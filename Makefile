@@ -1,0 +1,11 @@
+.PHONY: native test clean
+
+native:
+	cmake -S csrc -B build -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
+	cmake --build build
+
+test: native
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -rf build gloo_tpu/_native/*.so

@@ -1,0 +1,403 @@
+// C API for the tpucoll core, consumed by the gloo_tpu Python package over
+// ctypes (the repo's equivalent of a pybind layer, using only the stable C
+// ABI). Conventions:
+//  - handles are opaque pointers; *_free releases them;
+//  - functions return 0 on success or a TC_ERR_* code, with the message
+//    available from tc_last_error() (thread-local);
+//  - blocking calls release the GIL implicitly because ctypes drops it for
+//    foreign calls.
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/context.h"
+#include "tpucoll/rendezvous/file_store.h"
+#include "tpucoll/rendezvous/hash_store.h"
+#include "tpucoll/rendezvous/store.h"
+#include "tpucoll/transport/device.h"
+
+namespace {
+
+using tpucoll::Context;
+using tpucoll::DataType;
+using tpucoll::ReduceOp;
+using tpucoll::Store;
+using tpucoll::transport::Device;
+using tpucoll::transport::UnboundBuffer;
+
+thread_local std::string g_lastError;
+
+constexpr int TC_OK = 0;
+constexpr int TC_ERR = 1;
+constexpr int TC_ERR_TIMEOUT = 2;
+constexpr int TC_ERR_IO = 3;
+constexpr int TC_ERR_ABORTED = 4;
+
+template <typename Fn>
+int wrap(Fn&& fn) {
+  try {
+    fn();
+    return TC_OK;
+  } catch (const tpucoll::TimeoutException& e) {
+    g_lastError = e.what();
+    return TC_ERR_TIMEOUT;
+  } catch (const tpucoll::IoException& e) {
+    g_lastError = e.what();
+    return TC_ERR_IO;
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return TC_ERR;
+  } catch (...) {
+    g_lastError = "unknown error";
+    return TC_ERR;
+  }
+}
+
+std::chrono::milliseconds ms(int64_t v) {
+  return std::chrono::milliseconds(v);
+}
+
+using StoreHandle = std::shared_ptr<Store>;
+using DeviceHandle = std::shared_ptr<Device>;
+
+StoreHandle* asStore(void* h) { return static_cast<StoreHandle*>(h); }
+DeviceHandle* asDevice(void* h) { return static_cast<DeviceHandle*>(h); }
+Context* asContext(void* h) { return static_cast<Context*>(h); }
+UnboundBuffer* asBuffer(void* h) { return static_cast<UnboundBuffer*>(h); }
+
+template <typename Opts>
+void fillCommon(Opts& opts, Context* ctx, uint32_t tag, int64_t timeoutMs) {
+  opts.context = ctx;
+  opts.tag = tag;
+  opts.timeout = ms(timeoutMs);
+}
+
+std::vector<size_t> countsVec(const size_t* counts, int size) {
+  return std::vector<size_t>(counts, counts + size);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tc_last_error() { return g_lastError.c_str(); }
+
+// ---- stores ----
+
+void* tc_hash_store_new() {
+  return new StoreHandle(std::make_shared<tpucoll::HashStore>());
+}
+
+void* tc_file_store_new(const char* path) {
+  try {
+    return new StoreHandle(std::make_shared<tpucoll::FileStore>(path));
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+void* tc_prefix_store_new(void* base, const char* prefix) {
+  return new StoreHandle(
+      std::make_shared<tpucoll::PrefixStore>(*asStore(base), prefix));
+}
+
+void tc_store_free(void* store) { delete asStore(store); }
+
+int tc_store_set(void* store, const char* key, const uint8_t* data,
+                 size_t len) {
+  return wrap([&] {
+    (*asStore(store))->set(key, Store::Buf(data, data + len));
+  });
+}
+
+int tc_store_get(void* store, const char* key, int64_t timeoutMs,
+                 uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    auto buf = (*asStore(store))->get(key, ms(timeoutMs));
+    *outLen = buf.size();
+    *out = static_cast<uint8_t*>(malloc(buf.size()));
+    std::memcpy(*out, buf.data(), buf.size());
+  });
+}
+
+void tc_buf_free(uint8_t* buf) { free(buf); }
+
+int tc_store_add(void* store, const char* key, int64_t delta,
+                 int64_t* result) {
+  return wrap([&] { *result = (*asStore(store))->add(key, delta); });
+}
+
+// ---- device / context ----
+
+void* tc_device_new(const char* hostname, uint16_t port) {
+  try {
+    tpucoll::transport::DeviceAttr attr;
+    if (hostname != nullptr && hostname[0] != '\0') {
+      attr.hostname = hostname;
+    }
+    attr.port = port;
+    return new DeviceHandle(std::make_shared<Device>(attr));
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+void tc_device_free(void* dev) { delete asDevice(dev); }
+
+void* tc_context_new(int rank, int size) {
+  try {
+    return new Context(rank, size);
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+void tc_context_set_timeout(void* ctx, int64_t timeoutMs) {
+  asContext(ctx)->setTimeout(ms(timeoutMs));
+}
+
+int tc_context_connect(void* ctx, void* store, void* device) {
+  return wrap([&] {
+    asContext(ctx)->connectFullMesh(*asStore(store), *asDevice(device));
+  });
+}
+
+int tc_context_close(void* ctx) {
+  return wrap([&] { asContext(ctx)->close(); });
+}
+
+void tc_context_free(void* ctx) { delete asContext(ctx); }
+
+uint64_t tc_next_slot(void* ctx, uint32_t num) {
+  return asContext(ctx)->nextSlot(num);
+}
+
+// ---- collectives ----
+
+int tc_barrier(void* ctx, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::BarrierOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    tpucoll::barrier(opts);
+  });
+}
+
+int tc_broadcast(void* ctx, void* buffer, size_t count, int dtype, int root,
+                 uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::BroadcastOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.buffer = buffer;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.root = root;
+    tpucoll::broadcast(opts);
+  });
+}
+
+int tc_allreduce(void* ctx, const void* input, void* output, size_t count,
+                 int dtype, int op, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllreduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.inputs = {input};
+    opts.outputs = {output};
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    tpucoll::allreduce(opts);
+  });
+}
+
+int tc_reduce(void* ctx, const void* input, void* output, size_t count,
+              int dtype, int op, int root, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ReduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    opts.root = root;
+    tpucoll::reduce(opts);
+  });
+}
+
+int tc_gather(void* ctx, const void* input, void* output, size_t count,
+              int dtype, int root, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::GatherOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.root = root;
+    tpucoll::gather(opts);
+  });
+}
+
+int tc_gatherv(void* ctx, const void* input, void* output,
+               const size_t* counts, int dtype, int root, uint32_t tag,
+               int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::GathervOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.counts = countsVec(counts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.root = root;
+    tpucoll::gatherv(opts);
+  });
+}
+
+int tc_scatter(void* ctx, const void* input, void* output, size_t count,
+               int dtype, int root, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ScatterOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.root = root;
+    tpucoll::scatter(opts);
+  });
+}
+
+int tc_allgather(void* ctx, const void* input, void* output, size_t count,
+                 int dtype, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllgatherOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    tpucoll::allgather(opts);
+  });
+}
+
+int tc_allgatherv(void* ctx, const void* input, void* output,
+                  const size_t* counts, int dtype, uint32_t tag,
+                  int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllgathervOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.counts = countsVec(counts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    tpucoll::allgatherv(opts);
+  });
+}
+
+int tc_alltoall(void* ctx, const void* input, void* output, size_t count,
+                int dtype, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AlltoallOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    tpucoll::alltoall(opts);
+  });
+}
+
+int tc_alltoallv(void* ctx, const void* input, const size_t* inCounts,
+                 void* output, const size_t* outCounts, int dtype,
+                 uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AlltoallvOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.inCounts = countsVec(inCounts, asContext(ctx)->size());
+    opts.outCounts = countsVec(outCounts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    tpucoll::alltoallv(opts);
+  });
+}
+
+int tc_reduce_scatter(void* ctx, const void* input, void* output,
+                      const size_t* recvCounts, int dtype, int op,
+                      uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ReduceScatterOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.recvCounts = countsVec(recvCounts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    tpucoll::reduceScatter(opts);
+  });
+}
+
+// ---- point-to-point ----
+
+void* tc_buffer_new(void* ctx, void* ptr, size_t size) {
+  try {
+    return asContext(ctx)->createUnboundBuffer(ptr, size).release();
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+void tc_buffer_free(void* buf) { delete asBuffer(buf); }
+
+int tc_buffer_send(void* buf, int dst, uint64_t slot, size_t offset,
+                   size_t nbytes) {
+  return wrap([&] { asBuffer(buf)->send(dst, slot, offset, nbytes); });
+}
+
+int tc_buffer_recv(void* buf, int src, uint64_t slot, size_t offset,
+                   size_t nbytes) {
+  return wrap([&] { asBuffer(buf)->recv(src, slot, offset, nbytes); });
+}
+
+int tc_buffer_recv_any(void* buf, const int* srcs, size_t nsrcs,
+                       uint64_t slot, size_t offset, size_t nbytes) {
+  return wrap([&] {
+    asBuffer(buf)->recv(std::vector<int>(srcs, srcs + nsrcs), slot, offset,
+                        nbytes);
+  });
+}
+
+int tc_buffer_wait_send(void* buf, int64_t timeoutMs) {
+  int rv = TC_OK;
+  int code = wrap([&] {
+    if (!asBuffer(buf)->waitSend(ms(timeoutMs))) {
+      rv = TC_ERR_ABORTED;
+    }
+  });
+  return code != TC_OK ? code : rv;
+}
+
+int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
+  int rv = TC_OK;
+  int code = wrap([&] {
+    if (!asBuffer(buf)->waitRecv(srcOut, ms(timeoutMs))) {
+      rv = TC_ERR_ABORTED;
+    }
+  });
+  return code != TC_OK ? code : rv;
+}
+
+void tc_buffer_abort_wait_send(void* buf) {
+  asBuffer(buf)->abortWaitSend();
+}
+
+void tc_buffer_abort_wait_recv(void* buf) {
+  asBuffer(buf)->abortWaitRecv();
+}
+
+}  // extern "C"

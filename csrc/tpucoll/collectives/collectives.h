@@ -1,0 +1,154 @@
+// New-style collective API: free functions over options structs, untyped at
+// the schedule level (a ReduceFn is fetched once per call). Mirrors the
+// reference's function+options surface (e.g. gloo/allreduce.h:193,
+// gloo/broadcast.h, gloo/alltoallv.h) with the same semantics:
+//  - every collective on a context that may run concurrently with another
+//    must use a distinct tag;
+//  - all ranks must pass identical (count, dtype, op, tag);
+//  - timeouts default to the context timeout; failures throw IoException.
+//
+// Algorithms (original schedules, validated against the complexity notes in
+// reference docs/algorithms.md):
+//   barrier          dissemination, ceil(log2 P) rounds
+//   broadcast        binomial tree over virtual ranks rooted at `root`
+//   allreduce        ring reduce-scatter + ring allgather (bandwidth-optimal)
+//   reduce           binomial reduction tree to root
+//   gather(v)        direct sends to root
+//   scatter          direct sends from root
+//   allgather(v)     ring
+//   alltoall(v)      rotated pairwise exchange
+//   reduce_scatter   ring reduce-scatter with per-rank counts
+#pragma once
+
+#include <chrono>
+#include <cstddef>
+#include <vector>
+
+#include "tpucoll/context.h"
+#include "tpucoll/math.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+
+struct CollectiveOptions {
+  Context* context = nullptr;
+  uint32_t tag = 0;
+  // Zero means "use the context default".
+  std::chrono::milliseconds timeout{0};
+};
+
+struct BarrierOptions : CollectiveOptions {};
+void barrier(BarrierOptions& opts);
+
+struct BroadcastOptions : CollectiveOptions {
+  void* buffer = nullptr;  // in on root, out elsewhere
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+  int root = 0;
+};
+void broadcast(BroadcastOptions& opts);
+
+struct AllreduceOptions : CollectiveOptions {
+  // One or more local input buffers are reduced together first; the result
+  // lands in every output buffer (multi-buffer form matches the reference's
+  // multi-input allreduce used for one-process-per-host, N-accelerator
+  // setups). inputs may alias outputs.
+  std::vector<const void*> inputs;
+  std::vector<void*> outputs;
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+  ReduceOp op = ReduceOp::kSum;
+};
+void allreduce(AllreduceOptions& opts);
+
+struct ReduceOptions : CollectiveOptions {
+  const void* input = nullptr;
+  void* output = nullptr;  // required on root only
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+  ReduceOp op = ReduceOp::kSum;
+  int root = 0;
+};
+void reduce(ReduceOptions& opts);
+
+struct GatherOptions : CollectiveOptions {
+  const void* input = nullptr;  // count elements on every rank
+  void* output = nullptr;       // count * size elements on root
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+  int root = 0;
+};
+void gather(GatherOptions& opts);
+
+struct GathervOptions : CollectiveOptions {
+  const void* input = nullptr;        // counts[rank] elements
+  void* output = nullptr;             // sum(counts) elements on root
+  std::vector<size_t> counts;         // per-rank element counts, all ranks
+  DataType dtype = DataType::kFloat32;
+  int root = 0;
+};
+void gatherv(GathervOptions& opts);
+
+struct ScatterOptions : CollectiveOptions {
+  const void* input = nullptr;  // count * size elements on root
+  void* output = nullptr;       // count elements on every rank
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+  int root = 0;
+};
+void scatter(ScatterOptions& opts);
+
+struct AllgatherOptions : CollectiveOptions {
+  const void* input = nullptr;  // count elements
+  void* output = nullptr;       // count * size elements
+  size_t count = 0;
+  DataType dtype = DataType::kFloat32;
+};
+void allgather(AllgatherOptions& opts);
+
+struct AllgathervOptions : CollectiveOptions {
+  const void* input = nullptr;   // counts[rank] elements
+  void* output = nullptr;        // sum(counts) elements
+  std::vector<size_t> counts;    // per-rank element counts
+  DataType dtype = DataType::kFloat32;
+};
+void allgatherv(AllgathervOptions& opts);
+
+struct AlltoallOptions : CollectiveOptions {
+  const void* input = nullptr;  // count * size elements
+  void* output = nullptr;       // count * size elements
+  size_t count = 0;             // elements exchanged with EACH rank
+  DataType dtype = DataType::kFloat32;
+};
+void alltoall(AlltoallOptions& opts);
+
+struct AlltoallvOptions : CollectiveOptions {
+  const void* input = nullptr;
+  void* output = nullptr;
+  // inCounts[j]: elements this rank sends to rank j (contiguous splits).
+  // outCounts[j]: elements this rank receives from rank j.
+  std::vector<size_t> inCounts;
+  std::vector<size_t> outCounts;
+  DataType dtype = DataType::kFloat32;
+};
+void alltoallv(AlltoallvOptions& opts);
+
+struct ReduceScatterOptions : CollectiveOptions {
+  const void* input = nullptr;      // sum(recvCounts) elements
+  void* output = nullptr;           // recvCounts[rank] elements
+  std::vector<size_t> recvCounts;   // per-rank result block sizes
+  DataType dtype = DataType::kFloat32;
+  ReduceOp op = ReduceOp::kSum;
+};
+void reduceScatter(ReduceScatterOptions& opts);
+
+namespace detail {
+// Resolve the effective timeout for a collective call.
+inline std::chrono::milliseconds effectiveTimeout(
+    const CollectiveOptions& opts) {
+  return opts.timeout.count() > 0 ? opts.timeout
+                                  : opts.context->getTimeout();
+}
+}  // namespace detail
+
+}  // namespace tpucoll

@@ -1,0 +1,229 @@
+// Schedules built from direct point-to-point exchanges: barrier, broadcast,
+// gather(v), scatter, alltoall(v).
+#include <cstring>
+
+#include "tpucoll/collectives/collectives.h"
+
+namespace tpucoll {
+
+namespace {
+
+using transport::UnboundBuffer;
+
+char* bytePtr(void* p) { return static_cast<char*>(p); }
+const char* bytePtr(const void* p) { return static_cast<const char*>(p); }
+
+}  // namespace
+
+// Dissemination barrier (Hensgen–Finkel–Manber style, as in reference
+// gloo/barrier.cc:23-35): ceil(log2 P) rounds; in round i, signal rank+2^i
+// and await rank-2^i. Zero-byte messages carry the signal.
+void barrier(BarrierOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "barrier: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  if (size == 1) {
+    return;
+  }
+  Slot slot = Slot::build(SlotPrefix::kBarrier, opts.tag);
+  auto buf = ctx->createUnboundBuffer(nullptr, 0);
+  const uint64_t rounds = log2ceil(static_cast<uint64_t>(size));
+  for (uint64_t i = 0; i < rounds; i++) {
+    const int dist = 1 << i;
+    const int to = (rank + dist) % size;
+    const int from = (rank - dist + size) % size;
+    buf->send(to, slot.offset(i).value(), 0, 0);
+    buf->recv(from, slot.offset(i).value(), 0, 0);
+    buf->waitSend(timeout);
+    buf->waitRecv(nullptr, timeout);
+  }
+}
+
+// Binomial tree broadcast over virtual ranks (vrank 0 = root), matching the
+// reference's mask-walk participation scheme (gloo/broadcast.cc:44-84).
+void broadcast(BroadcastOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "broadcast: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE(opts.root >= 0 && opts.root < size, "broadcast: bad root");
+  const size_t nbytes = opts.count * elementSize(opts.dtype);
+  if (size == 1) {
+    return;
+  }
+  Slot slot = Slot::build(SlotPrefix::kBroadcast, opts.tag);
+  auto buf = ctx->createUnboundBuffer(opts.buffer, nbytes);
+  const int vrank = (rank - opts.root + size) % size;
+  auto physical = [&](int v) { return (v + opts.root) % size; };
+
+  // Climb until the bit where we receive from our parent.
+  int mask = 1;
+  while (mask < size) {
+    if (vrank & mask) {
+      buf->recv(physical(vrank - mask), slot.value(), 0, nbytes);
+      buf->waitRecv(nullptr, timeout);
+      break;
+    }
+    mask <<= 1;
+  }
+  // Fan out to children at decreasing distances.
+  mask >>= 1;
+  int pendingSends = 0;
+  while (mask > 0) {
+    if (vrank + mask < size) {
+      buf->send(physical(vrank + mask), slot.value(), 0, nbytes);
+      pendingSends++;
+    }
+    mask >>= 1;
+  }
+  while (pendingSends-- > 0) {
+    buf->waitSend(timeout);
+  }
+}
+
+void gather(GatherOptions& opts) {
+  GathervOptions v;
+  static_cast<CollectiveOptions&>(v) = opts;
+  v.input = opts.input;
+  v.output = opts.output;
+  v.counts.assign(opts.context->size(), opts.count);
+  v.dtype = opts.dtype;
+  v.root = opts.root;
+  gatherv(v);
+}
+
+// Root posts P-1 receives at per-rank offsets; leaves send once (reference:
+// gloo/gather.cc:28-59, gatherv.cc:58-109).
+void gatherv(GathervOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "gatherv: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE_EQ(opts.counts.size(), static_cast<size_t>(size),
+                "gatherv: counts must have one entry per rank");
+  const size_t elsize = elementSize(opts.dtype);
+  Slot slot = Slot::build(SlotPrefix::kGather, opts.tag);
+  const size_t myBytes = opts.counts[rank] * elsize;
+
+  if (rank == opts.root) {
+    size_t total = 0;
+    for (size_t c : opts.counts) {
+      total += c;
+    }
+    auto out = ctx->createUnboundBuffer(opts.output, total * elsize);
+    size_t offset = 0;
+    int pending = 0;
+    for (int j = 0; j < size; j++) {
+      const size_t jBytes = opts.counts[j] * elsize;
+      if (j == rank) {
+        std::memcpy(bytePtr(opts.output) + offset, opts.input, jBytes);
+      } else {
+        out->recv(j, slot.value(), offset, jBytes);
+        pending++;
+      }
+      offset += jBytes;
+    }
+    while (pending-- > 0) {
+      out->waitRecv(nullptr, timeout);
+    }
+  } else {
+    auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input),
+                                       myBytes);
+    in->send(opts.root, slot.value(), 0, myBytes);
+    in->waitSend(timeout);
+  }
+}
+
+// Root sends slice j to rank j; leaves post one receive (reference:
+// gloo/scatter.cc:38-60).
+void scatter(ScatterOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "scatter: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes = opts.count * elementSize(opts.dtype);
+  Slot slot = Slot::build(SlotPrefix::kScatter, opts.tag);
+
+  if (rank == opts.root) {
+    auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input),
+                                       nbytes * size);
+    int pending = 0;
+    for (int j = 0; j < size; j++) {
+      if (j == rank) {
+        std::memcpy(opts.output, bytePtr(opts.input) + j * nbytes, nbytes);
+      } else {
+        in->send(j, slot.value(), j * nbytes, nbytes);
+        pending++;
+      }
+    }
+    while (pending-- > 0) {
+      in->waitSend(timeout);
+    }
+  } else {
+    auto out = ctx->createUnboundBuffer(opts.output, nbytes);
+    out->recv(opts.root, slot.value(), 0, nbytes);
+    out->waitRecv(nullptr, timeout);
+  }
+}
+
+void alltoall(AlltoallOptions& opts) {
+  AlltoallvOptions v;
+  static_cast<CollectiveOptions&>(v) = opts;
+  v.input = opts.input;
+  v.output = opts.output;
+  v.inCounts.assign(opts.context->size(), opts.count);
+  v.outCounts.assign(opts.context->size(), opts.count);
+  v.dtype = opts.dtype;
+  alltoallv(v);
+}
+
+// Rotated pairwise exchange: at step i, send to rank+i and receive from
+// rank-i, so every step moves disjoint pairs and link load stays balanced
+// (reference: gloo/alltoall.cc:39-50, alltoallv.cc:19-30).
+void alltoallv(AlltoallvOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "alltoallv: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE_EQ(opts.inCounts.size(), static_cast<size_t>(size));
+  TC_ENFORCE_EQ(opts.outCounts.size(), static_cast<size_t>(size));
+  const size_t elsize = elementSize(opts.dtype);
+
+  std::vector<size_t> inOff(size, 0), outOff(size, 0);
+  size_t inTotal = 0, outTotal = 0;
+  for (int j = 0; j < size; j++) {
+    inOff[j] = inTotal;
+    outOff[j] = outTotal;
+    inTotal += opts.inCounts[j] * elsize;
+    outTotal += opts.outCounts[j] * elsize;
+  }
+
+  std::memcpy(bytePtr(opts.output) + outOff[rank],
+              bytePtr(opts.input) + inOff[rank],
+              opts.inCounts[rank] * elsize);
+  if (size == 1) {
+    return;
+  }
+
+  Slot slot = Slot::build(SlotPrefix::kAlltoall, opts.tag);
+  auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input), inTotal);
+  auto out = ctx->createUnboundBuffer(opts.output, outTotal);
+  for (int i = 1; i < size; i++) {
+    const int sendTo = (rank + i) % size;
+    const int recvFrom = (rank - i + size) % size;
+    in->send(sendTo, slot.value(), inOff[sendTo],
+             opts.inCounts[sendTo] * elsize);
+    out->recv(recvFrom, slot.value(), outOff[recvFrom],
+              opts.outCounts[recvFrom] * elsize);
+    in->waitSend(timeout);
+    out->waitRecv(nullptr, timeout);
+  }
+}
+
+}  // namespace tpucoll

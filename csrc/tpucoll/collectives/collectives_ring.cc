@@ -1,0 +1,277 @@
+// Ring schedules: allgather(v), allreduce (reduce-scatter + allgather),
+// reduce_scatter, and the binomial-tree reduce.
+//
+// Ring block bookkeeping: `count` elements are split into `size` blocks
+// (allreduce) or taken from per-rank counts (v-variants / reduce_scatter).
+// All rings send to rank+1 and receive from rank-1; per-step sub-slots keep
+// pipelined messages on one pair from cross-matching.
+#include <algorithm>
+#include <cstring>
+#include <vector>
+
+#include "tpucoll/collectives/collectives.h"
+
+namespace tpucoll {
+
+namespace {
+
+char* bytePtr(void* p) { return static_cast<char*>(p); }
+
+struct Blocks {
+  std::vector<size_t> bytes;    // per-block byte size
+  std::vector<size_t> offset;   // per-block byte offset
+};
+
+Blocks evenBlocks(size_t count, int size, size_t elsize) {
+  Blocks b;
+  b.bytes.resize(size);
+  b.offset.resize(size);
+  const size_t base = count / size;
+  const size_t rem = count % size;
+  size_t off = 0;
+  for (int i = 0; i < size; i++) {
+    const size_t elems = base + (static_cast<size_t>(i) < rem ? 1 : 0);
+    b.bytes[i] = elems * elsize;
+    b.offset[i] = off;
+    off += b.bytes[i];
+  }
+  return b;
+}
+
+Blocks countBlocks(const std::vector<size_t>& counts, size_t elsize) {
+  Blocks b;
+  b.bytes.resize(counts.size());
+  b.offset.resize(counts.size());
+  size_t off = 0;
+  for (size_t i = 0; i < counts.size(); i++) {
+    b.bytes[i] = counts[i] * elsize;
+    b.offset[i] = off;
+    off += b.bytes[i];
+  }
+  return b;
+}
+
+// Ring reduce-scatter over `work` (in place). After P-1 steps, rank r owns
+// block (r + 1 + startShift) mod P fully reduced. startShift=0 feeds the
+// allreduce allgather phase; startShift=-1 makes rank r own block r for the
+// standalone reduce_scatter.
+void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
+                       ReduceFn fn, size_t elsize, Slot slot,
+                       uint64_t slotBase, int startShift,
+                       std::chrono::milliseconds timeout,
+                       transport::UnboundBuffer* workBuf) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  size_t maxBlock = 0;
+  for (size_t b : blocks.bytes) {
+    maxBlock = std::max(maxBlock, b);
+  }
+  std::vector<char> tmp(maxBlock);
+  auto tmpBuf = ctx->createUnboundBuffer(tmp.data(), tmp.size());
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  for (int step = 0; step < size - 1; step++) {
+    const int sendBlock = (rank + startShift - step + 2 * size) % size;
+    const int recvBlock = (rank + startShift - step - 1 + 2 * size) % size;
+    const uint64_t s = slot.offset(slotBase + step).value();
+    workBuf->send(right, s, blocks.offset[sendBlock],
+                  blocks.bytes[sendBlock]);
+    tmpBuf->recv(left, s, 0, blocks.bytes[recvBlock]);
+    tmpBuf->waitRecv(nullptr, timeout);
+    if (blocks.bytes[recvBlock] > 0) {
+      fn(work + blocks.offset[recvBlock], tmp.data(),
+         blocks.bytes[recvBlock] / elsize);
+    }
+    workBuf->waitSend(timeout);
+  }
+}
+
+}  // namespace
+
+// Ring allgather: block b travels P-1 hops; receives land in place in the
+// output (reference schedule shape: gloo/allgather.cc:55-98).
+void allgatherv(AllgathervOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "allgatherv: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE_EQ(opts.counts.size(), static_cast<size_t>(size));
+  const size_t elsize = elementSize(opts.dtype);
+  Blocks blocks = countBlocks(opts.counts, elsize);
+  const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
+
+  if (opts.input != nullptr) {
+    std::memcpy(bytePtr(opts.output) + blocks.offset[rank], opts.input,
+                blocks.bytes[rank]);
+  }
+  if (size == 1) {
+    return;
+  }
+
+  Slot slot = Slot::build(SlotPrefix::kAllgather, opts.tag);
+  auto out = ctx->createUnboundBuffer(opts.output, total);
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  for (int step = 0; step < size - 1; step++) {
+    const int sendBlock = (rank - step + 2 * size) % size;
+    const int recvBlock = (rank - step - 1 + 2 * size) % size;
+    const uint64_t s = slot.offset(step).value();
+    out->send(right, s, blocks.offset[sendBlock], blocks.bytes[sendBlock]);
+    out->recv(left, s, blocks.offset[recvBlock], blocks.bytes[recvBlock]);
+    out->waitRecv(nullptr, timeout);
+    out->waitSend(timeout);
+  }
+}
+
+void allgather(AllgatherOptions& opts) {
+  AllgathervOptions v;
+  static_cast<CollectiveOptions&>(v) = opts;
+  v.input = opts.input;
+  v.output = opts.output;
+  v.counts.assign(opts.context->size(), opts.count);
+  v.dtype = opts.dtype;
+  allgatherv(v);
+}
+
+// Bandwidth-optimal ring allreduce (reference hot path: gloo/allreduce.cc:
+// 147-392): local multi-input reduce, ring reduce-scatter, ring allgather,
+// then fan the result to every output buffer.
+void allreduce(AllreduceOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "allreduce: null context");
+  TC_ENFORCE(!opts.inputs.empty() && !opts.outputs.empty(),
+             "allreduce: need at least one input and output");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t elsize = elementSize(opts.dtype);
+  const size_t nbytes = opts.count * elsize;
+  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+
+  // Local reduction of all inputs into outputs[0].
+  char* work = bytePtr(opts.outputs[0]);
+  if (work != opts.inputs[0]) {
+    std::memcpy(work, opts.inputs[0], nbytes);
+  }
+  for (size_t i = 1; i < opts.inputs.size(); i++) {
+    fn(work, opts.inputs[i], opts.count);
+  }
+
+  if (size > 1 && opts.count > 0) {
+    Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
+    Blocks blocks = evenBlocks(opts.count, size, elsize);
+    auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+    ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
+                      workBuf.get());
+    // Allgather phase: rank r starts owning reduced block (r+1); the block
+    // then rides the ring into place on every rank.
+    const int right = (rank + 1) % size;
+    const int left = (rank - 1 + size) % size;
+    for (int step = 0; step < size - 1; step++) {
+      const int sendBlock = (rank + 1 - step + 2 * size) % size;
+      const int recvBlock = (rank - step + 2 * size) % size;
+      const uint64_t s = slot.offset(size + step).value();
+      workBuf->send(right, s, blocks.offset[sendBlock],
+                    blocks.bytes[sendBlock]);
+      workBuf->recv(left, s, blocks.offset[recvBlock],
+                    blocks.bytes[recvBlock]);
+      workBuf->waitRecv(nullptr, timeout);
+      workBuf->waitSend(timeout);
+    }
+  }
+
+  for (size_t i = 1; i < opts.outputs.size(); i++) {
+    std::memcpy(opts.outputs[i], work, nbytes);
+  }
+}
+
+// Binomial reduction tree: leaves push partials toward the root, halving the
+// number of active ranks per round (log2 P latency steps).
+void reduce(ReduceOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "reduce: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE(opts.root >= 0 && opts.root < size, "reduce: bad root");
+  const size_t elsize = elementSize(opts.dtype);
+  const size_t nbytes = opts.count * elsize;
+  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+
+  const bool isRoot = rank == opts.root;
+  TC_ENFORCE(!isRoot || opts.output != nullptr, "reduce: root needs output");
+  std::vector<char> scratch;
+  char* result;
+  if (isRoot) {
+    result = bytePtr(opts.output);
+  } else {
+    scratch.resize(nbytes);
+    result = scratch.data();
+  }
+  if (result != opts.input) {
+    std::memcpy(result, opts.input, nbytes);
+  }
+  if (size == 1) {
+    return;
+  }
+
+  Slot slot = Slot::build(SlotPrefix::kReduce, opts.tag);
+  const int vrank = (rank - opts.root + size) % size;
+  auto physical = [&](int v) { return (v + opts.root) % size; };
+  auto resultBuf = ctx->createUnboundBuffer(result, nbytes);
+  std::vector<char> tmp(nbytes);
+  auto tmpBuf = ctx->createUnboundBuffer(tmp.data(), nbytes);
+
+  int mask = 1;
+  uint64_t round = 0;
+  while (mask < size) {
+    if (vrank & mask) {
+      resultBuf->send(physical(vrank - mask), slot.offset(round).value(), 0,
+                      nbytes);
+      resultBuf->waitSend(timeout);
+      break;
+    }
+    const int partner = vrank + mask;
+    if (partner < size) {
+      tmpBuf->recv(physical(partner), slot.offset(round).value(), 0, nbytes);
+      tmpBuf->waitRecv(nullptr, timeout);
+      fn(result, tmp.data(), opts.count);
+    }
+    mask <<= 1;
+    round++;
+  }
+}
+
+// Ring reduce-scatter with per-rank result blocks (reference analog:
+// gloo/reduce_scatter.h halving-doubling; the ring keeps per-step traffic
+// uniform and handles arbitrary recvCounts without bit-reversal reordering).
+void reduceScatter(ReduceScatterOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "reduceScatter: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE_EQ(opts.recvCounts.size(), static_cast<size_t>(size));
+  const size_t elsize = elementSize(opts.dtype);
+  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+  Blocks blocks = countBlocks(opts.recvCounts, elsize);
+  const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
+
+  if (size == 1) {
+    std::memcpy(opts.output, opts.input, total);
+    return;
+  }
+
+  // Work in a scratch copy so the caller's input stays intact.
+  std::vector<char> work(total);
+  std::memcpy(work.data(), opts.input, total);
+  Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
+  auto workBuf = ctx->createUnboundBuffer(work.data(), total);
+  ringReduceScatter(ctx, work.data(), blocks, fn, elsize, slot, 0,
+                    /*startShift=*/-1, timeout, workBuf.get());
+  std::memcpy(opts.output, work.data() + blocks.offset[rank],
+              blocks.bytes[rank]);
+}
+
+}  // namespace tpucoll

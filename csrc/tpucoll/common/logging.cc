@@ -1,0 +1,73 @@
+#include "tpucoll/common/logging.h"
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+
+namespace tpucoll {
+
+namespace {
+
+LogLevel parseThreshold() {
+  const char* env = std::getenv("TPUCOLL_LOG_LEVEL");
+  if (env == nullptr || env[0] == '\0') {
+    return LogLevel::kWarn;
+  }
+  if (strcasecmp(env, "debug") == 0 || strcmp(env, "0") == 0) {
+    return LogLevel::kDebug;
+  }
+  if (strcasecmp(env, "info") == 0 || strcmp(env, "1") == 0) {
+    return LogLevel::kInfo;
+  }
+  if (strcasecmp(env, "warn") == 0 || strcasecmp(env, "warning") == 0 ||
+      strcmp(env, "2") == 0) {
+    return LogLevel::kWarn;
+  }
+  return LogLevel::kError;
+}
+
+const char* levelName(LogLevel level) {
+  switch (level) {
+    case LogLevel::kDebug:
+      return "D";
+    case LogLevel::kInfo:
+      return "I";
+    case LogLevel::kWarn:
+      return "W";
+    case LogLevel::kError:
+      return "E";
+  }
+  return "?";
+}
+
+std::mutex& logMutex() {
+  static std::mutex m;
+  return m;
+}
+
+}  // namespace
+
+LogLevel logThreshold() {
+  static LogLevel threshold = parseThreshold();
+  return threshold;
+}
+
+void logMessage(LogLevel level, const char* file, int line,
+                const std::string& msg) {
+  const char* base = strrchr(file, '/');
+  base = (base != nullptr) ? base + 1 : file;
+  auto now = std::chrono::duration_cast<std::chrono::milliseconds>(
+                 std::chrono::system_clock::now().time_since_epoch())
+                 .count();
+  std::lock_guard<std::mutex> guard(logMutex());
+  fprintf(stderr, "[tpucoll %s %lld.%03lld pid=%d %s:%d] %s\n",
+          levelName(level), static_cast<long long>(now / 1000),
+          static_cast<long long>(now % 1000), getpid(), base, line,
+          msg.c_str());
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,115 @@
+// tpucoll L0: logging + enforcement macros + exception hierarchy.
+//
+// TPU-native rebuild of the reference's common layer (see
+// /root/reference/gloo/common/logging.h:40-207 and gloo/common/error.h for the
+// contracts being matched: leveled stderr logging gated by an env var, an
+// ENFORCE family that throws with file:line context, and an exception tree
+// where transport failures and timeouts are distinguishable).
+#pragma once
+
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+namespace tpucoll {
+
+enum class LogLevel : int {
+  kDebug = 0,
+  kInfo = 1,
+  kWarn = 2,
+  kError = 3,
+};
+
+// Threshold parsed once from TPUCOLL_LOG_LEVEL (DEBUG/INFO/WARN/ERROR or 0-3).
+// Default WARN so library is quiet under tests.
+LogLevel logThreshold();
+
+void logMessage(LogLevel level, const char* file, int line,
+                const std::string& msg);
+
+namespace detail {
+
+inline void strAppend(std::ostringstream&) {}
+
+template <typename T, typename... Rest>
+void strAppend(std::ostringstream& oss, const T& v, const Rest&... rest) {
+  oss << v;
+  strAppend(oss, rest...);
+}
+
+template <typename... Args>
+std::string strCat(const Args&... args) {
+  std::ostringstream oss;
+  strAppend(oss, args...);
+  return oss.str();
+}
+
+}  // namespace detail
+
+#define TC_LOG(level, ...)                                                    \
+  do {                                                                        \
+    if (static_cast<int>(level) >=                                            \
+        static_cast<int>(::tpucoll::logThreshold())) {                        \
+      ::tpucoll::logMessage(level, __FILE__, __LINE__,                        \
+                            ::tpucoll::detail::strCat(__VA_ARGS__));          \
+    }                                                                         \
+  } while (0)
+
+#define TC_DEBUG(...) TC_LOG(::tpucoll::LogLevel::kDebug, __VA_ARGS__)
+#define TC_INFO(...) TC_LOG(::tpucoll::LogLevel::kInfo, __VA_ARGS__)
+#define TC_WARN(...) TC_LOG(::tpucoll::LogLevel::kWarn, __VA_ARGS__)
+#define TC_ERROR(...) TC_LOG(::tpucoll::LogLevel::kError, __VA_ARGS__)
+
+// Root of the exception hierarchy. what() always carries file:line.
+class Exception : public std::runtime_error {
+ public:
+  explicit Exception(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+// Programmer error / contract violation (bad argument, bad state).
+class EnforceError : public Exception {
+ public:
+  using Exception::Exception;
+};
+
+// Transport-level failure: peer died, connection reset, socket error.
+// Contract (matching reference docs/errors.md): after an IoException the
+// context is poisoned; the caller rebuilds contexts/pairs to recover.
+class IoException : public Exception {
+ public:
+  using Exception::Exception;
+};
+
+// A blocking wait exceeded its deadline. Subtype of IoException so generic
+// "transport failed" handling catches it too.
+class TimeoutException : public IoException {
+ public:
+  using IoException::IoException;
+};
+
+// A wait was cancelled via abort().
+class AbortedException : public Exception {
+ public:
+  using Exception::Exception;
+};
+
+#define TC_THROW(ExcType, ...)                                                \
+  throw ExcType(::tpucoll::detail::strCat("[", __FILE__, ":", __LINE__, "] ", \
+                                          __VA_ARGS__))
+
+#define TC_ENFORCE(cond, ...)                                                 \
+  do {                                                                        \
+    if (!(cond)) {                                                            \
+      TC_THROW(::tpucoll::EnforceError, "enforce failed: " #cond " ",         \
+               ##__VA_ARGS__);                                                \
+    }                                                                         \
+  } while (0)
+
+#define TC_ENFORCE_EQ(a, b, ...) TC_ENFORCE((a) == (b), ##__VA_ARGS__)
+#define TC_ENFORCE_NE(a, b, ...) TC_ENFORCE((a) != (b), ##__VA_ARGS__)
+#define TC_ENFORCE_GE(a, b, ...) TC_ENFORCE((a) >= (b), ##__VA_ARGS__)
+#define TC_ENFORCE_GT(a, b, ...) TC_ENFORCE((a) > (b), ##__VA_ARGS__)
+#define TC_ENFORCE_LE(a, b, ...) TC_ENFORCE((a) <= (b), ##__VA_ARGS__)
+#define TC_ENFORCE_LT(a, b, ...) TC_ENFORCE((a) < (b), ##__VA_ARGS__)
+
+}  // namespace tpucoll

@@ -1,0 +1,43 @@
+#include "tpucoll/context.h"
+
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+
+constexpr std::chrono::milliseconds Context::kDefaultTimeout;
+
+Context::Context(int rank, int size) : rank_(rank), size_(size) {
+  TC_ENFORCE(size > 0, "context size must be positive");
+  TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
+             size);
+}
+
+Context::~Context() = default;
+
+void Context::connectFullMesh(std::shared_ptr<Store> store,
+                              std::shared_ptr<transport::Device> device) {
+  TC_ENFORCE(tctx_ == nullptr, "context already connected");
+  store_ = std::move(store);
+  device_ = std::move(device);
+  tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
+  tctx_->connectFullMesh(*store_, timeout_);
+}
+
+uint64_t Context::nextSlot(uint32_t numToSkip) {
+  uint32_t base = slotCounter_.fetch_add(numToSkip);
+  return Slot::build(SlotPrefix::kUser, base).value();
+}
+
+std::unique_ptr<transport::UnboundBuffer> Context::createUnboundBuffer(
+    void* ptr, size_t size) {
+  TC_ENFORCE(tctx_ != nullptr, "context not connected");
+  return tctx_->createUnboundBuffer(ptr, size);
+}
+
+void Context::close() {
+  if (tctx_) {
+    tctx_->close();
+  }
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,56 @@
+// Top-level process-group context: rank/size identity, default timeout, slot
+// allocation, and ownership of the transport mesh (reference contract:
+// gloo/context.h:27-65 + gloo/rendezvous/context.cc:25-35). All collective
+// state lives here — there is no global state anywhere in the library.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <memory>
+
+#include "tpucoll/rendezvous/store.h"
+#include "tpucoll/transport/context.h"
+#include "tpucoll/transport/device.h"
+
+namespace tpucoll {
+
+class Context {
+ public:
+  static constexpr std::chrono::milliseconds kDefaultTimeout =
+      std::chrono::milliseconds(30000);
+
+  Context(int rank, int size);
+  ~Context();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  std::chrono::milliseconds getTimeout() const { return timeout_; }
+  void setTimeout(std::chrono::milliseconds timeout) { timeout_ = timeout; }
+
+  // Bootstrap the full mesh over a rendezvous store. Call once.
+  void connectFullMesh(std::shared_ptr<Store> store,
+                       std::shared_ptr<transport::Device> device);
+
+  // Monotonic slot allocator for application point-to-point messaging under
+  // the kUser prefix; collectives namespace themselves by (prefix, tag).
+  uint64_t nextSlot(uint32_t numToSkip = 1);
+
+  std::unique_ptr<transport::UnboundBuffer> createUnboundBuffer(void* ptr,
+                                                               size_t size);
+
+  transport::Context* transport() const { return tctx_.get(); }
+
+  void close();
+
+ private:
+  const int rank_;
+  const int size_;
+  std::chrono::milliseconds timeout_{kDefaultTimeout};
+  std::atomic<uint32_t> slotCounter_{0};
+  std::shared_ptr<Store> store_;
+  std::shared_ptr<transport::Device> device_;
+  std::unique_ptr<transport::Context> tctx_;
+};
+
+}  // namespace tpucoll

@@ -1,0 +1,212 @@
+#include "tpucoll/math.h"
+
+#include <algorithm>
+#include <cstring>
+
+namespace tpucoll {
+
+float halfToFloat(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t u;
+  if (exp == 0) {
+    if (mant == 0) {
+      u = sign;  // +-0
+    } else {
+      // Subnormal: normalize.
+      int shift = 0;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        shift++;
+      }
+      mant &= 0x3ffu;
+      u = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    u = sign | 0x7f800000u | (mant << 13);  // inf / nan
+  } else {
+    u = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+uint16_t floatToHalf(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint32_t sign = (u >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((u >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = u & 0x7fffffu;
+  if (((u >> 23) & 0xff) == 0xff) {
+    // inf / nan
+    return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0));
+  }
+  if (exp >= 31) {
+    return static_cast<uint16_t>(sign | 0x7c00u);  // overflow -> inf
+  }
+  if (exp <= 0) {
+    if (exp < -10) {
+      return static_cast<uint16_t>(sign);  // underflow -> 0
+    }
+    // Subnormal half: shift with round-to-nearest-even.
+    mant |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t q = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (q & 1))) {
+      q++;
+    }
+    return static_cast<uint16_t>(sign | q);
+  }
+  // Normal: round mantissa 23 -> 10 bits, nearest-even.
+  uint32_t q = mant >> 13;
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (q & 1))) {
+    q++;
+    if (q == 0x400u) {
+      q = 0;
+      exp++;
+      if (exp >= 31) {
+        return static_cast<uint16_t>(sign | 0x7c00u);
+      }
+    }
+  }
+  return static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) | q);
+}
+
+uint16_t floatToBfloat16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  if ((u & 0x7f800000u) == 0x7f800000u && (u & 0x7fffffu)) {
+    return static_cast<uint16_t>((u >> 16) | 0x40u);  // quiet nan
+  }
+  uint32_t lsb = (u >> 16) & 1;
+  u += 0x7fffu + lsb;  // round to nearest even
+  return static_cast<uint16_t>(u >> 16);
+}
+
+namespace {
+
+template <typename T>
+struct OpSum {
+  static T apply(T a, T b) { return a + b; }
+};
+template <typename T>
+struct OpProd {
+  static T apply(T a, T b) { return a * b; }
+};
+template <typename T>
+struct OpMin {
+  static T apply(T a, T b) { return std::min(a, b); }
+};
+template <typename T>
+struct OpMax {
+  static T apply(T a, T b) { return std::max(a, b); }
+};
+
+template <typename T, template <typename> class Op>
+void reduceTyped(void* acc, const void* in, size_t n) {
+  T* a = static_cast<T*>(acc);
+  const T* b = static_cast<const T*>(in);
+  for (size_t i = 0; i < n; i++) {
+    a[i] = Op<T>::apply(a[i], b[i]);
+  }
+}
+
+// float16/bfloat16: widen to float, reduce, narrow. The loop is kept simple
+// so the compiler can vectorize the conversions; a Pallas/VPU path handles
+// the on-device case so this host path only sees staging buffers.
+template <template <typename> class Op>
+void reduceHalf(void* acc, const void* in, size_t n) {
+  uint16_t* a = static_cast<uint16_t*>(acc);
+  const uint16_t* b = static_cast<const uint16_t*>(in);
+  for (size_t i = 0; i < n; i++) {
+    a[i] = floatToHalf(Op<float>::apply(halfToFloat(a[i]), halfToFloat(b[i])));
+  }
+}
+
+template <template <typename> class Op>
+void reduceBf16(void* acc, const void* in, size_t n) {
+  uint16_t* a = static_cast<uint16_t*>(acc);
+  const uint16_t* b = static_cast<const uint16_t*>(in);
+  for (size_t i = 0; i < n; i++) {
+    a[i] = floatToBfloat16(
+        Op<float>::apply(bfloat16ToFloat(a[i]), bfloat16ToFloat(b[i])));
+  }
+}
+
+template <typename T>
+ReduceFn pickOp(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+      return &reduceTyped<T, OpSum>;
+    case ReduceOp::kProduct:
+      return &reduceTyped<T, OpProd>;
+    case ReduceOp::kMin:
+      return &reduceTyped<T, OpMin>;
+    case ReduceOp::kMax:
+      return &reduceTyped<T, OpMax>;
+  }
+  TC_THROW(EnforceError, "unknown reduce op");
+}
+
+ReduceFn pickHalfOp(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+      return &reduceHalf<OpSum>;
+    case ReduceOp::kProduct:
+      return &reduceHalf<OpProd>;
+    case ReduceOp::kMin:
+      return &reduceHalf<OpMin>;
+    case ReduceOp::kMax:
+      return &reduceHalf<OpMax>;
+  }
+  TC_THROW(EnforceError, "unknown reduce op");
+}
+
+ReduceFn pickBf16Op(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+      return &reduceBf16<OpSum>;
+    case ReduceOp::kProduct:
+      return &reduceBf16<OpProd>;
+    case ReduceOp::kMin:
+      return &reduceBf16<OpMin>;
+    case ReduceOp::kMax:
+      return &reduceBf16<OpMax>;
+  }
+  TC_THROW(EnforceError, "unknown reduce op");
+}
+
+}  // namespace
+
+ReduceFn getReduceFn(DataType dtype, ReduceOp op) {
+  switch (dtype) {
+    case DataType::kInt8:
+      return pickOp<int8_t>(op);
+    case DataType::kUint8:
+      return pickOp<uint8_t>(op);
+    case DataType::kInt32:
+      return pickOp<int32_t>(op);
+    case DataType::kUint32:
+      return pickOp<uint32_t>(op);
+    case DataType::kInt64:
+      return pickOp<int64_t>(op);
+    case DataType::kUint64:
+      return pickOp<uint64_t>(op);
+    case DataType::kFloat16:
+      return pickHalfOp(op);
+    case DataType::kBFloat16:
+      return pickBf16Op(op);
+    case DataType::kFloat32:
+      return pickOp<float>(op);
+    case DataType::kFloat64:
+      return pickOp<double>(op);
+  }
+  TC_THROW(EnforceError, "unknown dtype");
+}
+
+}  // namespace tpucoll

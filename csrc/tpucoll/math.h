@@ -1,0 +1,47 @@
+// tpucoll L0 math: typed elementwise reductions over raw memory, including
+// software float16 (IEEE binary16) and bfloat16.
+//
+// Replaces the reference's templated sum/product/max/min on raw pointers
+// (gloo/math.h:15-75) and its float16 type (gloo/types.h:97-335). The
+// collective schedules are untyped; they fetch a ReduceFn once per call and
+// apply it to byte ranges, so the dispatch cost is off the hot loop.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+
+// acc[i] = acc[i] OP in[i] for i in [0, n) elements.
+using ReduceFn = void (*)(void* acc, const void* in, size_t n);
+
+// Returns the builtin kernel for (dtype, op). Throws EnforceError for
+// unsupported combos (e.g. product over float16 is supported; nothing is
+// currently unsupported, but the check future-proofs custom dtypes).
+ReduceFn getReduceFn(DataType dtype, ReduceOp op);
+
+// IEEE 754 binary16 <-> float32 conversions (round-to-nearest-even on the
+// way down). Used by the fp16 reduction kernels and exposed for tests.
+float halfToFloat(uint16_t h);
+uint16_t floatToHalf(float f);
+
+// bfloat16 <-> float32 (round-to-nearest-even).
+inline float bfloat16ToFloat(uint16_t b) {
+  uint32_t u = static_cast<uint32_t>(b) << 16;
+  float f;
+  __builtin_memcpy(&f, &u, 4);
+  return f;
+}
+uint16_t floatToBfloat16(float f);
+
+inline uint64_t log2ceil(uint64_t n) {
+  uint64_t r = 0;
+  while ((uint64_t(1) << r) < n) {
+    r++;
+  }
+  return r;
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,143 @@
+#include "tpucoll/rendezvous/file_store.h"
+
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+
+namespace tpucoll {
+
+namespace {
+
+uint64_t fnv64(const std::string& s) {
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+std::string readAll(int fd) {
+  std::string out;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) {
+    out.append(buf, static_cast<size_t>(n));
+  }
+  TC_ENFORCE_GE(n, 0, "read failed: ", strerror(errno));
+  return out;
+}
+
+}  // namespace
+
+FileStore::FileStore(std::string path) : path_(std::move(path)) {
+  // Best-effort create; races with sibling ranks are fine.
+  mkdir(path_.c_str(), 0777);
+  struct stat st;
+  TC_ENFORCE(stat(path_.c_str(), &st) == 0 && S_ISDIR(st.st_mode),
+             "FileStore path is not a directory: ", path_);
+}
+
+std::string FileStore::fileFor(const std::string& key) const {
+  char name[32];
+  snprintf(name, sizeof(name), "tc_%016llx",
+           static_cast<unsigned long long>(fnv64(key)));
+  return path_ + "/" + name;
+}
+
+void FileStore::writeAtomic(const std::string& key, const Buf& value) {
+  const std::string target = fileFor(key);
+  const std::string tmp =
+      target + ".tmp." + std::to_string(getpid()) + "." +
+      std::to_string(reinterpret_cast<uintptr_t>(&value) & 0xffff);
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0666);
+  TC_ENFORCE_GE(fd, 0, "open failed for ", tmp, ": ", strerror(errno));
+  uint32_t keyLen = static_cast<uint32_t>(key.size());
+  bool ok = write(fd, &keyLen, sizeof(keyLen)) == sizeof(keyLen) &&
+            write(fd, key.data(), key.size()) ==
+                static_cast<ssize_t>(key.size()) &&
+            (value.empty() ||
+             write(fd, value.data(), value.size()) ==
+                 static_cast<ssize_t>(value.size()));
+  close(fd);
+  TC_ENFORCE(ok, "short write to ", tmp);
+  TC_ENFORCE(rename(tmp.c_str(), target.c_str()) == 0, "rename failed: ",
+             strerror(errno));
+}
+
+bool FileStore::tryRead(const std::string& key, Buf* out) const {
+  int fd = open(fileFor(key).c_str(), O_RDONLY);
+  if (fd < 0) {
+    TC_ENFORCE_EQ(errno, ENOENT, "open failed: ", strerror(errno));
+    return false;
+  }
+  std::string raw = readAll(fd);
+  close(fd);
+  TC_ENFORCE_GE(raw.size(), sizeof(uint32_t), "corrupt store file for ", key);
+  uint32_t keyLen;
+  std::memcpy(&keyLen, raw.data(), sizeof(keyLen));
+  TC_ENFORCE_GE(raw.size(), sizeof(uint32_t) + keyLen, "corrupt store file");
+  std::string storedKey = raw.substr(sizeof(uint32_t), keyLen);
+  TC_ENFORCE_EQ(storedKey, key, "FileStore key hash collision");
+  if (out != nullptr) {
+    const char* data = raw.data() + sizeof(uint32_t) + keyLen;
+    out->assign(data, data + raw.size() - sizeof(uint32_t) - keyLen);
+  }
+  return true;
+}
+
+void FileStore::set(const std::string& key, const Buf& value) {
+  writeAtomic(key, value);
+}
+
+Store::Buf FileStore::get(const std::string& key,
+                          std::chrono::milliseconds timeout) {
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  Buf out;
+  while (!tryRead(key, &out)) {
+    if (std::chrono::steady_clock::now() >= deadline) {
+      TC_THROW(TimeoutException, "FileStore::get timed out on key '", key,
+               "'");
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  return out;
+}
+
+bool FileStore::check(const std::vector<std::string>& keys) {
+  for (const auto& key : keys) {
+    if (!tryRead(key, nullptr)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+int64_t FileStore::add(const std::string& key, int64_t delta) {
+  const std::string lockPath = fileFor(key) + ".lock";
+  int lockFd = open(lockPath.c_str(), O_WRONLY | O_CREAT, 0666);
+  TC_ENFORCE_GE(lockFd, 0, "open lock failed: ", strerror(errno));
+  TC_ENFORCE(flock(lockFd, LOCK_EX) == 0, "flock failed: ", strerror(errno));
+  int64_t result = delta;
+  Buf current;
+  if (tryRead(key, &current)) {
+    TC_ENFORCE_EQ(current.size(), sizeof(int64_t), "add() on non-counter key");
+    int64_t value;
+    std::memcpy(&value, current.data(), sizeof(value));
+    result = value + delta;
+  }
+  Buf buf(sizeof(int64_t));
+  std::memcpy(buf.data(), &result, sizeof(result));
+  writeAtomic(key, buf);
+  flock(lockFd, LOCK_UN);
+  close(lockFd);
+  return result;
+}
+
+}  // namespace tpucoll

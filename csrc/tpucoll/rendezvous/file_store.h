@@ -1,0 +1,34 @@
+// Store over a shared filesystem: one file per key, written atomically via
+// tmp+rename, waits by polling. Works across processes and across hosts that
+// share a filesystem (reference: gloo/rendezvous/file_store.cc:31-90).
+//
+// Layout: <path>/tc_<fnv64(key)>. Each file embeds the full key so a hash
+// collision is detected rather than silently cross-matched. Atomic add() is
+// serialized with flock on a per-key lock file.
+#pragma once
+
+#include <string>
+
+#include "tpucoll/rendezvous/store.h"
+
+namespace tpucoll {
+
+class FileStore : public Store {
+ public:
+  explicit FileStore(std::string path);
+
+  void set(const std::string& key, const Buf& value) override;
+  Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
+  bool check(const std::vector<std::string>& keys) override;
+  int64_t add(const std::string& key, int64_t delta) override;
+
+ private:
+  std::string fileFor(const std::string& key) const;
+  // Returns false if the key file does not exist yet.
+  bool tryRead(const std::string& key, Buf* out) const;
+  void writeAtomic(const std::string& key, const Buf& value);
+
+  std::string path_;
+};
+
+}  // namespace tpucoll

@@ -1,0 +1,55 @@
+#include "tpucoll/rendezvous/hash_store.h"
+
+#include <cstring>
+
+namespace tpucoll {
+
+void HashStore::set(const std::string& key, const Buf& value) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    map_[key] = value;
+  }
+  cv_.notify_all();
+}
+
+Store::Buf HashStore::get(const std::string& key,
+                          std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto pred = [&] { return map_.find(key) != map_.end(); };
+  if (!cv_.wait_for(lock, timeout, pred)) {
+    TC_THROW(TimeoutException, "HashStore::get timed out on key '", key, "'");
+  }
+  return map_[key];
+}
+
+bool HashStore::check(const std::vector<std::string>& keys) {
+  std::lock_guard<std::mutex> guard(mu_);
+  for (const auto& key : keys) {
+    if (map_.find(key) == map_.end()) {
+      return false;
+    }
+  }
+  return true;
+}
+
+int64_t HashStore::add(const std::string& key, int64_t delta) {
+  int64_t result;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    int64_t current = 0;
+    auto it = map_.find(key);
+    if (it != map_.end()) {
+      TC_ENFORCE_EQ(it->second.size(), sizeof(int64_t),
+                    "add() on non-counter key '", key, "'");
+      std::memcpy(&current, it->second.data(), sizeof(int64_t));
+    }
+    result = current + delta;
+    Buf buf(sizeof(int64_t));
+    std::memcpy(buf.data(), &result, sizeof(int64_t));
+    map_[key] = std::move(buf);
+  }
+  cv_.notify_all();
+  return result;
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,27 @@
+// In-process store backed by a mutex-protected map; the default rendezvous
+// for multi-rank-in-one-process tests (reference: gloo/rendezvous/
+// hash_store.cc:14-52). Waits are condition-variable based, not polling.
+#pragma once
+
+#include <condition_variable>
+#include <mutex>
+#include <unordered_map>
+
+#include "tpucoll/rendezvous/store.h"
+
+namespace tpucoll {
+
+class HashStore : public Store {
+ public:
+  void set(const std::string& key, const Buf& value) override;
+  Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
+  bool check(const std::vector<std::string>& keys) override;
+  int64_t add(const std::string& key, int64_t delta) override;
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, Buf> map_;
+};
+
+}  // namespace tpucoll

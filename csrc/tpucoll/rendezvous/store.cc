@@ -1,0 +1,94 @@
+#include "tpucoll/rendezvous/store.h"
+
+#include <memory>
+#include <thread>
+
+namespace tpucoll {
+
+void Store::wait(const std::vector<std::string>& keys,
+                 std::chrono::milliseconds timeout) {
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  while (!check(keys)) {
+    if (std::chrono::steady_clock::now() >= deadline) {
+      TC_THROW(TimeoutException, "store wait timed out after ",
+               timeout.count(), "ms waiting for ", keys.size(), " key(s)");
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+}
+
+std::vector<Store::Buf> Store::multiGet(const std::vector<std::string>& keys,
+                                        std::chrono::milliseconds timeout) {
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  std::vector<Buf> out;
+  out.reserve(keys.size());
+  for (const auto& key : keys) {
+    auto now = std::chrono::steady_clock::now();
+    auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - now);
+    if (remaining.count() <= 0) {
+      TC_THROW(TimeoutException, "store multiGet timed out");
+    }
+    out.push_back(get(key, remaining));
+  }
+  return out;
+}
+
+void Store::multiSet(const std::vector<std::string>& keys,
+                     const std::vector<Buf>& values) {
+  TC_ENFORCE_EQ(keys.size(), values.size());
+  for (size_t i = 0; i < keys.size(); i++) {
+    set(keys[i], values[i]);
+  }
+}
+
+PrefixStore::PrefixStore(std::shared_ptr<Store> base, std::string prefix)
+    : base_(std::move(base)), prefix_(std::move(prefix)) {}
+
+std::string PrefixStore::qualify(const std::string& key) const {
+  return prefix_ + "/" + key;
+}
+
+void PrefixStore::set(const std::string& key, const Buf& value) {
+  base_->set(qualify(key), value);
+}
+
+Store::Buf PrefixStore::get(const std::string& key,
+                            std::chrono::milliseconds timeout) {
+  return base_->get(qualify(key), timeout);
+}
+
+bool PrefixStore::check(const std::vector<std::string>& keys) {
+  std::vector<std::string> qualified;
+  qualified.reserve(keys.size());
+  for (const auto& key : keys) {
+    qualified.push_back(qualify(key));
+  }
+  return base_->check(qualified);
+}
+
+int64_t PrefixStore::add(const std::string& key, int64_t delta) {
+  return base_->add(qualify(key), delta);
+}
+
+std::vector<Store::Buf> PrefixStore::multiGet(
+    const std::vector<std::string>& keys, std::chrono::milliseconds timeout) {
+  std::vector<std::string> qualified;
+  qualified.reserve(keys.size());
+  for (const auto& key : keys) {
+    qualified.push_back(qualify(key));
+  }
+  return base_->multiGet(qualified, timeout);
+}
+
+void PrefixStore::multiSet(const std::vector<std::string>& keys,
+                           const std::vector<Buf>& values) {
+  std::vector<std::string> qualified;
+  qualified.reserve(keys.size());
+  for (const auto& key : keys) {
+    qualified.push_back(qualify(key));
+  }
+  base_->multiSet(qualified, values);
+}
+
+}  // namespace tpucoll

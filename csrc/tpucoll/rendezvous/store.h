@@ -1,0 +1,76 @@
+// tpucoll rendezvous: key/value store interface used to bootstrap process
+// groups.
+//
+// Matches the reference contract (gloo/rendezvous/store.h:25-74 and
+// gloo/common/store.h:20-53): set/get with blocking waits and timeouts, an
+// existence check, plus the "v2" batched operations (multi_get/multi_set,
+// atomic add) that cut bootstrap round trips from O(n^2) to O(n) store calls.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+class Store {
+ public:
+  using Buf = std::vector<uint8_t>;
+  static constexpr std::chrono::milliseconds kDefaultTimeout =
+      std::chrono::milliseconds(30000);
+
+  virtual ~Store() = default;
+
+  virtual void set(const std::string& key, const Buf& value) = 0;
+
+  // Blocks until `key` exists, then returns its value. Throws
+  // TimeoutException if the deadline passes first.
+  virtual Buf get(const std::string& key,
+                  std::chrono::milliseconds timeout = kDefaultTimeout) = 0;
+
+  // Non-blocking: true iff every key currently exists.
+  virtual bool check(const std::vector<std::string>& keys) = 0;
+
+  // Blocks until all keys exist.
+  virtual void wait(const std::vector<std::string>& keys,
+                    std::chrono::milliseconds timeout = kDefaultTimeout);
+
+  // Atomically add `delta` to an integer-valued key (creating it at 0) and
+  // return the new value. Used for rank counting and store-side barriers.
+  virtual int64_t add(const std::string& key, int64_t delta) = 0;
+
+  // Batched variants; the base implementations loop, subclasses with a
+  // batched wire protocol (TCPStore) override them.
+  virtual std::vector<Buf> multiGet(
+      const std::vector<std::string>& keys,
+      std::chrono::milliseconds timeout = kDefaultTimeout);
+  virtual void multiSet(const std::vector<std::string>& keys,
+                        const std::vector<Buf>& values);
+};
+
+// Decorator that namespaces every key, so independent contexts can share one
+// physical store (reference: gloo/rendezvous/prefix_store.cc:13-40).
+class PrefixStore : public Store {
+ public:
+  PrefixStore(std::shared_ptr<Store> base, std::string prefix);
+
+  void set(const std::string& key, const Buf& value) override;
+  Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
+  bool check(const std::vector<std::string>& keys) override;
+  int64_t add(const std::string& key, int64_t delta) override;
+  std::vector<Buf> multiGet(const std::vector<std::string>& keys,
+                            std::chrono::milliseconds timeout) override;
+  void multiSet(const std::vector<std::string>& keys,
+                const std::vector<Buf>& values) override;
+
+ private:
+  std::string qualify(const std::string& key) const;
+  std::shared_ptr<Store> base_;
+  std::string prefix_;
+};
+
+}  // namespace tpucoll

@@ -1,0 +1,67 @@
+#include "tpucoll/transport/address.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+
+#include <cstring>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+std::string SockAddr::str() const {
+  char host[NI_MAXHOST];
+  char port[NI_MAXSERV];
+  int rv = getnameinfo(sa(), len, host, sizeof(host), port, sizeof(port),
+                       NI_NUMERICHOST | NI_NUMERICSERV);
+  if (rv != 0) {
+    return "<unresolvable>";
+  }
+  return std::string(host) + ":" + port;
+}
+
+std::vector<uint8_t> SockAddr::serialize() const {
+  std::vector<uint8_t> out(sizeof(socklen_t) + len);
+  std::memcpy(out.data(), &len, sizeof(socklen_t));
+  std::memcpy(out.data() + sizeof(socklen_t), &ss, len);
+  return out;
+}
+
+SockAddr SockAddr::deserialize(const uint8_t* data, size_t size) {
+  SockAddr addr;
+  TC_ENFORCE_GE(size, sizeof(socklen_t), "address blob too short");
+  std::memcpy(&addr.len, data, sizeof(socklen_t));
+  TC_ENFORCE_LE(sizeof(socklen_t) + addr.len, size, "address blob truncated");
+  TC_ENFORCE_LE(addr.len, socklen_t(sizeof(sockaddr_storage)));
+  std::memcpy(&addr.ss, data + sizeof(socklen_t), addr.len);
+  return addr;
+}
+
+SockAddr resolve(const std::string& hostname, uint16_t port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE | AI_NUMERICSERV;
+  addrinfo* result = nullptr;
+  const std::string portStr = std::to_string(port);
+  int rv = getaddrinfo(hostname.empty() ? nullptr : hostname.c_str(),
+                       portStr.c_str(), &hints, &result);
+  TC_ENFORCE_EQ(rv, 0, "getaddrinfo(", hostname, "): ", gai_strerror(rv));
+  SockAddr addr;
+  // Prefer IPv4 for loopback friendliness; fall back to first result.
+  addrinfo* chosen = result;
+  for (addrinfo* ai = result; ai != nullptr; ai = ai->ai_next) {
+    if (ai->ai_family == AF_INET) {
+      chosen = ai;
+      break;
+    }
+  }
+  addr.len = static_cast<socklen_t>(chosen->ai_addrlen);
+  std::memcpy(&addr.ss, chosen->ai_addr, chosen->ai_addrlen);
+  freeaddrinfo(result);
+  return addr;
+}
+
+}  // namespace transport
+}  // namespace tpucoll

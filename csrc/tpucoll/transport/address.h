@@ -1,0 +1,37 @@
+// Transport addresses: a resolved socket address that can be serialized into
+// a rendezvous store and reconstructed by peers (reference contract:
+// gloo/transport/address.h + gloo/transport/tcp/address.h:25-58; here the
+// pair-routing id travels separately in the rank blob, not in the address).
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+namespace transport {
+
+struct SockAddr {
+  sockaddr_storage ss{};
+  socklen_t len{0};
+
+  std::string str() const;
+
+  std::vector<uint8_t> serialize() const;
+  static SockAddr deserialize(const uint8_t* data, size_t size);
+
+  const sockaddr* sa() const {
+    return reinterpret_cast<const sockaddr*>(&ss);
+  }
+  sockaddr* sa() { return reinterpret_cast<sockaddr*>(&ss); }
+};
+
+// Resolve hostname (or dotted quad) to a bindable/connectable address with
+// the given port (0 = ephemeral).
+SockAddr resolve(const std::string& hostname, uint16_t port);
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,358 @@
+#include "tpucoll/transport/context.h"
+
+#include <cstring>
+
+#include "tpucoll/transport/device.h"
+#include "tpucoll/transport/pair.h"
+
+namespace tpucoll {
+namespace transport {
+
+namespace {
+
+std::string rankKey(int rank) { return "tc/rank/" + std::to_string(rank); }
+
+// Rank blob: [u32 numRanks][u32 addrLen][addr][u64 pairId * numRanks].
+std::vector<uint8_t> packRankBlob(int numRanks, const SockAddr& addr,
+                                  const std::vector<uint64_t>& pairIds) {
+  auto addrBytes = addr.serialize();
+  std::vector<uint8_t> blob;
+  blob.reserve(8 + addrBytes.size() + 8 * pairIds.size());
+  uint32_t n = static_cast<uint32_t>(numRanks);
+  uint32_t alen = static_cast<uint32_t>(addrBytes.size());
+  blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&n),
+              reinterpret_cast<uint8_t*>(&n) + 4);
+  blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&alen),
+              reinterpret_cast<uint8_t*>(&alen) + 4);
+  blob.insert(blob.end(), addrBytes.begin(), addrBytes.end());
+  blob.insert(blob.end(),
+              reinterpret_cast<const uint8_t*>(pairIds.data()),
+              reinterpret_cast<const uint8_t*>(pairIds.data()) +
+                  8 * pairIds.size());
+  return blob;
+}
+
+void unpackRankBlob(const std::vector<uint8_t>& blob, int expectRanks,
+                    SockAddr* addr, std::vector<uint64_t>* pairIds) {
+  TC_ENFORCE_GE(blob.size(), size_t(8), "rank blob too short");
+  uint32_t n, alen;
+  std::memcpy(&n, blob.data(), 4);
+  std::memcpy(&alen, blob.data() + 4, 4);
+  TC_ENFORCE_EQ(int(n), expectRanks, "rank blob size mismatch");
+  TC_ENFORCE_GE(blob.size(), size_t(8) + alen + size_t(8) * n,
+                "rank blob truncated");
+  *addr = SockAddr::deserialize(blob.data() + 8, alen);
+  pairIds->resize(n);
+  std::memcpy(pairIds->data(), blob.data() + 8 + alen, size_t(8) * n);
+}
+
+}  // namespace
+
+Context::Context(std::shared_ptr<Device> device, int rank, int size)
+    : device_(std::move(device)), rank_(rank), size_(size) {
+  TC_ENFORCE(rank >= 0 && rank < size, "bad rank ", rank, " for size ", size);
+  pairs_.resize(size);
+  pairErrors_.resize(size);
+}
+
+Context::~Context() {
+  close();
+  // Loop-thread teardowns may still reference this context (onPairError /
+  // matchIncoming); quiesce before members are freed.
+  device_->loop()->barrier();
+  pairs_.clear();
+}
+
+void Context::connectFullMesh(Store& store,
+                              std::chrono::milliseconds timeout) {
+  std::vector<uint64_t> pairIds(size_, 0);
+  for (int j = 0; j < size_; j++) {
+    if (j == rank_) {
+      continue;
+    }
+    pairs_[j] = std::make_unique<Pair>(this, device_->loop(), rank_, j,
+                                       device_->nextPairId());
+    pairIds[j] = pairs_[j]->localPairId();
+  }
+
+  store.set(rankKey(rank_), packRankBlob(size_, device_->address(), pairIds));
+
+  // Lower rank listens, higher rank initiates: register expectations first
+  // so an early initiator finds a parked or expected pair either way.
+  for (int j = rank_ + 1; j < size_; j++) {
+    pairs_[j]->expectViaListener(device_->listener());
+  }
+
+  std::vector<std::string> keys;
+  for (int j = 0; j < size_; j++) {
+    if (j != rank_) {
+      keys.push_back(rankKey(j));
+    }
+  }
+  auto blobs = store.multiGet(keys, timeout);
+
+  size_t blobIdx = 0;
+  for (int j = 0; j < size_; j++) {
+    if (j == rank_) {
+      continue;
+    }
+    SockAddr addr;
+    std::vector<uint64_t> peerPairIds;
+    unpackRankBlob(blobs[blobIdx++], size_, &addr, &peerPairIds);
+    if (rank_ > j) {
+      pairs_[j]->connect(addr, peerPairIds[rank_], timeout);
+    }
+  }
+
+  for (int j = 0; j < size_; j++) {
+    if (j != rank_) {
+      pairs_[j]->waitConnected(timeout);
+    }
+  }
+  TC_DEBUG("rank ", rank_, ": full mesh of ", size_, " connected via ",
+           device_->str());
+}
+
+std::unique_ptr<UnboundBuffer> Context::createUnboundBuffer(void* ptr,
+                                                            size_t size) {
+  return std::make_unique<UnboundBuffer>(this, ptr, size);
+}
+
+void Context::close() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_) {
+      return;
+    }
+    closed_ = true;
+  }
+  for (auto& pair : pairs_) {
+    if (pair) {
+      pair->close();
+    }
+  }
+  // Fail receives that will now never complete.
+  std::vector<UnboundBuffer*> victims;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (auto& pr : posted_) {
+      victims.push_back(pr.ubuf);
+    }
+    posted_.clear();
+    stashed_.clear();
+  }
+  for (auto* b : victims) {
+    b->onRecvError("context closed");
+  }
+}
+
+std::list<Context::PostedRecv>::iterator Context::findPosted(int srcRank,
+                                                             uint64_t slot,
+                                                             size_t nbytes) {
+  for (auto it = posted_.begin(); it != posted_.end(); ++it) {
+    if (it->slot == slot && it->allowed[srcRank]) {
+      TC_ENFORCE_EQ(it->nbytes, nbytes,
+                    "message size mismatch on slot ", slot, " from rank ",
+                    srcRank, ": posted ", it->nbytes, " incoming ", nbytes);
+      return it;
+    }
+  }
+  return posted_.end();
+}
+
+void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
+                       char* data, size_t nbytes) {
+  TC_ENFORCE(dstRank >= 0 && dstRank < size_, "bad destination rank ",
+             dstRank);
+  buf->addPendingSend();
+  if (dstRank == rank_) {
+    // Self-send: deliver through the matcher immediately. The payload is
+    // copied eagerly so the sender may reuse its buffer after waitSend.
+    UnboundBuffer* rbuf = nullptr;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      auto it = findPosted(rank_, slot, nbytes);
+      if (it != posted_.end()) {
+        std::memcpy(it->dest, data, nbytes);
+        rbuf = it->ubuf;
+        posted_.erase(it);
+      } else {
+        stashed_.push_back(
+            Stash{rank_, slot, std::vector<char>(data, data + nbytes)});
+      }
+    }
+    if (rbuf != nullptr) {
+      rbuf->onRecvComplete(rank_);
+    }
+    buf->onSendComplete();
+    return;
+  }
+  Pair* pair = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_) {
+      buf->cancelPendingSend();
+      TC_THROW(IoException, "send on closed context");
+    }
+    if (!pairErrors_[dstRank].empty()) {
+      buf->cancelPendingSend();
+      TC_THROW(IoException, "send to failed rank ", dstRank, ": ",
+               pairErrors_[dstRank]);
+    }
+    pair = pairs_[dstRank].get();
+    TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
+  }
+  try {
+    pair->send(buf, slot, data, nbytes);
+  } catch (...) {
+    buf->cancelPendingSend();
+    throw;
+  }
+}
+
+void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
+                       uint64_t slot, char* dest, size_t nbytes) {
+  buf->addPendingRecv();
+  bool fromStash = false;
+  int stashSrc = -1;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_) {
+      buf->cancelPendingRecv();
+      TC_THROW(IoException, "recv on closed context");
+    }
+    std::vector<char> allowed(size_, 0);
+    int liveAllowed = 0;
+    for (int r : srcRanks) {
+      TC_ENFORCE(r >= 0 && r < size_, "bad source rank ", r);
+      allowed[r] = 1;
+      if (pairErrors_[r].empty()) {
+        liveAllowed++;
+      }
+    }
+    // Earliest matching early-arrival wins (FIFO fairness across sources).
+    // The stash is consulted before the liveness check: data a peer
+    // delivered before departing is still consumable.
+    for (auto it = stashed_.begin(); it != stashed_.end(); ++it) {
+      if (it->slot == slot && allowed[it->srcRank]) {
+        TC_ENFORCE_EQ(it->data.size(), nbytes,
+                      "stashed message size mismatch on slot ", slot);
+        std::memcpy(dest, it->data.data(), nbytes);
+        stashSrc = it->srcRank;
+        stashed_.erase(it);
+        fromStash = true;
+        break;
+      }
+    }
+    if (!fromStash && liveAllowed == 0) {
+      buf->cancelPendingRecv();
+      TC_THROW(IoException, "recv: all source ranks failed (first error: ",
+               pairErrors_[srcRanks[0]], ")");
+    }
+    if (!fromStash) {
+      posted_.push_back(PostedRecv{buf, slot, dest, nbytes,
+                                   std::move(allowed)});
+    }
+  }
+  if (fromStash) {
+    buf->onRecvComplete(stashSrc);
+  }
+}
+
+void Context::cancelRecvsFor(UnboundBuffer* buf) {
+  int cancelled = 0;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (auto it = posted_.begin(); it != posted_.end();) {
+      if (it->ubuf == buf) {
+        it = posted_.erase(it);
+        cancelled++;
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (int i = 0; i < cancelled; i++) {
+    buf->cancelPendingRecv();
+  }
+}
+
+int Context::cancelSendsFor(UnboundBuffer* buf) {
+  int cancelled = 0;
+  for (auto& pair : pairs_) {
+    if (pair) {
+      cancelled += pair->cancelQueuedSends(buf);
+    }
+  }
+  for (int i = 0; i < cancelled; i++) {
+    buf->cancelPendingSend();
+  }
+  return cancelled;
+}
+
+void Context::failPairsWithInflightSend(UnboundBuffer* buf) {
+  for (auto& pair : pairs_) {
+    if (pair && pair->hasInflightSend(buf)) {
+      pair->failFromUser(
+          "send dropped: buffer destroyed while payload was in flight");
+    }
+  }
+}
+
+Context::Match Context::matchIncoming(int srcRank, uint64_t slot,
+                                      size_t nbytes) {
+  std::lock_guard<std::mutex> guard(mu_);
+  auto it = findPosted(srcRank, slot, nbytes);
+  if (it == posted_.end()) {
+    return Match{false, nullptr, nullptr};
+  }
+  Match m{true, it->ubuf, it->dest};
+  posted_.erase(it);
+  return m;
+}
+
+void Context::stashArrived(int srcRank, uint64_t slot,
+                           std::vector<char> data) {
+  UnboundBuffer* rbuf = nullptr;
+  int src = srcRank;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    // A matching recv may have been posted while the payload was in flight;
+    // prefer delivering straight into it.
+    auto it = findPosted(srcRank, slot, data.size());
+    if (it != posted_.end()) {
+      std::memcpy(it->dest, data.data(), data.size());
+      rbuf = it->ubuf;
+      posted_.erase(it);
+    } else {
+      stashed_.push_back(Stash{srcRank, slot, std::move(data)});
+    }
+  }
+  if (rbuf != nullptr) {
+    rbuf->onRecvComplete(src);
+  }
+}
+
+void Context::onPairError(int rank, const std::string& message) {
+  std::vector<UnboundBuffer*> victims;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (pairErrors_[rank].empty()) {
+      pairErrors_[rank] = message;
+    }
+    for (auto it = posted_.begin(); it != posted_.end();) {
+      if (it->allowed[rank]) {
+        victims.push_back(it->ubuf);
+        it = posted_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto* b : victims) {
+    b->onRecvError(message);
+  }
+}
+
+}  // namespace transport
+}  // namespace tpucoll

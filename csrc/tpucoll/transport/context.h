@@ -1,0 +1,111 @@
+// Transport context: owns the full mesh of pairs for one process group and
+// centralizes receive matching.
+//
+// Replaces the reference's per-slot tally/mutator machinery
+// (gloo/transport/tcp/context.cc, gloo/transport/context.h:111-298) with a
+// single matcher: a FIFO list of posted receives plus an arrival-ordered
+// stash of early messages. Recv-from-any falls out naturally: a posted
+// receive carries the set of admissible source ranks and the first matching
+// arrival claims it. Self-sends short-circuit through the same matcher.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <deque>
+#include <list>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tpucoll/common/logging.h"
+#include "tpucoll/rendezvous/store.h"
+#include "tpucoll/transport/unbound_buffer.h"
+
+namespace tpucoll {
+namespace transport {
+
+class Device;
+class Pair;
+
+class Context {
+ public:
+  Context(std::shared_ptr<Device> device, int rank, int size);
+  ~Context();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  Device* device() const { return device_.get(); }
+
+  // Store-based bootstrap: publish one blob per rank (address + per-peer
+  // pair routing ids — O(n) store traffic per rank, O(n^2) total), then
+  // connect the full mesh. Higher rank initiates, lower rank listens.
+  void connectFullMesh(Store& store, std::chrono::milliseconds timeout);
+
+  std::unique_ptr<UnboundBuffer> createUnboundBuffer(void* ptr, size_t size);
+
+  // Graceful teardown: closes all pairs; pending operations fail with
+  // IoException. Idempotent.
+  void close();
+
+  // ---- internal API (UnboundBuffer / Pair) ----
+  void postSend(UnboundBuffer* buf, int dstRank, uint64_t slot, char* data,
+                size_t nbytes);
+  void postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
+                uint64_t slot, char* dest, size_t nbytes);
+  void cancelRecvsFor(UnboundBuffer* buf);
+  // Drop queued (not yet on the wire) sends referencing buf; returns count.
+  int cancelSendsFor(UnboundBuffer* buf);
+  // Last-resort unblocking for ~UnboundBuffer: fail any pair that still has
+  // an in-flight (partially written) send referencing buf.
+  void failPairsWithInflightSend(UnboundBuffer* buf);
+
+  // Loop thread, on a fresh message header: claim a destination for it.
+  struct Match {
+    bool direct{false};  // true: land payload at `dest` and complete `ubuf`
+    UnboundBuffer* ubuf{nullptr};
+    char* dest{nullptr};
+  };
+  Match matchIncoming(int srcRank, uint64_t slot, size_t nbytes);
+
+  // Loop thread, when a stashed payload has fully arrived. Re-checks posted
+  // receives to close the race with a recv posted mid-payload.
+  void stashArrived(int srcRank, uint64_t slot, std::vector<char> data);
+
+  // A pair failed: poison posted receives that could match it and record the
+  // error for future sends.
+  void onPairError(int rank, const std::string& message);
+
+ private:
+  struct PostedRecv {
+    UnboundBuffer* ubuf;
+    uint64_t slot;
+    char* dest;
+    size_t nbytes;
+    std::vector<char> allowed;  // indexed by rank
+  };
+  struct Stash {
+    int srcRank;
+    uint64_t slot;
+    std::vector<char> data;
+  };
+
+  // Deliver a local or stashed payload into a posted recv (mu_ held).
+  // Returns the matched entry or posted_.end().
+  std::list<PostedRecv>::iterator findPosted(int srcRank, uint64_t slot,
+                                             size_t nbytes);
+
+  const std::shared_ptr<Device> device_;
+  const int rank_;
+  const int size_;
+
+  std::mutex mu_;
+  std::vector<std::unique_ptr<Pair>> pairs_;
+  std::list<PostedRecv> posted_;
+  std::deque<Stash> stashed_;
+  std::vector<std::string> pairErrors_;
+  bool closed_{false};
+};
+
+}  // namespace transport
+}  // namespace tpucoll

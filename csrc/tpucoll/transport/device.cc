@@ -1,0 +1,16 @@
+#include "tpucoll/transport/device.h"
+
+namespace tpucoll {
+namespace transport {
+
+Device::Device(const DeviceAttr& attr) {
+  SockAddr bindAddr = resolve(attr.hostname, attr.port);
+  listener_ = std::make_unique<Listener>(&loop_, bindAddr);
+}
+
+std::string Device::str() const {
+  return "tcp://" + listener_->address().str();
+}
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,44 @@
+// Device: the per-process transport endpoint. Owns the epoll loop thread and
+// the shared listener; hands out process-unique pair routing ids (reference
+// analog: gloo/transport/tcp/device.cc plus its Loop/Listener ownership).
+// Multiple contexts can share one device; their pairs never cross-match
+// because pair ids are globally unique within the device.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "tpucoll/transport/address.h"
+#include "tpucoll/transport/listener.h"
+#include "tpucoll/transport/loop.h"
+
+namespace tpucoll {
+namespace transport {
+
+struct DeviceAttr {
+  // Hostname or IP to bind and advertise. Loopback default suits
+  // single-host tests; multi-host deployments pass the DCN hostname.
+  std::string hostname{"127.0.0.1"};
+  uint16_t port{0};  // 0 = ephemeral
+};
+
+class Device {
+ public:
+  explicit Device(const DeviceAttr& attr);
+
+  Loop* loop() { return &loop_; }
+  Listener* listener() { return listener_.get(); }
+  const SockAddr& address() const { return listener_->address(); }
+  uint64_t nextPairId() { return pairId_.fetch_add(1); }
+  std::string str() const;
+
+ private:
+  Loop loop_;  // declared first: destroyed last
+  std::unique_ptr<Listener> listener_;
+  std::atomic<uint64_t> pairId_{1};
+};
+
+}  // namespace transport
+}  // namespace tpucoll

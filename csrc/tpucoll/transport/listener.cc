@@ -1,0 +1,176 @@
+#include "tpucoll/transport/listener.h"
+
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tpucoll/common/logging.h"
+#include "tpucoll/transport/pair.h"
+#include "tpucoll/transport/socket.h"
+#include "tpucoll/transport/wire.h"
+
+namespace tpucoll {
+namespace transport {
+
+// Reads the hello preamble off a fresh inbound connection, then hands the fd
+// back to the listener for routing.
+class PendingConn : public Handler {
+ public:
+  PendingConn(Listener* listener, int fd) : listener_(listener), fd_(fd) {}
+
+  int fd() const { return fd_; }
+
+  void handleEvents(uint32_t /*events*/) override {
+    while (true) {
+      ssize_t n = read(fd_, buf_ + got_, sizeof(WireHello) - got_);
+      if (n == 0) {
+        listener_->finishPending(this, false, 0, fd_);
+        return;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        listener_->finishPending(this, false, 0, fd_);
+        return;
+      }
+      got_ += static_cast<size_t>(n);
+      if (got_ == sizeof(WireHello)) {
+        WireHello hello;
+        std::memcpy(&hello, buf_, sizeof(hello));
+        const bool ok = hello.magic == kHelloMagic;
+        listener_->finishPending(this, ok, hello.pairId, fd_);
+        return;
+      }
+    }
+  }
+
+ private:
+  Listener* const listener_;
+  const int fd_;
+  char buf_[sizeof(WireHello)];
+  size_t got_{0};
+};
+
+Listener::Listener(Loop* loop, const SockAddr& bindAddr) : loop_(loop) {
+  fd_ = socket(bindAddr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  TC_ENFORCE_GE(fd_, 0, errnoString("socket"));
+  setReuseAddr(fd_);
+  TC_ENFORCE_EQ(bind(fd_, bindAddr.sa(), bindAddr.len), 0,
+                errnoString("bind"), " at ", bindAddr.str());
+  TC_ENFORCE_EQ(listen(fd_, 4096), 0, errnoString("listen"));
+  addr_.len = sizeof(addr_.ss);
+  TC_ENFORCE_EQ(getsockname(fd_, addr_.sa(), &addr_.len), 0,
+                errnoString("getsockname"));
+  setNonBlocking(fd_);
+  loop_->add(fd_, EPOLLIN, this);
+}
+
+Listener::~Listener() {
+  loop_->del(fd_);
+  ::close(fd_);
+  // Stop concurrent finishPending from routing/erasing while we tear down,
+  // then quiesce each half-open connection before closing it.
+  std::list<std::unique_ptr<PendingConn>> leftovers;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    shuttingDown_ = true;
+    leftovers.swap(pending_);
+  }
+  for (auto& conn : leftovers) {
+    loop_->del(conn->fd());  // barriers: no in-flight dispatch afterwards
+    ::close(conn->fd());
+  }
+  for (auto& kv : parked_) {
+    ::close(kv.second);
+  }
+}
+
+void Listener::handleEvents(uint32_t /*events*/) {
+  while (true) {
+    int fd = accept4(fd_, nullptr, nullptr, SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        return;
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      TC_WARN("accept failed: ", strerror(errno));
+      return;
+    }
+    setNoDelay(fd);
+    auto conn = std::make_unique<PendingConn>(this, fd);
+    PendingConn* raw = conn.get();
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      pending_.push_back(std::move(conn));
+    }
+    loop_->add(fd, EPOLLIN, raw);
+  }
+}
+
+void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
+                             int fd) {
+  Pair* target = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (shuttingDown_) {
+      return;  // the destructor owns this connection now
+    }
+    loop_->del(fd);  // loop thread: immediate
+    for (auto it = pending_.begin(); it != pending_.end(); ++it) {
+      if (it->get() == conn) {
+        pending_.erase(it);
+        break;
+      }
+    }
+    if (ok) {
+      auto it = expected_.find(pairId);
+      if (it != expected_.end()) {
+        target = it->second;
+        expected_.erase(it);
+      } else {
+        parked_[pairId] = fd;
+      }
+    }
+  }
+  if (!ok) {
+    ::close(fd);
+    return;
+  }
+  if (target != nullptr) {
+    target->assumeConnected(fd);
+  }
+}
+
+void Listener::expect(uint64_t pairId, Pair* pair) {
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    auto it = parked_.find(pairId);
+    if (it != parked_.end()) {
+      fd = it->second;
+      parked_.erase(it);
+    } else {
+      expected_[pairId] = pair;
+    }
+  }
+  if (fd >= 0) {
+    pair->assumeConnected(fd);
+  }
+}
+
+void Listener::unexpect(uint64_t pairId) {
+  std::lock_guard<std::mutex> guard(mu_);
+  expected_.erase(pairId);
+}
+
+}  // namespace transport
+}  // namespace tpucoll

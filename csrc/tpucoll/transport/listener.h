@@ -1,0 +1,53 @@
+// Listener: one listening socket per transport device, shared by every pair
+// and every context on that device. Inbound connections announce the pair
+// they belong to with a 16-byte hello; the listener routes the socket to the
+// expecting Pair, or parks it until the Pair registers (reference analog:
+// gloo/transport/tcp/listener.h:50-72 seq-number routing).
+#pragma once
+
+#include <cstdint>
+#include <list>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+#include "tpucoll/transport/address.h"
+#include "tpucoll/transport/loop.h"
+
+namespace tpucoll {
+namespace transport {
+
+class Pair;
+class PendingConn;
+
+class Listener : public Handler {
+ public:
+  Listener(Loop* loop, const SockAddr& bindAddr);
+  ~Listener() override;
+
+  const SockAddr& address() const { return addr_; }
+
+  // Route the inbound connection carrying `pairId` to `pair` (immediately if
+  // it already arrived and was parked).
+  void expect(uint64_t pairId, Pair* pair);
+  void unexpect(uint64_t pairId);
+
+  void handleEvents(uint32_t events) override;
+
+  // PendingConn completion (loop thread). Destroys `conn`.
+  void finishPending(PendingConn* conn, bool ok, uint64_t pairId, int fd);
+
+ private:
+  Loop* const loop_;
+  int fd_{-1};
+  SockAddr addr_;
+
+  std::mutex mu_;
+  bool shuttingDown_{false};
+  std::unordered_map<uint64_t, Pair*> expected_;
+  std::unordered_map<uint64_t, int> parked_;
+  std::list<std::unique_ptr<PendingConn>> pending_;
+};
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,140 @@
+#include "tpucoll/transport/loop.h"
+
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+namespace {
+constexpr int kMaxEvents = 64;
+}
+
+Loop::Loop() {
+  epollFd_ = epoll_create1(EPOLL_CLOEXEC);
+  TC_ENFORCE_GE(epollFd_, 0, "epoll_create1: ", strerror(errno));
+  wakeFd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  TC_ENFORCE_GE(wakeFd_, 0, "eventfd: ", strerror(errno));
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // nullptr marks the wake fd
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, wakeFd_, &ev), 0);
+  thread_ = std::thread([this] { run(); });
+}
+
+Loop::~Loop() {
+  stop_.store(true);
+  wake();
+  thread_.join();
+  ::close(wakeFd_);
+  ::close(epollFd_);
+}
+
+void Loop::add(int fd, uint32_t events, Handler* handler) {
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.ptr = handler;
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, fd, &ev), 0,
+                "epoll add: ", strerror(errno));
+}
+
+void Loop::mod(int fd, uint32_t events, Handler* handler) {
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.ptr = handler;
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_MOD, fd, &ev), 0,
+                "epoll mod: ", strerror(errno));
+}
+
+void Loop::del(int fd) {
+  epoll_event ev{};
+  int rv = epoll_ctl(epollFd_, EPOLL_CTL_DEL, fd, &ev);
+  if (rv != 0) {
+    TC_ENFORCE_EQ(errno, ENOENT, "epoll del: ", strerror(errno));
+  }
+  // Tick barrier: once the loop completes the current dispatch batch, no
+  // stale event for fd can be pending.
+  barrier();
+}
+
+void Loop::barrier() {
+  if (onLoopThread()) {
+    return;
+  }
+  uint64_t target;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    target = tick_ + 1;
+  }
+  wake();
+  std::unique_lock<std::mutex> lock(mu_);
+  cv_.wait(lock, [&] { return tick_ >= target || stop_.load(); });
+}
+
+void Loop::defer(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    deferred_.push_back(std::move(fn));
+  }
+  wake();
+}
+
+bool Loop::onLoopThread() const {
+  return std::this_thread::get_id() == thread_.get_id();
+}
+
+void Loop::wake() {
+  uint64_t one = 1;
+  ssize_t n = write(wakeFd_, &one, sizeof(one));
+  (void)n;
+}
+
+void Loop::run() {
+  epoll_event events[kMaxEvents];
+  while (!stop_.load()) {
+    int n = epoll_wait(epollFd_, events, kMaxEvents, 100);
+    if (n < 0) {
+      TC_ENFORCE_EQ(errno, EINTR, "epoll_wait: ", strerror(errno));
+      continue;
+    }
+    for (int i = 0; i < n; i++) {
+      if (events[i].data.ptr == nullptr) {
+        uint64_t drain;
+        while (read(wakeFd_, &drain, sizeof(drain)) > 0) {
+        }
+        continue;
+      }
+      try {
+        static_cast<Handler*>(events[i].data.ptr)
+            ->handleEvents(events[i].events);
+      } catch (const std::exception& e) {
+        // Handlers convert expected failures into pair errors themselves; an
+        // exception reaching here is a bug, but killing the whole process
+        // (std::terminate off a std::thread) would take every rank down.
+        TC_ERROR("unhandled exception on event loop thread: ", e.what());
+      }
+    }
+    std::vector<std::function<void()>> fns;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      tick_++;
+      fns.swap(deferred_);
+    }
+    cv_.notify_all();
+    for (auto& fn : fns) {
+      fn();
+    }
+  }
+  std::lock_guard<std::mutex> guard(mu_);
+  tick_ += 2;  // release any del() waiters at shutdown
+  cv_.notify_all();
+}
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,67 @@
+// Single epoll event-loop thread per transport device. All async socket I/O
+// dispatch happens on this thread; user threads only enqueue work and block
+// on condition variables (the reference's design point, gloo/transport/tcp/
+// loop.cc:103-220, rebuilt with an eventfd wakeup and a tick-barrier
+// unregister instead of deferred-function handshakes).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace tpucoll {
+namespace transport {
+
+class Handler {
+ public:
+  virtual ~Handler() = default;
+  virtual void handleEvents(uint32_t events) = 0;
+};
+
+class Loop {
+ public:
+  Loop();
+  ~Loop();
+
+  // Register fd with the epoll set. `events` is an EPOLL* mask. The handler
+  // must outlive the registration.
+  void add(int fd, uint32_t events, Handler* handler);
+  void mod(int fd, uint32_t events, Handler* handler);
+
+  // Remove fd. On return it is guaranteed no handler dispatch for this fd is
+  // in flight (unless called from the loop thread itself, where that is
+  // trivially true). The barrier is a loop-generation tick: the caller waits
+  // until the loop has passed through epoll_wait at least once more.
+  void del(int fd);
+
+  // Run fn on the loop thread at the next tick.
+  void defer(std::function<void()> fn);
+
+  // Wait until the loop has completed the current dispatch batch (no-op on
+  // the loop thread). After it returns, no handler invocation that started
+  // before the call is still in flight.
+  void barrier();
+
+  bool onLoopThread() const;
+
+ private:
+  void run();
+  void wake();
+
+  int epollFd_{-1};
+  int wakeFd_{-1};
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  uint64_t tick_{0};
+  std::vector<std::function<void()>> deferred_;
+};
+
+}  // namespace transport
+}  // namespace tpucoll

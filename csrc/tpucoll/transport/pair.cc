@@ -1,0 +1,538 @@
+#include "tpucoll/transport/pair.h"
+
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+
+#include "tpucoll/transport/context.h"
+#include "tpucoll/transport/listener.h"
+#include "tpucoll/transport/socket.h"
+
+namespace tpucoll {
+namespace transport {
+
+Pair::Pair(Context* context, Loop* loop, int selfRank, int peerRank,
+           uint64_t localPairId)
+    : context_(context),
+      loop_(loop),
+      selfRank_(selfRank),
+      peerRank_(peerRank),
+      localPairId_(localPairId) {}
+
+Pair::~Pair() {
+  close();
+  // A teardown started on the loop thread (EOF, tx error) may still be
+  // executing after close() early-returns; quiesce before freeing members.
+  loop_->barrier();
+}
+
+void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
+                   std::chrono::milliseconds timeout) {
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  int fd = socket(remote.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  TC_ENFORCE_GE(fd, 0, errnoString("socket"));
+  setNonBlocking(fd);
+
+  int rv = ::connect(fd, remote.sa(), remote.len);
+  if (rv != 0 && errno != EINPROGRESS) {
+    ::close(fd);
+    TC_THROW(IoException, "connect to rank ", peerRank_, " at ", remote.str(),
+             ": ", strerror(errno));
+  }
+  if (rv != 0) {
+    // Await writability = connection established (or refused). Retry EINTR
+    // against the remaining deadline; a real poll error is an IoException,
+    // not a timeout.
+    while (true) {
+      pollfd pfd{fd, POLLOUT, 0};
+      auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      int prv = poll(&pfd, 1, static_cast<int>(std::max<int64_t>(
+                                  remaining.count(), 0)));
+      if (prv > 0) {
+        break;
+      }
+      if (prv == 0) {
+        ::close(fd);
+        TC_THROW(TimeoutException, "connect to rank ", peerRank_, " at ",
+                 remote.str(), " timed out");
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      int savedErrno = errno;
+      ::close(fd);
+      TC_THROW(IoException, "connect to rank ", peerRank_, " at ",
+               remote.str(), ": poll: ", strerror(savedErrno));
+    }
+    int soErr = 0;
+    socklen_t soLen = sizeof(soErr);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &soErr, &soLen);
+    if (soErr != 0) {
+      ::close(fd);
+      TC_THROW(IoException, "connect to rank ", peerRank_, " at ",
+               remote.str(), ": ", strerror(soErr));
+    }
+  }
+  setNoDelay(fd);
+
+  // Route this connection to the peer's expecting Pair.
+  WireHello hello{kHelloMagic, 0, remotePairId};
+  const char* p = reinterpret_cast<const char*>(&hello);
+  size_t sent = 0;
+  while (sent < sizeof(hello)) {
+    ssize_t n = write(fd, p + sent, sizeof(hello) - sent);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        pollfd pfd{fd, POLLOUT, 0};
+        poll(&pfd, 1, 1000);
+        continue;
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      ::close(fd);
+      TC_THROW(IoException, "hello write to rank ", peerRank_, ": ",
+               strerror(errno));
+    }
+    sent += static_cast<size_t>(n);
+  }
+  assumeConnected(fd);
+}
+
+void Pair::expectViaListener(Listener* listener) {
+  expectedAt_ = listener;
+  listener->expect(localPairId_, this);
+}
+
+void Pair::assumeConnected(int fd) {
+  setNonBlocking(fd);
+  bool accepted = false;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (state_.load() == State::kInitializing) {
+      fd_ = fd;
+      epollMask_ = EPOLLIN;
+      everConnected_.store(true);
+      state_.store(State::kConnected);
+      loop_->add(fd, EPOLLIN, this);
+      accepted = true;
+    }
+  }
+  if (!accepted) {
+    ::close(fd);  // pair was closed while the connection was in flight
+    return;
+  }
+  cv_.notify_all();
+}
+
+void Pair::waitConnected(std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto pred = [&] { return state_.load() != State::kInitializing; };
+  if (!cv_.wait_for(lock, timeout, pred)) {
+    TC_THROW(TimeoutException, "rank ", selfRank_,
+             ": timed out connecting pair to rank ", peerRank_);
+  }
+  State s = state_.load();
+  if (s != State::kConnected && !everConnected_.load()) {
+    TC_THROW(IoException, "pair to rank ", peerRank_, " failed: ", error_);
+  }
+  // A pair that connected and already saw the peer depart counts as
+  // connected: everything the peer sent is staged in the context stash, so
+  // receive-only schedules against it still complete.
+}
+
+void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
+                size_t nbytes) {
+  std::vector<UnboundBuffer*> completed;
+  std::string txError;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    State s = state_.load();
+    if (s != State::kConnected || closing_) {
+      TC_THROW(IoException, "send to rank ", peerRank_, ": pair ",
+               s == State::kFailed ? error_
+               : closing_          ? "is closing"
+                                   : "is not connected");
+    }
+    TxOp op;
+    op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
+                           {0, 0, 0}, slot, nbytes};
+    op.ubuf = ubuf;
+    op.data = data;
+    op.nbytes = nbytes;
+    tx_.push_back(op);
+    if (tx_.size() == 1) {
+      // Inline fast path: try to push the bytes out right here, skipping a
+      // loop-thread wakeup when the socket has room (the common case).
+      flushTx(&completed);
+      if (state_.load() == State::kConnected && !tx_.empty()) {
+        updateEpollMask();
+      }
+    } else {
+      updateEpollMask();
+    }
+    txError = pendingTxError_;
+    pendingTxError_.clear();
+  }
+  for (auto* b : completed) {
+    if (b != nullptr) {
+      b->onSendComplete();
+    }
+  }
+  if (!txError.empty()) {
+    fail(txError);
+  }
+}
+
+int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
+  std::lock_guard<std::mutex> guard(mu_);
+  int removed = 0;
+  for (auto it = tx_.begin(); it != tx_.end();) {
+    const bool started = it == tx_.begin() && it->headerSent > 0;
+    if (it->ubuf == ubuf && !started) {
+      it = tx_.erase(it);
+      removed++;
+    } else {
+      ++it;
+    }
+  }
+  return removed;
+}
+
+bool Pair::hasInflightSend(UnboundBuffer* ubuf) {
+  std::lock_guard<std::mutex> guard(mu_);
+  for (const auto& op : tx_) {
+    if (op.ubuf == ubuf) {
+      return true;
+    }
+  }
+  return false;
+}
+
+void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
+  if (fd_ < 0) {
+    return;
+  }
+  while (!tx_.empty()) {
+    TxOp& op = tx_.front();
+    iovec iov[2];
+    int iovcnt = 0;
+    if (op.headerSent < sizeof(WireHeader)) {
+      iov[iovcnt].iov_base =
+          reinterpret_cast<char*>(&op.header) + op.headerSent;
+      iov[iovcnt].iov_len = sizeof(WireHeader) - op.headerSent;
+      iovcnt++;
+    }
+    if (op.dataSent < op.nbytes) {
+      iov[iovcnt].iov_base = const_cast<char*>(op.data) + op.dataSent;
+      iov[iovcnt].iov_len = op.nbytes - op.dataSent;
+      iovcnt++;
+    }
+    ssize_t n = iovcnt > 0 ? writev(fd_, iov, iovcnt) : 0;
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      pendingTxError_ = errnoString("send");
+      return;
+    }
+    size_t adv = static_cast<size_t>(n);
+    size_t headerRemaining = sizeof(WireHeader) - op.headerSent;
+    size_t take = std::min(adv, headerRemaining);
+    op.headerSent += take;
+    adv -= take;
+    op.dataSent += adv;
+    if (op.headerSent == sizeof(WireHeader) && op.dataSent == op.nbytes) {
+      completed->push_back(op.ubuf);
+      tx_.pop_front();
+    }
+  }
+}
+
+void Pair::updateEpollMask() {
+  if (fd_ < 0 || state_.load() != State::kConnected) {
+    return;
+  }
+  uint32_t desired = EPOLLIN | (tx_.empty() ? 0u : uint32_t(EPOLLOUT));
+  if (desired != epollMask_) {
+    loop_->mod(fd_, desired, this);
+    epollMask_ = desired;
+  }
+}
+
+void Pair::handleEvents(uint32_t events) {
+  if (state_.load() != State::kConnected) {
+    return;
+  }
+  if (events & (EPOLLIN | EPOLLERR | EPOLLHUP)) {
+    readLoop();
+  }
+  if (state_.load() != State::kConnected) {
+    return;
+  }
+  if (events & EPOLLOUT) {
+    std::vector<UnboundBuffer*> completed;
+    std::string txError;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      flushTx(&completed);
+      if (state_.load() == State::kConnected) {
+        updateEpollMask();
+      }
+      txError = pendingTxError_;
+      pendingTxError_.clear();
+    }
+    cv_.notify_all();  // close() may be waiting for the tx queue to drain
+    for (auto* b : completed) {
+      if (b != nullptr) {
+        b->onSendComplete();
+      }
+    }
+    if (!txError.empty()) {
+      fail(txError);
+    }
+  }
+}
+
+void Pair::readLoop() {
+  while (state_.load() == State::kConnected) {
+    if (!rxInPayload_) {
+      char* hp = reinterpret_cast<char*>(&rxHeader_);
+      ssize_t n = read(fd_, hp + rxHeaderRead_,
+                       sizeof(WireHeader) - rxHeaderRead_);
+      if (n == 0) {
+        bool orderly;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          orderly = peerGoodbye_;
+        }
+        if (orderly) {
+          teardown(State::kClosed,
+                   detail::strCat("rank ", peerRank_, " left the group"),
+                   /*notifyContext=*/true);
+        } else {
+          fail(detail::strCat("connection to rank ", peerRank_,
+                              " closed by peer unexpectedly"));
+        }
+        return;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        fail(errnoString("recv"));
+        return;
+      }
+      rxHeaderRead_ += static_cast<size_t>(n);
+      if (rxHeaderRead_ < sizeof(WireHeader)) {
+        continue;
+      }
+      if (rxHeader_.magic != kMsgMagic) {
+        fail(detail::strCat("protocol violation from rank ", peerRank_));
+        return;
+      }
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGoodbye)) {
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          peerGoodbye_ = true;
+        }
+        cv_.notify_all();
+        rxHeaderRead_ = 0;
+        continue;
+      }
+      if (rxHeader_.opcode != static_cast<uint8_t>(Opcode::kData)) {
+        fail(detail::strCat("protocol violation from rank ", peerRank_));
+        return;
+      }
+      const size_t nbytes = rxHeader_.nbytes;
+      Context::Match match;
+      try {
+        match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
+      } catch (const std::exception& e) {
+        // e.g. posted-size mismatch: an application-level contract violation
+        // (inconsistent counts across ranks). Poison this pair instead of
+        // unwinding through the event loop.
+        fail(detail::strCat("receive matching failed: ", e.what()));
+        return;
+      }
+      if (nbytes == 0) {
+        if (match.direct) {
+          match.ubuf->onRecvComplete(peerRank_);
+        } else {
+          context_->stashArrived(peerRank_, rxHeader_.slot, {});
+        }
+        rxHeaderRead_ = 0;
+        continue;
+      }
+      rxInPayload_ = true;
+      rxPayloadRead_ = 0;
+      if (match.direct) {
+        rxIsStash_ = false;
+        rxDest_ = match.dest;
+        std::lock_guard<std::mutex> guard(mu_);
+        rxUbuf_ = match.ubuf;
+      } else {
+        rxIsStash_ = true;
+        rxStashData_.resize(nbytes);
+        rxDest_ = rxStashData_.data();
+      }
+    } else {
+      ssize_t n = read(fd_, rxDest_ + rxPayloadRead_,
+                       rxHeader_.nbytes - rxPayloadRead_);
+      if (n == 0) {
+        fail(detail::strCat("connection to rank ", peerRank_,
+                            " closed mid-message"));
+        return;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        fail(errnoString("recv"));
+        return;
+      }
+      rxPayloadRead_ += static_cast<size_t>(n);
+      if (rxPayloadRead_ == rxHeader_.nbytes) {
+        finishMessage();
+      }
+    }
+  }
+}
+
+void Pair::finishMessage() {
+  if (rxIsStash_) {
+    try {
+      context_->stashArrived(peerRank_, rxHeader_.slot,
+                             std::move(rxStashData_));
+    } catch (const std::exception& e) {
+      fail(detail::strCat("receive matching failed: ", e.what()));
+      return;
+    }
+    rxStashData_ = std::vector<char>();
+  } else {
+    UnboundBuffer* b = nullptr;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      b = rxUbuf_;
+      rxUbuf_ = nullptr;
+    }
+    if (b != nullptr) {
+      b->onRecvComplete(peerRank_);
+    }
+  }
+  rxInPayload_ = false;
+  rxHeaderRead_ = 0;
+  rxDest_ = nullptr;
+}
+
+void Pair::fail(const std::string& message) {
+  teardown(State::kFailed, message, /*notifyContext=*/true);
+}
+
+void Pair::close() {
+  // Graceful departure: flush queued sends, announce goodbye, half-close the
+  // write side, then keep reading until the peer's EOF. Draining prevents
+  // the kernel from sending an RST (which would flush the peer's receive
+  // queue and lose delivered-but-unread payloads) when ranks reach teardown
+  // at different times.
+  static constexpr std::chrono::milliseconds kGrace{2000};
+  std::vector<UnboundBuffer*> completed;
+  {
+    std::unique_lock<std::mutex> lock(mu_);
+    if (state_.load() == State::kConnected && !closing_) {
+      closing_ = true;
+      TxOp op;
+      op.header = WireHeader{kMsgMagic,
+                             static_cast<uint8_t>(Opcode::kGoodbye),
+                             {0, 0, 0}, 0, 0};
+      op.ubuf = nullptr;
+      op.data = nullptr;
+      op.nbytes = 0;
+      tx_.push_back(op);
+      flushTx(&completed);
+      updateEpollMask();
+      pendingTxError_.clear();
+      const auto deadline = std::chrono::steady_clock::now() + kGrace;
+      cv_.wait_until(lock, deadline, [&] {
+        return tx_.empty() || state_.load() != State::kConnected;
+      });
+      if (fd_ >= 0) {
+        ::shutdown(fd_, SHUT_WR);
+      }
+      cv_.wait_until(lock, deadline, [&] {
+        return peerGoodbye_ || state_.load() != State::kConnected;
+      });
+    }
+  }
+  for (auto* b : completed) {
+    if (b != nullptr) {
+      b->onSendComplete();
+    }
+  }
+  teardown(State::kClosed, "pair closed", /*notifyContext=*/false);
+}
+
+void Pair::teardown(State target, const std::string& message,
+                    bool notifyContext) {
+  std::vector<UnboundBuffer*> sends;
+  UnboundBuffer* rxb = nullptr;
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    State s = state_.load();
+    if (s == State::kFailed || s == State::kClosed) {
+      return;
+    }
+    state_.store(target);
+    error_ = message;
+    for (auto& op : tx_) {
+      sends.push_back(op.ubuf);
+    }
+    tx_.clear();
+    fd = fd_;
+    fd_ = -1;
+    rxb = rxUbuf_;
+    rxUbuf_ = nullptr;
+  }
+  cv_.notify_all();
+  if (expectedAt_ != nullptr) {
+    expectedAt_->unexpect(localPairId_);
+  }
+  if (fd >= 0) {
+    // del() barriers on the loop tick: after it returns no dispatch touches
+    // this fd or the rx destination memory, so failing the buffers below
+    // cannot race an in-flight read into user memory.
+    loop_->del(fd);
+    ::close(fd);
+  }
+  for (auto* b : sends) {
+    if (b != nullptr) {
+      b->onSendError(message);
+    }
+  }
+  if (rxb != nullptr) {
+    rxb->onRecvError(message);
+  }
+  if (notifyContext) {
+    context_->onPairError(peerRank_, message);
+  }
+}
+
+}  // namespace transport
+}  // namespace tpucoll

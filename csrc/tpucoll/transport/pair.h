@@ -1,0 +1,134 @@
+// Pair: one bidirectional point-to-point channel between this process and a
+// peer rank, multiplexing all slot-tagged messages over a single TCP stream.
+//
+// Contract parity with the reference pair state machine (gloo/transport/tcp/
+// pair.h:87-92, pair.cc) — connect/close lifecycle, async sends with inline
+// fast path, error fan-out to pending operations — but with the eager wire
+// protocol of wire.h instead of the notify/ready handshake, and with receive
+// matching delegated to transport::Context.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tpucoll/transport/address.h"
+#include "tpucoll/transport/loop.h"
+#include "tpucoll/transport/unbound_buffer.h"
+#include "tpucoll/transport/wire.h"
+
+namespace tpucoll {
+namespace transport {
+
+class Context;
+class Listener;
+
+class Pair : public Handler {
+ public:
+  enum class State : int {
+    kInitializing = 0,
+    kConnected = 2,
+    kFailed = 3,
+    kClosed = 4,
+  };
+
+  Pair(Context* context, Loop* loop, int selfRank, int peerRank,
+       uint64_t localPairId);
+  ~Pair() override;
+
+  uint64_t localPairId() const { return localPairId_; }
+  int peerRank() const { return peerRank_; }
+
+  // Initiator path (blocking, user thread): TCP connect to the peer's
+  // listener and write the hello routing this connection to `remotePairId`.
+  void connect(const SockAddr& remote, uint64_t remotePairId,
+               std::chrono::milliseconds timeout);
+
+  // Listener path: register interest in an inbound connection carrying our
+  // localPairId; the listener hands us the fd once the hello arrives.
+  void expectViaListener(Listener* listener);
+
+  void waitConnected(std::chrono::milliseconds timeout);
+
+  // Async send; data must stay valid until the matching waitSend completes.
+  void send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
+            size_t nbytes);
+
+  // Remove queued sends for `ubuf` that have not started hitting the wire;
+  // returns how many were dropped. A partially-written front op cannot be
+  // cancelled (removing it would corrupt the stream framing).
+  int cancelQueuedSends(UnboundBuffer* ubuf);
+  // True if any tx op (including a partially-written one) references ubuf.
+  bool hasInflightSend(UnboundBuffer* ubuf);
+
+  // Graceful close; pending operations fail. Idempotent, thread-safe.
+  void close();
+
+  // Hard-fail the pair from a user thread (see Context::
+  // failPairsWithInflightSend).
+  void failFromUser(const std::string& message) { fail(message); }
+
+  void handleEvents(uint32_t events) override;
+
+  // Called by the listener (loop thread) when our inbound connection is up.
+  void assumeConnected(int fd);
+
+ private:
+  struct TxOp {
+    WireHeader header;
+    size_t headerSent{0};
+    UnboundBuffer* ubuf;
+    const char* data;
+    size_t nbytes;
+    size_t dataSent{0};
+  };
+
+  // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
+  // ops' buffers are appended to `completed` (callbacks run without mu_).
+  void flushTx(std::vector<UnboundBuffer*>* completed);
+  void updateEpollMask();  // mu_ held
+  void readLoop();         // loop thread only
+  // Consume a fully received message (loop thread).
+  void finishMessage();
+  // Transition to kFailed, release resources, fan error out. Safe from any
+  // thread; idempotent.
+  void fail(const std::string& message);
+  void teardown(State target, const std::string& message, bool notifyContext);
+
+  Context* const context_;
+  Loop* const loop_;
+  const int selfRank_;
+  const int peerRank_;
+  const uint64_t localPairId_;
+
+  std::atomic<State> state_{State::kInitializing};
+  std::atomic<bool> everConnected_{false};
+  Listener* expectedAt_{nullptr};
+  bool closing_{false};      // goodbye enqueued (mu_)
+  bool peerGoodbye_{false};  // peer announced orderly departure (mu_)
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int fd_{-1};
+  uint32_t epollMask_{0};
+  std::deque<TxOp> tx_;
+  std::string error_;
+  std::string pendingTxError_;  // set by flushTx (mu_ held), drained by caller
+  UnboundBuffer* rxUbuf_{nullptr};  // guarded by mu_ (cross-thread on fail)
+
+  // rx state, loop thread only
+  WireHeader rxHeader_{};
+  size_t rxHeaderRead_{0};
+  bool rxInPayload_{false};
+  char* rxDest_{nullptr};
+  std::vector<char> rxStashData_;
+  bool rxIsStash_{false};
+  size_t rxPayloadRead_{0};
+};
+
+}  // namespace transport
+}  // namespace tpucoll

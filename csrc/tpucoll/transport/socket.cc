@@ -1,0 +1,38 @@
+#include "tpucoll/transport/socket.h"
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+void setNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL);
+  TC_ENFORCE_GE(flags, 0, "fcntl(F_GETFL): ", strerror(errno));
+  TC_ENFORCE_EQ(fcntl(fd, F_SETFL, flags | O_NONBLOCK), 0,
+                "fcntl(F_SETFL): ", strerror(errno));
+}
+
+void setNoDelay(int fd) {
+  int on = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &on, sizeof(on));
+}
+
+void setReuseAddr(int fd) {
+  int on = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &on, sizeof(on));
+}
+
+std::string errnoString(const char* what) {
+  return std::string(what) + ": " + strerror(errno);
+}
+
+}  // namespace transport
+}  // namespace tpucoll

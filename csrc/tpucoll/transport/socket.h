@@ -1,0 +1,15 @@
+// Small socket option helpers shared by pair/listener/device.
+#pragma once
+
+#include <string>
+
+namespace tpucoll {
+namespace transport {
+
+void setNonBlocking(int fd);
+void setNoDelay(int fd);
+void setReuseAddr(int fd);
+std::string errnoString(const char* what);
+
+}  // namespace transport
+}  // namespace tpucoll

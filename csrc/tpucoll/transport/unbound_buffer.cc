@@ -1,0 +1,192 @@
+#include "tpucoll/transport/unbound_buffer.h"
+
+#include "tpucoll/transport/context.h"
+
+namespace tpucoll {
+namespace transport {
+
+UnboundBuffer::UnboundBuffer(Context* context, void* ptr, size_t size)
+    : context_(context), ptr_(ptr), size_(size) {
+  TC_ENFORCE(ptr != nullptr || size == 0, "null buffer with nonzero size");
+}
+
+UnboundBuffer::~UnboundBuffer() {
+  // Cancel operations that have not touched the wire yet, then drain
+  // whatever is still in flight: the loop thread may hold raw pointers into
+  // our memory until each op completes or the owning pair fails.
+  context_->cancelRecvsFor(this);
+  context_->cancelSendsFor(this);
+  auto done = [&] { return pendingSends_ == 0 && pendingRecvs_ == 0; };
+  {
+    std::unique_lock<std::mutex> lock(mu_);
+    if (cv_.wait_for(lock, std::chrono::seconds(5), done)) {
+      return;
+    }
+  }
+  // A partially-written send to a stalled peer is the only way to get here;
+  // poison those pairs (clears their tx queues and errors us) rather than
+  // blocking destruction forever.
+  context_->failPairsWithInflightSend(this);
+  std::unique_lock<std::mutex> lock(mu_);
+  cv_.wait(lock, done);
+}
+
+void UnboundBuffer::send(int dstRank, uint64_t slot, size_t offset,
+                         size_t nbytes) {
+  if (nbytes == SIZE_MAX) {
+    TC_ENFORCE_LE(offset, size_);
+    nbytes = size_ - offset;
+  }
+  TC_ENFORCE_LE(offset + nbytes, size_, "send out of bounds");
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortSend_ = false;
+  }
+  context_->postSend(this, dstRank, slot,
+                     static_cast<char*>(ptr_) + offset, nbytes);
+}
+
+void UnboundBuffer::recv(int srcRank, uint64_t slot, size_t offset,
+                         size_t nbytes) {
+  recv(std::vector<int>{srcRank}, slot, offset, nbytes);
+}
+
+void UnboundBuffer::recv(const std::vector<int>& srcRanks, uint64_t slot,
+                         size_t offset, size_t nbytes) {
+  if (nbytes == SIZE_MAX) {
+    TC_ENFORCE_LE(offset, size_);
+    nbytes = size_ - offset;
+  }
+  TC_ENFORCE_LE(offset + nbytes, size_, "recv out of bounds");
+  TC_ENFORCE_GT(srcRanks.size(), size_t(0), "empty source rank list");
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortRecv_ = false;
+  }
+  context_->postRecv(this, srcRanks, slot,
+                     static_cast<char*>(ptr_) + offset, nbytes);
+}
+
+bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto pred = [&] { return completedSends_ > 0 || abortSend_ || failed_; };
+  if (!cv_.wait_for(lock, timeout, pred)) {
+    TC_THROW(TimeoutException, "waitSend timed out after ", timeout.count(),
+             "ms");
+  }
+  if (failed_ && completedSends_ == 0) {
+    TC_THROW(IoException, error_);
+  }
+  if (abortSend_ && completedSends_ == 0) {
+    return false;
+  }
+  TC_ENFORCE_GT(completedSends_, 0);
+  completedSends_--;
+  return true;
+}
+
+bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto pred = [&] {
+    return !completedRecvs_.empty() || abortRecv_ || failed_;
+  };
+  if (!cv_.wait_for(lock, timeout, pred)) {
+    TC_THROW(TimeoutException, "waitRecv timed out after ", timeout.count(),
+             "ms");
+  }
+  if (failed_ && completedRecvs_.empty()) {
+    TC_THROW(IoException, error_);
+  }
+  if (abortRecv_ && completedRecvs_.empty()) {
+    return false;
+  }
+  TC_ENFORCE(!completedRecvs_.empty());
+  if (srcRank != nullptr) {
+    *srcRank = completedRecvs_.front();
+  }
+  completedRecvs_.pop_front();
+  return true;
+}
+
+void UnboundBuffer::abortWaitSend() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortSend_ = true;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::abortWaitRecv() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortRecv_ = true;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::onSendComplete() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingSends_--;
+    completedSends_++;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::onRecvComplete(int srcRank) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingRecvs_--;
+    completedRecvs_.push_back(srcRank);
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::onSendError(const std::string& message) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingSends_--;
+    failed_ = true;
+    error_ = message;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::onRecvError(const std::string& message) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingRecvs_--;
+    failed_ = true;
+    error_ = message;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::addPendingSend() {
+  std::lock_guard<std::mutex> guard(mu_);
+  pendingSends_++;
+}
+
+void UnboundBuffer::addPendingRecv() {
+  std::lock_guard<std::mutex> guard(mu_);
+  pendingRecvs_++;
+}
+
+void UnboundBuffer::cancelPendingSend() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingSends_--;
+    cv_.notify_all();
+  }
+}
+
+void UnboundBuffer::cancelPendingRecv() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    pendingRecvs_--;
+    cv_.notify_all();
+  }
+}
+
+}  // namespace transport
+}  // namespace tpucoll

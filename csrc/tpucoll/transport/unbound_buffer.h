@@ -1,0 +1,92 @@
+// UnboundBuffer: a registered memory region from which tagged sends are
+// issued and into which tagged receives land. Supports recv-from-any,
+// per-operation timeouts, and abortable waits.
+//
+// Contract parity with the reference's transport::UnboundBuffer
+// (gloo/transport/unbound_buffer.h:36-153): send/recv are async; waitSend/
+// waitRecv are the only blocking points; waits return false when aborted;
+// transport failures surface as IoException; destruction drains in-flight
+// operations so the region can never be written after free.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstddef>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+class Context;
+
+class UnboundBuffer {
+ public:
+  UnboundBuffer(Context* context, void* ptr, size_t size);
+  ~UnboundBuffer();
+
+  UnboundBuffer(const UnboundBuffer&) = delete;
+  UnboundBuffer& operator=(const UnboundBuffer&) = delete;
+
+  void* ptr() const { return ptr_; }
+  size_t size() const { return size_; }
+
+  // Async send of [offset, offset+nbytes) to dstRank under `slot`.
+  // nbytes == SIZE_MAX means "rest of the buffer".
+  void send(int dstRank, uint64_t slot, size_t offset = 0,
+            size_t nbytes = SIZE_MAX);
+
+  // Async recv into [offset, offset+nbytes) from srcRank under `slot`.
+  void recv(int srcRank, uint64_t slot, size_t offset = 0,
+            size_t nbytes = SIZE_MAX);
+
+  // Recv-from-any: first matching arrival from any rank in srcRanks wins.
+  void recv(const std::vector<int>& srcRanks, uint64_t slot,
+            size_t offset = 0, size_t nbytes = SIZE_MAX);
+
+  // Wait for one send to complete. Returns false if aborted. Throws
+  // TimeoutException past the deadline, IoException on transport failure.
+  bool waitSend(std::chrono::milliseconds timeout);
+  // Wait for one recv to complete; *srcRank (if non-null) receives the
+  // source. Same failure contract as waitSend.
+  bool waitRecv(int* srcRank, std::chrono::milliseconds timeout);
+
+  // Unblock current and future waiters (they return false) until the abort
+  // flag is cleared by the next send/recv post.
+  void abortWaitSend();
+  void abortWaitRecv();
+
+  // --- completion callbacks (Context / Pair internals) ---
+  void onSendComplete();
+  void onRecvComplete(int srcRank);
+  // Error paths decrement the matching pending count so destruction can
+  // always account for every operation exactly once.
+  void onSendError(const std::string& message);
+  void onRecvError(const std::string& message);
+  void addPendingSend();
+  void addPendingRecv();
+  void cancelPendingSend();
+  void cancelPendingRecv();
+
+ private:
+  Context* const context_;
+  void* const ptr_;
+  const size_t size_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int pendingSends_{0};
+  int pendingRecvs_{0};
+  int completedSends_{0};
+  std::deque<int> completedRecvs_;
+  bool abortSend_{false};
+  bool abortRecv_{false};
+  std::string error_;
+  bool failed_{false};
+};
+
+}  // namespace transport
+}  // namespace tpucoll

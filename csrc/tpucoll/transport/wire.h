@@ -1,0 +1,55 @@
+// Wire protocol for the tpucoll host (DCN/TCP) data plane.
+//
+// Original "eager + stash" design: a message is a fixed header followed
+// immediately by its payload. The receiver matches the (source, slot) against
+// posted receives and either lands the payload directly in user memory or
+// stashes it until a matching receive is posted. This replaces the
+// reference's four-opcode notify/ready handshake (gloo/transport/tcp/
+// pair.h:53-83) with a single-opcode protocol: one fewer round trip per
+// message, at the cost of bounded receiver-side staging for early arrivals —
+// the right trade for collective schedules that keep only a few segments in
+// flight.
+#pragma once
+
+#include <cstdint>
+
+namespace tpucoll {
+namespace transport {
+
+constexpr uint32_t kMsgMagic = 0x7C011001;
+constexpr uint32_t kHelloMagic = 0x7C011002;
+
+enum class Opcode : uint8_t {
+  kData = 1,
+  // Announces an orderly departure. Sent by close() before the write side is
+  // shut down; a peer that sees EOF *without* a preceding goodbye knows the
+  // remote died unexpectedly (fast failure detection), while EOF after
+  // goodbye is a clean group teardown. The goodbye/half-close/drain dance
+  // also guarantees no in-flight payload is lost to a TCP reset when ranks
+  // finish a collective at different times.
+  kGoodbye = 2,
+};
+
+#pragma pack(push, 1)
+struct WireHeader {
+  uint32_t magic;
+  uint8_t opcode;
+  uint8_t reserved[3];
+  uint64_t slot;
+  uint64_t nbytes;
+};
+
+// First bytes an initiator writes after TCP connect: routes the fresh
+// connection to the listener-side Pair expecting it.
+struct WireHello {
+  uint32_t magic;
+  uint32_t reserved;
+  uint64_t pairId;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(WireHeader) == 24, "wire header must be packed");
+static_assert(sizeof(WireHello) == 16, "wire hello must be packed");
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,104 @@
+// tpucoll core types: element dtypes, reduction ops, and the slot scheme.
+//
+// The slot scheme mirrors the reference's contract (gloo/types.h:40-91): a
+// 64-bit message tag that namespaces concurrent collectives so their
+// point-to-point traffic cannot cross-match. Layout here (original design):
+//   [63:56] collective prefix (8 bits)
+//   [55:24] user tag          (32 bits)
+//   [23:0]  op delta          (24 bits) — per-schedule message counter
+// The wider 24-bit delta (reference uses 8) lets heavily pipelined schedules
+// allocate one sub-slot per in-flight segment without wraparound.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+enum class DataType : uint8_t {
+  kInt8 = 0,
+  kUint8 = 1,
+  kInt32 = 2,
+  kUint32 = 3,
+  kInt64 = 4,
+  kUint64 = 5,
+  kFloat16 = 6,
+  kBFloat16 = 7,
+  kFloat32 = 8,
+  kFloat64 = 9,
+};
+
+inline size_t elementSize(DataType dt) {
+  switch (dt) {
+    case DataType::kInt8:
+    case DataType::kUint8:
+      return 1;
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kUint32:
+    case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64:
+    case DataType::kUint64:
+    case DataType::kFloat64:
+      return 8;
+  }
+  TC_THROW(EnforceError, "unknown dtype ", static_cast<int>(dt));
+}
+
+enum class ReduceOp : uint8_t {
+  kSum = 0,
+  kProduct = 1,
+  kMin = 2,
+  kMax = 3,
+};
+
+// Per-collective slot prefixes. Every collective entry point builds its base
+// slot from (prefix, user tag); concurrent collectives on one context must
+// use distinct user tags, matching the reference semantics (gloo/types.h:67-74).
+enum class SlotPrefix : uint8_t {
+  kUser = 0,  // raw send/recv issued directly by the application
+  kBarrier = 1,
+  kBroadcast = 2,
+  kAllreduce = 3,
+  kReduce = 4,
+  kGather = 5,
+  kScatter = 6,
+  kAllgather = 7,
+  kAlltoall = 8,
+  kReduceScatter = 9,
+};
+
+class Slot {
+ public:
+  static constexpr int kPrefixBits = 8;
+  static constexpr int kTagBits = 32;
+  static constexpr int kDeltaBits = 24;
+
+  static Slot build(SlotPrefix prefix, uint32_t tag) {
+    uint64_t v = (static_cast<uint64_t>(prefix) << (kTagBits + kDeltaBits)) |
+                 (static_cast<uint64_t>(tag) << kDeltaBits);
+    return Slot(v);
+  }
+
+  // Derive a sub-slot for the i-th message of a schedule; bounds-checked so
+  // overflow into the tag field is impossible.
+  Slot offset(uint64_t delta) const {
+    TC_ENFORCE_LT(delta, (uint64_t(1) << kDeltaBits), "slot delta overflow");
+    TC_ENFORCE_EQ(value_ & ((uint64_t(1) << kDeltaBits) - 1), uint64_t(0),
+                  "offset() must be called on a base slot");
+    return Slot(value_ | delta);
+  }
+
+  uint64_t value() const { return value_; }
+  explicit Slot(uint64_t v) : value_(v) {}
+
+ private:
+  uint64_t value_;
+};
+
+}  // namespace tpucoll

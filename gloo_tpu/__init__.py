@@ -1,0 +1,47 @@
+"""gloo_tpu: a TPU-native collective communications framework.
+
+Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
+(/root/reference/gloo, see SURVEY.md):
+
+- **Host plane** (`gloo_tpu.core`, C++ core in `csrc/`): store-based
+  rendezvous into a full-mesh process group, slot-tagged async send/recv over
+  an epoll TCP transport, and the full collective suite (barrier, broadcast,
+  allreduce, reduce, gather(v), scatter, allgather(v), alltoall(v),
+  reduce_scatter) with timeouts and abortable waits.
+- **Device plane** (`gloo_tpu.tpu`): the same collective surface over jax
+  arrays sharded across a `jax.sharding.Mesh` — XLA collectives compiled over
+  ICI, plus Pallas ring kernels for custom schedules.
+"""
+
+from gloo_tpu.core import (
+    Aborted,
+    Context,
+    Device,
+    Error,
+    FileStore,
+    HashStore,
+    IoError,
+    PrefixStore,
+    ReduceOp,
+    Store,
+    TimeoutError,
+    UnboundBuffer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Aborted",
+    "Context",
+    "Device",
+    "Error",
+    "FileStore",
+    "HashStore",
+    "IoError",
+    "PrefixStore",
+    "ReduceOp",
+    "Store",
+    "TimeoutError",
+    "UnboundBuffer",
+    "__version__",
+]

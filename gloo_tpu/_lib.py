@@ -1,0 +1,148 @@
+"""ctypes binding to the tpucoll C core (csrc/tpucoll/capi.cc).
+
+The native library is the host data plane of gloo_tpu: rendezvous stores, the
+epoll TCP transport, and the collective schedules, all in C++ (matching the
+reference's C++ core, /root/reference/gloo). This module only declares
+prototypes and maps error codes onto Python exceptions.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpucoll.so")
+
+
+class Error(RuntimeError):
+    """Base error from the tpucoll native core."""
+
+
+class IoError(Error):
+    """Transport failure: peer died, connection reset, context poisoned."""
+
+
+class TimeoutError(IoError):  # noqa: A001 - mirrors the C++ hierarchy
+    """A blocking wait exceeded its deadline."""
+
+
+class Aborted(Exception):
+    """A wait was cancelled via abort_wait_send/abort_wait_recv."""
+
+
+_TC_OK = 0
+_TC_ERR = 1
+_TC_ERR_TIMEOUT = 2
+_TC_ERR_IO = 3
+_TC_ERR_ABORTED = 4
+
+
+def _build_native() -> None:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "native"], cwd=repo_root, check=True,
+                   capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build_native()
+    return ctypes.CDLL(_LIB_PATH)
+
+
+_lib = _load()
+
+_c = ctypes.c_void_p
+_sz = ctypes.c_size_t
+_i64 = ctypes.c_int64
+_u64 = ctypes.c_uint64
+_u32 = ctypes.c_uint32
+_int = ctypes.c_int
+
+_PROTOTYPES = {
+    "tc_last_error": (ctypes.c_char_p, []),
+    # stores
+    "tc_hash_store_new": (_c, []),
+    "tc_file_store_new": (_c, [ctypes.c_char_p]),
+    "tc_prefix_store_new": (_c, [_c, ctypes.c_char_p]),
+    "tc_store_free": (None, [_c]),
+    "tc_store_set": (_int, [_c, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_uint8), _sz]),
+    "tc_store_get": (_int, [_c, ctypes.c_char_p, _i64,
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                            ctypes.POINTER(_sz)]),
+    "tc_buf_free": (None, [ctypes.POINTER(ctypes.c_uint8)]),
+    "tc_store_add": (_int, [_c, ctypes.c_char_p, _i64,
+                            ctypes.POINTER(_i64)]),
+    # device / context
+    "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16]),
+    "tc_device_free": (None, [_c]),
+    "tc_context_new": (_c, [_int, _int]),
+    "tc_context_set_timeout": (None, [_c, _i64]),
+    "tc_context_connect": (_int, [_c, _c, _c]),
+    "tc_context_close": (_int, [_c]),
+    "tc_context_free": (None, [_c]),
+    "tc_next_slot": (_u64, [_c, _u32]),
+    # collectives
+    "tc_barrier": (_int, [_c, _u32, _i64]),
+    "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_reduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32, _i64]),
+    "tc_gather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_gatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _int,
+                          _u32, _i64]),
+    "tc_scatter": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_allgather": (_int, [_c, _c, _c, _sz, _int, _u32, _i64]),
+    "tc_allgatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _u32,
+                             _i64]),
+    "tc_alltoall": (_int, [_c, _c, _c, _sz, _int, _u32, _i64]),
+    "tc_alltoallv": (_int, [_c, _c, ctypes.POINTER(_sz), _c,
+                            ctypes.POINTER(_sz), _int, _u32, _i64]),
+    "tc_reduce_scatter": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
+                                 _int, _u32, _i64]),
+    # p2p
+    "tc_buffer_new": (_c, [_c, _c, _sz]),
+    "tc_buffer_free": (None, [_c]),
+    "tc_buffer_send": (_int, [_c, _int, _u64, _sz, _sz]),
+    "tc_buffer_recv": (_int, [_c, _int, _u64, _sz, _sz]),
+    "tc_buffer_recv_any": (_int, [_c, ctypes.POINTER(_int), _sz, _u64, _sz,
+                                  _sz]),
+    "tc_buffer_wait_send": (_int, [_c, _i64]),
+    "tc_buffer_wait_recv": (_int, [_c, _i64, ctypes.POINTER(_int)]),
+    "tc_buffer_abort_wait_send": (None, [_c]),
+    "tc_buffer_abort_wait_recv": (None, [_c]),
+}
+
+for _name, (_restype, _argtypes) in _PROTOTYPES.items():
+    _fn = getattr(_lib, _name)
+    _fn.restype = _restype
+    _fn.argtypes = _argtypes
+
+
+def last_error() -> str:
+    msg = _lib.tc_last_error()
+    return msg.decode("utf-8", "replace") if msg else ""
+
+
+def check(code: int) -> None:
+    """Raise the Python mapping of a TC_ERR_* code."""
+    if code == _TC_OK:
+        return
+    msg = last_error()
+    if code == _TC_ERR_TIMEOUT:
+        raise TimeoutError(msg)
+    if code == _TC_ERR_IO:
+        raise IoError(msg)
+    if code == _TC_ERR_ABORTED:
+        raise Aborted(msg)
+    raise Error(msg)
+
+
+def check_handle(handle: int | None) -> int:
+    if not handle:
+        raise Error(last_error())
+    return handle
+
+
+lib = _lib

@@ -1,0 +1,448 @@
+"""Host data plane: stores, devices, contexts, collectives over numpy arrays.
+
+This is the user-facing Python surface of the native core — the gloo_tpu
+equivalent of the reference's C++ public API (context + rendezvous +
+collectives), with numpy arrays standing in for raw pointers. The TPU device
+plane (jax arrays over an ICI mesh) lives in gloo_tpu.tpu.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from gloo_tpu import _lib
+from gloo_tpu._lib import Aborted, Error, IoError, TimeoutError, check, check_handle
+
+__all__ = [
+    "Aborted",
+    "Context",
+    "Device",
+    "Error",
+    "FileStore",
+    "HashStore",
+    "IoError",
+    "PrefixStore",
+    "ReduceOp",
+    "Store",
+    "TimeoutError",
+    "UnboundBuffer",
+]
+
+_DTYPE_CODES = {
+    "int8": 0,
+    "uint8": 1,
+    "int32": 2,
+    "uint32": 3,
+    "int64": 4,
+    "uint64": 5,
+    "float16": 6,
+    "bfloat16": 7,
+    "float32": 8,
+    "float64": 9,
+}
+
+
+class ReduceOp:
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+
+    _BY_NAME = {"sum": SUM, "product": PRODUCT, "prod": PRODUCT, "min": MIN,
+                "max": MAX}
+
+    @classmethod
+    def parse(cls, op) -> int:
+        if isinstance(op, str):
+            return cls._BY_NAME[op.lower()]
+        return int(op)
+
+
+def _dtype_code(arr: np.ndarray) -> int:
+    name = arr.dtype.name
+    if name not in _DTYPE_CODES:
+        raise Error(f"unsupported dtype: {name}")
+    return _DTYPE_CODES[name]
+
+
+def _check_array(arr: np.ndarray, name: str = "array") -> np.ndarray:
+    if not isinstance(arr, np.ndarray):
+        raise TypeError(f"{name} must be a numpy array, got {type(arr)}")
+    if not arr.flags.c_contiguous:
+        raise Error(f"{name} must be C-contiguous")
+    return arr
+
+
+def _ptr(arr: np.ndarray):
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def _counts_arg(counts: Sequence[int]):
+    return (ctypes.c_size_t * len(counts))(*counts)
+
+
+def _timeout_ms(timeout: Optional[float]) -> int:
+    # 0 tells the native side to use the context default.
+    return 0 if timeout is None else max(1, int(timeout * 1000))
+
+
+class Store:
+    """Base rendezvous store handle."""
+
+    def __init__(self, handle: int):
+        self._handle = handle
+        # Bound at construction: module globals may already be cleared when
+        # __del__ runs during interpreter shutdown.
+        self._free = _lib.lib.tc_store_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+
+    def set(self, key: str, value: bytes) -> None:
+        data = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
+            if value else (ctypes.c_uint8 * 0)()
+        check(_lib.lib.tc_store_set(self._handle, key.encode(), data,
+                                    len(value)))
+
+    def get(self, key: str, timeout: float = 30.0) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        check(_lib.lib.tc_store_get(self._handle, key.encode(),
+                                    int(timeout * 1000),
+                                    ctypes.byref(out),
+                                    ctypes.byref(out_len)))
+        try:
+            return bytes(bytearray(out[: out_len.value]))
+        finally:
+            _lib.lib.tc_buf_free(out)
+
+    def add(self, key: str, delta: int) -> int:
+        result = ctypes.c_int64()
+        check(_lib.lib.tc_store_add(self._handle, key.encode(), delta,
+                                    ctypes.byref(result)))
+        return result.value
+
+
+class HashStore(Store):
+    """In-process store for multi-rank-in-one-process tests."""
+
+    def __init__(self):
+        super().__init__(check_handle(_lib.lib.tc_hash_store_new()))
+
+
+class FileStore(Store):
+    """Store over a shared filesystem directory."""
+
+    def __init__(self, path: str):
+        super().__init__(
+            check_handle(_lib.lib.tc_file_store_new(path.encode())))
+
+
+class PrefixStore(Store):
+    """Namespacing decorator over another store."""
+
+    def __init__(self, base: Store, prefix: str):
+        super().__init__(
+            check_handle(_lib.lib.tc_prefix_store_new(base._handle,
+                                                      prefix.encode())))
+        self._base = base  # keep the base handle alive
+
+
+class Device:
+    """Transport endpoint: epoll loop thread + shared listener."""
+
+    def __init__(self, hostname: str = "127.0.0.1", port: int = 0):
+        self._handle = check_handle(
+            _lib.lib.tc_device_new(hostname.encode(), port))
+        self._free = _lib.lib.tc_device_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+
+
+class UnboundBuffer:
+    """Registered region for tagged point-to-point send/recv."""
+
+    def __init__(self, context: "Context", array: np.ndarray):
+        _check_array(array)
+        self._array = array  # pin the memory
+        self._context = context
+        self._handle = check_handle(
+            _lib.lib.tc_buffer_new(context._handle, _ptr(array),
+                                   array.nbytes))
+        self._free = _lib.lib.tc_buffer_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+
+    def send(self, dst: int, slot: int, offset: int = 0,
+             nbytes: Optional[int] = None) -> None:
+        if nbytes is None:
+            nbytes = self._array.nbytes - offset
+        check(_lib.lib.tc_buffer_send(self._handle, dst, slot, offset,
+                                      nbytes))
+
+    def recv(self, src, slot: int, offset: int = 0,
+             nbytes: Optional[int] = None) -> None:
+        if nbytes is None:
+            nbytes = self._array.nbytes - offset
+        if isinstance(src, int):
+            check(_lib.lib.tc_buffer_recv(self._handle, src, slot, offset,
+                                          nbytes))
+        else:
+            srcs = (ctypes.c_int * len(src))(*src)
+            check(_lib.lib.tc_buffer_recv_any(self._handle, srcs, len(src),
+                                              slot, offset, nbytes))
+
+    def wait_send(self, timeout: Optional[float] = None) -> bool:
+        code = _lib.lib.tc_buffer_wait_send(
+            self._handle, self._context._resolve_timeout_ms(timeout))
+        if code == _lib._TC_ERR_ABORTED:
+            return False
+        check(code)
+        return True
+
+    def wait_recv(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Returns the source rank, or None if the wait was aborted."""
+        src = ctypes.c_int(-1)
+        code = _lib.lib.tc_buffer_wait_recv(
+            self._handle, self._context._resolve_timeout_ms(timeout),
+            ctypes.byref(src))
+        if code == _lib._TC_ERR_ABORTED:
+            return None
+        check(code)
+        return src.value
+
+    def abort_wait_send(self) -> None:
+        _lib.lib.tc_buffer_abort_wait_send(self._handle)
+
+    def abort_wait_recv(self) -> None:
+        _lib.lib.tc_buffer_abort_wait_recv(self._handle)
+
+
+class Context:
+    """A connected process group: collectives + point-to-point messaging.
+
+    One Context per (process, group). All collective calls are blocking and
+    must be entered by every rank with matching arguments; concurrent
+    collectives on one context need distinct tags.
+    """
+
+    def __init__(self, rank: int, size: int, timeout: float = 30.0):
+        self.rank = rank
+        self.size = size
+        self._timeout = timeout
+        self._handle = check_handle(_lib.lib.tc_context_new(rank, size))
+        _lib.lib.tc_context_set_timeout(self._handle, int(timeout * 1000))
+        self._store = None
+        self._device = None
+        self._free = _lib.lib.tc_context_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+
+    def _resolve_timeout_ms(self, timeout: Optional[float]) -> int:
+        return _timeout_ms(self._timeout if timeout is None else timeout)
+
+    def connect_full_mesh(self, store: Store, device: Device) -> None:
+        check(_lib.lib.tc_context_connect(self._handle, store._handle,
+                                          device._handle))
+        self._store = store
+        self._device = device
+
+    def close(self) -> None:
+        check(_lib.lib.tc_context_close(self._handle))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def next_slot(self, num: int = 1) -> int:
+        return _lib.lib.tc_next_slot(self._handle, num)
+
+    def register(self, array: np.ndarray) -> UnboundBuffer:
+        return UnboundBuffer(self, array)
+
+    # ---- collectives ----
+
+    def barrier(self, tag: int = 0, timeout: Optional[float] = None) -> None:
+        check(_lib.lib.tc_barrier(self._handle, tag, _timeout_ms(timeout)))
+
+    def broadcast(self, array: np.ndarray, root: int = 0, tag: int = 0,
+                  timeout: Optional[float] = None) -> np.ndarray:
+        _check_array(array)
+        check(_lib.lib.tc_broadcast(self._handle, _ptr(array), array.size,
+                                    _dtype_code(array), root, tag,
+                                    _timeout_ms(timeout)))
+        return array
+
+    def allreduce(self, array: np.ndarray, op="sum", tag: int = 0,
+                  timeout: Optional[float] = None) -> np.ndarray:
+        """In-place allreduce of `array` across the group."""
+        _check_array(array)
+        check(_lib.lib.tc_allreduce(self._handle, _ptr(array), _ptr(array),
+                                    array.size, _dtype_code(array),
+                                    ReduceOp.parse(op), tag,
+                                    _timeout_ms(timeout)))
+        return array
+
+    def reduce(self, array: np.ndarray, root: int = 0, op="sum",
+               output: Optional[np.ndarray] = None, tag: int = 0,
+               timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        """Reduce to `root`. Returns the result array on root, else None."""
+        _check_array(array)
+        if self.rank == root:
+            out = output if output is not None else np.empty_like(array)
+            _check_array(out, "output")
+        else:
+            out = None
+        check(_lib.lib.tc_reduce(self._handle, _ptr(array),
+                                 _ptr(out) if out is not None else None,
+                                 array.size, _dtype_code(array),
+                                 ReduceOp.parse(op), root, tag,
+                                 _timeout_ms(timeout)))
+        return out
+
+    def gather(self, array: np.ndarray, root: int = 0, tag: int = 0,
+               timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        """Gather equal-size arrays to root; returns (size, *shape) on root."""
+        _check_array(array)
+        if self.rank == root:
+            out = np.empty((self.size,) + array.shape, dtype=array.dtype)
+        else:
+            out = None
+        check(_lib.lib.tc_gather(self._handle, _ptr(array),
+                                 _ptr(out) if out is not None else None,
+                                 array.size, _dtype_code(array), root, tag,
+                                 _timeout_ms(timeout)))
+        return out
+
+    def gatherv(self, array: np.ndarray, counts: Sequence[int],
+                root: int = 0, tag: int = 0,
+                timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        _check_array(array)
+        assert array.size == counts[self.rank], "input size != counts[rank]"
+        if self.rank == root:
+            out = np.empty(int(sum(counts)), dtype=array.dtype)
+        else:
+            out = None
+        check(_lib.lib.tc_gatherv(self._handle, _ptr(array),
+                                  _ptr(out) if out is not None else None,
+                                  _counts_arg(counts), _dtype_code(array),
+                                  root, tag, _timeout_ms(timeout)))
+        return out
+
+    def scatter(self, array: Optional[np.ndarray], root: int = 0,
+                output: Optional[np.ndarray] = None, tag: int = 0,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Scatter rows of `array` (on root, shape (size, ...)) to all ranks."""
+        if self.rank == root:
+            _check_array(array)
+            assert array.shape[0] == self.size, "scatter input rows != size"
+            chunk_shape = array.shape[1:]
+            chunk = np.empty(chunk_shape, dtype=array.dtype) \
+                if output is None else output
+        else:
+            assert output is not None, "non-root scatter needs output array"
+            chunk = output
+        _check_array(chunk, "output")
+        check(_lib.lib.tc_scatter(
+            self._handle, _ptr(array) if array is not None else None,
+            _ptr(chunk), chunk.size, _dtype_code(chunk), root, tag,
+            _timeout_ms(timeout)))
+        return chunk
+
+    def allgather(self, array: np.ndarray, tag: int = 0,
+                  timeout: Optional[float] = None) -> np.ndarray:
+        _check_array(array)
+        out = np.empty((self.size,) + array.shape, dtype=array.dtype)
+        check(_lib.lib.tc_allgather(self._handle, _ptr(array), _ptr(out),
+                                    array.size, _dtype_code(array), tag,
+                                    _timeout_ms(timeout)))
+        return out
+
+    def allgatherv(self, array: np.ndarray, counts: Sequence[int],
+                   tag: int = 0,
+                   timeout: Optional[float] = None) -> np.ndarray:
+        _check_array(array)
+        assert array.size == counts[self.rank], "input size != counts[rank]"
+        out = np.empty(int(sum(counts)), dtype=array.dtype)
+        check(_lib.lib.tc_allgatherv(self._handle, _ptr(array), _ptr(out),
+                                     _counts_arg(counts),
+                                     _dtype_code(array), tag,
+                                     _timeout_ms(timeout)))
+        return out
+
+    def alltoall(self, array: np.ndarray, tag: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """First axis of `array` must equal group size; returns same shape."""
+        _check_array(array)
+        assert array.shape[0] == self.size, "alltoall input rows != size"
+        out = np.empty_like(array)
+        check(_lib.lib.tc_alltoall(self._handle, _ptr(array), _ptr(out),
+                                   array.size // self.size,
+                                   _dtype_code(array), tag,
+                                   _timeout_ms(timeout)))
+        return out
+
+    def alltoallv(self, array: np.ndarray, in_counts: Sequence[int],
+                  out_counts: Sequence[int], tag: int = 0,
+                  timeout: Optional[float] = None) -> np.ndarray:
+        _check_array(array)
+        assert array.size == sum(in_counts), "input size != sum(in_counts)"
+        out = np.empty(int(sum(out_counts)), dtype=array.dtype)
+        check(_lib.lib.tc_alltoallv(self._handle, _ptr(array),
+                                    _counts_arg(in_counts), _ptr(out),
+                                    _counts_arg(out_counts),
+                                    _dtype_code(array), tag,
+                                    _timeout_ms(timeout)))
+        return out
+
+    def reduce_scatter(self, array: np.ndarray,
+                       recv_counts: Optional[Sequence[int]] = None,
+                       op="sum", tag: int = 0,
+                       timeout: Optional[float] = None) -> np.ndarray:
+        _check_array(array)
+        if recv_counts is None:
+            assert array.size % self.size == 0, \
+                "array size not divisible by group size"
+            recv_counts = [array.size // self.size] * self.size
+        assert sum(recv_counts) == array.size, "sum(recv_counts) != size"
+        out = np.empty(int(recv_counts[self.rank]), dtype=array.dtype)
+        check(_lib.lib.tc_reduce_scatter(self._handle, _ptr(array),
+                                         _ptr(out),
+                                         _counts_arg(recv_counts),
+                                         _dtype_code(array),
+                                         ReduceOp.parse(op), tag,
+                                         _timeout_ms(timeout)))
+        return out
+
+    # ---- blocking p2p conveniences ----
+
+    def send(self, array: np.ndarray, dst: int, slot: int,
+             timeout: Optional[float] = None) -> None:
+        buf = self.register(array)
+        buf.send(dst, slot)
+        buf.wait_send(timeout)
+
+    def recv(self, array: np.ndarray, src, slot: int,
+             timeout: Optional[float] = None) -> int:
+        buf = self.register(array)
+        buf.recv(src, slot)
+        rank = buf.wait_recv(timeout)
+        assert rank is not None
+        return rank

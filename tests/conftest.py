@@ -1,0 +1,21 @@
+import os
+import subprocess
+import sys
+
+# Device-plane tests run on a virtual 8-device CPU mesh; set this before jax
+# is imported anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def pytest_configure(config):
+    # Build (or rebuild) the native core once per session.
+    subprocess.run(["make", "native"], cwd=_REPO_ROOT, check=True,
+                   capture_output=True)

@@ -1,0 +1,56 @@
+"""Multi-rank test harness.
+
+Mode 1 of the reference's test strategy (gloo/test/base_test.h:89-179): spawn
+`size` threads in one process, each with its own Device + Context, all
+rendezvousing over a shared in-process HashStore through loopback TCP.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+import gloo_tpu
+
+
+def spawn(size: int, fn: Callable, timeout: float = 30.0,
+          context_timeout: float = 15.0) -> List:
+    """Run fn(ctx, rank) on `size` threads; returns per-rank results.
+
+    The first exception raised by any rank is re-raised in the caller after
+    all threads have been joined.
+    """
+    store = gloo_tpu.HashStore()
+    results = [None] * size
+    errors = []
+    lock = threading.Lock()
+
+    def worker(rank: int) -> None:
+        ctx = None
+        try:
+            device = gloo_tpu.Device()
+            ctx = gloo_tpu.Context(rank, size, timeout=context_timeout)
+            ctx.connect_full_mesh(store, device)
+            results[rank] = fn(ctx, rank)
+        except BaseException as exc:  # noqa: BLE001 - surfaced to caller
+            with lock:
+                errors.append((rank, exc))
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"rank thread did not finish in {timeout}s")
+    if errors:
+        rank, exc = errors[0]
+        raise AssertionError(f"rank {rank} failed: {exc!r}") from exc
+    return results

@@ -1,0 +1,339 @@
+"""Collective correctness over (ranks x payload sizes x dtypes), with
+rank-and-index-determined fixtures and closed-form expected values
+(reference analog: gloo/test/allreduce_test.cc etc., base_test.h fixtures)."""
+
+import numpy as np
+import pytest
+
+from tests.harness import spawn
+
+SIZES = [1, 2, 3, 4, 8]
+COUNTS = [1, 7, 100, 10_000]
+
+
+def fixture(rank, count, dtype):
+    """Deterministic per-rank pattern with exact closed-form reductions."""
+    idx = np.arange(count, dtype=np.float64)
+    vals = (rank + 1) + (idx % 5)
+    return vals.astype(dtype)
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("count", COUNTS)
+def test_allreduce_sum(size, count):
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float32)
+        ctx.allreduce(x)
+        return x
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype,rtol", [
+    (np.int32, 0), (np.int64, 0), (np.uint8, 0),
+    (np.float64, 1e-12), (np.float16, 1e-2),
+])
+def test_allreduce_dtypes(dtype, rtol):
+    size, count = 4, 523
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, dtype)
+        ctx.allreduce(x)
+        return x
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    for got in results:
+        if rtol == 0:
+            np.testing.assert_array_equal(got.astype(np.float64), expected)
+        else:
+            np.testing.assert_allclose(got.astype(np.float64), expected,
+                                       rtol=rtol)
+
+
+def test_allreduce_bfloat16():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    size, count = 2, 256
+
+    def fn(ctx, rank):
+        x = np.full(count, rank + 1, dtype=ml_dtypes.bfloat16)
+        ctx.allreduce(x)
+        return x.astype(np.float32)
+
+    results = spawn(size, fn)
+    for got in results:
+        np.testing.assert_array_equal(got, np.full(count, 3.0, np.float32))
+
+
+@pytest.mark.parametrize("op,reducer", [
+    ("min", np.minimum), ("max", np.maximum), ("product", np.multiply),
+])
+def test_allreduce_ops(op, reducer):
+    size, count = 3, 97
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float32)
+        ctx.allreduce(x, op=op)
+        return x
+
+    results = spawn(size, fn)
+    expected = fixture(0, count, np.float32)
+    for r in range(1, size):
+        expected = reducer(expected, fixture(r, count, np.float32))
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_broadcast(size):
+    count = 1000
+
+    def fn(ctx, rank):
+        root = size // 2
+        if rank == root:
+            x = fixture(root, count, np.float32)
+        else:
+            x = np.zeros(count, dtype=np.float32)
+        ctx.broadcast(x, root=root)
+        return x
+
+    results = spawn(size, fn)
+    expected = fixture(size // 2, count, np.float32)
+    for got in results:
+        np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_reduce(size):
+    count = 1234
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float64)
+        out = ctx.reduce(x, root=0)
+        return out
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    np.testing.assert_allclose(results[0], expected, rtol=1e-12)
+    assert all(r is None for r in results[1:])
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_gather(size):
+    def fn(ctx, rank):
+        x = fixture(rank, 17, np.float32)
+        return ctx.gather(x, root=0)
+
+    results = spawn(size, fn)
+    for r in range(size):
+        np.testing.assert_array_equal(results[0][r],
+                                      fixture(r, 17, np.float32))
+
+
+def test_gatherv():
+    size = 4
+    counts = [3, 0, 5, 2]
+
+    def fn(ctx, rank):
+        x = np.full(counts[rank], float(rank), dtype=np.float32)
+        return ctx.gatherv(x, counts, root=1)
+
+    results = spawn(size, fn)
+    expected = np.concatenate(
+        [np.full(counts[r], float(r), np.float32) for r in range(size)])
+    np.testing.assert_array_equal(results[1], expected)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_scatter(size):
+    def fn(ctx, rank):
+        root = 0
+        if rank == root:
+            data = np.stack([fixture(r, 21, np.float32)
+                             for r in range(size)])
+            return ctx.scatter(data, root=root)
+        return ctx.scatter(None, root=root,
+                           output=np.zeros(21, dtype=np.float32))
+
+    results = spawn(size, fn)
+    for r in range(size):
+        np.testing.assert_array_equal(results[r],
+                                      fixture(r, 21, np.float32))
+
+
+@pytest.mark.parametrize("size", SIZES)
+@pytest.mark.parametrize("count", [1, 64, 5000])
+def test_allgather(size, count):
+    def fn(ctx, rank):
+        return ctx.allgather(fixture(rank, count, np.float32))
+
+    results = spawn(size, fn)
+    expected = np.stack([fixture(r, count, np.float32)
+                         for r in range(size)])
+    for got in results:
+        np.testing.assert_array_equal(got, expected)
+
+
+def test_allgatherv():
+    size = 4
+    counts = [2, 5, 0, 3]
+
+    def fn(ctx, rank):
+        x = np.full(counts[rank], float(rank + 1), dtype=np.float64)
+        return ctx.allgatherv(x, counts)
+
+    results = spawn(size, fn)
+    expected = np.concatenate(
+        [np.full(counts[r], float(r + 1), np.float64) for r in range(size)])
+    for got in results:
+        np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_alltoall(size):
+    count = 13
+
+    def fn(ctx, rank):
+        # Row j carries "rank -> j" markers.
+        x = np.stack([np.full(count, rank * 100 + j, dtype=np.int32)
+                      for j in range(size)])
+        return ctx.alltoall(x)
+
+    results = spawn(size, fn)
+    for r, got in enumerate(results):
+        for j in range(size):
+            np.testing.assert_array_equal(
+                got[j], np.full(count, j * 100 + r, dtype=np.int32))
+
+
+def test_alltoallv():
+    size = 3
+    # in_counts[i][j]: rank i sends that many elements to rank j.
+    in_counts = [[1, 2, 3], [4, 0, 1], [2, 2, 2]]
+
+    def fn(ctx, rank):
+        my_in = in_counts[rank]
+        out_counts = [in_counts[j][rank] for j in range(size)]
+        x = np.concatenate(
+            [np.full(my_in[j], rank * 10 + j, dtype=np.int64)
+             for j in range(size)])
+        return ctx.alltoallv(x, my_in, out_counts)
+
+    results = spawn(size, fn)
+    for r, got in enumerate(results):
+        expected = np.concatenate(
+            [np.full(in_counts[j][r], j * 10 + r, dtype=np.int64)
+             for j in range(size)])
+        np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_reduce_scatter(size):
+    count_per_rank = 9
+
+    def fn(ctx, rank):
+        x = fixture(rank, count_per_rank * size, np.float32)
+        return ctx.reduce_scatter(x)
+
+    results = spawn(size, fn)
+    full = sum(fixture(r, count_per_rank * size, np.float64)
+               for r in range(size))
+    for r in range(size):
+        np.testing.assert_allclose(
+            results[r].astype(np.float64),
+            full[r * count_per_rank:(r + 1) * count_per_rank], rtol=1e-6)
+
+
+def test_reduce_scatter_uneven():
+    size = 3
+    recv_counts = [4, 0, 7]
+    total = sum(recv_counts)
+
+    def fn(ctx, rank):
+        x = fixture(rank, total, np.float32)
+        return ctx.reduce_scatter(x, recv_counts=recv_counts)
+
+    results = spawn(size, fn)
+    full = sum(fixture(r, total, np.float64) for r in range(size))
+    offset = 0
+    for r in range(size):
+        np.testing.assert_allclose(
+            results[r].astype(np.float64),
+            full[offset:offset + recv_counts[r]], rtol=1e-6)
+        offset += recv_counts[r]
+
+
+@pytest.mark.parametrize("size", SIZES)
+def test_barrier(size):
+    import time
+
+    def fn(ctx, rank):
+        # Stagger arrival; everyone must leave after the last arrival.
+        time.sleep(0.02 * rank)
+        t_before = time.monotonic()
+        ctx.barrier()
+        return t_before, time.monotonic()
+
+    results = spawn(size, fn)
+    last_arrival = max(t0 for t0, _ in results)
+    for _, t_exit in results:
+        assert t_exit >= last_arrival - 0.005
+
+
+def test_concurrent_collectives_distinct_tags():
+    """Two allreduces interleaved on one context must not cross-match."""
+    size = 4
+
+    def fn(ctx, rank):
+        import threading
+        a = np.full(1000, float(rank), dtype=np.float32)
+        b = np.full(1000, float(rank * 2), dtype=np.float32)
+        t = threading.Thread(target=lambda: ctx.allreduce(b, tag=2))
+        t.start()
+        ctx.allreduce(a, tag=1)
+        t.join()
+        return float(a[0]), float(b[0])
+
+    results = spawn(size, fn)
+    sa = sum(range(size))
+    sb = sum(2 * r for r in range(size))
+    for a0, b0 in results:
+        assert (a0, b0) == (sa, sb)
+
+
+def test_multiple_contexts_same_device():
+    """Independent groups over one shared store namespace must isolate."""
+    import gloo_tpu
+
+    base = gloo_tpu.HashStore()
+    import threading
+    size = 3
+    results = [None] * (2 * size)
+    errors = []
+
+    def worker(group, rank):
+        try:
+            dev = gloo_tpu.Device()
+            store = gloo_tpu.PrefixStore(base, f"group{group}")
+            ctx = gloo_tpu.Context(rank, size, timeout=15)
+            ctx.connect_full_mesh(store, dev)
+            x = np.full(10, float(rank + group * 10), dtype=np.float32)
+            ctx.allreduce(x)
+            results[group * size + rank] = float(x[0])
+            ctx.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append((group, rank, exc))
+
+    threads = [threading.Thread(target=worker, args=(g, r))
+               for g in range(2) for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    assert results[:size] == [sum(range(size))] * size
+    expected_g1 = sum(r + 10 for r in range(size))
+    assert results[size:] == [expected_g1] * size

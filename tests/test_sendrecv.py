@@ -1,0 +1,172 @@
+"""Point-to-point semantics: tagged send/recv, offsets, recv-from-any,
+zero-byte messages, self-send, abortable waits (reference analog:
+gloo/test/send_recv_test.cc:26-512)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.harness import spawn
+
+
+def test_pairwise_send_recv():
+    """Every rank sends its rank value to every other rank."""
+    size = 4
+
+    def fn(ctx, rank):
+        out = {}
+        bufs = []
+        recv_arrays = {}
+        for peer in range(size):
+            if peer == rank:
+                continue
+            send_arr = np.array([rank], dtype=np.int64)
+            recv_arr = np.empty(1, dtype=np.int64)
+            recv_arrays[peer] = recv_arr
+            sbuf = ctx.register(send_arr)
+            rbuf = ctx.register(recv_arr)
+            sbuf.send(peer, slot=rank * size + peer)
+            rbuf.recv(peer, slot=peer * size + rank)
+            bufs.append((sbuf, rbuf, send_arr))
+        for sbuf, rbuf, _ in bufs:
+            assert sbuf.wait_send() is True
+            assert rbuf.wait_recv() is not None
+        for peer, arr in recv_arrays.items():
+            out[peer] = int(arr[0])
+        return out
+
+    results = spawn(size, fn)
+    for rank, got in enumerate(results):
+        assert got == {p: p for p in range(size) if p != rank}
+
+
+def test_send_recv_offsets():
+    def fn(ctx, rank):
+        if rank == 0:
+            arr = np.arange(10, dtype=np.float32)
+            buf = ctx.register(arr)
+            # Send elements [4, 6) only.
+            buf.send(1, slot=7, offset=16, nbytes=8)
+            buf.wait_send()
+            return None
+        arr = np.zeros(4, dtype=np.float32)
+        buf = ctx.register(arr)
+        # Land them at elements [1, 3).
+        buf.recv(0, slot=7, offset=4, nbytes=8)
+        buf.wait_recv()
+        return arr.tolist()
+
+    results = spawn(2, fn)
+    assert results[1] == [0.0, 4.0, 5.0, 0.0]
+
+
+def test_zero_byte_then_nonempty():
+    """Empty messages are real messages: ordering and matching still hold."""
+
+    def fn(ctx, rank):
+        if rank == 0:
+            empty = np.empty(0, dtype=np.uint8)
+            data = np.array([123], dtype=np.uint8)
+            b1 = ctx.register(empty)
+            b2 = ctx.register(data)
+            b1.send(1, slot=1)
+            b2.send(1, slot=2)
+            b1.wait_send()
+            b2.wait_send()
+            return None
+        empty = np.empty(0, dtype=np.uint8)
+        data = np.zeros(1, dtype=np.uint8)
+        b1 = ctx.register(empty)
+        b2 = ctx.register(data)
+        b1.recv(0, slot=1)
+        b2.recv(0, slot=2)
+        assert b1.wait_recv() == 0
+        assert b2.wait_recv() == 0
+        return int(data[0])
+
+    assert spawn(2, fn)[1] == 123
+
+
+def test_recv_from_any():
+    """Rank 0 posts wildcard receives and must see every sender exactly once."""
+    size = 4
+
+    def fn(ctx, rank):
+        if rank == 0:
+            seen = []
+            arr = np.zeros(1, dtype=np.int32)
+            buf = ctx.register(arr)
+            for _ in range(size - 1):
+                buf.recv(list(range(1, size)), slot=5)
+                src = buf.wait_recv()
+                assert arr[0] == src * 10
+                seen.append(src)
+            return sorted(seen)
+        arr = np.array([rank * 10], dtype=np.int32)
+        buf = ctx.register(arr)
+        buf.send(0, slot=5)
+        buf.wait_send()
+        return None
+
+    assert spawn(size, fn)[0] == [1, 2, 3]
+
+
+def test_self_send():
+    def fn(ctx, rank):
+        send = np.array([7.5], dtype=np.float64)
+        recv = np.zeros(1, dtype=np.float64)
+        sbuf = ctx.register(send)
+        rbuf = ctx.register(recv)
+        sbuf.send(rank, slot=3)
+        rbuf.recv(rank, slot=3)
+        sbuf.wait_send()
+        assert rbuf.wait_recv() == rank
+        return float(recv[0])
+
+    assert spawn(2, fn) == [7.5, 7.5]
+
+
+def test_abort_wait_recv():
+    def fn(ctx, rank):
+        if rank == 1:
+            # Stay alive until rank 0 has finished its abort sequence.
+            ctx.barrier(tag=42)
+            return None
+        arr = np.zeros(1, dtype=np.float32)
+        buf = ctx.register(arr)
+        buf.recv(1, slot=9)
+        import threading
+
+        threading.Timer(0.2, buf.abort_wait_recv).start()
+        t0 = time.monotonic()
+        result = buf.wait_recv(timeout=10.0)
+        assert result is None  # aborted
+        assert time.monotonic() - t0 < 5.0
+        del buf  # cancels the still-posted recv
+        ctx.barrier(tag=42)
+        return "aborted"
+
+    assert spawn(2, fn)[0] == "aborted"
+
+
+def test_wait_recv_timeout():
+    def fn(ctx, rank):
+        if rank == 1:
+            ctx.barrier(tag=99)
+            return None
+        arr = np.zeros(1, dtype=np.float32)
+        buf = ctx.register(arr)
+        buf.recv(1, slot=11)
+        with pytest.raises(gloo_tpu_timeout()):
+            buf.wait_recv(timeout=0.3)
+        ctx.barrier(tag=99)
+        return "timed-out"
+
+    assert spawn(2, fn)[0] == "timed-out"
+
+
+def gloo_tpu_timeout():
+    import gloo_tpu
+
+    return gloo_tpu.TimeoutError

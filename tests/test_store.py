@@ -1,0 +1,69 @@
+"""Rendezvous store tests (reference analog: store usage in
+gloo/rendezvous/* and gloo/test/ store paths)."""
+
+import threading
+
+import pytest
+
+import gloo_tpu
+
+
+def _exercise_store(store):
+    store.set("alpha", b"1")
+    store.set("beta", b"\x00\xffbin")
+    assert store.get("alpha") == b"1"
+    assert store.get("beta") == b"\x00\xffbin"
+    # Overwrite
+    store.set("alpha", b"2")
+    assert store.get("alpha") == b"2"
+    # Empty value is valid
+    store.set("empty", b"")
+    assert store.get("empty") == b""
+    # Atomic counter
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 41) == 42
+
+
+def test_hash_store():
+    _exercise_store(gloo_tpu.HashStore())
+
+
+def test_file_store(tmp_path):
+    _exercise_store(gloo_tpu.FileStore(str(tmp_path)))
+
+
+def test_file_store_cross_instance(tmp_path):
+    a = gloo_tpu.FileStore(str(tmp_path))
+    b = gloo_tpu.FileStore(str(tmp_path))
+    a.set("key", b"value")
+    assert b.get("key") == b"value"
+
+
+def test_prefix_store_namespacing():
+    base = gloo_tpu.HashStore()
+    p1 = gloo_tpu.PrefixStore(base, "ctx1")
+    p2 = gloo_tpu.PrefixStore(base, "ctx2")
+    p1.set("k", b"one")
+    p2.set("k", b"two")
+    assert p1.get("k") == b"one"
+    assert p2.get("k") == b"two"
+
+
+def test_get_timeout():
+    store = gloo_tpu.HashStore()
+    with pytest.raises(gloo_tpu.TimeoutError):
+        store.get("missing", timeout=0.1)
+
+
+def test_get_blocks_until_set():
+    store = gloo_tpu.HashStore()
+    result = {}
+
+    def reader():
+        result["value"] = store.get("later", timeout=5.0)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    store.set("later", b"done")
+    t.join(5.0)
+    assert result["value"] == b"done"
