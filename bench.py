@@ -1,0 +1,120 @@
+"""Headline benchmark: allreduce algorithm bandwidth, host plane.
+
+Config #1 from BASELINE.md: allreduce, float32, 64 MiB payload, 2 ranks,
+TCP transport on localhost — the reference's own benchmark methodology
+(p50 of timed iterations after warmup, verified first iteration).
+
+vs_baseline compares against pytorch/gloo's `benchmark --transport tcp
+allreduce_ring_chunked` at the same config: measured live when the
+reference build exists at build-ref/ (run `cmake -S /root/reference -B
+build-ref -G Ninja -DBUILD_BENCHMARK=ON -DUSE_REDIS=OFF && cmake --build
+build-ref`), otherwise against the value recorded on this host
+(0.620 GB/s, see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+ELEMENTS = 16 * 1024 * 1024  # 64 MiB float32
+WARMUP = 3
+ITERS = 15
+RECORDED_REFERENCE_GBPS = 0.620
+
+
+def bench_ours():
+    import numpy as np
+
+    import gloo_tpu
+
+    store = gloo_tpu.HashStore()
+    samples = [None, None]
+
+    def worker(rank):
+        device = gloo_tpu.Device()
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(store, device)
+        x = np.full(ELEMENTS, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x)
+        assert x[0] == 3.0, "allreduce verification failed"
+        x[:] = 1.0
+        for _ in range(WARMUP):
+            ctx.allreduce(x)
+        times = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            ctx.allreduce(x)
+            times.append(time.perf_counter() - t0)
+        samples[rank] = times
+        ctx.barrier()
+        ctx.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    import numpy as np
+
+    p50 = float(np.median(samples[0]))
+    p99 = float(np.percentile(samples[0], 99))
+    algbw = ELEMENTS * 4 / p50 / 1e9
+    print(f"[bench] ours: p50 {p50 * 1e6:.0f}us p99 {p99 * 1e6:.0f}us "
+          f"algbw {algbw:.3f} GB/s", file=sys.stderr)
+    return algbw
+
+
+def bench_reference():
+    """Run the reference gloo benchmark at the identical config, if built."""
+    binary = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "build-ref", "gloo", "benchmark", "benchmark")
+    if not os.path.exists(binary):
+        return None
+    store = tempfile.mkdtemp()
+    procs = []
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [binary, "--size", "2", "--rank", str(rank),
+             "--shared-path", store, "--transport", "tcp",
+             "--elements", str(ELEMENTS), "--iteration-time", "2s",
+             "--no-verify", "allreduce_ring_chunked"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for out in outs:
+        m = re.search(r"^\s*\d+\s+\d+\s+\d+\s+(\d+)\s+\d+\s+\d+\s+"
+                      r"([\d.]+)\s+\d+\s*$", out, re.M)
+        if m:
+            gbps = float(m.group(2))
+            p50_us = int(m.group(1))
+            print(f"[bench] reference gloo: p50 {p50_us}us algbw "
+                  f"{gbps:.3f} GB/s", file=sys.stderr)
+            return gbps
+    return None
+
+
+def main():
+    ours = bench_ours()
+    ref = bench_reference()
+    if ref is None:
+        ref = RECORDED_REFERENCE_GBPS
+        print(f"[bench] reference build absent; using recorded baseline "
+              f"{ref} GB/s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "allreduce_algbw_2rank_64MiB_tcp",
+        "value": round(ours, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(ours / ref, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

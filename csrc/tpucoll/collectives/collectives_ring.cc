@@ -55,6 +55,38 @@ Blocks countBlocks(const std::vector<size_t>& counts, size_t elsize) {
 // block (r + 1 + startShift) mod P fully reduced. startShift=0 feeds the
 // allreduce allgather phase; startShift=-1 makes rank r own block r for the
 // standalone reduce_scatter.
+//
+// Pipelining (the reference's key allreduce optimization, maxSegmentSize +
+// two-in-flight at gloo/allreduce.cc:196-218, re-derived for the eager
+// transport): block transfers are split into segments of at most
+// kMaxSegmentBytes; receives are pre-posted TWO steps ahead into
+// double-buffered staging so arriving payloads always land directly in
+// their destination (never the stash), and each segment is reduced the
+// moment it arrives, overlapping the VPU/AVX reduction with socket I/O of
+// later segments.
+constexpr size_t kMaxSegmentBytes = 4 << 20;
+
+struct SegSpan {
+  size_t offset;  // within the block
+  size_t nbytes;
+};
+
+std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
+  // Segment boundaries must fall on element boundaries for the reducer.
+  size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);
+  std::vector<SegSpan> segs;
+  size_t off = 0;
+  while (off < blockBytes) {
+    size_t n = std::min(segBytes, blockBytes - off);
+    segs.push_back(SegSpan{off, n});
+    off += n;
+  }
+  if (segs.empty()) {
+    segs.push_back(SegSpan{0, 0});  // zero-byte block still needs a message
+  }
+  return segs;
+}
+
 void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
                        ReduceFn fn, size_t elsize, Slot slot,
                        uint64_t slotBase, int startShift,
@@ -66,30 +98,144 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   for (size_t b : blocks.bytes) {
     maxBlock = std::max(maxBlock, b);
   }
-  std::vector<char> tmp(maxBlock);
-  auto tmpBuf = ctx->createUnboundBuffer(tmp.data(), tmp.size());
+  const size_t maxSegs = segmentize(maxBlock, elsize).size();
+  // Pooled staging: keeps pages warm across calls so the receive path never
+  // stalls on first-touch faults.
+  auto scratch = ctx->acquireScratch(2 * std::max(maxBlock, size_t(1)));
+  char* tmp = scratch.data();
+  auto tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
   const int right = (rank + 1) % size;
   const int left = (rank - 1 + size) % size;
-  for (int step = 0; step < size - 1; step++) {
-    const int sendBlock = (rank + startShift - step + 2 * size) % size;
-    const int recvBlock = (rank + startShift - step - 1 + 2 * size) % size;
-    const uint64_t s = slot.offset(slotBase + step).value();
-    workBuf->send(right, s, blocks.offset[sendBlock],
-                  blocks.bytes[sendBlock]);
-    tmpBuf->recv(left, s, 0, blocks.bytes[recvBlock]);
-    tmpBuf->waitRecv(nullptr, timeout);
-    if (blocks.bytes[recvBlock] > 0) {
-      fn(work + blocks.offset[recvBlock], tmp.data(),
-         blocks.bytes[recvBlock] / elsize);
+  const int steps = size - 1;
+
+  auto sendBlockAt = [&](int step) {
+    return (rank + startShift - step + 2 * size) % size;
+  };
+  auto recvBlockAt = [&](int step) {
+    return (rank + startShift - step - 1 + 2 * size) % size;
+  };
+  auto segSlot = [&](int step, size_t seg) {
+    return slot.offset(slotBase + uint64_t(step) * maxSegs + seg).value();
+  };
+
+  // Post all segment receives of `step` into staging half (step % 2).
+  auto postRecvsFor = [&](int step) {
+    const size_t base = (step % 2) * maxBlock;
+    auto segs = segmentize(blocks.bytes[recvBlockAt(step)], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      tmpBuf->recv(left, segSlot(step, k), base + segs[k].offset,
+                   segs[k].nbytes);
     }
-    workBuf->waitSend(timeout);
+  };
+  auto postSendsFor = [&](int step) {
+    const size_t blockOff = blocks.offset[sendBlockAt(step)];
+    auto segs = segmentize(blocks.bytes[sendBlockAt(step)], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      workBuf->send(right, segSlot(step, k), blockOff + segs[k].offset,
+                    segs[k].nbytes);
+    }
+  };
+
+  postRecvsFor(0);
+  if (steps > 1) {
+    postRecvsFor(1);
+  }
+  postSendsFor(0);
+
+  for (int step = 0; step < steps; step++) {
+    const int recvBlock = recvBlockAt(step);
+    const size_t base = (step % 2) * maxBlock;
+    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      tmpBuf->waitRecv(nullptr, timeout);
+      // Segments on one pair complete in wire order, so segment k of this
+      // step is the k-th completion.
+      if (segs[k].nbytes > 0) {
+        fn(work + blocks.offset[recvBlock] + segs[k].offset,
+           tmp + base + segs[k].offset, segs[k].nbytes / elsize);
+      }
+    }
+    // Drain this step's sends — counted from the SEND block's segment list,
+    // which can differ from the recv block's when block sizes straddle a
+    // segment boundary (e.g. evenBlocks remainders).
+    const size_t sendSegCount =
+        segmentize(blocks.bytes[sendBlockAt(step)], elsize).size();
+    for (size_t k = 0; k < sendSegCount; k++) {
+      workBuf->waitSend(timeout);
+    }
+    if (step + 2 < steps) {
+      postRecvsFor(step + 2);  // staging half (step % 2) is free again
+    }
+    if (step + 1 < steps) {
+      postSendsFor(step + 1);  // its block finished reducing just now
+    }
+  }
+}
+
+// Ring allgather phase over an in-place buffer: at step s, send block
+// (rank + shift - s), receive block (rank + shift - s - 1) directly into
+// place. All receives are pre-posted (each step writes a distinct block),
+// the own/seed block is sent first, and every received segment is forwarded
+// to the right neighbor the moment it arrives. shift=0 gathers each rank's
+// own block (plain allgather); shift=+1 rides behind a reduce-scatter that
+// left rank r owning reduced block r+1 (the allreduce second phase).
+void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
+                        const Blocks& blocks, size_t elsize, Slot slot,
+                        uint64_t slotBase, size_t maxSegs, int shift,
+                        std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  const int steps = size - 1;
+  auto blockAt = [&](int step) {
+    return (rank + shift - step + 2 * size) % size;
+  };
+  auto segSlot = [&](int step, size_t seg) {
+    return slot.offset(slotBase + uint64_t(step) * maxSegs + seg).value();
+  };
+  for (int step = 0; step < steps; step++) {
+    const int recvBlock = blockAt(step + 1);  // == sendBlock(step) - 1
+    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      buf->recv(left, segSlot(step, k),
+                blocks.offset[recvBlock] + segs[k].offset, segs[k].nbytes);
+    }
+  }
+  int pendingSends = 0;
+  {
+    const int sb = blockAt(0);
+    auto segs = segmentize(blocks.bytes[sb], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      buf->send(right, segSlot(0, k), blocks.offset[sb] + segs[k].offset,
+                segs[k].nbytes);
+      pendingSends++;
+    }
+  }
+  for (int step = 0; step < steps; step++) {
+    const int recvBlock = blockAt(step + 1);
+    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    for (size_t k = 0; k < segs.size(); k++) {
+      buf->waitRecv(nullptr, timeout);
+      if (step + 1 < steps) {
+        // This segment is exactly segment k of the next step's send block.
+        buf->send(right, segSlot(step + 1, k),
+                  blocks.offset[recvBlock] + segs[k].offset,
+                  segs[k].nbytes);
+        pendingSends++;
+      }
+    }
+  }
+  while (pendingSends-- > 0) {
+    buf->waitSend(timeout);
   }
 }
 
 }  // namespace
 
 // Ring allgather: block b travels P-1 hops; receives land in place in the
-// output (reference schedule shape: gloo/allgather.cc:55-98).
+// output (reference schedule shape: gloo/allgather.cc:55-98, with the
+// pre-post + segment-forward pipeline of ringAllgatherPhase).
 void allgatherv(AllgathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "allgatherv: null context");
@@ -109,19 +255,15 @@ void allgatherv(AllgathervOptions& opts) {
     return;
   }
 
+  size_t maxBlock = 0;
+  for (size_t b : blocks.bytes) {
+    maxBlock = std::max(maxBlock, b);
+  }
   Slot slot = Slot::build(SlotPrefix::kAllgather, opts.tag);
   auto out = ctx->createUnboundBuffer(opts.output, total);
-  const int right = (rank + 1) % size;
-  const int left = (rank - 1 + size) % size;
-  for (int step = 0; step < size - 1; step++) {
-    const int sendBlock = (rank - step + 2 * size) % size;
-    const int recvBlock = (rank - step - 1 + 2 * size) % size;
-    const uint64_t s = slot.offset(step).value();
-    out->send(right, s, blocks.offset[sendBlock], blocks.bytes[sendBlock]);
-    out->recv(left, s, blocks.offset[recvBlock], blocks.bytes[recvBlock]);
-    out->waitRecv(nullptr, timeout);
-    out->waitSend(timeout);
-  }
+  ringAllgatherPhase(ctx, out.get(), blocks, elsize, slot, 0,
+                     segmentize(maxBlock, elsize).size(), /*shift=*/0,
+                     timeout);
 }
 
 void allgather(AllgatherOptions& opts) {
@@ -143,7 +285,6 @@ void allreduce(AllreduceOptions& opts) {
   TC_ENFORCE(!opts.inputs.empty() && !opts.outputs.empty(),
              "allreduce: need at least one input and output");
   const auto timeout = detail::effectiveTimeout(opts);
-  const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
@@ -159,26 +300,32 @@ void allreduce(AllreduceOptions& opts) {
   }
 
   if (size > 1 && opts.count > 0) {
+    const auto t0 = std::chrono::steady_clock::now();
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
     Blocks blocks = evenBlocks(opts.count, size, elsize);
+    size_t maxBlock = 0;
+    for (size_t b : blocks.bytes) {
+      maxBlock = std::max(maxBlock, b);
+    }
+    const size_t maxSegs = segmentize(maxBlock, elsize).size();
     auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+    const auto t1 = std::chrono::steady_clock::now();
     ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
                       workBuf.get());
+    const auto t2 = std::chrono::steady_clock::now();
+
     // Allgather phase: rank r starts owning reduced block (r+1); the block
     // then rides the ring into place on every rank.
-    const int right = (rank + 1) % size;
-    const int left = (rank - 1 + size) % size;
-    for (int step = 0; step < size - 1; step++) {
-      const int sendBlock = (rank + 1 - step + 2 * size) % size;
-      const int recvBlock = (rank - step + 2 * size) % size;
-      const uint64_t s = slot.offset(size + step).value();
-      workBuf->send(right, s, blocks.offset[sendBlock],
-                    blocks.bytes[sendBlock]);
-      workBuf->recv(left, s, blocks.offset[recvBlock],
-                    blocks.bytes[recvBlock]);
-      workBuf->waitRecv(nullptr, timeout);
-      workBuf->waitSend(timeout);
-    }
+    ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
+                       /*slotBase=*/uint64_t(size) * maxSegs, maxSegs,
+                       /*shift=*/1, timeout);
+    const auto t3 = std::chrono::steady_clock::now();
+    auto us = [](auto a, auto b) {
+      return std::chrono::duration_cast<std::chrono::microseconds>(b - a)
+          .count();
+    };
+    TC_DEBUG("allreduce rank ", ctx->rank(), ": setup ", us(t0, t1),
+             "us rs ", us(t1, t2), "us ag ", us(t2, t3), "us");
   }
 
   for (size_t i = 1; i < opts.outputs.size(); i++) {
@@ -263,15 +410,15 @@ void reduceScatter(ReduceScatterOptions& opts) {
     return;
   }
 
-  // Work in a scratch copy so the caller's input stays intact.
-  std::vector<char> work(total);
-  std::memcpy(work.data(), opts.input, total);
+  // Work in a (pooled) scratch copy so the caller's input stays intact.
+  auto scratch = ctx->acquireScratch(total);
+  char* work = scratch.data();
+  std::memcpy(work, opts.input, total);
   Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
-  auto workBuf = ctx->createUnboundBuffer(work.data(), total);
-  ringReduceScatter(ctx, work.data(), blocks, fn, elsize, slot, 0,
+  auto workBuf = ctx->createUnboundBuffer(work, total);
+  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
                     /*startShift=*/-1, timeout, workBuf.get());
-  std::memcpy(opts.output, work.data() + blocks.offset[rank],
-              blocks.bytes[rank]);
+  std::memcpy(opts.output, work + blocks.offset[rank], blocks.bytes[rank]);
 }
 
 }  // namespace tpucoll

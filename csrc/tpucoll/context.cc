@@ -40,4 +40,27 @@ void Context::close() {
   }
 }
 
+Context::Scratch Context::acquireScratch(size_t minBytes) {
+  {
+    std::lock_guard<std::mutex> guard(scratchMu_);
+    for (auto it = scratchPool_.begin(); it != scratchPool_.end(); ++it) {
+      if (it->size() >= minBytes) {
+        std::vector<char> buf = std::move(*it);
+        scratchPool_.erase(it);
+        return Scratch(this, std::move(buf));
+      }
+    }
+  }
+  return Scratch(this, std::vector<char>(minBytes));
+}
+
+Context::Scratch::~Scratch() {
+  if (ctx_ != nullptr && !buf_.empty()) {
+    std::lock_guard<std::mutex> guard(ctx_->scratchMu_);
+    if (ctx_->scratchPool_.size() < 4) {
+      ctx_->scratchPool_.push_back(std::move(buf_));
+    }
+  }
+}
+
 }  // namespace tpucoll

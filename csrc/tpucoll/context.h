@@ -7,6 +7,8 @@
 #include <atomic>
 #include <chrono>
 #include <memory>
+#include <mutex>
+#include <vector>
 
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/transport/context.h"
@@ -39,6 +41,26 @@ class Context {
   std::unique_ptr<transport::UnboundBuffer> createUnboundBuffer(void* ptr,
                                                                size_t size);
 
+  // Reusable staging memory for collective schedules. Fresh allocations pay
+  // 4KiB-page first-touch faults inside the receive path (the kernel zeroes
+  // pages under read()), which dominates large-payload rings; the pool keeps
+  // pages warm across calls. Thread-safe; concurrent collectives each get
+  // their own buffer.
+  class Scratch {
+   public:
+    Scratch(Context* ctx, std::vector<char> buf)
+        : ctx_(ctx), buf_(std::move(buf)) {}
+    ~Scratch();
+    char* data() { return buf_.data(); }
+    size_t size() const { return buf_.size(); }
+
+   private:
+    friend class Context;
+    Context* ctx_;
+    std::vector<char> buf_;
+  };
+  Scratch acquireScratch(size_t minBytes);
+
   transport::Context* transport() const { return tctx_.get(); }
 
   void close();
@@ -51,6 +73,9 @@ class Context {
   std::shared_ptr<Store> store_;
   std::shared_ptr<transport::Device> device_;
   std::unique_ptr<transport::Context> tctx_;
+
+  std::mutex scratchMu_;
+  std::vector<std::vector<char>> scratchPool_;
 };
 
 }  // namespace tpucoll

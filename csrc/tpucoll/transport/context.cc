@@ -341,7 +341,22 @@ void Context::onPairError(int rank, const std::string& message) {
       pairErrors_[rank] = message;
     }
     for (auto it = posted_.begin(); it != posted_.end();) {
+      bool anyLive = false;
       if (it->allowed[rank]) {
+        // A recv-from-any can still be satisfied by another live source
+        // (everything a departed peer sent was delivered before its EOF,
+        // so its data cannot be pending). Fail only when no admissible
+        // source remains.
+        for (int r = 0; r < size_; r++) {
+          if (it->allowed[r] && pairErrors_[r].empty()) {
+            anyLive = true;
+            break;
+          }
+        }
+      } else {
+        anyLive = true;
+      }
+      if (!anyLive) {
         victims.push_back(it->ubuf);
         it = posted_.erase(it);
       } else {

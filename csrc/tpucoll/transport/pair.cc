@@ -113,6 +113,7 @@ void Pair::expectViaListener(Listener* listener) {
 
 void Pair::assumeConnected(int fd) {
   setNonBlocking(fd);
+  setBufferSizes(fd, 4 << 20);
   bool accepted = false;
   {
     std::lock_guard<std::mutex> guard(mu_);

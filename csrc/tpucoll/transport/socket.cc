@@ -30,6 +30,11 @@ void setReuseAddr(int fd) {
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &on, sizeof(on));
 }
 
+void setBufferSizes(int fd, int bytes) {
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
+}
+
 std::string errnoString(const char* what) {
   return std::string(what) + ": " + strerror(errno);
 }

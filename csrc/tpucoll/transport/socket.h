@@ -9,6 +9,10 @@ namespace transport {
 void setNonBlocking(int fd);
 void setNoDelay(int fd);
 void setReuseAddr(int fd);
+// Large buffers keep bulk collective segments flowing with fewer
+// syscall/wakeup round trips (reference analog: SO_SNDBUF autotuning in
+// gloo/transport/tcp/pair.cc:860-872).
+void setBufferSizes(int fd, int bytes);
 std::string errnoString(const char* what);
 
 }  // namespace transport

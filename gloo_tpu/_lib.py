@@ -13,7 +13,9 @@ import os
 import subprocess
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpucoll.so")
+# TPUCOLL_LIB points at an alternate build (e.g. a sanitizer build).
+_LIB_PATH = os.environ.get(
+    "TPUCOLL_LIB", os.path.join(_NATIVE_DIR, "libtpucoll.so"))
 
 
 class Error(RuntimeError):

@@ -1,0 +1,36 @@
+"""Minimal MLP — the SURVEY §7 M2 milestone model (data-parallel training
+with gradient allreduce through the framework's own collectives)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class MLP:
+    def __init__(self, sizes):
+        self.sizes = tuple(sizes)
+
+    def init(self, key):
+        params = []
+        for i, (fan_in, fan_out) in enumerate(zip(self.sizes, self.sizes[1:])):
+            key, sub = jax.random.split(key)
+            scale = jnp.sqrt(2.0 / fan_in)
+            params.append({
+                "w": jax.random.normal(sub, (fan_in, fan_out),
+                                       jnp.float32) * scale,
+                "b": jnp.zeros((fan_out,), jnp.float32),
+            })
+        return params
+
+    def apply(self, params, x):
+        for i, layer in enumerate(params):
+            x = x @ layer["w"] + layer["b"]
+            if i + 1 < len(params):
+                x = jax.nn.relu(x)
+        return x
+
+    def loss(self, params, batch):
+        x, y = batch
+        pred = self.apply(params, x)
+        return jnp.mean((pred - y) ** 2)

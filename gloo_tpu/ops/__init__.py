@@ -1,0 +1,11 @@
+"""Pallas TPU kernels: custom collective schedules over ICI.
+
+Device-plane analog of the reference's hand-written CUDA ring algorithms
+(gloo/cuda_allreduce_ring*.cc): where XLA's built-in collectives (see
+gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
+DMA engines directly for schedules XLA does not emit.
+"""
+
+from gloo_tpu.ops.pallas_ring import ring_allreduce
+
+__all__ = ["ring_allreduce"]

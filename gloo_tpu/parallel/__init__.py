@@ -1,0 +1,26 @@
+"""Parallelism strategies built on the gloo_tpu collective layers.
+
+The reference sits one layer below these (SURVEY.md §2.10): it supplies the
+collectives that DP/TP/PP/SP are built from. This package closes the loop
+by shipping the strategies themselves, each built on a gloo_tpu plane:
+
+- `ddp`: data parallelism — device-plane gradient psum over the mesh, and
+  host-plane gradient allreduce over the C++ TCP transport (the exact role
+  the reference plays under PyTorch DDP);
+- `tp`: Megatron-style tensor parallelism (column/row-parallel dense);
+- `sp`: sequence/context parallelism — ring attention over ppermute.
+"""
+
+from gloo_tpu.parallel.ddp import HostGradSync, make_ddp_train_step
+from gloo_tpu.parallel.sp import ring_attention
+from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
+                                  tp_mlp_block)
+
+__all__ = [
+    "HostGradSync",
+    "column_parallel_dense",
+    "make_ddp_train_step",
+    "ring_attention",
+    "row_parallel_dense",
+    "tp_mlp_block",
+]

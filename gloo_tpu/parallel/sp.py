@@ -1,0 +1,67 @@
+"""Sequence/context parallelism: ring attention over the device mesh.
+
+Long sequences are sharded along time; K/V blocks rotate around the ring
+(ppermute over ICI) while each device accumulates attention for its local
+queries with an online-softmax (flash-style) update. Communication volume
+matches the reference's chunked-ring schedule shape (SURVEY.md §5: the
+ring allreduce IS a ring sequence-parallel schedule over chunks) — here
+expressed as a jit-compiled XLA program.
+
+Call inside shard_map with the time axis sharded:
+    q, k, v: (batch, heads, t_local, head_dim) per device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gloo_tpu.tpu import spmd
+
+
+def ring_attention(q, k, v, axis: str, causal: bool = True):
+    n = spmd.size(axis)
+    my = spmd.rank(axis)
+    b, h, t_local, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+
+    q32 = q.astype(jnp.float32)
+    pos_q = my * t_local + lax.broadcasted_iota(jnp.int32, (t_local, 1), 0)
+
+    def step(i, carry):
+        k_blk, v_blk, out, m, l = carry
+        src = lax.rem(my - i + n, n)  # which shard's K/V we hold now
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            pos_k = src * t_local + lax.broadcasted_iota(
+                jnp.int32, (1, t_local), 1)
+            mask = pos_k <= pos_q  # (t_local, t_local)
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        # Guard fully-masked rows (no attendable keys yet): keep m finite.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe)
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        correction = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        correction = jnp.where(jnp.isfinite(m), correction, 0.0)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        out_new = out * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        # Rotate K/V to the right neighbor for the next step.
+        k_next = spmd.shift(k_blk, axis, 1)
+        v_next = spmd.shift(v_blk, axis, 1)
+        return k_next, v_next, out_new, m_new, l_new
+
+    out0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    # Fresh zeros are device-invariant; the loop carry becomes varying over
+    # the ring axis after one step, so pre-mark them to keep carry types
+    # stable under shard_map's vma checking.
+    out0, m0, l0 = (lax.pcast(a, (axis,), to="varying")
+                    for a in (out0, m0, l0))
+    _, _, out, m, l = lax.fori_loop(0, n, step, (k, v, out0, m0, l0))
+    out = out / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)

@@ -1,0 +1,42 @@
+"""Megatron-style tensor parallelism over a mesh axis.
+
+Built purely from gloo_tpu device-plane collectives — demonstrating that
+the collective layer is sufficient to express TP, the same way users build
+TP on the reference's allreduce/allgather (SURVEY.md §2.10). All functions
+run inside shard_map with the weight shards as per-device values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gloo_tpu.tpu import spmd
+
+
+def column_parallel_dense(x, w_shard, axis: str):
+    """y_shard = x @ w_shard where w is split along its output dim.
+
+    No forward communication; consumers either keep working on the output
+    shard (paired with a following row-parallel layer) or allgather.
+    """
+    return x @ w_shard
+
+
+def row_parallel_dense(x_shard, w_shard, axis: str):
+    """y = sum_over_ranks(x_shard @ w_shard): w split along its input dim,
+    x arriving already split (e.g. from a column-parallel layer). The psum
+    is the TP allreduce on the ICI mesh."""
+    partial = x_shard @ w_shard
+    return spmd.allreduce(partial, axis, "sum")
+
+
+def tp_mlp_block(x, w_up_shard, w_down_shard, axis: str, activation=None):
+    """The canonical 2-layer TP block: column-parallel up-projection,
+    nonlinearity on the shard, row-parallel down-projection (one psum per
+    block, like Megatron's MLP)."""
+    import jax
+
+    act = activation if activation is not None else jax.nn.gelu
+    h = column_parallel_dense(x, w_up_shard, axis)
+    h = act(h)
+    return row_parallel_dense(h, w_down_shard, axis)

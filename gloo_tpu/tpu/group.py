@@ -1,0 +1,105 @@
+"""TpuProcessGroup: array-level device-plane process group.
+
+Mirrors the host `gloo_tpu.Context` surface (one "rank" per device along a
+mesh axis, same collective names and semantics) but every call is a jitted
+XLA program over sharded jax arrays. This is the device-plane counterpart
+of the reference's CUDA algorithm classes (gloo/cuda_allreduce_*.cc):
+their ctor-time setup ≙ XLA compilation (cached per shape/dtype/op), their
+run() ≙ executing the compiled program over ICI.
+
+Array convention: the leading axis of every operand is the rank axis — a
+global array of shape (P, ...) whose row i lives on mesh position i.
+`shard(...)`/`unshard(...)` convert between host numpy and this layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gloo_tpu.tpu import spmd
+
+
+class TpuProcessGroup:
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        if axis is None:
+            if len(mesh.axis_names) != 1:
+                raise ValueError("axis required for multi-axis mesh")
+            axis = mesh.axis_names[0]
+        self.mesh = mesh
+        self.axis = axis
+        self.size = mesh.shape[axis]
+        self._row_sharding = NamedSharding(mesh, P(self.axis))
+
+    # ---- data movement helpers ----
+
+    def shard(self, array) -> jax.Array:
+        """Place a (P, ...) host array so row i lives on device i."""
+        array = jnp.asarray(array)
+        if array.shape[0] != self.size:
+            raise ValueError(
+                f"leading axis {array.shape[0]} != group size {self.size}")
+        return jax.device_put(array, self._row_sharding)
+
+    def unshard(self, array) -> np.ndarray:
+        return np.asarray(jax.device_get(array))
+
+    def _smap(self, fn, x):
+        shard_fn = jax.shard_map(fn, mesh=self.mesh,
+                                 in_specs=P(self.axis),
+                                 out_specs=P(self.axis))
+        return jax.jit(shard_fn)(x)
+
+    # ---- collectives (each rank's operand is its row) ----
+
+    def allreduce(self, x, op: str = "sum"):
+        return self._smap(lambda s: spmd.allreduce(s, self.axis, op), x)
+
+    def broadcast(self, x, root: int = 0):
+        return self._smap(lambda s: spmd.broadcast(s, self.axis, root), x)
+
+    def reduce(self, x, root: int = 0, op: str = "sum"):
+        return self._smap(lambda s: spmd.reduce(s, self.axis, root, op), x)
+
+    def allgather(self, x):
+        # Result is (P, P, ...): row i is rank i's copy of the gathered
+        # buffer (identical rows, matching the host API where every rank's
+        # output holds all inputs).
+        return self._smap(
+            lambda s: spmd.allgather(s[0], self.axis, gather_axis=0,
+                                     tiled=False)[None], x)
+
+    def reduce_scatter(self, x, op: str = "sum"):
+        """x rows are (P*k, ...); rank i keeps slice i of the sum."""
+        return self._smap(
+            lambda s: spmd.reduce_scatter(s[0], self.axis, op,
+                                          scatter_axis=0)[None], x)
+
+    def alltoall(self, x):
+        """Row i holds P blocks along axis 1; block j goes to rank j."""
+        return self._smap(
+            lambda s: spmd.alltoall(s[0], self.axis, split_axis=0,
+                                    concat_axis=0)[None], x)
+
+    def scatter(self, x, root: int = 0):
+        return self._smap(
+            lambda s: spmd.scatter(s[0], self.axis, root,
+                                   scatter_axis=0)[None], x)
+
+    def send_recv(self, x, perm: Sequence[tuple]):
+        return self._smap(lambda s: spmd.ppermute(s, self.axis, perm), x)
+
+    def shift(self, x, offset: int = 1):
+        return self._smap(lambda s: spmd.shift(s, self.axis, offset), x)
+
+    def barrier(self):
+        out = jax.jit(
+            jax.shard_map(lambda: spmd.barrier(self.axis)[None],
+                          mesh=self.mesh, in_specs=(),
+                          out_specs=P(self.axis)))()
+        jax.block_until_ready(out)

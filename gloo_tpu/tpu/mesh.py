@@ -1,0 +1,46 @@
+"""Device mesh construction helpers.
+
+The mesh is the device plane's "context": where the host plane bootstraps a
+full mesh of TCP pairs (rendezvous/context.cc analog), the device plane
+arranges chips into a named `jax.sharding.Mesh` whose axes carry the
+parallelism meaning (dp/tp/pp/sp/ep). XLA then lowers collectives over an
+axis to ICI transfers.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: Optional[Mapping[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh over `devices` (default: all local devices).
+
+    `axes` maps axis name -> size; one axis size may be -1 to absorb the
+    remaining devices (like a reshape). Default: a single "data" axis over
+    everything.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"data": len(devs)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n_free = sizes.count(-1)
+    if n_free > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if n_free == 1:
+        if len(devs) % known != 0:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by fixed axes {axes}")
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {len(devs)}")
+    grid = np.asarray(devs, dtype=object).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))

@@ -1,0 +1,122 @@
+"""Collective primitives for use inside shard_map / pjit SPMD code.
+
+These mirror the host collective suite (csrc/tpucoll/collectives/) but
+operate on the per-device shard inside an SPMD region, compiling to XLA
+collectives that ride ICI (reference analog: the NCCL op wrappers in
+gloo/nccl/nccl.h — here the "wrapper" is XLA itself, which also fuses and
+schedules them).
+
+All functions take `axis`: the mesh axis name the collective runs over.
+`op` accepts "sum" | "product" | "min" | "max".
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Sequence[str]]
+
+
+def rank(axis: Axis):
+    """Position of this shard along `axis` (the device-plane 'rank')."""
+    return lax.axis_index(axis)
+
+
+def size(axis: Axis) -> int:
+    return lax.axis_size(axis)
+
+
+def allreduce(x, axis: Axis, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op in ("product", "prod"):
+        # No pprod primitive: gather and reduce locally. XLA turns the
+        # all_gather + reduce into an efficient fused loop.
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
+    raise ValueError(f"unknown op: {op}")
+
+
+def mean(x, axis: Axis):
+    return lax.pmean(x, axis)
+
+
+def reduce_scatter(x, axis: Axis, op: str = "sum", scatter_axis: int = 0):
+    """Reduce across `axis` and leave each shard with its 1/P slice."""
+    if op != "sum":
+        # psum_scatter is sum-only; emulate others via allreduce + slice.
+        full = allreduce(x, axis, op)
+        p = size(axis)
+        idx = rank(axis)
+        chunk = x.shape[scatter_axis] // p
+        return lax.dynamic_slice_in_dim(full, idx * chunk, chunk,
+                                        axis=scatter_axis)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def allgather(x, axis: Axis, gather_axis: int = 0, tiled: bool = True):
+    """Concatenate every shard's x along `gather_axis`."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def alltoall(x, axis: Axis, split_axis: int = 0, concat_axis: int = 0):
+    """Scatter `split_axis` across the group and gather along `concat_axis`."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, axis: Axis, root: int = 0):
+    """Every shard receives the root shard's value."""
+    idx = rank(axis)
+    zeros = jnp.zeros_like(x)
+    return lax.psum(jnp.where(idx == root, x, zeros), axis)
+
+
+def reduce(x, axis: Axis, root: int = 0, op: str = "sum"):
+    """Full reduction; non-root shards receive zeros (XLA has no rooted
+    reduce — the collective cost is the same on ICI, matching psum)."""
+    full = allreduce(x, axis, op)
+    idx = rank(axis)
+    return jnp.where(idx == root, full, jnp.zeros_like(full))
+
+
+def scatter(x, axis: Axis, root: int = 0, scatter_axis: int = 0):
+    """Root's x is split into P slices; shard i receives slice i."""
+    rooted = broadcast(x, axis, root)
+    p = size(axis)
+    idx = rank(axis)
+    chunk = x.shape[scatter_axis] // p
+    return lax.dynamic_slice_in_dim(rooted, idx * chunk, chunk,
+                                    axis=scatter_axis)
+
+
+def ppermute(x, axis: Axis, perm: Sequence[tuple]):
+    """Point-to-point shift: pairs of (source_rank, dest_rank)."""
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def shift(x, axis: Axis, offset: int = 1, wrap: bool = True):
+    """Send each shard to rank + offset (ring neighbor exchange); the
+    building block for pipeline stages and ring attention."""
+    p = size(axis)
+    if wrap:
+        perm = [(i, (i + offset) % p) for i in range(p)]
+    else:
+        perm = [(i, i + offset) for i in range(p)
+                if 0 <= i + offset < p]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def barrier(axis: Axis):
+    """Synchronization point: returns a token-like scalar whose value
+    depends on every participant (XLA cannot elide or reorder it past uses
+    that consume the result)."""
+    return lax.psum(jnp.ones((), dtype=jnp.int32), axis)

@@ -2,13 +2,16 @@ import os
 import subprocess
 import sys
 
-# Device-plane tests run on a virtual 8-device CPU mesh; set this before jax
-# is imported anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Device-plane tests run on a virtual 8-device CPU mesh. The environment may
+# pin JAX_PLATFORMS to a TPU plugin (e.g. axon) at interpreter start, so
+# override via jax.config before any backend is initialized.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:

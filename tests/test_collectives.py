@@ -266,6 +266,22 @@ def test_reduce_scatter_uneven():
         offset += recv_counts[r]
 
 
+def test_allreduce_segment_boundary_mismatch():
+    """Blocks straddling the 4 MiB segment boundary give adjacent ring
+    blocks different segment counts; the send-drain accounting must follow
+    the send block's segmentation (regression test)."""
+    size, count = 2, 2 * 1024 * 1024 + 1  # blocks: 4MiB+4B vs 4MiB
+
+    def fn(ctx, rank):
+        x = np.full(count, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x)
+        return float(x[0]), float(x[-1])
+
+    results = spawn(size, fn, timeout=60)
+    for a, b in results:
+        assert (a, b) == (3.0, 3.0)
+
+
 @pytest.mark.parametrize("size", SIZES)
 def test_barrier(size):
     import time
