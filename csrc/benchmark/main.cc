@@ -1,0 +1,439 @@
+// tpucoll_bench: latency/bandwidth benchmark CLI for the host data plane.
+//
+// Reproduces the reference's measurement methodology (gloo/benchmark/
+// runner.cc, options.h, timer.h): element-count sweep, warmup iterations,
+// run each point for a minimum wall time, report min/p50/p99/max per
+// iteration plus algorithm bandwidth, verify the first iteration
+// element-wise. Rendezvous via FileStore or TcpStore (one rank can host
+// the store inline with --serve).
+//
+// Example (2 ranks on one host):
+//   ./tpucoll_bench --rank 0 --size 2 --serve 29500 --op allreduce &
+//   ./tpucoll_bench --rank 1 --size 2 --store tcp:127.0.0.1:29500
+//       --op allreduce
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <csignal>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <numeric>
+#include <string>
+#include <vector>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/context.h"
+#include "tpucoll/rendezvous/file_store.h"
+#include "tpucoll/rendezvous/tcp_store.h"
+#include "tpucoll/transport/device.h"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Options {
+  int rank = -1;
+  int size = -1;
+  std::string store;          // "file:/path" | "tcp:host:port"
+  int servePort = -1;         // host a TcpStoreServer on this port
+  std::string host = "127.0.0.1";
+  std::string op = "allreduce";
+  std::string algorithm = "auto";
+  std::vector<size_t> elements;
+  double minSeconds = 2.0;
+  int warmup = 5;
+  bool verify = true;
+  bool json = false;
+  uint32_t tagBase = 0;
+};
+
+void usage() {
+  fprintf(stderr,
+          "tpucoll_bench --rank R --size P (--store file:PATH|tcp:H:P | "
+          "--serve PORT)\n"
+          "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
+          "alltoall|barrier|sendrecv]\n"
+          "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
+          "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n");
+}
+
+std::vector<size_t> parseElements(const std::string& arg) {
+  std::vector<size_t> out;
+  size_t pos = 0;
+  while (pos < arg.size()) {
+    size_t comma = arg.find(',', pos);
+    if (comma == std::string::npos) {
+      comma = arg.size();
+    }
+    out.push_back(std::stoull(arg.substr(pos, comma - pos)));
+    pos = comma + 1;
+  }
+  return out;
+}
+
+Options parse(int argc, char** argv) {
+  Options o;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      TC_ENFORCE_LT(i + 1, argc, "missing value for ", a);
+      return argv[++i];
+    };
+    if (a == "--rank") {
+      o.rank = std::stoi(next());
+    } else if (a == "--size") {
+      o.size = std::stoi(next());
+    } else if (a == "--store") {
+      o.store = next();
+    } else if (a == "--serve") {
+      o.servePort = std::stoi(next());
+    } else if (a == "--host") {
+      o.host = next();
+    } else if (a == "--op") {
+      o.op = next();
+    } else if (a == "--algorithm") {
+      o.algorithm = next();
+    } else if (a == "--elements") {
+      o.elements = parseElements(next());
+    } else if (a == "--min-time") {
+      o.minSeconds = std::stod(next());
+    } else if (a == "--warmup") {
+      // At least one warmup iteration: its median seeds the agreed
+      // iteration count.
+      o.warmup = std::max(1, std::stoi(next()));
+    } else if (a == "--no-verify") {
+      o.verify = false;
+    } else if (a == "--json") {
+      o.json = true;
+    } else {
+      usage();
+      TC_THROW(tpucoll::EnforceError, "unknown argument ", a);
+    }
+  }
+  TC_ENFORCE(o.rank >= 0 && o.size > 0, "--rank/--size required");
+  TC_ENFORCE(!o.store.empty() || o.servePort >= 0,
+             "--store or --serve required");
+  if (o.elements.empty()) {
+    for (size_t n = 100; n <= 4'000'000; n *= 10) {
+      o.elements.push_back(n);
+    }
+  }
+  return o;
+}
+
+std::shared_ptr<tpucoll::Store> makeStore(
+    const Options& o, std::unique_ptr<tpucoll::TcpStoreServer>* server) {
+  if (o.servePort >= 0) {
+    *server = std::make_unique<tpucoll::TcpStoreServer>(
+        "0.0.0.0", static_cast<uint16_t>(o.servePort));
+    // With --serve 0 the kernel picks the port; peers need to know it.
+    fprintf(stderr, "[tpucoll_bench] store serving on port %u\n",
+            (*server)->port());
+    return std::make_shared<tpucoll::TcpStore>("127.0.0.1",
+                                               (*server)->port());
+  }
+  if (o.store.rfind("file:", 0) == 0) {
+    return std::make_shared<tpucoll::FileStore>(o.store.substr(5));
+  }
+  if (o.store.rfind("tcp:", 0) == 0) {
+    std::string rest = o.store.substr(4);
+    size_t colon = rest.rfind(':');
+    TC_ENFORCE_NE(colon, std::string::npos, "bad --store ", o.store);
+    return std::make_shared<tpucoll::TcpStore>(
+        rest.substr(0, colon),
+        static_cast<uint16_t>(std::stoi(rest.substr(colon + 1))));
+  }
+  TC_THROW(tpucoll::EnforceError, "bad --store ", o.store);
+}
+
+struct Workload {
+  // Returns bytes moved per iteration for bandwidth math (algorithm
+  // bandwidth = payload bytes / time, the reference's definition).
+  std::function<void()> run;
+  std::function<bool()> verifyOnce;  // true iff verified OK
+  size_t algBytes;
+};
+
+Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
+                      size_t elements, uint32_t tag, std::vector<float>& buf,
+                      std::vector<float>& out) {
+  using namespace tpucoll;
+  const int rank = ctx.rank();
+  const int size = ctx.size();
+  Workload w;
+  w.algBytes = elements * sizeof(float);
+
+  auto algo = o.algorithm == "ring" ? AllreduceAlgorithm::kRing
+              : (o.algorithm == "hd" || o.algorithm == "halving_doubling")
+                  ? AllreduceAlgorithm::kHalvingDoubling
+                  : AllreduceAlgorithm::kAuto;
+  // NOTE: lambdas capture buf/out/ctx by reference (owned by the caller for
+  // the workload's lifetime) and everything else by value — run/verifyOnce
+  // outlive this frame.
+  auto ctxp = &ctx;
+
+  if (o.op == "allreduce") {
+    buf.assign(elements, 0.f);
+    std::function<void()> run = [ctxp, &buf, tag, algo] {
+      AllreduceOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.inputs = {buf.data()};
+      opts.outputs = {buf.data()};
+      opts.count = buf.size();
+      opts.algorithm = algo;
+      allreduce(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &buf, rank, size] {
+      for (auto& v : buf) {
+        v = float(rank + 1);
+      }
+      run();
+      const float expect = size * (size + 1) / 2.0f;
+      bool ok = std::all_of(buf.begin(), buf.end(),
+                            [&](float v) { return v == expect; });
+      std::fill(buf.begin(), buf.end(), 1.f);
+      return ok;
+    };
+  } else if (o.op == "allgather") {
+    buf.assign(elements, float(rank));
+    out.assign(elements * size, 0.f);
+    std::function<void()> run = [ctxp, &buf, &out, tag] {
+      AllgatherOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = out.data();
+      opts.count = buf.size();
+      allgather(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, elements, size] {
+      run();
+      for (int r = 0; r < size; r++) {
+        for (size_t i = 0; i < elements; i++) {
+          if (out[r * elements + i] != float(r)) {
+            return false;
+          }
+        }
+      }
+      return true;
+    };
+  } else if (o.op == "reduce_scatter") {
+    buf.assign(elements, 1.f);
+    out.assign(elements / size + elements % size + 1, 0.f);
+    std::vector<size_t> counts(size, elements / size);
+    counts[0] += elements % size;
+    std::function<void()> run = [ctxp, &buf, &out, tag, counts] {
+      ReduceScatterOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = out.data();
+      opts.recvCounts = counts;
+      reduceScatter(opts);
+    };
+    w.run = run;
+    // Verify: with all-ones inputs every output element must equal `size`.
+    w.verifyOnce = [run, &out, counts, rank, size] {
+      run();
+      for (size_t i = 0; i < counts[rank]; i++) {
+        if (out[i] != float(size)) {
+          return false;
+        }
+      }
+      return true;
+    };
+  } else if (o.op == "broadcast") {
+    buf.assign(elements, rank == 0 ? 42.f : 0.f);
+    std::function<void()> run = [ctxp, &buf, tag] {
+      BroadcastOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.buffer = buf.data();
+      opts.count = buf.size();
+      broadcast(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &buf] {
+      run();
+      return std::all_of(buf.begin(), buf.end(),
+                         [](float v) { return v == 42.f; });
+    };
+  } else if (o.op == "alltoall") {
+    buf.assign(elements * size, float(rank));
+    out.assign(elements * size, 0.f);
+    w.algBytes = elements * size * sizeof(float);
+    std::function<void()> run = [ctxp, &buf, &out, tag, elements] {
+      AlltoallOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = out.data();
+      opts.count = elements;
+      alltoall(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, elements, size] {
+      run();
+      for (int r = 0; r < size; r++) {
+        for (size_t i = 0; i < elements; i++) {
+          if (out[r * elements + i] != float(r)) {
+            return false;
+          }
+        }
+      }
+      return true;
+    };
+  } else if (o.op == "barrier") {
+    w.algBytes = 0;
+    std::function<void()> run = [ctxp, tag] {
+      BarrierOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      barrier(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run] {
+      run();
+      return true;
+    };
+  } else if (o.op == "sendrecv") {
+    TC_ENFORCE_EQ(size, 2, "sendrecv runs with exactly 2 ranks");
+    buf.assign(elements, float(rank));
+    std::shared_ptr<tpucoll::transport::UnboundBuffer> ub(
+        ctx.createUnboundBuffer(buf.data(), buf.size() * sizeof(float))
+            .release());
+    std::function<void()> run = [ctxp, &buf, ub, rank] {
+      const uint64_t slot = ctxp->nextSlot();
+      if (rank == 0) {
+        ub->send(1, slot, 0, buf.size() * sizeof(float));
+        ub->waitSend(std::chrono::milliseconds(30000));
+      } else {
+        ub->recv(0, slot, 0, buf.size() * sizeof(float));
+        ub->waitRecv(nullptr, std::chrono::milliseconds(30000));
+      }
+    };
+    w.run = run;
+    w.verifyOnce = [run] {
+      run();
+      return true;
+    };
+  } else {
+    TC_THROW(tpucoll::EnforceError, "unknown op ", o.op);
+  }
+  return w;
+}
+
+}  // namespace
+
+int runBench(int argc, char** argv);
+
+int main(int argc, char** argv) {
+  try {
+    return runBench(argc, argv);
+  } catch (const std::exception& e) {
+    fprintf(stderr, "tpucoll_bench: %s\n", e.what());
+    return 1;
+  }
+}
+
+int runBench(int argc, char** argv) {
+  using namespace tpucoll;
+  signal(SIGPIPE, SIG_IGN);
+  Options o = parse(argc, argv);
+  std::unique_ptr<tpucoll::TcpStoreServer> server;
+  auto store = makeStore(o, &server);
+
+  tpucoll::transport::DeviceAttr attr;
+  attr.hostname = o.host;
+  auto device = std::make_shared<tpucoll::transport::Device>(attr);
+  tpucoll::Context ctx(o.rank, o.size);
+  ctx.connectFullMesh(store, device);
+
+  if (o.rank == 0 && !o.json) {
+    printf("# tpucoll_bench op=%s algorithm=%s size=%d transport=tcp\n",
+           o.op.c_str(), o.algorithm.c_str(), o.size);
+    printf("%12s %12s %10s %10s %10s %10s %12s %8s\n", "bytes", "elements",
+           "min(us)", "p50(us)", "p99(us)", "max(us)", "algbw(GB/s)",
+           "iters");
+  }
+
+  uint32_t tag = o.tagBase;
+  for (size_t elements : o.elements) {
+    std::vector<float> buf, out;
+    // One tag per sweep point: ranks can be a whole call skewed at the
+    // boundary between points, and collectives of different shapes must
+    // not cross-match (same contract as the reference's tag semantics).
+    Workload w = makeWorkload(o, ctx, elements, tag++, buf, out);
+
+    if (o.verify) {
+      TC_ENFORCE(w.verifyOnce(), "verification failed for ", o.op, " at ",
+                 elements, " elements");
+    }
+    double warmupP50 = 0;
+    {
+      std::vector<double> wsamples;
+      for (int i = 0; i < o.warmup; i++) {
+        const auto t0 = Clock::now();
+        w.run();
+        wsamples.push_back(
+            std::chrono::duration<double>(Clock::now() - t0).count());
+      }
+      std::sort(wsamples.begin(), wsamples.end());
+      warmupP50 = wsamples[wsamples.size() / 2];
+    }
+
+    // Agree on an iteration count (reference: median time broadcast,
+    // gloo/benchmark/runner.cc:322-330) so no rank leaves the sweep point
+    // before its peers.
+    // Cap the agreed count: near-zero-cost ops (barrier at size 1) would
+    // otherwise produce millions of iterations; percentile quality does
+    // not improve past a few tens of thousands of samples.
+    uint64_t iters = std::min<uint64_t>(
+        50000, std::max<uint64_t>(1, uint64_t(o.minSeconds / warmupP50)));
+    {
+      BroadcastOptions opts;
+      opts.context = &ctx;
+      opts.tag = tag++;
+      opts.buffer = &iters;
+      opts.count = 1;
+      opts.dtype = DataType::kUint64;
+      broadcast(opts);
+    }
+
+    std::vector<double> samples;
+    samples.reserve(iters);
+    for (uint64_t i = 0; i < iters; i++) {
+      const auto t0 = Clock::now();
+      w.run();
+      samples.push_back(
+          std::chrono::duration<double>(Clock::now() - t0).count());
+    }
+
+    std::sort(samples.begin(), samples.end());
+    auto pct = [&](double p) {
+      return samples[std::min(samples.size() - 1,
+                              size_t(p * samples.size()))] * 1e6;
+    };
+    const double p50 = pct(0.5);
+    const double algbw = w.algBytes / (p50 / 1e6) / 1e9;
+    if (o.rank == 0) {
+      if (o.json) {
+        printf("{\"op\":\"%s\",\"elements\":%zu,\"bytes\":%zu,"
+               "\"min_us\":%.1f,\"p50_us\":%.1f,\"p99_us\":%.1f,"
+               "\"max_us\":%.1f,\"algbw_gbps\":%.3f,\"iters\":%zu}\n",
+               o.op.c_str(), elements, w.algBytes, pct(0.0), p50, pct(0.99),
+               samples.back() * 1e6, algbw, samples.size());
+      } else {
+        printf("%12zu %12zu %10.1f %10.1f %10.1f %10.1f %12.3f %8zu\n",
+               w.algBytes, elements, pct(0.0), p50, pct(0.99),
+               samples.back() * 1e6, algbw, samples.size());
+      }
+    }
+  }
+  ctx.close();
+  return 0;
+}

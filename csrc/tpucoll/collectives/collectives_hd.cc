@@ -1,0 +1,122 @@
+// Recursive halving-doubling allreduce (Rabenseifner's algorithm over
+// block windows).
+//
+// Reduce-scatter by recursive vector halving: at each round a rank and its
+// partner (rank XOR mask, mask from P/2 down to 1) exchange complementary
+// halves of the current block window and reduce the half they keep. After
+// log2(P) rounds each rank's window is exactly its own block, fully
+// reduced — the window bookkeeping lands block r on rank r directly, with
+// no bit-reversal pass (contrast reference reduce_scatter.h:21-329).
+// Allgather by recursive doubling reverses the walk, windows merging with
+// their siblings until every rank holds the full vector.
+#include <cstring>
+
+#include "tpucoll/collectives/algorithms.h"
+#include "tpucoll/collectives/detail.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+using collectives_detail::Blocks;
+using collectives_detail::evenBlocks;
+using collectives_detail::largestPow2AtMost;
+
+void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
+                              size_t elsize, ReduceFn fn, Slot slot,
+                              std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes = count * elsize;
+  const int pow2 = static_cast<int>(largestPow2AtMost(size));
+  const int rem = size - pow2;
+
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto scratch = ctx->acquireScratch(nbytes);
+  char* tmp = scratch.data();
+  auto tmpBuf = ctx->createUnboundBuffer(tmp, nbytes);
+
+  // Fold: the first 2*rem ranks pair (even, odd); odds contribute their
+  // vector to their even partner and sit out the exchange.
+  uint64_t round = 0;
+  int vrank;
+  if (rank < 2 * rem) {
+    if (rank % 2 == 1) {
+      workBuf->send(rank - 1, slot.offset(round).value(), 0, nbytes);
+      workBuf->waitSend(timeout);
+      vrank = -1;
+    } else {
+      tmpBuf->recv(rank + 1, slot.offset(round).value(), 0, nbytes);
+      tmpBuf->waitRecv(nullptr, timeout);
+      fn(work, tmp, count);
+      vrank = rank / 2;
+    }
+  } else {
+    vrank = rank - rem;
+  }
+  round++;
+  auto physical = [&](int v) { return v < rem ? 2 * v : v + rem; };
+
+  if (vrank >= 0 && pow2 > 1) {
+    Blocks blocks = evenBlocks(count, pow2, elsize);
+    auto rangeOff = [&](int first) { return blocks.offset[first]; };
+    auto rangeBytes = [&](int first, int n) {
+      return blocks.rangeBytes(first, n);
+    };
+
+    // --- reduce-scatter: recursive vector halving ---
+    int winStart = 0;
+    int winCount = pow2;
+    for (int mask = pow2 / 2; mask >= 1; mask >>= 1, round++) {
+      const int partner = physical(vrank ^ mask);
+      const int half = winCount / 2;
+      const bool keepLower = (vrank & mask) == 0;
+      const int keepStart = keepLower ? winStart : winStart + half;
+      const int sendStart = keepLower ? winStart + half : winStart;
+      const uint64_t s = slot.offset(round).value();
+      // Receive into the scratch mirror at the kept range's own offsets.
+      tmpBuf->recv(partner, s, rangeOff(keepStart),
+                   rangeBytes(keepStart, half));
+      workBuf->send(partner, s, rangeOff(sendStart),
+                    rangeBytes(sendStart, half));
+      tmpBuf->waitRecv(nullptr, timeout);
+      if (rangeBytes(keepStart, half) > 0) {
+        fn(work + rangeOff(keepStart), tmp + rangeOff(keepStart),
+           rangeBytes(keepStart, half) / elsize);
+      }
+      workBuf->waitSend(timeout);
+      winStart = keepStart;
+      winCount = half;
+    }
+
+    // --- allgather: recursive doubling (receives land in place) ---
+    for (int mask = 1; mask < pow2; mask <<= 1, round++) {
+      const int partner = physical(vrank ^ mask);
+      const int partnerStart = winStart ^ winCount;  // sibling window
+      const uint64_t s = slot.offset(round).value();
+      workBuf->recv(partner, s, rangeOff(partnerStart),
+                    rangeBytes(partnerStart, winCount));
+      workBuf->send(partner, s, rangeOff(winStart),
+                    rangeBytes(winStart, winCount));
+      workBuf->waitRecv(nullptr, timeout);
+      workBuf->waitSend(timeout);
+      winStart = std::min(winStart, partnerStart);
+      winCount *= 2;
+    }
+  }
+
+  // Unfold: even partners push the final vector back to the odd ranks.
+  // A distinct sub-slot avoids any overlap with exchange rounds.
+  const uint64_t finalSlot = slot.offset(1 << 20).value();
+  if (rank < 2 * rem) {
+    if (rank % 2 == 1) {
+      workBuf->recv(rank - 1, finalSlot, 0, nbytes);
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      workBuf->send(rank + 1, finalSlot, 0, nbytes);
+      workBuf->waitSend(timeout);
+    }
+  }
+}
+
+}  // namespace algorithms
+}  // namespace tpucoll

@@ -9,47 +9,20 @@
 #include <cstring>
 #include <vector>
 
+#include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/collectives/detail.h"
 
 namespace tpucoll {
+
+using collectives_detail::Blocks;
+using collectives_detail::countBlocks;
+using collectives_detail::evenBlocks;
+using collectives_detail::segmentize;
 
 namespace {
 
 char* bytePtr(void* p) { return static_cast<char*>(p); }
-
-struct Blocks {
-  std::vector<size_t> bytes;    // per-block byte size
-  std::vector<size_t> offset;   // per-block byte offset
-};
-
-Blocks evenBlocks(size_t count, int size, size_t elsize) {
-  Blocks b;
-  b.bytes.resize(size);
-  b.offset.resize(size);
-  const size_t base = count / size;
-  const size_t rem = count % size;
-  size_t off = 0;
-  for (int i = 0; i < size; i++) {
-    const size_t elems = base + (static_cast<size_t>(i) < rem ? 1 : 0);
-    b.bytes[i] = elems * elsize;
-    b.offset[i] = off;
-    off += b.bytes[i];
-  }
-  return b;
-}
-
-Blocks countBlocks(const std::vector<size_t>& counts, size_t elsize) {
-  Blocks b;
-  b.bytes.resize(counts.size());
-  b.offset.resize(counts.size());
-  size_t off = 0;
-  for (size_t i = 0; i < counts.size(); i++) {
-    b.bytes[i] = counts[i] * elsize;
-    b.offset[i] = off;
-    off += b.bytes[i];
-  }
-  return b;
-}
 
 // Ring reduce-scatter over `work` (in place). After P-1 steps, rank r owns
 // block (r + 1 + startShift) mod P fully reduced. startShift=0 feeds the
@@ -64,29 +37,6 @@ Blocks countBlocks(const std::vector<size_t>& counts, size_t elsize) {
 // their destination (never the stash), and each segment is reduced the
 // moment it arrives, overlapping the VPU/AVX reduction with socket I/O of
 // later segments.
-constexpr size_t kMaxSegmentBytes = 4 << 20;
-
-struct SegSpan {
-  size_t offset;  // within the block
-  size_t nbytes;
-};
-
-std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
-  // Segment boundaries must fall on element boundaries for the reducer.
-  size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);
-  std::vector<SegSpan> segs;
-  size_t off = 0;
-  while (off < blockBytes) {
-    size_t n = std::min(segBytes, blockBytes - off);
-    segs.push_back(SegSpan{off, n});
-    off += n;
-  }
-  if (segs.empty()) {
-    segs.push_back(SegSpan{0, 0});  // zero-byte block still needs a message
-  }
-  return segs;
-}
-
 void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
                        ReduceFn fn, size_t elsize, Slot slot,
                        uint64_t slotBase, int startShift,
@@ -277,8 +227,8 @@ void allgather(AllgatherOptions& opts) {
 }
 
 // Bandwidth-optimal ring allreduce (reference hot path: gloo/allreduce.cc:
-// 147-392): local multi-input reduce, ring reduce-scatter, ring allgather,
-// then fan the result to every output buffer.
+// 147-392): local multi-input reduce, algorithm-specific exchange, then fan
+// the result to every output buffer.
 void allreduce(AllreduceOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "allreduce: null context");
@@ -300,38 +250,57 @@ void allreduce(AllreduceOptions& opts) {
   }
 
   if (size > 1 && opts.count > 0) {
-    const auto t0 = std::chrono::steady_clock::now();
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
-    Blocks blocks = evenBlocks(opts.count, size, elsize);
-    size_t maxBlock = 0;
-    for (size_t b : blocks.bytes) {
-      maxBlock = std::max(maxBlock, b);
+    AllreduceAlgorithm algo = opts.algorithm;
+    if (algo == AllreduceAlgorithm::kAuto) {
+      // Crossover measured on loopback 8 ranks (BASELINE.md): halving-
+      // doubling wins up to ~1 MiB, the pipelined ring beyond.
+      algo = nbytes <= (1 << 20) ? AllreduceAlgorithm::kHalvingDoubling
+                                 : AllreduceAlgorithm::kRing;
     }
-    const size_t maxSegs = segmentize(maxBlock, elsize).size();
-    auto workBuf = ctx->createUnboundBuffer(work, nbytes);
-    const auto t1 = std::chrono::steady_clock::now();
-    ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
-                      workBuf.get());
-    const auto t2 = std::chrono::steady_clock::now();
-
-    // Allgather phase: rank r starts owning reduced block (r+1); the block
-    // then rides the ring into place on every rank.
-    ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
-                       /*slotBase=*/uint64_t(size) * maxSegs, maxSegs,
-                       /*shift=*/1, timeout);
-    const auto t3 = std::chrono::steady_clock::now();
-    auto us = [](auto a, auto b) {
-      return std::chrono::duration_cast<std::chrono::microseconds>(b - a)
-          .count();
-    };
-    TC_DEBUG("allreduce rank ", ctx->rank(), ": setup ", us(t0, t1),
-             "us rs ", us(t1, t2), "us ag ", us(t2, t3), "us");
+    switch (algo) {
+      case AllreduceAlgorithm::kRing:
+        algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
+                                  timeout);
+        break;
+      case AllreduceAlgorithm::kHalvingDoubling:
+        algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
+                                             fn, slot, timeout);
+        break;
+      default:
+        TC_THROW(EnforceError, "unknown allreduce algorithm");
+    }
   }
 
   for (size_t i = 1; i < opts.outputs.size(); i++) {
     std::memcpy(opts.outputs[i], work, nbytes);
   }
 }
+
+namespace algorithms {
+
+void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
+                   ReduceFn fn, Slot slot,
+                   std::chrono::milliseconds timeout) {
+  const int size = ctx->size();
+  const size_t nbytes = count * elsize;
+  Blocks blocks = evenBlocks(count, size, elsize);
+  size_t maxBlock = 0;
+  for (size_t b : blocks.bytes) {
+    maxBlock = std::max(maxBlock, b);
+  }
+  const size_t maxSegs = segmentize(maxBlock, elsize).size();
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
+                    workBuf.get());
+  // Allgather phase: rank r starts owning reduced block (r+1); the block
+  // then rides the ring into place on every rank.
+  ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
+                     /*slotBase=*/uint64_t(size) * maxSegs, maxSegs,
+                     /*shift=*/1, timeout);
+}
+
+}  // namespace algorithms
 
 // Binomial reduction tree: leaves push partials toward the root, halving the
 // number of active ranks per round (log2 P latency steps).

@@ -1,0 +1,86 @@
+// Shared block/segment bookkeeping for collective schedules.
+#pragma once
+
+#include <algorithm>
+#include <cstddef>
+#include <vector>
+
+namespace tpucoll {
+namespace collectives_detail {
+
+struct Blocks {
+  std::vector<size_t> bytes;   // per-block byte size
+  std::vector<size_t> offset;  // per-block byte offset
+
+  // Bytes of the contiguous range covering blocks [first, first+n).
+  size_t rangeBytes(size_t first, size_t n) const {
+    size_t total = 0;
+    for (size_t i = first; i < first + n; i++) {
+      total += bytes[i];
+    }
+    return total;
+  }
+};
+
+inline Blocks evenBlocks(size_t count, int size, size_t elsize) {
+  Blocks b;
+  b.bytes.resize(size);
+  b.offset.resize(size);
+  const size_t base = count / size;
+  const size_t rem = count % size;
+  size_t off = 0;
+  for (int i = 0; i < size; i++) {
+    const size_t elems = base + (static_cast<size_t>(i) < rem ? 1 : 0);
+    b.bytes[i] = elems * elsize;
+    b.offset[i] = off;
+    off += b.bytes[i];
+  }
+  return b;
+}
+
+inline Blocks countBlocks(const std::vector<size_t>& counts, size_t elsize) {
+  Blocks b;
+  b.bytes.resize(counts.size());
+  b.offset.resize(counts.size());
+  size_t off = 0;
+  for (size_t i = 0; i < counts.size(); i++) {
+    b.bytes[i] = counts[i] * elsize;
+    b.offset[i] = off;
+    off += b.bytes[i];
+  }
+  return b;
+}
+
+struct SegSpan {
+  size_t offset;  // within the block
+  size_t nbytes;
+};
+
+// Pipelining granularity for ring schedules (see collectives_ring.cc).
+constexpr size_t kMaxSegmentBytes = 4 << 20;
+
+inline std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
+  size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);
+  std::vector<SegSpan> segs;
+  size_t off = 0;
+  while (off < blockBytes) {
+    size_t n = std::min(segBytes, blockBytes - off);
+    segs.push_back(SegSpan{off, n});
+    off += n;
+  }
+  if (segs.empty()) {
+    segs.push_back(SegSpan{0, 0});  // zero-byte block still needs a message
+  }
+  return segs;
+}
+
+inline uint64_t largestPow2AtMost(uint64_t n) {
+  uint64_t p = 1;
+  while (p * 2 <= n) {
+    p *= 2;
+  }
+  return p;
+}
+
+}  // namespace collectives_detail
+}  // namespace tpucoll

@@ -1,0 +1,429 @@
+#include "tpucoll/rendezvous/tcp_store.h"
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+#include "tpucoll/transport/address.h"
+#include "tpucoll/transport/socket.h"
+
+namespace tpucoll {
+
+namespace {
+
+enum Op : uint8_t {
+  kSet = 1,
+  kTryGet = 2,
+  kWaitGet = 3,
+  kAdd = 4,
+  kCheck = 5,
+  kMultiGet = 6,
+};
+
+enum Status : uint8_t {
+  kOk = 0,
+  kMissing = 1,
+  kTimeout = 2,
+  kBadRequest = 3,
+};
+
+bool readFull(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t rv = read(fd, p + got, n - got);
+    if (rv == 0) {
+      return false;
+    }
+    if (rv < 0) {
+      if (errno == EINTR) {
+        continue;
+      }
+      return false;
+    }
+    got += static_cast<size_t>(rv);
+  }
+  return true;
+}
+
+bool writeFull(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    // MSG_NOSIGNAL: a dead peer must surface as an error, not SIGPIPE.
+    ssize_t rv = send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (rv < 0) {
+      if (errno == EINTR) {
+        continue;
+      }
+      return false;
+    }
+    sent += static_cast<size_t>(rv);
+  }
+  return true;
+}
+
+template <typename T>
+bool readValue(int fd, T* v) {
+  return readFull(fd, v, sizeof(T));
+}
+
+bool readBlob(int fd, std::vector<uint8_t>* out, uint64_t maxLen = 1 << 30) {
+  uint64_t len;
+  if (!readValue(fd, &len) || len > maxLen) {
+    return false;
+  }
+  out->resize(len);
+  return len == 0 || readFull(fd, out->data(), len);
+}
+
+bool writeResponse(int fd, uint8_t status,
+                   const std::vector<Store::Buf>& vals) {
+  std::string out;
+  out.push_back(static_cast<char>(status));
+  uint32_t n = static_cast<uint32_t>(vals.size());
+  out.append(reinterpret_cast<char*>(&n), 4);
+  for (const auto& v : vals) {
+    uint64_t len = v.size();
+    out.append(reinterpret_cast<char*>(&len), 8);
+    out.append(reinterpret_cast<const char*>(v.data()), v.size());
+  }
+  return writeFull(fd, out.data(), out.size());
+}
+
+}  // namespace
+
+TcpStoreServer::TcpStoreServer(const std::string& host, uint16_t port) {
+  auto addr = transport::resolve(host, port);
+  listenFd_ = socket(addr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  TC_ENFORCE_GE(listenFd_, 0, "socket: ", strerror(errno));
+  transport::setReuseAddr(listenFd_);
+  TC_ENFORCE_EQ(bind(listenFd_, addr.sa(), addr.len), 0,
+                "TcpStoreServer bind: ", strerror(errno));
+  TC_ENFORCE_EQ(listen(listenFd_, 512), 0, "listen: ", strerror(errno));
+  transport::SockAddr bound;
+  bound.len = sizeof(bound.ss);
+  getsockname(listenFd_, bound.sa(), &bound.len);
+  if (bound.sa()->sa_family == AF_INET) {
+    port_ = ntohs(reinterpret_cast<sockaddr_in*>(&bound.ss)->sin_port);
+  } else {
+    port_ = ntohs(reinterpret_cast<sockaddr_in6*>(&bound.ss)->sin6_port);
+  }
+  acceptThread_ = std::thread([this] { acceptLoop(); });
+}
+
+TcpStoreServer::~TcpStoreServer() {
+  stop_.store(true);
+  // Unblock accept() and any server-side waits.
+  ::shutdown(listenFd_, SHUT_RDWR);
+  cv_.notify_all();
+  acceptThread_.join();
+  ::close(listenFd_);
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> guard(threadsMu_);
+    threads.swap(clientThreads_);
+    // Client handler threads may be blocked in read() on connections their
+    // clients still hold open; shut the sockets down so the joins return.
+    for (int fd : clientFds_) {
+      ::shutdown(fd, SHUT_RDWR);
+    }
+    clientFds_.clear();
+  }
+  for (auto& t : threads) {
+    t.join();
+  }
+}
+
+void TcpStoreServer::acceptLoop() {
+  while (!stop_.load()) {
+    int fd = accept4(listenFd_, nullptr, nullptr, SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EINTR) {
+        continue;
+      }
+      return;  // listener shut down
+    }
+    transport::setNoDelay(fd);
+    std::lock_guard<std::mutex> guard(threadsMu_);
+    clientFds_.push_back(fd);
+    clientThreads_.emplace_back([this, fd] { serveClient(fd); });
+  }
+}
+
+void TcpStoreServer::serveClient(int fd) {
+  while (!stop_.load()) {
+    uint8_t op;
+    uint32_t nkeys;
+    if (!readValue(fd, &op) || !readValue(fd, &nkeys) || nkeys > 65536) {
+      break;
+    }
+    std::vector<std::string> keys(nkeys);
+    bool ok = true;
+    for (auto& key : keys) {
+      uint32_t klen;
+      if (!readValue(fd, &klen) || klen > (1u << 20)) {
+        ok = false;
+        break;
+      }
+      key.resize(klen);
+      if (klen > 0 && !readFull(fd, key.data(), klen)) {
+        ok = false;
+        break;
+      }
+    }
+    if (!ok) {
+      break;
+    }
+
+    switch (op) {
+      case kSet: {
+        std::vector<uint8_t> val;
+        if (nkeys != 1 || !readBlob(fd, &val)) {
+          ok = false;
+          break;
+        }
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          map_[keys[0]] = std::move(val);
+        }
+        cv_.notify_all();
+        ok = writeResponse(fd, kOk, {});
+        break;
+      }
+      case kTryGet: {
+        if (nkeys != 1) {
+          writeResponse(fd, kBadRequest, {});
+          ok = false;
+          break;
+        }
+        std::lock_guard<std::mutex> guard(mu_);
+        auto it = map_.find(keys[0]);
+        if (it == map_.end()) {
+          ok = writeResponse(fd, kMissing, {});
+        } else {
+          ok = writeResponse(fd, kOk, {it->second});
+        }
+        break;
+      }
+      case kWaitGet:
+      case kMultiGet: {
+        uint64_t timeoutMs;
+        // kWaitGet is single-key; kMultiGet accepts zero keys (a size-1
+        // bootstrap legitimately asks for nothing).
+        if ((op == kWaitGet && nkeys != 1) || !readValue(fd, &timeoutMs)) {
+          ok = false;
+          break;
+        }
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeoutMs);
+        std::unique_lock<std::mutex> lock(mu_);
+        bool all = cv_.wait_until(lock, deadline, [&] {
+          if (stop_.load()) {
+            return true;
+          }
+          for (const auto& key : keys) {
+            if (map_.find(key) == map_.end()) {
+              return false;
+            }
+          }
+          return true;
+        });
+        if (!all || stop_.load()) {
+          lock.unlock();
+          ok = writeResponse(fd, kTimeout, {});
+        } else {
+          std::vector<Store::Buf> vals;
+          vals.reserve(keys.size());
+          for (const auto& key : keys) {
+            vals.push_back(map_[key]);
+          }
+          lock.unlock();
+          ok = writeResponse(fd, kOk, vals);
+        }
+        break;
+      }
+      case kAdd: {
+        int64_t delta;
+        if (nkeys != 1 || !readValue(fd, &delta)) {
+          ok = false;
+          break;
+        }
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          int64_t current = 0;
+          auto it = map_.find(keys[0]);
+          if (it != map_.end() && it->second.size() == sizeof(int64_t)) {
+            std::memcpy(&current, it->second.data(), sizeof(current));
+          }
+          result = current + delta;
+          Store::Buf buf(sizeof(result));
+          std::memcpy(buf.data(), &result, sizeof(result));
+          map_[keys[0]] = std::move(buf);
+        }
+        cv_.notify_all();
+        Store::Buf out(sizeof(result));
+        std::memcpy(out.data(), &result, sizeof(result));
+        ok = writeResponse(fd, kOk, {out});
+        break;
+      }
+      case kCheck: {
+        bool all = true;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          for (const auto& key : keys) {
+            if (map_.find(key) == map_.end()) {
+              all = false;
+              break;
+            }
+          }
+        }
+        ok = writeResponse(fd, all ? kOk : kMissing, {});
+        break;
+      }
+      default:
+        writeResponse(fd, kBadRequest, {});
+        ok = false;
+    }
+    if (!ok) {
+      break;
+    }
+  }
+  // Drop our registration before closing: the destructor must never
+  // shutdown() an fd number the kernel may have reused.
+  {
+    std::lock_guard<std::mutex> guard(threadsMu_);
+    for (auto it = clientFds_.begin(); it != clientFds_.end(); ++it) {
+      if (*it == fd) {
+        clientFds_.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+// ---- client ----
+
+TcpStore::TcpStore(const std::string& host, uint16_t port) {
+  auto addr = transport::resolve(host, port);
+  fd_ = socket(addr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  TC_ENFORCE_GE(fd_, 0, "socket: ", strerror(errno));
+  // Bounded retry: the server (typically rank 0) may come up after us.
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(30);
+  while (connect(fd_, addr.sa(), addr.len) != 0) {
+    if (errno != ECONNREFUSED && errno != EINTR) {
+      TC_THROW(IoException, "TcpStore connect to ", addr.str(), ": ",
+               strerror(errno));
+    }
+    if (std::chrono::steady_clock::now() >= deadline) {
+      TC_THROW(TimeoutException, "TcpStore connect to ", addr.str(),
+               " timed out");
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  transport::setNoDelay(fd_);
+}
+
+TcpStore::~TcpStore() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+  }
+}
+
+std::pair<uint8_t, std::vector<Store::Buf>> TcpStore::roundTrip(
+    uint8_t op, const std::vector<std::string>& keys,
+    const std::vector<Buf>& payload) {
+  std::lock_guard<std::mutex> guard(mu_);
+  std::string req;
+  req.push_back(static_cast<char>(op));
+  uint32_t nkeys = static_cast<uint32_t>(keys.size());
+  req.append(reinterpret_cast<char*>(&nkeys), 4);
+  for (const auto& key : keys) {
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    req.append(reinterpret_cast<char*>(&klen), 4);
+    req.append(key);
+  }
+  for (const auto& p : payload) {
+    req.append(reinterpret_cast<const char*>(p.data()), p.size());
+  }
+  TC_ENFORCE(writeFull(fd_, req.data(), req.size()),
+             "TcpStore request failed: ", strerror(errno));
+  uint8_t status;
+  uint32_t nvals;
+  if (!readValue(fd_, &status) || !readValue(fd_, &nvals)) {
+    TC_THROW(IoException, "TcpStore connection lost");
+  }
+  std::vector<Buf> vals(nvals);
+  for (auto& v : vals) {
+    if (!readBlob(fd_, &v)) {
+      TC_THROW(IoException, "TcpStore connection lost mid-response");
+    }
+  }
+  return {status, std::move(vals)};
+}
+
+namespace {
+Store::Buf packU64(uint64_t v) {
+  Store::Buf buf(8);
+  std::memcpy(buf.data(), &v, 8);
+  return buf;
+}
+}  // namespace
+
+void TcpStore::set(const std::string& key, const Buf& value) {
+  Buf payload(8 + value.size());
+  uint64_t len = value.size();
+  std::memcpy(payload.data(), &len, 8);
+  std::memcpy(payload.data() + 8, value.data(), value.size());
+  auto [status, vals] = roundTrip(kSet, {key}, {payload});
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore set failed");
+}
+
+Store::Buf TcpStore::get(const std::string& key,
+                         std::chrono::milliseconds timeout) {
+  auto [status, vals] =
+      roundTrip(kWaitGet, {key}, {packU64(timeout.count())});
+  if (status == kTimeout) {
+    TC_THROW(TimeoutException, "TcpStore::get timed out on key '", key, "'");
+  }
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore get failed");
+  TC_ENFORCE_EQ(vals.size(), size_t(1));
+  return vals[0];
+}
+
+bool TcpStore::check(const std::vector<std::string>& keys) {
+  auto [status, vals] = roundTrip(kCheck, keys, {});
+  return status == kOk;
+}
+
+int64_t TcpStore::add(const std::string& key, int64_t delta) {
+  Buf payload(8);
+  std::memcpy(payload.data(), &delta, 8);
+  auto [status, vals] = roundTrip(kAdd, {key}, {payload});
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore add failed");
+  TC_ENFORCE_EQ(vals.size(), size_t(1));
+  int64_t result;
+  std::memcpy(&result, vals[0].data(), 8);
+  return result;
+}
+
+std::vector<Store::Buf> TcpStore::multiGet(
+    const std::vector<std::string>& keys,
+    std::chrono::milliseconds timeout) {
+  auto [status, vals] =
+      roundTrip(kMultiGet, keys, {packU64(timeout.count())});
+  if (status == kTimeout) {
+    TC_THROW(TimeoutException, "TcpStore::multiGet timed out");
+  }
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore multiGet failed");
+  return vals;
+}
+
+}  // namespace tpucoll

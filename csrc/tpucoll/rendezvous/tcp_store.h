@@ -1,0 +1,80 @@
+// TCPStore: a self-contained key/value rendezvous service.
+//
+// Replaces the reference's RedisStore (gloo/rendezvous/redis_store.cc) with
+// a dependency-free server any rank (conventionally rank 0) can host —
+// the pattern modern frameworks bootstrap from. Implements the full Store
+// contract including blocking waits (server-side, no client polling),
+// atomic counters, and batched multiGet (the store-v2 batching the
+// reference gates behind GLOO_ENABLE_STORE_V2_API).
+//
+// Wire protocol (all integers little-endian):
+//   request:  [u8 op][u32 nkeys] then per key [u32 klen][key bytes],
+//             then op-specific payload
+//   response: [u8 status][u32 nvals] then per val [u64 vlen][bytes]
+// Ops: kSet(1, 1 key + 1 val), kTryGet(2), kWaitGet(3, payload u64
+// timeout_ms), kAdd(4, payload i64 delta -> returns 8-byte value),
+// kCheck(5, n keys -> status 0 iff all exist), kMultiGet(6, n keys with
+// u64 timeout_ms payload).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "tpucoll/rendezvous/store.h"
+
+namespace tpucoll {
+
+class TcpStoreServer {
+ public:
+  // Binds host:port (port 0 = ephemeral; read back via port()).
+  explicit TcpStoreServer(const std::string& host, uint16_t port = 0);
+  ~TcpStoreServer();
+
+  uint16_t port() const { return port_; }
+
+ private:
+  void acceptLoop();
+  void serveClient(int fd);
+
+  int listenFd_{-1};
+  uint16_t port_{0};
+  std::atomic<bool> stop_{false};
+  std::thread acceptThread_;
+  std::mutex threadsMu_;
+  std::vector<std::thread> clientThreads_;
+  std::vector<int> clientFds_;  // guarded by threadsMu_
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, Store::Buf> map_;
+};
+
+class TcpStore : public Store {
+ public:
+  TcpStore(const std::string& host, uint16_t port);
+  ~TcpStore() override;
+
+  void set(const std::string& key, const Buf& value) override;
+  Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
+  bool check(const std::vector<std::string>& keys) override;
+  int64_t add(const std::string& key, int64_t delta) override;
+  std::vector<Buf> multiGet(const std::vector<std::string>& keys,
+                            std::chrono::milliseconds timeout) override;
+
+ private:
+  // One request/response round trip (client socket is serialized).
+  std::pair<uint8_t, std::vector<Buf>> roundTrip(
+      uint8_t op, const std::vector<std::string>& keys,
+      const std::vector<Buf>& payload);
+
+  std::mutex mu_;
+  int fd_{-1};
+};
+
+}  // namespace tpucoll

@@ -87,7 +87,7 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
   const char* p = reinterpret_cast<const char*>(&hello);
   size_t sent = 0;
   while (sent < sizeof(hello)) {
-    ssize_t n = write(fd, p + sent, sizeof(hello) - sent);
+    ssize_t n = ::send(fd, p + sent, sizeof(hello) - sent, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
         pollfd pfd{fd, POLLOUT, 0};
@@ -236,7 +236,14 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
       iov[iovcnt].iov_len = op.nbytes - op.dataSent;
       iovcnt++;
     }
-    ssize_t n = iovcnt > 0 ? writev(fd_, iov, iovcnt) : 0;
+    ssize_t n = 0;
+    if (iovcnt > 0) {
+      msghdr msg{};
+      msg.msg_iov = iov;
+      msg.msg_iovlen = iovcnt;
+      // MSG_NOSIGNAL: broken pipes become errors, never SIGPIPE.
+      n = sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    }
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
         break;

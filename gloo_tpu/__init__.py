@@ -24,6 +24,8 @@ from gloo_tpu.core import (
     PrefixStore,
     ReduceOp,
     Store,
+    TcpStore,
+    TcpStoreServer,
     TimeoutError,
     UnboundBuffer,
 )
@@ -41,6 +43,8 @@ __all__ = [
     "PrefixStore",
     "ReduceOp",
     "Store",
+    "TcpStore",
+    "TcpStoreServer",
     "TimeoutError",
     "UnboundBuffer",
     "__version__",

@@ -68,6 +68,10 @@ _PROTOTYPES = {
     "tc_hash_store_new": (_c, []),
     "tc_file_store_new": (_c, [ctypes.c_char_p]),
     "tc_prefix_store_new": (_c, [_c, ctypes.c_char_p]),
+    "tc_tcp_store_server_new": (_c, [ctypes.c_char_p, ctypes.c_uint16]),
+    "tc_tcp_store_server_port": (ctypes.c_uint16, [_c]),
+    "tc_tcp_store_server_free": (None, [_c]),
+    "tc_tcp_store_new": (_c, [ctypes.c_char_p, ctypes.c_uint16]),
     "tc_store_free": (None, [_c]),
     "tc_store_set": (_int, [_c, ctypes.c_char_p,
                             ctypes.POINTER(ctypes.c_uint8), _sz]),
@@ -89,7 +93,8 @@ _PROTOTYPES = {
     # collectives
     "tc_barrier": (_int, [_c, _u32, _i64]),
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),
-    "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32,
+                            _i64]),
     "tc_reduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32, _i64]),
     "tc_gather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_gatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _int,

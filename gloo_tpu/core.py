@@ -27,6 +27,8 @@ __all__ = [
     "PrefixStore",
     "ReduceOp",
     "Store",
+    "TcpStore",
+    "TcpStoreServer",
     "TimeoutError",
     "UnboundBuffer",
 ]
@@ -92,6 +94,11 @@ def _timeout_ms(timeout: Optional[float]) -> int:
 class Store:
     """Base rendezvous store handle."""
 
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
+
     def __init__(self, handle: int):
         self._handle = handle
         # Bound at construction: module globals may already be cleared when
@@ -153,8 +160,41 @@ class PrefixStore(Store):
         self._base = base  # keep the base handle alive
 
 
+class TcpStoreServer:
+    """Hosts the rendezvous key/value service (typically on rank 0)."""
+
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._handle = check_handle(
+            _lib.lib.tc_tcp_store_server_new(host.encode(), port))
+        self.port = _lib.lib.tc_tcp_store_server_port(self._handle)
+        self._free = _lib.lib.tc_tcp_store_server_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+
+
+class TcpStore(Store):
+    """Client for a TcpStoreServer; retries while the server comes up."""
+
+    def __init__(self, host: str, port: int):
+        super().__init__(
+            check_handle(_lib.lib.tc_tcp_store_new(host.encode(), port)))
+
+
 class Device:
     """Transport endpoint: epoll loop thread + shared listener."""
+
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
 
     def __init__(self, hostname: str = "127.0.0.1", port: int = 0):
         self._handle = check_handle(
@@ -169,6 +209,11 @@ class Device:
 
 class UnboundBuffer:
     """Registered region for tagged point-to-point send/recv."""
+
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
 
     def __init__(self, context: "Context", array: np.ndarray):
         _check_array(array)
@@ -237,6 +282,11 @@ class Context:
     collectives on one context need distinct tags.
     """
 
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
+
     def __init__(self, rank: int, size: int, timeout: float = 30.0):
         self.rank = rank
         self.size = size
@@ -290,13 +340,21 @@ class Context:
                                     _timeout_ms(timeout)))
         return array
 
-    def allreduce(self, array: np.ndarray, op="sum", tag: int = 0,
+    _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2}
+
+    def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
+                  tag: int = 0,
                   timeout: Optional[float] = None) -> np.ndarray:
-        """In-place allreduce of `array` across the group."""
+        """In-place allreduce of `array` across the group.
+
+        algorithm: "auto" (ring for large payloads, halving-doubling for
+        small), "ring", or "halving_doubling".
+        """
         _check_array(array)
         check(_lib.lib.tc_allreduce(self._handle, _ptr(array), _ptr(array),
                                     array.size, _dtype_code(array),
-                                    ReduceOp.parse(op), tag,
+                                    ReduceOp.parse(op),
+                                    self._ALGORITHMS[algorithm], tag,
                                     _timeout_ms(timeout)))
         return array
 

@@ -18,18 +18,35 @@ def fixture(rank, count, dtype):
     return vals.astype(dtype)
 
 
+@pytest.mark.parametrize("algorithm", ["ring", "halving_doubling"])
 @pytest.mark.parametrize("size", SIZES)
 @pytest.mark.parametrize("count", COUNTS)
-def test_allreduce_sum(size, count):
+def test_allreduce_sum(size, count, algorithm):
     def fn(ctx, rank):
         x = fixture(rank, count, np.float32)
-        ctx.allreduce(x)
+        ctx.allreduce(x, algorithm=algorithm)
         return x
 
     results = spawn(size, fn)
     expected = sum(fixture(r, count, np.float64) for r in range(size))
     for got in results:
         np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("size", [2, 3, 5, 6, 7, 8])
+def test_allreduce_hd_nonpow2(size):
+    """Halving-doubling with the fold path on non-power-of-2 groups."""
+    count = 4097  # also exercises uneven block windows
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float64)
+        ctx.allreduce(x, algorithm="halving_doubling")
+        return x
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=1e-12)
 
 
 @pytest.mark.parametrize("dtype,rtol", [

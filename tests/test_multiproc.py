@@ -1,0 +1,136 @@
+"""Mode-2 tests: real child processes rendezvousing over a FileStore, with
+real failure injection (reference analog: gloo/test/multiproc_test.h:29-133
+and transport_test.cc IoErrors/IoTimeouts — kill a rank, assert peers fail
+fast with an IoError instead of hanging)."""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_worker(body: str, rank: int, size: int, store: str):
+    """Launch a child process running `body` with ctx/rank/size bound."""
+    prog = textwrap.dedent("""
+        import os, signal, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = {rank}; size = {size}
+        store = gloo_tpu.FileStore({store!r})
+        ctx = gloo_tpu.Context(rank, size, timeout=10.0)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+    """).format(repo=_REPO, rank=rank, size=size, store=store) + \
+        textwrap.dedent(body)
+    return subprocess.Popen([sys.executable, "-c", prog],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+KILL_BODY = """
+if rank == 1:
+    os.kill(os.getpid(), signal.SIGKILL)
+x = np.ones(1 << 20, dtype=np.float32)
+t0 = time.monotonic()
+try:
+    ctx.allreduce(x)
+    print("UNEXPECTED-SUCCESS")
+    sys.exit(3)
+except gloo_tpu.IoError:
+    elapsed = time.monotonic() - t0
+    print(f"IOERROR {elapsed:.3f}")
+    sys.exit(10)
+"""
+
+
+def test_peer_killed_mid_collective():
+    """SIGKILL one rank; survivors must exit with IoError well inside the
+    context timeout (fast failure detection, not timeout expiry)."""
+    store = tempfile.mkdtemp()
+    procs = [_spawn_worker(KILL_BODY, r, 3, store) for r in range(3)]
+    outs = [p.communicate(timeout=60) for p in procs]
+    codes = [p.returncode for p in procs]
+    assert codes[1] == -signal.SIGKILL
+    for r in (0, 2):
+        assert codes[r] == 10, (r, codes[r], outs[r])
+        line = [l for l in outs[r][0].splitlines() if l.startswith("IOERROR")]
+        assert line, outs[r]
+        elapsed = float(line[0].split()[1])
+        assert elapsed < 5.0, f"rank {r} took {elapsed}s to detect failure"
+
+
+TIMEOUT_BODY = """
+if rank == 1:
+    time.sleep(6)     # miss the collective entirely, then exit cleanly
+    sys.exit(0)
+x = np.ones(4, dtype=np.float32)
+t0 = time.monotonic()
+try:
+    ctx.allreduce(x, timeout=2.0)
+    print("UNEXPECTED-SUCCESS"); sys.exit(3)
+except gloo_tpu.TimeoutError:
+    print(f"TIMEOUT {time.monotonic()-t0:.3f}"); sys.exit(11)
+except gloo_tpu.IoError:
+    print(f"IOERROR {time.monotonic()-t0:.3f}"); sys.exit(12)
+"""
+
+
+def test_slow_peer_hits_op_timeout():
+    """A peer that never enters the collective must trip the per-op timeout
+    (reference analog: allreduce_test.cc timeout tests)."""
+    store = tempfile.mkdtemp()
+    procs = [_spawn_worker(TIMEOUT_BODY, r, 2, store) for r in range(2)]
+    outs = [p.communicate(timeout=60) for p in procs]
+    assert procs[1].returncode == 0, outs[1]
+    assert procs[0].returncode == 11, outs[0]
+    line = outs[0][0].splitlines()[0]
+    elapsed = float(line.split()[1])
+    assert 1.5 < elapsed < 4.0, f"timeout fired at {elapsed}s, wanted ~2s"
+
+
+CLEAN_EXIT_BODY = """
+x = np.full(1000, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x)
+expected = size * (size + 1) / 2
+assert x[0] == expected, x[0]
+ctx.close()
+print("OK")
+"""
+
+
+def test_multiproc_clean_run():
+    store = tempfile.mkdtemp()
+    procs = [_spawn_worker(CLEAN_EXIT_BODY, r, 4, store) for r in range(4)]
+    outs = [p.communicate(timeout=60) for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "OK" in out[0]
+
+
+def test_peer_killed_during_bootstrap():
+    """Death before rendezvous (rank 1 never starts): survivors must fail
+    connect_full_mesh with a timeout."""
+    store = tempfile.mkdtemp()
+    prog = textwrap.dedent("""
+        import os, sys, time
+        sys.path.insert(0, {repo!r})
+        import gloo_tpu
+        store = gloo_tpu.FileStore({store!r})
+        ctx = gloo_tpu.Context(0, 2, timeout=2.0)
+        try:
+            ctx.connect_full_mesh(store, gloo_tpu.Device())
+            print("UNEXPECTED-CONNECT"); sys.exit(3)
+        except gloo_tpu.TimeoutError:
+            print("BOOTSTRAP-TIMEOUT"); sys.exit(10)
+    """).format(repo=_REPO, store=store)
+    p = subprocess.Popen([sys.executable, "-c", prog], stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    out, err = p.communicate(timeout=60)
+    assert p.returncode == 10, (out, err)
