@@ -203,6 +203,22 @@ uint64_t tc_next_slot(void* ctx, uint32_t num) {
   return asContext(ctx)->nextSlot(num);
 }
 
+void tc_trace_start(void* ctx) { asContext(ctx)->tracer().start(); }
+
+void tc_trace_stop(void* ctx) { asContext(ctx)->tracer().stop(); }
+
+// Returns a malloc'd JSON string (Chrome trace-event format); caller frees
+// with tc_buf_free.
+int tc_trace_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    Context* c = asContext(ctx);
+    std::string json = c->tracer().toJson(c->rank());
+    *outLen = json.size();
+    *out = static_cast<uint8_t*>(malloc(json.size()));
+    std::memcpy(*out, json.data(), json.size());
+  });
+}
+
 // ---- collectives ----
 
 int tc_barrier(void* ctx, uint32_t tag, int64_t timeoutMs) {

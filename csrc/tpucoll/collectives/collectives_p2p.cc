@@ -21,6 +21,7 @@ const char* bytePtr(const void* p) { return static_cast<const char*>(p); }
 void barrier(BarrierOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "barrier: null context");
+  auto traceSpan = ctx->tracer().span("barrier");
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -46,6 +47,7 @@ void barrier(BarrierOptions& opts) {
 void broadcast(BroadcastOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "broadcast: null context");
+  auto traceSpan = ctx->tracer().span("broadcast", opts.count * elementSize(opts.dtype), opts.root);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -100,6 +102,7 @@ void gather(GatherOptions& opts) {
 void gatherv(GathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "gatherv: null context");
+  auto traceSpan = ctx->tracer().span("gatherv", 0, opts.root);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -143,6 +146,7 @@ void gatherv(GathervOptions& opts) {
 void scatter(ScatterOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "scatter: null context");
+  auto traceSpan = ctx->tracer().span("scatter", opts.count * elementSize(opts.dtype), opts.root);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -188,6 +192,7 @@ void alltoall(AlltoallOptions& opts) {
 void alltoallv(AlltoallvOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "alltoallv: null context");
+  auto traceSpan = ctx->tracer().span("alltoallv");
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();

@@ -189,6 +189,7 @@ void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
 void allgatherv(AllgathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "allgatherv: null context");
+  auto traceSpan = ctx->tracer().span("allgatherv");
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -258,6 +259,9 @@ void allreduce(AllreduceOptions& opts) {
       algo = nbytes <= (1 << 20) ? AllreduceAlgorithm::kHalvingDoubling
                                  : AllreduceAlgorithm::kRing;
     }
+    auto traceSpan = ctx->tracer().span(
+        "allreduce", nbytes, -1,
+        algo == AllreduceAlgorithm::kRing ? "ring" : "halving_doubling");
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -307,6 +311,7 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 void reduce(ReduceOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "reduce: null context");
+  auto traceSpan = ctx->tracer().span("reduce", opts.count * elementSize(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -365,6 +370,7 @@ void reduce(ReduceOptions& opts) {
 void reduceScatter(ReduceScatterOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "reduceScatter: null context");
+  auto traceSpan = ctx->tracer().span("reduce_scatter");
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();

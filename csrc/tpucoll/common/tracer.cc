@@ -1,0 +1,40 @@
+#include "tpucoll/common/tracer.h"
+
+#include <sstream>
+
+namespace tpucoll {
+
+std::string Tracer::toJson(int pid, bool drain) {
+  std::vector<Event> events;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (drain) {
+      events.swap(events_);
+    } else {
+      events = events_;
+    }
+  }
+  std::ostringstream out;
+  out << "[";
+  bool first = true;
+  for (const auto& e : events) {
+    if (!first) {
+      out << ",\n";
+    }
+    first = false;
+    out << "{\"name\":\"" << e.name << "\",\"ph\":\"X\",\"ts\":" << e.startUs
+        << ",\"dur\":" << (e.endUs - e.startUs) << ",\"pid\":" << pid
+        << ",\"tid\":0,\"args\":{\"bytes\":" << e.bytes;
+    if (e.peer >= 0) {
+      out << ",\"peer\":" << e.peer;
+    }
+    if (e.detail != nullptr) {
+      out << ",\"detail\":\"" << e.detail << "\"";
+    }
+    out << "}}";
+  }
+  out << "]";
+  return out.str();
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,91 @@
+// First-class tracing for the host data plane.
+//
+// The reference has no tracer (SURVEY.md §5: its only introspection is the
+// benchmark harness); this is a deliberate capability addition. Each
+// Context owns a Tracer; when enabled it records one span per collective /
+// p2p wait with wall-clock bounds and payload metadata, and dumps Chrome
+// trace-event JSON loadable in Perfetto / chrome://tracing alongside a
+// jax profiler trace from the device plane.
+//
+// Overhead when disabled: one relaxed atomic load per span.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+
+class Tracer {
+ public:
+  struct Event {
+    const char* name;     // static string (collective name)
+    int64_t startUs;
+    int64_t endUs;
+    uint64_t bytes;
+    int peer;             // -1 for collectives
+    const char* detail;   // static string (algorithm etc.), may be null
+  };
+
+  void start() { enabled_.store(true, std::memory_order_relaxed); }
+  void stop() { enabled_.store(false, std::memory_order_relaxed); }
+  bool enabled() const {
+    return enabled_.load(std::memory_order_relaxed);
+  }
+
+  // RAII span: records on destruction if the tracer was enabled at
+  // construction.
+  class Span {
+   public:
+    Span() = default;
+    Span(Tracer* tracer, const char* name, uint64_t bytes, int peer,
+         const char* detail)
+        : tracer_(tracer),
+          event_{name, nowUs(), 0, bytes, peer, detail} {}
+    ~Span() {
+      if (tracer_ != nullptr) {
+        event_.endUs = nowUs();
+        tracer_->record(event_);
+      }
+    }
+    Span(const Span&) = delete;
+    Span& operator=(const Span&) = delete;
+
+   private:
+    Tracer* tracer_{nullptr};
+    Event event_{};
+  };
+
+  Span span(const char* name, uint64_t bytes = 0, int peer = -1,
+            const char* detail = nullptr) {
+    if (!enabled()) {
+      return Span();
+    }
+    return Span(this, name, bytes, peer, detail);
+  }
+
+  void record(const Event& event) {
+    std::lock_guard<std::mutex> guard(mu_);
+    events_.push_back(event);
+  }
+
+  // Serialize to Chrome trace-event JSON. `pid` labels this process's
+  // lane (use the rank). Clears recorded events when `drain` is true.
+  std::string toJson(int pid, bool drain = true);
+
+  static int64_t nowUs() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+ private:
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::vector<Event> events_;
+};
+
+}  // namespace tpucoll

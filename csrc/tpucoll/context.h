@@ -10,6 +10,7 @@
 #include <mutex>
 #include <vector>
 
+#include "tpucoll/common/tracer.h"
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/transport/context.h"
 #include "tpucoll/transport/device.h"
@@ -63,6 +64,10 @@ class Context {
 
   transport::Context* transport() const { return tctx_.get(); }
 
+  // First-class tracing (capability the reference lacks): start(), run
+  // collectives, then dump Chrome trace-event JSON via traceJson().
+  Tracer& tracer() { return tracer_; }
+
   void close();
 
  private:
@@ -76,6 +81,7 @@ class Context {
 
   std::mutex scratchMu_;
   std::vector<std::vector<char>> scratchPool_;
+  Tracer tracer_;
 };
 
 }  // namespace tpucoll

@@ -1,0 +1,71 @@
+"""Multi-process data-parallel training over the host plane.
+
+The reference's example1 pattern (env-var bootstrap + store rendezvous),
+driving SURVEY §7 M2: a jax MLP trained data-parallel with gradient
+averaging through the framework's own C++ allreduce.
+
+Run (4 processes on one host):
+    for R in 0 1 2 3; do
+        RANK=$R SIZE=4 STORE=tcp:127.0.0.1:29500 SERVE=$([ $R = 0 ] && echo 1) \
+            python examples/example_host_ddp.py &
+    done; wait
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+import optax
+
+import gloo_tpu
+from gloo_tpu.models import MLP
+from gloo_tpu.parallel import HostGradSync
+
+
+def make_store():
+    spec = os.environ.get("STORE", "tcp:127.0.0.1:29500")
+    if spec.startswith("file:"):
+        return gloo_tpu.FileStore(spec[5:]), None
+    host, port = spec[4:].rsplit(":", 1)
+    server = None
+    if os.environ.get("SERVE"):
+        server = gloo_tpu.TcpStoreServer("0.0.0.0", int(port))
+    return gloo_tpu.TcpStore(host, int(port)), server
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    size = int(os.environ["SIZE"])
+    store, server = make_store()
+    ctx = gloo_tpu.Context(rank, size, timeout=30.0)
+    ctx.connect_full_mesh(store, gloo_tpu.Device())
+    sync = HostGradSync(ctx)
+
+    model = MLP([16, 64, 1])
+    params = model.init(jax.random.PRNGKey(0))  # same seed: same init
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    grad_fn = jax.jit(jax.value_and_grad(model.loss))
+
+    rng = np.random.RandomState(1000 + rank)  # each rank its own shard
+    for step in range(50):
+        x = rng.randn(32, 16).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True) * 0.1
+        loss, grads = grad_fn(params, (x, y))
+        grads = sync.average(grads)  # <-- the framework's C++ allreduce
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if rank == 0 and step % 10 == 0:
+            print(f"step {step:3d} loss {float(loss):.4f}")
+
+    ctx.barrier()
+    ctx.close()
+    if rank == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,10 @@ _PROTOTYPES = {
     "tc_context_close": (_int, [_c]),
     "tc_context_free": (None, [_c]),
     "tc_next_slot": (_u64, [_c, _u32]),
+    "tc_trace_start": (None, [_c]),
+    "tc_trace_stop": (None, [_c]),
+    "tc_trace_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # collectives
     "tc_barrier": (_int, [_c, _u32, _i64]),
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),

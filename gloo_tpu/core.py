@@ -324,6 +324,32 @@ class Context:
     def next_slot(self, num: int = 1) -> int:
         return _lib.lib.tc_next_slot(self._handle, num)
 
+    # ---- tracing (capability the reference lacks) ----
+
+    def trace_start(self) -> None:
+        """Begin recording one span per collective on this context."""
+        _lib.lib.tc_trace_start(self._handle)
+
+    def trace_stop(self) -> None:
+        _lib.lib.tc_trace_stop(self._handle)
+
+    def trace_json(self) -> str:
+        """Drain recorded spans as Chrome trace-event JSON (load the file
+        in Perfetto / chrome://tracing; merge ranks by concatenating their
+        event arrays)."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        check(_lib.lib.tc_trace_json(self._handle, ctypes.byref(out),
+                                     ctypes.byref(out_len)))
+        try:
+            return bytes(bytearray(out[: out_len.value])).decode()
+        finally:
+            _lib.lib.tc_buf_free(out)
+
+    def trace_dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.trace_json())
+
     def register(self, array: np.ndarray) -> UnboundBuffer:
         return UnboundBuffer(self, array)
 

@@ -1,0 +1,3 @@
+from gloo_tpu.utils.tracing import device_trace, merge_traces
+
+__all__ = ["device_trace", "merge_traces"]

@@ -1,0 +1,60 @@
+"""Tracing subsystem: host-plane collective spans + merged timelines."""
+
+import json
+
+import numpy as np
+
+from gloo_tpu.utils import merge_traces
+from tests.harness import spawn
+
+
+def test_collective_spans_recorded():
+    size = 2
+
+    def fn(ctx, rank):
+        ctx.trace_start()
+        x = np.ones(1000, dtype=np.float32)
+        ctx.allreduce(x)
+        ctx.broadcast(x, root=0)
+        ctx.barrier()
+        ctx.trace_stop()
+        ctx.allreduce(x)  # after stop: must not be recorded
+        return ctx.trace_json()
+
+    results = spawn(size, fn)
+    for rank, doc in enumerate(results):
+        events = json.loads(doc)
+        names = [e["name"] for e in events]
+        assert names == ["allreduce", "broadcast", "barrier"], names
+        for e in events:
+            assert e["ph"] == "X"
+            assert e["dur"] >= 0
+            assert e["pid"] == rank
+        assert events[0]["args"]["bytes"] == 4000
+        assert events[0]["args"]["detail"] in ("ring", "halving_doubling")
+        assert events[1]["args"]["peer"] == 0  # broadcast root
+
+
+def test_trace_drains():
+    def fn(ctx, rank):
+        ctx.trace_start()
+        ctx.barrier()
+        first = ctx.trace_json()
+        second = ctx.trace_json()
+        return json.loads(first), json.loads(second)
+
+    first, second = spawn(2, fn)[0]
+    assert len(first) == 1
+    assert second == []
+
+
+def test_merge_traces():
+    def fn(ctx, rank):
+        ctx.trace_start()
+        ctx.barrier()
+        return ctx.trace_json()
+
+    docs = spawn(2, fn)
+    merged = json.loads(merge_traces(docs))
+    assert len(merged) == 2
+    assert sorted(e["pid"] for e in merged) == [0, 1]
