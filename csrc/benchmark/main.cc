@@ -164,7 +164,8 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
   Workload w;
   w.algBytes = elements * sizeof(float);
 
-  auto algo = o.algorithm == "ring" ? AllreduceAlgorithm::kRing
+  auto algo = o.algorithm == "ring"    ? AllreduceAlgorithm::kRing
+              : o.algorithm == "bcube" ? AllreduceAlgorithm::kBcube
               : (o.algorithm == "hd" || o.algorithm == "halving_doubling")
                   ? AllreduceAlgorithm::kHalvingDoubling
                   : AllreduceAlgorithm::kAuto;

@@ -193,6 +193,10 @@ int tc_context_connect(void* ctx, void* store, void* device) {
   });
 }
 
+int tc_context_fork(void* ctx, void* parent, uint32_t tag) {
+  return wrap([&] { asContext(ctx)->forkFrom(*asContext(parent), tag); });
+}
+
 int tc_context_close(void* ctx) {
   return wrap([&] { asContext(ctx)->close(); });
 }

@@ -29,5 +29,13 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,
                               std::chrono::milliseconds timeout);
 
+// Mixed-radix grouped-hypercube (bcube) allreduce: log-depth like
+// halving-doubling but with configurable group fan-out per step; exact
+// schedule for any P via prime factorization (reference analog:
+// gloo/allreduce_bcube.h).
+void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
+                    ReduceFn fn, Slot slot,
+                    std::chrono::milliseconds timeout);
+
 }  // namespace algorithms
 }  // namespace tpucoll

@@ -55,6 +55,7 @@ enum class AllreduceAlgorithm : uint8_t {
   kAuto = 0,
   kRing = 1,
   kHalvingDoubling = 2,
+  kBcube = 3,
 };
 
 struct AllreduceOptions : CollectiveOptions {

@@ -1,0 +1,180 @@
+// Bcube (grouped hypercube) allreduce, generalized to mixed radix.
+//
+// The reference's AllreduceBcube (gloo/allreduce_bcube.h:68-264) factors
+// the group into base-B hypercube stages; this build generalizes the idea
+// to an arbitrary factorization P = G_0 * G_1 * ... * G_{k-1} (prime
+// factors by default), so every rank count gets an exact schedule — no
+// power-of-B restriction and no fold step.
+//
+// Reduce-scatter phase, step s: ranks sharing all mixed-radix digits
+// except digit s form a group of G_s members. The current block window
+// splits into G_s parts; each member keeps the part indexed by its own
+// digit, sends part j to member j, and reduces the G_s - 1 contributions
+// it receives (staged per sender, reduced in arrival order) into its kept
+// part. After k steps each rank holds one fully reduced block. The
+// allgather phase replays the steps in reverse with in-place receives.
+//
+// Latency is sum(G_s - 1) messages per phase over k steps versus the
+// ring's P - 1; bandwidth matches the ring's optimal 2N(P-1)/P.
+#include <cstring>
+#include <unordered_map>
+
+#include "tpucoll/collectives/algorithms.h"
+#include "tpucoll/collectives/detail.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+using collectives_detail::Blocks;
+using collectives_detail::evenBlocks;
+
+namespace {
+
+std::vector<int> primeFactors(int n) {
+  std::vector<int> factors;
+  for (int p = 2; p * p <= n; p++) {
+    while (n % p == 0) {
+      factors.push_back(p);
+      n /= p;
+    }
+  }
+  if (n > 1) {
+    factors.push_back(n);
+  }
+  return factors;
+}
+
+}  // namespace
+
+void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
+                    ReduceFn fn, Slot slot,
+                    std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes = count * elsize;
+  const std::vector<int> radices = primeFactors(size);
+  const int numSteps = static_cast<int>(radices.size());
+
+  Blocks blocks = evenBlocks(count, size, elsize);
+  auto rangeOff = [&](int first) { return blocks.offset[first]; };
+  auto rangeBytes = [&](int first, int n) {
+    return blocks.rangeBytes(first, n);
+  };
+
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  // Per-sender staging can need up to winCount * ceil(count/size) elements
+  // at a step (uneven blocks make one part slightly larger than the
+  // window's average); nbytes + size*elsize safely covers every step.
+  auto scratch = ctx->acquireScratch(nbytes + size * elsize);
+  char* tmp = scratch.data();
+  auto tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
+
+  // Mixed-radix digits of this rank: rank = sum(digit_s * stride_s).
+  std::vector<int> stride(numSteps), digit(numSteps);
+  {
+    int acc = 1;
+    for (int s = 0; s < numSteps; s++) {
+      stride[s] = acc;
+      digit[s] = (rank / acc) % radices[s];
+      acc *= radices[s];
+    }
+  }
+  auto member = [&](int s, int j) {
+    return rank + (j - digit[s]) * stride[s];
+  };
+
+  // (step, senderDigit, phase) -> unique sub-slot.
+  int maxRadix = 2;
+  for (int g : radices) {
+    maxRadix = std::max(maxRadix, g);
+  }
+  auto stepSlot = [&](int phase, int s, int j) {
+    return slot
+        .offset(uint64_t(phase * numSteps + s) * maxRadix + uint64_t(j))
+        .value();
+  };
+
+  // --- reduce-scatter: window narrows by G_s each step ---
+  int winStart = 0;
+  int winCount = size;
+  std::vector<int> winStartAt(numSteps), winCountAt(numSteps);
+  for (int s = 0; s < numSteps; s++) {
+    const int g = radices[s];
+    const int part = winCount / g;
+    winStartAt[s] = winStart;
+    winCountAt[s] = winCount;
+    const int myPartStart = winStart + digit[s] * part;
+    const size_t partBytes = rangeBytes(myPartStart, part);
+
+    // Sends: part j of the window goes to group member j.
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
+      }
+      const int partStart = winStart + j * part;
+      workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
+                    rangeOff(partStart), rangeBytes(partStart, part));
+    }
+    // Receives: each sender's contribution to MY part, staged per sender
+    // (slot j at scratch offset j * partBytes) so concurrent arrivals
+    // never share memory; reduced in arrival order via the source rank.
+    std::unordered_map<int, int> senderDigit;  // src rank -> j
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
+      }
+      senderDigit[member(s, j)] = j;
+      tmpBuf->recv(member(s, j), stepSlot(0, s, j),
+                   size_t(j) * partBytes, partBytes);
+    }
+    for (int n = 0; n < g - 1; n++) {
+      int src = -1;
+      tmpBuf->waitRecv(&src, timeout);
+      const int j = senderDigit.at(src);
+      if (partBytes > 0) {
+        fn(work + rangeOff(myPartStart), tmp + size_t(j) * partBytes,
+           partBytes / elsize);
+      }
+    }
+    for (int n = 0; n < g - 1; n++) {
+      workBuf->waitSend(timeout);
+    }
+    winStart = myPartStart;
+    winCount = part;
+  }
+
+  // --- allgather: replay steps in reverse, windows merge G_s-fold ---
+  for (int s = numSteps - 1; s >= 0; s--) {
+    const int g = radices[s];
+    const int stepWinStart = winStartAt[s];
+    const int part = winCountAt[s] / g;
+    // My current window is part digit[s] of the step-s window; send it to
+    // every group member and receive their parts in place.
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
+      }
+      workBuf->send(member(s, j), stepSlot(1, s, digit[s]),
+                    rangeOff(winStart), rangeBytes(winStart, winCount));
+    }
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
+      }
+      const int partStart = stepWinStart + j * part;
+      workBuf->recv(member(s, j), stepSlot(1, s, j), rangeOff(partStart),
+                    rangeBytes(partStart, part));
+    }
+    for (int n = 0; n < g - 1; n++) {
+      workBuf->waitRecv(nullptr, timeout);
+    }
+    for (int n = 0; n < g - 1; n++) {
+      workBuf->waitSend(timeout);
+    }
+    winStart = stepWinStart;
+    winCount = winCountAt[s];
+  }
+}
+
+}  // namespace algorithms
+}  // namespace tpucoll

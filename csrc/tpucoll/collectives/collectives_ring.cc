@@ -261,7 +261,9 @@ void allreduce(AllreduceOptions& opts) {
     }
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1,
-        algo == AllreduceAlgorithm::kRing ? "ring" : "halving_doubling");
+        algo == AllreduceAlgorithm::kRing    ? "ring"
+        : algo == AllreduceAlgorithm::kBcube ? "bcube"
+                                             : "halving_doubling");
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -270,6 +272,10 @@ void allreduce(AllreduceOptions& opts) {
       case AllreduceAlgorithm::kHalvingDoubling:
         algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
                                              fn, slot, timeout);
+        break;
+      case AllreduceAlgorithm::kBcube:
+        algorithms::bcubeAllreduce(ctx, work, opts.count, elsize, fn, slot,
+                                   timeout);
         break;
       default:
         TC_THROW(EnforceError, "unknown allreduce algorithm");

@@ -1,5 +1,6 @@
 #include "tpucoll/context.h"
 
+#include "tpucoll/collectives/collectives.h"
 #include "tpucoll/types.h"
 
 namespace tpucoll {
@@ -21,6 +22,53 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   device_ = std::move(device);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->connectFullMesh(*store_, timeout_);
+}
+
+void Context::forkFrom(Context& parent, uint32_t tag) {
+  TC_ENFORCE(tctx_ == nullptr, "context already connected");
+  TC_ENFORCE_EQ(rank_, parent.rank(), "fork must keep the parent rank");
+  TC_ENFORCE_EQ(size_, parent.size(), "fork must keep the parent size");
+  TC_ENFORCE(parent.tctx_ != nullptr, "parent context not connected");
+  device_ = parent.device_;
+  tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
+  auto blob = tctx_->prepareFullMesh();
+
+  // Exchange blob lengths, then the blobs themselves, over the parent.
+  std::vector<uint64_t> lens(size_);
+  uint64_t myLen = blob.size();
+  {
+    AllgatherOptions opts;
+    opts.context = &parent;
+    opts.tag = tag;
+    opts.input = &myLen;
+    opts.output = lens.data();
+    opts.count = 1;
+    opts.dtype = DataType::kUint64;
+    allgather(opts);
+  }
+  std::vector<size_t> counts(lens.begin(), lens.end());
+  size_t total = 0;
+  for (size_t c : counts) {
+    total += c;
+  }
+  std::vector<uint8_t> all(total);
+  {
+    AllgathervOptions opts;
+    opts.context = &parent;
+    opts.tag = tag + 1;
+    opts.input = blob.data();
+    opts.output = all.data();
+    opts.counts = counts;
+    opts.dtype = DataType::kUint8;
+    allgatherv(opts);
+  }
+  std::vector<std::vector<uint8_t>> blobs(size_);
+  size_t off = 0;
+  for (int j = 0; j < size_; j++) {
+    blobs[j].assign(all.begin() + off, all.begin() + off + counts[j]);
+    off += counts[j];
+  }
+  tctx_->connectWithBlobs(blobs, timeout_);
 }
 
 uint64_t Context::nextSlot(uint32_t numToSkip) {

@@ -35,6 +35,14 @@ class Context {
   void connectFullMesh(std::shared_ptr<Store> store,
                        std::shared_ptr<transport::Device> device);
 
+  // Bootstrap by riding an already-connected context: fresh pairs are
+  // created on the parent's device and the address blobs are exchanged
+  // with the parent's own collectives — no store traffic (reference
+  // ContextFactory, gloo/rendezvous/context.cc:37-162). `tag` namespaces
+  // the bootstrap exchange on the parent; it must not collide with
+  // concurrently running parent collectives.
+  void forkFrom(Context& parent, uint32_t tag = 0xFFFFFF0u);
+
   // Monotonic slot allocator for application point-to-point messaging under
   // the kUser prefix; collectives namespace themselves by (prefix, tag).
   uint64_t nextSlot(uint32_t numToSkip = 1);

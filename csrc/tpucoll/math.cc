@@ -2,6 +2,12 @@
 
 #include <algorithm>
 #include <cstring>
+#include <type_traits>
+
+#if defined(__AVX2__) && defined(__F16C__)
+#include <immintrin.h>
+#define TC_HAVE_VECTOR_HALF 1
+#endif
 
 namespace tpucoll {
 
@@ -116,13 +122,71 @@ void reduceTyped(void* acc, const void* in, size_t n) {
   }
 }
 
-// float16/bfloat16: widen to float, reduce, narrow. The loop is kept simple
-// so the compiler can vectorize the conversions; a Pallas/VPU path handles
-// the on-device case so this host path only sees staging buffers.
+// float16/bfloat16: widen to float, reduce, narrow. Sum (the gradient-
+// averaging hot path) gets an explicit vector kernel; other ops use the
+// scalar loop (reference analog: the F16C-vectorized fp16 reductions in
+// gloo/math.cc:21-98). A Pallas/VPU path handles the on-device case, so
+// this host path only sees staging buffers.
+
+#ifdef TC_HAVE_VECTOR_HALF
+void sumHalfVec(uint16_t* a, const uint16_t* b, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 fa = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(a + i)));
+    __m256 fb = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(b + i)));
+    __m128i packed = _mm256_cvtps_ph(_mm256_add_ps(fa, fb),
+                                     _MM_FROUND_TO_NEAREST_INT);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i), packed);
+  }
+  for (; i < n; i++) {
+    a[i] = floatToHalf(halfToFloat(a[i]) + halfToFloat(b[i]));
+  }
+}
+
+void sumBf16Vec(uint16_t* a, const uint16_t* b, size_t n) {
+  size_t i = 0;
+  const __m256i zero = _mm256_setzero_si256();
+  for (; i + 8 <= n; i += 8) {
+    // Widen bf16 -> f32: zero-extend to u32, shift into the high half.
+    __m256i wa = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(a + i))), 16);
+    __m256i wb = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(b + i))), 16);
+    __m256 sum = _mm256_add_ps(_mm256_castsi256_ps(wa),
+                               _mm256_castsi256_ps(wb));
+    // Narrow with round-to-nearest-even: add the rounding bias
+    // (0x7fff + lsb) in integer space, then take the high 16 bits.
+    __m256i bits = _mm256_castps_si256(sum);
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16),
+                                   _mm256_set1_epi32(1));
+    __m256i rounded = _mm256_add_epi32(
+        _mm256_add_epi32(bits, _mm256_set1_epi32(0x7fff)), lsb);
+    __m256i hi = _mm256_srli_epi32(rounded, 16);
+    __m256i packed = _mm256_packus_epi32(hi, zero);
+    packed = _mm256_permute4x64_epi64(packed, 0x08);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i),
+                     _mm256_castsi256_si128(packed));
+  }
+  for (; i < n; i++) {
+    a[i] = floatToBfloat16(bfloat16ToFloat(a[i]) + bfloat16ToFloat(b[i]));
+  }
+}
+#endif  // TC_HAVE_VECTOR_HALF
+
 template <template <typename> class Op>
 void reduceHalf(void* acc, const void* in, size_t n) {
   uint16_t* a = static_cast<uint16_t*>(acc);
   const uint16_t* b = static_cast<const uint16_t*>(in);
+#ifdef TC_HAVE_VECTOR_HALF
+  if (std::is_same<Op<float>, OpSum<float>>::value) {
+    sumHalfVec(a, b, n);
+    return;
+  }
+#endif
   for (size_t i = 0; i < n; i++) {
     a[i] = floatToHalf(Op<float>::apply(halfToFloat(a[i]), halfToFloat(b[i])));
   }
@@ -132,6 +196,12 @@ template <template <typename> class Op>
 void reduceBf16(void* acc, const void* in, size_t n) {
   uint16_t* a = static_cast<uint16_t*>(acc);
   const uint16_t* b = static_cast<const uint16_t*>(in);
+#ifdef TC_HAVE_VECTOR_HALF
+  if (std::is_same<Op<float>, OpSum<float>>::value) {
+    sumBf16Vec(a, b, n);
+    return;
+  }
+#endif
   for (size_t i = 0; i < n; i++) {
     a[i] = floatToBfloat16(
         Op<float>::apply(bfloat16ToFloat(a[i]), bfloat16ToFloat(b[i])));

@@ -63,8 +63,7 @@ Context::~Context() {
   pairs_.clear();
 }
 
-void Context::connectFullMesh(Store& store,
-                              std::chrono::milliseconds timeout) {
+std::vector<uint8_t> Context::prepareFullMesh() {
   std::vector<uint64_t> pairIds(size_, 0);
   for (int j = 0; j < size_; j++) {
     if (j == rank_) {
@@ -74,36 +73,25 @@ void Context::connectFullMesh(Store& store,
                                        device_->nextPairId());
     pairIds[j] = pairs_[j]->localPairId();
   }
-
-  store.set(rankKey(rank_), packRankBlob(size_, device_->address(), pairIds));
-
   // Lower rank listens, higher rank initiates: register expectations first
   // so an early initiator finds a parked or expected pair either way.
   for (int j = rank_ + 1; j < size_; j++) {
     pairs_[j]->expectViaListener(device_->listener());
   }
+  return packRankBlob(size_, device_->address(), pairIds);
+}
 
-  std::vector<std::string> keys;
-  for (int j = 0; j < size_; j++) {
-    if (j != rank_) {
-      keys.push_back(rankKey(j));
-    }
-  }
-  auto blobs = store.multiGet(keys, timeout);
-
-  size_t blobIdx = 0;
-  for (int j = 0; j < size_; j++) {
-    if (j == rank_) {
-      continue;
-    }
+void Context::connectWithBlobs(
+    const std::vector<std::vector<uint8_t>>& blobs,
+    std::chrono::milliseconds timeout) {
+  TC_ENFORCE_EQ(blobs.size(), static_cast<size_t>(size_));
+  // Connect only toward lower ranks; higher ranks initiate to us.
+  for (int j = 0; j < rank_; j++) {
     SockAddr addr;
     std::vector<uint64_t> peerPairIds;
-    unpackRankBlob(blobs[blobIdx++], size_, &addr, &peerPairIds);
-    if (rank_ > j) {
-      pairs_[j]->connect(addr, peerPairIds[rank_], timeout);
-    }
+    unpackRankBlob(blobs[j], size_, &addr, &peerPairIds);
+    pairs_[j]->connect(addr, peerPairIds[rank_], timeout);
   }
-
   for (int j = 0; j < size_; j++) {
     if (j != rank_) {
       pairs_[j]->waitConnected(timeout);
@@ -111,6 +99,26 @@ void Context::connectFullMesh(Store& store,
   }
   TC_DEBUG("rank ", rank_, ": full mesh of ", size_, " connected via ",
            device_->str());
+}
+
+void Context::connectFullMesh(Store& store,
+                              std::chrono::milliseconds timeout) {
+  auto myBlob = prepareFullMesh();
+  store.set(rankKey(rank_), myBlob);
+
+  std::vector<std::string> keys;
+  for (int j = 0; j < size_; j++) {
+    if (j != rank_) {
+      keys.push_back(rankKey(j));
+    }
+  }
+  auto peerBlobs = store.multiGet(keys, timeout);
+  std::vector<std::vector<uint8_t>> blobs(size_);
+  size_t idx = 0;
+  for (int j = 0; j < size_; j++) {
+    blobs[j] = (j == rank_) ? myBlob : std::move(peerBlobs[idx++]);
+  }
+  connectWithBlobs(blobs, timeout);
 }
 
 std::unique_ptr<UnboundBuffer> Context::createUnboundBuffer(void* ptr,

@@ -42,6 +42,14 @@ class Context {
   // connect the full mesh. Higher rank initiates, lower rank listens.
   void connectFullMesh(Store& store, std::chrono::milliseconds timeout);
 
+  // Store-free bootstrap: create this context's pairs and return the rank
+  // blob; then connect against all ranks' blobs (exchanged by the caller,
+  // e.g. over an already-connected parent context — the reference's
+  // ContextFactory pattern, gloo/rendezvous/context.cc:37-162).
+  std::vector<uint8_t> prepareFullMesh();
+  void connectWithBlobs(const std::vector<std::vector<uint8_t>>& blobs,
+                        std::chrono::milliseconds timeout);
+
   std::unique_ptr<UnboundBuffer> createUnboundBuffer(void* ptr, size_t size);
 
   // Graceful teardown: closes all pairs; pending operations fail with

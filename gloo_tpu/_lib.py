@@ -87,6 +87,7 @@ _PROTOTYPES = {
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),
     "tc_context_connect": (_int, [_c, _c, _c]),
+    "tc_context_fork": (_int, [_c, _c, _u32]),
     "tc_context_close": (_int, [_c]),
     "tc_context_free": (None, [_c]),
     "tc_next_slot": (_u64, [_c, _u32]),

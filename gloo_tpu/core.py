@@ -311,6 +311,17 @@ class Context:
         self._store = store
         self._device = device
 
+    def fork(self, tag: int = 0xFFFFFF0) -> "Context":
+        """Create a fresh, independently-tagged context over this one's
+        device, exchanging bootstrap blobs through this context's own
+        collectives instead of a store (the reference's ContextFactory
+        pattern). Cheap re-bootstrap for libraries that need private
+        communicators."""
+        child = Context(self.rank, self.size, timeout=self._timeout)
+        check(_lib.lib.tc_context_fork(child._handle, self._handle, tag))
+        child._device = self._device
+        return child
+
     def close(self) -> None:
         check(_lib.lib.tc_context_close(self._handle))
 
@@ -366,7 +377,8 @@ class Context:
                                     _timeout_ms(timeout)))
         return array
 
-    _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2}
+    _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
+                   "bcube": 3}
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
                   tag: int = 0,

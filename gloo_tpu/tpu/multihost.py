@@ -1,0 +1,59 @@
+"""Multi-host device-plane bootstrap.
+
+On a multi-host TPU pod the device plane needs jax.distributed so all
+hosts' chips form one global mesh; the host plane needs a connected
+Context for host-side collectives and control traffic. This module wires
+both from one set of coordinates, with the TcpStore serving double duty as
+the process-wide rendezvous:
+
+    ctx, mesh = init_multihost(rank, size, "host0:29500",
+                               mesh_axes={"data": -1})
+
+After it returns: jax.devices() spans the pod, `mesh` is a global mesh,
+and `ctx` is the host-plane process group (one rank per host process).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Tuple
+
+
+def init_multihost(rank: int, size: int, store_address: str,
+                   mesh_axes: Optional[Mapping[str, int]] = None,
+                   timeout: float = 120.0,
+                   device_hostname: Optional[str] = None):
+    """Initialize both planes. `store_address` is host:port; rank 0 hosts
+    the TcpStoreServer there. `device_hostname` is the DCN-reachable name
+    this process advertises for host-plane traffic (default: the machine
+    hostname)."""
+    import socket
+
+    import jax
+
+    import gloo_tpu
+    from gloo_tpu.tpu.mesh import make_mesh
+
+    host, port_str = store_address.rsplit(":", 1)
+    port = int(port_str)
+
+    server = None
+    if rank == 0:
+        server = gloo_tpu.TcpStoreServer("0.0.0.0", port)
+    store = gloo_tpu.TcpStore(host, port)
+
+    # Host plane: full-mesh process group over DCN.
+    if device_hostname is None:
+        device_hostname = socket.gethostname()
+    ctx = gloo_tpu.Context(rank, size, timeout=timeout)
+    ctx.connect_full_mesh(store, gloo_tpu.Device(hostname=device_hostname))
+    ctx._store_server = server  # pin the server to the context's lifetime
+
+    # Device plane: jax.distributed makes every host's chips visible as one
+    # global device set. The coordinator rides the same host as the store.
+    if size > 1:
+        coordinator = f"{host}:{port + 1}"
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=size, process_id=rank)
+
+    mesh = make_mesh(mesh_axes)
+    return ctx, mesh

@@ -18,7 +18,7 @@ def fixture(rank, count, dtype):
     return vals.astype(dtype)
 
 
-@pytest.mark.parametrize("algorithm", ["ring", "halving_doubling"])
+@pytest.mark.parametrize("algorithm", ["ring", "halving_doubling", "bcube"])
 @pytest.mark.parametrize("size", SIZES)
 @pytest.mark.parametrize("count", COUNTS)
 def test_allreduce_sum(size, count, algorithm):
@@ -33,14 +33,15 @@ def test_allreduce_sum(size, count, algorithm):
         np.testing.assert_allclose(got, expected, rtol=1e-6)
 
 
+@pytest.mark.parametrize("algorithm", ["halving_doubling", "bcube"])
 @pytest.mark.parametrize("size", [2, 3, 5, 6, 7, 8])
-def test_allreduce_hd_nonpow2(size):
-    """Halving-doubling with the fold path on non-power-of-2 groups."""
+def test_allreduce_hd_nonpow2(size, algorithm):
+    """Non-power-of-2 groups: HD fold path and mixed-radix bcube."""
     count = 4097  # also exercises uneven block windows
 
     def fn(ctx, rank):
         x = fixture(rank, count, np.float64)
-        ctx.allreduce(x, algorithm="halving_doubling")
+        ctx.allreduce(x, algorithm=algorithm)
         return x
 
     results = spawn(size, fn)
@@ -370,3 +371,25 @@ def test_multiple_contexts_same_device():
     assert results[:size] == [sum(range(size))] * size
     expected_g1 = sum(r + 10 for r in range(size))
     assert results[size:] == [expected_g1] * size
+
+
+def test_context_fork():
+    """ContextFactory parity: re-bootstrap over an existing context with no
+    store traffic; parent and child communicators are independent."""
+    size = 4
+
+    def fn(ctx, rank):
+        child = ctx.fork()
+        a = np.full(64, float(rank + 1), dtype=np.float32)
+        b = np.full(64, float(rank + 1) * 2, dtype=np.float32)
+        # Interleave collectives on both contexts.
+        ctx.allreduce(a)
+        child.allreduce(b)
+        child.barrier()
+        child.close()
+        return float(a[0]), float(b[0])
+
+    results = spawn(size, fn)
+    sa = size * (size + 1) / 2
+    for a0, b0 in results:
+        assert (a0, b0) == (sa, 2 * sa)

@@ -42,3 +42,27 @@ def test_ring_allreduce_large_chunks():
     expected = x.sum(axis=0)
     for i in range(4):
         np.testing.assert_allclose(out[i], expected, rtol=1e-5)
+
+
+def test_ring_allreduce_bfloat16():
+    """bf16 shards: per-device rows must honor (16, 128) tiling."""
+    import ml_dtypes
+
+    n = 4
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.asarray(devs[:n], dtype=object), ("x",))
+    fn = jax.jit(
+        jax.shard_map(lambda s: ring_allreduce(s, "x", interpret=True),
+                      mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                      check_vma=False))
+    per_rows = n * 16
+    x = (1.0 + np.arange(n, dtype=np.float32))[:, None, None] * np.ones(
+        (n, per_rows, 128), np.float32)
+    xb = x.astype(ml_dtypes.bfloat16)
+    out = np.asarray(fn(xb.reshape(n * per_rows, 128))).astype(np.float32)
+    expected = x.sum(axis=0)
+    out = out.reshape(n, per_rows, 128)
+    for i in range(n):
+        np.testing.assert_allclose(out[i], expected, rtol=1e-2)
