@@ -158,13 +158,17 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 
 // ---- device / context ----
 
-void* tc_device_new(const char* hostname, uint16_t port) {
+void* tc_device_new(const char* hostname, uint16_t port,
+                    const char* authKey) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
       attr.hostname = hostname;
     }
     attr.port = port;
+    if (authKey != nullptr) {
+      attr.authKey = authKey;
+    }
     return new DeviceHandle(std::make_shared<Device>(attr));
   } catch (const std::exception& e) {
     g_lastError = e.what();

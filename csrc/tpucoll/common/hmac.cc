@@ -1,0 +1,147 @@
+#include "tpucoll/common/hmac.h"
+
+#include <fcntl.h>
+#include <sys/random.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+namespace {
+
+constexpr uint32_t kInit[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+constexpr uint32_t kRound[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t v, int s) { return (v >> s) | (v << (32 - s)); }
+
+void compress(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) {
+    w[i] = (uint32_t(block[4 * i]) << 24) | (uint32_t(block[4 * i + 1]) << 16) |
+           (uint32_t(block[4 * i + 2]) << 8) | uint32_t(block[4 * i + 3]);
+  }
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + kRound[i] + w[i];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g;
+    g = f;
+    f = e;
+    e = d + t1;
+    d = c;
+    c = b;
+    b = a;
+    a = t1 + t2;
+  }
+  state[0] += a;
+  state[1] += b;
+  state[2] += c;
+  state[3] += d;
+  state[4] += e;
+  state[5] += f;
+  state[6] += g;
+  state[7] += h;
+}
+
+}  // namespace
+
+std::array<uint8_t, 32> sha256(const void* data, size_t len) {
+  uint32_t state[8];
+  std::memcpy(state, kInit, sizeof(state));
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; i++) {
+    compress(state, p + 64 * i);
+  }
+  // Final padded block(s).
+  uint8_t tail[128] = {0};
+  size_t rem = len % 64;
+  std::memcpy(tail, p + 64 * full, rem);
+  tail[rem] = 0x80;
+  size_t tailLen = (rem < 56) ? 64 : 128;
+  uint64_t bits = uint64_t(len) * 8;
+  for (int i = 0; i < 8; i++) {
+    tail[tailLen - 1 - i] = uint8_t(bits >> (8 * i));
+  }
+  compress(state, tail);
+  if (tailLen == 128) {
+    compress(state, tail + 64);
+  }
+  std::array<uint8_t, 32> out;
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(state[i] >> 24);
+    out[4 * i + 1] = uint8_t(state[i] >> 16);
+    out[4 * i + 2] = uint8_t(state[i] >> 8);
+    out[4 * i + 3] = uint8_t(state[i]);
+  }
+  return out;
+}
+
+std::array<uint8_t, 32> hmacSha256(const void* key, size_t keyLen,
+                                   const void* msg, size_t msgLen) {
+  uint8_t k[64] = {0};
+  if (keyLen > 64) {
+    auto kh = sha256(key, keyLen);
+    std::memcpy(k, kh.data(), 32);
+  } else {
+    std::memcpy(k, key, keyLen);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  std::string inner(reinterpret_cast<char*>(ipad), 64);
+  inner.append(static_cast<const char*>(msg), msgLen);
+  auto innerHash = sha256(inner.data(), inner.size());
+  std::string outer(reinterpret_cast<char*>(opad), 64);
+  outer.append(reinterpret_cast<char*>(innerHash.data()), 32);
+  return sha256(outer.data(), outer.size());
+}
+
+bool macEqual(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; i++) {
+    acc |= a[i] ^ b[i];
+  }
+  return acc == 0;
+}
+
+void randomBytes(void* out, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(out);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t rv = getrandom(p + got, n - got, 0);
+    TC_ENFORCE_GE(rv, 0, "getrandom failed");
+    got += static_cast<size_t>(rv);
+  }
+}
+
+}  // namespace tpucoll

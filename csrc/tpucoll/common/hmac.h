@@ -1,0 +1,28 @@
+// SHA-256 and HMAC-SHA256 (FIPS 180-4 / RFC 2104), self-contained.
+//
+// Used by the transport's pre-shared-key connection handshake — the
+// equivalent of the reference's TLS tier (gloo/transport/tcp/tls) scoped
+// to mutual authentication: it keeps rogue processes out of the mesh on a
+// pod network. Payload encryption is out of scope (the image ships no
+// crypto library headers; hand-rolling a cipher would be malpractice).
+#pragma once
+
+#include <array>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tpucoll {
+
+std::array<uint8_t, 32> sha256(const void* data, size_t len);
+
+std::array<uint8_t, 32> hmacSha256(const void* key, size_t keyLen,
+                                   const void* msg, size_t msgLen);
+
+// Constant-time comparison (authentication tags must not leak via timing).
+bool macEqual(const uint8_t* a, const uint8_t* b, size_t n);
+
+// Fill `out` with kernel randomness (getrandom / urandom).
+void randomBytes(void* out, size_t n);
+
+}  // namespace tpucoll

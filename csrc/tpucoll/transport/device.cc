@@ -3,9 +3,9 @@
 namespace tpucoll {
 namespace transport {
 
-Device::Device(const DeviceAttr& attr) {
+Device::Device(const DeviceAttr& attr) : authKey_(attr.authKey) {
   SockAddr bindAddr = resolve(attr.hostname, attr.port);
-  listener_ = std::make_unique<Listener>(&loop_, bindAddr);
+  listener_ = std::make_unique<Listener>(&loop_, bindAddr, authKey_);
 }
 
 std::string Device::str() const {

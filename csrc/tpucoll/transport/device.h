@@ -22,6 +22,9 @@ struct DeviceAttr {
   // single-host tests; multi-host deployments pass the DCN hostname.
   std::string hostname{"127.0.0.1"};
   uint16_t port{0};  // 0 = ephemeral
+  // Non-empty: require the PSK handshake on every inbound and outbound
+  // connection (mutual HMAC-SHA256 authentication; see wire.h).
+  std::string authKey;
 };
 
 class Device {
@@ -32,12 +35,14 @@ class Device {
   Listener* listener() { return listener_.get(); }
   const SockAddr& address() const { return listener_->address(); }
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
+  const std::string& authKey() const { return authKey_; }
   std::string str() const;
 
  private:
   Loop loop_;  // declared first: destroyed last
   std::unique_ptr<Listener> listener_;
   std::atomic<uint64_t> pairId_{1};
+  std::string authKey_;
 };
 
 }  // namespace transport

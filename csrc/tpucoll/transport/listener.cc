@@ -7,6 +7,11 @@
 #include <cerrno>
 #include <cstring>
 
+#include <poll.h>
+
+#include <array>
+
+#include "tpucoll/common/hmac.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/transport/pair.h"
 #include "tpucoll/transport/socket.h"
@@ -15,17 +20,23 @@
 namespace tpucoll {
 namespace transport {
 
-// Reads the hello preamble off a fresh inbound connection, then hands the fd
-// back to the listener for routing.
+// Reads the hello preamble off a fresh inbound connection — and, when the
+// device requires authentication, runs the listener side of the PSK
+// challenge/response (see wire.h) — then hands the fd back to the listener
+// for routing.
 class PendingConn : public Handler {
  public:
-  PendingConn(Listener* listener, int fd) : listener_(listener), fd_(fd) {}
+  PendingConn(Listener* listener, int fd, const std::string& authKey)
+      : listener_(listener), fd_(fd), authKey_(authKey) {}
 
   int fd() const { return fd_; }
 
   void handleEvents(uint32_t /*events*/) override {
     while (true) {
-      ssize_t n = read(fd_, buf_ + got_, sizeof(WireHello) - got_);
+      const size_t want = phase_ == Phase::kHello ? sizeof(WireHello)
+                          : phase_ == Phase::kNonce ? kAuthNonceBytes
+                                                    : kAuthMacBytes;
+      ssize_t n = read(fd_, buf_ + got_, want - got_);
       if (n == 0) {
         listener_->finishPending(this, false, 0, fd_);
         return;
@@ -41,24 +52,104 @@ class PendingConn : public Handler {
         return;
       }
       got_ += static_cast<size_t>(n);
-      if (got_ == sizeof(WireHello)) {
-        WireHello hello;
-        std::memcpy(&hello, buf_, sizeof(hello));
-        const bool ok = hello.magic == kHelloMagic;
-        listener_->finishPending(this, ok, hello.pairId, fd_);
-        return;
+      if (got_ < want) {
+        continue;
+      }
+      got_ = 0;
+      switch (phase_) {
+        case Phase::kHello: {
+          WireHello hello;
+          std::memcpy(&hello, buf_, sizeof(hello));
+          pairId_ = hello.pairId;
+          const bool wantAuth = !authKey_.empty();
+          if (hello.magic == kHelloMagic && !wantAuth) {
+            listener_->finishPending(this, true, pairId_, fd_);
+            return;
+          }
+          if (hello.magic != kHelloAuthMagic || !wantAuth) {
+            // Plain hello against an authenticated listener, auth hello
+            // against a plain one, or garbage: reject.
+            listener_->finishPending(this, false, 0, fd_);
+            return;
+          }
+          phase_ = Phase::kNonce;
+          break;
+        }
+        case Phase::kNonce: {
+          std::memcpy(nonceI_, buf_, kAuthNonceBytes);
+          randomBytes(nonceL_, kAuthNonceBytes);
+          // Challenge response: nonceL || HMAC(key, "srv"||id||nI||nL).
+          auto mac = transcriptMac("srv");
+          uint8_t out[kAuthNonceBytes + kAuthMacBytes];
+          std::memcpy(out, nonceL_, kAuthNonceBytes);
+          std::memcpy(out + kAuthNonceBytes, mac.data(), kAuthMacBytes);
+          if (!writeFullNoSig(fd_, out, sizeof(out))) {
+            listener_->finishPending(this, false, 0, fd_);
+            return;
+          }
+          phase_ = Phase::kClientMac;
+          break;
+        }
+        case Phase::kClientMac: {
+          auto expect = transcriptMac("cli");
+          const bool ok = macEqual(reinterpret_cast<uint8_t*>(buf_),
+                                   expect.data(), kAuthMacBytes);
+          if (!ok) {
+            TC_WARN("rejecting inbound connection: bad auth tag");
+          }
+          listener_->finishPending(this, ok, pairId_, fd_);
+          return;
+        }
       }
     }
   }
 
  private:
+  enum class Phase { kHello, kNonce, kClientMac };
+
+  std::array<uint8_t, 32> transcriptMac(const char* role) const {
+    std::string msg(role);
+    msg.append(reinterpret_cast<const char*>(&pairId_), sizeof(pairId_));
+    msg.append(reinterpret_cast<const char*>(nonceI_), kAuthNonceBytes);
+    msg.append(reinterpret_cast<const char*>(nonceL_), kAuthNonceBytes);
+    return hmacSha256(authKey_.data(), authKey_.size(), msg.data(),
+                      msg.size());
+  }
+
+  static bool writeFullNoSig(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t rv = ::send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+      if (rv < 0) {
+        if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) {
+          // Handshake frames are tiny; a fresh socket accepts them. EAGAIN
+          // here is pathological — retry briefly via blocking poll.
+          pollfd pfd{fd, POLLOUT, 0};
+          poll(&pfd, 1, 1000);
+          continue;
+        }
+        return false;
+      }
+      sent += static_cast<size_t>(rv);
+    }
+    return true;
+  }
+
   Listener* const listener_;
   const int fd_;
-  char buf_[sizeof(WireHello)];
+  const std::string& authKey_;
+  Phase phase_{Phase::kHello};
+  uint64_t pairId_{0};
+  uint8_t nonceI_[kAuthNonceBytes];
+  uint8_t nonceL_[kAuthNonceBytes];
+  char buf_[64];
   size_t got_{0};
 };
 
-Listener::Listener(Loop* loop, const SockAddr& bindAddr) : loop_(loop) {
+Listener::Listener(Loop* loop, const SockAddr& bindAddr,
+                   const std::string& authKey)
+    : loop_(loop), authKey_(authKey) {
   fd_ = socket(bindAddr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd_, 0, errnoString("socket"));
   setReuseAddr(fd_);
@@ -106,7 +197,7 @@ void Listener::handleEvents(uint32_t /*events*/) {
       return;
     }
     setNoDelay(fd);
-    auto conn = std::make_unique<PendingConn>(this, fd);
+    auto conn = std::make_unique<PendingConn>(this, fd, authKey_);
     PendingConn* raw = conn.get();
     {
       std::lock_guard<std::mutex> guard(mu_);

@@ -6,6 +6,7 @@
 #pragma once
 
 #include <cstdint>
+#include <string>
 #include <list>
 #include <memory>
 #include <mutex>
@@ -22,7 +23,8 @@ class PendingConn;
 
 class Listener : public Handler {
  public:
-  Listener(Loop* loop, const SockAddr& bindAddr);
+  Listener(Loop* loop, const SockAddr& bindAddr,
+           const std::string& authKey = "");
   ~Listener() override;
 
   const SockAddr& address() const { return addr_; }
@@ -41,6 +43,7 @@ class Listener : public Handler {
   Loop* const loop_;
   int fd_{-1};
   SockAddr addr_;
+  const std::string authKey_;
 
   std::mutex mu_;
   bool shuttingDown_{false};

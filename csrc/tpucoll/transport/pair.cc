@@ -10,7 +10,9 @@
 #include <cerrno>
 #include <cstring>
 
+#include "tpucoll/common/hmac.h"
 #include "tpucoll/transport/context.h"
+#include "tpucoll/transport/device.h"
 #include "tpucoll/transport/listener.h"
 #include "tpucoll/transport/socket.h"
 
@@ -82,26 +84,91 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
   }
   setNoDelay(fd);
 
-  // Route this connection to the peer's expecting Pair.
-  WireHello hello{kHelloMagic, 0, remotePairId};
-  const char* p = reinterpret_cast<const char*>(&hello);
-  size_t sent = 0;
-  while (sent < sizeof(hello)) {
-    ssize_t n = ::send(fd, p + sent, sizeof(hello) - sent, MSG_NOSIGNAL);
-    if (n < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        pollfd pfd{fd, POLLOUT, 0};
-        poll(&pfd, 1, 1000);
-        continue;
+  const std::string& authKey = context_->device()->authKey();
+  auto writeAll = [&](const void* buf, size_t len, const char* what) {
+    const char* p = static_cast<const char*>(buf);
+    size_t sent = 0;
+    while (sent < len) {
+      ssize_t n = ::send(fd, p + sent, len - sent, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd pfd{fd, POLLOUT, 0};
+          poll(&pfd, 1, 1000);
+          continue;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        ::close(fd);
+        TC_THROW(IoException, what, " write to rank ", peerRank_, ": ",
+                 strerror(errno));
       }
-      if (errno == EINTR) {
-        continue;
-      }
-      ::close(fd);
-      TC_THROW(IoException, "hello write to rank ", peerRank_, ": ",
-               strerror(errno));
+      sent += static_cast<size_t>(n);
     }
-    sent += static_cast<size_t>(n);
+  };
+  auto readAll = [&](void* buf, size_t len, const char* what) {
+    char* p = static_cast<char*>(buf);
+    size_t got = 0;
+    while (got < len) {
+      ssize_t n = ::recv(fd, p + got, len - got, 0);
+      if (n == 0) {
+        ::close(fd);
+        TC_THROW(IoException, what, ": rank ", peerRank_,
+                 " closed the connection (authentication mismatch?)");
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd pfd{fd, POLLIN, 0};
+          int prv = poll(&pfd, 1, static_cast<int>(std::max<int64_t>(
+              std::chrono::duration_cast<std::chrono::milliseconds>(
+                  deadline - std::chrono::steady_clock::now()).count(), 0)));
+          if (prv <= 0) {
+            ::close(fd);
+            TC_THROW(TimeoutException, what, ": handshake with rank ",
+                     peerRank_, " timed out");
+          }
+          continue;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        ::close(fd);
+        TC_THROW(IoException, what, ": ", strerror(errno));
+      }
+      got += static_cast<size_t>(n);
+    }
+  };
+
+  // Route this connection to the peer's expecting Pair; with a pre-shared
+  // key, run the mutual challenge/response of wire.h on top.
+  WireHello hello{authKey.empty() ? kHelloMagic : kHelloAuthMagic, 0,
+                  remotePairId};
+  writeAll(&hello, sizeof(hello), "hello");
+  if (!authKey.empty()) {
+    uint8_t nonceI[kAuthNonceBytes];
+    randomBytes(nonceI, sizeof(nonceI));
+    writeAll(nonceI, sizeof(nonceI), "auth nonce");
+
+    uint8_t reply[kAuthNonceBytes + kAuthMacBytes];
+    readAll(reply, sizeof(reply), "auth challenge");
+    auto transcript = [&](const char* role) {
+      std::string msg(role);
+      msg.append(reinterpret_cast<const char*>(&remotePairId),
+                 sizeof(remotePairId));
+      msg.append(reinterpret_cast<const char*>(nonceI), kAuthNonceBytes);
+      msg.append(reinterpret_cast<const char*>(reply), kAuthNonceBytes);
+      return hmacSha256(authKey.data(), authKey.size(), msg.data(),
+                        msg.size());
+    };
+    auto srvExpect = transcript("srv");
+    if (!macEqual(reply + kAuthNonceBytes, srvExpect.data(),
+                  kAuthMacBytes)) {
+      ::close(fd);
+      TC_THROW(IoException, "rank ", peerRank_,
+               " failed authentication (bad server tag)");
+    }
+    auto cliMac = transcript("cli");
+    writeAll(cliMac.data(), cliMac.size(), "auth tag");
   }
   assumeConnected(fd);
 }

@@ -18,6 +18,17 @@ namespace transport {
 
 constexpr uint32_t kMsgMagic = 0x7C011001;
 constexpr uint32_t kHelloMagic = 0x7C011002;
+// PSK-authenticated hello (the TLS-tier analog): the 16-byte hello with
+// this magic is followed by a mutual HMAC-SHA256 challenge/response —
+//   initiator: nonceI[16]
+//   listener:  nonceL[16] || HMAC(key, "srv" || pairId || nonceI || nonceL)
+//   initiator: HMAC(key, "cli" || pairId || nonceI || nonceL)
+// Either side drops the connection on a tag mismatch, so only holders of
+// the pre-shared key can join the mesh.
+constexpr uint32_t kHelloAuthMagic = 0x7C011003;
+
+constexpr size_t kAuthNonceBytes = 16;
+constexpr size_t kAuthMacBytes = 32;
 
 enum class Opcode : uint8_t {
   kData = 1,

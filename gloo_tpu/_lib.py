@@ -82,7 +82,8 @@ _PROTOTYPES = {
     "tc_store_add": (_int, [_c, ctypes.c_char_p, _i64,
                             ctypes.POINTER(_i64)]),
     # device / context
-    "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16]),
+    "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16,
+                       ctypes.c_char_p]),
     "tc_device_free": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),

@@ -196,9 +196,13 @@ class Device:
     _handle = None
     _free = staticmethod(lambda handle: None)
 
-    def __init__(self, hostname: str = "127.0.0.1", port: int = 0):
+    def __init__(self, hostname: str = "127.0.0.1", port: int = 0,
+                 auth_key: Optional[str] = None):
+        """auth_key: pre-shared key enabling the mutual HMAC handshake on
+        every connection (all ranks must agree; see docs/transport.md)."""
         self._handle = check_handle(
-            _lib.lib.tc_device_new(hostname.encode(), port))
+            _lib.lib.tc_device_new(hostname.encode(), port,
+                                   auth_key.encode() if auth_key else None))
         self._free = _lib.lib.tc_device_free
 
     def __del__(self):
