@@ -14,8 +14,10 @@ remote semaphore signal before the slot may be reused — without the ack, a
 fast sender two steps ahead could overwrite an unconsumed slot. The
 allgather phase needs no acks because every step writes a distinct chunk.
 
-v1 keeps the buffer VMEM-resident (shard sizes up to a few MiB); an
-HBM-streaming variant for larger payloads is the planned follow-up.
+Two variants: `ring_allreduce` keeps everything VMEM-resident (lowest
+latency, shard + 2 comm slots must fit in ~16 MB VMEM);
+`ring_allreduce_hbm` keeps the ring buffers in HBM and streams the
+reduction through VMEM tiles, scaling to arbitrarily large shards.
 """
 
 from __future__ import annotations
@@ -164,3 +166,173 @@ def ring_allreduce(x, axis_name: str, collective_id: int = 7,
     return _ring_allreduce_shard(x, axis_name=axis_name,
                                  collective_id=collective_id,
                                  interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# HBM-streaming variant: shards larger than VMEM.
+# ---------------------------------------------------------------------------
+
+def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
+                               copy_sem, rs_send, rs_recv, ack_sem, ag_send,
+                               ag_recv, *, axis_name: str, num_devices: int,
+                               chunk_rows: int, tile_rows: int):
+    # comm_ref is a second kernel output (discarded by the wrapper): remote
+    # DMA targets must be inputs/outputs for the distributed interpreter to
+    # map them across devices; an ANY-space scratch is not.
+    """Ring allreduce with all ring buffers resident in HBM.
+
+    Remote DMA moves chunks HBM->HBM over ICI; the reduction streams each
+    received chunk through VMEM in `tile_rows` slices (double-buffered DMA
+    in, VPU add, DMA out). Same schedule and flow control as the
+    VMEM-resident kernel.
+    """
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my - 1 + n, n)
+    tiles_per_chunk = chunk_rows // tile_rows
+
+    # Seed the output: HBM -> HBM local copy.
+    init = pltpu.make_async_copy(x_ref, o_ref, copy_sem.at[0])
+    init.start()
+    init.wait()
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def chunk_slice(idx):
+        return pl.ds(idx * chunk_rows, chunk_rows)
+
+    def rs_step(s, _):
+        send_chunk = lax.rem(my - s + n, n)
+        recv_chunk = lax.rem(my - s - 1 + n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[chunk_slice(send_chunk)],
+            dst_ref=comm_ref.at[slot],
+            send_sem=rs_send.at[slot],
+            recv_sem=rs_recv.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+        # Stream-reduce the received chunk: HBM tiles through VMEM.
+        def tile_step(t, _):
+            row0 = recv_chunk * chunk_rows + t * tile_rows
+            load_acc = pltpu.make_async_copy(
+                o_ref.at[pl.ds(row0, tile_rows)], acc_vmem, copy_sem.at[0])
+            load_in = pltpu.make_async_copy(
+                comm_ref.at[slot, pl.ds(t * tile_rows, tile_rows)], in_vmem,
+                copy_sem.at[1])
+            load_acc.start()
+            load_in.start()
+            load_acc.wait()
+            load_in.wait()
+            acc_vmem[...] = acc_vmem[...] + in_vmem[...]
+            store = pltpu.make_async_copy(
+                acc_vmem, o_ref.at[pl.ds(row0, tile_rows)], copy_sem.at[0])
+            store.start()
+            store.wait()
+            return 0
+
+        lax.fori_loop(0, tiles_per_chunk, tile_step, 0)
+        pltpu.semaphore_signal(ack_sem.at[slot], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+
+    @pl.when(n >= 3)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+
+    @pl.when(n >= 2)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+
+    def ag_step(s, _):
+        send_chunk = lax.rem(my + 1 - s + n, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[chunk_slice(send_chunk)],
+            dst_ref=o_ref.at[chunk_slice(send_chunk)],
+            send_sem=ag_send.at[s],
+            recv_sem=ag_recv.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "collective_id",
+                                    "interpret"))
+def _ring_allreduce_hbm_shard(x, *, axis_name: str, collective_id: int,
+                              interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
+    chunk_rows = rows // n
+    # Stream tile: at most 256 rows per VMEM buffer; chunk must tile evenly.
+    if chunk_rows % 256 == 0:
+        tile_rows = 256
+    else:
+        tile_rows = chunk_rows  # small chunk: single tile
+    kernel = functools.partial(_ring_allreduce_hbm_kernel,
+                               axis_name=axis_name, num_devices=n,
+                               chunk_rows=chunk_rows, tile_rows=tile_rows)
+    def reordered(x_ref, o_ref, comm_ref, *scratch):
+        return kernel(x_ref, o_ref, comm_ref, *scratch)
+
+    out, _comm = pl.pallas_call(
+        reordered,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                 vma=frozenset({axis_name})),
+            jax.ShapeDtypeStruct((2, chunk_rows, cols), x.dtype,
+                                 vma=frozenset({axis_name})),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],   # stays in HBM
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=[
+            pltpu.VMEM((tile_rows, cols), x.dtype),        # acc tile
+            pltpu.VMEM((tile_rows, cols), x.dtype),        # incoming tile
+            pltpu.SemaphoreType.DMA((2,)),                 # local copies
+            pltpu.SemaphoreType.DMA((2,)),                 # rs send
+            pltpu.SemaphoreType.DMA((2,)),                 # rs recv
+            pltpu.SemaphoreType.REGULAR((2,)),             # slot acks
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),     # ag send
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),     # ag recv
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+    return out
+
+
+def ring_allreduce_hbm(x, axis_name: str, collective_id: int = 8,
+                       interpret: bool = False):
+    """Sum-allreduce for shards too large for VMEM: ring buffers live in
+    HBM, remote DMA moves chunks chip-to-chip, and the reduction streams
+    through VMEM in 256-row tiles. Requirements: rows % ring_size == 0 and
+    the per-chunk rows either divisible by 256 or small enough to be a
+    single tile."""
+    return _ring_allreduce_hbm_shard(x, axis_name=axis_name,
+                                     collective_id=collective_id,
+                                     interpret=interpret)

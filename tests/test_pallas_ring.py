@@ -66,3 +66,26 @@ def test_ring_allreduce_bfloat16():
     out = out.reshape(n, per_rows, 128)
     for i in range(n):
         np.testing.assert_allclose(out[i], expected, rtol=1e-2)
+
+
+@pytest.mark.parametrize("n,per_rows", [(2, 16), (3, 24), (2, 1024), (4, 32)])
+def test_hbm_ring_allreduce(n, per_rows):
+    """HBM-streaming variant: buffers in HBM, tiled VMEM reduction
+    (per_rows=1024 exercises the multi-tile stream path)."""
+    from gloo_tpu.ops.pallas_ring import ring_allreduce_hbm
+
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    mesh = Mesh(np.asarray(devs[:n], dtype=object), ("x",))
+    fn = jax.jit(
+        jax.shard_map(lambda s: ring_allreduce_hbm(s, "x", interpret=True),
+                      mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                      check_vma=False))
+    x = (1.0 + np.arange(n, dtype=np.float32))[:, None, None] * np.ones(
+        (n, per_rows, 128), np.float32)
+    out = np.asarray(fn(x.reshape(n * per_rows, 128)))
+    expected = x.sum(axis=0)
+    out = out.reshape(n, per_rows, 128)
+    for i in range(n):
+        np.testing.assert_allclose(out[i], expected, rtol=1e-5)
