@@ -355,8 +355,9 @@ int runBench(int argc, char** argv) {
   ctx.connectFullMesh(store, device);
 
   if (o.rank == 0 && !o.json) {
-    printf("# tpucoll_bench op=%s algorithm=%s size=%d transport=tcp\n",
-           o.op.c_str(), o.algorithm.c_str(), o.size);
+    printf("# tpucoll_bench op=%s algorithm=%s size=%d device=%s\n",
+           o.op.c_str(), o.algorithm.c_str(), o.size,
+           device->str().c_str());
     printf("%12s %12s %10s %10s %10s %10s %12s %8s\n", "bytes", "elements",
            "min(us)", "p50(us)", "p99(us)", "max(us)", "algbw(GB/s)",
            "iters");

@@ -1,0 +1,65 @@
+#include "tpucoll/common/sysinfo.h"
+
+#include <ifaddrs.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace tpucoll {
+
+std::string interfaceForAddress(const sockaddr* addr) {
+  if (addr == nullptr) {
+    return "";
+  }
+  ifaddrs* list = nullptr;
+  if (getifaddrs(&list) != 0) {
+    return "";
+  }
+  std::string result;
+  for (ifaddrs* ifa = list; ifa != nullptr; ifa = ifa->ifa_next) {
+    if (ifa->ifa_addr == nullptr ||
+        ifa->ifa_addr->sa_family != addr->sa_family) {
+      continue;
+    }
+    bool match = false;
+    if (addr->sa_family == AF_INET) {
+      match = std::memcmp(
+                  &reinterpret_cast<const sockaddr_in*>(addr)->sin_addr,
+                  &reinterpret_cast<sockaddr_in*>(ifa->ifa_addr)->sin_addr,
+                  sizeof(in_addr)) == 0;
+    } else if (addr->sa_family == AF_INET6) {
+      match = std::memcmp(
+                  &reinterpret_cast<const sockaddr_in6*>(addr)->sin6_addr,
+                  &reinterpret_cast<sockaddr_in6*>(ifa->ifa_addr)->sin6_addr,
+                  sizeof(in6_addr)) == 0;
+    }
+    if (match) {
+      result = ifa->ifa_name;
+      break;
+    }
+  }
+  freeifaddrs(list);
+  return result;
+}
+
+int interfaceSpeedMbps(const std::string& name) {
+  if (name.empty()) {
+    return -1;
+  }
+  char path[256];
+  snprintf(path, sizeof(path), "/sys/class/net/%s/speed", name.c_str());
+  FILE* f = fopen(path, "r");
+  if (f == nullptr) {
+    return -1;
+  }
+  int speed = -1;
+  if (fscanf(f, "%d", &speed) != 1) {
+    speed = -1;
+  }
+  fclose(f);
+  return speed;
+}
+
+}  // namespace tpucoll

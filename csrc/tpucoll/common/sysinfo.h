@@ -1,0 +1,20 @@
+// Host topology probes (reference analog: gloo/common/linux.h:17-32 —
+// interface speed discovery used for benchmark metadata and transport
+// selection hints).
+#pragma once
+
+#include <string>
+
+struct sockaddr;
+
+namespace tpucoll {
+
+// Name of the network interface owning `addr` ("" if none matches —
+// e.g. 0.0.0.0 or a mismatched bind).
+std::string interfaceForAddress(const sockaddr* addr);
+
+// Link speed in Mb/s from /sys/class/net/<name>/speed; -1 when unknown
+// (virtual interfaces, loopback).
+int interfaceSpeedMbps(const std::string& name);
+
+}  // namespace tpucoll

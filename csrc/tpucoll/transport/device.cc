@@ -1,5 +1,7 @@
 #include "tpucoll/transport/device.h"
 
+#include "tpucoll/common/sysinfo.h"
+
 namespace tpucoll {
 namespace transport {
 
@@ -9,7 +11,17 @@ Device::Device(const DeviceAttr& attr) : authKey_(attr.authKey) {
 }
 
 std::string Device::str() const {
-  return "tcp://" + listener_->address().str();
+  std::string s = "tcp://" + listener_->address().str();
+  const std::string iface = interfaceForAddress(listener_->address().sa());
+  if (!iface.empty()) {
+    s += " (" + iface;
+    const int speed = interfaceSpeedMbps(iface);
+    if (speed > 0) {
+      s += ", " + std::to_string(speed) + " Mb/s";
+    }
+    s += ")";
+  }
+  return s;
 }
 
 }  // namespace transport
