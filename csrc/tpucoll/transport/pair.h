@@ -113,7 +113,11 @@ class Pair : public Handler {
 
   std::mutex mu_;
   std::condition_variable cv_;
-  int fd_{-1};
+  // Atomic: written during teardown (under mu_) while the loop thread's
+  // read path inspects it without the pair lock. The close() sequencing
+  // (state flip + loop tick barrier before ::close) provides the actual
+  // lifetime guarantee; atomicity just keeps the access well-defined.
+  std::atomic<int> fd_{-1};
   uint32_t epollMask_{0};
   std::deque<TxOp> tx_;
   std::string error_;

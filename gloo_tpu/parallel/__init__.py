@@ -8,10 +8,16 @@ by shipping the strategies themselves, each built on a gloo_tpu plane:
   host-plane gradient allreduce over the C++ TCP transport (the exact role
   the reference plays under PyTorch DDP);
 - `tp`: Megatron-style tensor parallelism (column/row-parallel dense);
-- `sp`: sequence/context parallelism — ring attention over ppermute.
+- `sp`: sequence/context parallelism — ring attention over ppermute;
+- `pp`: GPipe-style pipeline parallelism — stages rotate activations
+  with ppermute under one lax.scan;
+- `ep`: expert parallelism — fixed-capacity MoE dispatch/combine over
+  all_to_all.
 """
 
 from gloo_tpu.parallel.ddp import HostGradSync, make_ddp_train_step
+from gloo_tpu.parallel.ep import dispatch_combine
+from gloo_tpu.parallel.pp import pipeline_apply
 from gloo_tpu.parallel.sp import ring_attention
 from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
                                   tp_mlp_block)
@@ -19,7 +25,9 @@ from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
 __all__ = [
     "HostGradSync",
     "column_parallel_dense",
+    "dispatch_combine",
     "make_ddp_train_step",
+    "pipeline_apply",
     "ring_attention",
     "row_parallel_dense",
     "tp_mlp_block",

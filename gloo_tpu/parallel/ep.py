@@ -1,0 +1,73 @@
+"""Expert parallelism: MoE token routing over all_to_all.
+
+The canonical EP pattern the reference's alltoall exists to serve
+(SURVEY.md §2.10: "alltoall → EP/MoE routing"), expressed on the device
+plane: each device holds one expert shard; tokens are bucketed by
+assigned expert with fixed capacity, dispatched with a single all_to_all
+over ICI, processed by the local expert, and combined back by a second
+all_to_all.
+
+Fixed-capacity dispatch keeps shapes static for XLA: each device sends
+exactly `capacity` token slots to every expert; overflow tokens are
+dropped (their combine weight is zero), the standard MoE capacity-factor
+discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gloo_tpu.tpu import spmd
+
+
+def dispatch_combine(expert_fn: Callable, tokens, expert_idx, capacity: int,
+                     axis: str):
+    """Route tokens to experts and back. Call inside shard_map.
+
+    Per-device arguments:
+      tokens: (T, D) local tokens;
+      expert_idx: (T,) int32 assigned expert (global expert e lives on
+        mesh position e);
+      capacity: slots this device reserves PER expert.
+    Returns (T, D): expert outputs aligned with the input tokens (zeros
+    for overflow tokens).
+    """
+    n_experts = spmd.size(axis)
+    t_local, d = tokens.shape
+
+    # Position of each token within its expert bucket. Out-of-range
+    # assignments (router bug) are dropped like overflow — without the
+    # explicit bound check they would silently alias another expert's slot
+    # through the combine gather's index clipping.
+    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    pos_in_bucket = jnp.cumsum(one_hot, axis=0) * one_hot - one_hot
+    pos = pos_in_bucket.sum(axis=1)  # (T,)
+    keep = jnp.logical_and(pos < capacity,
+                           jnp.logical_and(expert_idx >= 0,
+                                           expert_idx < n_experts))
+
+    # Scatter tokens into the send buffer. Overflow tokens go to a dummy
+    # expert row (sliced off below) so they can never clobber a kept
+    # token's slot.
+    send = jnp.zeros((n_experts + 1, capacity, d), tokens.dtype)
+    send = send.at[jnp.where(keep, expert_idx, n_experts),
+                   jnp.where(keep, pos, 0)].set(tokens)
+    send = send[:n_experts]
+
+    # Dispatch: slot (e, c) goes to expert e; gather every device's bucket.
+    arrived = spmd.alltoall(send, axis, split_axis=0, concat_axis=0)
+    arrived = arrived.reshape(n_experts * capacity, d)
+
+    # Local expert processes all arrived tokens.
+    processed = expert_fn(arrived).reshape(n_experts, capacity, d)
+
+    # Combine: send results back to their source devices.
+    returned = spmd.alltoall(processed, axis, split_axis=0, concat_axis=0)
+
+    # Un-scatter back to token order.
+    out = returned[expert_idx, jnp.where(keep, pos, 0)]
+    return jnp.where(keep[:, None], out, jnp.zeros_like(out))

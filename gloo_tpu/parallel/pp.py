@@ -1,0 +1,79 @@
+"""Pipeline parallelism over the device mesh.
+
+GPipe-style schedule built from gloo_tpu collectives: stage weights live
+on their pipe-axis position, microbatches march stage-to-stage with
+`spmd.shift` (ppermute over ICI), and a `lax.scan` over ticks keeps the
+whole schedule one compiled XLA program with static control flow.
+
+The classic pipelining identity: with S stages and M microbatches the
+schedule runs S + M - 1 ticks; at tick t, stage s computes microbatch
+t - s (when 0 <= t - s < M). Each device applies only its own stage
+function; activations rotate right one stage per tick.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from gloo_tpu.tpu import spmd
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
+                   axis: str):
+    """Run a pipeline of `stage_fn` across the mesh axis.
+
+    Call inside shard_map. Per-device arguments:
+      stage_params: this device's stage weights (stage s on position s);
+      x_microbatches: (M, ...) microbatches, only meaningful on stage 0
+        (other stages may pass zeros of the same shape).
+    Returns (M, ...) outputs, meaningful on the LAST stage.
+
+    stage_fn(params, x) -> y must be shape-preserving across stages (equal
+    widths) so activations can rotate; pad stages to a common width
+    otherwise.
+    """
+    stages = spmd.size(axis)
+    my_stage = spmd.rank(axis)
+    m = x_microbatches.shape[0]
+    ticks = stages + m - 1
+
+    def tick(carry, t):
+        inflight, outputs = carry
+        # Which microbatch does stage 0 inject this tick?
+        feed_idx = jnp.clip(t, 0, m - 1)
+        injected = x_microbatches[feed_idx]
+        incoming = jnp.where(my_stage == 0, injected, inflight)
+
+        computed = stage_fn(stage_params, incoming)
+        # Stages outside their active window pass zeros along; harmless
+        # because their results are never recorded.
+        active = jnp.logical_and(t - my_stage >= 0, t - my_stage < m)
+        computed = jnp.where(active, computed, jnp.zeros_like(computed))
+
+        # Record finished microbatch t - (stages - 1) on the last stage.
+        done_idx = jnp.clip(t - (stages - 1), 0, m - 1)
+        record = jnp.logical_and(my_stage == stages - 1,
+                                 jnp.logical_and(t >= stages - 1,
+                                                 t - (stages - 1) < m))
+        outputs = jnp.where(
+            record,
+            outputs.at[done_idx].set(computed),
+            outputs)
+
+        # Rotate activations to the next stage.
+        nxt = spmd.shift(computed, axis, 1)
+        return (nxt, outputs), None
+
+    # pcast: the carry becomes device-varying after the first tick; fresh
+    # zeros must be pre-marked to keep scan carry types stable under
+    # shard_map's vma checking.
+    inflight0 = lax.pcast(jnp.zeros_like(x_microbatches[0]), (axis,),
+                          to="varying")
+    outputs0 = lax.pcast(jnp.zeros_like(x_microbatches), (axis,),
+                         to="varying")
+    (_, outputs), _ = lax.scan(tick, (inflight0, outputs0),
+                               jnp.arange(ticks))
+    return outputs

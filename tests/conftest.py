@@ -19,6 +19,10 @@ if _REPO_ROOT not in sys.path:
 
 
 def pytest_configure(config):
-    # Build (or rebuild) the native core once per session.
+    # Build (or rebuild) the native core once per session. Sanitizer runs
+    # set TPUCOLL_SKIP_BUILD=1 (the toolchain cannot run under LD_PRELOADed
+    # sanitizer runtimes) and point TPUCOLL_LIB at a prebuilt library.
+    if os.environ.get("TPUCOLL_SKIP_BUILD"):
+        return
     subprocess.run(["make", "native"], cwd=_REPO_ROOT, check=True,
                    capture_output=True)
