@@ -53,7 +53,7 @@ void usage() {
           "tpucoll_bench --rank R --size P (--store file:PATH|tcp:H:P | "
           "--serve PORT)\n"
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
-          "alltoall|barrier|sendrecv]\n"
+          "alltoall|barrier|pairwise_exchange|sendrecv]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n");
 }
@@ -300,6 +300,38 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
     w.verifyOnce = [run] {
       run();
       return true;
+    };
+  } else if (o.op == "pairwise_exchange") {
+    // Reference workload (gloo/benchmark pairwise_exchange.h): every rank
+    // exchanges `elements` floats with each XOR partner per iteration.
+    TC_ENFORCE((size & (size - 1)) == 0,
+               "pairwise_exchange needs a power-of-2 size");
+    buf.assign(elements, float(rank));
+    out.assign(elements, 0.f);
+    std::shared_ptr<tpucoll::transport::UnboundBuffer> sb(
+        ctx.createUnboundBuffer(buf.data(), buf.size() * sizeof(float))
+            .release());
+    std::shared_ptr<tpucoll::transport::UnboundBuffer> rb(
+        ctx.createUnboundBuffer(out.data(), out.size() * sizeof(float))
+            .release());
+    w.algBytes = elements * sizeof(float) * (size - 1);
+    std::function<void()> run = [ctxp, sb, rb, rank, size] {
+      for (int step = 1; step < size; step++) {
+        const int partner = rank ^ step;
+        const uint64_t slot = ctxp->nextSlot();
+        // Matching slot on both sides: nextSlot advances in lockstep
+        // because every rank runs the same schedule.
+        rb->recv(partner, slot);
+        sb->send(partner, slot);
+        rb->waitRecv(nullptr, std::chrono::milliseconds(30000));
+        sb->waitSend(std::chrono::milliseconds(30000));
+      }
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, rank, size] {
+      run();
+      // After the last step, out holds the last partner's rank value.
+      return out.empty() || out[0] == float(rank ^ (size - 1));
     };
   } else if (o.op == "sendrecv") {
     TC_ENFORCE_EQ(size, 2, "sendrecv runs with exactly 2 ranks");

@@ -1,0 +1,137 @@
+// Native unit tests for pieces below the Python binding surface: slot
+// arithmetic, dtype/reduction kernels (including the vector half paths),
+// float16/bfloat16 conversions, and the HMAC-SHA256 vectors. The pytest
+// suite covers everything above via the C API; this binary covers what it
+// cannot observe directly. Exit code 0 = all passed.
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tpucoll/common/hmac.h"
+#include "tpucoll/math.h"
+#include "tpucoll/types.h"
+
+namespace {
+
+int failures = 0;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);      \
+      failures++;                                                          \
+    }                                                                      \
+  } while (0)
+
+void testSlot() {
+  using tpucoll::Slot;
+  using tpucoll::SlotPrefix;
+  auto s = Slot::build(SlotPrefix::kAllreduce, 0xABCD);
+  CHECK(s.value() >> 56 == uint64_t(SlotPrefix::kAllreduce));
+  CHECK(((s.value() >> 24) & 0xFFFFFFFF) == 0xABCD);
+  CHECK(s.offset(7).value() == s.value() + 7);
+  bool threw = false;
+  try {
+    s.offset(uint64_t(1) << 24);
+  } catch (const tpucoll::EnforceError&) {
+    threw = true;
+  }
+  CHECK(threw);  // delta overflow must be rejected
+}
+
+void testHalfConversions() {
+  using tpucoll::floatToHalf;
+  using tpucoll::halfToFloat;
+  // Exact round trips for representable values.
+  for (float v : {0.0f, 1.0f, -2.5f, 65504.0f, 0.0009765625f}) {
+    CHECK(halfToFloat(floatToHalf(v)) == v);
+  }
+  CHECK(std::isinf(halfToFloat(floatToHalf(1e6f))));     // overflow -> inf
+  CHECK(halfToFloat(floatToHalf(1e-10f)) == 0.0f);       // underflow -> 0
+  CHECK(std::isnan(halfToFloat(floatToHalf(NAN))));
+  // bfloat16: round-to-nearest-even.
+  using tpucoll::bfloat16ToFloat;
+  using tpucoll::floatToBfloat16;
+  CHECK(bfloat16ToFloat(floatToBfloat16(1.0f)) == 1.0f);
+  CHECK(std::isnan(bfloat16ToFloat(floatToBfloat16(NAN))));
+}
+
+void testReduceKernels() {
+  using tpucoll::DataType;
+  using tpucoll::getReduceFn;
+  using tpucoll::ReduceOp;
+  // fp32 sum
+  std::vector<float> a(1037, 1.5f), b(1037, 2.25f);
+  getReduceFn(DataType::kFloat32, ReduceOp::kSum)(a.data(), b.data(),
+                                                  a.size());
+  for (float v : a) {
+    CHECK(v == 3.75f);
+  }
+  // fp16 vector+tail path
+  std::vector<uint16_t> ha(1037, tpucoll::floatToHalf(1.5f));
+  std::vector<uint16_t> hb(1037, tpucoll::floatToHalf(2.25f));
+  getReduceFn(DataType::kFloat16, ReduceOp::kSum)(ha.data(), hb.data(),
+                                                  ha.size());
+  for (uint16_t v : ha) {
+    CHECK(tpucoll::halfToFloat(v) == 3.75f);
+  }
+  // bf16 vector+tail path
+  std::vector<uint16_t> ba(1037, tpucoll::floatToBfloat16(1.5f));
+  std::vector<uint16_t> bb(1037, tpucoll::floatToBfloat16(2.25f));
+  getReduceFn(DataType::kBFloat16, ReduceOp::kSum)(ba.data(), bb.data(),
+                                                   ba.size());
+  for (uint16_t v : ba) {
+    CHECK(tpucoll::bfloat16ToFloat(v) == 3.75f);
+  }
+  // int64 max
+  std::vector<int64_t> ia{3, -5, 7}, ib{1, -2, 9};
+  getReduceFn(DataType::kInt64, ReduceOp::kMax)(ia.data(), ib.data(), 3);
+  CHECK(ia[0] == 3 && ia[1] == -2 && ia[2] == 9);
+}
+
+void testHmacVectors() {
+  auto hex = [](const std::array<uint8_t, 32>& mac) {
+    char buf[65];
+    for (int i = 0; i < 32; i++) {
+      snprintf(buf + 2 * i, 3, "%02x", mac[i]);
+    }
+    return std::string(buf);
+  };
+  CHECK(hex(tpucoll::sha256("abc", 3)) ==
+        "ba7816bf8f01cfea414140de5dae2223"
+        "b00361a396177a9cb410ff61f20015ad");
+  CHECK(hex(tpucoll::hmacSha256("Jefe", 4,
+                                "what do ya want for nothing?", 28)) ==
+        "5bdcc146bf60754e6a042426089575c7"
+        "5a003f089d2739839dec58b964ec3843");
+  // Long-key path (key > block size gets hashed first).
+  std::string longKey(131, 0xaa);
+  std::string msg = "Test Using Larger Than Block-Size Key - Hash Key First";
+  CHECK(hex(tpucoll::hmacSha256(longKey.data(), longKey.size(), msg.data(),
+                                msg.size())) ==
+        "60e431591ee0b67f0d8a26aacbf5b77f"
+        "8e0bc6213728c5140546040f0ee37f54");
+  // Constant-time compare behaves as equality.
+  auto m1 = tpucoll::sha256("x", 1);
+  auto m2 = m1;
+  CHECK(tpucoll::macEqual(m1.data(), m2.data(), 32));
+  m2[31] ^= 1;
+  CHECK(!tpucoll::macEqual(m1.data(), m2.data(), 32));
+}
+
+}  // namespace
+
+int main() {
+  testSlot();
+  testHalfConversions();
+  testReduceKernels();
+  testHmacVectors();
+  if (failures == 0) {
+    printf("tpucoll_unit: all tests passed\n");
+    return 0;
+  }
+  fprintf(stderr, "tpucoll_unit: %d failure(s)\n", failures);
+  return 1;
+}

@@ -1,0 +1,16 @@
+"""Runs the native unit-test binary (slot arithmetic, dtype conversions,
+vector reduction kernels, HMAC vectors — internals the C API doesn't
+expose directly)."""
+
+import os
+import subprocess
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_native_units():
+    binary = os.path.join(_REPO, "build", "tpucoll_unit")
+    result = subprocess.run([binary], capture_output=True, text=True,
+                            timeout=60)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "all tests passed" in result.stdout

@@ -145,9 +145,8 @@ def test_host_grad_sync_matches_mean():
 
     results = spawn(size, fn)
     w_expect = np.full((5, 3), np.mean(range(size)), np.float32)
-    b_expect = np.arange(3, np.float32) * np.mean(
-        [r + 1 for r in range(size)]) if False else \
-        np.arange(3, dtype=np.float32) * np.mean([r + 1 for r in range(size)])
+    b_expect = np.arange(3, dtype=np.float32) * np.mean(
+        [r + 1 for r in range(size)])
     for res in results:
         np.testing.assert_allclose(res["w"], w_expect, rtol=1e-6)
         np.testing.assert_allclose(res["b"], b_expect, rtol=1e-6)
