@@ -1,0 +1,111 @@
+"""Randomized soak: sequences of mixed collectives with random shapes,
+dtypes, ops, and algorithms, all mirrored against numpy. A last line of
+defense for matcher/schedule interactions no targeted test covers."""
+
+import numpy as np
+import pytest
+
+from tests.harness import spawn
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64]
+
+
+def _tol(dtype):
+    """Cross-rank float sums are order-dependent; tolerances scale with
+    dtype precision (random inputs cancel, inflating relative error)."""
+    if dtype == np.float32:
+        return dict(rtol=1e-4, atol=1e-5)
+    if dtype == np.float64:
+        return dict(rtol=1e-9, atol=1e-12)
+    return dict(rtol=0, atol=0)
+
+
+def _expected_reduce(inputs, op):
+    acc = inputs[0].astype(np.float64)
+    for x in inputs[1:]:
+        x = x.astype(np.float64)
+        acc = {"sum": np.add, "min": np.minimum, "max": np.maximum}[op](acc, x)
+    return acc
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_collective_sequences(seed):
+    rng = np.random.RandomState(seed)
+    size = int(rng.choice([2, 3, 4, 8]))
+    steps = 12
+    # Pre-generate the shared schedule (every rank must agree).
+    schedule = []
+    for i in range(steps):
+        kind = rng.choice(["allreduce", "broadcast", "allgather",
+                           "reduce_scatter", "alltoall", "barrier"])
+        count = int(rng.randint(1, 20000))
+        dtype = DTYPES[rng.randint(len(DTYPES))]
+        op = str(rng.choice(["sum", "min", "max"]))
+        algo = str(rng.choice(["ring", "halving_doubling", "bcube"]))
+        root = int(rng.randint(size))
+        schedule.append((kind, count, dtype, op, algo, root))
+
+    def make_input(rank, i, count, dtype):
+        r = np.random.RandomState(1000 * i + rank)
+        if np.issubdtype(dtype, np.integer):
+            return r.randint(-50, 50, count).astype(dtype)
+        return (r.randn(count) * 3).astype(dtype)
+
+    def fn(ctx, rank):
+        outs = []
+        for i, (kind, count, dtype, op, algo, root) in enumerate(schedule):
+            x = make_input(rank, i, count, dtype)
+            if kind == "allreduce":
+                ctx.allreduce(x, op=op, algorithm=algo, tag=i)
+                outs.append(x)
+            elif kind == "broadcast":
+                ctx.broadcast(x, root=root, tag=i)
+                outs.append(x)
+            elif kind == "allgather":
+                outs.append(ctx.allgather(x, tag=i))
+            elif kind == "reduce_scatter":
+                counts = [count // size] * size
+                counts[-1] += count % size
+                outs.append(ctx.reduce_scatter(x, recv_counts=counts,
+                                               op=op, tag=i))
+            elif kind == "alltoall":
+                per = max(count // size, 1)
+                a = make_input(rank, i, per * size, dtype).reshape(size, per)
+                outs.append(ctx.alltoall(a, tag=i))
+            else:
+                ctx.barrier(tag=i)
+                outs.append(None)
+        return outs
+
+    results = spawn(size, fn, timeout=120)
+
+    for i, (kind, count, dtype, op, algo, root) in enumerate(schedule):
+        ins = [make_input(r, i, count, dtype) for r in range(size)]
+        for rank in range(size):
+            got = results[rank][i]
+            if kind == "allreduce":
+                np.testing.assert_allclose(
+                    got.astype(np.float64), _expected_reduce(ins, op),
+                    err_msg=f"step {i} {kind} {algo}", **_tol(dtype))
+            elif kind == "broadcast":
+                np.testing.assert_array_equal(got, ins[root],
+                                              err_msg=f"step {i}")
+            elif kind == "allgather":
+                np.testing.assert_array_equal(got, np.stack(ins),
+                                              err_msg=f"step {i}")
+            elif kind == "reduce_scatter":
+                counts = [count // size] * size
+                counts[-1] += count % size
+                off = sum(counts[:rank])
+                np.testing.assert_allclose(
+                    got.astype(np.float64),
+                    _expected_reduce(ins, op)[off:off + counts[rank]],
+                    err_msg=f"step {i}", **_tol(dtype))
+            elif kind == "alltoall":
+                per = max(count // size, 1)
+                a2a_ins = [make_input(r, i, per * size, dtype)
+                           .reshape(size, per) for r in range(size)]
+                expected = np.stack([a2a_ins[src][rank]
+                                     for src in range(size)])
+                np.testing.assert_array_equal(got, expected,
+                                              err_msg=f"step {i}")

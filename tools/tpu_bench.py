@@ -1,0 +1,126 @@
+"""Device-plane benchmark: the north star's `--device tpu` sweep.
+
+Measures compiled mesh collectives (the XLA/ICI path) and the Pallas ring
+kernels over whatever devices are visible — a real TPU slice in
+production, or a forced CPU mesh for functional runs:
+
+    python tools/tpu_bench.py --op allreduce --elements 1024,1048576
+    JAX_PLATFORMS_FORCE_CPU=8 python tools/tpu_bench.py --op all
+
+Reports the same min/p50/p99/algbw table as tpucoll_bench. On a single
+device, collectives compile and execute but involve no inter-chip
+traffic; numbers then measure dispatch + on-chip bandwidth only (noted
+in the header).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--op", default="allreduce",
+                        choices=["allreduce", "allgather", "reduce_scatter",
+                                 "alltoall", "ppermute", "pallas_ring",
+                                 "pallas_ring_hbm", "all"])
+    parser.add_argument("--elements", default="1024,65536,1048576,16777216")
+    parser.add_argument("--min-time", type=float, default=1.0)
+    parser.add_argument("--warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    force_cpu = os.environ.get("JAX_PLATFORMS_FORCE_CPU")
+    if force_cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count="
+                                   f"{force_cpu}").strip()
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from gloo_tpu.tpu import make_mesh, spmd
+
+    mesh = make_mesh()
+    n = int(np.prod(list(mesh.shape.values())))
+    axis = mesh.axis_names[0]
+    platform = jax.devices()[0].platform
+    print(f"# tpu_bench devices={n}x{platform} mesh={dict(mesh.shape)}"
+          + (" (single device: dispatch/on-chip only)" if n == 1 else ""))
+    print(f"{'op':>16} {'bytes':>12} {'elements':>12} {'min(us)':>9} "
+          f"{'p50(us)':>9} {'p99(us)':>9} {'algbw(GB/s)':>12} {'iters':>7}")
+
+    def build(op, elements):
+        per = max(elements // n, 1)
+        if op in ("pallas_ring", "pallas_ring_hbm"):
+            from gloo_tpu.ops import ring_allreduce, ring_allreduce_hbm
+            base = (ring_allreduce if op == "pallas_ring"
+                    else ring_allreduce_hbm)
+            # CPU backends only run pallas through the interpreter.
+            interp = jax.devices()[0].platform == "cpu"
+            kern = lambda s, a: base(s, a, interpret=interp)  # noqa: E731
+            rows = max(per // 128, n)
+            rows -= rows % n or 0
+            rows = max(rows, n)
+            if op == "pallas_ring_hbm" and (rows // n) > 256:
+                rows -= rows % (256 * n)
+            x = jnp.ones((n * rows, 128), jnp.float32)
+            fn = jax.jit(jax.shard_map(lambda s: kern(s, axis), mesh=mesh,
+                                       in_specs=P(axis), out_specs=P(axis)))
+            nbytes = rows * 128 * 4  # per-shard payload
+            return fn, (x,), nbytes
+        x = jnp.ones((n, per), jnp.float32)
+        shard_ops = {
+            "allreduce": lambda s: spmd.allreduce(s, axis),
+            "allgather": lambda s: spmd.allgather(s[0], axis)[None],
+            "reduce_scatter": lambda s: spmd.reduce_scatter(
+                s[0].reshape(n, -1) if per >= n else s, axis)[None],
+            "alltoall": lambda s: spmd.alltoall(
+                s[0].reshape(n, -1), axis)[None] if per >= n else s,
+            "ppermute": lambda s: spmd.shift(s, axis, 1),
+        }
+        fn = jax.jit(jax.shard_map(shard_ops[op], mesh=mesh,
+                                   in_specs=P(axis), out_specs=P(axis)))
+        return fn, (x,), per * 4
+
+    ops = (["allreduce", "allgather", "reduce_scatter", "alltoall",
+            "ppermute", "pallas_ring", "pallas_ring_hbm"]
+           if args.op == "all" else [args.op])
+    elements_list = [int(e) for e in args.elements.split(",")]
+
+    for op in ops:
+        for elements in elements_list:
+            try:
+                fn, fargs, nbytes = build(op, elements)
+                out = fn(*fargs)
+                jax.block_until_ready(out)
+            except Exception as exc:  # noqa: BLE001
+                print(f"{op:>16} {'-':>12} {elements:>12}   skipped: "
+                      f"{str(exc)[:50]}")
+                continue
+            for _ in range(args.warmup):
+                jax.block_until_ready(fn(*fargs))
+            samples = []
+            t_start = time.perf_counter()
+            while time.perf_counter() - t_start < args.min_time:
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*fargs))
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            p = lambda q: samples[min(len(samples) - 1,
+                                      int(q * len(samples)))] * 1e6
+            algbw = nbytes / (p(0.5) / 1e6) / 1e9
+            print(f"{op:>16} {nbytes:>12} {elements:>12} {p(0):>9.1f} "
+                  f"{p(0.5):>9.1f} {p(0.99):>9.1f} {algbw:>12.3f} "
+                  f"{len(samples):>7}")
+
+
+if __name__ == "__main__":
+    main()
