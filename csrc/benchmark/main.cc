@@ -166,6 +166,8 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
 
   auto algo = o.algorithm == "ring"    ? AllreduceAlgorithm::kRing
               : o.algorithm == "bcube" ? AllreduceAlgorithm::kBcube
+              : o.algorithm == "ring_bf16_wire"
+                  ? AllreduceAlgorithm::kRingBf16Wire
               : (o.algorithm == "hd" || o.algorithm == "halving_doubling")
                   ? AllreduceAlgorithm::kHalvingDoubling
                   : AllreduceAlgorithm::kAuto;

@@ -37,5 +37,9 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
                     ReduceFn fn, Slot slot,
                     std::chrono::milliseconds timeout);
 
+// Ring allreduce with bfloat16 wire compression (float32 payloads).
+void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
+                           std::chrono::milliseconds timeout);
+
 }  // namespace algorithms
 }  // namespace tpucoll

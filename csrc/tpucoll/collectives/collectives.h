@@ -56,6 +56,11 @@ enum class AllreduceAlgorithm : uint8_t {
   kRing = 1,
   kHalvingDoubling = 2,
   kBcube = 3,
+  // bfloat16 wire compression (float32 payloads only): halves bytes on
+  // the wire; accumulation stays float32; all ranks receive identical
+  // results. Opt-in — see collectives_compressed.cc for the precision
+  // contract.
+  kRingBf16Wire = 4,
 };
 
 struct AllreduceOptions : CollectiveOptions {

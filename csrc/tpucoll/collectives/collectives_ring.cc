@@ -261,9 +261,10 @@ void allreduce(AllreduceOptions& opts) {
     }
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1,
-        algo == AllreduceAlgorithm::kRing    ? "ring"
-        : algo == AllreduceAlgorithm::kBcube ? "bcube"
-                                             : "halving_doubling");
+        algo == AllreduceAlgorithm::kRing          ? "ring"
+        : algo == AllreduceAlgorithm::kBcube       ? "bcube"
+        : algo == AllreduceAlgorithm::kRingBf16Wire ? "ring_bf16_wire"
+                                                    : "halving_doubling");
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -276,6 +277,14 @@ void allreduce(AllreduceOptions& opts) {
       case AllreduceAlgorithm::kBcube:
         algorithms::bcubeAllreduce(ctx, work, opts.count, elsize, fn, slot,
                                    timeout);
+        break;
+      case AllreduceAlgorithm::kRingBf16Wire:
+        TC_ENFORCE(opts.dtype == DataType::kFloat32,
+                   "bf16-wire allreduce requires float32 payloads");
+        TC_ENFORCE(opts.op == ReduceOp::kSum,
+                   "bf16-wire allreduce supports sum only");
+        algorithms::bf16WireRingAllreduce(ctx, work, opts.count, slot,
+                                          timeout);
         break;
       default:
         TC_THROW(EnforceError, "unknown allreduce algorithm");

@@ -253,6 +253,59 @@ ReduceFn pickBf16Op(ReduceOp op) {
 
 }  // namespace
 
+void f32StreamToBf16(const float* src, uint16_t* dst, size_t n) {
+  size_t i = 0;
+#ifdef TC_HAVE_VECTOR_HALF
+  const __m256i zero = _mm256_setzero_si256();
+  for (; i + 8 <= n; i += 8) {
+    __m256i bits = _mm256_castps_si256(_mm256_loadu_ps(src + i));
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16),
+                                   _mm256_set1_epi32(1));
+    __m256i rounded = _mm256_add_epi32(
+        _mm256_add_epi32(bits, _mm256_set1_epi32(0x7fff)), lsb);
+    __m256i hi = _mm256_srli_epi32(rounded, 16);
+    __m256i packed = _mm256_packus_epi32(hi, zero);
+    packed = _mm256_permute4x64_epi64(packed, 0x08);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_castsi256_si128(packed));
+  }
+#endif
+  for (; i < n; i++) {
+    dst[i] = floatToBfloat16(src[i]);
+  }
+}
+
+void bf16StreamToF32(const uint16_t* src, float* dst, size_t n) {
+  size_t i = 0;
+#ifdef TC_HAVE_VECTOR_HALF
+  for (; i + 8 <= n; i += 8) {
+    __m256i w = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(src + i))), 16);
+    _mm256_storeu_ps(dst + i, _mm256_castsi256_ps(w));
+  }
+#endif
+  for (; i < n; i++) {
+    dst[i] = bfloat16ToFloat(src[i]);
+  }
+}
+
+void bf16StreamAccumulate(float* dst, const uint16_t* src, size_t n) {
+  size_t i = 0;
+#ifdef TC_HAVE_VECTOR_HALF
+  for (; i + 8 <= n; i += 8) {
+    __m256i w = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(src + i))), 16);
+    _mm256_storeu_ps(dst + i, _mm256_add_ps(_mm256_loadu_ps(dst + i),
+                                            _mm256_castsi256_ps(w)));
+  }
+#endif
+  for (; i < n; i++) {
+    dst[i] += bfloat16ToFloat(src[i]);
+  }
+}
+
 ReduceFn getReduceFn(DataType dtype, ReduceOp op) {
   switch (dtype) {
     case DataType::kInt8:

@@ -36,6 +36,13 @@ inline float bfloat16ToFloat(uint16_t b) {
 }
 uint16_t floatToBfloat16(float f);
 
+// Bulk wire codecs (vectorized where the ISA allows): float32 <-> bfloat16
+// streams for wire-compressed collectives.
+void f32StreamToBf16(const float* src, uint16_t* dst, size_t n);
+void bf16StreamToF32(const uint16_t* src, float* dst, size_t n);
+// dst[i] += decode(src[i])
+void bf16StreamAccumulate(float* dst, const uint16_t* src, size_t n);
+
 inline uint64_t log2ceil(uint64_t n) {
   uint64_t r = 0;
   while ((uint64_t(1) << r) < n) {

@@ -382,7 +382,7 @@ class Context:
         return array
 
     _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
-                   "bcube": 3}
+                   "bcube": 3, "ring_bf16_wire": 4}
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
                   tag: int = 0,

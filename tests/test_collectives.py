@@ -393,3 +393,22 @@ def test_context_fork():
     sa = size * (size + 1) / 2
     for a0, b0 in results:
         assert (a0, b0) == (sa, 2 * sa)
+
+
+def test_allreduce_bf16_wire():
+    """bf16 wire compression: fp32 accumulate, half the wire bytes, all
+    ranks bit-identical, error within bf16 rounding of the true sum."""
+    size, count = 4, 10_000
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float32)
+        ctx.allreduce(x, algorithm="ring_bf16_wire")
+        return x
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    for got in results:
+        # Per-hop requantization: allow a few bf16 ulps (~0.8% rel).
+        np.testing.assert_allclose(got, expected, rtol=3e-2)
+    for got in results[1:]:
+        np.testing.assert_array_equal(got, results[0])  # consensus
