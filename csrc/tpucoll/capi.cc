@@ -394,6 +394,22 @@ int tc_reduce_scatter(void* ctx, const void* input, void* output,
   });
 }
 
+int tc_allreduce_multi(void* ctx, const void** inputs, void** outputs,
+                       size_t nbuffers, size_t count, int dtype, int op,
+                       int algorithm, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllreduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.inputs.assign(inputs, inputs + nbuffers);
+    opts.outputs.assign(outputs, outputs + nbuffers);
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    opts.algorithm = static_cast<tpucoll::AllreduceAlgorithm>(algorithm);
+    tpucoll::allreduce(opts);
+  });
+}
+
 // ---- point-to-point ----
 
 void* tc_buffer_new(void* ctx, void* ptr, size_t size) {

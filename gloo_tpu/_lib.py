@@ -101,6 +101,9 @@ _PROTOTYPES = {
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32,
                             _i64]),
+    "tc_allreduce_multi": (_int, [_c, ctypes.POINTER(_c),
+                                  ctypes.POINTER(_c), _sz, _sz, _int,
+                                  _int, _int, _u32, _i64]),
     "tc_reduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32, _i64]),
     "tc_gather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_gatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _int,

@@ -400,6 +400,25 @@ class Context:
                                     _timeout_ms(timeout)))
         return array
 
+    def allreduce_multi(self, arrays, op="sum", algorithm: str = "auto",
+                        tag: int = 0,
+                        timeout: Optional[float] = None):
+        """Allreduce N local buffers together (the reference's multi-input
+        form for one-process-per-host, N-accelerator setups: local
+        reduction first, one network pass, result fanned to every
+        buffer). In-place on all arrays."""
+        arrays = [_check_array(a) for a in arrays]
+        assert arrays, "need at least one array"
+        assert all(a.dtype == arrays[0].dtype and a.size == arrays[0].size
+                   for a in arrays), "arrays must match in dtype and size"
+        ptrs = (ctypes.c_void_p * len(arrays))(
+            *[a.ctypes.data for a in arrays])
+        check(_lib.lib.tc_allreduce_multi(
+            self._handle, ptrs, ptrs, len(arrays), arrays[0].size,
+            _dtype_code(arrays[0]), ReduceOp.parse(op),
+            self._ALGORITHMS[algorithm], tag, _timeout_ms(timeout)))
+        return arrays
+
     def reduce(self, array: np.ndarray, root: int = 0, op="sum",
                output: Optional[np.ndarray] = None, tag: int = 0,
                timeout: Optional[float] = None) -> Optional[np.ndarray]:

@@ -336,3 +336,166 @@ def ring_allreduce_hbm(x, axis_name: str, collective_id: int = 8,
     return _ring_allreduce_hbm_shard(x, axis_name=axis_name,
                                      collective_id=collective_id,
                                      interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Quantized variant: int8 wire with per-chunk scales (EQuARX-style).
+# ---------------------------------------------------------------------------
+
+def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
+                              rs_recv, ack_sem, ag_send, ag_recv, *,
+                              axis_name: str, num_devices: int,
+                              chunk_rows: int):
+    """Ring allreduce sending int8 + a per-chunk float32 scale over ICI.
+
+    Accumulation stays float32 in o_ref; every hop quantizes the outgoing
+    chunk symmetrically (scale = max|chunk| / 127) and the receiver
+    dequantize-accumulates. The allgather phase quantizes each final block
+    once and forwards the int8 stream verbatim, so every rank decodes
+    identical values. Wire volume: ~1/4 of float32 plus one (8, 128)
+    scale tile per chunk hop.
+    """
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my - 1 + n, n)
+
+    o_ref[...] = x_ref[...]
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def chunk_slice(idx):
+        return pl.ds(idx * chunk_rows, chunk_rows)
+
+    def quantize(chunk):
+        scale = jnp.max(jnp.abs(chunk)) / 127.0
+        safe = jnp.maximum(scale, 1e-30)
+        q = jnp.clip(jnp.round(chunk / safe), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def rs_step(s, _):
+        send_chunk = lax.rem(my - s + n, n)
+        recv_chunk = lax.rem(my - s - 1 + n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 2)
+
+        q, scale = quantize(o_ref[chunk_slice(send_chunk), :])
+        qcomm_ref[2 + slot] = q  # local staging slots 2/3; wire slots 0/1
+        scomm_ref[2 + slot] = jnp.full((8, 128), scale, jnp.float32)
+        qdma = pltpu.make_async_remote_copy(
+            src_ref=qcomm_ref.at[2 + slot], dst_ref=qcomm_ref.at[slot],
+            send_sem=rs_send.at[slot], recv_sem=rs_recv.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        sdma = pltpu.make_async_remote_copy(
+            src_ref=scomm_ref.at[2 + slot], dst_ref=scomm_ref.at[slot],
+            send_sem=rs_send.at[slot], recv_sem=rs_recv.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        qdma.start()
+        sdma.start()
+        qdma.wait()
+        sdma.wait()
+
+        inc = (qcomm_ref[slot].astype(jnp.float32) *
+               scomm_ref[slot, 0, 0])
+        o_ref[chunk_slice(recv_chunk), :] = (
+            o_ref[chunk_slice(recv_chunk), :] + inc)
+        pltpu.semaphore_signal(ack_sem.at[slot], inc=2, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+
+    @pl.when(n >= 3)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 2)
+
+    @pl.when(n >= 2)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 2)
+
+    # Allgather: quantize the owned block once, adopt its decoded values
+    # locally, then forward the received int8 stream verbatim.
+    own = lax.rem(my + 1, n)
+    q0, scale0 = quantize(o_ref[chunk_slice(own), :])
+    qcomm_ref[2] = q0
+    scomm_ref[2] = jnp.full((8, 128), scale0, jnp.float32)
+    o_ref[chunk_slice(own), :] = q0.astype(jnp.float32) * scale0
+
+    def ag_step(s, _):
+        recv_chunk = lax.rem(my - s + n, n)
+        src_slot = jax.lax.select(s == 0, 2, lax.rem(s - 1, 2))
+        dst_slot = lax.rem(s, 2)
+        qdma = pltpu.make_async_remote_copy(
+            src_ref=qcomm_ref.at[src_slot], dst_ref=qcomm_ref.at[dst_slot],
+            send_sem=ag_send.at[2 * s], recv_sem=ag_recv.at[2 * s],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        sdma = pltpu.make_async_remote_copy(
+            src_ref=scomm_ref.at[src_slot], dst_ref=scomm_ref.at[dst_slot],
+            send_sem=ag_send.at[2 * s + 1], recv_sem=ag_recv.at[2 * s + 1],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        qdma.start()
+        sdma.start()
+        qdma.wait()
+        sdma.wait()
+        o_ref[chunk_slice(recv_chunk), :] = (
+            qcomm_ref[dst_slot].astype(jnp.float32) *
+            scomm_ref[dst_slot, 0, 0])
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "collective_id",
+                                    "interpret"))
+def _ring_allreduce_q8_shard(x, *, axis_name: str, collective_id: int,
+                             interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    assert x.dtype == jnp.float32, "q8 ring quantizes float32 payloads"
+    assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
+    chunk_rows = rows // n
+    assert chunk_rows % 32 == 0 or n == 1, \
+        "int8 tiling needs chunk rows divisible by 32"
+    kernel = functools.partial(_ring_allreduce_q8_kernel,
+                               axis_name=axis_name, num_devices=n,
+                               chunk_rows=chunk_rows)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            # 0/1: wire landing slots; 2/3: local staging before send.
+            pltpu.VMEM((4, chunk_rows, cols), jnp.int8),
+            pltpu.VMEM((4, 8, 128), jnp.float32),      # per-chunk scales
+            pltpu.SemaphoreType.DMA((2,)),             # rs send
+            pltpu.SemaphoreType.DMA((2,)),             # rs recv
+            pltpu.SemaphoreType.REGULAR((2,)),         # slot acks
+            pltpu.SemaphoreType.DMA((max(2 * (n - 1), 1),)),  # ag send
+            pltpu.SemaphoreType.DMA((max(2 * (n - 1), 1),)),  # ag recv
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+
+
+def ring_allreduce_q8(x, axis_name: str, collective_id: int = 9,
+                      interpret: bool = False):
+    """Quantized (int8 wire, per-chunk scale) sum-allreduce over the ICI
+    ring: ~4x less inter-chip traffic than float32 at ~2.4 decimal digits
+    of precision; all ranks receive identical values. float32 shards,
+    rows divisible by ring size, chunk rows divisible by 32."""
+    return _ring_allreduce_q8_shard(x, axis_name=axis_name,
+                                    collective_id=collective_id,
+                                    interpret=interpret)

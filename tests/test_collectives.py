@@ -412,3 +412,20 @@ def test_allreduce_bf16_wire():
         np.testing.assert_allclose(got, expected, rtol=3e-2)
     for got in results[1:]:
         np.testing.assert_array_equal(got, results[0])  # consensus
+
+
+def test_allreduce_multi_input():
+    """Multi-buffer allreduce: N local buffers reduced together, result in
+    every buffer (the reference's one-process-N-accelerators form)."""
+    size = 3
+
+    def fn(ctx, rank):
+        a = np.full(100, float(rank + 1), dtype=np.float32)
+        b = np.full(100, float(10 * (rank + 1)), dtype=np.float32)
+        ctx.allreduce_multi([a, b])
+        return float(a[0]), float(b[0])
+
+    results = spawn(size, fn)
+    expected = sum((r + 1) + 10 * (r + 1) for r in range(size))
+    for a0, b0 in results:
+        assert a0 == expected and b0 == expected
