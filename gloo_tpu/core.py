@@ -408,9 +408,12 @@ class Context:
         reduction first, one network pass, result fanned to every
         buffer). In-place on all arrays."""
         arrays = [_check_array(a) for a in arrays]
-        assert arrays, "need at least one array"
-        assert all(a.dtype == arrays[0].dtype and a.size == arrays[0].size
-                   for a in arrays), "arrays must match in dtype and size"
+        if not arrays:
+            raise Error("allreduce_multi needs at least one array")
+        if any(a.dtype != arrays[0].dtype or a.size != arrays[0].size
+               for a in arrays):
+            raise Error("allreduce_multi arrays must match in dtype and "
+                        "size")
         ptrs = (ctypes.c_void_p * len(arrays))(
             *[a.ctypes.data for a in arrays])
         check(_lib.lib.tc_allreduce_multi(

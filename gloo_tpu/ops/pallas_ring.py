@@ -422,23 +422,30 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 2)
 
     # Allgather: quantize the owned block once, adopt its decoded values
-    # locally, then forward the received int8 stream verbatim.
+    # locally, then forward the received int8 stream verbatim. Wire slots
+    # are PER STEP (no reuse): unlike the base kernel, payloads route
+    # through shared comm memory rather than distinct o_ref chunks, and a
+    # reused slot could be overwritten by a fast left neighbor two steps
+    # ahead before this device consumed or forwarded it.
     own = lax.rem(my + 1, n)
     q0, scale0 = quantize(o_ref[chunk_slice(own), :])
-    qcomm_ref[2] = q0
-    scomm_ref[2] = jnp.full((8, 128), scale0, jnp.float32)
+    stage = n - 1  # slot index used to stage the initial send
+    qcomm_ref[4 + stage] = q0
+    scomm_ref[4 + stage] = jnp.full((8, 128), scale0, jnp.float32)
     o_ref[chunk_slice(own), :] = q0.astype(jnp.float32) * scale0
 
     def ag_step(s, _):
         recv_chunk = lax.rem(my - s + n, n)
-        src_slot = jax.lax.select(s == 0, 2, lax.rem(s - 1, 2))
-        dst_slot = lax.rem(s, 2)
+        src_slot = jax.lax.select(s == 0, stage, s - 1)
+        dst_slot = s
         qdma = pltpu.make_async_remote_copy(
-            src_ref=qcomm_ref.at[src_slot], dst_ref=qcomm_ref.at[dst_slot],
+            src_ref=qcomm_ref.at[4 + src_slot],
+            dst_ref=qcomm_ref.at[4 + dst_slot],
             send_sem=ag_send.at[2 * s], recv_sem=ag_recv.at[2 * s],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
         sdma = pltpu.make_async_remote_copy(
-            src_ref=scomm_ref.at[src_slot], dst_ref=scomm_ref.at[dst_slot],
+            src_ref=scomm_ref.at[4 + src_slot],
+            dst_ref=scomm_ref.at[4 + dst_slot],
             send_sem=ag_send.at[2 * s + 1], recv_sem=ag_recv.at[2 * s + 1],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
         qdma.start()
@@ -446,8 +453,8 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
         qdma.wait()
         sdma.wait()
         o_ref[chunk_slice(recv_chunk), :] = (
-            qcomm_ref[dst_slot].astype(jnp.float32) *
-            scomm_ref[dst_slot, 0, 0])
+            qcomm_ref[4 + dst_slot].astype(jnp.float32) *
+            scomm_ref[4 + dst_slot, 0, 0])
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
@@ -461,9 +468,11 @@ def _ring_allreduce_q8_shard(x, *, axis_name: str, collective_id: int,
     n = lax.axis_size(axis_name)
     rows, cols = x.shape
     assert x.dtype == jnp.float32, "q8 ring quantizes float32 payloads"
+    if n == 1:
+        return x  # identity: never quantize when nothing moves
     assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
     chunk_rows = rows // n
-    assert chunk_rows % 32 == 0 or n == 1, \
+    assert chunk_rows % 32 == 0, \
         "int8 tiling needs chunk rows divisible by 32"
     kernel = functools.partial(_ring_allreduce_q8_kernel,
                                axis_name=axis_name, num_devices=n,
@@ -476,9 +485,10 @@ def _ring_allreduce_q8_shard(x, *, axis_name: str, collective_id: int,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            # 0/1: wire landing slots; 2/3: local staging before send.
-            pltpu.VMEM((4, chunk_rows, cols), jnp.int8),
-            pltpu.VMEM((4, 8, 128), jnp.float32),      # per-chunk scales
+            # 0/1: RS wire slots; 2/3: RS staging; 4..4+n-1: per-step AG
+            # wire slots (last doubles as the AG staging slot).
+            pltpu.VMEM((4 + n, chunk_rows, cols), jnp.int8),
+            pltpu.VMEM((4 + n, 8, 128), jnp.float32),  # per-chunk scales
             pltpu.SemaphoreType.DMA((2,)),             # rs send
             pltpu.SemaphoreType.DMA((2,)),             # rs recv
             pltpu.SemaphoreType.REGULAR((2,)),         # slot acks
