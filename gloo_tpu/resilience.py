@@ -1,0 +1,87 @@
+"""Failure recovery: rebuild the process group after a rank dies.
+
+The error contract (docs/errors.md, matching the reference's
+docs/errors.md) is that a transport failure poisons the context and the
+application re-rendezvouses. This module ships that pattern as code
+instead of advice: `rebuild_after_failure` coordinates the survivors of a
+failed collective into a fresh, contiguous, smaller group over the same
+store.
+
+Protocol (store-side, no working mesh required):
+ 1. every survivor announces itself under a new generation namespace and
+    bumps a membership counter;
+ 2. survivors wait a settle window for stragglers, then read the final
+    count and the announced ranks;
+ 3. old ranks map to new contiguous ranks by sort order, and a normal
+    full-mesh bootstrap runs in the generation's namespace.
+
+Generations make retries safe: a survivor that crashes during rebuild
+just triggers another round with generation + 1.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import gloo_tpu
+
+
+def rebuild_after_failure(store: "gloo_tpu.Store", device: "gloo_tpu.Device",
+                          old_rank: int, old_size: int, generation: int,
+                          settle: float = 1.0, timeout: float = 30.0,
+                          min_size: int = 2
+                          ) -> Tuple[Optional["gloo_tpu.Context"], int, int]:
+    """Form a new group from whoever shows up.
+
+    Returns (context, new_rank, new_size); context is None when fewer than
+    `min_size` survivors remain (caller decides whether to continue solo).
+    `generation` must increase on every rebuild attempt (start at 1).
+    """
+    gen = gloo_tpu.PrefixStore(store, f"rebuild/{generation}")
+    gen.set(f"alive/{old_rank}", str(time.time()).encode())
+    gen.add("count", 1)
+    deadline = time.time() + timeout
+
+    # Membership settles when no new survivor has announced for `settle`
+    # seconds. Survivors detect the failure at different times — a rank
+    # blocked on the dead peer only notices at its operation timeout — so
+    # `settle` must exceed the slowest survivor's detection lag (bound it
+    # by the per-op timeout your collectives use).
+    def roll_call():
+        found = []
+        for r in range(old_size):
+            try:
+                gen.get(f"alive/{r}", timeout=0.001)
+                found.append(r)
+            except gloo_tpu.Error:
+                continue
+        return found
+
+    last = -1
+    last_change = time.time()
+    survivors = []
+    while True:
+        count = gen.add("count", 0)
+        now = time.time()
+        if count != last:
+            last, last_change = count, now
+        elif now - last_change >= settle:
+            survivors = roll_call()
+            # Re-verify: anyone arriving during the roll call restarts the
+            # settle window instead of being split-brained out.
+            if gen.add("count", 0) == last and len(survivors) == last:
+                break
+        if now > deadline:
+            survivors = roll_call()
+            break
+        time.sleep(0.05)
+
+    if len(survivors) < min_size or old_rank not in survivors:
+        return None, -1, len(survivors)
+
+    new_rank = survivors.index(old_rank)
+    new_size = len(survivors)
+    ctx = gloo_tpu.Context(new_rank, new_size, timeout=timeout)
+    ctx.connect_full_mesh(gloo_tpu.PrefixStore(gen, "mesh"), device)
+    return ctx, new_rank, new_size

@@ -134,3 +134,42 @@ def test_peer_killed_during_bootstrap():
                          stderr=subprocess.PIPE, text=True)
     out, err = p.communicate(timeout=60)
     assert p.returncode == 10, (out, err)
+
+
+RECOVERY_BODY = """
+from gloo_tpu.resilience import rebuild_after_failure
+if rank == 2:
+    os.kill(os.getpid(), signal.SIGKILL)
+x = np.full(1 << 18, float(rank + 1), dtype=np.float32)
+try:
+    ctx.allreduce(x, timeout=2.0)
+    print("UNEXPECTED-SUCCESS"); sys.exit(3)
+except gloo_tpu.IoError:
+    pass
+# Survivors regroup into a fresh, smaller world and keep training. The
+# settle window must cover detection skew (bounded by the 2s op timeout).
+new_ctx, new_rank, new_size = rebuild_after_failure(
+    store, gloo_tpu.Device(), old_rank=rank, old_size=size, generation=1,
+    settle=3.0, timeout=30.0)
+assert new_ctx is not None, "rebuild failed"
+assert new_size == 2, new_size
+y = np.full(100, float(new_rank + 1), dtype=np.float32)
+new_ctx.allreduce(y)
+assert y[0] == 3.0, y[0]
+new_ctx.close()
+print(f"RECOVERED {rank}->{new_rank}/{new_size}")
+sys.exit(0)
+"""
+
+
+def test_survivors_rebuild_after_rank_death():
+    """The documented recovery contract as working code: a SIGKILL'd rank
+    poisons the group; survivors re-rendezvous into a smaller world over
+    the same store and run collectives again."""
+    store = tempfile.mkdtemp()
+    procs = [_spawn_worker(RECOVERY_BODY, r, 3, store) for r in range(3)]
+    outs = [p.communicate(timeout=90) for p in procs]
+    assert procs[2].returncode == -signal.SIGKILL
+    for r in (0, 1):
+        assert procs[r].returncode == 0, (r, outs[r])
+        assert "RECOVERED" in outs[r][0], outs[r]

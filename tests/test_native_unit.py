@@ -14,3 +14,13 @@ def test_native_units():
                             timeout=60)
     assert result.returncode == 0, result.stdout + result.stderr
     assert "all tests passed" in result.stdout
+
+
+def test_native_integration():
+    """Pure C++ 4-thread end-to-end (all collectives, p2p, fork); also the
+    leak-check target for ASAN runs."""
+    binary = os.path.join(_REPO, "build", "tpucoll_integration")
+    result = subprocess.run([binary], capture_output=True, text=True,
+                            timeout=120)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "all checks passed" in result.stdout
