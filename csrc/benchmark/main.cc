@@ -53,7 +53,7 @@ void usage() {
           "tpucoll_bench --rank R --size P (--store file:PATH|tcp:H:P | "
           "--serve PORT)\n"
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
-          "alltoall|barrier|pairwise_exchange|sendrecv]\n"
+          "reduce|gather|scatter|alltoall|barrier|pairwise_exchange|sendrecv]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n");
 }
@@ -301,6 +301,88 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
     w.run = run;
     w.verifyOnce = [run] {
       run();
+      return true;
+    };
+  } else if (o.op == "reduce") {
+    buf.assign(elements, float(rank + 1));
+    out.assign(elements, 0.f);
+    std::function<void()> run = [ctxp, &buf, &out, tag, rank] {
+      ReduceOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = rank == 0 ? out.data() : nullptr;
+      opts.count = buf.size();
+      opts.root = 0;
+      reduce(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, rank, size, elements] {
+      run();
+      if (rank != 0) {
+        return true;
+      }
+      const float expect = size * (size + 1) / 2.0f;
+      for (size_t i = 0; i < elements; i++) {
+        if (out[i] != expect) {
+          return false;
+        }
+      }
+      return true;
+    };
+  } else if (o.op == "gather") {
+    buf.assign(elements, float(rank));
+    out.assign(elements * size, 0.f);
+    std::function<void()> run = [ctxp, &buf, &out, tag, rank] {
+      GatherOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = rank == 0 ? out.data() : nullptr;
+      opts.count = buf.size();
+      opts.root = 0;
+      gather(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, rank, size, elements] {
+      run();
+      if (rank != 0) {
+        return true;
+      }
+      for (int r = 0; r < size; r++) {
+        if (out[r * elements] != float(r)) {
+          return false;
+        }
+      }
+      return true;
+    };
+  } else if (o.op == "scatter") {
+    // Root's chunk r holds float(r) so misrouted/misoffset chunks are
+    // detectable.
+    buf.resize(elements * size);
+    for (int r = 0; r < size; r++) {
+      std::fill(buf.begin() + r * elements, buf.begin() + (r + 1) * elements,
+                float(r));
+    }
+    out.assign(elements, -1.f);
+    std::function<void()> run = [ctxp, &buf, &out, tag, rank] {
+      ScatterOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = rank == 0 ? buf.data() : nullptr;
+      opts.output = out.data();
+      opts.count = out.size();
+      opts.root = 0;
+      scatter(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, elements, rank] {
+      run();
+      for (size_t i = 0; i < elements; i++) {
+        if (out[i] != float(rank)) {
+          return false;
+        }
+      }
       return true;
     };
   } else if (o.op == "pairwise_exchange") {

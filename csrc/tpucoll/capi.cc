@@ -211,6 +211,8 @@ uint64_t tc_next_slot(void* ctx, uint32_t num) {
   return asContext(ctx)->nextSlot(num);
 }
 
+void tc_debug_dump(void* ctx) { asContext(ctx)->transport()->debugDump(); }
+
 void tc_trace_start(void* ctx) { asContext(ctx)->tracer().start(); }
 
 void tc_trace_stop(void* ctx) { asContext(ctx)->tracer().stop(); }

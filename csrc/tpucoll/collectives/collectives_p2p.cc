@@ -1,5 +1,6 @@
 // Schedules built from direct point-to-point exchanges: barrier, broadcast,
 // gather(v), scatter, alltoall(v).
+#include <cstdlib>
 #include <cstring>
 
 #include "tpucoll/collectives/collectives.h"
@@ -43,7 +44,10 @@ void barrier(BarrierOptions& opts) {
 }
 
 // Binomial tree broadcast over virtual ranks (vrank 0 = root), matching the
-// reference's mask-walk participation scheme (gloo/broadcast.cc:44-84).
+// reference's mask-walk participation scheme (gloo/broadcast.cc:44-84) —
+// with segment pipelining: large payloads are split into 1 MiB segments
+// that relay toward the leaves as they arrive, so the tree's depth costs
+// one segment of latency instead of one full payload per level.
 void broadcast(BroadcastOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "broadcast: null context");
@@ -52,7 +56,8 @@ void broadcast(BroadcastOptions& opts) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   TC_ENFORCE(opts.root >= 0 && opts.root < size, "broadcast: bad root");
-  const size_t nbytes = opts.count * elementSize(opts.dtype);
+  const size_t elsize = elementSize(opts.dtype);
+  const size_t nbytes = opts.count * elsize;
   if (size == 1) {
     return;
   }
@@ -61,25 +66,62 @@ void broadcast(BroadcastOptions& opts) {
   const int vrank = (rank - opts.root + size) % size;
   auto physical = [&](int v) { return (v + opts.root) % size; };
 
-  // Climb until the bit where we receive from our parent.
+  // 4 MiB default: measured knee on loopback (finer segments pay more in
+  // per-message overhead than the relay pipelining saves; deep trees on
+  // real networks may prefer smaller via TPUCOLL_BCAST_SEG).
+  size_t kBroadcastSegment = 4 << 20;
+  if (const char* env = std::getenv("TPUCOLL_BCAST_SEG")) {
+    kBroadcastSegment = std::max<size_t>(std::atoll(env), 4096);
+  }
+  const size_t segBytes =
+      std::max(kBroadcastSegment / elsize * elsize, elsize);
+  const size_t numSegs = nbytes == 0 ? 1 : (nbytes + segBytes - 1) / segBytes;
+  auto segSpan = [&](size_t k) {
+    const size_t off = k * segBytes;
+    return std::make_pair(off, std::min(segBytes, nbytes - off));
+  };
+
+  // Parent (if any) and children at this node.
+  int parent = -1;
   int mask = 1;
   while (mask < size) {
     if (vrank & mask) {
-      buf->recv(physical(vrank - mask), slot.value(), 0, nbytes);
-      buf->waitRecv(nullptr, timeout);
+      parent = physical(vrank - mask);
       break;
     }
     mask <<= 1;
   }
-  // Fan out to children at decreasing distances.
-  mask >>= 1;
-  int pendingSends = 0;
-  while (mask > 0) {
-    if (vrank + mask < size) {
-      buf->send(physical(vrank + mask), slot.value(), 0, nbytes);
-      pendingSends++;
+  std::vector<int> children;
+  for (int m = mask >> 1; m > 0; m >>= 1) {
+    if (vrank + m < size) {
+      children.push_back(physical(vrank + m));
     }
-    mask >>= 1;
+  }
+
+  int pendingSends = 0;
+  if (parent >= 0) {
+    for (size_t k = 0; k < numSegs; k++) {
+      auto [off, len] = segSpan(k);
+      buf->recv(parent, slot.offset(k).value(), off, len);
+    }
+    for (size_t k = 0; k < numSegs; k++) {
+      auto [off, len] = segSpan(k);
+      buf->waitRecv(nullptr, timeout);
+      // Relay this segment onward the moment it lands (wire order makes
+      // completion k the k-th segment).
+      for (int child : children) {
+        buf->send(child, slot.offset(k).value(), off, len);
+        pendingSends++;
+      }
+    }
+  } else {
+    for (size_t k = 0; k < numSegs; k++) {
+      auto [off, len] = segSpan(k);
+      for (int child : children) {
+        buf->send(child, slot.offset(k).value(), off, len);
+        pendingSends++;
+      }
+    }
   }
   while (pendingSends-- > 0) {
     buf->waitSend(timeout);

@@ -1,5 +1,7 @@
 #include "tpucoll/transport/context.h"
 
+#include <algorithm>
+#include <cstdlib>
 #include <cstring>
 
 #include "tpucoll/transport/device.h"
@@ -53,6 +55,12 @@ Context::Context(std::shared_ptr<Device> device, int rank, int size)
   TC_ENFORCE(rank >= 0 && rank < size, "bad rank ", rank, " for size ", size);
   pairs_.resize(size);
   pairErrors_.resize(size);
+  stashBytes_.resize(size, 0);
+  rxPaused_.resize(size, 0);
+  stashHighWater_ = 64u << 20;
+  if (const char* env = std::getenv("TPUCOLL_MAX_STASH_BYTES")) {
+    stashHighWater_ = std::max<size_t>(std::atoll(env), 1u << 20);
+  }
 }
 
 Context::~Context() {
@@ -148,6 +156,7 @@ void Context::close() {
     }
     posted_.clear();
     stashed_.clear();
+    std::fill(stashBytes_.begin(), stashBytes_.end(), 0);
   }
   for (auto* b : victims) {
     b->onRecvError("context closed");
@@ -247,9 +256,33 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
                       "stashed message size mismatch on slot ", slot);
         std::memcpy(dest, it->data.data(), nbytes);
         stashSrc = it->srcRank;
+        if (stashSrc != rank_) {
+          stashBytes_[stashSrc] -= it->data.size();
+        }
         stashed_.erase(it);
         fromStash = true;
         break;
+      }
+    }
+    // Backpressure release policy: if this recv drained from the stash,
+    // resume its source only once the stash falls below the low watermark
+    // (an unconditional resume would refill faster than one-per-recv
+    // drains, growing the stash without bound). If the recv could NOT be
+    // satisfied locally, the wanted message is still on the wire: resume
+    // every admissible paused source so it can arrive — it is the oldest
+    // in-stream, so it lands in this posted recv before the flood stashes.
+    if (fromStash) {
+      if (stashSrc != rank_ && rxPaused_[stashSrc] && pairs_[stashSrc] &&
+          stashBytes_[stashSrc] < stashHighWater_ / 2) {
+        rxPaused_[stashSrc] = 0;
+        pairs_[stashSrc]->resumeReading();  // under mu_: see stashArrived
+      }
+    } else {
+      for (int r : srcRanks) {
+        if (rxPaused_[r] && pairs_[r]) {
+          rxPaused_[r] = 0;
+          pairs_[r]->resumeReading();
+        }
       }
     }
     if (!fromStash && liveAllowed == 0) {
@@ -333,12 +366,50 @@ void Context::stashArrived(int srcRank, uint64_t slot,
       rbuf = it->ubuf;
       posted_.erase(it);
     } else {
+      stashBytes_[srcRank] += data.size();
+      // Pause at the high watermark — but never while a posted receive
+      // still admits this source: that receive's message is somewhere
+      // behind the stashed traffic, and pausing would starve it (one
+      // message trickling per unrelated postRecv under concurrent tags).
+      bool postedWantsSrc = false;
+      for (const auto& pr : posted_) {
+        if (pr.allowed[srcRank]) {
+          postedWantsSrc = true;
+          break;
+        }
+      }
+      if (srcRank != rank_ && !postedWantsSrc &&
+          stashBytes_[srcRank] > stashHighWater_ && !rxPaused_[srcRank] &&
+          pairs_[srcRank]) {
+        rxPaused_[srcRank] = 1;
+        // Under mu_: the flag and the pair's epoll state must change
+        // atomically with respect to postRecv's resume path (ctx -> pair
+        // lock order, same as close()).
+        pairs_[srcRank]->pauseReading();
+      }
       stashed_.push_back(Stash{srcRank, slot, std::move(data)});
     }
   }
   if (rbuf != nullptr) {
     rbuf->onRecvComplete(src);
   }
+}
+
+void Context::debugDump() {
+  std::lock_guard<std::mutex> guard(mu_);
+  std::string s = "rank " + std::to_string(rank_) + ": posted=[";
+  for (auto& pr : posted_) {
+    s += "(slot=" + std::to_string(pr.slot & 0xFFFFFF) + ",allow=";
+    for (int r = 0; r < size_; r++) s += pr.allowed[r] ? std::to_string(r) : "";
+    s += ") ";
+  }
+  s += "] stash={";
+  for (int r = 0; r < size_; r++) {
+    s += std::to_string(r) + ":" + std::to_string(stashBytes_[r] >> 10) +
+         "KB" + (rxPaused_[r] ? "*PAUSED" : "") + " ";
+  }
+  s += "} stashedCount=" + std::to_string(stashed_.size());
+  fprintf(stderr, "%s\n", s.c_str());
 }
 
 void Context::onPairError(int rank, const std::string& message) {

@@ -83,6 +83,7 @@ class Context {
   // A pair failed: poison posted receives that could match it and record the
   // error for future sends.
   void onPairError(int rank, const std::string& message);
+  void debugDump();
 
  private:
   struct PostedRecv {
@@ -112,6 +113,13 @@ class Context {
   std::list<PostedRecv> posted_;
   std::deque<Stash> stashed_;
   std::vector<std::string> pairErrors_;
+  // Stash backpressure (mu_): bytes staged per source rank. Crossing the
+  // high watermark pauses that pair's socket (TCP throttles the sender);
+  // posting a receive that admits the rank resumes it — posted receives
+  // bypass the stash, so progress is always possible.
+  std::vector<size_t> stashBytes_;
+  std::vector<char> rxPaused_;
+  size_t stashHighWater_;
   bool closed_{false};
 };
 

@@ -338,7 +338,8 @@ void Pair::updateEpollMask() {
   if (fd_ < 0 || state_.load() != State::kConnected) {
     return;
   }
-  uint32_t desired = EPOLLIN | (tx_.empty() ? 0u : uint32_t(EPOLLOUT));
+  uint32_t desired = (rxPaused_ ? 0u : uint32_t(EPOLLIN)) |
+                     (tx_.empty() ? 0u : uint32_t(EPOLLOUT));
   if (desired != epollMask_) {
     loop_->mod(fd_, desired, this);
     epollMask_ = desired;
@@ -380,7 +381,25 @@ void Pair::handleEvents(uint32_t events) {
 }
 
 void Pair::readLoop() {
+  // Fairness/backpressure budget: a sender that keeps the socket full
+  // could otherwise pin the loop thread in this loop forever (EAGAIN
+  // never comes), starving sibling pairs and making pauseReading
+  // ineffective — the epoll mask only matters once we return to the
+  // loop. Level-triggered epoll re-fires if data remains.
+  constexpr size_t kReadBudget = 8u << 20;
+  size_t consumed = 0;
   while (state_.load() == State::kConnected) {
+    if (consumed >= kReadBudget) {
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      if (rxPaused_ && !rxInPayload_) {
+        // Stop at a message boundary; remaining bytes stay in the socket
+        // until the context resumes us.
+        return;
+      }
+    }
     if (!rxInPayload_) {
       char* hp = reinterpret_cast<char*>(&rxHeader_);
       ssize_t n = read(fd_, hp + rxHeaderRead_,
@@ -412,6 +431,7 @@ void Pair::readLoop() {
         return;
       }
       rxHeaderRead_ += static_cast<size_t>(n);
+      consumed += static_cast<size_t>(n);
       if (rxHeaderRead_ < sizeof(WireHeader)) {
         continue;
       }
@@ -483,6 +503,7 @@ void Pair::readLoop() {
         return;
       }
       rxPayloadRead_ += static_cast<size_t>(n);
+      consumed += static_cast<size_t>(n);
       if (rxPayloadRead_ == rxHeader_.nbytes) {
         finishMessage();
       }
@@ -514,6 +535,22 @@ void Pair::finishMessage() {
   rxInPayload_ = false;
   rxHeaderRead_ = 0;
   rxDest_ = nullptr;
+}
+
+void Pair::pauseReading() {
+  std::lock_guard<std::mutex> guard(mu_);
+  if (!rxPaused_) {
+    rxPaused_ = true;
+    updateEpollMask();
+  }
+}
+
+void Pair::resumeReading() {
+  std::lock_guard<std::mutex> guard(mu_);
+  if (rxPaused_) {
+    rxPaused_ = false;
+    updateEpollMask();
+  }
 }
 
 void Pair::fail(const std::string& message) {

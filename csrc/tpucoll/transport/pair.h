@@ -77,6 +77,12 @@ class Pair : public Handler {
   // Called by the listener (loop thread) when our inbound connection is up.
   void assumeConnected(int fd);
 
+  // Receiver-side flow control (called by Context under its own lock):
+  // pause stops reading this pair's socket so TCP backpressure throttles a
+  // runaway sender; resume re-arms EPOLLIN. Safe from any thread.
+  void pauseReading();
+  void resumeReading();
+
  private:
   struct TxOp {
     WireHeader header;
@@ -110,6 +116,7 @@ class Pair : public Handler {
   Listener* expectedAt_{nullptr};
   bool closing_{false};      // goodbye enqueued (mu_)
   bool peerGoodbye_{false};  // peer announced orderly departure (mu_)
+  bool rxPaused_{false};     // stash backpressure engaged (mu_)
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -92,6 +92,7 @@ _PROTOTYPES = {
     "tc_context_close": (_int, [_c]),
     "tc_context_free": (None, [_c]),
     "tc_next_slot": (_u64, [_c, _u32]),
+    "tc_debug_dump": (None, [_c]),
     "tc_trace_start": (None, [_c]),
     "tc_trace_stop": (None, [_c]),
     "tc_trace_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(

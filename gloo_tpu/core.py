@@ -339,6 +339,11 @@ class Context:
     def next_slot(self, num: int = 1) -> int:
         return _lib.lib.tc_next_slot(self._handle, num)
 
+    def debug_dump(self) -> None:
+        """Print transport state (posted receives, stash occupancy,
+        backpressure flags) to stderr — the deadlock diagnosis tool."""
+        _lib.lib.tc_debug_dump(self._handle)
+
     # ---- tracing (capability the reference lacks) ----
 
     def trace_start(self) -> None:

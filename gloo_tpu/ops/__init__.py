@@ -6,7 +6,9 @@ gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
 DMA engines directly for schedules XLA does not emit.
 """
 
-from gloo_tpu.ops.pallas_ring import (ring_allreduce, ring_allreduce_hbm,
+from gloo_tpu.ops.pallas_ring import (ring_allreduce, ring_allreduce_bidir,
+                                       ring_allreduce_hbm,
                                        ring_allreduce_q8)
 
-__all__ = ["ring_allreduce", "ring_allreduce_hbm", "ring_allreduce_q8"]
+__all__ = ["ring_allreduce", "ring_allreduce_bidir", "ring_allreduce_hbm",
+           "ring_allreduce_q8"]

@@ -529,3 +529,174 @@ def ring_allreduce_q8(x, axis_name: str, collective_id: int = 9,
     rows divisible by ring size, chunk rows divisible by 32."""
     return _differentiable(_ring_allreduce_q8_shard, x, axis_name,
                             collective_id, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Bidirectional variant: both ICI directions at once.
+# ---------------------------------------------------------------------------
+
+def _ring_allreduce_bidir_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
+                                 ack_sem, ag_send, ag_recv, *,
+                                 axis_name: str, num_devices: int,
+                                 chunk_rows: int, half_cols: int):
+    """Two counter-rotating rings over one shard: columns [0, half) ride
+    the rightward ring, columns [half, 2*half) the leftward ring, so both
+    ICI directions of the torus axis carry traffic concurrently (2x link
+    bandwidth versus the unidirectional ring). Schedule and flow control
+    per direction are identical to the base kernel; direction d gets its
+    own comm slots, semaphores, and ack lane.
+    """
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, n)
+    left = lax.rem(my - 1 + n, n)
+
+    o_ref[...] = x_ref[...]
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    # Direction helpers: d = 0 sends right (chunks walk down), d = 1 sends
+    # left (chunk indices mirrored). Both directions' DMAs are issued
+    # before either is waited, so the two rings genuinely overlap on the
+    # torus axis's two links.
+    def neighbors(d):
+        to = jax.lax.select(d == 0, right, left)
+        frm = jax.lax.select(d == 0, left, right)
+        return to, frm
+
+    def rs_send_chunk(d, s):
+        return jax.lax.select(d == 0, lax.rem(my - s + n, n),
+                              lax.rem(my + s + n, n))
+
+    def rs_recv_chunk(d, s):
+        return jax.lax.select(d == 0, lax.rem(my - s - 1 + n, n),
+                              lax.rem(my + s + 1, n))
+
+    def rs_rdma(d, s):
+        to, _ = neighbors(d)
+        slot = lax.rem(s, 2)
+        return pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[pl.ds(rs_send_chunk(d, s) * chunk_rows,
+                                   chunk_rows),
+                             pl.ds(d * half_cols, half_cols)],
+            dst_ref=comm_ref.at[d, slot],
+            send_sem=rs_send.at[d, slot],
+            recv_sem=rs_recv.at[d, slot],
+            device_id=to,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def rs_step(s, _):
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[0, slot], 1)
+            pltpu.semaphore_wait(ack_sem.at[1, slot], 1)
+
+        dma0 = rs_rdma(0, s)
+        dma1 = rs_rdma(1, s)
+        dma0.start()
+        dma1.start()
+        dma0.wait()
+        dma1.wait()
+        for d in (0, 1):
+            rc = rs_recv_chunk(d, s)
+            col0 = d * half_cols
+            o_ref[pl.ds(rc * chunk_rows, chunk_rows),
+                  pl.ds(col0, half_cols)] = (
+                o_ref[pl.ds(rc * chunk_rows, chunk_rows),
+                      pl.ds(col0, half_cols)] + comm_ref[d, slot])
+            _, frm = neighbors(d)
+            pltpu.semaphore_signal(ack_sem.at[d, slot], inc=1,
+                                   device_id=frm,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+
+    for d in (0, 1):
+        @pl.when(n >= 3)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[d, lax.rem(n - 3, 2)], 1)
+
+        @pl.when(n >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[d, lax.rem(n - 2, 2)], 1)
+
+    def ag_send_chunk(d, s):
+        return jax.lax.select(d == 0, lax.rem(my + 1 - s + n, n),
+                              lax.rem(my - 1 + s + n, n))
+
+    def ag_rdma(d, s):
+        to, _ = neighbors(d)
+        sc = ag_send_chunk(d, s)
+        ref = o_ref.at[pl.ds(sc * chunk_rows, chunk_rows),
+                       pl.ds(d * half_cols, half_cols)]
+        return pltpu.make_async_remote_copy(
+            src_ref=ref, dst_ref=ref,
+            send_sem=ag_send.at[d, s], recv_sem=ag_recv.at[d, s],
+            device_id=to,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def ag_step(s, _):
+        dma0 = ag_rdma(0, s)
+        dma1 = ag_rdma(1, s)
+        dma0.start()
+        dma1.start()
+        dma0.wait()
+        dma1.wait()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "collective_id",
+                                    "interpret"))
+def _ring_allreduce_bidir_shard(x, *, axis_name: str, collective_id: int,
+                                interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    if n == 1:
+        return x
+    assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
+    assert cols % 256 == 0, "bidirectional split needs cols % 256 == 0"
+    chunk_rows = rows // n
+    half_cols = cols // 2
+    kernel = functools.partial(_ring_allreduce_bidir_kernel,
+                               axis_name=axis_name, num_devices=n,
+                               chunk_rows=chunk_rows, half_cols=half_cols)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, chunk_rows, half_cols), x.dtype),  # comm[d]
+            pltpu.SemaphoreType.DMA((2, 2)),                 # rs send[d]
+            pltpu.SemaphoreType.DMA((2, 2)),                 # rs recv[d]
+            pltpu.SemaphoreType.REGULAR((2, 2)),             # acks[d]
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),     # ag send[d]
+            pltpu.SemaphoreType.DMA((2, max(n - 1, 1))),     # ag recv[d]
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+
+
+def ring_allreduce_bidir(x, axis_name: str, collective_id: int = 10,
+                         interpret: bool = False):
+    """Bidirectional sum-allreduce: the shard's column halves ride
+    counter-rotating rings so both ICI directions carry traffic. cols must
+    be divisible by 256 (two tiling-aligned halves). Differentiable."""
+    return _differentiable(_ring_allreduce_bidir_shard, x, axis_name,
+                           collective_id, interpret)

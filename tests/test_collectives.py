@@ -429,3 +429,23 @@ def test_allreduce_multi_input():
     expected = sum((r + 1) + 10 * (r + 1) for r in range(size))
     for a0, b0 in results:
         assert a0 == expected and b0 == expected
+
+
+def test_runaway_sender_bounded_by_backpressure():
+    """Back-to-back same-tag collectives let a leaf rank run unboundedly
+    ahead of a slow parent; stash backpressure must bound receiver memory
+    (regression: the stash once grew to gigabytes) while preserving
+    completion."""
+    import os
+
+    os.environ["TPUCOLL_MAX_STASH_BYTES"] = str(2 << 20)
+    try:
+        def fn(ctx, rank):
+            x = np.ones(50_000, dtype=np.float32)
+            for _ in range(500):
+                ctx.reduce(x, root=0)
+            return True
+
+        assert all(spawn(4, fn, timeout=120))
+    finally:
+        del os.environ["TPUCOLL_MAX_STASH_BYTES"]
