@@ -449,3 +449,58 @@ def test_runaway_sender_bounded_by_backpressure():
         assert all(spawn(4, fn, timeout=120))
     finally:
         del os.environ["TPUCOLL_MAX_STASH_BYTES"]
+
+
+def test_concurrent_tags_under_backpressure():
+    """Two collectives on distinct tags per rank, one racing ahead, with a
+    tight stash cap: the paused-source policy must not starve the other
+    tag's receives (regression for the pause/starvation interaction)."""
+    import os
+    import threading as th
+
+    os.environ["TPUCOLL_MAX_STASH_BYTES"] = str(2 << 20)
+    try:
+        size = 4
+
+        def fn(ctx, rank):
+            a_ok = [False]
+            b_ok = [False]
+
+            def stream_a():
+                x = np.ones(100_000, dtype=np.float32)
+                for _ in range(100):
+                    ctx.reduce(x, root=0, tag=1)
+                a_ok[0] = True
+
+            def stream_b():
+                y = np.full(1000, float(rank + 1), dtype=np.float32)
+                for _ in range(100):
+                    ctx.allreduce(y, tag=2)
+                    y[:] = float(rank + 1)
+                b_ok[0] = True
+
+            ta, tb = th.Thread(target=stream_a), th.Thread(target=stream_b)
+            ta.start(); tb.start()
+            ta.join(90); tb.join(90)
+            return a_ok[0] and b_ok[0]
+
+        assert all(spawn(size, fn, timeout=120))
+    finally:
+        del os.environ["TPUCOLL_MAX_STASH_BYTES"]
+
+
+def test_sixteen_ranks():
+    """Scaling smoke: 16 thread-ranks, every allreduce algorithm."""
+    size = 16
+
+    def fn(ctx, rank):
+        results = []
+        for i, algo in enumerate(["ring", "halving_doubling", "bcube"]):
+            x = np.full(2000, float(rank + 1), dtype=np.float64)
+            ctx.allreduce(x, algorithm=algo, tag=i)
+            results.append(float(x[0]))
+        return results
+
+    expected = size * (size + 1) / 2
+    for res in spawn(size, fn, timeout=120, context_timeout=60):
+        assert res == [expected] * 3, res
