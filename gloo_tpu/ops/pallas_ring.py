@@ -249,26 +249,60 @@ def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
         rdma.start()
         rdma.wait()
 
-        # Stream-reduce the received chunk: HBM tiles through VMEM.
-        def tile_step(t, _):
+        # Stream-reduce the received chunk: HBM tiles through VMEM,
+        # double-buffered — tile t+1's loads overlap tile t's VPU add and
+        # store, hiding most of the HBM round trip.
+        def loads_for(t, buf):
             row0 = recv_chunk * chunk_rows + t * tile_rows
-            load_acc = pltpu.make_async_copy(
-                o_ref.at[pl.ds(row0, tile_rows)], acc_vmem, copy_sem.at[0])
-            load_in = pltpu.make_async_copy(
-                comm_ref.at[slot, pl.ds(t * tile_rows, tile_rows)], in_vmem,
-                copy_sem.at[1])
-            load_acc.start()
-            load_in.start()
-            load_acc.wait()
-            load_in.wait()
-            acc_vmem[...] = acc_vmem[...] + in_vmem[...]
-            store = pltpu.make_async_copy(
-                acc_vmem, o_ref.at[pl.ds(row0, tile_rows)], copy_sem.at[0])
-            store.start()
-            store.wait()
+            la = pltpu.make_async_copy(
+                o_ref.at[pl.ds(row0, tile_rows)], acc_vmem.at[buf],
+                copy_sem.at[2 * buf])
+            li = pltpu.make_async_copy(
+                comm_ref.at[slot, pl.ds(t * tile_rows, tile_rows)],
+                in_vmem.at[buf], copy_sem.at[2 * buf + 1])
+            return la, li
+
+        def store_for(t, buf):
+            row0 = recv_chunk * chunk_rows + t * tile_rows
+            return pltpu.make_async_copy(
+                acc_vmem.at[buf], o_ref.at[pl.ds(row0, tile_rows)],
+                copy_sem.at[4 + buf])
+
+        la0, li0 = loads_for(0, 0)
+        la0.start()
+        li0.start()
+
+        def tile_step(t, _):
+            cur = lax.rem(t, 2)
+            nxt = lax.rem(t + 1, 2)
+
+            @pl.when(t + 1 < tiles_per_chunk)
+            def _():
+                # Slot `nxt` must be free: its previous store (tile t-1)
+                # has to land before we overwrite acc_vmem[nxt].
+                @pl.when(t >= 1)
+                def _():
+                    store_for(t - 1, nxt).wait()
+                la, li = loads_for(t + 1, nxt)
+                la.start()
+                li.start()
+
+            la, li = loads_for(t, cur)
+            la.wait()
+            li.wait()
+            acc_vmem[cur] = acc_vmem[cur] + in_vmem[cur]
+            store_for(t, cur).start()
             return 0
 
         lax.fori_loop(0, tiles_per_chunk, tile_step, 0)
+        # Drain the last two stores before the chunk may be forwarded.
+        @pl.when(tiles_per_chunk >= 2)
+        def _():
+            store_for(tiles_per_chunk - 2,
+                      lax.rem(tiles_per_chunk - 2, 2)).wait()
+
+        store_for(tiles_per_chunk - 1,
+                  lax.rem(tiles_per_chunk - 1, 2)).wait()
         pltpu.semaphore_signal(ack_sem.at[slot], inc=1, device_id=left,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         return 0
@@ -333,9 +367,9 @@ def _ring_allreduce_hbm_shard(x, *, axis_name: str, collective_id: int,
         out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
                    pl.BlockSpec(memory_space=pltpu.ANY)),
         scratch_shapes=[
-            pltpu.VMEM((tile_rows, cols), x.dtype),        # acc tile
-            pltpu.VMEM((tile_rows, cols), x.dtype),        # incoming tile
-            pltpu.SemaphoreType.DMA((2,)),                 # local copies
+            pltpu.VMEM((2, tile_rows, cols), x.dtype),     # acc tiles (x2)
+            pltpu.VMEM((2, tile_rows, cols), x.dtype),     # in tiles (x2)
+            pltpu.SemaphoreType.DMA((6,)),                 # local copies
             pltpu.SemaphoreType.DMA((2,)),                 # rs send
             pltpu.SemaphoreType.DMA((2,)),                 # rs recv
             pltpu.SemaphoreType.REGULAR((2,)),             # slot acks
