@@ -6,9 +6,13 @@ gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
 DMA engines directly for schedules XLA does not emit.
 """
 
-from gloo_tpu.ops.pallas_ring import (ring_allreduce, ring_allreduce_bidir,
+from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
+                                       ring_allreduce_bidir,
                                        ring_allreduce_hbm,
-                                       ring_allreduce_q8)
+                                       ring_allreduce_q8,
+                                       ring_allreduce_torus,
+                                       ring_reduce_scatter)
 
-__all__ = ["ring_allreduce", "ring_allreduce_bidir", "ring_allreduce_hbm",
-           "ring_allreduce_q8"]
+__all__ = ["ring_allgather", "ring_allreduce", "ring_allreduce_bidir",
+           "ring_allreduce_hbm", "ring_allreduce_q8",
+           "ring_allreduce_torus", "ring_reduce_scatter"]

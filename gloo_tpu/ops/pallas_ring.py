@@ -31,6 +31,29 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _ring_neighbors(axis_name, mesh_axes):
+    """Flattened LOGICAL device ids of this device and its ring neighbors.
+
+    On a single-axis mesh the ring index IS the logical id. On a multi-axis
+    mesh the logical id is the row-major flattened coordinate over
+    `mesh_axes` (the mesh's full axis order), so the neighbor along one
+    axis differs by that axis's stride.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    if mesh_axes is None or tuple(mesh_axes) == (axis_name,):
+        return my, lax.rem(my + 1, n), lax.rem(my - 1 + n, n)
+    axes = tuple(mesh_axes)
+    my_flat = lax.axis_index(axes)
+    idx = axes.index(axis_name)
+    stride = 1
+    for a in axes[idx + 1:]:
+        stride = stride * lax.axis_size(a)
+    right = my_flat + (lax.rem(my + 1, n) - my) * stride
+    left = my_flat + (lax.rem(my - 1 + n, n) - my) * stride
+    return my_flat, right, left
+
+
 def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
                            ack_sem, ag_send, ag_recv, *, axis_name: str,
                            num_devices: int, chunk_rows: int):
@@ -734,3 +757,211 @@ def ring_allreduce_bidir(x, axis_name: str, collective_id: int = 10,
     be divisible by 256 (two tiling-aligned halves). Differentiable."""
     return _differentiable(_ring_allreduce_bidir_shard, x, axis_name,
                            collective_id, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Standalone phases: reduce-scatter and allgather kernels, and their
+# dimension-ordered composition for multi-axis (torus) meshes.
+# ---------------------------------------------------------------------------
+
+def _ring_reduce_scatter_kernel(x_ref, o_ref, work_ref, comm_ref, rs_send,
+                                rs_recv, ack_sem, *, axis_name: str,
+                                mesh_axes, num_devices: int,
+                                chunk_rows: int):
+    """Ring reduce-scatter: o_ref (one chunk) = sum over ranks of this
+    rank's chunk. Start shift -1 lands chunk r on rank r directly (same
+    bookkeeping as the host ring, collectives_ring.cc). mesh_axes names
+    the full mesh order so neighbor LOGICAL ids are correct on multi-axis
+    (torus) meshes."""
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    _, right, left = _ring_neighbors(axis_name, mesh_axes)
+
+    work_ref[...] = x_ref[...]
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def chunk_slice(idx):
+        return pl.ds(idx * chunk_rows, chunk_rows)
+
+    def rs_step(s, _):
+        send_chunk = lax.rem(my - 1 - s + 2 * n, n)
+        recv_chunk = lax.rem(my - 2 - s + 2 * n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=work_ref.at[chunk_slice(send_chunk)],
+            dst_ref=comm_ref.at[slot],
+            send_sem=rs_send.at[slot],
+            recv_sem=rs_recv.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        work_ref[chunk_slice(recv_chunk), :] = (
+            work_ref[chunk_slice(recv_chunk), :] + comm_ref[slot])
+        pltpu.semaphore_signal(ack_sem.at[slot], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n - 1, rs_step, 0)
+
+    @pl.when(n >= 3)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+
+    @pl.when(n >= 2)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+
+    o_ref[...] = work_ref[chunk_slice(my), :]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh_axes",
+                                    "collective_id", "interpret"))
+def _ring_reduce_scatter_shard(x, *, axis_name: str, mesh_axes,
+                               collective_id: int, interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    if n == 1:
+        return x
+    assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
+    chunk_rows = rows // n
+    kernel = functools.partial(_ring_reduce_scatter_kernel,
+                               axis_name=axis_name, mesh_axes=mesh_axes,
+                               num_devices=n, chunk_rows=chunk_rows)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct((chunk_rows, cols), x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((rows, cols), x.dtype),           # working copy
+            pltpu.VMEM((2, chunk_rows, cols), x.dtype),  # comm slots
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+
+
+def ring_reduce_scatter(x, axis_name: str, collective_id: int = 11,
+                        interpret: bool = False, mesh_axes=None):
+    """Ring reduce-scatter: returns this rank's 1/P slice of the sum.
+    x: (rows, cols), rows divisible by the ring size. On a multi-axis
+    mesh pass mesh_axes = the mesh's full axis-name order."""
+    return _ring_reduce_scatter_shard(
+        x, axis_name=axis_name,
+        mesh_axes=None if mesh_axes is None else tuple(mesh_axes),
+        collective_id=collective_id, interpret=interpret)
+
+
+def _ring_allgather_kernel(x_ref, o_ref, ag_send, ag_recv, *,
+                           axis_name: str, mesh_axes, num_devices: int,
+                           chunk_rows: int):
+    """Ring allgather: o_ref = all ranks' x chunks concatenated; chunk
+    forwarding rides per-step semaphores like the allreduce phase 2."""
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    _, right, left = _ring_neighbors(axis_name, mesh_axes)
+
+    o_ref[pl.ds(my * chunk_rows, chunk_rows), :] = x_ref[...]
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def ag_step(s, _):
+        send_chunk = lax.rem(my - s + n, n)
+        ref = o_ref.at[pl.ds(send_chunk * chunk_rows, chunk_rows), :]
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=ref, dst_ref=ref,
+            send_sem=ag_send.at[s], recv_sem=ag_recv.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh_axes",
+                                    "collective_id", "interpret"))
+def _ring_allgather_shard(x, *, axis_name: str, mesh_axes,
+                          collective_id: int, interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    if n == 1:
+        return x
+    kernel = functools.partial(_ring_allgather_kernel, axis_name=axis_name,
+                               mesh_axes=mesh_axes, num_devices=n,
+                               chunk_rows=rows)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct((n * rows, cols), x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+
+
+def ring_allgather(x, axis_name: str, collective_id: int = 12,
+                   interpret: bool = False, mesh_axes=None):
+    """Ring allgather: returns (P * rows, cols) — every rank's x stacked
+    in rank order. On a multi-axis mesh pass mesh_axes."""
+    return _ring_allgather_shard(
+        x, axis_name=axis_name,
+        mesh_axes=None if mesh_axes is None else tuple(mesh_axes),
+        collective_id=collective_id, interpret=interpret)
+
+
+def ring_allreduce_torus(x, axis_names, mesh_axes=None,
+                         collective_id_base: int = 13,
+                         interpret: bool = False):
+    """Dimension-ordered allreduce over a multi-axis (torus) mesh:
+    reduce-scatter along each axis in order (payload shrinking P_axis-fold
+    per hop), then allgather in reverse order. Bandwidth-optimal for tori:
+    each axis moves only the already-reduced fraction, unlike composing
+    full allreduces per axis. rows must be divisible by prod(P_axis).
+    mesh_axes: the mesh's full axis order (defaults to axis_names) —
+    required so per-axis neighbors map to correct flattened device ids.
+    """
+    axes = list(axis_names)
+    mesh_axes = tuple(mesh_axes) if mesh_axes is not None else tuple(axes)
+    for i, ax in enumerate(axes):
+        x = ring_reduce_scatter(x, ax, collective_id=collective_id_base + i,
+                                interpret=interpret, mesh_axes=mesh_axes)
+    for i, ax in enumerate(reversed(axes)):
+        x = ring_allgather(
+            x, ax,
+            collective_id=collective_id_base + len(axes) + i,
+            interpret=interpret, mesh_axes=mesh_axes)
+    return x
