@@ -864,7 +864,9 @@ def ring_reduce_scatter(x, axis_name: str, collective_id: int = 11,
                         interpret: bool = False, mesh_axes=None):
     """Ring reduce-scatter: returns this rank's 1/P slice of the sum.
     x: (rows, cols), rows divisible by the ring size. On a multi-axis
-    mesh pass mesh_axes = the mesh's full axis-name order."""
+    mesh, mesh_axes = the Mesh's axis order is REQUIRED (flattened device
+    ids follow mesh layout; omitting it there silently misroutes RDMA —
+    the default is only valid on single-axis meshes)."""
     return _ring_reduce_scatter_shard(
         x, axis_name=axis_name,
         mesh_axes=None if mesh_axes is None else tuple(mesh_axes),
@@ -936,14 +938,15 @@ def _ring_allgather_shard(x, *, axis_name: str, mesh_axes,
 def ring_allgather(x, axis_name: str, collective_id: int = 12,
                    interpret: bool = False, mesh_axes=None):
     """Ring allgather: returns (P * rows, cols) — every rank's x stacked
-    in rank order. On a multi-axis mesh pass mesh_axes."""
+    in rank order. On a multi-axis mesh, mesh_axes (the Mesh's axis
+    order) is REQUIRED — see ring_reduce_scatter."""
     return _ring_allgather_shard(
         x, axis_name=axis_name,
         mesh_axes=None if mesh_axes is None else tuple(mesh_axes),
         collective_id=collective_id, interpret=interpret)
 
 
-def ring_allreduce_torus(x, axis_names, mesh_axes=None,
+def ring_allreduce_torus(x, axis_names, mesh_axes,
                          collective_id_base: int = 13,
                          interpret: bool = False):
     """Dimension-ordered allreduce over a multi-axis (torus) mesh:
@@ -951,11 +954,19 @@ def ring_allreduce_torus(x, axis_names, mesh_axes=None,
     per hop), then allgather in reverse order. Bandwidth-optimal for tori:
     each axis moves only the already-reduced fraction, unlike composing
     full allreduces per axis. rows must be divisible by prod(P_axis).
-    mesh_axes: the mesh's full axis order (defaults to axis_names) —
-    required so per-axis neighbors map to correct flattened device ids.
+
+    mesh_axes is REQUIRED and must be the Mesh's axis_names in mesh order
+    (not the reduction order): flattened LOGICAL device ids follow the
+    mesh's row-major layout, and a mismatched order silently routes RDMA
+    to the wrong chips. There is no way to introspect the mesh from
+    inside shard_map, so the caller must state it.
     """
     axes = list(axis_names)
-    mesh_axes = tuple(mesh_axes) if mesh_axes is not None else tuple(axes)
+    if mesh_axes is None:
+        raise ValueError(
+            "ring_allreduce_torus requires mesh_axes (the Mesh's axis "
+            "order); a wrong guess silently corrupts results")
+    mesh_axes = tuple(mesh_axes)
     for i, ax in enumerate(axes):
         x = ring_reduce_scatter(x, ax, collective_id=collective_id_base + i,
                                 interpret=interpret, mesh_axes=mesh_axes)
