@@ -53,7 +53,8 @@ void usage() {
           "tpucoll_bench --rank R --size P (--store file:PATH|tcp:H:P | "
           "--serve PORT)\n"
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
-          "reduce|gather|scatter|alltoall|barrier|pairwise_exchange|sendrecv]\n"
+          "reduce|gather|scatter|alltoall|barrier|pairwise_exchange|sendrecv|\n"
+          "   sendrecv_roundtrip]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n");
 }
@@ -416,6 +417,36 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
       run();
       // After the last step, out holds the last partner's rank value.
       return out.empty() || out[0] == float(rank ^ (size - 1));
+    };
+  } else if (o.op == "sendrecv_roundtrip") {
+    // Ping-pong: rank 0 sends, rank 1 echoes; p50 is the full round trip
+    // (divide by 2 for one-way latency). Unlike `sendrecv`, completion
+    // requires delivery, not just kernel-buffer acceptance.
+    TC_ENFORCE_EQ(size, 2, "sendrecv_roundtrip runs with exactly 2 ranks");
+    buf.assign(elements, float(rank));
+    std::shared_ptr<tpucoll::transport::UnboundBuffer> ub(
+        ctx.createUnboundBuffer(buf.data(), buf.size() * sizeof(float))
+            .release());
+    std::function<void()> run = [ctxp, &buf, ub, rank] {
+      const uint64_t s1 = ctxp->nextSlot();
+      const uint64_t s2 = ctxp->nextSlot();
+      const auto t = std::chrono::milliseconds(30000);
+      if (rank == 0) {
+        ub->send(1, s1, 0, buf.size() * sizeof(float));
+        ub->waitSend(t);
+        ub->recv(1, s2, 0, buf.size() * sizeof(float));
+        ub->waitRecv(nullptr, t);
+      } else {
+        ub->recv(0, s1, 0, buf.size() * sizeof(float));
+        ub->waitRecv(nullptr, t);
+        ub->send(0, s2, 0, buf.size() * sizeof(float));
+        ub->waitSend(t);
+      }
+    };
+    w.run = run;
+    w.verifyOnce = [run] {
+      run();
+      return true;
     };
   } else if (o.op == "sendrecv") {
     TC_ENFORCE_EQ(size, 2, "sendrecv runs with exactly 2 ranks");
