@@ -28,6 +28,9 @@ class TransformerConfig:
     d_ff: int = 1024
     max_seq_len: int = 128
     dtype: Any = jnp.bfloat16
+    # Use the Pallas flash-attention kernel (gloo_tpu.ops) instead of the
+    # materialized-scores path; requires seq divisible by its block sizes.
+    use_flash_attention: bool = False
 
 
 class Transformer:
@@ -83,14 +86,21 @@ class Transformer:
         q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(hd))
-        mask = jnp.tril(jnp.ones((t, t), jnp.bool_))
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
-                         preferred_element_type=jnp.float32)
+        if cfg.use_flash_attention:
+            from gloo_tpu.ops.flash_attention import flash_attention
+
+            block = min(128, t)
+            out = flash_attention(q, k, v, causal=True, block_q=block,
+                                  block_k=block)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores / jnp.sqrt(jnp.float32(hd))
+            mask = jnp.tril(jnp.ones((t, t), jnp.bool_))
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                             preferred_element_type=jnp.float32)
         out = out.transpose(0, 2, 1, 3).reshape(b, t, d).astype(x.dtype)
         return out @ layer["wo"].astype(x.dtype)
 

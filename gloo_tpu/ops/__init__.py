@@ -6,6 +6,7 @@ gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
 DMA engines directly for schedules XLA does not emit.
 """
 
+from gloo_tpu.ops.flash_attention import flash_attention
 from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_bidir,
                                        ring_allreduce_hbm,
@@ -13,6 +14,7 @@ from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_torus,
                                        ring_reduce_scatter)
 
-__all__ = ["ring_allgather", "ring_allreduce", "ring_allreduce_bidir",
+__all__ = ["flash_attention", "ring_allgather", "ring_allreduce",
+           "ring_allreduce_bidir",
            "ring_allreduce_hbm", "ring_allreduce_q8",
            "ring_allreduce_torus", "ring_reduce_scatter"]

@@ -1,0 +1,107 @@
+"""Pallas flash attention (single device).
+
+The MXU-side companion to the collective kernels: the flagship
+transformer's hot op computed without materializing the (T, T) score
+matrix. Classic two-level structure — the grid walks (batch*heads,
+query-block), and each program streams key/value blocks through an
+online-softmax accumulator in VMEM (same math as the cross-chip ring
+attention in gloo_tpu.parallel.sp, applied at the block level).
+
+Block sizes honor float32 (8, 128) tiling; causal masking skips key
+blocks entirely above the diagonal (their contribution is fully masked).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  seq_len: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # block shapes carry a
+    # leading singleton (batch*head) dim
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # Key blocks strictly above the diagonal contribute nothing.
+        last = lax.div((qi + 1) * block_q - 1, block_k) + 1
+    else:
+        last = num_k_blocks
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + p.sum(axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    head_dim = q.shape[1]
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = lax.fori_loop(0, last, body, (acc0, m0, l0))
+    o_ref[0, ...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Attention over (batch, heads, seq, head_dim) without materializing
+    the score matrix. seq must be divisible by the block sizes; head_dim
+    should be a multiple of 128 for full MXU tiles (smaller works via
+    padding by the compiler at reduced efficiency)."""
+    b, h, t, d = q.shape
+    assert t % block_q == 0 and t % block_k == 0, (
+        f"seq {t} must be divisible by block sizes {block_q}/{block_k}")
+    scale = 1.0 / (d ** 0.5)
+
+    bh = b * h
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, t, d)
+    vf = v.reshape(bh, t, d)
+
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, seq_len=t, causal=causal,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)

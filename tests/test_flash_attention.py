@@ -1,0 +1,75 @@
+"""Pallas flash attention: correctness vs materialized attention (CPU
+interpret mode; real-chip validation rides the graft/TPU checks)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import jax.numpy as jnp  # noqa: E402
+
+from gloo_tpu.ops import flash_attention  # noqa: E402
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    b, h, t, d = 2, 2, 128, 128
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    out = np.asarray(flash_attention(q, k, v, causal=causal, block_q=64,
+                                     block_k=64, interpret=True))
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k))
+    s /= np.sqrt(d)
+    if causal:
+        s = np.where(np.tril(np.ones((t, t), bool)), s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_with_flash_attention():
+    """Transformer forward with the flash path matches the default path
+    (same weights) within matmul-precision tolerance."""
+    from gloo_tpu.models import Transformer, TransformerConfig
+
+    base = TransformerConfig(vocab_size=64, d_model=64, n_heads=2,
+                             n_layers=1, d_ff=128, max_seq_len=64,
+                             dtype=jnp.float32)
+    flash = TransformerConfig(vocab_size=64, d_model=64, n_heads=2,
+                              n_layers=1, d_ff=128, max_seq_len=64,
+                              dtype=jnp.float32, use_flash_attention=True)
+    m0, m1 = Transformer(base), Transformer(flash)
+    params = m0.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 64)), jnp.int32)
+    # Flash path in interpret mode isn't reachable through the model flag;
+    # on CPU, pallas needs interpret — monkeypatch for the comparison.
+    import gloo_tpu.models.transformer as tr
+    from gloo_tpu.ops import flash_attention as fa
+
+    orig_platform = jax.devices()[0].platform
+    if orig_platform != "tpu":
+        import sys
+
+        # The package re-export shadows the submodule attribute; fetch the
+        # real module from sys.modules.
+        fmod = sys.modules["gloo_tpu.ops.flash_attention"]
+        real = fmod.flash_attention
+
+        def interp(*a, **kw):
+            kw["interpret"] = True
+            return real(*a, **kw)
+
+        fmod.flash_attention = interp
+        try:
+            y0 = np.asarray(m0.apply(params, tokens))
+            y1 = np.asarray(m1.apply(params, tokens))
+        finally:
+            fmod.flash_attention = real
+    else:
+        y0 = np.asarray(m0.apply(params, tokens))
+        y1 = np.asarray(m1.apply(params, tokens))
+    np.testing.assert_allclose(y0, y1, rtol=2e-3, atol=2e-3)
