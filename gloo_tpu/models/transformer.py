@@ -87,9 +87,9 @@ class Transformer:
         k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
         if cfg.use_flash_attention:
-            from gloo_tpu.ops.flash_attention import flash_attention
+            from gloo_tpu.ops.attention import flash_attention, largest_block
 
-            block = min(128, t)
+            block = largest_block(t)
             out = flash_attention(q, k, v, causal=True, block_q=block,
                                   block_k=block)
         else:

@@ -6,7 +6,7 @@ gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
 DMA engines directly for schedules XLA does not emit.
 """
 
-from gloo_tpu.ops.flash_attention import flash_attention
+from gloo_tpu.ops.attention import flash_attention, largest_block
 from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_bidir,
                                        ring_allreduce_hbm,

@@ -1,0 +1,130 @@
+"""Pallas flash attention (single device).
+
+The MXU-side companion to the collective kernels: attention computed
+without materializing the (T, T) score matrix. The grid walks
+(batch*heads, query-block, key-block) with the key-block dimension
+innermost; the online-softmax state (accumulator, running max, running
+denominator) lives in VMEM scratch that persists across the sequential
+grid steps, so only ONE (block_q, d) query tile and ONE (block_k, d)
+key/value tile are resident at a time — sequence length is bounded by
+HBM, not VMEM. Same math as the cross-chip ring attention in
+gloo_tpu.parallel.sp, applied at the tile level.
+
+Causal masking: key blocks entirely above the diagonal skip their
+compute (the pipeline still fetches the tile — grid steps cannot be
+elided — but the MXU work is predicated away).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_q: int, block_k: int, causal: bool, scale: float):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: a key block whose first position exceeds the query block's
+    # last position contributes nothing.
+    active = True
+    if causal:
+        active = kb * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(active)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_ref[...] = l_ref[...] * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _():
+        o_ref[0, ...] = (acc_ref[...] /
+                         jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Attention over (batch, heads, seq, head_dim) without materializing
+    the score matrix. seq must be divisible by the block sizes; head_dim
+    should be a multiple of 128 for full MXU tiles."""
+    b, h, t, d = q.shape
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError(
+            f"seq {t} must be divisible by block sizes {block_q}/{block_k}")
+    scale = 1.0 / (d ** 0.5)
+
+    bh = b * h
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, t, d)
+    vf = v.reshape(bh, t, d)
+
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # accumulator
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
+        ],
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+def largest_block(t: int, cap: int = 128) -> int:
+    """Largest divisor of t that is a multiple of 8 and at most `cap`
+    (block-size helper for arbitrary multiple-of-8 sequence lengths)."""
+    best = 8
+    for candidate in range(8, cap + 1, 8):
+        if t % candidate == 0:
+            best = candidate
+    return best

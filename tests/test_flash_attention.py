@@ -14,12 +14,12 @@ from gloo_tpu.ops import flash_attention  # noqa: E402
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_matches_reference(causal):
     rng = np.random.RandomState(0)
-    b, h, t, d = 2, 2, 128, 128
+    b, h, t, d = 2, 2, 128, 128  # asymmetric blocks below cover t != block
     q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
     out = np.asarray(flash_attention(q, k, v, causal=causal, block_q=64,
-                                     block_k=64, interpret=True))
+                                     block_k=32, interpret=True))
     s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k))
     s /= np.sqrt(d)
     if causal:
@@ -47,8 +47,6 @@ def test_transformer_with_flash_attention():
         np.random.RandomState(0).randint(0, 64, (2, 64)), jnp.int32)
     # Flash path in interpret mode isn't reachable through the model flag;
     # on CPU, pallas needs interpret — monkeypatch for the comparison.
-    import gloo_tpu.models.transformer as tr
-    from gloo_tpu.ops import flash_attention as fa
 
     orig_platform = jax.devices()[0].platform
     if orig_platform != "tpu":
@@ -56,7 +54,7 @@ def test_transformer_with_flash_attention():
 
         # The package re-export shadows the submodule attribute; fetch the
         # real module from sys.modules.
-        fmod = sys.modules["gloo_tpu.ops.flash_attention"]
+        fmod = sys.modules["gloo_tpu.ops.attention"]
         real = fmod.flash_attention
 
         def interp(*a, **kw):
@@ -73,3 +71,21 @@ def test_transformer_with_flash_attention():
         y0 = np.asarray(m0.apply(params, tokens))
         y1 = np.asarray(m1.apply(params, tokens))
     np.testing.assert_allclose(y0, y1, rtol=2e-3, atol=2e-3)
+
+
+def test_flash_rejects_indivisible_seq():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    q = jnp.zeros((1, 1, 192, 128), jnp.float32)
+    with _pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=128, block_k=128, interpret=True)
+
+
+def test_largest_block_helper():
+    from gloo_tpu.ops import largest_block
+
+    assert largest_block(192) == 96
+    assert largest_block(128) == 128
+    assert largest_block(256) == 128
+    assert largest_block(40) == 40
