@@ -75,6 +75,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                          jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
+def _reference_attention(q, k, v, causal: bool):
+    """Materialized-scores attention; the recompute target for the VJP."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(d))
+    if causal:
+        t = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), jnp.bool_)), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
@@ -82,7 +96,12 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                     block_k: int = 128, interpret: bool = False):
     """Attention over (batch, heads, seq, head_dim) without materializing
     the score matrix. seq must be divisible by the block sizes; head_dim
-    should be a multiple of 128 for full MXU tiles."""
+    should be a multiple of 128 for full MXU tiles.
+
+    Differentiable: pallas_call has no autodiff rule, so the VJP
+    recomputes attention with the materialized-scores path and
+    differentiates that (flash-memory forward, standard-memory backward —
+    a dedicated backward kernel is the upgrade path)."""
     b, h, t, d = q.shape
     if t % block_q != 0 or t % block_k != 0:
         raise ValueError(
@@ -96,28 +115,53 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale)
-    out = pl.pallas_call(
-        kernel,
-        interpret=interpret,
-        grid=(bh, t // block_q, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),  # accumulator
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
-        ],
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+
+    @jax.custom_vjp
+    def op(qf, kf, vf):
+        return run_kernel(qf, kf, vf)
+
+    def fwd(qf, kf, vf):
+        return run_kernel(qf, kf, vf), (qf, kf, vf)
+
+    def bwd(residuals, g):
+        qf, kf, vf = residuals
+        qr = qf.reshape(b, h, t, d)
+        kr = kf.reshape(b, h, t, d)
+        vr = vf.reshape(b, h, t, d)
+        _, vjp = jax.vjp(
+            lambda a, bb, c: _reference_attention(a, bb, c, causal),
+            qr, kr, vr)
+        dq, dk, dv = vjp(g.reshape(b, h, t, d))
+        return (dq.reshape(bh, t, d), dk.reshape(bh, t, d),
+                dv.reshape(bh, t, d))
+
+    op.defvjp(fwd, bwd)
+
+    def run_kernel(qf, kf, vf):
+        return pl.pallas_call(
+            kernel,
+            interpret=interpret,
+            grid=(bh, t // block_q, t // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, kb: (i, j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),  # accumulator
+                pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+                pltpu.VMEM((block_q, 1), jnp.float32),  # running denom
+            ],
+        )(qf, kf, vf)
+
+    return op(qf, kf, vf).reshape(b, h, t, d)
 
 
 def largest_block(t: int, cap: int = 128) -> int:
