@@ -26,8 +26,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  block_q: int, block_k: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, block_q: int, block_k: int, causal: bool,
+                  scale: float):
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     num_k_blocks = pl.num_programs(2)
@@ -73,6 +74,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _():
         o_ref[0, ...] = (acc_ref[...] /
                          jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        # logsumexp per query row (the backward pass's softmax residual).
+        lse_ref[0, ...] = (m_ref[...] +
+                           jnp.log(jnp.maximum(l_ref[...], 1e-30)))
 
 
 def _reference_attention(q, k, v, causal: bool):
@@ -98,10 +102,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     the score matrix. seq must be divisible by the block sizes; head_dim
     should be a multiple of 128 for full MXU tiles.
 
-    Differentiable: pallas_call has no autodiff rule, so the VJP
-    recomputes attention with the materialized-scores path and
-    differentiates that (flash-memory forward, standard-memory backward —
-    a dedicated backward kernel is the upgrade path)."""
+    Differentiable with flash-memory in BOTH directions: the custom VJP
+    runs dedicated backward kernels (dQ; dK/dV) that recompute the
+    softmax tiles from the saved logsumexp rows — no (T, T)
+    materialization anywhere in training."""
     b, h, t, d = q.shape
     if t % block_q != 0 or t % block_k != 0:
         raise ValueError(
@@ -118,22 +122,18 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
 
     @jax.custom_vjp
     def op(qf, kf, vf):
-        return run_kernel(qf, kf, vf)
+        return run_kernel(qf, kf, vf)[0]
 
     def fwd(qf, kf, vf):
-        return run_kernel(qf, kf, vf), (qf, kf, vf)
+        out, lse = run_kernel(qf, kf, vf)
+        return out, (qf, kf, vf, out, lse)
 
     def bwd(residuals, g):
-        qf, kf, vf = residuals
-        qr = qf.reshape(b, h, t, d)
-        kr = kf.reshape(b, h, t, d)
-        vr = vf.reshape(b, h, t, d)
-        _, vjp = jax.vjp(
-            lambda a, bb, c: _reference_attention(a, bb, c, causal),
-            qr, kr, vr)
-        dq, dk, dv = vjp(g.reshape(b, h, t, d))
-        return (dq.reshape(bh, t, d), dk.reshape(bh, t, d),
-                dv.reshape(bh, t, d))
+        qf, kf, vf, out, lse = residuals
+        return _flash_backward(qf, kf, vf, out, lse, g.astype(qf.dtype),
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, scale=scale,
+                               interpret=interpret)
 
     op.defvjp(fwd, bwd)
 
@@ -150,10 +150,16 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                 pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d),
-                                   lambda i, j, kb: (i, j, 0),
-                                   memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            out_specs=(
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                             memory_space=pltpu.VMEM),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            ),
             scratch_shapes=[
                 pltpu.VMEM((block_q, d), jnp.float32),  # accumulator
                 pltpu.VMEM((block_q, 1), jnp.float32),  # running max
@@ -172,3 +178,175 @@ def largest_block(t: int, cap: int = 128) -> int:
         if t % candidate == 0:
             best = candidate
     return best
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels: dQ (query-block major) and dK/dV (key-block major).
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                         dq_ref, acc_ref, *, block_q: int, block_k: int,
+                         causal: bool, scale: float):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    active = True
+    if causal:
+        active = kb * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(active)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])          # (bq, bk), rows of softmax
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])          # delta = rowsum(do * o)
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _():
+        dq_ref[0, ...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, causal: bool, scale: float):
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q_blocks = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    active = True
+    if causal:
+        # Query blocks entirely above the diagonal see none of this key
+        # block: need qi*block_q + block_q - 1 >= kb*block_k.
+        active = qi * block_q + block_q - 1 >= kb * block_k
+
+    @pl.when(active)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse_ref[0])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        # dV += P^T dO
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        # dK += dS^T (q * scale); q already carries `scale`.
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _():
+        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
+                    block_k: int, scale: float, interpret: bool):
+    bh, t, d = qf.shape
+    # delta[i] = rowsum(dO * O): cheap elementwise pass outside pallas.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                                  block_k=block_k, causal=causal,
+                                  scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        interpret=interpret,
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )(qf, kf, vf, g, delta, lse)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                                   block_k=block_k, causal=causal,
+                                   scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        interpret=interpret,
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+    )(qf, kf, vf, g, delta, lse)
+    return dq, dk, dv
