@@ -26,6 +26,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal, scale):
+    """Shared tile computation for forward and backward kernels: scaled
+    scores with the causal mask applied. The backward kernels recompute
+    softmax from the forward's saved logsumexp, so all three MUST use
+    this single definition — any drift between them silently skews
+    gradients."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+    return q, s
+
+
+def _softmax_tile(s, lse):
+    p = jnp.exp(s - lse)
+    return jnp.where(jnp.isfinite(s), p, 0.0)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                   l_ref, *, block_q: int, block_k: int, causal: bool,
                   scale: float):
@@ -47,17 +71,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(active)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
+        _, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
+                           scale)
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
         m = m_ref[...]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -80,7 +96,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _reference_attention(q, k, v, causal: bool):
-    """Materialized-scores attention; the recompute target for the VJP."""
+    """Materialized-scores attention — the test parity oracle only (the
+    VJP runs the dedicated Pallas backward kernels)."""
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32)
@@ -201,20 +218,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 
     @pl.when(active)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
+        _, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
+                           scale)
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-        p = jnp.exp(s - lse_ref[0])          # (bq, bk), rows of softmax
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        p = _softmax_tile(s, lse_ref[0])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])          # delta = rowsum(do * o)
@@ -247,20 +256,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 
     @pl.when(active)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
+        q, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
+                           scale)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-        p = jnp.exp(s - lse_ref[0])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        p = _softmax_tile(s, lse_ref[0])
         # dV += P^T dO
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
