@@ -6,7 +6,8 @@ gloo_tpu.tpu.spmd) are the "NCCL path", these kernels drive the inter-chip
 DMA engines directly for schedules XLA does not emit.
 """
 
-from gloo_tpu.ops.attention import flash_attention, largest_block
+from gloo_tpu.ops.attention import (flash_attention, flash_attention_step,
+                                     largest_block)
 from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_bidir,
                                        ring_allreduce_hbm,
@@ -14,7 +15,8 @@ from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_torus,
                                        ring_reduce_scatter)
 
-__all__ = ["flash_attention", "ring_allgather", "ring_allreduce",
+__all__ = ["flash_attention", "flash_attention_step", "ring_allgather",
+           "ring_allreduce",
            "ring_allreduce_bidir",
            "ring_allreduce_hbm", "ring_allreduce_q8",
            "ring_allreduce_torus", "ring_reduce_scatter"]

@@ -350,3 +350,113 @@ def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
         ],
     )(qf, kf, vf, g, delta, lse)
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Flash step with carried state: the inner kernel for ring attention.
+# ---------------------------------------------------------------------------
+
+def _flash_step_kernel(q_ref, k_ref, v_ref, acc_in, m_in, l_in, q_off_ref,
+                       k_off_ref, acc_out, m_out, l_out, *, block_q: int,
+                       block_k: int, causal: bool, scale: float):
+    """One flash update: fold a (t_kv, d) key/value block into carried
+    online-softmax state. Offsets place the local tiles in the GLOBAL
+    sequence so causal masking works across ring-rotated blocks."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_out[0, ...] = acc_in[0]
+        m_out[0, ...] = m_in[0]
+        l_out[0, ...] = l_in[0]
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = (q_off_ref[0] + qi * block_q +
+                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+        k_pos = (k_off_ref[0] + kb * block_k +
+                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+
+    v = v_ref[0].astype(jnp.float32)
+    m = m_out[0]
+    m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_out[0, ...] = l_out[0] * corr + p.sum(axis=1, keepdims=True)
+    acc_out[0, ...] = acc_out[0] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_out[0, ...] = m_new
+    del num_k_blocks
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "vma_axes"))
+def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
+                         causal: bool = True, block_q: int = 128,
+                         block_k: int = 128, interpret: bool = False,
+                         vma_axes=()):
+    """Fold one key/value block into carried flash state.
+
+    q: (bh, t_q, d); k, v: (bh, t_kv, d); acc: (bh, t_q, d) float32;
+    m, l: (bh, t_q, 1) float32; q_offset/k_offset: () int32 global
+    positions of the tiles. Returns updated (acc, m, l). Used by
+    gloo_tpu.parallel.sp.ring_flash_attention, where the ring rotation
+    supplies a different k/v block (and k_offset) per step. Inside
+    shard_map with vma checking, pass vma_axes=(axis,).
+    """
+    bh, tq, d = q.shape
+    tkv = k.shape[1]
+    if tq % block_q != 0 or tkv % block_k != 0:
+        raise ValueError("tile sizes must divide the block shapes")
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_flash_step_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale)
+    q_off = jnp.reshape(q_offset.astype(jnp.int32), (1,))
+    k_off = jnp.reshape(k_offset.astype(jnp.int32), (1,))
+    return pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(bh, tq // block_q, tkv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32,
+                                 vma=frozenset(vma_axes)),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32,
+                                 vma=frozenset(vma_axes)),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32,
+                                 vma=frozenset(vma_axes)),
+        ),
+    )(q, k, v, acc, m, l, q_off, k_off)

@@ -18,7 +18,7 @@ by shipping the strategies themselves, each built on a gloo_tpu plane:
 from gloo_tpu.parallel.ddp import HostGradSync, make_ddp_train_step
 from gloo_tpu.parallel.ep import dispatch_combine
 from gloo_tpu.parallel.pp import pipeline_apply
-from gloo_tpu.parallel.sp import ring_attention
+from gloo_tpu.parallel.sp import ring_attention, ring_flash_attention
 from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
                                   tp_mlp_block)
 
@@ -29,6 +29,7 @@ __all__ = [
     "make_ddp_train_step",
     "pipeline_apply",
     "ring_attention",
+    "ring_flash_attention",
     "row_parallel_dense",
     "tp_mlp_block",
 ]

@@ -65,3 +65,47 @@ def ring_attention(q, k, v, axis: str, causal: bool = True):
     _, _, out, m, l = lax.fori_loop(0, n, step, (k, v, out0, m0, l0))
     out = out / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
+
+
+def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False):
+    """Ring attention with a Pallas flash inner kernel: K/V blocks rotate
+    over ICI (ppermute) while each device folds the arriving block into
+    carried online-softmax state tile-by-tile on the MXU — the standard
+    long-context recipe (cross-chip ring x on-chip flash), with no
+    (t_local, t_local) materialization either.
+
+    Shapes as ring_attention: q, k, v are (batch, heads, t_local, d) per
+    device inside shard_map. Forward-only (wrap with jax.checkpoint or
+    use ring_attention for the differentiable path until the step kernel
+    grows a VJP)."""
+    from gloo_tpu.ops.attention import flash_attention_step
+
+    n = spmd.size(axis)
+    my = spmd.rank(axis)
+    b, h, t_local, d = q.shape
+    qf = q.reshape(b * h, t_local, d)
+
+    def step(i, carry):
+        k_blk, v_blk, acc, m, l = carry
+        src = lax.rem(my - i + n, n)
+        acc, m, l = flash_attention_step(
+            qf, k_blk.reshape(b * h, t_local, d),
+            v_blk.reshape(b * h, t_local, d), acc, m, l,
+            q_offset=my * t_local, k_offset=src * t_local, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            vma_axes=(axis,))
+        k_next = spmd.shift(k_blk, axis, 1)
+        v_next = spmd.shift(v_blk, axis, 1)
+        return k_next, v_next, acc, m, l
+
+    acc0 = lax.pcast(jnp.zeros((b * h, t_local, d), jnp.float32), (axis,),
+                     to="varying")
+    m0 = lax.pcast(jnp.full((b * h, t_local, 1), -jnp.inf, jnp.float32),
+                   (axis,), to="varying")
+    l0 = lax.pcast(jnp.zeros((b * h, t_local, 1), jnp.float32), (axis,),
+                   to="varying")
+    _, _, acc, m, l = lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(b, h, t_local, d).astype(q.dtype)

@@ -225,3 +225,34 @@ def test_expert_parallel_out_of_range_assignment_dropped():
         out_specs=P("expert")))
     out = np.asarray(f(tokens, assignment))
     np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_attention_matches_full(causal):
+    """Ring rotation x flash inner kernel == full attention."""
+    from gloo_tpu.parallel import ring_flash_attention
+
+    mesh = make_mesh({"seq": -1})
+    p = mesh.shape["seq"]
+    b, h, t, d = 1, 2, 16 * p, 128
+    rng = np.random.RandomState(3)
+    q = rng.randn(b, h, t, d).astype(np.float32)
+    k = rng.randn(b, h, t, d).astype(np.float32)
+    v = rng.randn(b, h, t, d).astype(np.float32)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_flash_attention(q, k, v, "seq", causal=causal,
+                                             block_q=8, block_k=8,
+                                             interpret=True),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq"), check_vma=False))
+    got = np.asarray(f(q, k, v))
+
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        s = np.where(np.tril(np.ones((t, t), bool)), s, -np.inf)
+    pr = np.exp(s - s.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    expected = np.einsum("bhqk,bhkd->bhqd", pr, v)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
