@@ -7,6 +7,7 @@ DMA engines directly for schedules XLA does not emit.
 """
 
 from gloo_tpu.ops.attention import (flash_attention, flash_attention_step,
+                                    flash_attention_bwd_step,
                                      largest_block)
 from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_bidir,
@@ -15,7 +16,8 @@ from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_allreduce_torus,
                                        ring_reduce_scatter)
 
-__all__ = ["flash_attention", "flash_attention_step", "ring_allgather",
+__all__ = ["flash_attention", "flash_attention_step",
+           "flash_attention_bwd_step", "ring_allgather",
            "ring_allreduce",
            "ring_allreduce_bidir",
            "ring_allreduce_hbm", "ring_allreduce_q8",

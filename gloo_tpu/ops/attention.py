@@ -26,23 +26,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal, scale):
-    """Shared tile computation for forward and backward kernels: scaled
-    scores with the causal mask applied. The backward kernels recompute
-    softmax from the forward's saved logsumexp, so all three MUST use
-    this single definition — any drift between them silently skews
-    gradients."""
+def _score_tile_global(q_ref, k_ref, q_base, k_base, block_q, block_k,
+                       causal, scale):
+    """THE tile computation: scaled scores with the causal mask applied,
+    with the tile's rows at q_base.. and columns at k_base.. in the full
+    sequence (bases may be dynamic SMEM scalars for ring-rotated blocks).
+    Every kernel — forward, backward, step — must go through this single
+    definition: the backward kernels recompute softmax from the forward's
+    saved logsumexp, so any drift silently skews gradients."""
     q = q_ref[0].astype(jnp.float32) * scale
     k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if causal:
-        q_pos = qi * block_q + lax.broadcasted_iota(
+        q_pos = q_base + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = kb * block_k + lax.broadcasted_iota(
+        k_pos = k_base + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
     return q, s
+
+
+def _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal, scale):
+    """Local-sequence view of _score_tile_global (block indices, not
+    positions)."""
+    return _score_tile_global(q_ref, k_ref, qi * block_q, kb * block_k,
+                              block_q, block_k, causal, scale)
 
 
 def _softmax_tile(s, lse):
@@ -149,8 +158,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
         qf, kf, vf, out, lse = residuals
         return _flash_backward(qf, kf, vf, out, lse, g.astype(qf.dtype),
                                causal=causal, block_q=block_q,
-                               block_k=block_k, scale=scale,
-                               interpret=interpret)
+                               block_k=block_k, interpret=interpret)
 
     op.defvjp(fwd, bwd)
 
@@ -201,155 +209,19 @@ def largest_block(t: int, cap: int = 128) -> int:
 # Backward kernels: dQ (query-block major) and dK/dV (key-block major).
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                         dq_ref, acc_ref, *, block_q: int, block_k: int,
-                         causal: bool, scale: float):
-    qi = pl.program_id(1)
-    kb = pl.program_id(2)
-    num_k_blocks = pl.num_programs(2)
-
-    @pl.when(kb == 0)
-    def _():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    active = True
-    if causal:
-        active = kb * block_k <= qi * block_q + block_q - 1
-
-    @pl.when(active)
-    def _():
-        _, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
-                           scale)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        p = _softmax_tile(s, lse_ref[0])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])          # delta = rowsum(do * o)
-        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(kb == num_k_blocks - 1)
-    def _():
-        dq_ref[0, ...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, causal: bool, scale: float):
-    kb = pl.program_id(1)
-    qi = pl.program_id(2)
-    num_q_blocks = pl.num_programs(2)
-
-    @pl.when(qi == 0)
-    def _():
-        dk_acc[...] = jnp.zeros_like(dk_acc)
-        dv_acc[...] = jnp.zeros_like(dv_acc)
-
-    active = True
-    if causal:
-        # Query blocks entirely above the diagonal see none of this key
-        # block: need qi*block_q + block_q - 1 >= kb*block_k.
-        active = qi * block_q + block_q - 1 >= kb * block_k
-
-    @pl.when(active)
-    def _():
-        q, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
-                           scale)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        p = _softmax_tile(s, lse_ref[0])
-        # dV += P^T dO
-        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        # dK += dS^T (q * scale); q already carries `scale`.
-        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(qi == num_q_blocks - 1)
-    def _():
-        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
-
-
 def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, scale: float, interpret: bool):
-    bh, t, d = qf.shape
+                    block_k: int, interpret: bool):
+    """Local (single-block) backward: the step backward kernels with both
+    global offsets at zero."""
     # delta[i] = rowsum(dO * O): cheap elementwise pass outside pallas.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
-
-    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                                  block_k=block_k, causal=causal,
-                                  scale=scale)
-    dq = pl.pallas_call(
-        dq_kernel,
-        interpret=interpret,
-        grid=(bh, t // block_q, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-    )(qf, kf, vf, g, delta, lse)
-
-    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                                   block_k=block_k, causal=causal,
-                                   scale=scale)
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        interpret=interpret,
-        grid=(bh, t // block_k, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
-                         memory_space=pltpu.VMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-    )(qf, kf, vf, g, delta, lse)
-    return dq, dk, dv
+    zero = jnp.int32(0)
+    dq, dk, dv = flash_attention_bwd_step(
+        qf, kf, vf, g, delta, lse, q_offset=zero, k_offset=zero,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -372,17 +244,9 @@ def _flash_step_kernel(q_ref, k_ref, v_ref, acc_in, m_in, l_in, q_off_ref,
         m_out[0, ...] = m_in[0]
         l_out[0, ...] = l_in[0]
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    if causal:
-        q_pos = (q_off_ref[0] + qi * block_q +
-                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
-        k_pos = (k_off_ref[0] + kb * block_k +
-                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
-        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-
+    _, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
+                              k_off_ref[0] + kb * block_k, block_q, block_k,
+                              causal, scale)
     v = v_ref[0].astype(jnp.float32)
     m = m_out[0]
     m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
@@ -460,3 +324,188 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
                                  vma=frozenset(vma_axes)),
         ),
     )(q, k, v, acc, m, l, q_off, k_off)
+
+
+def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
+                              lse_ref, q_off_ref, k_off_ref, dq_ref, acc_ref,
+                              *, block_q: int, block_k: int, causal: bool,
+                              scale: float):
+    """dQ contribution of ONE key/value block (global offsets), for the
+    ring backward: softmax is recomputed from the forward's global
+    logsumexp, so each block's dQ piece is independently correct and the
+    ring loop just sums them."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    active = True
+    if causal:
+        active = (k_off_ref[0] + kb * block_k <=
+                  q_off_ref[0] + qi * block_q + block_q - 1)
+
+    @pl.when(active)
+    def _():
+        _, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
+                                  k_off_ref[0] + kb * block_k, block_q,
+                                  block_k, causal, scale)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _softmax_tile(s, lse_ref[0])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _():
+        dq_ref[0, ...] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
+                               lse_ref, q_off_ref, k_off_ref, dk_ref, dv_ref,
+                               dk_acc, dv_acc, *, block_q: int, block_k: int,
+                               causal: bool, scale: float):
+    """dK/dV of the currently-held key/value block w.r.t. the LOCAL
+    queries only (global offsets). In the ring backward these partials
+    ride the rotation with their block and sum to the full gradient once
+    the block returns home."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q_blocks = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    active = True
+    if causal:
+        active = (q_off_ref[0] + qi * block_q + block_q - 1 >=
+                  k_off_ref[0] + kb * block_k)
+
+    @pl.when(active)
+    def _():
+        q, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
+                                  k_off_ref[0] + kb * block_k, block_q,
+                                  block_k, causal, scale)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        p = _softmax_tile(s, lse_ref[0])
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        # q already carries `scale` (see _score_tile_global).
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _():
+        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "vma_axes"))
+def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
+                             causal: bool = True, block_q: int = 128,
+                             block_k: int = 128, interpret: bool = False,
+                             vma_axes=()):
+    """Backward mirror of flash_attention_step: gradients through one
+    key/value block at a global position.
+
+    q, do: (bh, t_q, d); k, v: (bh, t_kv, d); delta = rowsum(dO * O) and
+    lse = m + log(l), both (bh, t_q, 1) float32 from the completed
+    forward. Returns (dq_partial, dk, dv): dq_partial sums across blocks
+    to the full dQ; dk/dv are this block's gradients w.r.t. the local
+    queries only. Used by gloo_tpu.parallel.sp.ring_flash_attention's
+    VJP (reference backward split: gloo has no device plane; torch ring
+    attention recipes shard this the same way).
+    """
+    bh, tq, d = q.shape
+    tkv = k.shape[1]
+    if tq % block_q != 0 or tkv % block_k != 0:
+        raise ValueError("tile sizes must divide the block shapes")
+    scale = 1.0 / (d ** 0.5)
+    q_off = jnp.reshape(q_offset.astype(jnp.int32), (1,))
+    k_off = jnp.reshape(k_offset.astype(jnp.int32), (1,))
+    vma = frozenset(vma_axes)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_step_kernel, block_q=block_q,
+                                  block_k=block_k, causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        interpret=interpret,
+        grid=(bh, tq // block_q, tkv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), jnp.float32, vma=vma),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )(q, k, v, do, delta, lse, q_off, k_off)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_step_kernel,
+                                   block_q=block_q, block_k=block_k,
+                                   causal=causal, scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        interpret=interpret,
+        grid=(bh, tkv // block_k, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tkv, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, tkv, d), jnp.float32, vma=vma),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+    )(q, k, v, do, delta, lse, q_off, k_off)
+    return dq, dk, dv

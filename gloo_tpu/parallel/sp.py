@@ -13,6 +13,8 @@ Call inside shard_map with the time axis sharded:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -67,19 +69,8 @@ def ring_attention(q, k, v, axis: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
-                         block_q: int = 128, block_k: int = 128,
-                         interpret: bool = False):
-    """Ring attention with a Pallas flash inner kernel: K/V blocks rotate
-    over ICI (ppermute) while each device folds the arriving block into
-    carried online-softmax state tile-by-tile on the MXU — the standard
-    long-context recipe (cross-chip ring x on-chip flash), with no
-    (t_local, t_local) materialization either.
-
-    Shapes as ring_attention: q, k, v are (batch, heads, t_local, d) per
-    device inside shard_map. Forward-only (wrap with jax.checkpoint or
-    use ring_attention for the differentiable path until the step kernel
-    grows a VJP)."""
+def _ring_flash_forward(q, k, v, axis, causal, block_q, block_k, interpret):
+    """Forward ring loop; returns (out in q.dtype, logsumexp rows)."""
     from gloo_tpu.ops.attention import flash_attention_step
 
     n = spmd.size(axis)
@@ -100,12 +91,96 @@ def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
         v_next = spmd.shift(v_blk, axis, 1)
         return k_next, v_next, acc, m, l
 
-    acc0 = lax.pcast(jnp.zeros((b * h, t_local, d), jnp.float32), (axis,),
-                     to="varying")
-    m0 = lax.pcast(jnp.full((b * h, t_local, 1), -jnp.inf, jnp.float32),
-                   (axis,), to="varying")
-    l0 = lax.pcast(jnp.zeros((b * h, t_local, 1), jnp.float32), (axis,),
-                   to="varying")
+    def zeros(shape, fill=0.0):
+        return lax.pcast(jnp.full(shape, fill, jnp.float32), (axis,),
+                         to="varying")
+
+    acc0 = zeros((b * h, t_local, d))
+    m0 = zeros((b * h, t_local, 1), -jnp.inf)
+    l0 = zeros((b * h, t_local, 1))
     _, _, acc, m, l = lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.reshape(b, h, t_local, d).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe).reshape(b, h, t_local, d).astype(q.dtype)
+    return out, m + jnp.log(l_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis, causal, block_q, block_k, interpret):
+    return _ring_flash_forward(q, k, v, axis, causal, block_q, block_k,
+                               interpret)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, block_q, block_k, interpret):
+    out, lse = _ring_flash_forward(q, k, v, axis, causal, block_q, block_k,
+                                   interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, causal, block_q, block_k, interpret, res, g):
+    """Second ring pass. Softmax tiles are recomputed from the forward's
+    global logsumexp, so each (queries, rotated block) pair yields an
+    independently-correct gradient piece: dQ pieces sum locally; dK/dV
+    pieces are accumulated into buffers that rotate WITH their key/value
+    block, so each block's gradient arrives home exactly when the block
+    does."""
+    from gloo_tpu.ops.attention import flash_attention_bwd_step
+
+    q, k, v, out, lse = res
+    n = spmd.size(axis)
+    my = spmd.rank(axis)
+    b, h, t_local, d = q.shape
+    bh = b * h
+    qf = q.reshape(bh, t_local, d)
+    gf = g.astype(jnp.float32).reshape(bh, t_local, d)
+    delta = jnp.sum(gf * out.astype(jnp.float32).reshape(bh, t_local, d),
+                    axis=-1, keepdims=True)
+
+    def step(i, carry):
+        k_blk, v_blk, dk_c, dv_c, dq = carry
+        src = lax.rem(my - i + n, n)
+        dq_p, dk_p, dv_p = flash_attention_bwd_step(
+            qf, k_blk.reshape(bh, t_local, d),
+            v_blk.reshape(bh, t_local, d), gf, delta, lse,
+            q_offset=my * t_local, k_offset=src * t_local, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            vma_axes=(axis,))
+        return (spmd.shift(k_blk, axis, 1), spmd.shift(v_blk, axis, 1),
+                spmd.shift(dk_c + dk_p, axis, 1),
+                spmd.shift(dv_c + dv_p, axis, 1), dq + dq_p)
+
+    def zeros(shape):
+        return lax.pcast(jnp.zeros(shape, jnp.float32), (axis,),
+                         to="varying")
+
+    _, _, dk, dv, dq = lax.fori_loop(
+        0, n, step,
+        (k, v, zeros((bh, t_local, d)), zeros((bh, t_local, d)),
+         zeros((bh, t_local, d))))
+    shape = (b, h, t_local, d)
+    return (dq.reshape(shape).astype(q.dtype),
+            dk.reshape(shape).astype(k.dtype),
+            dv.reshape(shape).astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False):
+    """Ring attention with a Pallas flash inner kernel: K/V blocks rotate
+    over ICI (ppermute) while each device folds the arriving block into
+    carried online-softmax state tile-by-tile on the MXU — the standard
+    long-context recipe (cross-chip ring x on-chip flash), with no
+    (t_local, t_local) materialization either.
+
+    Shapes as ring_attention: q, k, v are (batch, heads, t_local, d) per
+    device inside shard_map. Differentiable: the custom VJP runs a second
+    ring pass with dedicated Pallas backward kernels (dQ local; dK/dV
+    partials ride the rotation home with their block).
+
+    interpret=True requires check_vma=False on the enclosing shard_map:
+    the Pallas HLO interpreter's block indexing mixes varying and
+    invariant operands, which vma checking rejects (JAX limitation; the
+    compiled TPU path works under the default check_vma=True)."""
+    return _ring_flash(q, k, v, axis, causal, block_q, block_k, interpret)

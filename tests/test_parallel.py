@@ -256,3 +256,39 @@ def test_ring_flash_attention_matches_full(causal):
     pr /= pr.sum(-1, keepdims=True)
     expected = np.einsum("bhqk,bhkd->bhqd", pr, v)
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_attention_grads(causal):
+    """VJP of the ring-flash path == grads of full attention."""
+    from gloo_tpu.parallel import ring_flash_attention
+
+    mesh = make_mesh({"seq": -1})
+    p = mesh.shape["seq"]
+    b, h, t, d = 1, 1, 16 * p, 32
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+
+    def loss_ring(q, k, v):
+        f = jax.shard_map(
+            lambda q, k, v: ring_flash_attention(q, k, v, "seq",
+                                                 causal=causal, block_q=8,
+                                                 block_k=8, interpret=True),
+            mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+            out_specs=P(None, None, "seq"), check_vma=False)
+        return jnp.sum(jnp.sin(f(q, k, v)))
+
+    def loss_full(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -jnp.inf)
+        pr = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.sin(jnp.einsum("bhqk,bhkd->bhqd", pr, v)))
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-5)
