@@ -283,6 +283,76 @@ int tc_reduce(void* ctx, const void* input, void* output, size_t count,
   });
 }
 
+
+// Custom-reduction variants: `fn` is an arbitrary commutative-associative
+// accumulate callback fn(acc, in, n_elems) invoked on the calling thread
+// (reference: gloo/allreduce.h:36 arbitrary Func; gloo/algorithm.h:59-95
+// ReductionFunction CUSTOM). Python passes a ctypes CFUNCTYPE here.
+int tc_allreduce_fn(void* ctx, const void* input, void* output, size_t count,
+                    int dtype, void (*fn)(void*, const void*, size_t),
+                    int algorithm, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllreduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.inputs = {input};
+    opts.outputs = {output};
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.customFn = fn;
+    opts.algorithm = static_cast<tpucoll::AllreduceAlgorithm>(algorithm);
+    tpucoll::allreduce(opts);
+  });
+}
+
+int tc_reduce_fn(void* ctx, const void* input, void* output, size_t count,
+                 int dtype, void (*fn)(void*, const void*, size_t), int root,
+                 uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ReduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.customFn = fn;
+    opts.root = root;
+    tpucoll::reduce(opts);
+  });
+}
+
+int tc_reduce_scatter_fn(void* ctx, const void* input, void* output,
+                         const size_t* recvCounts, int dtype,
+                         void (*fn)(void*, const void*, size_t), uint32_t tag,
+                         int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ReduceScatterOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = input;
+    opts.output = output;
+    opts.recvCounts = countsVec(recvCounts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.customFn = fn;
+    tpucoll::reduceScatter(opts);
+  });
+}
+
+int tc_allreduce_multi_fn(void* ctx, const void** inputs, void** outputs,
+                          size_t nbufs, size_t count, int dtype,
+                          void (*fn)(void*, const void*, size_t),
+                          int algorithm, uint32_t tag, int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllreduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.inputs.assign(inputs, inputs + nbufs);
+    opts.outputs.assign(outputs, outputs + nbufs);
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.customFn = fn;
+    opts.algorithm = static_cast<tpucoll::AllreduceAlgorithm>(algorithm);
+    tpucoll::allreduce(opts);
+  });
+}
+
 int tc_gather(void* ctx, const void* input, void* output, size_t count,
               int dtype, int root, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {

@@ -73,6 +73,11 @@ struct AllreduceOptions : CollectiveOptions {
   size_t count = 0;
   DataType dtype = DataType::kFloat32;
   ReduceOp op = ReduceOp::kSum;
+  // Overrides `op` when set: an arbitrary commutative-associative
+  // accumulate fn(acc, in, n_elems) (reference: gloo/allreduce.h:36 takes
+  // any Func; gloo/algorithm.h:59-95 ReductionFunction CUSTOM). Not
+  // compatible with kRingBf16Wire (the wire codec reduces in bf16).
+  ReduceFn customFn = nullptr;
   AllreduceAlgorithm algorithm = AllreduceAlgorithm::kAuto;
 };
 void allreduce(AllreduceOptions& opts);
@@ -83,6 +88,7 @@ struct ReduceOptions : CollectiveOptions {
   size_t count = 0;
   DataType dtype = DataType::kFloat32;
   ReduceOp op = ReduceOp::kSum;
+  ReduceFn customFn = nullptr;  // overrides `op` when set
   int root = 0;
 };
 void reduce(ReduceOptions& opts);
@@ -155,6 +161,7 @@ struct ReduceScatterOptions : CollectiveOptions {
   std::vector<size_t> recvCounts;   // per-rank result block sizes
   DataType dtype = DataType::kFloat32;
   ReduceOp op = ReduceOp::kSum;
+  ReduceFn customFn = nullptr;      // overrides `op` when set
 };
 void reduceScatter(ReduceScatterOptions& opts);
 

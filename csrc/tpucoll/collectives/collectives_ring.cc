@@ -260,7 +260,9 @@ void allreduce(AllreduceOptions& opts) {
   const int size = ctx->size();
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
-  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+  ReduceFn fn = opts.customFn != nullptr
+                  ? opts.customFn
+                  : getReduceFn(opts.dtype, opts.op);
 
   // Local reduction of all inputs into outputs[0].
   char* work = bytePtr(opts.outputs[0]);
@@ -270,6 +272,11 @@ void allreduce(AllreduceOptions& opts) {
   for (size_t i = 1; i < opts.inputs.size(); i++) {
     fn(work, opts.inputs[i], opts.count);
   }
+
+  TC_ENFORCE(opts.customFn == nullptr ||
+                 opts.algorithm != AllreduceAlgorithm::kRingBf16Wire,
+             "allreduce: custom reduction functions are incompatible "
+             "with the bf16-wire algorithm (it accumulates in bf16)");
 
   if (size > 1 && opts.count > 0) {
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
@@ -354,7 +361,9 @@ void reduce(ReduceOptions& opts) {
   TC_ENFORCE(opts.root >= 0 && opts.root < size, "reduce: bad root");
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
-  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+  ReduceFn fn = opts.customFn != nullptr
+                  ? opts.customFn
+                  : getReduceFn(opts.dtype, opts.op);
 
   const bool isRoot = rank == opts.root;
   TC_ENFORCE(!isRoot || opts.output != nullptr, "reduce: root needs output");
@@ -412,7 +421,9 @@ void reduceScatter(ReduceScatterOptions& opts) {
   const int size = ctx->size();
   TC_ENFORCE_EQ(opts.recvCounts.size(), static_cast<size_t>(size));
   const size_t elsize = elementSize(opts.dtype);
-  ReduceFn fn = getReduceFn(opts.dtype, opts.op);
+  ReduceFn fn = opts.customFn != nullptr
+                  ? opts.customFn
+                  : getReduceFn(opts.dtype, opts.op);
   Blocks blocks = countBlocks(opts.recvCounts, elsize);
   const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
 

@@ -63,6 +63,51 @@ class ReduceOp:
         return int(op)
 
 
+_REDUCE_CFUNC = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_size_t)
+
+
+def _wrap_reduce_fn(fn, dtype):
+    """Wrap a Python accumulate callable as the C ReduceFn ABI.
+
+    `fn(acc, inp)` receives two length-n numpy views of the collective's
+    dtype and must write the combined result into `acc` in place. The
+    operation must be commutative and associative — ring/halving-doubling/
+    bcube schedules apply it in rank-dependent orders (reference:
+    gloo/algorithm.h:59-95 ReductionFunction CUSTOM; gloo/allreduce.h:36
+    arbitrary Func).
+
+    Exceptions raised inside `fn` cannot propagate across the C boundary
+    mid-collective (the affected segment is left unreduced, and peers may
+    receive it), so the first one is captured and re-raised to THIS caller
+    after the collective returns — treat it as poisoning the result on
+    all ranks. Call raise_pending() after the C call.
+    """
+    dt = np.dtype(dtype)
+    pending = []
+
+    def thunk(acc_ptr, in_ptr, n):
+        try:
+            nbytes = int(n) * dt.itemsize
+            acc = np.frombuffer(
+                (ctypes.c_char * nbytes).from_address(acc_ptr), dtype=dt)
+            inp = np.frombuffer(
+                (ctypes.c_char * nbytes).from_address(in_ptr), dtype=dt)
+            fn(acc, inp)
+        except BaseException as e:  # noqa: BLE001 — must not cross C frame
+            if not pending:
+                pending.append(e)
+
+    def raise_pending():
+        if pending:
+            raise Error(
+                "custom reduction callable raised; the collective result "
+                "is invalid on all ranks") from pending[0]
+
+    cb = _REDUCE_CFUNC(thunk)
+    return cb, ctypes.cast(cb, ctypes.c_void_p), raise_pending
+
+
 def _dtype_code(arr: np.ndarray) -> int:
     name = arr.dtype.name
     if name not in _DTYPE_CODES:
@@ -396,8 +441,20 @@ class Context:
 
         algorithm: "auto" (ring for large payloads, halving-doubling for
         small), "ring", or "halving_doubling".
+
+        op may also be a callable `fn(acc, inp)` combining two numpy views
+        in place into acc (see _wrap_reduce_fn for the contract).
         """
         _check_array(array)
+        if callable(op):
+            cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
+            check(_lib.lib.tc_allreduce_fn(
+                self._handle, _ptr(array), _ptr(array), array.size,
+                _dtype_code(array), fnp, self._ALGORITHMS[algorithm], tag,
+                _timeout_ms(timeout)))
+            del cb
+            raise_pending()
+            return array
         check(_lib.lib.tc_allreduce(self._handle, _ptr(array), _ptr(array),
                                     array.size, _dtype_code(array),
                                     ReduceOp.parse(op),
@@ -421,6 +478,15 @@ class Context:
                         "size")
         ptrs = (ctypes.c_void_p * len(arrays))(
             *[a.ctypes.data for a in arrays])
+        if callable(op):
+            cb, fnp, raise_pending = _wrap_reduce_fn(op, arrays[0].dtype)
+            check(_lib.lib.tc_allreduce_multi_fn(
+                self._handle, ptrs, ptrs, len(arrays), arrays[0].size,
+                _dtype_code(arrays[0]), fnp, self._ALGORITHMS[algorithm],
+                tag, _timeout_ms(timeout)))
+            del cb
+            raise_pending()
+            return arrays
         check(_lib.lib.tc_allreduce_multi(
             self._handle, ptrs, ptrs, len(arrays), arrays[0].size,
             _dtype_code(arrays[0]), ReduceOp.parse(op),
@@ -437,6 +503,15 @@ class Context:
             _check_array(out, "output")
         else:
             out = None
+        if callable(op):
+            cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
+            check(_lib.lib.tc_reduce_fn(
+                self._handle, _ptr(array),
+                _ptr(out) if out is not None else None, array.size,
+                _dtype_code(array), fnp, root, tag, _timeout_ms(timeout)))
+            del cb
+            raise_pending()
+            return out
         check(_lib.lib.tc_reduce(self._handle, _ptr(array),
                                  _ptr(out) if out is not None else None,
                                  array.size, _dtype_code(array),
@@ -550,6 +625,15 @@ class Context:
             recv_counts = [array.size // self.size] * self.size
         assert sum(recv_counts) == array.size, "sum(recv_counts) != size"
         out = np.empty(int(recv_counts[self.rank]), dtype=array.dtype)
+        if callable(op):
+            cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
+            check(_lib.lib.tc_reduce_scatter_fn(
+                self._handle, _ptr(array), _ptr(out),
+                _counts_arg(recv_counts), _dtype_code(array), fnp, tag,
+                _timeout_ms(timeout)))
+            del cb
+            raise_pending()
+            return out
         check(_lib.lib.tc_reduce_scatter(self._handle, _ptr(array),
                                          _ptr(out),
                                          _counts_arg(recv_counts),

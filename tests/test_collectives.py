@@ -504,3 +504,103 @@ def test_sixteen_ranks():
     expected = size * (size + 1) / 2
     for res in spawn(size, fn, timeout=120, context_timeout=60):
         assert res == [expected] * 3, res
+
+
+@pytest.mark.parametrize("algorithm", ["ring", "halving_doubling", "bcube"])
+def test_allreduce_custom_fn(algorithm):
+    """Arbitrary Python reduction callable, every exchange schedule."""
+    n, count = 4, 1000
+
+    def custom(acc, inp):
+        # max-by-absolute-value: commutative + associative, not one of
+        # the builtin ops.
+        np.copyto(acc, np.where(np.abs(inp) > np.abs(acc), inp, acc))
+
+    def fn(ctx, rank):
+        rng = np.random.RandomState(rank)
+        x = rng.randn(count).astype(np.float32)
+        ctx.allreduce(x, op=custom, algorithm=algorithm)
+        return x
+
+    results = spawn(n, fn)
+    alls = np.stack([np.random.RandomState(r).randn(count).astype(np.float32)
+                     for r in range(n)])
+    expected = np.take_along_axis(
+        alls, np.abs(alls).argmax(axis=0)[None], axis=0)[0]
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_reduce_and_reduce_scatter_custom_fn():
+    n, count = 3, 90
+
+    def custom(acc, inp):
+        np.minimum(acc, inp, out=acc)
+
+    def fn(ctx, rank):
+        x = (np.arange(count, dtype=np.float32) + rank * 7) % 13
+        r = ctx.reduce(x.copy(), root=1, op=custom)
+        rs = ctx.reduce_scatter(x.copy(), op=custom)
+        return r, rs
+
+    results = spawn(n, fn)
+    alls = np.stack([(np.arange(count, dtype=np.float32) + r * 7) % 13
+                     for r in range(n)])
+    expected = alls.min(axis=0)
+    np.testing.assert_allclose(results[1][0], expected)
+    assert results[0][0] is None
+    per = count // n
+    for r in range(n):
+        np.testing.assert_allclose(results[r][1],
+                                   expected[r * per:(r + 1) * per])
+
+
+def test_allreduce_custom_fn_rejects_bf16_wire():
+    import gloo_tpu
+
+    def fn(ctx, rank):
+        x = np.ones(8, np.float32)
+        try:
+            ctx.allreduce(x, op=lambda a, b: None,
+                          algorithm="ring_bf16_wire")
+            return "no error"
+        except gloo_tpu.Error as e:
+            return str(e)
+
+    for msg in spawn(2, fn):
+        assert "incompatible" in msg
+
+
+def test_allreduce_multi_custom_fn():
+    n = 2
+
+    def custom(acc, inp):
+        np.maximum(acc, inp, out=acc)
+
+    def fn(ctx, rank):
+        a = np.full(16, rank * 2.0, np.float32)
+        b = np.full(16, rank * 2.0 + 1, np.float32)
+        ctx.allreduce_multi([a, b], op=custom)
+        return a, b
+
+    for a, b in spawn(n, fn):
+        np.testing.assert_array_equal(a, np.full(16, 3.0, np.float32))
+        np.testing.assert_array_equal(b, np.full(16, 3.0, np.float32))
+
+
+def test_allreduce_custom_fn_raising_callable_surfaces():
+    import gloo_tpu
+
+    def bad(acc, inp):
+        raise RuntimeError("boom in user fn")
+
+    def fn(ctx, rank):
+        x = np.ones(64, np.float32)
+        try:
+            ctx.allreduce(x, op=bad)
+            return "no error"
+        except gloo_tpu.Error as e:
+            return f"{e} / cause: {e.__cause__}"
+
+    for msg in spawn(2, fn):
+        assert "invalid on all ranks" in msg and "boom in user fn" in msg
