@@ -8,17 +8,24 @@ by shipping the strategies themselves, each built on a gloo_tpu plane:
   host-plane gradient allreduce over the C++ TCP transport (the exact role
   the reference plays under PyTorch DDP);
 - `tp`: Megatron-style tensor parallelism (column/row-parallel dense);
-- `sp`: sequence/context parallelism — ring attention over ppermute;
+- `sp`: sequence/context parallelism — ring attention over ppermute,
+  plus Ulysses-style all-to-all head/sequence exchange;
 - `pp`: GPipe-style pipeline parallelism — stages rotate activations
   with ppermute under one lax.scan;
 - `ep`: expert parallelism — fixed-capacity MoE dispatch/combine over
-  all_to_all.
+  all_to_all;
+- `fsdp`: ZeRO-3-style fully-sharded data parallelism — just-in-time
+  parameter allgather whose autodiff transpose is the gradient
+  reduce-scatter.
 """
 
 from gloo_tpu.parallel.ddp import HostGradSync, make_ddp_train_step
 from gloo_tpu.parallel.ep import dispatch_combine
+from gloo_tpu.parallel.fsdp import (make_fsdp_train_step, shard_params,
+                                    unshard_params)
 from gloo_tpu.parallel.pp import pipeline_apply
-from gloo_tpu.parallel.sp import ring_attention, ring_flash_attention
+from gloo_tpu.parallel.sp import (ring_attention, ring_flash_attention,
+                                  ulysses_attention)
 from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
                                   tp_mlp_block)
 
@@ -27,9 +34,13 @@ __all__ = [
     "column_parallel_dense",
     "dispatch_combine",
     "make_ddp_train_step",
+    "make_fsdp_train_step",
     "pipeline_apply",
     "ring_attention",
     "ring_flash_attention",
     "row_parallel_dense",
+    "shard_params",
+    "ulysses_attention",
+    "unshard_params",
     "tp_mlp_block",
 ]

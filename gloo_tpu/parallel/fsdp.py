@@ -1,0 +1,101 @@
+"""ZeRO-3 / FSDP-style fully-sharded data parallelism.
+
+Every parameter lives flattened and sharded across the data axis; the
+forward all-gathers each leaf just-in-time, and the backward produces
+gradients that are ALREADY sharded — no separate reduce-scatter pass is
+written anywhere. That is the TPU-native formulation of the ZeRO
+recipe: `lax.all_gather`'s transpose IS `psum_scatter`, so jax.grad of
+the gather-then-compute program emits exactly the reference-style
+allgather(params) + reduce_scatter(grads) schedule (SURVEY.md §2.10:
+gloo supplies those two collectives as the primitives FSDP/ZeRO are
+built from; the schedule here is recovered by autodiff instead of
+hand-written).
+
+Memory: parameter and gradient state per device is 1/n of the model
+(plus the transient gathered leaf); optimizer state (the SGD update
+below, or any optax state threaded the same way) is sharded too.
+
+Use inside shard_map with the batch sharded over `axis`:
+
+    sharded = shard_params(params, n, axis)        # once, per device
+    step = make_fsdp_train_step(loss_fn, params, axis, lr=0.1)
+    sharded, loss = step(sharded, batch)           # repeat
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gloo_tpu.tpu import spmd
+
+
+def _pad_len(size: int, n: int) -> int:
+    return (-size) % n
+
+
+def shard_params(params, n: int, axis: str):
+    """Flatten each leaf, zero-pad to a multiple of n, and keep only this
+    device's 1/n chunk. Call inside shard_map."""
+    my = spmd.rank(axis)
+
+    def shard(p):
+        flat = p.reshape(-1)
+        flat = jnp.pad(flat, (0, _pad_len(flat.size, n)))
+        chunk = flat.size // n
+        # dynamic_slice at a rank-dependent offset is already varying
+        # over `axis` — no pcast needed.
+        return lax.dynamic_slice(flat, (my * chunk,), (chunk,))
+
+    return jax.tree.map(shard, params)
+
+
+def unshard_params(sharded, template, axis: str):
+    """All-gather every leaf back to its full shape. `template` is any
+    pytree with the original leaf shapes (e.g. jax.eval_shape output or
+    the unsharded params)."""
+
+    def gather(piece, ref):
+        size = 1
+        for s in ref.shape:
+            size *= s
+        full = spmd.allgather(piece, axis)
+        return full[:size].reshape(ref.shape).astype(ref.dtype)
+
+    return jax.tree.map(gather, sharded, template)
+
+
+def make_fsdp_train_step(loss_fn, template, axis: str, lr: float = 1e-2):
+    """SGD train step over fully-sharded parameters.
+
+    loss_fn(params, batch) -> scalar local loss, computed on the
+    device's local batch shard. The step returns (new_sharded_params,
+    global mean loss). Gradients w.r.t. the shards come out of jax.grad
+    already reduce-scattered (all_gather transposes to psum_scatter),
+    so the update touches only 1/n of the model per device.
+    """
+    # Keep only leaf metadata: closing over real arrays would bake the
+    # whole unsharded model into the jitted executable as replicated
+    # constants, defeating the 1/n memory point of sharding.
+    template = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), template)
+
+    def local_loss(sharded, batch):
+        params = unshard_params(sharded, template, axis)
+        return loss_fn(params, batch)
+
+    def step(sharded, batch, step_lr=lr):
+        # Differentiate the LOCAL loss only: the all_gather's transpose
+        # (psum_scatter) already sums every device's contribution into
+        # the shard, so dividing by n yields the global-mean gradient.
+        # Keeping psum out of the differentiated function matters — its
+        # transpose re-psums the cotangent, which would scale grads by n
+        # (same pitfall as ddp.py's grads/n).
+        loss, grads = jax.value_and_grad(local_loss)(sharded, batch)
+        n = spmd.size(axis)
+        new = jax.tree.map(lambda p, g: p - step_lr * (g / n), sharded,
+                           grads)
+        return new, spmd.allreduce(loss, axis) / n
+
+    return step

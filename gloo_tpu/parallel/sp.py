@@ -184,3 +184,37 @@ def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
     invariant operands, which vma checking rejects (JAX limitation; the
     compiled TPU path works under the default check_vma=True)."""
     return _ring_flash(q, k, v, axis, causal, block_q, block_k, interpret)
+
+
+def ulysses_attention(q, k, v, axis: str, causal: bool = True,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses-style sequence parallelism: two all-to-alls swap
+    the sharded dimension from sequence to heads, so each device runs
+    FULL-sequence attention for a subset of heads, then a final
+    all-to-all restores sequence sharding. The complement to the ring
+    recipes: all_to_all rides ICI once per direction instead of n-1
+    ppermute steps, at the cost of requiring heads % group size == 0.
+    (Reference positioning: SURVEY.md §2.10 — gloo supplies alltoall as
+    the primitive these recipes are built from.)
+
+    q, k, v: (batch, heads, t_local, d) per device inside shard_map.
+    attn_fn(q, k, v, causal) computes attention on full-sequence inputs;
+    defaults to the materialized-scores reference (use
+    ops.flash_attention for long sequences).
+    """
+    n = spmd.size(axis)
+    b, h, t_local, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by group size {n}")
+    if attn_fn is None:
+        from gloo_tpu.ops.attention import _reference_attention
+        attn_fn = _reference_attention
+
+    # (b, h, t_local, d) -> (b, h/n, t_global, d): scatter heads, gather
+    # sequence. all_to_all splits/concats one axis; heads is axis 1,
+    # sequence axis 2.
+    qh, kh, vh = (spmd.alltoall(x, axis, split_axis=1, concat_axis=2)
+                  for x in (q, k, v))
+    out = attn_fn(qh, kh, vh, causal)
+    # (b, h/n, t_global, d) -> (b, h, t_local, d): inverse exchange.
+    return spmd.alltoall(out, axis, split_axis=2, concat_axis=1)
