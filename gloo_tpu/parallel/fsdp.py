@@ -17,7 +17,7 @@ below, or any optax state threaded the same way) is sharded too.
 
 Use inside shard_map with the batch sharded over `axis`:
 
-    sharded = shard_params(params, n, axis)        # once, per device
+    sharded = shard_params(params, axis)           # once, per device
     step = make_fsdp_train_step(loss_fn, params, axis, lr=0.1)
     sharded, loss = step(sharded, batch)           # repeat
 """
@@ -35,9 +35,10 @@ def _pad_len(size: int, n: int) -> int:
     return (-size) % n
 
 
-def shard_params(params, n: int, axis: str):
-    """Flatten each leaf, zero-pad to a multiple of n, and keep only this
-    device's 1/n chunk. Call inside shard_map."""
+def shard_params(params, axis: str):
+    """Flatten each leaf, zero-pad to a multiple of the axis size, and keep
+    only this device's 1/n chunk. Call inside shard_map."""
+    n = spmd.size(axis)
     my = spmd.rank(axis)
 
     def shard(p):

@@ -199,8 +199,11 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = True,
 
     q, k, v: (batch, heads, t_local, d) per device inside shard_map.
     attn_fn(q, k, v, causal) computes attention on full-sequence inputs;
-    defaults to the materialized-scores reference (use
-    ops.flash_attention for long sequences).
+    defaults to the materialized-scores reference. For long sequences
+    pass ops.flash_attention — that combination needs check_vma=False on
+    the enclosing shard_map (the single-device kernel's out_shape carries
+    no vma; same JAX limitation as ring_flash_attention's interpret
+    mode).
     """
     n = spmd.size(axis)
     b, h, t_local, d = q.shape

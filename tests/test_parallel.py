@@ -391,7 +391,7 @@ def test_fsdp_matches_single_device_sgd():
     step = make_fsdp_train_step(loss_fn, params, "data", lr=lr)
 
     def run(params, xs, ys):
-        sharded = shard_params(params, n, "data")
+        sharded = shard_params(params, "data")
         losses = []
         for _ in range(3):
             sharded, loss = step(sharded, (xs, ys))
