@@ -9,7 +9,8 @@ DMA engines directly for schedules XLA does not emit.
 from gloo_tpu.ops.attention import (flash_attention, flash_attention_step,
                                     flash_attention_bwd_step,
                                      largest_block)
-from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
+from gloo_tpu.ops.pallas_ring import (pallas_alltoall, ring_allgather,
+                                       ring_allreduce,
                                        ring_allreduce_bidir,
                                        ring_allreduce_hbm,
                                        ring_allreduce_q8,
@@ -17,7 +18,7 @@ from gloo_tpu.ops.pallas_ring import (ring_allgather, ring_allreduce,
                                        ring_reduce_scatter)
 
 __all__ = ["flash_attention", "flash_attention_step",
-           "flash_attention_bwd_step", "ring_allgather",
+           "flash_attention_bwd_step", "pallas_alltoall", "ring_allgather",
            "ring_allreduce",
            "ring_allreduce_bidir",
            "ring_allreduce_hbm", "ring_allreduce_q8",

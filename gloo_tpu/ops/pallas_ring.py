@@ -31,27 +31,33 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _ring_neighbors(axis_name, mesh_axes):
-    """Flattened LOGICAL device ids of this device and its ring neighbors.
+def _peer_logical_id(axis_name, mesh_axes, r):
+    """Flattened LOGICAL device id of ring-index r along axis_name.
 
     On a single-axis mesh the ring index IS the logical id. On a multi-axis
     mesh the logical id is the row-major flattened coordinate over
-    `mesh_axes` (the mesh's full axis order), so the neighbor along one
-    axis differs by that axis's stride.
+    `mesh_axes` (the mesh's full axis order), so a peer along one axis
+    differs by that axis's stride.
     """
-    n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     if mesh_axes is None or tuple(mesh_axes) == (axis_name,):
-        return my, lax.rem(my + 1, n), lax.rem(my - 1 + n, n)
+        return r
     axes = tuple(mesh_axes)
     my_flat = lax.axis_index(axes)
     idx = axes.index(axis_name)
     stride = 1
     for a in axes[idx + 1:]:
         stride = stride * lax.axis_size(a)
-    right = my_flat + (lax.rem(my + 1, n) - my) * stride
-    left = my_flat + (lax.rem(my - 1 + n, n) - my) * stride
-    return my_flat, right, left
+    return my_flat + (r - my) * stride
+
+
+def _ring_neighbors(axis_name, mesh_axes):
+    """(me, right, left) flattened LOGICAL ids — see _peer_logical_id."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    return (_peer_logical_id(axis_name, mesh_axes, my),
+            _peer_logical_id(axis_name, mesh_axes, lax.rem(my + 1, n)),
+            _peer_logical_id(axis_name, mesh_axes, lax.rem(my - 1 + n, n)))
 
 
 def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
@@ -976,3 +982,114 @@ def ring_allreduce_torus(x, axis_names, mesh_axes,
             collective_id=collective_id_base + len(axes) + i,
             interpret=interpret, mesh_axes=mesh_axes)
     return x
+
+
+def _alltoall_kernel(x_ref, o_ref, send_sems, recv_sems, *, axis_name: str,
+                     mesh_axes, num_devices: int, chunk_rows: int):
+    """Rotated-pairwise all-to-all (the on-device mirror of the host
+    schedule, reference: gloo/alltoall.cc:39-50): at step s every device
+    sends block (my+s) to peer (my+s) and receives block my from peer
+    (my-s) — a permutation per step. The per-step semaphore slots work
+    because each device gets exactly ONE incoming copy per step index
+    (from (my-s), which uses slot s on my side), not because sender and
+    receiver are the same pair; collapsing the slots or weakening the
+    full-peer entry barrier WOULD race. The copies are independent (each
+    reads a distinct x block and lands in a distinct remote slot), so all
+    n-1 start before any wait."""
+    n = num_devices
+    my = lax.axis_index(axis_name)
+
+    def blk(idx):
+        return pl.ds(idx * chunk_rows, chunk_rows)
+
+    o_ref[blk(my), :] = x_ref[blk(my), :]
+
+    # Every peer will be written to; none may be touched before it has
+    # entered the kernel and allocated its buffers.
+    barrier = pltpu.get_barrier_semaphore()
+
+    def signal_peer(s, _):
+        peer = _peer_logical_id(axis_name, mesh_axes, lax.rem(my + s, n))
+        pltpu.semaphore_signal(barrier, inc=1, device_id=peer,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(1, n, signal_peer, 0)
+    pltpu.semaphore_wait(barrier, n - 1)
+
+    def make_copy(s):
+        dst = lax.rem(my + s, n)
+        return pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[blk(dst), :],
+            dst_ref=o_ref.at[blk(my), :],
+            send_sem=send_sems.at[s - 1], recv_sem=recv_sems.at[s - 1],
+            device_id=_peer_logical_id(axis_name, mesh_axes, dst),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def start(s, _):
+        make_copy(s).start()
+        return 0
+
+    def wait(s, _):
+        make_copy(s).wait()
+        return 0
+
+    lax.fori_loop(1, n, start, 0)
+    lax.fori_loop(1, n, wait, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh_axes",
+                                    "collective_id", "interpret"))
+def _alltoall_shard(x, *, axis_name: str, mesh_axes, collective_id: int,
+                    interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, cols = x.shape
+    if n == 1:
+        return x
+    if rows % n != 0:
+        raise ValueError(f"rows {rows} not divisible by ring size {n}")
+    kernel = functools.partial(_alltoall_kernel, axis_name=axis_name,
+                               mesh_axes=mesh_axes, num_devices=n,
+                               chunk_rows=rows // n)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x)
+
+
+def pallas_alltoall(x, axis_name: str, collective_id: int = 19,
+                    interpret: bool = False, mesh_axes=None):
+    """All-to-all over the inter-chip DMA engines: x is (P * chunk_rows,
+    cols); output block r is peer r's block for this rank (the EP/MoE
+    dispatch hot path). On a multi-axis mesh, mesh_axes (the Mesh's axis
+    order) is REQUIRED — see ring_reduce_scatter. Differentiable: the
+    global block swap (i, j) -> (j, i) is an involution, so its adjoint
+    is the same all-to-all run on the cotangent."""
+    ma = None if mesh_axes is None else tuple(mesh_axes)
+
+    @jax.custom_vjp
+    def op(v):
+        return _alltoall_shard(v, axis_name=axis_name, mesh_axes=ma,
+                               collective_id=collective_id,
+                               interpret=interpret)
+
+    def fwd(v):
+        return op(v), None
+
+    def bwd(_, g):
+        return (op(g),)
+
+    op.defvjp(fwd, bwd)
+    return op(x)
