@@ -128,11 +128,25 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     the score matrix. seq must be divisible by the block sizes; head_dim
     should be a multiple of 128 for full MXU tiles.
 
+    Supports grouped-query attention: k/v may carry h_kv heads with
+    h % h_kv == 0. Both directions map each query head to its shared kv
+    head in the BlockSpec index maps — kv tiles are NEVER replicated in
+    memory; the backward's per-query-head dK/dV partials are group-summed
+    in f32 before the single downcast.
+
     Differentiable with flash-memory in BOTH directions: the custom VJP
     runs dedicated backward kernels (dQ; dK/dV) that recompute the
     softmax tiles from the saved logsumexp rows — no (T, T)
     materialization anywhere in training."""
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    if v.shape[1] != h_kv:
+        raise ValueError(
+            f"k has {h_kv} heads but v has {v.shape[1]}")
+    if h % h_kv != 0:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {h_kv}")
+    group = h // h_kv
     if t % block_q != 0 or t % block_k != 0:
         raise ValueError(
             f"seq {t} must be divisible by block sizes {block_q}/{block_k}")
@@ -140,8 +154,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
 
     bh = b * h
     qf = q.reshape(bh, t, d)
-    kf = k.reshape(bh, t, d)
-    vf = v.reshape(bh, t, d)
+    kf = k.reshape(b * h_kv, t, d)
+    vf = v.reshape(b * h_kv, t, d)
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale)
@@ -158,7 +172,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
         qf, kf, vf, out, lse = residuals
         return _flash_backward(qf, kf, vf, out, lse, g.astype(qf.dtype),
                                causal=causal, block_q=block_q,
-                               block_k=block_k, interpret=interpret)
+                               block_k=block_k, interpret=interpret,
+                               kv_group=group)
 
     op.defvjp(fwd, bwd)
 
@@ -170,9 +185,11 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kb: (i // group, kb, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, kb: (i // group, kb, 0),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=(
@@ -210,9 +227,12 @@ def largest_block(t: int, cap: int = 128) -> int:
 # ---------------------------------------------------------------------------
 
 def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, kv_group: int = 1):
     """Local (single-block) backward: the step backward kernels with both
-    global offsets at zero."""
+    global offsets at zero. kf/vf may carry bh // kv_group heads (GQA);
+    the per-query-head dK/dV partials come back in f32 and are
+    group-summed BEFORE the single downcast, matching the f32
+    accumulation of the ungrouped path."""
     # delta[i] = rowsum(dO * O): cheap elementwise pass outside pallas.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
@@ -220,7 +240,11 @@ def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
     dq, dk, dv = flash_attention_bwd_step(
         qf, kf, vf, g, delta, lse, q_offset=zero, k_offset=zero,
         causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret)
+        interpret=interpret, kv_group=kv_group)
+    if kv_group > 1:
+        tkv, d = kf.shape[1], kf.shape[2]
+        dk = dk.reshape(-1, kv_group, tkv, d).sum(1)
+        dv = dv.reshape(-1, kv_group, tkv, d).sum(1)
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
 
 
@@ -417,11 +441,11 @@ def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "vma_axes"))
+                                    "interpret", "vma_axes", "kv_group"))
 def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
                              causal: bool = True, block_q: int = 128,
                              block_k: int = 128, interpret: bool = False,
-                             vma_axes=()):
+                             vma_axes=(), kv_group: int = 1):
     """Backward mirror of flash_attention_step: gradients through one
     key/value block at a global position.
 
@@ -432,9 +456,16 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
     queries only. Used by gloo_tpu.parallel.sp.ring_flash_attention's
     VJP (reference backward split: gloo has no device plane; torch ring
     attention recipes shard this the same way).
+
+    kv_group > 1 (GQA): k/v carry bh // kv_group heads, read through the
+    i // kv_group index map (never replicated in memory); dk/dv are still
+    per-QUERY-head f32 partials — the caller group-sums them.
     """
     bh, tq, d = q.shape
     tkv = k.shape[1]
+    if bh % kv_group != 0 or k.shape[0] != bh // kv_group:
+        raise ValueError(
+            f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
     if tq % block_q != 0 or tkv % block_k != 0:
         raise ValueError("tile sizes must divide the block shapes")
     scale = 1.0 / (d ** 0.5)
@@ -451,9 +482,11 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -480,9 +513,11 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, kb, j: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, kb, j: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
                          memory_space=pltpu.VMEM),

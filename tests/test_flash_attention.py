@@ -9,6 +9,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from gloo_tpu.ops import flash_attention  # noqa: E402
+from gloo_tpu.ops.attention import _reference_attention  # noqa: E402
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -120,3 +121,43 @@ def test_flash_attention_trainable(causal, block_q, block_k):
     for a, b_ in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("h,h_kv", [(8, 2), (4, 1), (6, 3)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_gqa(h, h_kv, causal):
+    """Grouped-query/multi-query: kv heads shared via index map; grads
+    group-summed. Oracle: full attention on repeated kv heads."""
+    b, t, d = 2, 32, 32
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h_kv, t, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h_kv, t, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=causal, block_q=8, block_k=8, interpret=True)))
+
+    def loss_ref(q, k, v):
+        kx = jnp.repeat(k, h // h_kv, axis=1)
+        vx = jnp.repeat(v, h // h_kv, axis=1)
+        return jnp.sum(jnp.sin(_reference_attention(q, kx, vx, causal)))
+
+    out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          interpret=True)
+    ref = _reference_attention(q, jnp.repeat(k, h // h_kv, axis=1),
+                               jnp.repeat(v, h // h_kv, axis=1), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_gqa_bad_heads():
+    q = jnp.zeros((1, 5, 32, 16), jnp.float32)
+    k = jnp.zeros((1, 2, 32, 16), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, k, block_q=8, block_k=8, interpret=True)
