@@ -241,10 +241,8 @@ def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
         qf, kf, vf, g, delta, lse, q_offset=zero, k_offset=zero,
         causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret, kv_group=kv_group)
-    if kv_group > 1:
-        tkv, d = kf.shape[1], kf.shape[2]
-        dk = dk.reshape(-1, kv_group, tkv, d).sum(1)
-        dv = dv.reshape(-1, kv_group, tkv, d).sum(1)
+    dk = group_sum_kv(dk, kv_group)
+    dv = group_sum_kv(dv, kv_group)
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
 
 
@@ -288,11 +286,11 @@ def _flash_step_kernel(q_ref, k_ref, v_ref, acc_in, m_in, l_in, q_off_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret", "vma_axes"))
+                                    "interpret", "vma_axes", "kv_group"))
 def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
                          causal: bool = True, block_q: int = 128,
                          block_k: int = 128, interpret: bool = False,
-                         vma_axes=()):
+                         vma_axes=(), kv_group: int = 1):
     """Fold one key/value block into carried flash state.
 
     q: (bh, t_q, d); k, v: (bh, t_kv, d); acc: (bh, t_q, d) float32;
@@ -300,10 +298,14 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
     positions of the tiles. Returns updated (acc, m, l). Used by
     gloo_tpu.parallel.sp.ring_flash_attention, where the ring rotation
     supplies a different k/v block (and k_offset) per step. Inside
-    shard_map with vma checking, pass vma_axes=(axis,).
+    shard_map with vma checking, pass vma_axes=(axis,). kv_group > 1
+    (GQA): k/v carry bh // kv_group heads, shared via the index map.
     """
     bh, tq, d = q.shape
     tkv = k.shape[1]
+    if bh % kv_group != 0 or k.shape[0] != bh // kv_group:
+        raise ValueError(
+            f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
     if tq % block_q != 0 or tkv % block_k != 0:
         raise ValueError("tile sizes must divide the block shapes")
     scale = 1.0 / (d ** 0.5)
@@ -318,9 +320,11 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -348,6 +352,16 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
                                  vma=frozenset(vma_axes)),
         ),
     )(q, k, v, acc, m, l, q_off, k_off)
+
+
+def group_sum_kv(partials, kv_group: int):
+    """Fold per-query-head f32 dK/dV partials down to kv heads: flat query
+    head bi*h + hi pairs with kv head bi*h_kv + hi//group, so consecutive
+    runs of kv_group rows share one kv head."""
+    if kv_group == 1:
+        return partials
+    bh, tkv, d = partials.shape
+    return partials.reshape(bh // kv_group, kv_group, tkv, d).sum(1)
 
 
 def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,

@@ -76,17 +76,22 @@ def _ring_flash_forward(q, k, v, axis, causal, block_q, block_k, interpret):
     n = spmd.size(axis)
     my = spmd.rank(axis)
     b, h, t_local, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv != 0:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {h_kv}")
+    group = h // h_kv
     qf = q.reshape(b * h, t_local, d)
 
     def step(i, carry):
         k_blk, v_blk, acc, m, l = carry
         src = lax.rem(my - i + n, n)
         acc, m, l = flash_attention_step(
-            qf, k_blk.reshape(b * h, t_local, d),
-            v_blk.reshape(b * h, t_local, d), acc, m, l,
+            qf, k_blk.reshape(b * h_kv, t_local, d),
+            v_blk.reshape(b * h_kv, t_local, d), acc, m, l,
             q_offset=my * t_local, k_offset=src * t_local, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
-            vma_axes=(axis,))
+            vma_axes=(axis,), kv_group=group)
         k_next = spmd.shift(k_blk, axis, 1)
         v_next = spmd.shift(v_blk, axis, 1)
         return k_next, v_next, acc, m, l
@@ -123,13 +128,16 @@ def _ring_flash_bwd(axis, causal, block_q, block_k, interpret, res, g):
     pieces are accumulated into buffers that rotate WITH their key/value
     block, so each block's gradient arrives home exactly when the block
     does."""
-    from gloo_tpu.ops.attention import flash_attention_bwd_step
+    from gloo_tpu.ops.attention import flash_attention_bwd_step, group_sum_kv
 
     q, k, v, out, lse = res
     n = spmd.size(axis)
     my = spmd.rank(axis)
     b, h, t_local, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
     bh = b * h
+    bh_kv = b * h_kv
     qf = q.reshape(bh, t_local, d)
     gf = g.astype(jnp.float32).reshape(bh, t_local, d)
     delta = jnp.sum(gf * out.astype(jnp.float32).reshape(bh, t_local, d),
@@ -139,11 +147,13 @@ def _ring_flash_bwd(axis, causal, block_q, block_k, interpret, res, g):
         k_blk, v_blk, dk_c, dv_c, dq = carry
         src = lax.rem(my - i + n, n)
         dq_p, dk_p, dv_p = flash_attention_bwd_step(
-            qf, k_blk.reshape(bh, t_local, d),
-            v_blk.reshape(bh, t_local, d), gf, delta, lse,
+            qf, k_blk.reshape(bh_kv, t_local, d),
+            v_blk.reshape(bh_kv, t_local, d), gf, delta, lse,
             q_offset=my * t_local, k_offset=src * t_local, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
-            vma_axes=(axis,))
+            vma_axes=(axis,), kv_group=group)
+        dk_p = group_sum_kv(dk_p, group)
+        dv_p = group_sum_kv(dv_p, group)
         return (spmd.shift(k_blk, axis, 1), spmd.shift(v_blk, axis, 1),
                 spmd.shift(dk_c + dk_p, axis, 1),
                 spmd.shift(dv_c + dv_p, axis, 1), dq + dq_p)
@@ -154,12 +164,11 @@ def _ring_flash_bwd(axis, causal, block_q, block_k, interpret, res, g):
 
     _, _, dk, dv, dq = lax.fori_loop(
         0, n, step,
-        (k, v, zeros((bh, t_local, d)), zeros((bh, t_local, d)),
+        (k, v, zeros((bh_kv, t_local, d)), zeros((bh_kv, t_local, d)),
          zeros((bh, t_local, d))))
-    shape = (b, h, t_local, d)
-    return (dq.reshape(shape).astype(q.dtype),
-            dk.reshape(shape).astype(k.dtype),
-            dv.reshape(shape).astype(v.dtype))
+    return (dq.reshape(b, h, t_local, d).astype(q.dtype),
+            dk.reshape(b, h_kv, t_local, d).astype(k.dtype),
+            dv.reshape(b, h_kv, t_local, d).astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -175,9 +184,12 @@ def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
     (t_local, t_local) materialization either.
 
     Shapes as ring_attention: q, k, v are (batch, heads, t_local, d) per
-    device inside shard_map. Differentiable: the custom VJP runs a second
-    ring pass with dedicated Pallas backward kernels (dQ local; dK/dV
-    partials ride the rotation home with their block).
+    device inside shard_map; k/v may carry fewer heads (GQA — shared via
+    index maps, never replicated; the smaller blocks also shrink the ICI
+    rotation traffic by the group factor). Differentiable: the custom VJP
+    runs a second ring pass with dedicated Pallas backward kernels (dQ
+    local; per-block dK/dV partials group-summed in f32, riding the
+    rotation home with their block).
 
     interpret=True requires check_vma=False on the enclosing shard_map:
     the Pallas HLO interpreter's block indexing mixes varying and
