@@ -31,6 +31,9 @@ class TransformerConfig:
     # Use the Pallas flash-attention kernel (gloo_tpu.ops) instead of the
     # materialized-scores path; requires seq divisible by its block sizes.
     use_flash_attention: bool = False
+    # Grouped-query attention: number of shared k/v heads (None = n_heads,
+    # i.e. classic multi-head; 1 = multi-query).
+    n_kv_heads: int | None = None
 
 
 class Transformer:
@@ -48,13 +51,21 @@ class Transformer:
             return jax.random.normal(k, (fan_in, fan_out),
                                      jnp.float32) * scale
 
+        h_kv = (cfg.n_kv_heads if cfg.n_kv_heads is not None
+                else cfg.n_heads)
+        if h_kv < 1 or cfg.n_heads % h_kv != 0:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} must be a positive multiple of "
+                f"n_kv_heads {h_kv}")
+        kv_dim = (cfg.d_model // cfg.n_heads) * h_kv
         layers = []
         for i in range(cfg.n_layers):
             lk = jax.random.split(keys[2 + i], 6)
             layers.append({
                 "ln1": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
                 "ln2": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
-                "wqkv": dense(lk[0], cfg.d_model, 3 * cfg.d_model),
+                "wqkv": dense(lk[0], cfg.d_model,
+                              cfg.d_model + 2 * kv_dim),
                 "wo": dense(lk[1], cfg.d_model, cfg.d_model),
                 "w_up": dense(lk[2], cfg.d_model, cfg.d_ff),
                 "w_down": dense(lk[3], cfg.d_ff, cfg.d_model),
@@ -81,11 +92,14 @@ class Transformer:
         b, t, d = x.shape
         h = cfg.n_heads
         hd = d // h
+        h_kv = cfg.n_kv_heads if cfg.n_kv_heads is not None else h
+        kv_dim = hd * h_kv
         qkv = x @ layer["wqkv"].astype(x.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        q = qkv[..., :d].reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+        k = qkv[..., d:d + kv_dim].reshape(b, t, h_kv, hd)
+        k = k.transpose(0, 2, 1, 3)
+        v = qkv[..., d + kv_dim:].reshape(b, t, h_kv, hd)
+        v = v.transpose(0, 2, 1, 3)
         if cfg.use_flash_attention:
             from gloo_tpu.ops.attention import flash_attention, largest_block
 
@@ -93,6 +107,9 @@ class Transformer:
             out = flash_attention(q, k, v, causal=True, block_q=block,
                                   block_k=block)
         else:
+            if h_kv != h:
+                k = jnp.repeat(k, h // h_kv, axis=1)
+                v = jnp.repeat(v, h // h_kv, axis=1)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(jnp.float32(hd))
