@@ -34,6 +34,10 @@ class TransformerConfig:
     # Grouped-query attention: number of shared k/v heads (None = n_heads,
     # i.e. classic multi-head; 1 = multi-query).
     n_kv_heads: int | None = None
+    # Rotary position embeddings on q/k instead of the learned absolute
+    # table (the long-context default: positions travel with the math,
+    # so sequence-parallel shards rotate by their global offsets).
+    use_rope: bool = False
 
 
 class Transformer:
@@ -70,14 +74,18 @@ class Transformer:
                 "w_up": dense(lk[2], cfg.d_model, cfg.d_ff),
                 "w_down": dense(lk[3], cfg.d_ff, cfg.d_model),
             })
-        return {
+        params = {
             "embed": jax.random.normal(
                 keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32) * 0.02,
-            "pos": jax.random.normal(
-                keys[1], (cfg.max_seq_len, cfg.d_model), jnp.float32) * 0.02,
             "ln_f": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
             "layers": layers,
         }
+        if not cfg.use_rope:
+            # Learned absolute table only when it is actually consumed —
+            # a dead entry would still ride checkpoints/optimizer state.
+            params["pos"] = jax.random.normal(
+                keys[1], (cfg.max_seq_len, cfg.d_model), jnp.float32) * 0.02
+        return params
 
     # ---- forward ----
 
@@ -100,6 +108,12 @@ class Transformer:
         k = k.transpose(0, 2, 1, 3)
         v = qkv[..., d + kv_dim:].reshape(b, t, h_kv, hd)
         v = v.transpose(0, 2, 1, 3)
+        if cfg.use_rope:
+            from gloo_tpu.ops.rope import apply_rope, rope_positions
+
+            pos = rope_positions(t)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         if cfg.use_flash_attention:
             from gloo_tpu.ops.attention import flash_attention, largest_block
 
@@ -129,7 +143,9 @@ class Transformer:
         """tokens: (batch, seq) int32 -> logits (batch, seq, vocab) f32."""
         cfg = self.cfg
         t = tokens.shape[1]
-        x = params["embed"][tokens] + params["pos"][:t]
+        x = params["embed"][tokens]
+        if not cfg.use_rope:
+            x = x + params["pos"][:t]
         x = x.astype(cfg.dtype)
         for layer in params["layers"]:
             x = x + self._attention(layer, self._rmsnorm(

@@ -9,6 +9,7 @@ DMA engines directly for schedules XLA does not emit.
 from gloo_tpu.ops.attention import (flash_attention, flash_attention_step,
                                     flash_attention_bwd_step,
                                      largest_block)
+from gloo_tpu.ops.rope import apply_rope, rope_positions
 from gloo_tpu.ops.pallas_ring import (pallas_alltoall, ring_allgather,
                                        ring_allreduce,
                                        ring_allreduce_bidir,
@@ -17,7 +18,8 @@ from gloo_tpu.ops.pallas_ring import (pallas_alltoall, ring_allgather,
                                        ring_allreduce_torus,
                                        ring_reduce_scatter)
 
-__all__ = ["flash_attention", "flash_attention_step",
+__all__ = ["apply_rope", "rope_positions",
+           "flash_attention", "flash_attention_step",
            "flash_attention_bwd_step", "pallas_alltoall", "ring_allgather",
            "ring_allreduce",
            "ring_allreduce_bidir",

@@ -1,0 +1,48 @@
+"""Rotary position embeddings (RoPE) with explicit positions.
+
+Positions are an argument, not an assumption: under sequence parallelism
+each device holds t_local rows of a longer sequence, so the correct
+rotation uses GLOBAL positions (rank * t_local + row). Pairing this with
+gloo_tpu.parallel.sp: apply_rope(q, my * t_local + iota) on the queries
+and the SAME global positions on each k block BEFORE it enters the ring,
+and the rotated blocks stay correctly embedded as they travel (RoPE is
+applied to values, not indices, so rotation does not disturb it).
+
+TPU notes: pure elementwise ops — XLA fuses the rotation into the
+surrounding matmul prologue; no kernel needed. The half-split layout
+(rotate_half) is used, matching the convention of most open models.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rope_angles(positions, head_dim: int, theta: float = 10000.0):
+    """(..., t) int positions -> (..., t, head_dim // 2) angles."""
+    if head_dim % 2 != 0:
+        raise ValueError(f"head_dim {head_dim} must be even for RoPE")
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    return positions.astype(jnp.float32)[..., None] * inv_freq
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate x: (..., t, head_dim) by its positions: (t,) or broadcastable
+    to x's leading dims + (t,). Returns x's dtype."""
+    d = x.shape[-1]
+    ang = rope_angles(positions, d, theta)          # (..., t, d//2)
+    cos = jnp.cos(ang).astype(jnp.float32)
+    sin = jnp.sin(ang).astype(jnp.float32)
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def rope_positions(t: int, offset=0):
+    """Global positions for a local block of length t starting at offset
+    (e.g. offset = rank * t_local under sequence parallelism)."""
+    return offset + lax.iota(jnp.int32, t)
