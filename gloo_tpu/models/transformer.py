@@ -95,7 +95,11 @@ class Transformer:
                        keepdims=True)
         return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
-    def _attention(self, layer, x):
+    def _project_qkv(self, layer, x, positions):
+        """Single definition of the fused projection layout: slice offsets,
+        head reshapes, GQA kv width, and RoPE — used by BOTH the full
+        forward and the cached decode step so the two cannot drift (the
+        incremental-vs-full parity test guards exactly this)."""
         cfg = self.cfg
         b, t, d = x.shape
         h = cfg.n_heads
@@ -109,11 +113,21 @@ class Transformer:
         v = qkv[..., d + kv_dim:].reshape(b, t, h_kv, hd)
         v = v.transpose(0, 2, 1, 3)
         if cfg.use_rope:
-            from gloo_tpu.ops.rope import apply_rope, rope_positions
+            from gloo_tpu.ops.rope import apply_rope
 
-            pos = rope_positions(t)
-            q = apply_rope(q, pos)
-            k = apply_rope(k, pos)
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+        return q, k, v
+
+    def _attention(self, layer, x):
+        cfg = self.cfg
+        b, t, d = x.shape
+        h = cfg.n_heads
+        hd = d // h
+        h_kv = cfg.n_kv_heads if cfg.n_kv_heads is not None else h
+        from gloo_tpu.ops.rope import rope_positions
+
+        q, k, v = self._project_qkv(layer, x, rope_positions(t))
         if cfg.use_flash_attention:
             from gloo_tpu.ops.attention import flash_attention, largest_block
 
@@ -163,3 +177,114 @@ class Transformer:
         nll = -jnp.take_along_axis(logp, targets[..., None],
                                    axis=-1).squeeze(-1)
         return jnp.mean(nll)
+
+    # ---- incremental decoding (KV cache) ----
+
+    def init_cache(self, batch: int, max_len: int | None = None):
+        """Per-layer key/value cache for incremental decoding. GQA models
+        cache only n_kv_heads — the cache shrinks by the group factor,
+        which is the production reason to use GQA."""
+        cfg = self.cfg
+        max_len = max_len or cfg.max_seq_len
+        if not cfg.use_rope and max_len > cfg.max_seq_len:
+            # The learned positional table has max_seq_len rows; beyond it
+            # dynamic_slice would silently clamp to the last row.
+            raise ValueError(
+                f"cache length {max_len} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (learned positions)")
+        hd = cfg.d_model // cfg.n_heads
+        h_kv = cfg.n_kv_heads if cfg.n_kv_heads is not None else cfg.n_heads
+        zeros = jnp.zeros((batch, h_kv, max_len, hd), cfg.dtype)
+        return {"k": [zeros] * cfg.n_layers, "v": [zeros] * cfg.n_layers,
+                "len": jnp.zeros((), jnp.int32)}
+
+    def _decode_attention(self, layer, x, k_cache, v_cache, pos):
+        """One-token attention against the cache. x: (b, 1, d); pos: ()
+        current position. Returns (out, new_k_cache, new_v_cache)."""
+        cfg = self.cfg
+        b, _, d = x.shape
+        h = cfg.n_heads
+        hd = d // h
+        h_kv = cfg.n_kv_heads if cfg.n_kv_heads is not None else h
+        max_len = k_cache.shape[2]
+
+        q, k, v = self._project_qkv(layer, x, pos[None])
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+
+        kx, vx = k_cache, v_cache
+        if h_kv != h:
+            kx = jnp.repeat(kx, h // h_kv, axis=1)
+            vx = jnp.repeat(vx, h // h_kv, axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kx,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        valid = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1) <= pos
+        scores = jnp.where(valid[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vx,
+                         preferred_element_type=jnp.float32)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, d).astype(x.dtype)
+        return out @ layer["wo"].astype(x.dtype), k_cache, v_cache
+
+    def _step_hidden(self, params, cache, token):
+        """One cached step WITHOUT the unembedding: returns the final
+        hidden row (b, 1, d) and the updated cache. Prefill uses this so
+        prompt tokens never pay the O(vocab) output matmul."""
+        cfg = self.cfg
+        pos = cache["len"]
+        x = params["embed"][token][:, None, :]
+        if not cfg.use_rope:
+            x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1)
+        x = x.astype(cfg.dtype)
+        new_k, new_v = [], []
+        for i, layer in enumerate(params["layers"]):
+            attn, kc, vc = self._decode_attention(
+                layer, self._rmsnorm(x, layer["ln1"]["scale"].astype(
+                    x.dtype)), cache["k"][i], cache["v"][i], pos)
+            new_k.append(kc)
+            new_v.append(vc)
+            x = x + attn
+            x = x + self._mlp(layer, self._rmsnorm(
+                x, layer["ln2"]["scale"].astype(x.dtype)))
+        x = self._rmsnorm(x, params["ln_f"]["scale"].astype(x.dtype))
+        return x, {"k": new_k, "v": new_v, "len": pos + 1}
+
+    def decode_step(self, params, cache, token):
+        """Feed one token (b,) int32 at cache['len']; returns (logits
+        (b, vocab) f32, updated cache)."""
+        x, cache = self._step_hidden(params, cache, token)
+        return (x.astype(jnp.float32) @ params["embed"].T)[:, 0], cache
+
+    def generate(self, params, prompt, max_new: int):
+        """Greedy decoding: prompt (b, t_p) int32 -> (b, t_p + max_new).
+        Prefill streams prompt tokens through the cached step (exactly the
+        path new tokens use, minus the unembedding); generation runs under
+        lax.scan, so the whole loop compiles to one program."""
+        if max_new == 0:
+            return prompt
+        b, t_p = prompt.shape
+        cache = self.init_cache(b, t_p + max_new)
+
+        def prefill(cache, tok):
+            _, cache = self._step_hidden(params, cache, tok)
+            return cache, None
+
+        # All but the last prompt token only warm the cache; the last one
+        # produces the first generated token.
+        cache, _ = jax.lax.scan(prefill, cache, prompt[:, :-1].T)
+        logits, cache = self.decode_step(params, cache, prompt[:, -1])
+        next_tok = jnp.argmax(logits, axis=-1)
+
+        def step(carry, _):
+            cache, tok = carry
+            logits, cache = self.decode_step(params, cache, tok)
+            new = jnp.argmax(logits, axis=-1)
+            return (cache, new), new
+
+        (_, _), later = jax.lax.scan(step, (cache, next_tok), None,
+                                     length=max_new - 1)
+        toks = jnp.concatenate([next_tok[:, None], later.T], axis=1)
+        return jnp.concatenate([prompt, toks], axis=1)
