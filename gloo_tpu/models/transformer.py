@@ -258,15 +258,37 @@ class Transformer:
         x, cache = self._step_hidden(params, cache, token)
         return (x.astype(jnp.float32) @ params["embed"].T)[:, 0], cache
 
-    def generate(self, params, prompt, max_new: int):
-        """Greedy decoding: prompt (b, t_p) int32 -> (b, t_p + max_new).
-        Prefill streams prompt tokens through the cached step (exactly the
-        path new tokens use, minus the unembedding); generation runs under
+    def generate(self, params, prompt, max_new: int,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 key=None):
+        """Decoding: prompt (b, t_p) int32 -> (b, t_p + max_new).
+        temperature == 0 (default) is greedy; > 0 samples from the
+        softmax at that temperature, optionally truncated to the top_k
+        logits, using `key` (required when sampling). Prefill streams
+        prompt tokens through the cached step (exactly the path new
+        tokens use, minus the unembedding); generation runs under
         lax.scan, so the whole loop compiles to one program."""
         if max_new == 0:
             return prompt
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if temperature > 0.0 and key is None:
+            raise ValueError("sampling (temperature > 0) requires `key`")
+        if key is None:
+            key = jax.random.PRNGKey(0)  # unused on the greedy path
         b, t_p = prompt.shape
         cache = self.init_cache(b, t_p + max_new)
+
+        def pick(logits, key):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1)
 
         def prefill(cache, tok):
             _, cache = self._step_hidden(params, cache, tok)
@@ -276,15 +298,17 @@ class Transformer:
         # produces the first generated token.
         cache, _ = jax.lax.scan(prefill, cache, prompt[:, :-1].T)
         logits, cache = self.decode_step(params, cache, prompt[:, -1])
-        next_tok = jnp.argmax(logits, axis=-1)
+        key, sub = jax.random.split(key)
+        next_tok = pick(logits, sub)
 
         def step(carry, _):
-            cache, tok = carry
+            cache, tok, key = carry
             logits, cache = self.decode_step(params, cache, tok)
-            new = jnp.argmax(logits, axis=-1)
-            return (cache, new), new
+            key, sub = jax.random.split(key)
+            new = pick(logits, sub)
+            return (cache, new, key), new
 
-        (_, _), later = jax.lax.scan(step, (cache, next_tok), None,
-                                     length=max_new - 1)
+        (_, _, _), later = jax.lax.scan(step, (cache, next_tok, key), None,
+                                        length=max_new - 1)
         toks = jnp.concatenate([next_tok[:, None], later.T], axis=1)
         return jnp.concatenate([prompt, toks], axis=1)

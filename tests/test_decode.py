@@ -69,3 +69,29 @@ def test_init_cache_rejects_overlong_learned_positions():
     # RoPE has no table: long caches are fine
     m2, _ = _model(use_rope=True)
     m2.init_cache(1, 64)
+
+
+def test_generate_sampling():
+    m, p = _model(use_rope=True)
+    prompt = jnp.asarray(np.random.RandomState(3).randint(0, 64, (2, 4)))
+    a = m.generate(p, prompt, 8, temperature=1.0, top_k=8,
+                   key=jax.random.PRNGKey(1))
+    b = m.generate(p, prompt, 8, temperature=1.0, top_k=8,
+                   key=jax.random.PRNGKey(2))
+    c = m.generate(p, prompt, 8, temperature=1.0, top_k=8,
+                   key=jax.random.PRNGKey(1))
+    assert a.shape == (2, 12)
+    assert np.array_equal(np.asarray(a), np.asarray(c))  # deterministic
+    assert not np.array_equal(np.asarray(a), np.asarray(b))  # keyed
+    with pytest.raises(ValueError, match="requires `key`"):
+        m.generate(p, prompt, 4, temperature=0.7)
+
+
+def test_generate_rejects_bad_sampling_args():
+    m, p = _model()
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 64, (1, 4)))
+    with pytest.raises(ValueError, match="temperature"):
+        m.generate(p, prompt, 2, temperature=-1.0)
+    with pytest.raises(ValueError, match="top_k"):
+        m.generate(p, prompt, 2, temperature=1.0, top_k=0,
+                   key=jax.random.PRNGKey(0))
