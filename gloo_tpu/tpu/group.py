@@ -14,7 +14,7 @@ global array of shape (P, ...) whose row i lives on mesh position i.
 
 from __future__ import annotations
 
-import functools
+from collections import OrderedDict
 from typing import Optional, Sequence
 
 import jax
@@ -35,6 +35,13 @@ class TpuProcessGroup:
         self.axis = axis
         self.size = mesh.shape[axis]
         self._row_sharding = NamedSharding(mesh, P(self.axis))
+        # Jitted shard_map callables keyed on (method, static args). Reusing
+        # the same callable object across calls is what lets jax.jit's own
+        # (shape, dtype) cache hit: a fresh lambda per call would re-trace
+        # and re-compile every time. Bounded LRU so per-call-varying keys
+        # (rotating send_recv perms, shifting roots) can't grow it forever.
+        self._compiled = OrderedDict()
+        self._compiled_max = 128
 
     # ---- data movement helpers ----
 
@@ -49,57 +56,88 @@ class TpuProcessGroup:
     def unshard(self, array) -> np.ndarray:
         return np.asarray(jax.device_get(array))
 
-    def _smap(self, fn, x):
-        shard_fn = jax.shard_map(fn, mesh=self.mesh,
-                                 in_specs=P(self.axis),
-                                 out_specs=P(self.axis))
-        return jax.jit(shard_fn)(x)
+    def _smap(self, key, fn, *args):
+        """Run the cached jitted shard_map program for `key`.
+
+        On a cache hit `fn` is ignored and the stored jitted callable runs,
+        so repeat calls with the same static args hit jax.jit's
+        (shape, dtype) cache instead of re-tracing. `fn` must therefore be
+        fully determined by `key`.
+        """
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            in_specs = P(self.axis) if args else ()
+            compiled = jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=P(self.axis)))
+            self._compiled[key] = compiled
+            if len(self._compiled) > self._compiled_max:
+                self._compiled.popitem(last=False)
+        else:
+            self._compiled.move_to_end(key)
+        return compiled(*args)
 
     # ---- collectives (each rank's operand is its row) ----
 
     def allreduce(self, x, op: str = "sum"):
-        return self._smap(lambda s: spmd.allreduce(s, self.axis, op), x)
+        return self._smap(
+            ("allreduce", op),
+            lambda s: spmd.allreduce(s, self.axis, op), x)
 
     def broadcast(self, x, root: int = 0):
-        return self._smap(lambda s: spmd.broadcast(s, self.axis, root), x)
+        return self._smap(
+            ("broadcast", root),
+            lambda s: spmd.broadcast(s, self.axis, root), x)
 
     def reduce(self, x, root: int = 0, op: str = "sum"):
-        return self._smap(lambda s: spmd.reduce(s, self.axis, root, op), x)
+        return self._smap(
+            ("reduce", root, op),
+            lambda s: spmd.reduce(s, self.axis, root, op), x)
 
     def allgather(self, x):
         # Result is (P, P, ...): row i is rank i's copy of the gathered
         # buffer (identical rows, matching the host API where every rank's
         # output holds all inputs).
         return self._smap(
+            ("allgather",),
             lambda s: spmd.allgather(s[0], self.axis, gather_axis=0,
                                      tiled=False)[None], x)
 
     def reduce_scatter(self, x, op: str = "sum"):
         """x rows are (P*k, ...); rank i keeps slice i of the sum."""
         return self._smap(
+            ("reduce_scatter", op),
             lambda s: spmd.reduce_scatter(s[0], self.axis, op,
                                           scatter_axis=0)[None], x)
 
     def alltoall(self, x):
         """Row i holds P blocks along axis 1; block j goes to rank j."""
         return self._smap(
+            ("alltoall",),
             lambda s: spmd.alltoall(s[0], self.axis, split_axis=0,
                                     concat_axis=0)[None], x)
 
     def scatter(self, x, root: int = 0):
         return self._smap(
+            ("scatter", root),
             lambda s: spmd.scatter(s[0], self.axis, root,
                                    scatter_axis=0)[None], x)
 
     def send_recv(self, x, perm: Sequence[tuple]):
-        return self._smap(lambda s: spmd.ppermute(s, self.axis, perm), x)
+        # Materialize once: perm may be a generator, and the traced fn must
+        # see exactly what the cache key was built from.
+        perm_key = tuple((int(a), int(b)) for a, b in perm)
+        return self._smap(
+            ("send_recv", perm_key),
+            lambda s: spmd.ppermute(s, self.axis, perm_key), x)
 
     def shift(self, x, offset: int = 1):
-        return self._smap(lambda s: spmd.shift(s, self.axis, offset), x)
+        return self._smap(
+            ("shift", offset),
+            lambda s: spmd.shift(s, self.axis, offset), x)
 
     def barrier(self):
-        out = jax.jit(
-            jax.shard_map(lambda: spmd.barrier(self.axis)[None],
-                          mesh=self.mesh, in_specs=(),
-                          out_specs=P(self.axis)))()
+        out = self._smap(
+            ("barrier",),
+            lambda: spmd.barrier(self.axis)[None])
         jax.block_until_ready(out)

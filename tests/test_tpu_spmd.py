@@ -126,3 +126,38 @@ def test_grad_through_allreduce(pg):
     # d/dx_i sum over ranks of P * x_i^2-ish: each element contributes to
     # P rows of the output: grad = 2 * x * P.
     np.testing.assert_allclose(g, 2 * rows(pg) * pg.size, rtol=1e-6)
+
+
+def test_group_compile_cache_no_retrace(pg, monkeypatch):
+    """Repeat calls with the same shape/dtype/op must not re-trace.
+
+    The per-shard function only runs at trace time, so counting its
+    invocations counts traces (reference analog: CUDA algorithm ctors
+    compile once, run() many — gloo/cuda_allreduce_ring.cc:14-100).
+    """
+    from gloo_tpu.tpu import spmd
+    from gloo_tpu.tpu.group import TpuProcessGroup
+
+    fresh = TpuProcessGroup(pg.mesh, pg.axis)
+    traces = {"n": 0}
+    real_allreduce = spmd.allreduce
+
+    def counting(*args, **kwargs):
+        traces["n"] += 1
+        return real_allreduce(*args, **kwargs)
+
+    monkeypatch.setattr(spmd, "allreduce", counting)
+    x = fresh.shard(rows(pg))
+    fresh.allreduce(x)
+    assert traces["n"] == 1
+    fresh.allreduce(x)
+    fresh.allreduce(fresh.shard(rows(pg) * 2.0))
+    assert traces["n"] == 1, "same shape/dtype/op re-traced"
+
+    # Different shape or different op is a legitimate new trace.
+    fresh.allreduce(fresh.shard(rows(pg, cols=32)))
+    assert traces["n"] == 2
+    fresh.allreduce(x, op="max")
+    assert traces["n"] == 3
+    fresh.allreduce(x, op="max")
+    assert traces["n"] == 3
