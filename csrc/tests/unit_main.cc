@@ -91,6 +91,51 @@ void testReduceKernels() {
   CHECK(ia[0] == 3 && ia[1] == -2 && ia[2] == 9);
 }
 
+void testBf16NanLanes() {
+  using tpucoll::bfloat16ToFloat;
+  using tpucoll::DataType;
+  using tpucoll::f32StreamToBf16;
+  using tpucoll::floatToBfloat16;
+  using tpucoll::getReduceFn;
+  using tpucoll::ReduceOp;
+  // NaN payloads that defeat naive 0x7fff+lsb rounding: 0x7f800001 would
+  // carry into +Inf, 0x7fffffff would wrap into -0.0. NaN lanes must stay
+  // NaN in both the AVX2 body (first 8+ lanes) and the scalar tail, for
+  // the f32->bf16 wire narrowing and the bf16 sum reduction alike.
+  float sigNan, maxNan;
+  uint32_t u1 = 0x7f800001u, u2 = 0x7fffffffu;
+  std::memcpy(&sigNan, &u1, 4);
+  std::memcpy(&maxNan, &u2, 4);
+  std::vector<float> src(19, 1.0f);
+  src[0] = sigNan;   // vector lane
+  src[5] = maxNan;   // vector lane
+  src[17] = sigNan;  // scalar tail lane
+  std::vector<uint16_t> dst(src.size());
+  f32StreamToBf16(src.data(), dst.data(), src.size());
+  for (size_t i = 0; i < src.size(); i++) {
+    if (std::isnan(src[i])) {
+      CHECK(std::isnan(bfloat16ToFloat(dst[i])));
+    } else {
+      CHECK(bfloat16ToFloat(dst[i]) == 1.0f);
+    }
+  }
+  // bf16 + bf16 sum where one side is NaN: NaN must propagate per-lane
+  // identically in vector and tail regions.
+  std::vector<uint16_t> acc(19, floatToBfloat16(1.0f));
+  std::vector<uint16_t> in(19, floatToBfloat16(2.0f));
+  in[1] = floatToBfloat16(sigNan);
+  in[18] = floatToBfloat16(sigNan);
+  getReduceFn(DataType::kBFloat16, ReduceOp::kSum)(acc.data(), in.data(),
+                                                   acc.size());
+  for (size_t i = 0; i < acc.size(); i++) {
+    if (i == 1 || i == 18) {
+      CHECK(std::isnan(bfloat16ToFloat(acc[i])));
+    } else {
+      CHECK(bfloat16ToFloat(acc[i]) == 3.0f);
+    }
+  }
+}
+
 void testHmacVectors() {
   auto hex = [](const std::array<uint8_t, 32>& mac) {
     char buf[65];
@@ -127,6 +172,7 @@ int main() {
   testSlot();
   testHalfConversions();
   testReduceKernels();
+  testBf16NanLanes();
   testHmacVectors();
   if (failures == 0) {
     printf("tpucoll_unit: all tests passed\n");

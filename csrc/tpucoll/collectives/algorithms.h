@@ -19,12 +19,11 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 
 // Recursive-halving/recursive-doubling (Rabenseifner) allreduce:
 // 2*log2(P) rounds, latency-optimal for small payloads. Non-power-of-2
-// group sizes are handled by folding the first 2r odd ranks into their
-// even partners before the exchange and unfolding the result after
-// (reference analog: the binary-blocks machinery of
-// gloo/allreduce_halving_doubling.h:39-64; the fold is this build's
-// simpler equivalent, trading one extra full-vector exchange on the
-// folded ranks for far less bookkeeping).
+// group sizes use a binary-blocks decomposition (reference analog:
+// gloo/allreduce_halving_doubling.h:39-64) giving every rank work
+// proportional to its window; TPUCOLL_HD_NP2=fold selects the simpler
+// fold variant (first 2r odd ranks fold into their even partners, at the
+// cost of two extra full-vector hops on those ranks).
 void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,
                               std::chrono::milliseconds timeout);

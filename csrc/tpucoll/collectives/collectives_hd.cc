@@ -9,6 +9,19 @@
 // no bit-reversal pass (contrast reference reduce_scatter.h:21-329).
 // Allgather by recursive doubling reverses the walk, windows merging with
 // their siblings until every rank holds the full vector.
+//
+// Non-power-of-2 group sizes use a binary-blocks decomposition (behavior
+// parity with gloo/allreduce_halving_doubling.h:39-64 initBinaryBlocks,
+// re-derived for this build's in-order window walk): P is split into
+// power-of-2 blocks by its binary representation, larger blocks at lower
+// ranks. Each block reduce-scatters internally over the full vector, then
+// partial windows flow up the block chain smallest -> largest (each rank's
+// inter-block traffic is proportional to its window, unlike the fold,
+// where 2*rem ranks exchange the whole vector twice). The fully reduced
+// windows flow back down the chain, and each block allgathers internally.
+// The fold path is kept as TPUCOLL_HD_NP2=fold for small payloads where
+// its fewer messages can win.
+#include <cstdlib>
 #include <cstring>
 
 #include "tpucoll/collectives/algorithms.h"
@@ -21,9 +34,20 @@ using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
 using collectives_detail::largestPow2AtMost;
 
-void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
-                              size_t elsize, ReduceFn fn, Slot slot,
-                              std::chrono::milliseconds timeout) {
+namespace {
+
+// Slot-delta bases keep every phase's tags disjoint (Slot::offset is
+// bounds-checked against the 24-bit delta field, types.h).
+constexpr uint64_t kRsBase = 0x1000;
+constexpr uint64_t kFwdBase = 0x2000;
+constexpr uint64_t kBwdBase = 0x3000;
+constexpr uint64_t kAgBase = 0x4000;
+constexpr uint64_t kFoldBase = 0;
+constexpr uint64_t kUnfoldSlot = 1 << 20;
+
+void foldHalvingDoubling(Context* ctx, char* work, size_t count,
+                         size_t elsize, ReduceFn fn, Slot slot,
+                         std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -37,7 +61,7 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
 
   // Fold: the first 2*rem ranks pair (even, odd); odds contribute their
   // vector to their even partner and sit out the exchange.
-  uint64_t round = 0;
+  uint64_t round = kFoldBase;
   int vrank;
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
@@ -106,7 +130,7 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
 
   // Unfold: even partners push the final vector back to the odd ranks.
   // A distinct sub-slot avoids any overlap with exchange rounds.
-  const uint64_t finalSlot = slot.offset(1 << 20).value();
+  const uint64_t finalSlot = slot.offset(kUnfoldSlot).value();
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
       workBuf->recv(rank - 1, finalSlot, 0, nbytes);
@@ -115,6 +139,175 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
       workBuf->send(rank + 1, finalSlot, 0, nbytes);
       workBuf->waitSend(timeout);
     }
+  }
+}
+
+void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
+                                 size_t elsize, ReduceFn fn, Slot slot,
+                                 std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes = count * elsize;
+
+  // Binary-blocks layout: one block per set bit of P, larger blocks at
+  // lower ranks (so blocks[0] is the largest, at rank offset 0).
+  std::vector<int> bsize, boff;
+  for (int bit = 30, off = 0; bit >= 0; bit--) {
+    if (size & (1 << bit)) {
+      bsize.push_back(1 << bit);
+      boff.push_back(off);
+      off += 1 << bit;
+    }
+  }
+  const int k = static_cast<int>(bsize.size());
+  int b = k - 1;
+  while (boff[b] > rank) {
+    b--;
+  }
+  const int r = rank - boff[b];   // rank within my block
+  const int B = bsize[b];         // my block's size
+  const int Bmax = bsize[0];
+
+  // All windows are unions of "atoms": the vector split Bmax ways. Every
+  // block size divides Bmax, so window boundaries align across blocks.
+  Blocks atoms = evenBlocks(count, Bmax, elsize);
+  auto atomOff = [&](int first) { return atoms.offset[first]; };
+  auto atomBytes = [&](int first, int n) { return atoms.rangeBytes(first, n); };
+
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto scratch = ctx->acquireScratch(nbytes);
+  char* tmp = scratch.data();
+  auto tmpBuf = ctx->createUnboundBuffer(tmp, nbytes);
+
+  // --- intra-block reduce-scatter: recursive vector halving ---
+  // The window walk lands atoms [r*Bmax/B, (r+1)*Bmax/B) on block rank r.
+  int winStart = 0;
+  int winCount = Bmax;
+  int step = 0;
+  for (int mask = B / 2; mask >= 1; mask >>= 1, step++) {
+    const int partner = boff[b] + (r ^ mask);
+    const int half = winCount / 2;
+    const bool keepLower = (r & mask) == 0;
+    const int keepStart = keepLower ? winStart : winStart + half;
+    const int sendStart = keepLower ? winStart + half : winStart;
+    const uint64_t s = slot.offset(kRsBase + step).value();
+    tmpBuf->recv(partner, s, atomOff(keepStart), atomBytes(keepStart, half));
+    workBuf->send(partner, s, atomOff(sendStart), atomBytes(sendStart, half));
+    tmpBuf->waitRecv(nullptr, timeout);
+    if (atomBytes(keepStart, half) > 0) {
+      fn(work + atomOff(keepStart), tmp + atomOff(keepStart),
+         atomBytes(keepStart, half) / elsize);
+    }
+    workBuf->waitSend(timeout);
+    winStart = keepStart;
+    winCount = half;
+  }
+
+  // --- inter-block chain, forward leg (smallest -> largest) ---
+  // Exchange e joins blocks e (larger side) and e+1 (smaller side); the
+  // smaller side's windows are unions of the larger side's, so each
+  // smaller rank scatters pieces while each larger rank receives exactly
+  // its own window. The chain serializes naturally: a block cannot send
+  // partials up before it has absorbed the block below it.
+  if (b + 1 < k) {  // I am the larger side of exchange b.
+    const int ratio = B / bsize[b + 1];
+    const int peer = boff[b + 1] + r / ratio;
+    const uint64_t s = slot.offset(kFwdBase + b).value();
+    tmpBuf->recv(peer, s, atomOff(winStart), atomBytes(winStart, winCount));
+    tmpBuf->waitRecv(nullptr, timeout);
+    if (atomBytes(winStart, winCount) > 0) {
+      fn(work + atomOff(winStart), tmp + atomOff(winStart),
+         atomBytes(winStart, winCount) / elsize);
+    }
+  }
+  if (b > 0) {  // I am the smaller side of exchange b-1.
+    const int ratioUp = bsize[b - 1] / B;
+    const int Aup = Bmax / bsize[b - 1];  // atoms per larger-side window
+    const uint64_t fwd = slot.offset(kFwdBase + b - 1).value();
+    const uint64_t bwd = slot.offset(kBwdBase + b - 1).value();
+    for (int j = 0; j < ratioUp; j++) {
+      const int rUp = r * ratioUp + j;
+      workBuf->send(boff[b - 1] + rUp, fwd, atomOff(rUp * Aup),
+                    atomBytes(rUp * Aup, Aup));
+    }
+    for (int j = 0; j < ratioUp; j++) {
+      workBuf->waitSend(timeout);
+    }
+    // --- backward leg: fully reduced pieces come back in place ---
+    for (int j = 0; j < ratioUp; j++) {
+      const int rUp = r * ratioUp + j;
+      workBuf->recv(boff[b - 1] + rUp, bwd, atomOff(rUp * Aup),
+                    atomBytes(rUp * Aup, Aup));
+    }
+    for (int j = 0; j < ratioUp; j++) {
+      workBuf->waitRecv(nullptr, timeout);
+    }
+  }
+  if (b + 1 < k) {  // Backward leg toward the block below me.
+    const int ratio = B / bsize[b + 1];
+    const int peer = boff[b + 1] + r / ratio;
+    const uint64_t s = slot.offset(kBwdBase + b).value();
+    workBuf->send(peer, s, atomOff(winStart), atomBytes(winStart, winCount));
+    workBuf->waitSend(timeout);
+  }
+
+  // --- intra-block allgather: recursive doubling ---
+  step = 0;
+  for (int mask = 1; mask < B; mask <<= 1, step++) {
+    const int partner = boff[b] + (r ^ mask);
+    const int partnerStart = winStart ^ winCount;  // sibling window
+    const uint64_t s = slot.offset(kAgBase + step).value();
+    workBuf->recv(partner, s, atomOff(partnerStart),
+                  atomBytes(partnerStart, winCount));
+    workBuf->send(partner, s, atomOff(winStart),
+                  atomBytes(winStart, winCount));
+    workBuf->waitRecv(nullptr, timeout);
+    workBuf->waitSend(timeout);
+    winStart = std::min(winStart, partnerStart);
+    winCount *= 2;
+  }
+}
+
+}  // namespace
+
+void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
+                              size_t elsize, ReduceFn fn, Slot slot,
+                              std::chrono::milliseconds timeout) {
+  const int size = ctx->size();
+  const bool pow2 = (size & (size - 1)) == 0;
+  if (pow2) {
+    // Power-of-2 groups: binary-blocks degenerates to the same single-
+    // block walk; route through the fold path (rem == 0, no fold step).
+    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
+    return;
+  }
+  // Non-power-of-2 strategy. Loopback-measured crossover (BASELINE.md,
+  // P=6): fold's fewer messages win while per-message overhead dominates;
+  // binary-blocks' proportional byte work wins once payloads are large.
+  // TPUCOLL_HD_NP2=blocks|fold forces either; TPUCOLL_HD_NP2_CROSSOVER
+  // (bytes) moves the auto threshold — re-tune on real DCN, where the
+  // message-overhead regime is narrower than on a shared-core loopback.
+  bool useBlocks;
+  const char* env = std::getenv("TPUCOLL_HD_NP2");
+  if (env != nullptr && std::strcmp(env, "blocks") == 0) {
+    useBlocks = true;
+  } else if (env != nullptr && std::strcmp(env, "fold") == 0) {
+    useBlocks = false;
+  } else if (env != nullptr && *env != '\0' &&
+             std::strcmp(env, "auto") != 0) {
+    TC_THROW(EnforceError, "TPUCOLL_HD_NP2 must be blocks|fold|auto, got: ",
+             env);
+  } else {
+    size_t crossover = 1 << 20;
+    if (const char* c = std::getenv("TPUCOLL_HD_NP2_CROSSOVER")) {
+      crossover = std::strtoull(c, nullptr, 10);
+    }
+    useBlocks = count * elsize >= crossover;
+  }
+  if (useBlocks) {
+    binaryBlocksHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
+  } else {
+    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
   }
 }
 

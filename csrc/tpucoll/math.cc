@@ -129,6 +129,27 @@ void reduceTyped(void* acc, const void* in, size_t n) {
 // this host path only sees staging buffers.
 
 #ifdef TC_HAVE_VECTOR_HALF
+// Narrow 8 f32 lanes to bf16 with round-to-nearest-even. NaN lanes must
+// bypass the rounding bias: 0x7fff+lsb can carry into the exponent and
+// turn a NaN into +Inf (0x7f800001) or wrap into -0.0 (0x7fffffff), so
+// unordered lanes blend in the same quieted-NaN value the scalar
+// floatToBfloat16 produces ((bits>>16)|0x40).
+inline __m128i f32x8ToBf16Rne(__m256 v) {
+  __m256i bits = _mm256_castps_si256(v);
+  __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16),
+                                 _mm256_set1_epi32(1));
+  __m256i rounded = _mm256_add_epi32(
+      _mm256_add_epi32(bits, _mm256_set1_epi32(0x7fff)), lsb);
+  __m256i hi = _mm256_srli_epi32(rounded, 16);
+  __m256i nanHi = _mm256_or_si256(_mm256_srli_epi32(bits, 16),
+                                  _mm256_set1_epi32(0x40));
+  __m256i isNan = _mm256_castps_si256(_mm256_cmp_ps(v, v, _CMP_UNORD_Q));
+  hi = _mm256_blendv_epi8(hi, nanHi, isNan);
+  __m256i packed = _mm256_packus_epi32(hi, _mm256_setzero_si256());
+  packed = _mm256_permute4x64_epi64(packed, 0x08);
+  return _mm256_castsi256_si128(packed);
+}
+
 void sumHalfVec(uint16_t* a, const uint16_t* b, size_t n) {
   size_t i = 0;
   for (; i + 8 <= n; i += 8) {
@@ -147,7 +168,6 @@ void sumHalfVec(uint16_t* a, const uint16_t* b, size_t n) {
 
 void sumBf16Vec(uint16_t* a, const uint16_t* b, size_t n) {
   size_t i = 0;
-  const __m256i zero = _mm256_setzero_si256();
   for (; i + 8 <= n; i += 8) {
     // Widen bf16 -> f32: zero-extend to u32, shift into the high half.
     __m256i wa = _mm256_slli_epi32(
@@ -158,18 +178,8 @@ void sumBf16Vec(uint16_t* a, const uint16_t* b, size_t n) {
             reinterpret_cast<const __m128i*>(b + i))), 16);
     __m256 sum = _mm256_add_ps(_mm256_castsi256_ps(wa),
                                _mm256_castsi256_ps(wb));
-    // Narrow with round-to-nearest-even: add the rounding bias
-    // (0x7fff + lsb) in integer space, then take the high 16 bits.
-    __m256i bits = _mm256_castps_si256(sum);
-    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16),
-                                   _mm256_set1_epi32(1));
-    __m256i rounded = _mm256_add_epi32(
-        _mm256_add_epi32(bits, _mm256_set1_epi32(0x7fff)), lsb);
-    __m256i hi = _mm256_srli_epi32(rounded, 16);
-    __m256i packed = _mm256_packus_epi32(hi, zero);
-    packed = _mm256_permute4x64_epi64(packed, 0x08);
     _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i),
-                     _mm256_castsi256_si128(packed));
+                     f32x8ToBf16Rne(sum));
   }
   for (; i < n; i++) {
     a[i] = floatToBfloat16(bfloat16ToFloat(a[i]) + bfloat16ToFloat(b[i]));
@@ -256,18 +266,9 @@ ReduceFn pickBf16Op(ReduceOp op) {
 void f32StreamToBf16(const float* src, uint16_t* dst, size_t n) {
   size_t i = 0;
 #ifdef TC_HAVE_VECTOR_HALF
-  const __m256i zero = _mm256_setzero_si256();
   for (; i + 8 <= n; i += 8) {
-    __m256i bits = _mm256_castps_si256(_mm256_loadu_ps(src + i));
-    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(bits, 16),
-                                   _mm256_set1_epi32(1));
-    __m256i rounded = _mm256_add_epi32(
-        _mm256_add_epi32(bits, _mm256_set1_epi32(0x7fff)), lsb);
-    __m256i hi = _mm256_srli_epi32(rounded, 16);
-    __m256i packed = _mm256_packus_epi32(hi, zero);
-    packed = _mm256_permute4x64_epi64(packed, 0x08);
     _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
-                     _mm256_castsi256_si128(packed));
+                     f32x8ToBf16Rne(_mm256_loadu_ps(src + i)));
   }
 #endif
   for (; i < n; i++) {

@@ -92,8 +92,23 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
       ssize_t n = ::send(fd, p + sent, len - sent, MSG_NOSIGNAL);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          // Bound by the same handshake deadline readAll honors: a peer
+          // that accepts but never drains must not stall connect forever.
           pollfd pfd{fd, POLLOUT, 0};
-          poll(&pfd, 1, 1000);
+          int prv = poll(&pfd, 1, static_cast<int>(std::max<int64_t>(
+              std::chrono::duration_cast<std::chrono::milliseconds>(
+                  deadline - std::chrono::steady_clock::now()).count(), 0)));
+          if (prv == 0) {
+            ::close(fd);
+            TC_THROW(TimeoutException, what, ": handshake write to rank ",
+                     peerRank_, " timed out");
+          }
+          if (prv < 0 && errno != EINTR) {
+            int savedErrno = errno;
+            ::close(fd);
+            TC_THROW(IoException, what, ": handshake poll: ",
+                     strerror(savedErrno));
+          }
           continue;
         }
         if (errno == EINTR) {
@@ -122,10 +137,16 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
           int prv = poll(&pfd, 1, static_cast<int>(std::max<int64_t>(
               std::chrono::duration_cast<std::chrono::milliseconds>(
                   deadline - std::chrono::steady_clock::now()).count(), 0)));
-          if (prv <= 0) {
+          if (prv == 0) {
             ::close(fd);
             TC_THROW(TimeoutException, what, ": handshake with rank ",
                      peerRank_, " timed out");
+          }
+          if (prv < 0 && errno != EINTR) {
+            int savedErrno = errno;
+            ::close(fd);
+            TC_THROW(IoException, what, ": handshake poll: ",
+                     strerror(savedErrno));
           }
           continue;
         }

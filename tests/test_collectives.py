@@ -36,12 +36,32 @@ def test_allreduce_sum(size, count, algorithm):
 @pytest.mark.parametrize("algorithm", ["halving_doubling", "bcube"])
 @pytest.mark.parametrize("size", [2, 3, 5, 6, 7, 8])
 def test_allreduce_hd_nonpow2(size, algorithm):
-    """Non-power-of-2 groups: HD fold path and mixed-radix bcube."""
+    """Non-power-of-2 groups: HD binary-blocks path and mixed-radix bcube."""
     count = 4097  # also exercises uneven block windows
 
     def fn(ctx, rank):
         x = fixture(rank, count, np.float64)
         ctx.allreduce(x, algorithm=algorithm)
+        return x
+
+    results = spawn(size, fn)
+    expected = sum(fixture(r, count, np.float64) for r in range(size))
+    for got in results:
+        np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+@pytest.mark.parametrize("variant", ["blocks", "fold"])
+@pytest.mark.parametrize("size,count", [
+    (3, 1), (5, 3), (6, 4097), (7, 911), (12, 4097), (12, 5),
+])
+def test_allreduce_hd_np2_variants(size, count, variant, monkeypatch):
+    """Both non-power-of-2 HD strategies, incl. tiny counts where some
+    block windows are empty (zero-byte messages must still match up)."""
+    monkeypatch.setenv("TPUCOLL_HD_NP2", variant)
+
+    def fn(ctx, rank):
+        x = fixture(rank, count, np.float64)
+        ctx.allreduce(x, algorithm="halving_doubling")
         return x
 
     results = spawn(size, fn)
