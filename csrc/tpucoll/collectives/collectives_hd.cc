@@ -300,7 +300,12 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
   } else {
     size_t crossover = 1 << 20;
     if (const char* c = std::getenv("TPUCOLL_HD_NP2_CROSSOVER")) {
-      crossover = std::strtoull(c, nullptr, 10);
+      char* end = nullptr;
+      crossover = std::strtoull(c, &end, 10);
+      if (end == c || *end != '\0') {
+        TC_THROW(EnforceError,
+                 "TPUCOLL_HD_NP2_CROSSOVER must be a byte count, got: ", c);
+      }
     }
     useBlocks = count * elsize >= crossover;
   }

@@ -11,8 +11,9 @@ HBM, not VMEM. Same math as the cross-chip ring attention in
 gloo_tpu.parallel.sp, applied at the tile level.
 
 Causal masking: key blocks entirely above the diagonal skip their
-compute (the pipeline still fetches the tile — grid steps cannot be
-elided — but the MXU work is predicated away).
+compute, and a clamped kv index map repeats the last valid tile on dead
+grid steps so the pipeline elides their fetches; tiles straddling the
+diagonal pay the mask, fully-valid interior tiles run mask-free.
 """
 
 from __future__ import annotations
@@ -33,9 +34,16 @@ def _score_tile_global(q_ref, k_ref, q_base, k_base, block_q, block_k,
     sequence (bases may be dynamic SMEM scalars for ring-rotated blocks).
     Every kernel — forward, backward, step — must go through this single
     definition: the backward kernels recompute softmax from the forward's
-    saved logsumexp, so any drift silently skews gradients."""
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
+    saved logsumexp, so any drift silently skews gradients.
+
+    The dot runs in the inputs' native dtype (bf16 inputs hit the MXU at
+    its native rate) with f32 accumulation. `scale` is folded into the q
+    tile — a (block_q, d) multiply — rather than the (block_q, block_k)
+    scores: the kernels are VPU-bound, so every per-score-element op
+    counts. Returns the scaled q tile (backward kernels contract against
+    it, so dK inherits the scale for free)."""
+    q = q_ref[0] * scale
+    k = k_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     if causal:
@@ -55,8 +63,30 @@ def _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal, scale):
 
 
 def _softmax_tile(s, lse):
-    p = jnp.exp(s - lse)
-    return jnp.where(jnp.isfinite(s), p, 0.0)
+    # Masked entries hold -inf and lse is finite (every query row sees at
+    # least its diagonal key globally), so exp(-inf - lse) underflows to
+    # exactly 0 — no explicit guard needed on the VPU-bound hot path.
+    return jnp.exp(s - lse)
+
+
+def _online_step(s, v, m, l, acc):
+    """One online-softmax update shared by the forward and step kernels.
+
+    Handles m == -inf (initial state / fully masked rows so far) via the
+    m_safe/corr guards; masked score entries are -inf and their exp
+    underflows to 0 against the finite m_safe, so no per-element guard is
+    spent on them."""
+    m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + p.sum(axis=1, keepdims=True)
+    # p contracts on the MXU in v's dtype (matches the reference oracle,
+    # which also casts softmax weights to the input dtype).
+    acc_new = acc * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
@@ -72,28 +102,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: a key block whose first position exceeds the query block's
-    # last position contributes nothing.
-    active = True
-    if causal:
-        active = kb * block_k <= qi * block_q + block_q - 1
-
-    @pl.when(active)
-    def _():
-        _, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, causal,
+    def update(masked):
+        _, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, masked,
                            scale)
-        v = v_ref[0].astype(jnp.float32)
-        m = m_ref[...]
-        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe)
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        l_ref[...] = l_ref[...] * corr + p.sum(axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        acc_ref[...], m_ref[...], l_ref[...] = _online_step(
+            s, v_ref[0], m_ref[...], l_ref[...], acc_ref[...])
+
+    if causal:
+        # Split by tile kind: only tiles straddling the diagonal pay the
+        # iota/compare/select mask; interior (fully valid) tiles — the
+        # vast majority — run mask-free, and fully-masked tiles are
+        # skipped outright (their fetches are elided by the clamped kv
+        # index map in flash_attention).
+        active = kb * block_k <= qi * block_q + block_q - 1
+        interior = (kb + 1) * block_k - 1 <= qi * block_q
+
+        @pl.when(active & jnp.logical_not(interior))
+        def _():
+            update(True)
+
+        @pl.when(interior)
+        def _():
+            update(False)
+    else:
+        update(False)
 
     @pl.when(kb == num_k_blocks - 1)
     def _():
@@ -122,11 +154,17 @@ def _reference_attention(q, k, v, causal: bool):
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
+                    block_k: int = None, interpret: bool = False):
     """Attention over (batch, heads, seq, head_dim) without materializing
     the score matrix. seq must be divisible by the block sizes; head_dim
     should be a multiple of 128 for full MXU tiles.
+
+    block_q/block_k default to the largest divisors of seq up to 512/1024:
+    the kernel's cost is dominated by per-grid-step overhead, not the
+    matmuls, so big tiles win — the v5e block sweep (BASELINE.md) moved
+    sustained throughput from 15 to 107-139 TFLOP/s (54-70% MFU) going
+    from 128x128 to >=512 tiles.
 
     Supports grouped-query attention: k/v may carry h_kv heads with
     h % h_kv == 0. Both directions map each query head to its shared kv
@@ -147,6 +185,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
         raise ValueError(
             f"query heads {h} must be a multiple of kv heads {h_kv}")
     group = h // h_kv
+    if block_q is None:
+        block_q = largest_block(t, 512)
+    if block_k is None:
+        block_k = largest_block(t, 1024)
     if t % block_q != 0 or t % block_k != 0:
         raise ValueError(
             f"seq {t} must be divisible by block sizes {block_q}/{block_k}")
@@ -177,6 +219,19 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
 
     op.defvjp(fwd, bwd)
 
+    if causal:
+        # Key blocks fully above the diagonal are masked out; clamping
+        # their block index to the last in-range block makes consecutive
+        # dead steps request the SAME tile, so the pipeline elides the
+        # fetch — without this the HBM traffic for a causal forward is 2x
+        # what the math needs.
+        def kv_index(i, j, kb):
+            last = ((j + 1) * block_q - 1) // block_k
+            return (i // group, jnp.minimum(kb, last), 0)
+    else:
+        def kv_index(i, j, kb):
+            return (i // group, kb, 0)
+
     def run_kernel(qf, kf, vf):
         return pl.pallas_call(
             kernel,
@@ -185,11 +240,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, d),
-                             lambda i, j, kb: (i // group, kb, 0),
+                pl.BlockSpec((1, block_k, d), kv_index,
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_k, d),
-                             lambda i, j, kb: (i // group, kb, 0),
+                pl.BlockSpec((1, block_k, d), kv_index,
                              memory_space=pltpu.VMEM),
             ],
             out_specs=(
@@ -207,6 +260,11 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
                 pltpu.VMEM((block_q, 1), jnp.float32),  # running max
                 pltpu.VMEM((block_q, 1), jnp.float32),  # running denom
             ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+                # Large tiles (the measured optimum) exceed the default
+                # 16 MB scoped-vmem budget; v5e/v5p have 128 MB VMEM.
+                vmem_limit_bytes=100 * 1024 * 1024),
         )(qf, kf, vf)
 
     return op(qf, kf, vf).reshape(b, h, t, d)
@@ -269,18 +327,8 @@ def _flash_step_kernel(q_ref, k_ref, v_ref, acc_in, m_in, l_in, q_off_ref,
     _, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
                               k_off_ref[0] + kb * block_k, block_q, block_k,
                               causal, scale)
-    v = v_ref[0].astype(jnp.float32)
-    m = m_out[0]
-    m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-    l_out[0, ...] = l_out[0] * corr + p.sum(axis=1, keepdims=True)
-    acc_out[0, ...] = acc_out[0] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_out[0, ...] = m_new
+    acc_out[0, ...], m_out[0, ...], l_out[0, ...] = _online_step(
+        s, v_ref[0], m_out[0], l_out[0], acc_out[0])
     del num_k_blocks
 
 
@@ -288,8 +336,8 @@ def _flash_step_kernel(q_ref, k_ref, v_ref, acc_in, m_in, l_in, q_off_ref,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "vma_axes", "kv_group"))
 def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
-                         causal: bool = True, block_q: int = 128,
-                         block_k: int = 128, interpret: bool = False,
+                         causal: bool = True, block_q: int = None,
+                         block_k: int = None, interpret: bool = False,
                          vma_axes=(), kv_group: int = 1):
     """Fold one key/value block into carried flash state.
 
@@ -306,6 +354,10 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
     if bh % kv_group != 0 or k.shape[0] != bh // kv_group:
         raise ValueError(
             f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
+    if block_q is None:
+        block_q = largest_block(tq, 512)
+    if block_k is None:
+        block_k = largest_block(tkv, 1024)
     if tq % block_q != 0 or tkv % block_k != 0:
         raise ValueError("tile sizes must divide the block shapes")
     scale = 1.0 / (d ** 0.5)
@@ -351,6 +403,9 @@ def flash_attention_step(q, k, v, acc, m, l, q_offset, k_offset,
             jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32,
                                  vma=frozenset(vma_axes)),
         ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(q, k, v, acc, m, l, q_off, k_off)
 
 
@@ -390,15 +445,15 @@ def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
         _, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
                                   k_off_ref[0] + kb * block_k, block_q,
                                   block_k, causal, scale)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         p = _softmax_tile(s, lse_ref[0])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])
         acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_k_blocks - 1)
@@ -433,22 +488,23 @@ def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
         q, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
                                   k_off_ref[0] + kb * block_k, block_q,
                                   block_k, causal, scale)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0]
+        do = do_ref[0]
         p = _softmax_tile(s, lse_ref[0])
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])
-        # q already carries `scale` (see _score_tile_global).
         dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
     def _():
+        # q comes back from _score_tile_global already scaled, so the
+        # ds^T q contraction yields dK directly; dV needs no scale.
         dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
 
@@ -457,8 +513,8 @@ def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret", "vma_axes", "kv_group"))
 def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
-                             causal: bool = True, block_q: int = 128,
-                             block_k: int = 128, interpret: bool = False,
+                             causal: bool = True, block_q: int = None,
+                             block_k: int = None, interpret: bool = False,
                              vma_axes=(), kv_group: int = 1):
     """Backward mirror of flash_attention_step: gradients through one
     key/value block at a global position.
@@ -480,6 +536,10 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
     if bh % kv_group != 0 or k.shape[0] != bh // kv_group:
         raise ValueError(
             f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
+    if block_q is None:
+        block_q = largest_block(tq, 512)
+    if block_k is None:
+        block_k = largest_block(tkv, 1024)
     if tq % block_q != 0 or tkv % block_k != 0:
         raise ValueError("tile sizes must divide the block shapes")
     scale = 1.0 / (d ** 0.5)
@@ -515,6 +575,9 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), jnp.float32, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(q, k, v, do, delta, lse, q_off, k_off)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_step_kernel,
@@ -556,5 +619,8 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(q, k, v, do, delta, lse, q_off, k_off)
     return dq, dk, dv

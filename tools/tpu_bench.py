@@ -28,10 +28,13 @@ def main():
                         choices=["allreduce", "allgather", "reduce_scatter",
                                  "alltoall", "ppermute", "pallas_ring",
                                  "pallas_ring_hbm", "flash_attention",
-                                 "all"])
+                                 "flash_attention_bwd", "all"])
     parser.add_argument("--elements", default="1024,65536,1048576,16777216")
     parser.add_argument("--min-time", type=float, default=1.0)
     parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--flash-blocks", default=None,
+                        help="comma list of BQxBK pairs to sweep, e.g. "
+                             "128x128,512x1024 (default: kernel defaults)")
     args = parser.parse_args()
 
     force_cpu = os.environ.get("JAX_PLATFORMS_FORCE_CPU")
@@ -94,13 +97,15 @@ def main():
 
     ops = (["allreduce", "allgather", "reduce_scatter", "alltoall",
             "ppermute", "pallas_ring", "pallas_ring_hbm",
-            "flash_attention"]
+            "flash_attention", "flash_attention_bwd"]
            if args.op == "all" else [args.op])
     elements_list = [int(e) for e in args.elements.split(",")]
 
-    if "flash_attention" in ops:
-        bench_flash_attention(args, jax, jnp, elements_list)
-        ops = [o for o in ops if o != "flash_attention"]
+    for mode in ("flash_attention", "flash_attention_bwd"):
+        if mode in ops:
+            bench_flash_attention(args, jax, jnp, elements_list,
+                                  backward=mode.endswith("bwd"))
+            ops = [o for o in ops if o != mode]
     for op in ops:
         for elements in elements_list:
             try:
@@ -128,12 +133,16 @@ def main():
                   f"{len(samples):>7}")
 
 
-def bench_flash_attention(args, jax, jnp, elements_list):
+def bench_flash_attention(args, jax, jnp, elements_list, backward=False):
     """MXU kernel timing that survives remote-tunnel backends where
     block_until_ready does not synchronize: chain K kernel applications
     inside ONE jitted fori_loop (output feeds the next query, defeating
     DCE), force completion with a scalar fetch, and difference a K=1 run
-    to cancel the fetch round-trip. algbw column = achieved GFLOP/s."""
+    to cancel the fetch round-trip. algbw column = achieved GFLOP/s.
+
+    backward=True times fwd+bwd via jax.grad (flops counted 3.5x fwd:
+    one forward recompute-free pass plus the dQ and dK/dV kernels at
+    ~2.5x forward work). --flash-blocks sweeps tile sizes."""
     import time as _time
 
     from jax import lax
@@ -142,7 +151,13 @@ def bench_flash_attention(args, jax, jnp, elements_list):
 
     interp = jax.devices()[0].platform == "cpu"
     h, d = 8, 128
-    print("# flash_attention rows: the last column is GFLOP/s, not GB/s")
+    label = "flash_bwd" if backward else "flash_attention"
+    print(f"# {label} rows: the last column is GFLOP/s, not GB/s")
+    if args.flash_blocks:
+        block_list = [tuple(int(x) for x in pair.split("x"))
+                      for pair in args.flash_blocks.split(",")]
+    else:
+        block_list = [(None, None)]
 
     seen = set()
     for elements in elements_list:
@@ -154,42 +169,55 @@ def bench_flash_attention(args, jax, jnp, elements_list):
         if t in seen:  # small elements values clamp to the same config
             continue
         seen.add(t)
-        try:
-            q = jnp.ones((1, h, t, d), jnp.bfloat16)
+        for bq, bk in block_list:
+            tag = label if bq is None else f"{label}:{bq}x{bk}"
+            try:
+                q = jnp.ones((1, h, t, d), jnp.bfloat16)
 
-            def chain(k):
-                def body(i, c):
+                def apply(c):
                     return flash_attention(c, c, c, causal=True,
+                                           block_q=bq, block_k=bk,
                                            interpret=interp)
-                return jax.jit(lambda q: lax.fori_loop(0, k, body, q))
 
-            k_iters = 2 if interp else 64
-            f1, fk = chain(1), chain(k_iters)
+                if backward:
+                    step = jax.grad(
+                        lambda c: jnp.sum(apply(c).astype(jnp.float32) ** 2))
+                else:
+                    step = apply
 
-            def run(f):
-                out = f(q)
-                _ = float(out[0, 0, 0, 0])  # forces completion + fetch
+                def chain(k):
+                    def body(i, c):
+                        return step(c).astype(c.dtype)
+                    return jax.jit(lambda q: lax.fori_loop(0, k, body, q))
 
-            for _ in range(max(1, args.warmup)):
-                run(f1), run(fk)
-            reps = 1 if interp else 5
-            t1 = min(_timeit(run, f1, _time) for _ in range(reps))
-            tk = min(_timeit(run, fk, _time) for _ in range(reps))
-        except Exception as exc:  # noqa: BLE001 — skip row, keep sweeping
-            print(f"{'flash_attention':>16} {'-':>12} {elements:>12}   "
-                  f"skipped: {str(exc)[:50]}")
-            continue
-        if tk <= t1:
-            print(f"{'flash_attention':>16} {'-':>12} {h * t * d:>12}   "
-                  "skipped: timing noise exceeded kernel time "
-                  "(t too small to difference)")
-            continue
-        per_iter = (tk - t1) / (k_iters - 1)
-        flops = 2 * h * (t * t // 2) * d * 2
-        nbytes = 3 * h * t * d * 2
-        print(f"{'flash_attention':>16} {nbytes:>12} {h * t * d:>12} "
-              f"{per_iter * 1e6:>9.1f} {per_iter * 1e6:>9.1f} "
-              f"{'-':>9} {flops / per_iter / 1e9:>12.3f} {k_iters:>7}")
+                k_iters = 2 if interp else 64
+                f1, fk = chain(1), chain(k_iters)
+
+                def run(f):
+                    out = f(q)
+                    _ = float(out[0, 0, 0, 0])  # forces completion + fetch
+
+                for _ in range(max(1, args.warmup)):
+                    run(f1), run(fk)
+                reps = 1 if interp else 5
+                t1 = min(_timeit(run, f1, _time) for _ in range(reps))
+                tk = min(_timeit(run, fk, _time) for _ in range(reps))
+            except Exception as exc:  # noqa: BLE001 — skip row, sweep on
+                print(f"{tag:>16} {'-':>12} {elements:>12}   "
+                      f"skipped: {str(exc)[:50]}")
+                continue
+            if tk <= t1:
+                print(f"{tag:>16} {'-':>12} {h * t * d:>12}   "
+                      "skipped: timing noise exceeded kernel time "
+                      "(t too small to difference)")
+                continue
+            per_iter = (tk - t1) / (k_iters - 1)
+            fwd_flops = 2 * h * (t * t // 2) * d * 2
+            flops = int(fwd_flops * 3.5) if backward else fwd_flops
+            nbytes = 3 * h * t * d * 2
+            print(f"{tag:>16} {nbytes:>12} {h * t * d:>12} "
+                  f"{per_iter * 1e6:>9.1f} {per_iter * 1e6:>9.1f} "
+                  f"{'-':>9} {flops / per_iter / 1e9:>12.3f} {k_iters:>7}")
 
 
 def _timeit(run, f, _time):
