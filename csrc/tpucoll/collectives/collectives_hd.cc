@@ -301,8 +301,11 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
     size_t crossover = 1 << 20;
     if (const char* c = std::getenv("TPUCOLL_HD_NP2_CROSSOVER")) {
       char* end = nullptr;
+      errno = 0;
       crossover = std::strtoull(c, &end, 10);
-      if (end == c || *end != '\0') {
+      // strtoull silently wraps negatives and ERANGE overflows; both are
+      // misconfigurations this knob exists to catch loudly.
+      if (end == c || *end != '\0' || c[0] == '-' || errno == ERANGE) {
         TC_THROW(EnforceError,
                  "TPUCOLL_HD_NP2_CROSSOVER must be a byte count, got: ", c);
       }

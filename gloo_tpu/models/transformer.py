@@ -128,12 +128,14 @@ class Transformer:
         from gloo_tpu.ops.rope import rope_positions
 
         q, k, v = self._project_qkv(layer, x, rope_positions(t))
-        if cfg.use_flash_attention:
-            from gloo_tpu.ops.attention import flash_attention, largest_block
+        if cfg.use_flash_attention and t % 8 == 0:
+            from gloo_tpu.ops.attention import flash_attention
 
-            block = largest_block(t)
-            out = flash_attention(q, k, v, causal=True, block_q=block,
-                                  block_k=block)
+            # Adaptive tile defaults (BASELINE.md block sweep); CPU
+            # backends only run Pallas through the interpreter.
+            out = flash_attention(
+                q, k, v, causal=True,
+                interpret=jax.default_backend() == "cpu")
         else:
             if h_kv != h:
                 k = jnp.repeat(k, h // h_kv, axis=1)

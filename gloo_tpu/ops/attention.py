@@ -153,9 +153,10 @@ def _reference_attention(q, k, v, causal: bool):
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
-                                    "interpret"))
+                                    "interpret", "vma_axes"))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
-                    block_k: int = None, interpret: bool = False):
+                    block_k: int = None, interpret: bool = False,
+                    vma_axes=()):
     """Attention over (batch, heads, seq, head_dim) without materializing
     the score matrix. seq must be divisible by the block sizes; head_dim
     should be a multiple of 128 for full MXU tiles.
@@ -215,7 +216,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
         return _flash_backward(qf, kf, vf, out, lse, g.astype(qf.dtype),
                                causal=causal, block_q=block_q,
                                block_k=block_k, interpret=interpret,
-                               kv_group=group)
+                               kv_group=group, vma_axes=vma_axes)
 
     op.defvjp(fwd, bwd)
 
@@ -252,8 +253,10 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
                              memory_space=pltpu.VMEM),
             ),
             out_shape=(
-                jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+                jax.ShapeDtypeStruct((bh, t, d), q.dtype,
+                                     vma=frozenset(vma_axes)),
+                jax.ShapeDtypeStruct((bh, t, 1), jnp.float32,
+                                     vma=frozenset(vma_axes)),
             ),
             scratch_shapes=[
                 pltpu.VMEM((block_q, d), jnp.float32),  # accumulator
@@ -285,7 +288,8 @@ def largest_block(t: int, cap: int = 128) -> int:
 # ---------------------------------------------------------------------------
 
 def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
-                    block_k: int, interpret: bool, kv_group: int = 1):
+                    block_k: int, interpret: bool, kv_group: int = 1,
+                    vma_axes=()):
     """Local (single-block) backward: the step backward kernels with both
     global offsets at zero. kf/vf may carry bh // kv_group heads (GQA);
     the per-query-head dK/dV partials come back in f32 and are
@@ -298,7 +302,7 @@ def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
     dq, dk, dv = flash_attention_bwd_step(
         qf, kf, vf, g, delta, lse, q_offset=zero, k_offset=zero,
         causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, kv_group=kv_group)
+        interpret=interpret, kv_group=kv_group, vma_axes=vma_axes)
     dk = group_sum_kv(dk, kv_group)
     dv = group_sum_kv(dv, kv_group)
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)

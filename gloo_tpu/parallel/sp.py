@@ -199,7 +199,7 @@ def ring_flash_attention(q, k, v, axis: str, causal: bool = True,
 
 
 def ulysses_attention(q, k, v, axis: str, causal: bool = True,
-                      attn_fn=None):
+                      attn_fn=None, interpret: bool = False):
     """DeepSpeed-Ulysses-style sequence parallelism: two all-to-alls swap
     the sharded dimension from sequence to heads, so each device runs
     FULL-sequence attention for a subset of heads, then a final
@@ -210,20 +210,36 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = True,
     the primitive these recipes are built from.)
 
     q, k, v: (batch, heads, t_local, d) per device inside shard_map.
-    attn_fn(q, k, v, causal) computes attention on full-sequence inputs;
-    defaults to the materialized-scores reference. For long sequences
-    pass ops.flash_attention — that combination needs check_vma=False on
-    the enclosing shard_map (the single-device kernel's out_shape carries
-    no vma; same JAX limitation as ring_flash_attention's interpret
-    mode).
+    The attention over the gathered full sequence DEFAULTS to the Pallas
+    flash kernel — the configuration long-context users actually run —
+    with the shard_map varying-axis bookkeeping handled internally
+    (vma_axes=(axis,) threads through the kernel's out_shapes, so the
+    compiled TPU path works under the default check_vma=True).
+    interpret=True forces the Pallas interpreter for the DEFAULT flash
+    path (it is auto-enabled on CPU backends and ignored when attn_fn is
+    supplied — a custom attn_fn owns its own interpret choice); that
+    mode needs check_vma=False on the enclosing shard_map (HLO
+    interpreter limitation, as for ring_flash_attention). Pass attn_fn
+    (signature attn_fn(q, k, v, causal)) to substitute a different
+    full-sequence attention, e.g. the materialized-scores oracle.
     """
     n = spmd.size(axis)
     b, h, t_local, d = q.shape
     if h % n != 0:
         raise ValueError(f"heads {h} not divisible by group size {n}")
     if attn_fn is None:
-        from gloo_tpu.ops.attention import _reference_attention
-        attn_fn = _reference_attention
+        import jax
+
+        from gloo_tpu.ops.attention import flash_attention
+
+        # CPU backends only run Pallas through the interpreter (the
+        # 8-device test/dryrun meshes); real TPU backends compile.
+        use_interpret = interpret or jax.default_backend() == "cpu"
+
+        def attn_fn(qh, kh, vh, causal):
+            return flash_attention(qh, kh, vh, causal=causal,
+                                   interpret=use_interpret,
+                                   vma_axes=(axis,))
 
     # (b, h, t_local, d) -> (b, h/n, t_global, d): scatter heads, gather
     # sequence. all_to_all splits/concats one axis; heads is axis 1,

@@ -307,10 +307,12 @@ def test_ulysses_attention_matches_full(causal):
     k = rng.randn(b, h, t, d).astype(np.float32)
     v = rng.randn(b, h, t, d).astype(np.float32)
 
+    # Default attn path = the Pallas flash kernel (interpreted on the CPU
+    # mesh, which requires check_vma=False on the enclosing shard_map).
     f = jax.jit(jax.shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal),
         mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
-        out_specs=P(None, None, "seq")))
+        out_specs=P(None, None, "seq"), check_vma=False))
     got = np.asarray(f(q, k, v))
 
     s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
@@ -319,6 +321,34 @@ def test_ulysses_attention_matches_full(causal):
     pr = np.exp(s - s.max(-1, keepdims=True))
     pr /= pr.sum(-1, keepdims=True)
     expected = np.einsum("bhqk,bhkd->bhqd", pr, v)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_attention_vma_checked():
+    """The all_to_all vma bookkeeping must hold under default
+    check_vma=True (the flash default needs the interpreter on CPU and
+    so can't run checked here; the reference oracle path can)."""
+    from gloo_tpu.ops.attention import _reference_attention
+    from gloo_tpu.parallel import ulysses_attention
+
+    mesh = make_mesh({"seq": -1})
+    p = mesh.shape["seq"]
+    b, h, t, d = 1, p, 8 * p, 16
+    rng = np.random.RandomState(11)
+    q = rng.randn(b, h, t, d).astype(np.float32)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq",
+                                          attn_fn=_reference_attention),
+        mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
+        out_specs=P(None, None, "seq")))
+    got = np.asarray(f(q, q, q))
+
+    s = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(d)
+    s = np.where(np.tril(np.ones((t, t), bool)), s, -np.inf)
+    pr = np.exp(s - s.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    expected = np.einsum("bhqk,bhkd->bhqd", pr, q)
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
 
@@ -337,7 +367,7 @@ def test_ulysses_attention_grads():
     f = jax.shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, "seq"),
         mesh=mesh, in_specs=(P(None, None, "seq"),) * 3,
-        out_specs=P(None, None, "seq"))
+        out_specs=P(None, None, "seq"), check_vma=False)
 
     def loss_full(q, k, v):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)

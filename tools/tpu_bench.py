@@ -215,6 +215,9 @@ def bench_flash_attention(args, jax, jnp, elements_list, backward=False):
             fwd_flops = 2 * h * (t * t // 2) * d * 2
             flops = int(fwd_flops * 3.5) if backward else fwd_flops
             nbytes = 3 * h * t * d * 2
+            if backward:
+                # + dO/O/lse/delta reads and three f32 gradient writes.
+                nbytes = nbytes + 2 * h * t * d * 2 + 3 * h * t * d * 4
             print(f"{tag:>16} {nbytes:>12} {h * t * d:>12} "
                   f"{per_iter * 1e6:>9.1f} {per_iter * 1e6:>9.1f} "
                   f"{'-':>9} {flops / per_iter / 1e9:>12.3f} {k_iters:>7}")
