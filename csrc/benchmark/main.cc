@@ -46,6 +46,8 @@ struct Options {
   bool verify = true;
   bool json = false;
   uint32_t tagBase = 0;
+  std::string authKey;
+  bool encrypt = false;
 };
 
 void usage() {
@@ -56,7 +58,8 @@ void usage() {
           "reduce|gather|scatter|alltoall|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
-          "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n");
+          "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
+          "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n");
 }
 
 std::vector<size_t> parseElements(const std::string& arg) {
@@ -107,6 +110,10 @@ Options parse(int argc, char** argv) {
       o.verify = false;
     } else if (a == "--json") {
       o.json = true;
+    } else if (a == "--auth-key") {
+      o.authKey = next();
+    } else if (a == "--encrypt") {
+      o.encrypt = true;
     } else {
       usage();
       TC_THROW(tpucoll::EnforceError, "unknown argument ", a);
@@ -497,6 +504,8 @@ int runBench(int argc, char** argv) {
 
   tpucoll::transport::DeviceAttr attr;
   attr.hostname = o.host;
+  attr.authKey = o.authKey;
+  attr.encrypt = o.encrypt;
   auto device = std::make_shared<tpucoll::transport::Device>(attr);
   tpucoll::Context ctx(o.rank, o.size);
   ctx.connectFullMesh(store, device);

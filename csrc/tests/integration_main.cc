@@ -7,10 +7,18 @@
 #include <thread>
 #include <vector>
 
+#include <arpa/inet.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/crypto.h"
+#include "tpucoll/common/hmac.h"
 #include "tpucoll/context.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/transport/device.h"
+#include "tpucoll/transport/wire.h"
 
 namespace {
 
@@ -24,10 +32,10 @@ int failures = 0;
     }                                                                      \
   } while (0)
 
-void worker(std::shared_ptr<tpucoll::Store> store, int rank, int size) {
+void worker(std::shared_ptr<tpucoll::Store> store, int rank, int size,
+            tpucoll::transport::DeviceAttr attr = {}) {
   using namespace tpucoll;
-  auto device =
-      std::make_shared<transport::Device>(transport::DeviceAttr{});
+  auto device = std::make_shared<transport::Device>(attr);
   Context ctx(rank, size);
   ctx.setTimeout(std::chrono::milliseconds(15000));
   ctx.connectFullMesh(store, device);
@@ -143,16 +151,166 @@ void worker(std::shared_ptr<tpucoll::Store> store, int rank, int size) {
 
 }  // namespace
 
+// Wire-level tamper scenario: a hand-rolled malicious peer that KNOWS the
+// PSK completes the authenticated+encrypted handshake against a real
+// context, proves it can deliver a correctly sealed message (positive
+// control), then sends a frame with one flipped ciphertext byte — the
+// victim pair must reject it with an authentication IoException instead
+// of delivering corrupted plaintext.
+void tamperScenario() {
+  using namespace tpucoll;
+  const std::string psk = "integration-psk";
+  auto store = std::make_shared<HashStore>();
+
+  std::thread victim([&] {
+    transport::DeviceAttr attr;
+    attr.authKey = psk;
+    attr.encrypt = true;
+    auto device = std::make_shared<transport::Device>(attr);
+    Context ctx(0, 2);
+    ctx.setTimeout(std::chrono::milliseconds(15000));
+    ctx.connectFullMesh(store, device);
+    std::vector<char> data(64, 0);
+    {  // Positive control: a correctly sealed message lands intact.
+      auto buf = ctx.createUnboundBuffer(data.data(), data.size());
+      buf->recv(1, 7001);
+      CHECK(buf->waitRecv(nullptr, std::chrono::milliseconds(15000)));
+      CHECK(data[0] == 'A' && data[63] == 'A');
+    }
+    {  // Tampered frame: the recv must fail, not deliver. The pair may
+       // already be poisoned by the time the recv is posted (the frame
+       // races the post), so either recv() or waitRecv() may throw.
+      bool threw = false;
+      try {
+        auto buf = ctx.createUnboundBuffer(data.data(), data.size());
+        buf->recv(1, 7002);
+        buf->waitRecv(nullptr, std::chrono::milliseconds(15000));
+      } catch (const IoException& e) {
+        threw = std::string(e.what()).find("authentication") !=
+                std::string::npos;
+      }
+      CHECK(threw);
+    }
+  });
+
+  // ---- the attacker-with-the-key ----
+  // Read the victim's rank blob: [u32 n][u32 alen][addr][u64 pairId * n].
+  auto blob = store->get("tc/rank/0", std::chrono::milliseconds(15000));
+  uint32_t n32 = 0, alen = 0;
+  std::memcpy(&n32, blob.data(), 4);
+  std::memcpy(&alen, blob.data() + 4, 4);
+  CHECK(n32 == 2);
+  auto addr = transport::SockAddr::deserialize(blob.data() + 8, alen);
+  uint64_t pairIds[2];
+  std::memcpy(pairIds, blob.data() + 8 + alen, 16);
+  const uint64_t pairId = pairIds[1];  // the victim's pair expecting us
+  // Publish a throwaway rank-1 blob (rank 0 never parses it: it only
+  // unpacks blobs of lower ranks).
+  store->set("tc/rank/1", std::vector<uint8_t>{0});
+
+  int fd = socket(addr.sa()->sa_family, SOCK_STREAM, 0);
+  CHECK(fd >= 0);
+  CHECK(::connect(fd, addr.sa(), addr.len) == 0);
+  auto writeAll = [&](const void* p, size_t len) {
+    const char* c = static_cast<const char*>(p);
+    size_t done = 0;
+    while (done < len) {
+      ssize_t rv = ::send(fd, c + done, len - done, MSG_NOSIGNAL);
+      CHECK(rv > 0);
+      if (rv <= 0) return;
+      done += size_t(rv);
+    }
+  };
+  auto readAll = [&](void* p, size_t len) {
+    char* c = static_cast<char*>(p);
+    size_t done = 0;
+    while (done < len) {
+      ssize_t rv = ::recv(fd, c + done, len - done, 0);
+      CHECK(rv > 0);
+      if (rv <= 0) return;
+      done += size_t(rv);
+    }
+  };
+
+  // Authenticated+encrypted hello handshake (wire.h protocol).
+  transport::WireHello hello{transport::kHelloAuthEncMagic, 0, pairId};
+  writeAll(&hello, sizeof(hello));
+  uint8_t nonceI[transport::kAuthNonceBytes];
+  randomBytes(nonceI, sizeof(nonceI));
+  writeAll(nonceI, sizeof(nonceI));
+  uint8_t reply[transport::kAuthNonceBytes + transport::kAuthMacBytes];
+  readAll(reply, sizeof(reply));
+  auto transcript = [&](const char* role) {
+    std::string msg(role);
+    msg.append(reinterpret_cast<const char*>(&pairId), sizeof(pairId));
+    msg.append(reinterpret_cast<const char*>(nonceI), sizeof(nonceI));
+    msg.append(reinterpret_cast<const char*>(reply),
+               transport::kAuthNonceBytes);
+    return hmacSha256(psk.data(), psk.size(), msg.data(), msg.size());
+  };
+  auto srv = transcript("srv");
+  CHECK(macEqual(reply + transport::kAuthNonceBytes, srv.data(), 32));
+  auto cli = transcript("cli");
+  writeAll(cli.data(), cli.size());
+  auto keys = transport::deriveConnKeys(psk, pairId, nonceI, reply,
+                                        /*initiator=*/true);
+
+  uint64_t seq = 0;
+  auto sendSealed = [&](uint64_t slot, const std::vector<char>& payload,
+                        bool flipByte) {
+    transport::WireHeader hdr{transport::kMsgMagic, 1 /* kData */,
+                              {0, 0, 0}, slot, payload.size()};
+    std::vector<uint8_t> frame(sizeof(hdr) + kAeadTagBytes +
+                               payload.size() + kAeadTagBytes);
+    aeadSeal(keys.tx, seq++, nullptr, 0,
+             reinterpret_cast<const uint8_t*>(&hdr), sizeof(hdr),
+             frame.data(), frame.data() + sizeof(hdr));
+    uint8_t* c = frame.data() + sizeof(hdr) + kAeadTagBytes;
+    aeadSeal(keys.tx, seq++, nullptr, 0,
+             reinterpret_cast<const uint8_t*>(payload.data()),
+             payload.size(), c, c + payload.size());
+    if (flipByte) {
+      c[3] ^= 1;
+    }
+    writeAll(frame.data(), frame.size());
+  };
+
+  std::vector<char> payload(64, 'A');
+  sendSealed(7001, payload, /*flipByte=*/false);
+  sendSealed(7002, payload, /*flipByte=*/true);
+
+  victim.join();
+  ::close(fd);
+}
+
 int main() {
   const int size = 4;
   auto store = std::make_shared<tpucoll::HashStore>();
   std::vector<std::thread> threads;
   for (int r = 0; r < size; r++) {
-    threads.emplace_back(worker, store, r, size);
+    threads.emplace_back(worker, store, r, size,
+                         tpucoll::transport::DeviceAttr{});
   }
   for (auto& t : threads) {
     t.join();
   }
+
+  // Encrypted full mesh: every collective again, over AEAD framing.
+  {
+    tpucoll::transport::DeviceAttr enc;
+    enc.authKey = "integration-psk";
+    enc.encrypt = true;
+    auto encStore = std::make_shared<tpucoll::HashStore>();
+    std::vector<std::thread> encThreads;
+    for (int r = 0; r < size; r++) {
+      encThreads.emplace_back(worker, encStore, r, size, enc);
+    }
+    for (auto& t : encThreads) {
+      t.join();
+    }
+  }
+
+  tamperScenario();
   if (failures == 0) {
     printf("tpucoll_integration: all checks passed\n");
     return 0;

@@ -9,6 +9,7 @@
 #include <string>
 #include <vector>
 
+#include "tpucoll/common/crypto.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/math.h"
 #include "tpucoll/types.h"
@@ -136,6 +137,164 @@ void testBf16NanLanes() {
   }
 }
 
+void testCryptoVectors() {
+  using tpucoll::AeadKey;
+  using tpucoll::aeadOpen;
+  using tpucoll::aeadSeal;
+  using tpucoll::hkdfSha256;
+  using tpucoll::crypto_detail::chacha20Block;
+  using tpucoll::crypto_detail::poly1305;
+
+  auto unhex = [](const char* s) {
+    std::vector<uint8_t> out;
+    for (size_t i = 0; s[i] != '\0'; i += 2) {
+      auto nib = [](char c) -> uint8_t {
+        return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
+      };
+      out.push_back((nib(s[i]) << 4) | nib(s[i + 1]));
+    }
+    return out;
+  };
+  auto hex = [](const uint8_t* p, size_t n) {
+    std::string out;
+    for (size_t i = 0; i < n; i++) {
+      char b[3];
+      snprintf(b, 3, "%02x", p[i]);
+      out += b;
+    }
+    return out;
+  };
+
+  // RFC 8439 2.3.2: ChaCha20 block function test vector.
+  {
+    auto key = unhex("000102030405060708090a0b0c0d0e0f"
+                     "101112131415161718191a1b1c1d1e1f");
+    auto nonce = unhex("000000090000004a00000000");
+    uint8_t block[64];
+    chacha20Block(key.data(), 1, nonce.data(), block);
+    CHECK(hex(block, 16) == "10f1e7e4d13b5915500fdd1fa32071c4");
+    CHECK(hex(block + 48, 16) == "b5129cd1de164eb9cbd083e8a2503c4e");
+  }
+
+  // RFC 8439 2.5.2: Poly1305 tag test vector.
+  {
+    auto key = unhex("85d6be7857556d337f4452fe42d506a8"
+                     "0103808afb0db2fd4abff6af4149f51b");
+    const char* msg = "Cryptographic Forum Research Group";
+    uint8_t tag[16];
+    poly1305(key.data(), reinterpret_cast<const uint8_t*>(msg),
+             strlen(msg), tag);
+    CHECK(hex(tag, 16) == "a8061dc1305136c6c22b8baf0c0127a9");
+  }
+
+  // RFC 8439 2.8.2: full AEAD test vector (96-bit nonce with a 32-bit
+  // constant prefix — our seal() builds nonces as 4 zero bytes || seq,
+  // so drive the layout-compatible parts directly through the tag path
+  // by reproducing the seal with the RFC's nonce via the block fn).
+  {
+    auto key = unhex("808182838485868788898a8b8c8d8e8f"
+                     "909192939495969798999a9b9c9d9e9f");
+    AeadKey k;
+    std::memcpy(k.bytes, key.data(), 32);
+    auto aad = unhex("50515253c0c1c2c3c4c5c6c7");
+    const char* pt = "Ladies and Gentlemen of the class of '99: "
+                     "If I could offer you only one tip for the future, "
+                     "sunscreen would be it.";
+    const size_t n = strlen(pt);
+    // Pin the exact RFC ciphertext+tag via the explicit-nonce hook.
+    {
+      auto nonce = unhex("070000004041424344454647");
+      std::vector<uint8_t> rfcCt(n);
+      uint8_t rfcTag[16];
+      tpucoll::crypto_detail::aeadSealWithNonce(
+          k, nonce.data(), aad.data(), aad.size(),
+          reinterpret_cast<const uint8_t*>(pt), n, rfcCt.data(), rfcTag);
+      CHECK(hex(rfcCt.data(), 16) == "d31a8d34648e60db7b86afbc53ef7ec2");
+      CHECK(hex(rfcCt.data() + 96, 18) ==
+            "3ff4def08e4b7a9de576d26586cec64b6116");
+      CHECK(hex(rfcTag, 16) == "1ae10b594f09e26a7e902ecbd0600691");
+    }
+    // Then the transport's seq-derived nonce layout: round-trip + tamper.
+    std::vector<uint8_t> ct(n), back(n);
+    uint8_t tag[16];
+    aeadSeal(k, 7, aad.data(), aad.size(),
+             reinterpret_cast<const uint8_t*>(pt), n, ct.data(), tag);
+    CHECK(aeadOpen(k, 7, aad.data(), aad.size(), ct.data(), n, back.data(),
+                   tag));
+    CHECK(std::memcmp(back.data(), pt, n) == 0);
+    // Wrong seq (nonce) must fail.
+    CHECK(!aeadOpen(k, 8, aad.data(), aad.size(), ct.data(), n, back.data(),
+                    tag));
+    // Flipped ciphertext byte must fail.
+    ct[5] ^= 1;
+    CHECK(!aeadOpen(k, 7, aad.data(), aad.size(), ct.data(), n, back.data(),
+                    tag));
+    ct[5] ^= 1;
+    // Flipped tag byte must fail.
+    tag[0] ^= 1;
+    CHECK(!aeadOpen(k, 7, aad.data(), aad.size(), ct.data(), n, back.data(),
+                    tag));
+    tag[0] ^= 1;
+    // Flipped aad byte must fail.
+    aad[0] ^= 1;
+    CHECK(!aeadOpen(k, 7, aad.data(), aad.size(), ct.data(), n, back.data(),
+                    tag));
+    // In-place decryption works.
+    CHECK(aeadOpen(k, 7, unhex("50515253c0c1c2c3c4c5c6c7").data(), 12,
+                   ct.data(), n, ct.data(), tag));
+    CHECK(std::memcmp(ct.data(), pt, n) == 0);
+  }
+
+  // Long-message path: the AVX2 8-block keystream must match the scalar
+  // block function exactly (the RFC vectors are all < 512 bytes and
+  // never reach it). Build the expected keystream block-by-block.
+  {
+    AeadKey k;
+    for (int i = 0; i < 32; i++) {
+      k.bytes[i] = static_cast<uint8_t>(i * 7 + 1);
+    }
+    const size_t n = 8 * 512 + 137;  // several vector chunks + tail
+    std::vector<uint8_t> pt(n);
+    for (size_t i = 0; i < n; i++) {
+      pt[i] = static_cast<uint8_t>(i * 13 + 5);
+    }
+    std::vector<uint8_t> ct(n), expect(n);
+    uint8_t tag[16];
+    aeadSeal(k, 42, nullptr, 0, pt.data(), n, ct.data(), tag);
+    // Scalar reference: nonce = 4 zero bytes || seq le64; payload
+    // keystream starts at counter 1.
+    uint8_t nonce[12] = {0};
+    uint64_t seq = 42;
+    std::memcpy(nonce + 4, &seq, 8);
+    for (size_t off = 0; off < n; off += 64) {
+      uint8_t block[64];
+      chacha20Block(k.bytes, 1 + static_cast<uint32_t>(off / 64), nonce,
+                    block);
+      for (size_t i = 0; i < 64 && off + i < n; i++) {
+        expect[off + i] = pt[off + i] ^ block[i];
+      }
+    }
+    CHECK(std::memcmp(ct.data(), expect.data(), n) == 0);
+    std::vector<uint8_t> back(n);
+    CHECK(aeadOpen(k, 42, nullptr, 0, ct.data(), n, back.data(), tag));
+    CHECK(std::memcmp(back.data(), pt.data(), n) == 0);
+  }
+
+  // RFC 5869 A.1: HKDF-SHA256 test case 1.
+  {
+    auto ikm = unhex("0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b0b");
+    auto salt = unhex("000102030405060708090a0b0c");
+    auto info = unhex("f0f1f2f3f4f5f6f7f8f9");
+    uint8_t okm[42];
+    hkdfSha256(ikm.data(), ikm.size(), salt.data(), salt.size(),
+               info.data(), info.size(), okm, sizeof(okm));
+    CHECK(hex(okm, 42) ==
+          "3cb25f25faacd57a90434f64d0362f2a"
+          "2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+          "34007208d5b887185865");
+  }
+}
+
 void testHmacVectors() {
   auto hex = [](const std::array<uint8_t, 32>& mac) {
     char buf[65];
@@ -174,6 +333,7 @@ int main() {
   testReduceKernels();
   testBf16NanLanes();
   testHmacVectors();
+  testCryptoVectors();
   if (failures == 0) {
     printf("tpucoll_unit: all tests passed\n");
     return 0;

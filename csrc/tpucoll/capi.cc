@@ -159,7 +159,7 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 // ---- device / context ----
 
 void* tc_device_new(const char* hostname, uint16_t port,
-                    const char* authKey) {
+                    const char* authKey, int encrypt) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
@@ -169,6 +169,7 @@ void* tc_device_new(const char* hostname, uint16_t port,
     if (authKey != nullptr) {
       attr.authKey = authKey;
     }
+    attr.encrypt = encrypt != 0;
     return new DeviceHandle(std::make_shared<Device>(attr));
   } catch (const std::exception& e) {
     g_lastError = e.what();

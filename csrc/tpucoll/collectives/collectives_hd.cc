@@ -21,6 +21,8 @@
 // windows flow back down the chain, and each block allgathers internally.
 // The fold path is kept as TPUCOLL_HD_NP2=fold for small payloads where
 // its fewer messages can win.
+#include <cctype>
+#include <cerrno>
 #include <cstdlib>
 #include <cstring>
 
@@ -303,9 +305,12 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
       char* end = nullptr;
       errno = 0;
       crossover = std::strtoull(c, &end, 10);
-      // strtoull silently wraps negatives and ERANGE overflows; both are
-      // misconfigurations this knob exists to catch loudly.
-      if (end == c || *end != '\0' || c[0] == '-' || errno == ERANGE) {
+      // strtoull silently wraps negatives (even behind whitespace) and
+      // ERANGE overflows; both are misconfigurations this knob exists to
+      // catch loudly — accept plain digit strings only.
+      if (end == c || *end != '\0' ||
+          !std::isdigit(static_cast<unsigned char>(c[0])) ||
+          errno == ERANGE) {
         TC_THROW(EnforceError,
                  "TPUCOLL_HD_NP2_CROSSOVER must be a byte count, got: ", c);
       }

@@ -1,0 +1,474 @@
+#include "tpucoll/common/crypto.h"
+
+#include <cstring>
+
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
+#include "tpucoll/common/hmac.h"
+
+namespace tpucoll {
+namespace {
+
+inline uint32_t rotl32(uint32_t v, int c) {
+  return (v << c) | (v >> (32 - c));
+}
+
+inline uint32_t load32le(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+inline void store32le(uint8_t* p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v);
+  p[1] = static_cast<uint8_t>(v >> 8);
+  p[2] = static_cast<uint8_t>(v >> 16);
+  p[3] = static_cast<uint8_t>(v >> 24);
+}
+
+inline void store64le(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) {
+    p[i] = static_cast<uint8_t>(v >> (8 * i));
+  }
+}
+
+#define TC_QR(a, b, c, d)        \
+  a += b;                        \
+  d = rotl32(d ^ a, 16);         \
+  c += d;                        \
+  b = rotl32(b ^ c, 12);         \
+  a += b;                        \
+  d = rotl32(d ^ a, 8);          \
+  c += d;                        \
+  b = rotl32(b ^ c, 7)
+
+void chachaBlockWords(const uint32_t state[16], uint32_t out[16]) {
+  uint32_t x[16];
+  std::memcpy(x, state, sizeof(x));
+  for (int round = 0; round < 10; round++) {
+    TC_QR(x[0], x[4], x[8], x[12]);
+    TC_QR(x[1], x[5], x[9], x[13]);
+    TC_QR(x[2], x[6], x[10], x[14]);
+    TC_QR(x[3], x[7], x[11], x[15]);
+    TC_QR(x[0], x[5], x[10], x[15]);
+    TC_QR(x[1], x[6], x[11], x[12]);
+    TC_QR(x[2], x[7], x[8], x[13]);
+    TC_QR(x[3], x[4], x[9], x[14]);
+  }
+  for (int i = 0; i < 16; i++) {
+    out[i] = x[i] + state[i];
+  }
+}
+
+#undef TC_QR
+
+void initState(uint32_t state[16], const uint8_t key[32], uint32_t counter,
+               const uint8_t nonce[12]) {
+  state[0] = 0x61707865;
+  state[1] = 0x3320646e;
+  state[2] = 0x79622d32;
+  state[3] = 0x6b206574;
+  for (int i = 0; i < 8; i++) {
+    state[4 + i] = load32le(key + 4 * i);
+  }
+  state[12] = counter;
+  state[13] = load32le(nonce);
+  state[14] = load32le(nonce + 4);
+  state[15] = load32le(nonce + 8);
+}
+
+#ifdef __AVX2__
+inline __m256i vrot16(__m256i v) {
+  const __m256i mask = _mm256_setr_epi8(
+      2, 3, 0, 1, 6, 7, 4, 5, 10, 11, 8, 9, 14, 15, 12, 13,
+      2, 3, 0, 1, 6, 7, 4, 5, 10, 11, 8, 9, 14, 15, 12, 13);
+  return _mm256_shuffle_epi8(v, mask);
+}
+
+inline __m256i vrot8(__m256i v) {
+  const __m256i mask = _mm256_setr_epi8(
+      3, 0, 1, 2, 7, 4, 5, 6, 11, 8, 9, 10, 15, 12, 13, 14,
+      3, 0, 1, 2, 7, 4, 5, 6, 11, 8, 9, 10, 15, 12, 13, 14);
+  return _mm256_shuffle_epi8(v, mask);
+}
+
+inline __m256i vrot12(__m256i v) {
+  return _mm256_or_si256(_mm256_slli_epi32(v, 12),
+                         _mm256_srli_epi32(v, 20));
+}
+
+inline __m256i vrot7(__m256i v) {
+  return _mm256_or_si256(_mm256_slli_epi32(v, 7),
+                         _mm256_srli_epi32(v, 25));
+}
+
+#define TC_VQR(a, b, c, d)           \
+  a = _mm256_add_epi32(a, b);        \
+  d = vrot16(_mm256_xor_si256(d, a)); \
+  c = _mm256_add_epi32(c, d);        \
+  b = vrot12(_mm256_xor_si256(b, c)); \
+  a = _mm256_add_epi32(a, b);        \
+  d = vrot8(_mm256_xor_si256(d, a));  \
+  c = _mm256_add_epi32(c, d);        \
+  b = vrot7(_mm256_xor_si256(b, c))
+
+// Transpose 8 vectors of 8 u32 lanes: row[i] lane b  ->  out vector b
+// word i. Used to turn "word i of blocks 0..7" into contiguous blocks.
+inline void transpose8x8(__m256i r[8]) {
+  __m256i t[8], u[8];
+  t[0] = _mm256_unpacklo_epi32(r[0], r[1]);
+  t[1] = _mm256_unpackhi_epi32(r[0], r[1]);
+  t[2] = _mm256_unpacklo_epi32(r[2], r[3]);
+  t[3] = _mm256_unpackhi_epi32(r[2], r[3]);
+  t[4] = _mm256_unpacklo_epi32(r[4], r[5]);
+  t[5] = _mm256_unpackhi_epi32(r[4], r[5]);
+  t[6] = _mm256_unpacklo_epi32(r[6], r[7]);
+  t[7] = _mm256_unpackhi_epi32(r[6], r[7]);
+  u[0] = _mm256_unpacklo_epi64(t[0], t[2]);
+  u[1] = _mm256_unpackhi_epi64(t[0], t[2]);
+  u[2] = _mm256_unpacklo_epi64(t[1], t[3]);
+  u[3] = _mm256_unpackhi_epi64(t[1], t[3]);
+  u[4] = _mm256_unpacklo_epi64(t[4], t[6]);
+  u[5] = _mm256_unpackhi_epi64(t[4], t[6]);
+  u[6] = _mm256_unpacklo_epi64(t[5], t[7]);
+  u[7] = _mm256_unpackhi_epi64(t[5], t[7]);
+  r[0] = _mm256_permute2x128_si256(u[0], u[4], 0x20);
+  r[1] = _mm256_permute2x128_si256(u[1], u[5], 0x20);
+  r[2] = _mm256_permute2x128_si256(u[2], u[6], 0x20);
+  r[3] = _mm256_permute2x128_si256(u[3], u[7], 0x20);
+  r[4] = _mm256_permute2x128_si256(u[0], u[4], 0x31);
+  r[5] = _mm256_permute2x128_si256(u[1], u[5], 0x31);
+  r[6] = _mm256_permute2x128_si256(u[2], u[6], 0x31);
+  r[7] = _mm256_permute2x128_si256(u[3], u[7], 0x31);
+}
+
+// 8 blocks (512 bytes) of keystream per pass: each __m256i holds word i
+// of blocks 0..7 ("vertical" layout), so the scalar round function maps
+// 1:1 onto vector ops. Consumes full 512-byte chunks only.
+size_t chacha20Xor8(const uint32_t state[16], uint32_t counter,
+                    const uint8_t* in, size_t n, uint8_t* out) {
+  size_t done = 0;
+  while (n - done >= 512) {
+    __m256i init[16], v[16];
+    for (int i = 0; i < 16; i++) {
+      init[i] = _mm256_set1_epi32(static_cast<int>(state[i]));
+    }
+    init[12] = _mm256_add_epi32(
+        _mm256_set1_epi32(static_cast<int>(counter)),
+        _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7));
+    for (int i = 0; i < 16; i++) {
+      v[i] = init[i];
+    }
+    for (int round = 0; round < 10; round++) {
+      TC_VQR(v[0], v[4], v[8], v[12]);
+      TC_VQR(v[1], v[5], v[9], v[13]);
+      TC_VQR(v[2], v[6], v[10], v[14]);
+      TC_VQR(v[3], v[7], v[11], v[15]);
+      TC_VQR(v[0], v[5], v[10], v[15]);
+      TC_VQR(v[1], v[6], v[11], v[12]);
+      TC_VQR(v[2], v[7], v[8], v[13]);
+      TC_VQR(v[3], v[4], v[9], v[14]);
+    }
+    for (int i = 0; i < 16; i++) {
+      v[i] = _mm256_add_epi32(v[i], init[i]);
+    }
+    transpose8x8(v);      // words 0..7 of blocks 0..7
+    transpose8x8(v + 8);  // words 8..15 of blocks 0..7
+    for (int b = 0; b < 8; b++) {
+      const uint8_t* src = in + done + b * 64;
+      uint8_t* dst = out + done + b * 64;
+      __m256i lo = _mm256_xor_si256(
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src)), v[b]);
+      __m256i hi = _mm256_xor_si256(
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + 32)),
+          v[8 + b]);
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst), lo);
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + 32), hi);
+    }
+    counter += 8;
+    done += 512;
+  }
+  return done;
+}
+
+#undef TC_VQR
+#endif  // __AVX2__
+
+void chacha20Xor(const uint8_t key[32], uint32_t counter,
+                 const uint8_t nonce[12], const uint8_t* in, size_t n,
+                 uint8_t* out) {
+  uint32_t state[16];
+  initState(state, key, counter, nonce);
+#ifdef __AVX2__
+  const size_t vec = chacha20Xor8(state, counter, in, n, out);
+  in += vec;
+  out += vec;
+  n -= vec;
+  state[12] = counter + static_cast<uint32_t>(vec / 64);
+#endif
+  uint8_t block[64];
+  while (n > 0) {
+    uint32_t words[16];
+    chachaBlockWords(state, words);
+    for (int i = 0; i < 16; i++) {
+      store32le(block + 4 * i, words[i]);
+    }
+    const size_t take = n < 64 ? n : 64;
+    for (size_t i = 0; i < take; i++) {
+      out[i] = in[i] ^ block[i];
+    }
+    in += take;
+    out += take;
+    n -= take;
+    state[12]++;
+  }
+}
+
+// Poly1305 with 26-bit limbs (the well-trodden "donna" shape: carries
+// stay in 64-bit intermediates, no 128-bit type needed).
+struct Poly1305 {
+  uint32_t r[5];
+  uint32_t h[5]{0, 0, 0, 0, 0};
+  uint32_t pad[4];
+
+  explicit Poly1305(const uint8_t key[32]) {
+    r[0] = load32le(key + 0) & 0x3ffffff;
+    r[1] = (load32le(key + 3) >> 2) & 0x3ffff03;
+    r[2] = (load32le(key + 6) >> 4) & 0x3ffc0ff;
+    r[3] = (load32le(key + 9) >> 6) & 0x3f03fff;
+    r[4] = (load32le(key + 12) >> 8) & 0x00fffff;
+    for (int i = 0; i < 4; i++) {
+      pad[i] = load32le(key + 16 + 4 * i);
+    }
+  }
+
+  void blocks(const uint8_t* m, size_t n, uint32_t hibit) {
+    const uint64_t r0 = r[0], r1 = r[1], r2 = r[2], r3 = r[3], r4 = r[4];
+    const uint64_t s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+    uint64_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
+    while (n >= 16) {
+      h0 += load32le(m + 0) & 0x3ffffff;
+      h1 += (load32le(m + 3) >> 2) & 0x3ffffff;
+      h2 += (load32le(m + 6) >> 4) & 0x3ffffff;
+      h3 += (load32le(m + 9) >> 6) & 0x3ffffff;
+      h4 += (load32le(m + 12) >> 8) | (static_cast<uint64_t>(hibit) << 24);
+      const uint64_t d0 =
+          h0 * r0 + h1 * s4 + h2 * s3 + h3 * s2 + h4 * s1;
+      const uint64_t d1 =
+          h0 * r1 + h1 * r0 + h2 * s4 + h3 * s3 + h4 * s2;
+      const uint64_t d2 =
+          h0 * r2 + h1 * r1 + h2 * r0 + h3 * s4 + h4 * s3;
+      const uint64_t d3 =
+          h0 * r3 + h1 * r2 + h2 * r1 + h3 * r0 + h4 * s4;
+      const uint64_t d4 =
+          h0 * r4 + h1 * r3 + h2 * r2 + h3 * r1 + h4 * r0;
+      uint64_t c = d0 >> 26;
+      h0 = d0 & 0x3ffffff;
+      uint64_t e1 = d1 + c;
+      c = e1 >> 26;
+      h1 = e1 & 0x3ffffff;
+      uint64_t e2 = d2 + c;
+      c = e2 >> 26;
+      h2 = e2 & 0x3ffffff;
+      uint64_t e3 = d3 + c;
+      c = e3 >> 26;
+      h3 = e3 & 0x3ffffff;
+      uint64_t e4 = d4 + c;
+      c = e4 >> 26;
+      h4 = e4 & 0x3ffffff;
+      h0 += c * 5;
+      c = h0 >> 26;
+      h0 &= 0x3ffffff;
+      h1 += c;
+      m += 16;
+      n -= 16;
+    }
+    h[0] = static_cast<uint32_t>(h0);
+    h[1] = static_cast<uint32_t>(h1);
+    h[2] = static_cast<uint32_t>(h2);
+    h[3] = static_cast<uint32_t>(h3);
+    h[4] = static_cast<uint32_t>(h4);
+  }
+
+  void finish(uint8_t tag[16]) {
+    uint32_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
+    uint32_t c = h1 >> 26;
+    h1 &= 0x3ffffff;
+    h2 += c;
+    c = h2 >> 26;
+    h2 &= 0x3ffffff;
+    h3 += c;
+    c = h3 >> 26;
+    h3 &= 0x3ffffff;
+    h4 += c;
+    c = h4 >> 26;
+    h4 &= 0x3ffffff;
+    h0 += c * 5;
+    c = h0 >> 26;
+    h0 &= 0x3ffffff;
+    h1 += c;
+
+    // Compute h + -p and select it if h >= p.
+    uint32_t g0 = h0 + 5;
+    c = g0 >> 26;
+    g0 &= 0x3ffffff;
+    uint32_t g1 = h1 + c;
+    c = g1 >> 26;
+    g1 &= 0x3ffffff;
+    uint32_t g2 = h2 + c;
+    c = g2 >> 26;
+    g2 &= 0x3ffffff;
+    uint32_t g3 = h3 + c;
+    c = g3 >> 26;
+    g3 &= 0x3ffffff;
+    uint32_t g4 = h4 + c - (1u << 26);
+    const uint32_t mask = (g4 >> 31) - 1;  // all-ones if h >= p
+    h0 = (h0 & ~mask) | (g0 & mask);
+    h1 = (h1 & ~mask) | (g1 & mask);
+    h2 = (h2 & ~mask) | (g2 & mask);
+    h3 = (h3 & ~mask) | (g3 & mask);
+    h4 = (h4 & ~mask) | (g4 & mask);
+
+    // h mod 2^128 + pad.
+    h0 = (h0 | (h1 << 26)) & 0xffffffff;
+    h1 = ((h1 >> 6) | (h2 << 20)) & 0xffffffff;
+    h2 = ((h2 >> 12) | (h3 << 14)) & 0xffffffff;
+    h3 = ((h3 >> 18) | (h4 << 8)) & 0xffffffff;
+    uint64_t f = static_cast<uint64_t>(h0) + pad[0];
+    store32le(tag + 0, static_cast<uint32_t>(f));
+    f = static_cast<uint64_t>(h1) + pad[1] + (f >> 32);
+    store32le(tag + 4, static_cast<uint32_t>(f));
+    f = static_cast<uint64_t>(h2) + pad[2] + (f >> 32);
+    store32le(tag + 8, static_cast<uint32_t>(f));
+    f = static_cast<uint64_t>(h3) + pad[3] + (f >> 32);
+    store32le(tag + 12, static_cast<uint32_t>(f));
+  }
+};
+
+void polyUpdatePadded(Poly1305* mac, const uint8_t* data, size_t n) {
+  // Full 16-byte blocks straight from the source, then one zero-padded
+  // final block (RFC 8439 AEAD layout pads aad and ciphertext to 16).
+  const size_t full = n & ~static_cast<size_t>(15);
+  if (full > 0) {
+    mac->blocks(data, full, 1);
+  }
+  if (n - full > 0) {
+    uint8_t last[16] = {0};
+    std::memcpy(last, data + full, n - full);
+    mac->blocks(last, 16, 1);
+  }
+}
+
+void aeadTag(const uint8_t otk[32], const uint8_t* aad, size_t aadLen,
+             const uint8_t* ct, size_t ctLen, uint8_t tag[16]) {
+  Poly1305 mac(otk);
+  polyUpdatePadded(&mac, aad, aadLen);
+  polyUpdatePadded(&mac, ct, ctLen);
+  uint8_t lens[16];
+  store64le(lens, aadLen);
+  store64le(lens + 8, ctLen);
+  mac.blocks(lens, 16, 1);
+  mac.finish(tag);
+}
+
+void makeNonce(uint64_t seq, uint8_t nonce[12]) {
+  std::memset(nonce, 0, 4);
+  store64le(nonce + 4, seq);
+}
+
+}  // namespace
+
+namespace crypto_detail {
+
+void chacha20Block(const uint8_t key[32], uint32_t counter,
+                   const uint8_t nonce[12], uint8_t out[64]) {
+  uint32_t state[16];
+  initState(state, key, counter, nonce);
+  uint32_t words[16];
+  chachaBlockWords(state, words);
+  for (int i = 0; i < 16; i++) {
+    store32le(out + 4 * i, words[i]);
+  }
+}
+
+void poly1305(const uint8_t key[32], const uint8_t* msg, size_t n,
+              uint8_t tag[16]) {
+  Poly1305 mac(key);
+  const size_t full = n & ~static_cast<size_t>(15);
+  if (full > 0) {
+    mac.blocks(msg, full, 1);
+  }
+  if (n - full > 0) {
+    // Final partial block: append the 0x01 hibit byte, no zero padding
+    // into the hibit position (plain Poly1305 semantics).
+    uint8_t last[16] = {0};
+    std::memcpy(last, msg + full, n - full);
+    last[n - full] = 1;
+    mac.blocks(last, 16, 0);
+  }
+  mac.finish(tag);
+}
+
+void aeadSealWithNonce(const AeadKey& key, const uint8_t nonce[12],
+                       const uint8_t* aad, size_t aadLen, const uint8_t* in,
+                       size_t n, uint8_t* out, uint8_t tag[kAeadTagBytes]) {
+  uint8_t otk[64];
+  chacha20Block(key.bytes, 0, nonce, otk);
+  chacha20Xor(key.bytes, 1, nonce, in, n, out);
+  aeadTag(otk, aad, aadLen, out, n, tag);
+}
+
+}  // namespace crypto_detail
+
+void aeadSeal(const AeadKey& key, uint64_t seq, const uint8_t* aad,
+              size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
+              uint8_t tag[kAeadTagBytes]) {
+  uint8_t nonce[12];
+  makeNonce(seq, nonce);
+  crypto_detail::aeadSealWithNonce(key, nonce, aad, aadLen, in, n, out, tag);
+}
+
+bool aeadOpen(const AeadKey& key, uint64_t seq, const uint8_t* aad,
+              size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
+              const uint8_t tag[kAeadTagBytes]) {
+  uint8_t nonce[12];
+  makeNonce(seq, nonce);
+  uint8_t otk[64];
+  crypto_detail::chacha20Block(key.bytes, 0, nonce, otk);
+  uint8_t expect[kAeadTagBytes];
+  aeadTag(otk, aad, aadLen, in, n, expect);
+  if (!macEqual(expect, tag, kAeadTagBytes)) {
+    return false;
+  }
+  chacha20Xor(key.bytes, 1, nonce, in, n, out);
+  return true;
+}
+
+void hkdfSha256(const void* ikm, size_t ikmLen, const void* salt,
+                size_t saltLen, const void* info, size_t infoLen,
+                uint8_t* out, size_t outLen) {
+  // Extract: PRK = HMAC(salt, IKM).
+  auto prk = hmacSha256(salt, saltLen, ikm, ikmLen);
+  // Expand: T(i) = HMAC(PRK, T(i-1) || info || i).
+  uint8_t t[32];
+  size_t tLen = 0;
+  uint8_t counter = 1;
+  size_t produced = 0;
+  while (produced < outLen) {
+    std::string block(reinterpret_cast<const char*>(t), tLen);
+    block.append(static_cast<const char*>(info), infoLen);
+    block.push_back(static_cast<char>(counter));
+    auto digest = hmacSha256(prk.data(), prk.size(), block.data(),
+                             block.size());
+    std::memcpy(t, digest.data(), 32);
+    tLen = 32;
+    const size_t take = outLen - produced < 32 ? outLen - produced : 32;
+    std::memcpy(out + produced, t, take);
+    produced += take;
+    counter++;
+  }
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,56 @@
+// In-tree ChaCha20-Poly1305 AEAD (RFC 8439) and HKDF-SHA256 (RFC 5869)
+// for the host transport's wire encryption. No OpenSSL dependency: the
+// container ships no TLS headers, and the reference capability being
+// covered — confidentiality + integrity of the data plane, keyed from
+// the join handshake (gloo/transport/tcp/tls/pair.cc:22-53) — needs one
+// AEAD, not a TLS stack. Verified against the RFC test vectors in
+// csrc/tests/unit_main.cc.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpucoll {
+
+constexpr size_t kAeadKeyBytes = 32;
+constexpr size_t kAeadTagBytes = 16;
+constexpr size_t kAeadNonceBytes = 12;
+
+struct AeadKey {
+  uint8_t bytes[kAeadKeyBytes];
+};
+
+// Encrypt n bytes of `in` into `out` (in == out allowed) and write the
+// 16-byte authentication tag. The 12-byte nonce is formed from the
+// 64-bit sequence number (4 zero bytes || seq little-endian); a key must
+// never seal two messages with the same seq. `aad`/`aadLen` bind
+// additional plaintext context into the tag (may be empty).
+void aeadSeal(const AeadKey& key, uint64_t seq, const uint8_t* aad,
+              size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
+              uint8_t tag[kAeadTagBytes]);
+
+// Verify-then-decrypt counterpart. Returns false (and leaves `out`
+// unspecified) on tag mismatch. in == out allowed.
+bool aeadOpen(const AeadKey& key, uint64_t seq, const uint8_t* aad,
+              size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
+              const uint8_t tag[kAeadTagBytes]);
+
+// HKDF-SHA256 extract+expand. outLen <= 255 * 32.
+void hkdfSha256(const void* ikm, size_t ikmLen, const void* salt,
+                size_t saltLen, const void* info, size_t infoLen,
+                uint8_t* out, size_t outLen);
+
+// Exposed for unit tests (RFC 8439 section vectors).
+namespace crypto_detail {
+void chacha20Block(const uint8_t key[32], uint32_t counter,
+                   const uint8_t nonce[12], uint8_t out[64]);
+void poly1305(const uint8_t key[32], const uint8_t* msg, size_t n,
+              uint8_t tag[16]);
+// The AEAD with a caller-supplied 96-bit nonce (the transport always
+// derives nonces from sequence numbers; the RFC vectors do not).
+void aeadSealWithNonce(const AeadKey& key, const uint8_t nonce[12],
+                       const uint8_t* aad, size_t aadLen, const uint8_t* in,
+                       size_t n, uint8_t* out, uint8_t tag[kAeadTagBytes]);
+}  // namespace crypto_detail
+
+}  // namespace tpucoll

@@ -1,10 +1,11 @@
 // SHA-256 and HMAC-SHA256 (FIPS 180-4 / RFC 2104), self-contained.
 //
-// Used by the transport's pre-shared-key connection handshake — the
-// equivalent of the reference's TLS tier (gloo/transport/tcp/tls) scoped
-// to mutual authentication: it keeps rogue processes out of the mesh on a
-// pod network. Payload encryption is out of scope (the image ships no
-// crypto library headers; hand-rolling a cipher would be malpractice).
+// Used by the transport's pre-shared-key connection handshake (mutual
+// authentication; keeps rogue processes out of the mesh) and as the HKDF
+// core that derives per-connection AEAD keys when wire encryption is
+// enabled — see common/crypto.h for the ChaCha20-Poly1305 layer that
+// covers the reference's TLS-tier confidentiality/integrity
+// (gloo/transport/tcp/tls).
 #pragma once
 
 #include <array>

@@ -1,13 +1,19 @@
 #include "tpucoll/transport/device.h"
 
+#include "tpucoll/common/logging.h"
 #include "tpucoll/common/sysinfo.h"
 
 namespace tpucoll {
 namespace transport {
 
-Device::Device(const DeviceAttr& attr) : authKey_(attr.authKey) {
+Device::Device(const DeviceAttr& attr)
+    : authKey_(attr.authKey), encrypt_(attr.encrypt) {
+  TC_ENFORCE(!encrypt_ || !authKey_.empty(),
+             "encrypt=true requires an auth key (the AEAD keys are "
+             "derived from the PSK handshake)");
   SockAddr bindAddr = resolve(attr.hostname, attr.port);
-  listener_ = std::make_unique<Listener>(&loop_, bindAddr, authKey_);
+  listener_ = std::make_unique<Listener>(&loop_, bindAddr, authKey_,
+                                         encrypt_);
 }
 
 std::string Device::str() const {

@@ -25,6 +25,10 @@ struct DeviceAttr {
   // Non-empty: require the PSK handshake on every inbound and outbound
   // connection (mutual HMAC-SHA256 authentication; see wire.h).
   std::string authKey;
+  // Encrypt the data plane: per-connection ChaCha20-Poly1305 keys derived
+  // from the PSK handshake (requires a non-empty authKey). Both sides of
+  // every connection must agree — a plaintext peer is rejected at hello.
+  bool encrypt{false};
 };
 
 class Device {
@@ -36,6 +40,7 @@ class Device {
   const SockAddr& address() const { return listener_->address(); }
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
   const std::string& authKey() const { return authKey_; }
+  bool encrypt() const { return encrypt_; }
   std::string str() const;
 
  private:
@@ -43,6 +48,7 @@ class Device {
   std::unique_ptr<Listener> listener_;
   std::atomic<uint64_t> pairId_{1};
   std::string authKey_;
+  bool encrypt_{false};
 };
 
 }  // namespace transport

@@ -26,8 +26,10 @@ namespace transport {
 // for routing.
 class PendingConn : public Handler {
  public:
-  PendingConn(Listener* listener, int fd, const std::string& authKey)
-      : listener_(listener), fd_(fd), authKey_(authKey) {}
+  PendingConn(Listener* listener, int fd, const std::string& authKey,
+              bool encrypt)
+      : listener_(listener), fd_(fd), authKey_(authKey),
+        encrypt_(encrypt) {}
 
   int fd() const { return fd_; }
 
@@ -38,7 +40,7 @@ class PendingConn : public Handler {
                                                     : kAuthMacBytes;
       ssize_t n = read(fd_, buf_ + got_, want - got_);
       if (n == 0) {
-        listener_->finishPending(this, false, 0, fd_);
+        listener_->finishPending(this, false, 0, fd_, ConnKeys{});
         return;
       }
       if (n < 0) {
@@ -48,7 +50,7 @@ class PendingConn : public Handler {
         if (errno == EINTR) {
           continue;
         }
-        listener_->finishPending(this, false, 0, fd_);
+        listener_->finishPending(this, false, 0, fd_, ConnKeys{});
         return;
       }
       got_ += static_cast<size_t>(n);
@@ -63,13 +65,16 @@ class PendingConn : public Handler {
           pairId_ = hello.pairId;
           const bool wantAuth = !authKey_.empty();
           if (hello.magic == kHelloMagic && !wantAuth) {
-            listener_->finishPending(this, true, pairId_, fd_);
+            listener_->finishPending(this, true, pairId_, fd_, ConnKeys{});
             return;
           }
-          if (hello.magic != kHelloAuthMagic || !wantAuth) {
-            // Plain hello against an authenticated listener, auth hello
-            // against a plain one, or garbage: reject.
-            listener_->finishPending(this, false, 0, fd_);
+          // The hello must match this device's (auth, encrypt) tier
+          // exactly: plain vs authenticated vs encrypted mismatches (in
+          // either direction) and garbage are all rejected.
+          const uint32_t want = encrypt_ ? kHelloAuthEncMagic
+                                         : kHelloAuthMagic;
+          if (hello.magic != want || !wantAuth) {
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
             return;
           }
           phase_ = Phase::kNonce;
@@ -84,7 +89,7 @@ class PendingConn : public Handler {
           std::memcpy(out, nonceL_, kAuthNonceBytes);
           std::memcpy(out + kAuthNonceBytes, mac.data(), kAuthMacBytes);
           if (!writeFullNoSig(fd_, out, sizeof(out))) {
-            listener_->finishPending(this, false, 0, fd_);
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
             return;
           }
           phase_ = Phase::kClientMac;
@@ -97,7 +102,12 @@ class PendingConn : public Handler {
           if (!ok) {
             TC_WARN("rejecting inbound connection: bad auth tag");
           }
-          listener_->finishPending(this, ok, pairId_, fd_);
+          ConnKeys keys;
+          if (ok && encrypt_) {
+            keys = deriveConnKeys(authKey_, pairId_, nonceI_, nonceL_,
+                                  /*initiator=*/false);
+          }
+          listener_->finishPending(this, ok, pairId_, fd_, keys);
           return;
         }
       }
@@ -139,6 +149,7 @@ class PendingConn : public Handler {
   Listener* const listener_;
   const int fd_;
   const std::string& authKey_;
+  const bool encrypt_;
   Phase phase_{Phase::kHello};
   uint64_t pairId_{0};
   uint8_t nonceI_[kAuthNonceBytes];
@@ -148,8 +159,8 @@ class PendingConn : public Handler {
 };
 
 Listener::Listener(Loop* loop, const SockAddr& bindAddr,
-                   const std::string& authKey)
-    : loop_(loop), authKey_(authKey) {
+                   const std::string& authKey, bool encrypt)
+    : loop_(loop), authKey_(authKey), encrypt_(encrypt) {
   fd_ = socket(bindAddr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd_, 0, errnoString("socket"));
   setReuseAddr(fd_);
@@ -179,7 +190,7 @@ Listener::~Listener() {
     ::close(conn->fd());
   }
   for (auto& kv : parked_) {
-    ::close(kv.second);
+    ::close(kv.second.fd);
   }
 }
 
@@ -197,7 +208,7 @@ void Listener::handleEvents(uint32_t /*events*/) {
       return;
     }
     setNoDelay(fd);
-    auto conn = std::make_unique<PendingConn>(this, fd, authKey_);
+    auto conn = std::make_unique<PendingConn>(this, fd, authKey_, encrypt_);
     PendingConn* raw = conn.get();
     {
       std::lock_guard<std::mutex> guard(mu_);
@@ -208,7 +219,7 @@ void Listener::handleEvents(uint32_t /*events*/) {
 }
 
 void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
-                             int fd) {
+                             int fd, const ConnKeys& keys) {
   Pair* target = nullptr;
   {
     std::lock_guard<std::mutex> guard(mu_);
@@ -228,7 +239,7 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
         target = it->second;
         expected_.erase(it);
       } else {
-        parked_[pairId] = fd;
+        parked_[pairId] = Parked{fd, keys};
       }
     }
   }
@@ -237,24 +248,26 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
     return;
   }
   if (target != nullptr) {
-    target->assumeConnected(fd);
+    target->assumeConnected(fd, keys);
   }
 }
 
 void Listener::expect(uint64_t pairId, Pair* pair) {
   int fd = -1;
+  ConnKeys keys;
   {
     std::lock_guard<std::mutex> guard(mu_);
     auto it = parked_.find(pairId);
     if (it != parked_.end()) {
-      fd = it->second;
+      fd = it->second.fd;
+      keys = it->second.keys;
       parked_.erase(it);
     } else {
       expected_[pairId] = pair;
     }
   }
   if (fd >= 0) {
-    pair->assumeConnected(fd);
+    pair->assumeConnected(fd, keys);
   }
 }
 

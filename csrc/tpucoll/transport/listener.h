@@ -14,6 +14,7 @@
 
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/loop.h"
+#include "tpucoll/transport/wire.h"
 
 namespace tpucoll {
 namespace transport {
@@ -24,7 +25,7 @@ class PendingConn;
 class Listener : public Handler {
  public:
   Listener(Loop* loop, const SockAddr& bindAddr,
-           const std::string& authKey = "");
+           const std::string& authKey = "", bool encrypt = false);
   ~Listener() override;
 
   const SockAddr& address() const { return addr_; }
@@ -36,19 +37,27 @@ class Listener : public Handler {
 
   void handleEvents(uint32_t events) override;
 
-  // PendingConn completion (loop thread). Destroys `conn`.
-  void finishPending(PendingConn* conn, bool ok, uint64_t pairId, int fd);
+  // PendingConn completion (loop thread). Destroys `conn`. `keys` carries
+  // the connection's AEAD keys when the device encrypts.
+  void finishPending(PendingConn* conn, bool ok, uint64_t pairId, int fd,
+                     const ConnKeys& keys);
 
  private:
   Loop* const loop_;
   int fd_{-1};
   SockAddr addr_;
   const std::string authKey_;
+  const bool encrypt_;
+
+  struct Parked {
+    int fd;
+    ConnKeys keys;
+  };
 
   std::mutex mu_;
   bool shuttingDown_{false};
   std::unordered_map<uint64_t, Pair*> expected_;
-  std::unordered_map<uint64_t, int> parked_;
+  std::unordered_map<uint64_t, Parked> parked_;
   std::list<std::unique_ptr<PendingConn>> pending_;
 };
 

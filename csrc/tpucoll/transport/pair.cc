@@ -161,10 +161,15 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
   };
 
   // Route this connection to the peer's expecting Pair; with a pre-shared
-  // key, run the mutual challenge/response of wire.h on top.
-  WireHello hello{authKey.empty() ? kHelloMagic : kHelloAuthMagic, 0,
-                  remotePairId};
+  // key, run the mutual challenge/response of wire.h on top (and, when the
+  // device encrypts, derive the connection's AEAD keys from it).
+  const bool encrypt = context_->device()->encrypt();
+  WireHello hello{authKey.empty() ? kHelloMagic
+                  : encrypt       ? kHelloAuthEncMagic
+                                  : kHelloAuthMagic,
+                  0, remotePairId};
   writeAll(&hello, sizeof(hello), "hello");
+  ConnKeys keys;
   if (!authKey.empty()) {
     uint8_t nonceI[kAuthNonceBytes];
     randomBytes(nonceI, sizeof(nonceI));
@@ -190,8 +195,12 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
     }
     auto cliMac = transcript("cli");
     writeAll(cliMac.data(), cliMac.size(), "auth tag");
+    if (encrypt) {
+      keys = deriveConnKeys(authKey, remotePairId, nonceI, reply,
+                            /*initiator=*/true);
+    }
   }
-  assumeConnected(fd);
+  assumeConnected(fd, keys);
 }
 
 void Pair::expectViaListener(Listener* listener) {
@@ -199,13 +208,14 @@ void Pair::expectViaListener(Listener* listener) {
   listener->expect(localPairId_, this);
 }
 
-void Pair::assumeConnected(int fd) {
+void Pair::assumeConnected(int fd, const ConnKeys& keys) {
   setNonBlocking(fd);
   setBufferSizes(fd, 4 << 20);
   bool accepted = false;
   {
     std::lock_guard<std::mutex> guard(mu_);
     if (state_.load() == State::kInitializing) {
+      keys_ = keys;
       fd_ = fd;
       epollMask_ = EPOLLIN;
       everConnected_.store(true);
@@ -284,7 +294,8 @@ int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
   std::lock_guard<std::mutex> guard(mu_);
   int removed = 0;
   for (auto it = tx_.begin(); it != tx_.end();) {
-    const bool started = it == tx_.begin() && it->headerSent > 0;
+    const bool started =
+        it == tx_.begin() && (it->headerSent > 0 || it->headerSealed);
     if (it->ubuf == ubuf && !started) {
       it = tx_.erase(it);
       removed++;
@@ -311,6 +322,38 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
   }
   while (!tx_.empty()) {
     TxOp& op = tx_.front();
+    if (keys_.encrypted) {
+      if (op.cipherSent == op.cipher.size()) {
+        if (!op.headerSealed) {
+          sealHeaderFrame(&op);
+        } else if (op.sealOffset < op.nbytes) {
+          sealPayloadFrame(&op);
+        } else {
+          completed->push_back(op.ubuf);
+          tx_.pop_front();
+          continue;
+        }
+      }
+      ssize_t n = ::send(fd_, op.cipher.data() + op.cipherSent,
+                         op.cipher.size() - op.cipherSent, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          break;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        pendingTxError_ = errnoString("send");
+        return;
+      }
+      op.cipherSent += static_cast<size_t>(n);
+      if (op.cipherSent == op.cipher.size() && op.headerSealed &&
+          op.sealOffset == op.nbytes) {
+        completed->push_back(op.ubuf);
+        tx_.pop_front();
+      }
+      continue;
+    }
     iovec iov[2];
     int iovcnt = 0;
     if (op.headerSent < sizeof(WireHeader)) {
@@ -353,6 +396,28 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
       tx_.pop_front();
     }
   }
+}
+
+void Pair::sealHeaderFrame(TxOp* op) {
+  op->cipher.resize(sizeof(WireHeader) + kAeadTagBytes);
+  op->cipherSent = 0;
+  uint8_t* p = reinterpret_cast<uint8_t*>(op->cipher.data());
+  aeadSeal(keys_.tx, txSeq_++, nullptr, 0,
+           reinterpret_cast<const uint8_t*>(&op->header),
+           sizeof(WireHeader), p, p + sizeof(WireHeader));
+  op->headerSealed = true;
+}
+
+void Pair::sealPayloadFrame(TxOp* op) {
+  const size_t chunk =
+      std::min(kEncFrameBytes, op->nbytes - op->sealOffset);
+  op->cipher.resize(chunk + kAeadTagBytes);
+  op->cipherSent = 0;
+  uint8_t* p = reinterpret_cast<uint8_t*>(op->cipher.data());
+  aeadSeal(keys_.tx, txSeq_++, nullptr, 0,
+           reinterpret_cast<const uint8_t*>(op->data + op->sealOffset),
+           chunk, p, p + chunk);
+  op->sealOffset += chunk;
 }
 
 void Pair::updateEpollMask() {
@@ -422,9 +487,12 @@ void Pair::readLoop() {
       }
     }
     if (!rxInPayload_) {
-      char* hp = reinterpret_cast<char*>(&rxHeader_);
-      ssize_t n = read(fd_, hp + rxHeaderRead_,
-                       sizeof(WireHeader) - rxHeaderRead_);
+      const bool enc = keys_.encrypted;
+      const size_t hdrWant =
+          enc ? sizeof(rxHeaderCipher_) : sizeof(WireHeader);
+      char* hp = enc ? reinterpret_cast<char*>(rxHeaderCipher_)
+                     : reinterpret_cast<char*>(&rxHeader_);
+      ssize_t n = read(fd_, hp + rxHeaderRead_, hdrWant - rxHeaderRead_);
       if (n == 0) {
         bool orderly;
         {
@@ -453,8 +521,16 @@ void Pair::readLoop() {
       }
       rxHeaderRead_ += static_cast<size_t>(n);
       consumed += static_cast<size_t>(n);
-      if (rxHeaderRead_ < sizeof(WireHeader)) {
+      if (rxHeaderRead_ < hdrWant) {
         continue;
+      }
+      if (enc && !aeadOpen(keys_.rx, rxSeq_++, nullptr, 0, rxHeaderCipher_,
+                           sizeof(WireHeader),
+                           reinterpret_cast<uint8_t*>(&rxHeader_),
+                           rxHeaderCipher_ + sizeof(WireHeader))) {
+        fail(detail::strCat("message authentication failed from rank ",
+                            peerRank_));
+        return;
       }
       if (rxHeader_.magic != kMsgMagic) {
         fail(detail::strCat("protocol violation from rank ", peerRank_));
@@ -495,6 +571,7 @@ void Pair::readLoop() {
       }
       rxInPayload_ = true;
       rxPayloadRead_ = 0;
+      rxPlainDone_ = 0;
       if (match.direct) {
         rxIsStash_ = false;
         rxDest_ = match.dest;
@@ -506,8 +583,27 @@ void Pair::readLoop() {
         rxDest_ = rxStashData_.data();
       }
     } else {
-      ssize_t n = read(fd_, rxDest_ + rxPayloadRead_,
-                       rxHeader_.nbytes - rxPayloadRead_);
+      // Encrypted connections append a 16-byte tag after the payload
+      // ciphertext; the ciphertext itself lands in the final destination
+      // (user memory or stash) and is decrypted in place once complete.
+      // The destination is surfaced to the application only after the
+      // tag verifies, so a tamperer can at worst poison the pair.
+      const bool enc = keys_.encrypted;
+      const size_t frameLen =
+          enc ? std::min(kEncFrameBytes, rxHeader_.nbytes - rxPlainDone_)
+              : rxHeader_.nbytes;
+      const size_t frameTotal = frameLen + (enc ? kAeadTagBytes : 0);
+      char* dst;
+      size_t want;
+      if (rxPayloadRead_ < frameLen) {
+        dst = rxDest_ + rxPlainDone_ + rxPayloadRead_;
+        want = frameLen - rxPayloadRead_;
+      } else {
+        dst = reinterpret_cast<char*>(rxPayloadTag_) +
+              (rxPayloadRead_ - frameLen);
+        want = frameTotal - rxPayloadRead_;
+      }
+      ssize_t n = read(fd_, dst, want);
       if (n == 0) {
         fail(detail::strCat("connection to rank ", peerRank_,
                             " closed mid-message"));
@@ -525,7 +621,23 @@ void Pair::readLoop() {
       }
       rxPayloadRead_ += static_cast<size_t>(n);
       consumed += static_cast<size_t>(n);
-      if (rxPayloadRead_ == rxHeader_.nbytes) {
+      if (rxPayloadRead_ == frameTotal) {
+        if (enc) {
+          if (!aeadOpen(keys_.rx, rxSeq_++, nullptr, 0,
+                        reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
+                        frameLen,
+                        reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
+                        rxPayloadTag_)) {
+            fail(detail::strCat(
+                "message authentication failed from rank ", peerRank_));
+            return;
+          }
+          rxPlainDone_ += frameLen;
+          rxPayloadRead_ = 0;
+          if (rxPlainDone_ < rxHeader_.nbytes) {
+            continue;  // more frames of this message
+          }
+        }
         finishMessage();
       }
     }

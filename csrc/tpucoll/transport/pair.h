@@ -75,7 +75,8 @@ class Pair : public Handler {
   void handleEvents(uint32_t events) override;
 
   // Called by the listener (loop thread) when our inbound connection is up.
-  void assumeConnected(int fd);
+  // `keys` carries the connection's AEAD keys on encrypted devices.
+  void assumeConnected(int fd, const ConnKeys& keys = ConnKeys{});
 
   // Receiver-side flow control (called by Context under its own lock):
   // pause stops reading this pair's socket so TCP backpressure throttles a
@@ -91,11 +92,25 @@ class Pair : public Handler {
     const char* data;
     size_t nbytes;
     size_t dataSent{0};
+    // Encrypted framing: one sealed frame at a time (header frame, then
+    // payload frames of kEncFrameBytes), built lazily when the op FIRST
+    // starts transmitting so cancelled queued sends never consume a tx
+    // sequence number (a consumed-but-unsent seq would desynchronize the
+    // receiver's nonce counter). Framing bounds the staging buffer and
+    // overlaps sealing with socket writes.
+    std::vector<char> cipher;   // current frame (ciphertext + tag)
+    size_t cipherSent{0};
+    bool headerSealed{false};
+    size_t sealOffset{0};       // payload bytes sealed so far
   };
 
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
   // ops' buffers are appended to `completed` (callbacks run without mu_).
   void flushTx(std::vector<UnboundBuffer*>* completed);
+  // Seal the next frame (header, then payload chunks) into op->cipher,
+  // consuming one tx seq each (mu_ held).
+  void sealHeaderFrame(TxOp* op);
+  void sealPayloadFrame(TxOp* op);
   void updateEpollMask();  // mu_ held
   void readLoop();         // loop thread only
   // Consume a fully received message (loop thread).
@@ -131,6 +146,14 @@ class Pair : public Handler {
   std::string pendingTxError_;  // set by flushTx (mu_ held), drained by caller
   UnboundBuffer* rxUbuf_{nullptr};  // guarded by mu_ (cross-thread on fail)
 
+  // Connection cipher state. keys_ is written once before the pair is
+  // CONNECTED (handshake thread) and only read afterwards; the seq
+  // counters live on their owning threads (tx under mu_, rx on the loop
+  // thread).
+  ConnKeys keys_;
+  uint64_t txSeq_{0};
+  uint64_t rxSeq_{0};
+
   // rx state, loop thread only
   WireHeader rxHeader_{};
   size_t rxHeaderRead_{0};
@@ -138,7 +161,12 @@ class Pair : public Handler {
   char* rxDest_{nullptr};
   std::vector<char> rxStashData_;
   bool rxIsStash_{false};
-  size_t rxPayloadRead_{0};
+  size_t rxPayloadRead_{0};  // progress within the current frame
+  size_t rxPlainDone_{0};    // completed (verified) payload bytes
+  // Encrypted rx staging: ciphertext header+tag, and the payload tag that
+  // trails the in-place payload ciphertext.
+  uint8_t rxHeaderCipher_[sizeof(WireHeader) + kAeadTagBytes];
+  uint8_t rxPayloadTag_[kAeadTagBytes];
 };
 
 }  // namespace transport

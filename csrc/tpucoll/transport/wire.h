@@ -12,23 +12,77 @@
 #pragma once
 
 #include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "tpucoll/common/crypto.h"
 
 namespace tpucoll {
 namespace transport {
 
 constexpr uint32_t kMsgMagic = 0x7C011001;
 constexpr uint32_t kHelloMagic = 0x7C011002;
-// PSK-authenticated hello (the TLS-tier analog): the 16-byte hello with
-// this magic is followed by a mutual HMAC-SHA256 challenge/response —
+// PSK-authenticated hello: the 16-byte hello with this magic is followed
+// by a mutual HMAC-SHA256 challenge/response —
 //   initiator: nonceI[16]
 //   listener:  nonceL[16] || HMAC(key, "srv" || pairId || nonceI || nonceL)
 //   initiator: HMAC(key, "cli" || pairId || nonceI || nonceL)
 // Either side drops the connection on a tag mismatch, so only holders of
-// the pre-shared key can join the mesh.
+// the pre-shared key can join the mesh. NOTE: this magic provides JOIN
+// AUTHENTICATION ONLY — post-handshake traffic is plaintext with no
+// integrity protection. Untrusted networks want kHelloAuthEncMagic.
 constexpr uint32_t kHelloAuthMagic = 0x7C011003;
+// Same handshake, then the connection switches to encrypted framing (the
+// reference TLS tier's confidentiality+integrity, gloo/transport/tcp/
+// tls/pair.cc): per-connection ChaCha20-Poly1305 keys derived via
+// HKDF-SHA256 from the PSK and the handshake transcript. Every wire
+// message becomes sealed(header)+tag, then sealed(payload)+tag when a
+// payload follows; each seal consumes one per-direction sequence number
+// (the AEAD nonce), so reordering/replay/tampering all fail the tag and
+// poison the pair with an IoException.
+constexpr uint32_t kHelloAuthEncMagic = 0x7C011004;
 
 constexpr size_t kAuthNonceBytes = 16;
 constexpr size_t kAuthMacBytes = 32;
+// Encrypted payloads are sealed in frames of at most this many plaintext
+// bytes (each frame = ciphertext + 16-byte tag, one sequence number): it
+// bounds the sender's staging buffer, pipelines sealing with the socket
+// writes, and lets the receiver verify/deliver progressively. Both sides
+// derive the frame walk from the header's nbytes, so the size is part of
+// the wire protocol.
+constexpr size_t kEncFrameBytes = 256 * 1024;
+
+// Per-connection directional AEAD keys (encrypted == false for plaintext
+// connections; tx/rx then unused).
+struct ConnKeys {
+  bool encrypted{false};
+  AeadKey tx{};
+  AeadKey rx{};
+};
+
+// Derive the two directional keys from the PSK and the full handshake
+// transcript (pairId and both nonces), so a replayed transcript or a
+// different pair yields different keys.
+inline ConnKeys deriveConnKeys(const std::string& psk, uint64_t pairId,
+                               const uint8_t* nonceI, const uint8_t* nonceL,
+                               bool initiator) {
+  ConnKeys keys;
+  keys.encrypted = true;
+  uint8_t salt[sizeof(pairId) + 2 * kAuthNonceBytes];
+  std::memcpy(salt, &pairId, sizeof(pairId));
+  std::memcpy(salt + sizeof(pairId), nonceI, kAuthNonceBytes);
+  std::memcpy(salt + sizeof(pairId) + kAuthNonceBytes, nonceL,
+              kAuthNonceBytes);
+  uint8_t okm[2 * kAeadKeyBytes];
+  static constexpr char kInfo[] = "tpucoll-wire-v1";
+  hkdfSha256(psk.data(), psk.size(), salt, sizeof(salt), kInfo,
+             sizeof(kInfo) - 1, okm, sizeof(okm));
+  // okm[0:32] keys initiator->listener, okm[32:64] listener->initiator.
+  std::memcpy((initiator ? keys.tx : keys.rx).bytes, okm, kAeadKeyBytes);
+  std::memcpy((initiator ? keys.rx : keys.tx).bytes, okm + kAeadKeyBytes,
+              kAeadKeyBytes);
+  return keys;
+}
 
 enum class Opcode : uint8_t {
   kData = 1,

@@ -7,14 +7,32 @@ rendezvousing over a shared in-process HashStore through loopback TCP.
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 import gloo_tpu
 
 
+def _device_kwargs() -> dict:
+    """Env-selectable transport security tier, so the whole collective
+    suite can run authenticated/encrypted (TPUCOLL_TEST_AUTH_KEY=...,
+    TPUCOLL_TEST_ENCRYPT=1)."""
+    kwargs = {}
+    key = os.environ.get("TPUCOLL_TEST_AUTH_KEY")
+    if key:
+        kwargs["auth_key"] = key
+        kwargs["encrypt"] = os.environ.get("TPUCOLL_TEST_ENCRYPT") == "1"
+    elif os.environ.get("TPUCOLL_TEST_ENCRYPT"):
+        raise RuntimeError(
+            "TPUCOLL_TEST_ENCRYPT is set but TPUCOLL_TEST_AUTH_KEY is not "
+            "- the suite would silently run in plaintext")
+    return kwargs
+
+
 def spawn(size: int, fn: Callable, timeout: float = 30.0,
-          context_timeout: float = 15.0) -> List:
+          context_timeout: float = 15.0,
+          device_kwargs: Optional[dict] = None) -> List:
     """Run fn(ctx, rank) on `size` threads; returns per-rank results.
 
     The first exception raised by any rank is re-raised in the caller after
@@ -24,11 +42,13 @@ def spawn(size: int, fn: Callable, timeout: float = 30.0,
     results = [None] * size
     errors = []
     lock = threading.Lock()
+    dev_kwargs = (_device_kwargs() if device_kwargs is None
+                  else device_kwargs)
 
     def worker(rank: int) -> None:
         ctx = None
         try:
-            device = gloo_tpu.Device()
+            device = gloo_tpu.Device(**dev_kwargs)
             ctx = gloo_tpu.Context(rank, size, timeout=context_timeout)
             ctx.connect_full_mesh(store, device)
             results[rank] = fn(ctx, rank)
