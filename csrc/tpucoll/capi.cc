@@ -12,6 +12,7 @@
 
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/context.h"
+#include "tpucoll/transport/wire.h"
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/rendezvous/store.h"
@@ -532,6 +533,33 @@ int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
     }
   });
   return code != TC_OK ? code : rv;
+}
+
+size_t tc_remote_key_size() {
+  return sizeof(tpucoll::transport::WireRemoteKey);
+}
+
+int tc_buffer_remote_key(void* buf, char* out, size_t outLen) {
+  return wrap([&] {
+    auto key = asBuffer(buf)->getRemoteKey();
+    TC_ENFORCE_EQ(key.size(), outLen, "remote key buffer size mismatch");
+    std::memcpy(out, key.data(), key.size());
+  });
+}
+
+int tc_buffer_put(void* buf, const char* key, size_t keyLen, size_t offset,
+                  size_t roffset, size_t nbytes) {
+  return wrap([&] {
+    asBuffer(buf)->put(std::string(key, keyLen), offset, roffset, nbytes);
+  });
+}
+
+int tc_buffer_get(void* buf, const char* key, size_t keyLen, uint64_t slot,
+                  size_t offset, size_t roffset, size_t nbytes) {
+  return wrap([&] {
+    asBuffer(buf)->get(std::string(key, keyLen), slot, offset, roffset,
+                       nbytes);
+  });
 }
 
 void tc_buffer_abort_wait_send(void* buf) {

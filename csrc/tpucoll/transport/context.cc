@@ -1,5 +1,7 @@
 #include "tpucoll/transport/context.h"
 
+#include "tpucoll/transport/wire.h"
+
 #include <algorithm>
 #include <cstdlib>
 #include <cstring>
@@ -132,6 +134,107 @@ void Context::connectFullMesh(Store& store,
 std::unique_ptr<UnboundBuffer> Context::createUnboundBuffer(void* ptr,
                                                             size_t size) {
   return std::make_unique<UnboundBuffer>(this, ptr, size);
+}
+
+uint64_t Context::registerRegion(char* ptr, size_t size) {
+  std::lock_guard<std::mutex> guard(mu_);
+  const uint64_t token = nextRegionToken_++;
+  regions_[token] = Region{ptr, size};
+  return token;
+}
+
+void Context::unregisterRegion(uint64_t token) {
+  std::lock_guard<std::mutex> guard(mu_);
+  regions_.erase(token);
+}
+
+bool Context::readRegion(uint64_t token, uint64_t roffset, uint64_t nbytes,
+                         std::vector<char>* out) {
+  std::lock_guard<std::mutex> guard(mu_);
+  auto it = regions_.find(token);
+  if (it == regions_.end() || roffset > it->second.size ||
+      nbytes > it->second.size - roffset) {
+    return false;
+  }
+  out->assign(it->second.ptr + roffset, it->second.ptr + roffset + nbytes);
+  return true;
+}
+
+bool Context::writeRegion(uint64_t token, uint64_t roffset,
+                          const char* data, size_t nbytes) {
+  std::lock_guard<std::mutex> guard(mu_);
+  auto it = regions_.find(token);
+  if (it == regions_.end() || roffset > it->second.size ||
+      nbytes > it->second.size - roffset) {
+    return false;
+  }
+  std::memcpy(it->second.ptr + roffset, data, nbytes);
+  return true;
+}
+
+void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
+                      uint64_t roffset, char* data, size_t nbytes) {
+  TC_ENFORCE(dstRank >= 0 && dstRank < size_, "bad destination rank ",
+             dstRank);
+  if (dstRank == rank_) {
+    // Local put: straight into the registered region (one memcpy under
+    // the region lock, no staging copy).
+    buf->addPendingSend();
+    if (!writeRegion(token, roffset, data, nbytes)) {
+      buf->cancelPendingSend();
+      TC_THROW(EnforceError, "local put outside the registered region");
+    }
+    buf->onSendComplete();
+    return;
+  }
+  buf->addPendingSend();
+  Pair* pair = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_ || !pairErrors_[dstRank].empty()) {
+      buf->cancelPendingSend();
+      TC_THROW(IoException, "put to rank ", dstRank, ": ",
+               closed_ ? "context closed" : pairErrors_[dstRank].c_str());
+    }
+    pair = pairs_[dstRank].get();
+    TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
+  }
+  try {
+    pair->sendPut(buf, token, roffset, data, nbytes);
+  } catch (...) {
+    buf->cancelPendingSend();
+    throw;
+  }
+}
+
+void Context::postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
+                             uint64_t roffset, size_t nbytes) {
+  TC_ENFORCE(dstRank >= 0 && dstRank < size_, "bad source rank ", dstRank);
+  if (dstRank == rank_) {
+    // Local get: read the region, then deliver through the shared
+    // stash/posted matcher like any self-sourced message.
+    std::vector<char> data;
+    TC_ENFORCE(readRegion(token, roffset, nbytes, &data),
+               "local get outside the registered region");
+    stashArrived(rank_, respSlot, std::move(data));
+    return;
+  }
+  Pair* pair = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_ || !pairErrors_[dstRank].empty()) {
+      TC_THROW(IoException, "get from rank ", dstRank, ": ",
+               closed_ ? "context closed" : pairErrors_[dstRank].c_str());
+    }
+    pair = pairs_[dstRank].get();
+    TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
+  }
+  WireGetReq req{token, roffset, nbytes};
+  std::vector<char> payload(sizeof(req));
+  std::memcpy(payload.data(), &req, sizeof(req));
+  WireHeader header{kMsgMagic, static_cast<uint8_t>(Opcode::kGetReq),
+                    {0, 0, 0}, respSlot, sizeof(req), 0};
+  pair->sendOwned(header, std::move(payload));
 }
 
 void Context::close() {

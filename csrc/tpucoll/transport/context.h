@@ -16,6 +16,7 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #include "tpucoll/common/logging.h"
@@ -52,6 +53,22 @@ class Context {
 
   std::unique_ptr<UnboundBuffer> createUnboundBuffer(void* ptr, size_t size);
 
+  // ---- one-sided registered regions (RemoteKey put/get) ----
+  // Register [ptr, ptr+size) as a one-sided target; returns the token a
+  // serialized RemoteKey carries. Peers may then put into / get from the
+  // region with no posted operation on this side.
+  uint64_t registerRegion(char* ptr, size_t size);
+  void unregisterRegion(uint64_t token);
+  // Loop thread: validate + copy bytes out of a region (get). Empty
+  // optional-like: returns false when the token is unknown or the range
+  // is out of bounds.
+  bool readRegion(uint64_t token, uint64_t roffset, uint64_t nbytes,
+                  std::vector<char>* out);
+  // Loop thread: validate + copy bytes into a region (put). Returns false
+  // on unknown token / out-of-bounds (the caller poisons the pair).
+  bool writeRegion(uint64_t token, uint64_t roffset, const char* data,
+                   size_t nbytes);
+
   // Graceful teardown: closes all pairs; pending operations fail with
   // IoException. Idempotent.
   void close();
@@ -59,6 +76,14 @@ class Context {
   // ---- internal API (UnboundBuffer / Pair) ----
   void postSend(UnboundBuffer* buf, int dstRank, uint64_t slot, char* data,
                 size_t nbytes);
+  // One-sided write: local bytes -> peer's registered region (token,
+  // roffset). Completion via buf->waitSend; nothing happens peer-side.
+  void postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
+               uint64_t roffset, char* data, size_t nbytes);
+  // One-sided read: request region bytes from dstRank; they arrive as a
+  // normal message on respSlot (buf must have a recv posted for it).
+  void postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
+                      uint64_t roffset, size_t nbytes);
   void postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
                 uint64_t slot, char* dest, size_t nbytes);
   void cancelRecvsFor(UnboundBuffer* buf);
@@ -121,6 +146,15 @@ class Context {
   std::vector<char> rxPaused_;
   size_t stashHighWater_;
   bool closed_{false};
+
+  // One-sided region registry (mu_). Tokens are never reused, so a stale
+  // RemoteKey can only miss, not alias a new region.
+  struct Region {
+    char* ptr;
+    size_t size;
+  };
+  std::unordered_map<uint64_t, Region> regions_;
+  uint64_t nextRegionToken_{1};
 };
 
 }  // namespace transport

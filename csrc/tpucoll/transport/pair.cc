@@ -249,6 +249,37 @@ void Pair::waitConnected(std::chrono::milliseconds timeout) {
 
 void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
                 size_t nbytes) {
+  TxOp op;
+  op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
+                         {0, 0, 0}, slot, nbytes};
+  op.ubuf = ubuf;
+  op.data = data;
+  op.nbytes = nbytes;
+  enqueue(std::move(op));
+}
+
+void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
+                   const char* data, size_t nbytes) {
+  TxOp op;
+  op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kPut),
+                         {0, 0, 0}, token, nbytes, roffset};
+  op.ubuf = ubuf;
+  op.data = data;
+  op.nbytes = nbytes;
+  enqueue(std::move(op));
+}
+
+void Pair::sendOwned(WireHeader header, std::vector<char> payload) {
+  TxOp op;
+  op.header = header;
+  op.ubuf = nullptr;
+  op.nbytes = payload.size();
+  op.ownedData = std::move(payload);
+  op.data = nullptr;  // fixed up after the move into the queue
+  enqueue(std::move(op));
+}
+
+void Pair::enqueue(TxOp op) {
   std::vector<UnboundBuffer*> completed;
   std::string txError;
   {
@@ -260,13 +291,12 @@ void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
                : closing_          ? "is closing"
                                    : "is not connected");
     }
-    TxOp op;
-    op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
-                           {0, 0, 0}, slot, nbytes};
-    op.ubuf = ubuf;
-    op.data = data;
-    op.nbytes = nbytes;
-    tx_.push_back(op);
+    tx_.push_back(std::move(op));
+    if (tx_.back().data == nullptr && !tx_.back().ownedData.empty()) {
+      // Owned payloads must point into the queued op (deque elements are
+      // stable), not the moved-from local.
+      tx_.back().data = tx_.back().ownedData.data();
+    }
     if (tx_.size() == 1) {
       // Inline fast path: try to push the bytes out right here, skipping a
       // loop-thread wakeup when the socket has room (the common case).
@@ -545,6 +575,45 @@ void Pair::readLoop() {
         rxHeaderRead_ = 0;
         continue;
       }
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kPut)) {
+        // One-sided write: payload staged then copied into the registered
+        // region under the context lock (re-validated there, so a region
+        // torn down mid-flight cannot be scribbled on).
+        const size_t nbytes = rxHeader_.nbytes;
+        if (nbytes == 0) {
+          // Zero-byte puts still validate the token/offset: the same
+          // contract violation must not pass or fail based on length.
+          if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
+                                     nullptr, 0)) {
+            fail(detail::strCat("one-sided put outside registered region "
+                                "from rank ", peerRank_));
+            return;
+          }
+          rxHeaderRead_ = 0;
+          continue;
+        }
+        rxInPayload_ = true;
+        rxPayloadRead_ = 0;
+        rxPlainDone_ = 0;
+        rxMode_ = RxMode::kPut;
+        rxStashData_.resize(nbytes);
+        rxDest_ = rxStashData_.data();
+        continue;
+      }
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGetReq)) {
+        if (rxHeader_.nbytes != sizeof(WireGetReq)) {
+          fail(detail::strCat("malformed get request from rank ",
+                              peerRank_));
+          return;
+        }
+        rxInPayload_ = true;
+        rxPayloadRead_ = 0;
+        rxPlainDone_ = 0;
+        rxMode_ = RxMode::kGetReq;
+        rxStashData_.resize(sizeof(WireGetReq));
+        rxDest_ = rxStashData_.data();
+        continue;
+      }
       if (rxHeader_.opcode != static_cast<uint8_t>(Opcode::kData)) {
         fail(detail::strCat("protocol violation from rank ", peerRank_));
         return;
@@ -573,12 +642,12 @@ void Pair::readLoop() {
       rxPayloadRead_ = 0;
       rxPlainDone_ = 0;
       if (match.direct) {
-        rxIsStash_ = false;
+        rxMode_ = RxMode::kDirect;
         rxDest_ = match.dest;
         std::lock_guard<std::mutex> guard(mu_);
         rxUbuf_ = match.ubuf;
       } else {
-        rxIsStash_ = true;
+        rxMode_ = RxMode::kStash;
         rxStashData_.resize(nbytes);
         rxDest_ = rxStashData_.data();
       }
@@ -645,26 +714,68 @@ void Pair::readLoop() {
 }
 
 void Pair::finishMessage() {
-  if (rxIsStash_) {
-    try {
-      context_->stashArrived(peerRank_, rxHeader_.slot,
-                             std::move(rxStashData_));
-    } catch (const std::exception& e) {
-      fail(detail::strCat("receive matching failed: ", e.what()));
-      return;
+  switch (rxMode_) {
+    case RxMode::kStash:
+      try {
+        context_->stashArrived(peerRank_, rxHeader_.slot,
+                               std::move(rxStashData_));
+      } catch (const std::exception& e) {
+        fail(detail::strCat("receive matching failed: ", e.what()));
+        return;
+      }
+      rxStashData_ = std::vector<char>();
+      break;
+    case RxMode::kDirect: {
+      UnboundBuffer* b = nullptr;
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        b = rxUbuf_;
+        rxUbuf_ = nullptr;
+      }
+      if (b != nullptr) {
+        b->onRecvComplete(peerRank_);
+      }
+      break;
     }
-    rxStashData_ = std::vector<char>();
-  } else {
-    UnboundBuffer* b = nullptr;
-    {
-      std::lock_guard<std::mutex> guard(mu_);
-      b = rxUbuf_;
-      rxUbuf_ = nullptr;
-    }
-    if (b != nullptr) {
-      b->onRecvComplete(peerRank_);
+    case RxMode::kPut:
+      if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
+                                 rxStashData_.data(),
+                                 rxStashData_.size())) {
+        // Unknown token or out-of-bounds: a peer contract violation
+        // (bounds are validated sender-side against the RemoteKey, so
+        // only a stale key or a buggy/malicious peer lands here).
+        fail(detail::strCat("one-sided put outside registered region "
+                            "from rank ", peerRank_));
+        return;
+      }
+      rxStashData_ = std::vector<char>();
+      break;
+    case RxMode::kGetReq: {
+      WireGetReq req;
+      std::memcpy(&req, rxStashData_.data(), sizeof(req));
+      std::vector<char> data;
+      if (!context_->readRegion(req.token, req.roffset, req.nbytes,
+                                &data)) {
+        fail(detail::strCat("one-sided get outside registered region "
+                            "from rank ", peerRank_));
+        return;
+      }
+      // Respond with a plain data message on the requester's slot; the
+      // bytes were copied out under the region lock, so the response
+      // cannot race the exporting buffer's teardown.
+      WireHeader header{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
+                        {0, 0, 0}, rxHeader_.slot, data.size(), 0};
+      try {
+        sendOwned(header, std::move(data));
+      } catch (const std::exception&) {
+        // Pair already closing/failed: the requester's posted recv gets
+        // the pair error through the normal fan-out; nothing to unwind
+        // through the event loop here.
+      }
+      break;
     }
   }
+  rxMode_ = RxMode::kDirect;
   rxInPayload_ = false;
   rxHeaderRead_ = 0;
   rxDest_ = nullptr;

@@ -58,6 +58,14 @@ class Pair : public Handler {
   void send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
             size_t nbytes);
 
+  // One-sided write into the peer's registered region (kPut framing).
+  void sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
+               const char* data, size_t nbytes);
+
+  // Enqueue a message whose payload the op itself owns (get requests and
+  // get responses): no completion callback, safe from any thread.
+  void sendOwned(WireHeader header, std::vector<char> payload);
+
   // Remove queued sends for `ubuf` that have not started hitting the wire;
   // returns how many were dropped. A partially-written front op cannot be
   // cancelled (removing it would corrupt the stream framing).
@@ -102,11 +110,15 @@ class Pair : public Handler {
     size_t cipherSent{0};
     bool headerSealed{false};
     size_t sealOffset{0};       // payload bytes sealed so far
+    // Self-owned payload (get requests/responses): `data` points into it.
+    std::vector<char> ownedData;
   };
 
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
   // ops' buffers are appended to `completed` (callbacks run without mu_).
   void flushTx(std::vector<UnboundBuffer*>* completed);
+  // Shared enqueue path behind send/sendPut/sendOwned (acquires mu_).
+  void enqueue(TxOp op);
   // Seal the next frame (header, then payload chunks) into op->cipher,
   // consuming one tx seq each (mu_ held).
   void sealHeaderFrame(TxOp* op);
@@ -155,12 +167,13 @@ class Pair : public Handler {
   uint64_t rxSeq_{0};
 
   // rx state, loop thread only
+  enum class RxMode { kDirect, kStash, kPut, kGetReq };
   WireHeader rxHeader_{};
   size_t rxHeaderRead_{0};
   bool rxInPayload_{false};
   char* rxDest_{nullptr};
   std::vector<char> rxStashData_;
-  bool rxIsStash_{false};
+  RxMode rxMode_{RxMode::kDirect};
   size_t rxPayloadRead_{0};  // progress within the current frame
   size_t rxPlainDone_{0};    // completed (verified) payload bytes
   // Encrypted rx staging: ciphertext header+tag, and the payload tag that

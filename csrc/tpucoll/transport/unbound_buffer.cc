@@ -1,6 +1,9 @@
 #include "tpucoll/transport/unbound_buffer.h"
 
+#include <cstring>
+
 #include "tpucoll/transport/context.h"
+#include "tpucoll/transport/wire.h"
 
 namespace tpucoll {
 namespace transport {
@@ -11,6 +14,12 @@ UnboundBuffer::UnboundBuffer(Context* context, void* ptr, size_t size)
 }
 
 UnboundBuffer::~UnboundBuffer() {
+  // Revoke the one-sided registration first: later puts/gets against the
+  // region miss (peer contract violation), and in-flight ones already
+  // copied under the region lock.
+  if (regionToken_ != 0) {
+    context_->unregisterRegion(regionToken_);
+  }
   // Cancel operations that have not touched the wire yet, then drain
   // whatever is still in flight: the loop thread may hold raw pointers into
   // our memory until each op completes or the owning pair fails.
@@ -65,6 +74,62 @@ void UnboundBuffer::recv(const std::vector<int>& srcRanks, uint64_t slot,
   }
   context_->postRecv(this, srcRanks, slot,
                      static_cast<char*>(ptr_) + offset, nbytes);
+}
+
+namespace {
+
+WireRemoteKey parseRemoteKey(const std::string& blob) {
+  TC_ENFORCE_EQ(blob.size(), sizeof(WireRemoteKey), "bad remote key size");
+  WireRemoteKey key;
+  std::memcpy(&key, blob.data(), sizeof(key));
+  TC_ENFORCE_EQ(key.magic, kRemoteKeyMagic, "bad remote key magic");
+  return key;
+}
+
+}  // namespace
+
+std::string UnboundBuffer::getRemoteKey() {
+  if (regionToken_ == 0) {
+    regionToken_ =
+        context_->registerRegion(static_cast<char*>(ptr_), size_);
+  }
+  WireRemoteKey key{kRemoteKeyMagic, context_->rank(), regionToken_, size_};
+  return std::string(reinterpret_cast<const char*>(&key), sizeof(key));
+}
+
+void UnboundBuffer::put(const std::string& remoteKey, size_t offset,
+                        size_t roffset, size_t nbytes) {
+  const WireRemoteKey key = parseRemoteKey(remoteKey);
+  TC_ENFORCE(key.rank >= 0 && key.rank < context_->size(),
+             "remote key rank ", key.rank, " outside group of ",
+             context_->size());
+  TC_ENFORCE_LE(offset, size_, "put local offset out of bounds");
+  TC_ENFORCE_LE(nbytes, size_ - offset, "put out of local bounds");
+  TC_ENFORCE_LE(roffset, key.size, "put remote offset out of bounds");
+  TC_ENFORCE_LE(nbytes, key.size - roffset, "put out of remote bounds");
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortSend_ = false;
+  }
+  context_->postPut(this, key.rank, key.token, roffset,
+                    static_cast<char*>(ptr_) + offset, nbytes);
+}
+
+void UnboundBuffer::get(const std::string& remoteKey, uint64_t slot,
+                        size_t offset, size_t roffset, size_t nbytes) {
+  const WireRemoteKey key = parseRemoteKey(remoteKey);
+  TC_ENFORCE(key.rank >= 0 && key.rank < context_->size(),
+             "remote key rank ", key.rank, " outside group of ",
+             context_->size());
+  TC_ENFORCE_LE(offset, size_, "get local offset out of bounds");
+  TC_ENFORCE_LE(nbytes, size_ - offset, "get out of local bounds");
+  TC_ENFORCE_LE(roffset, key.size, "get remote offset out of bounds");
+  TC_ENFORCE_LE(nbytes, key.size - roffset, "get out of remote bounds");
+  // Issue the request first: if it throws, nothing is left pending. A
+  // response can never be lost to the ordering — early arrivals stash
+  // until the recv below posts (the eager protocol's normal path).
+  context_->postGetRequest(key.rank, slot, key.token, roffset, nbytes);
+  recv(key.rank, slot, offset, nbytes);
 }
 
 bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {

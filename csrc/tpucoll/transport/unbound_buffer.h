@@ -47,6 +47,27 @@ class UnboundBuffer {
   void recv(const std::vector<int>& srcRanks, uint64_t slot,
             size_t offset = 0, size_t nbytes = SIZE_MAX);
 
+  // ---- one-sided put/get (reference: transport/unbound_buffer.h:128-153
+  // + remote_key.h; DCN analog of the device plane's Pallas remote DMA) --
+
+  // Export this buffer as a one-sided target. The serialized key is
+  // exchangeable over any channel (typically allgathered); peers put/get
+  // against it with no posted operation on this side. The registration
+  // lives until this buffer is destroyed.
+  std::string getRemoteKey();
+
+  // One-sided write: local [offset, offset+nbytes) into the remote region
+  // [roffset, ...). Completion via waitSend; the target posts nothing.
+  void put(const std::string& remoteKey, size_t offset, size_t roffset,
+           size_t nbytes);
+
+  // One-sided read: remote region [roffset, roffset+nbytes) into local
+  // [offset, ...). Completion via waitRecv (the region bytes arrive as a
+  // normal message on `slot`, which must be unused by other traffic with
+  // that peer).
+  void get(const std::string& remoteKey, uint64_t slot, size_t offset,
+           size_t roffset, size_t nbytes);
+
   // Wait for one send to complete. Returns false if aborted. Throws
   // TimeoutException past the deadline, IoException on transport failure.
   bool waitSend(std::chrono::milliseconds timeout);
@@ -75,6 +96,7 @@ class UnboundBuffer {
   Context* const context_;
   void* const ptr_;
   const size_t size_;
+  uint64_t regionToken_{0};  // nonzero once exported via getRemoteKey
 
   std::mutex mu_;
   std::condition_variable cv_;

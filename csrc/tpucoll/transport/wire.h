@@ -93,6 +93,19 @@ enum class Opcode : uint8_t {
   // also guarantees no in-flight payload is lost to a TCP reset when ranks
   // finish a collective at different times.
   kGoodbye = 2,
+  // One-sided write into a registered region (reference capability:
+  // transport/unbound_buffer.h:134-141 put over ibverbs RDMA_WRITE).
+  // slot = region token, aux = remote offset; the payload lands directly
+  // in the target's registered memory with NO posted receive and no
+  // target-side completion — bounds are validated against the
+  // registration and violations poison the pair.
+  kPut = 3,
+  // One-sided read request (reference: unbound_buffer.h:143-152 get over
+  // RDMA_READ). slot = the requester's response slot; the 24-byte payload
+  // is {u64 token, u64 roffset, u64 nbytes}. The target responds with a
+  // normal kData message carrying region bytes on the response slot, so
+  // the response rides the ordinary matching path.
+  kGetReq = 4,
 };
 
 #pragma pack(push, 1)
@@ -102,7 +115,26 @@ struct WireHeader {
   uint8_t reserved[3];
   uint64_t slot;
   uint64_t nbytes;
+  uint64_t aux;  // kPut: remote offset; others: 0
 };
+
+// Payload of a kGetReq message.
+struct WireGetReq {
+  uint64_t token;
+  uint64_t roffset;
+  uint64_t nbytes;
+};
+
+// Serialized RemoteKey: the byte-exchangeable descriptor of a registered
+// region (reference: transport/remote_key.h:8-18 {rank, size} plus the
+// transport-specific addressing — here a per-context token).
+struct WireRemoteKey {
+  uint32_t magic;  // kRemoteKeyMagic
+  int32_t rank;
+  uint64_t token;
+  uint64_t size;
+};
+constexpr uint32_t kRemoteKeyMagic = 0x7C011005;
 
 // First bytes an initiator writes after TCP connect: routes the fresh
 // connection to the listener-side Pair expecting it.
@@ -113,8 +145,10 @@ struct WireHello {
 };
 #pragma pack(pop)
 
-static_assert(sizeof(WireHeader) == 24, "wire header must be packed");
+static_assert(sizeof(WireHeader) == 32, "wire header must be packed");
 static_assert(sizeof(WireHello) == 16, "wire hello must be packed");
+static_assert(sizeof(WireGetReq) == 24, "get request must be packed");
+static_assert(sizeof(WireRemoteKey) == 24, "remote key must be packed");
 
 }  // namespace transport
 }  // namespace tpucoll

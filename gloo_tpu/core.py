@@ -329,6 +329,40 @@ class UnboundBuffer:
     def abort_wait_recv(self) -> None:
         _lib.lib.tc_buffer_abort_wait_recv(self._handle)
 
+    # ---- one-sided put/get (reference: gloo transport RemoteKey) ----
+
+    def get_remote_key(self) -> bytes:
+        """Export this buffer as a one-sided target. The returned bytes
+        are exchangeable over any channel (typically allgathered); peers
+        put()/get() against them with no posted operation on this side.
+        The registration lives as long as this buffer."""
+        n = _lib.lib.tc_remote_key_size()
+        out = ctypes.create_string_buffer(n)
+        check(_lib.lib.tc_buffer_remote_key(self._handle, out, n))
+        return out.raw
+
+    def put(self, remote_key: bytes, offset: int = 0, roffset: int = 0,
+            nbytes: Optional[int] = None) -> None:
+        """One-sided write: local [offset, offset+nbytes) into the remote
+        region at roffset. Completion via wait_send; the target posts
+        nothing. Bounds are validated against the key synchronously."""
+        if nbytes is None:
+            nbytes = self._array.nbytes - offset
+        check(_lib.lib.tc_buffer_put(self._handle, remote_key,
+                                     len(remote_key), offset, roffset,
+                                     nbytes))
+
+    def get(self, remote_key: bytes, slot: int, offset: int = 0,
+            roffset: int = 0, nbytes: Optional[int] = None) -> None:
+        """One-sided read: remote region [roffset, roffset+nbytes) into
+        local [offset, ...). Completion via wait_recv; `slot` must not be
+        used by other traffic with that peer."""
+        if nbytes is None:
+            nbytes = self._array.nbytes - offset
+        check(_lib.lib.tc_buffer_get(self._handle, remote_key,
+                                     len(remote_key), slot, offset, roffset,
+                                     nbytes))
+
 
 class Context:
     """A connected process group: collectives + point-to-point messaging.

@@ -1,0 +1,220 @@
+"""Host-plane one-sided put/get over serialized RemoteKeys.
+
+Port of the reference's remote-key scenarios
+(gloo/test/remote_key_test.cc:62-164: Get, Put, and bounds rejection)
+onto this transport: keys are allgathered, gets pull every peer's region,
+puts scatter one byte into every peer's region with no posted receive on
+the target, and out-of-bounds put/get raise synchronously. Runs in
+threads (mode 1) and across real processes (mode 2), plaintext and
+encrypted.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from tests.harness import spawn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _exchange_keys(ctx, key: bytes):
+    mine = np.frombuffer(key, dtype=np.uint8).copy()
+    all_keys = ctx.allgather(mine)
+    return [all_keys[r].tobytes() for r in range(ctx.size)]
+
+
+@pytest.mark.parametrize("data_size", [1, 1024, 1000000])
+@pytest.mark.parametrize("size", [2, 4])
+def test_get(size, data_size):
+    """Reference Get scenario: every rank pulls every peer's region."""
+
+    def fn(ctx, rank):
+        shared = np.full(data_size, rank, dtype=np.uint8)
+        shared_buf = ctx.register(shared)
+        local = np.zeros(data_size, dtype=np.uint8)
+        local_buf = ctx.register(local)
+        keys = _exchange_keys(ctx, shared_buf.get_remote_key())
+        for i in range(ctx.size):
+            if i == rank:
+                continue
+            local_buf.get(keys[i], slot=ctx.next_slot(), offset=0,
+                          roffset=0, nbytes=data_size)
+            local_buf.wait_recv()
+            assert (local == i).all(), f"get from {i} corrupted"
+        ctx.barrier()
+        return True
+
+    assert all(spawn(size, fn))
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_put(size):
+    """Reference Put scenario: rank r writes byte r at position r of every
+    peer's exported region; targets post nothing."""
+
+    def fn(ctx, rank):
+        export = np.zeros(ctx.size, dtype=np.uint8)
+        export_buf = ctx.register(export)
+        local = np.full(ctx.size, rank, dtype=np.uint8)
+        local_buf = ctx.register(local)
+        keys = _exchange_keys(ctx, export_buf.get_remote_key())
+        for i in range(ctx.size):
+            if i == rank:
+                continue
+            local_buf.put(keys[i], offset=rank, roffset=rank, nbytes=1)
+            local_buf.wait_send()
+        ctx.barrier()
+        # One-sided delivery is not ordered with the barrier message on
+        # OTHER pairs, so poll briefly for the last writes.
+        import time
+        deadline = time.monotonic() + 5.0
+        want = np.arange(ctx.size, dtype=np.uint8)
+        want[rank] = 0
+        while time.monotonic() < deadline:
+            if all(export[j] == j for j in range(ctx.size) if j != rank):
+                return True
+            time.sleep(0.01)
+        raise AssertionError(f"puts not delivered: {export}")
+
+    assert all(spawn(size, fn))
+
+
+def test_bounds_rejected():
+    """Reference bounds checks: oversized offset/roffset/nbytes raise
+    synchronously, before anything hits the wire."""
+
+    def fn(ctx, rank):
+        shared = np.zeros(128, dtype=np.uint8)
+        shared_buf = ctx.register(shared)
+        local = np.zeros(128, dtype=np.uint8)
+        local_buf = ctx.register(local)
+        keys = _exchange_keys(ctx, shared_buf.get_remote_key())
+        peer = (rank + 1) % ctx.size
+        for kwargs in ({"offset": 1_000_000_000, "nbytes": 1},
+                       {"roffset": 1_000_000_000, "nbytes": 1},
+                       {"nbytes": 1_000_000_000}):
+            with pytest.raises(gloo_tpu.Error):
+                local_buf.get(keys[peer], slot=ctx.next_slot(), **kwargs)
+            with pytest.raises(gloo_tpu.Error):
+                local_buf.put(keys[peer], **kwargs)
+        ctx.barrier()
+        return True
+
+    assert all(spawn(2, fn))
+
+
+def test_self_put_get():
+    """Local put/get against a rank's own region short-circuits."""
+
+    def fn(ctx, rank):
+        region = np.zeros(16, dtype=np.uint8)
+        region_buf = ctx.register(region)
+        key = region_buf.get_remote_key()
+        local = np.arange(16, dtype=np.uint8)
+        local_buf = ctx.register(local)
+        local_buf.put(key, offset=0, roffset=0, nbytes=16)
+        local_buf.wait_send()
+        assert (region == np.arange(16)).all()
+        back = np.zeros(16, dtype=np.uint8)
+        back_buf = ctx.register(back)
+        back_buf.get(key, slot=ctx.next_slot(), nbytes=16)
+        back_buf.wait_recv()
+        assert (back == np.arange(16)).all()
+        return True
+
+    assert all(spawn(2, fn))
+
+
+def test_get_encrypted():
+    """One-sided reads ride the encrypted framing unchanged."""
+
+    def fn(ctx, rank):
+        shared = np.full(4096, rank + 10, dtype=np.uint8)
+        shared_buf = ctx.register(shared)
+        local = np.zeros(4096, dtype=np.uint8)
+        local_buf = ctx.register(local)
+        keys = _exchange_keys(ctx, shared_buf.get_remote_key())
+        peer = (rank + 1) % ctx.size
+        local_buf.get(keys[peer], slot=ctx.next_slot(), nbytes=4096)
+        local_buf.wait_recv()
+        assert (local == peer + 10).all()
+        ctx.barrier()
+        return True
+
+    assert all(spawn(2, fn,
+                     device_kwargs={"auth_key": "rk", "encrypt": True}))
+
+
+def test_put_get_across_processes():
+    """Mode 2: the full get+put dance across real OS processes."""
+    store = tempfile.mkdtemp()
+    size = 3
+
+    def worker(rank):
+        prog = textwrap.dedent("""
+            import sys, time
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            import gloo_tpu
+
+            rank = {rank}; size = {size}
+            store = gloo_tpu.FileStore({store!r})
+            ctx = gloo_tpu.Context(rank, size, timeout=15.0)
+            ctx.connect_full_mesh(store, gloo_tpu.Device())
+
+            shared = np.full(65536, rank, dtype=np.uint8)
+            shared_buf = ctx.register(shared)
+            export = np.zeros(size, dtype=np.uint8)
+            export_buf = ctx.register(export)
+            k1 = np.frombuffer(shared_buf.get_remote_key(),
+                               np.uint8).copy()
+            k2 = np.frombuffer(export_buf.get_remote_key(),
+                               np.uint8).copy()
+            keys1 = ctx.allgather(k1)
+            keys2 = ctx.allgather(k2)
+
+            local = np.zeros(65536, dtype=np.uint8)
+            local_buf = ctx.register(local)
+            for i in range(size):
+                if i == rank:
+                    continue
+                local_buf.get(keys1[i].tobytes(), slot=ctx.next_slot(),
+                              nbytes=65536)
+                local_buf.wait_recv()
+                assert (local == i).all(), f"get from {{i}}"
+
+            mine = np.full(size, rank, dtype=np.uint8)
+            mine_buf = ctx.register(mine)
+            for i in range(size):
+                if i == rank:
+                    continue
+                mine_buf.put(keys2[i].tobytes(), offset=rank,
+                             roffset=rank, nbytes=1)
+                mine_buf.wait_send()
+            ctx.barrier()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if all(export[j] == j for j in range(size) if j != rank):
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError(f"puts missing: {{export}}")
+            ctx.close()
+            print("OK")
+        """).format(repo=_REPO, rank=rank, size=size, store=store)
+        return subprocess.Popen([sys.executable, "-c", prog],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    procs = [worker(r) for r in range(size)]
+    outs = [p.communicate(timeout=90) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, (out, err)
+        assert "OK" in out
