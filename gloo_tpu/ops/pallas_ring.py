@@ -258,16 +258,18 @@ def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
     def chunk_slice(idx):
         return pl.ds(idx * chunk_rows, chunk_rows)
 
-    def rs_step(s, _):
+    # Send/receive are decoupled so the outgoing chunk's ICI transfer
+    # flies while the received chunk streams through VMEM: each step
+    # starts its send, then waits only for the INCOMING chunk before
+    # reducing (a ring step's send reads the chunk reduced in the
+    # previous step, so the send itself can never start earlier). Send
+    # completions are drained two steps late, when their semaphore slot
+    # is about to be reused — descriptors are reconstructed to wait; the
+    # semaphores carry the state.
+    def rs_rdma(s):
         send_chunk = lax.rem(my - s + n, n)
-        recv_chunk = lax.rem(my - s - 1 + n, n)
         slot = lax.rem(s, 2)
-
-        @pl.when(s >= 2)
-        def _():
-            pltpu.semaphore_wait(ack_sem.at[slot], 1)
-
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=o_ref.at[chunk_slice(send_chunk)],
             dst_ref=comm_ref.at[slot],
             send_sem=rs_send.at[slot],
@@ -275,8 +277,21 @@ def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def rs_step(s, _):
+        recv_chunk = lax.rem(my - s - 1 + n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            # Slot reuse gates: the receiver freed our comm slot, and the
+            # send that last used send_sem[slot] has fully left the chip.
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+            rs_rdma(s - 2).wait_send()
+
+        rdma = rs_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
 
         # Stream-reduce the received chunk: HBM tiles through VMEM,
         # double-buffered — tile t+1's loads overlap tile t's VPU add and
@@ -338,17 +353,20 @@ def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
 
     lax.fori_loop(0, n - 1, rs_step, 0)
 
+    # Drain the deferred RS send completions and the final acks.
     @pl.when(n >= 3)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+        rs_rdma(n - 3).wait_send()
 
     @pl.when(n >= 2)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+        rs_rdma(n - 2).wait_send()
 
-    def ag_step(s, _):
+    def ag_rdma(s):
         send_chunk = lax.rem(my + 1 - s + n, n)
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=o_ref.at[chunk_slice(send_chunk)],
             dst_ref=o_ref.at[chunk_slice(send_chunk)],
             send_sem=ag_send.at[s],
@@ -356,11 +374,22 @@ def _ring_allreduce_hbm_kernel(x_ref, o_ref, comm_ref, acc_vmem, in_vmem,
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def ag_step(s, _):
+        # Wait only for the incoming chunk (the next send depends on it);
+        # per-step semaphores let every send completion drain at the end.
+        rdma = ag_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def ag_drain(s, _):
+        ag_rdma(s).wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
 
 
 @functools.partial(jax.jit,
@@ -372,11 +401,16 @@ def _ring_allreduce_hbm_shard(x, *, axis_name: str, collective_id: int,
     rows, cols = x.shape
     assert rows % n == 0, f"rows {rows} not divisible by ring size {n}"
     chunk_rows = rows // n
-    # Stream tile: at most 256 rows per VMEM buffer; chunk must tile evenly.
-    if chunk_rows % 256 == 0:
-        tile_rows = 256
-    else:
-        tile_rows = chunk_rows  # small chunk: single tile
+    # Stream tile: the largest divisor of the chunk that is a multiple of
+    # 8 (sublane granularity) and at most 256 rows per VMEM buffer. Any
+    # multiple-of-8 chunk therefore streams (odd tile counts included);
+    # only chunks that are not multiples of 8 fall back to a single tile.
+    tile_rows = chunk_rows
+    if chunk_rows > 256 and chunk_rows % 8 == 0:
+        for cand in range(256, 7, -8):
+            if chunk_rows % cand == 0:
+                tile_rows = cand
+                break
     kernel = functools.partial(_ring_allreduce_hbm_kernel,
                                axis_name=axis_name, num_devices=n,
                                chunk_rows=chunk_rows, tile_rows=tile_rows)
@@ -415,9 +449,11 @@ def ring_allreduce_hbm(x, axis_name: str, collective_id: int = 8,
                        interpret: bool = False):
     """Sum-allreduce for shards too large for VMEM: ring buffers live in
     HBM, remote DMA moves chunks chip-to-chip, and the reduction streams
-    through VMEM in 256-row tiles. Requirements: rows % ring_size == 0 and
-    the per-chunk rows either divisible by 256 or small enough to be a
-    single tile."""
+    through VMEM in tiles of up to 256 rows while the NEXT chunk's ICI
+    transfer is already in flight (chunk-level double buffering).
+    Requirements: rows % ring_size == 0; per-chunk rows that are a
+    multiple of 8 stream tiled (any tile count), others fall back to a
+    single whole-chunk tile."""
     return _differentiable(_ring_allreduce_hbm_shard, x, axis_name,
                             collective_id, interpret)
 

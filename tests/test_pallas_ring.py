@@ -68,7 +68,13 @@ def test_ring_allreduce_bfloat16():
         np.testing.assert_allclose(out[i], expected, rtol=1e-2)
 
 
-@pytest.mark.parametrize("n,per_rows", [(2, 16), (3, 24), (2, 1024), (4, 32)])
+@pytest.mark.parametrize("n,per_rows", [
+    (2, 16), (3, 24), (2, 1024), (4, 32),
+    # Odd tile counts through the double-buffered stream (chunk = per
+    # rows / n): chunk 264 = 3 tiles of 88, chunk 520 = 5 tiles of 104
+    # (largest <=256 multiple-of-8 divisors).
+    (2, 528), (3, 792), (2, 1040),
+])
 def test_hbm_ring_allreduce(n, per_rows):
     """HBM-streaming variant: buffers in HBM, tiled VMEM reduction
     (per_rows=1024 exercises the multi-tile stream path)."""
