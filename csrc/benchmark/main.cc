@@ -20,6 +20,7 @@
 #include <memory>
 #include <numeric>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "tpucoll/collectives/collectives.h"
@@ -48,6 +49,10 @@ struct Options {
   uint32_t tagBase = 0;
   std::string authKey;
   bool encrypt = false;
+  int threads = 1;          // benchmark threads, each on a forked context
+  int inputs = 1;           // input buffers per rank (allreduce)
+  std::string dtype = "f32";  // allreduce payload: f32 | f16 | bf16
+  std::string iface;        // bind device by interface name
 };
 
 void usage() {
@@ -59,7 +64,9 @@ void usage() {
           "   sendrecv_roundtrip]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
-          "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n");
+          "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n"
+          "  [--threads N] [--inputs N] [--dtype f32|f16|bf16] "
+          "[--iface NAME]\n");
 }
 
 std::vector<size_t> parseElements(const std::string& arg) {
@@ -114,6 +121,16 @@ Options parse(int argc, char** argv) {
       o.authKey = next();
     } else if (a == "--encrypt") {
       o.encrypt = true;
+    } else if (a == "--threads") {
+      o.threads = std::max(1, std::stoi(next()));
+    } else if (a == "--inputs") {
+      o.inputs = std::max(1, std::stoi(next()));
+    } else if (a == "--dtype") {
+      o.dtype = next();
+      TC_ENFORCE(o.dtype == "f32" || o.dtype == "f16" || o.dtype == "bf16",
+                 "--dtype must be f32|f16|bf16, got ", o.dtype);
+    } else if (a == "--iface") {
+      o.iface = next();
     } else {
       usage();
       TC_THROW(tpucoll::EnforceError, "unknown argument ", a);
@@ -163,22 +180,102 @@ struct Workload {
   size_t algBytes;
 };
 
+// Per-workload buffer storage: lives at the call site for the workload's
+// lifetime (the lambdas capture views into it).
+struct Buffers {
+  std::vector<float> buf, out;
+  std::vector<uint16_t> half;                 // f16/bf16 payload
+  std::vector<std::vector<float>> extraF32;   // --inputs > 1
+  std::vector<std::vector<uint16_t>> extraHalf;
+};
+
+tpucoll::AllreduceAlgorithm parseAllreduceAlgorithm(const std::string& a) {
+  using tpucoll::AllreduceAlgorithm;
+  return a == "ring"             ? AllreduceAlgorithm::kRing
+         : a == "bcube"          ? AllreduceAlgorithm::kBcube
+         : a == "ring_bf16_wire" ? AllreduceAlgorithm::kRingBf16Wire
+         : (a == "hd" || a == "halving_doubling")
+             ? AllreduceAlgorithm::kHalvingDoubling
+             : AllreduceAlgorithm::kAuto;
+}
+
 Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
-                      size_t elements, uint32_t tag, std::vector<float>& buf,
-                      std::vector<float>& out) {
+                      size_t elements, uint32_t tag, Buffers& bufs) {
   using namespace tpucoll;
+  // --dtype and --inputs shape only the allreduce payload; refusing the
+  // combination beats emitting a mislabeled measurement row.
+  TC_ENFORCE(o.op == "allreduce" || (o.dtype == "f32" && o.inputs == 1),
+             "--dtype/--inputs apply to --op allreduce only");
+  std::vector<float>& buf = bufs.buf;
+  std::vector<float>& out = bufs.out;
   const int rank = ctx.rank();
   const int size = ctx.size();
   Workload w;
   w.algBytes = elements * sizeof(float);
 
-  auto algo = o.algorithm == "ring"    ? AllreduceAlgorithm::kRing
-              : o.algorithm == "bcube" ? AllreduceAlgorithm::kBcube
-              : o.algorithm == "ring_bf16_wire"
-                  ? AllreduceAlgorithm::kRingBf16Wire
-              : (o.algorithm == "hd" || o.algorithm == "halving_doubling")
-                  ? AllreduceAlgorithm::kHalvingDoubling
-                  : AllreduceAlgorithm::kAuto;
+  if (o.op == "allreduce" && o.dtype != "f32") {
+    // Half-precision payloads (reference: benchmark/options.h fp16 knob):
+    // the SIMD f16/bf16 reduction kernels run on the wire-facing path.
+    const DataType dt =
+        o.dtype == "f16" ? DataType::kFloat16 : DataType::kBFloat16;
+    auto enc = [dt](float v) {
+      return dt == DataType::kFloat16 ? floatToHalf(v) : floatToBfloat16(v);
+    };
+    auto dec = [dt](uint16_t v) {
+      return dt == DataType::kFloat16 ? halfToFloat(v) : bfloat16ToFloat(v);
+    };
+    w.algBytes = elements * sizeof(uint16_t);
+    bufs.half.assign(elements, enc(1.f));
+    bufs.extraHalf.assign(o.inputs - 1,
+                          std::vector<uint16_t>(elements, enc(1.f)));
+    // The bf16-wire codec compresses f32 payloads; with a half payload
+    // it is contradictory.
+    TC_ENFORCE(o.algorithm != "ring_bf16_wire",
+               "--dtype f16/bf16 cannot combine with ring_bf16_wire");
+    const auto algo = parseAllreduceAlgorithm(o.algorithm);
+    auto* bp = &bufs;
+    std::function<void()> run = [&ctx, bp, tag, dt, algo] {
+      AllreduceOptions opts;
+      opts.context = &ctx;
+      opts.tag = tag;
+      opts.inputs = {bp->half.data()};
+      for (auto& v : bp->extraHalf) {
+        opts.inputs.push_back(v.data());
+      }
+      opts.outputs = {bp->half.data()};
+      opts.count = bp->half.size();
+      opts.dtype = dt;
+      opts.algorithm = algo;
+      allreduce(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, bp, rank, size, enc, dec, inputs = o.inputs] {
+      for (auto& v : bp->half) {
+        v = enc(float(rank + 1));
+      }
+      for (auto& vec : bp->extraHalf) {
+        vec.assign(vec.size(), enc(float(rank + 1)));
+      }
+      run();
+      // Small integer sums are exact in both half formats.
+      const float expect = inputs * size * (size + 1) / 2.0f;
+      for (auto v : bp->half) {
+        if (dec(v) != expect) {
+          return false;
+        }
+      }
+      for (auto& v : bp->half) {
+        v = enc(1.f);
+      }
+      for (auto& vec : bp->extraHalf) {
+        vec.assign(vec.size(), enc(1.f));
+      }
+      return true;
+    };
+    return w;
+  }
+
+  auto algo = parseAllreduceAlgorithm(o.algorithm);
   // NOTE: lambdas capture buf/out/ctx by reference (owned by the caller for
   // the workload's lifetime) and everything else by value — run/verifyOnce
   // outlive this frame.
@@ -186,26 +283,37 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
 
   if (o.op == "allreduce") {
     buf.assign(elements, 0.f);
-    std::function<void()> run = [ctxp, &buf, tag, algo] {
+    bufs.extraF32.assign(o.inputs - 1, std::vector<float>(elements, 1.f));
+    auto* bp = &bufs;
+    std::function<void()> run = [ctxp, bp, tag, algo] {
       AllreduceOptions opts;
       opts.context = ctxp;
       opts.tag = tag;
-      opts.inputs = {buf.data()};
-      opts.outputs = {buf.data()};
-      opts.count = buf.size();
+      opts.inputs = {bp->buf.data()};
+      for (auto& v : bp->extraF32) {
+        opts.inputs.push_back(v.data());
+      }
+      opts.outputs = {bp->buf.data()};
+      opts.count = bp->buf.size();
       opts.algorithm = algo;
       allreduce(opts);
     };
     w.run = run;
-    w.verifyOnce = [run, &buf, rank, size] {
-      for (auto& v : buf) {
+    w.verifyOnce = [run, bp, rank, size, inputs = o.inputs] {
+      for (auto& v : bp->buf) {
         v = float(rank + 1);
       }
+      for (auto& vec : bp->extraF32) {
+        vec.assign(vec.size(), float(rank + 1));
+      }
       run();
-      const float expect = size * (size + 1) / 2.0f;
-      bool ok = std::all_of(buf.begin(), buf.end(),
+      const float expect = inputs * size * (size + 1) / 2.0f;
+      bool ok = std::all_of(bp->buf.begin(), bp->buf.end(),
                             [&](float v) { return v == expect; });
-      std::fill(buf.begin(), buf.end(), 1.f);
+      std::fill(bp->buf.begin(), bp->buf.end(), 1.f);
+      for (auto& vec : bp->extraF32) {
+        vec.assign(vec.size(), 1.f);
+      }
       return ok;
     };
   } else if (o.op == "allgather") {
@@ -504,11 +612,27 @@ int runBench(int argc, char** argv) {
 
   tpucoll::transport::DeviceAttr attr;
   attr.hostname = o.host;
+  attr.iface = o.iface;
   attr.authKey = o.authKey;
   attr.encrypt = o.encrypt;
   auto device = std::make_shared<tpucoll::transport::Device>(attr);
   tpucoll::Context ctx(o.rank, o.size);
   ctx.connectFullMesh(store, device);
+
+  // --threads: each benchmark thread drives its own context, forked from
+  // the connected mesh without another store round trip (reference:
+  // ContextFactory per thread, gloo/benchmark/runner.cc:286-288).
+  std::vector<std::unique_ptr<tpucoll::Context>> forked;
+  std::vector<tpucoll::Context*> tctxs{&ctx};
+  for (int t = 1; t < o.threads; t++) {
+    auto c = std::make_unique<tpucoll::Context>(o.rank, o.size);
+    // forkFrom consumes TWO tags on the parent (blob allgatherv +
+    // length allgather), so stride by 2 to keep forks from
+    // cross-matching at skewed boundaries.
+    c->forkFrom(ctx, 0xFFF000u + 2 * t);
+    tctxs.push_back(c.get());
+    forked.push_back(std::move(c));
+  }
 
   if (o.rank == 0 && !o.json) {
     printf("# tpucoll_bench op=%s algorithm=%s size=%d device=%s\n",
@@ -521,76 +645,110 @@ int runBench(int argc, char** argv) {
 
   uint32_t tag = o.tagBase;
   for (size_t elements : o.elements) {
-    std::vector<float> buf, out;
     // One tag per sweep point: ranks can be a whole call skewed at the
     // boundary between points, and collectives of different shapes must
     // not cross-match (same contract as the reference's tag semantics).
-    Workload w = makeWorkload(o, ctx, elements, tag++, buf, out);
+    const uint32_t pointTag = tag;
+    tag += 2;
 
-    if (o.verify) {
-      TC_ENFORCE(w.verifyOnce(), "verification failed for ", o.op, " at ",
-                 elements, " elements");
-    }
-    double warmupP50 = 0;
-    {
-      std::vector<double> wsamples;
-      for (int i = 0; i < o.warmup; i++) {
+    std::vector<std::vector<double>> allSamples(o.threads);
+    size_t algBytes = 0;
+
+    auto worker = [&](int t) {
+      tpucoll::Context& c = *tctxs[t];
+      Buffers bufs;
+      Workload w = makeWorkload(o, c, elements, pointTag, bufs);
+      if (t == 0) {
+        algBytes = w.algBytes;  // identical across threads; single writer
+      }
+
+      if (o.verify && t == 0) {
+        TC_ENFORCE(w.verifyOnce(), "verification failed for ", o.op,
+                   " at ", elements, " elements");
+      }
+      double warmupP50 = 0;
+      {
+        std::vector<double> wsamples;
+        for (int i = 0; i < o.warmup; i++) {
+          const auto t0 = Clock::now();
+          w.run();
+          wsamples.push_back(
+              std::chrono::duration<double>(Clock::now() - t0).count());
+        }
+        std::sort(wsamples.begin(), wsamples.end());
+        warmupP50 = wsamples[wsamples.size() / 2];
+      }
+
+      // Agree on an iteration count (reference: median time broadcast,
+      // gloo/benchmark/runner.cc:322-330) so no rank leaves the sweep
+      // point before its peers — per thread-context, since each forms
+      // its own lockstep group. Capped: percentile quality does not
+      // improve past a few tens of thousands of samples.
+      uint64_t iters = std::min<uint64_t>(
+          50000, std::max<uint64_t>(1, uint64_t(o.minSeconds / warmupP50)));
+      {
+        BroadcastOptions opts;
+        opts.context = &c;
+        opts.tag = pointTag + 1;
+        opts.buffer = &iters;
+        opts.count = 1;
+        opts.dtype = DataType::kUint64;
+        broadcast(opts);
+      }
+
+      auto& samples = allSamples[t];
+      samples.reserve(iters);
+      for (uint64_t i = 0; i < iters; i++) {
         const auto t0 = Clock::now();
         w.run();
-        wsamples.push_back(
+        samples.push_back(
             std::chrono::duration<double>(Clock::now() - t0).count());
       }
-      std::sort(wsamples.begin(), wsamples.end());
-      warmupP50 = wsamples[wsamples.size() / 2];
-    }
+    };
 
-    // Agree on an iteration count (reference: median time broadcast,
-    // gloo/benchmark/runner.cc:322-330) so no rank leaves the sweep point
-    // before its peers.
-    // Cap the agreed count: near-zero-cost ops (barrier at size 1) would
-    // otherwise produce millions of iterations; percentile quality does
-    // not improve past a few tens of thousands of samples.
-    uint64_t iters = std::min<uint64_t>(
-        50000, std::max<uint64_t>(1, uint64_t(o.minSeconds / warmupP50)));
-    {
-      BroadcastOptions opts;
-      opts.context = &ctx;
-      opts.tag = tag++;
-      opts.buffer = &iters;
-      opts.count = 1;
-      opts.dtype = DataType::kUint64;
-      broadcast(opts);
+    if (o.threads == 1) {
+      worker(0);
+    } else {
+      std::vector<std::thread> pool;
+      for (int t = 0; t < o.threads; t++) {
+        pool.emplace_back(worker, t);
+      }
+      for (auto& th : pool) {
+        th.join();
+      }
     }
 
     std::vector<double> samples;
-    samples.reserve(iters);
-    for (uint64_t i = 0; i < iters; i++) {
-      const auto t0 = Clock::now();
-      w.run();
-      samples.push_back(
-          std::chrono::duration<double>(Clock::now() - t0).count());
+    for (auto& s : allSamples) {
+      samples.insert(samples.end(), s.begin(), s.end());
     }
-
     std::sort(samples.begin(), samples.end());
     auto pct = [&](double p) {
       return samples[std::min(samples.size() - 1,
                               size_t(p * samples.size()))] * 1e6;
     };
     const double p50 = pct(0.5);
-    const double algbw = w.algBytes / (p50 / 1e6) / 1e9;
+    // Aggregate bandwidth: each thread moves algBytes per iteration
+    // concurrently.
+    const double algbw = double(o.threads) * algBytes / (p50 / 1e6) / 1e9;
     if (o.rank == 0) {
       if (o.json) {
         printf("{\"op\":\"%s\",\"elements\":%zu,\"bytes\":%zu,"
+               "\"dtype\":\"%s\",\"threads\":%d,\"inputs\":%d,"
                "\"min_us\":%.1f,\"p50_us\":%.1f,\"p99_us\":%.1f,"
                "\"max_us\":%.1f,\"algbw_gbps\":%.3f,\"iters\":%zu}\n",
-               o.op.c_str(), elements, w.algBytes, pct(0.0), p50, pct(0.99),
+               o.op.c_str(), elements, algBytes, o.dtype.c_str(),
+               o.threads, o.inputs, pct(0.0), p50, pct(0.99),
                samples.back() * 1e6, algbw, samples.size());
       } else {
         printf("%12zu %12zu %10.1f %10.1f %10.1f %10.1f %12.3f %8zu\n",
-               w.algBytes, elements, pct(0.0), p50, pct(0.99),
+               algBytes, elements, pct(0.0), p50, pct(0.99),
                samples.back() * 1e6, algbw, samples.size());
       }
     }
+  }
+  for (auto& c : forked) {
+    c->close();
   }
   ctx.close();
   return 0;

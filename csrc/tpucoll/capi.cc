@@ -160,11 +160,14 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 // ---- device / context ----
 
 void* tc_device_new(const char* hostname, uint16_t port,
-                    const char* authKey, int encrypt) {
+                    const char* authKey, int encrypt, const char* iface) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
       attr.hostname = hostname;
+    }
+    if (iface != nullptr) {
+      attr.iface = iface;
     }
     attr.port = port;
     if (authKey != nullptr) {

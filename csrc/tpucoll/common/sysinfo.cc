@@ -1,5 +1,6 @@
 #include "tpucoll/common/sysinfo.h"
 
+#include <arpa/inet.h>
 #include <ifaddrs.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
@@ -60,6 +61,40 @@ int interfaceSpeedMbps(const std::string& name) {
   }
   fclose(f);
   return speed;
+}
+
+std::string addressForInterface(const std::string& name) {
+  if (name.empty()) {
+    return "";
+  }
+  ifaddrs* list = nullptr;
+  if (getifaddrs(&list) != 0) {
+    return "";
+  }
+  std::string v4, v6;
+  for (ifaddrs* ifa = list; ifa != nullptr; ifa = ifa->ifa_next) {
+    if (ifa->ifa_addr == nullptr || name != ifa->ifa_name) {
+      continue;
+    }
+    char buf[INET6_ADDRSTRLEN] = {0};
+    if (ifa->ifa_addr->sa_family == AF_INET && v4.empty()) {
+      inet_ntop(AF_INET,
+                &reinterpret_cast<sockaddr_in*>(ifa->ifa_addr)->sin_addr,
+                buf, sizeof(buf));
+      v4 = buf;
+    } else if (ifa->ifa_addr->sa_family == AF_INET6 && v6.empty()) {
+      auto* sa6 = reinterpret_cast<sockaddr_in6*>(ifa->ifa_addr);
+      if (IN6_IS_ADDR_LINKLOCAL(&sa6->sin6_addr)) {
+        // A bare link-local string loses its scope id and cannot bind;
+        // better to fall through to the clear "no usable address" error.
+        continue;
+      }
+      inet_ntop(AF_INET6, &sa6->sin6_addr, buf, sizeof(buf));
+      v6 = buf;
+    }
+  }
+  freeifaddrs(list);
+  return v4.empty() ? v6 : v4;
 }
 
 }  // namespace tpucoll

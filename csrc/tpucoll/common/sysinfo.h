@@ -17,4 +17,10 @@ std::string interfaceForAddress(const sockaddr* addr);
 // (virtual interfaces, loopback).
 int interfaceSpeedMbps(const std::string& name);
 
+// First IPv4 (preferred) or IPv6 address owned by the named interface,
+// as a numeric string ("" if the interface has no address). Lets a
+// device bind by interface NAME (reference: gloo tcp/attr.h iface +
+// device.cc:30-141 resolution).
+std::string addressForInterface(const std::string& name);
+
 }  // namespace tpucoll

@@ -11,7 +11,13 @@ Device::Device(const DeviceAttr& attr)
   TC_ENFORCE(!encrypt_ || !authKey_.empty(),
              "encrypt=true requires an auth key (the AEAD keys are "
              "derived from the PSK handshake)");
-  SockAddr bindAddr = resolve(attr.hostname, attr.port);
+  std::string host = attr.hostname;
+  if (!attr.iface.empty()) {
+    host = addressForInterface(attr.iface);
+    TC_ENFORCE(!host.empty(), "interface ", attr.iface,
+               " has no usable address");
+  }
+  SockAddr bindAddr = resolve(host, attr.port);
   listener_ = std::make_unique<Listener>(&loop_, bindAddr, authKey_,
                                          encrypt_);
 }

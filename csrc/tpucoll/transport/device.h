@@ -21,6 +21,9 @@ struct DeviceAttr {
   // Hostname or IP to bind and advertise. Loopback default suits
   // single-host tests; multi-host deployments pass the DCN hostname.
   std::string hostname{"127.0.0.1"};
+  // Bind by interface NAME instead (reference: gloo tcp/attr.h iface):
+  // when non-empty, the interface's first address overrides hostname.
+  std::string iface;
   uint16_t port{0};  // 0 = ephemeral
   // Non-empty: require the PSK handshake on every inbound and outbound
   // connection (mutual HMAC-SHA256 authentication; see wire.h).
