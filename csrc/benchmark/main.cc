@@ -49,6 +49,7 @@ struct Options {
   uint32_t tagBase = 0;
   std::string authKey;
   bool encrypt = false;
+  bool sync = false;        // busy-poll latency mode (reference --sync)
   int threads = 1;          // benchmark threads, each on a forked context
   int inputs = 1;           // input buffers per rank (allreduce)
   std::string dtype = "f32";  // allreduce payload: f32 | f16 | bf16
@@ -66,7 +67,7 @@ void usage() {
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
           "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n"
           "  [--threads N] [--inputs N] [--dtype f32|f16|bf16] "
-          "[--iface NAME]\n");
+          "[--iface NAME] [--sync]\n");
 }
 
 std::vector<size_t> parseElements(const std::string& arg) {
@@ -131,6 +132,8 @@ Options parse(int argc, char** argv) {
                  "--dtype must be f32|f16|bf16, got ", o.dtype);
     } else if (a == "--iface") {
       o.iface = next();
+    } else if (a == "--sync") {
+      o.sync = true;
     } else {
       usage();
       TC_THROW(tpucoll::EnforceError, "unknown argument ", a);
@@ -144,6 +147,10 @@ Options parse(int argc, char** argv) {
       o.elements.push_back(n);
     }
   }
+  TC_ENFORCE(o.op == "allreduce" || (o.dtype == "f32" && o.inputs == 1),
+             "--dtype/--inputs apply to --op allreduce only");
+  TC_ENFORCE(o.dtype == "f32" || o.algorithm != "ring_bf16_wire",
+             "--dtype f16/bf16 cannot combine with ring_bf16_wire");
   return o;
 }
 
@@ -199,13 +206,87 @@ tpucoll::AllreduceAlgorithm parseAllreduceAlgorithm(const std::string& a) {
              : AllreduceAlgorithm::kAuto;
 }
 
+// Shared allreduce workload across payload dtypes: Elem is the storage
+// type, enc/dec convert to/from float (identity for f32). Verification
+// is tolerance-based so half formats stay valid at any rank/input count
+// (bf16 integers are only exact to 256).
+template <typename Elem, typename Enc, typename Dec>
+Workload makeAllreduceWorkloadT(const Options& o, tpucoll::Context& ctx,
+                                uint32_t tag, tpucoll::DataType dt,
+                                double rtol, size_t elements,
+                                std::vector<Elem>& payload,
+                                std::vector<std::vector<Elem>>& extra,
+                                Enc enc, Dec dec) {
+  using namespace tpucoll;
+  const int rank = ctx.rank();
+  const int size = ctx.size();
+  Workload w;
+  w.algBytes = elements * sizeof(Elem);
+  payload.assign(elements, enc(1.f));
+  extra.assign(o.inputs - 1, std::vector<Elem>(elements, enc(1.f)));
+  const auto algo = parseAllreduceAlgorithm(o.algorithm);
+  auto* pp = &payload;
+  auto* ep = &extra;
+  std::function<void()> run = [&ctx, pp, ep, tag, dt, algo] {
+    AllreduceOptions opts;
+    opts.context = &ctx;
+    opts.tag = tag;
+    opts.inputs = {pp->data()};
+    for (auto& v : *ep) {
+      opts.inputs.push_back(v.data());
+    }
+    opts.outputs = {pp->data()};
+    opts.count = pp->size();
+    opts.dtype = dt;
+    opts.algorithm = algo;
+    allreduce(opts);
+  };
+  w.run = run;
+  w.verifyOnce = [run, pp, ep, rank, size, enc, dec, rtol,
+                  inputs = o.inputs] {
+    pp->assign(pp->size(), enc(float(rank + 1)));
+    for (auto& vec : *ep) {
+      vec.assign(vec.size(), enc(float(rank + 1)));
+    }
+    run();
+    const double expect = double(inputs) * size * (size + 1) / 2.0;
+    bool ok = std::all_of(pp->begin(), pp->end(), [&](Elem v) {
+      return std::abs(double(dec(v)) - expect) <= rtol * expect;
+    });
+    pp->assign(pp->size(), enc(1.f));
+    for (auto& vec : *ep) {
+      vec.assign(vec.size(), enc(1.f));
+    }
+    return ok;
+  };
+  return w;
+}
+
+Workload makeAllreduceWorkload(const Options& o, tpucoll::Context& ctx,
+                               size_t elements, uint32_t tag,
+                               Buffers& bufs) {
+  using namespace tpucoll;
+  if (o.dtype == "f32") {
+    return makeAllreduceWorkloadT(
+        o, ctx, tag, DataType::kFloat32, 0.0, elements, bufs.buf,
+        bufs.extraF32, [](float v) { return v; },
+        [](float v) { return v; });
+  }
+  if (o.dtype == "f16") {
+    return makeAllreduceWorkloadT(
+        o, ctx, tag, DataType::kFloat16, 1e-3, elements, bufs.half,
+        bufs.extraHalf, [](float v) { return floatToHalf(v); },
+        [](uint16_t v) { return halfToFloat(v); });
+  }
+  return makeAllreduceWorkloadT(
+      o, ctx, tag, DataType::kBFloat16, 1e-2, elements, bufs.half,
+      bufs.extraHalf, [](float v) { return floatToBfloat16(v); },
+      [](uint16_t v) { return bfloat16ToFloat(v); });
+}
+
 Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
                       size_t elements, uint32_t tag, Buffers& bufs) {
   using namespace tpucoll;
-  // --dtype and --inputs shape only the allreduce payload; refusing the
-  // combination beats emitting a mislabeled measurement row.
-  TC_ENFORCE(o.op == "allreduce" || (o.dtype == "f32" && o.inputs == 1),
-             "--dtype/--inputs apply to --op allreduce only");
   std::vector<float>& buf = bufs.buf;
   std::vector<float>& out = bufs.out;
   const int rank = ctx.rank();
@@ -213,109 +294,13 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
   Workload w;
   w.algBytes = elements * sizeof(float);
 
-  if (o.op == "allreduce" && o.dtype != "f32") {
-    // Half-precision payloads (reference: benchmark/options.h fp16 knob):
-    // the SIMD f16/bf16 reduction kernels run on the wire-facing path.
-    const DataType dt =
-        o.dtype == "f16" ? DataType::kFloat16 : DataType::kBFloat16;
-    auto enc = [dt](float v) {
-      return dt == DataType::kFloat16 ? floatToHalf(v) : floatToBfloat16(v);
-    };
-    auto dec = [dt](uint16_t v) {
-      return dt == DataType::kFloat16 ? halfToFloat(v) : bfloat16ToFloat(v);
-    };
-    w.algBytes = elements * sizeof(uint16_t);
-    bufs.half.assign(elements, enc(1.f));
-    bufs.extraHalf.assign(o.inputs - 1,
-                          std::vector<uint16_t>(elements, enc(1.f)));
-    // The bf16-wire codec compresses f32 payloads; with a half payload
-    // it is contradictory.
-    TC_ENFORCE(o.algorithm != "ring_bf16_wire",
-               "--dtype f16/bf16 cannot combine with ring_bf16_wire");
-    const auto algo = parseAllreduceAlgorithm(o.algorithm);
-    auto* bp = &bufs;
-    std::function<void()> run = [&ctx, bp, tag, dt, algo] {
-      AllreduceOptions opts;
-      opts.context = &ctx;
-      opts.tag = tag;
-      opts.inputs = {bp->half.data()};
-      for (auto& v : bp->extraHalf) {
-        opts.inputs.push_back(v.data());
-      }
-      opts.outputs = {bp->half.data()};
-      opts.count = bp->half.size();
-      opts.dtype = dt;
-      opts.algorithm = algo;
-      allreduce(opts);
-    };
-    w.run = run;
-    w.verifyOnce = [run, bp, rank, size, enc, dec, inputs = o.inputs] {
-      for (auto& v : bp->half) {
-        v = enc(float(rank + 1));
-      }
-      for (auto& vec : bp->extraHalf) {
-        vec.assign(vec.size(), enc(float(rank + 1)));
-      }
-      run();
-      // Small integer sums are exact in both half formats.
-      const float expect = inputs * size * (size + 1) / 2.0f;
-      for (auto v : bp->half) {
-        if (dec(v) != expect) {
-          return false;
-        }
-      }
-      for (auto& v : bp->half) {
-        v = enc(1.f);
-      }
-      for (auto& vec : bp->extraHalf) {
-        vec.assign(vec.size(), enc(1.f));
-      }
-      return true;
-    };
-    return w;
-  }
-
-  auto algo = parseAllreduceAlgorithm(o.algorithm);
   // NOTE: lambdas capture buf/out/ctx by reference (owned by the caller for
   // the workload's lifetime) and everything else by value — run/verifyOnce
   // outlive this frame.
   auto ctxp = &ctx;
 
   if (o.op == "allreduce") {
-    buf.assign(elements, 0.f);
-    bufs.extraF32.assign(o.inputs - 1, std::vector<float>(elements, 1.f));
-    auto* bp = &bufs;
-    std::function<void()> run = [ctxp, bp, tag, algo] {
-      AllreduceOptions opts;
-      opts.context = ctxp;
-      opts.tag = tag;
-      opts.inputs = {bp->buf.data()};
-      for (auto& v : bp->extraF32) {
-        opts.inputs.push_back(v.data());
-      }
-      opts.outputs = {bp->buf.data()};
-      opts.count = bp->buf.size();
-      opts.algorithm = algo;
-      allreduce(opts);
-    };
-    w.run = run;
-    w.verifyOnce = [run, bp, rank, size, inputs = o.inputs] {
-      for (auto& v : bp->buf) {
-        v = float(rank + 1);
-      }
-      for (auto& vec : bp->extraF32) {
-        vec.assign(vec.size(), float(rank + 1));
-      }
-      run();
-      const float expect = inputs * size * (size + 1) / 2.0f;
-      bool ok = std::all_of(bp->buf.begin(), bp->buf.end(),
-                            [&](float v) { return v == expect; });
-      std::fill(bp->buf.begin(), bp->buf.end(), 1.f);
-      for (auto& vec : bp->extraF32) {
-        vec.assign(vec.size(), 1.f);
-      }
-      return ok;
-    };
+    return makeAllreduceWorkload(o, ctx, elements, tag, bufs);
   } else if (o.op == "allgather") {
     buf.assign(elements, float(rank));
     out.assign(elements * size, 0.f);
@@ -615,6 +600,7 @@ int runBench(int argc, char** argv) {
   attr.iface = o.iface;
   attr.authKey = o.authKey;
   attr.encrypt = o.encrypt;
+  attr.busyPoll = o.sync;
   auto device = std::make_shared<tpucoll::transport::Device>(attr);
   tpucoll::Context ctx(o.rank, o.size);
   ctx.connectFullMesh(store, device);
@@ -709,12 +695,26 @@ int runBench(int argc, char** argv) {
     if (o.threads == 1) {
       worker(0);
     } else {
+      // Capture worker exceptions: one escaping a std::thread would
+      // std::terminate past main()'s catch and dump core diagnostics-free.
+      std::vector<std::exception_ptr> errors(o.threads);
       std::vector<std::thread> pool;
       for (int t = 0; t < o.threads; t++) {
-        pool.emplace_back(worker, t);
+        pool.emplace_back([&, t] {
+          try {
+            worker(t);
+          } catch (...) {
+            errors[t] = std::current_exception();
+          }
+        });
       }
       for (auto& th : pool) {
         th.join();
+      }
+      for (auto& e : errors) {
+        if (e) {
+          std::rethrow_exception(e);
+        }
       }
     }
 

@@ -160,7 +160,8 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 // ---- device / context ----
 
 void* tc_device_new(const char* hostname, uint16_t port,
-                    const char* authKey, int encrypt, const char* iface) {
+                    const char* authKey, int encrypt, const char* iface,
+                    int busyPoll) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
@@ -174,6 +175,7 @@ void* tc_device_new(const char* hostname, uint16_t port,
       attr.authKey = authKey;
     }
     attr.encrypt = encrypt != 0;
+    attr.busyPoll = busyPoll != 0;
     return new DeviceHandle(std::make_shared<Device>(attr));
   } catch (const std::exception& e) {
     g_lastError = e.what();

@@ -7,7 +7,7 @@ namespace tpucoll {
 namespace transport {
 
 Device::Device(const DeviceAttr& attr)
-    : authKey_(attr.authKey), encrypt_(attr.encrypt) {
+    : loop_(attr.busyPoll), authKey_(attr.authKey), encrypt_(attr.encrypt) {
   TC_ENFORCE(!encrypt_ || !authKey_.empty(),
              "encrypt=true requires an auth key (the AEAD keys are "
              "derived from the PSK handshake)");

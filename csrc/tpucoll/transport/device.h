@@ -32,6 +32,11 @@ struct DeviceAttr {
   // from the PSK handshake (requires a non-empty authKey). Both sides of
   // every connection must agree — a plaintext peer is rejected at hello.
   bool encrypt{false};
+  // Sync/busy-poll latency mode (reference: tcp setSync + MSG_DONTWAIT
+  // busy-poll, gloo tcp/pair.cc:505): the loop thread spins on
+  // epoll_wait(0) and blocking waits spin instead of sleeping on their
+  // condition variables. Burns a core for the sub-10us regime.
+  bool busyPoll{false};
 };
 
 class Device {
@@ -44,6 +49,7 @@ class Device {
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
   const std::string& authKey() const { return authKey_; }
   bool encrypt() const { return encrypt_; }
+  bool busyPoll() const { return loop_.busyPoll(); }
   std::string str() const;
 
  private:

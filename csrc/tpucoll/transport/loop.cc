@@ -16,7 +16,7 @@ namespace {
 constexpr int kMaxEvents = 64;
 }
 
-Loop::Loop() {
+Loop::Loop(bool busyPoll) : busyPoll_(busyPoll) {
   epollFd_ = epoll_create1(EPOLL_CLOEXEC);
   TC_ENFORCE_GE(epollFd_, 0, "epoll_create1: ", strerror(errno));
   wakeFd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
@@ -98,9 +98,24 @@ void Loop::wake() {
 void Loop::run() {
   epoll_event events[kMaxEvents];
   while (!stop_.load()) {
-    int n = epoll_wait(epollFd_, events, kMaxEvents, 100);
+    // Busy-poll mode never sleeps in the kernel: epoll_wait(0) returns
+    // immediately and the pause keeps the spin hyperthread-friendly.
+    int n = epoll_wait(epollFd_, events, kMaxEvents, busyPoll_ ? 0 : 100);
     if (n < 0) {
       TC_ENFORCE_EQ(errno, EINTR, "epoll_wait: ", strerror(errno));
+      continue;
+    }
+    if (n == 0 && busyPoll_) {
+#if defined(__x86_64__) || defined(__i386__)
+      __builtin_ia32_pause();
+#endif
+      // Yield between empty polls: on a dedicated core this is nearly
+      // free; on an oversubscribed host it keeps spinners from starving
+      // the threads that would produce their events. Skipping the
+      // end-of-tick work (lock, tick++, notify) is safe here: barrier()
+      // and defer() both write the wake eventfd first, so any waiter
+      // forces a non-empty poll.
+      std::this_thread::yield();
       continue;
     }
     for (int i = 0; i < n; i++) {

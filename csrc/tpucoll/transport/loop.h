@@ -24,7 +24,11 @@ class Handler {
 
 class Loop {
  public:
-  Loop();
+  // busyPoll: spin on epoll_wait(0) instead of sleeping in the kernel —
+  // the reference's sync/busy-poll latency mode (gloo tcp/pair.cc:505
+  // MSG_DONTWAIT), traded CPU-for-latency at the device level here
+  // because one loop thread owns all sockets.
+  explicit Loop(bool busyPoll = false);
   ~Loop();
 
   // Register fd with the epoll set. `events` is an EPOLL* mask. The handler
@@ -37,6 +41,8 @@ class Loop {
   // trivially true). The barrier is a loop-generation tick: the caller waits
   // until the loop has passed through epoll_wait at least once more.
   void del(int fd);
+
+  bool busyPoll() const { return busyPoll_; }
 
   // Run fn on the loop thread at the next tick.
   void defer(std::function<void()> fn);
@@ -55,6 +61,7 @@ class Loop {
   int epollFd_{-1};
   int wakeFd_{-1};
   std::thread thread_;
+  const bool busyPoll_;
   std::atomic<bool> stop_{false};
 
   std::mutex mu_;

@@ -1,8 +1,10 @@
 #include "tpucoll/transport/unbound_buffer.h"
 
 #include <cstring>
+#include <thread>
 
 #include "tpucoll/transport/context.h"
+#include "tpucoll/transport/device.h"
 #include "tpucoll/transport/wire.h"
 
 namespace tpucoll {
@@ -132,10 +134,35 @@ void UnboundBuffer::get(const std::string& remoteKey, uint64_t slot,
   recv(key.rank, slot, offset, nbytes);
 }
 
+template <typename Pred>
+bool UnboundBuffer::waitFor(std::unique_lock<std::mutex>& lock, Pred pred,
+                            std::chrono::milliseconds timeout) {
+  if (!context_->device()->busyPoll()) {
+    return cv_.wait_for(lock, timeout, pred);
+  }
+  // Sync/busy-poll mode: spin instead of sleeping — the completion comes
+  // from the (also spinning) loop thread, so the round trip avoids two
+  // kernel wakeups.
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  while (!pred()) {
+    lock.unlock();
+#if defined(__x86_64__) || defined(__i386__)
+    __builtin_ia32_pause();
+#endif
+    std::this_thread::yield();
+    const bool expired = std::chrono::steady_clock::now() >= deadline;
+    lock.lock();
+    if (expired) {
+      return pred();
+    }
+  }
+  return true;
+}
+
 bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {
   std::unique_lock<std::mutex> lock(mu_);
   auto pred = [&] { return completedSends_ > 0 || abortSend_ || failed_; };
-  if (!cv_.wait_for(lock, timeout, pred)) {
+  if (!waitFor(lock, pred, timeout)) {
     TC_THROW(TimeoutException, "waitSend timed out after ", timeout.count(),
              "ms");
   }
@@ -155,7 +182,7 @@ bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
   auto pred = [&] {
     return !completedRecvs_.empty() || abortRecv_ || failed_;
   };
-  if (!cv_.wait_for(lock, timeout, pred)) {
+  if (!waitFor(lock, pred, timeout)) {
     TC_THROW(TimeoutException, "waitRecv timed out after ", timeout.count(),
              "ms");
   }

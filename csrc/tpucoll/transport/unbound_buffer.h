@@ -93,6 +93,12 @@ class UnboundBuffer {
   void cancelPendingRecv();
 
  private:
+  // Blocking-wait core: condvar sleep, or a spin when the device is in
+  // sync/busy-poll mode.
+  template <typename Pred>
+  bool waitFor(std::unique_lock<std::mutex>& lock, Pred pred,
+               std::chrono::milliseconds timeout);
+
   Context* const context_;
   void* const ptr_;
   const size_t size_;

@@ -83,7 +83,8 @@ _PROTOTYPES = {
                             ctypes.POINTER(_i64)]),
     # device / context
     "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16,
-                       ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]),
+                       ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+                       ctypes.c_int]),
     "tc_device_free": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),

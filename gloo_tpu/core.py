@@ -243,21 +243,25 @@ class Device:
 
     def __init__(self, hostname: str = "127.0.0.1", port: int = 0,
                  auth_key: Optional[str] = None, encrypt: bool = False,
-                 iface: Optional[str] = None):
+                 iface: Optional[str] = None, busy_poll: bool = False):
         """auth_key: pre-shared key enabling the mutual HMAC handshake on
         every connection (all ranks must agree; see docs/transport.md).
         encrypt=True additionally encrypts the data plane with
         per-connection ChaCha20-Poly1305 keys derived from the PSK
         handshake (requires auth_key; all ranks must agree — plaintext
         and encrypted peers reject each other at hello). iface binds by
-        interface NAME (its first address overrides hostname)."""
+        interface NAME (its first address overrides hostname).
+        busy_poll=True spins instead of sleeping (loop thread and
+        blocking waits) — the reference's sync mode for the sub-10us
+        latency regime; burns a core."""
         if encrypt and not auth_key:
             raise ValueError("encrypt=True requires auth_key")
         self._handle = check_handle(
             _lib.lib.tc_device_new(hostname.encode(), port,
                                    auth_key.encode() if auth_key else None,
                                    1 if encrypt else 0,
-                                   iface.encode() if iface else None))
+                                   iface.encode() if iface else None,
+                                   1 if busy_poll else 0))
         self._free = _lib.lib.tc_device_free
 
     def __del__(self):

@@ -170,3 +170,22 @@ def gloo_tpu_timeout():
     import gloo_tpu
 
     return gloo_tpu.TimeoutError
+
+
+def test_busy_poll_mode():
+    """Sync/busy-poll devices (reference: tcp setSync + MSG_DONTWAIT)
+    must run the same collectives and p2p traffic correctly — the mode
+    only changes HOW completions are awaited (spin vs condvar)."""
+    def fn(ctx, rank):
+        x = np.full(1000, float(rank + 1), np.float32)
+        ctx.allreduce(x)
+        if rank == 0:
+            ctx.send(np.arange(64, dtype=np.float64), dst=1, slot=77)
+            return x[0]
+        got = np.zeros(64, dtype=np.float64)
+        ctx.recv(got, src=0, slot=77)
+        np.testing.assert_array_equal(got, np.arange(64, dtype=np.float64))
+        return x[0]
+
+    results = spawn(2, fn, device_kwargs={"busy_poll": True})
+    assert results == [3.0, 3.0]
