@@ -12,7 +12,10 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
+
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/debug.h"
 #include "tpucoll/common/crypto.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/context.h"
@@ -283,6 +286,87 @@ void tamperScenario() {
   ::close(fd);
 }
 
+// Connect-retry diagnostics: a fake peer accepts and immediately closes
+// every connection, so the initiator must retry with backoff, emit
+// structured willRetry records, and finally surface an IoException —
+// never a silent hang or an instant give-up.
+void retryScenario() {
+  using namespace tpucoll;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(lfd >= 0);
+  sockaddr_in sin{};
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  CHECK(bind(lfd, reinterpret_cast<sockaddr*>(&sin), sizeof(sin)) == 0);
+  CHECK(listen(lfd, 16) == 0);
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  CHECK(getsockname(lfd, reinterpret_cast<sockaddr*>(&bound), &blen) == 0);
+
+  std::atomic<bool> stop{false};
+  std::thread closer([&] {
+    while (!stop.load()) {
+      int fd = accept(lfd, nullptr, nullptr);
+      if (fd >= 0) {
+        ::close(fd);  // slam the door: handshake EOF on the initiator
+      }
+    }
+  });
+
+  std::atomic<int> retryRecords{0};
+  std::atomic<int> terminalRecords{0};
+  setConnectDebugLogger([&](const ConnectDebugData& d) {
+    if (d.willRetry) {
+      retryRecords++;
+    }
+    if (!d.ok && !d.willRetry) {
+      terminalRecords++;
+    }
+  });
+
+  // Forge rank 0's blob pointing at the slammer; rank 1 initiates.
+  auto addr = transport::resolve(
+      "127.0.0.1", ntohs(bound.sin_port));
+  auto addrBytes = addr.serialize();
+  std::vector<uint8_t> blob;
+  uint32_t n32 = 2, alen = addrBytes.size();
+  blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&n32),
+              reinterpret_cast<uint8_t*>(&n32) + 4);
+  blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&alen),
+              reinterpret_cast<uint8_t*>(&alen) + 4);
+  blob.insert(blob.end(), addrBytes.begin(), addrBytes.end());
+  uint64_t pairIds[2] = {100, 101};
+  blob.insert(blob.end(), reinterpret_cast<uint8_t*>(pairIds),
+              reinterpret_cast<uint8_t*>(pairIds) + 16);
+  auto store = std::make_shared<HashStore>();
+  store->set("tc/rank/0", blob);
+
+  // PSK handshake: the initiator must READ the listener's challenge, so
+  // the slammed connection surfaces as a retryable EOF (a plain hello is
+  // write-only and would "succeed" into the doomed socket).
+  transport::DeviceAttr attr;
+  attr.authKey = "retry-psk";
+  auto device = std::make_shared<transport::Device>(attr);
+  Context ctx(1, 2);
+  ctx.setTimeout(std::chrono::milliseconds(700));
+  bool threw = false;
+  try {
+    ctx.connectFullMesh(store, device);
+  } catch (const IoException&) {
+    threw = true;
+  } catch (const TimeoutException&) {
+    threw = true;  // deadline can expire inside an attempt's handshake
+  }
+  CHECK(threw);
+  CHECK(retryRecords.load() >= 2);  // ~700ms / 50ms backoff: plenty
+  CHECK(terminalRecords.load() >= 1);  // the final attempt is recorded
+  setConnectDebugLogger(nullptr);
+  stop.store(true);
+  ::shutdown(lfd, SHUT_RDWR);
+  ::close(lfd);
+  closer.join();
+}
+
 int main() {
   const int size = 4;
   auto store = std::make_shared<tpucoll::HashStore>();
@@ -311,6 +395,7 @@ int main() {
   }
 
   tamperScenario();
+  retryScenario();
   if (failures == 0) {
     printf("tpucoll_integration: all checks passed\n");
     return 0;

@@ -11,6 +11,7 @@
 #include <string>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
 #include "tpucoll/transport/wire.h"
 #include "tpucoll/rendezvous/file_store.h"
@@ -184,6 +185,25 @@ void* tc_device_new(const char* hostname, uint16_t port,
 }
 
 void tc_device_free(void* dev) { delete asDevice(dev); }
+
+// Structured connect diagnostics hook (reference: tcp/debug_data.h +
+// DebugLogger). The callback runs on connecting threads; pass nullptr to
+// clear.
+typedef void (*tc_connect_logger_fn)(int selfRank, int peerRank,
+                                     const char* remote, const char* local,
+                                     int attempt, int ok, int willRetry,
+                                     const char* error);
+
+void tc_set_connect_debug_logger(tc_connect_logger_fn cb) {
+  if (cb == nullptr) {
+    tpucoll::setConnectDebugLogger(nullptr);
+    return;
+  }
+  tpucoll::setConnectDebugLogger([cb](const tpucoll::ConnectDebugData& d) {
+    cb(d.selfRank, d.peerRank, d.remote.c_str(), d.local.c_str(),
+       d.attempt, d.ok ? 1 : 0, d.willRetry ? 1 : 0, d.error.c_str());
+  });
+}
 
 void* tc_context_new(int rank, int size) {
   try {

@@ -1,0 +1,37 @@
+#include "tpucoll/common/debug.h"
+
+#include <mutex>
+#include <utility>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace {
+
+std::mutex g_mu;
+std::function<void(const ConnectDebugData&)> g_logger;
+
+}  // namespace
+
+void setConnectDebugLogger(
+    std::function<void(const ConnectDebugData&)> fn) {
+  std::lock_guard<std::mutex> guard(g_mu);
+  g_logger = std::move(fn);
+}
+
+void logConnectAttempt(const ConnectDebugData& data) {
+  TC_DEBUG("connect rank ", data.selfRank, " -> ", data.peerRank, " (",
+           data.remote, ", local ", data.local, ") attempt ", data.attempt,
+           data.ok ? ": ok" : ": failed", data.ok ? "" : " - ",
+           data.error, data.willRetry ? " (will retry)" : "");
+  std::function<void(const ConnectDebugData&)> fn;
+  {
+    std::lock_guard<std::mutex> guard(g_mu);
+    fn = g_logger;
+  }
+  if (fn) {
+    fn(data);
+  }
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,34 @@
+// Structured connect diagnostics (reference: gloo/transport/tcp/
+// debug_data.h ConnectDebugData + debug_logger.h DebugLogger::log): every
+// outbound connection attempt produces a record — success, retryable
+// failure, or terminal failure — delivered to an optional process-wide
+// hook so orchestration layers can surface WHICH pair of a large mesh is
+// failing to come up without scraping logs.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <string>
+
+namespace tpucoll {
+
+struct ConnectDebugData {
+  int selfRank{-1};
+  int peerRank{-1};
+  std::string remote;  // peer address
+  std::string local;   // local socket address ("" before bind/connect)
+  int attempt{0};      // 1-based
+  bool ok{false};
+  bool willRetry{false};
+  std::string error;  // "" on success
+};
+
+// Register (or clear, with nullptr) the process-wide hook. The callback
+// runs on the connecting thread; keep it cheap and reentrant-safe.
+void setConnectDebugLogger(std::function<void(const ConnectDebugData&)> fn);
+
+// Invoked by the transport on every attempt outcome. Always emits a
+// TC_DEBUG line; additionally calls the registered hook.
+void logConnectAttempt(const ConnectDebugData& data);
+
+}  // namespace tpucoll

@@ -10,6 +10,9 @@
 #include <cerrno>
 #include <cstring>
 
+#include <thread>
+
+#include "tpucoll/common/debug.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/transport/context.h"
 #include "tpucoll/transport/device.h"
@@ -36,7 +39,49 @@ Pair::~Pair() {
 
 void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
                    std::chrono::milliseconds timeout) {
+  static constexpr std::chrono::milliseconds kBackoff{50};
   const auto deadline = std::chrono::steady_clock::now() + timeout;
+  const bool retriesDisabled =
+      std::getenv("TPUCOLL_DISABLE_CONNECTION_RETRIES") != nullptr;
+  int attempt = 0;
+  while (true) {
+    attempt++;
+    ConnectDebugData d;
+    d.selfRank = selfRank_;
+    d.peerRank = peerRank_;
+    d.remote = remote.str();
+    d.attempt = attempt;
+    try {
+      connectAttempt(remote, remotePairId, deadline, &d.local);
+      d.ok = true;
+      logConnectAttempt(d);
+      return;
+    } catch (const TimeoutException&) {
+      d.error = "timed out";
+      logConnectAttempt(d);
+      throw;
+    } catch (const IoException& e) {
+      d.error = e.what();
+      // Definite auth rejections ("failed authentication", a bad tag
+      // from a live peer) are terminal — retrying a wrong key is noise.
+      // Everything else (refused, reset, clean EOF mid-handshake — the
+      // peer restarting during bootstrap) retries until the deadline.
+      d.willRetry =
+          !retriesDisabled &&
+          d.error.find("failed authentication") == std::string::npos &&
+          std::chrono::steady_clock::now() + kBackoff < deadline;
+      logConnectAttempt(d);
+      if (!d.willRetry) {
+        throw;
+      }
+      std::this_thread::sleep_for(kBackoff);
+    }
+  }
+}
+
+void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
+                          std::chrono::steady_clock::time_point deadline,
+                          std::string* localAddr) {
   int fd = socket(remote.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd, 0, errnoString("socket"));
   setNonBlocking(fd);
@@ -83,6 +128,13 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
     }
   }
   setNoDelay(fd);
+  {
+    SockAddr local;
+    local.len = sizeof(local.ss);
+    if (getsockname(fd, local.sa(), &local.len) == 0) {
+      *localAddr = local.str();
+    }
+  }
 
   const std::string& authKey = context_->device()->authKey();
   auto writeAll = [&](const void* buf, size_t len, const char* what) {

@@ -45,6 +45,11 @@ class Pair : public Handler {
 
   // Initiator path (blocking, user thread): TCP connect to the peer's
   // listener and write the hello routing this connection to `remotePairId`.
+  // Retries retryable failures (peer not accepting yet, reset mid-
+  // handshake) with backoff until the deadline, emitting a structured
+  // ConnectDebugData record per attempt (common/debug.h); set
+  // TPUCOLL_DISABLE_CONNECTION_RETRIES to fail on the first error
+  // (reference: GLOO_DISABLE_CONNECTION_RETRIES).
   void connect(const SockAddr& remote, uint64_t remotePairId,
                std::chrono::milliseconds timeout);
 
@@ -119,6 +124,11 @@ class Pair : public Handler {
   void flushTx(std::vector<UnboundBuffer*>* completed);
   // Shared enqueue path behind send/sendPut/sendOwned (acquires mu_).
   void enqueue(TxOp op);
+  // One connection attempt: TCP connect + hello + (optional) PSK
+  // handshake; throws on failure. Fills *localAddr once bound.
+  void connectAttempt(const SockAddr& remote, uint64_t remotePairId,
+                      std::chrono::steady_clock::time_point deadline,
+                      std::string* localAddr);
   // Seal the next frame (header, then payload chunks) into op->cipher,
   // consuming one tx seq each (mu_ held).
   void sealHeaderFrame(TxOp* op);

@@ -26,6 +26,7 @@ from gloo_tpu.core import (
     Store,
     TcpStore,
     TcpStoreServer,
+    set_connect_debug_logger,
     TimeoutError,
     UnboundBuffer,
 )

@@ -86,6 +86,7 @@ _PROTOTYPES = {
                        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
                        ctypes.c_int]),
     "tc_device_free": (None, [_c]),
+    "tc_set_connect_debug_logger": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),
     "tc_context_connect": (_int, [_c, _c, _c]),

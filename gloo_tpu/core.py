@@ -19,6 +19,7 @@ from gloo_tpu._lib import Aborted, Error, IoError, TimeoutError, check, check_ha
 __all__ = [
     "Aborted",
     "Context",
+    "set_connect_debug_logger",
     "Device",
     "Error",
     "FileStore",
@@ -268,6 +269,43 @@ class Device:
         handle, self._handle = self._handle, None
         if handle:
             self._free(handle)
+
+
+_CONNECT_LOGGER_CFUNC = ctypes.CFUNCTYPE(
+    None, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p)
+# Trampolines are retained for the process lifetime: an in-flight connect
+# on another thread may hold a snapshot of the previous hook, so freeing a
+# replaced trampoline could crash it. Debug-hook registration is rare;
+# the retention is bounded by the number of set_* calls.
+_connect_logger_keepalive = []
+
+
+def set_connect_debug_logger(fn) -> None:
+    """Register a process-wide hook receiving a dict per outbound
+    connection attempt: {self_rank, peer_rank, remote, local, attempt,
+    ok, will_retry, error} (reference: gloo tcp debug_data.h
+    ConnectDebugData -> DebugLogger). Runs on connecting threads — keep
+    it cheap. Pass None to clear."""
+    if fn is None:
+        _lib.lib.tc_set_connect_debug_logger(None)
+        return
+
+    def thunk(self_rank, peer_rank, remote, local, attempt, ok, will_retry,
+              error):
+        try:
+            fn({"self_rank": self_rank, "peer_rank": peer_rank,
+                "remote": (remote or b"").decode(),
+                "local": (local or b"").decode(), "attempt": attempt,
+                "ok": bool(ok), "will_retry": bool(will_retry),
+                "error": (error or b"").decode()})
+        except Exception:  # noqa: BLE001 — must not cross the C frame
+            pass
+
+    cb = _CONNECT_LOGGER_CFUNC(thunk)
+    _connect_logger_keepalive.append(cb)
+    _lib.lib.tc_set_connect_debug_logger(
+        ctypes.cast(cb, ctypes.c_void_p))
 
 
 class UnboundBuffer:

@@ -58,3 +58,31 @@ def test_plain_client_rejected_by_authenticated_mesh():
     results, errors = _spawn_group(2, device_fn, timeout=3.0)
     assert all(r is None for r in results)
     assert all(e is not None for e in errors), errors
+
+
+def test_connect_debug_records():
+    """Every outbound connect attempt produces a structured record
+    (reference: tcp/debug_data.h ConnectDebugData -> DebugLogger): a
+    healthy 2-rank mesh logs the initiator's successful attempt with
+    addresses and attempt=1."""
+    records = []
+    lock = threading.Lock()
+
+    def logger(rec):
+        with lock:
+            records.append(rec)
+
+    gloo_tpu.set_connect_debug_logger(logger)
+    try:
+        results, errors = _spawn_group(2, lambda rank: gloo_tpu.Device())
+        assert errors == [None, None], errors
+    finally:
+        gloo_tpu.set_connect_debug_logger(None)
+
+    ok = [r for r in records if r["ok"]]
+    assert ok, records
+    rec = ok[0]
+    assert rec["self_rank"] == 1 and rec["peer_rank"] == 0
+    assert rec["attempt"] == 1 and rec["error"] == ""
+    assert rec["remote"].startswith("127.0.0.1:")
+    assert rec["local"].startswith("127.0.0.1:")

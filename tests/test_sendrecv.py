@@ -187,5 +187,7 @@ def test_busy_poll_mode():
         np.testing.assert_array_equal(got, np.arange(64, dtype=np.float64))
         return x[0]
 
-    results = spawn(2, fn, device_kwargs={"busy_poll": True})
+    from tests.harness import _device_kwargs
+    results = spawn(2, fn,
+                    device_kwargs={**_device_kwargs(), "busy_poll": True})
     assert results == [3.0, 3.0]
