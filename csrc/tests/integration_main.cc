@@ -262,7 +262,7 @@ void tamperScenario() {
   auto sendSealed = [&](uint64_t slot, const std::vector<char>& payload,
                         bool flipByte) {
     transport::WireHeader hdr{transport::kMsgMagic, 1 /* kData */,
-                              {0, 0, 0}, slot, payload.size()};
+                              0, {0, 0}, slot, payload.size()};
     std::vector<uint8_t> frame(sizeof(hdr) + kAeadTagBytes +
                                payload.size() + kAeadTagBytes);
     aeadSeal(keys.tx, seq++, nullptr, 0,

@@ -550,6 +550,16 @@ int tc_buffer_wait_send(void* buf, int64_t timeoutMs) {
   return code != TC_OK ? code : rv;
 }
 
+int tc_buffer_wait_put(void* buf, int64_t timeoutMs, int* srcOut) {
+  int rv = TC_OK;
+  int code = wrap([&] {
+    if (!asBuffer(buf)->waitPutArrival(srcOut, ms(timeoutMs))) {
+      rv = TC_ERR_ABORTED;
+    }
+  });
+  return code != TC_OK ? code : rv;
+}
+
 int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
   int rv = TC_OK;
   int code = wrap([&] {
@@ -573,9 +583,10 @@ int tc_buffer_remote_key(void* buf, char* out, size_t outLen) {
 }
 
 int tc_buffer_put(void* buf, const char* key, size_t keyLen, size_t offset,
-                  size_t roffset, size_t nbytes) {
+                  size_t roffset, size_t nbytes, int notify) {
   return wrap([&] {
-    asBuffer(buf)->put(std::string(key, keyLen), offset, roffset, nbytes);
+    asBuffer(buf)->put(std::string(key, keyLen), offset, roffset, nbytes,
+                       notify != 0);
   });
 }
 

@@ -136,10 +136,11 @@ std::unique_ptr<UnboundBuffer> Context::createUnboundBuffer(void* ptr,
   return std::make_unique<UnboundBuffer>(this, ptr, size);
 }
 
-uint64_t Context::registerRegion(char* ptr, size_t size) {
+uint64_t Context::registerRegion(char* ptr, size_t size,
+                                 UnboundBuffer* owner) {
   std::lock_guard<std::mutex> guard(mu_);
   const uint64_t token = nextRegionToken_++;
-  regions_[token] = Region{ptr, size};
+  regions_[token] = Region{ptr, size, owner};
   return token;
 }
 
@@ -161,7 +162,8 @@ bool Context::readRegion(uint64_t token, uint64_t roffset, uint64_t nbytes,
 }
 
 bool Context::writeRegion(uint64_t token, uint64_t roffset,
-                          const char* data, size_t nbytes) {
+                          const char* data, size_t nbytes, bool notify,
+                          int srcRank) {
   std::lock_guard<std::mutex> guard(mu_);
   auto it = regions_.find(token);
   if (it == regions_.end() || roffset > it->second.size ||
@@ -169,18 +171,26 @@ bool Context::writeRegion(uint64_t token, uint64_t roffset,
     return false;
   }
   std::memcpy(it->second.ptr + roffset, data, nbytes);
+  if (notify && it->second.owner != nullptr) {
+    // Under mu_ by design (see header): ~UnboundBuffer unregisters under
+    // this same mutex first, so no notification can outlive the owner.
+    // onRegionPutArrived skips pending-recv accounting — nothing was
+    // posted for a one-sided arrival.
+    it->second.owner->onRegionPutArrived(srcRank);
+  }
   return true;
 }
 
 void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
-                      uint64_t roffset, char* data, size_t nbytes) {
+                      uint64_t roffset, char* data, size_t nbytes,
+                      bool notify) {
   TC_ENFORCE(dstRank >= 0 && dstRank < size_, "bad destination rank ",
              dstRank);
   if (dstRank == rank_) {
     // Local put: straight into the registered region (one memcpy under
     // the region lock, no staging copy).
     buf->addPendingSend();
-    if (!writeRegion(token, roffset, data, nbytes)) {
+    if (!writeRegion(token, roffset, data, nbytes, notify, rank_)) {
       buf->cancelPendingSend();
       TC_THROW(EnforceError, "local put outside the registered region");
     }
@@ -200,7 +210,7 @@ void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
   try {
-    pair->sendPut(buf, token, roffset, data, nbytes);
+    pair->sendPut(buf, token, roffset, data, nbytes, notify);
   } catch (...) {
     buf->cancelPendingSend();
     throw;
@@ -233,7 +243,7 @@ void Context::postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
   std::vector<char> payload(sizeof(req));
   std::memcpy(payload.data(), &req, sizeof(req));
   WireHeader header{kMsgMagic, static_cast<uint8_t>(Opcode::kGetReq),
-                    {0, 0, 0}, respSlot, sizeof(req), 0};
+                    0, {0, 0}, respSlot, sizeof(req), 0};
   pair->sendOwned(header, std::move(payload));
 }
 

@@ -56,8 +56,9 @@ class Context {
   // ---- one-sided registered regions (RemoteKey put/get) ----
   // Register [ptr, ptr+size) as a one-sided target; returns the token a
   // serialized RemoteKey carries. Peers may then put into / get from the
-  // region with no posted operation on this side.
-  uint64_t registerRegion(char* ptr, size_t size);
+  // region with no posted operation on this side; notify-puts complete a
+  // waitRecv on `owner`.
+  uint64_t registerRegion(char* ptr, size_t size, UnboundBuffer* owner);
   void unregisterRegion(uint64_t token);
   // Loop thread: validate + copy bytes out of a region (get). Empty
   // optional-like: returns false when the token is unknown or the range
@@ -65,9 +66,12 @@ class Context {
   bool readRegion(uint64_t token, uint64_t roffset, uint64_t nbytes,
                   std::vector<char>* out);
   // Loop thread: validate + copy bytes into a region (put). Returns false
-  // on unknown token / out-of-bounds (the caller poisons the pair).
+  // on unknown token / out-of-bounds (the caller poisons the pair). With
+  // notify, the owner's waitRecv completes (srcRank reported); the
+  // callback runs under mu_, which makes unregisterRegion a barrier: once
+  // it returns no further notification can touch the owner.
   bool writeRegion(uint64_t token, uint64_t roffset, const char* data,
-                   size_t nbytes);
+                   size_t nbytes, bool notify = false, int srcRank = -1);
 
   // Graceful teardown: closes all pairs; pending operations fail with
   // IoException. Idempotent.
@@ -79,7 +83,8 @@ class Context {
   // One-sided write: local bytes -> peer's registered region (token,
   // roffset). Completion via buf->waitSend; nothing happens peer-side.
   void postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
-               uint64_t roffset, char* data, size_t nbytes);
+               uint64_t roffset, char* data, size_t nbytes,
+               bool notify = false);
   // One-sided read: request region bytes from dstRank; they arrive as a
   // normal message on respSlot (buf must have a recv posted for it).
   void postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
@@ -152,6 +157,7 @@ class Context {
   struct Region {
     char* ptr;
     size_t size;
+    UnboundBuffer* owner;
   };
   std::unordered_map<uint64_t, Region> regions_;
   uint64_t nextRegionToken_{1};

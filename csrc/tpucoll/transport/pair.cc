@@ -22,6 +22,19 @@
 namespace tpucoll {
 namespace transport {
 
+namespace {
+
+// Typed handshake failures so the retry loop classifies robustly instead
+// of substring-matching error text.
+struct AuthRejected : IoException {
+  using IoException::IoException;
+};
+struct HandshakeEof : IoException {
+  using IoException::IoException;
+};
+
+}  // namespace
+
 Pair::Pair(Context* context, Loop* loop, int selfRank, int peerRank,
            uint64_t localPairId)
     : context_(context),
@@ -40,10 +53,16 @@ Pair::~Pair() {
 void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
                    std::chrono::milliseconds timeout) {
   static constexpr std::chrono::milliseconds kBackoff{50};
+  // Clean EOF mid-handshake is ambiguous: a peer restarting during
+  // bootstrap (retryable) or a permanent auth/encryption tier mismatch
+  // (terminal). Bounded retries resolve the ambiguity without burning
+  // the whole deadline on a misconfiguration.
+  static constexpr int kMaxEofRetries = 3;
   const auto deadline = std::chrono::steady_clock::now() + timeout;
   const bool retriesDisabled =
       std::getenv("TPUCOLL_DISABLE_CONNECTION_RETRIES") != nullptr;
   int attempt = 0;
+  int eofAttempts = 0;
   while (true) {
     attempt++;
     ConnectDebugData d;
@@ -60,16 +79,28 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
       d.error = "timed out";
       logConnectAttempt(d);
       throw;
-    } catch (const IoException& e) {
+    } catch (const AuthRejected& e) {
+      // A live peer refuted the tag: terminal, retrying a wrong key is
+      // noise.
       d.error = e.what();
-      // Definite auth rejections ("failed authentication", a bad tag
-      // from a live peer) are terminal — retrying a wrong key is noise.
-      // Everything else (refused, reset, clean EOF mid-handshake — the
-      // peer restarting during bootstrap) retries until the deadline.
-      d.willRetry =
-          !retriesDisabled &&
-          d.error.find("failed authentication") == std::string::npos &&
-          std::chrono::steady_clock::now() + kBackoff < deadline;
+      logConnectAttempt(d);
+      throw;
+    } catch (const HandshakeEof& e) {
+      d.error = e.what();
+      eofAttempts++;
+      d.willRetry = !retriesDisabled && eofAttempts <= kMaxEofRetries &&
+                    std::chrono::steady_clock::now() + kBackoff < deadline;
+      logConnectAttempt(d);
+      if (!d.willRetry) {
+        throw;
+      }
+      std::this_thread::sleep_for(kBackoff);
+    } catch (const IoException& e) {
+      // Refused/reset/poll errors: the peer is still coming up; retry
+      // until the deadline.
+      d.error = e.what();
+      d.willRetry = !retriesDisabled &&
+                    std::chrono::steady_clock::now() + kBackoff < deadline;
       logConnectAttempt(d);
       if (!d.willRetry) {
         throw;
@@ -180,8 +211,9 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
       ssize_t n = ::recv(fd, p + got, len - got, 0);
       if (n == 0) {
         ::close(fd);
-        TC_THROW(IoException, what, ": rank ", peerRank_,
-                 " closed the connection (authentication mismatch?)");
+        TC_THROW(HandshakeEof, what, ": rank ", peerRank_,
+                 " closed the connection during the handshake "
+                 "(restarting peer, or auth/encryption tier mismatch)");
       }
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -242,7 +274,7 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
     if (!macEqual(reply + kAuthNonceBytes, srvExpect.data(),
                   kAuthMacBytes)) {
       ::close(fd);
-      TC_THROW(IoException, "rank ", peerRank_,
+      TC_THROW(AuthRejected, "rank ", peerRank_,
                " failed authentication (bad server tag)");
     }
     auto cliMac = transcript("cli");
@@ -303,7 +335,7 @@ void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
                 size_t nbytes) {
   TxOp op;
   op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
-                         {0, 0, 0}, slot, nbytes};
+                         0, {0, 0}, slot, nbytes};
   op.ubuf = ubuf;
   op.data = data;
   op.nbytes = nbytes;
@@ -311,10 +343,11 @@ void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
 }
 
 void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
-                   const char* data, size_t nbytes) {
+                   const char* data, size_t nbytes, bool notify) {
   TxOp op;
   op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kPut),
-                         {0, 0, 0}, token, nbytes, roffset};
+                         notify ? kPutFlagNotify : uint8_t(0), {0, 0},
+                         token, nbytes, roffset};
   op.ubuf = ubuf;
   op.data = data;
   op.nbytes = nbytes;
@@ -636,7 +669,9 @@ void Pair::readLoop() {
           // Zero-byte puts still validate the token/offset: the same
           // contract violation must not pass or fail based on length.
           if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
-                                     nullptr, 0)) {
+                                     nullptr, 0,
+                                     rxHeader_.flags & kPutFlagNotify,
+                                     peerRank_)) {
             fail(detail::strCat("one-sided put outside registered region "
                                 "from rank ", peerRank_));
             return;
@@ -791,8 +826,9 @@ void Pair::finishMessage() {
     }
     case RxMode::kPut:
       if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
-                                 rxStashData_.data(),
-                                 rxStashData_.size())) {
+                                 rxStashData_.data(), rxStashData_.size(),
+                                 rxHeader_.flags & kPutFlagNotify,
+                                 peerRank_)) {
         // Unknown token or out-of-bounds: a peer contract violation
         // (bounds are validated sender-side against the RemoteKey, so
         // only a stale key or a buggy/malicious peer lands here).
@@ -816,7 +852,7 @@ void Pair::finishMessage() {
       // bytes were copied out under the region lock, so the response
       // cannot race the exporting buffer's teardown.
       WireHeader header{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
-                        {0, 0, 0}, rxHeader_.slot, data.size(), 0};
+                        0, {0, 0}, rxHeader_.slot, data.size(), 0};
       try {
         sendOwned(header, std::move(data));
       } catch (const std::exception&) {
@@ -868,7 +904,7 @@ void Pair::close() {
       TxOp op;
       op.header = WireHeader{kMsgMagic,
                              static_cast<uint8_t>(Opcode::kGoodbye),
-                             {0, 0, 0}, 0, 0};
+                             0, {0, 0}, 0, 0};
       op.ubuf = nullptr;
       op.data = nullptr;
       op.nbytes = 0;

@@ -64,8 +64,10 @@ class Pair : public Handler {
             size_t nbytes);
 
   // One-sided write into the peer's registered region (kPut framing).
+  // notify: the target's exporting buffer gets a waitRecv completion on
+  // arrival (bound-buffer semantics).
   void sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
-               const char* data, size_t nbytes);
+               const char* data, size_t nbytes, bool notify = false);
 
   // Enqueue a message whose payload the op itself owns (get requests and
   // get responses): no completion callback, safe from any thread.

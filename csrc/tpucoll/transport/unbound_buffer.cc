@@ -93,14 +93,14 @@ WireRemoteKey parseRemoteKey(const std::string& blob) {
 std::string UnboundBuffer::getRemoteKey() {
   if (regionToken_ == 0) {
     regionToken_ =
-        context_->registerRegion(static_cast<char*>(ptr_), size_);
+        context_->registerRegion(static_cast<char*>(ptr_), size_, this);
   }
   WireRemoteKey key{kRemoteKeyMagic, context_->rank(), regionToken_, size_};
   return std::string(reinterpret_cast<const char*>(&key), sizeof(key));
 }
 
 void UnboundBuffer::put(const std::string& remoteKey, size_t offset,
-                        size_t roffset, size_t nbytes) {
+                        size_t roffset, size_t nbytes, bool notify) {
   const WireRemoteKey key = parseRemoteKey(remoteKey);
   TC_ENFORCE(key.rank >= 0 && key.rank < context_->size(),
              "remote key rank ", key.rank, " outside group of ",
@@ -114,7 +114,7 @@ void UnboundBuffer::put(const std::string& remoteKey, size_t offset,
     abortSend_ = false;
   }
   context_->postPut(this, key.rank, key.token, roffset,
-                    static_cast<char*>(ptr_) + offset, nbytes);
+                    static_cast<char*>(ptr_) + offset, nbytes, notify);
 }
 
 void UnboundBuffer::get(const std::string& remoteKey, uint64_t slot,
@@ -200,6 +200,29 @@ bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
   return true;
 }
 
+bool UnboundBuffer::waitPutArrival(int* srcRank,
+                                   std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto pred = [&] {
+    return !putArrivals_.empty() || abortRecv_ || failed_;
+  };
+  if (!waitFor(lock, pred, timeout)) {
+    TC_THROW(TimeoutException, "waitPutArrival timed out after ",
+             timeout.count(), "ms");
+  }
+  if (failed_ && putArrivals_.empty()) {
+    TC_THROW(IoException, error_);
+  }
+  if (abortRecv_ && putArrivals_.empty()) {
+    return false;
+  }
+  if (srcRank != nullptr) {
+    *srcRank = putArrivals_.front();
+  }
+  putArrivals_.pop_front();
+  return true;
+}
+
 void UnboundBuffer::abortWaitSend() {
   {
     std::lock_guard<std::mutex> guard(mu_);
@@ -223,6 +246,12 @@ void UnboundBuffer::onSendComplete() {
     completedSends_++;
     cv_.notify_all();
   }
+}
+
+void UnboundBuffer::onRegionPutArrived(int srcRank) {
+  std::lock_guard<std::mutex> guard(mu_);
+  putArrivals_.push_back(srcRank);
+  cv_.notify_all();
 }
 
 void UnboundBuffer::onRecvComplete(int srcRank) {

@@ -58,8 +58,12 @@ class UnboundBuffer {
 
   // One-sided write: local [offset, offset+nbytes) into the remote region
   // [roffset, ...). Completion via waitSend; the target posts nothing.
+  // notify=true additionally completes a waitRecv on the exporting buffer
+  // when the payload lands — the reference's BOUND-buffer contract
+  // (one-sided write into registered memory + arrival notification,
+  // gloo/transport/buffer.h:16-41).
   void put(const std::string& remoteKey, size_t offset, size_t roffset,
-           size_t nbytes);
+           size_t nbytes, bool notify = false);
 
   // One-sided read: remote region [roffset, roffset+nbytes) into local
   // [offset, ...). Completion via waitRecv (the region bytes arrive as a
@@ -74,6 +78,11 @@ class UnboundBuffer {
   // Wait for one recv to complete; *srcRank (if non-null) receives the
   // source. Same failure contract as waitSend.
   bool waitRecv(int* srcRank, std::chrono::milliseconds timeout);
+  // Wait for one notify-put arrival into this buffer's exported region
+  // (bound-buffer waitRecv analog). Kept on a SEPARATE queue from posted
+  // receives so one-sided arrivals can never satisfy — or be satisfied
+  // by — a tagged recv. Honors abortWaitRecv.
+  bool waitPutArrival(int* srcRank, std::chrono::milliseconds timeout);
 
   // Unblock current and future waiters (they return false) until the abort
   // flag is cleared by the next send/recv post.
@@ -83,6 +92,9 @@ class UnboundBuffer {
   // --- completion callbacks (Context / Pair internals) ---
   void onSendComplete();
   void onRecvComplete(int srcRank);
+  // Notify-put arrival: queues a waitRecv completion WITHOUT pending-recv
+  // accounting (no recv was posted; the peer wrote one-sidedly).
+  void onRegionPutArrived(int srcRank);
   // Error paths decrement the matching pending count so destruction can
   // always account for every operation exactly once.
   void onSendError(const std::string& message);
@@ -110,6 +122,7 @@ class UnboundBuffer {
   int pendingRecvs_{0};
   int completedSends_{0};
   std::deque<int> completedRecvs_;
+  std::deque<int> putArrivals_;  // notify-put sources (separate contract)
   bool abortSend_{false};
   bool abortRecv_{false};
   std::string error_;

@@ -108,11 +108,19 @@ enum class Opcode : uint8_t {
   kGetReq = 4,
 };
 
+// WireHeader.flags bits (valid for kPut):
+//   bit 0: notify — complete a waitRecv on the target's exporting buffer
+//   when the payload lands (the reference's BOUND-buffer contract:
+//   one-sided write into pre-registered memory with an arrival
+//   notification, gloo/transport/buffer.h:16-41 waitRecv).
+constexpr uint8_t kPutFlagNotify = 1;
+
 #pragma pack(push, 1)
 struct WireHeader {
   uint32_t magic;
   uint8_t opcode;
-  uint8_t reserved[3];
+  uint8_t flags;
+  uint8_t reserved[2];
   uint64_t slot;
   uint64_t nbytes;
   uint64_t aux;  // kPut: remote offset; others: 0

@@ -137,9 +137,11 @@ _PROTOTYPES = {
                                   _sz]),
     "tc_buffer_wait_send": (_int, [_c, _i64]),
     "tc_buffer_wait_recv": (_int, [_c, _i64, ctypes.POINTER(_int)]),
+    "tc_buffer_wait_put": (_int, [_c, _i64, ctypes.POINTER(_int)]),
     "tc_remote_key_size": (_sz, []),
     "tc_buffer_remote_key": (_int, [_c, ctypes.c_char_p, _sz]),
-    "tc_buffer_put": (_int, [_c, ctypes.c_char_p, _sz, _sz, _sz, _sz]),
+    "tc_buffer_put": (_int, [_c, ctypes.c_char_p, _sz, _sz, _sz, _sz,
+                             _int]),
     "tc_buffer_get": (_int, [_c, ctypes.c_char_p, _sz, _u64, _sz, _sz,
                              _sz]),
     "tc_buffer_abort_wait_send": (None, [_c]),

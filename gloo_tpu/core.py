@@ -368,6 +368,20 @@ class UnboundBuffer:
         check(code)
         return src.value
 
+    def wait_put(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Wait for one notify-put arrival into this buffer's exported
+        region (bound-buffer waitRecv analog); returns the source rank,
+        or None if aborted. A SEPARATE queue from wait_recv: one-sided
+        arrivals never satisfy a posted tagged recv or vice versa."""
+        src = ctypes.c_int(-1)
+        code = _lib.lib.tc_buffer_wait_put(
+            self._handle, self._context._resolve_timeout_ms(timeout),
+            ctypes.byref(src))
+        if code == _lib._TC_ERR_ABORTED:
+            return None
+        check(code)
+        return src.value
+
     def abort_wait_send(self) -> None:
         _lib.lib.tc_buffer_abort_wait_send(self._handle)
 
@@ -387,15 +401,18 @@ class UnboundBuffer:
         return out.raw
 
     def put(self, remote_key: bytes, offset: int = 0, roffset: int = 0,
-            nbytes: Optional[int] = None) -> None:
+            nbytes: Optional[int] = None, notify: bool = False) -> None:
         """One-sided write: local [offset, offset+nbytes) into the remote
         region at roffset. Completion via wait_send; the target posts
-        nothing. Bounds are validated against the key synchronously."""
+        nothing. notify=True additionally completes a wait_put on the
+        EXPORTING buffer when the payload lands (the reference's bound-
+        buffer contract: registered memory + arrival notification).
+        Bounds are validated against the key synchronously."""
         if nbytes is None:
             nbytes = self._array.nbytes - offset
         check(_lib.lib.tc_buffer_put(self._handle, remote_key,
                                      len(remote_key), offset, roffset,
-                                     nbytes))
+                                     nbytes, 1 if notify else 0))
 
     def get(self, remote_key: bytes, slot: int, offset: int = 0,
             roffset: int = 0, nbytes: Optional[int] = None) -> None:

@@ -218,3 +218,45 @@ def test_put_get_across_processes():
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, (out, err)
         assert "OK" in out
+
+
+def test_put_notify_bound_buffer_semantics():
+    """put(notify=True) is the reference BOUND-buffer contract
+    (gloo/transport/buffer.h:16-41): a one-sided write into registered
+    memory that completes a wait_recv on the exporting buffer — no recv
+    ever posted. Ring exchange: rank r puts to its right neighbor."""
+
+    def fn(ctx, rank):
+        inbox = np.zeros(64, dtype=np.float64)
+        inbox_buf = ctx.register(inbox)
+        keys = _exchange_keys(ctx, inbox_buf.get_remote_key())
+        right = (rank + 1) % ctx.size
+        left = (rank - 1) % ctx.size
+
+        payload = np.full(64, float(rank), dtype=np.float64)
+        out_buf = ctx.register(payload)
+        out_buf.put(keys[right], nbytes=64 * 8, notify=True)
+        out_buf.wait_send()
+
+        src = inbox_buf.wait_put()  # completes on the notify arrival
+        assert src == left, (src, left)
+        np.testing.assert_array_equal(inbox, np.full(64, float(left)))
+        ctx.barrier()
+        return True
+
+    assert all(spawn(4, fn))
+
+
+def test_put_notify_self():
+    def fn(ctx, rank):
+        region = np.zeros(8, dtype=np.float32)
+        region_buf = ctx.register(region)
+        key = region_buf.get_remote_key()
+        src_buf = ctx.register(np.arange(8, dtype=np.float32))
+        src_buf.put(key, nbytes=32, notify=True)
+        src_buf.wait_send()
+        assert region_buf.wait_put() == rank
+        np.testing.assert_array_equal(region, np.arange(8))
+        return True
+
+    assert all(spawn(2, fn))
