@@ -83,18 +83,13 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
     pltpu.semaphore_wait(barrier, 2)
 
     # --- phase 1: reduce-scatter ---
-    def rs_step(s, _):
+    # Send/recv decoupled (see the HBM kernel): wait only the incoming
+    # chunk before reducing — the outgoing transfer overlaps the VPU add —
+    # and drain send completions two steps late at semaphore-slot reuse.
+    def rs_rdma(s):
         send_chunk = lax.rem(my - s + n, n)
-        recv_chunk = lax.rem(my - s - 1 + n, n)
         slot = lax.rem(s, 2)
-
-        # Reuse of a comm slot (step s >= 2) requires the right neighbor to
-        # have consumed what we previously parked there.
-        @pl.when(s >= 2)
-        def _():
-            pltpu.semaphore_wait(ack_sem.at[slot], 1)
-
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=o_ref.at[chunk_slice(send_chunk)],
             dst_ref=comm_ref.at[slot],
             send_sem=rs_send.at[slot],
@@ -102,8 +97,22 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def rs_step(s, _):
+        recv_chunk = lax.rem(my - s - 1 + n, n)
+        slot = lax.rem(s, 2)
+
+        # Reuse of a comm slot (step s >= 2) requires the right neighbor to
+        # have consumed what we previously parked there, and our own s-2
+        # send to have fully left (its send semaphore is reused now).
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+            rs_rdma(s - 2).wait_send()
+
+        rdma = rs_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
 
         o_ref[chunk_slice(recv_chunk), :] = (
             o_ref[chunk_slice(recv_chunk), :] + comm_ref[slot])
@@ -114,15 +123,17 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
 
     lax.fori_loop(0, n - 1, rs_step, 0)
 
-    # Drain outstanding acks so the semaphores end the kernel at zero
-    # (ack for steps n-3 and n-2 were signaled but never awaited).
+    # Drain outstanding acks and deferred send completions so every
+    # semaphore ends the kernel at zero.
     @pl.when(n >= 3)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+        rs_rdma(n - 3).wait_send()
 
     @pl.when(n >= 2)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+        rs_rdma(n - 2).wait_send()
 
     # --- phase 2: allgather ---
     # After reduce-scatter, rank r owns fully-reduced chunk (r + 1). Each
@@ -132,9 +143,9 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
     # step ahead release this device's wait before the matching chunk
     # actually landed (each signal is indistinguishable on a shared slot),
     # and the next step would then forward stale data.
-    def ag_step(s, _):
+    def ag_rdma(s):
         send_chunk = lax.rem(my + 1 - s + n, n)
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=o_ref.at[chunk_slice(send_chunk)],
             dst_ref=o_ref.at[chunk_slice(send_chunk)],
             send_sem=ag_send.at[s],
@@ -142,11 +153,20 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def ag_step(s, _):
+        rdma = ag_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def ag_drain(s, _):
+        ag_rdma(s).wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
 
 
 @functools.partial(jax.jit,
@@ -498,18 +518,12 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
         q = jnp.clip(jnp.round(chunk / safe), -127, 127).astype(jnp.int8)
         return q, scale
 
-    def rs_step(s, _):
-        send_chunk = lax.rem(my - s + n, n)
-        recv_chunk = lax.rem(my - s - 1 + n, n)
+    # Same send/recv decoupling as the HBM kernel: start the outgoing
+    # DMAs, wait only for the INCOMING pair before dequant-accumulating,
+    # and drain send completions two steps late when their staging slot
+    # and semaphore are about to be reused.
+    def rs_dmas(s):
         slot = lax.rem(s, 2)
-
-        @pl.when(s >= 2)
-        def _():
-            pltpu.semaphore_wait(ack_sem.at[slot], 2)
-
-        q, scale = quantize(o_ref[chunk_slice(send_chunk), :])
-        qcomm_ref[2 + slot] = q  # local staging slots 2/3; wire slots 0/1
-        scomm_ref[2 + slot] = jnp.full((8, 128), scale, jnp.float32)
         qdma = pltpu.make_async_remote_copy(
             src_ref=qcomm_ref.at[2 + slot], dst_ref=qcomm_ref.at[slot],
             send_sem=rs_send.at[slot], recv_sem=rs_recv.at[slot],
@@ -518,10 +532,30 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
             src_ref=scomm_ref.at[2 + slot], dst_ref=scomm_ref.at[slot],
             send_sem=rs_send.at[slot], recv_sem=rs_recv.at[slot],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return qdma, sdma
+
+    def rs_step(s, _):
+        send_chunk = lax.rem(my - s + n, n)
+        recv_chunk = lax.rem(my - s - 1 + n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            # Receiver freed the wire slot AND our s-2 send left the
+            # chip (its staging slot is overwritten just below).
+            pltpu.semaphore_wait(ack_sem.at[slot], 2)
+            oq, os_ = rs_dmas(s - 2)
+            oq.wait_send()
+            os_.wait_send()
+
+        q, scale = quantize(o_ref[chunk_slice(send_chunk), :])
+        qcomm_ref[2 + slot] = q  # local staging slots 2/3; wire slots 0/1
+        scomm_ref[2 + slot] = jnp.full((8, 128), scale, jnp.float32)
+        qdma, sdma = rs_dmas(s)
         qdma.start()
         sdma.start()
-        qdma.wait()
-        sdma.wait()
+        qdma.wait_recv()
+        sdma.wait_recv()
 
         inc = (qcomm_ref[slot].astype(jnp.float32) *
                scomm_ref[slot, 0, 0])
@@ -536,10 +570,16 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
     @pl.when(n >= 3)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 2)
+        oq, os_ = rs_dmas(n - 3)
+        oq.wait_send()
+        os_.wait_send()
 
     @pl.when(n >= 2)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 2)
+        oq, os_ = rs_dmas(n - 2)
+        oq.wait_send()
+        os_.wait_send()
 
     # Allgather: quantize the owned block once, adopt its decoded values
     # locally, then forward the received int8 stream verbatim. Wire slots
@@ -554,8 +594,7 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
     scomm_ref[4 + stage] = jnp.full((8, 128), scale0, jnp.float32)
     o_ref[chunk_slice(own), :] = q0.astype(jnp.float32) * scale0
 
-    def ag_step(s, _):
-        recv_chunk = lax.rem(my - s + n, n)
+    def ag_dmas(s):
         src_slot = jax.lax.select(s == 0, stage, s - 1)
         dst_slot = s
         qdma = pltpu.make_async_remote_copy(
@@ -568,16 +607,31 @@ def _ring_allreduce_q8_kernel(x_ref, o_ref, qcomm_ref, scomm_ref, rs_send,
             dst_ref=scomm_ref.at[4 + dst_slot],
             send_sem=ag_send.at[2 * s + 1], recv_sem=ag_recv.at[2 * s + 1],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return qdma, sdma
+
+    def ag_step(s, _):
+        # Wait only the incoming stream before decoding; per-step
+        # semaphores let every send completion drain after the loop.
+        recv_chunk = lax.rem(my - s + n, n)
+        qdma, sdma = ag_dmas(s)
         qdma.start()
         sdma.start()
-        qdma.wait()
-        sdma.wait()
+        qdma.wait_recv()
+        sdma.wait_recv()
         o_ref[chunk_slice(recv_chunk), :] = (
-            qcomm_ref[4 + dst_slot].astype(jnp.float32) *
-            scomm_ref[4 + dst_slot, 0, 0])
+            qcomm_ref[4 + s].astype(jnp.float32) *
+            scomm_ref[4 + s, 0, 0])
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def ag_drain(s, _):
+        qdma, sdma = ag_dmas(s)
+        qdma.wait_send()
+        sdma.wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
 
 
 @functools.partial(jax.jit,
@@ -697,13 +751,17 @@ def _ring_allreduce_bidir_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
         def _():
             pltpu.semaphore_wait(ack_sem.at[0, slot], 1)
             pltpu.semaphore_wait(ack_sem.at[1, slot], 1)
+            rs_rdma(0, s - 2).wait_send()
+            rs_rdma(1, s - 2).wait_send()
 
         dma0 = rs_rdma(0, s)
         dma1 = rs_rdma(1, s)
         dma0.start()
         dma1.start()
-        dma0.wait()
-        dma1.wait()
+        # Wait only the incoming halves (send/recv decoupled as in the
+        # unidirectional kernels); send completions drain at slot reuse.
+        dma0.wait_recv()
+        dma1.wait_recv()
         for d in (0, 1):
             rc = rs_recv_chunk(d, s)
             col0 = d * half_cols
@@ -723,10 +781,12 @@ def _ring_allreduce_bidir_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
         @pl.when(n >= 3)
         def _():
             pltpu.semaphore_wait(ack_sem.at[d, lax.rem(n - 3, 2)], 1)
+            rs_rdma(d, n - 3).wait_send()
 
         @pl.when(n >= 2)
         def _():
             pltpu.semaphore_wait(ack_sem.at[d, lax.rem(n - 2, 2)], 1)
+            rs_rdma(d, n - 2).wait_send()
 
     def ag_send_chunk(d, s):
         return jax.lax.select(d == 0, lax.rem(my + 1 - s + n, n),
@@ -749,11 +809,18 @@ def _ring_allreduce_bidir_kernel(x_ref, o_ref, comm_ref, rs_send, rs_recv,
         dma1 = ag_rdma(1, s)
         dma0.start()
         dma1.start()
-        dma0.wait()
-        dma1.wait()
+        dma0.wait_recv()
+        dma1.wait_recv()
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def ag_drain(s, _):
+        ag_rdma(0, s).wait_send()
+        ag_rdma(1, s).wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
 
 
 @functools.partial(jax.jit,
@@ -831,16 +898,13 @@ def _ring_reduce_scatter_kernel(x_ref, o_ref, work_ref, comm_ref, rs_send,
     def chunk_slice(idx):
         return pl.ds(idx * chunk_rows, chunk_rows)
 
-    def rs_step(s, _):
+    # Send/recv decoupled like the allreduce kernels: the outgoing chunk
+    # flies while the received one reduces; send waits drain at slot
+    # reuse and in the epilogue.
+    def rs_rdma(s):
         send_chunk = lax.rem(my - 1 - s + 2 * n, n)
-        recv_chunk = lax.rem(my - 2 - s + 2 * n, n)
         slot = lax.rem(s, 2)
-
-        @pl.when(s >= 2)
-        def _():
-            pltpu.semaphore_wait(ack_sem.at[slot], 1)
-
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=work_ref.at[chunk_slice(send_chunk)],
             dst_ref=comm_ref.at[slot],
             send_sem=rs_send.at[slot],
@@ -848,8 +912,19 @@ def _ring_reduce_scatter_kernel(x_ref, o_ref, work_ref, comm_ref, rs_send,
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def rs_step(s, _):
+        recv_chunk = lax.rem(my - 2 - s + 2 * n, n)
+        slot = lax.rem(s, 2)
+
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+            rs_rdma(s - 2).wait_send()
+
+        rdma = rs_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
         work_ref[chunk_slice(recv_chunk), :] = (
             work_ref[chunk_slice(recv_chunk), :] + comm_ref[slot])
         pltpu.semaphore_signal(ack_sem.at[slot], inc=1, device_id=left,
@@ -861,10 +936,12 @@ def _ring_reduce_scatter_kernel(x_ref, o_ref, work_ref, comm_ref, rs_send,
     @pl.when(n >= 3)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+        rs_rdma(n - 3).wait_send()
 
     @pl.when(n >= 2)
     def _():
         pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+        rs_rdma(n - 2).wait_send()
 
     o_ref[...] = work_ref[chunk_slice(my), :]
 
@@ -933,20 +1010,29 @@ def _ring_allgather_kernel(x_ref, o_ref, ag_send, ag_recv, *,
                            device_id_type=pltpu.DeviceIdType.LOGICAL)
     pltpu.semaphore_wait(barrier, 2)
 
-    def ag_step(s, _):
+    def ag_rdma(s):
         send_chunk = lax.rem(my - s + n, n)
         ref = o_ref.at[pl.ds(send_chunk * chunk_rows, chunk_rows), :]
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=ref, dst_ref=ref,
             send_sem=ag_send.at[s], recv_sem=ag_recv.at[s],
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
+
+    def ag_step(s, _):
+        rdma = ag_rdma(s)
         rdma.start()
-        rdma.wait()
+        rdma.wait_recv()
         return 0
 
     lax.fori_loop(0, n - 1, ag_step, 0)
+
+    def ag_drain(s, _):
+        ag_rdma(s).wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
 
 
 @functools.partial(jax.jit,
