@@ -109,3 +109,71 @@ def test_fuzz_collective_sequences(seed):
                                      for src in range(size)])
                 np.testing.assert_array_equal(got, expected,
                                               err_msg=f"step {i}")
+
+
+@pytest.mark.parametrize("seed", [10, 11])
+def test_fuzz_one_sided_mixed(seed):
+    """Mixed one-sided traffic interleaved with collectives, optionally
+    encrypted: random puts (some with notify), gets, and allreduces on
+    one context must never corrupt each other — one-sided frames bypass
+    the matcher while collectives ride it, so slot/stash interactions get
+    a randomized workout here."""
+    rng = np.random.RandomState(seed)
+    size = int(rng.choice([2, 3, 4]))
+    encrypted = bool(rng.randint(2))
+    steps = 10
+    region_words = 4096
+    schedule = []
+    for i in range(steps):
+        kind = rng.choice(["put", "put_notify", "get", "allreduce"])
+        peer_off = int(rng.randint(1, size))
+        count = int(rng.randint(1, 1024))
+        roffset = int(rng.randint(0, region_words - count))
+        schedule.append((str(kind), peer_off, count, roffset))
+
+    def fn(ctx, rank):
+        region = np.zeros(region_words, dtype=np.float64)
+        region_buf = ctx.register(region)
+        keys = None
+        mine = np.frombuffer(region_buf.get_remote_key(),
+                             dtype=np.uint8).copy()
+        keys = [k.tobytes() for k in ctx.allgather(mine)]
+        outs = []
+        for i, (kind, peer_off, count, roffset) in enumerate(schedule):
+            peer = (rank + peer_off) % ctx.size
+            if kind in ("put", "put_notify"):
+                payload = np.full(count, 100.0 * rank + i, np.float64)
+                pbuf = ctx.register(payload)
+                pbuf.put(keys[peer], roffset=roffset * 8,
+                         nbytes=count * 8, notify=kind == "put_notify")
+                pbuf.wait_send()
+                outs.append(None)
+            elif kind == "get":
+                got = np.zeros(count, dtype=np.float64)
+                gbuf = ctx.register(got)
+                gbuf.get(keys[peer], slot=1000 + i,
+                         roffset=roffset * 8, nbytes=count * 8)
+                gbuf.wait_recv()
+                outs.append(got)
+            else:
+                x = np.full(1000, float(rank + 1), np.float32)
+                ctx.allreduce(x, tag=100 + i)
+                outs.append(x[0])
+        # Every notify-put that targeted this rank must produce exactly
+        # one arrival (drained here so nothing leaks across tests).
+        expect_arrivals = sum(
+            1 for src in range(ctx.size)
+            for (k, off, c, ro) in schedule
+            if k == "put_notify" and (src + off) % ctx.size == rank)
+        for _ in range(expect_arrivals):
+            assert region_buf.wait_put(timeout=10.0) is not None
+        ctx.barrier(tag=999)
+        return outs
+
+    kwargs = ({"auth_key": "fuzz", "encrypt": True} if encrypted else {})
+    results = spawn(size, fn, timeout=120, device_kwargs=kwargs)
+    expect_ar = sum(r + 1 for r in range(size))
+    for rank in range(size):
+        for i, (kind, peer_off, count, roffset) in enumerate(schedule):
+            if kind == "allreduce":
+                assert results[rank][i] == expect_ar
