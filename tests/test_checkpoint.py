@@ -106,9 +106,13 @@ while step < 80:
         # save (its peers sit in this allreduce while it commits).
         ctx.allreduce(grad, timeout=8.0)
     except gloo_tpu.IoError:
+        # settle must exceed the op timeout above: the slowest survivor
+        # only detects the death when ITS allreduce times out, and the
+        # membership roll call has to wait for it (resilience.py
+        # docstring invariant).
         ctx, rank, size = rebuild_after_failure(
             store, gloo_tpu.Device(), old_rank=rank, old_size=size,
-            generation=gen, settle=3.0, timeout=30.0)
+            generation=gen, settle=10.0, timeout=60.0)
         assert ctx is not None
         gen += 1
         # Elastic resume: everyone reloads the last committed state so
