@@ -240,6 +240,11 @@ uint64_t tc_next_slot(void* ctx, uint32_t num) {
 
 void tc_debug_dump(void* ctx) { asContext(ctx)->transport()->debugDump(); }
 
+void tc_context_shm_stats(void* ctx, uint64_t* txBytes, uint64_t* rxBytes,
+                          int* activePairs) {
+  asContext(ctx)->transport()->shmStats(txBytes, rxBytes, activePairs);
+}
+
 void tc_trace_start(void* ctx) { asContext(ctx)->tracer().start(); }
 
 void tc_trace_stop(void* ctx) { asContext(ctx)->tracer().stop(); }

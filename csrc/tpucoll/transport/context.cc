@@ -508,6 +508,23 @@ void Context::stashArrived(int srcRank, uint64_t slot,
   }
 }
 
+void Context::shmStats(uint64_t* txBytes, uint64_t* rxBytes,
+                       int* activePairs) {
+  uint64_t tx = 0, rx = 0;
+  int active = 0;
+  std::lock_guard<std::mutex> guard(mu_);
+  for (auto& pair : pairs_) {
+    if (pair) {
+      tx += pair->shmTxBytes();
+      rx += pair->shmRxBytes();
+      active += pair->shmActive() ? 1 : 0;
+    }
+  }
+  *txBytes = tx;
+  *rxBytes = rx;
+  *activePairs = active;
+}
+
 void Context::debugDump() {
   std::lock_guard<std::mutex> guard(mu_);
   std::string s = "rank " + std::to_string(rank_) + ": posted=[";
@@ -522,6 +539,13 @@ void Context::debugDump() {
          "KB" + (rxPaused_[r] ? "*PAUSED" : "") + " ";
   }
   s += "} stashedCount=" + std::to_string(stashed_.size());
+  s += " pairs={";
+  for (int r = 0; r < size_; r++) {
+    if (pairs_[r]) {
+      s += std::to_string(r) + ":[" + pairs_[r]->debugState() + "] ";
+    }
+  }
+  s += "}";
   fprintf(stderr, "%s\n", s.c_str());
 }
 

@@ -115,6 +115,10 @@ class Context {
   void onPairError(int rank, const std::string& message);
   void debugDump();
 
+  // Shared-memory payload-plane stats summed over pairs: ring bytes sent /
+  // received and how many pairs negotiated the plane (any thread).
+  void shmStats(uint64_t* txBytes, uint64_t* rxBytes, int* activePairs);
+
  private:
   struct PostedRecv {
     UnboundBuffer* ubuf;

@@ -35,9 +35,11 @@ class PendingConn : public Handler {
 
   void handleEvents(uint32_t /*events*/) override {
     while (true) {
-      const size_t want = phase_ == Phase::kHello ? sizeof(WireHello)
-                          : phase_ == Phase::kNonce ? kAuthNonceBytes
-                                                    : kAuthMacBytes;
+      const size_t want = phase_ == Phase::kHello      ? sizeof(WireHello)
+                          : phase_ == Phase::kNonce    ? kAuthNonceBytes
+                          : phase_ == Phase::kShmOffer ? sizeof(WireShmOffer)
+                          : phase_ == Phase::kShmName  ? size_t(offer_.nameLen)
+                                                       : kAuthMacBytes;
       ssize_t n = read(fd_, buf_ + got_, want - got_);
       if (n == 0) {
         listener_->finishPending(this, false, 0, fd_, ConnKeys{});
@@ -63,8 +65,13 @@ class PendingConn : public Handler {
           WireHello hello;
           std::memcpy(&hello, buf_, sizeof(hello));
           pairId_ = hello.pairId;
+          shmOffered_ = (hello.reserved & kHelloFlagShmOffer) != 0;
           const bool wantAuth = !authKey_.empty();
           if (hello.magic == kHelloMagic && !wantAuth) {
+            if (shmOffered_) {
+              phase_ = Phase::kShmOffer;
+              break;
+            }
             listener_->finishPending(this, true, pairId_, fd_, ConnKeys{});
             return;
           }
@@ -101,13 +108,61 @@ class PendingConn : public Handler {
                                    expect.data(), kAuthMacBytes);
           if (!ok) {
             TC_WARN("rejecting inbound connection: bad auth tag");
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
+            return;
           }
-          ConnKeys keys;
-          if (ok && encrypt_) {
-            keys = deriveConnKeys(authKey_, pairId_, nonceI_, nonceL_,
-                                  /*initiator=*/false);
+          if (encrypt_) {
+            keys_ = deriveConnKeys(authKey_, pairId_, nonceI_, nonceL_,
+                                   /*initiator=*/false);
           }
-          listener_->finishPending(this, ok, pairId_, fd_, keys);
+          if (shmOffered_) {
+            phase_ = Phase::kShmOffer;
+            break;
+          }
+          listener_->finishPending(this, true, pairId_, fd_, keys_);
+          return;
+        }
+        case Phase::kShmOffer: {
+          std::memcpy(&offer_, buf_, sizeof(offer_));
+          if (offer_.magic != kShmOfferMagic ||
+              offer_.nameLen > sizeof(buf_)) {
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
+            return;
+          }
+          if (offer_.nameLen == 0) {
+            // The initiator failed to create a segment; acknowledge the
+            // fallback so both sides use TCP payloads.
+            uint8_t verdict = kShmReject;
+            if (!writeFullNoSig(fd_, &verdict, 1)) {
+              listener_->finishPending(this, false, 0, fd_, ConnKeys{});
+              return;
+            }
+            listener_->finishPending(this, true, pairId_, fd_, keys_);
+            return;
+          }
+          phase_ = Phase::kShmName;
+          break;
+        }
+        case Phase::kShmName: {
+          // Accept iff the segment opens and validates (magic, pairId,
+          // size) — which can only happen on the initiator's host, in the
+          // same IPC namespace, under the same user. Everything else
+          // degrades to TCP payloads, never to an error.
+          std::unique_ptr<ShmSegment> seg;
+          const bool sane = shmEnabled() &&
+                            offer_.ringBytes >= (64 << 10) &&
+                            offer_.ringBytes <= (uint64_t(1) << 30);
+          if (sane) {
+            seg = ShmSegment::open(std::string(buf_, offer_.nameLen),
+                                   pairId_, offer_.ringBytes);
+          }
+          uint8_t verdict = seg ? kShmAccept : kShmReject;
+          if (!writeFullNoSig(fd_, &verdict, 1)) {
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
+            return;
+          }
+          listener_->finishPending(this, true, pairId_, fd_, keys_,
+                                   std::move(seg));
           return;
         }
       }
@@ -115,7 +170,7 @@ class PendingConn : public Handler {
   }
 
  private:
-  enum class Phase { kHello, kNonce, kClientMac };
+  enum class Phase { kHello, kNonce, kClientMac, kShmOffer, kShmName };
 
   std::array<uint8_t, 32> transcriptMac(const char* role) const {
     std::string msg(role);
@@ -154,7 +209,10 @@ class PendingConn : public Handler {
   uint64_t pairId_{0};
   uint8_t nonceI_[kAuthNonceBytes];
   uint8_t nonceL_[kAuthNonceBytes];
-  char buf_[64];
+  bool shmOffered_{false};
+  WireShmOffer offer_{};
+  ConnKeys keys_;
+  char buf_[256];  // fits the largest phase read (shm segment name)
   size_t got_{0};
 };
 
@@ -219,7 +277,8 @@ void Listener::handleEvents(uint32_t /*events*/) {
 }
 
 void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
-                             int fd, const ConnKeys& keys) {
+                             int fd, ConnKeys keys,
+                             std::unique_ptr<ShmSegment> shm) {
   Pair* target = nullptr;
   {
     std::lock_guard<std::mutex> guard(mu_);
@@ -239,7 +298,7 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
         target = it->second;
         expected_.erase(it);
       } else {
-        parked_[pairId] = Parked{fd, keys};
+        parked_[pairId] = Parked{fd, keys, std::move(shm)};
       }
     }
   }
@@ -248,26 +307,29 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
     return;
   }
   if (target != nullptr) {
-    target->assumeConnected(fd, keys);
+    target->assumeConnected(fd, keys, std::move(shm),
+                            /*shmInitiator=*/false);
   }
 }
 
 void Listener::expect(uint64_t pairId, Pair* pair) {
   int fd = -1;
   ConnKeys keys;
+  std::unique_ptr<ShmSegment> shm;
   {
     std::lock_guard<std::mutex> guard(mu_);
     auto it = parked_.find(pairId);
     if (it != parked_.end()) {
       fd = it->second.fd;
       keys = it->second.keys;
+      shm = std::move(it->second.shm);
       parked_.erase(it);
     } else {
       expected_[pairId] = pair;
     }
   }
   if (fd >= 0) {
-    pair->assumeConnected(fd, keys);
+    pair->assumeConnected(fd, keys, std::move(shm), /*shmInitiator=*/false);
   }
 }
 

@@ -14,6 +14,7 @@
 
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/loop.h"
+#include "tpucoll/transport/shm.h"
 #include "tpucoll/transport/wire.h"
 
 namespace tpucoll {
@@ -38,9 +39,13 @@ class Listener : public Handler {
   void handleEvents(uint32_t events) override;
 
   // PendingConn completion (loop thread). Destroys `conn`. `keys` carries
-  // the connection's AEAD keys when the device encrypts.
+  // the connection's AEAD keys when the device encrypts; `shm` the accepted
+  // same-host payload segment (listener side), if any. keys is BY VALUE:
+  // callers pass the dying PendingConn's member, which this function frees
+  // before handing the keys on.
   void finishPending(PendingConn* conn, bool ok, uint64_t pairId, int fd,
-                     const ConnKeys& keys);
+                     ConnKeys keys,
+                     std::unique_ptr<ShmSegment> shm = nullptr);
 
  private:
   Loop* const loop_;
@@ -52,6 +57,7 @@ class Listener : public Handler {
   struct Parked {
     int fd;
     ConnKeys keys;
+    std::unique_ptr<ShmSegment> shm;
   };
 
   std::mutex mu_;

@@ -1,5 +1,6 @@
 #include "tpucoll/transport/pair.h"
 
+#include <netinet/in.h>
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -32,6 +33,31 @@ struct AuthRejected : IoException {
 struct HandshakeEof : IoException {
   using IoException::IoException;
 };
+
+// Same-host detection for the shm payload plane: the connected socket's
+// local and peer IPs are equal exactly when both endpoints live on this
+// machine (loopback, or a connection to the host's own address — the only
+// way peer IP == my IP). False negatives (multi-homed exotica) merely skip
+// the fast path; false positives are impossible.
+bool sameHostFd(int fd) {
+  sockaddr_storage a{}, b{};
+  socklen_t alen = sizeof(a), blen = sizeof(b);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&a), &alen) != 0 ||
+      getpeername(fd, reinterpret_cast<sockaddr*>(&b), &blen) != 0 ||
+      a.ss_family != b.ss_family) {
+    return false;
+  }
+  if (a.ss_family == AF_INET) {
+    return reinterpret_cast<sockaddr_in*>(&a)->sin_addr.s_addr ==
+           reinterpret_cast<sockaddr_in*>(&b)->sin_addr.s_addr;
+  }
+  if (a.ss_family == AF_INET6) {
+    return std::memcmp(&reinterpret_cast<sockaddr_in6*>(&a)->sin6_addr,
+                       &reinterpret_cast<sockaddr_in6*>(&b)->sin6_addr,
+                       sizeof(in6_addr)) == 0;
+  }
+  return false;
+}
 
 }  // namespace
 
@@ -246,12 +272,14 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
 
   // Route this connection to the peer's expecting Pair; with a pre-shared
   // key, run the mutual challenge/response of wire.h on top (and, when the
-  // device encrypts, derive the connection's AEAD keys from it).
+  // device encrypts, derive the connection's AEAD keys from it). When both
+  // endpoints share an IP, also offer the shared-memory payload plane.
   const bool encrypt = context_->device()->encrypt();
+  const bool offerShm = shmEnabled() && sameHostFd(fd);
   WireHello hello{authKey.empty() ? kHelloMagic
                   : encrypt       ? kHelloAuthEncMagic
                                   : kHelloAuthMagic,
-                  0, remotePairId};
+                  offerShm ? kHelloFlagShmOffer : 0, remotePairId};
   writeAll(&hello, sizeof(hello), "hello");
   ConnKeys keys;
   if (!authKey.empty()) {
@@ -284,7 +312,36 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
                             /*initiator=*/true);
     }
   }
-  assumeConnected(fd, keys);
+  std::unique_ptr<ShmSegment> shmSeg;
+  if (offerShm) {
+    // The hello promised an offer, so one is always sent; a failed segment
+    // creation degenerates to a zero-length name the listener rejects. Any
+    // throw below closes the fd and the local unique_ptr unlinks + unmaps.
+    try {
+      shmSeg = ShmSegment::create(remotePairId, shmRingBytesConfig());
+    } catch (const IoException& e) {
+      TC_WARN("shm segment creation failed, using TCP payloads: ", e.what());
+    }
+    WireShmOffer offer{kShmOfferMagic,
+                       shmSeg ? static_cast<uint32_t>(shmSeg->name().size())
+                              : 0,
+                       shmSeg ? shmSeg->ringBytes() : 0};
+    writeAll(&offer, sizeof(offer), "shm offer");
+    if (shmSeg) {
+      writeAll(shmSeg->name().data(), shmSeg->name().size(), "shm name");
+    }
+    uint8_t verdict = kShmReject;
+    readAll(&verdict, sizeof(verdict), "shm verdict");
+    if (shmSeg) {
+      // The peer either has the segment open or refused it; the filesystem
+      // name has served its purpose either way.
+      shmSeg->unlinkName();
+    }
+    if (verdict != kShmAccept) {
+      shmSeg.reset();
+    }
+  }
+  assumeConnected(fd, keys, std::move(shmSeg), /*shmInitiator=*/true);
 }
 
 void Pair::expectViaListener(Listener* listener) {
@@ -292,13 +349,23 @@ void Pair::expectViaListener(Listener* listener) {
   listener->expect(localPairId_, this);
 }
 
-void Pair::assumeConnected(int fd, const ConnKeys& keys) {
+void Pair::assumeConnected(int fd, const ConnKeys& keys,
+                           std::unique_ptr<ShmSegment> shm,
+                           bool shmInitiator) {
   setNonBlocking(fd);
   setBufferSizes(fd, 4 << 20);
   bool accepted = false;
   {
     std::lock_guard<std::mutex> guard(mu_);
     if (state_.load() == State::kInitializing) {
+      if (shm != nullptr) {
+        shm_ = std::move(shm);
+        shmTx_ = shm_->ring(shmInitiator ? 0 : 1);
+        shmRx_ = shm_->ring(shmInitiator ? 1 : 0);
+        shmActive_.store(true, std::memory_order_relaxed);
+        TC_DEBUG("rank ", selfRank_, ": shm payload plane to rank ",
+                 peerRank_, " (", shm_->ringBytes() >> 20, " MiB/dir)");
+      }
       keys_ = keys;
       fd_ = fd;
       epollMask_ = EPOLLIN;
@@ -333,24 +400,34 @@ void Pair::waitConnected(std::chrono::milliseconds timeout) {
 
 void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
                 size_t nbytes) {
+  const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
+                      nbytes >= shmThresholdBytes();
   TxOp op;
-  op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
-                         0, {0, 0}, slot, nbytes};
+  op.header = WireHeader{
+      kMsgMagic,
+      static_cast<uint8_t>(viaShm ? Opcode::kShmData : Opcode::kData),
+      0, {0, 0}, slot, nbytes};
   op.ubuf = ubuf;
   op.data = data;
   op.nbytes = nbytes;
+  op.viaShm = viaShm;
   enqueue(std::move(op));
 }
 
 void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
                    const char* data, size_t nbytes, bool notify) {
+  const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
+                      nbytes >= shmThresholdBytes();
   TxOp op;
-  op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kPut),
-                         notify ? kPutFlagNotify : uint8_t(0), {0, 0},
-                         token, nbytes, roffset};
+  op.header = WireHeader{
+      kMsgMagic,
+      static_cast<uint8_t>(viaShm ? Opcode::kShmPut : Opcode::kPut),
+      notify ? kPutFlagNotify : uint8_t(0), {0, 0},
+      token, nbytes, roffset};
   op.ubuf = ubuf;
   op.data = data;
   op.nbytes = nbytes;
+  op.viaShm = viaShm;
   enqueue(std::move(op));
 }
 
@@ -359,6 +436,14 @@ void Pair::sendOwned(WireHeader header, std::vector<char> payload) {
   op.header = header;
   op.ubuf = nullptr;
   op.nbytes = payload.size();
+  // Large one-sided get responses (plain data messages with an op-owned
+  // payload) take the shm fast path like any other bulk payload.
+  if (header.opcode == static_cast<uint8_t>(Opcode::kData) &&
+      shmActive_.load(std::memory_order_relaxed) &&
+      payload.size() >= shmThresholdBytes()) {
+    op.header.opcode = static_cast<uint8_t>(Opcode::kShmData);
+    op.viaShm = true;
+  }
   op.ownedData = std::move(payload);
   op.data = nullptr;  // fixed up after the move into the queue
   enqueue(std::move(op));
@@ -431,11 +516,182 @@ bool Pair::hasInflightSend(UnboundBuffer* ubuf) {
   return false;
 }
 
+bool Pair::streamAtBoundary() const {
+  if (tx_.empty()) {
+    return true;
+  }
+  const TxOp& op = tx_.front();
+  if (op.viaShm && op.announceDone) {
+    // Between chunk headers of an shm message is a wire-message boundary:
+    // control messages may preempt here (they carry no ordering).
+    return !op.chunkInFlight;
+  }
+  return op.headerSent == 0 && !op.headerSealed;
+}
+
+void Pair::queueCtrl(Opcode opcode) { ctrlQ_.push_back(opcode); }
+
+bool Pair::flushCtrl() {
+  while (true) {
+    if (ctrlSent_ < ctrlLen_) {
+      ssize_t n = ::send(fd_, ctrlBuf_ + ctrlSent_, ctrlLen_ - ctrlSent_,
+                         MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return false;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        pendingTxError_ = errnoString("send");
+        return false;
+      }
+      ctrlSent_ += static_cast<size_t>(n);
+      continue;
+    }
+    if (ctrlQ_.empty() || !streamAtBoundary()) {
+      return true;
+    }
+    WireHeader h{kMsgMagic, static_cast<uint8_t>(ctrlQ_.front()),
+                 0, {0, 0}, 0, 0, 0};
+    ctrlQ_.pop_front();
+    if (keys_.encrypted) {
+      uint8_t* p = reinterpret_cast<uint8_t*>(ctrlBuf_);
+      aeadSeal(keys_.tx, txSeq_++, nullptr, 0,
+               reinterpret_cast<const uint8_t*>(&h), sizeof(h), p,
+               p + sizeof(h));
+      ctrlLen_ = sizeof(WireHeader) + kAeadTagBytes;
+    } else {
+      std::memcpy(ctrlBuf_, &h, sizeof(h));
+      ctrlLen_ = sizeof(WireHeader);
+    }
+    ctrlSent_ = 0;
+  }
+}
+
+Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
+                                      std::vector<UnboundBuffer*>* completed) {
+  // Sends a small header's bytes; returns kDone / kSocketFull / kError.
+  auto pushBytes = [&](const char* base, size_t len,
+                       size_t* sent) -> ShmTxStatus {
+    while (*sent < len) {
+      ssize_t n = ::send(fd_, base + *sent, len - *sent, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          return ShmTxStatus::kSocketFull;
+        }
+        if (errno == EINTR) {
+          continue;
+        }
+        pendingTxError_ = errnoString("send");
+        return ShmTxStatus::kError;
+      }
+      *sent += static_cast<size_t>(n);
+    }
+    return ShmTxStatus::kDone;
+  };
+
+  if (!op->announceDone) {
+    ShmTxStatus st;
+    if (keys_.encrypted) {
+      if (!op->headerSealed) {
+        sealHeaderFrame(op);
+      }
+      st = pushBytes(op->cipher.data(), op->cipher.size(), &op->cipherSent);
+    } else {
+      st = pushBytes(reinterpret_cast<const char*>(&op->header),
+                     sizeof(WireHeader), &op->headerSent);
+    }
+    if (st != ShmTxStatus::kDone) {
+      return st;
+    }
+    op->announceDone = true;
+  }
+
+  // Chunks are capped at a quarter ring so the receiver starts draining
+  // while later chunks are still being written (sender-copy / receiver-copy
+  // overlap); a full-ring chunk would serialize the two memcpys.
+  const uint64_t maxChunk =
+      std::max<uint64_t>(shmTx_.cap / 4, uint64_t(64) << 10);
+  while (true) {
+    if (op->chunkInFlight) {
+      ShmTxStatus st;
+      if (keys_.encrypted) {
+        st = pushBytes(op->cipher.data(), op->cipher.size(),
+                       &op->cipherSent);
+      } else {
+        st = pushBytes(reinterpret_cast<const char*>(&op->chunkHeader),
+                       sizeof(WireHeader), &op->chunkHeaderSent);
+      }
+      if (st != ShmTxStatus::kDone) {
+        return st;
+      }
+      op->chunkInFlight = false;
+    }
+    if (op->shmAnnounced == op->nbytes) {
+      completed->push_back(op->ubuf);
+      tx_.pop_front();  // op is dangling from here
+      return ShmTxStatus::kDone;
+    }
+    const uint64_t want =
+        std::min<uint64_t>(op->nbytes - op->shmWritten, maxChunk);
+    const uint64_t w = shmTx_.write(op->data + op->shmWritten, want);
+    if (w == 0) {
+      // Ring full with nothing in flight to piggyback on: ask for an
+      // explicit wakeup. By FIFO the receiver has consumed every chunk
+      // announced before the request by the time it reads it, so its
+      // credit always signals real space.
+      if (!op->creditReqSent) {
+        queueCtrl(Opcode::kShmCreditReq);
+        op->creditReqSent = true;
+      }
+      txRingBlocked_ = true;
+      return ShmTxStatus::kRingBlocked;
+    }
+    op->creditReqSent = false;  // progress: a future stall re-requests
+    op->shmWritten += w;
+    shmTxBytes_.fetch_add(w, std::memory_order_relaxed);
+    op->chunkHeader = WireHeader{kMsgMagic,
+                                 static_cast<uint8_t>(Opcode::kShmChunk),
+                                 0, {0, 0}, 0,
+                                 op->shmWritten - op->shmAnnounced, 0};
+    op->shmAnnounced = op->shmWritten;
+    op->chunkHeaderSent = 0;
+    if (keys_.encrypted) {
+      op->cipher.resize(sizeof(WireHeader) + kAeadTagBytes);
+      op->cipherSent = 0;
+      uint8_t* p = reinterpret_cast<uint8_t*>(op->cipher.data());
+      aeadSeal(keys_.tx, txSeq_++, nullptr, 0,
+               reinterpret_cast<const uint8_t*>(&op->chunkHeader),
+               sizeof(WireHeader), p, p + sizeof(WireHeader));
+    }
+    op->chunkInFlight = true;
+  }
+}
+
 void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
   if (fd_ < 0) {
     return;
   }
-  while (!tx_.empty()) {
+  while (true) {
+    // The control channel first: finish any in-flight credit frame, then
+    // emit queued ones whenever the data stream sits at a boundary.
+    if (!flushCtrl()) {
+      return;
+    }
+    if (tx_.empty()) {
+      return;
+    }
+    if (tx_.front().viaShm) {
+      ShmTxStatus st = flushShmFront(&tx_.front(), completed);
+      if (st == ShmTxStatus::kDone) {
+        continue;
+      }
+      if (st == ShmTxStatus::kRingBlocked) {
+        flushCtrl();  // push the credit request out before parking
+      }
+      return;
+    }
     TxOp& op = tx_.front();
     if (keys_.encrypted) {
       if (op.cipherSent == op.cipher.size()) {
@@ -539,8 +795,13 @@ void Pair::updateEpollMask() {
   if (fd_ < 0 || state_.load() != State::kConnected) {
     return;
   }
+  // EPOLLOUT only when socket progress is possible: a front op parked on
+  // ring space has no bytes to write (its wakeup is the peer's credit),
+  // but pending control frames always count.
+  const bool txWants = ctrlSent_ < ctrlLen_ || !ctrlQ_.empty() ||
+                       (!tx_.empty() && !txRingBlocked_);
   uint32_t desired = (rxPaused_ ? 0u : uint32_t(EPOLLIN)) |
-                     (tx_.empty() ? 0u : uint32_t(EPOLLOUT));
+                     (txWants ? uint32_t(EPOLLOUT) : 0u);
   if (desired != epollMask_) {
     loop_->mod(fd_, desired, this);
     epollMask_ = desired;
@@ -657,6 +918,223 @@ void Pair::readLoop() {
           peerGoodbye_ = true;
         }
         cv_.notify_all();
+        rxHeaderRead_ = 0;
+        continue;
+      }
+      // ---- shared-memory payload plane ----
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit) ||
+          rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCreditReq)) {
+        const bool isGrant =
+            rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit);
+        std::vector<UnboundBuffer*> completed;
+        std::string txError;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          if (isGrant) {
+            txRingBlocked_ = false;
+            if (!tx_.empty() && tx_.front().viaShm) {
+              tx_.front().creditReqSent = false;
+            }
+          } else {
+            queueCtrl(Opcode::kShmCredit);
+          }
+          flushTx(&completed);
+          if (state_.load() == State::kConnected) {
+            updateEpollMask();
+          }
+          txError = pendingTxError_;
+          pendingTxError_.clear();
+        }
+        cv_.notify_all();
+        for (auto* b : completed) {
+          if (b != nullptr) {
+            b->onSendComplete();
+          }
+        }
+        if (!txError.empty()) {
+          fail(txError);
+          return;
+        }
+        rxHeaderRead_ = 0;
+        continue;
+      }
+      if (shmRxActive_ &&
+          rxHeader_.opcode != static_cast<uint8_t>(Opcode::kShmChunk)) {
+        // The sender's FIFO guarantees chunk announcements are contiguous;
+        // anything else mid-message is a protocol violation.
+        fail(detail::strCat("message interleaved with shm chunks from rank ",
+                            peerRank_));
+        return;
+      }
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmData) ||
+          rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
+        if (!shmActive_.load(std::memory_order_relaxed)) {
+          fail(detail::strCat("shm message without a negotiated segment "
+                              "from rank ", peerRank_));
+          return;
+        }
+        const size_t nbytes = rxHeader_.nbytes;
+        if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
+          if (nbytes == 0) {
+            if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
+                                       nullptr, 0,
+                                       rxHeader_.flags & kPutFlagNotify,
+                                       peerRank_)) {
+              fail(detail::strCat("one-sided put outside registered region "
+                                  "from rank ", peerRank_));
+              return;
+            }
+            rxHeaderRead_ = 0;
+            continue;
+          }
+          shmRxActive_ = true;
+          shmRxHeader_ = rxHeader_;
+          shmRxTotal_ = nbytes;
+          shmRxDone_ = 0;
+          shmRxMode_ = RxMode::kPut;
+          shmRxDest_ = nullptr;
+          rxHeaderRead_ = 0;
+          continue;
+        }
+        Context::Match match;
+        try {
+          match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
+        } catch (const std::exception& e) {
+          fail(detail::strCat("receive matching failed: ", e.what()));
+          return;
+        }
+        if (nbytes == 0) {
+          if (match.direct) {
+            match.ubuf->onRecvComplete(peerRank_);
+          } else {
+            context_->stashArrived(peerRank_, rxHeader_.slot, {});
+          }
+          rxHeaderRead_ = 0;
+          continue;
+        }
+        shmRxActive_ = true;
+        shmRxHeader_ = rxHeader_;
+        shmRxTotal_ = nbytes;
+        shmRxDone_ = 0;
+        if (match.direct) {
+          shmRxMode_ = RxMode::kDirect;
+          shmRxDest_ = match.dest;
+          std::lock_guard<std::mutex> guard(mu_);
+          rxUbuf_ = match.ubuf;
+        } else {
+          shmRxMode_ = RxMode::kStash;
+          shmRxStash_.resize(nbytes);
+          shmRxDest_ = shmRxStash_.data();
+        }
+        rxHeaderRead_ = 0;
+        continue;
+      }
+      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmChunk)) {
+        if (!shmRxActive_) {
+          fail(detail::strCat("shm chunk without an announced message "
+                              "from rank ", peerRank_));
+          return;
+        }
+        const uint64_t chunk = rxHeader_.nbytes;
+        if (chunk == 0 || chunk > shmRxTotal_ - shmRxDone_ ||
+            chunk > shmRx_.usedBytes()) {
+          fail(detail::strCat("shm chunk exceeds announced message or ring "
+                              "contents from rank ", peerRank_));
+          return;
+        }
+        bool ok = true;
+        if (shmRxMode_ == RxMode::kPut) {
+          // Ring spans land straight in the registered region (validated
+          // per span under the context lock) — no staging copy.
+          const uint64_t base = shmRxHeader_.aux + shmRxDone_;
+          ok = shmRx_.consume(
+              chunk, [&](const char* p, uint64_t len, uint64_t off) {
+                return context_->writeRegion(shmRxHeader_.slot, base + off,
+                                             p, len, false, peerRank_);
+              });
+        } else {
+          char* dst = shmRxDest_ + shmRxDone_;
+          shmRx_.consume(chunk,
+                         [&](const char* p, uint64_t len, uint64_t off) {
+                           std::memcpy(dst + off, p, len);
+                           return true;
+                         });
+        }
+        if (!ok) {
+          fail(detail::strCat("one-sided put outside registered region "
+                              "from rank ", peerRank_));
+          return;
+        }
+        shmRxDone_ += chunk;
+        shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
+        consumed += chunk;
+        // Eager credit after draining a big chunk: the sender throttles on
+        // ring space, and this lets it refill while we keep consuming.
+        if (chunk * 8 >= shmRx_.cap) {
+          std::vector<UnboundBuffer*> completed;
+          std::string txError;
+          {
+            std::lock_guard<std::mutex> guard(mu_);
+            queueCtrl(Opcode::kShmCredit);
+            flushTx(&completed);
+            if (state_.load() == State::kConnected) {
+              updateEpollMask();
+            }
+            txError = pendingTxError_;
+            pendingTxError_.clear();
+          }
+          for (auto* b : completed) {
+            if (b != nullptr) {
+              b->onSendComplete();
+            }
+          }
+          if (!txError.empty()) {
+            fail(txError);
+            return;
+          }
+        }
+        if (shmRxDone_ == shmRxTotal_) {
+          shmRxActive_ = false;
+          switch (shmRxMode_) {
+            case RxMode::kDirect: {
+              UnboundBuffer* b = nullptr;
+              {
+                std::lock_guard<std::mutex> guard(mu_);
+                b = rxUbuf_;
+                rxUbuf_ = nullptr;
+              }
+              if (b != nullptr) {
+                b->onRecvComplete(peerRank_);
+              }
+              break;
+            }
+            case RxMode::kStash:
+              try {
+                context_->stashArrived(peerRank_, shmRxHeader_.slot,
+                                       std::move(shmRxStash_));
+              } catch (const std::exception& e) {
+                fail(detail::strCat("receive matching failed: ", e.what()));
+                return;
+              }
+              shmRxStash_ = std::vector<char>();
+              break;
+            case RxMode::kPut:
+              if (shmRxHeader_.flags & kPutFlagNotify) {
+                // Zero-byte notify write: completes the exporting buffer's
+                // waitRecv now that every chunk has landed.
+                if (!context_->writeRegion(shmRxHeader_.slot,
+                                           shmRxHeader_.aux, nullptr, 0,
+                                           true, peerRank_)) {
+                  fail(detail::strCat("one-sided put outside registered "
+                                      "region from rank ", peerRank_));
+                  return;
+                }
+              }
+              break;
+            default:
+              break;
+          }
+        }
         rxHeaderRead_ = 0;
         continue;
       }
@@ -869,6 +1347,23 @@ void Pair::finishMessage() {
   rxDest_ = nullptr;
 }
 
+std::string Pair::debugState() {
+  std::lock_guard<std::mutex> guard(mu_);
+  std::string s = "txq=" + std::to_string(tx_.size());
+  if (shmActive_.load(std::memory_order_relaxed)) {
+    s += " shm[tx=" + std::to_string(shmTxBytes_.load() >> 10) +
+         "KB rx=" + std::to_string(shmRxBytes_.load() >> 10) + "KB";
+    if (txRingBlocked_) {
+      s += " RING-BLOCKED";  // waiting on a kShmCredit wakeup
+    }
+    if (!ctrlQ_.empty() || ctrlSent_ < ctrlLen_) {
+      s += " ctrl=" + std::to_string(ctrlQ_.size());
+    }
+    s += "]";
+  }
+  return s;
+}
+
 void Pair::pauseReading() {
   std::lock_guard<std::mutex> guard(mu_);
   if (!rxPaused_) {
@@ -949,6 +1444,10 @@ void Pair::teardown(State target, const std::string& message,
       sends.push_back(op.ubuf);
     }
     tx_.clear();
+    txRingBlocked_ = false;
+    ctrlQ_.clear();
+    ctrlLen_ = 0;
+    ctrlSent_ = 0;
     fd = fd_;
     fd_ = -1;
     rxb = rxUbuf_;

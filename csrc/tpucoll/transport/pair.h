@@ -18,6 +18,7 @@
 
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/loop.h"
+#include "tpucoll/transport/shm.h"
 #include "tpucoll/transport/unbound_buffer.h"
 #include "tpucoll/transport/wire.h"
 
@@ -90,8 +91,24 @@ class Pair : public Handler {
   void handleEvents(uint32_t events) override;
 
   // Called by the listener (loop thread) when our inbound connection is up.
-  // `keys` carries the connection's AEAD keys on encrypted devices.
-  void assumeConnected(int fd, const ConnKeys& keys = ConnKeys{});
+  // `keys` carries the connection's AEAD keys on encrypted devices; `shm`
+  // the negotiated same-host payload segment (nullptr: TCP payloads), with
+  // `shmInitiator` selecting this side's ring directions.
+  void assumeConnected(int fd, const ConnKeys& keys = ConnKeys{},
+                       std::unique_ptr<ShmSegment> shm = nullptr,
+                       bool shmInitiator = false);
+
+  // One-line tx/flow-control state for Context::debugDump (any thread).
+  std::string debugState();
+
+  // Shared-memory payload plane introspection (any thread).
+  bool shmActive() const { return shmActive_.load(std::memory_order_relaxed); }
+  uint64_t shmTxBytes() const {
+    return shmTxBytes_.load(std::memory_order_relaxed);
+  }
+  uint64_t shmRxBytes() const {
+    return shmRxBytes_.load(std::memory_order_relaxed);
+  }
 
   // Receiver-side flow control (called by Context under its own lock):
   // pause stops reading this pair's socket so TCP backpressure throttles a
@@ -119,11 +136,34 @@ class Pair : public Handler {
     size_t sealOffset{0};       // payload bytes sealed so far
     // Self-owned payload (get requests/responses): `data` points into it.
     std::vector<char> ownedData;
+    // Shared-memory payload plane (wire.h kShm*): the payload moves
+    // through the pair's shm ring; the socket carries only the announce
+    // header and per-chunk headers.
+    bool viaShm{false};
+    bool announceDone{false};       // announce header fully on the wire
+    uint64_t shmWritten{0};         // payload bytes copied into the ring
+    uint64_t shmAnnounced{0};       // payload bytes covered by chunk headers
+    bool creditReqSent{false};      // a kShmCreditReq is out for this stall
+    WireHeader chunkHeader{};       // current chunk header (plain path)
+    size_t chunkHeaderSent{0};
+    bool chunkInFlight{false};
   };
+
+  // Outcome of trying to advance the front shm op (mu_ held).
+  enum class ShmTxStatus { kDone, kSocketFull, kRingBlocked, kError };
 
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
   // ops' buffers are appended to `completed` (callbacks run without mu_).
   void flushTx(std::vector<UnboundBuffer*>* completed);
+  // Advance the front (shm) op: announce header, ring writes, chunk
+  // headers, credit requests. mu_ held.
+  ShmTxStatus flushShmFront(TxOp* op, std::vector<UnboundBuffer*>* completed);
+  // Drain the control channel (credits/credit requests), which preempts
+  // the data stream only at wire-message boundaries. Returns false when
+  // the socket is full or an error was recorded. mu_ held.
+  bool flushCtrl();
+  bool streamAtBoundary() const;  // mu_ held
+  void queueCtrl(Opcode opcode);  // mu_ held; caller flushes + updates mask
   // Shared enqueue path behind send/sendPut/sendOwned (acquires mu_).
   void enqueue(TxOp op);
   // One connection attempt: TCP connect + hello + (optional) PSK
@@ -178,6 +218,24 @@ class Pair : public Handler {
   uint64_t txSeq_{0};
   uint64_t rxSeq_{0};
 
+  // ---- shared-memory payload plane ----
+  std::unique_ptr<ShmSegment> shm_;  // set before CONNECTED, freed in dtor
+  ShmRing shmTx_;
+  ShmRing shmRx_;
+  std::atomic<bool> shmActive_{false};
+  std::atomic<uint64_t> shmTxBytes_{0};
+  std::atomic<uint64_t> shmRxBytes_{0};
+  // tx-side flow control (mu_): front op stalled on ring space, waiting
+  // for a kShmCredit wakeup.
+  bool txRingBlocked_{false};
+  // Control channel (mu_): queued credit/credit-request opcodes plus the
+  // one currently hitting the wire (raw header, or sealed frame).
+  std::deque<Opcode> ctrlQ_;
+  char ctrlBuf_[sizeof(WireHeader) + kAeadTagBytes];
+  size_t ctrlLen_{0};
+  size_t ctrlSent_{0};
+
+
   // rx state, loop thread only
   enum class RxMode { kDirect, kStash, kPut, kGetReq };
   WireHeader rxHeader_{};
@@ -192,6 +250,16 @@ class Pair : public Handler {
   // trails the in-place payload ciphertext.
   uint8_t rxHeaderCipher_[sizeof(WireHeader) + kAeadTagBytes];
   uint8_t rxPayloadTag_[kAeadTagBytes];
+
+  // rx-side shm message state (loop thread only): set by a kShmData/kShmPut
+  // announce, advanced by kShmChunk, cleared at message completion.
+  bool shmRxActive_{false};
+  RxMode shmRxMode_{RxMode::kDirect};
+  WireHeader shmRxHeader_{};   // the announce header (slot/aux/flags)
+  char* shmRxDest_{nullptr};   // direct: user memory; stash: shmRxStash_
+  std::vector<char> shmRxStash_;
+  uint64_t shmRxTotal_{0};
+  uint64_t shmRxDone_{0};
 };
 
 }  // namespace transport

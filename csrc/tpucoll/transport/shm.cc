@@ -1,0 +1,216 @@
+#include "tpucoll/transport/shm.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+
+#include "tpucoll/common/hmac.h"
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+bool shmEnabled() {
+  static const bool v = [] {
+    const char* e = std::getenv("TPUCOLL_SHM");
+    return e == nullptr || std::strcmp(e, "0") != 0;
+  }();
+  return v;
+}
+
+uint64_t shmRingBytesConfig() {
+  static const uint64_t v = [] {
+    const char* e = std::getenv("TPUCOLL_SHM_RING");
+    long long b = e != nullptr ? std::atoll(e) : 0;
+    if (e == nullptr || b <= 0) {
+      return uint64_t(8) << 20;
+    }
+    // Clamp into the window listeners accept (listener.cc sanity check);
+    // an out-of-window value would otherwise create-and-offer a segment
+    // every connect only to be rejected into TCP fallback each time.
+    const uint64_t lo = 64 << 10, hi = uint64_t(1) << 30;
+    const uint64_t u = static_cast<uint64_t>(b);
+    return u < lo ? lo : u > hi ? hi : u;
+  }();
+  return v;
+}
+
+uint64_t shmThresholdBytes() {
+  static const uint64_t v = [] {
+    const char* e = std::getenv("TPUCOLL_SHM_THRESHOLD");
+    long long b = e != nullptr ? std::atoll(e) : 0;
+    if (b < 1) {
+      b = 32 << 10;
+    }
+    return static_cast<uint64_t>(b);
+  }();
+  return v;
+}
+
+namespace {
+
+constexpr uint32_t kShmSegMagic = 0x7C011006;
+constexpr uint32_t kShmSegVersion = 1;
+
+// Header page layout. Counters live on their own cache lines so the
+// producer's head stores never false-share with the consumer's tail stores
+// (each wrapped in an alignas struct — aligning the bare array would only
+// align its start, leaving head and tail 8 bytes apart on one line).
+struct PaddedCounter {
+  alignas(64) std::atomic<uint64_t> v;
+};
+struct SegHdr {
+  uint32_t magic;
+  uint32_t version;
+  uint64_t pairId;
+  uint64_t ringBytes;
+  PaddedCounter counters[4];  // head0, tail0, head1, tail1
+};
+constexpr size_t kHdrBytes = 4096;
+static_assert(sizeof(SegHdr) <= kHdrBytes, "segment header fits one page");
+
+size_t mapSize(uint64_t ringBytes) { return kHdrBytes + 2 * ringBytes; }
+
+}  // namespace
+
+uint64_t ShmRing::write(const char* src, uint64_t n) {
+  const uint64_t h = head->load(std::memory_order_relaxed);
+  const uint64_t free = cap - (h - tail->load(std::memory_order_acquire));
+  if (n > free) {
+    n = free;
+  }
+  if (n == 0) {
+    return 0;
+  }
+  const uint64_t off = h % cap;
+  const uint64_t first = n < cap - off ? n : cap - off;
+  std::memcpy(data + off, src, first);
+  if (n > first) {
+    std::memcpy(data, src + first, n - first);
+  }
+  head->store(h + n, std::memory_order_release);
+  return n;
+}
+
+std::unique_ptr<ShmSegment> ShmSegment::create(uint64_t pairId,
+                                               uint64_t ringBytes) {
+  uint8_t rnd[16];
+  randomBytes(rnd, sizeof(rnd));
+  char name[64];
+  // 128 random bits: collision with a concurrently chosen name is
+  // impossible in practice, and a stale segment can never be confused for
+  // ours (O_EXCL below).
+  snprintf(name, sizeof(name),
+           "/tpucoll-%02x%02x%02x%02x%02x%02x%02x%02x"
+           "%02x%02x%02x%02x%02x%02x%02x%02x",
+           rnd[0], rnd[1], rnd[2], rnd[3], rnd[4], rnd[5], rnd[6], rnd[7],
+           rnd[8], rnd[9], rnd[10], rnd[11], rnd[12], rnd[13], rnd[14],
+           rnd[15]);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    TC_THROW(IoException, "shm_open(", name, "): ", strerror(errno));
+  }
+  const size_t bytes = mapSize(ringBytes);
+  if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+    int savedErrno = errno;
+    ::close(fd);
+    shm_unlink(name);
+    TC_THROW(IoException, "ftruncate(", name, ", ", bytes,
+             "): ", strerror(savedErrno));
+  }
+  void* base = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                    0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    TC_THROW(IoException, "mmap(", name, "): ", strerror(errno));
+  }
+  auto* hdr = new (base) SegHdr();
+  hdr->pairId = pairId;
+  hdr->ringBytes = ringBytes;
+  for (auto& c : hdr->counters) {
+    c.v.store(0, std::memory_order_relaxed);
+  }
+  hdr->version = kShmSegVersion;
+  // Magic last: an opener that wins a (theoretical) race sees either no
+  // magic or a fully initialized header.
+  reinterpret_cast<std::atomic<uint32_t>*>(&hdr->magic)
+      ->store(kShmSegMagic, std::memory_order_release);
+
+  auto seg = std::unique_ptr<ShmSegment>(new ShmSegment());
+  seg->name_ = name;
+  seg->linked_ = true;
+  seg->base_ = base;
+  seg->mapBytes_ = bytes;
+  seg->ringBytes_ = ringBytes;
+  return seg;
+}
+
+std::unique_ptr<ShmSegment> ShmSegment::open(const std::string& name,
+                                             uint64_t pairId,
+                                             uint64_t ringBytes) {
+  if (name.empty() || name[0] != '/' || name.size() > 255) {
+    return nullptr;
+  }
+  int fd = shm_open(name.c_str(), O_RDWR, 0);
+  if (fd < 0) {
+    return nullptr;  // different host / IPC namespace, or already gone
+  }
+  const size_t bytes = mapSize(ringBytes);
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size != static_cast<off_t>(bytes)) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                    0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    return nullptr;
+  }
+  auto* hdr = static_cast<SegHdr*>(base);
+  if (reinterpret_cast<std::atomic<uint32_t>*>(&hdr->magic)
+              ->load(std::memory_order_acquire) != kShmSegMagic ||
+      hdr->version != kShmSegVersion || hdr->pairId != pairId ||
+      hdr->ringBytes != ringBytes) {
+    munmap(base, bytes);
+    return nullptr;
+  }
+  auto seg = std::unique_ptr<ShmSegment>(new ShmSegment());
+  seg->name_ = name;
+  seg->base_ = base;
+  seg->mapBytes_ = bytes;
+  seg->ringBytes_ = ringBytes;
+  return seg;
+}
+
+void ShmSegment::unlinkName() {
+  if (linked_) {
+    shm_unlink(name_.c_str());
+    linked_ = false;
+  }
+}
+
+ShmRing ShmSegment::ring(int dir) const {
+  auto* hdr = static_cast<SegHdr*>(base_);
+  ShmRing r;
+  r.head = &hdr->counters[dir * 2].v;
+  r.tail = &hdr->counters[dir * 2 + 1].v;
+  r.data = static_cast<char*>(base_) + kHdrBytes + dir * ringBytes_;
+  r.cap = ringBytes_;
+  return r;
+}
+
+ShmSegment::~ShmSegment() {
+  unlinkName();
+  if (base_ != nullptr) {
+    munmap(base_, mapBytes_);
+  }
+}
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,113 @@
+// Shared-memory payload plane for same-host pairs.
+//
+// The reference exposes intra-host awareness (gloo/transport/pair.h:79-100
+// localRank) but never exploits it; NCCL-class backends do, with a SHM
+// transport between co-located ranks. Here the TCP stream stays the control
+// plane (headers, ordering, matching, failure detection all unchanged) while
+// large payloads move through a pair-private shared-memory segment holding
+// one lock-free SPSC byte ring per direction. One memcpy in (sender), one
+// memcpy out (receiver loop thread) — no syscalls, no socket buffers, no
+// kernel wakeups on the bulk path.
+//
+// Negotiated during the connect handshake: the initiator creates the
+// segment and offers its name when both socket endpoints share an IP; the
+// listener accepts iff it can open and validate the segment (random 128-bit
+// names plus a magic/pairId stamp make cross-host or cross-namespace
+// acceptance impossible — it simply fails to open and the pair falls back
+// to plain TCP payloads).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace tpucoll {
+namespace transport {
+
+// Process-wide configuration (read once). TPUCOLL_SHM=0 disables the
+// plane entirely; TPUCOLL_SHM_RING sizes each direction's ring (default
+// 8 MiB, clamped to [64 KiB, 1 GiB] — the window listeners accept);
+// TPUCOLL_SHM_THRESHOLD sets the payload size at and above which messages
+// ride the ring instead of the socket (default 32 KiB, min 1 — the
+// small-message latency path stays on the eager TCP protocol, which needs
+// no chunk round trips).
+bool shmEnabled();
+uint64_t shmRingBytesConfig();
+uint64_t shmThresholdBytes();
+
+// One direction of the segment: a single-producer single-consumer byte ring.
+// head = total bytes produced, tail = total bytes consumed (both monotonic;
+// the difference is the fill level). The producer owns head, the consumer
+// owns tail; each reads the other's counter with acquire ordering so the
+// data memcpy is visible before the counter that publishes it.
+struct ShmRing {
+  std::atomic<uint64_t>* head{nullptr};
+  std::atomic<uint64_t>* tail{nullptr};
+  char* data{nullptr};
+  uint64_t cap{0};
+
+  uint64_t freeBytes() const {
+    return cap - (head->load(std::memory_order_relaxed) -
+                  tail->load(std::memory_order_acquire));
+  }
+  uint64_t usedBytes() const {
+    return head->load(std::memory_order_acquire) -
+           tail->load(std::memory_order_relaxed);
+  }
+  // Producer: copy up to n bytes in (bounded by free space); returns the
+  // number written. Handles wraparound with a split memcpy.
+  uint64_t write(const char* src, uint64_t n);
+  // Consumer: stream n bytes (which the producer has published — the caller
+  // learned the count from a chunk-announce message) through fn as one or
+  // two contiguous spans, then advance tail. fn(ptr, len, offsetInMessage)
+  // returns false to abort (tail still advances; the pair is dying anyway).
+  template <typename Fn>
+  bool consume(uint64_t n, Fn&& fn) {
+    const uint64_t t = tail->load(std::memory_order_relaxed);
+    const uint64_t off = t % cap;
+    const uint64_t first = n < cap - off ? n : cap - off;
+    bool ok = fn(data + off, first, uint64_t(0));
+    if (ok && n > first) {
+      ok = fn(data, n - first, first);
+    }
+    tail->store(t + n, std::memory_order_release);
+    return ok;
+  }
+};
+
+class ShmSegment {
+ public:
+  ~ShmSegment();
+
+  // Initiator: create a fresh segment with two rings of ringBytes each,
+  // stamped with pairId. Throws IoException on failure.
+  static std::unique_ptr<ShmSegment> create(uint64_t pairId,
+                                            uint64_t ringBytes);
+  // Listener: open and validate an offered segment. Returns nullptr on any
+  // mismatch or failure (the caller then rejects the offer).
+  static std::unique_ptr<ShmSegment> open(const std::string& name,
+                                          uint64_t pairId,
+                                          uint64_t ringBytes);
+
+  const std::string& name() const { return name_; }
+  uint64_t ringBytes() const { return ringBytes_; }
+  // Drop the filesystem name; the mappings keep the memory alive. Called by
+  // the initiator as soon as the peer has the segment open (or on failure).
+  void unlinkName();
+
+  // dir 0: initiator -> listener; dir 1: listener -> initiator.
+  ShmRing ring(int dir) const;
+
+ private:
+  ShmSegment() = default;
+
+  std::string name_;
+  bool linked_{false};  // name still present in /dev/shm (we created it)
+  void* base_{nullptr};
+  size_t mapBytes_{0};
+  uint64_t ringBytes_{0};
+};
+
+}  // namespace transport
+}  // namespace tpucoll

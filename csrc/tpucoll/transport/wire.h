@@ -106,7 +106,58 @@ enum class Opcode : uint8_t {
   // normal kData message carrying region bytes on the response slot, so
   // the response rides the ordinary matching path.
   kGetReq = 4,
+  // ---- shared-memory payload plane (shm.h; same-host pairs only) ----
+  // These opcodes carry NO socket payload: the payload bytes move through
+  // the pair's shared-memory ring, and the TCP stream carries only the
+  // framing — so ordering, matching, timeouts, and failure detection are
+  // exactly the TCP protocol's. On encrypted connections the headers are
+  // sealed as usual while ring bytes stay plaintext: the ring never
+  // crosses the network and the segment is a 0600 same-user mapping, so
+  // the wire threat model (on-path attacker) does not reach it.
+  //
+  // Announces a message whose payload will arrive through the ring.
+  // Fields exactly as kData (slot, nbytes = total payload bytes); for
+  // kShmPut as kPut (slot = region token, aux = remote offset, flags
+  // kPutFlagNotify). Chunk announcements follow contiguously (the sender's
+  // FIFO guarantees no other data-bearing message interleaves).
+  kShmData = 5,
+  kShmPut = 6,
+  // Announces that nbytes MORE payload bytes of the current shm message
+  // are in the ring (written before this header was sent, so they are
+  // visible to the receiver by the time it reads the header).
+  kShmChunk = 7,
+  // Flow control. kShmCreditReq: the sender's ring is full and it has
+  // nothing in flight to piggyback on; the receiver — which by FIFO has
+  // consumed every previously announced chunk by the time it reads this —
+  // replies kShmCredit. kShmCredit: pure wakeup, also sent eagerly after
+  // consuming a large chunk so the sender refills while the receiver
+  // drains (pipelining). Both are idempotent and carry no ordering
+  // semantics, which is why they alone may preempt the tx queue at
+  // message boundaries.
+  kShmCreditReq = 8,
+  kShmCredit = 9,
 };
+
+// WireHello.reserved bits.
+constexpr uint32_t kHelloFlagShmOffer = 1;  // shm offer follows handshake
+
+// The shm offer the initiator sends after the (possibly authenticated)
+// handshake: {this struct}{name bytes}. The listener replies one byte,
+// kShmAccept or kShmReject; on reject both sides fall back to TCP
+// payloads. A tampered or corrupted offer can only cause a reject or an
+// open() failure — never a wrong mapping (segments are stamped with the
+// pairId and found by unguessable random name).
+#pragma pack(push, 1)
+struct WireShmOffer {
+  uint32_t magic;  // kShmOfferMagic
+  uint32_t nameLen;
+  uint64_t ringBytes;
+};
+#pragma pack(pop)
+constexpr uint32_t kShmOfferMagic = 0x7C011007;
+constexpr uint8_t kShmAccept = 1;
+constexpr uint8_t kShmReject = 0;
+static_assert(sizeof(WireShmOffer) == 16, "shm offer must be packed");
 
 // WireHeader.flags bits (valid for kPut):
 //   bit 0: notify — complete a waitRecv on the target's exporting buffer

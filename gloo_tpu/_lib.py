@@ -95,6 +95,9 @@ _PROTOTYPES = {
     "tc_context_free": (None, [_c]),
     "tc_next_slot": (_u64, [_c, _u32]),
     "tc_debug_dump": (None, [_c]),
+    "tc_context_shm_stats": (None, [_c, ctypes.POINTER(_u64),
+                             ctypes.POINTER(_u64),
+                             ctypes.POINTER(_int)]),
     "tc_trace_start": (None, [_c]),
     "tc_trace_stop": (None, [_c]),
     "tc_trace_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(

@@ -492,6 +492,18 @@ class Context:
         backpressure flags) to stderr — the deadlock diagnosis tool."""
         _lib.lib.tc_debug_dump(self._handle)
 
+    def shm_stats(self) -> dict:
+        """Shared-memory payload-plane stats: bytes moved through the
+        same-host rings and how many pairs negotiated the plane (0 when
+        peers are remote or TPUCOLL_SHM=0)."""
+        tx = ctypes.c_uint64()
+        rx = ctypes.c_uint64()
+        pairs = ctypes.c_int()
+        _lib.lib.tc_context_shm_stats(self._handle, ctypes.byref(tx),
+                                      ctypes.byref(rx), ctypes.byref(pairs))
+        return {"tx_bytes": tx.value, "rx_bytes": rx.value,
+                "active_pairs": pairs.value}
+
     # ---- tracing (capability the reference lacks) ----
 
     def trace_start(self) -> None:
