@@ -1083,6 +1083,7 @@ void Pair::readLoop() {
             txError = pendingTxError_;
             pendingTxError_.clear();
           }
+          cv_.notify_all();  // close() may be waiting on tx_ draining
           for (auto* b : completed) {
             if (b != nullptr) {
               b->onSendComplete();

@@ -18,7 +18,10 @@ Two usage levels:
 
 from gloo_tpu.tpu import spmd
 from gloo_tpu.tpu.group import TpuProcessGroup
+from gloo_tpu.tpu.hierarchical import (HierarchicalGroup,
+                                       make_hierarchical_ddp)
 from gloo_tpu.tpu.mesh import make_mesh
 from gloo_tpu.tpu.multihost import init_multihost
 
-__all__ = ["TpuProcessGroup", "init_multihost", "make_mesh", "spmd"]
+__all__ = ["HierarchicalGroup", "TpuProcessGroup", "init_multihost",
+           "make_hierarchical_ddp", "make_mesh", "spmd"]

@@ -1,0 +1,241 @@
+"""Hierarchical DCN x ICI collectives: the two planes composed.
+
+A jax.distributed global mesh covers pods whose every host runs the same
+XLA program. The reference also serves the OTHER deployment — independent
+per-host processes whose accelerators cannot form one compiled program
+(elastic groups, heterogeneous slices, DCN-only clusters) — by staging
+device buffers through the host and running the CPU-side schedule across
+machines (gloo/cuda_collectives_host.h CudaLocalHostReduce -> host ring ->
+CudaLocalHostBroadcast; workspace split gloo/cuda_workspace.h:17-27).
+
+HierarchicalGroup is that capability TPU-first: per-device partials are
+reduced on-accelerator (one jitted tree-reduce; the adds never touch the
+host), exactly one device->host transfer per collective crosses PCIe, the
+cross-host hop rides the C++ host plane (TCP / encrypted / shm payload
+rings — two processes on one machine exchange through shared memory
+automatically), and the result returns to the local devices replicated.
+Every host-plane property carries over: timeouts, abort, fast peer-death
+detection, generation-based recovery (resilience.py), checkpoint stores.
+
+Scale note (the scaling-book hierarchy argument): with L local chips and
+H hosts, local reduction traffic stays on ICI/PCIe and DCN moves
+2(H-1)/H of the payload once per HOST, independent of L — staging keeps
+the slow fabric's traffic from multiplying with local chip count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class HierarchicalGroup:
+    """Cross-host collectives over (local devices) x (host Context).
+
+    ctx: a connected host-plane `gloo_tpu.Context`, one rank per host
+    process. devices: the process-local accelerators (default
+    jax.local_devices()).
+
+    Operand convention (mirrors the reference's CUDA algorithms, which
+    take one pointer per local GPU): a collective accepts either
+      - a list of per-device jax arrays (same shape/dtype) — the local
+        partials, reduced on-accelerator first; or
+      - a single array (numpy, single-device, or replicated) — one local
+        contribution per host.
+    Data-sharded single arrays are rejected: slices of one tensor are not
+    partials, and silently summing them would corrupt data.
+    """
+
+    def __init__(self, ctx, devices: Optional[Sequence] = None,
+                 tag: int = 0x51):
+        import jax
+
+        self.ctx = ctx
+        self.devices = list(devices) if devices is not None \
+            else jax.local_devices()
+        self.tag = tag
+        self._jit_cache = {}
+
+    # ---- local (intra-host) stage ----
+
+    def _reduce_list(self, xs, op: str) -> np.ndarray:
+        """Jitted tree-reduce of per-device partials on device 0; one D2H
+        transfer of the result."""
+        import jax
+
+        key = ("reduce", op, len(xs), xs[0].shape, str(xs[0].dtype))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+
+            combine = {"sum": jnp.add, "prod": jnp.multiply,
+                       "max": jnp.maximum, "min": jnp.minimum}[op]
+
+            def reduce_parts(*parts):
+                acc = parts[0]
+                for p in parts[1:]:
+                    acc = combine(acc, p)
+                return acc
+
+            fn = jax.jit(reduce_parts)
+            self._jit_cache[key] = fn
+        dev0 = self.devices[0]
+        parts = [jax.device_put(x, dev0) for x in xs]
+        # copy=True: on CPU backends np.asarray can alias the device
+        # buffer, and the host collectives mutate their operand in place.
+        return np.array(fn(*parts), copy=True)
+
+    def _local_value(self, x, op: str = "sum") -> np.ndarray:
+        """One host copy of this process's contribution."""
+        import jax
+
+        if isinstance(x, (list, tuple)):
+            if len(x) == 0:
+                raise ValueError("empty input list")
+            return self._reduce_list(list(x), op)
+        if isinstance(x, np.ndarray):
+            return np.ascontiguousarray(x)
+        if not isinstance(x, jax.Array):
+            return np.ascontiguousarray(np.asarray(x))
+        shards = x.addressable_shards
+        if len(shards) > 1:
+            first = shards[0].index
+            if any(s.index != first for s in shards[1:]):
+                raise ValueError(
+                    "x is data-sharded over local devices; hierarchical "
+                    "collectives expect per-device PARTIALS. Pass a list "
+                    "of per-device arrays, or reduce locally first (e.g. "
+                    "shard_map psum).")
+        # copy=True: see _reduce_list — never hand the in-place host
+        # collectives a view of device memory.
+        return np.array(x, copy=True)
+
+    def _put_back(self, host: np.ndarray, like):
+        """numpy in -> numpy out; device in -> replicated over the local
+        devices (every chip sees the reduced value, the reference's
+        local-broadcast stage)."""
+        import jax
+
+        if isinstance(like, (list, tuple)):
+            return [jax.device_put(host, d) for d in self.devices]
+        if isinstance(like, np.ndarray) or not isinstance(like, jax.Array):
+            return host
+        if len(self.devices) == 1:
+            return jax.device_put(host, self.devices[0])
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(np.asarray(self.devices), ("local",))
+        return jax.device_put(host, NamedSharding(mesh, PartitionSpec()))
+
+    # ---- hierarchical collectives ----
+
+    def allreduce(self, x, op: str = "sum"):
+        """Local on-accelerator reduce -> host-plane allreduce over DCN ->
+        replicate back to local devices. Returns x's structure: list in,
+        per-device list out; array in, replicated array out."""
+        host = self._local_value(x, op)
+        flat = np.ascontiguousarray(host.reshape(-1))
+        self.ctx.allreduce(flat, op=op, tag=self.tag)
+        return self._put_back(flat.reshape(host.shape), x)
+
+    def mean(self, x):
+        """allreduce(sum) / total contribution count (hosts x local
+        partials, allgathered so uneven local counts stay correct)."""
+        nlocal = len(x) if isinstance(x, (list, tuple)) else 1
+        counts = np.array([nlocal], dtype=np.int64)
+        total = int(self.ctx.allgather(counts, tag=self.tag + 1).sum())
+        out = self.allreduce(x, op="sum")
+        scale = 1.0 / total
+
+        def _scale(a):
+            return (a * scale).astype(np.asarray(a).dtype) \
+                if isinstance(a, np.ndarray) else a * scale
+        if isinstance(out, list):
+            return [_scale(a) for a in out]
+        return _scale(out)
+
+    def broadcast(self, x, root: int = 0):
+        """Root host's value to every host's local devices."""
+        host = self._local_value(x)
+        flat = np.ascontiguousarray(host.reshape(-1))
+        self.ctx.broadcast(flat, root=root, tag=self.tag)
+        return self._put_back(flat.reshape(host.shape), x)
+
+    def allgather(self, x) -> np.ndarray:
+        """Stack each host's (locally reduced) contribution: (H, ...) on
+        every host."""
+        host = self._local_value(x)
+        flat = np.ascontiguousarray(host.reshape(-1))
+        out = self.ctx.allgather(flat, tag=self.tag)
+        return out.reshape((self.ctx.size,) + host.shape)
+
+    def barrier(self) -> None:
+        self.ctx.barrier(tag=self.tag)
+
+
+def make_hierarchical_ddp(loss_fn, optimizer, group: HierarchicalGroup,
+                          mesh=None, axis: str = "local"):
+    """Two-level DDP: the local device mesh averages gradients over ICI
+    inside one jitted step; the host plane then averages the per-host
+    means across machines (the reference's role as PyTorch's ProcessGroup
+    backend, SURVEY.md §2.10). Returns step(params, opt_state, batch) ->
+    (params, opt_state, loss); batch's leading axis shards over the local
+    mesh when one exists.
+    """
+    import jax
+    import optax
+
+    if mesh is None and len(group.devices) > 1:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(group.devices), (axis,))
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from gloo_tpu.tpu import spmd
+        local_axis = mesh.axis_names[0]
+
+        def local_grads(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # Replicated params => AD already psum'd grads across the
+            # axis; divide for the mean (same reasoning as parallel/ddp).
+            n = spmd.size(local_axis)
+            grads = jax.tree.map(lambda g: g / n, grads)
+            return spmd.mean(loss, local_axis), grads
+
+        grads_fn = jax.jit(jax.shard_map(
+            local_grads, mesh=mesh,
+            in_specs=(P(), P(local_axis)), out_specs=(P(), P())))
+    else:
+        grads_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def _apply(params, opt_state, grads):
+        updates, new_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    apply_fn = jax.jit(_apply)
+
+    def step(params, opt_state, batch):
+        loss, grads = grads_fn(params, batch)
+        if group.ctx.size > 1:
+            # Cross-host mean over DCN: one flat f32 buffer per step so
+            # the transport sees a single large payload (shm/TCP
+            # pipelining beats many small messages).
+            leaves, treedef = jax.tree.flatten(grads)
+            host_leaves = [np.asarray(l) for l in leaves]
+            if host_leaves:
+                flat = np.concatenate(
+                    [l.reshape(-1).astype(np.float32)
+                     for l in host_leaves])
+                group.ctx.allreduce(flat, tag=group.tag)
+                flat /= group.ctx.size
+                out, off = [], 0
+                for l in host_leaves:
+                    out.append(flat[off:off + l.size].reshape(l.shape)
+                               .astype(l.dtype))
+                    off += l.size
+                grads = jax.tree.unflatten(treedef, out)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step
