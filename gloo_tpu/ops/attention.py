@@ -290,22 +290,184 @@ def largest_block(t: int, cap: int = 128) -> int:
 def _flash_backward(qf, kf, vf, out, lse, g, *, causal: bool, block_q: int,
                     block_k: int, interpret: bool, kv_group: int = 1,
                     vma_axes=()):
-    """Local (single-block) backward: the step backward kernels with both
-    global offsets at zero. kf/vf may carry bh // kv_group heads (GQA);
-    the per-query-head dK/dV partials come back in f32 and are
-    group-summed BEFORE the single downcast, matching the f32
-    accumulation of the ungrouped path."""
+    """Local (single-block) backward via the FUSED one-pass kernel: scores
+    and dp are computed once per tile pair and feed dQ, dK, and dV
+    together (5 matmuls per tile instead of the two-pass split's 7 — dQ
+    accumulates in a resident f32 output block while the grid walks
+    key-major). kf/vf may carry bh // kv_group heads (GQA); the
+    per-query-head dK/dV partials come back in f32 and are group-summed
+    BEFORE the single downcast, matching the f32 accumulation of the
+    ungrouped path."""
     # delta[i] = rowsum(dO * O): cheap elementwise pass outside pallas.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    zero = jnp.int32(0)
-    dq, dk, dv = flash_attention_bwd_step(
-        qf, kf, vf, g, delta, lse, q_offset=zero, k_offset=zero,
-        causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, kv_group=kv_group, vma_axes=vma_axes)
+    dq, dk, dv = flash_attention_bwd_fused(
+        qf, kf, vf, g, delta, lse, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret, kv_group=kv_group,
+        vma_axes=vma_axes)
     dk = group_sum_kv(dk, kv_group)
     dv = group_sum_kv(dv, kv_group)
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                            dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                            block_q: int, block_k: int, causal: bool,
+                            scale: float):
+    """One-pass backward (local sequence, static offsets): grid
+    (bh, key-block, query-block), both inner dims sequential. Each tile
+    pair computes s / p / dp / ds ONCE and feeds all three gradients:
+    dV/dK accumulate in per-key-block scratch, dQ accumulates into the
+    full (t_q, d) f32 output block, which stays resident in VMEM for the
+    whole batch-head group and is scaled once at the end."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_k_blocks = pl.num_programs(1)
+    num_q_blocks = pl.num_programs(2)
+
+    @pl.when((kb == 0) & (qi == 0))
+    def _():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def update(masked):
+        q, s = _score_tile(q_ref, k_ref, qi, kb, block_q, block_k, masked,
+                           scale)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        p = _softmax_tile(s, lse_ref[0])
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row = qi * block_q
+        dq_ref[0, pl.dslice(row, block_q), :] = (
+            dq_ref[0, pl.dslice(row, block_q), :] +
+            jax.lax.dot_general(ds.astype(k.dtype), k,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32))
+
+    if causal:
+        active = qi * block_q + block_q - 1 >= kb * block_k
+        interior = (kb + 1) * block_k - 1 <= qi * block_q
+
+        @pl.when(active & jnp.logical_not(interior))
+        def _():
+            update(True)
+
+        @pl.when(interior)
+        def _():
+            update(False)
+    else:
+        update(False)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _():
+        # q comes back from _score_tile already scaled, so ds^T q is dK
+        # directly; dQ accumulated against UNscaled k and takes the scale
+        # once at the very end.
+        dk_ref[0, ...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc[...].astype(dv_ref.dtype)
+
+    @pl.when((kb == num_k_blocks - 1) & (qi == num_q_blocks - 1))
+    def _():
+        dq_ref[...] = dq_ref[...] * scale
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "vma_axes", "kv_group"))
+def flash_attention_bwd_fused(q, k, v, do, delta, lse, causal: bool = True,
+                              block_q: int = None, block_k: int = None,
+                              interpret: bool = False, vma_axes=(),
+                              kv_group: int = 1):
+    """Fused one-pass flash backward over the local sequence (the
+    jax.grad path; ring steps keep flash_attention_bwd_step, whose dQ and
+    dK/dV separate cleanly across rotation hops).
+
+    q, do: (bh, t, d); k, v: (bh // kv_group, t, d); delta/lse:
+    (bh, t, 1) f32. Returns (dq, dk, dv) f32, dk/dv per-QUERY-head
+    partials when kv_group > 1 (caller group-sums). Causal dead tiles
+    skip compute with their q-side fetches elided by clamped index maps;
+    interior tiles run mask-free.
+
+    VMEM note: the full (t, d) f32 dQ block stays resident (t=16k, d=128
+    -> 8 MB), which the 100 MB scoped budget comfortably holds to
+    ~100k-token sequences."""
+    bh, t, d = q.shape
+    if bh % kv_group != 0 or k.shape[0] != bh // kv_group:
+        raise ValueError(
+            f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
+    if block_q is None:
+        block_q = largest_block(t, 512)
+    if block_k is None:
+        block_k = largest_block(t, 1024)
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError("tile sizes must divide the sequence length")
+    scale = 1.0 / (d ** 0.5)
+    vma = frozenset(vma_axes)
+
+    if causal:
+        def q_index(i, kb, j):
+            first = (kb * block_k) // block_q
+            return (i, jnp.maximum(j, first), 0)
+    else:
+        def q_index(i, kb, j):
+            return (i, j, 0)
+
+    kernel = functools.partial(_flash_bwd_fused_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, kb, j: (i // kv_group, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, kb, j: (i // kv_group, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), q_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_index,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, t, d), lambda i, kb, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32, vma=vma),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(q, k, v, do, delta, lse)
 
 
 # ---------------------------------------------------------------------------
@@ -430,7 +592,8 @@ def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
     """dQ contribution of ONE key/value block (global offsets), for the
     ring backward: softmax is recomputed from the forward's global
     logsumexp, so each block's dQ piece is independently correct and the
-    ring loop just sums them."""
+    ring loop just sums them. (The local jax.grad path uses the fused
+    one-pass kernel below, where the static causal tile split lives.)"""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     num_k_blocks = pl.num_programs(2)
@@ -439,16 +602,10 @@ def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
     def _():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    active = True
-    if causal:
-        active = (k_off_ref[0] + kb * block_k <=
-                  q_off_ref[0] + qi * block_q + block_q - 1)
-
-    @pl.when(active)
-    def _():
+    def update(masked):
         _, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
                                   k_off_ref[0] + kb * block_k, block_q,
-                                  block_k, causal, scale)
+                                  block_k, masked, scale)
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
@@ -459,6 +616,16 @@ def _flash_bwd_dq_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
         acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        active = (k_off_ref[0] + kb * block_k <=
+                  q_off_ref[0] + qi * block_q + block_q - 1)
+
+        @pl.when(active)
+        def _():
+            update(True)
+    else:
+        update(False)
 
     @pl.when(kb == num_k_blocks - 1)
     def _():
@@ -482,16 +649,10 @@ def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    active = True
-    if causal:
-        active = (q_off_ref[0] + qi * block_q + block_q - 1 >=
-                  k_off_ref[0] + kb * block_k)
-
-    @pl.when(active)
-    def _():
+    def update(masked):
         q, s = _score_tile_global(q_ref, k_ref, q_off_ref[0] + qi * block_q,
                                   k_off_ref[0] + kb * block_k, block_q,
-                                  block_k, causal, scale)
+                                  block_k, masked, scale)
         v = v_ref[0]
         do = do_ref[0]
         p = _softmax_tile(s, lse_ref[0])
@@ -504,6 +665,16 @@ def _flash_bwd_dkv_step_kernel(q_ref, k_ref, v_ref, do_ref, delta_ref,
         dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        active = (q_off_ref[0] + qi * block_q + block_q - 1 >=
+                  k_off_ref[0] + kb * block_k)
+
+        @pl.when(active)
+        def _():
+            update(True)
+    else:
+        update(False)
 
     @pl.when(qi == num_q_blocks - 1)
     def _():
@@ -551,6 +722,9 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
     k_off = jnp.reshape(k_offset.astype(jnp.int32), (1,))
     vma = frozenset(vma_axes)
 
+    def dq_kv_index(i, j, kb):
+        return (i // kv_group, kb, 0)
+
     dq_kernel = functools.partial(_flash_bwd_dq_step_kernel, block_q=block_q,
                                   block_k=block_k, causal=causal, scale=scale)
     dq = pl.pallas_call(
@@ -560,11 +734,9 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d),
-                         lambda i, j, kb: (i // kv_group, kb, 0),
+            pl.BlockSpec((1, block_k, d), dq_kv_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d),
-                         lambda i, j, kb: (i // kv_group, kb, 0),
+            pl.BlockSpec((1, block_k, d), dq_kv_index,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -584,6 +756,9 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
             vmem_limit_bytes=100 * 1024 * 1024),
     )(q, k, v, do, delta, lse, q_off, k_off)
 
+    def dkv_q_index(i, kb, j):
+        return (i, j, 0)
+
     dkv_kernel = functools.partial(_flash_bwd_dkv_step_kernel,
                                    block_q=block_q, block_k=block_k,
                                    causal=causal, scale=scale)
@@ -592,7 +767,7 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
         interpret=interpret,
         grid=(bh, tkv // block_k, tq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), dkv_q_index,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d),
                          lambda i, kb, j: (i // kv_group, kb, 0),
@@ -600,11 +775,11 @@ def flash_attention_bwd_step(q, k, v, do, delta, lse, q_offset, k_offset,
             pl.BlockSpec((1, block_k, d),
                          lambda i, kb, j: (i // kv_group, kb, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, d), dkv_q_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, 1), dkv_q_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda i, kb, j: (i, j, 0),
+            pl.BlockSpec((1, block_q, 1), dkv_q_index,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),

@@ -95,9 +95,12 @@ class HierarchicalGroup:
                 raise ValueError("empty input list")
             return self._reduce_list(list(x), op)
         if isinstance(x, np.ndarray):
-            return np.ascontiguousarray(x)
+            # Copy like every other input kind: the host collectives
+            # reduce in place, and the caller's array must not be
+            # silently overwritten with intermediate values.
+            return np.array(x, copy=True)
         if not isinstance(x, jax.Array):
-            return np.ascontiguousarray(np.asarray(x))
+            return np.array(np.asarray(x), copy=True)
         shards = x.addressable_shards
         if len(shards) > 1:
             first = shards[0].index
