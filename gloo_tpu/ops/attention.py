@@ -161,11 +161,11 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
     the score matrix. seq must be divisible by the block sizes; head_dim
     should be a multiple of 128 for full MXU tiles.
 
-    block_q/block_k default to the largest divisors of seq up to 512/1024:
-    the kernel's cost is dominated by per-grid-step overhead, not the
-    matmuls, so big tiles win — the v5e block sweep (BASELINE.md) moved
-    sustained throughput from 15 to 107-139 TFLOP/s (54-70% MFU) going
-    from 128x128 to >=512 tiles.
+    block_q/block_k default to the largest divisors of seq up to
+    1024/1024: the kernel's cost is dominated by per-grid-step overhead,
+    not the matmuls, so big tiles win — the stable-timing v5e block sweep
+    (BASELINE.md) has 1024x1024 at 77-131 TFLOP/s across t=1k..16k vs
+    ~15 for the round-1 128x128 tiles.
 
     Supports grouped-query attention: k/v may carry h_kv heads with
     h % h_kv == 0. Both directions map each query head to its shared kv
@@ -187,7 +187,7 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = None,
             f"query heads {h} must be a multiple of kv heads {h_kv}")
     group = h // h_kv
     if block_q is None:
-        block_q = largest_block(t, 512)
+        block_q = largest_block(t, 1024)
     if block_k is None:
         block_k = largest_block(t, 1024)
     if t % block_q != 0 or t % block_k != 0:
@@ -409,7 +409,7 @@ def flash_attention_bwd_fused(q, k, v, do, delta, lse, causal: bool = True,
         raise ValueError(
             f"k head count {k.shape[0]} != bh {bh} / kv_group {kv_group}")
     if block_q is None:
-        block_q = largest_block(t, 512)
+        block_q = largest_block(t, 1024)
     if block_k is None:
         block_k = largest_block(t, 1024)
     if t % block_q != 0 or t % block_k != 0:

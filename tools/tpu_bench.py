@@ -141,8 +141,9 @@ def bench_flash_attention(args, jax, jnp, elements_list, backward=False):
     to cancel the fetch round-trip. algbw column = achieved GFLOP/s.
 
     backward=True times fwd+bwd via jax.grad (flops counted 3.5x fwd:
-    one forward recompute-free pass plus the dQ and dK/dV kernels at
-    ~2.5x forward work). --flash-blocks sweeps tile sizes."""
+    one forward pass plus the fused one-pass backward kernel, whose
+    ideal matmul work is ~2.5x forward). --flash-blocks sweeps tile
+    sizes."""
     import time as _time
 
     from jax import lax
