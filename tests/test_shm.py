@@ -310,6 +310,23 @@ except gloo_tpu.IoError:
     assert "IOERROR" in outs[0][0]
 
 
+@pytest.mark.parametrize("seed", [0, 2])
+def test_shm_stress_fuzz(seed):
+    """Re-run the randomized collective-sequence fuzz with a 64-byte
+    threshold and a 64 KiB ring: virtually every message rides shm, with
+    constant wraparound and credit traffic — the chunk/credit machinery's
+    soak test, verified against numpy by the fuzz's own oracle."""
+    env = dict(os.environ)
+    env.update({"TPUCOLL_SHM_THRESHOLD": "64", "TPUCOLL_SHM_RING": "65536",
+                "TPUCOLL_SKIP_BUILD": "1"})
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         f"tests/test_fuzz.py::test_fuzz_collective_sequences[{seed}]",
+         "-q", "-p", "no:cacheprovider"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=_REPO)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-2000:])
+
+
 def test_shm_no_segment_leak():
     """Segments are unlinked as soon as both sides hold mappings: nothing
     named tpucoll-* survives a connect/teardown cycle."""
