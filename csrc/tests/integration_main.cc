@@ -120,6 +120,111 @@ void worker(std::shared_ptr<tpucoll::Store> store, int rank, int size,
     }
   }
 
+  // Fused receive-reduce, straight on the transport API. Covers: the shm
+  // ring path with a 24-byte element (ring chunks are powers of two, so
+  // chunk boundaries split elements and exercise the carry buffer), the
+  // eager TCP path (small payload), combine-from-stash (send lands before
+  // the recvReduce posts; pair FIFO makes the ordering deterministic),
+  // and the self-send short-circuit in both post orders.
+  if (size >= 2) {
+    struct Triple {
+      double a, b, c;
+    };
+    static_assert(sizeof(Triple) == 24, "carry test needs a 24-byte element");
+    auto addTriples = [](void* acc, const void* in, size_t n) {
+      auto* A = static_cast<Triple*>(acc);
+      auto* I = static_cast<const Triple*>(in);
+      for (size_t i = 0; i < n; i++) {
+        A[i].a += I[i].a;
+        A[i].b += I[i].b;
+        A[i].c += I[i].c;
+      }
+    };
+    const auto tmo = std::chrono::milliseconds(15000);
+    if (rank == 0) {
+      // 3 MiB of triples: rides the shm ring in multiple chunks.
+      const size_t n = 128 * 1024;
+      std::vector<Triple> acc(n);
+      for (size_t i = 0; i < n; i++) {
+        acc[i] = {double(i), 1.0, -2.0};
+      }
+      auto buf = ctx.createUnboundBuffer(acc.data(), n * sizeof(Triple));
+      buf->recvReduce(1, 900, addTriples, sizeof(Triple));
+      buf->waitRecv(nullptr, tmo);
+      bool ok = true;
+      for (size_t i = 0; i < n && ok; i++) {
+        ok = acc[i].a == double(2 * i) && acc[i].b == 4.0 && acc[i].c == 3.0;
+      }
+      CHECK(ok);
+      // Small payload: eager TCP path (below any shm threshold).
+      float small[8] = {1, 1, 1, 1, 1, 1, 1, 1};
+      auto sbuf = ctx.createUnboundBuffer(small, sizeof(small));
+      sbuf->recvReduce(1, 901, tpucoll::getReduceFn(DataType::kFloat32,
+                                                    ReduceOp::kSum),
+                       sizeof(float));
+      sbuf->waitRecv(nullptr, tmo);
+      CHECK(small[0] == 3.0f && small[7] == 3.0f);
+      // Stash order: rank 1 sent slot 902 BEFORE the flag on 903; by pair
+      // FIFO the 902 payload is already stashed when this recvReduce
+      // posts, so the combine runs on the stash-hit path.
+      int32_t flag = 0;
+      auto fbuf = ctx.createUnboundBuffer(&flag, sizeof(flag));
+      fbuf->recv(1, 903);
+      fbuf->waitRecv(nullptr, tmo);
+      double accd[4] = {10.0, 20.0, 30.0, 40.0};
+      auto dbuf = ctx.createUnboundBuffer(accd, sizeof(accd));
+      dbuf->recvReduce(1, 902, tpucoll::getReduceFn(DataType::kFloat64,
+                                                    ReduceOp::kMax),
+                       sizeof(double));
+      dbuf->waitRecv(nullptr, tmo);
+      CHECK(accd[0] == 10.0 && accd[1] == 25.0 && accd[2] == 30.0 &&
+            accd[3] == 45.0);
+    } else if (rank == 1) {
+      const size_t n = 128 * 1024;
+      std::vector<Triple> in(n);
+      for (size_t i = 0; i < n; i++) {
+        in[i] = {double(i), 3.0, 5.0};
+      }
+      auto buf = ctx.createUnboundBuffer(in.data(), n * sizeof(Triple));
+      buf->send(0, 900);
+      buf->waitSend(tmo);
+      float small[8] = {2, 2, 2, 2, 2, 2, 2, 2};
+      auto sbuf = ctx.createUnboundBuffer(small, sizeof(small));
+      sbuf->send(0, 901);
+      sbuf->waitSend(tmo);
+      double vals[4] = {5.0, 25.0, 15.0, 45.0};
+      auto dbuf = ctx.createUnboundBuffer(vals, sizeof(vals));
+      dbuf->send(0, 902);  // stashes at rank 0 until its recvReduce posts
+      int32_t flag = 1;
+      auto fbuf = ctx.createUnboundBuffer(&flag, sizeof(flag));
+      fbuf->send(0, 903);
+      dbuf->waitSend(tmo);
+      fbuf->waitSend(tmo);
+    }
+    // Self-send recvReduce, both post orders, on every rank.
+    {
+      int32_t acc[4] = {1, 2, 3, 4};
+      int32_t inc[4] = {10, 10, 10, 10};
+      auto abuf = ctx.createUnboundBuffer(acc, sizeof(acc));
+      auto ibuf = ctx.createUnboundBuffer(inc, sizeof(inc));
+      // recv posted first: postSend's matcher hit runs the combine.
+      abuf->recvReduce(rank, 904, tpucoll::getReduceFn(DataType::kInt32,
+                                                       ReduceOp::kSum),
+                       sizeof(int32_t));
+      ibuf->send(rank, 904);
+      ibuf->waitSend(tmo);
+      abuf->waitRecv(nullptr, tmo);
+      // send first: combine runs on the stash-hit path inside postRecv.
+      ibuf->send(rank, 905);
+      ibuf->waitSend(tmo);
+      abuf->recvReduce(rank, 905, tpucoll::getReduceFn(DataType::kInt32,
+                                                       ReduceOp::kSum),
+                       sizeof(int32_t));
+      abuf->waitRecv(nullptr, tmo);
+      CHECK(acc[0] == 21 && acc[3] == 24);
+    }
+  }
+
   // Tagged p2p ring: send to right, recv from left.
   {
     int right = (rank + 1) % size;

@@ -13,9 +13,11 @@ namespace tpucoll {
 namespace algorithms {
 
 // Bandwidth-optimal ring (reduce-scatter + allgather), segment-pipelined.
+// fuseOk: fn is a builtin (loop-thread-safe) reduction, so the reduce-
+// scatter phase may use the transport's fused recvReduce path.
 void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
                    ReduceFn fn, Slot slot,
-                   std::chrono::milliseconds timeout);
+                   std::chrono::milliseconds timeout, bool fuseOk);
 
 // Recursive-halving/recursive-doubling (Rabenseifner) allreduce:
 // 2*log2(P) rounds, latency-optimal for small payloads. Non-power-of-2

@@ -18,6 +18,8 @@ namespace tpucoll {
 using collectives_detail::Blocks;
 using collectives_detail::countBlocks;
 using collectives_detail::evenBlocks;
+using collectives_detail::recvReduceMode;
+using collectives_detail::RecvReduceMode;
 using collectives_detail::segmentize;
 
 namespace {
@@ -41,7 +43,7 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
                        ReduceFn fn, size_t elsize, Slot slot,
                        uint64_t slotBase, int startShift,
                        std::chrono::milliseconds timeout,
-                       transport::UnboundBuffer* workBuf) {
+                       transport::UnboundBuffer* workBuf, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   size_t maxBlock = 0;
@@ -49,13 +51,34 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
     maxBlock = std::max(maxBlock, b);
   }
   const size_t maxSegs = segmentize(maxBlock, elsize).size();
-  // Pooled staging: keeps pages warm across calls so the receive path never
-  // stalls on first-touch faults.
-  auto scratch = ctx->acquireScratch(2 * std::max(maxBlock, size_t(1)));
-  char* tmp = scratch.data();
-  auto tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
   const int right = (rank + 1) % size;
   const int left = (rank - 1 + size) % size;
+  // Fused receive-reduce: arrivals are combined into `work` by the
+  // transport itself (straight out of the shm ring), so the schedule
+  // needs no staging at all and each payload byte is touched once instead
+  // of copy+reduce. Receives still pre-post two steps ahead; an in-flight
+  // combined segment is always disjoint from the blocks being sent (recv
+  // of step s writes block r-s-1 while sends read block r-s). Custom
+  // reduce fns stay on the scratch path: they may not be safe on the
+  // transport's loop thread (Python callbacks need the GIL). Fusing is
+  // per-source: the ring only ever receives from `left`, so one check
+  // picks the schedule (see recvReduceMode for the policy).
+  const auto mode = recvReduceMode();
+  const bool fuse = fuseOk && mode != RecvReduceMode::kOff &&
+                    elsize <= transport::kMaxCombineElsize &&
+                    (mode == RecvReduceMode::kForce ||
+                     ctx->transport()->peerUsesShm(left));
+  // Pooled staging (scratch path only — the fused path receives straight
+  // into `work` and must not hold a pooled buffer it never touches):
+  // keeps pages warm across calls so the receive path never stalls on
+  // first-touch faults.
+  auto scratch = fuse ? Context::Scratch(nullptr, {})
+                      : ctx->acquireScratch(2 * std::max(maxBlock, size_t(1)));
+  char* tmp = scratch.data();
+  std::unique_ptr<transport::UnboundBuffer> tmpBuf;
+  if (!fuse) {
+    tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
+  }
   const int steps = size - 1;
 
   auto sendBlockAt = [&](int step) {
@@ -68,10 +91,20 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
     return slot.offset(slotBase + uint64_t(step) * maxSegs + seg).value();
   };
 
-  // Post all segment receives of `step` into staging half (step % 2).
+  // Post all segment receives of `step`: fused, straight into the work
+  // block (combined on arrival); scratch path, into staging half (step%2).
   auto postRecvsFor = [&](int step) {
+    const int rb = recvBlockAt(step);
+    auto segs = segmentize(blocks.bytes[rb], elsize);
+    if (fuse) {
+      for (size_t k = 0; k < segs.size(); k++) {
+        workBuf->recvReduce(left, segSlot(step, k), fn, elsize,
+                            blocks.offset[rb] + segs[k].offset,
+                            segs[k].nbytes);
+      }
+      return;
+    }
     const size_t base = (step % 2) * maxBlock;
-    auto segs = segmentize(blocks.bytes[recvBlockAt(step)], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       tmpBuf->recv(left, segSlot(step, k), base + segs[k].offset,
                    segs[k].nbytes);
@@ -97,6 +130,12 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
     const size_t base = (step % 2) * maxBlock;
     auto segs = segmentize(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
+      if (fuse) {
+        // The combine already ran (loop thread / stash hit); the wait is
+        // purely the completion count.
+        workBuf->waitRecv(nullptr, timeout);
+        continue;
+      }
       tmpBuf->waitRecv(nullptr, timeout);
       // Segments on one pair complete in wire order, so segment k of this
       // step is the k-th completion.
@@ -296,7 +335,7 @@ void allreduce(AllreduceOptions& opts) {
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
-                                  timeout);
+                                  timeout, opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kHalvingDoubling:
         algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
@@ -328,7 +367,7 @@ namespace algorithms {
 
 void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
                    ReduceFn fn, Slot slot,
-                   std::chrono::milliseconds timeout) {
+                   std::chrono::milliseconds timeout, bool fuseOk) {
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
   Blocks blocks = evenBlocks(count, size, elsize);
@@ -339,7 +378,7 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
   const size_t maxSegs = segmentize(maxBlock, elsize).size();
   auto workBuf = ctx->createUnboundBuffer(work, nbytes);
   ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
-                    workBuf.get());
+                    workBuf.get(), fuseOk);
   // Allgather phase: rank r starts owning reduced block (r+1); the block
   // then rides the ring into place on every rank.
   ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
@@ -386,8 +425,27 @@ void reduce(ReduceOptions& opts) {
   const int vrank = (rank - opts.root + size) % size;
   auto physical = [&](int v) { return (v + opts.root) % size; };
   auto resultBuf = ctx->createUnboundBuffer(result, nbytes);
-  std::vector<char> tmp(nbytes);
-  auto tmpBuf = ctx->createUnboundBuffer(tmp.data(), nbytes);
+  // Fused receive-reduce: partner partials are combined into `result` by
+  // the transport (from the shm ring / stash, no scratch vector at all).
+  // Rounds are serialized by waitRecv, so result is never concurrently a
+  // send source and a combine target. Custom fns stay on the scratch path
+  // (not loop-thread-safe); the per-partner shm check picks fused vs
+  // scratch per round (see recvReduceMode).
+  const auto mode = recvReduceMode();
+  const bool fuseEligible = opts.customFn == nullptr &&
+                            mode != RecvReduceMode::kOff &&
+                            elsize <= transport::kMaxCombineElsize;
+  std::vector<char> tmp;
+  std::unique_ptr<transport::UnboundBuffer> tmpBuf;
+  auto scratchRecv = [&](int src, uint64_t recvSlot) {
+    if (!tmpBuf) {
+      tmp.resize(nbytes);
+      tmpBuf = ctx->createUnboundBuffer(tmp.data(), nbytes);
+    }
+    tmpBuf->recv(src, recvSlot, 0, nbytes);
+    tmpBuf->waitRecv(nullptr, timeout);
+    fn(result, tmp.data(), opts.count);
+  };
 
   int mask = 1;
   uint64_t round = 0;
@@ -400,9 +458,15 @@ void reduce(ReduceOptions& opts) {
     }
     const int partner = vrank + mask;
     if (partner < size) {
-      tmpBuf->recv(physical(partner), slot.offset(round).value(), 0, nbytes);
-      tmpBuf->waitRecv(nullptr, timeout);
-      fn(result, tmp.data(), opts.count);
+      const int src = physical(partner);
+      if (fuseEligible && (mode == RecvReduceMode::kForce ||
+                           ctx->transport()->peerUsesShm(src))) {
+        resultBuf->recvReduce(src, slot.offset(round).value(), fn, elsize,
+                              0, nbytes);
+        resultBuf->waitRecv(nullptr, timeout);
+      } else {
+        scratchRecv(src, slot.offset(round).value());
+      }
     }
     mask <<= 1;
     round++;
@@ -439,7 +503,8 @@ void reduceScatter(ReduceScatterOptions& opts) {
   Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
   auto workBuf = ctx->createUnboundBuffer(work, total);
   ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
-                    /*startShift=*/-1, timeout, workBuf.get());
+                    /*startShift=*/-1, timeout, workBuf.get(),
+                    /*fuseOk=*/opts.customFn == nullptr);
   std::memcpy(opts.output, work + blocks.offset[rank], blocks.bytes[rank]);
 }
 

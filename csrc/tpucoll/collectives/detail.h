@@ -3,6 +3,7 @@
 
 #include <algorithm>
 #include <cstddef>
+#include <cstdlib>
 #include <vector>
 
 namespace tpucoll {
@@ -58,6 +59,31 @@ struct SegSpan {
 
 // Pipelining granularity for ring schedules (see collectives_ring.cc).
 constexpr size_t kMaxSegmentBytes = 4 << 20;
+
+// Fused receive-reduce (UnboundBuffer::recvReduce) policy for builtin
+// reductions. Default (auto): fuse only when the source pair delivers
+// payloads through an shm ring — there the combine replaces the ring
+// copy-out outright, a strict win; on byte-stream TCP pairs fusing would
+// move the reduction onto the loop thread and lose the reduce/socket-I-O
+// overlap the scratch schedule (the reference's shape, gloo/allreduce.cc:
+// 284-299) gets for free, so auto keeps scratch there.
+// TPUCOLL_RECV_REDUCE=0 forces scratch everywhere; =1 forces fused
+// everywhere (A/B measurement on any transport).
+enum class RecvReduceMode { kOff, kAuto, kForce };
+
+inline RecvReduceMode recvReduceMode() {
+  static const RecvReduceMode mode = [] {
+    const char* v = std::getenv("TPUCOLL_RECV_REDUCE");
+    if (v != nullptr && v[0] == '0') {
+      return RecvReduceMode::kOff;
+    }
+    if (v != nullptr && v[0] == '1') {
+      return RecvReduceMode::kForce;
+    }
+    return RecvReduceMode::kAuto;
+  }();
+  return mode;
+}
 
 inline std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
   size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);

@@ -290,6 +290,21 @@ std::list<Context::PostedRecv>::iterator Context::findPosted(int srcRank,
   return posted_.end();
 }
 
+void Context::landPayload(char* dest, RecvReduceFn combine,
+                          size_t combineElsize, const char* data,
+                          size_t nbytes) {
+  if (combine != nullptr) {
+    combine(dest, data, nbytes / combineElsize);
+  } else {
+    std::memcpy(dest, data, nbytes);
+  }
+}
+
+void Context::landPayload(const PostedRecv& pr, const char* data,
+                          size_t nbytes) {
+  landPayload(pr.dest, pr.combine, pr.combineElsize, data, nbytes);
+}
+
 void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
                        char* data, size_t nbytes) {
   TC_ENFORCE(dstRank >= 0 && dstRank < size_, "bad destination rank ",
@@ -303,7 +318,7 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
       std::lock_guard<std::mutex> guard(mu_);
       auto it = findPosted(rank_, slot, nbytes);
       if (it != posted_.end()) {
-        std::memcpy(it->dest, data, nbytes);
+        landPayload(*it, data, nbytes);
         rbuf = it->ubuf;
         posted_.erase(it);
       } else {
@@ -341,7 +356,8 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
 }
 
 void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
-                       uint64_t slot, char* dest, size_t nbytes) {
+                       uint64_t slot, char* dest, size_t nbytes,
+                       RecvReduceFn combine, size_t combineElsize) {
   buf->addPendingRecv();
   bool fromStash = false;
   int stashSrc = -1;
@@ -367,7 +383,7 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
       if (it->slot == slot && allowed[it->srcRank]) {
         TC_ENFORCE_EQ(it->data.size(), nbytes,
                       "stashed message size mismatch on slot ", slot);
-        std::memcpy(dest, it->data.data(), nbytes);
+        landPayload(dest, combine, combineElsize, it->data.data(), nbytes);
         stashSrc = it->srcRank;
         if (stashSrc != rank_) {
           stashBytes_[stashSrc] -= it->data.size();
@@ -405,7 +421,8 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
     }
     if (!fromStash) {
       posted_.push_back(PostedRecv{buf, slot, dest, nbytes,
-                                   std::move(allowed)});
+                                   std::move(allowed), combine,
+                                   combineElsize});
     }
   }
   if (fromStash) {
@@ -458,9 +475,9 @@ Context::Match Context::matchIncoming(int srcRank, uint64_t slot,
   std::lock_guard<std::mutex> guard(mu_);
   auto it = findPosted(srcRank, slot, nbytes);
   if (it == posted_.end()) {
-    return Match{false, nullptr, nullptr};
+    return Match{};
   }
-  Match m{true, it->ubuf, it->dest};
+  Match m{true, it->ubuf, it->dest, it->combine, it->combineElsize};
   posted_.erase(it);
   return m;
 }
@@ -475,7 +492,7 @@ void Context::stashArrived(int srcRank, uint64_t slot,
     // prefer delivering straight into it.
     auto it = findPosted(srcRank, slot, data.size());
     if (it != posted_.end()) {
-      std::memcpy(it->dest, data.data(), data.size());
+      landPayload(*it, data.data(), data.size());
       rbuf = it->ubuf;
       posted_.erase(it);
     } else {
@@ -523,6 +540,17 @@ void Context::shmStats(uint64_t* txBytes, uint64_t* rxBytes,
   *txBytes = tx;
   *rxBytes = rx;
   *activePairs = active;
+}
+
+bool Context::peerUsesShm(int rank) {
+  if (rank == rank_) {
+    return true;  // self-sends combine from the stash / matcher directly
+  }
+  std::lock_guard<std::mutex> guard(mu_);
+  if (rank < 0 || rank >= size_ || !pairs_[rank]) {
+    return false;
+  }
+  return pairs_[rank]->shmActive();
 }
 
 void Context::debugDump() {

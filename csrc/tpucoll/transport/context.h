@@ -89,8 +89,12 @@ class Context {
   // normal message on respSlot (buf must have a recv posted for it).
   void postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
                       uint64_t roffset, size_t nbytes);
+  // With `combine` set, arriving payload is reduced into `dest` via
+  // combine(dest, payload, nbytes / combineElsize) instead of copied
+  // (UnboundBuffer::recvReduce); staged paths combine from staging memory.
   void postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
-                uint64_t slot, char* dest, size_t nbytes);
+                uint64_t slot, char* dest, size_t nbytes,
+                RecvReduceFn combine = nullptr, size_t combineElsize = 0);
   void cancelRecvsFor(UnboundBuffer* buf);
   // Drop queued (not yet on the wire) sends referencing buf; returns count.
   int cancelSendsFor(UnboundBuffer* buf);
@@ -103,6 +107,8 @@ class Context {
     bool direct{false};  // true: land payload at `dest` and complete `ubuf`
     UnboundBuffer* ubuf{nullptr};
     char* dest{nullptr};
+    RecvReduceFn combine{nullptr};  // non-null: reduce into dest, don't copy
+    size_t combineElsize{0};
   };
   Match matchIncoming(int srcRank, uint64_t slot, size_t nbytes);
 
@@ -119,6 +125,12 @@ class Context {
   // received and how many pairs negotiated the plane (any thread).
   void shmStats(uint64_t* txBytes, uint64_t* rxBytes, int* activePairs);
 
+  // True when payloads from `rank` arrive through an shm ring (or are
+  // local self-sends) — i.e. when a fused recvReduce combines straight
+  // from staging memory with no loss of reduce/I-O overlap. Schedules use
+  // this to pick fused vs scratch receives per source (any thread).
+  bool peerUsesShm(int rank);
+
  private:
   struct PostedRecv {
     UnboundBuffer* ubuf;
@@ -126,7 +138,17 @@ class Context {
     char* dest;
     size_t nbytes;
     std::vector<char> allowed;  // indexed by rank
+    RecvReduceFn combine;       // non-null: reduce arrivals into dest
+    size_t combineElsize;
   };
+  // Land `data` at `dest`: reduce when a combine fn is set, plain copy
+  // otherwise. Single definition of delivery semantics for every staged
+  // path (self-send, stash-hit, stashArrived race).
+  static void landPayload(char* dest, RecvReduceFn combine,
+                          size_t combineElsize, const char* data,
+                          size_t nbytes);
+  static void landPayload(const PostedRecv& pr, const char* data,
+                          size_t nbytes);
   struct Stash {
     int srcRank;
     uint64_t slot;

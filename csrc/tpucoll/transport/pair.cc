@@ -1019,12 +1019,16 @@ void Pair::readLoop() {
         if (match.direct) {
           shmRxMode_ = RxMode::kDirect;
           shmRxDest_ = match.dest;
+          shmRxCombine_ = match.combine;
+          shmRxCombineElsize_ = match.combineElsize;
+          shmRxCarryLen_ = 0;
           std::lock_guard<std::mutex> guard(mu_);
           rxUbuf_ = match.ubuf;
         } else {
           shmRxMode_ = RxMode::kStash;
           shmRxStash_.resize(nbytes);
           shmRxDest_ = shmRxStash_.data();
+          shmRxCombine_ = nullptr;
         }
         rxHeaderRead_ = 0;
         continue;
@@ -1052,6 +1056,16 @@ void Pair::readLoop() {
                 return context_->writeRegion(shmRxHeader_.slot, base + off,
                                              p, len, false, peerRank_);
               });
+        } else if (shmRxCombine_ != nullptr) {
+          // Fused receive-reduce: fold ring spans into the destination in
+          // place of the staging memcpy — the payload is touched exactly
+          // once on this side.
+          char* dst = shmRxDest_ + shmRxDone_;
+          shmRx_.consume(chunk,
+                         [&](const char* p, uint64_t len, uint64_t off) {
+                           combineShmSpan(dst + off, p, len);
+                           return true;
+                         });
         } else {
           char* dst = shmRxDest_ + shmRxDone_;
           shmRx_.consume(chunk,
@@ -1096,6 +1110,7 @@ void Pair::readLoop() {
         }
         if (shmRxDone_ == shmRxTotal_) {
           shmRxActive_ = false;
+          shmRxCombine_ = nullptr;  // carry is empty: nbytes % elsize == 0
           switch (shmRxMode_) {
             case RxMode::kDirect: {
               UnboundBuffer* b = nullptr;
@@ -1209,7 +1224,18 @@ void Pair::readLoop() {
       rxPlainDone_ = 0;
       if (match.direct) {
         rxMode_ = RxMode::kDirect;
-        rxDest_ = match.dest;
+        rxCombine_ = match.combine;
+        rxCombineElsize_ = match.combineElsize;
+        if (match.combine != nullptr) {
+          // recvReduce over the byte stream: partial reads (and in-place
+          // ciphertext) must never touch the accumulator, so the payload
+          // stages first and is folded in at completion.
+          rxFinalDest_ = match.dest;
+          rxStashData_.resize(nbytes);
+          rxDest_ = rxStashData_.data();
+        } else {
+          rxDest_ = match.dest;
+        }
         std::lock_guard<std::mutex> guard(mu_);
         rxUbuf_ = match.ubuf;
       } else {
@@ -1279,6 +1305,51 @@ void Pair::readLoop() {
   }
 }
 
+void Pair::combineShmSpan(char* dst, const char* src, size_t len) {
+  const size_t el = shmRxCombineElsize_;
+  size_t head = 0;
+  if (shmRxCarryLen_ > 0) {
+    // Finish the element a previous span split. Its destination starts
+    // shmRxCarryLen_ bytes before this span's first byte.
+    head = std::min(len, el - shmRxCarryLen_);
+    std::memcpy(shmRxCarry_ + shmRxCarryLen_, src, head);
+    shmRxCarryLen_ += head;
+    if (shmRxCarryLen_ < el) {
+      return;  // still mid-element (tiny span)
+    }
+    shmRxCombine_(dst + head - el, shmRxCarry_, 1);
+    shmRxCarryLen_ = 0;
+  }
+  const size_t mid = (len - head) / el * el;
+  if (mid > 0) {
+    // The ring is a plain byte ring: after odd-length traffic a span can
+    // start at any byte, but the reduce kernels dereference typed
+    // pointers. Feed them `src` only when it satisfies the element type's
+    // alignment (the largest power of two dividing elsize, the strictest
+    // requirement a type of that size can have); otherwise fold through a
+    // small aligned bounce so typed loads never see a misaligned address.
+    // (`dst` is the caller's own element-offset buffer — its alignment is
+    // the caller's contract, exactly as on the scratch schedule.)
+    const size_t req = std::min(el & (~el + 1), size_t(16));
+    if (reinterpret_cast<uintptr_t>(src + head) % req == 0) {
+      shmRxCombine_(dst + head, src + head, mid / el);
+    } else {
+      alignas(64) char bounce[8192];
+      const size_t step = sizeof(bounce) / el * el;
+      for (size_t pos = 0; pos < mid; pos += step) {
+        const size_t n = std::min(step, mid - pos);
+        std::memcpy(bounce, src + head + pos, n);
+        shmRxCombine_(dst + head + pos, bounce, n / el);
+      }
+    }
+  }
+  const size_t tail = len - head - mid;
+  if (tail > 0) {
+    std::memcpy(shmRxCarry_, src + head + mid, tail);
+    shmRxCarryLen_ = tail;
+  }
+}
+
 void Pair::finishMessage() {
   switch (rxMode_) {
     case RxMode::kStash:
@@ -1292,6 +1363,12 @@ void Pair::finishMessage() {
       rxStashData_ = std::vector<char>();
       break;
     case RxMode::kDirect: {
+      if (rxCombine_ != nullptr) {
+        rxCombine_(rxFinalDest_, rxStashData_.data(),
+                   rxHeader_.nbytes / rxCombineElsize_);
+        rxCombine_ = nullptr;
+        rxStashData_ = std::vector<char>();
+      }
       UnboundBuffer* b = nullptr;
       {
         std::lock_guard<std::mutex> guard(mu_);

@@ -244,6 +244,13 @@ class Pair : public Handler {
   char* rxDest_{nullptr};
   std::vector<char> rxStashData_;
   RxMode rxMode_{RxMode::kDirect};
+  // Fused receive-reduce over the byte-stream path: payload (incl.
+  // ciphertext) stages in rxStashData_ so partial reads never clobber the
+  // accumulator; at message completion rxCombine_ folds the staging into
+  // rxFinalDest_ (the posted recvReduce destination).
+  RecvReduceFn rxCombine_{nullptr};
+  size_t rxCombineElsize_{0};
+  char* rxFinalDest_{nullptr};
   size_t rxPayloadRead_{0};  // progress within the current frame
   size_t rxPlainDone_{0};    // completed (verified) payload bytes
   // Encrypted rx staging: ciphertext header+tag, and the payload tag that
@@ -260,6 +267,21 @@ class Pair : public Handler {
   std::vector<char> shmRxStash_;
   uint64_t shmRxTotal_{0};
   uint64_t shmRxDone_{0};
+  // Fused receive-reduce from the shm ring: spans are combined into the
+  // destination straight out of shared memory (no staging copy at all —
+  // the whole point of recvReduce). Ring wrap and chunk caps can split an
+  // element across spans; the carry buffer bridges those bytes.
+  RecvReduceFn shmRxCombine_{nullptr};
+  size_t shmRxCombineElsize_{0};
+  // Over-aligned: the carry is fed to typed reduce kernels as a 1-element
+  // span, so it must satisfy the strictest alignment any elsize allows.
+  alignas(kMaxCombineElsize) uint8_t shmRxCarry_[kMaxCombineElsize];
+  size_t shmRxCarryLen_{0};
+
+  // Combine one in-order span of the active shm message (handles
+  // element-straddling span boundaries via shmRxCarry_). `dst` is the
+  // span's true destination address within the posted recv region.
+  void combineShmSpan(char* dst, const char* src, size_t len);
 };
 
 }  // namespace transport

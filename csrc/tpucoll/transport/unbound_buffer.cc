@@ -78,6 +78,26 @@ void UnboundBuffer::recv(const std::vector<int>& srcRanks, uint64_t slot,
                      static_cast<char*>(ptr_) + offset, nbytes);
 }
 
+void UnboundBuffer::recvReduce(int srcRank, uint64_t slot, RecvReduceFn fn,
+                               size_t elsize, size_t offset, size_t nbytes) {
+  if (nbytes == SIZE_MAX) {
+    TC_ENFORCE_LE(offset, size_);
+    nbytes = size_ - offset;
+  }
+  TC_ENFORCE_LE(offset + nbytes, size_, "recvReduce out of bounds");
+  TC_ENFORCE(fn != nullptr, "recvReduce: null reduce fn");
+  TC_ENFORCE(elsize > 0 && elsize <= kMaxCombineElsize,
+             "recvReduce: element size ", elsize, " out of range");
+  TC_ENFORCE_EQ(nbytes % elsize, size_t(0),
+                "recvReduce: payload not a whole number of elements");
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    abortRecv_ = false;
+  }
+  context_->postRecv(this, std::vector<int>{srcRank}, slot,
+                     static_cast<char*>(ptr_) + offset, nbytes, fn, elsize);
+}
+
 namespace {
 
 WireRemoteKey parseRemoteKey(const std::string& blob) {

@@ -23,6 +23,15 @@ namespace transport {
 
 class Context;
 
+// Accumulator signature for fused receive-reduce (layout-compatible with
+// tpucoll::ReduceFn, math.h:18): fn(acc, in, n) combines n elements of
+// `in` into `acc`.
+using RecvReduceFn = void (*)(void* acc, const void* in, size_t n);
+
+// Ceiling on the element size a recvReduce may use: the shm receive path
+// keeps a carry buffer this large for ring spans that split an element.
+constexpr size_t kMaxCombineElsize = 32;
+
 class UnboundBuffer {
  public:
   UnboundBuffer(Context* context, void* ptr, size_t size);
@@ -46,6 +55,22 @@ class UnboundBuffer {
   // Recv-from-any: first matching arrival from any rank in srcRanks wins.
   void recv(const std::vector<int>& srcRanks, uint64_t slot,
             size_t offset = 0, size_t nbytes = SIZE_MAX);
+
+  // Fused receive-reduce: like recv, but the incoming payload is COMBINED
+  // into [offset, offset+nbytes) with `fn(acc, in, n)` instead of
+  // overwriting it. Where the transport stages payloads anyway (shm ring,
+  // stash, self-send) the combine runs straight from the staging memory,
+  // eliminating the copy-out pass a recv-into-scratch schedule pays; the
+  // byte-stream TCP path stages internally so the accumulator is never
+  // clobbered by partial reads. The reference has no equivalent — its
+  // schedules always recv into scratch and reduce afterwards
+  // (gloo/allreduce.cc:284-299); this is the single-core/bandwidth win of
+  // owning the receive path. `fn` runs on the transport's loop thread (or
+  // the poster's thread on stash/self-send hits), so it must be
+  // thread-safe and must not block; nbytes must be a multiple of elsize
+  // (elsize <= kMaxCombineElsize).
+  void recvReduce(int srcRank, uint64_t slot, RecvReduceFn fn, size_t elsize,
+                  size_t offset = 0, size_t nbytes = SIZE_MAX);
 
   // ---- one-sided put/get (reference: transport/unbound_buffer.h:128-153
   // + remote_key.h; DCN analog of the device plane's Pallas remote DMA) --

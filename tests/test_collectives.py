@@ -624,3 +624,44 @@ def test_allreduce_custom_fn_raising_callable_surfaces():
 
     for msg in spawn(2, fn):
         assert "invalid on all ranks" in msg and "boom in user fn" in msg
+
+
+def test_recv_reduce_disabled_fallback():
+    """TPUCOLL_RECV_REDUCE=0 restores the recv-into-scratch schedule; the
+    results must be identical to the fused default. The flag is read once
+    per process, so the disabled run happens in a child interpreter."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            x = np.arange(70_000, dtype=np.float32) + rank
+            ctx.allreduce(x, algorithm="ring")
+            y = np.full(4096, float(rank + 1), np.float64)
+            out = ctx.reduce_scatter(y, [1024] * 4)
+            z = np.full(33, float(rank), np.int32)
+            r = ctx.reduce(z, root=1)
+            return x, out, r
+
+        results = spawn(4, fn)
+        base = sum(np.arange(70_000, dtype=np.float64) + r for r in range(4))
+        for x, out, r in results:
+            np.testing.assert_allclose(x, base, rtol=1e-6)
+            np.testing.assert_array_equal(out, np.full(1024, 10.0))
+        np.testing.assert_array_equal(
+            results[1][2], np.full(33, 0 + 1 + 2 + 3, np.int32))
+        print("FALLBACK-OK")
+    """).format(repo=repo)
+    env = dict(os.environ, TPUCOLL_RECV_REDUCE="0")
+    proc = subprocess.run([sys.executable, "-c", prog], env=env,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr
+    assert "FALLBACK-OK" in proc.stdout
