@@ -28,7 +28,8 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 // cost of two extra full-vector hops on those ranks).
 void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,
-                              std::chrono::milliseconds timeout);
+                              std::chrono::milliseconds timeout,
+                              bool fuseOk);
 
 // Mixed-radix grouped-hypercube (bcube) allreduce: log-depth like
 // halving-doubling but with configurable group fan-out per step; exact
@@ -36,7 +37,7 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
 // gloo/allreduce_bcube.h).
 void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
                     ReduceFn fn, Slot slot,
-                    std::chrono::milliseconds timeout);
+                    std::chrono::milliseconds timeout, bool fuseOk);
 
 // Ring allreduce with bfloat16 wire compression (float32 payloads).
 void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,

@@ -48,7 +48,7 @@ std::vector<int> primeFactors(int n) {
 
 void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
                     ReduceFn fn, Slot slot,
-                    std::chrono::milliseconds timeout) {
+                    std::chrono::milliseconds timeout, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -62,12 +62,20 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
   };
 
   auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  // Fused receive-reduce applies to RADIX-2 steps only: with one sender
+  // the kept part is written by exactly one combine stream, disjoint from
+  // the part being sent. Steps with g > 2 have g-1 senders all reducing
+  // into the SAME kept part; fusing those would let a stash-hit combine
+  // (poster's thread) race a loop-thread combine, so they stay on the
+  // arrival-ordered scratch schedule. (P = 2^k therefore fuses fully.)
+  auto canFuse = [&](int src) {
+    return collectives_detail::fuseRecvReduce(ctx, fuseOk, elsize, src);
+  };
   // Per-sender staging can need up to winCount * ceil(count/size) elements
   // at a step (uneven blocks make one part slightly larger than the
   // window's average); nbytes + size*elsize safely covers every step.
-  auto scratch = ctx->acquireScratch(nbytes + size * elsize);
-  char* tmp = scratch.data();
-  auto tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
+  // Lazily acquired: an all-radix-2 fused run never touches it.
+  collectives_detail::LazyScratch stage(ctx, nbytes + size * elsize);
 
   // Mixed-radix digits of this rank: rank = sum(digit_s * stride_s).
   std::vector<int> stride(numSteps), digit(numSteps);
@@ -115,25 +123,34 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
       workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
                     rangeOff(partStart), rangeBytes(partStart, part));
     }
-    // Receives: each sender's contribution to MY part, staged per sender
-    // (slot j at scratch offset j * partBytes) so concurrent arrivals
-    // never share memory; reduced in arrival order via the source rank.
-    std::unordered_map<int, int> senderDigit;  // src rank -> j
-    for (int j = 0; j < g; j++) {
-      if (j == digit[s]) {
-        continue;
+    const bool fused =
+        g == 2 && canFuse(member(s, 1 - digit[s]));  // single sender
+    if (fused) {
+      workBuf->recvReduce(member(s, 1 - digit[s]),
+                          stepSlot(0, s, 1 - digit[s]), fn, elsize,
+                          rangeOff(myPartStart), partBytes);
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      // Receives: each sender's contribution to MY part, staged per sender
+      // (slot j at scratch offset j * partBytes) so concurrent arrivals
+      // never share memory; reduced in arrival order via the source rank.
+      std::unordered_map<int, int> senderDigit;  // src rank -> j
+      for (int j = 0; j < g; j++) {
+        if (j == digit[s]) {
+          continue;
+        }
+        senderDigit[member(s, j)] = j;
+        stage.buf()->recv(member(s, j), stepSlot(0, s, j),
+                          size_t(j) * partBytes, partBytes);
       }
-      senderDigit[member(s, j)] = j;
-      tmpBuf->recv(member(s, j), stepSlot(0, s, j),
-                   size_t(j) * partBytes, partBytes);
-    }
-    for (int n = 0; n < g - 1; n++) {
-      int src = -1;
-      tmpBuf->waitRecv(&src, timeout);
-      const int j = senderDigit.at(src);
-      if (partBytes > 0) {
-        fn(work + rangeOff(myPartStart), tmp + size_t(j) * partBytes,
-           partBytes / elsize);
+      for (int n = 0; n < g - 1; n++) {
+        int src = -1;
+        stage.buf()->waitRecv(&src, timeout);
+        const int j = senderDigit.at(src);
+        if (partBytes > 0) {
+          fn(work + rangeOff(myPartStart),
+             stage.data() + size_t(j) * partBytes, partBytes / elsize);
+        }
       }
     }
     for (int n = 0; n < g - 1; n++) {

@@ -25,6 +25,8 @@
 #include <cerrno>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
+#include <optional>
 
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
@@ -35,6 +37,8 @@ namespace algorithms {
 using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
 using collectives_detail::largestPow2AtMost;
+using collectives_detail::fuseRecvReduce;
+using collectives_detail::LazyScratch;
 
 namespace {
 
@@ -49,7 +53,7 @@ constexpr uint64_t kUnfoldSlot = 1 << 20;
 
 void foldHalvingDoubling(Context* ctx, char* work, size_t count,
                          size_t elsize, ReduceFn fn, Slot slot,
-                         std::chrono::milliseconds timeout) {
+                         std::chrono::milliseconds timeout, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -57,9 +61,16 @@ void foldHalvingDoubling(Context* ctx, char* work, size_t count,
   const int rem = size - pow2;
 
   auto workBuf = ctx->createUnboundBuffer(work, nbytes);
-  auto scratch = ctx->acquireScratch(nbytes);
-  char* tmp = scratch.data();
-  auto tmpBuf = ctx->createUnboundBuffer(tmp, nbytes);
+  // Fused receive-reduce (single policy: collectives_detail::
+  // fuseRecvReduce): every receive-with-reduce in this walk targets a
+  // range disjoint from any concurrently sent range, so partner partials
+  // may be combined into `work` by the transport. The decision is per
+  // partner (they change each round); scratch materializes lazily, only
+  // if some round falls back.
+  auto canFuse = [&](int src) {
+    return fuseRecvReduce(ctx, fuseOk, elsize, src);
+  };
+  LazyScratch stage(ctx, nbytes);
 
   // Fold: the first 2*rem ranks pair (even, odd); odds contribute their
   // vector to their even partner and sit out the exchange.
@@ -71,9 +82,15 @@ void foldHalvingDoubling(Context* ctx, char* work, size_t count,
       workBuf->waitSend(timeout);
       vrank = -1;
     } else {
-      tmpBuf->recv(rank + 1, slot.offset(round).value(), 0, nbytes);
-      tmpBuf->waitRecv(nullptr, timeout);
-      fn(work, tmp, count);
+      if (canFuse(rank + 1)) {
+        workBuf->recvReduce(rank + 1, slot.offset(round).value(), fn,
+                            elsize, 0, nbytes);
+        workBuf->waitRecv(nullptr, timeout);
+      } else {
+        stage.buf()->recv(rank + 1, slot.offset(round).value(), 0, nbytes);
+        stage.buf()->waitRecv(nullptr, timeout);
+        fn(work, stage.data(), count);
+      }
       vrank = rank / 2;
     }
   } else {
@@ -99,15 +116,27 @@ void foldHalvingDoubling(Context* ctx, char* work, size_t count,
       const int keepStart = keepLower ? winStart : winStart + half;
       const int sendStart = keepLower ? winStart + half : winStart;
       const uint64_t s = slot.offset(round).value();
-      // Receive into the scratch mirror at the kept range's own offsets.
-      tmpBuf->recv(partner, s, rangeOff(keepStart),
-                   rangeBytes(keepStart, half));
+      const bool fused = canFuse(partner);
+      if (fused) {
+        // Combined into the kept range on arrival; the sent half is
+        // disjoint, so the in-flight send never reads combined bytes.
+        workBuf->recvReduce(partner, s, fn, elsize, rangeOff(keepStart),
+                            rangeBytes(keepStart, half));
+      } else {
+        // Receive into the scratch mirror at the kept range's own offsets.
+        stage.buf()->recv(partner, s, rangeOff(keepStart),
+                          rangeBytes(keepStart, half));
+      }
       workBuf->send(partner, s, rangeOff(sendStart),
                     rangeBytes(sendStart, half));
-      tmpBuf->waitRecv(nullptr, timeout);
-      if (rangeBytes(keepStart, half) > 0) {
-        fn(work + rangeOff(keepStart), tmp + rangeOff(keepStart),
-           rangeBytes(keepStart, half) / elsize);
+      if (fused) {
+        workBuf->waitRecv(nullptr, timeout);
+      } else {
+        stage.buf()->waitRecv(nullptr, timeout);
+        if (rangeBytes(keepStart, half) > 0) {
+          fn(work + rangeOff(keepStart), stage.data() + rangeOff(keepStart),
+             rangeBytes(keepStart, half) / elsize);
+        }
       }
       workBuf->waitSend(timeout);
       winStart = keepStart;
@@ -146,7 +175,8 @@ void foldHalvingDoubling(Context* ctx, char* work, size_t count,
 
 void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
                                  size_t elsize, ReduceFn fn, Slot slot,
-                                 std::chrono::milliseconds timeout) {
+                                 std::chrono::milliseconds timeout,
+                                 bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -177,9 +207,13 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
   auto atomBytes = [&](int first, int n) { return atoms.rangeBytes(first, n); };
 
   auto workBuf = ctx->createUnboundBuffer(work, nbytes);
-  auto scratch = ctx->acquireScratch(nbytes);
-  char* tmp = scratch.data();
-  auto tmpBuf = ctx->createUnboundBuffer(tmp, nbytes);
+  // Fused receive-reduce (single policy: collectives_detail::
+  // fuseRecvReduce; disjoint kept/sent ranges make direct combining
+  // safe). Scratch only materializes if a partner falls back.
+  auto canFuse = [&](int src) {
+    return fuseRecvReduce(ctx, fuseOk, elsize, src);
+  };
+  LazyScratch stage(ctx, nbytes);
 
   // --- intra-block reduce-scatter: recursive vector halving ---
   // The window walk lands atoms [r*Bmax/B, (r+1)*Bmax/B) on block rank r.
@@ -193,12 +227,23 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
     const int keepStart = keepLower ? winStart : winStart + half;
     const int sendStart = keepLower ? winStart + half : winStart;
     const uint64_t s = slot.offset(kRsBase + step).value();
-    tmpBuf->recv(partner, s, atomOff(keepStart), atomBytes(keepStart, half));
+    const bool fused = canFuse(partner);
+    if (fused) {
+      workBuf->recvReduce(partner, s, fn, elsize, atomOff(keepStart),
+                          atomBytes(keepStart, half));
+    } else {
+      stage.buf()->recv(partner, s, atomOff(keepStart),
+                        atomBytes(keepStart, half));
+    }
     workBuf->send(partner, s, atomOff(sendStart), atomBytes(sendStart, half));
-    tmpBuf->waitRecv(nullptr, timeout);
-    if (atomBytes(keepStart, half) > 0) {
-      fn(work + atomOff(keepStart), tmp + atomOff(keepStart),
-         atomBytes(keepStart, half) / elsize);
+    if (fused) {
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      stage.buf()->waitRecv(nullptr, timeout);
+      if (atomBytes(keepStart, half) > 0) {
+        fn(work + atomOff(keepStart), stage.data() + atomOff(keepStart),
+           atomBytes(keepStart, half) / elsize);
+      }
     }
     workBuf->waitSend(timeout);
     winStart = keepStart;
@@ -215,11 +260,20 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
     const int ratio = B / bsize[b + 1];
     const int peer = boff[b + 1] + r / ratio;
     const uint64_t s = slot.offset(kFwdBase + b).value();
-    tmpBuf->recv(peer, s, atomOff(winStart), atomBytes(winStart, winCount));
-    tmpBuf->waitRecv(nullptr, timeout);
-    if (atomBytes(winStart, winCount) > 0) {
-      fn(work + atomOff(winStart), tmp + atomOff(winStart),
-         atomBytes(winStart, winCount) / elsize);
+    if (canFuse(peer)) {
+      // No send is in flight on this side of the exchange; the partial
+      // combines into the window in place.
+      workBuf->recvReduce(peer, s, fn, elsize, atomOff(winStart),
+                          atomBytes(winStart, winCount));
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      stage.buf()->recv(peer, s, atomOff(winStart),
+                        atomBytes(winStart, winCount));
+      stage.buf()->waitRecv(nullptr, timeout);
+      if (atomBytes(winStart, winCount) > 0) {
+        fn(work + atomOff(winStart), stage.data() + atomOff(winStart),
+           atomBytes(winStart, winCount) / elsize);
+      }
     }
   }
   if (b > 0) {  // I am the smaller side of exchange b-1.
@@ -274,13 +328,15 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
 
 void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,
-                              std::chrono::milliseconds timeout) {
+                              std::chrono::milliseconds timeout,
+                              bool fuseOk) {
   const int size = ctx->size();
   const bool pow2 = (size & (size - 1)) == 0;
   if (pow2) {
     // Power-of-2 groups: binary-blocks degenerates to the same single-
     // block walk; route through the fold path (rem == 0, no fold step).
-    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
+    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout,
+                        fuseOk);
     return;
   }
   // Non-power-of-2 strategy. Loopback-measured crossover (BASELINE.md,
@@ -318,9 +374,11 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
     useBlocks = count * elsize >= crossover;
   }
   if (useBlocks) {
-    binaryBlocksHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
+    binaryBlocksHalvingDoubling(ctx, work, count, elsize, fn, slot,
+                                timeout, fuseOk);
   } else {
-    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout);
+    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout,
+                        fuseOk);
   }
 }
 

@@ -18,8 +18,8 @@ namespace tpucoll {
 using collectives_detail::Blocks;
 using collectives_detail::countBlocks;
 using collectives_detail::evenBlocks;
-using collectives_detail::recvReduceMode;
-using collectives_detail::RecvReduceMode;
+using collectives_detail::fuseRecvReduce;
+using collectives_detail::LazyScratch;
 using collectives_detail::segmentize;
 
 namespace {
@@ -62,23 +62,12 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   // reduce fns stay on the scratch path: they may not be safe on the
   // transport's loop thread (Python callbacks need the GIL). Fusing is
   // per-source: the ring only ever receives from `left`, so one check
-  // picks the schedule (see recvReduceMode for the policy).
-  const auto mode = recvReduceMode();
-  const bool fuse = fuseOk && mode != RecvReduceMode::kOff &&
-                    elsize <= transport::kMaxCombineElsize &&
-                    (mode == RecvReduceMode::kForce ||
-                     ctx->transport()->peerUsesShm(left));
-  // Pooled staging (scratch path only — the fused path receives straight
-  // into `work` and must not hold a pooled buffer it never touches):
-  // keeps pages warm across calls so the receive path never stalls on
-  // first-touch faults.
-  auto scratch = fuse ? Context::Scratch(nullptr, {})
-                      : ctx->acquireScratch(2 * std::max(maxBlock, size_t(1)));
-  char* tmp = scratch.data();
-  std::unique_ptr<transport::UnboundBuffer> tmpBuf;
-  if (!fuse) {
-    tmpBuf = ctx->createUnboundBuffer(tmp, scratch.size());
-  }
+  // picks the schedule (collectives_detail::fuseRecvReduce).
+  const bool fuse = fuseRecvReduce(ctx, fuseOk, elsize, left);
+  // Pooled staging, scratch path only (lazy: the fused path receives
+  // straight into `work`): keeps pages warm across calls so the receive
+  // path never stalls on first-touch faults.
+  LazyScratch stage(ctx, 2 * std::max(maxBlock, size_t(1)));
   const int steps = size - 1;
 
   auto sendBlockAt = [&](int step) {
@@ -106,8 +95,8 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
     }
     const size_t base = (step % 2) * maxBlock;
     for (size_t k = 0; k < segs.size(); k++) {
-      tmpBuf->recv(left, segSlot(step, k), base + segs[k].offset,
-                   segs[k].nbytes);
+      stage.buf()->recv(left, segSlot(step, k), base + segs[k].offset,
+                        segs[k].nbytes);
     }
   };
   auto postSendsFor = [&](int step) {
@@ -136,12 +125,12 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
         workBuf->waitRecv(nullptr, timeout);
         continue;
       }
-      tmpBuf->waitRecv(nullptr, timeout);
+      stage.buf()->waitRecv(nullptr, timeout);
       // Segments on one pair complete in wire order, so segment k of this
       // step is the k-th completion.
       if (segs[k].nbytes > 0) {
         fn(work + blocks.offset[recvBlock] + segs[k].offset,
-           tmp + base + segs[k].offset, segs[k].nbytes / elsize);
+           stage.data() + base + segs[k].offset, segs[k].nbytes / elsize);
       }
     }
     // Drain this step's sends — counted from the SEND block's segment list,
@@ -339,11 +328,12 @@ void allreduce(AllreduceOptions& opts) {
         break;
       case AllreduceAlgorithm::kHalvingDoubling:
         algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
-                                             fn, slot, timeout);
+                                             fn, slot, timeout,
+                                             opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kBcube:
         algorithms::bcubeAllreduce(ctx, work, opts.count, elsize, fn, slot,
-                                   timeout);
+                                   timeout, opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kRingBf16Wire:
         TC_ENFORCE(opts.dtype == DataType::kFloat32,
@@ -429,23 +419,9 @@ void reduce(ReduceOptions& opts) {
   // the transport (from the shm ring / stash, no scratch vector at all).
   // Rounds are serialized by waitRecv, so result is never concurrently a
   // send source and a combine target. Custom fns stay on the scratch path
-  // (not loop-thread-safe); the per-partner shm check picks fused vs
-  // scratch per round (see recvReduceMode).
-  const auto mode = recvReduceMode();
-  const bool fuseEligible = opts.customFn == nullptr &&
-                            mode != RecvReduceMode::kOff &&
-                            elsize <= transport::kMaxCombineElsize;
-  std::vector<char> tmp;
-  std::unique_ptr<transport::UnboundBuffer> tmpBuf;
-  auto scratchRecv = [&](int src, uint64_t recvSlot) {
-    if (!tmpBuf) {
-      tmp.resize(nbytes);
-      tmpBuf = ctx->createUnboundBuffer(tmp.data(), nbytes);
-    }
-    tmpBuf->recv(src, recvSlot, 0, nbytes);
-    tmpBuf->waitRecv(nullptr, timeout);
-    fn(result, tmp.data(), opts.count);
-  };
+  // (not loop-thread-safe); fuseRecvReduce picks per partner, per round.
+  const bool fuseOk = opts.customFn == nullptr;
+  LazyScratch stage(ctx, nbytes);
 
   int mask = 1;
   uint64_t round = 0;
@@ -459,13 +435,14 @@ void reduce(ReduceOptions& opts) {
     const int partner = vrank + mask;
     if (partner < size) {
       const int src = physical(partner);
-      if (fuseEligible && (mode == RecvReduceMode::kForce ||
-                           ctx->transport()->peerUsesShm(src))) {
+      if (fuseRecvReduce(ctx, fuseOk, elsize, src)) {
         resultBuf->recvReduce(src, slot.offset(round).value(), fn, elsize,
                               0, nbytes);
         resultBuf->waitRecv(nullptr, timeout);
       } else {
-        scratchRecv(src, slot.offset(round).value());
+        stage.buf()->recv(src, slot.offset(round).value(), 0, nbytes);
+        stage.buf()->waitRecv(nullptr, timeout);
+        fn(result, stage.data(), opts.count);
       }
     }
     mask <<= 1;

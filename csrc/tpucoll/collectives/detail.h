@@ -4,7 +4,12 @@
 #include <algorithm>
 #include <cstddef>
 #include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <optional>
 #include <vector>
+
+#include "tpucoll/context.h"
 
 namespace tpucoll {
 namespace collectives_detail {
@@ -68,22 +73,70 @@ constexpr size_t kMaxSegmentBytes = 4 << 20;
 // overlap the scratch schedule (the reference's shape, gloo/allreduce.cc:
 // 284-299) gets for free, so auto keeps scratch there.
 // TPUCOLL_RECV_REDUCE=0 forces scratch everywhere; =1 forces fused
-// everywhere (A/B measurement on any transport).
+// everywhere (A/B measurement on any transport). Anything else (but
+// ""/"auto") throws: a misspelled knob must not silently run the wrong
+// arm of an A/B experiment.
 enum class RecvReduceMode { kOff, kAuto, kForce };
 
 inline RecvReduceMode recvReduceMode() {
   static const RecvReduceMode mode = [] {
     const char* v = std::getenv("TPUCOLL_RECV_REDUCE");
-    if (v != nullptr && v[0] == '0') {
+    if (v == nullptr || *v == '\0' || std::strcmp(v, "auto") == 0) {
+      return RecvReduceMode::kAuto;
+    }
+    if (std::strcmp(v, "0") == 0) {
       return RecvReduceMode::kOff;
     }
-    if (v != nullptr && v[0] == '1') {
+    if (std::strcmp(v, "1") == 0) {
       return RecvReduceMode::kForce;
     }
-    return RecvReduceMode::kAuto;
+    TC_THROW(EnforceError, "TPUCOLL_RECV_REDUCE must be 0|1|auto, got: ", v);
   }();
   return mode;
 }
+
+// THE fuse-eligibility predicate — single definition so every schedule
+// applies the same policy. `fuseOk` = the reduction is a builtin (safe on
+// the transport's loop thread).
+inline bool fuseRecvReduce(Context* ctx, bool fuseOk, size_t elsize,
+                           int srcRank) {
+  const auto mode = recvReduceMode();
+  return fuseOk && mode != RecvReduceMode::kOff &&
+         elsize <= transport::kMaxCombineElsize &&
+         (mode == RecvReduceMode::kForce ||
+          ctx->transport()->peerUsesShm(srcRank));
+}
+
+// Pooled scratch + its unbound buffer, materialized on first use: fully
+// fused schedules never pop a pooled buffer they won't touch, while any
+// fallback still gets the warm-page pool.
+class LazyScratch {
+ public:
+  LazyScratch(Context* ctx, size_t minBytes)
+      : ctx_(ctx), minBytes_(minBytes) {}
+  char* data() {
+    ensure();
+    return tmp_;
+  }
+  transport::UnboundBuffer* buf() {
+    ensure();
+    return tmpBuf_.get();
+  }
+
+ private:
+  void ensure() {
+    if (!tmpBuf_) {
+      scratch_.emplace(ctx_->acquireScratch(minBytes_));
+      tmp_ = scratch_->data();
+      tmpBuf_ = ctx_->createUnboundBuffer(tmp_, scratch_->size());
+    }
+  }
+  Context* const ctx_;
+  const size_t minBytes_;
+  std::optional<Context::Scratch> scratch_;
+  char* tmp_{nullptr};
+  std::unique_ptr<transport::UnboundBuffer> tmpBuf_;
+};
 
 inline std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
   size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);

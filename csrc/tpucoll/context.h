@@ -59,6 +59,12 @@ class Context {
    public:
     Scratch(Context* ctx, std::vector<char> buf)
         : ctx_(ctx), buf_(std::move(buf)) {}
+    Scratch(Scratch&& o) noexcept : ctx_(o.ctx_), buf_(std::move(o.buf_)) {
+      o.ctx_ = nullptr;  // moved-from dtor returns nothing to the pool
+    }
+    Scratch(const Scratch&) = delete;
+    Scratch& operator=(const Scratch&) = delete;
+    Scratch& operator=(Scratch&&) = delete;
     ~Scratch();
     char* data() { return buf_.data(); }
     size_t size() const { return buf_.size(); }

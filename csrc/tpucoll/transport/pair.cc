@@ -1231,8 +1231,10 @@ void Pair::readLoop() {
           // ciphertext) must never touch the accumulator, so the payload
           // stages first and is folded in at completion.
           rxFinalDest_ = match.dest;
-          rxStashData_.resize(nbytes);
-          rxDest_ = rxStashData_.data();
+          if (rxCombineStage_.size() < nbytes) {
+            rxCombineStage_.resize(nbytes);
+          }
+          rxDest_ = rxCombineStage_.data();
         } else {
           rxDest_ = match.dest;
         }
@@ -1364,10 +1366,9 @@ void Pair::finishMessage() {
       break;
     case RxMode::kDirect: {
       if (rxCombine_ != nullptr) {
-        rxCombine_(rxFinalDest_, rxStashData_.data(),
+        rxCombine_(rxFinalDest_, rxCombineStage_.data(),
                    rxHeader_.nbytes / rxCombineElsize_);
-        rxCombine_ = nullptr;
-        rxStashData_ = std::vector<char>();
+        rxCombine_ = nullptr;  // stage keeps its capacity for the next one
       }
       UnboundBuffer* b = nullptr;
       {

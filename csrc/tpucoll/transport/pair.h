@@ -245,12 +245,15 @@ class Pair : public Handler {
   std::vector<char> rxStashData_;
   RxMode rxMode_{RxMode::kDirect};
   // Fused receive-reduce over the byte-stream path: payload (incl.
-  // ciphertext) stages in rxStashData_ so partial reads never clobber the
-  // accumulator; at message completion rxCombine_ folds the staging into
-  // rxFinalDest_ (the posted recvReduce destination).
+  // ciphertext) stages in rxCombineStage_ so partial reads never clobber
+  // the accumulator; at message completion rxCombine_ folds the staging
+  // into rxFinalDest_ (the posted recvReduce destination). The stage is
+  // grow-only (kept across messages): fused TCP traffic must not pay a
+  // malloc + zero-fill per message.
   RecvReduceFn rxCombine_{nullptr};
   size_t rxCombineElsize_{0};
   char* rxFinalDest_{nullptr};
+  std::vector<char> rxCombineStage_;
   size_t rxPayloadRead_{0};  // progress within the current frame
   size_t rxPlainDone_{0};    // completed (verified) payload bytes
   // Encrypted rx staging: ciphertext header+tag, and the payload tag that
