@@ -13,6 +13,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
+#include "tpucoll/transport/loop_uring.h"
 #include "tpucoll/transport/wire.h"
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
@@ -162,7 +163,7 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 
 void* tc_device_new(const char* hostname, uint16_t port,
                     const char* authKey, int encrypt, const char* iface,
-                    int busyPoll) {
+                    int busyPoll, const char* engine) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
@@ -177,6 +178,9 @@ void* tc_device_new(const char* hostname, uint16_t port,
     }
     attr.encrypt = encrypt != 0;
     attr.busyPoll = busyPoll != 0;
+    if (engine != nullptr) {
+      attr.engine = engine;
+    }
     return new DeviceHandle(std::make_shared<Device>(attr));
   } catch (const std::exception& e) {
     g_lastError = e.what();
@@ -185,6 +189,12 @@ void* tc_device_new(const char* hostname, uint16_t port,
 }
 
 void tc_device_free(void* dev) { delete asDevice(dev); }
+
+// Engine introspection: lets callers pick engine="uring" only where the
+// kernel/sandbox supports it (an explicit uring request throws otherwise).
+int tc_uring_available() {
+  return tpucoll::transport::uringAvailable() ? 1 : 0;
+}
 
 // Structured connect diagnostics hook (reference: tcp/debug_data.h +
 // DebugLogger). The callback runs on connecting threads; pass nullptr to

@@ -7,7 +7,8 @@ namespace tpucoll {
 namespace transport {
 
 Device::Device(const DeviceAttr& attr)
-    : loop_(attr.busyPoll), authKey_(attr.authKey), encrypt_(attr.encrypt) {
+    : loop_(makeLoop(attr.busyPoll, attr.engine)), authKey_(attr.authKey),
+      encrypt_(attr.encrypt) {
   TC_ENFORCE(!encrypt_ || !authKey_.empty(),
              "encrypt=true requires an auth key (the AEAD keys are "
              "derived from the PSK handshake)");
@@ -18,7 +19,7 @@ Device::Device(const DeviceAttr& attr)
                " has no usable address");
   }
   SockAddr bindAddr = resolve(host, attr.port);
-  listener_ = std::make_unique<Listener>(&loop_, bindAddr, authKey_,
+  listener_ = std::make_unique<Listener>(loop_.get(), bindAddr, authKey_,
                                          encrypt_);
 }
 

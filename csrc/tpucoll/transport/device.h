@@ -37,23 +37,26 @@ struct DeviceAttr {
   // epoll_wait(0) and blocking waits spin instead of sleeping on their
   // condition variables. Burns a core for the sub-10us regime.
   bool busyPoll{false};
+  // Event engine: "epoll" | "uring" | "auto" | "" ("" = TPUCOLL_ENGINE env
+  // if set, else auto). See loop.h / loop_uring.h.
+  std::string engine;
 };
 
 class Device {
  public:
   explicit Device(const DeviceAttr& attr);
 
-  Loop* loop() { return &loop_; }
+  Loop* loop() { return loop_.get(); }
   Listener* listener() { return listener_.get(); }
   const SockAddr& address() const { return listener_->address(); }
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
   const std::string& authKey() const { return authKey_; }
   bool encrypt() const { return encrypt_; }
-  bool busyPoll() const { return loop_.busyPoll(); }
+  bool busyPoll() const { return loop_->busyPoll(); }
   std::string str() const;
 
  private:
-  Loop loop_;  // declared first: destroyed last
+  std::unique_ptr<Loop> loop_;  // declared first: destroyed last
   std::unique_ptr<Listener> listener_;
   std::atomic<uint64_t> pairId_{1};
   std::string authKey_;

@@ -5,9 +5,11 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstdlib>
 #include <cstring>
 
 #include "tpucoll/common/logging.h"
+#include "tpucoll/transport/loop_uring.h"
 
 namespace tpucoll {
 namespace transport {
@@ -16,54 +18,52 @@ namespace {
 constexpr int kMaxEvents = 64;
 }
 
-Loop::Loop(bool busyPoll) : busyPoll_(busyPoll) {
-  epollFd_ = epoll_create1(EPOLL_CLOEXEC);
-  TC_ENFORCE_GE(epollFd_, 0, "epoll_create1: ", strerror(errno));
+// ---- LoopBase: thread + wakeup + deferral + tick barrier ----
+
+LoopBase::LoopBase(bool busyPoll) : busyPoll_(busyPoll) {
   wakeFd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
   TC_ENFORCE_GE(wakeFd_, 0, "eventfd: ", strerror(errno));
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.ptr = nullptr;  // nullptr marks the wake fd
-  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, wakeFd_, &ev), 0);
+}
+
+LoopBase::~LoopBase() {
+  // Engines stopped the thread in their own dtor (their run() uses
+  // engine state destroyed before base members); this is the backstop.
+  stopThread();
+  ::close(wakeFd_);
+}
+
+void LoopBase::startThread() {
   thread_ = std::thread([this] { run(); });
 }
 
-Loop::~Loop() {
+void LoopBase::stopThread() {
+  if (joined_ || !thread_.joinable()) {
+    return;
+  }
   stop_.store(true);
   wake();
   thread_.join();
-  ::close(wakeFd_);
-  ::close(epollFd_);
+  joined_ = true;
+  std::lock_guard<std::mutex> guard(mu_);
+  tick_ += 2;  // release any barrier() waiters at shutdown
+  cv_.notify_all();
 }
 
-void Loop::add(int fd, uint32_t events, Handler* handler) {
-  epoll_event ev{};
-  ev.events = events;
-  ev.data.ptr = handler;
-  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, fd, &ev), 0,
-                "epoll add: ", strerror(errno));
+void LoopBase::wake() {
+  uint64_t one = 1;
+  ssize_t n = write(wakeFd_, &one, sizeof(one));
+  (void)n;
 }
 
-void Loop::mod(int fd, uint32_t events, Handler* handler) {
-  epoll_event ev{};
-  ev.events = events;
-  ev.data.ptr = handler;
-  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_MOD, fd, &ev), 0,
-                "epoll mod: ", strerror(errno));
-}
-
-void Loop::del(int fd) {
-  epoll_event ev{};
-  int rv = epoll_ctl(epollFd_, EPOLL_CTL_DEL, fd, &ev);
-  if (rv != 0) {
-    TC_ENFORCE_EQ(errno, ENOENT, "epoll del: ", strerror(errno));
+void LoopBase::defer(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    deferred_.push_back(std::move(fn));
   }
-  // Tick barrier: once the loop completes the current dispatch batch, no
-  // stale event for fd can be pending.
-  barrier();
+  wake();
 }
 
-void Loop::barrier() {
+void LoopBase::barrier() {
   if (onLoopThread()) {
     return;
   }
@@ -77,25 +77,68 @@ void Loop::barrier() {
   cv_.wait(lock, [&] { return tick_ >= target || stop_.load(); });
 }
 
-void Loop::defer(std::function<void()> fn) {
-  {
-    std::lock_guard<std::mutex> guard(mu_);
-    deferred_.push_back(std::move(fn));
-  }
-  wake();
-}
-
-bool Loop::onLoopThread() const {
+bool LoopBase::onLoopThread() const {
   return std::this_thread::get_id() == thread_.get_id();
 }
 
-void Loop::wake() {
-  uint64_t one = 1;
-  ssize_t n = write(wakeFd_, &one, sizeof(one));
-  (void)n;
+void LoopBase::endOfBatch() {
+  std::vector<std::function<void()>> fns;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    tick_++;
+    fns.swap(deferred_);
+  }
+  cv_.notify_all();
+  for (auto& fn : fns) {
+    fn();
+  }
 }
 
-void Loop::run() {
+// ---- EpollLoop ----
+
+EpollLoop::EpollLoop(bool busyPoll) : LoopBase(busyPoll) {
+  epollFd_ = epoll_create1(EPOLL_CLOEXEC);
+  TC_ENFORCE_GE(epollFd_, 0, "epoll_create1: ", strerror(errno));
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // nullptr marks the wake fd
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, wakeFd_, &ev), 0);
+  startThread();
+}
+
+EpollLoop::~EpollLoop() {
+  stopThread();
+  ::close(epollFd_);
+}
+
+void EpollLoop::add(int fd, uint32_t events, Handler* handler) {
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.ptr = handler;
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_ADD, fd, &ev), 0,
+                "epoll add: ", strerror(errno));
+}
+
+void EpollLoop::mod(int fd, uint32_t events, Handler* handler) {
+  epoll_event ev{};
+  ev.events = events;
+  ev.data.ptr = handler;
+  TC_ENFORCE_EQ(epoll_ctl(epollFd_, EPOLL_CTL_MOD, fd, &ev), 0,
+                "epoll mod: ", strerror(errno));
+}
+
+void EpollLoop::del(int fd) {
+  epoll_event ev{};
+  int rv = epoll_ctl(epollFd_, EPOLL_CTL_DEL, fd, &ev);
+  if (rv != 0) {
+    TC_ENFORCE_EQ(errno, ENOENT, "epoll del: ", strerror(errno));
+  }
+  // Tick barrier: once the loop completes the current dispatch batch, no
+  // stale event for fd can be pending.
+  barrier();
+}
+
+void EpollLoop::run() {
   epoll_event events[kMaxEvents];
   while (!stop_.load()) {
     // Busy-poll mode never sleeps in the kernel: epoll_wait(0) returns
@@ -111,10 +154,8 @@ void Loop::run() {
 #endif
       // Yield between empty polls: on a dedicated core this is nearly
       // free; on an oversubscribed host it keeps spinners from starving
-      // the threads that would produce their events. Skipping the
-      // end-of-tick work (lock, tick++, notify) is safe here: barrier()
-      // and defer() both write the wake eventfd first, so any waiter
-      // forces a non-empty poll.
+      // the threads that would produce their events. Skipping
+      // endOfBatch() here is safe per its contract (wakeFd_ is watched).
       std::this_thread::yield();
       continue;
     }
@@ -135,20 +176,26 @@ void Loop::run() {
         TC_ERROR("unhandled exception on event loop thread: ", e.what());
       }
     }
-    std::vector<std::function<void()>> fns;
-    {
-      std::lock_guard<std::mutex> guard(mu_);
-      tick_++;
-      fns.swap(deferred_);
-    }
-    cv_.notify_all();
-    for (auto& fn : fns) {
-      fn();
-    }
+    endOfBatch();
   }
-  std::lock_guard<std::mutex> guard(mu_);
-  tick_ += 2;  // release any del() waiters at shutdown
-  cv_.notify_all();
+}
+
+std::unique_ptr<Loop> makeLoop(bool busyPoll, const std::string& engine) {
+  std::string e = engine;
+  if (e.empty()) {
+    const char* env = std::getenv("TPUCOLL_ENGINE");
+    e = env != nullptr ? env : "auto";
+  }
+  if (e == "auto" || e == "epoll" || e.empty()) {
+    return std::make_unique<EpollLoop>(busyPoll);
+  }
+  if (e == "uring") {
+    // Explicit request: fail loudly if the kernel/sandbox lacks io_uring
+    // instead of silently running a different engine.
+    return makeUringLoop(busyPoll);
+  }
+  TC_THROW(EnforceError, "unknown event engine (want epoll|uring|auto): ",
+           e);
 }
 
 }  // namespace transport

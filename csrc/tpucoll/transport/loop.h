@@ -1,15 +1,27 @@
-// Single epoll event-loop thread per transport device. All async socket I/O
-// dispatch happens on this thread; user threads only enqueue work and block
-// on condition variables (the reference's design point, gloo/transport/tcp/
-// loop.cc:103-220, rebuilt with an eventfd wakeup and a tick-barrier
-// unregister instead of deferred-function handshakes).
+// Event-loop engines: one I/O dispatch thread per transport device. All
+// async socket I/O dispatch happens on that thread; user threads only
+// enqueue work and block on condition variables (the reference's design
+// point, gloo/transport/tcp/loop.cc:103-220).
+//
+// Two engines implement the same contract:
+//  - EpollLoop: epoll + eventfd wakeup + tick-barrier unregister (the
+//    flagship, default).
+//  - UringLoop (loop_uring.h): io_uring with oneshot poll re-armed after
+//    every dispatch — re-arming re-checks readiness, which preserves the
+//    LEVEL-TRIGGERED semantics the pair's read budget depends on
+//    (pair.cc kReadBudget stops mid-stream and relies on re-notification).
+//    This is the modern-Linux answer to the reference's alternative
+//    event-engine tier (gloo/transport/uv, libuv on epoll's behalf).
+// Selection: DeviceAttr.engine or TPUCOLL_ENGINE = epoll|uring|auto.
 #pragma once
 
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <functional>
+#include <memory>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -24,50 +36,105 @@ class Handler {
 
 class Loop {
  public:
-  // busyPoll: spin on epoll_wait(0) instead of sleeping in the kernel —
-  // the reference's sync/busy-poll latency mode (gloo tcp/pair.cc:505
-  // MSG_DONTWAIT), traded CPU-for-latency at the device level here
-  // because one loop thread owns all sockets.
-  explicit Loop(bool busyPoll = false);
-  ~Loop();
+  virtual ~Loop() = default;
 
-  // Register fd with the epoll set. `events` is an EPOLL* mask. The handler
-  // must outlive the registration.
-  void add(int fd, uint32_t events, Handler* handler);
-  void mod(int fd, uint32_t events, Handler* handler);
+  // Register fd. `events` is an EPOLL* mask (engines translate). The
+  // handler must outlive the registration. Level-triggered semantics:
+  // a handler that returns with the fd still ready is re-notified.
+  virtual void add(int fd, uint32_t events, Handler* handler) = 0;
+  virtual void mod(int fd, uint32_t events, Handler* handler) = 0;
 
-  // Remove fd. On return it is guaranteed no handler dispatch for this fd is
-  // in flight (unless called from the loop thread itself, where that is
-  // trivially true). The barrier is a loop-generation tick: the caller waits
-  // until the loop has passed through epoll_wait at least once more.
-  void del(int fd);
+  // Remove fd. On return it is guaranteed no handler dispatch for this fd
+  // is in flight (unless called from the loop thread itself, where that is
+  // trivially true).
+  virtual void del(int fd) = 0;
 
-  bool busyPoll() const { return busyPoll_; }
+  // busyPoll: spin instead of sleeping in the kernel — the reference's
+  // sync/busy-poll latency mode (gloo tcp/pair.cc:505 MSG_DONTWAIT),
+  // traded CPU-for-latency at the device level because one loop thread
+  // owns all sockets.
+  virtual bool busyPoll() const = 0;
 
   // Run fn on the loop thread at the next tick.
-  void defer(std::function<void()> fn);
+  virtual void defer(std::function<void()> fn) = 0;
 
   // Wait until the loop has completed the current dispatch batch (no-op on
   // the loop thread). After it returns, no handler invocation that started
   // before the call is still in flight.
-  void barrier();
+  virtual void barrier() = 0;
 
-  bool onLoopThread() const;
+  virtual bool onLoopThread() const = 0;
 
- private:
-  void run();
+  // "epoll" or "uring" (introspection / tests).
+  virtual const char* engineName() const = 0;
+};
+
+// Engine factory. `engine`: "epoll", "uring", "auto", or "" (= TPUCOLL_ENGINE
+// env if set, else auto). auto = epoll (the soaked default); an explicit
+// "uring" throws if io_uring is unavailable (seccomp, old kernel) rather
+// than silently running a different engine.
+std::unique_ptr<Loop> makeLoop(bool busyPoll, const std::string& engine = "");
+
+// Machinery both engines share: the dispatch thread, eventfd wakeup, the
+// deferred-fn queue, and the tick barrier that backs the del() "no
+// dispatch in flight" contract. The tick protocol is the subtle part of
+// that contract — it lives HERE, once. Engines implement waitAndDispatch
+// (block for events, dispatch handlers, return) and call startThread()
+// at the end of their constructor; endOfBatch() runs after every
+// dispatch batch.
+class LoopBase : public Loop {
+ public:
+  explicit LoopBase(bool busyPoll);
+  ~LoopBase() override;  // engines must call stopThread() in their dtor
+
+  bool busyPoll() const override { return busyPoll_; }
+  void defer(std::function<void()> fn) override;
+  void barrier() override;
+  bool onLoopThread() const override;
+
+ protected:
+  void startThread();
+  void stopThread();  // idempotent: join the loop thread, release waiters
+  // Write the wake eventfd (any thread). Engines watch wakeFd_ their own
+  // way and must drain it when it fires.
   void wake();
+  // tick_++/notify + run deferred fns. Engines call this after every
+  // dispatch batch. Skipping it on EMPTY busy-poll spins is safe iff the
+  // engine watches wakeFd_: barrier()/defer() write the eventfd first,
+  // so any waiter forces a non-empty batch.
+  void endOfBatch();
 
-  int epollFd_{-1};
   int wakeFd_{-1};
-  std::thread thread_;
   const bool busyPoll_;
   std::atomic<bool> stop_{false};
-
-  std::mutex mu_;
+  std::mutex mu_;  // engines may extend its protection to their own state
   std::condition_variable cv_;
+
+ private:
+  // Engine body: block for events (or spin when busyPoll), dispatch
+  // handlers, call endOfBatch() per batch; return when stop_ is set.
+  virtual void run() = 0;
+
+  std::thread thread_;
+  bool joined_{false};
   uint64_t tick_{0};
   std::vector<std::function<void()>> deferred_;
+};
+
+class EpollLoop : public LoopBase {
+ public:
+  explicit EpollLoop(bool busyPoll = false);
+  ~EpollLoop() override;
+
+  void add(int fd, uint32_t events, Handler* handler) override;
+  void mod(int fd, uint32_t events, Handler* handler) override;
+  void del(int fd) override;
+  const char* engineName() const override { return "epoll"; }
+
+ private:
+  void run() override;
+
+  int epollFd_{-1};
 };
 
 }  // namespace transport

@@ -1,0 +1,385 @@
+#include "tpucoll/transport/loop_uring.h"
+
+#include <linux/io_uring.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstring>
+#include <thread>
+#include <unordered_map>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace transport {
+
+namespace {
+
+int sysIoUringSetup(unsigned entries, io_uring_params* p) {
+  return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
+}
+
+int sysIoUringEnter(int fd, unsigned toSubmit, unsigned minComplete,
+                    unsigned flags) {
+  return static_cast<int>(syscall(__NR_io_uring_enter, fd, toSubmit,
+                                  minComplete, flags, nullptr, 0));
+}
+
+// user_data encoding: fd in the high 32 bits, registration generation in
+// the low 32. Generations disambiguate stale completions after del/re-add
+// of the same fd (fds are reused by the kernel immediately).
+uint64_t encodeUd(int fd, uint32_t gen) {
+  return (uint64_t(uint32_t(fd)) << 32) | gen;
+}
+int udFd(uint64_t ud) { return int(uint32_t(ud >> 32)); }
+uint32_t udGen(uint64_t ud) { return uint32_t(ud); }
+
+// POLL_REMOVE completions carry this marker so the dispatch loop drops
+// them without a table lookup (fd slot 0xFFFFFFFF is never a real fd).
+constexpr uint64_t kRemoveUd = ~uint64_t(0);
+
+// SQ depth: submission is immediate after every prep batch (max 2 SQEs),
+// so this never fills. CQ depth: every registered fd keeps one oneshot
+// poll in flight, so outstanding CQEs scale with the device's fd count
+// (pairs x contexts sharing one device) — ask for a deep CQ up front
+// (IORING_SETUP_CQSIZE, 64 KiB of ring) and additionally survive
+// overflow via FEAT_NODROP + the -EBUSY retry in submitLocked.
+constexpr unsigned kSqEntries = 256;
+constexpr unsigned kCqEntries = 4096;
+
+}  // namespace
+
+class UringLoop : public LoopBase {
+ public:
+  explicit UringLoop(bool busyPoll) : LoopBase(busyPoll) {
+    io_uring_params p;
+    std::memset(&p, 0, sizeof(p));
+    p.flags = IORING_SETUP_CQSIZE;
+    p.cq_entries = kCqEntries;
+    ringFd_ = sysIoUringSetup(kSqEntries, &p);
+    TC_ENFORCE_GE(ringFd_, 0, "io_uring_setup: ", strerror(errno),
+                  " (TPUCOLL_ENGINE=epoll to use the epoll engine)");
+
+    // Map the rings. With FEAT_SINGLE_MMAP the SQ and CQ rings share one
+    // mapping; otherwise they are separate.
+    sqLen_ = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cqLen_ = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    const bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    if (single) {
+      sqLen_ = cqLen_ = std::max(sqLen_, cqLen_);
+    }
+    sqPtr_ = mmap(nullptr, sqLen_, PROT_READ | PROT_WRITE,
+                  MAP_SHARED | MAP_POPULATE, ringFd_, IORING_OFF_SQ_RING);
+    TC_ENFORCE(sqPtr_ != MAP_FAILED, "io_uring sq mmap: ", strerror(errno));
+    if (single) {
+      cqPtr_ = sqPtr_;
+    } else {
+      cqPtr_ = mmap(nullptr, cqLen_, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, ringFd_, IORING_OFF_CQ_RING);
+      TC_ENFORCE(cqPtr_ != MAP_FAILED, "io_uring cq mmap: ",
+                 strerror(errno));
+    }
+    sqeLen_ = p.sq_entries * sizeof(io_uring_sqe);
+    sqes_ = static_cast<io_uring_sqe*>(
+        mmap(nullptr, sqeLen_, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_POPULATE, ringFd_, IORING_OFF_SQES));
+    TC_ENFORCE(sqes_ != MAP_FAILED, "io_uring sqe mmap: ", strerror(errno));
+
+    auto* sq = static_cast<char*>(sqPtr_);
+    sqHead_ = reinterpret_cast<unsigned*>(sq + p.sq_off.head);
+    sqTail_ = reinterpret_cast<unsigned*>(sq + p.sq_off.tail);
+    sqMask_ = *reinterpret_cast<unsigned*>(sq + p.sq_off.ring_mask);
+    sqArray_ = reinterpret_cast<unsigned*>(sq + p.sq_off.array);
+    auto* cq = static_cast<char*>(cqPtr_);
+    cqHead_ = reinterpret_cast<unsigned*>(cq + p.cq_off.head);
+    cqTail_ = reinterpret_cast<unsigned*>(cq + p.cq_off.tail);
+    cqMask_ = *reinterpret_cast<unsigned*>(cq + p.cq_off.ring_mask);
+    cqes_ = reinterpret_cast<io_uring_cqe*>(cq + p.cq_off.cqes);
+
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      armWakeLocked();
+    }
+    startThread();
+  }
+
+  ~UringLoop() override {
+    stopThread();
+    if (cqPtr_ != sqPtr_ && cqPtr_ != nullptr) {
+      munmap(cqPtr_, cqLen_);
+    }
+    if (sqPtr_ != nullptr) {
+      munmap(sqPtr_, sqLen_);
+    }
+    if (sqes_ != nullptr) {
+      munmap(sqes_, sqeLen_);
+    }
+    ::close(ringFd_);
+  }
+
+  void add(int fd, uint32_t events, Handler* handler) override {
+    std::lock_guard<std::mutex> guard(mu_);
+    Reg& reg = regs_[fd];
+    reg.handler = handler;
+    reg.events = events;
+    reg.gen = nextGen_++;
+    reg.armed = true;
+    armLocked(fd, reg);
+    submitLocked();
+  }
+
+  void mod(int fd, uint32_t events, Handler* handler) override {
+    std::lock_guard<std::mutex> guard(mu_);
+    auto it = regs_.find(fd);
+    TC_ENFORCE(it != regs_.end(), "uring mod: fd not registered");
+    Reg& reg = it->second;
+    reg.handler = handler;
+    reg.events = events;
+    if (reg.armed) {
+      // Cancel the in-flight poll and re-arm with the new mask under a
+      // fresh generation (the stale completion, ready or cancelled, is
+      // dropped by the generation check).
+      removeLocked(fd, reg.gen);
+      reg.gen = nextGen_++;
+      armLocked(fd, reg);
+    }
+    // !armed: the fd is mid-dispatch on the loop thread; the post-dispatch
+    // re-arm picks up the new mask.
+    submitLocked();
+  }
+
+  void del(int fd) override {
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      auto it = regs_.find(fd);
+      if (it != regs_.end()) {
+        if (it->second.armed) {
+          removeLocked(fd, it->second.gen);
+          submitLocked();
+        }
+        regs_.erase(it);
+      }
+    }
+    // Tick barrier: once the loop completes the current dispatch batch, no
+    // stale completion for fd can still be dispatching.
+    barrier();
+  }
+
+  const char* engineName() const override { return "uring"; }
+
+ private:
+  struct Reg {
+    Handler* handler{nullptr};
+    uint32_t events{0};
+    uint32_t gen{0};
+    bool armed{false};
+  };
+
+  // --- SQ production (mu_ held) ---
+
+  io_uring_sqe* sqeLocked() {
+    // Submission is immediate after every prep batch, and batches are at
+    // most 2 entries (remove + add), so the SQ cannot fill.
+    const unsigned head =
+        __atomic_load_n(sqHead_, __ATOMIC_ACQUIRE);
+    const unsigned tail = sqTailLocal_;
+    TC_ENFORCE(tail - head < kSqEntries, "io_uring SQ overflow");
+    io_uring_sqe* sqe = &sqes_[tail & sqMask_];
+    std::memset(sqe, 0, sizeof(*sqe));
+    sqArray_[tail & sqMask_] = tail & sqMask_;
+    sqTailLocal_ = tail + 1;
+    pending_++;
+    return sqe;
+  }
+
+  void armLocked(int fd, const Reg& reg) {
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_POLL_ADD;
+    sqe->fd = fd;
+    // EPOLL* and POLL* share values for IN/OUT/ERR/HUP/RDHUP; pass through.
+    sqe->poll32_events = reg.events | POLLERR | POLLHUP;
+    sqe->user_data = encodeUd(fd, reg.gen);
+  }
+
+  void removeLocked(int fd, uint32_t gen) {
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_POLL_REMOVE;
+    sqe->addr = encodeUd(fd, gen);
+    sqe->user_data = kRemoveUd;
+  }
+
+  void armWakeLocked() {
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_POLL_ADD;
+    sqe->fd = wakeFd_;
+    sqe->poll32_events = POLLIN;
+    sqe->user_data = encodeUd(wakeFd_, 0);  // gen 0 = the wake poll
+    submitLocked();
+  }
+
+  void submitLocked() {
+    if (pending_ == 0) {
+      return;
+    }
+    __atomic_store_n(sqTail_, sqTailLocal_, __ATOMIC_RELEASE);
+    const unsigned n = pending_;
+    pending_ = 0;
+    for (;;) {
+      int rv = sysIoUringEnter(ringFd_, n, 0, 0);
+      if (rv >= 0) {
+        return;
+      }
+      if (errno == EINTR) {
+        continue;
+      }
+      if (errno == EBUSY) {
+        // CQ is saturated (FEAT_NODROP backlog): the loop thread drains
+        // it without taking mu_, so yielding here makes progress even
+        // though we hold the lock. Bounded in practice by the CQ depth.
+        std::this_thread::yield();
+        continue;
+      }
+      TC_THROW(EnforceError, "io_uring_enter(submit): ", strerror(errno));
+    }
+  }
+
+  // --- CQ consumption (loop thread only) ---
+
+  void run() override {
+    struct Completion {
+      uint64_t ud;
+      int32_t res;
+    };
+    std::vector<Completion> batch;
+    while (!stop_.load()) {
+      // Drain available completions (sole consumer: plain head, acquire
+      // tail).
+      batch.clear();
+      unsigned head = *cqHead_;
+      const unsigned tail = __atomic_load_n(cqTail_, __ATOMIC_ACQUIRE);
+      for (; head != tail; head++) {
+        const io_uring_cqe& cqe = cqes_[head & cqMask_];
+        batch.push_back({cqe.user_data, cqe.res});
+      }
+      __atomic_store_n(cqHead_, head, __ATOMIC_RELEASE);
+
+      if (batch.empty()) {
+        if (busyPoll_) {
+#if defined(__x86_64__) || defined(__i386__)
+          __builtin_ia32_pause();
+#endif
+          // Same contract as EpollLoop: barrier()/defer() write the wake
+          // eventfd first, so skipping endOfBatch() on empty spins cannot
+          // strand a waiter.
+          std::this_thread::yield();
+          continue;
+        }
+        int rv = sysIoUringEnter(ringFd_, 0, 1, IORING_ENTER_GETEVENTS);
+        if (rv < 0 && errno != EINTR && errno != EBUSY) {
+          TC_ERROR("io_uring_enter(wait): ", strerror(errno));
+        }
+        continue;  // re-drain
+      }
+
+      for (const Completion& c : batch) {
+        if (c.ud == kRemoveUd) {
+          continue;  // POLL_REMOVE ack
+        }
+        const int fd = udFd(c.ud);
+        const uint32_t gen = udGen(c.ud);
+        if (fd == wakeFd_ && gen == 0) {
+          uint64_t drain;
+          while (read(wakeFd_, &drain, sizeof(drain)) > 0) {
+          }
+          std::lock_guard<std::mutex> guard(mu_);
+          armWakeLocked();
+          continue;
+        }
+        Handler* handler = nullptr;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          auto it = regs_.find(fd);
+          if (it == regs_.end() || it->second.gen != gen) {
+            continue;  // stale: removed or re-registered since
+          }
+          it->second.armed = false;
+          handler = it->second.handler;
+        }
+        // Same-generation ECANCELED should not happen (mod() bumps the
+        // generation before cancelling), but if it does, skip the dispatch
+        // and fall through to the re-arm so the fd cannot go silent.
+        if (c.res != -ECANCELED) {
+          const uint32_t events =
+              c.res > 0 ? uint32_t(c.res) : uint32_t(EPOLLERR);
+          try {
+            handler->handleEvents(events);
+          } catch (const std::exception& e) {
+            // Same contract as EpollLoop: handlers own expected failures.
+            TC_ERROR("unhandled exception on uring loop thread: ", e.what());
+          }
+        }
+        // Oneshot re-arm AFTER dispatch: POLL_ADD reports current
+        // readiness immediately, so un-drained data (read budget) fires
+        // again right away — level-triggered semantics.
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          auto it = regs_.find(fd);
+          if (it != regs_.end() && it->second.gen == gen &&
+              !it->second.armed) {
+            it->second.armed = true;
+            armLocked(fd, it->second);
+            submitLocked();
+          }
+        }
+      }
+
+      endOfBatch();
+    }
+  }
+
+  int ringFd_{-1};
+  void* sqPtr_{nullptr};
+  void* cqPtr_{nullptr};
+  size_t sqLen_{0}, cqLen_{0}, sqeLen_{0};
+  io_uring_sqe* sqes_{nullptr};
+  unsigned* sqHead_{nullptr};
+  unsigned* sqTail_{nullptr};
+  unsigned sqMask_{0};
+  unsigned* sqArray_{nullptr};
+  unsigned* cqHead_{nullptr};
+  unsigned* cqTail_{nullptr};
+  unsigned cqMask_{0};
+  io_uring_cqe* cqes_{nullptr};
+
+  unsigned sqTailLocal_{0};  // mu_ held for writes
+  unsigned pending_{0};
+  std::unordered_map<int, Reg> regs_;
+  uint32_t nextGen_{1};  // gen 0 is reserved for the wake poll
+};
+
+bool uringAvailable() {
+  static const bool ok = [] {
+    io_uring_params p;
+    std::memset(&p, 0, sizeof(p));
+    int fd = sysIoUringSetup(2, &p);
+    if (fd < 0) {
+      return false;
+    }
+    ::close(fd);
+    return true;
+  }();
+  return ok;
+}
+
+std::unique_ptr<Loop> makeUringLoop(bool busyPoll) {
+  return std::make_unique<UringLoop>(busyPoll);
+}
+
+}  // namespace transport
+}  // namespace tpucoll

@@ -1,0 +1,25 @@
+// io_uring event engine (see loop.h for the engine contract and why the
+// poll mode is oneshot-rearm). Raw syscall implementation — the image has
+// no liburing — against <linux/io_uring.h>: one ring per device, POLL_ADD
+// oneshot per registered fd, re-armed after every dispatch so handlers
+// keep level-triggered semantics. This is the TPU build's answer to the
+// reference's alternative-event-engine tier (gloo/transport/uv/*, libuv):
+// instead of carrying a second portability library, carry the kernel's
+// own modern interface behind the same Loop contract.
+#pragma once
+
+#include <memory>
+
+#include "tpucoll/transport/loop.h"
+
+namespace tpucoll {
+namespace transport {
+
+// True when the running kernel/sandbox lets us set up an io_uring.
+bool uringAvailable();
+
+// Throws EnforceError when unavailable.
+std::unique_ptr<Loop> makeUringLoop(bool busyPoll);
+
+}  // namespace transport
+}  // namespace tpucoll

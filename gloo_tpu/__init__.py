@@ -29,6 +29,7 @@ from gloo_tpu.core import (
     set_connect_debug_logger,
     TimeoutError,
     UnboundBuffer,
+    uring_available,
 )
 
 __version__ = "0.1.0"
@@ -49,4 +50,5 @@ __all__ = [
     "TimeoutError",
     "UnboundBuffer",
     "__version__",
+    "uring_available",
 ]

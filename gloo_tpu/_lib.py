@@ -84,8 +84,9 @@ _PROTOTYPES = {
     # device / context
     "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16,
                        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
-                       ctypes.c_int]),
+                       ctypes.c_int, ctypes.c_char_p]),
     "tc_device_free": (None, [_c]),
+    "tc_uring_available": (_int, []),
     "tc_set_connect_debug_logger": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),

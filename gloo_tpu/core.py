@@ -234,8 +234,14 @@ class TcpStore(Store):
             check_handle(_lib.lib.tc_tcp_store_new(host.encode(), port)))
 
 
+def uring_available() -> bool:
+    """True when the io_uring event engine can run here (kernel + sandbox).
+    Device(engine="uring") raises when it cannot; this probes first."""
+    return bool(_lib.lib.tc_uring_available())
+
+
 class Device:
-    """Transport endpoint: epoll loop thread + shared listener."""
+    """Transport endpoint: event-engine loop thread + shared listener."""
 
     # Class-level fallbacks so __del__ is safe when __init__ raised
     # before assignment.
@@ -244,7 +250,8 @@ class Device:
 
     def __init__(self, hostname: str = "127.0.0.1", port: int = 0,
                  auth_key: Optional[str] = None, encrypt: bool = False,
-                 iface: Optional[str] = None, busy_poll: bool = False):
+                 iface: Optional[str] = None, busy_poll: bool = False,
+                 engine: Optional[str] = None):
         """auth_key: pre-shared key enabling the mutual HMAC handshake on
         every connection (all ranks must agree; see docs/transport.md).
         encrypt=True additionally encrypts the data plane with
@@ -254,7 +261,9 @@ class Device:
         interface NAME (its first address overrides hostname).
         busy_poll=True spins instead of sleeping (loop thread and
         blocking waits) — the reference's sync mode for the sub-10us
-        latency regime; burns a core."""
+        latency regime; burns a core. engine picks the event engine:
+        "epoll" | "uring" (io_uring) | "auto"; default = TPUCOLL_ENGINE
+        env, else auto (docs/transport.md)."""
         if encrypt and not auth_key:
             raise ValueError("encrypt=True requires auth_key")
         self._handle = check_handle(
@@ -262,7 +271,8 @@ class Device:
                                    auth_key.encode() if auth_key else None,
                                    1 if encrypt else 0,
                                    iface.encode() if iface else None,
-                                   1 if busy_poll else 0))
+                                   1 if busy_poll else 0,
+                                   engine.encode() if engine else None))
         self._free = _lib.lib.tc_device_free
 
     def __del__(self):
