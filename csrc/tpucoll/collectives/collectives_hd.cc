@@ -21,12 +21,8 @@
 // windows flow back down the chain, and each block allgathers internally.
 // The fold path is kept as TPUCOLL_HD_NP2=fold for small payloads where
 // its fewer messages can win.
-#include <cctype>
-#include <cerrno>
 #include <cstdlib>
 #include <cstring>
-#include <memory>
-#include <optional>
 
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
@@ -356,21 +352,8 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
     TC_THROW(EnforceError, "TPUCOLL_HD_NP2 must be blocks|fold|auto, got: ",
              env);
   } else {
-    size_t crossover = 1 << 20;
-    if (const char* c = std::getenv("TPUCOLL_HD_NP2_CROSSOVER")) {
-      char* end = nullptr;
-      errno = 0;
-      crossover = std::strtoull(c, &end, 10);
-      // strtoull silently wraps negatives (even behind whitespace) and
-      // ERANGE overflows; both are misconfigurations this knob exists to
-      // catch loudly — accept plain digit strings only.
-      if (end == c || *end != '\0' ||
-          !std::isdigit(static_cast<unsigned char>(c[0])) ||
-          errno == ERANGE) {
-        TC_THROW(EnforceError,
-                 "TPUCOLL_HD_NP2_CROSSOVER must be a byte count, got: ", c);
-      }
-    }
+    static const size_t crossover = collectives_detail::envBytes(
+        "TPUCOLL_HD_NP2_CROSSOVER", 1 << 20);
     useBlocks = count * elsize >= crossover;
   }
   if (useBlocks) {

@@ -4,6 +4,7 @@
 #include <cstring>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/collectives/detail.h"
 
 namespace tpucoll {
 
@@ -68,11 +69,10 @@ void broadcast(BroadcastOptions& opts) {
 
   // 4 MiB default: measured knee on loopback (finer segments pay more in
   // per-message overhead than the relay pipelining saves; deep trees on
-  // real networks may prefer smaller via TPUCOLL_BCAST_SEG).
-  size_t kBroadcastSegment = 4 << 20;
-  if (const char* env = std::getenv("TPUCOLL_BCAST_SEG")) {
-    kBroadcastSegment = std::max<size_t>(std::atoll(env), 4096);
-  }
+  // real networks may prefer smaller via TPUCOLL_BCAST_SEG — strict
+  // digits-only parse, floored at 4 KiB).
+  static const size_t kBroadcastSegment = std::max<size_t>(
+      collectives_detail::envBytes("TPUCOLL_BCAST_SEG", 4 << 20), 4096);
   const size_t segBytes =
       std::max(kBroadcastSegment / elsize * elsize, elsize);
   const size_t numSegs = nbytes == 0 ? 1 : (nbytes + segBytes - 1) / segBytes;

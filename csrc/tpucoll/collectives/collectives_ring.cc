@@ -244,8 +244,12 @@ void allgatherv(AllgathervOptions& opts) {
   // Small/medium payloads: direct exchange — every pair transfers
   // concurrently with no store-and-forward chain (measured ~2x faster
   // than the ring below the threshold; the ring wins for bulk payloads
-  // where per-link balance matters).
-  if (maxBlock * size_t(size - 1) <= (8u << 20)) {
+  // where per-link balance matters). Loopback-tuned default; re-sweep on
+  // real DCN via TPUCOLL_ALLGATHER_DIRECT_MAX (bytes of total non-local
+  // traffic per rank; BASELINE.md documents the procedure).
+  static const size_t directMax =
+      collectives_detail::envBytes("TPUCOLL_ALLGATHER_DIRECT_MAX", 8u << 20);
+  if (maxBlock * size_t(size - 1) <= directMax) {
     for (int i = 1; i < size; i++) {
       const int to = (rank + i) % size;
       const int from = (rank - i + size) % size;
@@ -311,9 +315,12 @@ void allreduce(AllreduceOptions& opts) {
     AllreduceAlgorithm algo = opts.algorithm;
     if (algo == AllreduceAlgorithm::kAuto) {
       // Crossover measured on loopback 8 ranks (BASELINE.md): halving-
-      // doubling wins up to ~1 MiB, the pipelined ring beyond.
-      algo = nbytes <= (1 << 20) ? AllreduceAlgorithm::kHalvingDoubling
-                                 : AllreduceAlgorithm::kRing;
+      // doubling wins up to ~1 MiB, the pipelined ring beyond. Re-sweep
+      // on real DCN via TPUCOLL_ALLREDUCE_HD_MAX (payload bytes).
+      static const size_t hdMax = collectives_detail::envBytes(
+          "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
+      algo = nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
+                             : AllreduceAlgorithm::kRing;
     }
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1,

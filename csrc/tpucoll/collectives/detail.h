@@ -2,6 +2,7 @@
 #pragma once
 
 #include <algorithm>
+#include <cerrno>
 #include <cstddef>
 #include <cstdlib>
 #include <cstring>
@@ -93,6 +94,26 @@ inline RecvReduceMode recvReduceMode() {
     TC_THROW(EnforceError, "TPUCOLL_RECV_REDUCE must be 0|1|auto, got: ", v);
   }();
   return mode;
+}
+
+// Strict byte-count env knob: accepts plain digit strings only, throws on
+// anything else (strtoull would silently wrap negatives and overflows —
+// exactly the misconfigurations a tuning knob must catch loudly). Call
+// sites cache the result in a function-local static: these gate hot
+// schedule decisions.
+inline size_t envBytes(const char* name, size_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') {
+    return dflt;
+  }
+  char* end = nullptr;
+  errno = 0;
+  const unsigned long long parsed = std::strtoull(v, &end, 10);
+  if (end == v || *end != '\0' ||
+      !(v[0] >= '0' && v[0] <= '9') || errno == ERANGE) {
+    TC_THROW(EnforceError, name, " must be a byte count, got: ", v);
+  }
+  return static_cast<size_t>(parsed);
 }
 
 // THE fuse-eligibility predicate — single definition so every schedule
