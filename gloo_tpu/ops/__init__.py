@@ -9,6 +9,7 @@ DMA engines directly for schedules XLA does not emit.
 from gloo_tpu.ops.attention import (flash_attention, flash_attention_step,
                                     flash_attention_bwd_step,
                                      largest_block)
+from gloo_tpu.ops.overlap import allgather_matmul, matmul_reduce_scatter
 from gloo_tpu.ops.rope import apply_rope, rope_positions
 from gloo_tpu.ops.pallas_ring import (pallas_alltoall, ring_allgather,
                                        ring_allreduce,
@@ -18,7 +19,8 @@ from gloo_tpu.ops.pallas_ring import (pallas_alltoall, ring_allgather,
                                        ring_allreduce_torus,
                                        ring_reduce_scatter)
 
-__all__ = ["apply_rope", "rope_positions",
+__all__ = ["allgather_matmul", "apply_rope", "matmul_reduce_scatter",
+           "rope_positions",
            "flash_attention", "flash_attention_step",
            "flash_attention_bwd_step", "pallas_alltoall", "ring_allgather",
            "ring_allreduce",

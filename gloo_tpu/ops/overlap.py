@@ -1,0 +1,331 @@
+"""Compute/communication overlap kernels: matmul fused with its collective.
+
+The tensor-parallel hot path pays one collective per matmul (row-parallel:
+Y = sum_d X_d @ W_d then scatter rows; column-parallel backward: gather
+then matmul). Issued separately, the MXU idles during the collective and
+the ICI idles during the matmul. These kernels interleave them at ring-
+chunk granularity — each ICI hop's transfer flies while the MXU computes
+the NEXT chunk's partial product — the "collective matmul" the TPU's
+compiler applies to XLA-level sharded dots, here available as an explicit
+Pallas primitive for custom schedules (reference framework has no device
+compute at all; this is the TPU-native frontier beyond it).
+
+Both ops are differentiable and exactly dual under transposition:
+  matmul_reduce_scatter bwd -> allgather (+ dots)
+  allgather_matmul bwd      -> matmul_reduce_scatter
+Call inside shard_map; shapes per shard. Validated against reference
+einsums on the distributed-interpreter CPU mesh (tests/test_overlap.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gloo_tpu.ops.pallas_ring import _ring_neighbors, ring_allgather
+
+
+def _matmul_rs_kernel(x_ref, w_ref, o_ref, send_stage, comm, send_sem,
+                      recv_sem, ack_sem, *, axis_name: str, mesh_axes,
+                      num_devices: int, chunk_rows: int):
+    """Ring reduce-scatter of Y = sum_d X_d @ W_d, with each rank's partial
+    for a block computed WHILE the running sum for that block is in flight.
+
+    Schedule (ringReduceScatter convention, startShift=-1: block b lands
+    on rank b after P-1 hops): at step s this rank sends the running sum
+    for block (r-1-s) and receives block (r-2-s), adding its just-computed
+    partial. Double-buffered staging on both sides; comm-slot reuse is
+    ack-gated exactly like the pallas ring allreduce.
+    """
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    _, right, left = _ring_neighbors(axis_name, mesh_axes)
+
+    def partial_block(b):
+        rows = x_ref[pl.ds(b * chunk_rows, chunk_rows), :]
+        return jnp.dot(rows, w_ref[...],
+                       preferred_element_type=jnp.float32).astype(
+                           o_ref.dtype)
+
+    send_stage[0] = partial_block(lax.rem(my - 1 + n, n))
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def rdma(s):
+        slot = lax.rem(s, 2)
+        return pltpu.make_async_remote_copy(
+            src_ref=send_stage.at[slot],
+            dst_ref=comm.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def step(s, _):
+        slot = lax.rem(s, 2)
+        # Slot reuse (s >= 2): the right neighbor must have consumed what
+        # we parked in its comm[slot] two steps ago.
+        @pl.when(s >= 2)
+        def _():
+            pltpu.semaphore_wait(ack_sem.at[slot], 1)
+
+        tx = rdma(s)
+        tx.start()
+        # THE overlap: this block's local partial streams through the MXU
+        # while the running sum for it rides the ICI.
+        br = lax.rem(my - 2 - s + 2 * n, n)
+        p = partial_block(br)
+        tx.wait_recv()
+        tot = comm[slot] + p
+
+        # Next hop's payload. Its staging buffer was the src of send s-1;
+        # that transfer must have fully left before we overwrite it.
+        @pl.when(jnp.logical_and(s < n - 2, s >= 1))
+        def _():
+            rdma(s - 1).wait_send()
+
+        @pl.when(s < n - 2)
+        def _():
+            send_stage[lax.rem(s + 1, 2)] = tot
+
+        @pl.when(s == n - 2)
+        def _():
+            o_ref[...] = tot  # br == my at the last step
+
+        pltpu.semaphore_signal(ack_sem.at[slot], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n - 1, step, 0)
+
+    # Drain: two outstanding acks/sends for n >= 3, one for n == 2, so
+    # every semaphore ends the kernel at zero.
+    @pl.when(n >= 3)
+    def _():
+        pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 3, 2)], 1)
+        rdma(n - 3).wait_send()
+
+    pltpu.semaphore_wait(ack_sem.at[lax.rem(n - 2, 2)], 1)
+    rdma(n - 2).wait_send()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh_axes",
+                                    "collective_id", "interpret"))
+def _matmul_rs_shard(x, w, *, axis_name: str, mesh_axes, collective_id: int,
+                     interpret: bool):
+    n = lax.axis_size(axis_name)
+    m, k = x.shape
+    k2, cols = w.shape
+    assert k == k2, f"matmul_reduce_scatter: inner dims {k} vs {k2}"
+    assert m % n == 0, f"rows {m} not divisible by ring size {n}"
+    chunk_rows = m // n
+    if n == 1:
+        return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(
+            x.dtype)
+    kernel = functools.partial(_matmul_rs_kernel, axis_name=axis_name,
+                               mesh_axes=mesh_axes, num_devices=n,
+                               chunk_rows=chunk_rows)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=jax.ShapeDtypeStruct((chunk_rows, cols), x.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_rows, cols), x.dtype),  # send staging
+            pltpu.VMEM((2, chunk_rows, cols), x.dtype),  # comm slots
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x, w)
+
+
+def _ag_matmul_kernel(x_ref, w_ref, y_ref, gx_ref, ag_send, ag_recv, *,
+                      axis_name: str, mesh_axes, num_devices: int,
+                      chunk_rows: int):
+    """Ring allgather of X with the per-chunk matmul interleaved: chunk
+    (my - s) is forwarded right at step s while its product with W streams
+    through the MXU. gx_ref accumulates the gathered X (written once per
+    chunk, like the plain ring allgather) and doubles as the DMA target."""
+    n = num_devices
+    my = lax.axis_index(axis_name)
+    _, right, left = _ring_neighbors(axis_name, mesh_axes)
+
+    gx_ref[pl.ds(my * chunk_rows, chunk_rows), :] = x_ref[...]
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def dot_chunk(c):
+        rows = gx_ref[pl.ds(c * chunk_rows, chunk_rows), :]
+        y_ref[pl.ds(c * chunk_rows, chunk_rows), :] = jnp.dot(
+            rows, w_ref[...],
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)
+
+    def ag_rdma(s):
+        send_chunk = lax.rem(my - s + n, n)
+        ref = gx_ref.at[pl.ds(send_chunk * chunk_rows, chunk_rows), :]
+        return pltpu.make_async_remote_copy(
+            src_ref=ref, dst_ref=ref,
+            send_sem=ag_send.at[s], recv_sem=ag_recv.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    def ag_step(s, _):
+        tx = ag_rdma(s)
+        tx.start()
+        # Chunk (my - s) is already local (own chunk at s=0, received at
+        # step s-1 otherwise): its matmul overlaps the in-flight forward.
+        dot_chunk(lax.rem(my - s + n, n))
+        tx.wait_recv()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_step, 0)
+    # Last received chunk was never forwarded; compute its product.
+    dot_chunk(lax.rem(my - (n - 1) + n, n))
+
+    def ag_drain(s, _):
+        ag_rdma(s).wait_send()
+        return 0
+
+    lax.fori_loop(0, n - 1, ag_drain, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis_name", "mesh_axes",
+                                    "collective_id", "interpret"))
+def _ag_matmul_shard(x, w, *, axis_name: str, mesh_axes, collective_id: int,
+                     interpret: bool):
+    n = lax.axis_size(axis_name)
+    rows, k = x.shape
+    k2, cols = w.shape
+    assert k == k2, f"allgather_matmul: inner dims {k} vs {k2}"
+    if n == 1:
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        return y, x
+    kernel = functools.partial(_ag_matmul_kernel, axis_name=axis_name,
+                               mesh_axes=mesh_axes, num_devices=n,
+                               chunk_rows=rows)
+    return pl.pallas_call(
+        kernel,
+        interpret=pltpu.InterpretParams() if interpret else False,
+        out_shape=(
+            jax.ShapeDtypeStruct((n * rows, cols), x.dtype,
+                                 vma=frozenset({axis_name})),
+            jax.ShapeDtypeStruct((n * rows, k), x.dtype,
+                                 vma=frozenset({axis_name})),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+    )(x, w)
+
+
+# --------------------------------------------------------------------------
+# Public, differentiable ops (exactly dual under transposition).
+# --------------------------------------------------------------------------
+
+
+def matmul_reduce_scatter(x, w, axis_name: str, collective_id: int = 21,
+                          interpret: bool = False, mesh_axes=None):
+    """Rows [r*m/P, (r+1)*m/P) of sum_d X_d @ W_d, computed with the ring
+    reduce-scatter overlapped against the per-block matmuls.
+
+    Per shard: x [m, k_local], w [k_local, cols] -> [m/P, cols]. The
+    row-parallel TP forward (k sharded over `axis_name`) with its output
+    scattered over rows; m % P == 0 and tiling-friendly dims required.
+    On a multi-axis mesh, mesh_axes (the Mesh's full axis order) is
+    REQUIRED so the ring RDMA routes by flattened logical device id —
+    see ring_reduce_scatter. VJP: dx = gather(g) @ w^T,
+    dw = x^T @ gather(g) — one allgather.
+    """
+    axes = None if mesh_axes is None else tuple(mesh_axes)
+
+    @jax.custom_vjp
+    def op(xv, wv):
+        return _matmul_rs_shard(xv, wv, axis_name=axis_name, mesh_axes=axes,
+                                collective_id=collective_id,
+                                interpret=interpret)
+
+    def fwd(xv, wv):
+        return op(xv, wv), (xv, wv)
+
+    def bwd(res, g):
+        xv, wv = res
+        gfull = ring_allgather(g, axis_name, collective_id=collective_id + 1,
+                               interpret=interpret, mesh_axes=axes)
+        dx = jnp.dot(gfull, wv.T,
+                     preferred_element_type=jnp.float32).astype(xv.dtype)
+        dw = jnp.dot(xv.T, gfull,
+                     preferred_element_type=jnp.float32).astype(wv.dtype)
+        return dx, dw
+
+    op.defvjp(fwd, bwd)
+    return op(x, w)
+
+
+def allgather_matmul(x, w, axis_name: str, collective_id: int = 23,
+                     interpret: bool = False, mesh_axes=None):
+    """gather_rows(X over `axis_name`) @ W, the ring allgather overlapped
+    against per-chunk matmuls.
+
+    Per shard: x [m_local, k], w [k, cols] -> [P*m_local, cols]. The
+    column-parallel TP pattern (w may be a per-device column shard).
+    On a multi-axis mesh, mesh_axes is REQUIRED (see
+    matmul_reduce_scatter). VJP: dx = matmul_reduce_scatter(g, w^T)
+    (the dual fused kernel), dw = gather(x)^T @ g (gathered X is saved
+    from the forward).
+    """
+    axes = None if mesh_axes is None else tuple(mesh_axes)
+
+    @jax.custom_vjp
+    def op(xv, wv):
+        y, _ = _ag_matmul_shard(xv, wv, axis_name=axis_name, mesh_axes=axes,
+                                collective_id=collective_id,
+                                interpret=interpret)
+        return y
+
+    def fwd(xv, wv):
+        y, gx = _ag_matmul_shard(xv, wv, axis_name=axis_name, mesh_axes=axes,
+                                 collective_id=collective_id,
+                                 interpret=interpret)
+        return y, (gx, wv)
+
+    def bwd(res, g):
+        gx, wv = res
+        dx = matmul_reduce_scatter(g, wv.T, axis_name,
+                                   collective_id=collective_id + 1,
+                                   interpret=interpret, mesh_axes=axes)
+        dw = jnp.dot(gx.T, g,
+                     preferred_element_type=jnp.float32).astype(wv.dtype)
+        return dx, dw
+
+    op.defvjp(fwd, bwd)
+    return op(x, w)

@@ -40,3 +40,32 @@ def tp_mlp_block(x, w_up_shard, w_down_shard, axis: str, activation=None):
     h = column_parallel_dense(x, w_up_shard, axis)
     h = act(h)
     return row_parallel_dense(h, w_down_shard, axis)
+
+
+def row_parallel_dense_scattered(x_shard, w_shard, axis: str,
+                                 interpret: bool = False, mesh_axes=None):
+    """Row-parallel dense with the output SCATTERED over rows (sequence
+    dim) instead of replicated — and the reduce-scatter fused into the
+    matmul at ring-chunk granularity (gloo_tpu.ops.matmul_reduce_scatter):
+    each ICI hop flies while the MXU computes the next chunk's partial.
+    The Megatron-sp pattern (row-parallel -> reduce-scatter) in one
+    kernel; pair with allgather_matmul_dense for the gather side. On a
+    multi-axis mesh pass mesh_axes (the Mesh's full axis order)."""
+    from gloo_tpu.ops import matmul_reduce_scatter
+
+    return matmul_reduce_scatter(x_shard, w_shard, axis,
+                                 interpret=interpret, mesh_axes=mesh_axes)
+
+
+def allgather_matmul_dense(x_rows_shard, w, axis: str,
+                           interpret: bool = False, mesh_axes=None):
+    """Column-parallel-style dense whose input rows are sequence-sharded:
+    gather(x) @ w with the allgather overlapped against per-chunk matmuls
+    (gloo_tpu.ops.allgather_matmul). The dual of
+    row_parallel_dense_scattered — together they close the Megatron-sp
+    loop with both collectives fused. On a multi-axis mesh pass
+    mesh_axes (the Mesh's full axis order)."""
+    from gloo_tpu.ops import allgather_matmul
+
+    return allgather_matmul(x_rows_shard, w, axis, interpret=interpret,
+                            mesh_axes=mesh_axes)
