@@ -41,6 +41,19 @@ inline void decodeSegment(const uint16_t* in, float* dst, size_t n) {
   bf16StreamToF32(in, dst, n);
 }
 
+// RecvReduceFn-shaped adapters for the typed fused receive (bf16 wire
+// elements folded into / decoded into the f32 accumulator; see
+// UnboundBuffer::recvReduceTyped).
+void accumulateBf16Fn(void* acc, const void* in, size_t n) {
+  bf16StreamAccumulate(static_cast<float*>(acc),
+                       static_cast<const uint16_t*>(in), n);
+}
+
+void decodeBf16Fn(void* acc, const void* in, size_t n) {
+  bf16StreamToF32(static_cast<const uint16_t*>(in),
+                  static_cast<float*>(acc), n);
+}
+
 }  // namespace
 
 void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
@@ -57,20 +70,34 @@ void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
   const int left = (rank - 1 + size) % size;
   const int steps = size - 1;
 
+  // Typed fused receive: wire bf16 elements fold straight out of the shm
+  // ring into the f32 work array (decode+accumulate / decode-in-place),
+  // eliminating the rx staging entirely on shm sources (same policy as
+  // the plain ring, collectives_detail::fuseRecvReduce; wire elsize 2,
+  // accumulator elsize 4). The forward leg of the fused allgather
+  // re-compresses from work — exact, because bf16 -> f32 -> bf16 is a
+  // lossless roundtrip, so the forwarded wire bytes are identical to the
+  // verbatim copy the staged path sends (consensus preserved).
+  const bool fuse = collectives_detail::fuseRecvReduce(
+      ctx, /*fuseOk=*/true, /*elsize=*/sizeof(uint16_t), left);
+
   // Wire staging: bf16 segments. tx double-buffered (the sent segment must
-  // stay valid until waitSend), rx double-buffered like the fp32 ring.
+  // stay valid until waitSend); rx double-buffered like the fp32 ring,
+  // lazily acquired (never touched when fused).
   const size_t wireBlock = std::max(maxBlockElems * sizeof(uint16_t),
                                     size_t(1));
   auto txScratch = ctx->acquireScratch(2 * wireBlock);
-  auto rxScratch = ctx->acquireScratch(2 * wireBlock);
   uint16_t* tx = reinterpret_cast<uint16_t*>(txScratch.data());
-  uint16_t* rx = reinterpret_cast<uint16_t*>(rxScratch.data());
   auto txBuf = ctx->createUnboundBuffer(tx, 2 * wireBlock);
-  auto rxBuf = ctx->createUnboundBuffer(rx, 2 * wireBlock);
+  collectives_detail::LazyScratch rxStage(ctx, 2 * wireBlock);
+  auto workBuf = ctx->createUnboundBuffer(work, count * sizeof(float));
 
   auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
   auto blockStart = [&](int b) {
     return blocks.offset[b] / sizeof(float);
+  };
+  auto rx = [&]() {
+    return reinterpret_cast<uint16_t*>(rxStage.data());
   };
 
   // --- reduce-scatter (send block rank-s, reduce block rank-s-1) ---
@@ -82,21 +109,34 @@ void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
     uint16_t* txSeg = tx + txSlot * maxBlockElems;
     compressSegment(work + blockStart(sendBlock), txSeg,
                     blockElems(sendBlock));
-    rxBuf->recv(left, s, (step % 2) * wireBlock,
-                blockElems(recvBlock) * sizeof(uint16_t));
+    if (fuse) {
+      workBuf->recvReduceTyped(left, s, accumulateBf16Fn,
+                               sizeof(uint16_t), sizeof(float),
+                               blockStart(recvBlock) * sizeof(float),
+                               blockElems(recvBlock) * sizeof(uint16_t));
+    } else {
+      rxStage.buf()->recv(left, s, (step % 2) * wireBlock,
+                          blockElems(recvBlock) * sizeof(uint16_t));
+    }
     txBuf->send(right, s, txSlot * wireBlock,
                 blockElems(sendBlock) * sizeof(uint16_t));
-    rxBuf->waitRecv(nullptr, timeout);
-    accumulateCompressed(work + blockStart(recvBlock),
-                         rx + (step % 2) * maxBlockElems,
-                         blockElems(recvBlock));
+    if (fuse) {
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      rxStage.buf()->waitRecv(nullptr, timeout);
+      accumulateCompressed(work + blockStart(recvBlock),
+                           rx() + (step % 2) * maxBlockElems,
+                           blockElems(recvBlock));
+    }
     txBuf->waitSend(timeout);
   }
 
   // --- allgather: rank r owns reduced block (r+1). The owner compresses
   // its block ONCE; every rank (owner included) adopts the decoded bf16
-  // values so results are identical everywhere. Received wire segments are
-  // forwarded verbatim (no re-rounding along the ring). ---
+  // values so results are identical everywhere. Received wire segments
+  // are forwarded without re-rounding: verbatim on the staged path,
+  // re-compressed from the decoded block on the fused path (byte-
+  // identical, see above). ---
   const uint64_t agBase = steps;
   {
     const int own = (rank + 1) % size;
@@ -111,19 +151,34 @@ void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
     const int rxSlot = step % 2;
     if (step == 0) {
       // Own block already sits compressed in tx slot 0.
+    } else if (fuse) {
+      // Re-compress the block decoded last step (exact roundtrip).
+      compressSegment(work + blockStart(sendBlock),
+                      tx + txSlot * maxBlockElems, blockElems(sendBlock));
     } else {
       // Forward the wire bytes received last step.
       std::memcpy(tx + txSlot * maxBlockElems,
-                  rx + ((step - 1) % 2) * maxBlockElems,
+                  rx() + ((step - 1) % 2) * maxBlockElems,
                   blockElems(sendBlock) * sizeof(uint16_t));
     }
-    rxBuf->recv(left, s, rxSlot * wireBlock,
-                blockElems(recvBlock) * sizeof(uint16_t));
+    if (fuse) {
+      workBuf->recvReduceTyped(left, s, decodeBf16Fn, sizeof(uint16_t),
+                               sizeof(float),
+                               blockStart(recvBlock) * sizeof(float),
+                               blockElems(recvBlock) * sizeof(uint16_t));
+    } else {
+      rxStage.buf()->recv(left, s, rxSlot * wireBlock,
+                          blockElems(recvBlock) * sizeof(uint16_t));
+    }
     txBuf->send(right, s, txSlot * wireBlock,
                 blockElems(sendBlock) * sizeof(uint16_t));
-    rxBuf->waitRecv(nullptr, timeout);
-    decodeSegment(rx + rxSlot * maxBlockElems, work + blockStart(recvBlock),
-                  blockElems(recvBlock));
+    if (fuse) {
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
+      rxStage.buf()->waitRecv(nullptr, timeout);
+      decodeSegment(rx() + rxSlot * maxBlockElems,
+                    work + blockStart(recvBlock), blockElems(recvBlock));
+    }
     txBuf->waitSend(timeout);
   }
 }

@@ -357,7 +357,11 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
 
 void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
                        uint64_t slot, char* dest, size_t nbytes,
-                       RecvReduceFn combine, size_t combineElsize) {
+                       RecvReduceFn combine, size_t combineElsize,
+                       size_t combineAccElsize) {
+  if (combineAccElsize == 0) {
+    combineAccElsize = combineElsize;
+  }
   buf->addPendingRecv();
   bool fromStash = false;
   int stashSrc = -1;
@@ -422,7 +426,7 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
     if (!fromStash) {
       posted_.push_back(PostedRecv{buf, slot, dest, nbytes,
                                    std::move(allowed), combine,
-                                   combineElsize});
+                                   combineElsize, combineAccElsize});
     }
   }
   if (fromStash) {
@@ -477,7 +481,8 @@ Context::Match Context::matchIncoming(int srcRank, uint64_t slot,
   if (it == posted_.end()) {
     return Match{};
   }
-  Match m{true, it->ubuf, it->dest, it->combine, it->combineElsize};
+  Match m{true, it->ubuf, it->dest, it->combine, it->combineElsize,
+          it->combineAccElsize};
   posted_.erase(it);
   return m;
 }

@@ -91,10 +91,14 @@ class Context {
                       uint64_t roffset, size_t nbytes);
   // With `combine` set, arriving payload is reduced into `dest` via
   // combine(dest, payload, nbytes / combineElsize) instead of copied
-  // (UnboundBuffer::recvReduce); staged paths combine from staging memory.
+  // (UnboundBuffer::recvReduce); staged paths combine from staging
+  // memory. combineAccElsize (0 = combineElsize) is the accumulator's
+  // per-element stride when the wire carries a different dtype
+  // (recvReduceTyped).
   void postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
                 uint64_t slot, char* dest, size_t nbytes,
-                RecvReduceFn combine = nullptr, size_t combineElsize = 0);
+                RecvReduceFn combine = nullptr, size_t combineElsize = 0,
+                size_t combineAccElsize = 0);
   void cancelRecvsFor(UnboundBuffer* buf);
   // Drop queued (not yet on the wire) sends referencing buf; returns count.
   int cancelSendsFor(UnboundBuffer* buf);
@@ -108,7 +112,8 @@ class Context {
     UnboundBuffer* ubuf{nullptr};
     char* dest{nullptr};
     RecvReduceFn combine{nullptr};  // non-null: reduce into dest, don't copy
-    size_t combineElsize{0};
+    size_t combineElsize{0};        // wire bytes per element
+    size_t combineAccElsize{0};     // accumulator bytes per element
   };
   Match matchIncoming(int srcRank, uint64_t slot, size_t nbytes);
 
@@ -139,7 +144,8 @@ class Context {
     size_t nbytes;
     std::vector<char> allowed;  // indexed by rank
     RecvReduceFn combine;       // non-null: reduce arrivals into dest
-    size_t combineElsize;
+    size_t combineElsize;       // wire bytes per element
+    size_t combineAccElsize;    // accumulator bytes per element
   };
   // Land `data` at `dest`: reduce when a combine fn is set, plain copy
   // otherwise. Single definition of delivery semantics for every staged

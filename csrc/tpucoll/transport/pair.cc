@@ -1021,6 +1021,7 @@ void Pair::readLoop() {
           shmRxDest_ = match.dest;
           shmRxCombine_ = match.combine;
           shmRxCombineElsize_ = match.combineElsize;
+          shmRxCombineAccElsize_ = match.combineAccElsize;
           shmRxCarryLen_ = 0;
           std::lock_guard<std::mutex> guard(mu_);
           rxUbuf_ = match.ubuf;
@@ -1060,10 +1061,10 @@ void Pair::readLoop() {
           // Fused receive-reduce: fold ring spans into the destination in
           // place of the staging memcpy — the payload is touched exactly
           // once on this side.
-          char* dst = shmRxDest_ + shmRxDone_;
+          const uint64_t base = shmRxDone_;
           shmRx_.consume(chunk,
                          [&](const char* p, uint64_t len, uint64_t off) {
-                           combineShmSpan(dst + off, p, len);
+                           combineShmSpan(base + off, p, len);
                            return true;
                          });
         } else {
@@ -1307,11 +1308,16 @@ void Pair::readLoop() {
   }
 }
 
-void Pair::combineShmSpan(char* dst, const char* src, size_t len) {
+void Pair::combineShmSpan(uint64_t msgOff, const char* src, size_t len) {
   const size_t el = shmRxCombineElsize_;
+  const size_t accEl = shmRxCombineAccElsize_;
+  // Accumulator address of the wire element containing byte `pos`.
+  auto accAt = [&](uint64_t pos) {
+    return shmRxDest_ + (pos / el) * accEl;
+  };
   size_t head = 0;
   if (shmRxCarryLen_ > 0) {
-    // Finish the element a previous span split. Its destination starts
+    // Finish the element a previous span split. Its wire position starts
     // shmRxCarryLen_ bytes before this span's first byte.
     head = std::min(len, el - shmRxCarryLen_);
     std::memcpy(shmRxCarry_ + shmRxCarryLen_, src, head);
@@ -1319,7 +1325,7 @@ void Pair::combineShmSpan(char* dst, const char* src, size_t len) {
     if (shmRxCarryLen_ < el) {
       return;  // still mid-element (tiny span)
     }
-    shmRxCombine_(dst + head - el, shmRxCarry_, 1);
+    shmRxCombine_(accAt(msgOff + head - el), shmRxCarry_, 1);
     shmRxCarryLen_ = 0;
   }
   const size_t mid = (len - head) / el * el;
@@ -1330,18 +1336,19 @@ void Pair::combineShmSpan(char* dst, const char* src, size_t len) {
     // alignment (the largest power of two dividing elsize, the strictest
     // requirement a type of that size can have); otherwise fold through a
     // small aligned bounce so typed loads never see a misaligned address.
-    // (`dst` is the caller's own element-offset buffer — its alignment is
-    // the caller's contract, exactly as on the scratch schedule.)
+    // (The accumulator is the caller's own element-offset buffer — its
+    // alignment is the caller's contract, exactly as on the scratch
+    // schedule.)
     const size_t req = std::min(el & (~el + 1), size_t(16));
     if (reinterpret_cast<uintptr_t>(src + head) % req == 0) {
-      shmRxCombine_(dst + head, src + head, mid / el);
+      shmRxCombine_(accAt(msgOff + head), src + head, mid / el);
     } else {
       alignas(64) char bounce[8192];
       const size_t step = sizeof(bounce) / el * el;
       for (size_t pos = 0; pos < mid; pos += step) {
         const size_t n = std::min(step, mid - pos);
         std::memcpy(bounce, src + head + pos, n);
-        shmRxCombine_(dst + head + pos, bounce, n / el);
+        shmRxCombine_(accAt(msgOff + head + pos), bounce, n / el);
       }
     }
   }

@@ -275,16 +275,18 @@ class Pair : public Handler {
   // the whole point of recvReduce). Ring wrap and chunk caps can split an
   // element across spans; the carry buffer bridges those bytes.
   RecvReduceFn shmRxCombine_{nullptr};
-  size_t shmRxCombineElsize_{0};
+  size_t shmRxCombineElsize_{0};     // wire bytes per element
+  size_t shmRxCombineAccElsize_{0};  // accumulator bytes per element
   // Over-aligned: the carry is fed to typed reduce kernels as a 1-element
   // span, so it must satisfy the strictest alignment any elsize allows.
   alignas(kMaxCombineElsize) uint8_t shmRxCarry_[kMaxCombineElsize];
   size_t shmRxCarryLen_{0};
 
   // Combine one in-order span of the active shm message (handles
-  // element-straddling span boundaries via shmRxCarry_). `dst` is the
-  // span's true destination address within the posted recv region.
-  void combineShmSpan(char* dst, const char* src, size_t len);
+  // element-straddling span boundaries via shmRxCarry_). `msgOff` is the
+  // span's byte offset within the WIRE message; the accumulator address
+  // for wire element i is shmRxDest_ + i * shmRxCombineAccElsize_.
+  void combineShmSpan(uint64_t msgOff, const char* src, size_t len);
 };
 
 }  // namespace transport

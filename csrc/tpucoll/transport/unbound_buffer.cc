@@ -84,18 +84,29 @@ void UnboundBuffer::recvReduce(int srcRank, uint64_t slot, RecvReduceFn fn,
     TC_ENFORCE_LE(offset, size_);
     nbytes = size_ - offset;
   }
-  TC_ENFORCE_LE(offset + nbytes, size_, "recvReduce out of bounds");
+  recvReduceTyped(srcRank, slot, fn, elsize, elsize, offset, nbytes);
+}
+
+void UnboundBuffer::recvReduceTyped(int srcRank, uint64_t slot,
+                                    RecvReduceFn fn, size_t wireElsize,
+                                    size_t accElsize, size_t offset,
+                                    size_t wireNbytes) {
   TC_ENFORCE(fn != nullptr, "recvReduce: null reduce fn");
-  TC_ENFORCE(elsize > 0 && elsize <= kMaxCombineElsize,
-             "recvReduce: element size ", elsize, " out of range");
-  TC_ENFORCE_EQ(nbytes % elsize, size_t(0),
+  TC_ENFORCE(wireElsize > 0 && wireElsize <= kMaxCombineElsize,
+             "recvReduce: wire element size ", wireElsize, " out of range");
+  TC_ENFORCE(accElsize > 0, "recvReduce: bad accumulator element size");
+  TC_ENFORCE_EQ(wireNbytes % wireElsize, size_t(0),
                 "recvReduce: payload not a whole number of elements");
+  const size_t accBytes = wireNbytes / wireElsize * accElsize;
+  TC_ENFORCE(offset <= size_ && accBytes <= size_ - offset,
+             "recvReduce: accumulator range out of bounds");
   {
     std::lock_guard<std::mutex> guard(mu_);
     abortRecv_ = false;
   }
   context_->postRecv(this, std::vector<int>{srcRank}, slot,
-                     static_cast<char*>(ptr_) + offset, nbytes, fn, elsize);
+                     static_cast<char*>(ptr_) + offset, wireNbytes, fn,
+                     wireElsize, accElsize);
 }
 
 namespace {

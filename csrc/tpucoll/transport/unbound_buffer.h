@@ -72,6 +72,17 @@ class UnboundBuffer {
   void recvReduce(int srcRank, uint64_t slot, RecvReduceFn fn, size_t elsize,
                   size_t offset = 0, size_t nbytes = SIZE_MAX);
 
+  // Typed variant: the wire carries `wireElsize`-byte elements while the
+  // accumulator advances by `accElsize` per element — fn converts as it
+  // folds (e.g. bf16 wire into a float32 accumulator, fn = decode+add;
+  // fn may also ignore the accumulator's prior value to express a pure
+  // decode-into-place). `offset`/`accElsize` address THIS buffer (the
+  // accumulator); `wireNbytes` is the incoming message size and must
+  // match the sender's. recvReduce == the wireElsize == accElsize case.
+  void recvReduceTyped(int srcRank, uint64_t slot, RecvReduceFn fn,
+                       size_t wireElsize, size_t accElsize, size_t offset,
+                       size_t wireNbytes);
+
   // ---- one-sided put/get (reference: transport/unbound_buffer.h:128-153
   // + remote_key.h; DCN analog of the device plane's Pallas remote DMA) --
 

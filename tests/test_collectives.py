@@ -665,3 +665,43 @@ def test_recv_reduce_disabled_fallback():
                           capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stderr
     assert "FALLBACK-OK" in proc.stdout
+
+
+def test_allreduce_bf16_wire_fused_matches_staged():
+    """The fused typed receive (decode/accumulate straight from the shm
+    ring, with re-compressed forwarding) must be BITWISE identical to the
+    staged schedule — the allgather forward relies on bf16->f32->bf16
+    being an exact roundtrip, and all ranks must still agree."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prog = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            x = ((np.arange(30_001, dtype=np.float32) % 97) * 0.37
+                 + rank * 1.13).astype(np.float32)
+            ctx.allreduce(x, algorithm="ring_bf16_wire")
+            return x
+
+        results = spawn(4, fn)
+        for got in results[1:]:
+            np.testing.assert_array_equal(got, results[0])  # consensus
+        np.save(sys.argv[1], results[0])
+    """).format(repo=repo)
+    outs = {}
+    for mode in ("auto", "0"):
+        out = os.path.join(repo, "build", f"bf16wire_{mode}.npy")
+        env = dict(os.environ, TPUCOLL_RECV_REDUCE=mode)
+        proc = subprocess.run([sys.executable, "-c", prog, out], env=env,
+                              capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stderr
+        outs[mode] = np.load(out)
+        os.unlink(out)
+    np.testing.assert_array_equal(outs["auto"], outs["0"])

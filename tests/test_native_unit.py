@@ -24,3 +24,39 @@ def test_native_integration():
                             timeout=120)
     assert result.returncode == 0, result.stdout + result.stderr
     assert "all checks passed" in result.stdout
+
+
+def test_bench_cli_smoke():
+    """The benchmark CLI end-to-end at tiny sizes: 2 ranks over an inline
+    TcpStore, one rooted op, one v-variant (uneven splits), and sendrecv —
+    first iterations are verified element-wise by the harness itself."""
+    import re
+    import sys
+
+    binary = os.path.join(_REPO, "build", "tpucoll_bench")
+    if not os.path.exists(binary):
+        import pytest
+        pytest.skip("native build not present")
+    for op in ("allreduce", "alltoallv", "sendrecv"):
+        serve = subprocess.Popen(
+            [binary, "--rank", "0", "--size", "2", "--serve", "0",
+             "--op", op, "--elements", "1000", "--min-time", "0.2"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        # --serve 0 binds an ephemeral port and prints it on stderr.
+        port = None
+        for _ in range(200):
+            line = serve.stderr.readline()
+            m = re.search(r"serving on port (\d+)", line)
+            if m:
+                port = m.group(1)
+                break
+        assert port, "store port never announced"
+        peer = subprocess.run(
+            [binary, "--rank", "1", "--size", "2", "--store",
+             f"tcp:127.0.0.1:{port}", "--op", op, "--elements", "1000",
+             "--min-time", "0.2"],
+            capture_output=True, text=True, timeout=120)
+        out, err = serve.communicate(timeout=120)
+        assert serve.returncode == 0, (op, out, err)
+        assert peer.returncode == 0, (op, peer.stdout, peer.stderr)
+        assert re.search(r"^\s*\d+\s+\d+", out, re.M), (op, out)
