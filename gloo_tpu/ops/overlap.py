@@ -120,10 +120,20 @@ def _matmul_rs_kernel(x_ref, w_ref, o_ref, send_stage, comm, send_sem,
 
 @functools.partial(jax.jit,
                    static_argnames=("axis_name", "mesh_axes",
-                                    "collective_id", "interpret"))
+                                    "collective_id", "interpret",
+                                    "virtual_ranks"))
 def _matmul_rs_shard(x, w, *, axis_name: str, mesh_axes, collective_id: int,
-                     interpret: bool):
-    n = lax.axis_size(axis_name)
+                     interpret: bool, virtual_ranks: int | None = None):
+    # virtual_ranks: BENCH-ONLY. On a 1-device axis, run the kernel's full
+    # P-step schedule with self-loop neighbors (every RDMA lands in the
+    # local comm slot) so the compute pipeline can be timed on one chip
+    # without ICI. Data semantics degenerate; timing semantics don't.
+    # A >1-device axis would route the RDMAs to real neighbors while the
+    # chunk indexing walks the virtual ring — nonsense data AND timing.
+    if virtual_ranks:
+        assert lax.axis_size(axis_name) == 1, \
+            "virtual_ranks requires a 1-device axis (self-loop bench mode)"
+    n = virtual_ranks or lax.axis_size(axis_name)
     m, k = x.shape
     k2, cols = w.shape
     assert k == k2, f"matmul_reduce_scatter: inner dims {k} vs {k2}"
@@ -213,10 +223,15 @@ def _ag_matmul_kernel(x_ref, w_ref, y_ref, gx_ref, ag_send, ag_recv, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("axis_name", "mesh_axes",
-                                    "collective_id", "interpret"))
+                                    "collective_id", "interpret",
+                                    "virtual_ranks"))
 def _ag_matmul_shard(x, w, *, axis_name: str, mesh_axes, collective_id: int,
-                     interpret: bool):
-    n = lax.axis_size(axis_name)
+                     interpret: bool, virtual_ranks: int | None = None):
+    # virtual_ranks: BENCH-ONLY self-loop mode, see _matmul_rs_shard.
+    if virtual_ranks:
+        assert lax.axis_size(axis_name) == 1, \
+            "virtual_ranks requires a 1-device axis (self-loop bench mode)"
+    n = virtual_ranks or lax.axis_size(axis_name)
     rows, k = x.shape
     k2, cols = w.shape
     assert k == k2, f"allgather_matmul: inner dims {k} vs {k2}"

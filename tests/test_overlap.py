@@ -195,3 +195,42 @@ def test_matmul_reduce_scatter_multi_axis_mesh():
     out = np.asarray(fn(x, w))
     expected = x.astype(np.float64) @ w.astype(np.float64)
     np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_virtual_ring_selfloop_bench_path():
+    """The single-chip bench mode (virtual_ranks on a 1-device axis,
+    tools/tpu_bench.py --op overlap): self-loop RDMA means every hop
+    adds this rank's own staged sum, so matmul_rs degenerates to
+    sum over row-blocks of X_b @ W and allgather_matmul's own chunk
+    is exact. Guards the timing harness against schedule rot."""
+    from gloo_tpu.ops.overlap import _ag_matmul_shard, _matmul_rs_shard
+
+    mesh = _mesh(1)
+    V, m, k, cols = 4, 64, 32, 128
+    chunk = m // V
+    x = _rand((m, k), 7)
+    w = _rand((k, cols), 8)
+
+    out = jax.jit(jax.shard_map(
+        lambda xs, ws: _matmul_rs_shard(
+            xs, ws, axis_name="x", mesh_axes=None, collective_id=21,
+            interpret=True, virtual_ranks=V),
+        mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False))(
+            x, w)
+    expected = sum(x[b * chunk:(b + 1) * chunk].astype(np.float64)
+                   @ w.astype(np.float64) for b in range(V))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-5,
+                               atol=2e-5)
+
+    xs = _rand((chunk, k), 9)
+    y, _gx = jax.jit(jax.shard_map(
+        lambda xv, ws: _ag_matmul_shard(
+            xv, ws, axis_name="x", mesh_axes=None, collective_id=23,
+            interpret=True, virtual_ranks=V),
+        mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False))(
+            xs, w)
+    # Only this rank's own chunk (row-block 0 for rank 0) is defined in
+    # self-loop mode; the rest of gx is never received.
+    np.testing.assert_allclose(np.asarray(y)[:chunk],
+                               xs.astype(np.float64) @ w.astype(np.float64),
+                               rtol=2e-5, atol=2e-5)

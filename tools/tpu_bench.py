@@ -28,14 +28,31 @@ def main():
                         choices=["allreduce", "allgather", "reduce_scatter",
                                  "alltoall", "ppermute", "pallas_ring",
                                  "pallas_ring_hbm", "flash_attention",
-                                 "flash_attention_bwd", "all"])
+                                 "flash_attention_bwd", "overlap", "all"])
     parser.add_argument("--elements", default="1024,65536,1048576,16777216")
     parser.add_argument("--min-time", type=float, default=1.0)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--flash-blocks", default=None,
                         help="comma list of BQxBK pairs to sweep, e.g. "
                              "128x128,512x1024 (default: kernel defaults)")
+    parser.add_argument("--overlap-shapes", default="4096x2048,2048x4096,"
+                        "4096x4096",
+                        help="MxK list for --op overlap (cols==K)")
+    parser.add_argument("--overlap-ranks", type=int, default=8,
+                        help="virtual ring size for --op overlap")
     args = parser.parse_args()
+
+    if args.op == "overlap":
+        # The overlap kernels keep x, w and 4 staging buffers resident in
+        # VMEM; the default 16 MiB scoped-vmem budget rejects realistic TP
+        # shard shapes. Must be set before libtpu loads — and ONLY for
+        # this op, so the other rows stay comparable with prior runs
+        # (the flag can shift XLA's fusion/tiling choices). `--op all`
+        # re-execs overlap as a subprocess for the same reason.
+        cur = os.environ.get("LIBTPU_INIT_ARGS", "")
+        if "scoped_vmem_limit" not in cur:
+            os.environ["LIBTPU_INIT_ARGS"] = (
+                cur + " --xla_tpu_scoped_vmem_limit_kib=114688").strip()
 
     force_cpu = os.environ.get("JAX_PLATFORMS_FORCE_CPU")
     if force_cpu:
@@ -97,7 +114,7 @@ def main():
 
     ops = (["allreduce", "allgather", "reduce_scatter", "alltoall",
             "ppermute", "pallas_ring", "pallas_ring_hbm",
-            "flash_attention", "flash_attention_bwd"]
+            "flash_attention", "flash_attention_bwd", "overlap"]
            if args.op == "all" else [args.op])
     elements_list = [int(e) for e in args.elements.split(",")]
 
@@ -106,6 +123,18 @@ def main():
             bench_flash_attention(args, jax, jnp, elements_list,
                                   backward=mode.endswith("bwd"))
             ops = [o for o in ops if o != mode]
+    if "overlap" in ops:
+        if args.op == "overlap":
+            bench_overlap(args, jax, jnp, mesh, axis)
+        else:  # fresh process: overlap needs its own LIBTPU_INIT_ARGS
+            import subprocess
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--op", "overlap",
+                 "--overlap-shapes", args.overlap_shapes,
+                 "--overlap-ranks", str(args.overlap_ranks),
+                 "--warmup", str(args.warmup)], check=False)
+        ops = [o for o in ops if o != "overlap"]
     for op in ops:
         for elements in elements_list:
             try:
@@ -191,40 +220,17 @@ def bench_flash_attention(args, jax, jnp, elements_list, backward=False):
                         return step(c).astype(c.dtype)
                     return jax.jit(lambda q: lax.fori_loop(0, k, body, q))
 
-                k_iters = 2 if interp else 64
-                f1, fk = chain(1), chain(k_iters)
-
-                def run(f):
-                    out = f(q)
-                    _ = float(out[0, 0, 0, 0])  # forces completion + fetch
-
-                for _ in range(max(1, args.warmup)):
-                    run(f1), run(fk)
-                reps = 1 if interp else 5
-                t1 = min(_timeit(run, f1, _time) for _ in range(reps))
-                tk = min(_timeit(run, fk, _time) for _ in range(reps))
-                # Small kernels: 64 chained iterations are dwarfed by
-                # tunnel round-trip variance. Keep growing the chain until
-                # the measured difference actually exceeds 250 ms of work
-                # (a single re-estimate can itself be noise-inflated), with
-                # an iteration cap as the stop.
-                while not interp and tk - t1 < 0.25 and k_iters < 16384:
-                    per_est = max((tk - t1) / (k_iters - 1), 5e-7)
-                    k_iters = min(max(int(0.25 / per_est) + 64,
-                                      k_iters * 4), 16384)
-                    fk = chain(k_iters)
-                    run(fk)  # compile
-                    tk = min(_timeit(run, fk, _time) for _ in range(reps))
+                per_iter, k_iters = _chain_rate(args, jax, chain, q,
+                                                interp, _time, k0=64)
             except Exception as exc:  # noqa: BLE001 — skip row, sweep on
                 print(f"{tag:>16} {'-':>12} {elements:>12}   "
                       f"skipped: {str(exc)[:50]}")
                 continue
-            if tk <= t1:
+            if per_iter is None:
                 print(f"{tag:>16} {'-':>12} {h * t * d:>12}   "
                       "skipped: timing noise exceeded kernel time "
                       "(t too small to difference)")
                 continue
-            per_iter = (tk - t1) / (k_iters - 1)
             fwd_flops = 2 * h * (t * t // 2) * d * 2
             flops = int(fwd_flops * 3.5) if backward else fwd_flops
             nbytes = 3 * h * t * d * 2
@@ -234,6 +240,139 @@ def bench_flash_attention(args, jax, jnp, elements_list, backward=False):
             print(f"{tag:>16} {nbytes:>12} {h * t * d:>12} "
                   f"{per_iter * 1e6:>9.1f} {per_iter * 1e6:>9.1f} "
                   f"{'-':>9} {flops / per_iter / 1e9:>12.3f} {k_iters:>7}")
+
+
+def bench_overlap(args, jax, jnp, mesh, axis):
+    """Real-chip proof of the collective-matmul kernels' compute pipeline.
+
+    On one chip the ring runs with self-loop neighbors (virtual_ranks):
+    every hop's async copy lands in the local comm slot, so the kernel
+    executes its full P-step schedule — per-chunk MXU matmuls, staged
+    copies, semaphore waits — with the ICI leg replaced by on-chip DMA.
+    Comparing against a plain jnp.dot of the same [M,K]@[K,K] answers the
+    question that matters before any multi-chip run: how much MXU
+    throughput does the fused schedule's chunking give up? (The ICI leg
+    itself needs a multi-chip slice; tests/test_overlap.py covers ring
+    correctness on the interpret mesh.)
+
+    Timing is the tunnel-safe chained fori_loop (see
+    bench_flash_attention): the output feeds the next input, and the
+    chain grows until the differenced time exceeds 250 ms. The GFLOP/s
+    column counts 2*M*K*K per iteration for all three variants.
+    """
+    import time as _time
+
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from gloo_tpu.ops.overlap import _ag_matmul_shard, _matmul_rs_shard
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    interp = jax.devices()[0].platform == "cpu"
+    V = args.overlap_ranks
+    # Self-loop mode needs a 1-device axis regardless of the full mesh.
+    mesh = Mesh(np.asarray(jax.devices()[:1], dtype=object), (axis,))
+    shapes = [tuple(int(v) for v in s.split("x"))
+              for s in args.overlap_shapes.split(",")]
+    print(f"# overlap: virtual ring V={V} (self-loop RDMA), cols=K; "
+          f"last columns are GFLOP/s and fused/plain ratio")
+    seen = set()
+    for m, k in shapes:
+        if interp:
+            m, k = min(m, 256), min(k, 256)  # functional smoke only
+        if (m, k) in seen:  # interp clamp collapses shapes
+            continue
+        seen.add((m, k))
+        chunk = m // V
+        if chunk == 0 or chunk % 8:
+            print(f"{'overlap':>16} {'-':>12} {m}x{k}   skipped: "
+                  f"M/V={m}/{V} not a usable chunk")
+            continue
+        w = jnp.full((k, k), 1.0 / k, jnp.bfloat16)
+        flops = 2 * m * k * k
+
+        def plain_body(c):
+            return jnp.dot(c, w, preferred_element_type=jnp.float32
+                           ).astype(c.dtype)
+
+        def mmrs_body(c):
+            y = _matmul_rs_shard(c, w, axis_name=axis, mesh_axes=None,
+                                 collective_id=21, interpret=interp,
+                                 virtual_ranks=V)
+            return c.at[:chunk, :].set(y)
+
+        def agmm_body(c):
+            y, _ = _ag_matmul_shard(c, w, axis_name=axis, mesh_axes=None,
+                                    collective_id=23, interpret=interp,
+                                    virtual_ranks=V)
+            return y[:chunk, :]
+
+        variants = [("plain_dot", plain_body, (m, k)),
+                    ("matmul_rs", mmrs_body, (m, k)),
+                    ("ag_matmul", agmm_body, (chunk, k))]
+        rates = {}
+        for name, body, xshape in variants:
+            x = jnp.ones(xshape, jnp.bfloat16)
+
+            def make_chain(n_iter, body=body):
+                def outer(xv):
+                    return lax.fori_loop(0, n_iter,
+                                         lambda i, c: body(c), xv)
+                return jax.jit(jax.shard_map(outer, mesh=mesh,
+                                             in_specs=P(), out_specs=P(),
+                                             check_vma=False))
+
+            try:
+                per, _k = _chain_rate(args, jax, make_chain, x, interp,
+                                      _time)
+            except Exception as exc:  # noqa: BLE001 — skip row, sweep on
+                print(f"{name:>16} {'-':>12} {m}x{k}   skipped: "
+                      f"{str(exc)[:60]}")
+                continue
+            if per is None:
+                print(f"{name:>16} {'-':>12} {m}x{k}   skipped: timing "
+                      "noise exceeded kernel time")
+                continue
+            rates[name] = flops / per / 1e9
+            ratio = (f"{rates[name] / rates['plain_dot']:>8.2f}"
+                     if name != "plain_dot" and "plain_dot" in rates
+                     else f"{'-':>8}")
+            print(f"{name:>16} {m * k * 2:>12} {f'{m}x{k}':>12} "
+                  f"{per * 1e6:>9.1f} {per * 1e6:>9.1f} {'-':>9} "
+                  f"{rates[name]:>12.3f} {ratio}")
+
+
+def _chain_rate(args, jax, make_chain, x, interp, _time, k0=32):
+    """(seconds-per-chained-iteration, chain length) — differenced
+    against a 1-iteration run to cancel the tunnel round-trip. Small
+    kernels: k0 chained iterations are dwarfed by tunnel round-trip
+    variance, so the chain keeps growing until the measured difference
+    exceeds 250 ms of work (a single re-estimate can itself be
+    noise-inflated), with an iteration cap as the stop. Returns
+    (None, k) when even the longest chain is inside the noise."""
+    k_iters = 2 if interp else k0
+    f1, fk = make_chain(1), make_chain(k_iters)
+
+    def run(f):
+        out = f(x)
+        _ = float(out.ravel()[0])  # forces completion + fetch
+
+    for _ in range(max(1, args.warmup)):
+        run(f1), run(fk)
+    reps = 1 if interp else 5
+    t1 = min(_timeit(run, f1, _time) for _ in range(reps))
+    tk = min(_timeit(run, fk, _time) for _ in range(reps))
+    while not interp and tk - t1 < 0.25 and k_iters < 16384:
+        per_est = max((tk - t1) / (k_iters - 1), 5e-7)
+        k_iters = min(max(int(0.25 / per_est) + k0, k_iters * 4), 16384)
+        fk = make_chain(k_iters)
+        run(fk)  # compile
+        tk = min(_timeit(run, fk, _time) for _ in range(reps))
+    if tk <= t1:
+        return None, k_iters
+    return (tk - t1) / (k_iters - 1), k_iters
 
 
 def _timeit(run, f, _time):
