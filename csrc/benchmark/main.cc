@@ -63,7 +63,8 @@ void usage() {
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
           "reduce|gather|scatter|alltoall|alltoallv|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
-          "  [--algorithm auto|ring|hd] [--elements n1,n2,...] "
+          "  [--algorithm auto|ring|hd|bcube|ring_bf16_wire (allreduce) | auto|binomial|ring (reduce)]\n"
+          "  [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
           "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n"
           "  [--threads N] [--inputs N] [--dtype f32|f16|bf16] "
@@ -448,7 +449,14 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
   } else if (o.op == "reduce") {
     buf.assign(elements, float(rank + 1));
     out.assign(elements, 0.f);
-    std::function<void()> run = [ctxp, &buf, &out, tag, rank] {
+    TC_ENFORCE(o.algorithm == "auto" || o.algorithm == "ring" ||
+                   o.algorithm == "binomial",
+               "--op reduce supports --algorithm auto|binomial|ring");
+    const auto ralgo = o.algorithm == "ring" ? tpucoll::ReduceAlgorithm::kRing
+                       : o.algorithm == "binomial"
+                           ? tpucoll::ReduceAlgorithm::kBinomial
+                           : tpucoll::ReduceAlgorithm::kAuto;
+    std::function<void()> run = [ctxp, &buf, &out, tag, rank, ralgo] {
       ReduceOptions opts;
       opts.context = ctxp;
       opts.tag = tag;
@@ -456,6 +464,7 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
       opts.output = rank == 0 ? out.data() : nullptr;
       opts.count = buf.size();
       opts.root = 0;
+      opts.algorithm = ralgo;
       reduce(opts);
     };
     w.run = run;

@@ -311,7 +311,8 @@ int tc_allreduce(void* ctx, const void* input, void* output, size_t count,
 }
 
 int tc_reduce(void* ctx, const void* input, void* output, size_t count,
-              int dtype, int op, int root, uint32_t tag, int64_t timeoutMs) {
+              int dtype, int op, int root, int algorithm, uint32_t tag,
+              int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::ReduceOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -321,6 +322,7 @@ int tc_reduce(void* ctx, const void* input, void* output, size_t count,
     opts.dtype = static_cast<DataType>(dtype);
     opts.op = static_cast<ReduceOp>(op);
     opts.root = root;
+    opts.algorithm = static_cast<tpucoll::ReduceAlgorithm>(algorithm);
     tpucoll::reduce(opts);
   });
 }
@@ -348,7 +350,7 @@ int tc_allreduce_fn(void* ctx, const void* input, void* output, size_t count,
 
 int tc_reduce_fn(void* ctx, const void* input, void* output, size_t count,
                  int dtype, void (*fn)(void*, const void*, size_t), int root,
-                 uint32_t tag, int64_t timeoutMs) {
+                 int algorithm, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::ReduceOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -358,6 +360,7 @@ int tc_reduce_fn(void* ctx, const void* input, void* output, size_t count,
     opts.dtype = static_cast<DataType>(dtype);
     opts.customFn = fn;
     opts.root = root;
+    opts.algorithm = static_cast<tpucoll::ReduceAlgorithm>(algorithm);
     tpucoll::reduce(opts);
   });
 }

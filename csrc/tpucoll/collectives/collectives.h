@@ -82,6 +82,17 @@ struct AllreduceOptions : CollectiveOptions {
 };
 void allreduce(AllreduceOptions& opts);
 
+enum class ReduceAlgorithm : uint8_t {
+  // Binomial tree for latency-bound payloads (log2 P rounds, but log2 P
+  // full-size messages through the root's link); pipelined ring
+  // reduce-scatter + direct chunk gather to root for bandwidth-bound
+  // ones (~2N bytes per link total, the reference's only schedule:
+  // gloo/reduce.cc:61-246). Crossover: TPUCOLL_REDUCE_BINOMIAL_MAX.
+  kAuto = 0,
+  kBinomial = 1,
+  kRing = 2,
+};
+
 struct ReduceOptions : CollectiveOptions {
   const void* input = nullptr;
   void* output = nullptr;  // required on root only
@@ -90,6 +101,7 @@ struct ReduceOptions : CollectiveOptions {
   ReduceOp op = ReduceOp::kSum;
   ReduceFn customFn = nullptr;  // overrides `op` when set
   int root = 0;
+  ReduceAlgorithm algorithm = ReduceAlgorithm::kAuto;
 };
 void reduce(ReduceOptions& opts);
 

@@ -7,6 +7,7 @@
 // pipelined messages on one pair from cross-matching.
 #include <algorithm>
 #include <cstring>
+#include <optional>
 #include <vector>
 
 #include "tpucoll/collectives/algorithms.h"
@@ -39,6 +40,20 @@ char* bytePtr(void* p) { return static_cast<char*>(p); }
 // their destination (never the stash), and each segment is reduced the
 // moment it arrives, overlapping the VPU/AVX reduction with socket I/O of
 // later segments.
+// Slot span ringReduceScatter consumes starting at its slotBase: P-1
+// steps of maxSegs segment slots each, rounded up to P*maxSegs. Any
+// phase layered behind it on the same tag (allgather, gather-to-root)
+// MUST derive its slot base from this helper, so a change to the RS
+// slot schedule cannot silently collide with a follow-on phase.
+uint64_t ringReduceScatterSlotSpan(const Blocks& blocks, size_t elsize) {
+  size_t maxBlock = 0;
+  for (size_t b : blocks.bytes) {
+    maxBlock = std::max(maxBlock, b);
+  }
+  return uint64_t(blocks.bytes.size()) *
+         segmentize(maxBlock, elsize).size();
+}
+
 void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
                        ReduceFn fn, size_t elsize, Slot slot,
                        uint64_t slotBase, int startShift,
@@ -379,55 +394,32 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
   // Allgather phase: rank r starts owning reduced block (r+1); the block
   // then rides the ring into place on every rank.
   ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
-                     /*slotBase=*/uint64_t(size) * maxSegs, maxSegs,
-                     /*shift=*/1, timeout);
+                     /*slotBase=*/ringReduceScatterSlotSpan(blocks, elsize),
+                     maxSegs, /*shift=*/1, timeout);
 }
 
 }  // namespace algorithms
 
-// Binomial reduction tree: leaves push partials toward the root, halving the
-// number of active ranks per round (log2 P latency steps).
-void reduce(ReduceOptions& opts) {
-  Context* ctx = opts.context;
-  TC_ENFORCE(ctx != nullptr, "reduce: null context");
-  auto traceSpan = ctx->tracer().span("reduce", opts.count * elementSize(opts.dtype));
-  const auto timeout = detail::effectiveTimeout(opts);
+namespace {
+
+// Binomial reduction tree: leaves push partials toward the root, halving
+// the number of active ranks per round. log2(P) latency steps, but every
+// round moves a FULL payload and the root's in-link carries log2(P) * N
+// bytes — latency-optimal, bandwidth-hostile.
+void binomialReduce(Context* ctx, char* result, size_t count, size_t elsize,
+                    ReduceFn fn, int root, bool fuseOk, Slot slot,
+                    std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
-  TC_ENFORCE(opts.root >= 0 && opts.root < size, "reduce: bad root");
-  const size_t elsize = elementSize(opts.dtype);
-  const size_t nbytes = opts.count * elsize;
-  ReduceFn fn = opts.customFn != nullptr
-                  ? opts.customFn
-                  : getReduceFn(opts.dtype, opts.op);
-
-  const bool isRoot = rank == opts.root;
-  TC_ENFORCE(!isRoot || opts.output != nullptr, "reduce: root needs output");
-  std::vector<char> scratch;
-  char* result;
-  if (isRoot) {
-    result = bytePtr(opts.output);
-  } else {
-    scratch.resize(nbytes);
-    result = scratch.data();
-  }
-  if (result != opts.input) {
-    std::memcpy(result, opts.input, nbytes);
-  }
-  if (size == 1) {
-    return;
-  }
-
-  Slot slot = Slot::build(SlotPrefix::kReduce, opts.tag);
-  const int vrank = (rank - opts.root + size) % size;
-  auto physical = [&](int v) { return (v + opts.root) % size; };
+  const size_t nbytes = count * elsize;
+  const int vrank = (rank - root + size) % size;
+  auto physical = [&](int v) { return (v + root) % size; };
   auto resultBuf = ctx->createUnboundBuffer(result, nbytes);
   // Fused receive-reduce: partner partials are combined into `result` by
   // the transport (from the shm ring / stash, no scratch vector at all).
   // Rounds are serialized by waitRecv, so result is never concurrently a
   // send source and a combine target. Custom fns stay on the scratch path
   // (not loop-thread-safe); fuseRecvReduce picks per partner, per round.
-  const bool fuseOk = opts.customFn == nullptr;
   LazyScratch stage(ctx, nbytes);
 
   int mask = 1;
@@ -449,11 +441,115 @@ void reduce(ReduceOptions& opts) {
       } else {
         stage.buf()->recv(src, slot.offset(round).value(), 0, nbytes);
         stage.buf()->waitRecv(nullptr, timeout);
-        fn(result, stage.data(), opts.count);
+        fn(result, stage.data(), count);
       }
     }
     mask <<= 1;
     round++;
+  }
+}
+
+// Bandwidth-optimal reduce-to-root (contract of gloo/reduce.cc:61-246):
+// the pipelined ring reduce-scatter leaves rank r owning reduced block r
+// in-place, then every rank ships its one block straight to the root —
+// ~2N bytes per link total and ~N bytes through the root's in-link,
+// vs the binomial's log2(P) * N. Reuses ringReduceScatter wholesale
+// (segment pipelining, two-ahead pre-posts, fused receive-reduce).
+void ringReduce(Context* ctx, char* work, size_t count, size_t elsize,
+                ReduceFn fn, int root, bool fuseOk, Slot slot,
+                std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  Blocks blocks = evenBlocks(count, size, elsize);
+  auto workBuf = ctx->createUnboundBuffer(work, count * elsize);
+  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
+                    /*startShift=*/-1, timeout, workBuf.get(), fuseOk);
+  // Gather phase: block b travels root's in-link exactly once. Slots
+  // continue past the reduce-scatter's reserved range.
+  const uint64_t gatherBase = ringReduceScatterSlotSpan(blocks, elsize);
+  if (rank == root) {
+    int pending = 0;
+    for (int b = 0; b < size; b++) {
+      if (b == rank || blocks.bytes[b] == 0) {
+        continue;
+      }
+      workBuf->recv(b, slot.offset(gatherBase + uint64_t(b)).value(),
+                    blocks.offset[b], blocks.bytes[b]);
+      pending++;
+    }
+    for (int i = 0; i < pending; i++) {
+      workBuf->waitRecv(nullptr, timeout);
+    }
+  } else if (blocks.bytes[rank] > 0) {
+    workBuf->send(root, slot.offset(gatherBase + uint64_t(rank)).value(),
+                  blocks.offset[rank], blocks.bytes[rank]);
+    workBuf->waitSend(timeout);
+  }
+}
+
+}  // namespace
+
+void reduce(ReduceOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "reduce: null context");
+  const auto timeout = detail::effectiveTimeout(opts);
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE(opts.root >= 0 && opts.root < size, "reduce: bad root");
+  const size_t elsize = elementSize(opts.dtype);
+  const size_t nbytes = opts.count * elsize;
+  ReduceFn fn = opts.customFn != nullptr
+                  ? opts.customFn
+                  : getReduceFn(opts.dtype, opts.op);
+
+  const bool isRoot = rank == opts.root;
+  TC_ENFORCE(!isRoot || opts.output != nullptr, "reduce: root needs output");
+  // Non-root ranks work in pooled scratch (the ring writes the whole
+  // buffer during the reduce-scatter phase, so it must be full-size
+  // even though only one block of it is ever sent on).
+  std::optional<Context::Scratch> scratch;
+  char* result;
+  if (isRoot) {
+    result = bytePtr(opts.output);
+  } else {
+    scratch.emplace(ctx->acquireScratch(nbytes));
+    result = scratch->data();
+  }
+  if (result != opts.input) {
+    std::memcpy(result, opts.input, nbytes);
+  }
+  if (size == 1 || opts.count == 0) {
+    return;
+  }
+
+  Slot slot = Slot::build(SlotPrefix::kReduce, opts.tag);
+  const bool fuseOk = opts.customFn == nullptr;
+  ReduceAlgorithm algo = opts.algorithm;
+  if (algo == ReduceAlgorithm::kAuto) {
+    // Crossover measured on loopback P=4/8 (BASELINE.md round 3): the
+    // binomial wins p50 through ~4 MiB (its log2(P) full-payload rounds
+    // ride the eager pipeline well on one host), the ring wins p50 AND
+    // p99 beyond; on real multi-host DCN the root's in-link serializes
+    // much earlier — drop TPUCOLL_REDUCE_BINOMIAL_MAX to ~256K-1M there.
+    static const size_t binMax = collectives_detail::envBytes(
+        "TPUCOLL_REDUCE_BINOMIAL_MAX", 4u << 20);
+    algo = nbytes <= binMax ? ReduceAlgorithm::kBinomial
+                            : ReduceAlgorithm::kRing;
+  }
+  auto traceSpan = ctx->tracer().span(
+      "reduce", nbytes, -1,
+      algo == ReduceAlgorithm::kRing ? "ring" : "binomial");
+  switch (algo) {
+    case ReduceAlgorithm::kBinomial:
+      binomialReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
+                     slot, timeout);
+      break;
+    case ReduceAlgorithm::kRing:
+      ringReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
+                 slot, timeout);
+      break;
+    default:
+      TC_THROW(EnforceError, "unknown reduce algorithm");
   }
 }
 

@@ -111,13 +111,15 @@ _PROTOTYPES = {
     "tc_allreduce_multi": (_int, [_c, ctypes.POINTER(_c),
                                   ctypes.POINTER(_c), _sz, _sz, _int,
                                   _int, _int, _u32, _i64]),
-    "tc_reduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32, _i64]),
+    "tc_reduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _int, _u32,
+                         _i64]),
     "tc_allreduce_fn": (_int, [_c, _c, _c, _sz, _int, _c, _int, _u32,
                                _i64]),
     "tc_allreduce_multi_fn": (_int, [_c, ctypes.POINTER(_c),
                                      ctypes.POINTER(_c), _sz, _sz, _int,
                                      _c, _int, _u32, _i64]),
-    "tc_reduce_fn": (_int, [_c, _c, _c, _sz, _int, _c, _int, _u32, _i64]),
+    "tc_reduce_fn": (_int, [_c, _c, _c, _sz, _int, _c, _int, _int, _u32,
+                            _i64]),
     "tc_reduce_scatter_fn": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
                                     _c, _u32, _i64]),
     "tc_gather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),

@@ -558,6 +558,7 @@ class Context:
 
     _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
                    "bcube": 3, "ring_bf16_wire": 4}
+    _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
                   tag: int = 0,
@@ -619,10 +620,17 @@ class Context:
         return arrays
 
     def reduce(self, array: np.ndarray, root: int = 0, op="sum",
-               output: Optional[np.ndarray] = None, tag: int = 0,
+               output: Optional[np.ndarray] = None,
+               algorithm: str = "auto", tag: int = 0,
                timeout: Optional[float] = None) -> Optional[np.ndarray]:
-        """Reduce to `root`. Returns the result array on root, else None."""
+        """Reduce to `root`. Returns the result array on root, else None.
+
+        algorithm: "auto" (binomial tree for small payloads, pipelined
+        ring reduce-scatter + chunk gather for large; crossover via
+        TPUCOLL_REDUCE_BINOMIAL_MAX), "binomial", or "ring".
+        """
         _check_array(array)
+        algo = self._REDUCE_ALGORITHMS[algorithm]
         if self.rank == root:
             out = output if output is not None else np.empty_like(array)
             _check_array(out, "output")
@@ -633,14 +641,15 @@ class Context:
             check(_lib.lib.tc_reduce_fn(
                 self._handle, _ptr(array),
                 _ptr(out) if out is not None else None, array.size,
-                _dtype_code(array), fnp, root, tag, _timeout_ms(timeout)))
+                _dtype_code(array), fnp, root, algo, tag,
+                _timeout_ms(timeout)))
             del cb
             raise_pending()
             return out
         check(_lib.lib.tc_reduce(self._handle, _ptr(array),
                                  _ptr(out) if out is not None else None,
                                  array.size, _dtype_code(array),
-                                 ReduceOp.parse(op), root, tag,
+                                 ReduceOp.parse(op), root, algo, tag,
                                  _timeout_ms(timeout)))
         return out
 

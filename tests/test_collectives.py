@@ -159,6 +159,26 @@ def test_reduce(size):
     assert all(r is None for r in results[1:])
 
 
+@pytest.mark.parametrize("algorithm", ["binomial", "ring"])
+@pytest.mark.parametrize("size", SIZES)
+def test_reduce_algorithms(size, algorithm):
+    """Both reduce schedules, non-zero root, counts exercising the ring's
+    uneven trailing block (count % size != 0) and the sub-size payload
+    (count < size, some ranks own empty blocks)."""
+    for count, root in ((1234, min(1, size - 1)), (size - 1, 0),
+                        (8192 + 3, size - 1)):
+
+        def fn(ctx, rank, count=count, root=root):
+            x = fixture(rank, count, np.float32)
+            return ctx.reduce(x, root=root, algorithm=algorithm)
+
+        results = spawn(size, fn)
+        expected = sum(fixture(r, count, np.float64)
+                       for r in range(size)).astype(np.float32)
+        np.testing.assert_allclose(results[root], expected, rtol=1e-5)
+        assert all(r is None for i, r in enumerate(results) if i != root)
+
+
 @pytest.mark.parametrize("size", SIZES)
 def test_gather(size):
     def fn(ctx, rank):
