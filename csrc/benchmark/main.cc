@@ -63,7 +63,8 @@ void usage() {
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
           "reduce|gather|scatter|alltoall|alltoallv|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
-          "  [--algorithm auto|ring|hd|bcube|ring_bf16_wire (allreduce) | auto|binomial|ring (reduce)]\n"
+          "  [--algorithm auto|ring|hd|bcube|ring_bf16_wire (allreduce) | auto|binomial|ring (reduce)\n"
+          "   | auto|ring|hd|direct (reduce_scatter)]\n"
           "  [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
           "  [--auth-key K] [--encrypt]   (PSK handshake / AEAD wire)\n"
@@ -331,13 +332,25 @@ Workload makeWorkload(const Options& o, tpucoll::Context& ctx,
     out.assign(elements / size + elements % size + 1, 0.f);
     std::vector<size_t> counts(size, elements / size);
     counts[0] += elements % size;
-    std::function<void()> run = [ctxp, &buf, &out, tag, counts] {
+    TC_ENFORCE(
+        o.algorithm == "auto" || o.algorithm == "ring" ||
+            o.algorithm == "hd" || o.algorithm == "direct",
+        "--op reduce_scatter supports --algorithm auto|ring|hd|direct");
+    const auto rsalgo =
+        o.algorithm == "ring" ? tpucoll::ReduceScatterAlgorithm::kRing
+        : o.algorithm == "hd"
+            ? tpucoll::ReduceScatterAlgorithm::kHalvingDoubling
+        : o.algorithm == "direct"
+            ? tpucoll::ReduceScatterAlgorithm::kDirect
+            : tpucoll::ReduceScatterAlgorithm::kAuto;
+    std::function<void()> run = [ctxp, &buf, &out, tag, counts, rsalgo] {
       ReduceScatterOptions opts;
       opts.context = ctxp;
       opts.tag = tag;
       opts.input = buf.data();
       opts.output = out.data();
       opts.recvCounts = counts;
+      opts.algorithm = rsalgo;
       reduceScatter(opts);
     };
     w.run = run;

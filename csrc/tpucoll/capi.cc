@@ -367,8 +367,8 @@ int tc_reduce_fn(void* ctx, const void* input, void* output, size_t count,
 
 int tc_reduce_scatter_fn(void* ctx, const void* input, void* output,
                          const size_t* recvCounts, int dtype,
-                         void (*fn)(void*, const void*, size_t), uint32_t tag,
-                         int64_t timeoutMs) {
+                         void (*fn)(void*, const void*, size_t),
+                         int algorithm, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::ReduceScatterOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -377,6 +377,7 @@ int tc_reduce_scatter_fn(void* ctx, const void* input, void* output,
     opts.recvCounts = countsVec(recvCounts, asContext(ctx)->size());
     opts.dtype = static_cast<DataType>(dtype);
     opts.customFn = fn;
+    opts.algorithm = static_cast<tpucoll::ReduceScatterAlgorithm>(algorithm);
     tpucoll::reduceScatter(opts);
   });
 }
@@ -498,7 +499,7 @@ int tc_alltoallv(void* ctx, const void* input, const size_t* inCounts,
 
 int tc_reduce_scatter(void* ctx, const void* input, void* output,
                       const size_t* recvCounts, int dtype, int op,
-                      uint32_t tag, int64_t timeoutMs) {
+                      int algorithm, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::ReduceScatterOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -507,6 +508,7 @@ int tc_reduce_scatter(void* ctx, const void* input, void* output,
     opts.recvCounts = countsVec(recvCounts, asContext(ctx)->size());
     opts.dtype = static_cast<DataType>(dtype);
     opts.op = static_cast<ReduceOp>(op);
+    opts.algorithm = static_cast<tpucoll::ReduceScatterAlgorithm>(algorithm);
     tpucoll::reduceScatter(opts);
   });
 }

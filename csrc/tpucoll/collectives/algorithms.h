@@ -5,6 +5,7 @@
 
 #include <chrono>
 
+#include "tpucoll/collectives/detail.h"
 #include "tpucoll/context.h"
 #include "tpucoll/math.h"
 #include "tpucoll/types.h"
@@ -42,6 +43,32 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 // Ring allreduce with bfloat16 wire compression (float32 payloads).
 void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
                            std::chrono::milliseconds timeout);
+
+// Log-latency reduce-scatter by recursive vector halving (contract of
+// reference gloo/reduce_scatter.h:21-329, re-derived for the in-order
+// window walk): log2(P) rounds over windows of the caller's per-rank
+// result blocks (arbitrary recvCounts; floor splits keep partners in
+// lockstep on uneven counts). Power-of-2 groups land block r on rank r
+// directly; otherwise odd ranks of the first 2*rem fold into their even
+// partner and a final redistribution ships each owned block to its real
+// rank. `work` is reduced in place; afterwards block `rank` (at
+// blocks.offset[rank]) is this rank's fully reduced result.
+void hdReduceScatter(Context* ctx, char* work,
+                     const collectives_detail::Blocks& blocks, ReduceFn fn,
+                     size_t elsize, Slot slot,
+                     std::chrono::milliseconds timeout, bool fuseOk);
+
+// One-round reduce-scatter for tiny payloads: every rank ships its copy
+// of block j straight to rank j (P-1 concurrent transfers) and combines
+// the P-1 partials that land in its own block. Single network round —
+// beats both ring (P-1 rounds) and recursive halving (log2 P) when the
+// payload is latency-bound. No reference analog (its smallest-payload
+// path is still halving-doubling); same tier as the repo's direct
+// allgather (TPUCOLL_ALLGATHER_DIRECT_MAX).
+void directReduceScatter(Context* ctx, char* work,
+                         const collectives_detail::Blocks& blocks,
+                         ReduceFn fn, size_t elsize, Slot slot,
+                         std::chrono::milliseconds timeout, bool fuseOk);
 
 }  // namespace algorithms
 }  // namespace tpucoll

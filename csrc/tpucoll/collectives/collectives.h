@@ -167,6 +167,18 @@ struct AlltoallvOptions : CollectiveOptions {
 };
 void alltoallv(AlltoallvOptions& opts);
 
+enum class ReduceScatterAlgorithm : uint8_t {
+  // Ring for bandwidth-bound payloads (P-1 uniform pipelined steps);
+  // recursive vector halving (log2 P rounds, contract of reference
+  // gloo/reduce_scatter.h) in the middle; single-round direct exchange
+  // for tiny payloads. Crossovers: TPUCOLL_RS_DIRECT_MAX,
+  // TPUCOLL_RS_HD_MAX.
+  kAuto = 0,
+  kRing = 1,
+  kHalvingDoubling = 2,
+  kDirect = 3,
+};
+
 struct ReduceScatterOptions : CollectiveOptions {
   const void* input = nullptr;      // sum(recvCounts) elements
   void* output = nullptr;           // recvCounts[rank] elements
@@ -174,6 +186,7 @@ struct ReduceScatterOptions : CollectiveOptions {
   DataType dtype = DataType::kFloat32;
   ReduceOp op = ReduceOp::kSum;
   ReduceFn customFn = nullptr;      // overrides `op` when set
+  ReduceScatterAlgorithm algorithm = ReduceScatterAlgorithm::kAuto;
 };
 void reduceScatter(ReduceScatterOptions& opts);
 

@@ -44,6 +44,7 @@ constexpr uint64_t kRsBase = 0x1000;
 constexpr uint64_t kFwdBase = 0x2000;
 constexpr uint64_t kBwdBase = 0x3000;
 constexpr uint64_t kAgBase = 0x4000;
+constexpr uint64_t kRedistBase = 0x5000;
 constexpr uint64_t kFoldBase = 0;
 constexpr uint64_t kUnfoldSlot = 1 << 20;
 
@@ -321,6 +322,191 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
 }
 
 }  // namespace
+
+void hdReduceScatter(Context* ctx, char* work, const Blocks& blocks,
+                     ReduceFn fn, size_t elsize, Slot slot,
+                     std::chrono::milliseconds timeout, bool fuseOk) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes =
+      blocks.offset[size - 1] + blocks.bytes[size - 1];
+  const int pow2 = static_cast<int>(largestPow2AtMost(size));
+  const int rem = size - pow2;
+
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto canFuse = [&](int src) {
+    return fuseRecvReduce(ctx, fuseOk, elsize, src);
+  };
+  LazyScratch stage(ctx, nbytes);
+
+  // Fold (non-power-of-2 only): odd ranks of the first 2*rem contribute
+  // their whole vector to their even partner and rejoin for the
+  // redistribution at the end.
+  int vrank;
+  if (rank < 2 * rem) {
+    if (rank % 2 == 1) {
+      workBuf->send(rank - 1, slot.offset(kFoldBase).value(), 0, nbytes);
+      workBuf->waitSend(timeout);
+      vrank = -1;
+    } else {
+      if (canFuse(rank + 1)) {
+        workBuf->recvReduce(rank + 1, slot.offset(kFoldBase).value(), fn,
+                            elsize, 0, nbytes);
+        workBuf->waitRecv(nullptr, timeout);
+      } else {
+        stage.buf()->recv(rank + 1, slot.offset(kFoldBase).value(), 0,
+                          nbytes);
+        stage.buf()->waitRecv(nullptr, timeout);
+        if (nbytes > 0) {
+          fn(work, stage.data(), nbytes / elsize);
+        }
+      }
+      vrank = rank / 2;
+    }
+  } else {
+    vrank = rank - rem;
+  }
+  auto physical = [&](int v) { return v < rem ? 2 * v : v + rem; };
+
+  // Recursive vector halving over windows of RESULT blocks (size of
+  // them, arbitrary byte counts). Floor splits: both partners compute
+  // half = c/2 from the shared window, so uneven windows stay in
+  // lockstep; the upper window takes the extra block. Window byte
+  // ranges are contiguous, so each round is one transfer.
+  int pendingSends = 0;
+  int winStart = 0;
+  int winCount = size;
+  if (vrank >= 0) {
+    int step = 0;
+    for (int mask = pow2 / 2; mask >= 1; mask >>= 1, step++) {
+      const int half = winCount / 2;
+      const int partner = physical(vrank ^ mask);
+      const bool keepLower = (vrank & mask) == 0;
+      const int keepStart = keepLower ? winStart : winStart + half;
+      const int keepCount = keepLower ? half : winCount - half;
+      const int sendStart = keepLower ? winStart + half : winStart;
+      const int sendCount = winCount - keepCount;
+      const uint64_t s = slot.offset(kRsBase + step).value();
+      const size_t keepBytes = blocks.rangeBytes(keepStart, keepCount);
+      const bool fused = canFuse(partner);
+      if (fused) {
+        workBuf->recvReduce(partner, s, fn, elsize,
+                            blocks.offset[keepStart], keepBytes);
+      } else {
+        stage.buf()->recv(partner, s, blocks.offset[keepStart], keepBytes);
+      }
+      workBuf->send(partner, s, blocks.offset[sendStart],
+                    blocks.rangeBytes(sendStart, sendCount));
+      if (fused) {
+        workBuf->waitRecv(nullptr, timeout);
+      } else {
+        stage.buf()->waitRecv(nullptr, timeout);
+        if (keepBytes > 0) {
+          fn(work + blocks.offset[keepStart],
+             stage.data() + blocks.offset[keepStart], keepBytes / elsize);
+        }
+      }
+      // Send completions are deferred to the end of the call: every
+      // round's sent range is disjoint from all later combine targets
+      // (each round's keep window excludes what was sent), so in-flight
+      // data is never rewritten and the blocking wait would only add
+      // log2(P) stalls to a latency-bound path.
+      pendingSends++;
+      winStart = keepStart;
+      winCount = keepCount;
+    }
+  }
+
+  // Redistribution: power-of-2 groups land window == {block vrank ==
+  // block rank} and this phase is empty. Otherwise each participant
+  // ships the foreign blocks in its window to their real ranks, and
+  // every rank whose block ended elsewhere (including folded-out odd
+  // ranks) receives it. ownerOf replays the deterministic window walk.
+  auto ownerOf = [&](int j) {
+    int v = 0, s = 0, c = size;
+    for (int mask = pow2 / 2; mask >= 1; mask >>= 1) {
+      const int half = c / 2;
+      if (j < s + half) {
+        c = half;
+      } else {
+        v |= mask;
+        s += half;
+        c -= half;
+      }
+    }
+    return v;
+  };
+  if (vrank >= 0) {
+    for (int j = winStart; j < winStart + winCount; j++) {
+      if (j == rank || blocks.bytes[j] == 0) {
+        continue;
+      }
+      workBuf->send(j, slot.offset(kRedistBase + uint64_t(j)).value(),
+                    blocks.offset[j], blocks.bytes[j]);
+      pendingSends++;
+    }
+  }
+  const int owner = physical(ownerOf(rank));
+  if (owner != rank && blocks.bytes[rank] > 0) {
+    workBuf->recv(owner, slot.offset(kRedistBase + uint64_t(rank)).value(),
+                  blocks.offset[rank], blocks.bytes[rank]);
+    workBuf->waitRecv(nullptr, timeout);
+  }
+  for (int i = 0; i < pendingSends; i++) {
+    workBuf->waitSend(timeout);
+  }
+}
+
+void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
+                         ReduceFn fn, size_t elsize, Slot slot,
+                         std::chrono::milliseconds timeout, bool fuseOk) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const size_t nbytes =
+      blocks.offset[size - 1] + blocks.bytes[size - 1];
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+
+  // One latency round: ship this rank's copy of block j straight to
+  // rank j, all P-1 transfers concurrently in flight.
+  int sends = 0;
+  for (int j = 0; j < size; j++) {
+    if (j == rank || blocks.bytes[j] == 0) {
+      continue;
+    }
+    workBuf->send(j, slot.offset(uint64_t(j)).value(), blocks.offset[j],
+                  blocks.bytes[j]);
+    sends++;
+  }
+  // P-1 partials land in this rank's block. The combines are serialized
+  // (one outstanding recvReduce at a time): combine-on-arrival may run
+  // on the loop thread or, for stash hits, on this thread — two
+  // outstanding posts into the SAME range could race their accumulates.
+  // Serial posting keeps the zero-copy combine and still overlaps the
+  // wire time: senders fired already, later arrivals wait in the stash.
+  if (blocks.bytes[rank] > 0) {
+    LazyScratch stage(ctx, blocks.bytes[rank]);
+    for (int s = 0; s < size; s++) {
+      if (s == rank) {
+        continue;
+      }
+      if (fuseRecvReduce(ctx, fuseOk, elsize, s)) {
+        workBuf->recvReduce(s, slot.offset(uint64_t(rank)).value(), fn,
+                            elsize, blocks.offset[rank],
+                            blocks.bytes[rank]);
+        workBuf->waitRecv(nullptr, timeout);
+      } else {
+        stage.buf()->recv(s, slot.offset(uint64_t(rank)).value(), 0,
+                          blocks.bytes[rank]);
+        stage.buf()->waitRecv(nullptr, timeout);
+        fn(work + blocks.offset[rank], stage.data(),
+           blocks.bytes[rank] / elsize);
+      }
+    }
+  }
+  for (int i = 0; i < sends; i++) {
+    workBuf->waitSend(timeout);
+  }
+}
 
 void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,

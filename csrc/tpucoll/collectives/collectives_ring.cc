@@ -581,10 +581,43 @@ void reduceScatter(ReduceScatterOptions& opts) {
   char* work = scratch.data();
   std::memcpy(work, opts.input, total);
   Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
-  auto workBuf = ctx->createUnboundBuffer(work, total);
-  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
-                    /*startShift=*/-1, timeout, workBuf.get(),
-                    /*fuseOk=*/opts.customFn == nullptr);
+  const bool fuseOk = opts.customFn == nullptr;
+  ReduceScatterAlgorithm algo = opts.algorithm;
+  if (algo == ReduceScatterAlgorithm::kAuto) {
+    // Crossovers measured on loopback P=4/8 (BASELINE.md round 3):
+    // recursive halving wins through ~256K, the ring beyond. The
+    // single-round direct exchange loses on a shared-core loopback
+    // (its P*(P-1) total messages cost more than its one-round latency
+    // saves there), so it defaults OFF; on real DCN, where propagation
+    // delay dominates per-message CPU, set TPUCOLL_RS_DIRECT_MAX to
+    // ~16-64K to enable the tier. TPUCOLL_RS_HD_MAX moves the hd/ring
+    // crossover (total payload bytes).
+    static const size_t directMax = collectives_detail::envBytes(
+        "TPUCOLL_RS_DIRECT_MAX", 0);
+    static const size_t hdMax = collectives_detail::envBytes(
+        "TPUCOLL_RS_HD_MAX", 256u << 10);
+    algo = total <= directMax ? ReduceScatterAlgorithm::kDirect
+           : total <= hdMax   ? ReduceScatterAlgorithm::kHalvingDoubling
+                              : ReduceScatterAlgorithm::kRing;
+  }
+  switch (algo) {
+    case ReduceScatterAlgorithm::kDirect:
+      algorithms::directReduceScatter(ctx, work, blocks, fn, elsize, slot,
+                                      timeout, fuseOk);
+      break;
+    case ReduceScatterAlgorithm::kHalvingDoubling:
+      algorithms::hdReduceScatter(ctx, work, blocks, fn, elsize, slot,
+                                  timeout, fuseOk);
+      break;
+    case ReduceScatterAlgorithm::kRing: {
+      auto workBuf = ctx->createUnboundBuffer(work, total);
+      ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
+                        /*startShift=*/-1, timeout, workBuf.get(), fuseOk);
+      break;
+    }
+    default:
+      TC_THROW(EnforceError, "unknown reduce_scatter algorithm");
+  }
   std::memcpy(opts.output, work + blocks.offset[rank], blocks.bytes[rank]);
 }
 

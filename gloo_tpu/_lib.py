@@ -121,7 +121,7 @@ _PROTOTYPES = {
     "tc_reduce_fn": (_int, [_c, _c, _c, _sz, _int, _c, _int, _int, _u32,
                             _i64]),
     "tc_reduce_scatter_fn": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
-                                    _c, _u32, _i64]),
+                                    _c, _int, _u32, _i64]),
     "tc_gather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_gatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _int,
                           _u32, _i64]),
@@ -133,7 +133,7 @@ _PROTOTYPES = {
     "tc_alltoallv": (_int, [_c, _c, ctypes.POINTER(_sz), _c,
                             ctypes.POINTER(_sz), _int, _u32, _i64]),
     "tc_reduce_scatter": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
-                                 _int, _u32, _i64]),
+                                 _int, _int, _u32, _i64]),
     # p2p
     "tc_buffer_new": (_c, [_c, _c, _sz]),
     "tc_buffer_free": (None, [_c]),

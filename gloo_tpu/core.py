@@ -748,11 +748,24 @@ class Context:
                                     _timeout_ms(timeout)))
         return out
 
+    _RS_ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2,
+                      "hd": 2, "direct": 3}
+
     def reduce_scatter(self, array: np.ndarray,
                        recv_counts: Optional[Sequence[int]] = None,
-                       op="sum", tag: int = 0,
+                       op="sum", algorithm: str = "auto", tag: int = 0,
                        timeout: Optional[float] = None) -> np.ndarray:
+        """Reduce then scatter per-rank blocks.
+
+        algorithm: "auto" (recursive halving for small payloads, ring
+        for bulk; crossover via TPUCOLL_RS_HD_MAX=256K), "direct" (one
+        network round, P-1 concurrent transfers — auto only picks it
+        when TPUCOLL_RS_DIRECT_MAX is raised from its default 0; meant
+        for real DCN, it loses on shared-core loopback),
+        "halving_doubling"/"hd", or "ring".
+        """
         _check_array(array)
+        algo = self._RS_ALGORITHMS[algorithm]
         if recv_counts is None:
             assert array.size % self.size == 0, \
                 "array size not divisible by group size"
@@ -763,8 +776,8 @@ class Context:
             cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
             check(_lib.lib.tc_reduce_scatter_fn(
                 self._handle, _ptr(array), _ptr(out),
-                _counts_arg(recv_counts), _dtype_code(array), fnp, tag,
-                _timeout_ms(timeout)))
+                _counts_arg(recv_counts), _dtype_code(array), fnp, algo,
+                tag, _timeout_ms(timeout)))
             del cb
             raise_pending()
             return out
@@ -772,7 +785,7 @@ class Context:
                                          _ptr(out),
                                          _counts_arg(recv_counts),
                                          _dtype_code(array),
-                                         ReduceOp.parse(op), tag,
+                                         ReduceOp.parse(op), algo, tag,
                                          _timeout_ms(timeout)))
         return out
 

@@ -305,6 +305,32 @@ def test_reduce_scatter(size):
             full[r * count_per_rank:(r + 1) * count_per_rank], rtol=1e-6)
 
 
+@pytest.mark.parametrize("size", [2, 3, 4, 6, 8])
+@pytest.mark.parametrize("algorithm", ["ring", "hd", "direct"])
+def test_reduce_scatter_algorithms(size, algorithm):
+    """Both RS schedules: even counts, uneven counts (incl. empty
+    blocks), and a count smaller than the group."""
+    cases = [[7] * size,
+             [(3 * i) % 5 for i in range(size)],
+             [1 if i == size - 1 else 0 for i in range(size)]]
+    for recv_counts in cases:
+        total = sum(recv_counts)
+
+        def fn(ctx, rank, recv_counts=recv_counts, total=total):
+            x = fixture(rank, total, np.float32)
+            return ctx.reduce_scatter(x, recv_counts=recv_counts,
+                                      algorithm=algorithm)
+
+        results = spawn(size, fn)
+        full = sum(fixture(r, total, np.float64) for r in range(size))
+        off = 0
+        for r in range(size):
+            np.testing.assert_allclose(
+                results[r].astype(np.float64),
+                full[off:off + recv_counts[r]], rtol=1e-6)
+            off += recv_counts[r]
+
+
 def test_reduce_scatter_uneven():
     size = 3
     recv_counts = [4, 0, 7]
