@@ -18,6 +18,21 @@ namespace {
 constexpr int kMaxEvents = 64;
 }
 
+// ---- Loop: data-path defaults (readiness-only engines) ----
+
+void Loop::addData(int, Handler*) {
+  TC_THROW(EnforceError, "engine '", engineName(),
+           "' has no submission data path");
+}
+void Loop::asyncRecv(int, void*, size_t) {
+  TC_THROW(EnforceError, "engine '", engineName(),
+           "' has no submission data path");
+}
+void Loop::asyncSend(int, const iovec*, int) {
+  TC_THROW(EnforceError, "engine '", engineName(),
+           "' has no submission data path");
+}
+
 // ---- LoopBase: thread + wakeup + deferral + tick barrier ----
 
 LoopBase::LoopBase(bool busyPoll) : busyPoll_(busyPoll) {

@@ -15,6 +15,8 @@
 // Selection: DeviceAttr.engine or TPUCOLL_ENGINE = epoll|uring|auto.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -32,6 +34,13 @@ class Handler {
  public:
   virtual ~Handler() = default;
   virtual void handleEvents(uint32_t events) = 0;
+  // Data-path completion (engines where hasDataPath(); loop thread):
+  // result of an asyncRecv/asyncSend — bytes transferred, 0 = EOF (recv),
+  // negative = -errno. Default: readiness-only handlers never see it.
+  virtual void handleIoComplete(bool isRecv, int32_t res) {
+    (void)isRecv;
+    (void)res;
+  }
 };
 
 class Loop {
@@ -67,6 +76,19 @@ class Loop {
 
   // "epoll" or "uring" (introspection / tests).
   virtual const char* engineName() const = 0;
+
+  // ---- submission data path (uring engine) ----
+  // hasDataPath(): the engine executes socket I/O from submitted ops
+  // (batched SQEs, one io_uring_enter per dispatch batch) instead of
+  // readiness + caller syscalls. Registered via addData (no poll is
+  // armed); completions arrive at handler->handleIoComplete; del()
+  // cancels outstanding ops and returns only once the kernel is done
+  // with their buffers. At most ONE outstanding op per direction per fd;
+  // buffers must stay valid until completion or del(fd).
+  virtual bool hasDataPath() const { return false; }
+  virtual void addData(int fd, Handler* handler);
+  virtual void asyncRecv(int fd, void* buf, size_t len);
+  virtual void asyncSend(int fd, const iovec* iov, int iovcnt);
 };
 
 // Engine factory. `engine`: "epoll", "uring", "auto", or "" (= TPUCOLL_ENGINE

@@ -5,6 +5,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/mman.h>
+#include <sys/socket.h>
 #include <sys/syscall.h>
 #include <unistd.h>
 
@@ -31,25 +32,37 @@ int sysIoUringEnter(int fd, unsigned toSubmit, unsigned minComplete,
                                   minComplete, flags, nullptr, 0));
 }
 
-// user_data encoding: fd in the high 32 bits, registration generation in
-// the low 32. Generations disambiguate stale completions after del/re-add
-// of the same fd (fds are reused by the kernel immediately).
-uint64_t encodeUd(int fd, uint32_t gen) {
-  return (uint64_t(uint32_t(fd)) << 32) | gen;
+// user_data encoding: fd in the high 32 bits, then a 2-bit op kind, then
+// a 30-bit registration generation. Generations disambiguate stale
+// completions after del/re-add of the same fd (fds are reused by the
+// kernel immediately); the kind routes the completion (readiness poll vs
+// data-path recv/send).
+enum UdKind : uint32_t { kKindPoll = 0, kKindRecv = 1, kKindSend = 2 };
+constexpr uint32_t kGenBits = 30;
+constexpr uint32_t kGenMask = (1u << kGenBits) - 1;
+
+uint64_t encodeUd(int fd, UdKind kind, uint32_t gen) {
+  return (uint64_t(uint32_t(fd)) << 32) | (uint64_t(kind) << kGenBits) |
+         (gen & kGenMask);
 }
 int udFd(uint64_t ud) { return int(uint32_t(ud >> 32)); }
-uint32_t udGen(uint64_t ud) { return uint32_t(ud); }
+UdKind udKind(uint64_t ud) {
+  return UdKind((uint32_t(ud) >> kGenBits) & 0x3);
+}
+uint32_t udGen(uint64_t ud) { return uint32_t(ud) & kGenMask; }
 
-// POLL_REMOVE completions carry this marker so the dispatch loop drops
-// them without a table lookup (fd slot 0xFFFFFFFF is never a real fd).
+// POLL_REMOVE / ASYNC_CANCEL completions carry this marker so the
+// dispatch loop drops them without a table lookup (fd slot 0xFFFFFFFF is
+// never a real fd).
 constexpr uint64_t kRemoveUd = ~uint64_t(0);
 
-// SQ depth: submission is immediate after every prep batch (max 2 SQEs),
-// so this never fills. CQ depth: every registered fd keeps one oneshot
-// poll in flight, so outstanding CQEs scale with the device's fd count
-// (pairs x contexts sharing one device) — ask for a deep CQ up front
-// (IORING_SETUP_CQSIZE, 64 KiB of ring) and additionally survive
-// overflow via FEAT_NODROP + the -EBUSY retry in submitLocked.
+// SQ depth: producers submit (or the loop thread flushes) after every
+// prep batch; sqeLocked() force-flushes if a batch ever reaches the ring
+// size. CQ depth: every registered fd keeps at most one oneshot poll and
+// two data ops in flight, so outstanding CQEs scale with the device's fd
+// count (pairs x contexts sharing one device) — ask for a deep CQ up
+// front (IORING_SETUP_CQSIZE) and additionally survive overflow via
+// FEAT_NODROP (enforced at setup) + the -EBUSY handling in enterSubmit.
 constexpr unsigned kSqEntries = 256;
 constexpr unsigned kCqEntries = 4096;
 
@@ -65,6 +78,12 @@ class UringLoop : public LoopBase {
     ringFd_ = sysIoUringSetup(kSqEntries, &p);
     TC_ENFORCE_GE(ringFd_, 0, "io_uring_setup: ", strerror(errno),
                   " (TPUCOLL_ENGINE=epoll to use the epoll engine)");
+    // Overflow survival (and del()'s drain loop) depend on the kernel
+    // never dropping completions. 5.5+ (FEAT_NODROP) is also the floor
+    // for the data-path opcodes (OP_RECV/OP_SENDMSG are 5.6).
+    TC_ENFORCE((p.features & IORING_FEAT_NODROP) != 0,
+               "io_uring lacks IORING_FEAT_NODROP (kernel too old); "
+               "TPUCOLL_ENGINE=epoll to use the epoll engine");
 
     // Map the rings. With FEAT_SINGLE_MMAP the SQ and CQ rings share one
     // mapping; otherwise they are separate.
@@ -105,6 +124,7 @@ class UringLoop : public LoopBase {
     {
       std::lock_guard<std::mutex> guard(mu_);
       armWakeLocked();
+      flushLocked();
     }
     startThread();
   }
@@ -128,10 +148,11 @@ class UringLoop : public LoopBase {
     Reg& reg = regs_[fd];
     reg.handler = handler;
     reg.events = events;
-    reg.gen = nextGen_++;
+    reg.gen = nextGenLocked();
     reg.armed = true;
+    reg.dataMode = false;
     armLocked(fd, reg);
-    submitLocked();
+    flushLocked();
   }
 
   void mod(int fd, uint32_t events, Handler* handler) override {
@@ -146,50 +167,152 @@ class UringLoop : public LoopBase {
       // fresh generation (the stale completion, ready or cancelled, is
       // dropped by the generation check).
       removeLocked(fd, reg.gen);
-      reg.gen = nextGen_++;
+      reg.gen = nextGenLocked();
       armLocked(fd, reg);
     }
     // !armed: the fd is mid-dispatch on the loop thread; the post-dispatch
     // re-arm picks up the new mask.
-    submitLocked();
+    flushLocked();
   }
 
   void del(int fd) override {
+    bool hadPoll = false;
     {
-      std::lock_guard<std::mutex> guard(mu_);
+      std::unique_lock<std::mutex> lock(mu_);
       auto it = regs_.find(fd);
-      if (it != regs_.end()) {
-        if (it->second.armed) {
-          removeLocked(fd, it->second.gen);
-          submitLocked();
-        }
-        regs_.erase(it);
+      if (it == regs_.end()) {
+        return;
       }
+      Reg& reg = it->second;
+      hadPoll = !reg.dataMode;
+      reg.dying = true;
+      if (reg.armed) {
+        removeLocked(fd, reg.gen);
+      }
+      // Cancel outstanding data ops and WAIT for their terminal
+      // completions: the kernel may be mid-copy into/out of the caller's
+      // buffers, and the del() contract is "no dispatch AND no kernel
+      // access to op memory after return".
+      if (reg.recvOut) {
+        cancelLocked(encodeUd(fd, kKindRecv, reg.gen));
+      }
+      if (reg.sendOut) {
+        cancelLocked(encodeUd(fd, kKindSend, reg.gen));
+      }
+      flushLocked(/*force=*/true);
+      if (reg.recvOut || reg.sendOut) {
+        if (onLoopThread()) {
+          drainFdOpsOnLoopThread(lock, fd);
+        } else {
+          dataCv_.wait(lock, [&] {
+            auto i2 = regs_.find(fd);
+            return i2 == regs_.end() ||
+                   (!i2->second.recvOut && !i2->second.sendOut);
+          });
+        }
+      }
+      regs_.erase(fd);
     }
-    // Tick barrier: once the loop completes the current dispatch batch, no
-    // stale completion for fd can still be dispatching.
-    barrier();
+    if (hadPoll) {
+      // Tick barrier: once the loop completes the current dispatch batch,
+      // no stale poll completion for fd can still be dispatching.
+      barrier();
+    }
   }
 
   const char* engineName() const override { return "uring"; }
 
+  // ---- submission data path ----
+
+  bool hasDataPath() const override { return true; }
+
+  void addData(int fd, Handler* handler) override {
+    std::lock_guard<std::mutex> guard(mu_);
+    Reg& reg = regs_[fd];
+    reg.handler = handler;
+    reg.gen = nextGenLocked();
+    reg.dataMode = true;
+    reg.armed = false;
+  }
+
+  void asyncRecv(int fd, void* buf, size_t len) override {
+    std::lock_guard<std::mutex> guard(mu_);
+    auto it = regs_.find(fd);
+    TC_ENFORCE(it != regs_.end() && it->second.dataMode && !it->second.dying,
+               "uring asyncRecv: fd not in data mode");
+    Reg& reg = it->second;
+    TC_ENFORCE(!reg.recvOut, "uring asyncRecv: recv already outstanding");
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_RECV;
+    sqe->fd = fd;
+    sqe->addr = reinterpret_cast<uint64_t>(buf);
+    sqe->len = static_cast<uint32_t>(len);
+    sqe->user_data = encodeUd(fd, kKindRecv, reg.gen);
+    reg.recvOut = true;
+    flushLocked();
+  }
+
+  void asyncSend(int fd, const iovec* iov, int iovcnt) override {
+    std::lock_guard<std::mutex> guard(mu_);
+    auto it = regs_.find(fd);
+    TC_ENFORCE(it != regs_.end() && it->second.dataMode && !it->second.dying,
+               "uring asyncSend: fd not in data mode");
+    Reg& reg = it->second;
+    TC_ENFORCE(!reg.sendOut, "uring asyncSend: send already outstanding");
+    TC_ENFORCE(iovcnt > 0 && iovcnt <= kTxIovMax,
+               "uring asyncSend: bad iovcnt");
+    // The msghdr/iovec must stay valid until the kernel consumes the
+    // SQE (and with ASYNC they must live until completion): copy into
+    // registration-owned storage.
+    for (int i = 0; i < iovcnt; i++) {
+      reg.txIov[i] = iov[i];
+    }
+    std::memset(&reg.txMsg, 0, sizeof(reg.txMsg));
+    reg.txMsg.msg_iov = reg.txIov;
+    reg.txMsg.msg_iovlen = static_cast<size_t>(iovcnt);
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_SENDMSG;
+    sqe->fd = fd;
+    sqe->addr = reinterpret_cast<uint64_t>(&reg.txMsg);
+    sqe->len = 1;
+    sqe->msg_flags = MSG_NOSIGNAL;
+    sqe->user_data = encodeUd(fd, kKindSend, reg.gen);
+    reg.sendOut = true;
+    flushLocked();
+  }
+
  private:
+  static constexpr int kTxIovMax = 4;
+
   struct Reg {
     Handler* handler{nullptr};
     uint32_t events{0};
     uint32_t gen{0};
-    bool armed{false};
+    bool armed{false};     // readiness poll in flight
+    bool dataMode{false};  // addData registration (no poll)
+    bool dying{false};     // del() in progress: drop completions
+    bool recvOut{false};   // data-path ops in flight
+    bool sendOut{false};
+    msghdr txMsg{};
+    iovec txIov[kTxIovMax];
   };
+
+  struct Completion {
+    uint64_t ud;
+    int32_t res;
+  };
+
+  uint32_t nextGenLocked() { return nextGen_++ & kGenMask; }
 
   // --- SQ production (mu_ held) ---
 
   io_uring_sqe* sqeLocked() {
-    // Submission is immediate after every prep batch, and batches are at
-    // most 2 entries (remove + add), so the SQ cannot fill.
-    const unsigned head =
-        __atomic_load_n(sqHead_, __ATOMIC_ACQUIRE);
+    const unsigned head = __atomic_load_n(sqHead_, __ATOMIC_ACQUIRE);
+    if (sqTailLocal_ - head >= kSqEntries) {
+      // A lazy loop-thread batch filled the ring: flush it now.
+      flushLocked(/*force=*/true);
+    }
     const unsigned tail = sqTailLocal_;
-    TC_ENFORCE(tail - head < kSqEntries, "io_uring SQ overflow");
     io_uring_sqe* sqe = &sqes_[tail & sqMask_];
     std::memset(sqe, 0, sizeof(*sqe));
     sqArray_[tail & sqMask_] = tail & sqMask_;
@@ -204,13 +327,20 @@ class UringLoop : public LoopBase {
     sqe->fd = fd;
     // EPOLL* and POLL* share values for IN/OUT/ERR/HUP/RDHUP; pass through.
     sqe->poll32_events = reg.events | POLLERR | POLLHUP;
-    sqe->user_data = encodeUd(fd, reg.gen);
+    sqe->user_data = encodeUd(fd, kKindPoll, reg.gen);
   }
 
   void removeLocked(int fd, uint32_t gen) {
     io_uring_sqe* sqe = sqeLocked();
     sqe->opcode = IORING_OP_POLL_REMOVE;
-    sqe->addr = encodeUd(fd, gen);
+    sqe->addr = encodeUd(fd, kKindPoll, gen);
+    sqe->user_data = kRemoveUd;
+  }
+
+  void cancelLocked(uint64_t targetUd) {
+    io_uring_sqe* sqe = sqeLocked();
+    sqe->opcode = IORING_OP_ASYNC_CANCEL;
+    sqe->addr = targetUd;
     sqe->user_data = kRemoveUd;
   }
 
@@ -219,58 +349,165 @@ class UringLoop : public LoopBase {
     sqe->opcode = IORING_OP_POLL_ADD;
     sqe->fd = wakeFd_;
     sqe->poll32_events = POLLIN;
-    sqe->user_data = encodeUd(wakeFd_, 0);  // gen 0 = the wake poll
-    submitLocked();
+    sqe->user_data = encodeUd(wakeFd_, kKindPoll, 0);  // gen 0 = wake poll
   }
 
-  void submitLocked() {
+  // Publish prepped SQEs. On the loop thread submission is LAZY by
+  // default — the whole dispatch batch's SQEs ride the single
+  // io_uring_enter that also waits for the next completions. Any other
+  // thread must enter immediately (the doorbell that starts the I/O).
+  void flushLocked(bool force = false) {
     if (pending_ == 0) {
       return;
     }
     __atomic_store_n(sqTail_, sqTailLocal_, __ATOMIC_RELEASE);
+    if (!force && onLoopThread()) {
+      return;  // run() submits with its wait-enter
+    }
     const unsigned n = pending_;
     pending_ = 0;
-    for (;;) {
+    enterSubmit(n);
+  }
+
+  void enterSubmit(unsigned n) {
+    // mu_ held (ALL CQ consumption happens under mu_, so draining here
+    // is safe from any thread). EBUSY = CQ saturated (FEAT_NODROP
+    // backlog): free CQ space into the spill queue — yielding alone
+    // would deadlock on the loop thread (sole dispatcher waiting on
+    // itself) and stall other threads against a blocked loop.
+    bool spilled = false;
+    while (n > 0) {
       int rv = sysIoUringEnter(ringFd_, n, 0, 0);
       if (rv >= 0) {
-        return;
+        // Partial submission is possible (e.g. CQ filled mid-batch):
+        // keep going until every prepped SQE is consumed — dropping one
+        // loses an I/O forever.
+        n -= std::min(n, unsigned(rv));
+        continue;
       }
       if (errno == EINTR) {
         continue;
       }
       if (errno == EBUSY) {
-        // CQ is saturated (FEAT_NODROP backlog): the loop thread drains
-        // it without taking mu_, so yielding here makes progress even
-        // though we hold the lock. Bounded in practice by the CQ depth.
-        std::this_thread::yield();
+        if (drainCqLocked() == 0) {
+          std::this_thread::yield();
+        } else {
+          spilled = true;
+        }
         continue;
       }
       TC_THROW(EnforceError, "io_uring_enter(submit): ", strerror(errno));
     }
+    if (spilled && !onLoopThread()) {
+      wake();  // the loop may be blocked in GETEVENTS on a CQ we emptied
+    }
   }
 
-  // --- CQ consumption (loop thread only) ---
+  // --- CQ consumption ---
+
+  // Drain available CQEs into the dispatch queue; returns how many.
+  // mu_ held — the queue (not a thread-local batch) is THE holding area
+  // for undispatched completions, so del() can always find an op's
+  // terminal completion no matter which thread drained it.
+  unsigned drainCqLocked() {
+    unsigned head = *cqHead_;
+    const unsigned tail = __atomic_load_n(cqTail_, __ATOMIC_ACQUIRE);
+    unsigned n = 0;
+    for (; head != tail; head++, n++) {
+      const io_uring_cqe& cqe = cqes_[head & cqMask_];
+      dispatchQ_.push_back({cqe.user_data, cqe.res});
+    }
+    __atomic_store_n(cqHead_, head, __ATOMIC_RELEASE);
+    return n;
+  }
+
+  // del() on the loop thread: consume CQEs inline until fd's data ops
+  // have terminally completed; everything else spills to the next batch.
+  void drainFdOpsOnLoopThread(std::unique_lock<std::mutex>& lock, int fd) {
+    for (;;) {
+      Reg& reg = regs_.at(fd);
+      if (!reg.recvOut && !reg.sendOut) {
+        break;
+      }
+      // This fd's terminal completions may ALREADY sit in the dispatch
+      // queue — drained but not yet dispatched (this thread IS the
+      // dispatcher, and it is here, inside a handler). Waiting for a
+      // fresh CQE while the needed one sits queued would block forever.
+      // Consume ours from the queue first; only then wait for new ones.
+      bool found = false;
+      for (auto it = dispatchQ_.begin(); it != dispatchQ_.end();) {
+        if (it->ud != kRemoveUd && udFd(it->ud) == fd &&
+            udKind(it->ud) != kKindPoll && udGen(it->ud) == reg.gen) {
+          clearOutstandingLocked(reg, udKind(it->ud));
+          it = dispatchQ_.erase(it);
+          found = true;
+        } else {
+          ++it;
+        }
+      }
+      if (found) {
+        continue;
+      }
+      if (drainCqLocked() == 0) {
+        static std::atomic<int> spins{0};
+        if (++spins % 1 == 0) {
+          fprintf(stderr,
+                  "[uring inline-del] fd=%d recvOut=%d sendOut=%d gen=%u "
+                  "spill=%zu\n", fd, reg.recvOut, reg.sendOut, reg.gen,
+                  spill_.size());
+          for (const auto& c : spill_) {
+            fprintf(stderr, "  spill ud fd=%d kind=%d gen=%u res=%d\n",
+                    udFd(c.ud), int(udKind(c.ud)), udGen(c.ud), c.res);
+          }
+        }
+        lock.unlock();
+        int rv = sysIoUringEnter(ringFd_, 0, 1, IORING_ENTER_GETEVENTS);
+        if (rv < 0 && errno != EINTR && errno != EBUSY) {
+          TC_ERROR("io_uring_enter(del wait): ", strerror(errno));
+        }
+        lock.lock();
+      }
+    }
+  }
+
+  void clearOutstandingLocked(Reg& reg, UdKind kind) {
+    if (kind == kKindRecv) {
+      reg.recvOut = false;
+    } else if (kind == kKindSend) {
+      reg.sendOut = false;
+    }
+    dataCv_.notify_all();
+  }
 
   void run() override {
-    struct Completion {
-      uint64_t ud;
-      int32_t res;
-    };
-    std::vector<Completion> batch;
+    bool dispatched = false;
     while (!stop_.load()) {
-      // Drain available completions (sole consumer: plain head, acquire
-      // tail).
-      batch.clear();
-      unsigned head = *cqHead_;
-      const unsigned tail = __atomic_load_n(cqTail_, __ATOMIC_ACQUIRE);
-      for (; head != tail; head++) {
-        const io_uring_cqe& cqe = cqes_[head & cqMask_];
-        batch.push_back({cqe.user_data, cqe.res});
+      Completion c{};
+      bool have = false;
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        if (dispatchQ_.empty()) {
+          drainCqLocked();
+        }
+        if (!dispatchQ_.empty()) {
+          c = dispatchQ_.front();
+          dispatchQ_.pop_front();
+          have = true;
+        }
       }
-      __atomic_store_n(cqHead_, head, __ATOMIC_RELEASE);
 
-      if (batch.empty()) {
+      if (!have) {
+        if (dispatched) {
+          dispatched = false;
+          endOfBatch();
+          continue;  // the batch may have deferred work producing CQEs
+        }
         if (busyPoll_) {
+          // Spinning: publish + submit any lazily-prepped SQEs first.
+          {
+            std::lock_guard<std::mutex> guard(mu_);
+            flushLocked(/*force=*/true);
+          }
 #if defined(__x86_64__) || defined(__i386__)
           __builtin_ia32_pause();
 #endif
@@ -280,27 +517,51 @@ class UringLoop : public LoopBase {
           std::this_thread::yield();
           continue;
         }
-        int rv = sysIoUringEnter(ringFd_, 0, 1, IORING_ENTER_GETEVENTS);
+        // THE steady-state syscall: one enter submits the entire batch
+        // of prepped SQEs and waits for the next completion.
+        unsigned n = 0;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          if (pending_ > 0) {
+            __atomic_store_n(sqTail_, sqTailLocal_, __ATOMIC_RELEASE);
+            n = pending_;
+            pending_ = 0;
+          }
+        }
+        int rv = sysIoUringEnter(ringFd_, n, 1, IORING_ENTER_GETEVENTS);
+        if (rv >= 0) {
+          n -= std::min(n, unsigned(rv));
+        }
+        if (n > 0) {
+          // EBUSY/EINTR/partial consumption left unsubmitted SQEs in the
+          // ring; push them through or the I/Os they carry never start.
+          std::lock_guard<std::mutex> guard(mu_);
+          enterSubmit(n);
+        }
         if (rv < 0 && errno != EINTR && errno != EBUSY) {
           TC_ERROR("io_uring_enter(wait): ", strerror(errno));
         }
         continue;  // re-drain
       }
 
-      for (const Completion& c : batch) {
-        if (c.ud == kRemoveUd) {
-          continue;  // POLL_REMOVE ack
+      dispatched = true;
+      if (c.ud == kRemoveUd) {
+        continue;  // POLL_REMOVE / ASYNC_CANCEL ack
+      }
+      const int fd = udFd(c.ud);
+      const UdKind kind = udKind(c.ud);
+      const uint32_t gen = udGen(c.ud);
+      if (fd == wakeFd_ && kind == kKindPoll && gen == 0) {
+        uint64_t drain;
+        while (read(wakeFd_, &drain, sizeof(drain)) > 0) {
         }
-        const int fd = udFd(c.ud);
-        const uint32_t gen = udGen(c.ud);
-        if (fd == wakeFd_ && gen == 0) {
-          uint64_t drain;
-          while (read(wakeFd_, &drain, sizeof(drain)) > 0) {
-          }
-          std::lock_guard<std::mutex> guard(mu_);
-          armWakeLocked();
-          continue;
-        }
+        std::lock_guard<std::mutex> guard(mu_);
+        armWakeLocked();
+        continue;
+      }
+
+      if (kind != kKindPoll) {
+        // Data-path completion.
         Handler* handler = nullptr;
         {
           std::lock_guard<std::mutex> guard(mu_);
@@ -308,38 +569,56 @@ class UringLoop : public LoopBase {
           if (it == regs_.end() || it->second.gen != gen) {
             continue;  // stale: removed or re-registered since
           }
-          it->second.armed = false;
+          clearOutstandingLocked(it->second, kind);
+          if (it->second.dying) {
+            continue;  // del() in progress; it owns the wind-down
+          }
           handler = it->second.handler;
         }
-        // Same-generation ECANCELED should not happen (mod() bumps the
-        // generation before cancelling), but if it does, skip the dispatch
-        // and fall through to the re-arm so the fd cannot go silent.
-        if (c.res != -ECANCELED) {
-          const uint32_t events =
-              c.res > 0 ? uint32_t(c.res) : uint32_t(EPOLLERR);
-          try {
-            handler->handleEvents(events);
-          } catch (const std::exception& e) {
-            // Same contract as EpollLoop: handlers own expected failures.
-            TC_ERROR("unhandled exception on uring loop thread: ", e.what());
-          }
+        try {
+          handler->handleIoComplete(kind == kKindRecv, c.res);
+        } catch (const std::exception& e) {
+          TC_ERROR("unhandled exception on uring loop thread: ", e.what());
         }
-        // Oneshot re-arm AFTER dispatch: POLL_ADD reports current
-        // readiness immediately, so un-drained data (read budget) fires
-        // again right away — level-triggered semantics.
-        {
-          std::lock_guard<std::mutex> guard(mu_);
-          auto it = regs_.find(fd);
-          if (it != regs_.end() && it->second.gen == gen &&
-              !it->second.armed) {
-            it->second.armed = true;
-            armLocked(fd, it->second);
-            submitLocked();
-          }
-        }
+        continue;
       }
 
-      endOfBatch();
+      Handler* handler = nullptr;
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        auto it = regs_.find(fd);
+        if (it == regs_.end() || it->second.gen != gen) {
+          continue;  // stale: removed or re-registered since
+        }
+        it->second.armed = false;
+        handler = it->second.handler;
+      }
+      // Same-generation ECANCELED should not happen (mod() bumps the
+      // generation before cancelling), but if it does, skip the dispatch
+      // and fall through to the re-arm so the fd cannot go silent.
+      if (c.res != -ECANCELED) {
+        const uint32_t events =
+            c.res > 0 ? uint32_t(c.res) : uint32_t(EPOLLERR);
+        try {
+          handler->handleEvents(events);
+        } catch (const std::exception& e) {
+          // Same contract as EpollLoop: handlers own expected failures.
+          TC_ERROR("unhandled exception on uring loop thread: ", e.what());
+        }
+      }
+      // Oneshot re-arm AFTER dispatch: POLL_ADD reports current
+      // readiness immediately, so un-drained data (read budget) fires
+      // again right away — level-triggered semantics.
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        auto it = regs_.find(fd);
+        if (it != regs_.end() && it->second.gen == gen &&
+            !it->second.armed && !it->second.dataMode) {
+          it->second.armed = true;
+          armLocked(fd, it->second);
+          flushLocked();
+        }
+      }
     }
   }
 
@@ -360,6 +639,8 @@ class UringLoop : public LoopBase {
   unsigned sqTailLocal_{0};  // mu_ held for writes
   unsigned pending_{0};
   std::unordered_map<int, Reg> regs_;
+  std::deque<Completion> dispatchQ_;  // drained, undispatched; mu_ held
+  std::condition_variable dataCv_;  // del() waits for data-op drains
   uint32_t nextGen_{1};  // gen 0 is reserved for the wake poll
 };
 
@@ -371,8 +652,9 @@ bool uringAvailable() {
     if (fd < 0) {
       return false;
     }
+    const bool nodrop = (p.features & IORING_FEAT_NODROP) != 0;
     ::close(fd);
-    return true;
+    return nodrop;
   }();
   return ok;
 }

@@ -67,7 +67,8 @@ Pair::Pair(Context* context, Loop* loop, int selfRank, int peerRank,
       loop_(loop),
       selfRank_(selfRank),
       peerRank_(peerRank),
-      localPairId_(localPairId) {}
+      localPairId_(localPairId),
+      dataPath_(loop->hasDataPath()) {}
 
 Pair::~Pair() {
   close();
@@ -371,7 +372,15 @@ void Pair::assumeConnected(int fd, const ConnKeys& keys,
       epollMask_ = EPOLLIN;
       everConnected_.store(true);
       state_.store(State::kConnected);
-      loop_->add(fd, EPOLLIN, this);
+      if (dataPath_) {
+        // Submission mode: no readiness poll; register for completions
+        // and post the first header recv. Safe off the loop thread: no
+        // op is outstanding yet, so the rx cursors are quiescent.
+        loop_->addData(fd, this);
+        maybePostRecvLocked();
+      } else {
+        loop_->add(fd, EPOLLIN, this);
+      }
       accepted = true;
     }
   }
@@ -494,8 +503,12 @@ int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
   std::lock_guard<std::mutex> guard(mu_);
   int removed = 0;
   for (auto it = tx_.begin(); it != tx_.end();) {
+    // txInFlight_: a submitted SQE references the front op's memory even
+    // before any byte is confirmed — it must not be freed under the
+    // kernel.
     const bool started =
-        it == tx_.begin() && (it->headerSent > 0 || it->headerSealed);
+        it == tx_.begin() &&
+        (it->headerSent > 0 || it->headerSealed || txInFlight_);
     if (it->ubuf == ubuf && !started) {
       it = tx_.erase(it);
       removed++;
@@ -534,14 +547,11 @@ void Pair::queueCtrl(Opcode opcode) { ctrlQ_.push_back(opcode); }
 bool Pair::flushCtrl() {
   while (true) {
     if (ctrlSent_ < ctrlLen_) {
-      ssize_t n = ::send(fd_, ctrlBuf_ + ctrlSent_, ctrlLen_ - ctrlSent_,
-                         MSG_NOSIGNAL);
+      iovec iov{ctrlBuf_ + ctrlSent_, ctrlLen_ - ctrlSent_};
+      ssize_t n = txWrite(TxSite::kCtrl, &iov, 1);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           return false;
-        }
-        if (errno == EINTR) {
-          continue;
         }
         pendingTxError_ = errnoString("send");
         return false;
@@ -572,16 +582,14 @@ bool Pair::flushCtrl() {
 Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
                                       std::vector<UnboundBuffer*>* completed) {
   // Sends a small header's bytes; returns kDone / kSocketFull / kError.
-  auto pushBytes = [&](const char* base, size_t len,
+  auto pushBytes = [&](TxSite site, const char* base, size_t len,
                        size_t* sent) -> ShmTxStatus {
     while (*sent < len) {
-      ssize_t n = ::send(fd_, base + *sent, len - *sent, MSG_NOSIGNAL);
+      iovec iov{const_cast<char*>(base) + *sent, len - *sent};
+      ssize_t n = txWrite(site, &iov, 1);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           return ShmTxStatus::kSocketFull;
-        }
-        if (errno == EINTR) {
-          continue;
         }
         pendingTxError_ = errnoString("send");
         return ShmTxStatus::kError;
@@ -597,9 +605,11 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
       if (!op->headerSealed) {
         sealHeaderFrame(op);
       }
-      st = pushBytes(op->cipher.data(), op->cipher.size(), &op->cipherSent);
+      st = pushBytes(TxSite::kFrontCipher, op->cipher.data(),
+                     op->cipher.size(), &op->cipherSent);
     } else {
-      st = pushBytes(reinterpret_cast<const char*>(&op->header),
+      st = pushBytes(TxSite::kFrontHeader,
+                     reinterpret_cast<const char*>(&op->header),
                      sizeof(WireHeader), &op->headerSent);
     }
     if (st != ShmTxStatus::kDone) {
@@ -617,10 +627,11 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
     if (op->chunkInFlight) {
       ShmTxStatus st;
       if (keys_.encrypted) {
-        st = pushBytes(op->cipher.data(), op->cipher.size(),
-                       &op->cipherSent);
+        st = pushBytes(TxSite::kFrontCipher, op->cipher.data(),
+                       op->cipher.size(), &op->cipherSent);
       } else {
-        st = pushBytes(reinterpret_cast<const char*>(&op->chunkHeader),
+        st = pushBytes(TxSite::kFrontChunkHeader,
+                       reinterpret_cast<const char*>(&op->chunkHeader),
                        sizeof(WireHeader), &op->chunkHeaderSent);
       }
       if (st != ShmTxStatus::kDone) {
@@ -705,14 +716,12 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
           continue;
         }
       }
-      ssize_t n = ::send(fd_, op.cipher.data() + op.cipherSent,
-                         op.cipher.size() - op.cipherSent, MSG_NOSIGNAL);
+      iovec civ{op.cipher.data() + op.cipherSent,
+                op.cipher.size() - op.cipherSent};
+      ssize_t n = txWrite(TxSite::kFrontCipher, &civ, 1);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           break;
-        }
-        if (errno == EINTR) {
-          continue;
         }
         pendingTxError_ = errnoString("send");
         return;
@@ -740,18 +749,11 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
     }
     ssize_t n = 0;
     if (iovcnt > 0) {
-      msghdr msg{};
-      msg.msg_iov = iov;
-      msg.msg_iovlen = iovcnt;
-      // MSG_NOSIGNAL: broken pipes become errors, never SIGPIPE.
-      n = sendmsg(fd_, &msg, MSG_NOSIGNAL);
+      n = txWrite(TxSite::kFrontPlain, iov, iovcnt);
     }
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
         break;
-      }
-      if (errno == EINTR) {
-        continue;
       }
       pendingTxError_ = errnoString("send");
       return;
@@ -791,7 +793,72 @@ void Pair::sealPayloadFrame(TxOp* op) {
   op->sealOffset += chunk;
 }
 
+// The socket-write primitive behind every flush site (see pair.h).
+ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
+  if (!dataPath_) {
+    for (;;) {
+      ssize_t n;
+      if (iovcnt == 1) {
+        n = ::send(fd_, iov[0].iov_base, iov[0].iov_len, MSG_NOSIGNAL);
+      } else {
+        msghdr msg{};
+        msg.msg_iov = const_cast<iovec*>(iov);
+        msg.msg_iovlen = static_cast<size_t>(iovcnt);
+        // MSG_NOSIGNAL: broken pipes become errors, never SIGPIPE.
+        n = sendmsg(fd_, &msg, MSG_NOSIGNAL);
+      }
+      if (n < 0 && errno == EINTR) {
+        continue;
+      }
+      return n;
+    }
+  }
+  // Data path: one sendmsg SQE in flight at a time. Reporting EAGAIN
+  // makes every flush function stop exactly as on a full socket; the
+  // completion advances the cursors (txAdvanceInFlight) and re-runs it.
+  if (txInFlight_) {
+    errno = EAGAIN;
+    return -1;
+  }
+  loop_->asyncSend(fd_, iov, iovcnt);
+  txInFlight_ = true;
+  txSite_ = site;
+  errno = EAGAIN;
+  return -1;
+}
+
+void Pair::txAdvanceInFlight(size_t n) {
+  switch (txSite_) {
+    case TxSite::kCtrl:
+      ctrlSent_ += n;
+      return;
+    case TxSite::kFrontHeader:
+      tx_.front().headerSent += n;
+      return;
+    case TxSite::kFrontChunkHeader:
+      tx_.front().chunkHeaderSent += n;
+      return;
+    case TxSite::kFrontCipher:
+      tx_.front().cipherSent += n;
+      return;
+    case TxSite::kFrontPlain: {
+      // The synchronous path's header/data split arithmetic.
+      TxOp& op = tx_.front();
+      size_t adv = n;
+      const size_t take =
+          std::min(adv, sizeof(WireHeader) - op.headerSent);
+      op.headerSent += take;
+      adv -= take;
+      op.dataSent += adv;
+      return;
+    }
+  }
+}
+
 void Pair::updateEpollMask() {
+  if (dataPath_) {
+    return;  // submissions replace readiness; nothing to arm
+  }
   if (fd_ < 0 || state_.load() != State::kConnected) {
     return;
   }
@@ -842,6 +909,539 @@ void Pair::handleEvents(uint32_t events) {
   }
 }
 
+Pair::RxWant Pair::rxWant() {
+  if (!rxInPayload_) {
+    const bool enc = keys_.encrypted;
+    const size_t hdrWant =
+        enc ? sizeof(rxHeaderCipher_) : sizeof(WireHeader);
+    char* hp = enc ? reinterpret_cast<char*>(rxHeaderCipher_)
+                   : reinterpret_cast<char*>(&rxHeader_);
+    return {hp + rxHeaderRead_, hdrWant - rxHeaderRead_};
+  }
+  // Encrypted connections append a 16-byte tag after each payload frame's
+  // ciphertext; the ciphertext itself lands in the final destination
+  // (user memory or stash) and is decrypted in place once complete. The
+  // destination is surfaced to the application only after the tag
+  // verifies, so a tamperer can at worst poison the pair.
+  const bool enc = keys_.encrypted;
+  const size_t frameLen =
+      enc ? std::min(kEncFrameBytes, rxHeader_.nbytes - rxPlainDone_)
+          : rxHeader_.nbytes;
+  const size_t frameTotal = frameLen + (enc ? kAeadTagBytes : 0);
+  if (rxPayloadRead_ < frameLen) {
+    return {rxDest_ + rxPlainDone_ + rxPayloadRead_,
+            frameLen - rxPayloadRead_};
+  }
+  return {reinterpret_cast<char*>(rxPayloadTag_) +
+              (rxPayloadRead_ - frameLen),
+          frameTotal - rxPayloadRead_};
+}
+
+void Pair::onRxEof() {
+  if (rxInPayload_) {
+    fail(detail::strCat("connection to rank ", peerRank_,
+                        " closed mid-message"));
+    return;
+  }
+  bool orderly;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    orderly = peerGoodbye_;
+  }
+  if (orderly) {
+    teardown(State::kClosed,
+             detail::strCat("rank ", peerRank_, " left the group"),
+             /*notifyContext=*/true);
+  } else {
+    fail(detail::strCat("connection to rank ", peerRank_,
+                        " closed by peer unexpectedly"));
+  }
+}
+
+Pair::RxStep Pair::processRxBytes(size_t n, size_t* consumed) {
+  if (!rxInPayload_) {
+    const bool enc = keys_.encrypted;
+    const size_t hdrWant =
+        enc ? sizeof(rxHeaderCipher_) : sizeof(WireHeader);
+    rxHeaderRead_ += n;
+    *consumed += n;
+    if (rxHeaderRead_ < hdrWant) {
+      return RxStep::kMore;
+    }
+    return processHeader(consumed);
+  }
+  const bool enc = keys_.encrypted;
+  const size_t frameLen =
+      enc ? std::min(kEncFrameBytes, rxHeader_.nbytes - rxPlainDone_)
+          : rxHeader_.nbytes;
+  const size_t frameTotal = frameLen + (enc ? kAeadTagBytes : 0);
+  rxPayloadRead_ += n;
+  *consumed += n;
+  if (rxPayloadRead_ == frameTotal) {
+    if (enc) {
+      if (!aeadOpen(keys_.rx, rxSeq_++, nullptr, 0,
+                    reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
+                    frameLen,
+                    reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
+                    rxPayloadTag_)) {
+        fail(detail::strCat("message authentication failed from rank ",
+                            peerRank_));
+        return RxStep::kStop;
+      }
+      rxPlainDone_ += frameLen;
+      rxPayloadRead_ = 0;
+      if (rxPlainDone_ < rxHeader_.nbytes) {
+        return RxStep::kMore;  // more frames of this message
+      }
+    }
+    finishMessage();
+  }
+  return RxStep::kMore;
+}
+
+// Header complete: decrypt/validate it and dispatch on the opcode. This
+// is the former readLoop dispatch block, shared verbatim by both engines
+// (kMore == the old `continue`, kStop == the old `return`).
+Pair::RxStep Pair::processHeader(size_t* consumed) {
+  const bool enc = keys_.encrypted;
+  if (enc && !aeadOpen(keys_.rx, rxSeq_++, nullptr, 0, rxHeaderCipher_,
+                       sizeof(WireHeader),
+                       reinterpret_cast<uint8_t*>(&rxHeader_),
+                       rxHeaderCipher_ + sizeof(WireHeader))) {
+    fail(detail::strCat("message authentication failed from rank ",
+                        peerRank_));
+    return RxStep::kStop;
+  }
+  if (rxHeader_.magic != kMsgMagic) {
+    fail(detail::strCat("protocol violation from rank ", peerRank_));
+    return RxStep::kStop;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGoodbye)) {
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      peerGoodbye_ = true;
+    }
+    cv_.notify_all();
+    rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  // ---- shared-memory payload plane ----
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit) ||
+      rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCreditReq)) {
+    const bool isGrant =
+        rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit);
+    std::vector<UnboundBuffer*> completed;
+    std::string txError;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      if (isGrant) {
+        txRingBlocked_ = false;
+        if (!tx_.empty() && tx_.front().viaShm) {
+          tx_.front().creditReqSent = false;
+        }
+      } else {
+        queueCtrl(Opcode::kShmCredit);
+      }
+      flushTx(&completed);
+      if (state_.load() == State::kConnected) {
+        updateEpollMask();
+      }
+      txError = pendingTxError_;
+      pendingTxError_.clear();
+    }
+    cv_.notify_all();
+    for (auto* b : completed) {
+      if (b != nullptr) {
+        b->onSendComplete();
+      }
+    }
+    if (!txError.empty()) {
+      fail(txError);
+      return RxStep::kStop;
+    }
+    rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  if (shmRxActive_ &&
+      rxHeader_.opcode != static_cast<uint8_t>(Opcode::kShmChunk)) {
+    // The sender's FIFO guarantees chunk announcements are contiguous;
+    // anything else mid-message is a protocol violation.
+    fail(detail::strCat("message interleaved with shm chunks from rank ",
+                        peerRank_));
+    return RxStep::kStop;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmData) ||
+      rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
+    if (!shmActive_.load(std::memory_order_relaxed)) {
+      fail(detail::strCat("shm message without a negotiated segment "
+                          "from rank ", peerRank_));
+      return RxStep::kStop;
+    }
+    const size_t nbytes = rxHeader_.nbytes;
+    if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
+      if (nbytes == 0) {
+        if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
+                                   nullptr, 0,
+                                   rxHeader_.flags & kPutFlagNotify,
+                                   peerRank_)) {
+          fail(detail::strCat("one-sided put outside registered region "
+                              "from rank ", peerRank_));
+          return RxStep::kStop;
+        }
+        rxHeaderRead_ = 0;
+        return RxStep::kMore;
+      }
+      shmRxActive_ = true;
+      shmRxHeader_ = rxHeader_;
+      shmRxTotal_ = nbytes;
+      shmRxDone_ = 0;
+      shmRxMode_ = RxMode::kPut;
+      shmRxDest_ = nullptr;
+      rxHeaderRead_ = 0;
+      return RxStep::kMore;
+    }
+    Context::Match match;
+    try {
+      match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
+    } catch (const std::exception& e) {
+      fail(detail::strCat("receive matching failed: ", e.what()));
+      return RxStep::kStop;
+    }
+    if (nbytes == 0) {
+      if (match.direct) {
+        match.ubuf->onRecvComplete(peerRank_);
+      } else {
+        context_->stashArrived(peerRank_, rxHeader_.slot, {});
+      }
+      rxHeaderRead_ = 0;
+      return RxStep::kMore;
+    }
+    shmRxActive_ = true;
+    shmRxHeader_ = rxHeader_;
+    shmRxTotal_ = nbytes;
+    shmRxDone_ = 0;
+    if (match.direct) {
+      shmRxMode_ = RxMode::kDirect;
+      shmRxDest_ = match.dest;
+      shmRxCombine_ = match.combine;
+      shmRxCombineElsize_ = match.combineElsize;
+      shmRxCombineAccElsize_ = match.combineAccElsize;
+      shmRxCarryLen_ = 0;
+      std::lock_guard<std::mutex> guard(mu_);
+      rxUbuf_ = match.ubuf;
+    } else {
+      shmRxMode_ = RxMode::kStash;
+      shmRxStash_.resize(nbytes);
+      shmRxDest_ = shmRxStash_.data();
+      shmRxCombine_ = nullptr;
+    }
+    rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmChunk)) {
+    if (!shmRxActive_) {
+      fail(detail::strCat("shm chunk without an announced message "
+                          "from rank ", peerRank_));
+      return RxStep::kStop;
+    }
+    const uint64_t chunk = rxHeader_.nbytes;
+    if (chunk == 0 || chunk > shmRxTotal_ - shmRxDone_ ||
+        chunk > shmRx_.usedBytes()) {
+      fail(detail::strCat("shm chunk exceeds announced message or ring "
+                          "contents from rank ", peerRank_));
+      return RxStep::kStop;
+    }
+    bool ok = true;
+    if (shmRxMode_ == RxMode::kPut) {
+      // Ring spans land straight in the registered region (validated
+      // per span under the context lock) — no staging copy.
+      const uint64_t base = shmRxHeader_.aux + shmRxDone_;
+      ok = shmRx_.consume(
+          chunk, [&](const char* p, uint64_t len, uint64_t off) {
+            return context_->writeRegion(shmRxHeader_.slot, base + off,
+                                         p, len, false, peerRank_);
+          });
+    } else if (shmRxCombine_ != nullptr) {
+      // Fused receive-reduce: fold ring spans into the destination in
+      // place of the staging memcpy — the payload is touched exactly
+      // once on this side.
+      const uint64_t base = shmRxDone_;
+      shmRx_.consume(chunk,
+                     [&](const char* p, uint64_t len, uint64_t off) {
+                       combineShmSpan(base + off, p, len);
+                       return true;
+                     });
+    } else {
+      char* dst = shmRxDest_ + shmRxDone_;
+      shmRx_.consume(chunk,
+                     [&](const char* p, uint64_t len, uint64_t off) {
+                       std::memcpy(dst + off, p, len);
+                       return true;
+                     });
+    }
+    if (!ok) {
+      fail(detail::strCat("one-sided put outside registered region "
+                          "from rank ", peerRank_));
+      return RxStep::kStop;
+    }
+    shmRxDone_ += chunk;
+    shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
+    consumed += chunk;
+    // Eager credit after draining a big chunk: the sender throttles on
+    // ring space, and this lets it refill while we keep consuming.
+    if (chunk * 8 >= shmRx_.cap) {
+      std::vector<UnboundBuffer*> completed;
+      std::string txError;
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        queueCtrl(Opcode::kShmCredit);
+        flushTx(&completed);
+        if (state_.load() == State::kConnected) {
+          updateEpollMask();
+        }
+        txError = pendingTxError_;
+        pendingTxError_.clear();
+      }
+      cv_.notify_all();  // close() may be waiting on tx_ draining
+      for (auto* b : completed) {
+        if (b != nullptr) {
+          b->onSendComplete();
+        }
+      }
+      if (!txError.empty()) {
+        fail(txError);
+        return RxStep::kStop;
+      }
+    }
+    if (shmRxDone_ == shmRxTotal_) {
+      shmRxActive_ = false;
+      shmRxCombine_ = nullptr;  // carry is empty: nbytes % elsize == 0
+      switch (shmRxMode_) {
+        case RxMode::kDirect: {
+          UnboundBuffer* b = nullptr;
+          {
+            std::lock_guard<std::mutex> guard(mu_);
+            b = rxUbuf_;
+            rxUbuf_ = nullptr;
+          }
+          if (b != nullptr) {
+            b->onRecvComplete(peerRank_);
+          }
+          break;
+        }
+        case RxMode::kStash:
+          try {
+            context_->stashArrived(peerRank_, shmRxHeader_.slot,
+                                   std::move(shmRxStash_));
+          } catch (const std::exception& e) {
+            fail(detail::strCat("receive matching failed: ", e.what()));
+            return RxStep::kStop;
+          }
+          shmRxStash_ = std::vector<char>();
+          break;
+        case RxMode::kPut:
+          if (shmRxHeader_.flags & kPutFlagNotify) {
+            // Zero-byte notify write: completes the exporting buffer's
+            // waitRecv now that every chunk has landed.
+            if (!context_->writeRegion(shmRxHeader_.slot,
+                                       shmRxHeader_.aux, nullptr, 0,
+                                       true, peerRank_)) {
+              fail(detail::strCat("one-sided put outside registered "
+                                  "region from rank ", peerRank_));
+              return RxStep::kStop;
+            }
+          }
+          break;
+        default:
+          break;
+      }
+    }
+    rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kPut)) {
+    // One-sided write: payload staged then copied into the registered
+    // region under the context lock (re-validated there, so a region
+    // torn down mid-flight cannot be scribbled on).
+    const size_t nbytes = rxHeader_.nbytes;
+    if (nbytes == 0) {
+      // Zero-byte puts still validate the token/offset: the same
+      // contract violation must not pass or fail based on length.
+      if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
+                                 nullptr, 0,
+                                 rxHeader_.flags & kPutFlagNotify,
+                                 peerRank_)) {
+        fail(detail::strCat("one-sided put outside registered region "
+                            "from rank ", peerRank_));
+        return RxStep::kStop;
+      }
+      rxHeaderRead_ = 0;
+      return RxStep::kMore;
+    }
+    rxInPayload_ = true;
+    rxPayloadRead_ = 0;
+    rxPlainDone_ = 0;
+    rxMode_ = RxMode::kPut;
+    rxStashData_.resize(nbytes);
+    rxDest_ = rxStashData_.data();
+    return RxStep::kMore;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGetReq)) {
+    if (rxHeader_.nbytes != sizeof(WireGetReq)) {
+      fail(detail::strCat("malformed get request from rank ",
+                          peerRank_));
+      return RxStep::kStop;
+    }
+    rxInPayload_ = true;
+    rxPayloadRead_ = 0;
+    rxPlainDone_ = 0;
+    rxMode_ = RxMode::kGetReq;
+    rxStashData_.resize(sizeof(WireGetReq));
+    rxDest_ = rxStashData_.data();
+    return RxStep::kMore;
+  }
+  if (rxHeader_.opcode != static_cast<uint8_t>(Opcode::kData)) {
+    fail(detail::strCat("protocol violation from rank ", peerRank_));
+    return RxStep::kStop;
+  }
+  const size_t nbytes = rxHeader_.nbytes;
+  Context::Match match;
+  try {
+    match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
+  } catch (const std::exception& e) {
+    // e.g. posted-size mismatch: an application-level contract violation
+    // (inconsistent counts across ranks). Poison this pair instead of
+    // unwinding through the event loop.
+    fail(detail::strCat("receive matching failed: ", e.what()));
+    return RxStep::kStop;
+  }
+  if (nbytes == 0) {
+    if (match.direct) {
+      match.ubuf->onRecvComplete(peerRank_);
+    } else {
+      context_->stashArrived(peerRank_, rxHeader_.slot, {});
+    }
+    rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  rxInPayload_ = true;
+  rxPayloadRead_ = 0;
+  rxPlainDone_ = 0;
+  if (match.direct) {
+    rxMode_ = RxMode::kDirect;
+    rxCombine_ = match.combine;
+    rxCombineElsize_ = match.combineElsize;
+    if (match.combine != nullptr) {
+      // recvReduce over the byte stream: partial reads (and in-place
+      // ciphertext) must never touch the accumulator, so the payload
+      // stages first and is folded in at completion.
+      rxFinalDest_ = match.dest;
+      if (rxCombineStage_.size() < nbytes) {
+        rxCombineStage_.resize(nbytes);
+      }
+      rxDest_ = rxCombineStage_.data();
+    } else {
+      rxDest_ = match.dest;
+    }
+    std::lock_guard<std::mutex> guard(mu_);
+    rxUbuf_ = match.ubuf;
+  } else {
+    rxMode_ = RxMode::kStash;
+    rxStashData_.resize(nbytes);
+    rxDest_ = rxStashData_.data();
+  }
+  return RxStep::kMore;
+}
+
+void Pair::maybePostRecv() {
+  std::lock_guard<std::mutex> guard(mu_);
+  maybePostRecvLocked();
+}
+
+void Pair::maybePostRecvLocked() {
+  if (!dataPath_ || rxPosted_ || fd_ < 0 ||
+      state_.load() != State::kConnected) {
+    return;
+  }
+  if (rxPaused_ && !rxInPayload_) {
+    return;  // boundary pause; resumeReading reposts
+  }
+  RxWant w = rxWant();
+  loop_->asyncRecv(fd_, w.ptr, w.len);
+  rxPosted_ = true;
+}
+
+void Pair::handleIoComplete(bool isRecv, int32_t res) {
+  if (isRecv) {
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      rxPosted_ = false;
+    }
+    if (state_.load() != State::kConnected) {
+      return;
+    }
+    if (res == 0) {
+      onRxEof();
+      return;
+    }
+    if (res < 0) {
+      if (res == -EAGAIN || res == -EINTR) {
+        maybePostRecv();  // spurious wake on a pre-5.7 kernel; repost
+        return;
+      }
+      if (res == -ECANCELED) {
+        return;  // teardown owns the wind-down
+      }
+      errno = -res;
+      fail(errnoString("recv"));
+      return;
+    }
+    size_t consumed = 0;
+    if (processRxBytes(static_cast<size_t>(res), &consumed) ==
+        RxStep::kStop) {
+      return;
+    }
+    maybePostRecv();
+    return;
+  }
+
+  // Send completion: apply the confirmed byte count to the in-flight
+  // site's cursors, then resume the flush — the submission-mode mirror
+  // of handleEvents' EPOLLOUT arm.
+  std::vector<UnboundBuffer*> completed;
+  std::string txError;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    txInFlight_ = false;
+    if (state_.load() != State::kConnected) {
+      return;
+    }
+    if (res < 0) {
+      if (res != -EAGAIN && res != -EINTR && res != -ECANCELED) {
+        errno = -res;
+        pendingTxError_ = errnoString("send");
+      }
+      // -EAGAIN/-EINTR: zero progress; flushTx resubmits the same bytes.
+    } else {
+      txAdvanceInFlight(static_cast<size_t>(res));
+    }
+    if (res != -ECANCELED && pendingTxError_.empty()) {
+      flushTx(&completed);
+    }
+    txError = pendingTxError_;
+    pendingTxError_.clear();
+  }
+  cv_.notify_all();  // close() may be waiting for the tx queue to drain
+  for (auto* b : completed) {
+    if (b != nullptr) {
+      b->onSendComplete();
+    }
+  }
+  if (!txError.empty()) {
+    fail(txError);
+  }
+}
+
 void Pair::readLoop() {
   // Fairness/backpressure budget: a sender that keeps the socket full
   // could otherwise pin the loop thread in this loop forever (EAGAIN
@@ -862,448 +1462,25 @@ void Pair::readLoop() {
         return;
       }
     }
-    if (!rxInPayload_) {
-      const bool enc = keys_.encrypted;
-      const size_t hdrWant =
-          enc ? sizeof(rxHeaderCipher_) : sizeof(WireHeader);
-      char* hp = enc ? reinterpret_cast<char*>(rxHeaderCipher_)
-                     : reinterpret_cast<char*>(&rxHeader_);
-      ssize_t n = read(fd_, hp + rxHeaderRead_, hdrWant - rxHeaderRead_);
-      if (n == 0) {
-        bool orderly;
-        {
-          std::lock_guard<std::mutex> guard(mu_);
-          orderly = peerGoodbye_;
-        }
-        if (orderly) {
-          teardown(State::kClosed,
-                   detail::strCat("rank ", peerRank_, " left the group"),
-                   /*notifyContext=*/true);
-        } else {
-          fail(detail::strCat("connection to rank ", peerRank_,
-                              " closed by peer unexpectedly"));
-        }
+    RxWant w = rxWant();
+    ssize_t n = read(fd_, w.ptr, w.len);
+    if (n == 0) {
+      onRxEof();
+      return;
+    }
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
         return;
       }
-      if (n < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-          return;
-        }
-        if (errno == EINTR) {
-          continue;
-        }
-        fail(errnoString("recv"));
-        return;
-      }
-      rxHeaderRead_ += static_cast<size_t>(n);
-      consumed += static_cast<size_t>(n);
-      if (rxHeaderRead_ < hdrWant) {
+      if (errno == EINTR) {
         continue;
       }
-      if (enc && !aeadOpen(keys_.rx, rxSeq_++, nullptr, 0, rxHeaderCipher_,
-                           sizeof(WireHeader),
-                           reinterpret_cast<uint8_t*>(&rxHeader_),
-                           rxHeaderCipher_ + sizeof(WireHeader))) {
-        fail(detail::strCat("message authentication failed from rank ",
-                            peerRank_));
-        return;
-      }
-      if (rxHeader_.magic != kMsgMagic) {
-        fail(detail::strCat("protocol violation from rank ", peerRank_));
-        return;
-      }
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGoodbye)) {
-        {
-          std::lock_guard<std::mutex> guard(mu_);
-          peerGoodbye_ = true;
-        }
-        cv_.notify_all();
-        rxHeaderRead_ = 0;
-        continue;
-      }
-      // ---- shared-memory payload plane ----
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit) ||
-          rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCreditReq)) {
-        const bool isGrant =
-            rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit);
-        std::vector<UnboundBuffer*> completed;
-        std::string txError;
-        {
-          std::lock_guard<std::mutex> guard(mu_);
-          if (isGrant) {
-            txRingBlocked_ = false;
-            if (!tx_.empty() && tx_.front().viaShm) {
-              tx_.front().creditReqSent = false;
-            }
-          } else {
-            queueCtrl(Opcode::kShmCredit);
-          }
-          flushTx(&completed);
-          if (state_.load() == State::kConnected) {
-            updateEpollMask();
-          }
-          txError = pendingTxError_;
-          pendingTxError_.clear();
-        }
-        cv_.notify_all();
-        for (auto* b : completed) {
-          if (b != nullptr) {
-            b->onSendComplete();
-          }
-        }
-        if (!txError.empty()) {
-          fail(txError);
-          return;
-        }
-        rxHeaderRead_ = 0;
-        continue;
-      }
-      if (shmRxActive_ &&
-          rxHeader_.opcode != static_cast<uint8_t>(Opcode::kShmChunk)) {
-        // The sender's FIFO guarantees chunk announcements are contiguous;
-        // anything else mid-message is a protocol violation.
-        fail(detail::strCat("message interleaved with shm chunks from rank ",
-                            peerRank_));
-        return;
-      }
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmData) ||
-          rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
-        if (!shmActive_.load(std::memory_order_relaxed)) {
-          fail(detail::strCat("shm message without a negotiated segment "
-                              "from rank ", peerRank_));
-          return;
-        }
-        const size_t nbytes = rxHeader_.nbytes;
-        if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmPut)) {
-          if (nbytes == 0) {
-            if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
-                                       nullptr, 0,
-                                       rxHeader_.flags & kPutFlagNotify,
-                                       peerRank_)) {
-              fail(detail::strCat("one-sided put outside registered region "
-                                  "from rank ", peerRank_));
-              return;
-            }
-            rxHeaderRead_ = 0;
-            continue;
-          }
-          shmRxActive_ = true;
-          shmRxHeader_ = rxHeader_;
-          shmRxTotal_ = nbytes;
-          shmRxDone_ = 0;
-          shmRxMode_ = RxMode::kPut;
-          shmRxDest_ = nullptr;
-          rxHeaderRead_ = 0;
-          continue;
-        }
-        Context::Match match;
-        try {
-          match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
-        } catch (const std::exception& e) {
-          fail(detail::strCat("receive matching failed: ", e.what()));
-          return;
-        }
-        if (nbytes == 0) {
-          if (match.direct) {
-            match.ubuf->onRecvComplete(peerRank_);
-          } else {
-            context_->stashArrived(peerRank_, rxHeader_.slot, {});
-          }
-          rxHeaderRead_ = 0;
-          continue;
-        }
-        shmRxActive_ = true;
-        shmRxHeader_ = rxHeader_;
-        shmRxTotal_ = nbytes;
-        shmRxDone_ = 0;
-        if (match.direct) {
-          shmRxMode_ = RxMode::kDirect;
-          shmRxDest_ = match.dest;
-          shmRxCombine_ = match.combine;
-          shmRxCombineElsize_ = match.combineElsize;
-          shmRxCombineAccElsize_ = match.combineAccElsize;
-          shmRxCarryLen_ = 0;
-          std::lock_guard<std::mutex> guard(mu_);
-          rxUbuf_ = match.ubuf;
-        } else {
-          shmRxMode_ = RxMode::kStash;
-          shmRxStash_.resize(nbytes);
-          shmRxDest_ = shmRxStash_.data();
-          shmRxCombine_ = nullptr;
-        }
-        rxHeaderRead_ = 0;
-        continue;
-      }
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmChunk)) {
-        if (!shmRxActive_) {
-          fail(detail::strCat("shm chunk without an announced message "
-                              "from rank ", peerRank_));
-          return;
-        }
-        const uint64_t chunk = rxHeader_.nbytes;
-        if (chunk == 0 || chunk > shmRxTotal_ - shmRxDone_ ||
-            chunk > shmRx_.usedBytes()) {
-          fail(detail::strCat("shm chunk exceeds announced message or ring "
-                              "contents from rank ", peerRank_));
-          return;
-        }
-        bool ok = true;
-        if (shmRxMode_ == RxMode::kPut) {
-          // Ring spans land straight in the registered region (validated
-          // per span under the context lock) — no staging copy.
-          const uint64_t base = shmRxHeader_.aux + shmRxDone_;
-          ok = shmRx_.consume(
-              chunk, [&](const char* p, uint64_t len, uint64_t off) {
-                return context_->writeRegion(shmRxHeader_.slot, base + off,
-                                             p, len, false, peerRank_);
-              });
-        } else if (shmRxCombine_ != nullptr) {
-          // Fused receive-reduce: fold ring spans into the destination in
-          // place of the staging memcpy — the payload is touched exactly
-          // once on this side.
-          const uint64_t base = shmRxDone_;
-          shmRx_.consume(chunk,
-                         [&](const char* p, uint64_t len, uint64_t off) {
-                           combineShmSpan(base + off, p, len);
-                           return true;
-                         });
-        } else {
-          char* dst = shmRxDest_ + shmRxDone_;
-          shmRx_.consume(chunk,
-                         [&](const char* p, uint64_t len, uint64_t off) {
-                           std::memcpy(dst + off, p, len);
-                           return true;
-                         });
-        }
-        if (!ok) {
-          fail(detail::strCat("one-sided put outside registered region "
-                              "from rank ", peerRank_));
-          return;
-        }
-        shmRxDone_ += chunk;
-        shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
-        consumed += chunk;
-        // Eager credit after draining a big chunk: the sender throttles on
-        // ring space, and this lets it refill while we keep consuming.
-        if (chunk * 8 >= shmRx_.cap) {
-          std::vector<UnboundBuffer*> completed;
-          std::string txError;
-          {
-            std::lock_guard<std::mutex> guard(mu_);
-            queueCtrl(Opcode::kShmCredit);
-            flushTx(&completed);
-            if (state_.load() == State::kConnected) {
-              updateEpollMask();
-            }
-            txError = pendingTxError_;
-            pendingTxError_.clear();
-          }
-          cv_.notify_all();  // close() may be waiting on tx_ draining
-          for (auto* b : completed) {
-            if (b != nullptr) {
-              b->onSendComplete();
-            }
-          }
-          if (!txError.empty()) {
-            fail(txError);
-            return;
-          }
-        }
-        if (shmRxDone_ == shmRxTotal_) {
-          shmRxActive_ = false;
-          shmRxCombine_ = nullptr;  // carry is empty: nbytes % elsize == 0
-          switch (shmRxMode_) {
-            case RxMode::kDirect: {
-              UnboundBuffer* b = nullptr;
-              {
-                std::lock_guard<std::mutex> guard(mu_);
-                b = rxUbuf_;
-                rxUbuf_ = nullptr;
-              }
-              if (b != nullptr) {
-                b->onRecvComplete(peerRank_);
-              }
-              break;
-            }
-            case RxMode::kStash:
-              try {
-                context_->stashArrived(peerRank_, shmRxHeader_.slot,
-                                       std::move(shmRxStash_));
-              } catch (const std::exception& e) {
-                fail(detail::strCat("receive matching failed: ", e.what()));
-                return;
-              }
-              shmRxStash_ = std::vector<char>();
-              break;
-            case RxMode::kPut:
-              if (shmRxHeader_.flags & kPutFlagNotify) {
-                // Zero-byte notify write: completes the exporting buffer's
-                // waitRecv now that every chunk has landed.
-                if (!context_->writeRegion(shmRxHeader_.slot,
-                                           shmRxHeader_.aux, nullptr, 0,
-                                           true, peerRank_)) {
-                  fail(detail::strCat("one-sided put outside registered "
-                                      "region from rank ", peerRank_));
-                  return;
-                }
-              }
-              break;
-            default:
-              break;
-          }
-        }
-        rxHeaderRead_ = 0;
-        continue;
-      }
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kPut)) {
-        // One-sided write: payload staged then copied into the registered
-        // region under the context lock (re-validated there, so a region
-        // torn down mid-flight cannot be scribbled on).
-        const size_t nbytes = rxHeader_.nbytes;
-        if (nbytes == 0) {
-          // Zero-byte puts still validate the token/offset: the same
-          // contract violation must not pass or fail based on length.
-          if (!context_->writeRegion(rxHeader_.slot, rxHeader_.aux,
-                                     nullptr, 0,
-                                     rxHeader_.flags & kPutFlagNotify,
-                                     peerRank_)) {
-            fail(detail::strCat("one-sided put outside registered region "
-                                "from rank ", peerRank_));
-            return;
-          }
-          rxHeaderRead_ = 0;
-          continue;
-        }
-        rxInPayload_ = true;
-        rxPayloadRead_ = 0;
-        rxPlainDone_ = 0;
-        rxMode_ = RxMode::kPut;
-        rxStashData_.resize(nbytes);
-        rxDest_ = rxStashData_.data();
-        continue;
-      }
-      if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kGetReq)) {
-        if (rxHeader_.nbytes != sizeof(WireGetReq)) {
-          fail(detail::strCat("malformed get request from rank ",
-                              peerRank_));
-          return;
-        }
-        rxInPayload_ = true;
-        rxPayloadRead_ = 0;
-        rxPlainDone_ = 0;
-        rxMode_ = RxMode::kGetReq;
-        rxStashData_.resize(sizeof(WireGetReq));
-        rxDest_ = rxStashData_.data();
-        continue;
-      }
-      if (rxHeader_.opcode != static_cast<uint8_t>(Opcode::kData)) {
-        fail(detail::strCat("protocol violation from rank ", peerRank_));
-        return;
-      }
-      const size_t nbytes = rxHeader_.nbytes;
-      Context::Match match;
-      try {
-        match = context_->matchIncoming(peerRank_, rxHeader_.slot, nbytes);
-      } catch (const std::exception& e) {
-        // e.g. posted-size mismatch: an application-level contract violation
-        // (inconsistent counts across ranks). Poison this pair instead of
-        // unwinding through the event loop.
-        fail(detail::strCat("receive matching failed: ", e.what()));
-        return;
-      }
-      if (nbytes == 0) {
-        if (match.direct) {
-          match.ubuf->onRecvComplete(peerRank_);
-        } else {
-          context_->stashArrived(peerRank_, rxHeader_.slot, {});
-        }
-        rxHeaderRead_ = 0;
-        continue;
-      }
-      rxInPayload_ = true;
-      rxPayloadRead_ = 0;
-      rxPlainDone_ = 0;
-      if (match.direct) {
-        rxMode_ = RxMode::kDirect;
-        rxCombine_ = match.combine;
-        rxCombineElsize_ = match.combineElsize;
-        if (match.combine != nullptr) {
-          // recvReduce over the byte stream: partial reads (and in-place
-          // ciphertext) must never touch the accumulator, so the payload
-          // stages first and is folded in at completion.
-          rxFinalDest_ = match.dest;
-          if (rxCombineStage_.size() < nbytes) {
-            rxCombineStage_.resize(nbytes);
-          }
-          rxDest_ = rxCombineStage_.data();
-        } else {
-          rxDest_ = match.dest;
-        }
-        std::lock_guard<std::mutex> guard(mu_);
-        rxUbuf_ = match.ubuf;
-      } else {
-        rxMode_ = RxMode::kStash;
-        rxStashData_.resize(nbytes);
-        rxDest_ = rxStashData_.data();
-      }
-    } else {
-      // Encrypted connections append a 16-byte tag after the payload
-      // ciphertext; the ciphertext itself lands in the final destination
-      // (user memory or stash) and is decrypted in place once complete.
-      // The destination is surfaced to the application only after the
-      // tag verifies, so a tamperer can at worst poison the pair.
-      const bool enc = keys_.encrypted;
-      const size_t frameLen =
-          enc ? std::min(kEncFrameBytes, rxHeader_.nbytes - rxPlainDone_)
-              : rxHeader_.nbytes;
-      const size_t frameTotal = frameLen + (enc ? kAeadTagBytes : 0);
-      char* dst;
-      size_t want;
-      if (rxPayloadRead_ < frameLen) {
-        dst = rxDest_ + rxPlainDone_ + rxPayloadRead_;
-        want = frameLen - rxPayloadRead_;
-      } else {
-        dst = reinterpret_cast<char*>(rxPayloadTag_) +
-              (rxPayloadRead_ - frameLen);
-        want = frameTotal - rxPayloadRead_;
-      }
-      ssize_t n = read(fd_, dst, want);
-      if (n == 0) {
-        fail(detail::strCat("connection to rank ", peerRank_,
-                            " closed mid-message"));
-        return;
-      }
-      if (n < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-          return;
-        }
-        if (errno == EINTR) {
-          continue;
-        }
-        fail(errnoString("recv"));
-        return;
-      }
-      rxPayloadRead_ += static_cast<size_t>(n);
-      consumed += static_cast<size_t>(n);
-      if (rxPayloadRead_ == frameTotal) {
-        if (enc) {
-          if (!aeadOpen(keys_.rx, rxSeq_++, nullptr, 0,
-                        reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
-                        frameLen,
-                        reinterpret_cast<uint8_t*>(rxDest_ + rxPlainDone_),
-                        rxPayloadTag_)) {
-            fail(detail::strCat(
-                "message authentication failed from rank ", peerRank_));
-            return;
-          }
-          rxPlainDone_ += frameLen;
-          rxPayloadRead_ = 0;
-          if (rxPlainDone_ < rxHeader_.nbytes) {
-            continue;  // more frames of this message
-          }
-        }
-        finishMessage();
-      }
+      fail(errnoString("recv"));
+      return;
+    }
+    if (processRxBytes(static_cast<size_t>(n), &consumed) ==
+        RxStep::kStop) {
+      return;
     }
   }
 }
@@ -1463,6 +1640,10 @@ void Pair::resumeReading() {
   if (rxPaused_) {
     rxPaused_ = false;
     updateEpollMask();
+    // Data path: the pause parked the rx driver at a message boundary
+    // with no recv outstanding, so the cursors are quiescent and posting
+    // from this thread is safe.
+    maybePostRecvLocked();
   }
 }
 
@@ -1526,29 +1707,35 @@ void Pair::teardown(State target, const std::string& message,
     }
     state_.store(target);
     error_ = message;
-    for (auto& op : tx_) {
-      sends.push_back(op.ubuf);
-    }
-    tx_.clear();
-    txRingBlocked_ = false;
-    ctrlQ_.clear();
-    ctrlLen_ = 0;
-    ctrlSent_ = 0;
     fd = fd_;
     fd_ = -1;
-    rxb = rxUbuf_;
-    rxUbuf_ = nullptr;
   }
   cv_.notify_all();
   if (expectedAt_ != nullptr) {
     expectedAt_->unexpect(localPairId_);
   }
   if (fd >= 0) {
-    // del() barriers on the loop tick: after it returns no dispatch touches
-    // this fd or the rx destination memory, so failing the buffers below
-    // cannot race an in-flight read into user memory.
+    // del() barriers on the loop tick AND (data path) cancels + drains
+    // any outstanding recv/send SQEs: after it returns no dispatch — and
+    // no kernel DMA — touches this fd, the tx op buffers, or the rx
+    // destination memory. Only then is it safe to free the tx queue and
+    // fail the buffers below.
     loop_->del(fd);
     ::close(fd);
+  }
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (auto& op : tx_) {
+      sends.push_back(op.ubuf);
+    }
+    tx_.clear();
+    txInFlight_ = false;
+    txRingBlocked_ = false;
+    ctrlQ_.clear();
+    ctrlLen_ = 0;
+    ctrlSent_ = 0;
+    rxb = rxUbuf_;
+    rxUbuf_ = nullptr;
   }
   for (auto* b : sends) {
     if (b != nullptr) {

@@ -89,6 +89,9 @@ class Pair : public Handler {
   void failFromUser(const std::string& message) { fail(message); }
 
   void handleEvents(uint32_t events) override;
+  // Submission data path (uring engine): completion of an asyncRecv/
+  // asyncSend posted by this pair. Loop thread.
+  void handleIoComplete(bool isRecv, int32_t res) override;
 
   // Called by the listener (loop thread) when our inbound connection is up.
   // `keys` carries the connection's AEAD keys on encrypted devices; `shm`
@@ -152,6 +155,47 @@ class Pair : public Handler {
   // Outcome of trying to advance the front shm op (mu_ held).
   enum class ShmTxStatus { kDone, kSocketFull, kRingBlocked, kError };
 
+  // Which tx cursor an in-flight data-path send advances on completion.
+  // Each socket-write site in the flush functions is one site; the
+  // completion replays exactly the cursor arithmetic the synchronous
+  // path would have applied after its send() returned.
+  enum class TxSite : uint8_t {
+    kCtrl,             // ctrlSent_
+    kFrontHeader,      // tx_.front().headerSent (plain shm announce)
+    kFrontChunkHeader, // tx_.front().chunkHeaderSent (plain shm chunk)
+    kFrontCipher,      // tx_.front().cipherSent (any sealed frame)
+    kFrontPlain,       // tx_.front() header+data sendmsg split
+  };
+
+  // The socket-write primitive behind every flush site. Readiness mode:
+  // sendmsg/send directly (EINTR retried; EAGAIN reported). Data-path
+  // mode: submit ONE sendmsg SQE for the iovec (at most one in flight),
+  // record `site`, and report EAGAIN — the flush stops exactly as if
+  // the socket were full, and the completion advances the cursors and
+  // re-runs it. mu_ held.
+  ssize_t txWrite(TxSite site, const iovec* iov, int iovcnt);
+  // Apply `n` sent bytes to the cursors of the in-flight site (mu_ held).
+  void txAdvanceInFlight(size_t n);
+
+  // Data-path rx driver (loop thread unless noted).
+  struct RxWant {
+    char* ptr;
+    size_t len;
+  };
+  RxWant rxWant();  // next bytes the rx state machine needs
+  enum class RxStep { kMore, kStop };
+  // Post-read processing shared by readLoop (readiness) and
+  // handleIoComplete (data path): advance the state machine by n
+  // received bytes.
+  RxStep processRxBytes(size_t n, size_t* consumed);
+  RxStep processHeader(size_t* consumed);  // header complete: dispatch
+  void onRxEof();                          // peer closed (read returned 0)
+  // Post the next recv if connected, unposted, and not paused at a
+  // message boundary. Safe from any thread when no recv is outstanding
+  // (mu_ serializes the rxPosted_ flip; rx cursors are quiescent then).
+  void maybePostRecv();
+  void maybePostRecvLocked();
+
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
   // ops' buffers are appended to `completed` (callbacks run without mu_).
   void flushTx(std::vector<UnboundBuffer*>* completed);
@@ -189,6 +233,9 @@ class Pair : public Handler {
   const int selfRank_;
   const int peerRank_;
   const uint64_t localPairId_;
+  // Engine-selected I/O mode: submission data path (uring) vs readiness
+  // + direct syscalls (epoll). Fixed at construction.
+  const bool dataPath_;
 
   std::atomic<State> state_{State::kInitializing};
   std::atomic<bool> everConnected_{false};
@@ -208,6 +255,11 @@ class Pair : public Handler {
   std::deque<TxOp> tx_;
   std::string error_;
   std::string pendingTxError_;  // set by flushTx (mu_ held), drained by caller
+  // Data-path state (mu_): one in-flight sendmsg SQE + its cursor site;
+  // one in-flight recv SQE flag (flipped under mu_, cursors loop-thread).
+  bool txInFlight_{false};
+  TxSite txSite_{TxSite::kCtrl};
+  bool rxPosted_{false};
   UnboundBuffer* rxUbuf_{nullptr};  // guarded by mu_ (cross-thread on fail)
 
   // Connection cipher state. keys_ is written once before the pair is
