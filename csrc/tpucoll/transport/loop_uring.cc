@@ -12,6 +12,7 @@
 #include <atomic>
 #include <cerrno>
 #include <cstring>
+#include <deque>
 #include <thread>
 #include <unordered_map>
 
@@ -454,8 +455,8 @@ class UringLoop : public LoopBase {
           fprintf(stderr,
                   "[uring inline-del] fd=%d recvOut=%d sendOut=%d gen=%u "
                   "spill=%zu\n", fd, reg.recvOut, reg.sendOut, reg.gen,
-                  spill_.size());
-          for (const auto& c : spill_) {
+                  dispatchQ_.size());
+          for (const auto& c : dispatchQ_) {
             fprintf(stderr, "  spill ud fd=%d kind=%d gen=%u res=%d\n",
                     udFd(c.ud), int(udKind(c.ud)), udGen(c.ud), c.res);
           }
