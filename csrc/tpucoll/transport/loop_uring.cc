@@ -177,7 +177,6 @@ class UringLoop : public LoopBase {
   }
 
   void del(int fd) override {
-    bool hadPoll = false;
     {
       std::unique_lock<std::mutex> lock(mu_);
       auto it = regs_.find(fd);
@@ -185,7 +184,6 @@ class UringLoop : public LoopBase {
         return;
       }
       Reg& reg = it->second;
-      hadPoll = !reg.dataMode;
       reg.dying = true;
       if (reg.armed) {
         removeLocked(fd, reg.gen);
@@ -214,11 +212,14 @@ class UringLoop : public LoopBase {
       }
       regs_.erase(fd);
     }
-    if (hadPoll) {
-      // Tick barrier: once the loop completes the current dispatch batch,
-      // no stale poll completion for fd can still be dispatching.
-      barrier();
-    }
+    // Tick barrier: once the loop completes the current dispatch batch,
+    // no completion for fd — stale poll event OR data-path
+    // handleIoComplete (whose recvOut/sendOut were cleared at dispatch,
+    // BEFORE the handler ran) — can still be executing. Without this,
+    // del() could return mid-handler and the caller would free buffers
+    // the handler is still writing. No-op when called from the loop
+    // thread itself (the in-flight handler is this call stack).
+    barrier();
   }
 
   const char* engineName() const override { return "uring"; }
@@ -450,17 +451,6 @@ class UringLoop : public LoopBase {
         continue;
       }
       if (drainCqLocked() == 0) {
-        static std::atomic<int> spins{0};
-        if (++spins % 1 == 0) {
-          fprintf(stderr,
-                  "[uring inline-del] fd=%d recvOut=%d sendOut=%d gen=%u "
-                  "spill=%zu\n", fd, reg.recvOut, reg.sendOut, reg.gen,
-                  dispatchQ_.size());
-          for (const auto& c : dispatchQ_) {
-            fprintf(stderr, "  spill ud fd=%d kind=%d gen=%u res=%d\n",
-                    udFd(c.ud), int(udKind(c.ud)), udGen(c.ud), c.res);
-          }
-        }
         lock.unlock();
         int rv = sysIoUringEnter(ringFd_, 0, 1, IORING_ENTER_GETEVENTS);
         if (rv < 0 && errno != EINTR && errno != EBUSY) {

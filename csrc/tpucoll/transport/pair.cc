@@ -1186,7 +1186,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     }
     shmRxDone_ += chunk;
     shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
-    consumed += chunk;
+    *consumed += chunk;
     // Eager credit after draining a big chunk: the sender throttles on
     // ring space, and this lets it refill while we keep consuming.
     if (chunk * 8 >= shmRx_.cap) {
@@ -1353,11 +1353,6 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
   return RxStep::kMore;
 }
 
-void Pair::maybePostRecv() {
-  std::lock_guard<std::mutex> guard(mu_);
-  maybePostRecvLocked();
-}
-
 void Pair::maybePostRecvLocked() {
   if (!dataPath_ || rxPosted_ || fd_ < 0 ||
       state_.load() != State::kConnected) {
@@ -1373,35 +1368,63 @@ void Pair::maybePostRecvLocked() {
 
 void Pair::handleIoComplete(bool isRecv, int32_t res) {
   if (isRecv) {
-    {
+    // rxPosted_ stays set while this thread still owns the rx cursors:
+    // it is the latch that keeps resumeReading() (app thread) from
+    // posting a recv computed from cursors processRxBytes is mutating
+    // lock-free below. Clear it only at the repost decision points,
+    // under mu_, in the same critical section as the repost check.
+    if (state_.load() != State::kConnected) {
       std::lock_guard<std::mutex> guard(mu_);
       rxPosted_ = false;
-    }
-    if (state_.load() != State::kConnected) {
       return;
     }
     if (res == 0) {
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        rxPosted_ = false;
+      }
       onRxEof();
       return;
     }
     if (res < 0) {
       if (res == -EAGAIN || res == -EINTR) {
-        maybePostRecv();  // spurious wake on a pre-5.7 kernel; repost
+        // Spurious wake on a pre-5.7 kernel: cursors untouched; repost.
+        std::lock_guard<std::mutex> guard(mu_);
+        rxPosted_ = false;
+        maybePostRecvLocked();
         return;
       }
       if (res == -ECANCELED) {
+        std::lock_guard<std::mutex> guard(mu_);
+        rxPosted_ = false;
         return;  // teardown owns the wind-down
+      }
+      {
+        std::lock_guard<std::mutex> guard(mu_);
+        rxPosted_ = false;
       }
       errno = -res;
       fail(errnoString("recv"));
       return;
     }
     size_t consumed = 0;
-    if (processRxBytes(static_cast<size_t>(res), &consumed) ==
-        RxStep::kStop) {
-      return;
+    RxStep step = RxStep::kStop;
+    try {
+      step = processRxBytes(static_cast<size_t>(res), &consumed);
+    } catch (...) {
+      // Unlatch before propagating: a wedged-true rxPosted_ would
+      // silently stop this pair from ever receiving again.
+      std::lock_guard<std::mutex> guard(mu_);
+      rxPosted_ = false;
+      throw;
     }
-    maybePostRecv();
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      rxPosted_ = false;
+      if (step != RxStep::kStop) {
+        maybePostRecvLocked();
+      }
+    }
     return;
   }
 

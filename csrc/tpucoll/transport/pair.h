@@ -191,9 +191,9 @@ class Pair : public Handler {
   RxStep processHeader(size_t* consumed);  // header complete: dispatch
   void onRxEof();                          // peer closed (read returned 0)
   // Post the next recv if connected, unposted, and not paused at a
-  // message boundary. Safe from any thread when no recv is outstanding
-  // (mu_ serializes the rxPosted_ flip; rx cursors are quiescent then).
-  void maybePostRecv();
+  // message boundary. Requires mu_ held; rxPosted_ is the latch that
+  // keeps any other thread from posting while the loop thread still
+  // owns the rx cursors (cleared only at its repost decision points).
   void maybePostRecvLocked();
 
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
