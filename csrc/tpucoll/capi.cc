@@ -190,6 +190,18 @@ void* tc_device_new(const char* hostname, uint16_t port,
 
 void tc_device_free(void* dev) { delete asDevice(dev); }
 
+// Event-engine submission counters (loop.h Loop::EngineStats): uring
+// reports io_uring_enter syscalls / SQEs submitted / CQEs drained since
+// device creation; epoll reports zeros. sqes > enters is the batched-
+// submission evidence (readiness engines pay >=1 syscall per I/O op).
+void tc_device_engine_stats(void* dev, uint64_t* enters, uint64_t* sqes,
+                            uint64_t* cqes) {
+  const auto s = (*asDevice(dev))->loop()->engineStats();
+  *enters = s.enters;
+  *sqes = s.sqes;
+  *cqes = s.cqes;
+}
+
 // Engine introspection: lets callers pick engine="uring" only where the
 // kernel/sandbox supports it (an explicit uring request throws otherwise).
 int tc_uring_available() {

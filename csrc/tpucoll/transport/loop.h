@@ -77,6 +77,19 @@ class Loop {
   // "epoll" or "uring" (introspection / tests).
   virtual const char* engineName() const = 0;
 
+  // Cumulative submission statistics since construction. For the uring
+  // engine: `enters` = io_uring_enter syscalls, `sqes` = SQEs submitted
+  // (I/O ops + polls + cancels), `cqes` = completions drained. The
+  // sqes/enters ratio is the batching evidence: readiness engines pay
+  // >=1 syscall per I/O op by construction, so a ratio > 1 can only
+  // come from batched submission. Epoll engine reports zeros.
+  struct EngineStats {
+    uint64_t enters{0};
+    uint64_t sqes{0};
+    uint64_t cqes{0};
+  };
+  virtual EngineStats engineStats() const { return {}; }
+
   // ---- submission data path (uring engine) ----
   // hasDataPath(): the engine executes socket I/O from submitted ops
   // (batched SQEs, one io_uring_enter per dispatch batch) instead of

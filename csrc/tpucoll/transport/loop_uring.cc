@@ -224,6 +224,14 @@ class UringLoop : public LoopBase {
 
   const char* engineName() const override { return "uring"; }
 
+  EngineStats engineStats() const override {
+    EngineStats s;
+    s.enters = statEnters_.load(std::memory_order_relaxed);
+    s.sqes = statSqes_.load(std::memory_order_relaxed);
+    s.cqes = statCqes_.load(std::memory_order_relaxed);
+    return s;
+  }
+
   // ---- submission data path ----
 
   bool hasDataPath() const override { return true; }
@@ -379,8 +387,11 @@ class UringLoop : public LoopBase {
     // itself) and stall other threads against a blocked loop.
     bool spilled = false;
     while (n > 0) {
+      statEnters_.fetch_add(1, std::memory_order_relaxed);
       int rv = sysIoUringEnter(ringFd_, n, 0, 0);
       if (rv >= 0) {
+        statSqes_.fetch_add(std::min(n, unsigned(rv)),
+                            std::memory_order_relaxed);
         // Partial submission is possible (e.g. CQ filled mid-batch):
         // keep going until every prepped SQE is consumed — dropping one
         // loses an I/O forever.
@@ -420,6 +431,7 @@ class UringLoop : public LoopBase {
       dispatchQ_.push_back({cqe.user_data, cqe.res});
     }
     __atomic_store_n(cqHead_, head, __ATOMIC_RELEASE);
+    statCqes_.fetch_add(n, std::memory_order_relaxed);
     return n;
   }
 
@@ -452,6 +464,7 @@ class UringLoop : public LoopBase {
       }
       if (drainCqLocked() == 0) {
         lock.unlock();
+        statEnters_.fetch_add(1, std::memory_order_relaxed);
         int rv = sysIoUringEnter(ringFd_, 0, 1, IORING_ENTER_GETEVENTS);
         if (rv < 0 && errno != EINTR && errno != EBUSY) {
           TC_ERROR("io_uring_enter(del wait): ", strerror(errno));
@@ -519,8 +532,11 @@ class UringLoop : public LoopBase {
             pending_ = 0;
           }
         }
+        statEnters_.fetch_add(1, std::memory_order_relaxed);
         int rv = sysIoUringEnter(ringFd_, n, 1, IORING_ENTER_GETEVENTS);
         if (rv >= 0) {
+          statSqes_.fetch_add(std::min(n, unsigned(rv)),
+                              std::memory_order_relaxed);
           n -= std::min(n, unsigned(rv));
         }
         if (n > 0) {
@@ -633,6 +649,11 @@ class UringLoop : public LoopBase {
   std::deque<Completion> dispatchQ_;  // drained, undispatched; mu_ held
   std::condition_variable dataCv_;  // del() waits for data-op drains
   uint32_t nextGen_{1};  // gen 0 is reserved for the wake poll
+
+  // engineStats() counters; relaxed — observability only.
+  std::atomic<uint64_t> statEnters_{0};
+  std::atomic<uint64_t> statSqes_{0};
+  std::atomic<uint64_t> statCqes_{0};
 };
 
 bool uringAvailable() {

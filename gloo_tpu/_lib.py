@@ -86,6 +86,9 @@ _PROTOTYPES = {
                        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
                        ctypes.c_int, ctypes.c_char_p]),
     "tc_device_free": (None, [_c]),
+    "tc_device_engine_stats": (None, [_c, ctypes.POINTER(_u64),
+                                      ctypes.POINTER(_u64),
+                                      ctypes.POINTER(_u64)]),
     "tc_uring_available": (_int, []),
     "tc_set_connect_debug_logger": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),

@@ -275,6 +275,22 @@ class Device:
                                    engine.encode() if engine else None))
         self._free = _lib.lib.tc_device_free
 
+    def engine_stats(self) -> dict:
+        """Cumulative event-engine submission counters since device
+        creation: {"enters": io_uring_enter syscalls, "sqes": ops
+        submitted, "cqes": completions drained}. The uring engine batches
+        many SQEs per enter (sqes > enters); readiness engines pay one
+        syscall per I/O op by construction, and the epoll engine reports
+        zeros here. See docs/transport.md."""
+        enters = ctypes.c_uint64()
+        sqes = ctypes.c_uint64()
+        cqes = ctypes.c_uint64()
+        _lib.lib.tc_device_engine_stats(
+            self._handle, ctypes.byref(enters), ctypes.byref(sqes),
+            ctypes.byref(cqes))
+        return {"enters": enters.value, "sqes": sqes.value,
+                "cqes": cqes.value}
+
     def __del__(self):
         handle, self._handle = self._handle, None
         if handle:
