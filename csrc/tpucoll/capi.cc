@@ -15,6 +15,7 @@
 #include "tpucoll/context.h"
 #include "tpucoll/transport/loop_uring.h"
 #include "tpucoll/transport/wire.h"
+#include "tpucoll/common/crypto.h"
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/rendezvous/store.h"
@@ -204,6 +205,10 @@ void tc_device_engine_stats(void* dev, uint64_t* enters, uint64_t* sqes,
 
 // Engine introspection: lets callers pick engine="uring" only where the
 // kernel/sandbox supports it (an explicit uring request throws otherwise).
+// AEAD bulk tier this process dispatches to (crypto.h aeadIsaTier):
+// 2 = fused AVX-512, 1 = AVX2, 0 = scalar.
+int tc_crypto_isa_tier() { return tpucoll::aeadIsaTier(); }
+
 int tc_uring_available() {
   return tpucoll::transport::uringAvailable() ? 1 : 0;
 }

@@ -1,5 +1,6 @@
 #include "tpucoll/common/crypto.h"
 
+#include <cstdlib>
 #include <cstring>
 
 #ifdef __AVX2__
@@ -7,8 +8,26 @@
 #endif
 
 #include "tpucoll/common/hmac.h"
+#include "tpucoll/common/poly1305_impl.h"
 
 namespace tpucoll {
+
+#if defined(TPUCOLL_HAVE_AVX512)
+namespace crypto_detail {
+// crypto_avx512.cc: 16-block AVX-512 keystream tier and the fused
+// ChaCha+Poly bulk seal/open (full 1 KiB chunks only; each returns
+// bytes consumed).
+size_t chacha20Xor16Avx512(const uint32_t state[16], uint32_t counter,
+                           const uint8_t* in, size_t n, uint8_t* out);
+size_t sealFusedAvx512(const uint32_t state[16], uint32_t counter,
+                       const uint8_t* in, size_t n, uint8_t* out,
+                       Poly1305* mac);
+size_t openFusedAvx512(const uint32_t state[16], uint32_t counter,
+                       const uint8_t* in, size_t n, uint8_t* out,
+                       Poly1305* mac);
+}  // namespace crypto_detail
+#endif
+
 namespace {
 
 inline uint32_t rotl32(uint32_t v, int c) {
@@ -196,11 +215,36 @@ size_t chacha20Xor8(const uint32_t state[16], uint32_t counter,
 #undef TC_VQR
 #endif  // __AVX2__
 
+#if defined(TPUCOLL_HAVE_AVX512)
+// crypto_avx512.cc (own TU, -mavx512f). Runtime-gated below.
+bool avx512Usable() {
+  static const bool v = [] {
+    if (!__builtin_cpu_supports("avx512f")) {
+      return false;
+    }
+    const char* e = std::getenv("TPUCOLL_NO_AVX512");
+    return e == nullptr || std::strcmp(e, "0") == 0;
+  }();
+  return v;
+}
+#endif
+
 void chacha20Xor(const uint8_t key[32], uint32_t counter,
                  const uint8_t nonce[12], const uint8_t* in, size_t n,
                  uint8_t* out) {
   uint32_t state[16];
   initState(state, key, counter, nonce);
+#if defined(TPUCOLL_HAVE_AVX512)
+  if (avx512Usable()) {
+    const size_t z =
+        crypto_detail::chacha20Xor16Avx512(state, counter, in, n, out);
+    in += z;
+    out += z;
+    n -= z;
+    counter += static_cast<uint32_t>(z / 64);
+    state[12] = counter;
+  }
+#endif
 #ifdef __AVX2__
   const size_t vec = chacha20Xor8(state, counter, in, n, out);
   in += vec;
@@ -226,126 +270,9 @@ void chacha20Xor(const uint8_t key[32], uint32_t counter,
   }
 }
 
-// Poly1305 with 26-bit limbs (the well-trodden "donna" shape: carries
-// stay in 64-bit intermediates, no 128-bit type needed).
-struct Poly1305 {
-  uint32_t r[5];
-  uint32_t h[5]{0, 0, 0, 0, 0};
-  uint32_t pad[4];
-
-  explicit Poly1305(const uint8_t key[32]) {
-    r[0] = load32le(key + 0) & 0x3ffffff;
-    r[1] = (load32le(key + 3) >> 2) & 0x3ffff03;
-    r[2] = (load32le(key + 6) >> 4) & 0x3ffc0ff;
-    r[3] = (load32le(key + 9) >> 6) & 0x3f03fff;
-    r[4] = (load32le(key + 12) >> 8) & 0x00fffff;
-    for (int i = 0; i < 4; i++) {
-      pad[i] = load32le(key + 16 + 4 * i);
-    }
-  }
-
-  void blocks(const uint8_t* m, size_t n, uint32_t hibit) {
-    const uint64_t r0 = r[0], r1 = r[1], r2 = r[2], r3 = r[3], r4 = r[4];
-    const uint64_t s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
-    uint64_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
-    while (n >= 16) {
-      h0 += load32le(m + 0) & 0x3ffffff;
-      h1 += (load32le(m + 3) >> 2) & 0x3ffffff;
-      h2 += (load32le(m + 6) >> 4) & 0x3ffffff;
-      h3 += (load32le(m + 9) >> 6) & 0x3ffffff;
-      h4 += (load32le(m + 12) >> 8) | (static_cast<uint64_t>(hibit) << 24);
-      const uint64_t d0 =
-          h0 * r0 + h1 * s4 + h2 * s3 + h3 * s2 + h4 * s1;
-      const uint64_t d1 =
-          h0 * r1 + h1 * r0 + h2 * s4 + h3 * s3 + h4 * s2;
-      const uint64_t d2 =
-          h0 * r2 + h1 * r1 + h2 * r0 + h3 * s4 + h4 * s3;
-      const uint64_t d3 =
-          h0 * r3 + h1 * r2 + h2 * r1 + h3 * r0 + h4 * s4;
-      const uint64_t d4 =
-          h0 * r4 + h1 * r3 + h2 * r2 + h3 * r1 + h4 * r0;
-      uint64_t c = d0 >> 26;
-      h0 = d0 & 0x3ffffff;
-      uint64_t e1 = d1 + c;
-      c = e1 >> 26;
-      h1 = e1 & 0x3ffffff;
-      uint64_t e2 = d2 + c;
-      c = e2 >> 26;
-      h2 = e2 & 0x3ffffff;
-      uint64_t e3 = d3 + c;
-      c = e3 >> 26;
-      h3 = e3 & 0x3ffffff;
-      uint64_t e4 = d4 + c;
-      c = e4 >> 26;
-      h4 = e4 & 0x3ffffff;
-      h0 += c * 5;
-      c = h0 >> 26;
-      h0 &= 0x3ffffff;
-      h1 += c;
-      m += 16;
-      n -= 16;
-    }
-    h[0] = static_cast<uint32_t>(h0);
-    h[1] = static_cast<uint32_t>(h1);
-    h[2] = static_cast<uint32_t>(h2);
-    h[3] = static_cast<uint32_t>(h3);
-    h[4] = static_cast<uint32_t>(h4);
-  }
-
-  void finish(uint8_t tag[16]) {
-    uint32_t h0 = h[0], h1 = h[1], h2 = h[2], h3 = h[3], h4 = h[4];
-    uint32_t c = h1 >> 26;
-    h1 &= 0x3ffffff;
-    h2 += c;
-    c = h2 >> 26;
-    h2 &= 0x3ffffff;
-    h3 += c;
-    c = h3 >> 26;
-    h3 &= 0x3ffffff;
-    h4 += c;
-    c = h4 >> 26;
-    h4 &= 0x3ffffff;
-    h0 += c * 5;
-    c = h0 >> 26;
-    h0 &= 0x3ffffff;
-    h1 += c;
-
-    // Compute h + -p and select it if h >= p.
-    uint32_t g0 = h0 + 5;
-    c = g0 >> 26;
-    g0 &= 0x3ffffff;
-    uint32_t g1 = h1 + c;
-    c = g1 >> 26;
-    g1 &= 0x3ffffff;
-    uint32_t g2 = h2 + c;
-    c = g2 >> 26;
-    g2 &= 0x3ffffff;
-    uint32_t g3 = h3 + c;
-    c = g3 >> 26;
-    g3 &= 0x3ffffff;
-    uint32_t g4 = h4 + c - (1u << 26);
-    const uint32_t mask = (g4 >> 31) - 1;  // all-ones if h >= p
-    h0 = (h0 & ~mask) | (g0 & mask);
-    h1 = (h1 & ~mask) | (g1 & mask);
-    h2 = (h2 & ~mask) | (g2 & mask);
-    h3 = (h3 & ~mask) | (g3 & mask);
-    h4 = (h4 & ~mask) | (g4 & mask);
-
-    // h mod 2^128 + pad.
-    h0 = (h0 | (h1 << 26)) & 0xffffffff;
-    h1 = ((h1 >> 6) | (h2 << 20)) & 0xffffffff;
-    h2 = ((h2 >> 12) | (h3 << 14)) & 0xffffffff;
-    h3 = ((h3 >> 18) | (h4 << 8)) & 0xffffffff;
-    uint64_t f = static_cast<uint64_t>(h0) + pad[0];
-    store32le(tag + 0, static_cast<uint32_t>(f));
-    f = static_cast<uint64_t>(h1) + pad[1] + (f >> 32);
-    store32le(tag + 4, static_cast<uint32_t>(f));
-    f = static_cast<uint64_t>(h2) + pad[2] + (f >> 32);
-    store32le(tag + 8, static_cast<uint32_t>(f));
-    f = static_cast<uint64_t>(h3) + pad[3] + (f >> 32);
-    store32le(tag + 12, static_cast<uint32_t>(f));
-  }
-};
+// Poly1305 core (donna-64 shape, 4-block interleave) lives in
+// poly1305_impl.h so the AVX-512 fused-AEAD TU shares it.
+using crypto_detail::Poly1305;
 
 void polyUpdatePadded(Poly1305* mac, const uint8_t* data, size_t n) {
   // Full 16-byte blocks straight from the source, then one zero-padded
@@ -361,16 +288,14 @@ void polyUpdatePadded(Poly1305* mac, const uint8_t* data, size_t n) {
   }
 }
 
-void aeadTag(const uint8_t otk[32], const uint8_t* aad, size_t aadLen,
-             const uint8_t* ct, size_t ctLen, uint8_t tag[16]) {
-  Poly1305 mac(otk);
-  polyUpdatePadded(&mac, aad, aadLen);
-  polyUpdatePadded(&mac, ct, ctLen);
+// RFC 8439 tag closing: the lengths block after aad and ct (each
+// zero-padded to 16 by the caller via polyUpdatePadded).
+void finishTag(Poly1305* mac, size_t aadLen, size_t ctLen, uint8_t tag[16]) {
   uint8_t lens[16];
   store64le(lens, aadLen);
   store64le(lens + 8, ctLen);
-  mac.blocks(lens, 16, 1);
-  mac.finish(tag);
+  mac->blocks(lens, 16, 1);
+  mac->finish(tag);
 }
 
 void makeNonce(uint64_t seq, uint8_t nonce[12]) {
@@ -416,8 +341,22 @@ void aeadSealWithNonce(const AeadKey& key, const uint8_t nonce[12],
                        size_t n, uint8_t* out, uint8_t tag[kAeadTagBytes]) {
   uint8_t otk[64];
   chacha20Block(key.bytes, 0, nonce, otk);
-  chacha20Xor(key.bytes, 1, nonce, in, n, out);
-  aeadTag(otk, aad, aadLen, out, n, tag);
+  Poly1305 mac(otk);
+  polyUpdatePadded(&mac, aad, aadLen);
+  size_t done = 0;
+#if defined(TPUCOLL_HAVE_AVX512)
+  if (avx512Usable()) {
+    uint32_t state[16];
+    initState(state, key.bytes, 1, nonce);
+    done = sealFusedAvx512(state, 1, in, n, out, &mac);
+  }
+#endif
+  if (n - done > 0) {
+    chacha20Xor(key.bytes, 1 + static_cast<uint32_t>(done / 64), nonce,
+                in + done, n - done, out + done);
+    polyUpdatePadded(&mac, out + done, n - done);
+  }
+  finishTag(&mac, aadLen, n, tag);
 }
 
 }  // namespace crypto_detail
@@ -437,13 +376,45 @@ bool aeadOpen(const AeadKey& key, uint64_t seq, const uint8_t* aad,
   makeNonce(seq, nonce);
   uint8_t otk[64];
   crypto_detail::chacha20Block(key.bytes, 0, nonce, otk);
+  Poly1305 mac(otk);
+  polyUpdatePadded(&mac, aad, aadLen);
+  size_t done = 0;
+#if defined(TPUCOLL_HAVE_AVX512)
+  if (avx512Usable()) {
+    // Fused verify+decrypt: the bulk prefix is decrypted BEFORE the tag
+    // check completes. On mismatch `out` is unspecified — exactly the
+    // documented contract — and nothing is surfaced to callers.
+    uint32_t state[16];
+    initState(state, key.bytes, 1, nonce);
+    done = crypto_detail::openFusedAvx512(state, 1, in, n, out, &mac);
+  }
+#endif
+  // Absorb the remaining ciphertext before decrypting it (in == out
+  // in-place decryption would otherwise destroy the mac input).
+  polyUpdatePadded(&mac, in + done, n - done);
   uint8_t expect[kAeadTagBytes];
-  aeadTag(otk, aad, aadLen, in, n, expect);
+  finishTag(&mac, aadLen, n, expect);
   if (!macEqual(expect, tag, kAeadTagBytes)) {
     return false;
   }
-  chacha20Xor(key.bytes, 1, nonce, in, n, out);
+  if (n - done > 0) {
+    chacha20Xor(key.bytes, 1 + static_cast<uint32_t>(done / 64), nonce,
+                in + done, n - done, out + done);
+  }
   return true;
+}
+
+int aeadIsaTier() {
+#if defined(TPUCOLL_HAVE_AVX512)
+  if (avx512Usable()) {
+    return 2;
+  }
+#endif
+#ifdef __AVX2__
+  return 1;
+#else
+  return 0;
+#endif
 }
 
 void hkdfSha256(const void* ikm, size_t ikmLen, const void* salt,

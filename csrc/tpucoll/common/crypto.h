@@ -29,11 +29,19 @@ void aeadSeal(const AeadKey& key, uint64_t seq, const uint8_t* aad,
               size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
               uint8_t tag[kAeadTagBytes]);
 
-// Verify-then-decrypt counterpart. Returns false (and leaves `out`
-// unspecified) on tag mismatch. in == out allowed.
+// Open counterpart. Returns false on tag mismatch, in which case `out`
+// is UNSPECIFIED — the fused bulk path decrypts while it MACs, so a
+// forged message may leave (never-surfaced) decrypted bytes behind;
+// callers must not release `out` to anyone until this returns true.
+// in == out allowed.
 bool aeadOpen(const AeadKey& key, uint64_t seq, const uint8_t* aad,
               size_t aadLen, const uint8_t* in, size_t n, uint8_t* out,
               const uint8_t tag[kAeadTagBytes]);
+
+// Which AEAD bulk tier this process will use: 2 = fused AVX-512,
+// 1 = AVX2 8-block, 0 = scalar. For tests/diagnostics (the tiers are
+// wire-compatible; TPUCOLL_NO_AVX512=1 forces the fallback).
+int aeadIsaTier();
 
 // HKDF-SHA256 extract+expand. outLen <= 255 * 32.
 void hkdfSha256(const void* ikm, size_t ikmLen, const void* salt,

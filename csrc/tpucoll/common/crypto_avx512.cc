@@ -1,0 +1,204 @@
+// AVX-512 ChaCha20 keystream and fused ChaCha20+Poly1305 AEAD bulk
+// kernels: 16 blocks (1024 bytes) per pass, words held "vertically"
+// (one zmm = word i of blocks 0..15) so the scalar round function maps
+// 1:1 onto vector ops, with single-instruction 32-bit rotates (vprold —
+// the reason this tier exists: the AVX2 path spends a shuffle or a
+// shift+shift+or per rotate).
+//
+// The fused kernels interleave Poly1305 4-block groups between ChaCha
+// double-rounds IN THE SAME LOOP BODY: poly's 64x64 scalar multiplies
+// and chacha's zmm ALU ops retire on different execution ports, so the
+// out-of-order core runs them concurrently — measured materially faster
+// than running the two passes back-to-back, where the ~224-entry OOO
+// window can only overlap the seams. Seal lags poly one chunk behind
+// the cipher (poly eats ciphertext); open runs both on the same chunk.
+//
+// Compiled in its own TU with -mavx512f only when the toolchain
+// supports it (TPUCOLL_HAVE_AVX512); callers dispatch at runtime via
+// __builtin_cpu_supports (crypto.cc).
+#include <cstddef>
+#include <cstdint>
+
+#include <immintrin.h>
+
+#include "tpucoll/common/poly1305_impl.h"
+
+namespace tpucoll {
+namespace crypto_detail {
+
+namespace {
+
+#define TC_ZQR(a, b, c, d)                          \
+  a = _mm512_add_epi32(a, b);                       \
+  d = _mm512_rol_epi32(_mm512_xor_si512(d, a), 16); \
+  c = _mm512_add_epi32(c, d);                       \
+  b = _mm512_rol_epi32(_mm512_xor_si512(b, c), 12); \
+  a = _mm512_add_epi32(a, b);                       \
+  d = _mm512_rol_epi32(_mm512_xor_si512(d, a), 8);  \
+  c = _mm512_add_epi32(c, d);                       \
+  b = _mm512_rol_epi32(_mm512_xor_si512(b, c), 7)
+
+// Transpose the 16x16 u32 matrix "v[word] lane block" into
+// "out[j] = 64-byte block j" order: 32-bit and 64-bit unpacks build,
+// per 128-bit lane l, the column 4l+c of a 4-row group; two
+// shuffle_i32x4 levels then gather one column across the four groups.
+inline void transpose16x16(__m512i v[16], __m512i out[16]) {
+  __m512i t[16], u[16];
+  for (int g = 0; g < 4; g++) {
+    t[4 * g + 0] = _mm512_unpacklo_epi32(v[4 * g + 0], v[4 * g + 1]);
+    t[4 * g + 1] = _mm512_unpackhi_epi32(v[4 * g + 0], v[4 * g + 1]);
+    t[4 * g + 2] = _mm512_unpacklo_epi32(v[4 * g + 2], v[4 * g + 3]);
+    t[4 * g + 3] = _mm512_unpackhi_epi32(v[4 * g + 2], v[4 * g + 3]);
+  }
+  for (int g = 0; g < 4; g++) {
+    // u[4g+c] lane l = words 4g..4g+3 of block 4l+c.
+    u[4 * g + 0] = _mm512_unpacklo_epi64(t[4 * g + 0], t[4 * g + 2]);
+    u[4 * g + 1] = _mm512_unpackhi_epi64(t[4 * g + 0], t[4 * g + 2]);
+    u[4 * g + 2] = _mm512_unpacklo_epi64(t[4 * g + 1], t[4 * g + 3]);
+    u[4 * g + 3] = _mm512_unpackhi_epi64(t[4 * g + 1], t[4 * g + 3]);
+  }
+  for (int c = 0; c < 4; c++) {
+    const __m512i a0 = _mm512_shuffle_i32x4(u[c], u[4 + c], 0x44);
+    const __m512i a1 = _mm512_shuffle_i32x4(u[c], u[4 + c], 0xee);
+    const __m512i b0 = _mm512_shuffle_i32x4(u[8 + c], u[12 + c], 0x44);
+    const __m512i b1 = _mm512_shuffle_i32x4(u[8 + c], u[12 + c], 0xee);
+    out[c] = _mm512_shuffle_i32x4(a0, b0, 0x88);
+    out[4 + c] = _mm512_shuffle_i32x4(a0, b0, 0xdd);
+    out[8 + c] = _mm512_shuffle_i32x4(a1, b1, 0x88);
+    out[12 + c] = _mm512_shuffle_i32x4(a1, b1, 0xdd);
+  }
+}
+
+inline void initVectors(const uint32_t state[16], uint32_t counter,
+                        __m512i init[16]) {
+  for (int i = 0; i < 16; i++) {
+    init[i] = _mm512_set1_epi32(static_cast<int>(state[i]));
+  }
+  init[12] = _mm512_add_epi32(
+      _mm512_set1_epi32(static_cast<int>(counter)),
+      _mm512_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,
+                        15));
+}
+
+// 20 ChaCha rounds over v[16]; when kPoly, also absorb 1024 bytes at
+// polySrc into the poly accumulator as 16 4-block groups, two per
+// double-round for the first eight double-rounds — adjacent in the
+// instruction stream with the vector ops they overlap.
+template <bool kPoly>
+inline void rounds(__m512i v[16], Poly1305* mac, const uint8_t* polySrc,
+                   uint64_t* a0, uint64_t* a1, uint64_t* a2) {
+  for (int round = 0; round < 10; round++) {
+    TC_ZQR(v[0], v[4], v[8], v[12]);
+    TC_ZQR(v[1], v[5], v[9], v[13]);
+    TC_ZQR(v[2], v[6], v[10], v[14]);
+    TC_ZQR(v[3], v[7], v[11], v[15]);
+    if (kPoly && round < 8) {
+      mac->group4(polySrc + round * 128, a0, a1, a2);
+    }
+    TC_ZQR(v[0], v[5], v[10], v[15]);
+    TC_ZQR(v[1], v[6], v[11], v[12]);
+    TC_ZQR(v[2], v[7], v[8], v[13]);
+    TC_ZQR(v[3], v[4], v[9], v[14]);
+    if (kPoly && round < 8) {
+      mac->group4(polySrc + round * 128 + 64, a0, a1, a2);
+    }
+  }
+}
+
+// Rebuild the init vectors from scalar state instead of keeping 16 more
+// zmm registers live across the rounds (v[16] + init[16] would be the
+// entire register file; the resulting spills inside the round loop cost
+// more than 16 broadcasts here).
+inline void xorStore(const uint32_t state[16], uint32_t counter,
+                     __m512i v[16], const uint8_t* in, uint8_t* out) {
+  __m512i init[16], ks[16];
+  initVectors(state, counter, init);
+  for (int i = 0; i < 16; i++) {
+    v[i] = _mm512_add_epi32(v[i], init[i]);
+  }
+  transpose16x16(v, ks);
+  for (int b = 0; b < 16; b++) {
+    const __m512i x =
+        _mm512_xor_si512(_mm512_loadu_si512(in + 64 * b), ks[b]);
+    _mm512_storeu_si512(out + 64 * b, x);
+  }
+}
+
+}  // namespace
+
+// XOR `in` with keystream for full 1024-byte chunks only; returns bytes
+// consumed. Same contract as the AVX2 8-block tier (crypto.cc).
+size_t chacha20Xor16Avx512(const uint32_t state[16], uint32_t counter,
+                           const uint8_t* in, size_t n, uint8_t* out) {
+  size_t done = 0;
+  while (n - done >= 1024) {
+    __m512i v[16];
+    initVectors(state, counter, v);
+    rounds<false>(v, nullptr, nullptr, nullptr, nullptr, nullptr);
+    xorStore(state, counter, v, in + done, out + done);
+    counter += 16;
+    done += 1024;
+  }
+  return done;
+}
+
+// Fused seal bulk: encrypt full 1 KiB chunks AND absorb the produced
+// ciphertext into `mac`, poly running one chunk behind the cipher.
+// Returns bytes consumed; mac has absorbed exactly that ciphertext
+// prefix (a multiple of 16 bytes, hibit=1 blocks). in == out allowed.
+size_t sealFusedAvx512(const uint32_t state[16], uint32_t counter,
+                       const uint8_t* in, size_t n, uint8_t* out,
+                       Poly1305* mac) {
+  size_t done = 0;
+  uint64_t a0 = mac->h0, a1 = mac->h1, a2 = mac->h2;
+  const uint8_t* lag = nullptr;  // previous chunk's ciphertext
+  while (n - done >= 1024) {
+    __m512i v[16];
+    initVectors(state, counter, v);
+    if (lag != nullptr) {
+      rounds<true>(v, mac, lag, &a0, &a1, &a2);
+    } else {
+      rounds<false>(v, nullptr, nullptr, nullptr, nullptr, nullptr);
+    }
+    xorStore(state, counter, v, in + done, out + done);
+    lag = out + done;
+    counter += 16;
+    done += 1024;
+  }
+  mac->h0 = a0;
+  mac->h1 = a1;
+  mac->h2 = a2;
+  if (lag != nullptr) {
+    mac->blocks(lag, 1024, 1);  // the chunk the pipeline still owes
+  }
+  return done;
+}
+
+// Fused open bulk: absorb ciphertext into `mac` and decrypt, same chunk
+// per iteration (poly group loads precede the chunk's stores in program
+// order, so in == out in-place decryption is safe). Returns bytes
+// consumed. NOTE: bytes are decrypted before the caller verifies the
+// tag; on mismatch the output is unspecified, per the aeadOpen contract.
+size_t openFusedAvx512(const uint32_t state[16], uint32_t counter,
+                       const uint8_t* in, size_t n, uint8_t* out,
+                       Poly1305* mac) {
+  size_t done = 0;
+  uint64_t a0 = mac->h0, a1 = mac->h1, a2 = mac->h2;
+  while (n - done >= 1024) {
+    __m512i v[16];
+    initVectors(state, counter, v);
+    rounds<true>(v, mac, in + done, &a0, &a1, &a2);
+    xorStore(state, counter, v, in + done, out + done);
+    counter += 16;
+    done += 1024;
+  }
+  mac->h0 = a0;
+  mac->h1 = a1;
+  mac->h2 = a2;
+  return done;
+}
+
+#undef TC_ZQR
+
+}  // namespace crypto_detail
+}  // namespace tpucoll

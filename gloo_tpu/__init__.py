@@ -29,6 +29,7 @@ from gloo_tpu.core import (
     set_connect_debug_logger,
     TimeoutError,
     UnboundBuffer,
+    crypto_isa_tier,
     uring_available,
 )
 
@@ -50,5 +51,6 @@ __all__ = [
     "TimeoutError",
     "UnboundBuffer",
     "__version__",
+    "crypto_isa_tier",
     "uring_available",
 ]

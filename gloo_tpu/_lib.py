@@ -90,6 +90,7 @@ _PROTOTYPES = {
                                       ctypes.POINTER(_u64),
                                       ctypes.POINTER(_u64)]),
     "tc_uring_available": (_int, []),
+    "tc_crypto_isa_tier": (_int, []),
     "tc_set_connect_debug_logger": (None, [_c]),
     "tc_context_new": (_c, [_int, _int]),
     "tc_context_set_timeout": (None, [_c, _i64]),

@@ -240,6 +240,13 @@ def uring_available() -> bool:
     return bool(_lib.lib.tc_uring_available())
 
 
+def crypto_isa_tier() -> int:
+    """AEAD bulk tier this process dispatches to: 2 = fused AVX-512,
+    1 = AVX2 8-block, 0 = scalar. All tiers are wire-compatible;
+    TPUCOLL_NO_AVX512=1 forces the fallback (tests/diagnostics)."""
+    return int(_lib.lib.tc_crypto_isa_tier())
+
+
 class Device:
     """Transport endpoint: event-engine loop thread + shared listener."""
 

@@ -128,6 +128,47 @@ def test_tier_mismatch_rejected():
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_crypto_tier_agreement_on_the_wire():
+    """A rank sealing with the AVX-512 fused ChaCha+Poly kernels and a
+    rank restricted to the scalar/AVX2 fallback (TPUCOLL_NO_AVX512=1)
+    must interoperate byte-for-byte: same ciphertext framing, same tags.
+    Payload spans several 256 KiB frames plus a partial one so both the
+    fused bulk path and the tail path are exercised in each direction."""
+    if gloo_tpu.crypto_isa_tier() < 2:
+        pytest.skip("AVX-512 AEAD tier not active here: both ranks would "
+                    "run the same fallback and the test would be vacuous")
+    store = tempfile.mkdtemp()
+
+    def worker(rank, env_extra):
+        prog = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            import gloo_tpu
+
+            rank = {rank}; size = 2
+            store = gloo_tpu.FileStore({store!r})
+            ctx = gloo_tpu.Context(rank, size, timeout=15.0)
+            ctx.connect_full_mesh(
+                store, gloo_tpu.Device(auth_key="k", encrypt=True))
+            n = (640 * 1024 + 123) // 4
+            x = np.full(n, float(rank + 1), dtype=np.float32)
+            ctx.allreduce(x)
+            assert np.all(x == 3.0), x[:4]
+            ctx.barrier()
+            ctx.close()
+            sys.exit(10)
+        """).format(repo=_REPO, rank=rank, store=store)
+        env = dict(os.environ, TPUCOLL_SHM="0", **env_extra)
+        return subprocess.Popen([sys.executable, "-c", prog], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    procs = [worker(0, {}), worker(1, {"TPUCOLL_NO_AVX512": "1"})]
+    outs = [p.communicate(timeout=60) for p in procs]
+    assert [p.returncode for p in procs] == [10, 10], outs
+
+
 def test_peer_killed_mid_collective_encrypted():
     """Fast failure detection must survive the encrypted framing: SIGKILL
     one rank, survivors get IoError well inside the context timeout."""
