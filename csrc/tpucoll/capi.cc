@@ -16,6 +16,7 @@
 #include "tpucoll/transport/loop_uring.h"
 #include "tpucoll/transport/wire.h"
 #include "tpucoll/common/crypto.h"
+#include "tpucoll/common/keyring.h"
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/rendezvous/store.h"
@@ -164,7 +165,7 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 
 void* tc_device_new(const char* hostname, uint16_t port,
                     const char* authKey, int encrypt, const char* iface,
-                    int busyPoll, const char* engine) {
+                    int busyPoll, const char* engine, const char* keyring) {
   try {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
@@ -177,6 +178,9 @@ void* tc_device_new(const char* hostname, uint16_t port,
     if (authKey != nullptr) {
       attr.authKey = authKey;
     }
+    if (keyring != nullptr) {
+      attr.keyring = keyring;
+    }
     attr.encrypt = encrypt != 0;
     attr.busyPoll = busyPoll != 0;
     if (engine != nullptr) {
@@ -187,6 +191,21 @@ void* tc_device_new(const char* hostname, uint16_t port,
     g_lastError = e.what();
     return nullptr;
   }
+}
+
+// Launcher-side helper: derive rank `rank`'s serialized keyring from the
+// root secret (common/keyring.h threat model). The returned buffer is a
+// NUL-terminated string; free with tc_buf_free.
+int tc_derive_keyring(const char* rootKey, int rank, int size,
+                      uint8_t** out) {
+  return wrap([&] {
+    const std::string s =
+        tpucoll::Keyring::derive(rootKey != nullptr ? rootKey : "", rank,
+                                 size)
+            .serialize();
+    *out = static_cast<uint8_t*>(malloc(s.size() + 1));
+    std::memcpy(*out, s.data(), s.size() + 1);
+  });
 }
 
 void tc_device_free(void* dev) { delete asDevice(dev); }

@@ -1,0 +1,110 @@
+#include "tpucoll/common/keyring.h"
+
+#include <cstdio>
+#include <cstring>
+
+#include "tpucoll/common/crypto.h"
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+namespace {
+
+constexpr char kPrefix[] = "tcring1";
+
+void le32(uint32_t v, uint8_t out[4]) {
+  out[0] = static_cast<uint8_t>(v);
+  out[1] = static_cast<uint8_t>(v >> 8);
+  out[2] = static_cast<uint8_t>(v >> 16);
+  out[3] = static_cast<uint8_t>(v >> 24);
+}
+
+int hexVal(char c) {
+  if (c >= '0' && c <= '9') {
+    return c - '0';
+  }
+  if (c >= 'a' && c <= 'f') {
+    return c - 'a' + 10;
+  }
+  return -1;
+}
+
+}  // namespace
+
+Keyring Keyring::derive(const std::string& rootKey, int rank, int size) {
+  TC_ENFORCE(!rootKey.empty(), "keyring derivation needs a root key");
+  TC_ENFORCE(rank >= 0 && rank < size && size >= 2,
+             "bad rank/size for keyring: ", rank, "/", size);
+  Keyring ring;
+  ring.rank_ = rank;
+  ring.size_ = size;
+  ring.keys_.assign(static_cast<size_t>(size) * kKeyBytes, 0);
+  static constexpr char kSalt[] = "tpucoll-pairkey-v1";
+  for (int s = 0; s < size; s++) {
+    if (s == rank) {
+      continue;  // no self-key; the slot stays zeroed
+    }
+    // K[a,b] is symmetric in (a,b): key the pair by (min, max).
+    uint8_t info[8];
+    le32(static_cast<uint32_t>(rank < s ? rank : s), info);
+    le32(static_cast<uint32_t>(rank < s ? s : rank), info + 4);
+    hkdfSha256(rootKey.data(), rootKey.size(), kSalt, sizeof(kSalt) - 1,
+               info, sizeof(info),
+               ring.keys_.data() + static_cast<size_t>(s) * kKeyBytes,
+               kKeyBytes);
+  }
+  return ring;
+}
+
+std::string Keyring::serialize() const {
+  TC_ENFORCE(valid(), "cannot serialize an empty keyring");
+  std::string out(kPrefix);
+  out += ":" + std::to_string(rank_) + ":" + std::to_string(size_) + ":";
+  static const char* hex = "0123456789abcdef";
+  out.reserve(out.size() + keys_.size() * 2);
+  for (uint8_t b : keys_) {
+    out.push_back(hex[b >> 4]);
+    out.push_back(hex[b & 0xf]);
+  }
+  return out;
+}
+
+Keyring Keyring::parse(const std::string& blob) {
+  int rank = -1;
+  int size = -1;
+  int consumed = -1;
+  TC_ENFORCE(
+      std::sscanf(blob.c_str(), "tcring1:%d:%d:%n", &rank, &size,
+                  &consumed) == 2 && consumed > 0,
+      "malformed keyring (want \"tcring1:<rank>:<size>:<hex>\")");
+  TC_ENFORCE(rank >= 0 && size >= 2 && rank < size && size <= (1 << 20),
+             "keyring rank/size out of range: ", rank, "/", size);
+  const size_t want = static_cast<size_t>(size) * kKeyBytes * 2;
+  TC_ENFORCE_EQ(blob.size() - static_cast<size_t>(consumed), want,
+                "keyring hex length mismatch");
+  Keyring ring;
+  ring.rank_ = rank;
+  ring.size_ = size;
+  ring.keys_.resize(static_cast<size_t>(size) * kKeyBytes);
+  const char* p = blob.c_str() + consumed;
+  for (size_t i = 0; i < ring.keys_.size(); i++) {
+    const int hi = hexVal(p[2 * i]);
+    const int lo = hexVal(p[2 * i + 1]);
+    TC_ENFORCE(hi >= 0 && lo >= 0, "keyring contains non-hex bytes");
+    ring.keys_[i] = static_cast<uint8_t>((hi << 4) | lo);
+  }
+  return ring;
+}
+
+std::string Keyring::keyFor(int peer) const {
+  TC_ENFORCE(valid(), "no keyring configured");
+  TC_ENFORCE(peer >= 0 && peer < size_ && peer != rank_,
+             "no pairwise key for peer rank ", peer, " (self ", rank_,
+             ", size ", size_, ")");
+  return std::string(
+      reinterpret_cast<const char*>(keys_.data()) +
+          static_cast<size_t>(peer) * kKeyBytes,
+      kKeyBytes);
+}
+
+}  // namespace tpucoll

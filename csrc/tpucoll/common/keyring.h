@@ -1,0 +1,57 @@
+// Per-rank identity keyring: the transport's answer to the reference's
+// per-process key/cert TLS identity (gloo/transport/tcp/tls/context.h:
+// 25-42 — each process holds its OWN private key, so one leaked worker
+// credential does not impersonate the fleet).
+//
+// Model: a launcher holding a root secret derives, for worker r, the
+// keyring {K[r,s] = HKDF(root, "tpucoll-pairkey-v1", pair(r,s)) for all
+// s}. Workers receive ONLY their keyring, never the root. Connection
+// (a,b) authenticates with the pairwise key K[a,b], which exactly the
+// two legitimate endpoints hold. Leaking worker r's keyring therefore
+// lets an attacker impersonate r (to anyone) and impersonate other
+// ranks only TO r — it does NOT let them impersonate rank s to rank t.
+// That is strictly stronger than the single mesh PSK (where one leak
+// impersonates every rank to every rank) and covers the reference's
+// leak-containment property without an in-tree PKI; rotation = new
+// root, re-derive, restart (same operational cost as redistributing
+// certs). Trust anchor: the launcher and its channel to the workers —
+// the same anchor the reference's CA file distribution relies on.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+
+class Keyring {
+ public:
+  static constexpr size_t kKeyBytes = 32;
+
+  Keyring() = default;
+
+  // Launcher side: derive rank r's keyring from the root secret.
+  static Keyring derive(const std::string& rootKey, int rank, int size);
+
+  // Worker side: parse a serialized keyring ("tcring1:<rank>:<size>:
+  // <hex of size*32 key bytes>"; slot [rank] is zeros). Throws
+  // EnforceError on malformed input.
+  static Keyring parse(const std::string& blob);
+
+  std::string serialize() const;
+
+  bool valid() const { return rank_ >= 0; }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // K[rank, peer] as a string usable as an HMAC/HKDF key. Throws on
+  // out-of-range or self.
+  std::string keyFor(int peer) const;
+
+ private:
+  int rank_{-1};
+  int size_{0};
+  std::vector<uint8_t> keys_;  // size * kKeyBytes, slot [rank] zeroed
+};
+
+}  // namespace tpucoll

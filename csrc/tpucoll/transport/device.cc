@@ -9,9 +9,14 @@ namespace transport {
 Device::Device(const DeviceAttr& attr)
     : loop_(makeLoop(attr.busyPoll, attr.engine)), authKey_(attr.authKey),
       encrypt_(attr.encrypt) {
-  TC_ENFORCE(!encrypt_ || !authKey_.empty(),
-             "encrypt=true requires an auth key (the AEAD keys are "
-             "derived from the PSK handshake)");
+  if (!attr.keyring.empty()) {
+    TC_ENFORCE(authKey_.empty(),
+               "auth_key and keyring are mutually exclusive tiers");
+    keyring_ = Keyring::parse(attr.keyring);
+  }
+  TC_ENFORCE(!encrypt_ || !authKey_.empty() || keyring_.valid(),
+             "encrypt=true requires an auth key or keyring (the AEAD "
+             "keys are derived from the handshake)");
   std::string host = attr.hostname;
   if (!attr.iface.empty()) {
     host = addressForInterface(attr.iface);
@@ -20,7 +25,7 @@ Device::Device(const DeviceAttr& attr)
   }
   SockAddr bindAddr = resolve(host, attr.port);
   listener_ = std::make_unique<Listener>(loop_.get(), bindAddr, authKey_,
-                                         encrypt_);
+                                         keyring_, encrypt_);
 }
 
 std::string Device::str() const {

@@ -10,6 +10,7 @@
 #include <memory>
 #include <string>
 
+#include "tpucoll/common/keyring.h"
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/listener.h"
 #include "tpucoll/transport/loop.h"
@@ -28,8 +29,13 @@ struct DeviceAttr {
   // Non-empty: require the PSK handshake on every inbound and outbound
   // connection (mutual HMAC-SHA256 authentication; see wire.h).
   std::string authKey;
+  // Per-rank identity tier (common/keyring.h): a serialized keyring
+  // ("tcring1:...") of pairwise keys. Mutually exclusive with authKey;
+  // connections then authenticate with K[selfRank, peerRank], and a
+  // leaked keyring impersonates one rank, not the whole mesh.
+  std::string keyring;
   // Encrypt the data plane: per-connection ChaCha20-Poly1305 keys derived
-  // from the PSK handshake (requires a non-empty authKey). Both sides of
+  // from the handshake (requires authKey or keyring). Both sides of
   // every connection must agree — a plaintext peer is rejected at hello.
   bool encrypt{false};
   // Sync/busy-poll latency mode (reference: tcp setSync + MSG_DONTWAIT
@@ -51,16 +57,21 @@ class Device {
   const SockAddr& address() const { return listener_->address(); }
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
   const std::string& authKey() const { return authKey_; }
+  const Keyring& keyring() const { return keyring_; }
   bool encrypt() const { return encrypt_; }
   bool busyPoll() const { return loop_->busyPoll(); }
   std::string str() const;
 
  private:
   std::unique_ptr<Loop> loop_;  // declared first: destroyed last
+  // Declared before listener_: the listener holds references to the
+  // key material, so it must be destroyed first (reverse declaration
+  // order) and constructed after.
+  std::string authKey_;
+  Keyring keyring_;
+  bool encrypt_{false};
   std::unique_ptr<Listener> listener_;
   std::atomic<uint64_t> pairId_{1};
-  std::string authKey_;
-  bool encrypt_{false};
 };
 
 }  // namespace transport

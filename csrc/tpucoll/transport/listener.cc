@@ -21,14 +21,14 @@ namespace tpucoll {
 namespace transport {
 
 // Reads the hello preamble off a fresh inbound connection — and, when the
-// device requires authentication, runs the listener side of the PSK
-// challenge/response (see wire.h) — then hands the fd back to the listener
-// for routing.
+// device requires authentication, runs the listener side of the PSK (or
+// per-rank keyring) challenge/response (see wire.h) — then hands the fd
+// back to the listener for routing.
 class PendingConn : public Handler {
  public:
   PendingConn(Listener* listener, int fd, const std::string& authKey,
-              bool encrypt)
-      : listener_(listener), fd_(fd), authKey_(authKey),
+              const Keyring& keyring, bool encrypt)
+      : listener_(listener), fd_(fd), authKey_(authKey), keyring_(keyring),
         encrypt_(encrypt) {}
 
   int fd() const { return fd_; }
@@ -36,6 +36,7 @@ class PendingConn : public Handler {
   void handleEvents(uint32_t /*events*/) override {
     while (true) {
       const size_t want = phase_ == Phase::kHello      ? sizeof(WireHello)
+                          : phase_ == Phase::kRankIntro ? sizeof(uint32_t)
                           : phase_ == Phase::kNonce    ? kAuthNonceBytes
                           : phase_ == Phase::kShmOffer ? sizeof(WireShmOffer)
                           : phase_ == Phase::kShmName  ? size_t(offer_.nameLen)
@@ -67,7 +68,8 @@ class PendingConn : public Handler {
           pairId_ = hello.pairId;
           shmOffered_ = (hello.reserved & kHelloFlagShmOffer) != 0;
           const bool wantAuth = !authKey_.empty();
-          if (hello.magic == kHelloMagic && !wantAuth) {
+          const bool wantRing = keyring_.valid();
+          if (hello.magic == kHelloMagic && !wantAuth && !wantRing) {
             if (shmOffered_) {
               phase_ = Phase::kShmOffer;
               break;
@@ -75,15 +77,31 @@ class PendingConn : public Handler {
             listener_->finishPending(this, true, pairId_, fd_, ConnKeys{});
             return;
           }
-          // The hello must match this device's (auth, encrypt) tier
-          // exactly: plain vs authenticated vs encrypted mismatches (in
+          // The hello must match this device's (auth tier, encrypt) pair
+          // exactly: plain vs PSK vs keyring vs encrypted mismatches (in
           // either direction) and garbage are all rejected.
-          const uint32_t want = encrypt_ ? kHelloAuthEncMagic
-                                         : kHelloAuthMagic;
-          if (hello.magic != want || !wantAuth) {
+          const uint32_t want =
+              wantRing ? (encrypt_ ? kHelloRingEncMagic : kHelloRingMagic)
+                       : (encrypt_ ? kHelloAuthEncMagic : kHelloAuthMagic);
+          if (hello.magic != want || !(wantAuth || wantRing)) {
             listener_->finishPending(this, false, 0, fd_, ConnKeys{});
             return;
           }
+          phase_ = wantRing ? Phase::kRankIntro : Phase::kNonce;
+          break;
+        }
+        case Phase::kRankIntro: {
+          uint32_t claimed;
+          std::memcpy(&claimed, buf_, sizeof(claimed));
+          if (claimed >= static_cast<uint32_t>(keyring_.size()) ||
+              static_cast<int32_t>(claimed) == keyring_.rank()) {
+            TC_WARN("rejecting inbound connection: bad claimed rank ",
+                    claimed);
+            listener_->finishPending(this, false, 0, fd_, ConnKeys{});
+            return;
+          }
+          claimedRank_ = static_cast<int32_t>(claimed);
+          key_ = keyring_.keyFor(claimedRank_);
           phase_ = Phase::kNonce;
           break;
         }
@@ -112,14 +130,15 @@ class PendingConn : public Handler {
             return;
           }
           if (encrypt_) {
-            keys_ = deriveConnKeys(authKey_, pairId_, nonceI_, nonceL_,
+            keys_ = deriveConnKeys(connKey(), pairId_, nonceI_, nonceL_,
                                    /*initiator=*/false);
           }
           if (shmOffered_) {
             phase_ = Phase::kShmOffer;
             break;
           }
-          listener_->finishPending(this, true, pairId_, fd_, keys_);
+          listener_->finishPending(this, true, pairId_, fd_, keys_,
+                                   claimedRank_);
           return;
         }
         case Phase::kShmOffer: {
@@ -137,7 +156,8 @@ class PendingConn : public Handler {
               listener_->finishPending(this, false, 0, fd_, ConnKeys{});
               return;
             }
-            listener_->finishPending(this, true, pairId_, fd_, keys_);
+            listener_->finishPending(this, true, pairId_, fd_, keys_,
+                                     claimedRank_);
             return;
           }
           phase_ = Phase::kShmName;
@@ -162,7 +182,7 @@ class PendingConn : public Handler {
             return;
           }
           listener_->finishPending(this, true, pairId_, fd_, keys_,
-                                   std::move(seg));
+                                   claimedRank_, std::move(seg));
           return;
         }
       }
@@ -170,15 +190,31 @@ class PendingConn : public Handler {
   }
 
  private:
-  enum class Phase { kHello, kNonce, kClientMac, kShmOffer, kShmName };
+  enum class Phase {
+    kHello, kRankIntro, kNonce, kClientMac, kShmOffer, kShmName
+  };
+
+  // The HMAC/HKDF key for this connection: the pairwise K[self, claimed]
+  // on the keyring tier, the mesh PSK otherwise.
+  const std::string& connKey() const {
+    return claimedRank_ >= 0 ? key_ : authKey_;
+  }
 
   std::array<uint8_t, 32> transcriptMac(const char* role) const {
     std::string msg(role);
     msg.append(reinterpret_cast<const char*>(&pairId_), sizeof(pairId_));
+    if (claimedRank_ >= 0) {
+      // Keyring tier: both identities enter the transcript, so the MAC
+      // binds WHO is talking to WHOM, not just possession of a key.
+      const int32_t self = keyring_.rank();
+      msg.append(reinterpret_cast<const char*>(&claimedRank_),
+                 sizeof(claimedRank_));
+      msg.append(reinterpret_cast<const char*>(&self), sizeof(self));
+    }
     msg.append(reinterpret_cast<const char*>(nonceI_), kAuthNonceBytes);
     msg.append(reinterpret_cast<const char*>(nonceL_), kAuthNonceBytes);
-    return hmacSha256(authKey_.data(), authKey_.size(), msg.data(),
-                      msg.size());
+    const std::string& key = connKey();
+    return hmacSha256(key.data(), key.size(), msg.data(), msg.size());
   }
 
   static bool writeFullNoSig(int fd, const void* buf, size_t n) {
@@ -204,9 +240,12 @@ class PendingConn : public Handler {
   Listener* const listener_;
   const int fd_;
   const std::string& authKey_;
+  const Keyring& keyring_;
   const bool encrypt_;
   Phase phase_{Phase::kHello};
   uint64_t pairId_{0};
+  int32_t claimedRank_{-1};  // keyring tier: the authenticated peer rank
+  std::string key_;          // keyring tier: K[self, claimedRank_]
   uint8_t nonceI_[kAuthNonceBytes];
   uint8_t nonceL_[kAuthNonceBytes];
   bool shmOffered_{false};
@@ -217,8 +256,10 @@ class PendingConn : public Handler {
 };
 
 Listener::Listener(Loop* loop, const SockAddr& bindAddr,
-                   const std::string& authKey, bool encrypt)
-    : loop_(loop), authKey_(authKey), encrypt_(encrypt) {
+                   const std::string& authKey, const Keyring& keyring,
+                   bool encrypt)
+    : loop_(loop), authKey_(authKey), keyring_(keyring),
+      encrypt_(encrypt) {
   fd_ = socket(bindAddr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd_, 0, errnoString("socket"));
   setReuseAddr(fd_);
@@ -266,7 +307,8 @@ void Listener::handleEvents(uint32_t /*events*/) {
       return;
     }
     setNoDelay(fd);
-    auto conn = std::make_unique<PendingConn>(this, fd, authKey_, encrypt_);
+    auto conn = std::make_unique<PendingConn>(this, fd, authKey_, keyring_,
+                                              encrypt_);
     PendingConn* raw = conn.get();
     {
       std::lock_guard<std::mutex> guard(mu_);
@@ -277,7 +319,7 @@ void Listener::handleEvents(uint32_t /*events*/) {
 }
 
 void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
-                             int fd, ConnKeys keys,
+                             int fd, ConnKeys keys, int32_t authedRank,
                              std::unique_ptr<ShmSegment> shm) {
   Pair* target = nullptr;
   {
@@ -295,10 +337,29 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
     if (ok) {
       auto it = expected_.find(pairId);
       if (it != expected_.end()) {
-        target = it->second;
-        expected_.erase(it);
+        // Keyring tier: the connection proved possession of
+        // K[self, authedRank]; it may only land on the pair built for
+        // exactly that peer. A legitimate rank a replaying its own key
+        // against a slot expecting rank b dies here.
+        if (authedRank >= 0 && it->second->peerRank() != authedRank) {
+          TC_WARN("rejecting inbound connection: authenticated as rank ",
+                  authedRank, " but pair ", pairId, " expects rank ",
+                  it->second->peerRank());
+          ok = false;
+        } else {
+          target = it->second;
+          expected_.erase(it);
+        }
       } else {
-        parked_[pairId] = Parked{fd, keys, std::move(shm)};
+        auto old = parked_.find(pairId);
+        if (old != parked_.end()) {
+          // An earlier fully-handshaked connection for the same pairId
+          // (initiator retry, or a credential holder reconnecting) is
+          // superseded; close it rather than leak the fd.
+          ::close(old->second.fd);
+          parked_.erase(old);
+        }
+        parked_[pairId] = Parked{fd, keys, authedRank, std::move(shm)};
       }
     }
   }
@@ -320,10 +381,24 @@ void Listener::expect(uint64_t pairId, Pair* pair) {
     std::lock_guard<std::mutex> guard(mu_);
     auto it = parked_.find(pairId);
     if (it != parked_.end()) {
-      fd = it->second.fd;
-      keys = it->second.keys;
-      shm = std::move(it->second.shm);
-      parked_.erase(it);
+      const int32_t authedRank = it->second.authedRank;
+      if (authedRank >= 0 && pair->peerRank() != authedRank) {
+        // Same identity-vs-slot check as finishPending, for connections
+        // that arrived before the pair registered. Drop the parked fd;
+        // the pair keeps waiting (and times out) rather than accepting
+        // a mismatched identity.
+        TC_WARN("dropping parked connection: authenticated as rank ",
+                authedRank, " but pair ", pairId, " expects rank ",
+                pair->peerRank());
+        ::close(it->second.fd);
+        parked_.erase(it);
+        expected_[pairId] = pair;
+      } else {
+        fd = it->second.fd;
+        keys = it->second.keys;
+        shm = std::move(it->second.shm);
+        parked_.erase(it);
+      }
     } else {
       expected_[pairId] = pair;
     }

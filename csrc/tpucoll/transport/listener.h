@@ -12,6 +12,7 @@
 #include <mutex>
 #include <unordered_map>
 
+#include "tpucoll/common/keyring.h"
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/loop.h"
 #include "tpucoll/transport/shm.h"
@@ -25,8 +26,10 @@ class PendingConn;
 
 class Listener : public Handler {
  public:
-  Listener(Loop* loop, const SockAddr& bindAddr,
-           const std::string& authKey = "", bool encrypt = false);
+  // `authKey` and `keyring` are stored by reference: the owning Device
+  // outlives the Listener (device.h member order).
+  Listener(Loop* loop, const SockAddr& bindAddr, const std::string& authKey,
+           const Keyring& keyring, bool encrypt);
   ~Listener() override;
 
   const SockAddr& address() const { return addr_; }
@@ -42,21 +45,26 @@ class Listener : public Handler {
   // the connection's AEAD keys when the device encrypts; `shm` the accepted
   // same-host payload segment (listener side), if any. keys is BY VALUE:
   // callers pass the dying PendingConn's member, which this function frees
-  // before handing the keys on.
+  // before handing the keys on. `authedRank` is the rank the keyring tier
+  // authenticated (-1 on the PSK/plain tiers): routing additionally
+  // enforces it equals the expecting pair's peer rank, so K[a,b] lets its
+  // holder speak only AS a or b — not claim a third identity.
   void finishPending(PendingConn* conn, bool ok, uint64_t pairId, int fd,
-                     ConnKeys keys,
+                     ConnKeys keys, int32_t authedRank = -1,
                      std::unique_ptr<ShmSegment> shm = nullptr);
 
  private:
   Loop* const loop_;
   int fd_{-1};
   SockAddr addr_;
-  const std::string authKey_;
+  const std::string& authKey_;
+  const Keyring& keyring_;
   const bool encrypt_;
 
   struct Parked {
     int fd;
     ConnKeys keys;
+    int32_t authedRank;
     std::unique_ptr<ShmSegment> shm;
   };
 

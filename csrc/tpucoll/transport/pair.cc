@@ -272,18 +272,44 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
   };
 
   // Route this connection to the peer's expecting Pair; with a pre-shared
-  // key, run the mutual challenge/response of wire.h on top (and, when the
-  // device encrypts, derive the connection's AEAD keys from it). When both
-  // endpoints share an IP, also offer the shared-memory payload plane.
+  // key or per-rank keyring, run the mutual challenge/response of wire.h
+  // on top (and, when the device encrypts, derive the connection's AEAD
+  // keys from it). When both endpoints share an IP, also offer the
+  // shared-memory payload plane.
   const bool encrypt = context_->device()->encrypt();
+  const Keyring& keyring = context_->device()->keyring();
+  const bool ringTier = keyring.valid();
   const bool offerShm = shmEnabled() && sameHostFd(fd);
-  WireHello hello{authKey.empty() ? kHelloMagic
-                  : encrypt       ? kHelloAuthEncMagic
-                                  : kHelloAuthMagic,
-                  offerShm ? kHelloFlagShmOffer : 0, remotePairId};
+  const uint32_t magic =
+      ringTier ? (encrypt ? kHelloRingEncMagic : kHelloRingMagic)
+      : authKey.empty() ? kHelloMagic
+      : encrypt         ? kHelloAuthEncMagic
+                        : kHelloAuthMagic;
+  WireHello hello{magic, offerShm ? kHelloFlagShmOffer : 0, remotePairId};
   writeAll(&hello, sizeof(hello), "hello");
   ConnKeys keys;
-  if (!authKey.empty()) {
+  if (ringTier || !authKey.empty()) {
+    // Keyring tier: announce our identity, authenticate with the
+    // pairwise key K[selfRank, peerRank] that exactly the two legitimate
+    // endpoints hold, and bind both identities into the transcript. The
+    // listener verifies possession AND (at routing) that the claimed
+    // rank matches the slot, so a leaked keyring speaks only as its own
+    // rank (common/keyring.h threat model; reference analog: per-process
+    // TLS identity, gloo/transport/tcp/tls/context.h:25-42).
+    std::string ringKey;
+    if (ringTier) {
+      try {
+        TC_ENFORCE_EQ(keyring.rank(), selfRank_,
+                      "keyring was derived for a different rank");
+        ringKey = keyring.keyFor(peerRank_);
+      } catch (...) {
+        ::close(fd);  // every throw path here must release the socket
+        throw;
+      }
+      const uint32_t self = static_cast<uint32_t>(selfRank_);
+      writeAll(&self, sizeof(self), "rank intro");
+    }
+    const std::string& key = ringTier ? ringKey : authKey;
     uint8_t nonceI[kAuthNonceBytes];
     randomBytes(nonceI, sizeof(nonceI));
     writeAll(nonceI, sizeof(nonceI), "auth nonce");
@@ -294,10 +320,15 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
       std::string msg(role);
       msg.append(reinterpret_cast<const char*>(&remotePairId),
                  sizeof(remotePairId));
+      if (ringTier) {
+        const int32_t self = selfRank_;
+        const int32_t peer = peerRank_;
+        msg.append(reinterpret_cast<const char*>(&self), sizeof(self));
+        msg.append(reinterpret_cast<const char*>(&peer), sizeof(peer));
+      }
       msg.append(reinterpret_cast<const char*>(nonceI), kAuthNonceBytes);
       msg.append(reinterpret_cast<const char*>(reply), kAuthNonceBytes);
-      return hmacSha256(authKey.data(), authKey.size(), msg.data(),
-                        msg.size());
+      return hmacSha256(key.data(), key.size(), msg.data(), msg.size());
     };
     auto srvExpect = transcript("srv");
     if (!macEqual(reply + kAuthNonceBytes, srvExpect.data(),
@@ -309,7 +340,7 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
     auto cliMac = transcript("cli");
     writeAll(cliMac.data(), cliMac.size(), "auth tag");
     if (encrypt) {
-      keys = deriveConnKeys(authKey, remotePairId, nonceI, reply,
+      keys = deriveConnKeys(key, remotePairId, nonceI, reply,
                             /*initiator=*/true);
     }
   }

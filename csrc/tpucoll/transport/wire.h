@@ -41,6 +41,18 @@ constexpr uint32_t kHelloAuthMagic = 0x7C011003;
 // (the AEAD nonce), so reordering/replay/tampering all fail the tag and
 // poison the pair with an IoException.
 constexpr uint32_t kHelloAuthEncMagic = 0x7C011004;
+// Per-rank identity tier (common/keyring.h): the same mutual
+// challenge/response, but keyed with the PAIRWISE key K[a,b] that only
+// ranks a and b hold, so a leaked keyring impersonates one rank, not
+// the fleet (the reference's per-process TLS identity property,
+// gloo/transport/tcp/tls/context.h:25-42). The 16-byte hello is
+// followed by le32(initiatorRank) before the nonce exchange; BOTH
+// ranks enter the transcript, and the listener additionally enforces
+// at routing time that the authenticated rank matches the rank the
+// expecting pair was built for — possession of K[a,b] lets you speak
+// only as a to b and b to a.
+constexpr uint32_t kHelloRingMagic = 0x7C011008;
+constexpr uint32_t kHelloRingEncMagic = 0x7C011009;
 
 constexpr size_t kAuthNonceBytes = 16;
 constexpr size_t kAuthMacBytes = 32;

@@ -30,6 +30,7 @@ from gloo_tpu.core import (
     TimeoutError,
     UnboundBuffer,
     crypto_isa_tier,
+    derive_keyring,
     uring_available,
 )
 
@@ -52,5 +53,6 @@ __all__ = [
     "UnboundBuffer",
     "__version__",
     "crypto_isa_tier",
+    "derive_keyring",
     "uring_available",
 ]

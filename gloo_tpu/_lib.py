@@ -84,7 +84,10 @@ _PROTOTYPES = {
     # device / context
     "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16,
                        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
-                       ctypes.c_int, ctypes.c_char_p]),
+                       ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]),
+    "tc_derive_keyring": (_int, [ctypes.c_char_p, _int, _int,
+                                 ctypes.POINTER(
+                                     ctypes.POINTER(ctypes.c_uint8))]),
     "tc_device_free": (None, [_c]),
     "tc_device_engine_stats": (None, [_c, ctypes.POINTER(_u64),
                                       ctypes.POINTER(_u64),

@@ -240,6 +240,21 @@ def uring_available() -> bool:
     return bool(_lib.lib.tc_uring_available())
 
 
+def derive_keyring(root_key: str, rank: int, size: int) -> str:
+    """Launcher-side per-rank identity derivation (docs/transport.md
+    "Per-rank identity"): from a root secret the launcher keeps, derive
+    rank `rank`'s keyring of pairwise keys K[rank, s] and hand ONLY the
+    returned string to that worker (Device(keyring=...)). Workers never
+    see the root; a leaked keyring impersonates its one rank, not the
+    mesh. Rotation = new root, re-derive, restart."""
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    check(_lib.lib.tc_derive_keyring(root_key.encode(), rank, size,
+                                     ctypes.byref(out)))
+    s = ctypes.cast(out, ctypes.c_char_p).value.decode()
+    _lib.lib.tc_buf_free(out)
+    return s
+
+
 def crypto_isa_tier() -> int:
     """AEAD bulk tier this process dispatches to: 2 = fused AVX-512,
     1 = AVX2 8-block, 0 = scalar. All tiers are wire-compatible;
@@ -258,28 +273,36 @@ class Device:
     def __init__(self, hostname: str = "127.0.0.1", port: int = 0,
                  auth_key: Optional[str] = None, encrypt: bool = False,
                  iface: Optional[str] = None, busy_poll: bool = False,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 keyring: Optional[str] = None):
         """auth_key: pre-shared key enabling the mutual HMAC handshake on
         every connection (all ranks must agree; see docs/transport.md).
-        encrypt=True additionally encrypts the data plane with
-        per-connection ChaCha20-Poly1305 keys derived from the PSK
-        handshake (requires auth_key; all ranks must agree — plaintext
-        and encrypted peers reject each other at hello). iface binds by
-        interface NAME (its first address overrides hostname).
-        busy_poll=True spins instead of sleeping (loop thread and
-        blocking waits) — the reference's sync mode for the sub-10us
-        latency regime; burns a core. engine picks the event engine:
-        "epoll" | "uring" (io_uring) | "auto"; default = TPUCOLL_ENGINE
-        env, else auto (docs/transport.md)."""
-        if encrypt and not auth_key:
-            raise ValueError("encrypt=True requires auth_key")
+        keyring: per-rank identity tier instead — a serialized keyring
+        from derive_keyring(); connections then authenticate with the
+        PAIRWISE key only the two endpoints hold, so a leaked worker
+        credential impersonates one rank, not the mesh. Mutually
+        exclusive with auth_key. encrypt=True additionally encrypts the
+        data plane with per-connection ChaCha20-Poly1305 keys derived
+        from the handshake (requires auth_key or keyring; all ranks must
+        agree — plaintext and encrypted peers reject each other at
+        hello). iface binds by interface NAME (its first address
+        overrides hostname). busy_poll=True spins instead of sleeping
+        (loop thread and blocking waits) — the reference's sync mode for
+        the sub-10us latency regime; burns a core. engine picks the
+        event engine: "epoll" | "uring" (io_uring) | "auto"; default =
+        TPUCOLL_ENGINE env, else auto (docs/transport.md)."""
+        if encrypt and not (auth_key or keyring):
+            raise ValueError("encrypt=True requires auth_key or keyring")
+        if auth_key and keyring:
+            raise ValueError("auth_key and keyring are mutually exclusive")
         self._handle = check_handle(
             _lib.lib.tc_device_new(hostname.encode(), port,
                                    auth_key.encode() if auth_key else None,
                                    1 if encrypt else 0,
                                    iface.encode() if iface else None,
                                    1 if busy_poll else 0,
-                                   engine.encode() if engine else None))
+                                   engine.encode() if engine else None,
+                                   keyring.encode() if keyring else None))
         self._free = _lib.lib.tc_device_free
 
     def engine_stats(self) -> dict:

@@ -86,3 +86,167 @@ def test_connect_debug_records():
     assert rec["attempt"] == 1 and rec["error"] == ""
     assert rec["remote"].startswith("127.0.0.1:")
     assert rec["local"].startswith("127.0.0.1:")
+
+
+# ---- per-rank identity keyrings (docs/transport.md "Per-rank identity";
+# reference analog: per-process TLS key/cert, tls/context.h:25-42) ----
+
+
+ROOT = "launcher-root-secret"
+
+
+def test_keyring_mesh_connects():
+    rings = [gloo_tpu.derive_keyring(ROOT, r, 3) for r in range(3)]
+    results, errors = _spawn_group(
+        3, lambda rank: gloo_tpu.Device(keyring=rings[rank]))
+    assert errors == [None, None, None], errors
+    assert results == [6.0, 6.0, 6.0]
+
+
+def test_keyring_mesh_encrypted_connects():
+    rings = [gloo_tpu.derive_keyring(ROOT, r, 3) for r in range(3)]
+    results, errors = _spawn_group(
+        3, lambda rank: gloo_tpu.Device(keyring=rings[rank], encrypt=True))
+    assert errors == [None, None, None], errors
+    assert results == [6.0, 6.0, 6.0]
+
+
+def test_keyring_for_wrong_rank_refused_locally():
+    """The initiator refuses to use a keyring derived for a different
+    rank — the cheapest impersonation (pass rank 1's keyring to a rank-2
+    context) dies before any bytes hit the wire."""
+    ring1 = gloo_tpu.derive_keyring(ROOT, 1, 3)
+
+    def device_fn(rank):
+        return gloo_tpu.Device(
+            keyring=ring1 if rank == 2 else gloo_tpu.derive_keyring(
+                ROOT, rank, 3))
+
+    results, errors = _spawn_group(3, device_fn, timeout=3.0)
+    assert all(r is None for r in results)
+    assert all(e is not None for e in errors), errors
+
+
+def test_keyring_credential_cannot_claim_another_rank():
+    """THE leak-containment property: a forged keyring that claims rank 2
+    but carries rank 1's pairwise keys cannot connect anywhere — rank 1's
+    credential does not let its holder authenticate as rank 2 (the
+    listener keys the challenge off the claimed rank, and K[0,2] is not
+    derivable from rank 1's keyring)."""
+    ring1 = gloo_tpu.derive_keyring(ROOT, 1, 3)
+    assert ring1.startswith("tcring1:1:3:")
+    forged = ring1.replace("tcring1:1:3:", "tcring1:2:3:", 1)
+
+    def device_fn(rank):
+        return gloo_tpu.Device(
+            keyring=forged if rank == 2 else gloo_tpu.derive_keyring(
+                ROOT, rank, 3))
+
+    results, errors = _spawn_group(3, device_fn, timeout=3.0)
+    # Ranks 0 and 1 talk to each other fine but never see a valid rank 2;
+    # the forger is rejected at every handshake. Nobody hangs.
+    assert results[2] is None
+    assert errors[2] is not None, errors
+    assert errors[0] is not None and errors[1] is not None, errors
+
+
+def test_keyring_vs_psk_tier_rejected():
+    def device_fn(rank):
+        if rank == 0:
+            return gloo_tpu.Device(keyring=gloo_tpu.derive_keyring(
+                ROOT, 0, 2))
+        return gloo_tpu.Device(auth_key=ROOT)
+
+    results, errors = _spawn_group(2, device_fn, timeout=3.0)
+    assert all(r is None for r in results)
+    assert all(e is not None for e in errors), errors
+
+
+def test_keyring_different_roots_rejected():
+    def device_fn(rank):
+        root = ROOT if rank == 0 else "some-other-root"
+        return gloo_tpu.Device(keyring=gloo_tpu.derive_keyring(root, rank, 2))
+
+    results, errors = _spawn_group(2, device_fn, timeout=3.0)
+    assert all(r is None for r in results)
+    assert all(e is not None for e in errors), errors
+
+
+def test_keyring_valid_key_wrong_slot_rejected_at_routing():
+    """A possessed key must not open a different rank's slot: a raw-wire
+    client holding rank 1's REAL credential authenticates as rank 1 but
+    targets the pairId rank 0 allocated for rank 2. The HMAC handshake
+    succeeds (the key is genuine); the listener's routing check must then
+    drop the connection instead of delivering it to the rank-2 pair."""
+    import hashlib
+    import hmac as pyhmac
+    import socket
+    import struct
+    import tempfile
+    import time
+
+    store_dir = tempfile.mkdtemp()
+    store = gloo_tpu.FileStore(store_dir)
+    ring0 = gloo_tpu.derive_keyring(ROOT, 0, 3)
+    ring1 = gloo_tpu.derive_keyring(ROOT, 1, 3)
+    k01 = bytes.fromhex(ring1.split(":", 3)[3])[:32]  # slot 0 = K[0,1]
+
+    state = {}
+
+    def rank0():
+        ctx = gloo_tpu.Context(0, 3, timeout=8.0)
+        try:
+            ctx.connect_full_mesh(store, gloo_tpu.Device(keyring=ring0))
+            state["rank0"] = "connected"  # must NOT happen
+        except gloo_tpu.Error:
+            state["rank0"] = "timed out"  # ranks 1/2 never join the mesh
+
+    t0 = threading.Thread(target=rank0, daemon=True)
+    t0.start()
+
+    # Read rank 0's published blob: [u32 n][u32 addrLen][addr][u64 ids[n]]
+    # where addr = [socklen][sockaddr_storage prefix] (address.cc).
+    blob = None
+    for _ in range(100):
+        try:
+            blob = bytes(store.get("tc/rank/0", timeout=0.1))
+            break
+        except gloo_tpu.Error:
+            time.sleep(0.05)
+    assert blob is not None
+    n, alen = struct.unpack_from("<II", blob, 0)
+    assert n == 3
+    ab = blob[8:8 + alen]
+    fam = struct.unpack_from("<H", ab, 4)[0]
+    assert fam == socket.AF_INET, fam
+    port = struct.unpack_from(">H", ab, 6)[0]
+    host = socket.inet_ntoa(ab[8:12])
+    ids = struct.unpack_from("<3Q", blob, 8 + alen)
+    pair_for_rank2 = ids[2]
+
+    # Raw keyring-tier handshake: claim rank 1 (we DO hold K[0,1]), but
+    # target the slot rank 0 reserved for rank 2.
+    s = socket.create_connection((host, int(port)), timeout=5)
+    s.sendall(struct.pack("<IIQ", 0x7C011008, 0, pair_for_rank2))
+    s.sendall(struct.pack("<I", 1))  # claimed rank
+    nonce_i = b"\x11" * 16
+    s.sendall(nonce_i)
+    reply = b""
+    while len(reply) < 48:
+        chunk = s.recv(48 - len(reply))
+        assert chunk, "listener closed before the challenge reply"
+        reply += chunk
+    nonce_l, srv_mac = reply[:16], reply[16:]
+    transcript = (struct.pack("<Q", pair_for_rank2) +
+                  struct.pack("<ii", 1, 0) + nonce_i + nonce_l)
+    expect = pyhmac.new(k01, b"srv" + transcript, hashlib.sha256).digest()
+    assert srv_mac == expect, "listener keyed the challenge off K[0,1]"
+    s.sendall(pyhmac.new(k01, b"cli" + transcript, hashlib.sha256).digest())
+    # Authentication succeeded — but routing must reject the identity/slot
+    # mismatch by closing the connection (EOF), not delivering it.
+    s.settimeout(5)
+    assert s.recv(1) == b"", "expected EOF after routing rejection"
+    s.close()
+
+    t0.join(20)
+    assert state.get("rank0") == "timed out", state
