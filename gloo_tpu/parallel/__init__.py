@@ -10,8 +10,9 @@ by shipping the strategies themselves, each built on a gloo_tpu plane:
 - `tp`: Megatron-style tensor parallelism (column/row-parallel dense);
 - `sp`: sequence/context parallelism — ring attention over ppermute,
   plus Ulysses-style all-to-all head/sequence exchange;
-- `pp`: GPipe-style pipeline parallelism — stages rotate activations
-  with ppermute under one lax.scan;
+- `pp`: pipeline parallelism — the GPipe forward schedule plus the
+  1F1B training schedule (activation stash bounded by stages, not
+  microbatches), both static timetables under one lax.scan;
 - `ep`: expert parallelism — fixed-capacity MoE dispatch/combine over
   all_to_all;
 - `fsdp`: ZeRO-3-style fully-sharded data parallelism — just-in-time
@@ -23,7 +24,7 @@ from gloo_tpu.parallel.ddp import HostGradSync, make_ddp_train_step
 from gloo_tpu.parallel.ep import dispatch_combine
 from gloo_tpu.parallel.fsdp import (make_fsdp_train_step, shard_params,
                                     unshard_params)
-from gloo_tpu.parallel.pp import pipeline_apply
+from gloo_tpu.parallel.pp import pipeline_apply, pipeline_train_1f1b
 from gloo_tpu.parallel.sp import (ring_attention, ring_flash_attention,
                                   ulysses_attention)
 from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
@@ -36,6 +37,7 @@ __all__ = [
     "make_ddp_train_step",
     "make_fsdp_train_step",
     "pipeline_apply",
+    "pipeline_train_1f1b",
     "ring_attention",
     "ring_flash_attention",
     "row_parallel_dense",
