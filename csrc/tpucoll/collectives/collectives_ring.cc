@@ -526,13 +526,17 @@ void reduce(ReduceOptions& opts) {
   const bool fuseOk = opts.customFn == nullptr;
   ReduceAlgorithm algo = opts.algorithm;
   if (algo == ReduceAlgorithm::kAuto) {
-    // Crossover measured on loopback P=4/8 (BASELINE.md round 3): the
-    // binomial wins p50 through ~4 MiB (its log2(P) full-payload rounds
-    // ride the eager pipeline well on one host), the ring wins p50 AND
-    // p99 beyond; on real multi-host DCN the root's in-link serializes
-    // much earlier — drop TPUCOLL_REDUCE_BINOMIAL_MAX to ~256K-1M there.
+    // Crossover measured on loopback P=4/8 (BASELINE.md reduce-to-root
+    // table, r4 re-sweep): the binomial wins p50 through ~4 MiB (its
+    // log2(P) full-payload rounds ride the eager pipeline well on one
+    // host) but its p99 tail is 3-4x WORSE than the ring's from ~1 MiB
+    // up (full-payload rounds spike when the shared-core scheduler
+    // misaligns). The default follows the p99 crossover — tail latency
+    // is what a collective's callers stall on — and real multi-host DCN
+    // crosses earlier still (the root's in-link serializes):
+    // drop TPUCOLL_REDUCE_BINOMIAL_MAX to ~256K-1M there.
     static const size_t binMax = collectives_detail::envBytes(
-        "TPUCOLL_REDUCE_BINOMIAL_MAX", 4u << 20);
+        "TPUCOLL_REDUCE_BINOMIAL_MAX", 2u << 20);
     algo = nbytes <= binMax ? ReduceAlgorithm::kBinomial
                             : ReduceAlgorithm::kRing;
   }
