@@ -1,0 +1,134 @@
+"""Machine-readable benchmark sweep -> BASELINE_sweep.json.
+
+The reference's benchmark harness is built for reproducible comparison
+(gloo/benchmark/runner.cc:475-516: timed iterations, percentile
+summaries, one line per config). This sweep is the repo's equivalent
+artifact: every cell of workload x payload x ranks x payload-plane
+{plain TCP, shm, encrypted} x event engine {epoll, uring} measured with
+the SAME multi-process methodology (FileStore rendezvous, one OS
+process per rank — the deployment shape, not the thread harness), so
+BASELINE.md tables can cite committed JSON instead of hand-transcribed
+prose, and round-over-round regressions are a `diff` away.
+
+Usage: python tools/bench_sweep.py [--quick] [--out BASELINE_sweep.json]
+Each cell records p50/p99/min latency (us), algorithm bandwidth at p50,
+and iteration count, straight from tpucoll_bench --json.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "build", "tpucoll_bench")
+
+OPS = ["allreduce", "reduce_scatter", "broadcast"]
+ELEMENTS = [1024, 262144, 4194304]  # 4 KiB, 1 MiB, 16 MiB of f32
+RANKS = [2, 4]
+# (label, env overrides, extra argv) — the payload-plane tiers.
+PLANES = [
+    ("plain", {"TPUCOLL_SHM": "0"}, []),
+    # Pinned to "1" so an inherited TPUCOLL_SHM=0 cannot silently turn
+    # the shm cells into plain-TCP measurements labeled "shm".
+    ("shm", {"TPUCOLL_SHM": "1"}, []),
+    ("encrypted", {"TPUCOLL_SHM": "0"},
+     ["--auth-key", "sweep-key", "--encrypt"]),
+]
+ENGINES = ["epoll", "uring"]
+
+
+def run_cell(op, elements, ranks, plane, engine, min_time):
+    """One measurement cell. Fault-isolated: a hung/crashed/garbled cell
+    returns {"error": ...} instead of aborting the sweep, and its rank
+    processes and rendezvous dir are always reaped."""
+    label, env_over, extra = plane
+    store = tempfile.mkdtemp(prefix="tcsweep-")
+    env = dict(os.environ, TPUCOLL_ENGINE=engine, **env_over)
+    base = [BENCH, "--size", str(ranks), "--store", f"file:{store}",
+            "--op", op, "--elements", str(elements),
+            "--min-time", str(min_time), "--json", *extra]
+    procs = []
+    try:
+        procs = [subprocess.Popen(base + ["--rank", str(r)], env=env,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.DEVNULL, text=True)
+                 for r in range(1, ranks)]
+        out = subprocess.run(base + ["--rank", "0"], env=env,
+                             capture_output=True, text=True, timeout=120)
+        for p in procs:
+            p.communicate(timeout=120)
+        if out.returncode != 0:
+            return {"error": out.stderr.strip()[-200:]}
+        d = json.loads(out.stdout.splitlines()[0])
+        return {"p50_us": d["p50_us"], "p99_us": d["p99_us"],
+                "min_us": d["min_us"], "algbw_gbps": d["algbw_gbps"],
+                "iters": d["iters"]}
+    except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError,
+            KeyError) as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        shutil.rmtree(store, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BASELINE_sweep.json"))
+    ap.add_argument("--quick", action="store_true",
+                    help="0.5s cells instead of 2s (smoke runs)")
+    args = ap.parse_args()
+    if not os.path.exists(BENCH):
+        sys.exit("build/tpucoll_bench missing - run `make native` first")
+    min_time = 0.5 if args.quick else 2.0
+
+    cells = []
+    t0 = time.time()
+    total = len(OPS) * len(ELEMENTS) * len(RANKS) * len(PLANES) * \
+        len(ENGINES)
+    n = 0
+    for op in OPS:
+        for elements in ELEMENTS:
+            for ranks in RANKS:
+                for plane in PLANES:
+                    for engine in ENGINES:
+                        n += 1
+                        res = run_cell(op, elements, ranks, plane, engine,
+                                       min_time)
+                        cell = {"op": op, "elements": elements,
+                                "bytes": elements * 4, "ranks": ranks,
+                                "plane": plane[0], "engine": engine,
+                                **res}
+                        cells.append(cell)
+                        print(f"[{n}/{total}] {op} {elements * 4 >> 10}KiB "
+                              f"P={ranks} {plane[0]}/{engine}: "
+                              f"{res.get('p50_us', res)} us p50",
+                              file=sys.stderr)
+
+    doc = {
+        "methodology": "multi-process (one OS process per rank), "
+                       "FileStore rendezvous, tpucoll_bench --json; "
+                       "p50/p99/min over timed iterations after warmup; "
+                       f"min-time {min_time}s per cell",
+        "host": "single shared core (BASELINE.md: +/-15% run-to-run); "
+                "treat cross-cell ratios, not absolutes, as the signal",
+        "timestamp_unix": int(t0),
+        "cells": cells,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}: {len(cells)} cells in "
+          f"{time.time() - t0:.0f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
