@@ -234,3 +234,43 @@ def test_virtual_ring_selfloop_bench_path():
     np.testing.assert_allclose(np.asarray(y)[:chunk],
                                xs.astype(np.float64) @ w.astype(np.float64),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_fori_fallback_matches_unrolled(n=4, monkeypatch=None):
+    """Pod-size rings (> _kMaxUnrollRing) take the fori_loop form of the
+    ring walk instead of the static unroll; force it and pin both
+    kernels against the same oracles the unrolled path satisfies."""
+    import gloo_tpu.ops.overlap as ov
+
+    saved = ov._kMaxUnrollRing
+    ov._kMaxUnrollRing = 1  # every ring takes the fallback
+    # New jit cache keys: bump collective ids so cached unrolled
+    # executables are not reused.
+    try:
+        mesh = _mesh(n)
+        m, k_total, cols = 8 * n, 16 * n, 128
+        x = _rand((m, k_total), 20)
+        w = _rand((k_total, cols), 21)
+        fn = jax.jit(jax.shard_map(
+            lambda xs, ws: matmul_reduce_scatter(
+                xs, ws, "x", interpret=True, collective_id=41),
+            mesh=mesh, in_specs=(P(None, "x"), P("x", None)),
+            out_specs=P("x", None), check_vma=False))
+        np.testing.assert_allclose(
+            np.asarray(fn(x, w)),
+            x.astype(np.float64) @ w.astype(np.float64),
+            rtol=2e-5, atol=2e-5)
+
+        x2 = _rand((8 * n, 32), 22)
+        w2 = _rand((32, cols), 23)
+        fn2 = jax.jit(jax.shard_map(
+            lambda xs, ws: allgather_matmul(
+                xs, ws, "x", interpret=True, collective_id=43),
+            mesh=mesh, in_specs=(P("x", None), P(None, None)),
+            out_specs=P(None, None), check_vma=False))
+        np.testing.assert_allclose(
+            np.asarray(fn2(x2, w2)),
+            x2.astype(np.float64) @ w2.astype(np.float64),
+            rtol=2e-5, atol=2e-5)
+    finally:
+        ov._kMaxUnrollRing = saved

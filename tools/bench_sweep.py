@@ -81,11 +81,16 @@ def run_cell(op, elements, ranks, plane, engine, min_time):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "BASELINE_sweep.json"))
+    ap.add_argument("--out", default=None,
+                    help="output path (default: BASELINE_sweep.json; "
+                         "--quick defaults elsewhere so smoke runs never "
+                         "clobber the committed regression baseline)")
     ap.add_argument("--quick", action="store_true",
                     help="0.5s cells instead of 2s (smoke runs)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = ("/tmp/BASELINE_sweep_quick.json" if args.quick
+                    else os.path.join(REPO, "BASELINE_sweep.json"))
     if not os.path.exists(BENCH):
         sys.exit("build/tpucoll_bench missing - run `make native` first")
     min_time = 0.5 if args.quick else 2.0

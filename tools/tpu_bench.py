@@ -28,7 +28,10 @@ def main():
                         choices=["allreduce", "allgather", "reduce_scatter",
                                  "alltoall", "ppermute", "pallas_ring",
                                  "pallas_ring_hbm", "flash_attention",
-                                 "flash_attention_bwd", "overlap", "all"])
+                                 "flash_attention_bwd", "overlap",
+                                 "tp_step", "all"])
+    parser.add_argument("--tp-shape", default="2048x4096x4096",
+                        help="MxDxF for --op tp_step (seq x model x ffn)")
     parser.add_argument("--elements", default="1024,65536,1048576,16777216")
     parser.add_argument("--min-time", type=float, default=1.0)
     parser.add_argument("--warmup", type=int, default=3)
@@ -42,7 +45,7 @@ def main():
                         help="virtual ring size for --op overlap")
     args = parser.parse_args()
 
-    if args.op == "overlap":
+    if args.op in ("overlap", "tp_step"):
         # The overlap kernels keep x, w and 4 staging buffers resident in
         # VMEM; the default 16 MiB scoped-vmem budget rejects realistic TP
         # shard shapes. Must be set before libtpu loads — and ONLY for
@@ -61,6 +64,11 @@ def main():
         subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--op", "overlap",
              "--overlap-shapes", args.overlap_shapes,
+             "--overlap-ranks", str(args.overlap_ranks),
+             "--warmup", str(args.warmup)], check=False)
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--op", "tp_step",
+             "--tp-shape", args.tp_shape,
              "--overlap-ranks", str(args.overlap_ranks),
              "--warmup", str(args.warmup)], check=False)
 
@@ -138,6 +146,9 @@ def main():
             bench_overlap(args, jax, jnp, mesh, axis)
         # else: already ran as a pre-JAX-init subprocess above
         ops = [o for o in ops if o != "overlap"]
+    if "tp_step" in ops:
+        bench_tp_step(args, jax, jnp, axis)
+        ops = [o for o in ops if o != "tp_step"]
     for op in ops:
         for elements in elements_list:
             try:
@@ -349,6 +360,153 @@ def bench_overlap(args, jax, jnp, mesh, axis):
             print(f"{name:>16} {m * k * 2:>12} {f'{m}x{k}':>12} "
                   f"{per * 1e6:>9.1f} {'-':>9} {'-':>9} "
                   f"{rates[name]:>12.3f} {ratio}")
+
+
+def bench_tp_step(args, jax, jnp, axis):
+    """End-to-end fused-TP training-step A/B on one chip (VERDICT r3 #8).
+
+    The integration proof the kernel microbenches don't give: a full
+    forward + backward + SGD update through the Megatron-SP MLP pair,
+    with BOTH collectives fused into their matmuls (allgather_matmul up,
+    matmul_reduce_scatter down; each kernel is the other's VJP seed), vs
+    the identical-FLOP unfused step (plain dots — on ONE chip the
+    collectives are free, so plain dots are exactly the unfused math).
+    Virtual-ring mode: the fused path executes its full V-step schedule
+    with self-loop RDMA, so parity here means the pod-scale win (hidden
+    comm) costs nothing when there is nothing to hide.
+    """
+    import time as _time
+
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gloo_tpu.ops.overlap import _ag_matmul_shard, _matmul_rs_shard
+
+    interp = jax.devices()[0].platform == "cpu"
+    V = args.overlap_ranks
+    mesh = Mesh(np.asarray(jax.devices()[:1], dtype=object), (axis,))
+    m, d, f = (int(v) for v in args.tp_shape.split("x"))
+    if interp:
+        m, d, f, = 256, 256, 256
+    chunk = m // V
+    assert chunk and chunk % 8 == 0, f"M/V={m}/{V} not a usable chunk"
+
+    # Bench-local custom-vjp wrappers threading virtual_ranks through the
+    # same fused-dual structure as the public ops (overlap.py).
+    def make_fused_pair():
+        kw = dict(axis_name=axis, mesh_axes=None, interpret=interp,
+                  virtual_ranks=V)
+
+        @jax.custom_vjp
+        def ag_mm(xv, wv):
+            y, _ = _ag_matmul_shard(xv, wv, collective_id=23, **kw)
+            return y
+
+        def ag_fwd(xv, wv):
+            y, gx = _ag_matmul_shard(xv, wv, collective_id=23, **kw)
+            return y, (gx, wv)
+
+        def ag_bwd(res, g):
+            gx, wv = res
+            dx = _matmul_rs_shard(g, wv.T, collective_id=21, **kw)
+            dw = jnp.dot(gx.T, g, preferred_element_type=jnp.float32
+                         ).astype(wv.dtype)
+            return dx, dw
+
+        ag_mm.defvjp(ag_fwd, ag_bwd)
+
+        @jax.custom_vjp
+        def rs_mm(av, wv):
+            return _matmul_rs_shard(av, wv, collective_id=25, **kw)
+
+        def rs_fwd(av, wv):
+            return rs_mm(av, wv), (av, wv)
+
+        def rs_bwd(res, g):
+            av, wv = res
+            # dual: da = gather(g) @ w^T via the fused allgather kernel
+            da, gfull = _ag_matmul_shard(g, wv.T, collective_id=27, **kw)
+            dw = jnp.dot(av.T, gfull, preferred_element_type=jnp.float32
+                         ).astype(wv.dtype)
+            return da, dw
+
+        rs_mm.defvjp(rs_fwd, rs_bwd)
+        return ag_mm, rs_mm
+
+    ag_mm, rs_mm = make_fused_pair()
+    lr = 1e-3
+
+    def fused_loss(params, x_loc):
+        h = ag_mm(x_loc, params["up"])          # [m, f]
+        a = jax.nn.gelu(h)
+        y = rs_mm(a, params["down"])            # [chunk, d]
+        return jnp.mean(jnp.square(y.astype(jnp.float32)))
+
+    def plain_loss(params, x_full):
+        h = jnp.dot(x_full, params["up"], preferred_element_type=jnp.float32
+                    ).astype(x_full.dtype)
+        a = jax.nn.gelu(h)
+        y = jnp.dot(a, params["down"], preferred_element_type=jnp.float32)
+        # Loss over ALL rows: slicing to [chunk] here would let XLA
+        # dead-code-eliminate most of the down-projection and its
+        # backward (measured >peak "FLOP/s"), biasing the baseline. A
+        # real unfused TP rank computes the full [m,f]@[f,d] partial and
+        # reduce-scatters it; the fused path does the same work inside
+        # the kernel, so full-row loss is the equal-FLOPs comparison.
+        return jnp.mean(jnp.square(y))
+
+    def make_step(loss_fn):
+        def step(params, x):
+            # Grad w.r.t. x too: a real TP block sits in a stack and
+            # always produces dx for the layer below. Without this the
+            # plain path DCEs its dx matmul while the fused path's
+            # side-effecting kernels cannot — a structural 6-vs-5-matmul
+            # bias. The tiny x update keeps dx live in the chain.
+            g, gx = jax.grad(loss_fn, argnums=(0, 1))(params, x)
+            new_params = jax.tree.map(lambda p, gg: (p - lr * gg.astype(
+                jnp.float32)).astype(p.dtype), params, g)
+            return new_params, (x - 1e-6 * gx.astype(jnp.float32)).astype(
+                x.dtype)
+        return step
+
+    params = {"up": jnp.full((d, f), 1.0 / d, jnp.bfloat16),
+              "down": jnp.full((f, d), 1.0 / f, jnp.bfloat16)}
+    # fwd 2 matmuls + bwd 4 (dx, dw each layer) of m*d*f MACs.
+    flops = 2 * m * d * f * 6
+    print(f"# tp_step: Megatron-SP MLP pair, M={m} D={d} F={f}, virtual "
+          f"ring V={V}; full train step (fwd+bwd+sgd), GFLOP/s and ratio")
+    rates = {}
+    for name, loss_fn, xshape in (
+            ("unfused_step", plain_loss, (m, d)),
+            ("fused_step", fused_loss, (chunk, d))):
+        step = make_step(loss_fn)
+        x = jnp.ones(xshape, jnp.bfloat16)
+
+        def make_chain(n_iter, step=step):
+            def outer(pv):
+                fin = lax.fori_loop(0, n_iter,
+                                    lambda i, c: step(c[0], c[1]),
+                                    (pv, x))
+                return fin[0]["up"]  # array probe for _chain_rate's fetch
+            return jax.jit(jax.shard_map(outer, mesh=mesh, in_specs=P(),
+                                         out_specs=P(), check_vma=False))
+
+        try:
+            per, _k = _chain_rate(args, jax,
+                                  lambda n, mk=make_chain: mk(n), params,
+                                  interp, _time)
+        except Exception as exc:  # noqa: BLE001 — report and continue
+            print(f"{name:>16}   failed: {str(exc)[:80]}")
+            continue
+        if per is None:
+            print(f"{name:>16}   skipped: timing noise exceeded step time")
+            continue
+        rates[name] = flops / per / 1e9
+        ratio = ("" if "unfused_step" not in rates or name == "unfused_step"
+                 else f" {rates[name] / rates['unfused_step']:>8.2f}")
+        print(f"{name:>16} {per * 1e6:>12.1f} us/step "
+              f"{rates[name]:>12.1f} GFLOP/s{ratio}")
 
 
 def _chain_rate(args, jax, make_chain, x, interp, _time, k0=32):
