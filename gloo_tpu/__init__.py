@@ -13,6 +13,7 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
   ICI, plus Pallas ring kernels for custom schedules.
 """
 
+from gloo_tpu.bootstrap import detect_launch_env, init_from_env
 from gloo_tpu.core import (
     Aborted,
     Context,
@@ -53,6 +54,8 @@ __all__ = [
     "UnboundBuffer",
     "__version__",
     "crypto_isa_tier",
+    "detect_launch_env",
+    "init_from_env",
     "derive_keyring",
     "uring_available",
 ]

@@ -1,0 +1,96 @@
+"""Launcher-environment bootstrap (gloo_tpu.init_from_env): the
+reference mpi::Context's deployment story — ranks discover each other
+from what the launcher (mpirun/srun/torchrun) put in the environment,
+rank 0 serves the store (reference: gloo/mpi/context.cc:88-140; here
+the same metadata feeds the TcpStore rendezvous)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import gloo_tpu
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_detect_launch_env_priority_and_forms():
+    det = gloo_tpu.detect_launch_env
+    assert det({}) is None
+    assert det({"RANK": "3", "WORLD_SIZE": "8"}) == (3, 8)
+    assert det({"OMPI_COMM_WORLD_RANK": "1",
+                "OMPI_COMM_WORLD_SIZE": "4"}) == (1, 4)
+    assert det({"PMI_RANK": "0", "PMI_SIZE": "2"}) == (0, 2)
+    assert det({"SLURM_PROCID": "5", "SLURM_NTASKS": "6"}) == (5, 6)
+    # torchrun-style RANK wins over launcher-native vars when both exist
+    assert det({"RANK": "1", "WORLD_SIZE": "2",
+                "OMPI_COMM_WORLD_RANK": "9",
+                "OMPI_COMM_WORLD_SIZE": "9"}) == (1, 2)
+
+
+def test_init_from_env_requires_a_launcher():
+    with pytest.raises(RuntimeError, match="no launcher environment"):
+        gloo_tpu.init_from_env(env={})
+
+
+_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import gloo_tpu
+
+    ctx, server = gloo_tpu.init_from_env(timeout=20.0)
+    x = np.full(4096, float(ctx.rank + 1), dtype=np.float32)
+    ctx.allreduce(x)
+    size = ctx.size
+    assert np.all(x == size * (size + 1) / 2), x[:4]
+    ctx.barrier()
+    ctx.close()
+    del server
+    print("OK", flush=True)
+""").format(repo=_REPO)
+
+
+def _launch(rank_env):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("RANK", "WORLD_SIZE", "MASTER_ADDR", "MASTER_PORT")}
+    env.update(rank_env)
+    return subprocess.Popen([sys.executable, "-c", _WORKER], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("style", ["torchrun", "openmpi", "slurm"])
+def test_init_from_env_multiprocess(style):
+    """Real processes, launcher-style env only — no store plumbing in
+    user code. Rank 0 serves; clients retry while it comes up."""
+    size = 3
+    port = str(_free_port())
+
+    def env_for(rank):
+        if style == "torchrun":
+            return {"RANK": str(rank), "WORLD_SIZE": str(size),
+                    "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": port}
+        if style == "openmpi":
+            return {"OMPI_COMM_WORLD_RANK": str(rank),
+                    "OMPI_COMM_WORLD_SIZE": str(size),
+                    "OMPI_COMM_WORLD_LOCAL_SIZE": str(size),
+                    "MASTER_PORT": port}
+        return {"SLURM_PROCID": str(rank), "SLURM_NTASKS": str(size),
+                "SLURM_NNODES": "1", "MASTER_PORT": port}
+
+    procs = [_launch(env_for(r)) for r in range(size)]
+    outs = [p.communicate(timeout=90) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0 and "OK" in out, (out, err)
