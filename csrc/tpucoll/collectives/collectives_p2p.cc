@@ -217,7 +217,105 @@ void scatter(ScatterOptions& opts) {
   }
 }
 
+namespace {
+
+// Bruck's log-round alltoall (Bruck et al., "Efficient Algorithms for
+// All-to-All Communications in Multiport Message-Passing Systems",
+// IEEE TPDS 1997): ceil(log2 P) rounds instead of the pairwise
+// exchange's P-1, at the price of each block traveling up to log2 P
+// hops (total traffic ~(P/2)log2(P) blocks vs P-1). The win is the
+// latency-dominated regime — small blocks, where round count is the
+// whole cost — which is exactly the EP/MoE dispatch control case. The
+// reference ships only the single-round pattern (gloo/alltoall.cc);
+// this tier is beyond it.
+//
+// Phases: (1) local rotation tmp[j] = in[(rank+j) mod P] so slot j
+// holds the block destined to rank+j; (2) for k = 1,2,4,...: gather
+// every slot with bit k set into a contiguous staging buffer, send to
+// rank+k, receive the same slots from rank-k (already-received blocks
+// keep traveling — that is the algorithm); (3) inverse rotation
+// out[(rank - j) mod P] = tmp[j].
+void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
+                   size_t blockBytes, std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const uint8_t* in = static_cast<const uint8_t*>(opts.input);
+  uint8_t* out = static_cast<uint8_t*>(opts.output);
+
+  std::vector<uint8_t> tmp(static_cast<size_t>(size) * blockBytes);
+  for (int j = 0; j < size; j++) {
+    std::memcpy(tmp.data() + static_cast<size_t>(j) * blockBytes,
+                in + static_cast<size_t>((rank + j) % size) * blockBytes,
+                blockBytes);
+  }
+
+  const size_t maxBlocks = static_cast<size_t>((size + 1) / 2);
+  std::vector<uint8_t> sendStage(maxBlocks * blockBytes);
+  std::vector<uint8_t> recvStage(maxBlocks * blockBytes);
+  auto sendBuf = ctx->createUnboundBuffer(sendStage.data(),
+                                          sendStage.size());
+  auto recvBuf = ctx->createUnboundBuffer(recvStage.data(),
+                                          recvStage.size());
+  Slot slot = Slot::build(SlotPrefix::kAlltoall, opts.tag);
+
+  for (int k = 1; k < size; k <<= 1) {
+    size_t nblocks = 0;
+    for (int j = k; j < size; j++) {
+      if ((j & k) != 0) {
+        std::memcpy(sendStage.data() + nblocks * blockBytes,
+                    tmp.data() + static_cast<size_t>(j) * blockBytes,
+                    blockBytes);
+        nblocks++;
+      }
+    }
+    const int sendTo = (rank + k) % size;
+    const int recvFrom = (rank - k + size) % size;
+    sendBuf->send(sendTo, slot.value(), 0, nblocks * blockBytes);
+    recvBuf->recv(recvFrom, slot.value(), 0, nblocks * blockBytes);
+    sendBuf->waitSend(timeout);
+    recvBuf->waitRecv(nullptr, timeout);
+    size_t b = 0;
+    for (int j = k; j < size; j++) {
+      if ((j & k) != 0) {
+        std::memcpy(tmp.data() + static_cast<size_t>(j) * blockBytes,
+                    recvStage.data() + b * blockBytes, blockBytes);
+        b++;
+      }
+    }
+  }
+
+  for (int j = 0; j < size; j++) {
+    std::memcpy(out + static_cast<size_t>((rank - j + size) % size) *
+                          blockBytes,
+                tmp.data() + static_cast<size_t>(j) * blockBytes,
+                blockBytes);
+  }
+}
+
+}  // namespace
+
 void alltoall(AlltoallOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "alltoall: null context");
+  const size_t blockBytes = opts.count * elementSize(opts.dtype);
+  // Crossover: Bruck's ceil(log2 P) rounds win while per-block payload
+  // is latency-dominated; the pairwise exchange's P-1 single-hop
+  // rounds win once bandwidth dominates (each Bruck block travels up
+  // to log2 P hops). Loopback P=8 measurement (BASELINE.md r4): p50
+  // crosses below 2 KiB blocks on the shared-core host (Bruck 2.3x
+  // better at 512 B), while min latency favors Bruck through ~4 KiB
+  // (8.6 vs 246 us at 512 B — 28x). Default follows the p50 crossover;
+  // on real DCN, where a round costs an RTT instead of a scheduler
+  // quantum, the knob should move UP.
+  static const size_t bruckMax = collectives_detail::envBytes(
+      "TPUCOLL_ALLTOALL_BRUCK_MAX", 1 << 10);
+  if (ctx->size() > 2 && blockBytes > 0 && blockBytes <= bruckMax) {
+    auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
+                                        "bruck");
+    bruckAlltoall(ctx, opts, blockBytes,
+                  detail::effectiveTimeout(opts));
+    return;
+  }
   AlltoallvOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;

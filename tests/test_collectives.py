@@ -2,10 +2,14 @@
 rank-and-index-determined fixtures and closed-form expected values
 (reference analog: gloo/test/allreduce_test.cc etc., base_test.h fixtures)."""
 
+import os
+
 import numpy as np
 import pytest
 
 from tests.harness import spawn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SIZES = [1, 2, 3, 4, 8]
 COUNTS = [1, 7, 100, 10_000]
@@ -751,3 +755,50 @@ def test_allreduce_bf16_wire_fused_matches_staged():
         outs[mode] = np.load(out)
         os.unlink(out)
     np.testing.assert_array_equal(outs["auto"], outs["0"])
+
+
+@pytest.mark.parametrize("force", ["1073741824", "0"])
+@pytest.mark.parametrize("size", [2, 3, 4, 5, 8])
+def test_alltoall_bruck_and_pairwise_tiers(force, size):
+    """Both alltoall tiers against the oracle: the huge crossover
+    forces Bruck's log-round schedule at P=3,4,5,8 (non-power-of-2
+    included; the tier guard keeps P=2 on pairwise, so that cell is
+    extra pairwise coverage), =0 forces the pairwise exchange
+    everywhere. Subprocesses: the crossover knob is latched per
+    process."""
+    import subprocess
+    import sys
+    import textwrap
+
+    body = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, {repo!r})
+        sys.path.insert(0, {repo!r} + "/tests")
+        import numpy as np
+        from tests.harness import spawn
+
+        size = {size}
+
+        def fn(ctx, rank):
+            counts = [1, 7, 33]
+            outs = []
+            for c in counts:
+                x = np.arange(size * c, dtype=np.int64) + 1000 * rank
+                outs.append(ctx.alltoall(x.reshape(size, c)))
+            return outs
+
+        results = spawn(size, fn)
+        for c_i, c in enumerate([1, 7, 33]):
+            for r in range(size):
+                got = np.asarray(results[r][c_i]).reshape(size, c)
+                for src in range(size):
+                    expect = (np.arange(size * c, dtype=np.int64)
+                              + 1000 * src).reshape(size, c)[r]
+                    assert (got[src] == expect).all(), (r, src, c)
+        print("OK")
+    """).format(repo=_REPO, size=size)
+    env = dict(os.environ, TPUCOLL_ALLTOALL_BRUCK_MAX=force)
+    proc = subprocess.run([sys.executable, "-c", body], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0 and "OK" in proc.stdout, (proc.stdout,
+                                                          proc.stderr)
