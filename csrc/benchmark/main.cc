@@ -63,7 +63,7 @@ void usage() {
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
           "reduce|gather|scatter|alltoall|alltoallv|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
-          "  [--algorithm auto|ring|hd|bcube|ring_bf16_wire (allreduce) | auto|binomial|ring (reduce)\n"
+          "  [--algorithm auto|ring|hd|rd|bcube|ring_bf16_wire (allreduce) | auto|binomial|ring (reduce)\n"
           "   | auto|ring|hd|direct (reduce_scatter)]\n"
           "  [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
@@ -202,6 +202,7 @@ tpucoll::AllreduceAlgorithm parseAllreduceAlgorithm(const std::string& a) {
   using tpucoll::AllreduceAlgorithm;
   return a == "ring"             ? AllreduceAlgorithm::kRing
          : a == "bcube"          ? AllreduceAlgorithm::kBcube
+         : a == "rd"             ? AllreduceAlgorithm::kRecursiveDoubling
          : a == "ring_bf16_wire" ? AllreduceAlgorithm::kRingBf16Wire
          : (a == "hd" || a == "halving_doubling")
              ? AllreduceAlgorithm::kHalvingDoubling

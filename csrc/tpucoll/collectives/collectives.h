@@ -61,6 +61,11 @@ enum class AllreduceAlgorithm : uint8_t {
   // results. Opt-in — see collectives_compressed.cc for the precision
   // contract.
   kRingBf16Wire = 4,
+  // Recursive doubling: log2(P) full-vector exchange rounds (vs the
+  // halving-doubling pair's 2 log2 P) — the alpha-dominated tiny-payload
+  // tier. Power-of-2 groups only; auto falls back to halving-doubling
+  // otherwise. Crossover: TPUCOLL_ALLREDUCE_RD_MAX.
+  kRecursiveDoubling = 5,
 };
 
 struct AllreduceOptions : CollectiveOptions {

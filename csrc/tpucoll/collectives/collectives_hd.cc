@@ -508,6 +508,37 @@ void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   }
 }
 
+// Recursive doubling: log2(P) rounds; round k exchanges the FULL
+// running vector with partner = rank ^ (1 << k) and folds it in. Half
+// the rounds of the halving-doubling pair (no allgather phase), at
+// full-vector bytes per round — the alpha-dominated tiny-payload tier.
+// Send and receive ranges overlap (both are the whole vector), so the
+// receive always stages: folding into `work` while the concurrent send
+// still reads it would race. IEEE addition is commutative, so every
+// rank folds the same multiset in a pairwise-identical order and the
+// result is bitwise identical across ranks.
+void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
+                                size_t elsize, ReduceFn fn, Slot slot,
+                                std::chrono::milliseconds timeout) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  TC_ENFORCE((size & (size - 1)) == 0,
+             "recursive doubling requires a power-of-2 group, got ", size);
+  const size_t nbytes = count * elsize;
+  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  std::vector<char> scratch(nbytes);
+  auto scratchBuf = ctx->createUnboundBuffer(scratch.data(), nbytes);
+  uint64_t round = 0;
+  for (int k = 1; k < size; k <<= 1, round++) {
+    const int partner = rank ^ k;
+    workBuf->send(partner, slot.offset(round).value(), 0, nbytes);
+    scratchBuf->recv(partner, slot.offset(round).value(), 0, nbytes);
+    workBuf->waitSend(timeout);
+    scratchBuf->waitRecv(nullptr, timeout);
+    fn(work, scratch.data(), count);
+  }
+}
+
 void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               size_t elsize, ReduceFn fn, Slot slot,
                               std::chrono::milliseconds timeout,

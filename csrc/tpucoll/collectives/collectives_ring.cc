@@ -329,20 +329,29 @@ void allreduce(AllreduceOptions& opts) {
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
     AllreduceAlgorithm algo = opts.algorithm;
     if (algo == AllreduceAlgorithm::kAuto) {
-      // Crossover measured on loopback 8 ranks (BASELINE.md): halving-
-      // doubling wins up to ~1 MiB, the pipelined ring beyond. Re-sweep
-      // on real DCN via TPUCOLL_ALLREDUCE_HD_MAX (payload bytes).
+      // Crossovers measured on loopback (BASELINE.md): recursive
+      // doubling (log2 P full-vector rounds, power-of-2 groups) for the
+      // alpha-dominated tiny tier, halving-doubling up to ~1 MiB, the
+      // pipelined ring beyond. Re-sweep on real DCN via
+      // TPUCOLL_ALLREDUCE_RD_MAX / TPUCOLL_ALLREDUCE_HD_MAX (bytes).
+      static const size_t rdMax = collectives_detail::envBytes(
+          "TPUCOLL_ALLREDUCE_RD_MAX", 16u << 10);
       static const size_t hdMax = collectives_detail::envBytes(
           "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
-      algo = nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
-                             : AllreduceAlgorithm::kRing;
+      const bool pow2 = (size & (size - 1)) == 0;
+      algo = (pow2 && nbytes <= rdMax)
+                 ? AllreduceAlgorithm::kRecursiveDoubling
+             : nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
+                               : AllreduceAlgorithm::kRing;
     }
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1,
         algo == AllreduceAlgorithm::kRing          ? "ring"
         : algo == AllreduceAlgorithm::kBcube       ? "bcube"
         : algo == AllreduceAlgorithm::kRingBf16Wire ? "ring_bf16_wire"
-                                                    : "halving_doubling");
+        : algo == AllreduceAlgorithm::kRecursiveDoubling
+            ? "recursive_doubling"
+            : "halving_doubling");
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -352,6 +361,10 @@ void allreduce(AllreduceOptions& opts) {
         algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
                                              fn, slot, timeout,
                                              opts.customFn == nullptr);
+        break;
+      case AllreduceAlgorithm::kRecursiveDoubling:
+        algorithms::recursiveDoublingAllreduce(ctx, work, opts.count,
+                                               elsize, fn, slot, timeout);
         break;
       case AllreduceAlgorithm::kBcube:
         algorithms::bcubeAllreduce(ctx, work, opts.count, elsize, fn, slot,

@@ -603,7 +603,8 @@ class Context:
         return array
 
     _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
-                   "bcube": 3, "ring_bf16_wire": 4}
+                   "bcube": 3, "ring_bf16_wire": 4,
+                   "recursive_doubling": 5, "rd": 5}
     _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
@@ -611,8 +612,12 @@ class Context:
                   timeout: Optional[float] = None) -> np.ndarray:
         """In-place allreduce of `array` across the group.
 
-        algorithm: "auto" (ring for large payloads, halving-doubling for
-        small), "ring", or "halving_doubling".
+        algorithm: "auto" (recursive doubling for tiny payloads on
+        power-of-2 groups, halving-doubling through ~1 MiB, ring
+        beyond; crossovers TPUCOLL_ALLREDUCE_RD_MAX /
+        TPUCOLL_ALLREDUCE_HD_MAX), "ring", "halving_doubling" ("hd"),
+        "recursive_doubling" ("rd", power-of-2 groups only), "bcube",
+        or "ring_bf16_wire".
 
         op may also be a callable `fn(acc, inp)` combining two numpy views
         in place into acc (see _wrap_reduce_fn for the contract).

@@ -802,3 +802,71 @@ def test_alltoall_bruck_and_pairwise_tiers(force, size):
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0 and "OK" in proc.stdout, (proc.stdout,
                                                           proc.stderr)
+
+
+@pytest.mark.parametrize("force", ["1073741824", "0"])
+@pytest.mark.parametrize("size", [2, 4, 8])
+def test_allreduce_recursive_doubling_tier(force, size):
+    """Recursive doubling against the oracle at power-of-2 sizes
+    (forced via a huge TPUCOLL_ALLREDUCE_RD_MAX) and the same workload
+    with the tier disabled. Subprocesses: the knob latches per process."""
+    import subprocess
+    import sys
+    import textwrap
+
+    body = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, {repo!r})
+        sys.path.insert(0, {repo!r} + "/tests")
+        import numpy as np
+        from tests.harness import spawn
+
+        size = {size}
+
+        def fn(ctx, rank):
+            outs = []
+            for c in (1, 17, 300):
+                x = (np.arange(c, dtype=np.float64) + 1) * (rank + 1)
+                ctx.allreduce(x)
+                outs.append(x)
+            # mixed ops ride the same tier
+            m = np.full(5, float(rank), np.float32)
+            ctx.allreduce(m, op="max")
+            outs.append(m)
+            return outs
+
+        results = spawn(size, fn)
+        tot = size * (size + 1) / 2
+        for r in range(size):
+            for c_i, c in enumerate((1, 17, 300)):
+                expect = (np.arange(c, dtype=np.float64) + 1) * tot
+                np.testing.assert_allclose(results[r][c_i], expect,
+                                           rtol=1e-12)
+            assert (results[r][3] == size - 1).all()
+        # bitwise-identical across ranks (commutative pairwise folds)
+        for r in range(1, size):
+            assert (results[r][2] == results[0][2]).all()
+        print("OK")
+    """).format(repo=_REPO, size=size)
+    env = dict(os.environ, TPUCOLL_ALLREDUCE_RD_MAX=force)
+    proc = subprocess.run([sys.executable, "-c", body], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0 and "OK" in proc.stdout, (proc.stdout,
+                                                          proc.stderr)
+
+
+def test_allreduce_rd_rejects_non_power_of_two():
+    """Explicit algorithm="rd" at P=3 must fail loudly (auto never
+    selects it there)."""
+    import gloo_tpu
+
+    def fn(ctx, rank):
+        x = np.ones(8, np.float32)
+        try:
+            ctx.allreduce(x, algorithm="rd")
+            return "no-error"
+        except gloo_tpu.Error as e:
+            return "rejected" if "power-of-2" in str(e) else str(e)
+
+    results = spawn(3, fn)
+    assert all(r == "rejected" for r in results), results
