@@ -31,7 +31,8 @@ def test_collective_spans_recorded():
             assert e["dur"] >= 0
             assert e["pid"] == rank
         assert events[0]["args"]["bytes"] == 4000
-        assert events[0]["args"]["detail"] in ("ring", "halving_doubling")
+        assert events[0]["args"]["detail"] in (
+                "ring", "halving_doubling", "recursive_doubling")
         assert events[1]["args"]["peer"] == 0  # broadcast root
 
 
