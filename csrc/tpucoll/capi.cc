@@ -150,6 +150,9 @@ int tc_store_get(void* store, const char* key, int64_t timeoutMs,
     auto buf = (*asStore(store))->get(key, ms(timeoutMs));
     *outLen = buf.size();
     *out = static_cast<uint8_t*>(malloc(buf.size()));
+    if (*out == nullptr && !buf.empty()) {
+      throw std::bad_alloc();
+    }
     std::memcpy(*out, buf.data(), buf.size());
   });
 }
@@ -204,6 +207,9 @@ int tc_derive_keyring(const char* rootKey, int rank, int size,
                                  size)
             .serialize();
     *out = static_cast<uint8_t*>(malloc(s.size() + 1));
+    if (*out == nullptr) {
+      throw std::bad_alloc();
+    }
     std::memcpy(*out, s.data(), s.size() + 1);
   });
 }
@@ -303,6 +309,9 @@ int tc_trace_json(void* ctx, uint8_t** out, size_t* outLen) {
     std::string json = c->tracer().toJson(c->rank());
     *outLen = json.size();
     *out = static_cast<uint8_t*>(malloc(json.size()));
+    if (*out == nullptr && !json.empty()) {
+      throw std::bad_alloc();
+    }
     std::memcpy(*out, json.data(), json.size());
   });
 }

@@ -43,6 +43,14 @@ _TC_ERR_ABORTED = 4
 
 def _build_native() -> None:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo_root, "csrc")):
+        # Installed package (site-packages): there is no source tree to
+        # auto-build from. setup.py's build_py hook should have shipped
+        # the .so in the wheel — if it's missing the install is broken.
+        raise Error(
+            f"native library missing at {_LIB_PATH} and no csrc/ beside "
+            f"the package to build it from; reinstall (`pip install .` "
+            f"from a source checkout) or run `make native` in the repo")
     subprocess.run(["make", "native"], cwd=repo_root, check=True,
                    capture_output=True)
 

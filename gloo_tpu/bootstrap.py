@@ -64,7 +64,16 @@ def _mpi_endpoint(env_rank: int, host: str, port: int):
     rather than bcast-from-root-0: the serving rank is ENV rank 0,
     which need not share the communicator's numbering (e.g. a stray
     RANK export alongside OMPI vars). Returns (host, port) or None
-    without mpi4py."""
+    without mpi4py.
+
+    .. warning:: NEVER EXECUTED IN THIS REPO'S CI. The development and
+       CI images ship no MPI runtime and no mpi4py, so the live-
+       communicator branch below has never run; only the ImportError
+       fallback (env-var endpoint exchange, tested with real processes
+       in tests/test_bootstrap.py) is exercised. Treat this branch as
+       reviewed-but-unproven when first deploying under a real
+       mpirun/srun+PMI launch. Mirrors
+       /root/reference/gloo/mpi/context.cc:88-140 behaviorally."""
     try:
         from mpi4py import MPI  # noqa: PLC0415 - optional dependency
     except ImportError:

@@ -1,0 +1,54 @@
+"""Build hook: compile the native core (csrc/) into the wheel.
+
+`pip install .` must produce a package whose `gloo_tpu/_native/libtpucoll.so`
+exists in site-packages — the installed tree has no csrc/ to auto-build from
+(the in-checkout auto-build in gloo_tpu/_lib.py only works for source
+checkouts). Mirrors the reference's CMake-first build
+(/root/reference/CMakeLists.txt) driven from setuptools.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        lib = os.path.join(ROOT, "gloo_tpu", "_native", "libtpucoll.so")
+        # Always (re)build: cmake's dependency tracking makes this a no-op
+        # when up to date, and gating on os.path.exists(lib) would silently
+        # package a stale binary after csrc/ edits. One build recipe: the
+        # Makefile's `native` target (same one _lib.py's in-checkout
+        # auto-build uses); direct cmake only where make is absent.
+        if shutil.which("make"):
+            subprocess.run(["make", "native"], cwd=ROOT, check=True)
+        else:
+            build_dir = os.path.join(ROOT, "build")
+            gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+            subprocess.run(
+                ["cmake", "-S", os.path.join(ROOT, "csrc"),
+                 "-B", build_dir, *gen,
+                 "-DCMAKE_BUILD_TYPE=RelWithDebInfo"], check=True)
+            subprocess.run(["cmake", "--build", build_dir], check=True)
+        dest = os.path.join(self.build_lib, "gloo_tpu", "_native")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copy2(lib, dest)
+
+
+class BinaryDistribution(Distribution):
+    """The wheel carries a compiled .so: force a platform tag so a
+    linux/x86-64 wheel is never installed onto a foreign platform."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildPyWithNative},
+      distclass=BinaryDistribution)

@@ -64,6 +64,12 @@ def run_cell(op, elements, ranks, plane, engine, min_time):
             p.communicate(timeout=120)
         if out.returncode != 0:
             return {"error": out.stderr.strip()[-200:]}
+        # A non-rank-0 worker can fail after rank 0 finishes (e.g. a
+        # teardown crash); numbers from such a cell are not trustworthy.
+        bad = [p for p in procs if p.returncode != 0]
+        if bad:
+            return {"error": f"{len(bad)} worker(s) exited non-zero: "
+                             f"{[p.returncode for p in bad]}"}
         d = json.loads(out.stdout.splitlines()[0])
         return {"p50_us": d["p50_us"], "p99_us": d["p99_us"],
                 "min_us": d["min_us"], "algbw_gbps": d["algbw_gbps"],
