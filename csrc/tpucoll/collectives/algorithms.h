@@ -27,8 +27,10 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 // proportional to its window; TPUCOLL_HD_NP2=fold selects the simpler
 // fold variant (first 2r odd ranks fold into their even partners, at the
 // cost of two extra full-vector hops on those ranks).
-// Recursive doubling (power-of-2 size only): each round exchanges the
-// FULL running vector with partner rank^k and folds it in. Commutative
+// Recursive doubling: each round exchanges the FULL running vector with
+// partner rank^k and folds it in; non-power-of-2 sizes take a pre/post
+// fold (odd ranks of the first 2*(P-p2) ship their vector to the even
+// survivor, sit out the rounds, and receive the result). Commutative
 // IEEE addition makes the result bitwise identical across ranks.
 void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
                                 size_t elsize, ReduceFn fn, Slot slot,

@@ -63,8 +63,9 @@ enum class AllreduceAlgorithm : uint8_t {
   kRingBf16Wire = 4,
   // Recursive doubling: log2(P) full-vector exchange rounds (vs the
   // halving-doubling pair's 2 log2 P) — the alpha-dominated tiny-payload
-  // tier. Power-of-2 groups only; auto falls back to halving-doubling
-  // otherwise. Crossover: TPUCOLL_ALLREDUCE_RD_MAX.
+  // tier. Non-power-of-2 groups take a pre/post fold: odd ranks of the
+  // first 2*(P-p2) fold into their even partners, sit out the rounds,
+  // and receive the result. Crossover: TPUCOLL_ALLREDUCE_RD_MAX.
   kRecursiveDoubling = 5,
 };
 

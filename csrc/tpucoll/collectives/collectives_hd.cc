@@ -514,28 +514,67 @@ void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
 // full-vector bytes per round — the alpha-dominated tiny-payload tier.
 // Send and receive ranges overlap (both are the whole vector), so the
 // receive always stages: folding into `work` while the concurrent send
-// still reads it would race. IEEE addition is commutative, so every
-// rank folds the same multiset in a pairwise-identical order and the
-// result is bitwise identical across ranks.
+// still reads it would race.
+//
+// Non-power-of-2 groups use the standard pre/post fold (Rabenseifner's
+// small-message variant): with p2 the largest power of 2 <= P and
+// rem = P - p2, the first 2*rem ranks pair up — each odd "extra" ships
+// its whole vector to the even survivor below it, sits out the log
+// rounds, and receives the finished result. At the tiny payloads this
+// tier serves the two fold messages cost ~1 alpha each, keeping total
+// latency at log2(p2)+2 rounds vs fold-HD's 2*log2(p2)+2 — the same
+// 2x round advantage the pow-2 path measures (BASELINE.md).
+//
+// Bitwise identity across ranks: survivors enter the log rounds with
+// subgroup-identical values; at each round both partners compute
+// fn(X, Y) / fn(Y, X) over identical operand bits, and IEEE addition
+// (and min/max) is commutative, so every merged group stays bitwise
+// identical by induction. Extras receive those exact bits.
 void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
                                 size_t elsize, ReduceFn fn, Slot slot,
                                 std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
-  TC_ENFORCE((size & (size - 1)) == 0,
-             "recursive doubling requires a power-of-2 group, got ", size);
+  int p2 = 1;
+  while (p2 * 2 <= size) {
+    p2 *= 2;
+  }
+  const int rem = size - p2;
   const size_t nbytes = count * elsize;
   auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  // Slot layout: offset 0 = pre-fold, 1 = result return, 2+k = round k.
+  const bool extra = rank < 2 * rem && (rank & 1) != 0;
+  const bool paired = rank < 2 * rem && (rank & 1) == 0;
+  if (extra) {
+    // Extras never touch scratch — keep their path allocation-free.
+    workBuf->send(rank - 1, slot.offset(0).value(), 0, nbytes);
+    workBuf->waitSend(timeout);
+    workBuf->recv(rank - 1, slot.offset(1).value(), 0, nbytes);
+    workBuf->waitRecv(nullptr, timeout);
+    return;
+  }
   std::vector<char> scratch(nbytes);
   auto scratchBuf = ctx->createUnboundBuffer(scratch.data(), nbytes);
+  if (paired) {
+    scratchBuf->recv(rank + 1, slot.offset(0).value(), 0, nbytes);
+    scratchBuf->waitRecv(nullptr, timeout);
+    fn(work, scratch.data(), count);
+  }
+  // Survivors renumber into a dense [0, p2) space for the XOR walk.
+  const int rdRank = paired ? rank / 2 : rank - rem;
   uint64_t round = 0;
-  for (int k = 1; k < size; k <<= 1, round++) {
-    const int partner = rank ^ k;
-    workBuf->send(partner, slot.offset(round).value(), 0, nbytes);
-    scratchBuf->recv(partner, slot.offset(round).value(), 0, nbytes);
+  for (int k = 1; k < p2; k <<= 1, round++) {
+    const int rdPartner = rdRank ^ k;
+    const int partner = rdPartner < rem ? 2 * rdPartner : rdPartner + rem;
+    workBuf->send(partner, slot.offset(2 + round).value(), 0, nbytes);
+    scratchBuf->recv(partner, slot.offset(2 + round).value(), 0, nbytes);
     workBuf->waitSend(timeout);
     scratchBuf->waitRecv(nullptr, timeout);
     fn(work, scratch.data(), count);
+  }
+  if (paired) {
+    workBuf->send(rank + 1, slot.offset(1).value(), 0, nbytes);
+    workBuf->waitSend(timeout);
   }
 }
 

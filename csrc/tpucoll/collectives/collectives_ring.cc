@@ -330,17 +330,16 @@ void allreduce(AllreduceOptions& opts) {
     AllreduceAlgorithm algo = opts.algorithm;
     if (algo == AllreduceAlgorithm::kAuto) {
       // Crossovers measured on loopback (BASELINE.md): recursive
-      // doubling (log2 P full-vector rounds, power-of-2 groups) for the
-      // alpha-dominated tiny tier, halving-doubling up to ~1 MiB, the
-      // pipelined ring beyond. Re-sweep on real DCN via
-      // TPUCOLL_ALLREDUCE_RD_MAX / TPUCOLL_ALLREDUCE_HD_MAX (bytes).
+      // doubling (log2 P full-vector rounds; non-power-of-2 groups take
+      // a pre/post fold) for the alpha-dominated tiny tier,
+      // halving-doubling up to ~1 MiB, the pipelined ring beyond.
+      // Re-sweep on real DCN via TPUCOLL_ALLREDUCE_RD_MAX /
+      // TPUCOLL_ALLREDUCE_HD_MAX (bytes).
       static const size_t rdMax = collectives_detail::envBytes(
           "TPUCOLL_ALLREDUCE_RD_MAX", 16u << 10);
       static const size_t hdMax = collectives_detail::envBytes(
           "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
-      const bool pow2 = (size & (size - 1)) == 0;
-      algo = (pow2 && nbytes <= rdMax)
-                 ? AllreduceAlgorithm::kRecursiveDoubling
+      algo = nbytes <= rdMax ? AllreduceAlgorithm::kRecursiveDoubling
              : nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
                                : AllreduceAlgorithm::kRing;
     }

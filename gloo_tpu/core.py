@@ -612,12 +612,12 @@ class Context:
                   timeout: Optional[float] = None) -> np.ndarray:
         """In-place allreduce of `array` across the group.
 
-        algorithm: "auto" (recursive doubling for tiny payloads on
-        power-of-2 groups, halving-doubling through ~1 MiB, ring
-        beyond; crossovers TPUCOLL_ALLREDUCE_RD_MAX /
-        TPUCOLL_ALLREDUCE_HD_MAX), "ring", "halving_doubling" ("hd"),
-        "recursive_doubling" ("rd", power-of-2 groups only), "bcube",
-        or "ring_bf16_wire".
+        algorithm: "auto" (recursive doubling for tiny payloads,
+        halving-doubling through ~1 MiB, ring beyond; crossovers
+        TPUCOLL_ALLREDUCE_RD_MAX / TPUCOLL_ALLREDUCE_HD_MAX), "ring",
+        "halving_doubling" ("hd"), "recursive_doubling" ("rd";
+        non-power-of-2 groups take a pre/post fold), "bcube", or
+        "ring_bf16_wire".
 
         op may also be a callable `fn(acc, inp)` combining two numpy views
         in place into acc (see _wrap_reduce_fn for the contract).

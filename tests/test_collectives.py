@@ -805,11 +805,14 @@ def test_alltoall_bruck_and_pairwise_tiers(force, size):
 
 
 @pytest.mark.parametrize("force", ["1073741824", "0"])
-@pytest.mark.parametrize("size", [2, 4, 8])
+@pytest.mark.parametrize("size", [2, 3, 4, 5, 6, 8, 12])
 def test_allreduce_recursive_doubling_tier(force, size):
-    """Recursive doubling against the oracle at power-of-2 sizes
-    (forced via a huge TPUCOLL_ALLREDUCE_RD_MAX) and the same workload
-    with the tier disabled. Subprocesses: the knob latches per process."""
+    """Recursive doubling against the oracle (forced via a huge
+    TPUCOLL_ALLREDUCE_RD_MAX) and the same workload with the tier
+    disabled. Non-power-of-2 sizes exercise the Rabenseifner pre/post
+    fold (P=3: one pair + one direct survivor; P=5,6,12: mixed; the
+    bitwise-identity assertion covers extras receiving the survivors'
+    exact bits). Subprocesses: the knob latches per process."""
     import subprocess
     import sys
     import textwrap
@@ -855,18 +858,17 @@ def test_allreduce_recursive_doubling_tier(force, size):
                                                           proc.stderr)
 
 
-def test_allreduce_rd_rejects_non_power_of_two():
-    """Explicit algorithm="rd" at P=3 must fail loudly (auto never
-    selects it there)."""
-    import gloo_tpu
+def test_allreduce_rd_explicit_non_power_of_two():
+    """Explicit algorithm="rd" at P=3 runs the pre/post-fold path
+    (historically this was rejected; the fold made it exact)."""
 
     def fn(ctx, rank):
-        x = np.ones(8, np.float32)
-        try:
-            ctx.allreduce(x, algorithm="rd")
-            return "no-error"
-        except gloo_tpu.Error as e:
-            return "rejected" if "power-of-2" in str(e) else str(e)
+        x = (np.arange(64, dtype=np.float64) + 1) * (rank + 1)
+        ctx.allreduce(x, algorithm="rd")
+        return x
 
     results = spawn(3, fn)
-    assert all(r == "rejected" for r in results), results
+    expect = (np.arange(64, dtype=np.float64) + 1) * 6.0
+    for r in range(3):
+        np.testing.assert_allclose(results[r], expect, rtol=1e-12)
+        assert (results[r] == results[0]).all()
