@@ -1019,6 +1019,20 @@ Pair::RxStep Pair::processRxBytes(size_t n, size_t* consumed) {
                             peerRank_));
         return RxStep::kStop;
       }
+      if (rxFoldInline_ && rxCombine_ != nullptr) {
+        // Fold this frame's just-verified plaintext into the
+        // accumulator while it is still cache-hot (saves the cold
+        // whole-stage re-read at finishMessage). frameLen is a
+        // multiple of the element size: every non-final frame is
+        // kEncFrameBytes (checked aligned when rxFoldInline_ was
+        // set) and the final frame is nbytes minus a multiple of it,
+        // with nbytes itself element-aligned by matchIncoming. The
+        // accumulator offset is in ELEMENTS times ITS elsize — wire
+        // and accumulator strides differ for typed recvReduce.
+        const size_t elemsDone = rxPlainDone_ / rxCombineElsize_;
+        rxCombine_(rxFinalDest_ + elemsDone * rxCombineAccElsize_,
+                   rxDest_ + rxPlainDone_, frameLen / rxCombineElsize_);
+      }
       rxPlainDone_ += frameLen;
       rxPayloadRead_ = 0;
       if (rxPlainDone_ < rxHeader_.nbytes) {
@@ -1362,11 +1376,23 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     rxMode_ = RxMode::kDirect;
     rxCombine_ = match.combine;
     rxCombineElsize_ = match.combineElsize;
+    rxCombineAccElsize_ = match.combineAccElsize != 0
+                              ? match.combineAccElsize
+                              : match.combineElsize;
     if (match.combine != nullptr) {
       // recvReduce over the byte stream: partial reads (and in-place
       // ciphertext) must never touch the accumulator, so the payload
-      // stages first and is folded in at completion.
+      // stages first. Plaintext connections fold the stage at message
+      // completion; encrypted ones fold per verified frame (see
+      // rxFoldInline_ in pair.h) when frames are element-aligned —
+      // kEncFrameBytes is 4-KiB-aligned, so only exotic custom-fn
+      // element sizes fall back to the completion fold. Typed
+      // recvReduce (wire elsize != accumulator elsize, e.g. the
+      // bf16-wire ring) folds at ELEMENT offsets — each side scaled by
+      // its own elsize.
       rxFinalDest_ = match.dest;
+      rxFoldInline_ = keys_.encrypted &&
+                      kEncFrameBytes % match.combineElsize == 0;
       if (rxCombineStage_.size() < nbytes) {
         rxCombineStage_.resize(nbytes);
       }
@@ -1604,9 +1630,12 @@ void Pair::finishMessage() {
       break;
     case RxMode::kDirect: {
       if (rxCombine_ != nullptr) {
-        rxCombine_(rxFinalDest_, rxCombineStage_.data(),
-                   rxHeader_.nbytes / rxCombineElsize_);
+        if (!rxFoldInline_) {
+          rxCombine_(rxFinalDest_, rxCombineStage_.data(),
+                     rxHeader_.nbytes / rxCombineElsize_);
+        }
         rxCombine_ = nullptr;  // stage keeps its capacity for the next one
+        rxFoldInline_ = false;
       }
       UnboundBuffer* b = nullptr;
       {

@@ -302,9 +302,22 @@ class Pair : public Handler {
   // into rxFinalDest_ (the posted recvReduce destination). The stage is
   // grow-only (kept across messages): fused TCP traffic must not pay a
   // malloc + zero-fill per message.
+  //
+  // Encrypted connections instead fold FRAME-BY-FRAME (rxFoldInline_):
+  // each kEncFrameBytes frame's plaintext is combined into the
+  // accumulator right after its AEAD tag verifies, while it is still
+  // cache-hot — the whole-message fold at completion would re-read the
+  // stage cold, one full memory traversal per byte (measured on the
+  // 16 MiB encrypted-allreduce A/B, BASELINE.md r5). Only verified
+  // plaintext is ever folded; a tampered later frame poisons the pair
+  // and the pending op errors out with the accumulator partially
+  // updated — same contents-undefined-on-error contract as every other
+  // failed in-place collective.
   RecvReduceFn rxCombine_{nullptr};
-  size_t rxCombineElsize_{0};
+  size_t rxCombineElsize_{0};     // wire bytes per element
+  size_t rxCombineAccElsize_{0};  // accumulator bytes per element
   char* rxFinalDest_{nullptr};
+  bool rxFoldInline_{false};
   std::vector<char> rxCombineStage_;
   size_t rxPayloadRead_{0};  // progress within the current frame
   size_t rxPlainDone_{0};    // completed (verified) payload bytes

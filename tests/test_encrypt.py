@@ -209,3 +209,58 @@ def test_peer_killed_mid_collective_encrypted():
         assert codes[r] == 10, (codes, outs)
         elapsed = float(outs[r][0].split()[1])
         assert elapsed < 5.0, f"failure detection took {elapsed}s"
+
+
+@pytest.mark.parametrize("algorithm", ["ring", "hd", "ring_bf16_wire"])
+def test_allreduce_encrypted_multiframe_fold_on_open(algorithm):
+    """Multi-frame encrypted recvReduce over real TCP payloads
+    (TPUCOLL_SHM=0 — same-host shm would carry the bytes plaintext and
+    bypass the AEAD rx path entirely), with TPUCOLL_RECV_REDUCE=1: the
+    auto policy only fuses recvReduce onto shm peers, so the force knob
+    is what routes recvReduce over the encrypted TCP pairs and lights
+    up the r5 fold-on-open path (pair.cc rxFoldInline_: every verified
+    256 KiB frame folds straight into the accumulator). Each message
+    spans several frames. Ring covers the fused segment pipeline, hd
+    the window-walk recvReduce, and ring_bf16_wire the TYPED fold
+    (wire elsize 2, accumulator elsize 4 — per-frame accumulator
+    offsets must scale by the acc elsize, not wire bytes; values stay
+    small integers so bf16 wire rounding is exact). Size 3 adds the
+    non-trivial vrank/fold topology."""
+    store = tempfile.mkdtemp()
+    size = 3
+    n = (3 * 1024 * 1024 + 4096) // 4  # ~3 MiB: several frames/segment
+    # bf16 wire: keep every partial sum an integer <= 256 (exact in
+    # bf16's 8-bit mantissa) so the expectation is still closed-form.
+    mod = 64 if algorithm == "ring_bf16_wire" else 512
+
+    def worker(rank):
+        prog = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            import gloo_tpu
+
+            rank = {rank}; size = {size}; n = {n}
+            store = gloo_tpu.FileStore({store!r})
+            ctx = gloo_tpu.Context(rank, size, timeout=30.0)
+            ctx.connect_full_mesh(
+                store, gloo_tpu.Device(auth_key="k", encrypt=True))
+            x = (np.arange(n, dtype=np.float32) % {mod}) + rank + 1
+            ctx.allreduce(x, algorithm={algorithm!r})
+            expect = ((np.arange(n, dtype=np.float64) % {mod}) * size
+                      + size * (size + 1) / 2)
+            assert np.array_equal(x, expect.astype(np.float32)), \\
+                np.flatnonzero(x != expect.astype(np.float32))[:8]
+            ctx.barrier()
+            ctx.close()
+            sys.exit(10)
+        """).format(repo=_REPO, rank=rank, size=size, n=n, store=store,
+                    algorithm=algorithm, mod=mod)
+        env = dict(os.environ, TPUCOLL_SHM="0", TPUCOLL_RECV_REDUCE="1")
+        return subprocess.Popen([sys.executable, "-c", prog], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    procs = [worker(r) for r in range(size)]
+    outs = [p.communicate(timeout=120) for p in procs]
+    assert [p.returncode for p in procs] == [10] * size, outs
