@@ -27,13 +27,22 @@ from gloo_tpu.parallel.fsdp import (make_fsdp_train_step, shard_params,
 from gloo_tpu.parallel.pp import pipeline_apply, pipeline_train_1f1b
 from gloo_tpu.parallel.sp import (ring_attention, ring_flash_attention,
                                   ulysses_attention)
-from gloo_tpu.parallel.tp import (column_parallel_dense, row_parallel_dense,
-                                  tp_mlp_block)
+from gloo_tpu.parallel.tp import (allgather_matmul_dense_auto,
+                                  column_parallel_dense,
+                                  estimate_comm_share, fused_compute_ratio,
+                                  row_parallel_dense,
+                                  row_parallel_dense_scattered_auto,
+                                  tp_mlp_block, use_fused_overlap)
 
 __all__ = [
     "HostGradSync",
+    "allgather_matmul_dense_auto",
     "column_parallel_dense",
     "dispatch_combine",
+    "estimate_comm_share",
+    "fused_compute_ratio",
+    "row_parallel_dense_scattered_auto",
+    "use_fused_overlap",
     "make_ddp_train_step",
     "make_fsdp_train_step",
     "pipeline_apply",

@@ -8,6 +8,8 @@ run inside shard_map with the weight shards as per-device values.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from gloo_tpu.tpu import spmd
@@ -69,3 +71,153 @@ def allgather_matmul_dense(x_rows_shard, w, axis: str,
 
     return allgather_matmul(x_rows_shard, w, axis, interpret=interpret,
                             mesh_axes=mesh_axes)
+
+
+# ---------------------------------------------------------------------------
+# Shape-aware fused/unfused dispatch (r5).
+#
+# The fused overlap kernels hide the TP collective entirely but pay a
+# chunking cost on the matmul itself; measured on a real v5e chip
+# (BASELINE.md "End-to-end fused-TP" + the r4 overlap sweeps) the cost
+# tracks the kernel's shape family: near-parity with >=512-row chunks
+# and K<=2048, but down to 0.68x of the plain-dot step at 256-row
+# chunks with K=4096. Whether fusing wins therefore depends on how much
+# of the unfused step the collective would cost: with ratio = fused
+# compute throughput / plain-dot throughput and share = collective time
+# / unfused step time, fused wins iff share > 1 - ratio. Encoding that
+# rule HERE keeps a user on a single ICI domain with K-heavy shards
+# from silently losing a third of their step time to an
+# unconditionally-fused pair.
+# ---------------------------------------------------------------------------
+
+#: Conservative single-chip throughput of the fused kernels relative to
+#: a plain dot of the same FLOPs, by shape family. Calibrated against
+#: the two measured end-to-end points (0.93 at M=4096/D=F=2048 ->
+#: chunk 512/K=2048; 0.68 at M=2048/D=F=4096 -> chunk 256/K=4096) and
+#: the per-kernel sweeps; the slow draw of the bimodal 2048x4096 cell
+#: is the one encoded (conservatism favors unfused, whose cost is
+#: bounded and stable).
+_FUSED_BASE_RATIO = 0.95
+_SMALL_CHUNK_PENALTY = 0.85   # chunk_rows < 512
+_WIDE_K_PENALTY = 0.85        # K > 2048
+
+
+def fused_compute_ratio(m: int, k: int, axis_size: int) -> float:
+    """Estimated fused-kernel compute throughput as a fraction of the
+    plain dot's, for a per-shard [m, k] matmul on a ring of axis_size
+    (ring chunks are m // axis_size rows)."""
+    chunk_rows = max(1, m // max(1, axis_size))
+    ratio = _FUSED_BASE_RATIO
+    if chunk_rows < 512:
+        ratio *= _SMALL_CHUNK_PENALTY
+    if k > 2048:
+        ratio *= _WIDE_K_PENALTY
+    return ratio
+
+
+def estimate_comm_share(m: int, k: int, cols: int, axis_size: int,
+                        dtype_bytes: int = 2,
+                        ici_bytes_per_s: float | None = None,
+                        flops_per_s: float | None = None,
+                        wire_elems: int | None = None) -> float:
+    """Estimated collective share of the UNFUSED step for a per-shard
+    [m, k] @ [k, cols] matmul paired with its TP collective over
+    `axis_size` devices. `wire_elems` is the element count the
+    collective moves: default m*cols (the [m, cols] result riding a
+    reduce-scatter); the allgather side must pass its INPUT size
+    instead (m*k — the gathered X), which differs whenever k != cols.
+
+    Defaults are v5e-ish and env-tunable — TPUCOLL_TP_ICI_GBPS
+    (effective per-hop ring bandwidth, default 90 GB/s: two of the four
+    45 GB/s ICI links active in a bidirectional ring) and
+    TPUCOLL_TP_TFLOPS (sustained matmul throughput, default 170: the
+    measured plain-dot rate on v5e, not the 197 nameplate). Estimates
+    feed a one-bit decision with a wide gap between the families, so
+    ~30% parameter error does not flip it; re-tune on other
+    generations via the env knobs.
+    """
+    if axis_size <= 1:
+        return 0.0
+    if ici_bytes_per_s is None:
+        ici_bytes_per_s = float(
+            os.environ.get("TPUCOLL_TP_ICI_GBPS", "90")) * 1e9
+    if flops_per_s is None:
+        flops_per_s = float(
+            os.environ.get("TPUCOLL_TP_TFLOPS", "170")) * 1e12
+    if wire_elems is None:
+        wire_elems = m * cols
+    wire_bytes = (wire_elems * dtype_bytes) * (axis_size - 1) / axis_size
+    t_comm = wire_bytes / ici_bytes_per_s
+    t_mm = (2.0 * m * k * cols) / flops_per_s
+    return t_comm / (t_comm + t_mm)
+
+
+def use_fused_overlap(m: int, k: int, cols: int, axis_size: int,
+                      comm_share: float | None = None,
+                      dtype_bytes: int = 2,
+                      wire_elems: int | None = None) -> bool:
+    """The dispatch decision: fuse iff the collective's share of the
+    unfused step exceeds the fused kernels' compute penalty
+    (share > 1 - ratio). Pass `comm_share` directly when measured;
+    otherwise it is estimated from shape + hardware parameters.
+    TPUCOLL_TP_OVERLAP=fused|unfused forces either way (auto/unset =
+    decide); anything else raises."""
+    mode = os.environ.get("TPUCOLL_TP_OVERLAP", "auto")
+    if mode == "fused":
+        return True
+    if mode == "unfused":
+        return False
+    if mode not in ("", "auto"):
+        raise ValueError(
+            f"TPUCOLL_TP_OVERLAP must be fused|unfused|auto, got: {mode}")
+    if comm_share is None:
+        comm_share = estimate_comm_share(m, k, cols, axis_size,
+                                         dtype_bytes=dtype_bytes,
+                                         wire_elems=wire_elems)
+    return comm_share > 1.0 - fused_compute_ratio(m, k, axis_size)
+
+
+def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,
+                                      comm_share: float | None = None,
+                                      interpret: bool = False,
+                                      mesh_axes=None):
+    """row_parallel_dense_scattered with the fused/unfused choice made
+    by use_fused_overlap: the fused matmul_reduce_scatter kernel when
+    hiding the collective pays for the chunking cost, else the plain
+    dot + explicit reduce-scatter (identical semantics: [m/P, cols]
+    row-scattered output)."""
+    m, k = x_shard.shape
+    cols = w_shard.shape[1]
+    p = spmd.size(axis)
+    if use_fused_overlap(m, k, cols, p, comm_share=comm_share,
+                         dtype_bytes=x_shard.dtype.itemsize):
+        return row_parallel_dense_scattered(x_shard, w_shard, axis,
+                                            interpret=interpret,
+                                            mesh_axes=mesh_axes)
+    partial = jnp.dot(x_shard, w_shard,
+                      preferred_element_type=jnp.float32).astype(
+                          x_shard.dtype)
+    return spmd.reduce_scatter(partial, axis, "sum", scatter_axis=0)
+
+
+def allgather_matmul_dense_auto(x_rows_shard, w, axis: str,
+                                comm_share: float | None = None,
+                                interpret: bool = False, mesh_axes=None):
+    """allgather_matmul_dense with the fused/unfused choice made by
+    use_fused_overlap (same rule as the reduce-scatter side: the two
+    kernels are duals with the same chunk geometry), falling back to an
+    explicit allgather + plain dot."""
+    rows, k = x_rows_shard.shape
+    cols = w.shape[1]
+    p = spmd.size(axis)
+    m_total = rows * p
+    if use_fused_overlap(m_total, k, cols, p, comm_share=comm_share,
+                         dtype_bytes=x_rows_shard.dtype.itemsize,
+                         wire_elems=m_total * k):
+        return allgather_matmul_dense(x_rows_shard, w, axis,
+                                      interpret=interpret,
+                                      mesh_axes=mesh_axes)
+    x_full = spmd.allgather(x_rows_shard, axis, gather_axis=0)
+    return jnp.dot(x_full, w,
+                   preferred_element_type=jnp.float32).astype(
+                       x_rows_shard.dtype)

@@ -508,6 +508,22 @@ def bench_tp_step(args, jax, jnp, axis):
         print(f"{name:>16} {per * 1e6:>12.1f} us/step "
               f"{rates[name]:>12.1f} GFLOP/s{ratio}")
 
+    # Dispatcher check (r5): on one chip comm is free (share=0), so
+    # use_fused_overlap must pick unfused for this shape — and the
+    # measured ratio tells whether the model's flip threshold (1-ratio)
+    # brackets reality. Printed so sweep logs double as calibration
+    # evidence for gloo_tpu.parallel.use_fused_overlap.
+    if "unfused_step" in rates and "fused_step" in rates:
+        from gloo_tpu.parallel import fused_compute_ratio, use_fused_overlap
+        measured = rates["fused_step"] / rates["unfused_step"]
+        model = fused_compute_ratio(m, f, V)
+        picks_fused = use_fused_overlap(m, f, d, V, comm_share=0.0)
+        winner_ok = picks_fused == (measured > 1.0)
+        print(f"# dispatch: model ratio {model:.2f} (measured {measured:.2f},"
+              f" flip at comm>{1 - model:.0%}); share=0 picks "
+              f"{'fused' if picks_fused else 'unfused'} -> "
+              f"{'MATCHES' if winner_ok else 'CONTRADICTS'} measured winner")
+
 
 def _chain_rate(args, jax, make_chain, x, interp, _time, k0=32):
     """(seconds-per-chained-iteration, chain length) — differenced
