@@ -27,7 +27,10 @@ class BuildPyWithNative(build_py):
         # package a stale binary after csrc/ edits. One build recipe: the
         # Makefile's `native` target (same one _lib.py's in-checkout
         # auto-build uses); direct cmake only where make is absent.
-        if shutil.which("make"):
+        if shutil.which("make") and shutil.which("ninja"):
+            # The Makefile's native target hardcodes -G Ninja; without
+            # ninja fall through to the cmake branch, which drops the
+            # generator flag.
             subprocess.run(["make", "native"], cwd=ROOT, check=True)
         else:
             build_dir = os.path.join(ROOT, "build")

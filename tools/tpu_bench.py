@@ -514,10 +514,13 @@ def bench_tp_step(args, jax, jnp, axis):
     # brackets reality. Printed so sweep logs double as calibration
     # evidence for gloo_tpu.parallel.use_fused_overlap.
     if "unfused_step" in rates and "fused_step" in rates:
-        from gloo_tpu.parallel import fused_compute_ratio, use_fused_overlap
+        from gloo_tpu.parallel import fused_compute_ratio
         measured = rates["fused_step"] / rates["unfused_step"]
         model = fused_compute_ratio(m, f, V)
-        picks_fused = use_fused_overlap(m, f, d, V, comm_share=0.0)
+        # The model decision directly (share=0 > 1-ratio), NOT
+        # use_fused_overlap: that honors TPUCOLL_TP_OVERLAP, and a
+        # forced env would mislabel these calibration logs.
+        picks_fused = 0.0 > 1.0 - model
         winner_ok = picks_fused == (measured > 1.0)
         print(f"# dispatch: model ratio {model:.2f} (measured {measured:.2f},"
               f" flip at comm>{1 - model:.0%}); share=0 picks "
