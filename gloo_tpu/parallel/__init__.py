@@ -30,7 +30,7 @@ from gloo_tpu.parallel.sp import (ring_attention, ring_flash_attention,
 from gloo_tpu.parallel.tp import (allgather_matmul_dense_auto,
                                   column_parallel_dense,
                                   estimate_comm_share, fused_compute_ratio,
-                                  row_parallel_dense,
+                                  measure_fused_ratio, row_parallel_dense,
                                   row_parallel_dense_scattered_auto,
                                   tp_mlp_block, use_fused_overlap)
 
@@ -41,6 +41,7 @@ __all__ = [
     "dispatch_combine",
     "estimate_comm_share",
     "fused_compute_ratio",
+    "measure_fused_ratio",
     "row_parallel_dense_scattered_auto",
     "use_fused_overlap",
     "make_ddp_train_step",

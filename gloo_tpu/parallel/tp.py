@@ -155,11 +155,17 @@ def estimate_comm_share(m: int, k: int, cols: int, axis_size: int,
 def use_fused_overlap(m: int, k: int, cols: int, axis_size: int,
                       comm_share: float | None = None,
                       dtype_bytes: int = 2,
-                      wire_elems: int | None = None) -> bool:
+                      wire_elems: int | None = None,
+                      ratio: float | None = None) -> bool:
     """The dispatch decision: fuse iff the collective's share of the
     unfused step exceeds the fused kernels' compute penalty
     (share > 1 - ratio). Pass `comm_share` directly when measured;
-    otherwise it is estimated from shape + hardware parameters.
+    otherwise it is estimated from shape + hardware parameters. Pass
+    `ratio` from measure_fused_ratio() to use THIS process's measured
+    compile draw instead of the shape model (the fused kernels'
+    throughput is bimodal across compiles on some shapes — BASELINE.md
+    "Overlap kernels" — and a measured slow draw should fall back to
+    unfused even where the model would fuse).
     TPUCOLL_TP_OVERLAP=fused|unfused forces either way (auto/unset =
     decide); anything else raises."""
     mode = os.environ.get("TPUCOLL_TP_OVERLAP", "auto")
@@ -174,7 +180,113 @@ def use_fused_overlap(m: int, k: int, cols: int, axis_size: int,
         comm_share = estimate_comm_share(m, k, cols, axis_size,
                                          dtype_bytes=dtype_bytes,
                                          wire_elems=wire_elems)
-    return comm_share > 1.0 - fused_compute_ratio(m, k, axis_size)
+    if ratio is None:
+        ratio = fused_compute_ratio(m, k, axis_size)
+    return comm_share > 1.0 - ratio
+
+
+_PROBE_CACHE: dict = {}
+
+
+def measure_fused_ratio(m: int, k: int, axis_size: int,
+                        dtype=None, chain: int = 64, reps: int = 3,
+                        interpret: bool = False) -> float:
+    """Measure THIS process's fused-kernel compute throughput relative
+    to a plain dot of the same FLOPs, on one local device via the
+    self-loop virtual ring (the kernel runs its full axis_size-step
+    schedule with the ICI leg replaced by on-chip DMA — identical
+    compute pipeline, no other participants needed).
+
+    Why measure instead of model: the fused kernels' throughput is
+    BIMODAL across compiles on some shapes (fast ~0.88x of plain,
+    slow ~0.79x at 2048x4096 — BASELINE.md); the shape model cannot
+    know which draw this process got, a one-time probe can. Feed the
+    result to use_fused_overlap(ratio=...) — a slow draw then falls
+    back to plain dots + explicit collectives automatically, keeping
+    the deployed step at the measured-best schedule either way.
+
+    The probe runs the square [m, k] @ [k, k] member of the shape
+    family — the measured penalty tracks (chunk rows, K), not the
+    output width (BASELINE.md r4 sweeps), and the square output chains
+    back into the timing loop. Cost: one extra compile of the
+    self-loop kernel (minutes for unrolled rings on TPU — comparable
+    to the training step's own compile) plus ~chain*reps kernel
+    executions. Cached per (m, k, axis_size, dtype) for the process
+    lifetime.
+    """
+    import time
+
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gloo_tpu.ops.overlap import _matmul_rs_shard
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+    key = (m, k, axis_size, str(dtype))
+    if not interpret and key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    if m % axis_size:
+        raise ValueError(f"rows {m} not divisible by ring size {axis_size}")
+    if chain < 2:
+        raise ValueError(f"chain must be >= 2, got {chain}")
+    chunk = m // axis_size
+    import numpy as np
+
+    # local_devices: on a multi-host pod every process probes its OWN
+    # chip (jax.devices()[0] is only addressable from host 0).
+    mesh = Mesh(np.asarray(jax.local_devices()[:1], dtype=object),
+                ("_probe",))
+    w = jnp.full((k, k), 1.0 / k, dtype)
+    x = jnp.ones((m, k), dtype)
+
+    def fused_body(c):
+        y = _matmul_rs_shard(c, w, axis_name="_probe", mesh_axes=None,
+                             collective_id=29, interpret=interpret,
+                             virtual_ranks=axis_size)
+        return c.at[:chunk, :].set(y)
+
+    def plain_body(c):
+        return jnp.dot(c, w, preferred_element_type=jnp.float32
+                       ).astype(c.dtype)
+
+    def chained(body, n):
+        def outer(xv):
+            return lax.fori_loop(0, n, lambda i, c: body(c), xv)
+        return jax.jit(jax.shard_map(outer, mesh=mesh, in_specs=P(),
+                                     out_specs=P(), check_vma=False))
+
+    def run(f):
+        jax.block_until_ready(f(x))
+
+    def rate(body, name):
+        f1, fk = chained(body, 1), chained(body, chain)
+        run(f1), run(fk)
+        t1 = tk = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(f1)
+            t1 = min(t1, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run(fk)
+            tk = min(tk, time.perf_counter() - t0)
+        if tk <= t1 and not interpret:
+            # Noise exceeded chain-1 iterations of kernel time: a
+            # clamped value here would cache a garbage ratio and drive
+            # dispatch with it. Caller should raise `chain`.
+            raise RuntimeError(
+                f"measure_fused_ratio: timing noise exceeded the "
+                f"{name} kernel's chained time at chain={chain}; "
+                f"retry with a longer chain")
+        return max(tk - t1, 1e-9) / (chain - 1)
+
+    ratio = rate(plain_body, "plain") / rate(fused_body, "fused")
+    if not interpret:
+        # Interpreter-mode timings are meaningless — never serve them
+        # to a later real measurement of the same shape.
+        _PROBE_CACHE[key] = ratio
+    return ratio
 
 
 def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,

@@ -17,6 +17,7 @@ from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 from gloo_tpu.parallel import (allgather_matmul_dense_auto,  # noqa: E402
                                estimate_comm_share, fused_compute_ratio,
+                               measure_fused_ratio,
                                row_parallel_dense_scattered_auto,
                                use_fused_overlap)
 
@@ -86,6 +87,41 @@ def test_estimate_comm_share_sanity(monkeypatch):
     # smaller.
     odds = lambda s: s / (1.0 - s)  # noqa: E731
     assert abs(odds(out_sized) / odds(in_sized) - cols / k) < 0.01
+
+
+def test_measured_ratio_overrides_model(monkeypatch):
+    """The bimodality mitigation: a process that measured a SLOW fused
+    compile draw must fall back to unfused even where the shape model
+    would fuse. Fast-family shape (model ratio 0.95, flip at 5%) with
+    a 15% comm share: model fuses; a measured slow draw (0.79) does
+    not; a measured fast draw (0.93) does."""
+    monkeypatch.delenv("TPUCOLL_TP_OVERLAP", raising=False)
+    assert use_fused_overlap(4096, 2048, 2048, V, comm_share=0.15)
+    assert not use_fused_overlap(4096, 2048, 2048, V, comm_share=0.15,
+                                 ratio=0.79)
+    assert use_fused_overlap(4096, 2048, 2048, V, comm_share=0.15,
+                             ratio=0.93)
+
+
+def test_measure_fused_ratio_mechanism():
+    """Probe mechanism under the interpreter (timing values are
+    meaningless on CPU; shape checks, execution, and caching are not)."""
+    from gloo_tpu.parallel import tp
+
+    tp._PROBE_CACHE.clear()
+    r = measure_fused_ratio(32, 64, 4, chain=3, reps=1, interpret=True)
+    assert isinstance(r, float) and r > 0.0
+    # interpreter-mode timings are never cached: a CPU smoke run must
+    # not poison a later real measurement of the same shape
+    assert len(tp._PROBE_CACHE) == 0
+    # real measurements cache; simulate one by seeding the cache
+    tp._PROBE_CACHE[(32, 64, 4, str(jnp.bfloat16))] = 0.5
+    assert measure_fused_ratio(32, 64, 4) == 0.5
+    tp._PROBE_CACHE.clear()
+    with pytest.raises(ValueError, match="divisible"):
+        measure_fused_ratio(30, 64, 4, interpret=True)
+    with pytest.raises(ValueError, match="chain"):
+        measure_fused_ratio(32, 64, 4, chain=1, interpret=True)
 
 
 def _mesh(n):

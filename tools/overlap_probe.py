@@ -1,0 +1,106 @@
+"""Discriminate the overlap-kernel bimodality (BASELINE.md r4/r5 lead).
+
+The 2048x4096 collective-matmul cells are bimodal ACROSS PROCESS
+RESTARTS (fast ~0.87-0.88x of plain dot, slow ~0.79-0.80x) while plain
+dot varies <1%. Three candidate causes, separated by this harness:
+
+  run noise        — same compiled executable re-timed twice differs
+  compile draw     — two fresh compiles of identical HLO in ONE process
+                     differ (Mosaic scheduling nondeterminism)
+  process state    — in-process compiles agree, only restarts differ
+                     (per-process seed / allocator layout)
+
+Method per trial: clear the jit cache; time plain dot; time fused
+compile A; re-time compile A's SAME objects (run-noise bound); time a
+second fresh compile B (in-process compile-draw bound). Chains are
+sized to >0.25 s of differenced work so the tunnel round-trip noise
+cancels. The chain length is FIXED (unlike tpu_bench's adaptive
+`_chain_rate`, deliberately): compiles A and B must be timed over
+identical chain lengths or the comparison confounds chain growth with
+the compile draw it exists to isolate.
+
+Run several times from fresh processes to capture the cross-restart
+axis:  for i in 1 2 3; do python tools/overlap_probe.py; done
+"""
+
+import argparse
+import os
+import time
+
+cur = os.environ.get("LIBTPU_INIT_ARGS", "")
+if "scoped_vmem_limit" not in cur:
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        cur + " --xla_tpu_scoped_vmem_limit_kib=114688").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", default="2048x4096", help="MxK (cols=K)")
+    ap.add_argument("--ranks", type=int, default=8)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--chain", type=int, default=700)
+    args = ap.parse_args()
+    if args.chain < 2:
+        ap.error("--chain must be >= 2")
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gloo_tpu.ops.overlap import _matmul_rs_shard
+
+    m, k = (int(v) for v in args.shape.split("x"))
+    V, N = args.ranks, args.chain
+    chunk = m // V
+    mesh = Mesh(np.asarray(jax.devices()[:1], dtype=object), ("x",))
+    w = jnp.full((k, k), 1.0 / k, jnp.bfloat16)
+    x = jnp.ones((m, k), jnp.bfloat16)
+
+    def mmrs_body(c):
+        y = _matmul_rs_shard(c, w, axis_name="x", mesh_axes=None,
+                             collective_id=21, interpret=False,
+                             virtual_ranks=V)
+        return c.at[:chunk, :].set(y)
+
+    def plain_body(c):
+        return jnp.dot(c, w, preferred_element_type=jnp.float32
+                       ).astype(c.dtype)
+
+    def chain(body, n):
+        def outer(xv):
+            return lax.fori_loop(0, n, lambda i, c: body(c), xv)
+        return jax.jit(jax.shard_map(outer, mesh=mesh, in_specs=P(),
+                                     out_specs=P(), check_vma=False))
+
+    def run(f):
+        _ = float(np.asarray(f(x)).ravel()[0])
+
+    def timeit(f):
+        t0 = time.perf_counter()
+        run(f)
+        return time.perf_counter() - t0
+
+    def measure(f1, fk, reps=5):
+        run(f1), run(fk)
+        t1 = min(timeit(f1) for _ in range(reps))
+        tk = min(timeit(fk) for _ in range(reps))
+        return (tk - t1) / (N - 1)
+
+    print(f"# overlap_probe {m}x{k} V={V} chain={N} pid={os.getpid()}")
+    print("trial  plain_us  cmpA_us  cmpA2_us  cmpB_us  ratioA  ratioB")
+    for trial in range(args.trials):
+        jax.clear_caches()
+        p = measure(chain(plain_body, 1), chain(plain_body, N))
+        a1, ak = chain(mmrs_body, 1), chain(mmrs_body, N)
+        fa = measure(a1, ak)
+        fa2 = measure(a1, ak)   # same executables: run-noise bound
+        fb = measure(chain(mmrs_body, 1), chain(mmrs_body, N))
+        print(f"{trial:>5}  {p*1e6:8.1f} {fa*1e6:8.1f}  {fa2*1e6:8.1f} "
+              f"{fb*1e6:8.1f}   {p/fa:5.2f}   {p/fb:5.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
