@@ -11,6 +11,12 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+# Honor a JAX_PLATFORMS request even where site customization pinned the
+# platform before this script ran (the env var alone is read too early
+# to override that pin; jax.config is not).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import numpy as np
 import optax
 

@@ -18,6 +18,12 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+# Honor a JAX_PLATFORMS request even where site customization pinned the
+# platform before this script ran (the env var alone is read too early
+# to override that pin; jax.config is not).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -69,6 +75,35 @@ def main():
     g = jax.grad(loss, argnums=1)(x, w_up, w_down)
     print(f"dL/dw_up via fused VJPs: {np.asarray(g).shape}, "
           f"|g| {float(jnp.abs(g).mean()):.2e}")
+
+    # Production entry point (r5): the *_auto variants decide per shape
+    # whether fusing pays — the fused kernels give up some MXU
+    # throughput to hide the collective, and on shapes where the
+    # collective is cheap relative to that penalty (K-heavy shards,
+    # small chunks — the measured 0.68x trap, BASELINE.md) they fall
+    # back to plain dots + explicit collectives. Force either arm with
+    # TPUCOLL_TP_OVERLAP=fused|unfused; feed
+    # parallel.measure_fused_ratio() into use_fused_overlap for a
+    # probe-measured decision on real hardware.
+    from gloo_tpu.parallel import (allgather_matmul_dense_auto,
+                                   row_parallel_dense_scattered_auto,
+                                   use_fused_overlap)
+
+    def block_auto(xs, wu, wd):
+        h = allgather_matmul_dense_auto(xs, wu, "model",
+                                        interpret=INTERPRET)
+        h = jax.nn.gelu(h)
+        return row_parallel_dense_scattered_auto(h, wd, "model",
+                                                 interpret=INTERPRET)
+
+    y2 = np.asarray(jax.jit(jax.shard_map(
+        block_auto, mesh=mesh,
+        in_specs=(P("model", None), P(None, "model"), P("model", None)),
+        out_specs=P("model", None), check_vma=False))(x, w_up, w_down))
+    assert float(np.abs(y2 - ref).max()) < 2e-3
+    picked = use_fused_overlap(seq, d_ff // n, d_model, n)
+    print(f"auto dispatcher on this shape/mesh picks: "
+          f"{'fused' if picked else 'unfused'}")
     print("fused tensor-parallel example OK")
 
 

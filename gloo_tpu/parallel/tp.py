@@ -198,12 +198,21 @@ def measure_fused_ratio(m: int, k: int, axis_size: int,
     compute pipeline, no other participants needed).
 
     Why measure instead of model: the fused kernels' throughput is
-    BIMODAL across compiles on some shapes (fast ~0.88x of plain,
-    slow ~0.79x at 2048x4096 — BASELINE.md); the shape model cannot
-    know which draw this process got, a one-time probe can. Feed the
+    BIMODAL across process restarts on some shapes (fast ~0.88x of
+    plain, slow ~0.79x at 2048x4096 — BASELINE.md); the shape model
+    cannot know which draw this process got, a probe can. Feed the
     result to use_fused_overlap(ratio=...) — a slow draw then falls
-    back to plain dots + explicit collectives automatically, keeping
-    the deployed step at the measured-best schedule either way.
+    back to plain dots + explicit collectives.
+
+    Caveat on what the probe proves: it times its OWN compile of the
+    self-loop kernel, not the deployed step's compile. Under the
+    r4 observation (the draw is process-correlated: stable within a
+    process, bimodal across restarts) that is the same draw; if the
+    nondeterminism turns out to be fully per-compile
+    (tools/overlap_probe.py is the committed discrimination
+    experiment), the probe bounds the distribution but cannot
+    guarantee the deployed kernel's draw — time the real step when
+    you need certainty.
 
     The probe runs the square [m, k] @ [k, k] member of the shape
     family — the measured penalty tracks (chunk rows, K), not the
@@ -251,25 +260,31 @@ def measure_fused_ratio(m: int, k: int, axis_size: int,
         return jnp.dot(c, w, preferred_element_type=jnp.float32
                        ).astype(c.dtype)
 
-    def chained(body, n):
-        def outer(xv):
+    def chained(body):
+        # Trip count is a TRACED argument: one compiled executable
+        # serves both chain lengths, so the probe pays the fused
+        # kernel's multi-minute compile once (not twice) and the
+        # differenced t1/tk time the SAME schedule draw by
+        # construction.
+        def outer(xv, n):
             return lax.fori_loop(0, n, lambda i, c: body(c), xv)
-        return jax.jit(jax.shard_map(outer, mesh=mesh, in_specs=P(),
-                                     out_specs=P(), check_vma=False))
+        return jax.jit(jax.shard_map(outer, mesh=mesh,
+                                     in_specs=(P(), P()), out_specs=P(),
+                                     check_vma=False))
 
-    def run(f):
-        jax.block_until_ready(f(x))
+    def run(f, n):
+        jax.block_until_ready(f(x, jnp.int32(n)))
 
     def rate(body, name):
-        f1, fk = chained(body, 1), chained(body, chain)
-        run(f1), run(fk)
+        f = chained(body)
+        run(f, 1), run(f, chain)
         t1 = tk = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            run(f1)
+            run(f, 1)
             t1 = min(t1, time.perf_counter() - t0)
             t0 = time.perf_counter()
-            run(fk)
+            run(f, chain)
             tk = min(tk, time.perf_counter() - t0)
         if tk <= t1 and not interpret:
             # Noise exceeded chain-1 iterations of kernel time: a
