@@ -307,16 +307,19 @@ def measure_fused_ratio(m: int, k: int, axis_size: int,
 def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,
                                       comm_share: float | None = None,
                                       interpret: bool = False,
-                                      mesh_axes=None):
+                                      mesh_axes=None,
+                                      ratio: float | None = None):
     """row_parallel_dense_scattered with the fused/unfused choice made
     by use_fused_overlap: the fused matmul_reduce_scatter kernel when
     hiding the collective pays for the chunking cost, else the plain
     dot + explicit reduce-scatter (identical semantics: [m/P, cols]
-    row-scattered output)."""
+    row-scattered output). Pass ratio from measure_fused_ratio() to
+    dispatch on this process's measured compile draw."""
     m, k = x_shard.shape
     cols = w_shard.shape[1]
     p = spmd.size(axis)
     if use_fused_overlap(m, k, cols, p, comm_share=comm_share,
+                         ratio=ratio,
                          dtype_bytes=x_shard.dtype.itemsize):
         return row_parallel_dense_scattered(x_shard, w_shard, axis,
                                             interpret=interpret,
@@ -329,16 +332,22 @@ def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,
 
 def allgather_matmul_dense_auto(x_rows_shard, w, axis: str,
                                 comm_share: float | None = None,
-                                interpret: bool = False, mesh_axes=None):
+                                interpret: bool = False, mesh_axes=None,
+                                ratio: float | None = None):
     """allgather_matmul_dense with the fused/unfused choice made by
     use_fused_overlap (same rule as the reduce-scatter side: the two
     kernels are duals with the same chunk geometry), falling back to an
-    explicit allgather + plain dot."""
+    explicit allgather + plain dot. Pass ratio from
+    measure_fused_ratio(rows * axis_size, k, axis_size) — the kernel
+    gathers the FULL [rows*P, k] input, so the probe's m is the total
+    rows, not this shard's (unlike the reduce-scatter dual, whose m is
+    the local shard's rows)."""
     rows, k = x_rows_shard.shape
     cols = w.shape[1]
     p = spmd.size(axis)
     m_total = rows * p
     if use_fused_overlap(m_total, k, cols, p, comm_share=comm_share,
+                         ratio=ratio,
                          dtype_bytes=x_rows_shard.dtype.itemsize,
                          wire_elems=m_total * k):
         return allgather_matmul_dense(x_rows_shard, w, axis,
