@@ -120,9 +120,16 @@ def main():
                 for plane in PLANES:
                     for engine in ENGINES:
                         n += 1
-                        runs = [run_cell(op, elements, ranks, plane,
+                        runs = []
+                        for _ in range(args.reps):
+                            r = run_cell(op, elements, ranks, plane,
                                          engine, min_time)
-                                for _ in range(args.reps)]
+                            runs.append(r)
+                            if "Timeout" in str(r.get("error", "")):
+                                # A 120s timeout is a hang (cells run
+                                # 0.5-2s), not a transient: don't burn
+                                # reps x 2min on a dead config.
+                                break
                         ok = [r for r in runs if "p50_us" in r]
                         if not ok:
                             res = runs[0]
@@ -136,6 +143,13 @@ def main():
                                 res = dict(res,
                                            rep_p50s=[r["p50_us"]
                                                      for r in ok])
+                                errs = [r["error"] for r in runs
+                                        if "error" in r]
+                                if errs:
+                                    # Flaky cell: keep the evidence in
+                                    # the artifact, not just the
+                                    # surviving rep's numbers.
+                                    res["rep_errors"] = errs
                         cell = {"op": op, "elements": elements,
                                 "bytes": elements * 4, "ranks": ranks,
                                 "plane": plane[0], "engine": engine,

@@ -25,7 +25,11 @@ axis:  for i in 1 2 3; do python tools/overlap_probe.py; done
 
 import argparse
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 cur = os.environ.get("LIBTPU_INIT_ARGS", "")
 if "scoped_vmem_limit" not in cur:
@@ -41,11 +45,24 @@ def main():
     ap.add_argument("--ranks", type=int, default=8)
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--chain", type=int, default=700)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU + Pallas interpreter + tiny shape: proves "
+                         "the harness executes end-to-end where no TPU "
+                         "is reachable (timing columns meaningless)")
     args = ap.parse_args()
     if args.chain < 2:
         ap.error("--chain must be >= 2")
 
     import jax
+
+    if args.smoke:
+        # Force CPU through jax.config: site customization may pin the
+        # platform before this script runs, and with the TPU tunnel
+        # down the pinned backend hangs in connect retries.
+        jax.config.update("jax_platforms", "cpu")
+        args.shape, args.ranks, args.chain = "32x64", 4, 3
+        args.trials = min(args.trials, 1)
+
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
@@ -61,7 +78,7 @@ def main():
 
     def mmrs_body(c):
         y = _matmul_rs_shard(c, w, axis_name="x", mesh_axes=None,
-                             collective_id=21, interpret=False,
+                             collective_id=21, interpret=args.smoke,
                              virtual_ranks=V)
         return c.at[:chunk, :].set(y)
 
