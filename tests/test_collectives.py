@@ -565,7 +565,8 @@ def test_sixteen_ranks():
 
     def fn(ctx, rank):
         results = []
-        for i, algo in enumerate(["ring", "halving_doubling", "bcube"]):
+        for i, algo in enumerate(["ring", "halving_doubling", "bcube",
+                                  "rd"]):
             x = np.full(2000, float(rank + 1), dtype=np.float64)
             ctx.allreduce(x, algorithm=algo, tag=i)
             results.append(float(x[0]))
@@ -573,7 +574,7 @@ def test_sixteen_ranks():
 
     expected = size * (size + 1) / 2
     for res in spawn(size, fn, timeout=120, context_timeout=60):
-        assert res == [expected] * 3, res
+        assert res == [expected] * 4, res
 
 
 @pytest.mark.parametrize("algorithm", ["ring", "halving_doubling", "bcube"])

@@ -133,6 +133,13 @@ def main():
                         ok = [r for r in runs if "p50_us" in r]
                         if not ok:
                             res = runs[0]
+                            if len(runs) > 1:
+                                # All reps failed: keep every rep's
+                                # error, not just the first (failure
+                                # modes can differ across reps).
+                                res = dict(res,
+                                           rep_errors=[r.get("error")
+                                                       for r in runs])
                         else:
                             # Lower median: with an even rep count the
                             # upper-middle pick would select the SLOWER
