@@ -1,0 +1,45 @@
+"""Smoke the runnable examples: they are the first code a new user
+executes, and nothing else in CI runs them (r5 found two silently
+broken under a platform-pinning site customization — exactly the rot
+this file prevents). Each runs as the README documents it, on the
+virtual CPU mesh, asserting the script's own success line."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_REPO, "examples")
+
+
+def _run(name, timeout=420, env_extra=None):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               **(env_extra or {}))
+    proc = subprocess.run([sys.executable, os.path.join(_EX, name)],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, (name, proc.stdout[-1500:],
+                                  proc.stderr[-1500:])
+    return proc.stdout
+
+
+def test_example_fused_tp():
+    out = _run("example_fused_tp.py")
+    assert "fused tensor-parallel example OK" in out
+    assert "auto dispatcher" in out
+
+
+def test_example_device_plane():
+    out = _run("example_device_plane.py")
+    assert "done" in out
+
+
+def test_example_fsdp_long_context():
+    out = _run("example_fsdp_long_context.py")
+    assert "fsdp + long-context example OK" in out
