@@ -1,11 +1,63 @@
-.PHONY: native test clean
+.PHONY: native native-cmake native-cc test clean
 
+# Build the native core. Prefers the CMake/Ninja build (full configure
+# checks, separate bench/test binaries); falls back to a plain
+# compiler-driver build of just libtpucoll.so when cmake is not
+# installed, so `pip install .` / `make native` work on minimal images.
 native:
+	@if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then \
+		$(MAKE) native-cmake; \
+	else \
+		$(MAKE) -j$$(nproc) native-cc; \
+	fi
+
+native-cmake:
 	cmake -S csrc -B build -G Ninja -DCMAKE_BUILD_TYPE=RelWithDebInfo >/dev/null
 	cmake --build build
+
+# ---- fallback build (no cmake): mirrors csrc/CMakeLists.txt ----
+CXX ?= g++
+FB_BUILD := build-fb
+FB_SRCS := $(filter-out csrc/tpucoll/common/crypto_avx512.cc,\
+	$(wildcard csrc/tpucoll/*.cc csrc/tpucoll/*/*.cc))
+FB_OBJS := $(patsubst csrc/%.cc,$(FB_BUILD)/%.o,$(FB_SRCS))
+# -MMD/-MP: header dependency tracking, so editing a .h rebuilds the
+# objects that include it (cmake gets this for free; the fallback must
+# not silently package a stale .so after header edits).
+FB_FLAGS := -std=c++17 -O3 -g -fPIC -Wall -Wextra -Icsrc -pthread -MMD -MP
+
+ARCH := $(shell uname -m)
+ifeq ($(ARCH),x86_64)
+FB_FLAGS += -mavx2 -mfma -mf16c
+# AVX-512 ChaCha20 tier: own TU with -mavx512f, runtime-dispatched
+# (crypto.cc), only when the compiler supports the flag.
+FB_AVX512 := $(shell echo 'int main(){return 0;}' | $(CXX) -mavx512f \
+	-x c++ - -o /dev/null 2>/dev/null && echo 1)
+endif
+ifeq ($(FB_AVX512),1)
+FB_FLAGS += -DTPUCOLL_HAVE_AVX512=1
+FB_OBJS += $(FB_BUILD)/tpucoll/common/crypto_avx512.o
+endif
+
+native-cc: gloo_tpu/_native/libtpucoll.so
+
+gloo_tpu/_native/libtpucoll.so: $(FB_OBJS)
+	@mkdir -p gloo_tpu/_native
+	$(CXX) -shared -o $@ $(FB_OBJS) -lpthread -lrt
+
+$(FB_BUILD)/tpucoll/common/crypto_avx512.o: \
+		csrc/tpucoll/common/crypto_avx512.cc
+	@mkdir -p $(dir $@)
+	$(CXX) $(FB_FLAGS) -mavx512f -c $< -o $@
+
+$(FB_BUILD)/%.o: csrc/%.cc
+	@mkdir -p $(dir $@)
+	$(CXX) $(FB_FLAGS) -c $< -o $@
+
+-include $(FB_OBJS:.o=.d)
 
 test: native
 	python -m pytest tests/ -x -q
 
 clean:
-	rm -rf build gloo_tpu/_native/*.so
+	rm -rf build $(FB_BUILD) gloo_tpu/_native/*.so

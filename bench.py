@@ -38,7 +38,7 @@ ITERS = 15
 RECORDED_REFERENCE_GBPS = 0.620
 
 
-def bench_ours():
+def bench_ours(metrics_out=None):
     import numpy as np
 
     import gloo_tpu
@@ -56,12 +56,16 @@ def bench_ours():
         x[:] = 1.0
         for _ in range(WARMUP):
             ctx.allreduce(x)
+        if metrics_out is not None and rank == 0:
+            ctx.metrics(drain=True)  # measure the timed loop only
         times = []
         for _ in range(ITERS):
             t0 = time.perf_counter()
             ctx.allreduce(x)
             times.append(time.perf_counter() - t0)
         samples[rank] = times
+        if metrics_out is not None and rank == 0:
+            metrics_out.append(ctx.metrics())
         ctx.barrier()
         ctx.close()
 
@@ -114,7 +118,19 @@ def main():
     # evidence. `spread` = (max - min) / median of the three runs —
     # readers (and the round-over-round diff) can see the noise floor
     # next to the number instead of guessing it.
-    runs = sorted(bench_ours() for _ in range(3))
+    # --metrics: include a per-op metrics digest (calls, bytes, p50/p95
+    # latency from the native registry's histograms) from the last run's
+    # rank-0 context in the JSON line. Opt-in so the headline number's
+    # methodology is untouched by default.
+    with_metrics = "--metrics" in sys.argv[1:]
+    metrics_out = [] if with_metrics else None
+    runs = []
+    for i in range(3):
+        # Only the final run collects metrics (digest matches the last
+        # measurement rather than mixing three contexts).
+        collect = metrics_out if with_metrics and i == 2 else None
+        runs.append(bench_ours(collect))
+    runs = sorted(runs)
     ours = runs[1]
     spread = (runs[2] - runs[0]) / ours if ours > 0 else 0.0
     print(f"[bench] three runs: {[round(r, 3) for r in runs]} GB/s "
@@ -124,14 +140,19 @@ def main():
         ref = RECORDED_REFERENCE_GBPS
         print(f"[bench] reference build absent; using recorded baseline "
               f"{ref} GB/s", file=sys.stderr)
-    print(json.dumps({
+    line = {
         "metric": "allreduce_algbw_2rank_64MiB_host",
         "value": round(ours, 3),
         "unit": "GB/s",
         "vs_baseline": round(ours / ref, 3),
         "spread": round(spread, 3),
         "runs": [round(r, 3) for r in runs],
-    }))
+    }
+    if with_metrics and metrics_out:
+        from gloo_tpu.utils.metrics import summarize_ops
+
+        line["metrics"] = summarize_ops(metrics_out[0])
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -83,6 +83,20 @@ std::vector<size_t> countsVec(const size_t* counts, int size) {
   return std::vector<size_t>(counts, counts + size);
 }
 
+// p2p wait instrumentation: span against the buffer's tracer when the
+// owning context set one (standalone transport contexts have none).
+tpucoll::Tracer::Span maybeSpan(UnboundBuffer* buf, const char* name) {
+  tpucoll::Tracer* tracer = buf->transportContext()->tracer();
+  if (tracer == nullptr) {
+    return tpucoll::Tracer::Span();
+  }
+  return tracer->span(name, buf->size());
+}
+
+tpucoll::Metrics* bufMetrics(UnboundBuffer* buf) {
+  return buf->transportContext()->metrics();
+}
+
 }  // namespace
 
 extern "C" {
@@ -307,6 +321,36 @@ int tc_trace_json(void* ctx, uint8_t** out, size_t* outLen) {
   return wrap([&] {
     Context* c = asContext(ctx);
     std::string json = c->tracer().toJson(c->rank());
+    *outLen = json.size();
+    *out = static_cast<uint8_t*>(malloc(json.size()));
+    if (*out == nullptr && !json.empty()) {
+      throw std::bad_alloc();
+    }
+    std::memcpy(*out, json.data(), json.size());
+  });
+}
+
+// ---- metrics ----
+
+void tc_metrics_enable(void* ctx, int on) {
+  asContext(ctx)->metrics().setEnabled(on != 0);
+}
+
+int tc_metrics_enabled(void* ctx) {
+  return asContext(ctx)->metrics().enabled() ? 1 : 0;
+}
+
+// Straggler watchdog threshold; <= 0 disables. Overrides the
+// TPUCOLL_WATCHDOG_MS environment default for this context.
+void tc_metrics_set_watchdog(void* ctx, int64_t thresholdMs) {
+  asContext(ctx)->metrics().setWatchdogUs(thresholdMs * 1000);
+}
+
+// Returns a malloc'd JSON object (see Metrics::toJson); caller frees with
+// tc_buf_free. drain != 0 resets counters/histograms after the snapshot.
+int tc_metrics_json(void* ctx, int drain, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    std::string json = asContext(ctx)->metricsJson(drain != 0);
     *outLen = json.size();
     *out = static_cast<uint8_t*>(malloc(json.size()));
     if (*out == nullptr && !json.empty()) {
@@ -589,12 +633,22 @@ void tc_buffer_free(void* buf) { delete asBuffer(buf); }
 
 int tc_buffer_send(void* buf, int dst, uint64_t slot, size_t offset,
                    size_t nbytes) {
-  return wrap([&] { asBuffer(buf)->send(dst, slot, offset, nbytes); });
+  return wrap([&] {
+    asBuffer(buf)->send(dst, slot, offset, nbytes);
+    if (auto* m = bufMetrics(asBuffer(buf))) {
+      m->recordCall(tpucoll::MetricOp::kSend, nbytes);
+    }
+  });
 }
 
 int tc_buffer_recv(void* buf, int src, uint64_t slot, size_t offset,
                    size_t nbytes) {
-  return wrap([&] { asBuffer(buf)->recv(src, slot, offset, nbytes); });
+  return wrap([&] {
+    asBuffer(buf)->recv(src, slot, offset, nbytes);
+    if (auto* m = bufMetrics(asBuffer(buf))) {
+      m->recordCall(tpucoll::MetricOp::kRecv, nbytes);
+    }
+  });
 }
 
 int tc_buffer_recv_any(void* buf, const int* srcs, size_t nsrcs,
@@ -602,36 +656,83 @@ int tc_buffer_recv_any(void* buf, const int* srcs, size_t nsrcs,
   return wrap([&] {
     asBuffer(buf)->recv(std::vector<int>(srcs, srcs + nsrcs), slot, offset,
                         nbytes);
+    if (auto* m = bufMetrics(asBuffer(buf))) {
+      m->recordCall(tpucoll::MetricOp::kRecv, nbytes);
+    }
   });
 }
 
+// The p2p waits carry the user-facing instrumentation (tracer span +
+// latency histogram + error counter). Collective-internal waits are NOT
+// routed through here, so p2p spans never flood a collective trace.
 int tc_buffer_wait_send(void* buf, int64_t timeoutMs) {
+  UnboundBuffer* b = asBuffer(buf);
+  tpucoll::Metrics* m = bufMetrics(b);
+  const bool measured = m != nullptr && m->enabled();
+  const int64_t startUs = measured ? tpucoll::Tracer::nowUs() : 0;
   int rv = TC_OK;
-  int code = wrap([&] {
-    if (!asBuffer(buf)->waitSend(ms(timeoutMs))) {
-      rv = TC_ERR_ABORTED;
+  int code;
+  {
+    auto span = maybeSpan(b, "wait_send");
+    code = wrap([&] {
+      if (!b->waitSend(ms(timeoutMs))) {
+        rv = TC_ERR_ABORTED;
+      }
+    });
+  }
+  if (measured) {
+    m->recordLatency(tpucoll::MetricOp::kSend,
+                     tpucoll::Tracer::nowUs() - startUs);
+    if (code != TC_OK) {
+      m->recordError(tpucoll::MetricOp::kSend);
     }
-  });
+  }
   return code != TC_OK ? code : rv;
 }
 
 int tc_buffer_wait_put(void* buf, int64_t timeoutMs, int* srcOut) {
+  UnboundBuffer* b = asBuffer(buf);
   int rv = TC_OK;
-  int code = wrap([&] {
-    if (!asBuffer(buf)->waitPutArrival(srcOut, ms(timeoutMs))) {
-      rv = TC_ERR_ABORTED;
+  int code;
+  {
+    auto span = maybeSpan(b, "wait_put");
+    code = wrap([&] {
+      if (!b->waitPutArrival(srcOut, ms(timeoutMs))) {
+        rv = TC_ERR_ABORTED;
+      }
+    });
+    if (code == TC_OK && rv == TC_OK && srcOut != nullptr) {
+      span.setPeer(*srcOut);
     }
-  });
+  }
   return code != TC_OK ? code : rv;
 }
 
 int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
+  UnboundBuffer* b = asBuffer(buf);
+  tpucoll::Metrics* m = bufMetrics(b);
+  const bool measured = m != nullptr && m->enabled();
+  const int64_t startUs = measured ? tpucoll::Tracer::nowUs() : 0;
   int rv = TC_OK;
-  int code = wrap([&] {
-    if (!asBuffer(buf)->waitRecv(srcOut, ms(timeoutMs))) {
-      rv = TC_ERR_ABORTED;
+  int code;
+  {
+    auto span = maybeSpan(b, "wait_recv");
+    code = wrap([&] {
+      if (!b->waitRecv(srcOut, ms(timeoutMs))) {
+        rv = TC_ERR_ABORTED;
+      }
+    });
+    if (code == TC_OK && rv == TC_OK && srcOut != nullptr) {
+      span.setPeer(*srcOut);
     }
-  });
+  }
+  if (measured) {
+    m->recordLatency(tpucoll::MetricOp::kRecv,
+                     tpucoll::Tracer::nowUs() - startUs);
+    if (code != TC_OK) {
+      m->recordError(tpucoll::MetricOp::kRecv);
+    }
+  }
   return code != TC_OK ? code : rv;
 }
 

@@ -24,6 +24,7 @@ void barrier(BarrierOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "barrier: null context");
   auto traceSpan = ctx->tracer().span("barrier");
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kBarrier, 0);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -53,6 +54,8 @@ void broadcast(BroadcastOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "broadcast: null context");
   auto traceSpan = ctx->tracer().span("broadcast", opts.count * elementSize(opts.dtype), opts.root);
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kBroadcast,
+                      opts.count * elementSize(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -128,7 +131,18 @@ void broadcast(BroadcastOptions& opts) {
   }
 }
 
+// Shared schedule behind gather/gatherv; the public entries carry the
+// instrumentation, so each op is attributed under ITS OWN name (a
+// dashboard watching op="gather" must not read zero forever).
+static void gathervRun(GathervOptions& opts);
+
 void gather(GatherOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "gather: null context");
+  auto traceSpan = ctx->tracer().span(
+      "gather", opts.count * elementSize(opts.dtype), opts.root);
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kGather,
+                      opts.count * elementSize(opts.dtype));
   GathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -136,15 +150,26 @@ void gather(GatherOptions& opts) {
   v.counts.assign(opts.context->size(), opts.count);
   v.dtype = opts.dtype;
   v.root = opts.root;
-  gatherv(v);
+  gathervRun(v);
 }
 
-// Root posts P-1 receives at per-rank offsets; leaves send once (reference:
-// gloo/gather.cc:28-59, gatherv.cc:58-109).
 void gatherv(GathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "gatherv: null context");
   auto traceSpan = ctx->tracer().span("gatherv", 0, opts.root);
+  MetricsOp metricsOp(
+      &ctx->metrics(), MetricOp::kGatherv,
+      // Guarded: the counts-size enforce runs inside gathervRun.
+      static_cast<size_t>(ctx->rank()) < opts.counts.size()
+          ? opts.counts[ctx->rank()] * elementSize(opts.dtype)
+          : 0);
+  gathervRun(opts);
+}
+
+// Root posts P-1 receives at per-rank offsets; leaves send once (reference:
+// gloo/gather.cc:28-59, gatherv.cc:58-109).
+static void gathervRun(GathervOptions& opts) {
+  Context* ctx = opts.context;
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -189,6 +214,8 @@ void scatter(ScatterOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "scatter: null context");
   auto traceSpan = ctx->tracer().span("scatter", opts.count * elementSize(opts.dtype), opts.root);
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kScatter,
+                      opts.count * elementSize(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -294,10 +321,16 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
 
 }  // namespace
 
+// Shared schedule behind alltoall/alltoallv (instrumentation lives in
+// the public entries, same rationale as gathervRun).
+static void alltoallvRun(AlltoallvOptions& opts);
+
 void alltoall(AlltoallOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "alltoall: null context");
   const size_t blockBytes = opts.count * elementSize(opts.dtype);
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAlltoall,
+                      blockBytes * ctx->size());
   // Crossover: Bruck's ceil(log2 P) rounds win while per-block payload
   // is latency-dominated; the pairwise exchange's P-1 single-hop
   // rounds win once bandwidth dominates (each Bruck block travels up
@@ -316,6 +349,8 @@ void alltoall(AlltoallOptions& opts) {
                   detail::effectiveTimeout(opts));
     return;
   }
+  auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
+                                      "pairwise");
   AlltoallvOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -323,16 +358,27 @@ void alltoall(AlltoallOptions& opts) {
   v.inCounts.assign(opts.context->size(), opts.count);
   v.outCounts.assign(opts.context->size(), opts.count);
   v.dtype = opts.dtype;
-  alltoallv(v);
+  alltoallvRun(v);
+}
+
+void alltoallv(AlltoallvOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "alltoallv: null context");
+  auto traceSpan = ctx->tracer().span("alltoallv");
+  size_t inCountTotal = 0;
+  for (size_t c : opts.inCounts) {
+    inCountTotal += c;
+  }
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAlltoallv,
+                      inCountTotal * elementSize(opts.dtype));
+  alltoallvRun(opts);
 }
 
 // Rotated pairwise exchange: at step i, send to rank+i and receive from
 // rank-i, so every step moves disjoint pairs and link load stays balanced
 // (reference: gloo/alltoall.cc:39-50, alltoallv.cc:19-30).
-void alltoallv(AlltoallvOptions& opts) {
+static void alltoallvRun(AlltoallvOptions& opts) {
   Context* ctx = opts.context;
-  TC_ENFORCE(ctx != nullptr, "alltoallv: null context");
-  auto traceSpan = ctx->tracer().span("alltoallv");
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();

@@ -226,13 +226,44 @@ void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
 
 }  // namespace
 
-// Ring allgather: block b travels P-1 hops; receives land in place in the
-// output (reference schedule shape: gloo/allgather.cc:55-98, with the
-// pre-post + segment-forward pipeline of ringAllgatherPhase).
+// Shared schedule behind allgather/allgatherv; instrumentation lives in
+// the public entries so each op is attributed under its own name.
+static void allgathervRun(AllgathervOptions& opts);
+
 void allgatherv(AllgathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "allgatherv: null context");
   auto traceSpan = ctx->tracer().span("allgatherv");
+  MetricsOp metricsOp(
+      &ctx->metrics(), MetricOp::kAllgatherv,
+      // Guarded: the counts-size enforce runs inside allgathervRun.
+      static_cast<size_t>(ctx->rank()) < opts.counts.size()
+          ? opts.counts[ctx->rank()] * elementSize(opts.dtype)
+          : 0);
+  allgathervRun(opts);
+}
+
+void allgather(AllgatherOptions& opts) {
+  Context* ctx = opts.context;
+  TC_ENFORCE(ctx != nullptr, "allgather: null context");
+  auto traceSpan = ctx->tracer().span(
+      "allgather", opts.count * elementSize(opts.dtype));
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAllgather,
+                      opts.count * elementSize(opts.dtype));
+  AllgathervOptions v;
+  static_cast<CollectiveOptions&>(v) = opts;
+  v.input = opts.input;
+  v.output = opts.output;
+  v.counts.assign(opts.context->size(), opts.count);
+  v.dtype = opts.dtype;
+  allgathervRun(v);
+}
+
+// Ring allgather: block b travels P-1 hops; receives land in place in the
+// output (reference schedule shape: gloo/allgather.cc:55-98, with the
+// pre-post + segment-forward pipeline of ringAllgatherPhase).
+static void allgathervRun(AllgathervOptions& opts) {
+  Context* ctx = opts.context;
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -285,16 +316,6 @@ void allgatherv(AllgathervOptions& opts) {
                      timeout);
 }
 
-void allgather(AllgatherOptions& opts) {
-  AllgathervOptions v;
-  static_cast<CollectiveOptions&>(v) = opts;
-  v.input = opts.input;
-  v.output = opts.output;
-  v.counts.assign(opts.context->size(), opts.count);
-  v.dtype = opts.dtype;
-  allgatherv(v);
-}
-
 // Bandwidth-optimal ring allreduce (reference hot path: gloo/allreduce.cc:
 // 147-392): local multi-input reduce, algorithm-specific exchange, then fan
 // the result to every output buffer.
@@ -307,6 +328,7 @@ void allreduce(AllreduceOptions& opts) {
   const int size = ctx->size();
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAllreduce, nbytes);
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -510,6 +532,7 @@ void reduce(ReduceOptions& opts) {
   TC_ENFORCE(opts.root >= 0 && opts.root < size, "reduce: bad root");
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kReduce, nbytes);
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -586,6 +609,7 @@ void reduceScatter(ReduceScatterOptions& opts) {
                   : getReduceFn(opts.dtype, opts.op);
   Blocks blocks = countBlocks(opts.recvCounts, elsize);
   const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kReduceScatter, total);
 
   if (size == 1) {
     std::memcpy(opts.output, opts.input, total);

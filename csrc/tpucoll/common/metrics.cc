@@ -1,0 +1,258 @@
+#include "tpucoll/common/metrics.h"
+
+#include <sstream>
+
+#include "tpucoll/common/logging.h"
+#include "tpucoll/common/tracer.h"
+
+namespace tpucoll {
+
+const char* metricOpName(MetricOp op) {
+  switch (op) {
+    case MetricOp::kAllreduce:
+      return "allreduce";
+    case MetricOp::kBroadcast:
+      return "broadcast";
+    case MetricOp::kBarrier:
+      return "barrier";
+    case MetricOp::kReduce:
+      return "reduce";
+    case MetricOp::kGather:
+      return "gather";
+    case MetricOp::kGatherv:
+      return "gatherv";
+    case MetricOp::kScatter:
+      return "scatter";
+    case MetricOp::kAllgather:
+      return "allgather";
+    case MetricOp::kAllgatherv:
+      return "allgatherv";
+    case MetricOp::kAlltoall:
+      return "alltoall";
+    case MetricOp::kAlltoallv:
+      return "alltoallv";
+    case MetricOp::kReduceScatter:
+      return "reduce_scatter";
+    case MetricOp::kSend:
+      return "send";
+    case MetricOp::kRecv:
+      return "recv";
+    case MetricOp::kConnect:
+      return "connect";
+    case MetricOp::kCount:
+      break;
+  }
+  return "unknown";
+}
+
+void Metrics::Histogram::record(int64_t us) {
+  int idx = 0;
+  if (us > 0) {
+    idx = 63 - __builtin_clzll(static_cast<uint64_t>(us));
+    if (idx >= kLatencyBuckets) {
+      idx = kLatencyBuckets - 1;
+    }
+  }
+  buckets[idx].fetch_add(1, std::memory_order_relaxed);
+  count.fetch_add(1, std::memory_order_relaxed);
+  sumUs.fetch_add(us > 0 ? static_cast<uint64_t>(us) : 0,
+                  std::memory_order_relaxed);
+  // Racy max is fine: metrics tolerate losing one concurrent update.
+  uint64_t prev = maxUs.load(std::memory_order_relaxed);
+  while (us > 0 && static_cast<uint64_t>(us) > prev &&
+         !maxUs.compare_exchange_weak(prev, static_cast<uint64_t>(us),
+                                      std::memory_order_relaxed)) {
+  }
+}
+
+void Metrics::Histogram::reset() {
+  for (auto& b : buckets) {
+    b.store(0, std::memory_order_relaxed);
+  }
+  count.store(0, std::memory_order_relaxed);
+  sumUs.store(0, std::memory_order_relaxed);
+  maxUs.store(0, std::memory_order_relaxed);
+}
+
+Metrics::Metrics(int size) : size_(size), peers_(size) {
+  const char* ms = getenv("TPUCOLL_WATCHDOG_MS");
+  if (ms != nullptr && ms[0] != '\0') {
+    watchdogUs_.store(atoll(ms) * 1000, std::memory_order_relaxed);
+  }
+}
+
+void Metrics::recordStall(const Stall& stall) {
+  // Deliberately NOT gated on enabled_: the watchdog is armed by its own
+  // threshold, and a stall report must survive a counters-off config.
+  stalls_.fetch_add(1, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> guard(stallMu_);
+    haveStall_ = true;
+    lastStall_ = stall;
+  }
+  TC_WARN("watchdog: ", stall.isSend ? "send" : "recv", " blocked for ",
+          stall.waitedUs / 1000, "ms on peer ", stall.peer, " slot ",
+          stall.slot, " (peer last progress ",
+          stall.peerLastProgressUs == 0
+              ? -1
+              : (stall.atUs - stall.peerLastProgressUs) / 1000,
+          "ms ago)");
+}
+
+bool Metrics::lastStall(Stall* out) const {
+  std::lock_guard<std::mutex> guard(stallMu_);
+  if (!haveStall_) {
+    return false;
+  }
+  *out = lastStall_;
+  return true;
+}
+
+namespace {
+
+void histToJson(std::ostringstream& out, const Metrics::Histogram& h) {
+  out << "{\"count\":" << h.count.load(std::memory_order_relaxed)
+      << ",\"sum_us\":" << h.sumUs.load(std::memory_order_relaxed)
+      << ",\"max_us\":" << h.maxUs.load(std::memory_order_relaxed)
+      << ",\"buckets\":[";
+  bool first = true;
+  for (int i = 0; i < kLatencyBuckets; i++) {
+    const uint64_t n = h.buckets[i].load(std::memory_order_relaxed);
+    if (n == 0) {
+      continue;
+    }
+    if (!first) {
+      out << ",";
+    }
+    first = false;
+    // Upper bound of bucket i is 2^(i+1) us (exclusive).
+    out << "[" << (uint64_t(1) << (i + 1)) << "," << n << "]";
+  }
+  out << "]}";
+}
+
+}  // namespace
+
+std::string Metrics::toJson(int rank, bool drain) {
+  const int64_t nowUs = Tracer::nowUs();
+  std::ostringstream out;
+  out << "{\"rank\":" << rank << ",\"size\":" << size_
+      << ",\"enabled\":" << (enabled() ? "true" : "false")
+      << ",\"watchdog_ms\":" << watchdogUs() / 1000 << ",\"now_us\":" << nowUs
+      << ",\"retries\":" << retries_.load(std::memory_order_relaxed);
+
+  out << ",\"ops\":{";
+  bool first = true;
+  for (int i = 0; i < static_cast<int>(MetricOp::kCount); i++) {
+    const OpStats& s = ops_[i];
+    if (s.calls.load(std::memory_order_relaxed) == 0 &&
+        s.errors.load(std::memory_order_relaxed) == 0) {
+      continue;
+    }
+    if (!first) {
+      out << ",";
+    }
+    first = false;
+    out << "\"" << metricOpName(static_cast<MetricOp>(i))
+        << "\":{\"calls\":" << s.calls.load(std::memory_order_relaxed)
+        << ",\"bytes\":" << s.bytes.load(std::memory_order_relaxed)
+        << ",\"errors\":" << s.errors.load(std::memory_order_relaxed)
+        << ",\"latency_us\":";
+    histToJson(out, s.latency);
+    out << "}";
+  }
+  out << "}";
+
+  out << ",\"transport\":{";
+  first = true;
+  for (int p = 0; p < size_; p++) {
+    const PeerStats& ps = peers_[p];
+    const int64_t progress = ps.lastProgressUs.load(std::memory_order_relaxed);
+    if (ps.sentMsgs.load(std::memory_order_relaxed) == 0 &&
+        ps.recvMsgs.load(std::memory_order_relaxed) == 0 && progress == 0) {
+      continue;
+    }
+    if (!first) {
+      out << ",";
+    }
+    first = false;
+    out << "\"" << p
+        << "\":{\"sent_msgs\":" << ps.sentMsgs.load(std::memory_order_relaxed)
+        << ",\"sent_bytes\":" << ps.sentBytes.load(std::memory_order_relaxed)
+        << ",\"recv_msgs\":" << ps.recvMsgs.load(std::memory_order_relaxed)
+        << ",\"recv_bytes\":" << ps.recvBytes.load(std::memory_order_relaxed)
+        << ",\"last_progress_us\":" << progress
+        << ",\"last_progress_age_us\":"
+        << (progress == 0 ? -1 : nowUs - progress) << ",\"recv_wait_us\":";
+    histToJson(out, ps.recvWaitUs);
+    out << "}";
+  }
+  out << "}";
+
+  out << ",\"watchdog\":{\"stalls\":"
+      << stalls_.load(std::memory_order_relaxed) << ",\"last\":";
+  Stall stall;
+  if (lastStall(&stall)) {
+    out << "{\"op\":\"" << (stall.isSend ? "send" : "recv")
+        << "\",\"peer\":" << stall.peer << ",\"slot\":" << stall.slot
+        << ",\"waited_us\":" << stall.waitedUs << ",\"at_us\":" << stall.atUs
+        << ",\"age_us\":" << (nowUs - stall.atUs)
+        << ",\"peer_last_progress_us\":" << stall.peerLastProgressUs << "}";
+  } else {
+    out << "null";
+  }
+  out << "}}";
+
+  if (drain) {
+    resetAll();
+  }
+  return out.str();
+}
+
+void Metrics::resetAll() {
+  for (auto& s : ops_) {
+    s.calls.store(0, std::memory_order_relaxed);
+    s.bytes.store(0, std::memory_order_relaxed);
+    s.errors.store(0, std::memory_order_relaxed);
+    s.latency.reset();
+  }
+  for (auto& p : peers_) {
+    p.sentMsgs.store(0, std::memory_order_relaxed);
+    p.sentBytes.store(0, std::memory_order_relaxed);
+    p.recvMsgs.store(0, std::memory_order_relaxed);
+    p.recvBytes.store(0, std::memory_order_relaxed);
+    p.recvWaitUs.reset();
+    // lastProgressUs survives: it is a timestamp, not a counter.
+  }
+  retries_.store(0, std::memory_order_relaxed);
+  stalls_.store(0, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> guard(stallMu_);
+    haveStall_ = false;
+  }
+}
+
+MetricsOp::MetricsOp(Metrics* metrics, MetricOp op, uint64_t bytes)
+    : metrics_(metrics), op_(op), startUs_(0) {
+  if (metrics_ == nullptr || !metrics_->enabled()) {
+    metrics_ = nullptr;  // single disabled-path check, nothing else
+    return;
+  }
+  metrics_->recordCall(op, bytes);
+  startUs_ = Tracer::nowUs();
+  exceptionsAtEntry_ = std::uncaught_exceptions();
+}
+
+MetricsOp::~MetricsOp() {
+  if (metrics_ == nullptr) {
+    return;
+  }
+  // Baseline comparison, not a plain >0 check: a collective invoked from
+  // a destructor during unwinding must not count a phantom error.
+  if (std::uncaught_exceptions() > exceptionsAtEntry_) {
+    metrics_->recordError(op_);
+  }
+  metrics_->recordLatency(op_, Tracer::nowUs() - startUs_);
+}
+
+}  // namespace tpucoll

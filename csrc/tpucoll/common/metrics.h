@@ -1,0 +1,249 @@
+// Per-Context metrics registry: counters + fixed-bucket latency histograms
+// for every collective kind and transport peer, plus the straggler
+// watchdog's stall records.
+//
+// The reference ships no introspection beyond its benchmark harness
+// (SURVEY.md §5); the Tracer (tracer.h) added spans, and this layer adds
+// the always-cheap aggregate view a production deployment scrapes:
+// per-collective call/byte/error counters and latency distributions,
+// per-peer transport byte counters with a last-progress timestamp, and a
+// record of the last stalled operation (which peer/slot a rank was
+// blocked on past the watchdog deadline).
+//
+// Cost contract: every hot-path update is gated on ONE relaxed atomic
+// load (enabled_); when enabled, an update is a handful of relaxed
+// fetch_adds. No locks anywhere on the data path — the only mutex guards
+// the (rare) stall record and snapshot serialization.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+
+// Fixed power-of-two latency buckets: bucket i counts durations in
+// [2^i, 2^(i+1)) microseconds; the last bucket absorbs everything above
+// ~67s. 27 buckets cover 1us .. 2^26us with no allocation.
+constexpr int kLatencyBuckets = 27;
+
+// Everything the registry tracks per operation kind. kConnect covers the
+// rendezvous/bootstrap path (connectFullMesh / forkFrom).
+enum class MetricOp : uint8_t {
+  kAllreduce = 0,
+  kBroadcast,
+  kBarrier,
+  kReduce,
+  kGather,
+  kGatherv,
+  kScatter,
+  kAllgather,
+  kAllgatherv,
+  kAlltoall,
+  kAlltoallv,
+  kReduceScatter,
+  kSend,
+  kRecv,
+  kConnect,
+  kCount,
+};
+
+const char* metricOpName(MetricOp op);
+
+class Metrics {
+ public:
+  struct Histogram {
+    std::atomic<uint64_t> buckets[kLatencyBuckets] = {};
+    std::atomic<uint64_t> count{0};
+    std::atomic<uint64_t> sumUs{0};
+    std::atomic<uint64_t> maxUs{0};
+
+    void record(int64_t us);
+    void reset();
+    bool empty() const {
+      return count.load(std::memory_order_relaxed) == 0;
+    }
+  };
+
+  struct OpStats {
+    std::atomic<uint64_t> calls{0};
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<uint64_t> errors{0};
+    Histogram latency;
+  };
+
+  // Per-peer transport counters the transport::Context/Pair layer cannot
+  // hold itself (it is torn down on close; metrics must survive for the
+  // post-mortem snapshot). lastProgressUs is stamped by the pair whenever
+  // payload bytes move.
+  struct PeerStats {
+    std::atomic<uint64_t> sentMsgs{0};
+    std::atomic<uint64_t> sentBytes{0};
+    std::atomic<uint64_t> recvMsgs{0};
+    std::atomic<uint64_t> recvBytes{0};
+    std::atomic<int64_t> lastProgressUs{0};
+    // Latency from p2p wait start to completion against this peer
+    // (recv side, where the source rank is known).
+    Histogram recvWaitUs;
+  };
+
+  // Last stalled operation, as reported by the watchdog. `peer` is -1
+  // when the blocked op admits several sources (recv-from-any).
+  struct Stall {
+    bool isSend{false};
+    int peer{-1};
+    uint64_t slot{0};
+    int64_t waitedUs{0};
+    int64_t atUs{0};            // steady-clock us when detected
+    int64_t peerLastProgressUs{0};
+  };
+
+  explicit Metrics(int size);
+
+  // ---- hot-path gate ----
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+  void setEnabled(bool on) {
+    enabled_.store(on, std::memory_order_relaxed);
+  }
+
+  // ---- collective / p2p op accounting ----
+  void recordCall(MetricOp op, uint64_t bytes) {
+    if (!enabled()) {
+      return;
+    }
+    ops_[static_cast<int>(op)].calls.fetch_add(1, std::memory_order_relaxed);
+    if (bytes != 0) {
+      ops_[static_cast<int>(op)].bytes.fetch_add(bytes,
+                                                 std::memory_order_relaxed);
+    }
+  }
+  void recordLatency(MetricOp op, int64_t us) {
+    if (!enabled()) {
+      return;
+    }
+    ops_[static_cast<int>(op)].latency.record(us);
+  }
+  void recordError(MetricOp op) {
+    if (!enabled()) {
+      return;
+    }
+    ops_[static_cast<int>(op)].errors.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // ---- transport peer accounting (Pair / transport::Context) ----
+  void recordSent(int peer, uint64_t bytes) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].sentMsgs.fetch_add(1, std::memory_order_relaxed);
+    peers_[peer].sentBytes.fetch_add(bytes, std::memory_order_relaxed);
+  }
+  void recordRecvd(int peer, uint64_t bytes) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].recvMsgs.fetch_add(1, std::memory_order_relaxed);
+    peers_[peer].recvBytes.fetch_add(bytes, std::memory_order_relaxed);
+  }
+  // Back out sends that were counted at enqueue but cancelled before
+  // touching the wire (rare teardown path).
+  void uncountSent(int peer, uint64_t msgs, uint64_t bytes) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].sentMsgs.fetch_sub(msgs, std::memory_order_relaxed);
+    peers_[peer].sentBytes.fetch_sub(bytes, std::memory_order_relaxed);
+  }
+  // Stamped on every payload movement — the watchdog's "when did this
+  // link last make progress" signal. Always on (a single relaxed store)
+  // so the timestamp is trustworthy even if counters were enabled late.
+  void touchProgress(int peer, int64_t nowUs) {
+    if (peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].lastProgressUs.store(nowUs, std::memory_order_relaxed);
+  }
+  void recordRecvWait(int peer, int64_t us) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].recvWaitUs.record(us);
+  }
+
+  // ---- connect retries (Pair backoff loop) ----
+  void recordRetry() {
+    if (!enabled()) {
+      return;
+    }
+    retries_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // ---- straggler watchdog ----
+  // Threshold in microseconds a blocking wait may run before the stall is
+  // reported; <= 0 disables the watchdog (the default unless
+  // TPUCOLL_WATCHDOG_MS is set).
+  int64_t watchdogUs() const {
+    return watchdogUs_.load(std::memory_order_relaxed);
+  }
+  void setWatchdogUs(int64_t us) {
+    watchdogUs_.store(us, std::memory_order_relaxed);
+  }
+  // Record (and log) a stall detected by a blocking wait. Not hot: fires
+  // at most once per blocked wait, after `watchdogUs` of no progress.
+  void recordStall(const Stall& stall);
+
+  uint64_t stallCount() const {
+    return stalls_.load(std::memory_order_relaxed);
+  }
+  // Copy of the last stall record; returns false when none was recorded.
+  bool lastStall(Stall* out) const;
+
+  int64_t lastProgressUs(int peer) const {
+    if (peer < 0 || peer >= size_) {
+      return 0;
+    }
+    return peers_[peer].lastProgressUs.load(std::memory_order_relaxed);
+  }
+
+  // ---- snapshot ----
+  // Structured JSON snapshot of everything above. `drain` resets all
+  // counters/histograms/stall records after serialization (timestamps and
+  // the enabled/watchdog configuration survive a drain).
+  std::string toJson(int rank, bool drain);
+
+ private:
+  void resetAll();
+
+  const int size_;
+  std::atomic<bool> enabled_{true};
+  std::atomic<int64_t> watchdogUs_{0};
+  OpStats ops_[static_cast<int>(MetricOp::kCount)];
+  std::vector<PeerStats> peers_;
+  std::atomic<uint64_t> retries_{0};
+  std::atomic<uint64_t> stalls_{0};
+
+  mutable std::mutex stallMu_;
+  bool haveStall_{false};
+  Stall lastStall_;
+};
+
+// RAII op-scope: counts the call + payload bytes at construction, records
+// the latency at destruction, and counts an error when unwinding through
+// an exception. One relaxed load when metrics are disabled.
+class MetricsOp {
+ public:
+  MetricsOp(Metrics* metrics, MetricOp op, uint64_t bytes);
+  ~MetricsOp();
+  MetricsOp(const MetricsOp&) = delete;
+  MetricsOp& operator=(const MetricsOp&) = delete;
+
+ private:
+  Metrics* metrics_;
+  MetricOp op_;
+  int64_t startUs_;
+  int exceptionsAtEntry_{0};
+};
+
+}  // namespace tpucoll

@@ -54,6 +54,11 @@ class Tracer {
     Span(const Span&) = delete;
     Span& operator=(const Span&) = delete;
 
+    // Fill in a peer learned only at completion (e.g. recv-from-any
+    // resolves its source when the message lands). No-op on a disabled
+    // span.
+    void setPeer(int peer) { event_.peer = peer; }
+
    private:
     Tracer* tracer_{nullptr};
     Event event_{};

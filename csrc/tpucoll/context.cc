@@ -7,7 +7,8 @@ namespace tpucoll {
 
 constexpr std::chrono::milliseconds Context::kDefaultTimeout;
 
-Context::Context(int rank, int size) : rank_(rank), size_(size) {
+Context::Context(int rank, int size)
+    : rank_(rank), size_(size), metrics_(size) {
   TC_ENFORCE(size > 0, "context size must be positive");
   TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
              size);
@@ -18,9 +19,11 @@ Context::~Context() = default;
 void Context::connectFullMesh(std::shared_ptr<Store> store,
                               std::shared_ptr<transport::Device> device) {
   TC_ENFORCE(tctx_ == nullptr, "context already connected");
+  MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   store_ = std::move(store);
   device_ = std::move(device);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
+  tctx_->setInstrumentation(&tracer_, &metrics_);
   tctx_->connectFullMesh(*store_, timeout_);
 }
 
@@ -30,7 +33,9 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   TC_ENFORCE_EQ(size_, parent.size(), "fork must keep the parent size");
   TC_ENFORCE(parent.tctx_ != nullptr, "parent context not connected");
   device_ = parent.device_;
+  MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
+  tctx_->setInstrumentation(&tracer_, &metrics_);
   auto blob = tctx_->prepareFullMesh();
 
   // Exchange blob lengths, then the blobs themselves, over the parent.
@@ -69,6 +74,10 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
     off += counts[j];
   }
   tctx_->connectWithBlobs(blobs, timeout_);
+}
+
+std::string Context::metricsJson(bool drain) {
+  return metrics_.toJson(rank_, drain);
 }
 
 uint64_t Context::nextSlot(uint32_t numToSkip) {

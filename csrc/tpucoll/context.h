@@ -10,6 +10,7 @@
 #include <mutex>
 #include <vector>
 
+#include "tpucoll/common/metrics.h"
 #include "tpucoll/common/tracer.h"
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/transport/context.h"
@@ -82,6 +83,14 @@ class Context {
   // collectives, then dump Chrome trace-event JSON via traceJson().
   Tracer& tracer() { return tracer_; }
 
+  // Metrics registry (counters + latency histograms + watchdog state).
+  // Enabled by default; per-op cost is a few relaxed atomic adds, and a
+  // single relaxed load when disabled.
+  Metrics& metrics() { return metrics_; }
+
+  // Structured JSON snapshot of the registry; `drain` resets counters.
+  std::string metricsJson(bool drain);
+
   void close();
 
  private:
@@ -96,6 +105,7 @@ class Context {
   std::mutex scratchMu_;
   std::vector<std::vector<char>> scratchPool_;
   Tracer tracer_;
+  Metrics metrics_;
 };
 
 }  // namespace tpucoll

@@ -558,6 +558,52 @@ bool Context::peerUsesShm(int rank) {
   return pairs_[rank]->shmActive();
 }
 
+void Context::reportStall(UnboundBuffer* buf, bool isSend,
+                          int64_t waitedUs) {
+  if (metrics_ == nullptr) {
+    return;
+  }
+  Metrics::Stall stall;
+  stall.isSend = isSend;
+  stall.waitedUs = waitedUs;
+  stall.atUs = Tracer::nowUs();
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (isSend) {
+      for (auto& pair : pairs_) {
+        uint64_t slot = 0;
+        if (pair && pair->sendSlotFor(buf, &slot)) {
+          stall.peer = pair->peerRank();
+          stall.slot = slot;
+          break;
+        }
+      }
+    } else {
+      for (const auto& pr : posted_) {
+        if (pr.ubuf != buf) {
+          continue;
+        }
+        stall.slot = pr.slot;
+        int only = -1;
+        int admitted = 0;
+        for (int r = 0; r < size_; r++) {
+          if (pr.allowed[r]) {
+            only = r;
+            admitted++;
+          }
+        }
+        // Recv-from-any stays peer=-1: no single culprit to name.
+        stall.peer = admitted == 1 ? only : -1;
+        break;
+      }
+    }
+  }
+  if (stall.peer >= 0) {
+    stall.peerLastProgressUs = metrics_->lastProgressUs(stall.peer);
+  }
+  metrics_->recordStall(stall);
+}
+
 void Context::debugDump() {
   std::lock_guard<std::mutex> guard(mu_);
   std::string s = "rank " + std::to_string(rank_) + ": posted=[";

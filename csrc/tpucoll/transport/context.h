@@ -20,6 +20,8 @@
 #include <vector>
 
 #include "tpucoll/common/logging.h"
+#include "tpucoll/common/metrics.h"
+#include "tpucoll/common/tracer.h"
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/transport/unbound_buffer.h"
 
@@ -136,6 +138,25 @@ class Context {
   // this to pick fused vs scratch receives per source (any thread).
   bool peerUsesShm(int rank);
 
+  // ---- observability ----
+  // Borrowed from the owning tpucoll::Context (which outlives this
+  // object); both may be null for standalone transport use (C++ unit
+  // tests). Set once before connect, read from data-path threads.
+  void setInstrumentation(Tracer* tracer, Metrics* metrics) {
+    tracer_ = tracer;
+    metrics_ = metrics;
+  }
+  Tracer* tracer() const { return tracer_; }
+  Metrics* metrics() const { return metrics_; }
+
+  // Straggler watchdog: called by a blocking wait (UnboundBuffer) that
+  // has made no progress past the watchdog threshold. Figures out which
+  // peer/slot `buf` is blocked on from the pending-operation table
+  // (posted receives / per-pair tx queues), logs it, and records the
+  // stall in the metrics registry. The caller must NOT hold the buffer
+  // lock (lock order is context -> buffer).
+  void reportStall(UnboundBuffer* buf, bool isSend, int64_t waitedUs);
+
  private:
   struct PostedRecv {
     UnboundBuffer* ubuf;
@@ -169,6 +190,8 @@ class Context {
   const std::shared_ptr<Device> device_;
   const int rank_;
   const int size_;
+  Tracer* tracer_{nullptr};
+  Metrics* metrics_{nullptr};
 
   std::mutex mu_;
   std::vector<std::unique_ptr<Pair>> pairs_;

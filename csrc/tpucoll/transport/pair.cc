@@ -121,6 +121,9 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
       if (!d.willRetry) {
         throw;
       }
+      if (Metrics* m = context_->metrics()) {
+        m->recordRetry();
+      }
       std::this_thread::sleep_for(kBackoff);
     } catch (const IoException& e) {
       // Refused/reset/poll errors: the peer is still coming up; retry
@@ -131,6 +134,9 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
       logConnectAttempt(d);
       if (!d.willRetry) {
         throw;
+      }
+      if (Metrics* m = context_->metrics()) {
+        m->recordRetry();
       }
       std::this_thread::sleep_for(kBackoff);
     }
@@ -489,9 +495,16 @@ void Pair::sendOwned(WireHeader header, std::vector<char> payload) {
   enqueue(std::move(op));
 }
 
+void Pair::touchProgress() {
+  if (Metrics* m = context_->metrics()) {
+    m->touchProgress(peerRank_, Tracer::nowUs());
+  }
+}
+
 void Pair::enqueue(TxOp op) {
   std::vector<UnboundBuffer*> completed;
   std::string txError;
+  const size_t nbytes = op.nbytes;
   {
     std::lock_guard<std::mutex> guard(mu_);
     State s = state_.load();
@@ -520,6 +533,9 @@ void Pair::enqueue(TxOp op) {
     txError = pendingTxError_;
     pendingTxError_.clear();
   }
+  if (Metrics* m = context_->metrics()) {
+    m->recordSent(peerRank_, nbytes);
+  }
   for (auto* b : completed) {
     if (b != nullptr) {
       b->onSendComplete();
@@ -533,6 +549,7 @@ void Pair::enqueue(TxOp op) {
 int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
   std::lock_guard<std::mutex> guard(mu_);
   int removed = 0;
+  uint64_t removedBytes = 0;
   for (auto it = tx_.begin(); it != tx_.end();) {
     // txInFlight_: a submitted SQE references the front op's memory even
     // before any byte is confirmed — it must not be freed under the
@@ -541,10 +558,16 @@ int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
         it == tx_.begin() &&
         (it->headerSent > 0 || it->headerSealed || txInFlight_);
     if (it->ubuf == ubuf && !started) {
+      removedBytes += it->nbytes;
       it = tx_.erase(it);
       removed++;
     } else {
       ++it;
+    }
+  }
+  if (removed > 0) {
+    if (Metrics* m = context_->metrics()) {
+      m->uncountSent(peerRank_, removed, removedBytes);
     }
   }
   return removed;
@@ -554,6 +577,17 @@ bool Pair::hasInflightSend(UnboundBuffer* ubuf) {
   std::lock_guard<std::mutex> guard(mu_);
   for (const auto& op : tx_) {
     if (op.ubuf == ubuf) {
+      return true;
+    }
+  }
+  return false;
+}
+
+bool Pair::sendSlotFor(UnboundBuffer* ubuf, uint64_t* slot) {
+  std::lock_guard<std::mutex> guard(mu_);
+  for (const auto& op : tx_) {
+    if (op.ubuf == ubuf) {
+      *slot = op.header.slot;
       return true;
     }
   }
@@ -841,6 +875,9 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
       if (n < 0 && errno == EINTR) {
         continue;
       }
+      if (n > 0) {
+        touchProgress();
+      }
       return n;
     }
   }
@@ -859,6 +896,9 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
 }
 
 void Pair::txAdvanceInFlight(size_t n) {
+  if (n > 0) {
+    touchProgress();
+  }
   switch (txSite_) {
     case TxSite::kCtrl:
       ctrlSent_ += n;
@@ -990,6 +1030,9 @@ void Pair::onRxEof() {
 }
 
 Pair::RxStep Pair::processRxBytes(size_t n, size_t* consumed) {
+  if (n > 0) {
+    touchProgress();
+  }
   if (!rxInPayload_) {
     const bool enc = keys_.encrypted;
     const size_t hdrWant =
@@ -1231,6 +1274,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     }
     shmRxDone_ += chunk;
     shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
+    touchProgress();
     *consumed += chunk;
     // Eager credit after draining a big chunk: the sender throttles on
     // ring space, and this lets it refill while we keep consuming.
@@ -1261,6 +1305,9 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     if (shmRxDone_ == shmRxTotal_) {
       shmRxActive_ = false;
       shmRxCombine_ = nullptr;  // carry is empty: nbytes % elsize == 0
+      if (Metrics* m = context_->metrics()) {
+        m->recordRecvd(peerRank_, shmRxTotal_);
+      }
       switch (shmRxMode_) {
         case RxMode::kDirect: {
           UnboundBuffer* b = nullptr;
@@ -1617,6 +1664,9 @@ void Pair::combineShmSpan(uint64_t msgOff, const char* src, size_t len) {
 }
 
 void Pair::finishMessage() {
+  if (Metrics* m = context_->metrics()) {
+    m->recordRecvd(peerRank_, rxHeader_.nbytes);
+  }
   switch (rxMode_) {
     case RxMode::kStash:
       try {

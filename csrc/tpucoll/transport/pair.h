@@ -80,6 +80,9 @@ class Pair : public Handler {
   int cancelQueuedSends(UnboundBuffer* ubuf);
   // True if any tx op (including a partially-written one) references ubuf.
   bool hasInflightSend(UnboundBuffer* ubuf);
+  // Watchdog introspection: slot of the first queued/in-flight tx op that
+  // references ubuf. Returns false when none does.
+  bool sendSlotFor(UnboundBuffer* ubuf, uint64_t* slot);
 
   // Graceful close; pending operations fail. Idempotent, thread-safe.
   void close();
@@ -352,6 +355,11 @@ class Pair : public Handler {
   // span's byte offset within the WIRE message; the accumulator address
   // for wire element i is shmRxDest_ + i * shmRxCombineAccElsize_.
   void combineShmSpan(uint64_t msgOff, const char* src, size_t len);
+
+  // Stamp this pair's last-progress timestamp in the metrics registry
+  // (the watchdog's liveness signal). One relaxed store; called wherever
+  // payload or wire bytes actually move.
+  void touchProgress();
 };
 
 }  // namespace transport

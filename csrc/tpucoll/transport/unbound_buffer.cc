@@ -165,27 +165,66 @@ void UnboundBuffer::get(const std::string& remoteKey, uint64_t slot,
   recv(key.rank, slot, offset, nbytes);
 }
 
-template <typename Pred>
+template <typename Pred, typename OnStall>
 bool UnboundBuffer::waitFor(std::unique_lock<std::mutex>& lock, Pred pred,
-                            std::chrono::milliseconds timeout) {
+                            std::chrono::milliseconds timeout,
+                            OnStall onStall) {
+  Metrics* metrics = context_->metrics();
+  const int64_t watchdogUs =
+      metrics != nullptr ? metrics->watchdogUs() : 0;
+  const auto start = std::chrono::steady_clock::now();
+  const auto deadline = start + timeout;
+  bool reported = false;
+  auto maybeReport = [&](std::chrono::steady_clock::time_point now) {
+    if (reported || watchdogUs <= 0 ||
+        now - start < std::chrono::microseconds(watchdogUs)) {
+      return;
+    }
+    reported = true;
+    const int64_t waitedUs =
+        std::chrono::duration_cast<std::chrono::microseconds>(now - start)
+            .count();
+    // Released: reportStall takes the transport-context lock, and the
+    // established order is context -> buffer.
+    lock.unlock();
+    onStall(waitedUs);
+    lock.lock();
+  };
   if (!context_->device()->busyPoll()) {
-    return cv_.wait_for(lock, timeout, pred);
+    if (watchdogUs <= 0) {
+      return cv_.wait_for(lock, timeout, pred);
+    }
+    const auto stallAt = start + std::chrono::microseconds(watchdogUs);
+    while (!pred()) {
+      const auto next =
+          (!reported && stallAt < deadline) ? stallAt : deadline;
+      if (cv_.wait_until(lock, next, pred)) {
+        return true;
+      }
+      const auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) {
+        return pred();
+      }
+      maybeReport(now);
+    }
+    return true;
   }
   // Sync/busy-poll mode: spin instead of sleeping — the completion comes
   // from the (also spinning) loop thread, so the round trip avoids two
   // kernel wakeups.
-  const auto deadline = std::chrono::steady_clock::now() + timeout;
   while (!pred()) {
     lock.unlock();
 #if defined(__x86_64__) || defined(__i386__)
     __builtin_ia32_pause();
 #endif
     std::this_thread::yield();
-    const bool expired = std::chrono::steady_clock::now() >= deadline;
+    const auto now = std::chrono::steady_clock::now();
+    const bool expired = now >= deadline;
     lock.lock();
     if (expired) {
       return pred();
     }
+    maybeReport(now);
   }
   return true;
 }
@@ -193,7 +232,10 @@ bool UnboundBuffer::waitFor(std::unique_lock<std::mutex>& lock, Pred pred,
 bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {
   std::unique_lock<std::mutex> lock(mu_);
   auto pred = [&] { return completedSends_ > 0 || abortSend_ || failed_; };
-  if (!waitFor(lock, pred, timeout)) {
+  auto onStall = [this](int64_t waitedUs) {
+    context_->reportStall(this, /*isSend=*/true, waitedUs);
+  };
+  if (!waitFor(lock, pred, timeout, onStall)) {
     TC_THROW(TimeoutException, "waitSend timed out after ", timeout.count(),
              "ms");
   }
@@ -209,11 +251,18 @@ bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {
 }
 
 bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
+  // One relaxed load when metrics are off; timestamps only when on.
+  Metrics* metrics = context_->metrics();
+  const bool measured = metrics != nullptr && metrics->enabled();
+  const int64_t startUs = measured ? Tracer::nowUs() : 0;
   std::unique_lock<std::mutex> lock(mu_);
   auto pred = [&] {
     return !completedRecvs_.empty() || abortRecv_ || failed_;
   };
-  if (!waitFor(lock, pred, timeout)) {
+  auto onStall = [this](int64_t waitedUs) {
+    context_->reportStall(this, /*isSend=*/false, waitedUs);
+  };
+  if (!waitFor(lock, pred, timeout, onStall)) {
     TC_THROW(TimeoutException, "waitRecv timed out after ", timeout.count(),
              "ms");
   }
@@ -224,10 +273,15 @@ bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
     return false;
   }
   TC_ENFORCE(!completedRecvs_.empty());
+  const int src = completedRecvs_.front();
   if (srcRank != nullptr) {
-    *srcRank = completedRecvs_.front();
+    *srcRank = src;
   }
   completedRecvs_.pop_front();
+  if (measured) {
+    // Per-peer wait latency: the "which link is slow" histogram.
+    metrics->recordRecvWait(src, Tracer::nowUs() - startUs);
+  }
   return true;
 }
 
@@ -237,7 +291,10 @@ bool UnboundBuffer::waitPutArrival(int* srcRank,
   auto pred = [&] {
     return !putArrivals_.empty() || abortRecv_ || failed_;
   };
-  if (!waitFor(lock, pred, timeout)) {
+  auto onStall = [this](int64_t waitedUs) {
+    context_->reportStall(this, /*isSend=*/false, waitedUs);
+  };
+  if (!waitFor(lock, pred, timeout, onStall)) {
     TC_THROW(TimeoutException, "waitPutArrival timed out after ",
              timeout.count(), "ms");
   }

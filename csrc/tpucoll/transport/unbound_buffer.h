@@ -42,6 +42,8 @@ class UnboundBuffer {
 
   void* ptr() const { return ptr_; }
   size_t size() const { return size_; }
+  // Owning transport context (observability hooks live there).
+  Context* transportContext() const { return context_; }
 
   // Async send of [offset, offset+nbytes) to dstRank under `slot`.
   // nbytes == SIZE_MAX means "rest of the buffer".
@@ -142,10 +144,13 @@ class UnboundBuffer {
 
  private:
   // Blocking-wait core: condvar sleep, or a spin when the device is in
-  // sync/busy-poll mode.
-  template <typename Pred>
+  // sync/busy-poll mode. When the context's watchdog threshold is set and
+  // the wait exceeds it, `onStall(waitedUs)` fires ONCE with the buffer
+  // lock released (lock order is context -> buffer), then the wait
+  // continues to its normal deadline.
+  template <typename Pred, typename OnStall>
   bool waitFor(std::unique_lock<std::mutex>& lock, Pred pred,
-               std::chrono::milliseconds timeout);
+               std::chrono::milliseconds timeout, OnStall onStall);
 
   Context* const context_;
   void* const ptr_;

@@ -118,6 +118,12 @@ _PROTOTYPES = {
     "tc_trace_stop": (None, [_c]),
     "tc_trace_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # metrics + straggler watchdog
+    "tc_metrics_enable": (None, [_c, _int]),
+    "tc_metrics_enabled": (_int, [_c]),
+    "tc_metrics_set_watchdog": (None, [_c, _i64]),
+    "tc_metrics_json": (_int, [_c, _int, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # collectives
     "tc_barrier": (_int, [_c, _u32, _i64]),
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),

@@ -9,6 +9,7 @@ plane (jax arrays over an ICI mesh) lives in gloo_tpu.tpu.
 from __future__ import annotations
 
 import ctypes
+import json
 from typing import Optional, Sequence
 
 import numpy as np
@@ -585,6 +586,58 @@ class Context:
     def trace_dump(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.trace_json())
+
+    # ---- metrics + straggler watchdog (capability the reference lacks) --
+
+    def metrics(self, drain: bool = False) -> dict:
+        """Snapshot the context's metrics registry as a dict.
+
+        Shape: {"rank", "size", "enabled", "watchdog_ms", "now_us",
+        "retries", "ops": {name: {"calls", "bytes", "errors",
+        "latency_us": hist}}, "transport": {peer: {"sent_msgs",
+        "sent_bytes", "recv_msgs", "recv_bytes", "last_progress_us",
+        "last_progress_age_us", "recv_wait_us": hist}}, "watchdog":
+        {"stalls", "last"}} where hist is {"count", "sum_us", "max_us",
+        "buckets": [[le_us, n], ...]} with per-bucket (non-cumulative)
+        counts in power-of-two microsecond buckets. Timestamps are
+        steady-clock microseconds (compare against "now_us", not wall
+        time). drain=True atomically resets counters after the snapshot
+        (scrape-style usage); configuration and progress timestamps
+        survive a drain. See gloo_tpu.utils.metrics for Prometheus text
+        exposition and quantile estimation.
+        """
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        check(_lib.lib.tc_metrics_json(self._handle, 1 if drain else 0,
+                                       ctypes.byref(out),
+                                       ctypes.byref(out_len)))
+        try:
+            raw = bytes(bytearray(out[: out_len.value])).decode()
+        finally:
+            _lib.lib.tc_buf_free(out)
+        snap = json.loads(raw)
+        # JSON keys are strings; peer ranks are ints.
+        snap["transport"] = {int(k): v
+                             for k, v in snap["transport"].items()}
+        return snap
+
+    def metrics_enable(self, on: bool = True) -> None:
+        """Toggle counter collection. Enabled by default; when disabled
+        the per-op cost drops to a single relaxed atomic check."""
+        _lib.lib.tc_metrics_enable(self._handle, 1 if on else 0)
+
+    def metrics_enabled(self) -> bool:
+        return bool(_lib.lib.tc_metrics_enabled(self._handle))
+
+    def set_watchdog(self, threshold: Optional[float]) -> None:
+        """Arm the straggler watchdog: any blocking wait (collective
+        segment or p2p) that makes no progress for `threshold` seconds
+        logs which peer/slot this rank is blocked on and records the
+        stall in the metrics snapshot (metrics()["watchdog"]). None or 0
+        disarms. Default comes from TPUCOLL_WATCHDOG_MS."""
+        disarm = threshold is None or threshold <= 0
+        ms_val = 0 if disarm else max(1, int(threshold * 1000))
+        _lib.lib.tc_metrics_set_watchdog(self._handle, ms_val)
 
     def register(self, array: np.ndarray) -> UnboundBuffer:
         return UnboundBuffer(self, array)

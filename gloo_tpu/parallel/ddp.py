@@ -38,9 +38,10 @@ def make_ddp_train_step(loss_fn: Callable, optimizer, mesh,
         # already psum'd the per-device gradients across `axis`; dividing by
         # the axis size yields the mean (adding a pmean here would be a
         # no-op on the already-replicated value, not a division).
-        n = spmd.size(axis)
-        grads = jax.tree.map(lambda g: g / n, grads)
-        return spmd.mean(loss, axis), grads
+        with jax.named_scope("gloo_tpu.ddp.grad_sync"):
+            n = spmd.size(axis)
+            grads = jax.tree.map(lambda g: g / n, grads)
+            return spmd.mean(loss, axis), grads
 
     import optax
 
@@ -73,12 +74,18 @@ class HostGradSync:
         self._tag = 1 << 20  # leave low tags to the application
 
     def average(self, grads):
+        from gloo_tpu.utils.tracing import annotate
+
         size = self.context.size
         leaves, treedef = jax.tree.flatten(grads)
         out = []
-        for i, leaf in enumerate(leaves):
-            arr = np.ascontiguousarray(np.asarray(leaf))
-            self.context.allreduce(arr, op="sum", tag=self._tag + i)
-            out.append(jnp.asarray(arr / size, dtype=leaf.dtype)
-                       if hasattr(leaf, "dtype") else arr / size)
+        # The annotation puts the host-plane allreduce on the jax
+        # profiler timeline next to device activity (the C++ tracer's
+        # own span covers the native side; see docs/observability.md).
+        with annotate("gloo_tpu.ddp.host_grad_sync"):
+            for i, leaf in enumerate(leaves):
+                arr = np.ascontiguousarray(np.asarray(leaf))
+                self.context.allreduce(arr, op="sum", tag=self._tag + i)
+                out.append(jnp.asarray(arr / size, dtype=leaf.dtype)
+                           if hasattr(leaf, "dtype") else arr / size)
         return jax.tree.unflatten(treedef, out)

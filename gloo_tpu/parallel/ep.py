@@ -59,14 +59,17 @@ def dispatch_combine(expert_fn: Callable, tokens, expert_idx, capacity: int,
     send = send[:n_experts]
 
     # Dispatch: slot (e, c) goes to expert e; gather every device's bucket.
-    arrived = spmd.alltoall(send, axis, split_axis=0, concat_axis=0)
+    with jax.named_scope("gloo_tpu.ep.dispatch"):
+        arrived = spmd.alltoall(send, axis, split_axis=0, concat_axis=0)
     arrived = arrived.reshape(n_experts * capacity, d)
 
     # Local expert processes all arrived tokens.
     processed = expert_fn(arrived).reshape(n_experts, capacity, d)
 
     # Combine: send results back to their source devices.
-    returned = spmd.alltoall(processed, axis, split_axis=0, concat_axis=0)
+    with jax.named_scope("gloo_tpu.ep.combine"):
+        returned = spmd.alltoall(processed, axis, split_axis=0,
+                                 concat_axis=0)
 
     # Un-scatter back to token order.
     out = returned[expert_idx, jnp.where(keep, pos, 0)]

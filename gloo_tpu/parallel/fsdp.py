@@ -61,7 +61,8 @@ def unshard_params(sharded, template, axis: str):
         size = 1
         for s in ref.shape:
             size *= s
-        full = spmd.allgather(piece, axis)
+        with jax.named_scope("gloo_tpu.fsdp.unshard"):
+            full = spmd.allgather(piece, axis)
         return full[:size].reshape(ref.shape).astype(ref.dtype)
 
     return jax.tree.map(gather, sharded, template)

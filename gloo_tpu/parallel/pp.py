@@ -71,7 +71,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
             outputs)
 
         # Rotate activations to the next stage.
-        nxt = spmd.shift(computed, axis, 1)
+        with jax.named_scope("gloo_tpu.pp.stage_shift"):
+            nxt = spmd.shift(computed, axis, 1)
         return (nxt, outputs), None
 
     # pcast: the carry becomes device-varying after the first tick; fresh
@@ -209,16 +210,18 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
             jnp.logical_and(do_b, is_last), loss_val, 0.0)
 
         # ---- communication (the inter-tick transport) ----
-        sent_f = spmd.shift(jnp.where(do_f, y_out, jnp.zeros_like(y_out)),
-                            axis, 1)
+        with jax.named_scope("gloo_tpu.pp.fwd_shift"):
+            sent_f = spmd.shift(
+                jnp.where(do_f, y_out, jnp.zeros_like(y_out)), axis, 1)
         left_f = fwd_tbl[t, (my_stage - 1) % stages]
         take_f = jnp.logical_and(my_stage > 0, left_f >= 0)
         a_recv = jnp.where(
             take_f,
             a_recv.at[jnp.clip(left_f, 0, m - 1) % stages].set(sent_f),
             a_recv)
-        sent_b = spmd.shift(jnp.where(do_b, gx, jnp.zeros_like(gx)),
-                            axis, -1)
+        with jax.named_scope("gloo_tpu.pp.bwd_shift"):
+            sent_b = spmd.shift(jnp.where(do_b, gx, jnp.zeros_like(gx)),
+                                axis, -1)
         right_b = bwd_tbl[t, (my_stage + 1) % stages]
         take_b = jnp.logical_and(my_stage < stages - 1, right_b >= 0)
         g_recv = jnp.where(

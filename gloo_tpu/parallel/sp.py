@@ -52,8 +52,9 @@ def ring_attention(q, k, v, axis: str, causal: bool = True):
         out_new = out * correction + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
         # Rotate K/V to the right neighbor for the next step.
-        k_next = spmd.shift(k_blk, axis, 1)
-        v_next = spmd.shift(v_blk, axis, 1)
+        with jax.named_scope("gloo_tpu.sp.ring_shift"):
+            k_next = spmd.shift(k_blk, axis, 1)
+            v_next = spmd.shift(v_blk, axis, 1)
         return k_next, v_next, out_new, m_new, l_new
 
     out0 = jnp.zeros((b, h, t_local, d), jnp.float32)
@@ -92,8 +93,9 @@ def _ring_flash_forward(q, k, v, axis, causal, block_q, block_k, interpret):
             q_offset=my * t_local, k_offset=src * t_local, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
             vma_axes=(axis,), kv_group=group)
-        k_next = spmd.shift(k_blk, axis, 1)
-        v_next = spmd.shift(v_blk, axis, 1)
+        with jax.named_scope("gloo_tpu.sp.ring_shift"):
+            k_next = spmd.shift(k_blk, axis, 1)
+            v_next = spmd.shift(v_blk, axis, 1)
         return k_next, v_next, acc, m, l
 
     def zeros(shape, fill=0.0):
@@ -244,8 +246,10 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = True,
     # (b, h, t_local, d) -> (b, h/n, t_global, d): scatter heads, gather
     # sequence. all_to_all splits/concats one axis; heads is axis 1,
     # sequence axis 2.
-    qh, kh, vh = (spmd.alltoall(x, axis, split_axis=1, concat_axis=2)
-                  for x in (q, k, v))
+    with jax.named_scope("gloo_tpu.sp.ulysses_exchange"):
+        qh, kh, vh = (spmd.alltoall(x, axis, split_axis=1, concat_axis=2)
+                      for x in (q, k, v))
     out = attn_fn(qh, kh, vh, causal)
     # (b, h/n, t_global, d) -> (b, h, t_local, d): inverse exchange.
-    return spmd.alltoall(out, axis, split_axis=2, concat_axis=1)
+    with jax.named_scope("gloo_tpu.sp.ulysses_exchange"):
+        return spmd.alltoall(out, axis, split_axis=2, concat_axis=1)

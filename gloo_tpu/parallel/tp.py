@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 
+import jax
 import jax.numpy as jnp
 
 from gloo_tpu.tpu import spmd
@@ -29,7 +30,8 @@ def row_parallel_dense(x_shard, w_shard, axis: str):
     x arriving already split (e.g. from a column-parallel layer). The psum
     is the TP allreduce on the ICI mesh."""
     partial = x_shard @ w_shard
-    return spmd.allreduce(partial, axis, "sum")
+    with jax.named_scope("gloo_tpu.tp.row_sync"):
+        return spmd.allreduce(partial, axis, "sum")
 
 
 def tp_mlp_block(x, w_up_shard, w_down_shard, axis: str, activation=None):
@@ -327,7 +329,8 @@ def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,
     partial = jnp.dot(x_shard, w_shard,
                       preferred_element_type=jnp.float32).astype(
                           x_shard.dtype)
-    return spmd.reduce_scatter(partial, axis, "sum", scatter_axis=0)
+    with jax.named_scope("gloo_tpu.tp.row_scatter"):
+        return spmd.reduce_scatter(partial, axis, "sum", scatter_axis=0)
 
 
 def allgather_matmul_dense_auto(x_rows_shard, w, axis: str,
@@ -353,7 +356,8 @@ def allgather_matmul_dense_auto(x_rows_shard, w, axis: str,
         return allgather_matmul_dense(x_rows_shard, w, axis,
                                       interpret=interpret,
                                       mesh_axes=mesh_axes)
-    x_full = spmd.allgather(x_rows_shard, axis, gather_axis=0)
+    with jax.named_scope("gloo_tpu.tp.allgather_x"):
+        x_full = spmd.allgather(x_rows_shard, axis, gather_axis=0)
     return jnp.dot(x_full, w,
                    preferred_element_type=jnp.float32).astype(
                        x_rows_shard.dtype)

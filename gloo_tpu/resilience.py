@@ -21,24 +21,79 @@ just triggers another round with generation + 1.
 
 from __future__ import annotations
 
+import json
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import gloo_tpu
+
+
+def _stall_evidence(failed_context) -> Optional[dict]:
+    """Extract the watchdog's verdict from a poisoned context's metrics
+    snapshot: which peer/slot this rank was blocked on, and how stale
+    that link's progress was. Returns None when the watchdog never
+    fired (or metrics are unavailable)."""
+    try:
+        snap = failed_context.metrics()
+    except Exception:  # noqa: BLE001 - a dead context must not block rebuild
+        return None
+    last = snap.get("watchdog", {}).get("last")
+    if not last:
+        return None
+    evidence = {"suspect": last.get("peer", -1), "op": last.get("op"),
+                "slot": last.get("slot"), "waited_ms":
+                last.get("waited_us", 0) // 1000}
+    peer = last.get("peer", -1)
+    transport = snap.get("transport", {})
+    if peer in transport:
+        evidence["peer_progress_age_ms"] = (
+            transport[peer].get("last_progress_age_us", -1) // 1000)
+    return evidence
+
+
+def stall_reports(store: "gloo_tpu.Store", generation: int,
+                  old_size: int) -> Dict[int, dict]:
+    """Read every survivor's published stall evidence for `generation`
+    (written by rebuild_after_failure when failed_context is passed).
+    The modal `suspect` across reports is the rank to blame — recovery
+    tooling can exclude it from re-admission or page its host."""
+    gen = gloo_tpu.PrefixStore(store, f"rebuild/{generation}")
+    reports = {}
+    for r in range(old_size):
+        try:
+            raw = gen.get(f"stall/{r}", timeout=0.001)
+        except gloo_tpu.Error:
+            continue
+        try:
+            reports[r] = json.loads(raw.decode())
+        except ValueError:
+            continue
+    return reports
 
 
 def rebuild_after_failure(store: "gloo_tpu.Store", device: "gloo_tpu.Device",
                           old_rank: int, old_size: int, generation: int,
                           settle: float = 1.0, timeout: float = 30.0,
-                          min_size: int = 2
+                          min_size: int = 2, failed_context=None
                           ) -> Tuple[Optional["gloo_tpu.Context"], int, int]:
     """Form a new group from whoever shows up.
 
     Returns (context, new_rank, new_size); context is None when fewer than
     `min_size` survivors remain (caller decides whether to continue solo).
     `generation` must increase on every rebuild attempt (start at 1).
+
+    Pass the poisoned context as `failed_context` to feed the straggler
+    watchdog's evidence into recovery: this rank's last-stall record
+    (which peer/slot it was blocked on, per docs/observability.md) is
+    published under the generation namespace so survivors — and the
+    operator — can cite WHICH rank stalled instead of guessing. Read the
+    collected evidence with `stall_reports(store, generation, old_size)`.
     """
     gen = gloo_tpu.PrefixStore(store, f"rebuild/{generation}")
+    if failed_context is not None:
+        evidence = _stall_evidence(failed_context)
+        if evidence is not None:
+            gen.set(f"stall/{old_rank}", json.dumps(evidence).encode())
     gen.set(f"alive/{old_rank}", str(time.time()).encode())
     gen.add("count", 1)
     deadline = time.time() + timeout

@@ -8,6 +8,13 @@ schedules them).
 
 All functions take `axis`: the mesh axis name the collective runs over.
 `op` accepts "sum" | "product" | "min" | "max".
+
+Every collective runs under a `jax.named_scope("gloo_tpu.<op>")`: the
+scope lands in XLA op metadata, so a jax profiler trace of the device
+plane shows which gloo_tpu collective produced each ICI op — and lines
+up with the host plane's tracer spans and metrics (same op names) in one
+Perfetto investigation (docs/observability.md). Named scopes cost
+nothing at runtime; they only annotate the HLO.
 """
 
 from __future__ import annotations
@@ -31,53 +38,60 @@ def size(axis: Axis) -> int:
 
 
 def allreduce(x, axis: Axis, op: str = "sum"):
-    if op == "sum":
-        return lax.psum(x, axis)
-    if op == "max":
-        return lax.pmax(x, axis)
-    if op == "min":
-        return lax.pmin(x, axis)
-    if op in ("product", "prod"):
-        # No pprod primitive: gather and reduce locally. XLA turns the
-        # all_gather + reduce into an efficient fused loop.
-        return jnp.prod(lax.all_gather(x, axis), axis=0)
+    with jax.named_scope("gloo_tpu.allreduce"):
+        if op == "sum":
+            return lax.psum(x, axis)
+        if op == "max":
+            return lax.pmax(x, axis)
+        if op == "min":
+            return lax.pmin(x, axis)
+        if op in ("product", "prod"):
+            # No pprod primitive: gather and reduce locally. XLA turns the
+            # all_gather + reduce into an efficient fused loop.
+            return jnp.prod(lax.all_gather(x, axis), axis=0)
     raise ValueError(f"unknown op: {op}")
 
 
 def mean(x, axis: Axis):
-    return lax.pmean(x, axis)
+    with jax.named_scope("gloo_tpu.allreduce"):
+        return lax.pmean(x, axis)
 
 
 def reduce_scatter(x, axis: Axis, op: str = "sum", scatter_axis: int = 0):
     """Reduce across `axis` and leave each shard with its 1/P slice."""
-    if op != "sum":
-        # psum_scatter is sum-only; emulate others via allreduce + slice.
-        full = allreduce(x, axis, op)
-        p = size(axis)
-        idx = rank(axis)
-        chunk = x.shape[scatter_axis] // p
-        return lax.dynamic_slice_in_dim(full, idx * chunk, chunk,
-                                        axis=scatter_axis)
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
-                            tiled=True)
+    with jax.named_scope("gloo_tpu.reduce_scatter"):
+        if op != "sum":
+            # psum_scatter is sum-only; emulate others via allreduce +
+            # slice.
+            full = allreduce(x, axis, op)
+            p = size(axis)
+            idx = rank(axis)
+            chunk = x.shape[scatter_axis] // p
+            return lax.dynamic_slice_in_dim(full, idx * chunk, chunk,
+                                            axis=scatter_axis)
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                tiled=True)
 
 
 def allgather(x, axis: Axis, gather_axis: int = 0, tiled: bool = True):
     """Concatenate every shard's x along `gather_axis`."""
-    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+    with jax.named_scope("gloo_tpu.allgather"):
+        return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 
 def alltoall(x, axis: Axis, split_axis: int = 0, concat_axis: int = 0):
     """Scatter `split_axis` across the group and gather along `concat_axis`."""
-    return lax.all_to_all(x, axis, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    with jax.named_scope("gloo_tpu.alltoall"):
+        return lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, axis: Axis, root: int = 0):
     """Every shard receives the root shard's value."""
-    idx = rank(axis)
-    zeros = jnp.zeros_like(x)
-    return lax.psum(jnp.where(idx == root, x, zeros), axis)
+    with jax.named_scope("gloo_tpu.broadcast"):
+        idx = rank(axis)
+        zeros = jnp.zeros_like(x)
+        return lax.psum(jnp.where(idx == root, x, zeros), axis)
 
 
 def reduce(x, axis: Axis, root: int = 0, op: str = "sum"):
@@ -100,7 +114,8 @@ def scatter(x, axis: Axis, root: int = 0, scatter_axis: int = 0):
 
 def ppermute(x, axis: Axis, perm: Sequence[tuple]):
     """Point-to-point shift: pairs of (source_rank, dest_rank)."""
-    return lax.ppermute(x, axis, perm=perm)
+    with jax.named_scope("gloo_tpu.ppermute"):
+        return lax.ppermute(x, axis, perm=perm)
 
 
 def shift(x, axis: Axis, offset: int = 1, wrap: bool = True):
@@ -112,11 +127,13 @@ def shift(x, axis: Axis, offset: int = 1, wrap: bool = True):
     else:
         perm = [(i, i + offset) for i in range(p)
                 if 0 <= i + offset < p]
-    return lax.ppermute(x, axis, perm=perm)
+    with jax.named_scope("gloo_tpu.ppermute"):
+        return lax.ppermute(x, axis, perm=perm)
 
 
 def barrier(axis: Axis):
     """Synchronization point: returns a token-like scalar whose value
     depends on every participant (XLA cannot elide or reorder it past uses
     that consume the result)."""
-    return lax.psum(jnp.ones((), dtype=jnp.int32), axis)
+    with jax.named_scope("gloo_tpu.barrier"):
+        return lax.psum(jnp.ones((), dtype=jnp.int32), axis)

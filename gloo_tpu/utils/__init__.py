@@ -1,3 +1,13 @@
-from gloo_tpu.utils.tracing import device_trace, merge_traces
+from gloo_tpu.utils.metrics import (histogram_quantile, merge_snapshots,
+                                    summarize_ops, to_prometheus)
+from gloo_tpu.utils.tracing import annotate, device_trace, merge_traces
 
-__all__ = ["device_trace", "merge_traces"]
+__all__ = [
+    "annotate",
+    "device_trace",
+    "histogram_quantile",
+    "merge_snapshots",
+    "merge_traces",
+    "summarize_ops",
+    "to_prometheus",
+]

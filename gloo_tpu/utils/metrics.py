@@ -1,0 +1,190 @@
+"""Metrics post-processing: Prometheus text exposition + histogram math.
+
+`Context.metrics()` returns the native registry's structured snapshot
+(see its docstring for the shape). This module turns snapshots into the
+two forms a production deployment actually consumes:
+
+- `to_prometheus(snapshot)` renders the Prometheus text exposition format
+  (serve it from a /metrics endpoint or push it through a gateway);
+- `histogram_quantile(hist, q)` estimates latency quantiles from the
+  fixed power-of-two buckets (p50/p95 for dashboards and bench output);
+- `merge_snapshots(snaps)` sums per-rank snapshots into a job-level view.
+
+The native histograms store per-bucket (non-cumulative) counts as
+[[upper_bound_us, count], ...]; Prometheus buckets are cumulative with a
+trailing +Inf, and the conversion happens here so the hot path stays a
+couple of relaxed atomic adds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+def histogram_quantile(hist: dict, q: float) -> float:
+    """Estimate the q-quantile (0 < q <= 1) in microseconds.
+
+    Uses linear interpolation within the containing power-of-two bucket
+    ([upper/2, upper]); the true value is within 2x, which is what
+    log-bucketed histograms buy. Returns 0.0 for an empty histogram.
+    """
+    total = hist.get("count", 0)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for upper, n in hist.get("buckets", []):
+        if cum + n >= target:
+            lower = upper / 2 if upper > 1 else 0
+            frac = (target - cum) / n
+            return lower + frac * (upper - lower)
+        cum += n
+    return float(hist.get("max_us", 0))
+
+
+def summarize_ops(snapshot: dict) -> Dict[str, dict]:
+    """Per-op {calls, bytes, errors, p50_us, p95_us, mean_us} digest —
+    the compact form bench.py embeds in its JSON line."""
+    out = {}
+    for name, s in snapshot.get("ops", {}).items():
+        hist = s.get("latency_us", {})
+        count = hist.get("count", 0)
+        out[name] = {
+            "calls": s.get("calls", 0),
+            "bytes": s.get("bytes", 0),
+            "errors": s.get("errors", 0),
+            "p50_us": round(histogram_quantile(hist, 0.50), 1),
+            "p95_us": round(histogram_quantile(hist, 0.95), 1),
+            "mean_us": round(hist.get("sum_us", 0) / count, 1)
+            if count else 0.0,
+        }
+    return out
+
+
+def _fmt_labels(labels: Dict[str, object]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _emit_histogram(lines: List[str], name: str, hist: dict,
+                    labels: Dict[str, object]) -> None:
+    cum = 0
+    for upper, n in hist.get("buckets", []):
+        cum += n
+        lines.append(f"{name}_bucket"
+                     f"{_fmt_labels({**labels, 'le': upper})} {cum}")
+    lines.append(f"{name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} "
+                 f"{hist.get('count', 0)}")
+    lines.append(f"{name}_sum{_fmt_labels(labels)} "
+                 f"{hist.get('sum_us', 0)}")
+    lines.append(f"{name}_count{_fmt_labels(labels)} "
+                 f"{hist.get('count', 0)}")
+
+
+def to_prometheus(snapshot: dict,
+                  extra_labels: Optional[Dict[str, object]] = None) -> str:
+    """Render one rank's snapshot in the Prometheus text exposition
+    format (version 0.0.4). Latency units stay microseconds — the metric
+    names say so explicitly rather than silently converting."""
+    base = dict(extra_labels or {})
+    base["rank"] = snapshot.get("rank", 0)
+    lines: List[str] = []
+
+    lines.append("# TYPE gloo_tpu_collective_calls_total counter")
+    lines.append("# TYPE gloo_tpu_collective_bytes_total counter")
+    lines.append("# TYPE gloo_tpu_collective_errors_total counter")
+    lines.append("# TYPE gloo_tpu_collective_latency_us histogram")
+    for op, s in sorted(snapshot.get("ops", {}).items()):
+        labels = {**base, "op": op}
+        lines.append(f"gloo_tpu_collective_calls_total"
+                     f"{_fmt_labels(labels)} {s.get('calls', 0)}")
+        lines.append(f"gloo_tpu_collective_bytes_total"
+                     f"{_fmt_labels(labels)} {s.get('bytes', 0)}")
+        lines.append(f"gloo_tpu_collective_errors_total"
+                     f"{_fmt_labels(labels)} {s.get('errors', 0)}")
+        _emit_histogram(lines, "gloo_tpu_collective_latency_us",
+                        s.get("latency_us", {}), labels)
+
+    lines.append("# TYPE gloo_tpu_transport_sent_msgs_total counter")
+    lines.append("# TYPE gloo_tpu_transport_sent_bytes_total counter")
+    lines.append("# TYPE gloo_tpu_transport_recv_msgs_total counter")
+    lines.append("# TYPE gloo_tpu_transport_recv_bytes_total counter")
+    lines.append("# TYPE gloo_tpu_transport_last_progress_age_us gauge")
+    lines.append("# TYPE gloo_tpu_transport_recv_wait_us histogram")
+    for peer, s in sorted(snapshot.get("transport", {}).items()):
+        labels = {**base, "peer": peer}
+        for field, metric in (("sent_msgs", "sent_msgs_total"),
+                              ("sent_bytes", "sent_bytes_total"),
+                              ("recv_msgs", "recv_msgs_total"),
+                              ("recv_bytes", "recv_bytes_total"),
+                              ("last_progress_age_us",
+                               "last_progress_age_us")):
+            lines.append(f"gloo_tpu_transport_{metric}"
+                         f"{_fmt_labels(labels)} {s.get(field, 0)}")
+        _emit_histogram(lines, "gloo_tpu_transport_recv_wait_us",
+                        s.get("recv_wait_us", {}), labels)
+
+    lines.append("# TYPE gloo_tpu_connect_retries_total counter")
+    lines.append(f"gloo_tpu_connect_retries_total{_fmt_labels(base)} "
+                 f"{snapshot.get('retries', 0)}")
+    wd = snapshot.get("watchdog", {})
+    lines.append("# TYPE gloo_tpu_watchdog_stalls_total counter")
+    lines.append(f"gloo_tpu_watchdog_stalls_total{_fmt_labels(base)} "
+                 f"{wd.get('stalls', 0)}")
+    last = wd.get("last")
+    if last:
+        lines.append("# TYPE gloo_tpu_watchdog_last_stall_waited_us gauge")
+        labels = {**base, "op": last.get("op", ""),
+                  "peer": last.get("peer", -1)}
+        lines.append(f"gloo_tpu_watchdog_last_stall_waited_us"
+                     f"{_fmt_labels(labels)} {last.get('waited_us', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def _merge_hist(acc: dict, hist: dict) -> dict:
+    if not acc:
+        return {k: (list(map(list, v)) if k == "buckets" else v)
+                for k, v in hist.items()}
+    by_le = {le: n for le, n in acc.get("buckets", [])}
+    for le, n in hist.get("buckets", []):
+        by_le[le] = by_le.get(le, 0) + n
+    acc["buckets"] = sorted([le, n] for le, n in by_le.items())
+    acc["count"] = acc.get("count", 0) + hist.get("count", 0)
+    acc["sum_us"] = acc.get("sum_us", 0) + hist.get("sum_us", 0)
+    acc["max_us"] = max(acc.get("max_us", 0), hist.get("max_us", 0))
+    return acc
+
+
+def merge_snapshots(snapshots: Iterable[dict]) -> dict:
+    """Sum per-rank snapshots into one job-level view: op counters and
+    histograms add; transport keeps the per-(rank, peer) detail keyed as
+    "rank->peer"; watchdog stalls add and the most recent stall wins."""
+    merged: dict = {"ranks": [], "ops": {}, "transport": {},
+                    "watchdog": {"stalls": 0, "last": None}}
+    for snap in snapshots:
+        merged["ranks"].append(snap.get("rank"))
+        for op, s in snap.get("ops", {}).items():
+            acc = merged["ops"].setdefault(
+                op, {"calls": 0, "bytes": 0, "errors": 0,
+                     "latency_us": {}})
+            acc["calls"] += s.get("calls", 0)
+            acc["bytes"] += s.get("bytes", 0)
+            acc["errors"] += s.get("errors", 0)
+            acc["latency_us"] = _merge_hist(acc["latency_us"],
+                                            s.get("latency_us", {}))
+        for peer, s in snap.get("transport", {}).items():
+            merged["transport"][f"{snap.get('rank')}->{peer}"] = s
+        wd = snap.get("watchdog", {})
+        merged["watchdog"]["stalls"] += wd.get("stalls", 0)
+        last = wd.get("last")
+        prev = merged["watchdog"]["last"]
+        # Recency across ranks compares age_us (relative to each rank's
+        # own snapshot instant), NOT at_us: steady-clock epochs are
+        # per-host boot times and never comparable across machines.
+        if last and (prev is None
+                     or last.get("age_us", 0) < prev.get("age_us", 0)):
+            merged["watchdog"]["last"] = dict(last,
+                                              rank=snap.get("rank"))
+    return merged

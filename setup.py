@@ -22,15 +22,14 @@ class BuildPyWithNative(build_py):
     def run(self):
         super().run()
         lib = os.path.join(ROOT, "gloo_tpu", "_native", "libtpucoll.so")
-        # Always (re)build: cmake's dependency tracking makes this a no-op
-        # when up to date, and gating on os.path.exists(lib) would silently
+        # Always (re)build: dependency tracking makes this a no-op when
+        # up to date, and gating on os.path.exists(lib) would silently
         # package a stale binary after csrc/ edits. One build recipe: the
         # Makefile's `native` target (same one _lib.py's in-checkout
-        # auto-build uses); direct cmake only where make is absent.
-        if shutil.which("make") and shutil.which("ninja"):
-            # The Makefile's native target hardcodes -G Ninja; without
-            # ninja fall through to the cmake branch, which drops the
-            # generator flag.
+        # auto-build uses), which prefers cmake+ninja and falls back to a
+        # plain compiler-driver build on minimal images; direct cmake
+        # only where make itself is absent.
+        if shutil.which("make"):
             subprocess.run(["make", "native"], cwd=ROOT, check=True)
         else:
             build_dir = os.path.join(ROOT, "build")

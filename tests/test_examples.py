@@ -43,3 +43,10 @@ def test_example_device_plane():
 def test_example_fsdp_long_context():
     out = _run("example_fsdp_long_context.py")
     assert "fsdp + long-context example OK" in out
+
+
+def test_example_observability():
+    out = _run("example_observability.py", timeout=180)
+    assert "observability example OK" in out
+    assert "[watchdog] rank0 was blocked" in out
+    assert "labeled rank rows" in out

@@ -1,0 +1,269 @@
+"""Metrics registry: counters, histograms, drain, text exposition, and
+the straggler watchdog (tentpole of the observability subsystem —
+docs/observability.md)."""
+
+import time
+
+import numpy as np
+
+from gloo_tpu.utils.metrics import (histogram_quantile, merge_snapshots,
+                                    summarize_ops, to_prometheus)
+from tests.harness import spawn
+
+
+def test_collective_counters_and_bytes():
+    def fn(ctx, rank):
+        x = np.ones(1000, dtype=np.float32)
+        ctx.allreduce(x)
+        ctx.allreduce(x)
+        ctx.allreduce(x)
+        ctx.broadcast(x, root=0)
+        ctx.barrier()
+        return ctx.metrics()
+
+    for rank, snap in enumerate(spawn(2, fn)):
+        assert snap["rank"] == rank
+        assert snap["size"] == 2
+        assert snap["enabled"] is True
+        ops = snap["ops"]
+        # Exact call and byte accounting (1000 float32 = 4000 bytes/call).
+        assert ops["allreduce"]["calls"] == 3
+        assert ops["allreduce"]["bytes"] == 12000
+        assert ops["allreduce"]["errors"] == 0
+        assert ops["broadcast"]["calls"] == 1
+        assert ops["broadcast"]["bytes"] == 4000
+        assert ops["barrier"]["calls"] == 1
+        # The bootstrap is accounted as its own op.
+        assert ops["connect"]["calls"] == 1
+        # Nonzero latency histogram with consistent totals.
+        hist = ops["allreduce"]["latency_us"]
+        assert hist["count"] == 3
+        assert sum(n for _, n in hist["buckets"]) == 3
+        assert hist["sum_us"] >= 0
+        assert hist["max_us"] <= 2 * hist["sum_us"] + 1
+        # Transport counters: the peer moved bytes both ways, and its
+        # last-progress timestamp is recent.
+        peer = 1 - rank
+        t = snap["transport"][peer]
+        assert t["sent_bytes"] > 0
+        assert t["recv_bytes"] > 0
+        assert t["sent_msgs"] > 0
+        assert 0 <= t["last_progress_age_us"] < 60_000_000
+        assert t["recv_wait_us"]["count"] > 0
+
+
+def test_delegating_ops_keep_their_own_names():
+    """gather/allgather/alltoall share schedules with their *v forms but
+    must be attributed under their own names (dashboards watch
+    op="allgather"; it must not read zero forever)."""
+
+    def fn(ctx, rank):
+        x = np.ones(8, dtype=np.float32)
+        ctx.gather(x, root=0)
+        ctx.allgather(x)
+        # 3 ranks, block above the Bruck crossover: the pairwise
+        # (delegated) path must still count as alltoall.
+        big = np.ones((3, 1024), dtype=np.float32)
+        ctx.alltoall(big)
+        return ctx.metrics()
+
+    snap = spawn(3, fn)[0]
+    ops = snap["ops"]
+    assert ops["gather"]["calls"] == 1 and ops["gather"]["bytes"] == 32
+    assert ops["allgather"]["calls"] == 1
+    assert ops["allgather"]["bytes"] == 32
+    assert ops["alltoall"]["calls"] == 1
+    assert ops["alltoall"]["bytes"] == 3 * 4096
+    for delegated in ("gatherv", "allgatherv", "alltoallv"):
+        assert delegated not in ops, delegated
+
+
+def test_p2p_send_recv_counters():
+    def fn(ctx, rank):
+        x = np.arange(64, dtype=np.float32)
+        if rank == 0:
+            ctx.send(x, 1, slot=3)
+        else:
+            ctx.recv(x, 0, slot=3)
+        return ctx.metrics()
+
+    snaps = spawn(2, fn)
+    assert snaps[0]["ops"]["send"]["calls"] == 1
+    assert snaps[0]["ops"]["send"]["bytes"] == 256
+    assert snaps[0]["ops"]["send"]["latency_us"]["count"] == 1
+    assert snaps[1]["ops"]["recv"]["calls"] == 1
+    assert snaps[1]["ops"]["recv"]["bytes"] == 256
+    assert snaps[1]["ops"]["recv"]["latency_us"]["count"] == 1
+
+
+def test_drain_semantics():
+    def fn(ctx, rank):
+        x = np.ones(16, dtype=np.float32)
+        ctx.allreduce(x)
+        first = ctx.metrics(drain=True)
+        second = ctx.metrics()
+        ctx.allreduce(x)
+        third = ctx.metrics()
+        return first, second, third
+
+    first, second, third = spawn(2, fn)[0]
+    assert first["ops"]["allreduce"]["calls"] == 1
+    # Drained: counters reset (the op disappears from the snapshot)...
+    assert "allreduce" not in second["ops"]
+    assert second["watchdog"]["stalls"] == 0
+    # ...but counting continues from zero afterwards.
+    assert third["ops"]["allreduce"]["calls"] == 1
+    assert third["ops"]["allreduce"]["bytes"] == 64
+
+
+def test_disable_stops_counting():
+    def fn(ctx, rank):
+        x = np.ones(16, dtype=np.float32)
+        ctx.metrics_enable(False)
+        assert not ctx.metrics_enabled()
+        ctx.allreduce(x)
+        snap = ctx.metrics()
+        ctx.metrics_enable(True)
+        ctx.allreduce(x)
+        return snap, ctx.metrics()
+
+    disabled, enabled = spawn(2, fn)[0]
+    assert disabled["enabled"] is False
+    assert "allreduce" not in disabled["ops"]
+    assert enabled["ops"]["allreduce"]["calls"] == 1
+
+
+def test_prometheus_exposition():
+    def fn(ctx, rank):
+        x = np.ones(100, dtype=np.float32)
+        ctx.allreduce(x)
+        return ctx.metrics()
+
+    snap = spawn(2, fn)[0]
+    text = to_prometheus(snap, extra_labels={"job": "t1"})
+    lines = text.splitlines()
+    assert ('gloo_tpu_collective_calls_total'
+            '{job="t1",op="allreduce",rank="0"} 1') in lines
+    assert ('gloo_tpu_collective_bytes_total'
+            '{job="t1",op="allreduce",rank="0"} 400') in lines
+    assert "# TYPE gloo_tpu_collective_latency_us histogram" in lines
+    # Histogram buckets are cumulative and end with +Inf == count.
+    hist = snap["ops"]["allreduce"]["latency_us"]
+    inf_line = [ln for ln in lines
+                if ln.startswith("gloo_tpu_collective_latency_us_bucket")
+                and 'op="allreduce"' in ln and 'le="+Inf"' in ln]
+    assert inf_line and inf_line[0].endswith(f" {hist['count']}")
+    bucket_vals = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                   if ln.startswith(
+                       "gloo_tpu_collective_latency_us_bucket")
+                   and 'op="allreduce"' in ln]
+    assert bucket_vals == sorted(bucket_vals)  # cumulative
+    assert "gloo_tpu_watchdog_stalls_total" in text
+    assert "gloo_tpu_transport_sent_bytes_total" in text
+
+
+def test_watchdog_identifies_stalled_peer():
+    def fn(ctx, rank):
+        ctx.set_watchdog(0.05)
+        x = np.zeros(8, dtype=np.float32)
+        if rank == 0:
+            # Blocked on rank 1, which sits on its hands well past the
+            # watchdog threshold before sending.
+            ctx.recv(x, 1, slot=9, timeout=10)
+            return ctx.metrics()
+        time.sleep(0.35)
+        ctx.send(x, 0, slot=9)
+        return None
+
+    snap = spawn(2, fn)[0]
+    wd = snap["watchdog"]
+    assert wd["stalls"] >= 1
+    last = wd["last"]
+    assert last["op"] == "recv"
+    assert last["peer"] == 1  # the culprit is named
+    assert last["slot"] == 9
+    assert last["waited_us"] >= 50_000
+    # The wait eventually completed: no error was recorded.
+    assert snap["ops"]["recv"]["errors"] == 0
+
+
+def test_watchdog_disarmed_by_default():
+    def fn(ctx, rank):
+        x = np.zeros(4, dtype=np.float32)
+        if rank == 0:
+            ctx.recv(x, 1, slot=2, timeout=10)
+            return ctx.metrics()
+        time.sleep(0.15)
+        ctx.send(x, 0, slot=2)
+        return None
+
+    snap = spawn(2, fn)[0]
+    assert snap["watchdog"]["stalls"] == 0
+    assert snap["watchdog"]["last"] is None
+
+
+def test_histogram_quantile_and_summary():
+    hist = {"count": 100, "sum_us": 0, "max_us": 4096,
+            "buckets": [[64, 50], [128, 40], [4096, 10]]}
+    p50 = histogram_quantile(hist, 0.50)
+    assert 32 <= p50 <= 64
+    p95 = histogram_quantile(hist, 0.95)
+    assert 2048 <= p95 <= 4096
+    assert histogram_quantile({"count": 0, "buckets": []}, 0.5) == 0.0
+
+    snap = {"ops": {"allreduce": {"calls": 100, "bytes": 5, "errors": 0,
+                                  "latency_us": hist}}}
+    digest = summarize_ops(snap)["allreduce"]
+    assert digest["calls"] == 100
+    assert digest["p50_us"] == round(p50, 1)
+
+
+def test_rebuild_publishes_stall_evidence():
+    """resilience.rebuild_after_failure(failed_context=...) publishes the
+    watchdog's verdict so recovery can cite WHICH rank stalled."""
+    import gloo_tpu
+    from gloo_tpu.resilience import rebuild_after_failure, stall_reports
+
+    shared = gloo_tpu.HashStore()
+
+    def fn(ctx, rank):
+        ctx.set_watchdog(0.05)
+        x = np.zeros(4, dtype=np.float32)
+        if rank == 0:
+            ctx.recv(x, 1, slot=11, timeout=10)  # watchdog fires here
+        else:
+            time.sleep(0.3)
+            ctx.send(x, 0, slot=11)
+        # Pretend the group then failed: both ranks re-rendezvous,
+        # feeding the old context's evidence into the new generation.
+        new_ctx, new_rank, new_size = rebuild_after_failure(
+            shared, gloo_tpu.Device(), old_rank=rank, old_size=2,
+            generation=1, settle=0.3, timeout=30.0,
+            failed_context=ctx)
+        assert new_size == 2 and new_rank == rank
+        y = np.ones(4, dtype=np.float32)
+        new_ctx.allreduce(y)
+        new_ctx.close()
+        return float(y[0])
+
+    assert spawn(2, fn, timeout=60) == [2.0, 2.0]
+    reports = stall_reports(shared, generation=1, old_size=2)
+    # Rank 0 stalled on rank 1 and said so; rank 1 never stalled.
+    assert list(reports) == [0]
+    assert reports[0]["suspect"] == 1
+    assert reports[0]["op"] == "recv"
+    assert reports[0]["waited_ms"] >= 50
+
+
+def test_merge_snapshots():
+    def fn(ctx, rank):
+        x = np.ones(10, dtype=np.float32)
+        ctx.allreduce(x)
+        return ctx.metrics()
+
+    merged = merge_snapshots(spawn(2, fn))
+    assert sorted(merged["ranks"]) == [0, 1]
+    assert merged["ops"]["allreduce"]["calls"] == 2
+    assert merged["ops"]["allreduce"]["bytes"] == 80
+    assert merged["ops"]["allreduce"]["latency_us"]["count"] == 2
+    assert "0->1" in merged["transport"] and "1->0" in merged["transport"]

@@ -49,6 +49,30 @@ def test_trace_drains():
     assert second == []
 
 
+def test_p2p_wait_spans_recorded():
+    """The p2p path records spans too, not just collectives: wait_send on
+    the sender, wait_recv (with the resolved source peer) on the
+    receiver."""
+
+    def fn(ctx, rank):
+        ctx.trace_start()
+        x = np.arange(16, dtype=np.float32)
+        if rank == 0:
+            ctx.send(x, 1, slot=5)
+        else:
+            ctx.recv(x, 0, slot=5)
+        ctx.trace_stop()
+        return ctx.trace_json()
+
+    docs = spawn(2, fn)
+    sender = json.loads(docs[0])
+    assert [e["name"] for e in sender] == ["wait_send"]
+    assert sender[0]["args"]["bytes"] == 64  # registered buffer size
+    receiver = json.loads(docs[1])
+    assert [e["name"] for e in receiver] == ["wait_recv"]
+    assert receiver[0]["args"]["peer"] == 0  # resolved source rank
+
+
 def test_merge_traces():
     def fn(ctx, rank):
         ctx.trace_start()
@@ -57,5 +81,21 @@ def test_merge_traces():
 
     docs = spawn(2, fn)
     merged = json.loads(merge_traces(docs))
-    assert len(merged) == 2
-    assert sorted(e["pid"] for e in merged) == [0, 1]
+    meta = [e for e in merged if e["ph"] == "M"]
+    data = [e for e in merged if e["ph"] != "M"]
+    assert len(data) == 2
+    assert sorted(e["pid"] for e in data) == [0, 1]
+    # Per-rank labeled rows: process_name + process_sort_index metadata
+    # for every pid, so Perfetto renders "rank N" lanes.
+    assert {(e["name"], e["pid"]) for e in meta} == {
+        ("process_name", 0), ("process_name", 1),
+        ("process_sort_index", 0), ("process_sort_index", 1)}
+    name_meta = {e["pid"]: e["args"]["name"] for e in meta
+                 if e["name"] == "process_name"}
+    assert name_meta == {0: "rank 0", 1: "rank 1"}
+    # Data events come out globally time-ordered.
+    ts = [e["ts"] for e in data]
+    assert ts == sorted(ts)
+    # Metadata survives a re-merge without duplicating.
+    again = json.loads(merge_traces([json.dumps(merged)]))
+    assert len([e for e in again if e["ph"] == "M"]) == len(meta)
