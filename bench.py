@@ -112,7 +112,128 @@ def bench_reference():
     return None
 
 
+def bench_autotune(quick=False, out_path=None):
+    """--autotune: run the tuner sweep on a 2-rank group, persist the
+    elected table, and measure what the table buys: for every swept
+    allreduce size, p50 with the tuned table installed vs the default
+    (untuned) kAuto thresholds vs each fixed arm (ring, halving-
+    doubling). Prints ONE JSON line:
+
+      {"metric": "allreduce_autotune_2rank_host",
+       "value": <geomean over sizes of default_us / tuned_us>,
+       "unit": "x_speedup_vs_default_auto",
+       "ranks_agree": <all ranks installed byte-identical tables>,
+       "table": <path the table was saved to>,
+       "cells": [{"bytes", "tuned_us", "default_us", "ring_us", "hd_us",
+                  "tuned_vs_best_fixed"}, ...]}
+
+    tuned_vs_best_fixed is the acceptance signal: ~<= 1 plus noise means
+    the tuned dispatch never loses to the better fixed arm at any swept
+    size (the hardcoded threshold CAN lose — that is the point).
+    """
+    import math
+
+    import numpy as np
+
+    import gloo_tpu
+    from gloo_tpu import tuning
+
+    if out_path is None:
+        out_path = "/tmp/tuning_table.json"
+    # Quick mode (CI smoke): tiny sizes, few iterations.
+    min_bytes = 4 << 10
+    max_bytes = (64 << 10) if quick else (4 << 20)
+    tune_iters, tune_warmup = (3, 1) if quick else (8, 2)
+    time_iters = 10 if quick else 30
+
+    store = gloo_tpu.HashStore()
+    rank_tables = [None, None]
+    cells_out = [None]
+
+    def time_allreduce(ctx, x, iters, **kw):
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, **kw)
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times)) * 1e6
+
+    def worker(rank):
+        device = gloo_tpu.Device()
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(store, device)
+        table = tuning.tune(ctx, min_bytes=min_bytes, max_bytes=max_bytes,
+                            iters=tune_iters, warmup=tune_warmup)
+        rank_tables[rank] = json.dumps(table, sort_keys=True)
+        if rank == 0:
+            tuning.save_table(table, out_path)
+
+        # Measured-vs-default sweep. Both ranks run the identical
+        # sequence (install/clear are dispatch-relevant state and must
+        # flip at the same sequence points on every rank); rank 0's
+        # timings are reported.
+        cells = []
+        nbytes = min_bytes
+        while nbytes <= max_bytes:
+            x = np.zeros(nbytes // 4, dtype=np.float32)
+            tuned = time_allreduce(ctx, x, time_iters)  # table installed
+            ring = time_allreduce(ctx, x, time_iters, algorithm="ring")
+            hd = time_allreduce(ctx, x, time_iters,
+                                algorithm="halving_doubling")
+            tuning.clear_table(ctx)
+            default = time_allreduce(ctx, x, time_iters)  # stock kAuto
+            tuning.install_table(ctx, table)
+            cells.append({
+                "bytes": nbytes,
+                "tuned_us": round(tuned, 1),
+                "default_us": round(default, 1),
+                "ring_us": round(ring, 1),
+                "hd_us": round(hd, 1),
+                "tuned_vs_best_fixed": round(tuned / min(ring, hd), 3),
+            })
+            nbytes *= 2
+        if rank == 0:
+            cells_out[0] = cells
+        ctx.barrier()
+        ctx.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(1200)
+    assert all(t is not None for t in rank_tables), "a rank failed to tune"
+    cells = cells_out[0]
+    assert cells, "no measurement cells"
+    speedup = math.exp(
+        sum(math.log(c["default_us"] / c["tuned_us"]) for c in cells)
+        / len(cells))
+    for c in cells:
+        print(f"[autotune] {c['bytes'] >> 10}KiB tuned {c['tuned_us']:.0f}us"
+              f" default {c['default_us']:.0f}us ring {c['ring_us']:.0f}us"
+              f" hd {c['hd_us']:.0f}us", file=sys.stderr)
+    line = {
+        "metric": "allreduce_autotune_2rank_host",
+        "value": round(speedup, 3),
+        "unit": "x_speedup_vs_default_auto",
+        "ranks_agree": rank_tables[0] == rank_tables[1],
+        "table": out_path,
+        "cells": cells,
+    }
+    print(json.dumps(line))
+
+
 def main():
+    if "--autotune" in sys.argv[1:]:
+        out = None
+        if "--autotune-out" in sys.argv[1:]:
+            i = sys.argv.index("--autotune-out") + 1
+            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+                sys.exit("--autotune-out requires a path argument")
+            out = sys.argv[i]
+        bench_autotune(quick="--autotune-quick" in sys.argv[1:],
+                       out_path=out)
+        return
     # Median-of-3 full measurements: this host's run-to-run spread is
     # documented at +/-15% (BASELINE.md), so a single draw is not
     # evidence. `spread` = (max - min) / median of the three runs —

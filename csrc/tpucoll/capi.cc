@@ -22,6 +22,8 @@
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/rendezvous/tcp_store.h"
 #include "tpucoll/transport/device.h"
+#include "tpucoll/tuning/tuner.h"
+#include "tpucoll/tuning/tuning_table.h"
 
 namespace {
 
@@ -357,6 +359,67 @@ int tc_metrics_json(void* ctx, int drain, uint8_t** out, size_t* outLen) {
       throw std::bad_alloc();
     }
     std::memcpy(*out, json.data(), json.size());
+  });
+}
+
+// ---- collective autotuning plane (tuning/) ----
+
+namespace {
+
+int copyOut(const std::string& s, uint8_t** out, size_t* outLen) {
+  *outLen = s.size();
+  *out = static_cast<uint8_t*>(malloc(s.size()));
+  if (*out == nullptr && !s.empty()) {
+    throw std::bad_alloc();
+  }
+  std::memcpy(*out, s.data(), s.size());
+  return TC_OK;
+}
+
+}  // namespace
+
+// Run the tuner sweep (a COLLECTIVE — every rank must call concurrently
+// with identical arguments), elect + publish + install rank 0's table,
+// and return the installed table's JSON (malloc'd; free with
+// tc_buf_free). See tuning/tuner.h.
+int tc_tune(void* ctx, size_t minBytes, size_t maxBytes, int iters,
+            int warmup, uint32_t tag, int64_t timeoutMs, uint8_t** out,
+            size_t* outLen) {
+  return wrap([&] {
+    tpucoll::tuning::TunerOptions opts;
+    opts.minBytes = minBytes;
+    opts.maxBytes = maxBytes;
+    opts.iters = iters;
+    opts.warmup = warmup;
+    opts.tag = tag;
+    opts.timeout = ms(timeoutMs);
+    auto table = tpucoll::tuning::tune(asContext(ctx), opts);
+    copyOut(table->toJson(), out, outLen);
+  });
+}
+
+// Install a serialized table on THIS rank only (callers own the
+// all-ranks-identical contract; tc_tune handles it automatically). NULL
+// or empty JSON clears the installed table, restoring fallback dispatch.
+int tc_tuning_install(void* ctx, const char* json) {
+  return wrap([&] {
+    if (json == nullptr || json[0] == '\0') {
+      asContext(ctx)->setTuningTable(nullptr);
+      return;
+    }
+    asContext(ctx)->setTuningTable(
+        std::make_shared<const tpucoll::tuning::TuningTable>(
+            tpucoll::tuning::TuningTable::fromJson(json)));
+  });
+}
+
+// Serialized installed table (empty string when none is installed);
+// malloc'd, free with tc_buf_free.
+int tc_tuning_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    auto table = asContext(ctx)->tuningTable();
+    copyOut(table != nullptr ? table->toJson() : std::string(), out,
+            outLen);
   });
 }
 

@@ -41,6 +41,17 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
                               std::chrono::milliseconds timeout,
                               bool fuseOk);
 
+// The two halving-doubling non-power-of-2 strategies as directly callable
+// arms (AllreduceAlgorithm::kHdFold / kHdBlocks; halvingDoublingAllreduce
+// dispatches between them). Both are valid for ANY group size — on
+// power-of-2 groups they run the identical single-block walk.
+void hdFoldAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
+                     ReduceFn fn, Slot slot,
+                     std::chrono::milliseconds timeout, bool fuseOk);
+void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
+                             size_t elsize, ReduceFn fn, Slot slot,
+                             std::chrono::milliseconds timeout, bool fuseOk);
+
 // Mixed-radix grouped-hypercube (bcube) allreduce: log-depth like
 // halving-doubling but with configurable group fan-out per step; exact
 // schedule for any P via prime factorization (reference analog:

@@ -50,8 +50,11 @@ void broadcast(BroadcastOptions& opts);
 
 enum class AllreduceAlgorithm : uint8_t {
   // Ring for bandwidth-bound payloads, halving-doubling for latency-bound
-  // ones (threshold: 1 MiB, measured), matching the reference's RING/BCUBE
-  // split (gloo/allreduce.h:38-42) with an automatic default.
+  // ones, matching the reference's RING/BCUBE split (gloo/allreduce.h:
+  // 38-42) with an automatic default. kAuto consults the context's
+  // installed tuning table first (tuning/tuning_table.h: measured
+  // per-deployment crossovers) and falls back to the compile-time
+  // thresholds below when no table is loaded.
   kAuto = 0,
   kRing = 1,
   kHalvingDoubling = 2,
@@ -67,6 +70,14 @@ enum class AllreduceAlgorithm : uint8_t {
   // first 2*(P-p2) fold into their even partners, sit out the rounds,
   // and receive the result. Crossover: TPUCOLL_ALLREDUCE_RD_MAX.
   kRecursiveDoubling = 5,
+  // The two non-power-of-2 halving-doubling sub-variants as first-class
+  // choices (kHalvingDoubling picks between them by TPUCOLL_HD_NP2 /
+  // installed tuning table): the pre/post fold, and the binary-blocks
+  // decomposition. On power-of-2 groups both degenerate to the same
+  // single-block walk. Exposed so the tuner can sweep each arm and a
+  // tuned table can elect one directly.
+  kHdFold = 6,
+  kHdBlocks = 7,
 };
 
 struct AllreduceOptions : CollectiveOptions {
@@ -93,7 +104,8 @@ enum class ReduceAlgorithm : uint8_t {
   // full-size messages through the root's link); pipelined ring
   // reduce-scatter + direct chunk gather to root for bandwidth-bound
   // ones (~2N bytes per link total, the reference's only schedule:
-  // gloo/reduce.cc:61-246). Crossover: TPUCOLL_REDUCE_BINOMIAL_MAX.
+  // gloo/reduce.cc:61-246). Crossover: the installed tuning table when
+  // present, else TPUCOLL_REDUCE_BINOMIAL_MAX.
   kAuto = 0,
   kBinomial = 1,
   kRing = 2,
@@ -177,8 +189,8 @@ enum class ReduceScatterAlgorithm : uint8_t {
   // Ring for bandwidth-bound payloads (P-1 uniform pipelined steps);
   // recursive vector halving (log2 P rounds, contract of reference
   // gloo/reduce_scatter.h) in the middle; single-round direct exchange
-  // for tiny payloads. Crossovers: TPUCOLL_RS_DIRECT_MAX,
-  // TPUCOLL_RS_HD_MAX.
+  // for tiny payloads. Crossovers: the installed tuning table when
+  // present, else TPUCOLL_RS_DIRECT_MAX / TPUCOLL_RS_HD_MAX.
   kAuto = 0,
   kRing = 1,
   kHalvingDoubling = 2,

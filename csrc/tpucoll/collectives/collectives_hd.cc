@@ -26,6 +26,7 @@
 
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -48,9 +49,11 @@ constexpr uint64_t kRedistBase = 0x5000;
 constexpr uint64_t kFoldBase = 0;
 constexpr uint64_t kUnfoldSlot = 1 << 20;
 
-void foldHalvingDoubling(Context* ctx, char* work, size_t count,
-                         size_t elsize, ReduceFn fn, Slot slot,
-                         std::chrono::milliseconds timeout, bool fuseOk) {
+}  // namespace
+
+void hdFoldAllreduce(Context* ctx, char* work, size_t count,
+                     size_t elsize, ReduceFn fn, Slot slot,
+                     std::chrono::milliseconds timeout, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -170,10 +173,10 @@ void foldHalvingDoubling(Context* ctx, char* work, size_t count,
   }
 }
 
-void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
-                                 size_t elsize, ReduceFn fn, Slot slot,
-                                 std::chrono::milliseconds timeout,
-                                 bool fuseOk) {
+void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
+                             size_t elsize, ReduceFn fn, Slot slot,
+                             std::chrono::milliseconds timeout,
+                             bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
@@ -320,8 +323,6 @@ void binaryBlocksHalvingDoubling(Context* ctx, char* work, size_t count,
     winCount *= 2;
   }
 }
-
-}  // namespace
 
 void hdReduceScatter(Context* ctx, char* work, const Blocks& blocks,
                      ReduceFn fn, size_t elsize, Slot slot,
@@ -587,16 +588,16 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
   if (pow2) {
     // Power-of-2 groups: binary-blocks degenerates to the same single-
     // block walk; route through the fold path (rem == 0, no fold step).
-    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout,
-                        fuseOk);
+    hdFoldAllreduce(ctx, work, count, elsize, fn, slot, timeout, fuseOk);
     return;
   }
   // Non-power-of-2 strategy. Loopback-measured crossover (BASELINE.md,
   // P=6): fold's fewer messages win while per-message overhead dominates;
   // binary-blocks' proportional byte work wins once payloads are large.
-  // TPUCOLL_HD_NP2=blocks|fold forces either; TPUCOLL_HD_NP2_CROSSOVER
-  // (bytes) moves the auto threshold — re-tune on real DCN, where the
-  // message-overhead regime is narrower than on a shared-core loopback.
+  // TPUCOLL_HD_NP2=blocks|fold forces either; otherwise the installed
+  // tuning table's measured hd_fold/hd_blocks curves decide when both
+  // arms were swept on this deployment, and the TPUCOLL_HD_NP2_CROSSOVER
+  // byte threshold is the untuned fallback.
   bool useBlocks;
   const char* env = std::getenv("TPUCOLL_HD_NP2");
   if (env != nullptr && std::strcmp(env, "blocks") == 0) {
@@ -607,17 +608,18 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
              std::strcmp(env, "auto") != 0) {
     TC_THROW(EnforceError, "TPUCOLL_HD_NP2 must be blocks|fold|auto, got: ",
              env);
+  } else if (auto tuned = tuning::tableHdUseBlocks(ctx, count * elsize)) {
+    useBlocks = *tuned;
   } else {
     static const size_t crossover = collectives_detail::envBytes(
         "TPUCOLL_HD_NP2_CROSSOVER", 1 << 20);
     useBlocks = count * elsize >= crossover;
   }
   if (useBlocks) {
-    binaryBlocksHalvingDoubling(ctx, work, count, elsize, fn, slot,
-                                timeout, fuseOk);
+    hdBinaryBlocksAllreduce(ctx, work, count, elsize, fn, slot, timeout,
+                            fuseOk);
   } else {
-    foldHalvingDoubling(ctx, work, count, elsize, fn, slot, timeout,
-                        fuseOk);
+    hdFoldAllreduce(ctx, work, count, elsize, fn, slot, timeout, fuseOk);
   }
 }
 

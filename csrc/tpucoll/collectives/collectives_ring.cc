@@ -13,6 +13,7 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
 
@@ -351,28 +352,29 @@ void allreduce(AllreduceOptions& opts) {
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
     AllreduceAlgorithm algo = opts.algorithm;
     if (algo == AllreduceAlgorithm::kAuto) {
-      // Crossovers measured on loopback (BASELINE.md): recursive
-      // doubling (log2 P full-vector rounds; non-power-of-2 groups take
-      // a pre/post fold) for the alpha-dominated tiny tier,
-      // halving-doubling up to ~1 MiB, the pipelined ring beyond.
-      // Re-sweep on real DCN via TPUCOLL_ALLREDUCE_RD_MAX /
+      // Measured tuning table first (tuning/dispatch.h: per-deployment
+      // crossovers elected by tuning::tune and installed identically on
+      // every rank), then the loopback-measured compile-time fallback
+      // (BASELINE.md): recursive doubling (log2 P full-vector rounds;
+      // non-power-of-2 groups take a pre/post fold) for the
+      // alpha-dominated tiny tier, halving-doubling up to ~1 MiB, the
+      // pipelined ring beyond. Re-sweep via bench.py --autotune, or move
+      // the fallback thresholds with TPUCOLL_ALLREDUCE_RD_MAX /
       // TPUCOLL_ALLREDUCE_HD_MAX (bytes).
-      static const size_t rdMax = collectives_detail::envBytes(
-          "TPUCOLL_ALLREDUCE_RD_MAX", 16u << 10);
-      static const size_t hdMax = collectives_detail::envBytes(
-          "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
-      algo = nbytes <= rdMax ? AllreduceAlgorithm::kRecursiveDoubling
-             : nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
-                               : AllreduceAlgorithm::kRing;
+      if (auto tuned = tuning::tableAllreduce(ctx, opts.dtype, nbytes)) {
+        algo = *tuned;
+      } else {
+        static const size_t rdMax = collectives_detail::envBytes(
+            "TPUCOLL_ALLREDUCE_RD_MAX", 16u << 10);
+        static const size_t hdMax = collectives_detail::envBytes(
+            "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
+        algo = nbytes <= rdMax ? AllreduceAlgorithm::kRecursiveDoubling
+               : nbytes <= hdMax ? AllreduceAlgorithm::kHalvingDoubling
+                                 : AllreduceAlgorithm::kRing;
+      }
     }
     auto traceSpan = ctx->tracer().span(
-        "allreduce", nbytes, -1,
-        algo == AllreduceAlgorithm::kRing          ? "ring"
-        : algo == AllreduceAlgorithm::kBcube       ? "bcube"
-        : algo == AllreduceAlgorithm::kRingBf16Wire ? "ring_bf16_wire"
-        : algo == AllreduceAlgorithm::kRecursiveDoubling
-            ? "recursive_doubling"
-            : "halving_doubling");
+        "allreduce", nbytes, -1, tuning::allreduceAlgorithmName(algo));
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -382,6 +384,15 @@ void allreduce(AllreduceOptions& opts) {
         algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
                                              fn, slot, timeout,
                                              opts.customFn == nullptr);
+        break;
+      case AllreduceAlgorithm::kHdFold:
+        algorithms::hdFoldAllreduce(ctx, work, opts.count, elsize, fn, slot,
+                                    timeout, opts.customFn == nullptr);
+        break;
+      case AllreduceAlgorithm::kHdBlocks:
+        algorithms::hdBinaryBlocksAllreduce(ctx, work, opts.count, elsize,
+                                            fn, slot, timeout,
+                                            opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kRecursiveDoubling:
         algorithms::recursiveDoublingAllreduce(ctx, work, opts.count,
@@ -561,23 +572,27 @@ void reduce(ReduceOptions& opts) {
   const bool fuseOk = opts.customFn == nullptr;
   ReduceAlgorithm algo = opts.algorithm;
   if (algo == ReduceAlgorithm::kAuto) {
-    // Crossover measured on loopback P=4/8 (BASELINE.md reduce-to-root
-    // table, r4 re-sweep): the binomial wins p50 through ~4 MiB (its
-    // log2(P) full-payload rounds ride the eager pipeline well on one
-    // host) but its p99 tail is 3-4x WORSE than the ring's from ~1 MiB
-    // up (full-payload rounds spike when the shared-core scheduler
-    // misaligns). The default follows the p99 crossover — tail latency
-    // is what a collective's callers stall on — and real multi-host DCN
-    // crosses earlier still (the root's in-link serializes):
-    // drop TPUCOLL_REDUCE_BINOMIAL_MAX to ~256K-1M there.
-    static const size_t binMax = collectives_detail::envBytes(
-        "TPUCOLL_REDUCE_BINOMIAL_MAX", 2u << 20);
-    algo = nbytes <= binMax ? ReduceAlgorithm::kBinomial
-                            : ReduceAlgorithm::kRing;
+    // Measured tuning table first, then the loopback-measured fallback
+    // (BASELINE.md reduce-to-root table, r4 re-sweep): the binomial wins
+    // p50 through ~4 MiB (its log2(P) full-payload rounds ride the eager
+    // pipeline well on one host) but its p99 tail is 3-4x WORSE than the
+    // ring's from ~1 MiB up (full-payload rounds spike when the
+    // shared-core scheduler misaligns). The fallback follows the p99
+    // crossover — tail latency is what a collective's callers stall on —
+    // and real multi-host DCN crosses earlier still (the root's in-link
+    // serializes): tune there, or drop TPUCOLL_REDUCE_BINOMIAL_MAX to
+    // ~256K-1M.
+    if (auto tuned = tuning::tableReduce(ctx, opts.dtype, nbytes)) {
+      algo = *tuned;
+    } else {
+      static const size_t binMax = collectives_detail::envBytes(
+          "TPUCOLL_REDUCE_BINOMIAL_MAX", 2u << 20);
+      algo = nbytes <= binMax ? ReduceAlgorithm::kBinomial
+                              : ReduceAlgorithm::kRing;
+    }
   }
   auto traceSpan = ctx->tracer().span(
-      "reduce", nbytes, -1,
-      algo == ReduceAlgorithm::kRing ? "ring" : "binomial");
+      "reduce", nbytes, -1, tuning::reduceAlgorithmName(algo));
   switch (algo) {
     case ReduceAlgorithm::kBinomial:
       binomialReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
@@ -624,21 +639,27 @@ void reduceScatter(ReduceScatterOptions& opts) {
   const bool fuseOk = opts.customFn == nullptr;
   ReduceScatterAlgorithm algo = opts.algorithm;
   if (algo == ReduceScatterAlgorithm::kAuto) {
-    // Crossovers measured on loopback P=4/8 (BASELINE.md round 3):
+    // Measured tuning table first (keyed by total payload bytes), then
+    // the crossovers measured on loopback P=4/8 (BASELINE.md round 3):
     // recursive halving wins through ~256K, the ring beyond. The
     // single-round direct exchange loses on a shared-core loopback
     // (its P*(P-1) total messages cost more than its one-round latency
-    // saves there), so it defaults OFF; on real DCN, where propagation
-    // delay dominates per-message CPU, set TPUCOLL_RS_DIRECT_MAX to
-    // ~16-64K to enable the tier. TPUCOLL_RS_HD_MAX moves the hd/ring
-    // crossover (total payload bytes).
-    static const size_t directMax = collectives_detail::envBytes(
-        "TPUCOLL_RS_DIRECT_MAX", 0);
-    static const size_t hdMax = collectives_detail::envBytes(
-        "TPUCOLL_RS_HD_MAX", 256u << 10);
-    algo = total <= directMax ? ReduceScatterAlgorithm::kDirect
-           : total <= hdMax   ? ReduceScatterAlgorithm::kHalvingDoubling
-                              : ReduceScatterAlgorithm::kRing;
+    // saves there), so the fallback defaults it OFF; a tuned table on
+    // real DCN, where propagation delay dominates per-message CPU, can
+    // elect it from measurement. TPUCOLL_RS_DIRECT_MAX /
+    // TPUCOLL_RS_HD_MAX move the fallback crossovers (total payload
+    // bytes).
+    if (auto tuned = tuning::tableReduceScatter(ctx, opts.dtype, total)) {
+      algo = *tuned;
+    } else {
+      static const size_t directMax = collectives_detail::envBytes(
+          "TPUCOLL_RS_DIRECT_MAX", 0);
+      static const size_t hdMax = collectives_detail::envBytes(
+          "TPUCOLL_RS_HD_MAX", 256u << 10);
+      algo = total <= directMax ? ReduceScatterAlgorithm::kDirect
+             : total <= hdMax   ? ReduceScatterAlgorithm::kHalvingDoubling
+                                : ReduceScatterAlgorithm::kRing;
+    }
   }
   switch (algo) {
     case ReduceScatterAlgorithm::kDirect:

@@ -132,6 +132,16 @@ class Metrics {
     ops_[static_cast<int>(op)].errors.fetch_add(1, std::memory_order_relaxed);
   }
 
+  // Raw latency totals for one op — the tuner's measurement source
+  // (tuning/tuner.cc): mean-over-iterations is the delta of two
+  // (count, sumUs) snapshots, exact where the power-of-two buckets are
+  // only a factor-2 bound.
+  void opLatencyTotals(MetricOp op, uint64_t* count, uint64_t* sumUs) const {
+    const Histogram& h = ops_[static_cast<int>(op)].latency;
+    *count = h.count.load(std::memory_order_relaxed);
+    *sumUs = h.sumUs.load(std::memory_order_relaxed);
+  }
+
   // ---- transport peer accounting (Pair / transport::Context) ----
   void recordSent(int peer, uint64_t bytes) {
     if (!enabled() || peer < 0 || peer >= size_) {

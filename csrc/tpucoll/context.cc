@@ -1,6 +1,11 @@
 #include "tpucoll/context.h"
 
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/tuning/tuning_table.h"
 #include "tpucoll/types.h"
 
 namespace tpucoll {
@@ -25,6 +30,7 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_);
   tctx_->connectFullMesh(*store_, timeout_);
+  maybeLoadTuningFile();
 }
 
 void Context::forkFrom(Context& parent, uint32_t tag) {
@@ -74,10 +80,35 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
     off += counts[j];
   }
   tctx_->connectWithBlobs(blobs, timeout_);
+  maybeLoadTuningFile();
 }
 
 std::string Context::metricsJson(bool drain) {
   return metrics_.toJson(rank_, drain);
+}
+
+void Context::setTuningTable(
+    std::shared_ptr<const tuning::TuningTable> table) {
+  std::lock_guard<std::mutex> guard(tuningMu_);
+  tuningTable_ = std::move(table);
+}
+
+std::shared_ptr<const tuning::TuningTable> Context::tuningTable() const {
+  std::lock_guard<std::mutex> guard(tuningMu_);
+  return tuningTable_;
+}
+
+void Context::maybeLoadTuningFile() {
+  const char* path = std::getenv("TPUCOLL_TUNING_FILE");
+  if (path == nullptr || *path == '\0') {
+    return;
+  }
+  std::ifstream in(path, std::ios::binary);
+  TC_ENFORCE(in.good(), "TPUCOLL_TUNING_FILE: cannot read ", path);
+  std::ostringstream buf;
+  buf << in.rdbuf();
+  setTuningTable(std::make_shared<const tuning::TuningTable>(
+      tuning::TuningTable::fromJson(buf.str())));
 }
 
 uint64_t Context::nextSlot(uint32_t numToSkip) {

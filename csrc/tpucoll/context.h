@@ -18,6 +18,10 @@
 
 namespace tpucoll {
 
+namespace tuning {
+class TuningTable;
+}  // namespace tuning
+
 class Context {
  public:
   static constexpr std::chrono::milliseconds kDefaultTimeout =
@@ -91,13 +95,42 @@ class Context {
   // Structured JSON snapshot of the registry; `drain` resets counters.
   std::string metricsJson(bool drain);
 
+  // ---- collective autotuning plane (tuning/tuning_table.h) ----
+  // Installed measured tuning table consulted by every kAuto dispatch;
+  // null (the default) falls back to the historical compile-time
+  // thresholds. MUST be byte-identical across ranks (see tuning.h
+  // determinism contract) — install via tuning::tune() or from one
+  // shared serialized table, never from per-rank measurements.
+  // Reads take a mutex, not an atomic: dispatch happens once per
+  // collective call (a multi-microsecond operation), not per segment.
+  void setTuningTable(std::shared_ptr<const tuning::TuningTable> table);
+  std::shared_ptr<const tuning::TuningTable> tuningTable() const;
+
+  // Monotonic generation counter namespacing each tune() election's
+  // store keys. All ranks call tune() the same number of times (it is a
+  // collective), so the generation agrees without store traffic.
+  uint64_t nextTuneGeneration() { return tuneGen_.fetch_add(1) + 1; }
+
+  // Rendezvous store this context bootstrapped over; null for forked
+  // contexts (they exchange through the parent instead).
+  Store* store() const { return store_.get(); }
+
   void close();
 
  private:
+  // TPUCOLL_TUNING_FILE hook: load + install a serialized table right
+  // after connect, so a deployment can pin its measured table without
+  // touching application code. Malformed files throw (never silently
+  // run untuned against an operator's explicit instruction).
+  void maybeLoadTuningFile();
+
   const int rank_;
   const int size_;
   std::chrono::milliseconds timeout_{kDefaultTimeout};
   std::atomic<uint32_t> slotCounter_{0};
+  std::atomic<uint64_t> tuneGen_{0};
+  mutable std::mutex tuningMu_;
+  std::shared_ptr<const tuning::TuningTable> tuningTable_;
   std::shared_ptr<Store> store_;
   std::shared_ptr<transport::Device> device_;
   std::unique_ptr<transport::Context> tctx_;

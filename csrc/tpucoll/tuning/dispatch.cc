@@ -1,0 +1,163 @@
+#include "tpucoll/tuning/dispatch.h"
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpucoll/tuning/tuning_table.h"
+
+namespace tpucoll {
+namespace tuning {
+
+namespace {
+
+// Dispatch-eligible arms per collective. bf16-wire is measured by the
+// tuner but absent here (precision contract is opt-in); hd_fold /
+// hd_blocks appear as first-class arms so a tuned non-power-of-2 group
+// can land on the cheaper variant directly.
+const std::vector<std::string>& allreduceArms() {
+  static const std::vector<std::string> arms = {
+      "ring", "halving_doubling", "recursive_doubling",
+      "bcube", "hd_fold", "hd_blocks"};
+  return arms;
+}
+
+const std::vector<std::string>& reduceArms() {
+  static const std::vector<std::string> arms = {"binomial", "ring"};
+  return arms;
+}
+
+const std::vector<std::string>& reduceScatterArms() {
+  static const std::vector<std::string> arms = {
+      "ring", "halving_doubling", "direct"};
+  return arms;
+}
+
+}  // namespace
+
+const char* dataTypeName(DataType dtype) {
+  switch (dtype) {
+    case DataType::kInt8: return "int8";
+    case DataType::kUint8: return "uint8";
+    case DataType::kInt32: return "int32";
+    case DataType::kUint32: return "uint32";
+    case DataType::kInt64: return "int64";
+    case DataType::kUint64: return "uint64";
+    case DataType::kFloat16: return "float16";
+    case DataType::kBFloat16: return "bfloat16";
+    case DataType::kFloat32: return "float32";
+    case DataType::kFloat64: return "float64";
+  }
+  return "unknown";
+}
+
+const char* allreduceAlgorithmName(AllreduceAlgorithm algo) {
+  switch (algo) {
+    case AllreduceAlgorithm::kAuto: return "auto";
+    case AllreduceAlgorithm::kRing: return "ring";
+    case AllreduceAlgorithm::kHalvingDoubling: return "halving_doubling";
+    case AllreduceAlgorithm::kBcube: return "bcube";
+    case AllreduceAlgorithm::kRingBf16Wire: return "ring_bf16_wire";
+    case AllreduceAlgorithm::kRecursiveDoubling: return "recursive_doubling";
+    case AllreduceAlgorithm::kHdFold: return "hd_fold";
+    case AllreduceAlgorithm::kHdBlocks: return "hd_blocks";
+  }
+  return "unknown";
+}
+
+const char* reduceAlgorithmName(ReduceAlgorithm algo) {
+  switch (algo) {
+    case ReduceAlgorithm::kAuto: return "auto";
+    case ReduceAlgorithm::kBinomial: return "binomial";
+    case ReduceAlgorithm::kRing: return "ring";
+  }
+  return "unknown";
+}
+
+const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo) {
+  switch (algo) {
+    case ReduceScatterAlgorithm::kAuto: return "auto";
+    case ReduceScatterAlgorithm::kRing: return "ring";
+    case ReduceScatterAlgorithm::kHalvingDoubling: return "halving_doubling";
+    case ReduceScatterAlgorithm::kDirect: return "direct";
+  }
+  return "unknown";
+}
+
+std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
+                                                 DataType dtype,
+                                                 size_t nbytes) {
+  auto table = ctx->tuningTable();
+  if (table == nullptr) {
+    return std::nullopt;
+  }
+  auto name = table->choose("allreduce", ctx->size(), dataTypeName(dtype),
+                            nbytes, allreduceArms());
+  if (!name.has_value()) {
+    return std::nullopt;
+  }
+  if (*name == "ring") return AllreduceAlgorithm::kRing;
+  if (*name == "halving_doubling") return AllreduceAlgorithm::kHalvingDoubling;
+  if (*name == "recursive_doubling") {
+    return AllreduceAlgorithm::kRecursiveDoubling;
+  }
+  if (*name == "bcube") return AllreduceAlgorithm::kBcube;
+  if (*name == "hd_fold") return AllreduceAlgorithm::kHdFold;
+  if (*name == "hd_blocks") return AllreduceAlgorithm::kHdBlocks;
+  return std::nullopt;
+}
+
+std::optional<ReduceAlgorithm> tableReduce(Context* ctx, DataType dtype,
+                                           size_t nbytes) {
+  auto table = ctx->tuningTable();
+  if (table == nullptr) {
+    return std::nullopt;
+  }
+  auto name = table->choose("reduce", ctx->size(), dataTypeName(dtype),
+                            nbytes, reduceArms());
+  if (!name.has_value()) {
+    return std::nullopt;
+  }
+  if (*name == "binomial") return ReduceAlgorithm::kBinomial;
+  if (*name == "ring") return ReduceAlgorithm::kRing;
+  return std::nullopt;
+}
+
+std::optional<ReduceScatterAlgorithm> tableReduceScatter(Context* ctx,
+                                                         DataType dtype,
+                                                         size_t nbytes) {
+  auto table = ctx->tuningTable();
+  if (table == nullptr) {
+    return std::nullopt;
+  }
+  auto name = table->choose("reduce_scatter", ctx->size(),
+                            dataTypeName(dtype), nbytes, reduceScatterArms());
+  if (!name.has_value()) {
+    return std::nullopt;
+  }
+  if (*name == "ring") return ReduceScatterAlgorithm::kRing;
+  if (*name == "halving_doubling") {
+    return ReduceScatterAlgorithm::kHalvingDoubling;
+  }
+  if (*name == "direct") return ReduceScatterAlgorithm::kDirect;
+  return std::nullopt;
+}
+
+std::optional<bool> tableHdUseBlocks(Context* ctx, size_t nbytes) {
+  auto table = ctx->tuningTable();
+  if (table == nullptr) {
+    return std::nullopt;
+  }
+  // Empty dtype = wildcard (the caller only knows elsize); both arms must
+  // have data or the comparison is meaningless.
+  auto fold = table->cost("allreduce", "hd_fold", ctx->size(), "", nbytes);
+  auto blocks =
+      table->cost("allreduce", "hd_blocks", ctx->size(), "", nbytes);
+  if (!fold.has_value() || !blocks.has_value()) {
+    return std::nullopt;
+  }
+  return *blocks < *fold;
+}
+
+}  // namespace tuning
+}  // namespace tpucoll

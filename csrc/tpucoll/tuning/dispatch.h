@@ -1,0 +1,45 @@
+// Bridge between the installed TuningTable (string-keyed measurements)
+// and the collectives' algorithm enums. Each kAuto dispatch asks here
+// first; nullopt means "no table, or no data for this shape" and the
+// caller falls back to its historical compile-time thresholds, so an
+// untuned context behaves exactly as before this plane existed.
+//
+// Dispatch deliberately excludes algorithms whose numerics are opt-in
+// (ring_bf16_wire accumulates in bf16): the tuner measures them so the
+// table can report their headroom, but auto-dispatch must never change
+// the precision contract behind the caller's back.
+#pragma once
+
+#include <optional>
+
+#include "tpucoll/collectives/collectives.h"
+
+namespace tpucoll {
+namespace tuning {
+
+// Canonical string names for table keys, shared by the tuner and the
+// Python surface (they match gloo_tpu.core's algorithm/dtype spellings).
+const char* dataTypeName(DataType dtype);
+const char* allreduceAlgorithmName(AllreduceAlgorithm algo);
+const char* reduceAlgorithmName(ReduceAlgorithm algo);
+const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo);
+
+// Table-elected algorithm for a kAuto call, or nullopt to use the
+// fallback constants. Deterministic across ranks: the table is
+// rank-identical and (dtype, nbytes, size) match by collective contract.
+std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
+                                                 DataType dtype,
+                                                 size_t nbytes);
+std::optional<ReduceAlgorithm> tableReduce(Context* ctx, DataType dtype,
+                                           size_t nbytes);
+std::optional<ReduceScatterAlgorithm> tableReduceScatter(Context* ctx,
+                                                         DataType dtype,
+                                                         size_t nbytes);
+
+// Fold-vs-binary-blocks election for an explicit kHalvingDoubling call on
+// a non-power-of-2 group (collectives_hd.cc): true = blocks, false =
+// fold, nullopt = no table data, use the TPUCOLL_HD_NP2 crossover.
+std::optional<bool> tableHdUseBlocks(Context* ctx, size_t nbytes);
+
+}  // namespace tuning
+}  // namespace tpucoll

@@ -1,0 +1,292 @@
+#include "tpucoll/tuning/tuner.h"
+
+#include <algorithm>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/metrics.h"
+#include "tpucoll/tuning/dispatch.h"
+
+namespace tpucoll {
+namespace tuning {
+
+namespace {
+
+// The sweep dtype. Algorithm crossovers track payload BYTES, not element
+// width (every schedule moves bytes; only the reduction kernel sees
+// elements), so one dtype's curves generalize — choose() falls back to
+// ignoring dtype for queries the sweep didn't cover.
+constexpr DataType kSweepDtype = DataType::kFloat32;
+
+// Mean latency of `body()` over opts.iters runs, measured from the
+// metrics registry's (count, sumUs) delta for `op` — the PR-1 histograms
+// as the measurement source, exact to the microsecond where the
+// power-of-two buckets alone would only bound within 2x.
+double measureArm(Context* ctx, MetricOp op, int warmup, int iters,
+                  const std::function<void()>& body) {
+  for (int i = 0; i < warmup; i++) {
+    body();
+  }
+  uint64_t c0 = 0, s0 = 0, c1 = 0, s1 = 0;
+  ctx->metrics().opLatencyTotals(op, &c0, &s0);
+  for (int i = 0; i < iters; i++) {
+    body();
+  }
+  ctx->metrics().opLatencyTotals(op, &c1, &s1);
+  const uint64_t calls = c1 > c0 ? c1 - c0 : 1;
+  return static_cast<double>(s1 - s0) / static_cast<double>(calls);
+}
+
+struct AllreduceArm {
+  const char* name;
+  AllreduceAlgorithm algo;
+};
+
+std::vector<AllreduceArm> allreduceArms(int size) {
+  std::vector<AllreduceArm> arms = {
+      {"ring", AllreduceAlgorithm::kRing},
+      {"recursive_doubling", AllreduceAlgorithm::kRecursiveDoubling},
+      {"bcube", AllreduceAlgorithm::kBcube},
+      // Measurement-only in the table (dispatch.h excludes it): shows the
+      // wire-compression headroom next to the elected arm.
+      {"ring_bf16_wire", AllreduceAlgorithm::kRingBf16Wire},
+  };
+  const bool pow2 = (size & (size - 1)) == 0;
+  if (pow2) {
+    // fold == blocks on power-of-2 groups; one arm covers both.
+    arms.push_back({"halving_doubling", AllreduceAlgorithm::kHalvingDoubling});
+  } else {
+    // Sweep the two np2 sub-variants separately so the table can elect
+    // the cheaper one per size (collectives_hd.cc consults these curves
+    // for explicit kHalvingDoubling calls too).
+    arms.push_back({"hd_fold", AllreduceAlgorithm::kHdFold});
+    arms.push_back({"hd_blocks", AllreduceAlgorithm::kHdBlocks});
+  }
+  return arms;
+}
+
+struct ReduceArm {
+  const char* name;
+  ReduceAlgorithm algo;
+};
+
+// The histograms are the measurement source — force them on for the
+// sweep and restore the caller's setting on every exit path (a swept
+// collective can throw on timeout/peer failure; the caller's explicit
+// metrics-off choice must survive that).
+class MetricsEnableGuard {
+ public:
+  explicit MetricsEnableGuard(Metrics* metrics)
+      : metrics_(metrics), prev_(metrics->enabled()) {
+    metrics_->setEnabled(true);
+  }
+  ~MetricsEnableGuard() { metrics_->setEnabled(prev_); }
+  MetricsEnableGuard(const MetricsEnableGuard&) = delete;
+  MetricsEnableGuard& operator=(const MetricsEnableGuard&) = delete;
+
+ private:
+  Metrics* metrics_;
+  bool prev_;
+};
+
+struct RsArm {
+  const char* name;
+  ReduceScatterAlgorithm algo;
+};
+
+void publishAndInstall(Context* ctx, const TunerOptions& opts,
+                       std::string* json) {
+  const auto timeout =
+      opts.timeout.count() > 0 ? opts.timeout : ctx->getTimeout();
+  const uint64_t gen = ctx->nextTuneGeneration();
+  Store* store = ctx->store();
+  if (store != nullptr) {
+    // Elected through the rendezvous plane: rank 0 publishes under a
+    // generation-stamped key (all ranks advanced the same generation —
+    // tune() is a collective), everyone else blocks on the key. The
+    // table also stays visible in the store for external inspection.
+    const std::string key = "tpucoll/tuning/" + std::to_string(gen);
+    if (ctx->rank() == 0) {
+      store->set(key, Store::Buf(json->begin(), json->end()));
+    } else {
+      Store::Buf buf = store->get(key, timeout);
+      json->assign(buf.begin(), buf.end());
+    }
+  } else {
+    // Forked contexts have no store; the context's own collectives carry
+    // the election instead.
+    uint64_t len = json->size();
+    {
+      BroadcastOptions bo;
+      bo.context = ctx;
+      bo.tag = opts.tag;
+      bo.timeout = timeout;
+      bo.buffer = &len;
+      bo.count = 1;
+      bo.dtype = DataType::kUint64;
+      bo.root = 0;
+      broadcast(bo);
+    }
+    json->resize(len);
+    if (len > 0) {
+      BroadcastOptions bo;
+      bo.context = ctx;
+      bo.tag = opts.tag;
+      bo.timeout = timeout;
+      bo.buffer = json->data();
+      bo.count = len;
+      bo.dtype = DataType::kUint8;
+      bo.root = 0;
+      broadcast(bo);
+    }
+  }
+}
+
+}  // namespace
+
+std::shared_ptr<const TuningTable> tune(Context* ctx,
+                                        const TunerOptions& opts) {
+  TC_ENFORCE(ctx != nullptr, "tune: null context");
+  TC_ENFORCE(opts.minBytes >= sizeof(float) &&
+                 opts.maxBytes >= opts.minBytes,
+             "tune: need elementSize <= minBytes <= maxBytes");
+  TC_ENFORCE(opts.iters > 0 && opts.warmup >= 0,
+             "tune: iters must be positive, warmup non-negative");
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const auto timeout =
+      opts.timeout.count() > 0 ? opts.timeout : ctx->getTimeout();
+
+  if (size == 1) {
+    // Nothing to measure on a group of one; an empty table keeps kAuto on
+    // the fallback constants.
+    auto empty = std::make_shared<const TuningTable>();
+    ctx->setTuningTable(empty);
+    return empty;
+  }
+
+  MetricsEnableGuard metricsGuard(&ctx->metrics());
+
+  const size_t elsize = elementSize(kSweepDtype);
+  const size_t maxCount = std::max<size_t>(opts.maxBytes / elsize, 1);
+  // One zero-filled workspace reused by every cell: allreduce runs in
+  // place on zeros (0+0 stays exactly representable, so repeated timed
+  // iterations never overflow), reduce/reduce_scatter write into `out`.
+  std::vector<float> work(maxCount, 0.0f);
+  std::vector<float> out(maxCount, 0.0f);
+
+  TuningTable table;
+  const int firstBucket = sizeBucket(opts.minBytes);
+  const int lastBucket = sizeBucket(opts.maxBytes);
+
+  for (int bucket = firstBucket; bucket <= lastBucket; bucket++) {
+    const size_t nbytes = size_t(1) << bucket;
+    const size_t count = std::max<size_t>(nbytes / elsize, 1);
+
+    auto record = [&](const char* collective, const char* algorithm,
+                      double costUs) {
+      if (rank != 0) {
+        return;  // rank 0's measurements are the elected ones
+      }
+      table.add(Measurement{collective, algorithm, size,
+                            dataTypeName(kSweepDtype), bucket, costUs});
+    };
+
+    if (opts.sweepAllreduce) {
+      for (const AllreduceArm& arm : allreduceArms(size)) {
+        const double cost = measureArm(
+            ctx, MetricOp::kAllreduce, opts.warmup, opts.iters, [&] {
+              AllreduceOptions o;
+              o.context = ctx;
+              o.tag = opts.tag;
+              o.timeout = timeout;
+              o.inputs = {work.data()};
+              o.outputs = {work.data()};
+              o.count = count;
+              o.dtype = kSweepDtype;
+              o.op = ReduceOp::kSum;
+              o.algorithm = arm.algo;
+              allreduce(o);
+            });
+        record("allreduce", arm.name, cost);
+      }
+    }
+
+    if (opts.sweepReduce) {
+      static const ReduceArm kReduceArms[] = {
+          {"binomial", ReduceAlgorithm::kBinomial},
+          {"ring", ReduceAlgorithm::kRing},
+      };
+      for (const ReduceArm& arm : kReduceArms) {
+        const double cost = measureArm(
+            ctx, MetricOp::kReduce, opts.warmup, opts.iters, [&] {
+              ReduceOptions o;
+              o.context = ctx;
+              o.tag = opts.tag;
+              o.timeout = timeout;
+              o.input = work.data();
+              o.output = rank == 0 ? out.data() : nullptr;
+              o.count = count;
+              o.dtype = kSweepDtype;
+              o.op = ReduceOp::kSum;
+              o.root = 0;
+              o.algorithm = arm.algo;
+              reduce(o);
+            });
+        record("reduce", arm.name, cost);
+      }
+    }
+
+    if (opts.sweepReduceScatter) {
+      static const RsArm kRsArms[] = {
+          {"ring", ReduceScatterAlgorithm::kRing},
+          {"halving_doubling", ReduceScatterAlgorithm::kHalvingDoubling},
+          {"direct", ReduceScatterAlgorithm::kDirect},
+      };
+      std::vector<size_t> recvCounts(size, count / size);
+      for (size_t r = 0; r < count % size; r++) {
+        recvCounts[r]++;
+      }
+      for (const RsArm& arm : kRsArms) {
+        const double cost = measureArm(
+            ctx, MetricOp::kReduceScatter, opts.warmup, opts.iters, [&] {
+              ReduceScatterOptions o;
+              o.context = ctx;
+              o.tag = opts.tag;
+              o.timeout = timeout;
+              o.input = work.data();
+              o.output = out.data();
+              o.recvCounts = recvCounts;
+              o.dtype = kSweepDtype;
+              o.op = ReduceOp::kSum;
+              o.algorithm = arm.algo;
+              reduceScatter(o);
+            });
+        record("reduce_scatter", arm.name, cost);
+      }
+    }
+  }
+
+  // Elect rank 0's table: serialize, publish, and re-parse the SAME bytes
+  // on every rank (rank 0 included), so install is byte-identical.
+  std::string json = rank == 0 ? table.toJson() : std::string();
+  publishAndInstall(ctx, opts, &json);
+  auto installed =
+      std::make_shared<const TuningTable>(TuningTable::fromJson(json));
+  ctx->setTuningTable(installed);
+
+  // Leave the group in lockstep: no rank returns (and starts dispatching
+  // off the new table) until every rank has installed it.
+  BarrierOptions barrierOpts;
+  barrierOpts.context = ctx;
+  barrierOpts.tag = opts.tag;
+  barrierOpts.timeout = timeout;
+  barrier(barrierOpts);
+  return installed;
+}
+
+}  // namespace tuning
+}  // namespace tpucoll

@@ -1,0 +1,56 @@
+// Collective autotuner: measure every registered algorithm variant on the
+// live fabric, elect rank 0's measurements, and install the resulting
+// TuningTable identically on every rank.
+//
+// tune() is a COLLECTIVE: every rank of the context must call it
+// concurrently with identical options (it runs the real collectives to
+// measure them, and publishes the elected table to the whole group). The
+// measurement source is the PR-1 metrics registry's latency histograms —
+// each arm's cost is the delta of (count, sumUs) around its timed
+// iterations, on rank 0. The elected table is serialized, published
+// through the rendezvous Store the context bootstrapped over (or
+// broadcast through the context's own collectives for forked contexts,
+// which have no store), parsed back from the SAME bytes on every rank —
+// including rank 0 — and installed, so kAuto dispatch is byte-identical
+// everywhere.
+#pragma once
+
+#include <chrono>
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+
+#include "tpucoll/context.h"
+#include "tpucoll/tuning/tuning_table.h"
+
+namespace tpucoll {
+namespace tuning {
+
+struct TunerOptions {
+  // Swept payload range: one cell per log2 bucket from
+  // sizeBucket(minBytes) through sizeBucket(maxBytes).
+  size_t minBytes = 1u << 10;
+  size_t maxBytes = 4u << 20;
+  // Timed iterations per (collective, algorithm, bucket) cell, after
+  // `warmup` untimed ones.
+  int iters = 8;
+  int warmup = 2;
+  // Collective tag the sweep's operations run under; must not collide
+  // with application collectives running concurrently on this context.
+  uint32_t tag = 0;
+  // Per-operation timeout; zero uses the context default.
+  std::chrono::milliseconds timeout{0};
+  // Which collectives to sweep.
+  bool sweepAllreduce = true;
+  bool sweepReduce = true;
+  bool sweepReduceScatter = true;
+};
+
+// Run the sweep, elect + publish + install; returns the installed table
+// (already set on the context). Single-rank groups skip the sweep and
+// install an empty table (dispatch falls back to the default thresholds).
+std::shared_ptr<const TuningTable> tune(Context* ctx,
+                                        const TunerOptions& opts);
+
+}  // namespace tuning
+}  // namespace tpucoll

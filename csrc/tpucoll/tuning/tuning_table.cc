@@ -1,0 +1,472 @@
+#include "tpucoll/tuning/tuning_table.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <sstream>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace tuning {
+
+namespace {
+
+// Minimal JSON reader, scoped to the table interchange format (objects,
+// arrays, strings with the common escapes, numbers, bools, null). The
+// repo's other JSON surfaces only serialize; the table is the first thing
+// the core must also *read* (install_table / TPUCOLL_TUNING_FILE), and a
+// dependency-free ~100-line recursive-descent parser beats gating the
+// feature on a library the container doesn't ship.
+class JsonReader {
+ public:
+  explicit JsonReader(const std::string& text) : text_(text) {}
+
+  // Parsed value: exactly one of the members is active, by `kind`.
+  struct Value {
+    enum class Kind { kNull, kBool, kNumber, kString, kArray, kObject };
+    Kind kind = Kind::kNull;
+    bool boolean = false;
+    double number = 0.0;
+    std::string str;
+    std::vector<Value> items;
+    std::vector<std::pair<std::string, Value>> fields;
+
+    const Value* field(const std::string& name) const {
+      for (const auto& f : fields) {
+        if (f.first == name) {
+          return &f.second;
+        }
+      }
+      return nullptr;
+    }
+  };
+
+  Value parse() {
+    Value v = parseValue();
+    skipWs();
+    TC_ENFORCE_EQ(pos_, text_.size(), "tuning table JSON: trailing bytes");
+    return v;
+  }
+
+ private:
+  void skipWs() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_]))) {
+      pos_++;
+    }
+  }
+
+  char peek() {
+    skipWs();
+    TC_ENFORCE(pos_ < text_.size(), "tuning table JSON: unexpected end");
+    return text_[pos_];
+  }
+
+  void expect(char c) {
+    TC_ENFORCE(peek() == c, "tuning table JSON: expected '", c, "' at byte ",
+               pos_);
+    pos_++;
+  }
+
+  bool consume(char c) {
+    if (pos_ < text_.size() && peek() == c) {
+      pos_++;
+      return true;
+    }
+    return false;
+  }
+
+  Value parseValue() {
+    const char c = peek();
+    if (c == '{') return parseObject();
+    if (c == '[') return parseArray();
+    if (c == '"') {
+      Value v;
+      v.kind = Value::Kind::kString;
+      v.str = parseString();
+      return v;
+    }
+    if (c == 't' || c == 'f') return parseLiteralBool();
+    if (c == 'n') {
+      expectWord("null");
+      return Value{};
+    }
+    return parseNumber();
+  }
+
+  void expectWord(const char* w) {
+    skipWs();
+    for (const char* p = w; *p != '\0'; p++) {
+      TC_ENFORCE(pos_ < text_.size() && text_[pos_] == *p,
+                 "tuning table JSON: bad literal at byte ", pos_);
+      pos_++;
+    }
+  }
+
+  Value parseLiteralBool() {
+    Value v;
+    v.kind = Value::Kind::kBool;
+    if (peek() == 't') {
+      expectWord("true");
+      v.boolean = true;
+    } else {
+      expectWord("false");
+      v.boolean = false;
+    }
+    return v;
+  }
+
+  // Hand-rolled, locale-independent number scan: JSON numbers are
+  // always dot-decimal, but std::stod honors LC_NUMERIC — in a
+  // comma-decimal locale it would silently truncate "40.25" to 40.
+  Value parseNumber() {
+    skipWs();
+    const size_t start = pos_;
+    bool negative = false;
+    if (pos_ < text_.size() &&
+        (text_[pos_] == '-' || text_[pos_] == '+')) {
+      negative = text_[pos_] == '-';
+      pos_++;
+    }
+    bool anyDigit = false;
+    double mantissa = 0.0;
+    while (pos_ < text_.size() &&
+           std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+      mantissa = mantissa * 10.0 + (text_[pos_] - '0');
+      anyDigit = true;
+      pos_++;
+    }
+    if (pos_ < text_.size() && text_[pos_] == '.') {
+      pos_++;
+      double place = 0.1;
+      while (pos_ < text_.size() &&
+             std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+        mantissa += (text_[pos_] - '0') * place;
+        place *= 0.1;
+        anyDigit = true;
+        pos_++;
+      }
+    }
+    TC_ENFORCE(anyDigit, "tuning table JSON: expected number at byte ",
+               start);
+    int exponent = 0;
+    if (pos_ < text_.size() && (text_[pos_] == 'e' || text_[pos_] == 'E')) {
+      pos_++;
+      bool expNegative = false;
+      if (pos_ < text_.size() &&
+          (text_[pos_] == '-' || text_[pos_] == '+')) {
+        expNegative = text_[pos_] == '-';
+        pos_++;
+      }
+      bool anyExpDigit = false;
+      while (pos_ < text_.size() &&
+             std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+        exponent = std::min(exponent * 10 + (text_[pos_] - '0'), 9999);
+        anyExpDigit = true;
+        pos_++;
+      }
+      TC_ENFORCE(anyExpDigit, "tuning table JSON: bad exponent at byte ",
+                 start);
+      if (expNegative) {
+        exponent = -exponent;
+      }
+    }
+    Value v;
+    v.kind = Value::Kind::kNumber;
+    v.number = (negative ? -mantissa : mantissa) *
+               std::pow(10.0, exponent);
+    return v;
+  }
+
+  std::string parseString() {
+    expect('"');
+    std::string out;
+    while (true) {
+      TC_ENFORCE(pos_ < text_.size(),
+                 "tuning table JSON: unterminated string");
+      char c = text_[pos_++];
+      if (c == '"') {
+        return out;
+      }
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      TC_ENFORCE(pos_ < text_.size(), "tuning table JSON: bad escape");
+      char e = text_[pos_++];
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'n': out.push_back('\n'); break;
+        case 't': out.push_back('\t'); break;
+        case 'r': out.push_back('\r'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'u': {
+          // Table strings are ASCII identifiers; decode BMP escapes to
+          // their low byte and reject the rest rather than mis-decode.
+          TC_ENFORCE(pos_ + 4 <= text_.size(),
+                     "tuning table JSON: bad \\u escape");
+          unsigned code = 0;
+          for (int i = 0; i < 4; i++) {
+            char h = text_[pos_++];
+            code <<= 4;
+            if (h >= '0' && h <= '9') code |= h - '0';
+            else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+            else TC_THROW(EnforceError, "tuning table JSON: bad \\u escape");
+          }
+          TC_ENFORCE(code < 0x80,
+                     "tuning table JSON: non-ASCII \\u escape unsupported");
+          out.push_back(static_cast<char>(code));
+          break;
+        }
+        default:
+          TC_THROW(EnforceError, "tuning table JSON: bad escape '\\", e, "'");
+      }
+    }
+  }
+
+  Value parseArray() {
+    expect('[');
+    Value v;
+    v.kind = Value::Kind::kArray;
+    if (consume(']')) {
+      return v;
+    }
+    while (true) {
+      v.items.push_back(parseValue());
+      if (consume(']')) {
+        return v;
+      }
+      expect(',');
+    }
+  }
+
+  Value parseObject() {
+    expect('{');
+    Value v;
+    v.kind = Value::Kind::kObject;
+    if (consume('}')) {
+      return v;
+    }
+    while (true) {
+      std::string key = parseString();
+      expect(':');
+      v.fields.emplace_back(std::move(key), parseValue());
+      if (consume('}')) {
+        return v;
+      }
+      expect(',');
+    }
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+const JsonReader::Value& requireField(const JsonReader::Value& obj,
+                                      const std::string& name,
+                                      JsonReader::Value::Kind kind) {
+  const JsonReader::Value* f = obj.field(name);
+  TC_ENFORCE(f != nullptr, "tuning table JSON: entry missing \"", name, "\"");
+  TC_ENFORCE(f->kind == kind, "tuning table JSON: \"", name,
+             "\" has wrong type");
+  return *f;
+}
+
+void appendJsonString(std::ostringstream& out, const std::string& s) {
+  out << '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': out << "\\\""; break;
+      case '\\': out << "\\\\"; break;
+      case '\n': out << "\\n"; break;
+      case '\t': out << "\\t"; break;
+      case '\r': out << "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out << buf;
+        } else {
+          out << c;
+        }
+    }
+  }
+  out << '"';
+}
+
+// Fixed three-decimal cost serialization, built from integer pieces so
+// the output is locale-independent (snprintf "%f" honors LC_NUMERIC and
+// would emit "40,250" in a comma-decimal locale — invalid JSON). Costs
+// are enforced non-negative at add().
+void appendCost(std::ostringstream& out, double v) {
+  const long long scaled = std::llround(v * 1000.0);
+  char buf[8];
+  std::snprintf(buf, sizeof(buf), "%03lld", scaled % 1000);
+  out << scaled / 1000 << '.' << buf;
+}
+
+}  // namespace
+
+int sizeBucket(size_t nbytes) {
+  int b = 0;
+  while (nbytes > 1) {
+    nbytes >>= 1;
+    b++;
+  }
+  return b;
+}
+
+void TuningTable::add(const Measurement& m) {
+  TC_ENFORCE(!m.collective.empty() && !m.algorithm.empty(),
+             "tuning table: measurement needs collective and algorithm");
+  TC_ENFORCE(m.worldSize > 0, "tuning table: world size must be positive");
+  TC_ENFORCE(m.bucket >= 0 && m.bucket < 64, "tuning table: bad bucket ",
+             m.bucket);
+  TC_ENFORCE(m.costUs >= 0.0 && std::isfinite(m.costUs),
+             "tuning table: cost must be finite and non-negative");
+  cells_[Key{m.collective, m.algorithm, m.worldSize, m.dtype}][m.bucket] =
+      m.costUs;
+}
+
+std::optional<double> TuningTable::curveCost(const Curve& curve,
+                                             double x) const {
+  if (curve.empty()) {
+    return std::nullopt;
+  }
+  // Clamp outside the swept range: beyond the sweep the relative order at
+  // the boundary bucket is the best information the table has, and flat
+  // extrapolation preserves exactly that ordering (linear extrapolation
+  // in log space can invert wildly a few octaves out).
+  if (x <= curve.begin()->first) {
+    return curve.begin()->second;
+  }
+  auto last = std::prev(curve.end());
+  if (x >= last->first) {
+    return last->second;
+  }
+  auto hi = curve.upper_bound(static_cast<int>(std::floor(x)));
+  auto lo = std::prev(hi);
+  if (hi == curve.end()) {
+    return lo->second;
+  }
+  const double span = hi->first - lo->first;
+  const double t = (x - lo->first) / span;
+  return lo->second + t * (hi->second - lo->second);
+}
+
+std::optional<double> TuningTable::cost(const std::string& collective,
+                                        const std::string& algorithm,
+                                        int worldSize,
+                                        const std::string& dtype,
+                                        size_t nbytes) const {
+  const double x =
+      std::log2(static_cast<double>(nbytes > 0 ? nbytes : 1));
+  // Exact dtype first; fall back to dtype-agnostic aggregation (cheapest
+  // curve point across dtypes would mix curves — instead use the first
+  // matching curve in key order, which is deterministic on every rank).
+  auto it = cells_.find(Key{collective, algorithm, worldSize, dtype});
+  if (it != cells_.end()) {
+    return curveCost(it->second, x);
+  }
+  for (const auto& cell : cells_) {
+    if (cell.first.collective == collective &&
+        cell.first.algorithm == algorithm &&
+        cell.first.worldSize == worldSize) {
+      return curveCost(cell.second, x);
+    }
+  }
+  return std::nullopt;
+}
+
+std::optional<std::string> TuningTable::choose(
+    const std::string& collective, int worldSize, const std::string& dtype,
+    size_t nbytes, const std::vector<std::string>& allowed) const {
+  std::optional<std::string> best;
+  double bestCost = std::numeric_limits<double>::infinity();
+  for (const std::string& algo : allowed) {
+    auto c = cost(collective, algo, worldSize, dtype, nbytes);
+    if (c.has_value() && *c < bestCost) {
+      bestCost = *c;
+      best = algo;
+    }
+  }
+  return best;
+}
+
+std::vector<Measurement> TuningTable::measurements() const {
+  std::vector<Measurement> out;
+  for (const auto& cell : cells_) {
+    for (const auto& point : cell.second) {
+      out.push_back(Measurement{cell.first.collective, cell.first.algorithm,
+                                cell.first.worldSize, cell.first.dtype,
+                                point.first, point.second});
+    }
+  }
+  return out;
+}
+
+std::string TuningTable::toJson() const {
+  std::ostringstream out;
+  out << "{\"version\":1,\"entries\":[";
+  bool first = true;
+  // cells_ and each Curve are ordered maps: serialization order is a pure
+  // function of content, so equal tables are byte-equal JSON.
+  for (const auto& cell : cells_) {
+    for (const auto& point : cell.second) {
+      if (!first) {
+        out << ",";
+      }
+      first = false;
+      out << "{\"collective\":";
+      appendJsonString(out, cell.first.collective);
+      out << ",\"algorithm\":";
+      appendJsonString(out, cell.first.algorithm);
+      out << ",\"world_size\":" << cell.first.worldSize << ",\"dtype\":";
+      appendJsonString(out, cell.first.dtype);
+      out << ",\"bucket\":" << point.first << ",\"cost_us\":";
+      appendCost(out, point.second);
+      out << "}";
+    }
+  }
+  out << "]}";
+  return out.str();
+}
+
+TuningTable TuningTable::fromJson(const std::string& json) {
+  using Kind = JsonReader::Value::Kind;
+  JsonReader reader(json);
+  const JsonReader::Value root = reader.parse();
+  TC_ENFORCE(root.kind == Kind::kObject,
+             "tuning table JSON: root must be an object");
+  const JsonReader::Value* version = root.field("version");
+  TC_ENFORCE(version != nullptr && version->kind == Kind::kNumber &&
+                 version->number == 1.0,
+             "tuning table JSON: unsupported version");
+  const JsonReader::Value& entries =
+      requireField(root, "entries", Kind::kArray);
+  TuningTable table;
+  for (const JsonReader::Value& e : entries.items) {
+    TC_ENFORCE(e.kind == Kind::kObject,
+               "tuning table JSON: entry must be an object");
+    Measurement m;
+    m.collective = requireField(e, "collective", Kind::kString).str;
+    m.algorithm = requireField(e, "algorithm", Kind::kString).str;
+    m.worldSize =
+        static_cast<int>(requireField(e, "world_size", Kind::kNumber).number);
+    m.dtype = requireField(e, "dtype", Kind::kString).str;
+    m.bucket =
+        static_cast<int>(requireField(e, "bucket", Kind::kNumber).number);
+    m.costUs = requireField(e, "cost_us", Kind::kNumber).number;
+    table.add(m);
+  }
+  return table;
+}
+
+}  // namespace tuning
+}  // namespace tpucoll

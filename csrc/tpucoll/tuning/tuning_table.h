@@ -1,0 +1,109 @@
+// Measured tuning tables for collective auto-dispatch.
+//
+// Every kAuto threshold in the collectives was measured ONCE, on one
+// loopback host (collectives_ring.cc / collectives_hd.cc admit as much in
+// their comments: "re-sweep on real DCN"). GC3 (arXiv:2201.11840) and
+// HiCCL (arXiv:2408.05962) both make the same point: collective
+// performance is won by specializing the schedule to the actual fabric
+// and payload, not by one-size compile-time constants. This module holds
+// the deployment-measured replacement: a table of per-(collective,
+// algorithm, world-size, dtype, log2-size-bucket) costs produced by the
+// tuner (tuner.h), serialized as JSON, and installed identically on every
+// rank of a Context. kAuto dispatch consults the installed table first
+// (tuning/dispatch.h) and falls back to the historical constants when no
+// table is loaded, so untuned deployments behave exactly as before.
+//
+// Determinism contract: algorithm election must agree on every rank or a
+// collective deadlocks (ranks would run different schedules). The table
+// guarantees this structurally — all ranks install byte-identical JSON
+// (rank 0's measurements, published through the rendezvous Store), and
+// choose() is a pure function of (collective, world size, dtype, nbytes),
+// which the collective contract already requires to match across ranks.
+#pragma once
+
+#include <cstddef>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+namespace tuning {
+
+// One measured cell: the mean latency of `algorithm` serving `collective`
+// at payloads of ~2^bucket bytes in a `worldSize`-rank group.
+struct Measurement {
+  std::string collective;  // "allreduce" | "reduce" | "reduce_scatter"
+  std::string algorithm;   // e.g. "ring", "halving_doubling", "binomial"
+  int worldSize = 0;
+  std::string dtype;       // element dtype name, e.g. "float32"
+  int bucket = 0;          // log2(payload bytes)
+  double costUs = 0.0;     // measured mean latency, microseconds
+};
+
+class TuningTable {
+ public:
+  // Adds a cell; a later add with the same key overwrites the cost.
+  void add(const Measurement& m);
+
+  // Elect the cheapest algorithm for a payload of `nbytes`. Each
+  // candidate's cost curve is interpolated linearly in log2-size space
+  // between its measured buckets (the "interpolated crossover": where two
+  // curves cross between buckets, the winner flips there, not at a bucket
+  // edge), clamped flat outside the swept range. Only algorithms in
+  // `allowed` participate (dispatch excludes opt-in variants like
+  // bf16-wire whose numerics differ). An empty `dtype` matches any; a
+  // non-empty dtype falls back to ignoring dtype when it has no exact
+  // entries (size, not element width, dominates the crossovers — re-tune
+  // with that dtype to specialize). Returns nullopt when the table holds
+  // no candidate for (collective, worldSize).
+  std::optional<std::string> choose(
+      const std::string& collective, int worldSize, const std::string& dtype,
+      size_t nbytes, const std::vector<std::string>& allowed) const;
+
+  // Interpolated cost of one algorithm at `nbytes`; nullopt if the
+  // algorithm has no measurements for the key. Same dtype semantics as
+  // choose().
+  std::optional<double> cost(const std::string& collective,
+                             const std::string& algorithm, int worldSize,
+                             const std::string& dtype, size_t nbytes) const;
+
+  bool empty() const { return cells_.empty(); }
+  size_t size() const { return cells_.size(); }
+  std::vector<Measurement> measurements() const;
+
+  // JSON round trip. The serialized form is the interchange format:
+  // {"version": 1, "entries": [{"collective", "algorithm", "world_size",
+  // "dtype", "bucket", "cost_us"}, ...]}, entries sorted by key so equal
+  // tables serialize byte-identically (the rank-agreement check is a
+  // string compare). fromJson throws EnforceError on malformed input —
+  // a corrupt table file must fail loudly, never install as empty.
+  std::string toJson() const;
+  static TuningTable fromJson(const std::string& json);
+
+ private:
+  struct Key {
+    std::string collective;
+    std::string algorithm;
+    int worldSize;
+    std::string dtype;
+    bool operator<(const Key& o) const {
+      if (collective != o.collective) return collective < o.collective;
+      if (algorithm != o.algorithm) return algorithm < o.algorithm;
+      if (worldSize != o.worldSize) return worldSize < o.worldSize;
+      return dtype < o.dtype;
+    }
+  };
+  // bucket -> costUs, ordered for interpolation.
+  using Curve = std::map<int, double>;
+
+  std::optional<double> curveCost(const Curve& curve, double x) const;
+
+  std::map<Key, Curve> cells_;
+};
+
+// log2 size bucket of a payload (floor; nbytes 0 maps to bucket 0).
+int sizeBucket(size_t nbytes);
+
+}  // namespace tuning
+}  // namespace tpucoll

@@ -124,6 +124,13 @@ _PROTOTYPES = {
     "tc_metrics_set_watchdog": (None, [_c, _i64]),
     "tc_metrics_json": (_int, [_c, _int, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # collective autotuning plane
+    "tc_tune": (_int, [_c, _sz, _sz, _int, _int, _u32, _i64,
+                       ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                       ctypes.POINTER(_sz)]),
+    "tc_tuning_install": (_int, [_c, ctypes.c_char_p]),
+    "tc_tuning_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # collectives
     "tc_barrier": (_int, [_c, _u32, _i64]),
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),

@@ -657,7 +657,8 @@ class Context:
 
     _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
                    "bcube": 3, "ring_bf16_wire": 4,
-                   "recursive_doubling": 5, "rd": 5}
+                   "recursive_doubling": 5, "rd": 5,
+                   "hd_fold": 6, "hd_blocks": 7}
     _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
@@ -665,15 +666,26 @@ class Context:
                   timeout: Optional[float] = None) -> np.ndarray:
         """In-place allreduce of `array` across the group.
 
-        algorithm: "auto" (recursive doubling for tiny payloads,
-        halving-doubling through ~1 MiB, ring beyond; crossovers
-        TPUCOLL_ALLREDUCE_RD_MAX / TPUCOLL_ALLREDUCE_HD_MAX), "ring",
-        "halving_doubling" ("hd"), "recursive_doubling" ("rd";
-        non-power-of-2 groups take a pre/post fold), "bcube", or
-        "ring_bf16_wire".
+        algorithm: "auto" consults the installed tuning table first
+        (gloo_tpu.tuning: measured per-deployment crossovers), falling
+        back to the built-in thresholds (recursive doubling for tiny
+        payloads, halving-doubling through ~1 MiB, ring beyond;
+        crossovers TPUCOLL_ALLREDUCE_RD_MAX / TPUCOLL_ALLREDUCE_HD_MAX).
+        Explicit choices: "ring", "halving_doubling" ("hd"),
+        "recursive_doubling" ("rd"; non-power-of-2 groups take a
+        pre/post fold), "hd_fold" / "hd_blocks" (the halving-doubling
+        non-power-of-2 sub-variants), "bcube", or "ring_bf16_wire".
 
         op may also be a callable `fn(acc, inp)` combining two numpy views
         in place into acc (see _wrap_reduce_fn for the contract).
+
+        Error contract: the reduction runs IN PLACE, so if the call
+        raises (timeout, peer failure, AEAD verification failure on an
+        encrypted transport), the contents of `array` are UNDEFINED —
+        arbitrary mixtures of local, partially-folded, and peer data.
+        The context is poisoned; rebuild it and restore `array` from
+        the application's own copy before retrying (docs/errors.md,
+        "In-place collectives").
         """
         _check_array(array)
         if callable(op):
@@ -698,7 +710,8 @@ class Context:
         """Allreduce N local buffers together (the reference's multi-input
         form for one-process-per-host, N-accelerator setups: local
         reduction first, one network pass, result fanned to every
-        buffer). In-place on all arrays."""
+        buffer). In-place on all arrays; on error their contents are
+        undefined, exactly as for allreduce()."""
         arrays = [_check_array(a) for a in arrays]
         if not arrays:
             raise Error("allreduce_multi needs at least one array")
@@ -729,9 +742,17 @@ class Context:
                timeout: Optional[float] = None) -> Optional[np.ndarray]:
         """Reduce to `root`. Returns the result array on root, else None.
 
-        algorithm: "auto" (binomial tree for small payloads, pipelined
-        ring reduce-scatter + chunk gather for large; crossover via
+        algorithm: "auto" (the installed tuning table when present, else
+        binomial tree for small payloads, pipelined ring reduce-scatter
+        + chunk gather for large; fallback crossover via
         TPUCOLL_REDUCE_BINOMIAL_MAX), "binomial", or "ring".
+
+        Error contract: if the call raises, the contents of `output` (on
+        root) are undefined — the schedules fold partner contributions
+        into it in place, including transport-fused receive-reduce that
+        may have partially folded when an encrypted frame fails AEAD
+        verification. Rebuild the context and retry from application
+        state (docs/errors.md, "In-place collectives").
         """
         _check_array(array)
         algo = self._REDUCE_ALGORITHMS[algorithm]
@@ -861,12 +882,15 @@ class Context:
                        timeout: Optional[float] = None) -> np.ndarray:
         """Reduce then scatter per-rank blocks.
 
-        algorithm: "auto" (recursive halving for small payloads, ring
-        for bulk; crossover via TPUCOLL_RS_HD_MAX=256K), "direct" (one
-        network round, P-1 concurrent transfers — auto only picks it
-        when TPUCOLL_RS_DIRECT_MAX is raised from its default 0; meant
-        for real DCN, it loses on shared-core loopback),
-        "halving_doubling"/"hd", or "ring".
+        algorithm: "auto" (the installed tuning table when present, else
+        recursive halving for small payloads, ring for bulk; fallback
+        crossover via TPUCOLL_RS_HD_MAX=256K), "direct" (one network
+        round, P-1 concurrent transfers — the untuned fallback only
+        picks it when TPUCOLL_RS_DIRECT_MAX is raised from its default
+        0; meant for real DCN, it loses on shared-core loopback, and a
+        tuned table elects it from measurement), "halving_doubling"/
+        "hd", or "ring". On error the returned array's contents are
+        undefined (in-place folds; docs/errors.md).
         """
         _check_array(array)
         algo = self._RS_ALGORITHMS[algorithm]

@@ -169,7 +169,13 @@ def use_fused_overlap(m: int, k: int, cols: int, axis_size: int,
     "Overlap kernels" — and a measured slow draw should fall back to
     unfused even where the model would fuse).
     TPUCOLL_TP_OVERLAP=fused|unfused forces either way (auto/unset =
-    decide); anything else raises."""
+    decide); anything else raises.
+
+    CAUTION: the env var is read at TRACE time. A jitted caller bakes
+    the decision into its compiled computation, so flipping
+    TPUCOLL_TP_OVERLAP after the first call has NO effect on already-
+    traced shapes — re-jit the function or call jax.clear_caches() to
+    make a new setting take effect."""
     mode = os.environ.get("TPUCOLL_TP_OVERLAP", "auto")
     if mode == "fused":
         return True
@@ -316,7 +322,11 @@ def row_parallel_dense_scattered_auto(x_shard, w_shard, axis: str,
     hiding the collective pays for the chunking cost, else the plain
     dot + explicit reduce-scatter (identical semantics: [m/P, cols]
     row-scattered output). Pass ratio from measure_fused_ratio() to
-    dispatch on this process's measured compile draw."""
+    dispatch on this process's measured compile draw.
+
+    The dispatch (including its TPUCOLL_TP_OVERLAP override) happens at
+    trace time: under jit, a traced shape keeps whichever branch it was
+    compiled with until the caller re-jits or runs jax.clear_caches()."""
     m, k = x_shard.shape
     cols = w_shard.shape[1]
     p = spmd.size(axis)
@@ -344,7 +354,11 @@ def allgather_matmul_dense_auto(x_rows_shard, w, axis: str,
     measure_fused_ratio(rows * axis_size, k, axis_size) — the kernel
     gathers the FULL [rows*P, k] input, so the probe's m is the total
     rows, not this shard's (unlike the reduce-scatter dual, whose m is
-    the local shard's rows)."""
+    the local shard's rows).
+
+    As with the reduce-scatter dual, the fused/unfused choice (and any
+    TPUCOLL_TP_OVERLAP override) is captured at trace time — changing
+    the env var needs a re-jit or jax.clear_caches() to take effect."""
     rows, k = x_rows_shard.shape
     cols = w.shape[1]
     p = spmd.size(axis)

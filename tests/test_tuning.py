@@ -1,0 +1,334 @@
+"""Collective autotuning plane: table JSON round trips, kAuto dispatch
+consulting the installed table (and keeping today's threshold behavior
+when untuned), the TPUCOLL_TUNING_FILE hook, the tuner smoke, and
+rank-consistency of the elected table across a real multiprocess group.
+
+Dispatch decisions are asserted through the tracer: every allreduce /
+reduce span records the algorithm that actually ran in its `detail`
+arg, so these tests observe the native dispatcher itself, not a Python
+re-implementation of it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import tuning
+from tests.harness import spawn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _table(entries):
+    return {"version": 1, "entries": entries}
+
+
+def _entry(collective, algorithm, bucket, cost_us, world_size=2,
+           dtype="float32"):
+    return {"collective": collective, "algorithm": algorithm,
+            "world_size": world_size, "dtype": dtype, "bucket": bucket,
+            "cost_us": cost_us}
+
+
+def _spans(events, name):
+    """Trace-span details (algorithm names) for collective `name`.
+    `events` is a parsed trace (trace_json DRAINS — fetch it once)."""
+    return [e["args"].get("detail") for e in events if e["name"] == name]
+
+
+# ---- table JSON round trip (no group needed: install is per-rank) ----
+
+
+def test_table_json_roundtrip(tmp_path):
+    table = _table([
+        _entry("allreduce", "ring", 20, 1500.0),
+        _entry("allreduce", "ring", 10, 80.5),
+        _entry("allreduce", "halving_doubling", 10, 40.25),
+        _entry("reduce", "binomial", 14, 200.0),
+        _entry("reduce_scatter", "direct", 12, 55.125, world_size=4),
+    ])
+    path = os.path.join(tmp_path, "table.json")
+    tuning.save_table(table, path)
+    loaded = tuning.load_table(path)
+    assert loaded == table
+
+    ctx = gloo_tpu.Context(0, 2)  # install needs no transport
+    assert tuning.installed_table(ctx) is None
+    tuning.install_table(ctx, loaded)
+    got = tuning.installed_table(ctx)
+    # The native table canonicalizes entry order; compare as sets.
+    key = lambda e: (e["collective"], e["algorithm"], e["world_size"],
+                     e["dtype"], e["bucket"])
+    assert sorted(got["entries"], key=key) == sorted(table["entries"],
+                                                     key=key)
+    for mine, theirs in zip(sorted(got["entries"], key=key),
+                            sorted(table["entries"], key=key)):
+        assert mine["cost_us"] == pytest.approx(theirs["cost_us"])
+    # Native serialization is canonical: a second round trip through the
+    # core is byte-stable (the rank-agreement check is a string compare).
+    tuning.install_table(ctx, got)
+    assert tuning.installed_table(ctx) == got
+
+    tuning.clear_table(ctx)
+    assert tuning.installed_table(ctx) is None
+
+
+def test_malformed_table_raises():
+    ctx = gloo_tpu.Context(0, 2)
+    with pytest.raises(gloo_tpu.Error):
+        tuning.install_table(ctx, "{not json")
+    with pytest.raises(gloo_tpu.Error):
+        tuning.install_table(ctx, {"version": 99, "entries": []})
+    with pytest.raises(gloo_tpu.Error):
+        tuning.install_table(ctx, _table([
+            _entry("allreduce", "ring", 10, -5.0)]))  # negative cost
+    assert tuning.installed_table(ctx) is None
+
+
+# ---- fallback: untuned contexts keep today's threshold behavior ----
+
+
+def test_untuned_dispatch_keeps_default_thresholds():
+    """With no table installed, kAuto must follow the historical
+    constants: allreduce rd <= 16K < hd <= 1M < ring; reduce binomial
+    <= 2M < ring."""
+    def fn(ctx, rank):
+        assert tuning.installed_table(ctx) is None
+        ctx.trace_start()
+        ctx.allreduce(np.zeros(1024, dtype=np.float32))       # 4K -> rd
+        ctx.allreduce(np.zeros(128 * 1024, dtype=np.float32)) # 512K -> hd
+        ctx.allreduce(np.zeros(512 * 1024, dtype=np.float32)) # 2M -> ring
+        ctx.reduce(np.zeros(1024, dtype=np.float32))          # binomial
+        ctx.reduce(np.zeros(1024 * 1024, dtype=np.float32))   # 4M -> ring
+        events = json.loads(ctx.trace_json())
+        algos = _spans(events, "allreduce")
+        reduces = _spans(events, "reduce")
+        ctx.trace_stop()
+        assert algos == ["recursive_doubling", "halving_doubling", "ring"], \
+            algos
+        assert reduces == ["binomial", "ring"], reduces
+
+    spawn(2, fn)
+
+
+def test_installed_table_overrides_thresholds():
+    """A table that prices ring cheapest at small sizes must flip kAuto
+    to ring where the default thresholds would pick rd/hd — and
+    clear_table must restore the default choice."""
+    table = _table([
+        # ring "measured" cheapest across the whole range...
+        _entry("allreduce", "ring", 10, 10.0),
+        _entry("allreduce", "ring", 22, 10.0),
+        # ...and the competitors expensive.
+        _entry("allreduce", "recursive_doubling", 10, 900.0),
+        _entry("allreduce", "recursive_doubling", 22, 900.0),
+        _entry("allreduce", "halving_doubling", 10, 900.0),
+        _entry("allreduce", "halving_doubling", 22, 900.0),
+        # reduce: invert the default (ring for tiny payloads).
+        _entry("reduce", "ring", 10, 10.0),
+        _entry("reduce", "binomial", 10, 900.0),
+    ])
+
+    def fn(ctx, rank):
+        tuning.install_table(ctx, table)
+        ctx.trace_start()
+        x = np.zeros(1024, dtype=np.float32)  # 4K: default would pick rd
+        ctx.allreduce(x)
+        ctx.reduce(np.zeros(1024, dtype=np.float32))  # default: binomial
+        tuning.clear_table(ctx)
+        ctx.allreduce(x)  # back to the default choice
+        events = json.loads(ctx.trace_json())
+        algos = _spans(events, "allreduce")
+        reduces = _spans(events, "reduce")
+        ctx.trace_stop()
+        assert algos == ["ring", "recursive_doubling"], algos
+        assert reduces == ["ring"], reduces
+
+    spawn(2, fn)
+
+
+def test_table_interpolates_crossover_between_buckets():
+    """Cost curves cross BETWEEN measured buckets: ring is priced cheaper
+    at bucket 10 (1K), hd cheaper at bucket 20 (1M); linear-in-log2
+    interpolation puts the crossover at bucket 15, so 16K (bucket 14)
+    must still elect ring and 128K (bucket 17) hd."""
+    table = _table([
+        _entry("allreduce", "ring", 10, 100.0),
+        _entry("allreduce", "ring", 20, 600.0),
+        _entry("allreduce", "halving_doubling", 10, 200.0),
+        _entry("allreduce", "halving_doubling", 20, 500.0),
+    ])
+
+    def fn(ctx, rank):
+        tuning.install_table(ctx, table)
+        ctx.trace_start()
+        ctx.allreduce(np.zeros(4 * 1024, dtype=np.float32))   # 16K
+        ctx.allreduce(np.zeros(32 * 1024, dtype=np.float32))  # 128K
+        algos = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        assert algos == ["ring", "halving_doubling"], algos
+
+    spawn(2, fn)
+
+
+# ---- TPUCOLL_TUNING_FILE env hook ----
+
+
+def test_tuning_file_env_hook(tmp_path):
+    path = os.path.join(tmp_path, "env_table.json")
+    tuning.save_table(_table([
+        _entry("allreduce", "ring", 10, 1.0),
+        _entry("allreduce", "recursive_doubling", 10, 900.0),
+        _entry("allreduce", "halving_doubling", 10, 900.0),
+    ]), path)
+
+    def fn(ctx, rank):
+        got = tuning.installed_table(ctx)
+        assert got is not None and len(got["entries"]) == 3
+        ctx.trace_start()
+        ctx.allreduce(np.zeros(1024, dtype=np.float32))
+        algos = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        assert algos == ["ring"], algos
+
+    os.environ["TPUCOLL_TUNING_FILE"] = path
+    try:
+        spawn(2, fn)
+    finally:
+        del os.environ["TPUCOLL_TUNING_FILE"]
+
+
+def test_tuning_file_env_hook_malformed_fails_loudly(tmp_path):
+    path = os.path.join(tmp_path, "bad_table.json")
+    with open(path, "w") as f:
+        f.write("{definitely not a table")
+
+    os.environ["TPUCOLL_TUNING_FILE"] = path
+    try:
+        with pytest.raises(AssertionError, match="JSON"):
+            # connect_full_mesh must throw, not silently run untuned
+            # (spawn wraps each rank's failure in AssertionError).
+            spawn(2, lambda ctx, rank: None)
+    finally:
+        del os.environ["TPUCOLL_TUNING_FILE"]
+
+
+# ---- tuner smoke: tiny sizes, in-process group ----
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_tune_smoke_rank_consistent(size):
+    """tune() at tiny sizes: all ranks install byte-identical tables,
+    the table covers the swept collectives (including the np2 hd_fold /
+    hd_blocks arms at P=3), and collectives still verify afterwards."""
+    def fn(ctx, rank):
+        table = tuning.tune(ctx, min_bytes=4096, max_bytes=16384, iters=2,
+                            warmup=1)
+        x = np.full(256, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x)  # dispatches off the fresh table
+        expected = sum(range(1, size + 1))
+        np.testing.assert_allclose(x, expected)
+        return json.dumps(table, sort_keys=True)
+
+    results = spawn(size, fn, timeout=120, context_timeout=60)
+    assert len(set(results)) == 1, "ranks elected different tables"
+    table = json.loads(results[0])
+    entries = table["entries"]
+    assert entries, "tuner produced an empty table"
+    assert all(e["world_size"] == size for e in entries)
+    collectives = {e["collective"] for e in entries}
+    assert collectives == {"allreduce", "reduce", "reduce_scatter"}
+    algos = {e["algorithm"] for e in entries}
+    if size == 3:  # non-power-of-2: both hd sub-variants swept
+        assert {"hd_fold", "hd_blocks"} <= algos, algos
+    else:
+        assert "halving_doubling" in algos, algos
+    buckets = {e["bucket"] for e in entries}
+    assert buckets == {12, 13, 14}, buckets
+
+
+def test_tune_single_rank_installs_empty_table():
+    def fn(ctx, rank):
+        table = tuning.tune(ctx)
+        assert table["entries"] == []
+        # Untuned fallback still drives dispatch.
+        x = np.ones(16, dtype=np.float32)
+        ctx.allreduce(x)
+        np.testing.assert_allclose(x, 1.0)
+
+    spawn(1, fn)
+
+
+def test_tune_on_forked_context_broadcast_election():
+    """Forked contexts have no rendezvous store; the election must ride
+    the context's own broadcast instead."""
+    def fn(ctx, rank):
+        child = ctx.fork()
+        table = tuning.tune(child, min_bytes=4096, max_bytes=8192, iters=2,
+                            warmup=0)
+        assert table["entries"]
+        return json.dumps(table, sort_keys=True)
+
+    results = spawn(2, fn, timeout=120, context_timeout=60)
+    assert len(set(results)) == 1
+
+
+# ---- multiprocess rank consistency (the deployment shape) ----
+
+
+_MP_WORKER = """
+import hashlib, json, os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import gloo_tpu
+from gloo_tpu import tuning
+
+rank = {rank}; size = {size}
+store = gloo_tpu.FileStore({store!r})
+ctx = gloo_tpu.Context(rank, size, timeout=60.0)
+ctx.connect_full_mesh(store, gloo_tpu.Device())
+table = tuning.tune(ctx, min_bytes=4096, max_bytes=16384, iters=2,
+                    warmup=1)
+blob = json.dumps(tuning.installed_table(ctx), sort_keys=True)
+print("TABLEHASH", hashlib.sha256(blob.encode()).hexdigest())
+print("ENTRIES", len(table["entries"]))
+x = np.full(1024, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x)
+assert x[0] == sum(range(1, size + 1)), x[0]
+ctx.barrier()
+ctx.close()
+print("WORKER-OK")
+"""
+
+
+def test_tune_multiprocess_rank_consistency():
+    """Real child processes over a FileStore (the deployment shape):
+    every rank's installed table must hash identically — the store-
+    published rank-0 election, not per-rank measurements."""
+    size = 2
+    store = tempfile.mkdtemp(prefix="tctune-")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         textwrap.dedent(_MP_WORKER).format(repo=_REPO, rank=r, size=size,
+                                            store=store)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(size)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, (out[-1500:], err[-1500:])
+        assert "WORKER-OK" in out
+    hashes = set()
+    for out, _ in outs:
+        line = [l for l in out.splitlines() if l.startswith("TABLEHASH")]
+        assert line, out
+        hashes.add(line[0].split()[1])
+        entries = [l for l in out.splitlines() if l.startswith("ENTRIES")]
+        assert int(entries[0].split()[1]) > 0
+    assert len(hashes) == 1, f"ranks installed different tables: {hashes}"

@@ -74,8 +74,14 @@ def run_cell(op, elements, ranks, plane, engine, min_time):
         return {"p50_us": d["p50_us"], "p99_us": d["p99_us"],
                 "min_us": d["min_us"], "algbw_gbps": d["algbw_gbps"],
                 "iters": d["iters"]}
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError,
-            KeyError) as exc:
+    except subprocess.TimeoutExpired as exc:
+        # Structured kind, not just prose: the rep loop branches on this
+        # flag (substring-matching "Timeout" in a truncated message was
+        # fragile — the type name can be cut off at the 200-char cap or
+        # appear inside an unrelated worker error).
+        return {"error": f"{type(exc).__name__}: {exc}"[:200],
+                "timeout": True}
+    except (json.JSONDecodeError, IndexError, KeyError) as exc:
         return {"error": f"{type(exc).__name__}: {exc}"[:200]}
     finally:
         for p in procs:
@@ -125,7 +131,7 @@ def main():
                             r = run_cell(op, elements, ranks, plane,
                                          engine, min_time)
                             runs.append(r)
-                            if "Timeout" in str(r.get("error", "")):
+                            if r.get("timeout"):
                                 # A 120s timeout is a hang (cells run
                                 # 0.5-2s), not a transient: don't burn
                                 # reps x 2min on a dead config.
