@@ -4,8 +4,13 @@
 # checks, separate bench/test binaries); falls back to a plain
 # compiler-driver build of just libtpucoll.so when cmake is not
 # installed, so `pip install .` / `make native` work on minimal images.
+# SANITIZE=address|thread always takes the fallback path: sanitizer
+# flavors are a test-rig artifact of this cmake-less build (the cmake
+# build has TPUCOLL_OUTPUT_DIR for the same isolation).
 native:
-	@if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then \
+	@if [ -n "$(SANITIZE)" ]; then \
+		$(MAKE) -j$$(nproc) native-cc; \
+	elif command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then \
 		$(MAKE) native-cmake; \
 	else \
 		$(MAKE) -j$$(nproc) native-cc; \
@@ -17,14 +22,37 @@ native-cmake:
 
 # ---- fallback build (no cmake): mirrors csrc/CMakeLists.txt ----
 CXX ?= g++
-FB_BUILD := build-fb
+
+# Sanitizer flavors: `make SANITIZE=address` (or thread) compiles the
+# whole core with -fsanitize=... into its own build dir and a SUFFIXED
+# library (libtpucoll_asan.so / libtpucoll_tsan.so) so instrumented
+# builds never clobber — or get clobbered by — the production .so.
+# Run the Python suite against one with
+#   TPUCOLL_LIB=$PWD/gloo_tpu/_native/libtpucoll_asan.so \
+#   TPUCOLL_SKIP_BUILD=1 python -m pytest tests/ ...
+# (tests/test_native_unit.py has a skip-unless-built ASan smoke test).
+SAN_SUFFIX :=
+SAN_FLAGS :=
+ifeq ($(SANITIZE),address)
+SAN_SUFFIX := _asan
+SAN_FLAGS := -fsanitize=address -fno-omit-frame-pointer
+else ifeq ($(SANITIZE),thread)
+SAN_SUFFIX := _tsan
+SAN_FLAGS := -fsanitize=thread -fno-omit-frame-pointer
+else ifneq ($(SANITIZE),)
+$(error SANITIZE must be 'address' or 'thread', got '$(SANITIZE)')
+endif
+
+FB_BUILD := build-fb$(subst _,-,$(SAN_SUFFIX))
+FB_LIB := gloo_tpu/_native/libtpucoll$(SAN_SUFFIX).so
 FB_SRCS := $(filter-out csrc/tpucoll/common/crypto_avx512.cc,\
 	$(wildcard csrc/tpucoll/*.cc csrc/tpucoll/*/*.cc))
 FB_OBJS := $(patsubst csrc/%.cc,$(FB_BUILD)/%.o,$(FB_SRCS))
 # -MMD/-MP: header dependency tracking, so editing a .h rebuilds the
 # objects that include it (cmake gets this for free; the fallback must
 # not silently package a stale .so after header edits).
-FB_FLAGS := -std=c++17 -O3 -g -fPIC -Wall -Wextra -Icsrc -pthread -MMD -MP
+FB_FLAGS := -std=c++17 -O3 -g -fPIC -Wall -Wextra -Icsrc -pthread -MMD -MP \
+	$(SAN_FLAGS)
 
 ARCH := $(shell uname -m)
 ifeq ($(ARCH),x86_64)
@@ -39,11 +67,11 @@ FB_FLAGS += -DTPUCOLL_HAVE_AVX512=1
 FB_OBJS += $(FB_BUILD)/tpucoll/common/crypto_avx512.o
 endif
 
-native-cc: gloo_tpu/_native/libtpucoll.so
+native-cc: $(FB_LIB)
 
-gloo_tpu/_native/libtpucoll.so: $(FB_OBJS)
+$(FB_LIB): $(FB_OBJS)
 	@mkdir -p gloo_tpu/_native
-	$(CXX) -shared -o $@ $(FB_OBJS) -lpthread -lrt
+	$(CXX) -shared $(SAN_FLAGS) -o $@ $(FB_OBJS) -lpthread -lrt
 
 $(FB_BUILD)/tpucoll/common/crypto_avx512.o: \
 		csrc/tpucoll/common/crypto_avx512.cc
@@ -60,4 +88,4 @@ test: native
 	python -m pytest tests/ -x -q
 
 clean:
-	rm -rf build $(FB_BUILD) gloo_tpu/_native/*.so
+	rm -rf build build-fb build-fb-asan build-fb-tsan gloo_tpu/_native/*.so

@@ -223,7 +223,123 @@ def bench_autotune(quick=False, out_path=None):
     print(json.dumps(line))
 
 
+def bench_chaos_soak(seconds):
+    """--chaos-soak N: run a mixed collective/p2p workload for N seconds
+    with a low-rate delay/dup fault schedule installed (the soak-mode
+    face of the fault plane, docs/faults.md), verifying every result
+    against its closed form. Prints ONE JSON line:
+
+      {"metric": "chaos_soak_2rank_host", "value": <ops completed>,
+       "unit": "ops", "seconds": N, "faults": <faults injected>,
+       "faults_by_action": {...}, "ok": true}
+
+    A wrong value or a hang is a failure; the point is that a transport
+    under continuous low-rate fault pressure stays correct, not fast.
+    """
+    import numpy as np
+
+    import gloo_tpu
+    from gloo_tpu import fault
+
+    fault.install({"seed": 0xC405, "faults": [
+        {"when": {"opcode": "data", "min_bytes": 1},
+         "action": "delay", "ms": 1, "prob": 0.02},
+        {"when": {"opcode": "data", "min_bytes": 1},
+         "action": "dup", "prob": 0.01},
+    ]})
+    store = gloo_tpu.HashStore()
+    ops_out = [0]
+    errors = []
+    deadline = time.monotonic() + seconds
+
+    def guarded(rank):
+        try:
+            worker(rank)
+        except BaseException as exc:  # noqa: BLE001 — soak must report it
+            errors.append((rank, repr(exc)))
+
+    def worker(rank):
+        import numpy as np
+
+        device = gloo_tpu.Device()
+        ctx = gloo_tpu.Context(rank, 2, timeout=60)
+        ctx.connect_full_mesh(store, device)
+        ops = 0
+        i = 0
+        while True:
+            # Rank 0 owns the clock; the decision rides an allreduce so
+            # both ranks always agree on the iteration count. Tags and
+            # slots are unique per iteration — the dup-tolerance rule
+            # (docs/faults.md) — so a stale duplicate can never match a
+            # later operation.
+            flag = np.array(
+                [1.0 if rank != 0 or time.monotonic() < deadline
+                 else 0.0], dtype=np.float32)
+            ctx.allreduce(flag, op="min", tag=4 * i)
+            if flag[0] < 1.0:
+                break
+            n = 256 + (i * 97) % 4096
+            x = np.full(n, float(rank + 1 + i), dtype=np.float32)
+            ctx.allreduce(x, tag=4 * i + 1)
+            assert x[0] == 2 * i + 3, (i, x[0])
+            g = ctx.allgather(np.full(64, float(rank + i), np.float64),
+                              tag=4 * i + 2)
+            assert g[0][0] == float(i) and g[1][0] == float(1 + i), g
+            y = np.arange(n, dtype=np.float64) * (rank + 1)
+            out = np.zeros(n, dtype=np.float64)
+            ctx.send(y, dst=1 - rank, slot=10_000 + 2 * i + rank)
+            ctx.recv(out, src=1 - rank, slot=10_000 + 2 * i + (1 - rank))
+            assert out[1] == float(2 - rank), (i, out[1])
+            ops += 4
+            i += 1
+        ctx.barrier(tag=1)
+        if rank == 0:
+            ops_out[0] = ops
+        ctx.close()
+
+    # Daemon threads: the "soak hung" branch must actually exit 1 —
+    # interpreter shutdown would otherwise block forever joining the
+    # still-alive worker.
+    threads = [threading.Thread(target=guarded, args=(r,), daemon=True)
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(max(seconds * 10, 120))
+        if t.is_alive():
+            print(json.dumps({"metric": "chaos_soak_2rank_host",
+                              "ok": False, "error": "soak hung"}))
+            sys.exit(1)
+    if errors:
+        # A wrong value under fault pressure is the bug this soak
+        # exists to catch — it must never report ok.
+        print(json.dumps({"metric": "chaos_soak_2rank_host",
+                          "ok": False,
+                          "error": [f"rank {r}: {e}" for r, e in errors]}))
+        sys.exit(1)
+    fired = fault.report()
+    fault.clear()
+    by_action = {}
+    for e in fired:
+        by_action[e["action"]] = by_action.get(e["action"], 0) + 1
+    print(json.dumps({
+        "metric": "chaos_soak_2rank_host",
+        "value": ops_out[0],
+        "unit": "ops",
+        "seconds": seconds,
+        "faults": len(fired),
+        "faults_by_action": by_action,
+        "ok": True,
+    }))
+
+
 def main():
+    if "--chaos-soak" in sys.argv[1:]:
+        i = sys.argv.index("--chaos-soak") + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            sys.exit("--chaos-soak requires a duration (seconds)")
+        bench_chaos_soak(float(sys.argv[i]))
+        return
     if "--autotune" in sys.argv[1:]:
         out = None
         if "--autotune-out" in sys.argv[1:]:

@@ -13,6 +13,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
+#include "tpucoll/fault/fault.h"
 #include "tpucoll/transport/loop_uring.h"
 #include "tpucoll/transport/wire.h"
 #include "tpucoll/common/crypto.h"
@@ -421,6 +422,31 @@ int tc_tuning_json(void* ctx, uint8_t** out, size_t* outLen) {
     copyOut(table != nullptr ? table->toJson() : std::string(), out,
             outLen);
   });
+}
+
+// ---- deterministic fault-injection plane (fault/) ----
+
+// Install a fault schedule (JSON, docs/faults.md) for THIS process,
+// replacing any previous one and resetting the firing report. The table
+// is process-global: rules pin the injecting `rank` so several
+// in-process ranks can share it. Returns TC_ERR on malformed input.
+int tc_fault_install(const char* json) {
+  return wrap([&] {
+    TC_ENFORCE(json != nullptr && json[0] != '\0',
+               "tc_fault_install: empty schedule (use tc_fault_clear)");
+    tpucoll::fault::install(json);
+  });
+}
+
+// Remove the installed schedule; the transport hot path returns to its
+// single armed() pointer check costing nothing.
+void tc_fault_clear() { tpucoll::fault::clear(); }
+
+// Deterministic firing log as a JSON array (malloc'd; free with
+// tc_buf_free). Same seed + schedule + per-rank workload => the
+// per-rank subsequences are byte-identical across runs.
+int tc_fault_report(uint8_t** out, size_t* outLen) {
+  return wrap([&] { copyOut(tpucoll::fault::report(), out, outLen); });
 }
 
 // ---- collectives ----

@@ -1,0 +1,295 @@
+// Minimal JSON reader + string writer shared by the core's JSON surfaces.
+//
+// Grew out of the tuning table (tuning/tuning_table.cc): objects, arrays,
+// strings with the common escapes, numbers, bools, null — everything the
+// interchange formats the core must *read* (tuning tables, fault
+// schedules) use, and nothing more. A dependency-free ~150-line
+// recursive-descent parser beats gating those features on a JSON library
+// the container doesn't ship. The `what` label prefixes every error so a
+// malformed tuning table and a malformed fault schedule fail with their
+// own names.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+class JsonReader {
+ public:
+  explicit JsonReader(const std::string& text, const char* what = "JSON")
+      : text_(text), what_(what) {}
+
+  // Parsed value: exactly one of the members is active, by `kind`.
+  struct Value {
+    enum class Kind { kNull, kBool, kNumber, kString, kArray, kObject };
+    Kind kind = Kind::kNull;
+    bool boolean = false;
+    double number = 0.0;
+    std::string str;
+    std::vector<Value> items;
+    std::vector<std::pair<std::string, Value>> fields;
+
+    const Value* field(const std::string& name) const {
+      for (const auto& f : fields) {
+        if (f.first == name) {
+          return &f.second;
+        }
+      }
+      return nullptr;
+    }
+  };
+
+  Value parse() {
+    Value v = parseValue();
+    skipWs();
+    TC_ENFORCE_EQ(pos_, text_.size(), what_, ": trailing bytes");
+    return v;
+  }
+
+ private:
+  void skipWs() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_]))) {
+      pos_++;
+    }
+  }
+
+  char peek() {
+    skipWs();
+    TC_ENFORCE(pos_ < text_.size(), what_, ": unexpected end");
+    return text_[pos_];
+  }
+
+  void expect(char c) {
+    TC_ENFORCE(peek() == c, what_, ": expected '", c, "' at byte ", pos_);
+    pos_++;
+  }
+
+  bool consume(char c) {
+    if (pos_ < text_.size() && peek() == c) {
+      pos_++;
+      return true;
+    }
+    return false;
+  }
+
+  Value parseValue() {
+    const char c = peek();
+    if (c == '{') return parseObject();
+    if (c == '[') return parseArray();
+    if (c == '"') {
+      Value v;
+      v.kind = Value::Kind::kString;
+      v.str = parseString();
+      return v;
+    }
+    if (c == 't' || c == 'f') return parseLiteralBool();
+    if (c == 'n') {
+      expectWord("null");
+      return Value{};
+    }
+    return parseNumber();
+  }
+
+  void expectWord(const char* w) {
+    skipWs();
+    for (const char* p = w; *p != '\0'; p++) {
+      TC_ENFORCE(pos_ < text_.size() && text_[pos_] == *p, what_,
+                 ": bad literal at byte ", pos_);
+      pos_++;
+    }
+  }
+
+  Value parseLiteralBool() {
+    Value v;
+    v.kind = Value::Kind::kBool;
+    if (peek() == 't') {
+      expectWord("true");
+      v.boolean = true;
+    } else {
+      expectWord("false");
+      v.boolean = false;
+    }
+    return v;
+  }
+
+  // Hand-rolled, locale-independent number scan: JSON numbers are
+  // always dot-decimal, but std::stod honors LC_NUMERIC — in a
+  // comma-decimal locale it would silently truncate "40.25" to 40.
+  Value parseNumber() {
+    skipWs();
+    const size_t start = pos_;
+    bool negative = false;
+    if (pos_ < text_.size() &&
+        (text_[pos_] == '-' || text_[pos_] == '+')) {
+      negative = text_[pos_] == '-';
+      pos_++;
+    }
+    bool anyDigit = false;
+    double mantissa = 0.0;
+    while (pos_ < text_.size() &&
+           std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+      mantissa = mantissa * 10.0 + (text_[pos_] - '0');
+      anyDigit = true;
+      pos_++;
+    }
+    if (pos_ < text_.size() && text_[pos_] == '.') {
+      pos_++;
+      double place = 0.1;
+      while (pos_ < text_.size() &&
+             std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+        mantissa += (text_[pos_] - '0') * place;
+        place *= 0.1;
+        anyDigit = true;
+        pos_++;
+      }
+    }
+    TC_ENFORCE(anyDigit, what_, ": expected number at byte ", start);
+    int exponent = 0;
+    if (pos_ < text_.size() && (text_[pos_] == 'e' || text_[pos_] == 'E')) {
+      pos_++;
+      bool expNegative = false;
+      if (pos_ < text_.size() &&
+          (text_[pos_] == '-' || text_[pos_] == '+')) {
+        expNegative = text_[pos_] == '-';
+        pos_++;
+      }
+      bool anyExpDigit = false;
+      while (pos_ < text_.size() &&
+             std::isdigit(static_cast<unsigned char>(text_[pos_]))) {
+        exponent = std::min(exponent * 10 + (text_[pos_] - '0'), 9999);
+        anyExpDigit = true;
+        pos_++;
+      }
+      TC_ENFORCE(anyExpDigit, what_, ": bad exponent at byte ", start);
+      if (expNegative) {
+        exponent = -exponent;
+      }
+    }
+    Value v;
+    v.kind = Value::Kind::kNumber;
+    v.number = (negative ? -mantissa : mantissa) *
+               std::pow(10.0, exponent);
+    return v;
+  }
+
+  std::string parseString() {
+    expect('"');
+    std::string out;
+    while (true) {
+      TC_ENFORCE(pos_ < text_.size(), what_, ": unterminated string");
+      char c = text_[pos_++];
+      if (c == '"') {
+        return out;
+      }
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      TC_ENFORCE(pos_ < text_.size(), what_, ": bad escape");
+      char e = text_[pos_++];
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'n': out.push_back('\n'); break;
+        case 't': out.push_back('\t'); break;
+        case 'r': out.push_back('\r'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'u': {
+          // Interchange strings are ASCII identifiers; decode BMP
+          // escapes to their low byte and reject the rest rather than
+          // mis-decode.
+          TC_ENFORCE(pos_ + 4 <= text_.size(), what_, ": bad \\u escape");
+          unsigned code = 0;
+          for (int i = 0; i < 4; i++) {
+            char h = text_[pos_++];
+            code <<= 4;
+            if (h >= '0' && h <= '9') code |= h - '0';
+            else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+            else TC_THROW(EnforceError, what_, ": bad \\u escape");
+          }
+          TC_ENFORCE(code < 0x80, what_,
+                     ": non-ASCII \\u escape unsupported");
+          out.push_back(static_cast<char>(code));
+          break;
+        }
+        default:
+          TC_THROW(EnforceError, what_, ": bad escape '\\", e, "'");
+      }
+    }
+  }
+
+  Value parseArray() {
+    expect('[');
+    Value v;
+    v.kind = Value::Kind::kArray;
+    if (consume(']')) {
+      return v;
+    }
+    while (true) {
+      v.items.push_back(parseValue());
+      if (consume(']')) {
+        return v;
+      }
+      expect(',');
+    }
+  }
+
+  Value parseObject() {
+    expect('{');
+    Value v;
+    v.kind = Value::Kind::kObject;
+    if (consume('}')) {
+      return v;
+    }
+    while (true) {
+      std::string key = parseString();
+      expect(':');
+      v.fields.emplace_back(std::move(key), parseValue());
+      if (consume('}')) {
+        return v;
+      }
+      expect(',');
+    }
+  }
+
+  const std::string& text_;
+  const char* what_;
+  size_t pos_ = 0;
+};
+
+// Escaped JSON string literal writer (the serialization counterpart).
+inline void appendJsonString(std::ostringstream& out, const std::string& s) {
+  out << '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': out << "\\\""; break;
+      case '\\': out << "\\\\"; break;
+      case '\n': out << "\\n"; break;
+      case '\t': out << "\\t"; break;
+      case '\r': out << "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out << buf;
+        } else {
+          out << c;
+        }
+    }
+  }
+  out << '"';
+}
+
+}  // namespace tpucoll

@@ -2,6 +2,7 @@
 
 #include <sstream>
 
+#include "tpucoll/common/json.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/tracer.h"
 
@@ -99,6 +100,21 @@ void Metrics::recordStall(const Stall& stall) {
           "ms ago)");
 }
 
+void Metrics::recordPeerFailure(int peer, const std::string& message) {
+  peerFailures_.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> guard(stallMu_);
+  if (failedPeer_ < 0) {
+    failedPeer_ = peer;
+    failureMessage_ = message;
+  }
+}
+
+void Metrics::recordFault(const std::string& action) {
+  faultsTotal_.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> guard(faultMu_);
+  faultCounts_[action]++;
+}
+
 bool Metrics::lastStall(Stall* out) const {
   std::lock_guard<std::mutex> guard(stallMu_);
   if (!haveStall_) {
@@ -139,7 +155,35 @@ std::string Metrics::toJson(int rank, bool drain) {
   out << "{\"rank\":" << rank << ",\"size\":" << size_
       << ",\"enabled\":" << (enabled() ? "true" : "false")
       << ",\"watchdog_ms\":" << watchdogUs() / 1000 << ",\"now_us\":" << nowUs
-      << ",\"retries\":" << retries_.load(std::memory_order_relaxed);
+      << ",\"retries\":" << retries_.load(std::memory_order_relaxed)
+      << ",\"stash_pauses\":"
+      << stashPauses_.load(std::memory_order_relaxed);
+
+  out << ",\"faults\":{\"total\":"
+      << faultsTotal_.load(std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> guard(faultMu_);
+    for (const auto& fc : faultCounts_) {
+      out << ",";
+      appendJsonString(out, fc.first);
+      out << ":" << fc.second;
+    }
+  }
+  out << "}";
+
+  out << ",\"transport_failure\":";
+  {
+    std::lock_guard<std::mutex> guard(stallMu_);
+    if (failedPeer_ >= 0) {
+      out << "{\"peer\":" << failedPeer_ << ",\"count\":"
+          << peerFailures_.load(std::memory_order_relaxed)
+          << ",\"message\":";
+      appendJsonString(out, failureMessage_);
+      out << "}";
+    } else {
+      out << "null";
+    }
+  }
 
   out << ",\"ops\":{";
   bool first = true;
@@ -183,7 +227,9 @@ std::string Metrics::toJson(int rank, bool drain) {
         << ",\"recv_bytes\":" << ps.recvBytes.load(std::memory_order_relaxed)
         << ",\"last_progress_us\":" << progress
         << ",\"last_progress_age_us\":"
-        << (progress == 0 ? -1 : nowUs - progress) << ",\"recv_wait_us\":";
+        << (progress == 0 ? -1 : nowUs - progress)
+        << ",\"rx_pauses\":" << ps.rxPauses.load(std::memory_order_relaxed)
+        << ",\"recv_wait_us\":";
     histToJson(out, ps.recvWaitUs);
     out << "}";
   }
@@ -221,14 +267,24 @@ void Metrics::resetAll() {
     p.sentBytes.store(0, std::memory_order_relaxed);
     p.recvMsgs.store(0, std::memory_order_relaxed);
     p.recvBytes.store(0, std::memory_order_relaxed);
+    p.rxPauses.store(0, std::memory_order_relaxed);
     p.recvWaitUs.reset();
     // lastProgressUs survives: it is a timestamp, not a counter.
   }
   retries_.store(0, std::memory_order_relaxed);
   stalls_.store(0, std::memory_order_relaxed);
+  stashPauses_.store(0, std::memory_order_relaxed);
+  faultsTotal_.store(0, std::memory_order_relaxed);
+  peerFailures_.store(0, std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> guard(faultMu_);
+    faultCounts_.clear();
+  }
   {
     std::lock_guard<std::mutex> guard(stallMu_);
     haveStall_ = false;
+    failedPeer_ = -1;
+    failureMessage_.clear();
   }
 }
 

@@ -18,6 +18,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <map>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -84,6 +85,10 @@ class Metrics {
     std::atomic<uint64_t> recvMsgs{0};
     std::atomic<uint64_t> recvBytes{0};
     std::atomic<int64_t> lastProgressUs{0};
+    // Stash-backpressure engagements: how many times this peer's socket
+    // was paused because its early arrivals crossed the stash high
+    // watermark (TPUCOLL_MAX_STASH_BYTES; docs/observability.md).
+    std::atomic<uint64_t> rxPauses{0};
     // Latency from p2p wait start to completion against this peer
     // (recv side, where the source rank is known).
     Histogram recvWaitUs;
@@ -181,6 +186,28 @@ class Metrics {
     }
     peers_[peer].recvWaitUs.record(us);
   }
+  // Stash-watermark backpressure engaged against this peer (rare:
+  // at most once per watermark crossing).
+  void recordStashPause(int peer) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    stashPauses_.fetch_add(1, std::memory_order_relaxed);
+    peers_[peer].rxPauses.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // ---- transport failures (Context::onPairError) ----
+  // Not gated on enabled_: like the watchdog's stall record, failure
+  // evidence must survive a counters-off configuration — recovery
+  // tooling (resilience.stall_reports) reads it to name the dead rank.
+  void recordPeerFailure(int peer, const std::string& message);
+
+  // ---- fault-injection plane (fault/fault.h) ----
+  // Per-action fired-fault counters. Slow path only (a fault firing is
+  // rare by construction), so a mutex-guarded map keeps the registry
+  // decoupled from the fault plane's action enum. Not gated on
+  // enabled_: the chaos harness asserts on these.
+  void recordFault(const std::string& action);
 
   // ---- connect retries (Pair backoff loop) ----
   void recordRetry() {
@@ -233,10 +260,20 @@ class Metrics {
   std::vector<PeerStats> peers_;
   std::atomic<uint64_t> retries_{0};
   std::atomic<uint64_t> stalls_{0};
+  std::atomic<uint64_t> stashPauses_{0};
 
   mutable std::mutex stallMu_;
   bool haveStall_{false};
   Stall lastStall_;
+  // First transport failure observed (later errors are usually the
+  // cascade, not the cause) + total count.
+  int failedPeer_{-1};
+  std::string failureMessage_;
+  std::atomic<uint64_t> peerFailures_{0};
+
+  mutable std::mutex faultMu_;
+  std::map<std::string, uint64_t> faultCounts_;
+  std::atomic<uint64_t> faultsTotal_{0};
 };
 
 // RAII op-scope: counts the call + payload bytes at construction, records

@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/fault/fault.h"
 #include "tpucoll/tuning/tuning_table.h"
 #include "tpucoll/types.h"
 
@@ -24,6 +25,10 @@ Context::~Context() = default;
 void Context::connectFullMesh(std::shared_ptr<Store> store,
                               std::shared_ptr<transport::Device> device) {
   TC_ENFORCE(tctx_ == nullptr, "context already connected");
+  // Before the mesh comes up, so connect_refuse rules cover the
+  // bootstrap handshakes too. Malformed files throw (never silently
+  // run un-faulted against an operator's explicit schedule).
+  fault::maybeLoadEnvFile();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   store_ = std::move(store);
   device_ = std::move(device);
@@ -39,6 +44,7 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   TC_ENFORCE_EQ(size_, parent.size(), "fork must keep the parent size");
   TC_ENFORCE(parent.tctx_ != nullptr, "parent context not connected");
   device_ = parent.device_;
+  fault::maybeLoadEnvFile();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_);

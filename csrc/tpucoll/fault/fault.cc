@@ -1,0 +1,515 @@
+#include "tpucoll/fault/fault.h"
+
+#include <cstdlib>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/common/metrics.h"
+#include "tpucoll/common/tracer.h"
+#include "tpucoll/transport/wire.h"
+
+namespace tpucoll {
+namespace fault {
+
+namespace {
+
+// Logical opcodes a schedule can target. Matching happens BEFORE the
+// transport promotes bulk payloads onto the shm plane, so "data" covers
+// a payload whether it travels over TCP or a same-host ring.
+constexpr int kOpAny = -1;
+constexpr int kOpConnect = -2;
+
+int parseOpcode(const std::string& s) {
+  if (s == "any") return kOpAny;
+  if (s == "connect") return kOpConnect;
+  if (s == "data") return static_cast<int>(transport::Opcode::kData);
+  if (s == "put") return static_cast<int>(transport::Opcode::kPut);
+  if (s == "get_req") return static_cast<int>(transport::Opcode::kGetReq);
+  TC_THROW(EnforceError, "fault schedule: unknown opcode \"", s,
+           "\" (want data|put|get_req|connect|any)");
+}
+
+const char* opcodeName(int op) {
+  switch (op) {
+    case static_cast<int>(transport::Opcode::kData): return "data";
+    case static_cast<int>(transport::Opcode::kPut): return "put";
+    case static_cast<int>(transport::Opcode::kGetReq): return "get_req";
+    case kOpConnect: return "connect";
+  }
+  return "any";
+}
+
+Action parseAction(const std::string& s) {
+  if (s == "delay") return Action::kDelay;
+  if (s == "stall") return Action::kStall;
+  if (s == "dup") return Action::kDup;
+  if (s == "truncate") return Action::kTruncate;
+  if (s == "corrupt") return Action::kCorrupt;
+  if (s == "kill") return Action::kKill;
+  if (s == "connect_refuse") return Action::kConnectRefuse;
+  TC_THROW(EnforceError, "fault schedule: unknown action \"", s, "\"");
+}
+
+// splitmix64: turns (seed, rule index, rank) into a well-mixed xorshift
+// state so every (rule, rank) stream is independent but reproducible.
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+uint64_t xorshiftNext(uint64_t& s) {
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return s * 0x2545F4914F6CDD1Dull;
+}
+
+struct Rule {
+  // ---- match (when) ----
+  int rank{-1};            // injecting rank; -1 = any
+  int peer{-1};            // -1 = any
+  int opcode{kOpAny};
+  int64_t slot{-1};        // -1 = any
+  uint64_t minBytes{0};
+  uint64_t maxBytes{~0ull};
+  int64_t nth{0};          // >0: fire only on the nth match (1-based)
+  // ---- action ----
+  Action action{Action::kDelay};
+  uint32_t ms{0};
+  uint64_t truncBytes{0};  // 0: half the payload
+  uint64_t maxFires{~0ull};
+  double prob{1.0};
+  uint64_t seed{0};        // per-rule seed override (0: schedule seed)
+};
+
+// Per-(rule, rank) mutable state. Keyed by the injecting rank so that
+// several in-process ranks (thread-per-rank tests) each see their own
+// deterministic match/fire/PRNG sequence regardless of thread
+// interleaving between ranks.
+struct RuleState {
+  uint64_t matches{0};
+  uint64_t fires{0};
+  uint64_t rng{0};
+  bool rngInit{false};
+};
+
+struct Fired {
+  int rank;
+  uint64_t n;  // per-rank firing index
+  size_t rule;
+  Action action;
+  int peer;
+  int opcode;
+  uint64_t slot;
+  uint64_t nbytes;
+};
+
+struct Table {
+  uint64_t seed{0};
+  std::vector<Rule> rules;
+  // mutable firing state, guarded by g_mu
+  std::vector<std::map<int, RuleState>> state;  // per rule, per rank
+  std::map<int, uint64_t> firesPerRank;
+  std::vector<Fired> fired;
+};
+
+std::mutex g_mu;
+std::unique_ptr<Table> g_table;  // guarded by g_mu
+std::once_flag g_envOnce;
+
+uint64_t asCount(const JsonReader::Value& v, const char* what) {
+  TC_ENFORCE(v.kind == JsonReader::Value::Kind::kNumber && v.number >= 0,
+             "fault schedule: \"", what, "\" must be a non-negative number");
+  return static_cast<uint64_t>(v.number);
+}
+
+// Reject unknown/misspelled keys outright: a typo'd "rnak" must not
+// silently widen a kill rule to every rank. The schedule is an
+// operator's explicit instruction — docs/faults.md promises it is
+// never silently reinterpreted.
+void enforceKnownKeys(const JsonReader::Value& obj,
+                      std::initializer_list<const char*> allowed,
+                      const char* where) {
+  for (const auto& f : obj.fields) {
+    bool known = false;
+    for (const char* k : allowed) {
+      if (f.first == k) {
+        known = true;
+        break;
+      }
+    }
+    TC_ENFORCE(known, "fault schedule: unknown field \"", f.first,
+               "\" in ", where);
+  }
+}
+
+Rule parseRule(const JsonReader::Value& e, size_t index) {
+  using Kind = JsonReader::Value::Kind;
+  TC_ENFORCE(e.kind == Kind::kObject, "fault schedule: fault #", index,
+             " must be an object");
+  enforceKnownKeys(
+      e, {"when", "action", "ms", "bytes", "count", "prob", "seed"},
+      "fault rule");
+  Rule r;
+  if (const JsonReader::Value* when = e.field("when")) {
+    TC_ENFORCE(when->kind == Kind::kObject,
+               "fault schedule: \"when\" must be an object");
+    enforceKnownKeys(*when,
+                     {"rank", "peer", "opcode", "slot", "min_bytes",
+                      "max_bytes", "nth"},
+                     "\"when\"");
+    if (const auto* f = when->field("rank")) {
+      r.rank = static_cast<int>(asCount(*f, "rank"));
+    }
+    if (const auto* f = when->field("peer")) {
+      r.peer = static_cast<int>(asCount(*f, "peer"));
+    }
+    if (const auto* f = when->field("opcode")) {
+      TC_ENFORCE(f->kind == Kind::kString,
+                 "fault schedule: \"opcode\" must be a string");
+      r.opcode = parseOpcode(f->str);
+    }
+    if (const auto* f = when->field("slot")) {
+      r.slot = static_cast<int64_t>(asCount(*f, "slot"));
+    }
+    if (const auto* f = when->field("min_bytes")) {
+      r.minBytes = asCount(*f, "min_bytes");
+    }
+    if (const auto* f = when->field("max_bytes")) {
+      r.maxBytes = asCount(*f, "max_bytes");
+    }
+    if (const auto* f = when->field("nth")) {
+      r.nth = static_cast<int64_t>(asCount(*f, "nth"));
+      TC_ENFORCE(r.nth >= 1, "fault schedule: \"nth\" is 1-based");
+    }
+  }
+  const JsonReader::Value* action = e.field("action");
+  TC_ENFORCE(action != nullptr && action->kind == Kind::kString,
+             "fault schedule: fault #", index,
+             " needs a string \"action\"");
+  r.action = parseAction(action->str);
+  if (const auto* f = e.field("ms")) {
+    r.ms = static_cast<uint32_t>(asCount(*f, "ms"));
+  } else if (r.action == Action::kDelay) {
+    r.ms = 10;
+  } else if (r.action == Action::kStall) {
+    r.ms = 1000;
+  }
+  if (const auto* f = e.field("bytes")) {
+    r.truncBytes = asCount(*f, "bytes");
+  }
+  if (const auto* f = e.field("count")) {
+    r.maxFires = asCount(*f, "count");
+  }
+  if (const auto* f = e.field("prob")) {
+    TC_ENFORCE(f->kind == Kind::kNumber && f->number >= 0.0 &&
+                   f->number <= 1.0,
+               "fault schedule: \"prob\" must be in [0, 1]");
+    r.prob = f->number;
+  }
+  if (const auto* f = e.field("seed")) {
+    r.seed = asCount(*f, "seed");
+  }
+  if (r.action == Action::kConnectRefuse) {
+    TC_ENFORCE(r.opcode == kOpAny || r.opcode == kOpConnect,
+               "fault schedule: connect_refuse matches opcode "
+               "\"connect\" only");
+    r.opcode = kOpConnect;
+    // A refusal with no cap would starve the bootstrap past its
+    // deadline; default to one refusal so the retry path is exercised
+    // but connect still succeeds unless the schedule says otherwise.
+    if (e.field("count") == nullptr) {
+      r.maxFires = 1;
+    }
+  } else if (r.opcode == kOpConnect) {
+    TC_ENFORCE(r.action == Action::kDelay || r.action == Action::kStall,
+               "fault schedule: opcode \"connect\" supports "
+               "connect_refuse, delay, or stall");
+  }
+  return r;
+}
+
+const char* traceName(Action a) {
+  switch (a) {
+    case Action::kDelay: return "fault.delay";
+    case Action::kStall: return "fault.stall";
+    case Action::kDup: return "fault.dup";
+    case Action::kTruncate: return "fault.truncate";
+    case Action::kCorrupt: return "fault.corrupt";
+    case Action::kKill: return "fault.kill";
+    case Action::kConnectRefuse: return "fault.connect_refuse";
+    case Action::kCount: break;
+  }
+  return "fault";
+}
+
+// Evaluate all rules for one event under g_mu. Returns the fired rule
+// actions (in rule order) and the total sleep the caller must serve
+// after releasing the lock.
+struct Evaluation {
+  TxDecision decision;
+  uint32_t sleepMs{0};
+  Action sleepAction{Action::kDelay};  // span name for the served sleep
+  bool connectRefused{false};
+  std::vector<std::pair<Action, uint64_t>> firedActions;  // with nbytes
+};
+
+Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
+                          uint64_t nbytes) {
+  Evaluation ev;
+  Table* t = g_table.get();
+  if (t == nullptr) {
+    return ev;
+  }
+  const bool connectEvent = opcode == kOpConnect;
+  for (size_t i = 0; i < t->rules.size(); i++) {
+    Rule& r = t->rules[i];
+    // A wildcard-opcode rule with a tx-only destructive action must not
+    // match (or consume its count/nth budget on) a connect event — the
+    // connect path can only serve refuse/delay/stall, and a silently
+    // swallowed kill would falsely appear in the report.
+    if (connectEvent && r.action != Action::kConnectRefuse &&
+        r.action != Action::kDelay && r.action != Action::kStall) {
+      continue;
+    }
+    if ((r.rank != -1 && r.rank != rank) ||
+        (r.peer != -1 && r.peer != peer) ||
+        (r.opcode != kOpAny && r.opcode != opcode) ||
+        (r.slot != -1 && static_cast<uint64_t>(r.slot) != slot) ||
+        nbytes < r.minBytes || nbytes > r.maxBytes) {
+      continue;
+    }
+    RuleState& st = t->state[i][rank];
+    st.matches++;
+    if (st.fires >= r.maxFires) {
+      continue;
+    }
+    if (r.nth > 0 && st.matches != static_cast<uint64_t>(r.nth)) {
+      continue;
+    }
+    if (r.prob < 1.0) {
+      if (!st.rngInit) {
+        st.rng = splitmix64((r.seed != 0 ? r.seed : t->seed) ^
+                            splitmix64(i * 0x9E37u + 1) ^
+                            splitmix64(static_cast<uint64_t>(rank) + 0x51u));
+        st.rngInit = true;
+      }
+      const double u =
+          (xorshiftNext(st.rng) >> 11) * (1.0 / 9007199254740992.0);
+      if (u >= r.prob) {
+        continue;
+      }
+    }
+    st.fires++;
+    const uint64_t n = t->firesPerRank[rank]++;
+    t->fired.push_back(Fired{rank, n, i, r.action, peer, opcode, slot,
+                             nbytes});
+    ev.firedActions.emplace_back(r.action, nbytes);
+    switch (r.action) {
+      case Action::kDelay:
+      case Action::kStall:
+        ev.sleepMs += r.ms;
+        ev.sleepAction = r.action;  // last sleeper names the span
+        break;
+      case Action::kDup:
+        ev.decision.duplicate = true;
+        break;
+      case Action::kTruncate:
+        ev.decision.truncate = true;
+        ev.decision.truncateToBytes =
+            r.truncBytes != 0 ? std::min(r.truncBytes, nbytes)
+                              : nbytes / 2;
+        break;
+      case Action::kCorrupt:
+        ev.decision.corrupt = true;
+        break;
+      case Action::kKill:
+        ev.decision.kill = true;
+        break;
+      case Action::kConnectRefuse:
+        ev.connectRefused = true;
+        break;
+      case Action::kCount:
+        break;
+    }
+  }
+  return ev;
+}
+
+void accountFired(const Evaluation& ev, int rank, int peer,
+                  Metrics* metrics, Tracer* tracer) {
+  (void)rank;
+  for (const auto& fa : ev.firedActions) {
+    if (metrics != nullptr) {
+      metrics->recordFault(actionName(fa.first));
+    }
+    // Delay/stall get their span stamped around the actual sleep by the
+    // caller; the instantaneous actions are stamped here.
+    if (tracer != nullptr && tracer->enabled() &&
+        fa.first != Action::kDelay && fa.first != Action::kStall) {
+      const int64_t now = Tracer::nowUs();
+      tracer->record(Tracer::Event{traceName(fa.first), now, now,
+                                   fa.second, peer, "fault"});
+    }
+  }
+}
+
+}  // namespace
+
+namespace detail {
+std::atomic<bool> g_armed{false};
+}  // namespace detail
+
+const char* actionName(Action a) {
+  switch (a) {
+    case Action::kDelay: return "delay";
+    case Action::kStall: return "stall";
+    case Action::kDup: return "dup";
+    case Action::kTruncate: return "truncate";
+    case Action::kCorrupt: return "corrupt";
+    case Action::kKill: return "kill";
+    case Action::kConnectRefuse: return "connect_refuse";
+    case Action::kCount: break;
+  }
+  return "unknown";
+}
+
+std::string killMessage(int peer) {
+  return ::tpucoll::detail::strCat(
+      "fault injection: killed connection to rank ", peer);
+}
+
+std::string truncateMessage(int peer) {
+  return ::tpucoll::detail::strCat(
+      "fault injection: truncated message to rank ", peer);
+}
+
+void install(const std::string& json) {
+  using Kind = JsonReader::Value::Kind;
+  JsonReader reader(json, "fault schedule JSON");
+  const JsonReader::Value root = reader.parse();
+  TC_ENFORCE(root.kind == Kind::kObject,
+             "fault schedule JSON: root must be an object");
+  enforceKnownKeys(root, {"seed", "faults", "version"}, "schedule root");
+  auto table = std::make_unique<Table>();
+  if (const auto* f = root.field("seed")) {
+    table->seed = asCount(*f, "seed");
+  }
+  const JsonReader::Value* faults = root.field("faults");
+  TC_ENFORCE(faults != nullptr && faults->kind == Kind::kArray,
+             "fault schedule JSON: needs a \"faults\" array");
+  for (size_t i = 0; i < faults->items.size(); i++) {
+    table->rules.push_back(parseRule(faults->items[i], i));
+  }
+  table->state.resize(table->rules.size());
+  {
+    std::lock_guard<std::mutex> guard(g_mu);
+    g_table = std::move(table);
+    detail::g_armed.store(!g_table->rules.empty(),
+                          std::memory_order_relaxed);
+  }
+  TC_DEBUG("fault plane: installed ", faults->items.size(), " rule(s)");
+}
+
+void clear() {
+  std::lock_guard<std::mutex> guard(g_mu);
+  detail::g_armed.store(false, std::memory_order_relaxed);
+  g_table.reset();
+}
+
+std::string report() {
+  std::ostringstream out;
+  out << "[";
+  {
+    std::lock_guard<std::mutex> guard(g_mu);
+    if (g_table != nullptr) {
+      bool first = true;
+      for (const Fired& f : g_table->fired) {
+        if (!first) {
+          out << ",";
+        }
+        first = false;
+        out << "{\"rank\":" << f.rank << ",\"n\":" << f.n
+            << ",\"rule\":" << f.rule << ",\"action\":\""
+            << actionName(f.action) << "\",\"peer\":" << f.peer
+            << ",\"opcode\":\"" << opcodeName(f.opcode)
+            << "\",\"slot\":" << f.slot << ",\"nbytes\":" << f.nbytes
+            << "}";
+      }
+    }
+  }
+  out << "]";
+  return out.str();
+}
+
+void maybeLoadEnvFile() {
+  std::call_once(g_envOnce, [] {
+    const char* path = std::getenv("TPUCOLL_FAULT_FILE");
+    if (path == nullptr || *path == '\0') {
+      return;
+    }
+    std::ifstream in(path, std::ios::binary);
+    TC_ENFORCE(in.good(), "TPUCOLL_FAULT_FILE: cannot read ", path);
+    std::ostringstream buf;
+    buf << in.rdbuf();
+    install(buf.str());
+    TC_DEBUG("fault plane: loaded schedule from ", path);
+  });
+}
+
+TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
+                       uint64_t nbytes, Metrics* metrics, Tracer* tracer) {
+  Evaluation ev;
+  {
+    std::lock_guard<std::mutex> guard(g_mu);
+    ev = evaluateLocked(rank, peer, static_cast<int>(opcode), slot, nbytes);
+  }
+  accountFired(ev, rank, peer, metrics, tracer);
+  if (ev.sleepMs > 0) {
+    // The sleep runs on the calling (user) thread with no locks held:
+    // it delays this rank's subsequent sends and receive posting — the
+    // intended semantics of an injected link delay — without stalling
+    // the event loop or sibling ranks.
+    const int64_t t0 = Tracer::nowUs();
+    std::this_thread::sleep_for(std::chrono::milliseconds(ev.sleepMs));
+    if (tracer != nullptr && tracer->enabled()) {
+      tracer->record(Tracer::Event{traceName(ev.sleepAction), t0,
+                                   Tracer::nowUs(), nbytes, peer,
+                                   "fault"});
+    }
+  }
+  return ev.decision;
+}
+
+void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer) {
+  Evaluation ev;
+  {
+    std::lock_guard<std::mutex> guard(g_mu);
+    ev = evaluateLocked(rank, peer, kOpConnect, 0, 0);
+  }
+  accountFired(ev, rank, peer, metrics, tracer);
+  if (ev.sleepMs > 0) {
+    const int64_t t0 = Tracer::nowUs();
+    std::this_thread::sleep_for(std::chrono::milliseconds(ev.sleepMs));
+    if (tracer != nullptr && tracer->enabled()) {
+      tracer->record(Tracer::Event{traceName(ev.sleepAction), t0,
+                                   Tracer::nowUs(), 0, peer, "fault"});
+    }
+  }
+  if (ev.connectRefused) {
+    TC_THROW(IoException, "fault injection: connection to rank ", peer,
+             " refused");
+  }
+}
+
+}  // namespace fault
+}  // namespace tpucoll

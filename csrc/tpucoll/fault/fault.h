@@ -1,0 +1,120 @@
+// Deterministic fault-injection plane for the transport and resilience
+// stack (docs/faults.md).
+//
+// A process-global table of scripted fault rules interposes on the
+// transport layer's outbound wire messages (pair.cc send/sendPut) and
+// the pair connect path, and can — per rule — delay or stall a message,
+// duplicate it, truncate it on the wire, corrupt its header, hard-kill
+// the pair, or refuse connection attempts during the handshake. Rules
+// are matched on (rank, peer, opcode, slot, payload size, nth match)
+// and fire deterministically: same seed + same schedule + same per-rank
+// event sequence => byte-identical firing sequence, asserted via
+// report().
+//
+// The reference proves its failure handling with hand-written kill/abort
+// tests (gloo/test/multiproc_test.h); this plane turns every failure
+// class into a scriptable, repeatable input so the chaos harness
+// (tests/test_chaos.py) can cover the recovery contract instead of
+// assuming it.
+//
+// Cost contract: with no schedule installed the transport pays exactly
+// ONE relaxed atomic load + predictable branch per message (armed()),
+// nothing else — the plane is compiled in but free on the hot path.
+// Every evaluation beyond that gate happens on the (rare) slow path
+// under the table mutex; injected sleeps happen after the mutex is
+// released, on the calling user thread only (the loop thread is never
+// slept — sendOwned responses are deliberately not interposed).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace tpucoll {
+
+class Metrics;
+class Tracer;
+
+namespace fault {
+
+enum class Action : uint8_t {
+  kDelay = 0,     // sleep `ms` on the sending thread before enqueue
+  kStall,         // same mechanics, watchdog-tripping intent (long ms)
+  kDup,           // enqueue a second copy of the message after the first
+  kTruncate,      // put only `bytes` payload bytes on the wire, then
+                  // fail the pair (receiver sees EOF mid-message)
+  kCorrupt,       // corrupt the wire header (receiver: protocol
+                  // violation / AEAD failure naming this rank)
+  kKill,          // hard-fail the pair before the message is sent
+  kConnectRefuse, // throw a retryable IoException from connectAttempt
+  kCount,
+};
+
+const char* actionName(Action a);
+
+// What the transport must apply to the matched message. Delay/stall have
+// already been served (slept) by the time onTxMessage returns; the rest
+// are returned because only the pair can apply them.
+struct TxDecision {
+  bool corrupt{false};
+  bool duplicate{false};
+  bool truncate{false};
+  uint64_t truncateToBytes{0};  // payload bytes to actually transmit
+  bool kill{false};
+};
+
+// XOR mask applied to WireHeader.magic by a corrupt fault. Any nonzero
+// mask guarantees the magic check fails on the receiver; fixed so the
+// corruption itself is deterministic.
+constexpr uint32_t kCorruptMagicMask = 0xDEAD5A5Au;
+
+namespace detail {
+extern std::atomic<bool> g_armed;
+}  // namespace detail
+
+// Hot-path gate: one relaxed load. False whenever no schedule is
+// installed, so the per-message cost is a single predictable check.
+inline bool armed() {
+  return detail::g_armed.load(std::memory_order_relaxed);
+}
+
+// Install a schedule (JSON, see docs/faults.md), replacing any previous
+// one and resetting all rule state and the firing report. Throws
+// EnforceError on malformed input.
+void install(const std::string& json);
+
+// Remove the schedule and firing report; armed() returns false again.
+void clear();
+
+// The deterministic firing log as a JSON array, in firing order:
+//   [{"rank","n","rule","action","peer","opcode","slot","nbytes"}, ...]
+// `n` counts fires per injecting rank, so each rank's subsequence is
+// reproducible even when several in-process ranks interleave. Entries
+// carry no timestamps — two runs with the same seed, schedule, and
+// per-rank workload produce byte-identical per-rank sequences.
+std::string report();
+
+// Load TPUCOLL_FAULT_FILE once per process (no-op when unset; malformed
+// files throw — an operator's explicit schedule must never be silently
+// dropped). Called from Context connect so the schedule also covers the
+// bootstrap handshakes.
+void maybeLoadEnvFile();
+
+// Slow-path evaluation, called only when armed(). Counts each fired
+// fault in `metrics` (when non-null) and stamps a span into `tracer`
+// (when enabled); delay/stall sleep here, after the table mutex is
+// released.
+TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
+                       uint64_t nbytes, Metrics* metrics, Tracer* tracer);
+
+// Connect-path evaluation: throws IoException when a connect_refuse
+// rule fires (the pair's retry loop classifies it as retryable).
+void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer);
+
+// Message a kill fault poisons the pair with (also what the failed
+// collective surfaces); exposed so tests can match it exactly.
+std::string killMessage(int peer);
+std::string truncateMessage(int peer);
+
+}  // namespace fault
+}  // namespace tpucoll

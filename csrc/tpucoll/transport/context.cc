@@ -521,6 +521,9 @@ void Context::stashArrived(int srcRank, uint64_t slot,
         // atomically with respect to postRecv's resume path (ctx -> pair
         // lock order, same as close()).
         pairs_[srcRank]->pauseReading();
+        if (metrics_ != nullptr) {
+          metrics_->recordStashPause(srcRank);
+        }
       }
       stashed_.push_back(Stash{srcRank, slot, std::move(data)});
     }
@@ -628,7 +631,14 @@ void Context::debugDump() {
   fprintf(stderr, "%s\n", s.c_str());
 }
 
-void Context::onPairError(int rank, const std::string& message) {
+void Context::onPairError(int rank, const std::string& message,
+                          bool orderly) {
+  if (metrics_ != nullptr && !orderly) {
+    // Failure evidence for recovery tooling: even when the watchdog
+    // never fired (a SIGKILL'd peer surfaces via EOF in milliseconds),
+    // the metrics snapshot names which peer's link died first.
+    metrics_->recordPeerFailure(rank, message);
+  }
   std::vector<UnboundBuffer*> victims;
   {
     std::lock_guard<std::mutex> guard(mu_);

@@ -124,8 +124,11 @@ class Context {
   void stashArrived(int srcRank, uint64_t slot, std::vector<char> data);
 
   // A pair failed: poison posted receives that could match it and record the
-  // error for future sends.
-  void onPairError(int rank, const std::string& message);
+  // error for future sends. `orderly` marks a goodbye-announced departure
+  // (still poisons, but is not blamed in the metrics transport-failure
+  // record — clean shutdown skew is not a death).
+  void onPairError(int rank, const std::string& message,
+                   bool orderly = false);
   void debugDump();
 
   // Shared-memory payload-plane stats summed over pairs: ring bytes sent /

@@ -15,6 +15,7 @@
 
 #include "tpucoll/common/debug.h"
 #include "tpucoll/common/hmac.h"
+#include "tpucoll/fault/fault.h"
 #include "tpucoll/transport/context.h"
 #include "tpucoll/transport/device.h"
 #include "tpucoll/transport/listener.h"
@@ -146,6 +147,13 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
 void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
                           std::chrono::steady_clock::time_point deadline,
                           std::string* localAddr) {
+  if (fault::armed()) {
+    // A fired connect_refuse rule throws a retryable IoException here,
+    // driving the same backoff/classification path a real refused or
+    // reset handshake takes.
+    fault::onConnect(selfRank_, peerRank_, context_->metrics(),
+                     context_->tracer());
+  }
   int fd = socket(remote.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd, 0, errnoString("socket"));
   setNonBlocking(fd);
@@ -444,15 +452,73 @@ void Pair::waitConnected(std::chrono::milliseconds timeout) {
   // receive-only schedules against it still complete.
 }
 
+// Apply a fault decision to an outbound message: one shared slow path
+// behind the armed() gate in send/sendPut. Returns false when the
+// message must not be enqueued at all (kill). A truncated op keeps its
+// claimed header.nbytes but transmits only truncateToBytes; the caller
+// then fails the pair so the receiver observes EOF mid-message. A
+// corrupted op keeps its real length but carries a poisoned magic (on
+// encrypted connections the corrupt header is sealed normally, so the
+// frame authenticates and the receiver still hits the magic check —
+// "protocol violation from rank N" on every tier).
+bool Pair::applyTxFault(const fault::TxDecision& fd, TxOp* op) {
+  if (fd.kill) {
+    fail(fault::killMessage(peerRank_));
+    return false;  // enqueue would throw; the caller raises instead
+  }
+  if (fd.corrupt) {
+    op->header.magic ^= fault::kCorruptMagicMask;
+  }
+  if (fd.truncate) {
+    op->nbytes = fd.truncateToBytes;
+    // Truncation is a byte-stream fault: keep it off the shm plane,
+    // where announced chunk totals (not EOF) delimit the message and a
+    // short payload would park the receiver on the ring instead of
+    // failing loudly.
+    if (op->viaShm) {
+      op->viaShm = false;
+      op->header.opcode = static_cast<uint8_t>(
+          op->header.opcode == static_cast<uint8_t>(Opcode::kShmPut)
+              ? Opcode::kPut
+              : Opcode::kData);
+    }
+  }
+  return true;
+}
+
+// Post-enqueue fault tail: emit the duplicate copy and/or sever the
+// stream after a truncated message was flushed.
+void Pair::finishTxFault(const fault::TxDecision& fd,
+                         const WireHeader& cleanHeader, const char* data,
+                         size_t nbytes) {
+  if (fd.duplicate) {
+    try {
+      sendOwned(cleanHeader, std::vector<char>(data, data + nbytes));
+    } catch (const std::exception&) {
+      // Pair failed/closing between the two enqueues: the dup fault
+      // degenerates to a no-op, never to a new error.
+    }
+  }
+  if (fd.truncate) {
+    fail(fault::truncateMessage(peerRank_));
+  }
+}
+
 void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
                 size_t nbytes) {
+  if (__builtin_expect(fault::armed(), 0)) {
+    // Cold, self-contained: the disarmed hot path pays exactly this one
+    // predictable check (fault.h cost contract), nothing else.
+    sendFaulted(ubuf, slot, data, nbytes);
+    return;
+  }
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;
   op.header = WireHeader{
       kMsgMagic,
       static_cast<uint8_t>(viaShm ? Opcode::kShmData : Opcode::kData),
-      0, {0, 0}, slot, nbytes};
+      0, {0, 0}, slot, nbytes, 0};
   op.ubuf = ubuf;
   op.data = data;
   op.nbytes = nbytes;
@@ -460,8 +526,40 @@ void Pair::send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
   enqueue(std::move(op));
 }
 
+void Pair::sendFaulted(UnboundBuffer* ubuf, uint64_t slot,
+                       const char* data, size_t nbytes) {
+  fault::TxDecision fd = fault::onTxMessage(
+      selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kData), slot,
+      nbytes, context_->metrics(), context_->tracer());
+  const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
+                      nbytes >= shmThresholdBytes();
+  TxOp op;
+  op.header = WireHeader{
+      kMsgMagic,
+      static_cast<uint8_t>(viaShm ? Opcode::kShmData : Opcode::kData),
+      0, {0, 0}, slot, nbytes, 0};
+  op.ubuf = ubuf;
+  op.data = data;
+  op.nbytes = nbytes;
+  op.viaShm = viaShm;
+  if (!applyTxFault(fd, &op)) {
+    TC_THROW(IoException, "send to rank ", peerRank_, ": ",
+             fault::killMessage(peerRank_));
+  }
+  enqueue(std::move(op));
+  if (fd.duplicate || fd.truncate) {
+    WireHeader clean{kMsgMagic, static_cast<uint8_t>(Opcode::kData),
+                     0, {0, 0}, slot, nbytes, 0};
+    finishTxFault(fd, clean, data, nbytes);
+  }
+}
+
 void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
                    const char* data, size_t nbytes, bool notify) {
+  if (__builtin_expect(fault::armed(), 0)) {
+    sendPutFaulted(ubuf, token, roffset, data, nbytes, notify);
+    return;
+  }
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;
@@ -475,6 +573,41 @@ void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
   op.nbytes = nbytes;
   op.viaShm = viaShm;
   enqueue(std::move(op));
+}
+
+void Pair::sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
+                          uint64_t roffset, const char* data,
+                          size_t nbytes, bool notify) {
+  fault::TxDecision fd = fault::onTxMessage(
+      selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kPut), token,
+      nbytes, context_->metrics(), context_->tracer());
+  const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
+                      nbytes >= shmThresholdBytes();
+  TxOp op;
+  op.header = WireHeader{
+      kMsgMagic,
+      static_cast<uint8_t>(viaShm ? Opcode::kShmPut : Opcode::kPut),
+      notify ? kPutFlagNotify : uint8_t(0), {0, 0},
+      token, nbytes, roffset};
+  op.ubuf = ubuf;
+  op.data = data;
+  op.nbytes = nbytes;
+  op.viaShm = viaShm;
+  if (!applyTxFault(fd, &op)) {
+    TC_THROW(IoException, "put to rank ", peerRank_, ": ",
+             fault::killMessage(peerRank_));
+  }
+  enqueue(std::move(op));
+  if (fd.duplicate || fd.truncate) {
+    // A duplicated put re-writes the same bytes at the same offset —
+    // idempotent for the DATA. The notification is not idempotent (each
+    // notify-put completes one wait_put), so the duplicate always goes
+    // out notify-less: dup perturbs the wire, never the app's
+    // synchronization count.
+    WireHeader clean{kMsgMagic, static_cast<uint8_t>(Opcode::kPut),
+                     0, {0, 0}, token, nbytes, roffset};
+    finishTxFault(fd, clean, data, nbytes);
+  }
 }
 
 void Pair::sendOwned(WireHeader header, std::vector<char> payload) {
@@ -1879,7 +2012,8 @@ void Pair::teardown(State target, const std::string& message,
     rxb->onRecvError(message);
   }
   if (notifyContext) {
-    context_->onPairError(peerRank_, message);
+    context_->onPairError(peerRank_, message,
+                          /*orderly=*/target == State::kClosed);
   }
 }
 

@@ -16,6 +16,7 @@
 #include <string>
 #include <vector>
 
+#include "tpucoll/fault/fault.h"
 #include "tpucoll/transport/address.h"
 #include "tpucoll/transport/loop.h"
 #include "tpucoll/transport/shm.h"
@@ -213,6 +214,22 @@ class Pair : public Handler {
   void queueCtrl(Opcode opcode);  // mu_ held; caller flushes + updates mask
   // Shared enqueue path behind send/sendPut/sendOwned (acquires mu_).
   void enqueue(TxOp op);
+  // Fault-injection cold paths (fault/fault.h): send/sendPut delegate
+  // here when a schedule is armed, keeping the disarmed hot path at
+  // exactly one predictable check.
+  void sendFaulted(UnboundBuffer* ubuf, uint64_t slot, const char* data,
+                   size_t nbytes);
+  void sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
+                      uint64_t roffset, const char* data, size_t nbytes,
+                      bool notify);
+  // Mutate the op per the fired decision (corrupt/truncate), or veto
+  // the enqueue entirely (kill — the pair is already failed when this
+  // returns false).
+  bool applyTxFault(const fault::TxDecision& fd, TxOp* op);
+  // Post-enqueue fault tail: duplicate copy / sever after truncation.
+  void finishTxFault(const fault::TxDecision& fd,
+                     const WireHeader& cleanHeader, const char* data,
+                     size_t nbytes);
   // One connection attempt: TCP connect + hello + (optional) PSK
   // handshake; throws on failure. Fills *localAddr once bound.
   void connectAttempt(const SockAddr& remote, uint64_t remotePairId,

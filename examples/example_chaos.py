@@ -1,0 +1,130 @@
+"""Chaos demo: inject a peer stall, watch the watchdog name the peer,
+then kill the link and rebuild the context.
+
+Two processes over a FileStore. A fault schedule (docs/faults.md) is
+shared via TPUCOLL_FAULT_FILE:
+
+ 1. rank 1's first bulk message to rank 0 stalls 1.5s — rank 0's armed
+    watchdog fires mid-wait and names rank 1 + the blocked slot, and the
+    allreduce then completes correctly (a stall is a delay, not a death);
+ 2. rank 1's second bulk message hard-kills the pair — both ranks fail
+    loudly, rebuild through gloo_tpu.resilience over the same store, and
+    the evidence published by rebuild_after_failure(failed_context=...)
+    lets stall_reports name the faulted rank.
+
+Everything is deterministic: same schedule, same seed, same firing
+sequence (gloo_tpu.fault.report()).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEDULE = {"seed": 2026, "faults": [
+    {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1,
+              "min_bytes": 1024},
+     "action": "stall", "ms": 1500},
+    {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 2,
+              "min_bytes": 1024},
+     "action": "kill"},
+]}
+
+WORKER = textwrap.dedent("""
+    import json, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import gloo_tpu
+    from gloo_tpu import fault
+    from gloo_tpu.resilience import rebuild_after_failure, stall_reports
+
+    import os
+    from gloo_tpu.utils import merge_traces
+
+    rank, store_dir = int(sys.argv[1]), sys.argv[2]
+    store = gloo_tpu.FileStore(store_dir)
+    ctx = gloo_tpu.Context(rank, 2, timeout=15.0)
+    ctx.connect_full_mesh(store, gloo_tpu.Device())
+    ctx.set_watchdog(0.3)   # anything blocked > 300ms names its peer
+    ctx.trace_start()       # fired faults land in the trace as spans
+
+    # --- act 1: the stall. The collective survives; the watchdog saw it.
+    x = np.full(4096, float(rank + 1), dtype=np.float32)
+    ctx.allreduce(x, tag=1)
+    assert x[0] == 3.0, x[0]
+    if rank == 0:
+        wd = ctx.metrics()["watchdog"]
+        assert wd["stalls"] >= 1 and wd["last"]["peer"] == 1, wd
+        print(f"[watchdog] rank0 was blocked "
+              f"{{wd['last']['waited_us'] // 1000}}ms on rank "
+              f"{{wd['last']['peer']}} slot {{wd['last']['slot']}}",
+              flush=True)
+
+    # --- act 2: the kill. Fail loudly, rebuild, keep going.
+    y = np.full(4096, float(rank + 1), dtype=np.float32)
+    try:
+        ctx.allreduce(y, tag=2, timeout=3.0)
+        raise SystemExit("allreduce unexpectedly survived the kill")
+    except gloo_tpu.IoError as exc:
+        print(f"rank {{rank}}: failed loudly: {{str(exc)[:72]}}",
+              flush=True)
+
+    new_ctx, new_rank, new_size = rebuild_after_failure(
+        store, gloo_tpu.Device(), old_rank=rank, old_size=2, generation=1,
+        settle=2.0, timeout=60.0, failed_context=ctx)
+    assert new_ctx is not None and new_size == 2
+    z = np.full(1024, float(new_rank + 1), dtype=np.float32)
+    new_ctx.allreduce(z, tag=3)
+    assert z[0] == 3.0, z[0]
+    if rank == 0:
+        # At P=2 blame is symmetric (each survivor names the other end
+        # of the dead link); what matters is that the HEALTHY side's
+        # watchdog evidence names the faulted rank 1. At P>=3 the modal
+        # suspect across reports isolates the culprit
+        # (tests/test_chaos.py::test_sigkill_mid_allreduce_rebuild_and_blame).
+        reports = stall_reports(store, generation=1, old_size=2)
+        assert reports[0]["suspect"] == 1, reports
+        print(f"rebuilt OK; per-survivor evidence: "
+              f"{{ {{r: v.get('suspect') for r, v in reports.items()}} }}",
+              flush=True)
+    if rank == 1:
+        print("fault firing sequence:",
+              json.dumps(fault.report(rank=1)), flush=True)
+    # Merge both ranks' traces (stall/kill spans included) into one
+    # Perfetto timeline: each worker parks its doc in the store dir,
+    # rank 0 merges after the new context's barrier orders the writes.
+    with open(os.path.join(store_dir, f"trace_{{rank}}.json"), "w") as f:
+        f.write(ctx.trace_json())
+    new_ctx.barrier(tag=4)
+    if rank == 0:
+        docs = [open(os.path.join(store_dir, f"trace_{{r}}.json")).read()
+                for r in range(2)]
+        merged_path = os.path.join(store_dir, "chaos_trace.json")
+        with open(merged_path, "w") as f:
+            f.write(merge_traces(docs))
+        print(f"merged chaos trace (Perfetto: labeled rank rows, "
+              f"fault.* spans) -> {{merged_path}}", flush=True)
+    new_ctx.close()
+""").format(repo=_REPO)
+
+
+def main():
+    store = tempfile.mkdtemp()
+    sched_path = os.path.join(store, "schedule.json")
+    with open(sched_path, "w") as f:
+        json.dump(SCHEDULE, f)
+    env = dict(os.environ, TPUCOLL_FAULT_FILE=sched_path)
+    procs = [subprocess.Popen([sys.executable, "-c", WORKER, str(r), store],
+                              env=env)
+             for r in range(2)]
+    codes = [p.wait() for p in procs]
+    assert codes == [0, 0], codes
+    print("chaos example: OK")
+
+
+if __name__ == "__main__":
+    main()

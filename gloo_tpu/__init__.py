@@ -13,7 +13,7 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
   ICI, plus Pallas ring kernels for custom schedules.
 """
 
-from gloo_tpu import tuning
+from gloo_tpu import fault, tuning
 from gloo_tpu.bootstrap import detect_launch_env, init_from_env
 from gloo_tpu.core import (
     Aborted,
@@ -58,6 +58,7 @@ __all__ = [
     "detect_launch_env",
     "init_from_env",
     "derive_keyring",
+    "fault",
     "tuning",
     "uring_available",
 ]

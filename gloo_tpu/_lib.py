@@ -124,6 +124,11 @@ _PROTOTYPES = {
     "tc_metrics_set_watchdog": (None, [_c, _i64]),
     "tc_metrics_json": (_int, [_c, _int, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # deterministic fault-injection plane
+    "tc_fault_install": (_int, [ctypes.c_char_p]),
+    "tc_fault_clear": (None, []),
+    "tc_fault_report": (_int, [ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # collective autotuning plane
     "tc_tune": (_int, [_c, _sz, _sz, _int, _int, _u32, _i64,
                        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),

@@ -593,10 +593,13 @@ class Context:
         """Snapshot the context's metrics registry as a dict.
 
         Shape: {"rank", "size", "enabled", "watchdog_ms", "now_us",
-        "retries", "ops": {name: {"calls", "bytes", "errors",
+        "retries", "stash_pauses", "faults": {"total", <action>: n...},
+        "transport_failure": null | {"peer", "count", "message"},
+        "ops": {name: {"calls", "bytes", "errors",
         "latency_us": hist}}, "transport": {peer: {"sent_msgs",
         "sent_bytes", "recv_msgs", "recv_bytes", "last_progress_us",
-        "last_progress_age_us", "recv_wait_us": hist}}, "watchdog":
+        "last_progress_age_us", "rx_pauses", "recv_wait_us": hist}},
+        "watchdog":
         {"stalls", "last"}} where hist is {"count", "sum_us", "max_us",
         "buckets": [[le_us, n], ...]} with per-bucket (non-cumulative)
         counts in power-of-two microsecond buckets. Timestamps are

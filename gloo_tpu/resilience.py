@@ -29,26 +29,33 @@ import gloo_tpu
 
 
 def _stall_evidence(failed_context) -> Optional[dict]:
-    """Extract the watchdog's verdict from a poisoned context's metrics
-    snapshot: which peer/slot this rank was blocked on, and how stale
-    that link's progress was. Returns None when the watchdog never
-    fired (or metrics are unavailable)."""
+    """Extract the failure verdict from a poisoned context's metrics
+    snapshot: which peer this rank was blocked on (watchdog stall), or —
+    when the watchdog never fired because detection was EOF-fast, e.g. a
+    SIGKILL'd peer — which peer's link died first (the transport-failure
+    record Context.onPairError feeds). Returns None when neither source
+    names a peer (or metrics are unavailable)."""
     try:
         snap = failed_context.metrics()
     except Exception:  # noqa: BLE001 - a dead context must not block rebuild
         return None
     last = snap.get("watchdog", {}).get("last")
-    if not last:
-        return None
-    evidence = {"suspect": last.get("peer", -1), "op": last.get("op"),
-                "slot": last.get("slot"), "waited_ms":
-                last.get("waited_us", 0) // 1000}
-    peer = last.get("peer", -1)
-    transport = snap.get("transport", {})
-    if peer in transport:
-        evidence["peer_progress_age_ms"] = (
-            transport[peer].get("last_progress_age_us", -1) // 1000)
-    return evidence
+    if last:
+        evidence = {"suspect": last.get("peer", -1), "op": last.get("op"),
+                    "slot": last.get("slot"), "waited_ms":
+                    last.get("waited_us", 0) // 1000}
+        peer = last.get("peer", -1)
+        transport = snap.get("transport", {})
+        if peer in transport:
+            evidence["peer_progress_age_ms"] = (
+                transport[peer].get("last_progress_age_us", -1) // 1000)
+        return evidence
+    failure = snap.get("transport_failure")
+    if failure and failure.get("peer", -1) >= 0:
+        return {"suspect": failure["peer"], "op": "transport",
+                "error": str(failure.get("message", ""))[:160],
+                "failures": failure.get("count", 1)}
+    return None
 
 
 def stall_reports(store: "gloo_tpu.Store", generation: int,

@@ -129,6 +129,18 @@ def to_prometheus(snapshot: dict,
     lines.append("# TYPE gloo_tpu_connect_retries_total counter")
     lines.append(f"gloo_tpu_connect_retries_total{_fmt_labels(base)} "
                  f"{snapshot.get('retries', 0)}")
+    lines.append("# TYPE gloo_tpu_stash_pauses_total counter")
+    lines.append(f"gloo_tpu_stash_pauses_total{_fmt_labels(base)} "
+                 f"{snapshot.get('stash_pauses', 0)}")
+    # Per-action series only; the total is their sum (scrapers derive
+    # it), so one metric name never carries two label schemas.
+    faults = snapshot.get("faults", {})
+    lines.append("# TYPE gloo_tpu_faults_injected_total counter")
+    for action, n in sorted(faults.items()):
+        if action == "total":
+            continue
+        lines.append(f"gloo_tpu_faults_injected_total"
+                     f"{_fmt_labels({**base, 'action': action})} {n}")
     wd = snapshot.get("watchdog", {})
     lines.append("# TYPE gloo_tpu_watchdog_stalls_total counter")
     lines.append(f"gloo_tpu_watchdog_stalls_total{_fmt_labels(base)} "

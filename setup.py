@@ -21,6 +21,17 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 class BuildPyWithNative(build_py):
     def run(self):
         super().run()
+        # A sanitizer flavor requested via the environment (SANITIZE=
+        # address|thread) flows straight through to `make native`, which
+        # reads it as a make variable and produces a SUFFIXED library
+        # (libtpucoll_asan.so / libtpucoll_tsan.so) next to the normal
+        # one. Wheels always ship the production libtpucoll.so; the
+        # sanitizer artifacts are a test rig, not a distribution.
+        if os.environ.get("SANITIZE"):
+            raise RuntimeError(
+                "refusing to build a wheel with SANITIZE set: sanitizer "
+                "flavors are for `make native SANITIZE=...` test rigs, "
+                "not distribution (unset SANITIZE to build the wheel)")
         lib = os.path.join(ROOT, "gloo_tpu", "_native", "libtpucoll.so")
         # Always (re)build: dependency tracking makes this a no-op when
         # up to date, and gating on os.path.exists(lib) would silently

@@ -1,0 +1,504 @@
+"""Chaos harness: drive the transport's failure machinery with the
+deterministic fault-injection plane (csrc/tpucoll/fault/, docs/faults.md)
+and assert the recovery CONTRACT, not just the happy path:
+
+- tolerated faults (delay, dup, stall) complete with correct results;
+- destructive faults (truncate, corrupt, kill) fail loudly with the
+  faulted peer named, and `resilience.rebuild_after_failure` produces a
+  working context afterwards;
+- connect-path faults (connect_refuse) exercise the typed-handshake
+  retry classification and still converge;
+- the same seed + schedule fires a byte-identical sequence
+  (tc_fault_report), so every red run here is replayable.
+
+Multiprocess (P=3) over a FileStore, like test_multiproc.py — real
+processes, real sockets, schedules delivered via TPUCOLL_FAULT_FILE so
+the env hook is covered too.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_worker(body: str, rank: int, size: int, store: str,
+                  schedule=None, extra_env=None):
+    """Launch a child running `body` with ctx/rank/size/store bound and
+    (optionally) a fault schedule installed via TPUCOLL_FAULT_FILE."""
+    env = dict(os.environ)
+    env.pop("TPUCOLL_FAULT_FILE", None)
+    if schedule is not None:
+        path = os.path.join(store, "fault_schedule.json")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                json.dump(schedule, f)
+        env["TPUCOLL_FAULT_FILE"] = path
+    if extra_env:
+        env.update(extra_env)
+    prog = textwrap.dedent("""
+        import json, os, signal, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+        from gloo_tpu import fault
+        from gloo_tpu.resilience import rebuild_after_failure
+
+        rank = {rank}; size = {size}
+        store = gloo_tpu.FileStore({store!r})
+        ctx = gloo_tpu.Context(rank, size, timeout=10.0)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+    """).format(repo=_REPO, rank=rank, size=size, store=store) + \
+        textwrap.dedent(body)
+    return subprocess.Popen([sys.executable, "-c", prog],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+def _run(body, size, store, schedule=None, extra_env=None, timeout=120):
+    procs = [_spawn_worker(body, r, size, store, schedule, extra_env)
+             for r in range(size)]
+    outs = [p.communicate(timeout=timeout) for p in procs]
+    return procs, outs
+
+
+def _assert_ok(procs, outs, ranks=None):
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if ranks is not None and r not in ranks:
+            continue
+        assert p.returncode == 0, (r, p.returncode, out)
+        assert "OK" in out[0], (r, out)
+
+
+# A shared body for the destructive fault classes: run an allreduce that
+# the schedule breaks, assert the loud failure (pattern per rank), then
+# rebuild over the same store and prove the new context computes a
+# correct allreduce at full size (no process died — the fault plane
+# breaks links, not ranks).
+_BREAK_THEN_REBUILD = """
+x = np.full(4096, float(rank + 1), dtype=np.float32)
+err = None
+try:
+    ctx.allreduce(x, tag=1, timeout=3.0)
+except gloo_tpu.IoError as exc:   # TimeoutError subclasses IoError
+    err = str(exc)
+assert err is not None, "allreduce unexpectedly survived the fault"
+expect = {expect_err!r}
+if expect.get(str(rank)):
+    assert expect[str(rank)] in err, (rank, err)
+new_ctx, new_rank, new_size = rebuild_after_failure(
+    store, gloo_tpu.Device(), old_rank=rank, old_size=size, generation=1,
+    settle=3.0, timeout=60.0, failed_context=ctx)
+assert new_ctx is not None, "rebuild failed"
+assert new_size == size, new_size
+y = np.full(1024, float(new_rank + 1), dtype=np.float32)
+new_ctx.allreduce(y, tag=2)
+assert y[0] == size * (size + 1) / 2, y[0]
+new_ctx.close()
+print("OK", json.dumps(fault.report(rank=rank)))
+"""
+
+
+def test_chaos_delay_completes():
+    """An injected link delay is invisible to correctness: collectives
+    complete with the right values, and the firing is visible in the
+    report AND the injecting rank's metrics registry."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 1, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data"},
+         "action": "delay", "ms": 120, "count": 2}]}
+    body = """
+for i in range(3):
+    x = np.full(2048, float(rank + 1), dtype=np.float32)
+    ctx.allreduce(x, tag=i)
+    assert x[0] == size * (size + 1) / 2, (i, x[0])
+if rank == 1:
+    fired = fault.report(rank=1)
+    assert sum(1 for e in fired if e["action"] == "delay") == 2, fired
+    snap = ctx.metrics()
+    assert snap["faults"].get("delay", 0) == 2, snap["faults"]
+ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_dup_completes():
+    """Duplicated wire messages are tolerated: the first copy satisfies
+    the posted receive, the stale duplicate lands in the stash and is
+    dropped at close. Requires the app-level rule that slots/tags are
+    not reused — which these unique-tag workloads obey."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 2, "faults": [
+        {"when": {"rank": 1, "opcode": "data", "min_bytes": 1},
+         "action": "dup", "count": 2},
+        {"when": {"rank": 1, "opcode": "put"}, "action": "dup"}]}
+    body = """
+# p2p ring with unique slots, then a collective with a unique tag.
+mine = np.full(512, float(rank), dtype=np.float64)
+got = np.zeros(512, dtype=np.float64)
+sbuf = ctx.register(mine)
+rbuf = ctx.register(got)
+sbuf.send((rank + 1) % size, slot=100 + rank)
+rbuf.recv((rank - 1) % size, slot=100 + (rank - 1) % size)
+sbuf.wait_send(); rbuf.wait_recv()
+assert got[0] == float((rank - 1) % size), got[0]
+x = np.full(1000, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x, tag=7)
+assert x[0] == size * (size + 1) / 2, x[0]
+# Duplicated notify-put: the data write is idempotent and the duplicate
+# goes out notify-less, so EXACTLY one arrival completes per put.
+region = np.zeros(64, dtype=np.float64)
+region_buf = ctx.register(region)
+keys = [k.tobytes() for k in ctx.allgather(
+    np.frombuffer(region_buf.get_remote_key(), dtype=np.uint8).copy(),
+    tag=8)]
+if rank == 1:
+    payload = np.full(64, 42.0, dtype=np.float64)
+    pbuf = ctx.register(payload)
+    pbuf.put(keys[0], notify=True)
+    pbuf.wait_send()
+if rank == 0:
+    assert region_buf.wait_put(timeout=10.0) == 1
+    assert region[0] == 42.0, region[0]
+    try:
+        src = region_buf.wait_put(timeout=0.5)
+        raise SystemExit(
+            f"duplicate notify-put delivered a second arrival from {src}")
+    except gloo_tpu.TimeoutError:
+        pass  # exactly one arrival: the duplicate was notify-less
+ctx.barrier(tag=9)
+if rank == 1:
+    fired = fault.report(rank=1)
+    assert any(e["action"] == "dup" and e["opcode"] == "data"
+               for e in fired), fired
+    assert any(e["action"] == "dup" and e["opcode"] == "put"
+               for e in fired), fired
+ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_stall_trips_watchdog():
+    """A stalled peer trips the straggler watchdog on the blocked rank,
+    which names the peer and slot — and the collective still completes
+    once the stall clears (a stall is a delay, not a death)."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 3, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1},
+         "action": "stall", "ms": 700}]}
+    body = """
+ctx.set_watchdog(0.15)
+x = np.full(2048, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x, tag=1)
+assert x[0] == size * (size + 1) / 2, x[0]
+if rank == 0:
+    snap = ctx.metrics()
+    assert snap["watchdog"]["stalls"] >= 1, snap["watchdog"]
+    assert snap["watchdog"]["last"]["peer"] == 1, snap["watchdog"]
+if rank == 1:
+    assert any(e["action"] == "stall" for e in fault.report(rank=1))
+ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_corrupt_fails_loudly_then_rebuild():
+    """A corrupted wire header is detected at the protocol layer: the
+    receiver poisons the pair naming the sender, every rank fails
+    loudly, and a rebuild over the same store recovers all survivors."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 4, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1,
+                  "min_bytes": 1024},
+         "action": "corrupt"}]}
+    body = _BREAK_THEN_REBUILD.format(
+        expect_err={"0": "protocol violation from rank 1"})
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+    # The corrupt fired exactly once, on rank 1 (deterministic nth=1).
+    rank1_fired = json.loads(outs[1][0].split("OK ", 1)[1])
+    assert [e["action"] for e in rank1_fired] == ["corrupt"], rank1_fired
+
+
+def test_chaos_truncate_fails_loudly_then_rebuild():
+    """A truncated message severs the stream mid-payload: the receiver
+    observes EOF inside a message and names the peer; the sender's pair
+    carries the injection message. Rebuild recovers everyone."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 5, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1,
+                  "min_bytes": 1024},
+         "action": "truncate"}]}
+    body = _BREAK_THEN_REBUILD.format(
+        expect_err={"0": "rank 1",
+                    "1": "fault injection: truncated message to rank 0"})
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_kill_fails_loudly_then_rebuild():
+    """A hard-killed pair drives the full resilience path: the injecting
+    rank's collective raises naming the peer, the peer sees an
+    unexpected EOF naming the injector, and rebuild_after_failure forms
+    a working context (all processes survive a link kill)."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 6, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1,
+                  "min_bytes": 1024},
+         "action": "kill"}]}
+    body = _BREAK_THEN_REBUILD.format(
+        expect_err={"1": "fault injection: killed connection to rank 0",
+                    "0": "rank 1"})
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_connect_refuse_exercises_retry():
+    """Refused connections during the handshake take the typed retry
+    classification: bounded backoff retries, counted in the metrics
+    registry, and the mesh still comes up."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 7, "faults": [
+        {"when": {"rank": 2}, "action": "connect_refuse", "count": 2}]}
+    body = """
+x = np.full(1000, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x, tag=1)
+assert x[0] == size * (size + 1) / 2, x[0]
+if rank == 2:
+    fired = fault.report(rank=2)
+    assert sum(1 for e in fired
+               if e["action"] == "connect_refuse") == 2, fired
+    assert ctx.metrics()["retries"] >= 2
+ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store, schedule)
+    _assert_ok(procs, outs)
+
+
+def test_chaos_same_seed_same_firing_sequence():
+    """Acceptance: same seed + same schedule => byte-identical fault
+    firing sequence, via tc_fault_report across two runs of the same
+    deterministic workload (probabilistic rule, so the PRNG — not just
+    the counters — must reproduce)."""
+    from gloo_tpu import fault
+    from tests.harness import spawn
+
+    schedule = {"seed": 11, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.5, "seed": 99}]}
+
+    def workload():
+        def fn(ctx, rank):
+            data = np.arange(64, dtype=np.float64)
+            out = np.zeros(64, dtype=np.float64)
+            for i in range(40):
+                if rank == 1:
+                    ctx.send(data, dst=0, slot=500 + i)
+                else:
+                    ctx.recv(out, src=1, slot=500 + i)
+            ctx.barrier(tag=999)
+
+        spawn(2, fn, timeout=60)
+        return json.dumps(fault.report(rank=1), sort_keys=True)
+
+    fault.install(schedule)
+    try:
+        first = workload()
+        fault.install(schedule)  # reinstall: reset counters + report
+        second = workload()
+    finally:
+        fault.clear()
+    assert first == second
+    fired = json.loads(first)
+    # The coin actually flipped both ways (0 or 40 fires would mean the
+    # prob gate is broken, not deterministic).
+    assert 0 < len(fired) < 40, len(fired)
+
+
+def test_sigkill_mid_allreduce_rebuild_and_blame():
+    """Satellite: SIGKILL one rank mid-allreduce. Survivors must (a)
+    rebuild into a working smaller context via rebuild_after_failure and
+    (b) publish failure evidence such that stall_reports names the dead
+    rank — even though detection was EOF-fast and the watchdog never
+    fired (the transport-failure record supplies the suspect)."""
+    import gloo_tpu
+    from gloo_tpu.resilience import stall_reports
+
+    store = tempfile.mkdtemp()
+    body = """
+x = np.full(1 << 18, float(rank + 1), dtype=np.float32)
+if rank == 2:
+    os.kill(os.getpid(), signal.SIGKILL)
+try:
+    ctx.allreduce(x, tag=1, timeout=3.0)
+    print("UNEXPECTED-SUCCESS"); sys.exit(3)
+except gloo_tpu.IoError:
+    pass
+new_ctx, new_rank, new_size = rebuild_after_failure(
+    store, gloo_tpu.Device(), old_rank=rank, old_size=size, generation=1,
+    settle=3.0, timeout=60.0, failed_context=ctx)
+assert new_ctx is not None, "rebuild failed"
+assert new_size == 2, new_size
+y = np.full(100, float(new_rank + 1), dtype=np.float32)
+new_ctx.allreduce(y, tag=2)
+assert y[0] == 3.0, y[0]
+new_ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store)
+    assert procs[2].returncode == -signal.SIGKILL
+    _assert_ok(procs, outs, ranks=(0, 1))
+    reports = stall_reports(gloo_tpu.FileStore(store), generation=1,
+                            old_size=3)
+    assert reports, "no survivor published failure evidence"
+    suspects = [r.get("suspect") for r in reports.values()]
+    assert max(set(suspects), key=suspects.count) == 2, reports
+
+
+def test_stash_backpressure_under_injected_delay():
+    """Satellite: when the fault plane delays a rank's receive posting,
+    the peer's early arrivals cross the TPUCOLL_MAX_STASH_BYTES
+    watermark, backpressure engages, and the engagement is visible in
+    the metrics registry (stash_pauses / per-peer rx_pauses) — then the
+    delayed receives drain everything correctly."""
+    from gloo_tpu import fault
+    from tests.harness import spawn
+
+    os.environ["TPUCOLL_MAX_STASH_BYTES"] = str(1 << 20)
+    fault.install({"seed": 12, "faults": [
+        {"when": {"rank": 0, "peer": 1, "opcode": "data", "nth": 1},
+         "action": "delay", "ms": 800}]})
+    chunk_words = (256 << 10) // 8  # 256 KiB per message
+    n_chunks = 24                   # 6 MiB total, far past the 1 MiB mark
+
+    def fn(ctx, rank):
+        if rank == 1:
+            bufs = []
+            for i in range(n_chunks):
+                data = np.full(chunk_words, float(i), dtype=np.float64)
+                b = ctx.register(data)
+                b.send(0, slot=100 + i)
+                bufs.append((b, data))
+            go = np.zeros(4, dtype=np.float64)
+            ctx.recv(go, src=0, slot=1)
+            for b, _ in bufs:
+                b.wait_send(timeout=30.0)
+            ctx.barrier(tag=999)
+            return None
+        # rank 0: the delayed send stalls this thread ~800ms before any
+        # receive is posted — exactly "the fault plane delays posted
+        # receives" — while rank 1's flood crosses the watermark.
+        go = np.zeros(4, dtype=np.float64)
+        ctx.send(go, dst=1, slot=1)   # fault fires here (sleeps)
+        outs = [np.zeros(chunk_words, dtype=np.float64)
+                for _ in range(n_chunks)]
+        bufs = [ctx.register(o) for o in outs]
+        for i, b in enumerate(bufs):
+            b.recv(1, slot=100 + i)
+        for b in bufs:
+            assert b.wait_recv(timeout=30.0) == 1
+        for i, o in enumerate(outs):
+            assert o[0] == float(i), (i, o[0])
+        snap = ctx.metrics()
+        ctx.barrier(tag=999)
+        return snap
+
+    try:
+        results = spawn(2, fn, timeout=90)
+    finally:
+        fault.clear()
+        del os.environ["TPUCOLL_MAX_STASH_BYTES"]
+    snap = results[0]
+    assert snap["stash_pauses"] >= 1, snap["stash_pauses"]
+    assert snap["transport"][1]["rx_pauses"] >= 1, snap["transport"][1]
+
+
+def test_fault_schedule_malformed_fails_loudly():
+    """An operator's explicit schedule must never be silently dropped:
+    malformed JSON and unknown fields raise, both through install() and
+    the TPUCOLL_FAULT_FILE hook."""
+    import gloo_tpu
+    from gloo_tpu import fault
+
+    with pytest.raises(gloo_tpu.Error, match="fault schedule"):
+        fault.install("{not json")
+    with pytest.raises(gloo_tpu.Error, match="unknown action"):
+        fault.install({"faults": [{"action": "explode"}]})
+    with pytest.raises(gloo_tpu.Error, match="faults"):
+        fault.install({"seed": 3})
+    # Misspelled keys must not silently reinterpret the rule (a typo'd
+    # "rank" would otherwise widen a kill to every rank).
+    with pytest.raises(gloo_tpu.Error, match='unknown field "rnak"'):
+        fault.install({"faults": [{"when": {"rnak": 1},
+                                   "action": "kill"}]})
+    with pytest.raises(gloo_tpu.Error, match='unknown field "mss"'):
+        fault.install({"faults": [{"action": "delay", "mss": 500}]})
+    # env-hook: a child process pointed at a bad file must fail connect.
+    store = tempfile.mkdtemp()
+    bad = os.path.join(store, "bad.json")
+    with open(bad, "w") as f:
+        f.write("{broken")
+    prog = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import gloo_tpu
+        ctx = gloo_tpu.Context(0, 1, timeout=5.0)
+        try:
+            ctx.connect_full_mesh(gloo_tpu.FileStore({store!r}),
+                                  gloo_tpu.Device())
+            print("UNEXPECTED"); sys.exit(3)
+        except gloo_tpu.Error as e:
+            assert "fault schedule" in str(e), e
+            print("LOUD"); sys.exit(0)
+    """)
+    env = dict(os.environ, TPUCOLL_FAULT_FILE=bad)
+    p = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, env=env, timeout=60)
+    assert p.returncode == 0 and "LOUD" in p.stdout, (p.stdout, p.stderr)
+
+
+def test_wildcard_destructive_rule_skips_connect_events():
+    """A wildcard-opcode destructive rule (the fault.py docstring's own
+    {"when": {"rank": 1}, "action": "kill", "count": 1} example) must
+    not match — or silently burn its count on — connect events: the
+    kill lands on rank 1's first SEND, and the report never claims a
+    kill fired at opcode connect."""
+    import gloo_tpu
+    from gloo_tpu import fault
+    from tests.harness import spawn
+
+    fault.install({"faults": [
+        {"when": {"rank": 1}, "action": "kill", "count": 1}]})
+
+    def fn(ctx, rank):
+        x = np.full(256, float(rank + 1), dtype=np.float32)
+        try:
+            ctx.allreduce(x, tag=1, timeout=5.0)
+            return "survived"
+        except gloo_tpu.Error:
+            return "failed"
+
+    try:
+        results = spawn(2, fn, timeout=60)
+        fired = fault.report()
+    finally:
+        fault.clear()
+    assert "failed" in results, results
+    assert all(e["opcode"] != "connect" for e in fired), fired
+    assert any(e["action"] == "kill" and e["opcode"] == "data"
+               for e in fired), fired

@@ -52,6 +52,16 @@ def test_example_observability():
     assert "labeled rank rows" in out
 
 
+def test_example_chaos():
+    out = _run("example_chaos.py", timeout=180)
+    assert "chaos example: OK" in out
+    assert "fault firing sequence:" in out
+    assert '"action": "stall"' in out and '"action": "kill"' in out
+    assert "rebuilt OK" in out
+    assert "[watchdog] rank0 was blocked" in out
+    assert "merged chaos trace" in out
+
+
 def test_bench_autotune_smoke(tmp_path):
     """bench.py --autotune smoke cell (tiny sizes, 2 ranks): the sweep
     must elect a table all ranks agree on, persist it, and the tuned

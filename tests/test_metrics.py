@@ -267,3 +267,74 @@ def test_merge_snapshots():
     assert merged["ops"]["allreduce"]["bytes"] == 80
     assert merged["ops"]["allreduce"]["latency_us"]["count"] == 2
     assert "0->1" in merged["transport"] and "1->0" in merged["transport"]
+
+
+def test_fault_and_backpressure_fields_in_snapshot():
+    """The registry's PR-3 fields are always present: faults (zero when
+    no schedule is installed), stash_pauses, per-peer rx_pauses, and the
+    transport_failure record (null while healthy) — and they drain."""
+    def fn(ctx, rank):
+        x = np.ones(16, dtype=np.float32)
+        ctx.allreduce(x)
+        snap = ctx.metrics(drain=True)
+        drained = ctx.metrics()
+        return snap, drained
+
+    snap, drained = spawn(2, fn)[0]
+    assert snap["faults"] == {"total": 0}
+    assert snap["stash_pauses"] == 0
+    assert snap["transport_failure"] is None
+    assert snap["transport"][1]["rx_pauses"] == 0
+    assert drained["faults"] == {"total": 0}
+
+
+def test_transport_failure_names_first_failed_peer():
+    """An UNEXPECTED peer death is recorded in
+    metrics()["transport_failure"] even with the watchdog disarmed — the
+    EOF-fast evidence resilience uses to blame the dead rank — while an
+    orderly goodbye departure is not blamed (clean shutdown skew is not
+    a death)."""
+    import gloo_tpu
+    from gloo_tpu import fault
+    from gloo_tpu.resilience import _stall_evidence
+
+    fault.install({"faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data"},
+         "action": "kill", "count": 1}]})
+
+    def fn(ctx, rank):
+        x = np.zeros(8, dtype=np.float32)
+        if rank == 0:
+            try:
+                ctx.recv(x, 1, slot=3, timeout=10)
+            except gloo_tpu.IoError:
+                pass
+            return ctx.metrics(), _stall_evidence(ctx)
+        try:
+            ctx.send(x, 1 - rank, slot=3)  # the kill fires here
+        except gloo_tpu.IoError:
+            pass
+        return None
+
+    try:
+        snap, evidence = spawn(2, fn)[0]
+    finally:
+        fault.clear()
+    failure = snap["transport_failure"]
+    assert failure is not None and failure["peer"] == 1, failure
+    assert evidence is not None and evidence["suspect"] == 1, evidence
+
+    # Orderly departure: close() announces goodbye; no blame recorded.
+    def orderly(ctx, rank):
+        x = np.zeros(8, dtype=np.float32)
+        if rank == 0:
+            try:
+                ctx.recv(x, 1, slot=3, timeout=10)
+            except gloo_tpu.IoError:
+                pass
+            return ctx.metrics()
+        ctx.close()
+        return None
+
+    snap = spawn(2, orderly)[0]
+    assert snap["transport_failure"] is None, snap["transport_failure"]
