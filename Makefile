@@ -1,4 +1,4 @@
-.PHONY: native native-cmake native-cc test clean
+.PHONY: native native-cmake native-cc test clean postmortem-demo
 
 # Build the native core. Prefers the CMake/Ninja build (full configure
 # checks, separate bench/test binaries); falls back to a plain
@@ -86,6 +86,13 @@ $(FB_BUILD)/%.o: csrc/%.cc
 
 test: native
 	python -m pytest tests/ -x -q
+
+# Post-mortem walkthrough (docs/flightrec.md): inject a stall with the
+# fault plane, let the watchdog auto-dump the always-on flight recorder,
+# provoke a schedule desync, then merge the per-rank dumps and print the
+# blame — the whole chaos -> recorder -> merge -> blame chain.
+postmortem-demo: native
+	JAX_PLATFORMS=cpu python examples/example_flightrec.py
 
 clean:
 	rm -rf build build-fb build-fb-asan build-fb-tsan gloo_tpu/_native/*.so

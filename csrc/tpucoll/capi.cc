@@ -7,8 +7,11 @@
 //  - blocking calls release the GIL implicitly because ctypes drops it for
 //    foreign calls.
 #include <cstring>
+#include <deque>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/common/debug.h"
@@ -98,6 +101,57 @@ tpucoll::Tracer::Span maybeSpan(UnboundBuffer* buf, const char* name) {
 
 tpucoll::Metrics* bufMetrics(UnboundBuffer* buf) {
   return buf->transportContext()->metrics();
+}
+
+tpucoll::FlightRecorder* bufFlightrec(UnboundBuffer* buf) {
+  return buf->transportContext()->flightrec();
+}
+
+// Flight-recorder opcodes for user-facing p2p ops.
+const char kFrSend[] = "send";
+const char kFrRecv[] = "recv";
+const char kFrPut[] = "put";
+const char kFrGet[] = "get";
+
+// Flight-recorder p2p completion bookkeeping: each buffer's posted ops'
+// ring seqs, per direction, so a wait completes exactly an op posted on
+// THE BUFFER IT WAITED ON — never an older op pending on a different
+// (possibly hung) buffer. Waits on one buffer count completions rather
+// than naming ops, so within a buffer the oldest post of the direction
+// is the honest match. A mutex here is fine: this is the Python-facing
+// p2p path (a ctypes round-trip per call), not the collective hot path —
+// the recorder itself stays lock-free.
+struct FrPending {
+  std::deque<uint64_t> send;  // send + put posts
+  std::deque<uint64_t> recv;  // recv + get posts
+};
+std::mutex g_frPendingMu;
+std::unordered_map<void*, FrPending> g_frPending;
+
+void frPush(void* buf, bool isSend, uint64_t seq) {
+  std::lock_guard<std::mutex> guard(g_frPendingMu);
+  FrPending& p = g_frPending[buf];
+  (isSend ? p.send : p.recv).push_back(seq);
+}
+
+uint64_t frPop(void* buf, bool isSend) {
+  std::lock_guard<std::mutex> guard(g_frPendingMu);
+  auto it = g_frPending.find(buf);
+  if (it == g_frPending.end()) {
+    return tpucoll::FlightRecorder::kNoSeq;
+  }
+  std::deque<uint64_t>& q = isSend ? it->second.send : it->second.recv;
+  if (q.empty()) {
+    return tpucoll::FlightRecorder::kNoSeq;
+  }
+  const uint64_t seq = q.front();
+  q.pop_front();
+  return seq;
+}
+
+void frErase(void* buf) {
+  std::lock_guard<std::mutex> guard(g_frPendingMu);
+  g_frPending.erase(buf);
 }
 
 }  // namespace
@@ -361,6 +415,45 @@ int tc_metrics_json(void* ctx, int drain, uint8_t** out, size_t* outLen) {
     }
     std::memcpy(*out, json.data(), json.size());
   });
+}
+
+// ---- flight recorder (common/flightrec.h) ----
+
+// Always-on flight-recorder ring as a JSON document (docs/flightrec.md);
+// malloc'd, free with tc_buf_free. Never drains: the ring keeps rolling.
+int tc_flightrec_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    std::string json = asContext(ctx)->flightrec().toJson();
+    *outLen = json.size();
+    *out = static_cast<uint8_t*>(malloc(json.size()));
+    if (*out == nullptr && !json.empty()) {
+      throw std::bad_alloc();
+    }
+    std::memcpy(*out, json.data(), json.size());
+  });
+}
+
+// Explicit dump to `path` (the Python-side trigger; automatic triggers
+// write to TPUCOLL_FLIGHTREC_DIR on their own).
+int tc_flightrec_dump(void* ctx, const char* path) {
+  return wrap([&] {
+    TC_ENFORCE(path != nullptr && path[0] != '\0',
+               "tc_flightrec_dump: empty path");
+    TC_ENFORCE(asContext(ctx)->flightrec().dumpToFile(path, "explicit", -1),
+               "tc_flightrec_dump: cannot write ", path);
+  });
+}
+
+// Next per-context collective sequence number (== ops recorded so far).
+uint64_t tc_flightrec_seq(void* ctx) {
+  return asContext(ctx)->flightrec().nextSeq();
+}
+
+// Opt-in fatal-signal dumping (SIGSEGV/SIGABRT/SIGBUS/SIGFPE/SIGILL/
+// SIGTERM -> dump every live recorder to TPUCOLL_FLIGHTREC_DIR, then
+// re-raise). Also installable via TPUCOLL_FLIGHTREC_SIGNALS=1.
+void tc_flightrec_install_signal_handler() {
+  tpucoll::FlightRecorder::installSignalHandler();
 }
 
 // ---- collective autotuning plane (tuning/) ----
@@ -718,7 +811,10 @@ void* tc_buffer_new(void* ctx, void* ptr, size_t size) {
   }
 }
 
-void tc_buffer_free(void* buf) { delete asBuffer(buf); }
+void tc_buffer_free(void* buf) {
+  frErase(buf);
+  delete asBuffer(buf);
+}
 
 int tc_buffer_send(void* buf, int dst, uint64_t slot, size_t offset,
                    size_t nbytes) {
@@ -726,6 +822,9 @@ int tc_buffer_send(void* buf, int dst, uint64_t slot, size_t offset,
     asBuffer(buf)->send(dst, slot, offset, nbytes);
     if (auto* m = bufMetrics(asBuffer(buf))) {
       m->recordCall(tpucoll::MetricOp::kSend, nbytes);
+    }
+    if (auto* fr = bufFlightrec(asBuffer(buf))) {
+      frPush(buf, /*isSend=*/true, fr->beginP2p(kFrSend, slot, dst, nbytes));
     }
   });
 }
@@ -737,6 +836,10 @@ int tc_buffer_recv(void* buf, int src, uint64_t slot, size_t offset,
     if (auto* m = bufMetrics(asBuffer(buf))) {
       m->recordCall(tpucoll::MetricOp::kRecv, nbytes);
     }
+    if (auto* fr = bufFlightrec(asBuffer(buf))) {
+      frPush(buf, /*isSend=*/false,
+             fr->beginP2p(kFrRecv, slot, src, nbytes));
+    }
   });
 }
 
@@ -747,6 +850,11 @@ int tc_buffer_recv_any(void* buf, const int* srcs, size_t nsrcs,
                         nbytes);
     if (auto* m = bufMetrics(asBuffer(buf))) {
       m->recordCall(tpucoll::MetricOp::kRecv, nbytes);
+    }
+    if (auto* fr = bufFlightrec(asBuffer(buf))) {
+      // peer resolves when the wait completes (setPeer).
+      frPush(buf, /*isSend=*/false,
+             fr->beginP2p(kFrRecv, slot, nsrcs == 1 ? srcs[0] : -1, nbytes));
     }
   });
 }
@@ -774,6 +882,12 @@ int tc_buffer_wait_send(void* buf, int64_t timeoutMs) {
                      tpucoll::Tracer::nowUs() - startUs);
     if (code != TC_OK) {
       m->recordError(tpucoll::MetricOp::kSend);
+    }
+  }
+  if (code == TC_OK && rv == TC_OK) {
+    if (auto* fr = bufFlightrec(b)) {
+      fr->transition(frPop(buf, /*isSend=*/true),
+                     tpucoll::FlightRecorder::kCompleted);
     }
   }
   return code != TC_OK ? code : rv;
@@ -822,6 +936,15 @@ int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
       m->recordError(tpucoll::MetricOp::kRecv);
     }
   }
+  if (code == TC_OK && rv == TC_OK) {
+    if (auto* fr = bufFlightrec(b)) {
+      const uint64_t seq = frPop(buf, /*isSend=*/false);
+      fr->transition(seq, tpucoll::FlightRecorder::kCompleted);
+      if (srcOut != nullptr) {
+        fr->setPeer(seq, *srcOut);
+      }
+    }
+  }
   return code != TC_OK ? code : rv;
 }
 
@@ -842,6 +965,9 @@ int tc_buffer_put(void* buf, const char* key, size_t keyLen, size_t offset,
   return wrap([&] {
     asBuffer(buf)->put(std::string(key, keyLen), offset, roffset, nbytes,
                        notify != 0);
+    if (auto* fr = bufFlightrec(asBuffer(buf))) {
+      frPush(buf, /*isSend=*/true, fr->beginP2p(kFrPut, 0, -1, nbytes));
+    }
   });
 }
 
@@ -850,6 +976,9 @@ int tc_buffer_get(void* buf, const char* key, size_t keyLen, uint64_t slot,
   return wrap([&] {
     asBuffer(buf)->get(std::string(key, keyLen), slot, offset, roffset,
                        nbytes);
+    if (auto* fr = bufFlightrec(asBuffer(buf))) {
+      frPush(buf, /*isSend=*/false, fr->beginP2p(kFrGet, slot, -1, nbytes));
+    }
   });
 }
 

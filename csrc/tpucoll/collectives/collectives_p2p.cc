@@ -25,6 +25,9 @@ void barrier(BarrierOptions& opts) {
   TC_ENFORCE(ctx != nullptr, "barrier: null context");
   auto traceSpan = ctx->tracer().span("barrier");
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kBarrier, 0);
+  FlightRecOp frOp(&ctx->flightrec(), "barrier", nullptr,
+                   Slot::build(SlotPrefix::kBarrier, opts.tag).value(), -1,
+                   0, FlightRecorder::kNoDtype);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -56,6 +59,10 @@ void broadcast(BroadcastOptions& opts) {
   auto traceSpan = ctx->tracer().span("broadcast", opts.count * elementSize(opts.dtype), opts.root);
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kBroadcast,
                       opts.count * elementSize(opts.dtype));
+  FlightRecOp frOp(&ctx->flightrec(), "broadcast", nullptr,
+                   Slot::build(SlotPrefix::kBroadcast, opts.tag).value(),
+                   opts.root, opts.count * elementSize(opts.dtype),
+                   static_cast<uint8_t>(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -143,6 +150,10 @@ void gather(GatherOptions& opts) {
       "gather", opts.count * elementSize(opts.dtype), opts.root);
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kGather,
                       opts.count * elementSize(opts.dtype));
+  FlightRecOp frOp(&ctx->flightrec(), "gather", nullptr,
+                   Slot::build(SlotPrefix::kGather, opts.tag).value(),
+                   opts.root, opts.count * elementSize(opts.dtype),
+                   static_cast<uint8_t>(opts.dtype));
   GathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -157,12 +168,22 @@ void gatherv(GathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "gatherv: null context");
   auto traceSpan = ctx->tracer().span("gatherv", 0, opts.root);
-  MetricsOp metricsOp(
-      &ctx->metrics(), MetricOp::kGatherv,
-      // Guarded: the counts-size enforce runs inside gathervRun.
+  // Guarded: the counts-size enforce runs inside gathervRun.
+  const uint64_t myBytes =
       static_cast<size_t>(ctx->rank()) < opts.counts.size()
           ? opts.counts[ctx->rank()] * elementSize(opts.dtype)
-          : 0);
+          : 0;
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kGatherv, myBytes);
+  // Fingerprint over the GROUP total: per-rank counts legitimately
+  // differ on a matching gatherv schedule, their sum must not.
+  uint64_t totalCount = 0;
+  for (size_t c : opts.counts) {
+    totalCount += c;
+  }
+  FlightRecOp frOp(&ctx->flightrec(), "gatherv", nullptr,
+                   Slot::build(SlotPrefix::kGather, opts.tag).value(),
+                   opts.root, myBytes, static_cast<uint8_t>(opts.dtype),
+                   totalCount * elementSize(opts.dtype));
   gathervRun(opts);
 }
 
@@ -216,6 +237,10 @@ void scatter(ScatterOptions& opts) {
   auto traceSpan = ctx->tracer().span("scatter", opts.count * elementSize(opts.dtype), opts.root);
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kScatter,
                       opts.count * elementSize(opts.dtype));
+  FlightRecOp frOp(&ctx->flightrec(), "scatter", nullptr,
+                   Slot::build(SlotPrefix::kScatter, opts.tag).value(),
+                   opts.root, opts.count * elementSize(opts.dtype),
+                   static_cast<uint8_t>(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -331,6 +356,10 @@ void alltoall(AlltoallOptions& opts) {
   const size_t blockBytes = opts.count * elementSize(opts.dtype);
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAlltoall,
                       blockBytes * ctx->size());
+  FlightRecOp frOp(&ctx->flightrec(), "alltoall", nullptr,
+                   Slot::build(SlotPrefix::kAlltoall, opts.tag).value(),
+                   -1, blockBytes * ctx->size(),
+                   static_cast<uint8_t>(opts.dtype));
   // Crossover: Bruck's ceil(log2 P) rounds win while per-block payload
   // is latency-dominated; the pairwise exchange's P-1 single-hop
   // rounds win once bandwidth dominates (each Bruck block travels up
@@ -345,12 +374,14 @@ void alltoall(AlltoallOptions& opts) {
   if (ctx->size() > 2 && blockBytes > 0 && blockBytes <= bruckMax) {
     auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
                                         "bruck");
+    frOp.setAlgorithm("bruck");
     bruckAlltoall(ctx, opts, blockBytes,
                   detail::effectiveTimeout(opts));
     return;
   }
   auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
                                       "pairwise");
+  frOp.setAlgorithm("pairwise");
   AlltoallvOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -371,6 +402,12 @@ void alltoallv(AlltoallvOptions& opts) {
   }
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAlltoallv,
                       inCountTotal * elementSize(opts.dtype));
+  // fpBytes = 0: alltoallv's in/out counts are legitimately different on
+  // every rank, so only (op, dtype) participate in the fingerprint.
+  FlightRecOp frOp(&ctx->flightrec(), "alltoallv", nullptr,
+                   Slot::build(SlotPrefix::kAlltoall, opts.tag).value(),
+                   -1, inCountTotal * elementSize(opts.dtype),
+                   static_cast<uint8_t>(opts.dtype), /*fpBytes=*/0);
   alltoallvRun(opts);
 }
 

@@ -235,12 +235,23 @@ void allgatherv(AllgathervOptions& opts) {
   Context* ctx = opts.context;
   TC_ENFORCE(ctx != nullptr, "allgatherv: null context");
   auto traceSpan = ctx->tracer().span("allgatherv");
-  MetricsOp metricsOp(
-      &ctx->metrics(), MetricOp::kAllgatherv,
-      // Guarded: the counts-size enforce runs inside allgathervRun.
+  // Guarded: the counts-size enforce runs inside allgathervRun.
+  const uint64_t myBytes =
       static_cast<size_t>(ctx->rank()) < opts.counts.size()
           ? opts.counts[ctx->rank()] * elementSize(opts.dtype)
-          : 0);
+          : 0;
+  MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAllgatherv, myBytes);
+  // Fingerprint over the GROUP total: per-rank counts legitimately
+  // differ on a matching allgatherv schedule, the counts vector (and so
+  // its sum) must not.
+  uint64_t totalCount = 0;
+  for (size_t c : opts.counts) {
+    totalCount += c;
+  }
+  FlightRecOp frOp(&ctx->flightrec(), "allgatherv", nullptr,
+                   Slot::build(SlotPrefix::kAllgather, opts.tag).value(),
+                   -1, myBytes, static_cast<uint8_t>(opts.dtype),
+                   totalCount * elementSize(opts.dtype));
   allgathervRun(opts);
 }
 
@@ -251,6 +262,10 @@ void allgather(AllgatherOptions& opts) {
       "allgather", opts.count * elementSize(opts.dtype));
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAllgather,
                       opts.count * elementSize(opts.dtype));
+  FlightRecOp frOp(&ctx->flightrec(), "allgather", nullptr,
+                   Slot::build(SlotPrefix::kAllgather, opts.tag).value(),
+                   -1, opts.count * elementSize(opts.dtype),
+                   static_cast<uint8_t>(opts.dtype));
   AllgathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -330,6 +345,9 @@ void allreduce(AllreduceOptions& opts) {
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kAllreduce, nbytes);
+  FlightRecOp frOp(&ctx->flightrec(), "allreduce", nullptr,
+                   Slot::build(SlotPrefix::kAllreduce, opts.tag).value(),
+                   -1, nbytes, static_cast<uint8_t>(opts.dtype));
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -375,6 +393,7 @@ void allreduce(AllreduceOptions& opts) {
     }
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1, tuning::allreduceAlgorithmName(algo));
+    frOp.setAlgorithm(tuning::allreduceAlgorithmName(algo));
     switch (algo) {
       case AllreduceAlgorithm::kRing:
         algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
@@ -544,6 +563,9 @@ void reduce(ReduceOptions& opts) {
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kReduce, nbytes);
+  FlightRecOp frOp(&ctx->flightrec(), "reduce", nullptr,
+                   Slot::build(SlotPrefix::kReduce, opts.tag).value(),
+                   opts.root, nbytes, static_cast<uint8_t>(opts.dtype));
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -593,6 +615,7 @@ void reduce(ReduceOptions& opts) {
   }
   auto traceSpan = ctx->tracer().span(
       "reduce", nbytes, -1, tuning::reduceAlgorithmName(algo));
+  frOp.setAlgorithm(tuning::reduceAlgorithmName(algo));
   switch (algo) {
     case ReduceAlgorithm::kBinomial:
       binomialReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
@@ -625,6 +648,10 @@ void reduceScatter(ReduceScatterOptions& opts) {
   Blocks blocks = countBlocks(opts.recvCounts, elsize);
   const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kReduceScatter, total);
+  FlightRecOp frOp(
+      &ctx->flightrec(), "reduce_scatter", nullptr,
+      Slot::build(SlotPrefix::kReduceScatter, opts.tag).value(), -1, total,
+      static_cast<uint8_t>(opts.dtype));
 
   if (size == 1) {
     std::memcpy(opts.output, opts.input, total);
@@ -661,6 +688,7 @@ void reduceScatter(ReduceScatterOptions& opts) {
                                 : ReduceScatterAlgorithm::kRing;
     }
   }
+  frOp.setAlgorithm(tuning::reduceScatterAlgorithmName(algo));
   switch (algo) {
     case ReduceScatterAlgorithm::kDirect:
       algorithms::directReduceScatter(ctx, work, blocks, fn, elsize, slot,

@@ -1,0 +1,390 @@
+#include "tpucoll/common/flightrec.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <exception>
+#include <string>
+
+namespace tpucoll {
+
+namespace {
+
+// DataType code -> name (types.h); kNoDtype renders as null.
+const char* dtypeName(uint8_t code) {
+  static const char* kNames[] = {"int8",    "uint8",    "int32",  "uint32",
+                                 "int64",   "uint64",   "float16", "bfloat16",
+                                 "float32", "float64"};
+  if (code < sizeof(kNames) / sizeof(kNames[0])) {
+    return kNames[code];
+  }
+  return nullptr;
+}
+
+const char* stateName(int state) {
+  switch (state) {
+    case FlightRecorder::kEnqueued:
+      return "enqueued";
+    case FlightRecorder::kStarted:
+      return "started";
+    default:
+      return "completed";
+  }
+}
+
+uint64_t fnv1a(uint64_t h, const void* data, size_t n) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 0x100000001b3ULL;
+  }
+  return h;
+}
+
+size_t capacityFromEnv() {
+  size_t cap = 1024;
+  const char* s = std::getenv("TPUCOLL_FLIGHTREC_EVENTS");
+  if (s != nullptr && s[0] != '\0') {
+    const long long v = atoll(s);
+    if (v > 0) {
+      cap = static_cast<size_t>(v);
+    }
+  }
+  size_t pow2 = 8;
+  while (pow2 < cap) {
+    pow2 <<= 1;
+  }
+  return pow2;
+}
+
+// ---- process-global recorder registry (fatal-signal dumping) ----------
+// Lock-free fixed slots: a signal handler cannot take the registration
+// mutex, so registration CASes into a slot and the handler only ever
+// reads the atomics.
+constexpr int kMaxRecorders = 64;
+std::atomic<FlightRecorder*> g_recorders[kMaxRecorders] = {};
+// Dump directory snapshot taken at handler-install time (getenv inside a
+// signal handler is not guaranteed safe against concurrent setenv).
+char g_signalDir[512] = {0};
+std::atomic<bool> g_handlerInstalled{false};
+
+// Recursion guard: a crash while dumping (e.g. a recorder being torn
+// down on another thread at the instant the signal lands) must re-raise
+// the ORIGINAL default disposition, not loop back into this handler.
+std::atomic<bool> g_inHandler{false};
+
+void fatalSignalHandler(int sig) {
+  if (!g_inHandler.exchange(true) && g_signalDir[0] != '\0') {
+    for (int i = 0; i < kMaxRecorders; i++) {
+      FlightRecorder* rec = g_recorders[i].load(std::memory_order_relaxed);
+      if (rec == nullptr) {
+        continue;
+      }
+      char path[600];
+      snprintf(path, sizeof(path), "%s/flightrec-rank%d.json", g_signalDir,
+               rec->rank());
+      rec->dumpToFile(path, "signal", -1);
+    }
+  }
+  // Re-raise with the default disposition so the exit status (core dump,
+  // termination signal) is what the launcher expects.
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+// Writer abstraction so the entry formatter feeds either an fd (signal
+// path: snprintf + write(2) only) or a growing string (tc_flightrec_json).
+struct FdSink {
+  int fd;
+  bool ok{true};
+  void append(const char* data, size_t n) {
+    while (ok && n > 0) {
+      const ssize_t w = ::write(fd, data, n);
+      if (w < 0) {
+        if (errno == EINTR) {
+          continue;
+        }
+        ok = false;
+        return;
+      }
+      data += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+};
+
+struct StringSink {
+  std::string out;
+  bool ok{true};
+  void append(const char* data, size_t n) { out.append(data, n); }
+};
+
+}  // namespace
+
+int64_t FlightRecorder::nowUs() {
+  // CLOCK_MONOTONIC directly (async-signal-safe; same epoch as
+  // std::chrono::steady_clock on Linux, so these timestamps line up with
+  // Tracer spans and the metrics registry's progress stamps).
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+FlightRecorder::FlightRecorder(int rank, int size)
+    : rank_(rank), size_(size) {
+  const size_t cap = capacityFromEnv();
+  mask_ = cap - 1;
+  entries_.reset(new Entry[cap]);
+  for (int i = 0; i < kMaxRecorders; i++) {
+    FlightRecorder* expected = nullptr;
+    if (g_recorders[i].compare_exchange_strong(expected, this)) {
+      slotIdx_ = i;
+      break;
+    }
+  }
+}
+
+FlightRecorder::~FlightRecorder() {
+  if (slotIdx_ >= 0) {
+    g_recorders[slotIdx_].store(nullptr, std::memory_order_relaxed);
+  }
+}
+
+uint64_t FlightRecorder::begin(const char* opcode, const char* algorithm,
+                               uint64_t slot, int peer, uint64_t bytes,
+                               uint8_t dtype, int64_t cseq,
+                               uint64_t fingerprint) {
+  const uint64_t seq = nextSeq_.fetch_add(1, std::memory_order_relaxed);
+  Entry& e = entries_[seq & mask_];
+  // Claim-then-publish: park kNoSeq while the row's fields are being
+  // rewritten so a concurrent dump — expecting either the old lap's seq
+  // or the new one — skips the torn row, then publish the real seq as
+  // the LAST store.
+  e.seq.store(kNoSeq, std::memory_order_relaxed);
+  e.ts[kStarted].store(0, std::memory_order_relaxed);
+  e.ts[kCompleted].store(0, std::memory_order_relaxed);
+  e.cseq.store(cseq, std::memory_order_relaxed);
+  e.opcode.store(opcode, std::memory_order_relaxed);
+  e.algorithm.store(algorithm, std::memory_order_relaxed);
+  e.slot.store(slot, std::memory_order_relaxed);
+  e.peer.store(peer, std::memory_order_relaxed);
+  e.bytes.store(bytes, std::memory_order_relaxed);
+  e.dtype.store(dtype, std::memory_order_relaxed);
+  e.fingerprint.store(fingerprint, std::memory_order_relaxed);
+  e.ts[kEnqueued].store(nowUs(), std::memory_order_relaxed);
+  e.seq.store(seq, std::memory_order_relaxed);
+  return seq;
+}
+
+uint64_t FlightRecorder::beginCollective(const char* opcode,
+                                         const char* algorithm,
+                                         uint64_t slot, int peer,
+                                         uint64_t bytes, uint8_t dtype,
+                                         uint64_t fpBytes) {
+  // Desync fingerprint: what every rank must agree on at this collective
+  // seq — opcode, dtype, rank-invariant payload size, root, and the slot
+  // (prefix + tag: mismatched tags hang exactly like mismatched ops and
+  // must read as a desync, not a stall). Only the resolved algorithm is
+  // excluded: tuning tables may legitimately differ in how they get the
+  // same answer, but not in what the answer is about.
+  uint64_t fp = 0xcbf29ce484222325ULL;
+  fp = fnv1a(fp, opcode, strlen(opcode));
+  fp = fnv1a(fp, &dtype, sizeof(dtype));
+  fp = fnv1a(fp, &fpBytes, sizeof(fpBytes));
+  fp = fnv1a(fp, &slot, sizeof(slot));
+  const int32_t p = peer;
+  fp = fnv1a(fp, &p, sizeof(p));
+  const int64_t cseq = nextCollSeq_.fetch_add(1, std::memory_order_relaxed);
+  return begin(opcode, algorithm, slot, peer, bytes, dtype, cseq, fp);
+}
+
+uint64_t FlightRecorder::beginP2p(const char* opcode, uint64_t slot,
+                                  int peer, uint64_t bytes) {
+  // No collective seq, no fingerprint: p2p traffic is legitimately
+  // rank-asymmetric and never participates in the desync comparison.
+  return begin(opcode, nullptr, slot, peer, bytes, kNoDtype, -1, 0);
+}
+
+namespace {
+
+template <typename Sink>
+void dumpImpl(Sink& sink, int rank, int size, uint64_t mask,
+              const FlightRecorder::Entry* entries, uint64_t nextSeq,
+              const char* reason, int blamedPeer) {
+  char buf[640];
+  const uint64_t cap = mask + 1;
+  const uint64_t first = nextSeq > cap ? nextSeq - cap : 0;
+  int n = snprintf(buf, sizeof(buf),
+                   "{\"version\":1,\"kind\":\"tpucoll_flightrec\","
+                   "\"rank\":%d,\"size\":%d,\"reason\":\"%s\","
+                   "\"blamed_peer\":%d,\"now_us\":%lld,\"next_seq\":%llu,"
+                   "\"capacity\":%llu,\"dropped\":%llu,\"events\":[",
+                   rank, size, reason, blamedPeer,
+                   static_cast<long long>(FlightRecorder::nowUs()),
+                   static_cast<unsigned long long>(nextSeq),
+                   static_cast<unsigned long long>(cap),
+                   static_cast<unsigned long long>(first));
+  sink.append(buf, static_cast<size_t>(n));
+  bool firstRow = true;
+  for (uint64_t seq = first; seq < nextSeq; seq++) {
+    const FlightRecorder::Entry& e = entries[seq & mask];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      continue;  // mid-overwrite by a racing writer: drop the torn row
+    }
+    const char* op = e.opcode.load(std::memory_order_relaxed);
+    if (op == nullptr) {
+      continue;
+    }
+    const char* algo = e.algorithm.load(std::memory_order_relaxed);
+    const char* dt = dtypeName(e.dtype.load(std::memory_order_relaxed));
+    const int64_t tsq = e.ts[0].load(std::memory_order_relaxed);
+    const int64_t tst = e.ts[1].load(std::memory_order_relaxed);
+    const int64_t tsc = e.ts[2].load(std::memory_order_relaxed);
+    const int64_t cseq = e.cseq.load(std::memory_order_relaxed);
+    const int state = tsc != 0   ? FlightRecorder::kCompleted
+                      : tst != 0 ? FlightRecorder::kStarted
+                                 : FlightRecorder::kEnqueued;
+    char cseqBuf[24];
+    if (cseq >= 0) {
+      snprintf(cseqBuf, sizeof(cseqBuf), "%lld",
+               static_cast<long long>(cseq));
+    } else {
+      snprintf(cseqBuf, sizeof(cseqBuf), "null");
+    }
+    n = snprintf(
+        buf, sizeof(buf),
+        "%s\n{\"seq\":%llu,\"cseq\":%s,\"op\":\"%s\",\"algo\":%s%s%s,"
+        "\"slot\":%llu,"
+        "\"peer\":%d,\"bytes\":%llu,\"dtype\":%s%s%s,"
+        "\"fp\":\"%016llx\",\"state\":\"%s\",\"ts_enqueued_us\":%lld,"
+        "\"ts_started_us\":%lld,\"ts_completed_us\":%lld}",
+        firstRow ? "" : ",", static_cast<unsigned long long>(seq), cseqBuf,
+        op,
+        algo != nullptr ? "\"" : "", algo != nullptr ? algo : "null",
+        algo != nullptr ? "\"" : "",
+        static_cast<unsigned long long>(
+            e.slot.load(std::memory_order_relaxed)),
+        e.peer.load(std::memory_order_relaxed),
+        static_cast<unsigned long long>(
+            e.bytes.load(std::memory_order_relaxed)),
+        dt != nullptr ? "\"" : "", dt != nullptr ? dt : "null",
+        dt != nullptr ? "\"" : "",
+        static_cast<unsigned long long>(
+            e.fingerprint.load(std::memory_order_relaxed)),
+        stateName(state), static_cast<long long>(tsq),
+        static_cast<long long>(tst), static_cast<long long>(tsc));
+    sink.append(buf, static_cast<size_t>(n));
+    firstRow = false;
+  }
+  sink.append("\n]}\n", 4);
+}
+
+}  // namespace
+
+std::string FlightRecorder::toJson(const char* reason,
+                                   int blamedPeer) const {
+  StringSink sink;
+  dumpImpl(sink, rank_, size_, mask_, entries_.get(),
+           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer);
+  return std::move(sink.out);
+}
+
+bool FlightRecorder::dumpToFd(int fd, const char* reason,
+                              int blamedPeer) const {
+  FdSink sink{fd};
+  dumpImpl(sink, rank_, size_, mask_, entries_.get(),
+           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer);
+  return sink.ok;
+}
+
+bool FlightRecorder::dumpToFile(const char* path, const char* reason,
+                                int blamedPeer) const {
+  const int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) {
+    return false;
+  }
+  const bool ok = dumpToFd(fd, reason, blamedPeer);
+  ::close(fd);
+  return ok;
+}
+
+bool FlightRecorder::autoDump(const char* reason, int blamedPeer) {
+  const char* dir = std::getenv("TPUCOLL_FLIGHTREC_DIR");
+  if (dir == nullptr || dir[0] == '\0') {
+    return false;
+  }
+  // One-shot: the FIRST trigger is the evidence closest to the cause
+  // (the same principle as Metrics::recordPeerFailure keeping the first
+  // failure) — later triggers are usually the teardown cascade, and a
+  // re-firing watchdog must not turn into a dump storm. Explicit dumps
+  // (tc_flightrec_dump) are not limited.
+  int64_t expected = 0;
+  if (!lastAutoDumpUs_.compare_exchange_strong(expected, nowUs(),
+                                               std::memory_order_relaxed)) {
+    return false;
+  }
+  lastReason_.store(reason, std::memory_order_relaxed);
+  ::mkdir(dir, 0777);  // best-effort; EEXIST is the common case
+  char path[600];
+  snprintf(path, sizeof(path), "%s/flightrec-rank%d.json", dir, rank_);
+  return dumpToFile(path, reason, blamedPeer);
+}
+
+void FlightRecorder::installSignalHandler() {
+  bool expected = false;
+  if (!g_handlerInstalled.compare_exchange_strong(expected, true)) {
+    return;
+  }
+  const char* dir = std::getenv("TPUCOLL_FLIGHTREC_DIR");
+  if (dir != nullptr) {
+    snprintf(g_signalDir, sizeof(g_signalDir), "%s", dir);
+    ::mkdir(g_signalDir, 0777);
+  }
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = fatalSignalHandler;
+  sigemptyset(&sa.sa_mask);
+  for (int sig : {SIGSEGV, SIGABRT, SIGBUS, SIGFPE, SIGILL, SIGTERM}) {
+    sigaction(sig, &sa, nullptr);
+  }
+}
+
+void FlightRecorder::maybeInstallFromEnv() {
+  const char* v = std::getenv("TPUCOLL_FLIGHTREC_SIGNALS");
+  if (v != nullptr && v[0] != '\0' && strcmp(v, "0") != 0) {
+    installSignalHandler();
+  }
+}
+
+FlightRecOp::FlightRecOp(FlightRecorder* rec, const char* opcode,
+                         const char* algorithm, uint64_t slot, int peer,
+                         uint64_t bytes, uint8_t dtype, uint64_t fpBytes)
+    : rec_(rec) {
+  if (rec_ == nullptr) {
+    return;
+  }
+  seq_ = rec_->beginCollective(opcode, algorithm, slot, peer, bytes, dtype,
+                               fpBytes == ~uint64_t(0) ? bytes : fpBytes);
+  exceptionsAtEntry_ = std::uncaught_exceptions();
+}
+
+FlightRecOp::~FlightRecOp() {
+  if (rec_ == nullptr) {
+    return;
+  }
+  // Unwinding through an exception leaves the op at enqueued/started:
+  // the post-mortem must show it in flight, not done.
+  if (std::uncaught_exceptions() > exceptionsAtEntry_) {
+    return;
+  }
+  rec_->transition(seq_, FlightRecorder::kCompleted);
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,232 @@
+// Always-on flight recorder: a per-Context, fixed-size, lock-free ring of
+// collective/p2p operation records that survives to a post-mortem dump.
+//
+// The Tracer (tracer.h) is opt-in, unbounded, and lost with the process;
+// the metrics registry (metrics.h) aggregates but forgets ordering. This
+// layer is the black box in between: every operation the context issues
+// gets one ring entry {seq, opcode, algorithm, slot, peer, bytes, dtype,
+// state, timestamps, fingerprint}, where `seq` is a monotonic per-context
+// collective sequence number stamped at the public collective entry
+// points (collectives/*.cc) and the transport layer (transport/pair.cc)
+// flips enqueued -> started the moment payload bytes actually move.
+//
+// Cost contract (always on, no enable gate): a state transition is ONE
+// relaxed atomic store (a timestamp); entry allocation is one relaxed
+// fetch_add plus relaxed field stores. No locks anywhere on the data
+// path — the ring is preallocated and writers never block.
+//
+// Dump triggers (docs/flightrec.md):
+//  - straggler-watchdog stall            (transport::Context::reportStall)
+//  - transport failure                   (transport::Context::onPairError)
+//  - fatal signal, opt-in               (installSignalHandler /
+//                                        TPUCOLL_FLIGHTREC_SIGNALS=1)
+//  - explicit                            (tc_flightrec_dump / Python)
+// Automatic dumps go to TPUCOLL_FLIGHTREC_DIR/flightrec-rank<r>.json and
+// are throttled; when the env var is unset automatic triggers are no-ops.
+//
+// The per-op `fingerprint` (FNV-1a over opcode/dtype/bytes/root) is what
+// the cross-rank desync detector compares: ranks whose fingerprints
+// differ at the same seq issued DIFFERENT collectives — the classic
+// unrecoverable desync — and the merged report can say which rank ran
+// what (gloo_tpu/utils/flightrec.py, resilience.stall_reports).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace tpucoll {
+
+class FlightRecorder {
+ public:
+  enum State : int { kEnqueued = 0, kStarted = 1, kCompleted = 2 };
+
+  // All fields relaxed-atomic: written by the issuing thread (or, for
+  // ts[kStarted], the transport loop thread) and read by the dumper,
+  // possibly from a signal handler. A dump racing a writer may see one
+  // half-written row; the `seq` check below keeps it from mixing rows
+  // from different laps of the ring.
+  struct Entry {
+    std::atomic<uint64_t> seq{0};
+    // Collective sequence number: increments ONLY for collectives, so it
+    // is comparable ACROSS ranks (p2p traffic is legitimately rank-
+    // asymmetric — rank 1 sends while rank 0 receives — and must not
+    // shift or poison the desync comparison). -1 for p2p entries.
+    std::atomic<int64_t> cseq{-1};
+    std::atomic<const char*> opcode{nullptr};     // static string
+    std::atomic<const char*> algorithm{nullptr};  // static string or null
+    std::atomic<uint64_t> slot{0};
+    std::atomic<int32_t> peer{-1};  // root for rooted collectives, -1 else
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<uint8_t> dtype{kNoDtype};
+    std::atomic<uint64_t> fingerprint{0};
+    std::atomic<int64_t> ts[3] = {};  // indexed by State; 0 = not reached
+  };
+
+  static constexpr uint8_t kNoDtype = 0xFF;
+
+  // Capacity from TPUCOLL_FLIGHTREC_EVENTS (default 1024), rounded up to
+  // a power of two so the ring index is a mask.
+  FlightRecorder(int rank, int size);
+  ~FlightRecorder();
+
+  FlightRecorder(const FlightRecorder&) = delete;
+  FlightRecorder& operator=(const FlightRecorder&) = delete;
+
+  // ---- hot path -------------------------------------------------------
+  // Allocate the next ring entry and stamp the enqueued transition.
+  // Returns the op's ring sequence number. `peer` carries the root for
+  // rooted collectives and the destination/source for p2p ops; `dtype`
+  // is the DataType code (kNoDtype for untyped ops like barrier).
+  //
+  // beginCollective additionally advances the cross-rank collective
+  // sequence and fingerprints the op. `fpBytes` must be RANK-INVARIANT
+  // (every rank passes the same value for a matching schedule): the
+  // caller's own payload share for symmetric collectives, the group
+  // total for the *v forms, 0 where per-rank sizes legitimately differ
+  // (alltoallv).
+  uint64_t beginCollective(const char* opcode, const char* algorithm,
+                           uint64_t slot, int peer, uint64_t bytes,
+                           uint8_t dtype, uint64_t fpBytes);
+  uint64_t beginP2p(const char* opcode, uint64_t slot, int peer,
+                    uint64_t bytes);
+
+  // Record a state transition for op `seq`: one relaxed store. A seq
+  // already overwritten by a newer lap of the ring — or the kNoSeq
+  // sentinel (no matched entry / row mid-rewrite) — is ignored.
+  void transition(uint64_t seq, State state) {
+    if (seq == kNoSeq) {
+      return;
+    }
+    Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      return;  // lapped: this op's row was reused
+    }
+    e.ts[state].store(nowUs(), std::memory_order_relaxed);
+  }
+
+  // Late algorithm resolution (kAuto dispatch happens after the entry is
+  // allocated).
+  void setAlgorithm(uint64_t seq, const char* algorithm) {
+    Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      return;
+    }
+    e.algorithm.store(algorithm, std::memory_order_relaxed);
+  }
+
+  // Transport progress (pair.cc): flip the most recently issued op from
+  // enqueued to started the first time payload bytes move for it. Two
+  // relaxed loads on the already-started common case; the transition
+  // itself is the contractual single relaxed store. With concurrent
+  // same-context collectives (distinct tags on several threads) the
+  // attribution is approximate — acceptable for a post-mortem record.
+  void markTransportProgress() {
+    const uint64_t next = nextSeq_.load(std::memory_order_relaxed);
+    if (next == 0) {
+      return;
+    }
+    const uint64_t seq = next - 1;
+    Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq ||
+        e.ts[kStarted].load(std::memory_order_relaxed) != 0) {
+      return;
+    }
+    e.ts[kStarted].store(nowUs(), std::memory_order_relaxed);
+  }
+
+  uint64_t nextSeq() const {
+    return nextSeq_.load(std::memory_order_relaxed);
+  }
+
+  // Sentinel for "no entry": also parked in a ring row's seq while its
+  // fields are being rewritten, so a concurrent dump skips the torn row
+  // whichever lap it expected there.
+  static constexpr uint64_t kNoSeq = ~uint64_t(0);
+  // Late peer resolution (recv-from-any learns its source at completion).
+  void setPeer(uint64_t seq, int peer) {
+    if (seq == kNoSeq) {
+      return;
+    }
+    Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) == seq) {
+      e.peer.store(peer, std::memory_order_relaxed);
+    }
+  }
+
+  // ---- dump path (slow, possibly inside a signal handler) -------------
+  // Full JSON document (docs/flightrec.md "Record format").
+  std::string toJson(const char* reason = "explicit",
+                     int blamedPeer = -1) const;
+  // Write the dump with only snprintf + write(2), usable from the fatal-
+  // signal handler. Returns false on I/O error.
+  bool dumpToFd(int fd, const char* reason, int blamedPeer) const;
+  bool dumpToFile(const char* path, const char* reason,
+                  int blamedPeer) const;
+
+  // Automatic trigger: writes TPUCOLL_FLIGHTREC_DIR/flightrec-rank<r>.json
+  // (no-op when the env var is unset). One-shot per context: the first
+  // trigger is the evidence closest to the cause; later triggers are the
+  // cascade and must not overwrite it (nor storm the disk). `reason`
+  // must be a static string. Returns true when a file was written.
+  bool autoDump(const char* reason, int blamedPeer = -1);
+
+  // Opt-in fatal-signal dumping: installs handlers for SIGSEGV/SIGABRT/
+  // SIGBUS/SIGFPE/SIGILL/SIGTERM that dump every live recorder to
+  // TPUCOLL_FLIGHTREC_DIR, then re-raise with the default disposition.
+  // Idempotent; also reachable via TPUCOLL_FLIGHTREC_SIGNALS=1 (checked
+  // at context connect).
+  static void installSignalHandler();
+  static void maybeInstallFromEnv();
+
+  int rank() const { return rank_; }
+
+  static int64_t nowUs();
+
+ private:
+  uint64_t begin(const char* opcode, const char* algorithm, uint64_t slot,
+                 int peer, uint64_t bytes, uint8_t dtype, int64_t cseq,
+                 uint64_t fingerprint);
+
+  const int rank_;
+  const int size_;
+  uint64_t mask_;  // capacity - 1 (capacity is a power of two)
+  std::unique_ptr<Entry[]> entries_;
+  std::atomic<uint64_t> nextSeq_{0};
+  std::atomic<int64_t> nextCollSeq_{0};
+  std::atomic<int64_t> lastAutoDumpUs_{0};
+  std::atomic<const char*> lastReason_{nullptr};
+  int slotIdx_{-1};  // index into the process-global registry, -1 if full
+};
+
+// RAII op scope for the public collective entry points: allocates the
+// ring entry at construction and stamps `completed` at destruction —
+// unless the scope unwinds through an exception, in which case the op
+// stays at its last state so the dump shows it in flight (the truthful
+// post-mortem for a failed collective).
+class FlightRecOp {
+ public:
+  // `fpBytes` defaults to `bytes`; pass the rank-invariant total for the
+  // *v collectives (see beginCollective).
+  FlightRecOp(FlightRecorder* rec, const char* opcode, const char* algorithm,
+              uint64_t slot, int peer, uint64_t bytes, uint8_t dtype,
+              uint64_t fpBytes = ~uint64_t(0));
+  ~FlightRecOp();
+  FlightRecOp(const FlightRecOp&) = delete;
+  FlightRecOp& operator=(const FlightRecOp&) = delete;
+
+  uint64_t seq() const { return seq_; }
+  void setAlgorithm(const char* algorithm) {
+    if (rec_ != nullptr) {
+      rec_->setAlgorithm(seq_, algorithm);
+    }
+  }
+
+ private:
+  FlightRecorder* rec_;
+  uint64_t seq_{0};
+  int exceptionsAtEntry_{0};
+};
+
+}  // namespace tpucoll

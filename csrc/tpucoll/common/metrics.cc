@@ -157,7 +157,9 @@ std::string Metrics::toJson(int rank, bool drain) {
       << ",\"watchdog_ms\":" << watchdogUs() / 1000 << ",\"now_us\":" << nowUs
       << ",\"retries\":" << retries_.load(std::memory_order_relaxed)
       << ",\"stash_pauses\":"
-      << stashPauses_.load(std::memory_order_relaxed);
+      << stashPauses_.load(std::memory_order_relaxed)
+      << ",\"trace_events_dropped\":"
+      << traceEventsDropped_.load(std::memory_order_relaxed);
 
   out << ",\"faults\":{\"total\":"
       << faultsTotal_.load(std::memory_order_relaxed);
@@ -274,6 +276,7 @@ void Metrics::resetAll() {
   retries_.store(0, std::memory_order_relaxed);
   stalls_.store(0, std::memory_order_relaxed);
   stashPauses_.store(0, std::memory_order_relaxed);
+  traceEventsDropped_.store(0, std::memory_order_relaxed);
   faultsTotal_.store(0, std::memory_order_relaxed);
   peerFailures_.store(0, std::memory_order_relaxed);
   {

@@ -209,6 +209,14 @@ class Metrics {
   // enabled_: the chaos harness asserts on these.
   void recordFault(const std::string& action);
 
+  // ---- tracer overflow (tracer.h bounded event vector) ----
+  // Spans dropped because the opt-in tracer hit TPUCOLL_TRACE_MAX_EVENTS
+  // between drains. Not gated on enabled_: a silently truncated trace is
+  // exactly the kind of loss this registry exists to make visible.
+  void recordTraceDropped() {
+    traceEventsDropped_.fetch_add(1, std::memory_order_relaxed);
+  }
+
   // ---- connect retries (Pair backoff loop) ----
   void recordRetry() {
     if (!enabled()) {
@@ -261,6 +269,7 @@ class Metrics {
   std::atomic<uint64_t> retries_{0};
   std::atomic<uint64_t> stalls_{0};
   std::atomic<uint64_t> stashPauses_{0};
+  std::atomic<uint64_t> traceEventsDropped_{0};
 
   mutable std::mutex stallMu_;
   bool haveStall_{false};

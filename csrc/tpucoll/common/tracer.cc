@@ -1,8 +1,37 @@
 #include "tpucoll/common/tracer.h"
 
+#include <cstdlib>
 #include <sstream>
 
+#include "tpucoll/common/metrics.h"
+
 namespace tpucoll {
+
+size_t Tracer::capFromEnv() {
+  const char* s = std::getenv("TPUCOLL_TRACE_MAX_EVENTS");
+  if (s != nullptr && s[0] != '\0') {
+    const long long v = atoll(s);
+    if (v > 0) {
+      return static_cast<size_t>(v);
+    }
+  }
+  return 262144;
+}
+
+void Tracer::record(const Event& event) {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (events_.size() < cap_) {
+      events_.push_back(event);
+      return;
+    }
+  }
+  // Cap hit: drop the newest span (the retained prefix keeps its
+  // uninterrupted timeline) and make the loss visible in the registry.
+  if (metrics_ != nullptr) {
+    metrics_->recordTraceDropped();
+  }
+}
 
 std::string Tracer::toJson(int pid, bool drain) {
   std::vector<Event> events;

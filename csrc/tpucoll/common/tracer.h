@@ -8,6 +8,12 @@
 // jax profiler trace from the device plane.
 //
 // Overhead when disabled: one relaxed atomic load per span.
+//
+// The event vector is BOUNDED: at most `cap()` events are retained
+// between drains (TPUCOLL_TRACE_MAX_EVENTS, default 262144 ~ 12 MiB).
+// Overflow drops the newest span and counts it in the metrics registry
+// (`trace_events_dropped`) instead of growing without limit on long
+// runs; draining via toJson() frees the budget again.
 #pragma once
 
 #include <atomic>
@@ -18,6 +24,8 @@
 #include <vector>
 
 namespace tpucoll {
+
+class Metrics;
 
 class Tracer {
  public:
@@ -72,10 +80,13 @@ class Tracer {
     return Span(this, name, bytes, peer, detail);
   }
 
-  void record(const Event& event) {
-    std::lock_guard<std::mutex> guard(mu_);
-    events_.push_back(event);
-  }
+  // Drop-counter sink (owning Context wires its registry in); also the
+  // event-cap override hook for tests. Set before tracing starts.
+  void setMetrics(Metrics* metrics) { metrics_ = metrics; }
+  void setCap(size_t cap) { cap_ = cap; }
+  size_t cap() const { return cap_; }
+
+  void record(const Event& event);
 
   // Serialize to Chrome trace-event JSON. `pid` labels this process's
   // lane (use the rank). Clears recorded events when `drain` is true.
@@ -88,7 +99,11 @@ class Tracer {
   }
 
  private:
+  static size_t capFromEnv();
+
   std::atomic<bool> enabled_{false};
+  Metrics* metrics_{nullptr};
+  size_t cap_{capFromEnv()};
   std::mutex mu_;
   std::vector<Event> events_;
 };

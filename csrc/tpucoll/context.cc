@@ -14,10 +14,13 @@ namespace tpucoll {
 constexpr std::chrono::milliseconds Context::kDefaultTimeout;
 
 Context::Context(int rank, int size)
-    : rank_(rank), size_(size), metrics_(size) {
+    : rank_(rank), size_(size), metrics_(size), flightrec_(rank, size) {
   TC_ENFORCE(size > 0, "context size must be positive");
   TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
              size);
+  // Bounded tracer (tracer.h): overflow drops are counted in the
+  // registry instead of growing the event vector without limit.
+  tracer_.setMetrics(&metrics_);
 }
 
 Context::~Context() = default;
@@ -29,11 +32,12 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   // bootstrap handshakes too. Malformed files throw (never silently
   // run un-faulted against an operator's explicit schedule).
   fault::maybeLoadEnvFile();
+  FlightRecorder::maybeInstallFromEnv();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   store_ = std::move(store);
   device_ = std::move(device);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
-  tctx_->setInstrumentation(&tracer_, &metrics_);
+  tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->connectFullMesh(*store_, timeout_);
   maybeLoadTuningFile();
 }
@@ -45,9 +49,10 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   TC_ENFORCE(parent.tctx_ != nullptr, "parent context not connected");
   device_ = parent.device_;
   fault::maybeLoadEnvFile();
+  FlightRecorder::maybeInstallFromEnv();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
-  tctx_->setInstrumentation(&tracer_, &metrics_);
+  tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   auto blob = tctx_->prepareFullMesh();
 
   // Exchange blob lengths, then the blobs themselves, over the parent.

@@ -10,6 +10,7 @@
 #include <mutex>
 #include <vector>
 
+#include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/metrics.h"
 #include "tpucoll/common/tracer.h"
 #include "tpucoll/rendezvous/store.h"
@@ -92,6 +93,13 @@ class Context {
   // single relaxed load when disabled.
   Metrics& metrics() { return metrics_; }
 
+  // Always-on flight recorder (common/flightrec.h): bounded lock-free
+  // ring of every collective/p2p op this context issued, dumped to JSON
+  // on stall / transport failure / fatal signal / request. There is no
+  // off switch — the whole point is that the record exists when the
+  // process dies unexpectedly.
+  FlightRecorder& flightrec() { return flightrec_; }
+
   // Structured JSON snapshot of the registry; `drain` resets counters.
   std::string metricsJson(bool drain);
 
@@ -139,6 +147,7 @@ class Context {
   std::vector<std::vector<char>> scratchPool_;
   Tracer tracer_;
   Metrics metrics_;
+  FlightRecorder flightrec_;
 };
 
 }  // namespace tpucoll

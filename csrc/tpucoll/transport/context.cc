@@ -605,6 +605,12 @@ void Context::reportStall(UnboundBuffer* buf, bool isSend,
     stall.peerLastProgressUs = metrics_->lastProgressUs(stall.peer);
   }
   metrics_->recordStall(stall);
+  if (flightrec_ != nullptr) {
+    // Post-mortem evidence while the stall is live: what THIS rank has
+    // issued so far and which peer it is blocked on. No-op unless
+    // TPUCOLL_FLIGHTREC_DIR is set.
+    flightrec_->autoDump("stall", stall.peer);
+  }
 }
 
 void Context::debugDump() {
@@ -638,6 +644,9 @@ void Context::onPairError(int rank, const std::string& message,
     // never fired (a SIGKILL'd peer surfaces via EOF in milliseconds),
     // the metrics snapshot names which peer's link died first.
     metrics_->recordPeerFailure(rank, message);
+  }
+  if (flightrec_ != nullptr && !orderly) {
+    flightrec_->autoDump("transport_failure", rank);
   }
   std::vector<UnboundBuffer*> victims;
   {

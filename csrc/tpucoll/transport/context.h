@@ -19,6 +19,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/metrics.h"
 #include "tpucoll/common/tracer.h"
@@ -143,14 +144,17 @@ class Context {
 
   // ---- observability ----
   // Borrowed from the owning tpucoll::Context (which outlives this
-  // object); both may be null for standalone transport use (C++ unit
+  // object); all may be null for standalone transport use (C++ unit
   // tests). Set once before connect, read from data-path threads.
-  void setInstrumentation(Tracer* tracer, Metrics* metrics) {
+  void setInstrumentation(Tracer* tracer, Metrics* metrics,
+                          FlightRecorder* flightrec = nullptr) {
     tracer_ = tracer;
     metrics_ = metrics;
+    flightrec_ = flightrec;
   }
   Tracer* tracer() const { return tracer_; }
   Metrics* metrics() const { return metrics_; }
+  FlightRecorder* flightrec() const { return flightrec_; }
 
   // Straggler watchdog: called by a blocking wait (UnboundBuffer) that
   // has made no progress past the watchdog threshold. Figures out which
@@ -195,6 +199,7 @@ class Context {
   const int size_;
   Tracer* tracer_{nullptr};
   Metrics* metrics_{nullptr};
+  FlightRecorder* flightrec_{nullptr};
 
   std::mutex mu_;
   std::vector<std::unique_ptr<Pair>> pairs_;

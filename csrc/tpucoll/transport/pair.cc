@@ -632,6 +632,12 @@ void Pair::touchProgress() {
   if (Metrics* m = context_->metrics()) {
     m->touchProgress(peerRank_, Tracer::nowUs());
   }
+  if (FlightRecorder* fr = context_->flightrec()) {
+    // Every payload/header byte moving through a pair funnels here: the
+    // flight recorder's enqueued -> started transition for the op in
+    // flight (one relaxed store, and only on the first progress).
+    fr->markTransportProgress();
+  }
 }
 
 void Pair::enqueue(TxOp op) {

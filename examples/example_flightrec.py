@@ -1,0 +1,124 @@
+"""Post-mortem demo: chaos -> flight recorder -> dump -> merge -> blame.
+
+Three processes over a FileStore, with the always-on flight recorder
+(docs/flightrec.md) pointed at a dump directory:
+
+ 1. a fault schedule stalls rank 1's first bulk message mid-allreduce —
+    rank 0's armed watchdog fires while blocked and auto-dumps its ring
+    (reason "stall", blaming peer 1) with the allreduce still in flight;
+ 2. after the run the other ranks dump explicitly, `flightrec.merge`
+    folds the per-rank dumps into one timeline, and `flightrec.analyze`
+    blames rank 1 naming the in-flight op;
+ 3. the same machinery detects the UNRECOVERABLE failure class: ranks
+    deliberately issue different collectives at one sequence number, and
+    the fingerprint comparison raises the typed DesyncError saying who
+    ran what ("rank 2 is at seq N (broadcast ...) while rank 0 ...");
+ 4. the merged timeline converts to Perfetto JSON for the browser view.
+
+Run me:  python examples/example_flightrec.py
+(or `make postmortem-demo`)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SCHEDULE = {"seed": 404, "faults": [
+    {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1,
+              "min_bytes": 1024},
+     "action": "stall", "ms": 1200},
+]}
+
+WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import gloo_tpu
+    from gloo_tpu.utils import flightrec
+
+    rank, store_dir, fr_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    store = gloo_tpu.FileStore(store_dir)
+    ctx = gloo_tpu.Context(rank, 3, timeout=15.0)
+    ctx.connect_full_mesh(store, gloo_tpu.Device())
+    if rank == 0:
+        ctx.set_watchdog(0.2)  # the blocked wait will auto-dump
+
+    # --- act 1: a stalled allreduce. The recorder was already on — it
+    # always is — so rank 0's watchdog dump catches the op IN FLIGHT.
+    x = np.full(4096, float(rank + 1), dtype=np.float32)
+    ctx.allreduce(x, tag=1)
+    assert x[0] == 6.0, x[0]
+
+    # --- act 2: a deliberate schedule desync at the next seq. Rank 2
+    # issues a broadcast where everyone else issues an allreduce; the
+    # collectives time out (this divergence is unrecoverable by design).
+    y = np.full(1024, float(rank + 1), dtype=np.float32)
+    try:
+        if rank == 2:
+            ctx.broadcast(y, root=2, tag=2, timeout=2.0)
+        else:
+            ctx.allreduce(y, tag=2, timeout=2.0)
+        # rank 2's broadcast may complete locally (its sends land in
+        # peers' stashes) — only the allreduce ranks are guaranteed to
+        # time out.
+        assert rank == 2, "desynced allreduce unexpectedly completed"
+    except gloo_tpu.Error as exc:
+        print(f"rank {{rank}}: desync victim: {{str(exc)[:64]}}",
+              flush=True)
+
+    # Ranks 1/2 dump explicitly; rank 0 keeps its mid-stall auto dump.
+    if rank != 0:
+        flightrec.dump(ctx, fr_dir)
+    print(f"rank {{rank}}: recorded {{ctx.flightrec_seq()}} ops",
+          flush=True)
+""").format(repo=_REPO)
+
+
+def main():
+    from gloo_tpu.utils import flightrec
+    from gloo_tpu.utils.flightrec import DesyncError
+
+    store = tempfile.mkdtemp()
+    fr_dir = os.path.join(store, "flightrec-demo")
+    sched_path = os.path.join(store, "schedule.json")
+    with open(sched_path, "w") as f:
+        json.dump(SCHEDULE, f)
+    env = dict(os.environ, TPUCOLL_FAULT_FILE=sched_path,
+               TPUCOLL_FLIGHTREC_DIR=fr_dir)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, str(r), store, fr_dir], env=env)
+        for r in range(3)]
+    codes = [p.wait() for p in procs]
+    assert codes == [0, 0, 0], codes
+
+    # --- the post-mortem, exactly as an operator would run it.
+    merged = flightrec.merge(fr_dir)
+    assert sorted(merged["ranks"]) == [0, 1, 2], merged["missing"]
+    r0 = merged["ranks"][0]
+    print(f"\nrank 0 dump: reason={r0['reason']} "
+          f"blamed_peer={r0['blamed_peer']} (written mid-stall: its "
+          f"allreduce is '{r0['events'][0]['state']}')")
+    assert r0["reason"] == "stall" and r0["blamed_peer"] == 1
+
+    try:
+        flightrec.raise_on_desync(merged)
+        raise SystemExit("desync went undetected")
+    except DesyncError as exc:
+        print(f"desync verdict: {exc}")
+        assert "broadcast" in str(exc) and "allreduce" in str(exc)
+
+    perfetto_path = os.path.join(fr_dir, "postmortem_trace.json")
+    with open(perfetto_path, "w") as f:
+        f.write(flightrec.to_perfetto(merged))
+    print(f"merged Perfetto timeline -> {perfetto_path}")
+    print("flightrec example: OK")
+
+
+if __name__ == "__main__":
+    main()

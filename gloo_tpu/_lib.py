@@ -124,6 +124,12 @@ _PROTOTYPES = {
     "tc_metrics_set_watchdog": (None, [_c, _i64]),
     "tc_metrics_json": (_int, [_c, _int, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # flight recorder
+    "tc_flightrec_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_flightrec_dump": (_int, [_c, ctypes.c_char_p]),
+    "tc_flightrec_seq": (_u64, [_c]),
+    "tc_flightrec_install_signal_handler": (None, []),
     # deterministic fault-injection plane
     "tc_fault_install": (_int, [ctypes.c_char_p]),
     "tc_fault_clear": (None, []),

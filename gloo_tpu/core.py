@@ -587,13 +587,52 @@ class Context:
         with open(path, "w") as f:
             f.write(self.trace_json())
 
+    # ---- flight recorder (always-on post-mortem ring) ----
+
+    def flightrec(self) -> dict:
+        """Snapshot the context's always-on flight recorder as a dict.
+
+        Shape (docs/flightrec.md): {"rank", "size", "reason",
+        "blamed_peer", "now_us", "next_seq", "capacity", "dropped",
+        "events": [{"seq", "cseq", "op", "algo", "slot", "peer",
+        "bytes", "dtype", "fp", "state", "ts_enqueued_us",
+        "ts_started_us", "ts_completed_us"}, ...]} where `seq` is the
+        ring sequence over every recorded op, `cseq` the cross-rank-
+        comparable COLLECTIVE sequence number (null for p2p ops), `fp`
+        the desync fingerprint (hash of op/dtype/rank-invariant
+        bytes/root), and `state` one of enqueued/started/completed.
+        Non-draining: the ring keeps rolling. See
+        gloo_tpu.utils.flightrec for dump/merge/analyze."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        check(_lib.lib.tc_flightrec_json(self._handle, ctypes.byref(out),
+                                         ctypes.byref(out_len)))
+        try:
+            raw = bytes(bytearray(out[: out_len.value])).decode()
+        finally:
+            _lib.lib.tc_buf_free(out)
+        return json.loads(raw)
+
+    def flightrec_dump(self, path: str) -> str:
+        """Write the flight-recorder ring to `path` as JSON (the explicit
+        dump trigger; stalls, transport failures, and — opt-in — fatal
+        signals dump automatically to TPUCOLL_FLIGHTREC_DIR). Returns
+        the path for chaining into merge()."""
+        check(_lib.lib.tc_flightrec_dump(self._handle, path.encode()))
+        return path
+
+    def flightrec_seq(self) -> int:
+        """Ops recorded so far (== the next op's sequence number)."""
+        return int(_lib.lib.tc_flightrec_seq(self._handle))
+
     # ---- metrics + straggler watchdog (capability the reference lacks) --
 
     def metrics(self, drain: bool = False) -> dict:
         """Snapshot the context's metrics registry as a dict.
 
         Shape: {"rank", "size", "enabled", "watchdog_ms", "now_us",
-        "retries", "stash_pauses", "faults": {"total", <action>: n...},
+        "retries", "stash_pauses", "trace_events_dropped",
+        "faults": {"total", <action>: n...},
         "transport_failure": null | {"peer", "count", "message"},
         "ops": {name: {"calls", "bytes", "errors",
         "latency_us": hist}}, "transport": {peer: {"sent_msgs",

@@ -26,44 +26,84 @@ import time
 from typing import Dict, Optional, Tuple
 
 import gloo_tpu
+from gloo_tpu.utils.flightrec import (DesyncError, describe_event,
+                                      detect_desync, TAIL_K)
+
+
+def _flightrec_tail(failed_context) -> Optional[dict]:
+    """Compact flight-recorder tail for the store exchange: the last
+    TAIL_K COLLECTIVE ops' (cseq, fingerprint, description, state) plus
+    the frontier seq — everything the cross-rank desync comparison
+    needs, at store-value size. Collectives only: p2p entries carry no
+    comparable cseq/fingerprint, and a p2p-heavy workload must not flush
+    the collective evidence out of the exchanged window
+    (docs/flightrec.md "Desync detection")."""
+    try:
+        fr = failed_context.flightrec()
+    except Exception:  # noqa: BLE001 - a dead context must not block rebuild
+        return None
+    events = [e for e in fr.get("events", [])
+              if e.get("cseq") is not None][-TAIL_K:]
+    if not events:
+        return None
+    return {"next_seq": fr.get("next_seq", 0),
+            "tail": [{"seq": e["seq"], "cseq": e["cseq"],
+                      "fp": e["fp"], "state": e.get("state"),
+                      "desc": describe_event(e)} for e in events]}
 
 
 def _stall_evidence(failed_context) -> Optional[dict]:
-    """Extract the failure verdict from a poisoned context's metrics
-    snapshot: which peer this rank was blocked on (watchdog stall), or —
-    when the watchdog never fired because detection was EOF-fast, e.g. a
-    SIGKILL'd peer — which peer's link died first (the transport-failure
-    record Context.onPairError feeds). Returns None when neither source
-    names a peer (or metrics are unavailable)."""
+    """Extract the failure verdict from a poisoned context: which peer
+    this rank was blocked on (watchdog stall), or — when the watchdog
+    never fired because detection was EOF-fast, e.g. a SIGKILL'd peer —
+    which peer's link died first (the transport-failure record
+    Context.onPairError feeds). Either way the evidence also carries the
+    flight recorder's fingerprint tail, so the collected reports can
+    distinguish a stalled-but-matching schedule from a desync
+    (analyze_stall_reports). Returns None when no source has anything
+    to say (or the context is unreadable)."""
+    evidence = None
     try:
         snap = failed_context.metrics()
     except Exception:  # noqa: BLE001 - a dead context must not block rebuild
+        snap = None
+    if snap is not None:
+        last = snap.get("watchdog", {}).get("last")
+        failure = snap.get("transport_failure")
+        if last:
+            evidence = {"suspect": last.get("peer", -1),
+                        "op": last.get("op"), "slot": last.get("slot"),
+                        "waited_ms": last.get("waited_us", 0) // 1000}
+            peer = last.get("peer", -1)
+            transport = snap.get("transport", {})
+            if peer in transport:
+                evidence["peer_progress_age_ms"] = (
+                    transport[peer].get("last_progress_age_us", -1) // 1000)
+        elif failure and failure.get("peer", -1) >= 0:
+            evidence = {"suspect": failure["peer"], "op": "transport",
+                        "error": str(failure.get("message", ""))[:160],
+                        "failures": failure.get("count", 1)}
+    tail = _flightrec_tail(failed_context)
+    if evidence is None and tail is None:
         return None
-    last = snap.get("watchdog", {}).get("last")
-    if last:
-        evidence = {"suspect": last.get("peer", -1), "op": last.get("op"),
-                    "slot": last.get("slot"), "waited_ms":
-                    last.get("waited_us", 0) // 1000}
-        peer = last.get("peer", -1)
-        transport = snap.get("transport", {})
-        if peer in transport:
-            evidence["peer_progress_age_ms"] = (
-                transport[peer].get("last_progress_age_us", -1) // 1000)
-        return evidence
-    failure = snap.get("transport_failure")
-    if failure and failure.get("peer", -1) >= 0:
-        return {"suspect": failure["peer"], "op": "transport",
-                "error": str(failure.get("message", ""))[:160],
-                "failures": failure.get("count", 1)}
-    return None
+    if evidence is None:
+        # No single peer to blame (e.g. a timeout caused by a schedule
+        # desync) — the fingerprint tail IS the evidence.
+        evidence = {"suspect": -1, "op": None}
+    if tail is not None:
+        evidence["flightrec"] = tail
+    return evidence
 
 
 def stall_reports(store: "gloo_tpu.Store", generation: int,
                   old_size: int) -> Dict[int, dict]:
     """Read every survivor's published stall evidence for `generation`
     (written by rebuild_after_failure when failed_context is passed).
-    The modal `suspect` across reports is the rank to blame — recovery
-    tooling can exclude it from re-admission or page its host."""
+    The modal NON-NEGATIVE `suspect` across reports is the rank to
+    blame — since the flight recorder, ranks with nothing to blame also
+    publish (suspect -1, fingerprint tail only), so filter those out or
+    use `analyze_stall_reports`, which applies the full blame order
+    (desync > modal suspect) and names the culprit for you."""
     gen = gloo_tpu.PrefixStore(store, f"rebuild/{generation}")
     reports = {}
     for r in range(old_size):
@@ -76,6 +116,48 @@ def stall_reports(store: "gloo_tpu.Store", generation: int,
         except ValueError:
             continue
     return reports
+
+
+def analyze_stall_reports(reports: Dict[int, dict]) -> dict:
+    """Cross-rank verdict over `stall_reports` output.
+
+    Returns {"kind": "desync" | "stall" | "unknown", "blamed_ranks",
+    "message", "desync": <detect_desync report or None>,
+    "suspects": {rank: votes}}. A fingerprint mismatch at a shared seq
+    (two ranks issued DIFFERENT collectives) wins over everything else:
+    a desync explains every downstream stall, and no rebuild can fix
+    it — the application's schedule itself diverged. Raise it as a
+    typed error with `raise_on_desync_reports`."""
+    tails = {r: rep.get("flightrec", {}).get("tail", [])
+             for r, rep in reports.items()}
+    desync = detect_desync(tails)
+    suspects: Dict[int, int] = {}
+    for rep in reports.values():
+        s = rep.get("suspect", -1)
+        if isinstance(s, int) and s >= 0:
+            suspects[s] = suspects.get(s, 0) + 1
+    if desync is not None:
+        return {"kind": "desync", "blamed_ranks": desync["blamed_ranks"],
+                "message": desync["message"], "desync": desync,
+                "suspects": suspects}
+    if suspects:
+        top = max(suspects.items(), key=lambda kv: kv[1])[0]
+        return {"kind": "stall", "blamed_ranks": [top],
+                "message": f"survivors blame rank {top}", "desync": None,
+                "suspects": suspects}
+    return {"kind": "unknown", "blamed_ranks": [],
+            "message": "no evidence published", "desync": None,
+            "suspects": {}}
+
+
+def raise_on_desync_reports(reports: Dict[int, dict]) -> dict:
+    """`analyze_stall_reports`, raising the typed ``DesyncError`` when
+    the reports show a schedule divergence; returns the verdict
+    otherwise."""
+    verdict = analyze_stall_reports(reports)
+    if verdict["kind"] == "desync":
+        raise DesyncError(verdict["message"], verdict)
+    return verdict
 
 
 def rebuild_after_failure(store: "gloo_tpu.Store", device: "gloo_tpu.Device",

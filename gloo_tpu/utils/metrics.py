@@ -61,10 +61,20 @@ def summarize_ops(snapshot: dict) -> Dict[str, dict]:
     return out
 
 
+def _escape_label_value(value: object) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and line-feed must be escaped or the line
+    is unparseable — and transport-failure messages (which become label
+    values) routinely contain quotes and newlines."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Dict[str, object]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -132,6 +142,9 @@ def to_prometheus(snapshot: dict,
     lines.append("# TYPE gloo_tpu_stash_pauses_total counter")
     lines.append(f"gloo_tpu_stash_pauses_total{_fmt_labels(base)} "
                  f"{snapshot.get('stash_pauses', 0)}")
+    lines.append("# TYPE gloo_tpu_trace_events_dropped_total counter")
+    lines.append(f"gloo_tpu_trace_events_dropped_total{_fmt_labels(base)} "
+                 f"{snapshot.get('trace_events_dropped', 0)}")
     # Per-action series only; the total is their sum (scrapers derive
     # it), so one metric name never carries two label schemas.
     faults = snapshot.get("faults", {})

@@ -47,13 +47,23 @@ def merge_traces(jsons: Iterable[str]) -> str:
 
     Emits `process_name`/`process_sort_index` metadata ("M") events per
     rank pid so Perfetto shows labeled per-rank rows, and sorts data
-    events by timestamp so the merged document reads as one timeline.
-    Pre-existing metadata events in the inputs are preserved (except
-    process_name/process_sort_index, which are regenerated).
+    events by timestamp so the merged document reads as one timeline
+    (inputs with unsorted timestamps are fine). Pre-existing metadata
+    events in the inputs are preserved (except process_name/
+    process_sort_index, which are regenerated). Degrades gracefully over
+    a crashed rank's leavings: empty or unparseable documents are
+    skipped — the merge of the survivors must not throw.
     """
     events = []
     for doc in jsons:
-        events.extend(json.loads(doc))
+        if not doc:
+            continue
+        try:
+            parsed = json.loads(doc)
+        except ValueError:
+            continue
+        if isinstance(parsed, list):
+            events.extend(e for e in parsed if isinstance(e, dict))
     data = [e for e in events
             if e.get("ph") != "M"
             or e.get("name") not in ("process_name",

@@ -62,6 +62,14 @@ def test_example_chaos():
     assert "merged chaos trace" in out
 
 
+def test_example_flightrec():
+    out = _run("example_flightrec.py", timeout=180)
+    assert "flightrec example: OK" in out
+    assert "reason=stall blamed_peer=1" in out
+    assert "desync verdict: collective desync" in out
+    assert "merged Perfetto timeline" in out
+
+
 def test_bench_autotune_smoke(tmp_path):
     """bench.py --autotune smoke cell (tiny sizes, 2 ranks): the sweep
     must elect a table all ranks agree on, persist it, and the tuned

@@ -1,0 +1,409 @@
+"""Flight recorder + desync detector (docs/flightrec.md).
+
+Covers the full chain the observability tentpole promises: chaos
+(PR 3's fault plane) -> always-on recorder -> per-rank dumps -> cross-
+rank merge -> blame. Plus the merge() edge cases (empty file, missing
+rank, unsorted timestamps) and the determinism contract (same seed =>
+identical per-rank seq streams).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu.resilience import (_stall_evidence, analyze_stall_reports,
+                                 raise_on_desync_reports)
+from gloo_tpu.utils import flightrec
+from gloo_tpu.utils.flightrec import DesyncError
+from tests.harness import spawn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_flightrec_records_dump_merge_roundtrip():
+    """Tier-1 smoke: the recorder is ALWAYS on — no arming call — and a
+    clean run dumps, merges, and analyzes to an "ok" verdict with
+    identical per-rank seq/fingerprint streams."""
+    dump_dir = tempfile.mkdtemp(prefix="flightrec-")
+
+    def fn(ctx, rank):
+        x = np.full(2048, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        ctx.barrier(tag=2)
+        ctx.allgather(np.full(8, float(rank), np.float64), tag=3)
+        assert ctx.flightrec_seq() == 3
+        return flightrec.dump(ctx, dump_dir)
+
+    paths = spawn(3, fn)
+    assert all(os.path.exists(p) for p in paths)
+    merged = flightrec.merge(dump_dir)
+    assert sorted(merged["ranks"]) == [0, 1, 2]
+    assert merged["missing"] == []
+    # One timeline, 3 ops per rank, all completed, fingerprints agree.
+    assert len(merged["timeline"]) == 9
+    for doc in merged["ranks"].values():
+        assert [e["op"] for e in doc["events"]] == \
+            ["allreduce", "barrier", "allgather"]
+        assert all(e["state"] == "completed" for e in doc["events"])
+        # allreduce resolved its algorithm and the transport stamped the
+        # started transition between enqueue and completion.
+        ar = doc["events"][0]
+        assert ar["algo"] is not None
+        assert (ar["ts_enqueued_us"] <= ar["ts_started_us"]
+                <= ar["ts_completed_us"])
+    fps = [[e["fp"] for e in doc["events"]]
+           for _, doc in sorted(merged["ranks"].items())]
+    assert fps[0] == fps[1] == fps[2]
+    verdict = flightrec.analyze(merged)
+    assert verdict["kind"] == "ok", verdict
+    assert flightrec.raise_on_desync(merged)["kind"] == "ok"
+
+
+def test_chaos_stall_dumps_and_blames_inflight_op():
+    """Acceptance: a PR 3 fault schedule stalls rank 1 mid-allreduce at
+    P=3. Every rank writes a flight-recorder dump (rank 0's arrives via
+    the watchdog auto-dump trigger, mid-stall), flightrec.merge()
+    produces one timeline, and the analysis names rank 1 and the
+    in-flight op."""
+    store = tempfile.mkdtemp()
+    fr_dir = os.path.join(store, "flightrec")
+    schedule = {"seed": 21, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data", "nth": 1},
+         "action": "stall", "ms": 1200}]}
+    sched_path = os.path.join(store, "fault_schedule.json")
+    with open(sched_path, "w") as f:
+        json.dump(schedule, f)
+
+    body = textwrap.dedent("""
+        import json, os, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+        from gloo_tpu.utils import flightrec
+
+        rank = int(sys.argv[1]); size = 3
+        store = gloo_tpu.FileStore({store!r})
+        ctx = gloo_tpu.Context(rank, size, timeout=15.0)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        if rank == 0:
+            # Only rank 0 arms the watchdog: its blocked wait fires the
+            # automatic mid-stall dump that blames peer 1 (arming rank 2
+            # too would add a second, tie-breaking blame vote).
+            ctx.set_watchdog(0.15)
+        x = np.full(2048, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        assert x[0] == size * (size + 1) / 2, x[0]
+        if rank != 0:
+            # Ranks 1/2 dump explicitly; rank 0 keeps its auto dump (the
+            # mid-stall evidence) instead of overwriting it post-success.
+            flightrec.dump(ctx, {fr_dir!r})
+        ctx.close()
+        print("OK")
+    """).format(repo=_REPO, store=store, fr_dir=fr_dir)
+
+    env = dict(os.environ, TPUCOLL_FAULT_FILE=sched_path,
+               TPUCOLL_FLIGHTREC_DIR=fr_dir)
+    procs = [subprocess.Popen([sys.executable, "-c", body, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for r in range(3)]
+    outs = [p.communicate(timeout=120) for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0 and "OK" in out[0], (r, out)
+
+    merged = flightrec.merge(fr_dir)
+    assert sorted(merged["ranks"]) == [0, 1, 2], merged["missing"]
+    assert merged["missing"] == []
+    # Rank 0's dump is the watchdog's: written mid-stall, blaming peer 1,
+    # with the allreduce still in flight.
+    r0 = merged["ranks"][0]
+    assert r0["reason"] == "stall", r0["reason"]
+    assert r0["blamed_peer"] == 1, r0["blamed_peer"]
+    assert r0["events"][0]["op"] == "allreduce"
+    assert r0["events"][0]["state"] in ("enqueued", "started")
+    verdict = flightrec.analyze(merged)
+    assert verdict["kind"] == "stall", verdict
+    assert verdict["blamed_ranks"] == [1], verdict
+    assert "allreduce" in verdict["message"], verdict["message"]
+
+
+def test_desync_mismatched_schedule_typed_error():
+    """PR 3's third driver: a mismatched schedule. Rank 2 issues a
+    broadcast at the seq where ranks 0/1 issue an allreduce; the
+    collectives time out, the fingerprint exchange runs through the
+    resilience evidence path, and the verdict is a typed DesyncError
+    whose message names both ops at the diverging seq."""
+    gate = threading.Barrier(3, timeout=60)
+    docs = [None] * 3
+    reports = {}
+
+    def fn(ctx, rank):
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        try:
+            if rank == 2:
+                ctx.broadcast(x, root=2, tag=1, timeout=2.0)
+            else:
+                ctx.allreduce(x, tag=1, timeout=2.0)
+        except gloo_tpu.Error:
+            pass
+        gate.wait()
+        docs[rank] = ctx.flightrec()
+        reports[rank] = _stall_evidence(ctx)
+        gate.wait()  # hold every context open until evidence is read
+
+    spawn(3, fn, timeout=90)
+
+    merged = flightrec.merge(docs)
+    verdict = flightrec.analyze(merged)
+    assert verdict["kind"] == "desync", verdict
+    assert verdict["blamed_ranks"] == [2], verdict
+    with pytest.raises(DesyncError, match="desync") as exc:
+        flightrec.raise_on_desync(merged)
+    msg = str(exc.value)
+    assert "broadcast" in msg and "allreduce" in msg and "seq" in msg, msg
+
+    # Store-exchange face: the published stall evidence carries the
+    # fingerprint tails, and analyze_stall_reports reaches the same
+    # verdict through resilience.
+    assert all(r is not None and "flightrec" in r for r in reports.values())
+    v2 = analyze_stall_reports(reports)
+    assert v2["kind"] == "desync" and v2["blamed_ranks"] == [2], v2
+    with pytest.raises(DesyncError):
+        raise_on_desync_reports(reports)
+
+
+def test_same_seed_chaos_identical_seq_streams():
+    """Acceptance: same-seed chaos runs produce identical per-rank
+    (seq, op, fingerprint) streams — the recorder is deterministic even
+    with a probabilistic fault schedule firing underneath."""
+    from gloo_tpu import fault
+
+    schedule = {"seed": 31, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.5, "seed": 7}]}
+
+    def workload():
+        def fn(ctx, rank):
+            data = np.arange(64, dtype=np.float64)
+            out = np.zeros(64, dtype=np.float64)
+            for i in range(10):
+                ctx.allreduce(data.copy(), tag=2 * i)
+                if rank == 1:
+                    ctx.send(data, dst=0, slot=500 + i)
+                else:
+                    ctx.recv(out, src=1, slot=500 + i)
+            ctx.barrier(tag=999)
+            return [(e["seq"], e["op"], e["fp"])
+                    for e in ctx.flightrec()["events"]]
+
+        return spawn(2, fn, timeout=60)
+
+    fault.install(schedule)
+    try:
+        first = workload()
+        fault.install(schedule)  # reset firing state for the replay
+        second = workload()
+    finally:
+        fault.clear()
+    assert first == second
+    assert len(first[0]) == 21  # 10 allreduce + 10 p2p + barrier
+
+
+def test_merge_edge_cases_degrade_gracefully():
+    """Satellite: empty per-rank files, a missing rank's dump, and
+    unsorted timestamps must not throw — merge notes the absent rank
+    and still produces one ordered timeline."""
+    d = tempfile.mkdtemp(prefix="flightrec-")
+
+    def ev(seq, ts, op="allreduce", state="completed", fp="aa"):
+        return {"seq": seq, "cseq": seq, "op": op, "algo": None, "slot": 0,
+                "peer": -1, "bytes": 64, "dtype": "float32", "fp": fp,
+                "state": state, "ts_enqueued_us": ts,
+                "ts_started_us": ts + 1,
+                "ts_completed_us": ts + 2 if state == "completed" else 0}
+
+    # rank 0: healthy but with UNSORTED timestamps; rank 1: empty file;
+    # rank 2: truncated JSON; rank 3: never dumped (size says 4 ranks).
+    with open(os.path.join(d, "flightrec-rank0.json"), "w") as f:
+        json.dump({"version": 1, "rank": 0, "size": 4, "reason": "explicit",
+                   "blamed_peer": -1, "now_us": 100, "next_seq": 3,
+                   "capacity": 8, "dropped": 0,
+                   "events": [ev(1, 50), ev(0, 10), ev(2, 30)]}, f)
+    open(os.path.join(d, "flightrec-rank1.json"), "w").close()
+    with open(os.path.join(d, "flightrec-rank2.json"), "w") as f:
+        f.write('{"rank": 2, "events": [{"se')
+
+    merged = flightrec.merge(d)
+    assert sorted(merged["ranks"]) == [0]
+    assert merged["missing"] == [1, 2, 3]
+    assert [e["ts_enqueued_us"] for e in merged["timeline"]] == [10, 30, 50]
+    verdict = flightrec.analyze(merged)
+    assert verdict["kind"] == "stall"
+    assert verdict["blamed_ranks"] == [1, 2, 3]
+
+    # The dict/None input form tolerates absent docs the same way.
+    merged2 = flightrec.merge([merged["ranks"][0], None])
+    assert merged2["missing"] == [1, 2, 3]
+
+    # detect_desync over partial tails: overlapping collective seqs
+    # compare, absent ranks are simply not blamed.
+    tails = {0: [{"seq": 9, "cseq": 5, "fp": "x", "desc": "allreduce"}],
+             1: [{"seq": 7, "cseq": 5, "fp": "y", "desc": "broadcast"}],
+             2: []}
+    report = flightrec.detect_desync(tails)
+    assert report is not None and report["blamed_ranks"] in ([0], [1])
+
+
+def test_asymmetric_p2p_is_not_a_desync():
+    """Regression: user p2p traffic is rank-asymmetric by nature (one
+    rank sends, another receives, a third does neither) — it must
+    neither shift the collective comparison axis nor be compared
+    itself. Only a COLLECTIVE divergence is a desync."""
+    def fn(ctx, rank):
+        data = np.arange(32, dtype=np.float64)
+        out = np.zeros(32, dtype=np.float64)
+        ctx.allreduce(data.copy(), tag=1)
+        # ranks 0/1 exchange different NUMBERS of p2p ops; rank 2 none.
+        if rank == 1:
+            for i in range(3):
+                ctx.send(data, dst=0, slot=300 + i)
+        elif rank == 0:
+            for i in range(3):
+                ctx.recv(out, src=1, slot=300 + i)
+        ctx.barrier(tag=2)
+        return ctx.flightrec()
+
+    docs = spawn(3, fn, timeout=60)
+    # Ring seqs differ per rank (p2p counts differ), collective seqs
+    # align: allreduce at cseq 0, barrier at cseq 1, on every rank.
+    for doc in docs:
+        colls = [e for e in doc["events"] if e["cseq"] is not None]
+        assert [(e["cseq"], e["op"]) for e in colls] == \
+            [(0, "allreduce"), (1, "barrier")]
+        for e in doc["events"]:
+            if e["op"] in ("send", "recv"):
+                assert e["cseq"] is None
+    merged = flightrec.merge(docs)
+    verdict = flightrec.analyze(merged)
+    assert verdict["kind"] == "ok", verdict
+    flightrec.raise_on_desync(merged)
+
+
+def test_mismatched_tag_is_a_desync():
+    """Regression: a tag divergence hangs exactly like an op divergence
+    and must read as a desync — the fingerprint folds in the slot
+    (prefix + tag), not just the opcode."""
+    def fn(ctx, rank):
+        x = np.full(256, float(rank + 1), dtype=np.float32)
+        try:
+            ctx.allreduce(x, tag=9 if rank == 2 else 1, timeout=1.5)
+        except gloo_tpu.Error:
+            pass
+        return ctx.flightrec()
+
+    docs = spawn(3, fn, timeout=60)
+    verdict = flightrec.analyze(flightrec.merge(docs))
+    assert verdict["kind"] == "desync", verdict
+    assert verdict["blamed_ranks"] == [2], verdict
+
+
+def test_heterogeneous_counts_same_schedule_not_desync():
+    """Regression: allgatherv with per-rank counts is ONE schedule even
+    though every rank's own payload differs — the fingerprint must use
+    the rank-invariant group total, not this rank's share."""
+    def fn(ctx, rank):
+        counts = [4, 8, 12]
+        x = np.full(counts[rank], float(rank), dtype=np.float32)
+        ctx.allgatherv(x, counts, tag=1)
+        ctx.gatherv(x, counts, root=0, tag=2)
+        return ctx.flightrec()
+
+    docs = spawn(3, fn, timeout=60)
+    fps = [[e["fp"] for e in d["events"]] for d in docs]
+    assert fps[0] == fps[1] == fps[2], fps
+    assert flightrec.detect_desync(
+        {i: d["events"] for i, d in enumerate(docs)}) is None
+
+
+def test_signal_handler_dumps_on_fatal_signal():
+    """Opt-in fatal-signal trigger: TPUCOLL_FLIGHTREC_SIGNALS=1 dumps
+    the ring to TPUCOLL_FLIGHTREC_DIR on SIGTERM and the process still
+    dies with the signal's default disposition."""
+    store = tempfile.mkdtemp()
+    fr_dir = os.path.join(store, "fr")
+    prog = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        import gloo_tpu
+        ctx = gloo_tpu.Context(0, 1, timeout=5.0)
+        ctx.connect_full_mesh(gloo_tpu.FileStore({store!r}),
+                              gloo_tpu.Device())
+        ctx.allreduce(np.ones(16, dtype=np.float32), tag=1)
+        os.kill(os.getpid(), signal.SIGTERM)
+    """)
+    env = dict(os.environ, TPUCOLL_FLIGHTREC_DIR=fr_dir,
+               TPUCOLL_FLIGHTREC_SIGNALS="1")
+    p = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, env=env, timeout=60)
+    assert p.returncode == -signal.SIGTERM, (p.returncode, p.stderr)
+    doc = flightrec.load(os.path.join(fr_dir, "flightrec-rank0.json"))
+    assert doc is not None, os.listdir(fr_dir) if os.path.isdir(fr_dir) \
+        else "no dump dir"
+    assert doc["reason"] == "signal"
+    assert [e["op"] for e in doc["events"]] == ["allreduce"]
+
+
+def test_p2p_ops_recorded_with_resolved_peer():
+    """User-facing p2p posts get ring entries too; a recv-from-any
+    resolves its peer at completion and waits flip entries to
+    completed."""
+    def fn(ctx, rank):
+        data = np.full(32, float(rank), dtype=np.float64)
+        out = np.zeros(32, dtype=np.float64)
+        if rank == 0:
+            buf = ctx.register(out)
+            buf.recv([1, 2], slot=77)       # recv-from-any
+            src = buf.wait_recv()
+            assert src == 1
+        elif rank == 1:
+            ctx.send(data, dst=0, slot=77)
+        ctx.barrier(tag=5)
+        return ctx.flightrec()["events"]
+
+    events = spawn(3, fn, timeout=60)
+    r0 = [e for e in events[0] if e["op"] == "recv"]
+    assert len(r0) == 1
+    assert r0[0]["state"] == "completed"
+    assert r0[0]["peer"] == 1  # resolved at wait_recv
+    r1 = [e for e in events[1] if e["op"] == "send"]
+    assert len(r1) == 1 and r1[0]["state"] == "completed"
+    assert r1[0]["peer"] == 0
+
+
+def test_flightrec_ring_bounded_and_drop_counted():
+    """The ring is bounded: with TPUCOLL_FLIGHTREC_EVENTS=8, a 30-op run
+    keeps the newest 8 records and reports the overwritten count."""
+    os.environ["TPUCOLL_FLIGHTREC_EVENTS"] = "8"
+    try:
+        def fn(ctx, rank):
+            for i in range(30):
+                ctx.barrier(tag=i)
+            return ctx.flightrec()
+
+        doc = spawn(2, fn, timeout=60)[0]
+    finally:
+        del os.environ["TPUCOLL_FLIGHTREC_EVENTS"]
+    assert doc["capacity"] == 8
+    assert doc["next_seq"] == 30
+    assert doc["dropped"] == 22
+    assert [e["seq"] for e in doc["events"]] == list(range(22, 30))

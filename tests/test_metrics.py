@@ -2,6 +2,7 @@
 the straggler watchdog (tentpole of the observability subsystem —
 docs/observability.md)."""
 
+import json
 import time
 
 import numpy as np
@@ -228,6 +229,9 @@ def test_rebuild_publishes_stall_evidence():
 
     def fn(ctx, rank):
         ctx.set_watchdog(0.05)
+        # One collective first: the published evidence carries the flight
+        # recorder's COLLECTIVE fingerprint tail, so there must be one.
+        ctx.allreduce(np.ones(4, dtype=np.float32), tag=7)
         x = np.zeros(4, dtype=np.float32)
         if rank == 0:
             ctx.recv(x, 1, slot=11, timeout=10)  # watchdog fires here
@@ -248,11 +252,18 @@ def test_rebuild_publishes_stall_evidence():
 
     assert spawn(2, fn, timeout=60) == [2.0, 2.0]
     reports = stall_reports(shared, generation=1, old_size=2)
-    # Rank 0 stalled on rank 1 and said so; rank 1 never stalled.
-    assert list(reports) == [0]
+    # Rank 0 stalled on rank 1 and said so. Rank 1 never stalled, but it
+    # still publishes evidence: since the flight recorder every survivor
+    # ships its fingerprint tail (suspect -1 = "nothing to blame") so
+    # the cross-rank desync comparison has both sides.
+    assert sorted(reports) == [0, 1]
     assert reports[0]["suspect"] == 1
     assert reports[0]["op"] == "recv"
     assert reports[0]["waited_ms"] >= 50
+    assert reports[1]["suspect"] == -1
+    for r in (0, 1):
+        tail = reports[r]["flightrec"]["tail"]
+        assert tail and all("fp" in e and "seq" in e for e in tail)
 
 
 def test_merge_snapshots():
@@ -338,3 +349,66 @@ def test_transport_failure_names_first_failed_peer():
 
     snap = spawn(2, orderly)[0]
     assert snap["transport_failure"] is None, snap["transport_failure"]
+
+
+def test_prometheus_label_escaping():
+    """Satellite: label values containing backslash, double-quote, or
+    newline must be escaped per the exposition format — transport-
+    failure messages routinely contain all three."""
+    from gloo_tpu.utils.metrics import _fmt_labels
+
+    labels = _fmt_labels({"op": 'say "hi"\nback\\slash', "rank": 0})
+    assert labels == '{op="say \\"hi\\"\\nback\\\\slash",rank="0"}'
+    assert "\n" not in labels
+
+    # End to end: a snapshot whose stall op name carries the hostile
+    # characters still renders one metric per line, every line parseable
+    # as  name{labels} value.
+    snap = {"rank": 0, "ops": {}, "transport": {}, "retries": 0,
+            "stash_pauses": 0, "trace_events_dropped": 0, "faults": {},
+            "watchdog": {"stalls": 1,
+                         "last": {"op": 'recv "x"\n\\y', "peer": 2,
+                                  "waited_us": 5}}}
+    text = to_prometheus(snap)
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert "\n" not in line
+        name_part, value = line.rsplit(" ", 1)
+        float(value)  # the sample value must still parse
+    assert 'op="recv \\"x\\"\\n\\\\y"' in text
+
+
+def test_tracer_bounded_with_drop_counter():
+    """Satellite: the opt-in tracer no longer grows without limit — with
+    TPUCOLL_TRACE_MAX_EVENTS=5 a 12-op traced run retains 5 spans and
+    counts 7 drops in the metrics registry (and its Prometheus
+    exposition)."""
+    import os
+
+    from gloo_tpu.utils.metrics import to_prometheus as to_prom
+
+    os.environ["TPUCOLL_TRACE_MAX_EVENTS"] = "5"
+    try:
+        def fn(ctx, rank):
+            ctx.trace_start()
+            for i in range(12):
+                ctx.barrier(tag=i)
+            return json.loads(ctx.trace_json()), ctx.metrics()
+
+        events, snap = spawn(2, fn)[0]
+    finally:
+        del os.environ["TPUCOLL_TRACE_MAX_EVENTS"]
+    assert len(events) == 5, len(events)
+    assert snap["trace_events_dropped"] == 7, snap["trace_events_dropped"]
+    assert "gloo_tpu_trace_events_dropped_total" in to_prom(snap)
+
+    # Draining frees the budget: spans record again afterwards.
+    def fn2(ctx, rank):
+        ctx.trace_start()
+        ctx.barrier(tag=1)
+        ctx.trace_json()  # drain
+        ctx.barrier(tag=2)
+        return json.loads(ctx.trace_json())
+
+    assert len(spawn(2, fn2)[0]) == 1

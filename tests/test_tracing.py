@@ -99,3 +99,29 @@ def test_merge_traces():
     # Metadata survives a re-merge without duplicating.
     again = json.loads(merge_traces([json.dumps(merged)]))
     assert len([e for e in again if e["ph"] == "M"]) == len(meta)
+
+
+def test_merge_traces_edge_cases():
+    """Satellite: merge must degrade gracefully over a crashed rank's
+    leavings — empty documents, truncated JSON, a missing rank — and
+    re-sort inputs whose timestamps arrive unsorted."""
+    good = json.dumps([
+        {"name": "allreduce", "ph": "X", "ts": 300, "dur": 5, "pid": 0,
+         "tid": 0, "args": {}},
+        {"name": "barrier", "ph": "X", "ts": 100, "dur": 5, "pid": 0,
+         "tid": 0, "args": {}},  # unsorted on purpose
+    ])
+    other = json.dumps([
+        {"name": "allreduce", "ph": "X", "ts": 200, "dur": 5, "pid": 2,
+         "tid": 0, "args": {}},
+    ])
+    # rank 1 crashed: its trace is empty; another file is truncated junk.
+    merged = json.loads(merge_traces([good, "", '[{"name": "tru', other]))
+    data = [e for e in merged if e["ph"] != "M"]
+    assert [e["ts"] for e in data] == [100, 200, 300]
+    # Rows exist only for ranks that contributed events (0 and 2): the
+    # absent rank is visible by its missing lane, not a crash here.
+    meta_pids = {e["pid"] for e in merged if e["ph"] == "M"}
+    assert meta_pids == {0, 2}
+    # All-empty input produces an empty (but valid) document.
+    assert json.loads(merge_traces(["", None])) == []

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Post-mortem viewer for flight-recorder dumps (docs/flightrec.md).
+
+Point it at a dump directory (or individual dump files); it merges the
+per-rank rings, prints the cross-rank timeline tail and the verdict —
+desync (who ran what at the diverging seq), stall (who everyone blames),
+or clean — and can emit a Perfetto/chrome://tracing file of the merged
+timeline.
+
+    python tools/flightrec_view.py flightrec-dump/
+    python tools/flightrec_view.py dump/flightrec-rank*.json --tail 30
+    python tools/flightrec_view.py flightrec-dump/ --perfetto out.json
+    python tools/flightrec_view.py flightrec-dump/ --check   # exit 2 on desync
+
+Exit status: 0 clean, 1 stall, 2 desync (with --check; otherwise 0
+unless the input is unusable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gloo_tpu.utils import flightrec  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="+",
+                    help="dump directory or flightrec-rank*.json files")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="timeline rows to print (default 20)")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="write merged Chrome trace-event JSON here")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on stall, 2 on desync")
+    args = ap.parse_args()
+
+    source = args.dumps[0] if (len(args.dumps) == 1
+                               and os.path.isdir(args.dumps[0])) else \
+        args.dumps
+    merged = flightrec.merge(source)
+    if not merged["ranks"]:
+        print("no usable dumps found", file=sys.stderr)
+        return 1
+
+    print(f"ranks: {sorted(merged['ranks'])} of {merged['size']}"
+          + (f"  MISSING: {merged['missing']}" if merged["missing"] else ""))
+    for rank, doc in sorted(merged["ranks"].items()):
+        print(f"  rank {rank}: reason={doc.get('reason')} "
+              f"next_seq={doc.get('next_seq')} "
+              f"blamed_peer={doc.get('blamed_peer')} "
+              f"dropped={doc.get('dropped')}")
+
+    print(f"\ntimeline (last {args.tail} of {len(merged['timeline'])}):")
+    for e in merged["timeline"][-args.tail:]:
+        print(f"  seq {e.get('seq'):>5}  rank {e.get('rank')}  "
+              f"{e.get('state', '?'):>9}  {flightrec.describe_event(e)}  "
+              f"slot={e.get('slot')} fp={e.get('fp')}")
+
+    verdict = flightrec.analyze(merged)
+    print(f"\nverdict: {verdict['kind'].upper()}")
+    print(f"  {verdict['message']}")
+    if verdict["blamed_ranks"]:
+        print(f"  blamed rank(s): {verdict['blamed_ranks']}")
+    for rank, f in sorted(verdict.get("frontier", {}).items()):
+        print(f"  rank {rank} frontier: seq {f['seq']} ({f['desc']}, "
+              f"{f['state']})")
+
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            f.write(flightrec.to_perfetto(merged))
+        print(f"\nwrote {args.perfetto} (open in ui.perfetto.dev)")
+
+    if args.check:
+        return {"ok": 0, "stall": 1, "desync": 2}.get(verdict["kind"], 1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
