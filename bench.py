@@ -16,9 +16,15 @@ build-ref`), otherwise against the value recorded on this host
 (0.620 GB/s, see BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"spread", "runs"} — value is the median of three full measurements,
-spread is (max-min)/median of those runs (this host's noise floor next
-to the number), runs lists all three.
+"spread", "runs"} — value is the median of five full measurements taken
+after one discarded warm-up run (run-to-run spread was ~9.4% at
+median-of-3, BENCH_r05), spread is (max-min)/median of those runs (this
+host's remaining noise floor next to the number), runs lists all five.
+
+--channel-sweep measures allreduce algbw across the multi-channel
+transport grid (TPUCOLL_LOOP_THREADS x TPUCOLL_CHANNELS x
+TPUCOLL_STRIPE_BYTES), one JSON line per point, feeding the tuning
+plane's transport hints; add --quick for a small smoke grid.
 """
 
 import json
@@ -439,12 +445,102 @@ def bench_flightrec_soak(seconds):
         sys.exit(1)
 
 
+def bench_channel_sweep(quick=False):
+    """--channel-sweep: measure 2-rank allreduce algbw across the
+    multi-channel transport grid (loop threads x data channels x stripe
+    threshold), one JSON line per point — the measurement source for the
+    tuning plane's transport hints (tuning.set_transport_hints). Each
+    point runs in fresh subprocesses because the knobs are env-resolved
+    at context construction; TPUCOLL_SHM=0 pins the payloads to the TCP
+    plane the knobs actually govern (same-host shm bypasses striping).
+    """
+    import tempfile
+    import textwrap
+
+    if quick:
+        elements = 1 << 22  # 16 MiB f32
+        iters, warmup = 4, 1
+        grid = [(1, 1, 1 << 20), (2, 2, 1 << 20)]
+    else:
+        elements = ELEMENTS  # the headline 64 MiB config
+        iters, warmup = 8, 2
+        grid = [(loops, ch, stripe)
+                for loops in (1, 2, 4)
+                for ch in (1, 2, 4)
+                for stripe in (256 << 10, 1 << 20, 4 << 20)
+                # stripe threshold is meaningless without channels;
+                # keep exactly one single-channel baseline per loop count
+                if ch > 1 or stripe == 1 << 20]
+
+    body = textwrap.dedent("""
+        import sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4]); warm = int(sys.argv[5])
+        x = np.full(n, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x)
+        assert x[0] == 3.0, x[0]
+        x[:] = 1.0
+        for _ in range(warm):
+            ctx.allreduce(x)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x)
+            times.append(time.perf_counter() - t0)
+        if rank == 0:
+            print("P50US", int(np.median(times) * 1e6))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    ok_all = True
+    for loops, channels, stripe in grid:
+        store = tempfile.mkdtemp()
+        env = dict(os.environ,
+                   TPUCOLL_SHM="0",
+                   TPUCOLL_LOOP_THREADS=str(loops),
+                   TPUCOLL_CHANNELS=str(channels),
+                   TPUCOLL_STRIPE_BYTES=str(stripe))
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", body, str(r), store, str(elements),
+             str(iters), str(warmup)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for r in range(2)]
+        outs = [p.communicate(timeout=600) for p in procs]
+        line = {"metric": "channel_sweep", "loops": loops,
+                "channels": channels, "stripe_bytes": stripe,
+                "elements": elements, "iters": iters, "unit": "GB/s"}
+        if any(p.returncode != 0 for p in procs) or                 "P50US" not in outs[0][0]:
+            ok_all = False
+            line["ok"] = False
+            line["error"] = [f"rank {r}: rc={p.returncode} "
+                             f"err={outs[r][1][-200:]!r}"
+                             for r, p in enumerate(procs)]
+        else:
+            p50_us = int(outs[0][0].split("P50US", 1)[1].split()[0])
+            line["value"] = round(elements * 4 / (p50_us * 1e-6) / 1e9, 3)
+            line["p50_us"] = p50_us
+            line["ok"] = True
+        print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def main():
     if "--flightrec" in sys.argv[1:]:
         i = sys.argv.index("--flightrec") + 1
         if i >= len(sys.argv) or sys.argv[i].startswith("--"):
             sys.exit("--flightrec requires a duration (seconds)")
         bench_flightrec_soak(float(sys.argv[i]))
+        return
+    if "--channel-sweep" in sys.argv[1:]:
+        bench_channel_sweep(quick="--quick" in sys.argv[1:])
         return
     if "--chaos-soak" in sys.argv[1:]:
         i = sys.argv.index("--chaos-soak") + 1
@@ -462,27 +558,33 @@ def main():
         bench_autotune(quick="--autotune-quick" in sys.argv[1:],
                        out_path=out)
         return
-    # Median-of-3 full measurements: this host's run-to-run spread is
-    # documented at +/-15% (BASELINE.md), so a single draw is not
-    # evidence. `spread` = (max - min) / median of the three runs —
-    # readers (and the round-over-round diff) can see the noise floor
-    # next to the number instead of guessing it.
+    # Median-of-5 full measurements after one discarded warm-up run:
+    # this host's run-to-run spread was measured at ~9.4% over
+    # median-of-3 (BENCH_r05), which is noise the channel sweep's
+    # comparisons cannot afford. The warm-up run pays the first-touch /
+    # page-cache / cpufreq transients once, outside the sample; five
+    # samples tighten the median's own variance. `spread` =
+    # (max - min) / median — readers (and the round-over-round diff)
+    # see the remaining noise floor next to the number.
     # --metrics: include a per-op metrics digest (calls, bytes, p50/p95
     # latency from the native registry's histograms) from the last run's
     # rank-0 context in the JSON line. Opt-in so the headline number's
     # methodology is untouched by default.
     with_metrics = "--metrics" in sys.argv[1:]
     metrics_out = [] if with_metrics else None
+    warmup = bench_ours()
+    print(f"[bench] warm-up run: {warmup:.3f} GB/s (discarded)",
+          file=sys.stderr)
     runs = []
-    for i in range(3):
+    for i in range(5):
         # Only the final run collects metrics (digest matches the last
-        # measurement rather than mixing three contexts).
-        collect = metrics_out if with_metrics and i == 2 else None
+        # measurement rather than mixing contexts).
+        collect = metrics_out if with_metrics and i == 4 else None
         runs.append(bench_ours(collect))
     runs = sorted(runs)
-    ours = runs[1]
-    spread = (runs[2] - runs[0]) / ours if ours > 0 else 0.0
-    print(f"[bench] three runs: {[round(r, 3) for r in runs]} GB/s "
+    ours = runs[2]
+    spread = (runs[-1] - runs[0]) / ours if ours > 0 else 0.0
+    print(f"[bench] five runs: {[round(r, 3) for r in runs]} GB/s "
           f"(spread {spread:.1%})", file=sys.stderr)
     ref = bench_reference()
     if ref is None:

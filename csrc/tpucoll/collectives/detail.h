@@ -10,6 +10,7 @@
 #include <optional>
 #include <vector>
 
+#include "tpucoll/common/env.h"
 #include "tpucoll/context.h"
 
 namespace tpucoll {
@@ -96,25 +97,11 @@ inline RecvReduceMode recvReduceMode() {
   return mode;
 }
 
-// Strict byte-count env knob: accepts plain digit strings only, throws on
-// anything else (strtoull would silently wrap negatives and overflows —
-// exactly the misconfigurations a tuning knob must catch loudly). Call
-// sites cache the result in a function-local static: these gate hot
-// schedule decisions.
-inline size_t envBytes(const char* name, size_t dflt) {
-  const char* v = std::getenv(name);
-  if (v == nullptr || *v == '\0') {
-    return dflt;
-  }
-  char* end = nullptr;
-  errno = 0;
-  const unsigned long long parsed = std::strtoull(v, &end, 10);
-  if (end == v || *end != '\0' ||
-      !(v[0] >= '0' && v[0] <= '9') || errno == ERANGE) {
-    TC_THROW(EnforceError, name, " must be a byte count, got: ", v);
-  }
-  return static_cast<size_t>(parsed);
-}
+// Strict byte-count env knob — hoisted to common/env.h so the transport
+// layer shares the same contract; this alias keeps the many schedule
+// call sites unchanged. Call sites cache the result in a function-local
+// static: these gate hot schedule decisions.
+using ::tpucoll::envBytes;
 
 // THE fuse-eligibility predicate — single definition so every schedule
 // applies the same policy. `fuseOk` = the reduction is a builtin (safe on

@@ -1,0 +1,53 @@
+// Strict environment-knob parsing, shared by every layer that reads a
+// numeric TPUCOLL_* variable. Hoisted from collectives/detail.h so the
+// transport knobs (shm ring/threshold, stash watermark, channel striping,
+// loop-thread pool) get the same contract the schedule crossovers already
+// have: accept plain digit strings only, throw EnforceError on anything
+// else. atoll-style parsing swallows garbage ("8MB" -> 8, "-1" -> huge
+// size_t) — exactly the misconfigurations a tuning knob must catch loudly.
+#pragma once
+
+#include <cerrno>
+#include <cstdlib>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+// Byte-count knob: non-negative integer, default when unset/empty.
+inline size_t envBytes(const char* name, size_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') {
+    return dflt;
+  }
+  char* end = nullptr;
+  errno = 0;
+  const unsigned long long parsed = std::strtoull(v, &end, 10);
+  if (end == v || *end != '\0' ||
+      !(v[0] >= '0' && v[0] <= '9') || errno == ERANGE) {
+    TC_THROW(EnforceError, name, " must be a byte count, got: ", v);
+  }
+  return static_cast<size_t>(parsed);
+}
+
+// Small-count knob (thread/channel counts): strict parse PLUS a range
+// check, so TPUCOLL_CHANNELS=0 or =100000 fails at configuration time
+// instead of surfacing as a hung mesh or an OOM of loop threads.
+inline long envCount(const char* name, long dflt, long lo, long hi) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') {
+    return dflt;
+  }
+  char* end = nullptr;
+  errno = 0;
+  const long long parsed = std::strtoll(v, &end, 10);
+  if (end == v || *end != '\0' ||
+      !(v[0] >= '0' && v[0] <= '9') || errno == ERANGE) {
+    TC_THROW(EnforceError, name, " must be an integer, got: ", v);
+  }
+  TC_ENFORCE(parsed >= lo && parsed <= hi, name, " must be in [", lo, ", ",
+             hi, "], got: ", v);
+  return static_cast<long>(parsed);
+}
+
+}  // namespace tpucoll

@@ -186,6 +186,46 @@ class Metrics {
     }
     peers_[peer].recvWaitUs.record(us);
   }
+  // ---- multi-channel transport (pair data channels + loop pool) ----
+  // Wire bytes per data channel (channel 0 = the primary connection;
+  // channels 1.. carry stripes of large messages when TPUCOLL_CHANNELS
+  // > 1) and per event-loop thread progress stamps. Fixed small arrays:
+  // channel/loop counts are tiny configuration constants, and array
+  // indexing keeps the hot-path cost at one relaxed add.
+  static constexpr int kMaxChannelStats = 16;
+  static constexpr int kMaxLoopStats = 64;
+  void recordChannelTx(int channel, uint64_t bytes) {
+    if (!enabled() || channel < 0 || channel >= kMaxChannelStats) {
+      return;
+    }
+    channelTx_[channel].fetch_add(bytes, std::memory_order_relaxed);
+  }
+  void recordChannelRx(int channel, uint64_t bytes) {
+    if (!enabled() || channel < 0 || channel >= kMaxChannelStats) {
+      return;
+    }
+    channelRx_[channel].fetch_add(bytes, std::memory_order_relaxed);
+  }
+  uint64_t channelTxBytes(int channel) const {
+    return channel >= 0 && channel < kMaxChannelStats
+               ? channelTx_[channel].load(std::memory_order_relaxed)
+               : 0;
+  }
+  uint64_t channelRxBytes(int channel) const {
+    return channel >= 0 && channel < kMaxChannelStats
+               ? channelRx_[channel].load(std::memory_order_relaxed)
+               : 0;
+  }
+  // Always on like touchProgress: the per-loop liveness stamp must be
+  // trustworthy even when counters were enabled late.
+  void touchLoop(int loop, int64_t nowUs) {
+    if (loop < 0 || loop >= kMaxLoopStats) {
+      return;
+    }
+    loopLastProgressUs_[loop].store(nowUs, std::memory_order_relaxed);
+    loopEvents_[loop].fetch_add(1, std::memory_order_relaxed);
+  }
+
   // Stash-watermark backpressure engaged against this peer (rare:
   // at most once per watermark crossing).
   void recordStashPause(int peer) {
@@ -270,6 +310,10 @@ class Metrics {
   std::atomic<uint64_t> stalls_{0};
   std::atomic<uint64_t> stashPauses_{0};
   std::atomic<uint64_t> traceEventsDropped_{0};
+  std::atomic<uint64_t> channelTx_[kMaxChannelStats] = {};
+  std::atomic<uint64_t> channelRx_[kMaxChannelStats] = {};
+  std::atomic<uint64_t> loopEvents_[kMaxLoopStats] = {};
+  std::atomic<int64_t> loopLastProgressUs_[kMaxLoopStats] = {};
 
   mutable std::mutex stallMu_;
   bool haveStall_{false};

@@ -36,10 +36,14 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   store_ = std::move(store);
   device_ = std::move(device);
+  // Load any TPUCOLL_TUNING_FILE before the transport comes up: its
+  // transport hints (channel count / stripe threshold) configure the
+  // mesh being created, not just the next fork.
+  maybeLoadTuningFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
+  applyTransportHints();
   tctx_->connectFullMesh(*store_, timeout_);
-  maybeLoadTuningFile();
 }
 
 void Context::forkFrom(Context& parent, uint32_t tag) {
@@ -51,8 +55,10 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   fault::maybeLoadEnvFile();
   FlightRecorder::maybeInstallFromEnv();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
+  maybeLoadTuningFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
+  applyTransportHints();
   auto blob = tctx_->prepareFullMesh();
 
   // Exchange blob lengths, then the blobs themselves, over the parent.
@@ -91,7 +97,6 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
     off += counts[j];
   }
   tctx_->connectWithBlobs(blobs, timeout_);
-  maybeLoadTuningFile();
 }
 
 std::string Context::metricsJson(bool drain) {
@@ -107,6 +112,21 @@ void Context::setTuningTable(
 std::shared_ptr<const tuning::TuningTable> Context::tuningTable() const {
   std::lock_guard<std::mutex> guard(tuningMu_);
   return tuningTable_;
+}
+
+// Feed an installed tuning table's transport hints (tuned channel count
+// and stripe threshold) to the transport context about to connect. The
+// env knobs win inside setChannelConfig, so an operator override is
+// always possible; with no table or no hints the seed defaults hold.
+void Context::applyTransportHints() {
+  auto table = tuningTable();
+  if (table == nullptr) {
+    return;
+  }
+  const auto& hints = table->transportHints();
+  if (hints.set()) {
+    tctx_->setChannelConfig(hints.channels, hints.stripeBytes);
+  }
 }
 
 void Context::maybeLoadTuningFile() {

@@ -126,11 +126,17 @@ class Context {
   void close();
 
  private:
-  // TPUCOLL_TUNING_FILE hook: load + install a serialized table right
-  // after connect, so a deployment can pin its measured table without
-  // touching application code. Malformed files throw (never silently
-  // run untuned against an operator's explicit instruction).
+  // TPUCOLL_TUNING_FILE hook: load + install a serialized table at
+  // connect/fork (before the transport mesh is created, so its
+  // transport hints configure THIS mesh), letting a deployment pin its
+  // measured table without touching application code. Malformed files
+  // throw (never silently run untuned against an operator's explicit
+  // instruction).
   void maybeLoadTuningFile();
+  // Hand an installed table's tuned channel/stripe knobs to tctx_
+  // before it connects (env still wins; see transport::Context::
+  // setChannelConfig).
+  void applyTransportHints();
 
   const int rank_;
   const int size_;

@@ -6,6 +6,7 @@
 #include <memory>
 #include <mutex>
 #include <sstream>
+#include <utility>
 #include <thread>
 #include <vector>
 
@@ -91,10 +92,13 @@ struct Rule {
   uint64_t seed{0};        // per-rule seed override (0: schedule seed)
 };
 
-// Per-(rule, rank) mutable state. Keyed by the injecting rank so that
-// several in-process ranks (thread-per-rank tests) each see their own
-// deterministic match/fire/PRNG sequence regardless of thread
-// interleaving between ranks.
+// Per-(rule, rank, channel) mutable state. Keyed by the injecting rank
+// so that several in-process ranks (thread-per-rank tests) each see
+// their own deterministic match/fire/PRNG sequence regardless of thread
+// interleaving between ranks — and by the data channel so a pair whose
+// traffic stripes across channels (TPUCOLL_CHANNELS > 1) keeps one
+// deterministic stream per channel instead of a shared stream whose
+// order would depend on channel interleaving.
 struct RuleState {
   uint64_t matches{0};
   uint64_t fires{0};
@@ -111,13 +115,15 @@ struct Fired {
   int opcode;
   uint64_t slot;
   uint64_t nbytes;
+  int channel;
 };
 
 struct Table {
   uint64_t seed{0};
   std::vector<Rule> rules;
   // mutable firing state, guarded by g_mu
-  std::vector<std::map<int, RuleState>> state;  // per rule, per rank
+  // per rule, per (rank, channel)
+  std::vector<std::map<std::pair<int, int>, RuleState>> state;
   std::map<int, uint64_t> firesPerRank;
   std::vector<Fired> fired;
 };
@@ -264,7 +270,7 @@ struct Evaluation {
 };
 
 Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
-                          uint64_t nbytes) {
+                          uint64_t nbytes, int channel) {
   Evaluation ev;
   Table* t = g_table.get();
   if (t == nullptr) {
@@ -288,7 +294,7 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
         nbytes < r.minBytes || nbytes > r.maxBytes) {
       continue;
     }
-    RuleState& st = t->state[i][rank];
+    RuleState& st = t->state[i][{rank, channel}];
     st.matches++;
     if (st.fires >= r.maxFires) {
       continue;
@@ -300,7 +306,8 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
       if (!st.rngInit) {
         st.rng = splitmix64((r.seed != 0 ? r.seed : t->seed) ^
                             splitmix64(i * 0x9E37u + 1) ^
-                            splitmix64(static_cast<uint64_t>(rank) + 0x51u));
+                            splitmix64(static_cast<uint64_t>(rank) + 0x51u) ^
+                            splitmix64(static_cast<uint64_t>(channel) * 0xC11u));
         st.rngInit = true;
       }
       const double u =
@@ -312,7 +319,7 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
     st.fires++;
     const uint64_t n = t->firesPerRank[rank]++;
     t->fired.push_back(Fired{rank, n, i, r.action, peer, opcode, slot,
-                             nbytes});
+                             nbytes, channel});
     ev.firedActions.emplace_back(r.action, nbytes);
     switch (r.action) {
       case Action::kDelay:
@@ -443,7 +450,7 @@ std::string report() {
             << actionName(f.action) << "\",\"peer\":" << f.peer
             << ",\"opcode\":\"" << opcodeName(f.opcode)
             << "\",\"slot\":" << f.slot << ",\"nbytes\":" << f.nbytes
-            << "}";
+            << ",\"channel\":" << f.channel << "}";
       }
     }
   }
@@ -467,11 +474,13 @@ void maybeLoadEnvFile() {
 }
 
 TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
-                       uint64_t nbytes, Metrics* metrics, Tracer* tracer) {
+                       uint64_t nbytes, Metrics* metrics, Tracer* tracer,
+                       int channel) {
   Evaluation ev;
   {
     std::lock_guard<std::mutex> guard(g_mu);
-    ev = evaluateLocked(rank, peer, static_cast<int>(opcode), slot, nbytes);
+    ev = evaluateLocked(rank, peer, static_cast<int>(opcode), slot, nbytes,
+                        channel);
   }
   accountFired(ev, rank, peer, metrics, tracer);
   if (ev.sleepMs > 0) {
@@ -494,7 +503,7 @@ void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer) {
   Evaluation ev;
   {
     std::lock_guard<std::mutex> guard(g_mu);
-    ev = evaluateLocked(rank, peer, kOpConnect, 0, 0);
+    ev = evaluateLocked(rank, peer, kOpConnect, 0, 0, /*channel=*/0);
   }
   accountFired(ev, rank, peer, metrics, tracer);
   if (ev.sleepMs > 0) {

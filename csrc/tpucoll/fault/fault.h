@@ -87,7 +87,8 @@ void install(const std::string& json);
 void clear();
 
 // The deterministic firing log as a JSON array, in firing order:
-//   [{"rank","n","rule","action","peer","opcode","slot","nbytes"}, ...]
+//   [{"rank","n","rule","action","peer","opcode","slot","nbytes",
+//     "channel"}, ...]
 // `n` counts fires per injecting rank, so each rank's subsequence is
 // reproducible even when several in-process ranks interleave. Entries
 // carry no timestamps — two runs with the same seed, schedule, and
@@ -103,9 +104,13 @@ void maybeLoadEnvFile();
 // Slow-path evaluation, called only when armed(). Counts each fired
 // fault in `metrics` (when non-null) and stamps a span into `tracer`
 // (when enabled); delay/stall sleep here, after the table mutex is
-// released.
+// released. `channel` is the data channel carrying the message
+// (0 = the pair's primary connection): per-rule match/fire/PRNG state
+// is keyed per (rule, rank, channel) so a pair whose traffic stripes
+// across channels keeps a deterministic firing sequence per channel.
 TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
-                       uint64_t nbytes, Metrics* metrics, Tracer* tracer);
+                       uint64_t nbytes, Metrics* metrics, Tracer* tracer,
+                       int channel = 0);
 
 // Connect-path evaluation: throws IoException when a connect_refuse
 // rule fires (the pair's retry loop classifies it as retryable).

@@ -312,15 +312,28 @@ void TcpStoreServer::serveClient(int fd) {
 
 TcpStore::TcpStore(const std::string& host, uint16_t port) {
   auto addr = transport::resolve(host, port);
-  fd_ = socket(addr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
-  TC_ENFORCE_GE(fd_, 0, "socket: ", strerror(errno));
   // Bounded retry: the server (typically rank 0) may come up after us.
+  // Each attempt uses a FRESH socket — a socket whose connect failed is
+  // in an unspecified state, and retrying connect(2) on it is exactly
+  // what yields the sporadic ECONNABORTED ("software caused connection
+  // abort") that used to kill a rank out of the bootstrap race.
+  // ECONNABORTED/ECONNRESET are themselves transient during server
+  // startup and retry like ECONNREFUSED.
   const auto deadline =
       std::chrono::steady_clock::now() + std::chrono::seconds(30);
-  while (connect(fd_, addr.sa(), addr.len) != 0) {
-    if (errno != ECONNREFUSED && errno != EINTR) {
+  while (true) {
+    fd_ = socket(addr.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    TC_ENFORCE_GE(fd_, 0, "socket: ", strerror(errno));
+    if (connect(fd_, addr.sa(), addr.len) == 0) {
+      break;
+    }
+    const int err = errno;
+    ::close(fd_);
+    fd_ = -1;
+    if (err != ECONNREFUSED && err != ECONNABORTED && err != ECONNRESET &&
+        err != EINTR) {
       TC_THROW(IoException, "TcpStore connect to ", addr.str(), ": ",
-               strerror(errno));
+               strerror(err));
     }
     if (std::chrono::steady_clock::now() >= deadline) {
       TC_THROW(TimeoutException, "TcpStore connect to ", addr.str(),

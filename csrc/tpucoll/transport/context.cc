@@ -6,6 +6,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "tpucoll/common/env.h"
 #include "tpucoll/transport/device.h"
 #include "tpucoll/transport/pair.h"
 
@@ -17,11 +18,22 @@ namespace {
 std::string rankKey(int rank) { return "tc/rank/" + std::to_string(rank); }
 
 // Rank blob: [u32 numRanks][u32 addrLen][addr][u64 pairId * numRanks].
+// With TPUCOLL_CHANNELS > 1 a channel extension follows:
+// [u32 kBlobChannelsMagic][u32 channels][u64 channelId * numRanks*(C-1)]
+// (channel-major per peer: ids[j*(C-1) + (c-1)] routes channel c of the
+// pair toward peer j). A single-channel context emits the seed's exact
+// byte layout, and a channel-count mismatch between ranks fails the
+// bootstrap loudly instead of hanging the mesh.
+constexpr uint32_t kBlobChannelsMagic = 0x7C01100A;
+
 std::vector<uint8_t> packRankBlob(int numRanks, const SockAddr& addr,
-                                  const std::vector<uint64_t>& pairIds) {
+                                  const std::vector<uint64_t>& pairIds,
+                                  int channels,
+                                  const std::vector<uint64_t>& channelIds) {
   auto addrBytes = addr.serialize();
   std::vector<uint8_t> blob;
-  blob.reserve(8 + addrBytes.size() + 8 * pairIds.size());
+  blob.reserve(8 + addrBytes.size() + 8 * pairIds.size() +
+               (channels > 1 ? 8 + 8 * channelIds.size() : 0));
   uint32_t n = static_cast<uint32_t>(numRanks);
   uint32_t alen = static_cast<uint32_t>(addrBytes.size());
   blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&n),
@@ -33,11 +45,25 @@ std::vector<uint8_t> packRankBlob(int numRanks, const SockAddr& addr,
               reinterpret_cast<const uint8_t*>(pairIds.data()),
               reinterpret_cast<const uint8_t*>(pairIds.data()) +
                   8 * pairIds.size());
+  if (channels > 1) {
+    uint32_t magic = kBlobChannelsMagic;
+    uint32_t c = static_cast<uint32_t>(channels);
+    blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&magic),
+                reinterpret_cast<uint8_t*>(&magic) + 4);
+    blob.insert(blob.end(), reinterpret_cast<uint8_t*>(&c),
+                reinterpret_cast<uint8_t*>(&c) + 4);
+    blob.insert(blob.end(),
+                reinterpret_cast<const uint8_t*>(channelIds.data()),
+                reinterpret_cast<const uint8_t*>(channelIds.data()) +
+                    8 * channelIds.size());
+  }
   return blob;
 }
 
 void unpackRankBlob(const std::vector<uint8_t>& blob, int expectRanks,
-                    SockAddr* addr, std::vector<uint64_t>* pairIds) {
+                    int expectChannels, SockAddr* addr,
+                    std::vector<uint64_t>* pairIds,
+                    std::vector<uint64_t>* channelIds) {
   TC_ENFORCE_GE(blob.size(), size_t(8), "rank blob too short");
   uint32_t n, alen;
   std::memcpy(&n, blob.data(), 4);
@@ -48,6 +74,26 @@ void unpackRankBlob(const std::vector<uint8_t>& blob, int expectRanks,
   *addr = SockAddr::deserialize(blob.data() + 8, alen);
   pairIds->resize(n);
   std::memcpy(pairIds->data(), blob.data() + 8 + alen, size_t(8) * n);
+  size_t off = 8 + alen + size_t(8) * n;
+  channelIds->clear();
+  if (blob.size() > off) {
+    TC_ENFORCE_GE(blob.size(), off + 8, "rank blob truncated");
+    uint32_t magic, peerChannels;
+    std::memcpy(&magic, blob.data() + off, 4);
+    std::memcpy(&peerChannels, blob.data() + off + 4, 4);
+    TC_ENFORCE_EQ(magic, kBlobChannelsMagic, "rank blob corrupt");
+    TC_ENFORCE_EQ(int(peerChannels), expectChannels,
+                  "TPUCOLL_CHANNELS mismatch across ranks: peer uses ",
+                  peerChannels, ", this rank uses ", expectChannels);
+    const size_t want = size_t(8) * n * (peerChannels - 1);
+    TC_ENFORCE_GE(blob.size(), off + 8 + want, "rank blob truncated");
+    channelIds->resize(n * (peerChannels - 1));
+    std::memcpy(channelIds->data(), blob.data() + off + 8, want);
+  } else {
+    TC_ENFORCE_EQ(expectChannels, 1,
+                  "TPUCOLL_CHANNELS mismatch across ranks: peer uses 1, "
+                  "this rank uses ", expectChannels);
+  }
 }
 
 }  // namespace
@@ -56,59 +102,139 @@ Context::Context(std::shared_ptr<Device> device, int rank, int size)
     : device_(std::move(device)), rank_(rank), size_(size) {
   TC_ENFORCE(rank >= 0 && rank < size, "bad rank ", rank, " for size ", size);
   pairs_.resize(size);
+  channelPairs_.resize(size);
   pairErrors_.resize(size);
   stashBytes_.resize(size, 0);
   rxPaused_.resize(size, 0);
-  stashHighWater_ = 64u << 20;
-  if (const char* env = std::getenv("TPUCOLL_MAX_STASH_BYTES")) {
-    stashHighWater_ = std::max<size_t>(std::atoll(env), 1u << 20);
+  stripeStageBytes_.resize(size, 0);
+  stripePausedMask_.resize(size, 0);
+  // Strict parses (common/env.h): malformed knobs throw here, at context
+  // construction, instead of silently running with a default.
+  stashHighWater_ =
+      std::max<size_t>(envBytes("TPUCOLL_MAX_STASH_BYTES", 64u << 20),
+                       1u << 20);
+  const long envCh =
+      envCount("TPUCOLL_CHANNELS", 0, 1, kMaxStripeChannels);
+  if (envCh > 0) {
+    channels_ = static_cast<int>(envCh);
+    channelsFromEnv_ = true;
+  }
+  const uint64_t envStripe = envBytes("TPUCOLL_STRIPE_BYTES", 0);
+  if (envStripe > 0) {
+    // Floor keeps every stripe non-empty and the per-stripe header
+    // overhead negligible.
+    stripeBytes_ = std::max<uint64_t>(envStripe, 4096);
+    stripeBytesFromEnv_ = true;
+  }
+}
+
+void Context::setChannelConfig(int channels, uint64_t stripeBytes) {
+  for (const auto& p : pairs_) {
+    TC_ENFORCE(p == nullptr,
+               "setChannelConfig must run before the mesh is created");
+  }
+  if (!channelsFromEnv_ && channels > 0) {
+    TC_ENFORCE(channels <= static_cast<int>(kMaxStripeChannels),
+               "channels must be in [1, ", kMaxStripeChannels, "], got ",
+               channels);
+    channels_ = channels;
+  }
+  if (!stripeBytesFromEnv_ && stripeBytes > 0) {
+    stripeBytes_ = std::max<uint64_t>(stripeBytes, 4096);
   }
 }
 
 Context::~Context() {
   close();
   // Loop-thread teardowns may still reference this context (onPairError /
-  // matchIncoming); quiesce before members are freed.
-  device_->loop()->barrier();
+  // matchIncoming / stripeIncoming); pairs shard across the whole loop
+  // pool, so quiesce EVERY loop before members are freed.
+  device_->barrierAllLoops();
+  channelPairs_.clear();
   pairs_.clear();
 }
 
 std::vector<uint8_t> Context::prepareFullMesh() {
   std::vector<uint64_t> pairIds(size_, 0);
+  std::vector<uint64_t> channelIds(
+      channels_ > 1 ? size_t(size_) * (channels_ - 1) : 0, 0);
   for (int j = 0; j < size_; j++) {
     if (j == rank_) {
       continue;
     }
-    pairs_[j] = std::make_unique<Pair>(this, device_->loop(), rank_, j,
-                                       device_->nextPairId());
+    // Round-robin loop sharding: channel c of the pair toward peer j
+    // lands on loop (j*C + c) % numLoops, so with numLoops >= channels
+    // every channel of one logical pair progresses on a distinct loop
+    // thread.
+    const uint64_t key0 = uint64_t(j) * channels_;
+    pairs_[j] = std::make_unique<Pair>(this, device_->loopFor(key0), rank_,
+                                       j, device_->nextPairId(), 0,
+                                       device_->loopIndexFor(key0));
     pairIds[j] = pairs_[j]->localPairId();
+    channelPairs_[j].clear();
+    for (int c = 1; c < channels_; c++) {
+      const uint64_t key = key0 + c;
+      channelPairs_[j].push_back(std::make_unique<Pair>(
+          this, device_->loopFor(key), rank_, j, device_->nextPairId(), c,
+          device_->loopIndexFor(key)));
+      channelIds[size_t(j) * (channels_ - 1) + (c - 1)] =
+          channelPairs_[j].back()->localPairId();
+    }
   }
   // Lower rank listens, higher rank initiates: register expectations first
   // so an early initiator finds a parked or expected pair either way.
   for (int j = rank_ + 1; j < size_; j++) {
     pairs_[j]->expectViaListener(device_->listener());
+    for (auto& cp : channelPairs_[j]) {
+      cp->expectViaListener(device_->listener());
+    }
   }
-  return packRankBlob(size_, device_->address(), pairIds);
+  return packRankBlob(size_, device_->address(), pairIds, channels_,
+                      channelIds);
 }
 
 void Context::connectWithBlobs(
     const std::vector<std::vector<uint8_t>>& blobs,
     std::chrono::milliseconds timeout) {
   TC_ENFORCE_EQ(blobs.size(), static_cast<size_t>(size_));
-  // Connect only toward lower ranks; higher ranks initiate to us.
+  // Parse EVERY peer's blob up front, once: a configuration mismatch
+  // (e.g. disagreeing TPUCOLL_CHANNELS) must fail loudly on every
+  // rank — not just on the ranks that need the blob for an outbound
+  // connection (the others would time out waiting for a peer that
+  // already aborted) — and the connect loop below reuses the parses.
+  std::vector<SockAddr> peerAddrs(size_);
+  std::vector<std::vector<uint64_t>> peerPairIds(size_);
+  std::vector<std::vector<uint64_t>> peerChannelIds(size_);
+  for (int j = 0; j < size_; j++) {
+    if (j == rank_) {
+      continue;
+    }
+    unpackRankBlob(blobs[j], size_, channels_, &peerAddrs[j],
+                   &peerPairIds[j], &peerChannelIds[j]);
+  }
+  // Connect only toward lower ranks; higher ranks initiate to us. Every
+  // data channel is its own connection with its own handshake (and, on
+  // encrypted devices, its own derived AEAD keys).
   for (int j = 0; j < rank_; j++) {
-    SockAddr addr;
-    std::vector<uint64_t> peerPairIds;
-    unpackRankBlob(blobs[j], size_, &addr, &peerPairIds);
-    pairs_[j]->connect(addr, peerPairIds[rank_], timeout);
+    pairs_[j]->connect(peerAddrs[j], peerPairIds[j][rank_], timeout);
+    for (int c = 1; c < channels_; c++) {
+      channelPairs_[j][c - 1]->connect(
+          peerAddrs[j],
+          peerChannelIds[j][size_t(rank_) * (channels_ - 1) + (c - 1)],
+          timeout);
+    }
   }
   for (int j = 0; j < size_; j++) {
     if (j != rank_) {
       pairs_[j]->waitConnected(timeout);
+      for (auto& cp : channelPairs_[j]) {
+        cp->waitConnected(timeout);
+      }
     }
   }
   TC_DEBUG("rank ", rank_, ": full mesh of ", size_, " connected via ",
-           device_->str());
+           device_->str(), " (", channels_, " channel(s)/pair, stripe >= ",
+           stripeBytes_, " bytes)");
 }
 
 void Context::connectFullMesh(Store& store,
@@ -181,6 +307,39 @@ bool Context::writeRegion(uint64_t token, uint64_t roffset,
   return true;
 }
 
+namespace {
+
+// Shared failure tail of the striped fan-outs: nothing was enqueued ->
+// plain cancel (the single-channel contract: a throwing post leaves the
+// buffer clean and reusable); otherwise mark the logical op failed,
+// resolve the never-enqueued stripes, and let the LAST resolution
+// (possibly a sibling's wire completion on another loop) deliver the
+// single onSendError — never before the buffer's memory is quiescent.
+void resolveAbortedStripes(UnboundBuffer* buf,
+                           const std::shared_ptr<StripeTx>& st,
+                           int enqueued, int channels, const char* what) {
+  if (enqueued == 0) {
+    buf->cancelPendingSend();
+    return;
+  }
+  st->recordError(detail::strCat("striped ", what,
+                                 " aborted: a data channel refused "
+                                 "the stripe"));
+  const int missing = channels - enqueued;
+  if (st->remaining.fetch_sub(missing) == missing) {
+    // Copy under errMu: a sibling stripe's failure may be recording
+    // concurrently.
+    std::string msg;
+    {
+      std::lock_guard<std::mutex> guard(st->errMu);
+      msg = st->error;
+    }
+    buf->onSendError(msg);
+  }
+}
+
+}  // namespace
+
 void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
                       uint64_t roffset, char* data, size_t nbytes,
                       bool notify) {
@@ -209,10 +368,40 @@ void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
     pair = pairs_[dstRank].get();
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
+  // Non-notify puts stripe like sends (each stripe is an independent
+  // one-sided write of a disjoint range — no receiver-side reassembly
+  // needed). Notify puts stay whole: the arrival notification must fire
+  // after ALL bytes land, and cross-channel arrival order is undefined.
+  if (channels_ > 1 && !notify && nbytes >= stripeBytes_ &&
+      nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
+    buf->cancelPendingSend();  // postPutStriped re-adds exactly once
+    postPutStriped(buf, dstRank, token, roffset, data, nbytes);
+    return;
+  }
   try {
     pair->sendPut(buf, token, roffset, data, nbytes, notify);
   } catch (...) {
     buf->cancelPendingSend();
+    throw;
+  }
+}
+
+void Context::postPutStriped(UnboundBuffer* buf, int dstRank,
+                             uint64_t token, uint64_t roffset, char* data,
+                             size_t nbytes) {
+  buf->addPendingSend();
+  auto st = std::make_shared<StripeTx>(channels_);
+  int enqueued = 0;
+  try {
+    for (int c = 0; c < channels_; c++) {
+      const uint64_t off = stripeOffset(nbytes, channels_, c);
+      const uint64_t span = stripeSpan(nbytes, channels_, c);
+      pairFor(dstRank, c)->sendPut(buf, token, roffset + off, data + off,
+                                   span, /*notify=*/false, st);
+      enqueued++;
+    }
+  } catch (...) {
+    resolveAbortedStripes(buf, st, enqueued, channels_, "put");
     throw;
   }
 }
@@ -260,7 +449,13 @@ void Context::close() {
       pair->close();
     }
   }
-  // Fail receives that will now never complete.
+  for (auto& cps : channelPairs_) {
+    for (auto& cp : cps) {
+      cp->close();
+    }
+  }
+  // Fail receives that will now never complete — posted ones and those
+  // claimed by an in-flight stripe reassembly.
   std::vector<UnboundBuffer*> victims;
   {
     std::lock_guard<std::mutex> guard(mu_);
@@ -268,8 +463,17 @@ void Context::close() {
       victims.push_back(pr.ubuf);
     }
     posted_.clear();
+    // Every pair (all channels) was closed above — teardown del()s the
+    // fd with a loop-tick barrier — so no channel rx still writes into
+    // any reassembly buffer and everything can be reaped.
+    for (int r = 0; r < size_; r++) {
+      dropStripesLocked(r, "context closed", /*channel=*/-1,
+                        /*allQuiesced=*/true, &victims);
+    }
     stashed_.clear();
     std::fill(stashBytes_.begin(), stashBytes_.end(), 0);
+    std::fill(stripeStageBytes_.begin(), stripeStageBytes_.end(), 0);
+    std::fill(stripePausedMask_.begin(), stripePausedMask_.end(), 0);
   }
   for (auto* b : victims) {
     b->onRecvError("context closed");
@@ -347,10 +551,42 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
     pair = pairs_[dstRank].get();
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
+  // Stripe large payloads across the pair's data channels (perf path:
+  // TCP stack work, stash memcpys, and per-connection encryption then
+  // run concurrently on several loop threads). The shm plane already
+  // sidesteps the TCP serialization for same-host peers, so an shm
+  // pair keeps the single-connection path.
+  if (channels_ > 1 && nbytes >= stripeBytes_ &&
+      nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
+    buf->cancelPendingSend();  // postSendStriped re-adds exactly once
+    postSendStriped(buf, dstRank, slot, data, nbytes);
+    return;
+  }
   try {
     pair->send(buf, slot, data, nbytes);
   } catch (...) {
     buf->cancelPendingSend();
+    throw;
+  }
+}
+
+void Context::postSendStriped(UnboundBuffer* buf, int dstRank,
+                              uint64_t slot, char* data, size_t nbytes) {
+  buf->addPendingSend();
+  auto st = std::make_shared<StripeTx>(channels_);
+  const uint8_t seqLow = static_cast<uint8_t>(stripeSeq_.fetch_add(1));
+  int enqueued = 0;
+  try {
+    for (int c = 0; c < channels_; c++) {
+      const uint64_t off = stripeOffset(nbytes, channels_, c);
+      const uint64_t span = stripeSpan(nbytes, channels_, c);
+      pairFor(dstRank, c)->sendStripe(
+          buf, slot, data + off, span, nbytes,
+          static_cast<uint8_t>(channels_), seqLow, st);
+      enqueued++;
+    }
+  } catch (...) {
+    resolveAbortedStripes(buf, st, enqueued, channels_, "send");
     throw;
   }
 }
@@ -408,13 +644,13 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
       if (stashSrc != rank_ && rxPaused_[stashSrc] && pairs_[stashSrc] &&
           stashBytes_[stashSrc] < stashHighWater_ / 2) {
         rxPaused_[stashSrc] = 0;
-        pairs_[stashSrc]->resumeReading();  // under mu_: see stashArrived
+        resumePeerLocked(stashSrc);  // under mu_: see stashArrived
       }
     } else {
       for (int r : srcRanks) {
         if (rxPaused_[r] && pairs_[r]) {
           rxPaused_[r] = 0;
-          pairs_[r]->resumeReading();
+          resumePeerLocked(r);
         }
       }
     }
@@ -453,6 +689,12 @@ void Context::cancelRecvsFor(UnboundBuffer* buf) {
 }
 
 int Context::cancelSendsFor(UnboundBuffer* buf) {
+  // Only plain (non-striped) queued sends are cancellable, and those
+  // live exclusively on the primary pairs: striped ops are pinned in
+  // their queues (cancelQueuedSends skips them — a sibling stripe may
+  // already be on the wire, and shipping a partial message would hang
+  // the receiver's reassembly) and resolve via wire completion or via
+  // failPairsWithInflightSend failing their pair.
   int cancelled = 0;
   for (auto& pair : pairs_) {
     if (pair) {
@@ -470,6 +712,331 @@ void Context::failPairsWithInflightSend(UnboundBuffer* buf) {
     if (pair && pair->hasInflightSend(buf)) {
       pair->failFromUser(
           "send dropped: buffer destroyed while payload was in flight");
+    }
+  }
+  for (auto& cps : channelPairs_) {
+    for (auto& cp : cps) {
+      if (cp->hasInflightSend(buf)) {
+        cp->failFromUser(
+            "send dropped: buffer destroyed while payload was in flight");
+      }
+    }
+  }
+  // Receive analog for stripe reassembly: a recv claimed by an entry in
+  // stripes_ left posted_ (so cancelRecvsFor cannot see it) and only
+  // completes when the remaining stripes land. If the buffer is being
+  // destroyed while such an entry is open, fail the source's channel
+  // pairs — their teardown drops/poisons the entry and errors the
+  // claimed recv, unblocking the destructor.
+  std::vector<int> stripeSrcs;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (const auto& e : stripes_) {
+      if (e.ubuf == buf) {
+        stripeSrcs.push_back(e.srcRank);
+      }
+    }
+  }
+  for (int src : stripeSrcs) {
+    if (pairs_[src]) {
+      pairs_[src]->failFromUser(
+          "recv dropped: buffer destroyed while stripes were in flight");
+    }
+    for (auto& cp : channelPairs_[src]) {
+      cp->failFromUser(
+          "recv dropped: buffer destroyed while stripes were in flight");
+    }
+  }
+}
+
+void Context::pausePeerLocked(int rank) {
+  // Backpressure must cover every channel: a striped flood arrives on
+  // all of them, and pausing only the primary would let the stripes
+  // keep filling the reassembly list.
+  if (pairs_[rank]) {
+    pairs_[rank]->pauseReading();
+  }
+  for (auto& cp : channelPairs_[rank]) {
+    cp->pauseReading();
+  }
+}
+
+void Context::resumePeerLocked(int rank) {
+  if (pairs_[rank]) {
+    pairs_[rank]->resumeReading();
+  }
+  for (auto& cp : channelPairs_[rank]) {
+    cp->resumeReading();
+  }
+  // A full-peer resume also lifts any stage-backpressure pauses
+  // (resumeReading is idempotent; the mask must not go stale).
+  stripePausedMask_[rank] = 0;
+}
+
+void Context::accountStageLocked(int srcRank, size_t bytes) {
+  stripeStageBytes_[srcRank] += bytes;
+  maybePauseAheadChannelsLocked(srcRank);
+}
+
+void Context::maybePauseAheadChannelsLocked(int srcRank) {
+  if (stripeStageBytes_[srcRank] <= stashHighWater_ || srcRank == rank_ ||
+      rxPaused_[srcRank] || !pairs_[srcRank]) {
+    return;
+  }
+  // A channel is "ahead" when every open entry from this source already
+  // has its stripe fully landed: pausing it cannot block any open
+  // entry's completion. At least one channel always stays readable —
+  // every open entry has an unlanded stripe, and its channel fails the
+  // landedMask test — so the stage bytes keep draining and the pause is
+  // guaranteed to lift at the low watermark.
+  uint32_t ahead = (channels_ >= 32)
+                       ? ~uint32_t(0)
+                       : ((uint32_t(1) << channels_) - 1);
+  for (const auto& e : stripes_) {
+    if (e.srcRank == srcRank) {
+      ahead &= e.landedMask;
+    }
+  }
+  ahead &= ~stripePausedMask_[srcRank];
+  if (ahead == 0) {
+    return;
+  }
+  if (stripePausedMask_[srcRank] == 0 && metrics_ != nullptr) {
+    metrics_->recordStashPause(srcRank);
+  }
+  for (int c = 0; c < channels_; c++) {
+    if (ahead & (uint32_t(1) << c)) {
+      pairFor(srcRank, c)->pauseReading();
+      stripePausedMask_[srcRank] |= uint32_t(1) << c;
+    }
+  }
+}
+
+void Context::releaseStageLocked(int srcRank, size_t bytes) {
+  stripeStageBytes_[srcRank] -= bytes;
+  if (stripePausedMask_[srcRank] != 0 && !rxPaused_[srcRank] &&
+      stripeStageBytes_[srcRank] < stashHighWater_ / 2) {
+    const uint32_t mask = stripePausedMask_[srcRank];
+    stripePausedMask_[srcRank] = 0;
+    for (int c = 0; c < channels_; c++) {
+      if ((mask & (uint32_t(1) << c)) && pairFor(srcRank, c) != nullptr) {
+        pairFor(srcRank, c)->resumeReading();
+      }
+    }
+  }
+}
+
+Context::StripeMatch Context::stripeIncoming(int srcRank, uint64_t slot,
+                                             uint8_t seqLow, uint64_t total,
+                                             uint32_t count,
+                                             uint32_t index) {
+  const uint32_t bit = 1u << index;
+  std::vector<char> stage;  // sized OUTSIDE mu_ when a stage is needed
+  for (;;) {
+    std::unique_lock<std::mutex> guard(mu_);
+    for (auto& e : stripes_) {
+      if (e.srcRank == srcRank && e.slot == slot && e.seqLow == seqLow &&
+          e.total == total && e.count == count &&
+          (e.arrivedMask & bit) == 0) {
+        // Oldest key-matching entry this channel has not yet fed. The
+        // bit check also covers the 8-bit seq tag wrapping under extreme
+        // channel skew (256 same-key messages in flight): the wrapped
+        // message simply opens a fresh entry below, and per-channel FIFO
+        // keeps oldest-without-bit the correct home for every stripe.
+        e.arrivedMask |= bit;
+        char* base =
+            (e.direct && e.combine == nullptr) ? e.dest : e.buf.data();
+        return {base + stripeOffset(total, count, index), e.id};
+      }
+    }
+    // First stripe of this message: claim a posted receive exactly like
+    // matchIncoming would (throws on size mismatch), or start a stash
+    // reassembly. Entry creation order tracks logical-message order per
+    // (src, slot) — a later message's first stripe can only arrive after
+    // its channel delivered every earlier message's stripe, and this
+    // channel's delivery completes its install before the next header is
+    // read, even across the allocation gap below — so claims observe the
+    // same FIFO the single-connection path has.
+    //
+    // A source that already failed can never complete a NEWLY OPENED
+    // message: at least one of its channels is gone, and any message
+    // whose full stripe set made it out completed through entries opened
+    // before the failure (per-channel FIFO), so a set opened now is
+    // permanently short. Sink the payload into a born-dead entry —
+    // claiming a posted receive here would strand a buffer another live
+    // source could still serve — reaped by stripeLanded's dead path.
+    const bool bornDead = !pairErrors_[srcRank].empty();
+    auto it = bornDead ? posted_.end() : findPosted(srcRank, slot, total);
+    const bool needStage =
+        it == posted_.end() || it->combine != nullptr;
+    if (needStage && stage.size() != total) {
+      // The (possibly multi-MiB, zero-filling) stage allocation must not
+      // run under mu_ — it would stall every other channel's matching
+      // and all post/stash accounting. Drop the lock, size it, rescan: a
+      // sibling stripe may have installed the entry meanwhile (then the
+      // match above wins and this allocation is discarded), and the
+      // posted claim is re-resolved fresh after relocking.
+      guard.unlock();
+      stage.resize(total);
+      continue;
+    }
+    StripeEntry e;
+    e.id = nextStripeEntry_++;
+    e.srcRank = srcRank;
+    e.slot = slot;
+    e.seqLow = seqLow;
+    e.total = total;
+    e.count = count;
+    e.arrivedMask = bit;
+    if (bornDead) {
+      e.dead = true;
+      e.error = pairErrors_[srcRank];
+    }
+    if (it != posted_.end()) {
+      e.direct = true;
+      e.ubuf = it->ubuf;
+      e.dest = it->dest;
+      e.combine = it->combine;
+      e.combineElsize = it->combineElsize;
+      if (e.combine != nullptr) {
+        // Fused recvReduce: byte-offset stripes may split an element
+        // across channels, so stripes stage here and the fold runs once,
+        // at completion, over the whole message.
+        e.buf = std::move(stage);
+      }
+      posted_.erase(it);
+    } else {
+      e.buf = std::move(stage);
+    }
+    stripes_.push_back(std::move(e));
+    StripeEntry& ne = stripes_.back();
+    if (!ne.direct) {
+      // Unmatched stage: counts against the in-flight reassembly
+      // watermark (a claimed recv's stage is bounded by what the app
+      // posted and is not counted). Accounted AFTER the push so the
+      // pause scan sees this entry — its unlanded stripe keeps the
+      // delivering channel readable.
+      accountStageLocked(srcRank, total);
+    }
+    char* base =
+        (ne.direct && ne.combine == nullptr) ? ne.dest : ne.buf.data();
+    return {base + stripeOffset(total, count, index), ne.id};
+  }
+}
+
+void Context::stripeLanded(int srcRank, uint64_t entry, uint32_t index) {
+  UnboundBuffer* rbuf = nullptr;
+  UnboundBuffer* errBuf = nullptr;
+  std::string errMsg;
+  std::vector<char> stashPayload;
+  uint64_t slot = 0;
+  bool toStash = false;
+  char* foldDest = nullptr;
+  RecvReduceFn foldFn = nullptr;
+  size_t foldElsize = 0;
+  uint64_t foldTotal = 0;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    auto it = stripes_.begin();
+    while (it != stripes_.end() && it->id != entry) {
+      ++it;
+    }
+    if (it == stripes_.end()) {
+      return;  // reaped after a quiesced failure / close
+    }
+    it->landedMask |= 1u << index;
+    const uint32_t full = (1u << it->count) - 1;
+    if (it->dead) {
+      // A dead entry can NEVER complete successfully: any entry still
+      // in the list when its source failed was incomplete (a complete
+      // one is erased at its last stripeLanded, under this same mu_),
+      // and dropStripesLocked force-marks the dead channel's half-read
+      // stripe as landed — its byte range is a hole, not data. Reap
+      // with the deferred error once no sibling still writes.
+      if (it->landedMask == it->arrivedMask) {
+        errBuf = it->ubuf;
+        errMsg = it->error;
+        if (!it->direct) {
+          releaseStageLocked(srcRank, it->total);
+        }
+        stripes_.erase(it);
+      }
+    } else if (it->landedMask == full) {
+      // Every stripe landed: deliver. The (possibly multi-MiB)
+      // recvReduce fold runs OUTSIDE mu_ — the entry is off the list,
+      // so nothing else references its stage.
+      if (it->direct) {
+        if (it->combine != nullptr) {
+          foldDest = it->dest;
+          foldFn = it->combine;
+          foldElsize = it->combineElsize;
+          foldTotal = it->total;
+          stashPayload = std::move(it->buf);  // the stage to fold from
+        }
+        rbuf = it->ubuf;
+      } else {
+        toStash = true;
+        slot = it->slot;
+        stashPayload = std::move(it->buf);
+        releaseStageLocked(srcRank, it->total);
+      }
+      stripes_.erase(it);
+    } else {
+      // Entry stays open: this channel may have just become "ahead" of
+      // every open entry — re-evaluate the stage backpressure.
+      maybePauseAheadChannelsLocked(srcRank);
+    }
+  }
+  if (foldFn != nullptr) {
+    landPayload(foldDest, foldFn, foldElsize, stashPayload.data(),
+                foldTotal);
+  }
+  if (rbuf != nullptr) {
+    rbuf->onRecvComplete(srcRank);
+  }
+  if (errBuf != nullptr) {
+    errBuf->onRecvError(errMsg);
+  }
+  if (toStash) {
+    // The normal race-closing stash path: re-checks posted receives,
+    // accounts watermarks, and pauses the peer when flooded.
+    stashArrived(srcRank, slot, std::move(stashPayload));
+  }
+}
+
+void Context::dropStripesLocked(int rank, const std::string& message,
+                                int channel, bool allQuiesced,
+                                std::vector<UnboundBuffer*>* victims) {
+  for (auto it = stripes_.begin(); it != stripes_.end();) {
+    if (it->srcRank != rank) {
+      ++it;
+      continue;
+    }
+    if (channel >= 0) {
+      // The failing channel's rx is quiesced (teardown del'd its fd
+      // behind the loop barrier before notifying), so its half-read
+      // stripe — if any — is abandoned, not in flight.
+      const uint32_t bit = 1u << channel;
+      if ((it->arrivedMask & bit) != 0) {
+        it->landedMask |= bit;
+      }
+    }
+    if (!it->dead) {
+      it->dead = true;
+      it->error = message;
+    }
+    if (allQuiesced || it->landedMask == it->arrivedMask) {
+      // No channel still writes into this entry: reap now.
+      if (it->ubuf != nullptr) {
+        victims->push_back(it->ubuf);
+      }
+      if (!it->direct) {
+        releaseStageLocked(rank, it->total);
+      }
+      it = stripes_.erase(it);
+    } else {
+      // A sibling channel is mid-payload; the last stripeLanded reaps.
+      ++it;
     }
   }
 }
@@ -519,8 +1086,8 @@ void Context::stashArrived(int srcRank, uint64_t slot,
         rxPaused_[srcRank] = 1;
         // Under mu_: the flag and the pair's epoll state must change
         // atomically with respect to postRecv's resume path (ctx -> pair
-        // lock order, same as close()).
-        pairs_[srcRank]->pauseReading();
+        // lock order, same as close()). Covers every data channel.
+        pausePeerLocked(srcRank);
         if (metrics_ != nullptr) {
           metrics_->recordStashPause(srcRank);
         }
@@ -581,9 +1148,34 @@ void Context::reportStall(UnboundBuffer* buf, bool isSend,
           break;
         }
       }
+      if (stall.peer < 0) {
+        for (auto& cps : channelPairs_) {
+          uint64_t slot = 0;
+          for (auto& cp : cps) {
+            if (cp->sendSlotFor(buf, &slot)) {
+              stall.peer = cp->peerRank();
+              stall.slot = slot;
+              break;
+            }
+          }
+          if (stall.peer >= 0) {
+            break;
+          }
+        }
+      }
     } else {
+      // A receive claimed by stripe reassembly left posted_ at its
+      // first stripe; the watchdog's blame must keep naming the
+      // peer/slot it is stuck on.
+      for (const auto& e : stripes_) {
+        if (e.ubuf == buf) {
+          stall.peer = e.srcRank;
+          stall.slot = e.slot;
+          break;
+        }
+      }
       for (const auto& pr : posted_) {
-        if (pr.ubuf != buf) {
+        if (stall.peer >= 0 || pr.ubuf != buf) {
           continue;
         }
         stall.slot = pr.slot;
@@ -627,10 +1219,15 @@ void Context::debugDump() {
          "KB" + (rxPaused_[r] ? "*PAUSED" : "") + " ";
   }
   s += "} stashedCount=" + std::to_string(stashed_.size());
+  s += " stripes=" + std::to_string(stripes_.size());
   s += " pairs={";
   for (int r = 0; r < size_; r++) {
     if (pairs_[r]) {
       s += std::to_string(r) + ":[" + pairs_[r]->debugState() + "] ";
+      for (size_t c = 0; c < channelPairs_[r].size(); c++) {
+        s += std::to_string(r) + ".ch" + std::to_string(c + 1) + ":[" +
+             channelPairs_[r][c]->debugState() + "] ";
+      }
     }
   }
   s += "}";
@@ -638,7 +1235,7 @@ void Context::debugDump() {
 }
 
 void Context::onPairError(int rank, const std::string& message,
-                          bool orderly) {
+                          bool orderly, int channel) {
   if (metrics_ != nullptr && !orderly) {
     // Failure evidence for recovery tooling: even when the watchdog
     // never fired (a SIGKILL'd peer surfaces via EOF in milliseconds),
@@ -654,6 +1251,12 @@ void Context::onPairError(int rank, const std::string& message,
     if (pairErrors_[rank].empty()) {
       pairErrors_[rank] = message;
     }
+    // A failed channel strands any reassembly waiting on its stripes;
+    // the logical pair is keyed by rank, so one channel's death poisons
+    // the peer for sends (pairErrors_) and fails claimed receives here
+    // (deferred while a sibling channel is still mid-payload).
+    dropStripesLocked(rank, message, channel, /*allQuiesced=*/false,
+                      &victims);
     for (auto it = posted_.begin(); it != posted_.end();) {
       bool anyLive = false;
       if (it->allowed[rank]) {

@@ -9,6 +9,7 @@
 // arrival claims it. Self-sends short-circuit through the same matcher.
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <deque>
@@ -18,6 +19,8 @@
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+#include "tpucoll/transport/wire.h"
 
 #include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/logging.h"
@@ -40,6 +43,17 @@ class Context {
   int rank() const { return rank_; }
   int size() const { return size_; }
   Device* device() const { return device_.get(); }
+
+  // ---- multi-channel striping configuration ----
+  // Effective knobs resolve as: TPUCOLL_CHANNELS / TPUCOLL_STRIPE_BYTES
+  // env (strict parse, common/env.h) > setChannelConfig (the tuning
+  // plane's hook) > defaults (1 channel -- the seed's wire behavior --
+  // and 1 MiB). Must be called before the mesh is created; channel
+  // count must agree across ranks (the bootstrap blob carries it and
+  // connect fails loudly on a mismatch).
+  void setChannelConfig(int channels, uint64_t stripeBytes);
+  int channels() const { return channels_; }
+  uint64_t stripeThresholdBytes() const { return stripeBytes_; }
 
   // Store-based bootstrap: publish one blob per rank (address + per-peer
   // pair routing ids — O(n) store traffic per rank, O(n^2) total), then
@@ -124,12 +138,39 @@ class Context {
   // receives to close the race with a recv posted mid-payload.
   void stashArrived(int srcRank, uint64_t slot, std::vector<char> data);
 
+  // ---- stripe reassembly (multi-channel receive path) ----
+  // Loop thread of any channel pair, on a fresh kStripe header: claim
+  // (or join) the reassembly entry for the message this stripe belongs
+  // to, and return where the stripe's payload lands. The first stripe
+  // of a message claims a posted receive exactly like matchIncoming
+  // (and allocates a reassembly buffer when none is posted or when the
+  // receive is a fused recvReduce, whose fold must wait for the whole
+  // message). Throws on size mismatch or protocol violations (the pair
+  // poisons itself).
+  struct StripeMatch {
+    char* dest;      // stripe payload destination (already offset)
+    uint64_t entry;  // reassembly entry handle for stripeLanded
+  };
+  StripeMatch stripeIncoming(int srcRank, uint64_t slot, uint8_t seqLow,
+                             uint64_t total, uint32_t count,
+                             uint32_t index);
+  // Loop thread, when a stripe's payload has fully (and, on encrypted
+  // channels, verified) landed. Completes the logical message when it
+  // was the last stripe: direct receives complete their buffer (folding
+  // the stage for recvReduce), unmatched messages enter the stash via
+  // the normal stashArrived race-closing path.
+  void stripeLanded(int srcRank, uint64_t entry, uint32_t index);
+
   // A pair failed: poison posted receives that could match it and record the
   // error for future sends. `orderly` marks a goodbye-announced departure
   // (still poisons, but is not blamed in the metrics transport-failure
-  // record — clean shutdown skew is not a death).
+  // record — clean shutdown skew is not a death). `channel` is the data
+  // channel of the failing connection (-1 = unknown): by the time a
+  // pair's teardown notifies, its own rx is quiesced (fd del'd with the
+  // loop barrier), so that channel's half-read stripe — if any — can be
+  // safely abandoned while sibling channels may still be mid-payload.
   void onPairError(int rank, const std::string& message,
-                   bool orderly = false);
+                   bool orderly = false, int channel = -1);
   void debugDump();
 
   // Shared-memory payload-plane stats summed over pairs: ring bytes sent /
@@ -194,15 +235,90 @@ class Context {
   std::list<PostedRecv>::iterator findPosted(int srcRank, uint64_t slot,
                                              size_t nbytes);
 
+  // Striped fan-out behind postSend/postPut (channels_ > 1, payload at
+  // or above the stripe threshold, shm inactive for the peer).
+  void postSendStriped(UnboundBuffer* buf, int dstRank, uint64_t slot,
+                       char* data, size_t nbytes);
+  void postPutStriped(UnboundBuffer* buf, int dstRank, uint64_t token,
+                      uint64_t roffset, char* data, size_t nbytes);
+  // Channel c of the logical pair to `rank` (c == 0: the primary pair).
+  Pair* pairFor(int rank, int c) {
+    return c == 0 ? pairs_[rank].get() : channelPairs_[rank][c - 1].get();
+  }
+  // Stash backpressure across every channel of a peer (mu_ held).
+  void pausePeerLocked(int rank);
+  void resumePeerLocked(int rank);
+  // Backpressure for IN-FLIGHT reassembly stages (mu_ held): unmatched
+  // striped messages allocate their full `total` before completion, so
+  // under channel skew a fast channel can open stages far ahead of a
+  // laggard. Crossing the stash high watermark pauses only the channels
+  // that are "ahead" — fully landed on every open entry from the source
+  // — so no open entry's completion is ever blocked and the stage bytes
+  // are guaranteed to keep draining (release below resumes them at the
+  // low watermark).
+  void accountStageLocked(int srcRank, size_t bytes);
+  void maybePauseAheadChannelsLocked(int srcRank);
+  void releaseStageLocked(int srcRank, size_t bytes);
+  // Poison in-flight reassemblies from `rank` (pair failure / close):
+  // entries with no stripe mid-payload are reaped immediately (their
+  // claimed buffers appended to `victims` for the caller to fail
+  // OUTSIDE mu_); entries a sibling channel is still writing into are
+  // marked dead and reaped by the last stripeLanded. `channel` >= 0
+  // abandons that (quiesced) channel's own half-read stripe;
+  // `allQuiesced` (close(): every pair already torn down) force-reaps
+  // everything. mu_ held.
+  void dropStripesLocked(int rank, const std::string& message, int channel,
+                         bool allQuiesced,
+                         std::vector<UnboundBuffer*>* victims);
+
+  // One in-flight striped message's reassembly state (mu_). Lifetime
+  // rule: an entry (and so `buf`, which channel loop threads write into
+  // WITHOUT mu_ between stripeIncoming and stripeLanded) may only be
+  // freed once every arrived stripe has landed or its channel's rx is
+  // provably quiesced — a peer failure therefore marks entries `dead`
+  // and defers the reap to the last in-flight stripe instead of
+  // freeing memory under a sibling channel's read.
+  struct StripeEntry {
+    uint64_t id;
+    int srcRank;
+    uint64_t slot;
+    uint8_t seqLow;
+    uint64_t total;
+    uint32_t count;
+    uint32_t arrivedMask{0};  // stripes whose header was matched
+    uint32_t landedMask{0};   // stripes whose payload fully landed
+    bool direct{false};       // claimed a posted recv at creation
+    bool dead{false};         // source rank failed; reap when quiescent
+    std::string error;        // failure message for the deferred ubuf error
+    UnboundBuffer* ubuf{nullptr};
+    char* dest{nullptr};            // posted destination (direct)
+    RecvReduceFn combine{nullptr};  // non-null: fold buf into dest at end
+    size_t combineElsize{0};
+    std::vector<char> buf;  // stash payload, or recvReduce stage
+  };
+
   const std::shared_ptr<Device> device_;
   const int rank_;
   const int size_;
+  int channels_{1};
+  uint64_t stripeBytes_{uint64_t(1) << 20};
+  bool channelsFromEnv_{false};
+  bool stripeBytesFromEnv_{false};
+  // Tags all stripes of one logical message (low byte travels in the
+  // header flags) so back-to-back same-slot messages reassemble
+  // unambiguously.
+  std::atomic<uint64_t> stripeSeq_{0};
   Tracer* tracer_{nullptr};
   Metrics* metrics_{nullptr};
   FlightRecorder* flightrec_{nullptr};
 
   std::mutex mu_;
   std::vector<std::unique_ptr<Pair>> pairs_;
+  // channelPairs_[rank] holds channels 1..channels_-1 to that peer
+  // (channel 0 is pairs_[rank]); empty when channels_ == 1.
+  std::vector<std::vector<std::unique_ptr<Pair>>> channelPairs_;
+  std::list<StripeEntry> stripes_;  // in-flight reassemblies (mu_)
+  uint64_t nextStripeEntry_{1};
   std::list<PostedRecv> posted_;
   std::deque<Stash> stashed_;
   std::vector<std::string> pairErrors_;
@@ -212,6 +328,11 @@ class Context {
   // bypass the stash, so progress is always possible.
   std::vector<size_t> stashBytes_;
   std::vector<char> rxPaused_;
+  // In-flight unmatched reassembly stages per source (mu_); see
+  // accountStageLocked. stripePausedMask_ names channels paused by that
+  // mechanism (bit c = channel c), cleared by any full-peer resume.
+  std::vector<size_t> stripeStageBytes_;
+  std::vector<uint32_t> stripePausedMask_;
   size_t stashHighWater_;
   bool closed_{false};
 

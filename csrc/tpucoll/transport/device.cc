@@ -1,14 +1,37 @@
 #include "tpucoll/transport/device.h"
 
+#include "tpucoll/common/env.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/sysinfo.h"
 
 namespace tpucoll {
 namespace transport {
 
+namespace {
+
+// Loop-pool size: attr wins, else TPUCOLL_LOOP_THREADS (strict parse,
+// common/env.h), else 1 — the seed's single-thread data plane. Capped
+// well below any sane host so a typo cannot spawn hundreds of threads.
+constexpr long kMaxLoops = 64;
+
+int resolveNumLoops(int attrLoops) {
+  if (attrLoops > 0) {
+    TC_ENFORCE(attrLoops <= kMaxLoops, "DeviceAttr.numLoops must be <= ",
+               kMaxLoops, ", got ", attrLoops);
+    return attrLoops;
+  }
+  return static_cast<int>(envCount("TPUCOLL_LOOP_THREADS", 1, 1, kMaxLoops));
+}
+
+}  // namespace
+
 Device::Device(const DeviceAttr& attr)
-    : loop_(makeLoop(attr.busyPoll, attr.engine)), authKey_(attr.authKey),
-      encrypt_(attr.encrypt) {
+    : authKey_(attr.authKey), encrypt_(attr.encrypt) {
+  const int numLoops = resolveNumLoops(attr.numLoops);
+  loops_.reserve(numLoops);
+  for (int i = 0; i < numLoops; i++) {
+    loops_.push_back(makeLoop(attr.busyPoll, attr.engine));
+  }
   if (!attr.keyring.empty()) {
     TC_ENFORCE(authKey_.empty(),
                "auth_key and keyring are mutually exclusive tiers");
@@ -24,7 +47,9 @@ Device::Device(const DeviceAttr& attr)
                " has no usable address");
   }
   SockAddr bindAddr = resolve(host, attr.port);
-  listener_ = std::make_unique<Listener>(loop_.get(), bindAddr, authKey_,
+  // The listener stays on loop 0 regardless of pool size: accepts and
+  // handshakes are rare, and a fixed home keeps routing simple.
+  listener_ = std::make_unique<Listener>(loops_[0].get(), bindAddr, authKey_,
                                          keyring_, encrypt_);
 }
 
@@ -44,7 +69,10 @@ std::string Device::str() const {
     s += ")";
   }
   s += " [";
-  s += loop_->engineName();
+  s += loops_[0]->engineName();
+  if (loops_.size() > 1) {
+    s += " x" + std::to_string(loops_.size());
+  }
   s += "]";
   return s;
 }

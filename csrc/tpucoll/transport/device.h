@@ -9,6 +9,7 @@
 #include <cstdint>
 #include <memory>
 #include <string>
+#include <vector>
 
 #include "tpucoll/common/keyring.h"
 #include "tpucoll/transport/address.h"
@@ -46,24 +47,47 @@ struct DeviceAttr {
   // Event engine: "epoll" | "uring" | "auto" | "" ("" = TPUCOLL_ENGINE env
   // if set, else auto). See loop.h / loop_uring.h.
   std::string engine;
+  // Event-loop thread pool size. 0 = TPUCOLL_LOOP_THREADS env (strict
+  // parse) if set, else 1 — the seed's single-thread behavior. The
+  // listener always lives on loop 0; pairs (and their extra data
+  // channels) are sharded round-robin across the pool so TCP stack
+  // work, stash memcpys, and per-connection encryption spread over
+  // cores instead of single-streaming on one.
+  int numLoops{0};
 };
 
 class Device {
  public:
   explicit Device(const DeviceAttr& attr);
 
-  Loop* loop() { return loop_.get(); }
+  // Loop 0: the listener's loop and the legacy single-loop accessor.
+  Loop* loop() { return loops_[0].get(); }
+  // Round-robin shard for pair/channel `key` (stable for a given key).
+  Loop* loopFor(uint64_t key) { return loops_[key % loops_.size()].get(); }
+  int loopIndexFor(uint64_t key) const {
+    return static_cast<int>(key % loops_.size());
+  }
+  int numLoops() const { return static_cast<int>(loops_.size()); }
+  // Quiesce every loop in the pool (teardown barriers must cover all
+  // loops once pairs shard across them).
+  void barrierAllLoops() {
+    for (auto& l : loops_) {
+      l->barrier();
+    }
+  }
   Listener* listener() { return listener_.get(); }
   const SockAddr& address() const { return listener_->address(); }
   uint64_t nextPairId() { return pairId_.fetch_add(1); }
   const std::string& authKey() const { return authKey_; }
   const Keyring& keyring() const { return keyring_; }
   bool encrypt() const { return encrypt_; }
-  bool busyPoll() const { return loop_->busyPoll(); }
+  bool busyPoll() const { return loops_[0]->busyPoll(); }
   std::string str() const;
 
  private:
-  std::unique_ptr<Loop> loop_;  // declared first: destroyed last
+  // Declared first: destroyed last. loops_[0] hosts the listener; the
+  // rest are the data-channel shards.
+  std::vector<std::unique_ptr<Loop>> loops_;
   // Declared before listener_: the listener holds references to the
   // key material, so it must be destroyed first (reverse declaration
   // order) and constructed after.

@@ -63,13 +63,63 @@ bool sameHostFd(int fd) {
 }  // namespace
 
 Pair::Pair(Context* context, Loop* loop, int selfRank, int peerRank,
-           uint64_t localPairId)
+           uint64_t localPairId, int channel, int loopIndex)
     : context_(context),
       loop_(loop),
       selfRank_(selfRank),
       peerRank_(peerRank),
       localPairId_(localPairId),
+      channel_(channel),
+      loopIndex_(loopIndex),
       dataPath_(loop->hasDataPath()) {}
+
+// Striped-send completion routing (see pair.h StripeTx): a plain op
+// completes its buffer directly; a stripe op only records its outcome,
+// and the LAST stripe to resolve delivers the single logical
+// completion/error. Deferring the error to the last resolution is
+// load-bearing: it keeps the buffer's pending-send count nonzero while
+// any sibling stripe still transmits from the buffer's memory, so
+// ~UnboundBuffer cannot free bytes a loop thread is reading.
+void Pair::finalizeStripe(const TxDone& d) {
+  if (d.ubuf == nullptr) {
+    return;
+  }
+  if (d.stripe->failed.load(std::memory_order_acquire)) {
+    std::string msg;
+    {
+      std::lock_guard<std::mutex> guard(d.stripe->errMu);
+      msg = d.stripe->error;
+    }
+    d.ubuf->onSendError(msg);
+  } else {
+    d.ubuf->onSendComplete();
+  }
+}
+
+void Pair::deliverSendComplete(const TxDone& d) {
+  if (d.stripe == nullptr) {
+    if (d.ubuf != nullptr) {
+      d.ubuf->onSendComplete();
+    }
+    return;
+  }
+  if (d.stripe->remaining.fetch_sub(1) == 1) {
+    finalizeStripe(d);
+  }
+}
+
+void Pair::deliverSendError(const TxDone& d, const std::string& msg) {
+  if (d.stripe == nullptr) {
+    if (d.ubuf != nullptr) {
+      d.ubuf->onSendError(msg);
+    }
+    return;
+  }
+  d.stripe->recordError(msg);
+  if (d.stripe->remaining.fetch_sub(1) == 1) {
+    finalizeStripe(d);
+  }
+}
 
 Pair::~Pair() {
   close();
@@ -293,7 +343,10 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
   const bool encrypt = context_->device()->encrypt();
   const Keyring& keyring = context_->device()->keyring();
   const bool ringTier = keyring.valid();
-  const bool offerShm = shmEnabled() && sameHostFd(fd);
+  // Extra data channels never negotiate shm: the shm plane lives on the
+  // primary connection, and a pair whose payloads ride the shm ring
+  // bypasses striping entirely.
+  const bool offerShm = channel_ == 0 && shmEnabled() && sameHostFd(fd);
   const uint32_t magic =
       ringTier ? (encrypt ? kHelloRingEncMagic : kHelloRingMagic)
       : authKey.empty() ? kHelloMagic
@@ -530,7 +583,7 @@ void Pair::sendFaulted(UnboundBuffer* ubuf, uint64_t slot,
                        const char* data, size_t nbytes) {
   fault::TxDecision fd = fault::onTxMessage(
       selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kData), slot,
-      nbytes, context_->metrics(), context_->tracer());
+      nbytes, context_->metrics(), context_->tracer(), channel_);
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;
@@ -554,10 +607,53 @@ void Pair::sendFaulted(UnboundBuffer* ubuf, uint64_t slot,
   }
 }
 
-void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
-                   const char* data, size_t nbytes, bool notify) {
+// One stripe of a striped logical message. The header is fully
+// self-describing (wire.h kStripe): the receiver reassembles from
+// (slot, seqLow, total, count, index) alone, so sender and receiver
+// need no out-of-band channel agreement beyond the connection count.
+void Pair::sendStripe(UnboundBuffer* ubuf, uint64_t slot, const char* data,
+                      size_t nbytes, uint64_t total, uint8_t count,
+                      uint8_t seqLow, std::shared_ptr<StripeTx> st) {
+  TxOp op;
+  op.header = WireHeader{kMsgMagic, static_cast<uint8_t>(Opcode::kStripe),
+                         seqLow,
+                         {static_cast<uint8_t>(channel_), count},
+                         slot, nbytes, total};
+  op.ubuf = ubuf;
+  op.data = data;
+  op.nbytes = nbytes;
+  op.stripe = std::move(st);
   if (__builtin_expect(fault::armed(), 0)) {
-    sendPutFaulted(ubuf, token, roffset, data, nbytes, notify);
+    // Stripes match fault rules as DATA traffic (the opcode schedules
+    // name), with per-(rule, rank, channel) state keeping each
+    // channel's firing sequence deterministic. `dup` is counted in the
+    // report but not materialized: a duplicated stripe would violate
+    // reassembly's exactly-once-per-(message, channel) contract
+    // (docs/faults.md).
+    fault::TxDecision fd = fault::onTxMessage(
+        selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kData), slot,
+        nbytes, context_->metrics(), context_->tracer(), channel_);
+    if (!applyTxFault(fd, &op)) {
+      TC_THROW(IoException, "send to rank ", peerRank_, ": ",
+               fault::killMessage(peerRank_));
+    }
+    enqueue(std::move(op));
+    if (fd.truncate) {
+      // finishTxFault is deliberately not used here: its dup arm would
+      // materialize a second stripe; only the post-flush sever applies.
+      fail(fault::truncateMessage(peerRank_));
+    }
+    return;
+  }
+  enqueue(std::move(op));
+}
+
+void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
+                   const char* data, size_t nbytes, bool notify,
+                   std::shared_ptr<StripeTx> st) {
+  if (__builtin_expect(fault::armed(), 0)) {
+    sendPutFaulted(ubuf, token, roffset, data, nbytes, notify,
+                   std::move(st));
     return;
   }
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
@@ -572,15 +668,17 @@ void Pair::sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
   op.data = data;
   op.nbytes = nbytes;
   op.viaShm = viaShm;
+  op.stripe = std::move(st);
   enqueue(std::move(op));
 }
 
 void Pair::sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
                           uint64_t roffset, const char* data,
-                          size_t nbytes, bool notify) {
+                          size_t nbytes, bool notify,
+                          std::shared_ptr<StripeTx> st) {
   fault::TxDecision fd = fault::onTxMessage(
       selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kPut), token,
-      nbytes, context_->metrics(), context_->tracer());
+      nbytes, context_->metrics(), context_->tracer(), channel_);
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;
@@ -593,6 +691,7 @@ void Pair::sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
   op.data = data;
   op.nbytes = nbytes;
   op.viaShm = viaShm;
+  op.stripe = std::move(st);
   if (!applyTxFault(fd, &op)) {
     TC_THROW(IoException, "put to rank ", peerRank_, ": ",
              fault::killMessage(peerRank_));
@@ -628,20 +727,29 @@ void Pair::sendOwned(WireHeader header, std::vector<char> payload) {
   enqueue(std::move(op));
 }
 
-void Pair::touchProgress() {
+void Pair::touchProgress(bool tx, size_t bytes) {
   if (Metrics* m = context_->metrics()) {
-    m->touchProgress(peerRank_, Tracer::nowUs());
+    const int64_t now = Tracer::nowUs();
+    m->touchProgress(peerRank_, now);
+    m->touchLoop(loopIndex_, now);
+    if (tx) {
+      m->recordChannelTx(channel_, bytes);
+    } else {
+      m->recordChannelRx(channel_, bytes);
+    }
   }
   if (FlightRecorder* fr = context_->flightrec()) {
-    // Every payload/header byte moving through a pair funnels here: the
-    // flight recorder's enqueued -> started transition for the op in
-    // flight (one relaxed store, and only on the first progress).
+    // Every payload/header byte moving through a pair funnels here —
+    // including each stripe of a striped message on its own channel
+    // pair — so the flight recorder's enqueued -> started transition
+    // fires on the first progress of ANY stripe (one relaxed store,
+    // and only on the first progress).
     fr->markTransportProgress();
   }
 }
 
 void Pair::enqueue(TxOp op) {
-  std::vector<UnboundBuffer*> completed;
+  std::vector<TxDone> completed;
   std::string txError;
   const size_t nbytes = op.nbytes;
   {
@@ -675,10 +783,8 @@ void Pair::enqueue(TxOp op) {
   if (Metrics* m = context_->metrics()) {
     m->recordSent(peerRank_, nbytes);
   }
-  for (auto* b : completed) {
-    if (b != nullptr) {
-      b->onSendComplete();
-    }
+  for (auto& d : completed) {
+    deliverSendComplete(d);
   }
   if (!txError.empty()) {
     fail(txError);
@@ -686,27 +792,37 @@ void Pair::enqueue(TxOp op) {
 }
 
 int Pair::cancelQueuedSends(UnboundBuffer* ubuf) {
-  std::lock_guard<std::mutex> guard(mu_);
-  int removed = 0;
+  int removed = 0;      // LOGICAL sends released (pendingSend units)
+  int removedWire = 0;  // wire messages dropped (metrics units)
   uint64_t removedBytes = 0;
-  for (auto it = tx_.begin(); it != tx_.end();) {
-    // txInFlight_: a submitted SQE references the front op's memory even
-    // before any byte is confirmed — it must not be freed under the
-    // kernel.
-    const bool started =
-        it == tx_.begin() &&
-        (it->headerSent > 0 || it->headerSealed || txInFlight_);
-    if (it->ubuf == ubuf && !started) {
-      removedBytes += it->nbytes;
-      it = tx_.erase(it);
-      removed++;
-    } else {
-      ++it;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (auto it = tx_.begin(); it != tx_.end();) {
+      // txInFlight_: a submitted SQE references the front op's memory
+      // even before any byte is confirmed — it must not be freed under
+      // the kernel.
+      const bool started =
+          it == tx_.begin() &&
+          (it->headerSent > 0 || it->headerSealed || txInFlight_);
+      // Stripe ops are NEVER cancelled: a sibling stripe on another
+      // channel pair may already be on the wire, and removing this one
+      // would ship a partial message the receiver's reassembly waits on
+      // forever. They resolve through wire completion or through
+      // failPairsWithInflightSend failing this pair (hasInflightSend
+      // sees the queued op), whose teardown errors the shared state.
+      if (it->ubuf == ubuf && !started && it->stripe == nullptr) {
+        removedBytes += it->nbytes;
+        removedWire++;
+        removed++;
+        it = tx_.erase(it);
+      } else {
+        ++it;
+      }
     }
   }
-  if (removed > 0) {
+  if (removedWire > 0) {
     if (Metrics* m = context_->metrics()) {
-      m->uncountSent(peerRank_, removed, removedBytes);
+      m->uncountSent(peerRank_, removedWire, removedBytes);
     }
   }
   return removed;
@@ -784,7 +900,7 @@ bool Pair::flushCtrl() {
 }
 
 Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
-                                      std::vector<UnboundBuffer*>* completed) {
+                                      std::vector<TxDone>* completed) {
   // Sends a small header's bytes; returns kDone / kSocketFull / kError.
   auto pushBytes = [&](TxSite site, const char* base, size_t len,
                        size_t* sent) -> ShmTxStatus {
@@ -844,7 +960,7 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
       op->chunkInFlight = false;
     }
     if (op->shmAnnounced == op->nbytes) {
-      completed->push_back(op->ubuf);
+      completed->push_back(TxDone{op->ubuf, op->stripe});
       tx_.pop_front();  // op is dangling from here
       return ShmTxStatus::kDone;
     }
@@ -884,7 +1000,7 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
   }
 }
 
-void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
+void Pair::flushTx(std::vector<TxDone>* completed) {
   if (fd_ < 0) {
     return;
   }
@@ -915,7 +1031,7 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
         } else if (op.sealOffset < op.nbytes) {
           sealPayloadFrame(&op);
         } else {
-          completed->push_back(op.ubuf);
+          completed->push_back(TxDone{op.ubuf, op.stripe});
           tx_.pop_front();
           continue;
         }
@@ -933,7 +1049,7 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
       op.cipherSent += static_cast<size_t>(n);
       if (op.cipherSent == op.cipher.size() && op.headerSealed &&
           op.sealOffset == op.nbytes) {
-        completed->push_back(op.ubuf);
+        completed->push_back(TxDone{op.ubuf, op.stripe});
         tx_.pop_front();
       }
       continue;
@@ -969,7 +1085,7 @@ void Pair::flushTx(std::vector<UnboundBuffer*>* completed) {
     adv -= take;
     op.dataSent += adv;
     if (op.headerSent == sizeof(WireHeader) && op.dataSent == op.nbytes) {
-      completed->push_back(op.ubuf);
+      completed->push_back(TxDone{op.ubuf, op.stripe});
       tx_.pop_front();
     }
   }
@@ -1015,7 +1131,7 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
         continue;
       }
       if (n > 0) {
-        touchProgress();
+        touchProgress(/*tx=*/true, static_cast<size_t>(n));
       }
       return n;
     }
@@ -1036,7 +1152,7 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
 
 void Pair::txAdvanceInFlight(size_t n) {
   if (n > 0) {
-    touchProgress();
+    touchProgress(/*tx=*/true, n);
   }
   switch (txSite_) {
     case TxSite::kCtrl:
@@ -1096,7 +1212,7 @@ void Pair::handleEvents(uint32_t events) {
     return;
   }
   if (events & EPOLLOUT) {
-    std::vector<UnboundBuffer*> completed;
+    std::vector<TxDone> completed;
     std::string txError;
     {
       std::lock_guard<std::mutex> guard(mu_);
@@ -1108,10 +1224,8 @@ void Pair::handleEvents(uint32_t events) {
       pendingTxError_.clear();
     }
     cv_.notify_all();  // close() may be waiting for the tx queue to drain
-    for (auto* b : completed) {
-      if (b != nullptr) {
-        b->onSendComplete();
-      }
+    for (auto& d : completed) {
+      deliverSendComplete(d);
     }
     if (!txError.empty()) {
       fail(txError);
@@ -1170,7 +1284,7 @@ void Pair::onRxEof() {
 
 Pair::RxStep Pair::processRxBytes(size_t n, size_t* consumed) {
   if (n > 0) {
-    touchProgress();
+    touchProgress(/*tx=*/false, n);
   }
   if (!rxInPayload_) {
     const bool enc = keys_.encrypted;
@@ -1257,7 +1371,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
       rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCreditReq)) {
     const bool isGrant =
         rxHeader_.opcode == static_cast<uint8_t>(Opcode::kShmCredit);
-    std::vector<UnboundBuffer*> completed;
+    std::vector<TxDone> completed;
     std::string txError;
     {
       std::lock_guard<std::mutex> guard(mu_);
@@ -1277,10 +1391,8 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
       pendingTxError_.clear();
     }
     cv_.notify_all();
-    for (auto* b : completed) {
-      if (b != nullptr) {
-        b->onSendComplete();
-      }
+    for (auto& d : completed) {
+      deliverSendComplete(d);
     }
     if (!txError.empty()) {
       fail(txError);
@@ -1413,12 +1525,12 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     }
     shmRxDone_ += chunk;
     shmRxBytes_.fetch_add(chunk, std::memory_order_relaxed);
-    touchProgress();
+    touchProgress(/*tx=*/false, chunk);
     *consumed += chunk;
     // Eager credit after draining a big chunk: the sender throttles on
     // ring space, and this lets it refill while we keep consuming.
     if (chunk * 8 >= shmRx_.cap) {
-      std::vector<UnboundBuffer*> completed;
+      std::vector<TxDone> completed;
       std::string txError;
       {
         std::lock_guard<std::mutex> guard(mu_);
@@ -1431,10 +1543,8 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
         pendingTxError_.clear();
       }
       cv_.notify_all();  // close() may be waiting on tx_ draining
-      for (auto* b : completed) {
-        if (b != nullptr) {
-          b->onSendComplete();
-        }
+      for (auto& d : completed) {
+        deliverSendComplete(d);
       }
       if (!txError.empty()) {
         fail(txError);
@@ -1488,6 +1598,40 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
       }
     }
     rxHeaderRead_ = 0;
+    return RxStep::kMore;
+  }
+  if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kStripe)) {
+    // One contiguous stripe of a striped logical message: the context
+    // hands back where this channel's share lands (user memory at the
+    // stripe offset, or a reassembly/stage buffer) and an entry handle
+    // the completion reports into. The span re-derivation doubles as
+    // the protocol check — a header whose nbytes disagrees with the
+    // deterministic split is a violation, not a different layout.
+    const uint32_t count = rxHeader_.reserved[1];
+    const uint32_t index = rxHeader_.reserved[0];
+    const uint64_t total = rxHeader_.aux;
+    if (count < 2 || count > kMaxStripeChannels || index >= count ||
+        total < count ||
+        rxHeader_.nbytes != stripeSpan(total, count, index)) {
+      fail(detail::strCat("malformed stripe header from rank ", peerRank_));
+      return RxStep::kStop;
+    }
+    Context::StripeMatch sm;
+    try {
+      sm = context_->stripeIncoming(peerRank_, rxHeader_.slot,
+                                    rxHeader_.flags, total, count, index);
+    } catch (const std::exception& e) {
+      fail(detail::strCat("receive matching failed: ", e.what()));
+      return RxStep::kStop;
+    }
+    rxInPayload_ = true;
+    rxPayloadRead_ = 0;
+    rxPlainDone_ = 0;
+    rxMode_ = RxMode::kStripe;
+    rxCombine_ = nullptr;
+    rxFoldInline_ = false;
+    rxDest_ = sm.dest;
+    rxStripeEntry_ = sm.entry;
     return RxStep::kMore;
   }
   if (rxHeader_.opcode == static_cast<uint8_t>(Opcode::kPut)) {
@@ -1674,7 +1818,7 @@ void Pair::handleIoComplete(bool isRecv, int32_t res) {
   // Send completion: apply the confirmed byte count to the in-flight
   // site's cursors, then resume the flush — the submission-mode mirror
   // of handleEvents' EPOLLOUT arm.
-  std::vector<UnboundBuffer*> completed;
+  std::vector<TxDone> completed;
   std::string txError;
   {
     std::lock_guard<std::mutex> guard(mu_);
@@ -1698,10 +1842,8 @@ void Pair::handleIoComplete(bool isRecv, int32_t res) {
     pendingTxError_.clear();
   }
   cv_.notify_all();  // close() may be waiting for the tx queue to drain
-  for (auto* b : completed) {
-    if (b != nullptr) {
-      b->onSendComplete();
-    }
+  for (auto& d : completed) {
+    deliverSendComplete(d);
   }
   if (!txError.empty()) {
     fail(txError);
@@ -1851,6 +1993,16 @@ void Pair::finishMessage() {
       }
       rxStashData_ = std::vector<char>();
       break;
+    case RxMode::kStripe:
+      try {
+        context_->stripeLanded(peerRank_, rxStripeEntry_,
+                               rxHeader_.reserved[0]);
+      } catch (const std::exception& e) {
+        fail(detail::strCat("receive matching failed: ", e.what()));
+        return;
+      }
+      rxStripeEntry_ = 0;
+      break;
     case RxMode::kGetReq: {
       WireGetReq req;
       std::memcpy(&req, rxStashData_.data(), sizeof(req));
@@ -1930,7 +2082,7 @@ void Pair::close() {
   // queue and lose delivered-but-unread payloads) when ranks reach teardown
   // at different times.
   static constexpr std::chrono::milliseconds kGrace{2000};
-  std::vector<UnboundBuffer*> completed;
+  std::vector<TxDone> completed;
   {
     std::unique_lock<std::mutex> lock(mu_);
     if (state_.load() == State::kConnected && !closing_) {
@@ -1958,17 +2110,15 @@ void Pair::close() {
       });
     }
   }
-  for (auto* b : completed) {
-    if (b != nullptr) {
-      b->onSendComplete();
-    }
+  for (auto& d : completed) {
+    deliverSendComplete(d);
   }
   teardown(State::kClosed, "pair closed", /*notifyContext=*/false);
 }
 
 void Pair::teardown(State target, const std::string& message,
                     bool notifyContext) {
-  std::vector<UnboundBuffer*> sends;
+  std::vector<TxDone> sends;
   UnboundBuffer* rxb = nullptr;
   int fd = -1;
   {
@@ -1998,7 +2148,7 @@ void Pair::teardown(State target, const std::string& message,
   {
     std::lock_guard<std::mutex> guard(mu_);
     for (auto& op : tx_) {
-      sends.push_back(op.ubuf);
+      sends.push_back(TxDone{op.ubuf, op.stripe});
     }
     tx_.clear();
     txInFlight_ = false;
@@ -2009,17 +2159,15 @@ void Pair::teardown(State target, const std::string& message,
     rxb = rxUbuf_;
     rxUbuf_ = nullptr;
   }
-  for (auto* b : sends) {
-    if (b != nullptr) {
-      b->onSendError(message);
-    }
+  for (auto& d : sends) {
+    deliverSendError(d, message);
   }
   if (rxb != nullptr) {
     rxb->onRecvError(message);
   }
   if (notifyContext) {
     context_->onPairError(peerRank_, message,
-                          /*orderly=*/target == State::kClosed);
+                          /*orderly=*/target == State::kClosed, channel_);
   }
 }
 

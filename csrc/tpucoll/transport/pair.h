@@ -12,6 +12,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -29,6 +30,34 @@ namespace transport {
 class Context;
 class Listener;
 
+// Shared completion state for one striped logical send (TPUCOLL_CHANNELS
+// > 1). The logical operation resolves EXACTLY ONCE, when the LAST
+// stripe resolves (wire-completed or errored) — never earlier: an early
+// onSendError would zero the buffer's pending-send count while sibling
+// stripes on other channel pairs are still transmitting from its
+// memory, letting ~UnboundBuffer free bytes a loop thread is reading
+// (use-after-free). The last resolver delivers onSendError when ANY
+// stripe failed (first recorded message wins) and onSendComplete
+// otherwise; striped sends are never cancelled (cancelQueuedSends skips
+// them — a sibling may already be on the wire, and shipping a partial
+// message would hang the receiver's reassembly). Stripes live on
+// different Pair objects, so the state is atomics + one cold-path mutex.
+struct StripeTx {
+  explicit StripeTx(int n) : remaining(n) {}
+  std::atomic<int> remaining;  // unresolved stripes
+  std::atomic<bool> failed{false};
+  std::mutex errMu;
+  std::string error;  // first failure message (errMu)
+
+  void recordError(const std::string& msg) {
+    std::lock_guard<std::mutex> guard(errMu);
+    if (!failed.load(std::memory_order_relaxed)) {
+      error = msg;
+      failed.store(true, std::memory_order_relaxed);
+    }
+  }
+};
+
 class Pair : public Handler {
  public:
   enum class State : int {
@@ -38,12 +67,19 @@ class Pair : public Handler {
     kClosed = 4,
   };
 
+  // `channel` is this connection's data-channel index within the logical
+  // pair (0 = the primary connection, which alone carries control
+  // traffic, sub-threshold messages, and the shm plane; >= 1 = an extra
+  // stripe lane with its own handshake/encryption state, ideally on its
+  // own loop). `loopIndex` names `loop` within the device pool for the
+  // per-loop progress metrics.
   Pair(Context* context, Loop* loop, int selfRank, int peerRank,
-       uint64_t localPairId);
+       uint64_t localPairId, int channel = 0, int loopIndex = 0);
   ~Pair() override;
 
   uint64_t localPairId() const { return localPairId_; }
   int peerRank() const { return peerRank_; }
+  int channel() const { return channel_; }
 
   // Initiator path (blocking, user thread): TCP connect to the peer's
   // listener and write the hello routing this connection to `remotePairId`.
@@ -65,11 +101,23 @@ class Pair : public Handler {
   void send(UnboundBuffer* ubuf, uint64_t slot, const char* data,
             size_t nbytes);
 
+  // One stripe of a striped logical message (wire.h kStripe): this
+  // channel's contiguous [data, data+nbytes) share of a `total`-byte
+  // message split over `count` channels. `st` is the shared completion
+  // state; `seqLow` tags all stripes of one message (reassembly
+  // disambiguation). Only transport::Context calls this, once per
+  // channel, in channel order.
+  void sendStripe(UnboundBuffer* ubuf, uint64_t slot, const char* data,
+                  size_t nbytes, uint64_t total, uint8_t count,
+                  uint8_t seqLow, std::shared_ptr<StripeTx> st);
+
   // One-sided write into the peer's registered region (kPut framing).
   // notify: the target's exporting buffer gets a waitRecv completion on
-  // arrival (bound-buffer semantics).
+  // arrival (bound-buffer semantics). `st` carries the shared completion
+  // state when the put is one stripe of a striped logical put.
   void sendPut(UnboundBuffer* ubuf, uint64_t token, uint64_t roffset,
-               const char* data, size_t nbytes, bool notify = false);
+               const char* data, size_t nbytes, bool notify = false,
+               std::shared_ptr<StripeTx> st = nullptr);
 
   // Enqueue a message whose payload the op itself owns (get requests and
   // get responses): no completion callback, safe from any thread.
@@ -131,6 +179,9 @@ class Pair : public Handler {
     const char* data;
     size_t nbytes;
     size_t dataSent{0};
+    // Striped logical send: completion routes through the shared state
+    // (last stripe in wins) instead of completing ubuf directly.
+    std::shared_ptr<StripeTx> stripe;
     // Encrypted framing: one sealed frame at a time (header frame, then
     // payload frames of kEncFrameBytes), built lazily when the op FIRST
     // starts transmitting so cancelled queued sends never consume a tx
@@ -158,6 +209,17 @@ class Pair : public Handler {
 
   // Outcome of trying to advance the front shm op (mu_ held).
   enum class ShmTxStatus { kDone, kSocketFull, kRingBlocked, kError };
+
+  // A finished tx op's completion routing: direct (ubuf) or through the
+  // striped-send shared state. Built under mu_, delivered without it.
+  struct TxDone {
+    UnboundBuffer* ubuf;
+    std::shared_ptr<StripeTx> stripe;
+  };
+  static void deliverSendComplete(const TxDone& d);
+  static void deliverSendError(const TxDone& d, const std::string& msg);
+  // Last-resolution outcome delivery for a striped send (see StripeTx).
+  static void finalizeStripe(const TxDone& d);
 
   // Which tx cursor an in-flight data-path send advances on completion.
   // Each socket-write site in the flush functions is one site; the
@@ -202,10 +264,10 @@ class Pair : public Handler {
 
   // Write queued ops until EAGAIN or empty; requires mu_ held. Completed
   // ops' buffers are appended to `completed` (callbacks run without mu_).
-  void flushTx(std::vector<UnboundBuffer*>* completed);
+  void flushTx(std::vector<TxDone>* completed);
   // Advance the front (shm) op: announce header, ring writes, chunk
   // headers, credit requests. mu_ held.
-  ShmTxStatus flushShmFront(TxOp* op, std::vector<UnboundBuffer*>* completed);
+  ShmTxStatus flushShmFront(TxOp* op, std::vector<TxDone>* completed);
   // Drain the control channel (credits/credit requests), which preempts
   // the data stream only at wire-message boundaries. Returns false when
   // the socket is full or an error was recorded. mu_ held.
@@ -221,7 +283,7 @@ class Pair : public Handler {
                    size_t nbytes);
   void sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
                       uint64_t roffset, const char* data, size_t nbytes,
-                      bool notify);
+                      bool notify, std::shared_ptr<StripeTx> st);
   // Mutate the op per the fired decision (corrupt/truncate), or veto
   // the enqueue entirely (kill — the pair is already failed when this
   // returns false).
@@ -253,6 +315,8 @@ class Pair : public Handler {
   const int selfRank_;
   const int peerRank_;
   const uint64_t localPairId_;
+  const int channel_;    // data-channel index within the logical pair
+  const int loopIndex_;  // loop_'s index in the device pool (metrics)
   // Engine-selected I/O mode: submission data path (uring) vs readiness
   // + direct syscalls (epoll). Fixed at construction.
   const bool dataPath_;
@@ -309,7 +373,7 @@ class Pair : public Handler {
 
 
   // rx state, loop thread only
-  enum class RxMode { kDirect, kStash, kPut, kGetReq };
+  enum class RxMode { kDirect, kStash, kPut, kGetReq, kStripe };
   WireHeader rxHeader_{};
   size_t rxHeaderRead_{0};
   bool rxInPayload_{false};
@@ -373,10 +437,15 @@ class Pair : public Handler {
   // for wire element i is shmRxDest_ + i * shmRxCombineAccElsize_.
   void combineShmSpan(uint64_t msgOff, const char* src, size_t len);
 
-  // Stamp this pair's last-progress timestamp in the metrics registry
-  // (the watchdog's liveness signal). One relaxed store; called wherever
-  // payload or wire bytes actually move.
-  void touchProgress();
+  // Reassembly handle of the stripe currently landing (RxMode::kStripe;
+  // loop thread only) and its channel index echo.
+  uint64_t rxStripeEntry_{0};
+
+  // Stamp this pair's last-progress timestamp (the watchdog's liveness
+  // signal), the per-channel byte counters, and the per-loop progress
+  // stamp in the metrics registry. Called wherever payload or wire bytes
+  // actually move; `tx` picks the byte-counter direction.
+  void touchProgress(bool tx, size_t bytes);
 };
 
 }  // namespace transport

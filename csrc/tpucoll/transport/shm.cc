@@ -8,6 +8,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "tpucoll/common/env.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/common/logging.h"
 
@@ -23,30 +24,26 @@ bool shmEnabled() {
 }
 
 uint64_t shmRingBytesConfig() {
+  // Strict parse (common/env.h): "8MB" or "-1" throws instead of silently
+  // running with a default-sized ring.
   static const uint64_t v = [] {
-    const char* e = std::getenv("TPUCOLL_SHM_RING");
-    long long b = e != nullptr ? std::atoll(e) : 0;
-    if (e == nullptr || b <= 0) {
+    const uint64_t b = envBytes("TPUCOLL_SHM_RING", 0);
+    if (b == 0) {
       return uint64_t(8) << 20;
     }
     // Clamp into the window listeners accept (listener.cc sanity check);
     // an out-of-window value would otherwise create-and-offer a segment
     // every connect only to be rejected into TCP fallback each time.
     const uint64_t lo = 64 << 10, hi = uint64_t(1) << 30;
-    const uint64_t u = static_cast<uint64_t>(b);
-    return u < lo ? lo : u > hi ? hi : u;
+    return b < lo ? lo : b > hi ? hi : b;
   }();
   return v;
 }
 
 uint64_t shmThresholdBytes() {
   static const uint64_t v = [] {
-    const char* e = std::getenv("TPUCOLL_SHM_THRESHOLD");
-    long long b = e != nullptr ? std::atoll(e) : 0;
-    if (b < 1) {
-      b = 32 << 10;
-    }
-    return static_cast<uint64_t>(b);
+    const uint64_t b = envBytes("TPUCOLL_SHM_THRESHOLD", 0);
+    return b >= 1 ? b : uint64_t(32) << 10;
   }();
   return v;
 }

@@ -20,19 +20,34 @@ void setNonBlocking(int fd) {
                 "fcntl(F_SETFL): ", strerror(errno));
 }
 
+// Socket tuning is best-effort (a refused option is not fatal), but a
+// silently un-tuned socket shows up only as mysterious throughput or
+// latency loss — warn so debug output names the failed option.
 void setNoDelay(int fd) {
   int on = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &on, sizeof(on));
+  if (setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &on, sizeof(on)) != 0) {
+    TC_WARN("setsockopt(TCP_NODELAY) failed on fd ", fd, ": ",
+            strerror(errno));
+  }
 }
 
 void setReuseAddr(int fd) {
   int on = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &on, sizeof(on));
+  if (setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &on, sizeof(on)) != 0) {
+    TC_WARN("setsockopt(SO_REUSEADDR) failed on fd ", fd, ": ",
+            strerror(errno));
+  }
 }
 
 void setBufferSizes(int fd, int bytes) {
-  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
-  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
+  if (setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes)) != 0) {
+    TC_WARN("setsockopt(SO_SNDBUF, ", bytes, ") failed on fd ", fd, ": ",
+            strerror(errno));
+  }
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes)) != 0) {
+    TC_WARN("setsockopt(SO_RCVBUF, ", bytes, ") failed on fd ", fd, ": ",
+            strerror(errno));
+  }
 }
 
 std::string errnoString(const char* what) {

@@ -148,7 +148,49 @@ enum class Opcode : uint8_t {
   // message boundaries.
   kShmCreditReq = 8,
   kShmCredit = 9,
+  // ---- multi-channel striping (TPUCOLL_CHANNELS > 1 only) ----
+  // One contiguous stripe of a large kData message, carried on data
+  // channel `reserved[0]` of the logical pair. Striping is fully
+  // self-describing so the receiver needs no out-of-band agreement:
+  //   slot        = the message's slot (as kData)
+  //   nbytes      = THIS stripe's payload bytes (drives rx framing,
+  //                 incl. the encrypted frame walk — and must equal
+  //                 stripeSpan(aux, reserved[1], reserved[0]))
+  //   aux         = TOTAL message bytes (what receive matching uses)
+  //   reserved[0] = stripe/channel index, reserved[1] = stripe count
+  //   flags       = low 8 bits of the sender's per-pair stripe sequence
+  //                 (all stripes of one message carry the same value;
+  //                 disambiguates back-to-back same-slot messages during
+  //                 reassembly)
+  // The split is deterministic — derived from byte counts alone
+  // (stripeSpan/stripeOffset below), never from runtime state — so two
+  // runs stripe identically and the fault plane stays reproducible.
+  // A striped message completes (receive matching, waitRecv, flight-
+  // recorder completion) only when every stripe has landed; transport
+  // progress of ANY stripe counts as the op having started.
+  kStripe = 10,
 };
+
+// Upper bound on data channels per logical pair (TPUCOLL_CHANNELS):
+// stripe count/index travel in one-byte header fields and reassembly
+// tracks arrival in a 32-bit mask, but the practical ceiling is NIC
+// queues x cores, not the encoding.
+constexpr uint32_t kMaxStripeChannels = 8;
+
+// Deterministic contiguous stripe split: stripe `idx` of a `total`-byte
+// message over `count` channels. Balanced to within one byte; every
+// stripe is non-empty whenever total >= count (the stripe threshold is
+// far above any sane channel count).
+inline uint64_t stripeSpan(uint64_t total, uint32_t count, uint32_t idx) {
+  const uint64_t base = total / count;
+  const uint64_t rem = total % count;
+  return base + (idx < rem ? 1 : 0);
+}
+inline uint64_t stripeOffset(uint64_t total, uint32_t count, uint32_t idx) {
+  const uint64_t base = total / count;
+  const uint64_t rem = total % count;
+  return idx * base + (idx < rem ? idx : rem);
+}
 
 // WireHello.reserved bits.
 constexpr uint32_t kHelloFlagShmOffer = 1;  // shm offer follows handshake

@@ -1,5 +1,7 @@
 #include "tpucoll/tuning/tuning_table.h"
 
+#include "tpucoll/transport/wire.h"
+
 #include <cctype>
 #include <cmath>
 #include <cstdint>
@@ -158,7 +160,12 @@ std::string TuningTable::toJson() const {
       out << "}";
     }
   }
-  out << "]}";
+  out << "]";
+  if (transport_.set()) {
+    out << ",\"transport\":{\"channels\":" << transport_.channels
+        << ",\"stripe_bytes\":" << transport_.stripeBytes << "}";
+  }
+  out << "}";
   return out.str();
 }
 
@@ -188,6 +195,25 @@ TuningTable TuningTable::fromJson(const std::string& json) {
         static_cast<int>(requireField(e, "bucket", Kind::kNumber).number);
     m.costUs = requireField(e, "cost_us", Kind::kNumber).number;
     table.add(m);
+  }
+  if (const JsonReader::Value* t = root.field("transport")) {
+    TC_ENFORCE(t->kind == Kind::kObject,
+               "tuning table JSON: \"transport\" must be an object");
+    TransportHints hints;
+    if (const JsonReader::Value* c = t->field("channels")) {
+      TC_ENFORCE(c->kind == Kind::kNumber && c->number >= 1 &&
+                     c->number <= transport::kMaxStripeChannels,
+                 "tuning table JSON: transport.channels must be in [1, ",
+                 transport::kMaxStripeChannels, "]");
+      hints.channels = static_cast<int>(c->number);
+    }
+    if (const JsonReader::Value* b = t->field("stripe_bytes")) {
+      TC_ENFORCE(b->kind == Kind::kNumber && b->number >= 0,
+                 "tuning table JSON: transport.stripe_bytes must be a "
+                 "non-negative number");
+      hints.stripeBytes = static_cast<uint64_t>(b->number);
+    }
+    table.setTransportHints(hints);
   }
   return table;
 }

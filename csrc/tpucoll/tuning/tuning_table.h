@@ -22,6 +22,7 @@
 #pragma once
 
 #include <cstddef>
+#include <cstdint>
 #include <map>
 #include <optional>
 #include <string>
@@ -72,6 +73,25 @@ class TuningTable {
   size_t size() const { return cells_.size(); }
   std::vector<Measurement> measurements() const;
 
+  // ---- transport hints (multi-channel striping knobs) ----
+  // Tuned quantities for the transport plane, carried next to the
+  // algorithm-crossover cells: the per-pair data-channel count and the
+  // stripe threshold (docs/transport.md). 0 = unset. Installed tables
+  // apply these at connect time via transport::Context::
+  // setChannelConfig, unless the TPUCOLL_CHANNELS / TPUCOLL_STRIPE_BYTES
+  // env overrides them. The same rank-agreement property holds: all
+  // ranks install byte-identical JSON, so all ranks derive the same
+  // channel count (which the bootstrap blob additionally enforces).
+  struct TransportHints {
+    int channels{0};
+    uint64_t stripeBytes{0};
+    bool set() const { return channels > 0 || stripeBytes > 0; }
+  };
+  const TransportHints& transportHints() const { return transport_; }
+  void setTransportHints(const TransportHints& hints) {
+    transport_ = hints;
+  }
+
   // JSON round trip. The serialized form is the interchange format:
   // {"version": 1, "entries": [{"collective", "algorithm", "world_size",
   // "dtype", "bucket", "cost_us"}, ...]}, entries sorted by key so equal
@@ -100,6 +120,7 @@ class TuningTable {
   std::optional<double> curveCost(const Curve& curve, double x) const;
 
   std::map<Key, Curve> cells_;
+  TransportHints transport_;
 };
 
 // log2 size bucket of a payload (floor; nbytes 0 maps to bucket 0).

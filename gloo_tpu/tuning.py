@@ -138,3 +138,29 @@ def load_table(path: str) -> dict:
     """Read a table written by save_table() / tc_tune."""
     with open(path) as f:
         return json.load(f)
+
+
+def set_transport_hints(table: TableLike, channels: Optional[int] = None,
+                        stripe_bytes: Optional[int] = None) -> dict:
+    """Attach tuned TRANSPORT knobs to a table: the per-pair data-channel
+    count and the stripe threshold (docs/transport.md). A context that
+    installs the table (or loads it via TPUCOLL_TUNING_FILE) applies
+    them at connect time unless the TPUCOLL_CHANNELS /
+    TPUCOLL_STRIPE_BYTES env overrides them. Pick the values from a
+    ``bench.py --channel-sweep`` run on the target host. Returns the
+    table as a dict. The same every-rank-same-table contract applies:
+    channel counts must agree across ranks or connect fails loudly."""
+    t = json.loads(_to_json_str(table))
+    hints = dict(t.get("transport", {}))
+    if channels is not None:
+        # Ceiling mirrors transport::kMaxStripeChannels (csrc wire.h).
+        if not 1 <= int(channels) <= 8:
+            raise ValueError(f"channels must be in [1, 8], got {channels}")
+        hints["channels"] = int(channels)
+    if stripe_bytes is not None:
+        if int(stripe_bytes) < 0:
+            raise ValueError(f"stripe_bytes must be >= 0, got {stripe_bytes}")
+        hints["stripe_bytes"] = int(stripe_bytes)
+    if hints:
+        t["transport"] = hints
+    return t

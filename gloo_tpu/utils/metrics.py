@@ -136,6 +136,28 @@ def to_prometheus(snapshot: dict,
         _emit_histogram(lines, "gloo_tpu_transport_recv_wait_us",
                         s.get("recv_wait_us", {}), labels)
 
+    # Multi-channel transport: wire bytes per data channel (channel "0"
+    # is the primary connection; >= "1" carry stripes of large messages
+    # when TPUCOLL_CHANNELS > 1) and per-loop-thread progress.
+    lines.append("# TYPE gloo_tpu_channel_tx_bytes_total counter")
+    lines.append("# TYPE gloo_tpu_channel_rx_bytes_total counter")
+    for channel, s in sorted(snapshot.get("channels", {}).items()):
+        labels = {**base, "channel": channel}
+        lines.append(f"gloo_tpu_channel_tx_bytes_total"
+                     f"{_fmt_labels(labels)} {s.get('tx_bytes', 0)}")
+        lines.append(f"gloo_tpu_channel_rx_bytes_total"
+                     f"{_fmt_labels(labels)} {s.get('rx_bytes', 0)}")
+
+    lines.append("# TYPE gloo_tpu_loop_events_total counter")
+    lines.append("# TYPE gloo_tpu_loop_last_progress_age_us gauge")
+    for loop, s in sorted(snapshot.get("loops", {}).items()):
+        labels = {**base, "loop": loop}
+        lines.append(f"gloo_tpu_loop_events_total"
+                     f"{_fmt_labels(labels)} {s.get('events', 0)}")
+        lines.append(f"gloo_tpu_loop_last_progress_age_us"
+                     f"{_fmt_labels(labels)} "
+                     f"{s.get('last_progress_age_us', -1)}")
+
     lines.append("# TYPE gloo_tpu_connect_retries_total counter")
     lines.append(f"gloo_tpu_connect_retries_total{_fmt_labels(base)} "
                  f"{snapshot.get('retries', 0)}")

@@ -747,6 +747,9 @@ def test_allreduce_bf16_wire_fused_matches_staged():
         np.save(sys.argv[1], results[0])
     """).format(repo=repo)
     outs = {}
+    # The cmake-less fallback build never creates build/; the artifact
+    # path must not depend on which build flavor ran.
+    os.makedirs(os.path.join(repo, "build"), exist_ok=True)
     for mode in ("auto", "0"):
         out = os.path.join(repo, "build", f"bf16wire_{mode}.npy")
         env = dict(os.environ, TPUCOLL_RECV_REDUCE=mode)

@@ -98,3 +98,28 @@ def test_bench_autotune_smoke(tmp_path):
     with open(table_path) as f:
         table = json.load(f)
     assert table["version"] == 1 and table["entries"]
+
+
+def test_bench_channel_sweep_smoke():
+    """bench.py --channel-sweep --quick (2 ranks): every grid point must
+    produce a valid JSON measurement line — the data the tuning plane's
+    transport hints (tuning.set_transport_hints) are picked from. Values
+    are not compared: on a shared-core CI host the multi-channel arm can
+    legitimately lose; the sweep's job is producing trustworthy points."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--channel-sweep", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert len(lines) >= 2, proc.stdout
+    seen = set()
+    for line in lines:
+        assert line["metric"] == "channel_sweep"
+        assert line["ok"] is True, line
+        assert line["value"] > 0
+        seen.add((line["loops"], line["channels"], line["stripe_bytes"]))
+    assert (1, 1, 1 << 20) in seen and (2, 2, 1 << 20) in seen
