@@ -38,7 +38,11 @@ SAN_SUFFIX := _asan
 SAN_FLAGS := -fsanitize=address -fno-omit-frame-pointer
 else ifeq ($(SANITIZE),thread)
 SAN_SUFFIX := _tsan
-SAN_FLAGS := -fsanitize=thread -fno-omit-frame-pointer
+# tsan_preinclude.h: gcc-10 libtsan can't see pthread_cond_clockwait,
+# which libstdc++-10 uses for timed condvar waits — without this every
+# such mutex false-positives as "double lock" (GCC PR98624).
+SAN_FLAGS := -fsanitize=thread -fno-omit-frame-pointer \
+	-include csrc/tpucoll/common/tsan_preinclude.h
 else ifneq ($(SANITIZE),)
 $(error SANITIZE must be 'address' or 'thread', got '$(SANITIZE)')
 endif
@@ -67,11 +71,28 @@ FB_FLAGS += -DTPUCOLL_HAVE_AVX512=1
 FB_OBJS += $(FB_BUILD)/tpucoll/common/crypto_avx512.o
 endif
 
+# The cmake build also produces the native test binaries; the fallback
+# builds them too (same objects, one extra link each) so the pytest
+# wrappers in tests/test_native_unit.py run on cmake-less images instead
+# of failing on a missing build/tpucoll_unit. Sanitizer flavors skip
+# them: their pytest entry points are the LD_PRELOAD smokes, not these.
+ifeq ($(SAN_SUFFIX),)
+native-cc: $(FB_LIB) build/tpucoll_unit build/tpucoll_integration
+else
 native-cc: $(FB_LIB)
+endif
 
 $(FB_LIB): $(FB_OBJS)
 	@mkdir -p gloo_tpu/_native
 	$(CXX) -shared $(SAN_FLAGS) -o $@ $(FB_OBJS) -lpthread -lrt
+
+build/tpucoll_unit: $(FB_BUILD)/tests/unit_main.o $(FB_OBJS)
+	@mkdir -p build
+	$(CXX) -o $@ $^ -lpthread -lrt
+
+build/tpucoll_integration: $(FB_BUILD)/tests/integration_main.o $(FB_OBJS)
+	@mkdir -p build
+	$(CXX) -o $@ $^ -lpthread -lrt
 
 $(FB_BUILD)/tpucoll/common/crypto_avx512.o: \
 		csrc/tpucoll/common/crypto_avx512.cc
@@ -82,7 +103,8 @@ $(FB_BUILD)/%.o: csrc/%.cc
 	@mkdir -p $(dir $@)
 	$(CXX) $(FB_FLAGS) -c $< -o $@
 
--include $(FB_OBJS:.o=.d)
+-include $(FB_OBJS:.o=.d) $(FB_BUILD)/tests/unit_main.d \
+	$(FB_BUILD)/tests/integration_main.d
 
 test: native
 	python -m pytest tests/ -x -q

@@ -43,6 +43,21 @@ WARMUP = 3
 ITERS = 15
 RECORDED_REFERENCE_GBPS = 0.620
 
+# --pin: sched_setaffinity each rank's thread/process (and so, by
+# inheritance, its loop and lane threads) to core (rank % cpu_count),
+# cutting scheduler-migration noise out of the ~9% headline spread on
+# this 2-core host. Recorded in every JSON line it affects.
+PIN_RANKS = False
+
+
+def _maybe_pin(rank):
+    if not PIN_RANKS:
+        return
+    ncpu = os.cpu_count() or 1
+    # pid 0 = the calling thread on Linux; threads spawned afterwards
+    # (event loops, async lanes) inherit the mask.
+    os.sched_setaffinity(0, {rank % ncpu})
+
 
 def bench_ours(metrics_out=None):
     import numpy as np
@@ -53,6 +68,7 @@ def bench_ours(metrics_out=None):
     samples = [None, None]
 
     def worker(rank):
+        _maybe_pin(rank)
         device = gloo_tpu.Device()
         ctx = gloo_tpu.Context(rank, 2, timeout=120)
         ctx.connect_full_mesh(store, device)
@@ -532,7 +548,170 @@ def bench_channel_sweep(quick=False):
         sys.exit(1)
 
 
+def bench_grad_bucket(n_tensors, lanes=2, pin=False):
+    """--grad-bucket N: the training-shaped workload — N heterogeneous
+    gradient tensors with log-normally distributed sizes, allreduced
+    per step either sequentially (one blocking allreduce per tensor,
+    the pre-async baseline) or through the async engine + gradient
+    bucketer (docs/async.md: per-dtype ~TPUCOLL_BUCKET_BYTES flat
+    buckets, issued async so bucket k+1's pack overlaps bucket k's wire
+    time). Two real rank processes over a FileStore; per mode the step
+    time is the median of 5 timed steps after a warm-up step; three
+    size-distribution seeds; ONE JSON line:
+
+      {"metric": "grad_bucket_allreduce_2rank_host",
+       "value": <geomean over seeds of seq_ms / bucketed_ms>,
+       "unit": "x_speedup_vs_sequential", "tensors": N, "lanes": L,
+       "bucket_bytes": B, "pinned": bool,
+       "cells": [{"seed", "total_mb", "seq_ms", "bucketed_ms",
+                  "speedup"}, ...]}
+
+    Every step's results are verified against the closed form on both
+    ranks before anything is timed.
+    """
+    import math
+    import textwrap
+
+    from gloo_tpu.bucketer import DEFAULT_BUCKET_BYTES
+
+    bucket_bytes = int(os.environ.get("TPUCOLL_BUCKET_BYTES",
+                                      DEFAULT_BUCKET_BYTES))
+    body = textwrap.dedent("""
+        import os, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1]); store_path = sys.argv[2]
+        n = int(sys.argv[3]); seed = int(sys.argv[4])
+        lanes = int(sys.argv[5]); pin = int(sys.argv[6])
+        if pin:
+            os.sched_setaffinity(0, {{rank % (os.cpu_count() or 1)}})
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(store_path),
+                              gloo_tpu.Device())
+
+        # Log-normal tensor sizes (the shape of a real model's gradient
+        # list: many small, a few large), identical on both ranks.
+        rng = np.random.default_rng(seed)
+        nbytes = np.exp(rng.normal(np.log(64 * 1024), 1.25, size=n))
+        nbytes = np.clip(nbytes, 1024, 8 << 20).astype(np.int64)
+        tensors = [np.empty(max(1, int(b) // 4), dtype=np.float32)
+                   for b in nbytes]
+
+        def refill():
+            for t in tensors:
+                t[:] = rank + 1.0
+
+        def verify():
+            for t in tensors:
+                assert t[0] == 3.0, t[0]
+
+        STEPS = 5
+
+        # Sequential baseline: one blocking allreduce per tensor.
+        refill(); ctx.barrier(tag=1)
+        for t in tensors:
+            ctx.allreduce(t)
+        verify()
+        seq_times = []
+        for _ in range(STEPS):
+            refill(); ctx.barrier(tag=2)
+            t0 = time.perf_counter()
+            for t in tensors:
+                ctx.allreduce(t)
+            seq_times.append(time.perf_counter() - t0)
+        verify()
+
+        # Bucketed-async: per-dtype flat buckets on the engine lanes.
+        engine = ctx.async_engine(lanes=lanes)
+        bucketer = gloo_tpu.GradientBucketer(engine)
+        refill(); ctx.barrier(tag=3)
+        for t in tensors:
+            bucketer.add(t)
+        bucketer.finish()
+        verify()
+        bkt_times = []
+        for _ in range(STEPS):
+            refill(); ctx.barrier(tag=4)
+            t0 = time.perf_counter()
+            for t in tensors:
+                bucketer.add(t)
+            bucketer.finish()
+            bkt_times.append(time.perf_counter() - t0)
+        verify()
+        if rank == 0:
+            print("SEQ_MS", round(float(np.median(seq_times)) * 1e3, 2),
+                  "BKT_MS", round(float(np.median(bkt_times)) * 1e3, 2),
+                  "TOTAL_MB",
+                  round(float(sum(t.nbytes for t in tensors)) / 2**20, 1))
+        ctx.barrier(tag=5); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    cells = []
+    ok_all = True
+    for seed in (11, 23, 47):
+        store = tempfile.mkdtemp()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", body, str(r), store, str(n_tensors),
+             str(seed), str(lanes), "1" if pin else "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(2)]
+        outs = [p.communicate(timeout=900) for p in procs]
+        if any(p.returncode != 0 for p in procs) or \
+                "SEQ_MS" not in outs[0][0]:
+            ok_all = False
+            cells.append({"seed": seed, "ok": False,
+                          "error": [f"rank {r}: rc={p.returncode} "
+                                    f"err={outs[r][1][-300:]!r}"
+                                    for r, p in enumerate(procs)]})
+            continue
+        fields = outs[0][0].split()
+        seq_ms = float(fields[fields.index("SEQ_MS") + 1])
+        bkt_ms = float(fields[fields.index("BKT_MS") + 1])
+        total_mb = float(fields[fields.index("TOTAL_MB") + 1])
+        cells.append({"seed": seed, "total_mb": total_mb,
+                      "seq_ms": seq_ms, "bucketed_ms": bkt_ms,
+                      "speedup": round(seq_ms / bkt_ms, 3)})
+        print(f"[grad-bucket] seed {seed}: {n_tensors} tensors "
+              f"({total_mb:.1f} MiB) seq {seq_ms:.1f}ms bucketed "
+              f"{bkt_ms:.1f}ms ({seq_ms / bkt_ms:.2f}x)",
+              file=sys.stderr)
+    line = {
+        "metric": "grad_bucket_allreduce_2rank_host",
+        "unit": "x_speedup_vs_sequential",
+        "tensors": n_tensors,
+        "lanes": lanes,
+        "bucket_bytes": bucket_bytes,
+        "pinned": pin,
+        "cells": cells,
+        "ok": ok_all,
+    }
+    good = [c["speedup"] for c in cells if "speedup" in c]
+    if good:
+        line["value"] = round(
+            math.exp(sum(math.log(s) for s in good) / len(good)), 3)
+    print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def main():
+    global PIN_RANKS
+    if "--pin" in sys.argv[1:]:
+        PIN_RANKS = True
+    if "--grad-bucket" in sys.argv[1:]:
+        i = sys.argv.index("--grad-bucket") + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            sys.exit("--grad-bucket requires a tensor count")
+        lanes = 2
+        if "--lanes" in sys.argv[1:]:
+            j = sys.argv.index("--lanes") + 1
+            if j >= len(sys.argv) or sys.argv[j].startswith("--"):
+                sys.exit("--lanes requires a count")
+            lanes = int(sys.argv[j])
+        bench_grad_bucket(int(sys.argv[i]), lanes=lanes, pin=PIN_RANKS)
+        return
     if "--flightrec" in sys.argv[1:]:
         i = sys.argv.index("--flightrec") + 1
         if i >= len(sys.argv) or sys.argv[i].startswith("--"):
@@ -598,6 +777,7 @@ def main():
         "vs_baseline": round(ours / ref, 3),
         "spread": round(spread, 3),
         "runs": [round(r, 3) for r in runs],
+        "pinned": PIN_RANKS,
     }
     if with_metrics and metrics_out:
         from gloo_tpu.utils.metrics import summarize_ops

@@ -312,9 +312,12 @@ void tamperScenario() {
   uint64_t pairIds[2];
   std::memcpy(pairIds, blob.data() + 8 + alen, 16);
   const uint64_t pairId = pairIds[1];  // the victim's pair expecting us
-  // Publish a throwaway rank-1 blob (rank 0 never parses it: it only
-  // unpacks blobs of lower ranks).
-  store->set("tc/rank/1", std::vector<uint8_t>{0});
+  // Publish a throwaway rank-1 blob. Rank 0 never CONNECTS with it (it
+  // only initiates toward lower ranks) but it does parse every peer blob
+  // to validate the channel-count extension, so the throwaway must be
+  // well-formed — the victim's own blob (right rank count, default
+  // channel count) serves.
+  store->set("tc/rank/1", blob);
 
   int fd = socket(addr.sa()->sa_family, SOCK_STREAM, 0);
   CHECK(fd >= 0);
@@ -367,7 +370,7 @@ void tamperScenario() {
   auto sendSealed = [&](uint64_t slot, const std::vector<char>& payload,
                         bool flipByte) {
     transport::WireHeader hdr{transport::kMsgMagic, 1 /* kData */,
-                              0, {0, 0}, slot, payload.size()};
+                              0, {0, 0}, slot, payload.size(), 0};
     std::vector<uint8_t> frame(sizeof(hdr) + kAeadTagBytes +
                                payload.size() + kAeadTagBytes);
     aeadSeal(keys.tx, seq++, nullptr, 0,
@@ -458,9 +461,9 @@ void retryScenario() {
   try {
     ctx.connectFullMesh(store, device);
   } catch (const IoException&) {
+    // Covers TimeoutException too: the deadline can expire inside an
+    // attempt's handshake.
     threw = true;
-  } catch (const TimeoutException&) {
-    threw = true;  // deadline can expire inside an attempt's handshake
   }
   CHECK(threw);
   CHECK(retryRecords.load() >= 2);  // ~700ms / 50ms backoff: plenty

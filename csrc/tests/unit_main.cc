@@ -93,6 +93,90 @@ void testReduceKernels() {
   CHECK(ia[0] == 3 && ia[1] == -2 && ia[2] == 9);
 }
 
+// min/max/product on the 16-bit float paths: the AVX2 vector body and
+// the scalar tail must agree with the scalar widen-op-narrow reference
+// on every lane — including negatives, +-0 ties (std::min/max keep the
+// accumulator operand), product lanes that need round-to-nearest-even,
+// and NaN lanes (which must stay NaN; payload bits are not contractual).
+void testHalfMinMaxProdKernels() {
+  using tpucoll::bfloat16ToFloat;
+  using tpucoll::DataType;
+  using tpucoll::floatToBfloat16;
+  using tpucoll::floatToHalf;
+  using tpucoll::getReduceFn;
+  using tpucoll::halfToFloat;
+  using tpucoll::ReduceOp;
+  const size_t n = 41;  // 5 vector blocks + a scalar tail
+  std::vector<float> af(n), bf(n);
+  for (size_t i = 0; i < n; i++) {
+    af[i] = (static_cast<float>(i) - 20.0f) * 0.375f;
+    bf[i] = (20.0f - static_cast<float>(i)) * 0.4375f;
+  }
+  af[3] = 0.0f;
+  bf[3] = -0.0f;  // signed-zero tie in a vector lane
+  af[7] = NAN;    // NaN acc lane (vector)
+  bf[11] = NAN;   // NaN input lane (vector)
+  af[40] = NAN;   // NaN in the scalar tail
+  // Product pair whose f32 result is not bf16/f16 representable, so the
+  // narrowing must round (1.2109375 * 1.2109375 = 1.46636...).
+  af[13] = 1.2109375f;
+  bf[13] = 1.2109375f;
+  struct Case {
+    ReduceOp op;
+    float (*ref)(float, float);
+  };
+  const Case cases[] = {
+      {ReduceOp::kMin, [](float x, float y) { return std::min(x, y); }},
+      {ReduceOp::kMax, [](float x, float y) { return std::max(x, y); }},
+      {ReduceOp::kProduct, [](float x, float y) { return x * y; }},
+  };
+  for (const Case& c : cases) {
+    // float16
+    std::vector<uint16_t> ha(n), hb(n);
+    for (size_t i = 0; i < n; i++) {
+      ha[i] = floatToHalf(af[i]);
+      hb[i] = floatToHalf(bf[i]);
+    }
+    std::vector<uint16_t> href = ha;
+    for (size_t i = 0; i < n; i++) {
+      href[i] = floatToHalf(
+          c.ref(halfToFloat(href[i]), halfToFloat(hb[i])));
+    }
+    getReduceFn(DataType::kFloat16, c.op)(ha.data(), hb.data(), n);
+    for (size_t i = 0; i < n; i++) {
+      if (std::isnan(halfToFloat(href[i]))) {
+        CHECK(std::isnan(halfToFloat(ha[i])));
+      } else {
+        CHECK(ha[i] == href[i]);
+      }
+    }
+    // bfloat16
+    std::vector<uint16_t> ba(n), bb(n);
+    for (size_t i = 0; i < n; i++) {
+      ba[i] = floatToBfloat16(af[i]);
+      bb[i] = floatToBfloat16(bf[i]);
+    }
+    std::vector<uint16_t> bref = ba;
+    for (size_t i = 0; i < n; i++) {
+      bref[i] = floatToBfloat16(
+          c.ref(bfloat16ToFloat(bref[i]), bfloat16ToFloat(bb[i])));
+    }
+    getReduceFn(DataType::kBFloat16, c.op)(ba.data(), bb.data(), n);
+    for (size_t i = 0; i < n; i++) {
+      if (std::isnan(bfloat16ToFloat(bref[i]))) {
+        CHECK(std::isnan(bfloat16ToFloat(ba[i])));
+      } else {
+        CHECK(ba[i] == bref[i]);
+      }
+    }
+  }
+  // The signed-zero tie keeps the accumulator operand, exactly as
+  // std::min/std::max do (min(+0, -0) == +0, max(+0, -0) == +0).
+  std::vector<uint16_t> za{floatToHalf(0.0f)}, zb{floatToHalf(-0.0f)};
+  getReduceFn(DataType::kFloat16, ReduceOp::kMin)(za.data(), zb.data(), 1);
+  CHECK(za[0] == floatToHalf(0.0f));
+}
+
 void testBf16NanLanes() {
   using tpucoll::bfloat16ToFloat;
   using tpucoll::DataType;
@@ -351,6 +435,7 @@ int main() {
   testSlot();
   testHalfConversions();
   testReduceKernels();
+  testHalfMinMaxProdKernels();
   testBf16NanLanes();
   testHmacVectors();
   testCryptoVectors();

@@ -1,0 +1,359 @@
+#include "tpucoll/async/engine.h"
+
+#include <sstream>
+#include <utility>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace async {
+
+namespace {
+
+std::string describeOp(const char* opName, int lane, uint64_t seq) {
+  std::ostringstream os;
+  os << opName << " (async seq " << seq << ", lane " << lane << ")";
+  return os.str();
+}
+
+// Rethrow the in-flight exception with the lane/op named, preserving the
+// type (Timeout < Io, Aborted, Enforce) so the C API keeps mapping it to
+// the right Python exception.
+[[noreturn]] void rethrowAugmented(const char* opName, int lane,
+                                   uint64_t seq) {
+  const std::string who = describeOp(opName, lane, seq);
+  try {
+    throw;
+  } catch (const TimeoutException& e) {
+    throw TimeoutException(who + ": " + e.what());
+  } catch (const AbortedException& e) {
+    throw AbortedException(who + ": " + e.what());
+  } catch (const IoException& e) {
+    throw IoException(who + ": " + e.what());
+  } catch (const EnforceError& e) {
+    throw EnforceError(who + ": " + e.what());
+  } catch (const std::exception& e) {
+    throw IoException(who + ": " + e.what());
+  } catch (...) {
+    throw IoException(who + ": unknown error");
+  }
+}
+
+}  // namespace
+
+// ---- Work -----------------------------------------------------------------
+
+void Work::wait(std::chrono::milliseconds timeout) {
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    const bool completed = cv_.wait_for(lk, timeout, [&] {
+      Status s = status_.load(std::memory_order_acquire);
+      return s == Status::kDone || s == Status::kError;
+    });
+    if (!completed) {
+      TC_THROW(TimeoutException, "tc_work_wait: ",
+               describeOp(opName_, lane_, seq_), " still in flight after ",
+               timeout.count(),
+               "ms (the op is NOT cancelled by a wait timeout)");
+    }
+  }
+  if (status_.load(std::memory_order_acquire) == Status::kError) {
+    std::rethrow_exception(error_);
+  }
+}
+
+std::string Work::errorMessage() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return errorMessage_;
+}
+
+void Work::finish(std::exception_ptr err) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (err != nullptr) {
+      error_ = err;
+      try {
+        std::rethrow_exception(err);
+      } catch (const std::exception& e) {
+        errorMessage_ = e.what();
+      } catch (...) {
+        errorMessage_ = "unknown error";
+      }
+      status_.store(Status::kError, std::memory_order_release);
+    } else {
+      status_.store(Status::kDone, std::memory_order_release);
+    }
+  }
+  cv_.notify_all();
+}
+
+// ---- Engine ---------------------------------------------------------------
+
+Engine::Engine(Context* parent, const EngineOptions& opts)
+    : parent_(parent) {
+  TC_ENFORCE(parent != nullptr, "async engine: null parent context");
+  TC_ENFORCE(opts.lanes >= 1 && opts.lanes <= 16,
+             "async engine: lanes must be in [1, 16], got ", opts.lanes);
+  lanes_.reserve(opts.lanes);
+  for (int k = 0; k < opts.lanes; k++) {
+    auto lane = std::make_unique<Lane>();
+    lane->ctx = std::make_unique<Context>(parent->rank(), parent->size());
+    lane->ctx->setTimeout(parent->getTimeout());
+    // Lane identity for the post-mortem planes, set BEFORE the fork so
+    // even bootstrap-time faults/dumps carry it: the fault table keys
+    // its deterministic per-rule state by this domain, and the flight
+    // recorder's automatic dumps go to flightrec-rank<r>-lane<k>.json so
+    // they never clobber the parent's dump.
+    lane->ctx->setFaultDomain(k + 1);
+    lane->ctx->flightrec().setDumpTag(k);
+    // Two bootstrap tags per fork (allgather + allgatherv); stride 2.
+    lane->ctx->forkFrom(*parent, opts.tagBase + 2 * k);
+    lanes_.push_back(std::move(lane));
+  }
+  for (size_t k = 0; k < lanes_.size(); k++) {
+    Lane* lane = lanes_[k].get();
+    lane->thread = std::thread(
+        [this, lane, k] { laneMain(lane, static_cast<int>(k)); });
+  }
+}
+
+Engine::~Engine() {
+  try {
+    shutdown();
+  } catch (...) {
+    // Destructor must not throw; shutdown already recorded per-work
+    // errors and joined what it could.
+  }
+}
+
+Context* Engine::laneContext(int lane) const {
+  TC_ENFORCE(lane >= 0 && lane < static_cast<int>(lanes_.size()),
+             "async engine: lane ", lane, " out of range");
+  return lanes_[lane]->ctx.get();
+}
+
+std::shared_ptr<Work> Engine::submit(const char* opName,
+                                     std::function<void(Context*)> fn) {
+  if (stopping_.load(std::memory_order_acquire)) {
+    TC_THROW(IoException, "async engine: submit after shutdown");
+  }
+  const uint64_t seq = submitSeq_.fetch_add(1, std::memory_order_relaxed);
+  const int laneIdx = static_cast<int>(seq % lanes_.size());
+  Lane* lane = lanes_[laneIdx].get();
+  std::shared_ptr<Work> w(new Work(opName, laneIdx, seq));
+  w->fn_ = std::move(fn);
+  {
+    std::lock_guard<std::mutex> lk(lane->mu);
+    // Recheck under the lane lock: shutdown drains this queue exactly
+    // once, so a submit racing shutdown must not slip a work in after
+    // the drain (it would never run and never be failed).
+    if (stopping_.load(std::memory_order_acquire)) {
+      TC_THROW(IoException, "async engine: submit after shutdown");
+    }
+    lane->queue.push_back(w);
+    lane->submitted.fetch_add(1, std::memory_order_relaxed);
+  }
+  lane->cv.notify_one();
+  return w;
+}
+
+std::shared_ptr<Work> Engine::allreduce(const void* input, void* output,
+                                        size_t count, DataType dtype,
+                                        ReduceOp op, int algorithm,
+                                        std::chrono::milliseconds timeout) {
+  return submit("allreduce", [=](Context* ctx) {
+    AllreduceOptions opts;
+    opts.context = ctx;
+    opts.timeout = timeout;
+    opts.inputs = {input};
+    opts.outputs = {output};
+    opts.count = count;
+    opts.dtype = dtype;
+    opts.op = op;
+    opts.algorithm = static_cast<AllreduceAlgorithm>(algorithm);
+    tpucoll::allreduce(opts);
+  });
+}
+
+std::shared_ptr<Work> Engine::reduceScatter(
+    const void* input, void* output, std::vector<size_t> recvCounts,
+    DataType dtype, ReduceOp op, int algorithm,
+    std::chrono::milliseconds timeout) {
+  return submit("reduce_scatter",
+                [=, counts = std::move(recvCounts)](Context* ctx) {
+    ReduceScatterOptions opts;
+    opts.context = ctx;
+    opts.timeout = timeout;
+    opts.input = input;
+    opts.output = output;
+    opts.recvCounts = counts;
+    opts.dtype = dtype;
+    opts.op = op;
+    opts.algorithm = static_cast<ReduceScatterAlgorithm>(algorithm);
+    tpucoll::reduceScatter(opts);
+  });
+}
+
+std::shared_ptr<Work> Engine::allgather(const void* input, void* output,
+                                        size_t count, DataType dtype,
+                                        std::chrono::milliseconds timeout) {
+  return submit("allgather", [=](Context* ctx) {
+    AllgatherOptions opts;
+    opts.context = ctx;
+    opts.timeout = timeout;
+    opts.input = input;
+    opts.output = output;
+    opts.count = count;
+    opts.dtype = dtype;
+    tpucoll::allgather(opts);
+  });
+}
+
+void Engine::laneMain(Lane* lane, int laneIdx) {
+  for (;;) {
+    std::shared_ptr<Work> w;
+    bool poisoned = false;
+    std::string poisonMessage;
+    {
+      std::unique_lock<std::mutex> lk(lane->mu);
+      lane->cv.wait(lk, [&] {
+        return stopping_.load(std::memory_order_acquire) ||
+               !lane->queue.empty();
+      });
+      if (lane->queue.empty()) {
+        return;  // stopping, nothing left to run
+      }
+      w = lane->queue.front();
+      lane->queue.pop_front();
+      lane->running = w;
+      poisoned = lane->poisoned;
+      poisonMessage = lane->poisonMessage;
+    }
+    w->status_.store(Work::Status::kRunning, std::memory_order_release);
+    std::exception_ptr err;
+    try {
+      if (poisoned) {
+        TC_THROW(IoException, "not run: lane ", laneIdx,
+                 " poisoned by an earlier failure: ", poisonMessage);
+      }
+      w->fn_(lane->ctx.get());
+    } catch (...) {
+      try {
+        rethrowAugmented(w->opName_, laneIdx, w->seq_);
+      } catch (...) {
+        err = std::current_exception();
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(lane->mu);
+      lane->running = nullptr;
+      if (err != nullptr && !lane->poisoned) {
+        // An Io/Timeout failure poisons the lane context (docs/errors.md);
+        // later ops on this lane must fail fast instead of hanging on a
+        // dead mesh. Argument errors (EnforceError) do not poison.
+        try {
+          std::rethrow_exception(err);
+        } catch (const IoException& e) {
+          lane->poisoned = true;
+          lane->poisonMessage = e.what();
+        } catch (...) {
+        }
+      }
+    }
+    (err == nullptr ? lane->completed : lane->errors)
+        .fetch_add(1, std::memory_order_relaxed);
+    w->fn_ = nullptr;  // release captured state promptly
+    w->finish(err);
+  }
+}
+
+void Engine::shutdown() {
+  std::lock_guard<std::mutex> shutdownGuard(shutdownMu_);
+  if (shutdownDone_) {
+    return;
+  }
+  stopping_.store(true, std::memory_order_release);
+  // Fail everything queued-but-unstarted, loudly and typed.
+  std::vector<std::shared_ptr<Work>> orphans;
+  for (size_t k = 0; k < lanes_.size(); k++) {
+    Lane* lane = lanes_[k].get();
+    std::lock_guard<std::mutex> lk(lane->mu);
+    lane->errors.fetch_add(lane->queue.size(), std::memory_order_relaxed);
+    for (auto& w : lane->queue) {
+      orphans.push_back(w);
+    }
+    lane->queue.clear();
+  }
+  for (auto& w : orphans) {
+    std::exception_ptr err;
+    try {
+      TC_THROW(AbortedException, "async engine shut down with work in "
+               "flight: ", describeOp(w->opName_, w->lane_, w->seq_),
+               " was still queued and never ran");
+    } catch (...) {
+      err = std::current_exception();
+    }
+    w->fn_ = nullptr;
+    w->finish(err);
+  }
+  // Abort whatever is mid-collective: closing the lane context fails its
+  // pending and future transport ops with IoException, which unwinds the
+  // lane thread's blocking collective and lands — lane/op-augmented — in
+  // that Work's error slot.
+  for (auto& lane : lanes_) {
+    try {
+      lane->ctx->close();
+    } catch (...) {
+    }
+  }
+  for (auto& lane : lanes_) {
+    lane->cv.notify_all();
+    if (lane->thread.joinable()) {
+      lane->thread.join();
+    }
+  }
+  shutdownDone_ = true;
+}
+
+std::string Engine::statsJson() const {
+  uint64_t submitted = 0;
+  uint64_t completed = 0;
+  uint64_t errors = 0;
+  std::ostringstream lanesJson;
+  lanesJson << "[";
+  for (size_t k = 0; k < lanes_.size(); k++) {
+    Lane* lane = lanes_[k].get();
+    const uint64_t s = lane->submitted.load(std::memory_order_relaxed);
+    const uint64_t c = lane->completed.load(std::memory_order_relaxed);
+    const uint64_t e = lane->errors.load(std::memory_order_relaxed);
+    size_t depth;
+    bool poisoned;
+    {
+      std::lock_guard<std::mutex> lk(lane->mu);
+      depth = lane->queue.size();
+      poisoned = lane->poisoned;
+    }
+    submitted += s;
+    completed += c;
+    errors += e;
+    lanesJson << (k == 0 ? "" : ",") << "{\"submitted\":" << s
+              << ",\"completed\":" << c << ",\"errors\":" << e
+              << ",\"queue_depth\":" << depth
+              << ",\"poisoned\":" << (poisoned ? "true" : "false") << "}";
+  }
+  lanesJson << "]";
+  // Counter reads are not a consistent snapshot; clamp so a mid-flight
+  // read can never print a wrapped gauge.
+  const uint64_t finished = completed + errors;
+  const uint64_t inFlight = finished < submitted ? submitted - finished : 0;
+  std::ostringstream os;
+  os << "{\"lanes\":" << lanes_.size() << ",\"in_flight\":" << inFlight
+     << ",\"submitted\":" << submitted << ",\"completed\":" << completed
+     << ",\"errors\":" << errors << ",\"per_lane\":" << lanesJson.str()
+     << "}";
+  return os.str();
+}
+
+}  // namespace async
+}  // namespace tpucoll

@@ -1,0 +1,187 @@
+// Async collective engine: a small pool of LANES — worker threads that
+// each own a privately-tagged forked sub-context (Context::forkFrom, the
+// ContextFactory machinery) — executing collectives submitted as Work
+// handles with wait/test semantics, so a caller can issue bucket k+1's
+// pack/copy while bucket k is on the wire (HiCCL-style inter-collective
+// pipelining; GC3's "issue order decoupled from completion order").
+//
+// Isolation contract: concurrent collectives on DIFFERENT lanes can never
+// cross-match slots because each lane's traffic runs on its own transport
+// mesh (own pairs, own slot namespace). Within one lane ops run strictly
+// FIFO on one thread, which is exactly the safety profile of an
+// application loop issuing blocking collectives back-to-back on one tag.
+//
+// Determinism contract: submissions are assigned to lanes round-robin in
+// submission order (submit #i runs on lane i % lanes). Every rank must
+// submit the same collectives in the same order — the ordinary collective
+// matching contract — which then guarantees (a) lane k executes the same
+// op sequence on every rank, so each lane's flight-recorder cseq /
+// fingerprint stream stays cross-rank comparable and the desync detector
+// stays false-positive free, and (b) the fault plane's per-(rule, rank,
+// channel, domain) state sees a deterministic event stream per lane (each
+// lane context carries fault domain = lane + 1).
+//
+// Error contract: an op that fails surfaces its exception — typed, with
+// the lane and op named — at Work::wait()/test(), never on the engine
+// thread. The collective ran in place, so the buffer contents are
+// undefined (docs/errors.md "In-place collectives"); the failing lane is
+// poisoned and every later op already assigned to it fails fast citing
+// the original error. shutdown() (also run by ~Engine and by the owning
+// Python Context's close()) fails queued-but-unstarted work with
+// AbortedException and aborts the in-flight op by closing its lane's
+// context — waiters always unblock, loudly, naming the blamed lane/op.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <exception>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpucoll/context.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+namespace async {
+
+class Engine;
+
+// One submitted collective. Created by Engine::submit; shared between the
+// engine (until execution finishes) and the caller (until freed).
+class Work {
+ public:
+  enum class Status : int {
+    kQueued = 0,
+    kRunning = 1,
+    kDone = 2,
+    kError = 3,
+  };
+
+  // Blocks until the op completes or `timeout` elapses. On completion
+  // with error, rethrows the stored (lane/op-augmented) exception. A
+  // timeout here throws TimeoutException and does NOT cancel the op —
+  // it is still in flight on its lane.
+  void wait(std::chrono::milliseconds timeout);
+
+  // Non-blocking: true once the op reached kDone or kError. Never
+  // throws; the error (if any) surfaces at wait().
+  bool done() const {
+    Status s = status_.load(std::memory_order_acquire);
+    return s == Status::kDone || s == Status::kError;
+  }
+  Status status() const { return status_.load(std::memory_order_acquire); }
+
+  // Error message of a kError op ("" otherwise) — introspection without
+  // rethrow.
+  std::string errorMessage() const;
+
+  const char* opName() const { return opName_; }
+  int lane() const { return lane_; }
+  uint64_t seq() const { return seq_; }
+
+ private:
+  friend class Engine;
+  Work(const char* opName, int lane, uint64_t seq)
+      : opName_(opName), lane_(lane), seq_(seq) {}
+
+  void finish(std::exception_ptr err);
+
+  const char* opName_;  // static string
+  const int lane_;
+  const uint64_t seq_;  // engine-wide submission index
+  std::function<void(Context*)> fn_;
+
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::atomic<Status> status_{Status::kQueued};
+  std::exception_ptr error_;     // set before status_ -> kError
+  std::string errorMessage_;
+};
+
+struct EngineOptions {
+  int lanes = 2;
+  // Base user tag for the lane forks on the parent context; lane k's
+  // fork bootstraps on tags (tagBase + 2k, tagBase + 2k + 1). Must not
+  // collide with collectives running concurrently on the parent.
+  uint32_t tagBase = 0xFFFFD00u;
+};
+
+class Engine {
+ public:
+  // COLLECTIVE: forks `opts.lanes` sub-contexts over `parent`, so every
+  // rank must construct the engine concurrently with the same lane
+  // count and tag base. The parent must outlive the engine.
+  Engine(Context* parent, const EngineOptions& opts);
+  ~Engine();
+
+  Engine(const Engine&) = delete;
+  Engine& operator=(const Engine&) = delete;
+
+  int lanes() const { return static_cast<int>(lanes_.size()); }
+
+  // Async collectives, mirroring the blocking API's semantics; buffers
+  // must stay valid until the returned Work completes. timeout 0 uses
+  // the parent context's default. Custom reduce callbacks are not
+  // supported (they would run on a lane thread; Python trampolines need
+  // the caller's interpreter state).
+  std::shared_ptr<Work> allreduce(const void* input, void* output,
+                                  size_t count, DataType dtype, ReduceOp op,
+                                  int algorithm,
+                                  std::chrono::milliseconds timeout);
+  std::shared_ptr<Work> reduceScatter(const void* input, void* output,
+                                      std::vector<size_t> recvCounts,
+                                      DataType dtype, ReduceOp op,
+                                      int algorithm,
+                                      std::chrono::milliseconds timeout);
+  std::shared_ptr<Work> allgather(const void* input, void* output,
+                                  size_t count, DataType dtype,
+                                  std::chrono::milliseconds timeout);
+
+  // Borrowed lane context (metrics / flight recorder introspection).
+  Context* laneContext(int lane) const;
+
+  // Fail queued work (AbortedException), abort the in-flight op on each
+  // lane by closing its context, join the lane threads. Idempotent;
+  // after shutdown every submit throws.
+  void shutdown();
+
+  // {"lanes", "in_flight", "submitted", "completed", "errors",
+  //  "per_lane": [{"submitted","completed","errors","queue_depth",
+  //  "poisoned"}]}
+  std::string statsJson() const;
+
+ private:
+  struct Lane {
+    std::unique_ptr<Context> ctx;
+    std::thread thread;
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<std::shared_ptr<Work>> queue;  // mu
+    std::shared_ptr<Work> running;            // mu
+    bool poisoned{false};                     // mu; first Io failure
+    std::string poisonMessage;                // mu
+    std::atomic<uint64_t> submitted{0};
+    std::atomic<uint64_t> completed{0};
+    std::atomic<uint64_t> errors{0};
+  };
+
+  std::shared_ptr<Work> submit(const char* opName,
+                               std::function<void(Context*)> fn);
+  void laneMain(Lane* lane, int laneIdx);
+
+  Context* const parent_;
+  std::vector<std::unique_ptr<Lane>> lanes_;
+  std::atomic<uint64_t> submitSeq_{0};
+  std::atomic<bool> stopping_{false};
+  std::mutex shutdownMu_;  // serializes shutdown()
+  bool shutdownDone_{false};
+};
+
+}  // namespace async
+}  // namespace tpucoll

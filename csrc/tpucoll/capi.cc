@@ -13,6 +13,7 @@
 #include <string>
 #include <unordered_map>
 
+#include "tpucoll/async/engine.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
@@ -57,6 +58,9 @@ int wrap(Fn&& fn) {
   } catch (const tpucoll::IoException& e) {
     g_lastError = e.what();
     return TC_ERR_IO;
+  } catch (const tpucoll::AbortedException& e) {
+    g_lastError = e.what();
+    return TC_ERR_ABORTED;
   } catch (const std::exception& e) {
     g_lastError = e.what();
     return TC_ERR;
@@ -152,6 +156,37 @@ uint64_t frPop(void* buf, bool isSend) {
 void frErase(void* buf) {
   std::lock_guard<std::mutex> guard(g_frPendingMu);
   g_frPending.erase(buf);
+}
+
+// ---- async engine plumbing (async/engine.h) ----
+
+tpucoll::async::Engine* asEngine(void* h) {
+  return static_cast<tpucoll::async::Engine*>(h);
+}
+
+using WorkHandle = std::shared_ptr<tpucoll::async::Work>;
+
+WorkHandle* asWork(void* h) { return static_cast<WorkHandle*>(h); }
+
+// Heap-wrap a submitted Work as an opaque handle (NULL + tc_last_error
+// when submission itself failed, e.g. after shutdown).
+template <typename Fn>
+void* submitWork(Fn&& fn) {
+  try {
+    return new WorkHandle(fn());
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+// tc_work_wait timeout resolution: <= 0 means "no deadline"; clamp
+// everything to ~24 days so wait_for's nanosecond conversion can never
+// overflow (an overflowed deadline lands in the past and reads as an
+// instant spurious timeout).
+std::chrono::milliseconds workTimeout(int64_t timeoutMs) {
+  constexpr int64_t kMaxMs = int64_t(1) << 31;
+  return ms(timeoutMs > 0 && timeoutMs < kMaxMs ? timeoutMs : kMaxMs);
 }
 
 }  // namespace
@@ -799,6 +834,117 @@ int tc_allreduce_multi(void* ctx, const void** inputs, void** outputs,
     tpucoll::allreduce(opts);
   });
 }
+
+// ---- async collective engine (async/engine.h) ----
+
+// COLLECTIVE constructor: forks `lanes` privately-tagged sub-contexts
+// over `ctx`, so every rank must call concurrently with the same lane
+// count and tag base (0 = the default base). Returns NULL + tc_last_error
+// on failure.
+void* tc_async_new(void* ctx, int lanes, uint32_t tagBase) {
+  try {
+    tpucoll::async::EngineOptions opts;
+    opts.lanes = lanes;
+    if (tagBase != 0) {
+      opts.tagBase = tagBase;
+    }
+    return new tpucoll::async::Engine(asContext(ctx), opts);
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+// Fail queued work (typed, at wait), abort the in-flight op on every
+// lane, join the lane threads. Idempotent; also run by tc_async_free.
+int tc_async_shutdown(void* eng) {
+  return wrap([&] { asEngine(eng)->shutdown(); });
+}
+
+void tc_async_free(void* eng) { delete asEngine(eng); }
+
+int tc_async_lanes(void* eng) { return asEngine(eng)->lanes(); }
+
+// Borrowed handle to lane `lane`'s forked sub-context, usable with the
+// introspection entry points (tc_metrics_json / tc_flightrec_json /
+// tc_flightrec_dump). Owned by the engine — never tc_context_free it.
+void* tc_async_lane_context(void* eng, int lane) {
+  try {
+    return asEngine(eng)->laneContext(lane);
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  }
+}
+
+// Engine counters: {"lanes","in_flight","submitted","completed",
+// "errors","per_lane":[{"submitted","completed","errors","queue_depth",
+// "poisoned"}]}. malloc'd; free with tc_buf_free.
+int tc_async_stats_json(void* eng, uint8_t** out, size_t* outLen) {
+  return wrap([&] { copyOut(asEngine(eng)->statsJson(), out, outLen); });
+}
+
+// Async collectives: same semantics as the blocking forms, except the
+// call returns a work handle immediately and the collective runs on the
+// engine's deterministically-assigned lane. Buffers must stay valid
+// until the work completes; on error the buffer contents are UNDEFINED
+// (docs/errors.md "In-place collectives" — the undefined window opens at
+// ISSUE time, not at wait). timeoutMs 0 uses the parent context default.
+void* tc_async_allreduce(void* eng, const void* input, void* output,
+                         size_t count, int dtype, int op, int algorithm,
+                         int64_t timeoutMs) {
+  return submitWork([&] {
+    return asEngine(eng)->allreduce(
+        input, output, count, static_cast<DataType>(dtype),
+        static_cast<ReduceOp>(op), algorithm, ms(timeoutMs));
+  });
+}
+
+void* tc_async_reduce_scatter(void* eng, const void* input, void* output,
+                              const size_t* recvCounts, int size,
+                              int dtype, int op, int algorithm,
+                              int64_t timeoutMs) {
+  return submitWork([&] {
+    return asEngine(eng)->reduceScatter(
+        input, output, countsVec(recvCounts, size),
+        static_cast<DataType>(dtype), static_cast<ReduceOp>(op), algorithm,
+        ms(timeoutMs));
+  });
+}
+
+void* tc_async_allgather(void* eng, const void* input, void* output,
+                         size_t count, int dtype, int64_t timeoutMs) {
+  return submitWork([&] {
+    return asEngine(eng)->allgather(input, output, count,
+                                    static_cast<DataType>(dtype),
+                                    ms(timeoutMs));
+  });
+}
+
+// Block until the work completes. Returns TC_OK on success; the op's own
+// (lane/op-augmented) typed failure otherwise — TC_ERR_TIMEOUT both for
+// an op that timed out and for a wait that gave up first (the message
+// distinguishes them; the op is NOT cancelled by a wait timeout).
+// timeoutMs <= 0 waits with no deadline.
+int tc_work_wait(void* work, int64_t timeoutMs) {
+  return wrap([&] { (*asWork(work))->wait(workTimeout(timeoutMs)); });
+}
+
+// Non-blocking status probe: 0 queued, 1 running, 2 completed ok,
+// 3 completed with error (the error itself surfaces at tc_work_wait).
+int tc_work_status(void* work) {
+  return static_cast<int>((*asWork(work))->status());
+}
+
+// Error message of a failed work ("" when none / not finished); malloc'd,
+// free with tc_buf_free.
+int tc_work_error_message(void* work, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    copyOut((*asWork(work))->errorMessage(), out, outLen);
+  });
+}
+
+void tc_work_free(void* work) { delete asWork(work); }
 
 // ---- point-to-point ----
 

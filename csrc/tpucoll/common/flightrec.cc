@@ -80,6 +80,19 @@ std::atomic<bool> g_handlerInstalled{false};
 // the ORIGINAL default disposition, not loop back into this handler.
 std::atomic<bool> g_inHandler{false};
 
+// Automatic dump filename: the plain per-rank name, or the lane-tagged
+// variant for lane recorders (async/engine.h) so same-rank recorders in
+// one process never overwrite each other. snprintf only — shared with
+// the signal path.
+void autoDumpPath(char* path, size_t n, const char* dir, int rank,
+                  int tag) {
+  if (tag >= 0) {
+    snprintf(path, n, "%s/flightrec-rank%d-lane%d.json", dir, rank, tag);
+  } else {
+    snprintf(path, n, "%s/flightrec-rank%d.json", dir, rank);
+  }
+}
+
 void fatalSignalHandler(int sig) {
   if (!g_inHandler.exchange(true) && g_signalDir[0] != '\0') {
     for (int i = 0; i < kMaxRecorders; i++) {
@@ -88,8 +101,8 @@ void fatalSignalHandler(int sig) {
         continue;
       }
       char path[600];
-      snprintf(path, sizeof(path), "%s/flightrec-rank%d.json", g_signalDir,
-               rec->rank());
+      autoDumpPath(path, sizeof(path), g_signalDir, rec->rank(),
+                   rec->dumpTag());
       rec->dumpToFile(path, "signal", -1);
     }
   }
@@ -333,7 +346,8 @@ bool FlightRecorder::autoDump(const char* reason, int blamedPeer) {
   lastReason_.store(reason, std::memory_order_relaxed);
   ::mkdir(dir, 0777);  // best-effort; EEXIST is the common case
   char path[600];
-  snprintf(path, sizeof(path), "%s/flightrec-rank%d.json", dir, rank_);
+  autoDumpPath(path, sizeof(path), dir, rank_,
+               dumpTag_.load(std::memory_order_relaxed));
   return dumpToFile(path, reason, blamedPeer);
 }
 

@@ -182,6 +182,19 @@ class FlightRecorder {
 
   int rank() const { return rank_; }
 
+  // Dump-file tag for processes holding several recorders per rank
+  // (async-engine lane contexts): when set (>= 0), automatic dumps —
+  // stall / transport failure / fatal signal — go to
+  // flightrec-rank<r>-lane<tag>.json instead of the plain per-rank
+  // filename, so a lane's dump never clobbers (or races) the parent
+  // context's. Explicit dumps name their own path and are unaffected.
+  void setDumpTag(int tag) {
+    dumpTag_.store(tag, std::memory_order_relaxed);
+  }
+  int dumpTag() const {
+    return dumpTag_.load(std::memory_order_relaxed);
+  }
+
   static int64_t nowUs();
 
  private:
@@ -197,6 +210,7 @@ class FlightRecorder {
   std::atomic<int64_t> nextCollSeq_{0};
   std::atomic<int64_t> lastAutoDumpUs_{0};
   std::atomic<const char*> lastReason_{nullptr};
+  std::atomic<int> dumpTag_{-1};
   int slotIdx_{-1};  // index into the process-global registry, -1 if full
 };
 

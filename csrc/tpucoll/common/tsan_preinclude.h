@@ -1,0 +1,17 @@
+// ThreadSanitizer flavor workaround (forced into every TU of the
+// SANITIZE=thread build via -include; never included by name).
+//
+// gcc-10's libtsan has no pthread_cond_clockwait interceptor, but on
+// glibc >= 2.30 libstdc++-10 routes condition_variable::wait_for /
+// wait_until<steady_clock> through exactly that call
+// (_GLIBCXX_USE_PTHREAD_COND_CLOCKWAIT), so TSan never sees the
+// unlock/relock happening inside the wait and reports false
+// "double lock of a mutex" on any mutex paired with a timed condvar
+// wait (GCC PR98624). Pull in the config header first, then drop the
+// flag: every timed condvar wait in this flavor compiles down to the
+// intercepted pthread_cond_timedwait path instead. Timed waits ride
+// the realtime clock in this flavor — fine for a test rig, which is
+// all SANITIZE builds are (see Makefile).
+#pragma once
+#include <bits/c++config.h>
+#undef _GLIBCXX_USE_PTHREAD_COND_CLOCKWAIT

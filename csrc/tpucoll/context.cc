@@ -23,7 +23,18 @@ Context::Context(int rank, int size)
   tracer_.setMetrics(&metrics_);
 }
 
-Context::~Context() = default;
+Context::~Context() {
+  // The transport context holds raw pointers into tracer_/metrics_/
+  // flightrec_ (setInstrumentation), and its destructor quiesces the
+  // loop threads that may still be running a failure callback through
+  // them (onPairError on a self-failed pair runs on the loop thread
+  // AFTER the pair went kFailed, so a concurrent close() sails past it
+  // without a barrier). Members destroy in reverse declaration order
+  // and tctx_ is declared FIRST — i.e. it would be destroyed LAST,
+  // after the members those callbacks write — so tear it down
+  // explicitly before any member dies.
+  tctx_.reset();
+}
 
 void Context::connectFullMesh(std::shared_ptr<Store> store,
                               std::shared_ptr<transport::Device> device) {
@@ -42,6 +53,7 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   maybeLoadTuningFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
+  tctx_->setFaultDomain(faultDomain_);
   applyTransportHints();
   tctx_->connectFullMesh(*store_, timeout_);
 }
@@ -58,6 +70,7 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   maybeLoadTuningFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
+  tctx_->setFaultDomain(faultDomain_);
   applyTransportHints();
   auto blob = tctx_->prepareFullMesh();
 

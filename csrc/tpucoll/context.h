@@ -37,6 +37,14 @@ class Context {
   std::chrono::milliseconds getTimeout() const { return timeout_; }
   void setTimeout(std::chrono::milliseconds timeout) { timeout_ = timeout; }
 
+  // Fault-plane identity (fault.h) applied to the transport mesh when it
+  // is created: set BEFORE connectFullMesh/forkFrom so even the
+  // bootstrap traffic (connect_refuse rules, fork-time failures) is
+  // keyed to this domain rather than the parent's. 0 — the default — is
+  // the root domain; async-engine lanes carry lane + 1.
+  void setFaultDomain(int domain) { faultDomain_ = domain; }
+  int faultDomain() const { return faultDomain_; }
+
   // Bootstrap the full mesh over a rendezvous store. Call once.
   void connectFullMesh(std::shared_ptr<Store> store,
                        std::shared_ptr<transport::Device> device);
@@ -141,6 +149,7 @@ class Context {
   const int rank_;
   const int size_;
   std::chrono::milliseconds timeout_{kDefaultTimeout};
+  int faultDomain_{0};
   std::atomic<uint32_t> slotCounter_{0};
   std::atomic<uint64_t> tuneGen_{0};
   mutable std::mutex tuningMu_;

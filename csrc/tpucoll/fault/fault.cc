@@ -6,6 +6,7 @@
 #include <memory>
 #include <mutex>
 #include <sstream>
+#include <tuple>
 #include <utility>
 #include <thread>
 #include <vector>
@@ -92,13 +93,15 @@ struct Rule {
   uint64_t seed{0};        // per-rule seed override (0: schedule seed)
 };
 
-// Per-(rule, rank, channel) mutable state. Keyed by the injecting rank
-// so that several in-process ranks (thread-per-rank tests) each see
-// their own deterministic match/fire/PRNG sequence regardless of thread
-// interleaving between ranks — and by the data channel so a pair whose
-// traffic stripes across channels (TPUCOLL_CHANNELS > 1) keeps one
-// deterministic stream per channel instead of a shared stream whose
-// order would depend on channel interleaving.
+// Per-(rule, rank, channel, domain) mutable state. Keyed by the
+// injecting rank so that several in-process ranks (thread-per-rank
+// tests) each see their own deterministic match/fire/PRNG sequence
+// regardless of thread interleaving between ranks — by the data channel
+// so a pair whose traffic stripes across channels (TPUCOLL_CHANNELS >
+// 1) keeps one deterministic stream per channel — and by the fault
+// domain so a rank running concurrent collectives on several async-
+// engine lanes (each lane a serial stream on its own sub-context) keeps
+// one deterministic stream per lane.
 struct RuleState {
   uint64_t matches{0};
   uint64_t fires{0};
@@ -108,7 +111,7 @@ struct RuleState {
 
 struct Fired {
   int rank;
-  uint64_t n;  // per-rank firing index
+  uint64_t n;  // per-(rank, domain) firing index
   size_t rule;
   Action action;
   int peer;
@@ -116,15 +119,16 @@ struct Fired {
   uint64_t slot;
   uint64_t nbytes;
   int channel;
+  int domain;
 };
 
 struct Table {
   uint64_t seed{0};
   std::vector<Rule> rules;
   // mutable firing state, guarded by g_mu
-  // per rule, per (rank, channel)
-  std::vector<std::map<std::pair<int, int>, RuleState>> state;
-  std::map<int, uint64_t> firesPerRank;
+  // per rule, per (rank, channel, domain)
+  std::vector<std::map<std::tuple<int, int, int>, RuleState>> state;
+  std::map<std::pair<int, int>, uint64_t> firesPerRankDomain;
   std::vector<Fired> fired;
 };
 
@@ -270,7 +274,7 @@ struct Evaluation {
 };
 
 Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
-                          uint64_t nbytes, int channel) {
+                          uint64_t nbytes, int channel, int domain) {
   Evaluation ev;
   Table* t = g_table.get();
   if (t == nullptr) {
@@ -294,7 +298,7 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
         nbytes < r.minBytes || nbytes > r.maxBytes) {
       continue;
     }
-    RuleState& st = t->state[i][{rank, channel}];
+    RuleState& st = t->state[i][std::make_tuple(rank, channel, domain)];
     st.matches++;
     if (st.fires >= r.maxFires) {
       continue;
@@ -307,7 +311,8 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
         st.rng = splitmix64((r.seed != 0 ? r.seed : t->seed) ^
                             splitmix64(i * 0x9E37u + 1) ^
                             splitmix64(static_cast<uint64_t>(rank) + 0x51u) ^
-                            splitmix64(static_cast<uint64_t>(channel) * 0xC11u));
+                            splitmix64(static_cast<uint64_t>(channel) * 0xC11u) ^
+                            splitmix64(static_cast<uint64_t>(domain) * 0xD0A1u));
         st.rngInit = true;
       }
       const double u =
@@ -317,9 +322,9 @@ Evaluation evaluateLocked(int rank, int peer, int opcode, uint64_t slot,
       }
     }
     st.fires++;
-    const uint64_t n = t->firesPerRank[rank]++;
+    const uint64_t n = t->firesPerRankDomain[{rank, domain}]++;
     t->fired.push_back(Fired{rank, n, i, r.action, peer, opcode, slot,
-                             nbytes, channel});
+                             nbytes, channel, domain});
     ev.firedActions.emplace_back(r.action, nbytes);
     switch (r.action) {
       case Action::kDelay:
@@ -450,7 +455,8 @@ std::string report() {
             << actionName(f.action) << "\",\"peer\":" << f.peer
             << ",\"opcode\":\"" << opcodeName(f.opcode)
             << "\",\"slot\":" << f.slot << ",\"nbytes\":" << f.nbytes
-            << ",\"channel\":" << f.channel << "}";
+            << ",\"channel\":" << f.channel
+            << ",\"domain\":" << f.domain << "}";
       }
     }
   }
@@ -475,12 +481,12 @@ void maybeLoadEnvFile() {
 
 TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
                        uint64_t nbytes, Metrics* metrics, Tracer* tracer,
-                       int channel) {
+                       int channel, int domain) {
   Evaluation ev;
   {
     std::lock_guard<std::mutex> guard(g_mu);
     ev = evaluateLocked(rank, peer, static_cast<int>(opcode), slot, nbytes,
-                        channel);
+                        channel, domain);
   }
   accountFired(ev, rank, peer, metrics, tracer);
   if (ev.sleepMs > 0) {
@@ -499,11 +505,13 @@ TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
   return ev.decision;
 }
 
-void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer) {
+void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer,
+               int domain) {
   Evaluation ev;
   {
     std::lock_guard<std::mutex> guard(g_mu);
-    ev = evaluateLocked(rank, peer, kOpConnect, 0, 0, /*channel=*/0);
+    ev = evaluateLocked(rank, peer, kOpConnect, 0, 0, /*channel=*/0,
+                        domain);
   }
   accountFired(ev, rank, peer, metrics, tracer);
   if (ev.sleepMs > 0) {

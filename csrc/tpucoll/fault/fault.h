@@ -88,11 +88,14 @@ void clear();
 
 // The deterministic firing log as a JSON array, in firing order:
 //   [{"rank","n","rule","action","peer","opcode","slot","nbytes",
-//     "channel"}, ...]
-// `n` counts fires per injecting rank, so each rank's subsequence is
-// reproducible even when several in-process ranks interleave. Entries
+//     "channel","domain"}, ...]
+// `n` counts fires per (injecting rank, fault domain), so each serial
+// stream's subsequence is reproducible even when several in-process
+// ranks — or several async lanes of one rank — interleave. Entries
 // carry no timestamps — two runs with the same seed, schedule, and
-// per-rank workload produce byte-identical per-rank sequences.
+// per-rank workload produce byte-identical per-(rank, domain)
+// sequences (sort by (rank, domain, n) to canonicalize a run whose
+// global interleaving differs).
 std::string report();
 
 // Load TPUCOLL_FAULT_FILE once per process (no-op when unset; malformed
@@ -105,16 +108,23 @@ void maybeLoadEnvFile();
 // fault in `metrics` (when non-null) and stamps a span into `tracer`
 // (when enabled); delay/stall sleep here, after the table mutex is
 // released. `channel` is the data channel carrying the message
-// (0 = the pair's primary connection): per-rule match/fire/PRNG state
-// is keyed per (rule, rank, channel) so a pair whose traffic stripes
-// across channels keeps a deterministic firing sequence per channel.
+// (0 = the pair's primary connection) and `domain` the transport
+// context's fault domain (0 = the root context; async-engine lanes use
+// lane + 1): per-rule match/fire/PRNG state is keyed per (rule, rank,
+// channel, domain), so a pair whose traffic stripes across channels —
+// or a rank whose collectives run concurrently on several async lanes —
+// keeps one deterministic firing sequence per serial stream instead of
+// a shared stream whose order would depend on thread interleaving. The
+// report's per-fire index `n` counts per (rank, domain) for the same
+// reason.
 TxDecision onTxMessage(int rank, int peer, uint8_t opcode, uint64_t slot,
                        uint64_t nbytes, Metrics* metrics, Tracer* tracer,
-                       int channel = 0);
+                       int channel = 0, int domain = 0);
 
 // Connect-path evaluation: throws IoException when a connect_refuse
 // rule fires (the pair's retry loop classifies it as retryable).
-void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer);
+void onConnect(int rank, int peer, Metrics* metrics, Tracer* tracer,
+               int domain = 0);
 
 // Message a kill fault poisons the pair with (also what the failed
 // collective surfaces); exposed so tests can match it exactly.

@@ -96,21 +96,39 @@ uint16_t floatToBfloat16(float f) {
 
 namespace {
 
+// applyVec mirrors apply on 8 f32 lanes (instantiated only for the
+// half/bfloat16 widen-reduce-narrow paths, which accumulate in float).
+// Min/max operand order is deliberate: std::min(a, b) returns `a` on a
+// tie OR when either operand is NaN (the comparison is false), while
+// _mm256_min_ps(x, y) returns `y` in those cases — so the vector forms
+// pass (b, a) to keep tie/NaN selection identical to the scalar tail.
 template <typename T>
 struct OpSum {
   static T apply(T a, T b) { return a + b; }
+#ifdef TC_HAVE_VECTOR_HALF
+  static __m256 applyVec(__m256 a, __m256 b) { return _mm256_add_ps(a, b); }
+#endif
 };
 template <typename T>
 struct OpProd {
   static T apply(T a, T b) { return a * b; }
+#ifdef TC_HAVE_VECTOR_HALF
+  static __m256 applyVec(__m256 a, __m256 b) { return _mm256_mul_ps(a, b); }
+#endif
 };
 template <typename T>
 struct OpMin {
   static T apply(T a, T b) { return std::min(a, b); }
+#ifdef TC_HAVE_VECTOR_HALF
+  static __m256 applyVec(__m256 a, __m256 b) { return _mm256_min_ps(b, a); }
+#endif
 };
 template <typename T>
 struct OpMax {
   static T apply(T a, T b) { return std::max(a, b); }
+#ifdef TC_HAVE_VECTOR_HALF
+  static __m256 applyVec(__m256 a, __m256 b) { return _mm256_max_ps(b, a); }
+#endif
 };
 
 template <typename T, template <typename> class Op>
@@ -122,11 +140,13 @@ void reduceTyped(void* acc, const void* in, size_t n) {
   }
 }
 
-// float16/bfloat16: widen to float, reduce, narrow. Sum (the gradient-
-// averaging hot path) gets an explicit vector kernel; other ops use the
-// scalar loop (reference analog: the F16C-vectorized fp16 reductions in
-// gloo/math.cc:21-98). A Pallas/VPU path handles the on-device case, so
-// this host path only sees staging buffers.
+// float16/bfloat16: widen to float, reduce, narrow — all four ops on the
+// AVX2/F16C vector path (reference analog: the F16C-vectorized fp16
+// reductions in gloo/math.cc:21-98). Narrowing is round-to-nearest-even
+// for sum/product; min/max select one of the (exactly representable)
+// operands, so their narrowing is exact by construction. A Pallas/VPU
+// path handles the on-device case, so this host path only sees staging
+// buffers.
 
 #ifdef TC_HAVE_VECTOR_HALF
 // Narrow 8 f32 lanes to bf16 with round-to-nearest-even. NaN lanes must
@@ -150,54 +170,25 @@ inline __m128i f32x8ToBf16Rne(__m256 v) {
   return _mm256_castsi256_si128(packed);
 }
 
-void sumHalfVec(uint16_t* a, const uint16_t* b, size_t n) {
-  size_t i = 0;
-  for (; i + 8 <= n; i += 8) {
-    __m256 fa = _mm256_cvtph_ps(
-        _mm_loadu_si128(reinterpret_cast<const __m128i*>(a + i)));
-    __m256 fb = _mm256_cvtph_ps(
-        _mm_loadu_si128(reinterpret_cast<const __m128i*>(b + i)));
-    __m128i packed = _mm256_cvtps_ph(_mm256_add_ps(fa, fb),
-                                     _MM_FROUND_TO_NEAREST_INT);
-    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i), packed);
-  }
-  for (; i < n; i++) {
-    a[i] = floatToHalf(halfToFloat(a[i]) + halfToFloat(b[i]));
-  }
-}
-
-void sumBf16Vec(uint16_t* a, const uint16_t* b, size_t n) {
-  size_t i = 0;
-  for (; i + 8 <= n; i += 8) {
-    // Widen bf16 -> f32: zero-extend to u32, shift into the high half.
-    __m256i wa = _mm256_slli_epi32(
-        _mm256_cvtepu16_epi32(_mm_loadu_si128(
-            reinterpret_cast<const __m128i*>(a + i))), 16);
-    __m256i wb = _mm256_slli_epi32(
-        _mm256_cvtepu16_epi32(_mm_loadu_si128(
-            reinterpret_cast<const __m128i*>(b + i))), 16);
-    __m256 sum = _mm256_add_ps(_mm256_castsi256_ps(wa),
-                               _mm256_castsi256_ps(wb));
-    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i),
-                     f32x8ToBf16Rne(sum));
-  }
-  for (; i < n; i++) {
-    a[i] = floatToBfloat16(bfloat16ToFloat(a[i]) + bfloat16ToFloat(b[i]));
-  }
-}
 #endif  // TC_HAVE_VECTOR_HALF
 
 template <template <typename> class Op>
 void reduceHalf(void* acc, const void* in, size_t n) {
   uint16_t* a = static_cast<uint16_t*>(acc);
   const uint16_t* b = static_cast<const uint16_t*>(in);
+  size_t i = 0;
 #ifdef TC_HAVE_VECTOR_HALF
-  if (std::is_same<Op<float>, OpSum<float>>::value) {
-    sumHalfVec(a, b, n);
-    return;
+  for (; i + 8 <= n; i += 8) {
+    __m256 fa = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(a + i)));
+    __m256 fb = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(b + i)));
+    __m128i packed = _mm256_cvtps_ph(Op<float>::applyVec(fa, fb),
+                                     _MM_FROUND_TO_NEAREST_INT);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i), packed);
   }
 #endif
-  for (size_t i = 0; i < n; i++) {
+  for (; i < n; i++) {
     a[i] = floatToHalf(Op<float>::apply(halfToFloat(a[i]), halfToFloat(b[i])));
   }
 }
@@ -206,13 +197,26 @@ template <template <typename> class Op>
 void reduceBf16(void* acc, const void* in, size_t n) {
   uint16_t* a = static_cast<uint16_t*>(acc);
   const uint16_t* b = static_cast<const uint16_t*>(in);
+  size_t i = 0;
 #ifdef TC_HAVE_VECTOR_HALF
-  if (std::is_same<Op<float>, OpSum<float>>::value) {
-    sumBf16Vec(a, b, n);
-    return;
+  for (; i + 8 <= n; i += 8) {
+    // Widen bf16 -> f32: zero-extend to u32, shift into the high half.
+    __m256i wa = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(a + i))), 16);
+    __m256i wb = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(b + i))), 16);
+    __m256 combined = Op<float>::applyVec(_mm256_castsi256_ps(wa),
+                                          _mm256_castsi256_ps(wb));
+    // f32x8ToBf16Rne is exact for min/max (the selected operand is a
+    // widened bf16, so the RNE bias adds nothing) and RNE for
+    // sum/product, with the scalar-identical quiet-NaN blend.
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(a + i),
+                     f32x8ToBf16Rne(combined));
   }
 #endif
-  for (size_t i = 0; i < n; i++) {
+  for (; i < n; i++) {
     a[i] = floatToBfloat16(
         Op<float>::apply(bfloat16ToFloat(a[i]), bfloat16ToFloat(b[i])));
   }

@@ -55,6 +55,14 @@ class Context {
   int channels() const { return channels_; }
   uint64_t stripeThresholdBytes() const { return stripeBytes_; }
 
+  // Fault-plane identity of this mesh (fault.h): 0 — the default — is
+  // the root/parent domain; async-engine lane contexts carry lane + 1 so
+  // each lane's serial op stream draws from its own deterministic
+  // per-(rule, rank, channel, domain) fault state. Set once right after
+  // the mesh is created, before any traffic.
+  void setFaultDomain(int domain) { faultDomain_ = domain; }
+  int faultDomain() const { return faultDomain_; }
+
   // Store-based bootstrap: publish one blob per rank (address + per-peer
   // pair routing ids — O(n) store traffic per rank, O(n^2) total), then
   // connect the full mesh. Higher rank initiates, lower rank listens.
@@ -301,6 +309,7 @@ class Context {
   const int rank_;
   const int size_;
   int channels_{1};
+  int faultDomain_{0};
   uint64_t stripeBytes_{uint64_t(1) << 20};
   bool channelsFromEnv_{false};
   bool stripeBytesFromEnv_{false};

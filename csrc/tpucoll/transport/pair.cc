@@ -202,7 +202,7 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
     // driving the same backoff/classification path a real refused or
     // reset handshake takes.
     fault::onConnect(selfRank_, peerRank_, context_->metrics(),
-                     context_->tracer());
+                     context_->tracer(), context_->faultDomain());
   }
   int fd = socket(remote.sa()->sa_family, SOCK_STREAM | SOCK_CLOEXEC, 0);
   TC_ENFORCE_GE(fd, 0, errnoString("socket"));
@@ -583,7 +583,8 @@ void Pair::sendFaulted(UnboundBuffer* ubuf, uint64_t slot,
                        const char* data, size_t nbytes) {
   fault::TxDecision fd = fault::onTxMessage(
       selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kData), slot,
-      nbytes, context_->metrics(), context_->tracer(), channel_);
+      nbytes, context_->metrics(), context_->tracer(), channel_,
+      context_->faultDomain());
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;
@@ -632,7 +633,8 @@ void Pair::sendStripe(UnboundBuffer* ubuf, uint64_t slot, const char* data,
     // (docs/faults.md).
     fault::TxDecision fd = fault::onTxMessage(
         selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kData), slot,
-        nbytes, context_->metrics(), context_->tracer(), channel_);
+        nbytes, context_->metrics(), context_->tracer(), channel_,
+        context_->faultDomain());
     if (!applyTxFault(fd, &op)) {
       TC_THROW(IoException, "send to rank ", peerRank_, ": ",
                fault::killMessage(peerRank_));
@@ -678,7 +680,8 @@ void Pair::sendPutFaulted(UnboundBuffer* ubuf, uint64_t token,
                           std::shared_ptr<StripeTx> st) {
   fault::TxDecision fd = fault::onTxMessage(
       selfRank_, peerRank_, static_cast<uint8_t>(Opcode::kPut), token,
-      nbytes, context_->metrics(), context_->tracer(), channel_);
+      nbytes, context_->metrics(), context_->tracer(), channel_,
+      context_->faultDomain());
   const bool viaShm = shmActive_.load(std::memory_order_relaxed) &&
                       nbytes >= shmThresholdBytes();
   TxOp op;

@@ -15,8 +15,10 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
 
 from gloo_tpu import fault, tuning
 from gloo_tpu.bootstrap import detect_launch_env, init_from_env
+from gloo_tpu.bucketer import GradientBucketer
 from gloo_tpu.core import (
     Aborted,
+    AsyncEngine,
     Context,
     Device,
     Error,
@@ -31,6 +33,7 @@ from gloo_tpu.core import (
     set_connect_debug_logger,
     TimeoutError,
     UnboundBuffer,
+    Work,
     crypto_isa_tier,
     derive_keyring,
     uring_available,
@@ -40,7 +43,10 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Aborted",
+    "AsyncEngine",
     "Context",
+    "GradientBucketer",
+    "Work",
     "Device",
     "Error",
     "FileStore",

@@ -173,6 +173,23 @@ _PROTOTYPES = {
                             ctypes.POINTER(_sz), _int, _u32, _i64]),
     "tc_reduce_scatter": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
                                  _int, _int, _u32, _i64]),
+    # async collective engine + work handles
+    "tc_async_new": (_c, [_c, _int, _u32]),
+    "tc_async_shutdown": (_int, [_c]),
+    "tc_async_free": (None, [_c]),
+    "tc_async_lanes": (_int, [_c]),
+    "tc_async_lane_context": (_c, [_c, _int]),
+    "tc_async_stats_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_async_allreduce": (_c, [_c, _c, _c, _sz, _int, _int, _int, _i64]),
+    "tc_async_reduce_scatter": (_c, [_c, _c, _c, ctypes.POINTER(_sz),
+                                     _int, _int, _int, _int, _i64]),
+    "tc_async_allgather": (_c, [_c, _c, _c, _sz, _int, _i64]),
+    "tc_work_wait": (_int, [_c, _i64]),
+    "tc_work_status": (_int, [_c]),
+    "tc_work_error_message": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_work_free": (None, [_c]),
     # p2p
     "tc_buffer_new": (_c, [_c, _c, _sz]),
     "tc_buffer_free": (None, [_c]),
@@ -222,6 +239,18 @@ def check_handle(handle: int | None) -> int:
     if not handle:
         raise Error(last_error())
     return handle
+
+
+def copy_out(fn, *args) -> bytes:
+    """Call a C function whose trailing parameters are (uint8_t** out,
+    size_t* out_len), copy the buffer, and free it via tc_buf_free."""
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    check(fn(*args, ctypes.byref(out), ctypes.byref(out_len)))
+    try:
+        return bytes(bytearray(out[: out_len.value]))
+    finally:
+        lib.tc_buf_free(out)
 
 
 lib = _lib

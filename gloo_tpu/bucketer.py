@@ -1,0 +1,214 @@
+"""DDP-style gradient-bucket coalescing over the async collective engine.
+
+A training step produces hundreds of small heterogeneous gradient tensors;
+allreducing them one by one pays full per-op latency (ctypes round trip,
+schedule setup, per-segment rendezvous) serially per tensor. The
+:class:`GradientBucketer` flattens them into large per-dtype flat buckets
+(``TPUCOLL_BUCKET_BYTES``, default 25 MiB — the PyTorch DDP default
+``bucket_cap_mb`` that the reference backs as a ProcessGroup backend) and
+issues each bucket's allreduce ASYNC the moment it fills, so bucket k+1's
+pack/copy overlaps bucket k's wire time on the engine's lanes
+(inter-collective pipelining, docs/async.md). ``finish()`` waits in issue
+order and unflattens results back into the original tensors in place.
+
+Usage::
+
+    engine = ctx.async_engine(lanes=2)          # collective, once
+    bucketer = GradientBucketer(engine)
+    for step in range(steps):
+        for g in grads:                          # same order on every rank
+            bucketer.add(g)
+        bucketer.finish()                        # grads now hold the sums
+
+Ordering contract: every rank must ``add`` the same tensors (shape, dtype)
+in the same order and call ``finish()`` at the same point — the buckets
+then line up across ranks exactly like a sequence of blocking collectives,
+just issued asynchronously (same contract as torch DDP's reducer).
+
+Error contract: bucket failures surface TYPED at the ``finish()`` /
+``wait()`` boundary — IoError / TimeoutError / Aborted with the blamed
+lane and op named. The collectives run in place, so after an error every
+tensor added since the last successful ``finish()`` has UNDEFINED
+contents (the undefined window opens at issue time, docs/errors.md
+"In-place collectives"); discard the bucketer, rebuild the context
+(gloo_tpu.resilience), and restore gradients from application state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from gloo_tpu import core
+from gloo_tpu._lib import Aborted, Error
+
+__all__ = ["GradientBucketer", "DEFAULT_BUCKET_BYTES"]
+
+DEFAULT_BUCKET_BYTES = 25 << 20  # torch DDP's bucket_cap_mb default
+
+
+def _scale_inplace(arr: np.ndarray, scale: float) -> None:
+    # Integer dtypes: arr *= dtype(scale) would multiply by int(0.5)==0;
+    # take the truncated mean instead, matching the sequential
+    # HostGradSync path (arr / size, then cast back).
+    if np.issubdtype(arr.dtype, np.inexact):
+        arr *= arr.dtype.type(scale)
+    else:
+        np.copyto(arr, (arr * scale).astype(arr.dtype))
+
+
+def _bucket_bytes_from_env() -> int:
+    raw = os.environ.get("TPUCOLL_BUCKET_BYTES")
+    if not raw:
+        return DEFAULT_BUCKET_BYTES
+    try:
+        value = int(raw)
+        if value <= 0:
+            raise ValueError(raw)
+    except ValueError:
+        raise Error(f"TPUCOLL_BUCKET_BYTES: not a positive integer: "
+                    f"{raw!r}") from None
+    return value
+
+
+class GradientBucketer:
+    """Coalesce many small arrays into flat per-dtype async allreduces.
+
+    One instance is reusable across steps (add... add, finish; repeat).
+    Not thread-safe; drive it from one thread per rank.
+    """
+
+    def __init__(self, engine: "core.AsyncEngine",
+                 bucket_bytes: Optional[int] = None, op="sum",
+                 average: bool = False,
+                 timeout: Optional[float] = None):
+        """engine: the context's AsyncEngine (Context.async_engine()).
+        bucket_bytes: flush threshold per dtype bucket (default
+        TPUCOLL_BUCKET_BYTES, else 25 MiB). op: reduction (callable
+        reductions are unsupported — async contract). average=True
+        divides every result by world size after the wait (requires
+        op="sum"). timeout: per-bucket collective timeout."""
+        if callable(op):
+            raise Error("GradientBucketer does not support callable "
+                        "reductions (async ops run on lane threads)")
+        if average and core.ReduceOp.parse(op) != core.ReduceOp.SUM:
+            raise Error("average=True requires op='sum'")
+        self._engine = engine
+        self._bucket_bytes = (bucket_bytes if bucket_bytes is not None
+                              else _bucket_bytes_from_env())
+        if self._bucket_bytes <= 0:
+            raise Error("bucket_bytes must be positive")
+        self._op = op
+        self._average = average
+        self._timeout = timeout
+        # dtype name -> (list of member arrays, running byte total).
+        self._pending = {}
+        # Issued buckets in issue order: (work, flat, members); flat is
+        # None when an oversized array was issued in place.
+        self._issued: List = []
+
+    @property
+    def in_flight(self) -> int:
+        """Buckets issued and not yet waited (the finish() backlog)."""
+        return len(self._issued)
+
+    def add(self, array: np.ndarray) -> None:
+        """Queue one tensor. Must be a C-contiguous numpy array; every
+        rank must add matching tensors in matching order. The array must
+        not be touched again until finish() returns."""
+        if not isinstance(array, np.ndarray):
+            raise TypeError(f"add() needs a numpy array, "
+                            f"got {type(array)}")
+        if not array.flags.c_contiguous:
+            raise Error("add() needs a C-contiguous array")
+        if array.nbytes >= self._bucket_bytes:
+            # Already bucket-sized: skip the pack/unpack copy entirely
+            # and allreduce it in place as its own bucket. Issue order
+            # is preserved relative to the flat buckets.
+            self._flush_dtype(array.dtype.name)
+            work = self._engine.allreduce_async(array, op=self._op,
+                                                timeout=self._timeout)
+            self._issued.append((work, None, None))
+            return
+        members, nbytes = self._pending.get(array.dtype.name, ([], 0))
+        members.append(array)
+        nbytes += array.nbytes
+        self._pending[array.dtype.name] = (members, nbytes)
+        if nbytes >= self._bucket_bytes:
+            self._flush_dtype(array.dtype.name)
+
+    def flush(self) -> None:
+        """Issue every partially-filled bucket (finish() does this)."""
+        for dtype in list(self._pending):
+            self._flush_dtype(dtype)
+
+    def _flush_dtype(self, dtype: str) -> None:
+        entry = self._pending.pop(dtype, None)
+        if entry is None or not entry[0]:
+            return
+        members, _ = entry
+        total = sum(int(m.size) for m in members)
+        flat = np.empty(total, dtype=members[0].dtype)
+        off = 0
+        for m in members:
+            flat[off:off + m.size] = m.reshape(-1)
+            off += m.size
+        work = self._engine.allreduce_async(flat, op=self._op,
+                                            timeout=self._timeout)
+        self._issued.append((work, flat, members))
+
+    def finish(self, timeout: Optional[float] = None) -> None:
+        """Flush partial buckets, wait for every issued bucket in issue
+        order, and unflatten the reduced values back into the original
+        arrays in place (divided by world size when average=True;
+        integer dtypes get the truncated mean, matching the sequential
+        ``arr / size`` then-cast path).
+
+        On a bucket failure the typed error propagates immediately:
+        earlier buckets are already unpacked, the failing and later
+        buckets' tensors are undefined, and the bucketer drains its
+        backlog (waiting out still-running buckets so no lane thread
+        can touch a dropped buffer) — discard it and rebuild the
+        context before retrying. `timeout` bounds each individual wait
+        (None: rely on the per-bucket collective timeouts)."""
+        self.flush()
+        scale = (1.0 / self._engine._context.size if self._average
+                 else None)
+        try:
+            while self._issued:
+                work, flat, members = self._issued[0]
+                work.wait(timeout)
+                if flat is None:
+                    if scale is not None:
+                        _scale_inplace(work.result, scale)
+                else:
+                    if scale is not None:
+                        _scale_inplace(flat, scale)
+                    off = 0
+                    for m in members:
+                        np.copyto(m, flat[off:off + m.size]
+                                  .reshape(m.shape))
+                        off += m.size
+                self._issued.pop(0)
+        except BaseException:
+            self._drain_after_error(timeout)
+            raise
+
+    def _drain_after_error(self, timeout: Optional[float]) -> None:
+        # Later buckets may still be RUNNING on other lanes; dropping
+        # their Work/flat references would free numpy buffers the lane
+        # threads are still reducing into (use-after-free). Wait each
+        # one out — swallowing its error, the first failure is what
+        # propagates — and keep anything still in flight after its wait
+        # pinned in the backlog (released once it completes, or when
+        # the engine shuts down and joins its lanes).
+        remaining, self._issued = self._issued, []
+        for entry in remaining:
+            work = entry[0]
+            try:
+                work.wait(timeout)
+            except (Error, Aborted):
+                if not work.test():
+                    self._issued.append(entry)

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
@@ -19,6 +21,7 @@ from gloo_tpu._lib import Aborted, Error, IoError, TimeoutError, check, check_ha
 
 __all__ = [
     "Aborted",
+    "AsyncEngine",
     "Context",
     "set_connect_debug_logger",
     "Device",
@@ -33,6 +36,7 @@ __all__ = [
     "TcpStoreServer",
     "TimeoutError",
     "UnboundBuffer",
+    "Work",
 ]
 
 _DTYPE_CODES = {
@@ -138,6 +142,9 @@ def _timeout_ms(timeout: Optional[float]) -> int:
     return 0 if timeout is None else max(1, int(timeout * 1000))
 
 
+_copy_out = _lib.copy_out
+
+
 class Store:
     """Base rendezvous store handle."""
 
@@ -164,16 +171,8 @@ class Store:
                                     len(value)))
 
     def get(self, key: str, timeout: float = 30.0) -> bytes:
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_size_t()
-        check(_lib.lib.tc_store_get(self._handle, key.encode(),
-                                    int(timeout * 1000),
-                                    ctypes.byref(out),
-                                    ctypes.byref(out_len)))
-        try:
-            return bytes(bytearray(out[: out_len.value]))
-        finally:
-            _lib.lib.tc_buf_free(out)
+        return _copy_out(_lib.lib.tc_store_get, self._handle,
+                         key.encode(), int(timeout * 1000))
 
     def add(self, key: str, delta: int) -> int:
         result = ctypes.c_int64()
@@ -483,12 +482,257 @@ class UnboundBuffer:
                                      nbytes))
 
 
+class Work:
+    """Handle for one async collective issued on an :class:`AsyncEngine`.
+
+    The collective runs on its engine lane's private forked context; this
+    handle pins the numpy buffers until completion and surfaces the
+    result. Errors surface TYPED at :meth:`wait` — `TimeoutError`,
+    `IoError`, or `Aborted` (engine shut down with the op in flight) —
+    with the blamed lane and op named in the message. The collective ran
+    in place, so after an error the buffer contents are UNDEFINED from
+    the moment the op was ISSUED, not from wait() (docs/errors.md,
+    "In-place collectives"; docs/async.md)."""
+
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
+
+    def __init__(self, engine: "AsyncEngine", handle: int, op: str,
+                 arrays, result=None):
+        self._engine = engine
+        self._handle = handle
+        self.op = op
+        self._arrays = arrays  # pin the buffers until completion
+        #: Output array for allgather/reduce_scatter (the reduced array
+        #: itself for in-place allreduce).
+        self.result = result
+        self._free = _lib.lib.tc_work_free
+
+    def __del__(self):
+        handle, self._handle = self._handle, None
+        if not handle:
+            return
+        if _lib.lib.tc_work_status(handle) >= 2:  # done/error
+            self._free(handle)
+        else:
+            # Op still in flight: its lane thread keeps reading/writing
+            # our numpy buffers through raw pointers, so dropping the
+            # references now would be a use-after-free. Park buffers and
+            # handle on the engine; released at shutdown(), after the
+            # lane threads are joined.
+            self._engine._park(handle, self._arrays)
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the op completes; raises its typed error if it
+        failed. timeout=None waits with no wait-side deadline — the op's
+        own collective timeout (set at issue time) still bounds every
+        blocking step, so a dead peer surfaces as TimeoutError/IoError
+        here rather than a hang. A wait-side timeout raises TimeoutError
+        but does NOT cancel the op. Returns :attr:`result`."""
+        ms = 0 if timeout is None else max(1, int(timeout * 1000))
+        check(_lib.lib.tc_work_wait(self._handle, ms))
+        return self.result
+
+    def test(self) -> bool:
+        """Non-blocking: True once the op finished (successfully or
+        not). A failure still surfaces only at wait()."""
+        return _lib.lib.tc_work_status(self._handle) >= 2
+
+    def error(self) -> Optional[str]:
+        """Error message of a failed op, or None (pending/succeeded)."""
+        msg = _copy_out(_lib.lib.tc_work_error_message,
+                        self._handle).decode()
+        return msg or None
+
+
+class AsyncEngine:
+    """Async collective work queue over a pool of lanes (docs/async.md).
+
+    Each lane is a worker thread owning a privately-tagged forked
+    sub-context of the parent, so collectives in flight on different
+    lanes can never cross-match; submissions are assigned round-robin in
+    issue order (submission i runs on lane i % lanes), which keeps every
+    lane's op stream identical across ranks and the flight recorder's
+    cross-rank cseq/fingerprint comparison sound.
+
+    CONSTRUCTION IS A COLLECTIVE (it forks over the parent): every rank
+    must construct concurrently with the same lane count — as must every
+    issue_* call, in the same order, exactly like blocking collectives.
+    Prefer :meth:`Context.async_engine`, which also wires the engine
+    into the context's close()."""
+
+    # Class-level fallbacks so __del__ is safe when __init__ raised
+    # before assignment.
+    _handle = None
+    _free = staticmethod(lambda handle: None)
+    _parked = ()
+    _work_free = staticmethod(lambda handle: None)
+
+    def __init__(self, context: "Context", lanes: Optional[int] = None,
+                 tag_base: int = 0):
+        if lanes is None:
+            raw = os.environ.get("TPUCOLL_ASYNC_LANES", "2")
+            try:
+                lanes = int(raw)
+                if lanes < 1:
+                    raise ValueError(raw)
+            except ValueError:
+                raise Error(f"TPUCOLL_ASYNC_LANES: not a positive "
+                            f"integer: {raw!r}") from None
+        # (handle, arrays) of Works dropped while still in flight; their
+        # buffers must outlive the lane threads (see Work.__del__).
+        self._parked = []
+        self._work_free = _lib.lib.tc_work_free
+        self._handle = check_handle(
+            _lib.lib.tc_async_new(context._handle, lanes, tag_base))
+        self._context = context
+        self.lanes = lanes
+        self._free = _lib.lib.tc_async_free
+
+    def __del__(self):
+        # tc_async_free shuts down first: queued work fails typed
+        # (Aborted), the in-flight op is aborted via its lane context.
+        handle, self._handle = self._handle, None
+        if handle:
+            self._free(handle)
+        self._release_parked()
+
+    def _park(self, work_handle: int, arrays) -> None:
+        self._parked.append((work_handle, arrays))
+
+    def _release_parked(self) -> None:
+        # Only safe once the lane threads are joined (shutdown/free).
+        parked, self._parked = self._parked, []
+        for handle, _ in parked:
+            self._work_free(handle)
+
+    def shutdown(self) -> None:
+        """Fail queued work loudly (Aborted, naming lane/op), abort the
+        in-flight op on every lane, join the lane threads. Idempotent;
+        every waiter unblocks with a typed error."""
+        if self._handle:
+            check(_lib.lib.tc_async_shutdown(self._handle))
+            self._release_parked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    def allreduce_async(self, array: np.ndarray, op="sum",
+                        algorithm: str = "auto",
+                        timeout: Optional[float] = None) -> Work:
+        """In-place async allreduce; returns a :class:`Work`. Same
+        semantics as Context.allreduce except custom-callable reductions
+        are unsupported (they would run on a lane thread). From issue
+        until wait() returns, `array` must not be read or written — the
+        undefined-contents window of docs/errors.md opens HERE."""
+        _check_array(array)
+        if callable(op):
+            raise Error("async allreduce does not support callable "
+                        "reductions (lane threads cannot enter Python)")
+        handle = check_handle(_lib.lib.tc_async_allreduce(
+            self._handle, _ptr(array), _ptr(array), array.size,
+            _dtype_code(array), ReduceOp.parse(op),
+            Context._ALGORITHMS[algorithm], _timeout_ms(timeout)))
+        return Work(self, handle, "allreduce", (array,), result=array)
+
+    def reduce_scatter_async(self, array: np.ndarray,
+                             recv_counts: Optional[Sequence[int]] = None,
+                             op="sum", algorithm: str = "auto",
+                             timeout: Optional[float] = None) -> Work:
+        """Async reduce_scatter; the output array is ``work.result``."""
+        _check_array(array)
+        if callable(op):
+            raise Error("async reduce_scatter does not support callable "
+                        "reductions (lane threads cannot enter Python)")
+        size = self._context.size
+        if recv_counts is None:
+            assert array.size % size == 0, \
+                "array size not divisible by group size"
+            recv_counts = [array.size // size] * size
+        assert sum(recv_counts) == array.size, "sum(recv_counts) != size"
+        out = np.empty(int(recv_counts[self._context.rank]),
+                       dtype=array.dtype)
+        handle = check_handle(_lib.lib.tc_async_reduce_scatter(
+            self._handle, _ptr(array), _ptr(out),
+            _counts_arg(recv_counts), size, _dtype_code(array),
+            ReduceOp.parse(op), Context._RS_ALGORITHMS[algorithm],
+            _timeout_ms(timeout)))
+        return Work(self, handle, "reduce_scatter", (array, out),
+                    result=out)
+
+    def allgather_async(self, array: np.ndarray,
+                        timeout: Optional[float] = None) -> Work:
+        """Async allgather; the (size, *shape) output is ``work.result``."""
+        _check_array(array)
+        out = np.empty((self._context.size,) + array.shape,
+                       dtype=array.dtype)
+        handle = check_handle(_lib.lib.tc_async_allgather(
+            self._handle, _ptr(array), _ptr(out), array.size,
+            _dtype_code(array), _timeout_ms(timeout)))
+        return Work(self, handle, "allgather", (array, out), result=out)
+
+    def stats(self) -> dict:
+        """Engine counters: {"lanes", "in_flight", "submitted",
+        "completed", "errors", "per_lane": [{"submitted", "completed",
+        "errors", "queue_depth", "poisoned"}, ...]}."""
+        return json.loads(_copy_out(_lib.lib.tc_async_stats_json,
+                                    self._handle))
+
+    def _lane_handle(self, lane: int) -> int:
+        return check_handle(
+            _lib.lib.tc_async_lane_context(self._handle, lane))
+
+    def lane_metrics(self, lane: int, drain: bool = False) -> dict:
+        """Metrics snapshot of lane `lane`'s forked sub-context (async
+        ops are recorded there, not on the parent) — same shape as
+        Context.metrics()."""
+        snap = json.loads(_copy_out(_lib.lib.tc_metrics_json,
+                                    self._lane_handle(lane),
+                                    1 if drain else 0))
+        snap["transport"] = {int(k): v
+                             for k, v in snap["transport"].items()}
+        return snap
+
+    def lane_flightrec(self, lane: int) -> dict:
+        """Flight-recorder snapshot of lane `lane`'s sub-context — same
+        shape as Context.flightrec(). Lane k's cseq/fingerprint stream
+        is cross-rank comparable on its own (round-robin assignment is
+        deterministic), so merge per lane, never across lanes."""
+        return json.loads(_copy_out(_lib.lib.tc_flightrec_json,
+                                    self._lane_handle(lane)))
+
+    def flightrec_dump(self, directory: str) -> dict:
+        """Dump every lane's flight recorder under `directory`, one
+        merge-ready subdirectory per lane
+        (``<directory>/lane<k>/flightrec-rank<r>.json``). Returns
+        {lane: path}. Merge each lane subdirectory separately with
+        gloo_tpu.utils.flightrec.merge()."""
+        paths = {}
+        for lane in range(self.lanes):
+            lane_dir = os.path.join(directory, f"lane{lane}")
+            os.makedirs(lane_dir, exist_ok=True)
+            path = os.path.join(
+                lane_dir, f"flightrec-rank{self._context.rank}.json")
+            check(_lib.lib.tc_flightrec_dump(self._lane_handle(lane),
+                                             path.encode()))
+            paths[lane] = path
+        return paths
+
+
 class Context:
     """A connected process group: collectives + point-to-point messaging.
 
     One Context per (process, group). All collective calls are blocking and
     must be entered by every rank with matching arguments; concurrent
-    collectives on one context need distinct tags.
+    collectives on one context need distinct tags. For non-blocking
+    collectives with inter-collective pipelining, see
+    :meth:`async_engine` (docs/async.md).
     """
 
     # Class-level fallbacks so __del__ is safe when __init__ raised
@@ -504,6 +748,10 @@ class Context:
         _lib.lib.tc_context_set_timeout(self._handle, int(timeout * 1000))
         self._store = None
         self._device = None
+        # Weak refs (an engine holds a strong ref to its context, so a
+        # strong list here would cycle): close() shuts live engines down
+        # before tearing the parent transport down.
+        self._engines = []
         self._free = _lib.lib.tc_context_free
 
     def __del__(self):
@@ -532,6 +780,15 @@ class Context:
         return child
 
     def close(self) -> None:
+        """Close the context. Any async engine created through
+        :meth:`async_engine` is shut down FIRST: queued async work fails
+        loudly (Aborted, naming the lane/op), in-flight ops abort with a
+        typed IoError at their Work.wait() — never a hang or a segfault
+        (docs/async.md, "Lifecycle")."""
+        for ref in self._engines:
+            engine = ref()
+            if engine is not None:
+                engine.shutdown()
         check(_lib.lib.tc_context_close(self._handle))
 
     def __enter__(self):
@@ -574,14 +831,7 @@ class Context:
         """Drain recorded spans as Chrome trace-event JSON (load the file
         in Perfetto / chrome://tracing; merge ranks by concatenating their
         event arrays)."""
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_size_t()
-        check(_lib.lib.tc_trace_json(self._handle, ctypes.byref(out),
-                                     ctypes.byref(out_len)))
-        try:
-            return bytes(bytearray(out[: out_len.value])).decode()
-        finally:
-            _lib.lib.tc_buf_free(out)
+        return _copy_out(_lib.lib.tc_trace_json, self._handle).decode()
 
     def trace_dump(self, path: str) -> None:
         with open(path, "w") as f:
@@ -603,15 +853,8 @@ class Context:
         bytes/root), and `state` one of enqueued/started/completed.
         Non-draining: the ring keeps rolling. See
         gloo_tpu.utils.flightrec for dump/merge/analyze."""
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_size_t()
-        check(_lib.lib.tc_flightrec_json(self._handle, ctypes.byref(out),
-                                         ctypes.byref(out_len)))
-        try:
-            raw = bytes(bytearray(out[: out_len.value])).decode()
-        finally:
-            _lib.lib.tc_buf_free(out)
-        return json.loads(raw)
+        return json.loads(_copy_out(_lib.lib.tc_flightrec_json,
+                                    self._handle))
 
     def flightrec_dump(self, path: str) -> str:
         """Write the flight-recorder ring to `path` as JSON (the explicit
@@ -648,19 +891,21 @@ class Context:
         survive a drain. See gloo_tpu.utils.metrics for Prometheus text
         exposition and quantile estimation.
         """
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_size_t()
-        check(_lib.lib.tc_metrics_json(self._handle, 1 if drain else 0,
-                                       ctypes.byref(out),
-                                       ctypes.byref(out_len)))
-        try:
-            raw = bytes(bytearray(out[: out_len.value])).decode()
-        finally:
-            _lib.lib.tc_buf_free(out)
-        snap = json.loads(raw)
+        snap = json.loads(_copy_out(_lib.lib.tc_metrics_json,
+                                    self._handle, 1 if drain else 0))
         # JSON keys are strings; peer ranks are ints.
         snap["transport"] = {int(k): v
                              for k, v in snap["transport"].items()}
+        # Async engines record their collectives on their lane contexts
+        # (lane_metrics); the parent snapshot carries the engine-level
+        # gauges so one scrape sees the in-flight depth.
+        engines = [e() for e in self._engines]
+        engines = [e for e in engines if e is not None and e._handle]
+        if engines:
+            snap["async"] = {
+                "in_flight": sum(e.stats()["in_flight"] for e in engines),
+                "engines": [e.stats() for e in engines],
+            }
         return snap
 
     def metrics_enable(self, on: bool = True) -> None:
@@ -683,6 +928,20 @@ class Context:
 
     def register(self, array: np.ndarray) -> UnboundBuffer:
         return UnboundBuffer(self, array)
+
+    # ---- async collective engine (docs/async.md) ----
+
+    def async_engine(self, lanes: Optional[int] = None,
+                     tag_base: int = 0) -> AsyncEngine:
+        """Create an :class:`AsyncEngine` over this context — a
+        COLLECTIVE call (it forks lane sub-contexts over this one), so
+        every rank must call it concurrently with the same `lanes`
+        (default: TPUCOLL_ASYNC_LANES, else 2). The engine is shut down
+        automatically by close()."""
+        engine = AsyncEngine(self, lanes=lanes, tag_base=tag_base)
+        self._engines = [r for r in self._engines if r() is not None]
+        self._engines.append(weakref.ref(engine))
+        return engine
 
     # ---- collectives ----
 

@@ -40,7 +40,6 @@ from either side.
 
 from __future__ import annotations
 
-import ctypes
 import json
 from typing import Dict, List, Optional, Union
 
@@ -83,20 +82,15 @@ def report(rank: Optional[int] = None) -> List[Dict]:
     """The deterministic firing log, in firing order.
 
     Each entry is ``{"rank", "n", "rule", "action", "peer", "opcode",
-    "slot", "nbytes"}`` where ``n`` indexes fires per injecting rank.
-    With several in-process ranks the global interleaving is scheduling-
-    dependent, but each rank's subsequence is deterministic — pass
-    ``rank`` to get exactly that reproducible slice.
+    "slot", "nbytes", "channel", "domain"}`` where ``n`` indexes fires
+    per (injecting rank, fault domain) — domain 0 is the root context,
+    async-engine lanes carry lane + 1. With several in-process ranks
+    (or async lanes) the global interleaving is scheduling-dependent,
+    but each (rank, domain) subsequence is deterministic — pass ``rank``
+    for that rank's slice, and sort by ``(domain, n)`` to canonicalize a
+    run with concurrent lanes (docs/faults.md, "Determinism").
     """
-    out = ctypes.POINTER(ctypes.c_uint8)()
-    out_len = ctypes.c_size_t()
-    check(_lib.lib.tc_fault_report(ctypes.byref(out),
-                                   ctypes.byref(out_len)))
-    try:
-        raw = bytes(bytearray(out[: out_len.value])).decode()
-    finally:
-        _lib.lib.tc_buf_free(out)
-    entries = json.loads(raw)
+    entries = json.loads(_lib.copy_out(_lib.lib.tc_fault_report))
     if rank is not None:
         entries = [e for e in entries if e["rank"] == rank]
     return entries

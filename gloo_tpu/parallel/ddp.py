@@ -67,11 +67,27 @@ class HostGradSync:
     computes local gradients (any jax backend), then calls
     `average_(grads)` before the optimizer step. Matches the reference's
     DDP contract: allreduce(SUM) then divide by world size.
+
+    bucketed=True switches to the async engine + gradient bucketer
+    (docs/async.md): leaves are flattened into ~25 MiB per-dtype buckets
+    issued asynchronously, so bucket k+1's pack overlaps bucket k's wire
+    time — the fast path for the many-small-tensors shape of real
+    models. Construction is then a COLLECTIVE (it forks lane
+    sub-contexts), as is every average() call — same contract as the
+    sequential path.
     """
 
-    def __init__(self, context):
+    def __init__(self, context, bucketed: bool = False,
+                 bucket_bytes=None, lanes=None):
         self.context = context
         self._tag = 1 << 20  # leave low tags to the application
+        self._bucketer = None
+        if bucketed:
+            from gloo_tpu.bucketer import GradientBucketer
+
+            engine = context.async_engine(lanes=lanes)
+            self._bucketer = GradientBucketer(
+                engine, bucket_bytes=bucket_bytes, average=True)
 
     def average(self, grads):
         from gloo_tpu.utils.tracing import annotate
@@ -83,6 +99,16 @@ class HostGradSync:
         # profiler timeline next to device activity (the C++ tracer's
         # own span covers the native side; see docs/observability.md).
         with annotate("gloo_tpu.ddp.host_grad_sync"):
+            if self._bucketer is not None:
+                arrs = [np.ascontiguousarray(np.asarray(leaf))
+                        for leaf in leaves]
+                for arr in arrs:
+                    self._bucketer.add(arr)
+                self._bucketer.finish()  # arrs now hold the means
+                out = [jnp.asarray(arr, dtype=leaf.dtype)
+                       if hasattr(leaf, "dtype") else arr
+                       for leaf, arr in zip(leaves, arrs)]
+                return jax.tree.unflatten(treedef, out)
             for i, leaf in enumerate(leaves):
                 arr = np.ascontiguousarray(np.asarray(leaf))
                 self.context.allreduce(arr, op="sum", tag=self._tag + i)

@@ -176,6 +176,24 @@ def to_prometheus(snapshot: dict,
             continue
         lines.append(f"gloo_tpu_faults_injected_total"
                      f"{_fmt_labels({**base, 'action': action})} {n}")
+    # Async engine gauges (Context.metrics() attaches them when the
+    # context has live engines; the per-op detail lives in the lane
+    # contexts' own snapshots, AsyncEngine.lane_metrics).
+    async_ = snapshot.get("async")
+    if async_:
+        lines.append("# TYPE gloo_tpu_async_in_flight gauge")
+        lines.append(f"gloo_tpu_async_in_flight{_fmt_labels(base)} "
+                     f"{async_.get('in_flight', 0)}")
+        lines.append("# TYPE gloo_tpu_async_lane_submitted_total counter")
+        lines.append("# TYPE gloo_tpu_async_lane_completed_total counter")
+        lines.append("# TYPE gloo_tpu_async_lane_errors_total counter")
+        for ei, eng in enumerate(async_.get("engines", [])):
+            for lane, st in enumerate(eng.get("per_lane", [])):
+                labels = {**base, "engine": ei, "lane": lane}
+                for key in ("submitted", "completed", "errors"):
+                    lines.append(f"gloo_tpu_async_lane_{key}_total"
+                                 f"{_fmt_labels(labels)} "
+                                 f"{st.get(key, 0)}")
     wd = snapshot.get("watchdog", {})
     lines.append("# TYPE gloo_tpu_watchdog_stalls_total counter")
     lines.append(f"gloo_tpu_watchdog_stalls_total{_fmt_labels(base)} "

@@ -1,0 +1,284 @@
+"""Async collective engine + gradient bucketer (docs/async.md).
+
+Covers the tentpole contracts: correctness and out-of-order completion
+across lanes, deterministic round-robin lane assignment (the property
+that keeps per-lane flight-recorder streams cross-rank comparable),
+bucketer coalescing/unflattening over heterogeneous dtypes, the
+lifecycle contract (close()/teardown with work in flight fails loudly
+and typed, naming the blamed lane/op — never a hang or a segfault), and
+per-lane flightrec merges with no spurious desync.
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import GradientBucketer
+from gloo_tpu.utils import flightrec
+
+from tests.harness import spawn
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_async_allreduce_battery():
+    """Mixed async collectives across 2 lanes at P=3: results correct,
+    waits complete out of submission order, lane assignment is strict
+    round-robin, and the engine gauges settle to zero in flight."""
+
+    def fn(ctx, rank):
+        with ctx.async_engine(lanes=2) as eng:
+            works, arrays = [], []
+            for i in range(8):
+                x = np.full(500 + 321 * i, float(rank + 1 + i),
+                            dtype=np.float32)
+                works.append(eng.allreduce_async(x))
+                arrays.append(x)
+            # Reverse-order waits: completion order is decoupled from
+            # issue order (the GC3 framing the tentpole implements).
+            for i in reversed(range(8)):
+                works[i].wait()
+                expect = 3 * (i + 2)  # sum over ranks of (rank+1+i)
+                assert arrays[i][0] == expect, (i, arrays[i][0])
+            assert all(w.test() for w in works)
+            assert all(w.error() is None for w in works)
+
+            g = eng.allgather_async(np.full(16, float(rank), np.float64))
+            rs = eng.reduce_scatter_async(
+                np.arange(12, dtype=np.float32) * (rank + 1))
+            mn = eng.allreduce_async(
+                np.array([float(rank)], dtype=np.float64), op="min")
+            gout = g.wait()
+            assert gout.shape == (3, 16) and gout[2][0] == 2.0, gout[2][0]
+            rsout = rs.wait()
+            # sum over ranks of i*(rank+1) = 6i; rank owns its block of 4
+            assert rsout[0] == 6.0 * (4 * rank), rsout
+            assert mn.wait()[0] == 0.0
+
+            st = eng.stats()
+            assert st["lanes"] == 2
+            assert st["submitted"] == 11 and st["in_flight"] == 0, st
+            assert st["completed"] == 11 and st["errors"] == 0, st
+            # Round-robin: submission i -> lane i % 2, on every rank.
+            assert st["per_lane"][0]["submitted"] == 6, st
+            assert st["per_lane"][1]["submitted"] == 5, st
+            assert not st["per_lane"][0]["poisoned"]
+
+            # Async ops are recorded on the lane contexts.
+            ops = eng.lane_metrics(0)["ops"]
+            assert ops.get("allreduce", {}).get("calls", 0) >= 4, ops
+        return True
+
+    assert spawn(3, fn, timeout=60) == [True] * 3
+
+
+def test_async_callable_reduction_rejected():
+    def fn(ctx, rank):
+        with ctx.async_engine(lanes=1) as eng:
+            with pytest.raises(gloo_tpu.Error, match="callable"):
+                eng.allreduce_async(np.ones(4, np.float32),
+                                    op=lambda a, b: None)
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]
+
+
+def test_bucketer_coalesces_and_unflattens():
+    """Heterogeneous shapes and dtypes coalesce into per-dtype flat
+    buckets, results land back in the original tensors, the bucketer is
+    reusable across steps, and oversized tensors ride as their own
+    in-place bucket (no pack copy)."""
+
+    def fn(ctx, rank):
+        eng = ctx.async_engine(lanes=2)
+        b = GradientBucketer(eng, bucket_bytes=64 << 10)
+        shapes = [(3, 5), (128,), (17, 31), (2, 2, 2), (4096,), (63,)]
+        for step in range(3):
+            tensors = []
+            for i, shape in enumerate(shapes * 4):
+                dtype = [np.float32, np.float64, np.int32][i % 3]
+                t = np.full(shape, rank + 1 + step, dtype=dtype)
+                tensors.append(t)
+            big = np.full(100_000, float(rank + 1), np.float32)  # own bucket
+            for t in tensors:
+                b.add(t)
+            b.add(big)
+            assert b.in_flight > 0
+            b.finish()
+            assert b.in_flight == 0
+            for t in tensors:
+                assert t.flat[0] == 2 * (1 + step) + 1, (step, t.flat[0])
+            assert big[0] == 3.0
+        # average=True divides by world size after the wait.
+        avg = GradientBucketer(eng, bucket_bytes=1 << 20, average=True)
+        grads = [np.full(100, float(rank + 1), np.float32)
+                 for _ in range(5)]
+        for g in grads:
+            avg.add(g)
+        avg.finish()
+        for g in grads:
+            assert g[0] == 1.5, g[0]  # (1 + 2) / 2
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+def test_bucketer_rejects_bad_config():
+    class FakeEngine:
+        pass
+
+    with pytest.raises(gloo_tpu.Error, match="callable"):
+        GradientBucketer(FakeEngine(), op=lambda a, b: None)
+    with pytest.raises(gloo_tpu.Error, match="sum"):
+        GradientBucketer(FakeEngine(), op="max", average=True)
+
+
+def test_close_with_work_in_flight_fails_loudly():
+    """The lifecycle regression: Context.close() with async work still
+    in flight must surface typed errors at wait() — the running op
+    aborted via its lane (IoError/TimeoutError), queued ops failed as
+    Aborted — all naming the blamed lane/op, with no hang and no
+    segfault. Rank 1 never enters the collectives, so without the
+    shutdown path rank 0's waits would sit out their full timeouts."""
+
+    def fn(ctx, rank):
+        eng = ctx.async_engine(lanes=1)
+        works = []
+        if rank == 0:
+            for _ in range(3):
+                works.append(
+                    eng.allreduce_async(np.ones(200_000, np.float32)))
+            time.sleep(0.2)  # let seq 0 reach its blocking wait
+            t0 = time.time()
+            ctx.close()
+            closed_in = time.time() - t0
+            assert closed_in < 5.0, f"close took {closed_in}s"
+            # seq 0 was mid-collective: aborted through the lane context.
+            with pytest.raises(gloo_tpu.IoError) as excinfo:
+                works[0].wait(timeout=5)
+            msg = str(excinfo.value)
+            assert "lane 0" in msg and "allreduce" in msg, msg
+            # seq 1/2 were still queued: failed loudly, never ran.
+            for w in works[1:]:
+                with pytest.raises(gloo_tpu.Aborted) as excinfo:
+                    w.wait(timeout=5)
+                msg = str(excinfo.value)
+                assert "never ran" in msg and "allreduce" in msg, msg
+                assert "lane 0" in msg, msg
+            # The engine is down: new submissions fail loudly too
+            # (handle-constructor path, so the base Error type).
+            with pytest.raises(gloo_tpu.Error, match="shutdown"):
+                eng.allreduce_async(np.ones(4, np.float32))
+        else:
+            time.sleep(1.5)  # keep the peer mesh alive while rank 0
+            ctx.close()      # closes with its work genuinely in flight
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+def test_teardown_with_work_in_flight_never_hangs():
+    """Interpreter teardown (__del__ path, no explicit close/shutdown)
+    with async work in flight: the child process must exit 0 promptly —
+    no hang joining lane threads, no segfault from lanes outliving the
+    contexts."""
+    store = tempfile.mkdtemp()
+    body = textwrap.dedent("""
+        import sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=20.0)
+        ctx.connect_full_mesh(gloo_tpu.FileStore({store!r}),
+                              gloo_tpu.Device())
+        eng = ctx.async_engine(lanes=2)
+        if rank == 0:
+            # Rank 1 never joins: these stay in flight at exit.
+            works = [eng.allreduce_async(np.ones(50_000, np.float32))
+                     for _ in range(4)]
+        else:
+            time.sleep(0.5)
+        print("EXITING")
+        # Fall off the end: only __del__ / interpreter teardown runs.
+    """).format(repo=_REPO, store=store)
+    procs = [subprocess.Popen([sys.executable, "-c", body, str(r)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for r in range(2)]
+    outs = [p.communicate(timeout=60) for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (r, p.returncode, out)
+        assert "EXITING" in out[0], (r, out)
+
+
+def test_flightrec_lane_streams_merge_clean():
+    """Per-lane flight-recorder merges across ranks: deterministic
+    round-robin assignment keeps each lane's cseq/fingerprint stream
+    identical on every rank, so the desync detector reports OK for
+    every lane — no spurious desync from async interleaving — while the
+    per-lane streams really did record the async ops."""
+    dumps = tempfile.mkdtemp()
+
+    def fn(ctx, rank):
+        with ctx.async_engine(lanes=2) as eng:
+            works = []
+            for i in range(10):
+                # Heterogeneous ops and sizes, identical order per rank.
+                if i % 3 == 2:
+                    w = eng.allgather_async(
+                        np.full(50 + i, float(rank), np.float32))
+                else:
+                    w = eng.allreduce_async(
+                        np.full(1000 + 100 * i, 1.0, np.float32))
+                works.append(w)
+            for w in works:
+                w.wait()
+            eng.flightrec_dump(dumps)
+        return True
+
+    assert spawn(3, fn, timeout=60) == [True] * 3
+    for lane in range(2):
+        merged = flightrec.merge(os.path.join(dumps, f"lane{lane}"))
+        assert sorted(merged["ranks"]) == [0, 1, 2], merged["missing"]
+        verdict = flightrec.analyze(merged)
+        assert verdict["kind"] == "ok", verdict
+        assert flightrec.detect_desync(
+            {r: d["events"] for r, d in merged["ranks"].items()}) is None
+        # Each lane recorded its own 5-op collective stream.
+        events = merged["ranks"][0]["events"]
+        cseqs = [e["cseq"] for e in events if e.get("cseq") is not None]
+        assert len(cseqs) == 5 and cseqs == sorted(cseqs), cseqs
+
+
+def test_async_metrics_surface():
+    """Parent metrics carry the engine gauges; lane metrics and the
+    Prometheus exposition include the async series."""
+
+    def fn(ctx, rank):
+        eng = ctx.async_engine(lanes=2)
+        ws = [eng.allreduce_async(np.ones(100, np.float32))
+              for _ in range(4)]
+        for w in ws:
+            w.wait()
+        snap = ctx.metrics()
+        assert snap["async"]["in_flight"] == 0, snap["async"]
+        assert snap["async"]["engines"][0]["submitted"] == 4
+        from gloo_tpu.utils.metrics import to_prometheus
+
+        text = to_prometheus(snap)
+        assert "gloo_tpu_async_in_flight" in text
+        assert 'gloo_tpu_async_lane_submitted_total' in text
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]

@@ -267,6 +267,78 @@ def test_chaos_kill_fails_loudly_then_rebuild():
     _assert_ok(procs, outs)
 
 
+def test_chaos_kill_mid_async_bucketed_allreduce():
+    """Chaos with async work in flight (docs/async.md): a kill fault
+    fires mid bucketed-async allreduce on rank 1's lane traffic. The
+    victim bucket's Work.wait() raises naming the faulted peer, the
+    failing lane is named in the message, rebuild_after_failure reforms
+    a working full-size context afterwards, and — the determinism
+    acceptance — two same-seed runs produce byte-identical per-(rank,
+    domain) fault reports even though the firing lanes run concurrently
+    (rule state is keyed per (rule, rank, channel, domain); lane k is
+    domain k + 1)."""
+    schedule = {"seed": 21, "faults": [
+        # Only bucket-sized traffic matches (the engine's fork bootstrap
+        # and the small control collectives stay under min_bytes); one
+        # kill per (channel, domain) stream state, so each lane that
+        # carries a bucket to rank 0 loses its pair deterministically.
+        {"when": {"rank": 1, "peer": 0, "opcode": "data",
+                  "min_bytes": 40000},
+         "action": "kill", "count": 1}]}
+    body = """
+from gloo_tpu import GradientBucketer
+
+engine = ctx.async_engine(lanes=2)
+bucketer = GradientBucketer(engine, bucket_bytes=256 << 10)
+rng = np.random.default_rng(5)  # identical stream on every rank
+grads = [np.full(int(n), float(rank + 1), dtype=np.float32)
+         for n in rng.integers(2000, 30000, size=24)]
+err = None
+try:
+    for g in grads:
+        bucketer.add(g)
+    bucketer.finish()
+except gloo_tpu.IoError as exc:   # TimeoutError subclasses IoError
+    err = str(exc)
+assert err is not None, "bucketed allreduce unexpectedly survived"
+assert "lane" in err, err
+if rank == 1:
+    assert "fault injection: killed connection to rank 0" in err, err
+fired = sorted(((e["domain"], e["n"], e["action"], e["peer"],
+                 e["nbytes"]) for e in fault.report(rank=1)))
+# settle must outlast the slowest rank's exit from the broken step: a
+# rank whose buckets merely STALL (its pairs weren't the killed ones)
+# only unblocks at its 10s collective timeout, well after the injector's
+# EOF-fast failure.
+new_ctx, new_rank, new_size = rebuild_after_failure(
+    store, gloo_tpu.Device(), old_rank=rank, old_size=size, generation=1,
+    settle=15.0, timeout=90.0, failed_context=ctx)
+assert new_ctx is not None, "rebuild failed"
+assert new_size == size, new_size
+y = np.full(1024, float(new_rank + 1), dtype=np.float32)
+new_ctx.allreduce(y, tag=2)
+assert y[0] == size * (size + 1) / 2, y[0]
+new_ctx.close()
+print("OK", json.dumps(fired))
+"""
+    reports = []
+    for attempt in range(2):
+        store = tempfile.mkdtemp()
+        procs, outs = _run(body, 3, store, schedule, timeout=180)
+        _assert_ok(procs, outs)
+        # Rank 1's canonicalized (domain, n)-sorted firing report; every
+        # rank prints the same process-global table slice.
+        line = [ln for ln in outs[1][0].splitlines()
+                if ln.startswith("OK ")][0]
+        fired = json.loads(line[3:])
+        assert fired, "kill rule never fired"
+        # The firing domains are lane domains (> 0): the faults really
+        # hit async-lane traffic, not the parent context.
+        assert all(entry[0] >= 1 for entry in fired), fired
+        reports.append(fired)
+    assert reports[0] == reports[1], reports
+
+
 def test_chaos_connect_refuse_exercises_retry():
     """Refused connections during the handshake take the typed retry
     classification: bounded backoff retries, counted in the metrics

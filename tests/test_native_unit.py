@@ -66,6 +66,77 @@ def test_bench_cli_smoke():
         assert re.search(r"^\s*\d+\s+\d+", out, re.M), (op, out)
 
 
+def test_tsan_async_engine_smoke():
+    """Skip-unless-built ThreadSanitizer smoke of the async engine —
+    lanes are a brand-new thread surface (queue handoff, Work
+    completion, shutdown joining mid-collective), so run a 2-rank
+    in-process battery of concurrent async collectives + bucketer +
+    shutdown-with-work-in-flight under the TSan flavor
+    (`make native SANITIZE=thread`). Any data-race report aborts the
+    child with TSan's exit code."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    prog = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        import gloo_tpu
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            with ctx.async_engine(lanes=2) as eng:
+                works = []
+                for i in range(6):
+                    x = np.full(4096 + i, float(rank + 1), np.float32)
+                    works.append(eng.allreduce_async(x))
+                b = gloo_tpu.GradientBucketer(eng, bucket_bytes=32 << 10)
+                for _ in range(12):
+                    b.add(np.full(2000, float(rank + 1), np.float32))
+                b.finish()
+                for w in works:
+                    w.wait()
+                eng.stats()
+                ctx.metrics()
+            # Shutdown with work genuinely in flight: rank 0 issues ops
+            # rank 1 never matches, then tears the engine down.
+            eng2 = ctx.async_engine(lanes=2, tag_base=0xEEE00)
+            leftovers = []
+            if rank == 0:
+                leftovers = [eng2.allreduce_async(
+                    np.ones(50000, np.float32)) for _ in range(3)]
+                time.sleep(0.1)
+            eng2.shutdown()
+            for w in leftovers:
+                try:
+                    w.wait(timeout=5)
+                except (gloo_tpu.IoError, gloo_tpu.Aborted):
+                    pass
+            return True
+
+        assert spawn(2, fn, timeout=120) == [True, True]
+        print("TSAN-SMOKE-OK")
+    """)
+    preloads = []
+    for name in ("libtsan.so", "libstdc++.so"):
+        p = subprocess.run(["g++", "-print-file-name=" + name],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{name} runtime not found beside g++")
+        preloads.append(p)
+    env = dict(os.environ, TPUCOLL_LIB=lib, TPUCOLL_SKIP_BUILD="1",
+               LD_PRELOAD=" ".join(preloads),
+               # halt_on_error: the first report fails the child
+               # immediately instead of letting a racy run "pass".
+               TSAN_OPTIONS="halt_on_error=1 exitcode=66")
+    result = subprocess.run([sys.executable, "-c", prog],
+                            capture_output=True, text=True, timeout=300,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-4000:])
+    assert "TSAN-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_asan_smoke():
     """Skip-unless-built AddressSanitizer smoke: when the sanitizer
     flavor exists (`make native SANITIZE=address`), run a small 2-rank
