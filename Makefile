@@ -1,10 +1,10 @@
-.PHONY: native native-cmake native-cc test clean postmortem-demo
+.PHONY: native native-cmake native-cc test check clean postmortem-demo
 
 # Build the native core. Prefers the CMake/Ninja build (full configure
 # checks, separate bench/test binaries); falls back to a plain
 # compiler-driver build of just libtpucoll.so when cmake is not
 # installed, so `pip install .` / `make native` work on minimal images.
-# SANITIZE=address|thread always takes the fallback path: sanitizer
+# SANITIZE=address|thread|undefined always takes the fallback path: sanitizer
 # flavors are a test-rig artifact of this cmake-less build (the cmake
 # build has TPUCOLL_OUTPUT_DIR for the same isolation).
 native:
@@ -23,10 +23,11 @@ native-cmake:
 # ---- fallback build (no cmake): mirrors csrc/CMakeLists.txt ----
 CXX ?= g++
 
-# Sanitizer flavors: `make SANITIZE=address` (or thread) compiles the
-# whole core with -fsanitize=... into its own build dir and a SUFFIXED
-# library (libtpucoll_asan.so / libtpucoll_tsan.so) so instrumented
-# builds never clobber — or get clobbered by — the production .so.
+# Sanitizer flavors: `make SANITIZE=address` (or thread, undefined)
+# compiles the whole core with -fsanitize=... into its own build dir and
+# a SUFFIXED library (libtpucoll_asan.so / libtpucoll_tsan.so /
+# libtpucoll_ubsan.so) so instrumented builds never clobber — or get
+# clobbered by — the production .so.
 # Run the Python suite against one with
 #   TPUCOLL_LIB=$PWD/gloo_tpu/_native/libtpucoll_asan.so \
 #   TPUCOLL_SKIP_BUILD=1 python -m pytest tests/ ...
@@ -43,8 +44,14 @@ SAN_SUFFIX := _tsan
 # such mutex false-positives as "double lock" (GCC PR98624).
 SAN_FLAGS := -fsanitize=thread -fno-omit-frame-pointer \
 	-include csrc/tpucoll/common/tsan_preinclude.h
+else ifeq ($(SANITIZE),undefined)
+SAN_SUFFIX := _ubsan
+# -fno-sanitize-recover=all: a UB report aborts the process instead of
+# printing and carrying on, so the smoke test fails on the FIRST hit.
+SAN_FLAGS := -fsanitize=undefined -fno-sanitize-recover=all \
+	-fno-omit-frame-pointer
 else ifneq ($(SANITIZE),)
-$(error SANITIZE must be 'address' or 'thread', got '$(SANITIZE)')
+$(error SANITIZE must be 'address', 'thread' or 'undefined', got '$(SANITIZE)')
 endif
 
 FB_BUILD := build-fb$(subst _,-,$(SAN_SUFFIX))
@@ -109,6 +116,14 @@ $(FB_BUILD)/%.o: csrc/%.cc
 test: native
 	python -m pytest tests/ -x -q
 
+# Static-analysis suite (docs/check.md): the project-native invariants —
+# C-ABI mirroring, exception tightness, env hygiene, explicit atomics,
+# flightrec coverage, metrics name agreement, lock-order discipline,
+# no bare asserts. `make check JSON=report.json` also writes the
+# machine-readable report CI annotations consume.
+check:
+	python -m tools.check $(if $(JSON),--json $(JSON))
+
 # Post-mortem walkthrough (docs/flightrec.md): inject a stall with the
 # fault plane, let the watchdog auto-dump the always-on flight recorder,
 # provoke a schedule desync, then merge the per-rank dumps and print the
@@ -117,4 +132,5 @@ postmortem-demo: native
 	JAX_PLATFORMS=cpu python examples/example_flightrec.py
 
 clean:
-	rm -rf build build-fb build-fb-asan build-fb-tsan gloo_tpu/_native/*.so
+	rm -rf build build-fb build-fb-asan build-fb-tsan build-fb-ubsan \
+		gloo_tpu/_native/*.so

@@ -70,6 +70,51 @@ int wrap(Fn&& fn) {
   }
 }
 
+
+// Handle-factory boundary: nullptr + tc_last_error on failure — the
+// handle-returning mirror of wrap().
+template <typename Fn>
+void* wrapPtr(Fn&& fn) {
+  try {
+    return fn();
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return nullptr;
+  } catch (...) {
+    g_lastError = "unknown error";
+    return nullptr;
+  }
+}
+
+// Value-returning boundary: `fallback` + tc_last_error on failure, for
+// introspection entries whose return channel has no error code.
+template <typename T, typename Fn>
+T wrapVal(T fallback, Fn&& fn) {
+  try {
+    return fn();
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+    return fallback;
+  } catch (...) {
+    g_lastError = "unknown error";
+    return fallback;
+  }
+}
+
+// Void boundary (teardown/config entries): failures land in
+// tc_last_error and are swallowed — a free/abort path has no error
+// channel, and an exception crossing the C ABI aborts the process.
+template <typename Fn>
+void wrapVoid(Fn&& fn) {
+  try {
+    fn();
+  } catch (const std::exception& e) {
+    g_lastError = e.what();
+  } catch (...) {
+    g_lastError = "unknown error";
+  }
+}
+
 std::chrono::milliseconds ms(int64_t v) {
   return std::chrono::milliseconds(v);
 }
@@ -198,49 +243,51 @@ const char* tc_last_error() { return g_lastError.c_str(); }
 // ---- stores ----
 
 void* tc_hash_store_new() {
-  return new StoreHandle(std::make_shared<tpucoll::HashStore>());
+  return wrapPtr([&]() -> void* {
+    return new StoreHandle(std::make_shared<tpucoll::HashStore>());
+  });
 }
 
 void* tc_file_store_new(const char* path) {
-  try {
+  return wrapPtr([&]() -> void* {
     return new StoreHandle(std::make_shared<tpucoll::FileStore>(path));
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  });
 }
 
 void* tc_prefix_store_new(void* base, const char* prefix) {
-  return new StoreHandle(
-      std::make_shared<tpucoll::PrefixStore>(*asStore(base), prefix));
+  return wrapPtr([&]() -> void* {
+    return new StoreHandle(
+        std::make_shared<tpucoll::PrefixStore>(*asStore(base), prefix));
+  });
 }
 
-void tc_store_free(void* store) { delete asStore(store); }
+void tc_store_free(void* store) {
+  wrapVoid([&] { delete asStore(store); });
+}
 
 void* tc_tcp_store_server_new(const char* host, uint16_t port) {
-  try {
+  return wrapPtr([&]() -> void* {
     return new tpucoll::TcpStoreServer(host, port);
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  });
 }
 
 uint16_t tc_tcp_store_server_port(void* server) {
-  return static_cast<tpucoll::TcpStoreServer*>(server)->port();
+  return wrapVal<uint16_t>(0, [&] {
+    return static_cast<tpucoll::TcpStoreServer*>(server)->port();
+  });
 }
 
 void tc_tcp_store_server_free(void* server) {
-  delete static_cast<tpucoll::TcpStoreServer*>(server);
+  wrapVoid([&] {
+    delete static_cast<tpucoll::TcpStoreServer*>(server);
+  });
 }
 
 void* tc_tcp_store_new(const char* host, uint16_t port) {
-  try {
-    return new StoreHandle(std::make_shared<tpucoll::TcpStore>(host, port));
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  return wrapPtr([&]() -> void* {
+    return new StoreHandle(
+        std::make_shared<tpucoll::TcpStore>(host, port));
+  });
 }
 
 int tc_store_set(void* store, const char* key, const uint8_t* data,
@@ -263,7 +310,7 @@ int tc_store_get(void* store, const char* key, int64_t timeoutMs,
   });
 }
 
-void tc_buf_free(uint8_t* buf) { free(buf); }
+void tc_buf_free(uint8_t* buf) { wrapVoid([&] { free(buf); }); }
 
 int tc_store_add(void* store, const char* key, int64_t delta,
                  int64_t* result) {
@@ -275,7 +322,7 @@ int tc_store_add(void* store, const char* key, int64_t delta,
 void* tc_device_new(const char* hostname, uint16_t port,
                     const char* authKey, int encrypt, const char* iface,
                     int busyPoll, const char* engine, const char* keyring) {
-  try {
+  return wrapPtr([&]() -> void* {
     tpucoll::transport::DeviceAttr attr;
     if (hostname != nullptr && hostname[0] != '\0') {
       attr.hostname = hostname;
@@ -296,10 +343,7 @@ void* tc_device_new(const char* hostname, uint16_t port,
       attr.engine = engine;
     }
     return new DeviceHandle(std::make_shared<Device>(attr));
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  });
 }
 
 // Launcher-side helper: derive rank `rank`'s serialized keyring from the
@@ -320,7 +364,9 @@ int tc_derive_keyring(const char* rootKey, int rank, int size,
   });
 }
 
-void tc_device_free(void* dev) { delete asDevice(dev); }
+void tc_device_free(void* dev) {
+  wrapVoid([&] { delete asDevice(dev); });
+}
 
 // Event-engine submission counters (loop.h Loop::EngineStats): uring
 // reports io_uring_enter syscalls / SQEs submitted / CQEs drained since
@@ -328,20 +374,26 @@ void tc_device_free(void* dev) { delete asDevice(dev); }
 // submission evidence (readiness engines pay >=1 syscall per I/O op).
 void tc_device_engine_stats(void* dev, uint64_t* enters, uint64_t* sqes,
                             uint64_t* cqes) {
-  const auto s = (*asDevice(dev))->loop()->engineStats();
-  *enters = s.enters;
-  *sqes = s.sqes;
-  *cqes = s.cqes;
+  wrapVoid([&] {
+    const auto s = (*asDevice(dev))->loop()->engineStats();
+    *enters = s.enters;
+    *sqes = s.sqes;
+    *cqes = s.cqes;
+  });
 }
 
 // Engine introspection: lets callers pick engine="uring" only where the
 // kernel/sandbox supports it (an explicit uring request throws otherwise).
 // AEAD bulk tier this process dispatches to (crypto.h aeadIsaTier):
 // 2 = fused AVX-512, 1 = AVX2, 0 = scalar.
-int tc_crypto_isa_tier() { return tpucoll::aeadIsaTier(); }
+int tc_crypto_isa_tier() {
+  return wrapVal(0, [&] { return tpucoll::aeadIsaTier(); });
+}
 
 int tc_uring_available() {
-  return tpucoll::transport::uringAvailable() ? 1 : 0;
+  return wrapVal(0, [&] {
+    return tpucoll::transport::uringAvailable() ? 1 : 0;
+  });
 }
 
 // Structured connect diagnostics hook (reference: tcp/debug_data.h +
@@ -353,27 +405,26 @@ typedef void (*tc_connect_logger_fn)(int selfRank, int peerRank,
                                      const char* error);
 
 void tc_set_connect_debug_logger(tc_connect_logger_fn cb) {
-  if (cb == nullptr) {
-    tpucoll::setConnectDebugLogger(nullptr);
-    return;
-  }
-  tpucoll::setConnectDebugLogger([cb](const tpucoll::ConnectDebugData& d) {
-    cb(d.selfRank, d.peerRank, d.remote.c_str(), d.local.c_str(),
-       d.attempt, d.ok ? 1 : 0, d.willRetry ? 1 : 0, d.error.c_str());
+  wrapVoid([&] {
+    if (cb == nullptr) {
+      tpucoll::setConnectDebugLogger(nullptr);
+      return;
+    }
+    tpucoll::setConnectDebugLogger(
+        [cb](const tpucoll::ConnectDebugData& d) {
+          cb(d.selfRank, d.peerRank, d.remote.c_str(), d.local.c_str(),
+             d.attempt, d.ok ? 1 : 0, d.willRetry ? 1 : 0,
+             d.error.c_str());
+        });
   });
 }
 
 void* tc_context_new(int rank, int size) {
-  try {
-    return new Context(rank, size);
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  return wrapPtr([&]() -> void* { return new Context(rank, size); });
 }
 
 void tc_context_set_timeout(void* ctx, int64_t timeoutMs) {
-  asContext(ctx)->setTimeout(ms(timeoutMs));
+  wrapVoid([&] { asContext(ctx)->setTimeout(ms(timeoutMs)); });
 }
 
 int tc_context_connect(void* ctx, void* store, void* device) {
@@ -390,22 +441,34 @@ int tc_context_close(void* ctx) {
   return wrap([&] { asContext(ctx)->close(); });
 }
 
-void tc_context_free(void* ctx) { delete asContext(ctx); }
-
-uint64_t tc_next_slot(void* ctx, uint32_t num) {
-  return asContext(ctx)->nextSlot(num);
+void tc_context_free(void* ctx) {
+  wrapVoid([&] { delete asContext(ctx); });
 }
 
-void tc_debug_dump(void* ctx) { asContext(ctx)->transport()->debugDump(); }
+uint64_t tc_next_slot(void* ctx, uint32_t num) {
+  return wrapVal<uint64_t>(0, [&] {
+    return asContext(ctx)->nextSlot(num);
+  });
+}
+
+void tc_debug_dump(void* ctx) {
+  wrapVoid([&] { asContext(ctx)->transport()->debugDump(); });
+}
 
 void tc_context_shm_stats(void* ctx, uint64_t* txBytes, uint64_t* rxBytes,
                           int* activePairs) {
-  asContext(ctx)->transport()->shmStats(txBytes, rxBytes, activePairs);
+  wrapVoid([&] {
+    asContext(ctx)->transport()->shmStats(txBytes, rxBytes, activePairs);
+  });
 }
 
-void tc_trace_start(void* ctx) { asContext(ctx)->tracer().start(); }
+void tc_trace_start(void* ctx) {
+  wrapVoid([&] { asContext(ctx)->tracer().start(); });
+}
 
-void tc_trace_stop(void* ctx) { asContext(ctx)->tracer().stop(); }
+void tc_trace_stop(void* ctx) {
+  wrapVoid([&] { asContext(ctx)->tracer().stop(); });
+}
 
 // Returns a malloc'd JSON string (Chrome trace-event format); caller frees
 // with tc_buf_free.
@@ -425,17 +488,21 @@ int tc_trace_json(void* ctx, uint8_t** out, size_t* outLen) {
 // ---- metrics ----
 
 void tc_metrics_enable(void* ctx, int on) {
-  asContext(ctx)->metrics().setEnabled(on != 0);
+  wrapVoid([&] { asContext(ctx)->metrics().setEnabled(on != 0); });
 }
 
 int tc_metrics_enabled(void* ctx) {
-  return asContext(ctx)->metrics().enabled() ? 1 : 0;
+  return wrapVal(0, [&] {
+    return asContext(ctx)->metrics().enabled() ? 1 : 0;
+  });
 }
 
 // Straggler watchdog threshold; <= 0 disables. Overrides the
 // TPUCOLL_WATCHDOG_MS environment default for this context.
 void tc_metrics_set_watchdog(void* ctx, int64_t thresholdMs) {
-  asContext(ctx)->metrics().setWatchdogUs(thresholdMs * 1000);
+  wrapVoid([&] {
+    asContext(ctx)->metrics().setWatchdogUs(thresholdMs * 1000);
+  });
 }
 
 // Returns a malloc'd JSON object (see Metrics::toJson); caller frees with
@@ -481,14 +548,16 @@ int tc_flightrec_dump(void* ctx, const char* path) {
 
 // Next per-context collective sequence number (== ops recorded so far).
 uint64_t tc_flightrec_seq(void* ctx) {
-  return asContext(ctx)->flightrec().nextSeq();
+  return wrapVal<uint64_t>(0, [&] {
+    return asContext(ctx)->flightrec().nextSeq();
+  });
 }
 
 // Opt-in fatal-signal dumping (SIGSEGV/SIGABRT/SIGBUS/SIGFPE/SIGILL/
 // SIGTERM -> dump every live recorder to TPUCOLL_FLIGHTREC_DIR, then
 // re-raise). Also installable via TPUCOLL_FLIGHTREC_SIGNALS=1.
 void tc_flightrec_install_signal_handler() {
-  tpucoll::FlightRecorder::installSignalHandler();
+  wrapVoid([&] { tpucoll::FlightRecorder::installSignalHandler(); });
 }
 
 // ---- collective autotuning plane (tuning/) ----
@@ -568,7 +637,9 @@ int tc_fault_install(const char* json) {
 
 // Remove the installed schedule; the transport hot path returns to its
 // single armed() pointer check costing nothing.
-void tc_fault_clear() { tpucoll::fault::clear(); }
+void tc_fault_clear() {
+  wrapVoid([&] { tpucoll::fault::clear(); });
+}
 
 // Deterministic firing log as a JSON array (malloc'd; free with
 // tc_buf_free). Same seed + schedule + per-rank workload => the
@@ -861,20 +932,21 @@ int tc_async_shutdown(void* eng) {
   return wrap([&] { asEngine(eng)->shutdown(); });
 }
 
-void tc_async_free(void* eng) { delete asEngine(eng); }
+void tc_async_free(void* eng) {
+  wrapVoid([&] { delete asEngine(eng); });
+}
 
-int tc_async_lanes(void* eng) { return asEngine(eng)->lanes(); }
+int tc_async_lanes(void* eng) {
+  return wrapVal(0, [&] { return asEngine(eng)->lanes(); });
+}
 
 // Borrowed handle to lane `lane`'s forked sub-context, usable with the
 // introspection entry points (tc_metrics_json / tc_flightrec_json /
 // tc_flightrec_dump). Owned by the engine — never tc_context_free it.
 void* tc_async_lane_context(void* eng, int lane) {
-  try {
+  return wrapPtr([&]() -> void* {
     return asEngine(eng)->laneContext(lane);
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  });
 }
 
 // Engine counters: {"lanes","in_flight","submitted","completed",
@@ -933,7 +1005,10 @@ int tc_work_wait(void* work, int64_t timeoutMs) {
 // Non-blocking status probe: 0 queued, 1 running, 2 completed ok,
 // 3 completed with error (the error itself surfaces at tc_work_wait).
 int tc_work_status(void* work) {
-  return static_cast<int>((*asWork(work))->status());
+  // -1 (with tc_last_error set) when the probe itself fails.
+  return wrapVal(-1, [&] {
+    return static_cast<int>((*asWork(work))->status());
+  });
 }
 
 // Error message of a failed work ("" when none / not finished); malloc'd,
@@ -944,22 +1019,23 @@ int tc_work_error_message(void* work, uint8_t** out, size_t* outLen) {
   });
 }
 
-void tc_work_free(void* work) { delete asWork(work); }
+void tc_work_free(void* work) {
+  wrapVoid([&] { delete asWork(work); });
+}
 
 // ---- point-to-point ----
 
 void* tc_buffer_new(void* ctx, void* ptr, size_t size) {
-  try {
+  return wrapPtr([&]() -> void* {
     return asContext(ctx)->createUnboundBuffer(ptr, size).release();
-  } catch (const std::exception& e) {
-    g_lastError = e.what();
-    return nullptr;
-  }
+  });
 }
 
 void tc_buffer_free(void* buf) {
-  frErase(buf);
-  delete asBuffer(buf);
+  wrapVoid([&] {
+    frErase(buf);
+    delete asBuffer(buf);
+  });
 }
 
 int tc_buffer_send(void* buf, int dst, uint64_t slot, size_t offset,
@@ -1095,7 +1171,9 @@ int tc_buffer_wait_recv(void* buf, int64_t timeoutMs, int* srcOut) {
 }
 
 size_t tc_remote_key_size() {
-  return sizeof(tpucoll::transport::WireRemoteKey);
+  return wrapVal<size_t>(0, [&] {
+    return sizeof(tpucoll::transport::WireRemoteKey);
+  });
 }
 
 int tc_buffer_remote_key(void* buf, char* out, size_t outLen) {
@@ -1129,11 +1207,11 @@ int tc_buffer_get(void* buf, const char* key, size_t keyLen, uint64_t slot,
 }
 
 void tc_buffer_abort_wait_send(void* buf) {
-  asBuffer(buf)->abortWaitSend();
+  wrapVoid([&] { asBuffer(buf)->abortWaitSend(); });
 }
 
 void tc_buffer_abort_wait_recv(void* buf) {
-  asBuffer(buf)->abortWaitRecv();
+  wrapVoid([&] { asBuffer(buf)->abortWaitRecv(); });
 }
 
 }  // extern "C"

@@ -599,15 +599,12 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
   // arms were swept on this deployment, and the TPUCOLL_HD_NP2_CROSSOVER
   // byte threshold is the untuned fallback.
   bool useBlocks;
-  const char* env = std::getenv("TPUCOLL_HD_NP2");
-  if (env != nullptr && std::strcmp(env, "blocks") == 0) {
+  const char* env =
+      envChoice("TPUCOLL_HD_NP2", "auto", {"blocks", "fold", "auto"});
+  if (std::strcmp(env, "blocks") == 0) {
     useBlocks = true;
-  } else if (env != nullptr && std::strcmp(env, "fold") == 0) {
+  } else if (std::strcmp(env, "fold") == 0) {
     useBlocks = false;
-  } else if (env != nullptr && *env != '\0' &&
-             std::strcmp(env, "auto") != 0) {
-    TC_THROW(EnforceError, "TPUCOLL_HD_NP2 must be blocks|fold|auto, got: ",
-             env);
   } else if (auto tuned = tuning::tableHdUseBlocks(ctx, count * elsize)) {
     useBlocks = *tuned;
   } else {

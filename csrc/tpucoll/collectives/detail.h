@@ -82,17 +82,15 @@ enum class RecvReduceMode { kOff, kAuto, kForce };
 
 inline RecvReduceMode recvReduceMode() {
   static const RecvReduceMode mode = [] {
-    const char* v = std::getenv("TPUCOLL_RECV_REDUCE");
-    if (v == nullptr || *v == '\0' || std::strcmp(v, "auto") == 0) {
-      return RecvReduceMode::kAuto;
-    }
+    const char* v =
+        envChoice("TPUCOLL_RECV_REDUCE", "auto", {"0", "1", "auto"});
     if (std::strcmp(v, "0") == 0) {
       return RecvReduceMode::kOff;
     }
     if (std::strcmp(v, "1") == 0) {
       return RecvReduceMode::kForce;
     }
-    TC_THROW(EnforceError, "TPUCOLL_RECV_REDUCE must be 0|1|auto, got: ", v);
+    return RecvReduceMode::kAuto;
   }();
   return mode;
 }

@@ -7,6 +7,7 @@
 #include <immintrin.h>
 #endif
 
+#include "tpucoll/common/env.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/common/poly1305_impl.h"
 
@@ -222,8 +223,9 @@ bool avx512Usable() {
     if (!__builtin_cpu_supports("avx512f")) {
       return false;
     }
-    const char* e = std::getenv("TPUCOLL_NO_AVX512");
-    return e == nullptr || std::strcmp(e, "0") == 0;
+    // Strict flag (common/env.h): only 0/1 parse; historically any
+    // non-"0" value disabled the tier.
+    return !envFlag("TPUCOLL_NO_AVX512", false);
   }();
   return v;
 }

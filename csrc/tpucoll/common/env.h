@@ -1,14 +1,21 @@
 // Strict environment-knob parsing, shared by every layer that reads a
-// numeric TPUCOLL_* variable. Hoisted from collectives/detail.h so the
+// TPUCOLL_* variable. Hoisted from collectives/detail.h so the
 // transport knobs (shm ring/threshold, stash watermark, channel striping,
 // loop-thread pool) get the same contract the schedule crossovers already
 // have: accept plain digit strings only, throw EnforceError on anything
 // else. atoll-style parsing swallows garbage ("8MB" -> 8, "-1" -> huge
 // size_t) — exactly the misconfigurations a tuning knob must catch loudly.
+//
+// This header is the ONLY sanctioned caller of getenv in the core;
+// tools/check enforces that (rule env-hygiene, docs/check.md), and the
+// full knob matrix lives in docs/env.md.
 #pragma once
 
 #include <cerrno>
 #include <cstdlib>
+#include <cstring>
+#include <initializer_list>
+#include <sstream>
 
 #include "tpucoll/common/logging.h"
 
@@ -48,6 +55,57 @@ inline long envCount(const char* name, long dflt, long lo, long hi) {
   TC_ENFORCE(parsed >= lo && parsed <= hi, name, " must be in [", lo, ", ",
              hi, "], got: ", v);
   return static_cast<long>(parsed);
+}
+
+// String knob (paths, directory names): nullptr when unset or empty.
+// No validation here — a path's validity is the call site's contract —
+// but routing the read through this header keeps the env surface in one
+// place (and under the env-hygiene check).
+inline const char* envString(const char* name) {
+  const char* v = std::getenv(name);
+  return (v != nullptr && *v != '\0') ? v : nullptr;
+}
+
+// Boolean knob: unset/empty -> default, "0" -> false, "1" -> true,
+// anything else throws. The historical lenient readings ("any set value
+// means on", "anything but 0 means on") let TPUCOLL_SHM=false silently
+// mean *enabled*; a flag knob must be unambiguous.
+inline bool envFlag(const char* name, bool dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') {
+    return dflt;
+  }
+  if (std::strcmp(v, "0") == 0) {
+    return false;
+  }
+  if (std::strcmp(v, "1") == 0) {
+    return true;
+  }
+  TC_THROW(EnforceError, name, " must be 0 or 1, got: ", v);
+}
+
+// Enumerated knob: the value must be one of `allowed` (unset/empty ->
+// `dflt`, which need not be listed — e.g. an internal "auto"). Keeps
+// every mode switch (engine selection, schedule overrides) from
+// silently running the wrong arm on a typo.
+inline const char* envChoice(const char* name, const char* dflt,
+                             std::initializer_list<const char*> allowed) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') {
+    return dflt;
+  }
+  for (const char* a : allowed) {
+    if (std::strcmp(v, a) == 0) {
+      return v;
+    }
+  }
+  std::ostringstream want;
+  bool first = true;
+  for (const char* a : allowed) {
+    want << (first ? "" : "|") << a;
+    first = false;
+  }
+  TC_THROW(EnforceError, name, " must be ", want.str(), ", got: ", v);
 }
 
 }  // namespace tpucoll

@@ -1,4 +1,5 @@
 #include "tpucoll/common/flightrec.h"
+#include "tpucoll/common/env.h"
 
 #include <fcntl.h>
 #include <signal.h>
@@ -49,14 +50,10 @@ uint64_t fnv1a(uint64_t h, const void* data, size_t n) {
 }
 
 size_t capacityFromEnv() {
-  size_t cap = 1024;
-  const char* s = std::getenv("TPUCOLL_FLIGHTREC_EVENTS");
-  if (s != nullptr && s[0] != '\0') {
-    const long long v = atoll(s);
-    if (v > 0) {
-      cap = static_cast<size_t>(v);
-    }
-  }
+  // Strict count (common/env.h): atoll used to read "banana" as 0 and
+  // silently keep the default ring size.
+  const size_t cap = static_cast<size_t>(
+      envCount("TPUCOLL_FLIGHTREC_EVENTS", 1024, 1, 1 << 24));
   size_t pow2 = 8;
   while (pow2 < cap) {
     pow2 <<= 1;
@@ -94,7 +91,8 @@ void autoDumpPath(char* path, size_t n, const char* dir, int rank,
 }
 
 void fatalSignalHandler(int sig) {
-  if (!g_inHandler.exchange(true) && g_signalDir[0] != '\0') {
+  if (!g_inHandler.exchange(true, std::memory_order_seq_cst) &&
+      g_signalDir[0] != '\0') {
     for (int i = 0; i < kMaxRecorders; i++) {
       FlightRecorder* rec = g_recorders[i].load(std::memory_order_relaxed);
       if (rec == nullptr) {
@@ -157,7 +155,8 @@ FlightRecorder::FlightRecorder(int rank, int size)
   entries_.reset(new Entry[cap]);
   for (int i = 0; i < kMaxRecorders; i++) {
     FlightRecorder* expected = nullptr;
-    if (g_recorders[i].compare_exchange_strong(expected, this)) {
+    if (g_recorders[i].compare_exchange_strong(
+            expected, this, std::memory_order_seq_cst)) {
       slotIdx_ = i;
       break;
     }
@@ -329,8 +328,8 @@ bool FlightRecorder::dumpToFile(const char* path, const char* reason,
 }
 
 bool FlightRecorder::autoDump(const char* reason, int blamedPeer) {
-  const char* dir = std::getenv("TPUCOLL_FLIGHTREC_DIR");
-  if (dir == nullptr || dir[0] == '\0') {
+  const char* dir = envString("TPUCOLL_FLIGHTREC_DIR");
+  if (dir == nullptr) {
     return false;
   }
   // One-shot: the FIRST trigger is the evidence closest to the cause
@@ -353,10 +352,11 @@ bool FlightRecorder::autoDump(const char* reason, int blamedPeer) {
 
 void FlightRecorder::installSignalHandler() {
   bool expected = false;
-  if (!g_handlerInstalled.compare_exchange_strong(expected, true)) {
+  if (!g_handlerInstalled.compare_exchange_strong(
+          expected, true, std::memory_order_seq_cst)) {
     return;
   }
-  const char* dir = std::getenv("TPUCOLL_FLIGHTREC_DIR");
+  const char* dir = envString("TPUCOLL_FLIGHTREC_DIR");
   if (dir != nullptr) {
     snprintf(g_signalDir, sizeof(g_signalDir), "%s", dir);
     ::mkdir(g_signalDir, 0777);
@@ -371,8 +371,8 @@ void FlightRecorder::installSignalHandler() {
 }
 
 void FlightRecorder::maybeInstallFromEnv() {
-  const char* v = std::getenv("TPUCOLL_FLIGHTREC_SIGNALS");
-  if (v != nullptr && v[0] != '\0' && strcmp(v, "0") != 0) {
+  // Strict flag (common/env.h): only 0/1 parse.
+  if (envFlag("TPUCOLL_FLIGHTREC_SIGNALS", false)) {
     installSignalHandler();
   }
 }

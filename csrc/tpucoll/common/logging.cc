@@ -8,13 +8,15 @@
 #include <cstring>
 #include <mutex>
 
+#include "tpucoll/common/env.h"
+
 namespace tpucoll {
 
 namespace {
 
 LogLevel parseThreshold() {
-  const char* env = std::getenv("TPUCOLL_LOG_LEVEL");
-  if (env == nullptr || env[0] == '\0') {
+  const char* env = envString("TPUCOLL_LOG_LEVEL");
+  if (env == nullptr) {
     return LogLevel::kWarn;
   }
   if (strcasecmp(env, "debug") == 0 || strcmp(env, "0") == 0) {
@@ -27,7 +29,14 @@ LogLevel parseThreshold() {
       strcmp(env, "2") == 0) {
     return LogLevel::kWarn;
   }
-  return LogLevel::kError;
+  if (strcasecmp(env, "error") == 0 || strcmp(env, "3") == 0) {
+    return LogLevel::kError;
+  }
+  // Historically anything unrecognized silently meant ERROR — i.e. a
+  // typo'd TPUCOLL_LOG_LEVEL=debgu suppressed the very logs asked for.
+  TC_THROW(EnforceError,
+           "TPUCOLL_LOG_LEVEL must be debug|info|warn|error or 0-3, "
+           "got: ", env);
 }
 
 const char* levelName(LogLevel level) {

@@ -3,6 +3,7 @@
 #include <sstream>
 
 #include "tpucoll/common/json.h"
+#include "tpucoll/common/env.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/tracer.h"
 
@@ -76,9 +77,11 @@ void Metrics::Histogram::reset() {
 }
 
 Metrics::Metrics(int size) : size_(size), peers_(size) {
-  const char* ms = getenv("TPUCOLL_WATCHDOG_MS");
-  if (ms != nullptr && ms[0] != '\0') {
-    watchdogUs_.store(atoll(ms) * 1000, std::memory_order_relaxed);
+  // Strict count (common/env.h): atoll read "never" as 0 (watchdog
+  // off) — a typo must not silently disarm the straggler detector.
+  const long ms = envCount("TPUCOLL_WATCHDOG_MS", 0, 0, 1L << 40);
+  if (ms > 0) {
+    watchdogUs_.store(ms * 1000, std::memory_order_relaxed);
   }
 }
 

@@ -4,18 +4,15 @@
 #include <sstream>
 
 #include "tpucoll/common/metrics.h"
+#include "tpucoll/common/env.h"
 
 namespace tpucoll {
 
 size_t Tracer::capFromEnv() {
-  const char* s = std::getenv("TPUCOLL_TRACE_MAX_EVENTS");
-  if (s != nullptr && s[0] != '\0') {
-    const long long v = atoll(s);
-    if (v > 0) {
-      return static_cast<size_t>(v);
-    }
-  }
-  return 262144;
+  // Strict count (common/env.h): atoll used to read "-5"/"lots" as
+  // "keep the default" instead of failing the misconfiguration.
+  return static_cast<size_t>(
+      envCount("TPUCOLL_TRACE_MAX_EVENTS", 262144, 1, 1L << 31));
 }
 
 void Tracer::record(const Event& event) {

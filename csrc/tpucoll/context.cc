@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/env.h"
 #include "tpucoll/fault/fault.h"
 #include "tpucoll/tuning/tuning_table.h"
 #include "tpucoll/types.h"
@@ -18,6 +19,11 @@ Context::Context(int rank, int size)
   TC_ENFORCE(size > 0, "context size must be positive");
   TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
              size);
+  // Force the lazy TPUCOLL_LOG_LEVEL parse here, where the strict
+  // parser's throw crosses the wrapped C ABI as a typed error — the
+  // first organic log call can be on a loop thread, where an
+  // EnforceError would std::terminate instead.
+  logThreshold();
   // Bounded tracer (tracer.h): overflow drops are counted in the
   // registry instead of growing the event vector without limit.
   tracer_.setMetrics(&metrics_);
@@ -143,8 +149,8 @@ void Context::applyTransportHints() {
 }
 
 void Context::maybeLoadTuningFile() {
-  const char* path = std::getenv("TPUCOLL_TUNING_FILE");
-  if (path == nullptr || *path == '\0') {
+  const char* path = envString("TPUCOLL_TUNING_FILE");
+  if (path == nullptr) {
     return;
   }
   std::ifstream in(path, std::ios::binary);
@@ -156,7 +162,9 @@ void Context::maybeLoadTuningFile() {
 }
 
 uint64_t Context::nextSlot(uint32_t numToSkip) {
-  uint32_t base = slotCounter_.fetch_add(numToSkip);
+  // Relaxed: slot-range allocator — uniqueness only.
+  uint32_t base =
+      slotCounter_.fetch_add(numToSkip, std::memory_order_relaxed);
   return Slot::build(SlotPrefix::kUser, base).value();
 }
 

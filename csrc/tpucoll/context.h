@@ -125,7 +125,10 @@ class Context {
   // Monotonic generation counter namespacing each tune() election's
   // store keys. All ranks call tune() the same number of times (it is a
   // collective), so the generation agrees without store traffic.
-  uint64_t nextTuneGeneration() { return tuneGen_.fetch_add(1) + 1; }
+  uint64_t nextTuneGeneration() {
+    // Relaxed: generation-id allocator — uniqueness only.
+    return tuneGen_.fetch_add(1, std::memory_order_relaxed) + 1;
+  }
 
   // Rendezvous store this context bootstrapped over; null for forked
   // contexts (they exchange through the parent instead).

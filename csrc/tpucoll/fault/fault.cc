@@ -1,4 +1,5 @@
 #include "tpucoll/fault/fault.h"
+#include "tpucoll/common/env.h"
 
 #include <cstdlib>
 #include <fstream>
@@ -466,8 +467,8 @@ std::string report() {
 
 void maybeLoadEnvFile() {
   std::call_once(g_envOnce, [] {
-    const char* path = std::getenv("TPUCOLL_FAULT_FILE");
-    if (path == nullptr || *path == '\0') {
+    const char* path = envString("TPUCOLL_FAULT_FILE");
+    if (path == nullptr) {
       return;
     }
     std::ifstream in(path, std::ios::binary);

@@ -117,7 +117,9 @@ TcpStoreServer::TcpStoreServer(const std::string& host, uint16_t port) {
 }
 
 TcpStoreServer::~TcpStoreServer() {
-  stop_.store(true);
+  // Relaxed: pure exit flag — the dtor's thread join (not this
+  // store) is the synchronization point for the loop's effects.
+  stop_.store(true, std::memory_order_relaxed);
   // Unblock accept() and any server-side waits.
   ::shutdown(listenFd_, SHUT_RDWR);
   cv_.notify_all();
@@ -140,7 +142,7 @@ TcpStoreServer::~TcpStoreServer() {
 }
 
 void TcpStoreServer::acceptLoop() {
-  while (!stop_.load()) {
+  while (!stop_.load(std::memory_order_relaxed)) {
     int fd = accept4(listenFd_, nullptr, nullptr, SOCK_CLOEXEC);
     if (fd < 0) {
       if (errno == EINTR) {
@@ -156,7 +158,7 @@ void TcpStoreServer::acceptLoop() {
 }
 
 void TcpStoreServer::serveClient(int fd) {
-  while (!stop_.load()) {
+  while (!stop_.load(std::memory_order_relaxed)) {
     uint8_t op;
     uint32_t nkeys;
     if (!readValue(fd, &op) || !readValue(fd, &nkeys) || nkeys > 65536) {
@@ -223,7 +225,7 @@ void TcpStoreServer::serveClient(int fd) {
                         std::chrono::milliseconds(timeoutMs);
         std::unique_lock<std::mutex> lock(mu_);
         bool all = cv_.wait_until(lock, deadline, [&] {
-          if (stop_.load()) {
+          if (stop_.load(std::memory_order_relaxed)) {
             return true;
           }
           for (const auto& key : keys) {
@@ -233,7 +235,7 @@ void TcpStoreServer::serveClient(int fd) {
           }
           return true;
         });
-        if (!all || stop_.load()) {
+        if (!all || stop_.load(std::memory_order_relaxed)) {
           lock.unlock();
           ok = writeResponse(fd, kTimeout, {});
         } else {

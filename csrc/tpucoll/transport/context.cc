@@ -326,7 +326,11 @@ void resolveAbortedStripes(UnboundBuffer* buf,
                                  " aborted: a data channel refused "
                                  "the stripe"));
   const int missing = channels - enqueued;
-  if (st->remaining.fetch_sub(missing) == missing) {
+  // Acq-rel: the final decrementer completes the stripe and must
+  // observe every sibling channel's writes (error strings, landed
+  // payload); siblings' decrements must publish them.
+  if (st->remaining.fetch_sub(missing, std::memory_order_acq_rel) ==
+      missing) {
     // Copy under errMu: a sibling stripe's failure may be recording
     // concurrently.
     std::string msg;
@@ -497,6 +501,11 @@ std::list<Context::PostedRecv>::iterator Context::findPosted(int srcRank,
 void Context::landPayload(char* dest, RecvReduceFn combine,
                           size_t combineElsize, const char* data,
                           size_t nbytes) {
+  if (nbytes == 0) {
+    // Zero-byte payloads (barrier-style slots) may carry data ==
+    // nullptr; memcpy with a null pointer is UB even when n == 0.
+    return;
+  }
   if (combine != nullptr) {
     combine(dest, data, nbytes / combineElsize);
   } else {
@@ -574,7 +583,9 @@ void Context::postSendStriped(UnboundBuffer* buf, int dstRank,
                               uint64_t slot, char* data, size_t nbytes) {
   buf->addPendingSend();
   auto st = std::make_shared<StripeTx>(channels_);
-  const uint8_t seqLow = static_cast<uint8_t>(stripeSeq_.fetch_add(1));
+  // Relaxed: per-pair wire tag allocator — uniqueness only.
+  const uint8_t seqLow = static_cast<uint8_t>(
+      stripeSeq_.fetch_add(1, std::memory_order_relaxed));
   int enqueued = 0;
   try {
     for (int c = 0; c < channels_; c++) {

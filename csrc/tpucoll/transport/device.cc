@@ -27,6 +27,16 @@ int resolveNumLoops(int attrLoops) {
 
 Device::Device(const DeviceAttr& attr)
     : authKey_(attr.authKey), encrypt_(attr.encrypt) {
+  // Validate lazily-read global knobs before the loop threads exist:
+  // loop threads log, and encrypting pairs consult the AVX-512 kill
+  // switch from AEAD calls on the loop thread — a malformed value
+  // throwing inside a function-local static init there would terminate
+  // the process (or livelock the level-triggered loop) instead of
+  // surfacing as a typed error from this (wrapped) ctor. Validating
+  // here also makes TPUCOLL_NO_AVX512 uniformly strict: the lazy read
+  // is short-circuited away on hosts without AVX-512.
+  logThreshold();
+  envFlag("TPUCOLL_NO_AVX512", false);
   const int numLoops = resolveNumLoops(attr.numLoops);
   loops_.reserve(numLoops);
   for (int i = 0; i < numLoops; i++) {

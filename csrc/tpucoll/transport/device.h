@@ -77,7 +77,11 @@ class Device {
   }
   Listener* listener() { return listener_.get(); }
   const SockAddr& address() const { return listener_->address(); }
-  uint64_t nextPairId() { return pairId_.fetch_add(1); }
+  uint64_t nextPairId() {
+    // Relaxed: uniqueness is all that is needed from an id
+    // allocator; nothing is published through it.
+    return pairId_.fetch_add(1, std::memory_order_relaxed);
+  }
   const std::string& authKey() const { return authKey_; }
   const Keyring& keyring() const { return keyring_; }
   bool encrypt() const { return encrypt_; }

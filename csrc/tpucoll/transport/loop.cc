@@ -9,6 +9,7 @@
 #include <cstring>
 
 #include "tpucoll/common/logging.h"
+#include "tpucoll/common/env.h"
 #include "tpucoll/transport/loop_uring.h"
 
 namespace tpucoll {
@@ -55,7 +56,9 @@ void LoopBase::stopThread() {
   if (joined_ || !thread_.joinable()) {
     return;
   }
-  stop_.store(true);
+  // Relaxed: exit flag — wake() makes every sleeper re-check, and
+  // the join below is the synchronization point for loop effects.
+  stop_.store(true, std::memory_order_relaxed);
   wake();
   thread_.join();
   joined_ = true;
@@ -89,7 +92,7 @@ void LoopBase::barrier() {
   }
   wake();
   std::unique_lock<std::mutex> lock(mu_);
-  cv_.wait(lock, [&] { return tick_ >= target || stop_.load(); });
+  cv_.wait(lock, [&] { return tick_ >= target || stop_.load(std::memory_order_relaxed); });
 }
 
 bool LoopBase::onLoopThread() const {
@@ -155,7 +158,7 @@ void EpollLoop::del(int fd) {
 
 void EpollLoop::run() {
   epoll_event events[kMaxEvents];
-  while (!stop_.load()) {
+  while (!stop_.load(std::memory_order_relaxed)) {
     // Busy-poll mode never sleeps in the kernel: epoll_wait(0) returns
     // immediately and the pause keeps the spin hyperthread-friendly.
     int n = epoll_wait(epollFd_, events, kMaxEvents, busyPoll_ ? 0 : 100);
@@ -198,8 +201,9 @@ void EpollLoop::run() {
 std::unique_ptr<Loop> makeLoop(bool busyPoll, const std::string& engine) {
   std::string e = engine;
   if (e.empty()) {
-    const char* env = std::getenv("TPUCOLL_ENGINE");
-    e = env != nullptr ? env : "auto";
+    // Strict choice (common/env.h): a misspelled engine must not
+    // silently fall back to epoll and invalidate an A/B measurement.
+    e = envChoice("TPUCOLL_ENGINE", "auto", {"auto", "epoll", "uring"});
   }
   if (e == "auto" || e == "epoll" || e.empty()) {
     return std::make_unique<EpollLoop>(busyPoll);

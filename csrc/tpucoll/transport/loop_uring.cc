@@ -485,7 +485,9 @@ class UringLoop : public LoopBase {
 
   void run() override {
     bool dispatched = false;
-    while (!stop_.load()) {
+    // Relaxed: exit flag; the wake eventfd write makes the loop
+    // re-check, and join is the real synchronization point.
+    while (!stop_.load(std::memory_order_relaxed)) {
       Completion c{};
       bool have = false;
       {

@@ -14,6 +14,7 @@
 #include <thread>
 
 #include "tpucoll/common/debug.h"
+#include "tpucoll/common/env.h"
 #include "tpucoll/common/hmac.h"
 #include "tpucoll/fault/fault.h"
 #include "tpucoll/transport/context.h"
@@ -103,7 +104,9 @@ void Pair::deliverSendComplete(const TxDone& d) {
     }
     return;
   }
-  if (d.stripe->remaining.fetch_sub(1) == 1) {
+  // Acq-rel: the finalizing decrement must observe the other
+  // channels' writes; each decrement publishes its own.
+  if (d.stripe->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     finalizeStripe(d);
   }
 }
@@ -116,7 +119,7 @@ void Pair::deliverSendError(const TxDone& d, const std::string& msg) {
     return;
   }
   d.stripe->recordError(msg);
-  if (d.stripe->remaining.fetch_sub(1) == 1) {
+  if (d.stripe->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     finalizeStripe(d);
   }
 }
@@ -137,8 +140,10 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
   // the whole deadline on a misconfiguration.
   static constexpr int kMaxEofRetries = 3;
   const auto deadline = std::chrono::steady_clock::now() + timeout;
+  // Strict flag (common/env.h): historically "any set value" meant
+  // disabled, so =0 disabled retries too; now only 0/1 parse.
   const bool retriesDisabled =
-      std::getenv("TPUCOLL_DISABLE_CONNECTION_RETRIES") != nullptr;
+      envFlag("TPUCOLL_DISABLE_CONNECTION_RETRIES", false);
   int attempt = 0;
   int eofAttempts = 0;
   while (true) {
@@ -456,7 +461,7 @@ void Pair::assumeConnected(int fd, const ConnKeys& keys,
   bool accepted = false;
   {
     std::lock_guard<std::mutex> guard(mu_);
-    if (state_.load() == State::kInitializing) {
+    if (state_.load(std::memory_order_acquire) == State::kInitializing) {
       if (shm != nullptr) {
         shm_ = std::move(shm);
         shmTx_ = shm_->ring(shmInitiator ? 0 : 1);
@@ -466,10 +471,12 @@ void Pair::assumeConnected(int fd, const ConnKeys& keys,
                  peerRank_, " (", shm_->ringBytes() >> 20, " MiB/dir)");
       }
       keys_ = keys;
-      fd_ = fd;
+      // Release: connecting publishes the fields set above (keys_,
+      // shm rings, fd_) to lock-free acquire-loads of state_/fd_.
+      fd_.store(fd, std::memory_order_release);
       epollMask_ = EPOLLIN;
-      everConnected_.store(true);
-      state_.store(State::kConnected);
+      everConnected_.store(true, std::memory_order_release);
+      state_.store(State::kConnected, std::memory_order_release);
       if (dataPath_) {
         // Submission mode: no readiness poll; register for completions
         // and post the first header recv. Safe off the loop thread: no
@@ -491,13 +498,13 @@ void Pair::assumeConnected(int fd, const ConnKeys& keys,
 
 void Pair::waitConnected(std::chrono::milliseconds timeout) {
   std::unique_lock<std::mutex> lock(mu_);
-  auto pred = [&] { return state_.load() != State::kInitializing; };
+  auto pred = [&] { return state_.load(std::memory_order_acquire) != State::kInitializing; };
   if (!cv_.wait_for(lock, timeout, pred)) {
     TC_THROW(TimeoutException, "rank ", selfRank_,
              ": timed out connecting pair to rank ", peerRank_);
   }
-  State s = state_.load();
-  if (s != State::kConnected && !everConnected_.load()) {
+  State s = state_.load(std::memory_order_acquire);
+  if (s != State::kConnected && !everConnected_.load(std::memory_order_acquire)) {
     TC_THROW(IoException, "pair to rank ", peerRank_, " failed: ", error_);
   }
   // A pair that connected and already saw the peer depart counts as
@@ -757,7 +764,7 @@ void Pair::enqueue(TxOp op) {
   const size_t nbytes = op.nbytes;
   {
     std::lock_guard<std::mutex> guard(mu_);
-    State s = state_.load();
+    State s = state_.load(std::memory_order_acquire);
     if (s != State::kConnected || closing_) {
       TC_THROW(IoException, "send to rank ", peerRank_, ": pair ",
                s == State::kFailed ? error_
@@ -774,7 +781,7 @@ void Pair::enqueue(TxOp op) {
       // Inline fast path: try to push the bytes out right here, skipping a
       // loop-thread wakeup when the socket has room (the common case).
       flushTx(&completed);
-      if (state_.load() == State::kConnected && !tx_.empty()) {
+      if (state_.load(std::memory_order_acquire) == State::kConnected && !tx_.empty()) {
         updateEpollMask();
       }
     } else {
@@ -1004,7 +1011,7 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
 }
 
 void Pair::flushTx(std::vector<TxDone>* completed) {
-  if (fd_ < 0) {
+  if (fd_.load(std::memory_order_relaxed) < 0) {
     return;
   }
   while (true) {
@@ -1122,13 +1129,15 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
     for (;;) {
       ssize_t n;
       if (iovcnt == 1) {
-        n = ::send(fd_, iov[0].iov_base, iov[0].iov_len, MSG_NOSIGNAL);
+        n = ::send(fd_.load(std::memory_order_relaxed), iov[0].iov_base,
+                   iov[0].iov_len, MSG_NOSIGNAL);
       } else {
         msghdr msg{};
         msg.msg_iov = const_cast<iovec*>(iov);
         msg.msg_iovlen = static_cast<size_t>(iovcnt);
         // MSG_NOSIGNAL: broken pipes become errors, never SIGPIPE.
-        n = sendmsg(fd_, &msg, MSG_NOSIGNAL);
+        n = sendmsg(fd_.load(std::memory_order_relaxed), &msg,
+                    MSG_NOSIGNAL);
       }
       if (n < 0 && errno == EINTR) {
         continue;
@@ -1146,7 +1155,7 @@ ssize_t Pair::txWrite(TxSite site, const iovec* iov, int iovcnt) {
     errno = EAGAIN;
     return -1;
   }
-  loop_->asyncSend(fd_, iov, iovcnt);
+  loop_->asyncSend(fd_.load(std::memory_order_relaxed), iov, iovcnt);
   txInFlight_ = true;
   txSite_ = site;
   errno = EAGAIN;
@@ -1188,7 +1197,8 @@ void Pair::updateEpollMask() {
   if (dataPath_) {
     return;  // submissions replace readiness; nothing to arm
   }
-  if (fd_ < 0 || state_.load() != State::kConnected) {
+  if (fd_.load(std::memory_order_relaxed) < 0 ||
+      state_.load(std::memory_order_acquire) != State::kConnected) {
     return;
   }
   // EPOLLOUT only when socket progress is possible: a front op parked on
@@ -1199,19 +1209,19 @@ void Pair::updateEpollMask() {
   uint32_t desired = (rxPaused_ ? 0u : uint32_t(EPOLLIN)) |
                      (txWants ? uint32_t(EPOLLOUT) : 0u);
   if (desired != epollMask_) {
-    loop_->mod(fd_, desired, this);
+    loop_->mod(fd_.load(std::memory_order_relaxed), desired, this);
     epollMask_ = desired;
   }
 }
 
 void Pair::handleEvents(uint32_t events) {
-  if (state_.load() != State::kConnected) {
+  if (state_.load(std::memory_order_acquire) != State::kConnected) {
     return;
   }
   if (events & (EPOLLIN | EPOLLERR | EPOLLHUP)) {
     readLoop();
   }
-  if (state_.load() != State::kConnected) {
+  if (state_.load(std::memory_order_acquire) != State::kConnected) {
     return;
   }
   if (events & EPOLLOUT) {
@@ -1220,7 +1230,7 @@ void Pair::handleEvents(uint32_t events) {
     {
       std::lock_guard<std::mutex> guard(mu_);
       flushTx(&completed);
-      if (state_.load() == State::kConnected) {
+      if (state_.load(std::memory_order_acquire) == State::kConnected) {
         updateEpollMask();
       }
       txError = pendingTxError_;
@@ -1387,7 +1397,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
         queueCtrl(Opcode::kShmCredit);
       }
       flushTx(&completed);
-      if (state_.load() == State::kConnected) {
+      if (state_.load(std::memory_order_acquire) == State::kConnected) {
         updateEpollMask();
       }
       txError = pendingTxError_;
@@ -1539,7 +1549,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
         std::lock_guard<std::mutex> guard(mu_);
         queueCtrl(Opcode::kShmCredit);
         flushTx(&completed);
-        if (state_.load() == State::kConnected) {
+        if (state_.load(std::memory_order_acquire) == State::kConnected) {
           updateEpollMask();
         }
         txError = pendingTxError_;
@@ -1744,15 +1754,16 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
 }
 
 void Pair::maybePostRecvLocked() {
-  if (!dataPath_ || rxPosted_ || fd_ < 0 ||
-      state_.load() != State::kConnected) {
+  if (!dataPath_ || rxPosted_ ||
+      fd_.load(std::memory_order_relaxed) < 0 ||
+      state_.load(std::memory_order_acquire) != State::kConnected) {
     return;
   }
   if (rxPaused_ && !rxInPayload_) {
     return;  // boundary pause; resumeReading reposts
   }
   RxWant w = rxWant();
-  loop_->asyncRecv(fd_, w.ptr, w.len);
+  loop_->asyncRecv(fd_.load(std::memory_order_relaxed), w.ptr, w.len);
   rxPosted_ = true;
 }
 
@@ -1763,7 +1774,7 @@ void Pair::handleIoComplete(bool isRecv, int32_t res) {
     // posting a recv computed from cursors processRxBytes is mutating
     // lock-free below. Clear it only at the repost decision points,
     // under mu_, in the same critical section as the repost check.
-    if (state_.load() != State::kConnected) {
+    if (state_.load(std::memory_order_acquire) != State::kConnected) {
       std::lock_guard<std::mutex> guard(mu_);
       rxPosted_ = false;
       return;
@@ -1826,7 +1837,7 @@ void Pair::handleIoComplete(bool isRecv, int32_t res) {
   {
     std::lock_guard<std::mutex> guard(mu_);
     txInFlight_ = false;
-    if (state_.load() != State::kConnected) {
+    if (state_.load(std::memory_order_acquire) != State::kConnected) {
       return;
     }
     if (res < 0) {
@@ -1861,7 +1872,7 @@ void Pair::readLoop() {
   // loop. Level-triggered epoll re-fires if data remains.
   constexpr size_t kReadBudget = 8u << 20;
   size_t consumed = 0;
-  while (state_.load() == State::kConnected) {
+  while (state_.load(std::memory_order_acquire) == State::kConnected) {
     if (consumed >= kReadBudget) {
       return;
     }
@@ -1874,7 +1885,8 @@ void Pair::readLoop() {
       }
     }
     RxWant w = rxWant();
-    ssize_t n = read(fd_, w.ptr, w.len);
+    ssize_t n = read(fd_.load(std::memory_order_relaxed), w.ptr,
+                     w.len);
     if (n == 0) {
       onRxEof();
       return;
@@ -2041,8 +2053,13 @@ std::string Pair::debugState() {
   std::lock_guard<std::mutex> guard(mu_);
   std::string s = "txq=" + std::to_string(tx_.size());
   if (shmActive_.load(std::memory_order_relaxed)) {
-    s += " shm[tx=" + std::to_string(shmTxBytes_.load() >> 10) +
-         "KB rx=" + std::to_string(shmRxBytes_.load() >> 10) + "KB";
+    s += " shm[tx=" +
+         std::to_string(
+             shmTxBytes_.load(std::memory_order_relaxed) >> 10) +
+         "KB rx=" +
+         std::to_string(
+             shmRxBytes_.load(std::memory_order_relaxed) >> 10) +
+         "KB";
     if (txRingBlocked_) {
       s += " RING-BLOCKED";  // waiting on a kShmCredit wakeup
     }
@@ -2088,7 +2105,7 @@ void Pair::close() {
   std::vector<TxDone> completed;
   {
     std::unique_lock<std::mutex> lock(mu_);
-    if (state_.load() == State::kConnected && !closing_) {
+    if (state_.load(std::memory_order_acquire) == State::kConnected && !closing_) {
       closing_ = true;
       TxOp op;
       op.header = WireHeader{kMsgMagic,
@@ -2103,13 +2120,14 @@ void Pair::close() {
       pendingTxError_.clear();
       const auto deadline = std::chrono::steady_clock::now() + kGrace;
       cv_.wait_until(lock, deadline, [&] {
-        return tx_.empty() || state_.load() != State::kConnected;
+        return tx_.empty() || state_.load(std::memory_order_acquire) != State::kConnected;
       });
-      if (fd_ >= 0) {
-        ::shutdown(fd_, SHUT_WR);
+      const int sfd = fd_.load(std::memory_order_relaxed);
+      if (sfd >= 0) {
+        ::shutdown(sfd, SHUT_WR);
       }
       cv_.wait_until(lock, deadline, [&] {
-        return peerGoodbye_ || state_.load() != State::kConnected;
+        return peerGoodbye_ || state_.load(std::memory_order_acquire) != State::kConnected;
       });
     }
   }
@@ -2126,14 +2144,14 @@ void Pair::teardown(State target, const std::string& message,
   int fd = -1;
   {
     std::lock_guard<std::mutex> guard(mu_);
-    State s = state_.load();
+    State s = state_.load(std::memory_order_acquire);
     if (s == State::kFailed || s == State::kClosed) {
       return;
     }
-    state_.store(target);
+    state_.store(target, std::memory_order_release);
     error_ = message;
-    fd = fd_;
-    fd_ = -1;
+    fd = fd_.load(std::memory_order_relaxed);
+    fd_.store(-1, std::memory_order_release);
   }
   cv_.notify_all();
   if (expectedAt_ != nullptr) {

@@ -321,6 +321,10 @@ class Pair : public Handler {
   // + direct syscalls (epoll). Fixed at construction.
   const bool dataPath_;
 
+  // Ordering protocol (tools/check explicit-atomics): connect publishes
+  // keys_/shm rings/fd_ with release stores of state_/everConnected_;
+  // lock-free fast paths pair them with acquire loads. fd_ reads off
+  // the hot path are relaxed — the fd number itself is the data.
   std::atomic<State> state_{State::kInitializing};
   std::atomic<bool> everConnected_{false};
   Listener* expectedAt_{nullptr};

@@ -16,10 +16,10 @@ namespace tpucoll {
 namespace transport {
 
 bool shmEnabled() {
-  static const bool v = [] {
-    const char* e = std::getenv("TPUCOLL_SHM");
-    return e == nullptr || std::strcmp(e, "0") != 0;
-  }();
+  // Strict flag (common/env.h): historically any non-"0" value meant
+  // enabled, so TPUCOLL_SHM=false silently kept shm ON; now only 0/1
+  // parse and anything else throws at the first same-host config read.
+  static const bool v = envFlag("TPUCOLL_SHM", true);
   return v;
 }
 

@@ -517,11 +517,12 @@ class Work:
         if _lib.lib.tc_work_status(handle) >= 2:  # done/error
             self._free(handle)
         else:
-            # Op still in flight: its lane thread keeps reading/writing
-            # our numpy buffers through raw pointers, so dropping the
-            # references now would be a use-after-free. Park buffers and
-            # handle on the engine; released at shutdown(), after the
-            # lane threads are joined.
+            # Op still in flight — or the status probe itself failed
+            # (tc_work_status < 0): its lane thread may keep reading/
+            # writing our numpy buffers through raw pointers, so
+            # dropping the references now would be a use-after-free.
+            # Park buffers and handle on the engine; released at
+            # shutdown(), after the lane threads are joined.
             self._engine._park(handle, self._arrays)
 
     def wait(self, timeout: Optional[float] = None):
@@ -538,7 +539,12 @@ class Work:
     def test(self) -> bool:
         """Non-blocking: True once the op finished (successfully or
         not). A failure still surfaces only at wait()."""
-        return _lib.lib.tc_work_status(self._handle) >= 2
+        st = _lib.lib.tc_work_status(self._handle)
+        if st < 0:
+            # The probe itself failed; a poll loop must not read that
+            # as "still in flight" and spin forever.
+            raise _lib.Error(_lib.last_error())
+        return st >= 2
 
     def error(self) -> Optional[str]:
         """Error message of a failed op, or None (pending/succeeded)."""

@@ -1,0 +1,33 @@
+// abi-drift fixture: a deliberately drifted C ABI. Never compiled —
+// only scanned by tools/check (tests/test_static_analysis.py).
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Mirrored correctly in _lib.py: no violation.
+int tc_good(void* h, size_t n) {
+  return wrap([&] { use(h, n); });
+}
+
+// Exported here but removed from _lib.py: missing-in-lib.
+int tc_removed(void* h) {
+  return wrap([&] { use(h); });
+}
+
+// _lib.py declares one argument: arity mismatch.
+int tc_arity(void* h, size_t n, int flag) {
+  return wrap([&] { use(h, n, flag); });
+}
+
+// _lib.py declares restype None: missing/mismatched restype.
+const char* tc_restype(void* h) {
+  return lastError(h);
+}
+
+// _lib.py declares argument 1 as c_int where this is size_t.
+int tc_argtype(void* h, size_t n) {
+  return wrap([&] { use(h, n); });
+}
+
+}  // extern "C"

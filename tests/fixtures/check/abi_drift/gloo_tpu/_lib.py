@@ -1,0 +1,16 @@
+"""abi-drift fixture: the Python half of the drifted ABI surface."""
+
+import ctypes
+
+_c = ctypes.c_void_p
+_int = ctypes.c_int
+_sz = ctypes.c_size_t
+
+_PROTOTYPES = {
+    "tc_good": (_int, [_c, _sz]),
+    # tc_removed intentionally absent (simulates a removed symbol).
+    "tc_arity": (_int, [_c]),            # C side takes 3 arguments
+    "tc_restype": (None, [_c]),          # C side returns const char*
+    "tc_argtype": (_int, [_c, _int]),    # C side's arg 1 is size_t
+    "tc_ghost": (_int, [_c]),            # never defined in capi.cc
+}

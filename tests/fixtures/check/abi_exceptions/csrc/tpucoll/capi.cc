@@ -1,0 +1,29 @@
+// abi-exceptions fixture: one tc_* body per boundary style, plus one
+// with no boundary at all. Never compiled — only scanned.
+
+extern "C" {
+
+int tc_wrapped(void* h) {
+  return wrap([&] { use(h); });
+}
+
+void* tc_wrapped_ptr(void* h) {
+  return wrapPtr([&] { return make(h); });
+}
+
+int tc_trycatch(void* h) {
+  try {
+    use(h);
+    return 0;
+  } catch (...) {
+    return 1;
+  }
+}
+
+// No wrap/try: an exception thrown by use() crosses the C ABI.
+int tc_naked(void* h) {
+  use(h);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,13 @@
+// no-bare-assert fixture: one bare assert (violation) and one
+// static_assert (allowed). Never compiled.
+#include <cassert>
+
+namespace tpucoll {
+
+int clampNonNegative(int v) {
+  static_assert(sizeof(int) >= 4, "int width assumption");
+  assert(v >= 0);  // compiled out under NDEBUG: violation
+  return v;
+}
+
+}  // namespace tpucoll

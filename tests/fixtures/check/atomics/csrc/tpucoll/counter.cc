@@ -1,0 +1,41 @@
+// explicit-atomics fixture: each implicit-ordering access form once,
+// plus fully-annotated accesses that must NOT fire. Never compiled.
+#include <atomic>
+
+namespace tpucoll {
+
+class Counter {
+ public:
+  void annotated();
+  void defaultOrderLoad();
+  void implicitStore();
+  void implicitRmw();
+  int implicitLoad();
+
+ private:
+  std::atomic<int> n_{0};
+};
+
+void Counter::annotated() {
+  n_.store(1, std::memory_order_release);
+  (void)n_.load(std::memory_order_acquire);
+  n_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void Counter::defaultOrderLoad() {
+  (void)n_.load();  // default-order method call
+}
+
+void Counter::implicitStore() {
+  n_ = 7;  // implicit seq-cst store
+}
+
+void Counter::implicitRmw() {
+  n_++;  // implicit seq-cst RMW
+}
+
+int Counter::implicitLoad() {
+  return n_ < 3 ? 1 : 0;  // bare read = implicit seq-cst load
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,14 @@
+// env-hygiene fixture: the sanctioned accessor header. getenv here is
+// allowed — it IS the strict-parser home.
+#pragma once
+
+#include <cstdlib>
+
+namespace tpucoll {
+
+inline bool envFlag(const char* name, bool dflt) {
+  const char* v = std::getenv(name);
+  return v != nullptr ? v[0] == '1' : dflt;
+}
+
+}  // namespace tpucoll

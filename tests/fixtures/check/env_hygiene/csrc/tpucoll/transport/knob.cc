@@ -1,0 +1,20 @@
+// env-hygiene fixture: one raw-getenv offender and one undocumented
+// knob. Never compiled — only scanned.
+#include <cstdlib>
+
+#include "tpucoll/common/env.h"
+
+namespace tpucoll {
+
+bool rawRead() {
+  // Raw getenv outside common/env.h: violation. The var itself is
+  // documented, so only the access path is wrong.
+  return std::getenv("TPUCOLL_RAW_KNOB") != nullptr;
+}
+
+bool undocumentedRead() {
+  // Strict accessor, but the var appears nowhere under docs/.
+  return envFlag("TPUCOLL_UNDOCUMENTED", false);
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,10 @@
+// flightrec-coverage fixture capi: a p2p post that never registers its
+// flight-recorder seq (no frPush). Never compiled.
+
+extern "C" {
+
+int tc_buffer_send(void* buf, int dst) {
+  return wrap([&] { post(buf, dst); });
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+// flightrec-coverage fixture public surface. Never compiled.
+#pragma once
+
+namespace tpucoll {
+
+struct StampedOptions { int x; };
+struct NakedOptions { int x; };
+struct OrphanOptions { int x; };
+
+void stamped(StampedOptions& opts);
+void naked(NakedOptions& opts);
+void orphan(OrphanOptions& opts);
+
+}  // namespace tpucoll

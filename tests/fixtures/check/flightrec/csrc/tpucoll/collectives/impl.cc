@@ -1,0 +1,16 @@
+// flightrec-coverage fixture: one stamped entry, one naked one, and
+// `orphan` declared in the header with no definition at all.
+#include "tpucoll/collectives/collectives.h"
+
+namespace tpucoll {
+
+void stamped(StampedOptions& opts) {
+  FlightRecOp frOp(opts.x);
+  run(opts);
+}
+
+void naked(NakedOptions& opts) {
+  run(opts);  // no FlightRecOp stamp: violation
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,27 @@
+// lock-order fixture: an AB/BA cycle between two member mutexes, only
+// one direction of which is documented in the config. Never compiled.
+#include <mutex>
+
+namespace tpucoll {
+
+class Striper {
+ public:
+  void ab();
+  void ba();
+
+ private:
+  std::mutex aMu_;
+  std::mutex bMu_;
+};
+
+void Striper::ab() {
+  std::lock_guard<std::mutex> g1(aMu_);
+  std::lock_guard<std::mutex> g2(bMu_);
+}
+
+void Striper::ba() {
+  std::lock_guard<std::mutex> g1(bMu_);
+  std::lock_guard<std::mutex> g2(aMu_);
+}
+
+}  // namespace tpucoll

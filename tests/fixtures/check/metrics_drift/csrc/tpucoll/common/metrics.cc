@@ -1,0 +1,10 @@
+// metrics-drift fixture emitter: produces only "good_key" in its
+// snapshot JSON; the exposition also reads "ghost_key". Never compiled.
+
+namespace tpucoll {
+
+void snapshotJson(std::string& out) {
+  out += "{\"good_key\":1}";
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,11 @@
+"""metrics-drift fixture exposition: reads one key nobody emits and
+emits one Prometheus family the docs never mention."""
+
+
+def exposition(snap):
+    lines = []
+    lines.append("# TYPE gloo_tpu_documented_total counter")
+    lines.append("gloo_tpu_documented_total %d" % snap.get("good_key", 0))
+    lines.append("# TYPE gloo_tpu_undoc_total counter")
+    lines.append("gloo_tpu_undoc_total %d" % snap.get("ghost_key", 0))
+    return "\n".join(lines)

@@ -357,6 +357,23 @@ def test_channel_count_mismatch_fails_loudly():
     ("TPUCOLL_LOOP_THREADS", "0", "device"),
     ("TPUCOLL_SHM_RING", "big", "shm"),
     ("TPUCOLL_SHM_THRESHOLD", "1e6", "shm"),
+    # Knobs migrated off raw getenv by the env-hygiene pass
+    # (docs/check.md): each historically atoll'd/strcmp'd its value into
+    # silence; all now throw through common/env.h strict parsers.
+    ("TPUCOLL_ENGINE", "kqueue", "device"),
+    ("TPUCOLL_LOG_LEVEL", "debgu", "device"),
+    ("TPUCOLL_NO_AVX512", "true", "device"),
+    ("TPUCOLL_WATCHDOG_MS", "never", "shm"),
+    ("TPUCOLL_FLIGHTREC_EVENTS", "banana", "shm"),
+    ("TPUCOLL_FLIGHTREC_SIGNALS", "yes", "shm"),
+    ("TPUCOLL_TRACE_MAX_EVENTS", "-5", "shm"),
+    ("TPUCOLL_DISABLE_CONNECTION_RETRIES", "2", "shm"),
+    ("TPUCOLL_SHM", "yes", "shm"),
+    # Collective-time knobs: read at the first schedule that consults
+    # them — a ring-sized allreduce for the fuse policy, a forced-hd
+    # non-power-of-2 group for the fold/blocks strategy.
+    ("TPUCOLL_RECV_REDUCE", "maybe", "ring"),
+    ("TPUCOLL_HD_NP2", "folded", "hd3"),
 ])
 def test_strict_env_parsing(var, value, ctor):
     """Malformed transport knobs throw loudly at configuration time
@@ -368,31 +385,38 @@ def test_strict_env_parsing(var, value, ctor):
         import gloo_tpu
 
         var = sys.argv[1]
+        ctor = sys.argv[2]
         try:
             dev = gloo_tpu.Device()     # TPUCOLL_LOOP_THREADS reads here
             ctx = gloo_tpu.Context(0, 1, timeout=10)
             ctx.connect_full_mesh(gloo_tpu.HashStore(), dev)
-            # shm knobs resolve lazily, at first same-host transfer
-            # config read; a 1-rank group never connects a pair, so
-            # force the reads through a 2-rank in-process group.
-            if var.startswith("TPUCOLL_SHM"):
+            # Group- and collective-time knobs resolve lazily; a 1-rank
+            # group never connects a pair, so force the reads through an
+            # in-process group shaped for the knob: 2 ranks for the
+            # transport/shm/context-lifecycle knobs, a ring-sized
+            # payload for the fuse policy, 3 ranks + algorithm="hd" for
+            # the non-power-of-2 fold/blocks strategy.
+            if ctor in ("shm", "ring", "hd3"):
                 import threading
+                nranks = 3 if ctor == "hd3" else 2
+                nelems = (1 << 20) if ctor == "ring" else 64 << 10
+                kwargs = {"algorithm": "hd"} if ctor == "hd3" else {}
                 store = gloo_tpu.HashStore()
                 errs = []
                 def w(rank):
                     try:
                         d = gloo_tpu.Device()
-                        c = gloo_tpu.Context(rank, 2, timeout=10)
+                        c = gloo_tpu.Context(rank, nranks, timeout=10)
                         c.connect_full_mesh(store, d)
-                        x = np.full(64 << 10, 1.0, dtype=np.float32)
-                        c.allreduce(x)
+                        x = np.full(nelems, 1.0, dtype=np.float32)
+                        c.allreduce(x, **kwargs)
                         c.close()
                     except Exception as e:
                         errs.append(e)
                 ts = [threading.Thread(target=w, args=(r,))
-                      for r in range(2)]
+                      for r in range(nranks)]
                 [t.start() for t in ts]
-                [t.join(30) for t in ts]
+                [t.join(60) for t in ts]
                 if errs:
                     raise errs[0]
         except Exception as e:
@@ -403,7 +427,7 @@ def test_strict_env_parsing(var, value, ctor):
         sys.exit(1)
     """).replace("__REPO__", repr(_REPO))
     env = dict(os.environ, **{var: value})
-    proc = subprocess.run([sys.executable, "-c", body, var],
+    proc = subprocess.run([sys.executable, "-c", body, var, ctor],
                           capture_output=True, text=True, env=env,
                           timeout=120)
     assert proc.returncode == 0 and "STRICT-OK" in proc.stdout, \

@@ -137,6 +137,65 @@ def test_tsan_async_engine_smoke():
     assert "TSAN-SMOKE-OK" in result.stdout, result.stdout
 
 
+def test_ubsan_smoke():
+    """Skip-unless-built UndefinedBehaviorSanitizer smoke (`make native
+    SANITIZE=undefined`): a 2-rank collective battery crossing the
+    integer-width/shift/alignment territory UBSan patrols — dtype
+    conversions (f16/bf16 bit twiddling), unaligned views, and the slot
+    arithmetic. The flavor is compiled -fno-sanitize-recover=all, so any
+    UB report aborts the child; no report scraping needed."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    prog = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            for dtype in (np.float32, np.float16, np.int32, np.uint8):
+                x = np.full(4097, rank + 1, dtype=dtype)
+                ctx.allreduce(x, tag=hash(np.dtype(dtype).name) & 0xFF)
+                assert x[0] == 3, (dtype, x[0])
+            # Unaligned view: offset slice exercises the vector kernels'
+            # head/tail scalar paths where misaligned loads would be UB.
+            buf = np.zeros(1026, dtype=np.float32)
+            view = buf[1:1025]
+            view[:] = rank + 1
+            ctx.allreduce(view, tag=77)
+            assert view[0] == 3.0, view[0]
+            y = np.arange(256, dtype=np.float64) * (rank + 1)
+            out = np.zeros(256, dtype=np.float64)
+            ctx.send(y, dst=(rank + 1) % 2, slot=7 + rank)
+            ctx.recv(out, src=(rank + 1) % 2, slot=7 + (rank + 1) % 2)
+            ctx.barrier(tag=2)
+            return float(out[1])
+
+        res = spawn(2, fn, timeout=60)
+        assert res == [2.0, 1.0], res
+        print("UBSAN-SMOKE-OK")
+    """)
+    preloads = []
+    for name in ("libubsan.so", "libstdc++.so"):
+        p = subprocess.run(["g++", "-print-file-name=" + name],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{name} runtime not found beside g++")
+        preloads.append(p)
+    env = dict(os.environ, TPUCOLL_LIB=lib, TPUCOLL_SKIP_BUILD="1",
+               LD_PRELOAD=" ".join(preloads),
+               UBSAN_OPTIONS="print_stacktrace=1 halt_on_error=1")
+    result = subprocess.run([sys.executable, "-c", prog],
+                            capture_output=True, text=True, timeout=120,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "UBSAN-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_asan_smoke():
     """Skip-unless-built AddressSanitizer smoke: when the sanitizer
     flavor exists (`make native SANITIZE=address`), run a small 2-rank

@@ -1,0 +1,9 @@
+"""tpucoll-check: project-native static analysis for the tpucoll core.
+
+Entry point: `python -m tools.check` (or `make check`). See
+docs/check.md for the rule catalog and baseline format."""
+
+from .engine import Baseline, Corpus, Report, Rule, Violation, run_rules
+
+__all__ = ["Baseline", "Corpus", "Report", "Rule", "Violation",
+           "run_rules"]

@@ -1,0 +1,66 @@
+"""CLI for tpucoll-check.
+
+    python -m tools.check                 # full suite, human output
+    python -m tools.check --json out.json # plus machine-readable report
+    python -m tools.check --rules abi-drift,env-hygiene
+    python -m tools.check --list
+
+Exit code 0 iff every rule is clean: no unsuppressed violations AND no
+stale baseline entries (a fixed violation must leave the baseline)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .engine import run_rules
+from .rules import ALL_RULES, make_rules
+
+_DEFAULT_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.check",
+        description="tpucoll static-analysis suite (docs/check.md)")
+    ap.add_argument("--root", default=_DEFAULT_ROOT,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule names (default: all)")
+    ap.add_argument("--json", metavar="PATH", default="",
+                    help="write a machine-readable JSON report "
+                         "('-' for stdout)")
+    ap.add_argument("--no-baselines", action="store_true",
+                    help="ignore baseline files (report everything)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed violations")
+    ap.add_argument("--list", action="store_true",
+                    help="list rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for cls in ALL_RULES:
+            print(f"{cls.name:20s} {cls.description}")
+        return 0
+
+    rules = make_rules([r.strip() for r in args.rules.split(",")
+                        if r.strip()] or None)
+    baseline_dir = None if args.no_baselines else os.path.join(
+        args.root, "tools", "check", "baselines")
+    report = run_rules(args.root, rules, baseline_dir=baseline_dir)
+
+    if args.json == "-":
+        print(report.to_json())
+    else:
+        print(report.render(verbose=args.verbose))
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(report.to_json() + "\n")
+            print(f"json report: {args.json}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Lightweight C++ scanner for tpucoll-check.
+
+No clang on this image (g++ 10 only), so rules work from a
+tokenizer-level view of each translation unit rather than an AST:
+
+- comments and string/char literals are blanked (position-preserving)
+  into `code`, so structural regexes never match inside either;
+- string literal values are kept with their line numbers in `strings`
+  (env-var names, JSON keys, and Prometheus families all live in
+  literals);
+- preprocessor conditionals are tracked far enough to drop `#if 0`
+  blocks and to know each line's conditional depth;
+- function definitions are extracted by signature regex + brace
+  matching, with `Class::method` qualification preserved, so rules can
+  ask "does the body of tc_allreduce contain wrap(" or "which mutexes
+  does Pair::write acquire, in order".
+
+This is deliberately not a parser: it only needs to be exact about the
+constructs the rules in tools/check/rules/ key on, and those were
+chosen to be recognizable at this level.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class FunctionDef:
+    name: str           # qualified: "Pair::write", "tc_allreduce"
+    line: int           # 1-based line of the signature
+    params: str         # raw parameter list text
+    body: str           # body text with comments/strings blanked
+    body_line: int      # 1-based line where the body's '{' sits
+    ret: str            # raw return-type text (may be empty for ctors)
+
+
+@dataclass
+class CppFile:
+    path: str
+    raw: str
+    code: str = ""                  # comments + literals blanked
+    code_keep_strings: str = ""     # comments blanked, literals kept
+    strings: List[Tuple[int, str]] = field(default_factory=list)
+    line_starts: List[int] = field(default_factory=list)
+    if0_lines: frozenset = frozenset()
+    _functions: Optional[List[FunctionDef]] = None
+
+    @classmethod
+    def parse(cls, path: str, raw: str) -> "CppFile":
+        f = cls(path=path, raw=raw)
+        f._blank()
+        f._preprocess()
+        return f
+
+    # -- construction ---------------------------------------------------
+
+    def _blank(self) -> None:
+        """Single pass over the source replacing comment bodies and
+        literal bodies with spaces (newlines kept, so offsets and line
+        numbers stay valid in both derived views)."""
+        raw = self.raw
+        n = len(raw)
+        code = list(raw)
+        keep = list(raw)
+        strings: List[Tuple[int, str]] = []
+        self.line_starts = [0] + [m.end() for m in re.finditer("\n", raw)]
+        i = 0
+        while i < n:
+            c = raw[i]
+            nxt = raw[i + 1] if i + 1 < n else ""
+            if c == "/" and nxt == "/":
+                j = raw.find("\n", i)
+                j = n if j < 0 else j
+                for k in range(i, j):
+                    code[k] = keep[k] = " "
+                i = j
+            elif c == "/" and nxt == "*":
+                j = raw.find("*/", i + 2)
+                j = n - 2 if j < 0 else j
+                for k in range(i, j + 2):
+                    if code[k] != "\n":
+                        code[k] = keep[k] = " "
+                i = j + 2
+            elif c == '"' or c == "'":
+                quote = c
+                j = i + 1
+                while j < n and raw[j] != quote:
+                    j += 2 if raw[j] == "\\" else 1
+                if quote == '"':
+                    strings.append((self.line_of(i), raw[i + 1:j]))
+                for k in range(i + 1, min(j, n)):
+                    if code[k] != "\n":
+                        code[k] = " "
+                i = j + 1
+            else:
+                i += 1
+        self.code = "".join(code)
+        self.code_keep_strings = "".join(keep)
+        self.strings = strings
+
+    def _preprocess(self) -> None:
+        """Track #if nesting; record lines inside an `#if 0` block so
+        rules skip intentionally dead code."""
+        dead: set = set()
+        stack: List[bool] = []   # per level: is this an "#if 0" level
+        for ln, line in enumerate(self.code.splitlines(), 1):
+            stripped = line.lstrip()
+            if stripped.startswith("#"):
+                directive = stripped[1:].lstrip()
+                if directive.startswith(("if ", "ifdef", "ifndef", "if(")):
+                    stack.append(bool(re.match(r"if\s*\(?\s*0\s*\)?\s*$",
+                                               directive)))
+                elif directive.startswith(("else", "elif")) and stack:
+                    stack[-1] = False
+                elif directive.startswith("endif") and stack:
+                    stack.pop()
+            if any(stack):
+                dead.add(ln)
+        self.if0_lines = frozenset(dead)
+
+    # -- queries --------------------------------------------------------
+
+    def line_of(self, offset: int) -> int:
+        return bisect.bisect_right(self.line_starts, offset)
+
+    _SIG = re.compile(
+        r"(?:^|\n)"
+        r"(?P<ret>[ \t]*(?:[\w:~&<>,\*\s]|\[\[\w+\]\])*?)"
+        r"\b(?P<name>~?\w[\w]*(?:::~?\w+)?)\s*"
+        r"\((?P<params>[^;{}()]*(?:\([^()]*\)[^;{}()]*)*)\)\s*"
+        r"(?:const\s*)?(?:noexcept\s*)?(?:override\s*)?(?:->\s*[\w:<>]+\s*)?"
+        r"(?::\s*[^;{}]*)?"        # constructor initializer list
+        r"\{")
+
+    _NOT_FUNCS = frozenset({
+        "if", "for", "while", "switch", "catch", "return", "do", "else",
+        "sizeof", "alignas", "alignof", "new", "delete", "defined",
+        "static_assert", "decltype", "namespace",
+    })
+
+    def functions(self) -> List[FunctionDef]:
+        """Function definitions via signature regex + brace matching.
+        Good enough for the rule set: misses lambdas-as-values and
+        heavily-macro'd definitions, neither of which the checked
+        invariants live in."""
+        if self._functions is not None:
+            return self._functions
+        out: List[FunctionDef] = []
+        for m in self._SIG.finditer(self.code):
+            name = m.group("name")
+            base = name.split("::")[-1]
+            if base in self._NOT_FUNCS or name in self._NOT_FUNCS:
+                continue
+            ret = m.group("ret").strip()
+            # Control-flow keywords ending the "return type" mean this
+            # brace belongs to a statement, not a function definition.
+            if re.search(r"\b(?:return|else|do|=|\bthrow)\s*$", ret):
+                continue
+            open_brace = m.end() - 1
+            body_end = self._match_brace(open_brace)
+            if body_end < 0:
+                continue
+            out.append(FunctionDef(
+                name=name,
+                line=self.line_of(m.start("name")),
+                params=m.group("params"),
+                body=self.code[open_brace + 1:body_end],
+                body_line=self.line_of(open_brace),
+                ret=ret,
+            ))
+        self._functions = out
+        return out
+
+    def function(self, name: str) -> Optional[FunctionDef]:
+        for f in self.functions():
+            if f.name == name or f.name.split("::")[-1] == name:
+                return f
+        return None
+
+    def _match_brace(self, open_off: int) -> int:
+        depth = 0
+        for i in range(open_off, len(self.code)):
+            c = self.code[i]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return i
+        return -1
+
+    def call_argument_span(self, open_paren_off: int) -> str:
+        """Text of a call's argument list given the offset of its '(' in
+        `code` — spans newlines, so multi-line calls are seen whole."""
+        depth = 0
+        for i in range(open_paren_off, len(self.code)):
+            c = self.code[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    return self.code[open_paren_off + 1:i]
+        return self.code[open_paren_off + 1:]
+
+    def string_args(self, callee: str) -> List[Tuple[int, str]]:
+        """(line, first-string-literal-argument) for each call of
+        `callee` — e.g. every envBytes("TPUCOLL_X", ...) site."""
+        out = []
+        pat = re.compile(r"\b" + re.escape(callee) + r'\s*\(\s*"([^"]*)"')
+        for m in pat.finditer(self.code_keep_strings):
+            out.append((self.line_of(m.start()), m.group(1)))
+        return out

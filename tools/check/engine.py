@@ -1,0 +1,248 @@
+"""Rule engine for tpucoll-check: corpus loading, baselines, reporting.
+
+A rule examines the corpus (the repo's csrc/ + gloo_tpu/ + docs/ trees)
+and emits Violations keyed by a *stable* identifier — symbol names, env
+vars, mutex pairs — never line numbers, so baselines survive unrelated
+edits. Baselines live one file per rule under tools/check/baselines/:
+
+    # comment
+    <violation-key> -- <one-line justification>
+
+A baselined violation is suppressed (reported separately); a baseline
+entry with no live violation is *stale* and fails the run — fixed
+violations must leave the baseline, or the file rots into a blanket
+mute. See docs/check.md.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .cpp import CppFile
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    key: str          # stable id, unique within the rule
+    path: str         # repo-relative file the violation anchors to
+    line: int         # best-effort anchor (not part of identity)
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base class. Subclasses set `name`/`description` and implement
+    run(corpus) -> List[Violation]."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, corpus: "Corpus") -> List[Violation]:
+        raise NotImplementedError
+
+    def violation(self, key: str, path: str, line: int,
+                  message: str) -> Violation:
+        return Violation(self.name, key, path, line, message)
+
+
+class Corpus:
+    """Cached file access rooted at the repo (or a test fixture tree)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._text: Dict[str, Optional[str]] = {}
+        self._cpp: Dict[str, CppFile] = {}
+
+    def exists(self, rel: str) -> bool:
+        return os.path.isfile(os.path.join(self.root, rel))
+
+    def text(self, rel: str) -> Optional[str]:
+        if rel not in self._text:
+            p = os.path.join(self.root, rel)
+            try:
+                with open(p, "r", encoding="utf-8", errors="replace") as f:
+                    self._text[rel] = f.read()
+            except OSError:
+                self._text[rel] = None
+        return self._text[rel]
+
+    def cpp(self, rel: str) -> Optional[CppFile]:
+        if rel not in self._cpp:
+            raw = self.text(rel)
+            if raw is None:
+                return None
+            self._cpp[rel] = CppFile.parse(rel, raw)
+        return self._cpp.get(rel)
+
+    def glob(self, pattern: str,
+             exclude: Iterable[str] = ()) -> List[str]:
+        """Repo-relative paths under root matching a '**'-style glob."""
+        out = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in (".git", "__pycache__",
+                                        ".pytest_cache")]
+            for fn in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if fnmatch.fnmatch(rel, pattern) and not any(
+                        fnmatch.fnmatch(rel, e) for e in exclude):
+                    out.append(rel)
+        return sorted(out)
+
+    def cpp_sources(self) -> List[str]:
+        """Production C++ TUs: csrc/tpucoll, excluding the test/bench
+        mains (csrc/tests, csrc/benchmark) — those live by different
+        rules (bare assert is fine in a test main). Deduplicated:
+        fnmatch's '*' crosses '/', so the nested and top-level patterns
+        overlap."""
+        return sorted(set(self.glob("csrc/tpucoll/**/*.cc")
+                          + self.glob("csrc/tpucoll/*.cc")
+                          + self.glob("csrc/tpucoll/**/*.h")
+                          + self.glob("csrc/tpucoll/*.h")))
+
+
+# -- baselines ----------------------------------------------------------
+
+
+@dataclass
+class Baseline:
+    entries: Dict[str, str] = field(default_factory=dict)  # key -> why
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        b = cls()
+        if not os.path.isfile(path):
+            return b
+        with open(path, "r", encoding="utf-8") as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if " -- " not in line:
+                    raise ValueError(
+                        f"{path}:{ln}: baseline entries are "
+                        f"'<key> -- <justification>', got: {line}")
+                key, why = line.split(" -- ", 1)
+                key, why = key.strip(), why.strip()
+                if not why:
+                    raise ValueError(
+                        f"{path}:{ln}: suppression of {key!r} needs a "
+                        f"one-line justification after ' -- '")
+                b.entries[key] = why
+        return b
+
+
+# -- runner -------------------------------------------------------------
+
+
+@dataclass
+class RuleResult:
+    rule: str
+    description: str
+    violations: List[Violation]
+    suppressed: List[Tuple[Violation, str]]   # (violation, justification)
+    stale: List[str]                          # baseline keys with no hit
+    duration_s: float
+
+
+@dataclass
+class Report:
+    root: str
+    results: List[RuleResult]
+
+    @property
+    def ok(self) -> bool:
+        return not any(r.violations or r.stale for r in self.results)
+
+    def render(self, verbose: bool = False) -> str:
+        lines = []
+        for r in self.results:
+            status = "ok" if not (r.violations or r.stale) else "FAIL"
+            lines.append(
+                f"[{status}] {r.rule}: {len(r.violations)} violation(s), "
+                f"{len(r.suppressed)} suppressed, {len(r.stale)} stale "
+                f"baseline entr{'y' if len(r.stale) == 1 else 'ies'} "
+                f"({r.duration_s * 1000:.0f} ms)")
+            for v in r.violations:
+                lines.append("  " + v.render())
+            for key in r.stale:
+                lines.append(
+                    f"  baseline entry {key!r} matches no live violation "
+                    f"— the fix landed, now delete the entry "
+                    f"(tools/check/baselines/{r.rule}.txt)")
+            if verbose:
+                for v, why in r.suppressed:
+                    lines.append(f"  suppressed: {v.render()} [{why}]")
+        total = sum(len(r.violations) for r in self.results)
+        stale = sum(len(r.stale) for r in self.results)
+        lines.append(
+            f"tpucoll-check: {len(self.results)} rule(s), {total} "
+            f"violation(s), {stale} stale baseline entr"
+            f"{'y' if stale == 1 else 'ies'}"
+            + (" — clean" if self.ok else ""))
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        doc = {
+            "tool": "tpucoll-check",
+            "root": self.root,
+            "ok": self.ok,
+            "rules": [
+                {
+                    "rule": r.rule,
+                    "description": r.description,
+                    "ok": not (r.violations or r.stale),
+                    "duration_s": round(r.duration_s, 4),
+                    "violations": [asdict(v) for v in r.violations],
+                    "suppressed": [
+                        dict(asdict(v), justification=why)
+                        for v, why in r.suppressed
+                    ],
+                    "stale_baseline_entries": list(r.stale),
+                }
+                for r in self.results
+            ],
+        }
+        return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def run_rules(root: str, rules: Iterable[Rule],
+              baseline_dir: Optional[str] = None) -> Report:
+    corpus = Corpus(root)
+    results: List[RuleResult] = []
+    for rule in rules:
+        t0 = time.monotonic()
+        found = rule.run(corpus)
+        baseline = Baseline()
+        if baseline_dir:
+            baseline = Baseline.load(
+                os.path.join(baseline_dir, rule.name + ".txt"))
+        live_keys = {v.key for v in found}
+        dupes = len(found) - len(live_keys)
+        if dupes:
+            raise AssertionError(
+                f"rule {rule.name} produced {dupes} duplicate violation "
+                f"key(s); keys must be unique to be baselineable")
+        violations = [v for v in found if v.key not in baseline.entries]
+        suppressed = [(v, baseline.entries[v.key]) for v in found
+                      if v.key in baseline.entries]
+        stale = [k for k in baseline.entries if k not in live_keys]
+        results.append(RuleResult(
+            rule=rule.name,
+            description=rule.description,
+            violations=sorted(violations, key=lambda v: (v.path, v.line,
+                                                         v.key)),
+            suppressed=suppressed,
+            stale=sorted(stale),
+            duration_s=time.monotonic() - t0,
+        ))
+    return Report(root=corpus.root, results=results)

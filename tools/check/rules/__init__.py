@@ -1,0 +1,39 @@
+"""Rule registry for tpucoll-check. docs/check.md is the catalog."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..engine import Rule
+from .abi_drift import AbiDriftRule
+from .abi_exceptions import AbiExceptionsRule
+from .asserts import AssertsRule
+from .atomics import AtomicsRule
+from .env_hygiene import EnvHygieneRule
+from .flightrec import FlightrecRule
+from .lock_order import LockOrderRule
+from .metrics_drift import MetricsDriftRule
+
+ALL_RULES = (
+    AbiDriftRule,
+    AbiExceptionsRule,
+    EnvHygieneRule,
+    AtomicsRule,
+    FlightrecRule,
+    MetricsDriftRule,
+    LockOrderRule,
+    AssertsRule,
+)
+
+
+def make_rules(names: List[str] = None) -> List[Rule]:
+    rules = [cls() for cls in ALL_RULES]
+    if names:
+        by_name = {r.name: r for r in rules}
+        unknown = [n for n in names if n not in by_name]
+        if unknown:
+            raise SystemExit(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(have: {', '.join(sorted(by_name))})")
+        rules = [by_name[n] for n in names]
+    return rules

@@ -1,0 +1,207 @@
+"""C-ABI drift: every `extern "C"` symbol defined in capi.cc must have a
+matching ctypes prototype in gloo_tpu/_lib.py — same set, same arity,
+same types — and vice-versa. The ctypes layer is the repo's pybind
+equivalent; nothing checks it at build time, so a drifted argtype
+corrupts arguments silently at runtime (a size_t read as int32 truncates
+byte counts on every collective)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import Corpus, Rule, Violation
+
+CAPI = "csrc/tpucoll/capi.cc"
+LIB = "gloo_tpu/_lib.py"
+
+# C parameter/return type -> canonical ctypes spelling. Keys are
+# normalized ("const" dropped, one space before '*'s collapsed away).
+_C_TO_CTYPES = {
+    "void": None,
+    "void*": "c_void_p",
+    "void**": "POINTER(c_void_p)",
+    "char*": "c_char_p",
+    "uint8_t*": "POINTER(c_uint8)",
+    "uint8_t**": "POINTER(POINTER(c_uint8))",
+    "size_t": "c_size_t",
+    "size_t*": "POINTER(c_size_t)",
+    "int64_t": "c_int64",
+    "int64_t*": "POINTER(c_int64)",
+    "uint64_t": "c_uint64",
+    "uint64_t*": "POINTER(c_uint64)",
+    "uint32_t": "c_uint32",
+    "uint32_t*": "POINTER(c_uint32)",
+    "uint16_t": "c_uint16",
+    "int": "c_int",
+    "int*": "POINTER(c_int)",
+}
+
+
+def normalize_c_type(decl: str) -> Optional[str]:
+    """'const char* key' -> canonical ctypes spelling ('c_char_p')."""
+    t = decl.strip()
+    # Drop the parameter name (trailing identifier) when the remainder
+    # still names a type.
+    m = re.match(r"^(.*[\*\s])\s*\w+$", t)
+    if m and m.group(1).strip():
+        t = m.group(1).strip()
+    t = re.sub(r"\bconst\b", "", t)
+    t = re.sub(r"\s*\*\s*", "*", t).strip()
+    t = re.sub(r"\s+", " ", t)
+    # Function pointers (inline `void (*fn)(...)` or `*_fn` typedefs)
+    # ride as opaque pointers on the Python side.
+    if "(*" in decl or t.endswith("_fn"):
+        return "c_void_p"
+    return _C_TO_CTYPES.get(t, f"<unmapped:{t}>")
+
+
+def parse_capi(corpus: Corpus,
+               path: str = CAPI) -> Dict[str, Tuple[Optional[str],
+                                                    List[Optional[str]]]]:
+    """tc_* symbol -> (canonical restype, [canonical argtypes]) from the
+    extern "C" block of capi.cc."""
+    cpp = corpus.cpp(path)
+    if cpp is None:
+        return {}
+    out = {}
+    for fn in cpp.functions():
+        if not fn.name.startswith("tc_"):
+            continue
+        params = fn.params.strip()
+        args: List[Optional[str]] = []
+        if params and params != "void":
+            depth = 0
+            start = 0
+            parts = []
+            for i, ch in enumerate(params):
+                if ch in "(<":
+                    depth += 1
+                elif ch in ")>":
+                    depth -= 1
+                elif ch == "," and depth == 0:
+                    parts.append(params[start:i])
+                    start = i + 1
+            parts.append(params[start:])
+            args = [normalize_c_type(p) for p in parts]
+        out[fn.name] = (normalize_c_type(fn.ret), args)
+    return out
+
+
+def parse_lib(corpus: Corpus,
+              path: str = LIB) -> Dict[str, Tuple[Optional[str],
+                                                  List[Optional[str]],
+                                                  int]]:
+    """tc_* symbol -> (canonical restype, [canonical argtypes], line)
+    from the _PROTOTYPES dict, resolved through the module's ctypes
+    aliases (_c, _sz, ...) via the AST — never imported/executed."""
+    src = corpus.text(path)
+    if src is None:
+        return {}
+    tree = ast.parse(src)
+    aliases: Dict[str, str] = {}
+
+    def canon(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and node.value is None:
+            return None
+        if isinstance(node, ast.Name):
+            return canon_str(aliases.get(node.id, node.id))
+        if isinstance(node, ast.Attribute):   # ctypes.c_void_p
+            return canon_str(node.attr)
+        if isinstance(node, ast.Call):        # ctypes.POINTER(X)
+            fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                     else getattr(node.func, "id", "?"))
+            inner = canon(node.args[0]) if node.args else "?"
+            return f"{fname}({inner})"
+        return "<unparsed>"
+
+    def canon_str(name: str) -> str:
+        return name[len("ctypes."):] if name.startswith("ctypes.") else name
+
+    protos: Dict[str, Tuple[Optional[str], List[Optional[str]], int]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Name) and isinstance(node.value,
+                                                       (ast.Attribute,
+                                                        ast.Name)):
+            aliases[target.id] = ast.unparse(node.value)
+        if (isinstance(target, ast.Name) and target.id == "_PROTOTYPES"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(v, ast.Tuple)
+                        and len(v.elts) == 2):
+                    continue
+                restype = canon(v.elts[0])
+                arglist = v.elts[1]
+                argtypes = ([canon(a) for a in arglist.elts]
+                            if isinstance(arglist, ast.List) else [])
+                protos[k.value] = (restype, argtypes, k.lineno)
+    return protos
+
+
+class AbiDriftRule(Rule):
+    name = "abi-drift"
+    description = (
+        "every extern-C tc_* symbol in capi.cc is mirrored in "
+        "_lib.py's ctypes prototypes with matching arity and types")
+
+    capi_path = CAPI
+    lib_path = LIB
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        capi = parse_capi(corpus, self.capi_path)
+        lib = parse_lib(corpus, self.lib_path)
+        if not capi:
+            return [self.violation("no-capi", self.capi_path, 1,
+                                   f"{self.capi_path} missing or has no "
+                                   f"extern-C tc_* definitions")]
+        if not lib:
+            return [self.violation("no-lib", self.lib_path, 1,
+                                   f"{self.lib_path} missing or has no "
+                                   f"_PROTOTYPES dict")]
+        cpp = corpus.cpp(self.capi_path)
+        for name in sorted(set(capi) - set(lib)):
+            fn = cpp.function(name)
+            out.append(self.violation(
+                f"missing-in-lib:{name}", self.capi_path,
+                fn.line if fn else 1,
+                f"{name} is exported by capi.cc but has no ctypes "
+                f"prototype in {self.lib_path} (calls through it get "
+                f"default int/varargs marshalling)"))
+        for name in sorted(set(lib) - set(capi)):
+            out.append(self.violation(
+                f"missing-in-capi:{name}", self.lib_path, lib[name][2],
+                f"{name} is declared in {self.lib_path} but not defined "
+                f"in capi.cc (AttributeError at import, or a stale "
+                f"symbol)"))
+        for name in sorted(set(capi) & set(lib)):
+            c_ret, c_args = capi[name]
+            py_ret, py_args, line = lib[name]
+            fn = cpp.function(name)
+            cline = fn.line if fn else 1
+            if c_ret != py_ret:
+                out.append(self.violation(
+                    f"restype:{name}", self.lib_path, line,
+                    f"{name}: restype mismatch — capi.cc returns "
+                    f"{c_ret or 'void'}, _lib.py declares "
+                    f"{py_ret or 'None'}"))
+            if len(c_args) != len(py_args):
+                out.append(self.violation(
+                    f"arity:{name}", self.lib_path, line,
+                    f"{name}: arity mismatch — capi.cc takes "
+                    f"{len(c_args)} argument(s), _lib.py declares "
+                    f"{len(py_args)}"))
+                continue
+            for i, (ca, pa) in enumerate(zip(c_args, py_args)):
+                if ca != pa:
+                    out.append(self.violation(
+                        f"argtype:{name}:{i}", self.lib_path, line,
+                        f"{name}: argument {i} mismatch — capi.cc "
+                        f"({self.capi_path}:{cline}) has {ca}, _lib.py "
+                        f"declares {pa}"))
+        return out

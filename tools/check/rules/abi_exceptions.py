@@ -1,0 +1,46 @@
+"""C-ABI exception tightness: a C++ exception crossing the extern "C"
+boundary is undefined behavior (and in practice aborts the process out
+from under the Python caller, taking the whole rank down with no
+tc_last_error). Every tc_* body must therefore route through one of the
+catch-at-boundary helpers (wrap / wrapPtr / wrapVoid / wrapVal /
+submitWork) or carry its own try/catch."""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..engine import Corpus, Rule, Violation
+
+CAPI = "csrc/tpucoll/capi.cc"
+
+_BOUNDARY = re.compile(
+    r"\b(?:wrap|wrapPtr|wrapVoid|wrapVal|submitWork)\s*[(<]|\btry\s*\{")
+
+
+class AbiExceptionsRule(Rule):
+    name = "abi-exceptions"
+    description = ("every extern-C tc_* body routes through a "
+                   "catch-at-boundary helper (no exception may cross "
+                   "the C ABI)")
+
+    capi_path = CAPI
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        cpp = corpus.cpp(self.capi_path)
+        if cpp is None:
+            return [self.violation("no-capi", self.capi_path, 1,
+                                   f"{self.capi_path} not found")]
+        out: List[Violation] = []
+        for fn in cpp.functions():
+            if not fn.name.startswith("tc_"):
+                continue
+            if _BOUNDARY.search(fn.body):
+                continue
+            out.append(self.violation(
+                f"unwrapped:{fn.name}", self.capi_path, fn.line,
+                f"{fn.name} does not route through "
+                f"wrap/wrapPtr/wrapVoid/wrapVal or a try/catch — an "
+                f"exception here crosses the C ABI and aborts the "
+                f"process"))
+        return out

@@ -1,0 +1,50 @@
+"""Enforce-macro usage: no bare `assert` in non-test C++. NDEBUG builds
+(-O3 release, which is what ships) compile assert away entirely, so a
+bare assert is a check that exists only on a developer box. The project
+contract is TC_ENFORCE / TC_THROW (common/logging.h): always-on, throws
+with file:line context, and maps to a typed Python exception at the
+ABI."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from ..engine import Corpus, Rule, Violation
+
+# \b alone is not enough: static_assert ends in `assert` but its
+# preceding char is `_` (a word char), which (?<!\w) excludes.
+_ASSERT = re.compile(r"(?<![\w.])assert\s*\(")
+
+
+class AssertsRule(Rule):
+    name = "no-bare-assert"
+    description = ("no bare assert() in non-test C++ — use TC_ENFORCE/"
+                   "TC_THROW, which survive NDEBUG and cross the ABI "
+                   "as typed errors")
+
+    roots = ("csrc/tpucoll/**/*.cc", "csrc/tpucoll/**/*.h",
+             "csrc/tpucoll/*.cc", "csrc/tpucoll/*.h")
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        paths: List[str] = []
+        for pat in self.roots:
+            paths.extend(corpus.glob(pat))
+        counters: Dict[str, int] = {}
+        for path in sorted(set(paths)):
+            cpp = corpus.cpp(path)
+            if cpp is None:
+                continue
+            for m in _ASSERT.finditer(cpp.code):
+                line = cpp.line_of(m.start())
+                if line in cpp.if0_lines:
+                    continue
+                counters[path] = counters.get(path, 0) + 1
+                n = counters[path]
+                key = f"assert:{path}" + ("" if n == 1 else f"#{n}")
+                out.append(self.violation(
+                    key, path, line,
+                    "bare assert() — compiled out under NDEBUG; use "
+                    "TC_ENFORCE (always-on, typed, file:line) instead"))
+        return out

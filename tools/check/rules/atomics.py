@@ -1,0 +1,146 @@
+"""Explicit atomics: every std::atomic access in csrc/tpucoll/ names a
+memory_order. Default (seq-cst) ordering is almost never what a hot-path
+site means — and when seq-cst IS meant, writing it out is the evidence
+someone decided. Three access forms are checked:
+
+- method calls (load/store/fetch_*/exchange/compare_exchange_*): the
+  argument list, joined across lines, must contain `memory_order`;
+- operator stores (`flag_ = x`, `n_++`, `n_ += k`) on members declared
+  std::atomic: implicit seq-cst RMW/stores, must become explicit calls;
+- bare reads (`if (fd_ < 0)`) of such members: implicit seq-cst loads.
+
+Operator/bare detection is scoped to atomics declared with the member
+(`name_`) or global (`g_name`) naming convention in the file itself or
+its paired header, so a local variable shadowing a generic word never
+false-positives.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set
+
+from ..engine import Corpus, Rule, Violation
+
+_METHODS = ("load", "store", "fetch_add", "fetch_sub", "fetch_and",
+            "fetch_or", "fetch_xor", "exchange", "compare_exchange_weak",
+            "compare_exchange_strong")
+
+_METHOD_CALL = re.compile(
+    r"[\w\]\)]\s*(?:\.|->)\s*(" + "|".join(_METHODS) + r")\s*(\()")
+
+# std::atomic<...> name; / std::atomic_bool name{...}; etc. Captures
+# pointer declarators so pointer-to-atomic (accessed via explicit
+# load/store through the method pass) is excluded from operator checks.
+_ATOMIC_DECL = re.compile(
+    r"std\s*::\s*atomic(?:_bool|_int|_uint|_flag|_size_t)?"
+    r"\s*(?:<[^;{}=]*?>)?\s*(?P<ptr>\**)\s*(?P<name>\w+)\s*(?:[;{=\[])")
+
+
+class AtomicsRule(Rule):
+    name = "explicit-atomics"
+    description = ("every std::atomic load/store/RMW in csrc/tpucoll/ "
+                   "names an explicit memory_order")
+
+    roots = ("csrc/tpucoll/**/*.cc", "csrc/tpucoll/**/*.h",
+             "csrc/tpucoll/*.cc", "csrc/tpucoll/*.h")
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        paths: List[str] = []
+        for pat in self.roots:
+            paths.extend(corpus.glob(pat))
+        counters: Dict[str, int] = {}
+
+        def emit(kind: str, path: str, line: int, who: str,
+                 message: str) -> None:
+            base = f"{kind}:{path}:{who}"
+            counters[base] = counters.get(base, 0) + 1
+            key = base if counters[base] == 1 else \
+                f"{base}#{counters[base]}"
+            out.append(self.violation(key, path, line, message))
+
+        for path in sorted(set(paths)):
+            cpp = corpus.cpp(path)
+            if cpp is None:
+                continue
+            # -- pass 1: explicit method calls without an order --------
+            for m in _METHOD_CALL.finditer(cpp.code):
+                line = cpp.line_of(m.start())
+                if line in cpp.if0_lines:
+                    continue
+                args = cpp.call_argument_span(m.start(2))
+                method = m.group(1)
+                if "memory_order" in args:
+                    continue
+                # An atomic store/RMW always takes arguments; a no-arg
+                # call of the same name is an unrelated accessor
+                # (Context::store()). Only load() is validly empty.
+                if method != "load" and not args.strip():
+                    continue
+                # a .load()/.lock-free probe on a non-atomic (e.g. a
+                # shared_ptr helper) would be caught here too; the
+                # codebase has none, and a false hit is baselineable.
+                emit("default-order", path, line, method,
+                     f".{method}({args.strip()[:40]}...) uses default "
+                     f"seq-cst ordering — name the memory_order this "
+                     f"site actually needs (comment it when weaker "
+                     f"than seq-cst)")
+            # -- pass 2: operator stores / bare reads of conventioned
+            #            atomic members in this file + paired header ---
+            names = self._conventioned_atomics(corpus, path)
+            if not names:
+                continue
+            decl_spans = [m.span() for m in _ATOMIC_DECL.finditer(cpp.code)]
+            for name in sorted(names):
+                for m in re.finditer(r"(?<![\w.>])" + re.escape(name)
+                                     + r"\b", cpp.code):
+                    line = cpp.line_of(m.start())
+                    if line in cpp.if0_lines:
+                        continue
+                    if any(a <= m.start() < b for a, b in decl_spans):
+                        continue   # the declaration itself
+                    before = cpp.code[max(0, m.start() - 2):m.start()]
+                    after = cpp.code[m.end():m.end() + 24].lstrip()
+                    if before.endswith((".", "->", "::", "&")):
+                        continue
+                    if after.startswith((".", "->", "{", "[")):
+                        continue   # method call / init / element access
+                    if re.match(r"=[^=]", after):
+                        emit("implicit-store", path, line, name,
+                             f"`{name} = ...` is an implicit seq-cst "
+                             f"atomic store — use "
+                             f"{name}.store(..., memory_order)")
+                    elif after.startswith(("++", "--", "+=", "-=", "|=",
+                                           "&=", "^=")):
+                        emit("implicit-rmw", path, line, name,
+                             f"`{name}{after[:2]}` is an implicit "
+                             f"seq-cst atomic RMW — use an explicit "
+                             f"fetch_* with a memory_order")
+                    else:
+                        emit("implicit-load", path, line, name,
+                             f"bare read of atomic `{name}` is an "
+                             f"implicit seq-cst load — use "
+                             f"{name}.load(memory_order)")
+        return out
+
+    def _conventioned_atomics(self, corpus: Corpus,
+                              path: str) -> Set[str]:
+        """Member-convention (`x_`) and global-convention (`g_x`) atomic
+        names declared in this file or its sibling .h/.cc."""
+        names: Set[str] = set()
+        stem, ext = os.path.splitext(path)
+        siblings = [path] + [stem + e for e in (".h", ".cc")
+                             if stem + e != path]
+        for sib in siblings:
+            cpp = corpus.cpp(sib)
+            if cpp is None:
+                continue
+            for m in _ATOMIC_DECL.finditer(cpp.code):
+                if m.group("ptr"):
+                    continue
+                name = m.group("name")
+                if name.endswith("_") or name.startswith("g_"):
+                    names.add(name)
+        return names

@@ -1,0 +1,90 @@
+"""Flight-recorder coverage: the always-on post-mortem story (PR 4)
+only works if EVERY public collective entry stamps a FlightRecOp and
+every capi p2p post registers its ring seq (frPush) — one unstamped
+entry and the cross-rank desync comparison silently skips that op,
+turning a schedule mismatch into an unexplained hang.
+
+Entry points are not hardcoded: the rule reads the declarations out of
+collectives/collectives.h, so a new collective is covered the moment it
+is declared."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from ..engine import Corpus, Rule, Violation
+
+COLLECTIVES_H = "csrc/tpucoll/collectives/collectives.h"
+CAPI = "csrc/tpucoll/capi.cc"
+
+# capi entries that post user-facing p2p ops; each must push its flight-
+# recorder seq so the matching wait completes the right ring entry.
+P2P_POSTS = ("tc_buffer_send", "tc_buffer_recv", "tc_buffer_recv_any",
+             "tc_buffer_put", "tc_buffer_get")
+
+_DECL = re.compile(r"^\s*void\s+(\w+)\s*\(\s*\w*Options\s*&\s*\w+\s*\)\s*;",
+                   re.M)
+
+
+class FlightrecRule(Rule):
+    name = "flightrec-coverage"
+    description = ("every public collective entry stamps FlightRecOp "
+                   "and every capi p2p post registers its seq (frPush)")
+
+    collectives_h = COLLECTIVES_H
+    capi_path = CAPI
+    p2p_posts = P2P_POSTS
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        header = corpus.text(self.collectives_h)
+        if header is None:
+            return [self.violation("no-header", self.collectives_h, 1,
+                                   f"{self.collectives_h} not found")]
+        entries = _DECL.findall(header)
+        if not entries:
+            out.append(self.violation(
+                "no-entries", self.collectives_h, 1,
+                f"no `void name(XOptions&)` declarations found in "
+                f"{self.collectives_h} — rule cannot see the public "
+                f"surface"))
+        # Find each entry's definition across the collectives TUs.
+        impl_dir = self.collectives_h.rsplit("/", 1)[0]
+        impls = corpus.glob(impl_dir + "/*.cc")
+        defs: Dict[str, tuple] = {}
+        for path in impls:
+            cpp = corpus.cpp(path)
+            if cpp is None:
+                continue
+            for fn in cpp.functions():
+                base = fn.name.split("::")[-1]
+                if base in entries and "Options" in fn.params:
+                    defs.setdefault(base, (path, fn))
+        for entry in entries:
+            if entry not in defs:
+                out.append(self.violation(
+                    f"no-definition:{entry}", self.collectives_h, 1,
+                    f"{entry} is declared in {self.collectives_h} but "
+                    f"no definition was found under {impl_dir}/"))
+                continue
+            path, fn = defs[entry]
+            if "FlightRecOp" not in fn.body:
+                out.append(self.violation(
+                    f"unstamped:{entry}", path, fn.line,
+                    f"{entry} does not stamp a FlightRecOp — its ops "
+                    f"never enter the flight-recorder ring, so desync "
+                    f"detection and stall post-mortems skip them"))
+        capi = corpus.cpp(self.capi_path)
+        if capi is not None:
+            for name in self.p2p_posts:
+                fn = capi.function(name)
+                if fn is None:
+                    continue   # abi rules own existence
+                if "frPush(" not in fn.body:
+                    out.append(self.violation(
+                        f"unstamped-p2p:{name}", self.capi_path, fn.line,
+                        f"{name} posts a p2p op without frPush — the "
+                        f"wait side can never complete its flight-"
+                        f"recorder entry"))
+        return out

@@ -1,0 +1,178 @@
+"""Lock discipline: extract every lexically-nested mutex acquisition
+(lock B taken while lock A's guard is still in scope, within one
+function body), build the static lock graph across the whole core, and
+fail on (a) cycles — a static AB/BA deadlock candidate — and (b) any
+nesting edge not listed in tools/check/config/lock_order.txt. The
+config file IS the documented lock hierarchy: adding a new nesting
+means writing down why it is safe, in order, next to the others.
+
+Mutex identity is `Class::member` (from the qualified function name)
+or `<file-stem>::name` for file-scope/global mutexes, so `mu_` in Pair
+and `mu_` in Loop stay distinct."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..engine import Corpus, Rule, Violation
+
+CONFIG = "tools/check/config/lock_order.txt"
+
+_GUARD = re.compile(
+    r"std\s*::\s*(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^;>]*>)?\s*\w+\s*[({]\s*([^,;({]+?)\s*[,)}]")
+_MANUAL = re.compile(r"([\w.\->]+?)\s*\.\s*lock\s*\(\s*\)")
+
+
+def _edge_list(text: str) -> Dict[Tuple[str, str], int]:
+    """Parse the allowed-nesting config: one `A -> B` per line, comments
+    with #."""
+    out: Dict[Tuple[str, str], int] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if "->" not in line:
+            raise ValueError(f"lock_order.txt:{ln}: expected 'A -> B', "
+                             f"got: {line}")
+        a, b = (p.strip() for p in line.split("->", 1))
+        out[(a, b)] = ln
+    return out
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("the static mutex-nesting graph is acyclic and every "
+                   "nesting edge is documented in "
+                   "tools/check/config/lock_order.txt")
+
+    roots = ("csrc/tpucoll/**/*.cc", "csrc/tpucoll/**/*.h",
+             "csrc/tpucoll/*.cc", "csrc/tpucoll/*.h")
+    config_path = CONFIG
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        allowed: Dict[Tuple[str, str], int] = {}
+        cfg = corpus.text(self.config_path)
+        if cfg is not None:
+            allowed = _edge_list(cfg)
+
+        # edge -> (path, line, holder-fn) of first observation
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        paths: List[str] = []
+        for pat in self.roots:
+            paths.extend(corpus.glob(pat))
+        for path in sorted(set(paths)):
+            cpp = corpus.cpp(path)
+            if cpp is None:
+                continue
+            stem = os.path.splitext(os.path.basename(path))[0]
+            for fn in cpp.functions():
+                scope = (fn.name.rsplit("::", 1)[0]
+                         if "::" in fn.name else stem)
+                acquisitions: List[Tuple[int, int, str]] = []
+                for m in _GUARD.finditer(fn.body):
+                    mu = self._canon(scope, m.group(1))
+                    if mu is None:
+                        continue
+                    depth = fn.body.count("{", 0, m.start()) \
+                        - fn.body.count("}", 0, m.start())
+                    line = fn.body_line + fn.body.count("\n", 0,
+                                                        m.start())
+                    acquisitions.append((m.start(), depth, mu, line))
+                for m in _MANUAL.finditer(fn.body):
+                    mu = self._canon(scope, m.group(1))
+                    if mu is None:
+                        continue
+                    depth = fn.body.count("{", 0, m.start()) \
+                        - fn.body.count("}", 0, m.start())
+                    line = fn.body_line + fn.body.count("\n", 0,
+                                                        m.start())
+                    acquisitions.append((m.start(), depth, mu, line))
+                acquisitions.sort()
+                held: List[Tuple[int, int, str]] = []  # (off,depth,mu)
+                for off, depth, mu, line in acquisitions:
+                    # pop guards whose brace scope closed before here
+                    held = [
+                        (o, d, h) for (o, d, h) in held
+                        if not self._scope_closed(fn.body, o, d, off)
+                    ]
+                    for _, _, h in held:
+                        if h != mu:
+                            edges.setdefault((h, mu),
+                                             (path, line, fn.name))
+                    held.append((off, depth, mu))
+        # -- cycle check (DFS) -----------------------------------------
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, []).append(b)
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(graph):
+            stack: List[Tuple[str, List[str]]] = [(start, [start])]
+            while stack:
+                node, trail = stack.pop()
+                for nxt in graph.get(node, []):
+                    if nxt == start:
+                        cyc = tuple(sorted(trail))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        path, line, fnname = edges[(node, start)]
+                        out.append(self.violation(
+                            "cycle:" + "->".join(trail + [start]),
+                            path, line,
+                            f"lock-order cycle "
+                            f"{' -> '.join(trail + [start])} (closing "
+                            f"edge taken in {fnname}) — static "
+                            f"deadlock candidate"))
+                    elif nxt not in trail and len(trail) < 8:
+                        stack.append((nxt, trail + [nxt]))
+        # -- documentation check ---------------------------------------
+        for (a, b), (path, line, fnname) in sorted(edges.items()):
+            if (a, b) not in allowed:
+                out.append(self.violation(
+                    f"undocumented:{a}->{b}", path, line,
+                    f"{fnname} acquires {b} while holding {a}; this "
+                    f"nesting is not documented in {self.config_path} "
+                    f"— add it (with why it is safe) or restructure"))
+        for (a, b), ln in sorted(allowed.items()):
+            if (a, b) not in edges:
+                out.append(self.violation(
+                    f"stale-edge:{a}->{b}", self.config_path, ln,
+                    f"documented nesting {a} -> {b} no longer occurs "
+                    f"in the code — delete the entry"))
+        return out
+
+    @staticmethod
+    def _canon(scope: str, expr: str) -> str:
+        """Normalize a mutex expression to a stable identity, or None
+        for things that are clearly not mutexes (adopt_lock etc.)."""
+        e = expr.strip().replace("this->", "")
+        # Accept plain member/global expressions and no-arg accessor
+        # calls (logMutex()); reject anything with spaces or arguments.
+        if not e or not re.fullmatch(r"[\w.>\-\[\]]+(?:\(\))?", e):
+            return None
+        # Heuristic: project mutexes are named ...mu / ...Mu_ / ...mutex.
+        if not re.search(r"(?i)mu(?:tex)?_?(?:\(\))?$", e):
+            return None
+        if e.startswith("g_"):
+            return "::" + e
+        return f"{scope}::{e}"
+
+    @staticmethod
+    def _scope_closed(body: str, acq_off: int, acq_depth: int,
+                      now_off: int) -> bool:
+        """Did the brace scope the guard was constructed in close
+        between its acquisition and `now_off`?"""
+        depth = acq_depth
+        for i in range(acq_off, now_off):
+            c = body[i]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth < acq_depth:
+                    return True
+        return False

@@ -1,0 +1,121 @@
+"""Metrics name drift across the three layers that each spell the
+names by hand:
+
+- the C++ registry emits snapshot JSON keys (metrics.cc, engine.cc);
+- gloo_tpu/utils/metrics.py reads those keys and renders Prometheus
+  families (gloo_tpu_*);
+- docs/observability.md documents the families operators alert on.
+
+A rename in any one layer silently zeroes dashboards (dict.get defaults
+swallow the mismatch), so: every key the Python layer reads must be
+emitted somewhere (C++ JSON or a Python-side dict literal), every
+Prometheus family emitted must be documented, and every family the docs
+mention must still exist."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..engine import Corpus, Rule, Violation
+
+_FAMILY = re.compile(r"\bgloo_tpu_\w+")
+_TYPE_LINE = re.compile(r"#\s*TYPE\s+(gloo_tpu_\w+)\s+\w+")
+# JSON keys in C++ string literals: the emitters write  "...\"key\":..."
+_CPP_JSON_KEY = re.compile(r'\\"(\w+)\\":')
+# Python-side snapshot reads: x.get("key"...) / x["key"]
+_PY_READ = re.compile(r"""(?:\.get\(\s*|\[)\s*['"](\w+)['"]""")
+_PY_DICT_KEY = re.compile(r"""['"](\w+)['"]\s*:""")
+# Python-side attachment: snap["async"] = ... is an emission too.
+_PY_ASSIGN_KEY = re.compile(r"""\[\s*['"](\w+)['"]\s*\]\s*=[^=]""")
+# Histogram families expand to _bucket/_sum/_count series.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class MetricsDriftRule(Rule):
+    name = "metrics-drift"
+    description = ("snapshot keys, Prometheus families, and "
+                   "docs/observability.md agree on every metric name")
+
+    cpp_emitters = ("csrc/tpucoll/**/*.cc", "csrc/tpucoll/*.cc")
+    exposition = "gloo_tpu/utils/metrics.py"
+    py_emitters = ("gloo_tpu/**/*.py", "gloo_tpu/*.py")
+    doc_roots = ("docs/*.md", "README.md")
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        expo = corpus.text(self.exposition)
+        if expo is None:
+            return [self.violation("no-exposition", self.exposition, 1,
+                                   f"{self.exposition} not found")]
+
+        # -- emitted snapshot keys (C++ JSON writers + Python dicts) ---
+        emitted: Set[str] = set()
+        paths: List[str] = []
+        for pat in self.cpp_emitters:
+            paths.extend(corpus.glob(pat))
+        for path in sorted(set(paths)):
+            raw = corpus.text(path)
+            if raw:
+                emitted.update(_CPP_JSON_KEY.findall(raw))
+        py_paths: List[str] = []
+        for pat in self.py_emitters:
+            py_paths.extend(corpus.glob(pat))
+        for path in sorted(set(py_paths)):
+            raw = corpus.text(path)
+            if raw:
+                emitted.update(_PY_DICT_KEY.findall(raw))
+                emitted.update(_PY_ASSIGN_KEY.findall(raw))
+
+        # -- every key the exposition reads must be emitted ------------
+        for m in _PY_READ.finditer(expo):
+            key = m.group(1)
+            if key in emitted:
+                continue
+            line = expo.count("\n", 0, m.start()) + 1
+            v = self.violation(
+                f"unread-key:{key}", self.exposition, line,
+                f"{self.exposition} reads snapshot key {key!r} that no "
+                f"C++ JSON emitter or Python dict literal produces — "
+                f"renamed on one side only?")
+            if v.key not in {x.key for x in out}:
+                out.append(v)
+
+        # -- Prometheus families <-> docs ------------------------------
+        families = set(_TYPE_LINE.findall(expo))
+        emitted_names = set(_FAMILY.findall(expo))
+        doc_names: Dict[str, Tuple[str, int]] = {}
+        doc_paths: List[str] = []
+        for pat in self.doc_roots:
+            doc_paths.extend(corpus.glob(pat))
+        for path in sorted(set(doc_paths)):
+            text = corpus.text(path)
+            if text is None:
+                continue
+            for m in _FAMILY.finditer(text):
+                doc_names.setdefault(m.group(0),
+                                     (path, text.count("\n", 0,
+                                                       m.start()) + 1))
+        for fam in sorted(families):
+            if fam in doc_names or any(
+                    fam + s in doc_names for s in _HIST_SUFFIXES):
+                continue
+            out.append(self.violation(
+                f"undocumented-family:{fam}", self.exposition,
+                expo[:expo.index(fam)].count("\n") + 1,
+                f"Prometheus family {fam} is emitted but not mentioned "
+                f"in docs — add it to the metrics reference in "
+                f"docs/observability.md"))
+        for name, (path, line) in sorted(doc_names.items()):
+            base = name
+            for s in _HIST_SUFFIXES:
+                if name.endswith(s) and name[:-len(s)] in families:
+                    base = name[:-len(s)]
+            if base in emitted_names:
+                continue
+            out.append(self.violation(
+                f"docs-only-family:{name}", path, line,
+                f"docs mention Prometheus family {name} but the "
+                f"exposition ({self.exposition}) never emits it — "
+                f"stale doc or renamed metric"))
+        return out
