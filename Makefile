@@ -84,7 +84,8 @@ endif
 # of failing on a missing build/tpucoll_unit. Sanitizer flavors skip
 # them: their pytest entry points are the LD_PRELOAD smokes, not these.
 ifeq ($(SAN_SUFFIX),)
-native-cc: $(FB_LIB) build/tpucoll_unit build/tpucoll_integration
+native-cc: $(FB_LIB) build/tpucoll_unit build/tpucoll_integration \
+	build/tpucoll_bench
 else
 native-cc: $(FB_LIB)
 endif
@@ -101,6 +102,13 @@ build/tpucoll_integration: $(FB_BUILD)/tests/integration_main.o $(FB_OBJS)
 	@mkdir -p build
 	$(CXX) -o $@ $^ -lpthread -lrt
 
+# The benchmark CLI (csrc/benchmark/main.cc) — the measurement source of
+# tools/bench_sweep.py and the native-bench pytest wrapper; the cmake
+# build produces it as a first-class target, so the fallback must too.
+build/tpucoll_bench: $(FB_BUILD)/benchmark/main.o $(FB_OBJS)
+	@mkdir -p build
+	$(CXX) -o $@ $^ -lpthread -lrt
+
 $(FB_BUILD)/tpucoll/common/crypto_avx512.o: \
 		csrc/tpucoll/common/crypto_avx512.cc
 	@mkdir -p $(dir $@)
@@ -111,7 +119,7 @@ $(FB_BUILD)/%.o: csrc/%.cc
 	$(CXX) $(FB_FLAGS) -c $< -o $@
 
 -include $(FB_OBJS:.o=.d) $(FB_BUILD)/tests/unit_main.d \
-	$(FB_BUILD)/tests/integration_main.d
+	$(FB_BUILD)/tests/integration_main.d $(FB_BUILD)/benchmark/main.d
 
 test: native
 	python -m pytest tests/ -x -q

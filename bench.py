@@ -25,6 +25,13 @@ host's remaining noise floor next to the number), runs lists all five.
 transport grid (TPUCOLL_LOOP_THREADS x TPUCOLL_CHANNELS x
 TPUCOLL_STRIPE_BYTES), one JSON line per point, feeding the tuning
 plane's transport hints; add --quick for a small smoke grid.
+
+--wire-sweep measures allreduce algbw across the wire-codec family
+(plain ring vs ring_bf16_wire vs ring_q8_wire) x payload size under
+TPUCOLL_SHM=0 (the TCP plane, where wire bytes are the bottleneck the
+codecs exist to cut), one JSON line per (algorithm, size) point — the
+crossover data the tuner's lossy arms and future rounds consume; add
+--quick for a small smoke grid.
 """
 
 import json
@@ -548,6 +555,91 @@ def bench_channel_sweep(quick=False):
         sys.exit(1)
 
 
+def bench_wire_sweep(quick=False):
+    """--wire-sweep: 2-rank allreduce algbw per (wire codec x size)
+    point under TPUCOLL_SHM=0 — the host plane's wire-compression
+    crossover data (ISSUE 11; docs/algorithms.md precision contract).
+    One JSON line per point; fresh subprocesses per point so transport
+    state never leaks between cells. Every run verifies the reduced
+    values first: exact for the lossless ring, within the q8/bf16
+    per-hop error bound for the codecs."""
+    import tempfile
+    import textwrap
+
+    if quick:
+        sizes = [1 << 20]  # 4 MiB f32
+        iters, warmup = 3, 1
+    else:
+        sizes = [1 << 20, 1 << 22, ELEMENTS]  # 4 MiB, 16 MiB, 64 MiB
+        iters, warmup = 8, 2
+    algorithms = ["ring", "ring_bf16_wire", "ring_q8_wire"]
+
+    body = textwrap.dedent("""
+        import sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4])
+        warm = int(sys.argv[5]); algo = sys.argv[6]
+        x = np.full(n, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, algorithm=algo)
+        # 1+2=3 is exactly representable through both codecs' per-hop
+        # quantization only to within one step; bound the error instead
+        # of asserting exactness for the lossy arms.
+        tol = 0.0 if algo == "ring" else 3.0 / 127.0
+        assert abs(x[0] - 3.0) <= tol, x[0]
+        x[:] = 1.0
+        for _ in range(warm):
+            ctx.allreduce(x, algorithm=algo)
+        x[:] = 1.0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, algorithm=algo)
+            times.append(time.perf_counter() - t0)
+            x[:] = 1.0  # repeated lossy sums must not drift the scale
+        if rank == 0:
+            print("P50US", int(np.median(times) * 1e6))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    ok_all = True
+    for elements in sizes:
+        for algo in algorithms:
+            store = tempfile.mkdtemp()
+            env = dict(os.environ, TPUCOLL_SHM="0")
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", body, str(r), store, str(elements),
+                 str(iters), str(warmup), algo],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env) for r in range(2)]
+            outs = [p.communicate(timeout=600) for p in procs]
+            line = {"metric": "wire_sweep", "algorithm": algo,
+                    "elements": elements,
+                    "bytes": elements * 4, "iters": iters, "unit": "GB/s"}
+            if any(p.returncode != 0 for p in procs) or \
+                    "P50US" not in outs[0][0]:
+                ok_all = False
+                line["ok"] = False
+                line["error"] = [f"rank {r}: rc={p.returncode} "
+                                 f"err={outs[r][1][-200:]!r}"
+                                 for r, p in enumerate(procs)]
+            else:
+                p50_us = int(outs[0][0].split("P50US", 1)[1].split()[0])
+                line["value"] = round(
+                    elements * 4 / (p50_us * 1e-6) / 1e9, 3)
+                line["p50_us"] = p50_us
+                line["ok"] = True
+            print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def bench_grad_bucket(n_tensors, lanes=2, pin=False):
     """--grad-bucket N: the training-shaped workload — N heterogeneous
     gradient tensors with log-normally distributed sizes, allreduced
@@ -720,6 +812,9 @@ def main():
         return
     if "--channel-sweep" in sys.argv[1:]:
         bench_channel_sweep(quick="--quick" in sys.argv[1:])
+        return
+    if "--wire-sweep" in sys.argv[1:]:
+        bench_wire_sweep(quick="--quick" in sys.argv[1:])
         return
     if "--chaos-soak" in sys.argv[1:]:
         i = sys.argv.index("--chaos-soak") + 1

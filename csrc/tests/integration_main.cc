@@ -2,6 +2,7 @@
 // runs every collective, p2p messaging, a fork, and a graceful teardown —
 // with no Python in the loop, so ASAN leak checking covers the whole
 // library lifecycle (contexts, pairs, buffers, scratch, stores).
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 #include <thread>
@@ -59,6 +60,38 @@ void worker(std::shared_ptr<tpucoll::Store> store, int rank, int size,
     allreduce(opts);
     const float expect = size * (size + 1) / 2.0f;
     CHECK(x[0] == expect && x.back() == expect);
+  }
+
+  // q8-wire allreduce: tolerance-based (the int8 codec's decode of a
+  // small-integer sum is within one quantization step but not exact),
+  // plus the consensus contract — every rank's bytes identical —
+  // checked via an allgather of the q8 result.
+  {
+    std::vector<float> x(1000, float(rank + 1));
+    AllreduceOptions opts;
+    opts.context = &ctx;
+    opts.inputs = {x.data()};
+    opts.outputs = {x.data()};
+    opts.count = x.size();
+    opts.algorithm = AllreduceAlgorithm::kRingQ8Wire;
+    opts.tag = 40;
+    allreduce(opts);
+    const float expect = size * (size + 1) / 2.0f;
+    // Per-hop bound: <= (hops) * max/254 per element; generous 2%.
+    CHECK(std::fabs(x[0] - expect) <= 0.02f * expect);
+    CHECK(std::fabs(x.back() - expect) <= 0.02f * expect);
+    std::vector<float> all(x.size() * size);
+    AllgatherOptions ag;
+    ag.context = &ctx;
+    ag.input = x.data();
+    ag.output = all.data();
+    ag.count = x.size();
+    ag.tag = 41;
+    allgather(ag);
+    for (int r = 0; r < size; r++) {
+      CHECK(std::memcmp(all.data() + size_t(r) * x.size(), x.data(),
+                        x.size() * sizeof(float)) == 0);
+    }
   }
 
   // Broadcast + barrier + allgather + reduce_scatter + alltoall.

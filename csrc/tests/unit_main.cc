@@ -3,6 +3,7 @@
 // float16/bfloat16 conversions, and the HMAC-SHA256 vectors. The pytest
 // suite covers everything above via the C API; this binary covers what it
 // cannot observe directly. Exit code 0 = all passed.
+#include <algorithm>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -222,6 +223,63 @@ void testBf16NanLanes() {
   }
 }
 
+void testQ8Codec() {
+  using tpucoll::f32StreamToQ8;
+  using tpucoll::q8StreamAccumulate;
+  using tpucoll::q8StreamToF32;
+  using tpucoll::q8WireBytes;
+  const size_t block = 32;  // small block: exercises several units
+  // Sizes straddling unit boundaries, including a ragged tail and a
+  // sub-block stream.
+  for (size_t n : {size_t(1), size_t(31), size_t(32), size_t(33),
+                   size_t(100), size_t(96)}) {
+    std::vector<float> src(n);
+    uint64_t seed = 0x9E3779B97F4A7C15ull + n;
+    for (size_t i = 0; i < n; i++) {
+      seed = seed * 6364136223846793005ull + 1442695040888963407ull;
+      // Mixed magnitudes, signs, exact zeros.
+      src[i] = (int64_t(seed >> 33) % 2001 - 1000) / 7.0f;
+    }
+    std::vector<uint8_t> wire(q8WireBytes(n, block), 0xAB);
+    f32StreamToQ8(src.data(), wire.data(), n, block);
+    std::vector<float> dec(n);
+    q8StreamToF32(wire.data(), dec.data(), n, block);
+    for (size_t off = 0; off < n; off += block) {
+      const size_t b = std::min(block, n - off);
+      float maxAbs = 0.0f;
+      for (size_t i = 0; i < b; i++) {
+        maxAbs = std::max(maxAbs, std::fabs(src[off + i]));
+      }
+      const float bound = maxAbs / 254.0f * 1.000001f;
+      for (size_t i = 0; i < b; i++) {
+        CHECK(std::fabs(src[off + i] - dec[off + i]) <= bound);
+      }
+    }
+    // Accumulate == decode + add, element-wise identical.
+    std::vector<float> acc1(n, 0.5f), acc2(n, 0.5f);
+    q8StreamAccumulate(acc1.data(), wire.data(), n, block);
+    for (size_t i = 0; i < n; i++) {
+      acc2[i] += dec[i];
+      CHECK(acc1[i] == acc2[i]);
+    }
+  }
+  // All-zero blocks are exactly representable (scale 0, zero codes).
+  std::vector<float> zeros(70, 0.0f);
+  std::vector<uint8_t> zwire(q8WireBytes(zeros.size(), block));
+  f32StreamToQ8(zeros.data(), zwire.data(), zeros.size(), block);
+  std::vector<float> zdec(zeros.size(), 1.0f);
+  q8StreamToF32(zwire.data(), zdec.data(), zeros.size(), block);
+  for (float v : zdec) {
+    CHECK(v == 0.0f);
+  }
+  // The max element of every nonzero block always codes to ±127 (the
+  // symmetric-scale invariant the error bound rests on).
+  std::vector<float> one{3.5f, -7.0f, 1.0f};
+  std::vector<uint8_t> owire(q8WireBytes(one.size(), block));
+  f32StreamToQ8(one.data(), owire.data(), one.size(), block);
+  CHECK(static_cast<int8_t>(owire[4 + 1]) == -127);
+}
+
 void testCryptoVectors() {
   using tpucoll::AeadKey;
   using tpucoll::aeadOpen;
@@ -437,6 +495,7 @@ int main() {
   testReduceKernels();
   testHalfMinMaxProdKernels();
   testBf16NanLanes();
+  testQ8Codec();
   testHmacVectors();
   testCryptoVectors();
   testSysinfoProbes();

@@ -906,6 +906,48 @@ int tc_allreduce_multi(void* ctx, const void** inputs, void** outputs,
   });
 }
 
+// ---- int8 block-quantized wire codec (math.h q8 stream layout) ----
+// Exposed for the Python surface and the q8 property tests: the same
+// kernels AllreduceAlgorithm::kRingQ8Wire runs per hop.
+
+// Resolved TPUCOLL_Q8_BLOCK (elements per block); 0 + tc_last_error on a
+// malformed knob.
+size_t tc_q8_block() {
+  return wrapVal<size_t>(0, [&] { return tpucoll::q8BlockElems(); });
+}
+
+// Wire bytes a `count`-element float32 stream occupies after encoding.
+size_t tc_q8_wire_bytes(size_t count) {
+  return wrapVal<size_t>(0, [&] {
+    return tpucoll::q8WireBytes(count, tpucoll::q8BlockElems());
+  });
+}
+
+// Encode `count` float32 elements into the q8 wire stream. dstBytes must
+// equal tc_q8_wire_bytes(count) — a size echo so a stale caller fails
+// loudly instead of overrunning.
+int tc_q8_encode(const void* src, size_t count, void* dst,
+                 size_t dstBytes) {
+  return wrap([&] {
+    const size_t block = tpucoll::q8BlockElems();
+    TC_ENFORCE_EQ(dstBytes, tpucoll::q8WireBytes(count, block));
+    tpucoll::f32StreamToQ8(static_cast<const float*>(src),
+                           static_cast<uint8_t*>(dst), count, block);
+  });
+}
+
+// Decode a q8 wire stream back to `count` float32 elements (srcBytes
+// echoes tc_q8_wire_bytes(count)).
+int tc_q8_decode(const void* src, size_t srcBytes, void* dst,
+                 size_t count) {
+  return wrap([&] {
+    const size_t block = tpucoll::q8BlockElems();
+    TC_ENFORCE_EQ(srcBytes, tpucoll::q8WireBytes(count, block));
+    tpucoll::q8StreamToF32(static_cast<const uint8_t*>(src),
+                           static_cast<float*>(dst), count, block);
+  });
+}
+
 // ---- async collective engine (async/engine.h) ----
 
 // COLLECTIVE constructor: forks `lanes` privately-tagged sub-contexts

@@ -64,6 +64,21 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
                            std::chrono::milliseconds timeout);
 
+// Ring allreduce with the int8 block-quantized wire codec (float32 sum
+// payloads; math.h q8 stream layout, TPUCOLL_Q8_BLOCK block size).
+// Accumulation stays float32; every reduce-scatter hop re-quantizes, and
+// the allgather phase forwards the owner's final quantized stream
+// verbatim so all ranks decode bit-identical results.
+void q8WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
+                         std::chrono::milliseconds timeout);
+
+// Ring reduce-scatter over the same q8 wire (startShift -1: rank r ends
+// owning reduced block r of `blocks`, full-precision float32 — only the
+// wire hops are quantized).
+void q8WireRingReduceScatter(Context* ctx, char* work,
+                             const collectives_detail::Blocks& blocks,
+                             Slot slot, std::chrono::milliseconds timeout);
+
 // Log-latency reduce-scatter by recursive vector halving (contract of
 // reference gloo/reduce_scatter.h:21-329, re-derived for the in-order
 // window walk): log2(P) rounds over windows of the caller's per-rank

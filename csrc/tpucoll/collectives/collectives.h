@@ -78,6 +78,21 @@ enum class AllreduceAlgorithm : uint8_t {
   // tuned table can elect one directly.
   kHdFold = 6,
   kHdBlocks = 7,
+  // int8 block-quantized wire compression (float32 payloads only):
+  // ~4x fewer wire bytes than float32 (~2x vs bf16-wire) at ~2.4
+  // decimal digits of per-block precision; accumulation stays float32;
+  // all ranks receive identical results (the allgather phase forwards
+  // the final quantized stream verbatim). Opt-in — see
+  // collectives_q8.cc for the precision contract and TPUCOLL_Q8_BLOCK.
+  kRingQ8Wire = 8,
+  // kAuto that is ADDITIONALLY allowed to elect the lossy wire codecs
+  // (bf16/q8) from the installed tuning table — the caller's explicit
+  // opt-in to reduced wire precision on float32 sum allreduces. For any
+  // other (dtype, op, customFn) shape, or when no wire arm measures
+  // faster, it dispatches exactly like kAuto. Untuned fallback: the
+  // bandwidth tier (payloads past TPUCOLL_ALLREDUCE_HD_MAX) rides
+  // kRingQ8Wire, the latency tiers stay lossless.
+  kAutoLossyWire = 9,
 };
 
 struct AllreduceOptions : CollectiveOptions {
@@ -93,7 +108,8 @@ struct AllreduceOptions : CollectiveOptions {
   // Overrides `op` when set: an arbitrary commutative-associative
   // accumulate fn(acc, in, n_elems) (reference: gloo/allreduce.h:36 takes
   // any Func; gloo/algorithm.h:59-95 ReductionFunction CUSTOM). Not
-  // compatible with kRingBf16Wire (the wire codec reduces in bf16).
+  // compatible with the wire-compressed algorithms (kRingBf16Wire /
+  // kRingQ8Wire reduce through their wire codecs).
   ReduceFn customFn = nullptr;
   AllreduceAlgorithm algorithm = AllreduceAlgorithm::kAuto;
 };
@@ -195,6 +211,13 @@ enum class ReduceScatterAlgorithm : uint8_t {
   kRing = 1,
   kHalvingDoubling = 2,
   kDirect = 3,
+  // Ring reduce-scatter with the int8 block-quantized wire codec
+  // (float32 sum only; opt-in, never auto-elected — the tuner measures
+  // it so the table can report its headroom). Accumulation stays
+  // float32; each rank's result block is the full-precision accumulator,
+  // only the wire hops are quantized. Precision contract:
+  // collectives_q8.cc.
+  kRingQ8Wire = 4,
 };
 
 struct ReduceScatterOptions : CollectiveOptions {

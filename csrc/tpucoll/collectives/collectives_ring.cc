@@ -362,13 +362,38 @@ void allreduce(AllreduceOptions& opts) {
   }
 
   TC_ENFORCE(opts.customFn == nullptr ||
-                 opts.algorithm != AllreduceAlgorithm::kRingBf16Wire,
+                 (opts.algorithm != AllreduceAlgorithm::kRingBf16Wire &&
+                  opts.algorithm != AllreduceAlgorithm::kRingQ8Wire),
              "allreduce: custom reduction functions are incompatible "
-             "with the bf16-wire algorithm (it accumulates in bf16)");
+             "with the wire-compressed algorithms (they reduce through "
+             "the wire codec)");
 
   if (size > 1 && opts.count > 0) {
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
     AllreduceAlgorithm algo = opts.algorithm;
+    if (algo == AllreduceAlgorithm::kAutoLossyWire) {
+      // The caller's explicit opt-in to lossy wire precision. Only the
+      // float32 sum shape has wire codecs; anything else dispatches as
+      // plain kAuto. Tuned contexts elect from measurement (wire arms
+      // included); the untuned fallback routes the bandwidth tier to
+      // the q8 ring — the caller asked for wire compression exactly
+      // because the payload is bandwidth-bound.
+      if (opts.dtype == DataType::kFloat32 && opts.op == ReduceOp::kSum &&
+          opts.customFn == nullptr) {
+        if (auto tuned =
+                tuning::tableAllreduce(ctx, opts.dtype, nbytes,
+                                       /*lossyWireOk=*/true)) {
+          algo = *tuned;
+        } else {
+          static const size_t hdMaxLossy = collectives_detail::envBytes(
+              "TPUCOLL_ALLREDUCE_HD_MAX", 1u << 20);
+          algo = nbytes > hdMaxLossy ? AllreduceAlgorithm::kRingQ8Wire
+                                     : AllreduceAlgorithm::kAuto;
+        }
+      } else {
+        algo = AllreduceAlgorithm::kAuto;
+      }
+    }
     if (algo == AllreduceAlgorithm::kAuto) {
       // Measured tuning table first (tuning/dispatch.h: per-deployment
       // crossovers elected by tuning::tune and installed identically on
@@ -428,6 +453,14 @@ void allreduce(AllreduceOptions& opts) {
                    "bf16-wire allreduce supports sum only");
         algorithms::bf16WireRingAllreduce(ctx, work, opts.count, slot,
                                           timeout);
+        break;
+      case AllreduceAlgorithm::kRingQ8Wire:
+        TC_ENFORCE(opts.dtype == DataType::kFloat32,
+                   "q8-wire allreduce requires float32 payloads");
+        TC_ENFORCE(opts.op == ReduceOp::kSum,
+                   "q8-wire allreduce supports sum only");
+        algorithms::q8WireRingAllreduce(ctx, work, opts.count, slot,
+                                        timeout);
         break;
       default:
         TC_THROW(EnforceError, "unknown allreduce algorithm");
@@ -704,6 +737,13 @@ void reduceScatter(ReduceScatterOptions& opts) {
                         /*startShift=*/-1, timeout, workBuf.get(), fuseOk);
       break;
     }
+    case ReduceScatterAlgorithm::kRingQ8Wire:
+      TC_ENFORCE(opts.dtype == DataType::kFloat32,
+                 "q8-wire reduce_scatter requires float32 payloads");
+      TC_ENFORCE(opts.op == ReduceOp::kSum && opts.customFn == nullptr,
+                 "q8-wire reduce_scatter supports builtin sum only");
+      algorithms::q8WireRingReduceScatter(ctx, work, blocks, slot, timeout);
+      break;
     default:
       TC_THROW(EnforceError, "unknown reduce_scatter algorithm");
   }

@@ -1,8 +1,11 @@
 #include "tpucoll/math.h"
 
 #include <algorithm>
+#include <cmath>
 #include <cstring>
 #include <type_traits>
+
+#include "tpucoll/common/env.h"
 
 #if defined(__AVX2__) && defined(__F16C__)
 #include <immintrin.h>
@@ -308,6 +311,203 @@ void bf16StreamAccumulate(float* dst, const uint16_t* src, size_t n) {
 #endif
   for (; i < n; i++) {
     dst[i] += bfloat16ToFloat(src[i]);
+  }
+}
+
+// ---- int8 block-quantized wire codec (math.h for the stream layout) ----
+
+// The codec's documented arithmetic is mul-THEN-add (two roundings):
+// GCC's default -ffp-contract=fast would fuse both the scalar tails and
+// the explicit _mm256_mul_ps/_mm256_add_ps pairs into FMAs, silently
+// changing the accumulate's rounding vs a decode-then-add (and vs
+// clang-built or no-FMA-ISA peers). Pin contraction off for the codec
+// functions so `q8StreamAccumulate == q8StreamToF32 + add` holds
+// exactly (unit-tested) on every build of one ISA generation.
+#if defined(__GNUC__) && !defined(__clang__)
+#define TC_Q8_NO_FP_CONTRACT __attribute__((optimize("fp-contract=off")))
+#else
+// clang defaults to ISO contraction (never across statements), which
+// already preserves the mul-then-add shape used here.
+#define TC_Q8_NO_FP_CONTRACT
+#endif
+
+size_t q8BlockElems() {
+  static const size_t block = static_cast<size_t>(
+      envCount("TPUCOLL_Q8_BLOCK", 256, 8,
+               static_cast<long>(kQ8MaxBlockElems)));
+  return block;
+}
+
+namespace {
+
+#ifndef TC_HAVE_VECTOR_HALF
+// Scalar quantize of one block: the reference semantics the vector path
+// must match byte-for-byte. nearbyintf under the default FE_TONEAREST
+// mode is round-half-to-even, the same rounding
+// _mm256_round_ps(NEAREST) uses.
+TC_Q8_NO_FP_CONTRACT
+inline void q8EncodeBlockScalar(const float* src, uint8_t* dst, size_t n) {
+  float maxAbs = 0.0f;
+  for (size_t i = 0; i < n; i++) {
+    maxAbs = std::max(maxAbs, std::fabs(src[i]));
+  }
+  const float scale = maxAbs / 127.0f;
+  std::memcpy(dst, &scale, kQ8ScaleBytes);
+  int8_t* codes = reinterpret_cast<int8_t*>(dst + kQ8ScaleBytes);
+  if (scale == 0.0f) {
+    std::memset(codes, 0, n);
+    return;
+  }
+  for (size_t i = 0; i < n; i++) {
+    // The max element can land on ±128 when the scale division rounds
+    // down; clip keeps codes in the symmetric ±127 range.
+    int q = static_cast<int>(nearbyintf(src[i] / scale));
+    q = std::min(127, std::max(-127, q));
+    codes[i] = static_cast<int8_t>(q);
+  }
+}
+
+template <bool accumulate>
+TC_Q8_NO_FP_CONTRACT
+inline void q8DecodeBlockScalar(float* acc, const uint8_t* unit, size_t n) {
+  float scale;
+  std::memcpy(&scale, unit, kQ8ScaleBytes);
+  const int8_t* codes = reinterpret_cast<const int8_t*>(unit +
+                                                        kQ8ScaleBytes);
+  for (size_t i = 0; i < n; i++) {
+    const float v = static_cast<float>(codes[i]) * scale;
+    acc[i] = accumulate ? acc[i] + v : v;
+  }
+}
+#endif  // !TC_HAVE_VECTOR_HALF
+
+#ifdef TC_HAVE_VECTOR_HALF
+
+inline float hmax8(__m256 v) {
+  __m128 lo = _mm256_castps256_ps128(v);
+  __m128 hi = _mm256_extractf128_ps(v, 1);
+  __m128 m = _mm_max_ps(lo, hi);
+  m = _mm_max_ps(m, _mm_movehl_ps(m, m));
+  m = _mm_max_ss(m, _mm_shuffle_ps(m, m, 1));
+  return _mm_cvtss_f32(m);
+}
+
+// Vector quantize of one block. Identical bytes to the scalar path:
+// max over |x| is order-insensitive, the per-element work is a genuine
+// IEEE division (not a reciprocal multiply) with round-to-nearest-even,
+// and the clip happens on the converted int32 lanes.
+TC_Q8_NO_FP_CONTRACT
+inline void q8EncodeBlockVec(const float* src, uint8_t* dst, size_t n) {
+  const __m256 absMask = _mm256_castsi256_ps(_mm256_set1_epi32(0x7fffffff));
+  __m256 vmax = _mm256_setzero_ps();
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    vmax = _mm256_max_ps(vmax, _mm256_and_ps(_mm256_loadu_ps(src + i),
+                                             absMask));
+  }
+  float maxAbs = hmax8(vmax);
+  for (; i < n; i++) {
+    maxAbs = std::max(maxAbs, std::fabs(src[i]));
+  }
+  const float scale = maxAbs / 127.0f;
+  std::memcpy(dst, &scale, kQ8ScaleBytes);
+  int8_t* codes = reinterpret_cast<int8_t*>(dst + kQ8ScaleBytes);
+  if (scale == 0.0f) {
+    std::memset(codes, 0, n);
+    return;
+  }
+  const __m256 vscale = _mm256_set1_ps(scale);
+  const __m256i lim = _mm256_set1_epi32(127);
+  const __m256i nlim = _mm256_set1_epi32(-127);
+  i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 q = _mm256_round_ps(
+        _mm256_div_ps(_mm256_loadu_ps(src + i), vscale),
+        _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC);
+    __m256i qi = _mm256_min_epi32(_mm256_max_epi32(_mm256_cvtps_epi32(q),
+                                                   nlim), lim);
+    // 8 x int32 -> 8 x int8: pack within 128-bit lanes, then stitch.
+    __m128i lo = _mm256_castsi256_si128(qi);
+    __m128i hi = _mm256_extracti128_si256(qi, 1);
+    __m128i p16 = _mm_packs_epi32(lo, hi);
+    __m128i p8 = _mm_packs_epi16(p16, p16);
+    _mm_storel_epi64(reinterpret_cast<__m128i*>(codes + i), p8);
+  }
+  for (; i < n; i++) {
+    int q = static_cast<int>(nearbyintf(src[i] / scale));
+    q = std::min(127, std::max(-127, q));
+    codes[i] = static_cast<int8_t>(q);
+  }
+}
+
+// acc[i] (+)= codes[i] * scale over one block: accumulate=true folds,
+// false overwrites (pure decode). Mul then add — never FMA — so the
+// vector result equals the scalar fallback bit-for-bit.
+template <bool accumulate>
+TC_Q8_NO_FP_CONTRACT
+inline void q8DecodeBlockVec(float* acc, const uint8_t* unit, size_t n) {
+  float scale;
+  std::memcpy(&scale, unit, kQ8ScaleBytes);
+  const int8_t* codes = reinterpret_cast<const int8_t*>(unit +
+                                                        kQ8ScaleBytes);
+  const __m256 vscale = _mm256_set1_ps(scale);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i qi = _mm256_cvtepi8_epi32(
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(codes + i)));
+    __m256 v = _mm256_mul_ps(_mm256_cvtepi32_ps(qi), vscale);
+    if (accumulate) {
+      v = _mm256_add_ps(_mm256_loadu_ps(acc + i), v);
+    }
+    _mm256_storeu_ps(acc + i, v);
+  }
+  for (; i < n; i++) {
+    const float v = static_cast<float>(codes[i]) * scale;
+    acc[i] = accumulate ? acc[i] + v : v;
+  }
+}
+
+#endif  // TC_HAVE_VECTOR_HALF
+
+}  // namespace
+
+TC_Q8_NO_FP_CONTRACT
+void f32StreamToQ8(const float* src, uint8_t* dst, size_t n, size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q8EncodeBlockVec(src + off, dst, b);
+#else
+    q8EncodeBlockScalar(src + off, dst, b);
+#endif
+    dst += q8UnitBytes(b);
+  }
+}
+
+TC_Q8_NO_FP_CONTRACT
+void q8StreamToF32(const uint8_t* src, float* dst, size_t n, size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q8DecodeBlockVec<false>(dst + off, src, b);
+#else
+    q8DecodeBlockScalar<false>(dst + off, src, b);
+#endif
+    src += q8UnitBytes(b);
+  }
+}
+
+TC_Q8_NO_FP_CONTRACT
+void q8StreamAccumulate(float* dst, const uint8_t* src, size_t n,
+                        size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q8DecodeBlockVec<true>(dst + off, src, b);
+#else
+    q8DecodeBlockScalar<true>(dst + off, src, b);
+#endif
+    src += q8UnitBytes(b);
   }
 }
 

@@ -43,6 +43,43 @@ void bf16StreamToF32(const uint16_t* src, float* dst, size_t n);
 // dst[i] += decode(src[i])
 void bf16StreamAccumulate(float* dst, const uint16_t* src, size_t n);
 
+// ---- int8 block-quantized wire codec (EQuARX-style, host plane) ----
+//
+// Stream layout: consecutive UNITS, one per block of `block` float32
+// elements — a 4-byte little-endian float32 scale followed by `block`
+// int8 codes; the final unit of a stream carries only the tail
+// (n % block) codes, unpadded. Symmetric per-block quantization:
+// scale = max|x| / 127, code = clip(round(x / scale), -127, 127);
+// an all-zero (or all-subnormal-flushed) block stores scale 0 and zero
+// codes. Decode is code * scale in float32. The scalar and AVX2 paths
+// produce byte-identical streams (division, round-to-nearest-even, and
+// max are computed with the same IEEE operations in both), so mixed-ISA
+// groups keep wire consensus. Non-finite inputs are out of contract:
+// a NaN/Inf element poisons its block's scale (documented in
+// docs/errors.md with the rest of the precision contract).
+constexpr size_t kQ8ScaleBytes = 4;
+constexpr size_t kQ8MaxBlockElems = 2048;
+
+// Block size in elements: TPUCOLL_Q8_BLOCK (strict count, [8, 2048],
+// default 256), resolved once per process — both sides of every wire
+// must agree, so the knob must match across ranks (docs/env.md).
+size_t q8BlockElems();
+
+inline size_t q8UnitBytes(size_t block) { return kQ8ScaleBytes + block; }
+
+// Total wire bytes for an n-element stream at the given block size.
+inline size_t q8WireBytes(size_t n, size_t block) {
+  const size_t blocks = (n + block - 1) / block;
+  return blocks * kQ8ScaleBytes + n;
+}
+
+void f32StreamToQ8(const float* src, uint8_t* dst, size_t n, size_t block);
+void q8StreamToF32(const uint8_t* src, float* dst, size_t n, size_t block);
+// dst[i] += decode(src unit stream); mul-then-add (no FMA) so the
+// accumulated values are identical across the scalar and vector paths.
+void q8StreamAccumulate(float* dst, const uint8_t* src, size_t n,
+                        size_t block);
+
 inline uint64_t log2ceil(uint64_t n) {
   uint64_t r = 0;
   while ((uint64_t(1) << r) < n) {

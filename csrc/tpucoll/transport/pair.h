@@ -431,8 +431,10 @@ class Pair : public Handler {
   size_t shmRxCombineElsize_{0};     // wire bytes per element
   size_t shmRxCombineAccElsize_{0};  // accumulator bytes per element
   // Over-aligned: the carry is fed to typed reduce kernels as a 1-element
-  // span, so it must satisfy the strictest alignment any elsize allows.
-  alignas(kMaxCombineElsize) uint8_t shmRxCarry_[kMaxCombineElsize];
+  // span, so it must satisfy the strictest alignment any kernel wants
+  // (kMaxCombineElsize itself is no longer a power of two — it is sized
+  // for q8 wire units — so the alignment is pinned at a cache line).
+  alignas(64) uint8_t shmRxCarry_[kMaxCombineElsize];
   size_t shmRxCarryLen_{0};
 
   // Combine one in-order span of the active shm message (handles

@@ -30,7 +30,12 @@ using RecvReduceFn = void (*)(void* acc, const void* in, size_t n);
 
 // Ceiling on the element size a recvReduce may use: the shm receive path
 // keeps a carry buffer this large for ring spans that split an element.
-constexpr size_t kMaxCombineElsize = 32;
+// Sized for the largest q8 wire unit (4-byte scale + TPUCOLL_Q8_BLOCK
+// int8 codes at its 2048 maximum, math.h) — the widest "element" any
+// typed fused receive currently folds; plain dtype reductions stay <= 32.
+// A static_assert in collectives_q8.cc ties this to kQ8MaxBlockElems so
+// the two cannot drift apart silently.
+constexpr size_t kMaxCombineElsize = 2052;
 
 class UnboundBuffer {
  public:

@@ -11,14 +11,25 @@ namespace tuning {
 
 namespace {
 
-// Dispatch-eligible arms per collective. bf16-wire is measured by the
-// tuner but absent here (precision contract is opt-in); hd_fold /
-// hd_blocks appear as first-class arms so a tuned non-power-of-2 group
-// can land on the cheaper variant directly.
+// Dispatch-eligible arms per collective. The wire codecs (bf16/q8) are
+// measured by the tuner but excluded from the default set (their
+// precision contract is opt-in) — kAutoLossyWire widens the set via the
+// lossy list below; hd_fold / hd_blocks appear as first-class arms so a
+// tuned non-power-of-2 group can land on the cheaper variant directly.
 const std::vector<std::string>& allreduceArms() {
   static const std::vector<std::string> arms = {
       "ring", "halving_doubling", "recursive_doubling",
       "bcube", "hd_fold", "hd_blocks"};
+  return arms;
+}
+
+const std::vector<std::string>& allreduceArmsLossy() {
+  static const std::vector<std::string> arms = [] {
+    std::vector<std::string> a = allreduceArms();
+    a.push_back("ring_bf16_wire");
+    a.push_back("ring_q8_wire");
+    return a;
+  }();
   return arms;
 }
 
@@ -61,6 +72,8 @@ const char* allreduceAlgorithmName(AllreduceAlgorithm algo) {
     case AllreduceAlgorithm::kRecursiveDoubling: return "recursive_doubling";
     case AllreduceAlgorithm::kHdFold: return "hd_fold";
     case AllreduceAlgorithm::kHdBlocks: return "hd_blocks";
+    case AllreduceAlgorithm::kRingQ8Wire: return "ring_q8_wire";
+    case AllreduceAlgorithm::kAutoLossyWire: return "auto_lossy_wire";
   }
   return "unknown";
 }
@@ -80,19 +93,22 @@ const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo) {
     case ReduceScatterAlgorithm::kRing: return "ring";
     case ReduceScatterAlgorithm::kHalvingDoubling: return "halving_doubling";
     case ReduceScatterAlgorithm::kDirect: return "direct";
+    case ReduceScatterAlgorithm::kRingQ8Wire: return "ring_q8_wire";
   }
   return "unknown";
 }
 
 std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
                                                  DataType dtype,
-                                                 size_t nbytes) {
+                                                 size_t nbytes,
+                                                 bool lossyWireOk) {
   auto table = ctx->tuningTable();
   if (table == nullptr) {
     return std::nullopt;
   }
-  auto name = table->choose("allreduce", ctx->size(), dataTypeName(dtype),
-                            nbytes, allreduceArms());
+  auto name = table->choose(
+      "allreduce", ctx->size(), dataTypeName(dtype), nbytes,
+      lossyWireOk ? allreduceArmsLossy() : allreduceArms());
   if (!name.has_value()) {
     return std::nullopt;
   }
@@ -104,6 +120,8 @@ std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
   if (*name == "bcube") return AllreduceAlgorithm::kBcube;
   if (*name == "hd_fold") return AllreduceAlgorithm::kHdFold;
   if (*name == "hd_blocks") return AllreduceAlgorithm::kHdBlocks;
+  if (*name == "ring_bf16_wire") return AllreduceAlgorithm::kRingBf16Wire;
+  if (*name == "ring_q8_wire") return AllreduceAlgorithm::kRingQ8Wire;
   return std::nullopt;
 }
 

@@ -27,9 +27,15 @@ const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo);
 // Table-elected algorithm for a kAuto call, or nullopt to use the
 // fallback constants. Deterministic across ranks: the table is
 // rank-identical and (dtype, nbytes, size) match by collective contract.
+// lossyWireOk widens the eligible arm set with the wire codecs
+// (ring_bf16_wire / ring_q8_wire) — ONLY set for kAutoLossyWire calls
+// whose shape the codecs support (float32 sum, builtin reduction); a
+// plain kAuto must never change the precision contract behind the
+// caller's back.
 std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
                                                  DataType dtype,
-                                                 size_t nbytes);
+                                                 size_t nbytes,
+                                                 bool lossyWireOk = false);
 std::optional<ReduceAlgorithm> tableReduce(Context* ctx, DataType dtype,
                                            size_t nbytes);
 std::optional<ReduceScatterAlgorithm> tableReduceScatter(Context* ctx,

@@ -50,9 +50,11 @@ std::vector<AllreduceArm> allreduceArms(int size) {
       {"ring", AllreduceAlgorithm::kRing},
       {"recursive_doubling", AllreduceAlgorithm::kRecursiveDoubling},
       {"bcube", AllreduceAlgorithm::kBcube},
-      // Measurement-only in the table (dispatch.h excludes it): shows the
-      // wire-compression headroom next to the elected arm.
+      // Wire codecs: excluded from plain-kAuto dispatch (dispatch.h) but
+      // swept so the table shows their headroom next to the elected arm
+      // and so kAutoLossyWire can elect them from measurement.
       {"ring_bf16_wire", AllreduceAlgorithm::kRingBf16Wire},
+      {"ring_q8_wire", AllreduceAlgorithm::kRingQ8Wire},
   };
   const bool pow2 = (size & (size - 1)) == 0;
   if (pow2) {
@@ -245,6 +247,9 @@ std::shared_ptr<const TuningTable> tune(Context* ctx,
           {"ring", ReduceScatterAlgorithm::kRing},
           {"halving_doubling", ReduceScatterAlgorithm::kHalvingDoubling},
           {"direct", ReduceScatterAlgorithm::kDirect},
+          // Measurement-only (never auto-elected): wire-compression
+          // headroom data for the q8 reduce_scatter opt-in.
+          {"ring_q8_wire", ReduceScatterAlgorithm::kRingQ8Wire},
       };
       std::vector<size_t> recvCounts(size, count / size);
       for (size_t r = 0; r < count % size; r++) {

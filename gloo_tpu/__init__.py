@@ -13,6 +13,10 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
   ICI, plus Pallas ring kernels for custom schedules.
 """
 
+# NOTE: gloo_tpu._jaxcompat (the old-jax API backfill) is deliberately
+# NOT imported here — it would drag the multi-second jax import into
+# every host-plane-only process. The device-plane packages
+# (gloo_tpu.tpu / .ops / .parallel / .models) import it themselves.
 from gloo_tpu import fault, tuning
 from gloo_tpu.bootstrap import detect_launch_env, init_from_env
 from gloo_tpu.bucketer import GradientBucketer
@@ -36,6 +40,10 @@ from gloo_tpu.core import (
     Work,
     crypto_isa_tier,
     derive_keyring,
+    q8_block,
+    q8_decode,
+    q8_encode,
+    q8_wire_bytes,
     uring_available,
 )
 
@@ -65,6 +73,10 @@ __all__ = [
     "init_from_env",
     "derive_keyring",
     "fault",
+    "q8_block",
+    "q8_decode",
+    "q8_encode",
+    "q8_wire_bytes",
     "tuning",
     "uring_available",
 ]

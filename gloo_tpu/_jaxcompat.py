@@ -1,0 +1,123 @@
+"""Version compatibility for the jax surface the device plane uses.
+
+The device-plane code targets the current jax API (``jax.shard_map``,
+``jax.lax.axis_size``); older jax releases (0.4.x) ship the same
+functionality under different names (``jax.experimental.shard_map`` with
+``check_rep``, axis sizes via ``jax.core.axis_frame``). Importing this
+module installs the MISSING upstream names with their exact upstream
+semantics, so every call site stays written against the modern API and
+keeps working untouched when the container pins an old jax. On a modern
+jax this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["install"]
+
+
+def _axis_size_compat(axis_name):
+    """lax.axis_size for jax < 0.4.38: static mesh axis size inside
+    shard_map/pmap traces. axis_frame returned the bare int in some 0.4.x
+    releases and a frame object with .size in others."""
+    import jax.core as core
+
+    frame = core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def _shard_map_compat(f=None, **kwargs):
+    """jax.shard_map for jax < 0.6: the experimental module's entry with
+    the check_vma keyword translated to its old name check_rep."""
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: _sm(g, **kwargs)
+    return _sm(f, **kwargs)
+
+
+def _pcast_compat(x, axis_name=None, to=None):
+    """lax.pcast for jax < 0.7: purely a varying/invariant TYPE cast in
+    the new shard_map vma system — identity on values. Old jax has no
+    vma tracking (shard_map runs with check_rep=False there), so the
+    identity is the exact semantics."""
+    del axis_name, to
+    return x
+
+
+def _sds_vma_tolerant():
+    """jax.ShapeDtypeStruct accepting (and dropping) the vma= keyword on
+    jax releases that predate it."""
+    orig = jax.ShapeDtypeStruct
+
+    class ShapeDtypeStruct(orig):  # noqa: N801 - upstream name
+        def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+            del vma  # no vma tracking on this jax
+            super().__init__(shape, dtype, *args, **kwargs)
+
+    return ShapeDtypeStruct
+
+
+def pallas_interpret_available() -> bool:
+    """True when pallas ships the distributed TPU interpreter
+    (pltpu.InterpretParams) that emulates remote DMAs + semaphores on a
+    CPU mesh. The Pallas ring/overlap kernels need it to run off-TPU;
+    callers (e.g. dryrun_multichip) gate those sections on this."""
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except ImportError:
+        return False
+    return hasattr(pltpu, "InterpretParams")
+
+
+def _install_pallas_compat() -> None:
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except ImportError:
+        return
+    if hasattr(pltpu, "CompilerParams") or not hasattr(pltpu,
+                                                       "TPUCompilerParams"):
+        return
+    import dataclasses
+
+    allowed = {f.name for f in dataclasses.fields(pltpu.TPUCompilerParams)}
+
+    def compiler_params(**kwargs):
+        # TPUCompilerParams is the pre-rename spelling; fields that only
+        # exist in the modern class (e.g. has_side_effects) are dropped —
+        # the kernels passing them also need the distributed interpreter
+        # (pallas_interpret_available), so they cannot run on this jax
+        # either way.
+        return pltpu.TPUCompilerParams(
+            **{k: v for k, v in kwargs.items() if k in allowed})
+
+    pltpu.CompilerParams = compiler_params
+
+
+def install() -> None:
+    """Install the missing names (idempotent)."""
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_compat
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast_compat
+    if not hasattr(jax, "typeof"):
+        # jax.typeof(x) is the public aval accessor; get_aval is its
+        # pre-0.6 spelling (no vma field there — callers that probe
+        # .vma use getattr with a default).
+        import jax.core as _core
+
+        jax.typeof = _core.get_aval
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    except TypeError:
+        jax.ShapeDtypeStruct = _sds_vma_tolerant()
+    _install_pallas_compat()
+
+
+install()

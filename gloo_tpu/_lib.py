@@ -173,6 +173,11 @@ _PROTOTYPES = {
                             ctypes.POINTER(_sz), _int, _u32, _i64]),
     "tc_reduce_scatter": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int,
                                  _int, _int, _u32, _i64]),
+    # int8 block-quantized wire codec (the kRingQ8Wire per-hop kernels)
+    "tc_q8_block": (_sz, []),
+    "tc_q8_wire_bytes": (_sz, [_sz]),
+    "tc_q8_encode": (_int, [_c, _sz, _c, _sz]),
+    "tc_q8_decode": (_int, [_c, _sz, _c, _sz]),
     # async collective engine + work handles
     "tc_async_new": (_c, [_c, _int, _u32]),
     "tc_async_shutdown": (_int, [_c]),

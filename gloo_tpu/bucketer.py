@@ -83,18 +83,31 @@ class GradientBucketer:
     def __init__(self, engine: "core.AsyncEngine",
                  bucket_bytes: Optional[int] = None, op="sum",
                  average: bool = False,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 wire: Optional[str] = None):
         """engine: the context's AsyncEngine (Context.async_engine()).
         bucket_bytes: flush threshold per dtype bucket (default
         TPUCOLL_BUCKET_BYTES, else 25 MiB). op: reduction (callable
         reductions are unsupported — async contract). average=True
         divides every result by world size after the wait (requires
-        op="sum"). timeout: per-bucket collective timeout."""
+        op="sum"). timeout: per-bucket collective timeout. wire: opt-in
+        wire compression for FLOAT32 buckets — "q8" / "bf16" / "lossy",
+        the Context.allreduce shorthand (docs/algorithms.md precision
+        contract); other dtypes' buckets always ride the lossless path
+        (the codecs are float32-only). Requires op="sum"."""
         if callable(op):
             raise Error("GradientBucketer does not support callable "
                         "reductions (async ops run on lane threads)")
         if average and core.ReduceOp.parse(op) != core.ReduceOp.SUM:
             raise Error("average=True requires op='sum'")
+        if wire is not None:
+            if wire not in core.Context._WIRE_ALGORITHMS:
+                raise Error(f"wire= must be one of "
+                            f"{sorted(core.Context._WIRE_ALGORITHMS)}, "
+                            f"got {wire!r}")
+            if core.ReduceOp.parse(op) != core.ReduceOp.SUM:
+                raise Error("wire compression requires op='sum'")
+        self._wire = wire
         self._engine = engine
         self._bucket_bytes = (bucket_bytes if bucket_bytes is not None
                               else _bucket_bytes_from_env())
@@ -128,8 +141,9 @@ class GradientBucketer:
             # and allreduce it in place as its own bucket. Issue order
             # is preserved relative to the flat buckets.
             self._flush_dtype(array.dtype.name)
-            work = self._engine.allreduce_async(array, op=self._op,
-                                                timeout=self._timeout)
+            work = self._engine.allreduce_async(
+                array, op=self._op, timeout=self._timeout,
+                wire=self._wire_for(array.dtype))
             self._issued.append((work, None, None))
             return
         members, nbytes = self._pending.get(array.dtype.name, ([], 0))
@@ -144,6 +158,13 @@ class GradientBucketer:
         for dtype in list(self._pending):
             self._flush_dtype(dtype)
 
+    def _wire_for(self, dtype) -> Optional[str]:
+        # The wire codecs are float32-only; every other dtype's bucket
+        # stays lossless (the deterministic subset of the add stream
+        # that is float32 is identical on every rank, so the per-bucket
+        # algorithm choice is too).
+        return self._wire if dtype == np.float32 else None
+
     def _flush_dtype(self, dtype: str) -> None:
         entry = self._pending.pop(dtype, None)
         if entry is None or not entry[0]:
@@ -155,8 +176,9 @@ class GradientBucketer:
         for m in members:
             flat[off:off + m.size] = m.reshape(-1)
             off += m.size
-        work = self._engine.allreduce_async(flat, op=self._op,
-                                            timeout=self._timeout)
+        work = self._engine.allreduce_async(
+            flat, op=self._op, timeout=self._timeout,
+            wire=self._wire_for(flat.dtype))
         self._issued.append((work, flat, members))
 
     def finish(self, timeout: Optional[float] = None) -> None:

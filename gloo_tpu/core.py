@@ -37,6 +37,10 @@ __all__ = [
     "TimeoutError",
     "UnboundBuffer",
     "Work",
+    "q8_block",
+    "q8_decode",
+    "q8_encode",
+    "q8_wire_bytes",
 ]
 
 _DTYPE_CODES = {
@@ -232,6 +236,52 @@ class TcpStore(Store):
     def __init__(self, host: str, port: int):
         super().__init__(
             check_handle(_lib.lib.tc_tcp_store_new(host.encode(), port)))
+
+
+def q8_block() -> int:
+    """Resolved TPUCOLL_Q8_BLOCK: elements per q8 wire block (default
+    256). Must match on every rank — both ends of each wire parse the
+    same unit size (docs/env.md)."""
+    block = int(_lib.lib.tc_q8_block())
+    if block == 0:
+        raise Error(_lib.last_error())
+    return block
+
+
+def q8_wire_bytes(count: int) -> int:
+    """Wire bytes a `count`-element float32 stream occupies in the q8
+    codec: one float32 scale per block plus one int8 code per element."""
+    nbytes = int(_lib.lib.tc_q8_wire_bytes(count))
+    if nbytes == 0 and count > 0:
+        # 0 is the C boundary's error sentinel (malformed
+        # TPUCOLL_Q8_BLOCK) — a mis-sized wire buffer must not be the
+        # first symptom.
+        raise Error(_lib.last_error())
+    return nbytes
+
+
+def q8_encode(array: np.ndarray) -> np.ndarray:
+    """Encode a float32 array into its q8 wire stream (uint8 array) —
+    the exact per-hop codec AllreduceAlgorithm ring_q8_wire runs, for
+    tests and offline inspection."""
+    _check_array(array)
+    if array.dtype != np.float32:
+        raise Error("q8_encode requires a float32 array")
+    out = np.empty(q8_wire_bytes(array.size), dtype=np.uint8)
+    check(_lib.lib.tc_q8_encode(_ptr(array), array.size, _ptr(out),
+                                out.nbytes))
+    return out
+
+
+def q8_decode(wire: np.ndarray, count: int) -> np.ndarray:
+    """Decode a q8 wire stream (uint8 array from q8_encode) back to
+    `count` float32 elements."""
+    _check_array(wire, "wire")
+    if wire.dtype != np.uint8:
+        raise Error("q8_decode requires a uint8 wire array")
+    out = np.empty(count, dtype=np.float32)
+    check(_lib.lib.tc_q8_decode(_ptr(wire), wire.nbytes, _ptr(out), count))
+    return out
 
 
 def uring_available() -> bool:
@@ -631,12 +681,15 @@ class AsyncEngine:
 
     def allreduce_async(self, array: np.ndarray, op="sum",
                         algorithm: str = "auto",
-                        timeout: Optional[float] = None) -> Work:
+                        timeout: Optional[float] = None,
+                        wire: Optional[str] = None) -> Work:
         """In-place async allreduce; returns a :class:`Work`. Same
-        semantics as Context.allreduce except custom-callable reductions
-        are unsupported (they would run on a lane thread). From issue
-        until wait() returns, `array` must not be read or written — the
-        undefined-contents window of docs/errors.md opens HERE."""
+        semantics as Context.allreduce (including the wire= compression
+        opt-in) except custom-callable reductions are unsupported (they
+        would run on a lane thread). From issue until wait() returns,
+        `array` must not be read or written — the undefined-contents
+        window of docs/errors.md opens HERE."""
+        algorithm = Context._resolve_wire(wire, algorithm)
         _check_array(array)
         if callable(op):
             raise Error("async allreduce does not support callable "
@@ -650,8 +703,12 @@ class AsyncEngine:
     def reduce_scatter_async(self, array: np.ndarray,
                              recv_counts: Optional[Sequence[int]] = None,
                              op="sum", algorithm: str = "auto",
-                             timeout: Optional[float] = None) -> Work:
-        """Async reduce_scatter; the output array is ``work.result``."""
+                             timeout: Optional[float] = None,
+                             wire: Optional[str] = None) -> Work:
+        """Async reduce_scatter; the output array is ``work.result``.
+        wire="q8" opts into the int8 block-quantized wire (float32 sum
+        only; docs/algorithms.md)."""
+        algorithm = Context._resolve_rs_wire(wire, algorithm)
         _check_array(array)
         if callable(op):
             raise Error("async reduce_scatter does not support callable "
@@ -965,12 +1022,55 @@ class Context:
     _ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2, "hd": 2,
                    "bcube": 3, "ring_bf16_wire": 4,
                    "recursive_doubling": 5, "rd": 5,
-                   "hd_fold": 6, "hd_blocks": 7}
+                   "hd_fold": 6, "hd_blocks": 7,
+                   "ring_q8_wire": 8, "q8": 8,
+                   "auto_lossy_wire": 9, "auto_lossy": 9}
     _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
+
+    # wire= shorthand -> allreduce algorithm. The q8/bf16 codecs are
+    # float32-sum-only opt-ins (docs/algorithms.md precision contract);
+    # "lossy" keeps auto dispatch but allows the tuning table to elect a
+    # wire codec (auto_lossy_wire).
+    _WIRE_ALGORITHMS = {"q8": "ring_q8_wire", "bf16": "ring_bf16_wire",
+                        "lossy": "auto_lossy_wire"}
+
+    @classmethod
+    def _resolve_wire(cls, wire, algorithm):
+        """Fold the allreduce wire= shorthand into the algorithm choice
+        (conflicts compare RESOLVED algorithms, so aliases like "q8"
+        agree with their canonical spelling)."""
+        if wire is None:
+            return algorithm
+        mapped = cls._WIRE_ALGORITHMS.get(wire)
+        if mapped is None:
+            raise Error(f"wire= must be one of "
+                        f"{sorted(cls._WIRE_ALGORITHMS)}, got {wire!r}")
+        if (algorithm != "auto" and
+                cls._ALGORITHMS.get(algorithm) != cls._ALGORITHMS[mapped]):
+            raise Error(f"wire={wire!r} conflicts with "
+                        f"algorithm={algorithm!r}")
+        return mapped
+
+    @classmethod
+    def _resolve_rs_wire(cls, wire, algorithm):
+        """reduce_scatter's wire= shorthand (q8 is its only codec) —
+        the single validation both the blocking and async entries use."""
+        if wire is None:
+            return algorithm
+        if wire != "q8":
+            raise Error(f"reduce_scatter wire= supports only 'q8', "
+                        f"got {wire!r}")
+        if (algorithm != "auto" and
+                cls._RS_ALGORITHMS.get(algorithm) !=
+                cls._RS_ALGORITHMS["ring_q8_wire"]):
+            raise Error(f"wire='q8' conflicts with "
+                        f"algorithm={algorithm!r}")
+        return "ring_q8_wire"
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
                   tag: int = 0,
-                  timeout: Optional[float] = None) -> np.ndarray:
+                  timeout: Optional[float] = None,
+                  wire: Optional[str] = None) -> np.ndarray:
         """In-place allreduce of `array` across the group.
 
         algorithm: "auto" consults the installed tuning table first
@@ -981,7 +1081,16 @@ class Context:
         Explicit choices: "ring", "halving_doubling" ("hd"),
         "recursive_doubling" ("rd"; non-power-of-2 groups take a
         pre/post fold), "hd_fold" / "hd_blocks" (the halving-doubling
-        non-power-of-2 sub-variants), "bcube", or "ring_bf16_wire".
+        non-power-of-2 sub-variants), "bcube", "ring_bf16_wire", or
+        "ring_q8_wire" (int8 block-quantized wire, TPUCOLL_Q8_BLOCK).
+
+        wire: opt-in wire compression shorthand — "q8" / "bf16" force
+        the matching codec (float32 sum only; all ranks still receive
+        bit-identical results), "lossy" keeps auto dispatch but lets the
+        installed tuning table elect a wire codec when one measures
+        faster ("auto_lossy_wire"). See docs/algorithms.md for the
+        precision contract (per-hop requantization error grows with the
+        hop count).
 
         op may also be a callable `fn(acc, inp)` combining two numpy views
         in place into acc (see _wrap_reduce_fn for the contract).
@@ -994,6 +1103,7 @@ class Context:
         the application's own copy before retrying (docs/errors.md,
         "In-place collectives").
         """
+        algorithm = self._resolve_wire(wire, algorithm)
         _check_array(array)
         if callable(op):
             cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
@@ -1013,12 +1123,15 @@ class Context:
 
     def allreduce_multi(self, arrays, op="sum", algorithm: str = "auto",
                         tag: int = 0,
-                        timeout: Optional[float] = None):
+                        timeout: Optional[float] = None,
+                        wire: Optional[str] = None):
         """Allreduce N local buffers together (the reference's multi-input
         form for one-process-per-host, N-accelerator setups: local
         reduction first, one network pass, result fanned to every
         buffer). In-place on all arrays; on error their contents are
-        undefined, exactly as for allreduce()."""
+        undefined, exactly as for allreduce(). wire: same opt-in wire
+        compression shorthand as allreduce()."""
+        algorithm = self._resolve_wire(wire, algorithm)
         arrays = [_check_array(a) for a in arrays]
         if not arrays:
             raise Error("allreduce_multi needs at least one array")
@@ -1181,12 +1294,13 @@ class Context:
         return out
 
     _RS_ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2,
-                      "hd": 2, "direct": 3}
+                      "hd": 2, "direct": 3, "ring_q8_wire": 4, "q8": 4}
 
     def reduce_scatter(self, array: np.ndarray,
                        recv_counts: Optional[Sequence[int]] = None,
                        op="sum", algorithm: str = "auto", tag: int = 0,
-                       timeout: Optional[float] = None) -> np.ndarray:
+                       timeout: Optional[float] = None,
+                       wire: Optional[str] = None) -> np.ndarray:
         """Reduce then scatter per-rank blocks.
 
         algorithm: "auto" (the installed tuning table when present, else
@@ -1196,9 +1310,13 @@ class Context:
         picks it when TPUCOLL_RS_DIRECT_MAX is raised from its default
         0; meant for real DCN, it loses on shared-core loopback, and a
         tuned table elects it from measurement), "halving_doubling"/
-        "hd", or "ring". On error the returned array's contents are
+        "hd", "ring", or "ring_q8_wire" (int8 block-quantized wire,
+        float32 sum only — wire="q8" is the shorthand; only the hops
+        are quantized, each rank's result block is the float32
+        accumulator). On error the returned array's contents are
         undefined (in-place folds; docs/errors.md).
         """
+        algorithm = self._resolve_rs_wire(wire, algorithm)
         _check_array(array)
         algo = self._RS_ALGORITHMS[algorithm]
         if recv_counts is None:

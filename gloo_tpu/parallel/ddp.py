@@ -78,16 +78,23 @@ class HostGradSync:
     """
 
     def __init__(self, context, bucketed: bool = False,
-                 bucket_bytes=None, lanes=None):
+                 bucket_bytes=None, lanes=None, wire=None):
+        """wire: opt-in wire compression for float32 gradients — "q8" /
+        "bf16" / "lossy" (the Context.allreduce shorthand; precision
+        contract in docs/algorithms.md). Gradient averaging is the
+        canonical tolerant workload for lossy wire (EQuARX line of
+        work); non-float32 leaves always ride the lossless path."""
         self.context = context
         self._tag = 1 << 20  # leave low tags to the application
         self._bucketer = None
+        self._wire = wire
         if bucketed:
             from gloo_tpu.bucketer import GradientBucketer
 
             engine = context.async_engine(lanes=lanes)
             self._bucketer = GradientBucketer(
-                engine, bucket_bytes=bucket_bytes, average=True)
+                engine, bucket_bytes=bucket_bytes, average=True,
+                wire=wire)
 
     def average(self, grads):
         from gloo_tpu.utils.tracing import annotate
@@ -111,7 +118,9 @@ class HostGradSync:
                 return jax.tree.unflatten(treedef, out)
             for i, leaf in enumerate(leaves):
                 arr = np.ascontiguousarray(np.asarray(leaf))
-                self.context.allreduce(arr, op="sum", tag=self._tag + i)
+                wire = self._wire if arr.dtype == np.float32 else None
+                self.context.allreduce(arr, op="sum", tag=self._tag + i,
+                                       wire=wire)
                 out.append(jnp.asarray(arr / size, dtype=leaf.dtype)
                            if hasattr(leaf, "dtype") else arr / size)
         return jax.tree.unflatten(treedef, out)

@@ -211,7 +211,8 @@ def test_peer_killed_mid_collective_encrypted():
         assert elapsed < 5.0, f"failure detection took {elapsed}s"
 
 
-@pytest.mark.parametrize("algorithm", ["ring", "hd", "ring_bf16_wire"])
+@pytest.mark.parametrize("algorithm", ["ring", "hd", "ring_bf16_wire",
+                                       "ring_q8_wire"])
 def test_allreduce_encrypted_multiframe_fold_on_open(algorithm):
     """Multi-frame encrypted recvReduce over real TCP payloads
     (TPUCOLL_SHM=0 — same-host shm would carry the bytes plaintext and
@@ -224,14 +225,18 @@ def test_allreduce_encrypted_multiframe_fold_on_open(algorithm):
     the window-walk recvReduce, and ring_bf16_wire the TYPED fold
     (wire elsize 2, accumulator elsize 4 — per-frame accumulator
     offsets must scale by the acc elsize, not wire bytes; values stay
-    small integers so bf16 wire rounding is exact). Size 3 adds the
-    non-trivial vrank/fold topology."""
+    small integers so bf16 wire rounding is exact). ring_q8_wire covers
+    the typed fold with a wire elsize (260-byte scale+codes units) that
+    does NOT divide the AEAD frame, forcing the completion-time fold
+    instead of rxFoldInline_ — verified by tolerance plus a cross-rank
+    consensus allgather (q8's block quantization is inexact even on
+    small ints). Size 3 adds the non-trivial vrank/fold topology."""
     store = tempfile.mkdtemp()
     size = 3
     n = (3 * 1024 * 1024 + 4096) // 4  # ~3 MiB: several frames/segment
     # bf16 wire: keep every partial sum an integer <= 256 (exact in
     # bf16's 8-bit mantissa) so the expectation is still closed-form.
-    mod = 64 if algorithm == "ring_bf16_wire" else 512
+    mod = 64 if algorithm in ("ring_bf16_wire", "ring_q8_wire") else 512
 
     def worker(rank):
         prog = textwrap.dedent("""
@@ -249,8 +254,17 @@ def test_allreduce_encrypted_multiframe_fold_on_open(algorithm):
             ctx.allreduce(x, algorithm={algorithm!r})
             expect = ((np.arange(n, dtype=np.float64) % {mod}) * size
                       + size * (size + 1) / 2)
-            assert np.array_equal(x, expect.astype(np.float32)), \\
-                np.flatnonzero(x != expect.astype(np.float32))[:8]
+            if {algorithm!r} == "ring_q8_wire":
+                # Within the per-hop quantization bound, and
+                # bit-identical on every rank (consensus survives the
+                # encrypted typed fold).
+                assert np.abs(x - expect).max() <= expect.max() * 0.02
+                allx = ctx.allgather(x)
+                for r in range(size):
+                    assert np.array_equal(allx[r], x), r
+            else:
+                assert np.array_equal(x, expect.astype(np.float32)), \\
+                    np.flatnonzero(x != expect.astype(np.float32))[:8]
             ctx.barrier()
             ctx.close()
             sys.exit(10)

@@ -123,3 +123,29 @@ def test_bench_channel_sweep_smoke():
         assert line["value"] > 0
         seen.add((line["loops"], line["channels"], line["stripe_bytes"]))
     assert (1, 1, 1 << 20) in seen and (2, 2, 1 << 20) in seen
+
+
+def test_bench_wire_sweep_smoke():
+    """bench.py --wire-sweep --quick (2 ranks): one valid JSON
+    measurement line per wire-codec arm — the crossover data the lossy
+    auto dispatch (auto_lossy_wire) is elected from. Values are not
+    ranked: on a shared-core CI host the codec arms' CPU cost can
+    legitimately beat their wire savings; each run self-verifies its
+    reduced values before timing."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--wire-sweep", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3, proc.stdout
+    algos = set()
+    for line in lines:
+        assert line["metric"] == "wire_sweep"
+        assert line["ok"] is True, line
+        assert line["value"] > 0
+        algos.add(line["algorithm"])
+    assert algos == {"ring", "ring_bf16_wire", "ring_q8_wire"}
