@@ -252,6 +252,166 @@ def bench_autotune(quick=False, out_path=None):
     print(json.dumps(line))
 
 
+def bench_latency(quick=False):
+    """Small-message latency A/B: persistent collective plans on vs off.
+
+    The plan cache's headline is LATENCY, not bandwidth: per-op setup
+    (UnboundBuffer create+destroy, scratch acquisition, schedule
+    recompute) is a fixed cost that dominates small messages. This sweep
+    measures allreduce and reduce_scatter p50/p99 at 64 B..256 KiB under
+    TPUCOLL_SHM=0 (pure TCP loopback, the acceptance configuration),
+    with the two arms interleaved in time per size (A/B/A/B passes) so
+    host drift hits both equally. One JSON line per (op, size, arm).
+
+    Arms differ ONLY by TPUCOLL_PLAN_CACHE at context construction: the
+    off-arm context runs the transient path (pre-plan behavior), the
+    on-arm replays cached plans. The on-arm line also records the
+    steady-state ubuf_creates delta across the timed loop — the
+    zero-registration proof.
+    """
+    import numpy as np
+
+    import gloo_tpu
+
+    os.environ["TPUCOLL_SHM"] = "0"
+    sizes = [64, 256, 1024, 4096, 16384, 65536, 262144]
+    if quick:
+        sizes = [64, 1024, 16384, 65536]
+    warmup = 10 if quick else 30
+    passes = 2 if quick else 4
+    iters = 30 if quick else 100
+
+    store_on = gloo_tpu.HashStore()
+    store_off = gloo_tpu.HashStore()
+    gate = threading.Barrier(2)
+    results = []
+    lock = threading.Lock()
+
+    def worker(rank):
+        _maybe_pin(rank)
+        # Coordinated construction: TPUCOLL_PLAN_CACHE is read at
+        # Context creation, and the env is process-global, so both
+        # ranks build each arm's context under the same setting.
+        gate.wait()
+        if rank == 0:
+            os.environ["TPUCOLL_PLAN_CACHE"] = "0"
+        gate.wait()
+        dev = gloo_tpu.Device()
+        ctx_off = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx_off.connect_full_mesh(store_off, dev)
+        gate.wait()
+        if rank == 0:
+            os.environ["TPUCOLL_PLAN_CACHE"] = "1"
+        gate.wait()
+        ctx_on = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx_on.connect_full_mesh(store_on, dev)
+
+        for nbytes in sizes:
+            count = max(1, nbytes // 4)
+            for op in ("allreduce", "reduce_scatter"):
+                # Stable buffers per (size, arm): the plan cache keys on
+                # the pointer, and a training loop's buffers are stable —
+                # this measures that steady state.
+                # On-arm: the full persistent path — a CollectivePlan
+                # handle (one foreign call per step, marshalled once)
+                # over the warm native plan. Off-arm: the pre-plan
+                # per-call path (classic API, cache disabled).
+                x_on = np.full(count, float(rank + 1), dtype=np.float32)
+                out_on = np.empty(count // 2, dtype=np.float32)
+                if op == "allreduce":
+                    plan = ctx_on.allreduce_plan(x_on, tag=7)
+                else:
+                    # count is a multiple of 2 at every swept size
+                    # (>= 16 f32 elements), so the default even split
+                    # applies.
+                    plan = ctx_on.reduce_scatter_plan(x_on, tag=9,
+                                                      output=out_on)
+                x_off = np.full(count, float(rank + 1), dtype=np.float32)
+                out_off = np.empty(count // 2, dtype=np.float32)
+                cells = {"on": [], "off": []}
+                ub_delta = {}
+
+                def run_op(ctx, arm, n, record):
+                    for _ in range(n):
+                        t0 = time.perf_counter()
+                        if arm == "on":
+                            plan()
+                        elif op == "allreduce":
+                            ctx.allreduce(x_off, tag=7)
+                        else:
+                            ctx.reduce_scatter(x_off, tag=9,
+                                               output=out_off)
+                        if record is not None:
+                            record.append(time.perf_counter() - t0)
+
+                # Warm both arms (plan build happens here, outside the
+                # timed loops), then interleave A/B passes.
+                run_op(ctx_on, "on", warmup, None)
+                run_op(ctx_off, "off", warmup, None)
+                ub0 = ctx_on.metrics()["ubuf_creates"]
+                for _ in range(passes):
+                    run_op(ctx_on, "on", iters, cells["on"])
+                    run_op(ctx_off, "off", iters, cells["off"])
+                ub_delta["on"] = ctx_on.metrics()["ubuf_creates"] - ub0
+                if rank == 0:
+                    snap = ctx_on.metrics()
+                    for arm in ("on", "off"):
+                        times = cells[arm]
+                        line = {
+                            "bench": "latency",
+                            "op": op,
+                            "bytes": nbytes,
+                            "plans": arm == "on",
+                            "iters": len(times),
+                            "p50_us": round(
+                                float(np.median(times)) * 1e6, 2),
+                            "p99_us": round(
+                                float(np.percentile(times, 99)) * 1e6, 2),
+                            "pinned": PIN_RANKS,
+                        }
+                        if arm == "on":
+                            line["ubuf_creates_steady_delta"] = int(
+                                ub_delta["on"])
+                            line["plan_hits"] = snap["plan_hits"]
+                            line["plan_misses"] = snap["plan_misses"]
+                        with lock:
+                            results.append(line)
+        ctx_on.barrier(tag=99)
+        ctx_off.barrier(tag=99)
+        ctx_on.close()
+        ctx_off.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(1200)
+
+    for line in results:
+        print(json.dumps(line))
+    # Summary: geomean p50 speedup (plans on vs off) over the <= 64 KiB
+    # cells — the acceptance criterion's number.
+    import math
+    ratios = []
+    by_key = {(l["op"], l["bytes"], l["plans"]): l for l in results}
+    for (op_name, nbytes, plans), l in by_key.items():
+        if plans or nbytes > 65536:
+            continue
+        on = by_key.get((op_name, nbytes, True))
+        if on and on["p50_us"] > 0:
+            ratios.append(l["p50_us"] / on["p50_us"])
+    summary = {
+        "bench": "latency_summary",
+        "cells": len(results),
+        "geomean_p50_speedup_le_64KiB": round(
+            math.exp(sum(math.log(r) for r in ratios) / len(ratios)), 3)
+        if ratios else None,
+        "pinned": PIN_RANKS,
+    }
+    print(json.dumps(summary))
+    return results + [summary]
+
+
 def bench_chaos_soak(seconds):
     """--chaos-soak N: run a mixed collective/p2p workload for N seconds
     with a low-rate delay/dup fault schedule installed (the soak-mode
@@ -809,6 +969,9 @@ def main():
         if i >= len(sys.argv) or sys.argv[i].startswith("--"):
             sys.exit("--flightrec requires a duration (seconds)")
         bench_flightrec_soak(float(sys.argv[i]))
+        return
+    if "--latency" in sys.argv[1:]:
+        bench_latency(quick="--quick" in sys.argv[1:])
         return
     if "--channel-sweep" in sys.argv[1:]:
         bench_channel_sweep(quick="--quick" in sys.argv[1:])

@@ -15,6 +15,7 @@
 
 #include "tpucoll/async/engine.h"
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
 #include "tpucoll/fault/fault.h"
@@ -687,6 +688,68 @@ int tc_allreduce(void* ctx, const void* input, void* output, size_t count,
   });
 }
 
+// ---- zero-copy in-place entries (persistent-plan hot path) ----
+// One stable buffer pointer in, result written straight into it — no
+// copy-out pair, no per-call output allocation on the Python side, and
+// a (ptr, nbytes)-stable key for the plan cache (collectives/plan.h):
+// the steady-state Nth call performs zero allocations and zero buffer
+// registrations.
+
+// In-place allreduce of `buffer` (count elements of dtype).
+int tc_allreduce_inplace(void* ctx, void* buffer, size_t count, int dtype,
+                         int op, int algorithm, uint32_t tag,
+                         int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::AllreduceOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.inputs = {buffer};
+    opts.outputs = {buffer};
+    opts.count = count;
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    opts.algorithm = static_cast<tpucoll::AllreduceAlgorithm>(algorithm);
+    tpucoll::allreduce(opts);
+  });
+}
+
+// In-place reduce_scatter: this rank's reduced block (recvCounts[rank]
+// elements) lands at the FRONT of `buffer`; the rest of the buffer's
+// contents are unspecified afterwards (the schedule works in plan
+// scratch, so they are in practice left as the caller's input — but
+// only the front block is contract).
+int tc_reduce_scatter_inplace(void* ctx, void* buffer,
+                              const size_t* recvCounts, int dtype, int op,
+                              int algorithm, uint32_t tag,
+                              int64_t timeoutMs) {
+  return wrap([&] {
+    tpucoll::ReduceScatterOptions opts;
+    fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.input = buffer;
+    opts.output = buffer;
+    opts.recvCounts = countsVec(recvCounts, asContext(ctx)->size());
+    opts.dtype = static_cast<DataType>(dtype);
+    opts.op = static_cast<ReduceOp>(op);
+    opts.algorithm = static_cast<tpucoll::ReduceScatterAlgorithm>(algorithm);
+    tpucoll::reduceScatter(opts);
+  });
+}
+
+// ---- plan-cache introspection (collectives/plan.h) ----
+
+// Entries currently cached on this context (hits/misses/evictions and
+// the ubuf_creates registration counter live in tc_metrics_json).
+size_t tc_plan_cache_size(void* ctx) {
+  return wrapVal<size_t>(0, [&] {
+    return asContext(ctx)->planCache().size();
+  });
+}
+
+// Drop every cached plan (A/B measurement, tests). Safe at any point a
+// collective is not concurrently running on the context.
+void tc_plan_cache_clear(void* ctx) {
+  wrapVoid([&] { asContext(ctx)->planCache().clear(); });
+}
+
 int tc_reduce(void* ctx, const void* input, void* output, size_t count,
               int dtype, int op, int root, int algorithm, uint32_t tag,
               int64_t timeoutMs) {
@@ -1010,6 +1073,18 @@ void* tc_async_allreduce(void* eng, const void* input, void* output,
   return submitWork([&] {
     return asEngine(eng)->allreduce(
         input, output, count, static_cast<DataType>(dtype),
+        static_cast<ReduceOp>(op), algorithm, ms(timeoutMs));
+  });
+}
+
+// In-place async allreduce — the tc_allreduce_inplace analog on the
+// engine's lane (stable buffer pointer -> per-lane plan-cache hits).
+void* tc_async_allreduce_inplace(void* eng, void* buffer, size_t count,
+                                 int dtype, int op, int algorithm,
+                                 int64_t timeoutMs) {
+  return submitWork([&] {
+    return asEngine(eng)->allreduce(
+        buffer, buffer, count, static_cast<DataType>(dtype),
         static_cast<ReduceOp>(op), algorithm, ms(timeoutMs));
   });
 }

@@ -6,6 +6,7 @@
 #include <chrono>
 
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 #include "tpucoll/context.h"
 #include "tpucoll/math.h"
 #include "tpucoll/types.h"
@@ -16,8 +17,8 @@ namespace algorithms {
 // Bandwidth-optimal ring (reduce-scatter + allgather), segment-pipelined.
 // fuseOk: fn is a builtin (loop-thread-safe) reduction, so the reduce-
 // scatter phase may use the transport's fused recvReduce path.
-void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
-                   ReduceFn fn, Slot slot,
+void ringAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                   size_t count, size_t elsize, ReduceFn fn, Slot slot,
                    std::chrono::milliseconds timeout, bool fuseOk);
 
 // Recursive-halving/recursive-doubling (Rabenseifner) allreduce:
@@ -32,36 +33,39 @@ void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
 // fold (odd ranks of the first 2*(P-p2) ship their vector to the even
 // survivor, sit out the rounds, and receive the result). Commutative
 // IEEE addition makes the result bitwise identical across ranks.
-void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
-                                size_t elsize, ReduceFn fn, Slot slot,
+void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
+                                char* work, size_t count, size_t elsize,
+                                ReduceFn fn, Slot slot,
                                 std::chrono::milliseconds timeout);
 
-void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
-                              size_t elsize, ReduceFn fn, Slot slot,
-                              std::chrono::milliseconds timeout,
+void halvingDoublingAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                              size_t count, size_t elsize, ReduceFn fn,
+                              Slot slot, std::chrono::milliseconds timeout,
                               bool fuseOk);
 
 // The two halving-doubling non-power-of-2 strategies as directly callable
 // arms (AllreduceAlgorithm::kHdFold / kHdBlocks; halvingDoublingAllreduce
 // dispatches between them). Both are valid for ANY group size — on
 // power-of-2 groups they run the identical single-block walk.
-void hdFoldAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
-                     ReduceFn fn, Slot slot,
+void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                     size_t count, size_t elsize, ReduceFn fn, Slot slot,
                      std::chrono::milliseconds timeout, bool fuseOk);
-void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
-                             size_t elsize, ReduceFn fn, Slot slot,
-                             std::chrono::milliseconds timeout, bool fuseOk);
+void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                             size_t count, size_t elsize, ReduceFn fn,
+                             Slot slot, std::chrono::milliseconds timeout,
+                             bool fuseOk);
 
 // Mixed-radix grouped-hypercube (bcube) allreduce: log-depth like
 // halving-doubling but with configurable group fan-out per step; exact
 // schedule for any P via prime factorization (reference analog:
 // gloo/allreduce_bcube.h).
-void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
-                    ReduceFn fn, Slot slot,
+void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                    size_t count, size_t elsize, ReduceFn fn, Slot slot,
                     std::chrono::milliseconds timeout, bool fuseOk);
 
 // Ring allreduce with bfloat16 wire compression (float32 payloads).
-void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
+void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                           size_t count, Slot slot,
                            std::chrono::milliseconds timeout);
 
 // Ring allreduce with the int8 block-quantized wire codec (float32 sum
@@ -69,13 +73,15 @@ void bf16WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
 // Accumulation stays float32; every reduce-scatter hop re-quantizes, and
 // the allgather phase forwards the owner's final quantized stream
 // verbatim so all ranks decode bit-identical results.
-void q8WireRingAllreduce(Context* ctx, char* work, size_t count, Slot slot,
+void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                         size_t count, Slot slot,
                          std::chrono::milliseconds timeout);
 
 // Ring reduce-scatter over the same q8 wire (startShift -1: rank r ends
 // owning reduced block r of `blocks`, full-precision float32 — only the
 // wire hops are quantized).
-void q8WireRingReduceScatter(Context* ctx, char* work,
+void q8WireRingReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                             transport::UnboundBuffer* workBuf,
                              const collectives_detail::Blocks& blocks,
                              Slot slot, std::chrono::milliseconds timeout);
 
@@ -88,7 +94,8 @@ void q8WireRingReduceScatter(Context* ctx, char* work,
 // partner and a final redistribution ships each owned block to its real
 // rank. `work` is reduced in place; afterwards block `rank` (at
 // blocks.offset[rank]) is this rank's fully reduced result.
-void hdReduceScatter(Context* ctx, char* work,
+void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                     transport::UnboundBuffer* workBuf,
                      const collectives_detail::Blocks& blocks, ReduceFn fn,
                      size_t elsize, Slot slot,
                      std::chrono::milliseconds timeout, bool fuseOk);
@@ -100,7 +107,8 @@ void hdReduceScatter(Context* ctx, char* work,
 // payload is latency-bound. No reference analog (its smallest-payload
 // path is still halving-doubling); same tier as the repo's direct
 // allgather (TPUCOLL_ALLGATHER_DIRECT_MAX).
-void directReduceScatter(Context* ctx, char* work,
+void directReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                         transport::UnboundBuffer* workBuf,
                          const collectives_detail::Blocks& blocks,
                          ReduceFn fn, size_t elsize, Slot slot,
                          std::chrono::milliseconds timeout, bool fuseOk);

@@ -21,6 +21,7 @@
 
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -46,8 +47,8 @@ std::vector<int> primeFactors(int n) {
 
 }  // namespace
 
-void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
-                    ReduceFn fn, Slot slot,
+void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                    size_t count, size_t elsize, ReduceFn fn, Slot slot,
                     std::chrono::milliseconds timeout, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -55,13 +56,14 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
   const std::vector<int> radices = primeFactors(size);
   const int numSteps = static_cast<int>(radices.size());
 
-  Blocks blocks = evenBlocks(count, size, elsize);
+  const Blocks& blocks =
+      plan.blocks(0, [&] { return evenBlocks(count, size, elsize); });
   auto rangeOff = [&](int first) { return blocks.offset[first]; };
   auto rangeBytes = [&](int first, int n) {
     return blocks.rangeBytes(first, n);
   };
 
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto* workBuf = plan.userBuf(0, work, nbytes);
   // Fused receive-reduce applies to RADIX-2 steps only: with one sender
   // the kept part is written by exactly one combine stream, disjoint from
   // the part being sent. Steps with g > 2 have g-1 senders all reducing
@@ -75,7 +77,7 @@ void bcubeAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
   // at a step (uneven blocks make one part slightly larger than the
   // window's average); nbytes + size*elsize safely covers every step.
   // Lazily acquired: an all-radix-2 fused run never touches it.
-  collectives_detail::LazyScratch stage(ctx, nbytes + size * elsize);
+  plan::LazyStage stage(plan, 1, nbytes + size * elsize);
 
   // Mixed-radix digits of this rank: rank = sum(digit_s * stride_s).
   std::vector<int> stride(numSteps), digit(numSteps);

@@ -17,6 +17,7 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -56,12 +57,14 @@ void decodeBf16Fn(void* acc, const void* in, size_t n) {
 
 }  // namespace
 
-void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
-                           Slot slot, std::chrono::milliseconds timeout) {
+void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
+                           char* workBytes, size_t count, Slot slot,
+                           std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   float* work = reinterpret_cast<float*>(workBytes);
-  Blocks blocks = evenBlocks(count, size, sizeof(float));
+  const Blocks& blocks = plan.blocks(
+      0, [&] { return evenBlocks(count, size, sizeof(float)); });
   size_t maxBlockElems = 0;
   for (size_t b : blocks.bytes) {
     maxBlockElems = std::max(maxBlockElems, b / sizeof(float));
@@ -86,11 +89,11 @@ void bf16WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
   // lazily acquired (never touched when fused).
   const size_t wireBlock = std::max(maxBlockElems * sizeof(uint16_t),
                                     size_t(1));
-  auto txScratch = ctx->acquireScratch(2 * wireBlock);
-  uint16_t* tx = reinterpret_cast<uint16_t*>(txScratch.data());
-  auto txBuf = ctx->createUnboundBuffer(tx, 2 * wireBlock);
-  collectives_detail::LazyScratch rxStage(ctx, 2 * wireBlock);
-  auto workBuf = ctx->createUnboundBuffer(work, count * sizeof(float));
+  auto txStage = plan.stage(1, 2 * wireBlock);
+  uint16_t* tx = reinterpret_cast<uint16_t*>(txStage.data);
+  auto* txBuf = txStage.buf;
+  plan::LazyStage rxStage(plan, 2, 2 * wireBlock);
+  auto* workBuf = plan.userBuf(0, work, count * sizeof(float));
 
   auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
   auto blockStart = [&](int b) {

@@ -26,6 +26,7 @@
 
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -35,7 +36,7 @@ using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
 using collectives_detail::largestPow2AtMost;
 using collectives_detail::fuseRecvReduce;
-using collectives_detail::LazyScratch;
+using plan::LazyStage;
 
 namespace {
 
@@ -51,8 +52,8 @@ constexpr uint64_t kUnfoldSlot = 1 << 20;
 
 }  // namespace
 
-void hdFoldAllreduce(Context* ctx, char* work, size_t count,
-                     size_t elsize, ReduceFn fn, Slot slot,
+void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                     size_t count, size_t elsize, ReduceFn fn, Slot slot,
                      std::chrono::milliseconds timeout, bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -60,7 +61,7 @@ void hdFoldAllreduce(Context* ctx, char* work, size_t count,
   const int pow2 = static_cast<int>(largestPow2AtMost(size));
   const int rem = size - pow2;
 
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto* workBuf = plan.userBuf(0, work, nbytes);
   // Fused receive-reduce (single policy: collectives_detail::
   // fuseRecvReduce): every receive-with-reduce in this walk targets a
   // range disjoint from any concurrently sent range, so partner partials
@@ -70,7 +71,7 @@ void hdFoldAllreduce(Context* ctx, char* work, size_t count,
   auto canFuse = [&](int src) {
     return fuseRecvReduce(ctx, fuseOk, elsize, src);
   };
-  LazyScratch stage(ctx, nbytes);
+  LazyStage stage(plan, 1, nbytes);
 
   // Fold: the first 2*rem ranks pair (even, odd); odds contribute their
   // vector to their even partner and sit out the exchange.
@@ -100,7 +101,8 @@ void hdFoldAllreduce(Context* ctx, char* work, size_t count,
   auto physical = [&](int v) { return v < rem ? 2 * v : v + rem; };
 
   if (vrank >= 0 && pow2 > 1) {
-    Blocks blocks = evenBlocks(count, pow2, elsize);
+    const Blocks& blocks =
+        plan.blocks(0, [&] { return evenBlocks(count, pow2, elsize); });
     auto rangeOff = [&](int first) { return blocks.offset[first]; };
     auto rangeBytes = [&](int first, int n) {
       return blocks.rangeBytes(first, n);
@@ -173,9 +175,9 @@ void hdFoldAllreduce(Context* ctx, char* work, size_t count,
   }
 }
 
-void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
-                             size_t elsize, ReduceFn fn, Slot slot,
-                             std::chrono::milliseconds timeout,
+void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                             size_t count, size_t elsize, ReduceFn fn,
+                             Slot slot, std::chrono::milliseconds timeout,
                              bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -202,18 +204,19 @@ void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
 
   // All windows are unions of "atoms": the vector split Bmax ways. Every
   // block size divides Bmax, so window boundaries align across blocks.
-  Blocks atoms = evenBlocks(count, Bmax, elsize);
+  const Blocks& atoms =
+      plan.blocks(0, [&] { return evenBlocks(count, Bmax, elsize); });
   auto atomOff = [&](int first) { return atoms.offset[first]; };
   auto atomBytes = [&](int first, int n) { return atoms.rangeBytes(first, n); };
 
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto* workBuf = plan.userBuf(0, work, nbytes);
   // Fused receive-reduce (single policy: collectives_detail::
   // fuseRecvReduce; disjoint kept/sent ranges make direct combining
   // safe). Scratch only materializes if a partner falls back.
   auto canFuse = [&](int src) {
     return fuseRecvReduce(ctx, fuseOk, elsize, src);
   };
-  LazyScratch stage(ctx, nbytes);
+  LazyStage stage(plan, 1, nbytes);
 
   // --- intra-block reduce-scatter: recursive vector halving ---
   // The window walk lands atoms [r*Bmax/B, (r+1)*Bmax/B) on block rank r.
@@ -324,9 +327,11 @@ void hdBinaryBlocksAllreduce(Context* ctx, char* work, size_t count,
   }
 }
 
-void hdReduceScatter(Context* ctx, char* work, const Blocks& blocks,
-                     ReduceFn fn, size_t elsize, Slot slot,
-                     std::chrono::milliseconds timeout, bool fuseOk) {
+void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                     transport::UnboundBuffer* workBuf,
+                     const Blocks& blocks, ReduceFn fn, size_t elsize,
+                     Slot slot, std::chrono::milliseconds timeout,
+                     bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes =
@@ -334,11 +339,10 @@ void hdReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   const int pow2 = static_cast<int>(largestPow2AtMost(size));
   const int rem = size - pow2;
 
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
   auto canFuse = [&](int src) {
     return fuseRecvReduce(ctx, fuseOk, elsize, src);
   };
-  LazyScratch stage(ctx, nbytes);
+  LazyStage stage(plan, 1, nbytes);
 
   // Fold (non-power-of-2 only): odd ranks of the first 2*rem contribute
   // their whole vector to their even partner and rejoin for the
@@ -458,14 +462,13 @@ void hdReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   }
 }
 
-void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
-                         ReduceFn fn, size_t elsize, Slot slot,
-                         std::chrono::milliseconds timeout, bool fuseOk) {
+void directReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                         transport::UnboundBuffer* workBuf,
+                         const Blocks& blocks, ReduceFn fn, size_t elsize,
+                         Slot slot, std::chrono::milliseconds timeout,
+                         bool fuseOk) {
   const int rank = ctx->rank();
   const int size = ctx->size();
-  const size_t nbytes =
-      blocks.offset[size - 1] + blocks.bytes[size - 1];
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
 
   // One latency round: ship this rank's copy of block j straight to
   // rank j, all P-1 transfers concurrently in flight.
@@ -485,7 +488,7 @@ void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   // Serial posting keeps the zero-copy combine and still overlaps the
   // wire time: senders fired already, later arrivals wait in the stash.
   if (blocks.bytes[rank] > 0) {
-    LazyScratch stage(ctx, blocks.bytes[rank]);
+    LazyStage stage(plan, 1, blocks.bytes[rank]);
     for (int s = 0; s < size; s++) {
       if (s == rank) {
         continue;
@@ -531,8 +534,9 @@ void directReduceScatter(Context* ctx, char* work, const Blocks& blocks,
 // fn(X, Y) / fn(Y, X) over identical operand bits, and IEEE addition
 // (and min/max) is commutative, so every merged group stays bitwise
 // identical by induction. Extras receive those exact bits.
-void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
-                                size_t elsize, ReduceFn fn, Slot slot,
+void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
+                                char* work, size_t count, size_t elsize,
+                                ReduceFn fn, Slot slot,
                                 std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -542,7 +546,7 @@ void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
   }
   const int rem = size - p2;
   const size_t nbytes = count * elsize;
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
+  auto* workBuf = plan.userBuf(0, work, nbytes);
   // Slot layout: offset 0 = pre-fold, 1 = result return, 2+k = round k.
   const bool extra = rank < 2 * rem && (rank & 1) != 0;
   const bool paired = rank < 2 * rem && (rank & 1) == 0;
@@ -554,12 +558,18 @@ void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
     workBuf->waitRecv(nullptr, timeout);
     return;
   }
-  std::vector<char> scratch(nbytes);
-  auto scratchBuf = ctx->createUnboundBuffer(scratch.data(), nbytes);
+  // Receive staging (send/recv ranges overlap — both are the whole
+  // vector — so the receive can never fold in place): plan-staged, so
+  // the repeated tiny-payload call this tier serves replays with no
+  // allocation and no registration. This was the last per-op
+  // std::vector<char> scratch in the allreduce family.
+  auto st = plan.stage(1, nbytes);
+  char* scratch = st.data;
+  transport::UnboundBuffer* scratchBuf = st.buf;
   if (paired) {
     scratchBuf->recv(rank + 1, slot.offset(0).value(), 0, nbytes);
     scratchBuf->waitRecv(nullptr, timeout);
-    fn(work, scratch.data(), count);
+    fn(work, scratch, count);
   }
   // Survivors renumber into a dense [0, p2) space for the XOR walk.
   const int rdRank = paired ? rank / 2 : rank - rem;
@@ -571,7 +581,7 @@ void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
     scratchBuf->recv(partner, slot.offset(2 + round).value(), 0, nbytes);
     workBuf->waitSend(timeout);
     scratchBuf->waitRecv(nullptr, timeout);
-    fn(work, scratch.data(), count);
+    fn(work, scratch, count);
   }
   if (paired) {
     workBuf->send(rank + 1, slot.offset(1).value(), 0, nbytes);
@@ -579,16 +589,17 @@ void recursiveDoublingAllreduce(Context* ctx, char* work, size_t count,
   }
 }
 
-void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
-                              size_t elsize, ReduceFn fn, Slot slot,
-                              std::chrono::milliseconds timeout,
+void halvingDoublingAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                              size_t count, size_t elsize, ReduceFn fn,
+                              Slot slot, std::chrono::milliseconds timeout,
                               bool fuseOk) {
   const int size = ctx->size();
   const bool pow2 = (size & (size - 1)) == 0;
   if (pow2) {
     // Power-of-2 groups: binary-blocks degenerates to the same single-
     // block walk; route through the fold path (rem == 0, no fold step).
-    hdFoldAllreduce(ctx, work, count, elsize, fn, slot, timeout, fuseOk);
+    hdFoldAllreduce(ctx, plan, work, count, elsize, fn, slot, timeout,
+                    fuseOk);
     return;
   }
   // Non-power-of-2 strategy. Loopback-measured crossover (BASELINE.md,
@@ -613,10 +624,11 @@ void halvingDoublingAllreduce(Context* ctx, char* work, size_t count,
     useBlocks = count * elsize >= crossover;
   }
   if (useBlocks) {
-    hdBinaryBlocksAllreduce(ctx, work, count, elsize, fn, slot, timeout,
-                            fuseOk);
+    hdBinaryBlocksAllreduce(ctx, plan, work, count, elsize, fn, slot,
+                            timeout, fuseOk);
   } else {
-    hdFoldAllreduce(ctx, work, count, elsize, fn, slot, timeout, fuseOk);
+    hdFoldAllreduce(ctx, plan, work, count, elsize, fn, slot, timeout,
+                    fuseOk);
   }
 }
 

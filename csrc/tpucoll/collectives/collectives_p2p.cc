@@ -5,11 +5,15 @@
 
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 
 namespace tpucoll {
 
 namespace {
 
+using plan::PlanHandle;
+using plan::PlanKey;
+using plan::PlanOp;
 using transport::UnboundBuffer;
 
 char* bytePtr(void* p) { return static_cast<char*>(p); }
@@ -35,7 +39,11 @@ void barrier(BarrierOptions& opts) {
     return;
   }
   Slot slot = Slot::build(SlotPrefix::kBarrier, opts.tag);
-  auto buf = ctx->createUnboundBuffer(nullptr, 0);
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kBarrier);
+  key.tag = opts.tag;
+  PlanHandle planh(ctx, key);
+  auto* buf = planh->userBuf(0, nullptr, 0);
   const uint64_t rounds = log2ceil(static_cast<uint64_t>(size));
   for (uint64_t i = 0; i < rounds; i++) {
     const int dist = 1 << i;
@@ -73,7 +81,15 @@ void broadcast(BroadcastOptions& opts) {
     return;
   }
   Slot slot = Slot::build(SlotPrefix::kBroadcast, opts.tag);
-  auto buf = ctx->createUnboundBuffer(opts.buffer, nbytes);
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kBroadcast);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.root = opts.root;
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.buffer);
+  key.nbytes = nbytes;
+  PlanHandle planh(ctx, key);
+  auto* buf = planh->userBuf(0, opts.buffer, nbytes);
   const int vrank = (rank - opts.root + size) % size;
   auto physical = [&](int v) { return (v + opts.root) % size; };
 
@@ -199,13 +215,24 @@ static void gathervRun(GathervOptions& opts) {
   const size_t elsize = elementSize(opts.dtype);
   Slot slot = Slot::build(SlotPrefix::kGather, opts.tag);
   const size_t myBytes = opts.counts[rank] * elsize;
+  size_t total = 0;
+  for (size_t c : opts.counts) {
+    total += c;
+  }
+
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kGatherv);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.root = opts.root;
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = total * elsize;
+  key.aux = plan::hashCounts(opts.counts);
+  PlanHandle planh(ctx, key);
 
   if (rank == opts.root) {
-    size_t total = 0;
-    for (size_t c : opts.counts) {
-      total += c;
-    }
-    auto out = ctx->createUnboundBuffer(opts.output, total * elsize);
+    auto* out = planh->userBuf(0, opts.output, total * elsize);
     size_t offset = 0;
     int pending = 0;
     for (int j = 0; j < size; j++) {
@@ -222,8 +249,8 @@ static void gathervRun(GathervOptions& opts) {
       out->waitRecv(nullptr, timeout);
     }
   } else {
-    auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input),
-                                       myBytes);
+    auto* in =
+        planh->userBuf(0, const_cast<void*>(opts.input), myBytes);
     in->send(opts.root, slot.value(), 0, myBytes);
     in->waitSend(timeout);
   }
@@ -247,9 +274,19 @@ void scatter(ScatterOptions& opts) {
   const size_t nbytes = opts.count * elementSize(opts.dtype);
   Slot slot = Slot::build(SlotPrefix::kScatter, opts.tag);
 
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kScatter);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.root = opts.root;
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = nbytes;
+  PlanHandle planh(ctx, key);
+
   if (rank == opts.root) {
-    auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input),
-                                       nbytes * size);
+    auto* in = planh->userBuf(0, const_cast<void*>(opts.input),
+                              nbytes * size);
     int pending = 0;
     for (int j = 0; j < size; j++) {
       if (j == rank) {
@@ -263,7 +300,7 @@ void scatter(ScatterOptions& opts) {
       in->waitSend(timeout);
     }
   } else {
-    auto out = ctx->createUnboundBuffer(opts.output, nbytes);
+    auto* out = planh->userBuf(0, opts.output, nbytes);
     out->recv(opts.root, slot.value(), 0, nbytes);
     out->waitRecv(nullptr, timeout);
   }
@@ -294,28 +331,40 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
   const uint8_t* in = static_cast<const uint8_t*>(opts.input);
   uint8_t* out = static_cast<uint8_t*>(opts.output);
 
-  std::vector<uint8_t> tmp(static_cast<size_t>(size) * blockBytes);
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kAlltoallBruck);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = blockBytes * size;
+  PlanHandle planh(ctx, key);
+
+  // Rotation scratch (slot 0: memory only, never registered) and the
+  // per-round wire stages (slots 1/2), all plan-backed.
+  uint8_t* tmp = reinterpret_cast<uint8_t*>(
+      planh->scratch(0, static_cast<size_t>(size) * blockBytes));
   for (int j = 0; j < size; j++) {
-    std::memcpy(tmp.data() + static_cast<size_t>(j) * blockBytes,
+    std::memcpy(tmp + static_cast<size_t>(j) * blockBytes,
                 in + static_cast<size_t>((rank + j) % size) * blockBytes,
                 blockBytes);
   }
 
   const size_t maxBlocks = static_cast<size_t>((size + 1) / 2);
-  std::vector<uint8_t> sendStage(maxBlocks * blockBytes);
-  std::vector<uint8_t> recvStage(maxBlocks * blockBytes);
-  auto sendBuf = ctx->createUnboundBuffer(sendStage.data(),
-                                          sendStage.size());
-  auto recvBuf = ctx->createUnboundBuffer(recvStage.data(),
-                                          recvStage.size());
+  auto sendSt = planh->stage(1, maxBlocks * blockBytes);
+  auto recvSt = planh->stage(2, maxBlocks * blockBytes);
+  uint8_t* sendStage = reinterpret_cast<uint8_t*>(sendSt.data);
+  uint8_t* recvStage = reinterpret_cast<uint8_t*>(recvSt.data);
+  auto* sendBuf = sendSt.buf;
+  auto* recvBuf = recvSt.buf;
   Slot slot = Slot::build(SlotPrefix::kAlltoall, opts.tag);
 
   for (int k = 1; k < size; k <<= 1) {
     size_t nblocks = 0;
     for (int j = k; j < size; j++) {
       if ((j & k) != 0) {
-        std::memcpy(sendStage.data() + nblocks * blockBytes,
-                    tmp.data() + static_cast<size_t>(j) * blockBytes,
+        std::memcpy(sendStage + nblocks * blockBytes,
+                    tmp + static_cast<size_t>(j) * blockBytes,
                     blockBytes);
         nblocks++;
       }
@@ -329,8 +378,8 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
     size_t b = 0;
     for (int j = k; j < size; j++) {
       if ((j & k) != 0) {
-        std::memcpy(tmp.data() + static_cast<size_t>(j) * blockBytes,
-                    recvStage.data() + b * blockBytes, blockBytes);
+        std::memcpy(tmp + static_cast<size_t>(j) * blockBytes,
+                    recvStage + b * blockBytes, blockBytes);
         b++;
       }
     }
@@ -339,7 +388,7 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
   for (int j = 0; j < size; j++) {
     std::memcpy(out + static_cast<size_t>((rank - j + size) % size) *
                           blockBytes,
-                tmp.data() + static_cast<size_t>(j) * blockBytes,
+                tmp + static_cast<size_t>(j) * blockBytes,
                 blockBytes);
   }
 }
@@ -423,31 +472,48 @@ static void alltoallvRun(AlltoallvOptions& opts) {
   TC_ENFORCE_EQ(opts.outCounts.size(), static_cast<size_t>(size));
   const size_t elsize = elementSize(opts.dtype);
 
-  std::vector<size_t> inOff(size, 0), outOff(size, 0);
   size_t inTotal = 0, outTotal = 0;
   for (int j = 0; j < size; j++) {
-    inOff[j] = inTotal;
-    outOff[j] = outTotal;
     inTotal += opts.inCounts[j] * elsize;
     outTotal += opts.outCounts[j] * elsize;
   }
 
-  std::memcpy(bytePtr(opts.output) + outOff[rank],
-              bytePtr(opts.input) + inOff[rank],
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kAlltoallv);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = inTotal;
+  // Both count vectors shape the schedule; mix both into aux.
+  key.aux = plan::hashCounts(opts.inCounts) * 1099511628211ull ^
+            plan::hashCounts(opts.outCounts);
+  PlanHandle planh(ctx, key);
+  // countBlocks doubles as the per-peer offset table (memoized).
+  const auto& inBlocks = planh->blocks(
+      0, [&] { return collectives_detail::countBlocks(opts.inCounts,
+                                                      elsize); });
+  const auto& outBlocks = planh->blocks(
+      1, [&] { return collectives_detail::countBlocks(opts.outCounts,
+                                                      elsize); });
+
+  std::memcpy(bytePtr(opts.output) + outBlocks.offset[rank],
+              bytePtr(opts.input) + inBlocks.offset[rank],
               opts.inCounts[rank] * elsize);
   if (size == 1) {
     return;
   }
 
   Slot slot = Slot::build(SlotPrefix::kAlltoall, opts.tag);
-  auto in = ctx->createUnboundBuffer(const_cast<void*>(opts.input), inTotal);
-  auto out = ctx->createUnboundBuffer(opts.output, outTotal);
+  auto* in =
+      planh->userBuf(0, const_cast<void*>(opts.input), inTotal);
+  auto* out = planh->userBuf(1, opts.output, outTotal);
   for (int i = 1; i < size; i++) {
     const int sendTo = (rank + i) % size;
     const int recvFrom = (rank - i + size) % size;
-    in->send(sendTo, slot.value(), inOff[sendTo],
+    in->send(sendTo, slot.value(), inBlocks.offset[sendTo],
              opts.inCounts[sendTo] * elsize);
-    out->recv(recvFrom, slot.value(), outOff[recvFrom],
+    out->recv(recvFrom, slot.value(), outBlocks.offset[recvFrom],
               opts.outCounts[recvFrom] * elsize);
     in->waitSend(timeout);
     out->waitRecv(nullptr, timeout);

@@ -34,6 +34,7 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -74,7 +75,7 @@ void q8RingReduceScatterPhase(Context* ctx, float* work,
                               int startShift,
                               std::chrono::milliseconds timeout,
                               transport::UnboundBuffer* workBuf,
-                              collectives_detail::LazyScratch& rxStage,
+                              plan::LazyStage& rxStage,
                               uint8_t* tx,
                               transport::UnboundBuffer* txBuf,
                               size_t wireBlock) {
@@ -143,13 +144,15 @@ size_t maxWireBlock(const Blocks& blocks, size_t block) {
 
 }  // namespace
 
-void q8WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
-                         Slot slot, std::chrono::milliseconds timeout) {
+void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
+                         size_t count, Slot slot,
+                         std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   float* work = reinterpret_cast<float*>(workBytes);
   const size_t block = q8BlockElems();
-  Blocks blocks = evenBlocks(count, size, sizeof(float));
+  const Blocks& blocks = plan.blocks(
+      0, [&] { return evenBlocks(count, size, sizeof(float)); });
   const size_t wireBlock = maxWireBlock(blocks, block);
   const int right = (rank + 1) % size;
   const int left = (rank - 1 + size) % size;
@@ -157,12 +160,13 @@ void q8WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
 
   // Wire staging: tx double-buffered (a sent stream must stay valid
   // until waitSend); rx double-buffered, lazily acquired (untouched on
-  // fully fused hops).
-  auto txScratch = ctx->acquireScratch(2 * wireBlock);
-  uint8_t* tx = reinterpret_cast<uint8_t*>(txScratch.data());
-  auto txBuf = ctx->createUnboundBuffer(tx, 2 * wireBlock);
-  collectives_detail::LazyScratch rxStage(ctx, 2 * wireBlock);
-  auto workBuf = ctx->createUnboundBuffer(work, count * sizeof(float));
+  // fully fused hops). All plan-backed: warm arena + registration on
+  // the steady-state replay.
+  auto txStage = plan.stage(1, 2 * wireBlock);
+  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
+  auto* txBuf = txStage.buf;
+  plan::LazyStage rxStage(plan, 2, 2 * wireBlock);
+  auto* workBuf = plan.userBuf(0, work, count * sizeof(float));
 
   auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
   auto blockStart = [&](int b) {
@@ -170,7 +174,7 @@ void q8WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
   };
 
   q8RingReduceScatterPhase(ctx, work, blocks, slot, /*startShift=*/0,
-                           timeout, workBuf.get(), rxStage, tx, txBuf.get(),
+                           timeout, workBuf, rxStage, tx, txBuf,
                            wireBlock);
 
   // --- allgather: rank r owns reduced block (r+1). The owner quantizes
@@ -212,23 +216,22 @@ void q8WireRingAllreduce(Context* ctx, char* workBytes, size_t count,
   }
 }
 
-void q8WireRingReduceScatter(Context* ctx, char* workBytes,
+void q8WireRingReduceScatter(Context* ctx, plan::Plan& plan,
+                             char* workBytes,
+                             transport::UnboundBuffer* workBuf,
                              const Blocks& blocks, Slot slot,
                              std::chrono::milliseconds timeout) {
   float* work = reinterpret_cast<float*>(workBytes);
   const size_t block = q8BlockElems();
   const size_t wireBlock = maxWireBlock(blocks, block);
-  size_t total = 0;
-  for (size_t b : blocks.bytes) {
-    total += b;
-  }
-  auto txScratch = ctx->acquireScratch(2 * wireBlock);
-  uint8_t* tx = reinterpret_cast<uint8_t*>(txScratch.data());
-  auto txBuf = ctx->createUnboundBuffer(tx, 2 * wireBlock);
-  collectives_detail::LazyScratch rxStage(ctx, 2 * wireBlock);
-  auto workBuf = ctx->createUnboundBuffer(work, total);
+  // Stage slots 0/1 here: the entry's work copy owns slot 2
+  // (kStageRsWork in collectives_ring.cc), and these plans never meet
+  // the binomial/ring staging (different algorithm keys).
+  auto txStage = plan.stage(0, 2 * wireBlock);
+  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
+  plan::LazyStage rxStage(plan, 1, 2 * wireBlock);
   q8RingReduceScatterPhase(ctx, work, blocks, slot, /*startShift=*/-1,
-                           timeout, workBuf.get(), rxStage, tx, txBuf.get(),
+                           timeout, workBuf, rxStage, tx, txStage.buf,
                            wireBlock);
 }
 

@@ -5,6 +5,11 @@
 // (allreduce) or taken from per-rank counts (v-variants / reduce_scatter).
 // All rings send to rank+1 and receive from rank-1; per-step sub-slots keep
 // pipelined messages on one pair from cross-matching.
+//
+// Every public entry resolves its algorithm, then acquires a persistent
+// plan (plan.h) keyed by the call's full identity: the plan owns the
+// registered work/stage buffers and the memoized block/segment layout,
+// so a repeated call replays with zero allocations and registrations.
 #include <algorithm>
 #include <cstring>
 #include <optional>
@@ -13,6 +18,7 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -21,12 +27,26 @@ using collectives_detail::Blocks;
 using collectives_detail::countBlocks;
 using collectives_detail::evenBlocks;
 using collectives_detail::fuseRecvReduce;
-using collectives_detail::LazyScratch;
-using collectives_detail::segmentize;
+using plan::LazyStage;
+using plan::PlanHandle;
+using plan::PlanKey;
+using plan::PlanOp;
 
 namespace {
 
 char* bytePtr(void* p) { return static_cast<char*>(p); }
+
+// Plan stage-slot map for this file's schedules (indices are per-plan,
+// and a plan is keyed by its resolved algorithm, so only slots used by
+// ONE schedule may collide):
+//   0  algorithm-internal staging (binomial reduce)
+//   1  ring reduce-scatter double-buffered staging
+//   2  reduce_scatter work copy (the caller's input stays intact)
+//   3  reduce non-root result
+constexpr size_t kStageBinomial = 0;
+constexpr size_t kStageRingRs = 1;
+constexpr size_t kStageRsWork = 2;
+constexpr size_t kStageReduceResult = 3;
 
 // Ring reduce-scatter over `work` (in place). After P-1 steps, rank r owns
 // block (r + 1 + startShift) mod P fully reduced. startShift=0 feeds the
@@ -46,18 +66,19 @@ char* bytePtr(void* p) { return static_cast<char*>(p); }
 // phase layered behind it on the same tag (allgather, gather-to-root)
 // MUST derive its slot base from this helper, so a change to the RS
 // slot schedule cannot silently collide with a follow-on phase.
-uint64_t ringReduceScatterSlotSpan(const Blocks& blocks, size_t elsize) {
+uint64_t ringReduceScatterSlotSpan(plan::Plan& plan, const Blocks& blocks,
+                                   size_t elsize) {
   size_t maxBlock = 0;
   for (size_t b : blocks.bytes) {
     maxBlock = std::max(maxBlock, b);
   }
   return uint64_t(blocks.bytes.size()) *
-         segmentize(maxBlock, elsize).size();
+         plan.segments(maxBlock, elsize).size();
 }
 
-void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
-                       ReduceFn fn, size_t elsize, Slot slot,
-                       uint64_t slotBase, int startShift,
+void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                       const Blocks& blocks, ReduceFn fn, size_t elsize,
+                       Slot slot, uint64_t slotBase, int startShift,
                        std::chrono::milliseconds timeout,
                        transport::UnboundBuffer* workBuf, bool fuseOk) {
   const int rank = ctx->rank();
@@ -66,7 +87,7 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   for (size_t b : blocks.bytes) {
     maxBlock = std::max(maxBlock, b);
   }
-  const size_t maxSegs = segmentize(maxBlock, elsize).size();
+  const size_t maxSegs = plan.segments(maxBlock, elsize).size();
   const int right = (rank + 1) % size;
   const int left = (rank - 1 + size) % size;
   // Fused receive-reduce: arrivals are combined into `work` by the
@@ -80,10 +101,10 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   // per-source: the ring only ever receives from `left`, so one check
   // picks the schedule (collectives_detail::fuseRecvReduce).
   const bool fuse = fuseRecvReduce(ctx, fuseOk, elsize, left);
-  // Pooled staging, scratch path only (lazy: the fused path receives
-  // straight into `work`): keeps pages warm across calls so the receive
-  // path never stalls on first-touch faults.
-  LazyScratch stage(ctx, 2 * std::max(maxBlock, size_t(1)));
+  // Plan-backed staging, scratch path only (lazy: the fused path receives
+  // straight into `work`): cached plans keep the pages AND the
+  // registration warm across calls.
+  LazyStage stage(plan, kStageRingRs, 2 * std::max(maxBlock, size_t(1)));
   const int steps = size - 1;
 
   auto sendBlockAt = [&](int step) {
@@ -100,7 +121,7 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   // block (combined on arrival); scratch path, into staging half (step%2).
   auto postRecvsFor = [&](int step) {
     const int rb = recvBlockAt(step);
-    auto segs = segmentize(blocks.bytes[rb], elsize);
+    const auto& segs = plan.segments(blocks.bytes[rb], elsize);
     if (fuse) {
       for (size_t k = 0; k < segs.size(); k++) {
         workBuf->recvReduce(left, segSlot(step, k), fn, elsize,
@@ -117,7 +138,8 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   };
   auto postSendsFor = [&](int step) {
     const size_t blockOff = blocks.offset[sendBlockAt(step)];
-    auto segs = segmentize(blocks.bytes[sendBlockAt(step)], elsize);
+    const auto& segs =
+        plan.segments(blocks.bytes[sendBlockAt(step)], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       workBuf->send(right, segSlot(step, k), blockOff + segs[k].offset,
                     segs[k].nbytes);
@@ -133,7 +155,7 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
   for (int step = 0; step < steps; step++) {
     const int recvBlock = recvBlockAt(step);
     const size_t base = (step % 2) * maxBlock;
-    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       if (fuse) {
         // The combine already ran (loop thread / stash hit); the wait is
@@ -153,7 +175,7 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
     // which can differ from the recv block's when block sizes straddle a
     // segment boundary (e.g. evenBlocks remainders).
     const size_t sendSegCount =
-        segmentize(blocks.bytes[sendBlockAt(step)], elsize).size();
+        plan.segments(blocks.bytes[sendBlockAt(step)], elsize).size();
     for (size_t k = 0; k < sendSegCount; k++) {
       workBuf->waitSend(timeout);
     }
@@ -173,9 +195,10 @@ void ringReduceScatter(Context* ctx, char* work, const Blocks& blocks,
 // to the right neighbor the moment it arrives. shift=0 gathers each rank's
 // own block (plain allgather); shift=+1 rides behind a reduce-scatter that
 // left rank r owning reduced block r+1 (the allreduce second phase).
-void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
-                        const Blocks& blocks, size_t elsize, Slot slot,
-                        uint64_t slotBase, size_t maxSegs, int shift,
+void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
+                        transport::UnboundBuffer* buf, const Blocks& blocks,
+                        size_t elsize, Slot slot, uint64_t slotBase,
+                        size_t maxSegs, int shift,
                         std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -190,7 +213,7 @@ void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
   };
   for (int step = 0; step < steps; step++) {
     const int recvBlock = blockAt(step + 1);  // == sendBlock(step) - 1
-    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       buf->recv(left, segSlot(step, k),
                 blocks.offset[recvBlock] + segs[k].offset, segs[k].nbytes);
@@ -199,7 +222,7 @@ void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
   int pendingSends = 0;
   {
     const int sb = blockAt(0);
-    auto segs = segmentize(blocks.bytes[sb], elsize);
+    const auto& segs = plan.segments(blocks.bytes[sb], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       buf->send(right, segSlot(0, k), blocks.offset[sb] + segs[k].offset,
                 segs[k].nbytes);
@@ -208,7 +231,7 @@ void ringAllgatherPhase(Context* ctx, transport::UnboundBuffer* buf,
   }
   for (int step = 0; step < steps; step++) {
     const int recvBlock = blockAt(step + 1);
-    auto segs = segmentize(blocks.bytes[recvBlock], elsize);
+    const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       buf->waitRecv(nullptr, timeout);
       if (step + 1 < steps) {
@@ -285,8 +308,22 @@ static void allgathervRun(AllgathervOptions& opts) {
   const int size = ctx->size();
   TC_ENFORCE_EQ(opts.counts.size(), static_cast<size_t>(size));
   const size_t elsize = elementSize(opts.dtype);
-  Blocks blocks = countBlocks(opts.counts, elsize);
-  const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
+  size_t total = 0;
+  for (size_t c : opts.counts) {
+    total += c * elsize;
+  }
+
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kAllgatherv);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = total;
+  key.aux = plan::hashCounts(opts.counts);
+  PlanHandle planh(ctx, key);
+  const Blocks& blocks = planh->blocks(
+      0, [&] { return countBlocks(opts.counts, elsize); });
 
   if (opts.input != nullptr) {
     std::memcpy(bytePtr(opts.output) + blocks.offset[rank], opts.input,
@@ -301,7 +338,7 @@ static void allgathervRun(AllgathervOptions& opts) {
     maxBlock = std::max(maxBlock, b);
   }
   Slot slot = Slot::build(SlotPrefix::kAllgather, opts.tag);
-  auto out = ctx->createUnboundBuffer(opts.output, total);
+  auto* out = planh->userBuf(0, opts.output, total);
 
   // Small/medium payloads: direct exchange — every pair transfers
   // concurrently with no store-and-forward chain (measured ~2x faster
@@ -327,8 +364,8 @@ static void allgathervRun(AllgathervOptions& opts) {
     return;
   }
 
-  ringAllgatherPhase(ctx, out.get(), blocks, elsize, slot, 0,
-                     segmentize(maxBlock, elsize).size(), /*shift=*/0,
+  ringAllgatherPhase(ctx, *planh, out, blocks, elsize, slot, 0,
+                     planh->segments(maxBlock, elsize).size(), /*shift=*/0,
                      timeout);
 }
 
@@ -419,48 +456,66 @@ void allreduce(AllreduceOptions& opts) {
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1, tuning::allreduceAlgorithmName(algo));
     frOp.setAlgorithm(tuning::allreduceAlgorithmName(algo));
+    // Persistent plan, keyed by the RESOLVED algorithm (a tuning-table
+    // install clears the cache, so a stale kAuto choice cannot replay).
+    // Custom reductions stay transient: the fn pointer's identity is
+    // not stable across calls (Python rebuilds its trampoline).
+    PlanKey key;
+    key.opcode = static_cast<uint8_t>(PlanOp::kAllreduce);
+    key.algorithm = static_cast<uint8_t>(algo);
+    key.dtype = static_cast<uint8_t>(opts.dtype);
+    key.op = static_cast<uint8_t>(opts.op);
+    key.tag = opts.tag;
+    key.ptrA = reinterpret_cast<uintptr_t>(work);
+    key.nbytes = nbytes;
+    PlanHandle planh = opts.customFn == nullptr ? PlanHandle(ctx, key)
+                                                : PlanHandle(ctx);
     switch (algo) {
       case AllreduceAlgorithm::kRing:
-        algorithms::ringAllreduce(ctx, work, opts.count, elsize, fn, slot,
-                                  timeout, opts.customFn == nullptr);
+        algorithms::ringAllreduce(ctx, *planh, work, opts.count, elsize,
+                                  fn, slot, timeout,
+                                  opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kHalvingDoubling:
-        algorithms::halvingDoublingAllreduce(ctx, work, opts.count, elsize,
-                                             fn, slot, timeout,
+        algorithms::halvingDoublingAllreduce(ctx, *planh, work, opts.count,
+                                             elsize, fn, slot, timeout,
                                              opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kHdFold:
-        algorithms::hdFoldAllreduce(ctx, work, opts.count, elsize, fn, slot,
-                                    timeout, opts.customFn == nullptr);
+        algorithms::hdFoldAllreduce(ctx, *planh, work, opts.count, elsize,
+                                    fn, slot, timeout,
+                                    opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kHdBlocks:
-        algorithms::hdBinaryBlocksAllreduce(ctx, work, opts.count, elsize,
-                                            fn, slot, timeout,
+        algorithms::hdBinaryBlocksAllreduce(ctx, *planh, work, opts.count,
+                                            elsize, fn, slot, timeout,
                                             opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kRecursiveDoubling:
-        algorithms::recursiveDoublingAllreduce(ctx, work, opts.count,
-                                               elsize, fn, slot, timeout);
+        algorithms::recursiveDoublingAllreduce(ctx, *planh, work,
+                                               opts.count, elsize, fn,
+                                               slot, timeout);
         break;
       case AllreduceAlgorithm::kBcube:
-        algorithms::bcubeAllreduce(ctx, work, opts.count, elsize, fn, slot,
-                                   timeout, opts.customFn == nullptr);
+        algorithms::bcubeAllreduce(ctx, *planh, work, opts.count, elsize,
+                                   fn, slot, timeout,
+                                   opts.customFn == nullptr);
         break;
       case AllreduceAlgorithm::kRingBf16Wire:
         TC_ENFORCE(opts.dtype == DataType::kFloat32,
                    "bf16-wire allreduce requires float32 payloads");
         TC_ENFORCE(opts.op == ReduceOp::kSum,
                    "bf16-wire allreduce supports sum only");
-        algorithms::bf16WireRingAllreduce(ctx, work, opts.count, slot,
-                                          timeout);
+        algorithms::bf16WireRingAllreduce(ctx, *planh, work, opts.count,
+                                          slot, timeout);
         break;
       case AllreduceAlgorithm::kRingQ8Wire:
         TC_ENFORCE(opts.dtype == DataType::kFloat32,
                    "q8-wire allreduce requires float32 payloads");
         TC_ENFORCE(opts.op == ReduceOp::kSum,
                    "q8-wire allreduce supports sum only");
-        algorithms::q8WireRingAllreduce(ctx, work, opts.count, slot,
-                                        timeout);
+        algorithms::q8WireRingAllreduce(ctx, *planh, work, opts.count,
+                                        slot, timeout);
         break;
       default:
         TC_THROW(EnforceError, "unknown allreduce algorithm");
@@ -474,24 +529,26 @@ void allreduce(AllreduceOptions& opts) {
 
 namespace algorithms {
 
-void ringAllreduce(Context* ctx, char* work, size_t count, size_t elsize,
-                   ReduceFn fn, Slot slot,
+void ringAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                   size_t count, size_t elsize, ReduceFn fn, Slot slot,
                    std::chrono::milliseconds timeout, bool fuseOk) {
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
-  Blocks blocks = evenBlocks(count, size, elsize);
+  const Blocks& blocks =
+      plan.blocks(0, [&] { return evenBlocks(count, size, elsize); });
   size_t maxBlock = 0;
   for (size_t b : blocks.bytes) {
     maxBlock = std::max(maxBlock, b);
   }
-  const size_t maxSegs = segmentize(maxBlock, elsize).size();
-  auto workBuf = ctx->createUnboundBuffer(work, nbytes);
-  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0, 0, timeout,
-                    workBuf.get(), fuseOk);
+  const size_t maxSegs = plan.segments(maxBlock, elsize).size();
+  auto* workBuf = plan.userBuf(0, work, nbytes);
+  ringReduceScatter(ctx, plan, work, blocks, fn, elsize, slot, 0, 0,
+                    timeout, workBuf, fuseOk);
   // Allgather phase: rank r starts owning reduced block (r+1); the block
   // then rides the ring into place on every rank.
-  ringAllgatherPhase(ctx, workBuf.get(), blocks, elsize, slot,
-                     /*slotBase=*/ringReduceScatterSlotSpan(blocks, elsize),
+  ringAllgatherPhase(ctx, plan, workBuf, blocks, elsize, slot,
+                     /*slotBase=*/
+                     ringReduceScatterSlotSpan(plan, blocks, elsize),
                      maxSegs, /*shift=*/1, timeout);
 }
 
@@ -503,21 +560,21 @@ namespace {
 // the number of active ranks per round. log2(P) latency steps, but every
 // round moves a FULL payload and the root's in-link carries log2(P) * N
 // bytes — latency-optimal, bandwidth-hostile.
-void binomialReduce(Context* ctx, char* result, size_t count, size_t elsize,
-                    ReduceFn fn, int root, bool fuseOk, Slot slot,
-                    std::chrono::milliseconds timeout) {
+void binomialReduce(Context* ctx, plan::Plan& plan, char* result,
+                    transport::UnboundBuffer* resultBuf, size_t count,
+                    size_t elsize, ReduceFn fn, int root, bool fuseOk,
+                    Slot slot, std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   const size_t nbytes = count * elsize;
   const int vrank = (rank - root + size) % size;
   auto physical = [&](int v) { return (v + root) % size; };
-  auto resultBuf = ctx->createUnboundBuffer(result, nbytes);
   // Fused receive-reduce: partner partials are combined into `result` by
   // the transport (from the shm ring / stash, no scratch vector at all).
   // Rounds are serialized by waitRecv, so result is never concurrently a
   // send source and a combine target. Custom fns stay on the scratch path
   // (not loop-thread-safe); fuseRecvReduce picks per partner, per round.
-  LazyScratch stage(ctx, nbytes);
+  LazyStage stage(plan, kStageBinomial, nbytes);
 
   int mask = 1;
   uint64_t round = 0;
@@ -552,18 +609,20 @@ void binomialReduce(Context* ctx, char* result, size_t count, size_t elsize,
 // ~2N bytes per link total and ~N bytes through the root's in-link,
 // vs the binomial's log2(P) * N. Reuses ringReduceScatter wholesale
 // (segment pipelining, two-ahead pre-posts, fused receive-reduce).
-void ringReduce(Context* ctx, char* work, size_t count, size_t elsize,
-                ReduceFn fn, int root, bool fuseOk, Slot slot,
-                std::chrono::milliseconds timeout) {
+void ringReduce(Context* ctx, plan::Plan& plan, char* work,
+                transport::UnboundBuffer* workBuf, size_t count,
+                size_t elsize, ReduceFn fn, int root, bool fuseOk,
+                Slot slot, std::chrono::milliseconds timeout) {
   const int rank = ctx->rank();
   const int size = ctx->size();
-  Blocks blocks = evenBlocks(count, size, elsize);
-  auto workBuf = ctx->createUnboundBuffer(work, count * elsize);
-  ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
-                    /*startShift=*/-1, timeout, workBuf.get(), fuseOk);
+  const Blocks& blocks =
+      plan.blocks(0, [&] { return evenBlocks(count, size, elsize); });
+  ringReduceScatter(ctx, plan, work, blocks, fn, elsize, slot, 0,
+                    /*startShift=*/-1, timeout, workBuf, fuseOk);
   // Gather phase: block b travels root's in-link exactly once. Slots
   // continue past the reduce-scatter's reserved range.
-  const uint64_t gatherBase = ringReduceScatterSlotSpan(blocks, elsize);
+  const uint64_t gatherBase =
+      ringReduceScatterSlotSpan(plan, blocks, elsize);
   if (rank == root) {
     int pending = 0;
     for (int b = 0; b < size; b++) {
@@ -605,21 +664,10 @@ void reduce(ReduceOptions& opts) {
 
   const bool isRoot = rank == opts.root;
   TC_ENFORCE(!isRoot || opts.output != nullptr, "reduce: root needs output");
-  // Non-root ranks work in pooled scratch (the ring writes the whole
-  // buffer during the reduce-scatter phase, so it must be full-size
-  // even though only one block of it is ever sent on).
-  std::optional<Context::Scratch> scratch;
-  char* result;
-  if (isRoot) {
-    result = bytePtr(opts.output);
-  } else {
-    scratch.emplace(ctx->acquireScratch(nbytes));
-    result = scratch->data();
-  }
-  if (result != opts.input) {
-    std::memcpy(result, opts.input, nbytes);
-  }
   if (size == 1 || opts.count == 0) {
+    if (isRoot && opts.output != opts.input && nbytes > 0) {
+      std::memcpy(opts.output, opts.input, nbytes);
+    }
     return;
   }
 
@@ -649,14 +697,46 @@ void reduce(ReduceOptions& opts) {
   auto traceSpan = ctx->tracer().span(
       "reduce", nbytes, -1, tuning::reduceAlgorithmName(algo));
   frOp.setAlgorithm(tuning::reduceAlgorithmName(algo));
+
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kReduce);
+  key.algorithm = static_cast<uint8_t>(algo);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.op = static_cast<uint8_t>(opts.op);
+  key.root = opts.root;
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = nbytes;
+  PlanHandle planh =
+      fuseOk ? PlanHandle(ctx, key) : PlanHandle(ctx);
+
+  // Non-root ranks work in plan scratch (the ring writes the whole
+  // buffer during the reduce-scatter phase, so it must be full-size
+  // even though only one block of it is ever sent on). The stage's
+  // registration doubles as the schedule's work buffer.
+  char* result;
+  transport::UnboundBuffer* resultBuf;
+  if (isRoot) {
+    result = bytePtr(opts.output);
+    resultBuf = planh->userBuf(0, result, nbytes);
+  } else {
+    auto st = planh->stage(kStageReduceResult, nbytes);
+    result = st.data;
+    resultBuf = st.buf;
+  }
+  if (result != opts.input) {
+    std::memcpy(result, opts.input, nbytes);
+  }
+
   switch (algo) {
     case ReduceAlgorithm::kBinomial:
-      binomialReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
-                     slot, timeout);
+      binomialReduce(ctx, *planh, result, resultBuf, opts.count, elsize,
+                     fn, opts.root, fuseOk, slot, timeout);
       break;
     case ReduceAlgorithm::kRing:
-      ringReduce(ctx, result, opts.count, elsize, fn, opts.root, fuseOk,
-                 slot, timeout);
+      ringReduce(ctx, *planh, result, resultBuf, opts.count, elsize, fn,
+                 opts.root, fuseOk, slot, timeout);
       break;
     default:
       TC_THROW(EnforceError, "unknown reduce algorithm");
@@ -678,8 +758,10 @@ void reduceScatter(ReduceScatterOptions& opts) {
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
-  Blocks blocks = countBlocks(opts.recvCounts, elsize);
-  const size_t total = blocks.offset[size - 1] + blocks.bytes[size - 1];
+  size_t total = 0;
+  for (size_t c : opts.recvCounts) {
+    total += c * elsize;
+  }
   MetricsOp metricsOp(&ctx->metrics(), MetricOp::kReduceScatter, total);
   FlightRecOp frOp(
       &ctx->flightrec(), "reduce_scatter", nullptr,
@@ -691,10 +773,6 @@ void reduceScatter(ReduceScatterOptions& opts) {
     return;
   }
 
-  // Work in a (pooled) scratch copy so the caller's input stays intact.
-  auto scratch = ctx->acquireScratch(total);
-  char* work = scratch.data();
-  std::memcpy(work, opts.input, total);
   Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
   const bool fuseOk = opts.customFn == nullptr;
   ReduceScatterAlgorithm algo = opts.algorithm;
@@ -722,27 +800,47 @@ void reduceScatter(ReduceScatterOptions& opts) {
     }
   }
   frOp.setAlgorithm(tuning::reduceScatterAlgorithmName(algo));
+
+  PlanKey key;
+  key.opcode = static_cast<uint8_t>(PlanOp::kReduceScatter);
+  key.algorithm = static_cast<uint8_t>(algo);
+  key.dtype = static_cast<uint8_t>(opts.dtype);
+  key.op = static_cast<uint8_t>(opts.op);
+  key.tag = opts.tag;
+  key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+  key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+  key.nbytes = total;
+  key.aux = plan::hashCounts(opts.recvCounts);
+  PlanHandle planh =
+      fuseOk ? PlanHandle(ctx, key) : PlanHandle(ctx);
+  const Blocks& blocks = planh->blocks(
+      0, [&] { return countBlocks(opts.recvCounts, elsize); });
+
+  // Work in a plan-staged copy so the caller's input stays intact; the
+  // stage's registration is the schedule's work buffer.
+  auto st = planh->stage(kStageRsWork, total);
+  char* work = st.data;
+  std::memcpy(work, opts.input, total);
   switch (algo) {
     case ReduceScatterAlgorithm::kDirect:
-      algorithms::directReduceScatter(ctx, work, blocks, fn, elsize, slot,
-                                      timeout, fuseOk);
+      algorithms::directReduceScatter(ctx, *planh, work, st.buf, blocks,
+                                      fn, elsize, slot, timeout, fuseOk);
       break;
     case ReduceScatterAlgorithm::kHalvingDoubling:
-      algorithms::hdReduceScatter(ctx, work, blocks, fn, elsize, slot,
-                                  timeout, fuseOk);
+      algorithms::hdReduceScatter(ctx, *planh, work, st.buf, blocks, fn,
+                                  elsize, slot, timeout, fuseOk);
       break;
-    case ReduceScatterAlgorithm::kRing: {
-      auto workBuf = ctx->createUnboundBuffer(work, total);
-      ringReduceScatter(ctx, work, blocks, fn, elsize, slot, 0,
-                        /*startShift=*/-1, timeout, workBuf.get(), fuseOk);
+    case ReduceScatterAlgorithm::kRing:
+      ringReduceScatter(ctx, *planh, work, blocks, fn, elsize, slot, 0,
+                        /*startShift=*/-1, timeout, st.buf, fuseOk);
       break;
-    }
     case ReduceScatterAlgorithm::kRingQ8Wire:
       TC_ENFORCE(opts.dtype == DataType::kFloat32,
                  "q8-wire reduce_scatter requires float32 payloads");
       TC_ENFORCE(opts.op == ReduceOp::kSum && opts.customFn == nullptr,
                  "q8-wire reduce_scatter supports builtin sum only");
-      algorithms::q8WireRingReduceScatter(ctx, work, blocks, slot, timeout);
+      algorithms::q8WireRingReduceScatter(ctx, *planh, work, st.buf,
+                                          blocks, slot, timeout);
       break;
     default:
       TC_THROW(EnforceError, "unknown reduce_scatter algorithm");

@@ -113,36 +113,10 @@ inline bool fuseRecvReduce(Context* ctx, bool fuseOk, size_t elsize,
           ctx->transport()->peerUsesShm(srcRank));
 }
 
-// Pooled scratch + its unbound buffer, materialized on first use: fully
-// fused schedules never pop a pooled buffer they won't touch, while any
-// fallback still gets the warm-page pool.
-class LazyScratch {
- public:
-  LazyScratch(Context* ctx, size_t minBytes)
-      : ctx_(ctx), minBytes_(minBytes) {}
-  char* data() {
-    ensure();
-    return tmp_;
-  }
-  transport::UnboundBuffer* buf() {
-    ensure();
-    return tmpBuf_.get();
-  }
-
- private:
-  void ensure() {
-    if (!tmpBuf_) {
-      scratch_.emplace(ctx_->acquireScratch(minBytes_));
-      tmp_ = scratch_->data();
-      tmpBuf_ = ctx_->createUnboundBuffer(tmp_, scratch_->size());
-    }
-  }
-  Context* const ctx_;
-  const size_t minBytes_;
-  std::optional<Context::Scratch> scratch_;
-  char* tmp_{nullptr};
-  std::unique_ptr<transport::UnboundBuffer> tmpBuf_;
-};
+// (The lazily-materialized pooled scratch that used to live here —
+// LazyScratch — became plan::LazyStage: the same first-touch contract,
+// now backed by the persistent plan's arena so a repeated collective
+// reuses the registration instead of re-creating it. See plan.h.)
 
 inline std::vector<SegSpan> segmentize(size_t blockBytes, size_t elsize) {
   size_t segBytes = std::max(kMaxSegmentBytes / elsize * elsize, elsize);

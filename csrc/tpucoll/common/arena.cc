@@ -1,0 +1,46 @@
+#include "tpucoll/common/arena.h"
+
+#include <cstdlib>
+#include <new>
+#include <utility>
+
+namespace tpucoll {
+
+namespace {
+// Cache-line alignment: arena blocks back wire staging that the AVX
+// reduce kernels and the q8/bf16 codecs stream through.
+constexpr size_t kArenaAlign = 64;
+}  // namespace
+
+Arena::~Arena() {
+  std::free(buf_);
+}
+
+Arena::Arena(Arena&& o) noexcept
+    : buf_(std::exchange(o.buf_, nullptr)),
+      cap_(std::exchange(o.cap_, 0)),
+      grew_(std::exchange(o.grew_, false)) {}
+
+char* Arena::require(size_t minBytes) {
+  if (minBytes <= cap_ && buf_ != nullptr) {
+    grew_ = false;
+    return buf_;
+  }
+  // Round up to the alignment so aligned_alloc's size contract holds.
+  const size_t want =
+      (minBytes + kArenaAlign - 1) / kArenaAlign * kArenaAlign;
+  char* fresh = static_cast<char*>(
+      std::aligned_alloc(kArenaAlign, want == 0 ? kArenaAlign : want));
+  if (fresh == nullptr) {
+    throw std::bad_alloc();
+  }
+  // Grow-only: no copy of prior contents — plan stages are scratch whose
+  // lifetime is one collective call; a grown arena starts a fresh call.
+  std::free(buf_);
+  buf_ = fresh;
+  cap_ = want == 0 ? kArenaAlign : want;
+  grew_ = true;
+  return buf_;
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,47 @@
+// Grow-only scratch arena: a single aligned block that only ever grows
+// to its high watermark and is reused verbatim below it. The persistent
+// collective plans (collectives/plan.h) hold one arena per staging slot
+// so the steady-state replay of a repeated collective touches warm,
+// already-registered pages — no allocation, no first-touch page faults,
+// no re-registration.
+//
+// NOT thread-safe and NOT stable across growth: require() may move the
+// block when the watermark rises, invalidating every pointer (and any
+// UnboundBuffer registered over it). Owners that pair an arena with a
+// registration must rebuild the registration whenever require() grows —
+// plan::Plan::stage() is the reference user.
+#pragma once
+
+#include <cstddef>
+
+namespace tpucoll {
+
+class Arena {
+ public:
+  Arena() = default;
+  ~Arena();
+
+  Arena(const Arena&) = delete;
+  Arena& operator=(const Arena&) = delete;
+  Arena(Arena&& o) noexcept;
+  Arena& operator=(Arena&&) = delete;
+
+  // Pointer to at least minBytes of scratch, 64-byte aligned. Grows
+  // (moving the block) only when minBytes exceeds the current
+  // watermark; otherwise returns the existing block untouched.
+  char* require(size_t minBytes);
+
+  char* data() const { return buf_; }
+  size_t capacity() const { return cap_; }
+
+  // True when the last require() call grew (or first-allocated) the
+  // block — the signal to rebuild anything registered over it.
+  bool grewOnLastRequire() const { return grew_; }
+
+ private:
+  char* buf_{nullptr};
+  size_t cap_{0};
+  bool grew_{false};
+};
+
+}  // namespace tpucoll

@@ -162,7 +162,14 @@ std::string Metrics::toJson(int rank, bool drain) {
       << ",\"stash_pauses\":"
       << stashPauses_.load(std::memory_order_relaxed)
       << ",\"trace_events_dropped\":"
-      << traceEventsDropped_.load(std::memory_order_relaxed);
+      << traceEventsDropped_.load(std::memory_order_relaxed)
+      << ",\"plan_hits\":" << planHits_.load(std::memory_order_relaxed)
+      << ",\"plan_misses\":"
+      << planMisses_.load(std::memory_order_relaxed)
+      << ",\"plan_evictions\":"
+      << planEvictions_.load(std::memory_order_relaxed)
+      << ",\"ubuf_creates\":"
+      << ubufCreates_.load(std::memory_order_relaxed);
 
   out << ",\"faults\":{\"total\":"
       << faultsTotal_.load(std::memory_order_relaxed);
@@ -318,6 +325,10 @@ void Metrics::resetAll() {
     // lastProgressUs survives: it is a timestamp, not a counter.
   }
   retries_.store(0, std::memory_order_relaxed);
+  planHits_.store(0, std::memory_order_relaxed);
+  planMisses_.store(0, std::memory_order_relaxed);
+  planEvictions_.store(0, std::memory_order_relaxed);
+  ubufCreates_.store(0, std::memory_order_relaxed);
   stalls_.store(0, std::memory_order_relaxed);
   stashPauses_.store(0, std::memory_order_relaxed);
   traceEventsDropped_.store(0, std::memory_order_relaxed);

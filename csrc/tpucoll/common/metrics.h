@@ -257,6 +257,36 @@ class Metrics {
     traceEventsDropped_.fetch_add(1, std::memory_order_relaxed);
   }
 
+  // ---- persistent collective plans (collectives/plan.h) ----
+  // Cache traffic plus the registration counter the plans exist to
+  // flatten: ubuf_creates counts every UnboundBuffer constructed on
+  // this context's transport, so a steady-state loop proving "zero new
+  // registrations" is a zero delta on one number.
+  void recordPlanHit() {
+    if (!enabled()) {
+      return;
+    }
+    planHits_.fetch_add(1, std::memory_order_relaxed);
+  }
+  void recordPlanMiss() {
+    if (!enabled()) {
+      return;
+    }
+    planMisses_.fetch_add(1, std::memory_order_relaxed);
+  }
+  void recordPlanEvictions(uint64_t n) {
+    if (!enabled()) {
+      return;
+    }
+    planEvictions_.fetch_add(n, std::memory_order_relaxed);
+  }
+  void recordUbufCreate() {
+    if (!enabled()) {
+      return;
+    }
+    ubufCreates_.fetch_add(1, std::memory_order_relaxed);
+  }
+
   // ---- connect retries (Pair backoff loop) ----
   void recordRetry() {
     if (!enabled()) {
@@ -307,6 +337,10 @@ class Metrics {
   OpStats ops_[static_cast<int>(MetricOp::kCount)];
   std::vector<PeerStats> peers_;
   std::atomic<uint64_t> retries_{0};
+  std::atomic<uint64_t> planHits_{0};
+  std::atomic<uint64_t> planMisses_{0};
+  std::atomic<uint64_t> planEvictions_{0};
+  std::atomic<uint64_t> ubufCreates_{0};
   std::atomic<uint64_t> stalls_{0};
   std::atomic<uint64_t> stashPauses_{0};
   std::atomic<uint64_t> traceEventsDropped_{0};

@@ -5,6 +5,7 @@
 #include <sstream>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/env.h"
 #include "tpucoll/fault/fault.h"
 #include "tpucoll/tuning/tuning_table.h"
@@ -27,6 +28,9 @@ Context::Context(int rank, int size)
   // Bounded tracer (tracer.h): overflow drops are counted in the
   // registry instead of growing the event vector without limit.
   tracer_.setMetrics(&metrics_);
+  // Strict knobs parse here, where the throw crosses the wrapped C ABI
+  // as a typed error rather than killing a loop thread.
+  planCache_ = std::make_unique<plan::PlanCache>(this);
 }
 
 Context::~Context() {
@@ -38,7 +42,11 @@ Context::~Context() {
   // without a barrier). Members destroy in reverse declaration order
   // and tctx_ is declared FIRST — i.e. it would be destroyed LAST,
   // after the members those callbacks write — so tear it down
-  // explicitly before any member dies.
+  // explicitly before any member dies. Plans go first of all: they own
+  // UnboundBuffers whose destructors walk the live transport.
+  if (planCache_ != nullptr) {
+    planCache_->clear();
+  }
   tctx_.reset();
 }
 
@@ -124,8 +132,17 @@ std::string Context::metricsJson(bool drain) {
 
 void Context::setTuningTable(
     std::shared_ptr<const tuning::TuningTable> table) {
-  std::lock_guard<std::mutex> guard(tuningMu_);
-  tuningTable_ = std::move(table);
+  {
+    std::lock_guard<std::mutex> guard(tuningMu_);
+    tuningTable_ = std::move(table);
+  }
+  // Cached plans embed the RESOLVED algorithm of their kAuto dispatch;
+  // a new table may elect differently, so every plan is stale now.
+  // (Outside tuningMu_: clear() drains buffers and must not nest under
+  // the dispatch-path lock.)
+  if (planCache_ != nullptr) {
+    planCache_->clear();
+  }
 }
 
 std::shared_ptr<const tuning::TuningTable> Context::tuningTable() const {
@@ -175,6 +192,11 @@ std::unique_ptr<transport::UnboundBuffer> Context::createUnboundBuffer(
 }
 
 void Context::close() {
+  // Plans first: their registrations point into the transport about to
+  // be quiesced, and a cached buffer's drain pass needs it alive.
+  if (planCache_ != nullptr) {
+    planCache_->clear();
+  }
   if (tctx_) {
     tctx_->close();
   }

@@ -23,6 +23,10 @@ namespace tuning {
 class TuningTable;
 }  // namespace tuning
 
+namespace plan {
+class PlanCache;
+}  // namespace plan
+
 class Context {
  public:
   static constexpr std::chrono::milliseconds kDefaultTimeout =
@@ -92,6 +96,14 @@ class Context {
 
   transport::Context* transport() const { return tctx_.get(); }
 
+  // Persistent collective plans (collectives/plan.h): LRU of pre-created
+  // UnboundBuffers + scratch arenas + memoized schedules keyed by the
+  // repeated collective's full identity, so the steady-state replay of
+  // training traffic performs zero allocations and zero registrations.
+  // Invalidation: close()/destruction and setTuningTable() drop every
+  // plan (the latter because kAuto keys embed the resolved algorithm).
+  plan::PlanCache& planCache() { return *planCache_; }
+
   // First-class tracing (capability the reference lacks): start(), run
   // collectives, then dump Chrome trace-event JSON via traceJson().
   Tracer& tracer() { return tracer_; }
@@ -160,6 +172,7 @@ class Context {
   std::shared_ptr<Store> store_;
   std::shared_ptr<transport::Device> device_;
   std::unique_ptr<transport::Context> tctx_;
+  std::unique_ptr<plan::PlanCache> planCache_;
 
   std::mutex scratchMu_;
   std::vector<std::vector<char>> scratchPool_;

@@ -259,6 +259,11 @@ void Context::connectFullMesh(Store& store,
 
 std::unique_ptr<UnboundBuffer> Context::createUnboundBuffer(void* ptr,
                                                             size_t size) {
+  // Registration counter the plan cache's steady-state contract keys
+  // on: a warm planned loop must hold this at a zero delta.
+  if (metrics_ != nullptr) {
+    metrics_->recordUbufCreate();
+  }
   return std::make_unique<UnboundBuffer>(this, ptr, size);
 }
 

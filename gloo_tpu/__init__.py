@@ -23,6 +23,7 @@ from gloo_tpu.bucketer import GradientBucketer
 from gloo_tpu.core import (
     Aborted,
     AsyncEngine,
+    CollectivePlan,
     Context,
     Device,
     Error,

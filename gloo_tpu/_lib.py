@@ -147,6 +147,14 @@ _PROTOTYPES = {
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32,
                             _i64]),
+    # zero-copy in-place entries (persistent-plan hot path)
+    "tc_allreduce_inplace": (_int, [_c, _c, _sz, _int, _int, _int, _u32,
+                                    _i64]),
+    "tc_reduce_scatter_inplace": (_int, [_c, _c, ctypes.POINTER(_sz),
+                                         _int, _int, _int, _u32, _i64]),
+    # plan-cache introspection
+    "tc_plan_cache_size": (_sz, [_c]),
+    "tc_plan_cache_clear": (None, [_c]),
     "tc_allreduce_multi": (_int, [_c, ctypes.POINTER(_c),
                                   ctypes.POINTER(_c), _sz, _sz, _int,
                                   _int, _int, _u32, _i64]),
@@ -187,6 +195,8 @@ _PROTOTYPES = {
     "tc_async_stats_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     "tc_async_allreduce": (_c, [_c, _c, _c, _sz, _int, _int, _int, _i64]),
+    "tc_async_allreduce_inplace": (_c, [_c, _c, _sz, _int, _int, _int,
+                                        _i64]),
     "tc_async_reduce_scatter": (_c, [_c, _c, _c, ctypes.POINTER(_sz),
                                      _int, _int, _int, _int, _i64]),
     "tc_async_allgather": (_c, [_c, _c, _c, _sz, _int, _i64]),

@@ -121,6 +121,15 @@ class GradientBucketer:
         # Issued buckets in issue order: (work, flat, members); flat is
         # None when an oversized array was issued in place.
         self._issued: List = []
+        # (dtype name, elements) -> free flat buckets, reused across
+        # steps. A training loop adds the same tensors every step, so
+        # bucket shapes repeat exactly — reusing the flat buffer keeps
+        # its POINTER stable, which is what turns every bucket allreduce
+        # into a native plan-cache hit (zero allocations and zero
+        # buffer registrations on the lane contexts; docs/design.md).
+        # Buffers return to the pool only after their wait completed,
+        # so a pooled buffer is never concurrently owned by a lane.
+        self._flat_pool = {}
 
     @property
     def in_flight(self) -> int:
@@ -165,13 +174,27 @@ class GradientBucketer:
         # algorithm choice is too).
         return self._wire if dtype == np.float32 else None
 
+    def _take_flat(self, dtype, total: int) -> np.ndarray:
+        stack = self._flat_pool.get((np.dtype(dtype).name, total))
+        if stack:
+            return stack.pop()
+        return np.empty(total, dtype=dtype)
+
+    def _release_flat(self, flat: np.ndarray) -> None:
+        key = (flat.dtype.name, int(flat.size))
+        stack = self._flat_pool.setdefault(key, [])
+        # Bound the pool: at most lanes+1 buckets of one shape are ever
+        # in flight, so a small cap covers the steady state.
+        if len(stack) < 4:
+            stack.append(flat)
+
     def _flush_dtype(self, dtype: str) -> None:
         entry = self._pending.pop(dtype, None)
         if entry is None or not entry[0]:
             return
         members, _ = entry
         total = sum(int(m.size) for m in members)
-        flat = np.empty(total, dtype=members[0].dtype)
+        flat = self._take_flat(members[0].dtype, total)
         off = 0
         for m in members:
             flat[off:off + m.size] = m.reshape(-1)
@@ -213,6 +236,9 @@ class GradientBucketer:
                         np.copyto(m, flat[off:off + m.size]
                                   .reshape(m.shape))
                         off += m.size
+                    # Waited out and unpacked: safe to reuse next step
+                    # (same shape -> same pointer -> plan-cache hit).
+                    self._release_flat(flat)
                 self._issued.pop(0)
         except BaseException:
             self._drain_after_error(timeout)

@@ -22,6 +22,7 @@ from gloo_tpu._lib import Aborted, Error, IoError, TimeoutError, check, check_ha
 __all__ = [
     "Aborted",
     "AsyncEngine",
+    "CollectivePlan",
     "Context",
     "set_connect_debug_logger",
     "Device",
@@ -147,6 +148,38 @@ def _timeout_ms(timeout: Optional[float]) -> int:
 
 
 _copy_out = _lib.copy_out
+
+
+def _resolve_output(output, dtype, count: int, op_name: str) -> np.ndarray:
+    """Allocate (or validate a preallocated) result array: `count`
+    elements of `dtype`. Preallocation is the plan-cache hot path — a
+    stable output pointer lets repeated calls replay a cached plan."""
+    if output is None:
+        return np.empty(count, dtype=dtype)
+    out = _check_array(output, "output")
+    if out.dtype != dtype or out.size != count:
+        raise Error(f"{op_name} output must match dtype {np.dtype(dtype)} "
+                    f"and hold {count} elements")
+    return out
+
+
+def _resolve_recv_counts(recv_counts, array: np.ndarray, size: int):
+    """Shared reduce_scatter recv_counts contract: default to the even
+    split, enforce one entry per rank and a total matching the input
+    (typed errors — an assert would vanish under python -O and a short
+    vector would read past the ctypes array in the C layer)."""
+    if recv_counts is None:
+        if array.size % size != 0:
+            raise Error("reduce_scatter: array size not divisible by "
+                        "group size (pass recv_counts)")
+        return [array.size // size] * size
+    recv_counts = list(recv_counts)
+    if len(recv_counts) != size:
+        raise Error(f"reduce_scatter: recv_counts needs one entry per "
+                    f"rank ({size}), got {len(recv_counts)}")
+    if sum(recv_counts) != array.size:
+        raise Error("reduce_scatter: sum(recv_counts) != array.size")
+    return recv_counts
 
 
 class Store:
@@ -694,8 +727,11 @@ class AsyncEngine:
         if callable(op):
             raise Error("async allreduce does not support callable "
                         "reductions (lane threads cannot enter Python)")
-        handle = check_handle(_lib.lib.tc_async_allreduce(
-            self._handle, _ptr(array), _ptr(array), array.size,
+        # In-place entry: the stable buffer pointer keys the per-lane
+        # plan cache, so a training loop's repeated buckets replay with
+        # zero allocations/registrations on the lane contexts too.
+        handle = check_handle(_lib.lib.tc_async_allreduce_inplace(
+            self._handle, _ptr(array), array.size,
             _dtype_code(array), ReduceOp.parse(op),
             Context._ALGORITHMS[algorithm], _timeout_ms(timeout)))
         return Work(self, handle, "allreduce", (array,), result=array)
@@ -704,23 +740,23 @@ class AsyncEngine:
                              recv_counts: Optional[Sequence[int]] = None,
                              op="sum", algorithm: str = "auto",
                              timeout: Optional[float] = None,
-                             wire: Optional[str] = None) -> Work:
+                             wire: Optional[str] = None,
+                             output: Optional[np.ndarray] = None) -> Work:
         """Async reduce_scatter; the output array is ``work.result``.
         wire="q8" opts into the int8 block-quantized wire (float32 sum
-        only; docs/algorithms.md)."""
+        only; docs/algorithms.md). A preallocated `output`
+        (recv_counts[rank] elements) keeps the result pointer stable
+        across steps — the per-lane plan-cache hot path."""
         algorithm = Context._resolve_rs_wire(wire, algorithm)
         _check_array(array)
         if callable(op):
             raise Error("async reduce_scatter does not support callable "
                         "reductions (lane threads cannot enter Python)")
         size = self._context.size
-        if recv_counts is None:
-            assert array.size % size == 0, \
-                "array size not divisible by group size"
-            recv_counts = [array.size // size] * size
-        assert sum(recv_counts) == array.size, "sum(recv_counts) != size"
-        out = np.empty(int(recv_counts[self._context.rank]),
-                       dtype=array.dtype)
+        recv_counts = _resolve_recv_counts(recv_counts, array, size)
+        out = _resolve_output(output, array.dtype,
+                              int(recv_counts[self._context.rank]),
+                              "reduce_scatter")
         handle = check_handle(_lib.lib.tc_async_reduce_scatter(
             self._handle, _ptr(array), _ptr(out),
             _counts_arg(recv_counts), size, _dtype_code(array),
@@ -730,11 +766,17 @@ class AsyncEngine:
                     result=out)
 
     def allgather_async(self, array: np.ndarray,
-                        timeout: Optional[float] = None) -> Work:
-        """Async allgather; the (size, *shape) output is ``work.result``."""
+                        timeout: Optional[float] = None,
+                        output: Optional[np.ndarray] = None) -> Work:
+        """Async allgather; the (size, *shape) output is ``work.result``.
+        A preallocated `output` (size * array.size elements) keeps the
+        result pointer stable — the per-lane plan-cache hot path."""
         _check_array(array)
-        out = np.empty((self._context.size,) + array.shape,
-                       dtype=array.dtype)
+        out = _resolve_output(output, array.dtype,
+                              self._context.size * array.size,
+                              "allgather")
+        if output is None:
+            out = out.reshape((self._context.size,) + array.shape)
         handle = check_handle(_lib.lib.tc_async_allgather(
             self._handle, _ptr(array), _ptr(out), array.size,
             _dtype_code(array), _timeout_ms(timeout)))
@@ -786,6 +828,42 @@ class AsyncEngine:
                                              path.encode()))
             paths[lane] = path
         return paths
+
+
+class CollectivePlan:
+    """Persistent handle for one repeated collective — the reference's
+    Algorithm-object pattern (create once with pre-registered buffers,
+    replay every step), surfaced in Python.
+
+    Built by :meth:`Context.allreduce_plan` /
+    :meth:`Context.reduce_scatter_plan` / :meth:`Context.allgather_plan`.
+    Validation and ctypes argument marshalling happen ONCE at
+    construction; each ``plan()`` call is a single foreign call whose
+    stable buffer pointers hit the native plan cache, so the steady
+    state replays with zero allocations and zero buffer registrations
+    (docs/design.md "Persistent collective plans").
+
+    The plan pins its numpy buffers; the collective runs in place on
+    them every call (``result`` is the output array). All the usual
+    collective contracts apply per call — every rank must call matching
+    plans in matching order, and on error the buffer contents are
+    undefined (docs/errors.md)."""
+
+    __slots__ = ("_context", "_fn", "_args", "_arrays", "result")
+
+    def __init__(self, context, fn, args, arrays, result):
+        # Pin the owning Context: the marshalled args embed its native
+        # handle, so a plan outliving the Context object would call
+        # into freed memory otherwise.
+        self._context = context
+        self._fn = fn
+        self._args = args
+        self._arrays = arrays  # pin every buffer the native side touches
+        self.result = result
+
+    def __call__(self):
+        check(self._fn(*self._args))
+        return self.result
 
 
 class Context:
@@ -938,6 +1016,7 @@ class Context:
 
         Shape: {"rank", "size", "enabled", "watchdog_ms", "now_us",
         "retries", "stash_pauses", "trace_events_dropped",
+        "plan_hits", "plan_misses", "plan_evictions", "ubuf_creates",
         "faults": {"total", <action>: n...},
         "transport_failure": null | {"peer", "count", "message"},
         "ops": {name: {"calls", "bytes", "errors",
@@ -991,6 +1070,88 @@ class Context:
 
     def register(self, array: np.ndarray) -> UnboundBuffer:
         return UnboundBuffer(self, array)
+
+    # ---- persistent collective plans (docs/design.md) ----
+
+    def allreduce_plan(self, array: np.ndarray, op="sum",
+                       algorithm: str = "auto", tag: int = 0,
+                       timeout: Optional[float] = None,
+                       wire: Optional[str] = None) -> CollectivePlan:
+        """Build a persistent in-place allreduce over `array` (same
+        semantics and arguments as :meth:`allreduce`, callable
+        reductions excluded). ``plan()`` replays it: one foreign call,
+        zero per-step allocations or registrations once warm — the
+        hot path for training loops whose buffers are stable."""
+        algorithm = self._resolve_wire(wire, algorithm)
+        _check_array(array)
+        if callable(op):
+            raise Error("allreduce_plan does not support callable "
+                        "reductions (build per-call instead)")
+        args = (self._handle, _ptr(array), array.size, _dtype_code(array),
+                ReduceOp.parse(op), self._ALGORITHMS[algorithm], tag,
+                _timeout_ms(timeout))
+        return CollectivePlan(self, _lib.lib.tc_allreduce_inplace, args,
+                              (array,), array)
+
+    def reduce_scatter_plan(self, array: np.ndarray,
+                            recv_counts: Optional[Sequence[int]] = None,
+                            op="sum", algorithm: str = "auto",
+                            tag: int = 0,
+                            timeout: Optional[float] = None,
+                            wire: Optional[str] = None,
+                            output: Optional[np.ndarray] = None
+                            ) -> CollectivePlan:
+        """Persistent reduce_scatter: like :meth:`reduce_scatter` but
+        marshalled once; ``plan()`` reduces `array` and writes this
+        rank's block into ``plan.result`` (the preallocated `output`
+        when given)."""
+        algorithm = self._resolve_rs_wire(wire, algorithm)
+        _check_array(array)
+        if callable(op):
+            raise Error("reduce_scatter_plan does not support callable "
+                        "reductions (build per-call instead)")
+        recv_counts = _resolve_recv_counts(recv_counts, array, self.size)
+        out = _resolve_output(output, array.dtype,
+                              int(recv_counts[self.rank]),
+                              "reduce_scatter")
+        counts = _counts_arg(recv_counts)  # pinned by the plan
+        args = (self._handle, _ptr(array), _ptr(out), counts,
+                _dtype_code(array), ReduceOp.parse(op),
+                self._RS_ALGORITHMS[algorithm], tag, _timeout_ms(timeout))
+        return CollectivePlan(self, _lib.lib.tc_reduce_scatter, args,
+                              (array, out, counts), out)
+
+    def allgather_plan(self, array: np.ndarray, tag: int = 0,
+                       timeout: Optional[float] = None,
+                       output: Optional[np.ndarray] = None
+                       ) -> CollectivePlan:
+        """Persistent allgather: ``plan()`` gathers `array` from every
+        rank into ``plan.result`` ((size, *shape), or the preallocated
+        `output`)."""
+        _check_array(array)
+        out = _resolve_output(output, array.dtype, self.size * array.size,
+                              "allgather")
+        if output is None:
+            out = out.reshape((self.size,) + array.shape)
+        args = (self._handle, _ptr(array), _ptr(out), array.size,
+                _dtype_code(array), tag, _timeout_ms(timeout))
+        return CollectivePlan(self, _lib.lib.tc_allgather, args,
+                              (array, out), out)
+
+    def plan_cache_size(self) -> int:
+        """Entries currently in this context's persistent-plan LRU
+        (TPUCOLL_PLAN_LRU capacity; TPUCOLL_PLAN_CACHE=0 disables). A
+        cached plan pins the registered buffers + scratch of one
+        repeated collective so its steady-state replay performs zero
+        allocations and zero registrations — `metrics()` exposes
+        plan_hits / plan_misses / plan_evictions / ubuf_creates."""
+        return int(_lib.lib.tc_plan_cache_size(self._handle))
+
+    def plan_cache_clear(self) -> None:
+        """Drop every cached plan (A/B measurement; also happens
+        automatically on close() and on tuning-table install). Safe
+        whenever no collective is concurrently running here."""
+        _lib.lib.tc_plan_cache_clear(self._handle)
 
     # ---- async collective engine (docs/async.md) ----
 
@@ -1114,11 +1275,14 @@ class Context:
             del cb
             raise_pending()
             return array
-        check(_lib.lib.tc_allreduce(self._handle, _ptr(array), _ptr(array),
-                                    array.size, _dtype_code(array),
-                                    ReduceOp.parse(op),
-                                    self._ALGORITHMS[algorithm], tag,
-                                    _timeout_ms(timeout)))
+        # Zero-copy in-place entry: one stable pointer in, reduced in
+        # place — repeated calls on the same array replay a cached plan
+        # (zero allocations / registrations; see plan_cache_size()).
+        check(_lib.lib.tc_allreduce_inplace(self._handle, _ptr(array),
+                                            array.size, _dtype_code(array),
+                                            ReduceOp.parse(op),
+                                            self._ALGORITHMS[algorithm],
+                                            tag, _timeout_ms(timeout)))
         return array
 
     def allreduce_multi(self, arrays, op="sum", algorithm: str = "auto",
@@ -1248,9 +1412,18 @@ class Context:
         return chunk
 
     def allgather(self, array: np.ndarray, tag: int = 0,
-                  timeout: Optional[float] = None) -> np.ndarray:
+                  timeout: Optional[float] = None,
+                  output: Optional[np.ndarray] = None) -> np.ndarray:
+        """Allgather into a (size, *shape) array. Passing a preallocated
+        `output` (same dtype, size * array.size elements) avoids the
+        per-call allocation AND keeps the output pointer stable across
+        steps, which is what lets the native plan cache replay the
+        schedule with zero registrations (docs/design.md)."""
         _check_array(array)
-        out = np.empty((self.size,) + array.shape, dtype=array.dtype)
+        out = _resolve_output(output, array.dtype, self.size * array.size,
+                              "allgather")
+        if output is None:
+            out = out.reshape((self.size,) + array.shape)
         check(_lib.lib.tc_allgather(self._handle, _ptr(array), _ptr(out),
                                     array.size, _dtype_code(array), tag,
                                     _timeout_ms(timeout)))
@@ -1300,7 +1473,8 @@ class Context:
                        recv_counts: Optional[Sequence[int]] = None,
                        op="sum", algorithm: str = "auto", tag: int = 0,
                        timeout: Optional[float] = None,
-                       wire: Optional[str] = None) -> np.ndarray:
+                       wire: Optional[str] = None,
+                       output: Optional[np.ndarray] = None) -> np.ndarray:
         """Reduce then scatter per-rank blocks.
 
         algorithm: "auto" (the installed tuning table when present, else
@@ -1315,16 +1489,19 @@ class Context:
         are quantized, each rank's result block is the float32
         accumulator). On error the returned array's contents are
         undefined (in-place folds; docs/errors.md).
+
+        output: optional preallocated result array (dtype of `array`,
+        recv_counts[rank] elements) — avoids the per-call allocation and
+        keeps the output pointer stable across steps so the native plan
+        cache replays the schedule with zero registrations.
         """
         algorithm = self._resolve_rs_wire(wire, algorithm)
         _check_array(array)
         algo = self._RS_ALGORITHMS[algorithm]
-        if recv_counts is None:
-            assert array.size % self.size == 0, \
-                "array size not divisible by group size"
-            recv_counts = [array.size // self.size] * self.size
-        assert sum(recv_counts) == array.size, "sum(recv_counts) != size"
-        out = np.empty(int(recv_counts[self.rank]), dtype=array.dtype)
+        recv_counts = _resolve_recv_counts(recv_counts, array, self.size)
+        out = _resolve_output(output, array.dtype,
+                              int(recv_counts[self.rank]),
+                              "reduce_scatter")
         if callable(op):
             cb, fnp, raise_pending = _wrap_reduce_fn(op, array.dtype)
             check(_lib.lib.tc_reduce_scatter_fn(
@@ -1341,6 +1518,29 @@ class Context:
                                          ReduceOp.parse(op), algo, tag,
                                          _timeout_ms(timeout)))
         return out
+
+    def reduce_scatter_inplace(self, array: np.ndarray,
+                               recv_counts: Optional[Sequence[int]] = None,
+                               op="sum", algorithm: str = "auto",
+                               tag: int = 0,
+                               timeout: Optional[float] = None,
+                               wire: Optional[str] = None) -> np.ndarray:
+        """Zero-copy reduce_scatter: this rank's reduced block
+        (recv_counts[rank] elements) lands at the FRONT of `array` and
+        the returned value is that view — no output allocation at all.
+        The rest of `array` is unspecified afterwards. Same algorithm /
+        wire / error contracts as :meth:`reduce_scatter`."""
+        algorithm = self._resolve_rs_wire(wire, algorithm)
+        _check_array(array)
+        if callable(op):
+            raise Error("reduce_scatter_inplace does not support callable "
+                        "reductions (use reduce_scatter)")
+        recv_counts = _resolve_recv_counts(recv_counts, array, self.size)
+        check(_lib.lib.tc_reduce_scatter_inplace(
+            self._handle, _ptr(array), _counts_arg(recv_counts),
+            _dtype_code(array), ReduceOp.parse(op),
+            self._RS_ALGORITHMS[algorithm], tag, _timeout_ms(timeout)))
+        return array[:int(recv_counts[self.rank])]
 
     # ---- blocking p2p conveniences ----
 

@@ -167,6 +167,21 @@ def to_prometheus(snapshot: dict,
     lines.append("# TYPE gloo_tpu_trace_events_dropped_total counter")
     lines.append(f"gloo_tpu_trace_events_dropped_total{_fmt_labels(base)} "
                  f"{snapshot.get('trace_events_dropped', 0)}")
+    # Persistent collective plans (docs/design.md): cache traffic plus
+    # the registration counter the plans flatten — a healthy training
+    # loop shows hits climbing with ubuf_creates flat.
+    lines.append("# TYPE gloo_tpu_plan_hits_total counter")
+    lines.append(f"gloo_tpu_plan_hits_total{_fmt_labels(base)} "
+                 f"{snapshot.get('plan_hits', 0)}")
+    lines.append("# TYPE gloo_tpu_plan_misses_total counter")
+    lines.append(f"gloo_tpu_plan_misses_total{_fmt_labels(base)} "
+                 f"{snapshot.get('plan_misses', 0)}")
+    lines.append("# TYPE gloo_tpu_plan_evictions_total counter")
+    lines.append(f"gloo_tpu_plan_evictions_total{_fmt_labels(base)} "
+                 f"{snapshot.get('plan_evictions', 0)}")
+    lines.append("# TYPE gloo_tpu_ubuf_creates_total counter")
+    lines.append(f"gloo_tpu_ubuf_creates_total{_fmt_labels(base)} "
+                 f"{snapshot.get('ubuf_creates', 0)}")
     # Per-action series only; the total is their sum (scrapers derive
     # it), so one metric name never carries two label schemas.
     faults = snapshot.get("faults", {})

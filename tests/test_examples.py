@@ -125,6 +125,35 @@ def test_bench_channel_sweep_smoke():
     assert (1, 1, 1 << 20) in seen and (2, 2, 1 << 20) in seen
 
 
+def test_bench_latency_smoke():
+    """bench.py --latency --quick (2 ranks, TPUCOLL_SHM=0): one JSON
+    line per (op, size, plans on/off) cell plus a summary line. The
+    on-arm must prove the zero-registration steady state
+    (ubuf_creates_steady_delta == 0); speedups are NOT asserted — a
+    shared-core CI host's scheduler noise owns that margin, and the
+    committed LAT_r12.json records the measured run."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--latency", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    cells = [l for l in lines if l.get("bench") == "latency"]
+    summaries = [l for l in lines if l.get("bench") == "latency_summary"]
+    # 4 quick sizes x 2 ops x 2 arms.
+    assert len(cells) == 16, proc.stdout
+    assert len(summaries) == 1, proc.stdout
+    for cell in cells:
+        assert cell["p50_us"] > 0 and cell["p99_us"] >= cell["p50_us"]
+        if cell["plans"]:
+            assert cell["ubuf_creates_steady_delta"] == 0, cell
+            assert cell["plan_hits"] > 0, cell
+    assert summaries[0]["geomean_p50_speedup_le_64KiB"] is not None
+
+
 def test_bench_wire_sweep_smoke():
     """bench.py --wire-sweep --quick (2 ranks): one valid JSON
     measurement line per wire-codec arm — the crossover data the lossy

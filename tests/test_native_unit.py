@@ -196,6 +196,71 @@ def test_ubsan_smoke():
     assert "UBSAN-SMOKE-OK" in result.stdout, result.stdout
 
 
+def test_asan_plan_replay_smoke():
+    """Skip-unless-built ASan smoke for the persistent-plan steady
+    state: replay ONE cached plan 100x (plus a reduce_scatter plan and
+    an invalidation/rebuild cycle), which is exactly the reuse pattern
+    that would expose a use-after-free of the plan's arena or cached
+    UnboundBuffer registrations. Any ASan report aborts the child."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    prog = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            x = np.full(4096, float(rank + 1), dtype=np.float32)
+            plan = ctx.allreduce_plan(x, tag=1)
+            ub = None
+            for i in range(100):
+                x[:] = rank + 1
+                plan()
+                assert x[0] == 3.0, (i, x[0])
+                m = ctx.metrics()["ubuf_creates"]
+                if ub is None:
+                    ub = m
+                else:
+                    assert m == ub, "steady state registered buffers"
+            out = np.empty(2048, dtype=np.float32)
+            rsp = ctx.reduce_scatter_plan(x, tag=2, output=out)
+            for i in range(25):
+                x[:] = rank + 1
+                rsp()
+            # Invalidate mid-life, then rebuild and replay again: the
+            # dropped plan's buffers must drain cleanly.
+            ctx.plan_cache_clear()
+            for i in range(25):
+                x[:] = rank + 1
+                plan()
+                assert x[0] == 3.0
+            ctx.barrier(tag=9)
+            return True
+
+        res = spawn(2, fn, timeout=120)
+        assert res == [True, True], res
+        print("ASAN-PLAN-SMOKE-OK")
+    """)
+    preloads = []
+    for name in ("libasan.so", "libstdc++.so"):
+        p = subprocess.run(["g++", "-print-file-name=" + name],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{name} runtime not found beside g++")
+        preloads.append(p)
+    env = dict(os.environ, TPUCOLL_LIB=lib, TPUCOLL_SKIP_BUILD="1",
+               LD_PRELOAD=" ".join(preloads),
+               ASAN_OPTIONS="detect_leaks=0,abort_on_error=1")
+    result = subprocess.run([sys.executable, "-c", prog],
+                            capture_output=True, text=True, timeout=300,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "ASAN-PLAN-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_asan_smoke():
     """Skip-unless-built AddressSanitizer smoke: when the sanitizer
     flavor exists (`make native SANITIZE=address`), run a small 2-rank

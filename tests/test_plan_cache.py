@@ -1,0 +1,333 @@
+"""Persistent collective plans (csrc/tpucoll/collectives/plan.{h,cc}).
+
+Covers the PR's acceptance surface: the zero-allocation/zero-registration
+steady state (ubuf_creates delta == 0 across a warm loop), every
+invalidation edge (tuning-table install, close, changed pointers, LRU
+capacity, poisoned-by-exception entries, fork), the strict env knobs,
+the in-place / persistent-handle Python paths' result equality against
+the classic API, and same-seed chaos determinism with the cache on vs
+off (plans must not change the wire schedule by a single post).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import _lib
+from tests.harness import spawn
+
+
+def _env(**kv):
+    """Context manager: set TPUCOLL_* vars for the duration (the plan
+    knobs are read at Context construction, so tests toggle them
+    between spawns)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        old = {k: os.environ.get(k) for k in kv}
+        os.environ.update({k: str(v) for k, v in kv.items()})
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return cm()
+
+
+def test_steady_state_zero_registrations():
+    """The headline contract: after the first (miss) call, a repeated
+    allreduce replays its cached plan — plan hits accrue 1:1 and NOT
+    ONE new UnboundBuffer is registered across 100 iterations."""
+    def fn(ctx, rank):
+        x = np.full(4096, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)  # builds the plan (miss)
+        before = ctx.metrics()
+        for _ in range(100):
+            x[:] = rank + 1
+            ctx.allreduce(x, tag=1)
+        after = ctx.metrics()
+        assert x[0] == 3.0
+        assert after["ubuf_creates"] == before["ubuf_creates"], \
+            "steady-state loop registered buffers"
+        assert after["plan_hits"] - before["plan_hits"] == 100
+        assert after["plan_misses"] == before["plan_misses"]
+        assert ctx.plan_cache_size() >= 1
+        return True
+
+    assert spawn(2, fn) == [True, True]
+
+
+def test_steady_state_covers_all_algorithms():
+    """Every allreduce algorithm (and ring reduce_scatter/allgather)
+    reaches the zero-registration steady state — the arena conversion
+    covered hd/rd/bcube/bf16/q8 scratches, not just the ring."""
+    algos = ["ring", "halving_doubling", "hd_blocks", "recursive_doubling",
+             "bcube", "ring_bf16_wire", "ring_q8_wire"]
+
+    def fn(ctx, rank):
+        for i, algo in enumerate(algos):
+            x = np.full(2048, float(rank + 1), dtype=np.float32)
+            ctx.allreduce(x, algorithm=algo, tag=10 + i)
+            ub0 = ctx.metrics()["ubuf_creates"]
+            for _ in range(3):
+                x[:] = rank + 1
+                ctx.allreduce(x, algorithm=algo, tag=10 + i)
+            assert ctx.metrics()["ubuf_creates"] == ub0, algo
+            assert x[0] == pytest.approx(3.0, rel=1e-2), (algo, x[0])
+        # reduce_scatter + allgather with STABLE input and output
+        # buffers (a fresh input copy per call would be a fresh key —
+        # the cache correctly treats a different pointer as a miss).
+        src = np.full(2048, float(rank + 1), dtype=np.float32)
+        x = np.empty_like(src)
+        out = np.empty(1024, dtype=np.float32)
+        gout = np.empty(2 * 2048, dtype=np.float32)
+        x[:] = src
+        ctx.reduce_scatter(x, tag=40, output=out)
+        ctx.allgather(src, tag=41, output=gout)
+        ub0 = ctx.metrics()["ubuf_creates"]
+        for _ in range(3):
+            x[:] = src
+            ctx.reduce_scatter(x, tag=40, output=out)
+            ctx.allgather(src, tag=41, output=gout)
+        assert ctx.metrics()["ubuf_creates"] == ub0
+        return True
+
+    assert spawn(2, fn, timeout=90) == [True, True]
+
+
+def test_plan_cache_disabled_by_env():
+    """TPUCOLL_PLAN_CACHE=0: the transient (pre-plan) path — no cache
+    entries, no hit/miss traffic, results unchanged."""
+    def fn(ctx, rank):
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        for _ in range(5):
+            x[:] = rank + 1
+            ctx.allreduce(x, tag=1)
+        snap = ctx.metrics()
+        assert x[0] == 3.0
+        assert ctx.plan_cache_size() == 0
+        assert snap["plan_hits"] == 0 and snap["plan_misses"] == 0
+        return True
+
+    with _env(TPUCOLL_PLAN_CACHE="0"):
+        assert spawn(2, fn) == [True, True]
+
+
+def test_env_knobs_are_strict():
+    """Malformed plan knobs throw at Context construction (env.h
+    contract), never silently run the wrong arm."""
+    with _env(TPUCOLL_PLAN_CACHE="banana"):
+        with pytest.raises(gloo_tpu.Error, match="TPUCOLL_PLAN_CACHE"):
+            gloo_tpu.Context(0, 1)
+    with _env(TPUCOLL_PLAN_LRU="0"):
+        with pytest.raises(gloo_tpu.Error, match="TPUCOLL_PLAN_LRU"):
+            gloo_tpu.Context(0, 1)
+    with _env(TPUCOLL_PLAN_LRU="8MB"):
+        with pytest.raises(gloo_tpu.Error, match="TPUCOLL_PLAN_LRU"):
+            gloo_tpu.Context(0, 1)
+
+
+def test_invalidation_on_tuning_install():
+    """Installing (or clearing) a tuning table drops every plan: kAuto
+    keys embed the RESOLVED algorithm, and the new table may elect a
+    different one."""
+    from gloo_tpu import tuning
+
+    def fn(ctx, rank):
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        assert ctx.plan_cache_size() >= 1
+        # Clearing the installed table goes through setTuningTable too.
+        tuning.clear_table(ctx)
+        assert ctx.plan_cache_size() == 0
+        # The next call simply misses and rebuilds.
+        x[:] = rank + 1
+        ctx.allreduce(x, tag=1)
+        assert x[0] == 3.0
+        assert ctx.plan_cache_size() >= 1
+        return True
+
+    assert spawn(2, fn) == [True, True]
+
+
+def test_invalidation_on_close_and_explicit_clear():
+    def fn(ctx, rank):
+        x = np.full(512, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        ctx.reduce_scatter(x.copy(), tag=2)
+        assert ctx.plan_cache_size() >= 2
+        ctx.plan_cache_clear()
+        assert ctx.plan_cache_size() == 0
+        x[:] = rank + 1
+        ctx.allreduce(x, tag=1)
+        assert x[0] == 3.0
+        n = ctx.plan_cache_size()
+        ctx.barrier(tag=9)
+        ctx.close()
+        assert n >= 1
+        assert ctx.plan_cache_size() == 0  # close() dropped the plans
+        return True
+
+    assert spawn(2, fn) == [True, True]
+
+
+def test_changed_pointer_or_size_misses():
+    """A different buffer (or size) is a different key — it misses and
+    ages the old entry; the old entry still hits afterwards."""
+    def fn(ctx, rank):
+        a = np.full(1024, 1.0, dtype=np.float32)
+        b = np.full(1024, 1.0, dtype=np.float32)
+        c = np.full(2048, 1.0, dtype=np.float32)
+        ctx.allreduce(a, tag=1)
+        m0 = ctx.metrics()["plan_misses"]
+        ctx.allreduce(b, tag=1)  # same shape, different pointer: miss
+        ctx.allreduce(c, tag=1)  # different size: miss
+        assert ctx.metrics()["plan_misses"] - m0 == 2
+        h0 = ctx.metrics()["plan_hits"]
+        a[:] = 1.0
+        ctx.allreduce(a, tag=1)  # original entry still cached
+        assert ctx.metrics()["plan_hits"] - h0 == 1
+        return True
+
+    assert spawn(2, fn) == [True, True]
+
+
+def test_lru_eviction_at_capacity():
+    def fn(ctx, rank):
+        bufs = [np.full(256, 1.0, dtype=np.float32) for _ in range(4)]
+        for i, x in enumerate(bufs):
+            ctx.allreduce(x, tag=1)
+        snap = ctx.metrics()
+        assert ctx.plan_cache_size() <= 2
+        assert snap["plan_evictions"] >= 2
+        return True
+
+    with _env(TPUCOLL_PLAN_LRU="2"):
+        assert spawn(2, fn) == [True, True]
+
+
+def test_exception_drops_poisoned_plan():
+    """An exception unwinding through a planned collective drops that
+    plan: its buffers may carry in-flight ops only the destructor can
+    drain, so it must never serve another call."""
+    def fn(ctx, rank):
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        n0 = ctx.plan_cache_size()
+        if rank == 0:
+            # Rank 1 never joins tag 77, so this must time out; the
+            # poisoned plan is dropped on unwind.
+            with pytest.raises(gloo_tpu.TimeoutError):
+                ctx.allreduce(x, tag=77, timeout=0.3)
+            assert ctx.plan_cache_size() == n0
+        ctx.barrier(tag=9)
+        # The healthy entry still replays.
+        x[:] = rank + 1
+        ctx.allreduce(x, tag=1)
+        assert x[0] == 3.0
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+def test_fork_gets_fresh_cache():
+    def fn(ctx, rank):
+        x = np.full(512, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        child = ctx.fork()
+        assert child.plan_cache_size() == 0
+        y = np.full(512, float(rank + 1), dtype=np.float32)
+        child.allreduce(y, tag=1)
+        assert y[0] == 3.0
+        assert child.plan_cache_size() >= 1
+        child.close()
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "uint8"])
+def test_inplace_and_plan_paths_match_classic(dtype):
+    """Result equality across the Python surfaces: classic allreduce,
+    the persistent CollectivePlan handle, reduce_scatter with a
+    preallocated output, and the zero-copy reduce_scatter_inplace all
+    produce identical bytes."""
+    def fn(ctx, rank):
+        base = (np.arange(512) % 7 + rank + 1).astype(dtype)
+
+        classic = base.copy()
+        ctx.allreduce(classic, tag=1)
+
+        planned = base.copy()
+        p = ctx.allreduce_plan(planned, tag=2)
+        got = p()
+        assert got is planned
+        np.testing.assert_array_equal(planned, classic)
+        # Replay: refill and run the same plan again.
+        planned[:] = base
+        p()
+        np.testing.assert_array_equal(planned, classic)
+
+        rs_classic = ctx.reduce_scatter(base.copy(), tag=3)
+        out = np.empty(256, dtype=dtype)
+        rs_out = ctx.reduce_scatter(base.copy(), tag=4, output=out)
+        assert rs_out is out
+        np.testing.assert_array_equal(rs_classic, out)
+
+        scratch = base.copy()
+        rs_inplace = ctx.reduce_scatter_inplace(scratch, tag=5)
+        np.testing.assert_array_equal(rs_classic, rs_inplace)
+        assert rs_inplace.base is scratch  # a view, not a copy
+
+        rsp = ctx.reduce_scatter_plan(base.copy(), tag=6)
+        np.testing.assert_array_equal(rsp(), rs_classic)
+
+        agp = ctx.allgather_plan(base, tag=7)
+        ag = agp()
+        np.testing.assert_array_equal(ag, ctx.allgather(base, tag=8))
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+def test_same_seed_chaos_identical_streams_cache_on_vs_off():
+    """Plans must not change the wire schedule by a single post: the
+    same-seed chaos workload produces byte-identical per-rank
+    (seq, op, fingerprint) flightrec streams with the cache on vs off."""
+    from gloo_tpu import fault
+
+    schedule = {"seed": 13, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.5, "seed": 5}]}
+
+    def workload():
+        def fn(ctx, rank):
+            x = np.arange(1024, dtype=np.float32)
+            for i in range(6):
+                x[:] = rank + i
+                ctx.allreduce(x, tag=2 * i)
+                ctx.reduce_scatter(x.copy(), tag=100 + i)
+            ctx.barrier(tag=999)
+            return [(e["seq"], e["op"], e["fp"])
+                    for e in ctx.flightrec()["events"]]
+
+        return spawn(2, fn, timeout=60)
+
+    fault.install(schedule)
+    try:
+        with _env(TPUCOLL_PLAN_CACHE="1"):
+            on = workload()
+        fault.install(schedule)  # reset firing state for the replay
+        with _env(TPUCOLL_PLAN_CACHE="0"):
+            off = workload()
+    finally:
+        fault.clear()
+    assert on == off
