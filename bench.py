@@ -800,6 +800,112 @@ def bench_wire_sweep(quick=False):
         sys.exit(1)
 
 
+def bench_hier_sweep(quick=False):
+    """--hier-sweep: flat (ring) vs hierarchical allreduce per
+    (size x simulated hosts x ranks-per-host) cell, one JSON line per
+    cell (ISSUE 13; docs/topology.md).
+
+    Each cell spawns hosts*rph real processes over a FileStore, with
+    TPUCOLL_HOST_ID grouping them into simulated hosts — so intra-"host"
+    pairs negotiate the shm plane while cross-"host" pairs stay on TCP
+    (the topology mask pins them there), exactly the mixed fabric the
+    hierarchical schedule is built for. Both arms run in the same
+    process set (same mesh, interleaved) and verify the reduced value
+    first; `hier_vs_flat` is the bandwidth ratio (>1 = hier faster)."""
+    import tempfile
+    import textwrap
+
+    if quick:
+        cells = [(2, 2, 1 << 18)]  # 2 hosts x 2 rph, 1 MiB f32
+        iters, warmup = 3, 1
+    else:
+        cells = [(hosts, rph, elements)
+                 for hosts, rph in ((2, 2), (2, 3))
+                 for elements in (1 << 16, 1 << 18, 1 << 20, 1 << 22)]
+        iters, warmup = 8, 2
+
+    body = textwrap.dedent("""
+        import sys, time, json
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1]); size = int(sys.argv[2])
+        rph = int(sys.argv[3]); n = int(sys.argv[4])
+        iters = int(sys.argv[5]); warm = int(sys.argv[6])
+        ctx = gloo_tpu.Context(rank, size, timeout=120)
+        ctx.set_host_id("simhost%d" % (rank // rph))
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[7]),
+                              gloo_tpu.Device())
+        topo = ctx.topology()
+        assert topo["n_hosts"] == size // rph and topo["non_flat"], topo
+        expect = float(sum(range(1, size + 1)))
+        # Correctness first, then INTERLEAVED timed passes: alternating
+        # the arms inside each iteration exposes both to the same host
+        # drift (this box's run-to-run spread dwarfs the arm delta).
+        times = {{"ring": [], "hier": []}}
+        x = np.full(n, float(rank + 1), dtype=np.float32)
+        for algo in ("ring", "hier"):
+            ctx.allreduce(x, algorithm=algo)
+        x = np.full(n, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, algorithm="hier")
+        assert x[0] == expect and x[-1] == expect, x[0]
+        x[:] = 1.0
+        for _ in range(warm):
+            for algo in ("ring", "hier"):
+                ctx.allreduce(x, algorithm=algo)
+        for _ in range(iters):
+            for algo in ("ring", "hier"):
+                t0 = time.perf_counter()
+                ctx.allreduce(x, algorithm=algo)
+                times[algo].append(time.perf_counter() - t0)
+                x[:] = 1.0
+        results = {{a: int(np.median(t) * 1e6)
+                    for a, t in times.items()}}
+        # Mixed-fabric evidence: intra-host pairs negotiated shm.
+        assert ctx.shm_stats()["active_pairs"] == rph - 1
+        if rank == 0:
+            print("P50US", json.dumps(results))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    ok_all = True
+    for hosts, rph, elements in cells:
+        size = hosts * rph
+        store = tempfile.mkdtemp()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", body, str(r), str(size), str(rph),
+             str(elements), str(iters), str(warmup), store],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for r in range(size)]
+        outs = [p.communicate(timeout=600) for p in procs]
+        line = {"metric": "hier_sweep", "hosts": hosts,
+                "ranks_per_host": rph, "ranks": size,
+                "elements": elements, "bytes": elements * 4,
+                "iters": iters, "unit": "GB/s"}
+        if any(p.returncode != 0 for p in procs) or \
+                "P50US" not in outs[0][0]:
+            ok_all = False
+            line["ok"] = False
+            line["error"] = [f"rank {r}: rc={p.returncode} "
+                             f"err={outs[r][1][-200:]!r}"
+                             for r, p in enumerate(procs)]
+        else:
+            p50 = json.loads(
+                outs[0][0].split("P50US", 1)[1].strip().splitlines()[0])
+            line["flat_p50_us"] = p50["ring"]
+            line["hier_p50_us"] = p50["hier"]
+            line["flat_gbps"] = round(
+                elements * 4 / (p50["ring"] * 1e-6) / 1e9, 3)
+            line["hier_gbps"] = round(
+                elements * 4 / (p50["hier"] * 1e-6) / 1e9, 3)
+            line["hier_vs_flat"] = round(p50["ring"] / p50["hier"], 3)
+            line["ok"] = True
+        print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def bench_grad_bucket(n_tensors, lanes=2, pin=False):
     """--grad-bucket N: the training-shaped workload — N heterogeneous
     gradient tensors with log-normally distributed sizes, allreduced
@@ -978,6 +1084,9 @@ def main():
         return
     if "--wire-sweep" in sys.argv[1:]:
         bench_wire_sweep(quick="--quick" in sys.argv[1:])
+        return
+    if "--hier-sweep" in sys.argv[1:]:
+        bench_hier_sweep(quick="--quick" in sys.argv[1:])
         return
     if "--chaos-soak" in sys.argv[1:]:
         i = sys.argv.index("--chaos-soak") + 1

@@ -335,6 +335,10 @@ void tamperScenario() {
   });
 
   // ---- the attacker-with-the-key ----
+  // Play along with topology discovery first: the victim's
+  // connectFullMesh blocks on every rank's host fingerprint before it
+  // publishes its rank blob (group/topology.h).
+  store->set("tc/topo/1", Store::Buf{'e', 'v', 'i', 'l'});
   // Read the victim's rank blob: [u32 n][u32 alen][addr][u64 pairId * n].
   auto blob = store->get("tc/rank/0", std::chrono::milliseconds(15000));
   uint32_t n32 = 0, alen = 0;
@@ -481,6 +485,10 @@ void retryScenario() {
               reinterpret_cast<uint8_t*>(pairIds) + 16);
   auto store = std::make_shared<HashStore>();
   store->set("tc/rank/0", blob);
+  // Forged peer must also answer topology discovery, or the connect
+  // timeout burns inside the fingerprint exchange instead of the
+  // retry loop under test.
+  store->set("tc/topo/0", Store::Buf{'f', 'a', 'k', 'e'});
 
   // PSK handshake: the initiator must READ the listener's challenge, so
   // the slammed connection surfaces as a retryable EOF (a plain hello is

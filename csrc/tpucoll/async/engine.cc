@@ -104,9 +104,17 @@ Engine::Engine(Context* parent, const EngineOptions& opts)
     // even bootstrap-time faults/dumps carry it: the fault table keys
     // its deterministic per-rule state by this domain, and the flight
     // recorder's automatic dumps go to flightrec-rank<r>-lane<k>.json so
-    // they never clobber the parent's dump.
-    lane->ctx->setFaultDomain(k + 1);
+    // they never clobber the parent's dump. Lanes of a split sub-group
+    // compose with the parent's identity: domain offsets from the
+    // parent's (root parents keep the historical lane+1), and the
+    // group dump-tag carries through so a split group's lane dumps
+    // partition with the group (flightrec-rank<r>-g<tag>-lane<k>.json).
+    lane->ctx->setFaultDomain(parent->faultDomain() + k + 1);
     lane->ctx->flightrec().setDumpTag(k);
+    if (!parent->groupTag().empty()) {
+      lane->ctx->flightrec().setGroupTag(parent->groupTag().c_str());
+      lane->ctx->metrics().setGroup(parent->groupTag());
+    }
     // Two bootstrap tags per fork (allgather + allgatherv); stride 2.
     lane->ctx->forkFrom(*parent, opts.tagBase + 2 * k);
     lanes_.push_back(std::move(lane));
@@ -197,6 +205,7 @@ std::shared_ptr<Work> Engine::reduceScatter(
 
 std::shared_ptr<Work> Engine::allgather(const void* input, void* output,
                                         size_t count, DataType dtype,
+                                        int algorithm,
                                         std::chrono::milliseconds timeout) {
   return submit("allgather", [=](Context* ctx) {
     AllgatherOptions opts;
@@ -206,6 +215,7 @@ std::shared_ptr<Work> Engine::allgather(const void* input, void* output,
     opts.output = output;
     opts.count = count;
     opts.dtype = dtype;
+    opts.algorithm = static_cast<HierDispatch>(algorithm);
     tpucoll::allgather(opts);
   });
 }

@@ -141,6 +141,7 @@ class Engine {
                                       std::chrono::milliseconds timeout);
   std::shared_ptr<Work> allgather(const void* input, void* output,
                                   size_t count, DataType dtype,
+                                  int algorithm,
                                   std::chrono::milliseconds timeout);
 
   // Borrowed lane context (metrics / flight recorder introspection).

@@ -139,6 +139,19 @@ std::vector<size_t> countsVec(const size_t* counts, int size) {
   return std::vector<size_t>(counts, counts + size);
 }
 
+int copyOut(const std::string& s, uint8_t** out, size_t* outLen) {
+  *outLen = s.size();
+  *out = static_cast<uint8_t*>(malloc(s.size()));
+  if (s.empty()) {
+    return TC_OK;  // malloc(0) may be NULL; memcpy(NULL, ..., 0) is UB
+  }
+  if (*out == nullptr) {
+    throw std::bad_alloc();
+  }
+  std::memcpy(*out, s.data(), s.size());
+  return TC_OK;
+}
+
 // p2p wait instrumentation: span against the buffer's tracer when the
 // owning context set one (standalone transport contexts have none).
 tpucoll::Tracer::Span maybeSpan(UnboundBuffer* buf, const char* name) {
@@ -438,6 +451,62 @@ int tc_context_fork(void* ctx, void* parent, uint32_t tag) {
   return wrap([&] { asContext(ctx)->forkFrom(*asContext(parent), tag); });
 }
 
+// ---- process-group subsystem (group/): topology + communicator split --
+
+int tc_context_rank(void* ctx) {
+  return wrapVal(-1, [&] { return asContext(ctx)->rank(); });
+}
+
+int tc_context_size(void* ctx) {
+  return wrapVal(-1, [&] { return asContext(ctx)->size(); });
+}
+
+// Host-fingerprint override for topology discovery; must run before
+// tc_context_connect (throws afterwards). Empty/NULL restores the
+// TPUCOLL_HOST_ID / hostname+boot-id default.
+int tc_context_set_host_id(void* ctx, const char* hostId) {
+  return wrap([&] {
+    asContext(ctx)->setHostId(hostId != nullptr ? hostId : "");
+  });
+}
+
+// Discovered topology as JSON ({"rank","host_index","local_rank",
+// "local_size","leader","is_leader","n_hosts","non_flat","hosts":[...]});
+// malloc'd, free with tc_buf_free. Errors when the context never
+// discovered one (not connected).
+int tc_topology_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    auto topo = asContext(ctx)->topology();
+    TC_ENFORCE(topo != nullptr, "tc_topology_json: no topology "
+               "(context not connected)");
+    copyOut(topo->toJson(), out, outLen);
+  });
+}
+
+// Group tag namespace of this communicator ("" for a root context);
+// malloc'd, free with tc_buf_free.
+int tc_context_group_tag(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] { copyOut(asContext(ctx)->groupTag(), out, outLen); });
+}
+
+// Communicator split (MPI_Comm_split semantics): a COLLECTIVE over the
+// parent — every rank calls concurrently with the same `tag`
+// (concurrent splits need distinct tags). On success *out is the new
+// context handle (owned by the caller; tc_context_free it), or NULL
+// when color < 0 (this rank opted out). See Context::split.
+int tc_split(void* ctx, int color, int key, uint32_t tag, void** out) {
+  return wrap([&] {
+    *out = asContext(ctx)->split(color, key, tag).release();
+  });
+}
+
+// split(color = host index, key = rank): the intra-host communicator.
+int tc_split_by_host(void* ctx, uint32_t tag, void** out) {
+  return wrap([&] {
+    *out = asContext(ctx)->splitByHost(tag).release();
+  });
+}
+
 int tc_context_close(void* ctx) {
   return wrap([&] { asContext(ctx)->close(); });
 }
@@ -563,20 +632,6 @@ void tc_flightrec_install_signal_handler() {
 
 // ---- collective autotuning plane (tuning/) ----
 
-namespace {
-
-int copyOut(const std::string& s, uint8_t** out, size_t* outLen) {
-  *outLen = s.size();
-  *out = static_cast<uint8_t*>(malloc(s.size()));
-  if (*out == nullptr && !s.empty()) {
-    throw std::bad_alloc();
-  }
-  std::memcpy(*out, s.data(), s.size());
-  return TC_OK;
-}
-
-}  // namespace
-
 // Run the tuner sweep (a COLLECTIVE — every rank must call concurrently
 // with identical arguments), elect + publish + install rank 0's table,
 // and return the installed table's JSON (malloc'd; free with
@@ -651,16 +706,20 @@ int tc_fault_report(uint8_t** out, size_t* outLen) {
 
 // ---- collectives ----
 
-int tc_barrier(void* ctx, uint32_t tag, int64_t timeoutMs) {
+// `algorithm` on barrier/broadcast/allgather: 0 = the flat schedule,
+// 1 = hierarchical (HierDispatch::kHier; degrades to flat on a flat
+// topology — see group/hier.h).
+int tc_barrier(void* ctx, int algorithm, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::BarrierOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
+    opts.algorithm = static_cast<tpucoll::HierDispatch>(algorithm);
     tpucoll::barrier(opts);
   });
 }
 
 int tc_broadcast(void* ctx, void* buffer, size_t count, int dtype, int root,
-                 uint32_t tag, int64_t timeoutMs) {
+                 int algorithm, uint32_t tag, int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::BroadcastOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -668,6 +727,7 @@ int tc_broadcast(void* ctx, void* buffer, size_t count, int dtype, int root,
     opts.count = count;
     opts.dtype = static_cast<DataType>(dtype);
     opts.root = root;
+    opts.algorithm = static_cast<tpucoll::HierDispatch>(algorithm);
     tpucoll::broadcast(opts);
   });
 }
@@ -883,7 +943,8 @@ int tc_scatter(void* ctx, const void* input, void* output, size_t count,
 }
 
 int tc_allgather(void* ctx, const void* input, void* output, size_t count,
-                 int dtype, uint32_t tag, int64_t timeoutMs) {
+                 int dtype, int algorithm, uint32_t tag,
+                 int64_t timeoutMs) {
   return wrap([&] {
     tpucoll::AllgatherOptions opts;
     fillCommon(opts, asContext(ctx), tag, timeoutMs);
@@ -891,6 +952,7 @@ int tc_allgather(void* ctx, const void* input, void* output, size_t count,
     opts.output = output;
     opts.count = count;
     opts.dtype = static_cast<DataType>(dtype);
+    opts.algorithm = static_cast<tpucoll::HierDispatch>(algorithm);
     tpucoll::allgather(opts);
   });
 }
@@ -1102,11 +1164,12 @@ void* tc_async_reduce_scatter(void* eng, const void* input, void* output,
 }
 
 void* tc_async_allgather(void* eng, const void* input, void* output,
-                         size_t count, int dtype, int64_t timeoutMs) {
+                         size_t count, int dtype, int algorithm,
+                         int64_t timeoutMs) {
   return submitWork([&] {
     return asEngine(eng)->allgather(input, output, count,
                                     static_cast<DataType>(dtype),
-                                    ms(timeoutMs));
+                                    algorithm, ms(timeoutMs));
   });
 }
 

@@ -37,7 +37,19 @@ struct CollectiveOptions {
   std::chrono::milliseconds timeout{0};
 };
 
-struct BarrierOptions : CollectiveOptions {};
+// Hierarchical arm for the schedules without an algorithm-family of
+// their own (barrier/broadcast/allgather): kAuto is the flat schedule;
+// kHier composes the intra-host shm plane with a leader-only inter-host
+// exchange over the context's split sub-groups (group/hier.h) and
+// degrades to the flat schedule on a flat topology.
+enum class HierDispatch : uint8_t {
+  kAuto = 0,
+  kHier = 1,
+};
+
+struct BarrierOptions : CollectiveOptions {
+  HierDispatch algorithm = HierDispatch::kAuto;
+};
 void barrier(BarrierOptions& opts);
 
 struct BroadcastOptions : CollectiveOptions {
@@ -45,6 +57,7 @@ struct BroadcastOptions : CollectiveOptions {
   size_t count = 0;
   DataType dtype = DataType::kFloat32;
   int root = 0;
+  HierDispatch algorithm = HierDispatch::kAuto;
 };
 void broadcast(BroadcastOptions& opts);
 
@@ -93,6 +106,14 @@ enum class AllreduceAlgorithm : uint8_t {
   // bandwidth tier (payloads past TPUCOLL_ALLREDUCE_HD_MAX) rides
   // kRingQ8Wire, the latency tiers stay lossless.
   kAutoLossyWire = 9,
+  // Topology-aware hierarchical composition (group/hier.h): intra-host
+  // allreduce over the shm plane, leader-only exchange across hosts,
+  // intra-host broadcast. Electable by kAuto from a tuned table when
+  // the topology is non-flat (TPUCOLL_HIER_AUTO gates the election);
+  // explicit requests on a flat topology dispatch as kAuto. Reduction
+  // ORDER differs from the flat schedules (docs/topology.md precision
+  // contract); results stay identical across ranks.
+  kHier = 10,
 };
 
 struct AllreduceOptions : CollectiveOptions {
@@ -171,6 +192,7 @@ struct AllgatherOptions : CollectiveOptions {
   void* output = nullptr;       // count * size elements
   size_t count = 0;
   DataType dtype = DataType::kFloat32;
+  HierDispatch algorithm = HierDispatch::kAuto;
 };
 void allgather(AllgatherOptions& opts);
 
@@ -218,6 +240,12 @@ enum class ReduceScatterAlgorithm : uint8_t {
   // only the wire hops are quantized. Precision contract:
   // collectives_q8.cc.
   kRingQ8Wire = 4,
+  // Hierarchical composition (group/hier.h): intra-host allreduce of
+  // the staged vector, leader-only reduce_scatter of host-contiguous
+  // blocks, intra-host broadcast + local slice. Electable by kAuto on a
+  // non-flat topology from a tuned table; flat topologies dispatch as
+  // kAuto.
+  kHier = 5,
 };
 
 struct ReduceScatterOptions : CollectiveOptions {

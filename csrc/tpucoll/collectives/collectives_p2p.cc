@@ -6,6 +6,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/group/hier.h"
 
 namespace tpucoll {
 
@@ -36,6 +37,11 @@ void barrier(BarrierOptions& opts) {
   const int rank = ctx->rank();
   const int size = ctx->size();
   if (size == 1) {
+    return;
+  }
+  if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx)) {
+    frOp.setAlgorithm("hier");
+    group::hierBarrier(ctx, opts.tag, timeout);
     return;
   }
   Slot slot = Slot::build(SlotPrefix::kBarrier, opts.tag);
@@ -78,6 +84,12 @@ void broadcast(BroadcastOptions& opts) {
   const size_t elsize = elementSize(opts.dtype);
   const size_t nbytes = opts.count * elsize;
   if (size == 1) {
+    return;
+  }
+  if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx)) {
+    frOp.setAlgorithm("hier");
+    group::hierBroadcast(ctx, opts.buffer, opts.count, opts.dtype,
+                         opts.root, opts.tag, timeout);
     return;
   }
   Slot slot = Slot::build(SlotPrefix::kBroadcast, opts.tag);

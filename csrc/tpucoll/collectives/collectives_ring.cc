@@ -19,6 +19,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/group/hier.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -289,6 +290,14 @@ void allgather(AllgatherOptions& opts) {
                    Slot::build(SlotPrefix::kAllgather, opts.tag).value(),
                    -1, opts.count * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype));
+  if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx) &&
+      ctx->size() > 1 && opts.count > 0) {
+    frOp.setAlgorithm("hier");
+    group::hierAllgather(ctx, opts.input, opts.output, opts.count,
+                         opts.dtype, opts.tag,
+                         detail::effectiveTimeout(opts));
+    return;
+  }
   AllgathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -408,6 +417,12 @@ void allreduce(AllreduceOptions& opts) {
   if (size > 1 && opts.count > 0) {
     Slot slot = Slot::build(SlotPrefix::kAllreduce, opts.tag);
     AllreduceAlgorithm algo = opts.algorithm;
+    // An explicit hierarchical request on a flat topology (single host,
+    // or one rank per host) has no second plane to exploit; dispatch it
+    // like kAuto so kHier is always safe to hardcode.
+    if (algo == AllreduceAlgorithm::kHier && !group::hierEligible(ctx)) {
+      algo = AllreduceAlgorithm::kAuto;
+    }
     if (algo == AllreduceAlgorithm::kAutoLossyWire) {
       // The caller's explicit opt-in to lossy wire precision. Only the
       // float32 sum shape has wire codecs; anything else dispatches as
@@ -456,6 +471,17 @@ void allreduce(AllreduceOptions& opts) {
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1, tuning::allreduceAlgorithmName(algo));
     frOp.setAlgorithm(tuning::allreduceAlgorithmName(algo));
+    if (algo == AllreduceAlgorithm::kHier) {
+      // Hierarchical composition: every phase is an ordinary collective
+      // on a split sub-context, each with its own plan cache — the
+      // parent-level plan machinery below is deliberately skipped.
+      group::hierAllreduce(ctx, work, opts.count, opts.dtype, opts.op,
+                           opts.customFn, opts.tag, timeout);
+      for (size_t i = 1; i < opts.outputs.size(); i++) {
+        std::memcpy(opts.outputs[i], work, nbytes);
+      }
+      return;
+    }
     // Persistent plan, keyed by the RESOLVED algorithm (a tuning-table
     // install clears the cache, so a stale kAuto choice cannot replay).
     // Custom reductions stay transient: the fn pointer's identity is
@@ -776,6 +802,11 @@ void reduceScatter(ReduceScatterOptions& opts) {
   Slot slot = Slot::build(SlotPrefix::kReduceScatter, opts.tag);
   const bool fuseOk = opts.customFn == nullptr;
   ReduceScatterAlgorithm algo = opts.algorithm;
+  // Flat topology: a hierarchical request has no second plane; run it
+  // through the normal auto dispatch instead.
+  if (algo == ReduceScatterAlgorithm::kHier && !group::hierEligible(ctx)) {
+    algo = ReduceScatterAlgorithm::kAuto;
+  }
   if (algo == ReduceScatterAlgorithm::kAuto) {
     // Measured tuning table first (keyed by total payload bytes), then
     // the crossovers measured on loopback P=4/8 (BASELINE.md round 3):
@@ -800,6 +831,14 @@ void reduceScatter(ReduceScatterOptions& opts) {
     }
   }
   frOp.setAlgorithm(tuning::reduceScatterAlgorithmName(algo));
+  if (algo == ReduceScatterAlgorithm::kHier) {
+    // Phases are collectives on split sub-contexts with their own plan
+    // caches; the parent plan machinery below is skipped.
+    group::hierReduceScatter(ctx, opts.input, opts.output,
+                             opts.recvCounts, opts.dtype, opts.op,
+                             opts.customFn, opts.tag, timeout);
+    return;
+  }
 
   PlanKey key;
   key.opcode = static_cast<uint8_t>(PlanOp::kReduceScatter);

@@ -77,13 +77,20 @@ std::atomic<bool> g_handlerInstalled{false};
 // the ORIGINAL default disposition, not loop back into this handler.
 std::atomic<bool> g_inHandler{false};
 
-// Automatic dump filename: the plain per-rank name, or the lane-tagged
-// variant for lane recorders (async/engine.h) so same-rank recorders in
-// one process never overwrite each other. snprintf only — shared with
-// the signal path.
+// Automatic dump filename: the plain per-rank name, the lane-tagged
+// variant for lane recorders (async/engine.h), and/or the group-tagged
+// variant for split sub-communicators — so same-rank recorders in one
+// process never overwrite each other and post-mortem tooling can
+// partition by group. snprintf only — shared with the signal path.
 void autoDumpPath(char* path, size_t n, const char* dir, int rank,
-                  int tag) {
-  if (tag >= 0) {
+                  int tag, const char* group) {
+  const bool grouped = group != nullptr && group[0] != '\0';
+  if (grouped && tag >= 0) {
+    snprintf(path, n, "%s/flightrec-rank%d-g%s-lane%d.json", dir, rank,
+             group, tag);
+  } else if (grouped) {
+    snprintf(path, n, "%s/flightrec-rank%d-g%s.json", dir, rank, group);
+  } else if (tag >= 0) {
     snprintf(path, n, "%s/flightrec-rank%d-lane%d.json", dir, rank, tag);
   } else {
     snprintf(path, n, "%s/flightrec-rank%d.json", dir, rank);
@@ -98,9 +105,9 @@ void fatalSignalHandler(int sig) {
       if (rec == nullptr) {
         continue;
       }
-      char path[600];
+      char path[704];
       autoDumpPath(path, sizeof(path), g_signalDir, rec->rank(),
-                   rec->dumpTag());
+                   rec->dumpTag(), rec->groupTagFile());
       rec->dumpToFile(path, "signal", -1);
     }
   }
@@ -229,16 +236,20 @@ namespace {
 template <typename Sink>
 void dumpImpl(Sink& sink, int rank, int size, uint64_t mask,
               const FlightRecorder::Entry* entries, uint64_t nextSeq,
-              const char* reason, int blamedPeer) {
-  char buf[640];
+              const char* reason, int blamedPeer, const char* group) {
+  char buf[720];
   const uint64_t cap = mask + 1;
   const uint64_t first = nextSeq > cap ? nextSeq - cap : 0;
+  // `group` needs no JSON escaping: Context group tags are built from
+  // integers and [sc./] separators only.
   int n = snprintf(buf, sizeof(buf),
                    "{\"version\":1,\"kind\":\"tpucoll_flightrec\","
-                   "\"rank\":%d,\"size\":%d,\"reason\":\"%s\","
+                   "\"rank\":%d,\"size\":%d,\"group\":\"%s\","
+                   "\"reason\":\"%s\","
                    "\"blamed_peer\":%d,\"now_us\":%lld,\"next_seq\":%llu,"
                    "\"capacity\":%llu,\"dropped\":%llu,\"events\":[",
-                   rank, size, reason, blamedPeer,
+                   rank, size, group != nullptr ? group : "", reason,
+                   blamedPeer,
                    static_cast<long long>(FlightRecorder::nowUs()),
                    static_cast<unsigned long long>(nextSeq),
                    static_cast<unsigned long long>(cap),
@@ -300,11 +311,25 @@ void dumpImpl(Sink& sink, int rank, int size, uint64_t mask,
 
 }  // namespace
 
+void FlightRecorder::setGroupTag(const char* tag) {
+  if (tag == nullptr) {
+    tag = "";
+  }
+  snprintf(groupTag_, sizeof(groupTag_), "%s", tag);
+  snprintf(groupTagFile_, sizeof(groupTagFile_), "%s", tag);
+  for (char* p = groupTagFile_; *p != '\0'; p++) {
+    if (*p == '/') {
+      *p = '.';  // nested-split separator is not filename-safe
+    }
+  }
+}
+
 std::string FlightRecorder::toJson(const char* reason,
                                    int blamedPeer) const {
   StringSink sink;
   dumpImpl(sink, rank_, size_, mask_, entries_.get(),
-           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer);
+           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer,
+           groupTag_);
   return std::move(sink.out);
 }
 
@@ -312,7 +337,8 @@ bool FlightRecorder::dumpToFd(int fd, const char* reason,
                               int blamedPeer) const {
   FdSink sink{fd};
   dumpImpl(sink, rank_, size_, mask_, entries_.get(),
-           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer);
+           nextSeq_.load(std::memory_order_relaxed), reason, blamedPeer,
+           groupTag_);
   return sink.ok;
 }
 
@@ -344,9 +370,9 @@ bool FlightRecorder::autoDump(const char* reason, int blamedPeer) {
   }
   lastReason_.store(reason, std::memory_order_relaxed);
   ::mkdir(dir, 0777);  // best-effort; EEXIST is the common case
-  char path[600];
+  char path[704];
   autoDumpPath(path, sizeof(path), dir, rank_,
-               dumpTag_.load(std::memory_order_relaxed));
+               dumpTag_.load(std::memory_order_relaxed), groupTagFile_);
   return dumpToFile(path, reason, blamedPeer);
 }
 

@@ -195,6 +195,21 @@ class FlightRecorder {
     return dumpTag_.load(std::memory_order_relaxed);
   }
 
+  // Group dump-tag (split sub-communicators, Context::applyGroupTag):
+  // when set, automatic dumps go to flightrec-rank<r>-g<tag>.json
+  // (combined with a lane tag: ...-g<tag>-lane<k>.json) and every dump
+  // document carries "group":"<tag>", so post-mortem tooling can
+  // partition disjoint sub-groups BEFORE the desync comparison — two
+  // groups legitimately run different schedules and must never be
+  // fingerprint-compared against each other (utils/flightrec.py
+  // merge_by_tag). Set once before traffic; '/' (nested splits) is
+  // mapped to '.' in the filename form. Truncated at 63 bytes.
+  void setGroupTag(const char* tag);
+  const char* groupTag() const { return groupTag_; }
+  const char* groupTagFile() const { return groupTagFile_; }
+
+  static constexpr size_t kGroupTagBytes = 64;
+
   static int64_t nowUs();
 
  private:
@@ -211,6 +226,11 @@ class FlightRecorder {
   std::atomic<int64_t> lastAutoDumpUs_{0};
   std::atomic<const char*> lastReason_{nullptr};
   std::atomic<int> dumpTag_{-1};
+  // Written once at group creation, before any traffic; read by dump
+  // paths (including the fatal-signal handler — plain char arrays, no
+  // allocation). groupTagFile_ is the filename-safe form ('/' -> '.').
+  char groupTag_[kGroupTagBytes] = {0};
+  char groupTagFile_[kGroupTagBytes] = {0};
   int slotIdx_{-1};  // index into the process-global registry, -1 if full
 };
 

@@ -155,8 +155,9 @@ void histToJson(std::ostringstream& out, const Metrics::Histogram& h) {
 std::string Metrics::toJson(int rank, bool drain) {
   const int64_t nowUs = Tracer::nowUs();
   std::ostringstream out;
-  out << "{\"rank\":" << rank << ",\"size\":" << size_
-      << ",\"enabled\":" << (enabled() ? "true" : "false")
+  out << "{\"rank\":" << rank << ",\"size\":" << size_ << ",\"group\":";
+  appendJsonString(out, group());
+  out << ",\"enabled\":" << (enabled() ? "true" : "false")
       << ",\"watchdog_ms\":" << watchdogUs() / 1000 << ",\"now_us\":" << nowUs
       << ",\"retries\":" << retries_.load(std::memory_order_relaxed)
       << ",\"stash_pauses\":"

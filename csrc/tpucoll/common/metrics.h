@@ -113,6 +113,20 @@ class Metrics {
     enabled_.store(on, std::memory_order_relaxed);
   }
 
+  // Group tag of the owning communicator ("" = root context; split
+  // sub-communicators carry their Context::groupTag). Emitted as the
+  // snapshot's "group" field so per-group scrapes are distinguishable
+  // (the Python exposition turns it into a group= Prometheus label).
+  // Set once before traffic (Context::applyGroupTag), read by dumps.
+  void setGroup(const std::string& group) {
+    std::lock_guard<std::mutex> guard(groupMu_);
+    group_ = group;
+  }
+  std::string group() const {
+    std::lock_guard<std::mutex> guard(groupMu_);
+    return group_;
+  }
+
   // ---- collective / p2p op accounting ----
   void recordCall(MetricOp op, uint64_t bytes) {
     if (!enabled()) {
@@ -349,6 +363,8 @@ class Metrics {
   std::atomic<uint64_t> loopEvents_[kMaxLoopStats] = {};
   std::atomic<int64_t> loopLastProgressUs_[kMaxLoopStats] = {};
 
+  mutable std::mutex groupMu_;
+  std::string group_;
   mutable std::mutex stallMu_;
   bool haveStall_{false};
   Stall lastStall_;

@@ -34,6 +34,11 @@ Context::Context(int rank, int size)
 }
 
 Context::~Context() {
+  // Hier sub-communicators are whole Contexts of their own; drop them
+  // first so their collectives cannot outlive the parent state hier.cc
+  // reaches through (topology, tracer).
+  hierLeaders_.reset();
+  hierLocal_.reset();
   // The transport context holds raw pointers into tracer_/metrics_/
   // flightrec_ (setInstrumentation), and its destructor quiesces the
   // loop threads that may still be running a failure callback through
@@ -48,6 +53,99 @@ Context::~Context() {
     planCache_->clear();
   }
   tctx_.reset();
+}
+
+void Context::setHostId(std::string hostId) {
+  TC_ENFORCE(tctx_ == nullptr,
+             "setHostId: must be called before the context connects");
+  hostId_ = std::move(hostId);
+}
+
+std::shared_ptr<const Topology> Context::topology() const {
+  std::lock_guard<std::mutex> guard(topoMu_);
+  return topology_;
+}
+
+std::string Context::scopedStoreKey(const std::string& suffix) const {
+  if (groupTag_.empty()) {
+    return "tpucoll/" + suffix;
+  }
+  return "tpucoll/" + groupTag_ + "/" + suffix;
+}
+
+void Context::installTopology(std::shared_ptr<const Topology> topo) {
+  {
+    std::lock_guard<std::mutex> guard(topoMu_);
+    topology_ = topo;
+  }
+  if (tctx_ != nullptr && topo != nullptr) {
+    // Shm-reachability mask: the payload plane only negotiates between
+    // ranks the topology co-hosts. With real machines this is what the
+    // per-connection same-IP check would conclude anyway; with a
+    // TPUCOLL_HOST_ID override it is what SIMULATES the multi-host
+    // wiring (cross-"host" pairs stay on TCP).
+    std::vector<char> allowed(size_, 0);
+    for (int r = 0; r < size_; r++) {
+      allowed[r] = topo->sameHost(rank_, r) ? 1 : 0;
+    }
+    tctx_->setShmPeers(std::move(allowed));
+  }
+}
+
+void Context::discoverTopology() {
+  TC_ENFORCE(store_ != nullptr, "discoverTopology: no store");
+  const std::string fp = hostFingerprint(hostId_);
+  const std::string base = "tc/topo/";
+  store_->set(base + std::to_string(rank_),
+              Store::Buf(fp.begin(), fp.end()));
+  std::vector<std::string> fps(size_);
+  fps[rank_] = fp;
+  std::vector<std::string> keys;
+  std::vector<int> order;
+  for (int j = 0; j < size_; j++) {
+    if (j != rank_) {
+      keys.push_back(base + std::to_string(j));
+      order.push_back(j);
+    }
+  }
+  auto blobs = store_->multiGet(keys, timeout_);
+  for (size_t i = 0; i < order.size(); i++) {
+    fps[order[i]].assign(blobs[i].begin(), blobs[i].end());
+  }
+  installTopology(
+      std::make_shared<const Topology>(buildTopology(rank_, fps)));
+}
+
+namespace {
+
+// Deterministic fault-plane domain for a split group: any collision-
+// resistant pure function of the tag works (chaos determinism needs
+// same-tag => same-domain across runs and ranks, not global uniqueness).
+// Root stays 0; async lanes use parentDomain + lane + 1 (engine.cc), so
+// split domains start far above the root's lane range.
+int domainFromGroupTag(const std::string& tag) {
+  uint64_t h = 0xcbf29ce484222325ULL;
+  for (char c : tag) {
+    h = (h ^ static_cast<uint8_t>(c)) * 0x100000001b3ULL;
+  }
+  return static_cast<int>(h % 1000000000ULL) + 1000;
+}
+
+}  // namespace
+
+void Context::applyGroupTag(const std::string& tag) {
+  groupTag_ = tag;
+  if (tag.empty()) {
+    return;
+  }
+  setFaultDomain(domainFromGroupTag(tag));
+  flightrec_.setGroupTag(tag.c_str());
+  metrics_.setGroup(tag);
+}
+
+uint64_t Context::nextSplitGeneration(uint32_t tag) {
+  std::lock_guard<std::mutex> guard(splitGenMu_);
+  return ++splitGens_[tag];
 }
 
 void Context::connectFullMesh(std::shared_ptr<Store> store,
@@ -69,6 +167,9 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->setFaultDomain(faultDomain_);
   applyTransportHints();
+  // Fingerprint exchange BEFORE the mesh connects: the resulting
+  // co-host mask decides which pairs may negotiate the shm plane.
+  discoverTopology();
   tctx_->connectFullMesh(*store_, timeout_);
 }
 
@@ -78,6 +179,7 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   TC_ENFORCE_EQ(size_, parent.size(), "fork must keep the parent size");
   TC_ENFORCE(parent.tctx_ != nullptr, "parent context not connected");
   device_ = parent.device_;
+  hostId_ = parent.hostId_;
   fault::maybeLoadEnvFile();
   FlightRecorder::maybeInstallFromEnv();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
@@ -86,6 +188,9 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->setFaultDomain(faultDomain_);
   applyTransportHints();
+  // Same ranks, same machines: the fork inherits the parent's topology
+  // (and so its shm-reachability mask) without store traffic.
+  installTopology(parent.topology());
   auto blob = tctx_->prepareFullMesh();
 
   // Exchange blob lengths, then the blobs themselves, over the parent.
@@ -197,8 +302,25 @@ void Context::close() {
   if (planCache_ != nullptr) {
     planCache_->clear();
   }
+  // Parent mesh before the hier sub-communicators: a hierGroups() init
+  // blocked in a parent collective holds hierMu_, and killing the
+  // parent mesh is what unwinds it so the lock below can be taken.
   if (tctx_) {
     tctx_->close();
+  }
+  // Then the hier sub-communicators, so a hierarchical phase blocked on
+  // a sub-mesh unwinds too (exactly like async lanes on shutdown).
+  // hierMu_ is never held across the split bootstrap (hierGroups), so
+  // this cannot block on a builder stuck in a store wait; hierClosed_
+  // makes a build that FINISHES after this close tear its fresh
+  // sub-meshes down immediately.
+  std::lock_guard<std::mutex> guard(hierMu_);
+  hierClosed_ = true;
+  if (hierLeaders_ != nullptr) {
+    hierLeaders_->close();
+  }
+  if (hierLocal_ != nullptr) {
+    hierLocal_->close();
   }
 }
 

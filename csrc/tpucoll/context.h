@@ -6,13 +6,17 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
+#include <map>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <vector>
 
 #include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/metrics.h"
 #include "tpucoll/common/tracer.h"
+#include "tpucoll/group/topology.h"
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/transport/context.h"
 #include "tpucoll/transport/device.h"
@@ -48,6 +52,64 @@ class Context {
   // the root domain; async-engine lanes carry lane + 1.
   void setFaultDomain(int domain) { faultDomain_ = domain; }
   int faultDomain() const { return faultDomain_; }
+
+  // Host-fingerprint override for topology discovery (group/topology.h):
+  // set BEFORE connect. Empty — the default — falls back to
+  // TPUCOLL_HOST_ID, then "<hostname>/<boot-id>". Two ranks whose
+  // fingerprints match are treated as co-hosted: the shm payload plane
+  // negotiates between them, split_by_host() groups them, and the
+  // hierarchical collectives put them on the same intra-host plane —
+  // which is exactly how tests simulate an H-host topology on one
+  // machine (give each process a distinct fake id).
+  void setHostId(std::string hostId);
+  const std::string& hostId() const { return hostId_; }
+
+  // Host topology discovered at bootstrap (connectFullMesh exchanges
+  // fingerprints through the store; forked contexts inherit the
+  // parent's; split contexts carry the member subset). Null only for a
+  // context that has not connected yet.
+  std::shared_ptr<const Topology> topology() const;
+
+  // Group tag namespace of this communicator: "" for a bootstrap (root)
+  // context, "s<tag>.<gen>.c<color>" path segments for split
+  // sub-communicators (nested splits append). Scopes every rendezvous
+  // Store key written after bootstrap (tuning elections, split color
+  // exchanges), the flight-recorder dump filenames, the metrics
+  // snapshot's "group" field, and the fault-plane domain — so two
+  // concurrent splits over one store can never collide and a subgroup's
+  // post-mortem artifacts never clobber the parent's.
+  const std::string& groupTag() const { return groupTag_; }
+  // "tpucoll/<groupTag>/<suffix>" (or "tpucoll/<suffix>" at the root):
+  // the ONE spelling of post-bootstrap store keys.
+  std::string scopedStoreKey(const std::string& suffix) const;
+
+  // ---- process-group split (group/split.cc) ----
+  // MPI_Comm_split semantics: a COLLECTIVE over this context — every
+  // rank must call concurrently with the same `tag`. Ranks passing the
+  // same non-negative `color` form a subset communicator with fresh
+  // contiguous ranks ordered by (key, parent rank); a negative color
+  // opts out and yields nullptr. The child is a full Context: own
+  // members-only mesh (pairs between members only), own tag/slot
+  // namespace, own plan cache / metrics / flight recorder / fault
+  // domain, own store namespace (nested splits and tuning elections
+  // work), topology = the member subset.
+  //
+  // Exchange plumbing: the color exchange and the member mesh bootstrap
+  // ride the rendezvous store when this context has one (keys scoped by
+  // groupTag + `tag` + a per-tag generation, so sequential same-tag
+  // splits and concurrent distinct-tag splits never collide); forked
+  // store-less contexts exchange over this context's own collectives
+  // instead, consuming parent tags [tag, tag+2].
+  std::unique_ptr<Context> split(int color, int key, uint32_t tag = 0);
+  // Convenience: color = host index from the discovered topology — the
+  // intra-host communicator native hierarchical collectives ride.
+  std::unique_ptr<Context> splitByHost(uint32_t tag = 0);
+
+  // Lazily-created hierarchical sub-communicators (first kHier
+  // collective, or explicit): `local` spans this host's ranks, `leaders`
+  // one leader per host (null on non-leaders). Creation is a collective
+  // over this context (reserved split tags); single-flight per context.
+  void hierGroups(Context** local, Context** leaders);
 
   // Bootstrap the full mesh over a rendezvous store. Call once.
   void connectFullMesh(std::shared_ptr<Store> store,
@@ -149,6 +211,23 @@ class Context {
   void close();
 
  private:
+  // Exchange host fingerprints through the store and install the
+  // resulting Topology + shm-reachability mask on the transport (must
+  // run after tctx_ exists, before it connects).
+  void discoverTopology();
+  // Install `topo` and hand the co-host mask to tctx_ (when present).
+  void installTopology(std::shared_ptr<const Topology> topo);
+  // Stamp this context's group identity across the post-mortem planes:
+  // fault domain (deterministic hash of the tag), flight-recorder dump
+  // tag, metrics "group" field. Called before the mesh exists.
+  void applyGroupTag(const std::string& tag);
+  // Per-(user tag) split generation: same-tag splits are issued in the
+  // same order on every rank (split is a collective), so the counter
+  // agrees without store traffic; distinct tags stay independent so
+  // CONCURRENT splits (which must use distinct tags) cannot race the
+  // counter into rank-divergent generations.
+  uint64_t nextSplitGeneration(uint32_t tag);
+
   // TPUCOLL_TUNING_FILE hook: load + install a serialized table at
   // connect/fork (before the transport mesh is created, so its
   // transport hints configure THIS mesh), letting a deployment pin its
@@ -165,10 +244,27 @@ class Context {
   const int size_;
   std::chrono::milliseconds timeout_{kDefaultTimeout};
   int faultDomain_{0};
+  std::string hostId_;
+  std::string groupTag_;
   std::atomic<uint32_t> slotCounter_{0};
   std::atomic<uint64_t> tuneGen_{0};
   mutable std::mutex tuningMu_;
   std::shared_ptr<const tuning::TuningTable> tuningTable_;
+  mutable std::mutex topoMu_;
+  std::shared_ptr<const Topology> topology_;
+  std::mutex splitGenMu_;
+  std::map<uint32_t, uint64_t> splitGens_;
+  // Hierarchical sub-communicators (hierGroups); created single-flight
+  // WITHOUT holding hierMu_ across the (blocking) split bootstrap —
+  // hierBuilding_ + hierCv_ serialize builders, hierClosed_ records a
+  // close() that raced the build. Torn down by close()/~Context.
+  std::mutex hierMu_;
+  std::condition_variable hierCv_;
+  bool hierInit_{false};
+  bool hierBuilding_{false};
+  bool hierClosed_{false};
+  std::unique_ptr<Context> hierLocal_;
+  std::unique_ptr<Context> hierLeaders_;
   std::shared_ptr<Store> store_;
   std::shared_ptr<transport::Device> device_;
   std::unique_ptr<transport::Context> tctx_;

@@ -1,0 +1,434 @@
+#include "tpucoll/group/hier.h"
+
+#include <algorithm>
+#include <cstring>
+#include <sstream>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/context.h"
+#include "tpucoll/group/topology.h"
+
+namespace tpucoll {
+namespace group {
+
+namespace {
+
+// Subgroup-rank -> global-rank map for failure messages: a pair error
+// inside a phase names the SUBGROUP peer, which is meaningless without
+// this mapping.
+std::string describeMembers(const std::vector<int>& members) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < members.size(); i++) {
+    os << (i == 0 ? "" : ",") << i << "->" << members[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+// Run `phase` against sub-context `sub`; a typed failure is rethrown —
+// type preserved so the C ABI keeps its error-code mapping — naming the
+// collective, the phase, the subgroup tag, and the subgroup->global
+// rank map, so "pair to rank 1 failed" becomes attributable.
+template <typename Fn>
+void runPhase(const char* collective, const char* phaseName, Context* sub,
+              const std::vector<int>& members, Fn&& phase) {
+  try {
+    phase();
+  } catch (const TimeoutException& e) {
+    TC_THROW(TimeoutException, "hier ", collective, " [", phaseName,
+             "] on subgroup '", sub->groupTag(), "' (subgroup ranks ",
+             describeMembers(members), "): ", e.what());
+  } catch (const AbortedException& e) {
+    TC_THROW(AbortedException, "hier ", collective, " [", phaseName,
+             "] on subgroup '", sub->groupTag(), "' (subgroup ranks ",
+             describeMembers(members), "): ", e.what());
+  } catch (const IoException& e) {
+    TC_THROW(IoException, "hier ", collective, " [", phaseName,
+             "] on subgroup '", sub->groupTag(), "' (subgroup ranks ",
+             describeMembers(members), "): ", e.what());
+  }
+}
+
+struct HierPlanes {
+  Context* local;            // never null (size >= 1)
+  Context* leaders;          // null on non-leaders
+  std::vector<int> localMembers;    // local rank -> global rank
+  std::vector<int> leaderMembers;   // leader rank -> global rank
+  std::shared_ptr<const Topology> topo;
+};
+
+HierPlanes planes(Context* ctx) {
+  HierPlanes p;
+  ctx->hierGroups(&p.local, &p.leaders);
+  p.topo = ctx->topology();
+  TC_ENFORCE(p.local != nullptr && p.topo != nullptr,
+             "hier: no topology/sub-groups");
+  p.localMembers = p.topo->hosts[p.topo->hostIndex];
+  for (const auto& h : p.topo->hosts) {
+    p.leaderMembers.push_back(h.front());
+  }
+  return p;
+}
+
+// Global ranks in "grouped" order — concatenated by host, members
+// ascending within each host. The leader-plane *v collectives exchange
+// host-contiguous blocks, so payloads are staged in this order and
+// permuted back at the end when global rank order differs.
+std::vector<int> groupedRanks(const Topology& topo) {
+  std::vector<int> out;
+  for (const auto& h : topo.hosts) {
+    out.insert(out.end(), h.begin(), h.end());
+  }
+  return out;
+}
+
+}  // namespace
+
+bool hierEligible(Context* ctx) {
+  auto topo = ctx->topology();
+  return topo != nullptr && topo->nonFlat();
+}
+
+void hierAllreduce(Context* ctx, char* work, size_t count, DataType dtype,
+                   ReduceOp op, ReduceFn customFn, uint32_t tag,
+                   std::chrono::milliseconds timeout) {
+  HierPlanes p = planes(ctx);
+  const bool multiLocal = p.topo->localSize > 1;
+  if (multiLocal) {
+    // Reduce-to-leader (in place on the leader: reduce supports
+    // input == output on root) — half the intra-host bytes of a local
+    // allreduce, and only the leader needs the host sum before the
+    // inter-host exchange. Internally the bandwidth tier IS a ring
+    // reduce-scatter + chunk gather over the shm plane.
+    runPhase("allreduce", "intra-host reduce", p.local, p.localMembers,
+             [&] {
+      ReduceOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.input = work;
+      o.output = p.topo->isLeader ? work : nullptr;
+      o.count = count;
+      o.dtype = dtype;
+      o.op = op;
+      o.customFn = customFn;
+      o.root = 0;
+      reduce(o);
+    });
+  }
+  if (p.leaders != nullptr) {
+    runPhase("allreduce", "inter-host exchange", p.leaders,
+             p.leaderMembers, [&] {
+      AllreduceOptions o;
+      o.context = p.leaders;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.inputs = {work};
+      o.outputs = {work};
+      o.count = count;
+      o.dtype = dtype;
+      o.op = op;
+      o.customFn = customFn;
+      allreduce(o);
+    });
+  }
+  if (multiLocal) {
+    runPhase("allreduce", "intra-host broadcast", p.local, p.localMembers,
+             [&] {
+      BroadcastOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = work;
+      o.count = count;
+      o.dtype = dtype;
+      o.root = 0;  // the host leader is always local rank 0
+      broadcast(o);
+    });
+  }
+}
+
+void hierReduceScatter(Context* ctx, const void* input, void* output,
+                       const std::vector<size_t>& recvCounts,
+                       DataType dtype, ReduceOp op, ReduceFn customFn,
+                       uint32_t tag, std::chrono::milliseconds timeout) {
+  HierPlanes p = planes(ctx);
+  const Topology& topo = *p.topo;
+  const size_t elsize = elementSize(dtype);
+  size_t totalCount = 0;
+  for (size_t c : recvCounts) {
+    totalCount += c;
+  }
+  const std::vector<int> grouped = groupedRanks(topo);
+
+  // Stage the input in host-grouped block order so the leader plane's
+  // reduce_scatter hands each leader one CONTIGUOUS host block.
+  std::vector<size_t> blockOff(recvCounts.size(), 0);
+  {
+    size_t off = 0;
+    for (size_t r = 0; r < recvCounts.size(); r++) {
+      blockOff[r] = off;
+      off += recvCounts[r] * elsize;
+    }
+  }
+  auto stage = ctx->acquireScratch(totalCount * elsize);
+  {
+    size_t off = 0;
+    for (int r : grouped) {
+      const size_t len = recvCounts[r] * elsize;
+      std::memcpy(stage.data() + off,
+                  static_cast<const char*>(input) + blockOff[r], len);
+      off += len;
+    }
+  }
+
+  if (topo.localSize > 1) {
+    // Reduce-to-leader (in place on the leader): only leaders feed the
+    // inter-host reduce_scatter, so non-leaders need no host sum.
+    runPhase("reduce_scatter", "intra-host reduce", p.local,
+             p.localMembers, [&] {
+      ReduceOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.input = stage.data();
+      o.output = topo.isLeader ? stage.data() : nullptr;
+      o.count = totalCount;
+      o.dtype = dtype;
+      o.op = op;
+      o.customFn = customFn;
+      o.root = 0;
+      reduce(o);
+    });
+  }
+
+  // My host's block of the grouped layout.
+  size_t hostCount = 0;
+  for (int r : topo.hosts[topo.hostIndex]) {
+    hostCount += recvCounts[r];
+  }
+  auto hostBlock = ctx->acquireScratch(hostCount * elsize);
+  if (p.leaders != nullptr) {
+    std::vector<size_t> perHost(topo.nHosts(), 0);
+    for (int h = 0; h < topo.nHosts(); h++) {
+      for (int r : topo.hosts[h]) {
+        perHost[h] += recvCounts[r];
+      }
+    }
+    runPhase("reduce_scatter", "inter-host exchange", p.leaders,
+             p.leaderMembers, [&] {
+      ReduceScatterOptions o;
+      o.context = p.leaders;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.input = stage.data();
+      o.output = hostBlock.data();
+      o.recvCounts = perHost;
+      o.dtype = dtype;
+      o.op = op;
+      o.customFn = customFn;
+      reduceScatter(o);
+    });
+  }
+  if (topo.localSize > 1) {
+    runPhase("reduce_scatter", "intra-host broadcast", p.local,
+             p.localMembers, [&] {
+      BroadcastOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = hostBlock.data();
+      o.count = hostCount;
+      o.dtype = dtype;
+      o.root = 0;
+      broadcast(o);
+    });
+  }
+  // Slice my block out of the host block (members ascending, so my
+  // offset is the counts of lower-ranked co-hosted members).
+  size_t myOff = 0;
+  for (int r : topo.hosts[topo.hostIndex]) {
+    if (r == topo.rank) {
+      break;
+    }
+    myOff += recvCounts[r] * elsize;
+  }
+  std::memcpy(output, hostBlock.data() + myOff,
+              recvCounts[topo.rank] * elsize);
+}
+
+void hierAllgather(Context* ctx, const void* input, void* output,
+                   size_t count, DataType dtype, uint32_t tag,
+                   std::chrono::milliseconds timeout) {
+  HierPlanes p = planes(ctx);
+  const Topology& topo = *p.topo;
+  const size_t elsize = elementSize(dtype);
+  const size_t rankBytes = count * elsize;
+  const int size = static_cast<int>(topo.hostOf.size());
+  const std::vector<int> grouped = groupedRanks(topo);
+  if (input == nullptr) {
+    // In-place form: the caller staged its block at rank offset.
+    input = static_cast<const char*>(output) +
+            size_t(topo.rank) * rankBytes;
+  }
+
+  auto localBuf = ctx->acquireScratch(topo.localSize * rankBytes);
+  if (topo.localSize > 1) {
+    runPhase("allgather", "intra-host allgather", p.local, p.localMembers,
+             [&] {
+      AllgatherOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.input = input;
+      o.output = localBuf.data();
+      o.count = count;
+      o.dtype = dtype;
+      allgather(o);
+    });
+  } else {
+    std::memcpy(localBuf.data(), input, rankBytes);
+  }
+
+  auto groupedBuf = ctx->acquireScratch(size_t(size) * rankBytes);
+  if (p.leaders != nullptr) {
+    std::vector<size_t> perHost(topo.nHosts());
+    for (int h = 0; h < topo.nHosts(); h++) {
+      perHost[h] = topo.hosts[h].size() * count;
+    }
+    runPhase("allgather", "inter-host exchange", p.leaders,
+             p.leaderMembers, [&] {
+      AllgathervOptions o;
+      o.context = p.leaders;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.input = localBuf.data();
+      o.output = groupedBuf.data();
+      o.counts = perHost;
+      o.dtype = dtype;
+      allgatherv(o);
+    });
+  }
+  if (topo.localSize > 1) {
+    runPhase("allgather", "intra-host broadcast", p.local, p.localMembers,
+             [&] {
+      BroadcastOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = groupedBuf.data();
+      o.count = size_t(size) * count;
+      o.dtype = dtype;
+      o.root = 0;
+      broadcast(o);
+    });
+  }
+  // Grouped order -> global rank order.
+  for (int g = 0; g < size; g++) {
+    std::memcpy(static_cast<char*>(output) + size_t(grouped[g]) * rankBytes,
+                groupedBuf.data() + size_t(g) * rankBytes, rankBytes);
+  }
+}
+
+void hierBroadcast(Context* ctx, void* buffer, size_t count,
+                   DataType dtype, int root, uint32_t tag,
+                   std::chrono::milliseconds timeout) {
+  HierPlanes p = planes(ctx);
+  const Topology& topo = *p.topo;
+  const int rootHost = topo.hostOf[root];
+  const bool onRootHost = topo.hostIndex == rootHost;
+  const bool rootIsLeader = topo.hosts[rootHost].front() == root;
+
+  // Phase 1 (root's host, when the root is not its leader): local
+  // broadcast FROM the root, delivering to the leader and co-hosted
+  // ranks in one shm pass.
+  if (onRootHost && !rootIsLeader && topo.localSize > 1) {
+    runPhase("broadcast", "intra-host (root)", p.local, p.localMembers,
+             [&] {
+      const auto& mine = topo.hosts[topo.hostIndex];
+      const int rootLocal = static_cast<int>(
+          std::find(mine.begin(), mine.end(), root) - mine.begin());
+      BroadcastOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = buffer;
+      o.count = count;
+      o.dtype = dtype;
+      o.root = rootLocal;
+      broadcast(o);
+    });
+  }
+  // Phase 2: leaders relay across hosts (root's host's leader is the
+  // leader-plane root).
+  if (p.leaders != nullptr) {
+    runPhase("broadcast", "inter-host relay", p.leaders, p.leaderMembers,
+             [&] {
+      BroadcastOptions o;
+      o.context = p.leaders;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = buffer;
+      o.count = count;
+      o.dtype = dtype;
+      o.root = rootHost;  // host h's leader is leader-plane rank h
+      broadcast(o);
+    });
+  }
+  // Phase 3: every host whose members did not already receive in phase
+  // 1 broadcasts from its leader.
+  if (!(onRootHost && !rootIsLeader) && topo.localSize > 1) {
+    runPhase("broadcast", "intra-host (leader)", p.local, p.localMembers,
+             [&] {
+      BroadcastOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      o.buffer = buffer;
+      o.count = count;
+      o.dtype = dtype;
+      o.root = 0;
+      broadcast(o);
+    });
+  }
+}
+
+void hierBarrier(Context* ctx, uint32_t tag,
+                 std::chrono::milliseconds timeout) {
+  HierPlanes p = planes(ctx);
+  // arrive (local) -> synchronize (leaders) -> release (local): the
+  // second local barrier is what keeps a non-leader from exiting before
+  // the inter-host round completed.
+  if (p.topo->localSize > 1) {
+    runPhase("barrier", "intra-host arrive", p.local, p.localMembers, [&] {
+      BarrierOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      barrier(o);
+    });
+  }
+  if (p.leaders != nullptr) {
+    runPhase("barrier", "inter-host", p.leaders, p.leaderMembers, [&] {
+      BarrierOptions o;
+      o.context = p.leaders;
+      o.tag = tag;
+      o.timeout = timeout;
+      barrier(o);
+    });
+  }
+  if (p.topo->localSize > 1) {
+    runPhase("barrier", "intra-host release", p.local, p.localMembers,
+             [&] {
+      BarrierOptions o;
+      o.context = p.local;
+      o.tag = tag;
+      o.timeout = timeout;
+      barrier(o);
+    });
+  }
+}
+
+}  // namespace group
+}  // namespace tpucoll

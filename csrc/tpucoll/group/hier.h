@@ -1,0 +1,76 @@
+// Topology-aware hierarchical collectives (AllreduceAlgorithm::kHier and
+// friends): compose the intra-host fast plane (shm rings between
+// co-hosted ranks) with the inter-host slow plane (TCP between elected
+// leaders) instead of running one flat schedule over the mixed fabric.
+//
+// Shape (the HiCCL decomposition; docs/topology.md):
+//   allreduce       intra-host reduce to the leader (in place on the
+//                   leader; the bandwidth tier is a ring RS + chunk
+//                   gather over shm) -> leader-only allreduce across
+//                   hosts -> intra-host broadcast from the leader
+//   reduce_scatter  stage host-grouped -> intra-host reduce to the
+//                   leader -> leader reduce_scatter with per-host block
+//                   counts -> intra-host broadcast of the host block ->
+//                   local slice copy
+//   allgather       intra-host allgather -> leader allgatherv of host
+//                   blocks -> intra-host broadcast -> global-rank
+//                   permutation
+//   broadcast       root's host: local broadcast from root; leaders
+//                   relay across hosts; other hosts: local broadcast
+//   barrier         local barrier -> leader barrier -> local barrier
+//
+// With L ranks/host and H hosts the slow plane moves 2(H-1)/H of the
+// payload once per HOST (leaders only) instead of once per rank —
+// independent of L, which is the entire point.
+//
+// Every phase is an ordinary collective on a split sub-communicator
+// (Context::hierGroups), so the plan cache, tuning tables, metrics,
+// flight recorder, and fault plane all apply per sub-group for free.
+//
+// Precision/ordering contract: the reduction ORDER differs from the flat
+// schedules (local partials combine before any cross-host term), so
+// floating-point results are deterministic and identical across ranks,
+// but not bitwise-equal to the flat ring's result. Same class of
+// contract as the algorithm choice itself (docs/topology.md).
+#pragma once
+
+#include <chrono>
+#include <cstddef>
+#include <vector>
+
+#include "tpucoll/math.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+
+class Context;
+
+namespace group {
+
+// True when the topology has both planes to exploit (>1 host AND >1
+// rank on some host). The dispatchers fall back to the flat schedules
+// otherwise, so kHier is always safe to request.
+bool hierEligible(Context* ctx);
+
+void hierAllreduce(Context* ctx, char* work, size_t count, DataType dtype,
+                   ReduceOp op, ReduceFn customFn, uint32_t tag,
+                   std::chrono::milliseconds timeout);
+
+void hierReduceScatter(Context* ctx, const void* input, void* output,
+                       const std::vector<size_t>& recvCounts,
+                       DataType dtype, ReduceOp op, ReduceFn customFn,
+                       uint32_t tag, std::chrono::milliseconds timeout);
+
+void hierAllgather(Context* ctx, const void* input, void* output,
+                   size_t count, DataType dtype, uint32_t tag,
+                   std::chrono::milliseconds timeout);
+
+void hierBroadcast(Context* ctx, void* buffer, size_t count,
+                   DataType dtype, int root, uint32_t tag,
+                   std::chrono::milliseconds timeout);
+
+void hierBarrier(Context* ctx, uint32_t tag,
+                 std::chrono::milliseconds timeout);
+
+}  // namespace group
+}  // namespace tpucoll

@@ -1,0 +1,271 @@
+// Context::split — native communicator split (MPI_Comm_split semantics)
+// plus the lazily-built hierarchical sub-communicators every kHier
+// collective rides. Lives in group/ rather than context.cc because the
+// exchange plumbing (store color exchange, parent-collective blob
+// allgather) pulls in the collective layer, which context.cc must not.
+#include <algorithm>
+#include <cstring>
+#include <utility>
+
+#include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/context.h"
+#include "tpucoll/fault/fault.h"
+#include "tpucoll/group/topology.h"
+
+namespace tpucoll {
+
+namespace {
+
+// Reserved parent tags for the hierGroups() internal splits. High in the
+// 32-bit tag space next to the forkFrom default (0xFFFFFF0); each split
+// consumes [tag, tag+2] on store-less parents.
+constexpr uint32_t kHierLocalSplitTag = 0xFFFFE00u;
+constexpr uint32_t kHierLeaderSplitTag = 0xFFFFE40u;
+
+struct ColorKey {
+  int64_t color;
+  int64_t key;
+};
+
+std::string encodeColorKey(int color, int key) {
+  return std::to_string(color) + ":" + std::to_string(key);
+}
+
+ColorKey decodeColorKey(const std::string& s, int fromRank) {
+  const size_t sep = s.find(':');
+  TC_ENFORCE(sep != std::string::npos, "split: malformed color record \"",
+             s, "\" from rank ", fromRank);
+  ColorKey ck;
+  ck.color = std::strtoll(s.c_str(), nullptr, 10);
+  ck.key = std::strtoll(s.c_str() + sep + 1, nullptr, 10);
+  return ck;
+}
+
+}  // namespace
+
+std::unique_ptr<Context> Context::split(int color, int key, uint32_t tag) {
+  TC_ENFORCE(tctx_ != nullptr, "split: context not connected");
+  const uint64_t gen = nextSplitGeneration(tag);
+
+  // ---- 1. (color, key) exchange across the PARENT group -------------
+  // Store-backed when a rendezvous store exists (keys scoped by the
+  // context's group tag + the user tag + the per-tag generation: two
+  // concurrent splits over one store use distinct tags and cannot
+  // collide; sequential same-tag splits advance the generation instead
+  // of re-reading stale keys). Store-less (forked) contexts exchange
+  // over the parent's own collectives.
+  std::vector<ColorKey> all(size_);
+  const std::string scope = "split/" + std::to_string(tag) + "/" +
+                            std::to_string(gen) + "/";
+  if (store_ != nullptr) {
+    const std::string mine = encodeColorKey(color, key);
+    store_->set(scopedStoreKey(scope + "c" + std::to_string(rank_)),
+                Store::Buf(mine.begin(), mine.end()));
+    std::vector<std::string> keys;
+    std::vector<int> order;
+    for (int j = 0; j < size_; j++) {
+      if (j == rank_) {
+        all[j] = decodeColorKey(mine, j);
+      } else {
+        keys.push_back(scopedStoreKey(scope + "c" + std::to_string(j)));
+        order.push_back(j);
+      }
+    }
+    auto vals = store_->multiGet(keys, timeout_);
+    for (size_t i = 0; i < order.size(); i++) {
+      all[order[i]] = decodeColorKey(
+          std::string(vals[i].begin(), vals[i].end()), order[i]);
+    }
+  } else {
+    std::vector<int64_t> flat(size_t(size_) * 2);
+    int64_t mine[2] = {color, key};
+    AllgatherOptions opts;
+    opts.context = this;
+    opts.tag = tag;
+    opts.input = mine;
+    opts.output = flat.data();
+    opts.count = 2;
+    opts.dtype = DataType::kInt64;
+    allgather(opts);
+    for (int j = 0; j < size_; j++) {
+      all[j] = ColorKey{flat[2 * j], flat[2 * j + 1]};
+    }
+  }
+
+  // ---- 2. membership: my color's ranks, ordered by (key, rank) ------
+  std::vector<int> members;
+  for (int j = 0; j < size_; j++) {
+    if (color >= 0 && all[j].color == color) {
+      members.push_back(j);
+    }
+  }
+  std::stable_sort(members.begin(), members.end(), [&](int a, int b) {
+    return std::make_pair(all[a].key, int64_t(a)) <
+           std::make_pair(all[b].key, int64_t(b));
+  });
+  const bool member = color >= 0;
+  int newRank = -1;
+  if (member) {
+    newRank = static_cast<int>(
+        std::find(members.begin(), members.end(), rank_) -
+        members.begin());
+  }
+
+  // ---- 3. build + bootstrap the subset communicator -----------------
+  // Non-members still participate in the store-less blob exchange below
+  // (it runs over the full parent), then return null.
+  const std::string childTag =
+      (groupTag_.empty() ? std::string() : groupTag_ + "/") + "s" +
+      std::to_string(tag) + "." + std::to_string(gen) + ".c" +
+      std::to_string(color);
+  std::unique_ptr<Context> child;
+  if (member) {
+    child = std::make_unique<Context>(newRank,
+                                      static_cast<int>(members.size()));
+    child->setTimeout(timeout_);
+    child->hostId_ = hostId_;
+    child->applyGroupTag(childTag);
+  }
+
+  if (store_ != nullptr) {
+    if (!member) {
+      return nullptr;
+    }
+    // The subset's mesh bootstraps through the normal store path in its
+    // own scoped namespace — topology discovery (and so the shm mask)
+    // re-runs among the members, which is exactly the subset result.
+    auto prefix = std::make_shared<PrefixStore>(
+        store_, scopedStoreKey(scope + "g" + std::to_string(color)));
+    child->connectFullMesh(std::move(prefix), device_);
+    return child;
+  }
+
+  // Store-less parent: blob exchange over the parent's collectives, the
+  // forkFrom pattern widened to subsets (non-members contribute zero
+  // bytes and discard the result).
+  std::vector<uint8_t> blob;
+  if (member) {
+    child->device_ = device_;
+    fault::maybeLoadEnvFile();
+    FlightRecorder::maybeInstallFromEnv();
+    child->maybeLoadTuningFile();
+    child->tctx_ = std::make_unique<transport::Context>(
+        device_, newRank, static_cast<int>(members.size()));
+    child->tctx_->setInstrumentation(&child->tracer_, &child->metrics_,
+                                     &child->flightrec_);
+    child->tctx_->setFaultDomain(child->faultDomain_);
+    child->applyTransportHints();
+    auto parentTopo = topology();
+    if (parentTopo != nullptr) {
+      child->installTopology(std::make_shared<const Topology>(
+          subsetTopology(*parentTopo, members, newRank)));
+    }
+    blob = child->tctx_->prepareFullMesh();
+  }
+  std::vector<uint64_t> lens(size_);
+  uint64_t myLen = blob.size();
+  {
+    AllgatherOptions opts;
+    opts.context = this;
+    opts.tag = tag + 1;
+    opts.input = &myLen;
+    opts.output = lens.data();
+    opts.count = 1;
+    opts.dtype = DataType::kUint64;
+    allgather(opts);
+  }
+  std::vector<size_t> counts(lens.begin(), lens.end());
+  size_t total = 0;
+  for (size_t c : counts) {
+    total += c;
+  }
+  std::vector<uint8_t> allBlobs(total);
+  {
+    AllgathervOptions opts;
+    opts.context = this;
+    opts.tag = tag + 2;
+    opts.input = blob.data();
+    opts.output = allBlobs.data();
+    opts.counts = counts;
+    opts.dtype = DataType::kUint8;
+    allgatherv(opts);
+  }
+  if (!member) {
+    return nullptr;
+  }
+  std::vector<size_t> offsets(size_, 0);
+  {
+    size_t off = 0;
+    for (int j = 0; j < size_; j++) {
+      offsets[j] = off;
+      off += counts[j];
+    }
+  }
+  std::vector<std::vector<uint8_t>> memberBlobs(members.size());
+  for (size_t m = 0; m < members.size(); m++) {
+    const int parentRank = members[m];
+    TC_ENFORCE(counts[parentRank] > 0, "split: member rank ", parentRank,
+               " published no bootstrap blob");
+    memberBlobs[m].assign(
+        allBlobs.begin() + offsets[parentRank],
+        allBlobs.begin() + offsets[parentRank] + counts[parentRank]);
+  }
+  child->tctx_->connectWithBlobs(memberBlobs, timeout_);
+  return child;
+}
+
+std::unique_ptr<Context> Context::splitByHost(uint32_t tag) {
+  auto topo = topology();
+  TC_ENFORCE(topo != nullptr,
+             "split_by_host: no topology (context not connected?)");
+  return split(topo->hostIndex, rank_, tag);
+}
+
+void Context::hierGroups(Context** local, Context** leaders) {
+  std::unique_lock<std::mutex> lk(hierMu_);
+  // Single-flight WITHOUT holding hierMu_ across the split bootstrap:
+  // the exchange can block for the full store/collective timeout, and
+  // close() must be able to take hierMu_ meanwhile (a holder blocked in
+  // a rendezvous-store wait is NOT unwound by closing the parent mesh).
+  hierCv_.wait(lk, [&] { return !hierBuilding_; });
+  if (!hierInit_) {
+    hierBuilding_ = true;
+    lk.unlock();
+    std::unique_ptr<Context> localCtx;
+    std::unique_ptr<Context> leaderCtx;
+    try {
+      auto topo = topology();
+      TC_ENFORCE(topo != nullptr, "hierGroups: no topology");
+      // Key = global rank, so the host leader (lowest member rank)
+      // always lands on local rank 0 — the root every hier phase
+      // broadcasts from.
+      localCtx = split(topo->hostIndex, rank_, kHierLocalSplitTag);
+      leaderCtx =
+          split(topo->isLeader ? 0 : -1, rank_, kHierLeaderSplitTag);
+    } catch (...) {
+      lk.lock();
+      hierBuilding_ = false;
+      hierCv_.notify_all();
+      throw;
+    }
+    lk.lock();
+    hierLocal_ = std::move(localCtx);
+    hierLeaders_ = std::move(leaderCtx);
+    hierInit_ = true;
+    hierBuilding_ = false;
+    if (hierClosed_) {
+      // close() ran while we were bootstrapping: honor it now so the
+      // fresh sub-meshes don't outlive the closed parent.
+      if (hierLeaders_ != nullptr) {
+        hierLeaders_->close();
+      }
+      hierLocal_->close();
+    }
+    hierCv_.notify_all();
+  }
+  *local = hierLocal_.get();
+  *leaders = hierLeaders_.get();
+}
+
+}  // namespace tpucoll

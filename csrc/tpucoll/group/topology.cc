@@ -1,0 +1,127 @@
+#include "tpucoll/group/topology.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <map>
+#include <sstream>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+
+int Topology::maxLocalSize() const {
+  size_t m = 1;
+  for (const auto& h : hosts) {
+    m = std::max(m, h.size());
+  }
+  return static_cast<int>(m);
+}
+
+std::string Topology::toJson() const {
+  std::ostringstream out;
+  out << "{\"rank\":" << rank << ",\"host_index\":" << hostIndex
+      << ",\"local_rank\":" << localRank << ",\"local_size\":" << localSize
+      << ",\"leader\":" << leader
+      << ",\"is_leader\":" << (isLeader ? "true" : "false")
+      << ",\"n_hosts\":" << nHosts()
+      << ",\"non_flat\":" << (nonFlat() ? "true" : "false") << ",\"hosts\":[";
+  for (size_t h = 0; h < hosts.size(); h++) {
+    out << (h == 0 ? "" : ",") << "{\"fingerprint\":";
+    appendJsonString(out, fingerprints[h]);
+    out << ",\"ranks\":[";
+    for (size_t i = 0; i < hosts[h].size(); i++) {
+      out << (i == 0 ? "" : ",") << hosts[h][i];
+    }
+    out << "]}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+Topology buildTopology(int rank,
+                       const std::vector<std::string>& fingerprints) {
+  TC_ENFORCE(!fingerprints.empty(), "topology: no fingerprints");
+  TC_ENFORCE(rank >= 0 && rank < static_cast<int>(fingerprints.size()),
+             "topology: rank out of range");
+  Topology topo;
+  topo.fingerprints.clear();
+  topo.rank = rank;
+  topo.hostOf.assign(fingerprints.size(), -1);
+  // Hosts numbered by first-appearing (= lowest) member rank, so the
+  // numbering is deterministic across ranks and host 0 holds rank 0.
+  std::map<std::string, int> index;
+  for (size_t r = 0; r < fingerprints.size(); r++) {
+    auto it = index.find(fingerprints[r]);
+    int h;
+    if (it == index.end()) {
+      h = static_cast<int>(topo.hosts.size());
+      index.emplace(fingerprints[r], h);
+      topo.hosts.emplace_back();
+      topo.fingerprints.push_back(fingerprints[r]);
+    } else {
+      h = it->second;
+    }
+    topo.hostOf[r] = h;
+    topo.hosts[h].push_back(static_cast<int>(r));
+  }
+  topo.hostIndex = topo.hostOf[rank];
+  const auto& mine = topo.hosts[topo.hostIndex];
+  topo.localSize = static_cast<int>(mine.size());
+  topo.localRank = static_cast<int>(
+      std::find(mine.begin(), mine.end(), rank) - mine.begin());
+  topo.leader = mine.front();
+  topo.isLeader = topo.leader == rank;
+  return topo;
+}
+
+Topology subsetTopology(const Topology& parent,
+                        const std::vector<int>& members, int newRank) {
+  std::vector<std::string> fps;
+  fps.reserve(members.size());
+  for (int m : members) {
+    TC_ENFORCE(m >= 0 && m < static_cast<int>(parent.hostOf.size()),
+               "subsetTopology: member rank ", m, " out of range");
+    fps.push_back(parent.fingerprints[parent.hostOf[m]]);
+  }
+  return buildTopology(newRank, fps);
+}
+
+std::string hostFingerprint(const std::string& override_) {
+  if (!override_.empty()) {
+    return override_;
+  }
+  const char* env = envString("TPUCOLL_HOST_ID");
+  if (env != nullptr) {
+    return env;
+  }
+  char host[256] = {0};
+  if (gethostname(host, sizeof(host) - 1) != 0) {
+    snprintf(host, sizeof(host), "unknown-host");
+  }
+  std::string fp(host);
+  // The boot id disambiguates cloned hostnames; best-effort (containers
+  // may hide /proc) — the hostname alone still works for common setups.
+  FILE* f = fopen("/proc/sys/kernel/random/boot_id", "r");
+  if (f != nullptr) {
+    char boot[64] = {0};
+    if (fgets(boot, sizeof(boot), f) != nullptr) {
+      // strip trailing newline
+      for (char* p = boot; *p != '\0'; p++) {
+        if (*p == '\n') {
+          *p = '\0';
+          break;
+        }
+      }
+      fp += "/";
+      fp += boot;
+    }
+    fclose(f);
+  }
+  return fp;
+}
+
+}  // namespace tpucoll

@@ -1,0 +1,66 @@
+// Host topology of a process group: which ranks share a machine.
+//
+// Discovered once per context at bootstrap — every rank publishes a host
+// fingerprint (hostname + boot id, overridable for simulation and custom
+// placement labels) through the rendezvous store, and all ranks derive
+// the same ranks-per-host map, local rank/size, and per-host leader
+// (lowest global rank). The result drives two things:
+//  - the shm payload plane only negotiates between ranks whose
+//    fingerprints match (transport::Context::setShmPeers), which is also
+//    what lets tests simulate an H-host topology on one machine by
+//    overriding the fingerprint per process (TPUCOLL_HOST_ID);
+//  - the hierarchical collectives (group/hier.h) compose an intra-host
+//    fast plane (shm) with an inter-host slow plane (TCP) among elected
+//    leaders only — the HiCCL-style decomposition.
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+
+struct Topology {
+  // Host fingerprints in host-index order; hosts are numbered by their
+  // lowest member rank, so host 0 always contains global rank 0.
+  std::vector<std::string> fingerprints;
+  // hosts[h] = member global ranks of host h, ascending.
+  std::vector<std::vector<int>> hosts;
+  // hostOf[r] = host index of global rank r.
+  std::vector<int> hostOf;
+
+  int rank{0};        // this rank
+  int hostIndex{0};   // this rank's host
+  int localRank{0};   // index within hosts[hostIndex]
+  int localSize{1};
+  int leader{0};      // global rank of this host's leader (lowest member)
+  bool isLeader{true};
+
+  int nHosts() const { return static_cast<int>(hosts.size()); }
+  int maxLocalSize() const;
+  // True when the hierarchy has both planes to exploit: more than one
+  // host AND more than one rank on some host. Flat topologies dispatch
+  // hierarchical requests back to the flat schedules.
+  bool nonFlat() const { return nHosts() > 1 && maxLocalSize() > 1; }
+  // True when rank a and rank b share a host (shm-reachability modulo
+  // TPUCOLL_SHM and segment-creation success).
+  bool sameHost(int a, int b) const { return hostOf[a] == hostOf[b]; }
+
+  std::string toJson() const;
+};
+
+// Build from per-rank fingerprints (index = global rank).
+Topology buildTopology(int rank,
+                       const std::vector<std::string>& fingerprints);
+
+// Topology of a subset communicator: `members` are parent global ranks
+// of the subgroup in NEW-rank order; the result is renumbered 0..n-1.
+Topology subsetTopology(const Topology& parent,
+                        const std::vector<int>& members, int newRank);
+
+// This process's host fingerprint: `override` (Context::setHostId) wins,
+// then TPUCOLL_HOST_ID, then "<hostname>/<boot-id>". The boot id makes
+// hostname collisions across machines (cloned images) harmless; the
+// override is what lets one machine present as H simulated hosts.
+std::string hostFingerprint(const std::string& override_);
+
+}  // namespace tpucoll

@@ -55,6 +55,22 @@ class Context {
   int channels() const { return channels_; }
   uint64_t stripeThresholdBytes() const { return stripeBytes_; }
 
+  // Topology gate for the shm payload plane (group/topology.h): when
+  // set — before the mesh connects — a pair only OFFERS shm to peers
+  // the mask co-hosts. The per-connection same-IP probe still applies
+  // on top; this is what keeps a simulated multi-host topology
+  // (TPUCOLL_HOST_ID overrides) honest by pinning cross-"host" pairs
+  // to TCP. Unset (the default, and the standalone-transport case)
+  // allows every peer, the pre-topology behavior.
+  void setShmPeers(std::vector<char> allowed) {
+    shmPeers_ = std::move(allowed);
+  }
+  bool shmPeerAllowed(int rank) const {
+    return shmPeers_.empty() ||
+           (rank >= 0 && rank < static_cast<int>(shmPeers_.size()) &&
+            shmPeers_[rank] != 0);
+  }
+
   // Fault-plane identity of this mesh (fault.h): 0 — the default — is
   // the root/parent domain; async-engine lane contexts carry lane + 1 so
   // each lane's serial op stream draws from its own deterministic
@@ -310,6 +326,8 @@ class Context {
   const int size_;
   int channels_{1};
   int faultDomain_{0};
+  // Per-peer shm eligibility (setShmPeers); empty = all allowed.
+  std::vector<char> shmPeers_;
   uint64_t stripeBytes_{uint64_t(1) << 20};
   bool channelsFromEnv_{false};
   bool stripeBytesFromEnv_{false};

@@ -350,8 +350,12 @@ void Pair::connectAttempt(const SockAddr& remote, uint64_t remotePairId,
   const bool ringTier = keyring.valid();
   // Extra data channels never negotiate shm: the shm plane lives on the
   // primary connection, and a pair whose payloads ride the shm ring
-  // bypasses striping entirely.
-  const bool offerShm = channel_ == 0 && shmEnabled() && sameHostFd(fd);
+  // bypasses striping entirely. The topology mask (setShmPeers) gates
+  // on top of the socket-level same-host probe, so a simulated
+  // multi-host layout (TPUCOLL_HOST_ID) keeps its cross-"host" pairs on
+  // TCP even though every process shares one machine.
+  const bool offerShm = channel_ == 0 && shmEnabled() && sameHostFd(fd) &&
+                        context_->shmPeerAllowed(peerRank_);
   const uint32_t magic =
       ringTier ? (encrypt ? kHelloRingEncMagic : kHelloRingMagic)
       : authKey.empty() ? kHelloMagic

@@ -4,6 +4,8 @@
 #include <string>
 #include <vector>
 
+#include "tpucoll/common/env.h"
+#include "tpucoll/group/hier.h"
 #include "tpucoll/tuning/tuning_table.h"
 
 namespace tpucoll {
@@ -44,6 +46,38 @@ const std::vector<std::string>& reduceScatterArms() {
   return arms;
 }
 
+// The hierarchical arm joins the electable set only where it can run
+// (non-flat topology) and the operator has not pinned dispatch flat
+// (TPUCOLL_HIER_AUTO=0). The tuner sweeps it under the same condition,
+// so a table loaded on a DIFFERENT topology can never elect hier where
+// it would degenerate.
+bool hierElectable(Context* ctx) {
+  static const bool hierAuto = envFlag("TPUCOLL_HIER_AUTO", true);
+  return hierAuto && group::hierEligible(ctx);
+}
+
+// Hier-augmented arm lists are function-local statics like the flat
+// ones: this runs on every tuned dispatch, which PR 12 made a
+// zero-allocation path — no per-op vector/string copies here.
+std::vector<std::string> withHier(const std::vector<std::string>& base) {
+  std::vector<std::string> arms = base;
+  arms.push_back("hier");
+  return arms;
+}
+
+const std::vector<std::string>& allreduceArmsWithHier(bool lossyWireOk) {
+  static const std::vector<std::string> plain = withHier(allreduceArms());
+  static const std::vector<std::string> lossy =
+      withHier(allreduceArmsLossy());
+  return lossyWireOk ? lossy : plain;
+}
+
+const std::vector<std::string>& reduceScatterArmsWithHier() {
+  static const std::vector<std::string> arms =
+      withHier(reduceScatterArms());
+  return arms;
+}
+
 }  // namespace
 
 const char* dataTypeName(DataType dtype) {
@@ -74,6 +108,7 @@ const char* allreduceAlgorithmName(AllreduceAlgorithm algo) {
     case AllreduceAlgorithm::kHdBlocks: return "hd_blocks";
     case AllreduceAlgorithm::kRingQ8Wire: return "ring_q8_wire";
     case AllreduceAlgorithm::kAutoLossyWire: return "auto_lossy_wire";
+    case AllreduceAlgorithm::kHier: return "hier";
   }
   return "unknown";
 }
@@ -94,6 +129,7 @@ const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo) {
     case ReduceScatterAlgorithm::kHalvingDoubling: return "halving_doubling";
     case ReduceScatterAlgorithm::kDirect: return "direct";
     case ReduceScatterAlgorithm::kRingQ8Wire: return "ring_q8_wire";
+    case ReduceScatterAlgorithm::kHier: return "hier";
   }
   return "unknown";
 }
@@ -108,10 +144,13 @@ std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
   }
   auto name = table->choose(
       "allreduce", ctx->size(), dataTypeName(dtype), nbytes,
-      lossyWireOk ? allreduceArmsLossy() : allreduceArms());
+      hierElectable(ctx) ? allreduceArmsWithHier(lossyWireOk)
+      : lossyWireOk      ? allreduceArmsLossy()
+                         : allreduceArms());
   if (!name.has_value()) {
     return std::nullopt;
   }
+  if (*name == "hier") return AllreduceAlgorithm::kHier;
   if (*name == "ring") return AllreduceAlgorithm::kRing;
   if (*name == "halving_doubling") return AllreduceAlgorithm::kHalvingDoubling;
   if (*name == "recursive_doubling") {
@@ -149,10 +188,14 @@ std::optional<ReduceScatterAlgorithm> tableReduceScatter(Context* ctx,
     return std::nullopt;
   }
   auto name = table->choose("reduce_scatter", ctx->size(),
-                            dataTypeName(dtype), nbytes, reduceScatterArms());
+                            dataTypeName(dtype), nbytes,
+                            hierElectable(ctx)
+                                ? reduceScatterArmsWithHier()
+                                : reduceScatterArms());
   if (!name.has_value()) {
     return std::nullopt;
   }
+  if (*name == "hier") return ReduceScatterAlgorithm::kHier;
   if (*name == "ring") return ReduceScatterAlgorithm::kRing;
   if (*name == "halving_doubling") {
     return ReduceScatterAlgorithm::kHalvingDoubling;

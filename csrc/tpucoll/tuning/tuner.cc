@@ -8,6 +8,7 @@
 
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/common/metrics.h"
+#include "tpucoll/group/hier.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -45,7 +46,8 @@ struct AllreduceArm {
   AllreduceAlgorithm algo;
 };
 
-std::vector<AllreduceArm> allreduceArms(int size) {
+std::vector<AllreduceArm> allreduceArms(Context* ctx) {
+  const int size = ctx->size();
   std::vector<AllreduceArm> arms = {
       {"ring", AllreduceAlgorithm::kRing},
       {"recursive_doubling", AllreduceAlgorithm::kRecursiveDoubling},
@@ -66,6 +68,12 @@ std::vector<AllreduceArm> allreduceArms(int size) {
     // for explicit kHalvingDoubling calls too).
     arms.push_back({"hd_fold", AllreduceAlgorithm::kHdFold});
     arms.push_back({"hd_blocks", AllreduceAlgorithm::kHdBlocks});
+  }
+  if (group::hierEligible(ctx)) {
+    // Topology-aware composition (group/hier.h), swept only where the
+    // topology is non-flat so an elected "hier" entry is always
+    // runnable on the topology it was measured on.
+    arms.push_back({"hier", AllreduceAlgorithm::kHier});
   }
   return arms;
 }
@@ -110,7 +118,11 @@ void publishAndInstall(Context* ctx, const TunerOptions& opts,
     // generation-stamped key (all ranks advanced the same generation —
     // tune() is a collective), everyone else blocks on the key. The
     // table also stays visible in the store for external inspection.
-    const std::string key = "tpucoll/tuning/" + std::to_string(gen);
+    // Scoped by the context's group tag (Context::scopedStoreKey) so
+    // two split sub-groups tuning concurrently over ONE physical store
+    // publish under disjoint keys.
+    const std::string key =
+        ctx->scopedStoreKey("tuning/" + std::to_string(gen));
     if (ctx->rank() == 0) {
       store->set(key, Store::Buf(json->begin(), json->end()));
     } else {
@@ -198,7 +210,7 @@ std::shared_ptr<const TuningTable> tune(Context* ctx,
     };
 
     if (opts.sweepAllreduce) {
-      for (const AllreduceArm& arm : allreduceArms(size)) {
+      for (const AllreduceArm& arm : allreduceArms(ctx)) {
         const double cost = measureArm(
             ctx, MetricOp::kAllreduce, opts.warmup, opts.iters, [&] {
               AllreduceOptions o;
@@ -243,7 +255,7 @@ std::shared_ptr<const TuningTable> tune(Context* ctx,
     }
 
     if (opts.sweepReduceScatter) {
-      static const RsArm kRsArms[] = {
+      std::vector<RsArm> rsArms = {
           {"ring", ReduceScatterAlgorithm::kRing},
           {"halving_doubling", ReduceScatterAlgorithm::kHalvingDoubling},
           {"direct", ReduceScatterAlgorithm::kDirect},
@@ -251,11 +263,14 @@ std::shared_ptr<const TuningTable> tune(Context* ctx,
           // headroom data for the q8 reduce_scatter opt-in.
           {"ring_q8_wire", ReduceScatterAlgorithm::kRingQ8Wire},
       };
+      if (group::hierEligible(ctx)) {
+        rsArms.push_back({"hier", ReduceScatterAlgorithm::kHier});
+      }
       std::vector<size_t> recvCounts(size, count / size);
       for (size_t r = 0; r < count % size; r++) {
         recvCounts[r]++;
       }
-      for (const RsArm& arm : kRsArms) {
+      for (const RsArm& arm : rsArms) {
         const double cost = measureArm(
             ctx, MetricOp::kReduceScatter, opts.warmup, opts.iters, [&] {
               ReduceScatterOptions o;

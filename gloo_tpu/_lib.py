@@ -109,6 +109,16 @@ _PROTOTYPES = {
     "tc_context_fork": (_int, [_c, _c, _u32]),
     "tc_context_close": (_int, [_c]),
     "tc_context_free": (None, [_c]),
+    # process-group subsystem: topology discovery + communicator split
+    "tc_context_rank": (_int, [_c]),
+    "tc_context_size": (_int, [_c]),
+    "tc_context_set_host_id": (_int, [_c, ctypes.c_char_p]),
+    "tc_topology_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_context_group_tag": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_split": (_int, [_c, _int, _int, _u32, ctypes.POINTER(_c)]),
+    "tc_split_by_host": (_int, [_c, _u32, ctypes.POINTER(_c)]),
     "tc_next_slot": (_u64, [_c, _u32]),
     "tc_debug_dump": (None, [_c]),
     "tc_context_shm_stats": (None, [_c, ctypes.POINTER(_u64),
@@ -143,8 +153,8 @@ _PROTOTYPES = {
     "tc_tuning_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # collectives
-    "tc_barrier": (_int, [_c, _u32, _i64]),
-    "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _u32, _i64]),
+    "tc_barrier": (_int, [_c, _int, _u32, _i64]),
+    "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _int, _u32, _i64]),
     "tc_allreduce": (_int, [_c, _c, _c, _sz, _int, _int, _int, _u32,
                             _i64]),
     # zero-copy in-place entries (persistent-plan hot path)
@@ -173,7 +183,7 @@ _PROTOTYPES = {
     "tc_gatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _int,
                           _u32, _i64]),
     "tc_scatter": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
-    "tc_allgather": (_int, [_c, _c, _c, _sz, _int, _u32, _i64]),
+    "tc_allgather": (_int, [_c, _c, _c, _sz, _int, _int, _u32, _i64]),
     "tc_allgatherv": (_int, [_c, _c, _c, ctypes.POINTER(_sz), _int, _u32,
                              _i64]),
     "tc_alltoall": (_int, [_c, _c, _c, _sz, _int, _u32, _i64]),
@@ -199,7 +209,7 @@ _PROTOTYPES = {
                                         _i64]),
     "tc_async_reduce_scatter": (_c, [_c, _c, _c, ctypes.POINTER(_sz),
                                      _int, _int, _int, _int, _i64]),
-    "tc_async_allgather": (_c, [_c, _c, _c, _sz, _int, _i64]),
+    "tc_async_allgather": (_c, [_c, _c, _c, _sz, _int, _int, _i64]),
     "tc_work_wait": (_int, [_c, _i64]),
     "tc_work_status": (_int, [_c]),
     "tc_work_error_message": (_int, [_c, ctypes.POINTER(ctypes.POINTER(

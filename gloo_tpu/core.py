@@ -767,10 +767,12 @@ class AsyncEngine:
 
     def allgather_async(self, array: np.ndarray,
                         timeout: Optional[float] = None,
-                        output: Optional[np.ndarray] = None) -> Work:
+                        output: Optional[np.ndarray] = None,
+                        algorithm: str = "auto") -> Work:
         """Async allgather; the (size, *shape) output is ``work.result``.
         A preallocated `output` (size * array.size elements) keeps the
-        result pointer stable — the per-lane plan-cache hot path."""
+        result pointer stable — the per-lane plan-cache hot path.
+        algorithm="hier" as for Context.allgather."""
         _check_array(array)
         out = _resolve_output(output, array.dtype,
                               self._context.size * array.size,
@@ -779,7 +781,8 @@ class AsyncEngine:
             out = out.reshape((self._context.size,) + array.shape)
         handle = check_handle(_lib.lib.tc_async_allgather(
             self._handle, _ptr(array), _ptr(out), array.size,
-            _dtype_code(array), _timeout_ms(timeout)))
+            _dtype_code(array), Context._HIER_ALGORITHMS[algorithm],
+            _timeout_ms(timeout)))
         return Work(self, handle, "allgather", (array, out), result=out)
 
     def stats(self) -> dict:
@@ -919,6 +922,87 @@ class Context:
         check(_lib.lib.tc_context_fork(child._handle, self._handle, tag))
         child._device = self._device
         return child
+
+    # ---- process-group subsystem: topology + native split ----
+
+    @classmethod
+    def _from_handle(cls, handle: int, timeout: float,
+                     parent: "Context") -> "Context":
+        """Wrap a native context handle produced by tc_split (ownership
+        transfers to the wrapper)."""
+        obj = cls.__new__(cls)
+        obj.rank = int(_lib.lib.tc_context_rank(handle))
+        obj.size = int(_lib.lib.tc_context_size(handle))
+        obj._timeout = timeout
+        obj._handle = handle
+        obj._store = None
+        obj._device = parent._device
+        obj._engines = []
+        obj._parent = parent  # pin the parent (shared device, store)
+        obj._free = _lib.lib.tc_context_free
+        return obj
+
+    def set_host_id(self, host_id: str) -> None:
+        """Override this context's host fingerprint for topology
+        discovery; must be called BEFORE connect_full_mesh. Ranks with
+        equal fingerprints are treated as co-hosted: they may negotiate
+        the shm payload plane, split_by_host() groups them, and the
+        hierarchical collectives put them on one intra-host plane.
+        Defaults (unset): TPUCOLL_HOST_ID, else hostname + boot id.
+        Overriding is how tests simulate an H-host topology on one
+        machine (docs/topology.md)."""
+        check(_lib.lib.tc_context_set_host_id(self._handle,
+                                              host_id.encode()))
+
+    def topology(self) -> dict:
+        """Host topology discovered at bootstrap: {"rank", "host_index",
+        "local_rank", "local_size", "leader", "is_leader", "n_hosts",
+        "non_flat", "hosts": [{"fingerprint", "ranks"}, ...]}. Hosts are
+        numbered by lowest member rank; the leader of a host is its
+        lowest global rank (docs/topology.md)."""
+        return json.loads(_copy_out(_lib.lib.tc_topology_json,
+                                    self._handle))
+
+    def group_tag(self) -> str:
+        """Group-tag namespace of this communicator: "" for a bootstrap
+        context, "s<tag>.<gen>.c<color>" segments for split subgroups
+        (nested splits join with "/"). Scopes post-bootstrap store keys,
+        flight-recorder dump names, and the metrics "group" field."""
+        return _copy_out(_lib.lib.tc_context_group_tag,
+                         self._handle).decode()
+
+    def split(self, color: int, key: int = 0,
+              tag: int = 0) -> Optional["Context"]:
+        """Split this communicator (MPI_Comm_split semantics): ranks
+        passing the same non-negative `color` form a subset Context with
+        fresh contiguous ranks ordered by (key, parent rank); a negative
+        color opts out and returns None.
+
+        A COLLECTIVE over the parent: every rank must call concurrently
+        with the same `tag`; concurrent splits must use distinct tags
+        (the tag scopes both the store keys and, on store-less forked
+        parents, the exchange collectives — which also consume parent
+        tags [tag, tag+2]).
+
+        The child is a full communicator: members-only mesh, own
+        tag/slot namespace, own plan cache / metrics / flight recorder /
+        fault domain / store namespace, topology = the member subset.
+        All collectives, plans, and async engines work on it."""
+        out = ctypes.c_void_p()
+        check(_lib.lib.tc_split(self._handle, int(color), int(key), tag,
+                                ctypes.byref(out)))
+        if not out.value:
+            return None
+        return Context._from_handle(out.value, self._timeout, self)
+
+    def split_by_host(self, tag: int = 0) -> "Context":
+        """split(color = host index, key = rank): the intra-host
+        communicator (every member co-hosted, shm-reachable)."""
+        out = ctypes.c_void_p()
+        check(_lib.lib.tc_split_by_host(self._handle, tag,
+                                        ctypes.byref(out)))
+        return Context._from_handle(
+            check_handle(out.value), self._timeout, self)
 
     def close(self) -> None:
         """Close the context. Any async engine created through
@@ -1134,7 +1218,8 @@ class Context:
         if output is None:
             out = out.reshape((self.size,) + array.shape)
         args = (self._handle, _ptr(array), _ptr(out), array.size,
-                _dtype_code(array), tag, _timeout_ms(timeout))
+                _dtype_code(array), self._HIER_ALGORITHMS["auto"], tag,
+                _timeout_ms(timeout))
         return CollectivePlan(self, _lib.lib.tc_allgather, args,
                               (array, out), out)
 
@@ -1169,14 +1254,24 @@ class Context:
 
     # ---- collectives ----
 
-    def barrier(self, tag: int = 0, timeout: Optional[float] = None) -> None:
-        check(_lib.lib.tc_barrier(self._handle, tag, _timeout_ms(timeout)))
+    # Schedules without an algorithm family of their own take "auto"
+    # (flat) or "hier" (topology-aware composition over native splits;
+    # degrades to flat on a flat topology — docs/topology.md).
+    _HIER_ALGORITHMS = {"auto": 0, "hier": 1}
+
+    def barrier(self, tag: int = 0, timeout: Optional[float] = None,
+                algorithm: str = "auto") -> None:
+        check(_lib.lib.tc_barrier(self._handle,
+                                  self._HIER_ALGORITHMS[algorithm], tag,
+                                  _timeout_ms(timeout)))
 
     def broadcast(self, array: np.ndarray, root: int = 0, tag: int = 0,
-                  timeout: Optional[float] = None) -> np.ndarray:
+                  timeout: Optional[float] = None,
+                  algorithm: str = "auto") -> np.ndarray:
         _check_array(array)
         check(_lib.lib.tc_broadcast(self._handle, _ptr(array), array.size,
-                                    _dtype_code(array), root, tag,
+                                    _dtype_code(array), root,
+                                    self._HIER_ALGORITHMS[algorithm], tag,
                                     _timeout_ms(timeout)))
         return array
 
@@ -1185,7 +1280,8 @@ class Context:
                    "recursive_doubling": 5, "rd": 5,
                    "hd_fold": 6, "hd_blocks": 7,
                    "ring_q8_wire": 8, "q8": 8,
-                   "auto_lossy_wire": 9, "auto_lossy": 9}
+                   "auto_lossy_wire": 9, "auto_lossy": 9,
+                   "hier": 10}
     _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
 
     # wire= shorthand -> allreduce algorithm. The q8/bf16 codecs are
@@ -1413,19 +1509,23 @@ class Context:
 
     def allgather(self, array: np.ndarray, tag: int = 0,
                   timeout: Optional[float] = None,
-                  output: Optional[np.ndarray] = None) -> np.ndarray:
+                  output: Optional[np.ndarray] = None,
+                  algorithm: str = "auto") -> np.ndarray:
         """Allgather into a (size, *shape) array. Passing a preallocated
         `output` (same dtype, size * array.size elements) avoids the
         per-call allocation AND keeps the output pointer stable across
         steps, which is what lets the native plan cache replay the
-        schedule with zero registrations (docs/design.md)."""
+        schedule with zero registrations (docs/design.md).
+        algorithm="hier" composes intra-host allgather + leader-only
+        exchange on a non-flat topology (docs/topology.md)."""
         _check_array(array)
         out = _resolve_output(output, array.dtype, self.size * array.size,
                               "allgather")
         if output is None:
             out = out.reshape((self.size,) + array.shape)
         check(_lib.lib.tc_allgather(self._handle, _ptr(array), _ptr(out),
-                                    array.size, _dtype_code(array), tag,
+                                    array.size, _dtype_code(array),
+                                    self._HIER_ALGORITHMS[algorithm], tag,
                                     _timeout_ms(timeout)))
         return out
 
@@ -1467,7 +1567,8 @@ class Context:
         return out
 
     _RS_ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2,
-                      "hd": 2, "direct": 3, "ring_q8_wire": 4, "q8": 4}
+                      "hd": 2, "direct": 3, "ring_q8_wire": 4, "q8": 4,
+                      "hier": 5}
 
     def reduce_scatter(self, array: np.ndarray,
                        recv_counts: Optional[Sequence[int]] = None,

@@ -56,6 +56,49 @@ class HierarchicalGroup:
             else jax.local_devices()
         self.tag = tag
         self._jit_cache = {}
+        # Native topology plane: when the host context spans several
+        # processes per machine (one per accelerator is the common
+        # deployment), its collectives route through the native
+        # hierarchical schedules — intra-host shm plane, leader-only
+        # DCN exchange — built on Context.split sub-communicators. On a
+        # flat topology (one process per host, or a single host) the
+        # "hier" request degrades to the flat schedules natively, so
+        # this is always safe to pass.
+        try:
+            self._hier_algo = ("hier" if ctx.topology().get("non_flat")
+                               else "auto")
+        except Exception:  # pragma: no cover - not connected / mock ctx
+            self._hier_algo = "auto"
+        self._local_ctx = None
+        self._leader_ctx = None
+        self._planes_built = False
+
+    # ---- native split planes (no ad-hoc per-group store bootstrap) ----
+
+    def _ensure_planes(self):
+        """Build the intra-host / leader sub-communicators via native
+        Context.split — a collective over the host context, so every
+        process must reach the first accessor together. No side stores:
+        the split's color exchange and subset bootstrap ride the
+        context's own rendezvous namespace (docs/topology.md)."""
+        if not self._planes_built:
+            self._local_ctx = self.ctx.split_by_host(tag=0x51C0)
+            topo = self.ctx.topology()
+            self._leader_ctx = self.ctx.split(
+                0 if topo["is_leader"] else -1, key=self.ctx.rank,
+                tag=0x51C4)
+            self._planes_built = True
+        return self._local_ctx, self._leader_ctx
+
+    def local_group(self):
+        """Native intra-host communicator (co-hosted processes; shm
+        plane). A collective on first use — call on every rank."""
+        return self._ensure_planes()[0]
+
+    def leader_group(self):
+        """Native leader communicator (one process per host), or None on
+        non-leader processes. A collective on first use."""
+        return self._ensure_planes()[1]
 
     # ---- local (intra-host) stage ----
 
@@ -135,10 +178,13 @@ class HierarchicalGroup:
     def allreduce(self, x, op: str = "sum"):
         """Local on-accelerator reduce -> host-plane allreduce over DCN ->
         replicate back to local devices. Returns x's structure: list in,
-        per-device list out; array in, replicated array out."""
+        per-device list out; array in, replicated array out. On a
+        multi-process-per-host topology the host hop runs the native
+        hierarchical schedule (shm plane intra-host, leaders-only DCN)."""
         host = self._local_value(x, op)
         flat = np.ascontiguousarray(host.reshape(-1))
-        self.ctx.allreduce(flat, op=op, tag=self.tag)
+        self.ctx.allreduce(flat, op=op, tag=self.tag,
+                           algorithm=self._hier_algo)
         return self._put_back(flat.reshape(host.shape), x)
 
     def mean(self, x):
@@ -161,7 +207,8 @@ class HierarchicalGroup:
         """Root host's value to every host's local devices."""
         host = self._local_value(x)
         flat = np.ascontiguousarray(host.reshape(-1))
-        self.ctx.broadcast(flat, root=root, tag=self.tag)
+        self.ctx.broadcast(flat, root=root, tag=self.tag,
+                           algorithm=self._hier_algo)
         return self._put_back(flat.reshape(host.shape), x)
 
     def allgather(self, x) -> np.ndarray:
@@ -169,11 +216,12 @@ class HierarchicalGroup:
         every host."""
         host = self._local_value(x)
         flat = np.ascontiguousarray(host.reshape(-1))
-        out = self.ctx.allgather(flat, tag=self.tag)
+        out = self.ctx.allgather(flat, tag=self.tag,
+                                 algorithm=self._hier_algo)
         return out.reshape((self.ctx.size,) + host.shape)
 
     def barrier(self) -> None:
-        self.ctx.barrier(tag=self.tag)
+        self.ctx.barrier(tag=self.tag, algorithm=self._hier_algo)
 
 
 def make_hierarchical_ddp(loss_fn, optimizer, group: HierarchicalGroup,
@@ -230,7 +278,8 @@ def make_hierarchical_ddp(loss_fn, optimizer, group: HierarchicalGroup,
                 flat = np.concatenate(
                     [l.reshape(-1).astype(np.float32)
                      for l in host_leaves])
-                group.ctx.allreduce(flat, tag=group.tag)
+                group.ctx.allreduce(flat, tag=group.tag,
+                                    algorithm=group._hier_algo)
                 flat /= group.ctx.size
                 out, off = [], 0
                 for l in host_leaves:

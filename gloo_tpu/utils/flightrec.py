@@ -44,6 +44,7 @@ __all__ = [
     "install_signal_handler",
     "load",
     "merge",
+    "merge_by_tag",
     "raise_on_desync",
     "to_perfetto",
 ]
@@ -55,6 +56,13 @@ __all__ = [
 TAIL_K = 16
 
 _RANK_RE = re.compile(r"flightrec-rank(\d+)\.json$")
+# Tagged dump names: flightrec-rank<r>[-g<group>][-lane<k>].json — group
+# tags come from split sub-communicators (Context.group_tag, '/' mapped
+# to '.'), lane tags from async engines. merge() keeps its historical
+# contract (untagged = root-context dumps only); merge_by_tag() is the
+# partitioned form.
+_TAGGED_RE = re.compile(
+    r"flightrec-rank(\d+)(?:-g([\w.]+))?(?:-lane(\d+))?\.json$")
 
 
 class DesyncError(RuntimeError):
@@ -176,6 +184,37 @@ def merge(dumps: Union[str, Iterable]) -> dict:
     missing = [r for r in range(size) if r not in ranks]
     return {"ranks": ranks, "size": size, "missing": missing,
             "timeline": timeline}
+
+
+def merge_by_tag(directory: str) -> Dict[str, dict]:
+    """Partition a dump directory by tag, then merge each partition.
+
+    Returns {tag: merge_result}. The tag is "<group>" for split
+    sub-communicator dumps (flightrec-rank<r>-g<group>.json, with the
+    "group" field inside the doc as fallback), "<group>/lane<k>" or
+    "lane<k>" for async-lane dumps, and "" for plain root-context dumps.
+
+    Partitioning is REQUIRED before analysis when sub-groups share a
+    dump directory: disjoint split groups legitimately run different
+    schedules, so fingerprint-comparing rank 0 of group A against rank 0
+    of group B would report a desync that is not one. Analyze each
+    partition independently (see tools/flightrec_view.py)."""
+    partitions: Dict[str, list] = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "flightrec-rank*.json"))):
+        m = _TAGGED_RE.search(os.path.basename(path))
+        if m is None:
+            continue
+        doc = load(path)
+        if doc is None:
+            continue
+        group = m.group(2) or str(doc.get("group", "") or "")
+        lane = m.group(3)
+        tag = group
+        if lane is not None:
+            tag = f"{group}/lane{lane}" if group else f"lane{lane}"
+        partitions.setdefault(tag, []).append(doc)
+    return {tag: merge(docs) for tag, docs in sorted(partitions.items())}
 
 
 def detect_desync(tails: Dict[int, List[dict]]) -> Optional[dict]:

@@ -100,6 +100,12 @@ def to_prometheus(snapshot: dict,
     names say so explicitly rather than silently converting."""
     base = dict(extra_labels or {})
     base["rank"] = snapshot.get("rank", 0)
+    # Split sub-communicators stamp their group tag into the snapshot
+    # (Context.group_tag()); label every family with it so one scrape
+    # distinguishes e.g. a DP group's traffic from its TP sibling's.
+    # Root contexts ("" group) stay unlabeled — unchanged series names.
+    if snapshot.get("group"):
+        base["group"] = snapshot["group"]
     lines: List[str] = []
 
     lines.append("# TYPE gloo_tpu_collective_calls_total counter")

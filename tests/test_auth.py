@@ -201,6 +201,12 @@ def test_keyring_valid_key_wrong_slot_rejected_at_routing():
         except gloo_tpu.Error:
             state["rank0"] = "timed out"  # ranks 1/2 never join the mesh
 
+    # Play along with topology discovery: rank 0's connect_full_mesh
+    # blocks on every rank's host fingerprint BEFORE it publishes its
+    # rank blob (docs/topology.md), so the fake peers must answer.
+    store.set("tc/topo/1", b"fake-host-1")
+    store.set("tc/topo/2", b"fake-host-2")
+
     t0 = threading.Thread(target=rank0, daemon=True)
     t0.start()
 

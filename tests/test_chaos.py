@@ -574,3 +574,154 @@ def test_wildcard_destructive_rule_skips_connect_events():
     assert all(e["opcode"] != "connect" for e in fired), fired
     assert any(e["action"] == "kill" and e["opcode"] == "data"
                for e in fired), fired
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 satellites: chaos over the hierarchical (kHier) collectives
+# ---------------------------------------------------------------------------
+
+# Shared body for the hier SIGKILL arms: 2 simulated hosts x 2 ranks
+# (TPUCOLL_HOST_ID per process), one healthy kHier allreduce so the
+# split sub-groups exist, then `victim` SIGKILLs itself mid-kHier.
+# Survivors assert a TYPED failure whose message names the hier phase +
+# subgroup + subgroup->global rank map, then rebuild over the same store
+# and prove the REBUILT context reforms working split groups (new
+# split_by_host + subgroup allreduce + a kHier allreduce on the new
+# topology).
+_HIER_KILL_BODY = """
+victim = {victim}
+warm = np.full(256, 1.0, dtype=np.float32)
+ctx.allreduce(warm, algorithm="hier", tag=1)
+assert warm[0] == float(size), warm[0]
+x = np.full(1 << 18, float(rank + 1), dtype=np.float32)
+if rank == victim:
+    os.kill(os.getpid(), signal.SIGKILL)
+err = None
+try:
+    ctx.allreduce(x, algorithm="hier", tag=2, timeout=4.0)
+except gloo_tpu.IoError as exc:
+    err = str(exc)
+assert err is not None, "kHier allreduce unexpectedly survived"
+if rank == {named_rank}:
+    # This survivor shares a plane with the victim: its failing phase
+    # must name the hier collective, the subgroup, and the rank map.
+    assert "hier allreduce" in err, err
+    assert "subgroup" in err and "->" in err, err
+# settle must exceed the slowest survivor's detection lag: hier
+# failure detection CASCADES through phases (a healthy plane only
+# notices at its own phase timeout), so the 4s op timeout bounds it.
+new_ctx, new_rank, new_size = rebuild_after_failure(
+    store, gloo_tpu.Device(), old_rank=rank, old_size=size, generation=1,
+    settle=6.0, timeout=90.0, failed_context=ctx)
+assert new_ctx is not None, "rebuild failed"
+assert new_size == size - 1, new_size
+# Reform split groups on the rebuilt context (TPUCOLL_HOST_ID still
+# groups the survivors into hosts).
+local = new_ctx.split_by_host(tag=4)
+y = np.full(128, float(new_rank + 1), dtype=np.float32)
+local.allreduce(y)
+assert y[0] > 0
+z = np.full(1024, 1.0, dtype=np.float32)
+new_ctx.allreduce(z, algorithm="hier", tag=5)
+assert z[0] == float(new_size), z[0]
+local.close()
+new_ctx.close()
+print("OK")
+"""
+
+
+def _run_hier_kill(victim, named_rank):
+    store = tempfile.mkdtemp()
+    size = 4
+    procs = []
+    for r in range(size):
+        procs.append(_spawn_worker(
+            _HIER_KILL_BODY.format(victim=victim, named_rank=named_rank),
+            r, size, store,
+            extra_env={"TPUCOLL_HOST_ID": f"chaoshost{r // 2}"}))
+    outs = [p.communicate(timeout=180) for p in procs]
+    assert procs[victim].returncode == -signal.SIGKILL
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if r == victim:
+            continue
+        assert p.returncode == 0, (r, p.returncode, out)
+        assert "OK" in out[0], (r, out)
+
+
+def test_chaos_sigkill_nonleader_mid_hier_allreduce():
+    """SIGKILL a NON-LEADER (rank 3, host 1) mid-kHier: its co-hosted
+    leader (rank 2) fails typed in the intra-host phase naming the
+    subgroup, and rebuild_after_failure reforms working split groups."""
+    _run_hier_kill(victim=3, named_rank=2)
+
+
+def test_chaos_sigkill_leader_mid_hier_allreduce():
+    """SIGKILL a LEADER (rank 2, host 1) mid-kHier: both its co-hosted
+    member (rank 3, intra-host phase) and the peer leader (rank 0,
+    inter-host phase) observe the death; rank 0's typed error names the
+    hier subgroup. Rebuild reforms split groups on the 3-survivor
+    topology (host 1 degrades to one rank)."""
+    _run_hier_kill(victim=2, named_rank=0)
+
+
+def test_chaos_same_seed_determinism_with_group_domains():
+    """Same-seed fault determinism holds per (rank, domain) with GROUP
+    domains: a probabilistic delay rule fires inside the hier split
+    sub-groups (domain = hash of the group tag, >= 1000), and two runs
+    of the same workload produce identical per-(rank, domain)
+    subsequences."""
+    import gloo_tpu
+    from gloo_tpu import fault
+
+    schedule = {"seed": 5, "faults": [
+        {"when": {"opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.4, "seed": 17}]}
+
+    def workload():
+        import threading
+
+        store = gloo_tpu.HashStore()
+        reports = [None] * 4
+        errors = []
+
+        def worker(rank):
+            try:
+                ctx = gloo_tpu.Context(rank, 4, timeout=30)
+                ctx.set_host_id(f"dh{rank // 2}")
+                ctx.connect_full_mesh(store, gloo_tpu.Device())
+                x = np.full(4096, 1.0, dtype=np.float32)
+                for i in range(6):
+                    ctx.allreduce(x, algorithm="hier", tag=i)
+                    x[:] = 1.0
+                ctx.barrier(tag=99)
+                ctx.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append((rank, e))
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errors, errors
+        entries = fault.report()
+        # Canonicalize: the global interleaving is scheduling-dependent,
+        # each (rank, domain) stream is the deterministic unit.
+        entries.sort(key=lambda e: (e["rank"], e["domain"], e["n"]))
+        return entries
+
+    fault.install(schedule)
+    try:
+        first = workload()
+        fault.install(schedule)
+        second = workload()
+    finally:
+        fault.clear()
+    assert first == second
+    domains = {e["domain"] for e in first}
+    # Group domains engaged: hier phases run on split sub-contexts whose
+    # fault domains derive from the group tag (>= 1000), alongside the
+    # parent's root domain 0 traffic.
+    assert any(d >= 1000 for d in domains), domains
+    assert first, "no faults fired"

@@ -125,6 +125,30 @@ def test_bench_channel_sweep_smoke():
     assert (1, 1, 1 << 20) in seen and (2, 2, 1 << 20) in seen
 
 
+def test_bench_hier_sweep_smoke():
+    """bench.py --hier-sweep --quick (4 ranks, 2 simulated hosts): one
+    valid JSON cell comparing flat vs hierarchical allreduce over the
+    mixed shm+TCP fabric. The ratio is not asserted — the committed
+    HIER_r13.json records the measured grid; the smoke proves the cell
+    machinery (topology simulation, consensus check, shm-grouping
+    assertion inside the workers) holds together."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--hier-sweep", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    line = lines[0]
+    assert line["metric"] == "hier_sweep" and line["ok"] is True, line
+    assert line["hosts"] == 2 and line["ranks_per_host"] == 2
+    assert line["flat_gbps"] > 0 and line["hier_gbps"] > 0
+    assert line["hier_vs_flat"] > 0
+
+
 def test_bench_latency_smoke():
     """bench.py --latency --quick (2 ranks, TPUCOLL_SHM=0): one JSON
     line per (op, size, plans on/off) cell plus a summary line. The

@@ -1,0 +1,524 @@
+"""Process-group subsystem (ISSUE 13): topology discovery, native
+Context.split sub-communicators, and the topology-aware hierarchical
+(kHier) collectives — plus the store-key hygiene and post-mortem
+partitioning contracts that ride on the group tags.
+
+Topology simulation: each rank overrides its host fingerprint
+(Context.set_host_id) so one machine presents as H simulated hosts; the
+shm payload plane then negotiates only between co-"hosted" ranks, which
+is both the observable proof of the grouping and what makes the mixed
+shm+TCP fabric real (docs/topology.md).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu.utils import flightrec as frmod
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_topo(size, rph, fn, timeout=60.0, context_timeout=30.0,
+               host_of=None):
+    """harness.spawn with a simulated topology: rank r presents host
+    fingerprint grp-host<host_of(r)> (default r // rph)."""
+    store = gloo_tpu.HashStore()
+    results = [None] * size
+    errors = []
+    lock = threading.Lock()
+
+    def worker(rank):
+        ctx = None
+        try:
+            device = gloo_tpu.Device()
+            ctx = gloo_tpu.Context(rank, size, timeout=context_timeout)
+            host = host_of(rank) if host_of is not None else rank // rph
+            ctx.set_host_id(f"grp-host{host}")
+            ctx.connect_full_mesh(store, device)
+            results[rank] = fn(ctx, rank)
+        except BaseException as exc:  # noqa: BLE001 - surfaced to caller
+            with lock:
+                errors.append((rank, exc))
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"rank thread did not finish in {timeout}s")
+    if errors:
+        rank, exc = errors[0]
+        raise AssertionError(f"rank {rank} failed: {exc!r}") from exc
+    return results
+
+
+# ---------------------------------------------------------------------------
+# topology discovery
+# ---------------------------------------------------------------------------
+
+def test_topology_discovery_and_shm_grouping():
+    """2 simulated hosts x 3 ranks: every rank derives the same
+    ranks-per-host map, local coordinates, and leader; and the shm plane
+    negotiated with exactly the co-hosted peers (cross-host pairs pinned
+    to TCP by the topology mask)."""
+    def fn(ctx, rank):
+        topo = ctx.topology()
+        assert topo["n_hosts"] == 2 and topo["non_flat"] is True, topo
+        assert topo["hosts"][0]["ranks"] == [0, 1, 2]
+        assert topo["hosts"][1]["ranks"] == [3, 4, 5]
+        assert topo["host_index"] == rank // 3
+        assert topo["local_rank"] == rank % 3
+        assert topo["local_size"] == 3
+        assert topo["leader"] == (rank // 3) * 3
+        assert topo["is_leader"] == (rank % 3 == 0)
+        # Force traffic so shm negotiation evidence exists.
+        ctx.allreduce(np.ones(1 << 14, np.float32))
+        return ctx.shm_stats()["active_pairs"]
+
+    pairs = spawn_topo(6, 3, fn)
+    assert pairs == [2] * 6, pairs  # only the 2 co-hosted peers
+
+
+def test_topology_flat_without_override():
+    """No overrides: in-process ranks share the real host fingerprint —
+    one host, flat topology, every pair shm-eligible."""
+    from tests.harness import spawn
+
+    def fn(ctx, rank):
+        topo = ctx.topology()
+        assert topo["n_hosts"] == 1 and topo["non_flat"] is False, topo
+        assert topo["local_size"] == 3
+        return True
+
+    assert all(spawn(3, fn))
+
+
+# ---------------------------------------------------------------------------
+# Context.split
+# ---------------------------------------------------------------------------
+
+def test_split_colors_keys_and_optout():
+    """MPI_Comm_split semantics: same color groups; ranks ordered by
+    (key, parent rank) — keys reverse the order here; negative color
+    yields None but still participates in the exchange."""
+    def fn(ctx, rank):
+        # colors: even/odd; keys: descending => new ranks reversed
+        sub = ctx.split(rank % 2, key=-rank, tag=3)
+        members = [r for r in range(6) if r % 2 == rank % 2]
+        expect_rank = list(reversed(members)).index(rank)
+        assert sub.size == 3 and sub.rank == expect_rank, \
+            (rank, sub.rank)
+        x = np.full(7, float(rank), np.float32)
+        sub.allreduce(x)
+        assert x[0] == sum(members), (rank, x[0])
+        # subgroup identity
+        assert f"s3.1.c{rank % 2}" in sub.group_tag()
+        # opt-out: rank 5 sits this one out
+        solo = ctx.split(-1 if rank == 5 else 0, tag=5)
+        if rank == 5:
+            assert solo is None
+        else:
+            assert solo.size == 5
+            solo.barrier()
+            solo.close()
+        sub.close()
+        return True
+
+    assert all(spawn_topo(6, 3, fn))
+
+
+def test_split_subgroup_full_stack():
+    """A split subgroup is a full communicator: all collectives, fresh
+    tag/slot namespace, working plan cache, and async-engine lanes."""
+    def fn(ctx, rank):
+        sub = ctx.split_by_host(tag=1)
+        assert sub.size == 2 and sub.rank == rank % 2
+        base = (rank // 2) * 2
+        # collectives battery
+        x = np.full(64, float(rank + 1), np.float32)
+        sub.allreduce(x)
+        assert x[0] == (base + 1) + (base + 2)
+        b = np.full(8, float(rank), np.float32)
+        sub.broadcast(b, root=1)
+        assert b[0] == base + 1
+        g = sub.allgather(np.full(4, float(rank), np.float32))
+        assert g.shape == (2, 4) and g[1][0] == base + 1
+        rs = sub.reduce_scatter(np.arange(6, dtype=np.float32))
+        sub.barrier()
+        assert rs.size == 3
+        # plan cache lives per sub-context
+        p = sub.allreduce_plan(x, tag=9)
+        for _ in range(3):
+            x[:] = 1.0
+            p()
+            assert x[0] == 2.0
+        snap = sub.metrics()
+        assert snap["plan_hits"] >= 2, snap["plan_hits"]
+        assert snap["group"] == sub.group_tag()
+        # async lanes fork from the split group
+        with sub.async_engine(lanes=2) as eng:
+            works = [eng.allreduce_async(
+                np.full(32, float(sub.rank + 1), np.float32))
+                for _ in range(4)]
+            for w in works:
+                out = w.wait(timeout=30)
+                assert out[0] == 3.0, out[0]
+        sub.close()
+        return True
+
+    assert all(spawn_topo(4, 2, fn, timeout=90))
+
+
+def test_split_of_split_nested():
+    """Nested splits: split a 2x3 world by host, then split each host
+    group again; tags nest in the group namespace."""
+    def fn(ctx, rank):
+        host = ctx.split_by_host(tag=2)
+        pair = host.split(0 if host.rank < 2 else 1, tag=4)
+        assert "/" in pair.group_tag(), pair.group_tag()
+        x = np.full(5, 1.0, np.float32)
+        pair.allreduce(x)
+        assert x[0] == pair.size
+        pair.close()
+        host.close()
+        return True
+
+    assert all(spawn_topo(6, 3, fn))
+
+
+def test_concurrent_splits_store_key_hygiene():
+    """Satellite (store key hygiene): two SIMULTANEOUS split() calls per
+    rank — different tags, one shared physical store — must never read
+    each other's color/bootstrap keys. Both resulting subgroups verify a
+    collective."""
+    def fn(ctx, rank):
+        results = {}
+        errors = []
+
+        def do_split(name, color, tag):
+            try:
+                sub = ctx.split(color, key=rank, tag=tag)
+                x = np.full(16, float(rank + 1), np.float32)
+                sub.allreduce(x)
+                results[name] = (sub.size, float(x[0]), sub.group_tag())
+                sub.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append((name, e))
+
+        # rows: {0,1,2} x {3,4,5}; cols: {0,3} x {1,4} x {2,5} — issued
+        # CONCURRENTLY from two threads over the same HashStore.
+        t1 = threading.Thread(target=do_split,
+                              args=("row", rank // 3, 100))
+        t2 = threading.Thread(target=do_split,
+                              args=("col", rank % 3, 200))
+        t1.start(); t2.start(); t1.join(60); t2.join(60)
+        assert not errors, errors
+        row_base = (rank // 3) * 3
+        assert results["row"] == (
+            3, float(sum(r + 1 for r in range(row_base, row_base + 3))),
+            f"s100.1.c{rank // 3}")
+        assert results["col"][0] == 2
+        assert results["col"][1] == float((rank % 3 + 1) +
+                                          (rank % 3 + 4)), results["col"]
+        return True
+
+    assert all(spawn_topo(6, 3, fn, timeout=120, context_timeout=60))
+
+
+def test_sequential_same_tag_splits_fresh_generation():
+    """Same tag reused sequentially: the per-tag generation advances, so
+    the second split reads fresh keys (stale-key reuse would deliver the
+    FIRST split's colors)."""
+    def fn(ctx, rank):
+        a = ctx.split(rank % 2, tag=7)
+        b = ctx.split(rank // 2, tag=7)  # different grouping, same tag
+        assert "s7.1." in a.group_tag() and "s7.2." in b.group_tag()
+        x = np.full(4, 1.0, np.float32)
+        b.allreduce(x)
+        assert x[0] == b.size
+        a.close(); b.close()
+        return True
+
+    assert all(spawn_topo(4, 2, fn))
+
+
+def test_split_tuning_election_scoped_per_group():
+    """Two sibling subgroups run tune() concurrently over one shared
+    store: the election keys are scoped by the group tag, so each group
+    installs its own (size-consistent) table instead of racing for
+    'tpucoll/tuning/<gen>'."""
+    from gloo_tpu import tuning
+
+    def fn(ctx, rank):
+        sub = ctx.split_by_host(tag=11)
+        table = tuning.tune(sub, min_bytes=1 << 10, max_bytes=1 << 12,
+                            iters=2, warmup=1)
+        installed = tuning.installed_table(sub)
+        assert installed, "no table installed on the subgroup"
+        x = np.full(256, 1.0, np.float32)
+        sub.allreduce(x)  # dispatch off the installed table
+        assert x[0] == sub.size
+        sub.close()
+        return json.dumps(table)[:1]
+
+    assert all(spawn_topo(4, 2, fn, timeout=120, context_timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# hierarchical collectives
+# ---------------------------------------------------------------------------
+
+def _hier_battery(ctx, rank, size, rph):
+    hosts = size // rph
+    # allreduce: consensus + equality with the flat ring on exact ints
+    z = np.arange(1 << 10, dtype=np.float32) + rank
+    flat = z.copy()
+    ctx.allreduce(z, algorithm="hier", tag=1)
+    ctx.allreduce(flat, algorithm="ring", tag=2)
+    np.testing.assert_array_equal(z, flat)
+    # ops other than sum
+    m = np.full(17, float(rank), np.float32)
+    ctx.allreduce(m, op="max", algorithm="hier", tag=3)
+    assert m[0] == size - 1
+    # broadcast from a non-leader root and from a leader root
+    for root in (rph - 1, 0):
+        b = np.full(33, float(rank * 10), np.float32)
+        ctx.broadcast(b, root=root, algorithm="hier", tag=4)
+        assert np.all(b == root * 10), (rank, root, b[0])
+    # allgather ordering
+    g = ctx.allgather(np.full(3, float(rank), np.float32),
+                      algorithm="hier", tag=5)
+    assert g.shape == (size, 3)
+    assert [g[r][0] for r in range(size)] == list(map(float, range(size)))
+    # ragged reduce_scatter vs flat
+    counts = [i + 1 for i in range(size)]
+    src = np.arange(sum(counts), dtype=np.float32) * (rank + 1)
+    out_h = ctx.reduce_scatter(src, recv_counts=counts, algorithm="hier",
+                               tag=6)
+    out_f = ctx.reduce_scatter(src, recv_counts=counts, algorithm="ring",
+                               tag=7)
+    np.testing.assert_array_equal(out_h, out_f)
+    ctx.barrier(algorithm="hier", tag=8)
+    return hosts
+
+
+def test_hier_collectives_p4():
+    assert all(spawn_topo(
+        4, 2, lambda c, r: _hier_battery(c, r, 4, 2), timeout=90))
+
+
+def test_hier_collectives_p6():
+    assert all(spawn_topo(
+        6, 3, lambda c, r: _hier_battery(c, r, 6, 3), timeout=120,
+        context_timeout=60))
+
+
+def test_hier_interleaved_host_assignment():
+    """Ranks NOT grouped contiguously by host (round-robin placement):
+    the grouped-order permutations in hier allgather/reduce_scatter must
+    still produce global-rank-ordered results."""
+    assert all(spawn_topo(
+        6, 3, lambda c, r: _hier_battery(c, r, 6, 3), timeout=120,
+        context_timeout=60, host_of=lambda r: r % 2))
+
+
+def test_hier_degrades_on_flat_topology():
+    """kHier on a flat topology (no overrides => one host) dispatches
+    the flat schedule — same results, no error, and the flight recorder
+    shows the degraded (non-hier) algorithm."""
+    from tests.harness import spawn
+
+    def fn(ctx, rank):
+        x = np.full(512, float(rank + 1), np.float32)
+        ctx.allreduce(x, algorithm="hier", tag=1)
+        assert x[0] == 6.0, x[0]
+        algos = [e.get("algo") for e in ctx.flightrec()["events"]
+                 if e.get("op") == "allreduce"]
+        assert algos and algos[-1] != "hier", algos
+        ctx.barrier(algorithm="hier")
+        return True
+
+    assert all(spawn(3, fn))
+
+
+def test_hier_auto_election_from_tuned_table():
+    """A tuned table whose hier arm measures cheapest is elected by
+    plain kAuto on a non-flat topology (flight recorder shows the
+    resolved algorithm), and stays un-elected under TPUCOLL_HIER_AUTO=0
+    (subprocess arm)."""
+    table = {"version": 1, "entries": [
+        {"collective": "allreduce", "algorithm": "hier", "world_size": 4,
+         "dtype": "float32", "bucket": 12, "cost_us": 1.0},
+        {"collective": "allreduce", "algorithm": "ring", "world_size": 4,
+         "dtype": "float32", "bucket": 12, "cost_us": 1000.0},
+    ]}
+
+    def fn(ctx, rank):
+        from gloo_tpu import tuning
+        tuning.install_table(ctx, table)
+        x = np.full(1024, 1.0, np.float32)  # 4 KiB = bucket 12
+        ctx.allreduce(x, tag=1)
+        assert x[0] == 4.0
+        algos = [e.get("algo") for e in ctx.flightrec()["events"]
+                 if e.get("op") == "allreduce"]
+        assert algos[-1] == "hier", algos
+        return True
+
+    assert all(spawn_topo(4, 2, fn))
+
+    # TPUCOLL_HIER_AUTO=0: the hier arm leaves the electable set.
+    body = textwrap.dedent(f"""
+        import sys, threading
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        import gloo_tpu
+        from gloo_tpu import tuning
+        table = {table!r}
+        store = gloo_tpu.HashStore()
+        def worker(rank, errs):
+            try:
+                ctx = gloo_tpu.Context(rank, 4, timeout=30)
+                ctx.set_host_id("h%d" % (rank // 2))
+                ctx.connect_full_mesh(store, gloo_tpu.Device())
+                tuning.install_table(ctx, table)
+                x = np.full(1024, 1.0, np.float32)
+                ctx.allreduce(x, tag=1)
+                algos = [e.get("algo") for e in ctx.flightrec()["events"]
+                         if e.get("op") == "allreduce"]
+                assert algos[-1] != "hier", algos
+                ctx.close()
+            except BaseException as e:
+                errs.append((rank, e))
+        errs = []
+        ts = [threading.Thread(target=worker, args=(r, errs))
+              for r in range(4)]
+        [t.start() for t in ts]; [t.join(60) for t in ts]
+        assert not errs, errs
+        print("HIER-AUTO-OFF-OK")
+    """)
+    result = subprocess.run(
+        [sys.executable, "-c", body], capture_output=True, text=True,
+        timeout=180, env=dict(os.environ, TPUCOLL_HIER_AUTO="0"))
+    assert result.returncode == 0, (result.stdout, result.stderr[-2000:])
+    assert "HIER-AUTO-OFF-OK" in result.stdout
+
+
+def test_hier_failure_names_subgroup(tmp_path):
+    """A peer death mid-kHier surfaces TYPED with the failing phase, the
+    subgroup tag, and the subgroup->global rank map in the message."""
+    def fn(ctx, rank):
+        # One healthy pass first, so the hier sub-groups exist before
+        # the death (their creation is a collective of its own).
+        warm = np.ones(64, np.float32)
+        ctx.allreduce(warm, algorithm="hier", tag=1)
+        if rank == 3:
+            # Die mid-schedule: close the transport (and the split
+            # sub-meshes with it) under the other ranks' feet.
+            ctx.close()
+            return "closed"
+        try:
+            x = np.full(1 << 12, 1.0, np.float32)
+            ctx.allreduce(x, algorithm="hier", tag=2, timeout=5.0)
+        except gloo_tpu.IoError as e:
+            msg = str(e)
+            assert "hier allreduce" in msg, msg
+            assert "subgroup" in msg, msg
+            assert "->" in msg, msg  # the rank map
+            return "failed-typed"
+        return "no-error"
+
+    out = spawn_topo(4, 2, fn, timeout=60)
+    assert out[3] == "closed"
+    # rank 2 shares a host with the dead rank: its intra-host phase (or
+    # leader phase) must fail typed naming the subgroup.
+    assert out[2] == "failed-typed", out
+
+
+# ---------------------------------------------------------------------------
+# flightrec group partitioning (satellite)
+# ---------------------------------------------------------------------------
+
+def test_flightrec_groups_no_cross_group_desync(tmp_path):
+    """Two disjoint split groups legitimately run DIFFERENT schedules.
+    Partitioned by group tag (merge_by_tag), each analyzes clean; a
+    naive merge of the same docs WOULD report a desync — the regression
+    this partitioning exists to prevent."""
+    dumps = str(tmp_path)
+
+    def fn(ctx, rank):
+        sub = ctx.split(rank // 2, key=rank, tag=21)
+        if rank < 2:   # group A: allreduces
+            for i in range(4):
+                sub.allreduce(np.ones(64, np.float32), tag=i)
+        else:          # group B: broadcasts + barrier (different fps)
+            for i in range(3):
+                sub.broadcast(np.ones(32, np.float32), root=0, tag=i)
+            sub.barrier(tag=9)
+        tag = sub.group_tag().replace("/", ".")
+        sub.flightrec_dump(os.path.join(
+            dumps, f"flightrec-rank{sub.rank}-g{tag}.json"))
+        sub.close()
+        return sub.group_tag()
+
+    tags = spawn_topo(4, 2, fn)
+    groups = frmod.merge_by_tag(dumps)
+    assert len(groups) == 2, list(groups)
+    for tag, merged in groups.items():
+        verdict = frmod.analyze(merged)
+        assert verdict["kind"] == "ok", (tag, verdict)
+        assert not verdict["desync"], (tag, verdict)
+    # The control: comparing ACROSS the partitions reintroduces the
+    # false positive (rank r of A vs rank r of B ran different
+    # schedules, same cseq range — the fingerprints diverge).
+    tails = {}
+    for gi, merged in enumerate(groups.values()):
+        for r, doc in merged["ranks"].items():
+            tails[gi * 2 + r] = doc.get("events", [])
+    assert frmod.detect_desync(tails) is not None
+    # Dump docs carry the group tag.
+    assert all(doc.get("group") for m in groups.values()
+               for doc in m["ranks"].values())
+    # The CLI viewer partitions the same way and exits clean.
+    view = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "flightrec_view.py"),
+         dumps, "--check"], capture_output=True, text=True, timeout=60)
+    assert view.returncode == 0, (view.stdout, view.stderr)
+    assert "group" in view.stdout
+
+
+def test_metrics_group_label():
+    """Subgroup snapshots carry the group tag; the Prometheus exposition
+    labels every family with it."""
+    from gloo_tpu.utils.metrics import to_prometheus
+
+    def fn(ctx, rank):
+        sub = ctx.split_by_host(tag=31)
+        sub.allreduce(np.ones(32, np.float32))
+        snap = sub.metrics()
+        assert snap["group"] == sub.group_tag() != ""
+        expo = to_prometheus(snap)
+        assert f'group="{snap["group"]}"' in expo
+        # root context stays unlabeled
+        root_expo = to_prometheus(ctx.metrics())
+        assert 'group=' not in root_expo
+        sub.close()
+        return True
+
+    assert all(spawn_topo(4, 2, fn))

@@ -215,3 +215,43 @@ def test_hierarchical_cross_process():
     for (stdout, stderr), p in zip(outs, procs):
         assert p.returncode == 0, (stdout, stderr[-3000:])
         assert "HIER-OK" in stdout
+
+
+def test_hierarchical_host_plane_on_native_splits():
+    """ISSUE 13: the host plane runs on NATIVE splits. Four host
+    processes presenting as 2 simulated hosts x 2: HierarchicalGroup
+    routes its collectives through the native kHier schedules (intra-
+    host shm plane, leaders-only exchange) and exposes the intra-host /
+    leader sub-communicators via Context.split — no ad-hoc per-group
+    store bootstrap anywhere (the split's color exchange and subset
+    mesh ride the context's own rendezvous namespace)."""
+    from tests.test_group import spawn_topo
+
+    def fn(ctx, rank):
+        group = HierarchicalGroup(ctx, devices=[])
+        assert group._hier_algo == "hier"
+        # numpy path: the host hop IS the native hier allreduce.
+        out = group.allreduce(np.full(512, float(rank + 1), np.float32))
+        assert isinstance(out, np.ndarray) and out[0] == 10.0, out[0]
+        b = group.broadcast(np.full(16, float(rank), np.float32), root=3)
+        assert b[0] == 3.0
+        g = group.allgather(np.full(4, float(rank), np.float32))
+        assert g.shape == (4, 4) and g[2][0] == 2.0
+        group.barrier()
+        # native split planes, no side stores
+        local = group.local_group()
+        leaders = group.leader_group()
+        assert local.size == 2 and local.group_tag() != ""
+        x = np.full(8, 1.0, np.float32)
+        local.allreduce(x)
+        assert x[0] == 2.0
+        if ctx.topology()["is_leader"]:
+            assert leaders is not None and leaders.size == 2
+            y = np.full(8, 1.0, np.float32)
+            leaders.allreduce(y)
+            assert y[0] == 2.0
+        else:
+            assert leaders is None
+        return True
+
+    assert all(spawn_topo(4, 2, fn, timeout=90))

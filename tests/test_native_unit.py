@@ -315,3 +315,110 @@ def test_asan_smoke():
                             env=env)
     assert result.returncode == 0, (result.stdout, result.stderr)
     assert "ASAN-SMOKE-OK" in result.stdout, result.stdout
+
+
+def _sanitizer_env(runtime_names, lib, extra=None):
+    """LD_PRELOAD env for loading a sanitizer-flavored libtpucoll into an
+    uninstrumented interpreter (see test_asan_smoke for why libstdc++
+    must ride along)."""
+    preloads = []
+    for name in runtime_names:
+        p = subprocess.run(["g++", "-print-file-name=" + name],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{name} runtime not found beside g++")
+        preloads.append(p)
+    env = dict(os.environ, TPUCOLL_LIB=lib, TPUCOLL_SKIP_BUILD="1",
+               LD_PRELOAD=" ".join(preloads))
+    env.update(extra or {})
+    return env
+
+
+_SPLIT_HIER_PROG = f"""
+import sys, threading
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+
+size = 4
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.set_host_id("sanhost%d" % (rank // 2))
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        topo = ctx.topology()
+        assert topo["non_flat"], topo
+        sub = ctx.split_by_host(tag=3)
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        sub.allreduce(x)
+        z = np.full(4096, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(z, algorithm="hier", tag=5)
+        assert z[0] == 10.0, z[0]
+        ctx.barrier(algorithm="hier", tag=7)
+        sub.close()
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+[t.start() for t in threads]
+[t.join(180) for t in threads]
+assert not errors, errors
+print("SPLIT-HIER-SMOKE-OK")
+"""
+
+
+def test_asan_split_hier_smoke():
+    """Skip-unless-built ASan smoke driving the process-group subsystem
+    through the ctypes surface: topology discovery, split_by_host, a
+    subgroup allreduce, and a kHier allreduce + barrier at P=4 over a
+    simulated 2-host topology. Any ASan report aborts the child."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS":
+                          "detect_leaks=0,abort_on_error=1"})
+    result = subprocess.run([sys.executable, "-c", _SPLIT_HIER_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPLIT-HIER-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_split_hier_smoke():
+    """UBSan flavor of the split + kHier smoke (-fno-sanitize-recover:
+    the first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib)
+    result = subprocess.run([sys.executable, "-c", _SPLIT_HIER_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPLIT-HIER-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_split_hier_smoke():
+    """TSan flavor of the split + kHier smoke: four in-process ranks
+    exercising concurrent split bootstrap + hier phases is exactly the
+    shape that would expose a data race in the new topology/split
+    plumbing."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7"})
+    result = subprocess.run([sys.executable, "-c", _SPLIT_HIER_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPLIT-HIER-SMOKE-OK" in result.stdout, result.stdout

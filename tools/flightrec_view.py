@@ -39,44 +39,63 @@ def main() -> int:
                     help="exit 1 on stall, 2 on desync")
     args = ap.parse_args()
 
-    source = args.dumps[0] if (len(args.dumps) == 1
-                               and os.path.isdir(args.dumps[0])) else \
-        args.dumps
-    merged = flightrec.merge(source)
-    if not merged["ranks"]:
+    # A directory may hold dumps from several communicators — the root
+    # context plus split sub-groups (tagged -g<group>) and async lanes
+    # (-lane<k>). Each tag is an independent schedule: merge + analyze
+    # PER TAG, never across (disjoint groups legitimately run different
+    # collectives; comparing their fingerprints would invent a desync).
+    if len(args.dumps) == 1 and os.path.isdir(args.dumps[0]):
+        groups = flightrec.merge_by_tag(args.dumps[0])
+    else:
+        groups = {"": flightrec.merge(args.dumps)}
+    groups = {tag: m for tag, m in groups.items() if m["ranks"]}
+    if not groups:
         print("no usable dumps found", file=sys.stderr)
         return 1
 
-    print(f"ranks: {sorted(merged['ranks'])} of {merged['size']}"
-          + (f"  MISSING: {merged['missing']}" if merged["missing"] else ""))
-    for rank, doc in sorted(merged["ranks"].items()):
-        print(f"  rank {rank}: reason={doc.get('reason')} "
-              f"next_seq={doc.get('next_seq')} "
-              f"blamed_peer={doc.get('blamed_peer')} "
-              f"dropped={doc.get('dropped')}")
+    worst = 0
+    for tag, merged in groups.items():
+        label = f" [group {tag}]" if tag else ""
+        print(f"ranks{label}: {sorted(merged['ranks'])} of "
+              f"{merged['size']}"
+              + (f"  MISSING: {merged['missing']}"
+                 if merged["missing"] else ""))
+        for rank, doc in sorted(merged["ranks"].items()):
+            print(f"  rank {rank}: reason={doc.get('reason')} "
+                  f"next_seq={doc.get('next_seq')} "
+                  f"blamed_peer={doc.get('blamed_peer')} "
+                  f"dropped={doc.get('dropped')}")
 
-    print(f"\ntimeline (last {args.tail} of {len(merged['timeline'])}):")
-    for e in merged["timeline"][-args.tail:]:
-        print(f"  seq {e.get('seq'):>5}  rank {e.get('rank')}  "
-              f"{e.get('state', '?'):>9}  {flightrec.describe_event(e)}  "
-              f"slot={e.get('slot')} fp={e.get('fp')}")
+        print(f"\ntimeline{label} (last {args.tail} of "
+              f"{len(merged['timeline'])}):")
+        for e in merged["timeline"][-args.tail:]:
+            print(f"  seq {e.get('seq'):>5}  rank {e.get('rank')}  "
+                  f"{e.get('state', '?'):>9}  "
+                  f"{flightrec.describe_event(e)}  "
+                  f"slot={e.get('slot')} fp={e.get('fp')}")
 
-    verdict = flightrec.analyze(merged)
-    print(f"\nverdict: {verdict['kind'].upper()}")
-    print(f"  {verdict['message']}")
-    if verdict["blamed_ranks"]:
-        print(f"  blamed rank(s): {verdict['blamed_ranks']}")
-    for rank, f in sorted(verdict.get("frontier", {}).items()):
-        print(f"  rank {rank} frontier: seq {f['seq']} ({f['desc']}, "
-              f"{f['state']})")
+        verdict = flightrec.analyze(merged)
+        print(f"\nverdict{label}: {verdict['kind'].upper()}")
+        print(f"  {verdict['message']}")
+        if verdict["blamed_ranks"]:
+            print(f"  blamed rank(s): {verdict['blamed_ranks']}")
+        for rank, f in sorted(verdict.get("frontier", {}).items()):
+            print(f"  rank {rank} frontier: seq {f['seq']} ({f['desc']}, "
+                  f"{f['state']})")
+        print()
+        worst = max(worst,
+                    {"ok": 0, "stall": 1, "desync": 2}.get(
+                        verdict["kind"], 1))
 
-    if args.perfetto:
-        with open(args.perfetto, "w") as f:
-            f.write(flightrec.to_perfetto(merged))
-        print(f"\nwrote {args.perfetto} (open in ui.perfetto.dev)")
+        if args.perfetto:
+            out = args.perfetto if not tag else \
+                f"{args.perfetto}.{tag.replace('/', '.')}"
+            with open(out, "w") as f:
+                f.write(flightrec.to_perfetto(merged))
+            print(f"wrote {out} (open in ui.perfetto.dev)")
 
     if args.check:
-        return {"ok": 0, "stall": 1, "desync": 2}.get(verdict["kind"], 1)
+        return worst
     return 0
 
 
