@@ -37,9 +37,11 @@ crossover data the tuner's lossy arms and future rounds consume; add
 import json
 import os
 import re
+import signal
 import subprocess
 import sys
 import tempfile
+import textwrap
 import threading
 import time
 
@@ -410,6 +412,169 @@ def bench_latency(quick=False):
     }
     print(json.dumps(summary))
     return results + [summary]
+
+
+def bench_elastic_soak(seconds, quick=False):
+    """--elastic-soak N [--quick]: soak the elastic membership plane
+    (docs/elastic.md) for ~N seconds: three workers run a VERIFIED
+    mixed workload (allreduce at three sizes + allgather, every result
+    checked against its closed form for the CURRENT size) under
+    run_elastic while this driver periodically SIGKILLs a live worker
+    and respawns a replacement with join=True. No worker ever calls a
+    rebuild — every transition is lease-detected, epoch-agreed, and
+    auto-recovered. Prints ONE JSON line:
+
+      {"metric": "elastic_soak_3rank_host", "value": <epochs reached>,
+       "unit": "epochs", "seconds": N, "kills": k, "rejoins": k,
+       "steps": <verified steps across final workers>,
+       "rebuild_ms_p50": ..., "rebuild_ms_p99": ...,
+       "lease_ms": 200, "lease_grace_ms": 1200, "ok": true}
+
+    rebuild latency = EpochChanged caught -> successor mesh bound, per
+    transition per worker (the detect half is bounded separately by the
+    lease grace). --quick: one kill/rejoin cycle sized for CI smoke.
+    """
+    repo = os.path.dirname(os.path.abspath(__file__))
+    store_dir = tempfile.mkdtemp()
+    world = 3
+    env = dict(os.environ, TPUCOLL_LEASE_MS="200",
+               TPUCOLL_LEASE_GRACE="1200")
+
+    body = textwrap.dedent("""
+        import json, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+        from gloo_tpu import elastic
+
+        rank = int(sys.argv[1])
+        join = sys.argv[2] == "join"
+        store = gloo_tpu.FileStore({store!r})
+        SIZES = (1 << 12, 1 << 14, 1 << 16)
+
+        def step_fn(ectx, step, state):
+            flag = np.zeros(1, dtype=np.float32)
+            if ectx.rank == 0:
+                try:
+                    store.get("soak_stop", timeout=0.001)
+                    flag[0] = 1.0
+                except gloo_tpu.Error:
+                    pass
+            ectx.allreduce(flag, tag=0)
+            if flag[0] > 0:
+                raise StopIteration
+            n = ectx.size
+            x = np.full(SIZES[state["i"] % 3], float(ectx.rank + 1),
+                        dtype=np.float32)
+            ectx.allreduce(x, tag=1)
+            assert x[0] == n * (n + 1) / 2, (state["i"], x[0], n)
+            g = np.full(256, float(ectx.rank), dtype=np.float32)
+            out = ectx.allgather(g, tag=2)
+            assert [int(out[r][0]) for r in range(n)] == list(range(n))
+            state["i"] += 1
+            return state
+
+        res = elastic.run_elastic(
+            step_fn, store=store, device=gloo_tpu.Device(), rank=rank,
+            world_size={world}, min_size=2, join=join,
+            state={{"i": 0}}, timeout=120.0)
+        res.pop("state")
+        print("OK", json.dumps(res))
+    """).format(repo=repo, store=store_dir, world=world)
+
+    def spawn(rank, join=False):
+        return subprocess.Popen(
+            [sys.executable, "-c", body, str(rank),
+             "join" if join else "found"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+
+    procs = [spawn(r) for r in range(world)]
+    kills = 1 if quick else max(1, int(seconds // 10))
+    period = max(5.0, seconds / (kills + 1))
+    deadline = time.monotonic() + seconds
+    done_kills = 0
+    rng = __import__("random").Random(14)
+    try:
+        while time.monotonic() < deadline and done_kills < kills:
+            time.sleep(min(period, max(0.0, deadline - time.monotonic())))
+            live = [p for p in procs if p.poll() is None]
+            if done_kills >= kills or len(live) < world:
+                continue
+            victim = rng.choice(live)
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(timeout=30)
+            done_kills += 1
+            time.sleep(1.0)
+            procs.append(spawn(100 + done_kills, join=True))
+            print(f"[elastic-soak] kill #{done_kills} -> respawned joiner",
+                  file=sys.stderr)
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+    finally:
+        # Consensus stop: the current rank 0 folds the key into the
+        # next step's flag allreduce, so every worker exits at the
+        # same step boundary.
+        import gloo_tpu
+
+        gloo_tpu.FileStore(store_dir).set("soak_stop", b"1")
+
+    summaries = []
+    ok = True
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            ok = False
+            print(f"[elastic-soak] worker hung: {err[-400:]!r}",
+                  file=sys.stderr)
+            continue
+        if p.returncode == -signal.SIGKILL:
+            continue  # a driver-killed victim
+        if p.returncode != 0:
+            ok = False
+            print(f"[elastic-soak] worker rc={p.returncode}: "
+                  f"{err[-400:]!r}", file=sys.stderr)
+            continue
+        line = [ln for ln in out.splitlines() if ln.startswith("OK ")]
+        if not line:
+            ok = False
+            continue
+        summaries.append(json.loads(line[0][3:]))
+
+    ok = ok and len(summaries) == world  # full size at the end
+    rebuild_ms = sorted(ms for s in summaries for ms in s["rebuild_ms"])
+    epochs = max((e["epoch"] for s in summaries for e in s["epochs"]),
+                 default=0)
+    sizes_ok = all(e["size"] >= 2 for s in summaries
+                   for e in s["epochs"])
+
+    def pct(q):
+        if not rebuild_ms:
+            return None
+        return rebuild_ms[min(len(rebuild_ms) - 1,
+                              int(q * (len(rebuild_ms) - 1) + 0.5))]
+
+    line = {
+        "metric": "elastic_soak_3rank_host",
+        "value": epochs,
+        "unit": "epochs",
+        "seconds": seconds,
+        "kills": done_kills,
+        "rejoins": done_kills,
+        "steps": sum(s["steps"] for s in summaries),
+        "rebuilds": sum(s["rebuilds"] for s in summaries),
+        "rebuild_ms_p50": pct(0.50),
+        "rebuild_ms_p99": pct(0.99),
+        "lease_ms": 200,
+        "lease_grace_ms": 1200,
+        "ok": bool(ok and sizes_ok and epochs >= 1 + 2 * done_kills),
+    }
+    print(json.dumps(line))
+    if not line["ok"]:
+        sys.exit(1)
 
 
 def bench_chaos_soak(seconds):
@@ -1087,6 +1252,13 @@ def main():
         return
     if "--hier-sweep" in sys.argv[1:]:
         bench_hier_sweep(quick="--quick" in sys.argv[1:])
+        return
+    if "--elastic-soak" in sys.argv[1:]:
+        i = sys.argv.index("--elastic-soak") + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            sys.exit("--elastic-soak requires a duration (seconds)")
+        bench_elastic_soak(float(sys.argv[i]),
+                           quick="--quick" in sys.argv[1:])
         return
     if "--chaos-soak" in sys.argv[1:]:
         i = sys.argv.index("--chaos-soak") + 1

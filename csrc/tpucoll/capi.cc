@@ -10,6 +10,7 @@
 #include <deque>
 #include <memory>
 #include <mutex>
+#include <sstream>
 #include <string>
 #include <unordered_map>
 
@@ -22,7 +23,9 @@
 #include "tpucoll/transport/loop_uring.h"
 #include "tpucoll/transport/wire.h"
 #include "tpucoll/common/crypto.h"
+#include "tpucoll/common/json.h"
 #include "tpucoll/common/keyring.h"
+#include "tpucoll/elastic/elastic.h"
 #include "tpucoll/rendezvous/file_store.h"
 #include "tpucoll/rendezvous/hash_store.h"
 #include "tpucoll/rendezvous/store.h"
@@ -127,6 +130,9 @@ StoreHandle* asStore(void* h) { return static_cast<StoreHandle*>(h); }
 DeviceHandle* asDevice(void* h) { return static_cast<DeviceHandle*>(h); }
 Context* asContext(void* h) { return static_cast<Context*>(h); }
 UnboundBuffer* asBuffer(void* h) { return static_cast<UnboundBuffer*>(h); }
+tpucoll::elastic::ElasticAgent* asElastic(void* h) {
+  return static_cast<tpucoll::elastic::ElasticAgent*>(h);
+}
 
 template <typename Opts>
 void fillCommon(Opts& opts, Context* ctx, uint32_t tag, int64_t timeoutMs) {
@@ -329,6 +335,32 @@ void tc_buf_free(uint8_t* buf) { wrapVoid([&] { free(buf); }); }
 int tc_store_add(void* store, const char* key, int64_t delta,
                  int64_t* result) {
   return wrap([&] { *result = (*asStore(store))->add(key, delta); });
+}
+
+// Remove a key; *deleted = 1 when it existed. Namespace hygiene (lease
+// reaping, retired rebuild/epoch namespaces — docs/rendezvous.md).
+int tc_store_delete(void* store, const char* key, int* deleted) {
+  return wrap([&] {
+    *deleted = (*asStore(store))->deleteKey(key) ? 1 : 0;
+  });
+}
+
+// Keys currently present under `prefix`, as a JSON array of strings
+// (malloc'd; free with tc_buf_free). Snapshot semantics only.
+int tc_store_list(void* store, const char* prefix, uint8_t** out,
+                  size_t* outLen) {
+  return wrap([&] {
+    std::ostringstream json;
+    json << "[";
+    bool first = true;
+    for (const auto& key : (*asStore(store))->listKeys(prefix)) {
+      json << (first ? "" : ",");
+      tpucoll::appendJsonString(json, key);
+      first = false;
+    }
+    json << "]";
+    copyOut(json.str(), out, outLen);
+  });
 }
 
 // ---- device / context ----
@@ -675,6 +707,92 @@ int tc_tuning_json(void* ctx, uint8_t** out, size_t* outLen) {
     copyOut(table != nullptr ? table->toJson() : std::string(), out,
             outLen);
   });
+}
+
+// ---- elastic membership plane (elastic/elastic.h) ----
+
+// Create AND start an elastic agent: publishes this worker's lease
+// (renewed by a background heartbeat thread every TPUCOLL_LEASE_MS),
+// founds epoch 1 (rank 0, join == 0) or enqueues on the join queue
+// (join != 0; `rank` is then ignored and a fresh worker id is drawn),
+// and starts the membership monitor. `hostId` (nullable) overrides
+// topology discovery for rebuilt meshes; `timeoutMs` bounds document
+// waits and the default rebuild/collective timeout. NULL +
+// tc_last_error on failure.
+void* tc_elastic_new(void* store, void* device, int rank, int worldSize,
+                     int minSize, int join, const char* hostId,
+                     int64_t timeoutMs) {
+  return wrapPtr([&]() -> void* {
+    tpucoll::elastic::AgentOptions opts;
+    opts.rank = rank;
+    opts.worldSize = worldSize;
+    opts.minSize = minSize;
+    opts.join = join != 0;
+    if (hostId != nullptr) {
+      opts.hostId = hostId;
+    }
+    if (timeoutMs > 0) {
+      opts.timeout = ms(timeoutMs);
+    }
+    return new tpucoll::elastic::ElasticAgent(*asStore(store),
+                                              *asDevice(device), opts);
+  });
+}
+
+// Build the communicator for the CURRENT head epoch and bind it as the
+// agent's monitored context. *out is a full Context handle owned by the
+// caller (tc_context_free it — but only AFTER a later tc_elastic_rebuild
+// or tc_elastic_stop has unbound it). Typed failures: TC_ERR_TIMEOUT
+// past `timeoutMs` (<= 0 uses the agent default), TC_ERR_IO "evicted" /
+// "below min_size".
+int tc_elastic_rebuild(void* agent, int64_t timeoutMs, void** out) {
+  return wrap([&] {
+    *out = asElastic(agent)->rebuild(ms(timeoutMs)).release();
+  });
+}
+
+// Publish hard failure evidence ({"suspect_wid": w|-1, ...}) for the
+// bound epoch; the coordinator folds it into the next membership bump.
+int tc_elastic_note_failure(void* agent, const char* evidenceJson) {
+  return wrap([&] {
+    TC_ENFORCE(evidenceJson != nullptr && evidenceJson[0] != '\0',
+               "tc_elastic_note_failure: empty evidence");
+    asElastic(agent)->noteFailure(evidenceJson);
+  });
+}
+
+// Graceful leave: stop the heartbeat + monitor threads and delete this
+// worker's lease (peers observe an immediate departure). Idempotent.
+int tc_elastic_stop(void* agent) {
+  return wrap([&] { asElastic(agent)->stop(); });
+}
+
+void tc_elastic_free(void* agent) {
+  wrapVoid([&] { delete asElastic(agent); });
+}
+
+// Epoch of the bound context (0 before the first rebuild).
+uint64_t tc_elastic_epoch(void* agent) {
+  return wrapVal<uint64_t>(0, [&] { return asElastic(agent)->boundEpoch(); });
+}
+
+// Latest published epoch this agent has observed.
+uint64_t tc_elastic_head_epoch(void* agent) {
+  return wrapVal<uint64_t>(0, [&] { return asElastic(agent)->headEpoch(); });
+}
+
+// 1 when the membership moved past the bound context's epoch (the bound
+// collective surface is — or is about to be — poisoned); 0 otherwise.
+int tc_elastic_poll(void* agent) {
+  return wrapVal(0, [&] {
+    return asElastic(agent)->epochChanged() ? 1 : 0;
+  });
+}
+
+// Agent status document (the metrics()["elastic"] payload —
+// docs/observability.md); malloc'd, free with tc_buf_free.
+int tc_elastic_status_json(void* agent, uint8_t** out, size_t* outLen) {
+  return wrap([&] { copyOut(asElastic(agent)->statusJson(), out, outLen); });
 }
 
 // ---- deterministic fault-injection plane (fault/) ----

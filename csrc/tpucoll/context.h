@@ -31,6 +31,18 @@ namespace plan {
 class PlanCache;
 }  // namespace plan
 
+class Context;
+
+namespace elastic {
+class ElasticAgent;
+// See elastic/elastic.h — declared here so Context can befriend it.
+std::unique_ptr<Context> buildEpochContext(
+    std::shared_ptr<Store> store, std::shared_ptr<transport::Device> device,
+    int newRank, int newSize, uint64_t epoch, const std::string& hostId,
+    std::shared_ptr<const tuning::TuningTable> table,
+    std::chrono::milliseconds timeout);
+}  // namespace elastic
+
 class Context {
  public:
   static constexpr std::chrono::milliseconds kDefaultTimeout =
@@ -110,6 +122,22 @@ class Context {
   // one leader per host (null on non-leaders). Creation is a collective
   // over this context (reserved split tags); single-flight per context.
   void hierGroups(Context** local, Context** leaders);
+
+  // ---- elastic membership plane (elastic/elastic.h) ----
+  // Build THE successor communicator this group continues as in
+  // `epoch` after a membership change: `members` lists the surviving
+  // ranks of THIS context (ascending; this rank must be listed), the
+  // child takes fresh contiguous ranks in that order, bootstraps a
+  // members-only mesh under the epoch-scoped store namespace
+  // ("tpucoll/elastic/e<epoch>/mesh/..."), carries group tag
+  // "e<epoch>" (epoch-tagged flight recorder, metrics and fault
+  // domain), and inherits the installed tuning table, host id and
+  // timeout. Requires a store-backed context; every member must call
+  // with the same arguments. ElasticAgent drives this machinery
+  // automatically (lease-detected membership); defined in
+  // elastic/elastic.cc.
+  std::unique_ptr<Context> rebuild(const std::vector<int>& members,
+                                   uint64_t epoch);
 
   // Bootstrap the full mesh over a rendezvous store. Call once.
   void connectFullMesh(std::shared_ptr<Store> store,
@@ -211,6 +239,15 @@ class Context {
   void close();
 
  private:
+  // The elastic agent builds epoch-successor contexts from scratch
+  // (joiners have no prior Context to call rebuild() on) and needs the
+  // same pre-connect hooks rebuild() uses (hostId_, applyGroupTag).
+  friend class elastic::ElasticAgent;
+  friend std::unique_ptr<Context> elastic::buildEpochContext(
+      std::shared_ptr<Store>, std::shared_ptr<transport::Device>, int, int,
+      uint64_t, const std::string&,
+      std::shared_ptr<const tuning::TuningTable>, std::chrono::milliseconds);
+
   // Exchange host fingerprints through the store and install the
   // resulting Topology + shm-reachability mask on the transport (must
   // run after tctx_ exists, before it connects).

@@ -1,0 +1,818 @@
+// Elastic membership plane (see elastic.h for the protocol). Lives in
+// its own subsystem directory because it composes layers that must not
+// know about each other: the rendezvous store (leases, epoch documents),
+// the process-group bootstrap (members-only epoch meshes), and the
+// post-mortem planes (fault evidence feeding membership decisions).
+#include "tpucoll/elastic/elastic.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <utility>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/tuning/tuning_table.h"
+
+namespace tpucoll {
+namespace elastic {
+
+namespace {
+
+constexpr const char* kNs = "tpucoll/elastic/";
+
+std::string epochPrefix(uint64_t epoch) {
+  return std::string(kNs) + "e" + std::to_string(epoch) + "/";
+}
+
+Store::Buf packCounter(uint64_t v) {
+  Store::Buf buf(sizeof(v));
+  std::memcpy(buf.data(), &v, sizeof(v));
+  return buf;
+}
+
+uint64_t unpackCounter(const Store::Buf& buf) {
+  uint64_t v = 0;
+  std::memcpy(&v, buf.data(), std::min(buf.size(), sizeof(v)));
+  return v;
+}
+
+// Lease/doc reads poll with short bounded gets: a missing key must
+// return control to the monitor loop, never park it for the full
+// default store timeout.
+constexpr std::chrono::milliseconds kProbeTimeout{50};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Epoch-successor construction (shared by Context::rebuild and the agent)
+// ---------------------------------------------------------------------------
+
+std::unique_ptr<Context> buildEpochContext(
+    std::shared_ptr<Store> store, std::shared_ptr<transport::Device> device,
+    int newRank, int newSize, uint64_t epoch, const std::string& hostId,
+    std::shared_ptr<const tuning::TuningTable> table,
+    std::chrono::milliseconds timeout) {
+  TC_ENFORCE(store != nullptr, "elastic rebuild: no store");
+  TC_ENFORCE(device != nullptr, "elastic rebuild: no device");
+  auto ctx = std::make_unique<Context>(newRank, newSize);
+  ctx->setTimeout(timeout);
+  ctx->hostId_ = hostId;
+  // Group tag "e<epoch>": scopes post-bootstrap store keys, stamps the
+  // flight recorder (dumps go to flightrec-rank<r>-ge<N>.json and the
+  // documents carry "group":"e<N>"), the metrics "group" field, and a
+  // deterministic fault-plane domain — the whole post-mortem identity
+  // of the epoch.
+  ctx->applyGroupTag("e" + std::to_string(epoch));
+  if (table != nullptr) {
+    ctx->setTuningTable(std::move(table));
+  }
+  auto prefix = std::make_shared<PrefixStore>(
+      std::move(store), epochPrefix(epoch) + "mesh");
+  ctx->connectFullMesh(std::move(prefix), std::move(device));
+  return ctx;
+}
+
+}  // namespace elastic
+
+std::unique_ptr<Context> Context::rebuild(const std::vector<int>& members,
+                                          uint64_t epoch) {
+  TC_ENFORCE(store_ != nullptr,
+             "rebuild: store-less (forked) context cannot re-rendezvous");
+  TC_ENFORCE(!members.empty(), "rebuild: empty member list");
+  TC_ENFORCE(std::is_sorted(members.begin(), members.end()),
+             "rebuild: members must be sorted ascending");
+  auto it = std::find(members.begin(), members.end(), rank_);
+  TC_ENFORCE(it != members.end(), "rebuild: rank ", rank_,
+             " is not in the member list");
+  const int newRank = static_cast<int>(it - members.begin());
+  return elastic::buildEpochContext(
+      store_, device_, newRank, static_cast<int>(members.size()), epoch,
+      hostId_, tuningTable(), timeout_);
+}
+
+namespace elastic {
+
+// ---------------------------------------------------------------------------
+// ElasticAgent
+// ---------------------------------------------------------------------------
+
+ElasticAgent::ElasticAgent(std::shared_ptr<Store> store,
+                           std::shared_ptr<transport::Device> device,
+                           const AgentOptions& opts)
+    : store_(std::move(store)),
+      device_(std::move(device)),
+      opts_(opts),
+      leaseMs_(envCount("TPUCOLL_LEASE_MS", 500, 50, 60000)),
+      graceMs_(envCount("TPUCOLL_LEASE_GRACE", 3000, 100, 600000)),
+      pollMs_(std::max(20L, std::min(500L, leaseMs_ / 2))) {
+  TC_ENFORCE(store_ != nullptr, "elastic: no store");
+  TC_ENFORCE(device_ != nullptr, "elastic: no device");
+  TC_ENFORCE_GE(graceMs_, 2 * leaseMs_,
+                "TPUCOLL_LEASE_GRACE must be at least 2x TPUCOLL_LEASE_MS "
+                "(a single delayed renewal must not read as a death)");
+  TC_ENFORCE_GT(opts_.worldSize, 0, "elastic: world size must be positive");
+  TC_ENFORCE_GT(opts_.minSize, 0, "elastic: min size must be positive");
+  TC_ENFORCE_LE(opts_.minSize, opts_.worldSize,
+                "elastic: min size exceeds the target world size");
+
+  const auto deadline = std::chrono::steady_clock::now() + opts_.timeout;
+  if (!opts_.join) {
+    TC_ENFORCE(opts_.rank >= 0 && opts_.rank < opts_.worldSize,
+               "elastic: rank ", opts_.rank, " out of range for world size ",
+               opts_.worldSize);
+    wid_ = opts_.rank;
+    heartbeatOnce();
+    if (opts_.rank == 0) {
+      // Found epoch 1. The claim keeps a restarted rank 0 from
+      // re-founding over a live job's document.
+      if (store_->add(epochPrefix(1) + "claim", 1) == 1) {
+        std::vector<int64_t> members(opts_.worldSize);
+        for (int r = 0; r < opts_.worldSize; r++) {
+          members[r] = r;
+        }
+        store_->set(epochPrefix(1) + "doc",
+                    [&] {
+                      const std::string doc = docJson(1, members, "found");
+                      return Store::Buf(doc.begin(), doc.end());
+                    }());
+        store_->add(std::string(kNs) + "head", 1);
+      }
+    }
+  } else {
+    // Joiner: allocate a never-reused wid above the founding range,
+    // start heartbeating, then enqueue. The lease must exist BEFORE the
+    // join key: the coordinator only admits joiners it can see alive.
+    wid_ = opts_.worldSize - 1 + store_->add(std::string(kNs) + "nextwid", 1);
+    heartbeatOnce();
+    store_->set(std::string(kNs) + "join/" + std::to_string(wid_),
+                Store::Buf{1});
+  }
+
+  // Wait for the first visible epoch document (founders: epoch 1;
+  // joiners: whatever the job is at).
+  while (true) {
+    refreshHead();  // best-effort: a doc still in flight retries below
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      if (headEpoch_ >= 1) {
+        break;
+      }
+    }
+    TC_ENFORCE(std::chrono::steady_clock::now() < deadline,
+               "elastic: no epoch document appeared within ",
+               opts_.timeout.count(), "ms — is rank 0 (the founder) up?");
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+
+  heartbeat_ = std::thread([this] { heartbeatLoop(); });
+  monitor_ = std::thread([this] { monitorLoop(); });
+}
+
+ElasticAgent::~ElasticAgent() {
+  try {
+    stop();
+  } catch (...) {
+    // Destructor boundary: a store that died under us must not abort.
+  }
+}
+
+std::string ElasticAgent::k(const std::string& suffix) const {
+  return std::string(kNs) + suffix;
+}
+
+std::string ElasticAgent::leaseKey(int64_t wid) const {
+  return std::string(kNs) + "lease/" + std::to_string(wid);
+}
+
+int64_t ElasticAgent::nowMs() const {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void ElasticAgent::heartbeatOnce() {
+  // Relaxed: the counter's only job is to CHANGE between renewals;
+  // observers compare values, never order against other memory.
+  const uint64_t beat =
+      heartbeatCounter_.fetch_add(1, std::memory_order_relaxed) + 1;
+  store_->set(leaseKey(wid_), packCounter(beat));
+  leasesRenewed_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ElasticAgent::heartbeatLoop() {
+  while (!stop_.load(std::memory_order_relaxed)) {
+    try {
+      heartbeatOnce();
+    } catch (const std::exception& e) {
+      // A store hiccup must not kill the renewal thread: peers only
+      // declare us dead after a full grace of NO renewals.
+      TC_WARN("elastic: lease renewal failed (wid ", wid_, "): ", e.what());
+    }
+    std::unique_lock<std::mutex> lk(sleepMu_);
+    sleepCv_.wait_for(lk, std::chrono::milliseconds(leaseMs_), [&] {
+      return stop_.load(std::memory_order_relaxed);
+    });
+  }
+}
+
+void ElasticAgent::monitorLoop() {
+  while (!stop_.load(std::memory_order_relaxed)) {
+    try {
+      monitorOnce();
+    } catch (const std::exception& e) {
+      TC_DEBUG("elastic: monitor pass failed (wid ", wid_, "): ", e.what());
+    }
+    std::unique_lock<std::mutex> lk(sleepMu_);
+    sleepCv_.wait_for(lk, std::chrono::milliseconds(pollMs_), [&] {
+      return stop_.load(std::memory_order_relaxed);
+    });
+  }
+}
+
+void ElasticAgent::installDoc(uint64_t epoch, const std::string& raw) {
+  JsonReader reader(raw, "elastic epoch document");
+  auto doc = reader.parse();
+  const auto* membersField = doc.field("members");
+  TC_ENFORCE(membersField != nullptr &&
+                 membersField->kind == JsonReader::Value::Kind::kArray,
+             "elastic epoch document: missing members array");
+  std::vector<int64_t> members;
+  members.reserve(membersField->items.size());
+  for (const auto& item : membersField->items) {
+    TC_ENFORCE(item.kind == JsonReader::Value::Kind::kNumber,
+               "elastic epoch document: non-numeric member");
+    members.push_back(static_cast<int64_t>(item.number));
+  }
+  TC_ENFORCE(!members.empty(), "elastic epoch document: empty membership");
+
+  std::lock_guard<std::mutex> guard(mu_);
+  if (epoch <= headEpoch_) {
+    return;  // raced another installer
+  }
+  headEpoch_ = epoch;
+  members_ = std::move(members);
+  if (boundCtx_ != nullptr && boundEpoch_ < epoch &&
+      closedEpoch_ != boundEpoch_) {
+    closedEpoch_ = boundEpoch_;
+    TC_INFO("elastic: epoch moved to ", epoch, " — closing the epoch-",
+            boundEpoch_, " context (in-flight collectives fail typed)");
+    // Closed while HOLDING mu_: the owner's rebuild() unbinds under the
+    // same mutex before the context can be freed, so the pointer cannot
+    // die under this close. Context::close never re-enters the agent,
+    // so the nesting cannot deadlock; statusJson briefly blocks, which
+    // is acceptable on an epoch transition.
+    boundCtx_->close();
+  }
+}
+
+void ElasticAgent::refreshHead() {
+  const uint64_t head =
+      static_cast<uint64_t>(store_->add(k("head"), 0));
+  uint64_t observed;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    observed = headEpoch_;
+  }
+  if (head <= observed) {
+    return;
+  }
+  // Catch up one document at a time, best-effort with SHORT probes:
+  // an intermediate epoch's doc may be reaped (skip it), and the head
+  // epoch's doc may not have landed yet — publication in flight, or a
+  // transient counter overshoot from a raced head repair — in which
+  // case we simply return and the next poll retries. Blocking or
+  // throwing here would starve the rest of the monitor pass (liveness
+  // scans, bump publication) behind a store state that only ever
+  // resolves via those very passes.
+  for (uint64_t e = observed + 1; e <= head; e++) {
+    Store::Buf raw;
+    try {
+      raw = store_->get(epochPrefix(e) + "doc", kProbeTimeout);
+    } catch (const TimeoutException&) {
+      if (e == head) {
+        return;  // not published yet; next poll catches it
+      }
+      continue;  // reaped intermediate epoch
+    }
+    installDoc(e, std::string(raw.begin(), raw.end()));
+  }
+}
+
+std::string ElasticAgent::docJson(uint64_t epoch,
+                                  const std::vector<int64_t>& members,
+                                  const char* cause) {
+  std::ostringstream out;
+  out << "{\"epoch\":" << epoch << ",\"members\":[";
+  for (size_t i = 0; i < members.size(); i++) {
+    out << (i == 0 ? "" : ",") << members[i];
+  }
+  out << "],\"cause\":\"" << cause << "\"}";
+  return out.str();
+}
+
+bool ElasticAgent::publishEpoch(uint64_t target,
+                                const std::vector<int64_t>& members,
+                                const char* cause,
+                                const std::vector<int64_t>& dead,
+                                const std::vector<int64_t>& admitted) {
+  const std::string docKey = epochPrefix(target) + "doc";
+  const bool docAlready = store_->check({docKey});
+  if (!docAlready && store_->add(epochPrefix(target) + "claim", 1) != 1) {
+    // Another monitor claimed this epoch. If its document never lands
+    // (claimant died between claim and publish), take over after a
+    // grace: by then the claimant's own lease has expired, so at most
+    // one OTHER live monitor believes it is the coordinator.
+    if (pendingClaimEpoch_ != target) {
+      pendingClaimEpoch_ = target;
+      pendingClaimSinceMs_ = nowMs();
+      return false;
+    }
+    if (nowMs() - pendingClaimSinceMs_ < graceMs_ ||
+        store_->check({docKey})) {
+      // Document landed (or will shortly): fall through to the head
+      // repair below rather than returning — a claimant that died
+      // BETWEEN set(doc) and the head bump must not wedge the plane.
+      if (!store_->check({docKey})) {
+        return false;
+      }
+    } else {
+      TC_WARN("elastic: epoch ", target, " claimant never published — "
+              "taking over (wid ", wid_, ")");
+    }
+  }
+  pendingClaimEpoch_ = 0;
+  // Re-check before writing: the document is immutable once present
+  // (a claimant paused past the takeover grace that revives here must
+  // not overwrite the takeover's document with a divergent member
+  // list; the remaining check-then-set window is one store round trip
+  // wide and converges through the evidence path).
+  if (!store_->check({docKey})) {
+    store_->set(docKey, [&] {
+      const std::string doc = docJson(target, members, cause);
+      return Store::Buf(doc.begin(), doc.end());
+    }());
+  }
+  // Head bump, exactly once per epoch regardless of who dies where:
+  // the doc-set and the head increment are two store writes, so the
+  // bump rides its own single-winner claim ("headbump"), and the
+  // winner verifies head == target - 1 first — a stale reviver whose
+  // epoch was already counted (or reaped) skips, while a genuine
+  // repair (claimant died between doc and bump) lands it.
+  if (store_->add(epochPrefix(target) + "headbump", 1) == 1 ||
+      static_cast<uint64_t>(store_->add(k("head"), 0)) < target) {
+    if (static_cast<uint64_t>(store_->add(k("head"), 0)) == target - 1) {
+      store_->add(k("head"), 1);
+    }
+  }
+  TC_INFO("elastic: published epoch ", target, " (", cause, "), ",
+          members.size(), " member(s)");
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    bumpsPublished_++;
+  }
+  // ---- reap: leases of the departed, consumed join requests, the
+  // evidence that drove this bump, and the retired e<target-2>
+  // namespace (whose mesh bootstrap blobs are the bulk of the keys).
+  for (int64_t w : dead) {
+    store_->deleteKey(leaseKey(w));
+  }
+  for (int64_t w : admitted) {
+    store_->deleteKey(k("join/" + std::to_string(w)));
+  }
+  for (const auto& key : store_->listKeys(epochPrefix(target - 1) + "fail/")) {
+    store_->deleteKey(key);
+  }
+  if (target >= 3) {
+    for (const auto& key : store_->listKeys(epochPrefix(target - 2))) {
+      store_->deleteKey(key);
+    }
+  }
+  return true;
+}
+
+void ElasticAgent::monitorOnce() {
+  refreshHead();
+
+  uint64_t H;
+  std::vector<int64_t> members;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    H = headEpoch_;
+    members = members_;
+  }
+  if (std::find(members.begin(), members.end(), wid_) == members.end()) {
+    return;  // join pending or evicted: nothing to monitor yet
+  }
+  // Epoch moved since the last pass: reset the monitor-local state
+  // (this thread is its only toucher — installDoc runs on app threads
+  // too and must not reach into it). Departed wids lose their lease
+  // observations so a later same-wid entry never inherits a stale
+  // change timestamp.
+  if (monitorStateEpoch_ != H) {
+    monitorStateEpoch_ = H;
+    for (auto it = leases_.begin(); it != leases_.end();) {
+      if (std::find(members.begin(), members.end(), it->first) ==
+          members.end()) {
+        it = leases_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    evidenceFirstMs_ = 0;
+    pendingClaimEpoch_ = 0;
+  }
+
+  // ---- liveness: change observation on every other member's lease ----
+  const int64_t now = nowMs();
+  std::vector<int64_t> dead;
+  for (int64_t w : members) {
+    if (w == wid_) {
+      continue;
+    }
+    LeaseObs& obs = leases_[w];
+    if (obs.lastChangeMs == 0) {
+      obs.lastChangeMs = now;  // first observation of this member
+    }
+    if (!store_->check({leaseKey(w)})) {
+      if (obs.seen) {
+        dead.push_back(w);  // deleted lease: graceful leave, no grace
+      } else if (now - obs.lastChangeMs > graceMs_) {
+        dead.push_back(w);  // admitted but never heartbeated
+      }
+      continue;
+    }
+    const uint64_t value =
+        unpackCounter(store_->get(leaseKey(w), kProbeTimeout));
+    if (!obs.seen || value != obs.value) {
+      obs.seen = true;
+      obs.value = value;
+      obs.lastChangeMs = now;
+    } else if (now - obs.lastChangeMs > graceMs_) {
+      dead.push_back(w);
+    }
+  }
+
+  // ---- hard failure evidence published by survivors -----------------
+  const std::string failPrefix = epochPrefix(H) + "fail/";
+  std::vector<std::string> failKeys = store_->listKeys(failPrefix);
+  if (failKeys.empty()) {
+    evidenceFirstMs_ = 0;
+  } else if (evidenceFirstMs_ == 0) {
+    evidenceFirstMs_ = now;
+  }
+
+  // ---- only the coordinator (lowest LIVE wid) publishes -------------
+  int64_t lowestLive = -1;
+  for (int64_t w : members) {
+    if (std::find(dead.begin(), dead.end(), w) == dead.end()) {
+      lowestLive = w;
+      break;
+    }
+  }
+  if (lowestLive != wid_) {
+    return;
+  }
+
+  if (!dead.empty()) {
+    // Death bump: survivors only. Evidence is subsumed (the fresh mesh
+    // excludes the dead) and strikes reset with the new membership.
+    std::vector<int64_t> next;
+    for (int64_t w : members) {
+      if (std::find(dead.begin(), dead.end(), w) == dead.end()) {
+        next.push_back(w);
+      }
+    }
+    if (!next.empty() &&
+        publishEpoch(H + 1, next, "lease_expired", dead, {})) {
+      strikes_.clear();
+    }
+    return;
+  }
+
+  if (!failKeys.empty() && now - evidenceFirstMs_ > graceMs_) {
+    // Evidence with every lease alive: a broken link / poisoned mesh,
+    // not a death. Wait one grace first — a SIGKILL's EOF evidence
+    // arrives before its lease expires, and the death bump above is the
+    // better (smaller) transition. Then rebuild with the SAME members;
+    // a wid blamed twice running is excluded (persistently bad link or
+    // wedged peer).
+    std::map<int64_t, int> suspects;
+    for (const auto& key : failKeys) {
+      try {
+        Store::Buf raw = store_->get(key, kProbeTimeout);
+        JsonReader reader(std::string(raw.begin(), raw.end()),
+                          "elastic failure evidence");
+        auto doc = reader.parse();
+        const auto* s = doc.field("suspect_wid");
+        if (s != nullptr && s->kind == JsonReader::Value::Kind::kNumber &&
+            s->number >= 0) {
+          suspects[static_cast<int64_t>(s->number)]++;
+        }
+      } catch (const std::exception&) {
+        continue;  // torn/reaped evidence: the bump itself still happens
+      }
+    }
+    int64_t modal = -1;
+    int votes = 0;
+    for (const auto& kv : suspects) {
+      if (kv.second > votes) {
+        modal = kv.first;
+        votes = kv.second;
+      }
+    }
+    std::vector<int64_t> next = members;
+    if (modal >= 0 && ++strikes_[modal] >= 2 &&
+        static_cast<int>(members.size()) > 1) {
+      next.erase(std::remove(next.begin(), next.end(), modal), next.end());
+      TC_WARN("elastic: wid ", modal, " blamed in two consecutive "
+              "evidence rounds — excluding it from epoch ", H + 1);
+    }
+    publishEpoch(H + 1, next, "evidence", {}, {});
+    return;
+  }
+
+  // ---- grow: admit live joiners once the current epoch has settled --
+  // Settled means: no unconsumed failure evidence (the epoch may be
+  // about to shrink), and every member's lease FRESHLY renewed — a
+  // member that stopped renewing but has not yet crossed the grace is
+  // very possibly dead, and admitting a joiner now would bootstrap the
+  // next mesh around a corpse (everyone would slip one full mesh
+  // timeout before the death bump rescues them).
+  if (!failKeys.empty()) {
+    return;
+  }
+  const long freshMs = std::max(2 * leaseMs_ + pollMs_, 500L);
+  for (int64_t w : members) {
+    if (w == wid_) {
+      continue;
+    }
+    auto it = leases_.find(w);
+    if (it == leases_.end() || !it->second.seen ||
+        now - it->second.lastChangeMs > freshMs) {
+      return;
+    }
+  }
+  std::vector<int64_t> joiners;
+  std::vector<int64_t> joinSeen;
+  for (const auto& key : store_->listKeys(k("join/"))) {
+    const std::string name = key.substr(key.rfind('/') + 1);
+    char* end = nullptr;
+    const int64_t w = std::strtoll(name.c_str(), &end, 10);
+    if (end == name.c_str() || *end != '\0') {
+      continue;
+    }
+    joinSeen.push_back(w);
+    if (std::find(members.begin(), members.end(), w) != members.end()) {
+      store_->deleteKey(key);  // stale request from a current member
+      continue;
+    }
+    // A joiner is admissible only once its lease has been OBSERVED TO
+    // CHANGE recently: mere key presence could be the leftover of a
+    // joiner that died right after enqueueing, and admitting a corpse
+    // stalls every member in the next epoch's bootstrap. The one-
+    // transition requirement costs a healthy joiner ~one lease period.
+    LeaseObs& obs = joinLeases_[w];
+    if (obs.lastChangeMs == 0) {
+      obs.lastChangeMs = now;
+    }
+    if (!store_->check({leaseKey(w)})) {
+      if (obs.seen || now - obs.lastChangeMs > graceMs_) {
+        store_->deleteKey(key);  // died (or never lived) while queued
+        joinLeases_.erase(w);
+      }
+      continue;
+    }
+    const uint64_t value =
+        unpackCounter(store_->get(leaseKey(w), kProbeTimeout));
+    if (!obs.seen || value != obs.value) {
+      obs.changeSeen = obs.seen;  // a transition, not a first sighting
+      obs.seen = true;
+      obs.value = value;
+      obs.lastChangeMs = now;
+    } else if (now - obs.lastChangeMs > graceMs_) {
+      // Queued corpse: reap its request and lease so the queue stays
+      // clean and a later epoch never trips over it.
+      store_->deleteKey(key);
+      store_->deleteKey(leaseKey(w));
+      joinLeases_.erase(w);
+      continue;
+    }
+    if (obs.changeSeen && now - obs.lastChangeMs <= freshMs) {
+      joiners.push_back(w);
+    }
+  }
+  // Drop observations for requests that vanished (admitted elsewhere
+  // or reaped) so the map cannot grow without bound.
+  for (auto it = joinLeases_.begin(); it != joinLeases_.end();) {
+    if (std::find(joinSeen.begin(), joinSeen.end(), it->first) ==
+        joinSeen.end()) {
+      it = joinLeases_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  if (joiners.empty()) {
+    return;
+  }
+  std::vector<std::string> readyKeys;
+  readyKeys.reserve(members.size());
+  for (int64_t w : members) {
+    readyKeys.push_back(epochPrefix(H) + "ready/" + std::to_string(w));
+  }
+  if (!store_->check(readyKeys)) {
+    return;  // the current transition has not finished — admit later
+  }
+  std::sort(joiners.begin(), joiners.end());
+  std::vector<int64_t> next = members;  // survivors keep relative order
+  next.insert(next.end(), joiners.begin(), joiners.end());
+  if (publishEpoch(H + 1, next, "join", {}, joiners)) {
+    strikes_.clear();
+  }
+}
+
+std::unique_ptr<Context> ElasticAgent::rebuild(
+    std::chrono::milliseconds timeout) {
+  if (timeout.count() <= 0) {
+    timeout = opts_.timeout;
+  }
+  const auto deadline = std::chrono::steady_clock::now() + timeout;
+  {
+    // Unbind first: the monitor must stop reaching the old context the
+    // moment the owner is about to replace (and later free) it. Capture
+    // the installed tuning table so the successor keeps the deployment's
+    // measured dispatch.
+    std::lock_guard<std::mutex> guard(mu_);
+    if (boundCtx_ != nullptr) {
+      inheritedTable_ = boundCtx_->tuningTable();
+    }
+    boundCtx_ = nullptr;
+  }
+  const int64_t t0 = nowMs();
+
+  while (true) {
+    if (std::chrono::steady_clock::now() >= deadline) {
+      // Typed: callers distinguish "retry later" (timeout) from the
+      // terminal evicted / below-min-size verdicts below.
+      TC_THROW(TimeoutException, "elastic: rebuild did not converge "
+               "within ", timeout.count(), "ms (head epoch ",
+               headEpoch(), ")");
+    }
+    refreshHead();  // best-effort; a not-yet-published head retries below
+    uint64_t H;
+    std::vector<int64_t> members;
+    std::shared_ptr<const tuning::TuningTable> table;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      H = headEpoch_;
+      members = members_;
+      table = inheritedTable_;
+    }
+    auto self = std::find(members.begin(), members.end(), wid_);
+    if (self == members.end()) {
+      if (opts_.join) {
+        // Enqueued but not yet admitted: the coordinator admits at the
+        // next boundary once the current epoch settles.
+        std::this_thread::sleep_for(std::chrono::milliseconds(pollMs_));
+        continue;
+      }
+      TC_THROW(IoException, "elastic: wid ", wid_,
+               " was evicted from the membership at epoch ", H);
+    }
+    if (static_cast<int>(members.size()) < opts_.minSize) {
+      TC_THROW(IoException, "elastic: membership shrank to ",
+               members.size(), " member(s) at epoch ", H,
+               ", below min_size ", opts_.minSize);
+    }
+    const int newRank = static_cast<int>(self - members.begin());
+    const int newSize = static_cast<int>(members.size());
+
+    // Per-attempt mesh timeout: small enough that an epoch superseded
+    // mid-bootstrap (a second death during the transition) costs one
+    // bounded slip, not the whole rebuild budget.
+    const auto remaining =
+        std::chrono::duration_cast<std::chrono::milliseconds>(
+            deadline - std::chrono::steady_clock::now());
+    const auto attempt = std::min(
+        remaining,
+        std::chrono::milliseconds(std::max(4 * graceMs_, 5000L)));
+    std::unique_ptr<Context> ctx;
+    try {
+      ctx = buildEpochContext(store_, device_, newRank, newSize, H,
+                              opts_.hostId, table, attempt);
+    } catch (const std::exception& e) {
+      TC_INFO("elastic: epoch ", H, " mesh bootstrap failed (", e.what(),
+              ") — publishing evidence and retrying");
+      try {
+        store_->set(epochPrefix(H) + "fail/" + std::to_string(wid_),
+                    [&] {
+                      const std::string ev =
+                          "{\"suspect_wid\":-1,\"kind\":\"rebuild_failed\"}";
+                      return Store::Buf(ev.begin(), ev.end());
+                    }());
+      } catch (const std::exception&) {
+        // Evidence is best-effort; the retry loop itself recovers.
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(pollMs_));
+      continue;
+    }
+
+    ctx->setTimeout(opts_.timeout);  // attempt bound was bootstrap-only
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      boundCtx_ = ctx.get();
+      boundEpoch_ = H;
+      boundRank_ = newRank;
+      boundDomain_ = ctx->faultDomain();
+      closedEpoch_ = 0;
+      rebuilds_++;
+      lastRebuildMs_ = nowMs() - t0;
+      inheritedTable_ = ctx->tuningTable();
+    }
+    store_->set(epochPrefix(H) + "ready/" + std::to_string(wid_),
+                Store::Buf{1});
+    return ctx;
+  }
+}
+
+void ElasticAgent::noteFailure(const std::string& evidenceJson) {
+  uint64_t epoch;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    epoch = boundEpoch_ != 0 ? boundEpoch_ : headEpoch_;
+  }
+  store_->set(epochPrefix(epoch) + "fail/" + std::to_string(wid_),
+              Store::Buf(evidenceJson.begin(), evidenceJson.end()));
+}
+
+void ElasticAgent::stop() {
+  // Relaxed: pure exit flag; the joins below are the sync points.
+  const bool already = stop_.exchange(true, std::memory_order_relaxed);
+  sleepCv_.notify_all();
+  if (heartbeat_.joinable()) {
+    heartbeat_.join();
+  }
+  if (monitor_.joinable()) {
+    monitor_.join();
+  }
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    boundCtx_ = nullptr;
+  }
+  if (!already && wid_ >= 0) {
+    // Graceful leave: a deleted (previously seen) lease is an immediate
+    // departure for every observer — no grace wait.
+    store_->deleteKey(leaseKey(wid_));
+    store_->deleteKey(k("join/" + std::to_string(wid_)));
+  }
+}
+
+uint64_t ElasticAgent::boundEpoch() const {
+  std::lock_guard<std::mutex> guard(mu_);
+  return boundEpoch_;
+}
+
+uint64_t ElasticAgent::headEpoch() const {
+  std::lock_guard<std::mutex> guard(mu_);
+  return headEpoch_;
+}
+
+bool ElasticAgent::epochChanged() const {
+  std::lock_guard<std::mutex> guard(mu_);
+  return boundEpoch_ == 0 || headEpoch_ > boundEpoch_;
+}
+
+std::string ElasticAgent::statusJson() const {
+  std::lock_guard<std::mutex> guard(mu_);
+  int64_t lowest = -1;
+  for (int64_t w : members_) {
+    lowest = lowest < 0 ? w : std::min(lowest, w);
+  }
+  const bool joinPending =
+      std::find(members_.begin(), members_.end(), wid_) == members_.end();
+  std::ostringstream out;
+  out << "{\"epoch\":" << boundEpoch_ << ",\"head_epoch\":" << headEpoch_
+      << ",\"wid\":" << wid_ << ",\"rank\":" << boundRank_
+      << ",\"size\":" << members_.size() << ",\"members\":[";
+  for (size_t i = 0; i < members_.size(); i++) {
+    out << (i == 0 ? "" : ",") << members_[i];
+  }
+  out << "],\"target_size\":" << opts_.worldSize
+      << ",\"min_size\":" << opts_.minSize << ",\"coordinator\":"
+      << (wid_ == lowest && !joinPending ? "true" : "false")
+      << ",\"join_pending\":" << (joinPending ? "true" : "false")
+      << ",\"leases_renewed\":"
+      << leasesRenewed_.load(std::memory_order_relaxed)
+      << ",\"rebuilds\":" << rebuilds_
+      << ",\"bumps_published\":" << bumpsPublished_
+      << ",\"last_rebuild_ms\":" << lastRebuildMs_
+      << ",\"fault_domain\":" << boundDomain_
+      << ",\"lease_ms\":" << leaseMs_ << ",\"lease_grace_ms\":" << graceMs_
+      << "}";
+  return out.str();
+}
+
+}  // namespace elastic
+}  // namespace tpucoll

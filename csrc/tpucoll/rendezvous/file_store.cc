@@ -1,5 +1,6 @@
 #include "tpucoll/rendezvous/file_store.h"
 
+#include <dirent.h>
 #include <fcntl.h>
 #include <sys/file.h>
 #include <sys/stat.h>
@@ -117,6 +118,65 @@ bool FileStore::check(const std::vector<std::string>& keys) {
     }
   }
   return true;
+}
+
+bool FileStore::deleteKey(const std::string& key) {
+  // Verify the stored key before unlinking: under an fnv64 collision the
+  // file belongs to a DIFFERENT key and must survive.
+  if (!tryRead(key, nullptr)) {
+    return false;
+  }
+  const std::string target = fileFor(key);
+  unlink((target + ".lock").c_str());  // add()'s lock file, if any
+  if (unlink(target.c_str()) != 0) {
+    TC_ENFORCE_EQ(errno, ENOENT, "unlink failed for ", target, ": ",
+                  strerror(errno));
+    return false;  // lost a delete race; the key is gone either way
+  }
+  return true;
+}
+
+std::vector<std::string> FileStore::listKeys(const std::string& prefix) {
+  std::vector<std::string> out;
+  DIR* dir = opendir(path_.c_str());
+  TC_ENFORCE(dir != nullptr, "opendir failed for ", path_, ": ",
+             strerror(errno));
+  struct dirent* ent;
+  while ((ent = readdir(dir)) != nullptr) {
+    const std::string name(ent->d_name);
+    if (name.compare(0, 3, "tc_") != 0 ||
+        name.find(".tmp.") != std::string::npos ||
+        (name.size() >= 5 &&
+         name.compare(name.size() - 5, 5, ".lock") == 0)) {
+      continue;
+    }
+    int fd = open((path_ + "/" + name).c_str(), O_RDONLY);
+    if (fd < 0) {
+      continue;  // deleted between readdir and open
+    }
+    // Read ONLY the [keyLen][key] header — a listing must not re-read
+    // every value body (epoch namespaces hold multi-KB mesh blobs, and
+    // the elastic monitor lists queues on its poll cadence).
+    uint32_t keyLen = 0;
+    std::string key;
+    bool ok = read(fd, &keyLen, sizeof(keyLen)) ==
+                  static_cast<ssize_t>(sizeof(keyLen)) &&
+              keyLen <= (1u << 20);
+    if (ok) {
+      key.resize(keyLen);
+      ok = keyLen == 0 ||
+           read(fd, &key[0], keyLen) == static_cast<ssize_t>(keyLen);
+    }
+    close(fd);
+    if (!ok) {
+      continue;  // torn writer (set() renames atomically; be tolerant)
+    }
+    if (key.compare(0, prefix.size(), prefix) == 0) {
+      out.push_back(std::move(key));
+    }
+  }
+  closedir(dir);
+  return out;
 }
 
 int64_t FileStore::add(const std::string& key, int64_t delta) {

@@ -21,6 +21,11 @@ class FileStore : public Store {
   Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
   bool check(const std::vector<std::string>& keys) override;
   int64_t add(const std::string& key, int64_t delta) override;
+  bool deleteKey(const std::string& key) override;
+  // Scans the directory and reads each file's embedded key (the hashed
+  // filenames carry no prefix structure) — O(keys), for hygiene paths
+  // (lease reaping, retired namespaces), not hot paths.
+  std::vector<std::string> listKeys(const std::string& prefix) override;
 
  private:
   std::string fileFor(const std::string& key) const;

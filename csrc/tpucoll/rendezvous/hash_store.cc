@@ -32,6 +32,22 @@ bool HashStore::check(const std::vector<std::string>& keys) {
   return true;
 }
 
+bool HashStore::deleteKey(const std::string& key) {
+  std::lock_guard<std::mutex> guard(mu_);
+  return map_.erase(key) > 0;
+}
+
+std::vector<std::string> HashStore::listKeys(const std::string& prefix) {
+  std::lock_guard<std::mutex> guard(mu_);
+  std::vector<std::string> out;
+  for (const auto& kv : map_) {
+    if (kv.first.compare(0, prefix.size(), prefix) == 0) {
+      out.push_back(kv.first);
+    }
+  }
+  return out;
+}
+
 int64_t HashStore::add(const std::string& key, int64_t delta) {
   int64_t result;
   {

@@ -17,6 +17,8 @@ class HashStore : public Store {
   Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
   bool check(const std::vector<std::string>& keys) override;
   int64_t add(const std::string& key, int64_t delta) override;
+  bool deleteKey(const std::string& key) override;
+  std::vector<std::string> listKeys(const std::string& prefix) override;
 
  private:
   std::mutex mu_;

@@ -91,4 +91,22 @@ void PrefixStore::multiSet(const std::vector<std::string>& keys,
   base_->multiSet(qualified, values);
 }
 
+bool PrefixStore::deleteKey(const std::string& key) {
+  return base_->deleteKey(qualify(key));
+}
+
+std::vector<std::string> PrefixStore::listKeys(const std::string& prefix) {
+  const std::string scope = prefix_ + "/";
+  std::vector<std::string> out;
+  for (auto& key : base_->listKeys(qualify(prefix))) {
+    // base_->listKeys only returns keys under qualify(prefix), which
+    // itself starts with scope; the strip can therefore never miss.
+    TC_ENFORCE_EQ(key.compare(0, scope.size(), scope), 0,
+                  "PrefixStore::listKeys: base returned unscoped key '",
+                  key, "'");
+    out.push_back(key.substr(scope.size()));
+  }
+  return out;
+}
+
 }  // namespace tpucoll

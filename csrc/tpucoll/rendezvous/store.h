@@ -50,6 +50,16 @@ class Store {
       std::chrono::milliseconds timeout = kDefaultTimeout);
   virtual void multiSet(const std::vector<std::string>& keys,
                         const std::vector<Buf>& values);
+
+  // Remove `key`; true when it existed. A waiter blocked on a deleted
+  // key simply keeps waiting — deletion is for namespace hygiene (lease
+  // reaping, retired rebuild/epoch namespaces), not signalling.
+  virtual bool deleteKey(const std::string& key) = 0;
+
+  // Keys currently present that start with `prefix` (relative to this
+  // store's namespace), in unspecified order. Snapshot semantics only:
+  // keys created or deleted concurrently may or may not appear.
+  virtual std::vector<std::string> listKeys(const std::string& prefix) = 0;
 };
 
 // Decorator that namespaces every key, so independent contexts can share one
@@ -66,6 +76,11 @@ class PrefixStore : public Store {
                             std::chrono::milliseconds timeout) override;
   void multiSet(const std::vector<std::string>& keys,
                 const std::vector<Buf>& values) override;
+  bool deleteKey(const std::string& key) override;
+  // Qualifies the prefix, then strips this store's own namespace from
+  // the results, so listing through a PrefixStore stack yields keys
+  // usable with the same stack's get/delete.
+  std::vector<std::string> listKeys(const std::string& prefix) override;
 
  private:
   std::string qualify(const std::string& key) const;

@@ -22,6 +22,8 @@ enum Op : uint8_t {
   kAdd = 4,
   kCheck = 5,
   kMultiGet = 6,
+  kDelete = 7,
+  kList = 8,
 };
 
 enum Status : uint8_t {
@@ -274,6 +276,40 @@ void TcpStoreServer::serveClient(int fd) {
         ok = writeResponse(fd, kOk, {out});
         break;
       }
+      case kDelete: {
+        if (nkeys != 1) {
+          writeResponse(fd, kBadRequest, {});
+          ok = false;
+          break;
+        }
+        bool existed;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          existed = map_.erase(keys[0]) > 0;
+        }
+        ok = writeResponse(fd, kOk, {Store::Buf{existed ? uint8_t(1)
+                                                        : uint8_t(0)}});
+        break;
+      }
+      case kList: {
+        if (nkeys != 1) {
+          writeResponse(fd, kBadRequest, {});
+          ok = false;
+          break;
+        }
+        std::vector<Store::Buf> vals;
+        {
+          std::lock_guard<std::mutex> guard(mu_);
+          const std::string& prefix = keys[0];
+          for (const auto& kv : map_) {
+            if (kv.first.compare(0, prefix.size(), prefix) == 0) {
+              vals.emplace_back(kv.first.begin(), kv.first.end());
+            }
+          }
+        }
+        ok = writeResponse(fd, kOk, vals);
+        break;
+      }
       case kCheck: {
         bool all = true;
         {
@@ -427,6 +463,24 @@ int64_t TcpStore::add(const std::string& key, int64_t delta) {
   int64_t result;
   std::memcpy(&result, vals[0].data(), 8);
   return result;
+}
+
+bool TcpStore::deleteKey(const std::string& key) {
+  auto [status, vals] = roundTrip(kDelete, {key}, {});
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore delete failed");
+  TC_ENFORCE_EQ(vals.size(), size_t(1));
+  return !vals[0].empty() && vals[0][0] != 0;
+}
+
+std::vector<std::string> TcpStore::listKeys(const std::string& prefix) {
+  auto [status, vals] = roundTrip(kList, {prefix}, {});
+  TC_ENFORCE_EQ(int(status), int(kOk), "TcpStore list failed");
+  std::vector<std::string> out;
+  out.reserve(vals.size());
+  for (const auto& v : vals) {
+    out.emplace_back(v.begin(), v.end());
+  }
+  return out;
 }
 
 std::vector<Store::Buf> TcpStore::multiGet(

@@ -14,7 +14,8 @@
 // Ops: kSet(1, 1 key + 1 val), kTryGet(2), kWaitGet(3, payload u64
 // timeout_ms), kAdd(4, payload i64 delta -> returns 8-byte value),
 // kCheck(5, n keys -> status 0 iff all exist), kMultiGet(6, n keys with
-// u64 timeout_ms payload).
+// u64 timeout_ms payload), kDelete(7, 1 key -> 1 val: 1 byte 0/1
+// existed), kList(8, 1 key = prefix -> n vals, one key string each).
 #pragma once
 
 #include <atomic>
@@ -66,6 +67,8 @@ class TcpStore : public Store {
   int64_t add(const std::string& key, int64_t delta) override;
   std::vector<Buf> multiGet(const std::vector<std::string>& keys,
                             std::chrono::milliseconds timeout) override;
+  bool deleteKey(const std::string& key) override;
+  std::vector<std::string> listKeys(const std::string& prefix) override;
 
  private:
   // One request/response round trip (client socket is serialized).

@@ -6,6 +6,13 @@ rank 2 is SIGKILLed mid-run. The survivors detect the failure in
 milliseconds (EOF without goodbye), rebuild a 2-rank group through the
 store, reload the last committed checkpoint, and train to convergence.
 
+This is the MANUAL recovery pattern (the application catches the error
+and drives rebuild_after_failure itself). The elastic membership plane
+(gloo_tpu.elastic.run_elastic, docs/elastic.md) automates the whole
+loop — lease-detected failures, epoch agreement, auto-rebuild, and
+rejoin back to full size — with the same StepCheckpointer supplying
+the state.
+
     python examples/example_elastic_checkpoint.py
 """
 

@@ -17,7 +17,7 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
 # NOT imported here — it would drag the multi-second jax import into
 # every host-plane-only process. The device-plane packages
 # (gloo_tpu.tpu / .ops / .parallel / .models) import it themselves.
-from gloo_tpu import fault, tuning
+from gloo_tpu import elastic, fault, tuning
 from gloo_tpu.bootstrap import detect_launch_env, init_from_env
 from gloo_tpu.bucketer import GradientBucketer
 from gloo_tpu.core import (
@@ -73,6 +73,7 @@ __all__ = [
     "detect_launch_env",
     "init_from_env",
     "derive_keyring",
+    "elastic",
     "fault",
     "q8_block",
     "q8_decode",

@@ -89,6 +89,11 @@ _PROTOTYPES = {
     "tc_buf_free": (None, [ctypes.POINTER(ctypes.c_uint8)]),
     "tc_store_add": (_int, [_c, ctypes.c_char_p, _i64,
                             ctypes.POINTER(_i64)]),
+    "tc_store_delete": (_int, [_c, ctypes.c_char_p,
+                               ctypes.POINTER(_int)]),
+    "tc_store_list": (_int, [_c, ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                             ctypes.POINTER(_sz)]),
     # device / context
     "tc_device_new": (_c, [ctypes.c_char_p, ctypes.c_uint16,
                        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
@@ -140,6 +145,18 @@ _PROTOTYPES = {
     "tc_flightrec_dump": (_int, [_c, ctypes.c_char_p]),
     "tc_flightrec_seq": (_u64, [_c]),
     "tc_flightrec_install_signal_handler": (None, []),
+    # elastic membership plane (lease liveness + epoch transitions)
+    "tc_elastic_new": (_c, [_c, _c, _int, _int, _int, _int,
+                            ctypes.c_char_p, _i64]),
+    "tc_elastic_rebuild": (_int, [_c, _i64, ctypes.POINTER(_c)]),
+    "tc_elastic_note_failure": (_int, [_c, ctypes.c_char_p]),
+    "tc_elastic_stop": (_int, [_c]),
+    "tc_elastic_free": (None, [_c]),
+    "tc_elastic_epoch": (_u64, [_c]),
+    "tc_elastic_head_epoch": (_u64, [_c]),
+    "tc_elastic_poll": (_int, [_c]),
+    "tc_elastic_status_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # deterministic fault-injection plane
     "tc_fault_install": (_int, [ctypes.c_char_p]),
     "tc_fault_clear": (None, []),

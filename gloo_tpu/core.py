@@ -217,6 +217,23 @@ class Store:
                                     ctypes.byref(result)))
         return result.value
 
+    def delete(self, key: str) -> bool:
+        """Remove `key`; True when it existed. A waiter blocked on a
+        deleted key keeps waiting — deletion is namespace hygiene
+        (lease reaping, retired rebuild/epoch namespaces), not
+        signalling (docs/rendezvous.md)."""
+        deleted = ctypes.c_int(0)
+        check(_lib.lib.tc_store_delete(self._handle, key.encode(),
+                                       ctypes.byref(deleted)))
+        return bool(deleted.value)
+
+    def list(self, prefix: str = "") -> "list[str]":
+        """Keys currently present under `prefix` (relative to this
+        store's namespace), unspecified order. Snapshot semantics only:
+        keys created or deleted concurrently may or may not appear."""
+        return json.loads(_copy_out(_lib.lib.tc_store_list, self._handle,
+                                    prefix.encode()))
+
 
 class HashStore(Store):
     """In-process store for multi-rank-in-one-process tests."""

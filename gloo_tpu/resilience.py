@@ -228,4 +228,30 @@ def rebuild_after_failure(store: "gloo_tpu.Store", device: "gloo_tpu.Device",
     new_size = len(survivors)
     ctx = gloo_tpu.Context(new_rank, new_size, timeout=timeout)
     ctx.connect_full_mesh(gloo_tpu.PrefixStore(gen, "mesh"), device)
+    if new_rank == 0:
+        _reap_generation(gen)
     return ctx, new_rank, new_size
+
+
+def _reap_generation(gen: "gloo_tpu.Store") -> None:
+    """Reap this generation's bootstrap keys once the mesh is up, so
+    repeated rebuilds against one long-lived store don't leak a full
+    O(n^2) mesh-blob namespace per generation. Safe from new rank 0
+    after its connect returns: every survivor batch-reads ALL mesh
+    blobs before dialing rank 0, so a fully-accepted rank 0 proves the
+    store phase is globally over. Scope discipline: only the bootstrap
+    families go — `mesh/tc/` (address blobs + topology fingerprints)
+    plus the roll-call keys — because POST-rebuild traffic (splits,
+    tuner elections) rides the same store under `mesh/tpucoll/` and a
+    wholesale reap would race it. The `stall/<rank>` evidence keys are
+    deliberately KEPT — they are the post-mortem record stall_reports /
+    analyze_stall_reports read after the fact (docs/faults.md)."""
+    try:
+        for key in gen.list("mesh/tc/"):
+            gen.delete(key)
+        for key in gen.list("alive/"):
+            gen.delete(key)
+        gen.delete("count")
+    except gloo_tpu.Error:
+        # Hygiene must never turn a successful rebuild into a failure.
+        pass

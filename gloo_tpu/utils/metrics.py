@@ -215,6 +215,28 @@ def to_prometheus(snapshot: dict,
                     lines.append(f"gloo_tpu_async_lane_{key}_total"
                                  f"{_fmt_labels(labels)} "
                                  f"{st.get(key, 0)}")
+    # Elastic membership plane (docs/elastic.md): ElasticContext.metrics()
+    # attaches the agent status under "elastic" — the epoch gauge plus
+    # the liveness/transition counters operators alert on.
+    elastic = snapshot.get("elastic")
+    if elastic:
+        lines.append("# TYPE gloo_tpu_elastic_epoch gauge")
+        lines.append(f"gloo_tpu_elastic_epoch{_fmt_labels(base)} "
+                     f"{elastic.get('epoch', 0)}")
+        lines.append("# TYPE gloo_tpu_elastic_members gauge")
+        lines.append(f"gloo_tpu_elastic_members{_fmt_labels(base)} "
+                     f"{elastic.get('size', 0)}")
+        lines.append("# TYPE gloo_tpu_elastic_leases_renewed_total counter")
+        lines.append(f"gloo_tpu_elastic_leases_renewed_total"
+                     f"{_fmt_labels(base)} "
+                     f"{elastic.get('leases_renewed', 0)}")
+        lines.append("# TYPE gloo_tpu_elastic_rebuilds_total counter")
+        lines.append(f"gloo_tpu_elastic_rebuilds_total{_fmt_labels(base)} "
+                     f"{elastic.get('rebuilds', 0)}")
+        lines.append("# TYPE gloo_tpu_elastic_bumps_published_total counter")
+        lines.append(f"gloo_tpu_elastic_bumps_published_total"
+                     f"{_fmt_labels(base)} "
+                     f"{elastic.get('bumps_published', 0)}")
     wd = snapshot.get("watchdog", {})
     lines.append("# TYPE gloo_tpu_watchdog_stalls_total counter")
     lines.append(f"gloo_tpu_watchdog_stalls_total{_fmt_labels(base)} "

@@ -664,6 +664,88 @@ def test_chaos_sigkill_leader_mid_hier_allreduce():
     _run_hier_kill(victim=2, named_rank=0)
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellite: chaos under the elastic membership plane
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_link_kill_under_elastic_recovers_full_size():
+    """Hard-evidence recovery path of the elastic plane (docs/elastic.md):
+    a fault-injected link kill breaks a collective while every PROCESS
+    stays alive — so no lease ever expires. The survivors publish their
+    failure evidence (transport-failure verdicts), the coordinator
+    bumps the epoch with the SAME members after one grace, and the
+    group resumes at FULL size on a fresh mesh — the recovery a mere
+    broken TCP connection deserves, no shrink, no manual rebuild."""
+    store = tempfile.mkdtemp()
+    # min_bytes gates the kill onto the one large allreduce the
+    # workload issues exactly once (state["big_tried"] is set BEFORE
+    # the attempt, so the post-recovery retry goes small and the
+    # count=1 rule cannot re-fire in the new epoch's fresh domain).
+    schedule = {"seed": 41, "faults": [
+        {"when": {"rank": 1, "peer": 0, "opcode": "data",
+                  "min_bytes": 40000},
+         "action": "kill", "count": 1}]}
+    body = """
+from gloo_tpu import elastic
+
+def step_fn(ectx, step, state):
+    flag = np.zeros(1, dtype=np.float32)
+    if ectx.rank == 0 and state["done"] >= 6 and state["big_tried"]:
+        flag[0] = 1.0
+    ectx.allreduce(flag, tag=0)
+    if flag[0] > 0:
+        raise StopIteration
+    if step == 3 and not state["big_tried"]:
+        state["big_tried"] = True
+        big = np.full(1 << 16, float(ectx.rank + 1), dtype=np.float32)
+        ectx.allreduce(big, tag=1)       # the kill fires here, once
+        n = ectx.size
+        assert big[0] == n * (n + 1) / 2, big[0]
+    else:
+        x = np.full(4096, float(ectx.rank + 1), dtype=np.float32)
+        ectx.allreduce(x, tag=1)
+        n = ectx.size
+        assert x[0] == n * (n + 1) / 2, (step, x[0], n)
+    state["done"] += 1
+    return state
+
+res = elastic.run_elastic(
+    step_fn, store=store, device=gloo_tpu.Device(), rank=rank,
+    world_size=size, min_size=2,
+    state={"done": 0, "big_tried": False}, timeout=90.0)
+fired = [(e["domain"], e["action"], e["opcode"]) for e in
+         fault.report(rank=rank)]
+print("OK", json.dumps({
+    "sizes": [e["size"] for e in res["epochs"]],
+    "epoch": res["elastic"]["epoch"],
+    "members": res["elastic"]["members"],
+    "rebuilds": res["rebuilds"], "fired": fired}))
+"""
+    procs = [_spawn_worker(body, r, 3, store, schedule,
+                           extra_env={"TPUCOLL_LEASE_MS": "200",
+                                      "TPUCOLL_LEASE_GRACE": "1200"})
+             for r in range(3)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    _assert_ok(procs, outs)
+    for r in range(3):
+        line = [ln for ln in outs[r][0].splitlines()
+                if ln.startswith("OK ")][0]
+        res = json.loads(line[3:])
+        # Same members straight through: 3 -> 3 across the evidence
+        # bump; nobody was excluded for a single broken link.
+        assert res["sizes"] == [3, 3], res
+        assert res["epoch"] == 2 and res["members"] == [0, 1, 2], res
+        assert res["rebuilds"] == 1, res
+        if r == 1:
+            kills = [f for f in res["fired"] if f[1] == "kill"]
+            assert len(kills) == 1, res["fired"]
+            # The kill landed inside the epoch-1 group domain (>= 1000),
+            # proving the elastic context — not a root-domain mesh —
+            # carried the traffic.
+            assert kills[0][0] >= 1000, res["fired"]
+
+
 def test_chaos_same_seed_determinism_with_group_domains():
     """Same-seed fault determinism holds per (rank, domain) with GROUP
     domains: a probabilistic delay rule fires inside the hier split

@@ -178,6 +178,35 @@ def test_bench_latency_smoke():
     assert summaries[0]["geomean_p50_speedup_le_64KiB"] is not None
 
 
+def test_bench_elastic_soak_smoke():
+    """bench.py --elastic-soak --quick (3 workers, 1 SIGKILL + 1
+    rejoin): the soak must come back at FULL size with every mixed-
+    workload step verified, epochs covering the shrink + grow
+    transitions, and rebuild-latency percentiles measured — the
+    committed ELASTIC_r14.json records the longer run. Latency values
+    are not ranked (shared-core CI host); ok=True already asserts the
+    end-to-end recovery contract inside the driver."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--elastic-soak", "20", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    line = lines[0]
+    assert line["metric"] == "elastic_soak_3rank_host"
+    assert line["ok"] is True, line
+    assert line["kills"] == 1 and line["rejoins"] == 1
+    # One kill forces at least shrink + grow past the founding epoch.
+    assert line["value"] >= 3, line
+    assert line["steps"] > 0
+    assert line["rebuild_ms_p50"] > 0
+    assert line["rebuild_ms_p99"] >= line["rebuild_ms_p50"]
+
+
 def test_bench_wire_sweep_smoke():
     """bench.py --wire-sweep --quick (2 ranks): one valid JSON
     measurement line per wire-codec arm — the crossover data the lossy

@@ -372,6 +372,112 @@ print("SPLIT-HIER-SMOKE-OK")
 """
 
 
+_ELASTIC_PROG = f"""
+import sys, threading, time
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+from gloo_tpu import elastic
+
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ectx = elastic.ElasticContext(store, gloo_tpu.Device(), rank=rank,
+                                      world_size=2, min_size=1,
+                                      timeout=60.0)
+        x = np.full(2048, float(ectx.rank + 1), dtype=np.float32)
+        ectx.allreduce(x)
+        assert x[0] == 3.0, x[0]
+        assert ectx.group_tag() == "e1"
+        if rank == 1:
+            ectx.close()   # graceful leave: lease deleted, peers shrink
+            return
+        deadline = time.time() + 30
+        while time.time() < deadline and not ectx.agent.poll():
+            time.sleep(0.05)
+        assert ectx.agent.poll(), "no epoch bump after graceful leave"
+        ectx.rebuild()
+        st = ectx.status()
+        assert st["epoch"] == 2 and st["size"] == 1, st
+        assert st["coordinator"] is True, st
+        assert st["leases_renewed"] >= 2, st
+        y = np.full(64, 7.0, dtype=np.float32)
+        ectx.allreduce(y)
+        assert y[0] == 7.0
+        ectx.close()
+    except BaseException as e:
+        errors.append((rank, e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+[t.start() for t in threads]
+[t.join(180) for t in threads]
+assert not errors, errors
+print("ELASTIC-SMOKE-OK")
+"""
+
+
+def test_asan_elastic_smoke():
+    """Skip-unless-built ASan smoke of the elastic membership plane
+    through the ctypes surface: two in-process agents found epoch 1,
+    heartbeat leases, run a collective, one leaves gracefully, the
+    survivor observes the bump and rebuilds into the one-member epoch
+    2 — the lease-heartbeat + epoch-rebuild lifecycle under ASan
+    (TPUCOLL_LEASE_MS/GRACE shrunk so the pass is test-sized)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+                          "TPUCOLL_LEASE_MS": "200",
+                          "TPUCOLL_LEASE_GRACE": "1000"})
+    result = subprocess.run([sys.executable, "-c", _ELASTIC_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "ELASTIC-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_elastic_smoke():
+    """UBSan flavor of the elastic lifecycle smoke (-fno-sanitize-
+    recover: the first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib,
+                         {"TPUCOLL_LEASE_MS": "200",
+                          "TPUCOLL_LEASE_GRACE": "1000"})
+    result = subprocess.run([sys.executable, "-c", _ELASTIC_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "ELASTIC-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_elastic_smoke():
+    """TSan flavor of the elastic lifecycle smoke: two in-process
+    agents each run a heartbeat + monitor thread against one shared
+    HashStore while app threads rebuild through epoch transitions —
+    exactly the shape that would expose a data race in the lease /
+    epoch-document plumbing."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7",
+                          "TPUCOLL_LEASE_MS": "200",
+                          "TPUCOLL_LEASE_GRACE": "1000"})
+    result = subprocess.run([sys.executable, "-c", _ELASTIC_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "ELASTIC-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_asan_split_hier_smoke():
     """Skip-unless-built ASan smoke driving the process-group subsystem
     through the ctypes surface: topology discovery, split_by_host, a
