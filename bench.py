@@ -965,6 +965,136 @@ def bench_wire_sweep(quick=False):
         sys.exit(1)
 
 
+def bench_profile(quick=False):
+    """--profile: per-phase breakdown per (size x algorithm) cell plus
+    the profiler overhead A/B (ISSUE 15; docs/profiling.md).
+
+    Each cell runs a fresh 2-rank subprocess pair under TPUCOLL_SHM=0,
+    times `iters` allreduces, and reports the mean per-phase breakdown
+    from Context.profile() restricted to the timed ops. The A/B block
+    re-times the largest cell's ring allreduce with TPUCOLL_PROFILE=1
+    vs =0 in interleaved passes — the committed evidence (PROF_r15.json)
+    that the profiler stays inside host noise."""
+    import tempfile
+    import textwrap
+
+    if quick:
+        sizes = [1 << 18]  # 1 MiB f32
+        iters, warmup, ab_passes = 3, 1, 2
+    else:
+        sizes = [1 << 18, 1 << 22, ELEMENTS]  # 1 MiB, 16 MiB, 64 MiB
+        iters, warmup, ab_passes = 8, 2, 5
+    algorithms = ["ring", "hd", "ring_q8_wire"]
+
+    body = textwrap.dedent("""
+        import json, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4])
+        warm = int(sys.argv[5]); algo = sys.argv[6]
+        x = np.full(n, 1.0, dtype=np.float32)
+        for _ in range(warm):
+            ctx.allreduce(x, algorithm=algo)
+            x[:] = 1.0
+        first_seq = len(ctx.profile()["ops"])  # == ring seq after warm-up
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, algorithm=algo)
+            times.append(time.perf_counter() - t0)
+            x[:] = 1.0
+        if rank == 0:
+            snap = ctx.profile()
+            timed = [o for o in snap["ops"]
+                     if o["op"] == "allreduce" and o["seq"] >= first_seq]
+            phases = {{}}
+            total = 0
+            for o in timed:
+                total += o["total_us"]
+                for k, v in o["phases"].items():
+                    phases[k] = phases.get(k, 0) + v
+            out = {{"p50_us": int(np.median(times) * 1e6),
+                    "profiled_ops": len(timed),
+                    "enabled": snap["enabled"],
+                    "mean_total_us": total // max(len(timed), 1),
+                    "mean_phase_us": {{k: v // max(len(timed), 1)
+                                       for k, v in sorted(phases.items())}}}}
+            print("RESULT " + json.dumps(out))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    def run_cell(elements, algo, profile_on):
+        store = tempfile.mkdtemp()
+        env = dict(os.environ, TPUCOLL_SHM="0",
+                   TPUCOLL_PROFILE="1" if profile_on else "0")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", body, str(r), store, str(elements),
+             str(iters), str(warmup), algo],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for r in range(2)]
+        outs = [p.communicate(timeout=600) for p in procs]
+        if any(p.returncode != 0 for p in procs) or \
+                "RESULT " not in outs[0][0]:
+            return None, [f"rank {r}: rc={p.returncode} "
+                          f"err={outs[r][1][-200:]!r}"
+                          for r, p in enumerate(procs)]
+        return json.loads(outs[0][0].split("RESULT ", 1)[1]), None
+
+    ok_all = True
+    for elements in sizes:
+        for algo in algorithms:
+            res, err = run_cell(elements, algo, profile_on=True)
+            line = {"metric": "profile_phases", "algorithm": algo,
+                    "elements": elements, "bytes": elements * 4,
+                    "iters": iters}
+            if res is None:
+                ok_all = False
+                line.update(ok=False, error=err)
+            else:
+                line.update(ok=True, **res)
+            print(json.dumps(line))
+
+    # Overhead A/B on the largest ring cell: interleaved passes so host
+    # drift hits both arms equally; the JSON records both p50 series.
+    ab_elements = sizes[-1]
+    on_us, off_us = [], []
+    ab_errors = []
+    for _ in range(ab_passes):
+        for arm, acc in (("on", on_us), ("off", off_us)):
+            res, err = run_cell(ab_elements, "ring", arm == "on")
+            if res is None:
+                ab_errors.extend(err)
+            else:
+                acc.append(res["p50_us"])
+    line = {"metric": "profile_overhead_ab", "algorithm": "ring",
+            "elements": ab_elements, "bytes": ab_elements * 4,
+            "passes": ab_passes}
+    # A pass failure anywhere invalidates the A/B as committed evidence
+    # (a median over fewer samples than `passes` claims would quietly
+    # understate its own noise): every collected error is emitted and
+    # flips ok=False, even when both arms still have survivors.
+    if not on_us or not off_us or ab_errors:
+        ok_all = False
+        line.update(ok=False, error=ab_errors,
+                    runs_on_us=on_us, runs_off_us=off_us)
+    else:
+        med_on = sorted(on_us)[len(on_us) // 2]
+        med_off = sorted(off_us)[len(off_us) // 2]
+        line.update(ok=True, p50_us_profile_on=med_on,
+                    p50_us_profile_off=med_off,
+                    runs_on_us=on_us, runs_off_us=off_us,
+                    overhead=round(med_on / med_off - 1.0, 4))
+    print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def bench_hier_sweep(quick=False):
     """--hier-sweep: flat (ring) vs hierarchical allreduce per
     (size x simulated hosts x ranks-per-host) cell, one JSON line per
@@ -1252,6 +1382,9 @@ def main():
         return
     if "--hier-sweep" in sys.argv[1:]:
         bench_hier_sweep(quick="--quick" in sys.argv[1:])
+        return
+    if "--profile" in sys.argv[1:]:
+        bench_profile(quick="--quick" in sys.argv[1:])
         return
     if "--elastic-soak" in sys.argv[1:]:
         i = sys.argv.index("--elastic-soak") + 1

@@ -662,6 +662,27 @@ void tc_flightrec_install_signal_handler() {
   wrapVoid([&] { tpucoll::FlightRecorder::installSignalHandler(); });
 }
 
+// ---- phase-level collective profiler (common/profile.h) ----
+
+// Per-op phase-breakdown ring as JSON (docs/profiling.md); non-draining
+// like the flight recorder. Malloc'd, free with tc_buf_free.
+int tc_profile_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    copyOut(asContext(ctx)->profileJson(), out, outLen);
+  });
+}
+
+// Runtime override of the TPUCOLL_PROFILE gate for this context.
+void tc_profile_enable(void* ctx, int on) {
+  wrapVoid([&] { asContext(ctx)->profiler().setEnabled(on != 0); });
+}
+
+int tc_profile_enabled(void* ctx) {
+  return wrapVal(0, [&] {
+    return asContext(ctx)->profiler().enabled() ? 1 : 0;
+  });
+}
+
 // ---- collective autotuning plane (tuning/) ----
 
 // Run the tuner sweep (a COLLECTIVE — every rank must call concurrently

@@ -22,12 +22,15 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 
 namespace tpucoll {
 namespace algorithms {
 
 using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
+using profile::Phase;
+using profile::PhaseScope;
 
 namespace {
 
@@ -117,46 +120,63 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const size_t partBytes = rangeBytes(myPartStart, part);
 
     // Sends: part j of the window goes to group member j.
-    for (int j = 0; j < g; j++) {
-      if (j == digit[s]) {
-        continue;
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int j = 0; j < g; j++) {
+        if (j == digit[s]) {
+          continue;
+        }
+        const int partStart = winStart + j * part;
+        workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
+                      rangeOff(partStart), rangeBytes(partStart, part));
       }
-      const int partStart = winStart + j * part;
-      workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
-                    rangeOff(partStart), rangeBytes(partStart, part));
     }
     const bool fused =
         g == 2 && canFuse(member(s, 1 - digit[s]));  // single sender
     if (fused) {
-      workBuf->recvReduce(member(s, 1 - digit[s]),
-                          stepSlot(0, s, 1 - digit[s]), fn, elsize,
-                          rangeOff(myPartStart), partBytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->recvReduce(member(s, 1 - digit[s]),
+                            stepSlot(0, s, 1 - digit[s]), fn, elsize,
+                            rangeOff(myPartStart), partBytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
       // Receives: each sender's contribution to MY part, staged per sender
       // (slot j at scratch offset j * partBytes) so concurrent arrivals
       // never share memory; reduced in arrival order via the source rank.
       std::unordered_map<int, int> senderDigit;  // src rank -> j
-      for (int j = 0; j < g; j++) {
-        if (j == digit[s]) {
-          continue;
+      {
+        PhaseScope ps(Phase::kPost);
+        for (int j = 0; j < g; j++) {
+          if (j == digit[s]) {
+            continue;
+          }
+          senderDigit[member(s, j)] = j;
+          stage.buf()->recv(member(s, j), stepSlot(0, s, j),
+                            size_t(j) * partBytes, partBytes);
         }
-        senderDigit[member(s, j)] = j;
-        stage.buf()->recv(member(s, j), stepSlot(0, s, j),
-                          size_t(j) * partBytes, partBytes);
       }
       for (int n = 0; n < g - 1; n++) {
         int src = -1;
-        stage.buf()->waitRecv(&src, timeout);
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(&src, timeout);
+        }
         const int j = senderDigit.at(src);
         if (partBytes > 0) {
+          PhaseScope ps(Phase::kReduce);
           fn(work + rangeOff(myPartStart),
              stage.data() + size_t(j) * partBytes, partBytes / elsize);
         }
       }
     }
-    for (int n = 0; n < g - 1; n++) {
-      workBuf->waitSend(timeout);
+    {
+      PhaseScope ps(Phase::kWireWait);
+      for (int n = 0; n < g - 1; n++) {
+        workBuf->waitSend(timeout);
+      }
     }
     winStart = myPartStart;
     winCount = part;
@@ -169,26 +189,32 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int part = winCountAt[s] / g;
     // My current window is part digit[s] of the step-s window; send it to
     // every group member and receive their parts in place.
-    for (int j = 0; j < g; j++) {
-      if (j == digit[s]) {
-        continue;
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int j = 0; j < g; j++) {
+        if (j == digit[s]) {
+          continue;
+        }
+        workBuf->send(member(s, j), stepSlot(1, s, digit[s]),
+                      rangeOff(winStart), rangeBytes(winStart, winCount));
       }
-      workBuf->send(member(s, j), stepSlot(1, s, digit[s]),
-                    rangeOff(winStart), rangeBytes(winStart, winCount));
-    }
-    for (int j = 0; j < g; j++) {
-      if (j == digit[s]) {
-        continue;
+      for (int j = 0; j < g; j++) {
+        if (j == digit[s]) {
+          continue;
+        }
+        const int partStart = stepWinStart + j * part;
+        workBuf->recv(member(s, j), stepSlot(1, s, j), rangeOff(partStart),
+                      rangeBytes(partStart, part));
       }
-      const int partStart = stepWinStart + j * part;
-      workBuf->recv(member(s, j), stepSlot(1, s, j), rangeOff(partStart),
-                    rangeBytes(partStart, part));
     }
-    for (int n = 0; n < g - 1; n++) {
-      workBuf->waitRecv(nullptr, timeout);
-    }
-    for (int n = 0; n < g - 1; n++) {
-      workBuf->waitSend(timeout);
+    {
+      PhaseScope ps(Phase::kWireWait);
+      for (int n = 0; n < g - 1; n++) {
+        workBuf->waitRecv(nullptr, timeout);
+      }
+      for (int n = 0; n < g - 1; n++) {
+        workBuf->waitSend(timeout);
+      }
     }
     winStart = stepWinStart;
     winCount = winCountAt[s];

@@ -18,6 +18,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -26,6 +27,8 @@ using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
 using collectives_detail::SegSpan;
 using collectives_detail::segmentize;
+using profile::Phase;
+using profile::PhaseScope;
 
 namespace {
 
@@ -110,27 +113,39 @@ void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
     const int txSlot = step % 2;
     const uint64_t s = slot.offset(step).value();
     uint16_t* txSeg = tx + txSlot * maxBlockElems;
-    compressSegment(work + blockStart(sendBlock), txSeg,
-                    blockElems(sendBlock));
-    if (fuse) {
-      workBuf->recvReduceTyped(left, s, accumulateBf16Fn,
-                               sizeof(uint16_t), sizeof(float),
-                               blockStart(recvBlock) * sizeof(float),
-                               blockElems(recvBlock) * sizeof(uint16_t));
-    } else {
-      rxStage.buf()->recv(left, s, (step % 2) * wireBlock,
-                          blockElems(recvBlock) * sizeof(uint16_t));
+    {
+      PhaseScope ps(Phase::kPack);
+      compressSegment(work + blockStart(sendBlock), txSeg,
+                      blockElems(sendBlock));
     }
-    txBuf->send(right, s, txSlot * wireBlock,
-                blockElems(sendBlock) * sizeof(uint16_t));
+    {
+      PhaseScope ps(Phase::kPost);
+      if (fuse) {
+        workBuf->recvReduceTyped(left, s, accumulateBf16Fn,
+                                 sizeof(uint16_t), sizeof(float),
+                                 blockStart(recvBlock) * sizeof(float),
+                                 blockElems(recvBlock) * sizeof(uint16_t));
+      } else {
+        rxStage.buf()->recv(left, s, (step % 2) * wireBlock,
+                            blockElems(recvBlock) * sizeof(uint16_t));
+      }
+      txBuf->send(right, s, txSlot * wireBlock,
+                  blockElems(sendBlock) * sizeof(uint16_t));
+    }
     if (fuse) {
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      rxStage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        rxStage.buf()->waitRecv(nullptr, timeout);
+      }
+      PhaseScope ps(Phase::kReduce);
       accumulateCompressed(work + blockStart(recvBlock),
                            rx() + (step % 2) * maxBlockElems,
                            blockElems(recvBlock));
     }
+    PhaseScope ps(Phase::kWireWait);
     txBuf->waitSend(timeout);
   }
 
@@ -142,6 +157,7 @@ void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
   // identical, see above). ---
   const uint64_t agBase = steps;
   {
+    PhaseScope ps(Phase::kPack);
     const int own = (rank + 1) % size;
     compressSegment(work + blockStart(own), tx, blockElems(own));
     decodeSegment(tx, work + blockStart(own), blockElems(own));
@@ -156,32 +172,43 @@ void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
       // Own block already sits compressed in tx slot 0.
     } else if (fuse) {
       // Re-compress the block decoded last step (exact roundtrip).
+      PhaseScope ps(Phase::kPack);
       compressSegment(work + blockStart(sendBlock),
                       tx + txSlot * maxBlockElems, blockElems(sendBlock));
     } else {
       // Forward the wire bytes received last step.
+      PhaseScope ps(Phase::kPack);
       std::memcpy(tx + txSlot * maxBlockElems,
                   rx() + ((step - 1) % 2) * maxBlockElems,
                   blockElems(sendBlock) * sizeof(uint16_t));
     }
-    if (fuse) {
-      workBuf->recvReduceTyped(left, s, decodeBf16Fn, sizeof(uint16_t),
-                               sizeof(float),
-                               blockStart(recvBlock) * sizeof(float),
-                               blockElems(recvBlock) * sizeof(uint16_t));
-    } else {
-      rxStage.buf()->recv(left, s, rxSlot * wireBlock,
-                          blockElems(recvBlock) * sizeof(uint16_t));
+    {
+      PhaseScope ps(Phase::kPost);
+      if (fuse) {
+        workBuf->recvReduceTyped(left, s, decodeBf16Fn, sizeof(uint16_t),
+                                 sizeof(float),
+                                 blockStart(recvBlock) * sizeof(float),
+                                 blockElems(recvBlock) * sizeof(uint16_t));
+      } else {
+        rxStage.buf()->recv(left, s, rxSlot * wireBlock,
+                            blockElems(recvBlock) * sizeof(uint16_t));
+      }
+      txBuf->send(right, s, txSlot * wireBlock,
+                  blockElems(sendBlock) * sizeof(uint16_t));
     }
-    txBuf->send(right, s, txSlot * wireBlock,
-                blockElems(sendBlock) * sizeof(uint16_t));
     if (fuse) {
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      rxStage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        rxStage.buf()->waitRecv(nullptr, timeout);
+      }
+      PhaseScope ps(Phase::kUnpack);
       decodeSegment(rx() + rxSlot * maxBlockElems,
                     work + blockStart(recvBlock), blockElems(recvBlock));
     }
+    PhaseScope ps(Phase::kWireWait);
     txBuf->waitSend(timeout);
   }
 }

@@ -27,6 +27,7 @@
 #include "tpucoll/collectives/algorithms.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -37,6 +38,8 @@ using collectives_detail::evenBlocks;
 using collectives_detail::largestPow2AtMost;
 using collectives_detail::fuseRecvReduce;
 using plan::LazyStage;
+using profile::Phase;
+using profile::PhaseScope;
 
 namespace {
 
@@ -79,17 +82,33 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
   int vrank;
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
-      workBuf->send(rank - 1, slot.offset(round).value(), 0, nbytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->send(rank - 1, slot.offset(round).value(), 0, nbytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitSend(timeout);
       vrank = -1;
     } else {
       if (canFuse(rank + 1)) {
-        workBuf->recvReduce(rank + 1, slot.offset(round).value(), fn,
-                            elsize, 0, nbytes);
+        {
+          PhaseScope ps(Phase::kPost);
+          workBuf->recvReduce(rank + 1, slot.offset(round).value(), fn,
+                              elsize, 0, nbytes);
+        }
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->recv(rank + 1, slot.offset(round).value(), 0, nbytes);
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kPost);
+          stage.buf()->recv(rank + 1, slot.offset(round).value(), 0,
+                            nbytes);
+        }
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
+        PhaseScope ps(Phase::kReduce);
         fn(work, stage.data(), count);
       }
       vrank = rank / 2;
@@ -119,28 +138,40 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
       const int sendStart = keepLower ? winStart + half : winStart;
       const uint64_t s = slot.offset(round).value();
       const bool fused = canFuse(partner);
-      if (fused) {
-        // Combined into the kept range on arrival; the sent half is
-        // disjoint, so the in-flight send never reads combined bytes.
-        workBuf->recvReduce(partner, s, fn, elsize, rangeOff(keepStart),
+      {
+        PhaseScope ps(Phase::kPost);
+        if (fused) {
+          // Combined into the kept range on arrival; the sent half is
+          // disjoint, so the in-flight send never reads combined bytes.
+          workBuf->recvReduce(partner, s, fn, elsize, rangeOff(keepStart),
+                              rangeBytes(keepStart, half));
+        } else {
+          // Receive into the scratch mirror at the kept range's own
+          // offsets.
+          stage.buf()->recv(partner, s, rangeOff(keepStart),
                             rangeBytes(keepStart, half));
-      } else {
-        // Receive into the scratch mirror at the kept range's own offsets.
-        stage.buf()->recv(partner, s, rangeOff(keepStart),
-                          rangeBytes(keepStart, half));
+        }
+        workBuf->send(partner, s, rangeOff(sendStart),
+                      rangeBytes(sendStart, half));
       }
-      workBuf->send(partner, s, rangeOff(sendStart),
-                    rangeBytes(sendStart, half));
       if (fused) {
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
         if (rangeBytes(keepStart, half) > 0) {
+          PhaseScope ps(Phase::kReduce);
           fn(work + rangeOff(keepStart), stage.data() + rangeOff(keepStart),
              rangeBytes(keepStart, half) / elsize);
         }
       }
-      workBuf->waitSend(timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        workBuf->waitSend(timeout);
+      }
       winStart = keepStart;
       winCount = half;
     }
@@ -150,10 +181,14 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
       const int partner = physical(vrank ^ mask);
       const int partnerStart = winStart ^ winCount;  // sibling window
       const uint64_t s = slot.offset(round).value();
-      workBuf->recv(partner, s, rangeOff(partnerStart),
-                    rangeBytes(partnerStart, winCount));
-      workBuf->send(partner, s, rangeOff(winStart),
-                    rangeBytes(winStart, winCount));
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->recv(partner, s, rangeOff(partnerStart),
+                      rangeBytes(partnerStart, winCount));
+        workBuf->send(partner, s, rangeOff(winStart),
+                      rangeBytes(winStart, winCount));
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
       workBuf->waitSend(timeout);
       winStart = std::min(winStart, partnerStart);
@@ -166,10 +201,18 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
   const uint64_t finalSlot = slot.offset(kUnfoldSlot).value();
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
-      workBuf->recv(rank - 1, finalSlot, 0, nbytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->recv(rank - 1, finalSlot, 0, nbytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      workBuf->send(rank + 1, finalSlot, 0, nbytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->send(rank + 1, finalSlot, 0, nbytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitSend(timeout);
     }
   }
@@ -231,24 +274,36 @@ void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int sendStart = keepLower ? winStart + half : winStart;
     const uint64_t s = slot.offset(kRsBase + step).value();
     const bool fused = canFuse(partner);
-    if (fused) {
-      workBuf->recvReduce(partner, s, fn, elsize, atomOff(keepStart),
+    {
+      PhaseScope ps(Phase::kPost);
+      if (fused) {
+        workBuf->recvReduce(partner, s, fn, elsize, atomOff(keepStart),
+                            atomBytes(keepStart, half));
+      } else {
+        stage.buf()->recv(partner, s, atomOff(keepStart),
                           atomBytes(keepStart, half));
-    } else {
-      stage.buf()->recv(partner, s, atomOff(keepStart),
-                        atomBytes(keepStart, half));
+      }
+      workBuf->send(partner, s, atomOff(sendStart),
+                    atomBytes(sendStart, half));
     }
-    workBuf->send(partner, s, atomOff(sendStart), atomBytes(sendStart, half));
     if (fused) {
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      stage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        stage.buf()->waitRecv(nullptr, timeout);
+      }
       if (atomBytes(keepStart, half) > 0) {
+        PhaseScope ps(Phase::kReduce);
         fn(work + atomOff(keepStart), stage.data() + atomOff(keepStart),
            atomBytes(keepStart, half) / elsize);
       }
     }
-    workBuf->waitSend(timeout);
+    {
+      PhaseScope ps(Phase::kWireWait);
+      workBuf->waitSend(timeout);
+    }
     winStart = keepStart;
     winCount = half;
   }
@@ -266,14 +321,25 @@ void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
     if (canFuse(peer)) {
       // No send is in flight on this side of the exchange; the partial
       // combines into the window in place.
-      workBuf->recvReduce(peer, s, fn, elsize, atomOff(winStart),
-                          atomBytes(winStart, winCount));
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->recvReduce(peer, s, fn, elsize, atomOff(winStart),
+                            atomBytes(winStart, winCount));
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      stage.buf()->recv(peer, s, atomOff(winStart),
-                        atomBytes(winStart, winCount));
-      stage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kPost);
+        stage.buf()->recv(peer, s, atomOff(winStart),
+                          atomBytes(winStart, winCount));
+      }
+      {
+        PhaseScope ps(Phase::kWireWait);
+        stage.buf()->waitRecv(nullptr, timeout);
+      }
       if (atomBytes(winStart, winCount) > 0) {
+        PhaseScope ps(Phase::kReduce);
         fn(work + atomOff(winStart), stage.data() + atomOff(winStart),
            atomBytes(winStart, winCount) / elsize);
       }
@@ -284,20 +350,30 @@ void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int Aup = Bmax / bsize[b - 1];  // atoms per larger-side window
     const uint64_t fwd = slot.offset(kFwdBase + b - 1).value();
     const uint64_t bwd = slot.offset(kBwdBase + b - 1).value();
-    for (int j = 0; j < ratioUp; j++) {
-      const int rUp = r * ratioUp + j;
-      workBuf->send(boff[b - 1] + rUp, fwd, atomOff(rUp * Aup),
-                    atomBytes(rUp * Aup, Aup));
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int j = 0; j < ratioUp; j++) {
+        const int rUp = r * ratioUp + j;
+        workBuf->send(boff[b - 1] + rUp, fwd, atomOff(rUp * Aup),
+                      atomBytes(rUp * Aup, Aup));
+      }
     }
-    for (int j = 0; j < ratioUp; j++) {
-      workBuf->waitSend(timeout);
+    {
+      PhaseScope ps(Phase::kWireWait);
+      for (int j = 0; j < ratioUp; j++) {
+        workBuf->waitSend(timeout);
+      }
     }
     // --- backward leg: fully reduced pieces come back in place ---
-    for (int j = 0; j < ratioUp; j++) {
-      const int rUp = r * ratioUp + j;
-      workBuf->recv(boff[b - 1] + rUp, bwd, atomOff(rUp * Aup),
-                    atomBytes(rUp * Aup, Aup));
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int j = 0; j < ratioUp; j++) {
+        const int rUp = r * ratioUp + j;
+        workBuf->recv(boff[b - 1] + rUp, bwd, atomOff(rUp * Aup),
+                      atomBytes(rUp * Aup, Aup));
+      }
     }
+    PhaseScope ps(Phase::kWireWait);
     for (int j = 0; j < ratioUp; j++) {
       workBuf->waitRecv(nullptr, timeout);
     }
@@ -306,7 +382,12 @@ void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int ratio = B / bsize[b + 1];
     const int peer = boff[b + 1] + r / ratio;
     const uint64_t s = slot.offset(kBwdBase + b).value();
-    workBuf->send(peer, s, atomOff(winStart), atomBytes(winStart, winCount));
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->send(peer, s, atomOff(winStart),
+                    atomBytes(winStart, winCount));
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitSend(timeout);
   }
 
@@ -316,10 +397,14 @@ void hdBinaryBlocksAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int partner = boff[b] + (r ^ mask);
     const int partnerStart = winStart ^ winCount;  // sibling window
     const uint64_t s = slot.offset(kAgBase + step).value();
-    workBuf->recv(partner, s, atomOff(partnerStart),
-                  atomBytes(partnerStart, winCount));
-    workBuf->send(partner, s, atomOff(winStart),
-                  atomBytes(winStart, winCount));
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->recv(partner, s, atomOff(partnerStart),
+                    atomBytes(partnerStart, winCount));
+      workBuf->send(partner, s, atomOff(winStart),
+                    atomBytes(winStart, winCount));
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitRecv(nullptr, timeout);
     workBuf->waitSend(timeout);
     winStart = std::min(winStart, partnerStart);
@@ -350,19 +435,34 @@ void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
   int vrank;
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
-      workBuf->send(rank - 1, slot.offset(kFoldBase).value(), 0, nbytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        workBuf->send(rank - 1, slot.offset(kFoldBase).value(), 0, nbytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitSend(timeout);
       vrank = -1;
     } else {
       if (canFuse(rank + 1)) {
-        workBuf->recvReduce(rank + 1, slot.offset(kFoldBase).value(), fn,
-                            elsize, 0, nbytes);
+        {
+          PhaseScope ps(Phase::kPost);
+          workBuf->recvReduce(rank + 1, slot.offset(kFoldBase).value(),
+                              fn, elsize, 0, nbytes);
+        }
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->recv(rank + 1, slot.offset(kFoldBase).value(), 0,
-                          nbytes);
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kPost);
+          stage.buf()->recv(rank + 1, slot.offset(kFoldBase).value(), 0,
+                            nbytes);
+        }
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
         if (nbytes > 0) {
+          PhaseScope ps(Phase::kReduce);
           fn(work, stage.data(), nbytes / elsize);
         }
       }
@@ -394,19 +494,28 @@ void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
       const uint64_t s = slot.offset(kRsBase + step).value();
       const size_t keepBytes = blocks.rangeBytes(keepStart, keepCount);
       const bool fused = canFuse(partner);
-      if (fused) {
-        workBuf->recvReduce(partner, s, fn, elsize,
-                            blocks.offset[keepStart], keepBytes);
-      } else {
-        stage.buf()->recv(partner, s, blocks.offset[keepStart], keepBytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        if (fused) {
+          workBuf->recvReduce(partner, s, fn, elsize,
+                              blocks.offset[keepStart], keepBytes);
+        } else {
+          stage.buf()->recv(partner, s, blocks.offset[keepStart],
+                            keepBytes);
+        }
+        workBuf->send(partner, s, blocks.offset[sendStart],
+                      blocks.rangeBytes(sendStart, sendCount));
       }
-      workBuf->send(partner, s, blocks.offset[sendStart],
-                    blocks.rangeBytes(sendStart, sendCount));
       if (fused) {
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
         if (keepBytes > 0) {
+          PhaseScope ps(Phase::kReduce);
           fn(work + blocks.offset[keepStart],
              stage.data() + blocks.offset[keepStart], keepBytes / elsize);
         }
@@ -442,6 +551,7 @@ void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
     return v;
   };
   if (vrank >= 0) {
+    PhaseScope ps(Phase::kPost);
     for (int j = winStart; j < winStart + winCount; j++) {
       if (j == rank || blocks.bytes[j] == 0) {
         continue;
@@ -453,10 +563,16 @@ void hdReduceScatter(Context* ctx, plan::Plan& plan, char* work,
   }
   const int owner = physical(ownerOf(rank));
   if (owner != rank && blocks.bytes[rank] > 0) {
-    workBuf->recv(owner, slot.offset(kRedistBase + uint64_t(rank)).value(),
-                  blocks.offset[rank], blocks.bytes[rank]);
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->recv(owner,
+                    slot.offset(kRedistBase + uint64_t(rank)).value(),
+                    blocks.offset[rank], blocks.bytes[rank]);
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitRecv(nullptr, timeout);
   }
+  PhaseScope ps(Phase::kWireWait);
   for (int i = 0; i < pendingSends; i++) {
     workBuf->waitSend(timeout);
   }
@@ -473,13 +589,16 @@ void directReduceScatter(Context* ctx, plan::Plan& plan, char* work,
   // One latency round: ship this rank's copy of block j straight to
   // rank j, all P-1 transfers concurrently in flight.
   int sends = 0;
-  for (int j = 0; j < size; j++) {
-    if (j == rank || blocks.bytes[j] == 0) {
-      continue;
+  {
+    PhaseScope ps(Phase::kPost);
+    for (int j = 0; j < size; j++) {
+      if (j == rank || blocks.bytes[j] == 0) {
+        continue;
+      }
+      workBuf->send(j, slot.offset(uint64_t(j)).value(), blocks.offset[j],
+                    blocks.bytes[j]);
+      sends++;
     }
-    workBuf->send(j, slot.offset(uint64_t(j)).value(), blocks.offset[j],
-                  blocks.bytes[j]);
-    sends++;
   }
   // P-1 partials land in this rank's block. The combines are serialized
   // (one outstanding recvReduce at a time): combine-on-arrival may run
@@ -494,19 +613,31 @@ void directReduceScatter(Context* ctx, plan::Plan& plan, char* work,
         continue;
       }
       if (fuseRecvReduce(ctx, fuseOk, elsize, s)) {
-        workBuf->recvReduce(s, slot.offset(uint64_t(rank)).value(), fn,
-                            elsize, blocks.offset[rank],
-                            blocks.bytes[rank]);
+        {
+          PhaseScope ps(Phase::kPost);
+          workBuf->recvReduce(s, slot.offset(uint64_t(rank)).value(), fn,
+                              elsize, blocks.offset[rank],
+                              blocks.bytes[rank]);
+        }
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->recv(s, slot.offset(uint64_t(rank)).value(), 0,
-                          blocks.bytes[rank]);
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kPost);
+          stage.buf()->recv(s, slot.offset(uint64_t(rank)).value(), 0,
+                            blocks.bytes[rank]);
+        }
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
+        PhaseScope ps(Phase::kReduce);
         fn(work + blocks.offset[rank], stage.data(),
            blocks.bytes[rank] / elsize);
       }
     }
   }
+  PhaseScope ps(Phase::kWireWait);
   for (int i = 0; i < sends; i++) {
     workBuf->waitSend(timeout);
   }
@@ -552,9 +683,19 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
   const bool paired = rank < 2 * rem && (rank & 1) == 0;
   if (extra) {
     // Extras never touch scratch — keep their path allocation-free.
-    workBuf->send(rank - 1, slot.offset(0).value(), 0, nbytes);
-    workBuf->waitSend(timeout);
-    workBuf->recv(rank - 1, slot.offset(1).value(), 0, nbytes);
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->send(rank - 1, slot.offset(0).value(), 0, nbytes);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
+      workBuf->waitSend(timeout);
+    }
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->recv(rank - 1, slot.offset(1).value(), 0, nbytes);
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitRecv(nullptr, timeout);
     return;
   }
@@ -567,8 +708,15 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
   char* scratch = st.data;
   transport::UnboundBuffer* scratchBuf = st.buf;
   if (paired) {
-    scratchBuf->recv(rank + 1, slot.offset(0).value(), 0, nbytes);
-    scratchBuf->waitRecv(nullptr, timeout);
+    {
+      PhaseScope ps(Phase::kPost);
+      scratchBuf->recv(rank + 1, slot.offset(0).value(), 0, nbytes);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
+      scratchBuf->waitRecv(nullptr, timeout);
+    }
+    PhaseScope ps(Phase::kReduce);
     fn(work, scratch, count);
   }
   // Survivors renumber into a dense [0, p2) space for the XOR walk.
@@ -577,14 +725,25 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
   for (int k = 1; k < p2; k <<= 1, round++) {
     const int rdPartner = rdRank ^ k;
     const int partner = rdPartner < rem ? 2 * rdPartner : rdPartner + rem;
-    workBuf->send(partner, slot.offset(2 + round).value(), 0, nbytes);
-    scratchBuf->recv(partner, slot.offset(2 + round).value(), 0, nbytes);
-    workBuf->waitSend(timeout);
-    scratchBuf->waitRecv(nullptr, timeout);
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->send(partner, slot.offset(2 + round).value(), 0, nbytes);
+      scratchBuf->recv(partner, slot.offset(2 + round).value(), 0, nbytes);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
+      workBuf->waitSend(timeout);
+      scratchBuf->waitRecv(nullptr, timeout);
+    }
+    PhaseScope ps(Phase::kReduce);
     fn(work, scratch, count);
   }
   if (paired) {
-    workBuf->send(rank + 1, slot.offset(1).value(), 0, nbytes);
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->send(rank + 1, slot.offset(1).value(), 0, nbytes);
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitSend(timeout);
   }
 }

@@ -6,9 +6,14 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 #include "tpucoll/group/hier.h"
 
 namespace tpucoll {
+
+using profile::Phase;
+using profile::PhaseScope;
+using profile::ProfileOpScope;
 
 namespace {
 
@@ -33,6 +38,7 @@ void barrier(BarrierOptions& opts) {
   FlightRecOp frOp(&ctx->flightrec(), "barrier", nullptr,
                    Slot::build(SlotPrefix::kBarrier, opts.tag).value(), -1,
                    0, FlightRecorder::kNoDtype);
+  ProfileOpScope profOp(&ctx->profiler(), "barrier", frOp.cseq(), 0);
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -41,6 +47,7 @@ void barrier(BarrierOptions& opts) {
   }
   if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx)) {
     frOp.setAlgorithm("hier");
+    profOp.setAlgorithm("hier");
     group::hierBarrier(ctx, opts.tag, timeout);
     return;
   }
@@ -55,8 +62,12 @@ void barrier(BarrierOptions& opts) {
     const int dist = 1 << i;
     const int to = (rank + dist) % size;
     const int from = (rank - dist + size) % size;
-    buf->send(to, slot.offset(i).value(), 0, 0);
-    buf->recv(from, slot.offset(i).value(), 0, 0);
+    {
+      PhaseScope ps(Phase::kPost);
+      buf->send(to, slot.offset(i).value(), 0, 0);
+      buf->recv(from, slot.offset(i).value(), 0, 0);
+    }
+    PhaseScope ps(Phase::kWireWait);
     buf->waitSend(timeout);
     buf->waitRecv(nullptr, timeout);
   }
@@ -77,6 +88,8 @@ void broadcast(BroadcastOptions& opts) {
                    Slot::build(SlotPrefix::kBroadcast, opts.tag).value(),
                    opts.root, opts.count * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "broadcast", frOp.cseq(),
+                        opts.count * elementSize(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -88,6 +101,7 @@ void broadcast(BroadcastOptions& opts) {
   }
   if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx)) {
     frOp.setAlgorithm("hier");
+    profOp.setAlgorithm("hier");
     group::hierBroadcast(ctx, opts.buffer, opts.count, opts.dtype,
                          opts.root, opts.tag, timeout);
     return;
@@ -138,21 +152,29 @@ void broadcast(BroadcastOptions& opts) {
 
   int pendingSends = 0;
   if (parent >= 0) {
-    for (size_t k = 0; k < numSegs; k++) {
-      auto [off, len] = segSpan(k);
-      buf->recv(parent, slot.offset(k).value(), off, len);
+    {
+      PhaseScope ps(Phase::kPost);
+      for (size_t k = 0; k < numSegs; k++) {
+        auto [off, len] = segSpan(k);
+        buf->recv(parent, slot.offset(k).value(), off, len);
+      }
     }
     for (size_t k = 0; k < numSegs; k++) {
       auto [off, len] = segSpan(k);
-      buf->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        buf->waitRecv(nullptr, timeout);
+      }
       // Relay this segment onward the moment it lands (wire order makes
       // completion k the k-th segment).
+      PhaseScope ps(Phase::kPost);
       for (int child : children) {
         buf->send(child, slot.offset(k).value(), off, len);
         pendingSends++;
       }
     }
   } else {
+    PhaseScope ps(Phase::kPost);
     for (size_t k = 0; k < numSegs; k++) {
       auto [off, len] = segSpan(k);
       for (int child : children) {
@@ -161,6 +183,7 @@ void broadcast(BroadcastOptions& opts) {
       }
     }
   }
+  PhaseScope ps(Phase::kWireWait);
   while (pendingSends-- > 0) {
     buf->waitSend(timeout);
   }
@@ -182,6 +205,8 @@ void gather(GatherOptions& opts) {
                    Slot::build(SlotPrefix::kGather, opts.tag).value(),
                    opts.root, opts.count * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "gather", frOp.cseq(),
+                        opts.count * elementSize(opts.dtype));
   GathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -212,6 +237,8 @@ void gatherv(GathervOptions& opts) {
                    Slot::build(SlotPrefix::kGather, opts.tag).value(),
                    opts.root, myBytes, static_cast<uint8_t>(opts.dtype),
                    totalCount * elementSize(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "gatherv", frOp.cseq(),
+                        myBytes);
   gathervRun(opts);
 }
 
@@ -250,20 +277,27 @@ static void gathervRun(GathervOptions& opts) {
     for (int j = 0; j < size; j++) {
       const size_t jBytes = opts.counts[j] * elsize;
       if (j == rank) {
+        PhaseScope ps(Phase::kPack);
         std::memcpy(bytePtr(opts.output) + offset, opts.input, jBytes);
       } else {
+        PhaseScope ps(Phase::kPost);
         out->recv(j, slot.value(), offset, jBytes);
         pending++;
       }
       offset += jBytes;
     }
+    PhaseScope ps(Phase::kWireWait);
     while (pending-- > 0) {
       out->waitRecv(nullptr, timeout);
     }
   } else {
     auto* in =
         planh->userBuf(0, const_cast<void*>(opts.input), myBytes);
-    in->send(opts.root, slot.value(), 0, myBytes);
+    {
+      PhaseScope ps(Phase::kPost);
+      in->send(opts.root, slot.value(), 0, myBytes);
+    }
+    PhaseScope ps(Phase::kWireWait);
     in->waitSend(timeout);
   }
 }
@@ -280,6 +314,8 @@ void scatter(ScatterOptions& opts) {
                    Slot::build(SlotPrefix::kScatter, opts.tag).value(),
                    opts.root, opts.count * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "scatter", frOp.cseq(),
+                        opts.count * elementSize(opts.dtype));
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -302,18 +338,25 @@ void scatter(ScatterOptions& opts) {
     int pending = 0;
     for (int j = 0; j < size; j++) {
       if (j == rank) {
+        PhaseScope ps(Phase::kUnpack);
         std::memcpy(opts.output, bytePtr(opts.input) + j * nbytes, nbytes);
       } else {
+        PhaseScope ps(Phase::kPost);
         in->send(j, slot.value(), j * nbytes, nbytes);
         pending++;
       }
     }
+    PhaseScope ps(Phase::kWireWait);
     while (pending-- > 0) {
       in->waitSend(timeout);
     }
   } else {
     auto* out = planh->userBuf(0, opts.output, nbytes);
-    out->recv(opts.root, slot.value(), 0, nbytes);
+    {
+      PhaseScope ps(Phase::kPost);
+      out->recv(opts.root, slot.value(), 0, nbytes);
+    }
+    PhaseScope ps(Phase::kWireWait);
     out->waitRecv(nullptr, timeout);
   }
 }
@@ -356,10 +399,13 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
   // per-round wire stages (slots 1/2), all plan-backed.
   uint8_t* tmp = reinterpret_cast<uint8_t*>(
       planh->scratch(0, static_cast<size_t>(size) * blockBytes));
-  for (int j = 0; j < size; j++) {
-    std::memcpy(tmp + static_cast<size_t>(j) * blockBytes,
-                in + static_cast<size_t>((rank + j) % size) * blockBytes,
-                blockBytes);
+  {
+    PhaseScope ps(Phase::kPack);
+    for (int j = 0; j < size; j++) {
+      std::memcpy(tmp + static_cast<size_t>(j) * blockBytes,
+                  in + static_cast<size_t>((rank + j) % size) * blockBytes,
+                  blockBytes);
+    }
   }
 
   const size_t maxBlocks = static_cast<size_t>((size + 1) / 2);
@@ -373,20 +419,30 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
 
   for (int k = 1; k < size; k <<= 1) {
     size_t nblocks = 0;
-    for (int j = k; j < size; j++) {
-      if ((j & k) != 0) {
-        std::memcpy(sendStage + nblocks * blockBytes,
-                    tmp + static_cast<size_t>(j) * blockBytes,
-                    blockBytes);
-        nblocks++;
+    {
+      PhaseScope ps(Phase::kPack);
+      for (int j = k; j < size; j++) {
+        if ((j & k) != 0) {
+          std::memcpy(sendStage + nblocks * blockBytes,
+                      tmp + static_cast<size_t>(j) * blockBytes,
+                      blockBytes);
+          nblocks++;
+        }
       }
     }
     const int sendTo = (rank + k) % size;
     const int recvFrom = (rank - k + size) % size;
-    sendBuf->send(sendTo, slot.value(), 0, nblocks * blockBytes);
-    recvBuf->recv(recvFrom, slot.value(), 0, nblocks * blockBytes);
-    sendBuf->waitSend(timeout);
-    recvBuf->waitRecv(nullptr, timeout);
+    {
+      PhaseScope ps(Phase::kPost);
+      sendBuf->send(sendTo, slot.value(), 0, nblocks * blockBytes);
+      recvBuf->recv(recvFrom, slot.value(), 0, nblocks * blockBytes);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
+      sendBuf->waitSend(timeout);
+      recvBuf->waitRecv(nullptr, timeout);
+    }
+    PhaseScope ps(Phase::kUnpack);
     size_t b = 0;
     for (int j = k; j < size; j++) {
       if ((j & k) != 0) {
@@ -397,6 +453,7 @@ void bruckAlltoall(Context* ctx, const AlltoallOptions& opts,
     }
   }
 
+  PhaseScope ps(Phase::kUnpack);
   for (int j = 0; j < size; j++) {
     std::memcpy(out + static_cast<size_t>((rank - j + size) % size) *
                           blockBytes,
@@ -421,6 +478,8 @@ void alltoall(AlltoallOptions& opts) {
                    Slot::build(SlotPrefix::kAlltoall, opts.tag).value(),
                    -1, blockBytes * ctx->size(),
                    static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "alltoall", frOp.cseq(),
+                        blockBytes * ctx->size());
   // Crossover: Bruck's ceil(log2 P) rounds win while per-block payload
   // is latency-dominated; the pairwise exchange's P-1 single-hop
   // rounds win once bandwidth dominates (each Bruck block travels up
@@ -436,6 +495,7 @@ void alltoall(AlltoallOptions& opts) {
     auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
                                         "bruck");
     frOp.setAlgorithm("bruck");
+    profOp.setAlgorithm("bruck");
     bruckAlltoall(ctx, opts, blockBytes,
                   detail::effectiveTimeout(opts));
     return;
@@ -443,6 +503,7 @@ void alltoall(AlltoallOptions& opts) {
   auto traceSpan = ctx->tracer().span("alltoall", blockBytes, -1,
                                       "pairwise");
   frOp.setAlgorithm("pairwise");
+  profOp.setAlgorithm("pairwise");
   AlltoallvOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -469,6 +530,8 @@ void alltoallv(AlltoallvOptions& opts) {
                    Slot::build(SlotPrefix::kAlltoall, opts.tag).value(),
                    -1, inCountTotal * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype), /*fpBytes=*/0);
+  ProfileOpScope profOp(&ctx->profiler(), "alltoallv", frOp.cseq(),
+                        inCountTotal * elementSize(opts.dtype));
   alltoallvRun(opts);
 }
 
@@ -509,9 +572,12 @@ static void alltoallvRun(AlltoallvOptions& opts) {
       1, [&] { return collectives_detail::countBlocks(opts.outCounts,
                                                       elsize); });
 
-  std::memcpy(bytePtr(opts.output) + outBlocks.offset[rank],
-              bytePtr(opts.input) + inBlocks.offset[rank],
-              opts.inCounts[rank] * elsize);
+  {
+    PhaseScope ps(Phase::kPack);
+    std::memcpy(bytePtr(opts.output) + outBlocks.offset[rank],
+                bytePtr(opts.input) + inBlocks.offset[rank],
+                opts.inCounts[rank] * elsize);
+  }
   if (size == 1) {
     return;
   }
@@ -523,10 +589,14 @@ static void alltoallvRun(AlltoallvOptions& opts) {
   for (int i = 1; i < size; i++) {
     const int sendTo = (rank + i) % size;
     const int recvFrom = (rank - i + size) % size;
-    in->send(sendTo, slot.value(), inBlocks.offset[sendTo],
-             opts.inCounts[sendTo] * elsize);
-    out->recv(recvFrom, slot.value(), outBlocks.offset[recvFrom],
-              opts.outCounts[recvFrom] * elsize);
+    {
+      PhaseScope ps(Phase::kPost);
+      in->send(sendTo, slot.value(), inBlocks.offset[sendTo],
+               opts.inCounts[sendTo] * elsize);
+      out->recv(recvFrom, slot.value(), outBlocks.offset[recvFrom],
+                opts.outCounts[recvFrom] * elsize);
+    }
+    PhaseScope ps(Phase::kWireWait);
     in->waitSend(timeout);
     out->waitRecv(nullptr, timeout);
   }

@@ -35,6 +35,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -50,6 +51,8 @@ static_assert(transport::kMaxCombineElsize >=
 
 using collectives_detail::Blocks;
 using collectives_detail::evenBlocks;
+using profile::Phase;
+using profile::PhaseScope;
 
 namespace {
 
@@ -107,29 +110,42 @@ void q8RingReduceScatterPhase(Context* ctx, float* work,
     const size_t sendWire = q8WireBytes(sendElems, block);
     const size_t recvWire = q8WireBytes(recvElems, block);
     uint8_t* txSeg = tx + size_t(txSlot) * wireBlock;
-    f32StreamToQ8(work + blockStart(sendBlock), txSeg, sendElems, block);
+    {
+      PhaseScope ps(Phase::kPack);
+      f32StreamToQ8(work + blockStart(sendBlock), txSeg, sendElems, block);
+    }
     // Whole-unit hops fold straight out of the transport's staging into
     // the float32 accumulator; ragged tails (and empty blocks) stage.
     const bool fuse = pairFuse && recvElems > 0 && recvElems % block == 0;
-    if (fuse) {
-      workBuf->recvReduceTyped(left, s, accumulateQ8UnitsFn, unit,
-                               block * sizeof(float),
-                               blockStart(recvBlock) * sizeof(float),
-                               recvWire);
-    } else {
-      rxStage.buf()->recv(left, s, size_t(step % 2) * wireBlock, recvWire);
+    {
+      PhaseScope ps(Phase::kPost);
+      if (fuse) {
+        workBuf->recvReduceTyped(left, s, accumulateQ8UnitsFn, unit,
+                                 block * sizeof(float),
+                                 blockStart(recvBlock) * sizeof(float),
+                                 recvWire);
+      } else {
+        rxStage.buf()->recv(left, s, size_t(step % 2) * wireBlock,
+                            recvWire);
+      }
+      txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
     }
-    txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
     if (fuse) {
+      PhaseScope ps(Phase::kWireWait);
       workBuf->waitRecv(nullptr, timeout);
     } else {
-      rxStage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        rxStage.buf()->waitRecv(nullptr, timeout);
+      }
+      PhaseScope ps(Phase::kReduce);
       q8StreamAccumulate(
           work + blockStart(recvBlock),
           reinterpret_cast<uint8_t*>(rxStage.data()) +
               size_t(step % 2) * wireBlock,
           recvElems, block);
     }
+    PhaseScope ps(Phase::kWireWait);
     txBuf->waitSend(timeout);
   }
 }
@@ -185,6 +201,7 @@ void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
   // the exact same stream and results are identical everywhere. ---
   const uint64_t agBase = steps;
   {
+    PhaseScope ps(Phase::kPack);
     const int own = (rank + 1) % size;
     f32StreamToQ8(work + blockStart(own), tx, blockElems(own), block);
     q8StreamToF32(tx, work + blockStart(own), blockElems(own), block);
@@ -202,16 +219,27 @@ void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
       // Own block already sits quantized in tx slot 0.
     } else {
       // Forward the wire bytes received last step, verbatim.
+      PhaseScope ps(Phase::kPack);
       std::memcpy(tx + size_t(txSlot) * wireBlock,
                   rx + size_t((step - 1) % 2) * wireBlock, sendWire);
     }
-    rxStage.buf()->recv(left, s, size_t(rxSlot) * wireBlock, recvWire);
-    rx = reinterpret_cast<uint8_t*>(rxStage.data());
-    txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
-    rxStage.buf()->waitRecv(nullptr, timeout);
-    q8StreamToF32(rx + size_t(rxSlot) * wireBlock,
-                  work + blockStart(recvBlock), blockElems(recvBlock),
-                  block);
+    {
+      PhaseScope ps(Phase::kPost);
+      rxStage.buf()->recv(left, s, size_t(rxSlot) * wireBlock, recvWire);
+      rx = reinterpret_cast<uint8_t*>(rxStage.data());
+      txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
+      rxStage.buf()->waitRecv(nullptr, timeout);
+    }
+    {
+      PhaseScope ps(Phase::kUnpack);
+      q8StreamToF32(rx + size_t(rxSlot) * wireBlock,
+                    work + blockStart(recvBlock), blockElems(recvBlock),
+                    block);
+    }
+    PhaseScope ps(Phase::kWireWait);
     txBuf->waitSend(timeout);
   }
 }

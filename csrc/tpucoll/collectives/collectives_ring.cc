@@ -19,6 +19,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/profile.h"
 #include "tpucoll/group/hier.h"
 #include "tpucoll/tuning/dispatch.h"
 
@@ -32,6 +33,9 @@ using plan::LazyStage;
 using plan::PlanHandle;
 using plan::PlanKey;
 using plan::PlanOp;
+using profile::Phase;
+using profile::PhaseScope;
+using profile::ProfileOpScope;
 
 namespace {
 
@@ -121,6 +125,7 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
   // Post all segment receives of `step`: fused, straight into the work
   // block (combined on arrival); scratch path, into staging half (step%2).
   auto postRecvsFor = [&](int step) {
+    PhaseScope ps(Phase::kPost);
     const int rb = recvBlockAt(step);
     const auto& segs = plan.segments(blocks.bytes[rb], elsize);
     if (fuse) {
@@ -138,6 +143,7 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
     }
   };
   auto postSendsFor = [&](int step) {
+    PhaseScope ps(Phase::kPost);
     const size_t blockOff = blocks.offset[sendBlockAt(step)];
     const auto& segs =
         plan.segments(blocks.bytes[sendBlockAt(step)], elsize);
@@ -161,13 +167,18 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
       if (fuse) {
         // The combine already ran (loop thread / stash hit); the wait is
         // purely the completion count.
+        PhaseScope ps(Phase::kWireWait);
         workBuf->waitRecv(nullptr, timeout);
         continue;
       }
-      stage.buf()->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        stage.buf()->waitRecv(nullptr, timeout);
+      }
       // Segments on one pair complete in wire order, so segment k of this
       // step is the k-th completion.
       if (segs[k].nbytes > 0) {
+        PhaseScope ps(Phase::kReduce);
         fn(work + blocks.offset[recvBlock] + segs[k].offset,
            stage.data() + base + segs[k].offset, segs[k].nbytes / elsize);
       }
@@ -177,8 +188,11 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
     // segment boundary (e.g. evenBlocks remainders).
     const size_t sendSegCount =
         plan.segments(blocks.bytes[sendBlockAt(step)], elsize).size();
-    for (size_t k = 0; k < sendSegCount; k++) {
-      workBuf->waitSend(timeout);
+    {
+      PhaseScope ps(Phase::kWireWait);
+      for (size_t k = 0; k < sendSegCount; k++) {
+        workBuf->waitSend(timeout);
+      }
     }
     if (step + 2 < steps) {
       postRecvsFor(step + 2);  // staging half (step % 2) is free again
@@ -212,16 +226,21 @@ void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
   auto segSlot = [&](int step, size_t seg) {
     return slot.offset(slotBase + uint64_t(step) * maxSegs + seg).value();
   };
-  for (int step = 0; step < steps; step++) {
-    const int recvBlock = blockAt(step + 1);  // == sendBlock(step) - 1
-    const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
-    for (size_t k = 0; k < segs.size(); k++) {
-      buf->recv(left, segSlot(step, k),
-                blocks.offset[recvBlock] + segs[k].offset, segs[k].nbytes);
+  {
+    PhaseScope ps(Phase::kPost);
+    for (int step = 0; step < steps; step++) {
+      const int recvBlock = blockAt(step + 1);  // == sendBlock(step) - 1
+      const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
+      for (size_t k = 0; k < segs.size(); k++) {
+        buf->recv(left, segSlot(step, k),
+                  blocks.offset[recvBlock] + segs[k].offset,
+                  segs[k].nbytes);
+      }
     }
   }
   int pendingSends = 0;
   {
+    PhaseScope ps(Phase::kPost);
     const int sb = blockAt(0);
     const auto& segs = plan.segments(blocks.bytes[sb], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
@@ -234,9 +253,13 @@ void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
     const int recvBlock = blockAt(step + 1);
     const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
-      buf->waitRecv(nullptr, timeout);
+      {
+        PhaseScope ps(Phase::kWireWait);
+        buf->waitRecv(nullptr, timeout);
+      }
       if (step + 1 < steps) {
         // This segment is exactly segment k of the next step's send block.
+        PhaseScope ps(Phase::kPost);
         buf->send(right, segSlot(step + 1, k),
                   blocks.offset[recvBlock] + segs[k].offset,
                   segs[k].nbytes);
@@ -244,8 +267,11 @@ void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
       }
     }
   }
-  while (pendingSends-- > 0) {
-    buf->waitSend(timeout);
+  {
+    PhaseScope ps(Phase::kWireWait);
+    while (pendingSends-- > 0) {
+      buf->waitSend(timeout);
+    }
   }
 }
 
@@ -276,6 +302,8 @@ void allgatherv(AllgathervOptions& opts) {
                    Slot::build(SlotPrefix::kAllgather, opts.tag).value(),
                    -1, myBytes, static_cast<uint8_t>(opts.dtype),
                    totalCount * elementSize(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "allgatherv", frOp.cseq(),
+                        myBytes);
   allgathervRun(opts);
 }
 
@@ -290,9 +318,12 @@ void allgather(AllgatherOptions& opts) {
                    Slot::build(SlotPrefix::kAllgather, opts.tag).value(),
                    -1, opts.count * elementSize(opts.dtype),
                    static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "allgather", frOp.cseq(),
+                        opts.count * elementSize(opts.dtype));
   if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx) &&
       ctx->size() > 1 && opts.count > 0) {
     frOp.setAlgorithm("hier");
+    profOp.setAlgorithm("hier");
     group::hierAllgather(ctx, opts.input, opts.output, opts.count,
                          opts.dtype, opts.tag,
                          detail::effectiveTimeout(opts));
@@ -335,6 +366,7 @@ static void allgathervRun(AllgathervOptions& opts) {
       0, [&] { return countBlocks(opts.counts, elsize); });
 
   if (opts.input != nullptr) {
+    PhaseScope ps(Phase::kPack);
     std::memcpy(bytePtr(opts.output) + blocks.offset[rank], opts.input,
                 blocks.bytes[rank]);
   }
@@ -358,14 +390,18 @@ static void allgathervRun(AllgathervOptions& opts) {
   static const size_t directMax =
       collectives_detail::envBytes("TPUCOLL_ALLGATHER_DIRECT_MAX", 8u << 20);
   if (maxBlock * size_t(size - 1) <= directMax) {
-    for (int i = 1; i < size; i++) {
-      const int to = (rank + i) % size;
-      const int from = (rank - i + size) % size;
-      out->recv(from, slot.offset(0).value(), blocks.offset[from],
-                blocks.bytes[from]);
-      out->send(to, slot.offset(0).value(), blocks.offset[rank],
-                blocks.bytes[rank]);
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int i = 1; i < size; i++) {
+        const int to = (rank + i) % size;
+        const int from = (rank - i + size) % size;
+        out->recv(from, slot.offset(0).value(), blocks.offset[from],
+                  blocks.bytes[from]);
+        out->send(to, slot.offset(0).value(), blocks.offset[rank],
+                  blocks.bytes[rank]);
+      }
     }
+    PhaseScope ps(Phase::kWireWait);
     for (int i = 1; i < size; i++) {
       out->waitRecv(nullptr, timeout);
       out->waitSend(timeout);
@@ -394,17 +430,22 @@ void allreduce(AllreduceOptions& opts) {
   FlightRecOp frOp(&ctx->flightrec(), "allreduce", nullptr,
                    Slot::build(SlotPrefix::kAllreduce, opts.tag).value(),
                    -1, nbytes, static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "allreduce", frOp.cseq(),
+                        nbytes);
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
 
   // Local reduction of all inputs into outputs[0].
   char* work = bytePtr(opts.outputs[0]);
-  if (work != opts.inputs[0]) {
-    std::memcpy(work, opts.inputs[0], nbytes);
-  }
-  for (size_t i = 1; i < opts.inputs.size(); i++) {
-    fn(work, opts.inputs[i], opts.count);
+  {
+    PhaseScope ps(Phase::kPack);
+    if (work != opts.inputs[0]) {
+      std::memcpy(work, opts.inputs[0], nbytes);
+    }
+    for (size_t i = 1; i < opts.inputs.size(); i++) {
+      fn(work, opts.inputs[i], opts.count);
+    }
   }
 
   TC_ENFORCE(opts.customFn == nullptr ||
@@ -471,14 +512,18 @@ void allreduce(AllreduceOptions& opts) {
     auto traceSpan = ctx->tracer().span(
         "allreduce", nbytes, -1, tuning::allreduceAlgorithmName(algo));
     frOp.setAlgorithm(tuning::allreduceAlgorithmName(algo));
+    profOp.setAlgorithm(tuning::allreduceAlgorithmName(algo));
     if (algo == AllreduceAlgorithm::kHier) {
       // Hierarchical composition: every phase is an ordinary collective
       // on a split sub-context, each with its own plan cache — the
       // parent-level plan machinery below is deliberately skipped.
       group::hierAllreduce(ctx, work, opts.count, opts.dtype, opts.op,
                            opts.customFn, opts.tag, timeout);
-      for (size_t i = 1; i < opts.outputs.size(); i++) {
-        std::memcpy(opts.outputs[i], work, nbytes);
+      if (opts.outputs.size() > 1) {
+        PhaseScope ps(Phase::kUnpack);
+        for (size_t i = 1; i < opts.outputs.size(); i++) {
+          std::memcpy(opts.outputs[i], work, nbytes);
+        }
       }
       return;
     }
@@ -548,8 +593,11 @@ void allreduce(AllreduceOptions& opts) {
     }
   }
 
-  for (size_t i = 1; i < opts.outputs.size(); i++) {
-    std::memcpy(opts.outputs[i], work, nbytes);
+  if (opts.outputs.size() > 1) {
+    PhaseScope ps(Phase::kUnpack);
+    for (size_t i = 1; i < opts.outputs.size(); i++) {
+      std::memcpy(opts.outputs[i], work, nbytes);
+    }
   }
 }
 
@@ -606,8 +654,12 @@ void binomialReduce(Context* ctx, plan::Plan& plan, char* result,
   uint64_t round = 0;
   while (mask < size) {
     if (vrank & mask) {
-      resultBuf->send(physical(vrank - mask), slot.offset(round).value(), 0,
-                      nbytes);
+      {
+        PhaseScope ps(Phase::kPost);
+        resultBuf->send(physical(vrank - mask),
+                        slot.offset(round).value(), 0, nbytes);
+      }
+      PhaseScope ps(Phase::kWireWait);
       resultBuf->waitSend(timeout);
       break;
     }
@@ -615,12 +667,23 @@ void binomialReduce(Context* ctx, plan::Plan& plan, char* result,
     if (partner < size) {
       const int src = physical(partner);
       if (fuseRecvReduce(ctx, fuseOk, elsize, src)) {
-        resultBuf->recvReduce(src, slot.offset(round).value(), fn, elsize,
-                              0, nbytes);
+        {
+          PhaseScope ps(Phase::kPost);
+          resultBuf->recvReduce(src, slot.offset(round).value(), fn,
+                                elsize, 0, nbytes);
+        }
+        PhaseScope ps(Phase::kWireWait);
         resultBuf->waitRecv(nullptr, timeout);
       } else {
-        stage.buf()->recv(src, slot.offset(round).value(), 0, nbytes);
-        stage.buf()->waitRecv(nullptr, timeout);
+        {
+          PhaseScope ps(Phase::kPost);
+          stage.buf()->recv(src, slot.offset(round).value(), 0, nbytes);
+        }
+        {
+          PhaseScope ps(Phase::kWireWait);
+          stage.buf()->waitRecv(nullptr, timeout);
+        }
+        PhaseScope ps(Phase::kReduce);
         fn(result, stage.data(), count);
       }
     }
@@ -651,20 +714,29 @@ void ringReduce(Context* ctx, plan::Plan& plan, char* work,
       ringReduceScatterSlotSpan(plan, blocks, elsize);
   if (rank == root) {
     int pending = 0;
-    for (int b = 0; b < size; b++) {
-      if (b == rank || blocks.bytes[b] == 0) {
-        continue;
+    {
+      PhaseScope ps(Phase::kPost);
+      for (int b = 0; b < size; b++) {
+        if (b == rank || blocks.bytes[b] == 0) {
+          continue;
+        }
+        workBuf->recv(b, slot.offset(gatherBase + uint64_t(b)).value(),
+                      blocks.offset[b], blocks.bytes[b]);
+        pending++;
       }
-      workBuf->recv(b, slot.offset(gatherBase + uint64_t(b)).value(),
-                    blocks.offset[b], blocks.bytes[b]);
-      pending++;
     }
+    PhaseScope ps(Phase::kWireWait);
     for (int i = 0; i < pending; i++) {
       workBuf->waitRecv(nullptr, timeout);
     }
   } else if (blocks.bytes[rank] > 0) {
-    workBuf->send(root, slot.offset(gatherBase + uint64_t(rank)).value(),
-                  blocks.offset[rank], blocks.bytes[rank]);
+    {
+      PhaseScope ps(Phase::kPost);
+      workBuf->send(root,
+                    slot.offset(gatherBase + uint64_t(rank)).value(),
+                    blocks.offset[rank], blocks.bytes[rank]);
+    }
+    PhaseScope ps(Phase::kWireWait);
     workBuf->waitSend(timeout);
   }
 }
@@ -684,6 +756,7 @@ void reduce(ReduceOptions& opts) {
   FlightRecOp frOp(&ctx->flightrec(), "reduce", nullptr,
                    Slot::build(SlotPrefix::kReduce, opts.tag).value(),
                    opts.root, nbytes, static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "reduce", frOp.cseq(), nbytes);
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -723,6 +796,7 @@ void reduce(ReduceOptions& opts) {
   auto traceSpan = ctx->tracer().span(
       "reduce", nbytes, -1, tuning::reduceAlgorithmName(algo));
   frOp.setAlgorithm(tuning::reduceAlgorithmName(algo));
+  profOp.setAlgorithm(tuning::reduceAlgorithmName(algo));
 
   PlanKey key;
   key.opcode = static_cast<uint8_t>(PlanOp::kReduce);
@@ -752,6 +826,7 @@ void reduce(ReduceOptions& opts) {
     resultBuf = st.buf;
   }
   if (result != opts.input) {
+    PhaseScope ps(Phase::kPack);
     std::memcpy(result, opts.input, nbytes);
   }
 
@@ -793,6 +868,8 @@ void reduceScatter(ReduceScatterOptions& opts) {
       &ctx->flightrec(), "reduce_scatter", nullptr,
       Slot::build(SlotPrefix::kReduceScatter, opts.tag).value(), -1, total,
       static_cast<uint8_t>(opts.dtype));
+  ProfileOpScope profOp(&ctx->profiler(), "reduce_scatter", frOp.cseq(),
+                        total);
 
   if (size == 1) {
     std::memcpy(opts.output, opts.input, total);
@@ -831,6 +908,7 @@ void reduceScatter(ReduceScatterOptions& opts) {
     }
   }
   frOp.setAlgorithm(tuning::reduceScatterAlgorithmName(algo));
+  profOp.setAlgorithm(tuning::reduceScatterAlgorithmName(algo));
   if (algo == ReduceScatterAlgorithm::kHier) {
     // Phases are collectives on split sub-contexts with their own plan
     // caches; the parent plan machinery below is skipped.
@@ -859,7 +937,10 @@ void reduceScatter(ReduceScatterOptions& opts) {
   // stage's registration is the schedule's work buffer.
   auto st = planh->stage(kStageRsWork, total);
   char* work = st.data;
-  std::memcpy(work, opts.input, total);
+  {
+    PhaseScope ps(Phase::kPack);
+    std::memcpy(work, opts.input, total);
+  }
   switch (algo) {
     case ReduceScatterAlgorithm::kDirect:
       algorithms::directReduceScatter(ctx, *planh, work, st.buf, blocks,
@@ -884,7 +965,11 @@ void reduceScatter(ReduceScatterOptions& opts) {
     default:
       TC_THROW(EnforceError, "unknown reduce_scatter algorithm");
   }
-  std::memcpy(opts.output, work + blocks.offset[rank], blocks.bytes[rank]);
+  {
+    PhaseScope ps(Phase::kUnpack);
+    std::memcpy(opts.output, work + blocks.offset[rank],
+                blocks.bytes[rank]);
+  }
 }
 
 }  // namespace tpucoll

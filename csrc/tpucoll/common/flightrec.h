@@ -140,6 +140,21 @@ class FlightRecorder {
     return nextSeq_.load(std::memory_order_relaxed);
   }
 
+  // Cross-rank collective sequence number of ring op `seq` (-1 for p2p
+  // entries, lapped rows, or the kNoSeq sentinel). The phase profiler
+  // (common/profile.h) keys its per-op breakdowns on this value so
+  // per-rank breakdowns of the same collective are joinable.
+  int64_t cseqOf(uint64_t seq) const {
+    if (seq == kNoSeq) {
+      return -1;
+    }
+    const Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      return -1;
+    }
+    return e.cseq.load(std::memory_order_relaxed);
+  }
+
   // Sentinel for "no entry": also parked in a ring row's seq while its
   // fields are being rewritten, so a concurrent dump skips the torn row
   // whichever lap it expected there.
@@ -251,6 +266,11 @@ class FlightRecOp {
   FlightRecOp& operator=(const FlightRecOp&) = delete;
 
   uint64_t seq() const { return seq_; }
+  // Cross-rank collective sequence of this op (-1 for p2p scopes) — the
+  // phase profiler's join key.
+  int64_t cseq() const {
+    return rec_ != nullptr ? rec_->cseqOf(seq_) : -1;
+  }
   void setAlgorithm(const char* algorithm) {
     if (rec_ != nullptr) {
       rec_->setAlgorithm(seq_, algorithm);

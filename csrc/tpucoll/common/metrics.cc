@@ -118,6 +118,17 @@ void Metrics::recordFault(const std::string& action) {
   faultCounts_[action]++;
 }
 
+Metrics::Histogram* Metrics::phaseHistogram(const std::string& op,
+                                            const std::string& algo,
+                                            const std::string& phase) {
+  std::lock_guard<std::mutex> guard(phaseMu_);
+  auto& slot = phaseHists_[op][algo][phase];
+  if (slot == nullptr) {
+    slot.reset(new Histogram());
+  }
+  return slot.get();
+}
+
 bool Metrics::lastStall(Stall* out) const {
   std::lock_guard<std::mutex> guard(stallMu_);
   if (!haveStall_) {
@@ -217,6 +228,45 @@ std::string Metrics::toJson(int rank, bool drain) {
         << ",\"latency_us\":";
     histToJson(out, s.latency);
     out << "}";
+  }
+  out << "}";
+
+  // Phase-profiler aggregates (common/profile.h): per-(collective,
+  // algorithm, phase) latency histograms. Only populated families emit;
+  // an empty map emits {} so readers need no presence check.
+  out << ",\"phases\":{";
+  {
+    std::lock_guard<std::mutex> guard(phaseMu_);
+    bool firstOp = true;
+    for (const auto& opEntry : phaseHists_) {
+      if (!firstOp) {
+        out << ",";
+      }
+      firstOp = false;
+      appendJsonString(out, opEntry.first);
+      out << ":{";
+      bool firstAlgo = true;
+      for (const auto& algoEntry : opEntry.second) {
+        if (!firstAlgo) {
+          out << ",";
+        }
+        firstAlgo = false;
+        appendJsonString(out, algoEntry.first);
+        out << ":{";
+        bool firstPhase = true;
+        for (const auto& phaseEntry : algoEntry.second) {
+          if (!firstPhase) {
+            out << ",";
+          }
+          firstPhase = false;
+          appendJsonString(out, phaseEntry.first);
+          out << ":";
+          histToJson(out, *phaseEntry.second);
+        }
+        out << "}";
+      }
+      out << "}";
+    }
   }
   out << "}";
 
@@ -352,6 +402,18 @@ void Metrics::resetAll() {
     haveStall_ = false;
     failedPeer_ = -1;
     failureMessage_.clear();
+  }
+  {
+    // Reset contents, never erase: phaseHistogram hands out raw
+    // pointers that must survive a concurrent drain.
+    std::lock_guard<std::mutex> guard(phaseMu_);
+    for (auto& opEntry : phaseHists_) {
+      for (auto& algoEntry : opEntry.second) {
+        for (auto& phaseEntry : algoEntry.second) {
+          phaseEntry.second->reset();
+        }
+      }
+    }
   }
 }
 

@@ -19,6 +19,7 @@
 #include <atomic>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -301,6 +302,17 @@ class Metrics {
     ubufCreates_.fetch_add(1, std::memory_order_relaxed);
   }
 
+  // ---- phase profiler (common/profile.h) ----
+  // Per-(collective, algorithm, phase) latency histogram, created on
+  // first use. Slow path by design: the profiler flushes ONCE per
+  // collective call (never per segment), so a mutex + nested-map lookup
+  // is fine. The returned pointer stays valid for the registry's
+  // lifetime — resetAll() zeroes histogram contents but never erases
+  // entries, so a concurrent flush can't race a drain into a dangling
+  // pointer.
+  Histogram* phaseHistogram(const std::string& op, const std::string& algo,
+                            const std::string& phase);
+
   // ---- connect retries (Pair backoff loop) ----
   void recordRetry() {
     if (!enabled()) {
@@ -377,6 +389,15 @@ class Metrics {
   mutable std::mutex faultMu_;
   std::map<std::string, uint64_t> faultCounts_;
   std::atomic<uint64_t> faultsTotal_{0};
+
+  // op -> algorithm -> phase -> histogram (phase profiler). Entries are
+  // never erased (see phaseHistogram); unique_ptr keeps the Histogram
+  // address stable across map rebalancing.
+  mutable std::mutex phaseMu_;
+  std::map<std::string,
+           std::map<std::string,
+                    std::map<std::string, std::unique_ptr<Histogram>>>>
+      phaseHists_;
 };
 
 // RAII op-scope: counts the call + payload bytes at construction, records

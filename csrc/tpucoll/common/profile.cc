@@ -1,0 +1,209 @@
+#include "tpucoll/common/profile.h"
+
+#include <sstream>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/flightrec.h"
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/metrics.h"
+
+namespace tpucoll {
+namespace profile {
+
+const char* phaseName(Phase p) {
+  switch (p) {
+    case Phase::kPack:
+      return "pack";
+    case Phase::kPost:
+      return "post";
+    case Phase::kWireWait:
+      return "wire_wait";
+    case Phase::kReduce:
+      return "reduce";
+    case Phase::kUnpack:
+      return "unpack";
+    case Phase::kIntra:
+      return "intra";
+    case Phase::kInter:
+      return "inter";
+    case Phase::kFanout:
+      return "fanout";
+    case Phase::kCount:
+      break;
+  }
+  return "unknown";
+}
+
+namespace {
+
+// Collectives run synchronously on the issuing thread, so the active
+// accumulator is a per-thread stack head with no synchronization;
+// nested collectives (hier phases) save/restore through ProfileOpScope.
+thread_local OpAccumulator* t_currentOp = nullptr;
+
+size_t capacityFromEnv() {
+  // Strict count (common/env.h): a typo'd ring size must fail loudly,
+  // not silently fall back (same contract as TPUCOLL_FLIGHTREC_EVENTS).
+  const size_t cap = static_cast<size_t>(
+      envCount("TPUCOLL_PROFILE_RING", 256, 1, 1 << 20));
+  size_t pow2 = 8;
+  while (pow2 < cap) {
+    pow2 <<= 1;
+  }
+  return pow2;
+}
+
+}  // namespace
+
+OpAccumulator* currentOp() { return t_currentOp; }
+
+Profiler::Profiler(int rank, int size, Metrics* metrics)
+    : rank_(rank), size_(size), metrics_(metrics) {
+  const size_t cap = capacityFromEnv();
+  mask_ = cap - 1;
+  entries_.reset(new Entry[cap]);
+  enabled_.store(envFlag("TPUCOLL_PROFILE", true),
+                 std::memory_order_relaxed);
+}
+
+void Profiler::record(const char* opcode, const char* algorithm,
+                      int64_t cseq, uint64_t bytes, int64_t startUs,
+                      int64_t totalUs, const OpAccumulator& acc) {
+  const uint64_t seq = nextSeq_.fetch_add(1, std::memory_order_relaxed);
+  Entry& e = entries_[seq & mask_];
+  // Claim-then-publish (flightrec.h): park kNoSeq while fields are being
+  // rewritten so a concurrent toJson skips the torn row, then publish
+  // the real seq as the LAST store.
+  e.seq.store(kNoSeq, std::memory_order_relaxed);
+  e.cseq.store(cseq, std::memory_order_relaxed);
+  e.opcode.store(opcode, std::memory_order_relaxed);
+  e.algorithm.store(algorithm, std::memory_order_relaxed);
+  e.bytes.store(bytes, std::memory_order_relaxed);
+  e.startUs.store(startUs, std::memory_order_relaxed);
+  e.totalUs.store(totalUs, std::memory_order_relaxed);
+  for (int p = 0; p < kPhaseCount; p++) {
+    e.phaseUs[p].store(acc.phaseUs[p], std::memory_order_relaxed);
+  }
+  e.seq.store(seq, std::memory_order_relaxed);
+
+  // The aggregate flush honors the metrics registry's own gate: with
+  // ctx.metrics_enable(False) every other recorder freezes, and a
+  // "phases" section that kept growing would make the snapshot
+  // inconsistent (and pay mutex+map cost the disabled path promises
+  // not to). The per-op ring above is the profiler's own surface and
+  // is governed solely by the profiler gate.
+  if (metrics_ != nullptr && metrics_->enabled()) {
+    for (int p = 0; p < kPhaseCount; p++) {
+      if (acc.phaseUs[p] <= 0) {
+        continue;
+      }
+      metrics_
+          ->phaseHistogram(opcode, algorithm != nullptr ? algorithm : "",
+                           phaseName(static_cast<Phase>(p)))
+          ->record(acc.phaseUs[p]);
+    }
+  }
+}
+
+std::string Profiler::toJson() const {
+  std::ostringstream out;
+  const uint64_t next = nextSeq_.load(std::memory_order_relaxed);
+  const uint64_t cap = mask_ + 1;
+  const uint64_t first = next > cap ? next - cap : 0;
+  out << "{\"version\":1,\"kind\":\"tpucoll_profile\",\"rank\":" << rank_
+      << ",\"size\":" << size_ << ",\"group\":";
+  appendJsonString(out, metrics_ != nullptr ? metrics_->group()
+                                            : std::string());
+  out << ",\"enabled\":" << (enabled() ? "true" : "false")
+      << ",\"now_us\":" << FlightRecorder::nowUs()
+      << ",\"next_seq\":" << next << ",\"capacity\":" << cap
+      << ",\"dropped\":" << first << ",\"ops\":[";
+  bool firstRow = true;
+  for (uint64_t seq = first; seq < next; seq++) {
+    const Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      continue;  // torn row: mid-overwrite by a racing writer
+    }
+    const char* op = e.opcode.load(std::memory_order_relaxed);
+    if (op == nullptr) {
+      continue;
+    }
+    const char* algo = e.algorithm.load(std::memory_order_relaxed);
+    const int64_t cseq = e.cseq.load(std::memory_order_relaxed);
+    out << (firstRow ? "" : ",") << "\n{\"seq\":" << seq << ",\"cseq\":";
+    if (cseq >= 0) {
+      out << cseq;
+    } else {
+      out << "null";
+    }
+    out << ",\"op\":\"" << op << "\",\"algo\":";
+    if (algo != nullptr) {
+      out << "\"" << algo << "\"";
+    } else {
+      out << "null";
+    }
+    out << ",\"bytes\":" << e.bytes.load(std::memory_order_relaxed)
+        << ",\"start_us\":" << e.startUs.load(std::memory_order_relaxed)
+        << ",\"total_us\":" << e.totalUs.load(std::memory_order_relaxed)
+        << ",\"phases\":{";
+    bool firstPhase = true;
+    for (int p = 0; p < kPhaseCount; p++) {
+      const int64_t us = e.phaseUs[p].load(std::memory_order_relaxed);
+      if (us <= 0) {
+        continue;
+      }
+      out << (firstPhase ? "" : ",") << "\""
+          << phaseName(static_cast<Phase>(p)) << "\":" << us;
+      firstPhase = false;
+    }
+    out << "}}";
+    firstRow = false;
+  }
+  out << "\n]}\n";
+  return out.str();
+}
+
+ProfileOpScope::ProfileOpScope(Profiler* profiler, const char* opcode,
+                               int64_t cseq, uint64_t bytes)
+    : profiler_(profiler), opcode_(opcode), cseq_(cseq), bytes_(bytes),
+      startUs_(0), prev_(t_currentOp) {
+  if (profiler_ == nullptr || !profiler_->enabled()) {
+    // Disabled path: one relaxed load plus parking the thread-local at
+    // null. The park is NOT optional — a disabled op nested inside an
+    // enabled one (a hier sub-context whose profiler is off while the
+    // parent's is on) must not let its own PhaseScopes keep charging
+    // the PARENT's accumulator on top of the parent's intra/inter
+    // phase, which would double-count the same wall time.
+    profiler_ = nullptr;
+    t_currentOp = nullptr;
+    return;
+  }
+  startUs_ = FlightRecorder::nowUs();
+  t_currentOp = &acc_;
+}
+
+ProfileOpScope::~ProfileOpScope() {
+  t_currentOp = prev_;
+  if (profiler_ == nullptr) {
+    return;
+  }
+  profiler_->record(opcode_, algorithm_, cseq_, bytes_, startUs_,
+                    FlightRecorder::nowUs() - startUs_, acc_);
+}
+
+PhaseScope::PhaseScope(Phase phase)
+    : op_(t_currentOp), phase_(phase), startUs_(0) {
+  if (op_ != nullptr) {
+    startUs_ = FlightRecorder::nowUs();
+  }
+}
+
+PhaseScope::~PhaseScope() {
+  if (op_ != nullptr) {
+    op_->phaseUs[static_cast<int>(phase_)] +=
+        FlightRecorder::nowUs() - startUs_;
+  }
+}
+
+}  // namespace profile
+}  // namespace tpucoll

@@ -1,0 +1,202 @@
+// Phase-level collective profiler: decomposes every collective into its
+// canonical schedule phases and records where the time went.
+//
+// The metrics registry (metrics.h) answers "how long did allreduce
+// take"; the flight recorder (flightrec.h) answers "what was in flight
+// when we died"; neither answers "WHY was this allreduce slow" — a
+// 64 MiB ring op is one histogram sample with no decomposition into
+// pack/wire/reduce time and no way to tell "my reduce is slow" from
+// "rank 3 is a straggler". HiCCL/GC3-style composed schedules make the
+// phases first-class; this layer measures them:
+//
+//  - pack       local staging: input combine, wire encode (bf16/q8),
+//               layout copies before bytes can move
+//  - post       posting sends/recvs to the transport (includes any
+//               fault-injected send delay on the posting thread)
+//  - wire_wait  blocking waits for wire completions (waitSend/waitRecv;
+//               on fused receive-reduce paths the combine runs inside
+//               the wait and is attributed here — docs/profiling.md)
+//  - reduce     explicit arithmetic: staged-arrival reduction kernels
+//  - unpack     local unstaging: wire decode, result fan-out copies
+//  - intra/inter/fanout   the hierarchical composition's host phases
+//               (group/hier.cc): intra-host reduce, inter-host
+//               exchange, intra-host result distribution
+//
+// Mechanism: ProfileOpScope (stamped in every public collective entry,
+// next to MetricsOp/FlightRecOp) opens a per-op accumulator and parks it
+// in a thread-local; PhaseScope (stamped inside the algorithm bodies)
+// adds its elapsed time to the accumulator's phase bucket. Collectives
+// execute synchronously on the calling thread, so the thread-local needs
+// no synchronization; nested collectives (hier phases are ordinary
+// collectives on split sub-contexts) save/restore it like a stack, each
+// op accruing to ITS context's profiler.
+//
+// Cost contract (same discipline as metrics.h): disabled —
+// TPUCOLL_PROFILE=0 — costs one relaxed load plus a thread-local park
+// per collective entry (the park keeps a disabled nested op's phases
+// from charging an enabled outer op) and one thread-local read per
+// phase scope, no clock reads, no records.
+// Enabled, a phase scope is two clock_gettime calls and plain stores
+// into the stack accumulator; the per-op flush (ring publish + phase
+// histograms) runs once per collective, off the per-segment path.
+//
+// Output, per op, into a bounded lock-free ring (TPUCOLL_PROFILE_RING
+// entries) keyed by the flight recorder's cross-rank collective
+// sequence number `cseq` — the join key that lets
+// gloo_tpu/utils/profile.py line up rank 0's breakdown of collective
+// #41 against rank 3's and attribute wait time to the straggler — and,
+// aggregated, into per-(collective, algorithm, phase) histograms in the
+// metrics registry (scraped as gloo_tpu_phase_latency_us).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace tpucoll {
+
+class Metrics;
+
+namespace profile {
+
+enum class Phase : uint8_t {
+  kPack = 0,
+  kPost,
+  kWireWait,
+  kReduce,
+  kUnpack,
+  kIntra,
+  kInter,
+  kFanout,
+  kCount,
+};
+
+constexpr int kPhaseCount = static_cast<int>(Phase::kCount);
+
+const char* phaseName(Phase p);
+
+// Stack-allocated per-op accumulator: written only by the owning thread
+// (PhaseScope dtors), read once at op end by the flush. Plain integers.
+struct OpAccumulator {
+  int64_t phaseUs[kPhaseCount] = {};
+};
+
+class Profiler {
+ public:
+  // Ring row. All fields relaxed-atomic: written by the completing op's
+  // thread, read by a concurrent toJson; the claim-then-publish `seq`
+  // protocol (flightrec.h) keeps a dump from mixing rows across laps.
+  struct Entry {
+    std::atomic<uint64_t> seq{0};
+    std::atomic<int64_t> cseq{-1};
+    std::atomic<const char*> opcode{nullptr};     // static string
+    std::atomic<const char*> algorithm{nullptr};  // static string or null
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<int64_t> startUs{0};
+    std::atomic<int64_t> totalUs{0};
+    std::atomic<int64_t> phaseUs[kPhaseCount] = {};
+  };
+
+  static constexpr uint64_t kNoSeq = ~uint64_t(0);
+
+  // Capacity from TPUCOLL_PROFILE_RING (default 256), rounded up to a
+  // power of two; enable gate from TPUCOLL_PROFILE (default 1).
+  // `metrics` receives the per-(op, algorithm, phase) histogram flush;
+  // may be null (standalone tests).
+  Profiler(int rank, int size, Metrics* metrics);
+
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+  void setEnabled(bool on) {
+    enabled_.store(on, std::memory_order_relaxed);
+  }
+
+  // Publish one completed op: allocate the next ring row, stamp it, and
+  // flush the nonzero phases into the metrics registry's keyed
+  // histograms. Called once per profiled collective, from its
+  // ProfileOpScope destructor.
+  void record(const char* opcode, const char* algorithm, int64_t cseq,
+              uint64_t bytes, int64_t startUs, int64_t totalUs,
+              const OpAccumulator& acc);
+
+  uint64_t nextSeq() const {
+    return nextSeq_.load(std::memory_order_relaxed);
+  }
+  // Rows overwritten because more ops completed than the ring holds.
+  uint64_t dropped() const {
+    const uint64_t next = nextSeq();
+    const uint64_t cap = mask_ + 1;
+    return next > cap ? next - cap : 0;
+  }
+
+  // Full JSON document: {"version", "kind", "rank", "size", "group",
+  // "enabled", "next_seq", "capacity", "dropped", "ops": [{"seq",
+  // "cseq", "op", "algo", "bytes", "start_us", "total_us",
+  // "phases": {"pack": us, ...}} ...]} — nonzero phases only.
+  std::string toJson() const;
+
+  int rank() const { return rank_; }
+
+ private:
+  const int rank_;
+  const int size_;
+  Metrics* metrics_;
+  std::atomic<bool> enabled_{true};
+  uint64_t mask_;  // capacity - 1 (power of two)
+  std::unique_ptr<Entry[]> entries_;
+  std::atomic<uint64_t> nextSeq_{0};
+};
+
+// The thread-local accumulator stack head. Non-null exactly while an
+// enabled ProfileOpScope is alive on this thread; PhaseScope reads it
+// once at construction (so a hier phase scope opened before a nested
+// sub-context op keeps accruing to the PARENT's accumulator).
+OpAccumulator* currentOp();
+
+// RAII op scope for the public collective entry points. Opens the
+// accumulator, parks it in the thread-local (saving the previous head
+// for nested collectives), and on destruction publishes the op to the
+// profiler ring + metrics phase histograms. `cseq` is the flight
+// recorder's cross-rank collective sequence (FlightRecOp::cseq()).
+// Disabled profiler: one relaxed load, everything else skipped.
+class ProfileOpScope {
+ public:
+  ProfileOpScope(Profiler* profiler, const char* opcode, int64_t cseq,
+                 uint64_t bytes);
+  ~ProfileOpScope();
+  ProfileOpScope(const ProfileOpScope&) = delete;
+  ProfileOpScope& operator=(const ProfileOpScope&) = delete;
+
+  // Late algorithm resolution (kAuto dispatch), mirrors
+  // FlightRecOp::setAlgorithm.
+  void setAlgorithm(const char* algorithm) { algorithm_ = algorithm; }
+
+ private:
+  Profiler* profiler_;  // null when disabled at entry
+  const char* opcode_;
+  const char* algorithm_{nullptr};
+  int64_t cseq_;
+  uint64_t bytes_;
+  int64_t startUs_;
+  OpAccumulator acc_;
+  OpAccumulator* prev_;
+};
+
+// RAII phase scope: adds its elapsed wall time to the current op's
+// phase bucket. No-op (one thread-local read) when no profiled op is
+// active on this thread.
+class PhaseScope {
+ public:
+  explicit PhaseScope(Phase phase);
+  ~PhaseScope();
+  PhaseScope(const PhaseScope&) = delete;
+  PhaseScope& operator=(const PhaseScope&) = delete;
+
+ private:
+  OpAccumulator* op_;
+  Phase phase_;
+  int64_t startUs_;
+};
+
+}  // namespace profile
+}  // namespace tpucoll

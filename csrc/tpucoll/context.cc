@@ -16,7 +16,8 @@ namespace tpucoll {
 constexpr std::chrono::milliseconds Context::kDefaultTimeout;
 
 Context::Context(int rank, int size)
-    : rank_(rank), size_(size), metrics_(size), flightrec_(rank, size) {
+    : rank_(rank), size_(size), metrics_(size),
+      profiler_(rank, size, &metrics_), flightrec_(rank, size) {
   TC_ENFORCE(size > 0, "context size must be positive");
   TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
              size);

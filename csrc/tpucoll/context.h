@@ -15,6 +15,7 @@
 
 #include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/metrics.h"
+#include "tpucoll/common/profile.h"
 #include "tpucoll/common/tracer.h"
 #include "tpucoll/group/topology.h"
 #include "tpucoll/rendezvous/store.h"
@@ -210,8 +211,19 @@ class Context {
   // process dies unexpectedly.
   FlightRecorder& flightrec() { return flightrec_; }
 
+  // Phase-level collective profiler (common/profile.h): per-op
+  // pack/post/wire_wait/reduce/unpack breakdowns in a bounded ring
+  // keyed by the flight recorder's cseq, plus aggregate phase
+  // histograms flushed into the metrics registry. On by default
+  // (TPUCOLL_PROFILE=0 disables; off costs one relaxed load per op).
+  profile::Profiler& profiler() { return profiler_; }
+
   // Structured JSON snapshot of the registry; `drain` resets counters.
   std::string metricsJson(bool drain);
+
+  // JSON snapshot of the profiler's per-op phase-breakdown ring
+  // (non-draining, like the flight recorder).
+  std::string profileJson() { return profiler_.toJson(); }
 
   // ---- collective autotuning plane (tuning/tuning_table.h) ----
   // Installed measured tuning table consulted by every kAuto dispatch;
@@ -311,6 +323,9 @@ class Context {
   std::vector<std::vector<char>> scratchPool_;
   Tracer tracer_;
   Metrics metrics_;
+  // After metrics_: the profiler flushes phase histograms into the
+  // registry, so it must be constructed after and destroyed before it.
+  profile::Profiler profiler_;
   FlightRecorder flightrec_;
 };
 

@@ -5,11 +5,15 @@
 #include <sstream>
 
 #include "tpucoll/collectives/collectives.h"
+#include "tpucoll/common/profile.h"
 #include "tpucoll/context.h"
 #include "tpucoll/group/topology.h"
 
 namespace tpucoll {
 namespace group {
+
+using profile::Phase;
+using profile::PhaseScope;
 
 namespace {
 
@@ -30,9 +34,15 @@ std::string describeMembers(const std::vector<int>& members) {
 // type preserved so the C ABI keeps its error-code mapping — naming the
 // collective, the phase, the subgroup tag, and the subgroup->global
 // rank map, so "pair to rank 1 failed" becomes attributable.
+// `profPhase` charges the phase's wall time to the PARENT op's profiler
+// accumulator (intra / inter / fanout); the nested sub-context
+// collective additionally profiles its own pack/wire/reduce breakdown
+// into the sub-context's profiler.
 template <typename Fn>
-void runPhase(const char* collective, const char* phaseName, Context* sub,
+void runPhase(const char* collective, const char* phaseName,
+              Phase profPhase, Context* sub,
               const std::vector<int>& members, Fn&& phase) {
+  PhaseScope profScope(profPhase);
   try {
     phase();
   } catch (const TimeoutException& e) {
@@ -101,7 +111,8 @@ void hierAllreduce(Context* ctx, char* work, size_t count, DataType dtype,
     // allreduce, and only the leader needs the host sum before the
     // inter-host exchange. Internally the bandwidth tier IS a ring
     // reduce-scatter + chunk gather over the shm plane.
-    runPhase("allreduce", "intra-host reduce", p.local, p.localMembers,
+    runPhase("allreduce", "intra-host reduce", Phase::kIntra,
+             p.local, p.localMembers,
              [&] {
       ReduceOptions o;
       o.context = p.local;
@@ -118,7 +129,7 @@ void hierAllreduce(Context* ctx, char* work, size_t count, DataType dtype,
     });
   }
   if (p.leaders != nullptr) {
-    runPhase("allreduce", "inter-host exchange", p.leaders,
+    runPhase("allreduce", "inter-host exchange", Phase::kInter, p.leaders,
              p.leaderMembers, [&] {
       AllreduceOptions o;
       o.context = p.leaders;
@@ -134,7 +145,8 @@ void hierAllreduce(Context* ctx, char* work, size_t count, DataType dtype,
     });
   }
   if (multiLocal) {
-    runPhase("allreduce", "intra-host broadcast", p.local, p.localMembers,
+    runPhase("allreduce", "intra-host broadcast", Phase::kFanout,
+             p.local, p.localMembers,
              [&] {
       BroadcastOptions o;
       o.context = p.local;
@@ -174,6 +186,7 @@ void hierReduceScatter(Context* ctx, const void* input, void* output,
   }
   auto stage = ctx->acquireScratch(totalCount * elsize);
   {
+    PhaseScope ps(Phase::kPack);
     size_t off = 0;
     for (int r : grouped) {
       const size_t len = recvCounts[r] * elsize;
@@ -186,7 +199,7 @@ void hierReduceScatter(Context* ctx, const void* input, void* output,
   if (topo.localSize > 1) {
     // Reduce-to-leader (in place on the leader): only leaders feed the
     // inter-host reduce_scatter, so non-leaders need no host sum.
-    runPhase("reduce_scatter", "intra-host reduce", p.local,
+    runPhase("reduce_scatter", "intra-host reduce", Phase::kIntra, p.local,
              p.localMembers, [&] {
       ReduceOptions o;
       o.context = p.local;
@@ -216,7 +229,7 @@ void hierReduceScatter(Context* ctx, const void* input, void* output,
         perHost[h] += recvCounts[r];
       }
     }
-    runPhase("reduce_scatter", "inter-host exchange", p.leaders,
+    runPhase("reduce_scatter", "inter-host exchange", Phase::kInter, p.leaders,
              p.leaderMembers, [&] {
       ReduceScatterOptions o;
       o.context = p.leaders;
@@ -232,7 +245,7 @@ void hierReduceScatter(Context* ctx, const void* input, void* output,
     });
   }
   if (topo.localSize > 1) {
-    runPhase("reduce_scatter", "intra-host broadcast", p.local,
+    runPhase("reduce_scatter", "intra-host broadcast", Phase::kFanout, p.local,
              p.localMembers, [&] {
       BroadcastOptions o;
       o.context = p.local;
@@ -254,6 +267,7 @@ void hierReduceScatter(Context* ctx, const void* input, void* output,
     }
     myOff += recvCounts[r] * elsize;
   }
+  PhaseScope ps(Phase::kUnpack);
   std::memcpy(output, hostBlock.data() + myOff,
               recvCounts[topo.rank] * elsize);
 }
@@ -275,7 +289,8 @@ void hierAllgather(Context* ctx, const void* input, void* output,
 
   auto localBuf = ctx->acquireScratch(topo.localSize * rankBytes);
   if (topo.localSize > 1) {
-    runPhase("allgather", "intra-host allgather", p.local, p.localMembers,
+    runPhase("allgather", "intra-host allgather", Phase::kIntra,
+             p.local, p.localMembers,
              [&] {
       AllgatherOptions o;
       o.context = p.local;
@@ -288,6 +303,7 @@ void hierAllgather(Context* ctx, const void* input, void* output,
       allgather(o);
     });
   } else {
+    PhaseScope ps(Phase::kPack);
     std::memcpy(localBuf.data(), input, rankBytes);
   }
 
@@ -297,7 +313,7 @@ void hierAllgather(Context* ctx, const void* input, void* output,
     for (int h = 0; h < topo.nHosts(); h++) {
       perHost[h] = topo.hosts[h].size() * count;
     }
-    runPhase("allgather", "inter-host exchange", p.leaders,
+    runPhase("allgather", "inter-host exchange", Phase::kInter, p.leaders,
              p.leaderMembers, [&] {
       AllgathervOptions o;
       o.context = p.leaders;
@@ -311,7 +327,8 @@ void hierAllgather(Context* ctx, const void* input, void* output,
     });
   }
   if (topo.localSize > 1) {
-    runPhase("allgather", "intra-host broadcast", p.local, p.localMembers,
+    runPhase("allgather", "intra-host broadcast", Phase::kFanout,
+             p.local, p.localMembers,
              [&] {
       BroadcastOptions o;
       o.context = p.local;
@@ -325,6 +342,7 @@ void hierAllgather(Context* ctx, const void* input, void* output,
     });
   }
   // Grouped order -> global rank order.
+  PhaseScope ps(Phase::kUnpack);
   for (int g = 0; g < size; g++) {
     std::memcpy(static_cast<char*>(output) + size_t(grouped[g]) * rankBytes,
                 groupedBuf.data() + size_t(g) * rankBytes, rankBytes);
@@ -344,7 +362,8 @@ void hierBroadcast(Context* ctx, void* buffer, size_t count,
   // broadcast FROM the root, delivering to the leader and co-hosted
   // ranks in one shm pass.
   if (onRootHost && !rootIsLeader && topo.localSize > 1) {
-    runPhase("broadcast", "intra-host (root)", p.local, p.localMembers,
+    runPhase("broadcast", "intra-host (root)", Phase::kIntra,
+             p.local, p.localMembers,
              [&] {
       const auto& mine = topo.hosts[topo.hostIndex];
       const int rootLocal = static_cast<int>(
@@ -363,7 +382,8 @@ void hierBroadcast(Context* ctx, void* buffer, size_t count,
   // Phase 2: leaders relay across hosts (root's host's leader is the
   // leader-plane root).
   if (p.leaders != nullptr) {
-    runPhase("broadcast", "inter-host relay", p.leaders, p.leaderMembers,
+    runPhase("broadcast", "inter-host relay", Phase::kInter,
+             p.leaders, p.leaderMembers,
              [&] {
       BroadcastOptions o;
       o.context = p.leaders;
@@ -379,7 +399,8 @@ void hierBroadcast(Context* ctx, void* buffer, size_t count,
   // Phase 3: every host whose members did not already receive in phase
   // 1 broadcasts from its leader.
   if (!(onRootHost && !rootIsLeader) && topo.localSize > 1) {
-    runPhase("broadcast", "intra-host (leader)", p.local, p.localMembers,
+    runPhase("broadcast", "intra-host (leader)", Phase::kFanout,
+             p.local, p.localMembers,
              [&] {
       BroadcastOptions o;
       o.context = p.local;
@@ -401,7 +422,8 @@ void hierBarrier(Context* ctx, uint32_t tag,
   // second local barrier is what keeps a non-leader from exiting before
   // the inter-host round completed.
   if (p.topo->localSize > 1) {
-    runPhase("barrier", "intra-host arrive", p.local, p.localMembers, [&] {
+    runPhase("barrier", "intra-host arrive", Phase::kIntra,
+             p.local, p.localMembers, [&] {
       BarrierOptions o;
       o.context = p.local;
       o.tag = tag;
@@ -410,7 +432,8 @@ void hierBarrier(Context* ctx, uint32_t tag,
     });
   }
   if (p.leaders != nullptr) {
-    runPhase("barrier", "inter-host", p.leaders, p.leaderMembers, [&] {
+    runPhase("barrier", "inter-host", Phase::kInter,
+             p.leaders, p.leaderMembers, [&] {
       BarrierOptions o;
       o.context = p.leaders;
       o.tag = tag;
@@ -419,7 +442,8 @@ void hierBarrier(Context* ctx, uint32_t tag,
     });
   }
   if (p.topo->localSize > 1) {
-    runPhase("barrier", "intra-host release", p.local, p.localMembers,
+    runPhase("barrier", "intra-host release", Phase::kFanout,
+             p.local, p.localMembers,
              [&] {
       BarrierOptions o;
       o.context = p.local;

@@ -145,6 +145,11 @@ _PROTOTYPES = {
     "tc_flightrec_dump": (_int, [_c, ctypes.c_char_p]),
     "tc_flightrec_seq": (_u64, [_c]),
     "tc_flightrec_install_signal_handler": (None, []),
+    # phase-level collective profiler
+    "tc_profile_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_profile_enable": (None, [_c, _int]),
+    "tc_profile_enabled": (_int, [_c]),
     # elastic membership plane (lease liveness + epoch transitions)
     "tc_elastic_new": (_c, [_c, _c, _int, _int, _int, _int,
                             ctypes.c_char_p, _i64]),

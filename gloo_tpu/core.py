@@ -824,6 +824,14 @@ class AsyncEngine:
                              for k, v in snap["transport"].items()}
         return snap
 
+    def lane_profile(self, lane: int) -> dict:
+        """Phase-profiler snapshot of lane `lane`'s sub-context — same
+        shape as Context.profile(). Like the flight recorder, lane k's
+        cseq axis is cross-rank comparable per lane: merge lane k
+        against the peers' lane k, never across lanes."""
+        return json.loads(_copy_out(_lib.lib.tc_profile_json,
+                                    self._lane_handle(lane)))
+
     def lane_flightrec(self, lane: int) -> dict:
         """Flight-recorder snapshot of lane `lane`'s sub-context — same
         shape as Context.flightrec(). Lane k's cseq/fingerprint stream
@@ -1110,6 +1118,34 @@ class Context:
         """Ops recorded so far (== the next op's sequence number)."""
         return int(_lib.lib.tc_flightrec_seq(self._handle))
 
+    # ---- phase-level collective profiler (docs/profiling.md) ----
+
+    def profile(self) -> dict:
+        """Snapshot the context's phase profiler as a dict.
+
+        Shape: {"rank", "size", "group", "enabled", "now_us",
+        "next_seq", "capacity", "dropped", "ops": [{"seq", "cseq",
+        "op", "algo", "bytes", "start_us", "total_us",
+        "phases": {"pack"|"post"|"wire_wait"|"reduce"|"unpack"|
+        "intra"|"inter"|"fanout": us, ...}}, ...]} where `cseq` is the
+        flight recorder's cross-rank collective sequence number — merge
+        per-rank snapshots with gloo_tpu.utils.profile.merge() and
+        attribute stragglers with .attribute(). Non-draining: the
+        bounded ring (TPUCOLL_PROFILE_RING) keeps rolling; `dropped`
+        counts overwritten rows. Aggregate per-(op, algorithm, phase)
+        histograms land in metrics()["phases"]."""
+        return json.loads(_copy_out(_lib.lib.tc_profile_json,
+                                    self._handle))
+
+    def profile_enable(self, on: bool = True) -> None:
+        """Toggle the phase profiler at runtime (overrides the
+        TPUCOLL_PROFILE environment gate for this context). Off, every
+        collective pays exactly one relaxed atomic load."""
+        _lib.lib.tc_profile_enable(self._handle, 1 if on else 0)
+
+    def profile_enabled(self) -> bool:
+        return bool(_lib.lib.tc_profile_enabled(self._handle))
+
     # ---- metrics + straggler watchdog (capability the reference lacks) --
 
     def metrics(self, drain: bool = False) -> dict:
@@ -1121,7 +1157,10 @@ class Context:
         "faults": {"total", <action>: n...},
         "transport_failure": null | {"peer", "count", "message"},
         "ops": {name: {"calls", "bytes", "errors",
-        "latency_us": hist}}, "transport": {peer: {"sent_msgs",
+        "latency_us": hist}},
+        "phases": {op: {algorithm: {phase: hist}}} (the phase
+        profiler's aggregates, docs/profiling.md),
+        "transport": {peer: {"sent_msgs",
         "sent_bytes", "recv_msgs", "recv_bytes", "last_progress_us",
         "last_progress_age_us", "rx_pauses", "recv_wait_us": hist}},
         "watchdog":

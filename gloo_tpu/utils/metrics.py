@@ -123,6 +123,19 @@ def to_prometheus(snapshot: dict,
         _emit_histogram(lines, "gloo_tpu_collective_latency_us",
                         s.get("latency_us", {}), labels)
 
+    # Phase profiler aggregates (docs/profiling.md): one histogram per
+    # (collective, algorithm, phase) — the scrape-side decomposition of
+    # gloo_tpu_collective_latency_us into pack/post/wire_wait/reduce/
+    # unpack (+ hier intra/inter/fanout).
+    lines.append("# TYPE gloo_tpu_phase_latency_us histogram")
+    for op, algos in sorted(snapshot.get("phases", {}).items()):
+        for algo, phases in sorted(algos.items()):
+            for phase, hist in sorted(phases.items()):
+                labels = {**base, "op": op, "algorithm": algo,
+                          "phase": phase}
+                _emit_histogram(lines, "gloo_tpu_phase_latency_us",
+                                hist, labels)
+
     lines.append("# TYPE gloo_tpu_transport_sent_msgs_total counter")
     lines.append("# TYPE gloo_tpu_transport_sent_bytes_total counter")
     lines.append("# TYPE gloo_tpu_transport_recv_msgs_total counter")

@@ -207,6 +207,36 @@ def test_bench_elastic_soak_smoke():
     assert line["rebuild_ms_p99"] >= line["rebuild_ms_p50"]
 
 
+def test_bench_profile_smoke():
+    """bench.py --profile --quick (2 ranks): one per-phase breakdown
+    JSON line per (size x algorithm) cell plus the profiler overhead
+    A/B line (docs/profiling.md). Each cell must profile its timed ops
+    and the breakdown must carry canonical phase names."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--profile", "--quick"],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    cells = [l for l in lines if l["metric"] == "profile_phases"]
+    abs_ = [l for l in lines if l["metric"] == "profile_overhead_ab"]
+    assert len(cells) == 3 and len(abs_) == 1, proc.stdout
+    phase_names = {"pack", "post", "wire_wait", "reduce", "unpack",
+                   "intra", "inter", "fanout"}
+    for cell in cells:
+        assert cell["ok"] is True, cell
+        assert cell["profiled_ops"] > 0, cell
+        assert cell["mean_phase_us"], cell
+        assert set(cell["mean_phase_us"]) <= phase_names, cell
+        assert "wire_wait" in cell["mean_phase_us"], cell
+    ab = abs_[0]
+    assert ab["ok"] is True, ab
+    assert ab["p50_us_profile_on"] > 0 and ab["p50_us_profile_off"] > 0
+
+
 def test_bench_wire_sweep_smoke():
     """bench.py --wire-sweep --quick (2 ranks): one valid JSON
     measurement line per wire-codec arm — the crossover data the lossy

@@ -528,3 +528,98 @@ def test_tsan_split_hier_smoke():
                             env=env)
     assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
     assert "SPLIT-HIER-SMOKE-OK" in result.stdout, result.stdout
+
+
+_PROFILE_PROG = f"""
+import json, sys, threading, urllib.request
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+from gloo_tpu.utils import telemetry
+
+size = 2
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        x = np.full(1 << 16, 1.0, dtype=np.float32)
+        for _ in range(4):
+            ctx.allreduce(x, algorithm="ring")
+            x[:] = 1.0
+        prof = ctx.profile()
+        assert len(prof["ops"]) == 4, prof
+        assert all("wire_wait" in o["phases"] for o in prof["ops"]), prof
+        assert "ring" in ctx.metrics()["phases"]["allreduce"]
+        with telemetry.serve_telemetry(ctx) as srv:
+            with urllib.request.urlopen(srv.url + "/healthz") as r:
+                assert r.status == 200
+            with urllib.request.urlopen(srv.url + "/profile.json") as r:
+                assert json.load(r)["ops"], "empty live profile"
+        ctx.profile_enable(False)
+        ctx.barrier()
+        assert ctx.profile()["next_seq"] == 4
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+[t.start() for t in threads]
+[t.join(180) for t in threads]
+assert not errors, errors
+print("PROFILE-SMOKE-OK")
+"""
+
+
+def test_asan_profile_smoke():
+    """Skip-unless-built ASan smoke of the phase profiler + telemetry
+    endpoint through the ctypes surface: profiled collectives, the
+    per-op ring + phase histograms, a live /healthz + /profile.json
+    scrape, and the runtime toggle — the lock-free ring publish and the
+    keyed-histogram flush are the new memory-shape code under test."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS":
+                          "detect_leaks=0,abort_on_error=1"})
+    result = subprocess.run([sys.executable, "-c", _PROFILE_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "PROFILE-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_profile_smoke():
+    """UBSan flavor of the profiler smoke (-fno-sanitize-recover: the
+    first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib)
+    result = subprocess.run([sys.executable, "-c", _PROFILE_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "PROFILE-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_profile_smoke():
+    """TSan flavor: two ranks publishing to their profiler rings while
+    the telemetry thread snapshots them is exactly the writer/dumper
+    race the claim-then-publish seq protocol must keep benign."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7"})
+    result = subprocess.run([sys.executable, "-c", _PROFILE_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "PROFILE-SMOKE-OK" in result.stdout, result.stdout

@@ -1,0 +1,494 @@
+"""Phase-level collective profiler + live telemetry endpoint (ISSUE 15,
+docs/profiling.md):
+
+- phase sums reconcile with the op's metrics-histogram latency;
+- per-op breakdowns join the flight recorder's streams by cseq;
+- the bounded ring counts drops; TPUCOLL_PROFILE=0 leaves no records;
+- cross-rank attribution blames the rank a chaos schedule delayed;
+- /healthz flips non-200 while the watchdog stall is fresh and recovers;
+- strict env knob matrix (TPUCOLL_PROFILE, TPUCOLL_PROFILE_RING,
+  TPUCOLL_TELEMETRY_PORT);
+- same-seed chaos produces identical per-rank phase-sequence streams.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import fault
+from gloo_tpu.utils import metrics as metrics_util
+from gloo_tpu.utils import profile as profile_util
+from gloo_tpu.utils import telemetry
+from harness import spawn
+
+PHASE_NAMES = {"pack", "post", "wire_wait", "reduce", "unpack",
+               "intra", "inter", "fanout"}
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read().decode() or "{}")
+
+
+def test_phase_sums_reconcile_with_metrics_latency():
+    """Sum of a profiled op's phases is bounded by (and, for a payload
+    where waits dominate, close to) the op's metrics-histogram latency
+    — the phases decompose the same wall time the histogram records."""
+
+    def body(ctx, rank):
+        x = np.ones(1 << 20, dtype=np.float32)  # 4 MiB
+        ctx.allreduce(x, algorithm="ring")  # warm plans/registrations
+        ctx.metrics(drain=True)
+        ctx.allreduce(x, algorithm="ring")
+        snap = ctx.metrics()
+        prof = ctx.profile()
+        return snap, prof
+
+    for snap, prof in spawn(2, body):
+        ops = [o for o in prof["ops"] if o["op"] == "allreduce"]
+        timed = ops[-1]
+        assert timed["algo"] == "ring", timed
+        assert set(timed["phases"]) <= PHASE_NAMES, timed
+        phase_sum = sum(timed["phases"].values())
+        total = timed["total_us"]
+        # Disjoint sub-intervals of the op: the sum can't exceed the
+        # op's own span beyond clock granularity...
+        assert phase_sum <= total * 1.05 + 200, (phase_sum, total)
+        # ...and posts+waits+reduce dominate a 4 MiB ring op.
+        assert phase_sum >= 0.3 * total, (phase_sum, total)
+        # The metrics histogram recorded the same single op, a strict
+        # superset of the profiled span (MetricsOp opens first).
+        hist = snap["ops"]["allreduce"]["latency_us"]
+        assert hist["count"] == 1, hist
+        assert total <= hist["sum_us"] * 1.05 + 200, (total, hist)
+        assert phase_sum <= hist["sum_us"] * 1.05 + 200
+
+
+def test_cseq_joins_flightrec_streams():
+    """Every profiled collective joins the flight recorder's record at
+    the same cseq — same op name, same resolved algorithm — so one
+    rank's phase breakdown can be lined up against another's."""
+
+    def body(ctx, rank):
+        x = np.ones(4096, dtype=np.float32)
+        ctx.allreduce(x, algorithm="ring")
+        ctx.barrier()
+        out = np.zeros(4096 * 2, dtype=np.float32)
+        ctx.allgather(x, output=out)
+        return ctx.profile(), ctx.flightrec()
+
+    results = spawn(2, body)
+    for prof, fr in results:
+        fr_by_cseq = {e["cseq"]: e for e in fr["events"]
+                      if e.get("cseq") is not None}
+        assert len(prof["ops"]) == 3
+        for op in prof["ops"]:
+            assert op["cseq"] in fr_by_cseq, (op, sorted(fr_by_cseq))
+            event = fr_by_cseq[op["cseq"]]
+            assert event["op"] == op["op"], (op, event)
+            assert event["algo"] == op["algo"], (op, event)
+    # And the cseq axis is cross-rank: rank 0 and rank 1 profiled the
+    # same (cseq, op) sequence.
+    seq0 = [(o["cseq"], o["op"]) for o in results[0][0]["ops"]]
+    seq1 = [(o["cseq"], o["op"]) for o in results[1][0]["ops"]]
+    assert seq0 == seq1
+
+
+def test_bounded_ring_drop_counter(monkeypatch):
+    monkeypatch.setenv("TPUCOLL_PROFILE_RING", "8")
+
+    def body(ctx, rank):
+        for _ in range(20):
+            ctx.barrier()
+        return ctx.profile()
+
+    for prof in spawn(2, body):
+        assert prof["capacity"] == 8, prof["capacity"]
+        assert prof["next_seq"] == 20
+        assert prof["dropped"] == 12
+        assert len(prof["ops"]) == 8
+        # The ring keeps the LAST 8 ops.
+        assert [o["seq"] for o in prof["ops"]] == list(range(12, 20))
+
+
+def test_profile_off_leaves_no_records(monkeypatch):
+    """TPUCOLL_PROFILE=0: the entry gate is the only cost — no ring
+    rows, no phase histograms in the metrics registry."""
+    monkeypatch.setenv("TPUCOLL_PROFILE", "0")
+
+    def body(ctx, rank):
+        x = np.ones(1 << 16, dtype=np.float32)
+        ctx.allreduce(x)
+        ctx.barrier()
+        return ctx.profile(), ctx.metrics()
+
+    for prof, snap in spawn(2, body):
+        assert prof["enabled"] is False
+        assert prof["next_seq"] == 0
+        assert prof["ops"] == []
+        assert snap["phases"] == {}, snap["phases"]
+
+
+def test_runtime_toggle():
+    def body(ctx, rank):
+        assert ctx.profile_enabled()
+        ctx.profile_enable(False)
+        ctx.barrier()
+        off = ctx.profile()["next_seq"]
+        ctx.profile_enable(True)
+        ctx.barrier()
+        on = ctx.profile()["next_seq"]
+        return off, on
+
+    for off, on in spawn(2, body):
+        assert off == 0
+        assert on == 1
+
+
+def test_phase_histograms_flow_to_prometheus():
+    """The per-(op, algorithm, phase) aggregates land in the metrics
+    snapshot and render as the gloo_tpu_phase_latency_us family."""
+
+    def body(ctx, rank):
+        x = np.ones(1 << 18, dtype=np.float32)
+        ctx.allreduce(x, algorithm="ring")
+        return ctx.metrics()
+
+    snap = spawn(2, body)[0]
+    assert "ring" in snap["phases"]["allreduce"], snap["phases"]
+    ring = snap["phases"]["allreduce"]["ring"]
+    assert "wire_wait" in ring and ring["wire_wait"]["count"] >= 1
+    text = metrics_util.to_prometheus(snap)
+    assert 'gloo_tpu_phase_latency_us_count{algorithm="ring",' \
+        in text and 'phase="wire_wait"' in text, text[:2000]
+    # Drain resets the phase aggregates with the rest of the registry.
+
+
+def test_metrics_disable_freezes_phase_aggregates():
+    """ctx.metrics_enable(False) freezes the WHOLE registry — the
+    phase-histogram flush honors the same gate as every other recorder
+    — while the profiler's own per-op ring keeps recording (it has its
+    own gate)."""
+
+    def body(ctx, rank):
+        ctx.metrics_enable(False)
+        x = np.ones(4096, dtype=np.float32)
+        ctx.allreduce(x, algorithm="ring")
+        snap = ctx.metrics()
+        prof = ctx.profile()
+        ctx.metrics_enable(True)
+        return snap, prof
+
+    for snap, prof in spawn(2, body):
+        assert snap["phases"] == {}, snap["phases"]
+        # connect was counted before the disable; the op itself wasn't.
+        assert "allreduce" not in snap["ops"], snap["ops"]
+        assert prof["next_seq"] == 1 and prof["ops"], prof
+
+
+def test_merge_duplicate_rank_snapshots_never_mix():
+    """Two snapshots for one rank (stale dump beside a live fetch): the
+    last wins wholesale — per-cseq ops from different snapshots of one
+    rank must never interleave — and the rank is reported."""
+    old = {"rank": 0, "size": 2, "ops": [
+        {"cseq": 0, "op": "allreduce", "algo": "ring", "bytes": 4,
+         "start_us": 0, "total_us": 10, "phases": {"wire_wait": 9}},
+        {"cseq": 1, "op": "barrier", "algo": None, "bytes": 0,
+         "start_us": 20, "total_us": 5, "phases": {"wire_wait": 4}}]}
+    new = {"rank": 0, "size": 2, "ops": [
+        {"cseq": 2, "op": "allreduce", "algo": "ring", "bytes": 4,
+         "start_us": 40, "total_us": 12, "phases": {"wire_wait": 11}}]}
+    peer = {"rank": 1, "size": 2, "ops": [
+        {"cseq": 2, "op": "allreduce", "algo": "ring", "bytes": 4,
+         "start_us": 7, "total_us": 12, "phases": {"wire_wait": 2}}]}
+    merged = profile_util.merge([old, new, peer])
+    assert merged["ranks"] == [0, 1]
+    assert merged["duplicates"] == [0]
+    # Only the LAST rank-0 snapshot's ops participate.
+    assert sorted(merged["ops"]) == [2], merged["ops"]
+
+
+def test_merge_never_joins_across_groups():
+    """Split sub-groups renumber ranks and run independent schedules —
+    their cseq axes must never be compared. merge() keeps one group
+    (noting the skipped ones); merge_by_group partitions a mixed set."""
+    def snap(rank, group, cseq):
+        return {"rank": rank, "size": 2, "group": group, "ops": [
+            {"cseq": cseq, "op": "allreduce", "algo": "ring",
+             "bytes": 4, "start_us": 0, "total_us": 10,
+             "phases": {"wire_wait": 5}}]}
+
+    a0, a1 = snap(0, "s1.0.c0", 5), snap(1, "s1.0.c0", 5)
+    b1 = snap(1, "s1.0.c1", 5)
+    merged = profile_util.merge([a0, a1, b1])
+    assert merged["group"] == "s1.0.c0"
+    assert merged["ranks"] == [0, 1]
+    assert merged["skipped_groups"] == ["s1.0.c1"]
+    # Group B's rank 1 must not have displaced group A's.
+    assert merged["ops"][5][1] is a1["ops"][0]
+    by_group = profile_util.merge_by_group([a0, a1, b1])
+    assert sorted(by_group) == ["s1.0.c0", "s1.0.c1"]
+    assert by_group["s1.0.c1"]["ranks"] == [1]
+
+
+def test_metrics_drain_resets_phase_histograms():
+    def body(ctx, rank):
+        x = np.ones(4096, dtype=np.float32)
+        ctx.allreduce(x, algorithm="ring")
+        ctx.metrics(drain=True)
+        return ctx.metrics()
+
+    snap = spawn(2, body)[0]
+    for algos in snap["phases"].values():
+        for phases in algos.values():
+            for hist in phases.values():
+                assert hist["count"] == 0, snap["phases"]
+
+
+@pytest.mark.parametrize("var,value", [
+    ("TPUCOLL_PROFILE", "banana"),
+    ("TPUCOLL_PROFILE", "2"),
+    ("TPUCOLL_PROFILE_RING", "0"),
+    ("TPUCOLL_PROFILE_RING", "many"),
+    ("TPUCOLL_PROFILE_RING", "-4"),
+])
+def test_strict_env_knobs(monkeypatch, var, value):
+    """Malformed profiler knobs fail loudly at Context construction
+    (common/env.h strict parsers), never silently fall back."""
+    monkeypatch.setenv(var, value)
+    with pytest.raises(gloo_tpu.Error, match=var):
+        gloo_tpu.Context(0, 1)
+
+
+@pytest.mark.parametrize("value", ["abc", "70000", "-1"])
+def test_strict_telemetry_port(monkeypatch, value):
+    monkeypatch.setenv("TPUCOLL_TELEMETRY_PORT", value)
+
+    def body(ctx, rank):
+        with pytest.raises(ValueError, match="TPUCOLL_TELEMETRY_PORT"):
+            telemetry.serve_telemetry(ctx)
+
+    spawn(1, body)
+
+
+def test_telemetry_routes():
+    """All five routes against a live context: /metrics exposition,
+    /healthz 200, /profile.json + /flightrec rings, the guarded POST
+    /flightrec/dump (405 on GET) — and, with a token configured, EVERY
+    route requires it (403 without, header or ?token= accepted)."""
+
+    def body(ctx, rank):
+        x = np.ones(1 << 14, dtype=np.float32)
+        ctx.allreduce(x)
+        with telemetry.serve_telemetry(ctx, token="s3cret") as srv:
+            # Unauthenticated: every route refuses, GET and POST alike.
+            status, _ = _get(srv.url + "/healthz")
+            assert status == 403
+            req = urllib.request.Request(srv.url + "/flightrec/dump",
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 403
+            # Authenticated via ?token= query parameter...
+            status, hz = _get(srv.url + "/healthz?token=s3cret")
+            assert status == 200 and hz["ok"], hz
+            # ...and via the header.
+            tok = {"X-TpuColl-Token": "s3cret"}
+
+            def get(path):
+                req = urllib.request.Request(srv.url + path, headers=tok)
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            status, text = get("/metrics")
+            assert status == 200
+            assert b"gloo_tpu_collective_calls_total" in text
+            assert b"gloo_tpu_phase_latency_us" in text
+            status, prof = get("/profile.json")
+            assert status == 200 and json.loads(prof)["ops"]
+            status, fr = get("/flightrec")
+            assert status == 200 and json.loads(fr)["events"]
+            status, _ = get("/flightrec/dump")
+            assert status == 405
+            req = urllib.request.Request(
+                srv.url + "/flightrec/dump", method="POST", headers=tok)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                doc = json.load(resp)
+            assert doc["path"].endswith(f"flightrec-rank{rank}.json")
+            status, _ = get("/nope")
+            assert status == 404
+        # A split sub-context's dump route mirrors the native tagged
+        # naming (flightrec-rank<r>-g<tag>.json), so same-rank contexts
+        # sharing TPUCOLL_FLIGHTREC_DIR never overwrite each other.
+        sub = ctx.split(0, tag=11)
+        try:
+            with telemetry.serve_telemetry(sub) as ssrv:
+                req = urllib.request.Request(
+                    ssrv.url + "/flightrec/dump", method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    doc = json.load(resp)
+            tag = sub.group_tag().replace("/", ".")
+            assert tag and doc["path"].endswith(
+                f"flightrec-rank{sub.rank}-g{tag}.json"), doc
+        finally:
+            sub.close()
+
+    spawn(2, body)
+
+
+def test_healthz_unresolved_stall_stays_unhealthy():
+    """The watchdog fires at most once per blocked wait, so /healthz
+    must not age a WEDGED rank back to healthy: with the blamed peer
+    showing no transport progress since detection the verdict stays
+    unhealthy regardless of the stall's age; once the peer progressed,
+    age governs (pure-function check over synthetic snapshots)."""
+    stall = {"op": "recv", "peer": 1, "slot": 7, "waited_us": 200_000,
+             "at_us": 10_000_000, "age_us": 60_000_000}
+    base = {"rank": 0, "group": "", "watchdog_ms": 150,
+            "watchdog": {"stalls": 1, "last": dict(stall)},
+            "transport": {1: {"last_progress_us": 9_000_000}}}
+    # Peer never progressed past the stall: unhealthy despite 60s age.
+    verdict = telemetry.healthz(base)
+    assert not verdict["ok"] and "unresolved" in verdict["reasons"][0], \
+        verdict
+    # Peer progressed after detection + record aged out: healthy.
+    resumed = dict(base,
+                   transport={1: {"last_progress_us": 11_000_000}})
+    assert telemetry.healthz(resumed)["ok"], telemetry.healthz(resumed)
+    # Peer progressed but the record is still fresh: unhealthy.
+    fresh = dict(resumed, watchdog={"stalls": 1,
+                                    "last": dict(stall, age_us=100_000)})
+    assert not telemetry.healthz(fresh)["ok"]
+    # Unknown peer (recv-from-any): falls back to freshness alone.
+    anypeer = dict(base, watchdog={"stalls": 1,
+                                   "last": dict(stall, peer=-1)})
+    assert telemetry.healthz(anypeer)["ok"]
+    # String-keyed transport (raw JSON snapshot) resolves the same way.
+    rawkeys = dict(base,
+                   transport={"1": {"last_progress_us": 11_000_000}})
+    assert telemetry.healthz(rawkeys)["ok"]
+
+
+def test_attribution_blames_delayed_rank():
+    """Chaos-grounded attribution: a PR 3 fault schedule delays rank
+    1's data sends 50 ms mid-allreduce at P=3; the merged cross-rank
+    attribution must blame rank 1 — the other ranks' wire_wait excess
+    over the cross-rank minimum points at the straggler."""
+    fault.install({"seed": 7, "faults": [
+        {"when": {"rank": 1, "opcode": "data", "min_bytes": 1024},
+         "action": "delay", "ms": 50, "count": 6}]})
+    try:
+        def body(ctx, rank):
+            x = np.ones(1 << 18, dtype=np.float32)  # 1 MiB
+            for _ in range(4):
+                ctx.allreduce(x, algorithm="ring")
+            return ctx.profile()
+
+        snaps = spawn(3, body)
+    finally:
+        fired = fault.report()
+        fault.clear()
+    assert any(e["action"] == "delay" and e["rank"] == 1 for e in fired), \
+        fired
+    merged = profile_util.merge(snaps)
+    attributed = profile_util.attribute(merged)
+    board = profile_util.leaderboard(attributed)
+    assert board[0]["rank"] == 1, board
+    # The blamed time must reflect the injected delays (6 x 50 ms fired
+    # across the job, each stalling at least one peer's wire phase).
+    assert board[0]["blamed_us"] > 50_000, board
+    blamed = [o["straggler"] for o in attributed["ops"]
+              if o["excess_us"] > 30_000]
+    assert blamed and all(r == 1 for r in blamed), attributed["ops"]
+
+
+def test_healthz_flips_on_watchdog_stall_and_recovers():
+    """A stalled peer trips the watchdog on the blocked rank; its
+    /healthz serves 503 while the stall record is fresh and recovers to
+    200 once the window passes. The stalling rank itself (which never
+    waited) stays 200 throughout."""
+    fault.install({"seed": 8, "faults": [
+        {"when": {"rank": 1, "opcode": "data", "nth": 1},
+         "action": "stall", "ms": 1200, "count": 1}]})
+    try:
+        def body(ctx, rank):
+            ctx.set_watchdog(0.15)
+            x = np.ones(1 << 16, dtype=np.float32)
+            ctx.allreduce(x, algorithm="ring")
+            snap = ctx.metrics()
+            last = snap["watchdog"]["last"]
+            if not last or last.get("peer") != 1:
+                # Not the blocked observer (e.g. the stalling rank).
+                with telemetry.serve_telemetry(ctx) as srv:
+                    status, hz = _get(srv.url + "/healthz")
+                return ("healthy", status, hz)
+            age_ms = last["age_us"] / 1000.0
+            window = age_ms + 2000.0
+            with telemetry.serve_telemetry(
+                    ctx, stall_window_ms=window) as srv:
+                status1, hz1 = _get(srv.url + "/healthz")
+                deadline = time.monotonic() + 15.0
+                status2, hz2 = status1, hz1
+                while time.monotonic() < deadline and status2 != 200:
+                    time.sleep(0.3)
+                    status2, hz2 = _get(srv.url + "/healthz")
+            return ("stalled", status1, hz1, status2, hz2)
+
+        results = spawn(3, body, timeout=90, context_timeout=60)
+    finally:
+        fault.clear()
+    stalled = [r for r in results if r[0] == "stalled"]
+    assert stalled, results  # someone must have observed the stall
+    for _, status1, hz1, status2, hz2 in stalled:
+        assert status1 == 503, hz1
+        assert any("watchdog stall" in why for why in hz1["reasons"]), hz1
+        assert status2 == 200, hz2
+    for r in results:
+        if r[0] == "healthy":
+            assert r[1] == 200, r
+
+
+def test_same_seed_chaos_identical_phase_streams():
+    """Same seed + schedule + workload => every rank's profiled
+    (cseq, op, algo) stream is identical across runs (timings differ;
+    the SEQUENCE is deterministic, like the flight recorder's)."""
+    schedule = {"seed": 21, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 5, "prob": 0.5, "count": 8}]}
+
+    def run_once():
+        fault.install(schedule)
+        try:
+            def body(ctx, rank):
+                x = np.ones(1 << 14, dtype=np.float32)
+                for _ in range(3):
+                    ctx.allreduce(x, algorithm="ring")
+                ctx.barrier()
+                return [(o["cseq"], o["op"], o["algo"])
+                        for o in ctx.profile()["ops"]]
+
+            streams = spawn(3, body)
+            return streams, fault.report()
+        finally:
+            fault.clear()
+
+    streams_a, report_a = run_once()
+    streams_b, report_b = run_once()
+    assert streams_a == streams_b
+    strip = lambda rep: [  # noqa: E731 - local normalization
+        {k: e[k] for k in ("rule", "rank", "action", "n")}
+        for e in rep]
+    assert strip(report_a) == strip(report_b)

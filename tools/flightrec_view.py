@@ -1,14 +1,17 @@
 #!/usr/bin/env python
 """Post-mortem viewer for flight-recorder dumps (docs/flightrec.md).
 
-Point it at a dump directory (or individual dump files); it merges the
-per-rank rings, prints the cross-rank timeline tail and the verdict —
-desync (who ran what at the diverging seq), stall (who everyone blames),
-or clean — and can emit a Perfetto/chrome://tracing file of the merged
-timeline.
+Point it at a dump directory, individual dump files, or LIVE ranks'
+telemetry endpoints (``http://host:port`` sources fetch ``/flightrec``
+from gloo_tpu.utils.telemetry.serve_telemetry — post-mortem and live
+tooling share this one CLI); it merges the per-rank rings, prints the
+cross-rank timeline tail and the verdict — desync (who ran what at the
+diverging seq), stall (who everyone blames), or clean — and can emit a
+Perfetto/chrome://tracing file of the merged timeline.
 
     python tools/flightrec_view.py flightrec-dump/
     python tools/flightrec_view.py dump/flightrec-rank*.json --tail 30
+    python tools/flightrec_view.py http://10.0.0.1:9401 http://10.0.0.2:9401
     python tools/flightrec_view.py flightrec-dump/ --perfetto out.json
     python tools/flightrec_view.py flightrec-dump/ --check   # exit 2 on desync
 
@@ -25,12 +28,28 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from gloo_tpu.utils import flightrec  # noqa: E402
+from gloo_tpu.utils.telemetry import fetch_route  # noqa: E402
+
+
+def _resolve_source(src: str):
+    """A CLI source -> something flightrec.merge understands: http(s)
+    URLs fetch the live /flightrec ring (loaded dict; unreachable ranks
+    degrade to None, exactly like a missing dump file), everything else
+    passes through as a path."""
+    if not (src.startswith("http://") or src.startswith("https://")):
+        return src
+    try:
+        return fetch_route(src, "/flightrec")
+    except Exception as exc:  # noqa: BLE001 - absence is evidence
+        print(f"warning: cannot fetch {src}: {exc}", file=sys.stderr)
+        return None
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dumps", nargs="+",
-                    help="dump directory or flightrec-rank*.json files")
+                    help="dump directory, flightrec-rank*.json files, "
+                         "or live http://host:port telemetry endpoints")
     ap.add_argument("--tail", type=int, default=20,
                     help="timeline rows to print (default 20)")
     ap.add_argument("--perfetto", metavar="OUT",
@@ -47,7 +66,8 @@ def main() -> int:
     if len(args.dumps) == 1 and os.path.isdir(args.dumps[0]):
         groups = flightrec.merge_by_tag(args.dumps[0])
     else:
-        groups = {"": flightrec.merge(args.dumps)}
+        sources = [_resolve_source(s) for s in args.dumps]
+        groups = {"": flightrec.merge(sources)}
     groups = {tag: m for tag, m in groups.items() if m["ranks"]}
     if not groups:
         print("no usable dumps found", file=sys.stderr)
