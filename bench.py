@@ -1095,6 +1095,127 @@ def bench_profile(quick=False):
         sys.exit(1)
 
 
+def bench_fleetobs(quick=False):
+    """--fleetobs: overhead A/B of the in-band fleet observability
+    plane (ISSUE 16; docs/fleet.md).
+
+    Each arm runs a fresh 4-rank subprocess grid over a FileStore with
+    two simulated hosts (TPUCOLL_HOST_ID per process) so the full
+    member -> leader -> rank 0 relay is live, and times `iters` ring
+    allreduces with the plane aggregating at a 100 ms interval (on) vs
+    TPUCOLL_FLEETOBS=0 (off). Arms are interleaved so host drift hits
+    both equally. The on-arm also reports the fleet document's
+    coverage — the committed evidence (OBS_r16.json) that the plane
+    covers every rank while staying inside host noise."""
+    import tempfile
+    import textwrap
+
+    if quick:
+        elements, iters, warmup, ab_passes = 1 << 18, 3, 1, 2
+    else:
+        elements, iters, warmup, ab_passes = 1 << 20, 8, 2, 5
+    size, rph = 4, 2
+
+    body = textwrap.dedent("""
+        import json, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+        from gloo_tpu.utils import fleet as fleet_util
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, {size}, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4])
+        warm = int(sys.argv[5]); fleet_on = sys.argv[6] == "on"
+        if fleet_on:
+            ctx.fleetobs_start()
+        x = np.full(n, 1.0, dtype=np.float32)
+        for _ in range(warm):
+            ctx.allreduce(x, algorithm="ring")
+            x[:] = 1.0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, algorithm="ring")
+            times.append(time.perf_counter() - t0)
+            x[:] = 1.0
+        coverage = None
+        if fleet_on and rank == 0:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                coverage = fleet_util.coverage(ctx.fleet())
+                if coverage["complete"]:
+                    break
+                time.sleep(0.1)
+        if rank == 0:
+            out = {{"p50_us": int(np.median(times) * 1e6),
+                    "running": ctx.fleetobs_running(),
+                    "coverage": coverage}}
+            print("RESULT " + json.dumps(out))
+        ctx.barrier()
+        if fleet_on:
+            ctx.fleetobs_stop()
+        ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)),
+                size=size)
+
+    def run_arm(arm):
+        store = tempfile.mkdtemp()
+        procs = []
+        for r in range(size):
+            env = dict(os.environ, TPUCOLL_SHM="0",
+                       TPUCOLL_HOST_ID=f"obshost{r // rph}",
+                       TPUCOLL_FLEETOBS="1" if arm == "on" else "0",
+                       TPUCOLL_FLEETOBS_INTERVAL_MS="100")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", body, str(r), store,
+                 str(elements), str(iters), str(warmup), arm],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env))
+        outs = [p.communicate(timeout=600) for p in procs]
+        if any(p.returncode != 0 for p in procs) or \
+                "RESULT " not in outs[0][0]:
+            return None, [f"rank {r}: rc={p.returncode} "
+                          f"err={outs[r][1][-200:]!r}"
+                          for r, p in enumerate(procs)]
+        return json.loads(outs[0][0].split("RESULT ", 1)[1]), None
+
+    on_us, off_us, ab_errors = [], [], []
+    coverages = []
+    for _ in range(ab_passes):
+        for arm, acc in (("on", on_us), ("off", off_us)):
+            res, err = run_arm(arm)
+            if res is None:
+                ab_errors.extend(err)
+                continue
+            acc.append(res["p50_us"])
+            if arm == "on":
+                coverages.append(res["coverage"])
+    line = {"metric": "fleetobs_overhead_ab", "algorithm": "ring",
+            "ranks": size, "hosts": size // rph, "elements": elements,
+            "bytes": elements * 4, "iters": iters, "passes": ab_passes}
+    covered = bool(coverages) and all(
+        c and c["complete"] for c in coverages)
+    # Same evidence discipline as the profiler A/B: any pass failure or
+    # coverage hole flips ok=False — a partial median would quietly
+    # overstate its own confidence.
+    if not on_us or not off_us or ab_errors or not covered:
+        line.update(ok=False, error=ab_errors, coverage=coverages,
+                    runs_on_us=on_us, runs_off_us=off_us)
+        print(json.dumps(line))
+        sys.exit(1)
+    med_on = sorted(on_us)[len(on_us) // 2]
+    med_off = sorted(off_us)[len(off_us) // 2]
+    line.update(ok=True, p50_us_fleetobs_on=med_on,
+                p50_us_fleetobs_off=med_off,
+                runs_on_us=on_us, runs_off_us=off_us,
+                coverage=coverages[-1],
+                overhead=round(med_on / med_off - 1.0, 4))
+    print(json.dumps(line))
+
+
 def bench_hier_sweep(quick=False):
     """--hier-sweep: flat (ring) vs hierarchical allreduce per
     (size x simulated hosts x ranks-per-host) cell, one JSON line per
@@ -1385,6 +1506,9 @@ def main():
         return
     if "--profile" in sys.argv[1:]:
         bench_profile(quick="--quick" in sys.argv[1:])
+        return
+    if "--fleetobs" in sys.argv[1:]:
+        bench_fleetobs(quick="--quick" in sys.argv[1:])
         return
     if "--elastic-soak" in sys.argv[1:]:
         i = sys.argv.index("--elastic-soak") + 1

@@ -683,6 +683,44 @@ int tc_profile_enabled(void* ctx) {
   });
 }
 
+// ---- in-band fleet observability plane (common/fleetobs.h) ----
+
+// Start the hierarchical telemetry fold for this rank's topology role
+// (docs/fleet.md): members push fixed-size reports to their host leader,
+// leaders pre-aggregate and relay to rank 0, which runs the anomaly
+// detectors. Requires a connected context; under TPUCOLL_FLEETOBS=0 the
+// start is a no-op and tc_fleetobs_running stays 0.
+int tc_fleetobs_start(void* ctx) {
+  return wrap([&] { asContext(ctx)->fleetObsStart(); });
+}
+
+// Stop and join the aggregation thread. Safe when never started; also
+// runs automatically at context close/destruction.
+int tc_fleetobs_stop(void* ctx) {
+  return wrap([&] { asContext(ctx)->fleetObsStop(); });
+}
+
+int tc_fleetobs_running(void* ctx) {
+  return wrapVal(0, [&] {
+    return asContext(ctx)->fleetObsRunning() ? 1 : 0;
+  });
+}
+
+// Merge `auxJson` (a JSON object — e.g. the Python elastic agent's
+// status) into this rank's next report as its "aux" field. Validated
+// here so malformed JSON fails this call, never the aggregation thread.
+int tc_fleetobs_set_aux(void* ctx, const char* auxJson) {
+  return wrap([&] {
+    asContext(ctx)->fleetObsSetAux(auxJson != nullptr ? auxJson : "");
+  });
+}
+
+// Latest merged fleet document (rank 0; a role stub elsewhere) — the
+// telemetry endpoint's /fleet payload. Malloc'd, free with tc_buf_free.
+int tc_fleet_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] { copyOut(asContext(ctx)->fleetJson(), out, outLen); });
+}
+
 // ---- collective autotuning plane (tuning/) ----
 
 // Run the tuner sweep (a COLLECTIVE — every rank must call concurrently

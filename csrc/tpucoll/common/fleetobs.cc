@@ -1,0 +1,831 @@
+// In-band fleet observability plane — see fleetobs.h for the design
+// contract and docs/fleet.md for the operator view.
+#include "tpucoll/common/fleetobs.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <utility>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/flightrec.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/common/metrics.h"
+#include "tpucoll/common/tracer.h"
+#include "tpucoll/context.h"
+#include "tpucoll/group/topology.h"
+#include "tpucoll/transport/unbound_buffer.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+namespace fleetobs {
+
+namespace {
+
+using Value = JsonReader::Value;
+
+// Detector kinds. The flight-recorder opcodes must be static strings
+// (the ring stores the pointer); keeping kind and opcode side by side
+// here is what guarantees /fleet and /flightrec spell them the same.
+constexpr const char* kKindStraggler = "persistent_straggler";
+constexpr const char* kKindSlowLink = "slow_link";
+constexpr const char* kKindLeaseJitter = "lease_jitter";
+
+const char* anomalyOpcode(const char* kind) {
+  if (std::strcmp(kind, kKindStraggler) == 0) {
+    return "anomaly:persistent_straggler";
+  }
+  if (std::strcmp(kind, kKindSlowLink) == 0) {
+    return "anomaly:slow_link";
+  }
+  if (std::strcmp(kind, kKindLeaseJitter) == 0) {
+    return "anomaly:lease_jitter";
+  }
+  return "anomaly:unknown";
+}
+
+// Relay slots: member -> leader reports under tag 0, leader -> rank 0
+// host documents under tag 1, each offset by the SENDER's global rank
+// so concurrent senders never share a (slot, src) stream.
+uint64_t memberSlot(int senderRank) {
+  return Slot::build(SlotPrefix::kFleetObs, 0)
+      .offset(static_cast<uint64_t>(senderRank))
+      .value();
+}
+uint64_t leaderSlot(int senderRank) {
+  return Slot::build(SlotPrefix::kFleetObs, 1)
+      .offset(static_cast<uint64_t>(senderRank))
+      .value();
+}
+
+double numField(const Value& obj, const char* name, double dflt) {
+  const Value* f = obj.field(name);
+  return f != nullptr && f->kind == Value::Kind::kNumber ? f->number : dflt;
+}
+
+// Trim the space padding a fixed-size report rides in.
+std::string trimmed(const char* data, size_t n) {
+  while (n > 0 && (data[n - 1] == ' ' || data[n - 1] == '\0')) {
+    n--;
+  }
+  return std::string(data, n);
+}
+
+// How stale a relayed document may get (in the RECEIVER's rounds)
+// before it stops counting as coverage. Receiver-side by design:
+// steady clocks are not comparable across processes.
+constexpr int64_t kStaleRounds = 5;
+
+}  // namespace
+
+Options Options::fromEnv() {
+  Options o;
+  o.enabled = envFlag("TPUCOLL_FLEETOBS", true);
+  o.intervalMs = envCount("TPUCOLL_FLEETOBS_INTERVAL_MS", 1000, 10, 600000);
+  o.maxBytes = std::max<size_t>(
+      envBytes("TPUCOLL_FLEETOBS_MAX_BYTES", 32768), 4096);
+  o.opsTail = static_cast<int>(envCount("TPUCOLL_FLEETOBS_OPS", 64, 0, 4096));
+  o.windowRounds =
+      static_cast<int>(envCount("TPUCOLL_FLEETOBS_WINDOW", 30, 2, 10000));
+  o.stragglerMs =
+      envCount("TPUCOLL_FLEETOBS_STRAGGLER_MS", 200, 1, 86400000);
+  return o;
+}
+
+FleetObs::FleetObs(Context* ctx) : ctx_(ctx) {}
+
+FleetObs::~FleetObs() { stop(); }
+
+void FleetObs::start() {
+  opts_ = Options::fromEnv();
+  if (!opts_.enabled) {
+    TC_INFO("fleetobs: disabled by TPUCOLL_FLEETOBS=0");
+    return;
+  }
+  if (running()) {
+    return;
+  }
+  std::shared_ptr<const Topology> topo = ctx_->topology();
+  TC_ENFORCE(topo != nullptr,
+             "fleetobs: start() requires a connected context");
+
+  isLeader_ = topo->isLeader;
+  leaderRank_ = topo->leader;
+  hostIndex_ = topo->hostIndex;
+  localMembers_.clear();
+  otherLeaders_.clear();
+  for (int r : topo->hosts[topo->hostIndex]) {
+    if (r != ctx_->rank() && isLeader_) {
+      localMembers_.push_back(r);
+    }
+  }
+  if (ctx_->rank() == 0) {
+    for (int h = 1; h < topo->nHosts(); h++) {
+      otherLeaders_.push_back(topo->hosts[h][0]);
+    }
+  }
+
+  // Wire buffers. Registered up front (one ubuf_create per endpoint,
+  // never per round) and reused for the lifetime of the service.
+  auto makeLink = [&](int rank, uint64_t slot, size_t nbytes) {
+    PeerLink p;
+    p.rank = rank;
+    p.slot = slot;
+    p.bytes.assign(nbytes, ' ');
+    p.ubuf = ctx_->createUnboundBuffer(p.bytes.data(), nbytes);
+    return p;
+  };
+  // Uplinks carry OUR rank in the slot (sender-keyed streams);
+  // downlinks carry the sender's.
+  if (!isLeader_) {
+    up_ = makeLink(leaderRank_, memberSlot(ctx_->rank()), opts_.maxBytes);
+  } else if (ctx_->rank() != 0) {
+    up_ = makeLink(0, leaderSlot(ctx_->rank()), hostDocBytes(hostIndex_));
+  }
+  members_.clear();
+  for (int m : localMembers_) {
+    members_.push_back(makeLink(m, memberSlot(m), opts_.maxBytes));
+    PeerLink& p = members_.back();
+    p.ubuf->recv(p.rank, p.slot, 0, p.bytes.size());
+    p.posted = true;
+  }
+  leaders_.clear();
+  if (ctx_->rank() == 0) {
+    for (int l : otherLeaders_) {
+      leaders_.push_back(
+          makeLink(l, leaderSlot(l), hostDocBytes(topo->hostOf[l])));
+      PeerLink& p = leaders_.back();
+      p.ubuf->recv(p.rank, p.slot, 0, p.bytes.size());
+      p.posted = true;
+    }
+  }
+
+  {
+    std::lock_guard<std::mutex> guard(stopMu_);
+    stopRequested_ = false;
+  }
+  running_.store(true, std::memory_order_release);
+  thread_ = std::thread([this] { runLoop(); });
+}
+
+void FleetObs::stop() {
+  {
+    std::lock_guard<std::mutex> guard(stopMu_);
+    if (stopRequested_ && !thread_.joinable()) {
+      return;
+    }
+    stopRequested_ = true;
+  }
+  stopCv_.notify_all();
+  // Unblock any wire wait the tick is sitting in.
+  auto abortLink = [](PeerLink& p) {
+    if (p.ubuf != nullptr) {
+      p.ubuf->abortWaitSend();
+      p.ubuf->abortWaitRecv();
+    }
+  };
+  abortLink(up_);
+  for (auto& p : members_) {
+    abortLink(p);
+  }
+  for (auto& p : leaders_) {
+    abortLink(p);
+  }
+  if (thread_.joinable()) {
+    thread_.join();
+  }
+  running_.store(false, std::memory_order_release);
+  // Release the buffers while the transport is still alive: a posted
+  // recv is cancelled by ~UnboundBuffer, which needs the mesh.
+  up_ = PeerLink();
+  members_.clear();
+  leaders_.clear();
+}
+
+void FleetObs::setAux(std::string auxJson) {
+  if (!auxJson.empty()) {
+    JsonReader(auxJson, "fleetobs aux").parse();  // throws on malformed
+  }
+  std::lock_guard<std::mutex> guard(auxMu_);
+  auxJson_ = std::move(auxJson);
+}
+
+std::string FleetObs::fleetJson() {
+  {
+    std::lock_guard<std::mutex> guard(fleetMu_);
+    if (!fleetJson_.empty()) {
+      return fleetJson_;
+    }
+  }
+  std::ostringstream out;
+  out << "{\"version\":1,\"kind\":\"fleet\",\"rank\":" << ctx_->rank()
+      << ",\"size\":" << ctx_->size() << ",\"enabled\":"
+      << (opts_.enabled && running() ? "true" : "false") << ",\"role\":\""
+      << (ctx_->rank() == 0 ? "root" : (isLeader_ ? "leader" : "member"))
+      << "\",\"hosts\":[],\"coverage\":{\"expected\":" << ctx_->size()
+      << ",\"reported\":0,\"missing\":[";
+  // An honest stub: nobody has reported, so every rank is missing
+  // (consumers must never read "missing: []" as complete coverage).
+  for (int r = 0; r < ctx_->size(); r++) {
+    out << (r == 0 ? "" : ",") << r;
+  }
+  out << "]},\"note\":"
+      << (ctx_->rank() == 0
+              ? "\"no aggregation round has completed yet\""
+              : "\"fleet view is aggregated at rank 0\"")
+      << "}";
+  return out.str();
+}
+
+size_t FleetObs::hostDocBytes(int hostIndex) const {
+  // Deterministic on both ends of the leader -> rank 0 relay: wrapper
+  // slack plus one report slot per member of that host. Both sides
+  // compute it from the same topology, so the posted recv size always
+  // matches the sent document size.
+  std::shared_ptr<const Topology> topo = ctx_->topology();
+  const size_t members = topo != nullptr && hostIndex < topo->nHosts()
+                             ? topo->hosts[hostIndex].size()
+                             : 1;
+  return 8192 + opts_.maxBytes * members;
+}
+
+void FleetObs::runLoop() {
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lock(stopMu_);
+      stopCv_.wait_for(lock, std::chrono::milliseconds(opts_.intervalMs),
+                       [&] { return stopRequested_; });
+      if (stopRequested_) {
+        return;
+      }
+    }
+    try {
+      round_++;
+      tick();
+    } catch (const std::exception& e) {
+      // A torn round must never kill the plane (and never the process:
+      // this is a detached-from-collectives background thread). The
+      // next tick retries from scratch.
+      TC_WARN("fleetobs: round ", round_, " failed: ", e.what());
+    }
+  }
+}
+
+void FleetObs::drainPeer(PeerLink& p) {
+  if (p.dead || p.ubuf == nullptr) {
+    return;
+  }
+  try {
+    while (true) {
+      if (!p.posted) {
+        p.ubuf->recv(p.rank, p.slot, 0, p.bytes.size());
+        p.posted = true;
+      }
+      int src = -1;
+      if (!p.ubuf->waitRecv(&src, std::chrono::milliseconds(0))) {
+        return;  // abort: stop() is tearing us down
+      }
+      p.posted = false;
+      p.latestRaw = trimmed(p.bytes.data(), p.bytes.size());
+      p.lastSeenRound = round_;
+    }
+  } catch (const TimeoutException&) {
+    // Nothing (more) arrived this tick; the posted recv stays armed.
+  } catch (const IoException& e) {
+    TC_WARN("fleetobs: link to rank ", p.rank,
+            " failed, dropping it from aggregation: ", e.what());
+    p.dead = true;
+  }
+}
+
+std::string FleetObs::buildReportAttempt(int opsTail, int maxLinks) {
+  const int64_t nowUs = Tracer::nowUs();
+  std::ostringstream out;
+  out << "{\"v\":1,\"rank\":" << ctx_->rank() << ",\"round\":" << round_
+      << ",\"t_us\":" << nowUs;
+
+  // Health + op totals from the canonical metrics snapshot (no drain:
+  // the fleet plane observes, it never consumes). Parsing our own JSON
+  // keeps the report in lockstep with the snapshot schema instead of
+  // duplicating accessors for every field.
+  Value snap = JsonReader(ctx_->metricsJson(false), "fleetobs metrics")
+                   .parse();
+  uint64_t calls = 0;
+  uint64_t errors = 0;
+  if (const Value* ops = snap.field("ops")) {
+    for (const auto& f : ops->fields) {
+      calls += static_cast<uint64_t>(numField(f.second, "calls", 0));
+      errors += static_cast<uint64_t>(numField(f.second, "errors", 0));
+    }
+  }
+  uint64_t stalls = 0;
+  int64_t stallAgeUs = -1;
+  if (const Value* wd = snap.field("watchdog")) {
+    stalls = static_cast<uint64_t>(numField(*wd, "stalls", 0));
+    if (const Value* last = wd->field("last")) {
+      if (last->kind == Value::Kind::kObject) {
+        stallAgeUs = static_cast<int64_t>(numField(*last, "age_us", -1));
+      }
+    }
+  }
+  int failurePeer = -1;
+  const Value* failure = snap.field("transport_failure");
+  if (failure != nullptr && failure->kind == Value::Kind::kObject) {
+    failurePeer = static_cast<int>(numField(*failure, "peer", -1));
+  }
+  uint64_t anoms = 0;
+  if (const Value* an = snap.field("anomalies")) {
+    anoms = static_cast<uint64_t>(numField(*an, "total", 0));
+  }
+  out << ",\"ok\":" << (failurePeer < 0 ? "true" : "false")
+      << ",\"stalls\":" << stalls << ",\"stall_age_us\":" << stallAgeUs
+      << ",\"failure_peer\":" << failurePeer << ",\"calls\":" << calls
+      << ",\"errors\":" << errors << ",\"anoms\":" << anoms;
+
+  // Link telemetry: the busiest links' EWMA estimates, [peer, bw_bps,
+  // rtt_us, bytes], most-traffic first so a bounded list keeps the
+  // links that matter.
+  struct Link {
+    int peer;
+    uint64_t bw, rtt, bytes;
+  };
+  std::vector<Link> links;
+  if (const Value* tp = snap.field("transport")) {
+    for (const auto& f : tp->fields) {
+      Link l;
+      l.peer = std::atoi(f.first.c_str());
+      l.bw = static_cast<uint64_t>(numField(f.second, "bw_ewma_bps", 0));
+      l.rtt = static_cast<uint64_t>(numField(f.second, "rtt_ewma_us", 0));
+      l.bytes =
+          static_cast<uint64_t>(numField(f.second, "sent_bytes", 0)) +
+          static_cast<uint64_t>(numField(f.second, "recv_bytes", 0));
+      if (l.bw != 0 || l.rtt != 0) {
+        links.push_back(l);
+      }
+    }
+  }
+  std::sort(links.begin(), links.end(),
+            [](const Link& a, const Link& b) { return a.bytes > b.bytes; });
+  if (static_cast<int>(links.size()) > maxLinks) {
+    links.resize(maxLinks);
+  }
+  out << ",\"links\":[";
+  for (size_t i = 0; i < links.size(); i++) {
+    out << (i == 0 ? "" : ",") << "[" << links[i].peer << ","
+        << links[i].bw << "," << links[i].rtt << "," << links[i].bytes
+        << "]";
+  }
+  out << "]";
+
+  // Profile ring tail keyed by the cross-rank collective sequence:
+  // [cseq, total_us, wire_wait_us] triples rank 0 joins into the
+  // in-band straggler leaderboard (profile.py attribute() semantics).
+  out << ",\"ops\":[";
+  if (opsTail > 0) {
+    Value prof = JsonReader(ctx_->profileJson(), "fleetobs profile")
+                     .parse();
+    const Value* ops = prof.field("ops");
+    if (ops != nullptr && ops->kind == Value::Kind::kArray) {
+      const int n = static_cast<int>(ops->items.size());
+      const int begin = n > opsTail ? n - opsTail : 0;
+      bool first = true;
+      for (int i = begin; i < n; i++) {
+        const Value& op = ops->items[i];
+        const int64_t cseq =
+            static_cast<int64_t>(numField(op, "cseq", -1));
+        if (cseq < 0) {
+          continue;  // p2p / unsequenced: no cross-rank join possible
+        }
+        uint64_t waitUs = 0;
+        if (const Value* phases = op.field("phases")) {
+          waitUs = static_cast<uint64_t>(
+              numField(*phases, "wire_wait", 0));
+        }
+        out << (first ? "" : ",") << "[" << cseq << ","
+            << static_cast<uint64_t>(numField(op, "total_us", 0)) << ","
+            << waitUs << "]";
+        first = false;
+      }
+    }
+  }
+  out << "]";
+
+  {
+    std::lock_guard<std::mutex> guard(auxMu_);
+    if (!auxJson_.empty()) {
+      out << ",\"aux\":" << auxJson_;
+    }
+  }
+  out << "}";
+  return out.str();
+}
+
+std::string FleetObs::buildReport() {
+  int opsTail = opts_.opsTail;
+  int maxLinks = 16;
+  while (true) {
+    std::string report = buildReportAttempt(opsTail, maxLinks);
+    if (report.size() <= opts_.maxBytes) {
+      return report;
+    }
+    if (opsTail == 0 && maxLinks == 0) {
+      // Minimal skeleton (aux was the offender): health only.
+      std::ostringstream out;
+      out << "{\"v\":1,\"rank\":" << ctx_->rank() << ",\"round\":"
+          << round_ << ",\"t_us\":" << Tracer::nowUs()
+          << ",\"ok\":true,\"truncated\":true,\"links\":[],\"ops\":[]}";
+      return out.str();
+    }
+    opsTail /= 2;
+    maxLinks /= 2;
+  }
+}
+
+std::string FleetObs::buildHostDoc() {
+  std::shared_ptr<const Topology> topo = ctx_->topology();
+  std::ostringstream out;
+  out << "{\"v\":1,\"host_index\":" << hostIndex_ << ",\"fingerprint\":";
+  appendJsonString(out, topo != nullptr
+                            ? topo->fingerprints[hostIndex_]
+                            : std::string());
+  out << ",\"leader\":" << ctx_->rank() << ",\"ranks\":{";
+  uint64_t calls = 0;
+  uint64_t errors = 0;
+  std::vector<int> unhealthy;
+  std::vector<int> missing;
+  int reported = 0;
+  bool first = true;
+  auto embed = [&](int rank, const std::string& raw) {
+    out << (first ? "" : ",") << "\"" << rank << "\":" << raw;
+    first = false;
+    reported++;
+    try {
+      Value v = JsonReader(raw, "fleetobs report").parse();
+      calls += static_cast<uint64_t>(numField(v, "calls", 0));
+      errors += static_cast<uint64_t>(numField(v, "errors", 0));
+      const Value* ok = v.field("ok");
+      if (ok != nullptr && ok->kind == Value::Kind::kBool && !ok->boolean) {
+        unhealthy.push_back(rank);
+      }
+    } catch (const std::exception&) {
+      unhealthy.push_back(rank);  // unparseable counts as unhealthy
+    }
+  };
+  embed(ctx_->rank(), buildReport());
+  for (auto& p : members_) {
+    if (!p.latestRaw.empty() && !p.dead &&
+        p.lastSeenRound >= round_ - kStaleRounds) {
+      embed(p.rank, p.latestRaw);
+    } else {
+      missing.push_back(p.rank);
+    }
+  }
+  out << "},\"missing\":[";
+  for (size_t i = 0; i < missing.size(); i++) {
+    out << (i == 0 ? "" : ",") << missing[i];
+  }
+  out << "],\"summary\":{\"ranks\":"
+      << (topo != nullptr ? topo->hosts[hostIndex_].size() : 1)
+      << ",\"reported\":" << reported << ",\"calls\":" << calls
+      << ",\"errors\":" << errors << ",\"unhealthy\":[";
+  for (size_t i = 0; i < unhealthy.size(); i++) {
+    out << (i == 0 ? "" : ",") << unhealthy[i];
+  }
+  out << "]}}";
+  return out.str();
+}
+
+void FleetObs::tick() {
+  // 1) Leaders pull whatever members pushed since the last tick.
+  for (auto& p : members_) {
+    drainPeer(p);
+  }
+  if (ctx_->rank() == 0) {
+    for (auto& p : leaders_) {
+      drainPeer(p);
+    }
+    mergeAndDetect(buildHostDoc());
+    return;
+  }
+
+  // 2) Everyone below rank 0 pushes one fixed-size document upward,
+  // never rewriting a buffer with a send still in flight.
+  if (up_.dead || up_.ubuf == nullptr) {
+    return;
+  }
+  try {
+    if (up_.sendPending) {
+      if (!up_.ubuf->waitSend(std::chrono::milliseconds(0))) {
+        return;  // aborted: shutting down
+      }
+      up_.sendPending = false;
+    }
+    const std::string doc = isLeader_ ? buildHostDoc() : buildReport();
+    if (doc.size() > up_.bytes.size()) {
+      TC_WARN("fleetobs: document (", doc.size(),
+              "B) exceeds the wire slot (", up_.bytes.size(),
+              "B); skipping round ", round_);
+      return;
+    }
+    std::fill(up_.bytes.begin(), up_.bytes.end(), ' ');
+    std::memcpy(up_.bytes.data(), doc.data(), doc.size());
+    up_.ubuf->send(up_.rank, up_.slot, 0, up_.bytes.size());
+    up_.sendPending = true;
+  } catch (const TimeoutException&) {
+    // Send still in flight: the parent is slow, not gone. Skip the
+    // round; the pending flag keeps the buffer untouched.
+  } catch (const IoException& e) {
+    TC_WARN("fleetobs: uplink to rank ", up_.rank,
+            " failed, reporting stops: ", e.what());
+    up_.dead = true;
+  }
+}
+
+void FleetObs::ingestStragglerOps(int rank, const Value& report) {
+  const Value* ops = report.field("ops");
+  if (ops == nullptr || ops->kind != Value::Kind::kArray) {
+    return;
+  }
+  for (const Value& triple : ops->items) {
+    if (triple.kind != Value::Kind::kArray || triple.items.size() < 3) {
+      continue;
+    }
+    const int64_t cseq = static_cast<int64_t>(triple.items[0].number);
+    if (cseq <= processedThroughCseq_) {
+      continue;  // already finalized (ring tails resend old entries)
+    }
+    PendingOp& p = pendingOps_[cseq];
+    if (p.perRank.empty()) {
+      p.firstRound = round_;
+    }
+    p.perRank[rank] = {static_cast<uint64_t>(triple.items[1].number),
+                       static_cast<uint64_t>(triple.items[2].number)};
+  }
+}
+
+void FleetObs::finalizePendingOps() {
+  // Finalize in ascending cseq order: an op closes when every rank
+  // answered, or after a 2-round grace with at least two answers (the
+  // join needs a comparison, not a census). The watermark stops ring
+  // resends from double counting.
+  constexpr int64_t kGraceRounds = 2;
+  for (auto it = pendingOps_.begin(); it != pendingOps_.end();) {
+    PendingOp& p = it->second;
+    const bool complete =
+        static_cast<int>(p.perRank.size()) >= ctx_->size();
+    const bool graceOver = round_ - p.firstRound >= kGraceRounds &&
+                           p.perRank.size() >= 2;
+    if (!complete && !graceOver) {
+      ++it;
+      continue;
+    }
+    // profile.py attribute(): straggler = argmin wire_wait (lowest rank
+    // wins ties), excess_r = wait_r - min wait, blame the straggler for
+    // the total excess.
+    uint64_t minWait = UINT64_MAX;
+    int straggler = -1;
+    for (const auto& rw : p.perRank) {
+      if (rw.second.second < minWait) {
+        minWait = rw.second.second;
+        straggler = rw.first;
+      }
+    }
+    uint64_t totalExcess = 0;
+    for (const auto& rw : p.perRank) {
+      totalExcess += rw.second.second - minWait;
+    }
+    if (straggler >= 0 && totalExcess > 0) {
+      window_.push_back(WindowOp{round_, straggler, totalExcess});
+    }
+    processedThroughCseq_ = std::max(processedThroughCseq_, it->first);
+    it = pendingOps_.erase(it);
+  }
+  while (!window_.empty() &&
+         window_.front().round < round_ - opts_.windowRounds) {
+    window_.pop_front();
+  }
+}
+
+bool FleetObs::debounced(const std::string& kind, int rank) {
+  int64_t& last = lastFiredRound_[kind][rank];
+  if (last != 0 && round_ - last < opts_.windowRounds) {
+    return true;
+  }
+  last = round_;
+  return false;
+}
+
+void FleetObs::fireAnomaly(const char* kind, int rank, uint64_t detail) {
+  ctx_->metrics().recordAnomaly(kind, rank);
+  ctx_->flightrec().noteEvent(anomalyOpcode(kind), rank, detail);
+  recent_.push_back(AnomalyEvent{kind, rank, Tracer::nowUs(), detail});
+  while (recent_.size() > 64) {
+    recent_.pop_front();
+  }
+  TC_WARN("fleetobs: anomaly ", kind, " rank ", rank, " detail ", detail);
+}
+
+void FleetObs::runDetectors(
+    const std::map<int, const Value*>& reports) {
+  // --- persistent straggler: dominant blame over the sliding window ---
+  std::map<int, std::pair<uint64_t, uint64_t>> blame;  // rank -> (us, ops)
+  uint64_t windowExcess = 0;
+  for (const WindowOp& w : window_) {
+    blame[w.straggler].first += w.excessUs;
+    blame[w.straggler].second += 1;
+    windowExcess += w.excessUs;
+  }
+  const uint64_t thresholdUs =
+      static_cast<uint64_t>(opts_.stragglerMs) * 1000;
+  for (const auto& b : blame) {
+    if (b.second.first >= thresholdUs &&
+        b.second.first * 2 >= windowExcess && !debounced(kKindStraggler,
+                                                         b.first)) {
+      fireAnomaly(kKindStraggler, b.first, b.second.first);
+    }
+  }
+
+  // --- slow link: pair EWMA bandwidth far below the fleet median ---
+  struct LinkSample {
+    int rank, peer;
+    uint64_t bw;
+  };
+  std::vector<LinkSample> samples;
+  std::vector<uint64_t> bws;
+  constexpr uint64_t kMinLinkBytes = 1 << 20;
+  for (const auto& rr : reports) {
+    const Value* links = rr.second->field("links");
+    if (links == nullptr || links->kind != Value::Kind::kArray) {
+      continue;
+    }
+    for (const Value& l : links->items) {
+      if (l.kind != Value::Kind::kArray || l.items.size() < 4) {
+        continue;
+      }
+      const uint64_t bw = static_cast<uint64_t>(l.items[1].number);
+      const uint64_t bytes = static_cast<uint64_t>(l.items[3].number);
+      if (bw == 0 || bytes < kMinLinkBytes) {
+        continue;
+      }
+      samples.push_back(LinkSample{
+          rr.first, static_cast<int>(l.items[0].number), bw});
+      bws.push_back(bw);
+    }
+  }
+  slowLinks_.clear();
+  if (bws.size() >= 4) {
+    std::sort(bws.begin(), bws.end());
+    const uint64_t median = bws[bws.size() / 2];
+    for (const LinkSample& s : samples) {
+      if (s.bw * 8 < median) {
+        slowLinks_.push_back(SlowLink{s.rank, s.peer, s.bw, median});
+        if (!debounced(kKindSlowLink, s.rank)) {
+          fireAnomaly(kKindSlowLink, s.rank, s.bw);
+        }
+      }
+    }
+  }
+
+  // --- lease jitter: renewal cadence far off the elastic plane's own
+  // lease period (aux.elastic, fed through tc_fleetobs_set_aux) ---
+  for (const auto& rr : reports) {
+    const Value* aux = rr.second->field("aux");
+    if (aux == nullptr) {
+      continue;
+    }
+    const Value* elastic = aux->field("elastic");
+    if (elastic == nullptr) {
+      continue;
+    }
+    const double leaseMs = numField(*elastic, "lease_ms", 0);
+    const double renewed = numField(*elastic, "leases_renewed", -1);
+    if (leaseMs <= 0 || renewed < 0) {
+      continue;
+    }
+    auto& hist = leaseHistory_[rr.first];
+    hist.emplace_back(round_, static_cast<uint64_t>(renewed));
+    while (!hist.empty() &&
+           hist.front().first < round_ - opts_.windowRounds) {
+      hist.pop_front();
+    }
+    const int64_t spanRounds = hist.back().first - hist.front().first;
+    if (spanRounds * opts_.intervalMs < 4 * leaseMs) {
+      continue;  // window too short to judge a renewal cadence
+    }
+    const double expected =
+        static_cast<double>(spanRounds) * opts_.intervalMs / leaseMs;
+    const double observed = static_cast<double>(hist.back().second) -
+                            static_cast<double>(hist.front().second);
+    if (observed * 2 < expected && !debounced(kKindLeaseJitter,
+                                              rr.first)) {
+      fireAnomaly(kKindLeaseJitter, rr.first,
+                  static_cast<uint64_t>(observed));
+    }
+  }
+}
+
+void FleetObs::mergeAndDetect(const std::string& ownHostDoc) {
+  // Parse the fresh host documents (own + relayed) once, then reuse the
+  // parse for coverage, the detectors, and the embedded output.
+  std::vector<std::pair<const std::string*, Value>> hostDocs;
+  Value own = JsonReader(ownHostDoc, "fleetobs host doc").parse();
+  hostDocs.emplace_back(&ownHostDoc, std::move(own));
+  for (auto& p : leaders_) {
+    if (p.latestRaw.empty() || p.dead ||
+        p.lastSeenRound < round_ - kStaleRounds) {
+      continue;
+    }
+    try {
+      Value v = JsonReader(p.latestRaw, "fleetobs host doc").parse();
+      hostDocs.emplace_back(&p.latestRaw, std::move(v));
+    } catch (const std::exception& e) {
+      TC_WARN("fleetobs: unparseable host doc from rank ", p.rank, ": ",
+              e.what());
+    }
+  }
+
+  std::map<int, const Value*> reports;  // rank -> report (fresh docs)
+  for (const auto& hd : hostDocs) {
+    const Value* ranks = hd.second.field("ranks");
+    if (ranks == nullptr) {
+      continue;
+    }
+    for (const auto& f : ranks->fields) {
+      reports[std::atoi(f.first.c_str())] = &f.second;
+    }
+  }
+  for (const auto& rr : reports) {
+    ingestStragglerOps(rr.first, *rr.second);
+  }
+  finalizePendingOps();
+  runDetectors(reports);
+
+  // Straggler leaderboard over the window (blamed time descending).
+  std::map<int, std::pair<uint64_t, uint64_t>> blame;
+  for (const WindowOp& w : window_) {
+    blame[w.straggler].first += w.excessUs;
+    blame[w.straggler].second += 1;
+  }
+  std::vector<std::pair<int, std::pair<uint64_t, uint64_t>>> board(
+      blame.begin(), blame.end());
+  std::sort(board.begin(), board.end(),
+            [](const auto& a, const auto& b) {
+              return a.second.first != b.second.first
+                         ? a.second.first > b.second.first
+                         : a.first < b.first;
+            });
+
+  std::vector<int> missing;
+  for (int r = 0; r < ctx_->size(); r++) {
+    if (reports.find(r) == reports.end()) {
+      missing.push_back(r);
+    }
+  }
+
+  std::ostringstream out;
+  out << "{\"version\":1,\"kind\":\"fleet\",\"rank\":0,\"size\":"
+      << ctx_->size() << ",\"group\":";
+  appendJsonString(out, ctx_->groupTag());
+  out << ",\"enabled\":true,\"now_us\":" << Tracer::nowUs()
+      << ",\"round\":" << round_ << ",\"interval_ms\":" << opts_.intervalMs
+      << ",\"hosts\":[";
+  for (size_t i = 0; i < hostDocs.size(); i++) {
+    out << (i == 0 ? "" : ",") << *hostDocs[i].first;
+  }
+  out << "],\"coverage\":{\"expected\":" << ctx_->size()
+      << ",\"reported\":" << reports.size() << ",\"missing\":[";
+  for (size_t i = 0; i < missing.size(); i++) {
+    out << (i == 0 ? "" : ",") << missing[i];
+  }
+  out << "]},\"straggler\":{\"window_rounds\":" << opts_.windowRounds
+      << ",\"ops_window\":" << window_.size() << ",\"leaderboard\":[";
+  for (size_t i = 0; i < board.size(); i++) {
+    out << (i == 0 ? "" : ",") << "{\"rank\":" << board[i].first
+        << ",\"blamed_us\":" << board[i].second.first
+        << ",\"blamed_ops\":" << board[i].second.second << "}";
+  }
+  out << "]},\"slow_links\":[";
+  for (size_t i = 0; i < slowLinks_.size(); i++) {
+    out << (i == 0 ? "" : ",") << "{\"rank\":" << slowLinks_[i].rank
+        << ",\"peer\":" << slowLinks_[i].peer << ",\"bw_bps\":"
+        << slowLinks_[i].bwBps << ",\"median_bps\":"
+        << slowLinks_[i].medianBps << "}";
+  }
+  out << "],\"anomalies\":{\"total\":" << ctx_->metrics().anomaliesTotal()
+      << ",\"recent\":[";
+  for (size_t i = 0; i < recent_.size(); i++) {
+    out << (i == 0 ? "" : ",") << "{\"kind\":";
+    appendJsonString(out, recent_[i].kind);
+    out << ",\"rank\":" << recent_[i].rank << ",\"t_us\":"
+        << recent_[i].tUs << ",\"detail\":" << recent_[i].detail << "}";
+  }
+  out << "]}}";
+
+  std::lock_guard<std::mutex> guard(fleetMu_);
+  fleetJson_ = out.str();
+}
+
+}  // namespace fleetobs
+}  // namespace tpucoll

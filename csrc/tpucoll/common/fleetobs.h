@@ -1,0 +1,204 @@
+// In-band fleet observability plane (docs/fleet.md).
+//
+// A background aggregation service that folds every rank's metrics /
+// profile / health snapshot up the PR 13 topology the same way the
+// hierarchical collectives move payload: members push a bounded,
+// fixed-size report to their host leader (over the shm payload plane
+// where co-hosted pairs negotiated it), leaders pre-aggregate one host
+// document and relay it to rank 0 over TCP. Rank 0 therefore receives
+// O(hosts) messages per interval, never O(ranks), and serves the merged
+// fleet view through Context::fleetJson() -> capi tc_fleet_json -> the
+// telemetry endpoint's /fleet route. Members never open a telemetry
+// connection to rank 0 — relaying is structural, not a convention.
+//
+// Wire discipline: reports ride SlotPrefix::kFleetObs (their own slot
+// namespace — no collision with user or collective traffic) as
+// fixed-size space-padded JSON, so receivers post one exact-size recv
+// per sender and re-arm it after every message; the transport stash
+// absorbs pace skew exactly as it absorbs blind collective sends. A
+// sender never rewrites its buffer while a send is in flight, and a
+// wedged receiver degrades to skipped rounds, not a hang.
+//
+// Rank 0 additionally runs the continuous anomaly detectors on the
+// aggregated stream (persistent straggler / slow link / lease jitter;
+// docs/fleet.md) — each firing publishes a flight-recorder event AND a
+// metrics anomaly counter so /flightrec post-mortems and the live
+// /fleet view agree on what went wrong.
+//
+// Cost when idle: the service is its own thread doing nothing between
+// ticks; the transport hot path pays the metrics registry's existing
+// one-relaxed-load gate and nothing else. TPUCOLL_FLEETOBS=0 turns
+// start() into a no-op.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tpucoll/common/json.h"
+
+namespace tpucoll {
+
+class Context;
+
+namespace transport {
+class UnboundBuffer;
+}
+
+namespace fleetobs {
+
+// Knobs, resolved once at start() from the strict env.h parsers
+// (docs/env.md).
+struct Options {
+  bool enabled = true;        // TPUCOLL_FLEETOBS
+  int64_t intervalMs = 1000;  // TPUCOLL_FLEETOBS_INTERVAL_MS
+  size_t maxBytes = 32768;    // TPUCOLL_FLEETOBS_MAX_BYTES (per report)
+  int opsTail = 64;           // TPUCOLL_FLEETOBS_OPS (profile ring tail)
+  int windowRounds = 30;      // TPUCOLL_FLEETOBS_WINDOW (anomaly window)
+  int64_t stragglerMs = 200;  // TPUCOLL_FLEETOBS_STRAGGLER_MS
+
+  static Options fromEnv();
+};
+
+class FleetObs {
+ public:
+  explicit FleetObs(Context* ctx);
+  ~FleetObs();
+  FleetObs(const FleetObs&) = delete;
+  FleetObs& operator=(const FleetObs&) = delete;
+
+  // Spawn the aggregation thread for this rank's topology role. No-op
+  // when TPUCOLL_FLEETOBS=0, when already running, or when the context
+  // has no topology (not connected). Must be called after connect.
+  void start();
+
+  // Stop and join the thread, then release the wire buffers. Safe to
+  // call repeatedly and when never started; Context::close() calls it
+  // before the transport quiesces so no posted recv outlives the mesh.
+  void stop();
+
+  bool running() const { return running_.load(std::memory_order_acquire); }
+
+  // Side-channel for state the native core cannot see (the elastic
+  // agent lives behind the C ABI in Python): a JSON object merged into
+  // this rank's report as its "aux" field. Validated here so a
+  // malformed document fails the setter, not the aggregation thread.
+  void setAux(std::string auxJson);
+
+  // Rank 0: the latest merged fleet document (empty-coverage skeleton
+  // until the first round lands). Other ranks: a role stub that points
+  // the reader at rank 0. Always valid JSON.
+  std::string fleetJson();
+
+ private:
+  struct PeerLink {
+    // One sender or receiver endpoint: a fixed-size wire buffer plus
+    // the in-flight/dead state the tick loop needs.
+    int rank = -1;
+    uint64_t slot = 0;  // kFleetObs slot this link sends/receives on
+    std::vector<char> bytes;
+    std::unique_ptr<transport::UnboundBuffer> ubuf;
+    bool sendPending = false;
+    bool dead = false;
+    bool posted = false;
+    int64_t lastSeenRound = -1;
+    std::string latestRaw;  // last received report/doc, trimmed
+  };
+
+  // Finalized cross-rank op join: who stalled collective `cseq` and by
+  // how much (profile.py attribute() semantics, computed in-band).
+  struct WindowOp {
+    int64_t round = 0;
+    int straggler = -1;
+    uint64_t excessUs = 0;
+  };
+
+  struct AnomalyEvent {
+    std::string kind;
+    int rank = -1;
+    int64_t tUs = 0;
+    uint64_t detail = 0;
+  };
+
+  // Currently-slow link (latest detector pass): rank's pair EWMA
+  // bandwidth vs the fleet median. Rebuilt every round for /fleet.
+  struct SlowLink {
+    int rank = -1;
+    int peer = -1;
+    uint64_t bwBps = 0;
+    uint64_t medianBps = 0;
+  };
+
+  void runLoop();
+  void tick();
+  // Builds this rank's report (<= opts_.maxBytes once space-padded),
+  // shrinking the profile tail / link list until it fits.
+  std::string buildReport();
+  std::string buildReportAttempt(int opsTail, int maxLinks);
+  // Leader: drain member recvs, fold the host document.
+  void drainPeer(PeerLink& p);
+  std::string buildHostDoc();
+  // Rank 0: merge host docs, run detectors, publish fleetJson_.
+  void mergeAndDetect(const std::string& ownHostDoc);
+  void ingestStragglerOps(int rank, const JsonReader::Value& report);
+  void finalizePendingOps();
+  void runDetectors(
+      const std::map<int, const JsonReader::Value*>& reports);
+  void fireAnomaly(const char* kind, int rank, uint64_t detail);
+  bool debounced(const std::string& kind, int rank);
+
+  size_t hostDocBytes(int hostIndex) const;
+
+  Context* const ctx_;
+  Options opts_;
+  std::thread thread_;
+  std::atomic<bool> running_{false};
+  bool stopRequested_ = false;
+  std::mutex stopMu_;
+  std::condition_variable stopCv_;
+
+  // Role wiring, resolved at start() from the topology.
+  bool isLeader_ = false;
+  int leaderRank_ = -1;
+  int hostIndex_ = -1;
+  std::vector<int> localMembers_;  // co-hosted non-leader ranks (leader)
+  std::vector<int> otherLeaders_;  // other hosts' leaders (rank 0)
+
+  PeerLink up_;                     // member/leader: link toward parent
+  std::vector<PeerLink> members_;   // leader: one per local member
+  std::vector<PeerLink> leaders_;   // rank 0: one per other host leader
+  int64_t round_ = 0;
+
+  std::mutex auxMu_;
+  std::string auxJson_;
+
+  std::mutex fleetMu_;
+  std::string fleetJson_;
+
+  // ---- rank-0 detector state (aggregation thread only) ----
+  // cseq -> rank -> (total_us, wait_us), joined across reports until
+  // every rank answered or the grace expired.
+  struct PendingOp {
+    int64_t firstRound = 0;
+    std::map<int, std::pair<uint64_t, uint64_t>> perRank;
+  };
+  std::map<int64_t, PendingOp> pendingOps_;
+  int64_t processedThroughCseq_ = -1;
+  std::deque<WindowOp> window_;
+  std::map<std::string, std::map<int, int64_t>> lastFiredRound_;
+  std::deque<AnomalyEvent> recent_;
+  std::vector<SlowLink> slowLinks_;
+  // rank -> (round, leases_renewed) history for the lease-jitter
+  // detector.
+  std::map<int, std::deque<std::pair<int64_t, uint64_t>>> leaseHistory_;
+};
+
+}  // namespace fleetobs
+}  // namespace tpucoll

@@ -231,6 +231,17 @@ uint64_t FlightRecorder::beginP2p(const char* opcode, uint64_t slot,
   return begin(opcode, nullptr, slot, peer, bytes, kNoDtype, -1, 0);
 }
 
+uint64_t FlightRecorder::noteEvent(const char* opcode, int peer,
+                                   uint64_t detail) {
+  // Like p2p: no collective seq, no fingerprint (events are one-sided
+  // by nature and must never read as a desync).
+  const uint64_t seq = begin(opcode, nullptr, 0, peer, detail, kNoDtype,
+                             -1, 0);
+  transition(seq, kStarted);
+  transition(seq, kCompleted);
+  return seq;
+}
+
 namespace {
 
 template <typename Sink>

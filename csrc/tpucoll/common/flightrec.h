@@ -92,6 +92,15 @@ class FlightRecorder {
   uint64_t beginP2p(const char* opcode, uint64_t slot, int peer,
                     uint64_t bytes);
 
+  // Instantaneous structured event (fleet anomaly detectors,
+  // common/fleetobs.cc): one ring entry enqueued/started/completed at
+  // the same instant, so /flightrec post-mortems carry the detector
+  // verdicts the live /fleet view showed. `opcode` must be a static
+  // string like every opcode here; `peer` is the blamed rank and
+  // `detail` rides the bytes field (detector-defined unit, e.g. blamed
+  // microseconds).
+  uint64_t noteEvent(const char* opcode, int peer, uint64_t detail);
+
   // Record a state transition for op `seq`: one relaxed store. A seq
   // already overwritten by a newer lap of the ring — or the kNoSeq
   // sentinel (no matched entry / row mid-rewrite) — is ignored.

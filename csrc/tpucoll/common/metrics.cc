@@ -118,6 +118,12 @@ void Metrics::recordFault(const std::string& action) {
   faultCounts_[action]++;
 }
 
+void Metrics::recordAnomaly(const std::string& kind, int rank) {
+  anomaliesTotal_.fetch_add(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> guard(anomalyMu_);
+  anomalyCounts_[kind][rank]++;
+}
+
 Metrics::Histogram* Metrics::phaseHistogram(const std::string& op,
                                             const std::string& algo,
                                             const std::string& phase) {
@@ -194,6 +200,32 @@ std::string Metrics::toJson(int rank, bool drain) {
     }
   }
   out << "}";
+
+  // Fleet anomaly detector firings: {"total": N, "kinds": {kind:
+  // {rank: count}}}. Same shape discipline as "faults" — an empty map
+  // emits {} so readers need no presence check.
+  out << ",\"anomalies\":{\"total\":"
+      << anomaliesTotal_.load(std::memory_order_relaxed) << ",\"kinds\":{";
+  {
+    std::lock_guard<std::mutex> guard(anomalyMu_);
+    bool firstKind = true;
+    for (const auto& kindEntry : anomalyCounts_) {
+      if (!firstKind) {
+        out << ",";
+      }
+      firstKind = false;
+      appendJsonString(out, kindEntry.first);
+      out << ":{";
+      bool firstRank = true;
+      for (const auto& rankEntry : kindEntry.second) {
+        out << (firstRank ? "" : ",") << "\"" << rankEntry.first
+            << "\":" << rankEntry.second;
+        firstRank = false;
+      }
+      out << "}";
+    }
+  }
+  out << "}}";
 
   out << ",\"transport_failure\":";
   {
@@ -292,9 +324,34 @@ std::string Metrics::toJson(int rank, bool drain) {
         << ",\"last_progress_age_us\":"
         << (progress == 0 ? -1 : nowUs - progress)
         << ",\"rx_pauses\":" << ps.rxPauses.load(std::memory_order_relaxed)
+        << ",\"tx_posts\":" << ps.txPosts.load(std::memory_order_relaxed)
+        << ",\"bw_ewma_bps\":" << ps.bwEwmaBps.load(std::memory_order_relaxed)
+        << ",\"rtt_ewma_us\":" << ps.rttEwmaUs.load(std::memory_order_relaxed)
         << ",\"recv_wait_us\":";
     histToJson(out, ps.recvWaitUs);
-    out << "}";
+    // Per-link channel split (fleet plane): only channels that saw
+    // traffic emit, mirroring the global "channels" section.
+    out << ",\"chan_tx\":{";
+    bool firstChan = true;
+    for (int c = 0; c < PeerStats::kMaxPairChannels; c++) {
+      const uint64_t tx = ps.chanTx[c].load(std::memory_order_relaxed);
+      if (tx == 0) {
+        continue;
+      }
+      out << (firstChan ? "" : ",") << "\"" << c << "\":" << tx;
+      firstChan = false;
+    }
+    out << "},\"chan_rx\":{";
+    firstChan = true;
+    for (int c = 0; c < PeerStats::kMaxPairChannels; c++) {
+      const uint64_t rx = ps.chanRx[c].load(std::memory_order_relaxed);
+      if (rx == 0) {
+        continue;
+      }
+      out << (firstChan ? "" : ",") << "\"" << c << "\":" << rx;
+      firstChan = false;
+    }
+    out << "}}";
   }
   out << "}";
 
@@ -373,7 +430,15 @@ void Metrics::resetAll() {
     p.recvBytes.store(0, std::memory_order_relaxed);
     p.rxPauses.store(0, std::memory_order_relaxed);
     p.recvWaitUs.reset();
-    // lastProgressUs survives: it is a timestamp, not a counter.
+    for (int c = 0; c < PeerStats::kMaxPairChannels; c++) {
+      p.chanTx[c].store(0, std::memory_order_relaxed);
+      p.chanRx[c].store(0, std::memory_order_relaxed);
+    }
+    p.txPosts.store(0, std::memory_order_relaxed);
+    p.bwWinBytes.store(0, std::memory_order_relaxed);
+    // lastProgressUs, bwWinStartUs and the EWMA estimates survive:
+    // timestamps and estimators, not counters — a drain must not blind
+    // the slow-link detector for the next window.
   }
   retries_.store(0, std::memory_order_relaxed);
   planHits_.store(0, std::memory_order_relaxed);

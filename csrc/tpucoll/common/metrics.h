@@ -93,6 +93,28 @@ class Metrics {
     // Latency from p2p wait start to completion against this peer
     // (recv side, where the source rank is known).
     Histogram recvWaitUs;
+    // ---- link-level wire telemetry (fleet observability plane) ----
+    // Per-data-channel wire bytes on THIS pair. channelTx_/channelRx_
+    // fold the same movement across all peers; the per-link split is
+    // what the fleet plane's slow-link detector needs (one cold stripe
+    // to one peer hides inside the per-channel totals). Channels past
+    // kMaxPairChannels fold into the last slot so the per-peer
+    // footprint stays fixed.
+    static constexpr int kMaxPairChannels = 8;
+    std::atomic<uint64_t> chanTx[kMaxPairChannels] = {};
+    std::atomic<uint64_t> chanRx[kMaxPairChannels] = {};
+    // Wire messages enqueued toward this peer (per-pair post count;
+    // sentMsgs counts completions, posts count intent — a growing gap
+    // is a backed-up link).
+    std::atomic<uint64_t> txPosts{0};
+    // EWMA link estimates. Bandwidth folds a ~10ms byte window (both
+    // directions) into bytes/sec; RTT is seeded by the connect
+    // handshake and refreshed by shm credit round-trips. Zero = no
+    // sample yet.
+    std::atomic<uint64_t> bwEwmaBps{0};
+    std::atomic<uint64_t> rttEwmaUs{0};
+    std::atomic<int64_t> bwWinStartUs{0};
+    std::atomic<uint64_t> bwWinBytes{0};
   };
 
   // Last stalled operation, as reported by the watchdog. `peer` is -1
@@ -201,6 +223,84 @@ class Metrics {
     }
     peers_[peer].recvWaitUs.record(us);
   }
+  // ---- link telemetry (Pair::touchProgress / Pair::enqueue) ----
+  // Per-(peer, channel) byte counters plus the windowed EWMA bandwidth
+  // estimate. Rides the existing touchProgress call: when enabled it is
+  // one relaxed add per direction plus a window check; when disabled it
+  // is the same single relaxed load every other hot-path hook pays.
+  static constexpr int64_t kBwWindowUs = 10 * 1000;
+  void recordLink(int peer, int channel, bool tx, uint64_t bytes,
+                  int64_t nowUs) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    PeerStats& p = peers_[peer];
+    const int c = channel <= 0
+                      ? 0
+                      : (channel < PeerStats::kMaxPairChannels
+                             ? channel
+                             : PeerStats::kMaxPairChannels - 1);
+    (tx ? p.chanTx : p.chanRx)[c].fetch_add(bytes, std::memory_order_relaxed);
+    // Windowed EWMA fold. The CAS elects exactly one folder per window;
+    // losers just contributed bytes. A stale winBytes read racing the
+    // exchange skews one 10ms sample by one message — noise the EWMA
+    // exists to absorb.
+    p.bwWinBytes.fetch_add(bytes, std::memory_order_relaxed);
+    int64_t start = p.bwWinStartUs.load(std::memory_order_relaxed);
+    if (start == 0) {
+      p.bwWinStartUs.compare_exchange_strong(start, nowUs,
+                                             std::memory_order_relaxed);
+      return;
+    }
+    const int64_t elapsed = nowUs - start;
+    if (elapsed < kBwWindowUs) {
+      return;
+    }
+    if (!p.bwWinStartUs.compare_exchange_strong(start, nowUs,
+                                                std::memory_order_relaxed)) {
+      return;
+    }
+    const uint64_t winBytes = p.bwWinBytes.exchange(0,
+                                                    std::memory_order_relaxed);
+    const uint64_t bps =
+        winBytes * 1000000ULL / static_cast<uint64_t>(elapsed);
+    const uint64_t prev = p.bwEwmaBps.load(std::memory_order_relaxed);
+    p.bwEwmaBps.store(prev == 0 ? bps : (prev * 7 + bps) / 8,
+                      std::memory_order_relaxed);
+  }
+  void recordLinkPost(int peer) {
+    if (!enabled() || peer < 0 || peer >= size_) {
+      return;
+    }
+    peers_[peer].txPosts.fetch_add(1, std::memory_order_relaxed);
+  }
+  void recordLinkRtt(int peer, int64_t us) {
+    if (!enabled() || peer < 0 || peer >= size_ || us < 0) {
+      return;
+    }
+    PeerStats& p = peers_[peer];
+    const uint64_t prev = p.rttEwmaUs.load(std::memory_order_relaxed);
+    const uint64_t sample = static_cast<uint64_t>(us);
+    p.rttEwmaUs.store(prev == 0 ? sample : (prev * 7 + sample) / 8,
+                      std::memory_order_relaxed);
+  }
+  uint64_t linkBwBps(int peer) const {
+    return peer >= 0 && peer < size_
+               ? peers_[peer].bwEwmaBps.load(std::memory_order_relaxed)
+               : 0;
+  }
+
+  // ---- fleet anomaly detectors (common/fleetobs.cc) ----
+  // Per-(kind, blamed-rank) counters behind a mutex, modeled on the
+  // fault-plane map: detector firings are rare by construction, and the
+  // map keeps the registry decoupled from the detector set. Not gated
+  // on enabled_: an anomaly that fired must survive a counters-off
+  // configuration, exactly like faults and stalls.
+  void recordAnomaly(const std::string& kind, int rank);
+  uint64_t anomaliesTotal() const {
+    return anomaliesTotal_.load(std::memory_order_relaxed);
+  }
+
   // ---- multi-channel transport (pair data channels + loop pool) ----
   // Wire bytes per data channel (channel 0 = the primary connection;
   // channels 1.. carry stripes of large messages when TPUCOLL_CHANNELS
@@ -389,6 +489,11 @@ class Metrics {
   mutable std::mutex faultMu_;
   std::map<std::string, uint64_t> faultCounts_;
   std::atomic<uint64_t> faultsTotal_{0};
+
+  // kind -> blamed rank -> firings (fleet anomaly detectors).
+  mutable std::mutex anomalyMu_;
+  std::map<std::string, std::map<int, uint64_t>> anomalyCounts_;
+  std::atomic<uint64_t> anomaliesTotal_{0};
 
   // op -> algorithm -> phase -> histogram (phase profiler). Entries are
   // never erased (see phaseHistogram); unique_ptr keeps the Histogram

@@ -7,6 +7,7 @@
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/env.h"
+#include "tpucoll/common/fleetobs.h"
 #include "tpucoll/fault/fault.h"
 #include "tpucoll/tuning/tuning_table.h"
 #include "tpucoll/types.h"
@@ -35,6 +36,14 @@ Context::Context(int rank, int size)
 }
 
 Context::~Context() {
+  // The fleet observability plane goes first of everything: its
+  // aggregation thread posts sends/recvs through the transport mesh,
+  // and its wire buffers unregister against the live transport on
+  // destruction.
+  {
+    std::lock_guard<std::mutex> guard(fleetObsMu_);
+    fleetObs_.reset();
+  }
   // Hier sub-communicators are whole Contexts of their own; drop them
   // first so their collectives cannot outlive the parent state hier.cc
   // reaches through (topology, tracer).
@@ -297,8 +306,59 @@ std::unique_ptr<transport::UnboundBuffer> Context::createUnboundBuffer(
   return tctx_->createUnboundBuffer(ptr, size);
 }
 
+void Context::fleetObsStart() {
+  TC_ENFORCE(tctx_ != nullptr, "fleetObsStart: context not connected");
+  std::lock_guard<std::mutex> guard(fleetObsMu_);
+  if (fleetObs_ == nullptr) {
+    fleetObs_ = std::make_unique<fleetobs::FleetObs>(this);
+  }
+  fleetObs_->start();
+}
+
+void Context::fleetObsStop() {
+  std::lock_guard<std::mutex> guard(fleetObsMu_);
+  if (fleetObs_ != nullptr) {
+    fleetObs_->stop();
+  }
+}
+
+bool Context::fleetObsRunning() const {
+  std::lock_guard<std::mutex> guard(fleetObsMu_);
+  return fleetObs_ != nullptr && fleetObs_->running();
+}
+
+void Context::fleetObsSetAux(const std::string& auxJson) {
+  std::lock_guard<std::mutex> guard(fleetObsMu_);
+  TC_ENFORCE(fleetObs_ != nullptr,
+             "fleetObsSetAux: fleet observability plane never started");
+  fleetObs_->setAux(auxJson);
+}
+
+std::string Context::fleetJson() {
+  std::lock_guard<std::mutex> guard(fleetObsMu_);
+  if (fleetObs_ != nullptr) {
+    return fleetObs_->fleetJson();
+  }
+  std::ostringstream out;
+  out << "{\"version\":1,\"kind\":\"fleet\",\"rank\":" << rank_
+      << ",\"size\":" << size_
+      << ",\"enabled\":false,\"role\":\"off\",\"hosts\":[],"
+      << "\"coverage\":{\"expected\":" << size_
+      << ",\"reported\":0,\"missing\":[";
+  // Honest stub: nothing reported, so every rank is missing.
+  for (int r = 0; r < size_; r++) {
+    out << (r == 0 ? "" : ",") << r;
+  }
+  out << "]},\"note\":\"fleet observability plane not started\"}";
+  return out.str();
+}
+
 void Context::close() {
-  // Plans first: their registrations point into the transport about to
+  // Fleet observability plane first: its thread is mid-tick through the
+  // transport about to be quiesced, and stopping it here (not at
+  // destruction) means a posted relay recv never sees the mesh die.
+  fleetObsStop();
+  // Plans next: their registrations point into the transport about to
   // be quiesced, and a cached buffer's drain pass needs it alive.
   if (planCache_ != nullptr) {
     planCache_->clear();

@@ -32,6 +32,10 @@ namespace plan {
 class PlanCache;
 }  // namespace plan
 
+namespace fleetobs {
+class FleetObs;
+}  // namespace fleetobs
+
 class Context;
 
 namespace elastic {
@@ -221,6 +225,23 @@ class Context {
   // Structured JSON snapshot of the registry; `drain` resets counters.
   std::string metricsJson(bool drain);
 
+  // ---- in-band fleet observability plane (common/fleetobs.h) ----
+  // Start the hierarchical telemetry fold for this rank's topology role
+  // (member -> host leader -> rank 0). Requires a connected context;
+  // no-op under TPUCOLL_FLEETOBS=0 or when already running.
+  void fleetObsStart();
+  // Stop and join the aggregation thread; close()/destruction call this
+  // before the transport quiesces. Safe when never started.
+  void fleetObsStop();
+  bool fleetObsRunning() const;
+  // JSON object merged into this rank's report as "aux" (e.g. the
+  // elastic agent's lease status fed from Python). Throws EnforceError
+  // when the plane was never started or the document is malformed.
+  void fleetObsSetAux(const std::string& auxJson);
+  // Rank 0: latest merged fleet document (telemetry /fleet route).
+  // Other ranks / plane off: a valid-JSON stub saying so.
+  std::string fleetJson();
+
   // JSON snapshot of the profiler's per-op phase-breakdown ring
   // (non-draining, like the flight recorder).
   std::string profileJson() { return profiler_.toJson(); }
@@ -318,6 +339,10 @@ class Context {
   std::shared_ptr<transport::Device> device_;
   std::unique_ptr<transport::Context> tctx_;
   std::unique_ptr<plan::PlanCache> planCache_;
+  // Guarded by fleetObsMu_; stopped/reset explicitly before tctx_ dies
+  // (its wire buffers unregister against the live transport).
+  mutable std::mutex fleetObsMu_;
+  std::unique_ptr<fleetobs::FleetObs> fleetObs_;
 
   std::mutex scratchMu_;
   std::vector<std::vector<char>> scratchPool_;

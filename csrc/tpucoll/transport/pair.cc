@@ -154,7 +154,15 @@ void Pair::connect(const SockAddr& remote, uint64_t remotePairId,
     d.remote = remote.str();
     d.attempt = attempt;
     try {
+      const int64_t handshakeStartUs = Tracer::nowUs();
       connectAttempt(remote, remotePairId, deadline, &d.local);
+      if (Metrics* m = context_->metrics()) {
+        // Seed the link RTT estimate with the successful handshake
+        // duration — an upper bound (a few protocol round trips) that
+        // the shm credit plane refines where active. Failed attempts
+        // never sample: they time the peer's boot, not the wire.
+        m->recordLinkRtt(peerRank_, Tracer::nowUs() - handshakeStartUs);
+      }
       d.ok = true;
       logConnectAttempt(d);
       return;
@@ -751,6 +759,11 @@ void Pair::touchProgress(bool tx, size_t bytes) {
     } else {
       m->recordChannelRx(channel_, bytes);
     }
+    // Link-level split of the same movement: per-(peer, channel) bytes
+    // plus the windowed EWMA bandwidth fold (fleet observability
+    // plane). Same gate as the counters above — one relaxed load when
+    // metrics are off.
+    m->recordLink(peerRank_, channel_, tx, bytes, now);
   }
   if (FlightRecorder* fr = context_->flightrec()) {
     // Every payload/header byte moving through a pair funnels here —
@@ -796,6 +809,9 @@ void Pair::enqueue(TxOp op) {
   }
   if (Metrics* m = context_->metrics()) {
     m->recordSent(peerRank_, nbytes);
+    // Post count for the link plane: enqueue intent, distinct from the
+    // sentMsgs completion count (a growing gap is a backed-up link).
+    m->recordLinkPost(peerRank_);
   }
   for (auto& d : completed) {
     deliverSendComplete(d);
@@ -989,6 +1005,9 @@ Pair::ShmTxStatus Pair::flushShmFront(TxOp* op,
       if (!op->creditReqSent) {
         queueCtrl(Opcode::kShmCreditReq);
         op->creditReqSent = true;
+        // Stamp the request so the matching kShmCredit grant yields a
+        // link RTT sample (fleet observability plane).
+        op->creditReqUs = Tracer::nowUs();
       }
       txRingBlocked_ = true;
       return ShmTxStatus::kRingBlocked;
@@ -1395,7 +1414,18 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
       if (isGrant) {
         txRingBlocked_ = false;
         if (!tx_.empty() && tx_.front().viaShm) {
-          tx_.front().creditReqSent = false;
+          TxOp& front = tx_.front();
+          if (front.creditReqSent && front.creditReqUs != 0) {
+            // Request -> grant round trip: the cheapest in-band RTT
+            // probe this transport has (control header both ways, no
+            // payload). Relaxed-atomic EWMA update, safe under mu_.
+            if (Metrics* m = context_->metrics()) {
+              m->recordLinkRtt(peerRank_,
+                               Tracer::nowUs() - front.creditReqUs);
+            }
+            front.creditReqUs = 0;
+          }
+          front.creditReqSent = false;
         }
       } else {
         queueCtrl(Opcode::kShmCredit);

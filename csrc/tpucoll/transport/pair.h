@@ -202,6 +202,7 @@ class Pair : public Handler {
     uint64_t shmWritten{0};         // payload bytes copied into the ring
     uint64_t shmAnnounced{0};       // payload bytes covered by chunk headers
     bool creditReqSent{false};      // a kShmCreditReq is out for this stall
+    int64_t creditReqUs{0};         // when it went out (link RTT probe)
     WireHeader chunkHeader{};       // current chunk header (plain path)
     size_t chunkHeaderSent{0};
     bool chunkInFlight{false};

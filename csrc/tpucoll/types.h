@@ -71,6 +71,10 @@ enum class SlotPrefix : uint8_t {
   kAllgather = 7,
   kAlltoall = 8,
   kReduceScatter = 9,
+  // Fleet observability plane (common/fleetobs.cc): member -> leader
+  // and leader -> rank 0 telemetry relays ride their own prefix so
+  // in-band snapshots can never collide with user or collective slots.
+  kFleetObs = 10,
 };
 
 class Slot {

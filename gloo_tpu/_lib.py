@@ -150,6 +150,13 @@ _PROTOTYPES = {
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     "tc_profile_enable": (None, [_c, _int]),
     "tc_profile_enabled": (_int, [_c]),
+    # in-band fleet observability plane (hierarchical telemetry fold)
+    "tc_fleetobs_start": (_int, [_c]),
+    "tc_fleetobs_stop": (_int, [_c]),
+    "tc_fleetobs_running": (_int, [_c]),
+    "tc_fleetobs_set_aux": (_int, [_c, ctypes.c_char_p]),
+    "tc_fleet_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     # elastic membership plane (lease liveness + epoch transitions)
     "tc_elastic_new": (_c, [_c, _c, _int, _int, _int, _int,
                             ctypes.c_char_p, _i64]),

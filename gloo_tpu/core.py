@@ -1146,6 +1146,47 @@ class Context:
     def profile_enabled(self) -> bool:
         return bool(_lib.lib.tc_profile_enabled(self._handle))
 
+    # ---- in-band fleet observability plane (docs/fleet.md) ----
+
+    def fleetobs_start(self) -> None:
+        """Start the hierarchical telemetry fold for this rank's
+        topology role: members push fixed-size reports to their host
+        leader over the transport mesh, leaders pre-aggregate one host
+        document and relay it to rank 0, which merges the fleet view
+        and runs the continuous anomaly detectors
+        (persistent_straggler / slow_link / lease_jitter). Requires a
+        connected context; a no-op under TPUCOLL_FLEETOBS=0 or when
+        already running. Rank 0's merged view is fleet(), also served
+        as /fleet by serve_telemetry()."""
+        check(_lib.lib.tc_fleetobs_start(self._handle))
+
+    def fleetobs_stop(self) -> None:
+        """Stop and join the aggregation thread (automatic at
+        close()). Safe when never started."""
+        check(_lib.lib.tc_fleetobs_stop(self._handle))
+
+    def fleetobs_running(self) -> bool:
+        return bool(_lib.lib.tc_fleetobs_running(self._handle))
+
+    def fleetobs_set_aux(self, aux: dict) -> None:
+        """Attach a JSON-serializable dict to this rank's next fleet
+        report as its "aux" field — the side-channel for state the
+        native core cannot see (e.g. ElasticAgent.status() under an
+        "elastic" key, which feeds the lease_jitter detector).
+        Raises if the plane was never started."""
+        check(_lib.lib.tc_fleetobs_set_aux(
+            self._handle, json.dumps(aux).encode()))
+
+    def fleet(self) -> dict:
+        """The merged fleet document as a dict. On rank 0 (with the
+        plane running): coverage, per-host summaries with embedded
+        per-rank reports, the in-band straggler leaderboard, slow
+        links, and recent anomalies (see docs/fleet.md for the
+        schema). On other ranks or with the plane off: a stub whose
+        "role"/"note" say where the real view lives."""
+        return json.loads(_copy_out(_lib.lib.tc_fleet_json,
+                                    self._handle))
+
     # ---- metrics + straggler watchdog (capability the reference lacks) --
 
     def metrics(self, drain: bool = False) -> dict:
@@ -1155,6 +1196,8 @@ class Context:
         "retries", "stash_pauses", "trace_events_dropped",
         "plan_hits", "plan_misses", "plan_evictions", "ubuf_creates",
         "faults": {"total", <action>: n...},
+        "anomalies": {"total", "kinds": {kind: {rank: n}}} (fleet
+        observability detectors, docs/fleet.md),
         "transport_failure": null | {"peer", "count", "message"},
         "ops": {name: {"calls", "bytes", "errors",
         "latency_us": hist}},
@@ -1162,7 +1205,9 @@ class Context:
         profiler's aggregates, docs/profiling.md),
         "transport": {peer: {"sent_msgs",
         "sent_bytes", "recv_msgs", "recv_bytes", "last_progress_us",
-        "last_progress_age_us", "rx_pauses", "recv_wait_us": hist}},
+        "last_progress_age_us", "rx_pauses", "tx_posts",
+        "bw_ewma_bps", "rtt_ewma_us", "recv_wait_us": hist,
+        "chan_tx": {channel: bytes}, "chan_rx": {channel: bytes}}},
         "watchdog":
         {"stalls", "last"}} where hist is {"count", "sum_us", "max_us",
         "buckets": [[le_us, n], ...]} with per-bucket (non-cumulative)

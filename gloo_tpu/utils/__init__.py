@@ -1,3 +1,4 @@
+from gloo_tpu.utils import fleet
 from gloo_tpu.utils import flightrec
 from gloo_tpu.utils import profile
 from gloo_tpu.utils.flightrec import DesyncError
@@ -11,6 +12,7 @@ __all__ = [
     "TelemetryServer",
     "annotate",
     "device_trace",
+    "fleet",
     "flightrec",
     "histogram_quantile",
     "merge_snapshots",

@@ -78,6 +78,17 @@ def _fmt_labels(labels: Dict[str, object]) -> str:
     return "{" + inner + "}"
 
 
+def _family(lines: List[str], name: str, kind: str, help_text: str) -> None:
+    """Open one metric family: exactly one ``# HELP`` and one ``# TYPE``
+    line, in that order, before the family's first sample — the
+    exposition-format contract tests/test_prometheus_lint.py enforces.
+    HELP text escapes backslash and line-feed (the only escapes the
+    format defines for help lines)."""
+    escaped = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+    lines.append(f"# HELP {name} {escaped}")
+    lines.append(f"# TYPE {name} {kind}")
+
+
 def _emit_histogram(lines: List[str], name: str, hist: dict,
                     labels: Dict[str, object]) -> None:
     cum = 0
@@ -108,10 +119,14 @@ def to_prometheus(snapshot: dict,
         base["group"] = snapshot["group"]
     lines: List[str] = []
 
-    lines.append("# TYPE gloo_tpu_collective_calls_total counter")
-    lines.append("# TYPE gloo_tpu_collective_bytes_total counter")
-    lines.append("# TYPE gloo_tpu_collective_errors_total counter")
-    lines.append("# TYPE gloo_tpu_collective_latency_us histogram")
+    _family(lines, "gloo_tpu_collective_calls_total", "counter",
+            "Collective/p2p calls issued, by op.")
+    _family(lines, "gloo_tpu_collective_bytes_total", "counter",
+            "Payload bytes moved by collectives, by op.")
+    _family(lines, "gloo_tpu_collective_errors_total", "counter",
+            "Collective calls that raised, by op.")
+    _family(lines, "gloo_tpu_collective_latency_us", "histogram",
+            "End-to-end collective latency (microseconds), by op.")
     for op, s in sorted(snapshot.get("ops", {}).items()):
         labels = {**base, "op": op}
         lines.append(f"gloo_tpu_collective_calls_total"
@@ -127,7 +142,9 @@ def to_prometheus(snapshot: dict,
     # (collective, algorithm, phase) — the scrape-side decomposition of
     # gloo_tpu_collective_latency_us into pack/post/wire_wait/reduce/
     # unpack (+ hier intra/inter/fanout).
-    lines.append("# TYPE gloo_tpu_phase_latency_us histogram")
+    _family(lines, "gloo_tpu_phase_latency_us", "histogram",
+            "Per-phase collective latency (microseconds), by "
+            "op/algorithm/phase (docs/profiling.md).")
     for op, algos in sorted(snapshot.get("phases", {}).items()):
         for algo, phases in sorted(algos.items()):
             for phase, hist in sorted(phases.items()):
@@ -136,12 +153,18 @@ def to_prometheus(snapshot: dict,
                 _emit_histogram(lines, "gloo_tpu_phase_latency_us",
                                 hist, labels)
 
-    lines.append("# TYPE gloo_tpu_transport_sent_msgs_total counter")
-    lines.append("# TYPE gloo_tpu_transport_sent_bytes_total counter")
-    lines.append("# TYPE gloo_tpu_transport_recv_msgs_total counter")
-    lines.append("# TYPE gloo_tpu_transport_recv_bytes_total counter")
-    lines.append("# TYPE gloo_tpu_transport_last_progress_age_us gauge")
-    lines.append("# TYPE gloo_tpu_transport_recv_wait_us histogram")
+    _family(lines, "gloo_tpu_transport_sent_msgs_total", "counter",
+            "Messages sent to a peer.")
+    _family(lines, "gloo_tpu_transport_sent_bytes_total", "counter",
+            "Bytes sent to a peer.")
+    _family(lines, "gloo_tpu_transport_recv_msgs_total", "counter",
+            "Messages received from a peer.")
+    _family(lines, "gloo_tpu_transport_recv_bytes_total", "counter",
+            "Bytes received from a peer.")
+    _family(lines, "gloo_tpu_transport_last_progress_age_us", "gauge",
+            "Microseconds since the pair last moved a byte.")
+    _family(lines, "gloo_tpu_transport_recv_wait_us", "histogram",
+            "Time waitRecv blocked on a peer (microseconds).")
     for peer, s in sorted(snapshot.get("transport", {}).items()):
         labels = {**base, "peer": peer}
         for field, metric in (("sent_msgs", "sent_msgs_total"),
@@ -155,11 +178,43 @@ def to_prometheus(snapshot: dict,
         _emit_histogram(lines, "gloo_tpu_transport_recv_wait_us",
                         s.get("recv_wait_us", {}), labels)
 
+    # Link-level wire telemetry (fleet observability plane,
+    # docs/fleet.md): per-(peer, channel, direction) bytes, post counts,
+    # and the windowed EWMA bandwidth / credit-RTT estimates the
+    # slow-link detector consumes.
+    _family(lines, "gloo_tpu_pair_bytes_total", "counter",
+            "Wire bytes per (peer, channel, direction).")
+    _family(lines, "gloo_tpu_pair_posts_total", "counter",
+            "Send operations posted toward a peer (enqueue intent; a "
+            "growing gap vs sent_msgs is a backed-up link).")
+    _family(lines, "gloo_tpu_pair_bw_ewma", "gauge",
+            "EWMA link bandwidth toward a peer, bytes/second.")
+    _family(lines, "gloo_tpu_pair_rtt_ewma_us", "gauge",
+            "EWMA link round-trip estimate toward a peer "
+            "(shm credit grants / connect handshake), microseconds.")
+    for peer, s in sorted(snapshot.get("transport", {}).items()):
+        labels = {**base, "peer": peer}
+        for direction, field in (("tx", "chan_tx"), ("rx", "chan_rx")):
+            for channel, nbytes in sorted(
+                    (s.get(field) or {}).items()):
+                lines.append(
+                    f"gloo_tpu_pair_bytes_total"
+                    f"{_fmt_labels({**labels, 'channel': channel, 'direction': direction})}"
+                    f" {nbytes}")
+        lines.append(f"gloo_tpu_pair_posts_total{_fmt_labels(labels)} "
+                     f"{s.get('tx_posts', 0)}")
+        lines.append(f"gloo_tpu_pair_bw_ewma{_fmt_labels(labels)} "
+                     f"{s.get('bw_ewma_bps', 0)}")
+        lines.append(f"gloo_tpu_pair_rtt_ewma_us{_fmt_labels(labels)} "
+                     f"{s.get('rtt_ewma_us', 0)}")
+
     # Multi-channel transport: wire bytes per data channel (channel "0"
     # is the primary connection; >= "1" carry stripes of large messages
     # when TPUCOLL_CHANNELS > 1) and per-loop-thread progress.
-    lines.append("# TYPE gloo_tpu_channel_tx_bytes_total counter")
-    lines.append("# TYPE gloo_tpu_channel_rx_bytes_total counter")
+    _family(lines, "gloo_tpu_channel_tx_bytes_total", "counter",
+            "Wire bytes transmitted per data channel (all peers).")
+    _family(lines, "gloo_tpu_channel_rx_bytes_total", "counter",
+            "Wire bytes received per data channel (all peers).")
     for channel, s in sorted(snapshot.get("channels", {}).items()):
         labels = {**base, "channel": channel}
         lines.append(f"gloo_tpu_channel_tx_bytes_total"
@@ -167,8 +222,10 @@ def to_prometheus(snapshot: dict,
         lines.append(f"gloo_tpu_channel_rx_bytes_total"
                      f"{_fmt_labels(labels)} {s.get('rx_bytes', 0)}")
 
-    lines.append("# TYPE gloo_tpu_loop_events_total counter")
-    lines.append("# TYPE gloo_tpu_loop_last_progress_age_us gauge")
+    _family(lines, "gloo_tpu_loop_events_total", "counter",
+            "Events handled per transport loop thread.")
+    _family(lines, "gloo_tpu_loop_last_progress_age_us", "gauge",
+            "Microseconds since a loop thread last made progress.")
     for loop, s in sorted(snapshot.get("loops", {}).items()):
         labels = {**base, "loop": loop}
         lines.append(f"gloo_tpu_loop_events_total"
@@ -177,50 +234,76 @@ def to_prometheus(snapshot: dict,
                      f"{_fmt_labels(labels)} "
                      f"{s.get('last_progress_age_us', -1)}")
 
-    lines.append("# TYPE gloo_tpu_connect_retries_total counter")
+    _family(lines, "gloo_tpu_connect_retries_total", "counter",
+            "Bootstrap connect attempts that were retried.")
     lines.append(f"gloo_tpu_connect_retries_total{_fmt_labels(base)} "
                  f"{snapshot.get('retries', 0)}")
-    lines.append("# TYPE gloo_tpu_stash_pauses_total counter")
+    _family(lines, "gloo_tpu_stash_pauses_total", "counter",
+            "Times the early-arrival stash paused a sender.")
     lines.append(f"gloo_tpu_stash_pauses_total{_fmt_labels(base)} "
                  f"{snapshot.get('stash_pauses', 0)}")
-    lines.append("# TYPE gloo_tpu_trace_events_dropped_total counter")
+    _family(lines, "gloo_tpu_trace_events_dropped_total", "counter",
+            "Tracer events dropped at the ring bound.")
     lines.append(f"gloo_tpu_trace_events_dropped_total{_fmt_labels(base)} "
                  f"{snapshot.get('trace_events_dropped', 0)}")
     # Persistent collective plans (docs/design.md): cache traffic plus
     # the registration counter the plans flatten — a healthy training
     # loop shows hits climbing with ubuf_creates flat.
-    lines.append("# TYPE gloo_tpu_plan_hits_total counter")
+    _family(lines, "gloo_tpu_plan_hits_total", "counter",
+            "Persistent-plan cache hits.")
     lines.append(f"gloo_tpu_plan_hits_total{_fmt_labels(base)} "
                  f"{snapshot.get('plan_hits', 0)}")
-    lines.append("# TYPE gloo_tpu_plan_misses_total counter")
+    _family(lines, "gloo_tpu_plan_misses_total", "counter",
+            "Persistent-plan cache misses.")
     lines.append(f"gloo_tpu_plan_misses_total{_fmt_labels(base)} "
                  f"{snapshot.get('plan_misses', 0)}")
-    lines.append("# TYPE gloo_tpu_plan_evictions_total counter")
+    _family(lines, "gloo_tpu_plan_evictions_total", "counter",
+            "Persistent plans evicted from the LRU.")
     lines.append(f"gloo_tpu_plan_evictions_total{_fmt_labels(base)} "
                  f"{snapshot.get('plan_evictions', 0)}")
-    lines.append("# TYPE gloo_tpu_ubuf_creates_total counter")
+    _family(lines, "gloo_tpu_ubuf_creates_total", "counter",
+            "UnboundBuffer registrations (flat under plan reuse).")
     lines.append(f"gloo_tpu_ubuf_creates_total{_fmt_labels(base)} "
                  f"{snapshot.get('ubuf_creates', 0)}")
     # Per-action series only; the total is their sum (scrapers derive
     # it), so one metric name never carries two label schemas.
     faults = snapshot.get("faults", {})
-    lines.append("# TYPE gloo_tpu_faults_injected_total counter")
+    _family(lines, "gloo_tpu_faults_injected_total", "counter",
+            "Deterministic fault injections fired, by action.")
     for action, n in sorted(faults.items()):
         if action == "total":
             continue
         lines.append(f"gloo_tpu_faults_injected_total"
                      f"{_fmt_labels({**base, 'action': action})} {n}")
+
+    # Fleet anomaly detectors (docs/fleet.md): same counters the /fleet
+    # document reports, so scrape-side alerting and the in-band view
+    # can never disagree. The "rank" label is the BLAMED rank (these
+    # fire on rank 0, where the aggregation runs).
+    anomalies = snapshot.get("anomalies", {})
+    _family(lines, "gloo_tpu_anomaly_total", "counter",
+            "Fleet anomaly detections, by kind and blamed rank.")
+    for kind, by_rank in sorted((anomalies.get("kinds") or {}).items()):
+        for blamed, n in sorted(by_rank.items(),
+                                key=lambda kv: int(kv[0])):
+            labels = {**base, "kind": kind, "rank": blamed}
+            lines.append(f"gloo_tpu_anomaly_total"
+                         f"{_fmt_labels(labels)} {n}")
     # Async engine gauges (Context.metrics() attaches them when the
     # context has live engines; the per-op detail lives in the lane
     # contexts' own snapshots, AsyncEngine.lane_metrics).
     async_ = snapshot.get("async")
     if async_:
-        lines.append("# TYPE gloo_tpu_async_in_flight gauge")
+        _family(lines, "gloo_tpu_async_in_flight", "gauge",
+                "Async-engine collectives currently in flight.")
         lines.append(f"gloo_tpu_async_in_flight{_fmt_labels(base)} "
                      f"{async_.get('in_flight', 0)}")
-        lines.append("# TYPE gloo_tpu_async_lane_submitted_total counter")
-        lines.append("# TYPE gloo_tpu_async_lane_completed_total counter")
-        lines.append("# TYPE gloo_tpu_async_lane_errors_total counter")
+        _family(lines, "gloo_tpu_async_lane_submitted_total", "counter",
+                "Async ops submitted per engine lane.")
+        _family(lines, "gloo_tpu_async_lane_completed_total", "counter",
+                "Async ops completed per engine lane.")
+        _family(lines, "gloo_tpu_async_lane_errors_total", "counter",
+                "Async ops errored per engine lane.")
         for ei, eng in enumerate(async_.get("engines", [])):
             for lane, st in enumerate(eng.get("per_lane", [])):
                 labels = {**base, "engine": ei, "lane": lane}
@@ -233,30 +316,37 @@ def to_prometheus(snapshot: dict,
     # the liveness/transition counters operators alert on.
     elastic = snapshot.get("elastic")
     if elastic:
-        lines.append("# TYPE gloo_tpu_elastic_epoch gauge")
+        _family(lines, "gloo_tpu_elastic_epoch", "gauge",
+                "Membership epoch this worker is bound to.")
         lines.append(f"gloo_tpu_elastic_epoch{_fmt_labels(base)} "
                      f"{elastic.get('epoch', 0)}")
-        lines.append("# TYPE gloo_tpu_elastic_members gauge")
+        _family(lines, "gloo_tpu_elastic_members", "gauge",
+                "Members of the current epoch.")
         lines.append(f"gloo_tpu_elastic_members{_fmt_labels(base)} "
                      f"{elastic.get('size', 0)}")
-        lines.append("# TYPE gloo_tpu_elastic_leases_renewed_total counter")
+        _family(lines, "gloo_tpu_elastic_leases_renewed_total", "counter",
+                "Liveness lease renewals.")
         lines.append(f"gloo_tpu_elastic_leases_renewed_total"
                      f"{_fmt_labels(base)} "
                      f"{elastic.get('leases_renewed', 0)}")
-        lines.append("# TYPE gloo_tpu_elastic_rebuilds_total counter")
+        _family(lines, "gloo_tpu_elastic_rebuilds_total", "counter",
+                "Epoch transitions this worker completed.")
         lines.append(f"gloo_tpu_elastic_rebuilds_total{_fmt_labels(base)} "
                      f"{elastic.get('rebuilds', 0)}")
-        lines.append("# TYPE gloo_tpu_elastic_bumps_published_total counter")
+        _family(lines, "gloo_tpu_elastic_bumps_published_total", "counter",
+                "Head-epoch bumps this worker published.")
         lines.append(f"gloo_tpu_elastic_bumps_published_total"
                      f"{_fmt_labels(base)} "
                      f"{elastic.get('bumps_published', 0)}")
     wd = snapshot.get("watchdog", {})
-    lines.append("# TYPE gloo_tpu_watchdog_stalls_total counter")
+    _family(lines, "gloo_tpu_watchdog_stalls_total", "counter",
+            "Straggler-watchdog stalls recorded.")
     lines.append(f"gloo_tpu_watchdog_stalls_total{_fmt_labels(base)} "
                  f"{wd.get('stalls', 0)}")
     last = wd.get("last")
     if last:
-        lines.append("# TYPE gloo_tpu_watchdog_last_stall_waited_us gauge")
+        _family(lines, "gloo_tpu_watchdog_last_stall_waited_us", "gauge",
+                "Wait time of the most recent recorded stall.")
         labels = {**base, "op": last.get("op", ""),
                   "peer": last.get("peer", -1)}
         lines.append(f"gloo_tpu_watchdog_last_stall_waited_us"

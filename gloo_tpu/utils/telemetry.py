@@ -20,6 +20,10 @@ context (or any object with the same ``metrics()``/``profile()``/
                         below min size
 ``GET /profile.json``   the phase profiler's per-op breakdown ring
 ``GET /flightrec``      the always-on flight-recorder ring
+``GET /fleet``          the merged fleet observability document (rank 0
+                        with ``ctx.fleetobs_start()`` running: coverage,
+                        straggler leaderboard, slow links, anomalies;
+                        a role stub elsewhere — docs/fleet.md)
 ``POST /flightrec/dump``  write this rank's ring to the dump directory
                         (guarded: POST-only, plus the ``token`` check
                         below when configured)
@@ -54,18 +58,26 @@ from gloo_tpu.utils import metrics as metrics_util
 __all__ = ["TelemetryServer", "fetch_route", "serve_telemetry"]
 
 
-def fetch_route(source: str, route: str, timeout: float = 10.0):
+def fetch_route(source: str, route: str, timeout: float = 10.0,
+                token: Optional[str] = None):
     """Fetch one telemetry route from a live rank and parse the JSON.
 
     ``source`` is an ``http(s)://host:port`` base (``route`` — e.g.
     ``"/flightrec"`` or ``"/profile.json"`` — is appended unless the
-    source already ends with it). The one fetch path shared by
-    ``tools/flightrec_view.py`` and ``tools/profile_view.py`` so their
-    live-source handling cannot drift."""
+    source already ends with it). ``token`` (default: the
+    ``TPUCOLL_TELEMETRY_TOKEN`` environment variable) rides the
+    ``X-TpuColl-Token`` header for token-guarded endpoints. The one
+    fetch path shared by ``tools/flightrec_view.py`` and
+    ``tools/profile_view.py`` (via ``tools/_telemetry_client.py``) so
+    their live-source handling cannot drift."""
     url = source.rstrip("/")
     if not url.endswith(route):
         url += route
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
+    if token is None:
+        token = os.environ.get("TPUCOLL_TELEMETRY_TOKEN") or None
+    req = urllib.request.Request(
+        url, headers={"X-TpuColl-Token": token} if token else {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.load(resp)
 
 
@@ -218,10 +230,20 @@ class TelemetryServer:
                         self._reply_json(200, outer._ctx.profile())
                     elif path == "/flightrec":
                         self._reply_json(200, outer._ctx.flightrec())
+                    elif path == "/fleet":
+                        fleet_fn = getattr(outer._ctx, "fleet", None)
+                        if callable(fleet_fn):
+                            self._reply_json(200, fleet_fn())
+                        else:
+                            self._reply_json(404, {
+                                "error": "context has no fleet() "
+                                         "(fleet observability plane "
+                                         "unavailable)"})
                     elif path == "/":
                         self._reply_json(200, {"routes": [
                             "/metrics", "/healthz", "/profile.json",
-                            "/flightrec", "POST /flightrec/dump"]})
+                            "/flightrec", "/fleet",
+                            "POST /flightrec/dump"]})
                     elif path == "/flightrec/dump":
                         self._reply_json(405, {"error":
                                                "use POST (guarded route)"})
@@ -259,8 +281,17 @@ class TelemetryServer:
                 except Exception as exc:  # noqa: BLE001 - served as 500
                     self._reply_json(500, {"error": repr(exc)})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        # SO_REUSEADDR explicitly: a restarting rank must be able to
+        # rebind its fixed TPUCOLL_TELEMETRY_PORT while the previous
+        # server's sockets sit in TIME_WAIT. http.server happens to
+        # default this on; pinning it here makes the rebind contract
+        # ours, not an inherited accident (regression-tested).
+        class _Server(ThreadingHTTPServer):
+            allow_reuse_address = True
+
+        self._httpd = _Server((host, port), Handler)
         self._httpd.daemon_threads = True
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"tpucoll-telemetry-{self._httpd.server_address[1]}",
@@ -280,6 +311,12 @@ class TelemetryServer:
         return f"http://{self.host}:{self.port}"
 
     def close(self) -> None:
+        """Stop serving, close the listening socket, and JOIN the
+        serving thread — after close() returns, the port is free to
+        rebind. Idempotent: a second close is a no-op, not an error."""
+        if self._closed:
+            return
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

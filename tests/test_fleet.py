@@ -1,0 +1,491 @@
+"""In-band fleet observability plane (ISSUE 16, docs/fleet.md).
+
+The plane folds every rank's metrics / profile / health report up the
+host topology over the collective transport itself — members to their
+host leader, leaders to rank 0 — so rank 0 serves one merged ``/fleet``
+document with O(hosts) inbound traffic and NO side-channel: members
+never open a telemetry connection to rank 0 (the only HTTP server in
+these tests runs on rank 0, and the in-band document is complete
+regardless).
+
+Topology simulation follows test_group.py: each rank overrides its host
+fingerprint (Context.set_host_id) so one machine presents as H
+simulated hosts, which makes the member -> leader -> rank 0 relay real.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu.utils import fleet as fleet_util
+from gloo_tpu.utils.telemetry import fetch_route, serve_telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_hosts(size, rph, fn, timeout=90.0, context_timeout=45.0):
+    """Threaded grid with a simulated multi-host topology: rank r
+    presents host fingerprint fleet-host<r // rph>."""
+    store = gloo_tpu.HashStore()
+    results = [None] * size
+    errors = []
+    lock = threading.Lock()
+
+    def worker(rank):
+        ctx = None
+        try:
+            device = gloo_tpu.Device()
+            ctx = gloo_tpu.Context(rank, size, timeout=context_timeout)
+            ctx.set_host_id(f"fleet-host{rank // rph}")
+            ctx.connect_full_mesh(store, device)
+            results[rank] = fn(ctx, rank)
+        except BaseException as exc:  # noqa: BLE001 - surfaced to caller
+            with lock:
+                errors.append((rank, exc))
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"rank thread did not finish in {timeout}s")
+    if errors:
+        rank, exc = errors[0]
+        raise AssertionError(f"rank {rank} failed: {exc!r}") from exc
+    return results
+
+
+def _poll(predicate, deadline_s, interval_s=0.05):
+    """Poll predicate() until truthy or the deadline; returns the last
+    value either way (callers assert on it for a useful message)."""
+    deadline = time.monotonic() + deadline_s
+    value = predicate()
+    while not value and time.monotonic() < deadline:
+        time.sleep(interval_s)
+        value = predicate()
+    return value
+
+
+def _sync_until(ctx, rank, done_fn, deadline_s=30.0):
+    """Keep ALL ranks alive (and their planes relaying) until rank 0's
+    done_fn() is truthy: every iteration is one tiny allreduce where
+    rank 0 contributes 1.0 once done — so the whole grid agrees on the
+    exit round and nobody tears down the mesh under a live tick."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        flag = np.zeros(1, dtype=np.float32)
+        if rank == 0 and done_fn():
+            flag[0] = 1.0
+        ctx.allreduce(flag)
+        if flag[0] > 0:
+            return True
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical aggregation: the grid acceptance (P >= 8, simulated hosts)
+# ---------------------------------------------------------------------------
+
+def test_fleet_covers_all_ranks_over_simulated_hosts(monkeypatch):
+    """8 ranks across 4 simulated hosts: rank 0's /fleet document (both
+    Context.fleet() and the HTTP route) reaches complete coverage with
+    every rank's report relayed in-band through its host leader — no
+    member ever opens a telemetry connection (the sole HTTP server runs
+    on rank 0, started after coverage already completed in-band)."""
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_INTERVAL_MS", "80")
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_WINDOW", "5")
+    size, rph = 8, 2
+
+    def fn(ctx, rank):
+        ctx.fleetobs_start()
+        assert ctx.fleetobs_running()
+        # Some collective traffic so reports carry ops + link stats.
+        x = np.ones(256, dtype=np.float32)
+        for _ in range(5):
+            ctx.allreduce(x.copy())
+
+        out = {}
+        if rank == 0:
+            def complete():
+                doc = ctx.fleet()
+                return (doc if fleet_util.coverage(doc)["complete"]
+                        else None)
+            doc = _poll(complete, 25.0)
+            assert doc, f"no full coverage: {ctx.fleet()}"
+            out["doc"] = doc
+            # The HTTP route serves the very same merged document.
+            with serve_telemetry(ctx, port=0) as srv:
+                served = fetch_route(srv.url, "/fleet", timeout=5.0)
+            out["served"] = served
+        else:
+            out["doc"] = ctx.fleet()
+        ok = _sync_until(ctx, rank, lambda: "doc" in out)
+        assert ok, "grid did not agree on completion"
+        ctx.fleetobs_stop()
+        assert not ctx.fleetobs_running()
+        return out
+
+    results = spawn_hosts(size, rph, fn)
+
+    doc = results[0]["doc"]
+    assert doc["kind"] == "fleet" and doc["enabled"] is True
+    cov = fleet_util.coverage(doc)
+    assert cov == {"expected": size, "reported": size, "missing": [],
+                   "complete": True}
+    reps = fleet_util.reports(doc)
+    assert sorted(reps) == list(range(size))
+    assert len(doc["hosts"]) == size // rph
+    for host in doc["hosts"]:
+        # Host docs carry their leader and only their own members.
+        member_ranks = sorted(int(r) for r in host["ranks"])
+        assert member_ranks == [host["host_index"] * rph,
+                                host["host_index"] * rph + 1]
+        assert host["leader"] == member_ranks[0]
+    for rank, rep in reps.items():
+        assert rep["rank"] == rank
+        assert rep["ok"] is True and rep["errors"] == 0
+        assert rep["calls"] > 0, f"rank {rank} report carried no ops"
+    # Link telemetry made it into the reports (tentpole a -> b).
+    assert any(rep.get("links") for rep in reps.values())
+    # The HTTP route returned the same aggregation (round advances
+    # between the two snapshots; coverage must not regress).
+    served = results[0]["served"]
+    assert fleet_util.coverage(served)["complete"]
+
+    # Non-root ranks answer with an honest stub pointing at rank 0.
+    for rank in range(1, size):
+        stub = results[rank]["doc"]
+        assert stub["enabled"] in (True, False)
+        assert stub["role"] == ("leader" if rank % rph == 0 else "member")
+        assert stub["hosts"] == []
+        assert "rank 0" in stub["note"]
+
+
+def test_fleetobs_disabled_by_env(monkeypatch):
+    """TPUCOLL_FLEETOBS=0: start() is a no-op — no thread, no wire
+    buffers, and fleet() says so instead of serving stale data."""
+    monkeypatch.setenv("TPUCOLL_FLEETOBS", "0")
+
+    def fn(ctx, rank):
+        ctx.fleetobs_start()
+        assert not ctx.fleetobs_running()
+        return ctx.fleet()
+
+    docs = spawn_hosts(2, 1, fn)
+    for doc in docs:
+        assert doc["enabled"] is False
+        assert doc["hosts"] == []
+
+
+def test_fleetobs_not_started_stub():
+    """fleet() before fleetobs_start(): a stub document, not an error
+    (dashboards probe /fleet on every rank unconditionally)."""
+    def fn(ctx, rank):
+        return ctx.fleet()
+
+    docs = spawn_hosts(2, 2, fn)
+    for rank, doc in enumerate(docs):
+        assert doc["enabled"] is False
+        assert doc["rank"] == rank
+        assert "note" in doc
+
+
+# ---------------------------------------------------------------------------
+# continuous anomaly detection (tentpole c)
+# ---------------------------------------------------------------------------
+
+def test_chaos_delayed_rank_trips_persistent_straggler(monkeypatch):
+    """Chaos acceptance: one rank sleeps before every collective; the
+    in-band detector on rank 0 must blame exactly that rank with a
+    persistent_straggler anomaly visible in ALL THREE mirrors — the
+    /fleet document, rank 0's flight-recorder ring, and the
+    gloo_tpu_anomaly_total metrics counter."""
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_INTERVAL_MS", "80")
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_WINDOW", "40")
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_STRAGGLER_MS", "50")
+    size, rph, laggard = 4, 2, 3
+
+    def fn(ctx, rank):
+        ctx.fleetobs_start()
+        x = np.ones(64, dtype=np.float32)
+        for _ in range(12):
+            if rank == laggard:
+                time.sleep(0.03)
+            ctx.allreduce(x.copy())
+
+        out = {}
+        if rank == 0:
+            def fired():
+                doc = ctx.fleet()
+                hits = [ev for ev
+                        in doc.get("anomalies", {}).get("recent", [])
+                        if ev["kind"] == "persistent_straggler"]
+                return (doc, hits) if hits else None
+            got = _poll(fired, 25.0)
+            assert got, f"no straggler anomaly: {ctx.fleet()}"
+            out["doc"], out["hits"] = got
+            out["flightrec"] = ctx.flightrec()
+            out["metrics"] = ctx.metrics()
+        ok = _sync_until(ctx, rank, lambda: "doc" in out)
+        assert ok, "grid did not agree on completion"
+        ctx.fleetobs_stop()
+        return out
+
+    results = spawn_hosts(size, rph, fn)
+    doc, hits = results[0]["doc"], results[0]["hits"]
+
+    # 1) the /fleet document blames the delayed rank...
+    assert all(ev["rank"] == laggard for ev in hits), hits
+    assert doc["anomalies"]["total"] >= len(hits)
+    # ...and its leaderboard agrees on who the fleet waits for.
+    board = doc["straggler"]["leaderboard"]
+    assert board and board[0]["rank"] == laggard, board
+    assert board[0]["blamed_us"] >= 50_000
+
+    # 2) the flight recorder carries the same event in-ring.
+    anomaly_events = [e for e in results[0]["flightrec"]["events"]
+                      if e["op"] == "anomaly:persistent_straggler"]
+    assert anomaly_events, "anomaly missing from the flight recorder"
+    assert all(e["peer"] == laggard for e in anomaly_events)
+
+    # 3) the metrics registry counted it under the blamed rank.
+    kinds = results[0]["metrics"]["anomalies"]["kinds"]
+    assert kinds.get("persistent_straggler", {}).get(str(laggard), 0) >= 1
+
+
+def test_lease_jitter_detector_fires_from_aux(monkeypatch):
+    """A member publishing an elastic aux whose renewal counter never
+    advances (agent wedged) must trip lease_jitter for that rank once
+    the observation span covers >= 4 lease periods."""
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_INTERVAL_MS", "60")
+    monkeypatch.setenv("TPUCOLL_FLEETOBS_WINDOW", "50")
+
+    def fn(ctx, rank):
+        ctx.fleetobs_start()
+        if rank == 1:
+            # A wedged agent: the renewal counter never advances.
+            ctx.fleetobs_set_aux(
+                {"elastic": {"lease_ms": 20, "leases_renewed": 7}})
+        out = {}
+        if rank == 0:
+            def fired():
+                doc = ctx.fleet()
+                hits = [ev for ev
+                        in doc.get("anomalies", {}).get("recent", [])
+                        if ev["kind"] == "lease_jitter"]
+                return hits or None
+            hits = _poll(fired, 20.0)
+            assert hits, f"no lease_jitter anomaly: {ctx.fleet()}"
+            out["hits"] = hits
+        ok = _sync_until(ctx, rank, lambda: "hits" in out)
+        assert ok, "grid did not agree on completion"
+        ctx.fleetobs_stop()
+        return out
+
+    results = spawn_hosts(2, 2, fn)
+    assert all(ev["rank"] == 1 for ev in results[0]["hits"])
+
+
+def test_set_aux_rejects_malformed_json():
+    from gloo_tpu import _lib
+
+    def fn(ctx, rank):
+        ctx.fleetobs_start()
+        with pytest.raises(gloo_tpu.Error):
+            _lib.check(_lib.lib.tc_fleetobs_set_aux(
+                ctx._handle, b"{not json"))
+        ctx.fleetobs_stop()
+
+    spawn_hosts(1, 1, fn)
+
+
+# ---------------------------------------------------------------------------
+# document consumers: utils.fleet helpers + the shared tools client
+# ---------------------------------------------------------------------------
+
+_SYNTH_FLEET = {
+    "version": 1, "kind": "fleet", "rank": 0, "size": 4, "enabled": True,
+    "round": 9, "interval_ms": 1000,
+    "hosts": [
+        {"host_index": 0, "leader": 0, "ranks": {
+            "0": {"rank": 0, "ok": True, "stalls": 0, "errors": 0},
+            "1": {"rank": 1, "ok": False, "failure_peer": 2,
+                  "stalls": 2, "errors": 1}}},
+        {"host_index": 1, "leader": 2, "ranks": {
+            "2": {"rank": 2, "ok": True, "stalls": 0, "errors": 0}}},
+    ],
+    "coverage": {"expected": 4, "reported": 3, "missing": [3]},
+    "straggler": {"window_rounds": 30, "ops_window": 64,
+                  "leaderboard": [{"rank": 1, "blamed_us": 120000,
+                                   "blamed_ops": 8}]},
+    "slow_links": [{"rank": 2, "peer": 0, "bw_bps": 1e6,
+                    "median_bps": 2e7}],
+    "anomalies": {"total": 3, "recent": [
+        {"kind": "persistent_straggler", "rank": 1, "t_us": 1,
+         "detail": 120000}]},
+}
+
+
+def test_fleet_helpers_on_synthetic_document():
+    assert fleet_util.reports(_SYNTH_FLEET).keys() == {0, 1, 2}
+    cov = fleet_util.coverage(_SYNTH_FLEET)
+    assert cov["missing"] == [3] and not cov["complete"]
+
+    bad = fleet_util.unhealthy(_SYNTH_FLEET)
+    assert [e["rank"] for e in bad] == [1]
+    assert len(bad[0]["reasons"]) == 3  # failure + stalls + errors
+
+    s = fleet_util.summarize(_SYNTH_FLEET)
+    assert s["hosts"] == 2 and s["anomalies_total"] == 3
+    assert s["recent_anomalies_by_kind"] == {"persistent_straggler": 1}
+
+    text = fleet_util.render(_SYNTH_FLEET)
+    assert "coverage 3/4" in text and "missing: [3]" in text
+    assert "unhealthy rank 1" in text
+    assert "slow link 2->0" in text
+    assert "persistent_straggler" in text
+
+    # Coverage recomputes from the embedded reports when the document
+    # lost its own coverage section (truncated relay).
+    clipped = {k: v for k, v in _SYNTH_FLEET.items() if k != "coverage"}
+    assert fleet_util.coverage(clipped)["missing"] == [3]
+
+    # Stub documents render as an explicit "not here" line.
+    stub = {"enabled": False, "note": "fleet view is aggregated at rank 0"}
+    assert "disabled/stub" in fleet_util.render(stub)
+
+
+def test_tools_fleet_mode_renders_saved_document(tmp_path):
+    """Both viewers expose the shared --fleet source mode; exercised via
+    the profile viewer CLI against a saved document (exit 1: the synth
+    document has a coverage hole and recent anomalies)."""
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps(_SYNTH_FLEET))
+    for tool in ("profile_view.py", "flightrec_view.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", tool),
+             str(path), "--fleet"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1, (tool, proc.stderr)
+        assert "coverage 3/4" in proc.stdout, (tool, proc.stdout)
+
+
+# ---------------------------------------------------------------------------
+# telemetry server hardening (satellite: close() joins + rebind)
+# ---------------------------------------------------------------------------
+
+class _StubCtx:
+    rank = 0
+
+    def metrics(self, drain=False):
+        return {"rank": 0, "ops": {}, "transport": {}, "watchdog": {}}
+
+    def profile(self):
+        return {"rank": 0, "ops": []}
+
+    def flightrec(self):
+        return {"rank": 0, "events": []}
+
+
+def test_telemetry_close_frees_port_for_rebind():
+    """Regression (satellite 2): close() joins the serving thread and
+    releases the socket, and SO_REUSEADDR is pinned on — a restarting
+    rank rebinds its fixed TPUCOLL_TELEMETRY_PORT immediately, even
+    with the old sockets in TIME_WAIT."""
+    first = serve_telemetry(_StubCtx(), port=0)
+    port = first.port
+    assert fetch_route(first.url, "/healthz", timeout=5.0)["ok"]
+    first.close()
+    first.close()  # idempotent, not an error
+
+    second = serve_telemetry(_StubCtx(), port=port)
+    try:
+        assert second.port == port
+        assert fetch_route(second.url, "/healthz", timeout=5.0)["ok"]
+    finally:
+        second.close()
+
+
+# ---------------------------------------------------------------------------
+# mode-2 smoke: real processes over a FileStore (per-process host ids)
+# ---------------------------------------------------------------------------
+
+_PROC_BODY = """
+ctx.fleetobs_start()
+x = np.ones(128, dtype=np.float32)
+for _ in range(6):
+    ctx.allreduce(x.copy())
+deadline = time.monotonic() + 30.0
+done = False
+while True:
+    flag = np.zeros(1, dtype=np.float32)
+    if rank == 0:
+        from gloo_tpu.utils import fleet as fleet_util
+        if fleet_util.coverage(ctx.fleet())["complete"]:
+            done = True
+            flag[0] = 1.0
+    ctx.allreduce(flag)
+    if flag[0] > 0:
+        break
+    if time.monotonic() > deadline:
+        print("TIMEOUT", ctx.fleet())
+        sys.exit(4)
+    time.sleep(0.05)
+if rank == 0:
+    print("FLEET-COMPLETE")
+ctx.fleetobs_stop()
+ctx.close()
+sys.exit(0)
+"""
+
+
+def test_multiproc_filestore_fleet_smoke():
+    """Real child processes (one per rank, TPUCOLL_HOST_ID per process,
+    FileStore rendezvous): rank 0's in-band document reaches full
+    coverage — the same smoke CI runs, kept in-tree so it reproduces
+    locally with plain pytest."""
+    size, rph = 4, 2
+    store = tempfile.mkdtemp()
+    procs = []
+    for rank in range(size):
+        prog = textwrap.dedent("""
+            import os, sys, time
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            import gloo_tpu
+
+            rank = {rank}; size = {size}
+            store = gloo_tpu.FileStore({store!r})
+            ctx = gloo_tpu.Context(rank, size, timeout=30.0)
+            ctx.connect_full_mesh(store, gloo_tpu.Device())
+        """).format(repo=_REPO, rank=rank, size=size, store=store) \
+            + textwrap.dedent(_PROC_BODY)
+        env = dict(os.environ,
+                   TPUCOLL_HOST_ID=f"flthost{rank // rph}",
+                   TPUCOLL_FLEETOBS_INTERVAL_MS="80")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", prog], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=120) for p in procs]
+    codes = [p.returncode for p in procs]
+    assert codes == [0] * size, (codes, outs)
+    assert "FLEET-COMPLETE" in outs[0][0], outs[0]

@@ -623,3 +623,108 @@ def test_tsan_profile_smoke():
                             env=env)
     assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
     assert "PROFILE-SMOKE-OK" in result.stdout, result.stdout
+
+
+_FLEET_PROG = f"""
+import sys, threading, time
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+from gloo_tpu.utils import fleet as fleet_util
+
+size = 4
+store = gloo_tpu.HashStore()
+errors = []
+done = threading.Event()
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.set_host_id("sanflt%d" % (rank // 2))
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        ctx.fleetobs_start()
+        assert ctx.fleetobs_running()
+        x = np.full(1 << 12, 1.0, dtype=np.float32)
+        for _ in range(4):
+            ctx.allreduce(x, algorithm="ring")
+            x[:] = 1.0
+        if rank == 0:
+            deadline = time.time() + 25
+            while time.time() < deadline:
+                if fleet_util.coverage(ctx.fleet())["complete"]:
+                    break
+                time.sleep(0.05)
+            assert fleet_util.coverage(ctx.fleet())["complete"], ctx.fleet()
+            done.set()
+        else:
+            assert done.wait(30), "rank 0 never reached coverage"
+        ctx.fleetobs_stop()
+        ctx.barrier()
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+[t.start() for t in threads]
+[t.join(180) for t in threads]
+assert not errors, errors
+print("FLEET-SMOKE-OK")
+"""
+
+
+def test_asan_fleet_smoke():
+    """Skip-unless-built ASan smoke of the fleet observability plane
+    through the ctypes surface: four ranks on two simulated hosts, the
+    member -> leader -> rank 0 relay running to full coverage, then a
+    clean stop — the per-link wire buffers, the bounded JSON builders,
+    and the stop/teardown ordering are the memory-shape code under
+    test (TPUCOLL_FLEETOBS_INTERVAL_MS pinned low so the relay
+    actually cycles)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS":
+                          "detect_leaks=0,abort_on_error=1",
+                          "TPUCOLL_FLEETOBS_INTERVAL_MS": "80"})
+    result = subprocess.run([sys.executable, "-c", _FLEET_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "FLEET-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_fleet_smoke():
+    """UBSan flavor of the fleet-plane smoke (-fno-sanitize-recover:
+    the first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib,
+                         {"TPUCOLL_FLEETOBS_INTERVAL_MS": "80"})
+    result = subprocess.run([sys.executable, "-c", _FLEET_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "FLEET-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_fleet_smoke():
+    """TSan flavor: the aggregation thread's tick races the application
+    ranks' collectives and the rank-0 fleet() reader — the fleetMu_/
+    auxMu_ publish protocol and the stop() abort/join ordering are
+    exactly what this must keep benign."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7",
+                          "TPUCOLL_FLEETOBS_INTERVAL_MS": "80"})
+    result = subprocess.run([sys.executable, "-c", _FLEET_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "FLEET-SMOKE-OK" in result.stdout, result.stdout

@@ -1,0 +1,166 @@
+"""Prometheus text-exposition lint for utils.metrics.to_prometheus
+(satellite of ISSUE 16).
+
+A scrape endpoint that violates the exposition format fails silently:
+Prometheus drops the whole scrape, dashboards flatline, and nobody sees
+an error. These tests pin the format contract over a worst-case
+synthetic snapshot (every optional section populated, label values full
+of quotes/backslashes/newlines):
+
+- exactly one ``# HELP`` and one ``# TYPE`` per family, in that order,
+  BEFORE the family's first sample;
+- no duplicate (name, labels) series;
+- every non-comment line parses as ``name{labels} value`` with properly
+  escaped label values;
+- histogram buckets are cumulative and end with ``+Inf``.
+"""
+
+import re
+
+from gloo_tpu.utils.metrics import to_prometheus
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})?'
+    r' (?P<value>[0-9eE.+-]+|NaN)$')
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _snapshot() -> dict:
+    """Every section to_prometheus renders, with hostile label values
+    (a transport-failure message's quotes/newlines are typical)."""
+    hist = {"buckets": [[64, 2], [128, 1]], "count": 3, "sum_us": 200,
+            "max_us": 120}
+    return {
+        "rank": 3,
+        "group": 's1/g2"quoted\\back\nline',
+        "ops": {'allreduce"x': {"calls": 5, "bytes": 512, "errors": 1,
+                                "latency_us": hist}},
+        "phases": {"allreduce": {"ring": {"wire_wait": hist}}},
+        "transport": {"0": {
+            "sent_msgs": 9, "sent_bytes": 900, "recv_msgs": 8,
+            "recv_bytes": 800, "last_progress_age_us": 17,
+            "recv_wait_us": hist,
+            "tx_posts": 4, "bw_ewma_bps": 1.5e9, "rtt_ewma_us": 42.5,
+            "chan_tx": {"0": 600, "1": 300}, "chan_rx": {"0": 800}}},
+        "channels": {"0": {"tx_bytes": 600, "rx_bytes": 800}},
+        "loops": {"0": {"events": 11, "last_progress_age_us": 3}},
+        "retries": 1, "stash_pauses": 2, "trace_events_dropped": 0,
+        "plan_hits": 7, "plan_misses": 2, "plan_evictions": 1,
+        "ubuf_creates": 4,
+        "faults": {"total": 2, "drop": 1, 'de"lay': 1},
+        "anomalies": {"total": 2, "kinds": {
+            "persistent_straggler": {"3": 1, "10": 1}}},
+        "async": {"in_flight": 1, "engines": [
+            {"per_lane": [{"submitted": 3, "completed": 2, "errors": 0}]}]},
+        "elastic": {"epoch": 4, "size": 8, "leases_renewed": 99,
+                    "rebuilds": 1, "bumps_published": 2},
+        "watchdog": {"stalls": 1, "last": {
+            "op": "allreduce", "peer": 2, "waited_us": 5000}},
+    }
+
+
+def _parse(text: str):
+    """-> (help_lines, type_lines, samples) with per-family ordering
+    checks applied along the way."""
+    helps, types, samples = {}, {}, []
+    opened = []  # family open order: HELP must immediately precede TYPE
+    for ln, line in enumerate(text.splitlines(), 1):
+        assert line == line.strip(), f"line {ln}: stray whitespace"
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helps, f"duplicate # HELP {name}"
+            helps[name] = ln
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in types, f"duplicate # TYPE {name}"
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert helps.get(name) == ln - 1, \
+                f"# TYPE {name} not immediately after its # HELP"
+            types[name] = kind
+            opened.append(name)
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable sample line {ln}: {line!r}"
+        samples.append((m["name"], m["labels"] or "", ln))
+    return helps, types, samples
+
+
+def _base_family(sample_name: str, families) -> str:
+    """histogram samples append _bucket/_sum/_count to the family."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if (sample_name.endswith(suffix)
+                and sample_name[:-len(suffix)] in families):
+            return sample_name[:-len(suffix)]
+    return sample_name
+
+
+def test_exposition_format_contract():
+    text = to_prometheus(_snapshot())
+    helps, types, samples = _parse(text)
+
+    seen = set()
+    for name, labels, ln in samples:
+        family = _base_family(name, types)
+        assert family in types, f"sample {name} has no # TYPE"
+        assert helps[family] < ln, \
+            f"sample {name} before its family header"
+        if name != family:
+            assert types[family] == "histogram", name
+        key = (name, labels)
+        assert key not in seen, f"duplicate series: {name}{labels}"
+        seen.add(key)
+        # Label syntax: every k="v" pair must round-trip the escaping.
+        if labels:
+            inner = labels[1:-1]
+            consumed = ",".join(m.group(0)
+                                for m in _LABEL.finditer(inner))
+            assert consumed == inner, f"bad label syntax: {labels!r}"
+
+    # Families opened but never sampled are fine (empty sections);
+    # families sampled but never opened are not (checked above). The
+    # new fleet families must exist with samples.
+    sampled = {_base_family(n, types) for n, _, _ in samples}
+    for family in ("gloo_tpu_pair_bytes_total",
+                   "gloo_tpu_pair_posts_total",
+                   "gloo_tpu_pair_bw_ewma",
+                   "gloo_tpu_pair_rtt_ewma_us",
+                   "gloo_tpu_anomaly_total"):
+        assert family in sampled, f"{family} missing from exposition"
+
+
+def test_escaping_of_hostile_label_values():
+    text = to_prometheus(_snapshot())
+    # The raw hostile group tag must never appear unescaped: a literal
+    # newline inside a label value splits the line and kills the scrape.
+    assert '\nline"' not in text
+    assert '\\nline' in text          # escaped newline survives
+    assert '\\"quoted' in text        # escaped double-quote
+    assert '\\\\back' in text         # escaped backslash
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert _SAMPLE.match(line), repr(line)
+
+
+def test_anomaly_family_blamed_rank_labels():
+    """gloo_tpu_anomaly_total: the 'rank' label is the BLAMED rank, not
+    the emitting rank — one series per (kind, blamed), numerically
+    sorted (rank 10 after rank 3, not lexically before)."""
+    text = to_prometheus(_snapshot())
+    rows = [l for l in text.splitlines()
+            if l.startswith("gloo_tpu_anomaly_total{")]
+    assert len(rows) == 2
+    assert 'rank="3"' in rows[0] and 'rank="10"' in rows[1]
+    assert all('kind="persistent_straggler"' in r for r in rows)
+
+
+def test_histogram_buckets_cumulative_with_inf():
+    text = to_prometheus(_snapshot())
+    buckets = [l for l in text.splitlines()
+               if l.startswith("gloo_tpu_collective_latency_us_bucket")]
+    values = [float(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert values == sorted(values), "buckets must be cumulative"
+    assert 'le="+Inf"' in buckets[-1]
+    assert values[-1] == 3  # == count

@@ -17,6 +17,11 @@ Perfetto/chrome://tracing file of the merged timeline.
 
 Exit status: 0 clean, 1 stall, 2 desync (with --check; otherwise 0
 unless the input is unusable).
+
+With ``--fleet`` the sources are rank 0 endpoints (or saved fleet
+documents) and the merged in-band ``/fleet`` view is rendered instead
+(docs/fleet.md). Endpoint handling (timeout, auth token) is shared with
+profile_view via tools/_telemetry_client.py.
 """
 
 from __future__ import annotations
@@ -27,22 +32,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import _telemetry_client  # noqa: E402
 from gloo_tpu.utils import flightrec  # noqa: E402
-from gloo_tpu.utils.telemetry import fetch_route  # noqa: E402
 
 
-def _resolve_source(src: str):
+def _resolve_source(src: str, timeout: float = 10.0, token=None):
     """A CLI source -> something flightrec.merge understands: http(s)
     URLs fetch the live /flightrec ring (loaded dict; unreachable ranks
     degrade to None, exactly like a missing dump file), everything else
     passes through as a path."""
-    if not (src.startswith("http://") or src.startswith("https://")):
+    if not _telemetry_client.is_url(src):
         return src
-    try:
-        return fetch_route(src, "/flightrec")
-    except Exception as exc:  # noqa: BLE001 - absence is evidence
-        print(f"warning: cannot fetch {src}: {exc}", file=sys.stderr)
-        return None
+    return _telemetry_client.fetch(src, "/flightrec",
+                                   timeout=timeout, token=token)
 
 
 def main() -> int:
@@ -56,7 +58,12 @@ def main() -> int:
                     help="write merged Chrome trace-event JSON here")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 on stall, 2 on desync")
+    _telemetry_client.add_endpoint_args(ap)
     args = ap.parse_args()
+
+    if args.fleet:
+        return _telemetry_client.run_fleet_mode(
+            args.dumps, timeout=args.timeout, token=args.token)
 
     # A directory may hold dumps from several communicators — the root
     # context plus split sub-groups (tagged -g<group>) and async lanes
@@ -66,7 +73,9 @@ def main() -> int:
     if len(args.dumps) == 1 and os.path.isdir(args.dumps[0]):
         groups = flightrec.merge_by_tag(args.dumps[0])
     else:
-        sources = [_resolve_source(s) for s in args.dumps]
+        sources = [_resolve_source(s, timeout=args.timeout,
+                                   token=args.token)
+                   for s in args.dumps]
         groups = {"": flightrec.merge(sources)}
     groups = {tag: m for tag, m in groups.items() if m["ranks"]}
     if not groups:

@@ -14,6 +14,13 @@ the per-rank leaderboard of who the job waits for.
     python tools/profile_view.py http://127.0.0.1:9401 http://127.0.0.1:9402
     python tools/profile_view.py profile-dump/ --perfetto phases.json
     python tools/profile_view.py profile-dump/ --ops 10
+    python tools/profile_view.py http://10.0.0.1:9401 --fleet
+
+With ``--fleet`` the sources are rank 0 endpoints (or saved fleet
+documents) and the merged in-band ``/fleet`` view is rendered instead —
+coverage, health, straggler leaderboard, slow links, anomalies
+(docs/fleet.md). Endpoint handling (timeout, auth token) is shared with
+flightrec_view via tools/_telemetry_client.py.
 """
 
 from __future__ import annotations
@@ -26,16 +33,18 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import _telemetry_client  # noqa: E402
 from gloo_tpu.utils import profile  # noqa: E402
-from gloo_tpu.utils.telemetry import fetch_route  # noqa: E402
 
 
-def load_source(src: str) -> list:
+def load_source(src: str, timeout: float = 10.0, token=None) -> list:
     """One source -> list of profile snapshot dicts. Never raises for a
     single bad source; reports and returns []."""
     try:
-        if src.startswith("http://") or src.startswith("https://"):
-            return [fetch_route(src, "/profile.json")]
+        if _telemetry_client.is_url(src):
+            snap = _telemetry_client.fetch(src, "/profile.json",
+                                           timeout=timeout, token=token)
+            return [snap] if snap is not None else []
         if os.path.isdir(src):
             out = []
             for path in sorted(glob.glob(
@@ -71,11 +80,17 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="print the full attribution as JSON instead of "
                          "the table")
+    _telemetry_client.add_endpoint_args(ap)
     args = ap.parse_args()
+
+    if args.fleet:
+        return _telemetry_client.run_fleet_mode(
+            args.sources, timeout=args.timeout, token=args.token)
 
     snaps = []
     for src in args.sources:
-        snaps.extend(load_source(src))
+        snaps.extend(load_source(src, timeout=args.timeout,
+                                 token=args.token))
     if not snaps:
         print("no usable profile snapshots", file=sys.stderr)
         return 1
