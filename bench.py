@@ -254,6 +254,180 @@ def bench_autotune(quick=False, out_path=None):
     print(json.dumps(line))
 
 
+def bench_schedule_sweep(quick=False, out_path=None):
+    """--schedule-sweep [--quick]: sweep the schedule generator grid
+    against the native arms on a 4-rank group (docs/schedules.md).
+
+    For every swept allreduce size: p50 of the native kAuto dispatch
+    (schedule plane cleared) and of the fixed native ring and hd arms,
+    then each generated candidate schedule installed with a single
+    election for exactly that (collective, world, bucket) cell — the
+    grid includes the two families the native enum cannot express (the
+    chunked-pipelined ring, depth 2/4, and the 2-level hierarchy).
+    Elects the fastest candidate wherever it beats the BEST native arm,
+    saves the elected table (the TPUCOLL_SCHEDULE_FILE format), and
+    prints ONE JSON line:
+
+      {"metric": "allreduce_schedule_sweep_4rank_host",
+       "value": <cells where a generated schedule beat best-native>,
+       "unit": "cells_won", "ranks_agree": ..., "table": <path>,
+       "cells": [{"bytes", "native_auto_us", "native_ring_us",
+                  "native_hd_us", "arms": {name: us}, "winner",
+                  "winner_vs_best_native"}, ...]}
+
+    SCHED_r17.json in the repo root is a committed full run: the
+    acceptance evidence that schedule search finds real wins (a
+    pipelined ring or hierarchy cell under 1.0).
+    """
+    import numpy as np
+
+    import gloo_tpu
+    from gloo_tpu import schedule
+
+    if out_path is None:
+        out_path = "/tmp/schedule_table.json"
+    world = 4
+    min_bytes = (16 << 10) if quick else (64 << 10)
+    max_bytes = (64 << 10) if quick else (4 << 20)
+    iters, warmup = (6, 1) if quick else (20, 3)
+    candidates = [("ring", {"depth": 1}), ("ring", {"depth": 2}),
+                  ("ring", {"depth": 4}), ("hd", {}), ("bcube", {}),
+                  ("hier", {"ranks_per_host": 2})]
+    # The generated-only families: the acceptance signal counts wins
+    # from shapes the native enum cannot dispatch.
+    generated_only = {"ring_p4_k2", "ring_p4_k4", "hier_p4_h2"}
+
+    store = gloo_tpu.HashStore()
+    rank_tables = [None] * world
+    cells_out = [None]
+
+    def time_allreduce(ctx, x, **kw):
+        for _ in range(warmup):
+            ctx.allreduce(x, **kw)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, **kw)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2] * 1e6
+
+    def worker(rank):
+        _maybe_pin(rank)
+        device = gloo_tpu.Device()
+        ctx = gloo_tpu.Context(rank, world, timeout=120)
+        ctx.connect_full_mesh(store, device)
+        named = []
+        for family, params in candidates:
+            t = schedule.generate(family, world, params)
+            named.append((t["schedules"][0]["name"], t))
+
+        # Every rank runs the identical install/clear sequence (the
+        # plane is dispatch-relevant state and must flip at the same
+        # sequence points everywhere); rank 0's timings are reported.
+        cells = []
+        nbytes = min_bytes
+        while nbytes <= max_bytes:
+            x = np.zeros(nbytes // 4, dtype=np.float32)
+            schedule.clear(ctx)
+            ctx.barrier()
+            native_auto = time_allreduce(ctx, x)
+            native_ring = time_allreduce(ctx, x, algorithm="ring")
+            native_hd = time_allreduce(ctx, x,
+                                       algorithm="halving_doubling")
+            arms = {}
+            for name, table in named:
+                one = json.loads(json.dumps(table))
+                one["elections"] = [{
+                    "collective": "allreduce", "world_size": world,
+                    "dtype": "",
+                    "bucket": nbytes.bit_length() - 1,
+                    "schedule": name,
+                }]
+                schedule.install(ctx, one)
+                ctx.barrier()
+                arms[name] = time_allreduce(ctx, x)
+            best_native = min(native_auto, native_ring, native_hd)
+            winner = min(arms, key=arms.get)
+            cells.append({
+                "bytes": nbytes,
+                "native_auto_us": round(native_auto, 1),
+                "native_ring_us": round(native_ring, 1),
+                "native_hd_us": round(native_hd, 1),
+                "arms": {k: round(v, 1) for k, v in arms.items()},
+                "winner": winner,
+                "winner_vs_best_native": round(arms[winner] / best_native,
+                                               3),
+            })
+            nbytes *= 2
+        schedule.clear(ctx)
+
+        # Rank 0's timings decide (each rank measured its own clock);
+        # its elected table is broadcast so every rank reports the same
+        # bytes — the same agreement protocol schedule.sweep() uses.
+        if rank == 0:
+            elected = {"version": 1, "schedules": [], "elections": []}
+            used = set()
+            for c in cells:
+                best_native = min(c["native_auto_us"],
+                                  c["native_ring_us"], c["native_hd_us"])
+                if c["arms"][c["winner"]] < best_native:
+                    used.add(c["winner"])
+                    elected["elections"].append({
+                        "collective": "allreduce", "world_size": world,
+                        "dtype": "",
+                        "bucket": c["bytes"].bit_length() - 1,
+                        "schedule": c["winner"],
+                    })
+            for name, table in named:
+                if name in used:
+                    elected["schedules"].append(
+                        json.loads(json.dumps(table))["schedules"][0])
+            payload = json.dumps(elected, sort_keys=True).encode()
+            cells_out[0] = cells
+        else:
+            payload = b""
+        n = np.array([len(payload)], dtype=np.int64)
+        ctx.broadcast(n, root=0)
+        buf = np.zeros(int(n[0]), dtype=np.uint8)
+        if rank == 0:
+            buf[:] = np.frombuffer(payload, dtype=np.uint8)
+        ctx.broadcast(buf, root=0)
+        rank_tables[rank] = buf.tobytes().decode()
+        if rank == 0:
+            schedule.verify(rank_tables[rank])
+            schedule.save(rank_tables[rank], out_path)
+        ctx.barrier()
+        ctx.close()
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(1800)
+    assert all(t is not None for t in rank_tables), "a rank failed"
+    cells = cells_out[0]
+    assert cells, "no measurement cells"
+    for c in cells:
+        print(f"[sched] {c['bytes'] >> 10}KiB native "
+              f"{c['native_auto_us']:.0f}us winner {c['winner']} "
+              f"{c['arms'][c['winner']]:.0f}us "
+              f"(x{c['winner_vs_best_native']})", file=sys.stderr)
+    generated_wins = sum(
+        1 for c in cells
+        if c["winner"] in generated_only and c["winner_vs_best_native"] < 1)
+    line = {
+        "metric": "allreduce_schedule_sweep_4rank_host",
+        "value": generated_wins,
+        "unit": "cells_won",
+        "ranks_agree": len(set(rank_tables)) == 1,
+        "table": out_path,
+        "cells": cells,
+    }
+    print(json.dumps(line))
+
+
 def bench_latency(quick=False):
     """Small-message latency A/B: persistent collective plans on vs off.
 
@@ -1522,6 +1696,16 @@ def main():
         if i >= len(sys.argv) or sys.argv[i].startswith("--"):
             sys.exit("--chaos-soak requires a duration (seconds)")
         bench_chaos_soak(float(sys.argv[i]))
+        return
+    if "--schedule-sweep" in sys.argv[1:]:
+        out = None
+        if "--schedule-out" in sys.argv[1:]:
+            i = sys.argv.index("--schedule-out") + 1
+            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+                sys.exit("--schedule-out requires a path argument")
+            out = sys.argv[i]
+        bench_schedule_sweep(quick="--quick" in sys.argv[1:],
+                             out_path=out)
         return
     if "--autotune" in sys.argv[1:]:
         out = None

@@ -31,6 +31,10 @@
 #include "tpucoll/rendezvous/store.h"
 #include "tpucoll/rendezvous/tcp_store.h"
 #include "tpucoll/transport/device.h"
+#include "tpucoll/schedule/generators.h"
+#include "tpucoll/schedule/interpreter.h"
+#include "tpucoll/schedule/ir.h"
+#include "tpucoll/schedule/verifier.h"
 #include "tpucoll/tuning/tuner.h"
 #include "tpucoll/tuning/tuning_table.h"
 
@@ -765,6 +769,156 @@ int tc_tuning_json(void* ctx, uint8_t** out, size_t* outLen) {
     auto table = asContext(ctx)->tuningTable();
     copyOut(table != nullptr ? table->toJson() : std::string(), out,
             outLen);
+  });
+}
+
+// ---- collective schedule plane (schedule/) ----
+
+// Install a serialized schedule table on THIS rank only (the
+// all-ranks-identical contract is the caller's, exactly like
+// tc_tuning_install). Every schedule matching the context's world size
+// is verified AND resolved before the swap — malformed JSON or a
+// semantically invalid schedule fails the call and leaves the previous
+// plane (and the plan cache) untouched. NULL or empty JSON clears the
+// plane, restoring native dispatch.
+int tc_schedule_install(void* ctx, const char* json) {
+  return wrap([&] {
+    if (json == nullptr || json[0] == '\0') {
+      asContext(ctx)->setScheduleTable(nullptr);
+      return;
+    }
+    asContext(ctx)->setScheduleTable(
+        std::make_shared<const tpucoll::schedule::ScheduleTable>(
+            tpucoll::schedule::ScheduleTable::fromJson(json)));
+  });
+}
+
+// Serialized installed schedule table (empty string when none);
+// malloc'd, free with tc_buf_free.
+int tc_schedule_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    auto inst = asContext(ctx)->schedules();
+    copyOut(inst != nullptr ? inst->table->toJson() : std::string(), out,
+            outLen);
+  });
+}
+
+// Installed schedule summaries as a JSON array:
+//   [{"name","collective","world_size","steps","resolved"}]
+// "resolved" is 1 when the schedule matches this context's world (its
+// elections can fire), 0 when it is carried for round-trip only.
+int tc_schedule_list(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    auto inst = asContext(ctx)->schedules();
+    std::ostringstream os;
+    os << "[";
+    if (inst != nullptr) {
+      bool first = true;
+      for (const auto& s : inst->table->schedules()) {
+        if (!first) {
+          os << ",";
+        }
+        first = false;
+        os << "{\"name\":";
+        tpucoll::appendJsonString(os, s.name);
+        os << ",\"collective\":\""
+           << tpucoll::schedule::collectiveName(s.collective)
+           << "\",\"world_size\":" << s.worldSize
+           << ",\"steps\":" << s.steps.size() << ",\"resolved\":"
+           << (inst->programs.count(s.name) != 0 ? 1 : 0) << "}";
+      }
+    }
+    os << "]";
+    copyOut(os.str(), out, outLen);
+  });
+}
+
+// One installed schedule in full, serialized as a single-schedule table
+// (same interchange JSON as tc_schedule_json). TC_ERR for unknown names.
+int tc_schedule_describe(void* ctx, const char* name, uint8_t** out,
+                         size_t* outLen) {
+  return wrap([&] {
+    TC_ENFORCE(name != nullptr && name[0] != '\0',
+               "tc_schedule_describe: empty name");
+    auto inst = asContext(ctx)->schedules();
+    const tpucoll::schedule::Schedule* s =
+        inst != nullptr ? inst->table->find(name) : nullptr;
+    TC_ENFORCE(s != nullptr, "tc_schedule_describe: no installed ",
+               "schedule named \"", name, "\"");
+    tpucoll::schedule::ScheduleTable one;
+    one.add(*s);
+    copyOut(one.toJson(), out, outLen);
+  });
+}
+
+// Context-free: run `family` through the generator (paramsJson is a
+// JSON object of integer parameters, e.g. {"depth":2}; NULL/empty =
+// defaults), verify the result, and return it serialized as a
+// single-schedule table ready to merge or install. See
+// schedule/generators.h for the family list.
+int tc_schedule_generate(const char* family, int worldSize,
+                         const char* paramsJson, uint8_t** out,
+                         size_t* outLen) {
+  return wrap([&] {
+    TC_ENFORCE(family != nullptr && family[0] != '\0',
+               "tc_schedule_generate: empty family");
+    std::map<std::string, int> params;
+    if (paramsJson != nullptr && paramsJson[0] != '\0') {
+      // JsonReader keeps a reference to the text; give it a named string
+      // (a temporary from the char* would dangle past the constructor).
+      const std::string ptext(paramsJson);
+      tpucoll::JsonReader r(ptext, "schedule params",
+                            /*rejectDuplicateKeys=*/true);
+      using JValue = tpucoll::JsonReader::Value;
+      JValue v = r.parse();
+      TC_ENFORCE(v.kind == JValue::Kind::kObject,
+                 "schedule params: expected a JSON object");
+      for (const auto& kv : v.fields) {
+        TC_ENFORCE(kv.second.kind == JValue::Kind::kNumber,
+                   "schedule params: \"", kv.first,
+                   "\" must be an integer");
+        params[kv.first] = static_cast<int>(kv.second.number);
+      }
+    }
+    tpucoll::schedule::Schedule s =
+        tpucoll::schedule::generate(family, worldSize, params);
+    tpucoll::schedule::verifyOrThrow(s);
+    tpucoll::schedule::ScheduleTable one;
+    one.add(std::move(s));
+    copyOut(one.toJson(), out, outLen);
+  });
+}
+
+// Context-free: JSON array of generator family names.
+int tc_schedule_families(uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    std::ostringstream os;
+    os << "[";
+    bool first = true;
+    for (const auto& f : tpucoll::schedule::generatorFamilies()) {
+      if (!first) {
+        os << ",";
+      }
+      first = false;
+      tpucoll::appendJsonString(os, f);
+    }
+    os << "]";
+    copyOut(os.str(), out, outLen);
+  });
+}
+
+// Context-free: parse a schedule table and statically verify EVERY
+// schedule in it (all ranks of each schedule's declared world). 0 when
+// all pass; TC_ERR with the verifier's typed, step-naming message
+// (tc_last_error) on the first failure.
+int tc_schedule_verify(const char* json) {
+  return wrap([&] {
+    TC_ENFORCE(json != nullptr && json[0] != '\0',
+               "tc_schedule_verify: empty JSON");
+    auto table = tpucoll::schedule::ScheduleTable::fromJson(json);
+    for (const auto& s : table.schedules()) {
+      tpucoll::schedule::verifyOrThrow(s);
+    }
   });
 }
 

@@ -21,6 +21,7 @@
 #include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/profile.h"
 #include "tpucoll/group/hier.h"
+#include "tpucoll/schedule/interpreter.h"
 #include "tpucoll/tuning/dispatch.h"
 
 namespace tpucoll {
@@ -52,6 +53,46 @@ constexpr size_t kStageBinomial = 0;
 constexpr size_t kStageRingRs = 1;
 constexpr size_t kStageRsWork = 2;
 constexpr size_t kStageReduceResult = 3;
+
+// PlanKey.algorithm sentinel for scheduled (IR-interpreted) dispatch;
+// the schedule's identity rides in PlanKey.aux as an FNV-1a name hash.
+// Native algorithm enums are tiny, so 0xFF can never collide.
+constexpr uint8_t kScheduledAlgorithm = 0xFF;
+
+uint64_t fnvName(const std::string& name) {
+  uint64_t h = 1469598103934665603ull;
+  for (char c : name) {
+    h ^= static_cast<uint8_t>(c);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Elected-schedule lookup for a kAuto dispatch. A schedule election
+// names one exact (collective, world, dtype, size-bucket) cell — the
+// most specific evidence the tuner can record — so it outranks both the
+// tuning table and the compile-time fallback thresholds. Null when no
+// plane is installed, no cell matches this call, the elected schedule
+// was not resolvable for this world, or the program carries bf16-coded
+// wire steps without the caller's lossy-wire opt-in (codedOk).
+std::shared_ptr<const schedule::ResolvedProgram> electedSchedule(
+    Context* ctx, const char* collective, DataType dtype, size_t nbytes,
+    bool codedOk) {
+  auto inst = ctx->schedules();
+  if (inst == nullptr) {
+    return nullptr;
+  }
+  const schedule::Schedule* sel = inst->table->elected(
+      collective, ctx->size(), tuning::dataTypeName(dtype), nbytes);
+  if (sel == nullptr) {
+    return nullptr;
+  }
+  auto it = inst->programs.find(sel->name);
+  if (it == inst->programs.end() || (it->second->hasCoded && !codedOk)) {
+    return nullptr;
+  }
+  return it->second;
+}
 
 // Ring reduce-scatter over `work` (in place). After P-1 steps, rank r owns
 // block (r + 1 + startShift) mod P fully reduced. startShift=0 feeds the
@@ -329,6 +370,45 @@ void allgather(AllgatherOptions& opts) {
                          detail::effectiveTimeout(opts));
     return;
   }
+  if (ctx->size() > 1 && opts.count > 0 &&
+      opts.algorithm != HierDispatch::kHier) {
+    // Installed schedule plane first (see allreduce). Allgather
+    // elections are bucketed by TOTAL output bytes — the quantity the
+    // wire actually moves. Coded schedules never match: allgather has
+    // no reduction to absorb bf16 rounding, so generators don't emit
+    // them and electedSchedule's codedOk=false keeps it that way.
+    const int size = ctx->size();
+    const size_t elsize = elementSize(opts.dtype);
+    const size_t total = opts.count * size_t(size) * elsize;
+    if (auto prog = electedSchedule(ctx, "allgather", opts.dtype, total,
+                                    /*codedOk=*/false)) {
+      const char* lbl = schedule::internedLabel(prog->label);
+      auto schedSpan = ctx->tracer().span("allgather", total, -1, lbl);
+      frOp.setAlgorithm(lbl);
+      profOp.setAlgorithm(lbl);
+      const auto timeout = detail::effectiveTimeout(opts);
+      Slot slot = Slot::build(SlotPrefix::kAllgather, opts.tag);
+      char* out = bytePtr(opts.output);
+      PlanKey key;
+      key.opcode = static_cast<uint8_t>(PlanOp::kAllgatherv);
+      key.algorithm = kScheduledAlgorithm;
+      key.dtype = static_cast<uint8_t>(opts.dtype);
+      key.tag = opts.tag;
+      key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+      key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+      key.nbytes = total;
+      key.aux = fnvName(prog->name);
+      PlanHandle planh(ctx, key);
+      if (opts.input != nullptr) {
+        PhaseScope ps(Phase::kPack);
+        std::memcpy(out + size_t(ctx->rank()) * opts.count * elsize,
+                    opts.input, opts.count * elsize);
+      }
+      schedule::run(ctx, *planh, *prog, out, opts.count * size_t(size),
+                    elsize, /*fn=*/nullptr, opts.dtype, slot, timeout);
+      return;
+    }
+  }
   AllgathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -485,6 +565,42 @@ void allreduce(AllreduceOptions& opts) {
         }
       } else {
         algo = AllreduceAlgorithm::kAuto;
+      }
+    }
+    if (algo == AllreduceAlgorithm::kAuto && opts.customFn == nullptr) {
+      // Installed schedule plane first: an election names one exact
+      // (collective, world, dtype, bucket) cell, which is stronger
+      // evidence than the tuning table's whole-curve crossovers.
+      // Schedules carrying bf16-coded wire steps require the same
+      // float32 + sum + kAutoLossyWire opt-in as the native wire arms.
+      const bool codedOk =
+          opts.algorithm == AllreduceAlgorithm::kAutoLossyWire &&
+          opts.dtype == DataType::kFloat32 && opts.op == ReduceOp::kSum;
+      if (auto prog = electedSchedule(ctx, "allreduce", opts.dtype, nbytes,
+                                      codedOk)) {
+        const char* lbl = schedule::internedLabel(prog->label);
+        auto traceSpan = ctx->tracer().span("allreduce", nbytes, -1, lbl);
+        frOp.setAlgorithm(lbl);
+        profOp.setAlgorithm(lbl);
+        PlanKey key;
+        key.opcode = static_cast<uint8_t>(PlanOp::kAllreduce);
+        key.algorithm = kScheduledAlgorithm;
+        key.dtype = static_cast<uint8_t>(opts.dtype);
+        key.op = static_cast<uint8_t>(opts.op);
+        key.tag = opts.tag;
+        key.ptrA = reinterpret_cast<uintptr_t>(work);
+        key.nbytes = nbytes;
+        key.aux = fnvName(prog->name);
+        PlanHandle planh(ctx, key);
+        schedule::run(ctx, *planh, *prog, work, opts.count, elsize, fn,
+                      opts.dtype, slot, timeout);
+        if (opts.outputs.size() > 1) {
+          PhaseScope ps(Phase::kUnpack);
+          for (size_t i = 1; i < opts.outputs.size(); i++) {
+            std::memcpy(opts.outputs[i], work, nbytes);
+          }
+        }
+        return;
       }
     }
     if (algo == AllreduceAlgorithm::kAuto) {
@@ -883,6 +999,53 @@ void reduceScatter(ReduceScatterOptions& opts) {
   // through the normal auto dispatch instead.
   if (algo == ReduceScatterAlgorithm::kHier && !group::hierEligible(ctx)) {
     algo = ReduceScatterAlgorithm::kAuto;
+  }
+  if (algo == ReduceScatterAlgorithm::kAuto && fuseOk) {
+    // Installed schedule plane first (see allreduce). Generated
+    // reduce-scatter schedules assume even chunk geometry (chunk r is
+    // rank r's result block); uneven recvCounts fall through to native.
+    bool even = true;
+    for (size_t c : opts.recvCounts) {
+      even = even && c == opts.recvCounts[0];
+    }
+    if (even) {
+      if (auto prog = electedSchedule(ctx, "reduce_scatter", opts.dtype,
+                                      total, /*codedOk=*/false)) {
+        const char* lbl = schedule::internedLabel(prog->label);
+        auto schedSpan =
+            ctx->tracer().span("reduce_scatter", total, -1, lbl);
+        frOp.setAlgorithm(lbl);
+        profOp.setAlgorithm(lbl);
+        PlanKey key;
+        key.opcode = static_cast<uint8_t>(PlanOp::kReduceScatter);
+        key.algorithm = kScheduledAlgorithm;
+        key.dtype = static_cast<uint8_t>(opts.dtype);
+        key.op = static_cast<uint8_t>(opts.op);
+        key.tag = opts.tag;
+        key.ptrA = reinterpret_cast<uintptr_t>(opts.input);
+        key.ptrB = reinterpret_cast<uintptr_t>(opts.output);
+        key.nbytes = total;
+        key.aux = plan::hashCounts(opts.recvCounts) ^ fnvName(prog->name);
+        PlanHandle planh(ctx, key);
+        // Work in a plan-staged copy so the caller's input stays
+        // intact; the stage's registration doubles as the schedule's
+        // work buffer (the interpreter owns slots 0/1).
+        auto st = planh->stage(kStageRsWork, total);
+        {
+          PhaseScope ps(Phase::kPack);
+          std::memcpy(st.data, opts.input, total);
+        }
+        schedule::run(ctx, *planh, *prog, st.data, total / elsize, elsize,
+                      fn, opts.dtype, slot, timeout, st.buf);
+        {
+          PhaseScope ps(Phase::kUnpack);
+          const size_t blockBytes = opts.recvCounts[rank] * elsize;
+          std::memcpy(opts.output, st.data + size_t(rank) * blockBytes,
+                      blockBytes);
+        }
+        return;
+      }
+    }
   }
   if (algo == ReduceScatterAlgorithm::kAuto) {
     // Measured tuning table first (keyed by total payload bytes), then

@@ -24,8 +24,14 @@ namespace tpucoll {
 
 class JsonReader {
  public:
-  explicit JsonReader(const std::string& text, const char* what = "JSON")
-      : text_(text), what_(what) {}
+  // rejectDuplicateKeys: historically this reader accepted duplicate
+  // object keys silently (field() returns the first, so a duplicate was
+  // dead weight that masked typos in hand-edited files). Strict-mode
+  // loaders (tuning tables, schedule tables) pass true to fail loudly
+  // with the offending key path instead.
+  explicit JsonReader(const std::string& text, const char* what = "JSON",
+                      bool rejectDuplicateKeys = false)
+      : text_(text), what_(what), rejectDuplicateKeys_(rejectDuplicateKeys) {}
 
   // Parsed value: exactly one of the members is active, by `kind`.
   struct Value {
@@ -238,7 +244,11 @@ class JsonReader {
       return v;
     }
     while (true) {
+      char seg[16];
+      std::snprintf(seg, sizeof(seg), "[%zu]", v.items.size());
+      path_.emplace_back(seg);
       v.items.push_back(parseValue());
+      path_.pop_back();
       if (consume(']')) {
         return v;
       }
@@ -255,8 +265,15 @@ class JsonReader {
     }
     while (true) {
       std::string key = parseString();
+      if (rejectDuplicateKeys_ && v.field(key) != nullptr) {
+        TC_THROW(EnforceError, what_, ": duplicate key \"", pathTo(key),
+                 "\" at byte ", pos_);
+      }
       expect(':');
-      v.fields.emplace_back(std::move(key), parseValue());
+      path_.push_back(key);
+      Value parsed = parseValue();
+      path_.pop_back();
+      v.fields.emplace_back(std::move(key), std::move(parsed));
       if (consume('}')) {
         return v;
       }
@@ -264,9 +281,27 @@ class JsonReader {
     }
   }
 
+  // Dotted key path for error messages: "schedules[2].steps[0].op".
+  std::string pathTo(const std::string& leaf) const {
+    std::string out;
+    for (const std::string& seg : path_) {
+      if (!out.empty() && seg[0] != '[') {
+        out += '.';
+      }
+      out += seg;
+    }
+    if (!out.empty()) {
+      out += '.';
+    }
+    out += leaf;
+    return out;
+  }
+
   const std::string& text_;
   const char* what_;
+  const bool rejectDuplicateKeys_;
   size_t pos_ = 0;
+  std::vector<std::string> path_;
 };
 
 // Escaped JSON string literal writer (the serialization counterpart).

@@ -9,6 +9,7 @@
 #include "tpucoll/common/env.h"
 #include "tpucoll/common/fleetobs.h"
 #include "tpucoll/fault/fault.h"
+#include "tpucoll/schedule/interpreter.h"
 #include "tpucoll/tuning/tuning_table.h"
 #include "tpucoll/types.h"
 
@@ -173,6 +174,7 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   // transport hints (channel count / stripe threshold) configure the
   // mesh being created, not just the next fork.
   maybeLoadTuningFile();
+  maybeLoadScheduleFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->setFaultDomain(faultDomain_);
@@ -194,6 +196,7 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
   FlightRecorder::maybeInstallFromEnv();
   MetricsOp mop(&metrics_, MetricOp::kConnect, 0);
   maybeLoadTuningFile();
+  maybeLoadScheduleFile();
   tctx_ = std::make_unique<transport::Context>(device_, rank_, size_);
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->setFaultDomain(faultDomain_);
@@ -265,6 +268,33 @@ std::shared_ptr<const tuning::TuningTable> Context::tuningTable() const {
   return tuningTable_;
 }
 
+void Context::setScheduleTable(
+    std::shared_ptr<const schedule::ScheduleTable> table) {
+  // Verify + resolve BEFORE swapping: an invalid schedule throws here
+  // and the previously installed plane stays in force untouched.
+  std::shared_ptr<const schedule::InstalledSchedules> inst;
+  if (table != nullptr) {
+    inst = schedule::installSchedules(std::move(table), rank_, size_);
+  }
+  {
+    std::lock_guard<std::mutex> guard(schedMu_);
+    schedules_ = std::move(inst);
+  }
+  // Cached plans embed the resolved dispatch (an elected schedule keys
+  // plans under its name hash); install/clear makes every plan stale.
+  // (Outside schedMu_: clear() drains buffers and must not nest under
+  // the dispatch-path lock.)
+  if (planCache_ != nullptr) {
+    planCache_->clear();
+  }
+}
+
+std::shared_ptr<const schedule::InstalledSchedules> Context::schedules()
+    const {
+  std::lock_guard<std::mutex> guard(schedMu_);
+  return schedules_;
+}
+
 // Feed an installed tuning table's transport hints (tuned channel count
 // and stripe threshold) to the transport context about to connect. The
 // env knobs win inside setChannelConfig, so an operator override is
@@ -291,6 +321,19 @@ void Context::maybeLoadTuningFile() {
   buf << in.rdbuf();
   setTuningTable(std::make_shared<const tuning::TuningTable>(
       tuning::TuningTable::fromJson(buf.str())));
+}
+
+void Context::maybeLoadScheduleFile() {
+  const char* path = envString("TPUCOLL_SCHEDULE_FILE");
+  if (path == nullptr) {
+    return;
+  }
+  std::ifstream in(path, std::ios::binary);
+  TC_ENFORCE(in.good(), "TPUCOLL_SCHEDULE_FILE: cannot read ", path);
+  std::ostringstream buf;
+  buf << in.rdbuf();
+  setScheduleTable(std::make_shared<const schedule::ScheduleTable>(
+      schedule::ScheduleTable::fromJson(buf.str())));
 }
 
 uint64_t Context::nextSlot(uint32_t numToSkip) {

@@ -28,6 +28,11 @@ namespace tuning {
 class TuningTable;
 }  // namespace tuning
 
+namespace schedule {
+class ScheduleTable;
+struct InstalledSchedules;
+}  // namespace schedule
+
 namespace plan {
 class PlanCache;
 }  // namespace plan
@@ -257,6 +262,18 @@ class Context {
   void setTuningTable(std::shared_ptr<const tuning::TuningTable> table);
   std::shared_ptr<const tuning::TuningTable> tuningTable() const;
 
+  // ---- collective schedule plane (schedule/ir.h) ----
+  // Install a schedule table: every schedule matching this context's
+  // world size is statically VERIFIED (schedule/verifier.h — installing
+  // an incorrect schedule throws, nothing is swapped) and resolved for
+  // this rank; elected cells then take precedence over every other
+  // kAuto dispatch tier. Null clears. Same all-ranks-identical contract
+  // as the tuning table, and the same invalidation: cached plans embed
+  // the resolved dispatch, so install/clear drops every plan.
+  void setScheduleTable(std::shared_ptr<const schedule::ScheduleTable> table);
+  // The installed (verified + resolved) plane; null when none.
+  std::shared_ptr<const schedule::InstalledSchedules> schedules() const;
+
   // Monotonic generation counter namespacing each tune() election's
   // store keys. All ranks call tune() the same number of times (it is a
   // collective), so the generation agrees without store traffic.
@@ -305,6 +322,10 @@ class Context {
   // throw (never silently run untuned against an operator's explicit
   // instruction).
   void maybeLoadTuningFile();
+  // TPUCOLL_SCHEDULE_FILE hook: load + verify + install a serialized
+  // schedule table at connect/fork. Malformed or unverifiable files
+  // throw loudly (never silently drop an operator's elected schedules).
+  void maybeLoadScheduleFile();
   // Hand an installed table's tuned channel/stripe knobs to tctx_
   // before it connects (env still wins; see transport::Context::
   // setChannelConfig).
@@ -320,6 +341,8 @@ class Context {
   std::atomic<uint64_t> tuneGen_{0};
   mutable std::mutex tuningMu_;
   std::shared_ptr<const tuning::TuningTable> tuningTable_;
+  mutable std::mutex schedMu_;
+  std::shared_ptr<const schedule::InstalledSchedules> schedules_;
   mutable std::mutex topoMu_;
   std::shared_ptr<const Topology> topology_;
   std::mutex splitGenMu_;

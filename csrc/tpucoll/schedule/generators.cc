@@ -1,0 +1,740 @@
+#include "tpucoll/schedule/generators.h"
+
+#include <algorithm>
+#include <utility>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace schedule {
+
+namespace {
+
+using E = RankExpr;
+
+int32_t push(Schedule& s, Step st) {
+  s.steps.push_back(std::move(st));
+  return static_cast<int32_t>(s.steps.size() - 1);
+}
+
+std::string tag(const char* base, int t, int j) {
+  return std::string(base) + "_" + std::to_string(t) + "_" + std::to_string(j);
+}
+
+bool isPow2(int n) { return n >= 1 && (n & (n - 1)) == 0; }
+
+std::vector<int> primeFactors(int n) {
+  std::vector<int> factors;
+  for (int p = 2; p * p <= n; p++) {
+    while (n % p == 0) {
+      factors.push_back(p);
+      n /= p;
+    }
+  }
+  if (n > 1) {
+    factors.push_back(n);
+  }
+  return factors;
+}
+
+// --- ring (allreduce, pipeline depth k) --------------------------------
+//
+// Chunk (a, j) = segment owned by rank a, sub-chunk j: id = a * k + j.
+// The k sub-streams pipeline independently; within one, the classic
+// two-deep slot rotation (fold round t - 2 before reusing its slot).
+Schedule ringAllreduce(int world, int depth) {
+  TC_ENFORCE(depth >= 1 && depth <= 64, "ring: depth must be in [1, 64]");
+  Schedule s;
+  s.name = "ring_p" + std::to_string(world) +
+           (depth > 1 ? "_k" + std::to_string(depth) : "");
+  s.collective = Collective::kAllreduce;
+  s.worldSize = world;
+  s.nChunks = world * depth;
+  const int rounds = world - 1;
+  const int par = std::min(2, rounds);
+  s.nScratch = par * depth;
+  if (world == 1) {
+    return s;
+  }
+  std::vector<std::vector<int32_t>> sId(rounds, std::vector<int32_t>(depth));
+  std::vector<std::vector<int32_t>> rrId(rounds, std::vector<int32_t>(depth));
+  std::vector<std::vector<int32_t>> agR(rounds, std::vector<int32_t>(depth));
+  for (int t = 0; t < rounds; t++) {
+    for (int j = 0; j < depth; j++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.peer = E::ring(1);
+      snd.chunk = E::ring(-t, depth, j);
+      if (t > 0) {
+        snd.deps = {rrId[t - 1][j]};
+      }
+      snd.note = tag("rs_s", t, j);
+      sId[t][j] = push(s, std::move(snd));
+
+      Step rr;
+      rr.op = StepOp::kRecvReduce;
+      rr.peer = E::ring(-1);
+      rr.chunk = E::ring(-1 - t, depth, j);
+      rr.slot = E::constant((t % par) * depth + j);
+      if (t >= 2) {
+        rr.deps = {rrId[t - 2][j]};
+      }
+      rr.note = tag("rs_rr", t, j);
+      rrId[t][j] = push(s, std::move(rr));
+    }
+  }
+  for (int t = 0; t < rounds; t++) {
+    for (int j = 0; j < depth; j++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.peer = E::ring(1);
+      snd.chunk = E::ring(1 - t, depth, j);
+      snd.deps = {t == 0 ? rrId[rounds - 1][j] : agR[t - 1][j]};
+      snd.note = tag("ag_s", t, j);
+      push(s, std::move(snd));
+
+      Step rcv;
+      rcv.op = StepOp::kRecv;
+      rcv.peer = E::ring(-1);
+      rcv.chunk = E::ring(-t, depth, j);
+      // Drain the reduce-scatter send that read this chunk before the
+      // gathered copy overwrites it in place.
+      rcv.deps = {sId[t][j]};
+      rcv.note = tag("ag_r", t, j);
+      agR[t][j] = push(s, std::move(rcv));
+    }
+  }
+  return s;
+}
+
+// --- ring_rs (reduce-scatter) ------------------------------------------
+//
+// Shifted by one versus the allreduce phase so rank r finishes holding
+// chunk r (the standalone contract): round t sends chunk r - 1 - t,
+// folds chunk r - 2 - t; the final fold lands on chunk r.
+Schedule ringReduceScatter(int world) {
+  Schedule s;
+  s.name = "ring_rs_p" + std::to_string(world);
+  s.collective = Collective::kReduceScatter;
+  s.worldSize = world;
+  s.nChunks = world;
+  const int rounds = world - 1;
+  const int par = std::min(2, rounds);
+  s.nScratch = par;
+  if (world == 1) {
+    return s;
+  }
+  std::vector<int32_t> rrId(rounds);
+  for (int t = 0; t < rounds; t++) {
+    Step snd;
+    snd.op = StepOp::kSend;
+    snd.peer = E::ring(1);
+    snd.chunk = E::ring(-1 - t);
+    if (t > 0) {
+      snd.deps = {rrId[t - 1]};
+    }
+    snd.note = tag("rs_s", t, 0);
+    push(s, std::move(snd));
+
+    Step rr;
+    rr.op = StepOp::kRecvReduce;
+    rr.peer = E::ring(-1);
+    rr.chunk = E::ring(-2 - t);
+    rr.slot = E::constant(t % par);
+    if (t >= 2) {
+      rr.deps = {rrId[t - 2]};
+    }
+    rr.note = tag("rs_rr", t, 0);
+    rrId[t] = push(s, std::move(rr));
+  }
+  return s;
+}
+
+// --- ring_ag (allgather) -----------------------------------------------
+Schedule ringAllgather(int world) {
+  Schedule s;
+  s.name = "ring_ag_p" + std::to_string(world);
+  s.collective = Collective::kAllgather;
+  s.worldSize = world;
+  s.nChunks = world;
+  s.nScratch = 0;
+  if (world == 1) {
+    return s;
+  }
+  const int rounds = world - 1;
+  std::vector<int32_t> agR(rounds);
+  for (int t = 0; t < rounds; t++) {
+    Step snd;
+    snd.op = StepOp::kSend;
+    snd.peer = E::ring(1);
+    snd.chunk = E::ring(-t);
+    if (t > 0) {
+      snd.deps = {agR[t - 1]};
+    }
+    snd.note = tag("ag_s", t, 0);
+    push(s, std::move(snd));
+
+    Step rcv;
+    rcv.op = StepOp::kRecv;
+    rcv.peer = E::ring(-1);
+    rcv.chunk = E::ring(-1 - t);
+    rcv.note = tag("ag_r", t, 0);
+    agR[t] = push(s, std::move(rcv));
+  }
+  return s;
+}
+
+// --- hd family (power-of-two halving-doubling) -------------------------
+//
+// Per stage, per rank: window = the blockSize chunks sharing the rank's
+// high bits; the half containing the rank's own index is kept (so rank
+// r finishes the reduce-scatter owning chunk r), the other half is
+// sent. Chunk ids are rank-dependent -> table expressions. Stages are
+// fully barriered: every stage-s step depends on all stage-(s-1) steps,
+// exactly the native phase structure.
+enum class HdPhase { kReduceScatter, kAllgather, kBoth };
+
+Schedule hdSchedule(int world, HdPhase phase) {
+  TC_ENFORCE(isPow2(world), "hd: world must be a power of two, got ", world);
+  Schedule s;
+  s.worldSize = world;
+  s.nChunks = world;
+  s.nScratch = phase == HdPhase::kAllgather ? 0 : world / 2;
+  switch (phase) {
+    case HdPhase::kReduceScatter:
+      s.name = "hd_rs_p" + std::to_string(world);
+      s.collective = Collective::kReduceScatter;
+      break;
+    case HdPhase::kAllgather:
+      s.name = "hd_ag_p" + std::to_string(world);
+      s.collective = Collective::kAllgather;
+      break;
+    case HdPhase::kBoth:
+      s.name = "hd_p" + std::to_string(world);
+      s.collective = Collective::kAllreduce;
+      break;
+  }
+  if (world == 1) {
+    return s;
+  }
+  int numStages = 0;
+  while ((1 << numStages) < world) {
+    numStages++;
+  }
+  auto windows = [&](int stage, std::vector<int64_t>* kept,
+                     std::vector<int64_t>* sent, int i) {
+    const int blockSize = world >> stage;
+    const int dist = blockSize / 2;
+    for (int r = 0; r < world; r++) {
+      const int winStart = r & ~(blockSize - 1);
+      const bool upper = (r & dist) != 0;
+      (*kept)[r] = winStart + (upper ? dist : 0) + i;
+      (*sent)[r] = winStart + (upper ? 0 : dist) + i;
+    }
+  };
+  std::vector<int32_t> prev;
+  if (phase != HdPhase::kAllgather) {
+    for (int stage = 0; stage < numStages; stage++) {
+      const int dist = (world >> stage) / 2;
+      std::vector<int32_t> cur;
+      for (int i = 0; i < dist; i++) {
+        std::vector<int64_t> kept(world), sent(world);
+        windows(stage, &kept, &sent, i);
+        Step snd;
+        snd.op = StepOp::kSend;
+        snd.peer = E::xorOf(dist);
+        snd.chunk = E::tableOf(sent);
+        snd.deps = prev;
+        snd.note = tag("rs_s", stage, i);
+        cur.push_back(push(s, std::move(snd)));
+
+        Step rr;
+        rr.op = StepOp::kRecvReduce;
+        rr.peer = E::xorOf(dist);
+        rr.chunk = E::tableOf(kept);
+        rr.slot = E::constant(i);
+        rr.deps = prev;
+        rr.note = tag("rs_rr", stage, i);
+        cur.push_back(push(s, std::move(rr)));
+      }
+      prev = cur;
+    }
+  }
+  if (phase != HdPhase::kReduceScatter) {
+    for (int stage = numStages - 1; stage >= 0; stage--) {
+      const int dist = (world >> stage) / 2;
+      std::vector<int32_t> cur;
+      for (int i = 0; i < dist; i++) {
+        std::vector<int64_t> kept(world), sent(world);
+        windows(stage, &kept, &sent, i);
+        Step snd;
+        snd.op = StepOp::kSend;
+        snd.peer = E::xorOf(dist);
+        snd.chunk = E::tableOf(kept);
+        snd.deps = prev;
+        snd.note = tag("ag_s", stage, i);
+        cur.push_back(push(s, std::move(snd)));
+
+        Step rcv;
+        rcv.op = StepOp::kRecv;
+        rcv.peer = E::xorOf(dist);
+        rcv.chunk = E::tableOf(sent);
+        rcv.deps = prev;
+        rcv.note = tag("ag_r", stage, i);
+        cur.push_back(push(s, std::move(rcv)));
+      }
+      prev = cur;
+    }
+  }
+  return s;
+}
+
+// --- bcube (mixed-radix grouped hypercube allreduce) -------------------
+//
+// Stage st: ranks sharing all mixed-radix digits except digit st form a
+// group of g = radices[st]; the window splits into g parts, part j goes
+// to the member whose digit is j, contributions fold into the kept
+// part. Guards deactivate the self-directed (j == own digit) steps; the
+// allgather phase replays the stages in reverse with plain receives.
+Schedule bcubeAllreduce(int world) {
+  Schedule s;
+  s.name = "bcube_p" + std::to_string(world);
+  s.collective = Collective::kAllreduce;
+  s.worldSize = world;
+  s.nChunks = world;
+  s.nScratch = world > 1 ? world : 0;
+  if (world == 1) {
+    return s;
+  }
+  const std::vector<int> radices = primeFactors(world);
+  const int numStages = static_cast<int>(radices.size());
+  std::vector<int> stride(numStages);
+  stride[0] = 1;
+  for (int st = 1; st < numStages; st++) {
+    stride[st] = stride[st - 1] * radices[st - 1];
+  }
+  // Per-stage window geometry: winCount is rank-independent, winStart
+  // per rank; saved per stage so the allgather phase can replay it.
+  std::vector<std::vector<int>> winStartAt(numStages + 1,
+                                           std::vector<int>(world, 0));
+  std::vector<int> winCountAt(numStages + 1, world);
+  for (int st = 0; st < numStages; st++) {
+    const int g = radices[st];
+    const int part = winCountAt[st] / g;
+    for (int r = 0; r < world; r++) {
+      const int digit = (r / stride[st]) % g;
+      winStartAt[st + 1][r] = winStartAt[st][r] + digit * part;
+    }
+    winCountAt[st + 1] = part;
+  }
+  auto stageTables = [&](int st, int j, int i, std::vector<int64_t>* guard,
+                         std::vector<int64_t>* peer,
+                         std::vector<int64_t>* partChunk,
+                         std::vector<int64_t>* myChunk) {
+    const int g = radices[st];
+    const int part = winCountAt[st] / g;
+    for (int r = 0; r < world; r++) {
+      const int digit = (r / stride[st]) % g;
+      (*guard)[r] = digit == j ? 0 : 1;
+      (*peer)[r] = digit == j ? (r + 1) % world : r + (j - digit) * stride[st];
+      (*partChunk)[r] = winStartAt[st][r] + j * part + i;
+      (*myChunk)[r] = winStartAt[st][r] + digit * part + i;
+    }
+  };
+  std::vector<int32_t> prev;
+  for (int st = 0; st < numStages; st++) {
+    const int g = radices[st];
+    const int part = winCountAt[st] / g;
+    std::vector<int32_t> cur;
+    for (int j = 0; j < g; j++) {
+      for (int i = 0; i < part; i++) {
+        std::vector<int64_t> guard(world), peer(world), partChunk(world),
+            myChunk(world);
+        stageTables(st, j, i, &guard, &peer, &partChunk, &myChunk);
+        Step snd;
+        snd.op = StepOp::kSend;
+        snd.guard = E::tableOf(guard);
+        snd.peer = E::tableOf(peer);
+        snd.chunk = E::tableOf(partChunk);
+        snd.deps = prev;
+        snd.note = tag("rs_s", st, j * part + i);
+        cur.push_back(push(s, std::move(snd)));
+
+        Step rr;
+        rr.op = StepOp::kRecvReduce;
+        rr.guard = E::tableOf(guard);
+        rr.peer = E::tableOf(peer);
+        rr.chunk = E::tableOf(myChunk);
+        rr.slot = E::constant(j * part + i);
+        rr.deps = prev;
+        rr.note = tag("rs_rr", st, j * part + i);
+        cur.push_back(push(s, std::move(rr)));
+      }
+    }
+    prev = cur;
+  }
+  for (int st = numStages - 1; st >= 0; st--) {
+    const int g = radices[st];
+    const int part = winCountAt[st] / g;
+    std::vector<int32_t> cur;
+    for (int j = 0; j < g; j++) {
+      for (int i = 0; i < part; i++) {
+        std::vector<int64_t> guard(world), peer(world), partChunk(world),
+            myChunk(world);
+        stageTables(st, j, i, &guard, &peer, &partChunk, &myChunk);
+        Step snd;
+        snd.op = StepOp::kSend;
+        snd.guard = E::tableOf(guard);
+        snd.peer = E::tableOf(peer);
+        snd.chunk = E::tableOf(myChunk);
+        snd.deps = prev;
+        snd.note = tag("ag_s", st, j * part + i);
+        cur.push_back(push(s, std::move(snd)));
+
+        Step rcv;
+        rcv.op = StepOp::kRecv;
+        rcv.guard = E::tableOf(guard);
+        rcv.peer = E::tableOf(peer);
+        rcv.chunk = E::tableOf(partChunk);
+        rcv.deps = prev;
+        rcv.note = tag("ag_r", st, j * part + i);
+        cur.push_back(push(s, std::move(rcv)));
+      }
+    }
+    prev = cur;
+  }
+  return s;
+}
+
+// --- ring_bf16 (coded-wire ring allreduce) -----------------------------
+//
+// Each hop encodes the outgoing chunk to bf16 in a scratch slot, sends
+// the coded bytes, receives coded bytes into another slot, saves the
+// local partial, decodes the arrival over the chunk and folds the saved
+// partial back — recv_reduce cannot fold coded bytes, so the codec is
+// explicit IR. Slots rotate two-deep per role (encode/recv/save).
+Schedule ringBf16Allreduce(int world) {
+  Schedule s;
+  s.name = "ring_bf16_p" + std::to_string(world);
+  s.collective = Collective::kAllreduce;
+  s.worldSize = world;
+  s.nChunks = world;
+  const int rounds = world - 1;
+  const int par = std::min(2, rounds);
+  s.nScratch = 3 * par;
+  if (world == 1) {
+    return s;
+  }
+  // Global round u: reduce-scatter rounds [0, rounds), allgather rounds
+  // [rounds, 2 * rounds). Per-u ids for the slot-rotation deps.
+  std::vector<int32_t> sndId(2 * rounds), rcvId(2 * rounds),
+      doneId(2 * rounds);
+  auto slotE = [&](int u) { return E::constant(u % par); };
+  auto slotR = [&](int u) { return E::constant(par + u % par); };
+  for (int t = 0; t < rounds; t++) {
+    const int u = t;
+    Step enc;
+    enc.op = StepOp::kEncode;
+    enc.chunk = E::ring(-t);
+    enc.slot = slotE(u);
+    if (t > 0) {
+      enc.deps.push_back(doneId[u - 1]);  // chunk r-t finalized last round
+    }
+    if (u >= par) {
+      enc.deps.push_back(sndId[u - par]);  // drain the slot's last send
+    }
+    enc.note = tag("rs_e", t, 0);
+    const int32_t encId = push(s, std::move(enc));
+
+    Step snd;
+    snd.op = StepOp::kSend;
+    snd.flags = Step::kFlagCoded;
+    snd.peer = E::ring(1);
+    snd.chunk = E::ring(-t);
+    snd.slot = slotE(u);
+    snd.deps = {encId};
+    snd.note = tag("rs_s", t, 0);
+    sndId[u] = push(s, std::move(snd));
+
+    Step rcv;
+    rcv.op = StepOp::kRecv;
+    rcv.flags = Step::kFlagCoded;
+    rcv.peer = E::ring(-1);
+    rcv.chunk = E::ring(-1 - t);
+    rcv.slot = slotR(u);
+    if (u >= par) {
+      rcv.deps = {doneId[u - par]};  // the slot's last decode consumed it
+    }
+    rcv.note = tag("rs_r", t, 0);
+    rcvId[u] = push(s, std::move(rcv));
+
+    Step save;
+    save.op = StepOp::kCopy;
+    save.flags = Step::kFlagToSlot;
+    save.chunk = E::ring(-1 - t);
+    save.slot = E::constant(2 * par + u % par);
+    save.note = tag("rs_save", t, 0);
+    const int32_t saveId = push(s, std::move(save));
+
+    Step dec;
+    dec.op = StepOp::kDecode;
+    dec.chunk = E::ring(-1 - t);
+    dec.slot = slotR(u);
+    dec.deps = {rcvId[u], saveId};
+    dec.note = tag("rs_d", t, 0);
+    const int32_t decId = push(s, std::move(dec));
+
+    Step fold;
+    fold.op = StepOp::kReduceLocal;
+    fold.chunk = E::ring(-1 - t);
+    fold.slot = E::constant(2 * par + u % par);
+    fold.deps = {decId};
+    fold.note = tag("rs_rl", t, 0);
+    doneId[u] = push(s, std::move(fold));
+  }
+  for (int t = 0; t < rounds; t++) {
+    const int u = rounds + t;
+    Step enc;
+    enc.op = StepOp::kEncode;
+    enc.chunk = E::ring(1 - t);
+    enc.slot = slotE(u);
+    enc.deps = {doneId[u - 1], sndId[u - par]};
+    enc.note = tag("ag_e", t, 0);
+    const int32_t encId = push(s, std::move(enc));
+
+    Step snd;
+    snd.op = StepOp::kSend;
+    snd.flags = Step::kFlagCoded;
+    snd.peer = E::ring(1);
+    snd.chunk = E::ring(1 - t);
+    snd.slot = slotE(u);
+    snd.deps = {encId};
+    snd.note = tag("ag_s", t, 0);
+    sndId[u] = push(s, std::move(snd));
+
+    Step rcv;
+    rcv.op = StepOp::kRecv;
+    rcv.flags = Step::kFlagCoded;
+    rcv.peer = E::ring(-1);
+    rcv.chunk = E::ring(-t);
+    rcv.slot = slotR(u);
+    rcv.deps = {doneId[u - par]};
+    rcv.note = tag("ag_r", t, 0);
+    rcvId[u] = push(s, std::move(rcv));
+
+    Step dec;
+    dec.op = StepOp::kDecode;
+    dec.chunk = E::ring(-t);
+    dec.slot = slotR(u);
+    dec.deps = {rcvId[u]};
+    dec.note = tag("ag_d", t, 0);
+    doneId[u] = push(s, std::move(dec));
+  }
+  return s;
+}
+
+// --- hier (2-level hierarchy allreduce) --------------------------------
+//
+// P = L hosts x h ranks. Members push every chunk to their host leader
+// (fold on arrival), the L leaders ring-allreduce the host sums, then
+// fan the result back out. Guards split the one program into leader and
+// member roles; nChunks = L so the leader ring is chunk-balanced.
+Schedule hierAllreduce(int world, int ranksPerHost) {
+  TC_ENFORCE(ranksPerHost >= 1 && world % ranksPerHost == 0,
+             "hier: ranks_per_host (", ranksPerHost, ") must divide world (",
+             world, ")");
+  const int h = ranksPerHost;
+  const int hosts = world / h;
+  Schedule s;
+  s.name = "hier_p" + std::to_string(world) + "_h" + std::to_string(h);
+  s.collective = Collective::kAllreduce;
+  s.worldSize = world;
+  s.nChunks = hosts;
+  const int ringRounds = hosts - 1;
+  const int ringPar = std::min(2, std::max(ringRounds, 0));
+  s.nScratch = (h - 1) * hosts + ringPar;
+  if (world == 1) {
+    return s;
+  }
+  std::vector<int64_t> leaderGuard(world), nextLeader(world),
+      prevLeader(world);
+  for (int r = 0; r < world; r++) {
+    const bool leader = r % h == 0;
+    leaderGuard[r] = leader ? 1 : 0;
+    const int l = r / h;
+    nextLeader[r] = leader ? ((l + 1) % hosts) * h : (r + 1) % world;
+    prevLeader[r] = leader ? ((l - 1 + hosts) % hosts) * h : (r + 1) % world;
+  }
+  std::vector<int32_t> phase1;
+  std::vector<std::vector<int32_t>> upSend(h, std::vector<int32_t>(hosts));
+  for (int m = 1; m < h; m++) {
+    std::vector<int64_t> memberGuard(world);
+    for (int r = 0; r < world; r++) {
+      memberGuard[r] = r % h == m ? 1 : 0;
+    }
+    for (int c = 0; c < hosts; c++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.guard = E::tableOf(memberGuard);
+      snd.peer = E::ring(-m);
+      snd.chunk = E::constant(c);
+      snd.note = tag("up_s", m, c);
+      upSend[m][c] = push(s, std::move(snd));
+      phase1.push_back(upSend[m][c]);
+
+      Step rr;
+      rr.op = StepOp::kRecvReduce;
+      rr.guard = E::tableOf(leaderGuard);
+      rr.peer = E::ring(m);
+      rr.chunk = E::constant(c);
+      rr.slot = E::constant((m - 1) * hosts + c);
+      rr.note = tag("up_rr", m, c);
+      phase1.push_back(push(s, std::move(rr)));
+    }
+  }
+  // Leader ring allreduce over the host sums (shift +1: leader l ends
+  // holding chunk l + 1 reduced, then gathers the rest).
+  std::vector<int32_t> phase2 = phase1;
+  if (hosts > 1) {
+    std::vector<int32_t> lsId(ringRounds), lrrId(ringRounds),
+        lagR(ringRounds);
+    auto leaderChunk = [&](int shift) {
+      std::vector<int64_t> t(world);
+      for (int r = 0; r < world; r++) {
+        t[r] = r % h == 0 ? ((r / h + shift) % hosts + hosts) % hosts : 0;
+      }
+      return E::tableOf(std::move(t));
+    };
+    for (int t = 0; t < ringRounds; t++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.guard = E::tableOf(leaderGuard);
+      snd.peer = E::tableOf(nextLeader);
+      snd.chunk = leaderChunk(-t);
+      snd.deps = t == 0 ? phase1 : std::vector<int32_t>{lrrId[t - 1]};
+      snd.note = tag("lr_s", t, 0);
+      lsId[t] = push(s, std::move(snd));
+
+      Step rr;
+      rr.op = StepOp::kRecvReduce;
+      rr.guard = E::tableOf(leaderGuard);
+      rr.peer = E::tableOf(prevLeader);
+      rr.chunk = leaderChunk(-1 - t);
+      rr.slot = E::constant((h - 1) * hosts + t % ringPar);
+      // t >= 2: slot reuse (ringPar rotation). t < 2: anchor on the
+      // phase-1 folds so every later ring step (they all chain through
+      // lrrId) has a dependency path back to the host-local
+      // recv_reduces — round t's send ships chunk (l - t), which must
+      // already hold this host's member contributions.
+      rr.deps = t >= 2 ? std::vector<int32_t>{lrrId[t - 2]} : phase1;
+      rr.note = tag("lr_rr", t, 0);
+      lrrId[t] = push(s, std::move(rr));
+    }
+    for (int t = 0; t < ringRounds; t++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.guard = E::tableOf(leaderGuard);
+      snd.peer = E::tableOf(nextLeader);
+      snd.chunk = leaderChunk(1 - t);
+      snd.deps = {t == 0 ? lrrId[ringRounds - 1] : lagR[t - 1]};
+      snd.note = tag("lg_s", t, 0);
+      push(s, std::move(snd));
+
+      Step rcv;
+      rcv.op = StepOp::kRecv;
+      rcv.guard = E::tableOf(leaderGuard);
+      rcv.peer = E::tableOf(prevLeader);
+      rcv.chunk = leaderChunk(-t);
+      rcv.deps = {lsId[t]};
+      rcv.note = tag("lg_r", t, 0);
+      lagR[t] = push(s, std::move(rcv));
+    }
+    phase2.clear();
+    for (int t = 0; t < ringRounds; t++) {
+      phase2.push_back(lsId[t]);
+      phase2.push_back(lrrId[t]);
+      phase2.push_back(lagR[t]);
+    }
+  }
+  for (int m = 1; m < h; m++) {
+    std::vector<int64_t> memberGuard(world);
+    for (int r = 0; r < world; r++) {
+      memberGuard[r] = r % h == m ? 1 : 0;
+    }
+    for (int c = 0; c < hosts; c++) {
+      Step snd;
+      snd.op = StepOp::kSend;
+      snd.guard = E::tableOf(leaderGuard);
+      snd.peer = E::ring(m);
+      snd.chunk = E::constant(c);
+      snd.deps = phase2;
+      snd.note = tag("down_s", m, c);
+      push(s, std::move(snd));
+
+      Step rcv;
+      rcv.op = StepOp::kRecv;
+      rcv.guard = E::tableOf(memberGuard);
+      rcv.peer = E::ring(-m);
+      rcv.chunk = E::constant(c);
+      // Drain the member's own upward send before the reduced copy
+      // overwrites the chunk in place.
+      rcv.deps = {upSend[m][c]};
+      rcv.note = tag("down_r", m, c);
+      push(s, std::move(rcv));
+    }
+  }
+  return s;
+}
+
+int param(const std::map<std::string, int>& params, const std::string& name,
+          int fallback, std::vector<std::string>* known) {
+  known->push_back(name);
+  auto it = params.find(name);
+  return it == params.end() ? fallback : it->second;
+}
+
+}  // namespace
+
+Schedule generate(const std::string& family, int worldSize,
+                  const std::map<std::string, int>& params) {
+  TC_ENFORCE(worldSize >= 1 && worldSize <= 64,
+             "schedule generators support worlds in [1, 64], got ", worldSize);
+  std::vector<std::string> known;
+  Schedule s;
+  if (family == "ring") {
+    s = ringAllreduce(worldSize, param(params, "depth", 1, &known));
+  } else if (family == "ring_rs") {
+    s = ringReduceScatter(worldSize);
+  } else if (family == "ring_ag") {
+    s = ringAllgather(worldSize);
+  } else if (family == "hd") {
+    s = hdSchedule(worldSize, HdPhase::kBoth);
+  } else if (family == "hd_rs") {
+    s = hdSchedule(worldSize, HdPhase::kReduceScatter);
+  } else if (family == "hd_ag") {
+    s = hdSchedule(worldSize, HdPhase::kAllgather);
+  } else if (family == "bcube") {
+    s = bcubeAllreduce(worldSize);
+  } else if (family == "ring_bf16") {
+    s = ringBf16Allreduce(worldSize);
+  } else if (family == "hier") {
+    s = hierAllreduce(worldSize,
+                      param(params, "ranks_per_host", 1, &known));
+  } else {
+    TC_THROW(EnforceError, "unknown schedule family \"", family, "\"");
+  }
+  for (const auto& kv : params) {
+    TC_ENFORCE(std::find(known.begin(), known.end(), kv.first) != known.end(),
+               "schedule family \"", family, "\" has no param \"", kv.first,
+               "\"");
+  }
+  return s;
+}
+
+std::vector<std::string> generatorFamilies() {
+  return {"ring",  "ring_rs",   "ring_ag", "hd",  "hd_rs",
+          "hd_ag", "bcube",     "ring_bf16", "hier"};
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

@@ -1,0 +1,52 @@
+// Schedule generators: the native algorithm families — and families no
+// enum entry can express — emitted as schedule IR (ir.h).
+//
+// Families (parameters via generate()'s params map; see
+// docs/schedules.md):
+//
+//   ring       allreduce ring. Param "depth" (>= 1, default 1): depth k
+//              splits each of the P rank segments into k sub-chunks
+//              pipelined independently — k in-flight messages per
+//              direction instead of one, hiding per-hop latency on
+//              large payloads. k = 1 reproduces the native ring
+//              byte-for-byte.
+//   ring_rs    reduce-scatter ring (rank r ends owning block r).
+//   ring_ag    allgather ring.
+//   hd         allreduce halving-doubling (power-of-two worlds).
+//   hd_rs      reduce-scatter recursive halving (power-of-two worlds).
+//   hd_ag      allgather recursive doubling (power-of-two worlds).
+//   bcube      allreduce mixed-radix bcube (prime-factor stages, the
+//              native generalization).
+//   ring_bf16  allreduce ring with bf16-coded wire (encode/decode
+//              steps; float32 payloads, lossy-wire opt-in only).
+//   hier       allreduce 2-level hierarchy. Param "ranks_per_host"
+//              (must divide world): members send chunks to their host
+//              leader, leaders ring-allreduce, leaders fan out — two
+//              wire hops over the slow tier instead of P - 1.
+//
+// Every generated schedule passes the verifier by construction; tests
+// assert it, and the equivalence suite proves the native-family outputs
+// byte-identical to the hardcoded algorithms.
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "tpucoll/schedule/ir.h"
+
+namespace tpucoll {
+namespace schedule {
+
+// Generate family `family` for `worldSize` ranks. Unknown families,
+// unknown or out-of-range params, and family/world mismatches (hd on a
+// non-power-of-two world, hier with ranks_per_host not dividing world)
+// throw EnforceError.
+Schedule generate(const std::string& family, int worldSize,
+                  const std::map<std::string, int>& params = {});
+
+// All family names, in a stable order (sweep + describe listings).
+std::vector<std::string> generatorFamilies();
+
+}  // namespace schedule
+}  // namespace tpucoll

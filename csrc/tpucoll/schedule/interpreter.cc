@@ -1,0 +1,403 @@
+#include "tpucoll/schedule/interpreter.h"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <utility>
+
+#include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/common/profile.h"
+#include "tpucoll/context.h"
+#include "tpucoll/schedule/verifier.h"
+#include "tpucoll/transport/unbound_buffer.h"
+
+namespace tpucoll {
+namespace schedule {
+
+using collectives_detail::evenBlocks;
+using profile::Phase;
+using profile::PhaseScope;
+
+namespace {
+
+bool isWire(StepOp op) {
+  return op == StepOp::kSend || op == StepOp::kRecv ||
+         op == StepOp::kRecvReduce;
+}
+
+bool isRecvKind(StepOp op) {
+  return op == StepOp::kRecv || op == StepOp::kRecvReduce;
+}
+
+// Bookkeeping step flags, kept in plan scratch.
+constexpr uint8_t kArrived = 1;  // wire completion observed
+constexpr uint8_t kDone = 2;     // arrival effect (fold) applied
+
+size_t align4(size_t n) { return (n + 3) & ~size_t(3); }
+
+}  // namespace
+
+size_t ResolvedProgram::stateBytes() const {
+  // [per-step flags][queue heads: 2 buffers x world][outstanding sends x 2]
+  return align4(steps.size()) +
+         size_t(2) * static_cast<size_t>(worldSize) * sizeof(int32_t) +
+         2 * sizeof(int32_t);
+}
+
+std::shared_ptr<const ResolvedProgram> resolve(const Schedule& s, int rank) {
+  const int world = s.worldSize;
+  TC_ENFORCE(rank >= 0 && rank < world, "schedule \"", s.name,
+             "\": rank ", rank, " out of range for world ", world);
+  const int n = static_cast<int>(s.steps.size());
+  const std::vector<int32_t> topo = topoOrder(s, rank);
+
+  // Evaluate every rank's operands: the slot-delta assignment below must
+  // replay the verifier's global FIFO matching, which needs all ranks'
+  // wire steps, not just ours.
+  struct Ev {
+    bool active{false};
+    int peer{-1};
+    int chunk{0};
+    int slot{-1};
+  };
+  std::vector<std::vector<Ev>> ev(world, std::vector<Ev>(n));
+  bool hasCoded = false;
+  for (int r = 0; r < world; r++) {
+    for (int i = 0; i < n; i++) {
+      const Step& st = s.steps[i];
+      Ev& e = ev[r][i];
+      e.active = st.guard.eval(r, world) != 0;
+      if (!e.active) {
+        continue;
+      }
+      e.chunk = st.chunk.eval(r, world);
+      e.slot = st.slot.eval(r, world);
+      if ((st.flags & Step::kFlagCoded) || st.op == StepOp::kEncode ||
+          st.op == StepOp::kDecode) {
+        hasCoded = true;
+      }
+      TC_ENFORCE(e.chunk >= 0 && e.chunk < s.nChunks, "schedule \"", s.name,
+                 "\": step ", i, " chunk ", e.chunk, " out of range");
+      TC_ENFORCE(e.slot >= -1 && e.slot < s.nScratch, "schedule \"", s.name,
+                 "\": step ", i, " slot ", e.slot, " out of range");
+      if (isWire(st.op)) {
+        e.peer = st.peer.eval(r, world);
+        TC_ENFORCE(e.peer >= 0 && e.peer < world && e.peer != r,
+                   "schedule \"", s.name, "\": step ", i, " peer ", e.peer,
+                   " invalid at rank ", r);
+      }
+    }
+  }
+
+  // Global message matching in the verifier's deterministic order: per
+  // directed pair (a, b), the k-th send a posts toward b pairs with the
+  // k-th receive b posts from a; pairs are visited in std::map key order
+  // and each message gets the next sequential slot delta. Both endpoints
+  // derive the same delta, and every rank resolves the same table.
+  std::map<std::pair<int, int>, std::vector<std::pair<int, int>>> sends;
+  std::map<std::pair<int, int>, std::vector<std::pair<int, int>>> recvs;
+  for (int r = 0; r < world; r++) {
+    for (int32_t i : topo) {
+      const Ev& e = ev[r][i];
+      if (!e.active || !isWire(s.steps[i].op)) {
+        continue;
+      }
+      if (s.steps[i].op == StepOp::kSend) {
+        sends[{r, e.peer}].push_back({r, i});
+      } else {
+        recvs[{e.peer, r}].push_back({r, i});
+      }
+    }
+  }
+  std::vector<uint32_t> deltaOf(n, 0);
+  uint32_t next = 0;
+  for (const auto& kv : sends) {
+    auto rit = recvs.find(kv.first);
+    TC_ENFORCE(rit != recvs.end() && rit->second.size() == kv.second.size(),
+               "schedule \"", s.name, "\": unmatched wire steps between ranks ",
+               kv.first.first, " and ", kv.first.second,
+               " (schedule was not verified)");
+    for (size_t k = 0; k < kv.second.size(); k++) {
+      const uint32_t delta = next++;
+      if (kv.second[k].first == rank) {
+        deltaOf[kv.second[k].second] = delta;
+      }
+      if (rit->second[k].first == rank) {
+        deltaOf[rit->second[k].second] = delta;
+      }
+    }
+  }
+  TC_ENFORCE(next < (uint32_t(1) << Slot::kDeltaBits), "schedule \"", s.name,
+             "\": ", next, " wire messages exceed the slot delta space");
+
+  auto prog = std::make_shared<ResolvedProgram>();
+  prog->name = s.name;
+  prog->label = "sched:" + s.name;
+  prog->collective = s.collective;
+  prog->worldSize = world;
+  prog->rank = rank;
+  prog->nChunks = s.nChunks;
+  prog->nScratch = s.nScratch;
+  prog->hasCoded = hasCoded;
+
+  // Reorder into the shared topological order; positions are identical
+  // across ranks (deps are rank-independent), so dependency remapping is
+  // a pure index translation.
+  std::vector<int32_t> pos(n, -1);
+  for (int p = 0; p < n; p++) {
+    pos[topo[p]] = p;
+  }
+  prog->steps.resize(n);
+  for (int p = 0; p < n; p++) {
+    const int32_t i = topo[p];
+    const Step& st = s.steps[i];
+    const Ev& e = ev[rank][i];
+    RStep& r = prog->steps[p];
+    r.op = st.op;
+    r.active = e.active;
+    r.peer = e.peer;
+    r.chunk = e.chunk;
+    r.slot = e.slot;
+    r.flags = st.flags;
+    r.delta = deltaOf[i];
+    r.deps.reserve(st.deps.size());
+    for (int32_t d : st.deps) {
+      r.deps.push_back(pos[d]);
+    }
+    std::sort(r.deps.begin(), r.deps.end());
+  }
+
+  prog->recvQueues[0].assign(world, {});
+  prog->recvQueues[1].assign(world, {});
+  for (int p = 0; p < n; p++) {
+    const RStep& r = prog->steps[p];
+    if (r.active && isRecvKind(r.op)) {
+      prog->recvQueues[r.slot >= 0 ? 1 : 0][r.peer].push_back(p);
+    }
+  }
+  return prog;
+}
+
+void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
+         char* work, size_t count, size_t elsize, ReduceFn fn,
+         DataType dtype, Slot slotBase, std::chrono::milliseconds timeout,
+         transport::UnboundBuffer* callerWorkBuf) {
+  TC_ENFORCE(prog.worldSize == ctx->size() && prog.rank == ctx->rank(),
+             "schedule \"", prog.name, "\" resolved for rank ", prog.rank,
+             "/", prog.worldSize, " cannot run on rank ", ctx->rank(), "/",
+             ctx->size());
+  if (prog.hasCoded) {
+    TC_ENFORCE(dtype == DataType::kFloat32,
+               "schedule \"", prog.name,
+               "\" carries bf16-coded wire steps and requires float32");
+  }
+  const int world = prog.worldSize;
+  const size_t nbytes = count * elsize;
+  const auto& blocks =
+      plan.blocks(0, [&] { return evenBlocks(count, prog.nChunks, elsize); });
+  size_t maxChunk = elsize;
+  for (size_t b : blocks.bytes) {
+    maxChunk = std::max(maxChunk, b);
+  }
+  const size_t slotStride = maxChunk;
+
+  auto* workBuf = callerWorkBuf != nullptr ? callerWorkBuf
+                                           : plan.userBuf(0, work, nbytes);
+  plan::Plan::Stage arena{};
+  if (prog.nScratch > 0) {
+    arena = plan.stage(0, static_cast<size_t>(prog.nScratch) * slotStride);
+  }
+  transport::UnboundBuffer* bufs[2] = {workBuf, arena.buf};
+
+  // All bookkeeping lives in plan scratch: warm replays reset it with one
+  // memset and allocate nothing.
+  const int n = static_cast<int>(prog.steps.size());
+  char* state = plan.scratch(1, prog.stateBytes());
+  std::memset(state, 0, prog.stateBytes());
+  uint8_t* stepState = reinterpret_cast<uint8_t*>(state);
+  int32_t* heads = reinterpret_cast<int32_t*>(state + align4(n));
+  int32_t* sendsOut = heads + size_t(2) * world;
+
+  auto chunkPtr = [&](const RStep& st) { return work + blocks.offset[st.chunk]; };
+  auto slotPtr = [&](const RStep& st) {
+    return arena.data + static_cast<size_t>(st.slot) * slotStride;
+  };
+  auto chunkElems = [&](const RStep& st) { return blocks.bytes[st.chunk] / elsize; };
+  // Wire operand: coded steps move bf16 (2 bytes/elem) through their
+  // slot; uncoded steps move the chunk's bytes from the slot (if one is
+  // named) or in place from the work buffer.
+  auto wireLoc = [&](const RStep& st, int* bufIdx, size_t* off, size_t* len) {
+    const bool coded = (st.flags & Step::kFlagCoded) != 0;
+    *len = coded ? chunkElems(st) * 2 : blocks.bytes[st.chunk];
+    if (st.slot >= 0) {
+      *bufIdx = 1;
+      *off = static_cast<size_t>(st.slot) * slotStride;
+    } else {
+      *bufIdx = 0;
+      *off = blocks.offset[st.chunk];
+    }
+  };
+
+  auto drainSends = [&](int b) {
+    while (sendsOut[b] > 0) {
+      PhaseScope ws(Phase::kWireWait);
+      bufs[b]->waitSend(timeout);
+      sendsOut[b]--;
+    }
+  };
+  // Wait until step `p` (a receive posted on buffer `b`) has arrived,
+  // attributing each waitRecv completion through the per-source FIFO,
+  // then apply its fold (recv_reduce) exactly once. Folds thus execute
+  // at dependency-demand time in program order — deterministic float
+  // reduction order, independent of wire arrival order.
+  auto completeRecv = [&](int p) {
+    const RStep& st = prog.steps[p];
+    if (stepState[p] & kDone) {
+      return;
+    }
+    const int b = st.slot >= 0 ? 1 : 0;
+    while (!(stepState[p] & kArrived)) {
+      int src = -1;
+      {
+        PhaseScope ws(Phase::kWireWait);
+        bufs[b]->waitRecv(&src, timeout);
+      }
+      TC_ENFORCE(src >= 0 && src < world, "schedule \"", prog.name,
+                 "\": waitRecv reported bad source ", src);
+      const auto& q = prog.recvQueues[b][src];
+      int32_t& head = heads[b * world + src];
+      TC_ENFORCE(static_cast<size_t>(head) < q.size(), "schedule \"",
+                 prog.name, "\": unexpected receive completion from rank ",
+                 src);
+      stepState[q[head]] |= kArrived;
+      head++;
+    }
+    if (st.op == StepOp::kRecvReduce) {
+      PhaseScope rs(Phase::kReduce);
+      const size_t elems = chunkElems(st);
+      if (elems > 0) {
+        fn(chunkPtr(st), slotPtr(st), elems);
+      }
+    }
+    stepState[p] |= kDone;
+  };
+  auto completeDep = [&](int d) {
+    const RStep& ds = prog.steps[d];
+    if (!ds.active) {
+      return;
+    }
+    if (ds.op == StepOp::kSend) {
+      // waitSend carries no identity: a dependency on any send drains
+      // every outstanding send on that buffer (a superset, still safe).
+      drainSends(ds.slot >= 0 ? 1 : 0);
+    } else if (isRecvKind(ds.op)) {
+      completeRecv(d);
+    }
+    // Local steps already executed inline (sequential walk).
+  };
+
+  for (int p = 0; p < n; p++) {
+    const RStep& st = prog.steps[p];
+    if (!st.active) {
+      continue;
+    }
+    for (int32_t d : st.deps) {
+      completeDep(d);
+    }
+    switch (st.op) {
+      case StepOp::kSend: {
+        int b;
+        size_t off, len;
+        wireLoc(st, &b, &off, &len);
+        PhaseScope ps(Phase::kPost);
+        bufs[b]->send(st.peer, slotBase.offset(st.delta).value(), off, len);
+        sendsOut[b]++;
+        break;
+      }
+      case StepOp::kRecv:
+      case StepOp::kRecvReduce: {
+        int b;
+        size_t off, len;
+        wireLoc(st, &b, &off, &len);
+        PhaseScope ps(Phase::kPost);
+        bufs[b]->recv(st.peer, slotBase.offset(st.delta).value(), off, len);
+        break;
+      }
+      case StepOp::kReduceLocal: {
+        PhaseScope rs(Phase::kReduce);
+        const size_t elems = chunkElems(st);
+        if (elems > 0) {
+          fn(chunkPtr(st), slotPtr(st), elems);
+        }
+        break;
+      }
+      case StepOp::kCopy: {
+        PhaseScope cs(Phase::kPack);
+        const size_t len = blocks.bytes[st.chunk];
+        if (len > 0) {
+          if (st.flags & Step::kFlagToSlot) {
+            std::memcpy(slotPtr(st), chunkPtr(st), len);
+          } else {
+            std::memcpy(chunkPtr(st), slotPtr(st), len);
+          }
+        }
+        break;
+      }
+      case StepOp::kEncode: {
+        PhaseScope cs(Phase::kPack);
+        f32StreamToBf16(reinterpret_cast<const float*>(chunkPtr(st)),
+                        reinterpret_cast<uint16_t*>(slotPtr(st)),
+                        chunkElems(st));
+        break;
+      }
+      case StepOp::kDecode: {
+        PhaseScope cs(Phase::kUnpack);
+        bf16StreamToF32(reinterpret_cast<const uint16_t*>(slotPtr(st)),
+                        reinterpret_cast<float*>(chunkPtr(st)),
+                        chunkElems(st));
+        break;
+      }
+    }
+  }
+
+  // Completion: every posted receive must be consumed (in program order,
+  // so trailing folds stay deterministic) and every send drained before
+  // the plan is released back to the cache.
+  for (int p = 0; p < n; p++) {
+    const RStep& st = prog.steps[p];
+    if (st.active && isRecvKind(st.op)) {
+      completeRecv(p);
+    }
+  }
+  drainSends(0);
+  drainSends(1);
+}
+
+std::shared_ptr<const InstalledSchedules> installSchedules(
+    std::shared_ptr<const ScheduleTable> table, int rank, int worldSize) {
+  TC_ENFORCE(table != nullptr, "installSchedules: null table");
+  auto inst = std::make_shared<InstalledSchedules>();
+  inst->table = table;
+  for (const Schedule& s : table->schedules()) {
+    if (s.worldSize != worldSize) {
+      continue;
+    }
+    verifyOrThrow(s);
+    inst->programs[s.name] = resolve(s, rank);
+  }
+  return inst;
+}
+
+const char* internedLabel(const std::string& label) {
+  static std::mutex mu;
+  static std::set<std::string>* pool = new std::set<std::string>();
+  std::lock_guard<std::mutex> guard(mu);
+  return pool->insert(label).first->c_str();
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

@@ -1,0 +1,131 @@
+// Schedule interpreter: lowers a verified schedule (ir.h, verifier.h)
+// onto the transport through the plan-cache machinery.
+//
+// Two stages, split exactly where the cost is:
+//
+//   resolve(): per-rank, per-install. Evaluates every RankExpr,
+//   topologically orders the steps (the same deterministic order the
+//   verifier proved safe), assigns each matched wire message a unique
+//   slot delta (identical on sender and receiver — both sides replay
+//   the verifier's global FIFO matching), and precomputes the per-
+//   (buffer, source) receive queues that waitRecv completions pop.
+//   The result is immutable and shared by every call.
+//
+//   run(): per-call. Walks the resolved program in order; before a step
+//   runs, its declared dependencies are completed (receive: wait for
+//   arrival and fold; send: drain the buffer); local steps execute
+//   inline. All bookkeeping (arrival flags, queue heads, outstanding
+//   send counts) lives in plan scratch, and buffers/blocks come from
+//   the plan — warm replays through the plan cache perform zero
+//   allocations and zero registrations, the same `ubuf_creates`
+//   steady-state contract the native algorithms meet.
+//
+// Determinism: receive completions may arrive in any order (waitRecv
+// reports the source; the per-source FIFO attributes it), but folds
+// execute in program order at dependency-completion time — the same
+// payload and seed always produce the same float reduction order, which
+// the chaos-determinism suite asserts via flightrec fingerprints.
+//
+// Phase attribution (profiler): posts -> kPost, waits -> kWireWait,
+// folds -> kReduce, copy/encode -> kPack, decode -> kUnpack; the
+// schedule label ("sched:<name>") flows into flightrec op records and
+// profiler op summaries through the dispatch layer.
+#pragma once
+
+#include <chrono>
+#include <cstddef>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tpucoll/math.h"
+#include "tpucoll/schedule/ir.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+
+class Context;
+namespace plan {
+class Plan;
+}
+namespace transport {
+class UnboundBuffer;
+}
+
+namespace schedule {
+
+// One step with every expression evaluated for the executing rank,
+// stored in execution (topological) order; `deps` are positions in
+// that order, sorted ascending.
+struct RStep {
+  StepOp op{StepOp::kSend};
+  bool active{false};
+  int peer{-1};
+  int chunk{0};
+  int slot{-1};
+  uint8_t flags{0};
+  uint32_t delta{0};  // wire steps: sub-slot of the collective's base slot
+  std::vector<int32_t> deps;
+};
+
+struct ResolvedProgram {
+  std::string name;
+  std::string label;  // "sched:<name>"; stable storage for profiler tags
+  Collective collective{Collective::kAllreduce};
+  int worldSize{0};
+  int rank{0};
+  int nChunks{0};
+  int nScratch{0};
+  bool hasCoded{false};  // any bf16-coded wire step (float32-only)
+  std::vector<RStep> steps;
+  // Per buffer (0 = work, 1 = scratch arena), per source rank: positions
+  // of this rank's receive steps in post order — the FIFO a waitRecv
+  // completion from that source pops.
+  std::vector<std::vector<int32_t>> recvQueues[2];
+  size_t stateBytes() const;  // plan-scratch bookkeeping footprint
+};
+
+// Evaluate + order `s` for `rank`. Callers verify first
+// (verifyOrThrow); resolve re-derives the global message matching the
+// verifier proved consistent, so it runs on all ranks with identical
+// results. Throws EnforceError on schedules the verifier would reject
+// structurally (defense in depth), never returns a partial program.
+std::shared_ptr<const ResolvedProgram> resolve(const Schedule& s, int rank);
+
+// Execute one collective call. `work` is the full payload (count
+// elements of elsize bytes) laid out in nChunks even blocks; `fn` is
+// the reduction (may be null for fold-free programs, e.g. allgather).
+// Plan slots used: userBuf 0 (work), stage 0 (scratch chunk arena),
+// scratch 1 (bookkeeping) — entry points staging their own copies
+// start at slot 2, and pass that stage's registration as `workBuf`
+// (null = register `work` via plan.userBuf(0)).
+void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
+         char* work, size_t count, size_t elsize, ReduceFn fn,
+         DataType dtype, Slot slotBase, std::chrono::milliseconds timeout,
+         transport::UnboundBuffer* workBuf = nullptr);
+
+// The verified + per-rank-resolved schedule plane a Context holds
+// behind its schedule mutex (Context::setScheduleTable installs one
+// atomically; dispatch reads it once per collective call). Schedules
+// whose worldSize differs from the context's are kept in `table` (so
+// the installed JSON round-trips) but get no resolved program — their
+// elections can never fire because elected() matches worldSize.
+struct InstalledSchedules {
+  std::shared_ptr<const ScheduleTable> table;
+  std::map<std::string, std::shared_ptr<const ResolvedProgram>> programs;
+};
+
+// Verify (verifyOrThrow) and resolve every schedule in `table` matching
+// `worldSize`, for `rank`. Throws on the first invalid schedule —
+// installation is all-or-nothing.
+std::shared_ptr<const InstalledSchedules> installSchedules(
+    std::shared_ptr<const ScheduleTable> table, int rank, int worldSize);
+
+// Process-lifetime interned copy of a label string — safe to hand to
+// the flight recorder / profiler const char* algorithm fields even
+// after the schedule table is reinstalled or cleared.
+const char* internedLabel(const std::string& label);
+
+}  // namespace schedule
+}  // namespace tpucoll

@@ -1,0 +1,450 @@
+#include "tpucoll/schedule/ir.h"
+
+#include <sstream>
+#include <utility>
+
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/tuning/tuning_table.h"
+
+namespace tpucoll {
+namespace schedule {
+
+const char* stepOpName(StepOp op) {
+  switch (op) {
+    case StepOp::kSend:
+      return "send";
+    case StepOp::kRecv:
+      return "recv";
+    case StepOp::kRecvReduce:
+      return "recv_reduce";
+    case StepOp::kReduceLocal:
+      return "reduce_local";
+    case StepOp::kCopy:
+      return "copy";
+    case StepOp::kEncode:
+      return "encode";
+    case StepOp::kDecode:
+      return "decode";
+  }
+  TC_THROW(EnforceError, "unknown step op ", static_cast<int>(op));
+}
+
+std::optional<StepOp> stepOpFromName(const std::string& name) {
+  for (uint8_t i = 0; i <= static_cast<uint8_t>(StepOp::kDecode); i++) {
+    const StepOp op = static_cast<StepOp>(i);
+    if (name == stepOpName(op)) {
+      return op;
+    }
+  }
+  return std::nullopt;
+}
+
+const char* collectiveName(Collective c) {
+  switch (c) {
+    case Collective::kAllreduce:
+      return "allreduce";
+    case Collective::kReduceScatter:
+      return "reduce_scatter";
+    case Collective::kAllgather:
+      return "allgather";
+  }
+  TC_THROW(EnforceError, "unknown collective ", static_cast<int>(c));
+}
+
+std::optional<Collective> collectiveFromName(const std::string& name) {
+  for (uint8_t i = 0; i <= static_cast<uint8_t>(Collective::kAllgather);
+       i++) {
+    const Collective c = static_cast<Collective>(i);
+    if (name == collectiveName(c)) {
+      return c;
+    }
+  }
+  return std::nullopt;
+}
+
+namespace {
+
+// Euclidean remainder: ring arithmetic must wrap negative shifts
+// ((rank - t) mod world) into [0, world), which C++ % does not.
+int64_t posMod(int64_t v, int64_t m) {
+  const int64_t r = v % m;
+  return r < 0 ? r + m : r;
+}
+
+}  // namespace
+
+int64_t RankExpr::eval(int rank, int worldSize) const {
+  TC_ENFORCE(worldSize > 0, "schedule expr: world size must be positive");
+  switch (kind) {
+    case Kind::kConst:
+      return a;
+    case Kind::kRing:
+      return posMod(rank + a, worldSize) * scale + offset;
+    case Kind::kXor:
+      return posMod(rank ^ a, worldSize) * scale + offset;
+    case Kind::kTable:
+      TC_ENFORCE(static_cast<size_t>(rank) < table.size(),
+                 "schedule expr: table has ", table.size(),
+                 " entries, rank ", rank, " out of range");
+      return table[rank];
+  }
+  TC_THROW(EnforceError, "unknown expr kind ", static_cast<int>(kind));
+}
+
+RankExpr RankExpr::constant(int64_t v) {
+  RankExpr e;
+  e.kind = Kind::kConst;
+  e.a = v;
+  return e;
+}
+
+RankExpr RankExpr::ring(int64_t add, int64_t scale, int64_t offset) {
+  RankExpr e;
+  e.kind = Kind::kRing;
+  e.a = add;
+  e.scale = scale;
+  e.offset = offset;
+  return e;
+}
+
+RankExpr RankExpr::xorOf(int64_t mask, int64_t scale, int64_t offset) {
+  RankExpr e;
+  e.kind = Kind::kXor;
+  e.a = mask;
+  e.scale = scale;
+  e.offset = offset;
+  return e;
+}
+
+RankExpr RankExpr::tableOf(std::vector<int64_t> values) {
+  RankExpr e;
+  e.kind = Kind::kTable;
+  e.table = std::move(values);
+  return e;
+}
+
+void ScheduleTable::add(Schedule s) {
+  TC_ENFORCE(!s.name.empty(), "schedule table: schedule needs a name");
+  TC_ENFORCE(find(s.name) == nullptr, "schedule table: duplicate schedule \"",
+             s.name, "\"");
+  TC_ENFORCE(s.worldSize > 0, "schedule \"", s.name,
+             "\": world size must be positive");
+  TC_ENFORCE(s.nChunks > 0, "schedule \"", s.name,
+             "\": chunk count must be positive");
+  TC_ENFORCE(s.nScratch >= 0, "schedule \"", s.name,
+             "\": scratch count must be non-negative");
+  schedules_.push_back(std::move(s));
+}
+
+const Schedule* ScheduleTable::find(const std::string& name) const {
+  for (const Schedule& s : schedules_) {
+    if (s.name == name) {
+      return &s;
+    }
+  }
+  return nullptr;
+}
+
+void ScheduleTable::elect(Election e) {
+  TC_ENFORCE(find(e.schedule) != nullptr, "schedule table: election names "
+             "unknown schedule \"", e.schedule, "\"");
+  TC_ENFORCE(collectiveFromName(e.collective).has_value(),
+             "schedule table: election has unknown collective \"",
+             e.collective, "\"");
+  elections_.push_back(std::move(e));
+}
+
+const Schedule* ScheduleTable::elected(const std::string& collective,
+                                       int worldSize,
+                                       const std::string& dtype,
+                                       size_t nbytes) const {
+  const int bucket = tuning::sizeBucket(nbytes);
+  const Election* wildcard = nullptr;
+  for (const Election& e : elections_) {
+    if (e.collective != collective || e.worldSize != worldSize ||
+        e.bucket != bucket) {
+      continue;
+    }
+    if (e.dtype == dtype) {
+      return find(e.schedule);
+    }
+    if (e.dtype.empty() && wildcard == nullptr) {
+      wildcard = &e;
+    }
+  }
+  return wildcard != nullptr ? find(wildcard->schedule) : nullptr;
+}
+
+namespace {
+
+using Kind = JsonReader::Value::Kind;
+
+const JsonReader::Value& requireField(const JsonReader::Value& obj,
+                                      const std::string& name, Kind kind) {
+  const JsonReader::Value* f = obj.field(name);
+  TC_ENFORCE(f != nullptr, "schedule JSON: missing \"", name, "\"");
+  TC_ENFORCE(f->kind == kind, "schedule JSON: \"", name,
+             "\" has wrong type");
+  return *f;
+}
+
+int64_t requireInt(const JsonReader::Value& obj, const std::string& name) {
+  const JsonReader::Value& f = requireField(obj, name, Kind::kNumber);
+  const int64_t v = static_cast<int64_t>(f.number);
+  TC_ENFORCE(static_cast<double>(v) == f.number, "schedule JSON: \"", name,
+             "\" must be an integer");
+  return v;
+}
+
+int64_t optionalInt(const JsonReader::Value& obj, const std::string& name,
+                    int64_t fallback) {
+  if (obj.field(name) == nullptr) {
+    return fallback;
+  }
+  return requireInt(obj, name);
+}
+
+void appendExpr(std::ostringstream& out, const RankExpr& e) {
+  switch (e.kind) {
+    case RankExpr::Kind::kConst:
+      out << e.a;
+      return;
+    case RankExpr::Kind::kRing:
+    case RankExpr::Kind::kXor:
+      out << "{\"kind\":\""
+          << (e.kind == RankExpr::Kind::kRing ? "ring" : "xor")
+          << "\",\"a\":" << e.a;
+      if (e.scale != 1) {
+        out << ",\"scale\":" << e.scale;
+      }
+      if (e.offset != 0) {
+        out << ",\"offset\":" << e.offset;
+      }
+      out << "}";
+      return;
+    case RankExpr::Kind::kTable:
+      out << "{\"kind\":\"table\",\"values\":[";
+      for (size_t i = 0; i < e.table.size(); i++) {
+        if (i > 0) {
+          out << ",";
+        }
+        out << e.table[i];
+      }
+      out << "]}";
+      return;
+  }
+  TC_THROW(EnforceError, "unknown expr kind ", static_cast<int>(e.kind));
+}
+
+RankExpr parseExpr(const JsonReader::Value& v, const char* what) {
+  if (v.kind == Kind::kNumber) {
+    const int64_t n = static_cast<int64_t>(v.number);
+    TC_ENFORCE(static_cast<double>(n) == v.number, "schedule JSON: ", what,
+               " must be an integer or expr object");
+    return RankExpr::constant(n);
+  }
+  TC_ENFORCE(v.kind == Kind::kObject, "schedule JSON: ", what,
+             " must be an integer or expr object");
+  const std::string& kind = requireField(v, "kind", Kind::kString).str;
+  if (kind == "ring" || kind == "xor") {
+    const int64_t a = requireInt(v, "a");
+    const int64_t scale = optionalInt(v, "scale", 1);
+    const int64_t offset = optionalInt(v, "offset", 0);
+    return kind == "ring" ? RankExpr::ring(a, scale, offset)
+                          : RankExpr::xorOf(a, scale, offset);
+  }
+  if (kind == "table") {
+    const JsonReader::Value& values = requireField(v, "values", Kind::kArray);
+    std::vector<int64_t> table;
+    table.reserve(values.items.size());
+    for (const JsonReader::Value& item : values.items) {
+      TC_ENFORCE(item.kind == Kind::kNumber, "schedule JSON: ", what,
+                 " table values must be integers");
+      table.push_back(static_cast<int64_t>(item.number));
+    }
+    return RankExpr::tableOf(std::move(table));
+  }
+  TC_THROW(EnforceError, "schedule JSON: ", what, " has unknown expr kind \"",
+           kind, "\"");
+}
+
+bool isConst(const RankExpr& e, int64_t v) {
+  return e.kind == RankExpr::Kind::kConst && e.a == v;
+}
+
+}  // namespace
+
+std::string ScheduleTable::toJson() const {
+  std::ostringstream out;
+  out << "{\"version\":1,\"schedules\":[";
+  for (size_t si = 0; si < schedules_.size(); si++) {
+    const Schedule& s = schedules_[si];
+    if (si > 0) {
+      out << ",";
+    }
+    out << "{\"name\":";
+    appendJsonString(out, s.name);
+    out << ",\"collective\":\"" << collectiveName(s.collective)
+        << "\",\"world_size\":" << s.worldSize << ",\"chunks\":" << s.nChunks
+        << ",\"scratch\":" << s.nScratch << ",\"steps\":[";
+    for (size_t i = 0; i < s.steps.size(); i++) {
+      const Step& st = s.steps[i];
+      if (i > 0) {
+        out << ",";
+      }
+      out << "{\"op\":\"" << stepOpName(st.op) << "\"";
+      // Defaults are omitted so generated files stay reviewable; the
+      // parser restores them, making omission/presence round-trip clean.
+      if (!isConst(st.peer, -1)) {
+        out << ",\"peer\":";
+        appendExpr(out, st.peer);
+      }
+      out << ",\"chunk\":";
+      appendExpr(out, st.chunk);
+      if (!isConst(st.slot, -1)) {
+        out << ",\"slot\":";
+        appendExpr(out, st.slot);
+      }
+      if (!isConst(st.guard, 1)) {
+        out << ",\"guard\":";
+        appendExpr(out, st.guard);
+      }
+      if (st.flags != 0) {
+        out << ",\"flags\":" << static_cast<int>(st.flags);
+      }
+      if (!st.deps.empty()) {
+        out << ",\"deps\":[";
+        for (size_t d = 0; d < st.deps.size(); d++) {
+          if (d > 0) {
+            out << ",";
+          }
+          out << st.deps[d];
+        }
+        out << "]";
+      }
+      if (!st.note.empty()) {
+        out << ",\"note\":";
+        appendJsonString(out, st.note);
+      }
+      out << "}";
+    }
+    out << "]}";
+  }
+  out << "],\"elections\":[";
+  for (size_t i = 0; i < elections_.size(); i++) {
+    const Election& e = elections_[i];
+    if (i > 0) {
+      out << ",";
+    }
+    out << "{\"collective\":";
+    appendJsonString(out, e.collective);
+    out << ",\"world_size\":" << e.worldSize << ",\"dtype\":";
+    appendJsonString(out, e.dtype);
+    out << ",\"bucket\":" << e.bucket << ",\"schedule\":";
+    appendJsonString(out, e.schedule);
+    out << "}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+ScheduleTable ScheduleTable::fromJson(const std::string& json) {
+  JsonReader reader(json, "schedule JSON", /*rejectDuplicateKeys=*/true);
+  const JsonReader::Value root = reader.parse();
+  TC_ENFORCE(root.kind == Kind::kObject,
+             "schedule JSON: root must be an object");
+  const JsonReader::Value* version = root.field("version");
+  TC_ENFORCE(version != nullptr && version->kind == Kind::kNumber &&
+                 version->number == 1.0,
+             "schedule JSON: unsupported version");
+  ScheduleTable table;
+  // Both top-level arrays are optional (absent == empty): hand-written
+  // tables often carry only one of them.
+  static const JsonReader::Value kEmptyArray = [] {
+    JsonReader::Value v;
+    v.kind = Kind::kArray;
+    return v;
+  }();
+  const JsonReader::Value& schedules =
+      root.field("schedules") != nullptr
+          ? requireField(root, "schedules", Kind::kArray)
+          : kEmptyArray;
+  for (const JsonReader::Value& sv : schedules.items) {
+    TC_ENFORCE(sv.kind == Kind::kObject,
+               "schedule JSON: schedule must be an object");
+    Schedule s;
+    s.name = requireField(sv, "name", Kind::kString).str;
+    const std::string& coll =
+        requireField(sv, "collective", Kind::kString).str;
+    auto c = collectiveFromName(coll);
+    TC_ENFORCE(c.has_value(), "schedule JSON: schedule \"", s.name,
+               "\" has unknown collective \"", coll, "\"");
+    s.collective = *c;
+    s.worldSize = static_cast<int>(requireInt(sv, "world_size"));
+    s.nChunks = static_cast<int>(requireInt(sv, "chunks"));
+    s.nScratch = static_cast<int>(requireInt(sv, "scratch"));
+    const JsonReader::Value& steps = requireField(sv, "steps", Kind::kArray);
+    for (const JsonReader::Value& stv : steps.items) {
+      TC_ENFORCE(stv.kind == Kind::kObject,
+                 "schedule JSON: step must be an object");
+      Step st;
+      const std::string& opName = requireField(stv, "op", Kind::kString).str;
+      auto op = stepOpFromName(opName);
+      TC_ENFORCE(op.has_value(), "schedule JSON: schedule \"", s.name,
+                 "\" has unknown step op \"", opName, "\"");
+      st.op = *op;
+      if (const JsonReader::Value* p = stv.field("peer")) {
+        st.peer = parseExpr(*p, "peer");
+      }
+      const JsonReader::Value* chunk = stv.field("chunk");
+      TC_ENFORCE(chunk != nullptr, "schedule JSON: step missing \"chunk\"");
+      st.chunk = parseExpr(*chunk, "chunk");
+      if (const JsonReader::Value* sl = stv.field("slot")) {
+        st.slot = parseExpr(*sl, "slot");
+      }
+      if (const JsonReader::Value* g = stv.field("guard")) {
+        st.guard = parseExpr(*g, "guard");
+      }
+      const int64_t flags = optionalInt(stv, "flags", 0);
+      TC_ENFORCE(flags >= 0 && flags <= 0xff,
+                 "schedule JSON: step flags out of range");
+      st.flags = static_cast<uint8_t>(flags);
+      if (const JsonReader::Value* deps = stv.field("deps")) {
+        TC_ENFORCE(deps->kind == Kind::kArray,
+                   "schedule JSON: \"deps\" must be an array");
+        for (const JsonReader::Value& d : deps->items) {
+          TC_ENFORCE(d.kind == Kind::kNumber,
+                     "schedule JSON: deps must be integers");
+          st.deps.push_back(static_cast<int32_t>(d.number));
+        }
+      }
+      if (const JsonReader::Value* note = stv.field("note")) {
+        TC_ENFORCE(note->kind == Kind::kString,
+                   "schedule JSON: \"note\" must be a string");
+        st.note = note->str;
+      }
+      s.steps.push_back(std::move(st));
+    }
+    table.add(std::move(s));
+  }
+  const JsonReader::Value& elections =
+      root.field("elections") != nullptr
+          ? requireField(root, "elections", Kind::kArray)
+          : kEmptyArray;
+  for (const JsonReader::Value& ev : elections.items) {
+    TC_ENFORCE(ev.kind == Kind::kObject,
+               "schedule JSON: election must be an object");
+    Election e;
+    e.collective = requireField(ev, "collective", Kind::kString).str;
+    e.worldSize = static_cast<int>(requireInt(ev, "world_size"));
+    e.dtype = requireField(ev, "dtype", Kind::kString).str;
+    e.bucket = static_cast<int>(requireInt(ev, "bucket"));
+    e.schedule = requireField(ev, "schedule", Kind::kString).str;
+    table.elect(std::move(e));
+  }
+  return table;
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

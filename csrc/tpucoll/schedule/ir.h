@@ -1,0 +1,189 @@
+// Collective schedule IR: algorithms as data.
+//
+// A Schedule is a rank-parameterized program over chunk ids — the same
+// step list describes every rank, with per-step operand expressions
+// (RankExpr) evaluated against the executing rank, the way GC3
+// (arXiv:2201.11840) lifts collectives into a searchable program
+// representation instead of a closed algorithm enum. Seven step opcodes
+// cover everything the native schedules do on the wire:
+//
+//   send         post chunk bytes to a peer
+//   recv         receive chunk bytes from a peer (overwrite)
+//   recv_reduce  receive into a scratch slot, then fold into the chunk
+//   reduce_local fold a scratch slot into a chunk
+//   copy         move bytes between a chunk and a scratch slot
+//   encode       bf16-encode a chunk into a scratch slot (wire codec)
+//   decode       bf16-decode a scratch slot into a chunk
+//
+// Steps carry explicit dependency edges (indices into the step list,
+// same-rank); everything not ordered by an edge may overlap. The
+// verifier (verifier.h) statically proves a schedule computes its
+// declared collective before the interpreter (interpreter.h) is allowed
+// to lower it onto the transport; generators (generators.h) emit the
+// native ring/halving-doubling/bcube algorithms — plus families no enum
+// entry can express — as plain data for the tuner to search.
+//
+// Geometry: the payload is split into nChunks data chunks (evenBlocks,
+// detail.h — the same split every native schedule uses), numbered
+// [0, nChunks). Scratch slots [0, nScratch) are staging regions sized by
+// the largest chunk; a step that touches a slot also names the data
+// chunk giving the transfer its element count, so slots can be reused
+// across rounds with different geometry.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpucoll {
+namespace schedule {
+
+// Step opcodes. tools/check's schedule-step-coverage rule requires every
+// enumerator here to be handled in the verifier and interpreter
+// switches — extend all three together.
+enum class StepOp : uint8_t {
+  kSend = 0,
+  kRecv = 1,
+  kRecvReduce = 2,
+  kReduceLocal = 3,
+  kCopy = 4,
+  kEncode = 5,
+  kDecode = 6,
+};
+
+const char* stepOpName(StepOp op);
+std::optional<StepOp> stepOpFromName(const std::string& name);
+
+// Rank-parameterized integer expression — the reason ONE program
+// describes all ranks. Evaluated against (rank, worldSize):
+//   const  -> a
+//   ring   -> ((rank + a) mod world) * scale + offset
+//   xor    -> ((rank ^ a) mod world) * scale + offset
+//   table  -> table[rank]           (per-rank escape hatch)
+// ring/xor cover the symmetric algorithms (ring shifts, halving-
+// doubling partners); table expresses anything else (bcube mixed-radix
+// partners, hierarchy roles) without growing the language.
+struct RankExpr {
+  enum class Kind : uint8_t { kConst = 0, kRing = 1, kXor = 2, kTable = 3 };
+  Kind kind{Kind::kConst};
+  int64_t a{0};
+  int64_t scale{1};
+  int64_t offset{0};
+  std::vector<int64_t> table;
+
+  int64_t eval(int rank, int worldSize) const;
+
+  static RankExpr constant(int64_t v);
+  static RankExpr ring(int64_t add, int64_t scale = 1, int64_t offset = 0);
+  static RankExpr xorOf(int64_t mask, int64_t scale = 1, int64_t offset = 0);
+  static RankExpr tableOf(std::vector<int64_t> values);
+};
+
+// One step of the program. Operand roles by opcode:
+//   send        peer, chunk, slot (-1 = send the chunk region itself,
+//               >=0 = send the slot's bytes with the chunk's geometry)
+//   recv        peer, chunk, slot (-1 = land in the chunk, overwrite;
+//               >=0 = land in the slot)
+//   recv_reduce peer, chunk, slot (slot required: the landing region;
+//               the payload is folded into the chunk on completion)
+//   reduce_local chunk, slot (fold slot into chunk)
+//   copy        chunk, slot (+kFlagToSlot: chunk -> slot; default
+//               slot -> chunk)
+//   encode      chunk, slot (bf16(chunk) -> slot)
+//   decode      chunk, slot (f32(slot) -> chunk)
+struct Step {
+  StepOp op{StepOp::kSend};
+  RankExpr peer = RankExpr::constant(-1);
+  RankExpr chunk = RankExpr::constant(0);
+  RankExpr slot = RankExpr::constant(-1);
+  // Nonzero = this rank runs the step; zero = the step is skipped (its
+  // dependents treat it as already satisfied). How hierarchy shapes
+  // give leaders and members different programs inside one schedule.
+  RankExpr guard = RankExpr::constant(1);
+  // Flag bits (per-step modifiers).
+  static constexpr uint8_t kFlagToSlot = 1;  // copy direction
+  static constexpr uint8_t kFlagCoded = 2;   // send/recv move bf16 bytes
+  uint8_t flags{0};
+  // Indices into Schedule::steps that must complete (on this rank)
+  // before this step may run. Any order; the verifier topo-sorts and
+  // rejects cycles.
+  std::vector<int32_t> deps;
+  // Optional label surfaced by verifier errors and describe().
+  std::string note;
+};
+
+enum class Collective : uint8_t {
+  kAllreduce = 0,
+  kReduceScatter = 1,
+  kAllgather = 2,
+};
+
+const char* collectiveName(Collective c);
+std::optional<Collective> collectiveFromName(const std::string& name);
+
+struct Schedule {
+  std::string name;
+  Collective collective{Collective::kAllreduce};
+  int worldSize{0};
+  int nChunks{0};
+  int nScratch{0};
+  std::vector<Step> steps;
+};
+
+// One tuner-elected cell: "for (collective, world_size, dtype, log2
+// size bucket), run this named schedule instead of the native
+// algorithms". dtype "" matches any. Same rank-agreement contract as
+// the tuning table: every rank installs byte-identical JSON.
+struct Election {
+  std::string collective;
+  int worldSize{0};
+  std::string dtype;
+  int bucket{0};
+  std::string schedule;
+};
+
+// Named schedules + per-cell elections, JSON round trip — the
+// TPUCOLL_SCHEDULE_FILE interchange format (docs/schedules.md):
+//   {"version":1,
+//    "schedules":[{"name","collective","world_size","chunks","scratch",
+//                  "steps":[{"op","peer","chunk","slot","guard","flags",
+//                            "deps","note"}]}],
+//    "elections":[{"collective","world_size","dtype","bucket",
+//                  "schedule"}]}
+// fromJson throws EnforceError on malformed input (including duplicate
+// object keys — common/json.h strict mode), never installs partially.
+class ScheduleTable {
+ public:
+  // Adds a schedule; the name must be unique (EnforceError otherwise).
+  // Structural validation only — semantic verification happens at
+  // install (Context::setScheduleTable runs the verifier on every
+  // schedule matching the context's world size).
+  void add(Schedule s);
+
+  const Schedule* find(const std::string& name) const;
+  const std::vector<Schedule>& schedules() const { return schedules_; }
+
+  void elect(Election e);
+  const std::vector<Election>& elections() const { return elections_; }
+
+  // The schedule elected for this cell, or nullptr. Exact-dtype
+  // elections win over wildcard ("") ones; bucket = floor(log2(nbytes))
+  // must match exactly (elections are per-cell, not interpolated — a
+  // schedule measured at one size says nothing about another).
+  const Schedule* elected(const std::string& collective, int worldSize,
+                          const std::string& dtype, size_t nbytes) const;
+
+  bool empty() const { return schedules_.empty() && elections_.empty(); }
+
+  std::string toJson() const;
+  static ScheduleTable fromJson(const std::string& json);
+
+ private:
+  std::vector<Schedule> schedules_;
+  std::vector<Election> elections_;
+};
+
+}  // namespace schedule
+}  // namespace tpucoll

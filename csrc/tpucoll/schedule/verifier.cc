@@ -1,0 +1,764 @@
+#include "tpucoll/schedule/verifier.h"
+
+#include <algorithm>
+#include <map>
+#include <queue>
+#include <sstream>
+#include <utility>
+
+#include "tpucoll/common/logging.h"
+
+namespace tpucoll {
+namespace schedule {
+
+const char* verifyCodeName(VerifyCode code) {
+  switch (code) {
+    case VerifyCode::kBadStep:
+      return "bad_step";
+    case VerifyCode::kDependencyCycle:
+      return "dependency_cycle";
+    case VerifyCode::kMessageMismatch:
+      return "message_mismatch";
+    case VerifyCode::kStaleRead:
+      return "stale_read";
+    case VerifyCode::kChunkReducedTwice:
+      return "chunk_reduced_twice";
+    case VerifyCode::kHazard:
+      return "hazard";
+    case VerifyCode::kDeadlock:
+      return "deadlock";
+    case VerifyCode::kUndelivered:
+      return "undelivered";
+  }
+  TC_THROW(EnforceError, "unknown verify code ", static_cast<int>(code));
+}
+
+std::string VerifyError::format(const Schedule& s) const {
+  std::ostringstream out;
+  out << "schedule \"" << s.name << "\": " << verifyCodeName(code);
+  if (rank >= 0) {
+    out << " at rank " << rank;
+  }
+  if (step >= 0) {
+    out << " step " << step;
+    if (step < static_cast<int>(s.steps.size()) &&
+        !s.steps[step].note.empty()) {
+      out << " (" << s.steps[step].note << ")";
+    }
+  }
+  out << ": " << message;
+  return out.str();
+}
+
+namespace {
+
+bool isWire(StepOp op) {
+  return op == StepOp::kSend || op == StepOp::kRecv ||
+         op == StepOp::kRecvReduce;
+}
+
+bool isRecvKind(StepOp op) {
+  return op == StepOp::kRecv || op == StepOp::kRecvReduce;
+}
+
+// Concrete per-rank operands of one step (exprs evaluated).
+struct Operands {
+  bool active{false};
+  int peer{-1};
+  int chunk{0};
+  int slot{-1};
+};
+
+// How a step touches a region (work chunk or scratch slot). The hazard
+// check orders conflicting accesses: a wire step's effect is
+// asynchronous (send reads its source until drained; a receive writes
+// its landing region on arrival; recv_reduce's fold is deferred to the
+// first dependency demand), so any access that does not commute with it
+// needs a dependency path. Two reads commute; two reduce-folds into the
+// same chunk commute at contribution-set level (the interpreter
+// serializes them in program order); everything else does not.
+enum class AccessKind : uint8_t { kRead, kWrite, kRmw };
+
+struct Access {
+  bool slot;  // region kind: scratch slot vs work chunk
+  int idx;
+  AccessKind kind;
+};
+
+// The (at most two) region accesses of one step. Identical for the
+// synchronous view (the issuing step) and the asynchronous view (an
+// in-flight wire step) — wire opcodes' listed accesses ARE their async
+// effects.
+void accessesOf(StepOp op, const Operands& o, uint8_t flags,
+                std::vector<Access>& out) {
+  out.clear();
+  switch (op) {
+    case StepOp::kSend:
+      if (o.slot >= 0) {
+        out.push_back(Access{true, o.slot, AccessKind::kRead});
+      } else {
+        out.push_back(Access{false, o.chunk, AccessKind::kRead});
+      }
+      return;
+    case StepOp::kRecv:
+      if (o.slot >= 0) {
+        out.push_back(Access{true, o.slot, AccessKind::kWrite});
+      } else {
+        out.push_back(Access{false, o.chunk, AccessKind::kWrite});
+      }
+      return;
+    case StepOp::kRecvReduce:
+      out.push_back(Access{true, o.slot, AccessKind::kWrite});
+      out.push_back(Access{false, o.chunk, AccessKind::kRmw});
+      return;
+    case StepOp::kReduceLocal:
+      out.push_back(Access{true, o.slot, AccessKind::kRead});
+      out.push_back(Access{false, o.chunk, AccessKind::kRmw});
+      return;
+    case StepOp::kCopy:
+      if (flags & Step::kFlagToSlot) {
+        out.push_back(Access{false, o.chunk, AccessKind::kRead});
+        out.push_back(Access{true, o.slot, AccessKind::kWrite});
+      } else {
+        out.push_back(Access{true, o.slot, AccessKind::kRead});
+        out.push_back(Access{false, o.chunk, AccessKind::kWrite});
+      }
+      return;
+    case StepOp::kEncode:
+      out.push_back(Access{false, o.chunk, AccessKind::kRead});
+      out.push_back(Access{true, o.slot, AccessKind::kWrite});
+      return;
+    case StepOp::kDecode:
+      out.push_back(Access{true, o.slot, AccessKind::kRead});
+      out.push_back(Access{false, o.chunk, AccessKind::kWrite});
+      return;
+  }
+  TC_THROW(EnforceError, "unknown step op ", static_cast<int>(op));
+}
+
+bool accessesConflict(AccessKind inflight, AccessKind issuing) {
+  switch (inflight) {
+    case AccessKind::kRead:
+      return issuing != AccessKind::kRead;
+    case AccessKind::kWrite:
+      return true;
+    case AccessKind::kRmw:
+      return issuing != AccessKind::kRmw;
+  }
+  TC_THROW(EnforceError, "unknown access kind");
+}
+
+std::string maskStr(uint64_t mask) {
+  std::ostringstream out;
+  out << "{";
+  bool first = true;
+  for (int r = 0; r < 64; r++) {
+    if (mask & (uint64_t(1) << r)) {
+      if (!first) {
+        out << ",";
+      }
+      first = false;
+      out << r;
+    }
+  }
+  out << "}";
+  return out.str();
+}
+
+// Kahn's algorithm, smallest step index first among ready steps — the
+// one execution order every rank uses (deps are rank-independent).
+// Returns false and names a cycle member on failure.
+bool tryTopo(const Schedule& s, std::vector<int32_t>* order,
+             int* cycleStep) {
+  const int n = static_cast<int>(s.steps.size());
+  std::vector<int> indeg(n, 0);
+  std::vector<std::vector<int32_t>> dependents(n);
+  for (int i = 0; i < n; i++) {
+    for (int32_t d : s.steps[i].deps) {
+      dependents[d].push_back(i);
+      indeg[i]++;
+    }
+  }
+  std::priority_queue<int, std::vector<int>, std::greater<int>> ready;
+  for (int i = 0; i < n; i++) {
+    if (indeg[i] == 0) {
+      ready.push(i);
+    }
+  }
+  order->clear();
+  order->reserve(n);
+  while (!ready.empty()) {
+    const int i = ready.top();
+    ready.pop();
+    order->push_back(i);
+    for (int32_t dep : dependents[i]) {
+      if (--indeg[dep] == 0) {
+        ready.push(dep);
+      }
+    }
+  }
+  if (static_cast<int>(order->size()) == n) {
+    return true;
+  }
+  for (int i = 0; i < n; i++) {
+    if (indeg[i] > 0) {
+      *cycleStep = i;
+      break;
+    }
+  }
+  return false;
+}
+
+// One matched wire message: the k-th send a->b paired with the k-th
+// receive b posts from a (transport per-pair FIFO order == issue order,
+// because each rank issues in the shared topological order).
+struct Msg {
+  int sendRank, sendStep;
+  int recvRank, recvStep;
+  int chunk;
+  bool coded;
+  uint64_t mask{0};
+  bool sendIssued{false};
+  bool recvIssued{false};
+  bool applied{false};
+};
+
+struct RankState {
+  std::vector<uint64_t> work;     // per chunk: contribution set, 0 = unwritten
+  std::vector<uint64_t> scratch;  // per slot: contribution set, 0 = unwritten
+  std::vector<int> scratchChunk;  // per slot: geometry tag (chunk id), -1 none
+  std::vector<char> scratchCoded;
+  std::vector<char> issued;
+  int ptr{0};  // position in the topological order
+};
+
+}  // namespace
+
+std::optional<VerifyError> verify(const Schedule& s) {
+  const int world = s.worldSize;
+  const int n = static_cast<int>(s.steps.size());
+  auto err = [](VerifyCode code, int rank, int step, std::string msg) {
+    return VerifyError{code, rank, step, std::move(msg)};
+  };
+
+  if (world <= 0 || world > 64) {
+    return err(VerifyCode::kBadStep, -1, -1,
+               "world size must be in [1, 64] (contribution sets are one "
+               "machine word)");
+  }
+  if (s.nChunks <= 0) {
+    return err(VerifyCode::kBadStep, -1, -1, "chunk count must be positive");
+  }
+  if ((s.collective == Collective::kReduceScatter ||
+       s.collective == Collective::kAllgather) &&
+      s.nChunks != world) {
+    return err(VerifyCode::kBadStep, -1, -1,
+               "reduce_scatter/allgather schedules require chunks == "
+               "world_size (chunk c is rank c's block)");
+  }
+
+  // ---- structure: deps in range (rank-independent) ----
+  for (int i = 0; i < n; i++) {
+    for (int32_t d : s.steps[i].deps) {
+      if (d < 0 || d >= n) {
+        std::ostringstream msg;
+        msg << "dep " << d << " out of range [0, " << n << ")";
+        return err(VerifyCode::kBadStep, -1, i, msg.str());
+      }
+    }
+  }
+
+  // ---- structure: per-rank operands ----
+  std::vector<std::vector<Operands>> ops(world, std::vector<Operands>(n));
+  for (int r = 0; r < world; r++) {
+    for (int i = 0; i < n; i++) {
+      const Step& st = s.steps[i];
+      Operands& o = ops[r][i];
+      try {
+        o.active = st.guard.eval(r, world) != 0;
+        if (!o.active) {
+          continue;
+        }
+        o.peer = static_cast<int>(st.peer.eval(r, world));
+        o.chunk = static_cast<int>(st.chunk.eval(r, world));
+        o.slot = static_cast<int>(st.slot.eval(r, world));
+      } catch (const std::exception& e) {
+        return err(VerifyCode::kBadStep, r, i, e.what());
+      }
+      if (st.flags & ~(Step::kFlagToSlot | Step::kFlagCoded)) {
+        return err(VerifyCode::kBadStep, r, i, "unknown flag bits");
+      }
+      if ((st.flags & Step::kFlagToSlot) && st.op != StepOp::kCopy) {
+        return err(VerifyCode::kBadStep, r, i,
+                   "to_slot flag only applies to copy");
+      }
+      if ((st.flags & Step::kFlagCoded) &&
+          !(st.op == StepOp::kSend || st.op == StepOp::kRecv)) {
+        return err(VerifyCode::kBadStep, r, i,
+                   "coded flag only applies to send/recv (recv_reduce "
+                   "cannot fold coded bytes; recv then decode)");
+      }
+      if (o.chunk < 0 || o.chunk >= s.nChunks) {
+        std::ostringstream msg;
+        msg << "chunk " << o.chunk << " out of range [0, " << s.nChunks
+            << ")";
+        return err(VerifyCode::kBadStep, r, i, msg.str());
+      }
+      if (isWire(st.op)) {
+        if (o.peer < 0 || o.peer >= world || o.peer == r) {
+          std::ostringstream msg;
+          msg << "peer " << o.peer << " invalid for world " << world;
+          return err(VerifyCode::kBadStep, r, i, msg.str());
+        }
+      }
+      const bool slotRequired = st.op == StepOp::kRecvReduce ||
+                                st.op == StepOp::kReduceLocal ||
+                                st.op == StepOp::kCopy ||
+                                st.op == StepOp::kEncode ||
+                                st.op == StepOp::kDecode ||
+                                (st.flags & Step::kFlagCoded);
+      if (slotRequired && o.slot < 0) {
+        return err(VerifyCode::kBadStep, r, i,
+                   "step requires a scratch slot");
+      }
+      if (o.slot >= s.nScratch) {
+        std::ostringstream msg;
+        msg << "slot " << o.slot << " out of range [0, " << s.nScratch
+            << ")";
+        return err(VerifyCode::kBadStep, r, i, msg.str());
+      }
+    }
+  }
+
+  // ---- liveness: acyclic dependency graph ----
+  std::vector<int32_t> topo;
+  int cycleStep = -1;
+  if (!tryTopo(s, &topo, &cycleStep)) {
+    return err(VerifyCode::kDependencyCycle, -1, cycleStep,
+               "dependency edges form a cycle through this step");
+  }
+
+  // ---- matching: per-pair FIFO pairing of sends and receives ----
+  struct End {
+    int rank, step, chunk;
+    bool coded;
+  };
+  std::map<std::pair<int, int>, std::vector<End>> sendsOf, recvsOf;
+  for (int r = 0; r < world; r++) {
+    for (int32_t i : topo) {
+      const Operands& o = ops[r][i];
+      if (!o.active) {
+        continue;
+      }
+      const Step& st = s.steps[i];
+      const bool coded = (st.flags & Step::kFlagCoded) != 0;
+      if (st.op == StepOp::kSend) {
+        sendsOf[{r, o.peer}].push_back(End{r, i, o.chunk, coded});
+      } else if (isRecvKind(st.op)) {
+        recvsOf[{o.peer, r}].push_back(End{r, i, o.chunk, coded});
+      }
+    }
+  }
+  std::vector<Msg> msgs;
+  // msgOf[rank][step] -> index into msgs (each step is at most one
+  // message endpoint per rank).
+  std::vector<std::vector<int>> msgOf(world, std::vector<int>(n, -1));
+  for (const auto& pairSends : sendsOf) {
+    const auto& key = pairSends.first;
+    const auto& sends = pairSends.second;
+    auto rit = recvsOf.find(key);
+    const size_t nRecvs = rit == recvsOf.end() ? 0 : rit->second.size();
+    if (sends.size() != nRecvs) {
+      std::ostringstream msg;
+      msg << "rank " << key.first << " posts " << sends.size()
+          << " send(s) to rank " << key.second << " but rank " << key.second
+          << " posts " << nRecvs << " receive(s) from it";
+      return err(VerifyCode::kMessageMismatch, key.first, sends[0].step,
+                 msg.str());
+    }
+    for (size_t k = 0; k < sends.size(); k++) {
+      const End& se = sends[k];
+      const End& re = rit->second[k];
+      if (se.chunk != re.chunk || se.coded != re.coded) {
+        std::ostringstream msg;
+        msg << "message " << k << " of pair " << key.first << "->"
+            << key.second << ": send carries chunk " << se.chunk
+            << (se.coded ? " (coded)" : "") << " but receive step "
+            << re.step << " expects chunk " << re.chunk
+            << (re.coded ? " (coded)" : "");
+        return err(VerifyCode::kMessageMismatch, se.rank, se.step,
+                   msg.str());
+      }
+      msgOf[se.rank][se.step] = static_cast<int>(msgs.size());
+      msgOf[re.rank][re.step] = static_cast<int>(msgs.size());
+      msgs.push_back(Msg{se.rank, se.step, re.rank, re.step, se.chunk,
+                         se.coded});
+    }
+  }
+  for (const auto& pairRecvs : recvsOf) {
+    if (sendsOf.find(pairRecvs.first) == sendsOf.end()) {
+      const auto& key = pairRecvs.first;
+      std::ostringstream msg;
+      msg << "rank " << key.second << " posts "
+          << pairRecvs.second.size() << " receive(s) from rank "
+          << key.first << " but rank " << key.first << " posts no sends "
+          << "to it";
+      return err(VerifyCode::kMessageMismatch, key.second,
+                 pairRecvs.second[0].step, msg.str());
+    }
+  }
+
+  // ---- transitive dependency closure (rank-independent) ----
+  // closure[i] bit d set = step i transitively depends on step d. The
+  // hazard rule needs paths, not just direct edges.
+  const int words = (n + 63) / 64;
+  std::vector<std::vector<uint64_t>> closure(
+      n, std::vector<uint64_t>(words, 0));
+  for (int32_t i : topo) {
+    for (int32_t d : s.steps[i].deps) {
+      for (int w = 0; w < words; w++) {
+        closure[i][w] |= closure[d][w];
+      }
+      closure[i][d / 64] |= uint64_t(1) << (d % 64);
+    }
+  }
+  auto dependsOn = [&](int32_t i, int32_t d) {
+    return (closure[i][d / 64] >> (d % 64)) & 1;
+  };
+
+  // ---- dataflow + liveness simulation ----
+  std::vector<RankState> state(world);
+  for (int r = 0; r < world; r++) {
+    RankState& rs = state[r];
+    rs.work.assign(s.nChunks, 0);
+    rs.scratch.assign(s.nScratch, 0);
+    rs.scratchChunk.assign(s.nScratch, -1);
+    rs.scratchCoded.assign(s.nScratch, 0);
+    rs.issued.assign(n, 0);
+    const uint64_t self = uint64_t(1) << r;
+    if (s.collective == Collective::kAllgather) {
+      rs.work[r] = self;  // the rank's own block is the only valid input
+    } else {
+      for (int c = 0; c < s.nChunks; c++) {
+        rs.work[c] = self;
+      }
+    }
+  }
+
+  // Arrival effect of a matched message at its receiver.
+  auto applyArrival = [&](Msg& m) -> std::optional<VerifyError> {
+    RankState& rs = state[m.recvRank];
+    const Operands& o = ops[m.recvRank][m.recvStep];
+    const Step& st = s.steps[m.recvStep];
+    if (st.op == StepOp::kRecv) {
+      if (o.slot >= 0) {
+        rs.scratch[o.slot] = m.mask;
+        rs.scratchChunk[o.slot] = o.chunk;
+        rs.scratchCoded[o.slot] = m.coded ? 1 : 0;
+      } else {
+        rs.work[o.chunk] = m.mask;
+      }
+    } else {  // recv_reduce
+      if (rs.work[o.chunk] == 0) {
+        return err(VerifyCode::kStaleRead, m.recvRank, m.recvStep,
+                   "recv_reduce folds into an unwritten chunk");
+      }
+      if (rs.work[o.chunk] & m.mask) {
+        std::ostringstream msg;
+        msg << "chunk " << o.chunk << " already holds contributions "
+            << maskStr(rs.work[o.chunk]) << "; folding "
+            << maskStr(m.mask) << " from rank " << m.sendRank
+            << " would reduce " << maskStr(rs.work[o.chunk] & m.mask)
+            << " twice";
+        return err(VerifyCode::kChunkReducedTwice, m.recvRank, m.recvStep,
+                   msg.str());
+      }
+      rs.work[o.chunk] |= m.mask;
+      rs.scratch[o.slot] = m.mask;
+      rs.scratchChunk[o.slot] = o.chunk;
+      rs.scratchCoded[o.slot] = 0;
+    }
+    m.applied = true;
+    return std::nullopt;
+  };
+
+  // A dependency edge is satisfied when the dep step's *effects* are
+  // visible: locals on issue, sends once the matching receive is posted
+  // (the interpreter's drain), receives once the payload has arrived
+  // (matching send issued) and been applied.
+  auto depDone = [&](int r, int32_t d) {
+    const Operands& o = ops[r][d];
+    if (!o.active) {
+      return true;
+    }
+    if (!state[r].issued[d]) {
+      return false;
+    }
+    const StepOp op = s.steps[d].op;
+    if (op == StepOp::kSend) {
+      return msgs[msgOf[r][d]].recvIssued;
+    }
+    if (isRecvKind(op)) {
+      return msgs[msgOf[r][d]].applied;
+    }
+    return true;
+  };
+
+  // Issue-time effect of a step (wire arrivals excepted).
+  std::vector<Access> accesses, inflight;
+  auto issueStep = [&](int r, int32_t i) -> std::optional<VerifyError> {
+    RankState& rs = state[r];
+    const Operands& o = ops[r][i];
+    const Step& st = s.steps[i];
+    // Hazard: this step's accesses must commute with the asynchronous
+    // tail of every wire step already issued on this rank unless a
+    // dependency path orders them. (A send's source is read until the
+    // drain a dependency edge performs; a receive's landing region is
+    // written at arrival; a recv_reduce's fold into its chunk is
+    // deferred to the first dependency demand. The interpreter only
+    // synchronizes on declared edges, so nothing else orders these.)
+    accessesOf(st.op, o, st.flags, accesses);
+    for (int32_t q = 0; q < n; q++) {
+      if (q == i || !rs.issued[q] || !ops[r][q].active ||
+          !isWire(s.steps[q].op) || dependsOn(i, q)) {
+        continue;
+      }
+      accessesOf(s.steps[q].op, ops[r][q], s.steps[q].flags, inflight);
+      for (const Access& a : accesses) {
+        for (const Access& b : inflight) {
+          if (a.slot == b.slot && a.idx == b.idx &&
+              accessesConflict(b.kind, a.kind)) {
+            std::ostringstream msg;
+            msg << "touches " << (a.slot ? "slot " : "chunk ") << a.idx
+                << " while wire step " << q
+                << " is in flight with no dependency path between them";
+            return err(VerifyCode::kHazard, r, i, msg.str());
+          }
+        }
+      }
+    }
+    switch (st.op) {
+      case StepOp::kSend: {
+        uint64_t mask;
+        if (o.slot >= 0) {
+          if (rs.scratchChunk[o.slot] != o.chunk) {
+            std::ostringstream msg;
+            msg << "slot " << o.slot << " holds chunk "
+                << rs.scratchChunk[o.slot] << ", step sends chunk "
+                << o.chunk;
+            return err(VerifyCode::kBadStep, r, i, msg.str());
+          }
+          const bool coded = (st.flags & Step::kFlagCoded) != 0;
+          if (coded != (rs.scratchCoded[o.slot] != 0)) {
+            return err(VerifyCode::kBadStep, r, i,
+                       coded ? "coded send from an un-encoded slot"
+                             : "un-coded send from an encoded slot");
+          }
+          mask = rs.scratch[o.slot];
+        } else {
+          mask = rs.work[o.chunk];
+        }
+        if (mask == 0) {
+          return err(VerifyCode::kStaleRead, r, i,
+                     "send reads an unwritten region");
+        }
+        msgs[msgOf[r][i]].mask = mask;
+        msgs[msgOf[r][i]].sendIssued = true;
+        return std::nullopt;
+      }
+      case StepOp::kRecv:
+      case StepOp::kRecvReduce:
+        msgs[msgOf[r][i]].recvIssued = true;
+        return std::nullopt;
+      case StepOp::kReduceLocal: {
+        if (rs.scratch[o.slot] == 0) {
+          return err(VerifyCode::kStaleRead, r, i,
+                     "reduce_local reads an unwritten slot");
+        }
+        if (rs.scratchCoded[o.slot]) {
+          return err(VerifyCode::kBadStep, r, i,
+                     "reduce_local on a coded slot (decode first)");
+        }
+        if (rs.scratchChunk[o.slot] != o.chunk) {
+          std::ostringstream msg;
+          msg << "slot " << o.slot << " holds chunk "
+              << rs.scratchChunk[o.slot] << ", step folds into chunk "
+              << o.chunk;
+          return err(VerifyCode::kBadStep, r, i, msg.str());
+        }
+        if (rs.work[o.chunk] == 0) {
+          return err(VerifyCode::kStaleRead, r, i,
+                     "reduce_local folds into an unwritten chunk");
+        }
+        if (rs.work[o.chunk] & rs.scratch[o.slot]) {
+          std::ostringstream msg;
+          msg << "chunk " << o.chunk << " already holds contributions "
+              << maskStr(rs.work[o.chunk]) << "; folding "
+              << maskStr(rs.scratch[o.slot]) << " would reduce "
+              << maskStr(rs.work[o.chunk] & rs.scratch[o.slot])
+              << " twice";
+          return err(VerifyCode::kChunkReducedTwice, r, i, msg.str());
+        }
+        rs.work[o.chunk] |= rs.scratch[o.slot];
+        return std::nullopt;
+      }
+      case StepOp::kCopy:
+        if (st.flags & Step::kFlagToSlot) {
+          if (rs.work[o.chunk] == 0) {
+            return err(VerifyCode::kStaleRead, r, i,
+                       "copy reads an unwritten chunk");
+          }
+          rs.scratch[o.slot] = rs.work[o.chunk];
+          rs.scratchChunk[o.slot] = o.chunk;
+          rs.scratchCoded[o.slot] = 0;
+        } else {
+          if (rs.scratch[o.slot] == 0) {
+            return err(VerifyCode::kStaleRead, r, i,
+                       "copy reads an unwritten slot");
+          }
+          if (rs.scratchCoded[o.slot]) {
+            return err(VerifyCode::kBadStep, r, i,
+                       "copy from a coded slot (decode instead)");
+          }
+          if (rs.scratchChunk[o.slot] != o.chunk) {
+            std::ostringstream msg;
+            msg << "slot " << o.slot << " holds chunk "
+                << rs.scratchChunk[o.slot] << ", step copies to chunk "
+                << o.chunk;
+            return err(VerifyCode::kBadStep, r, i, msg.str());
+          }
+          rs.work[o.chunk] = rs.scratch[o.slot];
+        }
+        return std::nullopt;
+      case StepOp::kEncode:
+        if (rs.work[o.chunk] == 0) {
+          return err(VerifyCode::kStaleRead, r, i,
+                     "encode reads an unwritten chunk");
+        }
+        rs.scratch[o.slot] = rs.work[o.chunk];
+        rs.scratchChunk[o.slot] = o.chunk;
+        rs.scratchCoded[o.slot] = 1;
+        return std::nullopt;
+      case StepOp::kDecode:
+        if (rs.scratch[o.slot] == 0) {
+          return err(VerifyCode::kStaleRead, r, i,
+                     "decode reads an unwritten slot");
+        }
+        if (!rs.scratchCoded[o.slot]) {
+          return err(VerifyCode::kBadStep, r, i,
+                     "decode of an un-encoded slot");
+        }
+        if (rs.scratchChunk[o.slot] != o.chunk) {
+          std::ostringstream msg;
+          msg << "slot " << o.slot << " holds chunk "
+              << rs.scratchChunk[o.slot] << ", step decodes to chunk "
+              << o.chunk;
+          return err(VerifyCode::kBadStep, r, i, msg.str());
+        }
+        rs.work[o.chunk] = rs.scratch[o.slot];
+        return std::nullopt;
+    }
+    TC_THROW(EnforceError, "unknown step op ", static_cast<int>(st.op));
+  };
+
+  bool progress = true;
+  while (progress) {
+    progress = false;
+    for (Msg& m : msgs) {
+      if (m.sendIssued && m.recvIssued && !m.applied) {
+        if (auto e = applyArrival(m)) {
+          return e;
+        }
+        progress = true;
+      }
+    }
+    for (int r = 0; r < world; r++) {
+      RankState& rs = state[r];
+      while (rs.ptr < n) {
+        const int32_t i = topo[rs.ptr];
+        if (!ops[r][i].active) {
+          rs.issued[i] = 1;
+          rs.ptr++;
+          progress = true;
+          continue;
+        }
+        bool ready = true;
+        for (int32_t d : s.steps[i].deps) {
+          if (!depDone(r, d)) {
+            ready = false;
+            break;
+          }
+        }
+        if (!ready) {
+          break;
+        }
+        if (auto e = issueStep(r, i)) {
+          return e;
+        }
+        rs.issued[i] = 1;
+        rs.ptr++;
+        progress = true;
+      }
+    }
+  }
+  for (int r = 0; r < world; r++) {
+    if (state[r].ptr < n) {
+      const int32_t i = topo[state[r].ptr];
+      std::ostringstream msg;
+      msg << "no global progress possible; this step's dependencies can "
+             "never complete";
+      return err(VerifyCode::kDeadlock, r, i, msg.str());
+    }
+  }
+
+  // ---- completeness: the collective's postcondition ----
+  const uint64_t full =
+      world == 64 ? ~uint64_t(0) : (uint64_t(1) << world) - 1;
+  for (int r = 0; r < world; r++) {
+    for (int c = 0; c < s.nChunks; c++) {
+      uint64_t expected;
+      switch (s.collective) {
+        case Collective::kAllreduce:
+          expected = full;
+          break;
+        case Collective::kReduceScatter:
+          if (c != r) {
+            continue;  // only the rank's own block is the output
+          }
+          expected = full;
+          break;
+        case Collective::kAllgather:
+          expected = uint64_t(1) << c;
+          break;
+        default:
+          TC_THROW(EnforceError, "unknown collective");
+      }
+      if (state[r].work[c] != expected) {
+        std::ostringstream msg;
+        msg << "chunk " << c << " at rank " << r << " ends holding "
+            << maskStr(state[r].work[c]) << ", expected "
+            << maskStr(expected);
+        return err(VerifyCode::kUndelivered, r, -1, msg.str());
+      }
+    }
+  }
+  return std::nullopt;
+}
+
+void verifyOrThrow(const Schedule& s) {
+  if (auto e = verify(s)) {
+    TC_THROW(EnforceError, e->format(s));
+  }
+}
+
+std::vector<int32_t> topoOrder(const Schedule& s, int rank) {
+  (void)rank;  // deps are rank-independent; every rank shares one order
+  std::vector<int32_t> order;
+  int cycleStep = -1;
+  TC_ENFORCE(tryTopo(s, &order, &cycleStep), "schedule \"", s.name,
+             "\": dependency cycle through step ", cycleStep);
+  return order;
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

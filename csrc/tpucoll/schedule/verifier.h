@@ -1,0 +1,81 @@
+// Static verifier for collective schedules (ir.h).
+//
+// A schedule is admitted to the interpreter only after this module
+// proves, per rank and globally, that it computes its declared
+// collective:
+//
+//   - structure: every operand in range, op-specific slot/peer rules,
+//     dependency indices valid (bad_step);
+//   - liveness: the per-rank dependency graph is acyclic
+//     (dependency_cycle) and the global exchange reaches completion
+//     under a conservative rendezvous model — a send and its matching
+//     receive complete together (deadlock);
+//   - matching: the k-th send rank a posts toward rank b pairs with the
+//     k-th receive rank b posts from rank a (the transport's per-pair
+//     FIFO), and the pair must agree on chunk id and wire coding
+//     (message_mismatch);
+//   - dataflow: contribution sets are tracked per chunk per rank —
+//     reading an unwritten region is stale_read, folding a contribution
+//     a chunk already holds is chunk_reduced_twice, touching a region
+//     with an unordered in-flight receive is hazard;
+//   - completeness: the final contribution sets match the collective's
+//     postcondition everywhere, else undelivered.
+//
+// The model is conservative with respect to the interpreter
+// (interpreter.cc): each rank issues steps sequentially in the
+// deterministic topological order computed here (Kahn, smallest index
+// first), waiting only on declared dependency edges, so any execution
+// the interpreter can produce is an interleaving this simulation
+// admits. Worlds up to 64 ranks are supported (contribution sets are
+// one machine word); larger schedules are rejected loudly rather than
+// checked partially.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tpucoll/schedule/ir.h"
+
+namespace tpucoll {
+namespace schedule {
+
+enum class VerifyCode : uint8_t {
+  kBadStep = 0,
+  kDependencyCycle = 1,
+  kMessageMismatch = 2,
+  kStaleRead = 3,
+  kChunkReducedTwice = 4,
+  kHazard = 5,
+  kDeadlock = 6,
+  kUndelivered = 7,
+};
+
+const char* verifyCodeName(VerifyCode code);
+
+struct VerifyError {
+  VerifyCode code{VerifyCode::kBadStep};
+  int rank{-1};  // -1 = not rank-specific
+  int step{-1};  // -1 = not step-specific
+  std::string message;
+
+  // "chunk_reduced_twice at rank 1 step 4 (rs_rr_1): ..."
+  std::string format(const Schedule& s) const;
+};
+
+// Full static check; nullopt = the schedule provably computes its
+// declared collective under the model above.
+std::optional<VerifyError> verify(const Schedule& s);
+
+// verify() + TC_THROW(EnforceError) with the formatted error.
+void verifyOrThrow(const Schedule& s);
+
+// The deterministic per-rank execution order the verifier proved safe:
+// Kahn's algorithm, smallest step index first among ready steps. The
+// interpreter issues steps in exactly this order. Throws on a
+// dependency cycle (callers verify first).
+std::vector<int32_t> topoOrder(const Schedule& s, int rank);
+
+}  // namespace schedule
+}  // namespace tpucoll

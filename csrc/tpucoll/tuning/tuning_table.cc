@@ -86,6 +86,26 @@ std::optional<double> TuningTable::curveCost(const Curve& curve,
   return lo->second + t * (hi->second - lo->second);
 }
 
+const TuningTable::Curve* TuningTable::findCurve(
+    const std::string& collective, const std::string& algorithm,
+    int worldSize, const std::string& dtype) const {
+  // Exact dtype first; fall back to dtype-agnostic aggregation (cheapest
+  // curve point across dtypes would mix curves — instead use the first
+  // matching curve in key order, which is deterministic on every rank).
+  auto it = cells_.find(Key{collective, algorithm, worldSize, dtype});
+  if (it != cells_.end()) {
+    return &it->second;
+  }
+  for (const auto& cell : cells_) {
+    if (cell.first.collective == collective &&
+        cell.first.algorithm == algorithm &&
+        cell.first.worldSize == worldSize) {
+      return &cell.second;
+    }
+  }
+  return nullptr;
+}
+
 std::optional<double> TuningTable::cost(const std::string& collective,
                                         const std::string& algorithm,
                                         int worldSize,
@@ -93,33 +113,45 @@ std::optional<double> TuningTable::cost(const std::string& collective,
                                         size_t nbytes) const {
   const double x =
       std::log2(static_cast<double>(nbytes > 0 ? nbytes : 1));
-  // Exact dtype first; fall back to dtype-agnostic aggregation (cheapest
-  // curve point across dtypes would mix curves — instead use the first
-  // matching curve in key order, which is deterministic on every rank).
-  auto it = cells_.find(Key{collective, algorithm, worldSize, dtype});
-  if (it != cells_.end()) {
-    return curveCost(it->second, x);
+  const Curve* curve = findCurve(collective, algorithm, worldSize, dtype);
+  if (curve == nullptr) {
+    return std::nullopt;
   }
-  for (const auto& cell : cells_) {
-    if (cell.first.collective == collective &&
-        cell.first.algorithm == algorithm &&
-        cell.first.worldSize == worldSize) {
-      return curveCost(cell.second, x);
-    }
-  }
-  return std::nullopt;
+  return curveCost(*curve, x);
 }
 
 std::optional<std::string> TuningTable::choose(
     const std::string& collective, int worldSize, const std::string& dtype,
     size_t nbytes, const std::vector<std::string>& allowed) const {
+  const double x =
+      std::log2(static_cast<double>(nbytes > 0 ? nbytes : 1));
+  // Two-pass election. Pass 1 considers only candidates whose measured
+  // bucket range covers x: beyond its largest measured bucket a curve's
+  // clamped edge cost is an extrapolation, and comparing it against a
+  // curve genuinely measured at x let ragged sweeps elect an algorithm
+  // octaves outside its evidence (e.g. an arm swept only to 64 KiB
+  // "winning" the 16 MiB cell on its 64 KiB cost). Pass 2 — all
+  // candidates out of range — falls back to the clamped comparison:
+  // edge evidence beats no evidence.
   std::optional<std::string> best;
   double bestCost = std::numeric_limits<double>::infinity();
+  bool bestCovered = false;
   for (const std::string& algo : allowed) {
-    auto c = cost(collective, algo, worldSize, dtype, nbytes);
-    if (c.has_value() && *c < bestCost) {
+    const Curve* curve = findCurve(collective, algo, worldSize, dtype);
+    if (curve == nullptr || curve->empty()) {
+      continue;
+    }
+    const bool covered =
+        x >= curve->begin()->first && x <= std::prev(curve->end())->first;
+    auto c = curveCost(*curve, x);
+    if (!c.has_value()) {
+      continue;
+    }
+    if ((covered && !bestCovered) ||
+        (covered == bestCovered && *c < bestCost)) {
       bestCost = *c;
       best = algo;
+      bestCovered = covered;
     }
   }
   return best;
@@ -171,7 +203,7 @@ std::string TuningTable::toJson() const {
 
 TuningTable TuningTable::fromJson(const std::string& json) {
   using Kind = JsonReader::Value::Kind;
-  JsonReader reader(json, "tuning table JSON");
+  JsonReader reader(json, "tuning table JSON", /*rejectDuplicateKeys=*/true);
   const JsonReader::Value root = reader.parse();
   TC_ENFORCE(root.kind == Kind::kObject,
              "tuning table JSON: root must be an object");

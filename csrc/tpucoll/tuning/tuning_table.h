@@ -51,7 +51,12 @@ class TuningTable {
   // candidate's cost curve is interpolated linearly in log2-size space
   // between its measured buckets (the "interpolated crossover": where two
   // curves cross between buckets, the winner flips there, not at a bucket
-  // edge), clamped flat outside the swept range. Only algorithms in
+  // edge), clamped flat outside the swept range. Clamped edge costs are
+  // extrapolations, though: a candidate measured only octaves below the
+  // query must not beat one actually measured there on the strength of
+  // its small-size edge cost. Candidates whose sweep covers the query
+  // bucket are therefore preferred; the flat-clamped comparison is the
+  // fallback only when no candidate covers it. Only algorithms in
   // `allowed` participate (dispatch excludes opt-in variants like
   // bf16-wire whose numerics differ). An empty `dtype` matches any; a
   // non-empty dtype falls back to ignoring dtype when it has no exact
@@ -118,6 +123,11 @@ class TuningTable {
   using Curve = std::map<int, double>;
 
   std::optional<double> curveCost(const Curve& curve, double x) const;
+  // The curve for (collective, algorithm, worldSize, dtype), honoring the
+  // dtype-wildcard fallback documented on choose(). nullptr if none.
+  const Curve* findCurve(const std::string& collective,
+                         const std::string& algorithm, int worldSize,
+                         const std::string& dtype) const;
 
   std::map<Key, Curve> cells_;
   TransportHints transport_;

@@ -17,7 +17,7 @@ Two data planes, mirroring the reference's tcp-vs-ibverbs/CUDA split
 # NOT imported here — it would drag the multi-second jax import into
 # every host-plane-only process. The device-plane packages
 # (gloo_tpu.tpu / .ops / .parallel / .models) import it themselves.
-from gloo_tpu import elastic, fault, tuning
+from gloo_tpu import elastic, fault, schedule, tuning
 from gloo_tpu.bootstrap import detect_launch_env, init_from_env
 from gloo_tpu.bucketer import GradientBucketer
 from gloo_tpu.core import (
@@ -79,6 +79,7 @@ __all__ = [
     "q8_decode",
     "q8_encode",
     "q8_wire_bytes",
+    "schedule",
     "tuning",
     "uring_available",
 ]

@@ -181,6 +181,23 @@ _PROTOTYPES = {
     "tc_tuning_install": (_int, [_c, ctypes.c_char_p]),
     "tc_tuning_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # collective schedule plane (algorithms as data)
+    "tc_schedule_install": (_int, [_c, ctypes.c_char_p]),
+    "tc_schedule_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_schedule_list": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_schedule_describe": (_int, [_c, ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.POINTER(
+                                        ctypes.c_uint8)),
+                                    ctypes.POINTER(_sz)]),
+    "tc_schedule_generate": (_int, [ctypes.c_char_p, _int, ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.POINTER(
+                                        ctypes.c_uint8)),
+                                    ctypes.POINTER(_sz)]),
+    "tc_schedule_families": (_int, [ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_schedule_verify": (_int, [ctypes.c_char_p]),
     # collectives
     "tc_barrier": (_int, [_c, _int, _u32, _i64]),
     "tc_broadcast": (_int, [_c, _c, _sz, _int, _int, _int, _u32, _i64]),

@@ -1,0 +1,19 @@
+#include "tpucoll/schedule/ir.h"
+
+namespace tpucoll {
+namespace schedule {
+
+int lower(StepOp op) {
+  switch (op) {
+    case StepOp::kSend:
+      return 0;
+    case StepOp::kRecv:
+      return 1;
+    // kDecode missing: the violation under test.
+    default:
+      return -1;
+  }
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

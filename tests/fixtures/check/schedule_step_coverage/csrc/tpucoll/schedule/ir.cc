@@ -1,0 +1,14 @@
+#include "tpucoll/schedule/ir.h"
+
+namespace tpucoll {
+namespace schedule {
+
+const char* stepOpName(StepOp op) {
+  if (op == StepOp::kSend) return "send";
+  if (op == StepOp::kRecv) return "recv";
+  // kDecode missing from the name table: the violation under test.
+  return "?";
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

@@ -1,0 +1,17 @@
+// Fixture: kDecode is declared but interpreter.cc never lowers it and
+// ir.cc never names it; verifier.cc handles a kGhost op that no longer
+// exists.
+#pragma once
+#include <cstdint>
+
+namespace tpucoll {
+namespace schedule {
+
+enum class StepOp : uint8_t {
+  kSend = 0,
+  kRecv = 1,
+  kDecode = 2,
+};
+
+}  // namespace schedule
+}  // namespace tpucoll

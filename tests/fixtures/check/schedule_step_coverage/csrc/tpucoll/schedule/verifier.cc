@@ -1,0 +1,21 @@
+#include "tpucoll/schedule/ir.h"
+
+namespace tpucoll {
+namespace schedule {
+
+int classify(StepOp op) {
+  switch (op) {
+    case StepOp::kSend:
+      return 0;
+    case StepOp::kRecv:
+      return 1;
+    case StepOp::kDecode:
+      return 2;
+    case StepOp::kGhost:  // removed from the enum: stale case
+      return 3;
+  }
+  return -1;
+}
+
+}  // namespace schedule
+}  // namespace tpucoll

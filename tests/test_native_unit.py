@@ -261,6 +261,83 @@ def test_asan_plan_replay_smoke():
     assert "ASAN-PLAN-SMOKE-OK" in result.stdout, result.stdout
 
 
+def test_asan_schedule_replay_smoke():
+    """Skip-unless-built ASan smoke for the schedule interpreter: warm
+    scheduled replays (the pipelined ring the native enum cannot
+    express), an install/clear invalidation cycle, and a reduce_scatter
+    schedule — the arena/slot-bookkeeping reuse pattern that would
+    expose a use-after-free in a resolved program or its plan."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    prog = textwrap.dedent(f"""
+        import json
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        from gloo_tpu import schedule
+        from tests.harness import spawn
+
+        def fn(ctx, rank):
+            x = np.full(4096, float(rank + 1), dtype=np.float32)
+            t = schedule.generate("ring", 2, {{"depth": 2}})
+            t["elections"] = [{{
+                "collective": "allreduce", "world_size": 2, "dtype": "",
+                "bucket": x.nbytes.bit_length() - 1,
+                "schedule": t["schedules"][0]["name"]}}]
+            schedule.install(ctx, t)
+            ub = None
+            for i in range(50):
+                x[:] = rank + 1
+                ctx.allreduce(x, tag=1)
+                assert x[0] == 3.0, (i, x[0])
+                if i > 0:  # first call builds the plan
+                    m = ctx.metrics()["ubuf_creates"]
+                    if ub is None:
+                        ub = m
+                    else:
+                        assert m == ub, "scheduled replay registered"
+            # Invalidate mid-life (install drops every plan), rebuild,
+            # replay: the dropped plan's scratch must drain cleanly.
+            rs = schedule.generate("ring_rs", 2)
+            rs["elections"] = [{{
+                "collective": "reduce_scatter", "world_size": 2,
+                "dtype": "", "bucket": x.nbytes.bit_length() - 1,
+                "schedule": rs["schedules"][0]["name"]}}]
+            schedule.install(ctx, schedule.merge(t, rs))
+            for i in range(25):
+                x[:] = rank + 1
+                ctx.allreduce(x, tag=1)
+                ctx.reduce_scatter(x.copy(), tag=2)
+            schedule.clear(ctx)
+            x[:] = rank + 1
+            ctx.allreduce(x, tag=1)  # native dispatch after clear
+            assert x[0] == 3.0
+            ctx.barrier(tag=9)
+            return True
+
+        res = spawn(2, fn, timeout=120)
+        assert res == [True, True], res
+        print("ASAN-SCHED-SMOKE-OK")
+    """)
+    preloads = []
+    for name in ("libasan.so", "libstdc++.so"):
+        p = subprocess.run(["g++", "-print-file-name=" + name],
+                           capture_output=True, text=True,
+                           check=True).stdout.strip()
+        if not os.path.isabs(p):
+            pytest.skip(f"{name} runtime not found beside g++")
+        preloads.append(p)
+    env = dict(os.environ, TPUCOLL_LIB=lib, TPUCOLL_SKIP_BUILD="1",
+               LD_PRELOAD=" ".join(preloads),
+               ASAN_OPTIONS="detect_leaks=0,abort_on_error=1")
+    result = subprocess.run([sys.executable, "-c", prog],
+                            capture_output=True, text=True, timeout=300,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "ASAN-SCHED-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_asan_smoke():
     """Skip-unless-built AddressSanitizer smoke: when the sanitizer
     flavor exists (`make native SANITIZE=address`), run a small 2-rank

@@ -1,0 +1,657 @@
+"""Collective schedule plane (csrc/tpucoll/schedule/): IR round trips,
+the static verifier's typed rejections, generator families proven
+byte-identical to the native algorithms through real multiprocess
+groups, plan-cache integration (zero-allocation warm replays, install/
+clear invalidation including async-lane sub-contexts), election
+dispatch observed through the tracer and flight recorder, the
+TPUCOLL_SCHEDULE_FILE hook, the sweep smoke, and same-seed chaos
+determinism with schedules installed.
+
+Dispatch decisions are asserted through the tracer/flightrec algorithm
+labels ("sched:<name>"), so these tests observe the native dispatcher
+itself, not a Python re-implementation of it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import _lib, schedule
+from tests.harness import spawn
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    old = {k: os.environ.get(k) for k in kv}
+    os.environ.update({k: str(v) for k, v in kv.items()})
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _spans(events, name):
+    return [e["args"].get("detail") for e in events if e["name"] == name]
+
+
+def _elect(table, collective, world, nbytes, dtype=""):
+    """Add a single election for (collective, world, bucket(nbytes))."""
+    name = table["schedules"][0]["name"]
+    table = json.loads(json.dumps(table))
+    table["elections"] = [{
+        "collective": collective, "world_size": world, "dtype": dtype,
+        "bucket": nbytes.bit_length() - 1, "schedule": name,
+    }]
+    return table
+
+
+RING = {"kind": "ring", "a": 1}
+
+
+def _fixture(steps, chunks=2, scratch=2, collective="allreduce", world=2):
+    return {"version": 1, "schedules": [{
+        "name": "fix", "collective": collective, "world_size": world,
+        "chunks": chunks, "scratch": scratch, "steps": steps}]}
+
+
+# A correct staged P=2 exchange of chunk 0 (send + recv-to-slot + fold).
+_GOOD_C0 = [
+    {"op": "send", "peer": RING, "chunk": 0},
+    {"op": "recv", "peer": RING, "chunk": 0, "slot": 0},
+    {"op": "reduce_local", "chunk": 0, "slot": 0, "deps": [0, 1]},
+]
+
+
+# ---- generators + verifier (context-free) ----------------------------------
+
+
+def test_generator_families_verify():
+    """Every family generates + statically verifies across a world grid
+    (tc_schedule_generate runs the verifier before returning)."""
+    fams = schedule.families()
+    assert {"ring", "hd", "bcube", "ring_bf16", "hier",
+            "ring_rs", "ring_ag", "hd_rs", "hd_ag"} <= set(fams)
+    for world in (1, 2, 3, 4, 6, 8):
+        for fam in fams:
+            if fam.startswith("hd") and world & (world - 1):
+                with pytest.raises(gloo_tpu.Error, match="power of two"):
+                    schedule.generate(fam, world)
+                continue
+            t = schedule.generate(fam, world)
+            s = t["schedules"][0]
+            assert s["world_size"] == world
+            assert s["name"]
+
+
+def test_generator_params():
+    """Pipelined-ring depth and hier ranks_per_host parameterize the
+    emitted program; unknown params and families fail loudly."""
+    flat = schedule.generate("ring", 4, {"depth": 1})["schedules"][0]
+    deep = schedule.generate("ring", 4, {"depth": 4})["schedules"][0]
+    assert len(deep["steps"]) > len(flat["steps"])
+    h = schedule.generate("hier", 6, {"ranks_per_host": 3})["schedules"][0]
+    assert h["name"] == "hier_p6_h3"
+    with pytest.raises(gloo_tpu.Error, match="no param"):
+        schedule.generate("ring", 4, {"bogus": 1})
+    with pytest.raises(gloo_tpu.Error, match="unknown schedule family"):
+        schedule.generate("nope", 4)
+    with pytest.raises(gloo_tpu.Error, match="divide"):
+        schedule.generate("hier", 6, {"ranks_per_host": 4})
+
+
+def test_json_round_trip():
+    """generate -> serialize -> parse -> serialize is a fixed point."""
+    for fam in ("ring", "hd", "bcube", "ring_bf16", "hier"):
+        t = schedule.generate(fam, 4)
+        once = json.dumps(t, sort_keys=True)
+        ctx = gloo_tpu.Context(0, 4)  # install needs no transport
+        schedule.install(ctx, t)
+        again = schedule.installed(ctx)
+        assert json.dumps(again, sort_keys=True) == once, fam
+
+
+def test_verifier_rejects_chunk_reduced_twice():
+    bad = _fixture(_GOOD_C0 + [
+        {"op": "reduce_local", "chunk": 0, "slot": 0, "deps": [2],
+         "note": "double_fold"}])
+    with pytest.raises(gloo_tpu.Error) as ei:
+        schedule.verify(bad)
+    assert "chunk_reduced_twice" in str(ei.value)
+    assert "double_fold" in str(ei.value)  # errors name the step
+
+
+def test_verifier_rejects_undelivered():
+    with pytest.raises(gloo_tpu.Error) as ei:
+        schedule.verify(_fixture(list(_GOOD_C0)))  # chunk 1 never moves
+    assert "undelivered" in str(ei.value)
+    assert "chunk 1" in str(ei.value)
+
+
+def test_verifier_rejects_dependency_cycle():
+    bad = _fixture([
+        {"op": "send", "peer": RING, "chunk": 0, "deps": [1]},
+        {"op": "recv", "peer": RING, "chunk": 0, "slot": 0, "deps": [0]},
+        {"op": "reduce_local", "chunk": 0, "slot": 0, "deps": [0, 1]},
+    ])
+    with pytest.raises(gloo_tpu.Error, match="dependency_cycle"):
+        schedule.verify(bad)
+
+
+def test_verifier_rejects_unsynchronized_wire_hazard():
+    """A fold racing an in-flight send with no dependency path is the
+    hazard class the closure rule exists for."""
+    bad = _fixture([
+        {"op": "send", "peer": RING, "chunk": 0},
+        {"op": "recv_reduce", "peer": RING, "chunk": 0, "slot": 0},
+    ] + [
+        {"op": "send", "peer": RING, "chunk": 1, "deps": [1]},
+        {"op": "recv", "peer": RING, "chunk": 1, "slot": 1, "deps": [1]},
+        {"op": "reduce_local", "chunk": 1, "slot": 1, "deps": [2, 3]},
+    ])
+    with pytest.raises(gloo_tpu.Error, match="hazard"):
+        schedule.verify(bad)
+
+
+def test_verify_accepts_correct_fixture():
+    full = _GOOD_C0 + [
+        {"op": "send", "peer": RING, "chunk": 1},
+        {"op": "recv", "peer": RING, "chunk": 1, "slot": 1},
+        {"op": "reduce_local", "chunk": 1, "slot": 1, "deps": [3, 4]},
+    ]
+    schedule.verify(_fixture(full))
+
+
+def test_duplicate_json_key_rejected_with_path():
+    """Strict parsing (common/json.h): duplicate object keys fail
+    loudly, naming the offending key's dotted path."""
+    t = schedule.generate("ring", 2)
+    raw = json.dumps(t)
+    # Duplicate a step-level key: "op" appears twice in steps[0].
+    needle = '"op": "send"'
+    assert needle in raw
+    dup = raw.replace(needle, '"op": "send", "op": "send"', 1)
+    with pytest.raises(gloo_tpu.Error) as ei:
+        schedule.verify(dup)
+    msg = str(ei.value)
+    assert "duplicate key" in msg
+    assert "steps[0].op" in msg
+    # Top-level duplicate too.
+    dup2 = raw[:-1] + ', "version": 1}'
+    with pytest.raises(gloo_tpu.Error, match="duplicate key"):
+        schedule.verify(dup2)
+
+
+def test_install_requires_connect_worthy_table():
+    """Malformed tables and semantically invalid schedules never
+    install — and a failed install leaves the previous plane intact."""
+    ctx = gloo_tpu.Context(0, 2)
+    good = schedule.generate("ring", 2)
+    schedule.install(ctx, good)
+    assert schedule.installed(ctx) is not None
+    with pytest.raises(gloo_tpu.Error):
+        schedule.install(ctx, "{not json")
+    with pytest.raises(gloo_tpu.Error, match="undelivered"):
+        schedule.install(ctx, _fixture(list(_GOOD_C0)))
+    still = schedule.installed(ctx)
+    assert still["schedules"][0]["name"] == good["schedules"][0]["name"]
+    schedule.clear(ctx)
+    assert schedule.installed(ctx) is None
+
+
+def test_list_and_describe():
+    ctx = gloo_tpu.Context(0, 2)
+    t = schedule.merge(schedule.generate("ring", 2),
+                       schedule.generate("hd", 4))
+    schedule.install(ctx, t)
+    listing = {s["name"]: s for s in schedule.list_schedules(ctx)}
+    assert listing["ring_p2"]["resolved"] == 1
+    assert listing["hd_p4"]["resolved"] == 0  # wrong world: carried only
+    assert listing["ring_p2"]["collective"] == "allreduce"
+    d = schedule.describe(ctx, "ring_p2")
+    assert d["schedules"][0]["steps"]
+    with pytest.raises(gloo_tpu.Error, match="no installed"):
+        schedule.describe(ctx, "nope")
+
+
+# ---- equivalence vs native (real groups) -----------------------------------
+
+
+ALLREDUCE_FAMILIES = [
+    ("ring", {}),
+    ("ring", {"depth": 2}),
+    ("ring", {"depth": 4}),
+    ("hd", {}),
+    ("bcube", {}),
+    ("hier", {"ranks_per_host": 2}),
+]
+
+
+@pytest.mark.parametrize("fam,params", ALLREDUCE_FAMILIES,
+                         ids=lambda v: str(v))
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_allreduce_matches_native(fam, params, world):
+    """Interpreter replays are byte-identical to the native dispatch,
+    consensus-asserted: every rank compares its scheduled result to its
+    native result AND all ranks' bytes agree. Integer-valued payloads
+    make float addition exact, so fold order cannot blur the check."""
+    if fam == "hd" and world & (world - 1):
+        pytest.skip("hd needs a power-of-two world")
+    if fam == "hier" and world % params["ranks_per_host"]:
+        pytest.skip("ranks_per_host must divide world")
+
+    def fn(ctx, rank):
+        digests = []
+        for count, dtype in ((1536, np.float32), (1000, np.int32),
+                             (9, np.float64), (256, np.uint8)):
+            base = (np.random.RandomState(77 + rank)
+                    .randint(0, 50, size=count).astype(dtype))
+            native = base.copy()
+            ctx.allreduce(native)
+            t = _elect(schedule.generate(fam, world, params), "allreduce",
+                       world, count * base.itemsize)
+            schedule.install(ctx, t)
+            got = base.copy()
+            ctx.allreduce(got)
+            warm = base.copy()
+            ctx.allreduce(warm)
+            schedule.clear(ctx)
+            assert np.array_equal(native, got), (fam, world, dtype)
+            assert np.array_equal(native, warm), (fam, world, dtype)
+            digests.append(got.tobytes())
+        return digests
+
+    results = spawn(world, fn, timeout=90)
+    for per_rank in zip(*results):
+        assert len(set(per_rank)) == 1  # consensus across ranks
+
+
+@pytest.mark.parametrize("fam", ["ring_rs", "hd_rs"])
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_reduce_scatter_matches_native(fam, world):
+    if fam == "hd_rs" and world & (world - 1):
+        pytest.skip("hd needs a power-of-two world")
+
+    def fn(ctx, rank):
+        per = 96
+        base = (np.random.RandomState(3 + rank)
+                .randint(0, 40, size=per * world).astype(np.float32))
+        native = ctx.reduce_scatter(base.copy())
+        t = _elect(schedule.generate(fam, world), "reduce_scatter",
+                   world, per * world * 4)
+        schedule.install(ctx, t)
+        got = ctx.reduce_scatter(base.copy())
+        warm = ctx.reduce_scatter(base.copy())
+        schedule.clear(ctx)
+        assert np.array_equal(native, got)
+        assert np.array_equal(native, warm)
+        return got.tobytes()
+
+    spawn(world, fn, timeout=60)
+
+
+@pytest.mark.parametrize("fam", ["ring_ag", "hd_ag"])
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_allgather_matches_native(fam, world):
+    if fam == "hd_ag" and world & (world - 1):
+        pytest.skip("hd needs a power-of-two world")
+
+    def fn(ctx, rank):
+        per = 128
+        base = (np.random.RandomState(11 + rank)
+                .randint(0, 90, size=per).astype(np.int32))
+        native = ctx.allgather(base)
+        t = _elect(schedule.generate(fam, world), "allgather",
+                   world, per * world * 4)
+        schedule.install(ctx, t)
+        got = ctx.allgather(base)
+        warm = ctx.allgather(base)
+        schedule.clear(ctx)
+        assert np.array_equal(native, got)
+        assert np.array_equal(native, warm)
+        return got.tobytes()
+
+    results = spawn(world, fn, timeout=60)
+    assert len(set(results)) == 1
+
+
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_bf16_coded_schedule_needs_lossy_opt_in(world):
+    """The generated bf16-wire ring only fires under the same
+    float32+sum+wire="lossy" opt-in as the native coded arms; a plain
+    allreduce with the same election falls through to native dispatch.
+    Small-integer payloads round-trip bf16 exactly, so even the coded
+    path must be byte-exact here."""
+    def fn(ctx, rank):
+        count = 384
+        base = (np.random.RandomState(21 + rank)
+                .randint(0, 60, size=count).astype(np.float32))
+        expected = np.zeros(count, dtype=np.float32)
+        for r in range(world):
+            expected += (np.random.RandomState(21 + r)
+                         .randint(0, 60, size=count).astype(np.float32))
+        t = _elect(schedule.generate("ring_bf16", world), "allreduce",
+                   world, count * 4)
+        schedule.install(ctx, t)
+        ctx.trace_start()
+        coded = base.copy()
+        ctx.allreduce(coded, wire="lossy")
+        plain = base.copy()
+        ctx.allreduce(plain)
+        spans = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        schedule.clear(ctx)
+        assert np.array_equal(expected, coded)
+        assert np.array_equal(expected, plain)
+        name = t["schedules"][0]["name"]
+        assert f"sched:{name}" in spans
+        # The plain call must NOT have used the coded schedule.
+        assert spans.count(f"sched:{name}") == 1
+        return True
+
+    assert spawn(world, fn, timeout=60) == [True] * world
+
+
+def test_uneven_recv_counts_fall_back_to_native():
+    """Generated reduce-scatter schedules assume even chunk geometry;
+    uneven recvCounts must ignore the election and still be correct."""
+    def fn(ctx, rank):
+        counts = [100, 156]
+        base = (np.arange(256) % 13 + rank).astype(np.float32)
+        native = ctx.reduce_scatter(base.copy(), recv_counts=counts)
+        t = _elect(schedule.generate("ring_rs", 2), "reduce_scatter",
+                   2, 256 * 4)
+        schedule.install(ctx, t)
+        got = ctx.reduce_scatter(base.copy(), recv_counts=counts)
+        schedule.clear(ctx)
+        assert np.array_equal(native, got)
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]
+
+
+# ---- dispatch observability + elections ------------------------------------
+
+
+def test_election_dispatch_visible_in_tracer_and_flightrec():
+    def fn(ctx, rank):
+        count = 512
+        base = np.full(count, float(rank + 1), dtype=np.float32)
+        t = _elect(schedule.generate("ring", 2, {"depth": 2}), "allreduce",
+                   2, count * 4)
+        name = t["schedules"][0]["name"]
+        schedule.install(ctx, t)
+        ctx.trace_start()
+        x = base.copy()
+        ctx.allreduce(x)
+        spans = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        algos = [e["algo"] for e in ctx.flightrec()["events"]
+                 if e["op"] == "allreduce"]
+        schedule.clear(ctx)
+        # After clear, native dispatch returns.
+        y = base.copy()
+        ctx.allreduce(y)
+        assert np.array_equal(x, y)
+        assert spans == [f"sched:{name}"]
+        assert algos[-1] == f"sched:{name}"
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]
+
+
+def test_election_exact_dtype_beats_wildcard():
+    def fn(ctx, rank):
+        count = 512
+        nbytes = count * 4
+        ring = schedule.generate("ring", 2)
+        hd = schedule.generate("hd", 2)
+        t = schedule.merge(ring, hd)
+        t["elections"] = [
+            {"collective": "allreduce", "world_size": 2, "dtype": "",
+             "bucket": nbytes.bit_length() - 1, "schedule": "ring_p2"},
+            {"collective": "allreduce", "world_size": 2,
+             "dtype": "float32", "bucket": nbytes.bit_length() - 1,
+             "schedule": "hd_p2"},
+        ]
+        schedule.install(ctx, t)
+        ctx.trace_start()
+        x = np.full(count, 1.0, dtype=np.float32)
+        ctx.allreduce(x)            # exact float32 cell -> hd_p2
+        y = np.full(count, 1, dtype=np.int32)
+        ctx.allreduce(y)            # wildcard cell -> ring_p2
+        spans = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        schedule.clear(ctx)
+        assert spans == ["sched:hd_p2", "sched:ring_p2"], spans
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]
+
+
+def test_unelected_sizes_use_native_dispatch():
+    """An election binds ONE log2 bucket; other sizes stay native."""
+    def fn(ctx, rank):
+        t = _elect(schedule.generate("ring", 2), "allreduce", 2, 4096)
+        schedule.install(ctx, t)
+        ctx.trace_start()
+        small = np.full(16, 1.0, dtype=np.float32)    # 64 B: not elected
+        ctx.allreduce(small)
+        hit = np.full(1024, 1.0, dtype=np.float32)    # 4 KiB: elected
+        ctx.allreduce(hit)
+        spans = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        schedule.clear(ctx)
+        assert spans[0] != "sched:ring_p2"
+        assert spans[1] == "sched:ring_p2"
+        return True
+
+    assert spawn(2, fn, timeout=30) == [True, True]
+
+
+# ---- plan-cache integration ------------------------------------------------
+
+
+def test_warm_replay_zero_registrations():
+    """The acceptance headline: scheduled replays reach the identical
+    zero-allocation steady state as native plans — ubuf_creates delta
+    is 0 across a warm loop and plan hits accrue 1:1."""
+    def fn(ctx, rank):
+        x = np.full(2048, float(rank + 1), dtype=np.float32)
+        t = _elect(schedule.generate("ring", 2, {"depth": 2}), "allreduce",
+                   2, x.nbytes)
+        schedule.install(ctx, t)
+        ctx.allreduce(x, tag=1)  # builds the plan (miss)
+        before = ctx.metrics()
+        for _ in range(50):
+            x[:] = rank + 1
+            ctx.allreduce(x, tag=1)
+        after = ctx.metrics()
+        schedule.clear(ctx)
+        assert x[0] == 3.0
+        assert after["ubuf_creates"] == before["ubuf_creates"], \
+            "scheduled steady-state loop registered buffers"
+        assert after["plan_hits"] - before["plan_hits"] == 50
+        assert after["plan_misses"] == before["plan_misses"]
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+def test_install_and_clear_invalidate_plan_cache():
+    """Schedule install/clear drops every cached plan, exactly like
+    setTuningTable: a cached kAuto plan may embed a dispatch decision
+    the new plane would make differently."""
+    def fn(ctx, rank):
+        x = np.full(1024, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x, tag=1)
+        assert ctx.plan_cache_size() >= 1
+        schedule.install(ctx, schedule.generate("ring", 2))
+        assert ctx.plan_cache_size() == 0
+        x[:] = rank + 1
+        ctx.allreduce(x, tag=1)
+        assert ctx.plan_cache_size() >= 1
+        schedule.clear(ctx)
+        assert ctx.plan_cache_size() == 0
+        x[:] = rank + 1
+        ctx.allreduce(x, tag=1)
+        assert x[0] == 3.0
+        return True
+
+    assert spawn(2, fn) == [True, True]
+
+
+def test_install_invalidates_async_lane_caches():
+    """Async lanes are forked sub-contexts with their own plan caches;
+    installing a schedule plane on a lane's context clears that lane's
+    cache through the same setScheduleTable path."""
+    def fn(ctx, rank):
+        eng = ctx.async_engine(lanes=2)
+        try:
+            x = np.full(512, float(rank + 1), dtype=np.float32)
+            eng.allreduce_async(x).wait()
+            lane_handles = [eng._lane_handle(k) for k in range(2)]
+            filled = [h for h in lane_handles
+                      if _lib.lib.tc_plan_cache_size(h) > 0]
+            assert filled  # at least one lane built a plan
+            payload = json.dumps(schedule.generate("ring", 2)).encode()
+            for h in lane_handles:
+                _lib.check(_lib.lib.tc_schedule_install(h, payload))
+                assert _lib.lib.tc_plan_cache_size(h) == 0
+            # lanes still work under the installed plane
+            y = np.full(512, float(rank + 1), dtype=np.float32)
+            eng.allreduce_async(y).wait()
+            assert y[0] == 3.0
+            for h in lane_handles:
+                _lib.check(_lib.lib.tc_schedule_install(h, None))
+        finally:
+            eng.shutdown()
+        return True
+
+    assert spawn(2, fn, timeout=60) == [True, True]
+
+
+# ---- TPUCOLL_SCHEDULE_FILE -------------------------------------------------
+
+
+def test_schedule_file_env_installs_at_connect(tmp_path):
+    path = os.path.join(tmp_path, "sched.json")
+    t = _elect(schedule.generate("ring", 2, {"depth": 2}), "allreduce",
+               2, 2048 * 4)
+    name = t["schedules"][0]["name"]
+    schedule.save(t, path)
+
+    def fn(ctx, rank):
+        inst = schedule.installed(ctx)
+        assert inst is not None
+        assert inst["schedules"][0]["name"] == name
+        ctx.trace_start()
+        x = np.full(2048, float(rank + 1), dtype=np.float32)
+        ctx.allreduce(x)
+        spans = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        assert x[0] == 3.0
+        assert spans == [f"sched:{name}"]
+        return True
+
+    with _env(TPUCOLL_SCHEDULE_FILE=path):
+        assert spawn(2, fn, timeout=30) == [True, True]
+
+
+def test_schedule_file_env_malformed_is_loud(tmp_path):
+    path = os.path.join(tmp_path, "bad.json")
+    with open(path, "w") as f:
+        f.write('{"version": 1, "schedules": [')  # truncated
+
+    def fn(ctx, rank):  # pragma: no cover - must not connect
+        return True
+
+    with _env(TPUCOLL_SCHEDULE_FILE=path):
+        with pytest.raises(AssertionError, match="schedule"):
+            spawn(2, fn, timeout=30)
+    missing = os.path.join(tmp_path, "nope.json")
+    with _env(TPUCOLL_SCHEDULE_FILE=missing):
+        with pytest.raises(AssertionError, match="cannot read"):
+            spawn(2, fn, timeout=30)
+
+
+# ---- sweep -----------------------------------------------------------------
+
+
+def test_sweep_smoke_elects_consistently():
+    """A tiny sweep runs real measurements, installs rank-identical
+    bytes on every rank, and every elected cell names an installed,
+    resolvable schedule."""
+    def fn(ctx, rank):
+        table = schedule.sweep(
+            ctx, min_bytes=1 << 10, max_bytes=1 << 12, iters=2, warmup=1,
+            candidates=[("ring", {"depth": 2}), ("hd", {})])
+        inst = schedule.installed(ctx)
+        names = {s["name"] for s in table.get("schedules", [])}
+        for e in table.get("elections", []):
+            assert e["schedule"] in names
+            assert e["world_size"] == 2
+        schedule.clear(ctx)
+        return (json.dumps(table, sort_keys=True),
+                json.dumps(inst, sort_keys=True))
+
+    results = spawn(2, fn, timeout=120)
+    tables = {r[0] for r in results}
+    installs = {r[1] for r in results}
+    assert len(tables) == 1  # rank-identical election
+    assert len(installs) == 1
+
+
+# ---- chaos determinism -----------------------------------------------------
+
+
+def test_same_seed_chaos_identical_streams_with_schedules():
+    """Schedules must not change wire determinism: the same-seed chaos
+    workload produces identical per-rank (seq, op, fp) flightrec
+    streams across two runs with a schedule plane installed."""
+    from gloo_tpu import fault
+
+    chaos = {"seed": 17, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.5, "seed": 9}]}
+
+    def workload():
+        def fn(ctx, rank):
+            t = schedule.merge(
+                schedule.generate("ring", 2, {"depth": 2}),
+                schedule.generate("ring_rs", 2))
+            t["elections"] = [
+                {"collective": "allreduce", "world_size": 2, "dtype": "",
+                 "bucket": 12, "schedule": "ring_p2_k2"},
+                {"collective": "reduce_scatter", "world_size": 2,
+                 "dtype": "", "bucket": 12, "schedule": "ring_rs_p2"},
+            ]
+            schedule.install(ctx, t)
+            x = np.arange(1024, dtype=np.float32)  # 4 KiB: bucket 12
+            for i in range(5):
+                x[:] = rank + i
+                ctx.allreduce(x, tag=2 * i)
+                ctx.reduce_scatter(x.copy(), tag=100 + i)
+            ctx.barrier(tag=999)
+            return [(e["seq"], e["op"], e["fp"])
+                    for e in ctx.flightrec()["events"]]
+
+        return spawn(2, fn, timeout=60)
+
+    fault.install(chaos)
+    try:
+        first = workload()
+        fault.install(chaos)  # reset firing state for the replay
+        second = workload()
+    finally:
+        fault.clear()
+    assert first == second

@@ -171,6 +171,19 @@ def test_fixture_lock_order():
     assert "stale-edge:Striper::ghostMu_->Striper::bMu_" in keys
 
 
+def test_fixture_schedule_step_coverage():
+    """A declared op the interpreter never lowers (or ir.cc never
+    names) fires; a case for a removed op is reported stale; handled
+    ops stay quiet."""
+    keys = _keys(_fixture_report("schedule_step_coverage",
+                                 ["schedule-step-coverage"]))
+    assert ("unhandled:csrc/tpucoll/schedule/interpreter.cc:kDecode"
+            in keys)
+    assert "unhandled:csrc/tpucoll/schedule/ir.cc:kDecode" in keys
+    assert "stale:csrc/tpucoll/schedule/verifier.cc:kGhost" in keys
+    assert not any("kSend" in k or "kRecv" in k for k in keys), keys
+
+
 def test_fixture_asserts():
     """Bare assert fires; static_assert does not."""
     keys = _keys(_fixture_report("asserts", ["no-bare-assert"]))

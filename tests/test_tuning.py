@@ -178,6 +178,41 @@ def test_table_interpolates_crossover_between_buckets():
     spawn(2, fn)
 
 
+def test_boundary_cell_prefers_covered_candidate():
+    """Regression for the crossover extrapolation bug: beyond an arm's
+    largest measured bucket its clamped edge cost is an extrapolation,
+    and comparing it against a curve genuinely measured there let a
+    ragged sweep elect an algorithm octaves outside its evidence. hd is
+    priced very cheap but swept only to bucket 14 (16K); ring is dearer
+    but measured through bucket 22. Inside hd's range the cheap arm
+    wins; past it, election must fall to the covered curve — and only
+    with NO covered candidate may clamped evidence still elect."""
+    table = _table([
+        _entry("allreduce", "halving_doubling", 10, 10.0),
+        _entry("allreduce", "halving_doubling", 14, 20.0),
+        _entry("allreduce", "ring", 10, 300.0),
+        _entry("allreduce", "ring", 22, 400.0),
+        _entry("allreduce", "recursive_doubling", 10, 500.0),
+        _entry("allreduce", "recursive_doubling", 22, 600.0),
+    ])
+
+    def fn(ctx, rank):
+        tuning.install_table(ctx, table)
+        ctx.trace_start()
+        ctx.allreduce(np.zeros(1024, dtype=np.float32))        # 4K: in range
+        ctx.allreduce(np.zeros(256 * 1024, dtype=np.float32))  # 1M: beyond hd
+        # 16M (bucket 24): beyond EVERY curve — with no covered
+        # candidate the clamped comparison returns, and hd's cheap
+        # 16K edge may elect again (edge evidence beats no evidence).
+        ctx.allreduce(np.zeros(4 * 1024 * 1024, dtype=np.float32))
+        algos = _spans(json.loads(ctx.trace_json()), "allreduce")
+        ctx.trace_stop()
+        assert algos == ["halving_doubling", "ring", "halving_doubling"], \
+            algos
+
+    spawn(2, fn)
+
+
 # ---- TPUCOLL_TUNING_FILE env hook ----
 
 

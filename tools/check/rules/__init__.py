@@ -13,6 +13,7 @@ from .env_hygiene import EnvHygieneRule
 from .flightrec import FlightrecRule
 from .lock_order import LockOrderRule
 from .metrics_drift import MetricsDriftRule
+from .schedule_step_coverage import ScheduleStepCoverageRule
 
 ALL_RULES = (
     AbiDriftRule,
@@ -23,6 +24,7 @@ ALL_RULES = (
     MetricsDriftRule,
     LockOrderRule,
     AssertsRule,
+    ScheduleStepCoverageRule,
 )
 
 

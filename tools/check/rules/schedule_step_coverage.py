@@ -1,0 +1,78 @@
+"""Schedule IR step-op coverage across the hand-written switches.
+
+The schedule plane's StepOp enum (csrc/tpucoll/schedule/ir.h) is
+consumed by three hand-spelled surfaces: the verifier's semantic
+switches (verifier.cc), the interpreter's lowering switch
+(interpreter.cc), and the JSON name table (ir.cc). Adding an op to the
+enum without teaching every consumer compiles fine — switches carry a
+default/throw arm precisely so malformed programs fail loudly — but the
+new op then verifies or lowers as "bad step" at runtime instead of at
+review time. This rule fails the build the moment an enumerator is
+missing a `case StepOp::kX` in either switch file or a name mapping in
+ir.cc, and flags cases for enumerators that no longer exist."""
+
+from __future__ import annotations
+
+import re
+from typing import List, Set
+
+from ..engine import Corpus, Rule, Violation
+
+_ENUM = re.compile(r"enum\s+class\s+StepOp[^{]*\{([^}]*)\}", re.S)
+_ENUMERATOR = re.compile(r"\bk[A-Z]\w*")
+_CASE = re.compile(r"\bcase\s+StepOp::(k\w+)")
+# ir.cc's name table pairs each enumerator with its wire spelling.
+_NAME_MAP = re.compile(r"StepOp::(k\w+)")
+
+
+class ScheduleStepCoverageRule(Rule):
+    name = "schedule-step-coverage"
+    description = ("every StepOp enumerator is handled in the verifier "
+                   "and interpreter switches and named in ir.cc")
+
+    ir_header = "csrc/tpucoll/schedule/ir.h"
+    consumers = ("csrc/tpucoll/schedule/verifier.cc",
+                 "csrc/tpucoll/schedule/interpreter.cc")
+    name_table = "csrc/tpucoll/schedule/ir.cc"
+
+    def _enumerators(self, corpus: Corpus) -> Set[str]:
+        raw = corpus.text(self.ir_header)
+        if raw is None:
+            return set()
+        m = _ENUM.search(raw)
+        if m is None:
+            return set()
+        return set(_ENUMERATOR.findall(m.group(1)))
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        ops = self._enumerators(corpus)
+        if not ops:
+            return [self.violation(
+                "no-enum", self.ir_header, 1,
+                f"could not parse `enum class StepOp` from "
+                f"{self.ir_header} — moved without updating this rule?")]
+
+        for path in self.consumers + (self.name_table,):
+            raw = corpus.text(path)
+            if raw is None:
+                out.append(self.violation(
+                    f"missing-file:{path}", path, 1,
+                    f"{path} not found but the schedule IR exists"))
+                continue
+            pattern = _CASE if path in self.consumers else _NAME_MAP
+            handled = set(pattern.findall(raw))
+            for op in sorted(ops - handled):
+                out.append(self.violation(
+                    f"unhandled:{path}:{op}", self.ir_header, 1,
+                    f"StepOp::{op} is declared in {self.ir_header} but "
+                    f"{path} never handles it — new step ops must be "
+                    f"taught to the verifier, the interpreter, and the "
+                    f"JSON name table together"))
+            for op in sorted(handled - ops):
+                line = raw[:raw.index(op)].count("\n") + 1
+                out.append(self.violation(
+                    f"stale:{path}:{op}", path, line,
+                    f"{path} handles StepOp::{op} which {self.ir_header} "
+                    f"no longer declares — dead case from a removed op"))
+        return out
