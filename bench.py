@@ -627,7 +627,13 @@ def bench_elastic_soak(seconds, quick=False):
         SIZES = (1 << 12, 1 << 14, 1 << 16)
 
         def step_fn(ectx, step, state):
-            flag = np.zeros(1, dtype=np.float32)
+            # flag[1] carries this rank's step counter so the size
+            # index below comes from the allreduced (group-agreed) sum:
+            # a joiner enters with a fresh i=0 while survivors are at
+            # i=k, and rank-local SIZES[i % 3] would post mismatched
+            # allreduce lengths that wedge the mesh.
+            flag = np.zeros(2, dtype=np.float32)
+            flag[1] = float(state["i"] % 3)
             if ectx.rank == 0:
                 try:
                     store.get("soak_stop", timeout=0.001)
@@ -638,7 +644,7 @@ def bench_elastic_soak(seconds, quick=False):
             if flag[0] > 0:
                 raise StopIteration
             n = ectx.size
-            x = np.full(SIZES[state["i"] % 3], float(ectx.rank + 1),
+            x = np.full(SIZES[int(flag[1]) % 3], float(ectx.rank + 1),
                         dtype=np.float32)
             ectx.allreduce(x, tag=1)
             assert x[0] == n * (n + 1) / 2, (state["i"], x[0], n)
@@ -1644,6 +1650,230 @@ def bench_grad_bucket(n_tensors, lanes=2, pin=False):
         sys.exit(1)
 
 
+def bench_bootstrap_sweep(quick=False, out_path=None):
+    """--bootstrap-sweep [--quick]: measure the bootstrap plane
+    (docs/bootstrap.md) along its three acceptance axes and write ONE
+    JSON document (default BOOT_r18.json next to this script):
+
+    1. Store choreography: tc_boot_rendezvous_bench runs an in-process
+       N-thread rendezvous over a shared FileStore for N in {8, 32,
+       128, 512} ({8, 32} with --quick), once with the leader-relayed
+       lazy protocol and once with the full-mesh simulation the seed's
+       connectFullMesh performs. The lazy arm's store traffic is
+       O(hosts^2 + N) vs O(N^2); by N=512 the wall-clock gap must be
+       superlinear in N (the committed evidence for P>=512 scaling).
+    2. Real bring-up at small N: 8 thread-ranks across 2 simulated
+       hosts connect with TPUCOLL_BOOT_MODE=lazy vs the default eager
+       full mesh, verifying the reduced value both ways, then soak the
+       lazy mesh with a mixed alltoall/allreduce/p2p workload under
+       TPUCOLL_MAX_PAIRS=2 and assert the broker held the steady-state
+       broker-dialed pair count at or under the cap (with evictions
+       actually exercised).
+    3. Elastic rebuild with per-host lease aggregation: re-runs the
+       --elastic-soak quick cell with TPUCOLL_LEASE_AGG=1 and checks
+       rebuild_ms_p50 against the committed ELASTIC_r14.json p50 —
+       aggregation must not slow the small-N rebuild it exists to
+       protect at large N.
+    """
+    import numpy as np
+
+    import gloo_tpu
+    from gloo_tpu import _lib
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if out_path is None:
+        out_path = os.path.join(repo, "BOOT_r18.json")
+    rph, shards, payload = 8, 8, 64
+    ns = (8, 32) if quick else (8, 32, 128, 512)
+    ok_all = True
+
+    # -- 1. store choreography curves (native N-thread rendezvous sim) --
+    choreography = []
+    for n in ns:
+        cell = {"nranks": n, "hosts": max(1, n // rph)}
+        for arm in ("lazy", "full"):
+            d = tempfile.mkdtemp()
+            raw = _lib.copy_out(
+                _lib.lib.tc_boot_rendezvous_bench, d.encode(), n, rph,
+                shards, 1 if arm == "lazy" else 0, payload, 300000)
+            cell[arm] = {k: v for k, v in json.loads(raw).items()
+                         if k in ("wall_ms", "publish_ms", "topo_ms",
+                                  "exchange_ms", "store_ops",
+                                  "store_bytes")}
+        cell["wall_ratio"] = round(
+            cell["full"]["wall_ms"] / max(cell["lazy"]["wall_ms"], 1e-9), 2)
+        cell["ops_ratio"] = round(
+            cell["full"]["store_ops"] / max(cell["lazy"]["store_ops"], 1), 2)
+        # Crossover: the relay round-trips cost more than they save at
+        # tiny N; from 128 up the O(N^2) store scan must lose.
+        if n >= 128 and cell["wall_ratio"] <= 1.0:
+            ok_all = False
+        choreography.append(cell)
+        print(f"[bootstrap-sweep] N={n}: lazy "
+              f"{cell['lazy']['wall_ms']:.0f}ms/"
+              f"{cell['lazy']['store_ops']} ops, full "
+              f"{cell['full']['wall_ms']:.0f}ms/"
+              f"{cell['full']['store_ops']} ops "
+              f"({cell['wall_ratio']}x wall)", file=sys.stderr)
+    # Superlinear gap: the full/lazy wall ratio must itself grow with N.
+    ratios = [c["wall_ratio"] for c in choreography]
+    if not quick and not ratios[-1] > ratios[-2]:
+        ok_all = False
+
+    # -- 2. real bring-up + capped-broker soak at 8 ranks / 2 hosts --
+    size, cap = 8, 2
+
+    def bringup(lazy, soak):
+        errs = []
+        connect_ms = [0.0] * size
+        stats = [None] * size
+        store_dir = tempfile.mkdtemp()
+        barrier = threading.Barrier(size)
+
+        def worker(rank):
+            try:
+                ctx = gloo_tpu.Context(rank, size, timeout=60)
+                ctx.set_host_id("bootbench%d" % (rank // 4))
+                barrier.wait()
+                t0 = time.perf_counter()
+                ctx.connect_full_mesh(gloo_tpu.FileStore(store_dir),
+                                      gloo_tpu.Device())
+                connect_ms[rank] = (time.perf_counter() - t0) * 1e3
+                eager = ctx.metrics()["boot"]["pairs_connected"]
+                x = np.full(64, float(rank + 1), dtype=np.float32)
+                ctx.allreduce(x)
+                assert x[0] == size * (size + 1) / 2, x[0]
+                if soak:
+                    for i in range(12):
+                        a2a = np.full((size, 8), float(rank),
+                                      dtype=np.float32)
+                        out = ctx.alltoall(a2a, tag=1)
+                        assert out[rank][0] == float(rank), out[rank][0]
+                        y = np.ones(256, dtype=np.float32)
+                        ctx.allreduce(y)
+                        assert y[0] == size, y[0]
+                    # Quiesced single fresh dial per rank: the cap is
+                    # enforced at dial time (in-flight pairs are pinned
+                    # and may transiently exceed it), so the steady-
+                    # state claim is "after a dial with the mesh idle,
+                    # broker pairs <= cap".
+                    ctx.barrier(tag=2)
+                    z = np.full(16, float(rank), dtype=np.float32)
+                    ctx.send(z, (rank + 3) % size, slot=7)
+                    w = np.empty(16, dtype=np.float32)
+                    ctx.recv(w, (rank - 3) % size, slot=7)
+                    assert w[0] == float((rank - 3) % size), w[0]
+                    boot = ctx.metrics()["boot"]
+                    broker = boot["pairs_connected"] - eager
+                    assert broker <= cap, (rank, broker, boot)
+                    stats[rank] = {"eager": eager,
+                                   "broker_end": broker,
+                                   "evicted": boot["pairs_evicted"],
+                                   "dials": boot["lazy_dials"]}
+                ctx.barrier(tag=3)
+                ctx.close()
+            except BaseException as e:  # noqa: B036 - report & join
+                errs.append(f"rank {rank}: {type(e).__name__}: {e}")
+
+        env_keys = ("TPUCOLL_BOOT_MODE", "TPUCOLL_MAX_PAIRS")
+        saved = {k: os.environ.get(k) for k in env_keys}
+        try:
+            if lazy:
+                os.environ["TPUCOLL_BOOT_MODE"] = "lazy"
+                os.environ["TPUCOLL_MAX_PAIRS"] = str(cap)
+            else:
+                os.environ.pop("TPUCOLL_BOOT_MODE", None)
+                os.environ.pop("TPUCOLL_MAX_PAIRS", None)
+            threads = [threading.Thread(target=worker, args=(r,))
+                       for r in range(size)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if errs:
+            raise RuntimeError("; ".join(errs))
+        return max(connect_ms), stats
+
+    e2e = {"nranks": size, "hosts": 2, "cap": cap}
+    try:
+        lazy_ms, soak_stats = bringup(lazy=True, soak=True)
+        full_ms, _ = bringup(lazy=False, soak=False)
+        e2e["connect_ms_lazy"] = round(lazy_ms, 1)
+        e2e["connect_ms_full"] = round(full_ms, 1)
+        e2e["soak"] = {
+            "iters": 12,
+            "eager_pairs": [s["eager"] for s in soak_stats],
+            "broker_pairs_end": [s["broker_end"] for s in soak_stats],
+            "evictions": sum(s["evicted"] for s in soak_stats),
+            "dials": sum(s["dials"] for s in soak_stats),
+        }
+        e2e["ok"] = (max(s["broker_end"] for s in soak_stats) <= cap
+                     and e2e["soak"]["evictions"] > 0)
+    except RuntimeError as e:
+        e2e["ok"] = False
+        e2e["error"] = str(e)[-500:]
+    ok_all = ok_all and e2e["ok"]
+    print(f"[bootstrap-sweep] e2e 8-rank: {e2e}", file=sys.stderr)
+
+    # -- 3. elastic rebuild with aggregated leases vs ELASTIC_r14 --
+    base_p50 = 11
+    try:
+        with open(os.path.join(repo, "ELASTIC_r14.json")) as f:
+            base_p50 = json.load(f)["rebuild_ms_p50"]
+    except (OSError, KeyError, ValueError):
+        pass
+    soak_s = "8" if quick else "20"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--elastic-soak", soak_s, "--quick"],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, TPUCOLL_LEASE_AGG="1"))
+    elastic = {"baseline_r14_p50_ms": base_p50, "lease_agg": True}
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode == 0 and lines:
+        soak_line = json.loads(lines[-1])
+        elastic["rebuild_ms_p50"] = soak_line["rebuild_ms_p50"]
+        elastic["rebuild_ms_p99"] = soak_line["rebuild_ms_p99"]
+        elastic["epochs"] = soak_line["value"]
+        elastic["kills"] = soak_line["kills"]
+        # Same-machine jitter allowance: the claim is "aggregation does
+        # not slow the small-N rebuild", not a microbenchmark tie.
+        elastic["ok"] = (soak_line["ok"]
+                         and soak_line["rebuild_ms_p50"] <= base_p50 * 2)
+    else:
+        elastic["ok"] = False
+        elastic["error"] = (proc.stderr or proc.stdout)[-500:]
+    ok_all = ok_all and elastic["ok"]
+    print(f"[bootstrap-sweep] elastic agg rebuild: {elastic}",
+          file=sys.stderr)
+
+    doc = {
+        "metric": "bootstrap_scale_sweep",
+        "unit": "x_full_over_lazy_wall",
+        "value": ratios[-1],
+        "quick": quick,
+        "ranks_per_host": rph,
+        "shards": shards,
+        "choreography": choreography,
+        "e2e_8rank": e2e,
+        "elastic_rebuild": elastic,
+        "ok": ok_all,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: doc[k] for k in ("metric", "value", "ok")}))
+    if not ok_all:
+        sys.exit(1)
+
+
 def main():
     global PIN_RANKS
     if "--pin" in sys.argv[1:]:
@@ -1677,6 +1907,16 @@ def main():
         return
     if "--hier-sweep" in sys.argv[1:]:
         bench_hier_sweep(quick="--quick" in sys.argv[1:])
+        return
+    if "--bootstrap-sweep" in sys.argv[1:]:
+        out = None
+        if "--bootstrap-out" in sys.argv[1:]:
+            i = sys.argv.index("--bootstrap-out") + 1
+            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+                sys.exit("--bootstrap-out requires a path argument")
+            out = sys.argv[i]
+        bench_bootstrap_sweep(quick="--quick" in sys.argv[1:],
+                              out_path=out)
         return
     if "--profile" in sys.argv[1:]:
         bench_profile(quick="--quick" in sys.argv[1:])

@@ -1,0 +1,122 @@
+// Bootstrap plane (docs/bootstrap.md): scales context creation and
+// steady-state connection count past the full-mesh clique.
+//
+// Three cooperating pieces live under boot/:
+//  - the lazy pair-id codec (lazy_id.h) that lets a connection broker
+//    dial any pair on first use with no store round-trip;
+//  - leader-relayed rendezvous (rendezvous.cc): one store write per rank,
+//    host leaders batch their members' address payloads into per-host
+//    blobs and exchange those inter-host, members fan in from their
+//    leader's assembled table — O(hosts² + N) store operations where
+//    connectFullMesh needs O(N²);
+//  - the sharded key namespace (`tc/boot/s<shard>/…`) so a single store
+//    server never serializes all ranks through one key prefix.
+//
+// The elastic per-host lease aggregation (fourth piece of the plane)
+// lives with its consumer in elastic/; the env switchboard for all of it
+// is here (optionsFromEnv).
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "tpucoll/rendezvous/store.h"
+
+namespace tpucoll {
+
+struct Topology;
+
+namespace boot {
+
+enum class Mode { kFull, kLazy };
+enum class Eager { kNone, kRing, kHier };
+
+struct BootOptions {
+  Mode mode{Mode::kFull};
+  // Which pairs the lazy context dials at bootstrap (the rest are
+  // broker-dialed on first use): ring = ±1 neighbors; hier = ring plus
+  // same-host members plus (leaders only) the leader mesh — the working
+  // set of the six algorithm families' default schedules.
+  Eager eager{Eager::kHier};
+  // LRU cap on broker-dialed pairs per rank; 0 = unbounded. Eager pairs
+  // are pinned and never count against the cap.
+  int maxPairs{0};
+  // Key-namespace shards under tc/boot/.
+  int shards{8};
+};
+
+// Reads TPUCOLL_BOOT_MODE / TPUCOLL_BOOT_EAGER / TPUCOLL_MAX_PAIRS /
+// TPUCOLL_BOOT_SHARDS (strict parses; see docs/env.md).
+BootOptions optionsFromEnv();
+
+// Per-phase wall times and store-traffic counts for one rank's walk
+// through rendezvous. Feeds metrics ("boot" family) and the
+// --bootstrap-sweep bench.
+struct RendezvousStats {
+  int64_t publishUs{0};   // phase 1: write own fingerprint+payload
+  int64_t topoUs{0};      // phases 2-3: rank 0 assembles, all ranks read
+  int64_t exchangeUs{0};  // phases 4-6: host blobs, leader cross, fan-in
+  int64_t storeOps{0};
+  int64_t storeBytes{0};
+};
+
+struct RendezvousResult {
+  uint64_t meshId{0};
+  // Host fingerprints indexed by global rank (buildTopology input).
+  std::vector<std::string> fingerprints;
+  // Opaque per-rank address payloads indexed by global rank.
+  std::vector<Store::Buf> payloads;
+};
+
+// Leader-relayed rendezvous over `store` (see docs/bootstrap.md for the
+// key schema). Every rank calls this collectively; `payload` is this
+// rank's opaque address blob (transport::Context::lazyAddressBlob).
+// Blocking; throws TimeoutException past `timeout`.
+RendezvousResult relayedRendezvous(Store& store, int rank, int size,
+                                   const std::string& fingerprint,
+                                   const Store::Buf& payload, int shards,
+                                   std::chrono::milliseconds timeout,
+                                   RendezvousStats* stats = nullptr);
+
+// The full-mesh arm's store choreography (tc/topo/<r> + tc/rank/<r>
+// publish-then-multiGet-all pattern of discoverTopology +
+// connectFullMesh) with synthetic payloads, for apples-to-apples cost
+// curves in --bootstrap-sweep without paying N² real sockets.
+void fullMeshRendezvousSim(Store& store, int rank, int size,
+                           const std::string& fingerprint,
+                           const Store::Buf& payload,
+                           std::chrono::milliseconds timeout,
+                           RendezvousStats* stats = nullptr);
+
+// eager[r] = true for peers the lazy context must dial at bootstrap
+// under `opts.eager` given the discovered topology. eager[self] = false.
+std::vector<char> eagerPeers(const BootOptions& opts, const Topology& topo);
+
+// Store decorator counting operations and payload bytes (both
+// directions). Used to attribute rendezvous store traffic in stats.
+class CountingStore : public Store {
+ public:
+  explicit CountingStore(Store& inner) : inner_(inner) {}
+
+  void set(const std::string& key, const Buf& value) override;
+  Buf get(const std::string& key, std::chrono::milliseconds timeout) override;
+  bool check(const std::vector<std::string>& keys) override;
+  int64_t add(const std::string& key, int64_t delta) override;
+  std::vector<Buf> multiGet(const std::vector<std::string>& keys,
+                            std::chrono::milliseconds timeout) override;
+  bool deleteKey(const std::string& key) override;
+  std::vector<std::string> listKeys(const std::string& prefix) override;
+
+  int64_t ops() const { return ops_; }
+  int64_t bytes() const { return bytes_; }
+
+ private:
+  Store& inner_;
+  int64_t ops_{0};
+  int64_t bytes_{0};
+};
+
+}  // namespace boot
+}  // namespace tpucoll

@@ -1,0 +1,71 @@
+// Lazy pair-id namespace (docs/bootstrap.md): broker-dialed connections
+// carry a SELF-DESCRIBING routing id instead of one allocated by
+// Device::nextPairId() and exchanged through the store. Bit 63 marks the
+// namespace (the sequential allocator starts at 1 and can never reach
+// it); the remaining bits encode which mesh, which initiator, which
+// target, which data channel, and a redial generation — everything the
+// accepting side needs to build the matching Pair on demand when the
+// hello arrives, with zero store traffic at dial time.
+//
+// Header-only and dependency-free on purpose: transport/ (the listener
+// hook and the connection broker) and boot/ (rendezvous, which picks the
+// mesh id) must agree on this codec without a layering cycle.
+#pragma once
+
+#include <cstdint>
+
+namespace tpucoll {
+namespace boot {
+
+// Layout, high to low: [63] lazy flag | [62:39] mesh id (24 bits) |
+// [38:31] redial generation (8) | [30:18] initiator rank (13) |
+// [17:5] target rank (13) | [4:0] channel (5).
+constexpr uint64_t kLazyPairBit = uint64_t(1) << 63;
+constexpr int kLazyMeshBits = 24;
+constexpr int kLazyGenBits = 8;
+constexpr int kLazyRankBits = 13;  // 8192 ranks per mesh
+constexpr int kLazyChanBits = 5;   // 32 data channels
+
+constexpr int kLazyMaxRanks = 1 << kLazyRankBits;
+constexpr uint32_t kLazyMeshMask = (uint32_t(1) << kLazyMeshBits) - 1;
+
+struct LazyIdParts {
+  uint32_t meshId;
+  uint32_t gen;
+  int initiator;
+  int target;
+  int channel;
+};
+
+inline bool isLazyPairId(uint64_t id) { return (id & kLazyPairBit) != 0; }
+
+inline uint64_t makeLazyPairId(uint32_t meshId, uint32_t gen, int initiator,
+                               int target, int channel) {
+  uint64_t id = kLazyPairBit;
+  id |= uint64_t(meshId & kLazyMeshMask)
+        << (kLazyGenBits + 2 * kLazyRankBits + kLazyChanBits);
+  id |= uint64_t(gen & ((1u << kLazyGenBits) - 1))
+        << (2 * kLazyRankBits + kLazyChanBits);
+  id |= uint64_t(uint32_t(initiator) & (kLazyMaxRanks - 1))
+        << (kLazyRankBits + kLazyChanBits);
+  id |= uint64_t(uint32_t(target) & (kLazyMaxRanks - 1)) << kLazyChanBits;
+  id |= uint64_t(uint32_t(channel) & ((1u << kLazyChanBits) - 1));
+  return id;
+}
+
+inline LazyIdParts parseLazyPairId(uint64_t id) {
+  LazyIdParts p;
+  p.channel = static_cast<int>(id & ((1u << kLazyChanBits) - 1));
+  id >>= kLazyChanBits;
+  p.target = static_cast<int>(id & (kLazyMaxRanks - 1));
+  id >>= kLazyRankBits;
+  p.initiator = static_cast<int>(id & (kLazyMaxRanks - 1));
+  id >>= kLazyRankBits;
+  p.gen = static_cast<uint32_t>(id & ((1u << kLazyGenBits) - 1));
+  id >>= kLazyGenBits;
+  p.meshId = static_cast<uint32_t>(id & kLazyMeshMask);
+  return p;
+}
+
+}  // namespace boot
+}  // namespace tpucoll

@@ -1,0 +1,427 @@
+#include "tpucoll/boot/boot.h"
+
+#include <chrono>
+#include <cstring>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/logging.h"
+#include "tpucoll/group/topology.h"
+
+namespace tpucoll {
+namespace boot {
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t usSince(Clock::time_point t0) {
+  return std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() -
+                                                               t0)
+      .count();
+}
+
+std::chrono::milliseconds remaining(Clock::time_point deadline) {
+  const auto left =
+      std::chrono::duration_cast<std::chrono::milliseconds>(deadline -
+                                                            Clock::now());
+  if (left.count() <= 0) {
+    TC_THROW(TimeoutException, "bootstrap rendezvous timed out");
+  }
+  return left;
+}
+
+uint64_t fnv64(const void* data, size_t n, uint64_t h = 0xcbf29ce484222325ull) {
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+void put32(Store::Buf* b, uint32_t v) {
+  const size_t off = b->size();
+  b->resize(off + sizeof(v));
+  std::memcpy(b->data() + off, &v, sizeof(v));
+}
+
+void put64(Store::Buf* b, uint64_t v) {
+  const size_t off = b->size();
+  b->resize(off + sizeof(v));
+  std::memcpy(b->data() + off, &v, sizeof(v));
+}
+
+void putBytes(Store::Buf* b, const void* data, size_t n) {
+  const size_t off = b->size();
+  b->resize(off + n);
+  if (n > 0) {
+    std::memcpy(b->data() + off, data, n);
+  }
+}
+
+// Cursor-style reader with bounds enforcement; a torn or foreign blob
+// must fail loudly, not index out of range.
+struct Reader {
+  const Store::Buf& b;
+  size_t off{0};
+
+  uint32_t u32() {
+    TC_ENFORCE(off + sizeof(uint32_t) <= b.size(), "short bootstrap blob");
+    uint32_t v;
+    std::memcpy(&v, b.data() + off, sizeof(v));
+    off += sizeof(v);
+    return v;
+  }
+  uint64_t u64() {
+    TC_ENFORCE(off + sizeof(uint64_t) <= b.size(), "short bootstrap blob");
+    uint64_t v;
+    std::memcpy(&v, b.data() + off, sizeof(v));
+    off += sizeof(v);
+    return v;
+  }
+  Store::Buf bytes(size_t n) {
+    TC_ENFORCE(off + n <= b.size(), "short bootstrap blob");
+    Store::Buf out(b.begin() + off, b.begin() + off + n);
+    off += n;
+    return out;
+  }
+  std::string str(size_t n) {
+    TC_ENFORCE(off + n <= b.size(), "short bootstrap blob");
+    std::string out(reinterpret_cast<const char*>(b.data()) + off, n);
+    off += n;
+    return out;
+  }
+};
+
+// Key schema (docs/bootstrap.md). Shards spread hot prefixes so one
+// store server (or a future multi-store) never funnels every rank
+// through a single lexicographic range.
+std::string shardPrefix(int x, int shards) {
+  return "tc/boot/s" + std::to_string(x % shards) + "/";
+}
+
+std::string aKey(int r, int shards) {
+  return shardPrefix(r, shards) + "a/" + std::to_string(r);
+}
+
+std::string hKey(int h, int shards) {
+  return shardPrefix(h, shards) + "h/" + std::to_string(h);
+}
+
+std::string xKey(int h, int shards) {
+  return shardPrefix(h, shards) + "x/" + std::to_string(h);
+}
+
+constexpr const char* kTopoKey = "tc/boot/topo";
+constexpr const char* kMeshCounterKey = "tc/boot/mesh";
+
+// [u32 count][(u32 rank, u32 len, payload)×count]
+Store::Buf packPayloadTable(const std::vector<int>& ranks,
+                            const std::vector<Store::Buf>& payloads) {
+  Store::Buf b;
+  put32(&b, static_cast<uint32_t>(ranks.size()));
+  for (size_t i = 0; i < ranks.size(); i++) {
+    put32(&b, static_cast<uint32_t>(ranks[i]));
+    put32(&b, static_cast<uint32_t>(payloads[i].size()));
+    putBytes(&b, payloads[i].data(), payloads[i].size());
+  }
+  return b;
+}
+
+void unpackPayloadTable(const Store::Buf& b, int size,
+                        std::vector<Store::Buf>* out) {
+  Reader r{b};
+  const uint32_t count = r.u32();
+  for (uint32_t i = 0; i < count; i++) {
+    const uint32_t rank = r.u32();
+    TC_ENFORCE(rank < static_cast<uint32_t>(size),
+               "bootstrap payload table names rank ", rank, " of ", size);
+    (*out)[rank] = r.bytes(r.u32());
+  }
+}
+
+}  // namespace
+
+BootOptions optionsFromEnv() {
+  BootOptions opts;
+  const char* mode =
+      envChoice("TPUCOLL_BOOT_MODE", "full", {"full", "lazy"});
+  opts.mode = std::strcmp(mode, "lazy") == 0 ? Mode::kLazy : Mode::kFull;
+  const char* eager =
+      envChoice("TPUCOLL_BOOT_EAGER", "hier", {"hier", "ring", "none"});
+  opts.eager = std::strcmp(eager, "ring") == 0
+                   ? Eager::kRing
+                   : (std::strcmp(eager, "none") == 0 ? Eager::kNone
+                                                      : Eager::kHier);
+  opts.maxPairs =
+      static_cast<int>(envCount("TPUCOLL_MAX_PAIRS", 0, 0, 1 << 20));
+  opts.shards =
+      static_cast<int>(envCount("TPUCOLL_BOOT_SHARDS", 8, 1, 4096));
+  return opts;
+}
+
+RendezvousResult relayedRendezvous(Store& store, int rank, int size,
+                                   const std::string& fingerprint,
+                                   const Store::Buf& payload, int shards,
+                                   std::chrono::milliseconds timeout,
+                                   RendezvousStats* stats) {
+  TC_ENFORCE(size >= 1 && rank >= 0 && rank < size,
+             "relayedRendezvous: bad rank ", rank, "/", size);
+  CountingStore cs(store);
+  const auto deadline = Clock::now() + timeout;
+  RendezvousResult res;
+  res.payloads.assign(static_cast<size_t>(size), Store::Buf{});
+  res.payloads[rank] = payload;
+
+  // Phase 1: publish [fp][payload] under this rank's shard — the only
+  // per-rank write the whole rendezvous needs.
+  auto t0 = Clock::now();
+  {
+    Store::Buf b;
+    put32(&b, static_cast<uint32_t>(fingerprint.size()));
+    putBytes(&b, fingerprint.data(), fingerprint.size());
+    put32(&b, static_cast<uint32_t>(payload.size()));
+    putBytes(&b, payload.data(), payload.size());
+    cs.set(aKey(rank, shards), b);
+  }
+  if (stats != nullptr) {
+    stats->publishUs = usSince(t0);
+  }
+
+  // Phases 2-3: rank 0 reads every publish blob once, derives the mesh
+  // id (fingerprint digest mixed with a store-side counter so rebuilds
+  // in the same namespace never reuse an id), and fans the topology out
+  // through one key.
+  t0 = Clock::now();
+  std::vector<Store::Buf> hostPayloads;  // rank 0 keeps these for phase 4
+  if (rank == 0) {
+    std::vector<std::string> keys;
+    keys.reserve(static_cast<size_t>(size));
+    for (int r = 0; r < size; r++) {
+      keys.push_back(aKey(r, shards));
+    }
+    auto blobs = cs.multiGet(keys, remaining(deadline));
+    hostPayloads.assign(static_cast<size_t>(size), Store::Buf{});
+    res.fingerprints.resize(static_cast<size_t>(size));
+    uint64_t digest = 0xcbf29ce484222325ull;
+    for (int r = 0; r < size; r++) {
+      Reader rd{blobs[static_cast<size_t>(r)]};
+      res.fingerprints[static_cast<size_t>(r)] = rd.str(rd.u32());
+      hostPayloads[static_cast<size_t>(r)] = rd.bytes(rd.u32());
+      digest = fnv64(res.fingerprints[static_cast<size_t>(r)].data(),
+                     res.fingerprints[static_cast<size_t>(r)].size(), digest);
+    }
+    const int64_t epoch = cs.add(kMeshCounterKey, 1);
+    res.meshId = fnv64(&epoch, sizeof(epoch), digest);
+    Store::Buf b;
+    put64(&b, res.meshId);
+    put32(&b, static_cast<uint32_t>(size));
+    for (const auto& fp : res.fingerprints) {
+      put32(&b, static_cast<uint32_t>(fp.size()));
+      putBytes(&b, fp.data(), fp.size());
+    }
+    cs.set(kTopoKey, b);
+  } else {
+    const Store::Buf b = cs.get(kTopoKey, remaining(deadline));
+    Reader rd{b};
+    res.meshId = rd.u64();
+    const uint32_t n = rd.u32();
+    TC_ENFORCE_EQ(static_cast<int>(n), size,
+                  "bootstrap topo blob disagrees on world size");
+    res.fingerprints.resize(static_cast<size_t>(size));
+    for (uint32_t i = 0; i < n; i++) {
+      res.fingerprints[i] = rd.str(rd.u32());
+    }
+  }
+  if (stats != nullptr) {
+    stats->topoUs = usSince(t0);
+  }
+
+  // Phases 4-6: leaders batch member payloads per host, exchange host
+  // blobs among themselves, and publish the assembled table; members
+  // fan in from their own leader's copy. O(hosts²) leader traffic plus
+  // O(N) member reads.
+  t0 = Clock::now();
+  const Topology topo = buildTopology(rank, res.fingerprints);
+  if (size > 1) {
+    if (topo.isLeader) {
+      // Phase 4: my host's blob (rank 0 already holds every payload).
+      const auto& members = topo.hosts[static_cast<size_t>(topo.hostIndex)];
+      std::vector<Store::Buf> memberPayloads;
+      if (rank == 0) {
+        for (int m : members) {
+          memberPayloads.push_back(hostPayloads[static_cast<size_t>(m)]);
+        }
+      } else {
+        std::vector<std::string> keys;
+        for (int m : members) {
+          keys.push_back(aKey(m, shards));
+        }
+        auto blobs = cs.multiGet(keys, remaining(deadline));
+        for (auto& b : blobs) {
+          Reader rd{b};
+          rd.str(rd.u32());  // skip fingerprint
+          memberPayloads.push_back(rd.bytes(rd.u32()));
+        }
+      }
+      cs.set(hKey(topo.hostIndex, shards),
+             packPayloadTable(members, memberPayloads));
+
+      // Phase 5: read the other hosts' blobs, assemble the full table.
+      std::vector<std::string> keys;
+      for (int h = 0; h < topo.nHosts(); h++) {
+        if (h != topo.hostIndex) {
+          keys.push_back(hKey(h, shards));
+        }
+      }
+      auto blobs = cs.multiGet(keys, remaining(deadline));
+      for (const auto& b : blobs) {
+        unpackPayloadTable(b, size, &res.payloads);
+      }
+      for (size_t i = 0; i < members.size(); i++) {
+        res.payloads[static_cast<size_t>(members[i])] = memberPayloads[i];
+      }
+      std::vector<int> all(static_cast<size_t>(size));
+      for (int r = 0; r < size; r++) {
+        all[static_cast<size_t>(r)] = r;
+      }
+      cs.set(xKey(topo.hostIndex, shards),
+             packPayloadTable(all, res.payloads));
+    } else {
+      // Phase 6: one read of the leader's assembled table.
+      const Store::Buf b =
+          cs.get(xKey(topo.hostIndex, shards), remaining(deadline));
+      unpackPayloadTable(b, size, &res.payloads);
+      res.payloads[static_cast<size_t>(rank)] = payload;
+    }
+  }
+  if (stats != nullptr) {
+    stats->exchangeUs = usSince(t0);
+    stats->storeOps = cs.ops();
+    stats->storeBytes = cs.bytes();
+  }
+  return res;
+}
+
+void fullMeshRendezvousSim(Store& store, int rank, int size,
+                           const std::string& fingerprint,
+                           const Store::Buf& payload,
+                           std::chrono::milliseconds timeout,
+                           RendezvousStats* stats) {
+  CountingStore cs(store);
+  const auto deadline = Clock::now() + timeout;
+
+  // discoverTopology's pattern: per-rank fingerprint key, every rank
+  // reads every other — O(N²) reads fleet-wide.
+  auto t0 = Clock::now();
+  cs.set("tc/topo/" + std::to_string(rank),
+         Store::Buf(fingerprint.begin(), fingerprint.end()));
+  if (stats != nullptr) {
+    stats->publishUs = usSince(t0);
+  }
+  t0 = Clock::now();
+  std::vector<std::string> keys;
+  for (int r = 0; r < size; r++) {
+    if (r != rank) {
+      keys.push_back("tc/topo/" + std::to_string(r));
+    }
+  }
+  cs.multiGet(keys, remaining(deadline));
+  if (stats != nullptr) {
+    stats->topoUs = usSince(t0);
+  }
+
+  // connectFullMesh's pattern: per-rank address blob, every rank reads
+  // every other — another O(N²).
+  t0 = Clock::now();
+  cs.set("tc/rank/" + std::to_string(rank), payload);
+  keys.clear();
+  for (int r = 0; r < size; r++) {
+    if (r != rank) {
+      keys.push_back("tc/rank/" + std::to_string(r));
+    }
+  }
+  cs.multiGet(keys, remaining(deadline));
+  if (stats != nullptr) {
+    stats->exchangeUs = usSince(t0);
+    stats->storeOps = cs.ops();
+    stats->storeBytes = cs.bytes();
+  }
+}
+
+std::vector<char> eagerPeers(const BootOptions& opts, const Topology& topo) {
+  const int size = static_cast<int>(topo.hostOf.size());
+  std::vector<char> eager(static_cast<size_t>(size), 0);
+  if (size <= 1 || opts.eager == Eager::kNone) {
+    return eager;
+  }
+  // Ring neighbors in both modes.
+  eager[static_cast<size_t>((topo.rank + 1) % size)] = 1;
+  eager[static_cast<size_t>((topo.rank + size - 1) % size)] = 1;
+  if (opts.eager == Eager::kHier) {
+    for (int m : topo.hosts[static_cast<size_t>(topo.hostIndex)]) {
+      if (m != topo.rank) {
+        eager[static_cast<size_t>(m)] = 1;
+      }
+    }
+    if (topo.isLeader) {
+      for (const auto& members : topo.hosts) {
+        const int leader = members.front();
+        if (leader != topo.rank) {
+          eager[static_cast<size_t>(leader)] = 1;
+        }
+      }
+    }
+  }
+  eager[static_cast<size_t>(topo.rank)] = 0;
+  return eager;
+}
+
+void CountingStore::set(const std::string& key, const Buf& value) {
+  ops_++;
+  bytes_ += static_cast<int64_t>(key.size() + value.size());
+  inner_.set(key, value);
+}
+
+Store::Buf CountingStore::get(const std::string& key,
+                              std::chrono::milliseconds timeout) {
+  ops_++;
+  Buf out = inner_.get(key, timeout);
+  bytes_ += static_cast<int64_t>(key.size() + out.size());
+  return out;
+}
+
+bool CountingStore::check(const std::vector<std::string>& keys) {
+  ops_++;
+  return inner_.check(keys);
+}
+
+int64_t CountingStore::add(const std::string& key, int64_t delta) {
+  ops_++;
+  bytes_ += static_cast<int64_t>(key.size() + sizeof(int64_t));
+  return inner_.add(key, delta);
+}
+
+std::vector<Store::Buf> CountingStore::multiGet(
+    const std::vector<std::string>& keys, std::chrono::milliseconds timeout) {
+  ops_ += static_cast<int64_t>(keys.size());
+  auto out = inner_.multiGet(keys, timeout);
+  for (size_t i = 0; i < keys.size(); i++) {
+    bytes_ += static_cast<int64_t>(keys[i].size() + out[i].size());
+  }
+  return out;
+}
+
+bool CountingStore::deleteKey(const std::string& key) {
+  ops_++;
+  bytes_ += static_cast<int64_t>(key.size());
+  return inner_.deleteKey(key);
+}
+
+std::vector<std::string> CountingStore::listKeys(const std::string& prefix) {
+  ops_++;
+  return inner_.listKeys(prefix);
+}
+
+}  // namespace boot
+}  // namespace tpucoll

@@ -6,15 +6,19 @@
 //    available from tc_last_error() (thread-local);
 //  - blocking calls release the GIL implicitly because ctypes drops it for
 //    foreign calls.
+#include <algorithm>
 #include <cstring>
 #include <deque>
 #include <memory>
 #include <mutex>
 #include <sstream>
 #include <string>
+#include <thread>
 #include <unordered_map>
+#include <vector>
 
 #include "tpucoll/async/engine.h"
+#include "tpucoll/boot/boot.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/debug.h"
@@ -723,6 +727,103 @@ int tc_fleetobs_set_aux(void* ctx, const char* auxJson) {
 // telemetry endpoint's /fleet payload. Malloc'd, free with tc_buf_free.
 int tc_fleet_json(void* ctx, uint8_t** out, size_t* outLen) {
   return wrap([&] { copyOut(asContext(ctx)->fleetJson(), out, outLen); });
+}
+
+// ---- bootstrap plane (boot/, docs/bootstrap.md) ----
+
+// Store-choreography cost model for `bench.py --bootstrap-sweep`: run
+// `nranks` in-process rank threads through ONE bootstrap rendezvous
+// over a shared FileStore rooted at `storePath` (which must be fresh
+// per call — the key schema is fixed). lazy != 0 runs the leader-
+// relayed choreography (boot::relayedRendezvous) with `ranksPerHost`
+// ranks per simulated host and `shards` key shards; lazy == 0 runs the
+// full-mesh publish/multiGet-all choreography with an O(N)-sized
+// synthetic pair-id table per rank. `payloadBytes` sizes the lazy arm's
+// per-rank address payload. Writes a JSON summary — wall_ms plus
+// aggregate/max per-phase stats — to *out (malloc'd, free with
+// tc_buf_free). This measures the STORE protocol, not sockets: the
+// point of the sweep is the O(N^2) -> O(hosts^2 + N) curve.
+int tc_boot_rendezvous_bench(const char* storePath, int nranks,
+                             int ranksPerHost, int shards, int lazy,
+                             int payloadBytes, int64_t timeoutMs,
+                             uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    TC_ENFORCE(storePath != nullptr && storePath[0] != '\0',
+               "tc_boot_rendezvous_bench: empty store path");
+    TC_ENFORCE(nranks > 0 && nranks <= 4096,
+               "tc_boot_rendezvous_bench: nranks out of range");
+    TC_ENFORCE(ranksPerHost > 0, "tc_boot_rendezvous_bench: ranksPerHost "
+               "must be positive");
+    TC_ENFORCE(payloadBytes >= 0 && payloadBytes <= (1 << 20),
+               "tc_boot_rendezvous_bench: payloadBytes out of range");
+    const auto timeout = ms(timeoutMs > 0 ? timeoutMs : 120000);
+    std::vector<tpucoll::boot::RendezvousStats> stats(nranks);
+    std::vector<std::string> errors(nranks);
+    std::vector<int64_t> wallUs(nranks, 0);
+    std::vector<std::thread> threads;
+    threads.reserve(nranks);
+    for (int r = 0; r < nranks; r++) {
+      threads.emplace_back([&, r] {
+        try {
+          // Every "rank" opens its own FileStore client over the shared
+          // directory, exactly like separate processes would.
+          tpucoll::FileStore store(storePath);
+          const std::string fp =
+              "simhost-" + std::to_string(r / ranksPerHost);
+          Store::Buf payload(static_cast<size_t>(payloadBytes));
+          for (size_t i = 0; i < payload.size(); i++) {
+            payload[i] = static_cast<uint8_t>((r + static_cast<int>(i)) & 0xff);
+          }
+          const auto t0 = std::chrono::steady_clock::now();
+          if (lazy != 0) {
+            tpucoll::boot::relayedRendezvous(store, r, nranks, fp, payload,
+                                             shards, timeout, &stats[r]);
+          } else {
+            tpucoll::boot::fullMeshRendezvousSim(store, r, nranks, fp,
+                                                 payload, timeout, &stats[r]);
+          }
+          wallUs[r] = std::chrono::duration_cast<std::chrono::microseconds>(
+                          std::chrono::steady_clock::now() - t0)
+                          .count();
+        } catch (const std::exception& e) {
+          errors[r] = e.what();
+        }
+      });
+    }
+    for (auto& t : threads) {
+      t.join();
+    }
+    for (int r = 0; r < nranks; r++) {
+      TC_ENFORCE(errors[r].empty(), "bootstrap bench rank ", r, ": ",
+                 errors[r]);
+    }
+    int64_t maxWallUs = 0;
+    int64_t maxPublishUs = 0;
+    int64_t maxTopoUs = 0;
+    int64_t maxExchangeUs = 0;
+    int64_t totalOps = 0;
+    int64_t totalBytes = 0;
+    for (int r = 0; r < nranks; r++) {
+      maxWallUs = std::max(maxWallUs, wallUs[r]);
+      maxPublishUs = std::max(maxPublishUs, stats[r].publishUs);
+      maxTopoUs = std::max(maxTopoUs, stats[r].topoUs);
+      maxExchangeUs = std::max(maxExchangeUs, stats[r].exchangeUs);
+      totalOps += stats[r].storeOps;
+      totalBytes += stats[r].storeBytes;
+    }
+    std::ostringstream json;
+    json << "{\"nranks\":" << nranks << ",\"ranks_per_host\":" << ranksPerHost
+         << ",\"lazy\":" << (lazy != 0 ? "true" : "false")
+         << ",\"shards\":" << shards << ",\"wall_ms\":"
+         << static_cast<double>(maxWallUs) / 1000.0
+         << ",\"publish_ms\":" << static_cast<double>(maxPublishUs) / 1000.0
+         << ",\"topo_ms\":" << static_cast<double>(maxTopoUs) / 1000.0
+         << ",\"exchange_ms\":"
+         << static_cast<double>(maxExchangeUs) / 1000.0
+         << ",\"store_ops\":" << totalOps
+         << ",\"store_bytes\":" << totalBytes << "}";
+    copyOut(json.str(), out, outLen);
+  });
 }
 
 // ---- collective autotuning plane (tuning/) ----

@@ -189,6 +189,28 @@ std::string Metrics::toJson(int rank, bool drain) {
       << ",\"ubuf_creates\":"
       << ubufCreates_.load(std::memory_order_relaxed);
 
+  // Bootstrap plane: how the context came up (docs/bootstrap.md). The
+  // pair fields are live broker gauges — the owning context refreshes
+  // them right before calling toJson — so, like the configuration
+  // fields above, they are never drained.
+  out << ",\"boot\":{\"lazy\":"
+      << (bootLazy_.load(std::memory_order_relaxed) ? "true" : "false")
+      << ",\"publish_us\":" << bootPublishUs_.load(std::memory_order_relaxed)
+      << ",\"topo_us\":" << bootTopoUs_.load(std::memory_order_relaxed)
+      << ",\"exchange_us\":"
+      << bootExchangeUs_.load(std::memory_order_relaxed)
+      << ",\"store_ops\":" << bootStoreOps_.load(std::memory_order_relaxed)
+      << ",\"store_bytes\":"
+      << bootStoreBytes_.load(std::memory_order_relaxed)
+      << ",\"pairs_connected\":"
+      << bootPairsConnected_.load(std::memory_order_relaxed)
+      << ",\"pairs_inbound\":"
+      << bootPairsInbound_.load(std::memory_order_relaxed)
+      << ",\"pairs_evicted\":"
+      << bootPairsEvicted_.load(std::memory_order_relaxed)
+      << ",\"lazy_dials\":" << bootLazyDials_.load(std::memory_order_relaxed)
+      << "}";
+
   out << ",\"faults\":{\"total\":"
       << faultsTotal_.load(std::memory_order_relaxed);
   {

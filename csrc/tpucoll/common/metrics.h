@@ -402,6 +402,30 @@ class Metrics {
     ubufCreates_.fetch_add(1, std::memory_order_relaxed);
   }
 
+  // ---- bootstrap plane (boot/, docs/bootstrap.md) ----
+  // Rendezvous phase timings and store traffic are recorded once at
+  // connect time; the broker pair gauges are refreshed by the owning
+  // context immediately before each snapshot (they are live transport
+  // state, not accumulating counters). All of these are configuration-
+  // like facts about how the context came up, so they survive a drain.
+  void recordBootRendezvous(bool lazy, int64_t publishUs, int64_t topoUs,
+                            int64_t exchangeUs, uint64_t storeOps,
+                            uint64_t storeBytes) {
+    bootLazy_.store(lazy ? 1 : 0, std::memory_order_relaxed);
+    bootPublishUs_.store(publishUs, std::memory_order_relaxed);
+    bootTopoUs_.store(topoUs, std::memory_order_relaxed);
+    bootExchangeUs_.store(exchangeUs, std::memory_order_relaxed);
+    bootStoreOps_.store(storeOps, std::memory_order_relaxed);
+    bootStoreBytes_.store(storeBytes, std::memory_order_relaxed);
+  }
+  void recordBootPairs(uint64_t connected, uint64_t inbound, uint64_t evicted,
+                       uint64_t dials) {
+    bootPairsConnected_.store(connected, std::memory_order_relaxed);
+    bootPairsInbound_.store(inbound, std::memory_order_relaxed);
+    bootPairsEvicted_.store(evicted, std::memory_order_relaxed);
+    bootLazyDials_.store(dials, std::memory_order_relaxed);
+  }
+
   // ---- phase profiler (common/profile.h) ----
   // Per-(collective, algorithm, phase) latency histogram, created on
   // first use. Slow path by design: the profiler flushes ONCE per
@@ -468,6 +492,16 @@ class Metrics {
   std::atomic<uint64_t> planEvictions_{0};
   std::atomic<uint64_t> ubufCreates_{0};
   std::atomic<uint64_t> stalls_{0};
+  std::atomic<int> bootLazy_{0};
+  std::atomic<int64_t> bootPublishUs_{0};
+  std::atomic<int64_t> bootTopoUs_{0};
+  std::atomic<int64_t> bootExchangeUs_{0};
+  std::atomic<uint64_t> bootStoreOps_{0};
+  std::atomic<uint64_t> bootStoreBytes_{0};
+  std::atomic<uint64_t> bootPairsConnected_{0};
+  std::atomic<uint64_t> bootPairsInbound_{0};
+  std::atomic<uint64_t> bootPairsEvicted_{0};
+  std::atomic<uint64_t> bootLazyDials_{0};
   std::atomic<uint64_t> stashPauses_{0};
   std::atomic<uint64_t> traceEventsDropped_{0};
   std::atomic<uint64_t> channelTx_[kMaxChannelStats] = {};

@@ -4,6 +4,7 @@
 #include <fstream>
 #include <sstream>
 
+#include "tpucoll/boot/boot.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/env.h"
@@ -179,6 +180,37 @@ void Context::connectFullMesh(std::shared_ptr<Store> store,
   tctx_->setInstrumentation(&tracer_, &metrics_, &flightrec_);
   tctx_->setFaultDomain(faultDomain_);
   applyTransportHints();
+  const boot::BootOptions bopts = boot::optionsFromEnv();
+  if (bopts.mode == boot::Mode::kLazy) {
+    // Lazy bootstrap (docs/bootstrap.md): one leader-relayed rendezvous
+    // replaces BOTH store choreographies of the full-mesh path — the
+    // tc/topo fingerprint exchange of discoverTopology() AND the
+    // O(N^2) pair-id table of connectFullMesh() — because the relayed
+    // payload carries fingerprint and address blob together. Only the
+    // topology-selected eager pairs are dialed here; everything else is
+    // broker-dialed on first use.
+    boot::RendezvousStats stats;
+    const std::string fp = hostFingerprint(hostId_);
+    const auto rr = boot::relayedRendezvous(*store_, rank_, size_, fp,
+                                            tctx_->lazyAddressBlob(),
+                                            bopts.shards, timeout_, &stats);
+    installTopology(
+        std::make_shared<const Topology>(buildTopology(rank_, rr.fingerprints)));
+    std::vector<transport::SockAddr> addrs(size_);
+    for (int r = 0; r < size_; r++) {
+      transport::Context::parseLazyAddressBlob(rr.payloads[r],
+                                               tctx_->channels(), &addrs[r]);
+    }
+    const auto topo = topology();
+    tctx_->enableLazy(rr.meshId, std::move(addrs),
+                      boot::eagerPeers(bopts, *topo), bopts.maxPairs, timeout_);
+    tctx_->dialEager(timeout_);
+    metrics_.recordBootRendezvous(true, stats.publishUs, stats.topoUs,
+                                  stats.exchangeUs,
+                                  static_cast<uint64_t>(stats.storeOps),
+                                  static_cast<uint64_t>(stats.storeBytes));
+    return;
+  }
   // Fingerprint exchange BEFORE the mesh connects: the resulting
   // co-host mask decides which pairs may negotiate the shm plane.
   discoverTopology();
@@ -245,6 +277,17 @@ void Context::forkFrom(Context& parent, uint32_t tag) {
 }
 
 std::string Context::metricsJson(bool drain) {
+  // The broker pair counts are live transport state, not accumulating
+  // counters; refresh the "boot" gauges so every snapshot reflects the
+  // pair table as of this call (the eviction-cap soak asserts on them).
+  if (tctx_ != nullptr && tctx_->lazyEnabled()) {
+    uint64_t connected = 0;
+    uint64_t evicted = 0;
+    uint64_t inbound = 0;
+    uint64_t dials = 0;
+    tctx_->lazyPairStats(&connected, &evicted, &inbound, &dials);
+    metrics_.recordBootPairs(connected, inbound, evicted, dials);
+  }
   return metrics_.toJson(rank_, drain);
 }
 

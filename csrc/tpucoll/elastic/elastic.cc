@@ -6,6 +6,7 @@
 #include "tpucoll/elastic/elastic.h"
 
 #include <algorithm>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <sstream>
@@ -14,6 +15,7 @@
 #include "tpucoll/common/env.h"
 #include "tpucoll/common/json.h"
 #include "tpucoll/common/logging.h"
+#include "tpucoll/group/topology.h"
 #include "tpucoll/tuning/tuning_table.h"
 
 namespace tpucoll {
@@ -43,6 +45,39 @@ uint64_t unpackCounter(const Store::Buf& buf) {
 // return control to the monitor loop, never park it for the full
 // default store timeout.
 constexpr std::chrono::milliseconds kProbeTimeout{50};
+
+// Aggregate-lease blob: [u32 magic][u64 leaderBeat][u32 count]
+// [(i64 wid, u64 value, u8 present) x count]. The leader beat is the
+// aggregate's OWN lease counter — observers change-observe it exactly
+// like an individual lease to decide whether the embedded samples are
+// live at all.
+constexpr uint32_t kAggMagic = 0x7C0A66E5u;
+
+void packU32(Store::Buf& buf, uint32_t v) {
+  const size_t off = buf.size();
+  buf.resize(off + sizeof(v));
+  std::memcpy(buf.data() + off, &v, sizeof(v));
+}
+
+void packU64(Store::Buf& buf, uint64_t v) {
+  const size_t off = buf.size();
+  buf.resize(off + sizeof(v));
+  std::memcpy(buf.data() + off, &v, sizeof(v));
+}
+
+// Host fingerprints become one path segment of the aggregate key; hash
+// them so arbitrary TPUCOLL_HOST_ID strings cannot leak separators (or
+// unbounded length) into the store namespace.
+std::string fpHash(const std::string& fp) {
+  uint64_t h = 0xcbf29ce484222325ULL;
+  for (char c : fp) {
+    h = (h ^ static_cast<uint8_t>(c)) * 0x100000001b3ULL;
+  }
+  char hex[17];
+  std::snprintf(hex, sizeof(hex), "%016llx",
+                static_cast<unsigned long long>(h));
+  return std::string(hex);
+}
 
 }  // namespace
 
@@ -107,7 +142,8 @@ ElasticAgent::ElasticAgent(std::shared_ptr<Store> store,
       opts_(opts),
       leaseMs_(envCount("TPUCOLL_LEASE_MS", 500, 50, 60000)),
       graceMs_(envCount("TPUCOLL_LEASE_GRACE", 3000, 100, 600000)),
-      pollMs_(std::max(20L, std::min(500L, leaseMs_ / 2))) {
+      pollMs_(std::max(20L, std::min(500L, leaseMs_ / 2))),
+      leaseAgg_(envFlag("TPUCOLL_LEASE_AGG", false)) {
   TC_ENFORCE(store_ != nullptr, "elastic: no store");
   TC_ENFORCE(device_ != nullptr, "elastic: no device");
   TC_ENFORCE_GE(graceMs_, 2 * leaseMs_,
@@ -118,12 +154,21 @@ ElasticAgent::ElasticAgent(std::shared_ptr<Store> store,
   TC_ENFORCE_LE(opts_.minSize, opts_.worldSize,
                 "elastic: min size exceeds the target world size");
 
+  if (leaseAgg_) {
+    hostFp_ = hostFingerprint(opts_.hostId);
+  }
   const auto deadline = std::chrono::steady_clock::now() + opts_.timeout;
   if (!opts_.join) {
     TC_ENFORCE(opts_.rank >= 0 && opts_.rank < opts_.worldSize,
                "elastic: rank ", opts_.rank, " out of range for world size ",
                opts_.worldSize);
     wid_ = opts_.rank;
+    if (leaseAgg_) {
+      // Host mapping before the first lease: any monitor that can see
+      // this wid as a member must be able to place it on a host.
+      store_->set(k("host/" + std::to_string(wid_)),
+                  Store::Buf(hostFp_.begin(), hostFp_.end()));
+    }
     heartbeatOnce();
     if (opts_.rank == 0) {
       // Found epoch 1. The claim keeps a restarted rank 0 from
@@ -146,6 +191,10 @@ ElasticAgent::ElasticAgent(std::shared_ptr<Store> store,
     // start heartbeating, then enqueue. The lease must exist BEFORE the
     // join key: the coordinator only admits joiners it can see alive.
     wid_ = opts_.worldSize - 1 + store_->add(std::string(kNs) + "nextwid", 1);
+    if (leaseAgg_) {
+      store_->set(k("host/" + std::to_string(wid_)),
+                  Store::Buf(hostFp_.begin(), hostFp_.end()));
+    }
     heartbeatOnce();
     store_->set(std::string(kNs) + "join/" + std::to_string(wid_),
                 Store::Buf{1});
@@ -185,6 +234,204 @@ std::string ElasticAgent::k(const std::string& suffix) const {
 
 std::string ElasticAgent::leaseKey(int64_t wid) const {
   return std::string(kNs) + "lease/" + std::to_string(wid);
+}
+
+std::string ElasticAgent::aggKey(const std::string& hostFp) const {
+  return std::string(kNs) + "agg/" + fpHash(hostFp);
+}
+
+void ElasticAgent::refreshHostMap(const std::vector<int64_t>& members) {
+  if (hostMapEpoch_ == monitorStateEpoch_) {
+    bool complete = true;
+    for (int64_t w : members) {
+      if (hostOf_.find(w) == hostOf_.end()) {
+        complete = false;
+        break;
+      }
+    }
+    if (complete) {
+      return;
+    }
+  }
+  std::map<int64_t, std::string> next;
+  for (int64_t w : members) {
+    auto it = hostOf_.find(w);
+    if (it != hostOf_.end()) {
+      next.emplace(w, it->second);
+      continue;
+    }
+    if (w == wid_) {
+      next.emplace(w, hostFp_);
+      continue;
+    }
+    try {
+      Store::Buf raw =
+          store_->get(k("host/" + std::to_string(w)), kProbeTimeout);
+      next.emplace(w, std::string(raw.begin(), raw.end()));
+    } catch (const TimeoutException&) {
+      // Not published yet (write in flight, or a pre-aggregation
+      // worker): the member stays on the individual-lease path until
+      // its mapping appears.
+    }
+  }
+  hostOf_ = std::move(next);
+  hostMapEpoch_ = monitorStateEpoch_;
+}
+
+bool ElasticAgent::actingHostLeader(const std::vector<int64_t>& members,
+                                    int64_t now) {
+  // Members are wid-ascending (founders 0..N-1; joiner wids come from a
+  // monotone counter and are appended), so the first same-host member
+  // reached is the host's nominal leader. A lower same-host wid only
+  // yields the role once OBSERVED expired — until then its (possibly
+  // stale) aggregate is still the host's authority and a second writer
+  // would flap the key.
+  for (int64_t w : members) {
+    if (w == wid_) {
+      return true;
+    }
+    auto hit = hostOf_.find(w);
+    if (hit == hostOf_.end() || hit->second != hostFp_) {
+      continue;
+    }
+    auto lit = leases_.find(w);
+    if (lit == leases_.end() || lit->second.lastChangeMs == 0 ||
+        now - lit->second.lastChangeMs <= graceMs_) {
+      return false;  // lower-wid leader not (yet) observed dead
+    }
+  }
+  return false;
+}
+
+void ElasticAgent::publishAggregate(const std::vector<int64_t>& members) {
+  std::vector<std::pair<int64_t, std::pair<bool, uint64_t>>> rows;
+  for (int64_t w : members) {
+    auto hit = hostOf_.find(w);
+    if (hit == hostOf_.end() || hit->second != hostFp_) {
+      continue;
+    }
+    bool present = false;
+    uint64_t value = 0;
+    if (w == wid_) {
+      present = true;
+      value = heartbeatCounter_.load(std::memory_order_relaxed);
+    } else if (store_->check({leaseKey(w)})) {
+      try {
+        value = unpackCounter(store_->get(leaseKey(w), kProbeTimeout));
+        present = true;
+      } catch (const TimeoutException&) {
+        // Deleted between check and get: report absent.
+      }
+    }
+    rows.emplace_back(w, std::make_pair(present, value));
+  }
+  Store::Buf blob;
+  packU32(blob, kAggMagic);
+  packU64(blob, ++aggBeat_);
+  packU32(blob, static_cast<uint32_t>(rows.size()));
+  for (const auto& row : rows) {
+    packU64(blob, static_cast<uint64_t>(row.first));
+    packU64(blob, row.second.second);
+    blob.push_back(row.second.first ? 1 : 0);
+  }
+  store_->set(aggKey(hostFp_), blob);
+  aggPublishes_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void ElasticAgent::sampleAggregates(const std::vector<int64_t>& members,
+                                    int64_t now) {
+  std::vector<std::string> fps;
+  for (int64_t w : members) {
+    auto hit = hostOf_.find(w);
+    if (hit != hostOf_.end() &&
+        std::find(fps.begin(), fps.end(), hit->second) == fps.end()) {
+      fps.push_back(hit->second);
+    }
+  }
+  for (const auto& fp : fps) {
+    AggObs& obs = aggObs_[fp];
+    if (obs.lastChangeMs == 0) {
+      obs.lastChangeMs = now;
+    }
+    Store::Buf raw;
+    try {
+      raw = store_->get(aggKey(fp), kProbeTimeout);
+    } catch (const TimeoutException&) {
+      continue;  // no leader published yet: individual path covers it
+    }
+    constexpr size_t kHeader = 16;  // magic + beat + count
+    constexpr size_t kRow = 17;     // wid + value + present
+    if (raw.size() < kHeader) {
+      continue;
+    }
+    uint32_t magic = 0;
+    uint64_t beat = 0;
+    uint32_t count = 0;
+    std::memcpy(&magic, raw.data(), sizeof(magic));
+    std::memcpy(&beat, raw.data() + 4, sizeof(beat));
+    std::memcpy(&count, raw.data() + 12, sizeof(count));
+    if (magic != kAggMagic || raw.size() < kHeader + size_t(count) * kRow) {
+      continue;  // torn or foreign blob: degrade, never misjudge
+    }
+    std::map<int64_t, std::pair<bool, uint64_t>> values;
+    size_t off = kHeader;
+    for (uint32_t i = 0; i < count; i++) {
+      int64_t w = 0;
+      uint64_t v = 0;
+      std::memcpy(&w, raw.data() + off, sizeof(w));
+      std::memcpy(&v, raw.data() + off + 8, sizeof(v));
+      values[w] = {raw[off + 16] != 0, v};
+      off += kRow;
+    }
+    if (!obs.seen || beat != obs.leaderBeat) {
+      obs.seen = true;
+      obs.leaderBeat = beat;
+      obs.lastChangeMs = now;
+    }
+    obs.values = std::move(values);
+  }
+  for (auto it = aggObs_.begin(); it != aggObs_.end();) {
+    if (std::find(fps.begin(), fps.end(), it->first) == fps.end()) {
+      it = aggObs_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void ElasticAgent::readLease(int64_t w, int64_t now, bool* present,
+                             uint64_t* value) {
+  *present = false;
+  *value = 0;
+  if (leaseAgg_) {
+    auto hit = hostOf_.find(w);
+    if (hit != hostOf_.end()) {
+      auto ait = aggObs_.find(hit->second);
+      if (ait != aggObs_.end() && ait->second.seen &&
+          now - ait->second.lastChangeMs <= graceMs_) {
+        auto vit = ait->second.values.find(w);
+        if (vit != ait->second.values.end()) {
+          *present = vit->second.first;
+          *value = vit->second.second;
+          return;
+        }
+        // The leader's blob predates this member: fall through to the
+        // individual key until the next aggregate covers it.
+      }
+      // Stale or absent aggregate (dead leader): degraded path below —
+      // the host's members are judged by their individual leases for
+      // the grace window until a successor leader takes over.
+    }
+  }
+  if (!store_->check({leaseKey(w)})) {
+    return;
+  }
+  try {
+    *value = unpackCounter(store_->get(leaseKey(w), kProbeTimeout));
+    *present = true;
+  } catch (const TimeoutException&) {
+    // Deleted between check and get: report absent.
+  }
 }
 
 int64_t ElasticAgent::nowMs() const {
@@ -378,6 +625,9 @@ bool ElasticAgent::publishEpoch(uint64_t target,
   // namespace (whose mesh bootstrap blobs are the bulk of the keys).
   for (int64_t w : dead) {
     store_->deleteKey(leaseKey(w));
+    if (leaseAgg_) {
+      store_->deleteKey(k("host/" + std::to_string(w)));
+    }
   }
   for (int64_t w : admitted) {
     store_->deleteKey(k("join/" + std::to_string(w)));
@@ -426,7 +676,18 @@ void ElasticAgent::monitorOnce() {
   }
 
   // ---- liveness: change observation on every other member's lease ----
+  // With TPUCOLL_LEASE_AGG the per-member sample comes from the member's
+  // host aggregate (O(hosts) store reads per pass, refreshed just
+  // below) instead of its individual key (O(N)); the change-observation
+  // logic on the sampled value is identical either way.
   const int64_t now = nowMs();
+  if (leaseAgg_) {
+    refreshHostMap(members);
+    if (actingHostLeader(members, now)) {
+      publishAggregate(members);
+    }
+    sampleAggregates(members, now);
+  }
   std::vector<int64_t> dead;
   for (int64_t w : members) {
     if (w == wid_) {
@@ -436,7 +697,10 @@ void ElasticAgent::monitorOnce() {
     if (obs.lastChangeMs == 0) {
       obs.lastChangeMs = now;  // first observation of this member
     }
-    if (!store_->check({leaseKey(w)})) {
+    bool present = false;
+    uint64_t value = 0;
+    readLease(w, now, &present, &value);
+    if (!present) {
       if (obs.seen) {
         dead.push_back(w);  // deleted lease: graceful leave, no grace
       } else if (now - obs.lastChangeMs > graceMs_) {
@@ -444,8 +708,6 @@ void ElasticAgent::monitorOnce() {
       }
       continue;
     }
-    const uint64_t value =
-        unpackCounter(store_->get(leaseKey(w), kProbeTimeout));
     if (!obs.seen || value != obs.value) {
       obs.seen = true;
       obs.value = value;
@@ -763,9 +1025,14 @@ void ElasticAgent::stop() {
   }
   if (!already && wid_ >= 0) {
     // Graceful leave: a deleted (previously seen) lease is an immediate
-    // departure for every observer — no grace wait.
+    // departure for every observer — no grace wait. A departing host
+    // leader's aggregate simply goes stale; observers degrade to the
+    // individual leases of that host until the successor publishes.
     store_->deleteKey(leaseKey(wid_));
     store_->deleteKey(k("join/" + std::to_string(wid_)));
+    if (leaseAgg_) {
+      store_->deleteKey(k("host/" + std::to_string(wid_)));
+    }
   }
 }
 
@@ -810,7 +1077,9 @@ std::string ElasticAgent::statusJson() const {
       << ",\"last_rebuild_ms\":" << lastRebuildMs_
       << ",\"fault_domain\":" << boundDomain_
       << ",\"lease_ms\":" << leaseMs_ << ",\"lease_grace_ms\":" << graceMs_
-      << "}";
+      << ",\"lease_agg\":" << (leaseAgg_ ? "true" : "false")
+      << ",\"agg_publishes\":"
+      << aggPublishes_.load(std::memory_order_relaxed) << "}";
   return out.str();
 }
 

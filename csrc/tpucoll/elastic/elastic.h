@@ -16,6 +16,19 @@
 //    lease that was previously observed is an immediate, graceful
 //    departure (stop()).
 //
+//    With TPUCOLL_LEASE_AGG=1 (docs/bootstrap.md) the SCAN side of
+//    liveness aggregates per host: each worker publishes its host
+//    fingerprint once (`host/<wid>`), the lowest live wid per host acts
+//    as host leader and folds its co-members' individual lease values
+//    into one aggregate key (`agg/<fp-hash>`) every monitor pass, and
+//    every monitor samples O(hosts) aggregates instead of O(N)
+//    individual leases per pass. Members keep renewing their individual
+//    leases (writes are already O(N) fleet-wide and shard naturally;
+//    the N×N scan is the term that melts the store at P>=512), so when
+//    a leader dies its aggregate goes stale and observers degrade to
+//    the individual leases of that host for the grace window until the
+//    next-lowest wid takes the leader role over.
+//
 //  - Membership. The coordinator — the lowest live wid — publishes
 //    immutable epoch documents `e<N>/doc` = {epoch, members, cause} and
 //    advances a `head` counter. Publication is single-writer per epoch
@@ -129,6 +142,24 @@ class ElasticAgent {
  private:
   std::string k(const std::string& suffix) const;
   std::string leaseKey(int64_t wid) const;
+  std::string aggKey(const std::string& hostFp) const;
+  // ---- per-host lease aggregation (monitor thread only) ----
+  // Lazily (re)read the member -> host-fingerprint map for the current
+  // epoch: O(N) store reads once per epoch, not per pass.
+  void refreshHostMap(const std::vector<int64_t>& members);
+  // True when this wid should publish its host's aggregate: it is the
+  // lowest same-host member wid not currently observed expired.
+  bool actingHostLeader(const std::vector<int64_t>& members, int64_t now);
+  // Leader duty: fold co-members' individual lease values into one
+  // aggregate write.
+  void publishAggregate(const std::vector<int64_t>& members);
+  // Observer duty: one get per distinct member host, change-observed on
+  // the embedded leader beat.
+  void sampleAggregates(const std::vector<int64_t>& members, int64_t now);
+  // (present, value) of member w's lease — from its host's FRESH
+  // aggregate when there is one, else the individual key (the degraded
+  // path while a dead leader's aggregate ages out).
+  void readLease(int64_t w, int64_t now, bool* present, uint64_t* value);
   void heartbeatOnce();
   void heartbeatLoop();
   void monitorLoop();
@@ -156,6 +187,8 @@ class ElasticAgent {
   const long leaseMs_;
   const long graceMs_;
   const long pollMs_;
+  const bool leaseAgg_;    // TPUCOLL_LEASE_AGG
+  std::string hostFp_;     // this worker's host fingerprint (agg mode)
   int64_t wid_{-1};
 
   std::atomic<bool> stop_{false};
@@ -192,6 +225,21 @@ class ElasticAgent {
   };
   uint64_t monitorStateEpoch_{0};          // monitor thread only
   std::map<int64_t, LeaseObs> leases_;     // monitor thread only
+  // Lease-aggregation state (monitor thread only). AggObs mirrors
+  // LeaseObs one level up: change observation on the leader's embedded
+  // beat decides whether the aggregate is trustworthy at all.
+  struct AggObs {
+    uint64_t leaderBeat{0};
+    int64_t lastChangeMs{0};
+    bool seen{false};
+    // wid -> (present, lease value) as sampled by the host leader.
+    std::map<int64_t, std::pair<bool, uint64_t>> values;
+  };
+  uint64_t hostMapEpoch_{0};               // monitor thread only
+  std::map<int64_t, std::string> hostOf_;  // monitor thread only
+  std::map<std::string, AggObs> aggObs_;   // monitor thread only
+  uint64_t aggBeat_{0};                    // monitor thread only
+  std::atomic<uint64_t> aggPublishes_{0};
   // Join-queue lease observations, kept across epoch changes (a joiner
   // is not a member) and pruned with the queue itself.
   std::map<int64_t, LeaseObs> joinLeases_;  // monitor thread only

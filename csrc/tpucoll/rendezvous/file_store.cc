@@ -35,6 +35,66 @@ std::string readAll(int fd) {
   return out;
 }
 
+// Key-in-filename encoding ("tk_" scheme): [A-Za-z0-9_-] pass through,
+// everything else (including '.' and '%') percent-escapes, so a listing
+// recovers every key from readdir alone — no per-file open — and the
+// ".tmp." / ".lock" suffixes writeAtomic/add append can never collide
+// with an encoded key (no encoded name contains '.').
+bool safeNameChar(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == '-';
+}
+
+std::string escapeKey(const std::string& key) {
+  static const char* hex = "0123456789ABCDEF";
+  std::string out;
+  out.reserve(key.size());
+  for (unsigned char c : key) {
+    if (safeNameChar(c)) {
+      out.push_back(static_cast<char>(c));
+    } else {
+      out.push_back('%');
+      out.push_back(hex[c >> 4]);
+      out.push_back(hex[c & 0xF]);
+    }
+  }
+  return out;
+}
+
+int hexVal(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  return -1;
+}
+
+// Inverse of escapeKey; false on a malformed escape (foreign file).
+bool unescapeKey(const std::string& name, std::string* key) {
+  key->clear();
+  key->reserve(name.size());
+  for (size_t i = 0; i < name.size(); i++) {
+    if (name[i] != '%') {
+      key->push_back(name[i]);
+      continue;
+    }
+    if (i + 2 >= name.size()) {
+      return false;
+    }
+    const int hi = hexVal(name[i + 1]);
+    const int lo = hexVal(name[i + 2]);
+    if (hi < 0 || lo < 0) {
+      return false;
+    }
+    key->push_back(static_cast<char>((hi << 4) | lo));
+    i += 2;
+  }
+  return true;
+}
+
+// Escaped names longer than this fall back to the legacy fnv64-hashed
+// scheme ("tc_"), keeping well under the 255-byte filename limit.
+constexpr size_t kMaxEscapedName = 200;
+
 }  // namespace
 
 FileStore::FileStore(std::string path) : path_(std::move(path)) {
@@ -46,6 +106,13 @@ FileStore::FileStore(std::string path) : path_(std::move(path)) {
 }
 
 std::string FileStore::fileFor(const std::string& key) const {
+  // Key-in-filename ("tk_") so listKeys is a pure readdir + name
+  // filter; very long keys keep the legacy hashed ("tc_") scheme, whose
+  // listing path must open the file and read the [keyLen][key] header.
+  std::string esc = escapeKey(key);
+  if (esc.size() <= kMaxEscapedName) {
+    return path_ + "/tk_" + esc;
+  }
   char name[32];
   snprintf(name, sizeof(name), "tc_%016llx",
            static_cast<unsigned long long>(fnv64(key)));
@@ -144,10 +211,24 @@ std::vector<std::string> FileStore::listKeys(const std::string& prefix) {
   struct dirent* ent;
   while ((ent = readdir(dir)) != nullptr) {
     const std::string name(ent->d_name);
-    if (name.compare(0, 3, "tc_") != 0 ||
-        name.find(".tmp.") != std::string::npos ||
+    if (name.find(".tmp.") != std::string::npos ||
         (name.size() >= 5 &&
          name.compare(name.size() - 5, 5, ".lock") == 0)) {
+      continue;
+    }
+    // Fast path: "tk_" names carry the escaped key — the listing costs
+    // one readdir total, zero opens (the elastic monitor and the boot
+    // plane list on their poll cadence; under large N the per-file open
+    // of the hashed scheme dominated the whole poll).
+    if (name.compare(0, 3, "tk_") == 0) {
+      std::string key;
+      if (unescapeKey(name.substr(3), &key) &&
+          key.compare(0, prefix.size(), prefix) == 0) {
+        out.push_back(std::move(key));
+      }
+      continue;
+    }
+    if (name.compare(0, 3, "tc_") != 0) {
       continue;
     }
     int fd = open((path_ + "/" + name).c_str(), O_RDONLY);

@@ -301,10 +301,14 @@ void TcpStoreServer::serveClient(int fd) {
         {
           std::lock_guard<std::mutex> guard(mu_);
           const std::string& prefix = keys[0];
-          for (const auto& kv : map_) {
-            if (kv.first.compare(0, prefix.size(), prefix) == 0) {
-              vals.emplace_back(kv.first.begin(), kv.first.end());
-            }
+          // Ordered map: jump to the first candidate and stop at the
+          // first key past the prefix range — never a full-namespace
+          // walk under the serving lock.
+          for (auto it = map_.lower_bound(prefix);
+               it != map_.end() &&
+               it->first.compare(0, prefix.size(), prefix) == 0;
+               ++it) {
+            vals.emplace_back(it->first.begin(), it->first.end());
           }
         }
         ok = writeResponse(fd, kOk, vals);

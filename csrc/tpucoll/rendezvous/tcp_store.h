@@ -21,6 +21,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -53,7 +54,11 @@ class TcpStoreServer {
 
   std::mutex mu_;
   std::condition_variable cv_;
-  std::unordered_map<std::string, Store::Buf> map_;
+  // Ordered so kList serves a prefix as a lower_bound range scan
+  // (O(log n + matches)) instead of walking every key under the lock —
+  // the elastic monitor and the boot plane list on their poll cadence,
+  // and a large-N namespace made the full scan the server's hot loop.
+  std::map<std::string, Store::Buf> map_;
 };
 
 class TcpStore : public Store {

@@ -6,6 +6,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "tpucoll/boot/lazy_id.h"
 #include "tpucoll/common/env.h"
 #include "tpucoll/transport/device.h"
 #include "tpucoll/transport/pair.h"
@@ -25,6 +26,17 @@ std::string rankKey(int rank) { return "tc/rank/" + std::to_string(rank); }
 // byte layout, and a channel-count mismatch between ranks fails the
 // bootstrap loudly instead of hanging the mesh.
 constexpr uint32_t kBlobChannelsMagic = 0x7C01100A;
+
+// Lazy address blob (enableLazy bootstrap):
+// [u32 magic][u32 channels][u32 addrLen][addr]. No per-peer pair ids —
+// the lazy id codec (boot/lazy_id.h) derives routing ids from
+// (mesh, generation, initiator, target, channel) deterministically, so
+// the rendezvous exchange carries O(1) bytes per rank instead of O(n).
+constexpr uint32_t kLazyBlobMagic = 0x7C0B0071;
+// Eviction close grace: the victim's remote side is an rx-only lazy
+// inbound pair that replies to the goodbye immediately, so the
+// handshake completes in a round trip, not a drain.
+constexpr std::chrono::milliseconds kEvictGrace(250);
 
 std::vector<uint8_t> packRankBlob(int numRanks, const SockAddr& addr,
                                   const std::vector<uint64_t>& pairIds,
@@ -150,6 +162,8 @@ Context::~Context() {
   // matchIncoming / stripeIncoming); pairs shard across the whole loop
   // pool, so quiesce EVERY loop before members are freed.
   device_->barrierAllLoops();
+  graveyard_.clear();
+  inboundPairs_.clear();
   channelPairs_.clear();
   pairs_.clear();
 }
@@ -367,31 +381,47 @@ void Context::postPut(UnboundBuffer* buf, int dstRank, uint64_t token,
   }
   buf->addPendingSend();
   Pair* pair = nullptr;
+  bool pinned = false;
   {
-    std::lock_guard<std::mutex> guard(mu_);
+    std::unique_lock<std::mutex> lock(mu_);
     if (closed_ || !pairErrors_[dstRank].empty()) {
       buf->cancelPendingSend();
       TC_THROW(IoException, "put to rank ", dstRank, ": ",
                closed_ ? "context closed" : pairErrors_[dstRank].c_str());
     }
-    pair = pairs_[dstRank].get();
+    try {
+      pair = outboundForLocked(dstRank, lock, &pinned);
+    } catch (...) {
+      buf->cancelPendingSend();
+      throw;
+    }
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
-  // Non-notify puts stripe like sends (each stripe is an independent
-  // one-sided write of a disjoint range — no receiver-side reassembly
-  // needed). Notify puts stay whole: the arrival notification must fire
-  // after ALL bytes land, and cross-channel arrival order is undefined.
-  if (channels_ > 1 && !notify && nbytes >= stripeBytes_ &&
-      nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
-    buf->cancelPendingSend();  // postPutStriped re-adds exactly once
-    postPutStriped(buf, dstRank, token, roffset, data, nbytes);
-    return;
-  }
   try {
-    pair->sendPut(buf, token, roffset, data, nbytes, notify);
+    // Non-notify puts stripe like sends (each stripe is an independent
+    // one-sided write of a disjoint range — no receiver-side reassembly
+    // needed). Notify puts stay whole: the arrival notification must fire
+    // after ALL bytes land, and cross-channel arrival order is undefined.
+    if (channels_ > 1 && !notify && nbytes >= stripeBytes_ &&
+        nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
+      buf->cancelPendingSend();  // postPutStriped re-adds exactly once
+      postPutStriped(buf, dstRank, token, roffset, data, nbytes);
+    } else {
+      try {
+        pair->sendPut(buf, token, roffset, data, nbytes, notify);
+      } catch (...) {
+        buf->cancelPendingSend();
+        throw;
+      }
+    }
   } catch (...) {
-    buf->cancelPendingSend();
+    if (pinned) {
+      unpinLazy(dstRank);
+    }
     throw;
+  }
+  if (pinned) {
+    unpinLazy(dstRank);
   }
 }
 
@@ -428,13 +458,14 @@ void Context::postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
     return;
   }
   Pair* pair = nullptr;
+  bool pinned = false;
   {
-    std::lock_guard<std::mutex> guard(mu_);
+    std::unique_lock<std::mutex> lock(mu_);
     if (closed_ || !pairErrors_[dstRank].empty()) {
       TC_THROW(IoException, "get from rank ", dstRank, ": ",
                closed_ ? "context closed" : pairErrors_[dstRank].c_str());
     }
-    pair = pairs_[dstRank].get();
+    pair = outboundForLocked(dstRank, lock, &pinned);
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
   WireGetReq req{token, roffset, nbytes};
@@ -442,26 +473,77 @@ void Context::postGetRequest(int dstRank, uint64_t respSlot, uint64_t token,
   std::memcpy(payload.data(), &req, sizeof(req));
   WireHeader header{kMsgMagic, static_cast<uint8_t>(Opcode::kGetReq),
                     0, {0, 0}, respSlot, sizeof(req), 0};
-  pair->sendOwned(header, std::move(payload));
+  try {
+    pair->sendOwned(header, std::move(payload));
+  } catch (...) {
+    if (pinned) {
+      unpinLazy(dstRank);
+    }
+    throw;
+  }
+  if (pinned) {
+    unpinLazy(dstRank);
+  }
 }
 
 void Context::close() {
+  bool wasLazy;
+  uint32_t meshId;
   {
     std::lock_guard<std::mutex> guard(mu_);
     if (closed_) {
       return;
     }
     closed_ = true;
+    wasLazy = lazy_;
+    meshId = meshId_;
   }
-  for (auto& pair : pairs_) {
-    if (pair) {
-      pair->close();
+  if (wasLazy) {
+    // Stop routing new broker-dialed inbound connections here before the
+    // pair tables start draining.
+    device_->unregisterLazyMesh(meshId);
+  }
+  // Snapshot the pair tables under mu_ and close outside it (Pair::close
+  // blocks on loop barriers that themselves take mu_ via onPairError).
+  // With the lazy broker the tables mutate at any time — loop threads
+  // quiet-drop entries into the graveyard and app threads install dials —
+  // so the pre-lazy lock-free walk here was a use-after-free against a
+  // concurrent graveyard reallocation. Every entry snapshotted stays
+  // alive: closed_ (set above) makes dials refuse under mu_, and the only
+  // destroyer — the dial-time graveyard reap — first unlinks its victims
+  // from graveyard_ while holding mu_, so it can never free a pair this
+  // snapshot saw. Quiet drops only MOVE pairs between tables, which the
+  // raw-pointer snapshot is indifferent to.
+  std::vector<Pair*> toClose;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    for (auto& pair : pairs_) {
+      if (pair) {
+        toClose.push_back(pair.get());
+      }
+    }
+    for (auto& cps : channelPairs_) {
+      for (auto& cp : cps) {
+        if (cp) {
+          toClose.push_back(cp.get());
+        }
+      }
+    }
+    for (auto& ips : inboundPairs_) {
+      for (auto& ip : ips) {
+        if (ip) {
+          toClose.push_back(ip.get());
+        }
+      }
+    }
+    for (auto& g : graveyard_) {
+      if (g) {
+        toClose.push_back(g.get());  // defunct entries no-op on close
+      }
     }
   }
-  for (auto& cps : channelPairs_) {
-    for (auto& cp : cps) {
-      cp->close();
-    }
+  for (Pair* pair : toClose) {
+    pair->close();
   }
   // Fail receives that will now never complete — posted ones and those
   // claimed by an in-flight stripe reassembly.
@@ -551,8 +633,9 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
     return;
   }
   Pair* pair = nullptr;
+  bool pinned = false;
   {
-    std::lock_guard<std::mutex> guard(mu_);
+    std::unique_lock<std::mutex> lock(mu_);
     if (closed_) {
       buf->cancelPendingSend();
       TC_THROW(IoException, "send on closed context");
@@ -562,25 +645,40 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
       TC_THROW(IoException, "send to failed rank ", dstRank, ": ",
                pairErrors_[dstRank]);
     }
-    pair = pairs_[dstRank].get();
+    try {
+      pair = outboundForLocked(dstRank, lock, &pinned);
+    } catch (...) {
+      buf->cancelPendingSend();
+      throw;
+    }
     TC_ENFORCE(pair != nullptr, "no pair for rank ", dstRank);
   }
-  // Stripe large payloads across the pair's data channels (perf path:
-  // TCP stack work, stash memcpys, and per-connection encryption then
-  // run concurrently on several loop threads). The shm plane already
-  // sidesteps the TCP serialization for same-host peers, so an shm
-  // pair keeps the single-connection path.
-  if (channels_ > 1 && nbytes >= stripeBytes_ &&
-      nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
-    buf->cancelPendingSend();  // postSendStriped re-adds exactly once
-    postSendStriped(buf, dstRank, slot, data, nbytes);
-    return;
-  }
   try {
-    pair->send(buf, slot, data, nbytes);
+    // Stripe large payloads across the pair's data channels (perf path:
+    // TCP stack work, stash memcpys, and per-connection encryption then
+    // run concurrently on several loop threads). The shm plane already
+    // sidesteps the TCP serialization for same-host peers, so an shm
+    // pair keeps the single-connection path.
+    if (channels_ > 1 && nbytes >= stripeBytes_ &&
+        nbytes >= static_cast<size_t>(channels_) && !pair->shmActive()) {
+      buf->cancelPendingSend();  // postSendStriped re-adds exactly once
+      postSendStriped(buf, dstRank, slot, data, nbytes);
+    } else {
+      try {
+        pair->send(buf, slot, data, nbytes);
+      } catch (...) {
+        buf->cancelPendingSend();
+        throw;
+      }
+    }
   } catch (...) {
-    buf->cancelPendingSend();
+    if (pinned) {
+      unpinLazy(dstRank);
+    }
     throw;
+  }
+  if (pinned) {
+    unpinLazy(dstRank);
   }
 }
 
@@ -657,14 +755,15 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
     // every admissible paused source so it can arrive — it is the oldest
     // in-stream, so it lands in this posted recv before the flood stashes.
     if (fromStash) {
-      if (stashSrc != rank_ && rxPaused_[stashSrc] && pairs_[stashSrc] &&
+      if (stashSrc != rank_ && rxPaused_[stashSrc] &&
+          hasAnyPairLocked(stashSrc) &&
           stashBytes_[stashSrc] < stashHighWater_ / 2) {
         rxPaused_[stashSrc] = 0;
         resumePeerLocked(stashSrc);  // under mu_: see stashArrived
       }
     } else {
       for (int r : srcRanks) {
-        if (rxPaused_[r] && pairs_[r]) {
+        if (rxPaused_[r] && hasAnyPairLocked(r)) {
           rxPaused_[r] = 0;
           resumePeerLocked(r);
         }
@@ -732,7 +831,7 @@ void Context::failPairsWithInflightSend(UnboundBuffer* buf) {
   }
   for (auto& cps : channelPairs_) {
     for (auto& cp : cps) {
-      if (cp->hasInflightSend(buf)) {
+      if (cp && cp->hasInflightSend(buf)) {
         cp->failFromUser(
             "send dropped: buffer destroyed while payload was in flight");
       }
@@ -759,8 +858,19 @@ void Context::failPairsWithInflightSend(UnboundBuffer* buf) {
           "recv dropped: buffer destroyed while stripes were in flight");
     }
     for (auto& cp : channelPairs_[src]) {
-      cp->failFromUser(
-          "recv dropped: buffer destroyed while stripes were in flight");
+      if (cp) {
+        cp->failFromUser(
+            "recv dropped: buffer destroyed while stripes were in flight");
+      }
+    }
+    if (lazy_) {
+      // The stripes actually arrive on the peer's dialed connections.
+      for (auto& ip : inboundPairs_[src]) {
+        if (ip) {
+          ip->failFromUser(
+              "recv dropped: buffer destroyed while stripes were in flight");
+        }
+      }
     }
   }
 }
@@ -768,12 +878,23 @@ void Context::failPairsWithInflightSend(UnboundBuffer* buf) {
 void Context::pausePeerLocked(int rank) {
   // Backpressure must cover every channel: a striped flood arrives on
   // all of them, and pausing only the primary would let the stripes
-  // keep filling the reassembly list.
+  // keep filling the reassembly list. In lazy mode the peer's payload
+  // traffic arrives on its dialed (our inbound) connections, so those
+  // must pause too.
   if (pairs_[rank]) {
     pairs_[rank]->pauseReading();
   }
   for (auto& cp : channelPairs_[rank]) {
-    cp->pauseReading();
+    if (cp) {
+      cp->pauseReading();
+    }
+  }
+  if (lazy_) {
+    for (auto& ip : inboundPairs_[rank]) {
+      if (ip) {
+        ip->pauseReading();
+      }
+    }
   }
 }
 
@@ -782,7 +903,16 @@ void Context::resumePeerLocked(int rank) {
     pairs_[rank]->resumeReading();
   }
   for (auto& cp : channelPairs_[rank]) {
-    cp->resumeReading();
+    if (cp) {
+      cp->resumeReading();
+    }
+  }
+  if (lazy_) {
+    for (auto& ip : inboundPairs_[rank]) {
+      if (ip) {
+        ip->resumeReading();
+      }
+    }
   }
   // A full-peer resume also lifts any stage-backpressure pauses
   // (resumeReading is idempotent; the mask must not go stale).
@@ -796,7 +926,7 @@ void Context::accountStageLocked(int srcRank, size_t bytes) {
 
 void Context::maybePauseAheadChannelsLocked(int srcRank) {
   if (stripeStageBytes_[srcRank] <= stashHighWater_ || srcRank == rank_ ||
-      rxPaused_[srcRank] || !pairs_[srcRank]) {
+      rxPaused_[srcRank] || !hasAnyPairLocked(srcRank)) {
     return;
   }
   // A channel is "ahead" when every open entry from this source already
@@ -822,7 +952,14 @@ void Context::maybePauseAheadChannelsLocked(int srcRank) {
   }
   for (int c = 0; c < channels_; c++) {
     if (ahead & (uint32_t(1) << c)) {
-      pairFor(srcRank, c)->pauseReading();
+      Pair* p = pairFor(srcRank, c);
+      if (p != nullptr) {
+        p->pauseReading();
+      }
+      if (lazy_ && static_cast<size_t>(c) < inboundPairs_[srcRank].size() &&
+          inboundPairs_[srcRank][c]) {
+        inboundPairs_[srcRank][c]->pauseReading();
+      }
       stripePausedMask_[srcRank] |= uint32_t(1) << c;
     }
   }
@@ -835,8 +972,15 @@ void Context::releaseStageLocked(int srcRank, size_t bytes) {
     const uint32_t mask = stripePausedMask_[srcRank];
     stripePausedMask_[srcRank] = 0;
     for (int c = 0; c < channels_; c++) {
-      if ((mask & (uint32_t(1) << c)) && pairFor(srcRank, c) != nullptr) {
+      if ((mask & (uint32_t(1) << c)) == 0) {
+        continue;
+      }
+      if (pairFor(srcRank, c) != nullptr) {
         pairFor(srcRank, c)->resumeReading();
+      }
+      if (lazy_ && static_cast<size_t>(c) < inboundPairs_[srcRank].size() &&
+          inboundPairs_[srcRank][c]) {
+        inboundPairs_[srcRank][c]->resumeReading();
       }
     }
   }
@@ -1098,7 +1242,7 @@ void Context::stashArrived(int srcRank, uint64_t slot,
       }
       if (srcRank != rank_ && !postedWantsSrc &&
           stashBytes_[srcRank] > stashHighWater_ && !rxPaused_[srcRank] &&
-          pairs_[srcRank]) {
+          hasAnyPairLocked(srcRank)) {
         rxPaused_[srcRank] = 1;
         // Under mu_: the flag and the pair's epoll state must change
         // atomically with respect to postRecv's resume path (ctx -> pair
@@ -1128,6 +1272,17 @@ void Context::shmStats(uint64_t* txBytes, uint64_t* rxBytes,
       active += pair->shmActive() ? 1 : 0;
     }
   }
+  // Lazy mode: payloads from a peer arrive on its dialed (our inbound)
+  // connections; count their ring traffic too.
+  for (auto& ips : inboundPairs_) {
+    for (auto& ip : ips) {
+      if (ip) {
+        tx += ip->shmTxBytes();
+        rx += ip->shmRxBytes();
+        active += ip->shmActive() ? 1 : 0;
+      }
+    }
+  }
   *txBytes = tx;
   *rxBytes = rx;
   *activePairs = active;
@@ -1138,10 +1293,19 @@ bool Context::peerUsesShm(int rank) {
     return true;  // self-sends combine from the stash / matcher directly
   }
   std::lock_guard<std::mutex> guard(mu_);
-  if (rank < 0 || rank >= size_ || !pairs_[rank]) {
+  if (rank < 0 || rank >= size_) {
     return false;
   }
-  return pairs_[rank]->shmActive();
+  // Lazy mode: "payloads from `rank` arrive through shm" is a property
+  // of the peer's dialed connection — our inbound pair.
+  if (lazy_) {
+    for (auto& ip : inboundPairs_[rank]) {
+      if (ip && ip->shmActive()) {
+        return true;
+      }
+    }
+  }
+  return pairs_[rank] != nullptr && pairs_[rank]->shmActive();
 }
 
 void Context::reportStall(UnboundBuffer* buf, bool isSend,
@@ -1168,7 +1332,7 @@ void Context::reportStall(UnboundBuffer* buf, bool isSend,
         for (auto& cps : channelPairs_) {
           uint64_t slot = 0;
           for (auto& cp : cps) {
-            if (cp->sendSlotFor(buf, &slot)) {
+            if (cp && cp->sendSlotFor(buf, &slot)) {
               stall.peer = cp->peerRank();
               stall.slot = slot;
               break;
@@ -1241,17 +1405,55 @@ void Context::debugDump() {
     if (pairs_[r]) {
       s += std::to_string(r) + ":[" + pairs_[r]->debugState() + "] ";
       for (size_t c = 0; c < channelPairs_[r].size(); c++) {
-        s += std::to_string(r) + ".ch" + std::to_string(c + 1) + ":[" +
-             channelPairs_[r][c]->debugState() + "] ";
+        if (channelPairs_[r][c]) {
+          s += std::to_string(r) + ".ch" + std::to_string(c + 1) + ":[" +
+               channelPairs_[r][c]->debugState() + "] ";
+        }
       }
     }
   }
   s += "}";
+  if (lazy_) {
+    size_t in = 0;
+    for (const auto& ips : inboundPairs_) {
+      for (const auto& ip : ips) {
+        in += ip ? 1 : 0;
+      }
+    }
+    s += " lazy{out=" + std::to_string(lazyOutboundCount_) +
+         " in=" + std::to_string(in) +
+         // relaxed: debug-dump counters, no ordering against pair state
+         " dials=" +
+         std::to_string(lazyDials_.load(std::memory_order_relaxed)) +
+         " evicted=" +
+         std::to_string(lazyEvictions_.load(std::memory_order_relaxed)) +
+         " graveyard=" + std::to_string(graveyard_.size()) + "}";
+  }
   fprintf(stderr, "%s\n", s.c_str());
 }
 
 void Context::onPairError(int rank, const std::string& message,
                           bool orderly, int channel) {
+  if (lazy_ && orderly) {
+    // Lazy plane: an orderly goodbye is the peer evicting one direction
+    // (or closing cleanly), not a death. Reap the defunct connections
+    // quietly — pairErrors_ stays clear so a future send simply
+    // re-dials, and posted receives stay live (the peer can reconnect
+    // and deliver; context close still fails them).
+    std::vector<UnboundBuffer*> victims;
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      if (lazyPinned_[rank] == 0 && !dialing_[rank]) {
+        quietDropLocked(rank);
+      }
+      dropStripesLocked(rank, message, channel, /*allQuiesced=*/false,
+                        &victims);
+    }
+    for (auto* b : victims) {
+      b->onRecvError(message);
+    }
+    return;
+  }
   if (metrics_ != nullptr && !orderly) {
     // Failure evidence for recovery tooling: even when the watchdog
     // never fired (a SIGKILL'd peer surfaces via EOF in milliseconds),
@@ -1299,6 +1501,370 @@ void Context::onPairError(int rank, const std::string& message,
   }
   for (auto* b : victims) {
     b->onRecvError(message);
+  }
+}
+
+// ---- lazy connection plane --------------------------------------------
+
+std::vector<uint8_t> Context::lazyAddressBlob() const {
+  auto addrBytes = device_->address().serialize();
+  std::vector<uint8_t> blob(12 + addrBytes.size());
+  const uint32_t magic = kLazyBlobMagic;
+  const uint32_t ch = static_cast<uint32_t>(channels_);
+  const uint32_t alen = static_cast<uint32_t>(addrBytes.size());
+  std::memcpy(blob.data(), &magic, 4);
+  std::memcpy(blob.data() + 4, &ch, 4);
+  std::memcpy(blob.data() + 8, &alen, 4);
+  std::memcpy(blob.data() + 12, addrBytes.data(), addrBytes.size());
+  return blob;
+}
+
+void Context::parseLazyAddressBlob(const std::vector<uint8_t>& blob,
+                                   int expectChannels, SockAddr* addr) {
+  TC_ENFORCE_GE(blob.size(), size_t(12), "lazy address blob too short");
+  uint32_t magic, ch, alen;
+  std::memcpy(&magic, blob.data(), 4);
+  std::memcpy(&ch, blob.data() + 4, 4);
+  std::memcpy(&alen, blob.data() + 8, 4);
+  TC_ENFORCE_EQ(magic, kLazyBlobMagic, "lazy address blob corrupt");
+  TC_ENFORCE_EQ(int(ch), expectChannels,
+                "TPUCOLL_CHANNELS mismatch across ranks: peer uses ", ch,
+                ", this rank uses ", expectChannels);
+  TC_ENFORCE_GE(blob.size(), size_t(12) + alen,
+                "lazy address blob truncated");
+  *addr = SockAddr::deserialize(blob.data() + 12, alen);
+}
+
+void Context::enableLazy(uint64_t meshId, std::vector<SockAddr> peerAddrs,
+                         std::vector<char> eager, int maxPairs,
+                         std::chrono::milliseconds dialTimeout) {
+  TC_ENFORCE_EQ(peerAddrs.size(), static_cast<size_t>(size_),
+                "peer address table size mismatch");
+  TC_ENFORCE_EQ(eager.size(), static_cast<size_t>(size_),
+                "eager mask size mismatch");
+  TC_ENFORCE(size_ <= static_cast<int>(boot::kLazyMaxRanks),
+             "lazy broker supports up to ", boot::kLazyMaxRanks,
+             " ranks, got ", size_);
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    TC_ENFORCE(!lazy_, "enableLazy called twice");
+    for (const auto& p : pairs_) {
+      TC_ENFORCE(p == nullptr,
+                 "enableLazy must run before the mesh is created");
+    }
+    lazy_ = true;
+    meshId_ = static_cast<uint32_t>(meshId) & boot::kLazyMeshMask;
+    maxLazyPairs_ = maxPairs;
+    lazyDialTimeout_ = dialTimeout;
+    lazyPeerAddrs_ = std::move(peerAddrs);
+    lazyEager_ = std::move(eager);
+    dialGen_.assign(size_, 0);
+    dialing_.assign(size_, 0);
+    lazyPinned_.assign(size_, 0);
+    lazyLastUse_.assign(size_, 0);
+    inboundPairs_.resize(size_);
+    for (auto& v : inboundPairs_) {
+      v.resize(channels_);
+    }
+  }
+  device_->registerLazyMesh(meshId_, this);
+  TC_DEBUG("rank ", rank_, ": lazy broker armed (mesh ", meshId_,
+           ", cap ", maxLazyPairs_, ", ", channels_, " channel(s)/pair)");
+}
+
+void Context::dialEager(std::chrono::milliseconds timeout) {
+  std::unique_lock<std::mutex> lock(mu_);
+  TC_ENFORCE(lazy_, "dialEager requires enableLazy");
+  lazyDialTimeout_ = timeout;
+  for (int r = 0; r < size_; r++) {
+    if (r != rank_ && lazyEager_[r] && pairs_[r] == nullptr) {
+      ensureOutboundLocked(r, lock);
+    }
+  }
+}
+
+void Context::acceptLazyInbound(uint64_t pairId) {
+  const boot::LazyIdParts p = boot::parseLazyPairId(pairId);
+  if (p.target != rank_ || p.initiator < 0 || p.initiator >= size_ ||
+      p.initiator == rank_ || p.channel < 0 || p.channel >= channels_) {
+    TC_WARN("rank ", rank_, ": ignoring lazy connection with bad id "
+            "(initiator ", p.initiator, ", target ", p.target, ", channel ",
+            p.channel, ")");
+    return;
+  }
+  Pair* fresh = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    if (closed_ || !lazy_) {
+      return;
+    }
+    auto& slot = inboundPairs_[p.initiator][p.channel];
+    if (slot && slot->localPairId() == pairId && !slot->defunct()) {
+      return;  // duplicate hook firing for an already-claimed connection
+    }
+    if (slot) {
+      // A stale generation we have not yet seen EOF from: its own
+      // teardown reaps it in place; only the table slot moves.
+      graveyard_.push_back(std::move(slot));
+    }
+    const uint64_t key =
+        uint64_t(p.initiator) * channels_ + uint64_t(p.channel);
+    slot = std::make_unique<Pair>(this, device_->loopFor(key), rank_,
+                                  p.initiator, pairId, p.channel,
+                                  device_->loopIndexFor(key));
+    slot->setLazyInbound();
+    fresh = slot.get();
+  }
+  // Outside mu_: expect() may assume the parked connection inline, which
+  // starts rx on this loop thread (matchIncoming re-enters mu_).
+  fresh->expectViaListener(device_->listener());
+}
+
+void Context::lazyPairStats(uint64_t* connected, uint64_t* evicted,
+                            uint64_t* inbound, uint64_t* dials) {
+  std::lock_guard<std::mutex> guard(mu_);
+  uint64_t out = 0, in = 0;
+  for (int r = 0; r < size_; r++) {
+    out += pairs_[r] ? 1 : 0;
+  }
+  for (const auto& ips : inboundPairs_) {
+    for (const auto& ip : ips) {
+      in += (ip && !ip->defunct()) ? 1 : 0;
+    }
+  }
+  *connected = out;
+  *inbound = in;
+  *evicted = lazyEvictions_.load(std::memory_order_relaxed);
+  *dials = lazyDials_.load(std::memory_order_relaxed);
+}
+
+Pair* Context::outboundForLocked(int dstRank,
+                                 std::unique_lock<std::mutex>& lock,
+                                 bool* pinned) {
+  Pair* pair = pairs_[dstRank].get();
+  if (!lazy_) {
+    return pair;
+  }
+  if (pair != nullptr && pair->defunct() && lazyPinned_[dstRank] == 0) {
+    // The peer's whole context left orderly between ops and the quiet
+    // drop was deferred (rank was pinned at the time); reap now and
+    // fall through to a fresh dial.
+    quietDropLocked(dstRank);
+    pair = nullptr;
+  }
+  if (pair == nullptr) {
+    pair = ensureOutboundLocked(dstRank, lock);
+  }
+  lazyLastUse_[dstRank] = ++lazyUseTick_;
+  lazyPinned_[dstRank]++;
+  *pinned = true;
+  return pair;
+}
+
+Pair* Context::ensureOutboundLocked(int dstRank,
+                                    std::unique_lock<std::mutex>& lock) {
+  for (;;) {
+    if (closed_) {
+      TC_THROW(IoException, "send on closed context");
+    }
+    if (!pairErrors_[dstRank].empty()) {
+      TC_THROW(IoException, "send to failed rank ", dstRank, ": ",
+               pairErrors_[dstRank]);
+    }
+    if (pairs_[dstRank] != nullptr) {
+      return pairs_[dstRank].get();
+    }
+    if (dialing_[dstRank]) {
+      dialCv_.wait(lock);
+      continue;
+    }
+    dialing_[dstRank] = 1;
+    // Make room under the cap first, and piggyback the graveyard reap on
+    // the loop barrier the eviction close needs anyway. Only entries
+    // observed defunct BEFORE the barrier are freed: their teardown
+    // provably completed once every loop has ticked.
+    std::vector<std::unique_ptr<Pair>> evicted;
+    evictForCapLocked(&evicted);
+    std::vector<std::unique_ptr<Pair>> reap;
+    for (auto& g : graveyard_) {
+      if (g->defunct()) {
+        reap.push_back(std::move(g));
+      }
+    }
+    graveyard_.erase(
+        std::remove(graveyard_.begin(), graveyard_.end(), nullptr),
+        graveyard_.end());
+    const uint32_t gen = dialGen_[dstRank]++;
+    const std::chrono::milliseconds timeout = lazyDialTimeout_;
+    lock.unlock();
+    for (auto& v : evicted) {
+      v->close(kEvictGrace);
+    }
+    if (!evicted.empty() || !reap.empty()) {
+      device_->barrierAllLoops();
+      evicted.clear();
+      reap.clear();
+    }
+    std::vector<std::unique_ptr<Pair>> fresh(channels_);
+    std::string err;
+    try {
+      for (int c = 0; c < channels_; c++) {
+        const uint64_t key = uint64_t(dstRank) * channels_ + c;
+        // The deterministic id doubles as local id and remote routing
+        // id: the acceptor derives (mesh, initiator, channel) from it
+        // with no per-peer id exchange at rendezvous time.
+        const uint64_t id =
+            boot::makeLazyPairId(meshId_, gen, rank_, dstRank, c);
+        fresh[c] = std::make_unique<Pair>(this, device_->loopFor(key),
+                                          rank_, dstRank, id, c,
+                                          device_->loopIndexFor(key));
+        fresh[c]->connect(lazyPeerAddrs_[dstRank], id, timeout);
+      }
+      for (auto& f : fresh) {
+        f->waitConnected(timeout);
+      }
+    } catch (const std::exception& e) {
+      err = e.what();
+    }
+    lock.lock();
+    if (err.empty() && closed_) {
+      err = "context closed during lazy dial";
+    }
+    if (err.empty() && !pairErrors_[dstRank].empty()) {
+      err = pairErrors_[dstRank];
+    }
+    if (!err.empty()) {
+      dialing_[dstRank] = 0;
+      dialCv_.notify_all();
+      lock.unlock();
+      for (auto& f : fresh) {
+        if (f) {
+          f->close(std::chrono::milliseconds(0));
+        }
+      }
+      device_->barrierAllLoops();
+      fresh.clear();
+      lock.lock();
+      TC_THROW(IoException, "lazy dial to rank ", dstRank, " failed: ",
+               err);
+    }
+    lazyDials_.fetch_add(1, std::memory_order_relaxed);
+    // Install; anything stale from a prior generation moves to the
+    // graveyard (its own EOF teardown reaps it in place).
+    if (pairs_[dstRank]) {
+      graveyard_.push_back(std::move(pairs_[dstRank]));
+    }
+    for (auto& cp : channelPairs_[dstRank]) {
+      if (cp) {
+        graveyard_.push_back(std::move(cp));
+      }
+    }
+    channelPairs_[dstRank].clear();
+    pairs_[dstRank] = std::move(fresh[0]);
+    for (int c = 1; c < channels_; c++) {
+      channelPairs_[dstRank].push_back(std::move(fresh[c]));
+    }
+    if (!lazyEager_[dstRank]) {
+      lazyOutboundCount_++;
+    }
+    dialing_[dstRank] = 0;
+    dialCv_.notify_all();
+    return pairs_[dstRank].get();
+  }
+}
+
+void Context::evictForCapLocked(std::vector<std::unique_ptr<Pair>>* victims) {
+  if (!lazy_ || maxLazyPairs_ <= 0) {
+    return;
+  }
+  while (lazyOutboundCount_ >= maxLazyPairs_) {
+    int victim = -1;
+    uint64_t oldest = ~uint64_t(0);
+    for (int r = 0; r < size_; r++) {
+      if (r == rank_ || !pairs_[r] || lazyEager_[r] || dialing_[r] ||
+          lazyPinned_[r] != 0) {
+        continue;
+      }
+      if (lazyLastUse_[r] < oldest && logicalPairIdleLocked(r)) {
+        oldest = lazyLastUse_[r];
+        victim = r;
+      }
+    }
+    if (victim < 0) {
+      // Every broker pair is pinned or mid-op: exceed the cap under
+      // load rather than deadlock; the next dial trims back down.
+      return;
+    }
+    victims->push_back(std::move(pairs_[victim]));
+    for (auto& cp : channelPairs_[victim]) {
+      if (cp) {
+        victims->push_back(std::move(cp));
+      }
+    }
+    channelPairs_[victim].clear();
+    lazyOutboundCount_--;
+    lazyEvictions_.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+bool Context::logicalPairIdleLocked(int rank) {
+  if (!pairs_[rank]->idleForEvict()) {
+    return false;
+  }
+  for (auto& cp : channelPairs_[rank]) {
+    if (cp && !cp->idleForEvict()) {
+      return false;
+    }
+  }
+  return true;
+}
+
+void Context::unpinLazy(int rank) {
+  std::lock_guard<std::mutex> guard(mu_);
+  lazyPinned_[rank]--;
+}
+
+bool Context::hasAnyPairLocked(int rank) {
+  if (pairs_[rank]) {
+    return true;
+  }
+  if (!lazy_) {
+    return false;
+  }
+  for (const auto& ip : inboundPairs_[rank]) {
+    if (ip) {
+      return true;
+    }
+  }
+  return false;
+}
+
+void Context::quietDropLocked(int rank) {
+  bool outDead = pairs_[rank] != nullptr && pairs_[rank]->defunct();
+  for (const auto& cp : channelPairs_[rank]) {
+    outDead = outDead || (cp && cp->defunct());
+  }
+  if (outDead) {
+    // One dead component retires the whole logical outbound pair: the
+    // peer only closes this direction when its context goes away, so
+    // the siblings are dying too and a redial replaces all channels.
+    if (pairs_[rank]) {
+      if (!lazyEager_[rank] && lazyOutboundCount_ > 0) {
+        lazyOutboundCount_--;
+      }
+      graveyard_.push_back(std::move(pairs_[rank]));
+    }
+    for (auto& cp : channelPairs_[rank]) {
+      if (cp) {
+        graveyard_.push_back(std::move(cp));
+      }
+    }
+    channelPairs_[rank].clear();
+  }
+  for (auto& ip : inboundPairs_[rank]) {
+    if (ip && ip->defunct()) {
+      graveyard_.push_back(std::move(ip));
+    }
   }
 }
 

@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <list>
@@ -27,6 +28,7 @@
 #include "tpucoll/common/metrics.h"
 #include "tpucoll/common/tracer.h"
 #include "tpucoll/rendezvous/store.h"
+#include "tpucoll/transport/address.h"
 #include "tpucoll/transport/unbound_buffer.h"
 
 namespace tpucoll {
@@ -91,6 +93,40 @@ class Context {
   std::vector<uint8_t> prepareFullMesh();
   void connectWithBlobs(const std::vector<std::vector<uint8_t>>& blobs,
                         std::chrono::milliseconds timeout);
+
+  // ---- lazy connection plane (boot/, docs/bootstrap.md) ----
+  // Dual-simplex broker: each rank SENDS only on connections it dialed
+  // (pairs_/channelPairs_); peer-dialed connections land in a separate
+  // inbound table used for receive only. Receive matching is already
+  // context-level (posted_/stashed_ keyed by source rank), so a posted
+  // recv never needs a dialed pair, and two ranks dialing each other
+  // concurrently never race over one connection slot.
+  //
+  // This rank's address payload for the rendezvous exchange:
+  // [u32 magic][u32 channels][u32 addrLen][addr].
+  std::vector<uint8_t> lazyAddressBlob() const;
+  static void parseLazyAddressBlob(const std::vector<uint8_t>& blob,
+                                   int expectChannels, SockAddr* addr);
+  // Switch this context to lazy mode (instead of prepareFullMesh +
+  // connect*): store the full address table, register with the device's
+  // lazy-mesh registry under `meshId` (truncated to the id codec's mesh
+  // bits), and arm the broker. `eager` marks peers dialEager() connects
+  // up front (pinned, never evicted); everything else is dialed on
+  // first use, capped at `maxPairs` broker-dialed logical pairs
+  // (0 = unbounded) with LRU eviction of idle ones.
+  void enableLazy(uint64_t meshId, std::vector<SockAddr> peerAddrs,
+                  std::vector<char> eager, int maxPairs,
+                  std::chrono::milliseconds dialTimeout);
+  void dialEager(std::chrono::milliseconds timeout);
+  // Device hook (listener loop thread): a broker-dialed inbound
+  // connection arrived for this mesh; materialize its rx-only pair.
+  void acceptLazyInbound(uint64_t pairId);
+  bool lazyEnabled() const { return lazy_; }
+  // Broker counters (metrics "boot" family): currently connected
+  // outbound logical pairs (eager + broker-dialed), lifetime evictions,
+  // currently live inbound connections, lifetime broker dials.
+  void lazyPairStats(uint64_t* connected, uint64_t* evicted,
+                     uint64_t* inbound, uint64_t* dials);
 
   std::unique_ptr<UnboundBuffer> createUnboundBuffer(void* ptr, size_t size);
 
@@ -266,9 +302,36 @@ class Context {
   void postPutStriped(UnboundBuffer* buf, int dstRank, uint64_t token,
                       uint64_t roffset, char* data, size_t nbytes);
   // Channel c of the logical pair to `rank` (c == 0: the primary pair).
+  // May return null in lazy mode (pair not dialed / quiet-dropped).
   Pair* pairFor(int rank, int c) {
-    return c == 0 ? pairs_[rank].get() : channelPairs_[rank][c - 1].get();
+    if (c == 0) {
+      return pairs_[rank].get();
+    }
+    auto& cps = channelPairs_[rank];
+    return static_cast<size_t>(c - 1) < cps.size() ? cps[c - 1].get()
+                                                   : nullptr;
   }
+  // Lazy broker internals (mu_ held on entry/exit; ensureOutboundLocked
+  // drops the lock around the blocking dial and eviction close).
+  // outboundForLocked is the shared send-side lookup: full-mesh it is a
+  // plain table read; lazy it re-dials quiet-dropped peers, touches the
+  // LRU clock, and pins the pair (sets *pinned) across the caller's
+  // use-outside-mu_ window so the broker cannot evict or reap it.
+  Pair* outboundForLocked(int dstRank, std::unique_lock<std::mutex>& lock,
+                          bool* pinned);
+  Pair* ensureOutboundLocked(int dstRank, std::unique_lock<std::mutex>& lock);
+  void evictForCapLocked(std::vector<std::unique_ptr<Pair>>* victims);
+  bool logicalPairIdleLocked(int rank);
+  void unpinLazy(int rank);
+  // Any live connection to/from `rank` (outbound or lazy inbound)?
+  // Gates the stash-backpressure pause/resume paths, which in lazy mode
+  // must cover peer-dialed rx connections.
+  bool hasAnyPairLocked(int rank);
+  // Orderly lazy departure (peer evicted its dialed connection, or left
+  // cleanly): move this rank's DEFUNCT pairs to the graveyard without
+  // poisoning pairErrors_ — a future send simply re-dials. Healthy
+  // connections in the other direction are left untouched.
+  void quietDropLocked(int rank);
   // Stash backpressure across every channel of a peer (mu_ held).
   void pausePeerLocked(int rank);
   void resumePeerLocked(int rank);
@@ -372,6 +435,29 @@ class Context {
   };
   std::unordered_map<uint64_t, Region> regions_;
   uint64_t nextRegionToken_{1};
+
+  // ---- lazy broker state (mu_ unless noted) ----
+  bool lazy_{false};
+  uint32_t meshId_{0};  // codec-truncated rendezvous mesh id
+  int maxLazyPairs_{0};  // broker-dialed logical pair cap (0 = unbounded)
+  std::chrono::milliseconds lazyDialTimeout_{std::chrono::milliseconds(30000)};
+  std::vector<SockAddr> lazyPeerAddrs_;
+  std::vector<char> lazyEager_;      // pinned topology pairs, never evicted
+  std::vector<uint32_t> dialGen_;    // per-peer redial generation (id codec)
+  std::vector<char> dialing_;        // a thread is mid-dial to this peer
+  std::vector<int> lazyPinned_;      // ops between lookup and enqueue
+  std::vector<uint64_t> lazyLastUse_;  // LRU clock value per peer
+  uint64_t lazyUseTick_{0};
+  int lazyOutboundCount_{0};  // broker-dialed (non-eager) logical pairs
+  // inboundPairs_[rank][channel]: peer-dialed rx-only connections.
+  std::vector<std::vector<std::unique_ptr<Pair>>> inboundPairs_;
+  // Defunct pairs awaiting a safe destruction point (a Pair cannot be
+  // destroyed inside its own teardown callback; reaped under the loop
+  // barrier in close()/~Context).
+  std::vector<std::unique_ptr<Pair>> graveyard_;
+  std::condition_variable dialCv_;
+  std::atomic<uint64_t> lazyDials_{0};
+  std::atomic<uint64_t> lazyEvictions_{0};
 };
 
 }  // namespace transport

@@ -1,8 +1,10 @@
 #include "tpucoll/transport/device.h"
 
+#include "tpucoll/boot/lazy_id.h"
 #include "tpucoll/common/env.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/sysinfo.h"
+#include "tpucoll/transport/context.h"
 
 namespace tpucoll {
 namespace transport {
@@ -61,6 +63,47 @@ Device::Device(const DeviceAttr& attr)
   // handshakes are rare, and a fixed home keeps routing simple.
   listener_ = std::make_unique<Listener>(loops_[0].get(), bindAddr, authKey_,
                                          keyring_, encrypt_);
+}
+
+void Device::registerLazyMesh(uint32_t meshId, Context* ctx) {
+  {
+    std::lock_guard<std::mutex> guard(lazyMu_);
+    auto it = lazyMeshes_.find(meshId);
+    TC_ENFORCE(it == lazyMeshes_.end() || it->second == ctx,
+               "lazy mesh id collision: ", meshId);
+    lazyMeshes_[meshId] = ctx;
+  }
+  listener_->setUnclaimedHook(
+      [this](uint64_t pairId) { onUnclaimedLazy(pairId); });
+  // An eager peer may have dialed in while this mesh was still parsing
+  // rendezvous blobs; those connections parked unclaimed and must be
+  // routed now that the mesh can accept them.
+  listener_->replayUnclaimed();
+}
+
+void Device::unregisterLazyMesh(uint32_t meshId) {
+  std::lock_guard<std::mutex> guard(lazyMu_);
+  lazyMeshes_.erase(meshId);
+}
+
+void Device::onUnclaimedLazy(uint64_t pairId) {
+  const boot::LazyIdParts parts = boot::parseLazyPairId(pairId);
+  Context* ctx = nullptr;
+  {
+    std::lock_guard<std::mutex> guard(lazyMu_);
+    auto it = lazyMeshes_.find(parts.meshId);
+    if (it != lazyMeshes_.end()) {
+      ctx = it->second;
+    }
+  }
+  if (ctx == nullptr) {
+    // No registered mesh (context already closed, or a stale dialer):
+    // leave the connection parked; the listener reaps it at teardown.
+    TC_WARN("unclaimed lazy connection for unknown mesh ", parts.meshId,
+            " (initiator rank ", parts.initiator, ")");
+    return;
+  }
+  ctx->acceptLazyInbound(pairId);
 }
 
 std::string Device::str() const {

@@ -8,7 +8,9 @@
 #include <atomic>
 #include <cstdint>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #include "tpucoll/common/keyring.h"
@@ -18,6 +20,8 @@
 
 namespace tpucoll {
 namespace transport {
+
+class Context;
 
 struct DeviceAttr {
   // Hostname or IP to bind and advertise. Loopback default suits
@@ -88,7 +92,19 @@ class Device {
   bool busyPoll() const { return loops_[0]->busyPoll(); }
   std::string str() const;
 
+  // ---- lazy-mesh registry (boot plane) ----
+  // A context in lazy-connect mode registers under its rendezvous mesh
+  // id; the listener's unclaimed hook then routes broker-dialed inbound
+  // connections (lazy-namespace pair ids, boot/lazy_id.h) to that
+  // context's acceptLazyInbound. Register before any lazy peer can
+  // dial, unregister in Context::close() — the context stays alive
+  // through its destructor's barrierAllLoops(), which drains any hook
+  // still running on loop 0.
+  void registerLazyMesh(uint32_t meshId, Context* ctx);
+  void unregisterLazyMesh(uint32_t meshId);
+
  private:
+  void onUnclaimedLazy(uint64_t pairId);
   // Declared first: destroyed last. loops_[0] hosts the listener; the
   // rest are the data-channel shards.
   std::vector<std::unique_ptr<Loop>> loops_;
@@ -100,6 +116,8 @@ class Device {
   bool encrypt_{false};
   std::unique_ptr<Listener> listener_;
   std::atomic<uint64_t> pairId_{1};
+  std::mutex lazyMu_;
+  std::unordered_map<uint32_t, Context*> lazyMeshes_;
 };
 
 }  // namespace transport

@@ -322,6 +322,7 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
                              int fd, ConnKeys keys, int32_t authedRank,
                              std::unique_ptr<ShmSegment> shm) {
   Pair* target = nullptr;
+  std::function<void(uint64_t)> unclaimedHook;
   {
     std::lock_guard<std::mutex> guard(mu_);
     if (shuttingDown_) {
@@ -360,6 +361,9 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
           parked_.erase(old);
         }
         parked_[pairId] = Parked{fd, keys, authedRank, std::move(shm)};
+        if ((pairId & (uint64_t(1) << 63)) != 0) {
+          unclaimedHook = unclaimedHook_;  // copy under mu_ (replay races)
+        }
       }
     }
   }
@@ -370,6 +374,34 @@ void Listener::finishPending(PendingConn* conn, bool ok, uint64_t pairId,
   if (target != nullptr) {
     target->assumeConnected(fd, keys, std::move(shm),
                             /*shmInitiator=*/false);
+  } else if (unclaimedHook != nullptr) {
+    // Broker-dialed connection with no pair yet: ask the lazy-mesh
+    // registry to materialize the accepting side. The hook re-enters
+    // expect(), which claims the parked fd above.
+    unclaimedHook(pairId);
+  }
+}
+
+void Listener::replayUnclaimed() {
+  std::function<void(uint64_t)> hook;
+  std::vector<uint64_t> ids;
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    hook = unclaimedHook_;
+    for (const auto& kv : parked_) {
+      if ((kv.first & (uint64_t(1) << 63)) != 0) {
+        ids.push_back(kv.first);
+      }
+    }
+  }
+  if (hook == nullptr) {
+    return;
+  }
+  // Outside mu_: the hook re-enters expect(). A connection claimed
+  // between the snapshot and the call is fine — the accepting context
+  // treats an already-materialized pair id as a duplicate and returns.
+  for (uint64_t id : ids) {
+    hook(id);
   }
 }
 

@@ -6,6 +6,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <string>
 #include <list>
 #include <memory>
@@ -38,6 +39,26 @@ class Listener : public Handler {
   // it already arrived and was parked).
   void expect(uint64_t pairId, Pair* pair);
   void unexpect(uint64_t pairId);
+
+  // Lazy-dial hook (boot plane): invoked — outside the listener lock, on
+  // the listener's loop thread — when a fully-handshaked connection
+  // carrying a lazy-namespace pair id (boot/lazy_id.h bit 63) parks with
+  // no expecting pair. The hook (Device's lazy-mesh registry) builds the
+  // accepting Pair on demand and calls expect(), which picks the parked
+  // fd right back up. At most one hook; set before any lazy traffic.
+  void setUnclaimedHook(std::function<void(uint64_t)> hook) {
+    std::lock_guard<std::mutex> guard(mu_);
+    unclaimedHook_ = std::move(hook);
+  }
+
+  // Re-fire the unclaimed hook for lazy-namespace connections that
+  // parked BEFORE their mesh registered: an eager dialer can reach this
+  // listener while the local rank is still parsing rendezvous blobs, in
+  // which case finishPending's hook pass found no (or the wrong) mesh
+  // and the fd would otherwise stay parked forever. Called by the
+  // device's lazy-mesh registry after each registration, from the
+  // registering thread.
+  void replayUnclaimed();
 
   void handleEvents(uint32_t events) override;
 
@@ -73,6 +94,7 @@ class Listener : public Handler {
   std::unordered_map<uint64_t, Pair*> expected_;
   std::unordered_map<uint64_t, Parked> parked_;
   std::list<std::unique_ptr<PendingConn>> pending_;
+  std::function<void(uint64_t)> unclaimedHook_;
 };
 
 }  // namespace transport

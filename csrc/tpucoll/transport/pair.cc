@@ -1397,6 +1397,25 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     {
       std::lock_guard<std::mutex> guard(mu_);
       peerGoodbye_ = true;
+      if (lazyInbound_ && !closing_ &&
+          state_.load(std::memory_order_acquire) == State::kConnected) {
+        // Eviction handshake: answer the broker's goodbye at once so
+        // its close() returns without waiting out the grace, then let
+        // the EOF that follows tear this side down orderly.
+        closing_ = true;
+        TxOp op;
+        op.header = WireHeader{kMsgMagic,
+                               static_cast<uint8_t>(Opcode::kGoodbye),
+                               0, {0, 0}, 0, 0};
+        op.ubuf = nullptr;
+        op.data = nullptr;
+        op.nbytes = 0;
+        tx_.push_back(op);
+        std::vector<TxDone> completed;
+        flushTx(&completed);  // goodbye carries no ubuf: nothing completes
+        updateEpollMask();
+        pendingTxError_.clear();
+      }
     }
     cv_.notify_all();
     rxHeaderRead_ = 0;
@@ -2129,13 +2148,19 @@ void Pair::fail(const std::string& message) {
   teardown(State::kFailed, message, /*notifyContext=*/true);
 }
 
-void Pair::close() {
+bool Pair::idleForEvict() {
+  std::lock_guard<std::mutex> guard(mu_);
+  return state_.load(std::memory_order_acquire) == State::kConnected &&
+         tx_.empty() && !txInFlight_ && ctrlQ_.empty() && !closing_;
+}
+
+void Pair::close(std::chrono::milliseconds grace) {
   // Graceful departure: flush queued sends, announce goodbye, half-close the
   // write side, then keep reading until the peer's EOF. Draining prevents
   // the kernel from sending an RST (which would flush the peer's receive
   // queue and lose delivered-but-unread payloads) when ranks reach teardown
   // at different times.
-  static constexpr std::chrono::milliseconds kGrace{2000};
+  const std::chrono::milliseconds kGrace = grace;
   std::vector<TxDone> completed;
   {
     std::unique_lock<std::mutex> lock(mu_);

@@ -134,7 +134,27 @@ class Pair : public Handler {
   bool sendSlotFor(UnboundBuffer* ubuf, uint64_t* slot);
 
   // Graceful close; pending operations fail. Idempotent, thread-safe.
-  void close();
+  // `grace` bounds the goodbye/EOF drain (the default matches the
+  // historical close behavior; the lazy broker evicts with a shorter
+  // grace so a slow peer cannot stall the dial that triggered eviction).
+  void close(std::chrono::milliseconds grace = std::chrono::milliseconds(2000));
+
+  // ---- lazy broker hooks (transport::Context, boot plane) ----
+  // Marks a peer-initiated connection accepted on demand via the lazy
+  // pair-id namespace. Such a pair is rx-only (dual simplex: each side
+  // sends only on connections it dialed), and on receiving the peer's
+  // goodbye it answers with its own immediately — the evicting side's
+  // close() then completes without waiting out its grace, and this
+  // side's EOF tears down orderly. Set before connect/expect.
+  void setLazyInbound() { lazyInbound_ = true; }
+  // True once the pair tore down (failed or closed) — the broker drops
+  // such pairs from its tables on the next scan.
+  bool defunct() const {
+    State s = state_.load(std::memory_order_acquire);
+    return s == State::kFailed || s == State::kClosed;
+  }
+  // Eviction gate: connected with nothing queued or on the wire.
+  bool idleForEvict();
 
   // Hard-fail the pair from a user thread (see Context::
   // failPairsWithInflightSend).
@@ -332,6 +352,7 @@ class Pair : public Handler {
   bool closing_{false};      // goodbye enqueued (mu_)
   bool peerGoodbye_{false};  // peer announced orderly departure (mu_)
   bool rxPaused_{false};     // stash backpressure engaged (mu_)
+  bool lazyInbound_{false};  // broker-accepted rx-only pair (pre-connect)
 
   std::mutex mu_;
   std::condition_variable cv_;

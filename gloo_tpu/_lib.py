@@ -174,6 +174,12 @@ _PROTOTYPES = {
     "tc_fault_clear": (None, []),
     "tc_fault_report": (_int, [ctypes.POINTER(ctypes.POINTER(
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    # bootstrap plane (lazy pair broker + leader-relayed rendezvous)
+    "tc_boot_rendezvous_bench": (_int, [ctypes.c_char_p, _int, _int, _int,
+                                        _int, _int, _i64,
+                                        ctypes.POINTER(ctypes.POINTER(
+                                            ctypes.c_uint8)),
+                                        ctypes.POINTER(_sz)]),
     # collective autotuning plane
     "tc_tune": (_int, [_c, _sz, _sz, _int, _int, _u32, _i64,
                        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),

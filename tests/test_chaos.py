@@ -807,3 +807,106 @@ def test_chaos_same_seed_determinism_with_group_domains():
     # parent's root domain 0 traffic.
     assert any(d >= 1000 for d in domains), domains
     assert first, "no faults fired"
+
+
+def test_chaos_lazy_connect_refuse_on_first_use_dial():
+    """Lazy boot (docs/bootstrap.md) with nothing eager: the broker's
+    first-use dial hits the same typed connect-fault classification as
+    the seed's bring-up dials — refused twice, retried with backoff,
+    counted, and the collective still completes."""
+    store = tempfile.mkdtemp()
+    schedule = {"seed": 7, "faults": [
+        {"when": {"rank": 0}, "action": "connect_refuse", "count": 2}]}
+    body = """
+x = np.full(1000, float(rank + 1), dtype=np.float32)
+ctx.allreduce(x, tag=1)
+assert x[0] == size * (size + 1) / 2, x[0]
+boot = ctx.metrics()["boot"]
+assert boot["lazy"] is True, boot
+if rank == 0:
+    fired = fault.report(rank=0)
+    assert sum(1 for e in fired
+               if e["action"] == "connect_refuse") == 2, fired
+    assert ctx.metrics()["retries"] >= 2
+ctx.close()
+print("OK")
+"""
+    procs, outs = _run(body, 3, store, schedule,
+                       extra_env={"TPUCOLL_BOOT_MODE": "lazy",
+                                  "TPUCOLL_BOOT_EAGER": "none"})
+    _assert_ok(procs, outs)
+
+
+def test_chaos_lazy_evict_redial_same_seed_determinism():
+    """Acceptance: broker eviction churn does not perturb the fault
+    plane's determinism — a peer pair that is LRU-evicted and later
+    redialed (TPUCOLL_MAX_PAIRS=1) sees the same same-seed firing
+    sequence across two identical runs, byte for byte."""
+    import threading
+
+    import gloo_tpu
+    from gloo_tpu import fault
+
+    schedule = {"seed": 11, "faults": [
+        {"when": {"rank": 1, "opcode": "data"},
+         "action": "delay", "ms": 1, "prob": 0.5, "seed": 99}]}
+    size = 4
+
+    def workload():
+        store = gloo_tpu.HashStore()
+        evictions = [0] * size
+        errors = []
+
+        def worker(rank):
+            try:
+                ctx = gloo_tpu.Context(rank, size, timeout=30)
+                ctx.set_host_id(f"edh{rank // 2}")
+                ctx.connect_full_mesh(store, gloo_tpu.Device())
+                data = np.arange(64, dtype=np.float64)
+                out = np.zeros(64, dtype=np.float64)
+                for i in range(20):
+                    # Rank 1 alternates between the two cross-host
+                    # peers: cap=1 evicts the idle one before each
+                    # dial, so every other send rides a redial.
+                    peer = 2 + (i % 2)
+                    if rank == 1:
+                        ctx.send(data, dst=peer, slot=600 + i)
+                    elif rank == peer:
+                        ctx.recv(out, src=1, slot=600 + i)
+                ctx.barrier(tag=999)
+                evictions[rank] = ctx.metrics()["boot"]["pairs_evicted"]
+                ctx.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append((rank, e))
+
+        env = {"TPUCOLL_BOOT_MODE": "lazy", "TPUCOLL_MAX_PAIRS": "1",
+               "TPUCOLL_BOOT_EAGER": "none"}
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            threads = [threading.Thread(target=worker, args=(r,))
+                       for r in range(size)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(90)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        assert not errors, errors
+        assert evictions[1] > 0, evictions  # churn actually happened
+        return json.dumps(fault.report(rank=1), sort_keys=True)
+
+    fault.install(schedule)
+    try:
+        first = workload()
+        fault.install(schedule)  # reinstall: reset counters + report
+        second = workload()
+    finally:
+        fault.clear()
+    assert first == second
+    fired = json.loads(first)
+    assert 0 < len(fired) < 20, len(fired)

@@ -261,3 +261,35 @@ def test_bench_wire_sweep_smoke():
         assert line["value"] > 0
         algos.add(line["algorithm"])
     assert algos == {"ring", "ring_bf16_wire", "ring_q8_wire"}
+
+
+def test_bench_bootstrap_sweep_smoke():
+    """bench.py --bootstrap-sweep --quick: the choreography cells run
+    both rendezvous arms at N in {8, 32}, the real 8-rank lazy vs full
+    bring-up verifies its collectives and holds the broker cap under a
+    mixed soak, and the aggregated-lease elastic probe rebuilds — the
+    committed BOOT_r18.json records the full N<=512 curves (where the
+    lazy arm's win is ranked; quick Ns sit below the crossover, so
+    wall ratios are not asserted here)."""
+    import json
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(), "boot_sweep.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--bootstrap-sweep", "--quick", "--bootstrap-out", out],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "bootstrap_scale_sweep"
+    assert doc["ok"] is True, doc
+    assert [c["nranks"] for c in doc["choreography"]] == [8, 32]
+    for cell in doc["choreography"]:
+        # The relayed protocol's structural win holds at any N.
+        assert cell["ops_ratio"] > 1.0, cell
+    e2e = doc["e2e_8rank"]
+    assert e2e["ok"] is True, e2e
+    assert max(e2e["soak"]["broker_pairs_end"]) <= e2e["cap"]
+    assert e2e["soak"]["evictions"] > 0
+    assert doc["elastic_rebuild"]["ok"] is True, doc["elastic_rebuild"]

@@ -805,3 +805,97 @@ def test_tsan_fleet_smoke():
                             env=env)
     assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
     assert "FLEET-SMOKE-OK" in result.stdout, result.stdout
+
+
+_LAZY_BOOT_PROG = f"""
+import os, sys, threading
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+
+size, rph = 6, 3
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.set_host_id("sanhost%d" % (rank // rph))
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        for i in range(4):
+            x = np.full(2048, float(rank + 1), dtype=np.float32)
+            ctx.allreduce(x, tag=1)
+            assert x[0] == size * (size + 1) / 2, x[0]
+            a2a = np.full((size, 4), float(rank), dtype=np.float32)
+            out = ctx.alltoall(a2a, tag=2)
+            assert out[rank][0] == float(rank), out[rank][0]
+        # One quiesced broker dial: exercises LRU eviction + redial
+        # (TPUCOLL_MAX_PAIRS=1) under the sanitizer.
+        ctx.barrier(tag=3)
+        z = np.full(8, float(rank), dtype=np.float32)
+        ctx.send(z, (rank + 2) % size, slot=9)
+        w = np.empty(8, dtype=np.float32)
+        ctx.recv(w, (rank - 2) % size, slot=9)
+        assert w[0] == float((rank - 2) % size), w[0]
+        boot = ctx.metrics()["boot"]
+        assert boot["lazy"] is True, boot
+        # Host leaders keep the eager leader mesh, leaving them a single
+        # non-eager peer — the cap=1 LRU never has to evict for them.
+        # Non-leaders churn 2-3 broker peers through the cap every round.
+        if rank % rph != 0:
+            assert boot["pairs_evicted"] > 0, boot
+        ctx.barrier(tag=4)
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, repr(e)))
+
+threads = [threading.Thread(target=worker, args=(r,))
+           for r in range(size)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(240)
+assert not errors, errors
+print("LAZY-BOOT-SMOKE-OK")
+"""
+
+
+def test_asan_lazy_bootstrap_smoke():
+    """Skip-unless-built ASan smoke of the lazy bootstrap plane
+    (docs/bootstrap.md): 6 thread-ranks over 2 simulated hosts come up
+    with TPUCOLL_BOOT_MODE=lazy, run collectives that broker-dial on
+    first use, and churn the LRU cap (TPUCOLL_MAX_PAIRS=1) — the
+    dial / evict / graveyard-reap lifecycle is exactly where a
+    use-after-free in the pair broker would hide."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+                          "TPUCOLL_BOOT_MODE": "lazy",
+                          "TPUCOLL_MAX_PAIRS": "1"})
+    result = subprocess.run([sys.executable, "-c", _LAZY_BOOT_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "LAZY-BOOT-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_lazy_bootstrap_smoke():
+    """TSan flavor of the lazy bootstrap smoke: concurrent first-use
+    dials, context-level recv matching against rx-only inbound pairs,
+    and cap eviction from racing op threads — the broker's lock
+    discipline under the race detector."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7",
+                          "TPUCOLL_BOOT_MODE": "lazy",
+                          "TPUCOLL_MAX_PAIRS": "1"})
+    result = subprocess.run([sys.executable, "-c", _LAZY_BOOT_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "LAZY-BOOT-SMOKE-OK" in result.stdout, result.stdout
