@@ -1275,6 +1275,146 @@ def bench_profile(quick=False):
         sys.exit(1)
 
 
+def bench_critpath(quick=False):
+    """--critpath: overhead A/B of the causal span recorder (ISSUE 19;
+    docs/critpath.md) plus a critical-path attribution sanity cell.
+
+    The A/B times 2-rank ring allreduces with TPUCOLL_SPANS=1 vs =0 in
+    interleaved passes (host drift hits both arms equally) — the
+    committed evidence (CRIT_r19.json) that span recording stays inside
+    host noise. The attribution cell runs one spans-on pair, merges
+    both ranks' Context.spans() through utils.critpath.analyze(), and
+    reports how much of the op latency the extracted critical path
+    explains and that every wire edge matched."""
+    import tempfile
+    import textwrap
+
+    if quick:
+        elements, iters, warmup, ab_passes = 1 << 18, 3, 1, 2
+    else:
+        elements, iters, warmup, ab_passes = 1 << 22, 8, 2, 5
+
+    body = textwrap.dedent("""
+        import json, sys, time
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4])
+        warm = int(sys.argv[5]); store = sys.argv[2]
+        x = np.full(n, 1.0, dtype=np.float32)
+        for _ in range(warm):
+            ctx.allreduce(x, algorithm="ring")
+            x[:] = 1.0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ctx.allreduce(x, algorithm="ring")
+            times.append(time.perf_counter() - t0)
+            x[:] = 1.0
+        # Every rank parks its span snapshot in the store dir before the
+        # barrier so rank 0 can fold a cross-rank analysis after it.
+        import os
+        with open(os.path.join(store, f"spans-rank{{rank}}.json"),
+                  "w") as f:
+            json.dump(ctx.spans(), f)
+        ctx.barrier()
+        if rank == 0:
+            from gloo_tpu.utils import critpath
+            snaps = []
+            for r in range(2):
+                with open(os.path.join(store,
+                                       f"spans-rank{{r}}.json")) as f:
+                    snaps.append(json.load(f))
+            out = {{"p50_us": int(np.median(times) * 1e6),
+                    "spans_enabled": snaps[0]["enabled"]}}
+            if snaps[0]["enabled"]:
+                a = critpath.analyze(critpath.merge(snaps))
+                covs, unmatched = [], 0
+                for op in a["ops"]:
+                    if op["total_us"] <= 0:
+                        continue
+                    covered = sum(r["contrib_us"] for r in op["path"])
+                    covs.append(covered / op["total_us"])
+                    unmatched += sum(op["unmatched"].values())
+                covs.sort()
+                out.update(analyzed_ops=len(covs), unmatched=unmatched,
+                           path_coverage_p50=round(
+                               covs[len(covs) // 2], 4) if covs else 0.0)
+            print("RESULT " + json.dumps(out))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    def run_cell(spans_on):
+        store = tempfile.mkdtemp()
+        env = dict(os.environ, TPUCOLL_SHM="0",
+                   TPUCOLL_SPANS="1" if spans_on else "0")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", body, str(r), store, str(elements),
+             str(iters), str(warmup)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for r in range(2)]
+        outs = [p.communicate(timeout=600) for p in procs]
+        if any(p.returncode != 0 for p in procs) or \
+                "RESULT " not in outs[0][0]:
+            return None, [f"rank {r}: rc={p.returncode} "
+                          f"err={outs[r][1][-200:]!r}"
+                          for r, p in enumerate(procs)]
+        return json.loads(outs[0][0].split("RESULT ", 1)[1]), None
+
+    ok_all = True
+
+    # Attribution sanity cell (spans on, one pair).
+    res, err = run_cell(spans_on=True)
+    line = {"metric": "critpath_attribution", "algorithm": "ring",
+            "elements": elements, "bytes": elements * 4, "iters": iters}
+    if res is None:
+        ok_all = False
+        line.update(ok=False, error=err)
+    else:
+        line.update(ok=True, **res)
+    print(json.dumps(line))
+
+    # Overhead A/B: interleaved passes so host drift hits both arms
+    # equally; the JSON records both p50 series.
+    on_us, off_us = [], []
+    ab_errors = []
+    for i in range(ab_passes):
+        # Alternate which arm goes first so per-pass warm-up transients
+        # (page cache, cpufreq) don't land on one arm systematically.
+        arms = (("on", on_us), ("off", off_us))
+        for arm, acc in arms if i % 2 == 0 else arms[::-1]:
+            res, err = run_cell(spans_on=arm == "on")
+            if res is None:
+                ab_errors.extend(err)
+            else:
+                acc.append(res["p50_us"])
+    line = {"metric": "critpath_overhead_ab", "algorithm": "ring",
+            "elements": elements, "bytes": elements * 4,
+            "passes": ab_passes}
+    # A pass failure anywhere invalidates the A/B as committed evidence
+    # (same rule as profile_overhead_ab): every collected error is
+    # emitted and flips ok=False, even when both arms have survivors.
+    if not on_us or not off_us or ab_errors:
+        ok_all = False
+        line.update(ok=False, error=ab_errors,
+                    runs_on_us=on_us, runs_off_us=off_us)
+    else:
+        med_on = sorted(on_us)[len(on_us) // 2]
+        med_off = sorted(off_us)[len(off_us) // 2]
+        line.update(ok=True, p50_us_spans_on=med_on,
+                    p50_us_spans_off=med_off,
+                    runs_on_us=on_us, runs_off_us=off_us,
+                    overhead=round(med_on / med_off - 1.0, 4))
+    print(json.dumps(line))
+    if not ok_all:
+        sys.exit(1)
+
+
 def bench_fleetobs(quick=False):
     """--fleetobs: overhead A/B of the in-band fleet observability
     plane (ISSUE 16; docs/fleet.md).
@@ -1923,6 +2063,9 @@ def main():
         return
     if "--fleetobs" in sys.argv[1:]:
         bench_fleetobs(quick="--quick" in sys.argv[1:])
+        return
+    if "--critpath" in sys.argv[1:]:
+        bench_critpath(quick="--quick" in sys.argv[1:])
         return
     if "--elastic-soak" in sys.argv[1:]:
         i = sys.argv.index("--elastic-soak") + 1

@@ -691,6 +691,27 @@ int tc_profile_enabled(void* ctx) {
   });
 }
 
+// ---- causal span recorder (common/span.h) ----
+
+// Per-op step/phase-instance span ring as JSON (docs/critpath.md);
+// non-draining like the profiler ring. Malloc'd, free with tc_buf_free.
+int tc_spans_json(void* ctx, uint8_t** out, size_t* outLen) {
+  return wrap([&] {
+    copyOut(asContext(ctx)->spansJson(), out, outLen);
+  });
+}
+
+// Runtime override of the TPUCOLL_SPANS gate for this context.
+void tc_spans_enable(void* ctx, int on) {
+  wrapVoid([&] { asContext(ctx)->spans().setEnabled(on != 0); });
+}
+
+int tc_spans_enabled(void* ctx) {
+  return wrapVal(0, [&] {
+    return asContext(ctx)->spans().enabled() ? 1 : 0;
+  });
+}
+
 // ---- in-band fleet observability plane (common/fleetobs.h) ----
 
 // Start the hierarchical telemetry fold for this rank's topology role

@@ -120,16 +120,15 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const size_t partBytes = rangeBytes(myPartStart, part);
 
     // Sends: part j of the window goes to group member j.
-    {
-      PhaseScope ps(Phase::kPost);
-      for (int j = 0; j < g; j++) {
-        if (j == digit[s]) {
-          continue;
-        }
-        const int partStart = winStart + j * part;
-        workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
-                      rangeOff(partStart), rangeBytes(partStart, part));
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
       }
+      const int partStart = winStart + j * part;
+      PhaseScope ps(Phase::kPost, member(s, j), stepSlot(0, s, digit[s]),
+                    rangeBytes(partStart, part));
+      workBuf->send(member(s, j), stepSlot(0, s, digit[s]),
+                    rangeOff(partStart), rangeBytes(partStart, part));
     }
     const bool fused =
         g == 2 && canFuse(member(s, 1 - digit[s]));  // single sender
@@ -140,7 +139,8 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
                             stepSlot(0, s, 1 - digit[s]), fn, elsize,
                             rangeOff(myPartStart), partBytes);
       }
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, member(s, 1 - digit[s]),
+                    stepSlot(0, s, 1 - digit[s]), partBytes);
       workBuf->waitRecv(nullptr, timeout);
     } else {
       // Receives: each sender's contribution to MY part, staged per sender
@@ -189,15 +189,17 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
     const int part = winCountAt[s] / g;
     // My current window is part digit[s] of the step-s window; send it to
     // every group member and receive their parts in place.
+    for (int j = 0; j < g; j++) {
+      if (j == digit[s]) {
+        continue;
+      }
+      PhaseScope ps(Phase::kPost, member(s, j), stepSlot(1, s, digit[s]),
+                    rangeBytes(winStart, winCount));
+      workBuf->send(member(s, j), stepSlot(1, s, digit[s]),
+                    rangeOff(winStart), rangeBytes(winStart, winCount));
+    }
     {
       PhaseScope ps(Phase::kPost);
-      for (int j = 0; j < g; j++) {
-        if (j == digit[s]) {
-          continue;
-        }
-        workBuf->send(member(s, j), stepSlot(1, s, digit[s]),
-                      rangeOff(winStart), rangeBytes(winStart, winCount));
-      }
       for (int j = 0; j < g; j++) {
         if (j == digit[s]) {
           continue;
@@ -207,11 +209,21 @@ void bcubeAllreduce(Context* ctx, plan::Plan& plan, char* work,
                       rangeBytes(partStart, part));
       }
     }
-    {
+    if (g == 2) {
+      // Radix-2 step: exactly one sender, so the arrival is attributable.
+      const int j = 1 - digit[s];
+      const int partStart = stepWinStart + j * part;
+      PhaseScope ps(Phase::kWireWait, member(s, j), stepSlot(1, s, j),
+                    rangeBytes(partStart, part));
+      workBuf->waitRecv(nullptr, timeout);
+    } else {
       PhaseScope ps(Phase::kWireWait);
       for (int n = 0; n < g - 1; n++) {
         workBuf->waitRecv(nullptr, timeout);
       }
+    }
+    {
+      PhaseScope ps(Phase::kWireWait);
       for (int n = 0; n < g - 1; n++) {
         workBuf->waitSend(timeout);
       }

@@ -129,15 +129,21 @@ void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
         rxStage.buf()->recv(left, s, (step % 2) * wireBlock,
                             blockElems(recvBlock) * sizeof(uint16_t));
       }
+    }
+    {
+      PhaseScope ps(Phase::kPost, right, s,
+                    blockElems(sendBlock) * sizeof(uint16_t));
       txBuf->send(right, s, txSlot * wireBlock,
                   blockElems(sendBlock) * sizeof(uint16_t));
     }
     if (fuse) {
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, left, s,
+                    blockElems(recvBlock) * sizeof(uint16_t));
       workBuf->waitRecv(nullptr, timeout);
     } else {
       {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, s,
+                      blockElems(recvBlock) * sizeof(uint16_t));
         rxStage.buf()->waitRecv(nullptr, timeout);
       }
       PhaseScope ps(Phase::kReduce);
@@ -193,15 +199,21 @@ void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
         rxStage.buf()->recv(left, s, rxSlot * wireBlock,
                             blockElems(recvBlock) * sizeof(uint16_t));
       }
+    }
+    {
+      PhaseScope ps(Phase::kPost, right, s,
+                    blockElems(sendBlock) * sizeof(uint16_t));
       txBuf->send(right, s, txSlot * wireBlock,
                   blockElems(sendBlock) * sizeof(uint16_t));
     }
     if (fuse) {
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, left, s,
+                    blockElems(recvBlock) * sizeof(uint16_t));
       workBuf->waitRecv(nullptr, timeout);
     } else {
       {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, s,
+                      blockElems(recvBlock) * sizeof(uint16_t));
         rxStage.buf()->waitRecv(nullptr, timeout);
       }
       PhaseScope ps(Phase::kUnpack);

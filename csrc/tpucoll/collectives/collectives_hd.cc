@@ -83,7 +83,8 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
   if (rank < 2 * rem) {
     if (rank % 2 == 1) {
       {
-        PhaseScope ps(Phase::kPost);
+        PhaseScope ps(Phase::kPost, rank - 1, slot.offset(round).value(),
+                      nbytes);
         workBuf->send(rank - 1, slot.offset(round).value(), 0, nbytes);
       }
       PhaseScope ps(Phase::kWireWait);
@@ -96,7 +97,8 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
           workBuf->recvReduce(rank + 1, slot.offset(round).value(), fn,
                               elsize, 0, nbytes);
         }
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, rank + 1,
+                      slot.offset(round).value(), nbytes);
         workBuf->waitRecv(nullptr, timeout);
       } else {
         {
@@ -105,7 +107,8 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
                             nbytes);
         }
         {
-          PhaseScope ps(Phase::kWireWait);
+          PhaseScope ps(Phase::kWireWait, rank + 1,
+                        slot.offset(round).value(), nbytes);
           stage.buf()->waitRecv(nullptr, timeout);
         }
         PhaseScope ps(Phase::kReduce);
@@ -151,15 +154,21 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
           stage.buf()->recv(partner, s, rangeOff(keepStart),
                             rangeBytes(keepStart, half));
         }
+      }
+      {
+        PhaseScope ps(Phase::kPost, partner, s,
+                      rangeBytes(sendStart, half));
         workBuf->send(partner, s, rangeOff(sendStart),
                       rangeBytes(sendStart, half));
       }
       if (fused) {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, partner, s,
+                      rangeBytes(keepStart, half));
         workBuf->waitRecv(nullptr, timeout);
       } else {
         {
-          PhaseScope ps(Phase::kWireWait);
+          PhaseScope ps(Phase::kWireWait, partner, s,
+                        rangeBytes(keepStart, half));
           stage.buf()->waitRecv(nullptr, timeout);
         }
         if (rangeBytes(keepStart, half) > 0) {
@@ -185,11 +194,19 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
         PhaseScope ps(Phase::kPost);
         workBuf->recv(partner, s, rangeOff(partnerStart),
                       rangeBytes(partnerStart, winCount));
+      }
+      {
+        PhaseScope ps(Phase::kPost, partner, s,
+                      rangeBytes(winStart, winCount));
         workBuf->send(partner, s, rangeOff(winStart),
                       rangeBytes(winStart, winCount));
       }
+      {
+        PhaseScope ps(Phase::kWireWait, partner, s,
+                      rangeBytes(partnerStart, winCount));
+        workBuf->waitRecv(nullptr, timeout);
+      }
       PhaseScope ps(Phase::kWireWait);
-      workBuf->waitRecv(nullptr, timeout);
       workBuf->waitSend(timeout);
       winStart = std::min(winStart, partnerStart);
       winCount *= 2;
@@ -205,11 +222,11 @@ void hdFoldAllreduce(Context* ctx, plan::Plan& plan, char* work,
         PhaseScope ps(Phase::kPost);
         workBuf->recv(rank - 1, finalSlot, 0, nbytes);
       }
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, rank - 1, finalSlot, nbytes);
       workBuf->waitRecv(nullptr, timeout);
     } else {
       {
-        PhaseScope ps(Phase::kPost);
+        PhaseScope ps(Phase::kPost, rank + 1, finalSlot, nbytes);
         workBuf->send(rank + 1, finalSlot, 0, nbytes);
       }
       PhaseScope ps(Phase::kWireWait);
@@ -684,7 +701,7 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
   if (extra) {
     // Extras never touch scratch — keep their path allocation-free.
     {
-      PhaseScope ps(Phase::kPost);
+      PhaseScope ps(Phase::kPost, rank - 1, slot.offset(0).value(), nbytes);
       workBuf->send(rank - 1, slot.offset(0).value(), 0, nbytes);
     }
     {
@@ -695,7 +712,7 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
       PhaseScope ps(Phase::kPost);
       workBuf->recv(rank - 1, slot.offset(1).value(), 0, nbytes);
     }
-    PhaseScope ps(Phase::kWireWait);
+    PhaseScope ps(Phase::kWireWait, rank - 1, slot.offset(1).value(), nbytes);
     workBuf->waitRecv(nullptr, timeout);
     return;
   }
@@ -713,7 +730,8 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
       scratchBuf->recv(rank + 1, slot.offset(0).value(), 0, nbytes);
     }
     {
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, rank + 1, slot.offset(0).value(),
+                    nbytes);
       scratchBuf->waitRecv(nullptr, timeout);
     }
     PhaseScope ps(Phase::kReduce);
@@ -727,12 +745,20 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
     const int partner = rdPartner < rem ? 2 * rdPartner : rdPartner + rem;
     {
       PhaseScope ps(Phase::kPost);
-      workBuf->send(partner, slot.offset(2 + round).value(), 0, nbytes);
       scratchBuf->recv(partner, slot.offset(2 + round).value(), 0, nbytes);
+    }
+    {
+      PhaseScope ps(Phase::kPost, partner, slot.offset(2 + round).value(),
+                    nbytes);
+      workBuf->send(partner, slot.offset(2 + round).value(), 0, nbytes);
     }
     {
       PhaseScope ps(Phase::kWireWait);
       workBuf->waitSend(timeout);
+    }
+    {
+      PhaseScope ps(Phase::kWireWait, partner,
+                    slot.offset(2 + round).value(), nbytes);
       scratchBuf->waitRecv(nullptr, timeout);
     }
     PhaseScope ps(Phase::kReduce);
@@ -740,7 +766,7 @@ void recursiveDoublingAllreduce(Context* ctx, plan::Plan& plan,
   }
   if (paired) {
     {
-      PhaseScope ps(Phase::kPost);
+      PhaseScope ps(Phase::kPost, rank + 1, slot.offset(1).value(), nbytes);
       workBuf->send(rank + 1, slot.offset(1).value(), 0, nbytes);
     }
     PhaseScope ps(Phase::kWireWait);

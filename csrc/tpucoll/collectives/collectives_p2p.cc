@@ -39,6 +39,7 @@ void barrier(BarrierOptions& opts) {
                    Slot::build(SlotPrefix::kBarrier, opts.tag).value(), -1,
                    0, FlightRecorder::kNoDtype);
   ProfileOpScope profOp(&ctx->profiler(), "barrier", frOp.cseq(), 0);
+  span::OpScope spanOp(&ctx->spans(), "barrier", frOp.cseq());
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -90,6 +91,7 @@ void broadcast(BroadcastOptions& opts) {
                    static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "broadcast", frOp.cseq(),
                         opts.count * elementSize(opts.dtype));
+  span::OpScope spanOp(&ctx->spans(), "broadcast", frOp.cseq());
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -207,6 +209,7 @@ void gather(GatherOptions& opts) {
                    static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "gather", frOp.cseq(),
                         opts.count * elementSize(opts.dtype));
+  span::OpScope spanOp(&ctx->spans(), "gather", frOp.cseq());
   GathervOptions v;
   static_cast<CollectiveOptions&>(v) = opts;
   v.input = opts.input;
@@ -239,6 +242,7 @@ void gatherv(GathervOptions& opts) {
                    totalCount * elementSize(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "gatherv", frOp.cseq(),
                         myBytes);
+  span::OpScope spanOp(&ctx->spans(), "gatherv", frOp.cseq());
   gathervRun(opts);
 }
 
@@ -316,6 +320,7 @@ void scatter(ScatterOptions& opts) {
                    static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "scatter", frOp.cseq(),
                         opts.count * elementSize(opts.dtype));
+  span::OpScope spanOp(&ctx->spans(), "scatter", frOp.cseq());
   const auto timeout = detail::effectiveTimeout(opts);
   const int rank = ctx->rank();
   const int size = ctx->size();
@@ -480,6 +485,7 @@ void alltoall(AlltoallOptions& opts) {
                    static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "alltoall", frOp.cseq(),
                         blockBytes * ctx->size());
+  span::OpScope spanOp(&ctx->spans(), "alltoall", frOp.cseq());
   // Crossover: Bruck's ceil(log2 P) rounds win while per-block payload
   // is latency-dominated; the pairwise exchange's P-1 single-hop
   // rounds win once bandwidth dominates (each Bruck block travels up
@@ -532,6 +538,7 @@ void alltoallv(AlltoallvOptions& opts) {
                    static_cast<uint8_t>(opts.dtype), /*fpBytes=*/0);
   ProfileOpScope profOp(&ctx->profiler(), "alltoallv", frOp.cseq(),
                         inCountTotal * elementSize(opts.dtype));
+  span::OpScope spanOp(&ctx->spans(), "alltoallv", frOp.cseq());
   alltoallvRun(opts);
 }
 

@@ -128,14 +128,17 @@ void q8RingReduceScatterPhase(Context* ctx, float* work,
         rxStage.buf()->recv(left, s, size_t(step % 2) * wireBlock,
                             recvWire);
       }
+    }
+    {
+      PhaseScope ps(Phase::kPost, right, s, sendWire);
       txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
     }
     if (fuse) {
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, left, s, recvWire);
       workBuf->waitRecv(nullptr, timeout);
     } else {
       {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, s, recvWire);
         rxStage.buf()->waitRecv(nullptr, timeout);
       }
       PhaseScope ps(Phase::kReduce);
@@ -227,10 +230,13 @@ void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
       PhaseScope ps(Phase::kPost);
       rxStage.buf()->recv(left, s, size_t(rxSlot) * wireBlock, recvWire);
       rx = reinterpret_cast<uint8_t*>(rxStage.data());
+    }
+    {
+      PhaseScope ps(Phase::kPost, right, s, sendWire);
       txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
     }
     {
-      PhaseScope ps(Phase::kWireWait);
+      PhaseScope ps(Phase::kWireWait, left, s, recvWire);
       rxStage.buf()->waitRecv(nullptr, timeout);
     }
     {

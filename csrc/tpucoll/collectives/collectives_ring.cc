@@ -184,11 +184,13 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
     }
   };
   auto postSendsFor = [&](int step) {
-    PhaseScope ps(Phase::kPost);
     const size_t blockOff = blocks.offset[sendBlockAt(step)];
     const auto& segs =
         plan.segments(blocks.bytes[sendBlockAt(step)], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
+      // Annotated per segment: each send post is one causal span.
+      PhaseScope ps(Phase::kPost, right, segSlot(step, k),
+                    segs[k].nbytes);
       workBuf->send(right, segSlot(step, k), blockOff + segs[k].offset,
                     segs[k].nbytes);
     }
@@ -208,12 +210,14 @@ void ringReduceScatter(Context* ctx, plan::Plan& plan, char* work,
       if (fuse) {
         // The combine already ran (loop thread / stash hit); the wait is
         // purely the completion count.
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, segSlot(step, k),
+                      segs[k].nbytes);
         workBuf->waitRecv(nullptr, timeout);
         continue;
       }
       {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, segSlot(step, k),
+                      segs[k].nbytes);
         stage.buf()->waitRecv(nullptr, timeout);
       }
       // Segments on one pair complete in wire order, so segment k of this
@@ -281,10 +285,10 @@ void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
   }
   int pendingSends = 0;
   {
-    PhaseScope ps(Phase::kPost);
     const int sb = blockAt(0);
     const auto& segs = plan.segments(blocks.bytes[sb], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
+      PhaseScope ps(Phase::kPost, right, segSlot(0, k), segs[k].nbytes);
       buf->send(right, segSlot(0, k), blocks.offset[sb] + segs[k].offset,
                 segs[k].nbytes);
       pendingSends++;
@@ -295,12 +299,14 @@ void ringAllgatherPhase(Context* ctx, plan::Plan& plan,
     const auto& segs = plan.segments(blocks.bytes[recvBlock], elsize);
     for (size_t k = 0; k < segs.size(); k++) {
       {
-        PhaseScope ps(Phase::kWireWait);
+        PhaseScope ps(Phase::kWireWait, left, segSlot(step, k),
+                      segs[k].nbytes);
         buf->waitRecv(nullptr, timeout);
       }
       if (step + 1 < steps) {
         // This segment is exactly segment k of the next step's send block.
-        PhaseScope ps(Phase::kPost);
+        PhaseScope ps(Phase::kPost, right, segSlot(step + 1, k),
+                      segs[k].nbytes);
         buf->send(right, segSlot(step + 1, k),
                   blocks.offset[recvBlock] + segs[k].offset,
                   segs[k].nbytes);
@@ -345,6 +351,7 @@ void allgatherv(AllgathervOptions& opts) {
                    totalCount * elementSize(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "allgatherv", frOp.cseq(),
                         myBytes);
+  span::OpScope spanOp(&ctx->spans(), "allgatherv", frOp.cseq());
   allgathervRun(opts);
 }
 
@@ -361,6 +368,7 @@ void allgather(AllgatherOptions& opts) {
                    static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "allgather", frOp.cseq(),
                         opts.count * elementSize(opts.dtype));
+  span::OpScope spanOp(&ctx->spans(), "allgather", frOp.cseq());
   if (opts.algorithm == HierDispatch::kHier && group::hierEligible(ctx) &&
       ctx->size() > 1 && opts.count > 0) {
     frOp.setAlgorithm("hier");
@@ -512,6 +520,7 @@ void allreduce(AllreduceOptions& opts) {
                    -1, nbytes, static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "allreduce", frOp.cseq(),
                         nbytes);
+  span::OpScope spanOp(&ctx->spans(), "allreduce", frOp.cseq());
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -873,6 +882,7 @@ void reduce(ReduceOptions& opts) {
                    Slot::build(SlotPrefix::kReduce, opts.tag).value(),
                    opts.root, nbytes, static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "reduce", frOp.cseq(), nbytes);
+  span::OpScope spanOp(&ctx->spans(), "reduce", frOp.cseq());
   ReduceFn fn = opts.customFn != nullptr
                   ? opts.customFn
                   : getReduceFn(opts.dtype, opts.op);
@@ -986,6 +996,7 @@ void reduceScatter(ReduceScatterOptions& opts) {
       static_cast<uint8_t>(opts.dtype));
   ProfileOpScope profOp(&ctx->profiler(), "reduce_scatter", frOp.cseq(),
                         total);
+  span::OpScope spanOp(&ctx->spans(), "reduce_scatter", frOp.cseq());
 
   if (size == 1) {
     std::memcpy(opts.output, opts.input, total);

@@ -412,6 +412,55 @@ std::string FleetObs::buildReportAttempt(int opsTail, int maxLinks) {
   }
   out << "]";
 
+  // Causal critical-edge votes, span plane (common/span.h): for each
+  // recent collective, this rank's nominee for the op's critical edge —
+  // the peer of its longest recv span — as [cseq, owner] pairs. Rank 0
+  // tallies the fleet's votes into WindowOp::critOwner, upgrading the
+  // persistent-straggler detector from "most wire_wait excess" to "owns
+  // the critical edge in most of the window's ops". Empty (and free)
+  // when spans are disabled.
+  if (opsTail > 0 && ctx_->spans().enabled()) {
+    std::map<int64_t, std::pair<int64_t, int>> best;  // cseq->(us,peer)
+    Value sp = JsonReader(ctx_->spansJson(), "fleetobs spans").parse();
+    const Value* spans = sp.field("spans");
+    if (spans != nullptr && spans->kind == Value::Kind::kArray) {
+      for (const Value& s : spans->items) {
+        const Value* kind = s.field("kind");
+        if (kind == nullptr || kind->str != "recv") {
+          continue;
+        }
+        const int64_t cseq = static_cast<int64_t>(numField(s, "cseq", -1));
+        const int peer = static_cast<int>(numField(s, "peer", -1));
+        if (cseq < 0 || peer < 0) {
+          continue;
+        }
+        const int64_t us =
+            static_cast<int64_t>(numField(s, "t1_us", 0)) -
+            static_cast<int64_t>(numField(s, "t0_us", 0));
+        auto it = best.find(cseq);
+        if (it == best.end() || us > it->second.first) {
+          best[cseq] = {us, peer};
+        }
+      }
+    }
+    out << ",\"crit\":[";
+    // Same tail bound as "ops": the most recent opsTail collectives.
+    size_t skip = best.size() > static_cast<size_t>(opsTail)
+                      ? best.size() - static_cast<size_t>(opsTail)
+                      : 0;
+    bool first = true;
+    for (const auto& kv : best) {
+      if (skip > 0) {
+        skip--;
+        continue;
+      }
+      out << (first ? "" : ",") << "[" << kv.first << ","
+          << kv.second.second << "]";
+      first = false;
+    }
+    out << "]";
+  }
+
   {
     std::lock_guard<std::mutex> guard(auxMu_);
     if (!auxJson_.empty()) {
@@ -565,6 +614,29 @@ void FleetObs::ingestStragglerOps(int rank, const Value& report) {
   }
 }
 
+void FleetObs::ingestCritVotes(int rank, const Value& report) {
+  const Value* crit = report.field("crit");
+  if (crit == nullptr || crit->kind != Value::Kind::kArray) {
+    return;
+  }
+  for (const Value& pair : crit->items) {
+    if (pair.kind != Value::Kind::kArray || pair.items.size() < 2) {
+      continue;
+    }
+    const int64_t cseq = static_cast<int64_t>(pair.items[0].number);
+    const int owner = static_cast<int>(pair.items[1].number);
+    if (cseq <= processedThroughCseq_ || owner < 0 ||
+        owner >= ctx_->size()) {
+      continue;
+    }
+    PendingOp& p = pendingOps_[cseq];
+    if (p.perRank.empty() && p.critVotes.empty()) {
+      p.firstRound = round_;
+    }
+    p.critVotes[rank] = owner;  // keyed by voter: resends stay idempotent
+  }
+}
+
 void FleetObs::finalizePendingOps() {
   // Finalize in ascending cseq order: an op closes when every rank
   // answered, or after a 2-round grace with at least two answers (the
@@ -596,8 +668,25 @@ void FleetObs::finalizePendingOps() {
     for (const auto& rw : p.perRank) {
       totalExcess += rw.second.second - minWait;
     }
+    // Plurality of the ranks' critical-edge nominations (lowest rank
+    // wins ties); -1 when the fleet voted nothing (spans disabled).
+    int critOwner = -1;
+    {
+      std::map<int, int> tally;
+      for (const auto& vote : p.critVotes) {
+        tally[vote.second]++;
+      }
+      int bestVotes = 0;
+      for (const auto& t : tally) {
+        if (t.second > bestVotes) {
+          bestVotes = t.second;
+          critOwner = t.first;
+        }
+      }
+    }
     if (straggler >= 0 && totalExcess > 0) {
-      window_.push_back(WindowOp{round_, straggler, totalExcess});
+      window_.push_back(WindowOp{round_, straggler, totalExcess,
+                                 critOwner});
     }
     processedThroughCseq_ = std::max(processedThroughCseq_, it->first);
     it = pendingOps_.erase(it);
@@ -632,17 +721,36 @@ void FleetObs::runDetectors(
   // --- persistent straggler: dominant blame over the sliding window ---
   std::map<int, std::pair<uint64_t, uint64_t>> blame;  // rank -> (us, ops)
   uint64_t windowExcess = 0;
+  uint64_t votedOps = 0;
+  std::map<int, uint64_t> critOwn;  // rank -> window ops owned causally
   for (const WindowOp& w : window_) {
     blame[w.straggler].first += w.excessUs;
     blame[w.straggler].second += 1;
     windowExcess += w.excessUs;
+    if (w.critOwner >= 0) {
+      votedOps++;
+      critOwn[w.critOwner]++;
+    }
   }
   const uint64_t thresholdUs =
       static_cast<uint64_t>(opts_.stragglerMs) * 1000;
+  // With enough causally-voted ops in the window (a spans-enabled
+  // fleet), the firing rule upgrades from "most wire_wait excess" to
+  // "owns the critical edge in at least half of the voted ops" — the
+  // wait-excess heuristic can blame a rank that merely sits next to
+  // the slow one on the ring, the causal vote follows the actual edge.
+  // The blamed-time floor stays either way; without votes the excess
+  // rule stands unchanged.
+  constexpr uint64_t kMinVotedOps = 4;
   for (const auto& b : blame) {
-    if (b.second.first >= thresholdUs &&
-        b.second.first * 2 >= windowExcess && !debounced(kKindStraggler,
-                                                         b.first)) {
+    if (b.second.first < thresholdUs) {
+      continue;
+    }
+    const bool fires =
+        votedOps >= kMinVotedOps
+            ? critOwn[b.first] * 2 >= votedOps
+            : b.second.first * 2 >= windowExcess;
+    if (fires && !debounced(kKindStraggler, b.first)) {
       fireAnomaly(kKindStraggler, b.first, b.second.first);
     }
   }
@@ -758,6 +866,7 @@ void FleetObs::mergeAndDetect(const std::string& ownHostDoc) {
   }
   for (const auto& rr : reports) {
     ingestStragglerOps(rr.first, *rr.second);
+    ingestCritVotes(rr.first, *rr.second);
   }
   finalizePendingOps();
   runDetectors(reports);
@@ -805,6 +914,29 @@ void FleetObs::mergeAndDetect(const std::string& ownHostDoc) {
     out << (i == 0 ? "" : ",") << "{\"rank\":" << board[i].first
         << ",\"blamed_us\":" << board[i].second.first
         << ",\"blamed_ops\":" << board[i].second.second << "}";
+  }
+  // Causal critical-edge ownership over the window (span votes; empty
+  // with spans disabled). `ops` counts window ops the rank's edge
+  // gated, per the fleet's plurality vote.
+  std::map<int, uint64_t> critOwn;
+  uint64_t votedOps = 0;
+  for (const WindowOp& w : window_) {
+    if (w.critOwner >= 0) {
+      votedOps++;
+      critOwn[w.critOwner]++;
+    }
+  }
+  std::vector<std::pair<int, uint64_t>> owners(critOwn.begin(),
+                                               critOwn.end());
+  std::sort(owners.begin(), owners.end(),
+            [](const auto& a, const auto& b) {
+              return a.second != b.second ? a.second > b.second
+                                          : a.first < b.first;
+            });
+  out << "]},\"critpath\":{\"voted_ops\":" << votedOps << ",\"owners\":[";
+  for (size_t i = 0; i < owners.size(); i++) {
+    out << (i == 0 ? "" : ",") << "{\"rank\":" << owners[i].first
+        << ",\"ops\":" << owners[i].second << "}";
   }
   out << "]},\"slow_links\":[";
   for (size_t i = 0; i < slowLinks_.size(); i++) {

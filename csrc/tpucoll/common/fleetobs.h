@@ -114,10 +114,15 @@ class FleetObs {
 
   // Finalized cross-rank op join: who stalled collective `cseq` and by
   // how much (profile.py attribute() semantics, computed in-band).
+  // `critOwner` is the plurality winner of the ranks' causal
+  // critical-edge votes (each rank nominates the peer of its longest
+  // recv span — common/span.h); -1 when spans were off or no votes
+  // arrived for the op.
   struct WindowOp {
     int64_t round = 0;
     int straggler = -1;
     uint64_t excessUs = 0;
+    int critOwner = -1;
   };
 
   struct AnomalyEvent {
@@ -148,6 +153,7 @@ class FleetObs {
   // Rank 0: merge host docs, run detectors, publish fleetJson_.
   void mergeAndDetect(const std::string& ownHostDoc);
   void ingestStragglerOps(int rank, const JsonReader::Value& report);
+  void ingestCritVotes(int rank, const JsonReader::Value& report);
   void finalizePendingOps();
   void runDetectors(
       const std::map<int, const JsonReader::Value*>& reports);
@@ -188,6 +194,10 @@ class FleetObs {
   struct PendingOp {
     int64_t firstRound = 0;
     std::map<int, std::pair<uint64_t, uint64_t>> perRank;
+    // voter rank -> nominated owner (from the voter's "crit" array).
+    // Keyed by voter so ring-tail resends stay idempotent; empty when
+    // the fleet runs with spans disabled.
+    std::map<int, int> critVotes;
   };
   std::map<int64_t, PendingOp> pendingOps_;
   int64_t processedThroughCseq_ = -1;

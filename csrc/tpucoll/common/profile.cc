@@ -6,6 +6,7 @@
 #include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/json.h"
 #include "tpucoll/common/metrics.h"
+#include "tpucoll/common/span.h"
 
 namespace tpucoll {
 namespace profile {
@@ -192,16 +193,44 @@ ProfileOpScope::~ProfileOpScope() {
 }
 
 PhaseScope::PhaseScope(Phase phase)
-    : op_(t_currentOp), phase_(phase), startUs_(0) {
-  if (op_ != nullptr) {
+    : op_(t_currentOp), spanOp_(span::currentOp()), phase_(phase),
+      peer_(-1), slot_(0), bytes_(0), startUs_(0) {
+  if (op_ != nullptr || spanOp_ != nullptr) {
+    startUs_ = FlightRecorder::nowUs();
+  }
+}
+
+PhaseScope::PhaseScope(Phase phase, int peer, uint64_t slot,
+                       uint64_t bytes)
+    : op_(t_currentOp), spanOp_(span::currentOp()), phase_(phase),
+      peer_(peer), slot_(slot), bytes_(bytes), startUs_(0) {
+  if (op_ != nullptr || spanOp_ != nullptr) {
     startUs_ = FlightRecorder::nowUs();
   }
 }
 
 PhaseScope::~PhaseScope() {
+  if (op_ == nullptr && spanOp_ == nullptr) {
+    return;
+  }
+  const int64_t endUs = FlightRecorder::nowUs();
   if (op_ != nullptr) {
-    op_->phaseUs[static_cast<int>(phase_)] +=
-        FlightRecorder::nowUs() - startUs_;
+    op_->phaseUs[static_cast<int>(phase_)] += endUs - startUs_;
+  }
+  if (spanOp_ != nullptr) {
+    // Causal role from (annotation, phase): annotated posts are wire
+    // sends, annotated waits are arrivals from `peer`; unannotated
+    // waits are drains ("wait"), everything else is local work.
+    span::Kind kind = span::Kind::kLocal;
+    if (peer_ >= 0) {
+      kind = phase_ == Phase::kPost ? span::Kind::kSend
+                                    : span::Kind::kRecv;
+    } else if (phase_ == Phase::kWireWait) {
+      kind = span::Kind::kWait;
+    }
+    spanOp_->rec->record(*spanOp_, spanOp_->nextId++, kind,
+                         static_cast<uint8_t>(phase_), peer_, slot_,
+                         bytes_, startUs_, endUs);
   }
 }
 

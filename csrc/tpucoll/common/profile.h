@@ -58,6 +58,10 @@ namespace tpucoll {
 
 class Metrics;
 
+namespace span {
+struct OpState;
+}  // namespace span
+
 namespace profile {
 
 enum class Phase : uint8_t {
@@ -183,18 +187,30 @@ class ProfileOpScope {
 };
 
 // RAII phase scope: adds its elapsed wall time to the current op's
-// phase bucket. No-op (one thread-local read) when no profiled op is
-// active on this thread.
+// phase bucket, and — when a span::OpScope is live on this thread
+// (common/span.h) — emits this instance as one causal span. No-op
+// (two thread-local reads) when neither recorder has an active op.
+//
+// The annotated constructor carries the wire identity the causal
+// graph needs: a kPost scope posting a SEND toward `peer` emits a
+// "send" span (injected send delays run inside it); a kWireWait scope
+// waiting for an arrival FROM `peer` emits a "recv" span. Recv POSTS
+// and drain waits keep the plain form ("local"/"wait" spans).
 class PhaseScope {
  public:
   explicit PhaseScope(Phase phase);
+  PhaseScope(Phase phase, int peer, uint64_t slot, uint64_t bytes);
   ~PhaseScope();
   PhaseScope(const PhaseScope&) = delete;
   PhaseScope& operator=(const PhaseScope&) = delete;
 
  private:
   OpAccumulator* op_;
+  span::OpState* spanOp_;
   Phase phase_;
+  int32_t peer_;
+  uint64_t slot_;
+  uint64_t bytes_;
   int64_t startUs_;
 };
 

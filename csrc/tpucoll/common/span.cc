@@ -1,0 +1,170 @@
+#include "tpucoll/common/span.h"
+
+#include <sstream>
+
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/flightrec.h"
+#include "tpucoll/common/json.h"
+#include "tpucoll/common/metrics.h"
+#include "tpucoll/common/profile.h"
+
+namespace tpucoll {
+namespace span {
+
+const char* kindName(Kind k) {
+  switch (k) {
+    case Kind::kSend:
+      return "send";
+    case Kind::kRecv:
+      return "recv";
+    case Kind::kWait:
+      return "wait";
+    case Kind::kLocal:
+      return "local";
+    case Kind::kCount:
+      break;
+  }
+  return "unknown";
+}
+
+namespace {
+
+// Same single-threaded-op contract as the profiler's accumulator head:
+// collectives run synchronously on the issuing thread, so the active
+// op state is a per-thread stack head with no synchronization.
+thread_local OpState* t_currentOp = nullptr;
+
+size_t capacityFromEnv() {
+  const size_t cap = static_cast<size_t>(
+      envCount("TPUCOLL_SPANS_RING", 4096, 1, 1 << 20));
+  size_t pow2 = 8;
+  while (pow2 < cap) {
+    pow2 <<= 1;
+  }
+  return pow2;
+}
+
+}  // namespace
+
+OpState* currentOp() { return t_currentOp; }
+
+Recorder::Recorder(int rank, int size, Metrics* metrics)
+    : rank_(rank), size_(size), metrics_(metrics) {
+  const size_t cap = capacityFromEnv();
+  mask_ = cap - 1;
+  entries_.reset(new Entry[cap]);
+  enabled_.store(envFlag("TPUCOLL_SPANS", false),
+                 std::memory_order_relaxed);
+}
+
+void Recorder::record(const OpState& op, uint32_t id, Kind kind,
+                      uint8_t phase, int peer, uint64_t slot,
+                      uint64_t bytes, int64_t t0Us, int64_t t1Us) {
+  const uint64_t seq = nextSeq_.fetch_add(1, std::memory_order_relaxed);
+  Entry& e = entries_[seq & mask_];
+  e.seq.store(kNoSeq, std::memory_order_relaxed);
+  e.cseq.store(op.cseq, std::memory_order_relaxed);
+  e.id.store(id, std::memory_order_relaxed);
+  e.kind.store(static_cast<uint8_t>(kind), std::memory_order_relaxed);
+  e.phase.store(phase, std::memory_order_relaxed);
+  e.peer.store(peer, std::memory_order_relaxed);
+  e.slot.store(slot, std::memory_order_relaxed);
+  e.bytes.store(bytes, std::memory_order_relaxed);
+  e.t0Us.store(t0Us, std::memory_order_relaxed);
+  e.t1Us.store(t1Us, std::memory_order_relaxed);
+  e.opcode.store(op.opcode, std::memory_order_relaxed);
+  e.seq.store(seq, std::memory_order_relaxed);
+}
+
+std::string Recorder::toJson() const {
+  std::ostringstream out;
+  const uint64_t next = nextSeq_.load(std::memory_order_relaxed);
+  const uint64_t cap = mask_ + 1;
+  const uint64_t first = next > cap ? next - cap : 0;
+  out << "{\"version\":1,\"kind\":\"tpucoll_spans\",\"rank\":" << rank_
+      << ",\"size\":" << size_ << ",\"group\":";
+  appendJsonString(out, metrics_ != nullptr ? metrics_->group()
+                                            : std::string());
+  out << ",\"enabled\":" << (enabled() ? "true" : "false")
+      << ",\"now_us\":" << FlightRecorder::nowUs()
+      << ",\"next_seq\":" << next << ",\"capacity\":" << cap
+      << ",\"dropped\":" << first << ",\"spans\":[";
+  bool firstRow = true;
+  for (uint64_t seq = first; seq < next; seq++) {
+    const Entry& e = entries_[seq & mask_];
+    if (e.seq.load(std::memory_order_relaxed) != seq) {
+      continue;  // torn row: mid-overwrite by a racing writer
+    }
+    const char* op = e.opcode.load(std::memory_order_relaxed);
+    const int64_t cseq = e.cseq.load(std::memory_order_relaxed);
+    const uint8_t kind = e.kind.load(std::memory_order_relaxed);
+    const uint8_t phase = e.phase.load(std::memory_order_relaxed);
+    const int peer = e.peer.load(std::memory_order_relaxed);
+    out << (firstRow ? "" : ",") << "\n{\"seq\":" << seq << ",\"cseq\":";
+    if (cseq >= 0) {
+      out << cseq;
+    } else {
+      out << "null";
+    }
+    out << ",\"id\":" << e.id.load(std::memory_order_relaxed)
+        << ",\"kind\":\""
+        << kindName(kind < static_cast<uint8_t>(Kind::kCount)
+                        ? static_cast<Kind>(kind)
+                        : Kind::kCount)
+        << "\",\"phase\":\""
+        << profile::phaseName(phase < profile::kPhaseCount
+                                  ? static_cast<profile::Phase>(phase)
+                                  : profile::Phase::kCount)
+        << "\",\"peer\":";
+    if (peer >= 0) {
+      out << peer;
+    } else {
+      out << "null";
+    }
+    out << ",\"slot\":" << e.slot.load(std::memory_order_relaxed)
+        << ",\"bytes\":" << e.bytes.load(std::memory_order_relaxed)
+        << ",\"t0_us\":" << e.t0Us.load(std::memory_order_relaxed)
+        << ",\"t1_us\":" << e.t1Us.load(std::memory_order_relaxed)
+        << ",\"op\":";
+    if (op != nullptr) {
+      out << "\"" << op << "\"";
+    } else {
+      out << "null";
+    }
+    out << "}";
+    firstRow = false;
+  }
+  out << "\n]}\n";
+  return out.str();
+}
+
+OpScope::OpScope(Recorder* rec, const char* opcode, int64_t cseq)
+    : prev_(t_currentOp) {
+  if (rec == nullptr || !rec->enabled()) {
+    // Disabled path: one relaxed load plus parking the thread-local at
+    // null — a disabled nested op (hier sub-context with spans off
+    // while the parent's are on) must not interleave its instances
+    // into the parent's ordinal stream.
+    t_currentOp = nullptr;
+    return;
+  }
+  st_.rec = rec;
+  st_.cseq = cseq;
+  st_.opcode = opcode;
+  t_currentOp = &st_;
+}
+
+OpScope::~OpScope() { t_currentOp = prev_; }
+
+void emit(Kind kind, uint8_t phase, int peer, uint64_t slot,
+          uint64_t bytes, int64_t t0Us, int64_t t1Us) {
+  OpState* op = t_currentOp;
+  if (op == nullptr) {
+    return;
+  }
+  op->rec->record(*op, op->nextId++, kind, phase, peer, slot, bytes,
+                  t0Us, t1Us);
+}
+
+}  // namespace span
+}  // namespace tpucoll

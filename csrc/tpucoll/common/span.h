@@ -1,0 +1,175 @@
+// Causal step-level span recorder: the raw material for cross-rank
+// critical-path analysis (docs/critpath.md).
+//
+// The phase profiler (profile.h) aggregates WHERE an op's time went on
+// one rank (pack/post/wire_wait/... totals); it cannot say WHICH send
+// on WHICH rank gated the op's end-to-end latency, because that answer
+// needs the individual phase INSTANCES — this send to that peer on
+// this slot, from t0 to t1 — matched across ranks into a causal graph.
+// This layer records exactly those instances:
+//
+//   span = {cseq, id, kind, phase, peer, slot, bytes, t0_us, t1_us}
+//
+// where `cseq` is the flight recorder's cross-rank collective sequence
+// (the merge key), `id` the span's per-op emission ordinal (program
+// order — deterministic for a given schedule, the ordinal the Python
+// side uses to pair the k-th send a->b with the k-th recv b<-a), and
+// `kind` the causal role:
+//
+//   send   a wire send post, annotated with the destination peer. The
+//          posting call runs on the collective's thread, so injected
+//          send delays (fault plane) and slow serialization land INSIDE
+//          this span — which is what makes "rank 1's sends own the
+//          critical path" attributable.
+//   recv   a wire receive from `peer`: t0 = post (or wait start),
+//          t1 = observed arrival. The matched remote send's end gates
+//          this span's completion — the cross-rank edge.
+//   wait   an unattributed wire wait (send drains, wait-any loops).
+//   local  compute/copy work (reduce, pack, unpack, codec).
+//
+// Mechanism mirrors the profiler exactly: span::OpScope is stamped in
+// every public collective entry (next to ProfileOpScope; tools/check
+// rule span-coverage enforces it) and parks a per-op state in a
+// thread-local; profile::PhaseScope — already present at every phase
+// instance in the six native algorithm families and the schedule
+// interpreter — emits one span per instance when that state is live,
+// with wire sites upgraded to the annotated constructor carrying
+// (peer, slot, bytes). The interpreter additionally emits recv spans
+// directly (emit()) so their t0/t1 are the true post/arrival times
+// rather than the demand-time wait window.
+//
+// Cost contract: disabled — TPUCOLL_SPANS=0, the default — costs one
+// relaxed load plus a thread-local park per collective entry and one
+// thread-local read per phase scope; no clock reads, no records.
+// Enabled, each span is one fetch_add plus relaxed stores into the
+// bounded ring (TPUCOLL_SPANS_RING rows, claim-then-publish protocol
+// from flightrec.h), read concurrently by Context::spansJson().
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <string>
+
+namespace tpucoll {
+
+class Metrics;
+
+namespace span {
+
+enum class Kind : uint8_t {
+  kSend = 0,
+  kRecv,
+  kWait,
+  kLocal,
+  kCount,
+};
+
+const char* kindName(Kind k);
+
+class Recorder;
+
+// Per-op state parked in a thread-local by OpScope: the recorder to
+// emit into, the op identity every span row inherits, and the per-op
+// ordinal counter. Owned by the OpScope on the issuing thread; only
+// that thread touches it (collectives run synchronously).
+struct OpState {
+  Recorder* rec{nullptr};
+  int64_t cseq{-1};
+  const char* opcode{nullptr};  // static string
+  uint32_t nextId{0};
+};
+
+// The live op state on this thread, or null when no enabled span scope
+// is active (spans disabled / outside a collective).
+OpState* currentOp();
+
+class Recorder {
+ public:
+  // Ring row; all fields relaxed-atomic under the claim-then-publish
+  // `seq` protocol (flightrec.h) so a concurrent toJson skips rows
+  // that are mid-overwrite.
+  struct Entry {
+    std::atomic<uint64_t> seq{0};
+    std::atomic<int64_t> cseq{-1};
+    std::atomic<uint32_t> id{0};
+    std::atomic<uint8_t> kind{0};
+    std::atomic<uint8_t> phase{0};  // profile::Phase value
+    std::atomic<int32_t> peer{-1};
+    std::atomic<uint64_t> slot{0};
+    std::atomic<uint64_t> bytes{0};
+    std::atomic<int64_t> t0Us{0};
+    std::atomic<int64_t> t1Us{0};
+    std::atomic<const char*> opcode{nullptr};  // static string
+  };
+
+  static constexpr uint64_t kNoSeq = ~uint64_t(0);
+
+  // Capacity from TPUCOLL_SPANS_RING (default 4096, rounded up to a
+  // power of two); enable gate from TPUCOLL_SPANS (default 0 — spans
+  // are opt-in: they record per-instance rows, an order of magnitude
+  // more volume than the profiler's per-op summaries). Both knobs are
+  // strict (common/env.h). `metrics` supplies the group tag for the
+  // JSON document; may be null (standalone tests).
+  Recorder(int rank, int size, Metrics* metrics);
+
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+  void setEnabled(bool on) {
+    enabled_.store(on, std::memory_order_relaxed);
+  }
+
+  // Publish one span row. Thread-safe (ring slot claimed by fetch_add);
+  // called from PhaseScope destructors and the interpreter's direct
+  // emits via the thread-local op state.
+  void record(const OpState& op, uint32_t id, Kind kind, uint8_t phase,
+              int peer, uint64_t slot, uint64_t bytes, int64_t t0Us,
+              int64_t t1Us);
+
+  uint64_t nextSeq() const {
+    return nextSeq_.load(std::memory_order_relaxed);
+  }
+  uint64_t capacity() const { return mask_ + 1; }
+
+  // Full JSON document: {"version", "kind": "tpucoll_spans", "rank",
+  // "size", "group", "enabled", "now_us", "next_seq", "capacity",
+  // "dropped", "spans": [{"seq", "cseq", "id", "kind", "phase",
+  // "peer", "slot", "bytes", "t0_us", "t1_us", "op"}, ...]}.
+  std::string toJson() const;
+
+ private:
+  const int rank_;
+  const int size_;
+  Metrics* metrics_;
+  std::atomic<bool> enabled_{false};
+  uint64_t mask_;  // capacity - 1 (power of two)
+  std::unique_ptr<Entry[]> entries_;
+  std::atomic<uint64_t> nextSeq_{0};
+};
+
+// RAII op scope for the public collective entry points, stamped next
+// to ProfileOpScope. Parks the op state in the thread-local (saving
+// the previous head for nested collectives — hier phases are ordinary
+// collectives on sub-contexts, each accruing to ITS recorder); a
+// disabled recorder parks null, which keeps a disabled nested op's
+// spans from being charged to an enabled outer op's stream.
+class OpScope {
+ public:
+  OpScope(Recorder* rec, const char* opcode, int64_t cseq);
+  ~OpScope();
+  OpScope(const OpScope&) = delete;
+  OpScope& operator=(const OpScope&) = delete;
+
+ private:
+  OpState st_;
+  OpState* prev_;
+};
+
+// Emit one span with explicit endpoints into the current op's stream
+// (no-op outside an enabled op scope). For sites where t0/t1 are not
+// a lexical scope — the interpreter's recv spans (post time .. FIFO-
+// attributed arrival time) are the canonical caller.
+void emit(Kind kind, uint8_t phase, int peer, uint64_t slot,
+          uint64_t bytes, int64_t t0Us, int64_t t1Us);
+
+}  // namespace span
+}  // namespace tpucoll

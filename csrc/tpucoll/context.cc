@@ -20,7 +20,8 @@ constexpr std::chrono::milliseconds Context::kDefaultTimeout;
 
 Context::Context(int rank, int size)
     : rank_(rank), size_(size), metrics_(size),
-      profiler_(rank, size, &metrics_), flightrec_(rank, size) {
+      profiler_(rank, size, &metrics_), spanrec_(rank, size, &metrics_),
+      flightrec_(rank, size) {
   TC_ENFORCE(size > 0, "context size must be positive");
   TC_ENFORCE(rank >= 0 && rank < size, "rank ", rank, " out of range for size ",
              size);

@@ -16,6 +16,7 @@
 #include "tpucoll/common/flightrec.h"
 #include "tpucoll/common/metrics.h"
 #include "tpucoll/common/profile.h"
+#include "tpucoll/common/span.h"
 #include "tpucoll/common/tracer.h"
 #include "tpucoll/group/topology.h"
 #include "tpucoll/rendezvous/store.h"
@@ -227,6 +228,13 @@ class Context {
   // (TPUCOLL_PROFILE=0 disables; off costs one relaxed load per op).
   profile::Profiler& profiler() { return profiler_; }
 
+  // Causal span recorder (common/span.h): per-phase-INSTANCE spans —
+  // {cseq, id, kind, peer, slot, bytes, t0, t1} — in a bounded ring
+  // beside the profiler's, the raw material critpath.py merges across
+  // ranks into the op's causal graph. Opt-in (TPUCOLL_SPANS=1; off
+  // costs one relaxed load per op + one thread-local read per phase).
+  span::Recorder& spans() { return spanrec_; }
+
   // Structured JSON snapshot of the registry; `drain` resets counters.
   std::string metricsJson(bool drain);
 
@@ -250,6 +258,9 @@ class Context {
   // JSON snapshot of the profiler's per-op phase-breakdown ring
   // (non-draining, like the flight recorder).
   std::string profileJson() { return profiler_.toJson(); }
+
+  // JSON snapshot of the causal span ring (non-draining).
+  std::string spansJson() { return spanrec_.toJson(); }
 
   // ---- collective autotuning plane (tuning/tuning_table.h) ----
   // Installed measured tuning table consulted by every kAuto dispatch;
@@ -372,8 +383,11 @@ class Context {
   Tracer tracer_;
   Metrics metrics_;
   // After metrics_: the profiler flushes phase histograms into the
-  // registry, so it must be constructed after and destroyed before it.
+  // registry, so it must be constructed after and destroyed before it
+  // (the span recorder only reads the registry's group tag, but keeps
+  // the same ordering discipline).
   profile::Profiler profiler_;
+  span::Recorder spanrec_;
   FlightRecorder flightrec_;
 };
 

@@ -11,6 +11,7 @@
 #include "tpucoll/collectives/plan.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/profile.h"
+#include "tpucoll/common/span.h"
 #include "tpucoll/context.h"
 #include "tpucoll/schedule/verifier.h"
 #include "tpucoll/transport/unbound_buffer.h"
@@ -222,6 +223,18 @@ void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
   int32_t* heads = reinterpret_cast<int32_t*>(state + align4(n));
   int32_t* sendsOut = heads + size_t(2) * world;
 
+  // Per-step receive span bookkeeping: the recv span's interval is
+  // [post time, FIFO-attributed arrival time], not the wait that
+  // happened to observe it (a waitRecv can complete a DIFFERENT step's
+  // message). Allocated only when a span op is live on this thread, so
+  // the disabled path stays allocation- and clock-free.
+  span::OpState* const spanOp = span::currentOp();
+  std::vector<int64_t> recvPostUs, recvArriveUs;
+  if (spanOp != nullptr) {
+    recvPostUs.assign(n, 0);
+    recvArriveUs.assign(n, 0);
+  }
+
   auto chunkPtr = [&](const RStep& st) { return work + blocks.offset[st.chunk]; };
   auto slotPtr = [&](const RStep& st) {
     return arena.data + static_cast<size_t>(st.slot) * slotStride;
@@ -274,7 +287,18 @@ void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
                  prog.name, "\": unexpected receive completion from rank ",
                  src);
       stepState[q[head]] |= kArrived;
+      if (spanOp != nullptr) {
+        recvArriveUs[q[head]] = FlightRecorder::nowUs();
+      }
       head++;
+    }
+    if (spanOp != nullptr) {
+      int wb;
+      size_t woff, wlen;
+      wireLoc(st, &wb, &woff, &wlen);
+      span::emit(span::Kind::kRecv, static_cast<uint8_t>(Phase::kWireWait),
+                 st.peer, slotBase.offset(st.delta).value(), wlen,
+                 recvPostUs[p], recvArriveUs[p]);
     }
     if (st.op == StepOp::kRecvReduce) {
       PhaseScope rs(Phase::kReduce);
@@ -313,8 +337,9 @@ void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
         int b;
         size_t off, len;
         wireLoc(st, &b, &off, &len);
-        PhaseScope ps(Phase::kPost);
-        bufs[b]->send(st.peer, slotBase.offset(st.delta).value(), off, len);
+        const uint64_t wslot = slotBase.offset(st.delta).value();
+        PhaseScope ps(Phase::kPost, st.peer, wslot, len);
+        bufs[b]->send(st.peer, wslot, off, len);
         sendsOut[b]++;
         break;
       }
@@ -323,6 +348,9 @@ void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
         int b;
         size_t off, len;
         wireLoc(st, &b, &off, &len);
+        if (spanOp != nullptr) {
+          recvPostUs[p] = FlightRecorder::nowUs();
+        }
         PhaseScope ps(Phase::kPost);
         bufs[b]->recv(st.peer, slotBase.offset(st.delta).value(), off, len);
         break;

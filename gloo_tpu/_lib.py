@@ -150,6 +150,11 @@ _PROTOTYPES = {
         ctypes.c_uint8)), ctypes.POINTER(_sz)]),
     "tc_profile_enable": (None, [_c, _int]),
     "tc_profile_enabled": (_int, [_c]),
+    # causal span recorder (cross-rank critical-path tracing)
+    "tc_spans_json": (_int, [_c, ctypes.POINTER(ctypes.POINTER(
+        ctypes.c_uint8)), ctypes.POINTER(_sz)]),
+    "tc_spans_enable": (None, [_c, _int]),
+    "tc_spans_enabled": (_int, [_c]),
     # in-band fleet observability plane (hierarchical telemetry fold)
     "tc_fleetobs_start": (_int, [_c]),
     "tc_fleetobs_stop": (_int, [_c]),

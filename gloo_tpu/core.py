@@ -1146,6 +1146,35 @@ class Context:
     def profile_enabled(self) -> bool:
         return bool(_lib.lib.tc_profile_enabled(self._handle))
 
+    # ---- causal span recorder (docs/critpath.md) ----
+
+    def spans(self) -> dict:
+        """Snapshot the context's causal span recorder as a dict.
+
+        Shape: {"rank", "size", "group", "enabled", "now_us",
+        "next_seq", "capacity", "dropped", "spans": [{"seq", "cseq",
+        "id", "kind": "send"|"recv"|"wait"|"local", "phase", "peer",
+        "slot", "bytes", "t0_us", "t1_us", "op"}, ...]} where `cseq`
+        is the flight recorder's cross-rank collective sequence (null
+        for p2p ops), `id` the per-op emission ordinal (the k-th send
+        rank a posts toward b pairs with the k-th recv b posts from a),
+        and `peer` the remote rank for send/recv spans (null
+        otherwise). Merge per-rank snapshots and extract the critical
+        path with gloo_tpu.utils.critpath. Off by default
+        (TPUCOLL_SPANS=0); non-draining bounded ring
+        (TPUCOLL_SPANS_RING)."""
+        return json.loads(_copy_out(_lib.lib.tc_spans_json,
+                                    self._handle))
+
+    def spans_enable(self, on: bool = True) -> None:
+        """Toggle the causal span recorder at runtime (overrides the
+        TPUCOLL_SPANS environment gate for this context). Off, every
+        collective pays exactly one relaxed atomic load."""
+        _lib.lib.tc_spans_enable(self._handle, 1 if on else 0)
+
+    def spans_enabled(self) -> bool:
+        return bool(_lib.lib.tc_spans_enabled(self._handle))
+
     # ---- in-band fleet observability plane (docs/fleet.md) ----
 
     def fleetobs_start(self) -> None:

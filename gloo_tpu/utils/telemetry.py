@@ -19,6 +19,8 @@ context (or any object with the same ``metrics()``/``profile()``/
                         plane shows this worker superseded / evicted /
                         below min size
 ``GET /profile.json``   the phase profiler's per-op breakdown ring
+``GET /spans``          the causal span recorder's step-level ring
+                        (docs/critpath.md; feed tools/critpath_view.py)
 ``GET /flightrec``      the always-on flight-recorder ring
 ``GET /fleet``          the merged fleet observability document (rank 0
                         with ``ctx.fleetobs_start()`` running: coverage,
@@ -228,6 +230,15 @@ class TelemetryServer:
                                          verdict)
                     elif path == "/profile.json":
                         self._reply_json(200, outer._ctx.profile())
+                    elif path == "/spans":
+                        spans_fn = getattr(outer._ctx, "spans", None)
+                        if callable(spans_fn):
+                            self._reply_json(200, spans_fn())
+                        else:
+                            self._reply_json(404, {
+                                "error": "context has no spans() "
+                                         "(causal span recorder "
+                                         "unavailable)"})
                     elif path == "/flightrec":
                         self._reply_json(200, outer._ctx.flightrec())
                     elif path == "/fleet":
@@ -242,7 +253,7 @@ class TelemetryServer:
                     elif path == "/":
                         self._reply_json(200, {"routes": [
                             "/metrics", "/healthz", "/profile.json",
-                            "/flightrec", "/fleet",
+                            "/spans", "/flightrec", "/fleet",
                             "POST /flightrec/dump"]})
                     elif path == "/flightrec/dump":
                         self._reply_json(405, {"error":

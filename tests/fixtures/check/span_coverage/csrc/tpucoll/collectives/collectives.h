@@ -1,0 +1,14 @@
+// span-coverage fixture public surface. Never compiled.
+#pragma once
+
+namespace tpucoll {
+
+struct TracedOptions { int x; };
+struct BlindOptions { int x; };
+struct UnstampedOptions { int x; };
+
+void traced(TracedOptions& opts);
+void blind(BlindOptions& opts);
+void unstamped(UnstampedOptions& opts);
+
+}  // namespace tpucoll

@@ -1,0 +1,23 @@
+// span-coverage fixture: `traced` stamps both scopes (clean), `blind`
+// stamps the FlightRecOp but no span scope (violation), `unstamped`
+// stamps neither (flightrec-coverage's finding, not this rule's).
+#include "tpucoll/collectives/collectives.h"
+
+namespace tpucoll {
+
+void traced(TracedOptions& opts) {
+  FlightRecOp frOp(opts.x);
+  span::OpScope spanOp(nullptr, "traced", frOp.cseq());
+  run(opts);
+}
+
+void blind(BlindOptions& opts) {
+  FlightRecOp frOp(opts.x);
+  run(opts);  // no span::OpScope: violation
+}
+
+void unstamped(UnstampedOptions& opts) {
+  run(opts);
+}
+
+}  // namespace tpucoll

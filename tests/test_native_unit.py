@@ -702,6 +702,99 @@ def test_tsan_profile_smoke():
     assert "PROFILE-SMOKE-OK" in result.stdout, result.stdout
 
 
+_SPANS_PROG = f"""
+import os, sys, threading
+os.environ["TPUCOLL_SPANS"] = "1"
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+
+size = 2
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        assert ctx.spans_enabled()
+        x = np.full(1 << 16, 1.0, dtype=np.float32)
+        for _ in range(4):
+            ctx.allreduce(x, algorithm="ring")
+            x[:] = 1.0
+        snap = ctx.spans()
+        assert snap["enabled"] and snap["spans"], snap["next_seq"]
+        kinds = set(s["kind"] for s in snap["spans"])
+        assert "send" in kinds and "recv" in kinds, kinds
+        ctx.spans_enable(False)
+        ctx.barrier()
+        frozen = ctx.spans()["next_seq"]
+        ctx.allreduce(x, algorithm="ring")
+        assert ctx.spans()["next_seq"] == frozen
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, e))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+[t.start() for t in threads]
+[t.join(180) for t in threads]
+assert not errors, errors
+print("SPANS-SMOKE-OK")
+"""
+
+
+def test_asan_spans_smoke():
+    """Skip-unless-built ASan smoke of the causal span recorder through
+    the ctypes surface: spans-enabled collectives filling the bounded
+    ring, a snapshot walking it concurrently-shaped memory, and the
+    runtime toggle — the span ring's claim-then-publish slots are the
+    new memory-shape code under test."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS":
+                          "detect_leaks=0,abort_on_error=1"})
+    result = subprocess.run([sys.executable, "-c", _SPANS_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPANS-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_spans_smoke():
+    """UBSan flavor of the span-recorder smoke (-fno-sanitize-recover:
+    the first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib)
+    result = subprocess.run([sys.executable, "-c", _SPANS_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPANS-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_spans_smoke():
+    """TSan flavor: two ranks' collective threads emitting spans while
+    snapshots drain the ring is the writer/reader race the relaxed
+    enable-check plus acquire/release slot protocol must keep benign."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7"})
+    result = subprocess.run([sys.executable, "-c", _SPANS_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "SPANS-SMOKE-OK" in result.stdout, result.stdout
+
+
 _FLEET_PROG = f"""
 import sys, threading, time
 sys.path.insert(0, {_REPO!r})

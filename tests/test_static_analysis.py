@@ -153,6 +153,16 @@ def test_fixture_flightrec():
                    for k in keys), keys
 
 
+def test_fixture_span_coverage():
+    """An entry stamping FlightRecOp without a span::OpScope is caught;
+    the fully traced entry is clean, and the entry missing even the
+    FlightRecOp is left to flightrec-coverage (reported once, there)."""
+    keys = _keys(_fixture_report("span_coverage", ["span-coverage"]))
+    assert "unspanned:blind" in keys
+    assert not any("traced" in k for k in keys), keys
+    assert not any("unstamped" in k for k in keys), keys
+
+
 def test_fixture_metrics_drift():
     keys = _keys(_fixture_report("metrics_drift", ["metrics-drift"]))
     assert "unread-key:ghost_key" in keys

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Diff two committed bench JSONs cell-by-cell and gate on regressions.
+
+The repo commits its performance evidence as JSON — headline medians
+(``BENCH_r*.json``), sweep grids (``BASELINE_sweep*.json``), and
+per-round metric lines (``PROF_r15.json``, ``OBS_r16.json``,
+``CRIT_r19.json``, ...). This tool joins two such files by cell key,
+prints per-cell ratios, and exits nonzero when any cell regressed by
+more than the threshold (default 25%) BEYOND the spread the baseline
+itself recorded — a cell whose own noise floor is 10% must move 35%
+before it counts.
+
+    python tools/bench_compare.py BENCH_r11.json BENCH_r12.json
+    python tools/bench_compare.py BASELINE_sweep_r5.json BASELINE_sweep_r11.json
+    python tools/bench_compare.py PROF_r15.json fresh-profile.json --threshold 0.4
+
+Accepted shapes (auto-detected, mixable):
+
+- a single JSON object with a ``cells`` list (sweep files) — cell key
+  is the metadata tuple (op/bytes/ranks/plane/engine/...), value is
+  ``p50_us`` (lower is better);
+- one JSON object per line (round metric files) — cell key is
+  ``metric`` plus discriminators (algorithm/elements/ranks/...), value
+  is ``value`` (direction from ``unit``: rates are higher-better) or
+  ``p50_us``/``wall_ms`` (lower-better); a recorded ``spread``
+  (relative) or ``runs`` series widens that cell's allowance.
+
+Cells present on only one side are reported but never gate (grids grow
+between rounds); cells whose payload carries ``ok: false`` are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Key fields that discriminate cells of the same metric; everything
+# else in a row is payload.
+KEY_FIELDS = ("metric", "op", "algorithm", "collective", "elements",
+              "bytes", "ranks", "hosts", "nranks", "plane", "engine",
+              "schedule", "world", "unit")
+# Lower-is-better value fields, in preference order. The *_on fields
+# pick the instrumented arm out of overhead A/B rows so observability
+# rounds stay comparable across rounds.
+TIME_FIELDS = ("p50_us", "wall_ms", "p50_ms", "mean_total_us",
+               "exchange_ms", "publish_ms", "p50_us_spans_on",
+               "p50_us_profile_on", "p50_us_fleetobs_on")
+
+
+class Cell:
+    __slots__ = ("key", "value", "higher_better", "rel_spread")
+
+    def __init__(self, key: str, value: float, higher_better: bool,
+                 rel_spread: float):
+        self.key = key
+        self.value = value
+        self.higher_better = higher_better
+        self.rel_spread = rel_spread
+
+
+def _rel_spread(row: dict, value: float) -> float:
+    if value <= 0:
+        return 0.0
+    spread = row.get("spread")
+    if isinstance(spread, (int, float)):
+        # BENCH rows record (max - min) / median already; older rows
+        # recorded it absolute. Values > 1 are clearly absolute.
+        return float(spread) if spread <= 1 else float(spread) / value
+    runs = row.get("runs") or row.get("runs_on_us") or row.get("runs_us")
+    if isinstance(runs, list) and len(runs) >= 2 and \
+            all(isinstance(r, (int, float)) for r in runs):
+        return (max(runs) - min(runs)) / value
+    return 0.0
+
+
+def _row_cell(row: dict, prefix: str = "") -> Optional[Cell]:
+    if not isinstance(row, dict) or row.get("ok") is False:
+        return None
+    key = prefix + " ".join(
+        f"{k}={row[k]}" for k in KEY_FIELDS if k in row)
+    if not key:
+        return None
+    if isinstance(row.get("value"), (int, float)):
+        unit = str(row.get("unit", ""))
+        return Cell(key, float(row["value"]), "/s" in unit,
+                    _rel_spread(row, float(row["value"])))
+    for f in TIME_FIELDS:
+        if isinstance(row.get(f), (int, float)) and row[f] > 0:
+            return Cell(f"{key} [{f}]", float(row[f]), False,
+                        _rel_spread(row, float(row[f])))
+    return None
+
+
+def load_cells(path: str) -> List[Cell]:
+    with open(path) as f:
+        text = f.read()
+    docs: List[dict] = []
+    try:
+        doc = json.loads(text)
+        docs = doc if isinstance(doc, list) else [doc]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                docs.append(json.loads(line))
+    cells: List[Cell] = []
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("cells"), list):
+            for row in doc["cells"]:
+                cell = _row_cell(row)
+                if cell is not None:
+                    cells.append(cell)
+            continue
+        cell = _row_cell(doc)
+        if cell is not None:
+            cells.append(cell)
+            continue
+        # Sectioned round files (BENCH_r11+): named sub-objects each
+        # carrying their own metric row. Rows with their own "metric"
+        # field keep it as identity (so a sectioned headline still joins
+        # a flat one across rounds); anonymous rows take the section
+        # name as key prefix.
+        for name, sub in doc.items():
+            if isinstance(sub, dict):
+                cell = _row_cell(
+                    sub, prefix="" if "metric" in sub else f"{name}: ")
+                if cell is not None:
+                    cells.append(cell)
+    return cells
+
+
+def compare(old: List[Cell], new: List[Cell], threshold: float,
+            ) -> Tuple[List[dict], List[str]]:
+    """Join by key; return (joined rows, one-sided keys)."""
+    old_by: Dict[str, Cell] = {c.key: c for c in old}
+    new_by: Dict[str, Cell] = {c.key: c for c in new}
+    rows = []
+    for key in old_by:
+        if key not in new_by:
+            continue
+        o, n = old_by[key], new_by[key]
+        ratio = n.value / o.value if o.value else float("inf")
+        # Regression = the "worse" direction, beyond threshold plus the
+        # baseline cell's own recorded noise.
+        worse = ratio < 1.0 if o.higher_better else ratio > 1.0
+        magnitude = abs(ratio - 1.0)
+        allowance = threshold + o.rel_spread
+        rows.append({"key": key, "old": o.value, "new": n.value,
+                     "ratio": round(ratio, 3),
+                     "allowance": round(allowance, 3),
+                     "regressed": worse and magnitude > allowance})
+    only = ([f"only in old: {k}" for k in old_by if k not in new_by] +
+            [f"only in new: {k}" for k in new_by if k not in old_by])
+    return rows, only
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="baseline bench JSON (committed)")
+    ap.add_argument("new", help="candidate bench JSON")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="regression gate beyond recorded spread "
+                         "(default 0.25 = 25%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    args = ap.parse_args()
+
+    old = load_cells(args.old)
+    new = load_cells(args.new)
+    if not old or not new:
+        print(f"no comparable cells ({len(old)} old, {len(new)} new)",
+              file=sys.stderr)
+        return 1
+    rows, only = compare(old, new, args.threshold)
+    if not rows:
+        print("no overlapping cells between the two files",
+              file=sys.stderr)
+        return 1
+
+    regressed = [r for r in rows if r["regressed"]]
+    if args.json:
+        print(json.dumps({"rows": rows, "unmatched": only,
+                          "regressed": len(regressed)}, indent=2))
+    else:
+        width = max(len(r["key"]) for r in rows)
+        for r in sorted(rows, key=lambda r: r["key"]):
+            flag = "  REGRESSED" if r["regressed"] else ""
+            print(f"{r['key']:<{width}}  {r['old']:>12.3f} -> "
+                  f"{r['new']:>12.3f}  x{r['ratio']:.3f} "
+                  f"(allow ±{r['allowance']:.0%}){flag}")
+        for line in only:
+            print(f"note: {line}", file=sys.stderr)
+    if regressed:
+        print(f"{len(regressed)} cell(s) regressed beyond threshold",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ from .flightrec import FlightrecRule
 from .lock_order import LockOrderRule
 from .metrics_drift import MetricsDriftRule
 from .schedule_step_coverage import ScheduleStepCoverageRule
+from .span_coverage import SpanCoverageRule
 
 ALL_RULES = (
     AbiDriftRule,
@@ -21,6 +22,7 @@ ALL_RULES = (
     EnvHygieneRule,
     AtomicsRule,
     FlightrecRule,
+    SpanCoverageRule,
     MetricsDriftRule,
     LockOrderRule,
     AssertsRule,

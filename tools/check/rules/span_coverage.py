@@ -1,0 +1,64 @@
+"""Span-scope coverage: the causal critical-path engine (PR 19,
+docs/critpath.md) joins ranks' span streams by the flight recorder's
+cseq, so a collective entry that stamps a FlightRecOp but never opens a
+span::OpScope records NO spans for ops every other rank traces — the
+cross-rank merge then sees one-sided wire edges and the critical path
+silently detours around that rank's contribution.
+
+Entry points are not hardcoded: like flightrec-coverage, the rule reads
+the declarations out of collectives/collectives.h, so a new collective
+is covered the moment it is declared. Only entries that stamp a
+FlightRecOp are held to it (an entry missing even that is
+flightrec-coverage's finding, reported once, there)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from ..engine import Corpus, Rule, Violation
+
+COLLECTIVES_H = "csrc/tpucoll/collectives/collectives.h"
+
+_DECL = re.compile(r"^\s*void\s+(\w+)\s*\(\s*\w*Options\s*&\s*\w+\s*\)\s*;",
+                   re.M)
+
+
+class SpanCoverageRule(Rule):
+    name = "span-coverage"
+    description = ("every public collective entry that stamps a "
+                   "FlightRecOp also opens a span::OpScope")
+
+    collectives_h = COLLECTIVES_H
+
+    def run(self, corpus: Corpus) -> List[Violation]:
+        out: List[Violation] = []
+        header = corpus.text(self.collectives_h)
+        if header is None:
+            return [self.violation("no-header", self.collectives_h, 1,
+                                   f"{self.collectives_h} not found")]
+        entries = _DECL.findall(header)
+        impl_dir = self.collectives_h.rsplit("/", 1)[0]
+        defs: Dict[str, tuple] = {}
+        for path in corpus.glob(impl_dir + "/*.cc"):
+            cpp = corpus.cpp(path)
+            if cpp is None:
+                continue
+            for fn in cpp.functions():
+                base = fn.name.split("::")[-1]
+                if base in entries and "Options" in fn.params:
+                    defs.setdefault(base, (path, fn))
+        for entry in entries:
+            if entry not in defs:
+                continue  # flightrec-coverage owns missing definitions
+            path, fn = defs[entry]
+            if "FlightRecOp" not in fn.body:
+                continue  # flightrec-coverage owns unstamped entries
+            if "span::OpScope" not in fn.body:
+                out.append(self.violation(
+                    f"unspanned:{entry}", path, fn.line,
+                    f"{entry} stamps a FlightRecOp but never opens a "
+                    f"span::OpScope — its ops are invisible to the "
+                    f"cross-rank critical-path merge (docs/critpath.md) "
+                    f"while every peer traces them"))
+        return out
