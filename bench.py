@@ -27,11 +27,15 @@ TPUCOLL_STRIPE_BYTES), one JSON line per point, feeding the tuning
 plane's transport hints; add --quick for a small smoke grid.
 
 --wire-sweep measures allreduce algbw across the wire-codec family
-(plain ring vs ring_bf16_wire vs ring_q8_wire) x payload size under
-TPUCOLL_SHM=0 (the TCP plane, where wire bytes are the bottleneck the
-codecs exist to cut), one JSON line per (algorithm, size) point — the
-crossover data the tuner's lossy arms and future rounds consume; add
---quick for a small smoke grid.
+(plain ring vs ring_bf16_wire vs ring_q8_wire vs ring_q4_wire) x
+payload size under TPUCOLL_SHM=0 (the TCP plane, where wire bytes are
+the bottleneck the codecs exist to cut), one JSON line per
+(algorithm, size) point — the crossover data the tuner's lossy arms
+and future rounds consume. It also runs the pipelined-engine A/B
+(serial depth-1 hop vs depth-4 + codec pool, interleaved passes), the
+TPUCOLL_CODEC_THREADS width axis, and a profiled 64 MiB phase
+breakdown quantifying the op-thread pack+unpack cut; add --quick for
+a small smoke grid.
 """
 
 import json
@@ -1063,21 +1067,26 @@ def bench_channel_sweep(quick=False):
 def bench_wire_sweep(quick=False):
     """--wire-sweep: 2-rank allreduce algbw per (wire codec x size)
     point under TPUCOLL_SHM=0 — the host plane's wire-compression
-    crossover data (ISSUE 11; docs/algorithms.md precision contract).
-    One JSON line per point; fresh subprocesses per point so transport
-    state never leaks between cells. Every run verifies the reduced
-    values first: exact for the lossless ring, within the q8/bf16
-    per-hop error bound for the codecs."""
+    crossover data (ISSUE 11 grid, grown by ISSUE 20: the q4 arm, the
+    pipelined-vs-serial engine A/B in interleaved passes, the
+    codec-threads axis, and a profiled 64 MiB phase breakdown proving
+    the pack+unpack cut). One JSON line per point; fresh subprocesses
+    per point so transport state never leaks between cells. Every run
+    verifies the reduced values first: exact for the lossless ring,
+    within the per-hop error bound for the codecs."""
     import tempfile
     import textwrap
 
     if quick:
         sizes = [1 << 20]  # 4 MiB f32
         iters, warmup = 3, 1
+        ab_passes = 2
     else:
         sizes = [1 << 20, 1 << 22, ELEMENTS]  # 4 MiB, 16 MiB, 64 MiB
         iters, warmup = 8, 2
-    algorithms = ["ring", "ring_bf16_wire", "ring_q8_wire"]
+        ab_passes = 3
+    algorithms = ["ring", "ring_bf16_wire", "ring_q8_wire",
+                  "ring_q4_wire"]
 
     body = textwrap.dedent("""
         import sys, time
@@ -1093,10 +1102,13 @@ def bench_wire_sweep(quick=False):
         warm = int(sys.argv[5]); algo = sys.argv[6]
         x = np.full(n, float(rank + 1), dtype=np.float32)
         ctx.allreduce(x, algorithm=algo)
-        # 1+2=3 is exactly representable through both codecs' per-hop
+        # 1+2=3 is exactly representable through the codecs' per-hop
         # quantization only to within one step; bound the error instead
-        # of asserting exactness for the lossy arms.
-        tol = 0.0 if algo == "ring" else 3.0 / 127.0
+        # of asserting exactness for the lossy arms (q4's step is
+        # max|block|/7, the coarsest in the set).
+        tol = (0.0 if algo == "ring"
+               else 3.0 / 7.0 if algo == "ring_q4_wire"
+               else 3.0 / 127.0)
         assert abs(x[0] - 3.0) <= tol, x[0]
         x[:] = 1.0
         for _ in range(warm):
@@ -1113,34 +1125,167 @@ def bench_wire_sweep(quick=False):
         ctx.barrier(); ctx.close()
     """).format(repo=os.path.dirname(os.path.abspath(__file__)))
 
+    prof_body = textwrap.dedent("""
+        import json, sys
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import gloo_tpu
+
+        rank = int(sys.argv[1])
+        ctx = gloo_tpu.Context(rank, 2, timeout=120)
+        ctx.connect_full_mesh(gloo_tpu.FileStore(sys.argv[2]),
+                              gloo_tpu.Device())
+        n = int(sys.argv[3]); iters = int(sys.argv[4])
+        warm = int(sys.argv[5]); algo = sys.argv[6]
+        x = np.full(n, 1.0, dtype=np.float32)
+        for _ in range(warm + 1):
+            ctx.allreduce(x, algorithm=algo)
+            x[:] = 1.0
+        seq0 = ctx.profile()["next_seq"]
+        for _ in range(iters):
+            ctx.allreduce(x, algorithm=algo)
+            x[:] = 1.0
+        if rank == 0:
+            ops = [o for o in ctx.profile()["ops"] if o["seq"] >= seq0]
+            tot = {{}}
+            for o in ops:
+                for k, v in o.get("phases", {{}}).items():
+                    tot[k] = tot.get(k, 0) + v
+            print("PHASES", json.dumps(
+                {{k: v // max(len(ops), 1) for k, v in tot.items()}}))
+        ctx.barrier(); ctx.close()
+    """).format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    # The engine A/B arms. "serial" pins depth 1 on one lane with the
+    # fused transport fold off — byte- and schedule-identical to the
+    # pre-pipeline hop (the r11/r15 engine). "pipelined" is the new
+    # default shape: depth-4 sub-blocks, a 2-wide codec pool, fused
+    # dequant-accumulate on arrival.
+    serial_env = {"TPUCOLL_CODEC_PIPELINE": "1",
+                  "TPUCOLL_CODEC_THREADS": "1",
+                  "TPUCOLL_RECV_REDUCE": "0"}
+    piped_env = {"TPUCOLL_CODEC_PIPELINE": "4",
+                 "TPUCOLL_CODEC_THREADS": "2",
+                 "TPUCOLL_RECV_REDUCE": "1"}
+
     ok_all = True
+
+    def run_point(src, elements, algo, extra_env=None, marker="P50US"):
+        """One fresh 2-rank subprocess pair; returns (payload, errs)."""
+        store = tempfile.mkdtemp()
+        env = dict(os.environ, TPUCOLL_SHM="0", **(extra_env or {}))
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", src, str(r), store, str(elements),
+             str(iters), str(warmup), algo],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for r in range(2)]
+        outs = [p.communicate(timeout=600) for p in procs]
+        if any(p.returncode != 0 for p in procs) or \
+                marker not in outs[0][0]:
+            return None, [f"rank {r}: rc={p.returncode} "
+                          f"err={outs[r][1][-200:]!r}"
+                          for r, p in enumerate(procs)]
+        return outs[0][0].split(marker, 1)[1], None
+
+    def emit(line, payload, errs, elements):
+        nonlocal ok_all
+        if errs is not None:
+            ok_all = False
+            line["ok"] = False
+            line["error"] = errs
+        else:
+            p50_us = int(payload.split()[0])
+            line["value"] = round(elements * 4 / (p50_us * 1e-6) / 1e9, 3)
+            line["p50_us"] = p50_us
+            line["ok"] = True
+        print(json.dumps(line))
+
+    # 1) The codec-family grid (the r11 shape, plus the q4 arm).
     for elements in sizes:
         for algo in algorithms:
-            store = tempfile.mkdtemp()
-            env = dict(os.environ, TPUCOLL_SHM="0")
-            procs = [subprocess.Popen(
-                [sys.executable, "-c", body, str(r), store, str(elements),
-                 str(iters), str(warmup), algo],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                env=env) for r in range(2)]
-            outs = [p.communicate(timeout=600) for p in procs]
-            line = {"metric": "wire_sweep", "algorithm": algo,
-                    "elements": elements,
-                    "bytes": elements * 4, "iters": iters, "unit": "GB/s"}
-            if any(p.returncode != 0 for p in procs) or \
-                    "P50US" not in outs[0][0]:
-                ok_all = False
-                line["ok"] = False
-                line["error"] = [f"rank {r}: rc={p.returncode} "
-                                 f"err={outs[r][1][-200:]!r}"
-                                 for r, p in enumerate(procs)]
-            else:
-                p50_us = int(outs[0][0].split("P50US", 1)[1].split()[0])
-                line["value"] = round(
-                    elements * 4 / (p50_us * 1e-6) / 1e9, 3)
-                line["p50_us"] = p50_us
-                line["ok"] = True
-            print(json.dumps(line))
+            payload, errs = run_point(body, elements, algo)
+            emit({"metric": "wire_sweep", "algorithm": algo,
+                  "elements": elements, "bytes": elements * 4,
+                  "iters": iters, "unit": "GB/s"}, payload, errs,
+                 elements)
+
+    # 2) Pipelined-vs-serial engine A/B, interleaved passes (arm order
+    # alternates within each pass so drift lands on both arms equally).
+    for elements in sizes:
+        for algo in ("ring_q8_wire", "ring_q4_wire"):
+            runs = {"serial": [], "pipelined": []}
+            for p in range(ab_passes):
+                order = [("serial", serial_env), ("pipelined", piped_env)]
+                if p % 2:
+                    order.reverse()
+                for arm, arm_env in order:
+                    payload, errs = run_point(body, elements, algo,
+                                              arm_env)
+                    if errs is not None:
+                        ok_all = False
+                        print(json.dumps(
+                            {"metric": "wire_pipeline_ab", "ok": False,
+                             "algorithm": algo, "arm": arm,
+                             "elements": elements, "error": errs}))
+                    else:
+                        runs[arm].append(int(payload.split()[0]))
+            for arm in ("serial", "pipelined"):
+                if not runs[arm]:
+                    continue
+                p50 = int(sorted(runs[arm])[len(runs[arm]) // 2])
+                print(json.dumps(
+                    {"metric": "wire_pipeline_ab", "algorithm": algo,
+                     "arm": arm, "elements": elements,
+                     "bytes": elements * 4, "iters": iters,
+                     "unit": "GB/s", "runs_us": runs[arm],
+                     "p50_us": p50,
+                     "value": round(elements * 4 / (p50 * 1e-6) / 1e9, 3),
+                     "ok": True}))
+
+    # 3) Codec-pool width axis at the largest size (depth pinned to the
+    # pipelined arm's 4 so only the pool width moves).
+    for threads in (1, 2, 4):
+        payload, errs = run_point(
+            body, sizes[-1], "ring_q8_wire",
+            {"TPUCOLL_CODEC_PIPELINE": "4",
+             "TPUCOLL_CODEC_THREADS": str(threads)})
+        emit({"metric": "wire_codec_threads", "algorithm": "ring_q8_wire",
+              "codec_threads": threads, "elements": sizes[-1],
+              "bytes": sizes[-1] * 4, "iters": iters, "unit": "GB/s"},
+             payload, errs, sizes[-1])
+
+    # 4) Profiled phase breakdown at the headline size: where did the
+    # pack/unpack time go. The serial arm reproduces the pre-pipeline
+    # attribution (encode + staged decode on the op thread); the
+    # pipelined arm's codec work runs on the pool and in the transport
+    # fold, so op-thread pack+unpack must collapse.
+    phases = {}
+    for arm, arm_env in (("serial", serial_env), ("pipelined", piped_env)):
+        payload, errs = run_point(prof_body, sizes[-1], "ring_q8_wire",
+                                  dict(arm_env, TPUCOLL_PROFILE="1"),
+                                  marker="PHASES")
+        line = {"metric": "wire_phase_ab", "algorithm": "ring_q8_wire",
+                "arm": arm, "elements": sizes[-1],
+                "bytes": sizes[-1] * 4, "iters": iters}
+        if errs is not None:
+            ok_all = False
+            line["ok"] = False
+            line["error"] = errs
+        else:
+            line["mean_phase_us"] = json.loads(payload)
+            line["ok"] = True
+            phases[arm] = line["mean_phase_us"]
+        print(json.dumps(line))
+    if len(phases) == 2:
+        codec_us = {a: p.get("pack", 0) + p.get("unpack", 0)
+                    for a, p in phases.items()}
+        print(json.dumps(
+            {"metric": "wire_phase_cut", "elements": sizes[-1],
+             "pack_unpack_us": codec_us,
+             "cut": round(codec_us["serial"] /
+                          max(codec_us["pipelined"], 1), 2),
+             "ok": True}))
+
     if not ok_all:
         sys.exit(1)
 

@@ -63,7 +63,7 @@ void usage() {
           "  [--host H] [--op allreduce|allgather|reduce_scatter|broadcast|"
           "reduce|gather|scatter|alltoall|alltoallv|barrier|pairwise_exchange|sendrecv|\n"
           "   sendrecv_roundtrip]\n"
-          "  [--algorithm auto|ring|hd|rd|bcube|ring_bf16_wire|ring_q8_wire|auto_lossy_wire (allreduce) | auto|binomial|ring (reduce)\n"
+          "  [--algorithm auto|ring|hd|rd|bcube|ring_bf16_wire|ring_q8_wire|ring_q4_wire|auto_lossy_wire (allreduce) | auto|binomial|ring (reduce)\n"
           "   | auto|ring|hd|direct (reduce_scatter)]\n"
           "  [--elements n1,n2,...] "
           "[--min-time SECONDS] [--warmup N] [--no-verify] [--json]\n"
@@ -152,7 +152,8 @@ Options parse(int argc, char** argv) {
   TC_ENFORCE(o.op == "allreduce" || (o.dtype == "f32" && o.inputs == 1),
              "--dtype/--inputs apply to --op allreduce only");
   TC_ENFORCE(o.dtype == "f32" || (o.algorithm != "ring_bf16_wire" &&
-                                  o.algorithm != "ring_q8_wire"),
+                                  o.algorithm != "ring_q8_wire" &&
+                                  o.algorithm != "ring_q4_wire"),
              "--dtype f16/bf16 cannot combine with a wire codec "
              "(f32-only)");
   return o;
@@ -207,6 +208,7 @@ tpucoll::AllreduceAlgorithm parseAllreduceAlgorithm(const std::string& a) {
          : a == "rd"             ? AllreduceAlgorithm::kRecursiveDoubling
          : a == "ring_bf16_wire" ? AllreduceAlgorithm::kRingBf16Wire
          : a == "ring_q8_wire"   ? AllreduceAlgorithm::kRingQ8Wire
+         : a == "ring_q4_wire"   ? AllreduceAlgorithm::kRingQ4Wire
          : a == "auto_lossy_wire" ? AllreduceAlgorithm::kAutoLossyWire
          : (a == "hd" || a == "halving_doubling")
              ? AllreduceAlgorithm::kHalvingDoubling
@@ -274,15 +276,16 @@ Workload makeAllreduceWorkload(const Options& o, tpucoll::Context& ctx,
                                Buffers& bufs) {
   using namespace tpucoll;
   if (o.dtype == "f32") {
-    // Exact verification, except through the q8 wire: its per-hop
-    // block quantization is within one step (~1/254 per hop) but not
-    // exact even for small-integer payloads (the scale's *127/127
+    // Exact verification, except through the q8/q4 wires: their per-hop
+    // block quantization is within one step per hop but not exact even
+    // for small-integer payloads (the scale's *127/127 or *7/7
     // roundtrip double-rounds). bf16-wire stays exact here: small ints
     // are exactly representable in bf16.
-    const bool q8 = o.algorithm == "ring_q8_wire" ||
-                    o.algorithm == "auto_lossy_wire";
+    const bool lossy = o.algorithm == "ring_q8_wire" ||
+                       o.algorithm == "ring_q4_wire" ||
+                       o.algorithm == "auto_lossy_wire";
     return makeAllreduceWorkloadT(
-        o, ctx, tag, DataType::kFloat32, q8 ? 1e-2 : 0.0, elements,
+        o, ctx, tag, DataType::kFloat32, lossy ? 1e-2 : 0.0, elements,
         bufs.buf, bufs.extraF32, [](float v) { return v; },
         [](float v) { return v; });
   }

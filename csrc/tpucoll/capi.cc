@@ -21,6 +21,8 @@
 #include "tpucoll/boot/boot.h"
 #include "tpucoll/collectives/collectives.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/collectives/wire_codec.h"
+#include "tpucoll/common/codec_pool.h"
 #include "tpucoll/common/debug.h"
 #include "tpucoll/context.h"
 #include "tpucoll/fault/fault.h"
@@ -1523,6 +1525,98 @@ int tc_q8_decode(const void* src, size_t srcBytes, void* dst,
     TC_ENFORCE_EQ(srcBytes, tpucoll::q8WireBytes(count, block));
     tpucoll::q8StreamToF32(static_cast<const uint8_t*>(src),
                            static_cast<float*>(dst), count, block);
+  });
+}
+
+// ---- int4 packed-nibble wire codec (math.h q4 stream layout) ----
+// Same surface as q8: the kernels AllreduceAlgorithm::kRingQ4Wire runs.
+
+// Resolved TPUCOLL_Q4_BLOCK (elements per block).
+size_t tc_q4_block() {
+  return wrapVal<size_t>(0, [&] { return tpucoll::q4BlockElems(); });
+}
+
+size_t tc_q4_wire_bytes(size_t count) {
+  return wrapVal<size_t>(0, [&] {
+    return tpucoll::q4WireBytes(count, tpucoll::q4BlockElems());
+  });
+}
+
+int tc_q4_encode(const void* src, size_t count, void* dst,
+                 size_t dstBytes) {
+  return wrap([&] {
+    const size_t block = tpucoll::q4BlockElems();
+    TC_ENFORCE_EQ(dstBytes, tpucoll::q4WireBytes(count, block));
+    tpucoll::f32StreamToQ4(static_cast<const float*>(src),
+                           static_cast<uint8_t*>(dst), count, block);
+  });
+}
+
+int tc_q4_decode(const void* src, size_t srcBytes, void* dst,
+                 size_t count) {
+  return wrap([&] {
+    const size_t block = tpucoll::q4BlockElems();
+    TC_ENFORCE_EQ(srcBytes, tpucoll::q4WireBytes(count, block));
+    tpucoll::q4StreamToF32(static_cast<const uint8_t*>(src),
+                           static_cast<float*>(dst), count, block);
+  });
+}
+
+// ---- sharded codec surface (common/codec_pool.h + wire_codec.h) ----
+// The exact kernels the pipelined wire rings shard across the codec
+// pool, exposed so tests can prove byte-identity against the serial
+// walk for any shard count. `kind`: 0 = bf16, 1 = q8, 2 = q4.
+
+namespace {
+const tpucoll::algorithms::WireCodec& codecFor(int kind) {
+  switch (kind) {
+    case tpucoll::algorithms::kWireCodecBf16:
+      return tpucoll::algorithms::bf16WireCodec();
+    case tpucoll::algorithms::kWireCodecQ8:
+      return tpucoll::algorithms::q8WireCodec();
+    case tpucoll::algorithms::kWireCodecQ4:
+      return tpucoll::algorithms::q4WireCodec();
+    default:
+      TC_THROW(tpucoll::EnforceError, "unknown wire codec kind ", kind);
+  }
+}
+}  // namespace
+
+// Resolved TPUCOLL_CODEC_THREADS (pool width, >= 1).
+int tc_codec_threads() {
+  return wrapVal(0, [&] { return tpucoll::codec::codecThreads(); });
+}
+
+// Resolved TPUCOLL_CODEC_PIPELINE (sub-blocks per ring hop, >= 1).
+int tc_codec_pipeline() {
+  return wrapVal(0, [&] { return tpucoll::codec::codecPipelineDepth(); });
+}
+
+// Encode `count` float32 elements into `kind`'s wire stream across
+// `shards` pool shards (dstBytes echoes the codec's wire size). Output
+// is byte-identical to shards == 1 for every shard count.
+int tc_codec_encode_sharded(int kind, const void* src, size_t count,
+                            void* dst, size_t dstBytes, size_t shards) {
+  return wrap([&] {
+    const auto& codec = codecFor(kind);
+    TC_ENFORCE_EQ(dstBytes, codec.wire(count));
+    tpucoll::algorithms::wireEncode(codec, static_cast<const float*>(src),
+                                    static_cast<uint8_t*>(dst), count,
+                                    shards);
+  });
+}
+
+// acc[i] += decode(wire)[i] across `shards` pool shards (the fused
+// dequant-accumulate the reduce-scatter hops run).
+int tc_codec_accumulate_sharded(int kind, void* acc, const void* wire,
+                                size_t count, size_t wireBytes,
+                                size_t shards) {
+  return wrap([&] {
+    const auto& codec = codecFor(kind);
+    TC_ENFORCE_EQ(wireBytes, codec.wire(count));
+    tpucoll::algorithms::wireAccumulate(codec, static_cast<float*>(acc),
+                                        static_cast<const uint8_t*>(wire),
+                                        count, shards);
   });
 }
 

@@ -85,6 +85,19 @@ void q8WireRingReduceScatter(Context* ctx, plan::Plan& plan, char* work,
                              const collectives_detail::Blocks& blocks,
                              Slot slot, std::chrono::milliseconds timeout);
 
+// Ring allreduce / reduce-scatter over the int4 packed-nibble wire
+// codec (float32 sum; math.h q4 stream layout, TPUCOLL_Q4_BLOCK block
+// size). ~8x fewer wire bytes than float32 at max|block|/14 per-element
+// precision; the allgather forwards verbatim, so results stay
+// bit-identical across ranks. Opt-in / tuner-elected only.
+void q4WireRingAllreduce(Context* ctx, plan::Plan& plan, char* work,
+                         size_t count, Slot slot,
+                         std::chrono::milliseconds timeout);
+void q4WireRingReduceScatter(Context* ctx, plan::Plan& plan, char* work,
+                             transport::UnboundBuffer* workBuf,
+                             const collectives_detail::Blocks& blocks,
+                             Slot slot, std::chrono::milliseconds timeout);
+
 // Log-latency reduce-scatter by recursive vector halving (contract of
 // reference gloo/reduce_scatter.h:21-329, re-derived for the in-order
 // window walk): log2(P) rounds over windows of the caller's per-rank

@@ -114,6 +114,14 @@ enum class AllreduceAlgorithm : uint8_t {
   // ORDER differs from the flat schedules (docs/topology.md precision
   // contract); results stay identical across ranks.
   kHier = 10,
+  // int4 packed-nibble wire compression (float32 payloads only): ~8x
+  // fewer wire bytes than float32 at max|block|/14 per-element, per-hop
+  // precision — aggressive enough that it is opt-in or tuner-elected
+  // ONLY (kAutoLossyWire picks it solely from a measured table entry,
+  // never as a fallback). Consensus matches q8: the allgather forwards
+  // the owner's stream verbatim. See collectives_q4.cc for the
+  // contract and TPUCOLL_Q4_BLOCK.
+  kRingQ4Wire = 11,
 };
 
 struct AllreduceOptions : CollectiveOptions {
@@ -246,6 +254,10 @@ enum class ReduceScatterAlgorithm : uint8_t {
   // non-flat topology from a tuned table; flat topologies dispatch as
   // kAuto.
   kHier = 5,
+  // Ring reduce-scatter over the int4 packed-nibble wire codec
+  // (float32 sum only; opt-in / tuner-measured, never auto-elected).
+  // Precision contract: collectives_q4.cc.
+  kRingQ4Wire = 6,
 };
 
 struct ReduceScatterOptions : CollectiveOptions {

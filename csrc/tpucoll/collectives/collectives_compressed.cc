@@ -8,221 +8,27 @@
 //
 // Precision contract: each reduce-scatter hop re-quantizes the partial
 // sum, so worst-case error grows with the hop count (P-1) at bfloat16's
-// ~3 significant digits; the allgather phase transmits each final block
-// once, so all ranks decode IDENTICAL results (consensus is preserved —
-// every rank rounds the same bf16 stream). Opt in via
+// ~3 significant digits — tightened by the error-feedback residuals
+// (TPUCOLL_WIRE_EF, wire_ring.h) on repeated reductions; the allgather
+// phase transmits each final block once, so all ranks decode IDENTICAL
+// results (consensus is preserved — every rank rounds the same bf16
+// stream; bf16 -> f32 -> bf16 is a lossless roundtrip, which is what
+// lets fused allgather hops re-encode instead of staging). Opt in via
 // AllreduceAlgorithm::kRingBf16Wire; float32 only.
-#include <cstring>
-
+//
+// The schedule itself lives in wire_ring.cc (one pipelined engine for
+// every codec); this file binds it to the bf16 descriptor.
 #include "tpucoll/collectives/algorithms.h"
-#include "tpucoll/collectives/collectives.h"
-#include "tpucoll/collectives/detail.h"
-#include "tpucoll/collectives/plan.h"
-#include "tpucoll/common/profile.h"
+#include "tpucoll/collectives/wire_ring.h"
 
 namespace tpucoll {
 namespace algorithms {
 
-using collectives_detail::Blocks;
-using collectives_detail::evenBlocks;
-using collectives_detail::SegSpan;
-using collectives_detail::segmentize;
-using profile::Phase;
-using profile::PhaseScope;
-
-namespace {
-
-inline void compressSegment(const float* src, uint16_t* dst, size_t n) {
-  f32StreamToBf16(src, dst, n);
-}
-
-// work[i] += decode(in[i])
-inline void accumulateCompressed(float* work, const uint16_t* in, size_t n) {
-  bf16StreamAccumulate(work, in, n);
-}
-
-inline void decodeSegment(const uint16_t* in, float* dst, size_t n) {
-  bf16StreamToF32(in, dst, n);
-}
-
-// RecvReduceFn-shaped adapters for the typed fused receive (bf16 wire
-// elements folded into / decoded into the f32 accumulator; see
-// UnboundBuffer::recvReduceTyped).
-void accumulateBf16Fn(void* acc, const void* in, size_t n) {
-  bf16StreamAccumulate(static_cast<float*>(acc),
-                       static_cast<const uint16_t*>(in), n);
-}
-
-void decodeBf16Fn(void* acc, const void* in, size_t n) {
-  bf16StreamToF32(static_cast<const uint16_t*>(in),
-                  static_cast<float*>(acc), n);
-}
-
-}  // namespace
-
 void bf16WireRingAllreduce(Context* ctx, plan::Plan& plan,
                            char* workBytes, size_t count, Slot slot,
                            std::chrono::milliseconds timeout) {
-  const int rank = ctx->rank();
-  const int size = ctx->size();
-  float* work = reinterpret_cast<float*>(workBytes);
-  const Blocks& blocks = plan.blocks(
-      0, [&] { return evenBlocks(count, size, sizeof(float)); });
-  size_t maxBlockElems = 0;
-  for (size_t b : blocks.bytes) {
-    maxBlockElems = std::max(maxBlockElems, b / sizeof(float));
-  }
-  const int right = (rank + 1) % size;
-  const int left = (rank - 1 + size) % size;
-  const int steps = size - 1;
-
-  // Typed fused receive: wire bf16 elements fold straight out of the shm
-  // ring into the f32 work array (decode+accumulate / decode-in-place),
-  // eliminating the rx staging entirely on shm sources (same policy as
-  // the plain ring, collectives_detail::fuseRecvReduce; wire elsize 2,
-  // accumulator elsize 4). The forward leg of the fused allgather
-  // re-compresses from work — exact, because bf16 -> f32 -> bf16 is a
-  // lossless roundtrip, so the forwarded wire bytes are identical to the
-  // verbatim copy the staged path sends (consensus preserved).
-  const bool fuse = collectives_detail::fuseRecvReduce(
-      ctx, /*fuseOk=*/true, /*elsize=*/sizeof(uint16_t), left);
-
-  // Wire staging: bf16 segments. tx double-buffered (the sent segment must
-  // stay valid until waitSend); rx double-buffered like the fp32 ring,
-  // lazily acquired (never touched when fused).
-  const size_t wireBlock = std::max(maxBlockElems * sizeof(uint16_t),
-                                    size_t(1));
-  auto txStage = plan.stage(1, 2 * wireBlock);
-  uint16_t* tx = reinterpret_cast<uint16_t*>(txStage.data);
-  auto* txBuf = txStage.buf;
-  plan::LazyStage rxStage(plan, 2, 2 * wireBlock);
-  auto* workBuf = plan.userBuf(0, work, count * sizeof(float));
-
-  auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
-  auto blockStart = [&](int b) {
-    return blocks.offset[b] / sizeof(float);
-  };
-  auto rx = [&]() {
-    return reinterpret_cast<uint16_t*>(rxStage.data());
-  };
-
-  // --- reduce-scatter (send block rank-s, reduce block rank-s-1) ---
-  for (int step = 0; step < steps; step++) {
-    const int sendBlock = (rank - step + 2 * size) % size;
-    const int recvBlock = (rank - step - 1 + 2 * size) % size;
-    const int txSlot = step % 2;
-    const uint64_t s = slot.offset(step).value();
-    uint16_t* txSeg = tx + txSlot * maxBlockElems;
-    {
-      PhaseScope ps(Phase::kPack);
-      compressSegment(work + blockStart(sendBlock), txSeg,
-                      blockElems(sendBlock));
-    }
-    {
-      PhaseScope ps(Phase::kPost);
-      if (fuse) {
-        workBuf->recvReduceTyped(left, s, accumulateBf16Fn,
-                                 sizeof(uint16_t), sizeof(float),
-                                 blockStart(recvBlock) * sizeof(float),
-                                 blockElems(recvBlock) * sizeof(uint16_t));
-      } else {
-        rxStage.buf()->recv(left, s, (step % 2) * wireBlock,
-                            blockElems(recvBlock) * sizeof(uint16_t));
-      }
-    }
-    {
-      PhaseScope ps(Phase::kPost, right, s,
-                    blockElems(sendBlock) * sizeof(uint16_t));
-      txBuf->send(right, s, txSlot * wireBlock,
-                  blockElems(sendBlock) * sizeof(uint16_t));
-    }
-    if (fuse) {
-      PhaseScope ps(Phase::kWireWait, left, s,
-                    blockElems(recvBlock) * sizeof(uint16_t));
-      workBuf->waitRecv(nullptr, timeout);
-    } else {
-      {
-        PhaseScope ps(Phase::kWireWait, left, s,
-                      blockElems(recvBlock) * sizeof(uint16_t));
-        rxStage.buf()->waitRecv(nullptr, timeout);
-      }
-      PhaseScope ps(Phase::kReduce);
-      accumulateCompressed(work + blockStart(recvBlock),
-                           rx() + (step % 2) * maxBlockElems,
-                           blockElems(recvBlock));
-    }
-    PhaseScope ps(Phase::kWireWait);
-    txBuf->waitSend(timeout);
-  }
-
-  // --- allgather: rank r owns reduced block (r+1). The owner compresses
-  // its block ONCE; every rank (owner included) adopts the decoded bf16
-  // values so results are identical everywhere. Received wire segments
-  // are forwarded without re-rounding: verbatim on the staged path,
-  // re-compressed from the decoded block on the fused path (byte-
-  // identical, see above). ---
-  const uint64_t agBase = steps;
-  {
-    PhaseScope ps(Phase::kPack);
-    const int own = (rank + 1) % size;
-    compressSegment(work + blockStart(own), tx, blockElems(own));
-    decodeSegment(tx, work + blockStart(own), blockElems(own));
-  }
-  for (int step = 0; step < steps; step++) {
-    const int sendBlock = (rank + 1 - step + 2 * size) % size;
-    const int recvBlock = (rank - step + 2 * size) % size;
-    const uint64_t s = slot.offset(agBase + step).value();
-    const int txSlot = step % 2;
-    const int rxSlot = step % 2;
-    if (step == 0) {
-      // Own block already sits compressed in tx slot 0.
-    } else if (fuse) {
-      // Re-compress the block decoded last step (exact roundtrip).
-      PhaseScope ps(Phase::kPack);
-      compressSegment(work + blockStart(sendBlock),
-                      tx + txSlot * maxBlockElems, blockElems(sendBlock));
-    } else {
-      // Forward the wire bytes received last step.
-      PhaseScope ps(Phase::kPack);
-      std::memcpy(tx + txSlot * maxBlockElems,
-                  rx() + ((step - 1) % 2) * maxBlockElems,
-                  blockElems(sendBlock) * sizeof(uint16_t));
-    }
-    {
-      PhaseScope ps(Phase::kPost);
-      if (fuse) {
-        workBuf->recvReduceTyped(left, s, decodeBf16Fn, sizeof(uint16_t),
-                                 sizeof(float),
-                                 blockStart(recvBlock) * sizeof(float),
-                                 blockElems(recvBlock) * sizeof(uint16_t));
-      } else {
-        rxStage.buf()->recv(left, s, rxSlot * wireBlock,
-                            blockElems(recvBlock) * sizeof(uint16_t));
-      }
-    }
-    {
-      PhaseScope ps(Phase::kPost, right, s,
-                    blockElems(sendBlock) * sizeof(uint16_t));
-      txBuf->send(right, s, txSlot * wireBlock,
-                  blockElems(sendBlock) * sizeof(uint16_t));
-    }
-    if (fuse) {
-      PhaseScope ps(Phase::kWireWait, left, s,
-                    blockElems(recvBlock) * sizeof(uint16_t));
-      workBuf->waitRecv(nullptr, timeout);
-    } else {
-      {
-        PhaseScope ps(Phase::kWireWait, left, s,
-                      blockElems(recvBlock) * sizeof(uint16_t));
-        rxStage.buf()->waitRecv(nullptr, timeout);
-      }
-      PhaseScope ps(Phase::kUnpack);
-      decodeSegment(rx() + rxSlot * maxBlockElems,
-                    work + blockStart(recvBlock), blockElems(recvBlock));
-    }
-    PhaseScope ps(Phase::kWireWait);
-    txBuf->waitSend(timeout);
-  }
+  wireRingAllreduce(ctx, plan, bf16WireCodec(), workBytes, count, slot,
+                    timeout);
 }
 
 }  // namespace algorithms

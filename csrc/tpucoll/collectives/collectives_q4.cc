@@ -1,0 +1,58 @@
+// Ring collectives with int4 block-quantized wire compression for
+// float32 sum payloads — the aggressive end of the lossy-wire family
+// (~8x fewer bytes than float32, ~2x fewer than q8).
+//
+// Wire format (math.h): consecutive units of [float32 scale]
+// [ceil(B/2) packed nibble bytes], B = TPUCOLL_Q4_BLOCK (default 256).
+// Codes are biased nibbles (clip(round(x/scale), -7, 7) + 8), element i
+// in byte i/2 — even index low nibble, odd index high; a dangling odd
+// tail leaves the high nibble zero.
+//
+// Precision contract (docs/algorithms.md + docs/errors.md):
+//  - accumulation stays float32; only wire hops quantize, at
+//    |x - decode(x)| <= max|block| / 14 per element per hop — ~18x
+//    coarser than q8, which is why the tuner elects this arm only
+//    where measurement proves it wins and kAuto never does;
+//  - error feedback (TPUCOLL_WIRE_EF, wire_ring.h) folds each origin
+//    encode's error into the next call — at 4 bits it is what keeps
+//    the repeated-reduction error bounded instead of biased;
+//  - the allgather forwards the owner's stream verbatim (like q8, the
+//    scale roundtrip double-rounds), so results are bit-identical on
+//    every rank;
+//  - float32 + sum only; TPUCOLL_Q4_BLOCK and TPUCOLL_CODEC_PIPELINE
+//    must match on every rank.
+//
+// The schedule itself lives in wire_ring.cc (one pipelined engine for
+// every codec); this file binds it to the q4 descriptor.
+#include "tpucoll/collectives/algorithms.h"
+#include "tpucoll/collectives/wire_ring.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+// Same compile-time pin as q8: the fused arm's recvReduceTyped element
+// is one whole q4 unit (scale + packed codes).
+static_assert(transport::kMaxCombineElsize >=
+                  kQ4ScaleBytes + (kQ4MaxBlockElems + 1) / 2,
+              "q4 wire units must fit the transport combine ceiling "
+              "(raise kMaxCombineElsize alongside kQ4MaxBlockElems)");
+
+void q4WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
+                         size_t count, Slot slot,
+                         std::chrono::milliseconds timeout) {
+  wireRingAllreduce(ctx, plan, q4WireCodec(), workBytes, count, slot,
+                    timeout);
+}
+
+void q4WireRingReduceScatter(Context* ctx, plan::Plan& plan,
+                             char* workBytes,
+                             transport::UnboundBuffer* workBuf,
+                             const collectives_detail::Blocks& blocks,
+                             Slot slot,
+                             std::chrono::milliseconds timeout) {
+  wireRingReduceScatter(ctx, plan, q4WireCodec(), workBytes, workBuf,
+                        blocks, slot, timeout);
+}
+
+}  // namespace algorithms
+}  // namespace tpucoll

@@ -10,32 +10,25 @@
 //
 // Precision contract (documented in docs/algorithms.md + docs/errors.md):
 //  - accumulation stays float32; only wire hops quantize;
-//  - each reduce-scatter hop re-quantizes the partial sum, so worst-case
-//    error grows with the hop count (P-1) at ~2.4 decimal digits per
-//    block (|x - decode(x)| <= max|block| / 254 per element per hop);
+//  - each reduce-scatter hop re-quantizes the partial sum (|x -
+//    decode(x)| <= max|block| / 254 per element per hop); with error
+//    feedback on (TPUCOLL_WIRE_EF, wire_ring.h) each origin encode
+//    also folds in the previous call's quantization error, so repeated
+//    reductions see the error dither toward zero instead of biasing;
 //  - the allgather phase transmits each final block's quantized stream
 //    ONCE and every rank forwards the received bytes verbatim, so all
 //    ranks decode bit-identical results (consensus preserved). Unlike
 //    the bf16 codec, q8 re-encoding a decoded block is NOT bit-exact
 //    (the scale roundtrip through *127/127 double-rounds), so the
-//    allgather never re-encodes — it always stages and forwards.
+//    allgather never re-encodes — it always forwards.
 //  - float32 + sum only; non-finite inputs poison their block's scale;
-//  - TPUCOLL_Q8_BLOCK must match on every rank (both ends of each wire
-//    parse the same unit size).
+//  - TPUCOLL_Q8_BLOCK and TPUCOLL_CODEC_PIPELINE must match on every
+//    rank (unit size and per-hop message count are wire protocol).
 //
-// Schedule shape mirrors collectives_compressed.cc. The reduce-scatter
-// phase rides the typed fused receive (UnboundBuffer::recvReduceTyped)
-// when the source pair is fuse-eligible AND the hop's block is a whole
-// number of q8 units — the RecvReduceFn adapter folds whole units
-// (scale header + codes) straight out of the shm ring into the float32
-// work array. Ragged blocks and the allgather phase use the staged arm.
-#include <cstring>
-
+// The schedule itself lives in wire_ring.cc (one pipelined engine for
+// every codec); this file binds it to the q8 descriptor.
 #include "tpucoll/collectives/algorithms.h"
-#include "tpucoll/collectives/collectives.h"
-#include "tpucoll/collectives/detail.h"
-#include "tpucoll/collectives/plan.h"
-#include "tpucoll/common/profile.h"
+#include "tpucoll/collectives/wire_ring.h"
 
 namespace tpucoll {
 namespace algorithms {
@@ -49,224 +42,21 @@ static_assert(transport::kMaxCombineElsize >=
               "q8 wire units must fit the transport combine ceiling "
               "(raise kMaxCombineElsize alongside kQ8MaxBlockElems)");
 
-using collectives_detail::Blocks;
-using collectives_detail::evenBlocks;
-using profile::Phase;
-using profile::PhaseScope;
-
-namespace {
-
-// RecvReduceFn-shaped adapter for the typed fused receive: `in` is n
-// whole wire units (the fuse predicate below guarantees unit alignment),
-// `acc` the float32 accumulator. The block size is process-global
-// (TPUCOLL_Q8_BLOCK, resolved once), which is what lets a stateless
-// function pointer parse the stream.
-void accumulateQ8UnitsFn(void* acc, const void* in, size_t nUnits) {
-  const size_t block = q8BlockElems();
-  q8StreamAccumulate(static_cast<float*>(acc),
-                     static_cast<const uint8_t*>(in), nUnits * block,
-                     block);
-}
-
-// Ring reduce-scatter over `work` with q8-quantized hops. Identical
-// block walk to ringReduceScatter (collectives_ring.cc): after P-1
-// steps rank r owns block (r + 1 + startShift) mod P fully reduced in
-// float32. startShift 0 feeds the allreduce allgather phase; -1 lands
-// block r on rank r for the standalone reduce_scatter.
-void q8RingReduceScatterPhase(Context* ctx, float* work,
-                              const Blocks& blocks, Slot slot,
-                              int startShift,
-                              std::chrono::milliseconds timeout,
-                              transport::UnboundBuffer* workBuf,
-                              plan::LazyStage& rxStage,
-                              uint8_t* tx,
-                              transport::UnboundBuffer* txBuf,
-                              size_t wireBlock) {
-  const int rank = ctx->rank();
-  const int size = ctx->size();
-  const size_t block = q8BlockElems();
-  const size_t unit = q8UnitBytes(block);
-  const int right = (rank + 1) % size;
-  const int left = (rank - 1 + size) % size;
-  const int steps = size - 1;
-
-  auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
-  auto blockStart = [&](int b) {
-    return blocks.offset[b] / sizeof(float);
-  };
-
-  // Fuse-eligibility of the source pair, resolved once (the ring only
-  // receives from `left`); unit alignment is checked per hop.
-  const bool pairFuse =
-      collectives_detail::fuseRecvReduce(ctx, /*fuseOk=*/true, unit, left);
-
-  for (int step = 0; step < steps; step++) {
-    const int sendBlock = (rank + startShift - step + 2 * size) % size;
-    const int recvBlock = (rank + startShift - step - 1 + 2 * size) % size;
-    const int txSlot = step % 2;
-    const uint64_t s = slot.offset(step).value();
-    const size_t sendElems = blockElems(sendBlock);
-    const size_t recvElems = blockElems(recvBlock);
-    const size_t sendWire = q8WireBytes(sendElems, block);
-    const size_t recvWire = q8WireBytes(recvElems, block);
-    uint8_t* txSeg = tx + size_t(txSlot) * wireBlock;
-    {
-      PhaseScope ps(Phase::kPack);
-      f32StreamToQ8(work + blockStart(sendBlock), txSeg, sendElems, block);
-    }
-    // Whole-unit hops fold straight out of the transport's staging into
-    // the float32 accumulator; ragged tails (and empty blocks) stage.
-    const bool fuse = pairFuse && recvElems > 0 && recvElems % block == 0;
-    {
-      PhaseScope ps(Phase::kPost);
-      if (fuse) {
-        workBuf->recvReduceTyped(left, s, accumulateQ8UnitsFn, unit,
-                                 block * sizeof(float),
-                                 blockStart(recvBlock) * sizeof(float),
-                                 recvWire);
-      } else {
-        rxStage.buf()->recv(left, s, size_t(step % 2) * wireBlock,
-                            recvWire);
-      }
-    }
-    {
-      PhaseScope ps(Phase::kPost, right, s, sendWire);
-      txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
-    }
-    if (fuse) {
-      PhaseScope ps(Phase::kWireWait, left, s, recvWire);
-      workBuf->waitRecv(nullptr, timeout);
-    } else {
-      {
-        PhaseScope ps(Phase::kWireWait, left, s, recvWire);
-        rxStage.buf()->waitRecv(nullptr, timeout);
-      }
-      PhaseScope ps(Phase::kReduce);
-      q8StreamAccumulate(
-          work + blockStart(recvBlock),
-          reinterpret_cast<uint8_t*>(rxStage.data()) +
-              size_t(step % 2) * wireBlock,
-          recvElems, block);
-    }
-    PhaseScope ps(Phase::kWireWait);
-    txBuf->waitSend(timeout);
-  }
-}
-
-size_t maxWireBlock(const Blocks& blocks, size_t block) {
-  size_t maxElems = 0;
-  for (size_t b : blocks.bytes) {
-    maxElems = std::max(maxElems, b / sizeof(float));
-  }
-  return std::max(q8WireBytes(maxElems, block), size_t(1));
-}
-
-}  // namespace
-
 void q8WireRingAllreduce(Context* ctx, plan::Plan& plan, char* workBytes,
                          size_t count, Slot slot,
                          std::chrono::milliseconds timeout) {
-  const int rank = ctx->rank();
-  const int size = ctx->size();
-  float* work = reinterpret_cast<float*>(workBytes);
-  const size_t block = q8BlockElems();
-  const Blocks& blocks = plan.blocks(
-      0, [&] { return evenBlocks(count, size, sizeof(float)); });
-  const size_t wireBlock = maxWireBlock(blocks, block);
-  const int right = (rank + 1) % size;
-  const int left = (rank - 1 + size) % size;
-  const int steps = size - 1;
-
-  // Wire staging: tx double-buffered (a sent stream must stay valid
-  // until waitSend); rx double-buffered, lazily acquired (untouched on
-  // fully fused hops). All plan-backed: warm arena + registration on
-  // the steady-state replay.
-  auto txStage = plan.stage(1, 2 * wireBlock);
-  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
-  auto* txBuf = txStage.buf;
-  plan::LazyStage rxStage(plan, 2, 2 * wireBlock);
-  auto* workBuf = plan.userBuf(0, work, count * sizeof(float));
-
-  auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
-  auto blockStart = [&](int b) {
-    return blocks.offset[b] / sizeof(float);
-  };
-
-  q8RingReduceScatterPhase(ctx, work, blocks, slot, /*startShift=*/0,
-                           timeout, workBuf, rxStage, tx, txBuf,
-                           wireBlock);
-
-  // --- allgather: rank r owns reduced block (r+1). The owner quantizes
-  // its block ONCE and adopts the decoded values; every hop then stages
-  // the received stream, decodes it into place, and forwards the WIRE
-  // BYTES verbatim — never re-encoding (q8 re-encode of a decoded block
-  // is not bit-exact, see the header comment), so every rank decodes
-  // the exact same stream and results are identical everywhere. ---
-  const uint64_t agBase = steps;
-  {
-    PhaseScope ps(Phase::kPack);
-    const int own = (rank + 1) % size;
-    f32StreamToQ8(work + blockStart(own), tx, blockElems(own), block);
-    q8StreamToF32(tx, work + blockStart(own), blockElems(own), block);
-  }
-  uint8_t* rx = nullptr;
-  for (int step = 0; step < steps; step++) {
-    const int sendBlock = (rank + 1 - step + 2 * size) % size;
-    const int recvBlock = (rank - step + 2 * size) % size;
-    const uint64_t s = slot.offset(agBase + step).value();
-    const int txSlot = step % 2;
-    const int rxSlot = step % 2;
-    const size_t sendWire = q8WireBytes(blockElems(sendBlock), block);
-    const size_t recvWire = q8WireBytes(blockElems(recvBlock), block);
-    if (step == 0) {
-      // Own block already sits quantized in tx slot 0.
-    } else {
-      // Forward the wire bytes received last step, verbatim.
-      PhaseScope ps(Phase::kPack);
-      std::memcpy(tx + size_t(txSlot) * wireBlock,
-                  rx + size_t((step - 1) % 2) * wireBlock, sendWire);
-    }
-    {
-      PhaseScope ps(Phase::kPost);
-      rxStage.buf()->recv(left, s, size_t(rxSlot) * wireBlock, recvWire);
-      rx = reinterpret_cast<uint8_t*>(rxStage.data());
-    }
-    {
-      PhaseScope ps(Phase::kPost, right, s, sendWire);
-      txBuf->send(right, s, size_t(txSlot) * wireBlock, sendWire);
-    }
-    {
-      PhaseScope ps(Phase::kWireWait, left, s, recvWire);
-      rxStage.buf()->waitRecv(nullptr, timeout);
-    }
-    {
-      PhaseScope ps(Phase::kUnpack);
-      q8StreamToF32(rx + size_t(rxSlot) * wireBlock,
-                    work + blockStart(recvBlock), blockElems(recvBlock),
-                    block);
-    }
-    PhaseScope ps(Phase::kWireWait);
-    txBuf->waitSend(timeout);
-  }
+  wireRingAllreduce(ctx, plan, q8WireCodec(), workBytes, count, slot,
+                    timeout);
 }
 
 void q8WireRingReduceScatter(Context* ctx, plan::Plan& plan,
                              char* workBytes,
                              transport::UnboundBuffer* workBuf,
-                             const Blocks& blocks, Slot slot,
+                             const collectives_detail::Blocks& blocks,
+                             Slot slot,
                              std::chrono::milliseconds timeout) {
-  float* work = reinterpret_cast<float*>(workBytes);
-  const size_t block = q8BlockElems();
-  const size_t wireBlock = maxWireBlock(blocks, block);
-  // Stage slots 0/1 here: the entry's work copy owns slot 2
-  // (kStageRsWork in collectives_ring.cc), and these plans never meet
-  // the binomial/ring staging (different algorithm keys).
-  auto txStage = plan.stage(0, 2 * wireBlock);
-  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
-  plan::LazyStage rxStage(plan, 1, 2 * wireBlock);
-  q8RingReduceScatterPhase(ctx, work, blocks, slot, /*startShift=*/-1,
-                           timeout, workBuf, rxStage, tx, txStage.buf,
-                           wireBlock);
+  wireRingReduceScatter(ctx, plan, q8WireCodec(), workBytes, workBuf,
+                        blocks, slot, timeout);
 }
 
 }  // namespace algorithms

@@ -539,7 +539,8 @@ void allreduce(AllreduceOptions& opts) {
 
   TC_ENFORCE(opts.customFn == nullptr ||
                  (opts.algorithm != AllreduceAlgorithm::kRingBf16Wire &&
-                  opts.algorithm != AllreduceAlgorithm::kRingQ8Wire),
+                  opts.algorithm != AllreduceAlgorithm::kRingQ8Wire &&
+                  opts.algorithm != AllreduceAlgorithm::kRingQ4Wire),
              "allreduce: custom reduction functions are incompatible "
              "with the wire-compressed algorithms (they reduce through "
              "the wire codec)");
@@ -711,6 +712,14 @@ void allreduce(AllreduceOptions& opts) {
         TC_ENFORCE(opts.op == ReduceOp::kSum,
                    "q8-wire allreduce supports sum only");
         algorithms::q8WireRingAllreduce(ctx, *planh, work, opts.count,
+                                        slot, timeout);
+        break;
+      case AllreduceAlgorithm::kRingQ4Wire:
+        TC_ENFORCE(opts.dtype == DataType::kFloat32,
+                   "q4-wire allreduce requires float32 payloads");
+        TC_ENFORCE(opts.op == ReduceOp::kSum,
+                   "q4-wire allreduce supports sum only");
+        algorithms::q4WireRingAllreduce(ctx, *planh, work, opts.count,
                                         slot, timeout);
         break;
       default:
@@ -1134,6 +1143,14 @@ void reduceScatter(ReduceScatterOptions& opts) {
       TC_ENFORCE(opts.op == ReduceOp::kSum && opts.customFn == nullptr,
                  "q8-wire reduce_scatter supports builtin sum only");
       algorithms::q8WireRingReduceScatter(ctx, *planh, work, st.buf,
+                                          blocks, slot, timeout);
+      break;
+    case ReduceScatterAlgorithm::kRingQ4Wire:
+      TC_ENFORCE(opts.dtype == DataType::kFloat32,
+                 "q4-wire reduce_scatter requires float32 payloads");
+      TC_ENFORCE(opts.op == ReduceOp::kSum && opts.customFn == nullptr,
+                 "q4-wire reduce_scatter supports builtin sum only");
+      algorithms::q4WireRingReduceScatter(ctx, *planh, work, st.buf,
                                           blocks, slot, timeout);
       break;
     default:

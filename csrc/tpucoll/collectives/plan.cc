@@ -28,6 +28,10 @@ transport::UnboundBuffer* Plan::userBuf(size_t idx, void* ptr,
 }
 
 char* Plan::scratch(size_t idx, size_t minBytes) {
+  return scratch(idx, minBytes, nullptr);
+}
+
+char* Plan::scratch(size_t idx, size_t minBytes, bool* fresh) {
   if (stages_.size() <= idx) {
     stages_.resize(idx + 1);
   }
@@ -37,6 +41,9 @@ char* Plan::scratch(size_t idx, size_t minBytes) {
     if (slot.arena.grewOnLastRequire()) {
       slot.buf.reset();  // any registration points at the old block
     }
+    if (fresh != nullptr) {
+      *fresh = slot.arena.grewOnLastRequire();
+    }
     return data;
   }
   // Transient: the Context scratch pool (warm pages across calls, the
@@ -44,6 +51,9 @@ char* Plan::scratch(size_t idx, size_t minBytes) {
   if (!slot.pooled.has_value() || slot.pooled->size() < minBytes) {
     slot.buf.reset();
     slot.pooled.emplace(ctx_->acquireScratch(minBytes));
+  }
+  if (fresh != nullptr) {
+    *fresh = true;  // pool pages rotate between calls: never trust them
   }
   return slot.pooled->data();
 }

@@ -163,6 +163,13 @@ class Plan {
   // idx is either scratch or stage for a plan's whole life.
   char* scratch(size_t idx, size_t minBytes);
 
+  // scratch() that also reports whether the memory is FRESH — newly
+  // allocated or moved, i.e. its prior contents are gone. State that
+  // must persist across calls on a cached plan (the wire rings'
+  // error-feedback residuals) zero-fills exactly when *fresh is set;
+  // transient plans report fresh on every call (pool pages rotate).
+  char* scratch(size_t idx, size_t minBytes, bool* fresh);
+
   // Memoized block layout, slot `idx`: computed by `make()` on the
   // first call, returned by reference afterwards. The returned
   // reference stays valid across later blocks()/segments() calls

@@ -1,0 +1,226 @@
+#include "tpucoll/collectives/wire_codec.h"
+
+#include <algorithm>
+
+#include "tpucoll/common/codec_pool.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+namespace {
+
+// ---- bf16 adapters (unit = one element, no scale header) ----
+
+void bf16Encode(const float* src, uint8_t* dst, size_t n) {
+  f32StreamToBf16(src, reinterpret_cast<uint16_t*>(dst), n);
+}
+
+void bf16Decode(const uint8_t* src, float* dst, size_t n) {
+  bf16StreamToF32(reinterpret_cast<const uint16_t*>(src), dst, n);
+}
+
+void bf16Accumulate(float* acc, const uint8_t* src, size_t n) {
+  bf16StreamAccumulate(acc, reinterpret_cast<const uint16_t*>(src), n);
+}
+
+size_t bf16Wire(size_t n) { return n * sizeof(uint16_t); }
+
+void bf16FusedAccumulate(void* acc, const void* in, size_t n) {
+  bf16StreamAccumulate(static_cast<float*>(acc),
+                       static_cast<const uint16_t*>(in), n);
+}
+
+void bf16FusedDecode(void* acc, const void* in, size_t n) {
+  bf16StreamToF32(static_cast<const uint16_t*>(in),
+                  static_cast<float*>(acc), n);
+}
+
+// ---- q8 adapters (block size process-global, like the codec) ----
+
+void q8Encode(const float* src, uint8_t* dst, size_t n) {
+  f32StreamToQ8(src, dst, n, q8BlockElems());
+}
+
+void q8Decode(const uint8_t* src, float* dst, size_t n) {
+  q8StreamToF32(src, dst, n, q8BlockElems());
+}
+
+void q8Accumulate(float* acc, const uint8_t* src, size_t n) {
+  q8StreamAccumulate(acc, src, n, q8BlockElems());
+}
+
+size_t q8Wire(size_t n) { return q8WireBytes(n, q8BlockElems()); }
+
+void q8FusedAccumulate(void* acc, const void* in, size_t nUnits) {
+  const size_t block = q8BlockElems();
+  q8StreamAccumulate(static_cast<float*>(acc),
+                     static_cast<const uint8_t*>(in), nUnits * block,
+                     block);
+}
+
+// ---- q4 adapters ----
+
+void q4Encode(const float* src, uint8_t* dst, size_t n) {
+  f32StreamToQ4(src, dst, n, q4BlockElems());
+}
+
+void q4Decode(const uint8_t* src, float* dst, size_t n) {
+  q4StreamToF32(src, dst, n, q4BlockElems());
+}
+
+void q4Accumulate(float* acc, const uint8_t* src, size_t n) {
+  q4StreamAccumulate(acc, src, n, q4BlockElems());
+}
+
+size_t q4Wire(size_t n) { return q4WireBytes(n, q4BlockElems()); }
+
+void q4FusedAccumulate(void* acc, const void* in, size_t nUnits) {
+  const size_t block = q4BlockElems();
+  q4StreamAccumulate(static_cast<float*>(acc),
+                     static_cast<const uint8_t*>(in), nUnits * block,
+                     block);
+}
+
+}  // namespace
+
+const WireCodec& bf16WireCodec() {
+  static const WireCodec c = [] {
+    WireCodec w;
+    w.kind = kWireCodecBf16;
+    w.name = "bf16";
+    w.unitElems = 1;
+    w.unitBytes = sizeof(uint16_t);
+    w.exactReencode = true;
+    w.encode = bf16Encode;
+    w.decode = bf16Decode;
+    w.accumulate = bf16Accumulate;
+    w.wire = bf16Wire;
+    w.fusedAccumulate = bf16FusedAccumulate;
+    w.fusedDecode = bf16FusedDecode;
+    return w;
+  }();
+  return c;
+}
+
+const WireCodec& q8WireCodec() {
+  static const WireCodec c = [] {
+    WireCodec w;
+    w.kind = kWireCodecQ8;
+    w.name = "q8";
+    w.unitElems = q8BlockElems();
+    w.unitBytes = q8UnitBytes(q8BlockElems());
+    w.exactReencode = false;
+    w.encode = q8Encode;
+    w.decode = q8Decode;
+    w.accumulate = q8Accumulate;
+    w.wire = q8Wire;
+    w.fusedAccumulate = q8FusedAccumulate;
+    w.fusedDecode = nullptr;  // q8 re-encode double-rounds: never fuse AG
+    return w;
+  }();
+  return c;
+}
+
+const WireCodec& q4WireCodec() {
+  static const WireCodec c = [] {
+    WireCodec w;
+    w.kind = kWireCodecQ4;
+    w.name = "q4";
+    w.unitElems = q4BlockElems();
+    w.unitBytes = q4UnitBytes(q4BlockElems());
+    w.exactReencode = false;
+    w.encode = q4Encode;
+    w.decode = q4Decode;
+    w.accumulate = q4Accumulate;
+    w.wire = q4Wire;
+    w.fusedAccumulate = q4FusedAccumulate;
+    w.fusedDecode = nullptr;
+    return w;
+  }();
+  return c;
+}
+
+size_t subSpans(const WireCodec& codec, size_t n, int depth, SubSpan* out) {
+  const size_t units = codec.unitsOf(n);
+  const size_t count = std::max<size_t>(
+      1, std::min<size_t>(static_cast<size_t>(depth), units));
+  for (size_t k = 0; k < count; k++) {
+    const size_t u0 = units * k / count;
+    const size_t u1 = units * (k + 1) / count;
+    SubSpan& s = out[k];
+    s.elemOff = u0 * codec.unitElems;
+    const size_t elemEnd = std::min(u1 * codec.unitElems, n);
+    s.elems = elemEnd - s.elemOff;
+    s.wireOff = u0 * codec.unitBytes;
+    s.wireBytes = codec.wire(s.elems);
+  }
+  return count;
+}
+
+namespace {
+
+// Unit-aligned shard walk shared by the three sharded kernels: fn gets
+// (elemOff, elems, wireOff) per shard.
+template <typename Fn>
+void forEachShard(const WireCodec& codec, size_t n, size_t shards,
+                  const Fn& fn) {
+  const size_t units = codec.unitsOf(n);
+  const size_t count = std::max<size_t>(1, std::min(shards, units));
+  if (count <= 1) {
+    fn(size_t(0), n, size_t(0));
+    return;
+  }
+  codec::CodecPool::instance().parallelFor(count, [&](size_t k) {
+    const size_t u0 = units * k / count;
+    const size_t u1 = units * (k + 1) / count;
+    const size_t elemOff = u0 * codec.unitElems;
+    const size_t elemEnd = std::min(u1 * codec.unitElems, n);
+    fn(elemOff, elemEnd - elemOff, u0 * codec.unitBytes);
+  });
+}
+
+}  // namespace
+
+void wireEncode(const WireCodec& codec, const float* src, uint8_t* dst,
+                size_t n, size_t shards, float* res, float* tmp) {
+  if (res == nullptr) {
+    forEachShard(codec, n, shards,
+                 [&](size_t eo, size_t ne, size_t wo) {
+                   codec.encode(src + eo, dst + wo, ne);
+                 });
+    return;
+  }
+  // Error feedback, per shard: t = src + res; encode t; the residual
+  // array doubles as the decode scratch, then flips to t - decode(t).
+  // Mul-free elementwise passes — deterministic for any shard count.
+  forEachShard(codec, n, shards, [&](size_t eo, size_t ne, size_t wo) {
+    float* t = tmp + eo;
+    float* r = res + eo;
+    const float* s = src + eo;
+    for (size_t i = 0; i < ne; i++) {
+      t[i] = s[i] + r[i];
+    }
+    codec.encode(t, dst + wo, ne);
+    codec.decode(dst + wo, r, ne);
+    for (size_t i = 0; i < ne; i++) {
+      r[i] = t[i] - r[i];
+    }
+  });
+}
+
+void wireDecode(const WireCodec& codec, const uint8_t* src, float* dst,
+                size_t n, size_t shards) {
+  forEachShard(codec, n, shards, [&](size_t eo, size_t ne, size_t wo) {
+    codec.decode(src + wo, dst + eo, ne);
+  });
+}
+
+void wireAccumulate(const WireCodec& codec, float* acc, const uint8_t* src,
+                    size_t n, size_t shards) {
+  forEachShard(codec, n, shards, [&](size_t eo, size_t ne, size_t wo) {
+    codec.accumulate(acc + eo, src + wo, ne);
+  });
+}
+
+}  // namespace algorithms
+}  // namespace tpucoll

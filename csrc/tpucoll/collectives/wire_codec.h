@@ -1,0 +1,108 @@
+// Wire-codec descriptors: the lossy wire formats (math.h bf16/q8/q4
+// streams) as data, so one pipelined ring engine (wire_ring.cc) serves
+// every codec instead of each codec hand-rolling its own schedule.
+//
+// A codec's stream is a sequence of independent UNITS (q8/q4: one scale
+// header + one block of codes; bf16: a single element). Everything the
+// engine needs reduces to unit geometry plus four kernels:
+//
+//   - unit independence makes SHARDING exact: encoding units [a, b) and
+//     [b, c) separately and concatenating equals the serial walk
+//     byte-for-byte, for any split — the codec pool's byte-identity
+//     contract (wireEncode/wireDecode/wireAccumulate below);
+//   - the same boundaries split a ring hop into TPUCOLL_CODEC_PIPELINE
+//     sub-blocks (subSpans) that encode/transmit/decode independently —
+//     the pipelined hop's wire protocol;
+//   - error feedback (wireEncode with a residual) folds the previous
+//     call's quantization error into the next encode and captures the
+//     new error, per element, before the bytes hit the wire.
+//
+// Precision/consensus contracts stay per-codec (docs/errors.md); this
+// header only fixes the geometry and kernel surface.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+#include "tpucoll/math.h"
+#include "tpucoll/transport/unbound_buffer.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+// Stable codec ids (capi sharded-codec surface + tuner labels).
+constexpr int kWireCodecBf16 = 0;
+constexpr int kWireCodecQ8 = 1;
+constexpr int kWireCodecQ4 = 2;
+
+struct WireCodec {
+  int kind{0};             // kWireCodec*
+  const char* name{""};    // "bf16" / "q8" / "q4"
+  size_t unitElems{1};     // float32 elements per full unit
+  size_t unitBytes{2};     // wire bytes per full unit
+  // encode(decode(encode(x))) == encode(x): true only for bf16, where a
+  // decoded value re-rounds to the same wire bytes. Gates the fused
+  // allgather arm (re-encode forwarding); q8/q4 must forward verbatim.
+  bool exactReencode{false};
+
+  // Stream kernels over n elements (serial; sharding wraps them).
+  void (*encode)(const float* src, uint8_t* dst, size_t n){nullptr};
+  void (*decode)(const uint8_t* src, float* dst, size_t n){nullptr};
+  void (*accumulate)(float* acc, const uint8_t* src, size_t n){nullptr};
+  // Total wire bytes for an n-element stream (ragged tail included).
+  size_t (*wire)(size_t n){nullptr};
+
+  // RecvReduceFn-shaped adapters for the typed fused receive: `in` is n
+  // whole units, acc the float32 accumulator (wire elsize = unitBytes,
+  // acc elsize = unitElems * 4). fusedDecode is only set when
+  // exactReencode holds (the fused-allgather decode-in-place arm).
+  transport::RecvReduceFn fusedAccumulate{nullptr};
+  transport::RecvReduceFn fusedDecode{nullptr};
+
+  size_t unitsOf(size_t n) const {
+    return (n + unitElems - 1) / unitElems;
+  }
+};
+
+// Process-wide descriptors (q8/q4 bind the resolved TPUCOLL_Q8_BLOCK /
+// TPUCOLL_Q4_BLOCK once, like the codecs themselves).
+const WireCodec& bf16WireCodec();
+const WireCodec& q8WireCodec();
+const WireCodec& q4WireCodec();
+
+// One pipelined sub-block of an n-element hop stream: a unit-aligned
+// contiguous span. Sub boundaries are derived from (n, depth) alone, so
+// sender and receiver always agree on the per-message geometry.
+struct SubSpan {
+  size_t elemOff{0};    // first element of the span
+  size_t elems{0};      // elements in the span
+  size_t wireOff{0};    // byte offset of the span inside the stream
+  size_t wireBytes{0};  // wire bytes of the span
+};
+
+constexpr int kMaxPipelineDepth = 32;  // TPUCOLL_CODEC_PIPELINE ceiling
+
+// Split an n-element stream into at most `depth` unit-aligned spans
+// (fewer when the stream has fewer units; exactly one for n == 0).
+// Returns the span count; `out` must hold kMaxPipelineDepth entries.
+size_t subSpans(const WireCodec& codec, size_t n, int depth, SubSpan* out);
+
+// Sharded stream kernels: run the serial kernel over `shards` unit-
+// aligned pieces on the codec pool. Output is byte-identical to the
+// serial walk for ANY shard count (unit independence; disjoint dst
+// ranges) — unit-tested via the capi sharded surface.
+//
+// wireEncode optionally applies error feedback: with res != nullptr
+// (and tmp, a caller-provided n-float scratch), each element encodes
+// t = src + res and the new residual res = t - decode(encode(t)) is
+// captured in place. res/tmp slices shard with the stream.
+void wireEncode(const WireCodec& codec, const float* src, uint8_t* dst,
+                size_t n, size_t shards, float* res = nullptr,
+                float* tmp = nullptr);
+void wireDecode(const WireCodec& codec, const uint8_t* src, float* dst,
+                size_t n, size_t shards);
+void wireAccumulate(const WireCodec& codec, float* acc, const uint8_t* src,
+                    size_t n, size_t shards);
+
+}  // namespace algorithms
+}  // namespace tpucoll

@@ -1,0 +1,605 @@
+// Pipelined wire-codec ring engine (see wire_ring.h for the contract).
+//
+// Hop anatomy at pipeline depth D (both ring phases):
+//
+//   post   all <= D sub-recvs up front (slot delta = hop base + j, so
+//          the receiver can identify arrivals in ANY order);
+//   encode sub j on the codec pool; the WORKER posts the send the
+//          moment its encode finishes (in sub order, via the hop's
+//          send sequencer), so the op thread never blocks on encode
+//          before a send — sub j+1 encodes while sub j is on the wire
+//          and the op thread is already draining arrivals;
+//   drain  staged arrivals by slot (waitRecvSlot) and hand each sub to
+//          the pool for decode/accumulate on arrival;
+//   join   decode tickets, fused arrivals, encode tickets, and the D
+//          sends before the next hop (the rx/tx parity regions flip
+//          per hop, so a full per-hop drain is what makes their reuse
+//          safe).
+//
+// Phase attribution follows the work, not the schedule: with pool
+// workers, the op thread's pack bucket holds only the residual
+// (non-overlapped) encode join — the codec itself runs while the op
+// thread sits in wire_wait — and sends posted by workers are invisible
+// to the op-thread span stream (the pair-level wire telemetry still
+// counts them). With no workers (TPUCOLL_CODEC_THREADS=1, the default)
+// every kernel runs inline on the op thread under its honest phase
+// scope, including the staged decode/accumulate fallback.
+//
+// Fused receives keep working per sub-block: a sub whose element count
+// is whole units rides recvReduceTyped straight into the float32
+// accumulator on the transport thread (one fewer staging pass AND the
+// fold leaves the caller's profile entirely); ragged tails stage.
+//
+// Consensus: unchanged from the per-codec rings. The allgather forwards
+// received wire bytes verbatim for inexact codecs (q8/q4) — now
+// directly from the rx stage, with no copy into tx — and re-encodes
+// decoded values only where that roundtrip is exact (bf16). Error
+// feedback touches origin encodes only, so forwarded streams are
+// byte-identical with EF on or off.
+#include <cstring>
+#include <exception>
+#include <mutex>
+
+#include "tpucoll/collectives/wire_ring.h"
+#include "tpucoll/common/codec_pool.h"
+#include "tpucoll/common/env.h"
+#include "tpucoll/common/profile.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+using collectives_detail::Blocks;
+using collectives_detail::evenBlocks;
+using profile::Phase;
+using profile::PhaseScope;
+
+bool wireErrorFeedback() {
+  static const bool on = envFlag("TPUCOLL_WIRE_EF", true);
+  return on;
+}
+
+namespace {
+
+// Per-hop sub-block geometry (unit-aligned; identical on both ends of
+// the wire because it derives from the hop's element count alone).
+struct HopGeom {
+  SubSpan spans[kMaxPipelineDepth];
+  size_t n{0};
+};
+
+HopGeom hopGeom(const WireCodec& codec, size_t elems, int depth) {
+  HopGeom g;
+  g.n = subSpans(codec, elems, depth, g.spans);
+  return g;
+}
+
+// Encode state shared by both phases: residual/scratch slices resolved
+// per send block.
+struct EfState {
+  float* res{nullptr};  // count floats, plan-persistent (slot 3)
+  float* tmp{nullptr};  // maxBlockElems floats, per-call scratch (slot 4)
+};
+
+// In-order send sequencer for one hop's worker-posted sends: whichever
+// worker closes the lowest-index gap posts every consecutive ready sub.
+// Sends therefore hit the pair in sub order no matter which encode
+// finishes first — fault-injection draws and the wire telemetry see ONE
+// deterministic tx order per pair, run to run. Lives on the op thread's
+// stack; the per-hop encode-ticket join keeps every job from outliving
+// the frame.
+struct HopTx {
+  std::mutex mu;
+  size_t next{0};
+  size_t n{0};
+  std::exception_ptr err;  // first failed send; later subs stop posting
+  bool ready[kMaxPipelineDepth] = {};
+  SubSpan spans[kMaxPipelineDepth];
+  uint64_t slots[kMaxPipelineDepth];
+  transport::UnboundBuffer* buf{nullptr};
+  size_t base{0};
+  int right{-1};
+
+  void complete(size_t j) {
+    std::lock_guard<std::mutex> guard(mu);
+    ready[j] = true;
+    while (next < n && ready[next]) {
+      const SubSpan& ss = spans[next];
+      if (err == nullptr) {
+        try {
+          buf->send(right, slots[next], base + ss.wireOff, ss.wireBytes);
+        } catch (...) {
+          // Pool jobs must not throw (a worker-thread escape is
+          // std::terminate): latch the pair's error for the op
+          // thread's encode join to rethrow.
+          err = std::current_exception();
+        }
+      }
+      next++;
+    }
+  }
+};
+
+// Encode the hop's stream into txSeg and send each sub as soon as its
+// encode finishes. With pool workers (and depth > 1) each sub is one
+// async encode(+adopt)+send job — the worker posts the send through
+// `htx`, the op thread keeps going, and the returned ticket count is
+// joined at hop end. Otherwise the subs run synchronously on the op
+// thread: at depth 1 the single sub shards across the pool lanes
+// (maximum lanes on one stream), at depth > 1 with no workers each sub
+// encodes and ships in turn (same wire bytes, honest serial phases).
+// `adopt` != nullptr additionally decodes each encoded sub back into
+// place (the allgather owner's roundtrip; may alias `src`).
+size_t encodeAndSend(const WireCodec& codec, const HopGeom& sg,
+                     const float* src, float* res, float* tmp,
+                     float* adopt, uint8_t* txSeg,
+                     transport::UnboundBuffer* txBuf, size_t txBase,
+                     int right, Slot slot, uint64_t hopBase, int depth,
+                     HopTx* htx, codec::CodecPool::Ticket* tickets) {
+  codec::CodecPool& pool = codec::CodecPool::instance();
+  const size_t lanes = static_cast<size_t>(codec::codecThreads());
+  if (depth <= 1 || sg.n <= 1 || pool.workers() == 0) {
+    for (size_t j = 0; j < sg.n; j++) {
+      const SubSpan& ss = sg.spans[j];
+      {
+        PhaseScope ps(Phase::kPack);
+        wireEncode(codec, src + ss.elemOff, txSeg + ss.wireOff, ss.elems,
+                   lanes, res != nullptr ? res + ss.elemOff : nullptr,
+                   tmp);
+        if (adopt != nullptr) {
+          wireDecode(codec, txSeg + ss.wireOff, adopt + ss.elemOff,
+                     ss.elems, lanes);
+        }
+      }
+      const uint64_t s = slot.offset(hopBase + j).value();
+      PhaseScope ps(Phase::kPost, right, s, ss.wireBytes);
+      txBuf->send(right, s, txBase + ss.wireOff, ss.wireBytes);
+    }
+    return 0;
+  }
+  htx->n = sg.n;
+  htx->buf = txBuf;
+  htx->base = txBase;
+  htx->right = right;
+  for (size_t j = 0; j < sg.n; j++) {
+    htx->spans[j] = sg.spans[j];
+    htx->slots[j] = slot.offset(hopBase + j).value();
+  }
+  for (size_t j = 0; j < sg.n; j++) {
+    const SubSpan ss = sg.spans[j];  // by value: the job may outlive j
+    tickets[j] = pool.submit([&codec, ss, j, src, res, tmp, adopt, txSeg,
+                              htx] {
+      wireEncode(codec, src + ss.elemOff, txSeg + ss.wireOff, ss.elems,
+                 /*shards=*/1, res != nullptr ? res + ss.elemOff : nullptr,
+                 tmp != nullptr ? tmp + ss.elemOff : nullptr);
+      if (adopt != nullptr) {
+        wireDecode(codec, txSeg + ss.wireOff, adopt + ss.elemOff, ss.elems,
+                   /*shards=*/1);
+      }
+      htx->complete(j);
+    });
+  }
+  return sg.n;
+}
+
+// Join a hop's async encode tickets. join() is the happy path: the
+// residual (non-overlapped) encode time is all that stays on the op
+// thread's pack bucket — the sends were already posted by the workers,
+// in sub order — and a send failure latched in the sequencer rethrows
+// here, BEFORE the caller blocks on send completions that were never
+// posted. The destructor is the unwind net: the jobs reference this
+// frame's HopTx and scratch, so no exception (a dead peer surfacing in
+// drainHop) may leak them past the frame.
+struct EncodeJoin {
+  const codec::CodecPool::Ticket* tickets;
+  HopTx* htx;
+  size_t n{0};
+
+  void join() {
+    if (n != 0) {
+      codec::CodecPool& pool = codec::CodecPool::instance();
+      PhaseScope ps(Phase::kPack);
+      for (size_t j = 0; j < n; j++) {
+        pool.wait(tickets[j]);
+      }
+      n = 0;
+    }
+    // All jobs finished (pool.wait ordered us after complete()), so the
+    // latch is stable without the sequencer mutex.
+    if (htx->err != nullptr) {
+      std::rethrow_exception(htx->err);
+    }
+  }
+
+  ~EncodeJoin() {
+    codec::CodecPool& pool = codec::CodecPool::instance();
+    for (size_t j = 0; j < n; j++) {
+      pool.wait(tickets[j]);
+    }
+  }
+};
+
+// Drain `nStaged` staged sub-arrivals by slot, dispatching each to
+// `perSub(j)` the moment it lands (decode-on-arrival); then join the
+// issued tickets under `joinPhase` and reap `nFused` fused arrivals.
+template <typename PerSub>
+void drainHop(transport::UnboundBuffer* rxBuf,
+              transport::UnboundBuffer* workBuf, size_t nStaged,
+              size_t nFused, size_t nSubs, Slot slot, uint64_t hopBase,
+              int left, std::chrono::milliseconds timeout, Phase joinPhase,
+              const PerSub& perSub) {
+  codec::CodecPool& pool = codec::CodecPool::instance();
+  codec::CodecPool::Ticket tickets[kMaxPipelineDepth] = {};
+  // Unwind net: a decode job captures `perSub` — this frame — so a
+  // throwing wait below (peer death mid-hop) must join issued jobs
+  // before unwinding.
+  struct Join {
+    codec::CodecPool& pool;
+    const codec::CodecPool::Ticket* tickets;
+    size_t n{0};
+    ~Join() {
+      for (size_t i = 0; i < n; i++) {
+        pool.wait(tickets[i]);
+      }
+    }
+  } join{pool, tickets};
+  const uint64_t base = slot.offset(hopBase).value();
+  for (size_t i = 0; i < nStaged; i++) {
+    uint64_t landed = 0;
+    {
+      PhaseScope ps(Phase::kWireWait, left, base, 0);
+      rxBuf->waitRecvSlot(nullptr, &landed, timeout);
+    }
+    const uint64_t j = landed - base;
+    TC_ENFORCE_LT(j, static_cast<uint64_t>(nSubs),
+                  "wire ring: arrival outside the hop's slot window");
+    if (pool.workers() == 0) {
+      // No pool: the kernel runs inline right here — attribute it to
+      // the join phase it would otherwise have been waited under.
+      PhaseScope ps(joinPhase);
+      perSub(static_cast<size_t>(j));
+    } else {
+      tickets[i] =
+          pool.submit([&perSub, j] { perSub(static_cast<size_t>(j)); });
+      join.n = i + 1;
+    }
+  }
+  for (size_t i = 0; i < nFused; i++) {
+    PhaseScope ps(Phase::kWireWait, left, base, 0);
+    workBuf->waitRecv(nullptr, timeout);
+  }
+  PhaseScope ps(joinPhase);
+  for (size_t i = 0; i < join.n; i++) {
+    pool.wait(tickets[i]);
+  }
+  join.n = 0;
+}
+
+// Ring reduce-scatter phase with pipelined quantized hops. Identical
+// block walk to the per-codec rings: after P-1 steps rank r owns block
+// (r + 1 + startShift) mod P fully reduced in float32. startShift 0
+// feeds the allreduce allgather; -1 lands block r on rank r.
+void wireRingRsPhase(Context* ctx, const WireCodec& codec, float* work,
+                     const Blocks& blocks, Slot slot, int startShift,
+                     std::chrono::milliseconds timeout,
+                     transport::UnboundBuffer* workBuf,
+                     plan::LazyStage& rxStage, uint8_t* tx,
+                     transport::UnboundBuffer* txBuf, size_t wireBlock,
+                     const EfState& ef) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  const int steps = size - 1;
+  const int depth = codec::codecPipelineDepth();
+
+  auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
+  auto blockStart = [&](int b) {
+    return blocks.offset[b] / sizeof(float);
+  };
+
+  // Fuse-eligibility of the source pair, resolved once; unit alignment
+  // is checked per sub-block.
+  const bool pairFuse =
+      codec.fusedAccumulate != nullptr &&
+      collectives_detail::fuseRecvReduce(ctx, /*fuseOk=*/true,
+                                         codec.unitBytes, left);
+
+  for (int step = 0; step < steps; step++) {
+    const int sendBlock = (rank + startShift - step + 2 * size) % size;
+    const int recvBlock = (rank + startShift - step - 1 + 2 * size) % size;
+    const int parity = step % 2;
+    const uint64_t hopBase = static_cast<uint64_t>(step) * depth;
+    const HopGeom sg = hopGeom(codec, blockElems(sendBlock), depth);
+    const HopGeom rg = hopGeom(codec, blockElems(recvBlock), depth);
+
+    // Post every sub-recv before sending: arrivals complete in wire
+    // order, not posting order, and the decode keys off the slot.
+    size_t nFused = 0;
+    size_t nStaged = 0;
+    {
+      PhaseScope ps(Phase::kPost);
+      for (size_t j = 0; j < rg.n; j++) {
+        const SubSpan& ss = rg.spans[j];
+        const uint64_t s = slot.offset(hopBase + j).value();
+        const bool fuse = pairFuse && ss.elems > 0 &&
+                          ss.elems % codec.unitElems == 0;
+        if (fuse) {
+          workBuf->recvReduceTyped(
+              left, s, codec.fusedAccumulate, codec.unitBytes,
+              codec.unitElems * sizeof(float),
+              (blockStart(recvBlock) + ss.elemOff) * sizeof(float),
+              ss.wireBytes);
+          nFused++;
+        } else {
+          rxStage.buf()->recv(left, s,
+                              static_cast<size_t>(parity) * wireBlock +
+                                  ss.wireOff,
+                              ss.wireBytes);
+          nStaged++;
+        }
+      }
+    }
+
+    HopTx htx;
+    codec::CodecPool::Ticket txTickets[kMaxPipelineDepth] = {};
+    EncodeJoin txJoin{txTickets, &htx};
+    txJoin.n = encodeAndSend(
+        codec, sg, work + blockStart(sendBlock),
+        ef.res != nullptr ? ef.res + blockStart(sendBlock) : nullptr,
+        ef.tmp, /*adopt=*/nullptr,
+        tx + static_cast<size_t>(parity) * wireBlock, txBuf,
+        static_cast<size_t>(parity) * wireBlock, right, slot, hopBase,
+        depth, &htx, txTickets);
+
+    const uint8_t* rxSeg =
+        nStaged != 0 ? reinterpret_cast<const uint8_t*>(rxStage.data()) +
+                           static_cast<size_t>(parity) * wireBlock
+                     : nullptr;
+    float* acc = work + blockStart(recvBlock);
+    drainHop(rxStage.buf(), workBuf, nStaged, nFused, rg.n, slot, hopBase,
+             left, timeout, Phase::kReduce, [&](size_t j) {
+               const SubSpan& ss = rg.spans[j];
+               wireAccumulate(codec, acc + ss.elemOff, rxSeg + ss.wireOff,
+                              ss.elems,
+                              depth <= 1
+                                  ? static_cast<size_t>(
+                                        codec::codecThreads())
+                                  : 1);
+             });
+
+    txJoin.join();
+    PhaseScope ps(Phase::kWireWait);
+    for (size_t j = 0; j < sg.n; j++) {
+      txBuf->waitSend(timeout);
+    }
+  }
+}
+
+// Allgather phase: rank r owns reduced block (r+1). The owner encodes
+// its block ONCE (the call's only origin encode in this phase — error
+// feedback applies) and adopts the decoded values; every later hop
+// forwards the received stream verbatim straight from the rx stage
+// (inexact codecs) or re-encodes the adopted values (exact roundtrip
+// codecs on fused pairs), so all ranks decode bit-identical bytes.
+void wireRingAgPhase(Context* ctx, const WireCodec& codec, float* work,
+                     const Blocks& blocks, Slot slot,
+                     std::chrono::milliseconds timeout,
+                     transport::UnboundBuffer* workBuf,
+                     plan::LazyStage& rxStage, uint8_t* tx,
+                     transport::UnboundBuffer* txBuf, size_t wireBlock,
+                     const EfState& ef) {
+  const int rank = ctx->rank();
+  const int size = ctx->size();
+  const int right = (rank + 1) % size;
+  const int left = (rank - 1 + size) % size;
+  const int steps = size - 1;
+  const int depth = codec::codecPipelineDepth();
+
+  auto blockElems = [&](int b) { return blocks.bytes[b] / sizeof(float); };
+  auto blockStart = [&](int b) {
+    return blocks.offset[b] / sizeof(float);
+  };
+
+  // The fused-decode arm needs an exact re-encode for the forward leg;
+  // fusedDecode is only populated on codecs where that holds (bf16).
+  const bool pairFuse =
+      codec.fusedDecode != nullptr && codec.exactReencode &&
+      collectives_detail::fuseRecvReduce(ctx, /*fuseOk=*/true,
+                                         codec.unitBytes, left);
+
+  // Owner role: quantize own block into tx parity 0 and adopt the
+  // decoded values (consensus: every rank holds decode(stream)). With
+  // ring steps the encode folds into step 0 below — per sub, as
+  // encode+adopt+send jobs — so the first hop's wire time absorbs it;
+  // only a single-rank group runs it here.
+  const int own = (rank + 1) % size;
+  if (steps == 0) {
+    PhaseScope ps(Phase::kPack);
+    wireEncode(codec, work + blockStart(own), tx, blockElems(own),
+               static_cast<size_t>(codec::codecThreads()),
+               ef.res != nullptr ? ef.res + blockStart(own) : nullptr,
+               ef.tmp);
+    wireDecode(codec, tx, work + blockStart(own), blockElems(own),
+               static_cast<size_t>(codec::codecThreads()));
+    return;
+  }
+
+  const uint64_t agBase = static_cast<uint64_t>(steps) * depth;
+  for (int step = 0; step < steps; step++) {
+    const int sendBlock = (rank + 1 - step + 2 * size) % size;
+    const int recvBlock = (rank - step + 2 * size) % size;
+    const int parity = step % 2;
+    const uint64_t hopBase = agBase + static_cast<uint64_t>(step) * depth;
+    const HopGeom sg = hopGeom(codec, blockElems(sendBlock), depth);
+    const HopGeom rg = hopGeom(codec, blockElems(recvBlock), depth);
+
+    size_t nFused = 0;
+    size_t nStaged = 0;
+    {
+      PhaseScope ps(Phase::kPost);
+      for (size_t j = 0; j < rg.n; j++) {
+        const SubSpan& ss = rg.spans[j];
+        const uint64_t s = slot.offset(hopBase + j).value();
+        const bool fuse = pairFuse && ss.elems > 0 &&
+                          ss.elems % codec.unitElems == 0;
+        if (fuse) {
+          workBuf->recvReduceTyped(
+              left, s, codec.fusedDecode, codec.unitBytes,
+              codec.unitElems * sizeof(float),
+              (blockStart(recvBlock) + ss.elemOff) * sizeof(float),
+              ss.wireBytes);
+          nFused++;
+        } else {
+          rxStage.buf()->recv(left, s,
+                              static_cast<size_t>(parity) * wireBlock +
+                                  ss.wireOff,
+                              ss.wireBytes);
+          nStaged++;
+        }
+      }
+    }
+
+    HopTx htx;
+    codec::CodecPool::Ticket txTickets[kMaxPipelineDepth] = {};
+    EncodeJoin txJoin{txTickets, &htx};
+    if (step == 0) {
+      // Owner encode: quantize own block (the call's only origin
+      // encode in this phase — error feedback applies), adopt the
+      // decoded values, and ship each sub as it finishes.
+      txJoin.n = encodeAndSend(
+          codec, sg, work + blockStart(own),
+          ef.res != nullptr ? ef.res + blockStart(own) : nullptr, ef.tmp,
+          /*adopt=*/work + blockStart(own), tx, txBuf, /*txBase=*/0,
+          right, slot, hopBase, depth, &htx, txTickets);
+    } else if (pairFuse) {
+      // Fused pairs consumed last hop's stream in the transport;
+      // re-encode the adopted values (exact, so the forwarded bytes
+      // match the verbatim stream bit-for-bit). No residual: a forward
+      // re-encode is not an origin encode.
+      txJoin.n = encodeAndSend(
+          codec, sg, work + blockStart(sendBlock),
+          /*res=*/nullptr, /*tmp=*/nullptr,
+          /*adopt=*/nullptr,
+          tx + static_cast<size_t>(parity) * wireBlock, txBuf,
+          static_cast<size_t>(parity) * wireBlock, right, slot, hopBase,
+          depth, &htx, txTickets);
+    } else {
+      // Forward the bytes received last hop verbatim, directly from
+      // the rx stage's previous parity region — the per-hop send drain
+      // below is what keeps that region stable while it ships.
+      const size_t prev = static_cast<size_t>((step - 1) % 2) * wireBlock;
+      for (size_t j = 0; j < sg.n; j++) {
+        const SubSpan& ss = sg.spans[j];
+        const uint64_t s = slot.offset(hopBase + j).value();
+        PhaseScope ps(Phase::kPost, right, s, ss.wireBytes);
+        rxStage.buf()->send(right, s, prev + ss.wireOff, ss.wireBytes);
+      }
+    }
+
+    const uint8_t* rxSeg =
+        nStaged != 0 ? reinterpret_cast<const uint8_t*>(rxStage.data()) +
+                           static_cast<size_t>(parity) * wireBlock
+                     : nullptr;
+    float* dst = work + blockStart(recvBlock);
+    drainHop(rxStage.buf(), workBuf, nStaged, nFused, rg.n, slot, hopBase,
+             left, timeout, Phase::kUnpack, [&](size_t j) {
+               const SubSpan& ss = rg.spans[j];
+               wireDecode(codec, rxSeg + ss.wireOff, dst + ss.elemOff,
+                          ss.elems,
+                          depth <= 1
+                              ? static_cast<size_t>(codec::codecThreads())
+                              : 1);
+             });
+
+    txJoin.join();
+    PhaseScope ps(Phase::kWireWait);
+    for (size_t j = 0; j < sg.n; j++) {
+      const bool fromRx = step != 0 && !pairFuse;
+      (fromRx ? rxStage.buf() : txBuf)->waitSend(timeout);
+    }
+  }
+}
+
+size_t maxStreamBlock(const WireCodec& codec, const Blocks& blocks,
+                      size_t* maxElemsOut) {
+  size_t maxElems = 0;
+  for (size_t b : blocks.bytes) {
+    maxElems = std::max(maxElems, b / sizeof(float));
+  }
+  if (maxElemsOut != nullptr) {
+    *maxElemsOut = maxElems;
+  }
+  return std::max(codec.wire(maxElems), size_t(1));
+}
+
+EfState efState(plan::Plan& plan, size_t count, size_t maxBlockElems) {
+  EfState ef;
+  if (!wireErrorFeedback() || count == 0) {
+    return ef;
+  }
+  bool fresh = false;
+  ef.res = reinterpret_cast<float*>(
+      plan.scratch(3, count * sizeof(float), &fresh));
+  if (fresh) {
+    std::memset(ef.res, 0, count * sizeof(float));
+  }
+  ef.tmp = reinterpret_cast<float*>(
+      plan.scratch(4, std::max(maxBlockElems, size_t(1)) * sizeof(float)));
+  return ef;
+}
+
+}  // namespace
+
+void wireRingAllreduce(Context* ctx, plan::Plan& plan,
+                       const WireCodec& codec, char* workBytes,
+                       size_t count, Slot slot,
+                       std::chrono::milliseconds timeout) {
+  float* work = reinterpret_cast<float*>(workBytes);
+  const Blocks& blocks = plan.blocks(
+      0, [&] { return evenBlocks(count, ctx->size(), sizeof(float)); });
+  size_t maxBlockElems = 0;
+  const size_t wireBlock = maxStreamBlock(codec, blocks, &maxBlockElems);
+
+  // Wire staging: tx double-buffered (a sent stream must stay valid
+  // until its waitSend); rx double-buffered, lazily acquired (untouched
+  // when every hop fuses). All plan-backed: warm arena + registration
+  // on the steady-state replay.
+  auto txStage = plan.stage(1, 2 * wireBlock);
+  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
+  plan::LazyStage rxStage(plan, 2, 2 * wireBlock);
+  auto* workBuf = plan.userBuf(0, work, count * sizeof(float));
+  const EfState ef = efState(plan, count, maxBlockElems);
+
+  wireRingRsPhase(ctx, codec, work, blocks, slot, /*startShift=*/0,
+                  timeout, workBuf, rxStage, tx, txStage.buf, wireBlock,
+                  ef);
+  wireRingAgPhase(ctx, codec, work, blocks, slot, timeout, workBuf,
+                  rxStage, tx, txStage.buf, wireBlock, ef);
+}
+
+void wireRingReduceScatter(Context* ctx, plan::Plan& plan,
+                           const WireCodec& codec, char* workBytes,
+                           transport::UnboundBuffer* workBuf,
+                           const Blocks& blocks, Slot slot,
+                           std::chrono::milliseconds timeout) {
+  float* work = reinterpret_cast<float*>(workBytes);
+  size_t maxBlockElems = 0;
+  const size_t wireBlock = maxStreamBlock(codec, blocks, &maxBlockElems);
+  size_t count = 0;
+  for (size_t b : blocks.bytes) {
+    count += b / sizeof(float);
+  }
+  // Stage slots 0/1 here: the entry's work copy owns slot 2
+  // (kStageRsWork in collectives_ring.cc), and these plans never meet
+  // the binomial/ring staging (different algorithm keys).
+  auto txStage = plan.stage(0, 2 * wireBlock);
+  uint8_t* tx = reinterpret_cast<uint8_t*>(txStage.data);
+  plan::LazyStage rxStage(plan, 1, 2 * wireBlock);
+  const EfState ef = efState(plan, count, maxBlockElems);
+  wireRingRsPhase(ctx, codec, work, blocks, slot, /*startShift=*/-1,
+                  timeout, workBuf, rxStage, tx, txStage.buf, wireBlock,
+                  ef);
+}
+
+}  // namespace algorithms
+}  // namespace tpucoll

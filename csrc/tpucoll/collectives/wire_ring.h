@@ -1,0 +1,68 @@
+// Pipelined wire-codec ring engine: ONE schedule serving every lossy
+// wire codec (wire_codec.h descriptors — bf16/q8/q4), replacing the
+// per-codec rings of collectives_compressed.cc / collectives_q8.cc.
+//
+// What "pipelined" buys (TPUCOLL_CODEC_PIPELINE = D): each ring hop's
+// stream splits into up to D unit-aligned sub-blocks that encode,
+// transmit and decode independently — sub k+1 encodes while sub k is on
+// the wire, and the receiver decodes each sub AS IT ARRIVES instead of
+// after the whole hop lands. Arrival order is taken from the transport
+// (UnboundBuffer::waitRecvSlot): striped and non-striped sub-messages
+// ride different channel sets, so completion order is NOT posting
+// order. With D = 1 the engine reproduces the pre-pipeline wire
+// protocol (one message per hop) exactly.
+//
+// Codec work runs on the codec pool (common/codec_pool.h): at D = 1 the
+// hop's stream shards across TPUCOLL_CODEC_THREADS lanes; at D > 1 each
+// sub-block is an async pool job whose worker also posts the sub's send
+// the moment its encode finishes (in sub order), so the caller's thread
+// drains arrivals instead of chaperoning encodes. Both are
+// byte-identical to the serial walk (unit-aligned boundaries).
+//
+// Error feedback (TPUCOLL_WIRE_EF, default on): a per-plan residual
+// buffer accumulates each origin encode's quantization error and folds
+// it into the next call's encode of the same elements, so repeated
+// reductions (the gradient-averaging steady state) see the error
+// DITHER toward zero instead of biasing one way. Residuals apply only
+// to origin encodes (reduce-scatter sends + the allgather owner's
+// encode) — never to allgather forwards, which stay verbatim (q8/q4)
+// or exact re-encodes (bf16), preserving cross-rank consensus exactly
+// as before. Residuals live in the plan's arena, so they persist
+// across calls on a cached plan and start zeroed when (re)allocated.
+#pragma once
+
+#include <chrono>
+
+#include "tpucoll/collectives/detail.h"
+#include "tpucoll/collectives/plan.h"
+#include "tpucoll/collectives/wire_codec.h"
+#include "tpucoll/context.h"
+#include "tpucoll/types.h"
+
+namespace tpucoll {
+namespace algorithms {
+
+// TPUCOLL_WIRE_EF (strict 0/1, default 1): error-feedback residuals on
+// the wire rings' origin encodes. Read once per process.
+bool wireErrorFeedback();
+
+// Ring allreduce over `codec`'s wire format: reduce-scatter with
+// quantized hops (float32 accumulation), then an allgather whose
+// forwards preserve bit-identical results on every rank. Slot budget:
+// 2 * (P-1) * TPUCOLL_CODEC_PIPELINE deltas from `slot`.
+void wireRingAllreduce(Context* ctx, plan::Plan& plan,
+                       const WireCodec& codec, char* work, size_t count,
+                       Slot slot, std::chrono::milliseconds timeout);
+
+// Ring reduce-scatter over `codec`'s wire (startShift -1: rank r ends
+// owning reduced block r of `blocks` in full-precision float32; only
+// wire hops quantize). Stage slots 0/1; scratch slots 3/4 (residual +
+// encode scratch) — the caller's work copy owns slot 2.
+void wireRingReduceScatter(Context* ctx, plan::Plan& plan,
+                           const WireCodec& codec, char* work,
+                           transport::UnboundBuffer* workBuf,
+                           const collectives_detail::Blocks& blocks,
+                           Slot slot, std::chrono::milliseconds timeout);
+
+}  // namespace algorithms
+}  // namespace tpucoll

@@ -1,0 +1,164 @@
+#include "tpucoll/common/codec_pool.h"
+
+#include <unistd.h>
+
+#include <atomic>
+#include <utility>
+
+#include "tpucoll/common/env.h"
+
+namespace tpucoll {
+namespace codec {
+
+int codecThreads() {
+  static const int n = [] {
+    // Default = the transport loop width: a host provisioned to move
+    // bytes on N threads gets N codec lanes (device.cc reads the same
+    // knob with the same bounds).
+    const long dflt = envCount("TPUCOLL_LOOP_THREADS", 1, 1, 64);
+    return static_cast<int>(envCount("TPUCOLL_CODEC_THREADS", dflt, 1, 64));
+  }();
+  return n;
+}
+
+int codecPipelineDepth() {
+  static const int d = static_cast<int>(
+      envCount("TPUCOLL_CODEC_PIPELINE", 4, 1, 32));
+  return d;
+}
+
+CodecPool& CodecPool::instance() {
+  static CodecPool pool;
+  return pool;
+}
+
+CodecPool::CodecPool() : width_(codecThreads()) {}
+
+CodecPool::~CodecPool() {
+  {
+    std::lock_guard<std::mutex> guard(mu_);
+    stop_ = true;
+    cv_.notify_all();
+  }
+  const bool owner = ownerPid_ == ::getpid();
+  for (auto& t : threads_) {
+    if (owner) {
+      t.join();
+    } else {
+      // Forked child: the underlying threads died with the parent's
+      // address-space copy; just release the handles.
+      t.detach();
+    }
+  }
+}
+
+void CodecPool::ensureWorkers() {
+  // Called under mu_. Re-spawn check is pid-based: a forked child must
+  // never touch threads it only inherited as dead handles.
+  if (spawned_ && ownerPid_ == ::getpid()) {
+    return;
+  }
+  if (spawned_) {
+    return;  // foreign pid: caller falls back to inline execution
+  }
+  ownerPid_ = ::getpid();
+  spawned_ = true;
+  const int n = workers();
+  threads_.reserve(static_cast<size_t>(n));
+  for (int i = 0; i < n; i++) {
+    threads_.emplace_back([this] { workerMain(); });
+  }
+}
+
+void CodecPool::workerMain() {
+  for (;;) {
+    std::shared_ptr<Job> job;
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait(lock, [&] { return stop_ || !queue_.empty(); });
+      if (queue_.empty()) {
+        return;  // stop_
+      }
+      job = queue_.front();
+      queue_.pop_front();
+    }
+    job->fn();
+    {
+      std::lock_guard<std::mutex> guard(mu_);
+      job->done = true;
+      doneCv_.notify_all();
+    }
+  }
+}
+
+CodecPool::Ticket CodecPool::submit(std::function<void()> fn) {
+  if (workers() == 0) {
+    fn();
+    return 0;
+  }
+  std::unique_lock<std::mutex> lock(mu_);
+  ensureWorkers();
+  if (ownerPid_ != ::getpid()) {
+    lock.unlock();
+    fn();
+    return 0;
+  }
+  auto job = std::make_shared<Job>();
+  job->fn = std::move(fn);
+  job->id = nextId_++;
+  queue_.push_back(job);
+  live_[job->id] = job;
+  cv_.notify_one();
+  return job->id;
+}
+
+void CodecPool::wait(Ticket t) {
+  if (t == 0) {
+    return;
+  }
+  std::unique_lock<std::mutex> lock(mu_);
+  doneCv_.wait(lock, [&] {
+    auto it = live_.find(t);
+    return it == live_.end() || it->second->done;
+  });
+  live_.erase(t);
+}
+
+void CodecPool::parallelFor(size_t nShards,
+                            const std::function<void(size_t)>& fn) {
+  if (nShards == 0) {
+    return;
+  }
+  const size_t lanes =
+      std::min(static_cast<size_t>(width_), nShards);
+  if (lanes <= 1 || workers() == 0) {
+    for (size_t i = 0; i < nShards; i++) {
+      fn(i);
+    }
+    return;
+  }
+  // Dynamic shard claim: lane count changes WHO computes a shard, never
+  // WHAT it computes — byte identity rides on the shard boundaries,
+  // which the caller fixed before entering.
+  auto next = std::make_shared<std::atomic<size_t>>(0);
+  auto drain = [next, &fn, nShards] {
+    size_t i;
+    // relaxed: the counter only partitions shard indices; the caller's
+    // wait() on every ticket is the publication point for shard output.
+    while ((i = next->fetch_add(1, std::memory_order_relaxed)) < nShards) {
+      fn(i);
+    }
+  };
+  std::vector<Ticket> tickets;
+  tickets.reserve(lanes - 1);
+  for (size_t w = 1; w < lanes; w++) {
+    tickets.push_back(submit(drain));
+  }
+  drain();
+  for (Ticket t : tickets) {
+    wait(t);
+  }
+}
+
+}  // namespace codec
+}  // namespace tpucoll

@@ -511,6 +511,212 @@ void q8StreamAccumulate(float* dst, const uint8_t* src, size_t n,
   }
 }
 
+// ---- int4 block-quantized wire codec (math.h for the stream layout) ----
+
+size_t q4BlockElems() {
+  static const size_t block = static_cast<size_t>(
+      envCount("TPUCOLL_Q4_BLOCK", 256, 8,
+               static_cast<long>(kQ4MaxBlockElems)));
+  return block;
+}
+
+namespace {
+
+// Pack n int32 codes (already clipped to [-7, 7]) into biased nibbles.
+// Integer-exact, so sharing it between the scalar and vector encoders
+// cannot break byte identity.
+inline void q4PackCodes(const int* q, uint8_t* codes, size_t n) {
+  const size_t nb = (n + 1) / 2;
+  for (size_t i = 0; i < nb; i++) {
+    const uint8_t lo = static_cast<uint8_t>(q[2 * i] + 8);
+    const uint8_t hi =
+        2 * i + 1 < n ? static_cast<uint8_t>(q[2 * i + 1] + 8) : 0;
+    codes[i] = static_cast<uint8_t>(lo | (hi << 4));
+  }
+}
+
+#ifndef TC_HAVE_VECTOR_HALF
+TC_Q8_NO_FP_CONTRACT
+inline void q4EncodeBlockScalar(const float* src, uint8_t* dst, size_t n) {
+  float maxAbs = 0.0f;
+  for (size_t i = 0; i < n; i++) {
+    maxAbs = std::max(maxAbs, std::fabs(src[i]));
+  }
+  const float scale = maxAbs / 7.0f;
+  std::memcpy(dst, &scale, kQ4ScaleBytes);
+  uint8_t* codes = dst + kQ4ScaleBytes;
+  const size_t nb = (n + 1) / 2;
+  if (scale == 0.0f) {
+    // Biased zero code in every nibble; a dangling odd tail keeps its
+    // high nibble 0 like the non-zero path.
+    std::memset(codes, 0x88, nb);
+    if (n % 2 != 0) {
+      codes[nb - 1] = 0x08;
+    }
+    return;
+  }
+  int q[2];
+  for (size_t i = 0; i < n; i += 2) {
+    const size_t pair = std::min<size_t>(2, n - i);
+    for (size_t j = 0; j < pair; j++) {
+      int v = static_cast<int>(nearbyintf(src[i + j] / scale));
+      q[j] = std::min(7, std::max(-7, v));
+    }
+    q4PackCodes(q, codes + i / 2, pair);
+  }
+}
+
+template <bool accumulate>
+TC_Q8_NO_FP_CONTRACT
+inline void q4DecodeBlockScalar(float* acc, const uint8_t* unit, size_t n) {
+  float scale;
+  std::memcpy(&scale, unit, kQ4ScaleBytes);
+  const uint8_t* codes = unit + kQ4ScaleBytes;
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t byte = codes[i / 2];
+    const int nib = (i % 2 != 0) ? (byte >> 4) : (byte & 0x0f);
+    const float v = static_cast<float>(nib - 8) * scale;
+    acc[i] = accumulate ? acc[i] + v : v;
+  }
+}
+#endif  // !TC_HAVE_VECTOR_HALF
+
+#ifdef TC_HAVE_VECTOR_HALF
+
+// Vector quantize of one block: the expensive per-element IEEE division
+// and round run 8 lanes wide (identical ops to the scalar path); the
+// clipped int32 codes round-trip through a small stack array into the
+// integer-exact nibble packer.
+TC_Q8_NO_FP_CONTRACT
+inline void q4EncodeBlockVec(const float* src, uint8_t* dst, size_t n) {
+  const __m256 absMask = _mm256_castsi256_ps(_mm256_set1_epi32(0x7fffffff));
+  __m256 vmax = _mm256_setzero_ps();
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    vmax = _mm256_max_ps(vmax, _mm256_and_ps(_mm256_loadu_ps(src + i),
+                                             absMask));
+  }
+  float maxAbs = hmax8(vmax);
+  for (; i < n; i++) {
+    maxAbs = std::max(maxAbs, std::fabs(src[i]));
+  }
+  const float scale = maxAbs / 7.0f;
+  std::memcpy(dst, &scale, kQ4ScaleBytes);
+  uint8_t* codes = dst + kQ4ScaleBytes;
+  const size_t nb = (n + 1) / 2;
+  if (scale == 0.0f) {
+    std::memset(codes, 0x88, nb);
+    if (n % 2 != 0) {
+      codes[nb - 1] = 0x08;
+    }
+    return;
+  }
+  const __m256 vscale = _mm256_set1_ps(scale);
+  const __m256i lim = _mm256_set1_epi32(7);
+  const __m256i nlim = _mm256_set1_epi32(-7);
+  alignas(32) int q[8];
+  i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 r = _mm256_round_ps(
+        _mm256_div_ps(_mm256_loadu_ps(src + i), vscale),
+        _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC);
+    __m256i qi = _mm256_min_epi32(_mm256_max_epi32(_mm256_cvtps_epi32(r),
+                                                   nlim), lim);
+    _mm256_store_si256(reinterpret_cast<__m256i*>(q), qi);
+    q4PackCodes(q, codes + i / 2, 8);
+  }
+  for (; i < n; i++) {
+    int v = static_cast<int>(nearbyintf(src[i] / scale));
+    v = std::min(7, std::max(-7, v));
+    // i is even here whenever the vector loop ran (it advances by 8),
+    // but a short block can enter the tail at any parity.
+    const uint8_t c = static_cast<uint8_t>(v + 8);
+    if (i % 2 == 0) {
+      codes[i / 2] = c;
+    } else {
+      codes[i / 2] = static_cast<uint8_t>(codes[i / 2] | (c << 4));
+    }
+  }
+}
+
+// acc[i] (+)= (nibble - 8) * scale: the nibble unpack is integer-exact
+// scalar work; the float mul/add runs 8 lanes wide, mul then add (never
+// FMA) so vector equals scalar bit-for-bit.
+template <bool accumulate>
+TC_Q8_NO_FP_CONTRACT
+inline void q4DecodeBlockVec(float* acc, const uint8_t* unit, size_t n) {
+  float scale;
+  std::memcpy(&scale, unit, kQ4ScaleBytes);
+  const uint8_t* codes = unit + kQ4ScaleBytes;
+  const __m256 vscale = _mm256_set1_ps(scale);
+  alignas(16) int8_t w[8];
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    for (size_t j = 0; j < 4; j++) {
+      const uint8_t byte = codes[i / 2 + j];
+      w[2 * j] = static_cast<int8_t>(static_cast<int>(byte & 0x0f) - 8);
+      w[2 * j + 1] = static_cast<int8_t>(static_cast<int>(byte >> 4) - 8);
+    }
+    __m256i qi = _mm256_cvtepi8_epi32(
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(w)));
+    __m256 v = _mm256_mul_ps(_mm256_cvtepi32_ps(qi), vscale);
+    if (accumulate) {
+      v = _mm256_add_ps(_mm256_loadu_ps(acc + i), v);
+    }
+    _mm256_storeu_ps(acc + i, v);
+  }
+  for (; i < n; i++) {
+    const uint8_t byte = codes[i / 2];
+    const int nib = (i % 2 != 0) ? (byte >> 4) : (byte & 0x0f);
+    const float v = static_cast<float>(nib - 8) * scale;
+    acc[i] = accumulate ? acc[i] + v : v;
+  }
+}
+
+#endif  // TC_HAVE_VECTOR_HALF
+
+}  // namespace
+
+TC_Q8_NO_FP_CONTRACT
+void f32StreamToQ4(const float* src, uint8_t* dst, size_t n, size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q4EncodeBlockVec(src + off, dst, b);
+#else
+    q4EncodeBlockScalar(src + off, dst, b);
+#endif
+    dst += q4UnitBytes(b);
+  }
+}
+
+TC_Q8_NO_FP_CONTRACT
+void q4StreamToF32(const uint8_t* src, float* dst, size_t n, size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q4DecodeBlockVec<false>(dst + off, src, b);
+#else
+    q4DecodeBlockScalar<false>(dst + off, src, b);
+#endif
+    src += q4UnitBytes(b);
+  }
+}
+
+TC_Q8_NO_FP_CONTRACT
+void q4StreamAccumulate(float* dst, const uint8_t* src, size_t n,
+                        size_t block) {
+  for (size_t off = 0; off < n; off += block) {
+    const size_t b = std::min(block, n - off);
+#ifdef TC_HAVE_VECTOR_HALF
+    q4DecodeBlockVec<true>(dst + off, src, b);
+#else
+    q4DecodeBlockScalar<true>(dst + off, src, b);
+#endif
+    src += q4UnitBytes(b);
+  }
+}
+
 ReduceFn getReduceFn(DataType dtype, ReduceOp op) {
   switch (dtype) {
     case DataType::kInt8:

@@ -80,6 +80,48 @@ void q8StreamToF32(const uint8_t* src, float* dst, size_t n, size_t block);
 void q8StreamAccumulate(float* dst, const uint8_t* src, size_t n,
                         size_t block);
 
+// ---- int4 block-quantized wire codec (packed nibbles) ----
+//
+// Same stream shape as q8 but at half the code width: consecutive UNITS
+// of [4-byte little-endian float32 scale][ceil(block/2) bytes of packed
+// nibbles]; the final unit carries only the tail (n % block) codes.
+// Element i of a unit lives in byte i/2 — even elements in the low
+// nibble, odd in the high; a dangling high nibble at an odd tail is
+// written as 0 (deterministic bytes, never decoded). Codes are biased:
+// nibble = clip(round(x / scale), -7, 7) + 8 with scale = max|x| / 7,
+// so the stored range is [1, 15] and decode is (nibble - 8) * scale.
+// Scalar and AVX2 paths are byte-identical (IEEE division +
+// round-to-nearest-even in both; the nibble packing is integer-exact).
+// ~8x fewer wire bytes than float32 at ~0.9 decimal digits per block
+// (|x - decode(x)| <= max|block| / 14 per element per hop).
+constexpr size_t kQ4ScaleBytes = 4;
+constexpr size_t kQ4MaxBlockElems = 2048;
+
+// Block size in elements: TPUCOLL_Q4_BLOCK (strict count, [8, 2048],
+// default 256), resolved once per process; must match across ranks
+// (both ends of every wire parse the same unit size, docs/env.md).
+size_t q4BlockElems();
+
+inline size_t q4UnitBytes(size_t block) {
+  return kQ4ScaleBytes + (block + 1) / 2;
+}
+
+// Total wire bytes for an n-element stream at the given block size.
+inline size_t q4WireBytes(size_t n, size_t block) {
+  if (n == 0) {
+    return 0;
+  }
+  const size_t full = n / block;
+  const size_t tail = n % block;
+  return full * q4UnitBytes(block) + (tail != 0 ? q4UnitBytes(tail) : 0);
+}
+
+void f32StreamToQ4(const float* src, uint8_t* dst, size_t n, size_t block);
+void q4StreamToF32(const uint8_t* src, float* dst, size_t n, size_t block);
+// dst[i] += decode(src unit stream); mul-then-add, like q8.
+void q4StreamAccumulate(float* dst, const uint8_t* src, size_t n,
+                        size_t block);
+
 inline uint64_t log2ceil(uint64_t n) {
   uint64_t r = 0;
   while ((uint64_t(1) << r) < n) {

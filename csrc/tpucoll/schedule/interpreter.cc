@@ -9,6 +9,7 @@
 
 #include "tpucoll/collectives/detail.h"
 #include "tpucoll/collectives/plan.h"
+#include "tpucoll/collectives/wire_codec.h"
 #include "tpucoll/common/logging.h"
 #include "tpucoll/common/profile.h"
 #include "tpucoll/common/span.h"
@@ -164,6 +165,7 @@ std::shared_ptr<const ResolvedProgram> resolve(const Schedule& s, int rank) {
     r.chunk = e.chunk;
     r.slot = e.slot;
     r.flags = st.flags;
+    r.pipeline = st.pipeline;
     r.delta = deltaOf[i];
     r.deps.reserve(st.deps.size());
     for (int32_t d : st.deps) {
@@ -377,16 +379,22 @@ void run(Context* ctx, plan::Plan& plan, const ResolvedProgram& prog,
       }
       case StepOp::kEncode: {
         PhaseScope cs(Phase::kPack);
-        f32StreamToBf16(reinterpret_cast<const float*>(chunkPtr(st)),
-                        reinterpret_cast<uint16_t*>(slotPtr(st)),
-                        chunkElems(st));
+        // pipeline > 1 shards the walk across the codec pool
+        // (wire_codec.h) — byte-identical to the serial stream calls.
+        algorithms::wireEncode(
+            algorithms::bf16WireCodec(),
+            reinterpret_cast<const float*>(chunkPtr(st)),
+            reinterpret_cast<uint8_t*>(slotPtr(st)), chunkElems(st),
+            static_cast<size_t>(st.pipeline));
         break;
       }
       case StepOp::kDecode: {
         PhaseScope cs(Phase::kUnpack);
-        bf16StreamToF32(reinterpret_cast<const uint16_t*>(slotPtr(st)),
-                        reinterpret_cast<float*>(chunkPtr(st)),
-                        chunkElems(st));
+        algorithms::wireDecode(
+            algorithms::bf16WireCodec(),
+            reinterpret_cast<const uint8_t*>(slotPtr(st)),
+            reinterpret_cast<float*>(chunkPtr(st)), chunkElems(st),
+            static_cast<size_t>(st.pipeline));
         break;
       }
     }

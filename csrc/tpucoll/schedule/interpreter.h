@@ -65,6 +65,9 @@ struct RStep {
   int chunk{0};
   int slot{-1};
   uint8_t flags{0};
+  // encode/decode: codec-pool shard count for the sub-block walk
+  // (wire_codec.h subSpans) — byte-identical to the serial walk.
+  int32_t pipeline{1};
   uint32_t delta{0};  // wire steps: sub-slot of the collective's base slot
   std::vector<int32_t> deps;
 };

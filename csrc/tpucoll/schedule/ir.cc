@@ -313,6 +313,9 @@ std::string ScheduleTable::toJson() const {
       if (st.flags != 0) {
         out << ",\"flags\":" << static_cast<int>(st.flags);
       }
+      if (st.pipeline != 1) {
+        out << ",\"pipeline\":" << st.pipeline;
+      }
       if (!st.deps.empty()) {
         out << ",\"deps\":[";
         for (size_t d = 0; d < st.deps.size(); d++) {
@@ -410,6 +413,13 @@ ScheduleTable ScheduleTable::fromJson(const std::string& json) {
       TC_ENFORCE(flags >= 0 && flags <= 0xff,
                  "schedule JSON: step flags out of range");
       st.flags = static_cast<uint8_t>(flags);
+      // Range-checked here so a malformed file fails at parse; the
+      // verifier owns the per-opcode rule (pipeline > 1 only on codec
+      // steps).
+      const int64_t pipeline = optionalInt(stv, "pipeline", 1);
+      TC_ENFORCE(pipeline >= 1 && pipeline <= 0x7fffffff,
+                 "schedule JSON: step pipeline out of range");
+      st.pipeline = static_cast<int32_t>(pipeline);
       if (const JsonReader::Value* deps = stv.field("deps")) {
         TC_ENFORCE(deps->kind == Kind::kArray,
                    "schedule JSON: \"deps\" must be an array");

@@ -106,6 +106,13 @@ struct Step {
   static constexpr uint8_t kFlagToSlot = 1;  // copy direction
   static constexpr uint8_t kFlagCoded = 2;   // send/recv move bf16 bytes
   uint8_t flags{0};
+  // Pipeline depth for encode/decode steps: the codec walk is split
+  // into `pipeline` deterministic sub-spans sharded across the codec
+  // pool (wire_codec.h subSpans — byte-identical to the serial walk),
+  // so a generator can stripe codec work the way the native pipelined
+  // wire rings do. Must be 1 on every other opcode (the verifier
+  // rejects it elsewhere: only codec steps have a sub-block walk).
+  int32_t pipeline{1};
   // Indices into Schedule::steps that must complete (on this rank)
   // before this step may run. Any order; the verifier topo-sorts and
   // rejects cycles.

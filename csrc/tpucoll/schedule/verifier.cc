@@ -6,6 +6,7 @@
 #include <sstream>
 #include <utility>
 
+#include "tpucoll/collectives/wire_codec.h"
 #include "tpucoll/common/logging.h"
 
 namespace tpucoll {
@@ -297,6 +298,19 @@ std::optional<VerifyError> verify(const Schedule& s) {
         return err(VerifyCode::kBadStep, r, i,
                    "coded flag only applies to send/recv (recv_reduce "
                    "cannot fold coded bytes; recv then decode)");
+      }
+      if (st.pipeline < 1 ||
+          st.pipeline > static_cast<int32_t>(algorithms::kMaxPipelineDepth)) {
+        std::ostringstream msg;
+        msg << "pipeline depth " << st.pipeline << " out of range [1, "
+            << algorithms::kMaxPipelineDepth << "]";
+        return err(VerifyCode::kBadStep, r, i, msg.str());
+      }
+      if (st.pipeline > 1 &&
+          !(st.op == StepOp::kEncode || st.op == StepOp::kDecode)) {
+        return err(VerifyCode::kBadStep, r, i,
+                   "pipeline depth only applies to encode/decode (only "
+                   "codec steps have a sub-block walk to split)");
       }
       if (o.chunk < 0 || o.chunk >= s.nChunks) {
         std::ostringstream msg;

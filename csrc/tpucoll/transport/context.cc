@@ -627,7 +627,7 @@ void Context::postSend(UnboundBuffer* buf, int dstRank, uint64_t slot,
       }
     }
     if (rbuf != nullptr) {
-      rbuf->onRecvComplete(rank_);
+      rbuf->onRecvComplete(rank_, slot);
     }
     buf->onSendComplete();
     return;
@@ -781,7 +781,7 @@ void Context::postRecv(UnboundBuffer* buf, const std::vector<int>& srcRanks,
     }
   }
   if (fromStash) {
-    buf->onRecvComplete(stashSrc);
+    buf->onRecvComplete(stashSrc, slot);
   }
 }
 
@@ -1134,6 +1134,7 @@ void Context::stripeLanded(int srcRank, uint64_t entry, uint32_t index) {
           stashPayload = std::move(it->buf);  // the stage to fold from
         }
         rbuf = it->ubuf;
+        slot = it->slot;
       } else {
         toStash = true;
         slot = it->slot;
@@ -1152,7 +1153,7 @@ void Context::stripeLanded(int srcRank, uint64_t entry, uint32_t index) {
                 foldTotal);
   }
   if (rbuf != nullptr) {
-    rbuf->onRecvComplete(srcRank);
+    rbuf->onRecvComplete(srcRank, slot);
   }
   if (errBuf != nullptr) {
     errBuf->onRecvError(errMsg);
@@ -1256,7 +1257,7 @@ void Context::stashArrived(int srcRank, uint64_t slot,
     }
   }
   if (rbuf != nullptr) {
-    rbuf->onRecvComplete(src);
+    rbuf->onRecvComplete(src, slot);
   }
 }
 

@@ -1514,7 +1514,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
     }
     if (nbytes == 0) {
       if (match.direct) {
-        match.ubuf->onRecvComplete(peerRank_);
+        match.ubuf->onRecvComplete(peerRank_, rxHeader_.slot);
       } else {
         context_->stashArrived(peerRank_, rxHeader_.slot, {});
       }
@@ -1632,7 +1632,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
             rxUbuf_ = nullptr;
           }
           if (b != nullptr) {
-            b->onRecvComplete(peerRank_);
+            b->onRecvComplete(peerRank_, shmRxHeader_.slot);
           }
           break;
         }
@@ -1758,7 +1758,7 @@ Pair::RxStep Pair::processHeader(size_t* consumed) {
   }
   if (nbytes == 0) {
     if (match.direct) {
-      match.ubuf->onRecvComplete(peerRank_);
+      match.ubuf->onRecvComplete(peerRank_, rxHeader_.slot);
     } else {
       context_->stashArrived(peerRank_, rxHeader_.slot, {});
     }
@@ -2043,7 +2043,7 @@ void Pair::finishMessage() {
         rxUbuf_ = nullptr;
       }
       if (b != nullptr) {
-        b->onRecvComplete(peerRank_);
+        b->onRecvComplete(peerRank_, rxHeader_.slot);
       }
       break;
     }

@@ -251,6 +251,11 @@ bool UnboundBuffer::waitSend(std::chrono::milliseconds timeout) {
 }
 
 bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
+  return waitRecvSlot(srcRank, nullptr, timeout);
+}
+
+bool UnboundBuffer::waitRecvSlot(int* srcRank, uint64_t* slot,
+                                 std::chrono::milliseconds timeout) {
   // One relaxed load when metrics are off; timestamps only when on.
   Metrics* metrics = context_->metrics();
   const bool measured = metrics != nullptr && metrics->enabled();
@@ -273,9 +278,13 @@ bool UnboundBuffer::waitRecv(int* srcRank, std::chrono::milliseconds timeout) {
     return false;
   }
   TC_ENFORCE(!completedRecvs_.empty());
-  const int src = completedRecvs_.front();
+  const RecvDone done = completedRecvs_.front();
+  const int src = done.srcRank;
   if (srcRank != nullptr) {
     *srcRank = src;
+  }
+  if (slot != nullptr) {
+    *slot = done.slot;
   }
   completedRecvs_.pop_front();
   if (measured) {
@@ -342,11 +351,11 @@ void UnboundBuffer::onRegionPutArrived(int srcRank) {
   cv_.notify_all();
 }
 
-void UnboundBuffer::onRecvComplete(int srcRank) {
+void UnboundBuffer::onRecvComplete(int srcRank, uint64_t slot) {
   {
     std::lock_guard<std::mutex> guard(mu_);
     pendingRecvs_--;
-    completedRecvs_.push_back(srcRank);
+    completedRecvs_.push_back(RecvDone{srcRank, slot});
     cv_.notify_all();
   }
 }

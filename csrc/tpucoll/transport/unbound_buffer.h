@@ -121,6 +121,15 @@ class UnboundBuffer {
   // Wait for one recv to complete; *srcRank (if non-null) receives the
   // source. Same failure contract as waitSend.
   bool waitRecv(int* srcRank, std::chrono::milliseconds timeout);
+  // waitRecv that also reports WHICH message landed: *slot (if non-null)
+  // receives the completed message's slot. With several recvs
+  // outstanding on one buffer, completion order follows the wire, not
+  // the posting order (striped and non-striped messages ride different
+  // channel sets), so consumers that act per-message — the pipelined
+  // wire rings' decode-on-arrival — key off the slot instead of
+  // assuming FIFO.
+  bool waitRecvSlot(int* srcRank, uint64_t* slot,
+                    std::chrono::milliseconds timeout);
   // Wait for one notify-put arrival into this buffer's exported region
   // (bound-buffer waitRecv analog). Kept on a SEPARATE queue from posted
   // receives so one-sided arrivals can never satisfy — or be satisfied
@@ -134,7 +143,7 @@ class UnboundBuffer {
 
   // --- completion callbacks (Context / Pair internals) ---
   void onSendComplete();
-  void onRecvComplete(int srcRank);
+  void onRecvComplete(int srcRank, uint64_t slot);
   // Notify-put arrival: queues a waitRecv completion WITHOUT pending-recv
   // accounting (no recv was posted; the peer wrote one-sidedly).
   void onRegionPutArrived(int srcRank);
@@ -164,10 +173,15 @@ class UnboundBuffer {
 
   std::mutex mu_;
   std::condition_variable cv_;
+  struct RecvDone {
+    int srcRank;
+    uint64_t slot;
+  };
+
   int pendingSends_{0};
   int pendingRecvs_{0};
   int completedSends_{0};
-  std::deque<int> completedRecvs_;
+  std::deque<RecvDone> completedRecvs_;
   std::deque<int> putArrivals_;  // notify-put sources (separate contract)
   bool abortSend_{false};
   bool abortRecv_{false};

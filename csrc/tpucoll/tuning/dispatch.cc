@@ -30,6 +30,7 @@ const std::vector<std::string>& allreduceArmsLossy() {
     std::vector<std::string> a = allreduceArms();
     a.push_back("ring_bf16_wire");
     a.push_back("ring_q8_wire");
+    a.push_back("ring_q4_wire");
     return a;
   }();
   return arms;
@@ -107,6 +108,7 @@ const char* allreduceAlgorithmName(AllreduceAlgorithm algo) {
     case AllreduceAlgorithm::kHdFold: return "hd_fold";
     case AllreduceAlgorithm::kHdBlocks: return "hd_blocks";
     case AllreduceAlgorithm::kRingQ8Wire: return "ring_q8_wire";
+    case AllreduceAlgorithm::kRingQ4Wire: return "ring_q4_wire";
     case AllreduceAlgorithm::kAutoLossyWire: return "auto_lossy_wire";
     case AllreduceAlgorithm::kHier: return "hier";
   }
@@ -129,6 +131,7 @@ const char* reduceScatterAlgorithmName(ReduceScatterAlgorithm algo) {
     case ReduceScatterAlgorithm::kHalvingDoubling: return "halving_doubling";
     case ReduceScatterAlgorithm::kDirect: return "direct";
     case ReduceScatterAlgorithm::kRingQ8Wire: return "ring_q8_wire";
+    case ReduceScatterAlgorithm::kRingQ4Wire: return "ring_q4_wire";
     case ReduceScatterAlgorithm::kHier: return "hier";
   }
   return "unknown";
@@ -161,6 +164,7 @@ std::optional<AllreduceAlgorithm> tableAllreduce(Context* ctx,
   if (*name == "hd_blocks") return AllreduceAlgorithm::kHdBlocks;
   if (*name == "ring_bf16_wire") return AllreduceAlgorithm::kRingBf16Wire;
   if (*name == "ring_q8_wire") return AllreduceAlgorithm::kRingQ8Wire;
+  if (*name == "ring_q4_wire") return AllreduceAlgorithm::kRingQ4Wire;
   return std::nullopt;
 }
 

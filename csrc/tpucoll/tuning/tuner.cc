@@ -57,6 +57,7 @@ std::vector<AllreduceArm> allreduceArms(Context* ctx) {
       // and so kAutoLossyWire can elect them from measurement.
       {"ring_bf16_wire", AllreduceAlgorithm::kRingBf16Wire},
       {"ring_q8_wire", AllreduceAlgorithm::kRingQ8Wire},
+      {"ring_q4_wire", AllreduceAlgorithm::kRingQ4Wire},
   };
   const bool pow2 = (size & (size - 1)) == 0;
   if (pow2) {
@@ -260,8 +261,9 @@ std::shared_ptr<const TuningTable> tune(Context* ctx,
           {"halving_doubling", ReduceScatterAlgorithm::kHalvingDoubling},
           {"direct", ReduceScatterAlgorithm::kDirect},
           // Measurement-only (never auto-elected): wire-compression
-          // headroom data for the q8 reduce_scatter opt-in.
+          // headroom data for the q8/q4 reduce_scatter opt-ins.
           {"ring_q8_wire", ReduceScatterAlgorithm::kRingQ8Wire},
+          {"ring_q4_wire", ReduceScatterAlgorithm::kRingQ4Wire},
       };
       if (group::hierEligible(ctx)) {
         rsArms.push_back({"hier", ReduceScatterAlgorithm::kHier});

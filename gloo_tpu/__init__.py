@@ -39,8 +39,14 @@ from gloo_tpu.core import (
     TimeoutError,
     UnboundBuffer,
     Work,
+    codec_pipeline,
+    codec_threads,
     crypto_isa_tier,
     derive_keyring,
+    q4_block,
+    q4_decode,
+    q4_encode,
+    q4_wire_bytes,
     q8_block,
     q8_decode,
     q8_encode,
@@ -75,6 +81,12 @@ __all__ = [
     "derive_keyring",
     "elastic",
     "fault",
+    "codec_pipeline",
+    "codec_threads",
+    "q4_block",
+    "q4_decode",
+    "q4_encode",
+    "q4_wire_bytes",
     "q8_block",
     "q8_decode",
     "q8_encode",

@@ -253,6 +253,17 @@ _PROTOTYPES = {
     "tc_q8_wire_bytes": (_sz, [_sz]),
     "tc_q8_encode": (_int, [_c, _sz, _c, _sz]),
     "tc_q8_decode": (_int, [_c, _sz, _c, _sz]),
+    # int4 packed-nibble wire codec (the kRingQ4Wire per-hop kernels)
+    "tc_q4_block": (_sz, []),
+    "tc_q4_wire_bytes": (_sz, [_sz]),
+    "tc_q4_encode": (_int, [_c, _sz, _c, _sz]),
+    "tc_q4_decode": (_int, [_c, _sz, _c, _sz]),
+    # sharded codec surface: the pool-sharded kernels the pipelined wire
+    # rings run (kind: 0 = bf16, 1 = q8, 2 = q4)
+    "tc_codec_threads": (_int, []),
+    "tc_codec_pipeline": (_int, []),
+    "tc_codec_encode_sharded": (_int, [_int, _c, _sz, _c, _sz, _sz]),
+    "tc_codec_accumulate_sharded": (_int, [_int, _c, _c, _sz, _sz, _sz]),
     # async collective engine + work handles
     "tc_async_new": (_c, [_c, _int, _u32]),
     "tc_async_shutdown": (_int, [_c]),

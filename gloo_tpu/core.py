@@ -38,6 +38,12 @@ __all__ = [
     "TimeoutError",
     "UnboundBuffer",
     "Work",
+    "codec_pipeline",
+    "codec_threads",
+    "q4_block",
+    "q4_decode",
+    "q4_encode",
+    "q4_wire_bytes",
     "q8_block",
     "q8_decode",
     "q8_encode",
@@ -332,6 +338,69 @@ def q8_decode(wire: np.ndarray, count: int) -> np.ndarray:
     out = np.empty(count, dtype=np.float32)
     check(_lib.lib.tc_q8_decode(_ptr(wire), wire.nbytes, _ptr(out), count))
     return out
+
+
+def q4_block() -> int:
+    """Resolved TPUCOLL_Q4_BLOCK: elements per q4 wire block (default
+    256). Must match on every rank, like TPUCOLL_Q8_BLOCK."""
+    block = int(_lib.lib.tc_q4_block())
+    if block == 0:
+        raise Error(_lib.last_error())
+    return block
+
+
+def q4_wire_bytes(count: int) -> int:
+    """Wire bytes a `count`-element float32 stream occupies in the q4
+    codec: one float32 scale per block plus one packed-nibble byte per
+    element pair."""
+    nbytes = int(_lib.lib.tc_q4_wire_bytes(count))
+    if nbytes == 0 and count > 0:
+        raise Error(_lib.last_error())
+    return nbytes
+
+
+def q4_encode(array: np.ndarray) -> np.ndarray:
+    """Encode a float32 array into its q4 wire stream (uint8 array) —
+    the exact per-hop codec AllreduceAlgorithm ring_q4_wire runs.
+    Round-trip error is bounded by max|block| / 14 per block."""
+    _check_array(array)
+    if array.dtype != np.float32:
+        raise Error("q4_encode requires a float32 array")
+    out = np.empty(q4_wire_bytes(array.size), dtype=np.uint8)
+    check(_lib.lib.tc_q4_encode(_ptr(array), array.size, _ptr(out),
+                                out.nbytes))
+    return out
+
+
+def q4_decode(wire: np.ndarray, count: int) -> np.ndarray:
+    """Decode a q4 wire stream (uint8 array from q4_encode) back to
+    `count` float32 elements."""
+    _check_array(wire, "wire")
+    if wire.dtype != np.uint8:
+        raise Error("q4_decode requires a uint8 wire array")
+    out = np.empty(count, dtype=np.float32)
+    check(_lib.lib.tc_q4_decode(_ptr(wire), wire.nbytes, _ptr(out), count))
+    return out
+
+
+def codec_threads() -> int:
+    """Resolved TPUCOLL_CODEC_THREADS: codec pool width the wire rings
+    shard encode/dequant-accumulate across (defaults to
+    TPUCOLL_LOOP_THREADS). Sharding is byte-identical to serial."""
+    n = int(_lib.lib.tc_codec_threads())
+    if n == 0:
+        raise Error(_lib.last_error())
+    return n
+
+
+def codec_pipeline() -> int:
+    """Resolved TPUCOLL_CODEC_PIPELINE: sub-blocks each wire-ring hop is
+    split into so encode of block k+1 overlaps transmission of block k.
+    Must match on every rank (it shapes the per-hop wire protocol)."""
+    n = int(_lib.lib.tc_codec_pipeline())
+    if n == 0:
+        raise Error(_lib.last_error())
+    return n
 
 
 def uring_available() -> bool:
@@ -1411,14 +1480,16 @@ class Context:
                    "hd_fold": 6, "hd_blocks": 7,
                    "ring_q8_wire": 8, "q8": 8,
                    "auto_lossy_wire": 9, "auto_lossy": 9,
-                   "hier": 10}
+                   "hier": 10,
+                   "ring_q4_wire": 11, "q4": 11}
     _REDUCE_ALGORITHMS = {"auto": 0, "binomial": 1, "ring": 2}
 
     # wire= shorthand -> allreduce algorithm. The q8/bf16 codecs are
     # float32-sum-only opt-ins (docs/algorithms.md precision contract);
     # "lossy" keeps auto dispatch but allows the tuning table to elect a
     # wire codec (auto_lossy_wire).
-    _WIRE_ALGORITHMS = {"q8": "ring_q8_wire", "bf16": "ring_bf16_wire",
+    _WIRE_ALGORITHMS = {"q8": "ring_q8_wire", "q4": "ring_q4_wire",
+                        "bf16": "ring_bf16_wire",
                         "lossy": "auto_lossy_wire"}
 
     @classmethod
@@ -1440,19 +1511,20 @@ class Context:
 
     @classmethod
     def _resolve_rs_wire(cls, wire, algorithm):
-        """reduce_scatter's wire= shorthand (q8 is its only codec) —
+        """reduce_scatter's wire= shorthand (q8 and q4 are its codecs) —
         the single validation both the blocking and async entries use."""
         if wire is None:
             return algorithm
-        if wire != "q8":
-            raise Error(f"reduce_scatter wire= supports only 'q8', "
-                        f"got {wire!r}")
+        if wire not in ("q8", "q4"):
+            raise Error(f"reduce_scatter wire= supports only 'q8' or "
+                        f"'q4', got {wire!r}")
+        mapped = f"ring_{wire}_wire"
         if (algorithm != "auto" and
                 cls._RS_ALGORITHMS.get(algorithm) !=
-                cls._RS_ALGORITHMS["ring_q8_wire"]):
-            raise Error(f"wire='q8' conflicts with "
+                cls._RS_ALGORITHMS[mapped]):
+            raise Error(f"wire={wire!r} conflicts with "
                         f"algorithm={algorithm!r}")
-        return "ring_q8_wire"
+        return mapped
 
     def allreduce(self, array: np.ndarray, op="sum", algorithm: str = "auto",
                   tag: int = 0,
@@ -1468,12 +1540,14 @@ class Context:
         Explicit choices: "ring", "halving_doubling" ("hd"),
         "recursive_doubling" ("rd"; non-power-of-2 groups take a
         pre/post fold), "hd_fold" / "hd_blocks" (the halving-doubling
-        non-power-of-2 sub-variants), "bcube", "ring_bf16_wire", or
-        "ring_q8_wire" (int8 block-quantized wire, TPUCOLL_Q8_BLOCK).
+        non-power-of-2 sub-variants), "bcube", "ring_bf16_wire",
+        "ring_q8_wire" (int8 block-quantized wire, TPUCOLL_Q8_BLOCK), or
+        "ring_q4_wire" (packed-nibble int4 wire, TPUCOLL_Q4_BLOCK —
+        coarsest codec, tuner-elected only under auto dispatch).
 
-        wire: opt-in wire compression shorthand — "q8" / "bf16" force
-        the matching codec (float32 sum only; all ranks still receive
-        bit-identical results), "lossy" keeps auto dispatch but lets the
+        wire: opt-in wire compression shorthand — "q8" / "q4" / "bf16"
+        force the matching codec (float32 sum only; all ranks still
+        receive bit-identical results), "lossy" keeps auto dispatch but lets the
         installed tuning table elect a wire codec when one measures
         faster ("auto_lossy_wire"). See docs/algorithms.md for the
         precision contract (per-hop requantization error grows with the
@@ -1698,7 +1772,8 @@ class Context:
 
     _RS_ALGORITHMS = {"auto": 0, "ring": 1, "halving_doubling": 2,
                       "hd": 2, "direct": 3, "ring_q8_wire": 4, "q8": 4,
-                      "hier": 5}
+                      "hier": 5,
+                      "ring_q4_wire": 6, "q4": 6}
 
     def reduce_scatter(self, array: np.ndarray,
                        recv_counts: Optional[Sequence[int]] = None,
@@ -1715,11 +1790,11 @@ class Context:
         picks it when TPUCOLL_RS_DIRECT_MAX is raised from its default
         0; meant for real DCN, it loses on shared-core loopback, and a
         tuned table elects it from measurement), "halving_doubling"/
-        "hd", "ring", or "ring_q8_wire" (int8 block-quantized wire,
-        float32 sum only — wire="q8" is the shorthand; only the hops
-        are quantized, each rank's result block is the float32
-        accumulator). On error the returned array's contents are
-        undefined (in-place folds; docs/errors.md).
+        "hd", "ring", "ring_q8_wire", or "ring_q4_wire" (block-quantized
+        wire, float32 sum only — wire="q8" / wire="q4" are the
+        shorthands; only the hops are quantized, each rank's result
+        block is the float32 accumulator). On error the returned
+        array's contents are undefined (in-place folds; docs/errors.md).
 
         output: optional preallocated result array (dtype of `array`,
         recv_counts[rank] elements) — avoids the per-call allocation and

@@ -1,6 +1,8 @@
 // Fixture: kDecode is declared but interpreter.cc never lowers it and
 // ir.cc never names it; verifier.cc handles a kGhost op that no longer
-// exists.
+// exists. Step::pipeline is declared but ir.cc only parses it (no
+// toJson emit), and Step::ghost_attr is never round-tripped at all;
+// Step::flags is emitted AND parsed, so it stays quiet.
 #pragma once
 #include <cstdint>
 
@@ -11,6 +13,14 @@ enum class StepOp : uint8_t {
   kSend = 0,
   kRecv = 1,
   kDecode = 2,
+};
+
+struct Step {
+  StepOp op{StepOp::kSend};
+  static constexpr uint8_t kFlagToSlot = 1;  // constant: not state
+  uint8_t flags{0};
+  int32_t pipeline{1};
+  int32_t ghost_attr{0};
 };
 
 }  // namespace schedule

@@ -238,12 +238,14 @@ def test_bench_profile_smoke():
 
 
 def test_bench_wire_sweep_smoke():
-    """bench.py --wire-sweep --quick (2 ranks): one valid JSON
-    measurement line per wire-codec arm — the crossover data the lossy
-    auto dispatch (auto_lossy_wire) is elected from. Values are not
-    ranked: on a shared-core CI host the codec arms' CPU cost can
-    legitimately beat their wire savings; each run self-verifies its
-    reduced values before timing."""
+    """bench.py --wire-sweep --quick (2 ranks): the four sections the
+    committed WIRE_r20.json is built from — the wire grid (one line per
+    codec arm, the crossover data auto_lossy_wire is elected from), the
+    pipelined-vs-serial interleaved A/B, the codec-thread scaling curve,
+    and the phase-attribution A/B with its pack+unpack cut line. Values
+    are not ranked: on a shared-core CI host the codec arms' CPU cost
+    can legitimately beat their wire savings; each run self-verifies
+    its reduced values before timing."""
     import json
 
     proc = subprocess.run(
@@ -253,14 +255,27 @@ def test_bench_wire_sweep_smoke():
     assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
     lines = [json.loads(l) for l in proc.stdout.strip().splitlines()
              if l.startswith("{")]
-    assert len(lines) == 3, proc.stdout
-    algos = set()
+    by_metric = {}
     for line in lines:
-        assert line["metric"] == "wire_sweep"
         assert line["ok"] is True, line
-        assert line["value"] > 0
-        algos.add(line["algorithm"])
-    assert algos == {"ring", "ring_bf16_wire", "ring_q8_wire"}
+        by_metric.setdefault(line["metric"], []).append(line)
+    grid = by_metric.pop("wire_sweep")
+    assert {c["algorithm"] for c in grid} == {
+        "ring", "ring_bf16_wire", "ring_q8_wire", "ring_q4_wire"}
+    assert all(c["value"] > 0 for c in grid)
+    ab = by_metric.pop("wire_pipeline_ab")
+    assert {(c["algorithm"], c["arm"]) for c in ab} == {
+        (a, arm) for a in ("ring_q8_wire", "ring_q4_wire")
+        for arm in ("serial", "pipelined")}
+    threads = by_metric.pop("wire_codec_threads")
+    assert sorted(c["codec_threads"] for c in threads) == [1, 2, 4]
+    phases = by_metric.pop("wire_phase_ab")
+    assert {c["arm"] for c in phases} == {"serial", "pipelined"}
+    assert all(c["mean_phase_us"] for c in phases)
+    (cut,) = by_metric.pop("wire_phase_cut")
+    assert cut["pack_unpack_us"]["serial"] > 0
+    assert cut["pack_unpack_us"]["pipelined"] > 0
+    assert not by_metric, by_metric
 
 
 def test_bench_bootstrap_sweep_smoke():

@@ -974,6 +974,116 @@ def test_asan_lazy_bootstrap_smoke():
     assert "LAZY-BOOT-SMOKE-OK" in result.stdout, result.stdout
 
 
+_WIRE_PIPE_PROG = f"""
+import sys, threading
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import gloo_tpu
+
+size = 3
+store = gloo_tpu.HashStore()
+errors = []
+
+def worker(rank):
+    try:
+        ctx = gloo_tpu.Context(rank, size, timeout=60)
+        ctx.connect_full_mesh(store, gloo_tpu.Device())
+        total = size * (size + 1) / 2
+        # Repeated pipelined q8/q4 allreduces on ONE buffer: a cached
+        # plan replays the codec-pool fan-out and the slot-3 residual
+        # arena every call.
+        x = np.empty(3 * 256 * 5 + 17, dtype=np.float32)
+        for i in range(8):
+            x[:] = rank + 1
+            ctx.allreduce(x, algorithm="ring_q8_wire", tag=1)
+            assert abs(x[0] - total) < 0.1, (i, x[0])
+        for i in range(4):
+            x[:] = rank + 1
+            ctx.allreduce(x, algorithm="ring_q4_wire", tag=2)
+            assert abs(x[0] - total) < 0.5, (i, x[0])
+        counts = [600, 700, 800]
+        y = np.empty(sum(counts), dtype=np.float32)
+        for i in range(4):
+            y[:] = rank + 1
+            out = ctx.reduce_scatter(y, recv_counts=counts, wire="q8",
+                                     tag=3)
+            assert abs(out[0] - total) < 0.1, (i, out[0])
+        ctx.barrier(tag=9)
+        ctx.close()
+    except BaseException as e:
+        errors.append((rank, repr(e)))
+
+threads = [threading.Thread(target=worker, args=(r,)) for r in range(size)]
+[t.start() for t in threads]
+[t.join(240) for t in threads]
+assert not errors, errors
+print("WIRE-PIPE-SMOKE-OK")
+"""
+
+
+def test_asan_wire_pipeline_smoke():
+    """Skip-unless-built ASan smoke of the pipelined wire codec engine:
+    3 ranks running q8/q4 allreduces and a wire reduce_scatter with the
+    codec pool wide (TPUCOLL_CODEC_THREADS=4) and a deep hop pipeline
+    (TPUCOLL_CODEC_PIPELINE=6) on cached plans — the async encode jobs
+    writing tx staging, the decode-on-arrival jobs writing the work
+    buffer, and the plan-persistent EF residual arena are the
+    memory-shape code under test."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_asan.so")
+    if not os.path.exists(lib):
+        pytest.skip("ASan flavor not built (make native SANITIZE=address)")
+    env = _sanitizer_env(("libasan.so", "libstdc++.so"), lib,
+                         {"ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+                          "TPUCOLL_CODEC_THREADS": "4",
+                          "TPUCOLL_CODEC_PIPELINE": "6"})
+    result = subprocess.run([sys.executable, "-c", _WIRE_PIPE_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "WIRE-PIPE-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_ubsan_wire_pipeline_smoke():
+    """UBSan flavor of the pipelined-wire smoke: the nibble pack/unpack
+    bit twiddling and the scale divisions are int-width/shift territory
+    (-fno-sanitize-recover: the first UB hit aborts the child)."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native",
+                       "libtpucoll_ubsan.so")
+    if not os.path.exists(lib):
+        pytest.skip(
+            "UBSan flavor not built (make native SANITIZE=undefined)")
+    env = _sanitizer_env(("libubsan.so", "libstdc++.so"), lib,
+                         {"TPUCOLL_CODEC_THREADS": "4",
+                          "TPUCOLL_CODEC_PIPELINE": "6"})
+    result = subprocess.run([sys.executable, "-c", _WIRE_PIPE_PROG],
+                            capture_output=True, text=True, timeout=420,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "WIRE-PIPE-SMOKE-OK" in result.stdout, result.stdout
+
+
+def test_tsan_wire_pipeline_smoke():
+    """TSan flavor — the one that earns its keep here: pool workers
+    claim shards off the shared atomic counter while the op thread
+    encodes alongside them, async sub-block encode tickets race the
+    sends that publish them, and decode-on-arrival jobs write disjoint
+    work-buffer spans concurrently. Any missing happens-before edge in
+    the ticket/wait protocol is exactly what this run must surface."""
+    lib = os.path.join(_REPO, "gloo_tpu", "_native", "libtpucoll_tsan.so")
+    if not os.path.exists(lib):
+        pytest.skip("TSan flavor not built (make native SANITIZE=thread)")
+    env = _sanitizer_env(("libtsan.so", "libstdc++.so"), lib,
+                         {"TSAN_OPTIONS": "halt_on_error=1 "
+                          "report_signal_unsafe=0 history_size=7",
+                          "TPUCOLL_CODEC_THREADS": "4",
+                          "TPUCOLL_CODEC_PIPELINE": "6"})
+    result = subprocess.run([sys.executable, "-c", _WIRE_PIPE_PROG],
+                            capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert result.returncode == 0, (result.stdout, result.stderr[-3000:])
+    assert "WIRE-PIPE-SMOKE-OK" in result.stdout, result.stdout
+
+
 def test_tsan_lazy_bootstrap_smoke():
     """TSan flavor of the lazy bootstrap smoke: concurrent first-use
     dials, context-level recv matching against rx-only inbound pairs,

@@ -110,6 +110,43 @@ def test_q8_hop_error_growth_bound():
     assert np.all(np.abs(final - exact) <= bound * slack + 1e-6)
 
 
+def test_q8_hop_error_growth_bound_with_error_feedback():
+    """Error-feedback variant of the hop walk above (the recurrence
+    wire_ring.cc applies at every origin encode under TPUCOLL_WIRE_EF):
+    each hop encodes (input + residual) and carries the new residual.
+    Errors telescope — the residual itself IS the deviation, so the
+    running sum stays within ~one hop's half-step of exact instead of
+    the h-hop linear bound, no matter how many hops the walk takes."""
+    rng = np.random.default_rng(7)
+    parts = [rng.standard_normal(4 * BLOCK).astype(np.float32)
+             for _ in range(24)]
+
+    def walk(with_ef):
+        exact = parts[0].astype(np.float64).copy()
+        acc = parts[0].copy()
+        res = np.zeros_like(acc)
+        worst = 0.0
+        for part in parts[1:]:
+            t = acc + res if with_ef else acc
+            decoded = gloo_tpu.q8_decode(gloo_tpu.q8_encode(t), t.size)
+            if with_ef:
+                res = t - decoded
+            acc = decoded + part
+            exact += part.astype(np.float64)
+            worst = max(worst, np.abs(acc - exact).max())
+        return worst
+
+    one_hop = max(np.abs(np.sum(parts[:k], axis=0)).max() / 254.0
+                  for k in range(1, len(parts) + 1))
+    ef_worst = walk(True)
+    plain_worst = walk(False)
+    # EF: bounded by ~2 half-steps of the largest magnitude seen,
+    # independent of hop count (residual + current hop's rounding).
+    assert ef_worst <= 2.5 * one_hop, (ef_worst, one_hop)
+    # And measurably tighter than the unfed walk over 23 hops.
+    assert ef_worst < plain_worst / 2, (ef_worst, plain_worst)
+
+
 def test_q8_encode_type_checks():
     with pytest.raises(Error):
         gloo_tpu.q8_encode(np.zeros(8, dtype=np.float64))

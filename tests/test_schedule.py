@@ -162,6 +162,57 @@ def test_verifier_rejects_unsynchronized_wire_hazard():
         schedule.verify(bad)
 
 
+def test_verifier_rejects_pipeline_on_non_codec_step():
+    """The pipeline-depth attribute names a codec sub-block walk; on a
+    wire or fold step there is nothing to split, so the verifier
+    rejects it instead of silently ignoring the attribute."""
+    bad = _fixture(_GOOD_C0 + [
+        {"op": "send", "peer": RING, "chunk": 1, "pipeline": 4,
+         "note": "piped_send"},
+        {"op": "recv", "peer": RING, "chunk": 1, "slot": 1},
+        {"op": "reduce_local", "chunk": 1, "slot": 1, "deps": [3, 4]},
+    ])
+    with pytest.raises(gloo_tpu.Error) as ei:
+        schedule.verify(bad)
+    assert "pipeline depth only applies to encode/decode" in str(ei.value)
+    assert "piped_send" in str(ei.value)
+
+
+def test_verifier_rejects_pipeline_out_of_range():
+    """Depth 0 and depths beyond the engine ceiling (kMaxPipelineDepth
+    = 32) fail at parse/verify, not at lowering."""
+    for depth in (0, 33):
+        bad = _fixture(_GOOD_C0 + [
+            {"op": "send", "peer": RING, "chunk": 1},
+            {"op": "recv", "peer": RING, "chunk": 1, "slot": 1,
+             "pipeline": depth},
+            {"op": "reduce_local", "chunk": 1, "slot": 1, "deps": [3, 4]},
+        ])
+        with pytest.raises(gloo_tpu.Error, match="pipeline"):
+            schedule.verify(bad)
+
+
+def test_pipeline_attribute_round_trips_on_codec_steps():
+    """pipeline > 1 on encode/decode verifies and survives the JSON
+    round trip (omit-default emit: depth 1 disappears)."""
+    t = schedule.generate("ring_bf16", 2)
+    piped = 0
+    for st in t["schedules"][0]["steps"]:
+        if st["op"] in ("encode", "decode"):
+            st["pipeline"] = 4
+            piped += 1
+    assert piped > 0
+    schedule.verify(t)
+    ctx = gloo_tpu.Context(0, 2)
+    schedule.install(ctx, t)
+    back = schedule.installed(ctx)
+    for st in back["schedules"][0]["steps"]:
+        if st["op"] in ("encode", "decode"):
+            assert st["pipeline"] == 4
+        else:
+            assert "pipeline" not in st
+
+
 def test_verify_accepts_correct_fixture():
     full = _GOOD_C0 + [
         {"op": "send", "peer": RING, "chunk": 1},

@@ -184,7 +184,9 @@ def test_fixture_lock_order():
 def test_fixture_schedule_step_coverage():
     """A declared op the interpreter never lowers (or ir.cc never
     names) fires; a case for a removed op is reported stale; handled
-    ops stay quiet."""
+    ops stay quiet. Step attributes: a member ir.cc only half
+    round-trips (parse without emit) or never touches fires; a fully
+    round-tripped member and a static constexpr constant stay quiet."""
     keys = _keys(_fixture_report("schedule_step_coverage",
                                  ["schedule-step-coverage"]))
     assert ("unhandled:csrc/tpucoll/schedule/interpreter.cc:kDecode"
@@ -192,6 +194,11 @@ def test_fixture_schedule_step_coverage():
     assert "unhandled:csrc/tpucoll/schedule/ir.cc:kDecode" in keys
     assert "stale:csrc/tpucoll/schedule/verifier.cc:kGhost" in keys
     assert not any("kSend" in k or "kRecv" in k for k in keys), keys
+    assert "unserialized:pipeline" in keys
+    assert "unserialized:ghost_attr" in keys
+    assert "unserialized:op" not in keys, keys
+    assert "unserialized:flags" not in keys, keys
+    assert "unserialized:kFlagToSlot" not in keys, keys
 
 
 def test_fixture_asserts():

@@ -1,0 +1,494 @@
+"""Pipelined wire codec (ISSUE 20): the sharded codec pool's
+byte-identity contract across shard counts and ragged tails, the q4
+packed-nibble codec's layout and round-trip error bound, error-feedback
+residual convergence, pipelined-vs-serial A/B byte identity, P=2..4
+consensus for the new arms (ring_q4_wire allreduce + reduce_scatter,
+pipelined q8), and same-seed chaos determinism with the codec pool on.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import gloo_tpu
+from gloo_tpu import _lib
+from gloo_tpu._lib import Error
+
+from tests.harness import spawn
+
+BLOCK = 256  # default TPUCOLL_Q4_BLOCK / TPUCOLL_Q8_BLOCK
+
+# Codec kinds of the sharded capi surface (wire_codec.h ids).
+KIND_BF16, KIND_Q8, KIND_Q4 = 0, 1, 2
+
+
+def _ptr(a):
+    return a.ctypes.data
+
+
+# ---------------------------------------------------------------------------
+# Sharded codec surface: byte identity against the serial walk
+# ---------------------------------------------------------------------------
+
+def _serial_encode(kind, x):
+    if kind == KIND_Q8:
+        return gloo_tpu.q8_encode(x)
+    if kind == KIND_Q4:
+        return gloo_tpu.q4_encode(x)
+    # bf16: round-to-nearest-even via float32 truncation-with-rounding —
+    # jax/ml_dtypes-free reference: float32 -> uint32 -> rounded high half.
+    u = x.view(np.uint32)
+    rounded = (u + 0x7FFF + ((u >> 16) & 1)) >> 16
+    return rounded.astype(np.uint16).view(np.uint8).copy()
+
+
+@pytest.mark.parametrize("kind", [KIND_BF16, KIND_Q8, KIND_Q4])
+@pytest.mark.parametrize("n", [1, 7, BLOCK - 1, BLOCK, BLOCK + 1,
+                               4 * BLOCK + 13, 16 * BLOCK + 255])
+def test_sharded_encode_byte_identity(kind, n):
+    """tc_codec_encode_sharded output is byte-identical to the serial
+    codec for EVERY shard count, including shards > units and ragged
+    tails — the contract the pipelined rings ride on."""
+    rng = np.random.default_rng(n)
+    x = (rng.standard_normal(n) *
+         10.0 ** rng.integers(-2, 3, size=n)).astype(np.float32)
+    ref = _serial_encode(kind, x)
+    for shards in (1, 2, 3, 4, 7, 16, 64):
+        dst = np.zeros(ref.nbytes, dtype=np.uint8)
+        rc = _lib.lib.tc_codec_encode_sharded(
+            kind, _ptr(x), n, _ptr(dst), dst.nbytes, shards)
+        assert rc == 0, _lib.last_error()
+        assert bytes(dst) == bytes(ref), (kind, n, shards)
+
+
+@pytest.mark.parametrize("kind", [KIND_Q8, KIND_Q4])
+@pytest.mark.parametrize("n", [1, BLOCK, 4 * BLOCK + 13])
+def test_sharded_accumulate_byte_identity(kind, n):
+    """tc_codec_accumulate_sharded == decode + add, bit-exactly, for any
+    shard count (the fused dequant-accumulate the RS hops run)."""
+    rng = np.random.default_rng(n + 1)
+    x = rng.standard_normal(n).astype(np.float32)
+    base = rng.standard_normal(n).astype(np.float32)
+    wire = gloo_tpu.q8_encode(x) if kind == KIND_Q8 else gloo_tpu.q4_encode(x)
+    decoded = (gloo_tpu.q8_decode(wire, n) if kind == KIND_Q8
+               else gloo_tpu.q4_decode(wire, n))
+    ref = base + decoded
+    for shards in (1, 2, 5, 32):
+        acc = base.copy()
+        rc = _lib.lib.tc_codec_accumulate_sharded(
+            kind, _ptr(acc), _ptr(wire), n, wire.nbytes, shards)
+        assert rc == 0, _lib.last_error()
+        assert np.array_equal(acc, ref), (kind, n, shards)
+
+
+def test_sharded_surface_size_echo_and_kind_checks():
+    x = np.ones(100, dtype=np.float32)
+    dst = np.zeros(gloo_tpu.q8_wire_bytes(100), dtype=np.uint8)
+    # Wrong dstBytes echo fails loudly (stale-caller guard, q8 idiom).
+    assert _lib.lib.tc_codec_encode_sharded(
+        KIND_Q8, _ptr(x), 100, _ptr(dst), dst.nbytes - 1, 1) != 0
+    # Unknown kind fails loudly.
+    assert _lib.lib.tc_codec_encode_sharded(
+        9, _ptr(x), 100, _ptr(dst), dst.nbytes, 1) != 0
+    assert int(_lib.lib.tc_codec_threads()) >= 1
+    assert 1 <= int(_lib.lib.tc_codec_pipeline()) <= 32
+
+
+def test_codec_knob_resolution():
+    """TPUCOLL_CODEC_THREADS defaults to TPUCOLL_LOOP_THREADS; both it
+    and TPUCOLL_CODEC_PIPELINE resolve strictly in range."""
+    code = ("import gloo_tpu; "
+            "print(gloo_tpu.codec_threads(), gloo_tpu.codec_pipeline())")
+    env = dict(os.environ, TPUCOLL_LOOP_THREADS="3",
+               TPUCOLL_CODEC_PIPELINE="8", TPUCOLL_SKIP_BUILD="1")
+    env.pop("TPUCOLL_CODEC_THREADS", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    threads, depth = map(int, out.stdout.split())
+    assert threads == 3 and depth == 8
+
+    env = dict(os.environ, TPUCOLL_CODEC_THREADS="5",
+               TPUCOLL_SKIP_BUILD="1")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    assert int(out.stdout.split()[0]) == 5
+
+    for knob, bad in (("TPUCOLL_CODEC_THREADS", "0"),
+                      ("TPUCOLL_CODEC_THREADS", "banana"),
+                      ("TPUCOLL_CODEC_PIPELINE", "0"),
+                      ("TPUCOLL_CODEC_PIPELINE", "33")):
+        env = dict(os.environ, TPUCOLL_SKIP_BUILD="1", **{knob: bad})
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True)
+        assert r.returncode != 0, (knob, bad)
+        assert knob in r.stderr, r.stderr[-300:]
+
+
+# ---------------------------------------------------------------------------
+# q4 codec properties
+# ---------------------------------------------------------------------------
+
+def test_q4_block_default_and_layout():
+    assert gloo_tpu.q4_block() == BLOCK
+    # One f32 scale per block plus one byte per element PAIR (dangling
+    # odd element still costs a byte, high nibble zero).
+    assert gloo_tpu.q4_wire_bytes(0) == 0
+    assert gloo_tpu.q4_wire_bytes(1) == 4 + 1
+    assert gloo_tpu.q4_wire_bytes(2) == 4 + 1
+    assert gloo_tpu.q4_wire_bytes(3) == 4 + 2
+    assert gloo_tpu.q4_wire_bytes(BLOCK) == 4 + BLOCK // 2
+    assert gloo_tpu.q4_wire_bytes(BLOCK + 1) == 2 * 4 + BLOCK // 2 + 1
+    assert gloo_tpu.q4_wire_bytes(10 * BLOCK) == 10 * (4 + BLOCK // 2)
+    # Half of q8's wire for block-aligned streams.
+    assert (gloo_tpu.q4_wire_bytes(8 * BLOCK) - 8 * 4 ==
+            (gloo_tpu.q8_wire_bytes(8 * BLOCK) - 8 * 4) // 2)
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, BLOCK - 1, BLOCK, BLOCK + 1,
+                               4 * BLOCK + 13])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_q4_roundtrip_error_bound(n, seed):
+    """Property: per element, |x - decode(encode(x))| <= max|block|/14
+    (half a quantization step at scale = max|block|/7), modulo one ulp
+    of slack for the scale division rounding."""
+    rng = np.random.default_rng(seed)
+    x = (rng.standard_normal(n) *
+         10.0 ** rng.integers(-3, 4, size=n)).astype(np.float32)
+    wire = gloo_tpu.q4_encode(x)
+    assert wire.nbytes == gloo_tpu.q4_wire_bytes(n)
+    y = gloo_tpu.q4_decode(wire, n)
+    for start in range(0, n, BLOCK):
+        blk = x[start:start + BLOCK]
+        bound = np.abs(blk).max() / 14.0 * (1 + 1e-6)
+        err = np.abs(blk - y[start:start + BLOCK]).max()
+        assert err <= bound, (start, err, bound)
+
+
+def test_q4_zero_block_exact_and_type_checks():
+    z = np.zeros(2 * BLOCK + 5, dtype=np.float32)
+    assert np.array_equal(gloo_tpu.q4_decode(gloo_tpu.q4_encode(z), z.size),
+                          z)
+    with pytest.raises(Error):
+        gloo_tpu.q4_encode(np.zeros(8, dtype=np.float64))
+    with pytest.raises(Error):
+        gloo_tpu.q4_decode(np.zeros(8, dtype=np.float32), 4)
+
+
+def test_q4_block_env_knob():
+    code = ("import gloo_tpu; "
+            "print(gloo_tpu.q4_block(), gloo_tpu.q4_wire_bytes(1000))")
+    env = dict(os.environ, TPUCOLL_Q4_BLOCK="512", TPUCOLL_SKIP_BUILD="1")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    block, wire = map(int, out.stdout.split())
+    assert block == 512 and wire == 2 * 4 + 500
+    for bad in ("0", "7", "4096", "banana"):
+        env = dict(os.environ, TPUCOLL_Q4_BLOCK=bad, TPUCOLL_SKIP_BUILD="1")
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True)
+        assert r.returncode != 0, bad
+        assert "TPUCOLL_Q4_BLOCK" in r.stderr, r.stderr[-300:]
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback residuals
+# ---------------------------------------------------------------------------
+
+def test_error_feedback_telescopes_repeated_encodes():
+    """The EF recurrence, proven on the codec itself: encoding the SAME
+    vector k times without feedback accumulates k independent rounding
+    errors in the summed stream, while with feedback (encode x + res,
+    res = input - decoded) the summed decodes telescope to within ONE
+    rounding of k*x — the mechanism wire_ring.cc applies per hop."""
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal(4 * BLOCK).astype(np.float32)
+    k = 32
+    step_bound = np.abs(x).max() / 254.0
+
+    plain = np.zeros_like(x, dtype=np.float64)
+    for _ in range(k):
+        plain += gloo_tpu.q8_decode(gloo_tpu.q8_encode(x), x.size)
+    plain_err = np.abs(plain - k * x.astype(np.float64)).max()
+
+    ef = np.zeros_like(x, dtype=np.float64)
+    res = np.zeros_like(x)
+    for _ in range(k):
+        t = x + res
+        d = gloo_tpu.q8_decode(gloo_tpu.q8_encode(t), x.size)
+        res = t - d
+        ef += d
+    ef_err = np.abs(ef - k * x.astype(np.float64)).max()
+
+    # Same input every round -> the plain rounding error is deterministic
+    # and accumulates linearly (unless x happens to be exactly
+    # representable); EF stays within ~2 single-step bounds no matter
+    # how large k grows.
+    assert ef_err <= 2.5 * step_bound, (ef_err, step_bound)
+    assert ef_err < plain_err / 4, (ef_err, plain_err)
+
+
+def test_error_feedback_tightens_native_allreduce():
+    """The native engine, A/B over TPUCOLL_WIRE_EF: repeated q8
+    allreduces of the same gradient on a cached plan accumulate bias
+    without EF and telescope with it. Measured end to end through the
+    collective, not the codec. The buffer is reused so the plan (and
+    its slot-3 residual) survives between calls — the SGD regime EF
+    targets; a fresh buffer per call makes EF a deliberate no-op."""
+    code = """
+import sys, threading
+import numpy as np
+import gloo_tpu
+store = gloo_tpu.HashStore()
+out = [None]
+STEPS, COUNT = 24, 4 * 256
+def worker(rank):
+    ctx = gloo_tpu.Context(rank, 2, timeout=60)
+    ctx.connect_full_mesh(store, gloo_tpu.Device())
+    g = (np.random.default_rng(9).standard_normal(COUNT)
+         .astype(np.float32) * (rank + 1))
+    x = np.empty_like(g)  # ONE buffer: cached plan keeps the residual
+    total = np.zeros(COUNT, dtype=np.float64)
+    for _ in range(STEPS):
+        x[:] = g
+        ctx.allreduce(x, algorithm="ring_q8_wire")
+        total += x
+    if rank == 0:
+        exact = (np.random.default_rng(9).standard_normal(COUNT)
+                 .astype(np.float64) * 3 * STEPS)
+        print("ERR", np.abs(total - exact).max())
+    ctx.barrier(); ctx.close()
+ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+[t.start() for t in ts]; [t.join(120) for t in ts]
+"""
+    errs = {}
+    for ef in ("0", "1"):
+        env = dict(os.environ, TPUCOLL_WIRE_EF=ef, TPUCOLL_SKIP_BUILD="1")
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, r.stderr[-500:]
+        errs[ef] = float(r.stdout.split("ERR", 1)[1].split()[0])
+    # EF must measurably tighten the accumulated error (acceptance
+    # criterion); 2x is conservative — the bias mechanism gives ~10x+.
+    assert errs["1"] < errs["0"] / 2, errs
+
+
+# ---------------------------------------------------------------------------
+# Pipelined hop: A/B byte identity + consensus for the new arms
+# ---------------------------------------------------------------------------
+
+_AB_CODE = """
+import sys, threading
+import numpy as np
+import gloo_tpu
+algo = sys.argv[1]
+count = int(sys.argv[2])
+store = gloo_tpu.HashStore()
+out = [None] * 3
+def worker(rank):
+    ctx = gloo_tpu.Context(rank, 3, timeout=60)
+    ctx.connect_full_mesh(store, gloo_tpu.Device())
+    x = (np.random.default_rng(5).standard_normal(count)
+         .astype(np.float32) * (rank + 1))
+    ctx.allreduce(x, algorithm=algo)
+    out[rank] = x
+    ctx.barrier(); ctx.close()
+ts = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+[t.start() for t in ts]; [t.join(120) for t in ts]
+assert all(o is not None for o in out)
+assert np.array_equal(out[0], out[1]) and np.array_equal(out[0], out[2])
+sys.stdout.buffer.write(out[0].tobytes())
+"""
+
+
+@pytest.mark.parametrize("algo,count", [
+    ("ring_q8_wire", 3 * BLOCK * 7),   # block-aligned: fused arm engages
+    ("ring_q8_wire", 10_007),          # ragged sub-spans
+    ("ring_q4_wire", 3 * BLOCK * 7),
+    ("ring_bf16_wire", 9_999),
+])
+def test_pipeline_depth_is_invisible_in_the_bytes(algo, count):
+    """The pipelined engine's core contract: depth (and codec pool
+    width) change WHO computes and WHEN bytes move, never the bytes.
+    Depth 1 serial vs depth 8 with a 4-wide pool: identical results."""
+    blobs = {}
+    for label, extra in (
+            ("serial", {"TPUCOLL_CODEC_PIPELINE": "1",
+                        "TPUCOLL_CODEC_THREADS": "1"}),
+            ("piped", {"TPUCOLL_CODEC_PIPELINE": "8",
+                       "TPUCOLL_CODEC_THREADS": "4"})):
+        env = dict(os.environ, TPUCOLL_SKIP_BUILD="1", **extra)
+        r = subprocess.run(
+            [sys.executable, "-c", _AB_CODE, algo, str(count)],
+            env=env, capture_output=True, timeout=240)
+        assert r.returncode == 0, r.stderr[-500:]
+        blobs[label] = r.stdout
+    assert blobs["serial"] == blobs["piped"]
+
+
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_q4_allreduce_accuracy_and_consensus(size):
+    """ring_q4_wire at P=2..4: within the q4 per-hop bound of the exact
+    sum, and ALL ranks byte-identical (verbatim allgather forwarding)."""
+    count = 10_007
+
+    def fn(ctx, rank):
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(count).astype(np.float32) * (rank + 1)
+        ctx.allreduce(x, algorithm="ring_q4_wire")
+        return x
+
+    results = spawn(size, fn, timeout=90)
+    scale = sum(r + 1 for r in range(size))
+    exact = (np.random.default_rng(11).standard_normal(count)
+             .astype(np.float32) * scale)
+    rel = (np.abs(results[0] - exact).max() /
+           max(np.abs(exact).max(), 1e-9))
+    # Per-hop bound is max/14 (~7%); P-1 hops + final quantization.
+    assert rel < 0.2 * size, rel
+    for r in range(1, size):
+        assert np.array_equal(results[0], results[r]), f"rank {r} differs"
+
+
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_q4_reduce_scatter_consensus(size):
+    """ring_q4_wire reduce_scatter at P=2..4 (wire="q4" shorthand):
+    result blocks approximate the exact segment (f32 accumulator, only
+    hops quantize)."""
+    counts = [700 + 13 * r for r in range(size)]
+
+    def fn(ctx, rank):
+        x = np.arange(sum(counts), dtype=np.float32) * (rank + 1) / 100.0
+        return ctx.reduce_scatter(x, recv_counts=counts, wire="q4")
+
+    results = spawn(size, fn, timeout=90)
+    total = sum(r + 1 for r in range(size))
+    full = np.arange(sum(counts), dtype=np.float32) * total / 100.0
+    offs = np.cumsum([0] + counts)
+    for r in range(size):
+        seg = full[offs[r]:offs[r + 1]]
+        rel = (np.abs(results[r] - seg).max() /
+               max(np.abs(seg).max(), 1e-9))
+        assert rel < 0.1 * size, (r, rel)
+
+
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_pipelined_q8_allreduce_consensus(size):
+    """The default (pipelined, depth 4) q8 hop across P=2..4 — the
+    engine rewrite must preserve the q8 consensus contract unchanged."""
+    count = 4 * BLOCK * size + 17
+
+    def fn(ctx, rank):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal(count).astype(np.float32) * (rank + 1)
+        ctx.allreduce(x, algorithm="ring_q8_wire")
+        return x
+
+    results = spawn(size, fn, timeout=90)
+    for r in range(1, size):
+        assert np.array_equal(results[0], results[r]), f"rank {r} differs"
+
+
+def test_q4_wire_kwarg_and_conflicts():
+    def fn(ctx, rank):
+        x = np.ones(5000, dtype=np.float32)
+        ctx.allreduce(x, wire="q4")
+        out = x.copy()
+        with pytest.raises(Error):
+            ctx.allreduce(x, wire="q4", algorithm="ring")
+        with pytest.raises(Error):
+            ctx.allreduce(np.ones(16, dtype=np.int32), wire="q4")
+        with pytest.raises(Error):
+            ctx.allreduce(np.ones(16, dtype=np.float32), op="max",
+                          wire="q4")
+        return out
+
+    results = spawn(2, fn, timeout=60)
+    assert np.array_equal(results[0], results[1])
+    # ones are exactly representable at any block scale: lossless here.
+    assert np.array_equal(results[0], np.full(5000, 2.0, dtype=np.float32))
+
+
+def test_q4_swept_but_not_auto_elected():
+    """The tuner sweeps ring_q4_wire (headroom data) and the table JSON
+    round-trips the new algorithm id, but plain kAuto never elects a
+    lossy arm — q4 is reachable only through the lossy opt-in."""
+    def fn(ctx, rank):
+        table = gloo_tpu.tuning.tune(ctx, min_bytes=1 << 10,
+                                     max_bytes=1 << 12, iters=1, warmup=0)
+        ctx.allreduce(np.ones(256, dtype=np.float32), tag=7)
+        algos = [e.get("algo") for e in ctx.flightrec()["events"]
+                 if e.get("op") == "allreduce"]
+        return table, algos
+
+    table, algos = spawn(2, fn, timeout=240)[0]
+    swept = {e["algorithm"] for e in table["entries"]
+             if e["collective"] == "allreduce"}
+    assert "ring_q4_wire" in swept, swept
+    rs_swept = {e["algorithm"] for e in table["entries"]
+                if e["collective"] == "reduce_scatter"}
+    assert "ring_q4_wire" in rs_swept, rs_swept
+    # The post-tune dispatch (plain auto) stayed lossless.
+    assert algos[-1] not in ("ring_q4_wire", "ring_q8_wire",
+                             "ring_bf16_wire"), algos
+
+
+# ---------------------------------------------------------------------------
+# Chaos determinism with the codec pool on
+# ---------------------------------------------------------------------------
+
+def test_chaos_same_seed_determinism_with_codec_pool():
+    """Same-seed chaos with a 4-wide codec pool and a deep pipeline:
+    worker threads shard the codec dynamically, but shard boundaries are
+    deterministic, so two runs produce byte-identical fault reports AND
+    byte-identical collective results."""
+    code = """
+import json, sys
+import numpy as np
+import gloo_tpu
+from gloo_tpu import fault
+from tests.harness import spawn
+
+schedule = {"seed": 2222, "faults": [
+    {"when": {"rank": 1, "opcode": "data", "min_bytes": 64},
+     "action": "delay", "ms": 1, "prob": 0.5, "seed": 77},
+]}
+
+def workload():
+    def fn(ctx, rank):
+        rng = np.random.default_rng(4)
+        base = rng.standard_normal(3 * 256 * 4).astype(np.float32)
+        outs = []
+        for i in range(4):
+            x = base * (rank + 1 + i)
+            ctx.allreduce(x, algorithm="ring_q8_wire", tag=10 + i)
+            outs.append(x)
+        return outs
+    results = spawn(3, fn, timeout=120)
+    for i in range(4):
+        assert np.array_equal(results[0][i], results[1][i])
+        assert np.array_equal(results[0][i], results[2][i])
+    rep = [json.dumps(fault.report(rank=r), sort_keys=True)
+           for r in range(3)]
+    return rep, results[0]
+
+fault.install(schedule)
+rep1, out1 = workload()
+fault.install(schedule)
+rep2, out2 = workload()
+fault.clear()
+assert rep1 == rep2
+for a, b in zip(out1, out2):
+    assert np.array_equal(a, b)
+print("CHAOS_OK")
+"""
+    env = dict(os.environ, TPUCOLL_SKIP_BUILD="1",
+               TPUCOLL_CODEC_THREADS="4", TPUCOLL_CODEC_PIPELINE="6")
+    env["PYTHONPATH"] = os.getcwd()
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300,
+                       cwd=os.getcwd())
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "CHAOS_OK" in r.stdout

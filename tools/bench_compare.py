@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 # else in a row is payload.
 KEY_FIELDS = ("metric", "op", "algorithm", "collective", "elements",
               "bytes", "ranks", "hosts", "nranks", "plane", "engine",
-              "schedule", "world", "unit")
+              "schedule", "world", "unit", "arm", "codec_threads")
 # Lower-is-better value fields, in preference order. The *_on fields
 # pick the instrumented arm out of overhead A/B rows so observability
 # rounds stay comparable across rounds.

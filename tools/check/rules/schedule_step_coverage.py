@@ -9,7 +9,16 @@ default/throw arm precisely so malformed programs fail loudly — but the
 new op then verifies or lowers as "bad step" at runtime instead of at
 review time. This rule fails the build the moment an enumerator is
 missing a `case StepOp::kX` in either switch file or a name mapping in
-ir.cc, and flags cases for enumerators that no longer exist."""
+ir.cc, and flags cases for enumerators that no longer exist.
+
+The same review-time gap exists for per-step ATTRIBUTES: a field added
+to `struct Step` (ir.h) that toJson/fromJson never round-trip silently
+drops to its default through the TPUCOLL_SCHEDULE_FILE interchange —
+the schedule runs, just not the schedule that was written (the
+pipeline-depth attribute is exactly this shape). So the rule also
+requires every Step data member to appear as a quoted JSON key in
+ir.cc at least twice: once emitted (toJson) and once parsed
+(fromJson)."""
 
 from __future__ import annotations
 
@@ -23,6 +32,12 @@ _ENUMERATOR = re.compile(r"\bk[A-Z]\w*")
 _CASE = re.compile(r"\bcase\s+StepOp::(k\w+)")
 # ir.cc's name table pairs each enumerator with its wire spelling.
 _NAME_MAP = re.compile(r"StepOp::(k\w+)")
+_STEP_STRUCT = re.compile(r"struct\s+Step\s*\{(.*?)\n\};", re.S)
+# A data member: `Type name{...};` or `Type name = ...;` — constants
+# (static constexpr) and comments are not serialized state.
+_MEMBER = re.compile(
+    r"^\s*(?!static\b)[A-Za-z_][\w:]*(?:<[^>]*>)?\s+"
+    r"(\w+)\s*(?:\{[^;]*\}|=[^;]*)?;", re.M)
 
 
 class ScheduleStepCoverageRule(Rule):
@@ -43,6 +58,15 @@ class ScheduleStepCoverageRule(Rule):
         if m is None:
             return set()
         return set(_ENUMERATOR.findall(m.group(1)))
+
+    def _step_members(self, corpus: Corpus) -> Set[str]:
+        raw = corpus.text(self.ir_header)
+        if raw is None:
+            return set()
+        m = _STEP_STRUCT.search(raw)
+        if m is None:
+            return set()
+        return set(_MEMBER.findall(m.group(1)))
 
     def run(self, corpus: Corpus) -> List[Violation]:
         out: List[Violation] = []
@@ -75,4 +99,23 @@ class ScheduleStepCoverageRule(Rule):
                     f"stale:{path}:{op}", path, line,
                     f"{path} handles StepOp::{op} which {self.ir_header} "
                     f"no longer declares — dead case from a removed op"))
+
+        # ---- step-attribute JSON round-trip ----
+        raw = corpus.text(self.name_table)
+        if raw is not None:
+            for member in sorted(self._step_members(corpus)):
+                # Emitted keys live inside C++ string literals
+                # (\"pipeline\"), parsed keys are plain ("pipeline");
+                # a round-tripped attribute shows up at least twice.
+                hits = len(re.findall(
+                    r'\\?"' + re.escape(member) + r'\\?"', raw))
+                if hits < 2:
+                    out.append(self.violation(
+                        f"unserialized:{member}", self.ir_header, 1,
+                        f"Step::{member} is declared in {self.ir_header} "
+                        f"but {self.name_table} round-trips it "
+                        f"{hits} time(s) — a per-step attribute must be "
+                        f"emitted by toJson AND parsed by fromJson or "
+                        f"it silently drops to its default through the "
+                        f"schedule file"))
         return out
